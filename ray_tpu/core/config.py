@@ -1,0 +1,124 @@
+"""Typed runtime flag registry.
+
+Equivalent of the reference's RAY_CONFIG system
+(/root/reference/src/ray/common/ray_config_def.h: 181 typed flags overridable
+via env vars or an init-time JSON blob, propagated cluster-wide).  Here flags
+are declared once, read from ``RAY_TPU_<NAME>`` environment variables, and the
+resolved mapping is shipped to every node/worker at bootstrap so the whole
+cluster sees one consistent configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name, type_, default, doc):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+
+class Config:
+    """Registry of typed flags with env-var and JSON overrides."""
+
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._values: Dict[str, Any] = {}
+
+    def define(self, name: str, type_, default, doc: str = ""):
+        self._flags[name] = _Flag(name, type_, default, doc)
+        env = os.environ.get(f"RAY_TPU_{name.upper()}")
+        if env is not None:
+            self._values[name] = self._parse(type_, env)
+        else:
+            self._values[name] = default
+
+    @staticmethod
+    def _parse(type_, text: str):
+        if type_ is bool:
+            return text.lower() in ("1", "true", "yes", "on")
+        if type_ in (dict, list):
+            return json.loads(text)
+        return type_(text)
+
+    def update(self, overrides: Dict[str, Any]):
+        """Apply a JSON-style override dict (e.g. ``init(system_config=...)``)."""
+        for k, v in overrides.items():
+            if k not in self._flags:
+                raise KeyError(f"Unknown config flag: {k}")
+            f = self._flags[k]
+            self._values[k] = self._parse(f.type, v) if isinstance(v, str) and f.type is not str else v
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def load_snapshot(self, snap: Dict[str, Any]):
+        self._values.update(snap)
+
+    def __getattr__(self, name):
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def doc(self) -> str:
+        lines = []
+        for f in sorted(self._flags.values(), key=lambda f: f.name):
+            lines.append(f"{f.name} ({f.type.__name__}, default={f.default!r}): {f.doc}")
+        return "\n".join(lines)
+
+
+GlobalConfig = Config()
+_d = GlobalConfig.define
+
+# --- core runtime -----------------------------------------------------------
+_d("object_store_memory_mb", int, 2048, "Per-node shared-memory object store size.")
+_d("max_direct_call_object_size", int, 100 * 1024,
+   "Task returns at or below this many bytes ride the RPC reply into the "
+   "caller's in-process memory store instead of the shared-memory store "
+   "(reference: ray_config_def.h max_direct_call_object_size=100KiB).")
+_d("object_transfer_chunk_bytes", int, 4 * 1024 * 1024,
+   "Chunk size for node-to-node object push (reference: object_manager.proto).")
+_d("worker_pool_initial_size", int, 2, "Workers prestarted per node.")
+_d("worker_pool_max_size", int, 16, "Hard cap on workers per node.")
+_d("worker_lease_idle_seconds", float, 5.0,
+   "Leased workers are returned to the pool after this long with no task.")
+_d("heartbeat_interval_s", float, 0.5, "Nodelet -> controller resource report period.")
+_d("node_death_timeout_s", float, 5.0, "Heartbeat silence after which a node is dead.")
+_d("task_retry_delay_s", float, 0.2, "Delay before resubmitting a failed task.")
+_d("default_max_retries", int, 3, "Default retries for idempotent tasks.")
+_d("actor_restart_delay_s", float, 0.2, "Delay before restarting a dead actor.")
+_d("scheduler_spread_threshold", float, 0.5,
+   "Hybrid policy: below this critical-resource utilization nodes score equal "
+   "(pack); above it, weighted by utilization (spread). Mirrors the reference "
+   "hybrid_scheduling_policy.h rationale.")
+_d("scheduler_top_k_fraction", float, 0.2,
+   "Randomize among this fraction of best-scoring nodes to avoid herding.")
+_d("lease_request_timeout_s", float, 30.0, "Timeout for a worker lease grant.")
+_d("rpc_connect_retries", int, 60, "TCP connect retries (20ms backoff) at bootstrap.")
+_d("pull_retry_interval_s", float, 0.5, "Retry period for remote object pulls.")
+_d("inline_small_args_bytes", int, 64 * 1024,
+   "Task args at or below this size are inlined into the task spec.")
+_d("log_to_driver", bool, True, "Forward worker stdout/stderr lines to the driver.")
+_d("metrics_report_interval_s", float, 2.0, "Worker metric push period.")
+
+# --- TPU / accelerator ------------------------------------------------------
+_d("tpu_autodetect", bool, True, "Detect local TPU chips via JAX at node start.")
+_d("tpu_chips_per_host_override", int, 0, "Force the advertised TPU chip count (0=auto).")
+_d("tpu_topology_override", str, "", "Force the advertised slice topology, e.g. 'v5e-8'.")
+
+# --- train ------------------------------------------------------------------
+_d("train_default_checkpoint_keep", int, 2, "Checkpoints retained by CheckpointManager.")
+
+# --- serve ------------------------------------------------------------------
+_d("serve_default_max_concurrent_queries", int, 100,
+   "Per-replica in-flight cap used by the router.")
+_d("serve_http_host", str, "127.0.0.1", "HTTP proxy bind host.")
+_d("serve_http_port", int, 8000, "HTTP proxy bind port.")

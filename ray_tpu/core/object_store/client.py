@@ -1,0 +1,205 @@
+"""ctypes client for the shared-memory object store.
+
+The C++ library (store.cc) manages the index/allocator; data access happens
+through Python's own ``mmap`` of the same segment, so ``get`` returns
+zero-copy memoryviews over store memory (the reference gets the same via
+plasma fd-passing + PyArrow buffers; here the segment is a file in /dev/shm
+that every worker on the node maps).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "store.cc")
+_LIB = os.path.join(_HERE, "libtpustore.so")
+
+ID_LEN = 24
+
+RTS_OK = 0
+RTS_ERR_FULL = -1
+RTS_ERR_EXISTS = -2
+RTS_ERR_NOT_FOUND = -3
+RTS_ERR_TIMEOUT = -4
+RTS_ERR_STATE = -5
+RTS_ERR_SYS = -6
+RTS_ERR_TOO_MANY = -7
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+class StoreError(Exception):
+    pass
+
+
+class StoreFullError(StoreError):
+    pass
+
+
+class ObjectExistsError(StoreError):
+    pass
+
+
+def _ensure_built() -> str:
+    with _build_lock:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            tmp = _LIB + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC],
+                check=True, capture_output=True,
+            )
+            os.replace(tmp, _LIB)
+    return _LIB
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_ensure_built())
+    lib.rts_create_segment.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.rts_create_segment.restype = ctypes.c_int
+    lib.rts_open.argtypes = [ctypes.c_char_p]
+    lib.rts_open.restype = ctypes.c_void_p
+    lib.rts_close.argtypes = [ctypes.c_void_p]
+    lib.rts_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.rts_create.restype = ctypes.c_int64
+    for name in ("rts_seal", "rts_abort", "rts_release", "rts_contains", "rts_delete"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        fn.restype = ctypes.c_int
+    lib.rts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    lib.rts_get.restype = ctypes.c_int
+    lib.rts_stats.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 5
+    lib.rts_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.rts_list.restype = ctypes.c_int64
+    lib.rts_segment_size.argtypes = [ctypes.c_void_p]
+    lib.rts_segment_size.restype = ctypes.c_uint64
+    _lib = lib
+    return lib
+
+
+def create_segment(path: str, capacity_bytes: int, max_objects: int = 0):
+    lib = _load()
+    rc = lib.rts_create_segment(path.encode(), capacity_bytes, max_objects)
+    if rc != RTS_OK:
+        raise StoreError(f"create_segment({path}) failed: rc={rc} errno={ctypes.get_errno()}")
+
+
+class StoreClient:
+    """Per-process handle on the node's object store segment."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lib = _load()
+        self._h = self._lib.rts_open(path.encode())
+        if not self._h:
+            raise StoreError(f"cannot open store segment {path}")
+        size = self._lib.rts_segment_size(self._h)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mm)
+        self._closed = False
+
+    # -- write path ---------------------------------------------------------
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        """Reserve space; returns a writable view.  Call seal() when done."""
+        assert len(object_id) == ID_LEN
+        off = self._lib.rts_create(self._h, object_id, size)
+        if off == RTS_ERR_EXISTS:
+            raise ObjectExistsError(object_id.hex())
+        if off == RTS_ERR_FULL:
+            raise StoreFullError(f"object store full creating {size} bytes")
+        if off < 0:
+            raise StoreError(f"create failed rc={off}")
+        return self._view[off: off + size]
+
+    def seal(self, object_id: bytes):
+        rc = self._lib.rts_seal(self._h, object_id)
+        if rc != RTS_OK:
+            raise StoreError(f"seal failed rc={rc}")
+
+    def abort(self, object_id: bytes):
+        self._lib.rts_abort(self._h, object_id)
+
+    def put_parts(self, object_id: bytes, parts: List[memoryview]) -> int:
+        """Create+write+seal in one call; returns total bytes.  Idempotent:
+        an existing object is left in place (objects are immutable)."""
+        total = sum(p.nbytes for p in parts)
+        try:
+            dest = self.create(object_id, total)
+        except ObjectExistsError:
+            return total
+        off = 0
+        try:
+            for p in parts:
+                dest[off: off + p.nbytes] = p
+                off += p.nbytes
+        except BaseException:
+            del dest
+            self.abort(object_id)
+            raise
+        del dest
+        self.seal(object_id)
+        return total
+
+    # -- read path ----------------------------------------------------------
+    def get(self, object_id: bytes, timeout_ms: int = 0) -> Optional[memoryview]:
+        """Returns a zero-copy view or None on timeout.  Caller must
+        release() when the view (and anything aliasing it) is dropped."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rts_get(self._h, object_id, timeout_ms,
+                               ctypes.byref(off), ctypes.byref(size))
+        if rc == RTS_ERR_TIMEOUT:
+            return None
+        if rc != RTS_OK:
+            raise StoreError(f"get failed rc={rc}")
+        # Read-only: objects are immutable; a writable view would let readers
+        # corrupt shared store memory.
+        return self._view[off.value: off.value + size.value].toreadonly()
+
+    def release(self, object_id: bytes):
+        self._lib.rts_release(self._h, object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.rts_contains(self._h, object_id))
+
+    def delete(self, object_id: bytes):
+        self._lib.rts_delete(self._h, object_id)
+
+    def list_objects(self) -> List[bytes]:
+        buf = ctypes.create_string_buffer(ID_LEN * 65536)
+        n = self._lib.rts_list(self._h, buf, 65536)
+        raw = buf.raw
+        return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
+
+    def stats(self) -> Dict[str, int]:
+        vals = [ctypes.c_uint64() for _ in range(5)]
+        self._lib.rts_stats(self._h, *[ctypes.byref(v) for v in vals])
+        keys = ["used_bytes", "capacity_bytes", "num_objects", "num_evictions", "num_creates"]
+        return dict(zip(keys, [v.value for v in vals]))
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._view.release()
+            self._mm.close()
+        except BufferError:
+            pass  # outstanding zero-copy views; let the mapping die with us
+        self._lib.rts_close(self._h)
+        self._h = None

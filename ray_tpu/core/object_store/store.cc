@@ -1,0 +1,516 @@
+// ray_tpu shared-memory object store.
+//
+// Native equivalent of the reference's plasma store
+// (/root/reference/src/ray/object_manager/plasma/store.h: mmap'd arena +
+// dlmalloc + LRU eviction + fd passing over unix sockets).  Re-designed
+// rather than ported: the object index, allocator metadata and
+// synchronization primitives all live INSIDE one mmap'd shared-memory
+// segment, so every client on the node performs create/seal/get/release as a
+// lock-protected direct memory operation -- there is no store server process
+// and no per-operation IPC round trip at all (plasma pays a unix-socket
+// round trip per create/get; we pay a futex).  Payload buffers are 64-byte
+// aligned so jax.device_put can DMA straight out of the segment.
+//
+// Concurrency: one process-shared robust pthread mutex + condvar in the
+// header.  Robustness matters: if a worker dies holding the lock, the next
+// locker gets EOWNERDEAD and recovers.  Object state machine:
+// CREATED -> SEALED -> (refcnt==0, evictable) -> evicted/deleted,
+// mirroring plasma's ObjectLifecycleManager.
+//
+// Allocator: implicit free list with boundary tags, first-fit, coalescing
+// on free; LRU eviction of sealed refcount-0 objects when allocation fails
+// (plasma: eviction_policy.h LRUCache).
+
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <stdio.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <time.h>
+
+#include <new>
+
+extern "C" {
+
+#define RTS_OK 0
+#define RTS_ERR_FULL -1        // out of memory even after eviction
+#define RTS_ERR_EXISTS -2      // object already exists
+#define RTS_ERR_NOT_FOUND -3   // no such object
+#define RTS_ERR_TIMEOUT -4     // get timed out waiting for seal
+#define RTS_ERR_STATE -5       // wrong state for operation (e.g. seal twice)
+#define RTS_ERR_SYS -6         // system error (open/mmap)
+#define RTS_ERR_TOO_MANY -7    // object index full
+
+static const uint64_t MAGIC = 0x52545053544f5231ull;  // "RTPSTOR1"
+static const uint32_t ID_LEN = 24;
+static const uint64_t ALIGN = 64;
+
+enum ObjState : uint32_t {
+  FREE_SLOT = 0,
+  CREATED = 1,
+  SEALED = 2,
+};
+
+struct Entry {
+  uint8_t id[ID_LEN];
+  uint32_t state;
+  int32_t refcnt;
+  uint64_t offset;   // payload offset from segment base
+  uint64_t size;     // payload size
+  uint64_t lru;      // last-touch tick
+  uint32_t deleted;  // delete requested; reap when refcnt hits 0
+  uint32_t _pad;
+};
+
+// Block header for the arena allocator.  Blocks are laid out back to back;
+// size includes the header and footer.  Footer is a trailing uint64 copy of
+// size|free so the previous block can be found for coalescing.  The header
+// is padded to 64 bytes so payloads stay 64-byte aligned (blocks themselves
+// are 64-aligned because all sizes are rounded up to 64).
+struct Block {
+  uint64_t size_free;  // low bit: 1 = free
+  uint64_t entry_idx;  // owning entry when allocated (for diagnostics)
+  uint8_t _pad[48];
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t nentries;
+  uint64_t entries_off;
+  uint64_t arena_off;
+  uint64_t arena_size;
+  pthread_mutex_t mtx;
+  pthread_cond_t cv;
+  uint64_t lru_tick;
+  uint64_t used_bytes;       // payload bytes in live objects
+  uint64_t num_objects;
+  uint64_t num_evictions;
+  uint64_t num_creates;
+};
+
+struct Handle {
+  int fd;
+  uint8_t* base;
+  uint64_t size;
+  Header* hdr;
+};
+
+static inline uint64_t bsize(Block* b) { return b->size_free & ~1ull; }
+static inline int bfree(Block* b) { return (int)(b->size_free & 1ull); }
+static inline void bset(Block* b, uint64_t size, int fr) {
+  b->size_free = size | (fr ? 1ull : 0ull);
+  // footer
+  *(uint64_t*)((uint8_t*)b + size - 8) = b->size_free;
+}
+static const uint64_t BHDR = sizeof(Block);
+static const uint64_t BFTR = 8;
+static const uint64_t BMIN = BHDR + BFTR + ALIGN;
+
+static inline uint8_t* payload_ptr(Block* b) { return (uint8_t*)b + BHDR; }
+static inline Block* block_of_payload(uint8_t* p) { return (Block*)(p - BHDR); }
+
+static int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mtx);
+  if (rc == EOWNERDEAD) {
+    // A client died holding the lock.  State under the lock is always
+    // consistent for our operations (single-word writes ordered carefully
+    // is overkill; we accept the segment as-is and mark consistent).
+    pthread_mutex_consistent(&h->mtx);
+    rc = 0;
+  }
+  return rc;
+}
+static void unlock(Header* h) { pthread_mutex_unlock(&h->mtx); }
+
+// --- object index: linear-probed open addressing over Entry slots ---------
+
+static uint64_t id_hash(const uint8_t* id) {
+  // FNV-1a over the 24-byte id.
+  uint64_t x = 1469598103934665603ull;
+  for (uint32_t i = 0; i < ID_LEN; i++) { x ^= id[i]; x *= 1099511628211ull; }
+  return x;
+}
+
+static Entry* entries(Handle* h) { return (Entry*)(h->base + h->hdr->entries_off); }
+
+static Entry* find_entry(Handle* h, const uint8_t* id) {
+  Header* hd = h->hdr;
+  Entry* es = entries(h);
+  uint64_t n = hd->nentries;
+  uint64_t i = id_hash(id) % n;
+  for (uint64_t probe = 0; probe < n; probe++) {
+    Entry* e = &es[(i + probe) % n];
+    if (e->state == FREE_SLOT) return nullptr;
+    if (memcmp(e->id, id, ID_LEN) == 0 && e->state != FREE_SLOT) return e;
+  }
+  return nullptr;
+}
+
+static Entry* alloc_entry(Handle* h, const uint8_t* id) {
+  Header* hd = h->hdr;
+  Entry* es = entries(h);
+  uint64_t n = hd->nentries;
+  uint64_t i = id_hash(id) % n;
+  for (uint64_t probe = 0; probe < n; probe++) {
+    Entry* e = &es[(i + probe) % n];
+    if (e->state == FREE_SLOT) {
+      memcpy(e->id, id, ID_LEN);
+      e->deleted = 0;
+      return e;
+    }
+  }
+  return nullptr;
+}
+
+// Removing entries from a linear-probed table requires tombstone-free
+// re-insertion of the probe chain (Knuth 6.4 algorithm R).
+static void remove_entry(Handle* h, Entry* victim) {
+  Header* hd = h->hdr;
+  Entry* es = entries(h);
+  uint64_t n = hd->nentries;
+  uint64_t gap = (uint64_t)(victim - es);
+  victim->state = FREE_SLOT;
+  uint64_t i = gap;
+  for (;;) {
+    i = (i + 1) % n;
+    Entry* e = &es[i];
+    if (e->state == FREE_SLOT) break;
+    // e (at slot i, home slot `home`) must be moved into the gap iff the gap
+    // lies cyclically within [home, i) — otherwise lookups for e would stop
+    // at the gap and miss it (Knuth 6.4 algorithm R).
+    uint64_t home = id_hash(e->id) % n;
+    uint64_t dist_gap = (gap + n - home) % n;
+    uint64_t dist_e = (i + n - home) % n;
+    if (dist_gap < dist_e) {
+      es[gap] = *e;
+      e->state = FREE_SLOT;
+      gap = i;
+    }
+  }
+}
+
+// --- arena allocator -------------------------------------------------------
+
+static Block* first_block(Handle* h) { return (Block*)(h->base + h->hdr->arena_off); }
+static uint8_t* arena_end(Handle* h) {
+  return h->base + h->hdr->arena_off + h->hdr->arena_size;
+}
+
+static Block* next_block(Handle* h, Block* b) {
+  uint8_t* p = (uint8_t*)b + bsize(b);
+  return p >= arena_end(h) ? nullptr : (Block*)p;
+}
+
+static Block* prev_block(Handle* h, Block* b) {
+  if ((uint8_t*)b == h->base + h->hdr->arena_off) return nullptr;
+  uint64_t psz = *(uint64_t*)((uint8_t*)b - 8) & ~1ull;
+  return (Block*)((uint8_t*)b - psz);
+}
+
+static void free_block(Handle* h, Block* b) {
+  bset(b, bsize(b), 1);
+  // coalesce with next then prev
+  Block* nb = next_block(h, b);
+  if (nb && bfree(nb)) bset(b, bsize(b) + bsize(nb), 1);
+  Block* pb = prev_block(h, b);
+  if (pb && bfree(pb)) bset(pb, bsize(pb) + bsize(b), 1);
+}
+
+static Block* try_alloc(Handle* h, uint64_t need) {
+  for (Block* b = first_block(h); b; b = next_block(h, b)) {
+    if (!bfree(b) || bsize(b) < need) continue;
+    uint64_t remain = bsize(b) - need;
+    if (remain >= BMIN) {
+      bset(b, need, 0);
+      Block* rest = (Block*)((uint8_t*)b + need);
+      bset(rest, remain, 1);
+    } else {
+      bset(b, bsize(b), 0);
+    }
+    return b;
+  }
+  return nullptr;
+}
+
+static int evict_lru(Handle* h) {
+  // Evict the least-recently-used sealed object with refcnt==0.
+  Header* hd = h->hdr;
+  Entry* es = entries(h);
+  Entry* best = nullptr;
+  for (uint64_t i = 0; i < hd->nentries; i++) {
+    Entry* e = &es[i];
+    if (e->state == SEALED && e->refcnt == 0 &&
+        (!best || e->lru < best->lru)) best = e;
+  }
+  if (!best) return 0;
+  free_block(h, block_of_payload(h->base + best->offset));
+  hd->used_bytes -= best->size;
+  hd->num_objects--;
+  hd->num_evictions++;
+  remove_entry(h, best);
+  return 1;
+}
+
+// Allocate `size` payload bytes, evicting as needed.  Returns payload ptr.
+static uint8_t* arena_alloc(Handle* h, uint64_t size, uint64_t* entry_idx) {
+  uint64_t need = BHDR + size + BFTR;
+  need = (need + ALIGN - 1) & ~(ALIGN - 1);
+  if (need < BMIN) need = BMIN;
+  for (;;) {
+    Block* b = try_alloc(h, need);
+    if (b) { b->entry_idx = entry_idx ? *entry_idx : 0; return payload_ptr(b); }
+    if (!evict_lru(h)) return nullptr;
+  }
+}
+
+// --- public API -------------------------------------------------------------
+
+int rts_create_segment(const char* path, uint64_t capacity, uint64_t max_objects) {
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return RTS_ERR_SYS;
+  if (max_objects == 0) max_objects = 1 << 16;
+  uint64_t entries_bytes = max_objects * sizeof(Entry);
+  uint64_t header_bytes = (sizeof(Header) + ALIGN - 1) & ~(ALIGN - 1);
+  uint64_t entries_off = header_bytes;
+  uint64_t arena_off = (entries_off + entries_bytes + ALIGN - 1) & ~(ALIGN - 1);
+  uint64_t total = arena_off + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) { close(fd); unlink(path); return RTS_ERR_SYS; }
+  uint8_t* base = (uint8_t*)mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { close(fd); unlink(path); return RTS_ERR_SYS; }
+  Header* hd = new (base) Header();
+  hd->segment_size = total;
+  hd->nentries = max_objects;
+  hd->entries_off = entries_off;
+  hd->arena_off = arena_off;
+  hd->arena_size = capacity;
+  hd->lru_tick = 1;
+  hd->used_bytes = 0;
+  hd->num_objects = 0;
+  hd->num_evictions = 0;
+  hd->num_creates = 0;
+  memset(base + entries_off, 0, entries_bytes);
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hd->mtx, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&hd->cv, &ca);
+
+  Block* b0 = (Block*)(base + arena_off);
+  bset(b0, capacity, 1);
+  hd->magic = MAGIC;  // last: marks segment valid
+  msync(base, header_bytes, MS_SYNC);
+  munmap(base, total);
+  close(fd);
+  return RTS_OK;
+}
+
+void* rts_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  uint8_t* base = (uint8_t*)mmap(nullptr, (size_t)st.st_size,
+                                 PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { close(fd); return nullptr; }
+  Header* hd = (Header*)base;
+  if (hd->magic != MAGIC) { munmap(base, st.st_size); close(fd); return nullptr; }
+  Handle* h = new Handle{fd, base, (uint64_t)st.st_size, hd};
+  return h;
+}
+
+void rts_close(void* vh) {
+  Handle* h = (Handle*)vh;
+  if (!h) return;
+  munmap(h->base, h->size);
+  close(h->fd);
+  delete h;
+}
+
+// Create an object of `size` bytes; returns payload offset from segment base
+// (writer holds an implicit reference until seal/abort).
+int64_t rts_create(void* vh, const uint8_t* id, uint64_t size) {
+  Handle* h = (Handle*)vh;
+  Header* hd = h->hdr;
+  lock(hd);
+  if (find_entry(h, id)) { unlock(hd); return RTS_ERR_EXISTS; }
+  // Allocate BEFORE claiming an index slot: eviction inside arena_alloc
+  // relocates index entries (algorithm R), which would break the probe-chain
+  // invariant for a half-inserted slot.
+  uint64_t idx = 0;
+  uint8_t* p = arena_alloc(h, size ? size : 1, &idx);
+  if (!p) { unlock(hd); return RTS_ERR_FULL; }
+  Entry* e = alloc_entry(h, id);
+  if (!e) {
+    free_block(h, block_of_payload(p));
+    unlock(hd);
+    return RTS_ERR_TOO_MANY;
+  }
+  e->state = CREATED;
+  e->refcnt = 1;  // creator's reference
+  e->offset = (uint64_t)(p - h->base);
+  e->size = size;
+  e->lru = hd->lru_tick++;
+  hd->used_bytes += size;
+  hd->num_objects++;
+  hd->num_creates++;
+  int64_t off = (int64_t)e->offset;
+  unlock(hd);
+  return off;
+}
+
+int rts_seal(void* vh, const uint8_t* id) {
+  Handle* h = (Handle*)vh;
+  Header* hd = h->hdr;
+  lock(hd);
+  Entry* e = find_entry(h, id);
+  if (!e) { unlock(hd); return RTS_ERR_NOT_FOUND; }
+  if (e->state != CREATED) { unlock(hd); return RTS_ERR_STATE; }
+  e->state = SEALED;
+  e->refcnt -= 1;  // drop creator's write reference
+  e->lru = hd->lru_tick++;
+  pthread_cond_broadcast(&hd->cv);
+  unlock(hd);
+  return RTS_OK;
+}
+
+// Abort an unsealed create (e.g. writer failed mid-copy).
+int rts_abort(void* vh, const uint8_t* id) {
+  Handle* h = (Handle*)vh;
+  Header* hd = h->hdr;
+  lock(hd);
+  Entry* e = find_entry(h, id);
+  if (!e) { unlock(hd); return RTS_ERR_NOT_FOUND; }
+  if (e->state != CREATED) { unlock(hd); return RTS_ERR_STATE; }
+  free_block(h, block_of_payload(h->base + e->offset));
+  hd->used_bytes -= e->size;
+  hd->num_objects--;
+  remove_entry(h, e);
+  unlock(hd);
+  return RTS_OK;
+}
+
+// Blocking get: waits up to timeout_ms for the object to be sealed.
+// On success increments refcnt and writes offset/size.  timeout_ms < 0
+// waits forever; timeout_ms == 0 is a try-get.
+int rts_get(void* vh, const uint8_t* id, int64_t timeout_ms,
+            uint64_t* offset, uint64_t* size) {
+  Handle* h = (Handle*)vh;
+  Header* hd = h->hdr;
+  struct timespec deadline;
+  if (timeout_ms > 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) { deadline.tv_sec++; deadline.tv_nsec -= 1000000000L; }
+  }
+  lock(hd);
+  for (;;) {
+    Entry* e = find_entry(h, id);
+    if (e && e->state == SEALED && !e->deleted) {
+      e->refcnt++;
+      e->lru = hd->lru_tick++;
+      *offset = e->offset;
+      *size = e->size;
+      unlock(hd);
+      return RTS_OK;
+    }
+    if (timeout_ms == 0) { unlock(hd); return RTS_ERR_TIMEOUT; }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&hd->cv, &hd->mtx);
+    } else {
+      rc = pthread_cond_timedwait(&hd->cv, &hd->mtx, &deadline);
+    }
+    if (rc == ETIMEDOUT) { unlock(hd); return RTS_ERR_TIMEOUT; }
+  }
+}
+
+int rts_release(void* vh, const uint8_t* id) {
+  Handle* h = (Handle*)vh;
+  Header* hd = h->hdr;
+  lock(hd);
+  Entry* e = find_entry(h, id);
+  if (!e) { unlock(hd); return RTS_ERR_NOT_FOUND; }
+  if (e->refcnt > 0) e->refcnt--;
+  if (e->deleted && e->refcnt == 0) {
+    free_block(h, block_of_payload(h->base + e->offset));
+    hd->used_bytes -= e->size;
+    hd->num_objects--;
+    remove_entry(h, e);
+  }
+  unlock(hd);
+  return RTS_OK;
+}
+
+int rts_contains(void* vh, const uint8_t* id) {
+  Handle* h = (Handle*)vh;
+  lock(h->hdr);
+  Entry* e = find_entry(h, id);
+  int r = (e && e->state == SEALED && !e->deleted) ? 1 : 0;
+  unlock(h->hdr);
+  return r;
+}
+
+int rts_delete(void* vh, const uint8_t* id) {
+  Handle* h = (Handle*)vh;
+  Header* hd = h->hdr;
+  lock(hd);
+  Entry* e = find_entry(h, id);
+  if (!e) { unlock(hd); return RTS_ERR_NOT_FOUND; }
+  if (e->refcnt == 0 && e->state == SEALED) {
+    free_block(h, block_of_payload(h->base + e->offset));
+    hd->used_bytes -= e->size;
+    hd->num_objects--;
+    remove_entry(h, e);
+  } else {
+    e->deleted = 1;  // reaped on last release
+  }
+  unlock(hd);
+  return RTS_OK;
+}
+
+void rts_stats(void* vh, uint64_t* used, uint64_t* capacity,
+               uint64_t* num_objects, uint64_t* num_evictions,
+               uint64_t* num_creates) {
+  Handle* h = (Handle*)vh;
+  lock(h->hdr);
+  *used = h->hdr->used_bytes;
+  *capacity = h->hdr->arena_size;
+  *num_objects = h->hdr->num_objects;
+  *num_evictions = h->hdr->num_evictions;
+  *num_creates = h->hdr->num_creates;
+  unlock(h->hdr);
+}
+
+// List up to `max` sealed object ids into out (max * 24 bytes); returns count.
+int64_t rts_list(void* vh, uint8_t* out, int64_t max) {
+  Handle* h = (Handle*)vh;
+  Header* hd = h->hdr;
+  lock(hd);
+  Entry* es = entries(h);
+  int64_t n = 0;
+  for (uint64_t i = 0; i < hd->nentries && n < max; i++) {
+    if (es[i].state == SEALED && !es[i].deleted) {
+      memcpy(out + n * ID_LEN, es[i].id, ID_LEN);
+      n++;
+    }
+  }
+  unlock(hd);
+  return n;
+}
+
+uint64_t rts_segment_size(void* vh) { return ((Handle*)vh)->size; }
+
+}  // extern "C"

@@ -1,0 +1,255 @@
+"""Message-passing layer: length-framed msgpack RPC over asyncio TCP.
+
+Plays the role of the reference's gRPC layer (/root/reference/src/ray/rpc/
+grpc_server.h, grpc_client.h) for the control plane.  The protocol is
+symmetric: either end of a connection can issue calls, which is how
+long-poll-free pubsub pushes work (the controller calls back into
+subscribers, cf. /root/reference/src/ray/pubsub/publisher.h's batched
+long-poll design — TCP lets us push directly instead).
+
+Frame layout: 4-byte little-endian length, then msgpack ``[seq, kind, method,
+data]`` where kind is REQUEST/REPLY/ERROR/NOTIFY.  ``data`` is
+msgpack-serializable (callers pre-pickle rich Python values).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+import traceback
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+REQUEST, REPLY, ERROR, NOTIFY = 0, 1, 2, 3
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _pack(seq: int, kind: int, method: str, data: Any) -> bytes:
+    payload = msgpack.packb([seq, kind, method, data], use_bin_type=True)
+    return _LEN.pack(len(payload)) + payload
+
+
+class Connection:
+    """One bidirectional peer connection."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handlers: Dict[str, Callable[["Connection", Any], Awaitable[Any]]]):
+        self.reader = reader
+        self.writer = writer
+        self.handlers = handlers
+        self._seq = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+        self.peer_info: Dict[str, Any] = {}  # set by handshake handlers
+        self._task = asyncio.ensure_future(self._read_loop())
+
+    @property
+    def closed(self):
+        return self._closed
+
+    async def _send(self, frame: bytes):
+        async with self._send_lock:
+            self.writer.write(frame)
+            await self.writer.drain()
+
+    async def call(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:
+        if self._closed:
+            raise ConnectionLost(f"connection closed (calling {method})")
+        self._seq += 1
+        seq = self._seq
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[seq] = fut
+        await self._send(_pack(seq, REQUEST, method, data))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(seq, None)
+
+    async def notify(self, method: str, data: Any = None):
+        if self._closed:
+            raise ConnectionLost(f"connection closed (notifying {method})")
+        await self._send(_pack(0, NOTIFY, method, data))
+
+    async def _read_loop(self):
+        try:
+            while True:
+                head = await self.reader.readexactly(4)
+                (length,) = _LEN.unpack(head)
+                if length > MAX_FRAME:
+                    raise RpcError(f"frame too large: {length}")
+                payload = await self.reader.readexactly(length)
+                seq, kind, method, data = msgpack.unpackb(payload, raw=False)
+                if kind == REQUEST:
+                    asyncio.ensure_future(self._dispatch(seq, method, data))
+                elif kind == NOTIFY:
+                    asyncio.ensure_future(self._dispatch(0, method, data))
+                elif kind in (REPLY, ERROR):
+                    fut = self._pending.pop(seq, None)
+                    if fut is not None and not fut.done():
+                        if kind == REPLY:
+                            fut.set_result(data)
+                        else:
+                            fut.set_exception(RpcError(data))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        except asyncio.CancelledError:
+            pass
+        finally:
+            await self._shutdown()
+
+    async def _dispatch(self, seq: int, method: str, data: Any):
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = await handler(self, data)
+            if seq:
+                await self._send(_pack(seq, REPLY, method, result))
+        except Exception:
+            if seq:
+                try:
+                    await self._send(_pack(seq, ERROR, method, traceback.format_exc()))
+                except Exception:
+                    pass
+
+    async def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("peer disconnected"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            try:
+                self.on_close(self)
+            except Exception:
+                pass
+
+    async def close(self):
+        self._task.cancel()
+        await self._shutdown()
+
+
+class RpcServer:
+    """Accepts connections; all connections share one handler table."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.handlers: Dict[str, Callable] = {}
+        self.connections: set[Connection] = set()
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def handler(self, name: str):
+        def deco(fn):
+            self.handlers[name] = fn
+            return fn
+        return deco
+
+    def register(self, name: str, fn):
+        self.handlers[name] = fn
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._accept, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def _accept(self, reader, writer):
+        conn = Connection(reader, writer, self.handlers)
+        self.connections.add(conn)
+        conn.on_close = self.connections.discard
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(host: str, port: int,
+                  handlers: Optional[Dict[str, Callable]] = None,
+                  retries: int = 1, retry_delay: float = 0.02) -> Connection:
+    last = None
+    for _ in range(max(1, retries)):
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            return Connection(reader, writer, handlers or {})
+        except OSError as e:
+            last = e
+            await asyncio.sleep(retry_delay)
+    raise ConnectionLost(f"cannot connect to {host}:{port}: {last}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread.
+
+    Drivers and workers are synchronous user code; all their networking runs
+    here (the reference gets the same split from the C++ core worker's asio
+    io_service running on its own thread).
+    """
+
+    def __init__(self, name: str = "ray-tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=2)
+
+
+class BlockingClient:
+    """Synchronous facade over a Connection living on an EventLoopThread."""
+
+    def __init__(self, loop_thread: EventLoopThread, conn: Connection):
+        self._lt = loop_thread
+        self.conn = conn
+
+    @classmethod
+    def connect(cls, loop_thread: EventLoopThread, host: str, port: int,
+                handlers=None, retries: int = 50):
+        conn = loop_thread.run(connect(host, port, handlers, retries=retries))
+        return cls(loop_thread, conn)
+
+    def call(self, method: str, data: Any = None, timeout: Optional[float] = None):
+        return self._lt.run(self.conn.call(method, data, timeout=timeout),
+                            timeout=None if timeout is None else timeout + 5)
+
+    def notify(self, method: str, data: Any = None):
+        return self._lt.run(self.conn.notify(method, data))
+
+    def close(self):
+        try:
+            self._lt.run(self.conn.close())
+        except Exception:
+            pass

@@ -1,0 +1,147 @@
+"""Object serialization: pickle5 out-of-band buffers with zero-copy reads.
+
+Mirrors the reference's SerializationContext
+(/root/reference/python/ray/_private/serialization.py:92 and
+``_serialize_to_pickle5`` at :380): objects are pickled with protocol 5,
+large contiguous buffers (numpy arrays, bytes) are carried out-of-band and
+written verbatim into the shared-memory store, and deserialization
+reconstructs arrays as zero-copy views over store memory.
+
+TPU-specific addition: ``jax.Array`` values are staged to host memory on
+serialize and re-materialized with ``jax.device_put`` on deserialize, so
+device arrays can flow through the object store; buffers are 64-byte aligned
+so XLA's host-to-device DMA path can consume them directly.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+import msgpack
+
+_MAGIC = b"RTO1"  # ray-tpu object, version 1
+_ALIGN = 64
+
+
+class _JaxArrayPlaceholder:
+    """Reducer target re-materializing a device array on deserialize."""
+
+    def __init__(self, np_value):
+        self.np_value = np_value
+
+    def restore(self):
+        import jax
+        return jax.device_put(self.np_value)
+
+
+def _reduce_jax_array(arr):
+    import numpy as np
+    host = np.asarray(arr)
+    ph = _JaxArrayPlaceholder(host)
+    return (_restore_jax, (ph.np_value,))
+
+
+def _restore_jax(np_value):
+    import jax
+    return jax.device_put(np_value)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+
+    def reducer_override(self, obj):
+        t = type(obj)
+        mod = t.__module__
+        if mod.startswith("jaxlib") or mod.startswith("jax"):
+            try:
+                import jax
+                if isinstance(obj, jax.Array):
+                    return _reduce_jax_array(obj)
+            except ImportError:
+                pass
+        return super().reducer_override(obj)
+
+
+def serialize(value: Any) -> List[memoryview]:
+    """Serialize ``value`` to a list of buffers: header + pickled body + payload
+    buffers.  The caller concatenates them (e.g. straight into store memory)."""
+    buffers: List[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    _Pickler(f, buffers.append).dump(value)
+    body = f.getvalue()
+
+    raw: List[memoryview] = []
+    sizes: List[int] = []
+    for pb in buffers:
+        m = pb.raw()
+        if not m.contiguous:
+            m = memoryview(bytes(m))
+        raw.append(m)
+        sizes.append(m.nbytes)
+
+    header_payload = msgpack.packb({"body": len(body), "bufs": sizes})
+    header = _MAGIC + struct.pack("<I", len(header_payload)) + header_payload
+    out = [memoryview(header), memoryview(body)]
+    offset = len(header) + len(body)
+    for m in raw:
+        pad = (-offset) % _ALIGN
+        if pad:
+            out.append(memoryview(b"\x00" * pad))
+            offset += pad
+        out.append(m)
+        offset += m.nbytes
+    return out
+
+
+def serialized_size(parts: List[memoryview]) -> int:
+    return sum(p.nbytes for p in parts)
+
+
+def write_to(parts: List[memoryview], dest: memoryview) -> int:
+    off = 0
+    for p in parts:
+        dest[off: off + p.nbytes] = p
+        off += p.nbytes
+    return off
+
+
+def serialize_to_bytes(value: Any) -> bytes:
+    parts = serialize(value)
+    return b"".join(bytes(p) for p in parts)
+
+
+def deserialize(data: memoryview) -> Any:
+    """Deserialize from a single contiguous buffer.
+
+    Out-of-band buffers are returned as zero-copy views into ``data`` — numpy
+    arrays produced here alias store memory and are read-only, exactly like
+    the reference's zero-copy numpy reads from plasma.
+    """
+    if bytes(data[:4]) != _MAGIC:
+        raise ValueError("corrupt object: bad magic")
+    (hlen,) = struct.unpack("<I", data[4:8])
+    header = msgpack.unpackb(bytes(data[8: 8 + hlen]))
+    off = 8 + hlen
+    body = data[off: off + header["body"]]
+    off += header["body"]
+    bufs = []
+    for size in header["bufs"]:
+        off += (-off) % _ALIGN
+        bufs.append(data[off: off + size])
+        off += size
+    return pickle.loads(body, buffers=bufs)
+
+
+def dumps_function(fn) -> bytes:
+    """Ship a function/class definition (cloudpickle, like the reference's
+    function table: python/ray/_private/function_manager.py:56)."""
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(data: bytes):
+    return cloudpickle.loads(data)

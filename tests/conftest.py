@@ -1,0 +1,47 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-node-on-one-machine test strategy
+(/root/reference/python/ray/tests/conftest.py ray_start_cluster +
+cluster_utils.Cluster): distributed behavior is exercised locally, here with
+8 virtual XLA host devices standing in for a TPU slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY_MB", "256")
+os.environ.setdefault("RAY_TPU_WORKER_POOL_INITIAL_SIZE", "1")
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run test on a fresh event loop")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal stand-in for pytest-asyncio (not in the image)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {k: pyfuncitem.funcargs[k]
+                  for k in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture
+def local_cluster():
+    """A started single-node runtime, shut down afterwards."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()

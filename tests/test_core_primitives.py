@@ -1,0 +1,172 @@
+import asyncio
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import ids, rpc, serialization
+
+
+def test_id_sizes_and_derivation():
+    job = ids.JobID.from_int(7)
+    actor = ids.ActorID.of(job)
+    task = ids.TaskID.of(actor)
+    obj = ids.ObjectID.for_task_return(task, 3)
+    assert obj.task_id() == task
+    assert task.actor_id() == actor
+    assert actor.job_id() == job
+    assert obj.index() == 3
+    assert not obj.is_put()
+    put = ids.ObjectID.for_put(task, 5)
+    assert put.is_put() and put.index() == 5
+
+
+def test_id_hash_eq_pickle():
+    a = ids.NodeID.from_random()
+    b = ids.NodeID(a.binary())
+    assert a == b and hash(a) == hash(b)
+    assert pickle.loads(pickle.dumps(a)) == a
+    assert ids.NodeID.nil().is_nil()
+    assert ids.NodeID.from_hex(a.hex()) == a
+
+
+def test_serialize_roundtrip_basic():
+    for val in [1, "x", {"a": [1, 2, {"b": None}]}, (1, 2), b"bytes", 3.14]:
+        data = serialization.serialize_to_bytes(val)
+        assert serialization.deserialize(memoryview(data)) == val
+
+
+def test_serialize_numpy_zero_copy():
+    arr = np.arange(10000, dtype=np.float64).reshape(100, 100)
+    data = bytearray(serialization.serialize_to_bytes(arr))
+    out = serialization.deserialize(memoryview(data))
+    np.testing.assert_array_equal(out, arr)
+    # The deserialized array must alias the source buffer (zero-copy).
+    data[-arr.nbytes:] = b"\x00" * arr.nbytes
+    assert out[-1, -1] == 0.0
+
+
+def test_serialize_jax_array():
+    import jax.numpy as jnp
+    arr = jnp.arange(64, dtype=jnp.float32)
+    data = serialization.serialize_to_bytes(arr)
+    out = serialization.deserialize(memoryview(data))
+    import jax
+    assert isinstance(out, jax.Array)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_serialize_closure():
+    x = 41
+    fn = lambda y: x + y  # noqa: E731
+    data = serialization.dumps_function(fn)
+    assert serialization.loads_function(data)(1) == 42
+
+
+def test_serialize_exception():
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        data = serialization.serialize_to_bytes(e)
+    err = serialization.deserialize(memoryview(data))
+    assert isinstance(err, ValueError) and str(err) == "boom"
+
+
+@pytest.mark.asyncio
+async def test_rpc_call_and_notify():
+    server = rpc.RpcServer()
+    hits = []
+
+    @server.handler("echo")
+    async def _echo(conn, data):
+        return {"got": data}
+
+    @server.handler("note")
+    async def _note(conn, data):
+        hits.append(data)
+
+    await server.start()
+    conn = await rpc.connect("127.0.0.1", server.port)
+    assert await conn.call("echo", [1, "a", b"z"]) == {"got": [1, "a", b"z"]}
+    await conn.notify("note", 5)
+    for _ in range(100):
+        if hits:
+            break
+        await asyncio.sleep(0.01)
+    assert hits == [5]
+    await conn.close()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_rpc_error_propagates():
+    server = rpc.RpcServer()
+
+    @server.handler("fail")
+    async def _fail(conn, data):
+        raise RuntimeError("nope")
+
+    await server.start()
+    conn = await rpc.connect("127.0.0.1", server.port)
+    with pytest.raises(rpc.RpcError, match="nope"):
+        await conn.call("fail")
+    with pytest.raises(rpc.RpcError, match="no handler"):
+        await conn.call("missing")
+    await conn.close()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_rpc_server_push_to_client():
+    # Symmetric protocol: the server can call handlers registered client-side
+    # (this is how pubsub delivery works).
+    server = rpc.RpcServer()
+    got = asyncio.Event()
+
+    @server.handler("hello")
+    async def _hello(conn, data):
+        asyncio.ensure_future(conn.call("client_method", {"x": 1}))
+        return None
+
+    async def client_method(conn, data):
+        assert data == {"x": 1}
+        got.set()
+        return "ok"
+
+    await server.start()
+    conn = await rpc.connect("127.0.0.1", server.port,
+                             handlers={"client_method": client_method})
+    await conn.call("hello")
+    await asyncio.wait_for(got.wait(), 5)
+    await conn.close()
+    await server.stop()
+
+
+def test_blocking_client():
+    lt = rpc.EventLoopThread("test-io")
+
+    async def _make_server():
+        server = rpc.RpcServer()
+
+        @server.handler("add")
+        async def _add(conn, data):
+            return data["a"] + data["b"]
+
+        await server.start()
+        return server
+
+    server = lt.run(_make_server())
+    client = rpc.BlockingClient.connect(lt, "127.0.0.1", server.port)
+    assert client.call("add", {"a": 2, "b": 3}) == 5
+    client.close()
+    lt.run(server.stop())
+    lt.stop()
+
+
+def test_config_registry():
+    from ray_tpu.core.config import GlobalConfig
+    assert GlobalConfig.max_direct_call_object_size == 100 * 1024
+    snap = GlobalConfig.snapshot()
+    assert "heartbeat_interval_s" in snap
+    with pytest.raises(KeyError):
+        GlobalConfig.update({"not_a_flag": 1})
