@@ -1,0 +1,34 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Tasks, actors, and a shared-memory object store on a multi-node runtime
+(controller + per-node nodelets), with JAX/XLA as the accelerator data plane:
+device-mesh collectives over ICI instead of NCCL, pjit/shard_map sharding
+instead of DDP wrappers, and TPU-topology-aware placement groups.
+
+Capability mirror of Ray (see SURVEY.md for the layer map); architecture is
+TPU-first, not a port.
+"""
+
+from .api import (  # noqa: F401
+    ActorClass,
+    ActorHandle,
+    ClientContext,
+    RemoteFunction,
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from .core.driver import ObjectRef  # noqa: F401
+from . import exceptions  # noqa: F401
+
+__version__ = "0.1.0"

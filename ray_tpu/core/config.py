@@ -88,8 +88,11 @@ _d("object_transfer_chunk_bytes", int, 4 * 1024 * 1024,
    "Chunk size for node-to-node object push (reference: object_manager.proto).")
 _d("worker_pool_initial_size", int, 2, "Workers prestarted per node.")
 _d("worker_pool_max_size", int, 16, "Hard cap on workers per node.")
-_d("worker_lease_idle_seconds", float, 5.0,
-   "Leased workers are returned to the pool after this long with no task.")
+_d("worker_lease_idle_seconds", float, 0.2,
+   "Grace period a drained lease is held awaiting new same-key tasks before "
+   "the worker (and its resources) return to the pool.  Short on purpose: "
+   "the lease pins scheduler resources; warm reuse across bursts comes from "
+   "the nodelet's idle worker pool, not from held leases.")
 _d("heartbeat_interval_s", float, 0.5, "Nodelet -> controller resource report period.")
 _d("node_death_timeout_s", float, 5.0, "Heartbeat silence after which a node is dead.")
 _d("task_retry_delay_s", float, 0.2, "Delay before resubmitting a failed task.")
@@ -102,6 +105,8 @@ _d("scheduler_spread_threshold", float, 0.5,
 _d("scheduler_top_k_fraction", float, 0.2,
    "Randomize among this fraction of best-scoring nodes to avoid herding.")
 _d("lease_request_timeout_s", float, 30.0, "Timeout for a worker lease grant.")
+_d("actor_creation_timeout_s", float, 300.0,
+   "How long method calls wait for a PENDING/RESTARTING actor to come up.")
 _d("rpc_connect_retries", int, 60, "TCP connect retries (20ms backoff) at bootstrap.")
 _d("pull_retry_interval_s", float, 0.5, "Retry period for remote object pulls.")
 _d("inline_small_args_bytes", int, 64 * 1024,

@@ -1,0 +1,619 @@
+"""Cluster controller — the control plane (GCS equivalent).
+
+One process per cluster.  Owns: node membership + health
+(/root/reference/src/ray/gcs/gcs_server/gcs_health_check_manager.h:39),
+the actor lifecycle FSM (DEPENDENCIES_UNREADY → PENDING_CREATION → ALIVE →
+RESTARTING → DEAD, /root/reference/src/ray/protobuf/gcs.proto:89-98 and
+gcs_actor_manager.cc:240), placement groups with 2-phase bundle commit
+(gcs_placement_group_manager / placement_group_resource_manager.cc:196),
+an internal KV + function table (gcs_kv_manager.cc), the object directory,
+and pubsub to connected subscribers (drivers, nodelets).
+
+Scheduling of *tasks* never passes through here (drivers lease directly from
+nodelets); only actors and placement groups are scheduled centrally, exactly
+as in the reference's GCS-based actor scheduler (gcs_actor_scheduler.cc:53).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from . import rpc
+from .scheduling import NodeView, hybrid_policy, pack_bundles
+from .task_spec import ResourceSet, TaskSpec
+
+# Actor FSM states (wire strings).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class ActorRecord:
+    def __init__(self, actor_id: bytes, spec: dict, name: Optional[str],
+                 max_restarts: int, detached: bool):
+        self.actor_id = actor_id
+        self.spec = spec
+        self.name = name
+        self.max_restarts = max_restarts
+        self.detached = detached
+        self.state = PENDING_CREATION
+        self.address: Optional[str] = None      # "host:port" of the actor worker
+        self.node_id: Optional[str] = None
+        self.worker_id: Optional[bytes] = None
+        self.num_restarts = 0
+        self.death_cause: Optional[str] = None
+        self.owner_conn_id: Optional[int] = None
+        self.waiters: List[asyncio.Event] = []
+
+    def to_wire(self):
+        return {"actor_id": self.actor_id, "state": self.state,
+                "address": self.address, "node_id": self.node_id,
+                "name": self.name, "num_restarts": self.num_restarts,
+                "death_cause": self.death_cause,
+                "class_name": self.spec.get("fname", "")}
+
+
+class PGRecord:
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]], strategy: str,
+                 name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"          # PENDING | CREATED | REMOVED
+        self.node_ids: List[str] = []   # bundle index -> node id hex
+        self.waiters: List[asyncio.Event] = []
+
+    def to_wire(self):
+        return {"pg_id": self.pg_id, "state": self.state, "strategy": self.strategy,
+                "bundles": self.bundles, "node_ids": self.node_ids,
+                "name": self.name}
+
+
+class NodeRecord:
+    def __init__(self, view: NodeView, conn: rpc.Connection):
+        self.view = view
+        self.conn = conn
+        self.last_heartbeat = time.monotonic()
+
+
+class Controller:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout_s: float = 5.0):
+        self.server = rpc.RpcServer(host, port)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.nodes: Dict[str, NodeRecord] = {}
+        self.actors: Dict[bytes, ActorRecord] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.pgs: Dict[bytes, PGRecord] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.object_dir: Dict[bytes, Set[str]] = {}       # oid -> node ids
+        self.object_sizes: Dict[bytes, int] = {}
+        self.object_waiters: Dict[bytes, List[asyncio.Event]] = {}
+        self.subscribers: Dict[str, Set[rpc.Connection]] = {}  # channel -> conns
+        self.view_version = 0
+        self.config_snapshot: Dict[str, Any] = {}
+        self.jobs: Dict[bytes, dict] = {}
+        self._pending_actor_wakeup = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ setup
+    def _register_handlers(self):
+        s = self.server
+        for name in ("register_node", "heartbeat", "get_cluster_view",
+                     "kv_put", "kv_get", "kv_del", "kv_keys", "kv_exists",
+                     "register_actor", "wait_actor", "get_actor", "list_actors",
+                     "get_named_actor", "report_actor_death", "kill_actor",
+                     "create_placement_group", "wait_placement_group",
+                     "remove_placement_group", "list_placement_groups",
+                     "object_location_add", "object_location_remove",
+                     "object_locations_get", "free_objects",
+                     "subscribe", "publish", "register_job", "finish_job",
+                     "list_nodes", "report_worker_failure", "actor_alive",
+                     "drain_node", "ping"):
+            s.register(name, getattr(self, "_h_" + name))
+
+    async def start(self):
+        await self.server.start()
+        self._tasks.append(asyncio.ensure_future(self._health_check_loop()))
+        self._tasks.append(asyncio.ensure_future(self._actor_scheduler_loop()))
+        return self
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        await self.server.stop()
+
+    @property
+    def address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"
+
+    # ---------------------------------------------------------------- helpers
+    def _views(self) -> Dict[str, NodeView]:
+        return {nid: rec.view for nid, rec in self.nodes.items()}
+
+    def _bump_view(self):
+        self.view_version += 1
+
+    async def _broadcast(self, channel: str, data: Any):
+        for conn in list(self.subscribers.get(channel, ())):
+            if conn.closed:
+                self.subscribers[channel].discard(conn)
+                continue
+            try:
+                await conn.notify("pub:" + channel, data)
+            except Exception:
+                self.subscribers[channel].discard(conn)
+
+    # ------------------------------------------------------------- node table
+    async def _h_ping(self, conn, data):
+        return "pong"
+
+    async def _h_register_node(self, conn, data):
+        view = NodeView(data["node_id"], data["addr"], data["resources"],
+                        data["resources"], True, data.get("labels"))
+        self.nodes[data["node_id"]] = NodeRecord(view, conn)
+        conn.peer_info["node_id"] = data["node_id"]
+        conn.on_close = self._node_conn_closed
+        self._bump_view()
+        self.config_snapshot.update(data.get("config") or {})
+        await self._broadcast("nodes", {"event": "added", "node": view.to_wire()})
+        self._pending_actor_wakeup.set()
+        return {"view": [v.to_wire() for v in self._views().values()],
+                "view_version": self.view_version,
+                "config": self.config_snapshot}
+
+    def _node_conn_closed(self, conn):
+        nid = conn.peer_info.get("node_id")
+        if nid and nid in self.nodes:
+            asyncio.ensure_future(self._mark_node_dead(nid, "connection lost"))
+
+    async def _h_heartbeat(self, conn, data):
+        nid = data["node_id"]
+        rec = self.nodes.get(nid)
+        if rec is None:
+            return {"unknown_node": True}
+        rec.last_heartbeat = time.monotonic()
+        rec.view.available = ResourceSet(data["available"])
+        rec.view.total = ResourceSet(data["total"])
+        if not rec.view.alive:
+            rec.view.alive = True
+            self._bump_view()
+        self._pending_actor_wakeup.set()
+        reply: Dict[str, Any] = {"view_version": self.view_version}
+        if data.get("view_version", -1) != self.view_version:
+            reply["view"] = [v.to_wire() for v in self._views().values()]
+        return reply
+
+    async def _h_get_cluster_view(self, conn, data):
+        return {"view": [v.to_wire() for v in self._views().values()],
+                "view_version": self.view_version}
+
+    async def _h_list_nodes(self, conn, data):
+        return [v.to_wire() for v in self._views().values()]
+
+    async def _h_drain_node(self, conn, data):
+        await self._mark_node_dead(data["node_id"], "drained")
+        return True
+
+    async def _health_check_loop(self):
+        while True:
+            await asyncio.sleep(self.heartbeat_timeout_s / 3)
+            now = time.monotonic()
+            for nid, rec in list(self.nodes.items()):
+                if rec.view.alive and now - rec.last_heartbeat > self.heartbeat_timeout_s:
+                    await self._mark_node_dead(nid, "heartbeat timeout")
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        rec = self.nodes.get(node_id)
+        if rec is None or not rec.view.alive:
+            return
+        rec.view.alive = False
+        self._bump_view()
+        await self._broadcast("nodes", {"event": "dead", "node_id": node_id,
+                                        "reason": reason})
+        # Purge object locations on that node.
+        for oid, locs in list(self.object_dir.items()):
+            locs.discard(node_id)
+            if not locs:
+                del self.object_dir[oid]
+        # Restart or kill actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION):
+                await self._on_actor_failure(actor, f"node {node_id} died: {reason}")
+
+    # --------------------------------------------------------------------- kv
+    async def _h_kv_put(self, conn, data):
+        ns = self.kv.setdefault(data.get("ns", ""), {})
+        key = data["key"]
+        if data.get("overwrite", True) or key not in ns:
+            ns[key] = data["value"]
+            return True
+        return False
+
+    async def _h_kv_get(self, conn, data):
+        return self.kv.get(data.get("ns", ""), {}).get(data["key"])
+
+    async def _h_kv_del(self, conn, data):
+        return self.kv.get(data.get("ns", ""), {}).pop(data["key"], None) is not None
+
+    async def _h_kv_exists(self, conn, data):
+        return data["key"] in self.kv.get(data.get("ns", ""), {})
+
+    async def _h_kv_keys(self, conn, data):
+        prefix = data.get("prefix", b"")
+        return [k for k in self.kv.get(data.get("ns", ""), {}) if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------ actors
+    async def _h_register_actor(self, conn, data):
+        spec = data["spec"]
+        actor_id = spec["actor_new"]
+        name = data.get("name") or None
+        if name and name in self.named_actors:
+            existing = self.actors.get(self.named_actors[name])
+            if existing is not None and existing.state != DEAD:
+                if data.get("get_if_exists"):
+                    return {"actor_id": existing.actor_id, "existing": True}
+                return {"error": f"actor name {name!r} already taken"}
+        rec = ActorRecord(actor_id, spec, name, data.get("max_restarts", 0),
+                          data.get("detached", False))
+        self.actors[actor_id] = rec
+        if name:
+            self.named_actors[name] = actor_id
+        self._pending_actor_wakeup.set()
+        return {"actor_id": actor_id, "existing": False}
+
+    async def _actor_scheduler_loop(self):
+        """Drives PENDING/RESTARTING actors toward ALIVE, like the
+        reference's GcsActorScheduler (gcs_actor_scheduler.cc:53-55)."""
+        while True:
+            self._pending_actor_wakeup.clear()
+            for actor in list(self.actors.values()):
+                if actor.state in (PENDING_CREATION, RESTARTING) and actor.node_id is None:
+                    await self._try_schedule_actor(actor)
+            try:
+                await asyncio.wait_for(self._pending_actor_wakeup.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _try_schedule_actor(self, actor: ActorRecord):
+        spec = TaskSpec(actor.spec)
+        strategy = dict(spec.scheduling_strategy)
+        pg_id = actor.spec.get("pg")
+        if pg_id:
+            pg = self.pgs.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                return  # wait for the PG
+            strategy["node_id"] = pg.node_ids[max(actor.spec.get("bundle", 0), 0)]
+        node_id = hybrid_policy(self._views(), spec.resources, None,
+                                strategy=strategy)
+        if node_id is None:
+            return
+        rec = self.nodes.get(node_id)
+        if rec is None or not rec.view.alive:
+            return
+        actor.node_id = node_id
+        try:
+            result = await rec.conn.call("start_actor", {"spec": actor.spec},
+                                         timeout=120)
+        except Exception as e:
+            actor.node_id = None
+            await self._on_actor_failure(actor, f"creation RPC failed: {e}")
+            return
+        if not result.get("ok"):
+            actor.node_id = None
+            if result.get("retry"):
+                self._pending_actor_wakeup.set()
+            else:
+                await self._on_actor_failure(actor, result.get("error", "creation failed"))
+
+    async def _h_actor_alive(self, conn, data):
+        """Called by the actor's worker process once the instance exists."""
+        actor = self.actors.get(data["actor_id"])
+        if actor is None:
+            return False
+        actor.state = ALIVE
+        actor.address = data["address"]
+        actor.worker_id = data["worker_id"]
+        actor.node_id = data["node_id"]
+        self._notify_actor_waiters(actor)
+        await self._broadcast("actors", actor.to_wire())
+        return True
+
+    def _notify_actor_waiters(self, actor: ActorRecord):
+        for ev in actor.waiters:
+            ev.set()
+        actor.waiters.clear()
+
+    async def _h_wait_actor(self, conn, data):
+        actor = self.actors.get(data["actor_id"])
+        if actor is None:
+            return {"error": "no such actor"}
+        timeout = data.get("timeout", 60.0)
+        deadline = time.monotonic() + timeout
+        while actor.state not in (ALIVE, DEAD):
+            ev = asyncio.Event()
+            actor.waiters.append(ev)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"state": actor.state, "timeout": True}
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return {"state": actor.state, "timeout": True}
+        return actor.to_wire()
+
+    async def _h_get_actor(self, conn, data):
+        actor = self.actors.get(data["actor_id"])
+        return actor.to_wire() if actor else None
+
+    async def _h_list_actors(self, conn, data):
+        return [a.to_wire() for a in self.actors.values()]
+
+    async def _h_get_named_actor(self, conn, data):
+        aid = self.named_actors.get(data["name"])
+        if aid is None:
+            return None
+        actor = self.actors.get(aid)
+        if actor is None or actor.state == DEAD:
+            return None
+        return actor.to_wire() | {"spec": actor.spec}
+
+    async def _h_report_actor_death(self, conn, data):
+        actor = self.actors.get(data["actor_id"])
+        if actor is None:
+            return False
+        await self._on_actor_failure(actor, data.get("reason", "worker died"),
+                                     intended=data.get("intended", False))
+        return True
+
+    async def _h_report_worker_failure(self, conn, data):
+        """Nodelet tells us a worker process died; fail its actor if any."""
+        actor_id = data.get("actor_id")
+        if actor_id:
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                await self._on_actor_failure(actor, data.get("reason", "worker crashed"))
+        return True
+
+    async def _on_actor_failure(self, actor: ActorRecord, reason: str,
+                                intended: bool = False):
+        if actor.state == DEAD:
+            return
+        actor.address = None
+        actor.worker_id = None
+        actor.node_id = None
+        if not intended and actor.num_restarts < actor.max_restarts:
+            actor.num_restarts += 1
+            actor.state = RESTARTING
+            self._pending_actor_wakeup.set()
+        else:
+            actor.state = DEAD
+            actor.death_cause = reason
+            if actor.name:
+                self.named_actors.pop(actor.name, None)
+            self._notify_actor_waiters(actor)
+        await self._broadcast("actors", actor.to_wire())
+
+    async def _h_kill_actor(self, conn, data):
+        actor = self.actors.get(data["actor_id"])
+        if actor is None:
+            return False
+        if data.get("no_restart", True):
+            actor.max_restarts = actor.num_restarts  # exhaust restarts
+        addr = actor.address
+        node = self.nodes.get(actor.node_id) if actor.node_id else None
+        await self._on_actor_failure(actor, "killed via kill_actor",
+                                     intended=data.get("no_restart", True))
+        if node is not None and actor.worker_id is None and addr:
+            try:
+                await node.conn.call("kill_worker_at", {"address": addr}, timeout=5)
+            except Exception:
+                pass
+        return True
+
+    # --------------------------------------------------------- placement groups
+    async def _h_create_placement_group(self, conn, data):
+        pg = PGRecord(data["pg_id"], data["bundles"], data.get("strategy", "PACK"),
+                      data.get("name", ""))
+        self.pgs[pg.pg_id] = pg
+        await self._try_create_pg(pg)
+        return {"pg_id": pg.pg_id, "state": pg.state}
+
+    async def _try_create_pg(self, pg: PGRecord):
+        if pg.state != "PENDING":
+            return
+        placement = pack_bundles(self._views(), pg.bundles, pg.strategy)
+        if placement is None:
+            return
+        # 2-phase commit: prepare on every node, then commit; abort on failure
+        # (reference: placement_group_resource_manager.cc Prepare/Commit).
+        prepared: List[int] = []
+        ok = True
+        for idx, node_id in enumerate(placement):
+            rec = self.nodes.get(node_id)
+            if rec is None or not rec.view.alive:
+                ok = False
+                break
+            try:
+                r = await rec.conn.call("pg_prepare", {
+                    "pg_id": pg.pg_id, "bundle_index": idx,
+                    "resources": pg.bundles[idx]}, timeout=10)
+                if not r:
+                    ok = False
+                    break
+                prepared.append(idx)
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for idx in prepared:
+                rec = self.nodes.get(placement[idx])
+                if rec:
+                    try:
+                        await rec.conn.call("pg_abort", {"pg_id": pg.pg_id,
+                                                         "bundle_index": idx})
+                    except Exception:
+                        pass
+            return
+        committed: List[int] = []
+        try:
+            for idx, node_id in enumerate(placement):
+                await self.nodes[node_id].conn.call("pg_commit", {
+                    "pg_id": pg.pg_id, "bundle_index": idx}, timeout=10)
+                committed.append(idx)
+        except Exception:
+            # A node died mid-commit: roll everything back so nothing leaks,
+            # and leave the PG PENDING for the next attempt.
+            for idx in range(len(placement)):
+                rec = self.nodes.get(placement[idx])
+                if rec is None or not rec.view.alive:
+                    continue
+                op = "pg_return" if idx in committed else "pg_abort"
+                try:
+                    await rec.conn.call(op, {"pg_id": pg.pg_id,
+                                             "bundle_index": idx}, timeout=10)
+                except Exception:
+                    pass
+            return
+        pg.node_ids = placement
+        pg.state = "CREATED"
+        for ev in pg.waiters:
+            ev.set()
+        pg.waiters.clear()
+        self._pending_actor_wakeup.set()
+        await self._broadcast("pgs", pg.to_wire())
+
+    async def _h_wait_placement_group(self, conn, data):
+        pg = self.pgs.get(data["pg_id"])
+        if pg is None:
+            return {"error": "no such placement group"}
+        deadline = time.monotonic() + data.get("timeout", 60.0)
+        while pg.state == "PENDING":
+            await self._try_create_pg(pg)
+            if pg.state != "PENDING":
+                break
+            ev = asyncio.Event()
+            pg.waiters.append(ev)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"state": pg.state, "timeout": True}
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=min(remaining, 0.5))
+            except asyncio.TimeoutError:
+                pass
+        return pg.to_wire()
+
+    async def _h_remove_placement_group(self, conn, data):
+        pg = self.pgs.get(data["pg_id"])
+        if pg is None:
+            return False
+        if pg.state == "CREATED":
+            for idx, node_id in enumerate(pg.node_ids):
+                rec = self.nodes.get(node_id)
+                if rec is not None and rec.view.alive:
+                    try:
+                        await rec.conn.call("pg_return", {"pg_id": pg.pg_id,
+                                                          "bundle_index": idx})
+                    except Exception:
+                        pass
+        pg.state = "REMOVED"
+        await self._broadcast("pgs", pg.to_wire())
+        return True
+
+    async def _h_list_placement_groups(self, conn, data):
+        return [p.to_wire() for p in self.pgs.values()]
+
+    # ----------------------------------------------------------- object dir
+    async def _h_object_location_add(self, conn, data):
+        oid = data["object_id"]
+        self.object_dir.setdefault(oid, set()).add(data["node_id"])
+        if "size" in data:
+            self.object_sizes[oid] = data["size"]
+        for ev in self.object_waiters.pop(oid, []):
+            ev.set()
+        return True
+
+    async def _h_object_location_remove(self, conn, data):
+        oid = data["object_id"]
+        locs = self.object_dir.get(oid)
+        if locs:
+            locs.discard(data["node_id"])
+            if not locs:
+                self.object_dir.pop(oid, None)
+        return True
+
+    async def _h_object_locations_get(self, conn, data):
+        oid = data["object_id"]
+        timeout = data.get("timeout", 0.0)
+        deadline = time.monotonic() + timeout
+        while True:
+            locs = self.object_dir.get(oid)
+            if locs:
+                addrs = [self.nodes[n].view.addr for n in locs
+                         if n in self.nodes and self.nodes[n].view.alive]
+                ids = [n for n in locs if n in self.nodes and self.nodes[n].view.alive]
+                if addrs:
+                    return {"locations": addrs, "node_ids": ids,
+                            "size": self.object_sizes.get(oid, 0)}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"locations": [], "node_ids": [], "size": 0}
+            ev = asyncio.Event()
+            self.object_waiters.setdefault(oid, []).append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _h_free_objects(self, conn, data):
+        oids = data["object_ids"]
+        by_node: Dict[str, List[bytes]] = {}
+        for oid in oids:
+            for nid in self.object_dir.pop(oid, set()):
+                by_node.setdefault(nid, []).append(oid)
+            self.object_sizes.pop(oid, None)
+        for nid, node_oids in by_node.items():
+            rec = self.nodes.get(nid)
+            if rec is not None and rec.view.alive:
+                try:
+                    await rec.conn.notify("free_local", {"object_ids": node_oids})
+                except Exception:
+                    pass
+        return True
+
+    # ---------------------------------------------------------------- pubsub
+    async def _h_subscribe(self, conn, data):
+        self.subscribers.setdefault(data["channel"], set()).add(conn)
+        return True
+
+    async def _h_publish(self, conn, data):
+        await self._broadcast(data["channel"], data["data"])
+        return True
+
+    # ------------------------------------------------------------------- jobs
+    async def _h_register_job(self, conn, data):
+        self.jobs[data["job_id"]] = {"start": time.time(), "driver": data.get("driver")}
+        return True
+
+    async def _h_finish_job(self, conn, data):
+        job_id = data["job_id"]
+        self.jobs.pop(job_id, None)
+        # Kill the job's non-detached actors.
+        for actor in list(self.actors.values()):
+            if actor.detached or actor.state == DEAD:
+                continue
+            if actor.actor_id[:len(job_id)] == job_id:
+                await self._on_actor_failure(actor, "job finished", intended=True)
+        return True
+
+
+async def run_controller(host: str, port: int, heartbeat_timeout_s: float = 5.0):
+    c = Controller(host, port, heartbeat_timeout_s)
+    await c.start()
+    return c

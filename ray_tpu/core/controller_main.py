@@ -1,0 +1,31 @@
+"""Controller process entrypoint (reference: gcs_server_main.cc:40).
+
+Prints ``CONTROLLER_READY <host:port>`` on stdout once serving, which the
+launching process reads to learn the bound port.
+"""
+
+import argparse
+import asyncio
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--heartbeat-timeout", type=float, default=5.0)
+    args = p.parse_args()
+
+    from .controller import Controller
+
+    async def run():
+        c = Controller(args.host, args.port, args.heartbeat_timeout)
+        await c.start()
+        print(f"CONTROLLER_READY {c.address}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

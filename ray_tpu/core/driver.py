@@ -1,0 +1,691 @@
+"""Core client: task/actor submission and object operations.
+
+The Python equivalent of the reference's core worker + direct task transport
+(/root/reference/src/ray/core_worker/core_worker.cc SubmitTask :1629 /
+Get :1142 / Put :935; transport/direct_task_transport.cc lease pipelining).
+One ``CoreClient`` lives in every driver *and* every worker process (workers
+use it for nested ``remote()``/``get()`` calls), running its networking on a
+dedicated event-loop thread.
+
+Hot path: specs with the same scheduling key share worker leases — the driver
+pushes tasks directly to leased workers over persistent connections, going
+back to the nodelet only to acquire/return leases (reference: OnWorkerIdle,
+direct_task_transport.cc:174).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import exceptions
+from . import rpc, serialization
+from .config import GlobalConfig
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .memory_store import IN_PLASMA, MemoryStore
+from .object_store import client as store_client
+from .task_spec import ARG_REF, ARG_VALUE, TaskSpec
+from .worker_runtime import FN_NAMESPACE, _ErrorValue
+
+
+class ObjectRef:
+    """A handle to a (possibly pending) object (reference: ObjectRef in
+    _raylet.pyx).  Dropping the last local reference releases the object."""
+
+    __slots__ = ("_id", "_core", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, core: Optional["CoreClient"]):
+        self._id = object_id
+        self._core = core
+        if core is not None:
+            core._add_local_ref(object_id.binary())
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def __reduce__(self):
+        # Crossing a process boundary: the receiver resolves via the store.
+        return (_deserialize_ref, (self._id.binary(),))
+
+    def __del__(self):
+        core = self._core
+        if core is not None:
+            try:
+                core._remove_local_ref(self._id.binary())
+            except Exception:
+                pass
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def future(self):
+        """concurrent.futures.Future resolving to the object's value."""
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _bg():
+            try:
+                fut.set_result(self._core.get([self], timeout=None)[0])
+            except BaseException as e:
+                fut.set_exception(e)
+        threading.Thread(target=_bg, daemon=True).start()
+        return fut
+
+
+def _deserialize_ref(binary: bytes) -> "ObjectRef":
+    core = get_global_core()
+    return ObjectRef(ObjectID(binary), core)
+
+
+class _SchedulingKeyState:
+    """Per-scheduling-key lease pool + task queue."""
+
+    def __init__(self):
+        self.queue: deque = deque()          # (spec, attempts_left)
+        self.leases = 0                      # leases held or being acquired
+        self.wakeup = asyncio.Event()
+
+
+class _ActorState:
+    def __init__(self, actor_id: bytes, class_name: str):
+        self.actor_id = actor_id
+        self.class_name = class_name
+        self.conn: Optional[rpc.Connection] = None
+        self.address: Optional[str] = None
+        self.seq = 0
+        self.lock: Optional[asyncio.Lock] = None
+        self.dead_reason: Optional[str] = None
+
+
+class CoreClient:
+    def __init__(self, *, controller_addr: str, nodelet_addr: str,
+                 store_path: str, node_id: str, session_dir: str,
+                 job_id: Optional[JobID] = None, mode: str = "driver"):
+        self.controller_addr = controller_addr
+        self.nodelet_addr = nodelet_addr
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.mode = mode
+        self.job_id = job_id or JobID.from_int(os.getpid() & 0xFFFFFFFF)
+        self.task_ctx = TaskID.for_driver(self.job_id)
+        self.worker_id = WorkerID.from_random()
+        self.memory_store = MemoryStore()
+        self.store = store_client.StoreClient(store_path)
+        self.lt = rpc.EventLoopThread(f"ray-tpu-{mode}-io")
+        self.controller = rpc.BlockingClient.connect(
+            self.lt, *_split(controller_addr),
+            handlers={"pub:logs": self._on_log},
+            retries=GlobalConfig.rpc_connect_retries)
+        self.nodelet = rpc.BlockingClient.connect(
+            self.lt, *_split(nodelet_addr),
+            retries=GlobalConfig.rpc_connect_retries)
+        self._put_index = 0
+        self._fn_registered: set = set()
+        self._ref_lock = threading.Lock()
+        self._local_refs: Dict[bytes, int] = {}
+        self._owned: set = set()        # oids this process created (owner frees)
+        self._plasma_oids: set = set()  # oids known to live in shared memory
+        self._pinned: set = set()
+        self._sched: Dict[tuple, _SchedulingKeyState] = {}
+        self._actors: Dict[bytes, _ActorState] = {}
+        self._worker_conns: Dict[str, rpc.Connection] = {}
+        self._nodelet_conns: Dict[str, rpc.Connection] = {}
+        self._closed = False
+        if mode == "driver":
+            self.controller.call("register_job",
+                                 {"job_id": self.job_id.binary(),
+                                  "driver": f"pid-{os.getpid()}"})
+
+    # ------------------------------------------------------------- refcounts
+    def _add_local_ref(self, oid: bytes):
+        with self._ref_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def _remove_local_ref(self, oid: bytes):
+        if self._closed:
+            return
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
+            owned = oid in self._owned
+            self._owned.discard(oid)
+            plasma = oid in self._plasma_oids
+            self._plasma_oids.discard(oid)
+        self.memory_store.delete([oid])
+        # NB: the shared-memory pin (self._pinned) is NOT dropped here — it is
+        # tied to the lifetime of the deserialized value (weakref finalizer in
+        # _get_plasma), because zero-copy numpy views alias store memory.
+        if not (owned and plasma):
+            return  # borrowed or inline-only: nothing cluster-wide to free
+        coro = None
+        try:
+            coro = self.controller.conn.call("free_objects",
+                                             {"object_ids": [oid]})
+            self.lt.spawn(coro)
+        except Exception:
+            if coro is not None:
+                coro.close()
+
+    # ------------------------------------------------------------------- put
+    def put(self, value: Any) -> ObjectRef:
+        self._put_index += 1
+        oid = ObjectID.for_put(self.task_ctx, self._put_index)
+        parts = serialization.serialize(value)
+        size = serialization.serialized_size(parts)
+        with self._ref_lock:
+            self._owned.add(oid.binary())
+        if size <= GlobalConfig.max_direct_call_object_size:
+            self.memory_store.put(oid.binary(), b"".join(bytes(p) for p in parts))
+        else:
+            self.store.put_parts(oid.binary(), parts)
+            self.nodelet.call("put_location",
+                              {"object_id": oid.binary(), "size": size})
+            self.memory_store.put_in_plasma_marker(oid.binary())
+            with self._ref_lock:
+                self._plasma_oids.add(oid.binary())
+        return ObjectRef(oid, self)
+
+    # ------------------------------------------------------------------- get
+    def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        oids = [r.binary() for r in refs]
+        entries = self.memory_store.get(oids, timeout)
+        if entries is None:
+            raise exceptions.GetTimeoutError(
+                f"get() timed out waiting for {len(oids)} objects")
+        out = []
+        for oid, entry in zip(oids, entries):
+            if entry.is_exception:
+                raise _as_exception(entry.value)
+            if entry.value is IN_PLASMA:
+                out.append(self._get_plasma(oid, timeout))
+            else:
+                value = serialization.deserialize(memoryview(entry.value))
+                if isinstance(value, _ErrorValue):
+                    raise value.unwrap()
+                out.append(value)
+        return out
+
+    def _get_plasma(self, oid: bytes, timeout: Optional[float]) -> Any:
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            r = self.nodelet.call("pull", {"object_id": oid,
+                                           "timeout": timeout or 60.0},
+                                  timeout=(timeout or 60.0) + 10)
+            if not r.get("ok"):
+                raise exceptions.ObjectLostError(oid.hex(), r.get("error", ""))
+            view = self.store.get(oid, timeout_ms=10000)
+            if view is None:
+                raise exceptions.ObjectLostError(oid.hex(), "pull raced eviction")
+        with self._ref_lock:
+            already = oid in self._pinned
+            self._pinned.add(oid)
+        if already:
+            self.store.release(oid)  # only hold one pin per object
+        value = serialization.deserialize(view)
+        if isinstance(value, _ErrorValue):
+            raise value.unwrap()
+        # The store pin guards the zero-copy views aliasing store memory; tie
+        # its release to the *value's* lifetime when the value is
+        # weakref-able, else keep it pinned for the client's lifetime.
+        self._tie_pin_to_value(oid, value)
+        return value
+
+    def _tie_pin_to_value(self, oid: bytes, value: Any):
+        import weakref
+
+        def _unpin(oid=oid, store=self.store, pinned=self._pinned,
+                   lock=self._ref_lock):
+            with lock:
+                if oid not in pinned:
+                    return
+                pinned.discard(oid)
+            try:
+                store.release(oid)
+            except Exception:
+                pass
+        try:
+            weakref.finalize(value, _unpin)
+        except TypeError:
+            pass  # not weakref-able (int, tuple, ...): stay pinned
+
+    # ------------------------------------------------------------------ wait
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        oids = [r.binary() for r in refs]
+        by_oid = {r.binary(): r for r in refs}
+        ready, not_ready = self.memory_store.wait(oids, num_returns, timeout)
+        return [by_oid[o] for o in ready], [by_oid[o] for o in not_ready]
+
+    # -------------------------------------------------------- task submission
+    def register_function(self, fid: bytes, blob: bytes):
+        if fid in self._fn_registered:
+            return
+        self.controller.call("kv_put", {"ns": FN_NAMESPACE, "key": fid,
+                                        "value": blob, "overwrite": False})
+        self._fn_registered.add(fid)
+
+    def build_args(self, args: tuple, kwargs: dict):
+        """Encode call arguments for a spec: ObjectRefs stay refs, small
+        values inline, big values spill to the local store.  The trailing
+        element is always the serialized kwargs dict.  Returns
+        ``(encoded, temp_refs)`` — the caller must keep ``temp_refs`` alive
+        until the spec's arg refs are pinned (submit_task does this)."""
+        encoded: List[Any] = []
+        temp_refs: List[ObjectRef] = []
+        for a in args:
+            encoded.append(self._encode_arg(a, temp_refs))
+        encoded.append(self._encode_arg(kwargs or {}, temp_refs))
+        return encoded, temp_refs
+
+    def _encode_arg(self, value: Any, temp_refs: List["ObjectRef"]):
+        if isinstance(value, ObjectRef):
+            return [ARG_REF, value.binary()]
+        parts = serialization.serialize(value)
+        size = serialization.serialized_size(parts)
+        if size > GlobalConfig.inline_small_args_bytes:
+            ref = self.put(value)
+            temp_refs.append(ref)  # keep alive until submit pins it
+            return [ARG_REF, ref.binary()]
+        return [ARG_VALUE, b"".join(bytes(p) for p in parts)]
+
+    def submit_task(self, spec: TaskSpec,
+                    temp_refs: Optional[List["ObjectRef"]] = None
+                    ) -> List[ObjectRef]:
+        with self._ref_lock:
+            for oid in spec.return_ids():
+                self._owned.add(oid.binary())
+        refs = [ObjectRef(oid, self) for oid in spec.return_ids()]
+        for oid in spec.arg_ref_ids():
+            self._add_local_ref(oid.binary())  # pin args until task completes
+        del temp_refs  # spilled-arg refs are now pinned; drop the temporaries
+        self.lt.spawn(self._submit_pipeline(spec, spec.max_retries))
+        return refs
+
+    async def _submit_pipeline(self, spec: TaskSpec, attempts_left: int):
+        try:
+            ok = await self._resolve_dependencies(spec)
+            if not ok:
+                return  # dependency failed; error already propagated
+            key = spec.scheduling_key()
+            state = self._sched.get(key)
+            if state is None:
+                state = self._sched[key] = _SchedulingKeyState()
+            state.queue.append((spec, attempts_left))
+            state.wakeup.set()
+            # Pipelined lease requests: one lease per queued task, capped.
+            if state.leases < len(state.queue):
+                state.leases += 1
+                asyncio.ensure_future(self._lease_loop(key, state))
+        except Exception as e:
+            self._fail_task(spec, f"submission failed: {e!r}")
+
+    async def _resolve_dependencies(self, spec: TaskSpec) -> bool:
+        """Wait for owned in-memory args and inline them (reference:
+        LocalDependencyResolver in direct_task_transport.cc)."""
+        for i, arg in enumerate(spec.args):
+            if arg[0] != ARG_REF:
+                continue
+            oid = arg[1]
+            entry = self.memory_store.peek(oid)
+            if entry is None:
+                if self.store.contains(oid):
+                    continue  # plasma object from another owner
+                loop = asyncio.get_event_loop()
+                entry_list = await loop.run_in_executor(
+                    None, self.memory_store.get, [oid], 600.0)
+                if entry_list is None:
+                    self._fail_task(spec, f"dependency {oid.hex()[:16]} never "
+                                          "became available")
+                    return False
+                entry = entry_list[0]
+            if entry.value is IN_PLASMA:
+                continue
+            if entry.is_exception:
+                self._propagate_error(spec, entry.value)
+                return False
+            value = serialization.deserialize(memoryview(entry.value))
+            if isinstance(value, _ErrorValue):
+                self._propagate_error(spec, value)
+                return False
+            spec.args[i] = [ARG_VALUE, entry.value]
+            self._remove_local_ref(oid)  # inlined; drop the pin
+        return True
+
+    async def _lease_loop(self, key: tuple, state: _SchedulingKeyState):
+        """Acquire one lease and drain the queue through it."""
+        try:
+            while state.queue:
+                spec0, _ = state.queue[0]
+                grant = await self._acquire_lease(spec0)
+                if grant is None:
+                    while state.queue:
+                        spec, _ = state.queue.popleft()
+                        self._fail_task(spec, "could not lease a worker "
+                                              "(infeasible or timeout)")
+                    return
+                nodelet_conn, lease_id, worker_addr = grant
+                try:
+                    await self._drain_through_worker(state, worker_addr)
+                except rpc.RpcError:
+                    # Worker vanished between grant and connect (crash
+                    # window before the nodelet reaps it); re-lease.
+                    self._worker_conns.pop(worker_addr, None)
+                finally:
+                    try:
+                        await nodelet_conn.call("return_lease",
+                                                {"lease_id": lease_id})
+                    except rpc.RpcError:
+                        pass
+        finally:
+            state.leases -= 1
+
+    async def _acquire_lease(self, spec: TaskSpec):
+        addr = self.nodelet_addr
+        deadline = time.monotonic() + GlobalConfig.lease_request_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                conn = await self._nodelet_conn(addr)
+                reply = await conn.call("lease", {"spec": spec.to_wire(),
+                                                  "timeout": 5.0}, timeout=20)
+            except rpc.RpcError:
+                # Target nodelet unreachable (e.g. died): fall back local.
+                self._nodelet_conns.pop(addr, None)
+                addr = self.nodelet_addr
+                await asyncio.sleep(0.2)
+                continue
+            if reply.get("granted"):
+                return conn, reply["lease_id"], reply["worker_addr"]
+            if reply.get("spillback"):
+                addr = reply["spillback"]
+                continue
+            if reply.get("infeasible"):
+                return None
+            if reply.get("timeout"):
+                addr = self.nodelet_addr  # re-evaluate from local
+                continue
+            return None
+        return None
+
+    async def _drain_through_worker(self, state: _SchedulingKeyState,
+                                    worker_addr: str):
+        conn = await self._worker_conn(worker_addr)
+        idle_deadline = time.monotonic() + GlobalConfig.worker_lease_idle_seconds
+        while True:
+            if not state.queue:
+                # Hold the lease briefly for new work (lease reuse hot path).
+                if time.monotonic() > idle_deadline:
+                    return
+                state.wakeup.clear()
+                try:
+                    await asyncio.wait_for(state.wakeup.wait(), timeout=0.05)
+                except asyncio.TimeoutError:
+                    continue
+                continue
+            spec, attempts_left = state.queue.popleft()
+            try:
+                reply = await conn.call("push_task", {"spec": spec.to_wire()},
+                                        timeout=None)
+            except rpc.RpcError as e:
+                self._worker_conns.pop(worker_addr, None)
+                if attempts_left > 0:
+                    state.queue.appendleft((spec, attempts_left - 1))
+                else:
+                    self._fail_task(spec, f"worker died executing task: {e}")
+                return  # lease is dead either way
+            retried = self._handle_task_reply(spec, reply, attempts_left, state)
+            if retried:
+                continue
+            idle_deadline = time.monotonic() + GlobalConfig.worker_lease_idle_seconds
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict,
+                           attempts_left: int,
+                           state: Optional[_SchedulingKeyState]) -> bool:
+        """Returns True if the task was re-queued for retry."""
+        err = reply.get("error")
+        if err is not None:
+            if spec.retry_exceptions and attempts_left > 0 and state is not None:
+                state.queue.append((spec, attempts_left - 1))
+                state.wakeup.set()
+                return True
+            ev = _ErrorValue(err["traceback"], err.get("pickled"),
+                             err.get("fname", spec.function_name),
+                             is_actor=spec.actor_id is not None)
+            self._store_error(spec, ev)
+            return False
+        for oid, ret in zip(spec.return_ids(), reply["returns"]):
+            if "inline" in ret:
+                self.memory_store.put(oid.binary(), ret["inline"])
+            else:
+                with self._ref_lock:
+                    self._plasma_oids.add(oid.binary())
+                self.memory_store.put_in_plasma_marker(oid.binary())
+        for oid in spec.arg_ref_ids():
+            self._remove_local_ref(oid.binary())
+        return False
+
+    def _store_error(self, spec: TaskSpec, error_value: _ErrorValue):
+        data = serialization.serialize_to_bytes(error_value)
+        for oid in spec.return_ids():
+            self.memory_store.put(oid.binary(), data)
+        for oid in spec.arg_ref_ids():
+            self._remove_local_ref(oid.binary())
+
+    def _fail_task(self, spec: TaskSpec, reason: str):
+        self._store_error(spec, _ErrorValue(reason, None, spec.function_name))
+
+    def _propagate_error(self, spec: TaskSpec, error_value):
+        if isinstance(error_value, _ErrorValue):
+            self._store_error(spec, error_value)
+        else:
+            self._fail_task(spec, f"dependency failed: {error_value!r}")
+
+    # ---------------------------------------------------------------- actors
+    def create_actor(self, spec: TaskSpec, *, name: Optional[str],
+                     detached: bool, get_if_exists: bool = False) -> bytes:
+        reply = self.controller.call("register_actor", {
+            "spec": spec.to_wire(), "name": name,
+            "max_restarts": spec.max_restarts, "detached": detached,
+            "get_if_exists": get_if_exists})
+        if reply.get("error"):
+            raise exceptions.RayTpuError(reply["error"])
+        actor_id = reply["actor_id"]
+        if actor_id not in self._actors:
+            self._actors[actor_id] = _ActorState(actor_id, spec.function_name)
+        return actor_id
+
+    def attach_actor(self, actor_id: bytes, class_name: str):
+        if actor_id not in self._actors:
+            self._actors[actor_id] = _ActorState(actor_id, class_name)
+
+    def submit_actor_task(self, actor_id: bytes, spec: TaskSpec,
+                          max_task_retries: int = 0,
+                          temp_refs: Optional[List["ObjectRef"]] = None
+                          ) -> List[ObjectRef]:
+        with self._ref_lock:
+            for oid in spec.return_ids():
+                self._owned.add(oid.binary())
+        refs = [ObjectRef(oid, self) for oid in spec.return_ids()]
+        for oid in spec.arg_ref_ids():
+            self._add_local_ref(oid.binary())
+        del temp_refs
+        self.lt.spawn(self._submit_actor_pipeline(actor_id, spec,
+                                                  max_task_retries))
+        return refs
+
+    async def _submit_actor_pipeline(self, actor_id: bytes, spec: TaskSpec,
+                                     attempts_left: int):
+        try:
+            ok = await self._resolve_dependencies(spec)
+            if not ok:
+                return
+            state = self._actors[actor_id]
+            if state.lock is None:
+                state.lock = asyncio.Lock()
+            async with state.lock:
+                conn = await self._get_actor_conn(state)
+                if conn is None:
+                    self._fail_actor_task(spec, state)
+                    return
+                spec.d["seq"] = state.seq
+                state.seq += 1
+            try:
+                reply = await conn.call("push_actor_task",
+                                        {"spec": spec.to_wire()}, timeout=None)
+            except rpc.RpcError:
+                # Connection dropped: actor crashed or is restarting.
+                state.conn = None
+                state.address = None
+                if attempts_left > 0:
+                    await asyncio.sleep(GlobalConfig.actor_restart_delay_s)
+                    await self._submit_actor_pipeline(actor_id, spec,
+                                                      attempts_left - 1)
+                else:
+                    info = await self._wait_actor_info(actor_id, timeout=5)
+                    reason = (info or {}).get("death_cause") or "connection lost"
+                    self._store_error(spec, _ErrorValue(
+                        f"actor died: {reason}", None, spec.function_name,
+                        is_actor=True))
+                return
+            self._handle_task_reply(spec, reply, 0, None)
+        except Exception as e:
+            self._fail_task(spec, f"actor submission failed: {e!r}")
+
+    async def _wait_actor_info(self, actor_id: bytes, timeout: float = 60.0):
+        return await self.controller.conn.call(
+            "wait_actor", {"actor_id": actor_id, "timeout": timeout},
+            timeout=timeout + 10)
+
+    async def _get_actor_conn(self, state: _ActorState):
+        if state.conn is not None and not state.conn.closed:
+            return state.conn
+        # Poll until ALIVE or DEAD; PENDING/RESTARTING just means the actor
+        # is still being (re)created — give it the full creation budget.
+        deadline = time.monotonic() + GlobalConfig.actor_creation_timeout_s
+        while True:
+            info = await self._wait_actor_info(state.actor_id, timeout=30)
+            st = info.get("state")
+            if st == "ALIVE" and info.get("address"):
+                break
+            if st == "DEAD":
+                state.dead_reason = info.get("death_cause") or "DEAD"
+                return None
+            if time.monotonic() > deadline:
+                state.dead_reason = f"still {st} after creation timeout"
+                return None
+        host, port = _split(info["address"])
+        try:
+            state.conn = await rpc.connect(host, port, retries=10)
+        except rpc.ConnectionLost:
+            return None
+        state.address = info["address"]
+        state.seq = 0  # fresh worker incarnation orders from zero
+        return state.conn
+
+    def _fail_actor_task(self, spec: TaskSpec, state: _ActorState):
+        self._store_error(spec, _ErrorValue(
+            f"actor {state.actor_id.hex()[:12]} is dead: {state.dead_reason}",
+            None, spec.function_name, is_actor=True))
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        state = self._actors.get(actor_id)
+        if state is not None and state.conn is not None and not state.conn.closed:
+            try:
+                self.lt.run(state.conn.call("exit", {"restart": not no_restart},
+                                            timeout=5))
+            except rpc.RpcError:
+                pass
+        self.controller.call("kill_actor", {"actor_id": actor_id,
+                                            "no_restart": no_restart})
+
+    # -------------------------------------------------------------- plumbing
+    async def _worker_conn(self, addr: str) -> rpc.Connection:
+        conn = self._worker_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*_split(addr), retries=5)
+            self._worker_conns[addr] = conn
+        return conn
+
+    async def _nodelet_conn(self, addr: str) -> rpc.Connection:
+        if addr == self.nodelet_addr:
+            return self.nodelet.conn
+        conn = self._nodelet_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*_split(addr), retries=5)
+            self._nodelet_conns[addr] = conn
+        return conn
+
+    async def _on_log(self, conn, data):
+        if GlobalConfig.log_to_driver:
+            print(f"({data.get('src', 'worker')}) {data.get('line', '')}",
+                  flush=True)
+
+    # -------------------------------------------------------------- shutdown
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self.mode == "driver":
+            try:
+                self.controller.call("finish_job",
+                                     {"job_id": self.job_id.binary()}, timeout=5)
+            except Exception:
+                pass
+        for c in (self.controller, self.nodelet):
+            try:
+                c.close()
+            except Exception:
+                pass
+        self.lt.stop()
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+
+def _as_exception(value) -> Exception:
+    if isinstance(value, Exception):
+        return value
+    if isinstance(value, (bytes, memoryview)):
+        v = serialization.deserialize(memoryview(value))
+        if isinstance(v, _ErrorValue):
+            return v.unwrap()
+        if isinstance(v, Exception):
+            return v
+    return exceptions.RayTpuError(str(value))
+
+
+def _split(addr: str) -> Tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+_global_core: Optional[CoreClient] = None
+
+
+def get_global_core() -> Optional[CoreClient]:
+    return _global_core
+
+
+def set_global_core(core: Optional[CoreClient]):
+    global _global_core
+    _global_core = core

@@ -1,0 +1,99 @@
+"""In-process memory store for small objects and pending results.
+
+Equivalent of the reference's CoreWorkerMemoryStore
+(/root/reference/src/ray/core_worker/memory_store/): task returns at or below
+``max_direct_call_object_size`` ride the RPC reply straight into this store,
+never touching shared memory.  Waiters block on a condition variable; errors
+are first-class stored values so ``get`` re-raises at the call site.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class _Entry:
+    __slots__ = ("value", "is_exception")
+
+    def __init__(self, value: Any, is_exception: bool):
+        self.value = value
+        self.is_exception = is_exception
+
+
+class _Sentinel:
+    pass
+
+
+IN_PLASMA = _Sentinel()  # marker: the value lives in the shared-memory store
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._store: Dict[bytes, _Entry] = {}
+
+    def put(self, object_id: bytes, value: Any, is_exception: bool = False):
+        with self._lock:
+            self._store[object_id] = _Entry(value, is_exception)
+            self._lock.notify_all()
+
+    def put_in_plasma_marker(self, object_id: bytes):
+        with self._lock:
+            self._store[object_id] = _Entry(IN_PLASMA, False)
+            self._lock.notify_all()
+
+    def contains(self, object_id: bytes) -> bool:
+        with self._lock:
+            return object_id in self._store
+
+    def peek(self, object_id: bytes) -> Optional[_Entry]:
+        with self._lock:
+            return self._store.get(object_id)
+
+    def get(self, object_ids: List[bytes], timeout: Optional[float]
+            ) -> Optional[List[_Entry]]:
+        """Blocks until every id is present; None on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                missing = [oid for oid in object_ids if oid not in self._store]
+                if not missing:
+                    return [self._store[oid] for oid in object_ids]
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._lock.wait(remaining)
+                else:
+                    self._lock.wait()
+
+    def wait(self, object_ids: List[bytes], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[bytes], List[bytes]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [oid for oid in object_ids if oid in self._store]
+                if len(ready) >= num_returns:
+                    ready_set = set(ready[:num_returns])
+                    ready = [oid for oid in object_ids if oid in ready_set]
+                    not_ready = [oid for oid in object_ids if oid not in ready_set]
+                    return ready, not_ready
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        ready_set = set(ready)
+                        return ready, [o for o in object_ids if o not in ready_set]
+                    self._lock.wait(remaining)
+                else:
+                    self._lock.wait()
+
+    def delete(self, object_ids: List[bytes]):
+        with self._lock:
+            for oid in object_ids:
+                self._store.pop(oid, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._store)

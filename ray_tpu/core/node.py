@@ -1,0 +1,126 @@
+"""Process bootstrap: spawn controller/nodelet daemons for a local cluster.
+
+Equivalent of the reference's Node + services.py process orchestration
+(/root/reference/python/ray/_private/node.py:41, services.py:1200,1273):
+daemons are separate OS processes whose ready lines are read from stdout.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, Optional
+
+
+def new_session_dir() -> str:
+    # NB: not /tmp/ray_tpu — a directory named like the package next to a
+    # user's script would shadow the real package on sys.path.
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray-tpu-sessions")
+    path = os.path.join(base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    return path
+
+
+def _read_ready_line(proc: subprocess.Popen, tag: str, timeout: float = 30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"{tag} process exited with code {proc.returncode}")
+            time.sleep(0.01)
+            continue
+        text = line.decode().strip()
+        if text.startswith(tag):
+            return text.split()[1:]
+    raise TimeoutError(f"timed out waiting for {tag}")
+
+
+class ProcessHandle:
+    def __init__(self, proc: subprocess.Popen, kind: str):
+        self.proc = proc
+        self.kind = kind
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self, sig_term_first: bool = True):
+        if not self.alive():
+            return
+        if sig_term_first:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=3)
+                return
+            except subprocess.TimeoutExpired:
+                pass
+        self.proc.kill()
+        self.proc.wait(timeout=5)
+
+
+def start_controller(session_dir: str, heartbeat_timeout_s: float = 5.0,
+                     port: int = 0) -> tuple:
+    log = open(os.path.join(session_dir, "logs", "controller.err"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.controller_main",
+         "--port", str(port), "--heartbeat-timeout", str(heartbeat_timeout_s)],
+        stdout=subprocess.PIPE, stderr=log, start_new_session=True)
+    log.close()
+    (addr,) = _read_ready_line(proc, "CONTROLLER_READY")
+    return ProcessHandle(proc, "controller"), addr
+
+
+def start_nodelet(session_dir: str, controller_addr: str,
+                  resources: Optional[Dict[str, float]] = None,
+                  object_store_memory: int = 0,
+                  env: Optional[Dict[str, str]] = None) -> tuple:
+    import json
+    log = open(os.path.join(session_dir, "logs", "nodelet.err"), "ab")
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.nodelet_main",
+         "--controller", controller_addr,
+         "--session-dir", session_dir,
+         "--resources", json.dumps(resources or {}),
+         "--object-store-memory", str(object_store_memory)],
+        stdout=subprocess.PIPE, stderr=log, start_new_session=True,
+        env=full_env)
+    log.close()
+    addr, node_id, store_path = _read_ready_line(proc, "NODELET_READY")
+    return ProcessHandle(proc, "nodelet"), addr, node_id, store_path
+
+
+class LocalCluster:
+    """A head node: controller + one nodelet, as subprocesses."""
+
+    def __init__(self, *, resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: int = 0,
+                 heartbeat_timeout_s: float = 5.0):
+        self.session_dir = new_session_dir()
+        self.controller_proc, self.controller_addr = start_controller(
+            self.session_dir, heartbeat_timeout_s)
+        (self.nodelet_proc, self.nodelet_addr, self.node_id,
+         self.store_path) = start_nodelet(
+            self.session_dir, self.controller_addr, resources,
+            object_store_memory)
+        atexit.register(self.shutdown)
+
+    def shutdown(self):
+        for handle in (getattr(self, "nodelet_proc", None),
+                       getattr(self, "controller_proc", None)):
+            if handle is not None:
+                try:
+                    handle.kill()
+                except Exception:
+                    pass
+        try:
+            if os.path.exists(self.store_path):
+                os.unlink(self.store_path)
+        except OSError:
+            pass

@@ -1,0 +1,608 @@
+"""Nodelet — the per-node daemon (raylet equivalent).
+
+Owns the node's shared-memory object store segment, the worker pool
+(/root/reference/src/ray/raylet/worker_pool.cc:276 StartWorkerProcess), the
+local scheduler implementing the worker-lease protocol with spillback
+(/root/reference/src/ray/raylet/node_manager.cc:1880 HandleRequestWorkerLease
++ cluster_task_manager.cc:44), placement-group bundle prepare/commit
+(placement_group_resource_manager.cc:196), and node-to-node chunked object
+transfer (object_manager.cc push/pull, object_manager.proto:22-63).
+
+Drivers and workers on this node talk to the nodelet over TCP; the nodelet
+holds one persistent connection to the controller for heartbeats, the cluster
+resource view, and the object directory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from . import rpc
+from .config import GlobalConfig
+from .ids import NodeID, WorkerID
+from .object_store import client as store_client
+from .scheduling import NodeView, hybrid_policy
+from .task_spec import ResourceSet, TaskSpec
+
+
+class WorkerProc:
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.port: Optional[int] = None
+        self.registered = asyncio.Event()
+        self.state = "starting"   # starting | idle | leased | actor | dead
+        self.lease_id: Optional[bytes] = None
+        self.actor_id: Optional[bytes] = None
+        self.conn: Optional[rpc.Connection] = None
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+
+class Lease:
+    def __init__(self, lease_id: bytes, worker: WorkerProc, resources: ResourceSet):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.resources = resources
+
+
+class Nodelet:
+    def __init__(self, *, controller_addr: str, session_dir: str,
+                 resources: Dict[str, float], host: str = "127.0.0.1", port: int = 0,
+                 node_id: Optional[NodeID] = None,
+                 object_store_memory: Optional[int] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.node_id = node_id or NodeID.from_random()
+        self.controller_addr = controller_addr
+        self.session_dir = session_dir
+        self.labels = labels or {}
+        self.worker_env = worker_env or {}
+        self.server = rpc.RpcServer(host, port)
+        self.total = ResourceSet(resources)
+        self.available = ResourceSet(resources)
+        self.store_path = os.path.join(
+            "/dev/shm" if os.path.isdir("/dev/shm") else session_dir,
+            f"rtstore-{self.node_id.hex()[:12]}")
+        self.store_capacity = object_store_memory or (
+            GlobalConfig.object_store_memory_mb * 1024 * 1024)
+        self.store: Optional[store_client.StoreClient] = None
+        self.controller: Optional[rpc.Connection] = None
+        self.workers: Dict[bytes, WorkerProc] = {}
+        self.leases: Dict[bytes, Lease] = {}
+        self.view: Dict[str, NodeView] = {}
+        self.view_version = -1
+        self.pg_prepared: Dict[tuple, ResourceSet] = {}   # (pg_id, idx) -> reserved
+        self.pg_committed: Dict[tuple, ResourceSet] = {}
+        self._lease_cv = asyncio.Condition()
+        self._lease_waiters = 0
+        self._pull_locks: Dict[bytes, asyncio.Lock] = {}
+        self._peer_conns: Dict[str, rpc.Connection] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._next_worker_seq = 0
+        self._stopping = False
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ setup
+    def _register_handlers(self):
+        s = self.server
+        for name in ("register_worker", "lease", "return_lease", "start_actor",
+                     "pull", "fetch_meta", "fetch", "free_local", "pg_prepare",
+                     "pg_commit", "pg_abort", "pg_return", "kill_worker_at",
+                     "node_info", "stats", "put_location", "ping",
+                     "prestart_workers"):
+            s.register(name, getattr(self, "_h_" + name))
+
+    @property
+    def address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"
+
+    async def start(self):
+        store_client.create_segment(self.store_path, self.store_capacity)
+        self.store = store_client.StoreClient(self.store_path)
+        await self.server.start()
+        host, port = self.controller_addr.rsplit(":", 1)
+        # The controller calls back over this same connection (actor starts,
+        # PG 2PC, frees) — give it the full handler table plus pubsub.
+        handlers = dict(self.server.handlers)
+        handlers["pub:nodes"] = self._on_nodes_event
+        self.controller = await rpc.connect(
+            host, int(port), handlers=handlers,
+            retries=GlobalConfig.rpc_connect_retries)
+        reply = await self.controller.call("register_node", {
+            "node_id": self.node_id.hex(),
+            "addr": self.address,
+            "resources": self.total.to_dict(),
+            "labels": self.labels,
+            "config": GlobalConfig.snapshot(),
+        })
+        await self.controller.call("subscribe", {"channel": "nodes"})
+        self._apply_view(reply["view"], reply["view_version"])
+        for _ in range(GlobalConfig.worker_pool_initial_size):
+            self._spawn_worker()
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        return self
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            if w.proc.poll() is None:
+                w.proc.terminate()
+        for w in self.workers.values():
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                w.proc.kill()
+        await self.server.stop()
+        if self.controller:
+            await self.controller.close()
+        if self.store:
+            self.store.close()
+        try:
+            os.unlink(self.store_path)
+        except OSError:
+            pass
+
+    # ----------------------------------------------------------- cluster view
+    def _apply_view(self, view_wire: List[dict], version: int):
+        self.view = {d["id"]: NodeView.from_wire(d) for d in view_wire}
+        self.view_version = version
+        self._refresh_self_view()
+
+    def _refresh_self_view(self):
+        me = self.view.get(self.node_id.hex())
+        if me is not None:
+            me.available = self.available.copy()
+            me.total = self.total.copy()
+
+    async def _on_nodes_event(self, conn, data):
+        if data.get("event") == "dead":
+            nv = self.view.get(data["node_id"])
+            if nv:
+                nv.alive = False
+            self._peer_conns.pop(data.get("addr", ""), None)
+
+    async def _heartbeat_loop(self):
+        while True:
+            try:
+                reply = await self.controller.call("heartbeat", {
+                    "node_id": self.node_id.hex(),
+                    "available": self.available.to_dict(),
+                    "total": self.total.to_dict(),
+                    "view_version": self.view_version,
+                }, timeout=5)
+                if reply and "view" in reply:
+                    self._apply_view(reply["view"], reply["view_version"])
+            except rpc.RpcError:
+                pass
+            await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
+
+    async def _reap_loop(self):
+        """Detect dead worker processes (the reference raylet gets SIGCHLD)."""
+        while True:
+            await asyncio.sleep(0.2)
+            for w in list(self.workers.values()):
+                if w.state != "dead" and w.proc.poll() is not None:
+                    await self._on_worker_death(w)
+
+    async def _on_worker_death(self, w: WorkerProc):
+        prev_state = w.state
+        w.state = "dead"
+        self.workers.pop(w.worker_id, None)
+        if prev_state == "leased" and w.lease_id in self.leases:
+            lease = self.leases.pop(w.lease_id)
+            self.available.release(lease.resources)
+            await self._notify_lease_waiters()
+        if prev_state == "actor" and w.actor_id is not None:
+            try:
+                await self.controller.call("report_worker_failure", {
+                    "actor_id": w.actor_id,
+                    "reason": f"worker process exited with code {w.proc.returncode}",
+                })
+            except rpc.RpcError:
+                pass
+            # Actor lifetime resources are released exactly once on death
+            # (cleared here; also cleared by start_actor's own error paths).
+            res = getattr(w, "actor_resources", None)
+            if res is not None:
+                w.actor_resources = None
+                self.available.release(res)
+                await self._notify_lease_waiters()
+        if (prev_state in ("idle", "starting") and not self._stopping
+                and len(self.workers) < GlobalConfig.worker_pool_initial_size):
+            self._spawn_worker()
+
+    # ------------------------------------------------------------ worker pool
+    def _spawn_worker(self) -> WorkerProc:
+        worker_id = WorkerID.from_random().binary()
+        self._next_worker_seq += 1
+        log_path = os.path.join(self.session_dir, "logs",
+                                f"worker-{self.node_id.hex()[:8]}-{self._next_worker_seq}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        logf = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main",
+             "--nodelet", self.address,
+             "--controller", self.controller_addr,
+             "--store", self.store_path,
+             "--node-id", self.node_id.hex(),
+             "--worker-id", worker_id.hex(),
+             "--session-dir", self.session_dir],
+            stdout=logf, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+        logf.close()
+        w = WorkerProc(worker_id, proc)
+        self.workers[worker_id] = w
+        return w
+
+    async def _h_register_worker(self, conn, data):
+        w = self.workers.get(data["worker_id"])
+        if w is None:
+            return {"error": "unknown worker"}
+        w.port = data["port"]
+        w.conn = conn
+        w.state = "idle"
+        w.registered.set()
+        conn.peer_info["worker_id"] = data["worker_id"]
+        await self._notify_lease_waiters()
+        return {"config": GlobalConfig.snapshot(), "node_id": self.node_id.hex()}
+
+    async def _h_prestart_workers(self, conn, data):
+        for _ in range(data.get("count", 1)):
+            if len(self.workers) < GlobalConfig.worker_pool_max_size:
+                self._spawn_worker()
+        return True
+
+    async def _pop_idle_worker(self, waiting: int = 1) -> Optional[WorkerProc]:
+        for w in self.workers.values():
+            if w.state == "idle":
+                return w
+        # Spawn by demand, not per poll: at most ``waiting`` workers may be
+        # concurrently starting, else a burst of lease retries forks an
+        # import storm that starves the very workers it is waiting on.
+        starting = sum(1 for w in self.workers.values() if w.state == "starting")
+        alive = sum(1 for w in self.workers.values() if w.state != "dead")
+        if starting < waiting and alive < GlobalConfig.worker_pool_max_size:
+            self._spawn_worker()
+        return None
+
+    async def _notify_lease_waiters(self):
+        self._refresh_self_view()
+        async with self._lease_cv:
+            self._lease_cv.notify_all()
+
+    # -------------------------------------------------------- lease protocol
+    async def _h_lease(self, conn, data):
+        """Grant a worker lease, queue until possible, or spill to a peer.
+
+        The driver retries at the spillback target; hard node-affinity and
+        placement-group shadow resources arrive here as plain resource names,
+        so one code path covers them all.
+        """
+        spec = TaskSpec.from_wire(data["spec"])
+        request = spec.resources
+        strategy = spec.scheduling_strategy
+        deadline = time.monotonic() + data.get("timeout",
+                                               GlobalConfig.lease_request_timeout_s)
+        my_id = self.node_id.hex()
+        self._lease_waiters += 1
+        try:
+            return await self._lease_inner(spec, request, strategy, deadline, my_id)
+        finally:
+            self._lease_waiters -= 1
+
+    async def _lease_inner(self, spec, request, strategy, deadline, my_id):
+        while True:
+            self._refresh_self_view()
+            target = hybrid_policy(
+                self.view, request, my_id,
+                spread_threshold=GlobalConfig.scheduler_spread_threshold,
+                strategy=strategy)
+            if target is not None and target != my_id:
+                nv = self.view.get(target)
+                return {"spillback": nv.addr, "node_id": target}
+            if target is None and not self.total.fits(request):
+                # Infeasible everywhere we know of; wait for cluster growth.
+                if time.monotonic() > deadline:
+                    totals = {n.node_id[:8]: n.total.res for n in self.view.values()}
+                    return {"error": f"infeasible resource request {request.res} "
+                                     f"(cluster node totals: {totals})",
+                            "infeasible": True}
+            if self.available.fits(request):
+                worker = await self._pop_idle_worker(self._lease_waiters)
+                if worker is not None:
+                    lease_id = os.urandom(16)
+                    self.available.acquire(request)
+                    worker.state = "leased"
+                    worker.lease_id = lease_id
+                    self.leases[lease_id] = Lease(lease_id, worker, request)
+                    self._refresh_self_view()
+                    return {"granted": True, "lease_id": lease_id,
+                            "worker_id": worker.worker_id,
+                            "worker_addr": worker.address}
+            if time.monotonic() > deadline:
+                return {"timeout": True}
+            async with self._lease_cv:
+                try:
+                    await asyncio.wait_for(self._lease_cv.wait(), timeout=0.2)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def _h_return_lease(self, conn, data):
+        lease = self.leases.pop(data["lease_id"], None)
+        if lease is None:
+            return False
+        self.available.release(lease.resources)
+        if lease.worker.state == "leased":
+            lease.worker.state = "idle"
+            lease.worker.lease_id = None
+        await self._notify_lease_waiters()
+        return True
+
+    async def _h_start_actor(self, conn, data):
+        """Controller asks us to host an actor: dedicate a worker + resources
+        for the actor's lifetime and push the creation task to it."""
+        spec = TaskSpec.from_wire(data["spec"])
+        request = spec.resources
+        if not self.available.fits(request):
+            return {"ok": False, "retry": True, "error": "resources busy"}
+        deadline = time.monotonic() + 30
+        worker = None
+        while worker is None:
+            worker = await self._pop_idle_worker()
+            if worker is None:
+                if time.monotonic() > deadline:
+                    return {"ok": False, "retry": True, "error": "no worker available"}
+                async with self._lease_cv:
+                    try:
+                        await asyncio.wait_for(self._lease_cv.wait(), timeout=0.2)
+                    except asyncio.TimeoutError:
+                        pass
+        self.available.acquire(request)
+        worker.state = "actor"
+        worker.actor_id = spec.actor_creation_id.binary()
+        worker.actor_resources = request  # type: ignore[attr-defined]
+        self._refresh_self_view()
+        try:
+            reply = await worker.conn.call("create_actor", {"spec": data["spec"]},
+                                           timeout=120)
+        except (rpc.RpcError, asyncio.TimeoutError) as e:
+            # Release exactly once: clear actor_resources so the reap loop
+            # (which releases on dead 'actor' workers) can't double-release.
+            if getattr(worker, "actor_resources", None) is not None:
+                worker.actor_resources = None
+                self.available.release(request)
+            if worker.state == "actor" and worker.proc.poll() is None:
+                worker.proc.terminate()  # unknown state; recycle the process
+            await self._notify_lease_waiters()
+            return {"ok": False, "retry": True, "error": str(e)}
+        if not reply.get("ok"):
+            if getattr(worker, "actor_resources", None) is not None:
+                worker.actor_resources = None
+                self.available.release(request)
+            worker.state = "idle"
+            worker.actor_id = None
+            await self._notify_lease_waiters()
+            return {"ok": False, "retry": False, "error": reply.get("error")}
+        return {"ok": True, "worker_addr": worker.address}
+
+    async def _h_kill_worker_at(self, conn, data):
+        for w in self.workers.values():
+            if w.address == data["address"] and w.proc.poll() is None:
+                w.proc.terminate()
+                return True
+        return False
+
+    # --------------------------------------------------- placement-group 2PC
+    async def _h_pg_prepare(self, conn, data):
+        req = ResourceSet(data["resources"])
+        if not self.available.fits(req):
+            return False
+        self.available.acquire(req)
+        self.pg_prepared[(data["pg_id"], data["bundle_index"])] = req
+        self._refresh_self_view()
+        return True
+
+    async def _h_pg_commit(self, conn, data):
+        key = (data["pg_id"], data["bundle_index"])
+        req = self.pg_prepared.pop(key, None)
+        if req is None:
+            return False
+        self.pg_committed[key] = req
+        # Shadow resources let tasks target the bundle (reference naming:
+        # CPU_group_{index}_{pgid} and CPU_group_{pgid}).
+        hexid = data["pg_id"].hex() if isinstance(data["pg_id"], bytes) else data["pg_id"]
+        shadow = {}
+        for k, v in req.res.items():
+            shadow[f"{k}_group_{data['bundle_index']}_{hexid}"] = v
+            shadow[f"{k}_group_{hexid}"] = v
+        self.total.release(ResourceSet(shadow))
+        self.available.release(ResourceSet(shadow))
+        await self._notify_lease_waiters()
+        return True
+
+    async def _h_pg_abort(self, conn, data):
+        req = self.pg_prepared.pop((data["pg_id"], data["bundle_index"]), None)
+        if req is not None:
+            self.available.release(req)
+            await self._notify_lease_waiters()
+        return True
+
+    async def _h_pg_return(self, conn, data):
+        key = (data["pg_id"], data["bundle_index"])
+        req = self.pg_committed.pop(key, None)
+        if req is None:
+            return False
+        hexid = data["pg_id"].hex() if isinstance(data["pg_id"], bytes) else data["pg_id"]
+        shadow = {}
+        for k, v in req.res.items():
+            shadow[f"{k}_group_{data['bundle_index']}_{hexid}"] = v
+            shadow[f"{k}_group_{hexid}"] = v
+        self.total.acquire(ResourceSet(shadow))
+        self.available.acquire(ResourceSet(shadow))
+        self.available.release(req)
+        await self._notify_lease_waiters()
+        return True
+
+    # -------------------------------------------------------- object transfer
+    async def _h_put_location(self, conn, data):
+        await self.controller.call("object_location_add", {
+            "object_id": data["object_id"], "node_id": self.node_id.hex(),
+            "size": data.get("size", 0)})
+        return True
+
+    async def _h_pull(self, conn, data):
+        """Make the object local: chunk-pull from a peer holding it
+        (reference: pull_manager.cc:442 TryToMakeObjectLocal +
+        push_manager.cc chunked pushes)."""
+        oid = data["object_id"]
+        timeout = data.get("timeout", 30.0)
+        if self.store.contains(oid):
+            return {"ok": True}
+        lock = self._pull_locks.setdefault(oid, asyncio.Lock())
+        async with lock:
+            if self.store.contains(oid):
+                return {"ok": True}
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    info = await self.controller.call("object_locations_get", {
+                        "object_id": oid,
+                        "timeout": min(2.0, deadline - time.monotonic())})
+                except rpc.RpcError as e:
+                    return {"ok": False, "error": str(e)}
+                addrs = [a for a in info["locations"] if a != self.address]
+                if not addrs:
+                    if self.store.contains(oid):
+                        return {"ok": True}
+                    await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
+                    continue
+                for addr in addrs:
+                    if await self._pull_from(oid, addr):
+                        await self._h_put_location(None, {"object_id": oid})
+                        return {"ok": True}
+                await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
+            return {"ok": False, "error": f"pull timeout for {oid.hex()}"}
+
+    async def _peer(self, addr: str) -> rpc.Connection:
+        conn = self._peer_conns.get(addr)
+        if conn is None or conn.closed:
+            host, port = addr.rsplit(":", 1)
+            conn = await rpc.connect(host, int(port), retries=3)
+            self._peer_conns[addr] = conn
+        return conn
+
+    async def _pull_from(self, oid: bytes, addr: str) -> bool:
+        try:
+            peer = await self._peer(addr)
+            meta = await peer.call("fetch_meta", {"object_id": oid}, timeout=10)
+            if not meta.get("exists"):
+                return False
+            size = meta["size"]
+            try:
+                dest = self.store.create(oid, size)
+            except store_client.ObjectExistsError:
+                return True
+            chunk = GlobalConfig.object_transfer_chunk_bytes
+            try:
+                off = 0
+                while off < size:
+                    n = min(chunk, size - off)
+                    part = await peer.call("fetch", {"object_id": oid,
+                                                     "offset": off, "size": n},
+                                           timeout=30)
+                    if part is None:
+                        raise rpc.RpcError("remote object vanished mid-pull")
+                    dest[off: off + len(part)] = part
+                    off += len(part)
+            except BaseException:
+                del dest
+                self.store.abort(oid)
+                raise
+            del dest
+            self.store.seal(oid)
+            return True
+        except (rpc.RpcError, OSError):
+            return False
+
+    async def _h_fetch_meta(self, conn, data):
+        oid = data["object_id"]
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            return {"exists": False}
+        try:
+            return {"exists": True, "size": view.nbytes}
+        finally:
+            del view
+            self.store.release(oid)
+
+    async def _h_fetch(self, conn, data):
+        oid = data["object_id"]
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            return None
+        try:
+            off, size = data["offset"], data["size"]
+            return bytes(view[off: off + size])
+        finally:
+            del view
+            self.store.release(oid)
+
+    async def _h_free_local(self, conn, data):
+        for oid in data["object_ids"]:
+            try:
+                self.store.delete(oid)
+            except store_client.StoreError:
+                pass
+        return True
+
+    # ---------------------------------------------------------------- info
+    async def _h_node_info(self, conn, data):
+        return {"node_id": self.node_id.hex(), "addr": self.address,
+                "store_path": self.store_path,
+                "total": self.total.to_dict(),
+                "available": self.available.to_dict()}
+
+    async def _h_stats(self, conn, data):
+        return {"store": self.store.stats(),
+                "workers": {w.worker_id.hex()[:8]: w.state
+                            for w in self.workers.values()},
+                "leases": len(self.leases),
+                "available": self.available.to_dict()}
+
+    async def _h_ping(self, conn, data):
+        return "pong"
+
+
+def detect_tpu_resources() -> Dict[str, float]:
+    """TPU chip detection via JAX — the accelerator-native analogue of the
+    reference's GPU autodetect (_private/resource_spec.py:175)."""
+    if not GlobalConfig.tpu_autodetect:
+        return {}
+    override = GlobalConfig.tpu_chips_per_host_override
+    if override:
+        return {"TPU": float(override)}
+    try:
+        import jax
+        chips = [d for d in jax.devices() if d.platform == "tpu"]
+        if chips:
+            res = {"TPU": float(len(chips))}
+            kind = chips[0].device_kind.replace(" ", "-")
+            res[f"accelerator_type:{kind}"] = 1.0
+            return res
+    except Exception:
+        pass
+    return {}

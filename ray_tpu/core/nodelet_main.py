@@ -1,0 +1,52 @@
+"""Nodelet process entrypoint (reference: src/ray/raylet/main.cc:78).
+
+Prints ``NODELET_READY <host:port> <node_id_hex> <store_path>`` once serving.
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--controller", required=True)
+    p.add_argument("--session-dir", required=True)
+    p.add_argument("--resources", default="{}",
+                   help="JSON resource dict, e.g. '{\"CPU\": 8, \"TPU\": 4}'")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--object-store-memory", type=int, default=0)
+    p.add_argument("--labels", default="{}")
+    args = p.parse_args()
+
+    from .nodelet import Nodelet, detect_tpu_resources
+
+    resources = json.loads(args.resources)
+    if "CPU" not in resources:
+        import os
+        resources["CPU"] = float(os.cpu_count() or 1)
+    for k, v in detect_tpu_resources().items():
+        resources.setdefault(k, v)
+
+    async def run():
+        n = Nodelet(
+            controller_addr=args.controller,
+            session_dir=args.session_dir,
+            resources=resources,
+            host=args.host,
+            port=args.port,
+            object_store_memory=args.object_store_memory or None,
+            labels=json.loads(args.labels),
+        )
+        await n.start()
+        print(f"NODELET_READY {n.address} {n.node_id.hex()} {n.store_path}",
+              flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

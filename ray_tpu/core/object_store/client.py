@@ -64,7 +64,7 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(_ensure_built())
+    lib = ctypes.CDLL(_ensure_built(), use_errno=True)
     lib.rts_create_segment.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.rts_create_segment.restype = ctypes.c_int
     lib.rts_open.argtypes = [ctypes.c_char_p]
@@ -103,7 +103,12 @@ class StoreClient:
         self._lib = _load()
         self._h = self._lib.rts_open(path.encode())
         if not self._h:
-            raise StoreError(f"cannot open store segment {path}")
+            import errno as _errno
+            e = ctypes.get_errno()
+            raise StoreError(
+                f"cannot open store segment {path} "
+                f"(errno={e} {_errno.errorcode.get(e, '?')}, "
+                f"exists={os.path.exists(path)})")
         size = self._lib.rts_segment_size(self._h)
         fd = os.open(path, os.O_RDWR)
         try:

@@ -1,0 +1,171 @@
+"""Cluster scheduling policies.
+
+Implements the reference's two-level scheduling policies over a cluster
+resource view (/root/reference/src/ray/raylet/scheduling/policy/
+hybrid_scheduling_policy.h:23-46 for the hybrid score; bundle_scheduling_policy.cc
+for placement-group bundle packing).  The view is a dict
+``{node_id_hex: NodeView}`` maintained from heartbeats; every nodelet and the
+controller run the same code, so spillback decisions agree cluster-wide.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from .task_spec import EPS, ResourceSet
+
+
+class NodeView:
+    __slots__ = ("node_id", "addr", "available", "total", "alive", "labels")
+
+    def __init__(self, node_id: str, addr: str, available: Dict[str, float],
+                 total: Dict[str, float], alive: bool = True,
+                 labels: Optional[Dict[str, str]] = None):
+        self.node_id = node_id
+        self.addr = addr
+        self.available = ResourceSet(available)
+        self.total = ResourceSet(total)
+        self.alive = alive
+        self.labels = labels or {}
+
+    def to_wire(self):
+        return {"id": self.node_id, "addr": self.addr,
+                "avail": self.available.to_dict(), "total": self.total.to_dict(),
+                "alive": self.alive, "labels": self.labels}
+
+    @classmethod
+    def from_wire(cls, d):
+        return cls(d["id"], d["addr"], d["avail"], d["total"], d["alive"],
+                   d.get("labels"))
+
+
+def is_feasible(view: NodeView, request: ResourceSet) -> bool:
+    return view.alive and view.total.fits(request)
+
+
+def hybrid_policy(
+    views: Dict[str, NodeView],
+    request: ResourceSet,
+    local_node_id: Optional[str] = None,
+    spread_threshold: float = 0.5,
+    strategy: Optional[dict] = None,
+    rng: Optional[random.Random] = None,
+) -> Optional[str]:
+    """Pick a node id for ``request``, or None if infeasible everywhere.
+
+    Hybrid semantics from the reference: prefer nodes that can run the task
+    *now* over merely-feasible ones; among available nodes score by critical
+    resource utilization, truncated below ``spread_threshold`` so an
+    under-utilized cluster packs (ties broken toward the local node, then
+    lexical node id for determinism), and spreads once utilization passes the
+    threshold.
+    """
+    strategy = strategy or {}
+    if strategy.get("node_id"):
+        nv = views.get(strategy["node_id"])
+        if nv is not None and is_feasible(nv, request):
+            if strategy.get("soft") or nv.available.fits(request):
+                return nv.node_id
+            return nv.node_id  # hard affinity: queue there
+        return None
+    if strategy.get("spread"):
+        # Round-robin over feasible nodes, preferring available ones.
+        avail = [n for n in views.values()
+                 if is_feasible(n, request) and n.available.fits(request)]
+        feas = [n for n in views.values() if is_feasible(n, request)]
+        pool = avail or feas
+        if not pool:
+            return None
+        r = rng or random
+        return r.choice(pool).node_id
+
+    best: List[Tuple[float, int, str]] = []
+    for n in views.values():
+        if not is_feasible(n, request):
+            continue
+        available_now = n.available.fits(request)
+        util = (n.total.res and _util_after(n, request)) or 0.0
+        score = 0.0 if util < spread_threshold else util
+        # Sort key: available first, then low score, then local, then id.
+        local_bias = 0 if n.node_id == local_node_id else 1
+        best.append((score + (0 if available_now else 10.0), local_bias, n.node_id))
+    if not best:
+        return None
+    best.sort()
+    return best[0][2]
+
+
+def _util_after(n: NodeView, request: ResourceSet) -> float:
+    remaining = n.available.copy()
+    remaining.acquire(request)
+    return remaining.utilization(n.total)
+
+
+def pack_bundles(
+    views: Dict[str, NodeView],
+    bundles: List[Dict[str, float]],
+    strategy: str,
+) -> Optional[List[str]]:
+    """Assign each bundle a node id honoring a placement-group strategy.
+
+    PACK: minimize node count (best effort) — sort nodes by free capacity and
+    fill.  STRICT_PACK: all on one node.  SPREAD: best-effort distinct nodes.
+    STRICT_SPREAD: must be distinct nodes.  Returns None if unplaceable now.
+    (reference: src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc)
+    """
+    reqs = [ResourceSet(b) for b in bundles]
+    nodes = [n for n in views.values() if n.alive]
+    scratch = {n.node_id: n.available.copy() for n in nodes}
+
+    def fits(nid, req):
+        return scratch[nid].fits(req)
+
+    def take(nid, req):
+        scratch[nid].acquire(req)
+
+    if strategy == "STRICT_PACK":
+        for n in nodes:
+            if all(_seq_fits(scratch[n.node_id].copy(), reqs)):
+                return [n.node_id] * len(reqs)
+        return None
+
+    order = sorted(nodes, key=lambda n: -sum(n.available.res.values()))
+    placement: List[Optional[str]] = [None] * len(reqs)
+    if strategy in ("PACK", ""):
+        for i, req in enumerate(reqs):
+            placed = False
+            for n in order:
+                if fits(n.node_id, req):
+                    take(n.node_id, req)
+                    placement[i] = n.node_id
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return placement  # type: ignore[return-value]
+
+    # SPREAD / STRICT_SPREAD
+    used_nodes: set = set()
+    for i, req in enumerate(reqs):
+        candidates = sorted(order, key=lambda n: (n.node_id in used_nodes,
+                                                  -sum(scratch[n.node_id].res.values())))
+        placed = False
+        for n in candidates:
+            if strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                continue
+            if fits(n.node_id, req):
+                take(n.node_id, req)
+                used_nodes.add(n.node_id)
+                placement[i] = n.node_id
+                placed = True
+                break
+        if not placed:
+            return None
+    return placement  # type: ignore[return-value]
+
+
+def _seq_fits(avail: ResourceSet, reqs: List[ResourceSet]):
+    for r in reqs:
+        yield avail.fits(r)
+        avail.acquire(r)

@@ -1,0 +1,231 @@
+"""Task specifications and resource-set math.
+
+Equivalent of the reference's TaskSpecification / ResourceRequest
+(/root/reference/src/ray/common/task/task_spec.h,
+/root/reference/src/ray/raylet/scheduling/cluster_resource_data.h).  Specs are
+plain msgpack-able dicts wrapped in a thin class so they cross process
+boundaries without pickling; resource math uses floats with a small epsilon
+(the reference uses fixed-point for the same reason — avoid drift when
+repeatedly acquiring/returning fractional resources).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+EPS = 1e-6
+
+# Argument encodings inside a spec.
+ARG_VALUE = 0   # inline serialized bytes
+ARG_REF = 1     # ObjectID binary — resolved before execution
+
+
+class ResourceSet:
+    """A bag of named resource quantities with acquire/release arithmetic."""
+
+    __slots__ = ("res",)
+
+    def __init__(self, res: Optional[Dict[str, float]] = None):
+        self.res = {k: float(v) for k, v in (res or {}).items() if v}
+
+    def fits(self, request: "ResourceSet") -> bool:
+        for k, v in request.res.items():
+            if self.res.get(k, 0.0) + EPS < v:
+                return False
+        return True
+
+    def acquire(self, request: "ResourceSet"):
+        for k, v in request.res.items():
+            self.res[k] = self.res.get(k, 0.0) - v
+
+    def release(self, request: "ResourceSet"):
+        for k, v in request.res.items():
+            self.res[k] = self.res.get(k, 0.0) + v
+
+    def utilization(self, total: "ResourceSet") -> float:
+        """Max per-resource utilization — the 'critical resource' score used by
+        the hybrid policy (reference: hybrid_scheduling_policy.h:23-46)."""
+        best = 0.0
+        for k, cap in total.res.items():
+            if cap <= 0:
+                continue
+            used = cap - self.res.get(k, 0.0)
+            best = max(best, used / cap)
+        return best
+
+    def to_dict(self) -> Dict[str, float]:
+        return dict(self.res)
+
+    def copy(self) -> "ResourceSet":
+        return ResourceSet(self.res)
+
+    def __repr__(self):
+        return f"ResourceSet({self.res})"
+
+
+class TaskSpec:
+    """A submitted unit of work.  ``d`` is the wire format (msgpack dict)."""
+
+    __slots__ = ("d",)
+
+    def __init__(self, d: Dict[str, Any]):
+        self.d = d
+
+    @classmethod
+    def build(
+        cls,
+        *,
+        task_id: TaskID,
+        job_id: JobID,
+        function_id: bytes,
+        function_name: str,
+        args: List[Any],          # list of (ARG_VALUE, bytes) | (ARG_REF, id-bytes)
+        num_returns: int,
+        resources: Dict[str, float],
+        owner_addr: str,
+        max_retries: int = 0,
+        retry_exceptions: bool = False,
+        actor_creation_id: Optional[ActorID] = None,
+        actor_id: Optional[ActorID] = None,
+        actor_seq: int = 0,
+        max_concurrency: int = 1,
+        max_restarts: int = 0,
+        placement_group_id: Optional[PlacementGroupID] = None,
+        bundle_index: int = -1,
+        scheduling_strategy: Optional[Dict[str, Any]] = None,
+        runtime_env: Optional[Dict[str, Any]] = None,
+    ) -> "TaskSpec":
+        return cls({
+            "tid": task_id.binary(),
+            "jid": job_id.binary(),
+            "fid": function_id,
+            "fname": function_name,
+            "args": args,
+            "nret": num_returns,
+            "res": {k: float(v) for k, v in resources.items() if v},
+            "owner": owner_addr,
+            "retries": max_retries,
+            "retry_exc": retry_exceptions,
+            "actor_new": actor_creation_id.binary() if actor_creation_id else None,
+            "actor": actor_id.binary() if actor_id else None,
+            "seq": actor_seq,
+            "maxc": max_concurrency,
+            "max_restarts": max_restarts,
+            "pg": placement_group_id.binary() if placement_group_id else None,
+            "bundle": bundle_index,
+            "strategy": scheduling_strategy or {},
+            "renv": runtime_env or {},
+        })
+
+    # -- accessors -----------------------------------------------------------
+    @property
+    def task_id(self) -> TaskID:
+        return TaskID(self.d["tid"])
+
+    @property
+    def job_id(self) -> JobID:
+        return JobID(self.d["jid"])
+
+    @property
+    def function_id(self) -> bytes:
+        return self.d["fid"]
+
+    @property
+    def function_name(self) -> str:
+        return self.d["fname"]
+
+    @property
+    def args(self) -> List[Any]:
+        return self.d["args"]
+
+    @property
+    def num_returns(self) -> int:
+        return self.d["nret"]
+
+    @property
+    def resources(self) -> ResourceSet:
+        return ResourceSet(self.d["res"])
+
+    @property
+    def owner_addr(self) -> str:
+        return self.d["owner"]
+
+    @property
+    def max_retries(self) -> int:
+        return self.d["retries"]
+
+    @property
+    def retry_exceptions(self) -> bool:
+        return self.d.get("retry_exc", False)
+
+    @property
+    def is_actor_creation(self) -> bool:
+        return self.d["actor_new"] is not None
+
+    @property
+    def actor_creation_id(self) -> Optional[ActorID]:
+        b = self.d["actor_new"]
+        return ActorID(b) if b else None
+
+    @property
+    def actor_id(self) -> Optional[ActorID]:
+        b = self.d["actor"]
+        return ActorID(b) if b else None
+
+    @property
+    def actor_seq(self) -> int:
+        return self.d["seq"]
+
+    @property
+    def max_concurrency(self) -> int:
+        return self.d.get("maxc", 1)
+
+    @property
+    def max_restarts(self) -> int:
+        return self.d.get("max_restarts", 0)
+
+    @property
+    def placement_group_id(self) -> Optional[PlacementGroupID]:
+        b = self.d.get("pg")
+        return PlacementGroupID(b) if b else None
+
+    @property
+    def bundle_index(self) -> int:
+        return self.d.get("bundle", -1)
+
+    @property
+    def scheduling_strategy(self) -> Dict[str, Any]:
+        return self.d.get("strategy") or {}
+
+    @property
+    def runtime_env(self) -> Dict[str, Any]:
+        return self.d.get("renv") or {}
+
+    def return_ids(self) -> List[ObjectID]:
+        tid = self.task_id
+        return [ObjectID.for_task_return(tid, i) for i in range(self.num_returns)]
+
+    def arg_ref_ids(self) -> List[ObjectID]:
+        return [ObjectID(a[1]) for a in self.d["args"] if a[0] == ARG_REF]
+
+    def scheduling_key(self) -> tuple:
+        """Tasks with the same key can reuse each other's worker leases
+        (reference: direct_task_transport SchedulingKey)."""
+        res = tuple(sorted(self.d["res"].items()))
+        strat = self.d.get("strategy") or {}
+        return (self.d["fid"], res, self.d.get("pg"), self.d.get("bundle", -1),
+                strat.get("node_id"), strat.get("spread", False))
+
+    def to_wire(self) -> Dict[str, Any]:
+        return self.d
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "TaskSpec":
+        return cls(d)
+
+    def __repr__(self):
+        kind = "actor_creation" if self.is_actor_creation else (
+            "actor_task" if self.d["actor"] else "task")
+        return f"TaskSpec<{kind} {self.function_name} {self.task_id.hex()[:12]}>"

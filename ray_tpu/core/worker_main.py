@@ -1,0 +1,42 @@
+"""Worker process entrypoint (reference: python/ray/_private/workers/default_worker.py)."""
+
+import argparse
+import asyncio
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodelet", required=True)
+    p.add_argument("--controller", required=True)
+    p.add_argument("--store", required=True)
+    p.add_argument("--node-id", required=True)
+    p.add_argument("--worker-id", required=True)
+    p.add_argument("--session-dir", required=True)
+    args = p.parse_args()
+
+    import json
+    import os
+    os.environ["RAY_TPU_WORKER_CONTEXT"] = json.dumps({
+        "controller": args.controller, "nodelet": args.nodelet,
+        "store": args.store, "node_id": args.node_id,
+        "session_dir": args.session_dir})
+
+    from .worker_runtime import WorkerRuntime
+
+    async def run():
+        rt = WorkerRuntime(
+            nodelet_addr=args.nodelet,
+            controller_addr=args.controller,
+            store_path=args.store,
+            node_id=args.node_id,
+            worker_id=bytes.fromhex(args.worker_id),
+            session_dir=args.session_dir,
+        )
+        await rt.start()
+        await rt.run_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,282 @@
+"""Worker process runtime: the task execution loop.
+
+The in-process half of the reference's core worker
+(/root/reference/src/ray/core_worker/core_worker.cc ExecuteTask :2243 /
+HandlePushTask :2648, with Python dispatch at _raylet.pyx:678).  A worker:
+
+- serves ``push_task`` / ``create_actor`` / ``push_actor_task`` RPCs pushed
+  *directly* by drivers and other workers (direct task transport — no nodelet
+  round-trip on the hot path),
+- resolves reference args from the node's shared-memory store (pulling
+  remote objects via the nodelet),
+- executes user code on executor threads so the RPC loop stays live,
+- returns small results inline in the RPC reply and puts large ones into the
+  shared-memory store (reference: max_direct_call_object_size split),
+- for actors, keeps the live instance and executes methods in per-caller
+  sequence order (transport/actor_scheduling_queue.cc semantics); with
+  ``max_concurrency > 1`` methods run out-of-order on a thread pool.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from .. import exceptions
+from . import rpc, serialization
+from .config import GlobalConfig
+from .object_store import client as store_client
+from .task_spec import ARG_REF, ARG_VALUE, TaskSpec
+
+FN_NAMESPACE = "fn"
+
+
+class WorkerRuntime:
+    def __init__(self, *, nodelet_addr: str, controller_addr: str,
+                 store_path: str, node_id: str, worker_id: bytes,
+                 session_dir: str):
+        self.nodelet_addr = nodelet_addr
+        self.controller_addr = controller_addr
+        self.node_id = node_id
+        self.worker_id = worker_id
+        self.session_dir = session_dir
+        self.store = store_client.StoreClient(store_path)
+        self.server = rpc.RpcServer("127.0.0.1", 0)
+        self.nodelet: Optional[rpc.Connection] = None
+        self.controller: Optional[rpc.Connection] = None
+        self.fn_cache: Dict[bytes, Any] = {}
+        self.actor_instance: Any = None
+        self.actor_id: Optional[bytes] = None
+        self.actor_max_concurrency = 1
+        self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        self._seq_state: Dict[int, Dict[str, Any]] = {}  # conn id -> ordering
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pinned_args: set = set()
+        self._dying = False
+        self._shutdown = asyncio.Event()
+        for name in ("push_task", "create_actor", "push_actor_task", "ping",
+                     "exit", "actor_checkpoint"):
+            self.server.register(name, getattr(self, "_h_" + name))
+
+    # ------------------------------------------------------------------ setup
+    async def start(self):
+        self._loop = asyncio.get_event_loop()
+        await self.server.start()
+        host, port = self.nodelet_addr.rsplit(":", 1)
+        # The nodelet pushes actor-creation tasks back over this connection,
+        # so it shares the server's handler table.
+        self.nodelet = await rpc.connect(host, int(port),
+                                         handlers=dict(self.server.handlers),
+                                         retries=GlobalConfig.rpc_connect_retries)
+        host, port = self.controller_addr.rsplit(":", 1)
+        self.controller = await rpc.connect(host, int(port),
+                                            retries=GlobalConfig.rpc_connect_retries)
+        reply = await self.nodelet.call("register_worker", {
+            "worker_id": self.worker_id, "port": self.server.port,
+            "pid": os.getpid()})
+        GlobalConfig.load_snapshot(reply.get("config", {}))
+        self.nodelet.on_close = lambda conn: os._exit(1)  # nodelet died -> die
+        return self
+
+    async def run_forever(self):
+        await self._shutdown.wait()
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.server.port}"
+
+    # -------------------------------------------------------------- execution
+    async def _resolve_args(self, spec: TaskSpec):
+        """Returns (args, kwargs, views-to-release)."""
+        flat: List[Any] = []
+        views: List[bytes] = []
+        for kind, payload in spec.args:
+            if kind == ARG_VALUE:
+                flat.append(serialization.deserialize(memoryview(payload)))
+            else:
+                oid = payload
+                view = self.store.get(oid, timeout_ms=0)
+                if view is not None and oid in self._pinned_args:
+                    self.store.release(oid)  # one pin per object is enough
+                if view is None:
+                    r = await self.nodelet.call("pull", {"object_id": oid},
+                                                timeout=60)
+                    if not r.get("ok"):
+                        raise exceptions.ObjectLostError(oid.hex(), r.get("error", ""))
+                    view = self.store.get(oid, timeout_ms=5000)
+                    if view is None:
+                        raise exceptions.ObjectLostError(oid.hex(), "pull raced eviction")
+                self._pinned_args.add(oid)
+                views.append(oid)
+                value = serialization.deserialize(view)
+                if isinstance(value, _ErrorValue):
+                    raise value.unwrap(spec.function_name)
+                flat.append(value)
+        # Last element is the kwargs dict marker produced by the submitter.
+        *args, kwargs = flat
+        return args, kwargs, views
+
+    async def _get_function(self, fid: bytes):
+        fn = self.fn_cache.get(fid)
+        if fn is None:
+            blob = await self.controller.call("kv_get",
+                                              {"ns": FN_NAMESPACE, "key": fid})
+            if blob is None:
+                raise exceptions.RayTpuError(f"function {fid.hex()[:12]} not registered")
+            fn = serialization.loads_function(blob)
+            self.fn_cache[fid] = fn
+        return fn
+
+    async def _store_returns(self, spec: TaskSpec, result: Any) -> List[dict]:
+        nret = spec.num_returns
+        values = [result] if nret == 1 else list(result)
+        if nret > 1 and len(values) != nret:
+            raise ValueError(f"task {spec.function_name} declared {nret} returns "
+                             f"but produced {len(values)}")
+        out = []
+        for i, value in enumerate(values):
+            parts = serialization.serialize(value)
+            size = serialization.serialized_size(parts)
+            if size <= GlobalConfig.max_direct_call_object_size:
+                out.append({"inline": b"".join(bytes(p) for p in parts)})
+            else:
+                oid = spec.return_ids()[i].binary()
+                self.store.put_parts(oid, parts)
+                await self.nodelet.call("put_location",
+                                        {"object_id": oid, "size": size})
+                out.append({"plasma": size})
+        return out
+
+    def _run_user_code(self, fn, args, kwargs):
+        return fn(*args, **kwargs)
+
+    async def _execute(self, spec: TaskSpec, fn) -> dict:
+        # NB: store pins taken while resolving reference args are *not*
+        # released after execution — deserialization is zero-copy, so user
+        # code (e.g. an actor stashing an argument array) may alias store
+        # memory indefinitely.  Pins are deduped per object and dropped only
+        # when the worker exits (reference plasma has the same client-side
+        # pin-while-mapped semantics).
+        try:
+            args, kwargs, _views = await self._resolve_args(spec)
+            result = await self._loop.run_in_executor(
+                self.executor, self._run_user_code, fn, args, kwargs)
+            returns = await self._store_returns(spec, result)
+            return {"returns": returns}
+        except Exception as e:
+            tb = traceback.format_exc()
+            try:
+                pickled = serialization.dumps_function(e)
+            except Exception:
+                pickled = None
+            return {"error": {"traceback": tb, "pickled": pickled,
+                              "fname": spec.function_name}}
+
+    # --------------------------------------------------------------- handlers
+    async def _h_push_task(self, conn, data):
+        if self._dying:
+            return {"error": {"traceback": "worker is exiting", "pickled": None,
+                              "fname": "", "dying": True}}
+        spec = TaskSpec.from_wire(data["spec"])
+        try:
+            fn = await self._get_function(spec.function_id)
+        except Exception:
+            # Function-table / unpickling failures are user errors, not
+            # transport errors: report in-band so the driver doesn't treat a
+            # healthy worker as crashed.
+            return {"error": {"traceback": traceback.format_exc(),
+                              "pickled": None, "fname": spec.function_name}}
+        return await self._execute(spec, fn)
+
+    async def _h_create_actor(self, conn, data):
+        spec = TaskSpec.from_wire(data["spec"])
+        try:
+            cls = await self._get_function(spec.function_id)
+            args, kwargs, _ = await self._resolve_args(spec)
+            self.actor_instance = await self._loop.run_in_executor(
+                self.executor, lambda: cls(*args, **kwargs))
+            self.actor_id = spec.actor_creation_id.binary()
+            self.actor_max_concurrency = max(1, spec.max_concurrency)
+            if self.actor_max_concurrency > 1:
+                self.executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self.actor_max_concurrency)
+            await self.controller.call("actor_alive", {
+                "actor_id": self.actor_id, "address": self.address,
+                "worker_id": self.worker_id, "node_id": self.node_id})
+            return {"ok": True}
+        except Exception:
+            return {"ok": False, "error": traceback.format_exc()}
+
+    async def _h_push_actor_task(self, conn, data):
+        """Execute an actor method in per-caller seq order."""
+        spec = TaskSpec.from_wire(data["spec"])
+        if self._dying:
+            return {"error": {"traceback": "actor is exiting (killed)",
+                              "pickled": None, "fname": spec.function_name,
+                              "dying": True}}
+        state = self._seq_state.setdefault(
+            id(conn), {"next": 0, "waiters": {}})
+        seq = spec.actor_seq
+        if self.actor_max_concurrency == 1:
+            while state["next"] != seq:
+                ev = asyncio.Event()
+                state["waiters"][seq] = ev
+                await ev.wait()
+        if self.actor_instance is None:
+            return {"error": {"traceback": "actor instance not created",
+                              "pickled": None, "fname": spec.function_name}}
+        try:
+            method = getattr(self.actor_instance, spec.function_name)
+            return await self._execute(spec, method)
+        finally:
+            if self.actor_max_concurrency == 1:
+                state["next"] = seq + 1
+                ev = state["waiters"].pop(seq + 1, None)
+                if ev:
+                    ev.set()
+
+    async def _h_actor_checkpoint(self, conn, data):
+        """Optional user hook: actors exposing __save__/__restore__."""
+        if self.actor_instance is None or not hasattr(self.actor_instance, "__save__"):
+            return None
+        return serialization.serialize_to_bytes(self.actor_instance.__save__())
+
+    async def _h_ping(self, conn, data):
+        return "pong"
+
+    async def _h_exit(self, conn, data):
+        self._dying = True
+        if self.actor_instance is not None and self.actor_id is not None:
+            try:
+                await self.controller.call("report_actor_death", {
+                    "actor_id": self.actor_id, "reason": "ray_tpu.kill",
+                    "intended": not data.get("restart", False)})
+            except rpc.RpcError:
+                pass
+        threading.Timer(0.05, lambda: os._exit(0)).start()
+        return True
+
+
+class _ErrorValue:
+    """A stored value representing a task failure; getting it re-raises."""
+
+    def __init__(self, traceback_str: str, pickled: Optional[bytes], fname: str,
+                 is_actor: bool = False):
+        self.traceback_str = traceback_str
+        self.pickled = pickled
+        self.fname = fname
+        self.is_actor = is_actor
+
+    def unwrap(self, context_fname: str = "") -> Exception:
+        cause = None
+        if self.pickled is not None:
+            try:
+                cause = serialization.loads_function(self.pickled)
+            except Exception:
+                cause = None
+        cls = exceptions.ActorError if self.is_actor else exceptions.TaskError
+        return cls(self.fname or context_fname, self.traceback_str, cause)

@@ -1,0 +1,54 @@
+"""User-facing error types (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at every ``get`` of its returns.
+
+    Mirrors the reference's RayTaskError: carries the remote traceback and,
+    when picklable, the original exception as ``cause``.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+
+class ActorError(TaskError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    def __init__(self, actor_id_hex: str, reason: str):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"actor {actor_id_hex[:12]} died: {reason}")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing a task died unexpectedly."""
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str, detail: str = ""):
+        super().__init__(f"object {object_id_hex[:16]} is lost: {detail}")
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass

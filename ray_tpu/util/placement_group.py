@@ -1,0 +1,94 @@
+"""Placement groups: gang resource reservation across nodes.
+
+API mirror of the reference (python/ray/util/placement_group.py:130-146,
+strategies PACK | SPREAD | STRICT_PACK | STRICT_SPREAD) over the controller's
+2-phase bundle commit.  The TPU-native extension: ``tpu_topology`` bundles
+that reserve whole ICI sub-meshes (``TPU`` chips colocated per host) so a
+multi-host SPMD gang lands on one contiguous slice.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api import _ensure_initialized
+from ..core.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        core = _ensure_initialized()
+        reply = core.controller.call(
+            "wait_placement_group",
+            {"pg_id": self.id.binary(), "timeout": timeout_seconds},
+            timeout=timeout_seconds + 10)
+        return reply.get("state") == "CREATED"
+
+    def ready(self, timeout_seconds: float = 60.0) -> "PlacementGroup":
+        if not self.wait(timeout_seconds):
+            raise TimeoutError(
+                f"placement group {self.id.hex()[:12]} not ready "
+                f"after {timeout_seconds}s")
+        return self
+
+    def table(self) -> dict:
+        core = _ensure_initialized()
+        for entry in core.controller.call("list_placement_groups"):
+            if entry["pg_id"] == self.id.binary():
+                return entry
+        return {}
+
+    def bundle_node_ids(self) -> List[str]:
+        return self.table().get("node_ids", [])
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs, self.strategy))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    core = _ensure_initialized()
+    pg_id = PlacementGroupID.of(core.job_id)
+    core.controller.call("create_placement_group", {
+        "pg_id": pg_id.binary(),
+        "bundles": [{k: float(v) for k, v in b.items()} for b in bundles],
+        "strategy": strategy, "name": name})
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def tpu_slice_placement_group(num_hosts: int, chips_per_host: int = 4,
+                              cpus_per_host: float = 1.0,
+                              strict: bool = True) -> PlacementGroup:
+    """Reserve a TPU slice as one gang: ``num_hosts`` bundles of
+    ``chips_per_host`` TPU chips, spread across distinct hosts so each bundle
+    maps to one host's ICI-attached chips."""
+    bundles = [{"TPU": float(chips_per_host), "CPU": cpus_per_host}
+               for _ in range(num_hosts)]
+    return placement_group(bundles,
+                           strategy="STRICT_SPREAD" if strict else "SPREAD")
+
+
+def remove_placement_group(pg: PlacementGroup):
+    core = _ensure_initialized()
+    core.controller.call("remove_placement_group", {"pg_id": pg.id.binary()})
+
+
+def placement_group_table() -> List[dict]:
+    core = _ensure_initialized()
+    return core.controller.call("list_placement_groups")

@@ -1,0 +1,49 @@
+"""Chrome-trace profiling events (reference: core_worker/profiling.cc +
+python/ray/_private/state.py:414 chrome_tracing_dump).
+
+Round-1 scope: in-process event collection; cross-process aggregation rides
+the controller KV.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List
+
+_events: List[dict] = []
+_lock = threading.Lock()
+
+
+class profile:
+    """Context manager recording one Chrome-trace duration event."""
+
+    def __init__(self, name: str, category: str = "task"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self.start = time.perf_counter_us() if hasattr(time, "perf_counter_us") \
+            else time.perf_counter() * 1e6
+        return self
+
+    def __exit__(self, *exc):
+        end = time.perf_counter() * 1e6
+        with _lock:
+            _events.append({
+                "name": self.name, "cat": self.category, "ph": "X",
+                "ts": self.start, "dur": end - self.start,
+                "pid": os.getpid(), "tid": threading.get_ident() % 10000,
+            })
+
+
+def chrome_trace_events() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def dump_chrome_trace(path: str):
+    with open(path, "w") as f:
+        json.dump({"traceEvents": chrome_trace_events()}, f)

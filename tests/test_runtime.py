@@ -1,0 +1,343 @@
+"""Single-node runtime tests: tasks, objects, actors, placement groups.
+
+Mirrors the reference's python/ray/tests/test_basic*.py and test_actor*.py
+coverage at a smaller scale.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu.util.placement_group import (placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024,
+                 ignore_reinit_error=True)
+
+    # Warm the worker pool so timing-sensitive tests measure execution
+    # overlap, not cold-start worker forking.
+    @ray_tpu.remote
+    def _warm(i):
+        time.sleep(0.3)
+        return i
+
+    assert ray_tpu.get([_warm.remote(i) for i in range(4)]) == list(range(4))
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------- objects
+def test_put_get_small(cluster):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(cluster):
+    arr = np.random.RandomState(0).rand(500, 500)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # Large objects come back zero-copy from shared memory: read-only views.
+    assert not out.flags.writeable
+
+
+def test_get_timeout(cluster):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(exceptions.GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=0.2)
+
+
+# --------------------------------------------------------------------- tasks
+def test_task_basic(cluster):
+    @ray_tpu.remote
+    def f(x, y=10):
+        return x + y
+
+    assert ray_tpu.get(f.remote(1)) == 11
+    assert ray_tpu.get(f.remote(1, y=2)) == 3
+
+
+def test_task_chained_refs(cluster):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    r = inc.remote(0)
+    for _ in range(4):
+        r = inc.remote(r)
+    assert ray_tpu.get(r) == 5
+
+
+def test_task_multiple_returns(cluster):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_large_arg_and_return(cluster):
+    @ray_tpu.remote
+    def double(a):
+        return a * 2
+
+    arr = np.ones((600, 600))
+    out = ray_tpu.get(double.remote(arr))
+    assert out.sum() == 2 * arr.size
+
+
+def test_task_error_propagation(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def bad():
+        raise KeyError("missing")
+
+    with pytest.raises(exceptions.TaskError) as ei:
+        ray_tpu.get(bad.remote())
+    assert isinstance(ei.value.cause, KeyError)
+
+
+def test_dependency_error_fails_fast(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def bad():
+        raise ValueError("upstream")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(consume.remote(bad.remote()))
+
+
+def test_parallel_execution(cluster):
+    @ray_tpu.remote
+    def sleep_id(i):
+        time.sleep(0.4)
+        return i
+
+    start = time.time()
+    out = ray_tpu.get([sleep_id.remote(i) for i in range(4)])
+    elapsed = time.time() - start
+    assert out == [0, 1, 2, 3]
+    assert elapsed < 4 * 0.4  # genuinely overlapped
+
+
+def test_nested_tasks(cluster):
+    @ray_tpu.remote
+    def leaf(i):
+        return i * i
+
+    @ray_tpu.remote
+    def parent(n):
+        return sum(ray_tpu.get([leaf.remote(i) for i in range(n)]))
+
+    assert ray_tpu.get(parent.remote(4)) == 0 + 1 + 4 + 9
+
+
+def test_wait(cluster):
+    @ray_tpu.remote
+    def delay(t):
+        time.sleep(t)
+        return t
+
+    refs = [delay.remote(0.05), delay.remote(5)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=3)
+    assert len(ready) == 1 and len(not_ready) == 1
+    assert ray_tpu.get(ready[0]) == 0.05
+
+
+def test_retry_on_exception(cluster):
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky(key):
+        import os
+        import tempfile
+        marker = os.path.join(tempfile.gettempdir(), f"flaky-{key}")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("first attempt fails")
+        os.unlink(marker)
+        return "ok"
+
+    import uuid
+    assert ray_tpu.get(flaky.remote(uuid.uuid4().hex)) == "ok"
+
+
+# -------------------------------------------------------------------- actors
+def test_actor_basic(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.incr.remote()) == 6
+    assert ray_tpu.get([c.incr.remote() for _ in range(3)]) == [7, 8, 9]
+
+
+def test_actor_method_ordering(cluster):
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def items_list(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(20):
+        log.append.remote(i)
+    assert ray_tpu.get(log.items_list.remote()) == list(range(20))
+
+
+def test_actor_error(cluster):
+    @ray_tpu.remote
+    class Bomb:
+        def go(self):
+            raise RuntimeError("boom")
+
+        def fine(self):
+            return "ok"
+
+    b = Bomb.remote()
+    with pytest.raises(exceptions.ActorError):
+        ray_tpu.get(b.go.remote())
+    # Actor survives method exceptions.
+    assert ray_tpu.get(b.fine.remote()) == "ok"
+
+
+def test_named_actor(cluster):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.v = 42
+
+        def value(self):
+            return self.v
+
+    Holder.options(name="test_holder").remote()
+    h = ray_tpu.get_actor("test_holder")
+    assert ray_tpu.get(h.value.remote()) == 42
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_name")
+
+
+def test_kill_actor(cluster):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    with pytest.raises((exceptions.ActorError, exceptions.ActorDiedError)):
+        ray_tpu.get(v.ping.remote(), timeout=30)
+
+
+def test_actor_restart(cluster):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.alive_since = time.time()
+
+        def suicide(self):
+            import os
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.ping.remote()) == "pong"
+    p.suicide.remote()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            assert ray_tpu.get(p.ping.remote(), timeout=10) == "pong"
+            break
+        except (exceptions.ActorError, exceptions.ActorDiedError,
+                exceptions.GetTimeoutError):
+            time.sleep(0.3)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_actor_handle_passing(cluster):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, val):
+            self.v[k] = val
+            return True
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @ray_tpu.remote
+    def writer(handle, k, val):
+        return ray_tpu.get(handle.set.remote(k, val))
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, "x", 99))
+    assert ray_tpu.get(s.get.remote("x")) == 99
+
+
+# ---------------------------------------------------------------- placement
+def test_placement_group_lifecycle(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=15)
+    table = pg.table()
+    assert table["state"] == "CREATED"
+    assert len(table["node_ids"]) == 2
+
+    @ray_tpu.remote(num_cpus=1, placement_group=pg,
+                    placement_group_bundle_index=0)
+    def inside():
+        return "in-pg"
+
+    assert ray_tpu.get(inside.remote()) == "in-pg"
+    remove_placement_group(pg)
+    states = {e["pg_id"]: e["state"] for e in placement_group_table()}
+    assert states[pg.id.binary()] == "REMOVED"
+
+
+def test_placement_group_infeasible_pending(cluster):
+    pg = placement_group([{"CPU": 64}])  # never fits on a 4-CPU node
+    assert not pg.wait(timeout_seconds=1.0)
+    remove_placement_group(pg)
+
+
+# -------------------------------------------------------------------- misc
+def test_cluster_resources(cluster):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU") == 4.0
+
+
+def test_ref_counting_frees_memory(cluster):
+    refs = [ray_tpu.put(np.ones(300_000)) for _ in range(3)]
+    ray_tpu.get(refs[0])
+    del refs
+    time.sleep(0.5)  # frees propagate asynchronously
+    # No assertion on store internals; just verify the system stays healthy.
+    assert ray_tpu.get(ray_tpu.put(1)) == 1
