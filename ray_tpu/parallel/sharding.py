@@ -1,0 +1,130 @@
+"""Logical-axis sharding rules: the TP/FSDP engine.
+
+The reference has no tensor/FSDP parallelism of its own (SURVEY.md §2.4 —
+Train only wraps Torch-DDP, `train/torch/config.py:102-113`); here sharding is
+a first-class framework service.  Model code annotates every parameter with
+*logical* axis names (("embed", "mlp"), ("heads", "kv"), …) and a
+`ShardingRules` table maps logical names → mesh axes.  Swapping DP for FSDP
+for 2D FSDP×TP is a rules change, not a model change — the idiomatic
+pjit/GSPMD recipe from the scaling playbook.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxis = Union[str, Tuple[str, ...], None]
+
+
+class ShardingRules(dict):
+    """logical axis name → mesh axis (str), tuple of mesh axes, or None."""
+
+    def spec_for(self, logical_axes: Optional[Sequence[str]]) -> P:
+        if logical_axes is None:
+            return P()
+        return P(*(self.get(a) for a in logical_axes))
+
+    def with_overrides(self, **overrides: MeshAxis) -> "ShardingRules":
+        new = ShardingRules(self)
+        new.update(overrides)
+        return new
+
+
+# Canonical rule tables for transformer-family models.  Logical names follow
+# the T5X/flax convention: batch, seq, embed, mlp, heads, kv, vocab, expert,
+# stage (pipeline), plus kv_seq for attention ring buffers.
+DP_RULES = ShardingRules(
+    batch=("dp", "fsdp"), seq=None, embed=None, mlp=None, heads=None,
+    kv=None, vocab=None, expert=None, stage=None, kv_seq=None)
+
+FSDP_RULES = ShardingRules(
+    batch=("dp", "fsdp"), seq=None, embed="fsdp", mlp=None, heads=None,
+    kv=None, vocab=None, expert=None, stage=None, kv_seq=None)
+
+TP_RULES = ShardingRules(
+    batch=("dp", "fsdp"), seq=None, embed=None, mlp="tp", heads="tp",
+    kv=None, vocab="tp", expert=None, stage=None, kv_seq=None)
+
+FSDP_TP_RULES = ShardingRules(
+    batch=("dp", "fsdp"), seq="sp", embed="fsdp", mlp="tp", heads="tp",
+    kv=None, vocab="tp", expert="ep", stage="pp", kv_seq=None)
+
+
+def logical_to_mesh_axes(logical_axes: Optional[Sequence[str]],
+                         rules: Mapping[str, MeshAxis]) -> P:
+    if logical_axes is None:
+        return P()
+    return P(*(rules.get(a) for a in logical_axes))
+
+
+def _drop_missing_axes(spec: P, mesh: Mesh) -> P:
+    """Remove mesh axes the mesh doesn't have (lets the same rules run on a
+    trivial single-axis test mesh)."""
+    def fix(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, str):
+            return entry if entry in mesh.axis_names else None
+        kept = tuple(a for a in entry if a in mesh.axis_names)
+        return kept if kept else None
+    return P(*(fix(e) for e in spec))
+
+
+def named_sharding(mesh: Mesh, logical_axes: Optional[Sequence[str]],
+                   rules: Mapping[str, MeshAxis]) -> NamedSharding:
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    return NamedSharding(mesh, _drop_missing_axes(spec, mesh))
+
+
+def tree_paths_to_logical(params: Any,
+                          logical_axes_tree: Any) -> Dict[Tuple, Any]:
+    """Zip a params pytree with a matching tree of logical-axis tuples."""
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_a = jax.tree_util.tree_leaves(
+        logical_axes_tree, is_leaf=lambda x: x is None or isinstance(x, tuple))
+    if len(flat_p) != len(flat_a):
+        raise ValueError(
+            f"params tree has {len(flat_p)} leaves but axes tree has "
+            f"{len(flat_a)}")
+    return {path: ax for (path, _), ax in zip(flat_p, flat_a)}
+
+
+def pytree_shardings(params_axes: Any, mesh: Mesh,
+                     rules: Mapping[str, MeshAxis]) -> Any:
+    """Map a tree of logical-axis tuples → tree of NamedShardings."""
+    return jax.tree_util.tree_map(
+        lambda ax: named_sharding(mesh, ax, rules),
+        params_axes,
+        is_leaf=lambda x: x is None or isinstance(x, tuple))
+
+
+def shard_pytree(params: Any, params_axes: Any, mesh: Mesh,
+                 rules: Mapping[str, MeshAxis]) -> Any:
+    """Place a host pytree onto the mesh under the given rules."""
+    shardings = pytree_shardings(params_axes, mesh, rules)
+    return jax.device_put(params, shardings)
+
+
+def constrain(x: jax.Array, logical_axes: Optional[Sequence[str]],
+              rules: Mapping[str, MeshAxis],
+              mesh: Optional[Mesh] = None) -> jax.Array:
+    """`with_sharding_constraint` by logical names; no-op outside jit/mesh."""
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    if mesh is not None:
+        spec = _drop_missing_axes(spec, mesh)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x
+
+
+def batch_sharding(mesh: Mesh, rules: Mapping[str, MeshAxis],
+                   ndim: int = 2) -> NamedSharding:
+    """Sharding for input batches: batch axis sharded, rest replicated."""
+    axes = ["batch"] + [None] * (ndim - 1)
+    spec = logical_to_mesh_axes(axes, {**rules, None: None})
+    return NamedSharding(mesh, _drop_missing_axes(spec, mesh))

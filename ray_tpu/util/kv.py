@@ -1,0 +1,43 @@
+"""Cluster-wide internal KV client (reference: GCS InternalKV,
+`src/ray/gcs/gcs_server/gcs_kv_manager.cc`, Python surface
+`ray.experimental.internal_kv`).  Backed by the controller's KV table."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api import _ensure_initialized
+
+
+def _as_bytes(v) -> bytes:
+    return v if isinstance(v, bytes) else str(v).encode()
+
+
+def kv_put(key, value, *, namespace: str = "") -> None:
+    core = _ensure_initialized()
+    core.controller.call("kv_put", {
+        "ns": namespace, "key": _as_bytes(key), "value": _as_bytes(value)})
+
+
+def kv_get(key, *, namespace: str = "") -> Optional[bytes]:
+    core = _ensure_initialized()
+    return core.controller.call("kv_get", {
+        "ns": namespace, "key": _as_bytes(key)})
+
+
+def kv_del(key, *, namespace: str = "") -> bool:
+    core = _ensure_initialized()
+    return core.controller.call("kv_del", {
+        "ns": namespace, "key": _as_bytes(key)})
+
+
+def kv_exists(key, *, namespace: str = "") -> bool:
+    core = _ensure_initialized()
+    return core.controller.call("kv_exists", {
+        "ns": namespace, "key": _as_bytes(key)})
+
+
+def kv_keys(prefix=b"", *, namespace: str = "") -> List[bytes]:
+    core = _ensure_initialized()
+    return core.controller.call("kv_keys", {
+        "ns": namespace, "prefix": _as_bytes(prefix)})

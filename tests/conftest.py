@@ -14,6 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY_MB", "256")
+# The attached TPU plugin (axon) ignores JAX_PLATFORMS; route framework mesh
+# helpers to the 8-device virtual CPU backend explicitly.
+os.environ.setdefault("RAY_TPU_DEVICE_BACKEND", "cpu")
 os.environ.setdefault("RAY_TPU_WORKER_POOL_INITIAL_SIZE", "1")
 
 import asyncio  # noqa: E402
