@@ -1,0 +1,87 @@
+"""Headline benchmark: GPT-2-small training throughput / MFU on one chip.
+
+Mirrors the reference's Train parity methodology
+(/root/reference/doc/source/ray-air/benchmarks.rst:178 — framework overhead
+vs native loops): here the measured quantity is model FLOP utilization of the
+framework's own train step (bf16, Pallas flash attention, AdamW).
+`vs_baseline` is MFU / 0.40 — the BASELINE.json north-star target of 40% MFU
+for GPT-2 training.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets)
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5": 197.0,      # v5e ("v5 lite")
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6e": 918.0,
+    "TPU v6 lite": 918.0,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    # longest prefix first so "TPU v5p" isn't shadowed by "TPU v5"
+    for key, tf in sorted(_PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(key):
+            return tf * 1e12
+    return 197.0 * 1e12  # conservative default
+
+
+def main():
+    from ray_tpu.models import (TransformerConfig, flops_per_token,
+                                init_params, make_train_step)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig.gpt2("small")
+        batch, seq, steps = 8, 1024, 20
+    else:  # smoke-test shape for CPU runs of this script
+        cfg = TransformerConfig.tiny()
+        batch, seq, steps = 4, 128, 3
+
+    params, _ = init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
+                                0, cfg.vocab_size)
+    batch_data = {"tokens": tokens}
+
+    # warmup (compile + 2 steps)
+    for _ in range(2):
+        params, opt_state, metrics = step(params, opt_state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = step(params, opt_state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = steps * tokens_per_step / dt
+    flops_tok = flops_per_token(cfg, seq)
+    mfu = tok_s * flops_tok / _peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": "gpt2s_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {"tokens_per_s": round(tok_s, 1),
+                   "step_ms": round(1000 * dt / steps, 2),
+                   "backend": jax.default_backend()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

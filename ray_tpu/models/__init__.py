@@ -1,0 +1,18 @@
+"""Model zoo: TPU-first transformer family.
+
+The reference keeps models inside user frameworks (torch modules in Train
+examples, small MLP/CNN catalogs in RLlib — `rllib/models/catalog.py`); here
+decoder-only transformers are framework citizens: pure-JAX pytrees with
+logical sharding axes on every parameter, scan-over-layers bodies, and
+Pallas attention (`ray_tpu.ops`).
+"""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    forward,
+    lm_loss,
+    make_train_step,
+    count_params,
+    flops_per_token,
+)

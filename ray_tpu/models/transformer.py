@@ -1,0 +1,304 @@
+"""Decoder-only transformer (GPT-2 and Llama families), TPU-first.
+
+Design (idiomatic JAX, not a torch translation):
+
+  * parameters are a plain pytree of jnp arrays; alongside it a matching
+    ``params_axes`` tree of *logical axis* tuples feeds the sharding engine
+    (`ray_tpu.parallel.sharding`) — TP/FSDP/PP are rules-table changes.
+  * the layer stack is ONE set of stacked weights scanned with ``lax.scan``
+    (fast compile, natural pipeline-parallel partitioning over the leading
+    "layers" axis), with optional per-layer ``jax.checkpoint`` remat.
+  * attention dispatches to the Pallas flash kernel on TPU
+    (`ray_tpu.ops.attention`), with sequence-parallel ring attention as a
+    config switch.
+  * compute dtype bf16, params and softmax/norm statistics fp32 — the MXU
+    recipe.
+
+Configs: ``TransformerConfig.gpt2()`` (learned positions, GELU, LayerNorm)
+and ``TransformerConfig.llama()`` (RoPE, SwiGLU, RMSNorm, GQA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import multi_head_attention
+from ..ops.norms import layernorm, rmsnorm
+from ..ops.rotary import apply_rotary, rotary_angles
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304          # GPT-2 vocab padded to a 128 multiple
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: Optional[int] = None  # None → MHA
+    d_ff: Optional[int] = None        # None → 4*d_model (gelu) / 8/3 (swiglu)
+    max_seq_len: int = 1024
+    pos_emb: str = "learned"          # "learned" | "rope"
+    activation: str = "gelu"          # "gelu" | "swiglu"
+    norm: str = "layernorm"           # "layernorm" | "rmsnorm"
+    tie_embeddings: bool = True
+    rope_base: float = 10000.0
+    dtype: Any = jnp.bfloat16         # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "auto"      # "auto"|"flash"|"reference"|"ring"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.activation == "swiglu":
+            # Llama convention: 8/3 * d, rounded up to a 256 multiple
+            return ((int(8 * self.d_model / 3) + 255) // 256) * 256
+        return 4 * self.d_model
+
+    # -- presets (sizes follow the public GPT-2/Llama papers) ---------------
+    @staticmethod
+    def gpt2(size: str = "small", **kw) -> "TransformerConfig":
+        dims = {"small": (768, 12, 12), "medium": (1024, 24, 16),
+                "large": (1280, 36, 20), "xl": (1600, 48, 25)}[size]
+        d, l, h = dims
+        return TransformerConfig(
+            vocab_size=50304, d_model=d, n_layers=l, n_heads=h,
+            max_seq_len=1024, pos_emb="learned", activation="gelu",
+            norm="layernorm", tie_embeddings=True, **kw)
+
+    @staticmethod
+    def llama(size: str = "1b", **kw) -> "TransformerConfig":
+        dims = {  # d_model, layers, heads, kv_heads, d_ff, vocab
+            "tiny": (512, 4, 8, 4, 1408, 32000),
+            "1b": (2048, 16, 32, 8, 8192, 128256),
+            "3b": (3072, 28, 24, 8, 8192, 128256),
+            "8b": (4096, 32, 32, 8, 14336, 128256),
+        }[size]
+        d, l, h, hk, ff, v = dims
+        return TransformerConfig(
+            vocab_size=v, d_model=d, n_layers=l, n_heads=h, n_kv_heads=hk,
+            d_ff=ff, max_seq_len=kw.pop("max_seq_len", 4096),
+            pos_emb="rope", activation="swiglu", norm="rmsnorm",
+            tie_embeddings=False, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "TransformerConfig":
+        """Test-sized model that still exercises every code path."""
+        defaults = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, max_seq_len=128, pos_emb="rope",
+                        activation="swiglu", norm="rmsnorm",
+                        tie_embeddings=False, remat=False)
+        defaults.update(kw)
+        return TransformerConfig(**defaults)
+
+
+def count_params(cfg: TransformerConfig) -> int:
+    d, ff, hd = cfg.d_model, cfg.ff_dim, cfg.head_dim
+    attn = d * cfg.n_heads * hd + 2 * d * cfg.kv_heads * hd \
+        + cfg.n_heads * hd * d
+    mlp = d * ff * (3 if cfg.activation == "swiglu" else 2)
+    norms = 2 * d * (2 if cfg.norm == "layernorm" else 1)
+    per_layer = attn + mlp + norms
+    emb = cfg.vocab_size * d
+    if cfg.pos_emb == "learned":
+        emb += cfg.max_seq_len * d
+    head = 0 if cfg.tie_embeddings else cfg.vocab_size * d
+    final = d * (2 if cfg.norm == "layernorm" else 1)
+    return cfg.n_layers * per_layer + emb + head + final
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """Training FLOPs/token: 6*N_matmul + causal attention term."""
+    n = count_params(cfg)
+    emb = cfg.vocab_size * cfg.d_model
+    if cfg.pos_emb == "learned":
+        emb += cfg.max_seq_len * cfg.d_model
+    n_matmul = n - emb + (cfg.vocab_size * cfg.d_model
+                          if cfg.tie_embeddings else 0)
+    attn = 6 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len  # ≈ qk+pv
+    return 6 * n_matmul + attn
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: TransformerConfig
+                ) -> Tuple[Params, Params]:
+    """Returns (params, params_axes): matching pytrees of weights and
+    logical-axis tuples.  Stacked layer weights carry a leading "layers"
+    axis (pipeline-shardable)."""
+    d, hd, h, hk, ff, L = (cfg.d_model, cfg.head_dim, cfg.n_heads,
+                           cfg.kv_heads, cfg.ff_dim, cfg.n_layers)
+    pt = cfg.param_dtype
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pt) / math.sqrt(fan_in))
+
+    def stack(k, shape, fan_in):
+        return dense(k, (L,) + shape, fan_in)
+
+    params: Params = {
+        "embed": {"tok": jax.random.normal(next(keys), (cfg.vocab_size, d),
+                                           pt) * 0.02},
+        "layers": {
+            "attn_norm": jnp.ones((L, d), pt),
+            "wq": stack(next(keys), (d, h, hd), d),
+            "wk": stack(next(keys), (d, hk, hd), d),
+            "wv": stack(next(keys), (d, hk, hd), d),
+            "wo": stack(next(keys), (h, hd, d), h * hd),
+            "mlp_norm": jnp.ones((L, d), pt),
+            "w_in": stack(next(keys), (d, ff), d),
+            "w_out": stack(next(keys), (ff, d), ff),
+        },
+        "final_norm": jnp.ones((d,), pt),
+    }
+    axes: Params = {
+        "embed": {"tok": ("vocab", "embed")},
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads", "kv"),
+            "wk": ("layers", "embed", "heads", "kv"),
+            "wv": ("layers", "embed", "heads", "kv"),
+            "wo": ("layers", "heads", "kv", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_in": ("layers", "embed", "mlp"),
+            "w_out": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if cfg.activation == "swiglu":
+        params["layers"]["w_gate"] = stack(next(keys), (d, ff), d)
+        axes["layers"]["w_gate"] = ("layers", "embed", "mlp")
+    if cfg.norm == "layernorm":
+        params["layers"]["attn_norm_b"] = jnp.zeros((L, d), pt)
+        params["layers"]["mlp_norm_b"] = jnp.zeros((L, d), pt)
+        params["final_norm_b"] = jnp.zeros((d,), pt)
+        axes["layers"]["attn_norm_b"] = ("layers", "embed")
+        axes["layers"]["mlp_norm_b"] = ("layers", "embed")
+        axes["final_norm_b"] = ("embed",)
+    if cfg.pos_emb == "learned":
+        params["embed"]["pos"] = jax.random.normal(
+            next(keys), (cfg.max_seq_len, d), pt) * 0.01
+        axes["embed"]["pos"] = (None, "embed")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (d, cfg.vocab_size), d)
+        axes["lm_head"] = ("embed", "vocab")
+    return params, axes
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _norm(cfg, x, scale, bias):
+    if cfg.norm == "rmsnorm":
+        return rmsnorm(x, scale)
+    return layernorm(x, scale, bias)
+
+
+def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
+           cos, sin) -> jnp.ndarray:
+    b, s, d = x.shape
+    h, hk, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    y = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
+    q = jnp.einsum("bsd,dhk->bshk", y, lp["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", y, lp["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", y, lp["wv"].astype(dt))
+    if cfg.pos_emb == "rope":
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+    attn = multi_head_attention(q, k, v, causal=True,
+                                impl=cfg.attention_impl)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
+
+    y = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+    if cfg.activation == "swiglu":
+        up = jnp.einsum("bsd,df->bsf", y, lp["w_in"].astype(dt))
+        gate = jnp.einsum("bsd,df->bsf", y, lp["w_gate"].astype(dt))
+        z = jax.nn.silu(gate) * up
+    else:
+        z = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, lp["w_in"].astype(dt)))
+    x = x + jnp.einsum("bsf,fd->bsd", z, lp["w_out"].astype(dt))
+    return x
+
+
+def forward(params: Params, tokens: jnp.ndarray,
+            cfg: TransformerConfig) -> jnp.ndarray:
+    """tokens [batch, seq] int32 → logits [batch, seq, vocab] fp32."""
+    b, s = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"]["tok"][tokens].astype(dt)
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["pos"][:s].astype(dt)
+    cos, sin = (rotary_angles(s, cfg.head_dim, cfg.rope_base)
+                if cfg.pos_emb == "rope" else (None, None))
+
+    layer = functools.partial(_layer, cfg)
+    if cfg.remat:
+        layer = jax.checkpoint(layer, static_argnums=(),
+                               policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(carry, lp):
+        return layer(carry, lp, cos, sin), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    w_out = (params["embed"]["tok"].T if cfg.tie_embeddings
+             else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, w_out.astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def lm_loss(params: Params, batch: Dict[str, jnp.ndarray],
+            cfg: TransformerConfig) -> jnp.ndarray:
+    """Next-token cross entropy.  ``batch`` has "tokens" [b, s]; loss is on
+    positions 0..s-2 predicting 1..s-1."""
+    import optax
+
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return losses.mean()
+
+
+def make_train_step(cfg: TransformerConfig, optimizer):
+    """(params, opt_state, batch) → (params, opt_state, metrics); pure, jit
+    it under any mesh/sharding."""
+
+    def step(params, opt_state, batch):
+        import optax
+
+        loss, grads = jax.value_and_grad(
+            functools.partial(lm_loss, cfg=cfg))(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in jax.tree_util.tree_leaves(grads)))
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    return step

@@ -1,0 +1,12 @@
+"""TPU-native operator library.
+
+The reference delegates all accelerator math to torch/tf (SURVEY.md §2.3);
+here the hot ops are first-class: fused attention (Pallas flash kernel with a
+reference jnp fallback), rotary embeddings, and normalizations.  Everything is
+jit-traceable with static shapes so XLA can tile onto the MXU.
+"""
+
+from .norms import layernorm, rmsnorm  # noqa: F401
+from .rotary import apply_rotary, rotary_angles  # noqa: F401
+from .attention import multi_head_attention, reference_attention  # noqa: F401
+from .flash_attention import flash_attention  # noqa: F401

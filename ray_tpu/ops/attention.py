@@ -1,0 +1,82 @@
+"""Attention dispatch: Pallas flash kernel on TPU, reference jnp elsewhere.
+
+All shapes are ``[batch, seq, heads, head_dim]`` with KV heads a divisor of
+query heads (GQA).  `multi_head_attention` picks the implementation:
+
+  * ``"flash"``  — `ray_tpu.ops.flash_attention` (TPU Pallas kernel)
+  * ``"reference"`` — pure jnp (XLA-fused; used on CPU and for odd shapes)
+  * ``"ring"``   — sequence-parallel ring attention
+    (`ray_tpu.ops.ring_attention`, shards over the ``sp`` mesh axis)
+  * ``"auto"``   — flash when on TPU and shapes are block-aligned
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import flash_attention
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand [b, s, h_kv, d] → [b, s, h_kv*n_rep, d] for GQA fallbacks."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :],
+                            (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                        causal: bool = True,
+                        sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """Plain softmax(QKᵀ)V with fp32 statistics; the correctness oracle for
+    the flash kernel and the CPU execution path."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n_rep = q.shape[2] // k.shape[2]
+    k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+    # [b, h, s_q, s_k]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        rows = jnp.arange(s_q)[:, None] + (s_k - s_q)
+        mask = rows >= jnp.arange(s_k)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _flash_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    s_q, s_kv, d = q.shape[1], k.shape[1], q.shape[-1]
+    if d % 64:
+        return False
+    bq, bk = min(128, s_q), min(128, s_kv)
+    return s_q % bq == 0 and s_kv % bk == 0
+
+
+def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                         causal: bool = True,
+                         sm_scale: Optional[float] = None,
+                         impl: str = "auto") -> jnp.ndarray:
+    if impl == "auto":
+        impl = "flash" if _flash_ok(q, k) else "reference"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == "ring":
+        try:
+            from .ring_attention import ring_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "ring attention requires ray_tpu.ops.ring_attention "
+                "(sequence-parallel path)") from e
+        return ring_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

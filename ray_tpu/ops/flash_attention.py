@@ -1,0 +1,352 @@
+"""Blockwise fused attention (flash attention) as a Pallas TPU kernel.
+
+The reference framework has no fused attention of its own — it delegates all
+model math to torch (SURVEY.md §2.3); in a TPU-native stack the attention
+inner loop is the single hottest op, so it gets a hand-written kernel:
+
+  * online-softmax forward with fp32 accumulators in VMEM scratch,
+  * custom-VJP backward (separate dq and dk/dv kernels),
+  * grouped-query attention handled by index maps (no KV repetition),
+  * causal blocks above the diagonal skipped via ``pl.when``.
+
+Inputs are ``[batch, seq, heads, head_dim]`` (framework activation layout);
+the kernel operates in ``[batch, heads, seq, head_dim]``.  bf16 in/out, fp32
+softmax statistics.  Sequence length must be divisible by the block sizes —
+callers (`ray_tpu.ops.attention.multi_head_attention`) fall back to the
+reference jnp implementation otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas extensions (memory spaces, compiler params)
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30  # avoids -inf - -inf = nan in the online softmax
+
+
+def _dims(q, k):
+    b, h, s_q, d = q.shape
+    h_kv, s_kv = k.shape[1], k.shape[2]
+    assert h % h_kv == 0, f"query heads {h} not a multiple of kv heads {h_kv}"
+    return b, h, h_kv, h // h_kv, s_q, s_kv, d
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                sm_scale, causal, block_q, block_k, num_k, q_offset):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = ((ki * block_k <= qi * block_q + block_q - 1 + q_offset)
+            if causal else (ki >= 0))
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_ref.shape)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        v = v_ref[0, 0].astype(jnp.float32)
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype).astype(jnp.float32), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1],
+                                                           1e-30))
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    b, h, h_kv, group, s_q, s_kv, d = _dims(q, k)
+    num_q, num_k = s_q // block_q, s_kv // block_k
+    grid = (b, h, num_q, num_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k,
+        q_offset=s_kv - s_q)
+    out_shapes = (
+        jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, s_q, 1), jnp.float32),
+    )
+    compiler_params = None
+    if _HAS_PLTPU:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki, g=group: (b_, h_ // g, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki, g=group: (b_, h_ // g, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ] if _HAS_PLTPU else [],
+        out_shape=out_shapes,
+        compiler_params=compiler_params,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, sm_scale, causal, block_q, block_k, num_k,
+                   q_offset):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = ((ki * block_k <= qi * block_q + block_q - 1 + q_offset)
+            if causal else (ki >= 0))
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    sm_scale, causal, block_q, block_k, num_q, group,
+                    q_offset):
+    ki, gi, qi = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+
+    @pl.when((qi == 0) & (gi == 0))
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = ((qi * block_q + block_q - 1 + q_offset >= ki * block_k)
+            if causal else (qi >= 0))
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                   # [bq, bk]
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, d]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, d]
+
+    @pl.when((qi == num_q - 1) & (gi == group - 1))
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+    b, h, h_kv, group, s_q, s_kv, d = _dims(q, k)
+    num_q, num_k = s_q // block_q, s_kv // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                    # [b, h, s_q, 1]
+
+    sem = (("parallel", "parallel", "parallel", "arbitrary")
+           if _HAS_PLTPU else None)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k=num_k,
+                          q_offset=s_kv - s_q),
+        grid=(b, h, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki, g=group: (b_, h_ // g, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki, g=group: (b_, h_ // g, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]
+        if _HAS_PLTPU else [],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=sem)
+        if _HAS_PLTPU else None,
+    )(q, k, v, do, lse, delta)
+
+    sem5 = (("parallel", "parallel", "parallel", "arbitrary", "arbitrary")
+            if _HAS_PLTPU else None)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=num_q,
+                          group=group, q_offset=s_kv - s_q),
+        grid=(b, h_kv, num_k, group, num_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h2, ki, g_, qi, G=group: (b_, h2 * G + g_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h2, ki, g_, qi: (b_, h2, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h2, ki, g_, qi: (b_, h2, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h2, ki, g_, qi, G=group: (b_, h2 * G + g_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h2, ki, g_, qi, G=group: (b_, h2 * G + g_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h2, ki, g_, qi, G=group: (b_, h2 * G + g_, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h2, ki, g_, qi: (b_, h2, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h2, ki, g_, qi: (b_, h2, ki, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)]
+        if _HAS_PLTPU else [],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=sem5)
+        if _HAS_PLTPU else None,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper (operates in [b, h, s, d])
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, causal, sm_scale,
+                            block_q, block_k)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jnp.ndarray:
+    """Fused attention over ``[batch, seq, heads, head_dim]`` inputs.
+
+    KV heads may be a divisor of query heads (GQA/MQA).  Differentiable via
+    flash backward kernels.  Raises if seq lengths don't divide the block
+    sizes — use `multi_head_attention` for automatic fallback.
+    """
+    s_q, s_kv = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, s_q), min(block_k, s_kv)
+    if s_q % bq or s_kv % bk:
+        raise ValueError(
+            f"seq lengths ({s_q}, {s_kv}) must divide block sizes ({bq}, {bk})")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash(qt, kt, vt, causal, sm_scale, bq, bk)
+    return jnp.swapaxes(out, 1, 2)

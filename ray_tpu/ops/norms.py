@@ -1,0 +1,36 @@
+"""Normalization ops.
+
+Plain jnp on purpose: XLA fuses norm → matmul chains into the surrounding
+HLO better than a hand-written kernel boundary would allow (pallas_call is a
+fusion barrier).  Accumulation is fp32 even for bf16 activations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm (Llama family).  fp32 statistics, output in x.dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * scale.astype(jnp.float32)).astype(dtype)
+
+
+def layernorm(x: jnp.ndarray, scale: jnp.ndarray,
+              bias: Optional[jnp.ndarray] = None,
+              eps: float = 1e-5) -> jnp.ndarray:
+    """LayerNorm (GPT-2 family).  fp32 statistics, output in x.dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)

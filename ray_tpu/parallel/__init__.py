@@ -24,8 +24,10 @@ from .sharding import (  # noqa: F401
     ShardingRules,
     logical_to_mesh_axes,
     named_sharding,
+    pytree_shardings,
     shard_pytree,
     constrain,
+    batch_sharding,
     DP_RULES,
     FSDP_RULES,
     TP_RULES,

@@ -8,7 +8,10 @@ cluster_utils.Cluster): distributed behavior is exercised locally, here with
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite (not setdefault): the ambient env pins JAX_PLATFORMS=axon for
+# the attached TPU; tests must be hermetic on the virtual CPU mesh even when
+# the axon plugin is unregistered (PALLAS_AXON_POOL_IPS= bypass).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

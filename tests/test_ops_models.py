@@ -1,0 +1,119 @@
+"""Ops + model tests (CPU reference paths; the Pallas kernel itself is
+TPU-only and exercised by bench.py / TPU-gated tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import (TransformerConfig, count_params, forward,
+                            init_params, lm_loss, make_train_step)
+from ray_tpu.ops import (apply_rotary, layernorm, multi_head_attention,
+                         reference_attention, rmsnorm, rotary_angles)
+from ray_tpu.parallel import (FSDP_TP_RULES, MeshSpec, create_mesh,
+                              pytree_shardings)
+
+
+def test_norms_match_numpy():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32), jnp.float32)
+    scale = jnp.ones((32,)) * 2.0
+    y = rmsnorm(x, scale)
+    ref = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * 2
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4)
+    y2 = layernorm(x, jnp.ones((32,)), jnp.zeros((32,)))
+    xa = np.asarray(x)
+    ref2 = (xa - xa.mean(-1, keepdims=True)) / np.sqrt(
+        xa.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y2), ref2, rtol=1e-4)
+
+
+def test_rotary_preserves_norm():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 32))
+    cos, sin = rotary_angles(16, 32)
+    y = apply_rotary(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-4)
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-5)
+
+
+def test_reference_attention_causality():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 2, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 8, 2, 16))
+    out1 = reference_attention(q, k, v, causal=True)
+    # future keys must not affect past outputs
+    k2 = k.at[:, 4:].set(0.0)
+    v2 = v.at[:, 4:].set(0.0)
+    out2 = reference_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :4]),
+                               np.asarray(out2[:, :4]), rtol=1e-5)
+
+
+def test_gqa_matches_expanded_mha():
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 4, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 2, 16))
+    out = reference_attention(q, k, v, causal=True)
+    k_full = jnp.repeat(k, 2, axis=2)
+    v_full = jnp.repeat(v, 2, axis=2)
+    out_full = reference_attention(q, k_full, v_full, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_full),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("preset", ["llama", "gpt2"])
+def test_model_trains(preset):
+    if preset == "llama":
+        cfg = TransformerConfig.tiny()
+    else:
+        cfg = TransformerConfig.tiny(pos_emb="learned", activation="gelu",
+                                     norm="layernorm", tie_embeddings=True,
+                                     n_kv_heads=None)
+    params, axes = init_params(jax.random.PRNGKey(0), cfg)
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    assert count_params(cfg) == sum(
+        x.size for x in jax.tree_util.tree_leaves(params))
+    assert n_leaves == len(jax.tree_util.tree_leaves(
+        axes, is_leaf=lambda x: isinstance(x, tuple)))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                              cfg.vocab_size)
+    step = jax.jit(make_train_step(cfg, optax.adamw(1e-3)))
+    opt_state = optax.adamw(1e-3).init(params)
+    batch = {"tokens": toks}
+    losses = []
+    for _ in range(8):
+        params, opt_state, metrics = step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_masked_loss():
+    cfg = TransformerConfig.tiny()
+    params, _ = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    full = lm_loss(params, {"tokens": toks}, cfg)
+    masked = lm_loss(params, {"tokens": toks,
+                              "mask": jnp.ones_like(toks)}, cfg)
+    np.testing.assert_allclose(float(full), float(masked), rtol=1e-5)
+
+
+def test_sharded_train_step_on_virtual_mesh():
+    """Full train step jitted over an 8-device dp×tp mesh (the multichip
+    path the driver dry-runs)."""
+    cfg = TransformerConfig.tiny()
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    params, axes = init_params(jax.random.PRNGKey(0), cfg)
+    shardings = pytree_shardings(axes, mesh, FSDP_TP_RULES)
+    params = jax.device_put(params, shardings)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 256)
+    with jax.set_mesh(mesh):
+        params2, opt_state, metrics = step(params, opt_state,
+                                           {"tokens": toks})
+    assert np.isfinite(float(metrics["loss"]))
