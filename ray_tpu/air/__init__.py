@@ -1,0 +1,18 @@
+"""AIR-core equivalents: shared ML primitives.
+
+Mirrors the capability set of the reference's `python/ray/air/`
+(`Checkpoint` air/checkpoint.py:60, `ScalingConfig`/`RunConfig`/
+`FailureConfig` air/config.py, `session.report` air/session.py:41,
+`Result`) with TPU-first semantics: ScalingConfig speaks TPU topologies and
+mesh specs, checkpoints hold jax pytrees natively.
+"""
+
+from .checkpoint import Checkpoint  # noqa: F401
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .result import Result  # noqa: F401
+from . import session  # noqa: F401

@@ -1,0 +1,62 @@
+"""Run/scaling/failure configuration.
+
+Capability mirror of the reference's `air/config.py` (`ScalingConfig`,
+`RunConfig`, `FailureConfig`, `CheckpointConfig`).  TPU-native additions:
+``topology`` (e.g. "v5e-16") and ``mesh`` (a `MeshSpec` or "dp=2,tp=4"
+string) on ScalingConfig — placement becomes ICI-topology-aware bundles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None        # e.g. "v5e-16": slice to gang on
+    mesh: Union[MeshSpec, str, None] = None  # parallelism layout per worker
+
+    @property
+    def mesh_spec(self) -> Optional[MeshSpec]:
+        if isinstance(self.mesh, str):
+            return MeshSpec.parse(self.mesh)
+        return self.mesh
+
+    def bundle(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+    def bundles(self) -> List[Dict[str, float]]:
+        return [self.bundle() for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # gang restarts from last checkpoint
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = True
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 0
+    stop: Optional[Dict[str, Any]] = None

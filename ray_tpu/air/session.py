@@ -1,0 +1,114 @@
+"""Training session: the in-train-loop API.
+
+Capability mirror of the reference's `air/session.py:41,94`
+(`session.report(metrics, checkpoint=...)`, rank getters) — the user's
+train function calls these; the backing `_Session` is installed per worker
+by the Train backend executor and streams results back to the driver.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+
+class _Session:
+    def __init__(self, *, world_rank: int = 0, local_rank: int = 0,
+                 world_size: int = 1, node_rank: int = 0,
+                 trial_name: str = "default", dataset_shard=None):
+        self.world_rank = world_rank
+        self.local_rank = local_rank
+        self.world_size = world_size
+        self.node_rank = node_rank
+        self.trial_name = trial_name
+        self.dataset_shard = dataset_shard
+        self.queue: "queue.Queue" = queue.Queue()
+        self.stop_event = threading.Event()
+        self.last_checkpoint: Optional[Checkpoint] = None
+        self.iteration = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None):
+        self.iteration += 1
+        if checkpoint is not None:
+            self.last_checkpoint = checkpoint
+        self.queue.put({"metrics": dict(metrics), "checkpoint": checkpoint,
+                        "iteration": self.iteration})
+        if self.stop_event.is_set():
+            raise SystemExit("session stopped by driver")
+
+
+_session: threading.local = threading.local()
+
+
+def _set_session(s: Optional[_Session]):
+    _session.value = s
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_session, "value", None)
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report intermediate metrics (and optionally a checkpoint) to the
+    driver; outside a Train session it's a no-op print."""
+    s = _get_session()
+    if s is None:
+        print(f"[ray_tpu.air.session] {metrics}")
+        return
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set on restore), else None."""
+    s = _get_session()
+    return s.last_checkpoint if s else None
+
+
+def get_world_rank() -> int:
+    s = _get_session()
+    return s.world_rank if s else 0
+
+
+def get_local_rank() -> int:
+    s = _get_session()
+    return s.local_rank if s else 0
+
+
+def get_world_size() -> int:
+    s = _get_session()
+    return s.world_size if s else 1
+
+
+def get_node_rank() -> int:
+    s = _get_session()
+    return s.node_rank if s else 0
+
+
+def get_trial_name() -> str:
+    s = _get_session()
+    return s.trial_name if s else "default"
+
+
+def get_mesh():
+    """The gang's `jax.sharding.Mesh` (set by the SPMD backend), or a local
+    mesh outside a session."""
+    s = _get_session()
+    mesh = getattr(s, "mesh", None) if s else None
+    if mesh is None:
+        from ..parallel.mesh import create_mesh
+        mesh = create_mesh()
+    return mesh
+
+
+def get_dataset_shard(name: str = "train"):
+    s = _get_session()
+    if s is None or s.dataset_shard is None:
+        return None
+    if isinstance(s.dataset_shard, dict):
+        return s.dataset_shard.get(name)
+    return s.dataset_shard

@@ -1,0 +1,16 @@
+"""Ray-Train-equivalent distributed training orchestration, TPU-first.
+
+Capability mirror of the reference's `python/ray/train/` (SURVEY.md §3.4:
+`BaseTrainer.fit` → `BackendExecutor` → `WorkerGroup` actors → per-rank
+`train_func` with `session.report`), with the NCCL/DDP slot replaced by the
+SPMD mesh path: workers gang-schedule under a placement group, rendezvous
+into one XLA runtime (`jax.distributed`) and run pjit/shard_map programs
+over a global device mesh — gradients sync as compiled ICI collectives,
+never as a sidecar allreduce library.
+"""
+
+from .backend import Backend, BackendConfig, SpmdConfig, HostArrayConfig  # noqa: F401
+from .backend_executor import BackendExecutor  # noqa: F401
+from .checkpointing import CheckpointManager  # noqa: F401
+from .trainer import JaxTrainer, TorchCompatTrainer  # noqa: F401
+from .worker_group import WorkerGroup  # noqa: F401

@@ -1,0 +1,124 @@
+"""Train backends: how a worker gang becomes a communicating group.
+
+The reference's backends rendezvous NCCL/Gloo process groups
+(`train/torch/config.py:69-144`, `train/tensorflow/config.py:21-40`,
+`train/horovod/config.py:32`).  The TPU-native palette:
+
+  * `SpmdConfig` — the flagship: every worker (one per TPU host) joins a
+    `jax.distributed` runtime through the controller-KV rendezvous
+    (`ray_tpu.parallel.coordinator`), so one global `jax.sharding.Mesh`
+    spans the gang and gradient sync is compiled ICI collectives.
+  * `HostArrayConfig` — host-side numpy allreduce through a reducer actor;
+    the Gloo-role backend for CPU tests and non-XLA glue (metrics, small
+    state).  Works with any number of single-device processes.
+  * `TorchCompatConfig` (in trainer.py) — drop-in for reference torch
+    train_funcs: rendezvouses torch.distributed gloo over the same KV.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Type
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self) -> Type["Backend"]:
+        return Backend
+
+
+class Backend:
+    """Hooks run by the BackendExecutor around the training lifecycle.
+    ``on_start``/``on_shutdown`` run on the driver; ``worker_setup_fn``
+    returns a function executed ON EACH WORKER before the train loop."""
+
+    def __init__(self, config: BackendConfig):
+        self.config = config
+
+    def on_start(self, worker_group, executor) -> None:
+        pass
+
+    def worker_setup_fn(self, executor):
+        return None
+
+    def on_shutdown(self, worker_group, executor) -> None:
+        pass
+
+
+@dataclasses.dataclass
+class SpmdConfig(BackendConfig):
+    """Multi-host SPMD: workers link into one XLA runtime + global mesh."""
+
+    mesh: Optional[str] = None        # "dp=2,tp=4" per-gang layout
+    timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return _SpmdBackend
+
+
+class _SpmdBackend(Backend):
+    def worker_setup_fn(self, executor):
+        group_name = f"train_gang_{executor.run_id}"
+        world_size = executor.num_workers
+        mesh_text = self.config.mesh
+        timeout_s = self.config.timeout_s
+
+        def setup():
+            from ..air import session
+            from ..parallel.coordinator import join_mesh_gang
+            from ..parallel.mesh import MeshSpec
+            spec = MeshSpec.parse(mesh_text) if mesh_text else None
+            mesh = join_mesh_gang(group_name, world_size,
+                                  rank=session.get_world_rank(),
+                                  timeout_s=timeout_s, spec=spec)
+            session._get_session().mesh = mesh
+
+        return setup
+
+    def on_shutdown(self, worker_group, executor) -> None:
+        group_name = f"train_gang_{executor.run_id}"
+
+        def teardown():
+            from ..parallel.coordinator import leave_mesh_gang
+            leave_mesh_gang(group_name)
+
+        try:
+            worker_group.execute(teardown)
+        except Exception:
+            pass
+
+
+@dataclasses.dataclass
+class HostArrayConfig(BackendConfig):
+    """Host-side collective backend (reducer actor per gang)."""
+
+    @property
+    def backend_cls(self):
+        return _HostArrayBackend
+
+
+class _HostArrayBackend(Backend):
+    def on_start(self, worker_group, executor) -> None:
+        from .host_collective import create_reducer
+        self._reducer = create_reducer(executor.num_workers)
+        executor.shared_env["__host_reducer__"] = self._reducer
+
+    def worker_setup_fn(self, executor):
+        reducer = executor.shared_env.get("__host_reducer__")
+
+        def setup():
+            from . import host_collective
+            host_collective._set_reducer(reducer)
+
+        return setup
+
+    def on_shutdown(self, worker_group, executor) -> None:
+        from .. import api
+        reducer = executor.shared_env.pop("__host_reducer__", None)
+        if reducer is not None:
+            try:
+                api.kill(reducer)
+            except Exception:
+                pass

@@ -1,0 +1,78 @@
+"""Checkpoint bookkeeping for training runs.
+
+Capability mirror of the reference's `train/_internal/checkpoint.py:37,206`
+(`CheckpointManager`: track, persist, prune to ``num_to_keep``, expose
+latest/best).  Checkpoints land under ``<storage>/checkpoint_<iter>`` as
+directories (Checkpoint.to_directory), so multi-host orbax saves can write
+straight into them.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..air.checkpoint import Checkpoint
+from ..air.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, storage_path: str,
+                 config: Optional[CheckpointConfig] = None,
+                 metric: Optional[str] = None, mode: str = "max"):
+        self.storage_path = storage_path
+        self.config = config or CheckpointConfig()
+        self.metric = metric
+        self.mode = mode
+        self._tracked: List[Tuple[int, str, Dict[str, Any]]] = []
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, iteration: int, checkpoint: Checkpoint,
+                 metrics: Optional[Dict[str, Any]] = None) -> str:
+        path = os.path.join(self.storage_path, f"checkpoint_{iteration:06d}")
+        checkpoint.to_directory(path)
+        self._tracked.append((iteration, path, dict(metrics or {})))
+        self._prune()
+        return path
+
+    def _score(self, entry) -> float:
+        _, _, metrics = entry
+        if self.metric and self.metric in metrics:
+            v = float(metrics[self.metric])
+            return v if self.mode == "max" else -v
+        return float("-inf")
+
+    def _prune(self) -> None:
+        keep = self.config.num_to_keep
+        if keep is None or len(self._tracked) <= keep:
+            return
+        # keep the most recent `keep` - but never drop the best-by-metric
+        candidates = sorted(self._tracked, key=lambda e: e[0])
+        best = (max(self._tracked, key=self._score)
+                if self.metric else None)
+        while len(candidates) > keep:
+            victim = candidates[0]
+            if best is not None and victim is best and len(candidates) > 1:
+                victim = candidates[1]
+            candidates.remove(victim)
+            self._tracked.remove(victim)
+            shutil.rmtree(victim[1], ignore_errors=True)
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        path = max(self._tracked, key=lambda e: e[0])[1]
+        return Checkpoint.from_directory(path)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        entry = max(self._tracked, key=self._score)
+        return Checkpoint.from_directory(entry[1])
+
+    @property
+    def latest_iteration(self) -> int:
+        return max((e[0] for e in self._tracked), default=0)

@@ -1,0 +1,120 @@
+"""Host-side collectives through a reducer actor.
+
+Fills the Gloo role of the reference's collective backends
+(`util/collective/collective_group/gloo_collective_group.py:184`) for
+host-resident numpy data: worker processes allreduce/broadcast without a
+shared XLA runtime.  Accelerator-resident tensors should never come through
+here — they sync as XLA collectives inside compiled programs (SpmdConfig).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_REDUCER = None
+
+
+def _set_reducer(handle) -> None:
+    global _REDUCER
+    _REDUCER = handle
+
+
+class _Reducer:
+    """Barrier-style reducer: each rank contributes once per key; when all
+    world_size contributions arrive, every pending waiter gets the result."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._pending: Dict[str, list] = {}
+        self._done: Dict[str, Any] = {}
+
+    def contribute(self, key: str, value, op: str):
+        entry = self._pending.setdefault(key, [])
+        entry.append(value)
+        if len(entry) == self.world_size:
+            arrs = [np.asarray(v) for v in entry]
+            if op == "sum" or op == "mean":
+                out = np.sum(arrs, axis=0)
+                if op == "mean":
+                    out = out / self.world_size
+            elif op == "max":
+                out = np.max(arrs, axis=0)
+            elif op == "min":
+                out = np.min(arrs, axis=0)
+            elif op == "gather":
+                out = arrs
+            else:
+                raise ValueError(f"unknown op {op}")
+            self._done[key] = out
+            del self._pending[key]
+        return True
+
+    def fetch(self, key: str):
+        return self._done.get(key, "__pending__")
+
+    def clear(self, key: str):
+        self._done.pop(key, None)
+        return True
+
+
+def create_reducer(world_size: int):
+    from .. import api
+    return api.remote(_Reducer).remote(world_size)
+
+
+def _run(key: str, value, op: str, timeout_s: float = 120.0):
+    import time
+
+    from .. import api
+    if _REDUCER is None:
+        if op == "gather":
+            return [value]
+        return np.asarray(value)
+    api.get(_REDUCER.contribute.remote(key, value, op), timeout=timeout_s)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        out = api.get(_REDUCER.fetch.remote(key), timeout=timeout_s)
+        if not (isinstance(out, str) and out == "__pending__"):
+            return out
+        time.sleep(0.005)
+    raise TimeoutError(f"host allreduce {key!r} timed out")
+
+
+_COUNTERS: Dict[str, int] = {}
+
+
+def _next_key(tag: str) -> str:
+    n = _COUNTERS.get(tag, 0)
+    _COUNTERS[tag] = n + 1
+    return f"{tag}/{n}"
+
+
+def allreduce(value, op: str = "mean", tag: str = "allreduce"):
+    """Blocking allreduce of a numpy-like value across the train gang."""
+    return _run(_next_key(tag), np.asarray(value), op)
+
+
+def allgather(value, tag: str = "allgather"):
+    return _run(_next_key(tag), np.asarray(value), "gather")
+
+
+def barrier(tag: str = "barrier"):
+    _run(_next_key(tag), np.zeros(()), "sum")
+
+
+def allreduce_pytree(tree, op: str = "mean", tag: str = "tree"):
+    """Allreduce every leaf of a pytree (gradients in host-DP loops)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flat = np.concatenate([np.ravel(np.asarray(l, dtype=np.float32))
+                           for l in leaves]) if leaves else np.zeros((0,))
+    reduced = _run(_next_key(tag), flat, op)
+    out, off = [], 0
+    for l in leaves:
+        size = int(np.size(l))
+        out.append(np.asarray(reduced[off:off + size],
+                              dtype=np.asarray(l).dtype).reshape(np.shape(l)))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,182 @@
+"""Trainers: the user-facing `fit()` entry points.
+
+Capability mirror of the reference's `DataParallelTrainer.training_loop`
+(`train/data_parallel_trainer.py:56,329` — PG → WorkerGroup → backend →
+train_func per rank → results/checkpoints bubbled up) plus its elastic
+recovery (`FailureConfig` + restart-from-checkpoint via Tune retries,
+`train/base_trainer.py:339`).  TPU-first: `JaxTrainer` defaults to the SPMD
+backend so a gang of per-host workers runs ONE pjit program over a global
+mesh; `TorchCompatTrainer` covers reference-style torch train functions
+(gloo process group over the controller-KV rendezvous).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+from ..air.checkpoint import Checkpoint
+from ..air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                          ScalingConfig)
+from ..air.result import Result
+from .backend import BackendConfig, HostArrayConfig, SpmdConfig
+from .backend_executor import BackendExecutor, TrainingFailedError
+from .checkpointing import CheckpointManager
+
+
+class JaxTrainer:
+    """Run ``train_loop_per_worker`` on a gang of workers with mesh/session
+    plumbing.  With ``scaling_config.num_workers == 1`` the single worker
+    still sees every local device (pjit over the full host mesh) — scale-out
+    adds hosts, not a new programming model."""
+
+    _default_backend = SpmdConfig
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend_config = backend_config or self._default_backend()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # -- orchestration ------------------------------------------------------
+    def fit(self) -> Result:
+        name = self.run_config.name or "train_run"
+        storage = (self.run_config.storage_path
+                   or os.path.join(tempfile.gettempdir(), "ray_tpu_results"))
+        run_dir = os.path.join(storage, name)
+        ckpt_mgr = CheckpointManager(
+            run_dir, self.run_config.checkpoint_config or CheckpointConfig())
+        failure = self.run_config.failure_config or FailureConfig()
+
+        attempts = 0
+        resume = self.resume_from_checkpoint
+        history: List[Dict[str, Any]] = []
+        while True:
+            try:
+                metrics = self._run_attempt(name, ckpt_mgr, resume, history)
+                return Result(metrics=metrics,
+                              checkpoint=ckpt_mgr.latest_checkpoint,
+                              path=run_dir, metrics_history=history)
+            except TrainingFailedError as e:
+                attempts += 1
+                if failure.max_failures >= 0 and \
+                        attempts > failure.max_failures:
+                    return Result(metrics=history[-1] if history else {},
+                                  checkpoint=ckpt_mgr.latest_checkpoint,
+                                  error=e, path=run_dir,
+                                  metrics_history=history)
+                resume = ckpt_mgr.latest_checkpoint or resume
+
+    def _dataset_shards(self) -> Optional[List[Any]]:
+        if not self.datasets:
+            return None
+        n = self.scaling_config.num_workers
+        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for key, ds in self.datasets.items():
+            if hasattr(ds, "split"):
+                parts = ds.split(n)
+            else:  # static sequence: strided split
+                parts = [list(ds)[i::n] for i in range(n)]
+            for i in range(n):
+                shards[i][key] = parts[i]
+        return shards
+
+    def _run_attempt(self, name: str, ckpt_mgr: CheckpointManager,
+                     resume: Optional[Checkpoint],
+                     history: List[Dict[str, Any]]) -> Dict[str, Any]:
+        sc = self.scaling_config
+        executor = BackendExecutor(
+            self.backend_config, num_workers=sc.num_workers,
+            resources_per_worker=sc.bundle(),
+            placement_strategy=sc.placement_strategy)
+        try:
+            executor.start(trial_name=name, resume_checkpoint=resume,
+                           dataset_shards=self._dataset_shards())
+            executor.start_training(self.train_loop, self.train_loop_config)
+            last_metrics: Dict[str, Any] = {}
+            while True:
+                results = executor.next_results()
+                if results is None:
+                    break
+                rank0 = next((r for r in results
+                              if isinstance(r, dict)), None)
+                if rank0 is None:
+                    continue
+                last_metrics = rank0["metrics"]
+                history.append(last_metrics)
+                ckpt_blob = rank0.get("checkpoint")
+                if ckpt_blob is not None:
+                    ckpt_mgr.register(rank0["iteration"],
+                                      Checkpoint.from_bytes(ckpt_blob),
+                                      last_metrics)
+            executor.finish()
+            return last_metrics
+        finally:
+            executor.shutdown()
+
+
+class _TorchGlooBackendConfig(BackendConfig):
+    @property
+    def backend_cls(self):
+        return _TorchGlooBackend
+
+
+from .backend import Backend as _Backend  # noqa: E402
+
+
+class _TorchGlooBackend(_Backend):
+    def on_start(self, worker_group, executor) -> None:
+        from ..parallel.coordinator import _free_port, _local_ip
+        executor.shared_env["master_addr"] = _local_ip()
+        executor.shared_env["master_port"] = _free_port()
+
+    def worker_setup_fn(self, executor):
+        addr = executor.shared_env["master_addr"]
+        port = executor.shared_env["master_port"]
+        world = executor.num_workers
+
+        def setup():
+            import datetime
+            import os
+
+            import torch.distributed as dist
+
+            from ..air import session
+            os.environ["MASTER_ADDR"] = str(addr)
+            os.environ["MASTER_PORT"] = str(port)
+            dist.init_process_group(
+                "gloo", rank=session.get_world_rank(), world_size=world,
+                timeout=datetime.timedelta(seconds=120))
+
+        return setup
+
+    def on_shutdown(self, worker_group, executor) -> None:
+        def teardown():
+            import torch.distributed as dist
+            if dist.is_initialized():
+                dist.destroy_process_group()
+
+        try:
+            worker_group.execute(teardown)
+        except Exception:
+            pass
+
+
+class TorchCompatTrainer(JaxTrainer):
+    """Runs reference-style torch train functions: sets up a
+    ``torch.distributed`` gloo group (CPU) over the gang, mirroring
+    `train/torch/config.py:113` (`dist.init_process_group`)."""
+
+    _default_backend = _TorchGlooBackendConfig

@@ -1,0 +1,175 @@
+"""Gang of training worker actors under one placement group.
+
+Capability mirror of the reference's `train/_internal/worker_group.py:92,186`
+(`WorkerGroup` spawning actor workers, `execute`/`execute_async` on all).
+TPU-first difference: the gang is placed with topology-aware bundles so each
+worker owns one TPU host's chips, and worker metadata carries device/slice
+info for mesh bring-up.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..util.placement_group import PlacementGroup, placement_group, \
+    remove_placement_group
+from ..util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class TrainWorker:
+    """Actor hosting one rank of the training gang.  The train function runs
+    on a session thread so actor methods stay responsive for result polling
+    (the reference's session-thread design, `train/_internal/session.py`)."""
+
+    def __init__(self, rank_env: Dict[str, Any]):
+        import os
+        for k, v in (rank_env or {}).items():
+            os.environ[str(k)] = str(v)
+        self._thread = None
+        self._session = None
+        self._error: Optional[BaseException] = None
+
+    def metadata(self) -> Dict[str, Any]:
+        import os
+        return {"hostname": socket.gethostname(), "pid": os.getpid()}
+
+    def execute(self, fn_bytes: bytes, *args, **kwargs):
+        from ..core.serialization import loads_function
+        fn = loads_function(fn_bytes)
+        return fn(*args, **kwargs)
+
+    def init_session(self, *, world_rank: int, local_rank: int,
+                     world_size: int, node_rank: int,
+                     trial_name: str = "train",
+                     checkpoint_bytes: Optional[bytes] = None,
+                     dataset_shard=None):
+        from ..air.checkpoint import Checkpoint
+        from ..air.session import _Session, _set_session
+        self._session = _Session(
+            world_rank=world_rank, local_rank=local_rank,
+            world_size=world_size, node_rank=node_rank,
+            trial_name=trial_name, dataset_shard=dataset_shard)
+        if checkpoint_bytes is not None:
+            self._session.last_checkpoint = Checkpoint.from_bytes(
+                checkpoint_bytes)
+        # install on the actor main thread as well: backend setup fns run
+        # there (via execute) and need ranks / a place to hang the mesh
+        _set_session(self._session)
+
+    def start_training(self, fn_bytes: bytes, config: Dict[str, Any]):
+        import threading
+
+        from ..core.serialization import loads_function
+        from ..air.session import _set_session
+        train_fn = loads_function(fn_bytes)
+        session = self._session
+
+        def run():
+            _set_session(session)
+            try:
+                if config:
+                    train_fn(config)
+                else:
+                    train_fn()
+            except SystemExit:
+                pass
+            except BaseException as e:  # surfaced via finish()
+                self._error = e
+            finally:
+                session.queue.put(None)  # sentinel: training done
+
+        self._error = None
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return True
+
+    def next_result(self, timeout_s: float = 10.0):
+        """One queued report (metrics + optional checkpoint bytes), the
+        sentinel None when training ended, or "__timeout__"."""
+        import queue as _q
+        try:
+            item = self._session.queue.get(timeout=timeout_s)
+        except _q.Empty:
+            return "__timeout__"
+        if item is None:
+            return None
+        ckpt = item.get("checkpoint")
+        if ckpt is not None:
+            item = dict(item, checkpoint=ckpt.to_bytes())
+        return item
+
+    def finish(self):
+        if self._thread is not None:
+            self._thread.join()
+        if self._error is not None:
+            import traceback
+            raise RuntimeError("train function failed: " + "".join(
+                traceback.format_exception(self._error)))
+        return True
+
+    def stop_session(self):
+        if self._session is not None:
+            self._session.stop_event.set()
+        return True
+
+    def shutdown(self):
+        return True
+
+
+class WorkerGroup:
+    """N TrainWorker actors gang-scheduled under one placement group."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Optional[Dict[str, float]] = None,
+                 placement_strategy: str = "PACK",
+                 rank_env: Optional[Dict[str, Any]] = None):
+        self.num_workers = num_workers
+        bundles = []
+        for _ in range(num_workers):
+            b = dict(resources_per_worker or {})
+            b.setdefault("CPU", 1.0)
+            bundles.append(b)
+        self.pg: PlacementGroup = placement_group(
+            bundles, strategy=placement_strategy)
+        self.pg.ready()
+        actor_cls = api.remote(TrainWorker)
+        self.workers = []
+        for i in range(num_workers):
+            strategy = PlacementGroupSchedulingStrategy(
+                placement_group=self.pg, placement_group_bundle_index=i)
+            self.workers.append(
+                actor_cls.options(
+                    scheduling_strategy=strategy,
+                    num_cpus=bundles[i].get("CPU", 1.0),
+                ).remote(rank_env or {}))
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run fn on every worker, return per-rank results."""
+        from ..core.serialization import dumps_function
+        blob = dumps_function(fn)
+        refs = [w.execute.remote(blob, *args, **kwargs)
+                for w in self.workers]
+        return api.get(refs, timeout=600.0)
+
+    def execute_single(self, index: int, fn: Callable, *args, **kwargs):
+        from ..core.serialization import dumps_function
+        return api.get(self.workers[index].execute.remote(
+            dumps_function(fn), *args, **kwargs), timeout=600.0)
+
+    def metadata(self) -> List[Dict[str, Any]]:
+        return api.get([w.metadata.remote() for w in self.workers],
+                       timeout=60.0)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                api.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass
+        self.workers = []
