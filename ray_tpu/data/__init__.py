@@ -1,0 +1,27 @@
+"""Distributed datasets on object-store blocks.
+
+Capability mirror of the reference's `python/ray/data/` (SURVEY.md §2.3:
+`Dataset` over plasma block refs, `BlockAccessor` per format, lazy-ish
+transform pipeline, task-parallel compute, 2-stage shuffle, datasources,
+windowed `DatasetPipeline`).  TPU-first notes: `iter_batches` yields
+numpy-dict batches shaped for `jax.device_put` onto a mesh's data axis, and
+`Dataset.split(n)` produces per-worker shards for Train ingest.
+"""
+
+from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from .dataset import Dataset  # noqa: F401
+from .dataset_pipeline import DatasetPipeline  # noqa: F401
+from .grouped import GroupedData  # noqa: F401
+from .read_api import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A001  (mirrors the reference's public name)
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)

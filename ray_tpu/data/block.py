@@ -1,0 +1,200 @@
+"""Blocks: the unit of distributed data.
+
+Capability mirror of the reference's `data/block.py:101,211,235` — a Block
+is a pyarrow Table, pandas DataFrame, or Python list; `BlockAccessor`
+dispatches format-specific ops; `BlockMetadata` carries rows/bytes/schema
+for planning without touching data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+Block = Union["pyarrow.Table", "pandas.DataFrame", List[Any]]
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    num_rows: Optional[int] = None
+    size_bytes: Optional[int] = None
+    schema: Optional[Any] = None
+    input_files: Optional[List[str]] = None
+
+
+class BlockAccessor:
+    """Format-agnostic operations over one block."""
+
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # -- introspection ------------------------------------------------------
+    def num_rows(self) -> int:
+        b = self._block
+        if _is_arrow(b):
+            return b.num_rows
+        if _is_pandas(b):
+            return len(b)
+        return len(b)
+
+    def size_bytes(self) -> int:
+        b = self._block
+        if _is_arrow(b):
+            return b.nbytes
+        if _is_pandas(b):
+            return int(b.memory_usage(index=True, deep=True).sum())
+        return sum(sys.getsizeof(x) for x in b)
+
+    def schema(self):
+        b = self._block
+        if _is_arrow(b):
+            return b.schema
+        if _is_pandas(b):
+            return list(b.dtypes.items())
+        return type(b[0]).__name__ if b else None
+
+    def metadata(self, input_files=None) -> BlockMetadata:
+        return BlockMetadata(num_rows=self.num_rows(),
+                             size_bytes=self.size_bytes(),
+                             schema=self.schema(),
+                             input_files=input_files)
+
+    # -- conversions --------------------------------------------------------
+    def to_arrow(self):
+        import pyarrow as pa
+        b = self._block
+        if _is_arrow(b):
+            return b
+        if _is_pandas(b):
+            return pa.Table.from_pandas(b, preserve_index=False)
+        if b and isinstance(b[0], dict):
+            return pa.Table.from_pylist(b)
+        return pa.table({"value": b})
+
+    def to_pandas(self):
+        import pandas as pd
+        b = self._block
+        if _is_arrow(b):
+            return b.to_pandas()
+        if _is_pandas(b):
+            return b
+        if b and isinstance(b[0], dict):
+            return pd.DataFrame(b)
+        return pd.DataFrame({"value": b})
+
+    def to_numpy(self, column: Optional[str] = None):
+        b = self._block
+        if _is_arrow(b):
+            if column:
+                return b.column(column).to_numpy(zero_copy_only=False)
+            return {name: b.column(name).to_numpy(zero_copy_only=False)
+                    for name in b.column_names}
+        if _is_pandas(b):
+            if column:
+                return b[column].to_numpy()
+            return {c: b[c].to_numpy() for c in b.columns}
+        if b and isinstance(b[0], dict):
+            keys = b[0].keys()
+            return {k: np.asarray([row[k] for row in b]) for k in keys}
+        return np.asarray(b)
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("native", "default"):
+            return self._block
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format == "pyarrow":
+            return self.to_arrow()
+        if batch_format == "numpy":
+            return self.to_numpy()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # -- slicing / iteration ------------------------------------------------
+    def slice(self, start: int, end: int) -> Block:
+        b = self._block
+        if _is_arrow(b):
+            return b.slice(start, end - start)
+        if _is_pandas(b):
+            return b.iloc[start:end]
+        return b[start:end]
+
+    def take(self, indices: List[int]) -> Block:
+        b = self._block
+        if _is_arrow(b):
+            import pyarrow as pa
+            return b.take(pa.array(indices))
+        if _is_pandas(b):
+            return b.iloc[indices]
+        return [b[i] for i in indices]
+
+    def iter_rows(self) -> Iterator[Any]:
+        b = self._block
+        if _is_arrow(b):
+            yield from b.to_pylist()
+        elif _is_pandas(b):
+            for _, row in b.iterrows():
+                yield row.to_dict()
+        else:
+            yield from b
+
+    def sample(self, n: int, sort_key: Optional[str]) -> List[Any]:
+        rows = self.num_rows()
+        if rows == 0:
+            return []
+        idx = np.random.default_rng(0).choice(
+            rows, size=min(n, rows), replace=False)
+        picked = BlockAccessor(self.take([int(i) for i in idx]))
+        if sort_key is None:
+            return list(picked.iter_rows())
+        return [r[sort_key] for r in picked.iter_rows()]
+
+    @staticmethod
+    def combine(blocks: List[Block]) -> Block:
+        """Concatenate same-format blocks."""
+        blocks = [b for b in blocks
+                  if BlockAccessor(b).num_rows() > 0] or blocks[:1]
+        first = blocks[0]
+        if _is_arrow(first):
+            import pyarrow as pa
+            return pa.concat_tables(blocks)
+        if _is_pandas(first):
+            import pandas as pd
+            return pd.concat(blocks, ignore_index=True)
+        out: List[Any] = []
+        for b in blocks:
+            out.extend(b)
+        return out
+
+    @staticmethod
+    def empty_like(block: Block) -> Block:
+        if _is_arrow(block):
+            return block.slice(0, 0)
+        if _is_pandas(block):
+            return block.iloc[0:0]
+        return []
+
+
+def _is_arrow(b) -> bool:
+    mod = type(b).__module__
+    return mod.startswith("pyarrow") and type(b).__name__ == "Table"
+
+
+def _is_pandas(b) -> bool:
+    return type(b).__module__.startswith("pandas") and \
+        type(b).__name__ == "DataFrame"
+
+
+def batch_to_block(batch: Any) -> Block:
+    """Normalize a user map_batches return value into a block."""
+    if isinstance(batch, dict):  # numpy dict batch
+        import pandas as pd
+        return pd.DataFrame({k: list(np.asarray(v)) for k, v in
+                             batch.items()})
+    return batch

@@ -1,0 +1,450 @@
+"""Dataset: task-parallel transforms over object-store block refs.
+
+Capability mirror of the reference's `data/dataset.py:323` (map_batches and
+friends), `_internal/push_based_shuffle.py:330` (2-stage shuffle),
+`_internal/compute.py` (task compute).  Every transform fans out one runtime
+task per block; all-to-all ops (repartition/shuffle/sort) run the two-stage
+map/merge pattern so no single process materializes the dataset.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .. import api
+from .block import Block, BlockAccessor, BlockMetadata, batch_to_block
+
+# lazily-created remote helpers (need an initialized runtime)
+_REMOTES: Dict[str, Any] = {}
+
+
+def _remote(name: str, fn: Callable, num_returns: int = 1):
+    key = f"{name}/{num_returns}"
+    if key not in _REMOTES:
+        _REMOTES[key] = api.remote(num_returns=num_returns)(fn)
+    return _REMOTES[key]
+
+
+# -- task bodies (top-level, cloudpickled once each) ------------------------
+
+def _map_block(fn_bytes: bytes, block: Block) -> Tuple[Block, BlockMetadata]:
+    from ..core.serialization import loads_function
+    fn = loads_function(fn_bytes)
+    out = fn(block)
+    acc = BlockAccessor(out)
+    return out, acc.metadata()
+
+
+def _split_block(block: Block, n: int, how: str, seed: Optional[int],
+                 part_index: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    if how == "shuffle":
+        rng = np.random.default_rng(None if seed is None
+                                    else seed + part_index)
+        assignment = rng.integers(0, n, size=rows)
+    else:  # contiguous split for repartition
+        assignment = np.repeat(np.arange(n),
+                               np.diff(np.linspace(0, rows, n + 1)
+                                       .astype(int)))
+    return [acc.take(list(np.nonzero(assignment == i)[0]))
+            for i in range(n)]
+
+
+def _merge_blocks(shuffle_seed, *parts: Block) -> Tuple[Block, BlockMetadata]:
+    merged = BlockAccessor.combine(list(parts))
+    if shuffle_seed is not None:
+        acc = BlockAccessor(merged)
+        rng = np.random.default_rng(shuffle_seed)
+        merged = acc.take(list(rng.permutation(acc.num_rows())))
+    return merged, BlockAccessor(merged).metadata()
+
+
+def _sort_partition(block: Block, key: Optional[str], boundaries: List[Any],
+                    descending: bool) -> List[Block]:
+    acc = BlockAccessor(block)
+    rows = list(acc.iter_rows())
+    vals = [r[key] if key else r for r in rows]
+    order = np.argsort(np.asarray(vals, dtype=object), kind="stable")
+    parts: List[List[int]] = [[] for _ in builtins.range(
+        len(boundaries) + 1)]
+    for i in order:
+        v = vals[i]
+        j = np.searchsorted(np.asarray(boundaries, dtype=object), v,
+                            side="right")
+        parts[int(j)].append(int(i))
+    # partitions stay in ascending boundary order; the driver reverses the
+    # partition iteration for descending sorts
+    return [acc.take(p) for p in parts]
+
+
+def _sort_merge(key: Optional[str], descending: bool,
+                *parts: Block) -> Tuple[Block, BlockMetadata]:
+    merged = BlockAccessor.combine(list(parts))
+    acc = BlockAccessor(merged)
+    rows = list(acc.iter_rows())
+    vals = [r[key] if key else r for r in rows]
+    order = list(np.argsort(np.asarray(vals, dtype=object), kind="stable"))
+    if descending:
+        order = order[::-1]
+    out = acc.take([int(i) for i in order])
+    return out, BlockAccessor(out).metadata()
+
+
+def _get_meta(block: Block) -> BlockMetadata:
+    return BlockAccessor(block).metadata()
+
+
+def _sample_block(block: Block, n: int, key: Optional[str]) -> List[Any]:
+    return BlockAccessor(block).sample(n, key)
+
+
+def _write_block(block: Block, index: int, path: str, fmt: str) -> str:
+    import os
+    out = os.path.join(path, f"part-{index:05d}.{fmt}")
+    df = BlockAccessor(block).to_pandas()
+    if fmt == "parquet":
+        df.to_parquet(out)
+    elif fmt == "csv":
+        df.to_csv(out, index=False)
+    else:
+        df.to_json(out, orient="records", lines=True)
+    return out
+
+
+class Dataset:
+    """Distributed rows in object-store blocks."""
+
+    def __init__(self, block_refs: List[Any],
+                 metadata: Optional[List[BlockMetadata]] = None):
+        self._blocks = list(block_refs)
+        self._meta = metadata or [BlockMetadata()] * len(self._blocks)
+
+    # -- introspection ------------------------------------------------------
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def _ensure_meta(self) -> List[BlockMetadata]:
+        if any(m.num_rows is None for m in self._meta):
+            f = _remote("get_meta", _get_meta)
+            self._meta = api.get([f.remote(b) for b in self._blocks],
+                                 timeout=300.0)
+        return self._meta
+
+    def count(self) -> int:
+        return sum(m.num_rows for m in self._ensure_meta())
+
+    def size_bytes(self) -> int:
+        return sum(m.size_bytes or 0 for m in self._ensure_meta())
+
+    def schema(self):
+        meta = self._ensure_meta()
+        return meta[0].schema if meta else None
+
+    def input_files(self) -> List[str]:
+        out: List[str] = []
+        for m in self._ensure_meta():
+            out.extend(m.input_files or [])
+        return out
+
+    # -- transforms ---------------------------------------------------------
+    def _map_all(self, block_fn: Callable[[Block], Block]) -> "Dataset":
+        from ..core.serialization import dumps_function
+        f = _remote("map_block", _map_block, num_returns=2)
+        blob = dumps_function(block_fn)
+        pairs = [f.remote(blob, b) for b in self._blocks]
+        refs = [p[0] for p in pairs]
+        meta = api.get([p[1] for p in pairs], timeout=600.0)
+        return Dataset(refs, meta)
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "native") -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            size = batch_size or max(rows, 1)
+            outs = []
+            for start in builtins.range(0, max(rows, 1), size):
+                piece = BlockAccessor(acc.slice(start, min(start + size,
+                                                           rows)))
+                res = fn(piece.to_batch(batch_format))
+                outs.append(batch_to_block(res))
+            return BlockAccessor.combine(outs) if outs else block
+        return self._map_all(block_fn)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            return [fn(r) for r in BlockAccessor(block).iter_rows()]
+        return self._map_all(block_fn)
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            out: List[Any] = []
+            for r in BlockAccessor(block).iter_rows():
+                out.extend(fn(r))
+            return out
+        return self._map_all(block_fn)
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            keep = [i for i, r in enumerate(acc.iter_rows()) if fn(r)]
+            return acc.take(keep)
+        return self._map_all(block_fn)
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def block_fn(block: Block) -> Block:
+            df = BlockAccessor(block).to_pandas().copy()
+            df[name] = fn(df)
+            return df
+        return self._map_all(block_fn)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(lambda df: df.drop(columns=list(cols)),
+                                batch_format="pandas")
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(lambda df: df[list(cols)],
+                                batch_format="pandas")
+
+    # -- all-to-all ---------------------------------------------------------
+    def _two_stage(self, n_out: int, how: str,
+                   seed: Optional[int]) -> "Dataset":
+        merge = _remote("merge", _merge_blocks, num_returns=2)
+        if n_out == 1:
+            pair = merge.remote(seed if how == "shuffle" else None,
+                                *self._blocks)
+            return Dataset([pair[0]], [api.get(pair[1], timeout=600.0)])
+        split = _remote(f"split/{n_out}", _split_block, num_returns=n_out)
+        parts = [split.remote(b, n_out, how, seed, i)
+                 for i, b in enumerate(self._blocks)]
+        out_refs, out_meta_refs = [], []
+        for j in builtins.range(n_out):
+            seed_j = None if seed is None else seed + 1000003 * j
+            pair = merge.remote(seed_j if how == "shuffle" else None,
+                                *[p[j] for p in parts])
+            out_refs.append(pair[0])
+            out_meta_refs.append(pair[1])
+        return Dataset(out_refs, api.get(out_meta_refs, timeout=600.0))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._two_stage(num_blocks, "even", None)
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        return self._two_stage(num_blocks or max(self.num_blocks(), 1),
+                               "shuffle", seed if seed is not None else 0)
+
+    def sort(self, key: Optional[str] = None,
+             descending: bool = False) -> "Dataset":
+        n = max(self.num_blocks(), 1)
+        sampler = _remote("sample", _sample_block)
+        samples: List[Any] = []
+        for chunk in api.get([sampler.remote(b, 16, key)
+                              for b in self._blocks], timeout=600.0):
+            samples.extend(chunk)
+        if not samples:
+            return self
+        merge = _remote("sortmerge", _sort_merge, num_returns=2)
+        if n == 1:
+            pair = merge.remote(key, descending, *self._blocks)
+            return Dataset([pair[0]], [api.get(pair[1], timeout=600.0)])
+        ordered = sorted(samples)
+        boundaries = [ordered[len(ordered) * j // n]
+                      for j in builtins.range(1, n)]
+        part = _remote(f"sortpart/{n}", _sort_partition, num_returns=n)
+        parts = [part.remote(b, key, boundaries, descending)
+                 for b in self._blocks]
+        out_refs, metas = [], []
+        order = builtins.range(n - 1, -1, -1) if descending \
+            else builtins.range(n)
+        for j in order:
+            pair = merge.remote(key, descending, *[p[j] for p in parts])
+            out_refs.append(pair[0])
+            metas.append(pair[1])
+        return Dataset(out_refs, api.get(metas, timeout=600.0))
+
+    # -- combining ----------------------------------------------------------
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._blocks)
+        meta = list(self._meta)
+        for o in others:
+            refs.extend(o._blocks)
+            meta.extend(o._meta)
+        return Dataset(refs, meta)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left = self.to_pandas()
+        right = other.to_pandas()
+        right.columns = [f"{c}_1" if c in left.columns else c
+                         for c in right.columns]
+        import pandas as pd
+        merged = pd.concat([left.reset_index(drop=True),
+                            right.reset_index(drop=True)], axis=1)
+        return Dataset([api.put(merged)],
+                       [BlockAccessor(merged).metadata()])
+
+    # -- splitting ----------------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        if equal or self.num_blocks() < n:
+            ds = self.repartition(n)
+            return [Dataset([b], [m]) for b, m in
+                    zip(ds._blocks, ds._meta)]
+        out = []
+        for i in builtins.range(n):
+            out.append(Dataset(self._blocks[i::n], self._meta[i::n]))
+        return out
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        df = self.to_pandas()
+        out = []
+        prev = 0
+        for idx in list(indices) + [len(df)]:
+            piece = df.iloc[prev:idx]
+            out.append(Dataset([api.put(piece)],
+                               [BlockAccessor(piece).metadata()]))
+            prev = idx
+        return out
+
+    def limit(self, n: int) -> "Dataset":
+        taken: List[Block] = []
+        total = 0
+        for ref, meta in zip(self._blocks, self._ensure_meta()):
+            if total >= n:
+                break
+            block = api.get(ref, timeout=300.0)
+            acc = BlockAccessor(block)
+            take = min(acc.num_rows(), n - total)
+            taken.append(acc.slice(0, take))
+            total += take
+        return Dataset([api.put(b) for b in taken],
+                       [BlockAccessor(b).metadata() for b in taken])
+
+    # -- consumption --------------------------------------------------------
+    def iter_rows(self) -> Iterator[Any]:
+        for ref in self._blocks:
+            yield from BlockAccessor(api.get(ref, timeout=300.0)).iter_rows()
+
+    def take(self, n: int = 20) -> List[Any]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        """Stream batches across block boundaries (Train ingest path)."""
+        carry: Optional[Block] = None
+        for ref in self._blocks:
+            block = api.get(ref, timeout=300.0)
+            if carry is not None:
+                block = BlockAccessor.combine([carry, block])
+                carry = None
+            acc = BlockAccessor(block)
+            rows = acc.num_rows()
+            start = 0
+            while rows - start >= batch_size:
+                piece = BlockAccessor(acc.slice(start, start + batch_size))
+                yield piece.to_batch(batch_format)
+                start += batch_size
+            if start < rows:
+                carry = acc.slice(start, rows)
+        if carry is not None and not drop_last:
+            yield BlockAccessor(carry).to_batch(batch_format)
+
+    def to_pandas(self):
+        blocks = [BlockAccessor(api.get(r, timeout=300.0)).to_pandas()
+                  for r in self._blocks]
+        import pandas as pd
+        return pd.concat(blocks, ignore_index=True) if blocks \
+            else pd.DataFrame()
+
+    def to_numpy(self, column: Optional[str] = None):
+        chunks = [BlockAccessor(api.get(r, timeout=300.0)).to_numpy(column)
+                  for r in self._blocks]
+        if not chunks:
+            return np.asarray([])
+        if isinstance(chunks[0], dict):
+            return {k: np.concatenate([c[k] for c in chunks])
+                    for k in chunks[0]}
+        return np.concatenate(chunks)
+
+    def materialize(self) -> "Dataset":
+        self._ensure_meta()
+        return self
+
+    # -- aggregates ---------------------------------------------------------
+    def _column_values(self, column: Optional[str]) -> np.ndarray:
+        vals: List[Any] = []
+        for r in self.iter_rows():
+            vals.append(r[column] if column else r)
+        return np.asarray(vals)
+
+    def sum(self, column: Optional[str] = None):
+        return self._column_values(column).sum()
+
+    def min(self, column: Optional[str] = None):
+        return self._column_values(column).min()
+
+    def max(self, column: Optional[str] = None):
+        return self._column_values(column).max()
+
+    def mean(self, column: Optional[str] = None):
+        return float(self._column_values(column).mean())
+
+    def std(self, column: Optional[str] = None):
+        return float(self._column_values(column).std(ddof=1))
+
+    def groupby(self, key: str):
+        from .grouped import GroupedData
+        return GroupedData(self, key)
+
+    # -- IO -----------------------------------------------------------------
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> List[str]:
+        import os
+        os.makedirs(path, exist_ok=True)
+
+        f = _remote("write", _write_block)
+        return api.get([f.remote(b, i, path, fmt)
+                        for i, b in enumerate(self._blocks)], timeout=600.0)
+
+    # -- pipeline -----------------------------------------------------------
+    def window(self, *, blocks_per_window: int = 10):
+        from .dataset_pipeline import DatasetPipeline
+        return DatasetPipeline.from_windows(
+            [Dataset(self._blocks[i:i + blocks_per_window],
+                     self._meta[i:i + blocks_per_window])
+             for i in builtins.range(0, len(self._blocks),
+                                     blocks_per_window)])
+
+    def repeat(self, times: int):
+        from .dataset_pipeline import DatasetPipeline
+        return DatasetPipeline.from_windows([self] * times)
+
+    def stats(self) -> str:
+        meta = self._ensure_meta()
+        return (f"Dataset(blocks={len(meta)}, "
+                f"rows={sum(m.num_rows or 0 for m in meta)}, "
+                f"bytes={sum(m.size_bytes or 0 for m in meta)})")
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={self.num_blocks()}, "
+                f"num_rows={self._meta[0].num_rows and self.count()})")

@@ -1,0 +1,73 @@
+"""Windowed streaming over datasets.
+
+Capability mirror of the reference's `data/dataset_pipeline.py` (window /
+repeat / per-window transforms / streaming iteration) — overlap ingest with
+compute by handing Train one window at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, Optional
+
+
+class DatasetPipeline:
+    def __init__(self, window_fns: List[Callable[[], Any]]):
+        self._window_fns = list(window_fns)
+
+    @classmethod
+    def from_windows(cls, windows: List[Any]) -> "DatasetPipeline":
+        return cls([(lambda w=w: w) for w in windows])
+
+    def num_windows(self) -> int:
+        return len(self._window_fns)
+
+    def iter_datasets(self) -> Iterator[Any]:
+        for fn in self._window_fns:
+            yield fn()
+
+    # transforms compose lazily per window
+    def _chain(self, op: Callable[[Any], Any]) -> "DatasetPipeline":
+        return DatasetPipeline(
+            [(lambda fn=fn: op(fn())) for fn in self._window_fns])
+
+    def map_batches(self, fn: Callable, **kw) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.map_batches(fn, **kw))
+
+    def map(self, fn: Callable) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.map(fn))
+
+    def filter(self, fn: Callable) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.filter(fn))
+
+    def random_shuffle_each_window(self, **kw) -> "DatasetPipeline":
+        return self._chain(lambda ds: ds.random_shuffle(**kw))
+
+    def repeat(self, times: int) -> "DatasetPipeline":
+        return DatasetPipeline(list(self._window_fns) * times)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ds in self.iter_datasets():
+            yield from ds.iter_rows()
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        for ds in self.iter_datasets():
+            yield from ds.iter_batches(**kw)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(ds.count() for ds in self.iter_datasets())
+
+    def split(self, n: int) -> List["DatasetPipeline"]:
+        """Round-robin windows across n consumers (Train ingest)."""
+        return [DatasetPipeline(self._window_fns[i::n])
+                for i in range(n)]
+
+    def __repr__(self):
+        return f"DatasetPipeline(num_windows={self.num_windows()})"

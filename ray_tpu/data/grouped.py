@@ -1,0 +1,133 @@
+"""GroupBy: hash-partition then per-partition aggregate.
+
+Capability mirror of the reference's `data/grouped_dataset.py` (sum/min/
+max/mean/std/count + map_groups), built on the same two-stage all-to-all
+machinery as shuffle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import api
+from .block import Block, BlockAccessor, BlockMetadata
+
+
+def _hash_partition(block: Block, key: str, n: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    rows = list(acc.iter_rows())
+    parts: List[List[int]] = [[] for _ in range(n)]
+    for i, r in enumerate(rows):
+        parts[hash(r[key]) % n].append(i)
+    return [acc.take(p) for p in parts]
+
+
+def _agg_partition(key: str, aggs: List[Tuple[str, Optional[str]]],
+                   *parts: Block) -> Tuple[Block, BlockMetadata]:
+    import pandas as pd
+    dfs = [BlockAccessor(p).to_pandas() for p in parts]
+    df = pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
+    if df.empty:
+        out = df
+    else:
+        groups = df.groupby(key, sort=True)
+        cols: Dict[str, Any] = {}
+        for op, col in aggs:
+            if op == "count":
+                cols["count()"] = groups.size()
+                continue
+            target_cols = [col] if col else [
+                c for c in df.columns
+                if c != key and np.issubdtype(df[c].dtype, np.number)]
+            for c in target_cols:
+                series = getattr(groups[c], op if op != "std" else "std")()
+                cols[f"{op}({c})"] = series
+        out = pd.DataFrame(cols).reset_index()
+    return out, BlockAccessor(out).metadata()
+
+
+def _map_groups(key: str, fn_bytes: bytes,
+                *parts: Block) -> Tuple[Block, BlockMetadata]:
+    import pandas as pd
+
+    from ..core.serialization import loads_function
+    fn = loads_function(fn_bytes)
+    dfs = [BlockAccessor(p).to_pandas() for p in parts]
+    df = pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
+    outs = []
+    if not df.empty:
+        for _, group in df.groupby(key, sort=True):
+            outs.append(BlockAccessor(
+                _normalize(fn(group))).to_pandas())
+    out = pd.concat(outs, ignore_index=True) if outs else df
+    return out, BlockAccessor(out).metadata()
+
+
+def _normalize(res):
+    import pandas as pd
+    if isinstance(res, dict):
+        return pd.DataFrame({k: np.atleast_1d(v) for k, v in res.items()})
+    return res
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._ds = dataset
+        self._key = key
+
+    def _partitioned(self, n: int):
+        from .dataset import _remote
+        part = _remote(f"hashpart/{n}", _hash_partition, num_returns=n)
+        parts = [part.remote(b, self._key, n) for b in self._ds._blocks]
+        if n == 1:
+            parts = [[p] for p in parts]
+        return parts
+
+    def _aggregate(self, aggs: List[Tuple[str, Optional[str]]]):
+        from .dataset import Dataset, _remote
+        n = max(min(self._ds.num_blocks(), 8), 1)
+        parts = self._partitioned(n)
+        agg = _remote("aggpart", _agg_partition, num_returns=2)
+        refs, metas = [], []
+        for j in range(n):
+            pair = agg.remote(self._key, aggs, *[p[j] for p in parts])
+            refs.append(pair[0])
+            metas.append(pair[1])
+        return Dataset(refs, api.get(metas, timeout=600.0))
+
+    def count(self):
+        return self._aggregate([("count", None)])
+
+    def sum(self, column: Optional[str] = None):
+        return self._aggregate([("sum", column)])
+
+    def min(self, column: Optional[str] = None):
+        return self._aggregate([("min", column)])
+
+    def max(self, column: Optional[str] = None):
+        return self._aggregate([("max", column)])
+
+    def mean(self, column: Optional[str] = None):
+        return self._aggregate([("mean", column)])
+
+    def std(self, column: Optional[str] = None):
+        return self._aggregate([("std", column)])
+
+    def aggregate(self, *aggs: Tuple[str, Optional[str]]):
+        return self._aggregate(list(aggs))
+
+    def map_groups(self, fn: Callable):
+        from ..core.serialization import dumps_function
+        from .dataset import Dataset, _remote
+        n = max(min(self._ds.num_blocks(), 8), 1)
+        parts = self._partitioned(n)
+        blob = dumps_function(fn)
+        mg = _remote("mapgroups", _map_groups, num_returns=2)
+        refs, metas = [], []
+        for j in range(n):
+            pair = mg.remote(self._key, blob, *[p[j] for p in parts])
+            refs.append(pair[0])
+            metas.append(pair[1])
+        return Dataset(refs, api.get(metas, timeout=600.0))

@@ -1,0 +1,144 @@
+"""Dataset creation: in-memory sources and file datasources.
+
+Capability mirror of the reference's `data/read_api.py` + `data/datasource/`
+(range/from_items/from_pandas/from_numpy/from_arrow, parquet/csv/json/text/
+binary readers).  File reads fan out one runtime task per file.
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as _glob
+import os
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .. import api
+from .block import BlockAccessor, BlockMetadata
+from .dataset import Dataset, _remote
+
+
+def _put_blocks(blocks: List[Any]) -> Dataset:
+    refs = [api.put(b) for b in blocks]
+    meta = [BlockAccessor(b).metadata() for b in blocks]
+    return Dataset(refs, meta)
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    n = max(1, min(parallelism, len(items) or 1))
+    size = -(-len(items) // n) or 1
+    blocks = [items[i:i + size]
+              for i in builtins.range(0, max(len(items), 1), size)]
+    return _put_blocks(blocks or [[]])
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    import pandas as pd
+    n_blocks = max(1, min(parallelism, n or 1))
+    bounds = np.linspace(0, n, n_blocks + 1).astype(int)
+    blocks = [pd.DataFrame({"id": np.arange(lo, hi)})
+              for lo, hi in zip(bounds[:-1], bounds[1:])]
+    return _put_blocks(blocks)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
+    import pandas as pd
+    n_blocks = max(1, min(parallelism, n or 1))
+    bounds = np.linspace(0, n, n_blocks + 1).astype(int)
+    blocks = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        idx = np.arange(lo, hi)
+        data = (idx.reshape((-1,) + (1,) * len(shape)) *
+                np.ones(shape)[None])
+        blocks.append(pd.DataFrame(
+            {"data": list(data)}))
+    return _put_blocks(blocks)
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _put_blocks(dfs)
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _put_blocks(tables)
+
+
+def from_numpy(arrays) -> Dataset:
+    import pandas as pd
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    return _put_blocks([pd.DataFrame({"data": list(a)}) for a in arrays])
+
+
+# -- file readers -----------------------------------------------------------
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def _read_file(path: str, fmt: str, kwargs: dict):
+    import pandas as pd
+    if fmt == "parquet":
+        block = pd.read_parquet(path, **kwargs)
+    elif fmt == "csv":
+        block = pd.read_csv(path, **kwargs)
+    elif fmt == "json":
+        block = pd.read_json(path, orient="records", lines=True, **kwargs)
+    elif fmt == "text":
+        with open(path, "r", errors="replace") as f:
+            block = [line.rstrip("\n") for line in f]
+    elif fmt == "binary":
+        with open(path, "rb") as f:
+            block = [f.read()]
+    else:
+        raise ValueError(fmt)
+    meta = BlockAccessor(block).metadata(input_files=[path])
+    return block, meta
+
+
+def _read(paths, fmt: str, **kwargs) -> Dataset:
+    files = _expand(paths)
+    f = _remote("read_file", _read_file, num_returns=2)
+    pairs = [f.remote(p, fmt, kwargs) for p in files]
+    refs = [p[0] for p in pairs]
+    meta = api.get([p[1] for p in pairs], timeout=600.0)
+    return Dataset(refs, meta)
+
+
+def read_parquet(paths, **kwargs) -> Dataset:
+    return _read(paths, "parquet", **kwargs)
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return _read(paths, "csv", **kwargs)
+
+
+def read_json(paths, **kwargs) -> Dataset:
+    return _read(paths, "json", **kwargs)
+
+
+def read_text(paths, **kwargs) -> Dataset:
+    return _read(paths, "text", **kwargs)
+
+
+def read_binary_files(paths, **kwargs) -> Dataset:
+    return _read(paths, "binary", **kwargs)
