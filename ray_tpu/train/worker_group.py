@@ -67,9 +67,10 @@ class TrainWorker:
         session = self._session
 
         def run():
+            import inspect
             _set_session(session)
             try:
-                if config:
+                if inspect.signature(train_fn).parameters:
                     train_fn(config)
                 else:
                     train_fn()

@@ -1,0 +1,33 @@
+"""Hyperparameter tuning over the distributed runtime.
+
+Capability mirror of the reference's `python/ray/tune/` (SURVEY.md §2.3:
+`Tuner.fit` → `TrialRunner.step` event loop → trial actors under placement,
+schedulers ASHA/HyperBand/PBT/median-stopping, searchers, ResultGrid).
+TPU-first: a trial's unit of placement is a whole worker gang (a Trainer),
+so one Tune trial can own a pod slice; trial actors reuse the Train session
+machinery for report/checkpoint streaming.
+"""
+
+from .result_grid import ResultGrid  # noqa: F401
+from .sample import (  # noqa: F401
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from .search import BasicVariantGenerator, OptunaSearch, Searcher  # noqa: F401
+from .trial import Trial  # noqa: F401
+from .tuner import TuneConfig, Tuner, run  # noqa: F401

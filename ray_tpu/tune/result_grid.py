@@ -1,0 +1,69 @@
+"""ResultGrid (reference: `tune/result_grid.py`)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..air.checkpoint import Checkpoint
+from ..air.result import Result
+from .trial import Trial
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._to_result(self._trials[i])
+
+    def _to_result(self, t: Trial) -> Result:
+        ckpt = (Checkpoint.from_directory(t.checkpoint_dir)
+                if t.checkpoint_dir else None)
+        err = RuntimeError(t.error) if t.error else None
+        metrics = dict(t.last_result)
+        metrics["config"] = t.config
+        return Result(metrics=metrics, checkpoint=ckpt, error=err,
+                      metrics_history=t.metrics_history)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (none set in TuneConfig)")
+
+        def score(t: Trial) -> float:
+            best = t.best_result(metric, mode)
+            if best is None:
+                return float("-inf")
+            v = float(best[metric])
+            return v if mode == "max" else -v
+
+        best_trial = max(self._trials, key=score)
+        res = self._to_result(best_trial)
+        best = best_trial.best_result(metric, mode)
+        if best:
+            res.metrics.update(best)
+        return res
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for t in self._trials:
+            row = dict(t.last_result)
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            for k, v in t.config.items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [RuntimeError(t.error) for t in self._trials if t.error]

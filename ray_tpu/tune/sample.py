@@ -1,0 +1,120 @@
+"""Search-space primitives (reference: `python/ray/tune/search/sample.py`)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Sequence
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Categorical(Domain):
+    categories: Sequence[Any]
+
+    def sample(self, rng):
+        return self.categories[int(rng.integers(len(self.categories)))]
+
+
+@dataclasses.dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+    q: float = 0.0
+
+    def sample(self, rng):
+        v = float(rng.uniform(self.low, self.high))
+        return round(v / self.q) * self.q if self.q else v
+
+
+@dataclasses.dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return float(np.exp(rng.uniform(np.log(self.low),
+                                        np.log(self.high))))
+
+
+@dataclasses.dataclass
+class Randint(Domain):
+    low: int
+    high: int
+    q: int = 1
+    log: bool = False
+
+    def sample(self, rng):
+        if self.log:
+            v = int(np.exp(rng.uniform(np.log(self.low),
+                                       np.log(self.high))))
+        else:
+            v = int(rng.integers(self.low, self.high))
+        return (v // self.q) * self.q
+
+
+@dataclasses.dataclass
+class Normal(Domain):
+    mean: float = 0.0
+    sd: float = 1.0
+
+    def sample(self, rng):
+        return float(rng.normal(self.mean, self.sd))
+
+
+@dataclasses.dataclass
+class Function(Domain):
+    fn: Callable[[Dict[str, Any]], Any]
+
+    def sample(self, rng):  # spec-dependent sampling resolved at variant gen
+        return self.fn({})
+
+
+@dataclasses.dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(list(categories))
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> Uniform:
+    return Uniform(low, high, q)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def qrandint(low: int, high: int, q: int) -> Randint:
+    return Randint(low, high, q)
+
+
+def lograndint(low: int, high: int) -> Randint:
+    return Randint(low, high, log=True)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Normal:
+    return Normal(mean, sd)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)

@@ -1,0 +1,168 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Capability mirror of the reference's `tune/schedulers/` — ASHA
+(`async_hyperband.py`), HyperBand, median stopping, PBT (`pbt.py`).
+Decisions are returned from ``on_trial_result``: CONTINUE / STOP, plus
+PBT's exploit directive (restart-from-checkpoint with a mutated config).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_metric(self, metric: str, mode: str):
+        self.metric = getattr(self, "metric", None) or metric
+        self.mode = getattr(self, "mode", None) or mode
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]):
+        pass
+
+    def exploit_directive(self, trial):
+        """PBT only: (checkpoint, new_config) to restart the trial with."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference:
+    `tune/schedulers/async_hyperband.py`): rungs at grace_period *
+    reduction_factor^k; a trial reaching a rung stops unless its score is in
+    the top 1/reduction_factor of rung peers."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3.0,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self._rungs: Dict[int, List[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(int(t))
+            t *= reduction_factor
+        self._milestones = milestones
+
+    def on_trial_result(self, trial, result):
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP
+        for m in self._milestones:
+            if t == m:
+                rung = self._rungs.setdefault(m, [])
+                score = self._score(result)
+                rung.append(score)
+                k = max(1, int(len(rung) / self.rf))
+                cutoff = sorted(rung, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class HyperBandScheduler(ASHAScheduler):
+    """Bracketed variant; this implementation shares the ASHA rung logic
+    with the most exploratory bracket (the common configuration)."""
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score so far is below the median of peers'
+    running averages (reference: `tune/schedulers/median_stopping_rule.py`)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 grace_period: int = 1,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.time_attr = time_attr
+        self._history: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial, result):
+        scores = self._history.setdefault(trial.trial_id, [])
+        scores.append(self._score(result))
+        if int(result.get(self.time_attr, 0)) <= self.grace_period:
+            return CONTINUE
+        means = [float(np.mean(v)) for k, v in self._history.items()
+                 if k != trial.trial_id and v]
+        if means and max(scores) < float(np.median(means)):
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: `tune/schedulers/pbt.py`): at each
+    ``perturbation_interval``, bottom-quantile trials copy a top-quantile
+    trial's checkpoint and continue with a perturbed config."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self.rng = np.random.default_rng(seed)
+        self._last: Dict[str, Dict[str, Any]] = {}
+        self._directives: Dict[str, Any] = {}
+
+    def on_trial_result(self, trial, result):
+        self._last[trial.trial_id] = result
+        t = int(result.get(self.time_attr, 0))
+        if t == 0 or t % self.interval:
+            return CONTINUE
+        peers = list(self._last.items())
+        if len(peers) < 2:
+            return CONTINUE
+        scored = sorted(peers, key=lambda kv: self._score(kv[1]))
+        n_q = max(1, int(len(scored) * self.quantile))
+        bottom = {k for k, _ in scored[:n_q]}
+        top = [k for k, _ in scored[-n_q:]]
+        if trial.trial_id in bottom:
+            donor_id = top[int(self.rng.integers(len(top)))]
+            self._directives[trial.trial_id] = donor_id
+            return STOP  # runner restarts it via exploit_directive
+        return CONTINUE
+
+    def exploit_directive(self, trial):
+        donor_id = self._directives.pop(trial.trial_id, None)
+        if donor_id is None:
+            return None
+        new_config = dict(trial.config)
+        for k, mut in self.mutations.items():
+            from .sample import Domain
+            if isinstance(mut, Domain):
+                new_config[k] = mut.sample(self.rng)
+            elif isinstance(mut, list):
+                new_config[k] = mut[int(self.rng.integers(len(mut)))]
+            elif callable(mut):
+                new_config[k] = mut()
+            elif k in new_config:  # numeric: perturb by 0.8x / 1.2x
+                new_config[k] = new_config[k] * \
+                    (1.2 if self.rng.random() < 0.5 else 0.8)
+        return donor_id, new_config

@@ -1,0 +1,134 @@
+"""Search algorithms: config suggestion.
+
+Capability mirror of the reference's `tune/search/` (BasicVariantGenerator
+grid/random resolution, pluggable `Searcher` ABC, Optuna adapter
+`tune/search/optuna/optuna_search.py` — gated on the library).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .sample import Domain, Function, GridSearch
+
+
+class Searcher:
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False):
+        pass
+
+
+def _split_spec(spec: Dict[str, Any]):
+    grids, domains, consts = {}, {}, {}
+    for k, v in (spec or {}).items():
+        if isinstance(v, GridSearch):
+            grids[k] = v.values
+        elif isinstance(v, Domain):
+            domains[k] = v
+        else:
+            consts[k] = v
+    return grids, domains, consts
+
+
+class BasicVariantGenerator(Searcher):
+    """Cross-product of grid_search values × random samples of domains,
+    repeated ``num_samples`` times (the reference's default search)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = 0, **kw):
+        super().__init__(**kw)
+        self.rng = np.random.default_rng(seed)
+        grids, self.domains, self.consts = _split_spec(param_space)
+        grid_items = sorted(grids.items())
+        combos = list(itertools.product(*[v for _, v in grid_items])) or [()]
+        self._variants: List[Dict[str, Any]] = []
+        for _ in range(num_samples):
+            for combo in combos:
+                cfg = dict(self.consts)
+                cfg.update({k: val for (k, _), val in
+                            zip(grid_items, combo)})
+                self._variants.append(cfg)
+        self._next = 0
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._next >= len(self._variants):
+            return None
+        cfg = dict(self._variants[self._next])
+        self._next += 1
+        for k, dom in self.domains.items():
+            if isinstance(dom, Function):
+                cfg[k] = dom.fn(cfg)
+            else:
+                cfg[k] = dom.sample(self.rng)
+        return cfg
+
+
+class OptunaSearch(Searcher):
+    """TPE suggestion via optuna (reference:
+    `tune/search/optuna/optuna_search.py`); requires optuna installed."""
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", seed: Optional[int] = 0):
+        super().__init__(metric=metric, mode=mode)
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the optuna package") from e
+        self._optuna = optuna
+        sampler = optuna.samplers.TPESampler(seed=seed)
+        direction = "maximize" if mode == "max" else "minimize"
+        self._study = optuna.create_study(sampler=sampler,
+                                          direction=direction)
+        _, self.domains, self.consts = _split_spec(param_space)
+        self._trials: Dict[str, Any] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        from .sample import (Categorical, LogUniform, Normal, Randint,
+                             Uniform)
+        ot = self._study.ask()
+        self._trials[trial_id] = ot
+        cfg = dict(self.consts)
+        for k, dom in self.domains.items():
+            if isinstance(dom, Categorical):
+                cfg[k] = ot.suggest_categorical(k, dom.categories)
+            elif isinstance(dom, LogUniform):
+                cfg[k] = ot.suggest_float(k, dom.low, dom.high, log=True)
+            elif isinstance(dom, Uniform):
+                cfg[k] = ot.suggest_float(k, dom.low, dom.high)
+            elif isinstance(dom, Randint):
+                cfg[k] = ot.suggest_int(k, dom.low, dom.high - 1,
+                                        log=dom.log)
+            elif isinstance(dom, Normal):
+                cfg[k] = dom.mean + dom.sd * ot.suggest_float(
+                    k, -4.0, 4.0)
+            else:
+                cfg[k] = dom.sample(np.random.default_rng(0))
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        ot = self._trials.pop(trial_id, None)
+        if ot is None:
+            return
+        if error or not result or self.metric not in result:
+            self._study.tell(ot, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(ot, float(result[self.metric]))

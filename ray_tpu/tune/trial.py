@@ -1,0 +1,35 @@
+"""Trial state (reference: `tune/experiment/trial.py`)."""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERRORED = "ERRORED"
+
+
+@dataclasses.dataclass
+class Trial:
+    config: Dict[str, Any]
+    trial_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:8])
+    status: str = PENDING
+    last_result: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    error: Optional[str] = None
+    checkpoint_dir: Optional[str] = None
+    iteration: int = 0
+    restarts: int = 0
+
+    def best_result(self, metric: str, mode: str) -> Optional[Dict[str, Any]]:
+        rows = [r for r in self.metrics_history if metric in r]
+        if not rows:
+            return None
+        key = (lambda r: r[metric]) if mode == "max" \
+            else (lambda r: -r[metric])
+        return max(rows, key=key)

