@@ -1,0 +1,23 @@
+"""Model serving on the distributed runtime.
+
+Capability mirror of the reference's `python/ray/serve/` (SURVEY.md §3.5:
+controller actor reconciling replica actors, HTTP proxies, router with
+in-flight-capped round robin, config push, `@serve.batch`, autoscaling).
+TPU-first: a replica is a *program host* — it owns a local device mesh and
+serves a pjit-compiled sharded model; scale-out replicates compiled
+programs, scale-up grows one replica's mesh.
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    get_deployment_handle,
+    get_handle,
+    list_deployments,
+    run,
+    shutdown,
+    start,
+)
+from .batching import batch  # noqa: F401
+from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
+from .deployment import Deployment, deployment  # noqa: F401
+from .handle import ServeHandle  # noqa: F401

@@ -1,0 +1,113 @@
+"""serve.run / handles / lifecycle (reference: `serve/api.py:455`)."""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, Optional, Union
+
+from .. import api as core_api
+from ..core.serialization import dumps_function
+from .config import HTTPOptions
+from .controller import ServeController
+from .deployment import Deployment
+from .handle import ServeHandle
+from .router import Router
+
+_state: Dict[str, Any] = {}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def start(http_options: Optional[HTTPOptions] = None, *,
+          detached: bool = False) -> None:
+    """Boot the controller (and HTTP proxy) if not already running."""
+    if "controller" in _state:
+        return
+    controller = core_api.remote(ServeController).options(
+        num_cpus=0.1).remote()
+    _state["controller"] = controller
+    _state["router"] = Router(controller)
+    http = http_options or HTTPOptions(port=_free_port())
+    from .http_proxy import HTTPProxy
+    proxy = core_api.remote(HTTPProxy).options(
+        num_cpus=0.1, max_concurrency=64).remote(controller, http.host,
+                                                 http.port)
+    core_api.get(proxy.healthy.remote(), timeout=30.0)
+    _state["proxy"] = proxy
+    _state["http_address"] = f"http://{http.host}:{http.port}"
+
+
+def run(target: Deployment, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = "__derive__",
+        _blocking: bool = False) -> ServeHandle:
+    """Deploy and return a handle (reference `serve.run`)."""
+    start()
+    dep = target
+    if not isinstance(dep, Deployment):
+        raise TypeError("serve.run expects a Deployment "
+                        "(@serve.deployment-decorated)")
+    dep_name = name or dep.name
+    prefix = dep.route_prefix if route_prefix == "__derive__" \
+        else route_prefix
+    if prefix is None:
+        prefix = f"/{dep_name}"
+    cfg = {
+        "num_replicas": dep.config.num_replicas,
+        "max_concurrent_queries": dep.config.max_concurrent_queries,
+        "user_config": dep.config.user_config,
+        "ray_actor_options": dep.config.ray_actor_options,
+        "autoscaling_config": (
+            vars(dep.config.autoscaling_config)
+            if dep.config.autoscaling_config else None),
+    }
+    core_api.get(_state["controller"].deploy.remote(
+        dep_name, dumps_function(dep.func_or_class), dep.init_args,
+        dep.init_kwargs, cfg, prefix), timeout=120.0)
+    return get_handle(dep_name)
+
+
+def get_handle(name: str) -> ServeHandle:
+    if "router" not in _state:
+        raise RuntimeError("serve not started")
+    return ServeHandle(_state["router"], name)
+
+
+get_deployment_handle = get_handle
+
+
+def list_deployments() -> Dict[str, dict]:
+    if "controller" not in _state:
+        return {}
+    return core_api.get(_state["controller"].list_deployments.remote(),
+                        timeout=30.0)
+
+
+def http_address() -> Optional[str]:
+    return _state.get("http_address")
+
+
+def delete(name: str) -> None:
+    if "controller" in _state:
+        core_api.get(_state["controller"].delete.remote(name),
+                     timeout=60.0)
+
+
+def shutdown() -> None:
+    if "controller" in _state:
+        try:
+            core_api.get(_state["controller"].shutdown_all.remote(),
+                         timeout=60.0)
+        except Exception:
+            pass
+        for key in ("proxy", "controller"):
+            h = _state.pop(key, None)
+            if h is not None:
+                try:
+                    core_api.kill(h)
+                except Exception:
+                    pass
+    _state.clear()

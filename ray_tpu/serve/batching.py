@@ -1,0 +1,113 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Capability mirror of the reference's `serve/batching.py` — callers invoke
+the wrapped function with single items; the wrapper groups up to
+``max_batch_size`` concurrent calls (waiting ``batch_wait_timeout_s``) and
+invokes the underlying function ONCE with the list.  Thread-based (replicas
+run with max_concurrency > 1): the first arrival becomes the flush leader.
+On TPU replicas this is what keeps the MXU fed — batched pjit calls instead
+of batch-1 inference.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+class _Slot:
+    __slots__ = ("args", "event", "result", "error")
+
+    def __init__(self, args):
+        self.args = args
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._queue: List[_Slot] = []
+        self._leader = False
+
+    def submit(self, item: Any) -> Any:
+        slot = _Slot(item)
+        lead = False
+        with self._lock:
+            self._queue.append(slot)
+            if not self._leader:
+                self._leader = lead = True
+        if lead:
+            self._flush_as_leader()
+        slot.event.wait()
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    def _flush_as_leader(self) -> None:
+        deadline = time.monotonic() + self.timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._queue) >= self.max_batch_size:
+                    break
+            time.sleep(min(0.001, self.timeout_s / 4))
+        with self._lock:
+            batch = self._queue[:self.max_batch_size]
+            self._queue = self._queue[self.max_batch_size:]
+            self._leader = bool(self._queue)
+            requeue_leader = self._leader
+        try:
+            results = self.fn([s.args for s in batch])
+            if results is None or len(results) != len(batch):
+                raise ValueError(
+                    "@serve.batch function must return one result per "
+                    f"input ({len(batch)} in, "
+                    f"{0 if results is None else len(results)} out)")
+            for s, r in zip(batch, results):
+                s.result = r
+        except BaseException as e:
+            for s in batch:
+                s.error = e
+        finally:
+            for s in batch:
+                s.event.set()
+            if requeue_leader:
+                self._flush_as_leader()
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate ``fn(self, items: list) -> list`` (or a free function taking
+    a list); call sites pass single items."""
+
+    def wrap(fn: Callable):
+        batchers = {}  # per bound instance
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                owner, item = args
+                key = id(owner)
+                call = lambda items: fn(owner, items)  # noqa: E731
+            else:
+                (item,) = args
+                key = 0
+                call = fn
+            b = batchers.get(key)
+            if b is None:
+                b = batchers.setdefault(
+                    key, _Batcher(call, max_batch_size,
+                                  batch_wait_timeout_s))
+            return b.submit(item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    return wrap(_fn) if _fn is not None else wrap

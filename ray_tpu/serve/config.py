@@ -1,0 +1,32 @@
+"""Serve configuration schemas (reference: `serve/config.py`)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_num_ongoing_requests_per_replica: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 2.0
+
+
+@dataclasses.dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    version: int = 0

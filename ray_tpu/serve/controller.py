@@ -1,0 +1,147 @@
+"""Serve controller: the single reconciliation authority.
+
+Capability mirror of the reference's `ServeController`
+(`serve/controller.py:61`) + `DeploymentStateManager`
+(`serve/_private/deployment_state.py:958,1767`): holds target state, starts/
+stops replica actors toward it, versions the routing table (long-poll
+`serve/_private/long_poll.py` role: routers poll ``snapshot(version)``),
+and applies the autoscaling policy on router-reported metrics
+(`serve/_private/autoscaling_policy.py:93`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._version = 0
+        self._replica_seq = 0
+
+    # -- deploy / delete ----------------------------------------------------
+    def deploy(self, name: str, callable_blob: bytes, init_args: tuple,
+               init_kwargs: dict, config: dict,
+               route_prefix: Optional[str]) -> bool:
+        entry = self._deployments.get(name)
+        if entry is None:
+            entry = {"replicas": [], "metrics": {}, "last_scaled": 0.0}
+            self._deployments[name] = entry
+        entry.update(callable_blob=callable_blob, init_args=init_args,
+                     init_kwargs=init_kwargs, config=dict(config),
+                     route_prefix=route_prefix)
+        # full restart on redeploy of code/config (simple + correct);
+        # user_config-only updates go through reconfigure()
+        self._scale_to(name, 0)
+        self._reconcile(name)
+        self._version += 1
+        return True
+
+    def reconfigure_deployment(self, name: str, user_config: Any) -> bool:
+        entry = self._deployments[name]
+        entry["config"]["user_config"] = user_config
+        from .. import api
+        api.get([r["handle"].reconfigure.remote(user_config)
+                 for r in entry["replicas"]], timeout=60.0)
+        self._version += 1
+        return True
+
+    def delete(self, name: str) -> bool:
+        if name in self._deployments:
+            self._scale_to(name, 0)
+            del self._deployments[name]
+            self._version += 1
+        return True
+
+    def shutdown_all(self) -> bool:
+        for name in list(self._deployments):
+            self.delete(name)
+        return True
+
+    # -- reconciliation -----------------------------------------------------
+    def _reconcile(self, name: str) -> None:
+        entry = self._deployments[name]
+        cfg = entry["config"]
+        target = cfg["num_replicas"]
+        auto = cfg.get("autoscaling_config")
+        if auto:
+            target = max(auto["min_replicas"],
+                         min(target, auto["max_replicas"]))
+            cfg["num_replicas"] = target
+        self._scale_to(name, target)
+
+    def _scale_to(self, name: str, target: int) -> None:
+        from .. import api
+        from .replica import ServeReplica
+        entry = self._deployments[name]
+        cfg = entry.get("config", {})
+        while len(entry["replicas"]) < target:
+            self._replica_seq += 1
+            rid = f"{name}#{self._replica_seq}"
+            opts = dict(cfg.get("ray_actor_options") or {})
+            handle = api.remote(ServeReplica).options(
+                max_concurrency=int(cfg.get("max_concurrent_queries", 8)),
+                num_cpus=opts.get("num_cpus", 0.1),
+            ).remote(name, rid, entry["callable_blob"],
+                     entry["init_args"], entry["init_kwargs"],
+                     cfg.get("user_config"))
+            entry["replicas"].append({"id": rid, "handle": handle})
+        while len(entry["replicas"]) > target:
+            rep = entry["replicas"].pop()
+            try:
+                api.kill(rep["handle"])
+            except Exception:
+                pass
+        self._version += 1
+
+    # -- routing state ------------------------------------------------------
+    def snapshot(self, known_version: int = -1) -> Optional[dict]:
+        """Routing table if newer than known_version (long-poll pull)."""
+        if known_version == self._version:
+            return None
+        table = {}
+        for name, entry in self._deployments.items():
+            table[name] = {
+                "route_prefix": entry.get("route_prefix"),
+                "max_concurrent_queries":
+                    entry["config"].get("max_concurrent_queries", 8),
+                "replicas": [{"id": r["id"], "handle": r["handle"]}
+                             for r in entry["replicas"]],
+            }
+        return {"version": self._version, "table": table}
+
+    def list_deployments(self) -> Dict[str, dict]:
+        return {name: {"num_replicas": len(e["replicas"]),
+                       "route_prefix": e.get("route_prefix"),
+                       "config": {k: v for k, v in e["config"].items()
+                                  if k != "ray_actor_options"}}
+                for name, e in self._deployments.items()}
+
+    # -- autoscaling --------------------------------------------------------
+    def report_metrics(self, name: str, ongoing_per_replica: List[int]
+                       ) -> bool:
+        """Router-reported in-flight counts drive the basic autoscaler."""
+        entry = self._deployments.get(name)
+        if entry is None:
+            return False
+        cfg = entry["config"]
+        auto = cfg.get("autoscaling_config")
+        if not auto:
+            return True
+        now = time.monotonic()
+        n = max(len(ongoing_per_replica), 1)
+        avg = sum(ongoing_per_replica) / n
+        target_per = auto["target_num_ongoing_requests_per_replica"]
+        desired = min(max(
+            int(-(-sum(ongoing_per_replica) // target_per) or 1),
+            auto["min_replicas"]), auto["max_replicas"])
+        cur = len(entry["replicas"])
+        delay = (auto["upscale_delay_s"] if desired > cur
+                 else auto["downscale_delay_s"])
+        if desired != cur and now - entry["last_scaled"] >= delay:
+            entry["last_scaled"] = now
+            cfg["num_replicas"] = desired
+            self._scale_to(name, desired)
+        return True

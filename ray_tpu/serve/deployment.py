@@ -1,0 +1,78 @@
+"""@serve.deployment: declarative deployment definitions.
+
+Capability mirror of the reference's `serve/deployment.py` +
+`serve/api.py:455` — a Deployment wraps the user class/function with
+replica/runtime options; `serve.run(deployment)` materializes it via the
+controller.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from .config import AutoscalingConfig, DeploymentConfig
+
+
+@dataclasses.dataclass
+class Deployment:
+    func_or_class: Callable
+    name: str
+    config: DeploymentConfig
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    route_prefix: Optional[str] = None
+
+    def options(self, *, num_replicas: Optional[int] = None,
+                max_concurrent_queries: Optional[int] = None,
+                user_config: Any = None,
+                autoscaling_config: Optional[AutoscalingConfig] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                route_prefix: Optional[str] = "__keep__",
+                name: Optional[str] = None) -> "Deployment":
+        cfg = dataclasses.replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_concurrent_queries is not None:
+            cfg.max_concurrent_queries = max_concurrent_queries
+        if user_config is not None:
+            cfg.user_config = user_config
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = autoscaling_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        return dataclasses.replace(
+            self, config=cfg,
+            name=name or self.name,
+            route_prefix=(self.route_prefix if route_prefix == "__keep__"
+                          else route_prefix))
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """Capture init args (the deployment-graph entry point)."""
+        return dataclasses.replace(self, init_args=args,
+                                   init_kwargs=kwargs)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "deployments are not callable directly; use serve.run() and a "
+            "handle")
+
+
+def deployment(_func_or_class: Optional[Callable] = None, *,
+               name: Optional[str] = None, num_replicas: int = 1,
+               max_concurrent_queries: int = 8,
+               user_config: Any = None,
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               route_prefix: Optional[str] = None):
+    def wrap(fc: Callable) -> Deployment:
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options or {})
+        return Deployment(fc, name or fc.__name__, cfg,
+                          route_prefix=route_prefix)
+
+    return wrap(_func_or_class) if _func_or_class is not None else wrap

@@ -1,0 +1,65 @@
+"""ServeHandle: Python-side calls into a deployment (reference:
+`serve/handle.py` RayServeHandle / DeploymentHandle)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import api
+
+
+class _MethodCaller:
+    def __init__(self, handle: "ServeHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._call(args, kwargs, self._method)
+
+
+class ServeHandle:
+    def __init__(self, router, deployment_name: str):
+        self._router = router
+        self._name = deployment_name
+
+    def remote(self, *args, **kwargs):
+        """Returns an ObjectRef with the response."""
+        return self._call(args, kwargs, None)
+
+    def _call(self, args, kwargs, method: Optional[str]):
+        ref, replica_id = self._router.assign_request(
+            self._name, args, kwargs, method)
+        # completion accounting piggybacks on result retrieval
+        return _TrackedRef(ref, self._router, self._name, replica_id)
+
+    def __getattr__(self, item: str) -> _MethodCaller:
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+
+class _TrackedRef:
+    """ObjectRef wrapper that releases the router's in-flight slot when the
+    result is fetched."""
+
+    def __init__(self, ref, router, name, replica_id):
+        self._ref = ref
+        self._router = router
+        self._name = name
+        self._replica_id = replica_id
+        self._done = False
+
+    def result(self, timeout_s: float = 60.0) -> Any:
+        try:
+            return api.get(self._ref, timeout=timeout_s)
+        finally:
+            self._release()
+
+    def _release(self):
+        if not self._done:
+            self._done = True
+            self._router.complete(self._name, self._replica_id)
+
+    @property
+    def ref(self):
+        return self._ref

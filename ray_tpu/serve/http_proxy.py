@@ -1,0 +1,112 @@
+"""HTTP ingress: aiohttp proxy actor.
+
+Capability mirror of the reference's `HTTPProxy` ASGI actors
+(`serve/_private/http_proxy.py:218,312,387`, managed per node by
+`http_state.py:28`): prefix-routes requests to deployments through the
+in-proc Router, JSON in/out.  The server runs on a dedicated event-loop
+thread inside the replica-hosting worker process; replica calls execute on
+a thread pool so the accept loop never blocks on inference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+
+class HTTPProxy:
+    def __init__(self, controller_handle, host: str = "127.0.0.1",
+                 port: int = 8000):
+        from .router import Router
+        self._router = Router(controller_handle)
+        self._host = host
+        self._port = port
+        self._pool = ThreadPoolExecutor(max_workers=32)
+        self._ready = threading.Event()
+        self._startup_error: Optional[str] = None
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._ready.wait(timeout=15.0)
+        if self._startup_error:
+            raise RuntimeError(self._startup_error)
+
+    # -- server thread ------------------------------------------------------
+    def _serve(self) -> None:
+        try:
+            from aiohttp import web
+        except ImportError as e:  # pragma: no cover
+            self._startup_error = f"aiohttp unavailable: {e}"
+            self._ready.set()
+            return
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def handle(request: "web.Request") -> "web.Response":
+            path = request.path
+            if path == "/-/routes":
+                table = {name: f"/{name}"
+                         for name in self._router.deployment_names()}
+                return web.json_response(table)
+            if path == "/-/healthz":
+                return web.Response(text="ok")
+            name = self._router.match_route(path)
+            if name is None:
+                return web.Response(status=404,
+                                    text=f"no deployment for {path}")
+            if request.can_read_body:
+                raw = await request.read()
+                try:
+                    payload = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    payload = raw.decode("utf-8", "replace")
+            else:
+                payload = None
+            if payload is None and request.query:
+                payload = dict(request.query)
+
+            def call():
+                from .. import api as _api
+                ref, rid = self._router.assign_request(
+                    name, (payload,) if payload is not None else (), {})
+                try:
+                    return _api.get(ref, timeout=60.0)
+                finally:
+                    self._router.complete(name, rid)
+
+            try:
+                result = await loop.run_in_executor(self._pool, call)
+            except Exception as e:
+                return web.Response(status=500, text=str(e))
+            if isinstance(result, (bytes, bytearray)):
+                return web.Response(body=bytes(result))
+            if isinstance(result, str):
+                return web.Response(text=result)
+            return web.json_response(result)
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handle)
+        runner = web.AppRunner(app)
+
+        async def start():
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._port)
+            try:
+                await site.start()
+            except OSError as e:
+                self._startup_error = str(e)
+            self._ready.set()
+
+        loop.run_until_complete(start())
+        if not self._startup_error:
+            loop.run_forever()
+
+    # -- actor surface ------------------------------------------------------
+    def address(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def healthy(self) -> bool:
+        return self._thread.is_alive() and not self._startup_error

@@ -1,0 +1,73 @@
+"""Replica actor: hosts one copy of the user callable.
+
+Capability mirror of the reference's `RayServeReplica`
+(`serve/_private/replica.py:250,494`) — wraps the deployment's
+class/function, counts in-flight queries, supports `reconfigure`
+(user_config hot update) and async handlers.  Runs with
+``max_concurrency > 1`` so `@serve.batch` queues can fill.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+from typing import Any, Dict, Optional
+
+
+class ServeReplica:
+    def __init__(self, deployment_name: str, replica_id: str,
+                 callable_blob: bytes, init_args: tuple,
+                 init_kwargs: Dict[str, Any], user_config: Any):
+        from ..core.serialization import loads_function
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        fc = loads_function(callable_blob)
+        if inspect.isclass(fc):
+            self._callable = fc(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = fc
+            self._is_function = True
+        self._num_ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: Any) -> bool:
+        target = self._callable
+        if not self._is_function and hasattr(target, "reconfigure"):
+            target.reconfigure(user_config)
+        return True
+
+    def handle_request(self, args: tuple, kwargs: Dict[str, Any],
+                       method: Optional[str] = None) -> Any:
+        with self._lock:
+            self._num_ongoing += 1
+            self._total += 1
+        try:
+            target = self._callable
+            if not self._is_function and method:
+                target = getattr(target, method)
+            elif not self._is_function:
+                target = target.__call__
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._num_ongoing -= 1
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"replica_id": self.replica_id,
+                    "num_ongoing": self._num_ongoing,
+                    "total": self._total}
+
+    def health_check(self) -> bool:
+        target = self._callable
+        if not self._is_function and hasattr(target, "check_health"):
+            target.check_health()
+        return True

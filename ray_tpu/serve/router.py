@@ -1,0 +1,113 @@
+"""Router: replica selection with in-flight caps.
+
+Capability mirror of the reference's `Router`/`ReplicaSet`
+(`serve/_private/router.py:62,134,221`): round-robin over replicas,
+skipping those at ``max_concurrent_queries``; blocks (with backoff) when
+all are saturated.  Runs in-process in every handle/proxy; refreshes its
+table by polling the controller's versioned snapshot (the long-poll role).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import api
+
+
+class Router:
+    def __init__(self, controller_handle, poll_interval_s: float = 0.25):
+        self._controller = controller_handle
+        self._version = -1
+        self._table: Dict[str, dict] = {}
+        self._inflight: Dict[str, int] = {}
+        self._rr: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._poll_interval = poll_interval_s
+        self._last_poll = 0.0
+        self._refresh(force=True)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_poll < self._poll_interval:
+            return
+        self._last_poll = now
+        snap = api.get(self._controller.snapshot.remote(self._version),
+                       timeout=30.0)
+        if snap is None:
+            return
+        with self._lock:
+            self._version = snap["version"]
+            self._table = snap["table"]
+            self._rr = {name: itertools.cycle(range(
+                max(len(e["replicas"]), 1)))
+                for name, e in self._table.items()}
+
+    def deployment_names(self):
+        self._refresh()
+        return list(self._table)
+
+    def match_route(self, path: str) -> Optional[str]:
+        self._refresh()
+        best = None
+        for name, entry in self._table.items():
+            prefix = entry.get("route_prefix") or f"/{name}"
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                if best is None or len(prefix) > len(best[1]):
+                    best = (name, prefix)
+        return best[0] if best else None
+
+    def assign_request(self, name: str, args: tuple, kwargs: dict,
+                       method: Optional[str] = None,
+                       timeout_s: float = 60.0):
+        """Pick a non-saturated replica round-robin and return the result
+        ObjectRef; counts in-flight per replica."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self._refresh()
+            with self._lock:
+                entry = self._table.get(name)
+                replicas = entry["replicas"] if entry else []
+                cap = entry.get("max_concurrent_queries", 8) if entry else 0
+                chosen = None
+                if replicas:
+                    start = next(self._rr[name]) % len(replicas)
+                    for off in range(len(replicas)):
+                        rep = replicas[(start + off) % len(replicas)]
+                        if self._inflight.get(rep["id"], 0) < cap:
+                            chosen = rep
+                            break
+                if chosen is not None:
+                    self._inflight[chosen["id"]] = \
+                        self._inflight.get(chosen["id"], 0) + 1
+            if chosen is not None:
+                ref = chosen["handle"].handle_request.remote(
+                    args, kwargs, method)
+                return ref, chosen["id"]
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica available for {name!r} within "
+                    f"{timeout_s}s")
+            self._refresh(force=True)
+            time.sleep(0.01)
+
+    def complete(self, name: str, replica_id: str) -> None:
+        with self._lock:
+            if replica_id in self._inflight:
+                self._inflight[replica_id] -= 1
+                if self._inflight[replica_id] <= 0:
+                    del self._inflight[replica_id]
+        self._report(name)
+
+    def _report(self, name: str) -> None:
+        entry = self._table.get(name)
+        if not entry:
+            return
+        counts = [self._inflight.get(r["id"], 0)
+                  for r in entry["replicas"]]
+        try:
+            self._controller.report_metrics.remote(name, counts)
+        except Exception:
+            pass

