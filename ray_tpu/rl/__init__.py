@@ -1,0 +1,18 @@
+"""Reinforcement learning on the distributed runtime.
+
+Capability mirror of the reference's `rllib/` core (SURVEY.md §3.6:
+`Algorithm(Trainable)` with `training_step`, `RolloutWorker` actors +
+`WorkerSet`, `Policy` abstraction, vectorized envs) — redesigned TPU-first:
+environments are pure-JAX functions, so rollout + GAE + PPO update compile
+into ONE XLA program (`lax.scan` over env steps); the reference's
+embryonic JAX policy path (`rllib/policy/policy_template.py:38`,
+`rllib/models/jax/`) becomes the only path.  External (host) envs are
+supported through rollout-worker actors like the reference's sampler.
+"""
+
+from .algorithm import Algorithm  # noqa: F401
+from .env import CartPole, JaxEnv, Pendulum  # noqa: F401
+from .policy import MLPPolicy  # noqa: F401
+from .ppo import PPO, PPOConfig  # noqa: F401
+from .rollout_worker import RolloutWorker  # noqa: F401
+from .worker_set import WorkerSet  # noqa: F401

@@ -1,0 +1,79 @@
+"""Algorithm: the Trainable-style RL entry point.
+
+Capability mirror of the reference's `Algorithm(Trainable)`
+(`rllib/algorithms/algorithm.py:147,711`): `train()` drives
+`training_step`, results accumulate standard keys, checkpoints via
+`air.Checkpoint`, and `to_trainable()` plugs into Tune.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..air.checkpoint import Checkpoint
+
+
+class Algorithm:
+    _config_cls = None
+
+    def __init__(self, config):
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+
+    # -- Trainable protocol -------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        result = self.training_step()
+        self._total_env_steps += result.get("env_steps_this_iter", 0)
+        result.setdefault("training_iteration", self.iteration)
+        result["env_steps_total"] = self._total_env_steps
+        return result
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        workers = getattr(self, "_workers", None)
+        if workers is not None:
+            workers.stop()
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def save(self) -> Checkpoint:
+        return Checkpoint.from_dict(self.get_state())
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        self.set_state(checkpoint.to_dict())
+
+    # -- Tune integration ---------------------------------------------------
+    @classmethod
+    def to_trainable(cls, base_config) -> Callable:
+        """A Tune function-trainable: config overrides merge into the
+        algorithm config; reports every iteration with a checkpoint."""
+
+        def trainable(config: Dict[str, Any]):
+            import dataclasses
+
+            from ..air import session
+            overrides = {k: v for k, v in config.items()
+                         if hasattr(base_config, k)}
+            algo_cfg = dataclasses.replace(base_config, **overrides)
+            stop_iters = config.get("stop_iters", 10)
+            algo = cls(algo_cfg)
+            ck = session.get_checkpoint()
+            if ck is not None:
+                algo.restore(ck)
+            try:
+                while algo.iteration < stop_iters:
+                    result = algo.train()
+                    session.report(result, checkpoint=algo.save())
+            finally:
+                algo.stop()
+
+        return trainable

@@ -1,0 +1,132 @@
+"""Pure-JAX environments: reset/step as jittable functions.
+
+The reference's env stack (`rllib/env/`) drives external gym envs from
+Python loops; here first-class envs are functional — state is a pytree,
+``step`` is traceable — so a whole rollout is one `lax.scan` on the TPU
+(the design constraint behind the ≥100k env-steps/s target).  Classic
+control tasks are implemented from their public dynamics equations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+State = Any
+
+
+class JaxEnv:
+    """Functional env interface: subclass and implement reset/step."""
+
+    observation_size: int
+    action_size: int          # number of discrete actions, or dim if cont.
+    discrete: bool = True
+    max_episode_steps: int = 500
+
+    def reset(self, key: jax.Array) -> Tuple[State, jnp.ndarray]:
+        raise NotImplementedError
+
+    def step(self, state: State, action: jnp.ndarray, key: jax.Array
+             ) -> Tuple[State, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """→ (state, obs, reward, done)."""
+        raise NotImplementedError
+
+
+class CartPole(JaxEnv):
+    """Cart-pole balancing (classic control dynamics)."""
+
+    observation_size = 4
+    action_size = 2
+    discrete = True
+    max_episode_steps = 500
+
+    gravity = 9.8
+    masscart = 1.0
+    masspole = 0.1
+    length = 0.5
+    force_mag = 10.0
+    tau = 0.02
+    theta_threshold = 12 * 2 * jnp.pi / 360
+    x_threshold = 2.4
+
+    def reset(self, key):
+        obs = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = {"obs": obs, "t": jnp.zeros((), jnp.int32)}
+        return state, obs
+
+    def step(self, state, action, key):
+        x, x_dot, theta, theta_dot = state["obs"]
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta) \
+            / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta ** 2
+                           / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        obs = jnp.stack([x, x_dot, theta, theta_dot])
+        t = state["t"] + 1
+        done = (jnp.abs(x) > self.x_threshold) | \
+               (jnp.abs(theta) > self.theta_threshold) | \
+               (t >= self.max_episode_steps)
+        reward = jnp.ones(())
+        # auto-reset on done (vectorized rollout convention)
+        reset_state, reset_obs = self.reset(key)
+        new_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(done, r, c),
+            reset_state, {"obs": obs, "t": t})
+        new_obs = jnp.where(done, reset_obs, obs)
+        return new_state, new_obs, reward, done
+
+
+class Pendulum(JaxEnv):
+    """Torque-controlled pendulum swing-up (continuous actions)."""
+
+    observation_size = 3
+    action_size = 1
+    discrete = False
+    max_episode_steps = 200
+
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    length = 1.0
+
+    def _obs(self, th, thdot):
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def reset(self, key):
+        k1, k2 = jax.random.split(key)
+        th = jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi)
+        thdot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        state = {"th": th, "thdot": thdot, "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(th, thdot)
+
+    def step(self, state, action, key):
+        th, thdot, t = state["th"], state["thdot"], state["t"]
+        u = jnp.clip(jnp.squeeze(action), -self.max_torque,
+                     self.max_torque)
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.g / (2 * self.length) * jnp.sin(th)
+                         + 3.0 / (self.m * self.length ** 2) * u) * self.dt
+        thdot = jnp.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        t = t + 1
+        done = t >= self.max_episode_steps
+        reset_state, reset_obs = self.reset(key)
+        cur = {"th": th, "thdot": thdot, "t": t}
+        new_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(done, r, c), reset_state, cur)
+        obs = self._obs(new_state["th"], new_state["thdot"])
+        return new_state, obs, -cost, done

@@ -1,0 +1,113 @@
+"""Policies: pure-JAX actor-critic networks.
+
+The reference's `Policy` (`rllib/policy/policy.py:161`) has torch/tf
+variants and a vestigial JAX template (`rllib/models/jax/fcnet.py`); here
+the JAX MLP actor-critic is the native policy: params are pytrees, apply is
+jit-friendly, discrete heads emit logits, continuous heads emit
+(mean, log_std).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+class MLPPolicy:
+    def __init__(self, obs_size: int, action_size: int, *,
+                 discrete: bool = True,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_size = obs_size
+        self.action_size = action_size
+        self.discrete = discrete
+        self.hidden = tuple(hidden)
+
+    # -- params -------------------------------------------------------------
+    def init(self, key: jax.Array) -> Params:
+        sizes = (self.obs_size,) + self.hidden
+        n_out = self.action_size if self.discrete else 2 * self.action_size
+        keys = jax.random.split(key, len(sizes) + 2)
+        layers = []
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            layers.append({
+                "w": jax.random.normal(keys[i], (a, b)) * math.sqrt(2.0 / a),
+                "b": jnp.zeros((b,))})
+        return {
+            "torso": layers,
+            "pi": {"w": jax.random.normal(keys[-2],
+                                          (sizes[-1], n_out)) * 0.01,
+                   "b": jnp.zeros((n_out,))},
+            "vf": {"w": jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0,
+                   "b": jnp.zeros((1,))},
+        }
+
+    # -- forward ------------------------------------------------------------
+    def _torso(self, params: Params, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs
+        for layer in params["torso"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        return x
+
+    def forward(self, params: Params, obs: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """→ (policy head output, value)."""
+        x = self._torso(params, obs)
+        pi = x @ params["pi"]["w"] + params["pi"]["b"]
+        v = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return pi, v
+
+    # -- distributions ------------------------------------------------------
+    def sample_action(self, params: Params, obs: jnp.ndarray,
+                      key: jax.Array):
+        """→ (action, logp, value)."""
+        pi, v = self.forward(params, obs)
+        if self.discrete:
+            action = jax.random.categorical(key, pi)
+            logp_all = jax.nn.log_softmax(pi)
+            logp = jnp.take_along_axis(
+                logp_all, action[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            return action, logp, v
+        mean, log_std = jnp.split(pi, 2, axis=-1)
+        log_std = jnp.clip(log_std, -5.0, 2.0)
+        eps = jax.random.normal(key, mean.shape)
+        action = mean + jnp.exp(log_std) * eps
+        logp = self._gauss_logp(mean, log_std, action)
+        return action, logp, v
+
+    def log_prob(self, params: Params, obs: jnp.ndarray,
+                 action: jnp.ndarray):
+        """→ (logp, entropy, value) for PPO updates."""
+        pi, v = self.forward(params, obs)
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(pi)
+            logp = jnp.take_along_axis(
+                logp_all, action[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            return logp, entropy, v
+        mean, log_std = jnp.split(pi, 2, axis=-1)
+        log_std = jnp.clip(log_std, -5.0, 2.0)
+        logp = self._gauss_logp(mean, log_std, action)
+        entropy = jnp.sum(log_std + 0.5 * math.log(2 * math.pi * math.e),
+                          axis=-1)
+        return logp, entropy, v
+
+    @staticmethod
+    def _gauss_logp(mean, log_std, action):
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(-((action - mean) ** 2) / (2 * var) - log_std
+                       - 0.5 * math.log(2 * math.pi), axis=-1)
+
+    def get_weights(self, params: Params):
+        import numpy as np
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+
+    def set_weights(self, params: Params, weights):
+        return jax.tree_util.tree_map(lambda _, w: jnp.asarray(w),
+                                      params, weights)

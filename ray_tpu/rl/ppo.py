@@ -1,0 +1,273 @@
+"""PPO: rollout + GAE + clipped-surrogate update as one XLA program.
+
+Capability mirror of the reference's PPO (`rllib/algorithms/ppo/ppo.py:311`
+— `synchronous_parallel_sample` then `train_one_step`), redesigned so the
+whole iteration is jit-compiled: `lax.scan` unrolls the vectorized env,
+GAE runs as a reverse scan, and the epoch/minibatch SGD is a nested scan —
+zero host↔device traffic inside an iteration.  Distributed mode fans
+rollouts out to `RolloutWorker` actors and learns on the driver (the
+reference's sync pattern).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import MLPPolicy
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_envs: int = 64            # vectorized envs per worker
+    rollout_length: int = 128     # steps per env per iteration
+    num_workers: int = 0          # 0 = rollouts inline on the driver
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    lr: float = 3e-4
+    num_sgd_epochs: int = 4
+    num_minibatches: int = 4
+    max_grad_norm: float = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
+                    rollout_length: int):
+    """Jittable: (params, env_states, key) → (batch, env_states, stats)."""
+
+    def rollout(params, env_states, obs, key):
+        def step(carry, _):
+            env_states, obs, key = carry
+            key, akey, skey = jax.random.split(key, 3)
+            akeys = jax.random.split(akey, num_envs)
+            actions, logps, values = jax.vmap(
+                lambda o, k: policy.sample_action(params, o, k))(obs, akeys)
+            skeys = jax.random.split(skey, num_envs)
+            env_states, next_obs, rewards, dones = jax.vmap(env.step)(
+                env_states, actions, skeys)
+            frame = {"obs": obs, "action": actions, "logp": logps,
+                     "value": values, "reward": rewards, "done": dones}
+            return (env_states, next_obs, key), frame
+
+        (env_states, last_obs, key), traj = jax.lax.scan(
+            step, (env_states, obs, key), None, length=rollout_length)
+        _, last_value = jax.vmap(lambda o: policy.forward(params, o))(
+            last_obs)
+        return traj, env_states, last_obs, last_value, key
+
+    return rollout
+
+
+def compute_gae(traj, last_value, gamma: float, lam: float):
+    """Reverse-scan GAE over a [T, B] trajectory."""
+
+    def scan_fn(carry, frame):
+        next_adv, next_value = carry
+        nonterminal = 1.0 - frame["done"].astype(jnp.float32)
+        delta = frame["reward"] + gamma * next_value * nonterminal \
+            - frame["value"]
+        adv = delta + gamma * lam * nonterminal * next_adv
+        return (adv, frame["value"]), adv
+
+    (_, _), advantages = jax.lax.scan(
+        scan_fn, (jnp.zeros_like(last_value), last_value), traj,
+        reverse=True)
+    returns = advantages + traj["value"]
+    return advantages, returns
+
+
+class PPO(Algorithm):
+    _config_cls = PPOConfig
+
+    def __init__(self, config: PPOConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("PPOConfig.env required (an env factory)")
+        self.env = cfg.env()
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        key, pkey, ekey = jax.random.split(key, 3)
+        self.params = self.policy.init(pkey)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.key = key
+        self._rollout = make_rollout_fn(self.env, self.policy,
+                                        cfg.num_envs, cfg.rollout_length)
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._workers = None
+        if cfg.num_workers > 0:
+            from .worker_set import WorkerSet
+            self._workers = WorkerSet(cfg)
+        # episode-return tracking (host side, cheap)
+        self._ep_returns = np.zeros(cfg.num_envs)
+        self._ep_done_returns: list = []
+
+    # -- the compiled iteration --------------------------------------------
+    def _make_update_fn(self, batch_size: int):
+        cfg = self.config
+        policy = self.policy
+        mb_size = batch_size // cfg.num_minibatches
+
+        def loss_fn(params, batch):
+            logp, entropy, value = jax.vmap(
+                lambda o, a: policy.log_prob(params, o, a))(
+                    batch["obs"], batch["action"])
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["adv"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - cfg.clip_eps,
+                               1 + cfg.clip_eps) * adv
+            pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            vf_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
+            ent = jnp.mean(entropy)
+            total = pi_loss + cfg.vf_coeff * vf_loss \
+                - cfg.entropy_coeff * ent
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent}
+
+        def update_epoch(carry, _):
+            params, opt_state, batch, key = carry
+            key, pkey = jax.random.split(key)
+            perm = jax.random.permutation(pkey, batch_size)
+
+            def update_minibatch(carry, idx):
+                params, opt_state = carry
+                mb = jax.tree_util.tree_map(
+                    lambda x: x[idx], batch)
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, mb)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), aux
+
+            idxs = perm[:cfg.num_minibatches * mb_size].reshape(
+                cfg.num_minibatches, mb_size)
+            (params, opt_state), auxs = jax.lax.scan(
+                update_minibatch, (params, opt_state), idxs)
+            return (params, opt_state, batch, key), auxs
+
+        def update(params, opt_state, flat, key):
+            (params, opt_state, _, key), auxs = jax.lax.scan(
+                update_epoch, (params, opt_state, flat, key), None,
+                length=cfg.num_sgd_epochs)
+            metrics = jax.tree_util.tree_map(lambda x: x[-1, -1], auxs)
+            return params, opt_state, key, metrics
+
+        return update
+
+    def _make_train_iter(self):
+        cfg = self.config
+        batch_size = cfg.num_envs * cfg.rollout_length
+        update = self._make_update_fn(batch_size)
+
+        def train_iter(params, opt_state, env_states, obs, key):
+            traj, env_states, obs, last_value, key = self._rollout(
+                params, env_states, obs, key)
+            adv, ret = compute_gae(traj, last_value, cfg.gamma,
+                                   cfg.gae_lambda)
+            flat = {
+                "obs": traj["obs"].reshape(batch_size, -1),
+                "action": traj["action"].reshape(
+                    (batch_size,) if self.env.discrete
+                    else (batch_size, -1)),
+                "logp": traj["logp"].reshape(batch_size),
+                "adv": adv.reshape(batch_size),
+                "ret": ret.reshape(batch_size),
+            }
+            params, opt_state, key, metrics = update(
+                params, opt_state, flat, key)
+            metrics["reward_sum"] = traj["reward"].sum()
+            return params, opt_state, env_states, obs, key, metrics, \
+                traj["reward"], traj["done"]
+
+        return train_iter
+
+    # -- Trainable interface ------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        if self._workers is not None:
+            batches = self._workers.sample(
+                self.policy.get_weights(self.params))
+            # learn on driver from worker trajectories
+            metrics = self._learn_on_batch(batches)
+            env_steps = cfg.num_workers * cfg.num_envs * cfg.rollout_length
+        else:
+            (self.params, self.opt_state, self.env_states, self.obs,
+             self.key, metrics, rewards, dones) = self._train_iter(
+                self.params, self.opt_state, self.env_states, self.obs,
+                self.key)
+            env_steps = cfg.num_envs * cfg.rollout_length
+            self._track_episodes(np.asarray(rewards), np.asarray(dones))
+            metrics = {k: float(v) for k, v in metrics.items()}
+        dt = time.perf_counter() - t0
+        out = dict(metrics)
+        out.update({
+            "env_steps_this_iter": env_steps,
+            "env_steps_per_s": env_steps / dt,
+            "episode_reward_mean": float(np.mean(
+                self._ep_done_returns[-100:])) if self._ep_done_returns
+            else float("nan"),
+        })
+        return out
+
+    def _track_episodes(self, rewards: np.ndarray, dones: np.ndarray):
+        for t in range(rewards.shape[0]):
+            self._ep_returns += rewards[t]
+            finished = dones[t].astype(bool)
+            if finished.any():
+                self._ep_done_returns.extend(
+                    self._ep_returns[finished].tolist())
+                self._ep_returns[finished] = 0.0
+
+    def _learn_on_batch(self, batches) -> Dict[str, float]:
+        keys = ("obs", "action", "logp", "adv", "ret")
+        flat = {k: jnp.asarray(np.concatenate([b[k] for b in batches]))
+                for k in keys}
+        for b in batches:
+            ep = b.get("episode_returns")
+            if ep is not None and len(ep):
+                self._ep_done_returns.extend(np.asarray(ep).tolist())
+        total = flat["obs"].shape[0]
+        if getattr(self, "_update_bs", None) != total:
+            self._update_bs = total
+            self._update_jit = jax.jit(self._make_update_fn(total))
+        self.params, self.opt_state, self.key, metrics = self._update_jit(
+            self.params, self.opt_state, flat, self.key)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.policy.get_weights(self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = self.policy.set_weights(self.params, state["params"])
+        self.iteration = state.get("iteration", 0)

@@ -1,0 +1,72 @@
+"""Rollout workers: distributed experience collection.
+
+Capability mirror of the reference's `RolloutWorker.sample`
+(`rllib/evaluation/rollout_worker.py:153,864`): an actor owning env +
+policy; the driver broadcasts weights and gathers sample batches.  The
+inner loop is the same jitted rollout as the single-process path — an
+actor on a TPU host samples at compiled speed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+class RolloutWorker:
+    def __init__(self, config_blob: bytes, worker_index: int):
+        import jax
+
+        from ..core.serialization import loads_function
+        from .policy import MLPPolicy
+        from .ppo import compute_gae, make_rollout_fn
+        cfg = loads_function(config_blob)
+        self.cfg = cfg
+        self.env = cfg.env()
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed + 1000 * (worker_index + 1))
+        self.key, ekey, pkey = jax.random.split(key, 3)
+        self.params = self.policy.init(pkey)
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        rollout = make_rollout_fn(self.env, self.policy, cfg.num_envs,
+                                  cfg.rollout_length)
+
+        def sample_fn(params, env_states, obs, key):
+            traj, env_states, obs, last_value, key = rollout(
+                params, env_states, obs, key)
+            adv, ret = compute_gae(traj, last_value, cfg.gamma,
+                                   cfg.gae_lambda)
+            bs = cfg.num_envs * cfg.rollout_length
+            flat = {
+                "obs": traj["obs"].reshape(bs, -1),
+                "action": traj["action"].reshape(
+                    (bs,) if self.env.discrete else (bs, -1)),
+                "logp": traj["logp"].reshape(bs),
+                "adv": adv.reshape(bs),
+                "ret": ret.reshape(bs),
+            }
+            return flat, env_states, obs, key, traj["reward"], traj["done"]
+
+        self._sample = jax.jit(sample_fn)
+        self._ep_returns = np.zeros(cfg.num_envs)
+        self._done_returns: list = []
+
+    def sample(self, weights) -> Dict[str, Any]:
+        self.params = self.policy.set_weights(self.params, weights)
+        flat, self.env_states, self.obs, self.key, rewards, dones = \
+            self._sample(self.params, self.env_states, self.obs, self.key)
+        rewards, dones = np.asarray(rewards), np.asarray(dones)
+        for t in range(rewards.shape[0]):
+            self._ep_returns += rewards[t]
+            f = dones[t].astype(bool)
+            if f.any():
+                self._done_returns.extend(self._ep_returns[f].tolist())
+                self._ep_returns[f] = 0.0
+        batch = {k: np.asarray(v) for k, v in flat.items()}
+        batch["episode_returns"] = np.asarray(self._done_returns[-100:])
+        return batch

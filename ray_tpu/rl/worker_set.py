@@ -1,0 +1,34 @@
+"""WorkerSet: the gang of rollout actors (reference:
+`rllib/evaluation/worker_set.py`)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from .. import api
+from ..core.serialization import dumps_function
+
+
+class WorkerSet:
+    def __init__(self, config):
+        from .rollout_worker import RolloutWorker
+        blob = dumps_function(config)
+        cls = api.remote(RolloutWorker)
+        self._workers = [cls.options(num_cpus=1.0).remote(blob, i)
+                         for i in range(config.num_workers)]
+
+    def sample(self, weights) -> List[Dict[str, Any]]:
+        ref = api.put(weights)  # broadcast once through the object store
+        return api.get([w.sample.remote(ref) for w in self._workers],
+                       timeout=600.0)
+
+    def num_workers(self) -> int:
+        return len(self._workers)
+
+    def stop(self) -> None:
+        for w in self._workers:
+            try:
+                api.kill(w)
+            except Exception:
+                pass
+        self._workers = []
