@@ -53,7 +53,9 @@ def main():
     opt = optax.adamw(3e-4, weight_decay=0.1)
     opt_state = opt.init(params)
     step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1),
+    # lm_loss runs the model on the full token length — keep it equal to
+    # seq so the flash kernel's 128-block alignment holds
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
                                 0, cfg.vocab_size)
     batch_data = {"tokens": tokens}
 

@@ -275,8 +275,10 @@ def lm_loss(params: Params, batch: Dict[str, jnp.ndarray],
     positions 0..s-2 predicting 1..s-1."""
     import optax
 
+    # run the model on the FULL sequence and shift the logits: keeps the
+    # model's seq length divisible by sequence-parallel mesh axes (sp)
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg)
+    logits = forward(params, tokens, cfg)[:, :-1]
     targets = tokens[:, 1:]
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     mask = batch.get("mask")

@@ -72,11 +72,22 @@ def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if impl == "reference":
         return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "ring":
-        try:
-            from .ring_attention import ring_attention
-        except ImportError as e:
-            raise NotImplementedError(
-                "ring attention requires ray_tpu.ops.ring_attention "
-                "(sequence-parallel path)") from e
-        return ring_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        # sequence-parallel path: shard_map over the ambient mesh's sp axis
+        # (set the mesh with `jax.set_mesh` / `with mesh:` around the jit)
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from .ring_attention import ring_attention_shard
+        mesh = jax.sharding.get_abstract_mesh()
+        sp = dict(mesh.shape).get("sp", 1) if mesh is not None else 1
+        if sp <= 1:
+            return reference_attention(q, k, v, causal=causal,
+                                       sm_scale=sm_scale)
+        spec = P(None, "sp", None, None)
+        return jax.shard_map(
+            functools.partial(ring_attention_shard, axis_name="sp",
+                              axis_size=sp, causal=causal,
+                              sm_scale=sm_scale),
+            in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,173 @@
+"""Sequence-parallel attention: ring (ppermute) and Ulysses (all_to_all).
+
+The reference has NO long-context strategy (SURVEY.md §5.7 — sequence
+scaling is delegated to user frameworks); here it is a first-class op pair
+on the ``sp`` mesh axis:
+
+  * **Ring attention** — K/V shards rotate around the ICI ring
+    (`lax.ppermute`) while each device accumulates blockwise online-softmax
+    statistics for its local queries.  Memory per device is O(S/n · S/n);
+    the rotation overlaps with compute under XLA pipelining.  Causality is
+    enforced with rank-relation masks, so the op is fully differentiable
+    (no custom VJP needed — gradients flow through ppermute).
+  * **Ulysses** — all_to_all re-shards from sequence to heads, runs dense
+    local attention (the Pallas flash kernel when on TPU), and re-shards
+    back.  Cheaper at moderate S, needs head_count % sp == 0.
+
+Both are written against `shard_map` shards: ``*_shard`` functions take
+LOCAL arrays [batch, seq_local, heads, head_dim] and must run inside
+`shard_map` (or any SPMD region) over the named axis.  `make_ring_attention`
+/ `make_ulysses_attention` wrap them for whole-array use on a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _online_block(q, k, v, mask, sm_scale, m, l, acc):
+    """One blockwise online-softmax accumulation step (fp32 stats).
+    q:[B,Sq,H,D] k/v:[B,Sk,H,D] mask broadcastable to [B,H,Sq,Sk]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    acc_new = acc * alpha[..., 0][..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention_shard(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                         axis_name: str = "sp", axis_size: int,
+                         causal: bool = True,
+                         sm_scale: Optional[float] = None) -> jnp.ndarray:
+    """Ring attention over LOCAL shards (call inside shard_map over
+    ``axis_name``).  Shapes [B, S/n, H, D]; KV heads may divide Q heads."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:
+        from .attention import repeat_kv
+        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+    b, s_loc, h, d = q.shape
+    my = jax.lax.axis_index(axis_name)
+
+    rows = jnp.arange(s_loc)[:, None]
+    cols = jnp.arange(s_loc)[None, :]
+    diag_mask = rows >= cols                      # within-chunk causal
+
+    m = jnp.full((b, h, s_loc, 1), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - i) % axis_size                # owner rank of k_cur
+        if causal:
+            mask = jnp.where(src < my, True, False) | \
+                   ((src == my) & diag_mask)
+            mask = jnp.broadcast_to(mask, (b, h, s_loc, s_loc))
+        else:
+            mask = jnp.ones((b, h, s_loc, s_loc), bool)
+        m2, l2, acc2 = _online_block(q, k_cur, v_cur, mask, sm_scale,
+                                     m, l, acc)
+        # fully-masked steps (src > my under causal) must not touch stats
+        if causal:
+            skip = src > my
+            m2 = jnp.where(skip, m, m2)
+            l2 = jnp.where(skip, l, l2)
+            acc2 = jnp.where(skip, acc, acc2)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m2, l2, acc2, k_next, v_next
+
+    carry = (m, l, acc, k, v)
+    for i in range(axis_size):                    # static unroll: n steps
+        carry = body(i, carry)
+    m, l, acc, _, _ = carry
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ulysses_attention_shard(q: jnp.ndarray, k: jnp.ndarray,
+                            v: jnp.ndarray, *, axis_name: str = "sp",
+                            causal: bool = True,
+                            sm_scale: Optional[float] = None,
+                            inner_impl: str = "auto") -> jnp.ndarray:
+    """Ulysses SP: seq-sharded [B, S/n, H, D] → heads-sharded full-seq
+    attention → seq-sharded output.  Requires H % n == 0."""
+    from .attention import multi_head_attention
+
+    def a2a(x, split, concat):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split,
+                                  concat_axis=concat, tiled=True)
+
+    q_h = a2a(q, 2, 1)     # [B, S, H/n, D]
+    k_h = a2a(k, 2, 1)
+    v_h = a2a(v, 2, 1)
+    out = multi_head_attention(q_h, k_h, v_h, causal=causal,
+                               sm_scale=sm_scale, impl=inner_impl)
+    return a2a(out, 1, 2)  # back to [B, S/n, H, D]
+
+
+# -- whole-array wrappers ----------------------------------------------------
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
+    """(q, k, v) → out with q/k/v whole arrays sharded [B, S@sp, H, D]."""
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    spec = P(None, axis_name, None, None)
+
+    @jax.jit
+    def fn(q, k, v):
+        shard = functools.partial(ring_attention_shard,
+                                  axis_name=axis_name,
+                                  axis_size=axis_size)
+        return jax.shard_map(shard, mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)
+
+    return fn
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
+                           inner_impl: str = "auto"):
+    spec = P(None, axis_name, None, None)
+
+    @jax.jit
+    def fn(q, k, v):
+        shard = functools.partial(ulysses_attention_shard,
+                                  axis_name=axis_name,
+                                  inner_impl=inner_impl)
+        return jax.shard_map(shard, mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)
+
+    return fn
+
+
+def ring_attention(q, k, v, *, causal: bool = True,
+                   sm_scale: Optional[float] = None,
+                   axis_name: str = "sp", axis_size: Optional[int] = None):
+    """Shard-level entry used by the model's attention dispatch: must be
+    traced inside an SPMD region over ``axis_name``.  ``axis_size`` falls
+    back to the bound axis size."""
+    if axis_size is None:
+        axis_size = jax.lax.psum(1, axis_name)
+        if not isinstance(axis_size, int):
+            raise ValueError(
+                "ring attention needs a static axis_size; pass it or call "
+                "through make_ring_attention(mesh)")
+    return ring_attention_shard(q, k, v, axis_name=axis_name,
+                                axis_size=axis_size, causal=causal,
+                                sm_scale=sm_scale)

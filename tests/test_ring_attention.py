@@ -1,0 +1,137 @@
+"""Sequence-parallel attention vs the dense reference, on the virtual
+8-device CPU mesh (the multi-chip test strategy from SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.ops.ring_attention import (make_ring_attention,
+                                        make_ulysses_attention)
+from ray_tpu.parallel import MeshSpec, create_mesh
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return create_mesh(MeshSpec(sp=4, fsdp=2))
+
+
+def _qkv(b=2, s=64, h=4, hkv=4, d=16, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (jax.random.normal(ks[0], (b, s, h, d), dtype),
+            jax.random.normal(ks[1], (b, s, hkv, d), dtype),
+            jax.random.normal(ks[2], (b, s, hkv, d), dtype))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(sp_mesh, causal):
+    q, k, v = _qkv()
+    ring = make_ring_attention(sp_mesh)
+    if not causal:
+        from ray_tpu.ops.ring_attention import ring_attention_shard
+        import functools
+        from jax.sharding import PartitionSpec as P
+        spec = P(None, "sp", None, None)
+        fn = jax.jit(jax.shard_map(
+            functools.partial(ring_attention_shard, axis_name="sp",
+                              axis_size=4, causal=False),
+            mesh=sp_mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        out = fn(q, k, v)
+    else:
+        out = ring(q, k, v)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_ring_gqa(sp_mesh):
+    q, k, v = _qkv(h=4, hkv=2)
+    out = make_ring_attention(sp_mesh)(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_ring_gradients_match(sp_mesh):
+    q, k, v = _qkv(b=1, s=32, h=2, hkv=2, d=8)
+    ring = make_ring_attention(sp_mesh)
+
+    def loss_ring(q, k, v):
+        return (ring(q, k, v) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-3)
+
+
+def test_ulysses_matches_reference(sp_mesh):
+    q, k, v = _qkv(h=8, hkv=8)  # heads divisible by sp=4
+    out = make_ulysses_attention(sp_mesh)(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_model_forward_with_ring_attention(sp_mesh):
+    """End-to-end: transformer forward under shard_map with sp-sharded
+    activations using ring attention."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.ops.ring_attention import ring_attention_shard
+
+    b, s, h, d = 1, 64, 4, 32
+    q, k, v = _qkv(b=b, s=s, h=h, hkv=h, d=d)
+    # sanity: the shard-level entry composes under jit+shard_map the same
+    # way the model's attention dispatch will use it
+    spec = P(None, "sp", None, None)
+    fn = jax.jit(jax.shard_map(
+        functools.partial(ring_attention_shard, axis_name="sp",
+                          axis_size=4),
+        mesh=sp_mesh, in_specs=(spec, spec, spec), out_specs=spec))
+    out = fn(q, k, v)
+    assert out.shape == q.shape
+
+
+def test_transformer_trains_with_ring_attention(sp_mesh):
+    """Full model path: TransformerConfig(attention_impl="ring") under an
+    sp×fsdp mesh — the long-context Train strategy."""
+    import optax
+
+    from ray_tpu.models import TransformerConfig, init_params, \
+        make_train_step
+    from ray_tpu.parallel import FSDP_TP_RULES, batch_sharding, \
+        pytree_shardings
+
+    cfg = TransformerConfig.tiny(attention_impl="ring", max_seq_len=64)
+    params, axes = init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(
+        params, pytree_shardings(axes, sp_mesh, FSDP_TP_RULES))
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 256)
+    toks = jax.device_put(toks, batch_sharding(sp_mesh, FSDP_TP_RULES))
+    losses = []
+    with jax.set_mesh(sp_mesh):
+        for _ in range(4):
+            params, opt_state, m = step(params, opt_state,
+                                        {"tokens": toks})
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+    # parity: same init with reference attention gives ~the same first loss
+    cfg2 = TransformerConfig.tiny(attention_impl="reference",
+                                  max_seq_len=64)
+    params2, _ = init_params(jax.random.PRNGKey(0), cfg2)
+    from ray_tpu.models import lm_loss
+    l_ref = float(lm_loss(params2, {"tokens": toks}, cfg2))
+    np.testing.assert_allclose(losses[0], l_ref, rtol=5e-3)
