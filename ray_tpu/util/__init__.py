@@ -1,0 +1,16 @@
+"""Utility integrations over the core API (reference: `python/ray/util/`):
+placement groups, scheduling strategies, collectives, actor pool, queue,
+multiprocessing Pool, tracing."""
+
+from .actor_pool import ActorPool  # noqa: F401
+from .placement_group import (  # noqa: F401
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+    tpu_slice_placement_group,
+)
+from .queue import Empty, Full, Queue  # noqa: F401
+from .scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)

@@ -1,0 +1,84 @@
+"""ActorPool (reference: `python/ray/util/actor_pool.py`): load-balanced
+work distribution over a fixed set of actors."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+from .. import api
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending = []          # ordered (index, ref)
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._results = {}
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        if not self._idle:
+            raise RuntimeError("no idle actors; call get_next first")
+        actor = self._idle.pop(0)
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._pending.append((self._next_task_index, ref))
+        self._next_task_index += 1
+
+    def has_next(self) -> bool:
+        return bool(self._pending) or \
+            self._next_return_index in self._results
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def _collect(self, ref) -> Any:
+        actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        return api.get(ref, timeout=600.0)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order."""
+        idx = self._next_return_index
+        self._next_return_index += 1
+        if idx in self._results:
+            return self._results.pop(idx)
+        while True:
+            for i, (task_idx, ref) in enumerate(self._pending):
+                if task_idx == idx:
+                    del self._pending[i]
+                    return self._collect(ref)
+            raise RuntimeError(f"no pending task with index {idx}")
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        if not self._pending:
+            raise RuntimeError("no pending tasks")
+        refs = [ref for _, ref in self._pending]
+        ready, _ = api.wait(refs, num_returns=1, timeout=timeout)
+        ref = ready[0]
+        self._pending = [(i, r) for i, r in self._pending if r != ref]
+        return self._collect(ref)
+
+    _DONE = object()
+
+    def _map_impl(self, fn: Callable, values: Iterable[Any],
+                  next_result: Callable):
+        it = iter(values)
+        while True:
+            if self._idle:
+                v = next(it, self._DONE)
+                if v is self._DONE:
+                    break
+                self.submit(fn, v)
+            else:
+                yield next_result()
+        while self._pending or \
+                self._next_return_index in self._results:
+            yield next_result()
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        return self._map_impl(fn, values, self.get_next)
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        return self._map_impl(fn, values, self.get_next_unordered)

@@ -1,0 +1,219 @@
+"""Collective communication groups.
+
+Capability mirror of the reference's `ray.util.collective`
+(`python/ray/util/collective/collective.py:120-615`: named groups with
+allreduce/allgather/reducescatter/broadcast/send/recv/barrier over NCCL or
+Gloo).  TPU-native split:
+
+  * **Accelerator tensors** never use this module imperatively — they sync
+    as XLA collectives (psum/all_gather/ppermute) compiled into programs
+    over the device mesh (`ray_tpu.parallel`).  `mesh_collective_hints`
+    returns the in-jit equivalents for each op.
+  * **Host arrays** (the Gloo role) go through a named rendezvous actor —
+    the same detached-store pattern as the reference's
+    `NCCLUniqueIDStore` (`nccl_collective_group.py:29-34`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import api
+
+_groups: Dict[str, "_GroupClient"] = {}
+_local = threading.local()
+
+
+class _GroupActor:
+    """Rendezvous + reduction state for one named group."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._contrib: Dict[str, list] = {}
+        self._ready: Dict[str, Any] = {}
+        self._mailbox: Dict[str, Any] = {}
+
+    def contribute(self, key: str, rank: int, value, op: str):
+        entry = self._contrib.setdefault(key, [None] * self.world_size)
+        entry[rank] = np.asarray(value)
+        if all(v is not None for v in entry):
+            if op == "sum" or op == "mean":
+                out = np.sum(entry, axis=0)
+                if op == "mean":
+                    out = out / self.world_size
+            elif op == "max":
+                out = np.max(entry, axis=0)
+            elif op == "min":
+                out = np.min(entry, axis=0)
+            elif op == "prod":
+                out = np.prod(entry, axis=0)
+            elif op == "gather":
+                out = list(entry)
+            else:
+                raise ValueError(f"unknown reduce op {op!r}")
+            self._ready[key] = out
+            del self._contrib[key]
+        return True
+
+    def fetch(self, key: str):
+        return self._ready.get(key, "__pending__")
+
+    def post(self, key: str, value):
+        self._mailbox[key] = np.asarray(value)
+        return True
+
+    def take(self, key: str):
+        if key in self._mailbox:
+            return self._mailbox.pop(key)
+        return "__pending__"
+
+    def peek(self, key: str):
+        return self._mailbox.get(key, "__pending__")
+
+
+class _GroupClient:
+    def __init__(self, name: str, world_size: int, rank: int, handle):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.handle = handle
+        self._counters: Dict[str, int] = {}
+
+    def _key(self, tag: str) -> str:
+        n = self._counters.get(tag, 0)
+        self._counters[tag] = n + 1
+        return f"{tag}/{n}"
+
+    def _await(self, getter, key: str, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            out = api.get(getter(key), timeout=timeout_s)
+            if not (isinstance(out, str) and out == "__pending__"):
+                return out
+            time.sleep(0.004)
+        raise TimeoutError(f"collective {key!r} timed out in {self.name}")
+
+    def reduce(self, value, op: str, tag: str, timeout_s: float):
+        key = self._key(tag)
+        api.get(self.handle.contribute.remote(key, self.rank, value, op),
+                timeout=timeout_s)
+        return self._await(lambda k: self.handle.fetch.remote(k), key,
+                           timeout_s)
+
+
+def init_collective_group(world_size: int, rank: int, *,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Join a named group.  ``backend="host"`` (numpy over a rendezvous
+    actor); accelerator tensors use mesh collectives inside jit instead."""
+    actor_name = f"collective::{group_name}"
+    if rank == 0:
+        handle = api.remote(_GroupActor).options(
+            name=actor_name, get_if_exists=True,
+            num_cpus=0.05).remote(world_size)
+    else:
+        # concurrent get_if_exists creation races; non-zero ranks wait for
+        # rank 0's actor (the reference's unique-id-store rendezvous shape)
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                handle = api.get_actor(actor_name)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.02)
+    _groups[group_name] = _GroupClient(group_name, world_size, rank, handle)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            api.kill(g.handle)
+        except Exception:
+            pass
+
+
+def _group(group_name: str) -> _GroupClient:
+    if group_name not in _groups:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized "
+            "(call init_collective_group)")
+    return _groups[group_name]
+
+
+def allreduce(value, *, op: str = "sum", group_name: str = "default",
+              timeout_s: float = 120.0):
+    return _group(group_name).reduce(value, op, "ar", timeout_s)
+
+
+def allgather(value, *, group_name: str = "default",
+              timeout_s: float = 120.0) -> List[Any]:
+    return _group(group_name).reduce(value, "gather", "ag", timeout_s)
+
+
+def reducescatter(value, *, op: str = "sum", group_name: str = "default",
+                  timeout_s: float = 120.0):
+    """Reduce then return this rank's equal slice along axis 0."""
+    g = _group(group_name)
+    full = g.reduce(value, op, "rs", timeout_s)
+    chunks = np.array_split(np.asarray(full), g.world_size, axis=0)
+    return chunks[g.rank]
+
+
+def broadcast(value, *, src_rank: int = 0, group_name: str = "default",
+              timeout_s: float = 120.0):
+    g = _group(group_name)
+    key = g._key("bc")
+    if g.rank == src_rank:
+        api.get(g.handle.post.remote(key, value), timeout=timeout_s)
+        return np.asarray(value)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        out = api.get(g.handle.peek.remote(key), timeout=timeout_s)
+        if not (isinstance(out, str) and out == "__pending__"):
+            return out
+        time.sleep(0.004)
+    raise TimeoutError("broadcast timed out")
+
+
+def send(value, dst_rank: int, *, group_name: str = "default",
+         timeout_s: float = 120.0) -> None:
+    g = _group(group_name)
+    key = f"p2p/{g.rank}->{dst_rank}/{g._key('send')}"
+    api.get(g.handle.post.remote(key, value), timeout=timeout_s)
+
+
+def recv(src_rank: int, *, group_name: str = "default",
+         timeout_s: float = 120.0):
+    g = _group(group_name)
+    key = f"p2p/{src_rank}->{g.rank}/{g._key('send')}"
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        out = api.get(g.handle.take.remote(key), timeout=timeout_s)
+        if not (isinstance(out, str) and out == "__pending__"):
+            return out
+        time.sleep(0.004)
+    raise TimeoutError(f"recv from rank {src_rank} timed out")
+
+
+def barrier(*, group_name: str = "default",
+            timeout_s: float = 120.0) -> None:
+    _group(group_name).reduce(np.zeros(()), "sum", "bar", timeout_s)
+
+
+def mesh_collective_hints() -> Dict[str, str]:
+    """The in-jit (compiled, ICI) equivalent for each imperative op."""
+    return {
+        "allreduce": "jax.lax.psum(x, axis_name)",
+        "allgather": "jax.lax.all_gather(x, axis_name)",
+        "reducescatter": "jax.lax.psum_scatter(x, axis_name)",
+        "broadcast": "replicate via sharding (NamedSharding(mesh, P()))",
+        "send/recv": "jax.lax.ppermute(x, axis_name, perm)",
+        "alltoall": "jax.lax.all_to_all(x, axis_name, split, concat)",
+    }

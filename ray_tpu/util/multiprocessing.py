@@ -1,0 +1,88 @@
+"""multiprocessing.Pool over runtime tasks (reference:
+`python/ray/util/multiprocessing/pool.py`)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+from .. import api
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = api.get(self._refs, timeout=timeout or 600.0)
+        return out[0] if self._single else out
+
+    def ready(self) -> bool:
+        ready, _ = api.wait(self._refs, num_returns=len(self._refs),
+                            timeout=0)
+        return len(ready) == len(self._refs)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        api.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+
+class Pool:
+    """Process pool on cluster tasks; `processes` caps concurrency only in
+    the scheduler sense (tasks queue beyond it)."""
+
+    def __init__(self, processes: Optional[int] = None):
+        self._task = api.remote(_call)
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        from ..core.serialization import dumps_function
+        blob = dumps_function(fn)
+        return AsyncResult([self._task.remote(blob, args, kwds or {})],
+                           single=True)
+
+    def map(self, fn: Callable, iterable: Iterable[Any]) -> List[Any]:
+        return self.map_async(fn, iterable).get()
+
+    def map_async(self, fn: Callable,
+                  iterable: Iterable[Any]) -> AsyncResult:
+        from ..core.serialization import dumps_function
+        blob = dumps_function(fn)
+        refs = [self._task.remote(blob, (x,), {}) for x in iterable]
+        return AsyncResult(refs, single=False)
+
+    def imap(self, fn: Callable, iterable: Iterable[Any]):
+        from ..core.serialization import dumps_function
+        blob = dumps_function(fn)
+        refs = [self._task.remote(blob, (x,), {}) for x in iterable]
+        for r in refs:
+            yield api.get(r, timeout=600.0)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple]) -> List[Any]:
+        from ..core.serialization import dumps_function
+        blob = dumps_function(fn)
+        refs = [self._task.remote(blob, tuple(args), {})
+                for args in iterable]
+        return api.get(refs, timeout=600.0)
+
+    def close(self) -> None:
+        pass
+
+    def terminate(self) -> None:
+        pass
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+def _call(fn_blob: bytes, args: tuple, kwds: dict):
+    from ..core.serialization import loads_function
+    return loads_function(fn_blob)(*args, **kwds)

@@ -1,0 +1,85 @@
+"""Distributed FIFO queue (reference: `python/ray/util/queue.py`) backed by
+a queue actor."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+from .. import api
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._items: List[Any] = []
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self._items) >= self.maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def get(self):
+        if not self._items:
+            return ("__empty__",)
+        return (self._items.pop(0), None)
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: dict = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.05)
+        self._actor = api.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok = api.get(self._actor.put.remote(item), timeout=60.0)
+            if ok:
+                return
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Full()
+            time.sleep(0.01)
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            out = api.get(self._actor.get.remote(), timeout=60.0)
+            if not (isinstance(out, tuple) and out[0] == "__empty__"):
+                return out[0]
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Empty()
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return api.get(self._actor.qsize.remote(), timeout=60.0)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def shutdown(self) -> None:
+        try:
+            api.kill(self._actor)
+        except Exception:
+            pass

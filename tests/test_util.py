@@ -1,0 +1,103 @@
+"""Util integration tests (reference model: `python/ray/tests/test_actor_pool.py`,
+`test_queue.py`, `python/ray/util/collective` tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_actor_pool_map(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+    actors = [Worker.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+    out2 = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                     range(5)))
+    assert out2 == [0, 2, 4, 6, 8]
+
+
+def test_queue(cluster):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    q.put_nowait(3)
+    assert q.get() == 2 and q.get() == 3
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_multiprocessing_pool(cluster):
+    with Pool() as p:
+        assert p.map(lambda x: x * x, range(6)) == [0, 1, 4, 9, 16, 25]
+        r = p.apply_async(lambda a, b: a + b, (2, 3))
+        assert r.get() == 5
+        assert p.starmap(lambda a, b: a * b, [(1, 2), (3, 4)]) == [2, 12]
+        assert list(p.imap(lambda x: -x, [1, 2])) == [-1, -2]
+
+
+def test_collective_group(cluster):
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    def rank_main(rank, world):
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+        col.init_collective_group(world, rank, group_name="g1")
+        total = col.allreduce(np.asarray([rank + 1.0]), group_name="g1")
+        gathered = col.allgather(np.asarray([rank]), group_name="g1")
+        bc = col.broadcast(np.asarray([42.0]) if rank == 0 else None,
+                           src_rank=0, group_name="g1")
+        if rank == 0:
+            col.send(np.asarray([7.0]), dst_rank=1, group_name="g1")
+            recvd = None
+        else:
+            recvd = col.recv(0, group_name="g1")
+        col.barrier(group_name="g1")
+        return (float(total[0]), [int(g[0]) for g in gathered],
+                float(bc[0]), None if recvd is None else float(recvd[0]))
+
+    results = ray_tpu.get([rank_main.remote(r, 2) for r in range(2)],
+                          timeout=120.0)
+    for rank, (total, gathered, bc, recvd) in enumerate(results):
+        assert total == 3.0          # (0+1) + (1+1)
+        assert gathered == [0, 1]
+        assert bc == 42.0
+        if rank == 1:
+            assert recvd == 7.0
+
+
+def test_reducescatter(cluster):
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    def rank_main(rank, world):
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+        col.init_collective_group(world, rank, group_name="g2")
+        out = col.reducescatter(np.arange(4.0), group_name="g2")
+        return out.tolist()
+
+    res = ray_tpu.get([rank_main.remote(r, 2) for r in range(2)],
+                      timeout=120.0)
+    assert res[0] == [0.0, 2.0] and res[1] == [4.0, 6.0]
