@@ -30,5 +30,9 @@ from .api import (  # noqa: F401
 )
 from .core.driver import ObjectRef  # noqa: F401
 from . import exceptions  # noqa: F401
+from .dag.node import install_bind as _install_bind
+
+_install_bind()
+del _install_bind
 
 __version__ = "0.1.0"

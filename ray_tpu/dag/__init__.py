@@ -1,0 +1,16 @@
+"""Lazy task/actor call graphs.
+
+Capability mirror of the reference's `python/ray/dag/` (`dag_node.py`,
+`function_node.py`, `class_node.py`, `input_node.py`): `.bind()` builds the
+DAG, `.execute()` submits it as runtime tasks with ref-passing between
+nodes (upstream results flow as ObjectRefs — data never gathers on the
+driver).
+"""
+
+from .node import (  # noqa: F401
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+)

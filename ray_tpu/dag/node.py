@@ -1,0 +1,158 @@
+"""DAG nodes: bind-time graph construction, execute-time task submission.
+
+`RemoteFunction.bind` / `ActorClass.bind` (installed onto the API types by
+this module's import in `ray_tpu/__init__`) return nodes; nested nodes in
+args are resolved depth-first at execute; function nodes submit tasks whose
+args are upstream ObjectRefs, so the graph runs fully distributed.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Any, Dict, List, Optional, Tuple
+
+_input_value = contextvars.ContextVar("dag_input", default=None)
+
+
+class DAGNode:
+    def execute(self, *input_args, **input_kwargs):
+        """Run the whole graph; returns the terminal node's result
+        (materialized)."""
+        from .. import api
+        token = _input_value.set((input_args, input_kwargs))
+        try:
+            cache: Dict[int, Any] = {}
+            out = self._resolve(cache)
+            from ..core.driver import ObjectRef
+            return api.get(out, timeout=600.0) \
+                if isinstance(out, ObjectRef) else out
+        finally:
+            _input_value.reset(token)
+
+    def _resolve(self, cache: Dict[int, Any]):
+        raise NotImplementedError
+
+    @staticmethod
+    def _resolve_args(args, kwargs, cache):
+        def rec(v):
+            if isinstance(v, DAGNode):
+                return v._resolve(cache)
+            if isinstance(v, (list, tuple)):
+                return type(v)(rec(x) for x in v)
+            if isinstance(v, dict):
+                return {k: rec(x) for k, x in v.items()}
+            return v
+
+        return ([rec(a) for a in args],
+                {k: rec(v) for k, v in kwargs.items()})
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input (reference:
+    `dag/input_node.py`); supports attribute/index access on the input."""
+
+    def __init__(self, key: Optional[Any] = None):
+        self._key = key
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return InputNode(key=name)
+
+    def __getitem__(self, idx):
+        return InputNode(key=idx)
+
+    def _resolve(self, cache):
+        args, kwargs = _input_value.get()
+        base = args[0] if args else kwargs
+        if self._key is None:
+            return base
+        if isinstance(self._key, str) and hasattr(base, self._key):
+            return getattr(base, self._key)
+        return base[self._key]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self._fn = remote_fn
+        self._args = args
+        self._kwargs = kwargs
+
+    def _resolve(self, cache):
+        if id(self) in cache:
+            return cache[id(self)]
+        args, kwargs = self._resolve_args(self._args, self._kwargs, cache)
+        ref = self._fn.remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+
+class ClassNode(DAGNode):
+    """A bound actor-constructor; method .bind() produces method nodes on
+    the SAME actor instance (created once per execute)."""
+
+    def __init__(self, actor_cls, args: tuple, kwargs: dict):
+        self._cls = actor_cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClassMethodBinder(self, name)
+
+    def _resolve(self, cache):
+        if id(self) in cache:
+            return cache[id(self)]
+        args, kwargs = self._resolve_args(self._args, self._kwargs, cache)
+        handle = self._cls.remote(*args, **kwargs)
+        cache[id(self)] = handle
+        return handle
+
+
+class _ClassMethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str,
+                 args: tuple, kwargs: dict):
+        self._class_node = class_node
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+
+    def _resolve(self, cache):
+        if id(self) in cache:
+            return cache[id(self)]
+        handle = self._class_node._resolve(cache)
+        args, kwargs = self._resolve_args(self._args, self._kwargs, cache)
+        ref = getattr(handle, self._method).remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+
+def install_bind():
+    """Add `.bind()` to RemoteFunction / ActorClass (the reference exposes
+    bind directly on remote decorables)."""
+    from .. import api
+
+    def fn_bind(self, *args, **kwargs):
+        return FunctionNode(self, args, kwargs)
+
+    def cls_bind(self, *args, **kwargs):
+        return ClassNode(self, args, kwargs)
+
+    api.RemoteFunction.bind = fn_bind
+    api.ActorClass.bind = cls_bind

@@ -1,0 +1,20 @@
+"""Durable workflows: DAG execution with persisted step results.
+
+Capability mirror of the reference's `python/ray/workflow/`
+(`workflow_executor.py:32`, `workflow_storage.py:229`, `api.py:120,232,468`
+— run/resume/resume_all/list_all/get_status with step-level durability):
+each step's result persists to storage on completion; resuming a crashed
+workflow skips finished steps and re-executes the rest.
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    resume_all,
+    run,
+)
+from .storage import WorkflowStorage  # noqa: F401

@@ -1,0 +1,163 @@
+"""Workflow execution API (reference: `workflow/api.py:120,232,468` +
+`workflow_executor.py:32`).
+
+Steps are the DAG's FunctionNodes, identified by a deterministic
+structural id; completed step results replay from storage on resume, so a
+crashed workflow re-executes only unfinished steps.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..core.serialization import dumps_function, loads_function
+from ..dag.node import ClassMethodNode, ClassNode, DAGNode, FunctionNode, \
+    InputNode
+from .storage import WorkflowStorage, get_base, list_workflow_ids, set_base
+
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+
+
+def init(storage_path: Optional[str] = None) -> None:
+    if storage_path:
+        set_base(storage_path)
+
+
+def _assign_step_ids(node: DAGNode, counter: List[int],
+                     ids: Dict[int, str]) -> None:
+    """Post-order deterministic ids: stable across identical DAG builds."""
+    if id(node) in ids:
+        return
+    children = []
+    if isinstance(node, (FunctionNode, ClassMethodNode, ClassNode)):
+        args = node._args
+        kwargs = node._kwargs
+        for v in list(args) + list(kwargs.values()):
+            if isinstance(v, DAGNode):
+                children.append(v)
+    if isinstance(node, ClassMethodNode):
+        children.append(node._class_node)
+    for c in children:
+        _assign_step_ids(c, counter, ids)
+    name = getattr(getattr(node, "_fn", None), "_name", None) or \
+        type(node).__name__
+    ids[id(node)] = f"step_{counter[0]:04d}_{name}"
+    counter[0] += 1
+
+
+class _DurableExecutor:
+    """Resolves the DAG like DAGNode.execute, but consults storage before
+    running a FunctionNode and persists results after."""
+
+    def __init__(self, storage: WorkflowStorage):
+        self.storage = storage
+
+    def execute(self, node: DAGNode) -> Any:
+        from .. import api
+        from ..core.driver import ObjectRef
+        ids: Dict[int, str] = {}
+        _assign_step_ids(node, [0], ids)
+        cache: Dict[int, Any] = {}
+        out = self._resolve(node, ids, cache)
+        return api.get(out, timeout=600.0) \
+            if isinstance(out, ObjectRef) else out
+
+    def _resolve(self, node: Any, ids, cache):
+        from .. import api
+        from ..core.driver import ObjectRef
+        if not isinstance(node, DAGNode):
+            return node
+        if id(node) in cache:
+            return cache[id(node)]
+        step_id = ids.get(id(node))
+        if isinstance(node, FunctionNode) and \
+                self.storage.has_step(step_id):
+            val = self.storage.load_step(step_id)
+            cache[id(node)] = val
+            return val
+        # resolve children then run
+        if isinstance(node, (FunctionNode, ClassMethodNode, ClassNode)):
+            args = [self._resolve(a, ids, cache) for a in node._args]
+            kwargs = {k: self._resolve(v, ids, cache)
+                      for k, v in node._kwargs.items()}
+            if isinstance(node, FunctionNode):
+                ref = node._fn.remote(*args, **kwargs)
+                val = api.get(ref, timeout=600.0)
+                self.storage.save_step(step_id, val)
+            elif isinstance(node, ClassNode):
+                val = node._cls.remote(*args, **kwargs)
+            else:  # ClassMethodNode — actor state isn't durable
+                handle = self._resolve(node._class_node, ids, cache)
+                val = api.get(getattr(handle, node._method)
+                              .remote(*args, **kwargs), timeout=600.0)
+                self.storage.save_step(step_id, val)
+            cache[id(node)] = val
+            return val
+        if isinstance(node, InputNode):
+            return node._resolve(cache)
+        raise TypeError(f"unsupported node {type(node)}")
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
+    """Execute durably; persists the DAG so `resume` can re-run it."""
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:8]}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_dag(dumps_function(dag))
+    storage.set_status(RUNNING)
+    try:
+        result = _DurableExecutor(storage).execute(dag)
+    except BaseException:
+        storage.set_status(FAILED)
+        raise
+    storage.save_output(result)
+    storage.set_status(SUCCESSFUL)
+    return result
+
+
+def resume(workflow_id: str) -> Any:
+    storage = WorkflowStorage(workflow_id)
+    if storage.has_output():
+        return storage.load_output()
+    dag = loads_function(storage.load_dag())
+    storage.set_status(RUNNING)
+    try:
+        result = _DurableExecutor(storage).execute(dag)
+    except BaseException:
+        storage.set_status(FAILED)
+        raise
+    storage.save_output(result)
+    storage.set_status(SUCCESSFUL)
+    return result
+
+
+def resume_all() -> Dict[str, Any]:
+    out = {}
+    for wid in list_workflow_ids():
+        st = WorkflowStorage(wid).get_status()
+        if st in (RUNNING, FAILED):
+            out[wid] = resume(wid)
+    return out
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return WorkflowStorage(workflow_id).get_status()
+
+
+def get_output(workflow_id: str) -> Any:
+    s = WorkflowStorage(workflow_id)
+    if not s.has_output():
+        raise ValueError(f"workflow {workflow_id} has no output yet")
+    return s.load_output()
+
+
+def list_all() -> List[tuple]:
+    return [(wid, WorkflowStorage(wid).get_status())
+            for wid in list_workflow_ids()]
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+    shutil.rmtree(WorkflowStorage(workflow_id).root, ignore_errors=True)

@@ -1,0 +1,93 @@
+"""Workflow persistence (reference: `workflow/workflow_storage.py:229`):
+filesystem layout  <base>/<workflow_id>/{dag.pkl, status, steps/<id>.pkl}.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_BASE = None
+
+
+def set_base(path: str) -> None:
+    global _DEFAULT_BASE
+    _DEFAULT_BASE = path
+    os.makedirs(path, exist_ok=True)
+
+
+def get_base() -> str:
+    global _DEFAULT_BASE
+    if _DEFAULT_BASE is None:
+        _DEFAULT_BASE = os.path.join(tempfile.gettempdir(),
+                                     "ray_tpu_workflows")
+        os.makedirs(_DEFAULT_BASE, exist_ok=True)
+    return _DEFAULT_BASE
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str, base: Optional[str] = None):
+        self.workflow_id = workflow_id
+        self.root = os.path.join(base or get_base(), workflow_id)
+        os.makedirs(os.path.join(self.root, "steps"), exist_ok=True)
+
+    # -- atomic file io -----------------------------------------------------
+    def _write(self, path: str, obj: Any) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, path)
+
+    def _read(self, path: str) -> Any:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    # -- dag ----------------------------------------------------------------
+    def save_dag(self, dag_blob: bytes) -> None:
+        self._write(os.path.join(self.root, "dag.pkl"), dag_blob)
+
+    def load_dag(self) -> bytes:
+        return self._read(os.path.join(self.root, "dag.pkl"))
+
+    # -- status -------------------------------------------------------------
+    def set_status(self, status: str) -> None:
+        self._write(os.path.join(self.root, "status"), status)
+
+    def get_status(self) -> Optional[str]:
+        p = os.path.join(self.root, "status")
+        return self._read(p) if os.path.exists(p) else None
+
+    # -- steps --------------------------------------------------------------
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.root, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def save_step(self, step_id: str, result: Any) -> None:
+        self._write(self._step_path(step_id), result)
+
+    def load_step(self, step_id: str) -> Any:
+        return self._read(self._step_path(step_id))
+
+    def list_steps(self) -> List[str]:
+        d = os.path.join(self.root, "steps")
+        return sorted(f[:-4] for f in os.listdir(d) if f.endswith(".pkl"))
+
+    # -- output -------------------------------------------------------------
+    def save_output(self, value: Any) -> None:
+        self._write(os.path.join(self.root, "output.pkl"), value)
+
+    def load_output(self) -> Any:
+        return self._read(os.path.join(self.root, "output.pkl"))
+
+    def has_output(self) -> bool:
+        return os.path.exists(os.path.join(self.root, "output.pkl"))
+
+
+def list_workflow_ids(base: Optional[str] = None) -> List[str]:
+    b = base or get_base()
+    return sorted(d for d in os.listdir(b)
+                  if os.path.isdir(os.path.join(b, d)))

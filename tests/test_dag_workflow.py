@@ -1,0 +1,110 @@
+"""DAG + workflow tests (reference model: `python/ray/dag/tests/`,
+`python/ray/workflow/tests/`)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_function_dag(cluster):
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def combine(x, y):
+        return x + y
+
+    with InputNode() as inp:
+        dag = combine.bind(a.bind(inp), b.bind(inp))
+    assert dag.execute(3) == (3 + 1) + (3 * 2)
+    assert dag.execute(10) == 31
+
+
+def test_actor_dag(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def add(self, x):
+            self.n += x
+            return self.n
+
+    with InputNode() as inp:
+        node = Counter.bind(5)
+        dag = node.add.bind(inp)
+    assert dag.execute(3) == 8
+
+
+def test_workflow_run_and_output(cluster, tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    dag = add.bind(double.bind(5), double.bind(7))
+    out = workflow.run(dag, workflow_id="w1")
+    assert out == 24
+    assert workflow.get_status("w1") == workflow.api.SUCCESSFUL
+    assert workflow.get_output("w1") == 24
+    assert ("w1", "SUCCESSFUL") in workflow.list_all()
+
+
+def test_workflow_resume_skips_done_steps(cluster, tmp_path):
+    workflow.init(str(tmp_path))
+    sentinel = str(tmp_path / "ran_marker")
+
+    @ray_tpu.remote
+    def step_one():
+        return 10
+
+    @ray_tpu.remote
+    def flaky(x, marker):
+        import os
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            raise RuntimeError("first attempt fails")
+        return x + 5
+
+    dag = flaky.bind(step_one.bind(), sentinel)
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == workflow.api.FAILED
+    out = workflow.resume("w2")
+    assert out == 15
+    assert workflow.get_status("w2") == workflow.api.SUCCESSFUL
+    # resume_all with everything done is a no-op
+    assert workflow.resume_all() == {}
+
+
+def test_workflow_delete(cluster, tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="w3")
+    workflow.delete("w3")
+    assert all(wid != "w3" for wid, _ in workflow.list_all())
