@@ -1,0 +1,13 @@
+"""Autoscaler: node-count reconciliation against resource demand.
+
+Capability mirror of the reference's `StandardAutoscaler`
+(`python/ray/autoscaler/_private/autoscaler.py:166,357` — read load →
+bin-pack demands → `NodeProvider` launch/terminate) with the TPU twist
+that node types describe slices (a provider node = a TPU host with its
+chips).  `LocalNodeProvider` boots real nodelet processes, so scaling
+behavior is testable on one machine (the reference's fake_multi_node
+strategy).
+"""
+
+from .autoscaler import StandardAutoscaler, request_resources  # noqa: F401
+from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401

@@ -1,0 +1,121 @@
+"""The reconciliation loop.
+
+Capability mirror of the reference's `StandardAutoscaler.update`
+(`autoscaler.py:166,357`) + `ResourceDemandScheduler.get_nodes_to_launch`
+(`resource_demand_scheduler.py:103,171`): demands (explicit
+`request_resources` bundles + unplaceable-shortfall heuristics) bin-pack
+onto node types; idle nodes terminate after a timeout.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from .node_provider import NodeProvider
+
+_pending_requests: List[Dict[str, float]] = []
+
+
+def request_resources(bundles: List[Dict[str, float]]) -> None:
+    """Explicit demand hint (reference:
+    `ray.autoscaler.sdk.request_resources`)."""
+    _pending_requests.clear()
+    _pending_requests.extend(dict(b) for b in bundles)
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, *,
+                 max_workers: int = 8,
+                 idle_timeout_s: float = 30.0,
+                 upscale_headroom: float = 0.0,
+                 state_source=None):
+        """``state_source``: callable returning the node table (defaults to
+        `ray_tpu.state.list_nodes` on the connected cluster)."""
+        self.provider = provider
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.upscale_headroom = upscale_headroom
+        self._idle_since: Dict[str, float] = {}
+        self._state_source = state_source
+
+    def _nodes(self) -> List[Dict[str, Any]]:
+        if self._state_source is not None:
+            return self._state_source()
+        from .. import state
+        return state.list_nodes()
+
+    @staticmethod
+    def _fits(bundle: Dict[str, float],
+              avail: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) >= v for k, v in bundle.items())
+
+    def _nodes_to_launch(self, alive: List[Dict[str, Any]]
+                         ) -> Dict[str, int]:
+        """Bin-pack outstanding demand bundles onto existing free capacity;
+        whatever doesn't fit maps to new nodes by type."""
+        free = [dict(n.get("avail", {})) for n in alive]
+        launch: Dict[str, int] = {}
+        pending_caps: List[Dict[str, float]] = []
+        for bundle in list(_pending_requests):
+            placed = False
+            for cap in free + pending_caps:
+                if self._fits(bundle, cap):
+                    for k, v in bundle.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            # need a new node: first type that can hold the bundle
+            for ntype in self.provider.node_types:
+                cap = self.provider.node_resources(ntype)
+                if self._fits(bundle, cap):
+                    for k, v in bundle.items():
+                        cap[k] -= v
+                    pending_caps.append(cap)
+                    launch[ntype] = launch.get(ntype, 0) + 1
+                    break
+        return launch
+
+    def update(self) -> Dict[str, Any]:
+        """One reconciliation step; returns a summary of actions."""
+        nodes = self._nodes()
+        alive = [n for n in nodes if n.get("alive")]
+        actions = {"launched": [], "terminated": []}
+
+        current_workers = len(self.provider.non_terminated_nodes())
+        for ntype, count in self._nodes_to_launch(alive).items():
+            for _ in range(count):
+                if current_workers >= self.max_workers:
+                    break
+                actions["launched"].append(
+                    self.provider.create_node(ntype))
+                current_workers += 1
+        if actions["launched"]:
+            _pending_requests.clear()
+
+        # idle downscaling: a provider node whose avail == total for longer
+        # than idle_timeout_s terminates
+        now = time.monotonic()
+        provider_ids = set(self.provider.non_terminated_nodes())
+        for n in alive:
+            nid = n.get("id")
+            if nid not in provider_ids:
+                continue  # not ours (e.g. the head node)
+            idle = n.get("avail") == n.get("total")
+            if not idle:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            if now - first >= self.idle_timeout_s:
+                self.provider.terminate_node(nid)
+                actions["terminated"].append(nid)
+                self._idle_since.pop(nid, None)
+        return actions
+
+    def run(self, interval_s: float = 5.0, stop_event=None) -> None:
+        """The monitor loop (reference: `monitor.py:126`)."""
+        while stop_event is None or not stop_event.is_set():
+            self.update()
+            time.sleep(interval_s)

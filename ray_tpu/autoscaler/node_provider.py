@@ -1,0 +1,71 @@
+"""Node providers (reference: `python/ray/autoscaler/node_provider.py` +
+`_private/fake_multi_node/node_provider.py`)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract: launch/terminate/list."""
+
+    def create_node(self, node_type: str) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_resources(self, node_type: str) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns real nodelet processes on this machine (the fake-multi-node
+    equivalent): scaling tests exercise the actual control plane."""
+
+    def __init__(self, session_dir: str, controller_addr: str,
+                 node_types: Optional[Dict[str, Dict[str, float]]] = None,
+                 object_store_memory: int = 64 * 1024 * 1024):
+        self.session_dir = session_dir
+        self.controller_addr = controller_addr
+        self.node_types = node_types or {
+            "cpu_worker": {"CPU": 2.0},
+        }
+        self.object_store_memory = object_store_memory
+        self._nodes: Dict[str, Any] = {}
+
+    def node_resources(self, node_type: str) -> Dict[str, float]:
+        return dict(self.node_types[node_type])
+
+    def create_node(self, node_type: str) -> str:
+        from ..core import node as node_mod
+        handle, addr, node_id, store = node_mod.start_nodelet(
+            self.session_dir, self.controller_addr,
+            self.node_resources(node_type), self.object_store_memory)
+        self._nodes[node_id] = (handle, store, node_type)
+        return node_id
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        entry = self._nodes.pop(provider_node_id, None)
+        if entry is None:
+            return
+        handle, store, _ = entry
+        try:
+            handle.kill()
+        except Exception:
+            pass
+        import os
+        try:
+            os.unlink(store)
+        except OSError:
+            pass
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [nid for nid, (h, _, _) in self._nodes.items() if h.alive()]
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        entry = self._nodes.get(node_id)
+        return entry[2] if entry else None

@@ -1,0 +1,79 @@
+"""Core-op microbenchmarks.
+
+Capability mirror of the reference's `python/ray/_private/ray_perf.py:93-150`
+(`ray microbenchmark` CLI): per-op throughput for tasks, actor calls, puts
+and gets on a live cluster.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def _rate(fn: Callable[[], int], min_time: float = 1.0) -> float:
+    """ops/s: run batches until min_time elapsed."""
+    fn()  # warmup
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < min_time:
+        n += fn()
+    return n / (time.perf_counter() - t0)
+
+
+def run_microbenchmarks(min_time: float = 1.0) -> Dict[str, float]:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    class Actor:
+        def noop(self):
+            return None
+
+    results: Dict[str, float] = {}
+
+    def tasks_batch():
+        ray_tpu.get([noop.remote() for _ in range(100)], timeout=60.0)
+        return 100
+
+    results["tasks_per_s"] = _rate(tasks_batch, min_time)
+
+    actor = Actor.remote()
+
+    def actor_batch():
+        ray_tpu.get([actor.noop.remote() for _ in range(100)],
+                    timeout=60.0)
+        return 100
+
+    results["actor_calls_per_s"] = _rate(actor_batch, min_time)
+
+    small = b"x" * 1024
+
+    def put_batch():
+        [ray_tpu.put(small) for _ in range(100)]
+        return 100
+
+    results["put_1kb_per_s"] = _rate(put_batch, min_time)
+
+    big = np.zeros(8 * 1024 * 1024, dtype=np.uint8)  # 8 MiB
+
+    def put_big():
+        ref = ray_tpu.put(big)
+        ray_tpu.get(ref, timeout=60.0)
+        return 1
+
+    rt = _rate(put_big, min_time)
+    results["put_get_roundtrip_GBps"] = rt * big.nbytes / 1e9
+
+    def get_many():
+        refs = [ray_tpu.put(small) for _ in range(100)]
+        ray_tpu.get(refs, timeout=60.0)
+        return 100
+
+    results["get_1kb_per_s"] = _rate(get_many, min_time)
+    return results

@@ -1,0 +1,201 @@
+"""Command-line interface.
+
+Capability mirror of the reference's CLI
+(`python/ray/scripts/scripts.py:529,974,...` — start/stop/status/list/
+submit/logs/timeline/microbenchmark).  Usage: ``python -m ray_tpu.scripts.cli
+<command>`` (or the ``ray-tpu`` alias once on PATH).
+
+Cluster address plumbing: ``start --head`` writes
+``/tmp/ray_tpu_head.json`` (controller + nodelet address); client commands
+read it, or take ``--address host:port``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_HEAD_FILE = os.path.join(tempfile.gettempdir(), "ray_tpu_head.json")
+
+
+def _connect(args) -> None:
+    import ray_tpu
+    if getattr(args, "address", None):
+        ray_tpu.init(address=args.address)
+        return
+    if os.path.exists(_HEAD_FILE):
+        with open(_HEAD_FILE) as f:
+            head = json.load(f)
+        os.environ["RAY_TPU_SESSION_DIR"] = head["session_dir"]
+        ray_tpu.init(address=head["controller"],
+                     nodelet_addr=head["nodelet"])
+        return
+    ray_tpu.init()
+
+
+def cmd_start(args) -> None:
+    from ray_tpu.core import node as node_mod
+    if not args.head and not args.address:
+        sys.exit("either --head or --address required")
+    if args.head:
+        session_dir = node_mod.new_session_dir()
+        _, controller_addr = node_mod.start_controller(session_dir)
+        resources = {"CPU": float(args.num_cpus)}
+        if args.num_tpus:
+            resources["TPU"] = float(args.num_tpus)
+        _, nodelet_addr, node_id, _ = node_mod.start_nodelet(
+            session_dir, controller_addr, resources,
+            args.object_store_memory)
+        with open(_HEAD_FILE, "w") as f:
+            json.dump({"controller": controller_addr,
+                       "nodelet": nodelet_addr,
+                       "session_dir": session_dir}, f)
+        print(f"head started: controller={controller_addr} "
+              f"nodelet={nodelet_addr}")
+        print(f"connect with: ray_tpu.init(address={controller_addr!r})")
+    else:
+        with open(_HEAD_FILE) as f:
+            head = json.load(f)
+        resources = {"CPU": float(args.num_cpus)}
+        if args.num_tpus:
+            resources["TPU"] = float(args.num_tpus)
+        _, addr, node_id, _ = node_mod.start_nodelet(
+            head["session_dir"], args.address or head["controller"],
+            resources, args.object_store_memory)
+        print(f"node {node_id} joined at {addr}")
+
+
+def cmd_stop(args) -> None:
+    import signal
+    import subprocess
+    # kill controller/nodelet/worker processes of the local session
+    out = subprocess.run(
+        ["pkill", "-f", "ray_tpu.core.(controller|nodelet|worker)_main"],
+        capture_output=True)
+    if os.path.exists(_HEAD_FILE):
+        os.unlink(_HEAD_FILE)
+    print("stopped" if out.returncode in (0, 1) else "pkill failed")
+
+
+def cmd_status(args) -> None:
+    import ray_tpu
+    from ray_tpu import state
+    _connect(args)
+    summary = state.cluster_summary()
+    print(json.dumps(summary, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_list(args) -> None:
+    import ray_tpu
+    from ray_tpu import state
+    _connect(args)
+    fn = {"nodes": state.list_nodes, "actors": state.list_actors,
+          "placement-groups": state.list_placement_groups,
+          "jobs": state.list_jobs}[args.kind]
+    print(json.dumps(fn(), indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_submit(args) -> None:
+    import ray_tpu
+    from ray_tpu import jobs
+    _connect(args)
+    job_id = jobs.submit_job(" ".join(args.entrypoint))
+    print(f"submitted {job_id}")
+    if args.wait:
+        status = jobs.wait_job(job_id, timeout_s=args.timeout)
+        print(jobs.get_job_logs(job_id), end="")
+        print(f"job {job_id}: {status}")
+        ray_tpu.shutdown()
+        sys.exit(0 if status == jobs.SUCCEEDED else 1)
+    ray_tpu.shutdown()
+
+
+def cmd_logs(args) -> None:
+    import ray_tpu
+    from ray_tpu import jobs
+    _connect(args)
+    print(jobs.get_job_logs(args.job_id), end="")
+    ray_tpu.shutdown()
+
+
+def cmd_timeline(args) -> None:
+    import ray_tpu
+    _connect(args)
+    events = ray_tpu.timeline()
+    path = args.output or "timeline.json"
+    with open(path, "w") as f:
+        json.dump(events, f)
+    print(f"{len(events)} events -> {path}")
+    ray_tpu.shutdown()
+
+
+def cmd_microbenchmark(args) -> None:
+    import ray_tpu
+    from ray_tpu.microbenchmark import run_microbenchmarks
+    ray_tpu.init(num_cpus=args.num_cpus)
+    results = run_microbenchmarks(min_time=args.min_time)
+    for k, v in results.items():
+        print(f"{k}: {v:,.1f}")
+    ray_tpu.shutdown()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start head or join a cluster")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address")
+    sp.add_argument("--num-cpus", type=float, default=os.cpu_count() or 4)
+    sp.add_argument("--num-tpus", type=float, default=0)
+    sp.add_argument("--object-store-memory", type=int,
+                    default=256 * 1024 * 1024)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop local cluster processes")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster summary")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["nodes", "actors",
+                                     "placement-groups", "jobs"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("submit", help="submit a job entrypoint")
+    sp.add_argument("--address")
+    sp.add_argument("--wait", action="store_true")
+    sp.add_argument("--timeout", type=float, default=300.0)
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("logs", help="fetch job logs")
+    sp.add_argument("job_id")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("timeline", help="dump chrome trace")
+    sp.add_argument("--address")
+    sp.add_argument("-o", "--output")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("microbenchmark", help="core op throughput")
+    sp.add_argument("--num-cpus", type=float, default=4)
+    sp.add_argument("--min-time", type=float, default=1.0)
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

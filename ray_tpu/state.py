@@ -1,0 +1,57 @@
+"""State observability API.
+
+Capability mirror of the reference's state API (`ray list actors/tasks/...`,
+`python/ray/experimental/state/api.py:112,729,1269`, aggregator
+`dashboard/state_aggregator.py`) — reads cluster state from the controller.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .api import _ensure_initialized
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _ensure_initialized().controller.call("list_nodes")
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return _ensure_initialized().controller.call("list_actors")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _ensure_initialized().controller.call("list_placement_groups")
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    from . import jobs
+    return jobs.list_jobs()
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for a in list_actors():
+        key = a.get("state", "UNKNOWN")
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def summarize_nodes() -> Dict[str, Any]:
+    ns = list_nodes()
+    return {
+        "total": len(ns),
+        "alive": sum(1 for n in ns if n.get("alive")),
+        "resources": {
+            k: sum(n["total"].get(k, 0) for n in ns if n.get("alive"))
+            for n in ns for k in n.get("total", {})
+        } if ns else {},
+    }
+
+
+def cluster_summary() -> Dict[str, Any]:
+    return {
+        "nodes": summarize_nodes(),
+        "actors": summarize_actors(),
+        "placement_groups": len(list_placement_groups()),
+    }
