@@ -1,0 +1,72 @@
+"""Per-task/actor runtime environments.
+
+Capability mirror of the reference's runtime-env plugins
+(`python/ray/_private/runtime_env/` — env_vars, working_dir, py_modules;
+agent handler `dashboard/modules/runtime_env/runtime_env_agent.py:160`).
+This image forbids package installation, so pip/conda specs validate but
+raise; env_vars / working_dir / py_modules apply in-worker.  Tasks restore
+the previous environment afterwards; actors keep theirs for life (the
+reference dedicates workers per env hash — same observable behavior).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Any, Dict
+
+SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+
+
+def validate(env: Dict[str, Any]) -> None:
+    unknown = set(env) - SUPPORTED
+    if unknown:
+        raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
+    if env.get("pip") or env.get("conda"):
+        raise RuntimeError(
+            "pip/conda runtime envs require package installation, which "
+            "this deployment forbids; pre-bake dependencies in the image")
+
+
+def apply(env: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply; returns an undo record for `restore`."""
+    validate(env)
+    undo: Dict[str, Any] = {"env_vars": {}, "cwd": None, "sys_path": None}
+    for k, v in (env.get("env_vars") or {}).items():
+        undo["env_vars"][k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    wd = env.get("working_dir")
+    if wd:
+        undo["cwd"] = os.getcwd()
+        os.chdir(wd)
+    mods = env.get("py_modules")
+    if mods:
+        undo["sys_path"] = list(sys.path)
+        for m in mods:
+            sys.path.insert(0, m)
+    return undo
+
+
+def restore(undo: Dict[str, Any]) -> None:
+    for k, old in undo["env_vars"].items():
+        if old is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = old
+    if undo["cwd"] is not None:
+        os.chdir(undo["cwd"])
+    if undo["sys_path"] is not None:
+        sys.path[:] = undo["sys_path"]
+
+
+@contextlib.contextmanager
+def applied(env: Dict[str, Any]):
+    if not env:
+        yield
+        return
+    undo = apply(env)
+    try:
+        yield
+    finally:
+        restore(undo)

@@ -163,8 +163,19 @@ class WorkerRuntime:
         # pin-while-mapped semantics).
         try:
             args, kwargs, _views = await self._resolve_args(spec)
-            result = await self._loop.run_in_executor(
-                self.executor, self._run_user_code, fn, args, kwargs)
+            renv = spec.runtime_env
+            if renv:
+                from . import runtime_env as _renv
+
+                def run_in_env(fn=fn, args=args, kwargs=kwargs):
+                    with _renv.applied(renv):
+                        return self._run_user_code(fn, args, kwargs)
+
+                result = await self._loop.run_in_executor(
+                    self.executor, run_in_env)
+            else:
+                result = await self._loop.run_in_executor(
+                    self.executor, self._run_user_code, fn, args, kwargs)
             returns = await self._store_returns(spec, result)
             return {"returns": returns}
         except Exception as e:
@@ -197,6 +208,9 @@ class WorkerRuntime:
         try:
             cls = await self._get_function(spec.function_id)
             args, kwargs, _ = await self._resolve_args(spec)
+            if spec.runtime_env:
+                from . import runtime_env as _renv
+                _renv.apply(spec.runtime_env)  # actor keeps env for life
             self.actor_instance = await self._loop.run_in_executor(
                 self.executor, lambda: cls(*args, **kwargs))
             self.actor_id = spec.actor_creation_id.binary()

@@ -1,0 +1,9 @@
+"""Dashboard: REST head exposing cluster state.
+
+Capability mirror of the reference's `dashboard/head.py` + modules
+(`dashboard/modules/{node,actor,job,reporter,metrics}`): an aiohttp app
+serving the state API, job submission, and Prometheus metrics over HTTP.
+The TS frontend is out of scope; the API surface matches what it consumes.
+"""
+
+from .head import DashboardHead, start_dashboard  # noqa: F401

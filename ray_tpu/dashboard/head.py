@@ -1,0 +1,128 @@
+"""The dashboard head server (reference: `dashboard/head.py` aiohttp app;
+job endpoints mirror `dashboard/modules/job/job_head.py`)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._host = host
+        self._port = port
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        self._ready = threading.Event()
+        self._error: Optional[str] = None
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._ready.wait(timeout=15.0)
+        if self._error:
+            raise RuntimeError(self._error)
+
+    @property
+    def address(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        def blocking(fn):
+            async def handler(request):
+                try:
+                    body = await loop.run_in_executor(
+                        self._pool, fn, request)
+                except Exception as e:
+                    return web.json_response({"error": str(e)}, status=500)
+                if isinstance(body, str):
+                    return web.Response(text=body)
+                return web.json_response(body)
+            return handler
+
+        def nodes(_):
+            from .. import state
+            return state.list_nodes()
+
+        def actors(_):
+            from .. import state
+            return state.list_actors()
+
+        def pgs(_):
+            from .. import state
+            return state.list_placement_groups()
+
+        def summary(_):
+            from .. import state
+            return state.cluster_summary()
+
+        def jobs_list(_):
+            from .. import jobs
+            return jobs.list_jobs()
+
+        def job_submit(request):
+            from .. import jobs
+            # aiohttp request.read() is async; run here via the loop
+            raw = asyncio.run_coroutine_threadsafe(
+                request.read(), loop).result(timeout=10)
+            payload = json.loads(raw or b"{}")
+            job_id = jobs.submit_job(
+                payload["entrypoint"],
+                runtime_env=payload.get("runtime_env"))
+            return {"job_id": job_id}
+
+        def job_status(request):
+            from .. import jobs
+            jid = request.match_info["job_id"]
+            info = jobs.get_job_info(jid)
+            if info is None:
+                raise ValueError(f"unknown job {jid}")
+            return info
+
+        def job_logs(request):
+            from .. import jobs
+            return jobs.get_job_logs(request.match_info["job_id"])
+
+        def metrics_text(_):
+            from .. import metrics
+            return metrics.prometheus_text()
+
+        app = web.Application()
+        app.router.add_get("/api/nodes", blocking(nodes))
+        app.router.add_get("/api/actors", blocking(actors))
+        app.router.add_get("/api/placement_groups", blocking(pgs))
+        app.router.add_get("/api/cluster_summary", blocking(summary))
+        app.router.add_get("/api/jobs", blocking(jobs_list))
+        app.router.add_post("/api/jobs", blocking(job_submit))
+        app.router.add_get("/api/jobs/{job_id}", blocking(job_status))
+        app.router.add_get("/api/jobs/{job_id}/logs", blocking(job_logs))
+        app.router.add_get("/metrics", blocking(metrics_text))
+        app.router.add_get(
+            "/api/version",
+            blocking(lambda _: {"ray_tpu": __import__(
+                "ray_tpu").__version__}))
+
+        runner = web.AppRunner(app)
+
+        async def start():
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._port)
+            try:
+                await site.start()
+            except OSError as e:
+                self._error = str(e)
+            self._ready.set()
+
+        loop.run_until_complete(start())
+        if not self._error:
+            loop.run_forever()
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 8265) -> DashboardHead:
+    return DashboardHead(host, port)

@@ -1,0 +1,113 @@
+"""Dashboard REST API + runtime env tests."""
+
+import json
+import sys
+
+import pytest
+import requests
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def dashboard(cluster):
+    import socket
+
+    from ray_tpu.dashboard import start_dashboard
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    return start_dashboard(port=port)
+
+
+def test_dashboard_state_endpoints(dashboard):
+    addr = dashboard.address
+    nodes = requests.get(f"{addr}/api/nodes", timeout=10).json()
+    assert nodes and nodes[0]["alive"]
+    summary = requests.get(f"{addr}/api/cluster_summary",
+                           timeout=10).json()
+    assert summary["nodes"]["alive"] >= 1
+    assert "ray_tpu" in requests.get(f"{addr}/api/version",
+                                     timeout=10).json()
+    assert requests.get(f"{addr}/metrics", timeout=10).status_code == 200
+
+
+def test_dashboard_job_flow(dashboard):
+    addr = dashboard.address
+    r = requests.post(f"{addr}/api/jobs", json={
+        "entrypoint": f"{sys.executable} -c \"print('dash job ok')\""},
+        timeout=30)
+    job_id = r.json()["job_id"]
+    import time
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        info = requests.get(f"{addr}/api/jobs/{job_id}", timeout=10).json()
+        if info["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert info["status"] == "SUCCEEDED"
+    logs = requests.get(f"{addr}/api/jobs/{job_id}/logs", timeout=10).text
+    assert "dash job ok" in logs
+    listed = requests.get(f"{addr}/api/jobs", timeout=10).json()
+    assert any(j["job_id"] == job_id for j in listed)
+
+
+def test_runtime_env_env_vars(cluster):
+    @ray_tpu.remote
+    def read_env():
+        import os
+        return os.environ.get("MY_RT_VAR")
+
+    val = ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"MY_RT_VAR": "42"}}).remote(),
+        timeout=60.0)
+    assert val == "42"
+    # a plain task on the same (possibly reused) worker must NOT see it
+    assert ray_tpu.get(read_env.remote(), timeout=60.0) is None
+
+
+def test_runtime_env_working_dir_and_modules(cluster, tmp_path):
+    pkg = tmp_path / "my_rt_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 'xyz'\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import my_rt_pkg
+        import os
+        return my_rt_pkg.MAGIC, os.getcwd()
+
+    magic, cwd = ray_tpu.get(use_module.options(runtime_env={
+        "py_modules": [str(tmp_path)],
+        "working_dir": str(tmp_path)}).remote(), timeout=60.0)
+    assert magic == "xyz"
+    assert cwd == str(tmp_path)
+
+
+def test_runtime_env_actor_keeps_env(cluster):
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self):
+            import os
+            return os.environ.get("ACTOR_VAR")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"ACTOR_VAR": "life"}}).remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60.0) == "life"
+
+
+def test_runtime_env_pip_rejected(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(f.options(
+            runtime_env={"pip": ["requests"]}).remote(), timeout=60.0)
