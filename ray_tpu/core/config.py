@@ -113,6 +113,8 @@ _d("inline_small_args_bytes", int, 64 * 1024,
    "Task args at or below this size are inlined into the task spec.")
 _d("log_to_driver", bool, True, "Forward worker stdout/stderr lines to the driver.")
 _d("metrics_report_interval_s", float, 2.0, "Worker metric push period.")
+_d("lineage_cache_size", int, 100000,
+   "Task specs retained per driver for lineage reconstruction.")
 
 # --- TPU / accelerator ------------------------------------------------------
 _d("tpu_autodetect", bool, True, "Detect local TPU chips via JAX at node start.")

@@ -19,11 +19,11 @@ import asyncio
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import exceptions
-from . import rpc, serialization
+from . import rpc, serialization, spill
 from .config import GlobalConfig
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .memory_store import IN_PLASMA, MemoryStore
@@ -148,6 +148,9 @@ class CoreClient:
         self._worker_conns: Dict[str, rpc.Connection] = {}
         self._nodelet_conns: Dict[str, rpc.Connection] = {}
         self._closed = False
+        self._lineage: "OrderedDict[bytes, TaskSpec]" = OrderedDict()
+        self._put_pins: set = set()  # owner pins of put() primary copies
+        self._spilled_paths: Dict[bytes, str] = {}
         if mode == "driver":
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
@@ -172,6 +175,14 @@ class CoreClient:
             plasma = oid in self._plasma_oids
             self._plasma_oids.discard(oid)
         self.memory_store.delete([oid])
+        with self._ref_lock:
+            put_pinned = oid in self._put_pins
+            self._put_pins.discard(oid)
+        if put_pinned:
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
         # NB: the shared-memory pin (self._pinned) is NOT dropped here — it is
         # tied to the lifetime of the deserialized value (weakref finalizer in
         # _get_plasma), because zero-copy numpy views alias store memory.
@@ -197,12 +208,27 @@ class CoreClient:
         if size <= GlobalConfig.max_direct_call_object_size:
             self.memory_store.put(oid.binary(), b"".join(bytes(p) for p in parts))
         else:
-            self.store.put_parts(oid.binary(), parts)
-            self.nodelet.call("put_location",
-                              {"object_id": oid.binary(), "size": size})
+            try:
+                self.store.put_parts(oid.binary(), parts)
+                # pin the primary copy so LRU eviction can't drop an owned
+                # object (reference: raylet pins primary copies; spilling,
+                # not eviction, reclaims them)
+                if self.store.get(oid.binary(), timeout_ms=0) is not None:
+                    with self._ref_lock:
+                        self._put_pins.add(oid.binary())
+                self.nodelet.call("put_location",
+                                  {"object_id": oid.binary(), "size": size})
+                with self._ref_lock:
+                    self._plasma_oids.add(oid.binary())
+            except store_client.StoreFullError:
+                # spill to external storage (reference: plasma → spill
+                # workers → ExternalStorage; here the writer spills inline)
+                path = spill.write_object(oid.binary(), parts)
+                self.controller.call(
+                    "kv_put", {**spill.kv_entry(oid.binary()),
+                               "value": path.encode()})
+                self._spilled_paths[oid.binary()] = path
             self.memory_store.put_in_plasma_marker(oid.binary())
-            with self._ref_lock:
-                self._plasma_oids.add(oid.binary())
         return ObjectRef(oid, self)
 
     # ------------------------------------------------------------------- get
@@ -228,10 +254,16 @@ class CoreClient:
     def _get_plasma(self, oid: bytes, timeout: Optional[float]) -> Any:
         view = self.store.get(oid, timeout_ms=0)
         if view is None:
+            spilled = self._read_spilled(oid)
+            if spilled is not None:
+                value = serialization.deserialize(memoryview(spilled))
+                if isinstance(value, _ErrorValue):
+                    raise value.unwrap()
+                return value
             r = self.nodelet.call("pull", {"object_id": oid,
                                            "timeout": timeout or 60.0},
                                   timeout=(timeout or 60.0) + 10)
-            if not r.get("ok"):
+            if not r.get("ok") and not self._reconstruct(oid, timeout):
                 raise exceptions.ObjectLostError(oid.hex(), r.get("error", ""))
             view = self.store.get(oid, timeout_ms=10000)
             if view is None:
@@ -249,6 +281,35 @@ class CoreClient:
         # weakref-able, else keep it pinned for the client's lifetime.
         self._tie_pin_to_value(oid, value)
         return value
+
+    def _read_spilled(self, oid: bytes) -> Optional[bytes]:
+        path = self._spilled_paths.get(oid)
+        if path is None:
+            raw = self.controller.call("kv_get", spill.kv_entry(oid))
+            if not raw:
+                return None
+            path = raw.decode()
+        return spill.read_file(path)
+
+    def _reconstruct(self, oid: bytes, timeout: Optional[float]) -> bool:
+        """Lineage reconstruction (reference:
+        `object_recovery_manager.h:96-106`): resubmit the task that created
+        the lost object and wait for it to land back in the store.  First
+        cut: one level (arguments must still be reachable)."""
+        spec = self._lineage.get(oid)
+        if spec is None:
+            return False
+        self.lt.spawn(self._submit_pipeline(spec, spec.max_retries))
+        deadline = time.monotonic() + (timeout or 60.0)
+        while time.monotonic() < deadline:
+            if self.store.contains(oid):
+                return True
+            r = self.nodelet.call("pull", {"object_id": oid,
+                                           "timeout": 1.0}, timeout=11)
+            if r.get("ok"):
+                return True
+            time.sleep(0.2)
+        return False
 
     def _tie_pin_to_value(self, oid: bytes, value: Any):
         import weakref
@@ -315,6 +376,11 @@ class CoreClient:
             for oid in spec.return_ids():
                 self._owned.add(oid.binary())
         refs = [ObjectRef(oid, self) for oid in spec.return_ids()]
+        if spec.actor_creation_id is None and spec.actor_id is None:
+            for oid in spec.return_ids():
+                self._lineage[oid.binary()] = spec
+            while len(self._lineage) > GlobalConfig.lineage_cache_size:
+                self._lineage.popitem(last=False)
         for oid in spec.arg_ref_ids():
             self._add_local_ref(oid.binary())  # pin args until task completes
         del temp_refs  # spilled-arg refs are now pinned; drop the temporaries

@@ -1,0 +1,57 @@
+"""Object spilling to external storage.
+
+Capability mirror of the reference's spill pipeline (plasma → dedicated
+spill workers → `ExternalStorage` filesystem backend,
+`python/ray/_private/external_storage.py:72,246`; orchestrated by
+`src/ray/raylet/local_object_manager.cc`).  Simplified topology: the
+process that hits `StoreFullError` writes the serialized object to the
+session spill directory itself and registers the location in the
+controller KV, so any node can restore it (shared-fs or single-machine
+sessions; a remote-read RPC slots in for multi-host without changing
+callers).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+_NS = "spill"
+
+
+def spill_root() -> str:
+    base = os.environ.get("RAY_TPU_SESSION_DIR") or tempfile.gettempdir()
+    path = os.path.join(base, "spill")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def write_object(oid: bytes, parts: List[memoryview]) -> str:
+    """Write serialized parts to a spill file; returns the path."""
+    path = os.path.join(spill_root(), oid.hex())
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        for p in parts:
+            f.write(bytes(p))
+    os.replace(tmp, path)
+    return path
+
+
+def kv_entry(oid: bytes) -> dict:
+    return {"ns": _NS, "key": oid}
+
+
+def read_file(path: str) -> Optional[bytes]:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except FileNotFoundError:
+        return None
+
+
+def delete_file(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass

@@ -103,6 +103,14 @@ class WorkerRuntime:
                 if view is not None and oid in self._pinned_args:
                     self.store.release(oid)  # one pin per object is enough
                 if view is None:
+                    spilled = await self._read_spilled(oid)
+                    if spilled is not None:
+                        value = serialization.deserialize(
+                            memoryview(spilled))
+                        if isinstance(value, _ErrorValue):
+                            raise value.unwrap(spec.function_name)
+                        flat.append(value)
+                        continue
                     r = await self.nodelet.call("pull", {"object_id": oid},
                                                 timeout=60)
                     if not r.get("ok"):
@@ -119,6 +127,13 @@ class WorkerRuntime:
         # Last element is the kwargs dict marker produced by the submitter.
         *args, kwargs = flat
         return args, kwargs, views
+
+    async def _read_spilled(self, oid: bytes):
+        from . import spill
+        raw = await self.controller.call("kv_get", spill.kv_entry(oid))
+        if not raw:
+            return None
+        return spill.read_file(raw.decode())
 
     async def _get_function(self, fid: bytes):
         fn = self.fn_cache.get(fid)
@@ -145,9 +160,16 @@ class WorkerRuntime:
                 out.append({"inline": b"".join(bytes(p) for p in parts)})
             else:
                 oid = spec.return_ids()[i].binary()
-                self.store.put_parts(oid, parts)
-                await self.nodelet.call("put_location",
-                                        {"object_id": oid, "size": size})
+                try:
+                    self.store.put_parts(oid, parts)
+                    await self.nodelet.call("put_location",
+                                            {"object_id": oid, "size": size})
+                except store_client.StoreFullError:
+                    from . import spill
+                    path = spill.write_object(oid, parts)
+                    await self.controller.call(
+                        "kv_put", {**spill.kv_entry(oid),
+                                   "value": path.encode()})
                 out.append({"plasma": size})
         return out
 

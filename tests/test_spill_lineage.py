@@ -1,0 +1,86 @@
+"""Object spilling + lineage reconstruction tests (reference model:
+`python/ray/tests/test_object_spilling.py`, `test_reconstruction.py`)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_put_spills_when_store_full():
+    """Objects beyond store capacity spill to disk and stay gettable."""
+    ray_tpu.init(num_cpus=2, object_store_memory=16 * 1024 * 1024)
+    try:
+        blobs = [np.full(4 * 1024 * 1024, i, dtype=np.uint8)
+                 for i in range(8)]  # 32 MiB total > 16 MiB store
+        refs = [ray_tpu.put(b) for b in blobs]
+        for i, r in enumerate(refs):
+            out = ray_tpu.get(r, timeout=60.0)
+            assert out[0] == i and out.nbytes == 4 * 1024 * 1024
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_spilled_object_as_task_arg():
+    ray_tpu.init(num_cpus=2, object_store_memory=16 * 1024 * 1024)
+    try:
+        refs = [ray_tpu.put(np.full(4 * 1024 * 1024, i, dtype=np.uint8))
+                for i in range(8)]
+
+        @ray_tpu.remote
+        def head(arr):
+            return int(arr[0])
+
+        assert ray_tpu.get([head.remote(r) for r in refs],
+                           timeout=120.0) == list(range(8))
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_task_returns_spill():
+    ray_tpu.init(num_cpus=2, object_store_memory=16 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def make(i):
+            return np.full(4 * 1024 * 1024, i, dtype=np.uint8)
+
+        refs = [make.remote(i) for i in range(8)]
+        for i, r in enumerate(refs):
+            assert ray_tpu.get(r, timeout=120.0)[0] == i
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_lineage_reconstruction_after_node_death():
+    """A task-produced object lost with its node is recomputed from
+    lineage on get (reference: ObjectRecoveryManager)."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    worker_node = cluster.add_node(num_cpus=1,
+                                   resources={"victim": 1.0})
+    cluster.connect()
+    try:
+        @ray_tpu.remote(resources={"victim": 1.0}, num_cpus=0)
+        def produce():
+            return np.arange(1024 * 1024, dtype=np.int32)  # > inline size
+
+        ref = produce.remote()
+        first = ray_tpu.get(ref, timeout=60.0)
+        assert first[5] == 5
+        del first
+        # kill the node holding the object
+        worker_node.kill()
+        import time
+        time.sleep(1.0)
+
+        # retarget the recomputation anywhere: lineage respec goes through
+        # the normal scheduler; victim resource is gone, so give the task a
+        # chance to run on the surviving node by removing the constraint —
+        # instead, produce2 mirrors the common case: same-resource retry on
+        # a restarted node
+        cluster.add_node(num_cpus=1, resources={"victim": 1.0})
+        out = ray_tpu.get(ref, timeout=60.0)
+        assert out[5] == 5 and out.shape == (1024 * 1024,)
+    finally:
+        cluster.shutdown()
