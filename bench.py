@@ -2,20 +2,28 @@
 
 Mirrors the reference's Train parity methodology
 (/root/reference/doc/source/ray-air/benchmarks.rst:178 — framework overhead
-vs native loops): here the measured quantity is model FLOP utilization of the
-framework's own train step (bf16, Pallas flash attention, AdamW).
-`vs_baseline` is MFU / 0.40 — the BASELINE.json north-star target of 40% MFU
-for GPT-2 training.
+vs native loops) and its always-report harness discipline
+(/root/reference/python/ray/_private/ray_perf.py:93-150): the measured
+quantity is model FLOP utilization of the framework's own train step (bf16,
+Pallas flash attention, AdamW).  ``vs_baseline`` is MFU / 0.40 — the
+BASELINE.json north-star target of 40% MFU for GPT-2 training.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness contract: this script ALWAYS prints exactly ONE json line
+{"metric", "value", "unit", "vs_baseline"} and exits 0 unless the fallback
+path itself is broken.  TPU backend init is retried (fresh subprocess each
+time — a failed XLA client init poisons the process); after retries it
+falls back to a CPU smoke run so a number is still recorded.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
+import traceback
 
-import jax
-import jax.numpy as jnp
-import optax
+_CHILD_FLAG = "_BENCH_CHILD"   # value: "tpu" or "cpu"
+_TPU_RETRIES = 3
 
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets)
 _PEAK_TFLOPS = {
@@ -37,7 +45,12 @@ def _peak_flops(device) -> float:
     return 197.0 * 1e12  # conservative default
 
 
-def main():
+def _run_measurement() -> dict:
+    """The actual benchmark body; assumes a working JAX backend."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401
+    import optax
+
     from ray_tpu.models import (TransformerConfig, flops_per_token,
                                 init_params, make_train_step)
 
@@ -59,22 +72,35 @@ def main():
                                 0, cfg.vocab_size)
     batch_data = {"tokens": tokens}
 
-    # warmup (compile + 2 steps)
+    # warmup (compile + 2 steps); float() is a hard device→host sync — the
+    # tunnelled backend has been seen returning early from block_until_ready
     for _ in range(2):
         params, opt_state, metrics = step(params, opt_state, batch_data)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, metrics = step(params, opt_state, batch_data)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    def _measure(sync_every_step: bool) -> float:
+        nonlocal params, opt_state
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = step(params, opt_state, batch_data)
+            if sync_every_step:
+                float(m["loss"])
+        float(m["loss"])
+        return time.perf_counter() - t0
 
+    dt = _measure(sync_every_step=False)
     tokens_per_step = batch * seq
-    tok_s = steps * tokens_per_step / dt
     flops_tok = flops_per_token(cfg, seq)
-    mfu = tok_s * flops_tok / _peak_flops(jax.devices()[0])
-    print(json.dumps({
+    peak = _peak_flops(jax.devices()[0])
+
+    def _mfu(dt: float) -> float:
+        return steps * tokens_per_step / dt * flops_tok / peak
+
+    if not (0.0 < _mfu(dt) < 0.95):  # async dispatch outran the device
+        dt = _measure(sync_every_step=True)
+    tok_s = steps * tokens_per_step / dt
+    mfu = _mfu(dt)
+    return {
         "metric": "gpt2s_train_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
@@ -82,6 +108,78 @@ def main():
         "detail": {"tokens_per_s": round(tok_s, 1),
                    "step_ms": round(1000 * dt / steps, 2),
                    "backend": jax.default_backend()},
+    }
+
+
+def _child_main(mode: str) -> None:
+    """Run one measurement attempt in this (fresh) process."""
+    if mode == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    print(json.dumps(_run_measurement()))
+
+
+def _spawn(mode: str) -> "subprocess.CompletedProcess":
+    env = dict(os.environ)
+    env[_CHILD_FLAG] = mode
+    if mode == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=1800)
+
+
+def _extract_json_line(out: str):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    mode = os.environ.get(_CHILD_FLAG)
+    if mode:
+        _child_main(mode)
+        return
+
+    errors = []
+    for attempt in range(_TPU_RETRIES):
+        try:
+            proc = _spawn("tpu")
+        except subprocess.TimeoutExpired:
+            errors.append(f"tpu attempt {attempt}: timeout")
+            continue
+        result = _extract_json_line(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"tpu attempt {attempt}: rc={proc.returncode} "
+                      f"stderr={proc.stderr.strip()[-300:]}")
+        time.sleep(2 * (attempt + 1))
+
+    try:
+        proc = _spawn("cpu")
+        result = _extract_json_line(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            result.setdefault("detail", {})["tpu_errors"] = errors[-1:]
+            print(json.dumps(result))
+            return
+        errors.append(f"cpu fallback: rc={proc.returncode} "
+                      f"stderr={proc.stderr.strip()[-300:]}")
+    except Exception:
+        errors.append(f"cpu fallback: {traceback.format_exc(limit=2)}")
+
+    # Last resort: still one parseable JSON line, value 0.
+    print(json.dumps({
+        "metric": "gpt2s_train_mfu", "value": 0.0,
+        "unit": "fraction_of_peak", "vs_baseline": 0.0,
+        "detail": {"backend": "none", "errors": errors[-3:]},
     }))
 
 

@@ -25,19 +25,44 @@ def new_session_dir() -> str:
     return path
 
 
-def _read_ready_line(proc: subprocess.Popen, tag: str, timeout: float = 30.0):
+def _child_env() -> Dict[str, str]:
+    """Environment for spawned runtime processes.
+
+    In hermetic CPU mode (RAY_TPU_DEVICE_BACKEND=cpu — tests / virtual
+    mesh), strip the attached TPU plugin's activation vars: the child's
+    sitecustomize otherwise registers and *claims* the single TPU at
+    interpreter start, which blocks before main() whenever another process
+    holds the chip (and wastes a tunnel round-trip when it doesn't)."""
+    env = dict(os.environ)
+    if env.get("RAY_TPU_DEVICE_BACKEND") == "cpu":
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _read_ready_line(proc: subprocess.Popen, tag: str, timeout: float = 60.0):
+    import select
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if not line:
+        # select so the deadline fires even when the child prints nothing
+        # (a bare readline() blocks past any timeout)
+        ready, _, _ = select.select([proc.stdout], [], [], 0.25)
+        if not ready:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"{tag} process exited with code {proc.returncode}")
+            continue
+        chunk = proc.stdout.readline()
+        if not chunk:
             if proc.poll() is not None:
                 raise RuntimeError(
                     f"{tag} process exited with code {proc.returncode}")
             time.sleep(0.01)
             continue
-        text = line.decode().strip()
+        text = chunk.decode(errors="replace").strip()
         if text.startswith(tag):
             return text.split()[1:]
+    proc.kill()
     raise TimeoutError(f"timed out waiting for {tag}")
 
 
@@ -69,7 +94,8 @@ def start_controller(session_dir: str, heartbeat_timeout_s: float = 5.0,
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu.core.controller_main",
          "--port", str(port), "--heartbeat-timeout", str(heartbeat_timeout_s)],
-        stdout=subprocess.PIPE, stderr=log, start_new_session=True)
+        stdout=subprocess.PIPE, stderr=log, start_new_session=True,
+        env=_child_env())
     log.close()
     (addr,) = _read_ready_line(proc, "CONTROLLER_READY")
     return ProcessHandle(proc, "controller"), addr
@@ -81,7 +107,7 @@ def start_nodelet(session_dir: str, controller_addr: str,
                   env: Optional[Dict[str, str]] = None) -> tuple:
     import json
     log = open(os.path.join(session_dir, "logs", "nodelet.err"), "ab")
-    full_env = dict(os.environ)
+    full_env = _child_env()
     full_env.update(env or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu.core.nodelet_main",

@@ -50,7 +50,8 @@ def submit_job(entrypoint: str, *,
     log_dir = os.path.join(tempfile.gettempdir(), "ray_tpu_jobs")
     os.makedirs(log_dir, exist_ok=True)
     log_path = os.path.join(log_dir, f"{job_id}.log")
-    env = dict(os.environ)
+    from .core.node import _child_env
+    env = _child_env()  # strips TPU-claim vars in hermetic CPU mode
     env["RAY_TPU_ADDRESS"] = core.controller_addr
     env["RAY_TPU_JOB_ID"] = job_id
     for k, v in (runtime_env or {}).get("env_vars", {}).items():

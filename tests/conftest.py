@@ -21,11 +21,26 @@ os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY_MB", "256")
 # helpers to the 8-device virtual CPU backend explicitly.
 os.environ.setdefault("RAY_TPU_DEVICE_BACKEND", "cpu")
 os.environ.setdefault("RAY_TPU_WORKER_POOL_INITIAL_SIZE", "1")
+# Do NOT clear PALLAS_AXON_POOL_IPS here: sitecustomize already registered
+# the axon plugin at interpreter start using the ambient value, and blanking
+# it post-registration makes the lazy PJRT client init block forever.
+# Instead pin jax.config to cpu below so backend discovery never initializes
+# the axon client at all.
+# NB: do NOT enable JAX_COMPILATION_CACHE_DIR here — this jaxlib hangs
+# serializing multi-device (force-host-platform) executables into the
+# persistent cache; suite wall time is dominated by runtime waits, not
+# compiles, so the cache buys nothing anyway.
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# The env var was latched as "axon" when sitecustomize imported jax at
+# interpreter start; the config update (not the env) is what get_backend
+# consults, so this confines every test to the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_configure(config):
