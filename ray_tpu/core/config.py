@@ -115,6 +115,9 @@ _d("log_to_driver", bool, True, "Forward worker stdout/stderr lines to the drive
 _d("metrics_report_interval_s", float, 2.0, "Worker metric push period.")
 _d("lineage_cache_size", int, 100000,
    "Task specs retained per driver for lineage reconstruction.")
+_d("max_reconstruction_depth", int, 20,
+   "Maximum recursion depth when reconstructing a chain of lost objects "
+   "(reference: object_recovery_manager.h recursive recovery).")
 
 # --- TPU / accelerator ------------------------------------------------------
 _d("tpu_autodetect", bool, True, "Detect local TPU chips via JAX at node start.")

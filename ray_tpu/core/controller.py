@@ -20,7 +20,7 @@ import asyncio
 import time
 from typing import Any, Dict, List, Optional, Set
 
-from . import rpc
+from . import rpc, spill
 from .scheduling import NodeView, hybrid_policy, pack_bundles
 from .task_spec import ResourceSet, TaskSpec
 
@@ -94,6 +94,16 @@ class Controller:
         self.object_dir: Dict[bytes, Set[str]] = {}       # oid -> node ids
         self.object_sizes: Dict[bytes, int] = {}
         self.object_waiters: Dict[bytes, List[asyncio.Event]] = {}
+        # -- distributed ref counting (reference: reference_count.h:61) ----
+        # A "holder" is either a live connection (borrower process) or a
+        # container object ("obj:<hex>" — refs serialized inside a stored
+        # value).  The owner requests a free when its local refs drop; the
+        # free executes only once no holder borrows the object.
+        self.borrows: Dict[bytes, Dict[str, int]] = {}    # oid -> holder -> n
+        self.holder_refs: Dict[str, Dict[bytes, int]] = {}  # holder -> oid -> n
+        self.pending_free: Set[bytes] = set()
+        self.ref_stats = {"lineage_evictions": 0, "deferred_frees": 0,
+                          "cascade_frees": 0}
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}  # channel -> conns
         self.view_version = 0
         self.config_snapshot: Dict[str, Any] = {}
@@ -113,6 +123,7 @@ class Controller:
                      "remove_placement_group", "list_placement_groups",
                      "object_location_add", "object_location_remove",
                      "object_locations_get", "free_objects",
+                     "ref_inc", "ref_dec", "free_request", "ref_counts",
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
                      "drain_node", "ping"):
@@ -270,16 +281,30 @@ class Controller:
 
     async def _actor_scheduler_loop(self):
         """Drives PENDING/RESTARTING actors toward ALIVE, like the
-        reference's GcsActorScheduler (gcs_actor_scheduler.cc:53-55)."""
+        reference's GcsActorScheduler (gcs_actor_scheduler.cc:53-55).
+        Creations run CONCURRENTLY (one task per actor): a gang actor's
+        constructor may block until its peers exist (mesh-join barriers),
+        so awaiting one creation before scheduling the next would deadlock
+        every gang of size > 1."""
         while True:
             self._pending_actor_wakeup.clear()
             for actor in list(self.actors.values()):
-                if actor.state in (PENDING_CREATION, RESTARTING) and actor.node_id is None:
-                    await self._try_schedule_actor(actor)
+                if actor.state in (PENDING_CREATION, RESTARTING) \
+                        and actor.node_id is None \
+                        and not getattr(actor, "scheduling", False):
+                    actor.scheduling = True
+                    asyncio.ensure_future(self._schedule_one(actor))
             try:
                 await asyncio.wait_for(self._pending_actor_wakeup.wait(), timeout=0.5)
             except asyncio.TimeoutError:
                 pass
+
+    async def _schedule_one(self, actor: ActorRecord):
+        try:
+            await self._try_schedule_actor(actor)
+        finally:
+            actor.scheduling = False
+            self._pending_actor_wakeup.set()
 
     async def _try_schedule_actor(self, actor: ActorRecord):
         spec = TaskSpec(actor.spec)
@@ -572,12 +597,126 @@ class Controller:
                 pass
 
     async def _h_free_objects(self, conn, data):
-        oids = data["object_ids"]
-        by_node: Dict[str, List[bytes]] = {}
+        """Immediate (unconditional) free — spilling/testing paths."""
+        await self._do_free(data["object_ids"])
+        return True
+
+    # ------------------------------------------- distributed ref counting
+    def _conn_holder(self, conn, data) -> str:
+        h = data.get("holder")
+        if h:
+            return h
+        key = f"conn:{id(conn)}"
+        # First borrow through this connection: chain a close hook so a
+        # crashed/exited process's borrows are swept (the reference gets
+        # this from the owner failing its borrower RPC client).
+        if not conn.peer_info.get("_ref_holder"):
+            conn.peer_info["_ref_holder"] = key
+            prev = conn.on_close
+
+            def _closed(c, prev=prev, key=key):
+                if prev:
+                    prev(c)
+                asyncio.ensure_future(self._clear_holder(key))
+            conn.on_close = _closed
+        return key
+
+    async def _h_ref_inc(self, conn, data):
+        holder = self._conn_holder(conn, data)
+        for oid in data["object_ids"]:
+            self.borrows.setdefault(oid, {})
+            self.borrows[oid][holder] = self.borrows[oid].get(holder, 0) + 1
+            hr = self.holder_refs.setdefault(holder, {})
+            hr[oid] = hr.get(oid, 0) + 1
+        return True
+
+    async def _h_ref_dec(self, conn, data):
+        holder = self._conn_holder(conn, data)
+        freeable = []
+        for oid in data["object_ids"]:
+            if self._drop_borrow(oid, holder):
+                freeable.append(oid)
+        if freeable:
+            await self._do_free(freeable)
+        return True
+
+    def _drop_borrow(self, oid: bytes, holder: str) -> bool:
+        """Returns True if the object became freeable (pending + unborrowed)."""
+        d = self.borrows.get(oid)
+        if d is not None:
+            n = d.get(holder, 0) - 1
+            if n > 0:
+                d[holder] = n
+            else:
+                d.pop(holder, None)
+            if not d:
+                self.borrows.pop(oid, None)
+        hr = self.holder_refs.get(holder)
+        if hr is not None:
+            n = hr.get(oid, 0) - 1
+            if n > 0:
+                hr[oid] = n
+            else:
+                hr.pop(oid, None)
+            if not hr:
+                self.holder_refs.pop(holder, None)
+        return oid in self.pending_free and not self.borrows.get(oid)
+
+    async def _clear_holder(self, holder: str):
+        """Drop every borrow held by a dead process / freed container."""
+        oids = list(self.holder_refs.get(holder, {}).keys())
+        freeable = []
         for oid in oids:
+            d = self.borrows.get(oid)
+            if d is not None:
+                d.pop(holder, None)
+                if not d:
+                    self.borrows.pop(oid, None)
+            if oid in self.pending_free and not self.borrows.get(oid):
+                freeable.append(oid)
+        self.holder_refs.pop(holder, None)
+        if freeable:
+            self.ref_stats["cascade_frees"] += len(freeable)
+            await self._do_free(freeable)
+
+    async def _h_free_request(self, conn, data):
+        """Owner dropped its last local ref: free now if unborrowed, else
+        defer until every borrower (process or container) lets go."""
+        now, deferred = [], 0
+        for oid in data["object_ids"]:
+            if self.borrows.get(oid):
+                self.pending_free.add(oid)
+                deferred += 1
+            else:
+                now.append(oid)
+        self.ref_stats["deferred_frees"] += deferred
+        if now:
+            await self._do_free(now)
+        return True
+
+    async def _h_ref_counts(self, conn, data):
+        """Debug/observability: outstanding borrows (ray memory equivalent)."""
+        return {
+            "borrows": {oid.hex(): {h: n for h, n in d.items()}
+                        for oid, d in self.borrows.items()},
+            "pending_free": [o.hex() for o in self.pending_free],
+            "stats": dict(self.ref_stats),
+        }
+
+    async def _do_free(self, oids: List[bytes]):
+        by_node: Dict[str, List[bytes]] = {}
+        spill_ns = self.kv.get("spill", {})
+        for oid in oids:
+            self.pending_free.discard(oid)
             for nid in self.object_dir.pop(oid, set()):
                 by_node.setdefault(nid, []).append(oid)
             self.object_sizes.pop(oid, None)
+            # Sweep spill storage for freed objects (worker-spilled files are
+            # registered here; shared-fs/single-machine sessions can unlink).
+            path = spill_ns.pop(oid, None)
+            if path is not None:
+                spill.delete_file(path.decode() if isinstance(path, bytes)
+                                  else path)
         for nid, node_oids in by_node.items():
             rec = self.nodes.get(nid)
             if rec is not None and rec.view.alive:
@@ -585,6 +724,10 @@ class Controller:
                     await rec.conn.notify("free_local", {"object_ids": node_oids})
                 except Exception:
                     pass
+        # Containment cascade: refs pinned by a freed container are released
+        # (may recursively free nested containers).
+        for oid in oids:
+            await self._clear_holder(f"obj:{oid.hex()}")
         return True
 
     # ---------------------------------------------------------------- pubsub

@@ -91,6 +91,15 @@ class ObjectRef:
 
 def _deserialize_ref(binary: bytes) -> "ObjectRef":
     core = get_global_core()
+    if core is None:
+        # Worker process deserializing a nested ref before its lazy core
+        # exists: bring it up from the env context so the ref participates
+        # in borrow counting (and so .get()/.future() work on it).
+        try:
+            from .. import api
+            core = api._ensure_initialized()
+        except Exception:
+            core = None
     return ObjectRef(ObjectID(binary), core)
 
 
@@ -151,6 +160,11 @@ class CoreClient:
         self._lineage: "OrderedDict[bytes, TaskSpec]" = OrderedDict()
         self._put_pins: set = set()  # owner pins of put() primary copies
         self._spilled_paths: Dict[bytes, str] = {}
+        self._containers: set = set()  # owned oids with contained-ref pins
+        self._borrow_epoch = 0         # ref_incs issued (see sync_borrows)
+        self._borrow_synced = 0
+        self._extra_pins_map: Dict[bytes, List[bytes]] = {}  # in-flight nested pins
+        self._value_finalizers: list = []  # detached at shutdown (segfault guard)
         if mode == "driver":
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
@@ -158,8 +172,46 @@ class CoreClient:
 
     # ------------------------------------------------------------- refcounts
     def _add_local_ref(self, oid: bytes):
+        """Local count; a 0→1 transition on a *borrowed* oid additionally
+        registers this process as a borrower with the controller (the
+        distributed half of reference_count.h's borrower protocol — the
+        owner's free is gated on these)."""
         with self._ref_lock:
-            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+            n = self._local_refs.get(oid, 0)
+            self._local_refs[oid] = n + 1
+            borrow = n == 0 and oid not in self._owned
+        if borrow and not self._closed:
+            self._notify_controller("ref_inc", {"object_ids": [oid]})
+
+    def _notify_controller(self, method: str, data: dict):
+        """Fire-and-forget controller notify; per-connection FIFO keeps
+        inc/dec ordered."""
+        if method == "ref_inc":
+            self._borrow_epoch += 1
+        try:
+            self.lt.spawn(self.controller.conn.notify(method, data))
+        except Exception:
+            pass
+
+    def sync_borrows(self):
+        """Block until every borrow registered so far is visible at the
+        controller.  A worker calls this BEFORE replying to a task: the
+        caller releases its argument pins only after the reply, so the
+        borrow→reply→release→free_request order makes the deferred-free
+        gate race-free across connections (the reference achieves this by
+        shipping borrower lists in the task reply itself —
+        reference_count.h "borrowers" merge)."""
+        epoch = self._borrow_epoch
+        if epoch == self._borrow_synced or self._closed:
+            return
+        try:
+            # ping rides the same FIFO connection as the ref_inc notifies;
+            # the controller handles frames with a synchronous prefix in
+            # arrival order, so the ping reply implies the incs applied.
+            self.controller.call("ping", {}, timeout=10)
+            self._borrow_synced = epoch
+        except Exception:
+            pass
 
     def _remove_local_ref(self, oid: bytes):
         if self._closed:
@@ -174,6 +226,8 @@ class CoreClient:
             self._owned.discard(oid)
             plasma = oid in self._plasma_oids
             self._plasma_oids.discard(oid)
+            contained = oid in self._containers
+            self._containers.discard(oid)
         self.memory_store.delete([oid])
         with self._ref_lock:
             put_pinned = oid in self._put_pins
@@ -186,25 +240,39 @@ class CoreClient:
         # NB: the shared-memory pin (self._pinned) is NOT dropped here — it is
         # tied to the lifetime of the deserialized value (weakref finalizer in
         # _get_plasma), because zero-copy numpy views alias store memory.
-        if not (owned and plasma):
-            return  # borrowed or inline-only: nothing cluster-wide to free
-        coro = None
-        try:
-            coro = self.controller.conn.call("free_objects",
-                                             {"object_ids": [oid]})
-            self.lt.spawn(coro)
-        except Exception:
-            if coro is not None:
-                coro.close()
+        if not owned:
+            # Borrower letting go: the owner's deferred free may now run.
+            self._notify_controller("ref_dec", {"object_ids": [oid]})
+            return
+        # Owner final release.  Spill storage is NOT reclaimed here: the
+        # spill file may be the only copy and a borrower may still hold the
+        # ref — the controller sweeps the file (via the spill KV namespace)
+        # inside the borrow-gated free itself (_do_free).
+        spilled_path = self._spilled_paths.pop(oid, None)
+        self._lineage.pop(oid, None)  # deliberate: lineage dies with the ref
+        if not (plasma or contained or spilled_path is not None):
+            return  # inline-only, nothing pinned: nothing cluster-wide
+        # Gated free: executes once no borrower (process or container) holds
+        # the object (controller _h_free_request).
+        self._notify_controller("free_request", {"object_ids": [oid]})
 
     # ------------------------------------------------------------------- put
     def put(self, value: Any) -> ObjectRef:
         self._put_index += 1
         oid = ObjectID.for_put(self.task_ctx, self._put_index)
-        parts = serialization.serialize(value)
+        contained: List[bytes] = []
+        parts = serialization.serialize(value, ref_collector=contained)
         size = serialization.serialized_size(parts)
         with self._ref_lock:
             self._owned.add(oid.binary())
+        if contained:
+            # Containment pin: refs inside the stored value stay alive until
+            # this container is freed (reference: "contained in owned object"
+            # edges of reference_count.h).
+            with self._ref_lock:
+                self._containers.add(oid.binary())
+            self._notify_controller("ref_inc", {
+                "object_ids": contained, "holder": f"obj:{oid.hex()}"})
         if size <= GlobalConfig.max_direct_call_object_size:
             self.memory_store.put(oid.binary(), b"".join(bytes(p) for p in parts))
         else:
@@ -234,7 +302,25 @@ class CoreClient:
     # ------------------------------------------------------------------- get
     def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
         oids = [r.binary() for r in refs]
+        # Revived refs (deserialized out of a container after the original
+        # handle was released) have no memory-store entry — the release
+        # deleted it — but the object itself still lives in a store / spill
+        # (its free was deferred on the containment hold).  Re-establish the
+        # plasma marker so the wait below doesn't block on an entry nothing
+        # will ever re-put.  Fast pre-pass: local shm store only (no RPC on
+        # the hot path); cluster-wide lookup runs only after a miss.
+        for oid in dict.fromkeys(oids):
+            if self.memory_store.peek(oid) is None and self.store.contains(oid):
+                self.memory_store.put_in_plasma_marker(oid)
         entries = self.memory_store.get(oids, timeout)
+        if entries is None:
+            revived = False
+            for oid in dict.fromkeys(oids):
+                if self.memory_store.peek(oid) is None \
+                        and self._object_available(oid):
+                    self.memory_store.put_in_plasma_marker(oid)
+                    revived = True
+            entries = self.memory_store.get(oids, 5.0) if revived else None
         if entries is None:
             raise exceptions.GetTimeoutError(
                 f"get() timed out waiting for {len(oids)} objects")
@@ -291,14 +377,48 @@ class CoreClient:
             path = raw.decode()
         return spill.read_file(path)
 
-    def _reconstruct(self, oid: bytes, timeout: Optional[float]) -> bool:
-        """Lineage reconstruction (reference:
+    def _object_available(self, oid: bytes) -> bool:
+        """Reachable without reconstruction: local memory/store, any node's
+        store (controller directory), or spill storage."""
+        if self.memory_store.peek(oid) is not None or self.store.contains(oid):
+            return True
+        try:
+            locs = self.controller.call("object_locations_get",
+                                        {"object_id": oid, "timeout": 0.05},
+                                        timeout=5)
+            if locs and locs.get("locations"):
+                return True
+        except Exception:
+            pass
+        try:
+            if self.controller.call("kv_get", spill.kv_entry(oid)):
+                return True
+        except Exception:
+            pass
+        return False
+
+    def _reconstruct(self, oid: bytes, timeout: Optional[float],
+                     _depth: int = 0) -> bool:
+        """Multi-level lineage reconstruction (reference:
         `object_recovery_manager.h:96-106`): resubmit the task that created
-        the lost object and wait for it to land back in the store.  First
-        cut: one level (arguments must still be reachable)."""
+        the lost object, first recursively reconstructing any of its
+        argument objects that are themselves lost — so a chain a→b→c
+        recovers end-to-end after the whole chain is evicted."""
+        if _depth > GlobalConfig.max_reconstruction_depth:
+            return False
         spec = self._lineage.get(oid)
         if spec is None:
             return False
+        for arg_oid in {o.binary() if hasattr(o, "binary") else o
+                        for o in spec.arg_ref_ids()}:
+            if not self._object_available(arg_oid):
+                if not self._reconstruct(arg_oid, timeout, _depth + 1):
+                    return False
+        # The resubmitted task's reply releases one local ref per arg
+        # (_handle_task_reply) — take those refs NOW or the user's own
+        # handles get over-decremented (and freed) by the recovery.
+        for arg_oid in spec.arg_ref_ids():
+            self._add_local_ref(arg_oid.binary())
         self.lt.spawn(self._submit_pipeline(spec, spec.max_retries))
         deadline = time.monotonic() + (timeout or 60.0)
         while time.monotonic() < deadline:
@@ -325,9 +445,16 @@ class CoreClient:
             except Exception:
                 pass
         try:
-            weakref.finalize(value, _unpin)
+            fin = weakref.finalize(value, _unpin)
         except TypeError:
             pass  # not weakref-able (int, tuple, ...): stay pinned
+        else:
+            # Track so shutdown() can detach before closing the store: a GC
+            # run after close() must not re-enter the ctypes layer.
+            self._value_finalizers.append(fin)
+            if len(self._value_finalizers) > 256:
+                self._value_finalizers = [
+                    f for f in self._value_finalizers if f.alive]
 
     # ------------------------------------------------------------------ wait
     def wait(self, refs: List[ObjectRef], num_returns: int,
@@ -350,18 +477,25 @@ class CoreClient:
         values inline, big values spill to the local store.  The trailing
         element is always the serialized kwargs dict.  Returns
         ``(encoded, temp_refs)`` — the caller must keep ``temp_refs`` alive
-        until the spec's arg refs are pinned (submit_task does this)."""
+        until the spec's arg refs are pinned (submit_task does this).
+        Refs *nested inside* inline arg values are pinned too (as temp
+        refs re-bound to this core), so e.g. ``f.remote([ref1, ref2])``
+        keeps the nested objects alive until the task lands."""
         encoded: List[Any] = []
         temp_refs: List[ObjectRef] = []
+        nested: List[bytes] = []
         for a in args:
-            encoded.append(self._encode_arg(a, temp_refs))
-        encoded.append(self._encode_arg(kwargs or {}, temp_refs))
+            encoded.append(self._encode_arg(a, temp_refs, nested))
+        encoded.append(self._encode_arg(kwargs or {}, temp_refs, nested))
+        for b in nested:
+            temp_refs.append(ObjectRef(ObjectID(b), self))
         return encoded, temp_refs
 
-    def _encode_arg(self, value: Any, temp_refs: List["ObjectRef"]):
+    def _encode_arg(self, value: Any, temp_refs: List["ObjectRef"],
+                    nested: List[bytes]):
         if isinstance(value, ObjectRef):
             return [ARG_REF, value.binary()]
-        parts = serialization.serialize(value)
+        parts = serialization.serialize(value, ref_collector=nested)
         size = serialization.serialized_size(parts)
         if size > GlobalConfig.inline_small_args_bytes:
             ref = self.put(value)
@@ -383,7 +517,14 @@ class CoreClient:
                 self._lineage.popitem(last=False)
         for oid in spec.arg_ref_ids():
             self._add_local_ref(oid.binary())  # pin args until task completes
-        del temp_refs  # spilled-arg refs are now pinned; drop the temporaries
+        # Nested/spilled-arg temporaries: hold a local ref until the task
+        # completes (released with the arg pins in _handle_task_reply).
+        extra = [r.binary() for r in (temp_refs or [])]
+        if extra:
+            for b in extra:
+                self._add_local_ref(b)
+            self._extra_pins_map[spec.task_id.binary()] = extra
+        del temp_refs
         self.lt.spawn(self._submit_pipeline(spec, spec.max_retries))
         return refs
 
@@ -539,6 +680,12 @@ class CoreClient:
             self._store_error(spec, ev)
             return False
         for oid, ret in zip(spec.return_ids(), reply["returns"]):
+            if ret.get("contained"):
+                # Worker registered containment pins keyed on this return
+                # oid; the owner must free_request on final release so the
+                # controller cascades them (even for inline returns).
+                with self._ref_lock:
+                    self._containers.add(oid.binary())
             if "inline" in ret:
                 self.memory_store.put(oid.binary(), ret["inline"])
             else:
@@ -547,7 +694,13 @@ class CoreClient:
                 self.memory_store.put_in_plasma_marker(oid.binary())
         for oid in spec.arg_ref_ids():
             self._remove_local_ref(oid.binary())
+        self._release_extra_pins(spec)
         return False
+
+    def _release_extra_pins(self, spec: TaskSpec):
+        key = spec.task_id.binary()
+        for b in self._extra_pins_map.pop(key, ()):  # idempotent (pop)
+            self._remove_local_ref(b)
 
     def _store_error(self, spec: TaskSpec, error_value: _ErrorValue):
         data = serialization.serialize_to_bytes(error_value)
@@ -555,6 +708,7 @@ class CoreClient:
             self.memory_store.put(oid.binary(), data)
         for oid in spec.arg_ref_ids():
             self._remove_local_ref(oid.binary())
+        self._release_extra_pins(spec)
 
     def _fail_task(self, spec: TaskSpec, reason: str):
         self._store_error(spec, _ErrorValue(reason, None, spec.function_name))
@@ -593,6 +747,11 @@ class CoreClient:
         refs = [ObjectRef(oid, self) for oid in spec.return_ids()]
         for oid in spec.arg_ref_ids():
             self._add_local_ref(oid.binary())
+        extra = [r.binary() for r in (temp_refs or [])]
+        if extra:
+            for b in extra:
+                self._add_local_ref(b)
+            self._extra_pins_map[spec.task_id.binary()] = extra
         del temp_refs
         self.lt.spawn(self._submit_actor_pipeline(actor_id, spec,
                                                   max_task_retries))
@@ -710,6 +869,14 @@ class CoreClient:
         if self._closed:
             return
         self._closed = True
+        # Detach value finalizers first: after store.close() any late GC of a
+        # zero-copy value must not call back into the (closed) ctypes client.
+        for fin in self._value_finalizers:
+            try:
+                fin.detach()
+            except Exception:
+                pass
+        self._value_finalizers.clear()
         if self.mode == "driver":
             try:
                 self.controller.call("finish_job",
@@ -744,6 +911,8 @@ def _split(addr: str) -> Tuple[str, int]:
     host, port = addr.rsplit(":", 1)
     return host, int(port)
 
+
+serialization.register_ref_class(ObjectRef)
 
 _global_core: Optional[CoreClient] = None
 

@@ -117,11 +117,22 @@ class StoreClient:
             os.close(fd)
         self._view = memoryview(self._mm)
         self._closed = False
+        # Serializes close() against release/delete/abort from weakref
+        # finalizers (GC may run them on any thread after shutdown); every
+        # ctypes entry checks _closed so a closed handle is never passed to
+        # the C side (mirrors plasma client disconnect semantics,
+        # reference: src/ray/object_manager/plasma/client.cc).
+        self._close_lock = threading.Lock()
+
+    def _check_open(self):
+        if self._closed:
+            raise StoreError("store client is closed")
 
     # -- write path ---------------------------------------------------------
     def create(self, object_id: bytes, size: int) -> memoryview:
         """Reserve space; returns a writable view.  Call seal() when done."""
         assert len(object_id) == ID_LEN
+        self._check_open()
         off = self._lib.rts_create(self._h, object_id, size)
         if off == RTS_ERR_EXISTS:
             raise ObjectExistsError(object_id.hex())
@@ -132,12 +143,16 @@ class StoreClient:
         return self._view[off: off + size]
 
     def seal(self, object_id: bytes):
+        self._check_open()
         rc = self._lib.rts_seal(self._h, object_id)
         if rc != RTS_OK:
             raise StoreError(f"seal failed rc={rc}")
 
     def abort(self, object_id: bytes):
-        self._lib.rts_abort(self._h, object_id)
+        with self._close_lock:
+            if self._closed:
+                return
+            self._lib.rts_abort(self._h, object_id)
 
     def put_parts(self, object_id: bytes, parts: List[memoryview]) -> int:
         """Create+write+seal in one call; returns total bytes.  Idempotent:
@@ -164,6 +179,7 @@ class StoreClient:
     def get(self, object_id: bytes, timeout_ms: int = 0) -> Optional[memoryview]:
         """Returns a zero-copy view or None on timeout.  Caller must
         release() when the view (and anything aliasing it) is dropped."""
+        self._check_open()
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         rc = self._lib.rts_get(self._h, object_id, timeout_ms,
@@ -177,34 +193,46 @@ class StoreClient:
         return self._view[off.value: off.value + size.value].toreadonly()
 
     def release(self, object_id: bytes):
-        self._lib.rts_release(self._h, object_id)
+        with self._close_lock:
+            if self._closed:
+                return
+            self._lib.rts_release(self._h, object_id)
 
     def contains(self, object_id: bytes) -> bool:
-        return bool(self._lib.rts_contains(self._h, object_id))
+        with self._close_lock:
+            if self._closed:
+                return False
+            return bool(self._lib.rts_contains(self._h, object_id))
 
     def delete(self, object_id: bytes):
-        self._lib.rts_delete(self._h, object_id)
+        with self._close_lock:
+            if self._closed:
+                return
+            self._lib.rts_delete(self._h, object_id)
 
     def list_objects(self) -> List[bytes]:
+        self._check_open()
         buf = ctypes.create_string_buffer(ID_LEN * 65536)
         n = self._lib.rts_list(self._h, buf, 65536)
         raw = buf.raw
         return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(n)]
 
     def stats(self) -> Dict[str, int]:
+        self._check_open()
         vals = [ctypes.c_uint64() for _ in range(5)]
         self._lib.rts_stats(self._h, *[ctypes.byref(v) for v in vals])
         keys = ["used_bytes", "capacity_bytes", "num_objects", "num_evictions", "num_creates"]
         return dict(zip(keys, [v.value for v in vals]))
 
     def close(self):
-        if self._closed:
-            return
-        self._closed = True
-        try:
-            self._view.release()
-            self._mm.close()
-        except BufferError:
-            pass  # outstanding zero-copy views; let the mapping die with us
-        self._lib.rts_close(self._h)
-        self._h = None
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._view.release()
+                self._mm.close()
+            except BufferError:
+                pass  # outstanding zero-copy views; let the mapping die with us
+            self._lib.rts_close(self._h)
+            self._h = None

@@ -18,13 +18,24 @@ from __future__ import annotations
 import io
 import pickle
 import struct
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import cloudpickle
 import msgpack
 
 _MAGIC = b"RTO1"  # ray-tpu object, version 1
 _ALIGN = 64
+
+# Registered by core.driver: the ObjectRef class, so the pickler can report
+# refs *contained* in a serialized value (the ownership protocol needs to
+# pin them while the container object lives — reference:
+# src/ray/core_worker/reference_count.h:61 "contained in owned object").
+_REF_CLASS = None
+
+
+def register_ref_class(cls) -> None:
+    global _REF_CLASS
+    _REF_CLASS = cls
 
 
 class _JaxArrayPlaceholder:
@@ -51,10 +62,15 @@ def _restore_jax(np_value):
 
 
 class _Pickler(cloudpickle.CloudPickler):
-    def __init__(self, file, buffer_callback):
+    def __init__(self, file, buffer_callback, ref_collector=None):
         super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self._ref_collector = ref_collector
 
     def reducer_override(self, obj):
+        if self._ref_collector is not None and _REF_CLASS is not None \
+                and isinstance(obj, _REF_CLASS):
+            self._ref_collector.append(obj.binary())
+            return NotImplemented  # fall through to ObjectRef.__reduce__
         t = type(obj)
         mod = t.__module__
         if mod.startswith("jaxlib") or mod.startswith("jax"):
@@ -67,12 +83,15 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
-def serialize(value: Any) -> List[memoryview]:
+def serialize(value: Any, ref_collector: Optional[list] = None
+              ) -> List[memoryview]:
     """Serialize ``value`` to a list of buffers: header + pickled body + payload
-    buffers.  The caller concatenates them (e.g. straight into store memory)."""
+    buffers.  The caller concatenates them (e.g. straight into store memory).
+    ``ref_collector``, if given, receives the binary ids of every ObjectRef
+    contained in ``value`` (for containment pinning)."""
     buffers: List[pickle.PickleBuffer] = []
     f = io.BytesIO()
-    _Pickler(f, buffers.append).dump(value)
+    _Pickler(f, buffers.append, ref_collector).dump(value)
     body = f.getvalue()
 
     raw: List[memoryview] = []

@@ -29,6 +29,13 @@ from typing import Any, Dict, List, Optional
 from .. import exceptions
 from . import rpc, serialization
 from .config import GlobalConfig
+
+
+def _get_worker_core():
+    """This worker's lazily-created CoreClient (None before user code first
+    touches the API)."""
+    from .driver import get_global_core
+    return get_global_core()
 from .object_store import client as store_client
 from .task_spec import ARG_REF, ARG_VALUE, TaskSpec
 
@@ -154,12 +161,20 @@ class WorkerRuntime:
                              f"but produced {len(values)}")
         out = []
         for i, value in enumerate(values):
-            parts = serialization.serialize(value)
+            oid = spec.return_ids()[i].binary()
+            contained: List[bytes] = []
+            parts = serialization.serialize(value, ref_collector=contained)
             size = serialization.serialized_size(parts)
+            if contained:
+                # Containment pin keyed on the return object: nested refs
+                # stay alive until the caller frees the container
+                # (reference_count.h "contained in owned object" edges).
+                await self.controller.notify("ref_inc", {
+                    "object_ids": contained, "holder": f"obj:{oid.hex()}"})
             if size <= GlobalConfig.max_direct_call_object_size:
-                out.append({"inline": b"".join(bytes(p) for p in parts)})
+                out.append({"inline": b"".join(bytes(p) for p in parts),
+                            "contained": bool(contained)})
             else:
-                oid = spec.return_ids()[i].binary()
                 try:
                     self.store.put_parts(oid, parts)
                     await self.nodelet.call("put_location",
@@ -170,7 +185,7 @@ class WorkerRuntime:
                     await self.controller.call(
                         "kv_put", {**spill.kv_entry(oid),
                                    "value": path.encode()})
-                out.append({"plasma": size})
+                out.append({"plasma": size, "contained": bool(contained)})
         return out
 
     def _run_user_code(self, fn, args, kwargs):
@@ -199,6 +214,15 @@ class WorkerRuntime:
                 result = await self._loop.run_in_executor(
                     self.executor, self._run_user_code, fn, args, kwargs)
             returns = await self._store_returns(spec, result)
+            # Borrow barrier: refs deserialized during this task registered
+            # borrows via fire-and-forget notifies on the worker-core's own
+            # controller connection; the caller drops its argument pins the
+            # moment it sees this reply, so those borrows must be visible at
+            # the controller FIRST or its deferred-free gate races open
+            # (reference ships borrower lists in the reply itself).
+            core = _get_worker_core()
+            if core is not None:
+                await self._loop.run_in_executor(None, core.sync_borrows)
             return {"returns": returns}
         except Exception as e:
             tb = traceback.format_exc()

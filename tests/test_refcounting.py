@@ -1,0 +1,190 @@
+"""Distributed reference counting + multi-level lineage tests.
+
+Capability model: the reference's ownership/borrower protocol
+(/root/reference/src/ray/core_worker/reference_count.h:61 — borrower
+registration, "contained in owned object" edges, deferred deletion) and
+recursive lineage recovery (object_recovery_manager.h:96-106).  Here the
+controller arbitrates: owners issue gated free_requests, borrowers and
+container objects register holds, and frees cascade when the last hold
+drops (VERDICT round-1 item 4 done-criteria)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _controller_refcounts():
+    from ray_tpu.core.driver import get_global_core
+    core = get_global_core()
+    return core.controller.call("ref_counts", {}, timeout=10)
+
+
+def test_nested_ref_survives_owner_handle_gc():
+    """A ref stored inside another object stays alive after the original
+    handle is dropped: the container's containment pin holds it."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        inner = ray_tpu.put(np.full(1024 * 1024, 7, dtype=np.uint8))
+        container = ray_tpu.put({"payload": inner, "tag": "x"})
+        inner_bin = inner.binary()
+        del inner  # owner's local handle gone; containment must pin it
+        time.sleep(0.3)
+        rc = _controller_refcounts()
+        assert inner_bin.hex() in rc["borrows"], \
+            "containment hold missing after handle GC"
+        out = ray_tpu.get(container, timeout=30.0)
+        got = ray_tpu.get(out["payload"], timeout=30.0)
+        assert got[0] == 7
+        del got, out
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_container_free_cascades():
+    """Freeing the container releases its containment holds (controller
+    cascade), letting the inner object's deferred free run."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        inner = ray_tpu.put(np.full(1024 * 1024, 3, dtype=np.uint8))
+        container = ray_tpu.put([inner])
+        inner_bin = inner.binary().hex()
+        del inner
+        time.sleep(0.3)
+        assert inner_bin in _controller_refcounts()["borrows"]
+        del container
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            rc = _controller_refcounts()
+            if inner_bin not in rc["borrows"] and \
+                    inner_bin not in rc["pending_free"]:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"containment hold never released: {rc}")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nested_ref_passed_through_task():
+    """driver → task: a ref nested inside an inline arg value resolves in
+    the worker even after the driver drops its own handle immediately."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def read_inner(box):
+            return int(ray_tpu.get(box["r"], timeout=30.0)[0])
+
+        r = ray_tpu.put(np.full(1024 * 1024, 9, dtype=np.uint8))
+        out = read_inner.remote({"r": r})
+        del r  # in-flight nested pin must keep it alive
+        assert ray_tpu.get(out, timeout=60.0) == 9
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nested_ref_through_actor_and_task_roundtrip():
+    """VERDICT done-criteria: nested refs passed driver→actor→task survive
+    owner-side GC of the original handles."""
+    ray_tpu.init(num_cpus=3, object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def deref(box):
+            return int(ray_tpu.get(box[0], timeout=30.0)[0])
+
+        @ray_tpu.remote
+        class Keeper:
+            def __init__(self):
+                self.boxes = []
+
+            def stash(self, box):
+                self.boxes.append(box)
+                return True
+
+            def fanout(self):
+                return ray_tpu.get(
+                    [deref.remote(b) for b in self.boxes], timeout=60.0)
+
+        k = Keeper.remote()
+        ref = ray_tpu.put(np.full(1024 * 1024, 5, dtype=np.uint8))
+        ray_tpu.get(k.stash.remote([ref]), timeout=60.0)
+        del ref  # only the actor's stashed copy keeps it alive now
+        time.sleep(0.3)
+        assert ray_tpu.get(k.fanout.remote(), timeout=120.0) == [5]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_return_containing_ref():
+    """task returns {"r": ref}: the return's containment pin keeps the
+    inner object alive until the driver frees the container."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def makes_box():
+            inner = ray_tpu.put(np.full(512 * 1024, 4, dtype=np.uint8))
+            return {"r": inner}
+
+        box = ray_tpu.get(makes_box.remote(), timeout=60.0)
+        # the worker's own handle is long gone; containment must hold
+        val = ray_tpu.get(box["r"], timeout=30.0)
+        assert val[0] == 4
+        del val
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_chain_reconstruction_after_node_death():
+    """VERDICT done-criteria: a→b→c chain, all intermediates lost with
+    their node — get(c) recursively resubmits a then b then c."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    victim = cluster.add_node(num_cpus=2, resources={"victim": 2.0})
+    cluster.connect()
+    try:
+        @ray_tpu.remote(resources={"victim": 0.5}, num_cpus=0)
+        def step(x, inc):
+            return x + np.full(1024 * 1024, inc, dtype=np.int64)
+
+        a = step.remote(np.zeros(1024 * 1024, dtype=np.int64), 1)
+        b = step.remote(a, 10)
+        c = step.remote(b, 100)
+        assert ray_tpu.get(c, timeout=60.0)[0] == 111
+        victim.kill()
+        time.sleep(1.0)
+        cluster.add_node(num_cpus=2, resources={"victim": 2.0})
+        out = ray_tpu.get(c, timeout=120.0)
+        assert out[0] == 111 and out.shape == (1024 * 1024,)
+    finally:
+        cluster.shutdown()
+
+
+def test_borrower_crash_releases_holds():
+    """A borrowing process that dies has its holds swept on disconnect, so
+    a pending free eventually runs."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        class Borrower:
+            def hold(self, box):
+                self._box = box  # borrow lives in this process
+                return True
+
+        b = Borrower.remote()
+        r = ray_tpu.put(np.full(1024 * 1024, 2, dtype=np.uint8))
+        ray_tpu.get(b.hold.remote([r]), timeout=60.0)
+        rbin = r.binary().hex()
+        ray_tpu.kill(b)
+        del r
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            rc = _controller_refcounts()
+            if rbin not in rc["pending_free"]:
+                return
+            time.sleep(0.3)
+        pytest.fail(f"free never ran after borrower death: {rc}")
+    finally:
+        ray_tpu.shutdown()
