@@ -11,6 +11,7 @@ from .transformer import (  # noqa: F401
     TransformerConfig,
     init_params,
     forward,
+    forward_with_aux,
     lm_loss,
     make_train_step,
     count_params,

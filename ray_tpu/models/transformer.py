@@ -53,6 +53,14 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     attention_impl: str = "auto"      # "auto"|"flash"|"reference"|"ring"
     remat: bool = True
+    # -- pipeline parallelism (SURVEY §2.4 row 3; parallel/pipeline.py) -----
+    pp_stages: int = 1                # >1 → GPipe schedule over mesh "pp"
+    pp_microbatches: Optional[int] = None  # None → pp_stages
+    # -- mixture of experts (SURVEY §2.4 row 5; ops/moe.py) -----------------
+    n_experts: int = 0                # 0 → dense FFN
+    expert_top_k: int = 2
+    capacity_factor: float = 2.0
+    router_aux_weight: float = 0.01   # Switch load-balancing loss weight
 
     @property
     def head_dim(self) -> int:
@@ -108,13 +116,26 @@ class TransformerConfig:
         return TransformerConfig(**defaults)
 
 
-def count_params(cfg: TransformerConfig) -> int:
+def _per_layer_matmul_params(cfg: TransformerConfig, active: bool) -> int:
+    """Matmul parameters per layer; for MoE, ``active`` counts only the
+    top-k experts a token actually visits (the FLOP count), while
+    ``active=False`` counts every expert (the memory count)."""
     d, ff, hd = cfg.d_model, cfg.ff_dim, cfg.head_dim
     attn = d * cfg.n_heads * hd + 2 * d * cfg.kv_heads * hd \
         + cfg.n_heads * hd * d
-    mlp = d * ff * (3 if cfg.activation == "swiglu" else 2)
+    base_mlp = d * ff * (3 if cfg.activation == "swiglu" else 2)
+    if cfg.n_experts:
+        mult = cfg.expert_top_k if active else cfg.n_experts
+        mlp = mult * base_mlp + d * cfg.n_experts  # + router
+    else:
+        mlp = base_mlp
+    return attn + mlp
+
+
+def count_params(cfg: TransformerConfig) -> int:
+    d = cfg.d_model
     norms = 2 * d * (2 if cfg.norm == "layernorm" else 1)
-    per_layer = attn + mlp + norms
+    per_layer = _per_layer_matmul_params(cfg, active=False) + norms
     emb = cfg.vocab_size * d
     if cfg.pos_emb == "learned":
         emb += cfg.max_seq_len * d
@@ -124,13 +145,11 @@ def count_params(cfg: TransformerConfig) -> int:
 
 
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
-    """Training FLOPs/token: 6*N_matmul + causal attention term."""
-    n = count_params(cfg)
-    emb = cfg.vocab_size * cfg.d_model
-    if cfg.pos_emb == "learned":
-        emb += cfg.max_seq_len * cfg.d_model
-    n_matmul = n - emb + (cfg.vocab_size * cfg.d_model
-                          if cfg.tie_embeddings else 0)
+    """Training FLOPs/token: 6*N_active_matmul + causal attention term."""
+    d = cfg.d_model
+    unembed = cfg.vocab_size * d  # tied or not, the logits matmul runs
+    n_matmul = cfg.n_layers * _per_layer_matmul_params(cfg, active=True) \
+        + unembed
     attn = 6 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len  # ≈ qk+pv
     return 6 * n_matmul + attn
 
@@ -165,8 +184,6 @@ def init_params(key: jax.Array, cfg: TransformerConfig
             "wv": stack(next(keys), (d, hk, hd), d),
             "wo": stack(next(keys), (h, hd, d), h * hd),
             "mlp_norm": jnp.ones((L, d), pt),
-            "w_in": stack(next(keys), (d, ff), d),
-            "w_out": stack(next(keys), (ff, d), ff),
         },
         "final_norm": jnp.ones((d,), pt),
     }
@@ -179,14 +196,28 @@ def init_params(key: jax.Array, cfg: TransformerConfig
             "wv": ("layers", "embed", "heads", "kv"),
             "wo": ("layers", "heads", "kv", "embed"),
             "mlp_norm": ("layers", "embed"),
-            "w_in": ("layers", "embed", "mlp"),
-            "w_out": ("layers", "mlp", "embed"),
         },
         "final_norm": ("embed",),
     }
-    if cfg.activation == "swiglu":
-        params["layers"]["w_gate"] = stack(next(keys), (d, ff), d)
-        axes["layers"]["w_gate"] = ("layers", "embed", "mlp")
+    if cfg.n_experts:
+        E = cfg.n_experts
+        params["layers"]["router"] = stack(next(keys), (d, E), d)
+        axes["layers"]["router"] = ("layers", "embed", "expert")
+        params["layers"]["w_in"] = stack(next(keys), (E, d, ff), d)
+        axes["layers"]["w_in"] = ("layers", "expert", "embed", "mlp")
+        params["layers"]["w_out"] = stack(next(keys), (E, ff, d), ff)
+        axes["layers"]["w_out"] = ("layers", "expert", "mlp", "embed")
+        if cfg.activation == "swiglu":
+            params["layers"]["w_gate"] = stack(next(keys), (E, d, ff), d)
+            axes["layers"]["w_gate"] = ("layers", "expert", "embed", "mlp")
+    else:
+        params["layers"]["w_in"] = stack(next(keys), (d, ff), d)
+        axes["layers"]["w_in"] = ("layers", "embed", "mlp")
+        params["layers"]["w_out"] = stack(next(keys), (ff, d), ff)
+        axes["layers"]["w_out"] = ("layers", "mlp", "embed")
+        if cfg.activation == "swiglu":
+            params["layers"]["w_gate"] = stack(next(keys), (d, ff), d)
+            axes["layers"]["w_gate"] = ("layers", "embed", "mlp")
     if cfg.norm == "layernorm":
         params["layers"]["attn_norm_b"] = jnp.zeros((L, d), pt)
         params["layers"]["mlp_norm_b"] = jnp.zeros((L, d), pt)
@@ -215,7 +246,8 @@ def _norm(cfg, x, scale, bias):
 
 
 def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
-           cos, sin) -> jnp.ndarray:
+           cos, sin) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One transformer block; returns (x, router_aux_loss)."""
     b, s, d = x.shape
     h, hk, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -232,6 +264,14 @@ def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
     x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
 
     y = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.n_experts:
+        from ..ops.moe import moe_ffn
+        z, aux = moe_ffn(
+            y, lp["router"], lp["w_in"], lp["w_out"], lp.get("w_gate"),
+            top_k=cfg.expert_top_k, capacity_factor=cfg.capacity_factor)
+        x = x + z
+        return x, aux
     if cfg.activation == "swiglu":
         up = jnp.einsum("bsd,df->bsf", y, lp["w_in"].astype(dt))
         gate = jnp.einsum("bsd,df->bsf", y, lp["w_gate"].astype(dt))
@@ -239,12 +279,16 @@ def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
     else:
         z = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, lp["w_in"].astype(dt)))
     x = x + jnp.einsum("bsf,fd->bsd", z, lp["w_out"].astype(dt))
-    return x
+    return x, aux
 
 
-def forward(params: Params, tokens: jnp.ndarray,
-            cfg: TransformerConfig) -> jnp.ndarray:
-    """tokens [batch, seq] int32 → logits [batch, seq, vocab] fp32."""
+def forward_with_aux(params: Params, tokens: jnp.ndarray,
+                     cfg: TransformerConfig
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [batch, seq] int32 → (logits [batch, seq, vocab] fp32,
+    mean router aux loss).  With ``cfg.pp_stages > 1`` the layer stack runs
+    as a GPipe pipeline over the ambient mesh's ``pp`` axis
+    (parallel/pipeline.py); otherwise a plain `lax.scan`."""
     b, s = tokens.shape
     dt = cfg.dtype
     x = params["embed"]["tok"][tokens].astype(dt)
@@ -259,14 +303,44 @@ def forward(params: Params, tokens: jnp.ndarray,
                                policy=jax.checkpoint_policies.nothing_saveable)
 
     def body(carry, lp):
-        return layer(carry, lp, cos, sin), None
+        h, aux = carry
+        h, aux_l = layer(h, lp, cos, sin)
+        return (h, aux + aux_l), None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    if cfg.pp_stages > 1:
+        from ..parallel.pipeline import (microbatch, pipeline_apply,
+                                         unmicrobatch)
+        if cfg.n_layers % cfg.pp_stages:
+            raise ValueError(f"{cfg.n_layers} layers not divisible by "
+                             f"{cfg.pp_stages} pipeline stages")
+        n_micro = cfg.pp_microbatches or cfg.pp_stages
+
+        def stage_fn(slab, state):
+            out, _ = jax.lax.scan(body, state, slab)
+            return out
+
+        x_mb = (microbatch(x, n_micro),
+                jnp.zeros((n_micro,), jnp.float32))
+        h_mb, aux_mb = pipeline_apply(
+            stage_fn, params["layers"], x_mb,
+            n_stages=cfg.pp_stages, n_micro=n_micro)
+        x = unmicrobatch(h_mb)
+        aux = aux_mb.sum() / (n_micro * cfg.n_layers)
+    else:
+        (x, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+        aux = aux / cfg.n_layers
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     w_out = (params["embed"]["tok"].T if cfg.tie_embeddings
              else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, w_out.astype(dt))
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux
+
+
+def forward(params: Params, tokens: jnp.ndarray,
+            cfg: TransformerConfig) -> jnp.ndarray:
+    """tokens [batch, seq] int32 → logits [batch, seq, vocab] fp32."""
+    return forward_with_aux(params, tokens, cfg)[0]
 
 
 def lm_loss(params: Params, batch: Dict[str, jnp.ndarray],
@@ -278,14 +352,16 @@ def lm_loss(params: Params, batch: Dict[str, jnp.ndarray],
     # run the model on the FULL sequence and shift the logits: keeps the
     # model's seq length divisible by sequence-parallel mesh axes (sp)
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg)[:, :-1]
+    logits, aux = forward_with_aux(params, tokens, cfg)
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    aux_term = cfg.router_aux_weight * aux if cfg.n_experts else 0.0
     mask = batch.get("mask")
     if mask is not None:
         mask = mask[:, 1:].astype(jnp.float32)
-        return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return losses.mean()
+        return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0) + aux_term
+    return losses.mean() + aux_term
 
 
 def make_train_step(cfg: TransformerConfig, optimizer):

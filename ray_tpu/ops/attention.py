@@ -45,7 +45,11 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         s_q, s_k = q.shape[1], k.shape[1]
         rows = jnp.arange(s_q)[:, None] + (s_k - s_q)
         mask = rows >= jnp.arange(s_k)[None, :]
-        s = jnp.where(mask, s, -jnp.inf)
+        # additive bias rather than jnp.where: a select against an invariant
+        # constant inside a partial-manual shard_map scan (the pp pipeline)
+        # trips an XLA partitioner CHECK ("invalid binary opcode copy");
+        # adds fuse into the matmul epilogue anyway
+        s = s + (1.0 - mask.astype(jnp.float32)) * -1e30
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -75,7 +75,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_prev = m_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # A row fully masked within a live block (causal with s_q > s_kv:
+        # rows above the diagonal of their first k-block) has m_new ==
+        # _NEG_INF, making exp(s - m_new) == 1 for every masked column —
+        # zero those rows instead of averaging V uniformly.
+        p = jnp.where(m_new <= _NEG_INF * 0.5, 0.0, jnp.exp(s - m_new))
         l_ref[...] = jnp.broadcast_to(
             alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True),
             l_ref.shape)
@@ -91,8 +95,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1],
-                                                           1e-30))
+        # Dead rows (m still _NEG_INF) get lse = 0 so the backward kernels'
+        # exp(s - lse) = exp(_NEG_INF) underflows to zero gradient; the
+        # natural m + log(l) would be ~ -1e30 - 69, making s - lse positive.
+        m = m_ref[:, :1]
+        lse_ref[0, 0] = jnp.where(
+            m <= _NEG_INF * 0.5, 0.0,
+            m + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30)))
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):

@@ -1,0 +1,132 @@
+"""Mixture-of-experts FFN with top-k routing and capacity-based dispatch.
+
+The reference has no expert parallelism at all (SURVEY.md §2.4 row 5 —
+"Absent"); this is the TPU-native deliverable for that row.  The design is
+the GShard/Switch einsum formulation, which is what maps onto the MXU and
+onto GSPMD's all_to_all insertion:
+
+  * router logits → top-k gate weights per token,
+  * a dense one-hot *dispatch* tensor [batch, seq, experts, capacity]
+    scatters tokens into per-expert buffers (einsum, no gather loops),
+  * expert FFNs run batched over a leading ``expert`` axis — sharding that
+    axis over the mesh's ``ep`` axis makes XLA insert the all_to_all
+    dispatch/combine pair over ICI,
+  * a *combine* tensor (same shape, gate-weighted) merges expert outputs
+    back to token order.
+
+Tokens beyond an expert's capacity are dropped (their combine weight is
+zero and the residual connection carries them through unchanged) — the
+standard Switch-Transformer overflow policy.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(seq_tokens: int, n_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Per-expert buffer size: ``ceil(tokens * k / E * factor)`` rounded up
+    to a multiple of 8 (TPU sublane alignment)."""
+    cap = math.ceil(seq_tokens * top_k * capacity_factor / n_experts)
+    return max(8, ((cap + 7) // 8) * 8)
+
+
+def route(y: jnp.ndarray, router_w: jnp.ndarray, top_k: int,
+          capacity: int):
+    """Compute dispatch/combine tensors.
+
+    y: [b, s, d] activations; router_w: [d, E].
+    Returns (dispatch [b,s,E,C] bool-ish, combine [b,s,E,C] float32,
+    aux_loss scalar) where aux_loss is the Switch load-balancing loss.
+    """
+    b, s, _ = y.shape
+    n_experts = router_w.shape[-1]
+    logits = jnp.einsum("bsd,de->bse", y.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)                     # [b,s,E]
+    gate_k, idx_k = jax.lax.top_k(gates, top_k)                 # [b,s,k]
+    gate_k = gate_k / jnp.maximum(gate_k.sum(-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(idx_k, n_experts, dtype=jnp.float32)  # [b,s,k,E]
+    # Position of each (token, choice) within its expert's buffer: running
+    # count over the flattened (s*k) selection order.
+    flat = onehot.reshape(b, s * top_k, n_experts)
+    pos = (jnp.cumsum(flat, axis=1) - flat).reshape(b, s, top_k, n_experts)
+    within = (pos < capacity).astype(jnp.float32) * onehot      # [b,s,k,E]
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)   # [b,s,k,E,C]
+    # combine[b,s,e,c] = sum_k gate_k * 1[expert k == e] * 1[slot k == c]
+    combine = jnp.einsum("bsk,bske,bskec->bsec",
+                         gate_k, within, pos_oh)                # [b,s,E,C]
+    dispatch = (combine > 0.0).astype(y.dtype)
+
+    # Switch load-balancing aux loss: E * sum_e f_e * p_e where f_e is the
+    # fraction of tokens routed (top-1) to e and p_e the mean gate prob.
+    top1 = jax.nn.one_hot(idx_k[..., 0], n_experts, dtype=jnp.float32)
+    aux = n_experts * jnp.mean(
+        jnp.mean(top1, axis=(0, 1)) * jnp.mean(gates, axis=(0, 1)))
+    return dispatch, combine, aux
+
+
+def moe_ffn(y: jnp.ndarray, router_w: jnp.ndarray, w_in: jnp.ndarray,
+            w_out: jnp.ndarray, w_gate: Optional[jnp.ndarray] = None, *,
+            top_k: int = 2, capacity_factor: float = 2.0,
+            constrain=None):
+    """MoE feed-forward block.
+
+    y [b,s,d]; router_w [d,E]; w_in [E,d,f]; w_out [E,f,d];
+    w_gate [E,d,f] selects SwiGLU (None → GELU).
+    Returns (out [b,s,d], aux_loss).  ``constrain(x, logical_axes)`` is an
+    optional sharding-constraint hook — the expert-major intermediates get
+    ("expert", ...) so the `ep` mesh axis produces all_to_alls.
+    """
+    b, s, d = y.shape
+    n_experts = w_in.shape[0]
+    dt = y.dtype
+    cap = expert_capacity(s, n_experts, top_k, capacity_factor)
+    dispatch, combine, aux = route(y, router_w, top_k, cap)
+
+    # dispatch: token-major → expert-major [E, b, C, d] (GSPMD all_to_all
+    # happens here when `ep` shards the leading axis and batch shards b)
+    xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(dt), y)
+    if constrain is not None:
+        xe = constrain(xe, ("expert", "batch", None, None))
+    up = jnp.einsum("ebcd,edf->ebcf", xe, w_in.astype(dt))
+    if w_gate is not None:
+        gate = jnp.einsum("ebcd,edf->ebcf", xe, w_gate.astype(dt))
+        z = jax.nn.silu(gate) * up
+    else:
+        z = jax.nn.gelu(up)
+    oe = jnp.einsum("ebcf,efd->ebcd", z, w_out.astype(dt))
+    if constrain is not None:
+        oe = constrain(oe, ("expert", "batch", None, None))
+    out = jnp.einsum("ebcd,bsec->bsd", oe, combine.astype(dt))
+    return out, aux
+
+
+def moe_ffn_reference(y, router_w, w_in, w_out, w_gate=None, *, top_k=2):
+    """Slow per-token loop-free reference (no capacity limit): every token
+    is processed by its top-k experts exactly.  Used by tests to validate
+    the dispatch-einsum path (which must agree when capacity is ample)."""
+    b, s, d = y.shape
+    n_experts = w_in.shape[0]
+    f32 = jnp.float32
+    gates = jax.nn.softmax(jnp.einsum("bsd,de->bse", y.astype(f32),
+                                      router_w.astype(f32)), axis=-1)
+    gate_k, idx_k = jax.lax.top_k(gates, top_k)
+    gate_k = gate_k / jnp.maximum(gate_k.sum(-1, keepdims=True), 1e-9)
+    yf = y.astype(f32)
+    up = jnp.einsum("bsd,edf->bsef", yf, w_in.astype(f32))
+    if w_gate is not None:
+        g = jnp.einsum("bsd,edf->bsef", yf, w_gate.astype(f32))
+        z = jax.nn.silu(g) * up
+    else:
+        z = jax.nn.gelu(up)
+    all_out = jnp.einsum("bsef,efd->bsed", z, w_out.astype(f32))  # [b,s,E,d]
+    weight = jnp.einsum("bsk,bske->bse", gate_k,
+                        jax.nn.one_hot(idx_k, n_experts, dtype=f32))
+    return jnp.einsum("bsed,bse->bsd", all_out, weight).astype(y.dtype)
