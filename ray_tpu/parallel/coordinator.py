@@ -49,25 +49,20 @@ def join_mesh_gang(group_name: str, world_size: int,
         return create_mesh(spec)
 
     if rank is None:
-        # First-come rank assignment through an atomic KV counter emulation:
-        # claim the lowest unclaimed slot.
-        for attempt in range(world_size * 4):
-            for r in range(world_size):
-                key = f"{group_name}/rank/{r}".encode()
-                claim = f"{socket.gethostname()}:{id(core)}".encode()
-                if not _kv(core).call("kv_exists", {"ns": _NS, "key": key}):
-                    _kv(core).call("kv_put", {"ns": _NS, "key": key,
-                                              "value": claim})
-                    # Re-read to detect a lost race (last-write-wins store).
-                    if _kv(core).call("kv_get",
-                                      {"ns": _NS, "key": key}) == claim:
-                        rank = r
-                        break
-            if rank is not None:
+        # First-come rank assignment: claim the lowest unclaimed slot with a
+        # real compare-and-set (kv_put overwrite=False is atomic inside the
+        # controller's single event loop) — no check-then-put race.
+        claim = f"{socket.gethostname()}:{id(core)}".encode()
+        for r in range(world_size):
+            key = f"{group_name}/rank/{r}".encode()
+            if _kv(core).call("kv_put", {"ns": _NS, "key": key,
+                                         "value": claim,
+                                         "overwrite": False}):
+                rank = r
                 break
-            time.sleep(0.05)
         if rank is None:
-            raise TimeoutError(f"could not claim a rank in {group_name}")
+            raise TimeoutError(f"could not claim a rank in {group_name}: "
+                               f"all {world_size} slots taken")
 
     addr_key = f"{group_name}/coordinator".encode()
     if rank == 0:

@@ -30,11 +30,14 @@ class _Reducer:
         self._pending: Dict[str, list] = {}
         self._done: Dict[str, Any] = {}
 
-    def contribute(self, key: str, value, op: str):
-        entry = self._pending.setdefault(key, [])
-        entry.append(value)
-        if len(entry) == self.world_size:
-            arrs = [np.asarray(v) for v in entry]
+    def contribute(self, key: str, value, op: str, rank: int = 0):
+        # Rank-indexed slots: gather must return results in world-rank order
+        # (callers index the list by rank), matching the reference's
+        # rank-ordered allgather and _GroupActor's behavior.
+        entry = self._pending.setdefault(key, [None] * self.world_size)
+        entry[rank] = np.asarray(value)
+        if all(v is not None for v in entry):
+            arrs = entry
             if op == "sum" or op == "mean":
                 out = np.sum(arrs, axis=0)
                 if op == "mean":
@@ -72,7 +75,9 @@ def _run(key: str, value, op: str, timeout_s: float = 120.0):
         if op == "gather":
             return [value]
         return np.asarray(value)
-    api.get(_REDUCER.contribute.remote(key, value, op), timeout=timeout_s)
+    from ..air.session import get_world_rank
+    api.get(_REDUCER.contribute.remote(key, value, op, get_world_rank()),
+            timeout=timeout_s)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         out = api.get(_REDUCER.fetch.remote(key), timeout=timeout_s)

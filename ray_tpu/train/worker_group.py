@@ -82,19 +82,27 @@ class TrainWorker:
                 session.queue.put(None)  # sentinel: training done
 
         self._error = None
+        self._finished = False
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
         return True
 
     def next_result(self, timeout_s: float = 10.0):
         """One queued report (metrics + optional checkpoint bytes), the
-        sentinel None when training ended, or "__timeout__"."""
+        sentinel None when training ended, or "__timeout__".  Completion is
+        latched: after the sentinel has been seen once, every later poll
+        returns None immediately — ranks that finish (or fail) early must
+        not turn into perpetual "__timeout__"s that keep the executor's
+        all-None termination condition unreachable."""
         import queue as _q
+        if getattr(self, "_finished", False):
+            return None
         try:
             item = self._session.queue.get(timeout=timeout_s)
         except _q.Empty:
             return "__timeout__"
         if item is None:
+            self._finished = True
             return None
         ckpt = item.get("checkpoint")
         if ckpt is not None:

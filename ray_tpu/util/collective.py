@@ -84,9 +84,12 @@ class _GroupClient:
         self._counters: Dict[str, int] = {}
 
     def _key(self, tag: str) -> str:
+        return f"{tag}/{self._seq(tag)}"
+
+    def _seq(self, tag: str) -> int:
         n = self._counters.get(tag, 0)
         self._counters[tag] = n + 1
-        return f"{tag}/{n}"
+        return n
 
     def _await(self, getter, key: str, timeout_s: float):
         deadline = time.monotonic() + timeout_s
@@ -185,14 +188,17 @@ def broadcast(value, *, src_rank: int = 0, group_name: str = "default",
 def send(value, dst_rank: int, *, group_name: str = "default",
          timeout_s: float = 120.0) -> None:
     g = _group(group_name)
-    key = f"p2p/{g.rank}->{dst_rank}/{g._key('send')}"
+    # Sequence per (src,dst) pair: the nth send from src to dst matches the
+    # nth recv of dst from src, regardless of traffic to/from other peers or
+    # asymmetric send/recv counts (a shared counter deadlocks those).
+    key = f"p2p/{g.rank}->{dst_rank}/{g._seq(f'send:{dst_rank}')}"
     api.get(g.handle.post.remote(key, value), timeout=timeout_s)
 
 
 def recv(src_rank: int, *, group_name: str = "default",
          timeout_s: float = 120.0):
     g = _group(group_name)
-    key = f"p2p/{src_rank}->{g.rank}/{g._key('send')}"
+    key = f"p2p/{src_rank}->{g.rank}/{g._seq(f'recv:{src_rank}')}"
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         out = api.get(g.handle.take.remote(key), timeout=timeout_s)
