@@ -20,4 +20,5 @@ from .api import (  # noqa: F401
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
 from .deployment import Deployment, deployment  # noqa: F401
+from .gang import GangContext, get_gang_context  # noqa: F401
 from .handle import ServeHandle  # noqa: F401

@@ -63,6 +63,9 @@ def run(target: Deployment, *, name: Optional[str] = None,
         "autoscaling_config": (
             vars(dep.config.autoscaling_config)
             if dep.config.autoscaling_config else None),
+        "gang_size": dep.config.gang_size,
+        "gang_mesh": dep.config.gang_mesh,
+        "gang_strategy": dep.config.gang_strategy,
     }
     core_api.get(_state["controller"].deploy.remote(
         dep_name, dumps_function(dep.func_or_class), dep.init_args,

@@ -30,3 +30,7 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
     version: int = 0
+    # -- gang replicas (serve/gang.py): one replica spanning N processes --
+    gang_size: int = 1                    # >1 → replica is a mesh gang
+    gang_mesh: Optional[str] = None       # MeshSpec text, e.g. "tp=2"
+    gang_strategy: str = "PACK"           # placement group strategy

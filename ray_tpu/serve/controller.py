@@ -43,8 +43,9 @@ class ServeController:
         entry = self._deployments[name]
         entry["config"]["user_config"] = user_config
         from .. import api
-        api.get([r["handle"].reconfigure.remote(user_config)
-                 for r in entry["replicas"]], timeout=60.0)
+        api.get([m.reconfigure.remote(user_config)
+                 for r in entry["replicas"]
+                 for m in (r.get("gang") or [r["handle"]])], timeout=60.0)
         self._version += 1
         return True
 
@@ -77,9 +78,18 @@ class ServeController:
         from .replica import ServeReplica
         entry = self._deployments[name]
         cfg = entry.get("config", {})
+        gang_size = int(cfg.get("gang_size", 1) or 1)
         while len(entry["replicas"]) < target:
             self._replica_seq += 1
             rid = f"{name}#{self._replica_seq}"
+            if gang_size > 1:
+                # Multi-process replica: a placement-group gang hosting one
+                # sharded program (serve/gang.py); the routing table carries
+                # only the leader, so the router sees one unit.
+                from .gang import start_gang_replica
+                entry["replicas"].append(
+                    start_gang_replica(name, rid, entry, cfg))
+                continue
             opts = dict(cfg.get("ray_actor_options") or {})
             handle = api.remote(ServeReplica).options(
                 max_concurrency=int(cfg.get("max_concurrent_queries", 8)),
@@ -90,6 +100,10 @@ class ServeController:
             entry["replicas"].append({"id": rid, "handle": handle})
         while len(entry["replicas"]) > target:
             rep = entry["replicas"].pop()
+            if rep.get("gang"):
+                from .gang import stop_gang_replica
+                stop_gang_replica(rep)
+                continue
             try:
                 api.kill(rep["handle"])
             except Exception:

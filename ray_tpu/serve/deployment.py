@@ -29,7 +29,10 @@ class Deployment:
                 autoscaling_config: Optional[AutoscalingConfig] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
                 route_prefix: Optional[str] = "__keep__",
-                name: Optional[str] = None) -> "Deployment":
+                name: Optional[str] = None,
+                gang_size: Optional[int] = None,
+                gang_mesh: Optional[str] = None,
+                gang_strategy: Optional[str] = None) -> "Deployment":
         cfg = dataclasses.replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
@@ -41,6 +44,12 @@ class Deployment:
             cfg.autoscaling_config = autoscaling_config
         if ray_actor_options is not None:
             cfg.ray_actor_options = ray_actor_options
+        if gang_size is not None:
+            cfg.gang_size = gang_size
+        if gang_mesh is not None:
+            cfg.gang_mesh = gang_mesh
+        if gang_strategy is not None:
+            cfg.gang_strategy = gang_strategy
         return dataclasses.replace(
             self, config=cfg,
             name=name or self.name,
@@ -64,14 +73,18 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
                user_config: Any = None,
                autoscaling_config: Optional[AutoscalingConfig] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               route_prefix: Optional[str] = None):
+               route_prefix: Optional[str] = None,
+               gang_size: int = 1, gang_mesh: Optional[str] = None,
+               gang_strategy: str = "PACK"):
     def wrap(fc: Callable) -> Deployment:
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_concurrent_queries=max_concurrent_queries,
             user_config=user_config,
             autoscaling_config=autoscaling_config,
-            ray_actor_options=ray_actor_options or {})
+            ray_actor_options=ray_actor_options or {},
+            gang_size=gang_size, gang_mesh=gang_mesh,
+            gang_strategy=gang_strategy)
         return Deployment(fc, name or fc.__name__, cfg,
                           route_prefix=route_prefix)
 

@@ -1,0 +1,102 @@
+"""Gang replicas: one Serve replica spanning multiple processes.
+
+VERDICT round-1 item 6 done-criteria: a 2-process replica serving a TP=2
+sharded transformer — the replica is a placement-group gang whose members
+join one `jax.distributed` runtime (each contributes its own CPU device;
+Gloo plays ICI's role on the test mesh), the model's weights are sharded
+over the cross-process ``tp`` axis, and the router addresses the gang as
+one unit (reference contrast: `serve/_private/replica.py:250` replicas are
+single actors; `deployment_state.py:958` reconciles only those).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_gang_replica_tp2_across_processes(serve_cluster):
+    class ShardedModel:
+        """A TP=2-sharded transformer whose shards live across the gang."""
+
+        def __init__(self, seed: int):
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.models import TransformerConfig, forward, init_params
+            from ray_tpu.parallel import FSDP_TP_RULES, pytree_shardings
+
+            ctx = serve.get_gang_context()
+            assert ctx is not None and ctx.world_size == 2
+            self.ctx = ctx
+            mesh = ctx.mesh
+            # one device per process → the tp axis spans the two processes
+            assert mesh.devices.size == 2, mesh
+            assert len({d.process_index for d in mesh.devices.flat}) == 2, \
+                "mesh must span both gang processes"
+            self.cfg = TransformerConfig.tiny(max_seq_len=32,
+                                              attention_impl="reference",
+                                              dtype=jnp.float32)
+            params, axes = init_params(jax.random.PRNGKey(seed), self.cfg)
+            shardings = pytree_shardings(axes, mesh, FSDP_TP_RULES)
+            self.params = jax.device_put(params, shardings)
+            self._fwd = jax.jit(
+                lambda p, t: forward(p, t, self.cfg),
+                out_shardings=NamedSharding(mesh, P()))  # replicated output
+            self.mesh = mesh
+
+        def __call__(self, tokens):
+            import jax
+            import jax.numpy as jnp
+            with jax.set_mesh(self.mesh):
+                logits = self._fwd(self.params,
+                                   jnp.asarray(tokens, dtype=jnp.int32))
+            # replicated out_sharding → every member (incl. the leader) holds
+            # the full logits; return summary stats to the router
+            local = np.asarray(jax.device_get(logits.addressable_shards[0].data))
+            return {"rank": self.ctx.rank, "shape": list(logits.shape),
+                    "mean": float(local.mean()), "argmax0": int(
+                        local[0, -1].argmax())}
+
+        def stats(self):
+            return {"rank": self.ctx.rank, "world": self.ctx.world_size}
+
+    dep = serve.deployment(
+        ShardedModel, name="sharded_lm", gang_size=2, gang_mesh="tp=2",
+        ray_actor_options={
+            "num_cpus": 1.0,
+            # one device per member process so the tp axis truly spans the
+            # two processes (conftest's 8 virtual devices would otherwise
+            # put both tp shards inside each member)
+            "runtime_env": {"env_vars": {
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}},
+        }).bind(0)
+    handle = serve.run(dep)
+
+    tokens = np.arange(8, dtype=np.int32).reshape(1, 8) % 50
+    out = handle.remote(tokens).result(timeout_s=300.0)
+    assert out["rank"] == 0, "router must answer from the gang leader"
+    assert out["shape"][0] == 1 and out["shape"][1] == 8
+    assert np.isfinite(out["mean"])
+
+    # determinism across repeated requests through the same gang program
+    out2 = handle.remote(tokens).result(timeout_s=120.0)
+    assert out2["mean"] == out["mean"]
+
+    # method routing still works on gang replicas
+    st = handle.stats.remote().result(timeout_s=120.0)
+    assert st == {"rank": 0, "world": 2}
+
+    # the deployment reports a single replica (the gang is one unit)
+    deps = serve.list_deployments()
+    assert deps["sharded_lm"]["num_replicas"] == 1
