@@ -33,9 +33,22 @@ class ClusterNode:
 class Cluster:
     def __init__(self, *, heartbeat_timeout_s: float = 2.0):
         self.session_dir = node_mod.new_session_dir()
+        self.heartbeat_timeout_s = heartbeat_timeout_s
         self.controller_proc, self.controller_addr = node_mod.start_controller(
             self.session_dir, heartbeat_timeout_s)
         self.nodes: List[ClusterNode] = []
+
+    def kill_controller(self):
+        """Hard-kill the control plane (fault injection for controller FT)."""
+        self.controller_proc.kill(sig_term_first=False)
+
+    def restart_controller(self):
+        """Restart the controller at the SAME address; it restores its
+        tables from the session's snapshot+WAL and live nodelets re-register
+        over their heartbeat reconnect loops."""
+        port = int(self.controller_addr.rsplit(":", 1)[1])
+        self.controller_proc, self.controller_addr = node_mod.start_controller(
+            self.session_dir, self.heartbeat_timeout_s, port=port)
 
     def add_node(self, *, num_cpus: float = 4, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,

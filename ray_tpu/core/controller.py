@@ -83,7 +83,8 @@ class NodeRecord:
 
 class Controller:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat_timeout_s: float = 5.0):
+                 heartbeat_timeout_s: float = 5.0,
+                 persist_dir: Optional[str] = None):
         self.server = rpc.RpcServer(host, port)
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.nodes: Dict[str, NodeRecord] = {}
@@ -110,7 +111,76 @@ class Controller:
         self.jobs: Dict[bytes, dict] = {}
         self._pending_actor_wakeup = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
+        self._pub_buf: Dict[int, tuple] = {}   # conn id -> (conn, events)
+        self._pub_flusher: Optional[asyncio.Task] = None
+        # -- durability (reference: gcs_table_storage.h:357 Redis-backed
+        # GCS restart; here snapshot+WAL on local disk, persistence.py) ----
+        self.pstore = None
+        if persist_dir:
+            from .persistence import ControllerStore
+            self.pstore = ControllerStore(persist_dir)
+            self.pstore._snapshot_provider = self._tables_snapshot
+            self._restore(self.pstore.load())
         self._register_handlers()
+
+    # ------------------------------------------------------------ durability
+    def _p(self, *record):
+        """Append one mutation to the WAL (no-op without persistence)."""
+        if self.pstore is not None:
+            self.pstore.append(*record)
+
+    @staticmethod
+    def _actor_to_disk(rec: "ActorRecord") -> dict:
+        return {"actor_id": rec.actor_id, "spec": rec.spec, "name": rec.name,
+                "max_restarts": rec.max_restarts, "detached": rec.detached,
+                "state": rec.state, "address": rec.address,
+                "node_id": rec.node_id, "num_restarts": rec.num_restarts,
+                "death_cause": rec.death_cause}
+
+    @staticmethod
+    def _pg_to_disk(pg: "PGRecord") -> dict:
+        return {"pg_id": pg.pg_id, "bundles": pg.bundles,
+                "strategy": pg.strategy, "name": pg.name, "state": pg.state,
+                "node_ids": pg.node_ids}
+
+    def _tables_snapshot(self) -> dict:
+        return {
+            "kv": {ns: dict(d) for ns, d in self.kv.items()},
+            "actors": {rec.actor_id: self._actor_to_disk(rec)
+                       for rec in self.actors.values()},
+            "named_actors": dict(self.named_actors),
+            "pgs": {pg.pg_id: self._pg_to_disk(pg)
+                    for pg in self.pgs.values()},
+            "jobs": {jid: info for jid, info in self.jobs.items()},
+        }
+
+    def _restore(self, state: Optional[dict]) -> None:
+        """Repopulate tables after a controller restart.  Live nodelets
+        re-register through their heartbeat reconnect loops; ALIVE actors
+        keep their addresses (their worker processes survived us)."""
+        if not state:
+            return
+        self.kv = {ns: dict(d) for ns, d in state.get("kv", {}).items()}
+        for d in state.get("actors", {}).values():
+            rec = ActorRecord(d["actor_id"], d["spec"], d.get("name"),
+                              d.get("max_restarts", 0),
+                              d.get("detached", False))
+            rec.state = d.get("state", PENDING_CREATION)
+            rec.address = d.get("address")
+            rec.node_id = d.get("node_id")
+            rec.num_restarts = d.get("num_restarts", 0)
+            rec.death_cause = d.get("death_cause")
+            if rec.state in (PENDING_CREATION, RESTARTING):
+                rec.node_id = None  # reschedule once nodes re-register
+            self.actors[rec.actor_id] = rec
+        self.named_actors = dict(state.get("named_actors", {}))
+        for d in state.get("pgs", {}).values():
+            pg = PGRecord(d["pg_id"], d["bundles"], d["strategy"],
+                          d.get("name", ""))
+            pg.state = d.get("state", "PENDING")
+            pg.node_ids = list(d.get("node_ids", []))
+            self.pgs[pg.pg_id] = pg
+        self.jobs = dict(state.get("jobs", {}))
 
     # ------------------------------------------------------------------ setup
     def _register_handlers(self):
@@ -152,14 +222,40 @@ class Controller:
         self.view_version += 1
 
     async def _broadcast(self, channel: str, data: Any):
+        """Buffered pub: events are coalesced per subscriber and flushed as
+        one ``pub_batch`` frame (reference: the batched long-poll publisher,
+        src/ray/pubsub/publisher.h + README — one wire message per
+        subscriber per flush instead of per event; matters for the
+        high-rate ``logs`` channel)."""
         for conn in list(self.subscribers.get(channel, ())):
             if conn.closed:
                 self.subscribers[channel].discard(conn)
                 continue
-            try:
-                await conn.notify("pub:" + channel, data)
-            except Exception:
-                self.subscribers[channel].discard(conn)
+            self._pub_buf.setdefault(id(conn), (conn, []))[1].append(
+                (channel, data))
+        if self._pub_buf and self._pub_flusher is None:
+            self._pub_flusher = asyncio.ensure_future(self._flush_pubs())
+
+    async def _flush_pubs(self):
+        try:
+            while self._pub_buf:
+                buf, self._pub_buf = self._pub_buf, {}
+                for conn, events in buf.values():
+                    if conn.closed:
+                        continue
+                    try:
+                        if len(events) == 1:
+                            ch, data = events[0]
+                            await conn.notify("pub:" + ch, data)
+                        else:
+                            await conn.notify("pub_batch",
+                                              {"events": events})
+                    except Exception:
+                        pass
+                if self._pub_buf:
+                    await asyncio.sleep(0.01)  # coalesce the burst
+        finally:
+            self._pub_flusher = None
 
     # ------------------------------------------------------------- node table
     async def _h_ping(self, conn, data):
@@ -240,10 +336,12 @@ class Controller:
 
     # --------------------------------------------------------------------- kv
     async def _h_kv_put(self, conn, data):
-        ns = self.kv.setdefault(data.get("ns", ""), {})
+        ns_name = data.get("ns", "")
+        ns = self.kv.setdefault(ns_name, {})
         key = data["key"]
         if data.get("overwrite", True) or key not in ns:
             ns[key] = data["value"]
+            self._p("kv_put", ns_name, key, data["value"])
             return True
         return False
 
@@ -251,7 +349,10 @@ class Controller:
         return self.kv.get(data.get("ns", ""), {}).get(data["key"])
 
     async def _h_kv_del(self, conn, data):
-        return self.kv.get(data.get("ns", ""), {}).pop(data["key"], None) is not None
+        hit = self.kv.get(data.get("ns", ""), {}).pop(data["key"], None) is not None
+        if hit:
+            self._p("kv_del", data.get("ns", ""), data["key"])
+        return hit
 
     async def _h_kv_exists(self, conn, data):
         return data["key"] in self.kv.get(data.get("ns", ""), {})
@@ -276,6 +377,7 @@ class Controller:
         self.actors[actor_id] = rec
         if name:
             self.named_actors[name] = actor_id
+        self._p("actor", self._actor_to_disk(rec))
         self._pending_actor_wakeup.set()
         return {"actor_id": actor_id, "existing": False}
 
@@ -346,6 +448,7 @@ class Controller:
         actor.address = data["address"]
         actor.worker_id = data["worker_id"]
         actor.node_id = data["node_id"]
+        self._p("actor", self._actor_to_disk(actor))
         self._notify_actor_waiters(actor)
         await self._broadcast("actors", actor.to_wire())
         return True
@@ -423,6 +526,7 @@ class Controller:
             if actor.name:
                 self.named_actors.pop(actor.name, None)
             self._notify_actor_waiters(actor)
+        self._p("actor", self._actor_to_disk(actor))
         await self._broadcast("actors", actor.to_wire())
 
     async def _h_kill_actor(self, conn, data):
@@ -447,6 +551,7 @@ class Controller:
         pg = PGRecord(data["pg_id"], data["bundles"], data.get("strategy", "PACK"),
                       data.get("name", ""))
         self.pgs[pg.pg_id] = pg
+        self._p("pg", self._pg_to_disk(pg))
         await self._try_create_pg(pg)
         return {"pg_id": pg.pg_id, "state": pg.state}
 
@@ -508,6 +613,7 @@ class Controller:
             return
         pg.node_ids = placement
         pg.state = "CREATED"
+        self._p("pg", self._pg_to_disk(pg))
         for ev in pg.waiters:
             ev.set()
         pg.waiters.clear()
@@ -548,6 +654,7 @@ class Controller:
                     except Exception:
                         pass
         pg.state = "REMOVED"
+        self._p("pg_del", pg.pg_id)
         await self._broadcast("pgs", pg.to_wire())
         return True
 
@@ -742,11 +849,13 @@ class Controller:
     # ------------------------------------------------------------------- jobs
     async def _h_register_job(self, conn, data):
         self.jobs[data["job_id"]] = {"start": time.time(), "driver": data.get("driver")}
+        self._p("job", data["job_id"], self.jobs[data["job_id"]])
         return True
 
     async def _h_finish_job(self, conn, data):
         job_id = data["job_id"]
-        self.jobs.pop(job_id, None)
+        if self.jobs.pop(job_id, None) is not None:
+            self._p("job_del", job_id)
         # Kill the job's non-detached actors.
         for actor in list(self.actors.values()):
             if actor.detached or actor.state == DEAD:
@@ -756,7 +865,8 @@ class Controller:
         return True
 
 
-async def run_controller(host: str, port: int, heartbeat_timeout_s: float = 5.0):
-    c = Controller(host, port, heartbeat_timeout_s)
+async def run_controller(host: str, port: int, heartbeat_timeout_s: float = 5.0,
+                         persist_dir: Optional[str] = None):
+    c = Controller(host, port, heartbeat_timeout_s, persist_dir=persist_dir)
     await c.start()
     return c

@@ -14,12 +14,15 @@ def main():
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--heartbeat-timeout", type=float, default=5.0)
+    p.add_argument("--persist-dir", default=None,
+                   help="snapshot+WAL dir for controller fault tolerance")
     args = p.parse_args()
 
     from .controller import Controller
 
     async def run():
-        c = Controller(args.host, args.port, args.heartbeat_timeout)
+        c = Controller(args.host, args.port, args.heartbeat_timeout,
+                       persist_dir=args.persist_dir)
         await c.start()
         print(f"CONTROLLER_READY {c.address}", flush=True)
         await asyncio.Event().wait()

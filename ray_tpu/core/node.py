@@ -89,12 +89,18 @@ class ProcessHandle:
 
 
 def start_controller(session_dir: str, heartbeat_timeout_s: float = 5.0,
-                     port: int = 0) -> tuple:
+                     port: int = 0, persist: bool = True) -> tuple:
+    """Persistence is on by default: the controller snapshots/WALs its
+    metadata tables under the session dir, so a restarted controller at
+    the same address resumes with actors/PGs/KV/jobs intact (reference:
+    GCS restart-from-Redis, gcs_table_storage.h:357)."""
     log = open(os.path.join(session_dir, "logs", "controller.err"), "ab")
+    cmd = [sys.executable, "-m", "ray_tpu.core.controller_main",
+           "--port", str(port), "--heartbeat-timeout", str(heartbeat_timeout_s)]
+    if persist:
+        cmd += ["--persist-dir", os.path.join(session_dir, "controller_state")]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.core.controller_main",
-         "--port", str(port), "--heartbeat-timeout", str(heartbeat_timeout_s)],
-        stdout=subprocess.PIPE, stderr=log, start_new_session=True,
+        cmd, stdout=subprocess.PIPE, stderr=log, start_new_session=True,
         env=_child_env())
     log.close()
     (addr,) = _read_ready_line(proc, "CONTROLLER_READY")

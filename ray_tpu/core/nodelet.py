@@ -108,6 +108,18 @@ class Nodelet:
         store_client.create_segment(self.store_path, self.store_capacity)
         self.store = store_client.StoreClient(self.store_path)
         await self.server.start()
+        await self._connect_controller()
+        for _ in range(GlobalConfig.worker_pool_initial_size):
+            self._spawn_worker()
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        return self
+
+    async def _connect_controller(self):
+        """Dial + register with the controller.  Also the RECONNECT path: a
+        restarted (persistence-restored) controller learns its live nodes
+        only from these re-registrations, so the heartbeat loop calls this
+        whenever the connection drops."""
         host, port = self.controller_addr.rsplit(":", 1)
         # The controller calls back over this same connection (actor starts,
         # PG 2PC, frees) — give it the full handler table plus pubsub.
@@ -125,11 +137,6 @@ class Nodelet:
         })
         await self.controller.call("subscribe", {"channel": "nodes"})
         self._apply_view(reply["view"], reply["view_version"])
-        for _ in range(GlobalConfig.worker_pool_initial_size):
-            self._spawn_worker()
-        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
-        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
-        return self
 
     async def stop(self):
         self._stopping = True
@@ -175,6 +182,8 @@ class Nodelet:
     async def _heartbeat_loop(self):
         while True:
             try:
+                if self.controller is None or self.controller.closed:
+                    await self._connect_controller()
                 reply = await self.controller.call("heartbeat", {
                     "node_id": self.node_id.hex(),
                     "available": self.available.to_dict(),
@@ -183,7 +192,7 @@ class Nodelet:
                 }, timeout=5)
                 if reply and "view" in reply:
                     self._apply_view(reply["view"], reply["view_version"])
-            except rpc.RpcError:
+            except (rpc.RpcError, OSError):
                 pass
             await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
 

@@ -1,0 +1,148 @@
+"""Controller state persistence: snapshot + write-ahead log.
+
+Capability mirror of the reference's GCS storage backends
+(/root/reference/src/ray/gcs/store_client/in_memory_store_client.h:27 →
+RedisGcsTableStorage, gcs_table_storage.h:357-361): the control plane's
+metadata tables survive a controller crash, so a restarted controller
+resumes with its actors, placement groups, KV, and jobs intact while live
+nodelets re-register over their heartbeat loops.
+
+Design: no external store (the reference needs Redis; a TPU-pod control
+plane should not).  Tables are msgpack'd to a snapshot file; every mutation
+between snapshots appends one length-prefixed msgpack record to a WAL.
+Recovery = load snapshot, replay WAL.  The WAL is compacted into a fresh
+snapshot every ``compact_every`` appends.  Mutation rate on the controller
+is low (actors/PGs/KV, never tasks), so fsync-per-append is affordable.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Dict, List, Optional
+
+import msgpack
+
+_LEN = struct.Struct("<I")
+
+
+def _pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpack(raw: bytes) -> Any:
+    return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+
+class ControllerStore:
+    """Durable home of the controller's metadata tables."""
+
+    def __init__(self, persist_dir: str, compact_every: int = 512,
+                 fsync: bool = True):
+        self.dir = persist_dir
+        os.makedirs(persist_dir, exist_ok=True)
+        self.snap_path = os.path.join(persist_dir, "controller.snapshot")
+        self.wal_path = os.path.join(persist_dir, "controller.wal")
+        self._wal = None
+        self._appends = 0
+        self._compact_every = compact_every
+        self._fsync = fsync
+        self._snapshot_provider = None  # set by the controller
+
+    # -- recovery ------------------------------------------------------------
+    def load(self) -> Optional[Dict[str, Any]]:
+        """Snapshot + WAL replay → tables dict, or None on first boot."""
+        state: Optional[Dict[str, Any]] = None
+        if os.path.exists(self.snap_path):
+            with open(self.snap_path, "rb") as f:
+                state = _unpack(f.read())
+        records = self._read_wal()
+        if records and state is None:
+            state = _empty_tables()
+        for rec in records:
+            _apply(state, rec)
+        return state
+
+    def _read_wal(self) -> List[tuple]:
+        if not os.path.exists(self.wal_path):
+            return []
+        out = []
+        with open(self.wal_path, "rb") as f:
+            raw = f.read()
+        off = 0
+        while off + _LEN.size <= len(raw):
+            (n,) = _LEN.unpack_from(raw, off)
+            off += _LEN.size
+            if off + n > len(raw):
+                break  # torn tail write: discard (snapshot+prefix is valid)
+            out.append(_unpack(raw[off:off + n]))
+            off += n
+        return out
+
+    # -- mutation log --------------------------------------------------------
+    def append(self, *record: Any) -> None:
+        if self._wal is None:
+            self._wal = open(self.wal_path, "ab")
+        blob = _pack(list(record))
+        self._wal.write(_LEN.pack(len(blob)) + blob)
+        self._wal.flush()
+        if self._fsync:
+            os.fsync(self._wal.fileno())
+        self._appends += 1
+        if self._appends >= self._compact_every \
+                and self._snapshot_provider is not None:
+            self.snapshot(self._snapshot_provider())
+
+    def snapshot(self, tables: Dict[str, Any]) -> None:
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_pack(tables))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+        try:
+            os.unlink(self.wal_path)
+        except OSError:
+            pass
+        self._appends = 0
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+
+def _empty_tables() -> Dict[str, Any]:
+    return {"kv": {}, "actors": {}, "pgs": {}, "jobs": {},
+            "named_actors": {}}
+
+
+def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
+    """Replay one WAL record onto the tables."""
+    op = rec[0]
+    if op == "kv_put":
+        _, ns, key, value = rec
+        state["kv"].setdefault(ns, {})[key] = value
+    elif op == "kv_del":
+        _, ns, key = rec
+        state["kv"].get(ns, {}).pop(key, None)
+    elif op == "actor":
+        state["actors"][rec[1]["actor_id"]] = rec[1]
+        name = rec[1].get("name")
+        if name:
+            state["named_actors"][name] = rec[1]["actor_id"]
+    elif op == "actor_del":
+        doomed = state["actors"].pop(rec[1], None)
+        if doomed and doomed.get("name"):
+            state["named_actors"].pop(doomed["name"], None)
+    elif op == "pg":
+        state["pgs"][rec[1]["pg_id"]] = rec[1]
+    elif op == "pg_del":
+        state["pgs"].pop(rec[1], None)
+    elif op == "job":
+        state["jobs"][rec[1]] = rec[2]
+    elif op == "job_del":
+        state["jobs"].pop(rec[1], None)

@@ -18,7 +18,7 @@ import asyncio
 import struct
 import threading
 import traceback
-from typing import Any, Awaitable, Callable, Dict, Optional
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
 
@@ -188,6 +188,17 @@ class RpcServer:
 async def connect(host: str, port: int,
                   handlers: Optional[Dict[str, Callable]] = None,
                   retries: int = 1, retry_delay: float = 0.02) -> Connection:
+    # Subscribers transparently accept coalesced event frames (the
+    # publisher batches bursts — controller._flush_pubs).
+    if handlers and "pub_batch" not in handlers \
+            and any(k.startswith("pub:") for k in handlers):
+        async def _pub_batch(conn, data, _h=handlers):
+            for ch, ev in data.get("events", []):
+                h = _h.get("pub:" + ch)
+                if h is not None:
+                    await h(conn, ev)
+            return True
+        handlers = {**handlers, "pub_batch": _pub_batch}
     last = None
     for _ in range(max(1, retries)):
         try:
@@ -229,23 +240,42 @@ class EventLoopThread:
 
 
 class BlockingClient:
-    """Synchronous facade over a Connection living on an EventLoopThread."""
+    """Synchronous facade over a Connection living on an EventLoopThread.
 
-    def __init__(self, loop_thread: EventLoopThread, conn: Connection):
+    When constructed via ``connect`` it remembers its endpoint and redials
+    on entry if the connection has dropped — the client half of controller
+    fault tolerance (a restarted controller resumes at the same address;
+    reference: GCS clients retry through gcs_rpc_client.h)."""
+
+    def __init__(self, loop_thread: EventLoopThread, conn: Connection,
+                 endpoint: Optional[Tuple[str, int]] = None, handlers=None):
         self._lt = loop_thread
         self.conn = conn
+        self._endpoint = endpoint
+        self._handlers = handlers
+        self._redial_lock = threading.Lock()
 
     @classmethod
     def connect(cls, loop_thread: EventLoopThread, host: str, port: int,
                 handlers=None, retries: int = 50):
         conn = loop_thread.run(connect(host, port, handlers, retries=retries))
-        return cls(loop_thread, conn)
+        return cls(loop_thread, conn, endpoint=(host, port), handlers=handlers)
+
+    def _ensure_conn(self):
+        if not self.conn.closed or self._endpoint is None:
+            return
+        with self._redial_lock:
+            if self.conn.closed:
+                self.conn = self._lt.run(connect(
+                    *self._endpoint, self._handlers, retries=10))
 
     def call(self, method: str, data: Any = None, timeout: Optional[float] = None):
+        self._ensure_conn()
         return self._lt.run(self.conn.call(method, data, timeout=timeout),
                             timeout=None if timeout is None else timeout + 5)
 
     def notify(self, method: str, data: Any = None):
+        self._ensure_conn()
         return self._lt.run(self.conn.notify(method, data))
 
     def close(self):

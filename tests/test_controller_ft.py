@@ -1,0 +1,117 @@
+"""Controller fault tolerance: kill-and-restart keeps cluster metadata.
+
+VERDICT round-1 item 7 done-criteria.  Capability model: the reference's
+GCS restart-from-Redis (/root/reference/src/ray/gcs/store_client/ +
+gcs_table_storage.h:357-361, gcs_redis_failure_detector.cc) — here a
+snapshot+WAL on local disk (core/persistence.py).  A restarted controller
+at the same address restores actors/PGs/KV/jobs; live nodelets re-register
+through their heartbeat reconnect loops; driver clients redial on entry.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _wait_nodes(n, timeout=30.0):
+    from ray_tpu.core.driver import get_global_core
+    core = get_global_core()
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = [r for r in core.controller.call("list_nodes", {},
+                                                    timeout=5)
+                    if r.get("alive")]
+            if len(last) >= n:
+                return last
+        except Exception as e:
+            last = e
+        time.sleep(0.3)
+    pytest.fail(f"nodes never re-registered: {last}")
+
+
+def test_controller_restart_keeps_actors_pgs_kv():
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    cluster.connect()
+    try:
+        @ray_tpu.remote
+        class Registry:
+            def __init__(self):
+                self.d = {}
+
+            def put(self, k, v):
+                self.d[k] = v
+                return True
+
+            def get(self, k):
+                return self.d.get(k)
+
+        from ray_tpu.core.driver import get_global_core
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  placement_group_table)
+        core = get_global_core()
+
+        reg = Registry.options(name="registry", lifetime="detached",
+                               num_cpus=0.5).remote()
+        assert ray_tpu.get(reg.put.remote("alpha", 42), timeout=60.0)
+        pg = placement_group([{"CPU": 1.0}], strategy="PACK", name="keep_pg")
+        assert pg.ready(30.0)
+        core.controller.call("kv_put", {"ns": "user", "key": b"k1",
+                                        "value": b"v1"})
+
+        cluster.kill_controller()
+        time.sleep(0.5)
+        cluster.restart_controller()
+        _wait_nodes(1)
+
+        # KV survived
+        assert core.controller.call("kv_get",
+                                    {"ns": "user", "key": b"k1"},
+                                    timeout=10) == b"v1"
+        # named actor survived — resolvable AND its (still-running) worker
+        # holds its state
+        got = ray_tpu.get_actor("registry")
+        assert ray_tpu.get(got.get.remote("alpha"), timeout=60.0) == 42
+        # placement group survived with its committed bundles
+        names = [e.get("name") for e in placement_group_table()]
+        assert "keep_pg" in names
+        states = {e.get("name"): e.get("state")
+                  for e in placement_group_table()}
+        assert states["keep_pg"] == "CREATED"
+        # the control plane is fully live: new actors schedule
+        reg2 = Registry.options(num_cpus=0.5).remote()
+        assert ray_tpu.get(reg2.put.remote("beta", 7), timeout=60.0)
+    finally:
+        cluster.shutdown()
+
+
+def test_wal_snapshot_roundtrip(tmp_path):
+    """Unit: snapshot + WAL replay reproduce the tables, torn tails are
+    discarded."""
+    from ray_tpu.core.persistence import ControllerStore
+
+    st = ControllerStore(str(tmp_path), fsync=False)
+    assert st.load() is None
+    st.append("kv_put", "ns", b"a", b"1")
+    st.append("kv_put", "ns", b"b", b"2")
+    st.append("kv_del", "ns", b"a")
+    st.append("job", b"j1", {"start": 1.0})
+    state = st.load()
+    assert state["kv"]["ns"] == {b"b": b"2"}
+    assert state["jobs"] == {b"j1": {"start": 1.0}}
+
+    st.snapshot(state)
+    st.append("kv_put", "ns", b"c", b"3")
+    st.close()
+    # torn tail: truncate the WAL mid-record
+    import os
+    with open(st.wal_path, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f corrupt")
+    st2 = ControllerStore(str(tmp_path), fsync=False)
+    state2 = st2.load()
+    assert state2["kv"]["ns"] == {b"b": b"2", b"c": b"3"}
