@@ -109,6 +109,10 @@ _d("actor_creation_timeout_s", float, 300.0,
    "How long method calls wait for a PENDING/RESTARTING actor to come up.")
 _d("rpc_connect_retries", int, 60, "TCP connect retries (20ms backoff) at bootstrap.")
 _d("pull_retry_interval_s", float, 0.5, "Retry period for remote object pulls.")
+_d("max_concurrent_pulls", int, 4,
+   "Concurrent inbound object transfers per node — bounds store churn "
+   "under memory pressure (reference: pull_manager.cc:228 prioritizes "
+   "pulls against available memory).")
 _d("inline_small_args_bytes", int, 64 * 1024,
    "Task args at or below this size are inlined into the task spec.")
 _d("log_to_driver", bool, True, "Forward worker stdout/stderr lines to the driver.")

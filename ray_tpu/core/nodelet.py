@@ -84,6 +84,8 @@ class Nodelet:
         self._lease_cv = asyncio.Condition()
         self._lease_waiters = 0
         self._pull_locks: Dict[bytes, asyncio.Lock] = {}
+        self._pull_sem = asyncio.Semaphore(GlobalConfig.max_concurrent_pulls)
+        self._primary_pins: set = set()  # store pins on primary copies
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._tasks: List[asyncio.Task] = []
         self._next_worker_seq = 0
@@ -107,6 +109,12 @@ class Nodelet:
     async def start(self):
         store_client.create_segment(self.store_path, self.store_capacity)
         self.store = store_client.StoreClient(self.store_path)
+        # Native object plane: C++ in-store transfer server (transfer.cc) —
+        # peers fetch segment-to-segment, bypassing the Python RPC codec.
+        try:
+            self.transfer_port = self.store.serve_transfers()
+        except store_client.StoreError:
+            self.transfer_port = None  # chunked-RPC fallback still works
         await self.server.start()
         await self._connect_controller()
         for _ in range(GlobalConfig.worker_pool_initial_size):
@@ -468,8 +476,17 @@ class Nodelet:
 
     # -------------------------------------------------------- object transfer
     async def _h_put_location(self, conn, data):
+        oid = data["object_id"]
+        # Pin PRIMARY copies (worker/driver-produced) in the store so LRU
+        # eviction cannot silently drop the only copy — under memory
+        # pressure new creates then fail into the writer-spill path instead
+        # (reference: the raylet pins primary copies and spills them,
+        # local_object_manager.cc; eviction only reclaims replicas).
+        if data.get("primary", True) and oid not in self._primary_pins:
+            if self.store.get(oid, timeout_ms=0) is not None:
+                self._primary_pins.add(oid)  # hold the get-pin, drop the view
         await self.controller.call("object_location_add", {
-            "object_id": data["object_id"], "node_id": self.node_id.hex(),
+            "object_id": oid, "node_id": self.node_id.hex(),
             "size": data.get("size", 0)})
         return True
 
@@ -486,6 +503,12 @@ class Nodelet:
             if self.store.contains(oid):
                 return {"ok": True}
             deadline = time.monotonic() + timeout
+            # Fast-fail when the directory has NO location anywhere (self
+            # included): primary copies are pinned, so a directory with no
+            # entry means the object is gone (evicted replica + dead node,
+            # or freed) — report promptly so the owner's lineage
+            # reconstruction starts instead of spinning out the timeout.
+            no_loc_deadline = time.monotonic() + min(timeout, 5.0)
             while time.monotonic() < deadline:
                 try:
                     info = await self.controller.call("object_locations_get", {
@@ -493,16 +516,38 @@ class Nodelet:
                         "timeout": min(2.0, deadline - time.monotonic())})
                 except rpc.RpcError as e:
                     return {"ok": False, "error": str(e)}
-                addrs = [a for a in info["locations"] if a != self.address]
+                pairs = [(a, n) for a, n in
+                         zip(info["locations"],
+                             info.get("node_ids", [None] * len(
+                                 info["locations"])))
+                         if a != self.address]
+                addrs = [a for a, _ in pairs]
                 if not addrs:
                     if self.store.contains(oid):
                         return {"ok": True}
+                    if not info["locations"] \
+                            and time.monotonic() > no_loc_deadline:
+                        return {"ok": False,
+                                "error": f"no locations for {oid.hex()}"}
                     await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
                     continue
-                for addr in addrs:
-                    if await self._pull_from(oid, addr):
-                        await self._h_put_location(None, {"object_id": oid})
+                no_loc_deadline = time.monotonic() + min(timeout, 5.0)
+                for addr, nid in pairs:
+                    async with self._pull_sem:  # bound store churn
+                        pulled = await self._pull_from(oid, addr)
+                    if pulled:
+                        await self._h_put_location(
+                            None, {"object_id": oid, "primary": False})
                         return {"ok": True}
+                    # Evicted replica left a stale directory entry: purge it
+                    # so the no-location fast-fail above can fire.
+                    if nid is not None and pulled is None:
+                        try:
+                            await self.controller.call(
+                                "object_location_remove",
+                                {"object_id": oid, "node_id": nid})
+                        except rpc.RpcError:
+                            pass
                 await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
             return {"ok": False, "error": f"pull timeout for {oid.hex()}"}
 
@@ -514,12 +559,26 @@ class Nodelet:
             self._peer_conns[addr] = conn
         return conn
 
-    async def _pull_from(self, oid: bytes, addr: str) -> bool:
+    async def _pull_from(self, oid: bytes, addr: str) -> Optional[bool]:
+        """True = pulled; None = peer definitively lacks the object (caller
+        may purge the stale directory entry); False = transient failure."""
         try:
             peer = await self._peer(addr)
             meta = await peer.call("fetch_meta", {"object_id": oid}, timeout=10)
             if not meta.get("exists"):
-                return False
+                return None
+            # Fast path: the C++ object plane (transfer.cc) streams the
+            # payload segment-to-segment with no Python on the data path.
+            tport = meta.get("transfer_port")
+            if tport:
+                host = addr.rsplit(":", 1)[0]
+                try:
+                    ok = await asyncio.get_event_loop().run_in_executor(
+                        None, self.store.fetch, host, tport, oid)
+                    if ok:
+                        return True
+                except store_client.StoreError:
+                    pass  # fall back to the chunked RPC path
             size = meta["size"]
             try:
                 dest = self.store.create(oid, size)
@@ -553,7 +612,8 @@ class Nodelet:
         if view is None:
             return {"exists": False}
         try:
-            return {"exists": True, "size": view.nbytes}
+            return {"exists": True, "size": view.nbytes,
+                    "transfer_port": self.transfer_port}
         finally:
             del view
             self.store.release(oid)
@@ -572,6 +632,9 @@ class Nodelet:
 
     async def _h_free_local(self, conn, data):
         for oid in data["object_ids"]:
+            if oid in self._primary_pins:
+                self._primary_pins.discard(oid)
+                self.store.release(oid)
             try:
                 self.store.delete(oid)
             except store_client.StoreError:

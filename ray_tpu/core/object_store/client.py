@@ -17,7 +17,9 @@ import threading
 from typing import Dict, List, Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "store.cc")
+_SRCS = [os.path.join(_HERE, "store.cc"),
+         os.path.join(_HERE, "transfer.cc")]
+_SRC = _SRCS[0]
 _LIB = os.path.join(_HERE, "libtpustore.so")
 
 ID_LEN = 24
@@ -50,10 +52,12 @@ class ObjectExistsError(StoreError):
 def _ensure_built() -> str:
     with _build_lock:
         if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                or os.path.getmtime(_LIB) < max(os.path.getmtime(s)
+                                                for s in _SRCS)):
             tmp = _LIB + f".tmp.{os.getpid()}"
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC],
+                ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp,
+                 *_SRCS],
                 check=True, capture_output=True,
             )
             os.replace(tmp, _LIB)
@@ -84,6 +88,13 @@ def _load():
     lib.rts_list.restype = ctypes.c_int64
     lib.rts_segment_size.argtypes = [ctypes.c_void_p]
     lib.rts_segment_size.restype = ctypes.c_uint64
+    lib.rts_serve.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_int)]
+    lib.rts_serve.restype = ctypes.c_int
+    lib.rts_serve_stop.argtypes = [ctypes.c_int]
+    lib.rts_fetch.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                              ctypes.c_char_p]
+    lib.rts_fetch.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -224,11 +235,44 @@ class StoreClient:
         keys = ["used_bytes", "capacity_bytes", "num_objects", "num_evictions", "num_creates"]
         return dict(zip(keys, [v.value for v in vals]))
 
+    # -- native transfer plane (transfer.cc; C++ object manager role) -------
+    def serve_transfers(self, port: int = 0) -> int:
+        """Start the in-store C++ transfer server; returns the bound port.
+        Payloads stream straight out of the mapped segment — no Python on
+        the data path."""
+        self._check_open()
+        lfd = ctypes.c_int(-1)
+        bound = self._lib.rts_serve(self._h, port, ctypes.byref(lfd))
+        if bound <= 0:
+            raise StoreError("transfer server failed to start")
+        self._transfer_lfd = lfd.value
+        return bound
+
+    def stop_transfers(self):
+        lfd = getattr(self, "_transfer_lfd", None)
+        if lfd is not None:
+            self._lib.rts_serve_stop(lfd)
+            self._transfer_lfd = None
+
+    def fetch(self, host: str, port: int, object_id: bytes) -> bool:
+        """Pull one object from a peer's transfer server straight into this
+        segment (C++-to-C++, zero user-space copies).  Returns True once
+        the object is local; raises on transport/store failure."""
+        assert len(object_id) == ID_LEN
+        self._check_open()
+        rc = self._lib.rts_fetch(self._h, host.encode(), port, object_id)
+        if rc in (0, 1):
+            return True
+        if rc == -2:
+            return False  # peer no longer has it: caller tries elsewhere
+        raise StoreError(f"native fetch failed rc={rc}")
+
     def close(self):
         with self._close_lock:
             if self._closed:
                 return
             self._closed = True
+            self.stop_transfers()
             try:
                 self._view.release()
                 self._mm.close()

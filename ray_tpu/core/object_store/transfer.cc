@@ -1,0 +1,207 @@
+// Native object plane: node-to-node object transfer for the shm store.
+//
+// Role mirror of the reference's C++ object manager data path
+// (/root/reference/src/ray/object_manager/object_manager.cc — gRPC chunked
+// Push/Pull, push_manager.cc:23, pull_manager.cc:228), redesigned for the
+// serverless in-segment store (store.cc): instead of chunk RPCs copied
+// through a Python codec, a tiny C++ TCP server streams object payloads
+// DIRECTLY out of the mmapped segment, and the fetch client receives
+// DIRECTLY into a freshly created entry in the destination segment —
+// zero user-space copies on either side beyond the kernel socket buffers,
+// no Python on the data path at all.
+//
+// Protocol (one request per connection; objects here are >100 KiB — the
+// inline threshold — so connection setup is noise vs payload):
+//   request : "RTF1" + 24-byte object id
+//   response: int64 size (little-endian); -1 = not found; then `size`
+//             payload bytes.
+//
+// Build: compiled into libtpustore.so together with store.cc (see
+// client.py::_ensure_built); uses the public rts_* C API.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+
+// Public store API (store.cc, same shared object).
+extern "C" {
+void* rts_open(const char* path);
+int64_t rts_create(void* vh, const uint8_t* id, uint64_t size);
+int rts_seal(void* vh, const uint8_t* id);
+int rts_abort(void* vh, const uint8_t* id);
+int rts_get(void* vh, const uint8_t* id, int64_t timeout_ms,
+            uint64_t* off, uint64_t* size);
+int rts_release(void* vh, const uint8_t* id);
+int rts_contains(void* vh, const uint8_t* id);
+}
+
+// Handle layout prefix (must match store.cc's Handle: fd, base, size, hdr).
+struct TransferHandleView {
+  int fd;
+  uint8_t* base;
+  uint64_t size;
+  void* hdr;
+};
+
+namespace {
+
+constexpr int kIdLen = 24;
+constexpr char kMagic[4] = {'R', 'T', 'F', '1'};
+
+bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = (uint8_t*)buf;
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = (const uint8_t*)buf;
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+void serve_conn(void* vh, int cfd) {
+  TransferHandleView* h = (TransferHandleView*)vh;
+  char magic[4];
+  uint8_t id[kIdLen];
+  if (!read_full(cfd, magic, 4) || memcmp(magic, kMagic, 4) != 0 ||
+      !read_full(cfd, id, kIdLen)) {
+    close(cfd);
+    return;
+  }
+  uint64_t off = 0, size = 0;
+  int rc = rts_get(vh, id, /*timeout_ms=*/0, &off, &size);
+  if (rc != 0) {
+    int64_t none = -1;
+    write_full(cfd, &none, sizeof(none));
+    close(cfd);
+    return;
+  }
+  int64_t sz = (int64_t)size;
+  // Stream straight from the mapped segment while holding the get-pin
+  // (eviction cannot reclaim the entry mid-send).
+  bool ok = write_full(cfd, &sz, sizeof(sz)) &&
+            write_full(cfd, h->base + off, size);
+  (void)ok;
+  rts_release(vh, id);
+  close(cfd);
+}
+
+void accept_loop(void* vh, int lfd) {
+  for (;;) {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EINTR) continue;
+      break;  // listener closed: rts_serve_stop or process exit
+    }
+    int one = 1;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_conn, vh, cfd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start the transfer server on 127.0.0.1:<port> (0 = ephemeral).
+// Returns the bound port (>0) and fills *lfd_out with the listener fd
+// (close it to stop), or -1 on error.
+int rts_serve(void* vh, int port, int* lfd_out) {
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return -1;
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(lfd, 64) != 0) {
+    close(lfd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  if (getsockname(lfd, (sockaddr*)&addr, &alen) != 0) {
+    close(lfd);
+    return -1;
+  }
+  std::thread(accept_loop, vh, lfd).detach();
+  if (lfd_out) *lfd_out = lfd;
+  return (int)ntohs(addr.sin_port);
+}
+
+void rts_serve_stop(int lfd) { close(lfd); }
+
+// Fetch `id` from host:port straight into this segment.
+// Returns 0 on success, 1 if already local, -2 not found remotely,
+// -1 transport/store error.
+int rts_fetch(void* vh, const char* host, int port, const uint8_t* id) {
+  if (rts_contains(vh, id)) return 1;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int64_t size = -1;
+  if (!write_full(fd, kMagic, 4) || !write_full(fd, id, kIdLen) ||
+      !read_full(fd, &size, sizeof(size))) {
+    close(fd);
+    return -1;
+  }
+  if (size < 0) {
+    close(fd);
+    return -2;
+  }
+  int64_t off = rts_create(vh, id, (uint64_t)size);
+  if (off == -2 /*RTS_ERR_EXISTS*/) {
+    close(fd);
+    return 1;
+  }
+  if (off < 0) {
+    close(fd);
+    return -1;
+  }
+  TransferHandleView* h = (TransferHandleView*)vh;
+  // Receive straight into the destination segment's arena.
+  if (!read_full(fd, h->base + off, (size_t)size)) {
+    rts_abort(vh, id);
+    close(fd);
+    return -1;
+  }
+  close(fd);
+  return rts_seal(vh, id) == 0 ? 0 : -1;
+}
+
+}  // extern "C"
