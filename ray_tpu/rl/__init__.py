@@ -12,6 +12,7 @@ supported through rollout-worker actors like the reference's sampler.
 
 from .algorithm import Algorithm  # noqa: F401
 from .env import CartPole, JaxEnv, Pendulum  # noqa: F401
+from .impala import Impala, ImpalaConfig  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401

@@ -1,0 +1,294 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace off-policy correction.
+
+Capability mirror of the reference's IMPALA
+(/root/reference/rllib/algorithms/impala/impala.py:528 — async sampling
+decoupled from the learner, V-trace correcting the policy lag), redesigned
+TPU-first:
+
+  * actors are `TrajectoryWorker` processes whose rollout is ONE compiled
+    XLA program (`lax.scan` over a vectorized pure-JAX env) — they sample
+    with whatever weights they last received and never block the learner,
+  * the learner keeps exactly one sample request in flight per actor
+    (`api.wait`-style completion): as each batch lands it V-trace-corrects
+    and applies one SGD step, then re-arms that actor with fresh weights —
+    the reference's learner-queue pattern without queue actors,
+  * V-trace (Espeholt et al. 2018, eq. 1) runs as a reverse `lax.scan`
+    inside the jitted update — no host-side target computation.
+
+Degenerate mode ``num_workers=0`` samples inline (behavior == target
+policy, rho == 1) for single-process tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import MLPPolicy
+from .ppo import make_rollout_fn
+
+
+@dataclasses.dataclass
+class ImpalaConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_envs: int = 64
+    rollout_length: int = 64
+    num_workers: int = 0          # async actors; 0 = inline sampling
+    gamma: float = 0.99
+    rho_bar: float = 1.0          # V-trace importance clip (rho)
+    c_bar: float = 1.0            # V-trace trace-cutting clip (c)
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    lr: float = 5e-4
+    max_grad_norm: float = 40.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "Impala":
+        return Impala(self)
+
+
+def vtrace(behavior_logp, target_logp, values, last_value, rewards, dones,
+           *, gamma: float, rho_bar: float, c_bar: float):
+    """V-trace targets + policy-gradient advantages over [T, B] tensors.
+
+    Returns (vs, pg_adv): vs are the corrected value targets; pg_adv is
+    rho_t * (r_t + gamma * vs_{t+1} - V_t).
+    """
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_bar)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_bar)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate(
+        [values[1:], last_value[None]], axis=0)
+    deltas = rho * (rewards + gamma * next_values * nonterminal - values)
+
+    def scan_fn(acc, xs):
+        delta_t, c_t, nonterm_t = xs
+        acc = delta_t + gamma * nonterm_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(last_value), (deltas, c, nonterminal),
+        reverse=True)
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * next_vs * nonterminal - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class TrajectoryWorker:
+    """Async actor: compiled vectorized rollouts, T-major output with
+    behavior log-probs (the learner needs them for the rho/c ratios)."""
+
+    def __init__(self, config_blob: bytes, worker_index: int):
+        from ..core.serialization import loads_function
+        cfg = loads_function(config_blob)
+        self.cfg = cfg
+        self.env = cfg.env()
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed + 7919 * (worker_index + 1))
+        self.key, ekey, pkey = jax.random.split(key, 3)
+        self.params = self.policy.init(pkey)
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self._rollout = jax.jit(make_rollout_fn(
+            self.env, self.policy, cfg.num_envs, cfg.rollout_length))
+        self._ep_returns = np.zeros(cfg.num_envs)
+        self._done_returns: list = []
+
+    def sample(self, weights) -> Dict[str, Any]:
+        self.params = self.policy.set_weights(self.params, weights)
+        traj, self.env_states, self.obs, last_value, self.key = \
+            self._rollout(self.params, self.env_states, self.obs, self.key)
+        rewards = np.asarray(traj["reward"])
+        dones = np.asarray(traj["done"])
+        for t in range(rewards.shape[0]):
+            self._ep_returns += rewards[t]
+            f = dones[t].astype(bool)
+            if f.any():
+                self._done_returns.extend(self._ep_returns[f].tolist())
+                self._ep_returns[f] = 0.0
+        return {
+            "obs": np.asarray(traj["obs"]),          # [T, B, obs]
+            "action": np.asarray(traj["action"]),    # [T, B]
+            "logp": np.asarray(traj["logp"]),        # behavior log-probs
+            "reward": rewards,
+            "done": dones,
+            "last_value": np.asarray(last_value),
+            "episode_returns": np.asarray(self._done_returns[-100:]),
+        }
+
+
+class Impala(Algorithm):
+    _config_cls = ImpalaConfig
+
+    def __init__(self, config: ImpalaConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("ImpalaConfig.env required (an env factory)")
+        self.env = cfg.env()
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        key, pkey, ekey = jax.random.split(key, 3)
+        self.params = self.policy.init(pkey)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self.key = key
+        self._learn = jax.jit(self._make_learn_fn())
+        self._ep_done_returns: list = []
+        self._inflight: Dict[int, Any] = {}   # worker idx -> pending ref
+        self._actors: list = []
+        if cfg.num_workers > 0:
+            from .. import api
+            from ..core.serialization import dumps_function
+            blob = dumps_function(cfg)
+            actor_cls = api.remote(TrajectoryWorker)
+            self._actors = [actor_cls.options(num_cpus=1.0).remote(blob, i)
+                            for i in range(cfg.num_workers)]
+        else:
+            ekeys = jax.random.split(ekey, cfg.num_envs)
+            self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+            self._rollout = jax.jit(make_rollout_fn(
+                self.env, self.policy, cfg.num_envs, cfg.rollout_length))
+            self._ep_returns = np.zeros(cfg.num_envs)
+
+    # -- the compiled learner step ------------------------------------------
+    def _make_learn_fn(self):
+        cfg = self.config
+        policy = self.policy
+
+        def learn(params, opt_state, batch):
+            def loss_fn(params):
+                T, B = batch["reward"].shape
+                obs_flat = batch["obs"].reshape(T * B, -1)
+                act_flat = batch["action"].reshape(
+                    (T * B,) if self.env.discrete else (T * B, -1))
+                logp, entropy, value = jax.vmap(
+                    lambda o, a: policy.log_prob(params, o, a))(
+                        obs_flat, act_flat)
+                logp = logp.reshape(T, B)
+                value = value.reshape(T, B)
+                vs, pg_adv = vtrace(
+                    batch["logp"], logp, value, batch["last_value"],
+                    batch["reward"], batch["done"], gamma=cfg.gamma,
+                    rho_bar=cfg.rho_bar, c_bar=cfg.c_bar)
+                pi_loss = -jnp.mean(logp * pg_adv)
+                vf_loss = 0.5 * jnp.mean((vs - value) ** 2)
+                ent = jnp.mean(entropy)
+                total = pi_loss + cfg.vf_coeff * vf_loss \
+                    - cfg.entropy_coeff * ent
+                return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                               "entropy": ent,
+                               "mean_rho": jnp.mean(jnp.exp(
+                                   logp - batch["logp"]))}
+
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        return learn
+
+    # -- async driver loop ---------------------------------------------------
+    def _arm(self, idx: int):
+        from .. import api
+        weights_ref = api.put(self.policy.get_weights(self.params))
+        self._inflight[idx] = self._actors[idx].sample.remote(weights_ref)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        if self._actors:
+            from .. import api
+            for i in range(len(self._actors)):
+                if i not in self._inflight:
+                    self._arm(i)
+            # learn on every batch as it lands; one pass over the fleet
+            metrics: Dict[str, float] = {}
+            learned = 0
+            refs = {self._inflight[i]: i for i in self._inflight}
+            ready, _ = api.wait(list(refs), num_returns=1, timeout=300.0)
+            order = [refs[r] for r in ready] + \
+                [i for r, i in refs.items() if r not in ready]
+            for i in order[:max(1, len(self._actors))]:
+                batch = api.get(self._inflight.pop(i), timeout=300.0)
+                ep = batch.pop("episode_returns", None)
+                if ep is not None and len(ep):
+                    self._ep_done_returns.extend(np.asarray(ep).tolist())
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self.opt_state, m = self._learn(
+                    self.params, self.opt_state, jbatch)
+                metrics = {k: float(v) for k, v in m.items()}
+                learned += 1
+                self._arm(i)  # re-arm immediately with fresh weights
+            env_steps = learned * cfg.num_envs * cfg.rollout_length
+        else:
+            traj, self.env_states, self.obs, last_value, self.key = \
+                self._rollout(self.params, self.env_states, self.obs,
+                              self.key)
+            self._track_episodes(np.asarray(traj["reward"]),
+                                 np.asarray(traj["done"]))
+            batch = {"obs": traj["obs"], "action": traj["action"],
+                     "logp": traj["logp"], "reward": traj["reward"],
+                     "done": traj["done"], "last_value": last_value}
+            self.params, self.opt_state, m = self._learn(
+                self.params, self.opt_state, batch)
+            metrics = {k: float(v) for k, v in m.items()}
+            env_steps = cfg.num_envs * cfg.rollout_length
+        dt = time.perf_counter() - t0
+        out = dict(metrics)
+        out.update({
+            "env_steps_this_iter": env_steps,
+            "env_steps_per_s": env_steps / dt,
+            "episode_reward_mean": float(np.mean(
+                self._ep_done_returns[-100:])) if self._ep_done_returns
+            else float("nan"),
+        })
+        return out
+
+    def _track_episodes(self, rewards: np.ndarray, dones: np.ndarray):
+        for t in range(rewards.shape[0]):
+            self._ep_returns += rewards[t]
+            finished = dones[t].astype(bool)
+            if finished.any():
+                self._ep_done_returns.extend(
+                    self._ep_returns[finished].tolist())
+                self._ep_returns[finished] = 0.0
+
+    def stop(self) -> None:
+        from .. import api
+        for a in self._actors:
+            try:
+                api.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+        self._inflight = {}
+
+    # -- checkpointing -------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.policy.get_weights(self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = self.policy.set_weights(self.params, state["params"])
+        self.iteration = state.get("iteration", 0)

@@ -1,0 +1,94 @@
+"""IMPALA async actor-learner tests (VERDICT round-1 item 9).
+
+Capability model: /root/reference/rllib/algorithms/impala/impala.py:528 —
+async sampling decoupled from the learner with V-trace correction.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import CartPole
+
+
+def _cfg(**kw):
+    from ray_tpu.rl.impala import ImpalaConfig
+    kw.setdefault("env", CartPole)
+    kw.setdefault("num_envs", 16)
+    kw.setdefault("rollout_length", 32)
+    kw.setdefault("seed", 0)
+    return ImpalaConfig(**kw)
+
+
+def test_vtrace_on_policy_reduces_to_td_lambda1():
+    """With behavior == target (rho = c = 1) and no dones, V-trace targets
+    equal the discounted Monte-Carlo/bootstrap returns."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.impala import vtrace
+
+    T, B = 5, 3
+    rng = np.random.default_rng(0)
+    logp = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    last_value = jnp.asarray(rng.normal(size=(B,)).astype(np.float32))
+    rewards = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    dones = jnp.zeros((T, B), bool)
+    vs, pg_adv = vtrace(logp, logp, values, last_value, rewards, dones,
+                        gamma=0.9, rho_bar=1.0, c_bar=1.0)
+    # reference: vs_t = r_t + gamma * vs_{t+1}, vs_T = r_T + gamma * V_last
+    want = np.zeros((T, B), np.float32)
+    nxt = np.asarray(last_value)
+    for t in reversed(range(T)):
+        want[t] = np.asarray(rewards)[t] + 0.9 * nxt
+        nxt = want[t]
+    np.testing.assert_allclose(np.asarray(vs), want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(pg_adv)[:-1],
+        (np.asarray(rewards) + 0.9 * np.vstack(
+            [want[1:], np.asarray(last_value)[None]])
+         - np.asarray(values))[:-1], rtol=1e-5, atol=1e-5)
+
+
+def test_impala_inline_learns_cartpole():
+    cfg = _cfg(num_envs=32, rollout_length=64, lr=5e-3,
+               entropy_coeff=0.005)
+    algo = cfg.build()
+    first = algo.train()
+    for _ in range(60):
+        result = algo.train()
+        if result["episode_reward_mean"] >= 100.0:
+            break
+    assert result["episode_reward_mean"] > max(
+        25.0, first.get("episode_reward_mean") or 25.0), result
+    # checkpoint roundtrip
+    ck = algo.save()
+    algo2 = _cfg().build()
+    algo2.restore(ck)
+    assert algo2.iteration == algo.iteration
+
+
+def test_impala_async_actors_learn_and_offpolicy_correct():
+    """2 async actor processes: learner consumes batches as they land,
+    mean rho != 1 confirms genuine off-policy correction, and the learner
+    improves the policy."""
+    ray_tpu.init(num_cpus=3, object_store_memory=128 * 1024 * 1024)
+    try:
+        cfg = _cfg(num_workers=2, num_envs=16, rollout_length=64,
+                   lr=5e-3, entropy_coeff=0.005)
+        algo = cfg.build()
+        rhos = []
+        result = None
+        for _ in range(40):
+            result = algo.train()
+            if "mean_rho" in result:
+                rhos.append(result["mean_rho"])
+            if (result["episode_reward_mean"] or 0) >= 80.0:
+                break
+        assert result is not None
+        assert result["episode_reward_mean"] > 25.0, result
+        # staleness exists: at least one batch was off-policy
+        assert any(abs(r - 1.0) > 1e-4 for r in rhos), rhos
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
