@@ -192,7 +192,7 @@ class Controller:
                      "create_placement_group", "wait_placement_group",
                      "remove_placement_group", "list_placement_groups",
                      "object_location_add", "object_location_remove",
-                     "object_locations_get", "free_objects",
+                     "object_locations_get", "free_objects", "list_objects",
                      "ref_inc", "ref_dec", "free_request", "ref_counts",
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
@@ -800,6 +800,28 @@ class Controller:
         if now:
             await self._do_free(now)
         return True
+
+    async def _h_list_objects(self, conn, data):
+        """Cluster object table with node attribution (reference: `ray list
+        objects` / `ray memory` via internal_api.py + state aggregator)."""
+        out = []
+        for oid, locs in self.object_dir.items():
+            out.append({
+                "object_id": oid.hex(),
+                "size": self.object_sizes.get(oid, 0),
+                "node_ids": sorted(locs),
+                "pending_free": oid in self.pending_free,
+                "borrows": {h: n
+                            for h, n in self.borrows.get(oid, {}).items()},
+            })
+        # borrowed-but-not-located (inline/spilled) objects still show up
+        for oid, holders in self.borrows.items():
+            if oid not in self.object_dir:
+                out.append({"object_id": oid.hex(), "size": 0,
+                            "node_ids": [],
+                            "pending_free": oid in self.pending_free,
+                            "borrows": dict(holders)})
+        return out
 
     async def _h_ref_counts(self, conn, data):
         """Debug/observability: outstanding borrows (ray memory equivalent)."""

@@ -86,6 +86,8 @@ class Nodelet:
         self._pull_locks: Dict[bytes, asyncio.Lock] = {}
         self._pull_sem = asyncio.Semaphore(GlobalConfig.max_concurrent_pulls)
         self._primary_pins: set = set()  # store pins on primary copies
+        self._running_tasks: Dict[bytes, dict] = {}   # worker_id -> task
+        self._task_counts: Dict[str, int] = {}        # fname -> finished
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._tasks: List[asyncio.Task] = []
         self._next_worker_seq = 0
@@ -99,6 +101,7 @@ class Nodelet:
                      "pull", "fetch_meta", "fetch", "free_local", "pg_prepare",
                      "pg_commit", "pg_abort", "pg_return", "kill_worker_at",
                      "node_info", "stats", "put_location", "ping",
+                     "task_state", "node_stats", "tail_log",
                      "prestart_workers"):
             s.register(name, getattr(self, "_h_" + name))
 
@@ -654,6 +657,75 @@ class Nodelet:
                             for w in self.workers.values()},
                 "leases": len(self.leases),
                 "available": self.available.to_dict()}
+
+    # ------------------------------------------------- task/node observability
+    async def _h_task_state(self, conn, data):
+        """Workers report task start/finish here (direct driver→worker
+        pushes bypass the nodelet, so this notify is how the per-node task
+        table — the reference's `ray list tasks` source — gets filled)."""
+        wid = data["worker_id"]
+        if data["event"] == "start":
+            self._running_tasks[wid] = {
+                "name": data.get("name", "?"),
+                "task_id": data.get("task_id", b"").hex()
+                if data.get("task_id") else "",
+                "start": time.time()}
+        else:
+            self._running_tasks.pop(wid, None)
+            name = data.get("name", "?")
+            self._task_counts[name] = self._task_counts.get(name, 0) + 1
+        return True
+
+    async def _h_node_stats(self, conn, data):
+        """Per-node deep stats (reference: dashboard/agent.py reporter +
+        node module): worker table, running tasks, finished-task counts,
+        object store usage, pins, transfer port."""
+        workers = []
+        for w in self.workers.values():
+            ent = {"worker_id": w.worker_id.hex(), "state": w.state,
+                   "pid": w.proc.pid,
+                   "actor_id": w.actor_id.hex() if w.actor_id else None}
+            run = self._running_tasks.get(w.worker_id)
+            if run is not None:
+                ent["running_task"] = dict(run)
+            workers.append(ent)
+        return {
+            "node_id": self.node_id.hex(),
+            "addr": self.address,
+            "workers": workers,
+            "running_tasks": [
+                {"worker_id": wid.hex(), **info}
+                for wid, info in self._running_tasks.items()],
+            "task_counts": dict(self._task_counts),
+            "store": self.store.stats(),
+            "primary_pins": len(self._primary_pins),
+            "transfer_port": self.transfer_port,
+            "available": self.available.to_dict(),
+            "total": self.total.to_dict(),
+        }
+
+    async def _h_tail_log(self, conn, data):
+        """Tail a per-process log file from this node's session dir
+        (reference: LogMonitor tailing /tmp/ray/session_*/logs,
+        python/ray/_private/log_monitor.py:100)."""
+        import glob
+        name = data.get("name", "")
+        if "/" in name or ".." in name:
+            return {"error": "bad log name"}
+        log_dir = os.path.join(self.session_dir, "logs")
+        if not name:
+            return {"files": sorted(os.path.basename(p) for p in
+                                    glob.glob(os.path.join(log_dir, "*")))}
+        path = os.path.join(log_dir, name)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                n = min(int(data.get("bytes", 65536)), size)
+                f.seek(size - n)
+                return {"data": f.read(n), "size": size}
+        except OSError as e:
+            return {"error": str(e)}
 
     async def _h_ping(self, conn, data):
         return "pong"

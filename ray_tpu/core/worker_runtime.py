@@ -247,7 +247,19 @@ class WorkerRuntime:
             # healthy worker as crashed.
             return {"error": {"traceback": traceback.format_exc(),
                               "pickled": None, "fname": spec.function_name}}
-        return await self._execute(spec, fn)
+        # Task-state observability: the nodelet keeps the per-worker task
+        # table the reference's core worker reports to the GCS
+        # (task_manager / state API `ray list tasks`); pushes go direct
+        # driver→worker, so the nodelet can't see them itself.
+        await self.nodelet.notify("task_state", {
+            "worker_id": self.worker_id, "event": "start",
+            "name": spec.function_name, "task_id": spec.task_id.binary()})
+        try:
+            return await self._execute(spec, fn)
+        finally:
+            await self.nodelet.notify("task_state", {
+                "worker_id": self.worker_id, "event": "finish",
+                "name": spec.function_name})
 
     async def _h_create_actor(self, conn, data):
         spec = TaskSpec.from_wire(data["spec"])
@@ -291,7 +303,18 @@ class WorkerRuntime:
                               "pickled": None, "fname": spec.function_name}}
         try:
             method = getattr(self.actor_instance, spec.function_name)
-            return await self._execute(spec, method)
+            await self.nodelet.notify("task_state", {
+                "worker_id": self.worker_id, "event": "start",
+                "name": f"{type(self.actor_instance).__name__}."
+                        f"{spec.function_name}",
+                "task_id": spec.task_id.binary()})
+            try:
+                return await self._execute(spec, method)
+            finally:
+                await self.nodelet.notify("task_state", {
+                    "worker_id": self.worker_id, "event": "finish",
+                    "name": f"{type(self.actor_instance).__name__}."
+                            f"{spec.function_name}"})
         finally:
             if self.actor_max_concurrency == 1:
                 state["next"] = seq + 1

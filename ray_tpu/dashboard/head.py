@@ -92,7 +92,30 @@ class DashboardHead:
             from .. import metrics
             return metrics.prometheus_text()
 
+        def node_stats(request):
+            from .. import state
+            return state.node_stats(request.match_info.get("node_id"))
+
+        def objects(_):
+            from .. import state
+            return state.list_objects()
+
+        def tasks(_):
+            from .. import state
+            return state.list_tasks()
+
+        def memory(_):
+            from .. import state
+            m = state.memory_summary()
+            # refs values contain non-JSON types (hex-keyed dicts are fine)
+            return json.loads(json.dumps(m, default=str))
+
         app = web.Application()
+        app.router.add_get("/api/nodes/{node_id}/stats",
+                           blocking(node_stats))
+        app.router.add_get("/api/objects", blocking(objects))
+        app.router.add_get("/api/tasks", blocking(tasks))
+        app.router.add_get("/api/memory", blocking(memory))
         app.router.add_get("/api/nodes", blocking(nodes))
         app.router.add_get("/api/actors", blocking(actors))
         app.router.add_get("/api/placement_groups", blocking(pgs))

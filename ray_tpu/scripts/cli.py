@@ -96,7 +96,8 @@ def cmd_list(args) -> None:
     _connect(args)
     fn = {"nodes": state.list_nodes, "actors": state.list_actors,
           "placement-groups": state.list_placement_groups,
-          "jobs": state.list_jobs}[args.kind]
+          "jobs": state.list_jobs, "tasks": state.list_tasks,
+          "objects": state.list_objects}[args.kind]
     print(json.dumps(fn(), indent=2, default=str))
     ray_tpu.shutdown()
 
@@ -121,6 +122,29 @@ def cmd_logs(args) -> None:
     from ray_tpu import jobs
     _connect(args)
     print(jobs.get_job_logs(args.job_id), end="")
+    ray_tpu.shutdown()
+
+
+def cmd_memory(args) -> None:
+    """`ray memory` equivalent: object table + borrows + store usage."""
+    import ray_tpu
+    from ray_tpu import state
+    _connect(args)
+    print(json.dumps(state.memory_summary(), indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_taillog(args) -> None:
+    """Tail a per-process log file from a node's session dir."""
+    import ray_tpu
+    from ray_tpu import state
+    _connect(args)
+    if not args.name:
+        for f in state.list_logs(args.node):
+            print(f)
+    else:
+        sys.stdout.buffer.write(state.tail_log(args.name, args.node,
+                                               args.bytes))
     ray_tpu.shutdown()
 
 
@@ -167,7 +191,8 @@ def main(argv=None) -> None:
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors",
-                                     "placement-groups", "jobs"])
+                                     "placement-groups", "jobs",
+                                     "tasks", "objects"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
 
@@ -182,6 +207,17 @@ def main(argv=None) -> None:
     sp.add_argument("job_id")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("memory", help="object/ref memory dump")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("taillog", help="list/tail per-process log files")
+    sp.add_argument("name", nargs="?", default="")
+    sp.add_argument("--node", help="node address host:port")
+    sp.add_argument("--bytes", type=int, default=65536)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_taillog)
 
     sp = sub.add_parser("timeline", help="dump chrome trace")
     sp.add_argument("--address")

@@ -54,4 +54,106 @@ def cluster_summary() -> Dict[str, Any]:
         "nodes": summarize_nodes(),
         "actors": summarize_actors(),
         "placement_groups": len(list_placement_groups()),
+        "tasks": summarize_tasks(),
     }
+
+
+# -------------------------------------------------- per-node deep state
+def _node_call(addr: str, method: str, data: Optional[dict] = None,
+               timeout: float = 10.0):
+    """One short-lived RPC to a nodelet (the aggregator role of the
+    reference's dashboard/state_aggregator.py querying per-node agents)."""
+    from .core import rpc as rpc_mod
+    core = _ensure_initialized()
+    host, port = addr.rsplit(":", 1)
+    conn = core.lt.run(rpc_mod.connect(host, int(port), retries=3))
+    try:
+        return core.lt.run(conn.call(method, data or {}, timeout=timeout))
+    finally:
+        core.lt.run(conn.close())
+
+
+def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Deep per-node stats: worker tables, running tasks, store usage
+    (reference: dashboard reporter/agent per-node stats)."""
+    out = []
+    for n in list_nodes():
+        if not n.get("alive"):
+            continue
+        if node_id is not None and n["id"] != node_id:
+            continue
+        try:
+            out.append(_node_call(n["addr"], "node_stats"))
+        except Exception as e:
+            out.append({"node_id": n["id"], "error": str(e)})
+    return out
+
+
+def list_tasks() -> List[Dict[str, Any]]:
+    """RUNNING tasks cluster-wide with node attribution (reference:
+    `ray list tasks`, experimental/state/api.py)."""
+    tasks = []
+    for ns in node_stats():
+        for t in ns.get("running_tasks", []):
+            tasks.append({**t, "node_id": ns.get("node_id")})
+    return tasks
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Finished-task counts by function + currently running count
+    (reference: `ray summary tasks`, state/api.py:1269)."""
+    counts: Dict[str, int] = {}
+    running = 0
+    for ns in node_stats():
+        running += len(ns.get("running_tasks", []))
+        for name, n in ns.get("task_counts", {}).items():
+            counts[name] = counts.get(name, 0) + n
+    return {"finished_by_func": counts, "running": running}
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Cluster object table: size, locations, borrow holders, deferred
+    frees (reference: `ray list objects`)."""
+    return _ensure_initialized().controller.call("list_objects", {})
+
+
+def memory_summary() -> Dict[str, Any]:
+    """`ray memory`-style dump: object table + outstanding borrows +
+    per-node store usage (reference: python/ray/_private/internal_api.py
+    memory_summary)."""
+    core = _ensure_initialized()
+    stores = {}
+    for ns in node_stats():
+        if "store" in ns:
+            stores[ns["node_id"]] = {**ns["store"],
+                                     "primary_pins": ns.get("primary_pins")}
+    return {
+        "objects": list_objects(),
+        "refs": core.controller.call("ref_counts", {}),
+        "stores": stores,
+    }
+
+
+def list_logs(node_addr: Optional[str] = None) -> List[str]:
+    """Per-process log files on a node's session dir (reference:
+    LogMonitor's file set, `ray logs`)."""
+    nodes = list_nodes()
+    addr = node_addr or next(
+        (n["addr"] for n in nodes if n.get("alive")), None)
+    if addr is None:
+        return []
+    return _node_call(addr, "tail_log", {}).get("files", [])
+
+
+def tail_log(name: str, node_addr: Optional[str] = None,
+             nbytes: int = 65536) -> bytes:
+    """Tail one per-process log file (reference: `ray logs <file>`)."""
+    nodes = list_nodes()
+    addr = node_addr or next(
+        (n["addr"] for n in nodes if n.get("alive")), None)
+    if addr is None:
+        raise RuntimeError("no alive node")
+    r = _node_call(addr, "tail_log", {"name": name, "bytes": nbytes})
+    if "error" in r:
+        raise RuntimeError(r["error"])
+    return r["data"]

@@ -1,0 +1,109 @@
+"""Observability depth: task table, object table, memory dump, log tailing.
+
+VERDICT round-1 item 10 done-criteria: state API lists tasks + objects
+with node attribution; per-process logs reachable from the driver.
+Reference models: `ray list tasks/objects` (experimental/state/api.py),
+`ray memory` (python/ray/_private/internal_api.py), LogMonitor
+(python/ray/_private/log_monitor.py:100), dashboard reporter/agent.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=3, object_store_memory=96 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_running_tasks_listed_with_node_attribution(cluster):
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(2.0)
+        return x
+
+    refs = [slow.remote(i) for i in range(2)]
+    deadline = time.monotonic() + 20
+    tasks = []
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        if tasks:
+            break
+        time.sleep(0.1)
+    assert tasks, "running tasks never appeared in the state API"
+    assert all(t.get("node_id") for t in tasks)
+    assert any(t["name"] == "slow" for t in tasks)
+    assert ray_tpu.get(refs, timeout=60.0) == [0, 1]
+    # after completion: finished counts include the function
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        summ = state.summarize_tasks()
+        if summ["finished_by_func"].get("slow", 0) >= 2:
+            break
+        time.sleep(0.1)
+    assert summ["finished_by_func"].get("slow", 0) >= 2, summ
+
+
+def test_actor_method_and_node_stats(cluster):
+    @ray_tpu.remote
+    class Holder:
+        def poke(self):
+            return 1
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.poke.remote(), timeout=60.0) == 1
+    stats = state.node_stats()
+    assert stats and "workers" in stats[0]
+    states = {w["state"] for ns in stats for w in ns["workers"]}
+    assert "actor" in states
+    # actor method shows in finished counts as Class.method
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        counts = state.summarize_tasks()["finished_by_func"]
+        if any(k.endswith(".poke") for k in counts):
+            break
+        time.sleep(0.1)
+    assert any(k.endswith(".poke") for k in counts), counts
+
+
+def test_object_table_and_memory_summary(cluster):
+    ref = ray_tpu.put(np.zeros(1024 * 1024, dtype=np.uint8))
+    deadline = time.monotonic() + 10
+    objs = []
+    while time.monotonic() < deadline:
+        objs = state.list_objects()
+        if any(o["object_id"] == ref.hex() for o in objs):
+            break
+        time.sleep(0.1)
+    entry = next(o for o in objs if o["object_id"] == ref.hex())
+    assert entry["size"] >= 1024 * 1024
+    assert entry["node_ids"], "object table must attribute a node"
+
+    mem = state.memory_summary()
+    assert mem["stores"], "per-node store stats missing"
+    st = next(iter(mem["stores"].values()))
+    assert st["used_bytes"] > 0 and st["primary_pins"] >= 1
+    assert any(o["object_id"] == ref.hex() for o in mem["objects"])
+    del ref
+
+
+def test_log_files_listed_and_tailable(cluster):
+    @ray_tpu.remote
+    def noisy():
+        print("OBS-TEST-LINE", flush=True)
+        return True
+
+    assert ray_tpu.get(noisy.remote(), timeout=60.0)
+    files = state.list_logs()
+    assert any(f.startswith("worker-") for f in files), files
+    # tail one worker log (driver-side LogMonitor role)
+    wf = [f for f in files if f.startswith("worker-")]
+    blob = b"".join(state.tail_log(f) for f in wf)
+    assert isinstance(blob, bytes)
