@@ -168,7 +168,8 @@ _DEFAULT_TASK_OPTIONS = dict(
 
 _DEFAULT_ACTOR_OPTIONS = dict(
     num_cpus=0.0, num_tpus=0.0, resources=None, max_restarts=0,
-    max_task_retries=0, max_concurrency=1, name=None, lifetime=None,
+    max_task_retries=0, max_concurrency=1, concurrency_groups=None,
+    name=None, lifetime=None,
     get_if_exists=False, scheduling_strategy=None, placement_group=None,
     placement_group_bundle_index=-1, num_returns=1, runtime_env=None,
 )
@@ -261,17 +262,22 @@ class RemoteFunction:
 
 # ------------------------------------------------------------------- actors
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1,
+                concurrency_group: Optional[str] = None):
+        return ActorMethod(self._handle, self._name, num_returns,
+                           concurrency_group)
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(self._name, args, kwargs,
-                                           self._num_returns)
+                                           self._num_returns,
+                                           self._concurrency_group)
 
 
 class ActorHandle:
@@ -294,7 +300,8 @@ class ActorHandle:
                 f"actor {self._class_name} has no method {name!r}")
         return ActorMethod(self, name)
 
-    def _submit_method(self, method: str, args, kwargs, num_returns: int):
+    def _submit_method(self, method: str, args, kwargs, num_returns: int,
+                       concurrency_group: Optional[str] = None):
         core = _ensure_initialized()
         core.attach_actor(self._actor_id, self._class_name)
         encoded_args, temp_refs = core.build_args(args, kwargs)
@@ -308,6 +315,7 @@ class ActorHandle:
             resources={},
             owner_addr="",
             actor_id=ActorID(self._actor_id),
+            concurrency_group=concurrency_group,
         )
         refs = core.submit_actor_task(self._actor_id, spec,
                                       self._max_task_retries,
@@ -359,6 +367,7 @@ class ActorClass:
             owner_addr="",
             actor_creation_id=actor_id,
             max_concurrency=opts["max_concurrency"],
+            concurrency_groups=opts.get("concurrency_groups"),
             max_restarts=opts["max_restarts"],
             placement_group_id=PlacementGroupID(pg.id.binary())
             if pg is not None and hasattr(pg, "id") else None,

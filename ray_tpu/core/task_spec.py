@@ -96,6 +96,8 @@ class TaskSpec:
         bundle_index: int = -1,
         scheduling_strategy: Optional[Dict[str, Any]] = None,
         runtime_env: Optional[Dict[str, Any]] = None,
+        concurrency_groups: Optional[Dict[str, int]] = None,
+        concurrency_group: Optional[str] = None,
     ) -> "TaskSpec":
         return cls({
             "tid": task_id.binary(),
@@ -117,6 +119,8 @@ class TaskSpec:
             "bundle": bundle_index,
             "strategy": scheduling_strategy or {},
             "renv": runtime_env or {},
+            "cgroups": concurrency_groups or {},
+            "cgroup": concurrency_group,
         })
 
     # -- accessors -----------------------------------------------------------
@@ -198,6 +202,17 @@ class TaskSpec:
     @property
     def scheduling_strategy(self) -> Dict[str, Any]:
         return self.d.get("strategy") or {}
+
+    @property
+    def concurrency_groups(self) -> Dict[str, int]:
+        """Actor creation: named method groups with their own concurrency
+        caps (reference: ConcurrencyGroupManager,
+        core_worker/transport/concurrency_group_manager.h)."""
+        return self.d.get("cgroups") or {}
+
+    @property
+    def concurrency_group(self) -> Optional[str]:
+        return self.d.get("cgroup")
 
     @property
     def runtime_env(self) -> Dict[str, Any]:

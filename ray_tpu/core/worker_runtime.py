@@ -60,6 +60,11 @@ class WorkerRuntime:
         self.actor_id: Optional[bytes] = None
         self.actor_max_concurrency = 1
         self.executor = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        # Concurrency groups (reference: ConcurrencyGroupManager,
+        # core_worker/transport/concurrency_group_manager.h): named method
+        # groups each with their own executor (sync) + semaphore (async).
+        self._group_pools: Dict[str, concurrent.futures.ThreadPoolExecutor] = {}
+        self._group_sems: Dict[str, asyncio.Semaphore] = {}
         self._seq_state: Dict[int, Dict[str, Any]] = {}  # conn id -> ordering
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pinned_args: set = set()
@@ -191,6 +196,49 @@ class WorkerRuntime:
     def _run_user_code(self, fn, args, kwargs):
         return fn(*args, **kwargs)
 
+    def _is_async(self, fn) -> bool:
+        import inspect
+        return inspect.iscoroutinefunction(fn) or \
+            inspect.iscoroutinefunction(getattr(fn, "__call__", None))
+
+    async def _run_target(self, spec: TaskSpec, fn, args, kwargs):
+        """Dispatch to the right execution lane.
+
+        Async methods run NATIVELY on the worker's event loop (the role
+        boost fibers play in the reference, core_worker/fiber.h) bounded by
+        their concurrency-group semaphore; sync methods run on the group's
+        thread pool.  Both lanes honor per-task runtime envs."""
+        import inspect
+        renv = spec.runtime_env
+        group = spec.concurrency_group or "_default"
+        if self._is_async(fn):
+            sem = self._group_sems.get(group) or self._group_sems.get(
+                "_default")
+            if sem is None:
+                sem = self._group_sems["_default"] = asyncio.Semaphore(
+                    max(1, self.actor_max_concurrency))
+            async with sem:
+                if renv:
+                    from . import runtime_env as _renv
+                    with _renv.applied(renv):
+                        return await fn(*args, **kwargs)
+                return await fn(*args, **kwargs)
+        pool = self._group_pools.get(group, self.executor)
+        if renv:
+            from . import runtime_env as _renv
+
+            def run_in_env():
+                with _renv.applied(renv):
+                    return self._run_user_code(fn, args, kwargs)
+
+            result = await self._loop.run_in_executor(pool, run_in_env)
+        else:
+            result = await self._loop.run_in_executor(
+                pool, self._run_user_code, fn, args, kwargs)
+        if inspect.iscoroutine(result):
+            result = await result  # sync wrapper returned a coroutine
+        return result
+
     async def _execute(self, spec: TaskSpec, fn) -> dict:
         # NB: store pins taken while resolving reference args are *not*
         # released after execution — deserialization is zero-copy, so user
@@ -200,19 +248,7 @@ class WorkerRuntime:
         # pin-while-mapped semantics).
         try:
             args, kwargs, _views = await self._resolve_args(spec)
-            renv = spec.runtime_env
-            if renv:
-                from . import runtime_env as _renv
-
-                def run_in_env(fn=fn, args=args, kwargs=kwargs):
-                    with _renv.applied(renv):
-                        return self._run_user_code(fn, args, kwargs)
-
-                result = await self._loop.run_in_executor(
-                    self.executor, run_in_env)
-            else:
-                result = await self._loop.run_in_executor(
-                    self.executor, self._run_user_code, fn, args, kwargs)
+            result = await self._run_target(spec, fn, args, kwargs)
             returns = await self._store_returns(spec, result)
             # Borrow barrier: refs deserialized during this task registered
             # borrows via fire-and-forget notifies on the worker-core's own
@@ -276,6 +312,23 @@ class WorkerRuntime:
             if self.actor_max_concurrency > 1:
                 self.executor = concurrent.futures.ThreadPoolExecutor(
                     max_workers=self.actor_max_concurrency)
+            # Async actors get real event-loop concurrency even without an
+            # explicit max_concurrency (reference defaults async actors to
+            # a large cap — fiber.h).
+            has_async = any(
+                self._is_async(getattr(self.actor_instance, m))
+                for m in dir(self.actor_instance) if not m.startswith("_")
+                and callable(getattr(self.actor_instance, m, None)))
+            default_cap = self.actor_max_concurrency
+            if has_async and spec.max_concurrency <= 1:
+                default_cap = 100
+            self._group_sems["_default"] = asyncio.Semaphore(default_cap)
+            self.concurrency_groups = dict(spec.concurrency_groups)
+            for gname, cap in self.concurrency_groups.items():
+                self._group_pools[gname] = \
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=max(1, int(cap)))
+                self._group_sems[gname] = asyncio.Semaphore(max(1, int(cap)))
             await self.controller.call("actor_alive", {
                 "actor_id": self.actor_id, "address": self.address,
                 "worker_id": self.worker_id, "node_id": self.node_id})
@@ -290,19 +343,31 @@ class WorkerRuntime:
             return {"error": {"traceback": "actor is exiting (killed)",
                               "pickled": None, "fname": spec.function_name,
                               "dying": True}}
-        state = self._seq_state.setdefault(
-            id(conn), {"next": 0, "waiters": {}})
-        seq = spec.actor_seq
-        if self.actor_max_concurrency == 1:
-            while state["next"] != seq:
-                ev = asyncio.Event()
-                state["waiters"][seq] = ev
-                await ev.wait()
         if self.actor_instance is None:
             return {"error": {"traceback": "actor instance not created",
                               "pickled": None, "fname": spec.function_name}}
+        method = getattr(self.actor_instance, spec.function_name, None)
+        if method is None:
+            return {"error": {"traceback": f"no method {spec.function_name}",
+                              "pickled": None, "fname": spec.function_name}}
+        state = self._seq_state.setdefault(
+            id(conn), {"next": 0, "waiters": {}})
+        seq = spec.actor_seq
+        # Per-caller FIFO applies to plain sync actors; async methods and
+        # concurrency-group methods execute out of order up to their caps
+        # (reference: ActorSchedulingQueue vs OutOfOrderActorSchedulingQueue
+        # + fiber.h async actors).
+        ordered = self.actor_max_concurrency == 1 \
+            and not self._is_async(method) \
+            and not spec.concurrency_group
+        if ordered:
+            # eligible once every earlier seq (ordered or not) has finished:
+            # unordered completions advance "next" monotonically too
+            while state["next"] < seq:
+                ev = state["waiters"].setdefault(seq, asyncio.Event())
+                await ev.wait()
+                state["waiters"].pop(seq, None)
         try:
-            method = getattr(self.actor_instance, spec.function_name)
             await self.nodelet.notify("task_state", {
                 "worker_id": self.worker_id, "event": "start",
                 "name": f"{type(self.actor_instance).__name__}."
@@ -316,11 +381,11 @@ class WorkerRuntime:
                     "name": f"{type(self.actor_instance).__name__}."
                             f"{spec.function_name}"})
         finally:
-            if self.actor_max_concurrency == 1:
+            if state["next"] <= seq:
                 state["next"] = seq + 1
-                ev = state["waiters"].pop(seq + 1, None)
-                if ev:
-                    ev.set()
+            for s2 in list(state["waiters"]):
+                if s2 <= state["next"]:
+                    state["waiters"].pop(s2).set()
 
     async def _h_actor_checkpoint(self, conn, data):
         """Optional user hook: actors exposing __save__/__restore__."""
