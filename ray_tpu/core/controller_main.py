@@ -18,6 +18,14 @@ def main():
                    help="snapshot+WAL dir for controller fault tolerance")
     args = p.parse_args()
 
+    # `ray stack` facility: SIGUSR1 dumps every thread's Python stack to
+    # stderr (per-process log file) — the reference gets this from py-spy
+    # (`ray stack`, scripts.py:1712); here it's built into every runtime
+    # process.
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     from .controller import Controller
 
     async def run():

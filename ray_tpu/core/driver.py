@@ -109,6 +109,7 @@ class _SchedulingKeyState:
     def __init__(self):
         self.queue: deque = deque()          # (spec, attempts_left)
         self.leases = 0                      # leases held or being acquired
+        self.busy = 0                        # lease loops executing a task
         self.wakeup = asyncio.Event()
 
 
@@ -539,10 +540,7 @@ class CoreClient:
                 state = self._sched[key] = _SchedulingKeyState()
             state.queue.append((spec, attempts_left))
             state.wakeup.set()
-            # Pipelined lease requests: one lease per queued task, capped.
-            if state.leases < len(state.queue):
-                state.leases += 1
-                asyncio.ensure_future(self._lease_loop(key, state))
+            self._maybe_grow_leases(key, state)
         except Exception as e:
             self._fail_task(spec, f"submission failed: {e!r}")
 
@@ -577,6 +575,18 @@ class CoreClient:
             spec.args[i] = [ARG_VALUE, entry.value]
             self._remove_local_ref(oid)  # inlined; drop the pin
         return True
+
+    def _maybe_grow_leases(self, key: tuple, state: _SchedulingKeyState):
+        """Pipelined lease requests: one lease per task AWAITING service.
+        Free servers = leases - busy; a lease loop blocked inside a
+        long-running push cannot drain the queue, so counting it as
+        available deadlocks any workload where queued task B must run
+        concurrently with in-flight task A (e.g. collective rendezvous —
+        the reference avoids this by leasing per pending task,
+        direct_task_transport.cc:325 RequestNewWorkerIfNeeded)."""
+        if len(state.queue) > state.leases - state.busy:
+            state.leases += 1
+            asyncio.ensure_future(self._lease_loop(key, state))
 
     async def _lease_loop(self, key: tuple, state: _SchedulingKeyState):
         """Acquire one lease and drain the queue through it."""
@@ -649,7 +659,11 @@ class CoreClient:
                     continue
                 continue
             spec, attempts_left = state.queue.popleft()
+            state.busy += 1
             try:
+                # The queue may still hold tasks that must run CONCURRENTLY
+                # with this one; with this loop now busy, grow the pool.
+                self._maybe_grow_leases(None, state)
                 reply = await conn.call("push_task", {"spec": spec.to_wire()},
                                         timeout=None)
             except rpc.RpcError as e:
@@ -659,6 +673,8 @@ class CoreClient:
                 else:
                     self._fail_task(spec, f"worker died executing task: {e}")
                 return  # lease is dead either way
+            finally:
+                state.busy -= 1
             retried = self._handle_task_reply(spec, reply, attempts_left, state)
             if retried:
                 continue

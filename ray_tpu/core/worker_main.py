@@ -14,6 +14,14 @@ def main():
     p.add_argument("--session-dir", required=True)
     args = p.parse_args()
 
+    # `ray stack` facility: SIGUSR1 dumps every thread's Python stack to
+    # stderr (per-process log file) — the reference gets this from py-spy
+    # (`ray stack`, scripts.py:1712); here it's built into every runtime
+    # process.
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     import json
     import os
     os.environ["RAY_TPU_WORKER_CONTEXT"] = json.dumps({
