@@ -1,0 +1,21 @@
+"""Remote drivers over one endpoint (the Ray Client role).
+
+Capability mirror of the reference's Ray Client
+(/root/reference/python/ray/util/client/ — `ray://` proxy, ARCHITECTURE.md;
+server at util/client/server/proxier.py): a process OUTSIDE the cluster
+connects to a single TCP endpoint and drives the cluster transparently —
+`ray_tpu.remote/put/get/wait`, actors, named actors, and the state API all
+work, with every operation forwarded to a server-side driver core that
+owns the objects/actors on the client's behalf.
+
+    import ray_tpu.client
+    ray_tpu.client.connect("host:port")     # instead of ray_tpu.init()
+    ...normal ray_tpu API...
+    ray_tpu.shutdown()
+
+Server side (at the head): ``ray_tpu.client.serve(port)`` inside any
+driver, or ``python -m ray_tpu.client.server --address <controller>``.
+"""
+
+from .client_core import ClientCore, connect  # noqa: F401
+from .server import ClientServer, serve  # noqa: F401

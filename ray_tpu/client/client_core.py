@@ -1,0 +1,225 @@
+"""Client-side core: the CoreClient interface over one TCP connection.
+
+Implements exactly the surface `ray_tpu.api` consumes (submit/put/get/
+wait/actors/controller passthrough), so the whole user API works
+unmodified from outside the cluster — the reference's client-mode
+`ray.init("ray://...")` swap (python/ray/util/client/__init__.py).
+Values are (de)serialized client-side with the normal codec; the server
+holds a mirror ObjectRef for every ref the client sees (released on the
+client's last local release or on disconnect — the per-client ref
+tracking of the reference's proxier).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import exceptions
+from ..core import rpc, serialization
+from ..core.config import GlobalConfig
+from ..core.driver import ObjectRef
+from ..core.ids import ActorID, JobID, ObjectID, TaskID
+from ..core.task_spec import ARG_REF, ARG_VALUE, TaskSpec
+from ..core.worker_runtime import _ErrorValue
+
+
+class _ControllerProxy:
+    """core.controller lookalike: forwards .call to the client server
+    (state APIs, serve internals, and KV all ride through this)."""
+
+    def __init__(self, srv: rpc.BlockingClient):
+        self._srv = srv
+
+    def call(self, method: str, data: Any = None,
+             timeout: Optional[float] = None):
+        return self._srv.call("controller_call",
+                              {"method": method, "data": data},
+                              timeout=timeout or 60.0)
+
+    def notify(self, method: str, data: Any = None):
+        return self._srv.notify("controller_call",
+                                {"method": method, "data": data})
+
+
+class ClientCore:
+    """Drop-in for CoreClient in client mode (mode == "client")."""
+
+    def __init__(self, address: str):
+        host, port = address.replace("client://", "").rsplit(":", 1)
+        self.lt = rpc.EventLoopThread("ray-tpu-client-io")
+        self._srv = rpc.BlockingClient.connect(
+            self.lt, host, int(port), retries=GlobalConfig.rpc_connect_retries)
+        hello = self._srv.call("client_hello", {}, timeout=30)
+        self.job_id = JobID(hello["job_id"])
+        self.node_id = hello.get("node_id", "")
+        self.session_dir = hello.get("session_dir", "")
+        self.mode = "client"
+        self.controller = _ControllerProxy(self._srv)
+        self._ref_lock = threading.Lock()
+        self._local_refs: Dict[bytes, int] = {}
+        self._fn_registered: set = set()
+        self._closed = False
+
+    # ---------------------------------------------------------- ref counting
+    def _add_local_ref(self, oid: bytes):
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0)
+            self._local_refs[oid] = n + 1
+        if n == 0 and not self._closed:
+            # first local handle: mirror it server-side (idempotent there)
+            try:
+                self._srv.notify("client_ref_inc", {"object_ids": [oid]})
+            except Exception:
+                pass
+
+    def _remove_local_ref(self, oid: bytes):
+        if self._closed:
+            return
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
+        try:
+            self._srv.notify("client_ref_dec", {"object_ids": [oid]})
+        except Exception:
+            pass
+
+    # -------------------------------------------------------------- data ops
+    def put(self, value: Any) -> ObjectRef:
+        blob = serialization.serialize_to_bytes(value)
+        r = self._srv.call("client_put", {"blob": blob}, timeout=120)
+        return ObjectRef(ObjectID(r["object_id"]), self)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float]
+            ) -> List[Any]:
+        r = self._srv.call("client_get", {
+            "object_ids": [x.binary() for x in refs],
+            "timeout": timeout}, timeout=(timeout or 3600) + 30)
+        if r.get("timeout"):
+            raise exceptions.GetTimeoutError(
+                f"get() timed out waiting for {len(refs)} objects")
+        out = []
+        for blob in r["values"]:
+            value = serialization.deserialize(memoryview(blob))
+            if isinstance(value, _ErrorValue):
+                raise value.unwrap()
+            out.append(value)
+        return out
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float]
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        r = self._srv.call("client_wait", {
+            "object_ids": [x.binary() for x in refs],
+            "num_returns": num_returns, "timeout": timeout},
+            timeout=(timeout or 3600) + 30)
+        by = {x.binary(): x for x in refs}
+        return ([by[o] for o in r["ready"]],
+                [by[o] for o in r["not_ready"]])
+
+    # -------------------------------------------------------------- task ops
+    def register_function(self, fid: bytes, blob: bytes):
+        if fid in self._fn_registered:
+            return
+        self._srv.call("client_register_function",
+                       {"fid": fid, "blob": blob}, timeout=60)
+        self._fn_registered.add(fid)
+
+    def build_args(self, args: tuple, kwargs: dict):
+        encoded: List[Any] = []
+        temp_refs: List[ObjectRef] = []
+        nested: List[bytes] = []
+        for a in args:
+            encoded.append(self._encode_arg(a, temp_refs, nested))
+        encoded.append(self._encode_arg(kwargs or {}, temp_refs, nested))
+        for b in nested:
+            temp_refs.append(ObjectRef(ObjectID(b), self))
+        return encoded, temp_refs
+
+    def _encode_arg(self, value, temp_refs, nested):
+        if isinstance(value, ObjectRef):
+            return [ARG_REF, value.binary()]
+        parts = serialization.serialize(value, ref_collector=nested)
+        size = serialization.serialized_size(parts)
+        if size > GlobalConfig.inline_small_args_bytes:
+            ref = self.put(value)
+            temp_refs.append(ref)
+            return [ARG_REF, ref.binary()]
+        return [ARG_VALUE, b"".join(bytes(p) for p in parts)]
+
+    def submit_task(self, spec: TaskSpec,
+                    temp_refs: Optional[List[ObjectRef]] = None
+                    ) -> List[ObjectRef]:
+        self._srv.call("client_submit_task", {"spec": spec.to_wire()},
+                       timeout=60)
+        del temp_refs  # server-side core holds the arg pins
+        return [ObjectRef(oid, self) for oid in spec.return_ids()]
+
+    # ------------------------------------------------------------- actor ops
+    def create_actor(self, spec: TaskSpec, *, name: Optional[str],
+                     detached: bool, get_if_exists: bool = False) -> bytes:
+        r = self._srv.call("client_create_actor", {
+            "spec": spec.to_wire(), "name": name, "detached": detached,
+            "get_if_exists": get_if_exists}, timeout=120)
+        if r.get("error"):
+            raise exceptions.RayTpuError(r["error"])
+        return r["actor_id"]
+
+    def attach_actor(self, actor_id: bytes, class_name: str):
+        pass  # the server-side core tracks actor transports
+
+    def submit_actor_task(self, actor_id: bytes, spec: TaskSpec,
+                          max_task_retries: int = 0,
+                          temp_refs: Optional[List[ObjectRef]] = None
+                          ) -> List[ObjectRef]:
+        self._srv.call("client_submit_actor_task", {
+            "actor_id": actor_id, "spec": spec.to_wire(),
+            "max_task_retries": max_task_retries}, timeout=60)
+        del temp_refs
+        return [ObjectRef(oid, self) for oid in spec.return_ids()]
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self._srv.call("client_kill_actor",
+                       {"actor_id": actor_id, "no_restart": no_restart},
+                       timeout=60)
+
+    def get_named_actor(self, name: str):
+        r = self._srv.call("controller_call",
+                           {"method": "get_named_actor",
+                            "data": {"name": name}}, timeout=30)
+        return r
+
+    # ------------------------------------------------------------- lifecycle
+    def timeline(self) -> list:
+        return self._srv.call("client_timeline", {}, timeout=60)
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._srv.call("client_bye", {}, timeout=10)
+        except Exception:
+            pass
+        try:
+            self._srv.close()
+        except Exception:
+            pass
+        self.lt.stop()
+
+
+def connect(address: str) -> ClientCore:
+    """Attach this process as a REMOTE driver (reference:
+    ``ray.init("ray://host:port")``).  After this, the normal module-level
+    API (`ray_tpu.remote/put/get/...`) drives the remote cluster."""
+    from .. import api
+    from ..core.driver import get_global_core, set_global_core
+    if get_global_core() is not None:
+        raise RuntimeError("already initialized; call ray_tpu.shutdown() "
+                           "before client.connect()")
+    core = ClientCore(address)
+    set_global_core(core)
+    return core

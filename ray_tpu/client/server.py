@@ -1,0 +1,219 @@
+"""Client server: the head-side endpoint remote drivers attach to.
+
+Capability mirror of the reference's Ray Client server/proxier
+(/root/reference/python/ray/util/client/server/proxier.py — one endpoint
+multiplexing remote clients; per-client object/actor bookkeeping).
+Redesigned for the msgpack RPC stack: one `ClientServer` inside any
+driver process serves every `client_*` RPC by delegating to the local
+(real) CoreClient on a thread pool, holding a per-connection mirror
+ObjectRef for everything the remote client can reach — dropped on the
+client's release notifications or wholesale on disconnect.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import traceback
+from typing import Any, Dict, Optional
+
+from .. import exceptions
+from ..core import rpc, serialization
+from ..core.driver import ObjectRef
+from ..core.ids import ObjectID
+from ..core.task_spec import TaskSpec
+from ..core.worker_runtime import _ErrorValue
+
+
+class ClientServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from .. import api
+        self.core = api._ensure_initialized()
+        if getattr(self.core, "mode", "") == "client":
+            raise RuntimeError("ClientServer needs a real driver core")
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
+        self.lt = rpc.EventLoopThread("ray-tpu-client-server")
+        self.server = rpc.RpcServer(host, port)
+        for name in ("client_hello", "client_put", "client_get",
+                     "client_wait", "client_register_function",
+                     "client_submit_task", "client_create_actor",
+                     "client_submit_actor_task", "client_kill_actor",
+                     "client_ref_inc", "client_ref_dec", "client_timeline",
+                     "client_bye", "controller_call"):
+            self.server.register(name, self._wrap(getattr(
+                self, "_h_" + name[7:] if name.startswith("client_")
+                else "_h_" + name)))
+        self.lt.run(self.server.start())
+
+    @property
+    def address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"
+
+    def _wrap(self, fn):
+        async def handler(conn, data):
+            loop = asyncio.get_event_loop()
+            return await loop.run_in_executor(self._pool, fn, conn, data)
+        return handler
+
+    # -- per-connection mirror refs -----------------------------------------
+    def _refs(self, conn) -> Dict[bytes, list]:
+        table = conn.peer_info.get("client_refs")
+        if table is None:
+            table = conn.peer_info["client_refs"] = {}
+            prev = conn.on_close
+
+            def closed(c, prev=prev):
+                if prev:
+                    prev(c)
+                c.peer_info.get("client_refs", {}).clear()
+            conn.on_close = closed
+        return table
+
+    def _hold(self, conn, ref: ObjectRef):
+        table = self._refs(conn)
+        ent = table.get(ref.binary())
+        if ent is None:
+            table[ref.binary()] = [ref, 1]
+        else:
+            ent[1] += 1
+
+    # -- handlers -------------------------------------------------------------
+    def _h_hello(self, conn, data):
+        return {"job_id": self.core.job_id.binary(),
+                "node_id": self.core.node_id,
+                "session_dir": self.core.session_dir}
+
+    def _h_put(self, conn, data):
+        value = serialization.deserialize(memoryview(data["blob"]))
+        ref = self.core.put(value)
+        self._hold(conn, ref)
+        return {"object_id": ref.binary()}
+
+    def _h_get(self, conn, data):
+        refs = [ObjectRef(ObjectID(o), self.core)
+                for o in data["object_ids"]]
+        try:
+            values = self.core.get(refs, data.get("timeout"))
+        except exceptions.GetTimeoutError:
+            return {"timeout": True}
+        except BaseException as e:
+            try:
+                pickled = serialization.dumps_function(e)
+            except Exception:
+                pickled = None
+            err = _ErrorValue(traceback.format_exc(), pickled, "client_get")
+            return {"values": [serialization.serialize_to_bytes(err)]
+                    * len(refs)}
+        return {"values": [serialization.serialize_to_bytes(v)
+                           for v in values]}
+
+    def _h_wait(self, conn, data):
+        refs = [ObjectRef(ObjectID(o), self.core)
+                for o in data["object_ids"]]
+        ready, not_ready = self.core.wait(refs, data["num_returns"],
+                                          data.get("timeout"))
+        return {"ready": [r.binary() for r in ready],
+                "not_ready": [r.binary() for r in not_ready]}
+
+    def _h_register_function(self, conn, data):
+        self.core.register_function(data["fid"], data["blob"])
+        return True
+
+    def _h_submit_task(self, conn, data):
+        spec = TaskSpec.from_wire(data["spec"])
+        for ref in self.core.submit_task(spec):
+            self._hold(conn, ref)
+        return True
+
+    def _h_create_actor(self, conn, data):
+        spec = TaskSpec.from_wire(data["spec"])
+        try:
+            actor_id = self.core.create_actor(
+                spec, name=data.get("name"),
+                detached=bool(data.get("detached")),
+                get_if_exists=bool(data.get("get_if_exists")))
+        except Exception as e:
+            return {"error": str(e)}
+        return {"actor_id": actor_id}
+
+    def _h_submit_actor_task(self, conn, data):
+        spec = TaskSpec.from_wire(data["spec"])
+        self.core.attach_actor(data["actor_id"], spec.function_name)
+        for ref in self.core.submit_actor_task(
+                data["actor_id"], spec,
+                data.get("max_task_retries", 0)):
+            self._hold(conn, ref)
+        return True
+
+    def _h_kill_actor(self, conn, data):
+        self.core.kill_actor(data["actor_id"],
+                             data.get("no_restart", True))
+        return True
+
+    def _h_ref_inc(self, conn, data):
+        for oid in data["object_ids"]:
+            table = self._refs(conn)
+            if oid not in table:
+                # a ref the client revived from a nested value: mirror it
+                table[oid] = [ObjectRef(ObjectID(oid), self.core), 1]
+            else:
+                table[oid][1] += 1
+        return True
+
+    def _h_ref_dec(self, conn, data):
+        table = self._refs(conn)
+        for oid in data["object_ids"]:
+            ent = table.get(oid)
+            if ent is None:
+                continue
+            ent[1] -= 1
+            if ent[1] <= 0:
+                table.pop(oid, None)  # mirror ObjectRef released by GC
+        return True
+
+    def _h_timeline(self, conn, data):
+        from ..util import tracing
+        return tracing.chrome_trace_events()
+
+    def _h_bye(self, conn, data):
+        self._refs(conn).clear()
+        return True
+
+    def _h_controller_call(self, conn, data):
+        return self.core.controller.call(data["method"], data.get("data"),
+                                         timeout=60)
+
+    def stop(self):
+        try:
+            self.lt.run(self.server.stop())
+        except Exception:
+            pass
+        self.lt.stop()
+
+
+def serve(port: int = 0, host: str = "127.0.0.1") -> ClientServer:
+    """Start a client endpoint inside the current driver (the head)."""
+    return ClientServer(host, port)
+
+
+def main():
+    import argparse
+    import signal
+
+    from .. import api
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True,
+                   help="controller address host:port")
+    p.add_argument("--nodelet", required=True,
+                   help="a nodelet address host:port for this host")
+    p.add_argument("--port", type=int, default=10001)
+    args = p.parse_args()
+    api.init(address=args.address, nodelet_addr=args.nodelet)
+    s = ClientServer("0.0.0.0", args.port)
+    print(f"CLIENT_SERVER_READY {s.address}", flush=True)
+    signal.pause()
+
+
+if __name__ == "__main__":
+    main()

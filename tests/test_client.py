@@ -1,0 +1,104 @@
+"""Remote-driver client tests (VERDICT round-1 missing item 9).
+
+Capability model: the reference's Ray Client
+(/root/reference/python/ray/util/client/ — `ray://` proxy server,
+ARCHITECTURE.md; server/proxier.py): a process that is NOT part of the
+cluster drives it through one endpoint with the unchanged public API.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.client import serve as client_serve
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import sys
+
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.client
+
+    ray_tpu.client.connect(sys.argv[1])
+
+    # tasks
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get([add.remote(i, 10) for i in range(4)],
+                       timeout=60.0) == [10, 11, 12, 13]
+
+    # big objects through put/get
+    arr = np.arange(500_000, dtype=np.int64)
+    ref = ray_tpu.put(arr)
+    back = ray_tpu.get(ref, timeout=60.0)
+    assert (back == arr).all()
+
+    # refs as task args resolve server-side
+    assert int(ray_tpu.get(add.remote(ref, ref), timeout=60.0)[-1]) == \\
+        2 * (500_000 - 1)
+
+    # wait
+    ready, not_ready = ray_tpu.wait([add.remote(1, 1)], timeout=30.0)
+    assert len(ready) == 1 and not not_ready
+
+    # actors incl. named lookup from the remote driver
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+        def incr(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.options(name="remote_counter").remote()
+    assert ray_tpu.get([c.incr.remote(2) for _ in range(3)],
+                       timeout=60.0)[-1] == 6
+    c2 = ray_tpu.get_actor("remote_counter")
+    assert ray_tpu.get(c2.incr.remote(4), timeout=60.0) == 10
+
+    # state API rides the controller passthrough
+    from ray_tpu import state
+    assert any(n.get("alive") for n in state.list_nodes())
+
+    # task errors propagate to the remote driver
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom-xyz")
+
+    try:
+        ray_tpu.get(boom.remote(), timeout=60.0)
+    except Exception as e:
+        assert "boom-xyz" in str(e) or "boom-xyz" in repr(e), e
+    else:
+        raise AssertionError("error did not propagate")
+
+    ray_tpu.shutdown()
+    print("CLIENT_OK")
+""")
+
+
+def test_remote_driver_full_api(tmp_path):
+    ray_tpu.init(num_cpus=3, object_store_memory=128 * 1024 * 1024)
+    server = None
+    try:
+        server = client_serve(port=0)
+        script = tmp_path / "client_driver.py"
+        script.write_text(CLIENT_SCRIPT)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, str(script), server.address],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "CLIENT_OK" in proc.stdout
+    finally:
+        if server is not None:
+            server.stop()
+        ray_tpu.shutdown()
