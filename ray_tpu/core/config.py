@@ -109,6 +109,10 @@ _d("actor_creation_timeout_s", float, 300.0,
    "How long method calls wait for a PENDING/RESTARTING actor to come up.")
 _d("rpc_connect_retries", int, 60, "TCP connect retries (20ms backoff) at bootstrap.")
 _d("pull_retry_interval_s", float, 0.5, "Retry period for remote object pulls.")
+_d("max_pending_lease_requests", int, 10,
+   "Free (not-yet-executing) lease loops per scheduling key — bounds the "
+   "lease-request pipeline like the reference's "
+   "max_pending_lease_requests_per_scheduling_category.")
 _d("max_concurrent_pulls", int, 4,
    "Concurrent inbound object transfers per node — bounds store churn "
    "under memory pressure (reference: pull_manager.cc:228 prioritizes "
@@ -125,6 +129,9 @@ _d("max_reconstruction_depth", int, 20,
 
 # --- TPU / accelerator ------------------------------------------------------
 _d("tpu_autodetect", bool, True, "Detect local TPU chips via JAX at node start.")
+_d("tpu_detect_timeout_s", float, 30.0,
+   "Subprocess-probe timeout for TPU detection; a wedged TPU runtime must "
+   "not hang node startup.")
 _d("tpu_chips_per_host_override", int, 0, "Force the advertised TPU chip count (0=auto).")
 _d("tpu_topology_override", str, "", "Force the advertised slice topology, e.g. 'v5e-8'.")
 

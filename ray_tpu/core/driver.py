@@ -583,8 +583,13 @@ class CoreClient:
         available deadlocks any workload where queued task B must run
         concurrently with in-flight task A (e.g. collective rendezvous —
         the reference avoids this by leasing per pending task,
-        direct_task_transport.cc:325 RequestNewWorkerIfNeeded)."""
-        if len(state.queue) > state.leases - state.busy:
+        direct_task_transport.cc:325 RequestNewWorkerIfNeeded).  Free
+        (non-busy) loops are capped like the reference's pending lease
+        requests, so a burst of thousands of queued tasks doesn't storm
+        the nodelet with lease RPCs."""
+        free = state.leases - state.busy
+        if free < len(state.queue) \
+                and free < GlobalConfig.max_pending_lease_requests:
             state.leases += 1
             asyncio.ensure_future(self._lease_loop(key, state))
 

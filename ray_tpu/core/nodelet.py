@@ -733,20 +733,36 @@ class Nodelet:
 
 def detect_tpu_resources() -> Dict[str, float]:
     """TPU chip detection via JAX — the accelerator-native analogue of the
-    reference's GPU autodetect (_private/resource_spec.py:175)."""
+    reference's GPU autodetect (_private/resource_spec.py:175).
+
+    Probes in a SUBPROCESS with a hard timeout: a wedged/unreachable TPU
+    runtime (plugin client init can block indefinitely) must degrade to
+    "no TPU resources" instead of hanging the nodelet at startup."""
     if not GlobalConfig.tpu_autodetect:
         return {}
     override = GlobalConfig.tpu_chips_per_host_override
     if override:
         return {"TPU": float(override)}
+    if os.environ.get("RAY_TPU_DEVICE_BACKEND") == "cpu":
+        return {}
+    probe = ("import jax, json; d=[x for x in jax.devices() "
+             "if x.platform=='tpu']; "
+             "print('TPUPROBE '+json.dumps({'n': len(d), 'kind': "
+             "d[0].device_kind if d else ''}))")
     try:
-        import jax
-        chips = [d for d in jax.devices() if d.platform == "tpu"]
-        if chips:
-            res = {"TPU": float(len(chips))}
-            kind = chips[0].device_kind.replace(" ", "-")
-            res[f"accelerator_type:{kind}"] = 1.0
-            return res
-    except Exception:
-        pass
+        out = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, text=True,
+            timeout=GlobalConfig.tpu_detect_timeout_s)
+        for line in out.stdout.splitlines():
+            if line.startswith("TPUPROBE "):
+                import json
+                info = json.loads(line[len("TPUPROBE "):])
+                if info["n"]:
+                    res = {"TPU": float(info["n"])}
+                    kind = str(info["kind"]).replace(" ", "-")
+                    res[f"accelerator_type:{kind}"] = 1.0
+                    return res
+    except (subprocess.TimeoutExpired, OSError, ValueError):
+        print("WARNING: TPU probe timed out/failed; starting without TPU "
+              "resources", file=sys.stderr, flush=True)
     return {}
