@@ -48,13 +48,26 @@ class Config:
             return json.loads(text)
         return type_(text)
 
-    def update(self, overrides: Dict[str, Any]):
-        """Apply a JSON-style override dict (e.g. ``init(system_config=...)``)."""
+    def update(self, overrides: Dict[str, Any], export_env: bool = True):
+        """Apply a JSON-style override dict (e.g. ``init(system_config=...)``).
+
+        Overrides are also exported as ``RAY_TPU_<NAME>`` env vars so every
+        process this one SPAWNS (controller, nodelets, workers) inherits
+        them — the same-host half of the reference's cluster-wide config
+        propagation (GetSystemConfig RPC, node_manager.proto:408)."""
         for k, v in overrides.items():
             if k not in self._flags:
                 raise KeyError(f"Unknown config flag: {k}")
             f = self._flags[k]
             self._values[k] = self._parse(f.type, v) if isinstance(v, str) and f.type is not str else v
+            if export_env:
+                if isinstance(v, bool):
+                    text = "1" if v else "0"
+                elif isinstance(v, (dict, list)):
+                    text = json.dumps(v)
+                else:
+                    text = str(v)
+                os.environ[f"RAY_TPU_{k.upper()}"] = text
 
     def snapshot(self) -> Dict[str, Any]:
         return dict(self._values)
@@ -109,6 +122,12 @@ _d("actor_creation_timeout_s", float, 300.0,
    "How long method calls wait for a PENDING/RESTARTING actor to come up.")
 _d("rpc_connect_retries", int, 60, "TCP connect retries (20ms backoff) at bootstrap.")
 _d("pull_retry_interval_s", float, 0.5, "Retry period for remote object pulls.")
+_d("memory_monitor_interval_s", float, 1.0,
+   "Node memory-pressure check period; 0 disables the monitor "
+   "(reference: memory_monitor_refresh_ms).")
+_d("memory_usage_threshold", float, 0.95,
+   "Fraction of system memory above which the nodelet OOM-kills a worker "
+   "(reference: memory_usage_threshold, worker_killing_policy.cc).")
 _d("max_pending_lease_requests", int, 10,
    "Free (not-yet-executing) lease loops per scheduling key — bounds the "
    "lease-request pipeline like the reference's "

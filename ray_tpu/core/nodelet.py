@@ -124,6 +124,9 @@ class Nodelet:
             self._spawn_worker()
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if GlobalConfig.memory_monitor_interval_s > 0:
+            self._tasks.append(
+                asyncio.ensure_future(self._memory_monitor_loop()))
         return self
 
     async def _connect_controller(self):
@@ -241,6 +244,75 @@ class Nodelet:
         if (prev_state in ("idle", "starting") and not self._stopping
                 and len(self.workers) < GlobalConfig.worker_pool_initial_size):
             self._spawn_worker()
+
+    # ------------------------------------------------------- memory monitor
+    @staticmethod
+    def _memory_usage_fraction() -> float:
+        """System memory pressure from /proc/meminfo (reference:
+        MemoryMonitor::GetMemoryBytes, src/ray/common/memory_monitor.cc —
+        cgroup/system available vs total)."""
+        total = avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+        except OSError:
+            return 0.0
+        if not total:
+            return 0.0
+        return 1.0 - (avail or 0) / total
+
+    @staticmethod
+    def _worker_rss_kb(pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * (os.sysconf("SC_PAGE_SIZE") // 1024)
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    def _pick_oom_victim(self) -> Optional[WorkerProc]:
+        """Kill policy (reference: worker_killing_policy.cc — prefer
+        retriable work, newest first): leased task workers before actors,
+        and among candidates the largest RSS."""
+        leased = [w for w in self.workers.values() if w.state == "leased"]
+        actors = [w for w in self.workers.values() if w.state == "actor"]
+        for group in (leased, actors):
+            if group:
+                return max(group,
+                           key=lambda w: self._worker_rss_kb(w.proc.pid))
+        return None
+
+    async def _memory_monitor_loop(self):
+        """OOM protection (reference: raylet MemoryMonitor + worker
+        killing): above the usage threshold, kill one worker per tick —
+        its task fails with a retriable worker-died error (or the actor
+        restarts under max_restarts) instead of the kernel OOM-killing the
+        nodelet or store."""
+        while True:
+            await asyncio.sleep(GlobalConfig.memory_monitor_interval_s)
+            try:
+                frac = self._memory_usage_fraction()
+                if frac < GlobalConfig.memory_usage_threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                print(f"MEMORY PRESSURE {frac:.3f} >= "
+                      f"{GlobalConfig.memory_usage_threshold}: killing "
+                      f"worker {victim.worker_id.hex()[:8]} "
+                      f"(state={victim.state}, "
+                      f"rss={self._worker_rss_kb(victim.proc.pid)}kB)",
+                      file=sys.stderr, flush=True)
+                self._oom_kills = getattr(self, "_oom_kills", 0) + 1
+                victim.proc.kill()
+            except Exception:
+                pass  # the monitor must never die
 
     # ------------------------------------------------------------ worker pool
     def _spawn_worker(self) -> WorkerProc:
@@ -699,6 +771,8 @@ class Nodelet:
             "task_counts": dict(self._task_counts),
             "store": self.store.stats(),
             "primary_pins": len(self._primary_pins),
+            "oom_kills": getattr(self, "_oom_kills", 0),
+            "memory_usage": self._memory_usage_fraction(),
             "transfer_port": self.transfer_port,
             "available": self.available.to_dict(),
             "total": self.total.to_dict(),
