@@ -13,6 +13,14 @@ supported through rollout-worker actors like the reference's sampler.
 from .algorithm import Algorithm  # noqa: F401
 from .env import CartPole, JaxEnv, Pendulum  # noqa: F401
 from .impala import Impala, ImpalaConfig  # noqa: F401
+from .offline import (  # noqa: F401
+    BC,
+    BCConfig,
+    collect_dataset,
+    importance_sampling_estimate,
+    load_dataset,
+    save_dataset,
+)
 from .policy import MLPPolicy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401

@@ -1,0 +1,214 @@
+"""Offline RL: datasets of experience, behavioral cloning, OPE.
+
+Capability mirror of the reference's offline stack
+(/root/reference/rllib/offline/ — JsonWriter/JsonReader dataset IO,
+`rllib/offline/estimators/importance_sampling.py` off-policy estimation,
+BC/MARWIL in rllib/algorithms/bc) — TPU-first: datasets are columnar
+array batches (one device transfer, MXU-shaped minibatches), the BC
+update is one jitted scan over minibatches, and collection reuses the
+compiled rollout program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import MLPPolicy
+from .ppo import make_rollout_fn
+
+
+# ------------------------------------------------------------------ datasets
+def collect_dataset(env_factory: Callable[[], JaxEnv], policy_fn,
+                    *, n_steps: int, num_envs: int = 32,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    """Roll a (possibly scripted) policy and record columnar experience.
+
+    ``policy_fn(obs, key) -> action`` is any jittable function — a trained
+    policy's sampler or a scripted expert.  Returns T-major flattened
+    columns: obs, action, reward, done, next_obs (the reference's
+    SampleBatch columns, rllib/policy/sample_batch.py).
+    """
+    env = env_factory()
+    key = jax.random.PRNGKey(seed)
+    key, ekey = jax.random.split(key)
+    ekeys = jax.random.split(ekey, num_envs)
+    states, obs = jax.vmap(env.reset)(ekeys)
+    steps = -(-n_steps // num_envs)
+
+    def tick(carry, _):
+        states, obs, key = carry
+        key, akey, skey = jax.random.split(key, 3)
+        actions = jax.vmap(policy_fn)(obs, jax.random.split(akey, num_envs))
+        states, next_obs, rewards, dones = jax.vmap(env.step)(
+            states, actions, jax.random.split(skey, num_envs))
+        frame = {"obs": obs, "action": actions, "reward": rewards,
+                 "done": dones, "next_obs": next_obs}
+        return (states, next_obs, key), frame
+
+    (_, _, _), traj = jax.lax.scan(tick, (states, obs, key), None,
+                                   length=steps)
+    flat = {}
+    for k, v in traj.items():
+        v = np.asarray(v)
+        flat[k] = v.reshape((-1,) + v.shape[2:])[:n_steps]
+    return flat
+
+
+def save_dataset(path: str, batch: Dict[str, np.ndarray]) -> None:
+    np.savez_compressed(path, **batch)
+
+
+def load_dataset(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+# ------------------------------------------------------ behavioral cloning
+@dataclasses.dataclass
+class BCConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    dataset: Optional[Dict[str, np.ndarray]] = None
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs_per_iter: int = 1
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC(Algorithm):
+    """Behavioral cloning: maximize log pi(a|s) over the dataset
+    (reference: rllib/algorithms/bc — MARWIL with beta=0)."""
+
+    _config_cls = BCConfig
+
+    def __init__(self, config: BCConfig):
+        super().__init__(config)
+        if config.env is None or config.dataset is None:
+            raise ValueError("BCConfig.env and BCConfig.dataset required")
+        self.env = config.env()
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=config.hidden)
+        self.key = jax.random.PRNGKey(config.seed)
+        self.key, pkey = jax.random.split(self.key)
+        self.params = self.policy.init(pkey)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        ds = config.dataset
+        n = (len(ds["obs"]) // config.batch_size) * config.batch_size
+        self._obs = jnp.asarray(ds["obs"][:n])
+        self._act = jnp.asarray(ds["action"][:n])
+        self._epoch = jax.jit(self._make_epoch_fn(n))
+
+    def _make_epoch_fn(self, n: int):
+        cfg = self.config
+        policy = self.policy
+        n_mb = n // cfg.batch_size
+
+        def epoch(params, opt_state, key):
+            key, pkey = jax.random.split(key)
+            idx = jax.random.permutation(pkey, n).reshape(
+                n_mb, cfg.batch_size)
+
+            def mb_step(carry, ix):
+                params, opt_state = carry
+
+                def loss_fn(p):
+                    logp, _, _ = jax.vmap(
+                        lambda o, a: policy.log_prob(p, o, a))(
+                            self._obs[ix], self._act[ix])
+                    return -jnp.mean(logp)
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                mb_step, (params, opt_state), idx)
+            return params, opt_state, key, losses.mean()
+
+        return epoch
+
+    def training_step(self) -> Dict[str, Any]:
+        loss = None
+        for _ in range(self.config.epochs_per_iter):
+            self.params, self.opt_state, self.key, loss = self._epoch(
+                self.params, self.opt_state, self.key)
+        return {"bc_loss": float(loss),
+                "env_steps_this_iter": 0}
+
+    def action_fn(self):
+        """Greedy jittable policy for deployment/eval."""
+        policy = self.policy
+        params = self.params
+
+        def act(obs, key):
+            return policy.greedy_action(params, obs) \
+                if hasattr(policy, "greedy_action") \
+                else policy.sample_action(params, obs, key)[0]
+        return act
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.policy.get_weights(self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = self.policy.set_weights(self.params, state["params"])
+        self.iteration = state.get("iteration", 0)
+
+
+# ------------------------------------------------- off-policy estimation
+def importance_sampling_estimate(policy: MLPPolicy, params,
+                                 episodes: Dict[str, np.ndarray],
+                                 behavior_logp: np.ndarray,
+                                 gamma: float = 0.99,
+                                 weighted: bool = True) -> Dict[str, float]:
+    """Per-episode (W)IS estimate of the target policy's value from
+    behavior data (reference: rllib/offline/estimators/
+    importance_sampling.py / weighted_importance_sampling.py).
+
+    ``episodes`` columns obs/action/reward/done delimit episodes by
+    ``done``; ``behavior_logp`` are the behavior policy's log-probs for
+    the logged actions.
+    """
+    logp, _, _ = jax.vmap(lambda o, a: policy.log_prob(params, o, a))(
+        jnp.asarray(episodes["obs"]), jnp.asarray(episodes["action"]))
+    ratios = np.exp(np.asarray(logp) - behavior_logp)
+    rewards, dones = episodes["reward"], episodes["done"]
+    ep_returns, ep_weights = [], []
+    w, ret, disc = 1.0, 0.0, 1.0
+    for t in range(len(rewards)):
+        w *= float(ratios[t])
+        ret += disc * float(rewards[t])
+        disc *= gamma
+        if dones[t]:
+            ep_returns.append(ret)
+            ep_weights.append(w)
+            w, ret, disc = 1.0, 0.0, 1.0
+    if not ep_returns:
+        ep_returns, ep_weights = [ret], [w]
+    ep_returns = np.asarray(ep_returns)
+    ep_weights = np.asarray(ep_weights)
+    if weighted:
+        denom = max(ep_weights.sum(), 1e-8)
+        v = float((ep_weights * ep_returns).sum() / denom)
+    else:
+        v = float((ep_weights * ep_returns).mean())
+    return {"v_target": v,
+            "v_behavior": float(ep_returns.mean()),
+            "num_episodes": int(len(ep_returns)),
+            "mean_ratio": float(ratios.mean())}
