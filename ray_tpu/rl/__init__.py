@@ -21,6 +21,12 @@ from .offline import (  # noqa: F401
     load_dataset,
     save_dataset,
 )
+from .multi_agent import (  # noqa: F401
+    IndependentPPO,
+    IndependentPPOConfig,
+    MultiAgentJaxEnv,
+    SpreadLine,
+)
 from .policy import MLPPolicy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401

@@ -1,0 +1,288 @@
+"""Multi-agent RL: fixed-population envs + independent PPO learners.
+
+Capability mirror of the reference's multi-agent stack
+(/root/reference/rllib/env/multi_agent_env.py dict-keyed obs/actions;
+per-policy training via the policy map in rllib/evaluation/) — redesigned
+TPU-first: instead of dict-of-agents Python structures (dynamic shapes,
+host control flow), the agent population is a STATIC LEADING AXIS.
+
+  * `MultiAgentJaxEnv.step(state, actions[N], key)` returns
+    obs[N, obs_size] / rewards[N] — every agent advances in one
+    compiled program,
+  * independent learning vmaps policy params over the agent axis: N
+    policies initialize, act, and PPO-update as one XLA computation —
+    "per-agent policies" become a batch dimension instead of a Python
+    loop over policy objects.
+
+Parameter sharing is the degenerate case (broadcast one param set).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .policy import MLPPolicy
+
+
+class MultiAgentJaxEnv:
+    """Protocol: fixed ``n_agents``; states/obs/actions carry a leading
+    agent axis (static shape → MXU-friendly, no per-agent host loop)."""
+
+    n_agents: int
+    observation_size: int
+    action_size: int
+    discrete: bool = True
+
+    def reset(self, key):
+        raise NotImplementedError
+
+    def step(self, state, actions, key):
+        """→ (state, obs[N, obs], rewards[N], done) — shared episode end."""
+        raise NotImplementedError
+
+
+class SpreadLine(MultiAgentJaxEnv):
+    """N agents on a line must spread to their own targets while being
+    pushed by their neighbors — a jittable mini "simple spread"
+    (cooperative reward shaping per agent, conflict through collisions).
+    """
+
+    def __init__(self, n_agents: int = 4, horizon: int = 64):
+        self.n_agents = n_agents
+        self.horizon = horizon
+        self.observation_size = 3   # (pos, own target, nearest-other dist)
+        self.action_size = 3        # left / stay / right
+        self.discrete = True
+
+    def reset(self, key):
+        pkey, _ = jax.random.split(key)
+        pos = jax.random.uniform(pkey, (self.n_agents,), minval=-1.0,
+                                 maxval=1.0)
+        targets = jnp.linspace(-1.0, 1.0, self.n_agents)
+        state = {"pos": pos, "targets": targets,
+                 "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(state)
+
+    def _obs(self, state):
+        pos, targets = state["pos"], state["targets"]
+        diff = jnp.abs(pos[:, None] - pos[None, :]) \
+            + jnp.eye(self.n_agents) * 1e9
+        nearest = jnp.min(diff, axis=1)
+        return jnp.stack([pos, targets, nearest], axis=1)
+
+    def step(self, state, actions, key):
+        delta = (actions.astype(jnp.float32) - 1.0) * 0.1
+        pos = jnp.clip(state["pos"] + delta, -1.5, 1.5)
+        # soft collision: agents within 0.1 push each other apart
+        diff = pos[:, None] - pos[None, :]
+        close = (jnp.abs(diff) < 0.1) & ~jnp.eye(self.n_agents, dtype=bool)
+        push = jnp.sum(jnp.sign(diff) * close * 0.05, axis=1)
+        pos = jnp.clip(pos + push, -1.5, 1.5)
+        t = state["t"] + 1
+        state = {"pos": pos, "targets": state["targets"], "t": t}
+        dist = jnp.abs(pos - state["targets"])
+        rewards = -dist - 0.25 * jnp.sum(close, axis=1)
+        done = t >= self.horizon
+        return state, self._obs(state), rewards, done
+
+
+@dataclasses.dataclass
+class IndependentPPOConfig:
+    env: Optional[Callable[[], MultiAgentJaxEnv]] = None
+    num_envs: int = 32
+    rollout_length: int = 64
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    lr: float = 3e-4
+    num_sgd_epochs: int = 2
+    hidden: tuple = (64, 64)
+    share_parameters: bool = False
+    seed: int = 0
+
+    def build(self) -> "IndependentPPO":
+        return IndependentPPO(self)
+
+
+class IndependentPPO(Algorithm):
+    """One PPO learner PER AGENT, all vmapped into a single program
+    (reference: per-policy train ops over the policy_map — here the
+    policy map is an array axis)."""
+
+    _config_cls = IndependentPPOConfig
+
+    def __init__(self, config: IndependentPPOConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("IndependentPPOConfig.env required")
+        self.env = cfg.env()
+        N = self.env.n_agents
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        key, pkey, ekey = jax.random.split(key, 3)
+        if cfg.share_parameters:
+            shared = self.policy.init(pkey)
+            self.params = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x, (N,) + x.shape), shared)
+        else:
+            self.params = jax.vmap(self.policy.init)(
+                jax.random.split(pkey, N))
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = jax.vmap(self.optimizer.init)(self.params)
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.key = key
+        self._iter = jax.jit(self._make_train_iter())
+        self._ep_rewards: list = []
+
+    def _make_train_iter(self):
+        cfg = self.config
+        env = self.env
+        policy = self.policy
+        N = env.n_agents
+
+        def rollout(params, env_states, obs, key):
+            def tick(carry, _):
+                env_states, obs, key = carry
+                key, akey, skey = jax.random.split(key, 3)
+                # vmap over envs (outer) x agents (inner, with per-agent
+                # params) — one program moves every agent everywhere
+                akeys = jax.random.split(akey, cfg.num_envs * N).reshape(
+                    cfg.num_envs, N, 2)
+
+                def agents_act(obs_e, keys_e):
+                    return jax.vmap(policy.sample_action)(params, obs_e,
+                                                          keys_e)
+
+                actions, logps, values = jax.vmap(agents_act)(obs, akeys)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, rewards, done = jax.vmap(env.step)(
+                    env_states, actions, skeys)
+                frame = {"obs": obs, "action": actions, "logp": logps,
+                         "value": values, "reward": rewards,
+                         "done": jnp.broadcast_to(done[:, None],
+                                                  (cfg.num_envs, N))}
+                return (env_states, next_obs, key), frame
+
+            (env_states, last_obs, key), traj = jax.lax.scan(
+                tick, (env_states, obs, key), None,
+                length=cfg.rollout_length)
+
+            def agents_value(obs_e):
+                _, v = jax.vmap(policy.forward)(params, obs_e)
+                return v
+
+            last_value = jax.vmap(agents_value)(last_obs)
+            return traj, env_states, last_obs, last_value, key
+
+        def gae(traj, last_value):
+            def scan_fn(carry, frame):
+                next_adv, next_value = carry
+                nonterm = 1.0 - frame["done"].astype(jnp.float32)
+                delta = frame["reward"] + cfg.gamma * next_value * nonterm \
+                    - frame["value"]
+                adv = delta + cfg.gamma * cfg.gae_lambda * nonterm * next_adv
+                return (adv, frame["value"]), adv
+
+            (_, _), adv = jax.lax.scan(
+                scan_fn, (jnp.zeros_like(last_value), last_value), traj,
+                reverse=True)
+            return adv, adv + traj["value"]
+
+        def per_agent_update(params_a, opt_state_a, batch_a, key_a):
+            """One agent's PPO epochs over its own [T*B] batch."""
+            n = batch_a["obs"].shape[0]
+
+            def loss_fn(p, mb):
+                logp, entropy, value = jax.vmap(
+                    lambda o, a: policy.log_prob(p, o, a))(
+                        mb["obs"], mb["action"])
+                ratio = jnp.exp(logp - mb["logp"])
+                adv = mb["adv"]
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                pi_loss = -jnp.mean(jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1 - cfg.clip_eps,
+                             1 + cfg.clip_eps) * adv))
+                vf_loss = 0.5 * jnp.mean((value - mb["ret"]) ** 2)
+                ent = jnp.mean(entropy)
+                return pi_loss + cfg.vf_coeff * vf_loss \
+                    - cfg.entropy_coeff * ent
+
+            def epoch(carry, _):
+                p, os_, key = carry
+                key, pkey = jax.random.split(key)
+                idx = jax.random.permutation(pkey, n)
+                mb = jax.tree_util.tree_map(lambda x: x[idx], batch_a)
+                loss, grads = jax.value_and_grad(loss_fn)(p, mb)
+                updates, os_ = self.optimizer.update(grads, os_, p)
+                p = optax.apply_updates(p, updates)
+                return (p, os_, key), loss
+
+            (params_a, opt_state_a, _), losses = jax.lax.scan(
+                epoch, (params_a, opt_state_a, key_a), None,
+                length=cfg.num_sgd_epochs)
+            return params_a, opt_state_a, losses[-1]
+
+        def train_iter(params, opt_state, env_states, obs, key):
+            traj, env_states, obs, last_value, key = rollout(
+                params, env_states, obs, key)
+            adv, ret = gae(traj, last_value)
+            TB = cfg.rollout_length * cfg.num_envs
+            # [T, B, N, ...] -> per-agent [N, T*B, ...]
+            def to_agent_major(x):
+                x = jnp.moveaxis(x, 2, 0)
+                return x.reshape((N, TB) + x.shape[3:])
+
+            batch = {
+                "obs": to_agent_major(traj["obs"]),
+                "action": to_agent_major(traj["action"]),
+                "logp": to_agent_major(traj["logp"]),
+                "adv": to_agent_major(adv),
+                "ret": to_agent_major(ret),
+            }
+            key, ukey = jax.random.split(key)
+            params, opt_state, losses = jax.vmap(per_agent_update)(
+                params, opt_state, batch, jax.random.split(ukey, N))
+            mean_reward = traj["reward"].mean(axis=(0, 1))  # per agent
+            return (params, opt_state, env_states, obs, key,
+                    losses, mean_reward)
+
+        return train_iter
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        (self.params, self.opt_state, self.env_states, self.obs, self.key,
+         losses, mean_reward) = self._iter(
+            self.params, self.opt_state, self.env_states, self.obs,
+            self.key)
+        mean_reward = np.asarray(mean_reward)
+        self._ep_rewards.append(float(mean_reward.mean()))
+        return {
+            "loss_per_agent": np.asarray(losses).tolist(),
+            "reward_mean_per_agent": mean_reward.tolist(),
+            "reward_mean": float(mean_reward.mean()),
+            "env_steps_this_iter":
+                cfg.num_envs * cfg.rollout_length * self.env.n_agents,
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.iteration = state.get("iteration", 0)

@@ -1,0 +1,66 @@
+"""Multi-agent RL tests.
+
+Reference model: /root/reference/rllib/env/multi_agent_env.py +
+per-policy training via the policy map; here the agent population is a
+static array axis and N independent PPO learners run as one program.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.rl.multi_agent import (IndependentPPOConfig, SpreadLine)
+
+
+def test_env_contract():
+    env = SpreadLine(n_agents=4)
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    assert obs.shape == (4, 3)
+    actions = np.array([0, 1, 2, 1])
+    state, obs2, rewards, done = env.step(state, actions,
+                                          jax.random.PRNGKey(1))
+    assert obs2.shape == (4, 3) and rewards.shape == (4,)
+    assert not bool(done)
+
+
+def test_independent_ppo_improves_all_agents():
+    cfg = IndependentPPOConfig(env=lambda: SpreadLine(n_agents=3),
+                               num_envs=32, rollout_length=64,
+                               lr=3e-3, num_sgd_epochs=3, seed=0)
+    algo = cfg.build()
+    first = algo.train()
+    for _ in range(15):
+        result = algo.train()
+    # every agent's mean reward improved over its own starting point
+    first_r = np.asarray(first["reward_mean_per_agent"])
+    last_r = np.asarray(result["reward_mean_per_agent"])
+    assert (last_r > first_r).all(), (first_r, last_r)
+    assert result["reward_mean"] > first["reward_mean"]
+    # per-agent parameters actually diverged (independent learners)
+    leaf = jax.tree_util.tree_leaves(algo.params)[0]
+    assert not np.allclose(np.asarray(leaf[0]), np.asarray(leaf[1]))
+
+
+def test_shared_parameters_mode():
+    cfg = IndependentPPOConfig(env=lambda: SpreadLine(n_agents=3),
+                               num_envs=8, rollout_length=16,
+                               share_parameters=True, seed=0)
+    algo = cfg.build()
+    leaf = jax.tree_util.tree_leaves(algo.params)[0]
+    np.testing.assert_array_equal(np.asarray(leaf[0]), np.asarray(leaf[1]))
+    result = algo.train()
+    assert np.isfinite(result["reward_mean"])
+
+
+def test_checkpoint_roundtrip():
+    cfg = IndependentPPOConfig(env=lambda: SpreadLine(n_agents=2),
+                               num_envs=8, rollout_length=16, seed=0)
+    algo = cfg.build()
+    algo.train()
+    ck = algo.save()
+    algo2 = cfg.build()
+    algo2.restore(ck)
+    a = jax.tree_util.tree_leaves(algo.params)[0]
+    b = jax.tree_util.tree_leaves(algo2.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
