@@ -11,3 +11,4 @@ strategy).
 
 from .autoscaler import StandardAutoscaler, request_resources  # noqa: F401
 from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401
+from .tpu_pod_provider import TpuPodProvider  # noqa: F401

@@ -110,7 +110,12 @@ class DashboardHead:
             # refs values contain non-JSON types (hex-keyed dicts are fine)
             return json.loads(json.dumps(m, default=str))
 
+        async def index(_):
+            from .index_html import INDEX_HTML
+            return web.Response(text=INDEX_HTML, content_type="text/html")
+
         app = web.Application()
+        app.router.add_get("/", index)
         app.router.add_get("/api/nodes/{node_id}/stats",
                            blocking(node_stats))
         app.router.add_get("/api/objects", blocking(objects))

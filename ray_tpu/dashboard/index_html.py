@@ -1,0 +1,76 @@
+"""Single-file dashboard frontend served at ``/`` by the head.
+
+The reference ships a React/TS client (dashboard/client/src/); this is the
+framework-native minimal equivalent: one dependency-free HTML page that
+polls the REST API (/api/cluster_summary, /api/nodes, /api/actors,
+/api/tasks, /api/jobs, /api/memory) and renders live tables.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray-tpu dashboard</title>
+<style>
+  body { font-family: ui-monospace, Menlo, monospace; margin: 1.5rem;
+         background: #101418; color: #d8dee6; }
+  h1 { font-size: 1.1rem; } h2 { font-size: 0.95rem; margin: 1.2rem 0 .4rem; }
+  table { border-collapse: collapse; width: 100%; font-size: .8rem; }
+  th, td { border: 1px solid #2a3138; padding: .25rem .5rem;
+           text-align: left; }
+  th { background: #1a2026; }
+  .ok { color: #7fd962; } .bad { color: #f07178; }
+  #err { color: #f07178; min-height: 1em; }
+</style>
+</head>
+<body>
+<h1>ray-tpu dashboard</h1>
+<div id="err"></div>
+<h2>cluster</h2><div id="summary"></div>
+<h2>nodes</h2><table id="nodes"></table>
+<h2>running tasks</h2><table id="tasks"></table>
+<h2>actors</h2><table id="actors"></table>
+<h2>jobs</h2><table id="jobs"></table>
+<h2>object store</h2><table id="stores"></table>
+<script>
+async function j(url) { const r = await fetch(url); return r.json(); }
+function table(el, rows, cols) {
+  const t = document.getElementById(el);
+  if (!rows || !rows.length) { t.innerHTML = "<tr><td>(none)</td></tr>"; return; }
+  let h = "<tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => `<td>${fmt(r[c])}</td>`).join("") + "</tr>";
+  t.innerHTML = h;
+}
+function fmt(v) {
+  if (v === null || v === undefined) return "";
+  if (typeof v === "object") return JSON.stringify(v);
+  return String(v);
+}
+async function refresh() {
+  try {
+    const [sum, nodes, actors, tasks, jobs, mem] = await Promise.all([
+      j("/api/cluster_summary"), j("/api/nodes"), j("/api/actors"),
+      j("/api/tasks"), j("/api/jobs"), j("/api/memory")]);
+    document.getElementById("summary").textContent = JSON.stringify(sum);
+    table("nodes", nodes, ["id", "addr", "alive", "total", "available"]);
+    table("tasks", tasks, ["name", "task_id", "node_id", "worker_id"]);
+    table("actors", actors, ["actor_id", "class_name", "state", "name",
+                             "address", "num_restarts"]);
+    table("jobs", jobs, ["job_id", "status", "entrypoint"]);
+    const stores = Object.entries(mem.stores || {}).map(
+      ([k, v]) => ({node: k, ...v}));
+    table("stores", stores, ["node", "used_bytes", "capacity_bytes",
+                             "num_objects", "num_evictions",
+                             "primary_pins"]);
+    document.getElementById("err").textContent = "";
+  } catch (e) {
+    document.getElementById("err").textContent = "refresh failed: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -125,6 +125,43 @@ def cmd_logs(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_stack(args) -> None:
+    """Dump Python stacks of every local runtime process (reference:
+    `ray stack`, scripts.py:1712 via py-spy): SIGUSR1 makes each process
+    write all thread stacks to its session log; this prints them."""
+    import glob
+    import signal
+    import subprocess
+    import time as _time
+
+    patterns = ("ray_tpu.core.controller_main", "ray_tpu.core.nodelet_main",
+                "ray_tpu.core.worker_main")
+    signalled = 0
+    for pat in patterns:
+        out = subprocess.run(["pkill", "-USR1", "-f", pat],
+                             capture_output=True)
+        signalled += 1 if out.returncode == 0 else 0
+    _time.sleep(1.0)
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray-tpu-sessions")
+    sessions = sorted(glob.glob(os.path.join(base, "session_*")),
+                      key=os.path.getmtime)
+    if not sessions:
+        print("no sessions found")
+        return
+    logdir = os.path.join(sessions[-1], "logs")
+    for f in sorted(glob.glob(os.path.join(logdir, "*"))):
+        try:
+            with open(f, "rb") as fh:
+                data = fh.read()[-20000:]
+        except OSError:
+            continue
+        if b"Thread 0x" in data:
+            print(f"==== {os.path.basename(f)}")
+            tail = data[data.rfind(b"Thread 0x"):]
+            sys.stdout.write(tail.decode(errors="replace"))
+    print(f"(signalled {signalled} process groups; stacks from {logdir})")
+
+
 def cmd_memory(args) -> None:
     """`ray memory` equivalent: object table + borrows + store usage."""
     import ray_tpu
@@ -207,6 +244,9 @@ def main(argv=None) -> None:
     sp.add_argument("job_id")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("stack", help="dump stacks of runtime processes")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("memory", help="object/ref memory dump")
     sp.add_argument("--address")

@@ -1,0 +1,88 @@
+"""TPU-pod provider tests (gcloud mutations via an injected fake runner).
+
+Reference model: /root/reference/python/ray/autoscaler/_private/gcp/
+node_provider.py (cloud provider plugin) — here specialized to TPU slices
+where one scale-up brings a whole ICI sub-mesh online.
+"""
+
+import json
+
+import pytest
+
+from ray_tpu.autoscaler.tpu_pod_provider import TpuPodProvider
+
+
+class FakeGcloud:
+    def __init__(self):
+        self.calls = []
+        self.instances = {}
+
+    def __call__(self, args, timeout=None):
+        self.calls.append(args)
+        cmd = args[:4]
+        if cmd[:3] == ["compute", "tpus", "tpu-vm"]:
+            verb = cmd[3]
+            if verb == "create":
+                name = args[4]
+                self.instances[name] = {"name": name, "state": "READY"}
+                return ""
+            if verb == "delete":
+                self.instances.pop(args[4], None)
+                return ""
+            if verb == "list":
+                return json.dumps(list(self.instances.values()))
+        raise AssertionError(f"unexpected gcloud args {args}")
+
+
+@pytest.fixture
+def provider():
+    fake = FakeGcloud()
+    p = TpuPodProvider(
+        project="proj", zone="us-central2-b",
+        head_address="10.0.0.2:6379",
+        node_types={
+            "v4_8": {"accelerator_type": "v4-8", "hosts": 1},
+            "v4_32": {"accelerator_type": "v4-32", "hosts": 4,
+                      "host_resources": {"CPU": 16.0, "TPU": 4.0}},
+        },
+        runner=fake)
+    return p, fake
+
+
+def test_create_list_terminate_lifecycle(provider):
+    p, fake = provider
+    n1 = p.create_node("v4_8")
+    n2 = p.create_node("v4_32")
+    assert set(p.non_terminated_nodes()) == {n1, n2}
+    create = fake.calls[0]
+    assert "--accelerator-type" in create
+    assert create[create.index("--accelerator-type") + 1] == "v4-8"
+    # startup script joins every host to THIS cluster
+    meta = create[create.index("--metadata") + 1]
+    assert "ray-tpu start --address 10.0.0.2:6379" in meta
+    p.terminate_node(n1)
+    assert p.non_terminated_nodes() == [n2]
+
+
+def test_slice_resources_scale_with_hosts(provider):
+    p, _ = provider
+    assert p.node_resources("v4_8") == {"CPU": 8.0, "TPU": 4.0}
+    assert p.node_resources("v4_32") == {"CPU": 64.0, "TPU": 16.0}
+
+
+def test_bin_packing_against_tpu_demand(provider):
+    """The autoscaler's bin-packer picks the slice type that satisfies a
+    TPU demand (StandardAutoscaler._nodes_to_launch over the provider's
+    node types)."""
+    p, fake = provider
+    from ray_tpu.autoscaler.autoscaler import (StandardAutoscaler,
+                                               request_resources)
+    auto = StandardAutoscaler(p, state_source=lambda: [])
+    request_resources([{"TPU": 16.0}])
+    try:
+        plan = auto._nodes_to_launch([])
+        assert plan, "demand for 16 chips must launch something"
+        (node_type, count), = plan.items()
+        assert node_type == "v4_32" and count == 1
+    finally:
+        request_resources([])
