@@ -12,5 +12,6 @@ never as a sidecar allreduce library.
 from .backend import Backend, BackendConfig, SpmdConfig, HostArrayConfig  # noqa: F401
 from .backend_executor import BackendExecutor  # noqa: F401
 from .checkpointing import CheckpointManager  # noqa: F401
+from .hf import TransformersTrainer  # noqa: F401
 from .trainer import JaxTrainer, TorchCompatTrainer  # noqa: F401
 from .worker_group import WorkerGroup  # noqa: F401

@@ -1,0 +1,80 @@
+"""Hugging Face `transformers` trainer integration.
+
+Capability mirror of the reference's `HuggingFaceTrainer`
+(/root/reference/python/ray/train/huggingface/huggingface_trainer.py:157 —
+wrap a user-built `transformers.Trainer` so it runs data-parallel across
+the gang with results/checkpoints bubbling through the session): here the
+gang is the framework's worker group, the process group is the
+torch-gloo compat backend (CPU torch in this image; the JAX path is the
+flagship — this exists for drop-in reference-style workloads), and a
+`TrainerCallback` bridges HF logs/checkpoints into `session.report`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..air import Checkpoint, RunConfig, ScalingConfig
+from .trainer import TorchCompatTrainer
+
+
+class TransformersTrainer(TorchCompatTrainer):
+    """``trainer_init_per_worker(config) -> transformers.Trainer`` runs on
+    every worker; torch.distributed (gloo) is already initialized, so HF's
+    own DDP wrapping distributes the step."""
+
+    def __init__(self, trainer_init_per_worker: Callable[[Dict[str, Any]],
+                                                         Any], *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+
+        def loop(config: Dict[str, Any]):
+            import os
+            import tempfile
+
+            import transformers
+
+            from ..air import session
+
+            # HF reads the distributed layout from env (the gloo group is
+            # already up — _TorchGlooBackend).
+            os.environ.setdefault("RANK", str(session.get_world_rank()))
+            os.environ.setdefault("WORLD_SIZE",
+                                  str(session.get_world_size()))
+            os.environ.setdefault("LOCAL_RANK", "0")
+            trainer = trainer_init_per_worker(config)
+
+            class _SessionBridge(transformers.TrainerCallback):
+                """HF logs → session.report; rank 0 ships checkpoints
+                (reference: the _huggingface integration's report
+                callback)."""
+
+                def on_log(self, args, state, control, logs=None, **kw):
+                    if logs is None:
+                        return
+                    metrics = {k: v for k, v in logs.items()
+                               if isinstance(v, (int, float))}
+                    metrics["iteration"] = int(state.global_step)
+                    ckpt = None
+                    if session.get_world_rank() == 0:
+                        with tempfile.TemporaryDirectory() as d:
+                            trainer.save_model(d)
+                            # pack while the dir exists: from_directory
+                            # holds a path reference only
+                            ckpt = Checkpoint.from_bytes(
+                                Checkpoint.from_directory(d).to_bytes())
+                    session.report(metrics, checkpoint=ckpt)
+
+            trainer.add_callback(_SessionBridge())
+            resume = None
+            ck = session.get_checkpoint()
+            if ck is not None:
+                resume = ck.to_directory()
+            trainer.train(resume_from_checkpoint=resume)
+
+        super().__init__(loop, train_loop_config=train_loop_config,
+                         scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
