@@ -113,6 +113,11 @@ class Controller:
         self._tasks: List[asyncio.Task] = []
         self._pub_buf: Dict[int, tuple] = {}   # conn id -> (conn, events)
         self._pub_flusher: Optional[asyncio.Task] = None
+        # structured cluster events (reference: src/ray/util/event.h +
+        # dashboard/modules/event): bounded ring, newest last
+        from collections import deque as _deque
+        self.events = _deque(maxlen=1000)
+        self._event_seq = 0
         # -- durability (reference: gcs_table_storage.h:357 Redis-backed
         # GCS restart; here snapshot+WAL on local disk, persistence.py) ----
         self.pstore = None
@@ -194,6 +199,7 @@ class Controller:
                      "object_location_add", "object_location_remove",
                      "object_locations_get", "free_objects", "list_objects",
                      "ref_inc", "ref_dec", "free_request", "ref_counts",
+                     "report_event", "list_events",
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
                      "drain_node", "ping"):
@@ -322,6 +328,9 @@ class Controller:
             return
         rec.view.alive = False
         self._bump_view()
+        self._emit_event("ERROR", "controller",
+                         f"node {node_id[:12]} died: {reason}",
+                         node_id=node_id)
         await self._broadcast("nodes", {"event": "dead", "node_id": node_id,
                                         "reason": reason})
         # Purge object locations on that node.
@@ -523,6 +532,12 @@ class Controller:
         else:
             actor.state = DEAD
             actor.death_cause = reason
+            if not intended:
+                self._emit_event(
+                    "ERROR", "controller",
+                    f"actor {actor.actor_id.hex()[:12]} "
+                    f"({actor.spec.get('fname', '?')}) died: {reason}",
+                    actor_id=actor.actor_id.hex())
             if actor.name:
                 self.named_actors.pop(actor.name, None)
             self._notify_actor_waiters(actor)
@@ -860,6 +875,30 @@ class Controller:
         return True
 
     # ---------------------------------------------------------------- pubsub
+    # ----------------------------------------------------------------- events
+    def _emit_event(self, severity: str, source: str, message: str,
+                    **meta):
+        self._event_seq += 1
+        ev = {"seq": self._event_seq, "ts": time.time(),
+              "severity": severity, "source": source, "message": message,
+              "meta": meta}
+        self.events.append(ev)
+        asyncio.ensure_future(self._broadcast("events", ev))
+
+    async def _h_report_event(self, conn, data):
+        self._emit_event(data.get("severity", "INFO"),
+                         data.get("source", "user"),
+                         data.get("message", ""),
+                         **(data.get("meta") or {}))
+        return True
+
+    async def _h_list_events(self, conn, data):
+        sev = data.get("severity")
+        limit = int(data.get("limit", 200))
+        out = [e for e in self.events
+               if sev is None or e["severity"] == sev]
+        return out[-limit:]
+
     async def _h_subscribe(self, conn, data):
         self.subscribers.setdefault(data["channel"], set()).add(conn)
         return True

@@ -311,6 +311,15 @@ class Nodelet:
                       file=sys.stderr, flush=True)
                 self._oom_kills = getattr(self, "_oom_kills", 0) + 1
                 victim.proc.kill()
+                try:
+                    await self.controller.notify("report_event", {
+                        "severity": "ERROR", "source": "memory_monitor",
+                        "message": f"OOM-killed worker "
+                                   f"{victim.worker_id.hex()[:8]} at "
+                                   f"{frac:.2f} memory usage",
+                        "meta": {"node_id": self.node_id.hex()}})
+                except Exception:
+                    pass
             except Exception:
                 pass  # the monitor must never die
 

@@ -118,6 +118,11 @@ class DashboardHead:
         app.router.add_get("/", index)
         app.router.add_get("/api/nodes/{node_id}/stats",
                            blocking(node_stats))
+        def events(_):
+            from .. import state
+            return state.list_events()
+
+        app.router.add_get("/api/events", blocking(events))
         app.router.add_get("/api/objects", blocking(objects))
         app.router.add_get("/api/tasks", blocking(tasks))
         app.router.add_get("/api/memory", blocking(memory))

@@ -111,6 +111,22 @@ def summarize_tasks() -> Dict[str, Any]:
     return {"finished_by_func": counts, "running": running}
 
 
+def list_events(severity: Optional[str] = None,
+                limit: int = 200) -> List[Dict[str, Any]]:
+    """Structured cluster events, newest last (reference: the event
+    framework, src/ray/util/event.h + dashboard/modules/event)."""
+    return _ensure_initialized().controller.call(
+        "list_events", {"severity": severity, "limit": limit})
+
+
+def report_event(message: str, *, severity: str = "INFO",
+                 source: str = "user", **meta) -> None:
+    """Emit a user event into the cluster event log."""
+    _ensure_initialized().controller.call(
+        "report_event", {"severity": severity, "source": source,
+                         "message": message, "meta": meta})
+
+
 def list_objects() -> List[Dict[str, Any]]:
     """Cluster object table: size, locations, borrow holders, deferred
     frees (reference: `ray list objects`)."""

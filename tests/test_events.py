@@ -1,0 +1,44 @@
+"""Structured cluster events (reference: src/ray/util/event.h +
+dashboard/modules/event): lifecycle failures and user events land in a
+bounded controller-side log, queryable via the state API."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+
+def test_user_and_actor_death_events():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        state.report_event("deploy started", severity="INFO",
+                           source="ci", build="abc123")
+        evs = state.list_events()
+        assert any(e["message"] == "deploy started"
+                   and e["meta"].get("build") == "abc123" for e in evs)
+
+        @ray_tpu.remote
+        class Crasher:
+            def die(self):
+                import os
+                os._exit(9)
+
+        c = Crasher.remote()
+        with pytest.raises(Exception):
+            ray_tpu.get(c.die.remote(), timeout=60.0)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            errs = state.list_events(severity="ERROR")
+            if any("actor" in e["message"] and "died" in e["message"]
+                   for e in errs):
+                break
+            time.sleep(0.2)
+        assert any("actor" in e["message"] and "died" in e["message"]
+                   for e in errs), errs
+        # ordering: seq strictly increasing
+        seqs = [e["seq"] for e in state.list_events()]
+        assert seqs == sorted(seqs)
+    finally:
+        ray_tpu.shutdown()
