@@ -23,7 +23,9 @@ import time
 import traceback
 
 _CHILD_FLAG = "_BENCH_CHILD"   # value: "tpu" or "cpu"
-_TPU_RETRIES = 3
+_TPU_RETRIES = 2
+_TPU_PROBE_TIMEOUT = 180       # quick devices() probe before real attempts
+_TPU_ATTEMPT_TIMEOUT = 900     # a wedged tunnel must not eat the round
 
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets)
 _PEAK_TFLOPS = {
@@ -128,7 +130,8 @@ def _spawn(mode: str) -> "subprocess.CompletedProcess":
     return subprocess.run(
         [sys.executable, os.path.abspath(__file__)], env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
-        capture_output=True, text=True, timeout=1800)
+        capture_output=True, text=True,
+        timeout=_TPU_ATTEMPT_TIMEOUT if mode == "tpu" else 1800)
 
 
 def _extract_json_line(out: str):
@@ -149,7 +152,26 @@ def main() -> None:
         return
 
     errors = []
-    for attempt in range(_TPU_RETRIES):
+    # Fast probe: a wedged/unreachable TPU runtime can block client init
+    # indefinitely — detect that in bounded time and skip straight to the
+    # CPU fallback instead of burning the attempt budget.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('NTPU', sum(d.platform == 'tpu' "
+             "for d in jax.devices()))"],
+            capture_output=True, text=True, timeout=_TPU_PROBE_TIMEOUT)
+        probe_ok = probe.returncode == 0 and "NTPU" in probe.stdout \
+            and "NTPU 0" not in probe.stdout
+        if not probe_ok:
+            errors.append(f"tpu probe: rc={probe.returncode} "
+                          f"out={probe.stdout.strip()[:80]} "
+                          f"err={probe.stderr.strip()[-160:]}")
+    except subprocess.TimeoutExpired:
+        probe_ok = False
+        errors.append(f"tpu probe: timeout after {_TPU_PROBE_TIMEOUT}s "
+                      "(wedged TPU runtime)")
+    for attempt in range(_TPU_RETRIES if probe_ok else 0):
         try:
             proc = _spawn("tpu")
         except subprocess.TimeoutExpired:
