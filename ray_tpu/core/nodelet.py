@@ -127,6 +127,9 @@ class Nodelet:
         if GlobalConfig.memory_monitor_interval_s > 0:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
+        self._lag_ewma = 0.0
+        self._lag_max = 0.0
+        self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
         return self
 
     async def _connect_controller(self):
@@ -782,6 +785,9 @@ class Nodelet:
             "primary_pins": len(self._primary_pins),
             "oom_kills": getattr(self, "_oom_kills", 0),
             "memory_usage": self._memory_usage_fraction(),
+            "event_loop_lag": {
+                "ewma_ms": getattr(self, "_lag_ewma", 0.0) * 1000.0,
+                "max_ms": getattr(self, "_lag_max", 0.0) * 1000.0},
             "transfer_port": self.transfer_port,
             "available": self.available.to_dict(),
             "total": self.total.to_dict(),

@@ -220,12 +220,22 @@ class EventLoopThread:
 
     def __init__(self, name: str = "ray-tpu-io"):
         self.loop = asyncio.new_event_loop()
+        self._lag_ewma = 0.0   # seconds; see loop_lag_monitor
+        self._lag_max = 0.0
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
+        self.loop.create_task(loop_lag_monitor(self))
         self.loop.run_forever()
+
+    def lag_stats(self) -> Dict[str, float]:
+        """Event-loop scheduling lag (reference: asio event_stats,
+        src/ray/common/event_stats.cc — how late handlers run vs when they
+        were ready)."""
+        return {"ewma_ms": self._lag_ewma * 1000.0,
+                "max_ms": self._lag_max * 1000.0}
 
     def run(self, coro, timeout: Optional[float] = None):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
@@ -237,6 +247,20 @@ class EventLoopThread:
     def stop(self):
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=2)
+
+
+async def loop_lag_monitor(owner, interval: float = 0.25):
+    """Measure how late the loop wakes from a timed sleep — a saturated or
+    blocked loop (sync work on the async thread) shows up as lag.  Works
+    for EventLoopThread and for server processes (owner just needs
+    `_lag_ewma`/`_lag_max` attributes)."""
+    import time as _time
+    while True:
+        t0 = _time.monotonic()
+        await asyncio.sleep(interval)
+        lag = max(0.0, _time.monotonic() - t0 - interval)
+        owner._lag_ewma = 0.9 * owner._lag_ewma + 0.1 * lag
+        owner._lag_max = max(owner._lag_max, lag)
 
 
 class BlockingClient:
