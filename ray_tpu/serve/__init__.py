@@ -21,4 +21,5 @@ from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
 from .deployment import Deployment, deployment  # noqa: F401
 from .gang import GangContext, get_gang_context  # noqa: F401
+from .graph import composed, pipeline, run_graph  # noqa: F401
 from .handle import ServeHandle  # noqa: F401

@@ -27,8 +27,11 @@ def start(http_options: Optional[HTTPOptions] = None, *,
     """Boot the controller (and HTTP proxy) if not already running."""
     if "controller" in _state:
         return
+    # Named so ANY process (e.g. a graph-driver replica composing other
+    # deployments) can resolve the controller and build its own router.
     controller = core_api.remote(ServeController).options(
-        num_cpus=0.1).remote()
+        num_cpus=0.1, name="serve::controller",
+        get_if_exists=True).remote()
     _state["controller"] = controller
     _state["router"] = Router(controller)
     http = http_options or HTTPOptions(port=_free_port())

@@ -1,0 +1,101 @@
+"""Model composition: multi-deployment inference graphs.
+
+Capability mirror of the reference's deployment graphs
+(/root/reference/python/ray/serve/deployment_graph.py + the DAGDriver in
+serve/drivers.py, built on ray/dag): several deployments composed into one
+routable endpoint.  Two entry points:
+
+  * ``serve.pipeline([d1, d2, ...])`` — the linear chain (each stage's
+    output feeds the next stage's input; the dominant production shape:
+    preprocess → model → postprocess),
+  * ``serve.composed(fn, deployments={...})`` — arbitrary composition:
+    ``fn(handles, *args)`` runs inside a driver deployment with a handle
+    per upstream deployment, so branches/ensembles/conditionals are plain
+    Python over async-capable handles (the reference's DAGDriver role).
+
+Every upstream deployment is deployed alongside the driver; the driver is
+what the router/proxy expose.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .deployment import Deployment, deployment
+
+
+class _HandleProxy:
+    """What the composition fn sees: call a deployment like a function."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._handle = None
+
+    def _resolve(self):
+        if self._handle is None:
+            from . import api as serve_api
+            if "router" in serve_api._state:     # driver process
+                self._handle = serve_api.get_handle(self._name)
+            else:                                # inside a replica
+                from .. import api as core_api
+                from .handle import ServeHandle
+                from .router import Router
+                ctrl = core_api.get_actor("serve::controller")
+                self._handle = ServeHandle(Router(ctrl), self._name)
+        return self._handle
+
+    def __call__(self, *args, **kwargs):
+        """Synchronous call-through (stages run remotely; the driver
+        deployment blocks on the result)."""
+        return self._resolve().remote(*args, **kwargs).result(
+            timeout_s=300.0)
+
+    def remote(self, *args, **kwargs):
+        """Async: returns the tracked ref (compose fan-out/ensembles)."""
+        return self._resolve().remote(*args, **kwargs)
+
+
+def composed(fn: Callable, *, deployments: Dict[str, Deployment],
+             name: Optional[str] = None,
+             **driver_options) -> Deployment:
+    """A driver deployment running ``fn(handles, *args, **kwargs)`` with a
+    `_HandleProxy` per upstream deployment."""
+    dep_names = {key: d.name for key, d in deployments.items()}
+
+    class _Driver:
+        def __init__(self):
+            self._handles = {key: _HandleProxy(dname)
+                             for key, dname in dep_names.items()}
+
+        def __call__(self, *args, **kwargs):
+            return fn(self._handles, *args, **kwargs)
+
+    _Driver.__name__ = name or getattr(fn, "__name__", "graph_driver")
+    driver = deployment(_Driver, name=name or f"{_Driver.__name__}",
+                        **driver_options)
+    driver._upstreams = list(deployments.values())  # deployed by run_graph
+    return driver
+
+
+def pipeline(stages: List[Deployment], *, name: str = "pipeline",
+             **driver_options) -> Deployment:
+    """Linear chain: output of stage i feeds stage i+1."""
+    keys = [f"s{i}" for i in range(len(stages))]
+
+    def chain(handles, *args, **kwargs):
+        out = handles[keys[0]](*args, **kwargs)
+        for k in keys[1:]:
+            out = handles[k](out)
+        return out
+
+    return composed(chain, deployments=dict(zip(keys, stages)), name=name,
+                    **driver_options)
+
+
+def run_graph(driver: Deployment, *, route_prefix: Optional[str] = None):
+    """Deploy every upstream deployment, then the driver (the routable
+    endpoint).  Returns the driver's handle."""
+    from . import api as serve_api
+    for up in getattr(driver, "_upstreams", []):
+        serve_api.run(up, route_prefix=None)
+    return serve_api.run(driver, route_prefix=route_prefix or "__derive__")

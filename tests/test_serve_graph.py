@@ -1,0 +1,56 @@
+"""Serve model composition (reference: serve/deployment_graph.py +
+DAGDriver in serve/drivers.py): multiple deployments behind one routable
+endpoint — linear pipelines and arbitrary composition (ensembles)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_linear_pipeline(cluster):
+    @serve.deployment(name="tokenize")
+    def tokenize(text):
+        return text.split()
+
+    @serve.deployment(name="count")
+    def count(tokens):
+        return {"n": len(tokens)}
+
+    driver = serve.pipeline([tokenize, count], name="wc")
+    handle = serve.run_graph(driver)
+    out = handle.remote("a b c d").result(timeout_s=120.0)
+    assert out == {"n": 4}
+    # all three deployments exist; the driver is the endpoint
+    deps = serve.list_deployments()
+    assert {"tokenize", "count", "wc"} <= set(deps)
+
+
+def test_composed_ensemble(cluster):
+    @serve.deployment(name="m1")
+    def m1(x):
+        return x * 2
+
+    @serve.deployment(name="m2")
+    def m2(x):
+        return x + 100
+
+    def ensemble(handles, x):
+        # fan out to both models concurrently, then combine
+        r1 = handles["a"].remote(x)
+        r2 = handles["b"].remote(x)
+        return {"sum": r1.result(timeout_s=60.0) + r2.result(timeout_s=60.0)}
+
+    driver = serve.composed(ensemble, deployments={"a": m1, "b": m2},
+                            name="ens")
+    handle = serve.run_graph(driver)
+    out = handle.remote(5).result(timeout_s=120.0)
+    assert out == {"sum": 10 + 105}
