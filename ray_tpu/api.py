@@ -442,6 +442,8 @@ def available_resources() -> Dict[str, float]:
 
 
 def timeline() -> List[dict]:
-    """Chrome-trace events (reference: ray.timeline / chrome_tracing_dump)."""
+    """Chrome-trace events, cluster-wide: driver-local spans + per-node
+    finished-task spans (reference: ray.timeline / chrome_tracing_dump,
+    _private/state.py:414)."""
     from .util import tracing
-    return tracing.chrome_trace_events()
+    return tracing.cluster_trace_events()

@@ -88,6 +88,8 @@ class Nodelet:
         self._primary_pins: set = set()  # store pins on primary copies
         self._running_tasks: Dict[bytes, dict] = {}   # worker_id -> task
         self._task_counts: Dict[str, int] = {}        # fname -> finished
+        from collections import deque as _deque
+        self._task_spans = _deque(maxlen=5000)        # finished-task spans
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._tasks: List[asyncio.Task] = []
         self._next_worker_seq = 0
@@ -101,7 +103,7 @@ class Nodelet:
                      "pull", "fetch_meta", "fetch", "free_local", "pg_prepare",
                      "pg_commit", "pg_abort", "pg_return", "kill_worker_at",
                      "node_info", "stats", "put_location", "ping",
-                     "task_state", "node_stats", "tail_log",
+                     "task_state", "node_stats", "tail_log", "task_spans",
                      "prestart_workers"):
             s.register(name, getattr(self, "_h_" + name))
 
@@ -755,10 +757,24 @@ class Nodelet:
                 if data.get("task_id") else "",
                 "start": time.time()}
         else:
-            self._running_tasks.pop(wid, None)
+            run = self._running_tasks.pop(wid, None)
             name = data.get("name", "?")
             self._task_counts[name] = self._task_counts.get(name, 0) + 1
+            # bounded span log for the cluster timeline (reference: per-task
+            # profile events -> GCS -> ray.timeline chrome dump,
+            # core_worker/profiling.cc + _private/state.py:414)
+            if run is not None:
+                self._task_spans.append({
+                    "name": name, "worker_id": wid.hex(),
+                    "task_id": run.get("task_id", ""),
+                    "start": run["start"], "end": time.time()})
         return True
+
+    async def _h_task_spans(self, conn, data):
+        spans = list(self._task_spans)
+        if data.get("clear"):
+            self._task_spans.clear()
+        return spans
 
     async def _h_node_stats(self, conn, data):
         """Per-node deep stats (reference: dashboard/agent.py reporter +

@@ -44,6 +44,30 @@ def chrome_trace_events() -> List[dict]:
         return list(_events)
 
 
+def cluster_trace_events() -> List[dict]:
+    """Driver-local spans PLUS every node's finished-task spans (the
+    reference's profile-event aggregation: core_worker/profiling.cc ->
+    GCS -> `ray.timeline` chrome dump, _private/state.py:414)."""
+    events = chrome_trace_events()
+    try:
+        from .. import state
+        for n in state.list_nodes():
+            if not n.get("alive"):
+                continue
+            for sp in state._node_call(n["addr"], "task_spans"):
+                events.append({
+                    "name": sp["name"], "cat": "task", "ph": "X",
+                    "ts": sp["start"] * 1e6,
+                    "dur": max(0.0, (sp["end"] - sp["start"])) * 1e6,
+                    "pid": "node:" + n["id"][:8],
+                    "tid": "worker:" + sp["worker_id"][:8],
+                    "args": {"task_id": sp.get("task_id", "")},
+                })
+    except Exception:
+        pass  # not connected / nodes unreachable: driver-local only
+    return events
+
+
 def dump_chrome_trace(path: str):
     with open(path, "w") as f:
         json.dump({"traceEvents": chrome_trace_events()}, f)
