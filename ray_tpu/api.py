@@ -91,6 +91,12 @@ def shutdown():
     with _init_lock:
         core = get_global_core()
         if core is not None:
+            try:
+                from . import usage
+                usage.maybe_write_report(core.session_dir)
+            except Exception:
+                pass
+        if core is not None:
             core.shutdown()
             set_global_core(None)
         if _local_cluster is not None:

@@ -122,6 +122,9 @@ _d("actor_creation_timeout_s", float, 300.0,
    "How long method calls wait for a PENDING/RESTARTING actor to come up.")
 _d("rpc_connect_retries", int, 60, "TCP connect retries (20ms backoff) at bootstrap.")
 _d("pull_retry_interval_s", float, 0.5, "Retry period for remote object pulls.")
+_d("usage_stats_enabled", bool, False,
+   "Write a local JSON usage report under the session dir at shutdown "
+   "(never leaves the machine; reference: _private/usage/usage_lib.py).")
 _d("memory_monitor_interval_s", float, 1.0,
    "Node memory-pressure check period; 0 disables the monitor "
    "(reference: memory_monitor_refresh_ms).")

@@ -1,0 +1,61 @@
+"""Usage stats: local, opt-in session usage reports.
+
+Capability mirror of the reference's usage-stats subsystem
+(/root/reference/python/ray/_private/usage/usage_lib.py + the dashboard
+usage module) with the telemetry inverted for this environment: nothing
+ever leaves the machine — when ``usage_stats_enabled`` is on, a JSON
+usage report (cluster shape, feature-use counters, task/actor volumes)
+is written under the session dir at shutdown for the operator's own
+fleet accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict
+
+_feature_uses: Dict[str, int] = {}
+
+
+def record_feature(name: str) -> None:
+    """Libraries call this on first use (train/tune/serve/data/rl/...)."""
+    _feature_uses[name] = _feature_uses.get(name, 0) + 1
+
+
+def collect() -> Dict[str, Any]:
+    from . import state
+    from .core.config import GlobalConfig
+    report: Dict[str, Any] = {
+        "ts": time.time(),
+        "version": __import__("ray_tpu").__version__,
+        "features": dict(_feature_uses),
+    }
+    try:
+        report["cluster"] = state.cluster_summary()
+        report["nodes"] = [
+            {"resources": n.get("total"), "alive": n.get("alive")}
+            for n in state.list_nodes()]
+    except Exception:
+        pass
+    report["config_overrides"] = {
+        k: v for k, v in GlobalConfig.snapshot().items()
+        if os.environ.get(f"RAY_TPU_{k.upper()}") is not None}
+    return report
+
+
+def write_report(session_dir: str) -> str:
+    path = os.path.join(session_dir, "usage_report.json")
+    with open(path, "w") as f:
+        json.dump(collect(), f, indent=2, default=str)
+    return path
+
+
+def maybe_write_report(session_dir: str) -> None:
+    from .core.config import GlobalConfig
+    if getattr(GlobalConfig, "usage_stats_enabled", False):
+        try:
+            write_report(session_dir)
+        except Exception:
+            pass
