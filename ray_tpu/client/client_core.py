@@ -153,9 +153,16 @@ class ClientCore:
     def submit_task(self, spec: TaskSpec,
                     temp_refs: Optional[List[ObjectRef]] = None
                     ) -> List[ObjectRef]:
-        self._srv.call("client_submit_task", {"spec": spec.to_wire()},
-                       timeout=60)
-        del temp_refs  # server-side core holds the arg pins
+        # Refs nested inside inline args (and client-side spilled args) are
+        # pinned SERVER-side for the task's duration: ship their ids so the
+        # server core takes the same _extra_pins_map holds the local path
+        # takes — the client's own temp handles may be GC'd before the
+        # task even dequeues.
+        self._srv.call("client_submit_task", {
+            "spec": spec.to_wire(),
+            "hold_refs": [r.binary() for r in (temp_refs or [])]},
+            timeout=60)
+        del temp_refs
         return [ObjectRef(oid, self) for oid in spec.return_ids()]
 
     # ------------------------------------------------------------- actor ops
@@ -177,7 +184,9 @@ class ClientCore:
                           ) -> List[ObjectRef]:
         self._srv.call("client_submit_actor_task", {
             "actor_id": actor_id, "spec": spec.to_wire(),
-            "max_task_retries": max_task_retries}, timeout=60)
+            "max_task_retries": max_task_retries,
+            "hold_refs": [r.binary() for r in (temp_refs or [])]},
+            timeout=60)
         del temp_refs
         return [ObjectRef(oid, self) for oid in spec.return_ids()]
 

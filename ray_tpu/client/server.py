@@ -121,7 +121,9 @@ class ClientServer:
 
     def _h_submit_task(self, conn, data):
         spec = TaskSpec.from_wire(data["spec"])
-        for ref in self.core.submit_task(spec):
+        holds = [ObjectRef(ObjectID(b), self.core)
+                 for b in data.get("hold_refs", [])]
+        for ref in self.core.submit_task(spec, temp_refs=holds):
             self._hold(conn, ref)
         return True
 
@@ -139,9 +141,11 @@ class ClientServer:
     def _h_submit_actor_task(self, conn, data):
         spec = TaskSpec.from_wire(data["spec"])
         self.core.attach_actor(data["actor_id"], spec.function_name)
+        holds = [ObjectRef(ObjectID(b), self.core)
+                 for b in data.get("hold_refs", [])]
         for ref in self.core.submit_actor_task(
                 data["actor_id"], spec,
-                data.get("max_task_retries", 0)):
+                data.get("max_task_retries", 0), temp_refs=holds):
             self._hold(conn, ref)
         return True
 

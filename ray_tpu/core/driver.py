@@ -313,15 +313,30 @@ class CoreClient:
         for oid in dict.fromkeys(oids):
             if self.memory_store.peek(oid) is None and self.store.contains(oid):
                 self.memory_store.put_in_plasma_marker(oid)
-        entries = self.memory_store.get(oids, timeout)
-        if entries is None:
+        # Wait in bounded slices so the cluster-wide revive lookup also runs
+        # for timeout=None gets — a revived ref living on ANOTHER node has
+        # no local entry and nothing will ever re-put one.  Only refs this
+        # process does NOT own can need revival (owned returns/puts are
+        # fulfilled by task replies / put markers), so the periodic RPC
+        # check is bounded to the borrowed subset.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        entries = self.memory_store.get(oids, min(timeout or 5.0, 5.0))
+        while entries is None:
             revived = False
-            for oid in dict.fromkeys(oids):
+            with self._ref_lock:
+                borrowed = [o for o in dict.fromkeys(oids)
+                            if o not in self._owned]
+            for oid in borrowed:
                 if self.memory_store.peek(oid) is None \
                         and self._object_available(oid):
                     self.memory_store.put_in_plasma_marker(oid)
                     revived = True
-            entries = self.memory_store.get(oids, 5.0) if revived else None
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0 and not revived:
+                break
+            step = 5.0 if remaining is None else max(0.1, min(remaining, 5.0))
+            entries = self.memory_store.get(oids, step)
         if entries is None:
             raise exceptions.GetTimeoutError(
                 f"get() timed out waiting for {len(oids)} objects")

@@ -54,10 +54,13 @@ def run(target: Deployment, *, name: Optional[str] = None,
         raise TypeError("serve.run expects a Deployment "
                         "(@serve.deployment-decorated)")
     dep_name = name or dep.name
-    prefix = dep.route_prefix if route_prefix == "__derive__" \
-        else route_prefix
-    if prefix is None:
-        prefix = f"/{dep_name}"
+    # route_prefix semantics (reference serve.run): "__derive__" → the
+    # deployment's own prefix or /<name>; an EXPLICIT None → no HTTP route
+    # (internal deployments, e.g. graph upstreams, stay handle-only).
+    if route_prefix == "__derive__":
+        prefix = dep.route_prefix or f"/{dep_name}"
+    else:
+        prefix = route_prefix
     cfg = {
         "num_replicas": dep.config.num_replicas,
         "max_concurrent_queries": dep.config.max_concurrent_queries,

@@ -152,9 +152,18 @@ class GangReplicaWorker:
         """Follower side of one request: run the same computation, strictly
         in leader-assigned sequence order (concurrent actor threads would
         otherwise race into the collectives out of order)."""
+        import time as _time
+        deadline = _time.monotonic() + 600.0
         with self._seq_cv:
             while seq != self._next_seq:
-                self._seq_cv.wait(timeout=300.0)
+                if _time.monotonic() > deadline:
+                    # a gap in the sequence (leader failed mid-fan-out):
+                    # fail loudly instead of wedging this thread forever
+                    raise RuntimeError(
+                        f"gang member {self.rank} stuck waiting for seq "
+                        f"{self._next_seq} (got {seq}); leader fan-out "
+                        "gap — replica needs replacement")
+                self._seq_cv.wait(timeout=30.0)
         try:
             self._execute(args, kwargs, method)
         finally:

@@ -97,5 +97,5 @@ def run_graph(driver: Deployment, *, route_prefix: Optional[str] = None):
     endpoint).  Returns the driver's handle."""
     from . import api as serve_api
     for up in getattr(driver, "_upstreams", []):
-        serve_api.run(up, route_prefix=None)
+        serve_api.run(up, route_prefix=None)  # handle-only: no HTTP route
     return serve_api.run(driver, route_prefix=route_prefix or "__derive__")

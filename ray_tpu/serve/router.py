@@ -53,7 +53,9 @@ class Router:
         self._refresh()
         best = None
         for name, entry in self._table.items():
-            prefix = entry.get("route_prefix") or f"/{name}"
+            prefix = entry.get("route_prefix")
+            if prefix is None:
+                continue  # handle-only deployment: no HTTP route
             if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
                 if best is None or len(prefix) > len(best[1]):
                     best = (name, prefix)

@@ -61,16 +61,29 @@ def cluster_summary() -> Dict[str, Any]:
 # -------------------------------------------------- per-node deep state
 def _node_call(addr: str, method: str, data: Optional[dict] = None,
                timeout: float = 10.0):
-    """One short-lived RPC to a nodelet (the aggregator role of the
-    reference's dashboard/state_aggregator.py querying per-node agents)."""
+    """One RPC to a nodelet (the aggregator role of the reference's
+    dashboard/state_aggregator.py querying per-node agents).  Connections
+    are pooled on the core (dashboards poll every couple of seconds — no
+    per-poll connect/teardown churn); a dead conn is dropped and redialed
+    once."""
     from .core import rpc as rpc_mod
     core = _ensure_initialized()
+    pool = getattr(core, "_state_conns", None)
+    if pool is None:
+        pool = core._state_conns = {}
     host, port = addr.rsplit(":", 1)
-    conn = core.lt.run(rpc_mod.connect(host, int(port), retries=3))
-    try:
-        return core.lt.run(conn.call(method, data or {}, timeout=timeout))
-    finally:
-        core.lt.run(conn.close())
+    for attempt in (0, 1):
+        conn = pool.get(addr)
+        if conn is None or conn.closed:
+            conn = core.lt.run(rpc_mod.connect(host, int(port), retries=3))
+            pool[addr] = conn
+        try:
+            return core.lt.run(conn.call(method, data or {},
+                                         timeout=timeout))
+        except (rpc_mod.RpcError, OSError):
+            pool.pop(addr, None)
+            if attempt:
+                raise
 
 
 def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
