@@ -141,6 +141,22 @@ _d("max_concurrent_pulls", int, 4,
    "pulls against available memory).")
 _d("inline_small_args_bytes", int, 64 * 1024,
    "Task args at or below this size are inlined into the task spec.")
+_d("spill_storage_uri", str, "",
+   "External spill storage: '' = session spill dir (filesystem); "
+   "file:///path = explicit filesystem root; any other scheme (s3://, "
+   "gs://) = smart_open-backed bucket shared by all hosts (reference: "
+   "external_storage.py pluggable backends).")
+_d("spill_threshold_frac", float, 0.80,
+   "Store usage fraction above which the nodelet proactively spills "
+   "pinned primary copies to external storage (reference: raylet "
+   "LocalObjectManager spilling under memory pressure).")
+_d("spill_low_water_frac", float, 0.60,
+   "Proactive spilling stops once store usage drops below this fraction.")
+_d("spill_min_object_bytes", int, 32 * 1024,
+   "Primary copies smaller than this are never proactively spilled "
+   "(reference: min_spilling_size batches small objects instead).")
+_d("spill_check_interval_s", float, 0.5,
+   "Nodelet store-pressure check period; 0 disables proactive spilling.")
 _d("log_to_driver", bool, True, "Forward worker stdout/stderr lines to the driver.")
 _d("metrics_report_interval_s", float, 2.0, "Worker metric push period.")
 _d("lineage_cache_size", int, 100000,

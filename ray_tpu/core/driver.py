@@ -159,13 +159,14 @@ class CoreClient:
         self._nodelet_conns: Dict[str, rpc.Connection] = {}
         self._closed = False
         self._lineage: "OrderedDict[bytes, TaskSpec]" = OrderedDict()
-        self._put_pins: set = set()  # owner pins of put() primary copies
         self._spilled_paths: Dict[bytes, str] = {}
         self._containers: set = set()  # owned oids with contained-ref pins
         self._borrow_epoch = 0         # ref_incs issued (see sync_borrows)
         self._borrow_synced = 0
         self._extra_pins_map: Dict[bytes, List[bytes]] = {}  # in-flight nested pins
         self._value_finalizers: list = []  # detached at shutdown (segfault guard)
+        self._state_conns: Dict[str, rpc.Connection] = {}  # state.py pool
+        self._state_conns_lock = threading.Lock()
         if mode == "driver":
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
@@ -230,14 +231,6 @@ class CoreClient:
             contained = oid in self._containers
             self._containers.discard(oid)
         self.memory_store.delete([oid])
-        with self._ref_lock:
-            put_pinned = oid in self._put_pins
-            self._put_pins.discard(oid)
-        if put_pinned:
-            try:
-                self.store.release(oid)
-            except Exception:
-                pass
         # NB: the shared-memory pin (self._pinned) is NOT dropped here — it is
         # tied to the lifetime of the deserialized value (weakref finalizer in
         # _get_plasma), because zero-copy numpy views alias store memory.
@@ -279,14 +272,19 @@ class CoreClient:
         else:
             try:
                 self.store.put_parts(oid.binary(), parts)
-                # pin the primary copy so LRU eviction can't drop an owned
-                # object (reference: raylet pins primary copies; spilling,
-                # not eviction, reclaims them)
-                if self.store.get(oid.binary(), timeout_ms=0) is not None:
-                    with self._ref_lock:
-                        self._put_pins.add(oid.binary())
-                self.nodelet.call("put_location",
-                                  {"object_id": oid.binary(), "size": size})
+                # Bridge pin: hold a get-pin only until the nodelet takes
+                # its primary pin (put_location reply), closing the LRU
+                # race without double-pinning — the nodelet must stay the
+                # SOLE durable pinner so its spill loop can reclaim the
+                # segment bytes (reference: the raylet, not the client,
+                # pins primary copies; spilling reclaims them).
+                bridge = self.store.get(oid.binary(), timeout_ms=0) is not None
+                try:
+                    self.nodelet.call("put_location",
+                                      {"object_id": oid.binary(), "size": size})
+                finally:
+                    if bridge:
+                        self.store.release(oid.binary())
                 with self._ref_lock:
                     self._plasma_oids.add(oid.binary())
             except store_client.StoreFullError:
@@ -320,7 +318,9 @@ class CoreClient:
         # fulfilled by task replies / put markers), so the periodic RPC
         # check is bounded to the borrowed subset.
         deadline = None if timeout is None else time.monotonic() + timeout
-        entries = self.memory_store.get(oids, min(timeout or 5.0, 5.0))
+        # timeout=0 must stay a non-blocking poll (0 is falsy: no `or`)
+        first_slice = 5.0 if timeout is None else min(timeout, 5.0)
+        entries = self.memory_store.get(oids, first_slice)
         while entries is None:
             revived = False
             with self._ref_lock:

@@ -1,0 +1,175 @@
+"""Pluggable external storage for spilled objects.
+
+Capability mirror of the reference's `ExternalStorage` hierarchy
+(/root/reference/python/ray/_private/external_storage.py:72 ABC, :246
+filesystem, :368 smart_open/S3, :445 ray-storage): spilled objects are
+written to a storage backend addressed by URL, and any process that can
+reach the backend can restore them.  The backend is selected once per
+session from the ``spill_storage_uri`` config flag:
+
+- ``""`` (default) → filesystem under the session spill directory.
+  Single machine and shared-fs clusters restore from any node.
+- ``file:///path`` → filesystem rooted at an explicit path.
+- any other scheme (``s3://…``, ``gs://…``) → smart_open-backed storage,
+  gated on the ``smart_open`` package being importable.  This is the
+  multi-host story: a bucket every TPU host can reach, so restore never
+  depends on which host spilled.
+
+URLs are plain strings stored in the controller KV (namespace ``spill``);
+the filesystem backend uses bare paths so round-1 KV entries stay
+readable.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+
+class ExternalStorage(ABC):
+    """One spilled object per URL; values are the serialized byte stream."""
+
+    @abstractmethod
+    def spill(self, oid: bytes, parts: List[memoryview]) -> str:
+        """Write serialized parts; returns the restore URL."""
+
+    @abstractmethod
+    def restore(self, url: str) -> Optional[bytes]:
+        """Read back the serialized bytes, or None if absent."""
+
+    @abstractmethod
+    def delete(self, url: str) -> None:
+        """Best-effort removal of a spilled object."""
+
+
+class FilesystemStorage(ExternalStorage):
+    """Default backend: one file per object under a root directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def spill(self, oid: bytes, parts: List[memoryview]) -> str:
+        path = os.path.join(self.root, oid.hex())
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for p in parts:
+                f.write(bytes(p))
+        os.replace(tmp, path)
+        return path
+
+    def restore(self, url: str) -> Optional[bytes]:
+        path = url[len("file://"):] if url.startswith("file://") else url
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except (FileNotFoundError, IsADirectoryError):
+            return None
+
+    def delete(self, url: str) -> None:
+        path = url[len("file://"):] if url.startswith("file://") else url
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+class SmartOpenStorage(ExternalStorage):
+    """Cloud-bucket backend over ``smart_open`` (s3://, gs://, …).
+
+    Mirrors the reference's ExternalStorageSmartOpenImpl
+    (external_storage.py:368).  Import is gated: constructing this backend
+    without the package raises immediately with a clear message instead of
+    failing at first spill.
+    """
+
+    def __init__(self, uri_prefix: str):
+        try:
+            from smart_open import open as smart_open  # type: ignore
+        except ImportError as e:  # pragma: no cover - package not in image
+            raise RuntimeError(
+                "spill_storage_uri=%r needs the smart_open package" %
+                uri_prefix) from e
+        self._open = smart_open
+        self.prefix = uri_prefix.rstrip("/")
+
+    def spill(self, oid: bytes, parts: List[memoryview]) -> str:
+        url = f"{self.prefix}/{oid.hex()}"
+        with self._open(url, "wb") as f:
+            for p in parts:
+                f.write(bytes(p))
+        return url
+
+    def restore(self, url: str) -> Optional[bytes]:
+        try:
+            with self._open(url, "rb") as f:
+                return f.read()
+        except Exception:
+            return None
+
+    def delete(self, url: str) -> None:
+        """Scheme-dispatched removal: s3 via boto3, gs via google-cloud
+        or gcsfs, file via unlink.  Falls back to a once-per-scheme
+        warning instead of silently leaking bucket objects forever."""
+        try:
+            import smart_open  # type: ignore
+            parsed = smart_open.parse_uri(url)
+            scheme = parsed.scheme
+            if scheme == "file":
+                os.unlink(parsed.uri_path)
+                return
+            if scheme in ("s3", "s3a", "s3n"):
+                import boto3  # type: ignore
+                boto3.client("s3").delete_object(
+                    Bucket=parsed.bucket_id, Key=parsed.key_id)
+                return
+            if scheme in ("gs", "gcs"):
+                import gcsfs  # type: ignore
+                gcsfs.GCSFileSystem().rm(url)
+                return
+            raise NotImplementedError(scheme)
+        except Exception:
+            scheme = url.split("://", 1)[0]
+            if scheme not in self._warned_schemes:
+                self._warned_schemes.add(scheme)
+                import sys
+                print(f"ray_tpu: cannot delete spilled object {url!r} "
+                      f"(no delete client for scheme {scheme!r}); spilled "
+                      "objects will accumulate in external storage",
+                      file=sys.stderr)
+
+    _warned_schemes: set = set()
+
+
+def default_spill_root() -> str:
+    base = os.environ.get("RAY_TPU_SESSION_DIR") or tempfile.gettempdir()
+    return os.path.join(base, "spill")
+
+
+_storage: Optional[ExternalStorage] = None
+_storage_uri: Optional[str] = None
+
+
+def get_storage() -> ExternalStorage:
+    """Session singleton resolved from the ``spill_storage_uri`` flag."""
+    global _storage, _storage_uri
+    from .config import GlobalConfig
+    uri = getattr(GlobalConfig, "spill_storage_uri", "")
+    if _storage is None or uri != _storage_uri:
+        if not uri:
+            _storage = FilesystemStorage(default_spill_root())
+        elif uri.startswith("file://"):
+            _storage = FilesystemStorage(uri[len("file://"):])
+        else:
+            _storage = SmartOpenStorage(uri)
+        _storage_uri = uri
+    return _storage
+
+
+def reset_storage() -> None:
+    """Drop the cached backend (tests / config reload)."""
+    global _storage, _storage_uri
+    _storage = None
+    _storage_uri = None

@@ -20,9 +20,10 @@ import os
 import subprocess
 import sys
 import time
+import traceback
 from typing import Any, Dict, List, Optional, Set
 
-from . import rpc
+from . import rpc, spill
 from .config import GlobalConfig
 from .ids import NodeID, WorkerID
 from .object_store import client as store_client
@@ -85,7 +86,11 @@ class Nodelet:
         self._lease_waiters = 0
         self._pull_locks: Dict[bytes, asyncio.Lock] = {}
         self._pull_sem = asyncio.Semaphore(GlobalConfig.max_concurrent_pulls)
-        self._primary_pins: set = set()  # store pins on primary copies
+        # Store pins on primary copies, oid -> size (dict also gives
+        # insertion order so proactive spilling walks oldest-first).
+        self._primary_pins: Dict[bytes, int] = {}
+        self._spilling: Set[bytes] = set()          # oids mid-spill
+        self._spill_tombstones: Set[bytes] = set()  # freed while mid-spill
         self._running_tasks: Dict[bytes, dict] = {}   # worker_id -> task
         self._task_counts: Dict[str, int] = {}        # fname -> finished
         from collections import deque as _deque
@@ -129,6 +134,8 @@ class Nodelet:
         if GlobalConfig.memory_monitor_interval_s > 0:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
+        if GlobalConfig.spill_check_interval_s > 0:
+            self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         self._lag_ewma = 0.0
         self._lag_max = 0.0
         self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
@@ -327,6 +334,86 @@ class Nodelet:
                     pass
             except Exception:
                 pass  # the monitor must never die
+
+    async def _spill_loop(self):
+        """Proactive spilling under store pressure (reference:
+        `src/ray/raylet/local_object_manager.cc` SpillObjectsOfSize — the
+        raylet, not the writer, decides when pinned primaries move to
+        external storage).  Above the high-water mark, pinned primary
+        copies spill oldest-first to the configured backend
+        (external_storage.py) until usage drops below the low-water mark;
+        the store copy is then deleted so new creates stop hitting
+        StoreFullError.  Restore stays transparent: readers fall back to
+        the spill KV entry exactly as for writer-inline spills."""
+        while True:
+            await asyncio.sleep(GlobalConfig.spill_check_interval_s)
+            try:
+                st = self.store.stats()
+                cap = st["capacity_bytes"] or 1
+                if st["used_bytes"] / cap < GlobalConfig.spill_threshold_frac:
+                    continue
+                min_bytes = GlobalConfig.spill_min_object_bytes
+                for oid, size in list(self._primary_pins.items()):
+                    if 0 < size < min_bytes:
+                        continue  # known-small: skip without touching the store
+                    if (self.store.stats()["used_bytes"] / cap
+                            < GlobalConfig.spill_low_water_frac):
+                        break
+                    await self._spill_one(oid)
+            except Exception:
+                # pressure relief must never die, but must not fail silently
+                traceback.print_exc(file=sys.stderr)
+
+    async def _spill_one(self, oid: bytes) -> bool:
+        """Spill one pinned primary copy; returns True if store space was
+        reclaimed."""
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            self._primary_pins.pop(oid, None)
+            return False
+        self._spilling.add(oid)
+        try:
+            return await self._spill_locked(oid, view)
+        finally:
+            self._spilling.discard(oid)
+            self._spill_tombstones.discard(oid)
+
+    async def _spill_locked(self, oid: bytes, view) -> bool:
+        try:
+            if len(view) < GlobalConfig.spill_min_object_bytes:
+                return False
+            url = await asyncio.to_thread(spill.write_object, oid, [view])
+        finally:
+            del view
+            self.store.release(oid)
+        # The write awaited: _h_free_local may have freed this object
+        # meanwhile — and the controller's spill-ns sweep for it already
+        # ran, so registering now would leak the KV entry and the file
+        # forever.  _h_free_local leaves a tombstone for oids mid-spill
+        # (self._spilling); check it after EVERY await below and undo.
+        if oid in self._spill_tombstones or oid not in self._primary_pins:
+            self._spill_tombstones.discard(oid)
+            await asyncio.to_thread(spill.delete_file, url)
+            return False
+        self._spilled_objects = getattr(self, "_spilled_objects", 0) + 1
+        await self.controller.call("kv_put", {
+            **spill.kv_entry(oid), "value": url.encode()})
+        await self.controller.call("object_location_remove", {
+            "object_id": oid, "node_id": self.node_id.hex()})
+        if oid in self._spill_tombstones:
+            # freed between our registration and now: the sweep missed the
+            # fresh KV entry — clean up both ourselves.
+            self._spill_tombstones.discard(oid)
+            await self.controller.call("kv_del", spill.kv_entry(oid))
+            await asyncio.to_thread(spill.delete_file, url)
+            return False
+        if self._primary_pins.pop(oid, None) is not None:
+            self.store.release(oid)  # drop the primary pin
+        try:
+            self.store.delete(oid)
+        except store_client.StoreError:
+            pass
+        return True
 
     # ------------------------------------------------------------ worker pool
     def _spawn_worker(self) -> WorkerProc:
@@ -573,7 +660,9 @@ class Nodelet:
         # local_object_manager.cc; eviction only reclaims replicas).
         if data.get("primary", True) and oid not in self._primary_pins:
             if self.store.get(oid, timeout_ms=0) is not None:
-                self._primary_pins.add(oid)  # hold the get-pin, drop the view
+                # hold the get-pin, drop the view; remember the size so the
+                # spill loop can pick victims without touching the store
+                self._primary_pins[oid] = int(data.get("size", 0))
         await self.controller.call("object_location_add", {
             "object_id": oid, "node_id": self.node_id.hex(),
             "size": data.get("size", 0)})
@@ -721,8 +810,11 @@ class Nodelet:
 
     async def _h_free_local(self, conn, data):
         for oid in data["object_ids"]:
-            if oid in self._primary_pins:
-                self._primary_pins.discard(oid)
+            if oid in self._spilling:
+                # mid-spill: the spiller must not register a KV entry the
+                # controller's sweep has already passed (leaked file)
+                self._spill_tombstones.add(oid)
+            if self._primary_pins.pop(oid, None) is not None:
                 self.store.release(oid)
             try:
                 self.store.delete(oid)

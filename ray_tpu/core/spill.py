@@ -1,57 +1,50 @@
 """Object spilling to external storage.
 
 Capability mirror of the reference's spill pipeline (plasma → dedicated
-spill workers → `ExternalStorage` filesystem backend,
-`python/ray/_private/external_storage.py:72,246`; orchestrated by
-`src/ray/raylet/local_object_manager.cc`).  Simplified topology: the
-process that hits `StoreFullError` writes the serialized object to the
-session spill directory itself and registers the location in the
-controller KV, so any node can restore it (shared-fs or single-machine
-sessions; a remote-read RPC slots in for multi-host without changing
-callers).
+spill workers → `ExternalStorage` backends,
+`python/ray/_private/external_storage.py:72,246,368`; orchestrated by
+`src/ray/raylet/local_object_manager.cc`).  Two triggers feed it:
+
+1. **Writer-inline** — a put that hits `StoreFullError` spills its own
+   serialized stream (driver.py put path), so creates never fail while
+   external storage has room.
+2. **Nodelet-orchestrated** — the nodelet's spill loop watches store
+   usage and proactively spills pinned primary copies above the
+   high-water mark (nodelet.py `_spill_loop`), the role the reference's
+   raylet `LocalObjectManager::SpillObjects` plays.
+
+Either way the restore URL is registered in the controller KV
+(namespace ``spill``), so any process whose storage backend is shared
+(session dir on one machine, bucket URI across hosts) can restore.
+The backend is pluggable via the ``spill_storage_uri`` flag — see
+`external_storage.py`.
 """
 
 from __future__ import annotations
 
-import os
-import tempfile
 from typing import List, Optional
+
+from . import external_storage
 
 _NS = "spill"
 
 
 def spill_root() -> str:
-    base = os.environ.get("RAY_TPU_SESSION_DIR") or tempfile.gettempdir()
-    path = os.path.join(base, "spill")
-    os.makedirs(path, exist_ok=True)
-    return path
+    return external_storage.default_spill_root()
 
 
 def write_object(oid: bytes, parts: List[memoryview]) -> str:
-    """Write serialized parts to a spill file; returns the path."""
-    path = os.path.join(spill_root(), oid.hex())
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        for p in parts:
-            f.write(bytes(p))
-    os.replace(tmp, path)
-    return path
+    """Spill serialized parts to the configured backend; returns the URL."""
+    return external_storage.get_storage().spill(oid, parts)
 
 
 def kv_entry(oid: bytes) -> dict:
     return {"ns": _NS, "key": oid}
 
 
-def read_file(path: str) -> Optional[bytes]:
-    try:
-        with open(path, "rb") as f:
-            return f.read()
-    except FileNotFoundError:
-        return None
+def read_file(url: str) -> Optional[bytes]:
+    return external_storage.get_storage().restore(url)
 
 
-def delete_file(path: str) -> None:
-    try:
-        os.unlink(path)
-    except OSError:
-        pass
+def delete_file(url: str) -> None:
+    external_storage.get_storage().delete(url)

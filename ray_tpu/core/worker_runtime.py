@@ -182,8 +182,17 @@ class WorkerRuntime:
             else:
                 try:
                     self.store.put_parts(oid, parts)
-                    await self.nodelet.call("put_location",
-                                            {"object_id": oid, "size": size})
+                    # Bridge pin until the nodelet takes its primary pin —
+                    # same LRU-race close as the driver put path: under
+                    # store pressure an unpinned return value could be
+                    # evicted before put_location pins it.
+                    bridge = self.store.get(oid, timeout_ms=0) is not None
+                    try:
+                        await self.nodelet.call(
+                            "put_location", {"object_id": oid, "size": size})
+                    finally:
+                        if bridge:
+                            self.store.release(oid)
                 except store_client.StoreFullError:
                     from . import spill
                     path = spill.write_object(oid, parts)

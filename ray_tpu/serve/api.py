@@ -61,6 +61,11 @@ def run(target: Deployment, *, name: Optional[str] = None,
         prefix = dep.route_prefix or f"/{dep_name}"
     else:
         prefix = route_prefix
+    if prefix is not None and not prefix.startswith("/"):
+        # an empty/relative prefix would prefix-match every request path
+        raise ValueError(
+            f"route_prefix must start with '/' (got {prefix!r}); "
+            "use route_prefix=None for a handle-only deployment")
     cfg = {
         "num_replicas": dep.config.num_replicas,
         "max_concurrent_queries": dep.config.max_concurrent_queries,

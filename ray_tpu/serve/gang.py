@@ -101,6 +101,7 @@ class GangReplicaWorker:
         self._exec_lock = threading.Lock()
         self._seq = 0
         self._next_seq = 0
+        self._num_executing = 0
         self._seq_cv = threading.Condition()
         if user_config is not None:
             self.reconfigure(user_config)
@@ -153,9 +154,19 @@ class GangReplicaWorker:
         in leader-assigned sequence order (concurrent actor threads would
         otherwise race into the collectives out of order)."""
         import time as _time
-        deadline = _time.monotonic() + 600.0
         with self._seq_cv:
+            # The deadline bounds *stall*, not total wait: it resets while
+            # _next_seq advances AND while an earlier request of this gang
+            # member is still executing (a single long request — compile,
+            # long-context generation — is progress, not a gap).  Only a
+            # true fan-out gap (nothing running, nothing advancing for the
+            # full window) trips it.
+            deadline = _time.monotonic() + 600.0
+            last_seen = self._next_seq
             while seq != self._next_seq:
+                if self._next_seq != last_seen or self._num_executing > 0:
+                    last_seen = self._next_seq
+                    deadline = _time.monotonic() + 600.0
                 if _time.monotonic() > deadline:
                     # a gap in the sequence (leader failed mid-fan-out):
                     # fail loudly instead of wedging this thread forever
@@ -164,10 +175,12 @@ class GangReplicaWorker:
                         f"{self._next_seq} (got {seq}); leader fan-out "
                         "gap — replica needs replacement")
                 self._seq_cv.wait(timeout=30.0)
+            self._num_executing += 1
         try:
             self._execute(args, kwargs, method)
         finally:
             with self._seq_cv:
+                self._num_executing -= 1
                 self._next_seq = seq + 1
                 self._seq_cv.notify_all()
         return True

@@ -68,20 +68,34 @@ def _node_call(addr: str, method: str, data: Optional[dict] = None,
     once."""
     from .core import rpc as rpc_mod
     core = _ensure_initialized()
-    pool = getattr(core, "_state_conns", None)
-    if pool is None:
-        pool = core._state_conns = {}
+    lock = core._state_conns_lock
+    pool = core._state_conns
     host, port = addr.rsplit(":", 1)
     for attempt in (0, 1):
-        conn = pool.get(addr)
+        with lock:
+            conn = pool.get(addr)
         if conn is None or conn.closed:
             conn = core.lt.run(rpc_mod.connect(host, int(port), retries=3))
-            pool[addr] = conn
+            with lock:
+                stale = pool.get(addr)
+                if stale is not None and stale is not conn \
+                        and not stale.closed:
+                    # lost a dial race: keep the winner, close ours
+                    core.lt.run(conn.close())
+                    conn = stale
+                else:
+                    pool[addr] = conn
         try:
             return core.lt.run(conn.call(method, data or {},
                                          timeout=timeout))
         except (rpc_mod.RpcError, OSError):
-            pool.pop(addr, None)
+            with lock:
+                if pool.get(addr) is conn:
+                    pool.pop(addr, None)
+            try:
+                core.lt.run(conn.close())  # drop the fd, not just the ref
+            except Exception:
+                pass
             if attempt:
                 raise
 

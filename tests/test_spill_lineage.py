@@ -84,3 +84,72 @@ def test_lineage_reconstruction_after_node_death():
         assert out[5] == 5 and out.shape == (1024 * 1024,)
     finally:
         cluster.shutdown()
+
+
+@pytest.fixture
+def _scrub_spill_config():
+    """system_config exports RAY_TPU_* env vars; restore spill defaults."""
+    import os
+    from ray_tpu.core import external_storage
+    from ray_tpu.core.config import GlobalConfig
+    keys = ("spill_threshold_frac", "spill_low_water_frac",
+            "spill_check_interval_s", "spill_min_object_bytes",
+            "spill_storage_uri")
+    saved = {k: getattr(GlobalConfig, k) for k in keys}
+    yield
+    for k, v in saved.items():
+        GlobalConfig.update({k: v}, export_env=False)
+        os.environ.pop(f"RAY_TPU_{k.upper()}", None)
+    external_storage.reset_storage()
+
+
+def test_nodelet_proactive_spill(_scrub_spill_config):
+    """Above the high-water mark the nodelet spills pinned primaries to
+    external storage and reclaims store bytes, while every ref stays
+    gettable (reference: local_object_manager.cc spilling under
+    pressure, test_object_spilling.py)."""
+    import time
+
+    ray_tpu.init(num_cpus=2, object_store_memory=16 * 1024 * 1024,
+                 system_config={"spill_threshold_frac": 0.5,
+                                "spill_low_water_frac": 0.25,
+                                "spill_check_interval_s": 0.1})
+    try:
+        # 12 MiB of pinned primaries in a 16 MiB store: crosses the 50%
+        # high-water mark while every put still fits (no writer spill).
+        refs = [ray_tpu.put(np.full(3 * 1024 * 1024, i, dtype=np.uint8))
+                for i in range(4)]
+        from ray_tpu.api import get_global_core
+        store = get_global_core().store  # same shm segment as the nodelet
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            st = store.stats()
+            if st["used_bytes"] / st["capacity_bytes"] <= 0.5:
+                break
+            time.sleep(0.1)
+        st = store.stats()
+        assert st["used_bytes"] / st["capacity_bytes"] <= 0.5, st
+        # spilled objects restore transparently
+        for i, r in enumerate(refs):
+            out = ray_tpu.get(r, timeout=60.0)
+            assert out[0] == i and out.nbytes == 3 * 1024 * 1024
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_custom_spill_storage_uri(tmp_path, _scrub_spill_config):
+    """spill_storage_uri=file://... routes spills to an explicit root
+    (reference: external_storage.py pluggable backends)."""
+    import os
+
+    root = str(tmp_path / "spillroot")
+    ray_tpu.init(num_cpus=2, object_store_memory=16 * 1024 * 1024,
+                 system_config={"spill_storage_uri": f"file://{root}"})
+    try:
+        refs = [ray_tpu.put(np.full(4 * 1024 * 1024, i, dtype=np.uint8))
+                for i in range(8)]  # 32 MiB > store: writer-inline spills
+        assert os.listdir(root), "no spill files under the configured root"
+        for i, r in enumerate(refs):
+            assert ray_tpu.get(r, timeout=60.0)[0] == i
+    finally:
+        ray_tpu.shutdown()
