@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional
 
+import numpy as np
+
 from ..air.checkpoint import Checkpoint
 
 
@@ -20,6 +22,28 @@ class Algorithm:
         self.config = config
         self.iteration = 0
         self._total_env_steps = 0
+
+    # -- shared episode accounting (host side, cheap) -----------------------
+    def _init_episode_tracking(self, num_envs: int) -> None:
+        self._ep_returns = np.zeros(num_envs)
+        self._ep_done_returns: list = []
+
+    def _track_episodes(self, rewards: np.ndarray, dones: np.ndarray):
+        """Accumulate per-env returns from a [T, B] reward/done trajectory,
+        banking each finished episode's return."""
+        for t in range(rewards.shape[0]):
+            self._ep_returns += rewards[t]
+            finished = dones[t].astype(bool)
+            if finished.any():
+                self._ep_done_returns.extend(
+                    self._ep_returns[finished].tolist())
+                self._ep_returns[finished] = 0.0
+
+    def episode_reward_mean(self) -> float:
+        """Mean return of the last 100 finished episodes (NaN before any)."""
+        if not getattr(self, "_ep_done_returns", None):
+            return float("nan")
+        return float(np.mean(self._ep_done_returns[-100:]))
 
     # -- Trainable protocol -------------------------------------------------
     def train(self) -> Dict[str, Any]:

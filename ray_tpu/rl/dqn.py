@@ -1,0 +1,238 @@
+"""DQN: value-based RL with a device-resident replay buffer.
+
+Capability mirror of the reference's DQN family
+(`rllib/algorithms/dqn/dqn.py` — replay buffer, target network, double-Q,
+epsilon-greedy exploration) — redesigned so one `training_step` compiles
+to ONE XLA program: `lax.scan` collects `rollout_steps` vectorized env
+transitions straight into the on-device circular buffer (replay.py), then
+a second scan runs `num_updates` double-DQN SGD steps on uniform samples,
+with a Polyak-averaged target network.  No host↔device traffic inside an
+iteration — the same design constraint as ppo.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import replay
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import mlp_apply, mlp_init
+
+
+class QNetwork:
+    """MLP state-action value network: obs → Q[action]."""
+
+    def __init__(self, obs_size: int, n_actions: int,
+                 hidden=(64, 64)):
+        self.obs_size = obs_size
+        self.n_actions = n_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key: jax.Array):
+        return mlp_init(key,
+                        (self.obs_size,) + self.hidden + (self.n_actions,))
+
+    def apply(self, params, obs: jnp.ndarray) -> jnp.ndarray:
+        return mlp_apply(params, obs)
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_envs: int = 16
+    rollout_steps: int = 32        # env steps per env per iteration
+    buffer_capacity: int = 50_000
+    batch_size: int = 128
+    num_updates: int = 32          # SGD steps per iteration
+    gamma: float = 0.99
+    lr: float = 1e-3
+    tau: float = 0.01              # Polyak target-average rate
+    double_q: bool = True
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 20_000  # env steps to anneal epsilon over
+    learn_start: int = 1_000       # env steps before updates begin
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN(Algorithm):
+    _config_cls = DQNConfig
+
+    def __init__(self, config: DQNConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("DQNConfig.env required (an env factory)")
+        self.env = cfg.env()
+        if not self.env.discrete:
+            raise ValueError("DQN requires a discrete-action env")
+        self.q = QNetwork(self.env.observation_size, self.env.action_size,
+                          hidden=cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        key, pkey, ekey = jax.random.split(key, 3)
+        self.params = self.q.init(pkey)
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        obs_dim = self.env.observation_size
+        self.buffer = replay.init(cfg.buffer_capacity, {
+            "obs": jnp.zeros((obs_dim,), jnp.float32),
+            "action": jnp.zeros((), jnp.int32),
+            "reward": jnp.zeros((), jnp.float32),
+            "next_obs": jnp.zeros((obs_dim,), jnp.float32),
+            "done": jnp.zeros((), jnp.float32),
+        })
+        self.key = key
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._init_episode_tracking(cfg.num_envs)
+
+    # -- the compiled iteration --------------------------------------------
+    def _make_train_iter(self):
+        cfg = self.config
+        env, q, opt = self.env, self.q, self.optimizer
+        insert_bs = cfg.num_envs  # one buffer insert per scanned env step
+
+        def epsilon(total_steps):
+            frac = jnp.clip(total_steps / cfg.eps_decay_steps, 0.0, 1.0)
+            return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+        def train_iter(params, target_params, opt_state, buffer,
+                       env_states, obs, key, total_steps):
+            eps = epsilon(total_steps)
+
+            def collect(carry, _):
+                buffer, env_states, obs, key = carry
+                key, akey, gkey, skey = jax.random.split(key, 4)
+                qvals = q.apply(params, obs)                  # [B, A]
+                greedy = jnp.argmax(qvals, axis=-1)
+                rand = jax.random.randint(akey, greedy.shape, 0,
+                                          env.action_size)
+                explore = jax.random.uniform(gkey, greedy.shape) < eps
+                action = jnp.where(explore, rand, greedy)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(env.step)(
+                    env_states, action, skeys)
+                buffer = replay.add_batch(buffer, {
+                    "obs": obs.astype(jnp.float32),
+                    "action": action.astype(jnp.int32),
+                    "reward": reward.astype(jnp.float32),
+                    "next_obs": next_obs.astype(jnp.float32),
+                    "done": done.astype(jnp.float32),
+                }, insert_bs)
+                frame = {"reward": reward, "done": done}
+                return (buffer, env_states, next_obs, key), frame
+
+            (buffer, env_states, obs, key), traj = jax.lax.scan(
+                collect, (buffer, env_states, obs, key), None,
+                length=cfg.rollout_steps)
+
+            def td_loss(params, batch):
+                qvals = q.apply(params, batch["obs"])
+                q_sa = jnp.take_along_axis(
+                    qvals, batch["action"][:, None], axis=-1)[:, 0]
+                next_q_target = q.apply(target_params, batch["next_obs"])
+                if cfg.double_q:
+                    # double-DQN: online net selects, target net evaluates
+                    next_a = jnp.argmax(q.apply(params, batch["next_obs"]),
+                                        axis=-1)
+                    next_q = jnp.take_along_axis(
+                        next_q_target, next_a[:, None], axis=-1)[:, 0]
+                else:
+                    next_q = jnp.max(next_q_target, axis=-1)
+                target = batch["reward"] + cfg.gamma * next_q * \
+                    (1.0 - batch["done"])
+                target = jax.lax.stop_gradient(target)
+                return jnp.mean((q_sa - target) ** 2)
+
+            def update(carry, _):
+                params, target_params, opt_state, key = carry
+                batch, key = replay.sample(buffer, key, cfg.batch_size)
+                loss, grads = jax.value_and_grad(td_loss)(params, batch)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                target_params = jax.tree_util.tree_map(
+                    lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+                    target_params, params)
+                return (params, target_params, opt_state, key), loss
+
+            # gate learning until the buffer has learn_start transitions
+            do_learn = buffer["size"] >= cfg.learn_start
+
+            def run_updates(args):
+                params, target_params, opt_state, key = args
+                (params, target_params, opt_state, key), losses = \
+                    jax.lax.scan(update,
+                                 (params, target_params, opt_state, key),
+                                 None, length=cfg.num_updates)
+                return params, target_params, opt_state, key, losses[-1]
+
+            def skip_updates(args):
+                params, target_params, opt_state, key = args
+                return params, target_params, opt_state, key, jnp.zeros(())
+
+            params, target_params, opt_state, key, last_loss = jax.lax.cond(
+                do_learn, run_updates, skip_updates,
+                (params, target_params, opt_state, key))
+            metrics = {"td_loss": last_loss, "epsilon": eps,
+                       "buffer_size": buffer["size"]}
+            return (params, target_params, opt_state, buffer, env_states,
+                    obs, key, metrics, traj["reward"], traj["done"])
+
+        return train_iter
+
+    # -- Trainable interface ------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        (self.params, self.target_params, self.opt_state, self.buffer,
+         self.env_states, self.obs, self.key, metrics, rewards, dones) = \
+            self._train_iter(self.params, self.target_params,
+                             self.opt_state, self.buffer, self.env_states,
+                             self.obs, self.key,
+                             jnp.asarray(self._total_env_steps, jnp.float32))
+        env_steps = cfg.num_envs * cfg.rollout_steps
+        self._track_episodes(np.asarray(rewards), np.asarray(dones))
+        dt = time.perf_counter() - t0
+        out = {k: float(v) for k, v in metrics.items()}
+        out["step_reward_mean"] = float(np.asarray(rewards).mean())
+        out.update({
+            "env_steps_this_iter": env_steps,
+            "env_steps_per_s": env_steps / dt,
+            "episode_reward_mean": self.episode_reward_mean(),
+        })
+        return out
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "target_params": to_np(self.target_params),
+                "iteration": self.iteration,
+                # epsilon anneals on env_steps_total: a restored run must
+                # not restart exploration from eps_start
+                "env_steps_total": self._total_env_steps}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        to_dev = lambda t, w: jax.tree_util.tree_map(  # noqa: E731
+            lambda _, x: jnp.asarray(x), t, w)
+        self.params = to_dev(self.params, state["params"])
+        self.target_params = to_dev(self.target_params,
+                                    state["target_params"])
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("env_steps_total", 0)

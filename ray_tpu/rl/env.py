@@ -24,6 +24,7 @@ class JaxEnv:
     action_size: int          # number of discrete actions, or dim if cont.
     discrete: bool = True
     max_episode_steps: int = 500
+    action_high: float = 1.0  # continuous action bound: actions in ±high
 
     def reset(self, key: jax.Array) -> Tuple[State, jnp.ndarray]:
         raise NotImplementedError
@@ -94,6 +95,7 @@ class Pendulum(JaxEnv):
     action_size = 1
     discrete = False
     max_episode_steps = 200
+    action_high = 2.0         # == max_torque: policies must span it
 
     max_speed = 8.0
     max_torque = 2.0

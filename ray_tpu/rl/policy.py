@@ -18,6 +18,23 @@ import jax.numpy as jnp
 Params = Any
 
 
+def mlp_init(key: jax.Array, sizes: Sequence[int]) -> List[Dict[str, Any]]:
+    """He-initialized dense stack: [{w, b}] per layer (shared by
+    MLPPolicy, DQN's QNetwork, and SAC's actor/critics)."""
+    keys = jax.random.split(key, len(sizes) - 1)
+    return [{"w": jax.random.normal(k, (a, b)) * math.sqrt(2.0 / a),
+             "b": jnp.zeros((b,))}
+            for k, a, b in zip(keys, sizes[:-1], sizes[1:])]
+
+
+def mlp_apply(params: List[Dict[str, Any]], x: jnp.ndarray,
+              activation=jnp.tanh) -> jnp.ndarray:
+    """Apply an mlp_init stack; activation on all but the output layer."""
+    for layer in params[:-1]:
+        x = activation(x @ layer["w"] + layer["b"])
+    return x @ params[-1]["w"] + params[-1]["b"]
+
+
 class MLPPolicy:
     def __init__(self, obs_size: int, action_size: int, *,
                  discrete: bool = True,
@@ -31,14 +48,9 @@ class MLPPolicy:
     def init(self, key: jax.Array) -> Params:
         sizes = (self.obs_size,) + self.hidden
         n_out = self.action_size if self.discrete else 2 * self.action_size
-        keys = jax.random.split(key, len(sizes) + 2)
-        layers = []
-        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
-            layers.append({
-                "w": jax.random.normal(keys[i], (a, b)) * math.sqrt(2.0 / a),
-                "b": jnp.zeros((b,))})
+        keys = jax.random.split(key, 3)
         return {
-            "torso": layers,
+            "torso": mlp_init(keys[0], sizes),
             "pi": {"w": jax.random.normal(keys[-2],
                                           (sizes[-1], n_out)) * 0.01,
                    "b": jnp.zeros((n_out,))},

@@ -123,9 +123,7 @@ class PPO(Algorithm):
         if cfg.num_workers > 0:
             from .worker_set import WorkerSet
             self._workers = WorkerSet(cfg)
-        # episode-return tracking (host side, cheap)
-        self._ep_returns = np.zeros(cfg.num_envs)
-        self._ep_done_returns: list = []
+        self._init_episode_tracking(cfg.num_envs)
 
     # -- the compiled iteration --------------------------------------------
     def _make_update_fn(self, batch_size: int):
@@ -232,20 +230,9 @@ class PPO(Algorithm):
         out.update({
             "env_steps_this_iter": env_steps,
             "env_steps_per_s": env_steps / dt,
-            "episode_reward_mean": float(np.mean(
-                self._ep_done_returns[-100:])) if self._ep_done_returns
-            else float("nan"),
+            "episode_reward_mean": self.episode_reward_mean(),
         })
         return out
-
-    def _track_episodes(self, rewards: np.ndarray, dones: np.ndarray):
-        for t in range(rewards.shape[0]):
-            self._ep_returns += rewards[t]
-            finished = dones[t].astype(bool)
-            if finished.any():
-                self._ep_done_returns.extend(
-                    self._ep_returns[finished].tolist())
-                self._ep_returns[finished] = 0.0
 
     def _learn_on_batch(self, batches) -> Dict[str, float]:
         keys = ("obs", "action", "logp", "adv", "ret")

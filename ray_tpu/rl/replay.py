@@ -1,0 +1,57 @@
+"""Device-resident replay buffer for off-policy RL.
+
+The reference's replay buffers (`rllib/utils/replay_buffers/`) are
+host-side Python deques feeding per-batch device copies.  TPU-first
+redesign: the buffer lives in device memory as a fixed-capacity pytree of
+arrays with a circular write cursor, and both `add_batch` and `sample`
+are jittable — so an entire DQN/SAC iteration (collect → insert →
+sample → update) compiles into one XLA program with zero host↔device
+traffic.  Uniform sampling; prioritized variants can layer a segment
+tree on the same storage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+BufferState = Dict[str, Any]   # {"data": pytree[capacity, ...], "cursor", "size"}
+
+
+def init(capacity: int, example: Dict[str, jnp.ndarray]) -> BufferState:
+    """Allocate storage shaped like one transition, times capacity."""
+    data = jax.tree_util.tree_map(
+        lambda x: jnp.zeros((capacity,) + jnp.asarray(x).shape,
+                            jnp.asarray(x).dtype), example)
+    return {"data": data,
+            "cursor": jnp.zeros((), jnp.int32),
+            "size": jnp.zeros((), jnp.int32),
+            "capacity": capacity}
+
+
+def add_batch(state: BufferState, batch: Dict[str, jnp.ndarray],
+              batch_size: int) -> BufferState:
+    """Insert [batch_size, ...] transitions at the circular cursor.
+
+    Scatter at (cursor + i) % capacity — jittable, handles wrap-around.
+    """
+    capacity = state["capacity"]
+    idx = (state["cursor"] + jnp.arange(batch_size)) % capacity
+    data = jax.tree_util.tree_map(
+        lambda buf, new: buf.at[idx].set(new), state["data"], batch)
+    return {"data": data,
+            "cursor": (state["cursor"] + batch_size) % capacity,
+            "size": jnp.minimum(state["size"] + batch_size, capacity),
+            "capacity": capacity}
+
+
+def sample(state: BufferState, key: jax.Array, batch_size: int
+           ) -> Tuple[Dict[str, jnp.ndarray], jax.Array]:
+    """Uniform sample of batch_size transitions from the filled region."""
+    key, skey = jax.random.split(key)
+    idx = jax.random.randint(skey, (batch_size,), 0,
+                             jnp.maximum(state["size"], 1))
+    batch = jax.tree_util.tree_map(lambda buf: buf[idx], state["data"])
+    return batch, key

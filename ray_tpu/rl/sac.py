@@ -1,0 +1,274 @@
+"""SAC: maximum-entropy continuous control, fully jitted.
+
+Capability mirror of the reference's SAC
+(`rllib/algorithms/sac/sac.py` — squashed-Gaussian actor, twin Q critics,
+Polyak targets, auto-tuned entropy temperature) — redesigned like dqn.py:
+the replay buffer lives on device (replay.py) and one `training_step`
+(collect scan → twin-critic/actor/alpha update scan) is a single XLA
+program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import replay
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import mlp_apply, mlp_init as _mlp_init
+
+_LOG_STD_MIN, _LOG_STD_MAX = -10.0, 2.0
+
+
+def _mlp_apply(params, x):
+    # relu torso (SAC's canonical choice: tanh saturates under the large
+    # unnormalized Q targets of cost-shaped envs)
+    return mlp_apply(params, x, activation=jax.nn.relu)
+
+
+@dataclasses.dataclass
+class SACConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_envs: int = 16
+    rollout_steps: int = 16
+    buffer_capacity: int = 100_000
+    batch_size: int = 256
+    num_updates: int = 16
+    gamma: float = 0.99
+    lr: float = 3e-4
+    tau: float = 0.005             # Polyak target-average rate
+    init_alpha: float = 0.2
+    autotune_alpha: bool = True    # gradient-tune log(alpha) to target entropy
+    learn_start: int = 1_000
+    hidden: tuple = (128, 128)
+    seed: int = 0
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(Algorithm):
+    _config_cls = SACConfig
+
+    def __init__(self, config: SACConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("SACConfig.env required (an env factory)")
+        self.env = cfg.env()
+        if self.env.discrete:
+            raise ValueError("SAC requires a continuous-action env")
+        obs_dim = self.env.observation_size
+        act_dim = self.env.action_size
+        self.act_dim = act_dim
+        key = jax.random.PRNGKey(cfg.seed)
+        key, k1, k2, k3, ekey = jax.random.split(key, 5)
+        h = tuple(cfg.hidden)
+        self.params = {
+            # actor: obs → (mean, log_std)
+            "actor": _mlp_init(k1, (obs_dim,) + h + (2 * act_dim,)),
+            # twin critics: [obs, act] → q
+            "q1": _mlp_init(k2, (obs_dim + act_dim,) + h + (1,)),
+            "q2": _mlp_init(k3, (obs_dim + act_dim,) + h + (1,)),
+            "log_alpha": jnp.asarray(math.log(cfg.init_alpha)),
+        }
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.buffer = replay.init(cfg.buffer_capacity, {
+            "obs": jnp.zeros((obs_dim,), jnp.float32),
+            "action": jnp.zeros((act_dim,), jnp.float32),
+            "reward": jnp.zeros((), jnp.float32),
+            "next_obs": jnp.zeros((obs_dim,), jnp.float32),
+            "done": jnp.zeros((), jnp.float32),
+        })
+        self.key = key
+        self.target_entropy = -float(act_dim)
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._init_episode_tracking(cfg.num_envs)
+
+    # -- policy -------------------------------------------------------------
+    def _sample_action(self, actor_params, obs, key):
+        """Squashed Gaussian: a = high * tanh(u), u ~ N(mean, std);
+        returns (action, logp) with the full log-det-Jacobian of
+        a = high*tanh(u) — including the log|high| constant, which does
+        NOT cancel in the alpha-autotune loss (its fixed point is
+        mean(logp) = -target_entropy, so a shifted logp would bias the
+        tuned temperature whenever action_high != 1)."""
+        out = _mlp_apply(actor_params, obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(key, mean.shape)
+        a = self.env.action_high * jnp.tanh(u)
+        gauss_logp = jnp.sum(
+            -((u - mean) ** 2) / (2 * std ** 2) - log_std
+            - 0.5 * math.log(2 * math.pi), axis=-1)
+        # log|det da/du| = sum log(high) + log(1 - tanh(u)^2); the
+        # softplus form of the tanh term is the numerically stable
+        # public identity
+        squash = jnp.sum(2.0 * (math.log(2.0) - u
+                                - jax.nn.softplus(-2.0 * u)), axis=-1) \
+            + self.act_dim * math.log(self.env.action_high)
+        return a, gauss_logp - squash
+
+    def _q(self, q_params, obs, act):
+        return _mlp_apply(q_params, jnp.concatenate([obs, act],
+                                                    axis=-1))[..., 0]
+
+    # -- the compiled iteration --------------------------------------------
+    def _make_train_iter(self):
+        cfg = self.config
+        env, opt = self.env, self.optimizer
+
+        def train_iter(params, target_q, opt_state, buffer, env_states,
+                       obs, key):
+            def collect(carry, _):
+                buffer, env_states, obs, key = carry
+                key, akey, skey = jax.random.split(key, 3)
+                akeys = jax.random.split(akey, cfg.num_envs)
+                action, _ = jax.vmap(
+                    lambda o, k: self._sample_action(params["actor"], o, k)
+                )(obs, akeys)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(env.step)(
+                    env_states, action, skeys)
+                buffer = replay.add_batch(buffer, {
+                    "obs": obs.astype(jnp.float32),
+                    "action": action.astype(jnp.float32),
+                    "reward": reward.astype(jnp.float32),
+                    "next_obs": next_obs.astype(jnp.float32),
+                    "done": done.astype(jnp.float32),
+                }, cfg.num_envs)
+                return (buffer, env_states, next_obs, key), \
+                    {"reward": reward, "done": done}
+
+            (buffer, env_states, obs, key), traj = jax.lax.scan(
+                collect, (buffer, env_states, obs, key), None,
+                length=cfg.rollout_steps)
+
+            def loss_fn(p, batch, key):
+                alpha = jnp.exp(p["log_alpha"])
+                # critic target from the CURRENT params' actor + target Qs
+                next_a, next_logp = jax.vmap(
+                    lambda o, k: self._sample_action(p["actor"], o, k))(
+                        batch["next_obs"],
+                        jax.random.split(key, cfg.batch_size))
+                tq = jnp.minimum(
+                    self._q(target_q["q1"], batch["next_obs"], next_a),
+                    self._q(target_q["q2"], batch["next_obs"], next_a))
+                target = batch["reward"] + cfg.gamma * \
+                    (1.0 - batch["done"]) * (
+                        tq - jax.lax.stop_gradient(alpha) * next_logp)
+                target = jax.lax.stop_gradient(target)
+                q1 = self._q(p["q1"], batch["obs"], batch["action"])
+                q2 = self._q(p["q2"], batch["obs"], batch["action"])
+                critic_loss = jnp.mean((q1 - target) ** 2) \
+                    + jnp.mean((q2 - target) ** 2)
+                # actor: maximize E[min Q - alpha*logp] through fresh actions
+                key2 = jax.random.fold_in(key, 1)
+                a, logp = jax.vmap(
+                    lambda o, k: self._sample_action(p["actor"], o, k))(
+                        batch["obs"],
+                        jax.random.split(key2, cfg.batch_size))
+                q_pi = jnp.minimum(
+                    self._q(jax.lax.stop_gradient(p["q1"]), batch["obs"], a),
+                    self._q(jax.lax.stop_gradient(p["q2"]), batch["obs"], a))
+                actor_loss = jnp.mean(
+                    jax.lax.stop_gradient(alpha) * logp - q_pi)
+                # temperature: match target entropy
+                if cfg.autotune_alpha:
+                    alpha_loss = -jnp.mean(
+                        p["log_alpha"] * jax.lax.stop_gradient(
+                            logp + self.target_entropy))
+                else:
+                    alpha_loss = 0.0
+                total = critic_loss + actor_loss + alpha_loss
+                return total, {"critic_loss": critic_loss,
+                               "actor_loss": actor_loss,
+                               "alpha": alpha,
+                               "entropy": -jnp.mean(logp)}
+
+            def update(carry, _):
+                params, target_q, opt_state, key = carry
+                batch, key = replay.sample(buffer, key, cfg.batch_size)
+                key, lkey = jax.random.split(key)
+                (_, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch, lkey)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                target_q = jax.tree_util.tree_map(
+                    lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+                    target_q, {"q1": params["q1"], "q2": params["q2"]})
+                return (params, target_q, opt_state, key), aux
+
+            do_learn = buffer["size"] >= cfg.learn_start
+
+            def run(args):
+                params, target_q, opt_state, key = args
+                (params, target_q, opt_state, key), auxs = jax.lax.scan(
+                    update, (params, target_q, opt_state, key), None,
+                    length=cfg.num_updates)
+                return params, target_q, opt_state, key, \
+                    jax.tree_util.tree_map(lambda x: x[-1], auxs)
+
+            def skip(args):
+                params, target_q, opt_state, key = args
+                zero = {"critic_loss": jnp.zeros(()),
+                        "actor_loss": jnp.zeros(()),
+                        "alpha": jnp.exp(params["log_alpha"]),
+                        "entropy": jnp.zeros(())}
+                return params, target_q, opt_state, key, zero
+
+            params, target_q, opt_state, key, metrics = jax.lax.cond(
+                do_learn, run, skip, (params, target_q, opt_state, key))
+            metrics["buffer_size"] = buffer["size"]
+            return (params, target_q, opt_state, buffer, env_states, obs,
+                    key, metrics, traj["reward"], traj["done"])
+
+        return train_iter
+
+    # -- Trainable interface ------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        (self.params, self.target_q, self.opt_state, self.buffer,
+         self.env_states, self.obs, self.key, metrics, rewards, dones) = \
+            self._train_iter(self.params, self.target_q, self.opt_state,
+                             self.buffer, self.env_states, self.obs,
+                             self.key)
+        env_steps = cfg.num_envs * cfg.rollout_steps
+        self._track_episodes(np.asarray(rewards), np.asarray(dones))
+        dt = time.perf_counter() - t0
+        out = {k: float(v) for k, v in metrics.items()}
+        out["step_reward_mean"] = float(np.asarray(rewards).mean())
+        out.update({
+            "env_steps_this_iter": env_steps,
+            "env_steps_per_s": env_steps / dt,
+            "episode_reward_mean": self.episode_reward_mean(),
+        })
+        return out
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "target_q": to_np(self.target_q),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        to_dev = lambda t, w: jax.tree_util.tree_map(  # noqa: E731
+            lambda _, x: jnp.asarray(x), t, w)
+        self.params = to_dev(self.params, state["params"])
+        self.target_q = to_dev(self.target_q, state["target_q"])
+        self.iteration = state.get("iteration", 0)

@@ -1,0 +1,69 @@
+"""Off-policy RL families: DQN (value-based) and SAC (continuous
+max-entropy).  Reference models: rllib/algorithms/dqn, rllib/algorithms/sac
+(learning smoke tests in their tests/ dirs)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import DQN, DQNConfig, SAC, SACConfig
+from ray_tpu.rl.env import CartPole, Pendulum
+from ray_tpu.rl import replay
+
+
+def test_replay_buffer_wraps_and_samples():
+    import jax
+    buf = replay.init(8, {"x": jnp.zeros((2,), jnp.float32)})
+    add = jax.jit(lambda s, b: replay.add_batch(s, b, 4))
+    for i in range(3):  # 12 inserts into capacity 8: cursor wraps
+        batch = {"x": jnp.full((4, 2), float(i))}
+        buf = add(buf, batch)
+    assert int(buf["size"]) == 8
+    assert int(buf["cursor"]) == 4
+    # slots 0-3 hold the newest batch (i=2), 4-7 the middle one (i=1)
+    data = np.asarray(buf["data"]["x"])
+    assert (data[:4] == 2.0).all() and (data[4:] == 1.0).all()
+    sample, _ = replay.sample(buf, jax.random.PRNGKey(0), 16)
+    assert sample["x"].shape == (16, 2)
+
+
+def test_dqn_learns_cartpole():
+    algo = DQNConfig(env=CartPole, num_envs=16, rollout_steps=32,
+                     batch_size=128, num_updates=64, lr=1e-3,
+                     eps_decay_steps=6000, learn_start=512,
+                     seed=0).build()
+    rewards = []
+    for _ in range(16):
+        res = algo.train()
+        rewards.append(res["episode_reward_mean"])
+    # untrained CartPole averages ~20; a learning Q-policy clears 40
+    assert res["env_steps_total"] == 16 * 16 * 32
+    assert rewards[-1] > 40, f"no learning progress: {rewards}"
+
+
+def test_dqn_checkpoint_roundtrip():
+    import jax
+    algo = DQNConfig(env=CartPole, num_envs=8, rollout_steps=16).build()
+    algo.train()
+    ck = algo.save()
+    algo2 = DQNConfig(env=CartPole, num_envs=8, rollout_steps=16).build()
+    algo2.restore(ck)
+    for a, b in zip(jax.tree_util.tree_leaves(algo.get_state()["params"]),
+                    jax.tree_util.tree_leaves(algo2.get_state()["params"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sac_improves_pendulum():
+    algo = SACConfig(env=Pendulum, num_envs=16, rollout_steps=25,
+                     batch_size=256, num_updates=100, learn_start=512,
+                     lr=1e-3, tau=0.01, seed=0).build()
+    per_step = []
+    for _ in range(36):
+        res = algo.train()
+        per_step.append(res["step_reward_mean"])
+    # pendulum step reward is the negative swing-up cost (~-6 untrained,
+    # ~0 balanced at the top); learning must shrink it markedly
+    early = float(np.mean(per_step[:3]))
+    late = float(np.mean(per_step[-3:]))
+    assert late > early + 2.0, \
+        f"no improvement: early={early:.2f} late={late:.2f} ({per_step})"
+    assert np.isfinite(res["critic_loss"]) and res["alpha"] > 0
