@@ -106,7 +106,13 @@ class SmartOpenStorage(ExternalStorage):
         try:
             with self._open(url, "rb") as f:
                 return f.read()
-        except Exception:
+        except Exception as e:
+            # None means "not there" to callers (they fall through to
+            # reconstruction) — an auth/misconfig error must not
+            # masquerade silently as data loss.
+            import sys
+            print(f"ray_tpu: restore of spilled object {url!r} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
             return None
 
     def delete(self, url: str) -> None:

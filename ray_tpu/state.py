@@ -88,6 +88,12 @@ def _node_call(addr: str, method: str, data: Optional[dict] = None,
         try:
             return core.lt.run(conn.call(method, data or {},
                                          timeout=timeout))
+        except TimeoutError:
+            # A slow reply proves nothing about the transport — the conn
+            # is shared; closing it would kill other threads' in-flight
+            # calls (and TimeoutError IS an OSError on py3.11+, so it
+            # must be excluded from the broken-transport handling below).
+            raise
         except (rpc_mod.RpcError, OSError):
             with lock:
                 if pool.get(addr) is conn:

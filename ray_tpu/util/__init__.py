@@ -1,8 +1,10 @@
 """Utility integrations over the core API (reference: `python/ray/util/`):
 placement groups, scheduling strategies, collectives, actor pool, queue,
-multiprocessing Pool, tracing."""
+multiprocessing Pool, tracing, parallel iterators, joblib backend,
+serializability inspection, remote debugger."""
 
 from .actor_pool import ActorPool  # noqa: F401
+from .check_serialize import inspect_serializability  # noqa: F401
 from .placement_group import (  # noqa: F401
     placement_group,
     placement_group_table,

@@ -1,0 +1,95 @@
+"""Serializability inspector.
+
+Capability mirror of the reference's
+`ray.util.check_serialize.inspect_serializability`
+(`python/ray/util/check_serialize.py`): recursively probe an object with
+the framework serializer and report WHICH nested attribute/closure cell
+fails, instead of surfacing one opaque pickling error from deep inside a
+task submission.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Set, Tuple
+
+import cloudpickle
+
+
+class FailTuple:
+    """One leaf that failed: (name, parent object description)."""
+
+    def __init__(self, name: str, parent: str):
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailTuple({self.name!r} found in {self.parent!r})"
+
+    def __eq__(self, other):
+        return (isinstance(other, FailTuple)
+                and (self.name, self.parent) == (other.name, other.parent))
+
+    def __hash__(self):
+        return hash((self.name, self.parent))
+
+
+def _serializable(obj: Any) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def inspect_serializability(obj: Any, name: str = "object", *,
+                            _depth: int = 3, _seen: Set[int] = None,
+                            _print: bool = True
+                            ) -> Tuple[bool, Set[FailTuple]]:
+    """→ (ok, failures).  Walks closures, attributes, and containers of a
+    non-serializable object to name the offending leaves."""
+    _seen = _seen if _seen is not None else set()
+    failures: Set[FailTuple] = set()
+    if _serializable(obj):
+        return True, failures
+    if id(obj) in _seen or _depth <= 0:
+        failures.add(FailTuple(name, type(obj).__name__))
+        return False, failures
+    _seen.add(id(obj))
+
+    parent_desc = f"{name} ({type(obj).__name__})"
+    children = []
+    if inspect.isfunction(obj):
+        if obj.__closure__:
+            children += [(f"closure cell {v}", c.cell_contents)
+                         for v, c in zip(
+                             obj.__code__.co_freevars, obj.__closure__)]
+        # referenced globals: only those the code object names
+        gnames = getattr(obj.__code__, "co_names", ())
+        g = getattr(obj, "__globals__", {})
+        children += [(f"global {n}", g[n]) for n in gnames if n in g]
+    elif isinstance(obj, dict):
+        children = [(f"[{k!r}]", v) for k, v in list(obj.items())[:100]]
+    elif isinstance(obj, (list, tuple, set)):
+        children = [(f"[{i}]", v) for i, v in enumerate(list(obj)[:100])]
+    elif hasattr(obj, "__dict__"):
+        children = list(vars(obj).items())[:100]
+
+    any_child_failed = False
+    for cname, child in children:
+        if _serializable(child):
+            continue
+        any_child_failed = True
+        ok, sub = inspect_serializability(
+            child, cname, _depth=_depth - 1, _seen=_seen, _print=False)
+        if sub:
+            failures |= sub
+        else:
+            failures.add(FailTuple(cname, parent_desc))
+    if not any_child_failed:
+        # the object itself is the unpicklable leaf
+        failures.add(FailTuple(name, type(obj).__name__))
+    if _print:
+        for f in failures:
+            print(f"  !!! FAIL serialization: {f}")
+    return False, failures

@@ -1,0 +1,149 @@
+"""Parallel iterators over actors.
+
+Capability mirror of the reference's `ray.util.iter` (`python/ray/util/iter.py`):
+a `ParallelIterator` is a set of iterator *shards*, each hosted by an actor,
+with functional transforms (`for_each`/`filter`/`batch`/`flatten`) applied
+lazily per shard and results gathered synchronously (round-robin across
+shards) or asynchronously (whichever shard is ready).  Built directly on
+this framework's actors — shard state lives in `_IterShard` actors, and
+`gather_async` uses `ray_tpu.wait` exactly as the reference uses
+`ray.wait`.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class _IterShard:
+    """Actor hosting one shard: a base iterable + a transform pipeline."""
+
+    def __init__(self, items: List[Any]):
+        self._items = items
+        self._ops: List[tuple] = []
+        self._it = None
+
+    def apply(self, op: str, fn_or_n) -> bool:
+        self._ops.append((op, fn_or_n))
+        return True
+
+    def _build(self):
+        it: Iterable[Any] = iter(self._items)
+        for op, arg in self._ops:
+            if op == "for_each":
+                it = map(arg, it)
+            elif op == "filter":
+                it = filter(arg, it)
+            elif op == "flatten":
+                it = itertools.chain.from_iterable(it)
+            elif op == "batch":
+                it = self._batched(it, arg)
+        return it
+
+    @staticmethod
+    def _batched(it, n):
+        buf = []
+        for x in it:
+            buf.append(x)
+            if len(buf) == n:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+    def reset(self) -> bool:
+        self._it = self._build()
+        return True
+
+    def next_item(self):
+        if self._it is None:
+            self.reset()
+        try:
+            return {"item": next(self._it)}
+        except StopIteration:
+            return {"stop": True}
+
+
+class ParallelIterator:
+    """Sharded lazy iterator; transforms fan out to every shard actor."""
+
+    def __init__(self, shard_actors: List[Any]):
+        self._shards = shard_actors
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_items(items: List[Any], num_shards: int = 2
+                   ) -> "ParallelIterator":
+        chunks: List[List[Any]] = [[] for _ in range(num_shards)]
+        for i, x in enumerate(items):
+            chunks[i % num_shards].append(x)
+        actor_cls = ray_tpu.remote(_IterShard)
+        return ParallelIterator(
+            [actor_cls.remote(c) for c in chunks])
+
+    @staticmethod
+    def from_range(n: int, num_shards: int = 2) -> "ParallelIterator":
+        return ParallelIterator.from_items(list(range(n)), num_shards)
+
+    # -- transforms (lazy, per shard) ---------------------------------------
+    def _apply(self, op: str, arg) -> "ParallelIterator":
+        ray_tpu.get([s.apply.remote(op, arg) for s in self._shards])
+        return self
+
+    def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
+        return self._apply("for_each", fn)
+
+    def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
+        return self._apply("filter", fn)
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._apply("batch", n)
+
+    def flatten(self) -> "ParallelIterator":
+        return self._apply("flatten", None)
+
+    def num_shards(self) -> int:
+        return len(self._shards)
+
+    # -- gathering ----------------------------------------------------------
+    def gather_sync(self) -> Iterable[Any]:
+        """Round-robin across shards, preserving per-shard order."""
+        ray_tpu.get([s.reset.remote() for s in self._shards])
+        live = list(self._shards)
+        while live:
+            nxt: List[Any] = []
+            for s in live:
+                out = ray_tpu.get(s.next_item.remote())
+                if out.get("stop"):
+                    continue
+                nxt.append(s)
+                yield out["item"]
+            live = nxt
+
+    def gather_async(self) -> Iterable[Any]:
+        """Yield from whichever shard finishes first (reference:
+        gather_async's completion-order semantics via ray.wait)."""
+        ray_tpu.get([s.reset.remote() for s in self._shards])
+        pending = {s.next_item.remote(): s for s in self._shards}
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+            ref = ready[0]
+            shard = pending.pop(ref)
+            out = ray_tpu.get(ref)
+            if out.get("stop"):
+                continue
+            pending[shard.next_item.remote()] = shard
+            yield out["item"]
+
+    def take(self, n: int) -> List[Any]:
+        return list(itertools.islice(self.gather_sync(), n))
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        return ParallelIterator(self._shards + other._shards)
+
+
+from_items = ParallelIterator.from_items
+from_range = ParallelIterator.from_range

@@ -1,0 +1,117 @@
+"""joblib backend: run scikit-learn style Parallel() jobs on the cluster.
+
+Capability mirror of the reference's `ray.util.joblib`
+(`python/ray/util/joblib/__init__.py` `register_ray` +
+`ray_backend.py` RayBackend): registers a joblib parallel backend whose
+batches execute as framework tasks, so
+``with joblib.parallel_backend("ray_tpu"): Parallel()(delayed(f)(x) ...)``
+fans out across the cluster.  Implements joblib's modern submit/future
+contract (`ParallelBackendBase.submit` + ``retrieve_result_callback``,
+joblib >= 1.4); the future-like wraps an ObjectRef with a waiter thread
+that fires joblib's completion callback.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _run_batch(pickled_batch: bytes):
+    import cloudpickle as cp
+    return cp.loads(pickled_batch)()
+
+
+class _RefFuture:
+    """Future-like over an ObjectRef (joblib drives it via
+    add_done_callback + get)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+        self._lock = threading.Lock()
+        self._cbs: List[Callable] = []
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+        self._done = threading.Event()
+        threading.Thread(target=self._wait, daemon=True).start()
+
+    def _wait(self):
+        try:
+            self._result = ray_tpu.get(self._ref)
+        except BaseException as e:  # noqa: BLE001 - surfaced via get()
+            self._exc = e
+        with self._lock:
+            self._done.set()
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            cb(self)
+
+    def add_done_callback(self, cb: Callable) -> None:
+        with self._lock:
+            if not self._done.is_set():
+                self._cbs.append(cb)
+                return
+        cb(self)
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("task did not complete in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    result = get
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib backend (call once per process)."""
+    try:
+        from joblib._parallel_backends import ParallelBackendBase
+        from joblib.parallel import register_parallel_backend
+    except ImportError as e:
+        raise ImportError(
+            "joblib is not available in this environment; "
+            "register_ray_tpu() needs it") from e
+
+    class _TpuBackend(ParallelBackendBase):
+        """Batches become tasks; effective_n_jobs = cluster CPUs."""
+
+        supports_retrieve_callback = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def effective_n_jobs(self, n_jobs: int) -> int:
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 in Parallel has no meaning")
+            if n_jobs == 1:
+                return 1
+            try:
+                total = int(ray_tpu.cluster_resources().get("CPU", 1))
+            except Exception:
+                total = 1
+            return total if n_jobs in (-1, None) else min(n_jobs, total)
+
+        def submit(self, func, callback=None):
+            import cloudpickle
+
+            fut = _RefFuture(_run_batch.remote(cloudpickle.dumps(func)))
+            if callback is not None:
+                fut.add_done_callback(callback)
+            return fut
+
+        # joblib < 1.4 spelled it apply_async
+        def apply_async(self, func, callback=None):
+            return self.submit(func, callback)
+
+        def retrieve_result_callback(self, out):
+            return out.get()
+
+        def abort_everything(self, ensure_ready: bool = True):
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs,
+                               parallel=self.parallel)
+
+    register_parallel_backend("ray_tpu", _TpuBackend)

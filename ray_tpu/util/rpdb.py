@@ -1,0 +1,109 @@
+"""Remote debugger: pdb over a TCP socket for worker processes.
+
+Capability mirror of the reference's `ray.util.rpdb`
+(`python/ray/util/rpdb.py`): a breakpoint inside a task/actor can't use
+stdin (the worker's stdio goes to log files), so ``set_trace()`` binds a
+localhost socket, registers the address in the controller KV (namespace
+``rpdb``), and serves a full pdb session to whoever connects —
+``ray_tpu debug``-style tooling or a raw ``nc host port``.
+"""
+
+from __future__ import annotations
+
+import pdb
+import socket
+import sys
+from typing import List, Optional, Tuple
+
+_NS = "rpdb"
+
+
+class _SocketPdb(pdb.Pdb):
+    """Pdb bound to an accepted TCP connection instead of stdio."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._fh = conn.makefile("rw", buffering=1)
+        super().__init__(stdin=self._fh, stdout=self._fh)
+        self.use_rawinput = False
+        self.prompt = "(rpdb) "
+
+    def close(self):
+        try:
+            self._fh.close()
+            self._conn.close()
+        except OSError:
+            pass
+
+
+def _announce(addr: Tuple[str, int], label: str) -> None:
+    """Best-effort: register in the controller KV so `list_sessions` /
+    CLI tooling can find waiting breakpoints."""
+    try:
+        from ..api import get_global_core
+        core = get_global_core()
+        core.controller.call("kv_put", {
+            "ns": _NS, "key": label.encode(),
+            "value": f"{addr[0]}:{addr[1]}".encode()})
+    except Exception:
+        pass
+
+
+def _retract(label: str) -> None:
+    """Remove the KV announcement once the breakpoint is no longer
+    accepting (session over or accept timed out) — list_sessions must
+    not accumulate dead addresses."""
+    try:
+        from ..api import get_global_core
+        core = get_global_core()
+        core.controller.call("kv_del", {"ns": _NS, "key": label.encode()})
+    except Exception:
+        pass
+
+
+def set_trace(frame=None, *, port: int = 0,
+              timeout_s: Optional[float] = 300.0) -> None:
+    """Break here and wait (bounded) for a debugger client to connect.
+
+    Prints/logs the address; if nobody connects within ``timeout_s`` the
+    program continues instead of wedging a production task forever.
+    """
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(1)
+    addr = srv.getsockname()
+    import os
+    label = f"pid-{os.getpid()}"
+    print(f"RPDB waiting on {addr[0]}:{addr[1]} "
+          f"(connect: nc {addr[0]} {addr[1]})", file=sys.stderr, flush=True)
+    _announce(addr, label)
+    srv.settimeout(timeout_s)
+    try:
+        conn, _ = srv.accept()
+    except (TimeoutError, socket.timeout):
+        print("RPDB: no client connected; continuing", file=sys.stderr)
+        srv.close()
+        _retract(label)
+        return
+    srv.close()
+    _retract(label)  # accepting now: the address is no longer joinable
+    dbg = _SocketPdb(conn)
+    dbg.set_trace(frame or sys._getframe().f_back)
+
+
+def list_sessions() -> List[Tuple[str, str]]:
+    """(label, host:port) of breakpoints currently waiting."""
+    try:
+        from ..api import get_global_core
+        core = get_global_core()
+        keys = core.controller.call("kv_keys", {"ns": _NS}) or []
+        out = []
+        for k in keys:
+            v = core.controller.call("kv_get", {"ns": _NS, "key": k})
+            if v:
+                out.append((k.decode() if isinstance(k, bytes) else k,
+                            v.decode() if isinstance(v, bytes) else v))
+        return out
+    except Exception:
+        return []
