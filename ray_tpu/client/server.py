@@ -39,7 +39,10 @@ class ClientServer:
                      "client_submit_task", "client_create_actor",
                      "client_submit_actor_task", "client_kill_actor",
                      "client_ref_inc", "client_ref_dec", "client_timeline",
-                     "client_bye", "controller_call"):
+                     "client_bye", "controller_call",
+                     "client_xlang_put", "client_xlang_get",
+                     "client_xlang_call", "client_xlang_create_actor",
+                     "client_xlang_actor_call", "client_xlang_kill_actor"):
             self.server.register(name, self._wrap(getattr(
                 self, "_h_" + name[7:] if name.startswith("client_")
                 else "_h_" + name)))
@@ -174,6 +177,147 @@ class ClientServer:
             if ent[1] <= 0:
                 table.pop(oid, None)  # mirror ObjectRef released by GC
         return True
+
+    # -- cross-language (xlang) boundary ------------------------------------
+    # The reference's cross-language calls (java/cpp → python) restrict the
+    # data boundary to msgpack-representable values and resolve callees by
+    # module path.  Same design here: these handlers let a non-Python
+    # driver (ray_tpu/cpp client) put/get raw-typed values and invoke
+    # Python functions/classes by "module:qualname" without speaking
+    # pickle.
+
+    @staticmethod
+    def _xlang_wire(v, _depth=0):
+        """Python value → msgpack-representable, or TypeError."""
+        if _depth > 8:
+            raise TypeError("xlang value nests too deep")
+        if v is None or isinstance(v, (bool, int, float, str, bytes)):
+            return v
+        if isinstance(v, bytearray):
+            return bytes(v)
+        if isinstance(v, (list, tuple)):
+            return [ClientServer._xlang_wire(x, _depth + 1) for x in v]
+        if isinstance(v, dict):
+            out = {}
+            for k, x in v.items():
+                if not isinstance(k, (str, bytes)):
+                    raise TypeError(f"xlang dict key {type(k).__name__}")
+                out[k] = ClientServer._xlang_wire(x, _depth + 1)
+            return out
+        raise TypeError(
+            f"value of type {type(v).__name__} does not cross the "
+            "xlang boundary (allowed: nil/bool/int/float/str/bytes/"
+            "list/dict)")
+
+    @staticmethod
+    def _xlang_resolve(target: str):
+        """'pkg.mod:qualname' → the named module attribute."""
+        import importlib
+        mod_name, _, qual = target.partition(":")
+        if not mod_name or not qual:
+            raise ValueError(f"xlang target must be 'module:qualname', "
+                             f"got {target!r}")
+        obj = importlib.import_module(mod_name)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    def _h_xlang_put(self, conn, data):
+        ref = self.core.put(bytes(data["blob"]))
+        self._hold(conn, ref)
+        return {"object_id": ref.binary()}
+
+    def _h_xlang_get(self, conn, data):
+        import time as _time
+        refs = [ObjectRef(ObjectID(o), self.core)
+                for o in data["object_ids"]]
+        timeout = data.get("timeout")
+        # per-ref gets give per-ref error granularity, but the client's
+        # timeout is a TOTAL budget — track a shared deadline, not N
+        # independent windows
+        deadline = None if timeout is None \
+            else _time.monotonic() + float(timeout)
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - _time.monotonic())
+            try:
+                value = self.core.get([ref], remaining)[0]
+                out.append({"value": self._xlang_wire(value)})
+            except exceptions.GetTimeoutError:
+                out.append({"timeout": True})
+            except Exception as e:
+                out.append({"error": f"{type(e).__name__}: {e}"})
+        return {"results": out}
+
+    def _h_xlang_call(self, conn, data):
+        from .. import api
+        try:
+            fn = self._xlang_resolve(data["function"])
+            opts = {"num_returns": int(data.get("num_returns", 1))}
+            if data.get("num_cpus"):
+                opts["num_cpus"] = float(data["num_cpus"])
+            refs = api.remote(fn).options(**opts).remote(
+                *list(data.get("args", [])))
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        if not isinstance(refs, (list, tuple)):
+            refs = [refs]
+        for r in refs:
+            self._hold(conn, r)
+        return {"object_ids": [r.binary() for r in refs]}
+
+    def _h_xlang_create_actor(self, conn, data):
+        from .. import api
+        try:
+            cls = self._xlang_resolve(data["actor_class"])
+            handle = api.remote(cls).remote(*list(data.get("args", [])))
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        actors = conn.peer_info.get("xlang_actors")
+        if actors is None:
+            actors = conn.peer_info["xlang_actors"] = {}
+            prev = conn.on_close
+
+            def closed(c, prev=prev):
+                if prev:
+                    prev(c)
+                # xlang actors die with their driver connection (like the
+                # reference's non-detached actors dying with the driver)
+                for aid in list(c.peer_info.get("xlang_actors", {})):
+                    try:
+                        self.core.kill_actor(aid, True)
+                    except Exception:
+                        pass
+                c.peer_info.get("xlang_actors", {}).clear()
+            conn.on_close = closed
+        actors[handle._actor_id] = handle
+        return {"actor_id": handle._actor_id}
+
+    def _h_xlang_kill_actor(self, conn, data):
+        handle = conn.peer_info.get("xlang_actors", {}).pop(
+            data["actor_id"], None)
+        if handle is None:
+            return {"error": "unknown actor (created on this connection?)"}
+        try:
+            self.core.kill_actor(data["actor_id"],
+                                 data.get("no_restart", True))
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        return {"ok": True}
+
+    def _h_xlang_actor_call(self, conn, data):
+        handle = conn.peer_info.get("xlang_actors", {}).get(
+            data["actor_id"])
+        if handle is None:
+            return {"error": "unknown actor (created on this connection?)"}
+        try:
+            ref = getattr(handle, data["method"]).remote(
+                *list(data.get("args", [])))
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        self._hold(conn, ref)
+        return {"object_ids": [ref.binary()]}
 
     def _h_timeline(self, conn, data):
         from ..util import tracing

@@ -176,8 +176,30 @@ _d("tpu_topology_override", str, "", "Force the advertised slice topology, e.g. 
 # --- train ------------------------------------------------------------------
 _d("train_default_checkpoint_keep", int, 2, "Checkpoints retained by CheckpointManager.")
 
+# --- observability ----------------------------------------------------------
+_d("task_spans_buffer_size", int, 5000,
+   "Finished-task spans retained per nodelet for the cluster timeline.")
+_d("events_buffer_size", int, 1000,
+   "Structured cluster events retained by the controller.")
+_d("pubsub_coalesce_s", float, 0.01,
+   "Controller publish loop batches events arriving within this window "
+   "into one push per subscriber (reference: pubsub batched long-poll).")
+_d("actor_worker_startup_timeout_s", float, 30.0,
+   "How long an actor start waits for a pooled worker to come up before "
+   "failing the placement.")
+
 # --- serve ------------------------------------------------------------------
 _d("serve_default_max_concurrent_queries", int, 100,
    "Per-replica in-flight cap used by the router.")
 _d("serve_http_host", str, "127.0.0.1", "HTTP proxy bind host.")
 _d("serve_http_port", int, 8000, "HTTP proxy bind port.")
+_d("serve_request_timeout_s", float, 60.0,
+   "End-to-end timeout for one proxied HTTP request (replica execution "
+   "included).")
+_d("serve_gang_ready_timeout_s", float, 300.0,
+   "How long gang-replica bring-up may take (PG + N actors + "
+   "jax.distributed rendezvous + model load) before the replica is "
+   "declared failed.")
+_d("serve_gang_stall_timeout_s", float, 600.0,
+   "Gang follower stall window: with nothing executing and no sequence "
+   "progress for this long, the member declares a leader fan-out gap.")

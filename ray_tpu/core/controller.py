@@ -21,6 +21,7 @@ import time
 from typing import Any, Dict, List, Optional, Set
 
 from . import rpc, spill
+from .config import GlobalConfig
 from .scheduling import NodeView, hybrid_policy, pack_bundles
 from .task_spec import ResourceSet, TaskSpec
 
@@ -116,7 +117,7 @@ class Controller:
         # structured cluster events (reference: src/ray/util/event.h +
         # dashboard/modules/event): bounded ring, newest last
         from collections import deque as _deque
-        self.events = _deque(maxlen=1000)
+        self.events = _deque(maxlen=GlobalConfig.events_buffer_size)
         self._event_seq = 0
         # -- durability (reference: gcs_table_storage.h:357 Redis-backed
         # GCS restart; here snapshot+WAL on local disk, persistence.py) ----
@@ -259,7 +260,8 @@ class Controller:
                     except Exception:
                         pass
                 if self._pub_buf:
-                    await asyncio.sleep(0.01)  # coalesce the burst
+                    await asyncio.sleep(          # coalesce the burst
+                        GlobalConfig.pubsub_coalesce_s)
         finally:
             self._pub_flusher = None
 

@@ -94,7 +94,8 @@ class Nodelet:
         self._running_tasks: Dict[bytes, dict] = {}   # worker_id -> task
         self._task_counts: Dict[str, int] = {}        # fname -> finished
         from collections import deque as _deque
-        self._task_spans = _deque(maxlen=5000)        # finished-task spans
+        self._task_spans = _deque(                    # finished-task spans
+            maxlen=GlobalConfig.task_spans_buffer_size)
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._tasks: List[asyncio.Task] = []
         self._next_worker_seq = 0
@@ -552,7 +553,8 @@ class Nodelet:
         request = spec.resources
         if not self.available.fits(request):
             return {"ok": False, "retry": True, "error": "resources busy"}
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + \
+            GlobalConfig.actor_worker_startup_timeout_s
         worker = None
         while worker is None:
             worker = await self._pop_idle_worker()

@@ -1,0 +1,243 @@
+// Minimal msgpack codec for the ray_tpu wire protocol.
+//
+// The control plane frames msgpack arrays [seq, kind, method, data]
+// (ray_tpu/core/rpc.py).  This header implements exactly the subset the
+// protocol uses — nil/bool/int/float64/str/bin/array/map(string keys) —
+// with no external dependencies, playing the role the vendored
+// msgpack-c headers play for the reference's C++ worker (cpp/ in
+// /root/reference, xlang data boundary).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+namespace msgpack_lite {
+
+class Value {
+ public:
+  enum class Type { Nil, Bool, Int, Float, Str, Bin, Array, Map };
+
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                  // Str and Bin payloads
+  std::vector<Value> arr;
+  std::map<std::string, Value> map;
+
+  Value() = default;
+  static Value Nil() { return Value(); }
+  static Value Of(bool v) { Value x; x.type = Type::Bool; x.b = v; return x; }
+  static Value Of(int64_t v) { Value x; x.type = Type::Int; x.i = v; return x; }
+  static Value Of(int v) { return Of(static_cast<int64_t>(v)); }
+  static Value Of(double v) { Value x; x.type = Type::Float; x.f = v; return x; }
+  static Value Str(std::string v) {
+    Value x; x.type = Type::Str; x.s = std::move(v); return x;
+  }
+  static Value Bin(std::string v) {
+    Value x; x.type = Type::Bin; x.s = std::move(v); return x;
+  }
+  static Value Arr(std::vector<Value> v) {
+    Value x; x.type = Type::Array; x.arr = std::move(v); return x;
+  }
+  static Value MapOf(std::map<std::string, Value> v) {
+    Value x; x.type = Type::Map; x.map = std::move(v); return x;
+  }
+
+  bool is_nil() const { return type == Type::Nil; }
+  int64_t as_int() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Float) return static_cast<int64_t>(f);
+    throw std::runtime_error("msgpack: not an int");
+  }
+  double as_float() const {
+    if (type == Type::Float) return f;
+    if (type == Type::Int) return static_cast<double>(i);
+    throw std::runtime_error("msgpack: not a float");
+  }
+  const std::string& as_str() const {
+    if (type != Type::Str && type != Type::Bin)
+      throw std::runtime_error("msgpack: not a str/bin");
+    return s;
+  }
+  const Value& at(const std::string& key) const {
+    auto it = map.find(key);
+    if (it == map.end()) throw std::runtime_error("msgpack: no key " + key);
+    return it->second;
+  }
+  bool has(const std::string& key) const { return map.count(key) > 0; }
+};
+
+// ----------------------------------------------------------------- encode
+inline void PackTo(const Value& v, std::string* out);
+
+inline void put_u8(std::string* o, uint8_t b) { o->push_back(char(b)); }
+inline void put_be(std::string* o, uint64_t v, int bytes) {
+  for (int i = bytes - 1; i >= 0; --i) o->push_back(char((v >> (8 * i)) & 0xff));
+}
+
+inline void PackTo(const Value& v, std::string* out) {
+  using T = Value::Type;
+  switch (v.type) {
+    case T::Nil: put_u8(out, 0xc0); break;
+    case T::Bool: put_u8(out, v.b ? 0xc3 : 0xc2); break;
+    case T::Int: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) put_u8(out, uint8_t(x));
+      else if (x < 0 && x >= -32) put_u8(out, uint8_t(x));
+      else { put_u8(out, 0xd3); put_be(out, uint64_t(x), 8); }
+      break;
+    }
+    case T::Float: {
+      put_u8(out, 0xcb);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(v.f), "double size");
+      std::memcpy(&bits, &v.f, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case T::Str: {
+      size_t n = v.s.size();
+      if (n < 32) put_u8(out, uint8_t(0xa0 | n));
+      else if (n < 256) { put_u8(out, 0xd9); put_u8(out, uint8_t(n)); }
+      else if (n < 65536) { put_u8(out, 0xda); put_be(out, n, 2); }
+      else { put_u8(out, 0xdb); put_be(out, n, 4); }
+      out->append(v.s);
+      break;
+    }
+    case T::Bin: {
+      size_t n = v.s.size();
+      if (n < 256) { put_u8(out, 0xc4); put_u8(out, uint8_t(n)); }
+      else if (n < 65536) { put_u8(out, 0xc5); put_be(out, n, 2); }
+      else { put_u8(out, 0xc6); put_be(out, n, 4); }
+      out->append(v.s);
+      break;
+    }
+    case T::Array: {
+      size_t n = v.arr.size();
+      if (n < 16) put_u8(out, uint8_t(0x90 | n));
+      else if (n < 65536) { put_u8(out, 0xdc); put_be(out, n, 2); }
+      else { put_u8(out, 0xdd); put_be(out, n, 4); }
+      for (const auto& e : v.arr) PackTo(e, out);
+      break;
+    }
+    case T::Map: {
+      size_t n = v.map.size();
+      if (n < 16) put_u8(out, uint8_t(0x80 | n));
+      else if (n < 65536) { put_u8(out, 0xde); put_be(out, n, 2); }
+      else { put_u8(out, 0xdf); put_be(out, n, 4); }
+      for (const auto& kv : v.map) {
+        PackTo(Value::Str(kv.first), out);
+        PackTo(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+inline std::string Pack(const Value& v) {
+  std::string out;
+  PackTo(v, &out);
+  return out;
+}
+
+// ----------------------------------------------------------------- decode
+class Reader {
+ public:
+  Reader(const char* data, size_t size) : p_(data), end_(data + size) {}
+
+  Value Next() {
+    uint8_t tag = u8();
+    if (tag < 0x80) return Value::Of(int64_t(tag));            // pos fixint
+    if (tag >= 0xe0) return Value::Of(int64_t(int8_t(tag)));   // neg fixint
+    if ((tag & 0xf0) == 0x90) return array(tag & 0x0f);        // fixarray
+    if ((tag & 0xf0) == 0x80) return mapv(tag & 0x0f);         // fixmap
+    if ((tag & 0xe0) == 0xa0) return str(tag & 0x1f);          // fixstr
+    switch (tag) {
+      case 0xc0: return Value::Nil();
+      case 0xc2: return Value::Of(false);
+      case 0xc3: return Value::Of(true);
+      case 0xc4: return bin(u8());
+      case 0xc5: return bin(be(2));
+      case 0xc6: return bin(be(4));
+      case 0xca: {  // float32
+        uint32_t bits = uint32_t(be(4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::Of(double(f));
+      }
+      case 0xcb: {  // float64
+        uint64_t bits = be(8);
+        double f;
+        std::memcpy(&f, &bits, 8);
+        return Value::Of(f);
+      }
+      case 0xcc: return Value::Of(int64_t(u8()));
+      case 0xcd: return Value::Of(int64_t(be(2)));
+      case 0xce: return Value::Of(int64_t(be(4)));
+      case 0xcf: return Value::Of(int64_t(be(8)));   // uint64 (truncates >2^63)
+      case 0xd0: return Value::Of(int64_t(int8_t(u8())));
+      case 0xd1: return Value::Of(int64_t(int16_t(be(2))));
+      case 0xd2: return Value::Of(int64_t(int32_t(be(4))));
+      case 0xd3: return Value::Of(int64_t(be(8)));
+      case 0xd9: return str(u8());
+      case 0xda: return str(be(2));
+      case 0xdb: return str(be(4));
+      case 0xdc: return array(be(2));
+      case 0xdd: return array(be(4));
+      case 0xde: return mapv(be(2));
+      case 0xdf: return mapv(be(4));
+      default:
+        throw std::runtime_error("msgpack: unsupported tag");
+    }
+  }
+
+ private:
+  uint8_t u8() {
+    if (p_ >= end_) throw std::runtime_error("msgpack: truncated");
+    return uint8_t(*p_++);
+  }
+  uint64_t be(int bytes) {
+    uint64_t v = 0;
+    for (int i = 0; i < bytes; ++i) v = (v << 8) | u8();
+    return v;
+  }
+  std::string take(size_t n) {
+    if (size_t(end_ - p_) < n) throw std::runtime_error("msgpack: truncated");
+    std::string s(p_, n);
+    p_ += n;
+    return s;
+  }
+  Value str(size_t n) { return Value::Str(take(n)); }
+  Value bin(size_t n) { return Value::Bin(take(n)); }
+  Value array(size_t n) {
+    std::vector<Value> out;
+    out.reserve(n);
+    for (size_t i = 0; i < n; ++i) out.push_back(Next());
+    return Value::Arr(std::move(out));
+  }
+  Value mapv(size_t n) {
+    std::map<std::string, Value> out;
+    for (size_t i = 0; i < n; ++i) {
+      Value k = Next();
+      out[k.as_str()] = Next();
+    }
+    return Value::MapOf(std::move(out));
+  }
+
+  const char* p_;
+  const char* end_;
+};
+
+inline Value Unpack(const std::string& buf) {
+  Reader r(buf.data(), buf.size());
+  return r.Next();
+}
+
+}  // namespace msgpack_lite
+}  // namespace ray_tpu

@@ -1,0 +1,222 @@
+// Implementation of the C++ driver client (see ray_tpu_client.h).
+//
+// Wire protocol (must match ray_tpu/core/rpc.py): every frame is a
+// 4-byte little-endian length followed by msgpack [seq, kind, method,
+// data], kind in {REQUEST=0, REPLY=1, ERROR=2, NOTIFY=3}.  This client
+// issues REQUESTs and matches REPLY/ERROR by seq; NOTIFY frames (pubsub
+// pushes) are skipped.
+
+#include "ray_tpu_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+
+namespace ray_tpu {
+
+namespace {
+constexpr int kRequest = 0;
+constexpr int kReply = 1;
+constexpr int kError = 2;
+constexpr int kNotify = 3;
+
+void WriteAll(int fd, const char* data, size_t n) {
+  while (n > 0) {
+    // MSG_NOSIGNAL: a server-side disconnect must surface as the
+    // exception below, not deliver SIGPIPE and kill the host process.
+    ssize_t w = ::send(fd, data, n, MSG_NOSIGNAL);
+    if (w <= 0) throw std::runtime_error("ray_tpu: connection write failed");
+    data += w;
+    n -= size_t(w);
+  }
+}
+
+void ReadAll(int fd, char* data, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::read(fd, data, n);
+    if (r <= 0) throw std::runtime_error("ray_tpu: connection closed");
+    data += r;
+    n -= size_t(r);
+  }
+}
+
+Val IdArray(const std::vector<std::string>& ids) {
+  std::vector<Val> out;
+  out.reserve(ids.size());
+  for (const auto& id : ids) out.push_back(Val::Bin(id));
+  return Val::Arr(std::move(out));
+}
+}  // namespace
+
+Client::~Client() { Close(); }
+
+void Client::Connect(const std::string& host, int port) {
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res)
+    throw std::runtime_error("ray_tpu: cannot resolve " + host);
+  fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+    throw std::runtime_error("ray_tpu: cannot connect to " + host + ":" +
+                             port_s);
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Val hello = Request("client_hello", Val::MapOf({}));
+  job_id_ = hello.at("job_id").as_str();
+}
+
+void Client::Close() {
+  if (fd_ >= 0) {
+    try {
+      Request("client_bye", Val::MapOf({}));
+    } catch (...) {
+    }
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Client::SendFrame(const std::string& payload) {
+  uint32_t len = uint32_t(payload.size());
+  char hdr[4] = {char(len & 0xff), char((len >> 8) & 0xff),
+                 char((len >> 16) & 0xff), char((len >> 24) & 0xff)};
+  WriteAll(fd_, hdr, 4);
+  WriteAll(fd_, payload.data(), payload.size());
+}
+
+std::string Client::RecvFrame() {
+  char hdr[4];
+  ReadAll(fd_, hdr, 4);
+  uint32_t len = uint32_t(uint8_t(hdr[0])) | (uint32_t(uint8_t(hdr[1])) << 8) |
+                 (uint32_t(uint8_t(hdr[2])) << 16) |
+                 (uint32_t(uint8_t(hdr[3])) << 24);
+  std::string payload(len, '\0');
+  ReadAll(fd_, payload.data(), len);
+  return payload;
+}
+
+Val Client::Request(const std::string& method, Val data) {
+  if (fd_ < 0) throw std::runtime_error("ray_tpu: not connected");
+  int64_t seq = ++seq_;
+  Val frame = Val::Arr({Val::Of(seq), Val::Of(int64_t(kRequest)),
+                        Val::Str(method), std::move(data)});
+  SendFrame(msgpack_lite::Pack(frame));
+  for (;;) {
+    Val reply = msgpack_lite::Unpack(RecvFrame());
+    if (reply.arr.size() != 4) throw std::runtime_error("ray_tpu: bad frame");
+    int64_t kind = reply.arr[1].as_int();
+    if (kind == kNotify || kind == kRequest) continue;  // pubsub push: skip
+    if (reply.arr[0].as_int() != seq) continue;         // stale reply
+    if (kind == kError)
+      throw std::runtime_error("ray_tpu: remote error: " +
+                               reply.arr[3].as_str());
+    return reply.arr[3];
+  }
+}
+
+ObjectId Client::Put(const std::string& bytes) {
+  Val r = Request("client_xlang_put", Val::MapOf({{"blob", Val::Bin(bytes)}}));
+  return r.at("object_id").as_str();
+}
+
+std::vector<GetResult> Client::Get(const std::vector<ObjectId>& ids,
+                                   std::optional<double> timeout_s) {
+  std::map<std::string, Val> req{{"object_ids", IdArray(ids)}};
+  if (timeout_s) req["timeout"] = Val::Of(*timeout_s);
+  Val r = Request("client_xlang_get", Val::MapOf(std::move(req)));
+  std::vector<GetResult> out;
+  for (const auto& entry : r.at("results").arr) {
+    GetResult g;
+    if (entry.has("timeout") && entry.at("timeout").b) {
+      g.timeout = true;
+    } else if (entry.has("error")) {
+      g.error = entry.at("error").as_str();
+    } else {
+      g.ok = true;
+      g.value = entry.at("value");
+    }
+    out.push_back(std::move(g));
+  }
+  return out;
+}
+
+GetResult Client::Get(const ObjectId& id, std::optional<double> timeout_s) {
+  return Get(std::vector<ObjectId>{id}, timeout_s)[0];
+}
+
+std::pair<std::vector<ObjectId>, std::vector<ObjectId>> Client::Wait(
+    const std::vector<ObjectId>& ids, int num_returns,
+    std::optional<double> timeout_s) {
+  std::map<std::string, Val> req{{"object_ids", IdArray(ids)},
+                                 {"num_returns", Val::Of(num_returns)}};
+  if (timeout_s) req["timeout"] = Val::Of(*timeout_s);
+  Val r = Request("client_wait", Val::MapOf(std::move(req)));
+  std::pair<std::vector<ObjectId>, std::vector<ObjectId>> out;
+  for (const auto& v : r.at("ready").arr) out.first.push_back(v.as_str());
+  for (const auto& v : r.at("not_ready").arr) out.second.push_back(v.as_str());
+  return out;
+}
+
+std::vector<ObjectId> Client::Call(const std::string& function,
+                                   const std::vector<Val>& args,
+                                   int num_returns) {
+  Val r = Request("client_xlang_call",
+                  Val::MapOf({{"function", Val::Str(function)},
+                              {"args", Val::Arr(args)},
+                              {"num_returns", Val::Of(num_returns)}}));
+  if (r.has("error"))
+    throw std::runtime_error("ray_tpu: call failed: " + r.at("error").as_str());
+  std::vector<ObjectId> out;
+  for (const auto& v : r.at("object_ids").arr) out.push_back(v.as_str());
+  return out;
+}
+
+ActorId Client::CreateActor(const std::string& actor_class,
+                            const std::vector<Val>& args) {
+  Val r = Request("client_xlang_create_actor",
+                  Val::MapOf({{"actor_class", Val::Str(actor_class)},
+                              {"args", Val::Arr(args)}}));
+  if (r.has("error"))
+    throw std::runtime_error("ray_tpu: actor creation failed: " +
+                             r.at("error").as_str());
+  return r.at("actor_id").as_str();
+}
+
+ObjectId Client::ActorCall(const ActorId& actor, const std::string& method,
+                           const std::vector<Val>& args) {
+  Val r = Request("client_xlang_actor_call",
+                  Val::MapOf({{"actor_id", Val::Bin(actor)},
+                              {"method", Val::Str(method)},
+                              {"args", Val::Arr(args)}}));
+  if (r.has("error"))
+    throw std::runtime_error("ray_tpu: actor call failed: " +
+                             r.at("error").as_str());
+  return r.at("object_ids").arr[0].as_str();
+}
+
+void Client::KillActor(const ActorId& actor) {
+  Val r = Request("client_xlang_kill_actor",
+                  Val::MapOf({{"actor_id", Val::Bin(actor)}}));
+  if (r.has("error"))
+    throw std::runtime_error("ray_tpu: kill failed: " + r.at("error").as_str());
+}
+
+void Client::Release(const std::vector<ObjectId>& ids) {
+  Request("client_ref_dec", Val::MapOf({{"object_ids", IdArray(ids)}}));
+}
+
+}  // namespace ray_tpu

@@ -1,0 +1,94 @@
+// C++ driver API for the ray_tpu cluster.
+//
+// Role of the reference's C++ worker API (cpp/include/ray/api/ in
+// /root/reference: ray::Init, ray::Put/Get, ray::Task(...).Remote(),
+// actor handles, xlang calls) — redesigned for this framework's remote-
+// driver endpoint: the client speaks the length-framed msgpack protocol
+// to a ClientServer (ray_tpu/client/server.py) and crosses the language
+// boundary with msgpack-typed values, invoking Python callees by
+// "module:qualname" exactly like the reference's cross-language calls.
+//
+// Synchronous, single-connection, no external dependencies.
+//
+//   ray_tpu::Client c;
+//   c.Connect("127.0.0.1", port);
+//   auto id  = c.Put("raw bytes");
+//   auto ids = c.Call("my_pkg.funcs:square", {ray_tpu::Val::Of(7)});
+//   auto v   = c.Get(ids[0], /*timeout_s=*/30.0);   // v.as_int() == 49
+//   auto actor = c.CreateActor("my_pkg.funcs:Counter", {});
+//   auto r = c.ActorCall(actor, "incr", {ray_tpu::Val::Of(5)});
+#pragma once
+
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "msgpack_lite.h"
+
+namespace ray_tpu {
+
+using Val = msgpack_lite::Value;
+
+using ObjectId = std::string;   // opaque binary object id
+using ActorId = std::string;    // opaque binary actor id
+
+struct GetResult {
+  bool ok = false;
+  bool timeout = false;
+  std::string error;
+  Val value;
+};
+
+class Client {
+ public:
+  Client() = default;
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Dial a ClientServer endpoint; performs the hello handshake.
+  void Connect(const std::string& host, int port);
+  void Close();
+  bool connected() const { return fd_ >= 0; }
+
+  const std::string& job_id() const { return job_id_; }
+
+  // Store raw bytes as an object (arrives Python-side as `bytes`).
+  ObjectId Put(const std::string& bytes);
+
+  // Fetch one object across the msgpack boundary.
+  GetResult Get(const ObjectId& id, std::optional<double> timeout_s = {});
+  std::vector<GetResult> Get(const std::vector<ObjectId>& ids,
+                             std::optional<double> timeout_s = {});
+
+  // ray.wait: first `num_returns` ready ids (ready, not_ready).
+  std::pair<std::vector<ObjectId>, std::vector<ObjectId>> Wait(
+      const std::vector<ObjectId>& ids, int num_returns,
+      std::optional<double> timeout_s = {});
+
+  // Invoke a Python function by "module:qualname"; returns object ids.
+  std::vector<ObjectId> Call(const std::string& function,
+                             const std::vector<Val>& args,
+                             int num_returns = 1);
+
+  // Create a Python actor by "module:QualName"; call its methods.
+  ActorId CreateActor(const std::string& actor_class,
+                      const std::vector<Val>& args);
+  ObjectId ActorCall(const ActorId& actor, const std::string& method,
+                     const std::vector<Val>& args);
+  void KillActor(const ActorId& actor);
+
+  // Drop the server-side mirror refs for ids this client is done with.
+  void Release(const std::vector<ObjectId>& ids);
+
+ private:
+  Val Request(const std::string& method, Val data);
+  void SendFrame(const std::string& payload);
+  std::string RecvFrame();
+
+  int fd_ = -1;
+  int64_t seq_ = 0;
+  std::string job_id_;
+};
+
+}  // namespace ray_tpu

@@ -161,12 +161,14 @@ class GangReplicaWorker:
             # long-context generation — is progress, not a gap).  Only a
             # true fan-out gap (nothing running, nothing advancing for the
             # full window) trips it.
-            deadline = _time.monotonic() + 600.0
+            from ..core.config import GlobalConfig
+            stall_s = GlobalConfig.serve_gang_stall_timeout_s
+            deadline = _time.monotonic() + stall_s
             last_seen = self._next_seq
             while seq != self._next_seq:
                 if self._next_seq != last_seen or self._num_executing > 0:
                     last_seen = self._next_seq
-                    deadline = _time.monotonic() + 600.0
+                    deadline = _time.monotonic() + stall_s
                 if _time.monotonic() > deadline:
                     # a gap in the sequence (leader failed mid-fan-out):
                     # fail loudly instead of wedging this thread forever
@@ -250,7 +252,9 @@ def start_gang_replica(name: str, rid: str, entry: Dict[str, Any],
         members.append(handle)
     # Constructors run concurrently (the mesh join is a barrier); readiness
     # of all members implies jax.distributed linked the gang.
-    api.get([m.ready.remote() for m in members], timeout=300.0)
+    from ..core.config import GlobalConfig
+    api.get([m.ready.remote() for m in members],
+            timeout=GlobalConfig.serve_gang_ready_timeout_s)
     api.get(members[0].set_peers.remote(members[1:]), timeout=60.0)
     return {"id": rid, "handle": members[0], "gang": members, "pg": pg}
 

@@ -73,7 +73,9 @@ class HTTPProxy:
                 ref, rid = self._router.assign_request(
                     name, (payload,) if payload is not None else (), {})
                 try:
-                    return _api.get(ref, timeout=60.0)
+                    from ..core.config import GlobalConfig
+                    return _api.get(
+                        ref, timeout=GlobalConfig.serve_request_timeout_s)
                 finally:
                     self._router.complete(name, rid)
 

@@ -35,7 +35,19 @@ class FailTuple:
 
 
 def _serializable(obj: Any) -> bool:
+    """Probe with the FRAMEWORK serializer, not raw cloudpickle — they
+    diverge (core.serialization stages jax.Array to host memory via
+    reducer_override and collects nested ObjectRefs), and the question
+    this tool answers is 'can a task argument ship', not 'can pickle
+    pickle it'."""
     try:
+        from ..core import serialization
+        serialization.serialize(obj, ref_collector=[])
+        return True
+    except Exception:
+        pass
+    try:
+        # functions/classes ship via the function-table path
         cloudpickle.dumps(obj)
         return True
     except Exception:

@@ -4,35 +4,37 @@ Capability mirror of the reference's `ray.util.iter` (`python/ray/util/iter.py`)
 a `ParallelIterator` is a set of iterator *shards*, each hosted by an actor,
 with functional transforms (`for_each`/`filter`/`batch`/`flatten`) applied
 lazily per shard and results gathered synchronously (round-robin across
-shards) or asynchronously (whichever shard is ready).  Built directly on
-this framework's actors — shard state lives in `_IterShard` actors, and
-`gather_async` uses `ray_tpu.wait` exactly as the reference uses
-`ray.wait`.
+shards) or asynchronously (whichever shard is ready).  Like the reference,
+transforms are IMMUTABLE: each returns a new `ParallelIterator` sharing the
+shard actors but carrying its own op pipeline.  Each gather materializes
+its pipeline under a fresh token on the shard actors, so branched views of
+one base iterator can be gathered concurrently (interleaved generators,
+`union` of branches) without clobbering each other.  Built directly on
+this framework's actors; `gather_async` uses `ray_tpu.wait` exactly as
+the reference uses `ray.wait`.
 """
 
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Iterable, List, Optional
+import uuid
+from typing import Any, Callable, Iterable, List, Tuple
 
 import ray_tpu
 
 
 class _IterShard:
-    """Actor hosting one shard: a base iterable + a transform pipeline."""
+    """Actor hosting one shard: a base iterable plus any number of live
+    pipelines, keyed by gather token (ops live client-side so transforms
+    stay immutable)."""
 
     def __init__(self, items: List[Any]):
         self._items = items
-        self._ops: List[tuple] = []
-        self._it = None
+        self._pipelines: dict = {}
 
-    def apply(self, op: str, fn_or_n) -> bool:
-        self._ops.append((op, fn_or_n))
-        return True
-
-    def _build(self):
+    def reset(self, token: str, ops: List[Tuple[str, Any]]) -> bool:
         it: Iterable[Any] = iter(self._items)
-        for op, arg in self._ops:
+        for op, arg in ops:
             if op == "for_each":
                 it = map(arg, it)
             elif op == "filter":
@@ -41,7 +43,8 @@ class _IterShard:
                 it = itertools.chain.from_iterable(it)
             elif op == "batch":
                 it = self._batched(it, arg)
-        return it
+        self._pipelines[token] = it
+        return True
 
     @staticmethod
     def _batched(it, n):
@@ -54,24 +57,27 @@ class _IterShard:
         if buf:
             yield buf
 
-    def reset(self) -> bool:
-        self._it = self._build()
-        return True
-
-    def next_item(self):
-        if self._it is None:
-            self.reset()
-        try:
-            return {"item": next(self._it)}
-        except StopIteration:
+    def next_item(self, token: str):
+        it = self._pipelines.get(token)
+        if it is None:
             return {"stop": True}
+        try:
+            return {"item": next(it)}
+        except StopIteration:
+            self._pipelines.pop(token, None)
+            return {"stop": True}
+
+    def drop(self, token: str) -> bool:
+        self._pipelines.pop(token, None)
+        return True
 
 
 class ParallelIterator:
-    """Sharded lazy iterator; transforms fan out to every shard actor."""
+    """Sharded lazy iterator; transforms return new iterators."""
 
-    def __init__(self, shard_actors: List[Any]):
-        self._shards = shard_actors
+    def __init__(self, shards: List[Tuple[Any, Tuple[Tuple[str, Any], ...]]]):
+        # [(shard_actor, ops applied to that shard)]
+        self._shards = shards
 
     # -- constructors -------------------------------------------------------
     @staticmethod
@@ -82,66 +88,89 @@ class ParallelIterator:
             chunks[i % num_shards].append(x)
         actor_cls = ray_tpu.remote(_IterShard)
         return ParallelIterator(
-            [actor_cls.remote(c) for c in chunks])
+            [(actor_cls.remote(c), ()) for c in chunks])
 
     @staticmethod
     def from_range(n: int, num_shards: int = 2) -> "ParallelIterator":
         return ParallelIterator.from_items(list(range(n)), num_shards)
 
-    # -- transforms (lazy, per shard) ---------------------------------------
-    def _apply(self, op: str, arg) -> "ParallelIterator":
-        ray_tpu.get([s.apply.remote(op, arg) for s in self._shards])
-        return self
+    # -- transforms (lazy, immutable) ---------------------------------------
+    def _extend(self, op: str, arg) -> "ParallelIterator":
+        return ParallelIterator(
+            [(actor, ops + ((op, arg),)) for actor, ops in self._shards])
 
     def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
-        return self._apply("for_each", fn)
+        return self._extend("for_each", fn)
 
     def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
-        return self._apply("filter", fn)
+        return self._extend("filter", fn)
 
     def batch(self, n: int) -> "ParallelIterator":
-        return self._apply("batch", n)
+        return self._extend("batch", n)
 
     def flatten(self) -> "ParallelIterator":
-        return self._apply("flatten", None)
+        return self._extend("flatten", None)
 
     def num_shards(self) -> int:
         return len(self._shards)
 
     # -- gathering ----------------------------------------------------------
+    def _start(self) -> List[Tuple[Any, str]]:
+        """Materialize this view's pipelines; one token PER ENTRY so a
+        union whose sides share a shard actor gets two independent
+        pipelines on it."""
+        base = uuid.uuid4().hex
+        entries = [(actor, f"{base}-{i}")
+                   for i, (actor, _) in enumerate(self._shards)]
+        ray_tpu.get([actor.reset.remote(tok, list(ops))
+                     for (actor, ops), (_, tok)
+                     in zip(self._shards, entries)])
+        return entries
+
     def gather_sync(self) -> Iterable[Any]:
         """Round-robin across shards, preserving per-shard order."""
-        ray_tpu.get([s.reset.remote() for s in self._shards])
-        live = list(self._shards)
-        while live:
-            nxt: List[Any] = []
-            for s in live:
-                out = ray_tpu.get(s.next_item.remote())
-                if out.get("stop"):
-                    continue
-                nxt.append(s)
-                yield out["item"]
-            live = nxt
+        entries = self._start()
+        live = list(entries)
+        try:
+            while live:
+                nxt: List[Any] = []
+                for s, tok in live:
+                    out = ray_tpu.get(s.next_item.remote(tok))
+                    if out.get("stop"):
+                        continue
+                    nxt.append((s, tok))
+                    yield out["item"]
+                live = nxt
+        finally:
+            for actor, tok in entries:
+                actor.drop.remote(tok)
 
     def gather_async(self) -> Iterable[Any]:
         """Yield from whichever shard finishes first (reference:
         gather_async's completion-order semantics via ray.wait)."""
-        ray_tpu.get([s.reset.remote() for s in self._shards])
-        pending = {s.next_item.remote(): s for s in self._shards}
-        while pending:
-            ready, _ = ray_tpu.wait(list(pending), num_returns=1)
-            ref = ready[0]
-            shard = pending.pop(ref)
-            out = ray_tpu.get(ref)
-            if out.get("stop"):
-                continue
-            pending[shard.next_item.remote()] = shard
-            yield out["item"]
+        entries = self._start()
+        pending = {s.next_item.remote(tok): (s, tok) for s, tok in entries}
+        try:
+            while pending:
+                ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+                ref = ready[0]
+                shard, tok = pending.pop(ref)
+                out = ray_tpu.get(ref)
+                if out.get("stop"):
+                    continue
+                pending[shard.next_item.remote(tok)] = (shard, tok)
+                yield out["item"]
+        finally:
+            for actor, tok in entries:
+                actor.drop.remote(tok)
 
     def take(self, n: int) -> List[Any]:
         return list(itertools.islice(self.gather_sync(), n))
 
     def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        """Concatenate shard sets; branches of one base may be unioned
+        (each gather keeps per-entry pipelines, so a shard actor shared
+        by both sides serves two independent token pipelines)."""
         return ParallelIterator(self._shards + other._shards)
 
 

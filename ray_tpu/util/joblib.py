@@ -27,7 +27,8 @@ def _run_batch(pickled_batch: bytes):
 
 class _RefFuture:
     """Future-like over an ObjectRef (joblib drives it via
-    add_done_callback + get)."""
+    add_done_callback + get); completion is delivered by the shared
+    dispatcher, not a thread per future."""
 
     def __init__(self, ref):
         self._ref = ref
@@ -36,11 +37,13 @@ class _RefFuture:
         self._result: Any = None
         self._exc: Optional[BaseException] = None
         self._done = threading.Event()
-        threading.Thread(target=self._wait, daemon=True).start()
+        _dispatcher().track(self)
 
-    def _wait(self):
+    def _complete(self):
         try:
-            self._result = ray_tpu.get(self._ref)
+            # the ref is wait()-ready; the short timeout only guards a
+            # ready-then-evicted race
+            self._result = ray_tpu.get(self._ref, timeout=30.0)
         except BaseException as e:  # noqa: BLE001 - surfaced via get()
             self._exc = e
         with self._lock:
@@ -64,6 +67,55 @@ class _RefFuture:
         return self._result
 
     result = get
+
+
+class _Dispatcher:
+    """One thread multiplexing completion of every outstanding batch via
+    ray_tpu.wait — hundreds of in-flight joblib batches cost one waiter,
+    not one blocked thread each."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: dict = {}   # ref -> _RefFuture
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ray-tpu-joblib-dispatch")
+        self._thread.start()
+
+    def track(self, fut: "_RefFuture") -> None:
+        with self._lock:
+            self._pending[fut._ref] = fut
+        self._wake.set()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                refs = list(self._pending)
+            if not refs:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
+            except Exception:
+                ready = []
+            for ref in ready:
+                with self._lock:
+                    fut = self._pending.pop(ref, None)
+                if fut is not None:
+                    fut._complete()
+
+
+_dispatcher_singleton: Optional[_Dispatcher] = None
+_dispatcher_lock = threading.Lock()
+
+
+def _dispatcher() -> _Dispatcher:
+    global _dispatcher_singleton
+    with _dispatcher_lock:
+        if _dispatcher_singleton is None:
+            _dispatcher_singleton = _Dispatcher()
+        return _dispatcher_singleton
 
 
 def register_ray_tpu() -> None:
@@ -92,7 +144,12 @@ def register_ray_tpu() -> None:
                 total = int(ray_tpu.cluster_resources().get("CPU", 1))
             except Exception:
                 total = 1
-            return total if n_jobs in (-1, None) else min(n_jobs, total)
+            if n_jobs is None:
+                return total
+            if n_jobs < 0:
+                # joblib convention: -1 = all, -2 = all but one, ...
+                return max(total + 1 + n_jobs, 1)
+            return min(n_jobs, total)
 
         def submit(self, func, callback=None):
             import cloudpickle

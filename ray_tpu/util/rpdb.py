@@ -16,10 +16,29 @@ import sys
 from typing import List, Optional, Tuple
 
 _NS = "rpdb"
+_trace_seq = 0
+
+
+def _node_ip() -> str:
+    """This host's outbound IP (UDP-connect trick; no packet is sent)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
 
 
 class _SocketPdb(pdb.Pdb):
-    """Pdb bound to an accepted TCP connection instead of stdio."""
+    """Pdb bound to an accepted TCP connection instead of stdio.
+
+    The session's fds are closed when the user detaches: on quit always,
+    and on continue when no breakpoints remain (tracing stops then, so
+    the prompt can never come back and the fds would otherwise leak —
+    one socket + one file object per breakpoint hit)."""
 
     def __init__(self, conn: socket.socket):
         self._conn = conn
@@ -34,6 +53,28 @@ class _SocketPdb(pdb.Pdb):
             self._conn.close()
         except OSError:
             pass
+
+    def do_continue(self, arg):
+        res = super().do_continue(arg)
+        if not self.breaks:
+            self.close()
+        return res
+
+    do_c = do_cont = do_continue
+
+    def do_quit(self, arg):
+        try:
+            return super().do_quit(arg)
+        finally:
+            self.close()
+
+    do_q = do_exit = do_quit
+
+    def do_EOF(self, arg):
+        try:
+            return super().do_EOF(arg)
+        finally:
+            self.close()
 
 
 def _announce(addr: Tuple[str, int], label: str) -> None:
@@ -70,11 +111,19 @@ def set_trace(frame=None, *, port: int = 0,
     """
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("127.0.0.1", port))
+    # bind all interfaces but ANNOUNCE this node's routable IP — the
+    # breakpoint may fire on a worker host while the operator connects
+    # from the head (reference rpdb advertises the node IP for this)
+    srv.bind(("0.0.0.0", port))
     srv.listen(1)
-    addr = srv.getsockname()
+    addr = (_node_ip(), srv.getsockname()[1])
     import os
-    label = f"pid-{os.getpid()}"
+    import threading
+    global _trace_seq
+    _trace_seq += 1
+    # unique per call: concurrent breakpoints in one process (threaded
+    # actors) must not overwrite / retract each other's announcements
+    label = f"pid-{os.getpid()}-t{threading.get_ident()}-{_trace_seq}"
     print(f"RPDB waiting on {addr[0]}:{addr[1]} "
           f"(connect: nc {addr[0]} {addr[1]})", file=sys.stderr, flush=True)
     _announce(addr, label)

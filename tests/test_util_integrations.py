@@ -120,3 +120,29 @@ def test_joblib_backend_runs_on_cluster(cluster):
         out = joblib.Parallel(n_jobs=4)(
             joblib.delayed(lambda x: x * x)(i) for i in range(10))
     assert out == [i * i for i in range(10)]
+
+
+def test_parallel_iterator_branching_is_immutable(cluster):
+    """Transforms return NEW iterators: branching one base must not
+    compound ops (reference iter.py semantics)."""
+    from ray_tpu.util import iter as par_iter
+
+    base = par_iter.from_range(10, num_shards=2)
+    evens = base.filter(lambda x: x % 2 == 0)
+    doubled = base.for_each(lambda x: x * 2)
+    assert sorted(doubled.gather_sync()) == [x * 2 for x in range(10)]
+    assert sorted(evens.gather_sync()) == [0, 2, 4, 6, 8]
+    assert sorted(base.gather_sync()) == list(range(10))
+    # interleaved gathers of branched views must not clobber each other
+    import itertools as it
+    out_e, out_d = [], []
+    for a, b in it.zip_longest(evens.gather_sync(), doubled.gather_sync()):
+        if a is not None:
+            out_e.append(a)
+        if b is not None:
+            out_d.append(b)
+    assert sorted(out_e) == [0, 2, 4, 6, 8]
+    assert sorted(out_d) == [x * 2 for x in range(10)]
+    # union of branches sharing shard actors: independent pipelines
+    u = sorted(evens.union(doubled).gather_sync())
+    assert u == sorted([0, 2, 4, 6, 8] + [x * 2 for x in range(10)])
