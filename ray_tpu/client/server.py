@@ -295,15 +295,17 @@ class ClientServer:
         return {"actor_id": handle._actor_id}
 
     def _h_xlang_kill_actor(self, conn, data):
-        handle = conn.peer_info.get("xlang_actors", {}).pop(
-            data["actor_id"], None)
-        if handle is None:
+        actors = conn.peer_info.get("xlang_actors", {})
+        if data["actor_id"] not in actors:
             return {"error": "unknown actor (created on this connection?)"}
         try:
             self.core.kill_actor(data["actor_id"],
                                  data.get("no_restart", True))
         except Exception as e:
+            # keep the handle: a failed kill must stay retryable (and the
+            # close-time sweep must still cover this actor)
             return {"error": f"{type(e).__name__}: {e}"}
+        actors.pop(data["actor_id"], None)
         return {"ok": True}
 
     def _h_xlang_actor_call(self, conn, data):

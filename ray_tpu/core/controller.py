@@ -225,8 +225,14 @@ class Controller:
     def _views(self) -> Dict[str, NodeView]:
         return {nid: rec.view for nid, rec in self.nodes.items()}
 
-    def _bump_view(self):
+    def _bump_view(self, node_id: Optional[str] = None):
+        """Advance the global Lamport counter; when a node is named, stamp
+        its view so delta syncs (``_h_heartbeat``) pick the change up."""
         self.view_version += 1
+        if node_id is not None:
+            rec = self.nodes.get(node_id)
+            if rec is not None:
+                rec.view.version = self.view_version
 
     async def _broadcast(self, channel: str, data: Any):
         """Buffered pub: events are coalesced per subscriber and flushed as
@@ -275,7 +281,7 @@ class Controller:
         self.nodes[data["node_id"]] = NodeRecord(view, conn)
         conn.peer_info["node_id"] = data["node_id"]
         conn.on_close = self._node_conn_closed
-        self._bump_view()
+        self._bump_view(data["node_id"])
         self.config_snapshot.update(data.get("config") or {})
         await self._broadcast("nodes", {"event": "added", "node": view.to_wire()})
         self._pending_actor_wakeup.set()
@@ -289,20 +295,36 @@ class Controller:
             asyncio.ensure_future(self._mark_node_dead(nid, "connection lost"))
 
     async def _h_heartbeat(self, conn, data):
+        """Resource report + versioned view sync in one round trip.
+
+        The reply carries only views stamped NEWER than the reporter's
+        high-water mark (``view_version`` it last applied) — the
+        versioned-delta design of the reference's RaySyncer
+        (`ray_syncer.h:75-88` NodeState versions) in place of its older
+        full-view broadcaster.  Availability changes bump the reporting
+        node's stamp, so peers see fresh utilization within one heartbeat
+        period instead of only at membership events."""
         nid = data["node_id"]
         rec = self.nodes.get(nid)
         if rec is None:
             return {"unknown_node": True}
         rec.last_heartbeat = time.monotonic()
-        rec.view.available = ResourceSet(data["available"])
-        rec.view.total = ResourceSet(data["total"])
+        new_avail = ResourceSet(data["available"])
+        new_total = ResourceSet(data["total"])
+        if (new_avail.to_dict() != rec.view.available.to_dict()
+                or new_total.to_dict() != rec.view.total.to_dict()):
+            rec.view.available = new_avail
+            rec.view.total = new_total
+            self._bump_view(nid)
         if not rec.view.alive:
             rec.view.alive = True
-            self._bump_view()
+            self._bump_view(nid)
         self._pending_actor_wakeup.set()
         reply: Dict[str, Any] = {"view_version": self.view_version}
-        if data.get("view_version", -1) != self.view_version:
-            reply["view"] = [v.to_wire() for v in self._views().values()]
+        known = data.get("view_version", -1)
+        if known != self.view_version:
+            reply["delta"] = [v.to_wire() for v in self._views().values()
+                              if v.version > known]
         return reply
 
     async def _h_get_cluster_view(self, conn, data):
@@ -329,7 +351,7 @@ class Controller:
         if rec is None or not rec.view.alive:
             return
         rec.view.alive = False
-        self._bump_view()
+        self._bump_view(node_id)
         self._emit_event("ERROR", "controller",
                          f"node {node_id[:12]} died: {reason}",
                          node_id=node_id)

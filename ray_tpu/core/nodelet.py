@@ -193,6 +193,19 @@ class Nodelet:
         self.view_version = version
         self._refresh_self_view()
 
+    def _apply_delta(self, delta_wire: List[dict], version: int):
+        """Merge a versioned delta (only the CHANGED node views ship —
+        reference: RaySyncer's per-node versioned sync vs the legacy
+        full-view broadcaster).  Per-view version guard keeps a stale
+        delta from clobbering a newer view."""
+        for d in delta_wire:
+            nv = NodeView.from_wire(d)
+            cur = self.view.get(nv.node_id)
+            if cur is None or nv.version >= cur.version:
+                self.view[nv.node_id] = nv
+        self.view_version = version
+        self._refresh_self_view()
+
     def _refresh_self_view(self):
         me = self.view.get(self.node_id.hex())
         if me is not None:
@@ -219,6 +232,8 @@ class Nodelet:
                 }, timeout=5)
                 if reply and "view" in reply:
                     self._apply_view(reply["view"], reply["view_version"])
+                elif reply and "delta" in reply:
+                    self._apply_delta(reply["delta"], reply["view_version"])
             except (rpc.RpcError, OSError):
                 pass
             await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
@@ -836,7 +851,10 @@ class Nodelet:
                 "workers": {w.worker_id.hex()[:8]: w.state
                             for w in self.workers.values()},
                 "leases": len(self.leases),
-                "available": self.available.to_dict()}
+                "available": self.available.to_dict(),
+                "view_version": self.view_version,
+                "cluster_view": {nid: v.to_wire()
+                                 for nid, v in self.view.items()}}
 
     # ------------------------------------------------- task/node observability
     async def _h_task_state(self, conn, data):

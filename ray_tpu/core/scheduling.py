@@ -17,27 +17,35 @@ from .task_spec import EPS, ResourceSet
 
 
 class NodeView:
-    __slots__ = ("node_id", "addr", "available", "total", "alive", "labels")
+    __slots__ = ("node_id", "addr", "available", "total", "alive", "labels",
+                 "version")
 
     def __init__(self, node_id: str, addr: str, available: Dict[str, float],
                  total: Dict[str, float], alive: bool = True,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 version: int = 0):
         self.node_id = node_id
         self.addr = addr
         self.available = ResourceSet(available)
         self.total = ResourceSet(total)
         self.alive = alive
         self.labels = labels or {}
+        # Lamport stamp of the last change to THIS node's view; the
+        # versioned syncer ships only views newer than the receiver's
+        # high-water mark (reference: RaySyncer per-node versioned views,
+        # src/ray/common/ray_syncer/ray_syncer.h:75-88).
+        self.version = version
 
     def to_wire(self):
         return {"id": self.node_id, "addr": self.addr,
                 "avail": self.available.to_dict(), "total": self.total.to_dict(),
-                "alive": self.alive, "labels": self.labels}
+                "alive": self.alive, "labels": self.labels,
+                "ver": self.version}
 
     @classmethod
     def from_wire(cls, d):
         return cls(d["id"], d["addr"], d["avail"], d["total"], d["alive"],
-                   d.get("labels"))
+                   d.get("labels"), d.get("ver", 0))
 
 
 def is_feasible(view: NodeView, request: ResourceSet) -> bool:

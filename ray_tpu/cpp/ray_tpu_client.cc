@@ -14,6 +14,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <cstring>
 #include <stdexcept>
 
@@ -30,6 +31,7 @@ void WriteAll(int fd, const char* data, size_t n) {
     // MSG_NOSIGNAL: a server-side disconnect must surface as the
     // exception below, not deliver SIGPIPE and kill the host process.
     ssize_t w = ::send(fd, data, n, MSG_NOSIGNAL);
+    if (w < 0 && errno == EINTR) continue;  // signal during send: retry
     if (w <= 0) throw std::runtime_error("ray_tpu: connection write failed");
     data += w;
     n -= size_t(w);
@@ -39,6 +41,7 @@ void WriteAll(int fd, const char* data, size_t n) {
 void ReadAll(int fd, char* data, size_t n) {
   while (n > 0) {
     ssize_t r = ::read(fd, data, n);
+    if (r < 0 && errno == EINTR) continue;  // signal during read: retry
     if (r <= 0) throw std::runtime_error("ray_tpu: connection closed");
     data += r;
     n -= size_t(r);

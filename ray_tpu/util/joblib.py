@@ -88,6 +88,7 @@ class _Dispatcher:
         self._wake.set()
 
     def _loop(self):
+        import time as _time
         while True:
             with self._lock:
                 refs = list(self._pending)
@@ -95,15 +96,34 @@ class _Dispatcher:
                 self._wake.wait(timeout=1.0)
                 self._wake.clear()
                 continue
+            if not ray_tpu.is_initialized():
+                # the cluster shut down under outstanding batches: fail
+                # them (ray_tpu.wait from this daemon thread would
+                # otherwise auto-BOOT a fresh cluster via init())
+                self._fail_all(RuntimeError(
+                    "ray_tpu shut down with joblib batches in flight"))
+                continue
             try:
                 ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
             except Exception:
+                _time.sleep(0.2)  # don't busy-spin a persistent failure
                 ready = []
             for ref in ready:
                 with self._lock:
                     fut = self._pending.pop(ref, None)
                 if fut is not None:
                     fut._complete()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            futs, self._pending = list(self._pending.values()), {}
+        for fut in futs:
+            fut._exc = exc
+            with fut._lock:
+                fut._done.set()
+                cbs, fut._cbs = fut._cbs, []
+            for cb in cbs:
+                cb(fut)
 
 
 _dispatcher_singleton: Optional[_Dispatcher] = None

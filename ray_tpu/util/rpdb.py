@@ -111,12 +111,16 @@ def set_trace(frame=None, *, port: int = 0,
     """
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    # bind all interfaces but ANNOUNCE this node's routable IP — the
-    # breakpoint may fire on a worker host while the operator connects
-    # from the head (reference rpdb advertises the node IP for this)
-    srv.bind(("0.0.0.0", port))
+    # Bind the node's routable IP (NOT all interfaces — an unauthenticated
+    # pdb socket is arbitrary code execution, so expose it no wider than
+    # the cluster network) and announce that address: the breakpoint may
+    # fire on a worker host while the operator connects from the head.
+    try:
+        srv.bind((_node_ip(), port))
+    except OSError:
+        srv.bind(("127.0.0.1", port))
     srv.listen(1)
-    addr = (_node_ip(), srv.getsockname()[1])
+    addr = srv.getsockname()
     import os
     import threading
     global _trace_seq

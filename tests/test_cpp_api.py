@@ -42,6 +42,9 @@ _CALLEE = textwrap.dedent('''
 @pytest.fixture(scope="module")
 def cpp_driver(tmp_path_factory):
     """Compile the C++ client + example driver once."""
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
     build = tmp_path_factory.mktemp("cppbuild")
     binary = build / "example_driver"
     srcs = [os.path.join(_CPP_DIR, "ray_tpu_client.cc"),
@@ -61,6 +64,7 @@ def test_cpp_driver_end_to_end(cpp_driver, tmp_path):
     old_pp = os.environ.get("PYTHONPATH", "")
     os.environ["PYTHONPATH"] = f"{mod_dir}{os.pathsep}{old_pp}"
     sys.path.insert(0, str(mod_dir))
+    srv = None
     try:
         ray_tpu.init(num_cpus=2)
         from ray_tpu.client.server import ClientServer
@@ -73,8 +77,9 @@ def test_cpp_driver_end_to_end(cpp_driver, tmp_path):
         assert "CPP_DRIVER_OK" in out.stdout, \
             f"stdout:\n{out.stdout}\nstderr:\n{out.stderr}"
         assert "FAIL" not in out.stdout
-        srv.stop()
     finally:
+        if srv is not None:
+            srv.stop()
         sys.path.remove(str(mod_dir))
         os.environ["PYTHONPATH"] = old_pp
         ray_tpu.shutdown()
