@@ -1,33 +1,152 @@
 """Per-task/actor runtime environments.
 
-Capability mirror of the reference's runtime-env plugins
-(`python/ray/_private/runtime_env/` — env_vars, working_dir, py_modules;
-agent handler `dashboard/modules/runtime_env/runtime_env_agent.py:160`).
-This image forbids package installation, so pip/conda specs validate but
-raise; env_vars / working_dir / py_modules apply in-worker.  Tasks restore
-the previous environment afterwards; actors keep theirs for life (the
-reference dedicates workers per env hash — same observable behavior).
+Capability mirror of the reference's runtime-env stack
+(`python/ray/_private/runtime_env/` plugins — env_vars, working_dir,
+py_modules, pip, conda, container — created on demand by the per-node
+agent (`dashboard/modules/runtime_env/runtime_env_agent.py:160,257`) and
+cached by content-hash URI).  Here the same shape, node-local:
+
+* **env_vars / working_dir / py_modules** apply in-worker and undo after
+  the task (actors keep theirs for life — the reference dedicates
+  workers per env hash; same observable behavior).
+* **pip** is a real plugin: the spec hashes to a URI, the first user
+  builds a venv under the node's runtime-env cache and installs the
+  requested packages OFFLINE (``--no-index``; wheels come from the
+  spec's ``find_links`` directory — this deployment has no package
+  index egress), later users reuse the cached env, and workers prepend
+  the env's site-packages to ``sys.path``.  Creation is concurrency-safe
+  (atomic rename of a staging dir).
+* **conda / container** validate but raise: neither a conda binary nor
+  a container runtime exists in this image; the error says so instead
+  of failing deep in a worker.
 """
 
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 import os
+import shutil
+import subprocess
 import sys
-from typing import Any, Dict
+import sysconfig
+import tempfile
+from typing import Any, Dict, List, Optional
 
-SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+             "container"}
 
 
 def validate(env: Dict[str, Any]) -> None:
     unknown = set(env) - SUPPORTED
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
-    if env.get("pip") or env.get("conda"):
+    if env.get("conda"):
         raise RuntimeError(
-            "pip/conda runtime envs require package installation, which "
-            "this deployment forbids; pre-bake dependencies in the image")
+            "conda runtime envs need a conda binary, which this image "
+            "does not ship; use the pip plugin (offline wheels) or "
+            "pre-bake dependencies")
+    if env.get("container"):
+        raise RuntimeError(
+            "container runtime envs need a container runtime, which this "
+            "image does not ship")
+    pip = env.get("pip")
+    if pip is not None:
+        spec = _pip_spec(pip)
+        if spec["packages"] and not spec["find_links"]:
+            raise RuntimeError(
+                "pip runtime envs install OFFLINE (no package-index "
+                "egress): provide {'packages': [...], 'find_links': "
+                "'<dir with wheels>'}")
 
+
+# ----------------------------------------------------------------- pip plugin
+
+def _pip_spec(pip: Any) -> Dict[str, Any]:
+    """Normalize 'pip' forms: list of requirements, or
+    {packages: [...], find_links: dir}."""
+    if isinstance(pip, (list, tuple)):
+        return {"packages": list(pip), "find_links": None}
+    if isinstance(pip, dict):
+        return {"packages": list(pip.get("packages", [])),
+                "find_links": pip.get("find_links")}
+    raise ValueError(f"pip spec must be a list or dict, got {type(pip)}")
+
+
+def _cache_root() -> str:
+    base = os.environ.get("RAY_TPU_SESSION_DIR") or tempfile.gettempdir()
+    path = os.path.join(base, "runtime_envs")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def pip_env_uri(pip: Any) -> str:
+    """Content-hash URI for a pip spec (reference: URI-keyed cache so
+    equal specs share one env)."""
+    spec = _pip_spec(pip)
+    blob = json.dumps(spec, sort_keys=True).encode()
+    return "pip-" + hashlib.sha256(blob).hexdigest()[:16]
+
+
+def ensure_pip_env(pip: Any) -> str:
+    """Create-or-reuse the venv for a pip spec; returns its
+    site-packages path.  Safe under concurrent creators: the env builds
+    in a staging dir and lands via atomic rename."""
+    spec = _pip_spec(pip)
+    uri = pip_env_uri(pip)
+    env_dir = os.path.join(_cache_root(), uri)
+    site = _site_packages(env_dir)
+    if os.path.isfile(os.path.join(env_dir, ".ready")):
+        return site
+    staging = tempfile.mkdtemp(prefix=uri + ".build-", dir=_cache_root())
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages",
+             staging], check=True, capture_output=True, timeout=300)
+        if spec["packages"]:
+            cmd = [os.path.join(staging, "bin", "python"), "-m", "pip",
+                   "install", "--no-index", "--quiet",
+                   "--find-links", spec["find_links"], *spec["packages"]]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip env {uri} install failed:\n{proc.stderr[-2000:]}")
+        open(os.path.join(staging, ".ready"), "w").close()
+        try:
+            os.rename(staging, env_dir)
+        except OSError:
+            # lost the race: another creator landed the same URI first
+            shutil.rmtree(staging, ignore_errors=True)
+        return site
+    except Exception:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+
+
+def _site_packages(env_dir: str) -> str:
+    v = sysconfig.get_python_version()
+    return os.path.join(env_dir, "lib", f"python{v}", "site-packages")
+
+
+def list_cached_uris() -> List[str]:
+    """URIs with a ready env in this node's cache (observability)."""
+    root = _cache_root()
+    return sorted(d for d in os.listdir(root)
+                  if os.path.isfile(os.path.join(root, d, ".ready")))
+
+
+def delete_uri(uri: str) -> bool:
+    """Evict one cached env (reference: URI cache GC)."""
+    path = os.path.join(_cache_root(), uri)
+    if not os.path.isdir(path):
+        return False
+    shutil.rmtree(path, ignore_errors=True)
+    return True
+
+
+# ------------------------------------------------------------- apply/restore
 
 def apply(env: Dict[str, Any]) -> Dict[str, Any]:
     """Apply; returns an undo record for `restore`."""
@@ -40,7 +159,10 @@ def apply(env: Dict[str, Any]) -> Dict[str, Any]:
     if wd:
         undo["cwd"] = os.getcwd()
         os.chdir(wd)
-    mods = env.get("py_modules")
+    mods = list(env.get("py_modules") or [])
+    pip = env.get("pip")
+    if pip is not None:
+        mods.append(ensure_pip_env(pip))
     if mods:
         undo["sys_path"] = list(sys.path)
         for m in mods:

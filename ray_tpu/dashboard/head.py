@@ -122,7 +122,21 @@ class DashboardHead:
             from .. import state
             return state.list_events()
 
+        def fire_workflow_event(request):
+            # the HTTP event provider role (reference: workflow's HTTP
+            # event listener): POST /api/workflow_events/<name> with an
+            # optional JSON payload unblocks waiting workflow steps
+            from ..workflow import events as wf_events
+            raw = asyncio.run_coroutine_threadsafe(
+                request.read(), loop).result(timeout=10)
+            payload = json.loads(raw) if raw else None
+            name = request.match_info["name"]
+            wf_events.trigger_event(name, payload)
+            return {"fired": name}
+
         app.router.add_get("/api/events", blocking(events))
+        app.router.add_post("/api/workflow_events/{name}",
+                            blocking(fire_workflow_event))
         app.router.add_get("/api/objects", blocking(objects))
         app.router.add_get("/api/tasks", blocking(tasks))
         app.router.add_get("/api/memory", blocking(memory))

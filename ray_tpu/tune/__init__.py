@@ -28,6 +28,11 @@ from .schedulers import (  # noqa: F401
     MedianStoppingRule,
     PopulationBasedTraining,
 )
-from .search import BasicVariantGenerator, OptunaSearch, Searcher  # noqa: F401
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    OptunaSearch,
+    Searcher,
+    TPESearch,
+)
 from .trial import Trial  # noqa: F401
 from .tuner import TuneConfig, Tuner, run  # noqa: F401

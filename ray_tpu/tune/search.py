@@ -81,6 +81,131 @@ class BasicVariantGenerator(Searcher):
         return cfg
 
 
+class TPESearch(Searcher):
+    """Dependency-free Tree-structured Parzen Estimator.
+
+    The reference ships many model-based searchers behind optional
+    libraries (`tune/search/{optuna,hyperopt,bayesopt}/`); this is the
+    in-tree model-based option with zero dependencies (numpy only).
+    Public TPE recipe: split observations at the ``gamma`` quantile into
+    good/bad sets, model each numeric dimension with a Gaussian KDE per
+    set (log-space for LogUniform/log-Randint), draw candidates from the
+    GOOD model and keep the candidate maximizing the good/bad density
+    ratio; categoricals use smoothed count ratios.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", seed: Optional[int] = 0,
+                 n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24):
+        super().__init__(metric=metric, mode=mode)
+        self.rng = np.random.default_rng(seed)
+        grids, self.domains, self.consts = _split_spec(param_space)
+        if grids:
+            raise ValueError("TPESearch does not combine with grid_search; "
+                             "use BasicVariantGenerator for grids")
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._history: List[tuple] = []   # (config, objective-to-minimize)
+
+    # -- per-domain transforms ---------------------------------------------
+    @staticmethod
+    def _to_unit(dom, v: float) -> float:
+        from .sample import LogUniform, Randint
+        if isinstance(dom, LogUniform) or (isinstance(dom, Randint)
+                                           and dom.log):
+            return float(np.log(v))
+        return float(v)
+
+    @staticmethod
+    def _from_unit(dom, u: float):
+        from .sample import LogUniform, Normal, Randint, Uniform
+        if isinstance(dom, LogUniform):
+            return float(np.clip(np.exp(u), dom.low, dom.high))
+        if isinstance(dom, Randint):
+            v = int(round(np.exp(u))) if dom.log else int(round(u))
+            v = max(dom.low, min(dom.high - 1, v))
+            return (v // dom.q) * dom.q if dom.q > 1 else v
+        if isinstance(dom, Uniform):
+            v = float(np.clip(u, dom.low, dom.high))
+            return round(v / dom.q) * dom.q if dom.q else v
+        if isinstance(dom, Normal):
+            return float(u)
+        return float(u)
+
+    def _kde_sample_and_score(self, dom, good: List[float],
+                              bad: List[float]):
+        """Draw candidates from the good-set KDE; return the argmax of
+        good/bad density ratio (all in transformed space)."""
+        g = np.asarray([self._to_unit(dom, v) for v in good])
+        b = np.asarray([self._to_unit(dom, v) for v in bad])
+        spread = max(g.std(), 1e-3 * (abs(g.mean()) + 1.0))
+        bw = spread * (len(g) ** -0.2) + 1e-6
+        centers = self.rng.choice(g, size=self.n_candidates)
+        cands = centers + self.rng.normal(0, bw, size=self.n_candidates)
+
+        def kde(x, pts, h):
+            d = (x[:, None] - pts[None, :]) / h
+            return np.exp(-0.5 * d * d).sum(axis=1) / (len(pts) * h)
+
+        lg = kde(cands, g, bw)
+        lb = kde(cands, b, bw) if len(b) else np.full_like(lg, 1e-12)
+        best = cands[int(np.argmax(lg / (lb + 1e-12)))]
+        return self._from_unit(dom, best)
+
+    def _pick_categorical(self, dom, good: List[Any], bad: List[Any]):
+        scores = []
+        for c in dom.categories:
+            gc = sum(1 for v in good if v == c) + 1.0
+            bc = sum(1 for v in bad if v == c) + 1.0
+            scores.append(gc / bc)
+        p = np.asarray(scores) / sum(scores)
+        return dom.categories[int(self.rng.choice(len(dom.categories),
+                                                  p=p))]
+
+    # -- Searcher interface -------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        from .sample import Categorical, Function
+        cfg = dict(self.consts)
+        startup = len(self._history) < self.n_startup
+        if not startup:
+            cut = max(1, int(np.ceil(self.gamma * len(self._history))))
+            ranked = sorted(self._history, key=lambda t: t[1])
+            good_cfgs = [c for c, _ in ranked[:cut]]
+            bad_cfgs = [c for c, _ in ranked[cut:]] or good_cfgs
+        for k, dom in self.domains.items():
+            if isinstance(dom, Function):
+                continue  # resolved after the other keys
+            if startup:
+                cfg[k] = dom.sample(self.rng)
+            elif isinstance(dom, Categorical):
+                cfg[k] = self._pick_categorical(
+                    dom, [c[k] for c in good_cfgs],
+                    [c[k] for c in bad_cfgs])
+            else:
+                cfg[k] = self._kde_sample_and_score(
+                    dom, [c[k] for c in good_cfgs],
+                    [c[k] for c in bad_cfgs])
+        for k, dom in self.domains.items():
+            if isinstance(dom, Function):
+                cfg[k] = dom.fn(cfg)
+        self._live[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False):
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        if self.mode == "max":
+            val = -val
+        self._history.append((cfg, val))
+
+
 class OptunaSearch(Searcher):
     """TPE suggestion via optuna (reference:
     `tune/search/optuna/optuna_search.py`); requires optuna installed."""

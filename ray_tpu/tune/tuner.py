@@ -14,6 +14,7 @@ import os
 import shutil
 import tempfile
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import api
@@ -175,13 +176,24 @@ class _TrialRunner:
 
     # -- event loop ---------------------------------------------------------
     def run(self) -> List[Trial]:
+        # Model-based searchers (TPE/Optuna) suggest forever; num_samples
+        # is the trial budget for them.  BasicVariantGenerator knows its
+        # own exhaustion point (total_trials already folds num_samples in).
+        max_trials = getattr(self.searcher, "total_trials",
+                             self.cfg.num_samples)
         while True:
             # refill to concurrency
-            while len(self.running) < self.cfg.max_concurrent_trials:
-                cfg = self.searcher.suggest(f"t{len(self.trials)}")
+            while len(self.running) < self.cfg.max_concurrent_trials \
+                    and len(self.trials) < max_trials:
+                # suggest under the trial's OWN id: on_trial_result /
+                # on_trial_complete use trial.trial_id, and model-based
+                # searchers (TPE/Optuna) key their live-trial state on the
+                # suggest-time id — a mismatch silently drops feedback
+                tid = f"t{len(self.trials)}_{uuid.uuid4().hex[:6]}"
+                cfg = self.searcher.suggest(tid)
                 if cfg is None:
                     break
-                trial = Trial(config=cfg)
+                trial = Trial(config=cfg, trial_id=tid)
                 self.trials.append(trial)
                 self._launch(trial)
             if not self.running:

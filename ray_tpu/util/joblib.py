@@ -46,6 +46,13 @@ class _RefFuture:
             self._result = ray_tpu.get(self._ref, timeout=30.0)
         except BaseException as e:  # noqa: BLE001 - surfaced via get()
             self._exc = e
+        self._finish()
+
+    def _fail(self, exc: BaseException):
+        self._exc = exc
+        self._finish()
+
+    def _finish(self):
         with self._lock:
             self._done.set()
             cbs, self._cbs = self._cbs, []
@@ -118,12 +125,7 @@ class _Dispatcher:
         with self._lock:
             futs, self._pending = list(self._pending.values()), {}
         for fut in futs:
-            fut._exc = exc
-            with fut._lock:
-                fut._done.set()
-                cbs, fut._cbs = fut._cbs, []
-            for cb in cbs:
-                cb(fut)
+            fut._fail(exc)
 
 
 _dispatcher_singleton: Optional[_Dispatcher] = None

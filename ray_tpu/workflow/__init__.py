@@ -17,4 +17,11 @@ from .api import (  # noqa: F401
     resume_all,
     run,
 )
+from .events import (  # noqa: F401
+    EventListener,
+    KVEventListener,
+    clear_event,
+    trigger_event,
+    wait_for_event,
+)
 from .storage import WorkflowStorage  # noqa: F401

@@ -1,0 +1,109 @@
+"""Workflow events: durable steps that wait for external signals.
+
+Capability mirror of the reference's workflow event system
+(`workflow/event_listener.py` EventListener ABC + HTTP event provider,
+`workflow/api.py wait_for_event`): a workflow step can block until an
+external event fires, and because the step's result (the event payload)
+persists like any other step, a resumed workflow replays the payload
+instead of waiting again.
+
+The built-in provider signals through the controller KV (namespace
+``wf_events``): any driver/task calls :func:`trigger_event`, and the
+dashboard head exposes ``POST /api/workflow_events/<name>`` (the
+HTTP-provider role) so external systems can fire events with a plain
+HTTP call.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import cloudpickle
+
+_NS = "wf_events"
+
+
+class EventListener:
+    """Poll-based listener ABC (reference: workflow EventListener).
+
+    Subclasses implement :meth:`poll`, returning ``None`` while the
+    event has not fired and the payload (any picklable value; ``None``
+    payloads are represented by returning ``(True, None)`` from
+    :meth:`poll_with_flag`) once it has.
+    """
+
+    def poll(self) -> Optional[Any]:
+        raise NotImplementedError
+
+    def poll_with_flag(self) -> tuple:
+        """→ (fired, payload); override when None is a valid payload."""
+        payload = self.poll()
+        return (payload is not None), payload
+
+
+class KVEventListener(EventListener):
+    """Event signaled via the controller KV (cluster-wide, durable for
+    the controller's lifetime + snapshots)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def poll_with_flag(self) -> tuple:
+        from ..api import _ensure_initialized
+        core = _ensure_initialized()
+        raw = core.controller.call(
+            "kv_get", {"ns": _NS, "key": self.name.encode()})
+        if not raw:
+            return False, None
+        return True, cloudpickle.loads(raw)
+
+    def poll(self) -> Optional[Any]:
+        fired, payload = self.poll_with_flag()
+        return payload if fired else None
+
+
+def trigger_event(name: str, payload: Any = None) -> None:
+    """Fire an event: every workflow step waiting on ``name`` unblocks
+    with ``payload``."""
+    from ..api import _ensure_initialized
+    core = _ensure_initialized()
+    core.controller.call("kv_put", {
+        "ns": _NS, "key": name.encode(),
+        "value": cloudpickle.dumps(payload)})
+
+
+def clear_event(name: str) -> None:
+    from ..api import _ensure_initialized
+    core = _ensure_initialized()
+    core.controller.call("kv_del", {"ns": _NS, "key": name.encode()})
+
+
+def wait_for_event(listener: Any, *, poll_interval_s: float = 0.2,
+                   timeout_s: Optional[float] = None):
+    """A DAG node that completes when the event fires, yielding its
+    payload.  ``listener`` is an :class:`EventListener` instance or a
+    plain string (KV event name).  Durable: the payload persists as the
+    step's result, so resume replays it without re-waiting."""
+    from .. import api
+
+    if isinstance(listener, str):
+        listener = KVEventListener(listener)
+
+    @api.remote
+    def _wait_for_event_step(pickled_listener: bytes,
+                             interval: float,
+                             timeout: Optional[float]):
+        lst = cloudpickle.loads(pickled_listener)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            fired, payload = lst.poll_with_flag()
+            if fired:
+                return payload
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"event did not fire within {timeout}s")
+            time.sleep(interval)
+
+    return _wait_for_event_step.bind(cloudpickle.dumps(listener),
+                                     poll_interval_s, timeout_s)

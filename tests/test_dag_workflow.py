@@ -108,3 +108,52 @@ def test_workflow_delete(cluster, tmp_path):
     workflow.run(one.bind(), workflow_id="w3")
     workflow.delete("w3")
     assert all(wid != "w3" for wid, _ in workflow.list_all())
+
+
+def test_workflow_wait_for_event(cluster):
+    """A workflow step blocks on an external event and resumes with its
+    payload; once fired, the payload is durable (reference: workflow
+    event listeners)."""
+    import threading
+    import time
+
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def combine(evt_payload, base):
+        return f"{base}:{evt_payload}"
+
+    name = "test_evt_" + str(time.time_ns())
+    workflow.clear_event(name)
+    dag = combine.bind(workflow.wait_for_event(name, timeout_s=30.0),
+                       "got")
+
+    def fire():
+        time.sleep(1.0)
+        workflow.trigger_event(name, "payload42")
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    wid = "wf_evt_test"
+    workflow.delete(wid)
+    out = workflow.run(dag, workflow_id=wid)
+    assert out == "got:payload42"
+    t.join()
+    # durable: resume replays the persisted payload without re-waiting
+    workflow.clear_event(name)
+    assert workflow.resume(wid) == "got:payload42"
+
+
+def test_workflow_event_timeout(cluster):
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def ident(x):
+        return x
+
+    dag = ident.bind(workflow.wait_for_event(
+        "never_fires_" + str(__import__("time").time_ns()),
+        timeout_s=1.0, poll_interval_s=0.1))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf_evt_timeout")
+    workflow.delete("wf_evt_timeout")

@@ -103,7 +103,9 @@ def test_runtime_env_actor_keeps_env(cluster):
     assert ray_tpu.get(a.read.remote(), timeout=60.0) == "life"
 
 
-def test_runtime_env_pip_rejected(cluster):
+def test_runtime_env_pip_without_wheels_rejected(cluster):
+    """Index-based installs need egress this deployment forbids: the
+    validation error must be immediate and explicit."""
     @ray_tpu.remote
     def f():
         return 1
@@ -111,3 +113,74 @@ def test_runtime_env_pip_rejected(cluster):
     with pytest.raises(Exception):
         ray_tpu.get(f.options(
             runtime_env={"pip": ["requests"]}).remote(), timeout=60.0)
+
+
+def _make_wheel(dirpath, name, version, module_source):
+    """Craft a minimal pure-python wheel offline (a wheel is a zip with
+    dist-info metadata)."""
+    import os
+    import zipfile
+    fname = f"{name}-{version}-py3-none-any.whl"
+    path = os.path.join(str(dirpath), fname)
+    di = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr(f"{name}.py", module_source)
+        z.writestr(f"{di}/METADATA",
+                   f"Metadata-Version: 2.1\nName: {name}\n"
+                   f"Version: {version}\n")
+        z.writestr(f"{di}/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: test\n"
+                   "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        z.writestr(f"{di}/RECORD",
+                   f"{name}.py,,\n{di}/METADATA,,\n"
+                   f"{di}/WHEEL,,\n{di}/RECORD,,\n")
+    return path
+
+
+def test_runtime_env_pip_offline_wheels(cluster, tmp_path):
+    """The pip plugin builds a cached venv from LOCAL wheels (--no-index)
+    and tasks see the installed package (reference: runtime_env/pip.py
+    creating per-URI virtualenvs)."""
+    import importlib.util
+    _make_wheel(tmp_path, "rt_probe_pkg", "0.3",
+                "VALUE = 7\n\ndef double(x):\n    return 2 * x\n")
+
+    # the package must NOT leak into workers outside the env
+    @ray_tpu.remote
+    def absent():
+        import importlib.util as iu
+        return iu.find_spec("rt_probe_pkg") is None
+
+    assert ray_tpu.get(absent.remote(), timeout=60.0)
+    assert importlib.util.find_spec("rt_probe_pkg") is None
+
+    @ray_tpu.remote
+    def probe():
+        import rt_probe_pkg
+        return rt_probe_pkg.VALUE, rt_probe_pkg.double(5)
+
+    env = {"pip": {"packages": ["rt_probe_pkg"],
+                   "find_links": str(tmp_path)}}
+    assert ray_tpu.get(probe.options(runtime_env=env).remote(),
+                       timeout=120.0) == (7, 10)
+    # second use hits the URI cache (same env dir, no rebuild)
+    assert ray_tpu.get(probe.options(runtime_env=env).remote(),
+                       timeout=120.0) == (7, 10)
+    from ray_tpu.core import runtime_env as re_mod
+    assert re_mod.pip_env_uri(env["pip"]) in re_mod.list_cached_uris()
+
+
+def test_dashboard_http_event_provider(dashboard):
+    """POST /api/workflow_events/<name> fires a workflow event (the HTTP
+    event-provider role of the reference's workflow event system)."""
+    from ray_tpu import workflow
+    addr = dashboard.address
+    name = "http_evt_test"
+    workflow.clear_event(name)
+    r = requests.post(f"{addr}/api/workflow_events/{name}",
+                      data=json.dumps({"k": 5}), timeout=10)
+    assert r.status_code == 200 and r.json()["fired"] == name
+    from ray_tpu.workflow.events import KVEventListener
+    fired, payload = KVEventListener(name).poll_with_flag()
+    assert fired and payload == {"k": 5}
+    workflow.clear_event(name)

@@ -341,4 +341,3 @@ def test_ref_counting_frees_memory(cluster):
     time.sleep(0.5)  # frees propagate asynchronously
     # No assertion on store internals; just verify the system stays healthy.
     assert ray_tpu.get(ray_tpu.put(1)) == 1
-

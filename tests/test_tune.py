@@ -147,3 +147,51 @@ def test_median_stopping(cluster, tmp_path):
     histories = sorted(len(grid[i].metrics_history)
                        for i in range(len(grid)))
     assert histories[0] < 6  # the 0.0 trial stopped before finishing
+
+
+def test_tpe_search_beats_random_on_quadratic():
+    """TPESearch (in-tree, numpy-only) concentrates samples near the
+    optimum of a known objective (reference role: tune/search model-based
+    searchers).  Pure searcher test: no cluster needed."""
+    from ray_tpu.tune.search import TPESearch
+
+    def f(x):
+        return (x - 3.0) ** 2
+
+    tpe = TPESearch({"x": tune.uniform(-10, 10)}, metric="loss",
+                    mode="min", seed=0, n_startup=8)
+    rng = np.random.default_rng(0)
+    tpe_best, rand_best = float("inf"), float("inf")
+    for i in range(40):
+        cfg = tpe.suggest(f"t{i}")
+        loss = f(cfg["x"])
+        tpe.on_trial_complete(f"t{i}", {"loss": loss})
+        tpe_best = min(tpe_best, loss)
+        rand_best = min(rand_best, f(float(rng.uniform(-10, 10))))
+    assert tpe_best < 0.5, f"TPE did not converge: best={tpe_best}"
+    assert tpe_best <= rand_best, (tpe_best, rand_best)
+
+
+def test_tpe_with_tuner(cluster, tmp_path):
+    """num_samples bounds a model-based searcher's trial budget."""
+    from ray_tpu.tune.search import TPESearch
+
+    def objective(config):
+        session.report({"loss": (config["x"] - 2.0) ** 2})
+
+    space = {"x": tune.uniform(-5, 5)}
+    searcher = TPESearch(space, metric="loss", mode="min", seed=1,
+                         n_startup=4)
+    res = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=12,
+                               max_concurrent_trials=3,
+                               search_alg=searcher),
+        run_config=RunConfig(name="tpe", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(res) == 12
+    # feedback actually reached the searcher (trial-id plumbing): without
+    # it TPE silently degrades to random sampling
+    assert len(searcher._history) == 12
+    assert res.get_best_result().metrics["loss"] < 4.0
