@@ -7,6 +7,7 @@ Mirrors the capability set of the reference's `python/ray/air/`
 mesh specs, checkpoints hold jax pytrees natively.
 """
 
+from .batch_predictor import BatchPredictor  # noqa: F401
 from .checkpoint import Checkpoint  # noqa: F401
 from .config import (  # noqa: F401
     CheckpointConfig,

@@ -165,6 +165,14 @@ def apply(env: Dict[str, Any]) -> Dict[str, Any]:
         mods.append(ensure_pip_env(pip))
     if mods:
         undo["sys_path"] = list(sys.path)
+        # sys.path restore alone is not isolation: modules imported FROM
+        # the env would stay cached in sys.modules and leak into later
+        # tasks (wrong version, or a package the next env never asked
+        # for).  Snapshot module names so restore can evict exactly the
+        # env-sourced imports (reference: dedicated workers per env hash
+        # give the same guarantee by construction).
+        undo["mod_snapshot"] = set(sys.modules)
+        undo["env_paths"] = [os.path.abspath(m) for m in mods]
         for m in mods:
             sys.path.insert(0, m)
     return undo
@@ -180,6 +188,15 @@ def restore(undo: Dict[str, Any]) -> None:
         os.chdir(undo["cwd"])
     if undo["sys_path"] is not None:
         sys.path[:] = undo["sys_path"]
+    snapshot = undo.get("mod_snapshot")
+    if snapshot is not None:
+        paths = undo.get("env_paths", [])
+        for name in set(sys.modules) - snapshot:
+            mod = sys.modules.get(name)
+            f = getattr(mod, "__file__", None) or ""
+            if f and any(f.startswith(p + os.sep) or f.startswith(p)
+                         for p in paths):
+                del sys.modules[name]
 
 
 @contextlib.contextmanager

@@ -134,9 +134,16 @@ class DashboardHead:
             wf_events.trigger_event(name, payload)
             return {"fired": name}
 
+        def serve_deployments(_):
+            # serve REST role (reference: serve REST schema + CLI status)
+            from ..serve.api import status_table
+            return status_table()
+
         app.router.add_get("/api/events", blocking(events))
         app.router.add_post("/api/workflow_events/{name}",
                             blocking(fire_workflow_event))
+        app.router.add_get("/api/serve/deployments",
+                           blocking(serve_deployments))
         app.router.add_get("/api/objects", blocking(objects))
         app.router.add_get("/api/tasks", blocking(tasks))
         app.router.add_get("/api/memory", blocking(memory))

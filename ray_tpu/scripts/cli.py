@@ -90,6 +90,16 @@ def cmd_status(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_serve_status(args) -> None:
+    """Deployment table of the running Serve instance (reference:
+    `serve status` CLI)."""
+    import ray_tpu
+    from ray_tpu.serve.api import status_table
+    _connect(args)
+    print(json.dumps(status_table(), indent=2, default=str))
+    ray_tpu.shutdown()
+
+
 def cmd_list(args) -> None:
     import ray_tpu
     from ray_tpu import state
@@ -225,6 +235,10 @@ def main(argv=None) -> None:
     sp = sub.add_parser("status", help="cluster summary")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("serve-status", help="Serve deployment table")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_status)
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors",

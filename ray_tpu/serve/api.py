@@ -100,6 +100,19 @@ def list_deployments() -> Dict[str, dict]:
                         timeout=30.0)
 
 
+def status_table() -> Dict[str, dict]:
+    """Deployment table via the NAMED controller, so any driver — the
+    dashboard head, the CLI — reports Serve state without having started
+    Serve itself (reference: serve REST status / `serve status` CLI)."""
+    if "controller" in _state:
+        return list_deployments()
+    try:
+        h = core_api.get_actor("serve::controller")
+    except ValueError:
+        return {}  # no Serve instance in this cluster
+    return core_api.get(h.list_deployments.remote(), timeout=10.0)
+
+
 def http_address() -> Optional[str]:
     return _state.get("http_address")
 

@@ -1,0 +1,129 @@
+"""SklearnTrainer: scikit-learn estimators on the cluster.
+
+Capability mirror of the reference's SklearnTrainer
+(`python/ray/train/sklearn/sklearn_trainer.py` — fit on a dataset with
+cluster-parallelized cross-validation scoring) and GBDTTrainer shape
+(`train/gbdt_trainer.py` — here gated: xgboost/lightgbm are not in this
+image).  The estimator fits in one task (sklearn is in-memory); CV folds
+fan out as parallel tasks; the fitted estimator ships back as an
+`air.Checkpoint`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..air.checkpoint import Checkpoint
+from ..air.config import RunConfig, ScalingConfig
+from ..air.result import Result
+
+
+def _to_xy(dataset: Any, label_column: str):
+    """Accepts a ray_tpu.data Dataset or a pandas DataFrame."""
+    import pandas as pd
+    if hasattr(dataset, "to_pandas"):
+        df = dataset.to_pandas()
+    elif isinstance(dataset, pd.DataFrame):
+        df = dataset
+    else:
+        raise TypeError(f"dataset must be a Dataset or DataFrame, "
+                        f"got {type(dataset)}")
+    y = df[label_column].to_numpy()
+    X = df.drop(columns=[label_column]).to_numpy()
+    return X, y
+
+
+class SklearnTrainer:
+    """Fit an sklearn estimator; optional parallel cross-validation.
+
+    ``datasets={"train": ds, "valid": ds2}``: the train split fits the
+    estimator, every other split reports ``score()`` metrics.  With
+    ``cv=k``, k folds score in parallel tasks across the cluster before
+    the final full fit — the reference's parallelize_cv behavior.
+    """
+
+    def __init__(self, estimator: Any, *, datasets: Dict[str, Any],
+                 label_column: str, cv: Optional[int] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        if "train" not in datasets:
+            raise ValueError("datasets must contain a 'train' split")
+        self.estimator = estimator
+        self.datasets = datasets
+        self.label_column = label_column
+        self.cv = cv
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        import cloudpickle
+
+        from .. import api
+
+        label = self.label_column
+        est_blob = cloudpickle.dumps(self.estimator)
+        Xy = {name: _to_xy(ds, label) for name, ds in self.datasets.items()}
+
+        @api.remote
+        def _fit_full(est_blob: bytes, X, y):
+            import cloudpickle as cp
+            est = cp.loads(est_blob)
+            est.fit(X, y)
+            return cp.dumps(est)
+
+        @api.remote
+        def _score_fold(est_blob: bytes, X, y, train_idx, test_idx):
+            import cloudpickle as cp
+            est = cp.loads(est_blob)
+            est.fit(X[train_idx], y[train_idx])
+            return float(est.score(X[test_idx], y[test_idx]))
+
+        metrics: Dict[str, Any] = {}
+        X_train, y_train = Xy["train"]
+        # one object-store upload feeds the full fit AND every CV fold
+        # (passing the arrays positionally would re-serialize them per
+        # task: cv+1 copies of the training set over the wire)
+        x_ref = api.put(X_train)
+        y_ref = api.put(y_train)
+        fit_ref = _fit_full.remote(est_blob, x_ref, y_ref)
+
+        if self.cv:
+            from sklearn.model_selection import KFold
+            folds = KFold(n_splits=self.cv, shuffle=True, random_state=0)
+            fold_refs = [
+                _score_fold.remote(est_blob, x_ref, y_ref, tr, te)
+                for tr, te in folds.split(X_train)]
+            scores: List[float] = api.get(fold_refs, timeout=600.0)
+            metrics["cv"] = {"test_score": scores,
+                             "test_score_mean": float(np.mean(scores)),
+                             "test_score_std": float(np.std(scores))}
+
+        fitted_blob = api.get(fit_ref, timeout=600.0)
+        fitted = cloudpickle.loads(fitted_blob)
+        for name, (X, y) in Xy.items():
+            if name != "train":
+                metrics[f"{name}_score"] = float(fitted.score(X, y))
+        ckpt = Checkpoint.from_dict({"estimator": fitted_blob,
+                                     "label_column": label})
+        return Result(metrics=metrics, checkpoint=ckpt)
+
+    @staticmethod
+    def load_estimator(checkpoint: Checkpoint):
+        import cloudpickle
+        return cloudpickle.loads(checkpoint.to_dict()["estimator"])
+
+
+class GBDTTrainer(SklearnTrainer):
+    """Gradient-boosted trees (reference: `train/gbdt_trainer.py`
+    xgboost/lightgbm backends).  Gated: neither library ships in this
+    image, so construction points at the sklearn HistGradientBoosting
+    equivalents instead of failing at fit time."""
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            "xgboost/lightgbm are not available in this image; use "
+            "SklearnTrainer with sklearn.ensemble."
+            "HistGradientBoostingClassifier/Regressor (same algorithm "
+            "family) instead")

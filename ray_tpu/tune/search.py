@@ -169,7 +169,8 @@ class TPESearch(Searcher):
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         from .sample import Categorical, Function
         cfg = dict(self.consts)
-        startup = len(self._history) < self.n_startup
+        # max(1, ...): the KDE path needs at least one observation
+        startup = len(self._history) < max(1, self.n_startup)
         if not startup:
             cut = max(1, int(np.ceil(self.gamma * len(self._history))))
             ranked = sorted(self._history, key=lambda t: t[1])

@@ -169,6 +169,17 @@ def test_runtime_env_pip_offline_wheels(cluster, tmp_path):
     from ray_tpu.core import runtime_env as re_mod
     assert re_mod.pip_env_uri(env["pip"]) in re_mod.list_cached_uris()
 
+    # isolation survives reuse of the SAME workers: env-sourced modules
+    # are evicted from sys.modules at restore, so env-less tasks cannot
+    # see the cached import
+    @ray_tpu.remote
+    def leaked():
+        import sys
+        return "rt_probe_pkg" in sys.modules
+
+    assert not any(ray_tpu.get([leaked.remote() for _ in range(4)],
+                               timeout=60.0))
+
 
 def test_dashboard_http_event_provider(dashboard):
     """POST /api/workflow_events/<name> fires a workflow event (the HTTP

@@ -116,3 +116,27 @@ def test_delete_deployment(served):
     assert "temp" in serve.list_deployments()
     serve.delete("temp")
     assert "temp" not in serve.list_deployments()
+
+
+def test_serve_rest_status_endpoint(served):
+    """GET /api/serve/deployments reports the deployment table through
+    the dashboard (reference: serve REST API + `serve status` CLI)."""
+    import socket
+
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @serve.deployment(num_replicas=2)
+    def rest_probe(x=None):
+        return x
+
+    serve.run(rest_probe)
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    dash = start_dashboard(port=port)
+    table = requests.get(f"{dash.address}/api/serve/deployments",
+                         timeout=10).json()
+    assert table["rest_probe"]["num_replicas"] == 2
+    assert table["rest_probe"]["route_prefix"] == "/rest_probe"

@@ -1,0 +1,66 @@
+"""SklearnTrainer + BatchPredictor (reference models:
+python/ray/train/sklearn/sklearn_trainer.py, train/batch_predictor.py
+and their tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rt_data
+from ray_tpu.air import BatchPredictor
+from ray_tpu.train import GBDTTrainer, SklearnTrainer
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _toy_frame(n=200, seed=0):
+    import pandas as pd
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    y = (X @ np.array([1.5, -2.0, 0.5]) > 0).astype(int)
+    df = pd.DataFrame(X, columns=["a", "b", "c"])
+    df["label"] = y
+    return df
+
+
+def test_sklearn_trainer_fit_and_cv(cluster):
+    from sklearn.linear_model import LogisticRegression
+
+    df = _toy_frame()
+    valid = _toy_frame(seed=1)
+    result = SklearnTrainer(
+        LogisticRegression(max_iter=200),
+        datasets={"train": df, "valid": valid},
+        label_column="label", cv=4).fit()
+    assert result.metrics["valid_score"] > 0.9
+    cv = result.metrics["cv"]
+    assert len(cv["test_score"]) == 4 and cv["test_score_mean"] > 0.9
+    est = SklearnTrainer.load_estimator(result.checkpoint)
+    assert est.predict(np.array([[3.0, -3.0, 1.0]]))[0] == 1
+
+
+def test_batch_predictor_over_dataset(cluster):
+    from sklearn.linear_model import LogisticRegression
+
+    df = _toy_frame()
+    result = SklearnTrainer(
+        LogisticRegression(max_iter=200),
+        datasets={"train": df}, label_column="label").fit()
+
+    feats = df.drop(columns=["label"]).to_numpy()
+    ds = rt_data.from_items([row for row in feats], parallelism=4)
+    preds_ds = BatchPredictor.from_sklearn(result.checkpoint).predict(ds)
+    preds = np.asarray(preds_ds.take_all())
+    assert preds.shape == (len(df),)
+    acc = (preds == df["label"].to_numpy()).mean()
+    assert acc > 0.9
+
+
+def test_gbdt_trainer_gated(cluster):
+    with pytest.raises(ImportError):
+        GBDTTrainer(None, datasets={"train": None}, label_column="y")
