@@ -23,9 +23,14 @@ import time
 import traceback
 
 _CHILD_FLAG = "_BENCH_CHILD"   # value: "tpu" or "cpu"
-_TPU_RETRIES = 2
-_TPU_PROBE_TIMEOUT = 180       # quick devices() probe before real attempts
-_TPU_ATTEMPT_TIMEOUT = 900     # a wedged tunnel must not eat the round
+# ONE generous TPU attempt, no separate devices() probe: every extra
+# claim/release cycle against the tunnelled chip is a wedge opportunity
+# (a killed claimant blocks the next client init until the grant times
+# out — observed as rc=124 attempt chains).  The attempt doubles as the
+# probe; a FAST failure (backend UNAVAILABLE) earns one retry, a slow
+# one (wedged/compiling past budget) goes straight to the CPU fallback.
+_TPU_ATTEMPT_TIMEOUT = 1500
+_TPU_FAST_FAIL_S = 240
 
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets)
 _PEAK_TFLOPS = {
@@ -49,6 +54,15 @@ def _peak_flops(device) -> float:
 
 def _run_measurement() -> dict:
     """The actual benchmark body; assumes a working JAX backend."""
+    t_start = time.perf_counter()
+
+    def log(msg: str) -> None:
+        # progress breadcrumbs land in the parent's captured stderr tail,
+        # so a timed-out attempt shows WHERE it stalled
+        print(f"[bench {time.perf_counter() - t_start:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    log("importing jax...")
     import jax
     import jax.numpy as jnp  # noqa: F401
     import optax
@@ -56,6 +70,7 @@ def _run_measurement() -> dict:
     from ray_tpu.models import (TransformerConfig, flops_per_token,
                                 init_params, make_train_step)
 
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = TransformerConfig.gpt2("small")
@@ -76,9 +91,11 @@ def _run_measurement() -> dict:
 
     # warmup (compile + 2 steps); float() is a hard device→host sync — the
     # tunnelled backend has been seen returning early from block_until_ready
+    log("warmup: compiling + 2 steps...")
     for _ in range(2):
         params, opt_state, metrics = step(params, opt_state, batch_data)
     float(metrics["loss"])
+    log(f"warmup done; measuring {steps} steps")
 
     def _measure(sync_every_step: bool) -> float:
         nonlocal params, opt_state
@@ -152,38 +169,26 @@ def main() -> None:
         return
 
     errors = []
-    # Fast probe: a wedged/unreachable TPU runtime can block client init
-    # indefinitely — detect that in bounded time and skip straight to the
-    # CPU fallback instead of burning the attempt budget.
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('NTPU', sum(d.platform == 'tpu' "
-             "for d in jax.devices()))"],
-            capture_output=True, text=True, timeout=_TPU_PROBE_TIMEOUT)
-        probe_ok = probe.returncode == 0 and "NTPU" in probe.stdout \
-            and "NTPU 0" not in probe.stdout
-        if not probe_ok:
-            errors.append(f"tpu probe: rc={probe.returncode} "
-                          f"out={probe.stdout.strip()[:80]} "
-                          f"err={probe.stderr.strip()[-160:]}")
-    except subprocess.TimeoutExpired:
-        probe_ok = False
-        errors.append(f"tpu probe: timeout after {_TPU_PROBE_TIMEOUT}s "
-                      "(wedged TPU runtime)")
-    for attempt in range(_TPU_RETRIES if probe_ok else 0):
+    for attempt in range(2):
+        t0 = time.perf_counter()
         try:
             proc = _spawn("tpu")
         except subprocess.TimeoutExpired:
-            errors.append(f"tpu attempt {attempt}: timeout")
-            continue
+            errors.append(f"tpu attempt {attempt}: timeout after "
+                          f"{_TPU_ATTEMPT_TIMEOUT}s (wedged or "
+                          "over-budget compile)")
+            break  # a killed slow attempt may have wedged the grant: stop
         result = _extract_json_line(proc.stdout)
         if proc.returncode == 0 and result is not None:
             print(json.dumps(result))
             return
+        dt = time.perf_counter() - t0
         errors.append(f"tpu attempt {attempt}: rc={proc.returncode} "
+                      f"after {dt:.0f}s "
                       f"stderr={proc.stderr.strip()[-300:]}")
-        time.sleep(2 * (attempt + 1))
+        if dt > _TPU_FAST_FAIL_S:
+            break  # slow failure: retrying would just eat the round
+        time.sleep(5)
 
     try:
         proc = _spawn("cpu")

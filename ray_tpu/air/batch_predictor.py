@@ -46,18 +46,53 @@ class BatchPredictor:
         return cls(checkpoint, build)
 
     def predict(self, dataset: Any, *, batch_size: Optional[int] = None):
-        """→ Dataset of predictions (one row per input row)."""
-        ckpt_dict = self.checkpoint.to_dict()
+        """→ Dataset of predictions (one row per input row).
+
+        A LARGE checkpoint uploads to the shared object store once and
+        every block task carries only the ref (small puts live in the
+        owner's in-process memory store, which remote workers cannot
+        fetch — those embed in the closure, which is cheap at that
+        size).  Each worker PROCESS builds the model once: the cache
+        lives at module level keyed by the checkpoint blob's hash, so
+        repeated blocks on one worker reuse the built predictor.
+        """
+        import hashlib
+
+        import cloudpickle
+
+        import ray_tpu
+        from ..core.config import GlobalConfig
+
+        blob = cloudpickle.dumps(self.checkpoint.to_dict())
+        key = hashlib.sha256(blob).hexdigest()[:16]
+        ckpt_ref = None
+        if len(blob) > GlobalConfig.inline_small_args_bytes:
+            ckpt_ref = ray_tpu.put(blob)   # plasma-backed: workers can pull
+            carrier: Any = ckpt_ref
+        else:
+            carrier = blob
         predictor_fn = self.predictor_fn
 
-        def _predict_batch(batch):
-            # rebuilt per task; cached per worker process via attribute
-            cache_key = "_ray_tpu_batch_predictor"
-            fn = getattr(_predict_batch, cache_key, None)
+        def _predict_batch(batch, _carrier=carrier, _key=key):
+            from ray_tpu.air import batch_predictor as bp
+            fn = bp._PROCESS_CACHE.get(_key)
             if fn is None:
-                fn = predictor_fn(Checkpoint.from_dict(ckpt_dict))
-                setattr(_predict_batch, cache_key, fn)
-            out = fn(batch)
-            return list(out)
+                import cloudpickle as cp
 
-        return dataset.map_batches(_predict_batch, batch_size=batch_size)
+                import ray_tpu as rt
+                raw = _carrier if isinstance(_carrier, bytes) \
+                    else rt.get(_carrier)
+                fn = predictor_fn(Checkpoint.from_dict(cp.loads(raw)))
+                bp._PROCESS_CACHE[_key] = fn
+            return list(fn(batch))
+
+        out = dataset.map_batches(_predict_batch, batch_size=batch_size)
+        if ckpt_ref is not None:
+            # the closure's ref is not arg-tracked: keep the checkpoint
+            # alive at least as long as the prediction dataset
+            out._batch_predictor_ckpt_ref = ckpt_ref
+        return out
+
+
+#: per-process predictor cache: checkpoint-blob hash -> batch fn
+_PROCESS_CACHE: Dict[str, Callable] = {}

@@ -727,6 +727,7 @@ class Nodelet:
                     await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
                     continue
                 no_loc_deadline = time.monotonic() + min(timeout, 5.0)
+                await self._admit_pull(int(info.get("size", 0)), deadline)
                 for addr, nid in pairs:
                     async with self._pull_sem:  # bound store churn
                         pulled = await self._pull_from(oid, addr)
@@ -745,6 +746,53 @@ class Nodelet:
                             pass
                 await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
             return {"ok": False, "error": f"pull timeout for {oid.hex()}"}
+
+    async def _make_room(self, nbytes: int) -> None:
+        """Spill pinned primaries oldest-first until ``nbytes`` fits (or
+        no spillable pins remain)."""
+        while True:
+            st = self.store.stats()
+            if st["used_bytes"] + nbytes <= st["capacity_bytes"] * 0.95:
+                return
+            if not await self._spill_oldest_pin():
+                return
+
+    async def _spill_oldest_pin(self) -> bool:
+        """Spill exactly one pinned primary (oldest spillable first);
+        False when nothing could be spilled.  Known-small pins skip on
+        their recorded size — no store round trip per skip."""
+        min_bytes = GlobalConfig.spill_min_object_bytes
+        for oid, size in list(self._primary_pins.items()):
+            if 0 < size < min_bytes:
+                continue
+            try:
+                if await self._spill_one(oid):
+                    return True
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+        return False
+
+    async def _admit_pull(self, size: int, deadline: float) -> None:
+        """Memory-pressure pull admission (reference:
+        `pull_manager.cc:228` UpdatePullsBasedOnAvailableMemory — active
+        pulls are limited to what fits in available memory).  When the
+        incoming object would not fit without evicting live data, spill
+        pinned primaries to make room first; if concurrent pulls are
+        racing for the same space, wait briefly for them to settle.  The
+        pull proceeds regardless at the deadline (the create-time
+        make-room retry backstops it)."""
+        if not size:
+            return
+        st = self.store.stats()
+        if st["used_bytes"] + size <= st["capacity_bytes"] * 0.95:
+            return
+        await self._make_room(size)
+        admit_deadline = min(deadline - 1.0, time.monotonic() + 2.0)
+        while time.monotonic() < admit_deadline:
+            st = self.store.stats()
+            if st["used_bytes"] + size <= st["capacity_bytes"] * 0.95:
+                return
+            await asyncio.sleep(0.1)
 
     async def _peer(self, addr: str) -> rpc.Connection:
         conn = self._peer_conns.get(addr)
@@ -775,10 +823,20 @@ class Nodelet:
                 except store_client.StoreError:
                     pass  # fall back to the chunked RPC path
             size = meta["size"]
-            try:
-                dest = self.store.create(oid, size)
-            except store_client.ObjectExistsError:
-                return True
+            # Pressure relief on demand (reference: the plasma create
+            # queue triggers spilling): each StoreFullError spills one
+            # more pinned primary and retries — byte accounting alone
+            # isn't enough, the allocator needs a CONTIGUOUS hole, so
+            # keep spilling until the create lands or pins run out.
+            while True:
+                try:
+                    dest = self.store.create(oid, size)
+                    break
+                except store_client.ObjectExistsError:
+                    return True
+                except store_client.StoreFullError:
+                    if not await self._spill_oldest_pin():
+                        raise
             chunk = GlobalConfig.object_transfer_chunk_bytes
             try:
                 off = 0

@@ -191,11 +191,24 @@ def restore(undo: Dict[str, Any]) -> None:
     snapshot = undo.get("mod_snapshot")
     if snapshot is not None:
         paths = undo.get("env_paths", [])
+
+        def _under_env(location: str) -> bool:
+            # directory-boundary check: '/env/lib' must not match the
+            # sibling '/env/lib_extra'
+            return any(location == p or location.startswith(p + os.sep)
+                       for p in paths)
+
         for name in set(sys.modules) - snapshot:
             mod = sys.modules.get(name)
-            f = getattr(mod, "__file__", None) or ""
-            if f and any(f.startswith(p + os.sep) or f.startswith(p)
-                         for p in paths):
+            f = getattr(mod, "__file__", None)
+            if f and _under_env(f):
+                del sys.modules[name]
+                continue
+            # namespace packages have no __file__; their __path__ entries
+            # pointing into the env would keep resolving submodules from
+            # it after restore — the leak this eviction exists to close
+            pkg_paths = list(getattr(mod, "__path__", []) or [])
+            if pkg_paths and all(_under_env(p) for p in pkg_paths):
                 del sys.modules[name]
 
 

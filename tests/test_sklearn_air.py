@@ -64,3 +64,25 @@ def test_batch_predictor_over_dataset(cluster):
 def test_gbdt_trainer_gated(cluster):
     with pytest.raises(ImportError):
         GBDTTrainer(None, datasets={"train": None}, label_column="y")
+
+
+def test_batch_predictor_large_checkpoint_via_store(cluster):
+    """Checkpoints above the inline threshold ship through the shared
+    object store once (ref in the closure), not per block."""
+    from ray_tpu.air import Checkpoint
+
+    big = np.arange(512 * 1024, dtype=np.float64)  # 4 MiB blob
+    ckpt = Checkpoint.from_dict({"weights": big, "offset": 2.0})
+
+    def build(c):
+        d = c.to_dict()
+        off = d["offset"]
+        assert d["weights"].nbytes == big.nbytes
+
+        def predict(batch):
+            return [x + off for x in batch]
+        return predict
+
+    ds = rt_data.from_items(list(range(20)), parallelism=4)
+    out = BatchPredictor(ckpt, build).predict(ds).take_all()
+    assert sorted(out) == [x + 2.0 for x in range(20)]

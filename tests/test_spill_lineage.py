@@ -153,3 +153,39 @@ def test_custom_spill_storage_uri(tmp_path, _scrub_spill_config):
             assert ray_tpu.get(r, timeout=60.0)[0] == i
     finally:
         ray_tpu.shutdown()
+
+
+def test_pull_admission_waits_for_spill(_scrub_spill_config):
+    """A pull into a pressured store defers until the spill loop
+    reclaims space, then lands (reference: pull_manager.cc:228
+    UpdatePullsBasedOnAvailableMemory)."""
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    a = cluster.add_node(num_cpus=1,
+                         object_store_memory=24 * 1024 * 1024)
+    b = cluster.add_node(num_cpus=1, resources={"src": 1},
+                         object_store_memory=64 * 1024 * 1024)
+    cluster.connect(a)
+    try:
+        import ray_tpu
+
+        # spilling configured slow-ish so the admission path is exercised
+        @ray_tpu.remote(resources={"src": 1})
+        def make_big():
+            return np.arange(10 * 1024 * 1024, dtype=np.uint8)
+
+        # fill node A with pinned primaries (~18 of 24 MiB)
+        local_refs = [ray_tpu.put(np.full(6 * 1024 * 1024, i, np.uint8))
+                      for i in range(3)]
+        big_ref = make_big.remote()   # lives on node B
+        # pulling 10 MiB into A crosses the 95% admission bar; the spill
+        # loop must reclaim pinned primaries before the pull lands
+        out = ray_tpu.get(big_ref, timeout=120.0)
+        assert out.nbytes == 10 * 1024 * 1024 and out[5] == 5
+        for i, r in enumerate(local_refs):
+            assert ray_tpu.get(r, timeout=60.0)[0] == i
+    finally:
+        cluster.shutdown()
