@@ -180,6 +180,13 @@ def main() -> None:
             break  # a killed slow attempt may have wedged the grant: stop
         result = _extract_json_line(proc.stdout)
         if proc.returncode == 0 and result is not None:
+            backend = (result.get("detail") or {}).get("backend")
+            if backend != "tpu":
+                # soft TPU-init failure fell back to jax's CPU backend: a
+                # smoke number must not masquerade as the TPU headline
+                errors.append(f"tpu attempt {attempt}: ran on "
+                              f"backend={backend!r}, rejecting")
+                break
             print(json.dumps(result))
             return
         dt = time.perf_counter() - t0

@@ -64,7 +64,12 @@ class BatchPredictor:
         from ..core.config import GlobalConfig
 
         blob = cloudpickle.dumps(self.checkpoint.to_dict())
-        key = hashlib.sha256(blob).hexdigest()[:16]
+        # key on checkpoint AND builder: two predictors sharing one
+        # checkpoint (different predictor_fn) must not reuse each other's
+        # built model
+        fn_tag = hashlib.sha256(
+            cloudpickle.dumps(self.predictor_fn)).hexdigest()[:8]
+        key = hashlib.sha256(blob).hexdigest()[:16] + "-" + fn_tag
         ckpt_ref = None
         if len(blob) > GlobalConfig.inline_small_args_bytes:
             ckpt_ref = ray_tpu.put(blob)   # plasma-backed: workers can pull
@@ -84,6 +89,9 @@ class BatchPredictor:
                     else rt.get(_carrier)
                 fn = predictor_fn(Checkpoint.from_dict(cp.loads(raw)))
                 bp._PROCESS_CACHE[_key] = fn
+                # bounded: built models are large, workers are long-lived
+                while len(bp._PROCESS_CACHE) > bp._PROCESS_CACHE_MAX:
+                    bp._PROCESS_CACHE.pop(next(iter(bp._PROCESS_CACHE)))
             return list(fn(batch))
 
         out = dataset.map_batches(_predict_batch, batch_size=batch_size)
@@ -94,5 +102,7 @@ class BatchPredictor:
         return out
 
 
-#: per-process predictor cache: checkpoint-blob hash -> batch fn
+#: per-process predictor cache: (checkpoint, builder) hash -> batch fn;
+#: insertion-ordered dict doubles as FIFO eviction at the cap
 _PROCESS_CACHE: Dict[str, Callable] = {}
+_PROCESS_CACHE_MAX = 2

@@ -1,0 +1,197 @@
+"""Cluster launcher: `ray-tpu up / down / exec` from a YAML config.
+
+Capability mirror of the reference's cluster launcher
+(`python/ray/scripts/scripts.py:529` up / `:974` down / `:1161` attach /
+exec; YAML schema `python/ray/autoscaler/ray-schema.json`): a config file
+names a provider and worker node types, `up` boots the head (controller +
+nodelet) and the initial workers through the provider, `down` terminates
+everything, `exec` runs a command against the live cluster.  Providers:
+
+* ``local`` — worker nodelets as processes on this machine (the
+  fake-multi-node story; full control-plane fidelity, no cloud).
+* ``tpu_pod`` — TPU slices via ``gcloud`` (autoscaler/tpu_pod_provider).
+
+Example config::
+
+    cluster_name: dev
+    provider:
+      type: local
+    head:
+      num_cpus: 4
+    workers:
+      cpu_worker:
+        count: 2
+        resources: {CPU: 2}
+
+Cluster state persists under ``~/.ray_tpu/clusters/<name>.json`` so
+``down``/``exec`` find the running processes across CLI invocations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+def _state_path(name: str) -> str:
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    return os.path.join(_STATE_DIR, f"{name}.json")
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    if "cluster_name" not in cfg:
+        raise ValueError("cluster config needs a cluster_name")
+    provider = (cfg.get("provider") or {}).get("type", "local")
+    if provider not in ("local", "tpu_pod"):
+        raise ValueError(f"unknown provider type {provider!r}")
+    return cfg
+
+
+def up(config_path: str) -> Dict[str, Any]:
+    """Boot the head + initial workers; returns the cluster state."""
+    from ..core import node as node_mod
+
+    cfg = load_config(config_path)
+    name = cfg["cluster_name"]
+    state_file = _state_path(name)
+    if os.path.exists(state_file):
+        raise RuntimeError(
+            f"cluster {name!r} appears to be running "
+            f"({state_file} exists); `down` it first")
+
+    session_dir = node_mod.new_session_dir()
+    head_cfg = cfg.get("head") or {}
+    controller_proc, controller_addr = node_mod.start_controller(session_dir)
+    resources = {"CPU": float(head_cfg.get("num_cpus", 4))}
+    if head_cfg.get("num_tpus"):
+        resources["TPU"] = float(head_cfg["num_tpus"])
+    nodelet_proc, nodelet_addr, node_id, _ = node_mod.start_nodelet(
+        session_dir, controller_addr, resources,
+        int(head_cfg.get("object_store_memory", 0)))
+
+    state: Dict[str, Any] = {
+        "cluster_name": name,
+        "config_path": os.path.abspath(config_path),
+        "controller": controller_addr,
+        "nodelet": nodelet_addr,
+        "session_dir": session_dir,
+        "pids": [controller_proc.proc.pid, nodelet_proc.proc.pid],
+        "provider": (cfg.get("provider") or {}).get("type", "local"),
+        "provider_nodes": [],
+    }
+
+    # persist as soon as the head is up: if worker bring-up fails below,
+    # `down` can still find and terminate everything started so far
+    with open(state_file, "w") as f:
+        json.dump(state, f, indent=2)
+
+    try:
+        provider = _make_provider(cfg, session_dir, controller_addr)
+        for wtype, wcfg in (cfg.get("workers") or {}).items():
+            count = int((wcfg or {}).get("count", 0))
+            if hasattr(provider, "node_types") and isinstance(wcfg, dict) \
+                    and wcfg.get("resources"):
+                provider.node_types[wtype] = dict(wcfg["resources"])
+            for _ in range(count):
+                nid = provider.create_node(wtype)
+                state["provider_nodes"].append(nid)
+                entry = getattr(provider, "_nodes", {}).get(nid)
+                proc = getattr(entry[0], "proc", None) if entry else None
+                if proc is not None:
+                    state["pids"].append(proc.pid)
+                with open(state_file, "w") as f:
+                    json.dump(state, f, indent=2)
+    except BaseException:
+        try:
+            down(name)
+        except Exception:
+            pass
+        raise
+    return state
+
+
+def down(name_or_config: str) -> Dict[str, Any]:
+    """Terminate every process/instance of the named cluster."""
+    name = _resolve_name(name_or_config)
+    state_file = _state_path(name)
+    if not os.path.exists(state_file):
+        raise RuntimeError(f"no running cluster named {name!r}")
+    with open(state_file) as f:
+        state = json.load(f)
+    if state.get("provider") == "tpu_pod":
+        cfg = load_config(state["config_path"])
+        provider = _make_provider(cfg, state["session_dir"],
+                                  state["controller"])
+        for nid in state.get("provider_nodes", []):
+            try:
+                provider.terminate_node(nid)
+            except Exception:
+                pass
+    for pid in reversed(state.get("pids", [])):  # workers before head
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    # reap any that are OUR children (an in-process `up` leaves them as
+    # zombies otherwise; cross-process `down` gets ECHILD, fine)
+    for pid in state.get("pids", []):
+        try:
+            os.waitpid(pid, os.WNOHANG)
+        except OSError:
+            pass
+    os.unlink(state_file)
+    return state
+
+
+def exec_cmd(name_or_config: str, command: List[str],
+             timeout: Optional[float] = None) -> int:
+    """Run a command with the cluster's address exported (the local-form
+    `ray exec`: the command lands on the head environment)."""
+    name = _resolve_name(name_or_config)
+    with open(_state_path(name)) as f:
+        state = json.load(f)
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = state["controller"]
+    env["RAY_TPU_NODELET"] = state["nodelet"]
+    env["RAY_TPU_SESSION_DIR"] = state["session_dir"]
+    proc = subprocess.run(command, env=env, timeout=timeout)
+    return proc.returncode
+
+
+def get_state(name_or_config: str) -> Optional[Dict[str, Any]]:
+    name = _resolve_name(name_or_config)
+    path = _state_path(name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _resolve_name(name_or_config: str) -> str:
+    if os.path.exists(name_or_config) and \
+            name_or_config.endswith((".yaml", ".yml")):
+        return load_config(name_or_config)["cluster_name"]
+    return name_or_config
+
+
+def _make_provider(cfg: Dict[str, Any], session_dir: str,
+                   controller_addr: str):
+    from .node_provider import LocalNodeProvider
+    ptype = (cfg.get("provider") or {}).get("type", "local")
+    if ptype == "local":
+        return LocalNodeProvider(session_dir, controller_addr,
+                                 node_types={})
+    from .tpu_pod_provider import TpuPodProvider
+    p = dict(cfg["provider"])
+    p.pop("type")
+    return TpuPodProvider(head_address=controller_addr,
+                          node_types={}, **p)

@@ -361,6 +361,34 @@ class Dataset:
         if carry is not None and not drop_last:
             yield BlockAccessor(carry).to_batch(batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: Optional[str] = None,
+                           drop_last: bool = False) -> Iterator[Any]:
+        """iter_batches with torch-tensor conversion (reference:
+        `Dataset.iter_torch_batches` — the Torch ingest path).  Columnar
+        batches become {column: tensor}; array batches become one
+        tensor."""
+        import torch
+
+        def _tensor(arr, column=None):
+            t = torch.as_tensor(np.ascontiguousarray(arr))
+            if isinstance(dtypes, dict):
+                if column in dtypes:
+                    t = t.to(dtypes[column])
+            elif dtypes is not None:
+                t = t.to(dtypes)
+            if device is not None:
+                t = t.to(device)
+            return t
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: _tensor(v, k) for k, v in batch.items()}
+            else:
+                yield _tensor(batch)
+
     def to_pandas(self):
         blocks = [BlockAccessor(api.get(r, timeout=300.0)).to_pandas()
                   for r in self._blocks]

@@ -90,6 +90,36 @@ def cmd_status(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_up(args) -> None:
+    from ray_tpu.autoscaler import launcher
+    state = launcher.up(args.config)
+    print(f"cluster {state['cluster_name']!r} up: "
+          f"controller={state['controller']} "
+          f"workers={len(state['provider_nodes'])}")
+    print(f"connect with: ray_tpu.init(address={state['controller']!r}, "
+          f"nodelet_addr={state['nodelet']!r})")
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler import launcher
+    state = launcher.down(args.cluster)
+    print(f"cluster {state['cluster_name']!r} terminated "
+          f"({len(state.get('pids', []))} processes)")
+
+
+def cmd_exec(args) -> None:
+    from ray_tpu.autoscaler import launcher
+    sys.exit(launcher.exec_cmd(args.cluster, args.command))
+
+
+def cmd_attach(args) -> None:
+    """Interactive shell with the cluster's env exported (local form of
+    `ray attach`)."""
+    from ray_tpu.autoscaler import launcher
+    sys.exit(launcher.exec_cmd(args.cluster,
+                               [os.environ.get("SHELL", "/bin/bash")]))
+
+
 def cmd_serve_status(args) -> None:
     """Deployment table of the running Serve instance (reference:
     `serve status` CLI)."""
@@ -239,6 +269,23 @@ def main(argv=None) -> None:
     sp = sub.add_parser("serve-status", help="Serve deployment table")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve_status)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="terminate a launched cluster")
+    sp.add_argument("cluster", help="cluster name or its YAML config")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("exec", help="run a command against a cluster")
+    sp.add_argument("cluster")
+    sp.add_argument("command", nargs="+")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("attach", help="shell with the cluster env")
+    sp.add_argument("cluster")
+    sp.set_defaults(fn=cmd_attach)
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["nodes", "actors",

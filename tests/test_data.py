@@ -143,3 +143,18 @@ def test_aggregates_and_stats(cluster):
     assert ds.max("id") == 9
     assert "rows=10" in ds.stats()
     assert ds.limit(3).count() == 3
+
+
+def test_iter_torch_batches(cluster):
+    """Torch ingest path (reference: Dataset.iter_torch_batches)."""
+    import torch
+
+    ds = rdata.from_items([{"x": float(i), "y": float(2 * i)}
+                             for i in range(10)], parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    xs = torch.cat([b["x"] for b in batches])
+    assert sorted(xs.tolist()) == [float(i) for i in range(10)]
+    ys = torch.cat([b["y"] for b in batches])
+    assert torch.equal(torch.sort(ys).values,
+                       torch.sort(2 * xs).values)
