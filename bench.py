@@ -183,10 +183,12 @@ def main() -> None:
             backend = (result.get("detail") or {}).get("backend")
             if backend != "tpu":
                 # soft TPU-init failure fell back to jax's CPU backend: a
-                # smoke number must not masquerade as the TPU headline
+                # smoke number must not masquerade as the TPU headline —
+                # and it's the same transient class the retry exists for
                 errors.append(f"tpu attempt {attempt}: ran on "
                               f"backend={backend!r}, rejecting")
-                break
+                time.sleep(5)
+                continue
             print(json.dumps(result))
             return
         dt = time.perf_counter() - t0

@@ -37,12 +37,16 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
-_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+def _state_dir() -> str:
+    # overridable so tests (and parallel CI runs) get isolated state
+    return os.environ.get("RAY_TPU_CLUSTER_STATE_DIR",
+                          os.path.expanduser("~/.ray_tpu/clusters"))
 
 
 def _state_path(name: str) -> str:
-    os.makedirs(_STATE_DIR, exist_ok=True)
-    return os.path.join(_STATE_DIR, f"{name}.json")
+    d = _state_dir()
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{name}.json")
 
 
 def load_config(path: str) -> Dict[str, Any]:
@@ -71,12 +75,21 @@ def up(config_path: str) -> Dict[str, Any]:
     session_dir = node_mod.new_session_dir()
     head_cfg = cfg.get("head") or {}
     controller_proc, controller_addr = node_mod.start_controller(session_dir)
-    resources = {"CPU": float(head_cfg.get("num_cpus", 4))}
-    if head_cfg.get("num_tpus"):
-        resources["TPU"] = float(head_cfg["num_tpus"])
-    nodelet_proc, nodelet_addr, node_id, _ = node_mod.start_nodelet(
-        session_dir, controller_addr, resources,
-        int(head_cfg.get("object_store_memory", 0)))
+    try:
+        resources = {"CPU": float(head_cfg.get("num_cpus", 4))}
+        if head_cfg.get("num_tpus"):
+            resources["TPU"] = float(head_cfg["num_tpus"])
+        nodelet_proc, nodelet_addr, node_id, _ = node_mod.start_nodelet(
+            session_dir, controller_addr, resources,
+            int(head_cfg.get("object_store_memory", 0)))
+    except BaseException:
+        # no state file exists yet: kill the detached controller here or
+        # nothing ever will
+        try:
+            controller_proc.kill()
+        except Exception:
+            pass
+        raise
 
     state: Dict[str, Any] = {
         "cluster_name": name,
@@ -128,26 +141,46 @@ def down(name_or_config: str) -> Dict[str, Any]:
     with open(state_file) as f:
         state = json.load(f)
     if state.get("provider") == "tpu_pod":
-        cfg = load_config(state["config_path"])
-        provider = _make_provider(cfg, state["session_dir"],
-                                  state["controller"])
-        for nid in state.get("provider_nodes", []):
-            try:
-                provider.terminate_node(nid)
-            except Exception:
-                pass
+        # best effort: a moved/deleted YAML must not make the cluster
+        # permanently un-down-able — the head pids and the state file
+        # still get cleaned up below either way
+        try:
+            cfg = load_config(state["config_path"])
+            provider = _make_provider(cfg, state["session_dir"],
+                                      state["controller"])
+            for nid in state.get("provider_nodes", []):
+                try:
+                    provider.terminate_node(nid)
+                except Exception:
+                    pass
+        except Exception as e:
+            import sys as _sys
+            print(f"ray_tpu: could not terminate provider nodes "
+                  f"({type(e).__name__}: {e}); clean them up via the "
+                  "cloud console", file=_sys.stderr)
     for pid in reversed(state.get("pids", [])):  # workers before head
         try:
             os.kill(pid, signal.SIGTERM)
         except OSError:
             pass
     # reap any that are OUR children (an in-process `up` leaves them as
-    # zombies otherwise; cross-process `down` gets ECHILD, fine)
-    for pid in state.get("pids", []):
-        try:
-            os.waitpid(pid, os.WNOHANG)
-        except OSError:
-            pass
+    # zombies otherwise; cross-process `down` gets ECHILD, fine) —
+    # bounded retry, since they need a moment to exit after SIGTERM
+    import time as _time
+    pending = list(state.get("pids", []))
+    deadline = _time.monotonic() + 5.0
+    while pending and _time.monotonic() < deadline:
+        still = []
+        for pid in pending:
+            try:
+                done_pid, _ = os.waitpid(pid, os.WNOHANG)
+                if done_pid == 0:
+                    still.append(pid)
+            except OSError:
+                pass  # not our child / already reaped
+        pending = still
+        if pending:
+            _time.sleep(0.1)
     os.unlink(state_file)
     return state
 

@@ -7,6 +7,12 @@ logical sharding axes on every parameter, scan-over-layers bodies, and
 Pallas attention (`ray_tpu.ops`).
 """
 
+from .generate import (  # noqa: F401
+    decode_step,
+    generate,
+    init_kv_cache,
+    prefill,
+)
 from .transformer import (  # noqa: F401
     TransformerConfig,
     init_params,

@@ -264,22 +264,31 @@ def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
     x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
 
     y = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+    z, aux = _ffn(cfg, y, lp)
+    return x + z, aux
+
+
+def _ffn(cfg: TransformerConfig, y: jnp.ndarray, lp: Params
+         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Post-attention FFN on a normed input — ONE implementation shared
+    by training/prefill (`_layer`) and KV-cache decode
+    (`models/generate.py`), so the architectures can't desynchronize.
+    → (residual delta, router aux loss)."""
+    dt = cfg.dtype
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts:
         from ..ops.moe import moe_ffn
         z, aux = moe_ffn(
             y, lp["router"], lp["w_in"], lp["w_out"], lp.get("w_gate"),
             top_k=cfg.expert_top_k, capacity_factor=cfg.capacity_factor)
-        x = x + z
-        return x, aux
+        return z, aux
     if cfg.activation == "swiglu":
         up = jnp.einsum("bsd,df->bsf", y, lp["w_in"].astype(dt))
         gate = jnp.einsum("bsd,df->bsf", y, lp["w_gate"].astype(dt))
         z = jax.nn.silu(gate) * up
     else:
         z = jax.nn.gelu(jnp.einsum("bsd,df->bsf", y, lp["w_in"].astype(dt)))
-    x = x + jnp.einsum("bsf,fd->bsd", z, lp["w_out"].astype(dt))
-    return x, aux
+    return jnp.einsum("bsf,fd->bsd", z, lp["w_out"].astype(dt)), aux
 
 
 def forward_with_aux(params: Params, tokens: jnp.ndarray,

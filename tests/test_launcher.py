@@ -15,7 +15,11 @@ from ray_tpu.autoscaler import launcher
 
 
 @pytest.fixture
-def config_file(tmp_path):
+def config_file(tmp_path, monkeypatch):
+    # isolated state dir: never touch a user's real ~/.ray_tpu clusters,
+    # and parallel test runs cannot collide
+    monkeypatch.setenv("RAY_TPU_CLUSTER_STATE_DIR",
+                       str(tmp_path / "cluster_state"))
     cfg = tmp_path / "cluster.yaml"
     cfg.write_text(textwrap.dedent("""
         cluster_name: launcher_test
@@ -29,13 +33,6 @@ def config_file(tmp_path):
             count: 2
             resources: {CPU: 1}
     """))
-    # a previous crashed run may have left state behind
-    state = launcher._state_path("launcher_test")
-    if os.path.exists(state):
-        try:
-            launcher.down("launcher_test")
-        except Exception:
-            os.unlink(state)
     return str(cfg)
 
 
