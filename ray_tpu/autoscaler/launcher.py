@@ -156,7 +156,8 @@ def down(name_or_config: str) -> Dict[str, Any]:
         except Exception as e:
             import sys as _sys
             print(f"ray_tpu: could not terminate provider nodes "
-                  f"({type(e).__name__}: {e}); clean them up via the "
+                  f"{state.get('provider_nodes')} "
+                  f"({type(e).__name__}: {e}); clean these up via the "
                   "cloud console", file=_sys.stderr)
     for pid in reversed(state.get("pids", [])):  # workers before head
         try:

@@ -81,7 +81,9 @@ def prefill(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
              else params["lm_head"])
     logits = jnp.einsum("bd,dv->bv", x[:, -1], w_out.astype(dt))
 
-    max_len = cache["k"].shape[2]
+    if s > cache["k"].shape[2]:
+        raise ValueError(f"prompt length {s} exceeds cache capacity "
+                         f"{cache['k'].shape[2]}")
     cache = {
         "k": jax.lax.dynamic_update_slice(
             cache["k"], ks.astype(cfg.dtype), (0, 0, 0, 0, 0)),
@@ -89,7 +91,6 @@ def prefill(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
             cache["v"], vs.astype(cfg.dtype), (0, 0, 0, 0, 0)),
         "pos": jnp.asarray(s, jnp.int32),
     }
-    del max_len
     return logits.astype(jnp.float32), cache
 
 
@@ -153,9 +154,9 @@ def decode_step(params: Params, token: jnp.ndarray, cache: KVCache,
     return logits.astype(jnp.float32), {"k": ks, "v": vs, "pos": pos + 1}
 
 
-def _sample(logits: jnp.ndarray, key: jax.Array, temperature: float,
-            top_k: Optional[int]) -> jnp.ndarray:
-    if temperature == 0.0:
+def _sample(logits: jnp.ndarray, key: jax.Array, greedy: bool,
+            temperature: jnp.ndarray, top_k: Optional[int]) -> jnp.ndarray:
+    if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k:
@@ -166,8 +167,33 @@ def _sample(logits: jnp.ndarray, key: jax.Array, temperature: float,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "max_new_tokens",
-                                    "temperature", "top_k", "max_len"))
+                   static_argnames=("cfg", "max_new_tokens", "greedy",
+                                    "top_k", "total"))
+def _generate_impl(params, prompt, temperature, key, *, cfg,
+                   max_new_tokens, greedy, top_k, total):
+    b, s = prompt.shape
+    cache = init_kv_cache(cfg, b, total)
+    logits, cache = prefill(params, prompt, cfg, cache)
+
+    # Token t_i samples from the PREVIOUS logits (prefill's for t_1), so
+    # only max_new_tokens - 1 decode passes are needed — decoding after
+    # the final sample would be a wasted full forward pass.
+    def step(carry, _):
+        logits, cache, key = carry
+        key, skey = jax.random.split(key)
+        tok = _sample(logits, skey, greedy, temperature, top_k)
+        logits, cache = decode_step(params, tok, cache, cfg)
+        return (logits, cache, key), tok
+
+    (logits, _, key), toks = jax.lax.scan(
+        step, (logits, cache, key), None, length=max_new_tokens - 1)
+    key, skey = jax.random.split(key)
+    last = _sample(logits, skey, greedy, temperature, top_k)
+    toks = jnp.concatenate([toks, last[None]], axis=0) \
+        if max_new_tokens > 1 else last[None]
+    return jnp.swapaxes(toks, 0, 1)                            # [B, N]
+
+
 def generate(params: Params, prompt: jnp.ndarray, *,
              cfg: TransformerConfig, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
@@ -177,6 +203,9 @@ def generate(params: Params, prompt: jnp.ndarray, *,
 
     Greedy when ``temperature == 0`` (default), else temperature /
     top-k sampling.  One compiled program: prefill + scanned decode.
+    ``temperature`` is a TRACED input — serving different temperatures
+    per request does not recompile (only the greedy/sampled switch,
+    top_k, and the shape-bearing knobs are static).
     """
     b, s = prompt.shape
     total = max_len or (s + max_new_tokens)
@@ -185,18 +214,14 @@ def generate(params: Params, prompt: jnp.ndarray, *,
         raise ValueError(
             f"max_len={total} < prompt ({s}) + max_new_tokens "
             f"({max_new_tokens})")
+    if cfg.pos_emb == "learned" and total > cfg.max_seq_len:
+        # dynamic_slice would silently clamp to the last embedding row
+        raise ValueError(
+            f"prompt + max_new_tokens ({total}) exceeds the learned "
+            f"position table ({cfg.max_seq_len})")
     if key is None:
         key = jax.random.PRNGKey(0)
-    cache = init_kv_cache(cfg, b, total)
-    logits, cache = prefill(params, prompt, cfg, cache)
-
-    def step(carry, _):
-        logits, cache, key = carry
-        key, skey = jax.random.split(key)
-        tok = _sample(logits, skey, temperature, top_k)
-        logits, cache = decode_step(params, tok, cache, cfg)
-        return (logits, cache, key), tok
-
-    (_, _, _), toks = jax.lax.scan(step, (logits, cache, key), None,
-                                   length=max_new_tokens)
-    return jnp.swapaxes(toks, 0, 1)                            # [B, N]
+    return _generate_impl(
+        params, prompt, jnp.asarray(temperature, jnp.float32), key,
+        cfg=cfg, max_new_tokens=max_new_tokens,
+        greedy=(temperature == 0.0), top_k=top_k, total=total)

@@ -104,6 +104,35 @@ def test_sampling_modes_shapes_and_determinism():
     assert int(a.max()) < cfg.vocab_size and int(a.min()) >= 0
 
 
+def test_temperature_is_traced_no_recompile():
+    """Serving different temperatures must not recompile the program."""
+    from ray_tpu.models.generate import _generate_impl
+
+    cfg = TransformerConfig.tiny(max_seq_len=64,
+                                 attention_impl="reference",
+                                 dtype=jnp.float32)
+    params, _ = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    before = _generate_impl._cache_size()
+    for t in (0.5, 0.8, 1.3):
+        generate(params, prompt, cfg=cfg, max_new_tokens=3,
+                 temperature=t, key=jax.random.PRNGKey(0))
+    assert _generate_impl._cache_size() == before + 1
+
+
+def test_learned_positions_overflow_rejected():
+    cfg = TransformerConfig(vocab_size=64, d_model=16, n_layers=1,
+                            n_heads=2, max_seq_len=8,
+                            pos_emb="learned", activation="gelu",
+                            norm="layernorm", tie_embeddings=True,
+                            attention_impl="reference",
+                            dtype=jnp.float32, remat=False)
+    params, _ = init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError):
+        generate(params, jnp.zeros((1, 6), jnp.int32), cfg=cfg,
+                 max_new_tokens=4)
+
+
 def test_pp_config_rejected():
     cfg = TransformerConfig.tiny(max_seq_len=32, pp_stages=2,
                                  dtype=jnp.float32)
