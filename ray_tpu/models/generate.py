@@ -171,7 +171,7 @@ def _sample(logits: jnp.ndarray, key: jax.Array, greedy: bool,
                                     "top_k", "total"))
 def _generate_impl(params, prompt, temperature, key, *, cfg,
                    max_new_tokens, greedy, top_k, total):
-    b, s = prompt.shape
+    b = prompt.shape[0]
     cache = init_kv_cache(cfg, b, total)
     logits, cache = prefill(params, prompt, cfg, cache)
 
@@ -187,10 +187,11 @@ def _generate_impl(params, prompt, temperature, key, *, cfg,
 
     (logits, _, key), toks = jax.lax.scan(
         step, (logits, cache, key), None, length=max_new_tokens - 1)
-    key, skey = jax.random.split(key)
+    _, skey = jax.random.split(key)
     last = _sample(logits, skey, greedy, temperature, top_k)
-    toks = jnp.concatenate([toks, last[None]], axis=0) \
-        if max_new_tokens > 1 else last[None]
+    # scan with length=0 yields a [0, B] array, so this is total for
+    # every max_new_tokens >= 1
+    toks = jnp.concatenate([toks, last[None]], axis=0)
     return jnp.swapaxes(toks, 0, 1)                            # [B, N]
 
 
@@ -208,6 +209,9 @@ def generate(params: Params, prompt: jnp.ndarray, *,
     top_k, and the shape-bearing knobs are static).
     """
     b, s = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
     total = max_len or (s + max_new_tokens)
     if total < s + max_new_tokens:
         # a short cache would silently clamp writes onto the last slot
@@ -221,7 +225,10 @@ def generate(params: Params, prompt: jnp.ndarray, *,
             f"position table ({cfg.max_seq_len})")
     if key is None:
         key = jax.random.PRNGKey(0)
+    # the greedy switch must be a concrete host bool (it selects the
+    # compiled program); temperature itself stays traced
+    greedy = bool(float(temperature) == 0.0)
     return _generate_impl(
         params, prompt, jnp.asarray(temperature, jnp.float32), key,
         cfg=cfg, max_new_tokens=max_new_tokens,
-        greedy=(temperature == 0.0), top_k=top_k, total=total)
+        greedy=greedy, top_k=top_k, total=total)

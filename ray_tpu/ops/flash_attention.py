@@ -31,6 +31,16 @@ try:  # TPU-specific pallas extensions (memory spaces, compiler params)
 except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
+# Interpreter-mode switch: RAY_TPU_PALLAS_INTERPRET=1 runs the kernels
+# through the Pallas interpreter (any backend) — the off-chip validation
+# path for kernel logic (tests use it so the kernel math is proven even
+# when no TPU is attached).
+import os as _os
+
+def _interpret() -> bool:
+    return _os.environ.get("RAY_TPU_PALLAS_INTERPRET") == "1"
+
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30  # avoids -inf - -inf = nan in the online softmax
@@ -125,6 +135,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
+        interpret=_interpret(),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
@@ -248,7 +259,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     sem = (("parallel", "parallel", "parallel", "arbitrary")
            if _HAS_PLTPU else None)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        interpret=_interpret(),
+        kernel=functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k=num_k,
                           q_offset=s_kv - s_q),
         grid=(b, h, num_q, num_k),
@@ -276,7 +288,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     sem5 = (("parallel", "parallel", "parallel", "arbitrary", "arbitrary")
             if _HAS_PLTPU else None)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        interpret=_interpret(),
+        kernel=functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q=num_q,
                           group=group, q_offset=s_kv - s_q),
         grid=(b, h_kv, num_k, group, num_q),
