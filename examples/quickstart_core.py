@@ -1,0 +1,41 @@
+"""Core runtime quickstart: tasks, actors, objects, placement groups."""
+
+import numpy as np
+
+import ray_tpu
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    print("tasks:", ray_tpu.get([square.remote(i) for i in range(5)]))
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    ray_tpu.get([c.incr.remote() for _ in range(9)])
+    print("actor count:", ray_tpu.get(c.incr.remote()))
+
+    big = ray_tpu.put(np.arange(1_000_000))
+    print("zero-copy sum:", int(ray_tpu.get(big).sum()))
+
+    from ray_tpu.util import placement_group
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    print("placement group ready:", pg.wait(timeout_seconds=30))
+    print("EXAMPLE_OK quickstart_core")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

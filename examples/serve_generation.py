@@ -1,0 +1,45 @@
+"""Serve KV-cache text generation behind the HTTP proxy."""
+
+import requests
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+
+    @serve.deployment
+    class Generator:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models import TransformerConfig, init_params
+            self.jnp = jnp
+            self.cfg = TransformerConfig.tiny(max_seq_len=64,
+                                              attention_impl="reference",
+                                              dtype=jnp.float32)
+            self.params, _ = init_params(jax.random.PRNGKey(0), self.cfg)
+
+        def __call__(self, payload):
+            from ray_tpu.models import generate
+            prompt = self.jnp.asarray(payload["prompt"], self.jnp.int32)
+            toks = generate(self.params, prompt, cfg=self.cfg,
+                            max_new_tokens=int(payload.get("n", 8)))
+            return {"tokens": toks.tolist()}
+
+    serve.run(Generator.bind())
+    out = requests.post(f"{serve.http_address()}/Generator",
+                        json={"prompt": [[1, 2, 3]], "n": 5},
+                        timeout=120).json()
+    print("generated:", out["tokens"])
+    assert len(out["tokens"][0]) == 5
+    print("EXAMPLE_OK serve_generation")
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Hyperparameter search with the in-tree TPE searcher."""
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, session
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.search import TPESearch
+
+
+def objective(config):
+    session.report({"loss": (config["x"] - 2.0) ** 2 + config["y"]})
+
+
+def main():
+    import tempfile
+    ray_tpu.init(num_cpus=4)
+    space = {"x": tune.uniform(-5, 5), "y": tune.choice([0.0, 1.0])}
+    res = Tuner(
+        objective, param_space=space,
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=10,
+                               search_alg=TPESearch(space, metric="loss",
+                                                    mode="min")),
+        run_config=RunConfig(name="tpe_demo",
+                             storage_path=tempfile.mkdtemp()),
+    ).fit()
+    best = res.get_best_result()
+    print("best config:", best.metrics["config"],
+          "loss:", best.metrics["loss"])
+    print("EXAMPLE_OK tune_tpe")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

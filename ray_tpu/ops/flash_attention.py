@@ -259,8 +259,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     sem = (("parallel", "parallel", "parallel", "arbitrary")
            if _HAS_PLTPU else None)
     dq = pl.pallas_call(
-        interpret=_interpret(),
-        kernel=functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k=num_k,
                           q_offset=s_kv - s_q),
         grid=(b, h, num_q, num_k),
@@ -283,13 +282,13 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(dimension_semantics=sem)
         if _HAS_PLTPU else None,
+        interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
     sem5 = (("parallel", "parallel", "parallel", "arbitrary", "arbitrary")
             if _HAS_PLTPU else None)
     dk, dv = pl.pallas_call(
-        interpret=_interpret(),
-        kernel=functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q=num_q,
                           group=group, q_offset=s_kv - s_q),
         grid=(b, h_kv, num_k, group, num_q),
@@ -320,6 +319,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         compiler_params=pltpu.CompilerParams(dimension_semantics=sem5)
         if _HAS_PLTPU else None,
+        interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 

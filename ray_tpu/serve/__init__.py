@@ -12,10 +12,12 @@ from .api import (  # noqa: F401
     delete,
     get_deployment_handle,
     get_handle,
+    http_address,
     list_deployments,
     run,
     shutdown,
     start,
+    status_table,
 )
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
