@@ -73,7 +73,10 @@ def _run_measurement() -> dict:
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        cfg = TransformerConfig.gpt2("small")
+        # remat=False: gpt2-small at b8/s1024 fits HBM without
+        # rematerialization, and remat's recompute FLOPs are real work
+        # the MFU numerator does not count (~25-30% of the step)
+        cfg = TransformerConfig.gpt2("small", remat=False)
         batch, seq, steps = 8, 1024, 20
     else:  # smoke-test shape for CPU runs of this script
         cfg = TransformerConfig.tiny()

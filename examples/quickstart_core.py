@@ -32,7 +32,8 @@ def main():
 
     from ray_tpu.util import placement_group
     pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
-    print("placement group ready:", pg.wait(timeout_seconds=30))
+    assert pg.wait(timeout_seconds=30), "placement group not ready"
+    print("placement group ready: True")
     print("EXAMPLE_OK quickstart_core")
     ray_tpu.shutdown()
 

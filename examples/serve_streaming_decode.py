@@ -1,0 +1,68 @@
+"""Token-by-token serving: a stateful decode session on a Serve replica.
+
+TTFT-style serving without waiting for the full completion: the replica
+holds the KV cache between calls, so `start` pays one prefill and every
+`next_token` call is a single decode step (the reference delegates this
+to external engines; here it is the in-tree transformer runtime).
+"""
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+
+    @serve.deployment(max_concurrent_queries=4)
+    class DecodeSession:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models import TransformerConfig, init_params
+            self.jnp = jnp
+            self.cfg = TransformerConfig.tiny(max_seq_len=64,
+                                              attention_impl="reference",
+                                              dtype=jnp.float32)
+            self.params, _ = init_params(jax.random.PRNGKey(0), self.cfg)
+            self.sessions = {}
+            self._next = 0
+
+        def __call__(self, req):
+            from ray_tpu.models import decode_step, init_kv_cache, prefill
+            jnp = self.jnp
+            if req["op"] == "start":
+                prompt = jnp.asarray(req["prompt"], jnp.int32)
+                cache = init_kv_cache(self.cfg, prompt.shape[0], 64)
+                logits, cache = prefill(self.params, prompt, self.cfg,
+                                        cache)
+                sid = self._next
+                self._next += 1
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                self.sessions[sid] = (cache, tok)
+                return {"sid": sid, "token": tok.tolist()}
+            cache, tok = self.sessions[req["sid"]]
+            logits, cache = decode_step(self.params, tok, cache, self.cfg)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            self.sessions[req["sid"]] = (cache, tok)
+            return {"token": tok.tolist()}
+
+    handle = serve.run(DecodeSession.bind())
+    out = handle.remote({"op": "start", "prompt": [[5, 6, 7]]}).result(
+        timeout_s=180.0)
+    sid = out["sid"]
+    stream = [out["token"][0]]
+    for _ in range(4):
+        out = handle.remote({"op": "next", "sid": sid}).result(
+            timeout_s=60.0)
+        stream.append(out["token"][0])
+    print("streamed tokens:", stream)
+    assert len(stream) == 5
+    print("EXAMPLE_OK serve_streaming_decode")
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

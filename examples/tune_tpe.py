@@ -15,15 +15,17 @@ def main():
     import tempfile
     ray_tpu.init(num_cpus=4)
     space = {"x": tune.uniform(-5, 5), "y": tune.choice([0.0, 1.0])}
-    res = Tuner(
-        objective, param_space=space,
-        tune_config=TuneConfig(metric="loss", mode="min", num_samples=10,
-                               search_alg=TPESearch(space, metric="loss",
-                                                    mode="min")),
-        run_config=RunConfig(name="tpe_demo",
-                             storage_path=tempfile.mkdtemp()),
-    ).fit()
-    best = res.get_best_result()
+    with tempfile.TemporaryDirectory() as storage:
+        res = Tuner(
+            objective, param_space=space,
+            tune_config=TuneConfig(metric="loss", mode="min",
+                                   num_samples=10,
+                                   search_alg=TPESearch(space,
+                                                        metric="loss",
+                                                        mode="min")),
+            run_config=RunConfig(name="tpe_demo", storage_path=storage),
+        ).fit()
+        best = res.get_best_result()
     print("best config:", best.metrics["config"],
           "loss:", best.metrics["loss"])
     print("EXAMPLE_OK tune_tpe")
