@@ -75,8 +75,10 @@ def _run_measurement() -> dict:
     if on_tpu:
         # remat=False: gpt2-small at b8/s1024 fits HBM without
         # rematerialization, and remat's recompute FLOPs are real work
-        # the MFU numerator does not count (~25-30% of the step)
-        cfg = TransformerConfig.gpt2("small", remat=False)
+        # the MFU numerator does not count (~25-30% of the step).
+        # loss_chunk: never materialize the full [8, 1024, 50304] fp32
+        # logits (1.6 GB) — one [8, 128, 50304] block at a time.
+        cfg = TransformerConfig.gpt2("small", remat=False, loss_chunk=128)
         batch, seq, steps = 8, 1024, 20
     else:  # smoke-test shape for CPU runs of this script
         cfg = TransformerConfig.tiny()

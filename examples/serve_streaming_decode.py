@@ -26,6 +26,10 @@ def main():
                                               attention_impl="reference",
                                               dtype=jnp.float32)
             self.params, _ = init_params(jax.random.PRNGKey(0), self.cfg)
+            # the replica runs threaded (max_concurrent_queries > 1):
+            # session state needs a lock
+            import threading
+            self._lock = threading.Lock()
             self.sessions = {}
             self._next = 0
 
@@ -37,15 +41,18 @@ def main():
                 cache = init_kv_cache(self.cfg, prompt.shape[0], 64)
                 logits, cache = prefill(self.params, prompt, self.cfg,
                                         cache)
-                sid = self._next
-                self._next += 1
                 tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                self.sessions[sid] = (cache, tok)
+                with self._lock:
+                    sid = self._next
+                    self._next += 1
+                    self.sessions[sid] = (cache, tok)
                 return {"sid": sid, "token": tok.tolist()}
-            cache, tok = self.sessions[req["sid"]]
+            with self._lock:
+                cache, tok = self.sessions.pop(req["sid"])
             logits, cache = decode_step(self.params, tok, cache, self.cfg)
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            self.sessions[req["sid"]] = (cache, tok)
+            with self._lock:
+                self.sessions[req["sid"]] = (cache, tok)
             return {"token": tok.tolist()}
 
     handle = serve.run(DecodeSession.bind())

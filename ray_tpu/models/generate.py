@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.rotary import apply_rotary, rotary_angles
-from .transformer import TransformerConfig, _ffn, _layer, _norm
+from .transformer import TransformerConfig, _ffn, _layer, _norm, _unembed
 
 Params = Any
 KVCache = Dict[str, jnp.ndarray]   # {"k","v": [L, B, max_len, hk, hd], "pos"}
@@ -77,9 +77,7 @@ def prefill(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
-    w_out = (params["embed"]["tok"].T if cfg.tie_embeddings
-             else params["lm_head"])
-    logits = jnp.einsum("bd,dv->bv", x[:, -1], w_out.astype(dt))
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], _unembed(params, cfg))
 
     if s > cache["k"].shape[2]:
         raise ValueError(f"prompt length {s} exceeds cache capacity "
@@ -148,9 +146,7 @@ def decode_step(params: Params, token: jnp.ndarray, cache: KVCache,
     x, (ks, vs) = jax.lax.scan(body, x,
                                (params["layers"], cache["k"], cache["v"]))
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
-    w_out = (params["embed"]["tok"].T if cfg.tie_embeddings
-             else params["lm_head"])
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], w_out.astype(dt))
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], _unembed(params, cfg))
     return logits.astype(jnp.float32), {"k": ks, "v": vs, "pos": pos + 1}
 
 

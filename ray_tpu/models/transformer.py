@@ -53,6 +53,10 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     attention_impl: str = "auto"      # "auto"|"flash"|"reference"|"ring"
     remat: bool = True
+    loss_chunk: int = 0               # >0 → chunked cross entropy: logits
+    #   materialize [b, chunk, vocab] at a time (rematerialized in bwd)
+    #   instead of the full [b, s, vocab] fp32 tensor — the biggest HBM
+    #   spike of LM training at GPT-2 vocab sizes
     # -- pipeline parallelism (SURVEY §2.4 row 3; parallel/pipeline.py) -----
     pp_stages: int = 1                # >1 → GPipe schedule over mesh "pp"
     pp_microbatches: Optional[int] = None  # None → pp_stages
@@ -291,13 +295,10 @@ def _ffn(cfg: TransformerConfig, y: jnp.ndarray, lp: Params
     return jnp.einsum("bsf,fd->bsd", z, lp["w_out"].astype(dt)), aux
 
 
-def forward_with_aux(params: Params, tokens: jnp.ndarray,
-                     cfg: TransformerConfig
-                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens [batch, seq] int32 → (logits [batch, seq, vocab] fp32,
-    mean router aux loss).  With ``cfg.pp_stages > 1`` the layer stack runs
-    as a GPipe pipeline over the ambient mesh's ``pp`` axis
-    (parallel/pipeline.py); otherwise a plain `lax.scan`."""
+def _trunk(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Everything up to (and including) the final norm:
+    tokens [b, s] → (hidden [b, s, d] in cfg.dtype, mean router aux)."""
     b, s = tokens.shape
     dt = cfg.dtype
     x = params["embed"]["tok"][tokens].astype(dt)
@@ -340,9 +341,24 @@ def forward_with_aux(params: Params, tokens: jnp.ndarray,
             body, (x, jnp.zeros((), jnp.float32)), params["layers"])
         aux = aux / cfg.n_layers
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
-    w_out = (params["embed"]["tok"].T if cfg.tie_embeddings
-             else params["lm_head"])
-    logits = jnp.einsum("bsd,dv->bsv", x, w_out.astype(dt))
+    return x, aux
+
+
+def _unembed(params: Params, cfg: TransformerConfig) -> jnp.ndarray:
+    w = (params["embed"]["tok"].T if cfg.tie_embeddings
+         else params["lm_head"])
+    return w.astype(cfg.dtype)
+
+
+def forward_with_aux(params: Params, tokens: jnp.ndarray,
+                     cfg: TransformerConfig
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [batch, seq] int32 → (logits [batch, seq, vocab] fp32,
+    mean router aux loss).  With ``cfg.pp_stages > 1`` the layer stack runs
+    as a GPipe pipeline over the ambient mesh's ``pp`` axis
+    (parallel/pipeline.py); otherwise a plain `lax.scan`."""
+    x, aux = _trunk(params, tokens, cfg)
+    logits = jnp.einsum("bsd,dv->bsv", x, _unembed(params, cfg))
     return logits.astype(jnp.float32), aux
 
 
@@ -355,21 +371,67 @@ def forward(params: Params, tokens: jnp.ndarray,
 def lm_loss(params: Params, batch: Dict[str, jnp.ndarray],
             cfg: TransformerConfig) -> jnp.ndarray:
     """Next-token cross entropy.  ``batch`` has "tokens" [b, s]; loss is on
-    positions 0..s-2 predicting 1..s-1."""
+    positions 0..s-2 predicting 1..s-1.
+
+    With ``cfg.loss_chunk`` set (and dividing s), the unembed + softmax
+    runs chunk-by-chunk under `jax.checkpoint`, so only one
+    [b, chunk, vocab] logits block exists at a time (forward AND
+    backward) instead of the full [b, s, vocab] fp32 tensor.
+    """
     import optax
 
     # run the model on the FULL sequence and shift the logits: keeps the
     # model's seq length divisible by sequence-parallel mesh axes (sp)
     tokens = batch["tokens"]
+    b, s = tokens.shape
+    aux_weight = cfg.router_aux_weight if cfg.n_experts else 0.0
+    mask = batch.get("mask")
+
+    if cfg.loss_chunk and s % cfg.loss_chunk:
+        # falling back silently would re-materialize the full
+        # [b, s, vocab] logits — the OOM cliff loss_chunk exists to avoid
+        raise ValueError(f"seq length {s} is not divisible by "
+                         f"loss_chunk={cfg.loss_chunk}")
+    if cfg.loss_chunk:
+        x, aux = _trunk(params, tokens, cfg)
+        w_out = _unembed(params, cfg)
+        # target for the LAST position is a dummy masked to weight 0
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
+        valid = jnp.concatenate(
+            [jnp.ones((b, s - 1), jnp.float32),
+             jnp.zeros((b, 1), jnp.float32)], axis=1)
+        if mask is not None:
+            shifted = jnp.concatenate(
+                [mask[:, 1:], jnp.zeros((b, 1), mask.dtype)], axis=1)
+            valid = valid * shifted.astype(jnp.float32)
+        n = s // cfg.loss_chunk
+        xc = jnp.swapaxes(x.reshape(b, n, cfg.loss_chunk, -1), 0, 1)
+        tc = jnp.swapaxes(targets.reshape(b, n, cfg.loss_chunk), 0, 1)
+        vc = jnp.swapaxes(valid.reshape(b, n, cfg.loss_chunk), 0, 1)
+
+        def chunk_sum(xi, ti, vi):
+            logits = jnp.einsum("bcd,dv->bcv", xi,
+                                w_out).astype(jnp.float32)
+            ls = optax.softmax_cross_entropy_with_integer_labels(logits, ti)
+            return (ls * vi).sum()
+
+        def body(acc, inp):
+            xi, ti, vi = inp
+            return acc + jax.checkpoint(chunk_sum)(xi, ti, vi), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (xc, tc, vc))
+        return total / jnp.maximum(valid.sum(), 1.0) + aux_weight * aux
+
     logits, aux = forward_with_aux(params, tokens, cfg)
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    aux_term = cfg.router_aux_weight * aux if cfg.n_experts else 0.0
-    mask = batch.get("mask")
+    aux_term = aux_weight * aux
     if mask is not None:
-        mask = mask[:, 1:].astype(jnp.float32)
-        return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0) + aux_term
+        m = mask[:, 1:].astype(jnp.float32)
+        return (losses * m).sum() / jnp.maximum(m.sum(), 1.0) + aux_term
     return losses.mean() + aux_term
 
 
