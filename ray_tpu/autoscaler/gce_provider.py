@@ -1,0 +1,98 @@
+"""GCE VM node provider: scale with plain Compute Engine instances.
+
+Capability mirror of the reference's GCP provider
+(/root/reference/python/ray/autoscaler/_private/gcp/node_provider.py) for
+the CPU-worker side of a TPU cluster (data loading, preprocessing,
+rollout workers — anything that doesn't need chips).  Same design as
+`tpu_pod_provider.py`: all cloud mutations go through the ``gcloud`` CLI
+(zero SDK dependencies; unit tests inject a fake runner), and every
+created instance boots a startup script that joins the cluster with
+``ray-tpu start --address <head>``.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+from typing import Any, Callable, Dict, List, Optional
+
+from .node_provider import NodeProvider
+from .tpu_pod_provider import _run_gcloud
+
+_DEFAULT_RESOURCES = {"CPU": 4.0}
+
+
+class GceProvider(NodeProvider):
+    """Provision/terminate worker VMs via ``gcloud compute instances``.
+
+    node_types maps a logical name to the instance shape, e.g.::
+
+        {"cpu_16": {"machine_type": "n2-standard-16",
+                    "host_resources": {"CPU": 16}},
+         "highmem": {"machine_type": "n2-highmem-8",
+                     "image_family": "debian-12",
+                     "image_project": "debian-cloud"}}
+    """
+
+    def __init__(self, *, project: str, zone: str, head_address: str,
+                 node_types: Dict[str, Dict[str, Any]],
+                 name_prefix: str = "ray-tpu-w",
+                 runner: Optional[Callable[[List[str]], str]] = None):
+        self.project = project
+        self.zone = zone
+        self.head_address = head_address
+        self.node_types = node_types
+        self.name_prefix = name_prefix
+        self._run = runner or _run_gcloud
+        self._seq = 0
+
+    # -- provider contract ---------------------------------------------------
+    def node_resources(self, node_type: str) -> Dict[str, float]:
+        nt = self.node_types[node_type]
+        return dict(nt.get("host_resources", _DEFAULT_RESOURCES))
+
+    def create_node(self, node_type: str) -> str:
+        nt = self.node_types[node_type]
+        self._seq += 1
+        name = f"{self.name_prefix}-{node_type}-{self._seq}".replace(
+            "_", "-")
+        args = [
+            "compute", "instances", "create", name,
+            "--project", self.project, "--zone", self.zone,
+            "--machine-type", nt.get("machine_type", "n2-standard-4"),
+            "--metadata", f"startup-script={self._startup_script(nt)}",
+        ]
+        if nt.get("image_family"):
+            args += ["--image-family", nt["image_family"]]
+        if nt.get("image_project"):
+            args += ["--image-project", nt["image_project"]]
+        self._run(args, timeout=600.0)
+        return name
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self._run([
+            "compute", "instances", "delete", provider_node_id,
+            "--project", self.project, "--zone", self.zone, "--quiet",
+        ], timeout=600.0)
+
+    def non_terminated_nodes(self) -> List[str]:
+        out = self._run([
+            "compute", "instances", "list",
+            "--project", self.project,
+            "--zones", self.zone,
+            "--format", "json",
+        ])
+        nodes = json.loads(out or "[]")
+        return [n["name"] for n in nodes
+                if n["name"].startswith(self.name_prefix)
+                and n.get("status") in ("RUNNING", "PROVISIONING",
+                                        "STAGING", None)]
+
+    # -- wiring ---------------------------------------------------------------
+    def _startup_script(self, nt: Dict[str, Any]) -> str:
+        extra = nt.get("setup_commands", [])
+        res = dict(nt.get("host_resources", _DEFAULT_RESOURCES))
+        join = (f"ray-tpu start --address "
+                f"{shlex.quote(self.head_address)} "
+                f"--num-cpus {int(res.get('CPU', 4))}")
+        return "#! /bin/bash\n" + "\n".join([*extra, join]) + "\n"

@@ -86,3 +86,51 @@ def test_bin_packing_against_tpu_demand(provider):
         assert node_type == "v4_32" and count == 1
     finally:
         request_resources([])
+
+
+class FakeGcloudVm:
+    def __init__(self):
+        self.calls = []
+        self.instances = {}
+
+    def __call__(self, args, timeout=None):
+        self.calls.append(args)
+        if args[:2] == ["compute", "instances"]:
+            verb = args[2]
+            if verb == "create":
+                name = args[3]
+                self.instances[name] = {"name": name, "status": "RUNNING"}
+                return ""
+            if verb == "delete":
+                self.instances.pop(args[3], None)
+                return ""
+            if verb == "list":
+                return json.dumps(list(self.instances.values()))
+        raise AssertionError(f"unexpected gcloud args {args}")
+
+
+def test_gce_provider_lifecycle():
+    """GCE VM provider: create/list/terminate through the gcloud CLI
+    boundary, with the join startup script wired (reference:
+    autoscaler/_private/gcp/node_provider.py)."""
+    from ray_tpu.autoscaler.gce_provider import GceProvider
+
+    fake = FakeGcloudVm()
+    p = GceProvider(project="proj", zone="us-central1-a",
+                    head_address="10.0.0.2:6379",
+                    node_types={"cpu_16": {
+                        "machine_type": "n2-standard-16",
+                        "host_resources": {"CPU": 16}}},
+                    runner=fake)
+    assert p.node_resources("cpu_16") == {"CPU": 16}
+    nid = p.create_node("cpu_16")
+    assert nid.startswith("ray-tpu-w-cpu-16")
+    create_args = fake.calls[0]
+    assert "--machine-type" in create_args and \
+        "n2-standard-16" in create_args
+    startup = [a for a in create_args if a.startswith("startup-script=")]
+    assert startup and "ray-tpu start --address 10.0.0.2:6379" in startup[0]
+    assert "--num-cpus 16" in startup[0]
+    assert p.non_terminated_nodes() == [nid]
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
