@@ -60,7 +60,9 @@ class GceProvider(NodeProvider):
             "compute", "instances", "create", name,
             "--project", self.project, "--zone", self.zone,
             "--machine-type", nt.get("machine_type", "n2-standard-4"),
-            "--metadata", f"startup-script={self._startup_script(nt)}",
+            # comma-safe custom delimiter (see tpu_pod_provider)
+            "--metadata",
+            f"^|@|^startup-script={self._startup_script(nt)}",
         ]
         if nt.get("image_family"):
             args += ["--image-family", nt["image_family"]]

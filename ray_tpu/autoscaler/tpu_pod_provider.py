@@ -79,7 +79,10 @@ class TpuPodProvider(NodeProvider):
             "--accelerator-type", nt["accelerator_type"],
             "--version", nt.get("runtime_version",
                                 "tpu-ubuntu2204-base"),
-            "--metadata", f"startup-script={startup}",
+            # ^|@|^ sets a custom list delimiter: gcloud otherwise splits
+            # --metadata on COMMAS, truncating any script that
+            # contains one
+            "--metadata", f"^|@|^startup-script={startup}",
         ], timeout=600.0)
         return name
 

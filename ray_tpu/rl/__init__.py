@@ -13,6 +13,7 @@ supported through rollout-worker actors like the reference's sampler.
 from .algorithm import Algorithm  # noqa: F401
 from .dqn import DQN, DQNConfig, QNetwork  # noqa: F401
 from .env import CartPole, JaxEnv, Pendulum  # noqa: F401
+from .es import ES, ESConfig  # noqa: F401
 from .impala import Impala, ImpalaConfig  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
 from .offline import (  # noqa: F401

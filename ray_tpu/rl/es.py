@@ -1,0 +1,197 @@
+"""Evolution strategies: derivative-free RL by cluster-wide fan-out.
+
+Capability mirror of the reference's ES/ARS family
+(`rllib/algorithms/es/es.py` — perturb the policy, evaluate episodes in
+parallel workers, estimate the gradient from ranked returns).  The shape
+that makes ES interesting here is the RUNTIME's: each iteration fans one
+task per perturbation pair across the cluster (tasks, not actors — ES
+evaluation is stateless), ships only a SEED per task (workers regenerate
+the noise locally, the classic bandwidth trick), and the jitted
+evaluator runs the whole episode batch as one XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import mlp_apply, mlp_init
+
+
+def _flatten(params):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    flat = jnp.concatenate([jnp.ravel(x) for x in leaves])
+    shapes = [x.shape for x in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    return flat, (treedef, shapes, sizes)
+
+
+def _unflatten(flat, meta):
+    treedef, shapes, sizes = meta
+    out, off = [], 0
+    for shape, size in zip(shapes, sizes):
+        out.append(flat[off:off + size].reshape(shape))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def make_eval_fn(env: JaxEnv, n_episodes: int, horizon: int):
+    """Jittable: (params, key) → mean undiscounted return of
+    ``n_episodes`` vectorized episodes under the DETERMINISTIC policy."""
+
+    def evaluate(params, key):
+        ekeys = jax.random.split(key, n_episodes)
+        states, obs = jax.vmap(env.reset)(ekeys)
+
+        def step(carry, _):
+            states, obs, ret, done, key = carry
+            out = jax.vmap(lambda o: mlp_apply(params, o))(obs)
+            if env.discrete:
+                action = jnp.argmax(out, axis=-1)
+            else:
+                action = env.action_high * jnp.tanh(out)
+            key, skey = jax.random.split(key)
+            skeys = jax.random.split(skey, n_episodes)
+            states, obs, reward, step_done = jax.vmap(env.step)(
+                states, action, skeys)
+            ret = ret + reward * (1.0 - done)
+            done = jnp.maximum(done, step_done.astype(jnp.float32))
+            return (states, obs, ret, done, key), None
+
+        init = (states, obs, jnp.zeros(n_episodes),
+                jnp.zeros(n_episodes), key)
+        (_, _, ret, _, _), _ = jax.lax.scan(step, init, None,
+                                            length=horizon)
+        return ret.mean()
+
+    return evaluate
+
+
+@dataclasses.dataclass
+class ESConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_perturbations: int = 16    # antithetic PAIRS per iteration
+    sigma: float = 0.1             # perturbation stddev
+    lr: float = 0.05
+    episodes_per_eval: int = 4
+    horizon: int = 200
+    num_workers: int = 0           # 0 = evaluate inline on the driver
+    hidden: tuple = (32, 32)
+    seed: int = 0
+
+    def build(self) -> "ES":
+        return ES(self)
+
+
+def _es_eval_task(env_factory, episodes, horizon, flat_np, meta,
+                  sigma, noise_seed):
+    """One perturbation pair, runnable as a cluster task: regenerate the
+    noise from its seed sequence, evaluate +eps and -eps."""
+    env = env_factory()
+    evaluate = jax.jit(make_eval_fn(env, episodes, horizon))
+    base = jnp.asarray(flat_np)
+    rng = np.random.default_rng(np.random.SeedSequence(noise_seed))
+    eps = jnp.asarray(rng.standard_normal(base.shape[0], dtype=np.float32))
+    eval_key = jax.random.PRNGKey(noise_seed[-1] if
+                                  isinstance(noise_seed, (list, tuple))
+                                  else noise_seed)
+    r_pos = float(evaluate(_unflatten(base + sigma * eps, meta), eval_key))
+    r_neg = float(evaluate(_unflatten(base - sigma * eps, meta), eval_key))
+    return r_pos, r_neg
+
+
+class ES(Algorithm):
+    _config_cls = ESConfig
+
+    def __init__(self, config: ESConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("ESConfig.env required (an env factory)")
+        self.env = cfg.env()
+        n_out = self.env.action_size
+        key = jax.random.PRNGKey(cfg.seed)
+        params = mlp_init(key, (self.env.observation_size,)
+                          + tuple(cfg.hidden) + (n_out,))
+        self.flat, self.meta = _flatten(params)
+        self._eval = jax.jit(make_eval_fn(self.env,
+                                          cfg.episodes_per_eval,
+                                          cfg.horizon))
+        self._iter_seed = cfg.seed
+        self._remote_task = None
+
+    # -- one ES iteration ---------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        self._iter_seed += 1
+        # SeedSequence entropy lists mix (config seed, iteration, index)
+        # NON-linearly: adjacent config seeds must not share noise streams
+        seeds = [[cfg.seed, self._iter_seed, i]
+                 for i in range(cfg.num_perturbations)]
+        flat_np = np.asarray(self.flat)  # one device->host transfer
+
+        if cfg.num_workers > 0:
+            import ray_tpu
+            flat_ref = ray_tpu.put(flat_np)
+            if self._remote_task is None:  # register the task once
+                self._remote_task = ray_tpu.remote(_es_eval_task)
+            refs = [self._remote_task.remote(
+                        cfg.env, cfg.episodes_per_eval, cfg.horizon,
+                        flat_ref, self.meta, cfg.sigma, s)
+                    for s in seeds]
+            results = ray_tpu.get(refs, timeout=600.0)
+        else:
+            results = [_es_eval_task(cfg.env, cfg.episodes_per_eval,
+                                     cfg.horizon, flat_np, self.meta,
+                                     cfg.sigma, s)
+                       for s in seeds]
+
+        r_pos = np.asarray([r[0] for r in results])
+        r_neg = np.asarray([r[1] for r in results])
+        # centered-rank normalization over the 2n evaluations (the
+        # public ES recipe: robust to return scale)
+        all_r = np.concatenate([r_pos, r_neg])
+        ranks = np.empty_like(all_r)
+        ranks[np.argsort(all_r)] = np.arange(all_r.size)
+        ranks = ranks / (all_r.size - 1) - 0.5
+        w = ranks[:len(r_pos)] - ranks[len(r_pos):]
+
+        grad = np.zeros(self.flat.shape[0], dtype=np.float32)
+        for wi, s in zip(w, seeds):
+            rng = np.random.default_rng(np.random.SeedSequence(s))
+            grad += wi * rng.standard_normal(self.flat.shape[0],
+                                             dtype=np.float32)
+        grad /= (len(seeds) * cfg.sigma)
+        self.flat = self.flat + cfg.lr * jnp.asarray(grad)
+
+        dt = time.perf_counter() - t0
+        episodes = 2 * len(seeds) * cfg.episodes_per_eval
+        mean_return = float(self._eval(
+            _unflatten(self.flat, self.meta),
+            jax.random.PRNGKey(self._iter_seed)))
+
+        return {"episode_reward_mean": mean_return,
+                "perturbations": len(seeds),
+                "env_steps_this_iter": episodes * cfg.horizon,
+                "env_steps_per_s": episodes * cfg.horizon / dt}
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        return {"flat": np.asarray(self.flat),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.flat = jnp.asarray(state["flat"])
+        self.iteration = state.get("iteration", 0)
+        # resume the noise stream where it left off — replaying seeds
+        # already trained on would break the gradient estimate's
+        # independence assumption
+        self._iter_seed = self.config.seed + self.iteration

@@ -128,7 +128,8 @@ def test_gce_provider_lifecycle():
     create_args = fake.calls[0]
     assert "--machine-type" in create_args and \
         "n2-standard-16" in create_args
-    startup = [a for a in create_args if a.startswith("startup-script=")]
+    startup = [a for a in create_args
+               if a.startswith("^|@|^startup-script=")]
     assert startup and "ray-tpu start --address 10.0.0.2:6379" in startup[0]
     assert "--num-cpus 16" in startup[0]
     assert p.non_terminated_nodes() == [nid]
