@@ -15,6 +15,7 @@ from .api import (  # noqa: F401
     ClientContext,
     RemoteFunction,
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,

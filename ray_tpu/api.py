@@ -417,6 +417,17 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     _ensure_initialized().kill_actor(actor._actor_id, no_restart)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Cancel the task producing ``ref`` (reference: `ray.cancel`).
+
+    Queued tasks unschedule immediately; running tasks are interrupted
+    in-band (or their worker killed with ``force=True``).  Getting a
+    cancelled ref raises ``TaskCancelledError``.  Returns False when
+    there is nothing to cancel: the task already finished, or the ref
+    belongs to an actor task (kill the actor instead) or a put."""
+    return _ensure_initialized().cancel(ref, force=force)
+
+
 def get_actor(name: str) -> ActorHandle:
     core = _ensure_initialized()
     info = core.controller.call("get_named_actor", {"name": name})

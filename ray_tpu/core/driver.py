@@ -167,6 +167,8 @@ class CoreClient:
         self._value_finalizers: list = []  # detached at shutdown (segfault guard)
         self._state_conns: Dict[str, rpc.Connection] = {}  # state.py pool
         self._state_conns_lock = threading.Lock()
+        self._cancelled: set = set()   # task_ids cancel() was called on
+        self._task_sites: Dict[bytes, rpc.Connection] = {}  # running tasks
         if mode == "driver":
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
@@ -679,7 +681,12 @@ class CoreClient:
                     continue
                 continue
             spec, attempts_left = state.queue.popleft()
+            tid = spec.task_id.binary()
+            if tid in self._cancelled:
+                self._finish_cancel(spec)  # cancelled while queued
+                continue
             state.busy += 1
+            self._task_sites[tid] = conn
             try:
                 # The queue may still hold tasks that must run CONCURRENTLY
                 # with this one; with this loop now busy, grow the pool.
@@ -688,13 +695,17 @@ class CoreClient:
                                         timeout=None)
             except rpc.RpcError as e:
                 self._worker_conns.pop(worker_addr, None)
-                if attempts_left > 0:
+                if tid in self._cancelled:
+                    # force-cancel killed the worker: that IS the cancel
+                    self._finish_cancel(spec)
+                elif attempts_left > 0:
                     state.queue.appendleft((spec, attempts_left - 1))
                 else:
                     self._fail_task(spec, f"worker died executing task: {e}")
                 return  # lease is dead either way
             finally:
                 state.busy -= 1
+                self._task_sites.pop(tid, None)
             retried = self._handle_task_reply(spec, reply, attempts_left, state)
             if retried:
                 continue
@@ -705,7 +716,25 @@ class CoreClient:
                            state: Optional[_SchedulingKeyState]) -> bool:
         """Returns True if the task was re-queued for retry."""
         err = reply.get("error")
+        tid = spec.task_id.binary()
+        if err is None:
+            # a late cancel lost the race: the stale entry must not
+            # poison a future lineage resubmission of the same task_id
+            self._cancelled.discard(tid)
         if err is not None:
+            if tid in self._cancelled:
+                # an interrupted task errors out (TaskCancelledError raised
+                # in the worker); surface THE CANCEL, never retry
+                self._finish_cancel(spec)
+                return False
+            if self._is_spurious_cancel(err) and state is not None \
+                    and attempts_left > 0:
+                # PyThreadState_SetAsyncExc can land in a pool thread that
+                # already moved on to ANOTHER task — a cancel error for a
+                # task nobody cancelled is that victim: retry it
+                state.queue.append((spec, attempts_left - 1))
+                state.wakeup.set()
+                return True
             if spec.retry_exceptions and attempts_left > 0 and state is not None:
                 state.queue.append((spec, attempts_left - 1))
                 state.wakeup.set()
@@ -748,6 +777,72 @@ class CoreClient:
 
     def _fail_task(self, spec: TaskSpec, reason: str):
         self._store_error(spec, _ErrorValue(reason, None, spec.function_name))
+
+    # ---------------------------------------------------------------- cancel
+    def cancel(self, ref: "ObjectRef", *, force: bool = False) -> bool:
+        """Cancel the task that produces ``ref`` (reference:
+        `CoreWorker::CancelTask` / `ray.cancel`).  Queued tasks unschedule
+        immediately; running tasks get an in-band interrupt
+        (TaskCancelledError raised in the worker thread / asyncio task),
+        or — with ``force`` — their worker process is killed.  Returns
+        False when the task already finished (no-op, like the reference).
+        Getting a cancelled ref raises TaskCancelledError."""
+        oid = ref.binary()
+        spec = self._lineage.get(oid)
+        if spec is None:
+            # finished (lineage released), an actor-task ref (no lineage —
+            # kill the actor instead), or a plain put: nothing to cancel
+            return False
+        if spec.actor_id is not None or spec.actor_creation_id is not None:
+            return False  # actor work cancels by killing the actor
+        if self.memory_store.peek(oid) is not None:
+            return False  # result already landed
+        tid = spec.task_id.binary()
+        self._cancelled.add(tid)
+        state = self._sched.get(spec.scheduling_key())
+        if state is not None:
+            for item in list(state.queue):
+                if item[0].task_id.binary() == tid:
+                    try:
+                        state.queue.remove(item)
+                    except ValueError:
+                        break  # a lease loop grabbed it: fall through
+                    self._finish_cancel(spec)
+                    return True
+        conn = self._task_sites.get(tid)
+        if conn is not None:
+            try:
+                self.lt.run(conn.notify("cancel_task", {
+                    "task_id": tid, "force": force}))
+            except Exception:
+                pass
+        return True
+
+    @staticmethod
+    def _is_spurious_cancel(err: dict) -> bool:
+        pickled = err.get("pickled")
+        if not pickled:
+            return False
+        try:
+            return isinstance(serialization.loads_function(pickled),
+                              exceptions.TaskCancelledError)
+        except Exception:
+            return False
+
+    def _finish_cancel(self, spec: TaskSpec):
+        """Fulfill a cancelled task's refs with TaskCancelledError and
+        drop its pins."""
+        self._cancelled.discard(spec.task_id.binary())
+        try:
+            pickled = serialization.dumps_function(
+                exceptions.TaskCancelledError(
+                    f"task {spec.function_name} was cancelled"))
+        except Exception:
+            pickled = None
+        # _store_error releases the arg refs and extra pins itself
+        self._store_error(spec, _ErrorValue(
+            f"task {spec.function_name} was cancelled", pickled,
+            spec.function_name))
 
     def _propagate_error(self, spec: TaskSpec, error_value):
         if isinstance(error_value, _ErrorValue):

@@ -71,8 +71,12 @@ class WorkerRuntime:
         self._dying = False
         self._shutdown = asyncio.Event()
         for name in ("push_task", "create_actor", "push_actor_task", "ping",
-                     "exit", "actor_checkpoint"):
+                     "exit", "actor_checkpoint", "cancel_task"):
             self.server.register(name, getattr(self, "_h_" + name))
+        self._running_threads: Dict[bytes, int] = {}   # task_id -> thread id
+        self._running_aio: Dict[bytes, Any] = {}       # task_id -> aio task
+        self._inflight: set = set()            # pushed, not yet replied
+        self._cancel_requested: set = set()    # cancel seen pre-user-code
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -202,8 +206,47 @@ class WorkerRuntime:
                 out.append({"plasma": size, "contained": bool(contained)})
         return out
 
-    def _run_user_code(self, fn, args, kwargs):
-        return fn(*args, **kwargs)
+    def _run_user_code(self, fn, args, kwargs, task_id=None):
+        if task_id is not None:
+            if task_id in self._cancel_requested:
+                # cancelled while queued in the executor (before any
+                # thread/aio registration existed to interrupt)
+                raise exceptions.TaskCancelledError("task was cancelled")
+            import threading
+            self._running_threads[task_id] = threading.get_ident()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            if task_id is not None:
+                self._running_threads.pop(task_id, None)
+
+    async def _h_cancel_task(self, conn, data):
+        """In-band task cancellation (reference: CancelTask RPC +
+        KillActor-style force).  Sync tasks get TaskCancelledError raised
+        asynchronously in their thread; asyncio tasks are cancelled at
+        their next await; tasks still queued worker-side trip the
+        cancel-requested flag before user code starts; force exits the
+        process (the driver converts the dead-worker error into the
+        cancel).  A task NOT in flight here is a no-op — force must not
+        kill a worker over a task that already finished."""
+        tid = data["task_id"]
+        if tid not in self._inflight:
+            return False
+        if data.get("force"):
+            import os as _os
+            _os._exit(1)
+        self._cancel_requested.add(tid)
+        aio = self._running_aio.get(tid)
+        if aio is not None:
+            aio.cancel()
+            return True
+        ident = self._running_threads.get(tid)
+        if ident is not None:
+            import ctypes
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident),
+                ctypes.py_object(exceptions.TaskCancelledError))
+        return True
 
     def _is_async(self, fn) -> bool:
         import inspect
@@ -219,6 +262,7 @@ class WorkerRuntime:
         thread pool.  Both lanes honor per-task runtime envs."""
         import inspect
         renv = spec.runtime_env
+        tid = spec.task_id.binary()
         group = spec.concurrency_group or "_default"
         if self._is_async(fn):
             sem = self._group_sems.get(group) or self._group_sems.get(
@@ -227,23 +271,40 @@ class WorkerRuntime:
                 sem = self._group_sems["_default"] = asyncio.Semaphore(
                     max(1, self.actor_max_concurrency))
             async with sem:
-                if renv:
-                    from . import runtime_env as _renv
-                    with _renv.applied(renv):
-                        return await fn(*args, **kwargs)
-                return await fn(*args, **kwargs)
+                if tid in self._cancel_requested:
+                    raise exceptions.TaskCancelledError(
+                        "task was cancelled")  # cancelled behind the sem
+                # cancel_task targets this handler task; the conversion
+                # below keeps the cancellation in-band (error reply, not a
+                # torn connection)
+                self._running_aio[tid] = asyncio.current_task()
+                try:
+                    if renv:
+                        from . import runtime_env as _renv
+                        with _renv.applied(renv):
+                            return await fn(*args, **kwargs)
+                    return await fn(*args, **kwargs)
+                except asyncio.CancelledError:
+                    cur = asyncio.current_task()
+                    if hasattr(cur, "uncancel"):
+                        cur.uncancel()
+                    raise exceptions.TaskCancelledError(
+                        f"task {spec.function_name} was cancelled") from None
+                finally:
+                    self._running_aio.pop(tid, None)
         pool = self._group_pools.get(group, self.executor)
         if renv:
             from . import runtime_env as _renv
 
             def run_in_env():
                 with _renv.applied(renv):
-                    return self._run_user_code(fn, args, kwargs)
+                    return self._run_user_code(fn, args, kwargs,
+                                               task_id=tid)
 
             result = await self._loop.run_in_executor(pool, run_in_env)
         else:
             result = await self._loop.run_in_executor(
-                pool, self._run_user_code, fn, args, kwargs)
+                pool, self._run_user_code, fn, args, kwargs, tid)
         if inspect.iscoroutine(result):
             result = await result  # sync wrapper returned a coroutine
         return result
@@ -299,9 +360,13 @@ class WorkerRuntime:
         await self.nodelet.notify("task_state", {
             "worker_id": self.worker_id, "event": "start",
             "name": spec.function_name, "task_id": spec.task_id.binary()})
+        tid = spec.task_id.binary()
+        self._inflight.add(tid)
         try:
             return await self._execute(spec, fn)
         finally:
+            self._inflight.discard(tid)
+            self._cancel_requested.discard(tid)
             await self.nodelet.notify("task_state", {
                 "worker_id": self.worker_id, "event": "finish",
                 "name": spec.function_name})
@@ -435,5 +500,7 @@ class _ErrorValue:
                 cause = serialization.loads_function(self.pickled)
             except Exception:
                 cause = None
+        if isinstance(cause, exceptions.TaskCancelledError):
+            return cause  # ray.cancel surfaces AS TaskCancelledError
         cls = exceptions.ActorError if self.is_actor else exceptions.TaskError
         return cls(self.fname or context_fname, self.traceback_str, cause)

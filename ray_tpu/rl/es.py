@@ -90,18 +90,31 @@ class ESConfig:
         return ES(self)
 
 
+#: one compiled evaluator per (env class, episodes, horizon) per process
+_EVAL_CACHE: dict = {}
+
+
+def _cached_eval(env_factory, episodes, horizon):
+    key = (env_factory, episodes, horizon)
+    fn = _EVAL_CACHE.get(key)
+    if fn is None:
+        fn = _EVAL_CACHE[key] = jax.jit(
+            make_eval_fn(env_factory(), episodes, horizon))
+    return fn
+
+
 def _es_eval_task(env_factory, episodes, horizon, flat_np, meta,
                   sigma, noise_seed):
     """One perturbation pair, runnable as a cluster task: regenerate the
     noise from its seed sequence, evaluate +eps and -eps."""
-    env = env_factory()
-    evaluate = jax.jit(make_eval_fn(env, episodes, horizon))
+    evaluate = _cached_eval(env_factory, episodes, horizon)
     base = jnp.asarray(flat_np)
-    rng = np.random.default_rng(np.random.SeedSequence(noise_seed))
+    seq = np.random.SeedSequence(noise_seed)
+    rng = np.random.default_rng(seq)
     eps = jnp.asarray(rng.standard_normal(base.shape[0], dtype=np.float32))
-    eval_key = jax.random.PRNGKey(noise_seed[-1] if
-                                  isinstance(noise_seed, (list, tuple))
-                                  else noise_seed)
+    # fold the FULL seed sequence into the episode keys: every
+    # (config seed, iteration, index) triple sees fresh episodes
+    eval_key = jax.random.PRNGKey(int(seq.generate_state(1)[0]))
     r_pos = float(evaluate(_unflatten(base + sigma * eps, meta), eval_key))
     r_neg = float(evaluate(_unflatten(base - sigma * eps, meta), eval_key))
     return r_pos, r_neg

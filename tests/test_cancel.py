@@ -1,0 +1,91 @@
+"""Task cancellation (reference model: ray.cancel —
+python/ray/tests/test_cancel.py; CoreWorker::CancelTask)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cancel_queued_task(cluster):
+    """A task still waiting for a worker unschedules instantly; other
+    queued work is untouched."""
+    @ray_tpu.remote(num_cpus=2)
+    def slow(i):
+        time.sleep(3)
+        return i
+
+    blocker = slow.remote(0)     # occupies both CPUs
+    queued = slow.remote(1)      # cannot start yet
+    time.sleep(0.5)
+    assert ray_tpu.cancel(queued)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=10)
+    assert ray_tpu.get(blocker, timeout=30) == 0
+
+
+def test_cancel_running_task_interrupts(cluster):
+    """A running task gets TaskCancelledError raised in its thread —
+    cancellation lands well before the task would have finished."""
+    @ray_tpu.remote(max_retries=0)
+    def sleeper():
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            time.sleep(0.05)
+        return "survived"
+
+    ref = sleeper.remote()
+    time.sleep(1.0)  # let it start
+    t0 = time.monotonic()
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+    assert time.monotonic() - t0 < 15
+
+
+def test_cancel_force_kills_worker(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def stuck():
+        time.sleep(60)
+        return 1
+
+    ref = stuck.remote()
+    time.sleep(1.0)
+    assert ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_finished_task_is_noop(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 41
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=30) == 41
+    assert ray_tpu.cancel(ref) is False
+    assert ray_tpu.get(ref, timeout=10) == 41  # result untouched
+
+
+def test_cancel_actor_task_is_noop(cluster):
+    """Actor tasks are not cancellable (kill the actor instead, like the
+    reference's recommended path): cancel() is a no-op returning False
+    and the method still completes."""
+    @ray_tpu.remote
+    class A:
+        def work(self):
+            return 1
+
+    a = A.remote()
+    ref = a.work.remote()
+    assert ray_tpu.cancel(ref) is False
+    assert ray_tpu.get(ref, timeout=30) == 1
