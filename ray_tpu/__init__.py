@@ -19,6 +19,8 @@ from .api import (  # noqa: F401
     cluster_resources,
     get,
     get_actor,
+    get_runtime_context,
+    get_tpu_ids,
     init,
     is_initialized,
     kill,

@@ -417,6 +417,71 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     _ensure_initialized().kill_actor(actor._actor_id, no_restart)
 
 
+class RuntimeContext:
+    """What `ray_tpu.get_runtime_context()` returns (reference:
+    `ray.get_runtime_context()` / WorkerContext): identity and placement
+    of the current driver / task / actor."""
+
+    def __init__(self, core, spec, runtime):
+        self._core = core
+        self._spec = spec
+        self._runtime = runtime
+
+    @property
+    def job_id(self) -> str:
+        if self._spec is not None:
+            # the SUBMITTING job (embedded in the task id), not the
+            # worker process's own job context
+            return self._spec.task_id.job_id().hex()
+        return self._core.job_id.hex()
+
+    @property
+    def node_id(self) -> str:
+        return self._core.node_id
+
+    @property
+    def worker_id(self) -> str:
+        return self._core.worker_id.hex()
+
+    @property
+    def task_id(self) -> Optional[str]:
+        return self._spec.task_id.hex() if self._spec is not None else None
+
+    @property
+    def actor_id(self) -> Optional[str]:
+        aid = getattr(self._runtime, "actor_id", None)
+        return aid.hex() if aid else None
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        """The running task's resource request ({} on the driver)."""
+        if self._spec is None:
+            return {}
+        return dict(self._spec.resources.to_dict())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"job_id": self.job_id, "node_id": self.node_id,
+                "worker_id": self.worker_id, "task_id": self.task_id,
+                "actor_id": self.actor_id,
+                "assigned_resources": self.get_assigned_resources()}
+
+
+def get_runtime_context() -> RuntimeContext:
+    """Identity/placement of the current execution context (reference:
+    `ray.get_runtime_context`)."""
+    from .core import worker_runtime as wr
+    core = _ensure_initialized()
+    return RuntimeContext(core, wr.current_task_spec(),
+                          wr.current_worker_runtime())
+
+
+def get_tpu_ids() -> List[int]:
+    """Indices of the TPU chips assigned to the current task (the TPU
+    role of the reference's `ray.get_gpu_ids`): [] outside a task or for
+    tasks that requested no TPU."""
+    ctx = get_runtime_context()
+    return list(range(int(ctx.get_assigned_resources().get("TPU", 0))))
+
+
 def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
     """Cancel the task producing ``ref`` (reference: `ray.cancel`).
 

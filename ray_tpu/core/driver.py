@@ -169,6 +169,7 @@ class CoreClient:
         self._state_conns_lock = threading.Lock()
         self._cancelled: set = set()   # task_ids cancel() was called on
         self._task_sites: Dict[bytes, rpc.Connection] = {}  # running tasks
+        self._spurious_requeues: Dict[bytes, int] = {}
         if mode == "driver":
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
@@ -727,14 +728,18 @@ class CoreClient:
                 # in the worker); surface THE CANCEL, never retry
                 self._finish_cancel(spec)
                 return False
-            if self._is_spurious_cancel(err) and state is not None \
-                    and attempts_left > 0:
-                # PyThreadState_SetAsyncExc can land in a pool thread that
-                # already moved on to ANOTHER task — a cancel error for a
-                # task nobody cancelled is that victim: retry it
-                state.queue.append((spec, attempts_left - 1))
-                state.wakeup.set()
-                return True
+            if self._is_spurious_cancel(err) and state is not None:
+                # The TAGGED injection class for a task nobody cancelled:
+                # PyThreadState_SetAsyncExc landed in a pool thread that
+                # already moved on to ANOTHER task.  Requeue the victim
+                # WITHOUT burning its retry budget (the fault is ours, not
+                # the task's), bounded against pathological repetition.
+                n = self._spurious_requeues.get(tid, 0)
+                if n < 5:
+                    self._spurious_requeues[tid] = n + 1
+                    state.queue.append((spec, attempts_left))
+                    state.wakeup.set()
+                    return True
             if spec.retry_exceptions and attempts_left > 0 and state is not None:
                 state.queue.append((spec, attempts_left - 1))
                 state.wakeup.set()
@@ -820,12 +825,15 @@ class CoreClient:
 
     @staticmethod
     def _is_spurious_cancel(err: dict) -> bool:
+        """Only OUR injected class counts — user code that legitimately
+        raises TaskCancelledError (e.g. it got a cancelled ref) must keep
+        normal error semantics."""
         pickled = err.get("pickled")
         if not pickled:
             return False
         try:
             return isinstance(serialization.loads_function(pickled),
-                              exceptions.TaskCancelledError)
+                              exceptions.TaskInterruptedByCancel)
         except Exception:
             return False
 

@@ -116,6 +116,15 @@ class TaskID(BaseID):
     def actor_id(self) -> ActorID:
         return ActorID(self._bytes[: ActorID.SIZE])
 
+    def job_id(self) -> JobID:
+        """The submitting job, for either layout: driver task ids carry
+        the job AFTER a nil pad (`for_driver`), actor task ids embed it
+        at the front of the actor id (`ActorID.of`)."""
+        pad = ActorID.SIZE - JobID.SIZE
+        if self._bytes[:pad] == b"\xff" * pad:  # driver-submitted
+            return JobID(self._bytes[pad: ActorID.SIZE])
+        return self.actor_id().job_id()
+
 
 class ObjectID(BaseID):
     SIZE = 24

@@ -41,6 +41,23 @@ from .task_spec import ARG_REF, ARG_VALUE, TaskSpec
 
 FN_NAMESPACE = "fn"
 
+# The spec of the task currently executing in this context (thread /
+# asyncio task) — feeds `ray_tpu.get_runtime_context()` (reference:
+# WorkerContext / ray.get_runtime_context).
+import contextvars  # noqa: E402
+
+_current_spec: "contextvars.ContextVar[Optional[TaskSpec]]" = \
+    contextvars.ContextVar("ray_tpu_current_spec", default=None)
+_runtime_singleton: Optional["WorkerRuntime"] = None
+
+
+def current_task_spec() -> Optional[TaskSpec]:
+    return _current_spec.get()
+
+
+def current_worker_runtime() -> Optional["WorkerRuntime"]:
+    return _runtime_singleton
+
 
 class WorkerRuntime:
     def __init__(self, *, nodelet_addr: str, controller_addr: str,
@@ -77,6 +94,8 @@ class WorkerRuntime:
         self._running_aio: Dict[bytes, Any] = {}       # task_id -> aio task
         self._inflight: set = set()            # pushed, not yet replied
         self._cancel_requested: set = set()    # cancel seen pre-user-code
+        global _runtime_singleton
+        _runtime_singleton = self
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -206,17 +225,19 @@ class WorkerRuntime:
                 out.append({"plasma": size, "contained": bool(contained)})
         return out
 
-    def _run_user_code(self, fn, args, kwargs, task_id=None):
+    def _run_user_code(self, fn, args, kwargs, task_id=None, spec=None):
         if task_id is not None:
             if task_id in self._cancel_requested:
                 # cancelled while queued in the executor (before any
                 # thread/aio registration existed to interrupt)
                 raise exceptions.TaskCancelledError("task was cancelled")
-            import threading
             self._running_threads[task_id] = threading.get_ident()
+        token = _current_spec.set(spec) if spec is not None else None
         try:
             return fn(*args, **kwargs)
         finally:
+            if token is not None:
+                _current_spec.reset(token)
             if task_id is not None:
                 self._running_threads.pop(task_id, None)
 
@@ -233,8 +254,7 @@ class WorkerRuntime:
         if tid not in self._inflight:
             return False
         if data.get("force"):
-            import os as _os
-            _os._exit(1)
+            os._exit(1)
         self._cancel_requested.add(tid)
         aio = self._running_aio.get(tid)
         if aio is not None:
@@ -245,7 +265,7 @@ class WorkerRuntime:
             import ctypes
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_ulong(ident),
-                ctypes.py_object(exceptions.TaskCancelledError))
+                ctypes.py_object(exceptions.TaskInterruptedByCancel))
         return True
 
     def _is_async(self, fn) -> bool:
@@ -278,6 +298,7 @@ class WorkerRuntime:
                 # below keeps the cancellation in-band (error reply, not a
                 # torn connection)
                 self._running_aio[tid] = asyncio.current_task()
+                token = _current_spec.set(spec)
                 try:
                     if renv:
                         from . import runtime_env as _renv
@@ -291,6 +312,7 @@ class WorkerRuntime:
                     raise exceptions.TaskCancelledError(
                         f"task {spec.function_name} was cancelled") from None
                 finally:
+                    _current_spec.reset(token)
                     self._running_aio.pop(tid, None)
         pool = self._group_pools.get(group, self.executor)
         if renv:
@@ -299,12 +321,12 @@ class WorkerRuntime:
             def run_in_env():
                 with _renv.applied(renv):
                     return self._run_user_code(fn, args, kwargs,
-                                               task_id=tid)
+                                               task_id=tid, spec=spec)
 
             result = await self._loop.run_in_executor(pool, run_in_env)
         else:
             result = await self._loop.run_in_executor(
-                pool, self._run_user_code, fn, args, kwargs, tid)
+                pool, self._run_user_code, fn, args, kwargs, tid, spec)
         if inspect.iscoroutine(result):
             result = await result  # sync wrapper returned a coroutine
         return result
@@ -345,6 +367,18 @@ class WorkerRuntime:
             return {"error": {"traceback": "worker is exiting", "pickled": None,
                               "fname": "", "dying": True}}
         spec = TaskSpec.from_wire(data["spec"])
+        tid = spec.task_id.binary()
+        # in-flight from the FIRST moment a cancel could name this task —
+        # the function fetch below can take a while and a cancel arriving
+        # during it must not be dropped
+        self._inflight.add(tid)
+        try:
+            return await self._push_task_body(spec)
+        finally:
+            self._inflight.discard(tid)
+            self._cancel_requested.discard(tid)
+
+    async def _push_task_body(self, spec: TaskSpec):
         try:
             fn = await self._get_function(spec.function_id)
         except Exception:
@@ -360,13 +394,9 @@ class WorkerRuntime:
         await self.nodelet.notify("task_state", {
             "worker_id": self.worker_id, "event": "start",
             "name": spec.function_name, "task_id": spec.task_id.binary()})
-        tid = spec.task_id.binary()
-        self._inflight.add(tid)
         try:
             return await self._execute(spec, fn)
         finally:
-            self._inflight.discard(tid)
-            self._cancel_requested.discard(tid)
             await self.nodelet.notify("task_state", {
                 "worker_id": self.worker_id, "event": "finish",
                 "name": spec.function_name})

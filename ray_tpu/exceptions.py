@@ -52,3 +52,13 @@ class TaskCancelledError(RayTpuError):
 
 class RuntimeEnvSetupError(RayTpuError):
     pass
+
+
+class TaskInterruptedByCancel(TaskCancelledError):
+    """INTERNAL: the class injected by cancel_task's async-exception path.
+
+    Distinguishes our injection from user code legitimately raising
+    TaskCancelledError: if a reply carries THIS type for a task nobody
+    cancelled, the interrupt landed in an innocent pool thread (the
+    documented PyThreadState_SetAsyncExc race) and the driver re-queues
+    the victim without consuming its retry budget."""

@@ -90,16 +90,24 @@ class ESConfig:
         return ES(self)
 
 
-#: one compiled evaluator per (env class, episodes, horizon) per process
+#: one compiled evaluator per (env type, episodes, horizon) per process;
+#: keyed by qualname (a deserialized factory is a fresh OBJECT per task,
+#: so identity keys would never hit) and bounded (FIFO) so exotic
+#: factories cannot grow it without limit
 _EVAL_CACHE: dict = {}
+_EVAL_CACHE_MAX = 8
 
 
 def _cached_eval(env_factory, episodes, horizon):
-    key = (env_factory, episodes, horizon)
+    key = (getattr(env_factory, "__module__", ""),
+           getattr(env_factory, "__qualname__", repr(env_factory)),
+           episodes, horizon)
     fn = _EVAL_CACHE.get(key)
     if fn is None:
         fn = _EVAL_CACHE[key] = jax.jit(
             make_eval_fn(env_factory(), episodes, horizon))
+        while len(_EVAL_CACHE) > _EVAL_CACHE_MAX:
+            _EVAL_CACHE.pop(next(iter(_EVAL_CACHE)))
     return fn
 
 

@@ -89,3 +89,33 @@ def test_cancel_actor_task_is_noop(cluster):
     ref = a.work.remote()
     assert ray_tpu.cancel(ref) is False
     assert ray_tpu.get(ref, timeout=30) == 1
+
+
+def test_runtime_context_driver_task_actor(cluster):
+    """Identity/placement introspection (reference:
+    ray.get_runtime_context / get_gpu_ids)."""
+    ctx = ray_tpu.get_runtime_context()
+    d = ctx.to_dict()
+    assert d["job_id"] and d["node_id"] and d["worker_id"]
+    assert d["task_id"] is None and d["actor_id"] is None
+    assert ray_tpu.get_tpu_ids() == []
+
+    @ray_tpu.remote(num_cpus=1, resources={"fake_tpu": 0})
+    def inspect_ctx():
+        c = ray_tpu.get_runtime_context()
+        return c.to_dict()
+
+    t = ray_tpu.get(inspect_ctx.remote(), timeout=60)
+    assert t["task_id"] is not None
+    assert t["assigned_resources"].get("CPU") == 1.0
+    assert t["job_id"] == d["job_id"]
+
+    @ray_tpu.remote
+    class Inspector:
+        def who(self):
+            c = ray_tpu.get_runtime_context()
+            return c.actor_id, c.task_id
+
+    a = Inspector.remote()
+    actor_id, task_id = ray_tpu.get(a.who.remote(), timeout=60)
+    assert actor_id is not None and task_id is not None
