@@ -441,6 +441,12 @@ class RuntimeContext:
 
     @property
     def worker_id(self) -> str:
+        # inside a worker, report the id the nodelet REGISTERED (what
+        # state/timeline/task tables show), not the lazily-created
+        # CoreClient's random one
+        wid = getattr(self._runtime, "worker_id", None)
+        if wid is not None:
+            return wid.hex() if isinstance(wid, bytes) else str(wid)
         return self._core.worker_id.hex()
 
     @property
@@ -475,9 +481,16 @@ def get_runtime_context() -> RuntimeContext:
 
 
 def get_tpu_ids() -> List[int]:
-    """Indices of the TPU chips assigned to the current task (the TPU
-    role of the reference's `ray.get_gpu_ids`): [] outside a task or for
-    tasks that requested no TPU."""
+    """Local indices for the TPU chips this task RESERVED (the TPU role
+    of the reference's `ray.get_gpu_ids`): [] outside a task or for
+    tasks that requested no TPU.
+
+    Semantics differ from CUDA: TPU chips are counted resources without
+    per-chip visible-device isolation (the SPMD pattern is one worker
+    per host driving every local chip through one jax client), so the
+    indices are 0..n-1 into ``jax.local_devices()`` — NOT a disjoint
+    assignment between concurrent sub-host TPU tasks.  Schedule one TPU
+    task per host (the TPU-native layout) when exclusivity matters."""
     ctx = get_runtime_context()
     return list(range(int(ctx.get_assigned_resources().get("TPU", 0))))
 

@@ -722,6 +722,7 @@ class CoreClient:
             # a late cancel lost the race: the stale entry must not
             # poison a future lineage resubmission of the same task_id
             self._cancelled.discard(tid)
+            self._spurious_requeues.pop(tid, None)
         if err is not None:
             if tid in self._cancelled:
                 # an interrupted task errors out (TaskCancelledError raised

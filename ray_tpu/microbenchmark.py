@@ -23,7 +23,8 @@ def _rate(fn: Callable[[], int], min_time: float = 1.0) -> float:
     return n / (time.perf_counter() - t0)
 
 
-def run_microbenchmarks(min_time: float = 1.0) -> Dict[str, float]:
+def run_microbenchmarks(min_time: float = 1.0,
+                        include_serve: bool = False) -> Dict[str, float]:
     import ray_tpu
 
     @ray_tpu.remote
@@ -76,4 +77,51 @@ def run_microbenchmarks(min_time: float = 1.0) -> Dict[str, float]:
         return 100
 
     results["get_1kb_per_s"] = _rate(get_many, min_time)
+
+    if not include_serve:
+        # serve boots a controller + proxy + replica into the CALLER'S
+        # cluster — opt-in only (the CLI passes it; library callers with
+        # small clusters keep the core numbers cheap)
+        return results
+    # Serve overhead (BASELINE row: the reference documents ~1-2 ms added
+    # latency, doc/source/serve/performance.md:19): time a no-op
+    # deployment end to end through handle + router + replica.
+    deployed = False
+    try:
+        from ray_tpu import serve
+
+        @serve.deployment(max_concurrent_queries=64)
+        def _bench_noop(x=None):
+            return x
+
+        handle = serve.run(_bench_noop, name="_bench_noop")
+        deployed = True
+        handle.remote(1).result(timeout_s=60.0)  # warm the path
+
+        def serve_batch():
+            futs = [handle.remote(i) for i in range(20)]
+            for f in futs:
+                f.result(timeout_s=60.0)
+            return 20
+
+        qps = _rate(serve_batch, min_time)
+        results["serve_noop_qps"] = qps
+        # sequential round trip = the added-latency figure
+        t0 = time.perf_counter()
+        n = 50
+        for i in range(n):
+            handle.remote(i).result(timeout_s=60.0)
+        results["serve_latency_ms"] = (
+            (time.perf_counter() - t0) / n * 1000)
+    except Exception:  # pragma: no cover - serve-less contexts
+        import sys
+        import traceback
+        print("microbenchmark: serve section skipped:", file=sys.stderr)
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        if deployed:
+            try:  # never leave the bench deployment in the caller's cluster
+                serve.delete("_bench_noop")
+            except Exception:
+                pass
     return results

@@ -99,8 +99,12 @@ _EVAL_CACHE_MAX = 8
 
 
 def _cached_eval(env_factory, episodes, horizon):
-    key = (getattr(env_factory, "__module__", ""),
-           getattr(env_factory, "__qualname__", repr(env_factory)),
+    import hashlib
+
+    import cloudpickle
+    # content hash: identical factories (including captured closure
+    # values) share a compiled evaluator; make(5) and make(10) do not
+    key = (hashlib.sha256(cloudpickle.dumps(env_factory)).hexdigest(),
            episodes, horizon)
     fn = _EVAL_CACHE.get(key)
     if fn is None:

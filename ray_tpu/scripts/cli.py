@@ -240,7 +240,8 @@ def cmd_microbenchmark(args) -> None:
     import ray_tpu
     from ray_tpu.microbenchmark import run_microbenchmarks
     ray_tpu.init(num_cpus=args.num_cpus)
-    results = run_microbenchmarks(min_time=args.min_time)
+    results = run_microbenchmarks(min_time=args.min_time,
+                                  include_serve=True)
     for k, v in results.items():
         print(f"{k}: {v:,.1f}")
     ray_tpu.shutdown()
