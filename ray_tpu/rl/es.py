@@ -90,10 +90,11 @@ class ESConfig:
         return ES(self)
 
 
-#: one compiled evaluator per (env type, episodes, horizon) per process;
-#: keyed by qualname (a deserialized factory is a fresh OBJECT per task,
-#: so identity keys would never hit) and bounded (FIFO) so exotic
-#: factories cannot grow it without limit
+#: one compiled evaluator per (env factory CONTENT, episodes, horizon)
+#: per process; keyed by a cloudpickle hash (a deserialized factory is a
+#: fresh object per task, so identity keys would never hit, and closures
+#: with equal qualnames but different captures must not collide) and
+#: bounded (FIFO) so exotic factories cannot grow it without limit
 _EVAL_CACHE: dict = {}
 _EVAL_CACHE_MAX = 8
 
