@@ -62,26 +62,29 @@ class Checkpoint:
         path = os.path.abspath(path or tempfile.mkdtemp(
             prefix="ray_tpu_ckpt_"))
         target = os.path.join(path, _PYTREE_DIR)
-        # overwrite safely: commit the new save NEXT TO the old pytree
-        # and swap only after it is fully written — a crash mid-save must
-        # never destroy the previous (only) copy
-        staging = target + ".saving"
-        if os.path.exists(staging):
-            shutil.rmtree(staging)
+        if os.path.exists(target):
+            # No in-place overwrite: any cross-process staging/swap dance
+            # is racy, while orbax's OWN commit (write to a tmp dir, then
+            # rename) is already atomic for a FRESH directory — so each
+            # save goes to a fresh path and retention is the
+            # CheckpointManager's job (its step-numbered dirs never
+            # collide).  A crash mid-save can then never touch an
+            # existing checkpoint.
+            raise ValueError(
+                f"{target} already holds a pytree checkpoint; save each "
+                "checkpoint to a fresh directory (CheckpointManager "
+                "handles retention/pruning)")
         ckptr = ocp.StandardCheckpointer()
         try:
-            # the save commits ASYNCHRONOUSLY (per-host shard writers)
-            ckptr.save(staging, tree)
+            # the save commits ASYNCHRONOUSLY (per-host shard writers);
+            # wait_until_finished includes the cross-process commit
+            # barrier (jax.distributed/SpmdConfig gangs; independent
+            # single-process workers saving to one path fail loudly on
+            # orbax's existing-directory check instead of corrupting it)
+            ckptr.save(target, tree)
             ckptr.wait_until_finished()
         finally:
             ckptr.close()
-        if os.path.exists(target):
-            old = target + ".old"
-            os.rename(target, old)
-            os.rename(staging, target)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(staging, target)
         return cls.from_directory(path)
 
     def to_pytree(self, target: Any = None) -> Any:
