@@ -23,6 +23,36 @@ _DICT_FILE = "checkpoint.pkl"
 _PYTREE_DIR = "pytree"
 
 
+def _pytree_saves(path: str) -> list:
+    """Committed pytree save dirs under ``path``, oldest → newest
+    (atomic orbax commit means presence == complete)."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    out = [n for n in names
+           if n == _PYTREE_DIR
+           or (n.startswith(_PYTREE_DIR + "-") and
+               n[len(_PYTREE_DIR) + 1:].isdigit())]
+    return sorted(out)
+
+
+def _next_pytree_dir(path: str) -> str:
+    saves = _pytree_saves(path)
+    nums = [int(n[len(_PYTREE_DIR) + 1:]) for n in saves
+            if n != _PYTREE_DIR]
+    nxt = (max(nums) + 1) if nums else (1 if saves else 0)
+    return f"{_PYTREE_DIR}-{nxt:06d}"
+
+
+def _latest_pytree_dir(path: str):
+    saves = _pytree_saves(path)
+    if not saves:
+        return None
+    # numbered saves sort after the legacy bare name; newest wins
+    return os.path.join(path, saves[-1])
+
+
 class Checkpoint:
     """Immutable handle on checkpoint data, either in memory or on disk."""
 
@@ -61,19 +91,16 @@ class Checkpoint:
                 "directory for the coordinated shard writers to commit)")
         path = os.path.abspath(path or tempfile.mkdtemp(
             prefix="ray_tpu_ckpt_"))
-        target = os.path.join(path, _PYTREE_DIR)
-        if os.path.exists(target):
-            # No in-place overwrite: any cross-process staging/swap dance
-            # is racy, while orbax's OWN commit (write to a tmp dir, then
-            # rename) is already atomic for a FRESH directory — so each
-            # save goes to a fresh path and retention is the
-            # CheckpointManager's job (its step-numbered dirs never
-            # collide).  A crash mid-save can then never touch an
-            # existing checkpoint.
-            raise ValueError(
-                f"{target} already holds a pytree checkpoint; save each "
-                "checkpoint to a fresh directory (CheckpointManager "
-                "handles retention/pruning)")
+        # Saves NEVER overwrite: each save commits into a fresh
+        # monotonically numbered subdirectory (orbax's tmp-dir + rename
+        # commit is atomic for a fresh name), so a crashed or retried
+        # save can re-target the same ``path`` — the failure-retry /
+        # resume pattern — without any cross-process swap dance and
+        # without ever endangering the previous copy.  Gang ranks agree
+        # on the index because they enumerate the same shared directory
+        # after the previous save's commit barrier.  ``to_pytree`` reads
+        # the NEWEST committed save.
+        target = os.path.join(path, _next_pytree_dir(path))
         ckptr = ocp.StandardCheckpointer()
         try:
             # the save commits ASYNCHRONOUSLY (per-host shard writers);
@@ -101,8 +128,8 @@ class Checkpoint:
             # materializing the whole dict to a leaked temp directory
             raise ValueError("checkpoint holds no orbax pytree "
                              "(was it saved with from_pytree?)")
-        item = os.path.join(self._path, _PYTREE_DIR)
-        if not os.path.isdir(item):
+        item = _latest_pytree_dir(self._path)
+        if item is None:
             raise ValueError("checkpoint holds no orbax pytree "
                              "(was it saved with from_pytree?)")
         ckptr = ocp.StandardCheckpointer()

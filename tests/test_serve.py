@@ -140,3 +140,30 @@ def test_serve_rest_status_endpoint(served):
                          timeout=10).json()
     assert table["rest_probe"]["num_replicas"] == 2
     assert table["rest_probe"]["route_prefix"] == "/rest_probe"
+
+
+def test_redeploy_scales_replicas(served):
+    """serve.run on an existing deployment reconciles the replica set to
+    the new target (reference: deployment_state reconciliation)."""
+    @serve.deployment(num_replicas=1)
+    class Scaler:
+        def __init__(self):
+            import os
+            self.pid = os.getpid()
+
+        def __call__(self, _=None):
+            return self.pid
+
+    handle = serve.run(Scaler.bind(), name="scaler")
+    assert serve.list_deployments()["scaler"]["num_replicas"] == 1
+    first = handle.remote().result(timeout_s=120.0)
+
+    scaled = Scaler.options(num_replicas=3)
+    handle = serve.run(scaled.bind(), name="scaler")
+    # the controller reconciles synchronously inside serve.run
+    assert serve.list_deployments()["scaler"]["num_replicas"] == 3
+    time.sleep(0.4)  # let the shared router's 0.25s table poll refresh
+    pids = {handle.remote().result(timeout_s=120.0) for _ in range(12)}
+    assert len(pids) >= 2, f"requests not spread: {pids}"
+    assert isinstance(first, int)
+    serve.delete("scaler")

@@ -216,15 +216,18 @@ def test_gang_training_orbax_checkpoint_resharded_resume(cluster, tmp_path):
     assert out["w"].sharding.spec == P(None, "tp")
 
 
-def test_pytree_checkpoint_no_inplace_overwrite(tmp_path):
-    """Saving twice to one path raises (fresh-dir contract: orbax's
-    atomic commit covers fresh dirs; retention is CheckpointManager's
-    job)."""
+def test_pytree_checkpoint_resave_same_path(tmp_path):
+    """Re-saving to one path commits a NEW numbered save (the
+    failure-retry / resume pattern); restore reads the newest, and the
+    older save is never touched mid-write (atomic fresh-dir commits)."""
     import jax.numpy as jnp
+    import numpy as np
 
     from ray_tpu.air import Checkpoint
 
     p = str(tmp_path / "ck")
     Checkpoint.from_pytree({"x": jnp.ones(4)}, path=p)
-    with pytest.raises(ValueError):
-        Checkpoint.from_pytree({"x": jnp.zeros(4)}, path=p)
+    ck2 = Checkpoint.from_pytree({"x": jnp.full(4, 7.0)}, path=p)
+    out = ck2.to_pytree()
+    np.testing.assert_array_equal(np.asarray(out["x"]),
+                                  np.full(4, 7.0))
