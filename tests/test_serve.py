@@ -167,3 +167,61 @@ def test_redeploy_scales_replicas(served):
     assert len(pids) >= 2, f"requests not spread: {pids}"
     assert isinstance(first, int)
     serve.delete("scaler")
+
+
+def test_autoscaling_scales_up_under_load_and_back_down(served):
+    """Queue-depth autoscaling (reference: BasicAutoscalingPolicy,
+    autoscaling_policy.py:93): sustained in-flight load grows the
+    replica set toward max_replicas; idling shrinks it to min."""
+    import concurrent.futures
+
+    from ray_tpu.serve import AutoscalingConfig
+
+    @serve.deployment(autoscaling_config=AutoscalingConfig(
+        min_replicas=1, max_replicas=3,
+        target_num_ongoing_requests_per_replica=1.0,
+        upscale_delay_s=0.0, downscale_delay_s=0.5),
+        max_concurrent_queries=8)
+    def slow_echo(x=None):
+        time.sleep(0.4)
+        return x
+
+    handle = serve.run(slow_echo, name="auto_echo")
+    assert serve.list_deployments()["auto_echo"]["num_replicas"] == 1
+
+    import threading
+    done = threading.Event()
+    scaled_up = False
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as pool:
+        def hammer(i):
+            while not done.is_set():
+                try:
+                    handle.remote(i).result(timeout_s=60.0)
+                except Exception:
+                    pass
+
+        futs = [pool.submit(hammer, i) for i in range(6)]
+        deadline = time.time() + 12
+        while time.time() < deadline:
+            if serve.list_deployments()["auto_echo"]["num_replicas"] >= 2:
+                scaled_up = True
+                break
+            time.sleep(0.2)
+        done.set()  # stop the load the moment scale-up is observed
+        for f in futs:
+            f.result(timeout=30)
+    assert scaled_up, "never scaled past 1 replica under sustained load"
+
+    # idle: scale back down to min (router reports zeros as results
+    # drain); the trickle may land on a replica the downscale is killing
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            handle.remote(0).result(timeout_s=60.0)
+        except Exception:
+            pass  # request raced a replica teardown: keep trickling
+        if serve.list_deployments()["auto_echo"]["num_replicas"] == 1:
+            break
+        time.sleep(0.3)
+    assert serve.list_deployments()["auto_echo"]["num_replicas"] == 1
+    serve.delete("auto_echo")
