@@ -34,11 +34,14 @@ def test_memory_fraction_sane():
 
 
 def test_oom_kill_under_forced_pressure():
-    """threshold=0.01 => always over: the monitor must kill the leased
-    worker running a long task; the task fails with a worker-died error
-    instead of hanging."""
+    """A threshold pinned BELOW the host's current usage => always over:
+    the monitor must kill the leased worker running a long task; the
+    task fails with a worker-died error instead of hanging.  (A fixed
+    0.01 threshold proved environment-sensitive: an idle 125 GB box can
+    sit under 1% used.)"""
+    threshold = max(Nodelet._memory_usage_fraction() * 0.5, 1e-4)
     ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024,
-                 system_config={"memory_usage_threshold": 0.01,
+                 system_config={"memory_usage_threshold": threshold,
                                 "memory_monitor_interval_s": 0.2})
     try:
         @ray_tpu.remote(max_retries=0)
