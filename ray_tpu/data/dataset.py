@@ -300,11 +300,24 @@ class Dataset:
         return out
 
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
-        df = self.to_pandas()
-        out = []
-        prev = 0
-        for idx in list(indices) + [len(df)]:
-            piece = df.iloc[prev:idx]
+        # Slice in the blocks' NATIVE representation — coercing through
+        # pandas would silently turn list-block scalar rows into
+        # {"value": ...} dict rows.  Mixed-format datasets (e.g. a union
+        # of list and dataframe blocks) fall back to the pandas path,
+        # which combine() cannot represent natively.
+        blocks = api.get(list(self._blocks), timeout=300.0) \
+            if self._blocks else []
+        kinds = {type(b) for b in blocks}
+        if len(kinds) > 1:
+            combined = self.to_pandas()
+        elif blocks:
+            combined = BlockAccessor.combine(blocks)
+        else:
+            combined = []
+        acc = BlockAccessor(combined)
+        out, prev = [], 0
+        for idx in list(indices) + [acc.num_rows()]:
+            piece = acc.slice(prev, idx)
             out.append(Dataset([api.put(piece)],
                                [BlockAccessor(piece).metadata()]))
             prev = idx

@@ -158,3 +158,40 @@ def test_iter_torch_batches(cluster):
     ys = torch.cat([b["y"] for b in batches])
     assert torch.equal(torch.sort(ys).values,
                        torch.sort(2 * xs).values)
+
+
+def test_zip_split_at_indices_limit(cluster):
+    """Remaining transform surface: zip pairs rows positionally,
+    split_at_indices cuts at exact boundaries, limit truncates."""
+    a = rdata.from_items([1, 2, 3, 4, 5, 6], parallelism=2)
+    b = rdata.from_items(["a", "b", "c", "d", "e", "f"], parallelism=2)
+    z = a.zip(b).take_all()
+    # columnar zip (reference semantics): right columns get _1 suffixes
+    assert z[0] == {"value": 1, "value_1": "a"}
+    assert [r["value"] for r in z] == [1, 2, 3, 4, 5, 6]
+    assert [r["value_1"] for r in z] == ["a", "b", "c", "d", "e", "f"]
+
+    parts = rdata.from_items(list(range(10)), parallelism=3) \
+        .split_at_indices([3, 7])
+    assert [p.take_all() for p in parts] == [[0, 1, 2], [3, 4, 5, 6],
+                                             [7, 8, 9]]
+
+    assert rdata.from_items(list(range(10)),
+                            parallelism=3).limit(4).take_all() == \
+        [0, 1, 2, 3]
+
+
+def test_split_at_indices_edge_cases(cluster):
+    """Mixed-format datasets and empty datasets keep the arity contract
+    (len(indices) + 1 parts) and real row values."""
+    mixed = rdata.from_items([1, 2], parallelism=1).union(
+        rdata.range(3, parallelism=1))
+    parts = mixed.split_at_indices([2])
+    assert len(parts) == 2
+    assert parts[0].count() == 2 and parts[1].count() == 3
+    # the union's second half came from range(): dict rows with "id"
+    assert [r["id"] for r in parts[1].iter_rows()] == [0, 1, 2]
+
+    empty = rdata.from_items(list(range(3)), parallelism=1).limit(0)
+    train, test = empty.split_at_indices([1])
+    assert train.count() == 0 and test.count() == 0
