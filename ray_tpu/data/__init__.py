@@ -11,7 +11,19 @@ numpy-dict batches shaped for `jax.device_put` onto a mesh's data axis, and
 from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from .dataset import Dataset  # noqa: F401
 from .dataset_pipeline import DatasetPipeline  # noqa: F401
+from .datasource import (  # noqa: F401
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    FileBasedDatasource,
+    JSONDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    TextDatasource,
+)
 from .grouped import GroupedData  # noqa: F401
+from .plan import AllToAllStage, ExecutionPlan, OneToOneStage  # noqa: F401
 from .read_api import (  # noqa: F401
     from_arrow,
     from_items,
@@ -21,6 +33,7 @@ from .read_api import (  # noqa: F401
     range_tensor,
     read_binary_files,
     read_csv,
+    read_datasource,
     read_json,
     read_parquet,
     read_text,

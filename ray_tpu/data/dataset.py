@@ -1,10 +1,12 @@
-"""Dataset: task-parallel transforms over object-store block refs.
+"""Dataset: lazy task-parallel transforms over object-store block refs.
 
 Capability mirror of the reference's `data/dataset.py:323` (map_batches and
-friends), `_internal/push_based_shuffle.py:330` (2-stage shuffle),
-`_internal/compute.py` (task compute).  Every transform fans out one runtime
-task per block; all-to-all ops (repartition/shuffle/sort) run the two-stage
-map/merge pattern so no single process materializes the dataset.
+friends), `_internal/plan.py:74` (lazy plan + stage fusion),
+`_internal/push_based_shuffle.py:330` (2-stage shuffle).  Transforms record
+stages on an ExecutionPlan; at execution, chained map-family stages fuse
+into ONE task per block, and all-to-all ops (repartition/shuffle/sort) run
+the two-stage map/merge pattern so no single process materializes the
+dataset.
 """
 
 from __future__ import annotations
@@ -30,14 +32,6 @@ def _remote(name: str, fn: Callable, num_returns: int = 1):
 
 
 # -- task bodies (top-level, cloudpickled once each) ------------------------
-
-def _map_block(fn_bytes: bytes, block: Block) -> Tuple[Block, BlockMetadata]:
-    from ..core.serialization import loads_function
-    fn = loads_function(fn_bytes)
-    out = fn(block)
-    acc = BlockAccessor(out)
-    return out, acc.metadata()
-
 
 def _split_block(block: Block, n: int, how: str, seed: Optional[int],
                  part_index: int) -> List[Block]:
@@ -103,37 +97,99 @@ def _sample_block(block: Block, n: int, key: Optional[str]) -> List[Any]:
     return BlockAccessor(block).sample(n, key)
 
 
-def _write_block(block: Block, index: int, path: str, fmt: str) -> str:
-    import os
-    out = os.path.join(path, f"part-{index:05d}.{fmt}")
-    df = BlockAccessor(block).to_pandas()
-    if fmt == "parquet":
-        df.to_parquet(out)
-    elif fmt == "csv":
-        df.to_csv(out, index=False)
-    else:
-        df.to_json(out, orient="records", lines=True)
-    return out
+# -- all-to-all executors (driver-side, run inside AllToAllStage.fn) --------
+
+def _exec_two_stage(refs: List[Any], n_out: int, how: str,
+                    seed: Optional[int]):
+    merge = _remote("merge", _merge_blocks, num_returns=2)
+    if n_out == 1:
+        pair = merge.remote(seed if how == "shuffle" else None, *refs)
+        return [pair[0]], [api.get(pair[1], timeout=600.0)]
+    split = _remote(f"split/{n_out}", _split_block, num_returns=n_out)
+    parts = [split.remote(b, n_out, how, seed, i)
+             for i, b in enumerate(refs)]
+    out_refs, out_meta_refs = [], []
+    for j in builtins.range(n_out):
+        seed_j = None if seed is None else seed + 1000003 * j
+        pair = merge.remote(seed_j if how == "shuffle" else None,
+                            *[p[j] for p in parts])
+        out_refs.append(pair[0])
+        out_meta_refs.append(pair[1])
+    return out_refs, api.get(out_meta_refs, timeout=600.0)
+
+
+def _exec_sort(refs: List[Any], meta: List[BlockMetadata],
+               key: Optional[str], descending: bool):
+    n = max(len(refs), 1)
+    sampler = _remote("sample", _sample_block)
+    samples: List[Any] = []
+    for chunk in api.get([sampler.remote(b, 16, key) for b in refs],
+                         timeout=600.0):
+        samples.extend(chunk)
+    if not samples:
+        return refs, meta
+    merge = _remote("sortmerge", _sort_merge, num_returns=2)
+    if n == 1:
+        pair = merge.remote(key, descending, *refs)
+        return [pair[0]], [api.get(pair[1], timeout=600.0)]
+    ordered = sorted(samples)
+    boundaries = [ordered[len(ordered) * j // n]
+                  for j in builtins.range(1, n)]
+    part = _remote(f"sortpart/{n}", _sort_partition, num_returns=n)
+    parts = [part.remote(b, key, boundaries, descending) for b in refs]
+    out_refs, metas = [], []
+    order = builtins.range(n - 1, -1, -1) if descending \
+        else builtins.range(n)
+    for j in order:
+        pair = merge.remote(key, descending, *[p[j] for p in parts])
+        out_refs.append(pair[0])
+        metas.append(pair[1])
+    return out_refs, api.get(metas, timeout=600.0)
 
 
 class Dataset:
-    """Distributed rows in object-store blocks."""
+    """Distributed rows in object-store blocks, built lazily.
+
+    Transforms record stages on an :class:`ExecutionPlan` (reference:
+    `data/_internal/plan.py:74`); nothing runs until a consumption op
+    touches ``_blocks``.  Chained map-family stages fuse into one task
+    per block.
+    """
 
     def __init__(self, block_refs: List[Any],
                  metadata: Optional[List[BlockMetadata]] = None):
-        self._blocks = list(block_refs)
-        self._meta = metadata or [BlockMetadata()] * len(self._blocks)
+        from .plan import ExecutionPlan
+        self._plan = ExecutionPlan.from_blocks(list(block_refs), metadata)
+
+    @classmethod
+    def from_plan(cls, plan) -> "Dataset":
+        ds = cls.__new__(cls)
+        ds._plan = plan
+        return ds
+
+    # _blocks/_meta force execution; everything downstream (iteration,
+    # splitting, writes, groupby) reads through these two properties.
+    @property
+    def _blocks(self) -> List[Any]:
+        return self._plan.execute()[0]
+
+    @property
+    def _meta(self) -> List[BlockMetadata]:
+        return self._plan.execute()[1]
 
     # -- introspection ------------------------------------------------------
     def num_blocks(self) -> int:
-        return len(self._blocks)
+        if self._plan.executed:
+            return len(self._blocks)
+        return self._plan.expected_num_blocks()
 
     def _ensure_meta(self) -> List[BlockMetadata]:
-        if any(m.num_rows is None for m in self._meta):
+        refs, meta = self._plan.execute()
+        if any(m.num_rows is None for m in meta):
             f = _remote("get_meta", _get_meta)
-            self._meta = api.get([f.remote(b) for b in self._blocks],
-                                 timeout=300.0)
-        return self._meta
+            meta = api.get([f.remote(b) for b in refs], timeout=300.0)
+            self._plan._out = (refs, meta)
+        return meta
 
     def count(self) -> int:
         return sum(m.num_rows for m in self._ensure_meta())
@@ -151,15 +207,12 @@ class Dataset:
             out.extend(m.input_files or [])
         return out
 
-    # -- transforms ---------------------------------------------------------
-    def _map_all(self, block_fn: Callable[[Block], Block]) -> "Dataset":
-        from ..core.serialization import dumps_function
-        f = _remote("map_block", _map_block, num_returns=2)
-        blob = dumps_function(block_fn)
-        pairs = [f.remote(blob, b) for b in self._blocks]
-        refs = [p[0] for p in pairs]
-        meta = api.get([p[1] for p in pairs], timeout=600.0)
-        return Dataset(refs, meta)
+    # -- transforms (lazy: each appends a fusable one-to-one stage) ---------
+    def _map_all(self, block_fn: Callable[[Block], Block],
+                 name: str = "map") -> "Dataset":
+        from .plan import OneToOneStage
+        return Dataset.from_plan(
+            self._plan.with_stage(OneToOneStage(name, block_fn)))
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "native") -> "Dataset":
@@ -174,12 +227,12 @@ class Dataset:
                 res = fn(piece.to_batch(batch_format))
                 outs.append(batch_to_block(res))
             return BlockAccessor.combine(outs) if outs else block
-        return self._map_all(block_fn)
+        return self._map_all(block_fn, "map_batches")
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         def block_fn(block: Block) -> Block:
             return [fn(r) for r in BlockAccessor(block).iter_rows()]
-        return self._map_all(block_fn)
+        return self._map_all(block_fn, "map")
 
     def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
         def block_fn(block: Block) -> Block:
@@ -187,21 +240,21 @@ class Dataset:
             for r in BlockAccessor(block).iter_rows():
                 out.extend(fn(r))
             return out
-        return self._map_all(block_fn)
+        return self._map_all(block_fn, "flat_map")
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
         def block_fn(block: Block) -> Block:
             acc = BlockAccessor(block)
             keep = [i for i, r in enumerate(acc.iter_rows()) if fn(r)]
             return acc.take(keep)
-        return self._map_all(block_fn)
+        return self._map_all(block_fn, "filter")
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def block_fn(block: Block) -> Block:
             df = BlockAccessor(block).to_pandas().copy()
             df[name] = fn(df)
             return df
-        return self._map_all(block_fn)
+        return self._map_all(block_fn, "add_column")
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
         return self.map_batches(lambda df: df.drop(columns=list(cols)),
@@ -211,62 +264,29 @@ class Dataset:
         return self.map_batches(lambda df: df[list(cols)],
                                 batch_format="pandas")
 
-    # -- all-to-all ---------------------------------------------------------
-    def _two_stage(self, n_out: int, how: str,
-                   seed: Optional[int]) -> "Dataset":
-        merge = _remote("merge", _merge_blocks, num_returns=2)
-        if n_out == 1:
-            pair = merge.remote(seed if how == "shuffle" else None,
-                                *self._blocks)
-            return Dataset([pair[0]], [api.get(pair[1], timeout=600.0)])
-        split = _remote(f"split/{n_out}", _split_block, num_returns=n_out)
-        parts = [split.remote(b, n_out, how, seed, i)
-                 for i, b in enumerate(self._blocks)]
-        out_refs, out_meta_refs = [], []
-        for j in builtins.range(n_out):
-            seed_j = None if seed is None else seed + 1000003 * j
-            pair = merge.remote(seed_j if how == "shuffle" else None,
-                                *[p[j] for p in parts])
-            out_refs.append(pair[0])
-            out_meta_refs.append(pair[1])
-        return Dataset(out_refs, api.get(out_meta_refs, timeout=600.0))
+    # -- all-to-all (lazy barrier stages) -----------------------------------
+    def _two_stage(self, n_out: int, how: str, seed: Optional[int],
+                   name: str) -> "Dataset":
+        from .plan import AllToAllStage
+        return Dataset.from_plan(self._plan.with_stage(AllToAllStage(
+            name, lambda refs, meta: _exec_two_stage(refs, n_out, how, seed),
+            num_out=n_out)))
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        return self._two_stage(num_blocks, "even", None)
+        return self._two_stage(num_blocks, "even", None, "repartition")
 
     def random_shuffle(self, *, seed: Optional[int] = None,
                        num_blocks: Optional[int] = None) -> "Dataset":
         return self._two_stage(num_blocks or max(self.num_blocks(), 1),
-                               "shuffle", seed if seed is not None else 0)
+                               "shuffle", seed if seed is not None else 0,
+                               "random_shuffle")
 
     def sort(self, key: Optional[str] = None,
              descending: bool = False) -> "Dataset":
-        n = max(self.num_blocks(), 1)
-        sampler = _remote("sample", _sample_block)
-        samples: List[Any] = []
-        for chunk in api.get([sampler.remote(b, 16, key)
-                              for b in self._blocks], timeout=600.0):
-            samples.extend(chunk)
-        if not samples:
-            return self
-        merge = _remote("sortmerge", _sort_merge, num_returns=2)
-        if n == 1:
-            pair = merge.remote(key, descending, *self._blocks)
-            return Dataset([pair[0]], [api.get(pair[1], timeout=600.0)])
-        ordered = sorted(samples)
-        boundaries = [ordered[len(ordered) * j // n]
-                      for j in builtins.range(1, n)]
-        part = _remote(f"sortpart/{n}", _sort_partition, num_returns=n)
-        parts = [part.remote(b, key, boundaries, descending)
-                 for b in self._blocks]
-        out_refs, metas = [], []
-        order = builtins.range(n - 1, -1, -1) if descending \
-            else builtins.range(n)
-        for j in order:
-            pair = merge.remote(key, descending, *[p[j] for p in parts])
-            out_refs.append(pair[0])
-            metas.append(pair[1])
-        return Dataset(out_refs, api.get(metas, timeout=600.0))
+        from .plan import AllToAllStage
+        return Dataset.from_plan(self._plan.with_stage(AllToAllStage(
+            "sort", lambda refs, meta: _exec_sort(refs, meta, key,
+                                                  descending))))
 
     # -- combining ----------------------------------------------------------
     def union(self, *others: "Dataset") -> "Dataset":
@@ -449,23 +469,30 @@ class Dataset:
         from .grouped import GroupedData
         return GroupedData(self, key)
 
-    # -- IO -----------------------------------------------------------------
-    def write_parquet(self, path: str) -> List[str]:
-        return self._write(path, "parquet")
-
-    def write_csv(self, path: str) -> List[str]:
-        return self._write(path, "csv")
-
-    def write_json(self, path: str) -> List[str]:
-        return self._write(path, "json")
-
-    def _write(self, path: str, fmt: str) -> List[str]:
+    # -- IO (through the Datasource ABC; reference:
+    # `data/datasource/datasource.py:1` do_write) ---------------------------
+    def write_datasource(self, datasource, *, path: str,
+                         **write_args) -> List[Any]:
         import os
         os.makedirs(path, exist_ok=True)
+        blocks = self._blocks  # plan execution errors are not write errors
+        try:
+            return datasource.do_write(blocks, path, **write_args)
+        except Exception as exc:
+            datasource.on_write_failed(exc)
+            raise
 
-        f = _remote("write", _write_block)
-        return api.get([f.remote(b, i, path, fmt)
-                        for i, b in enumerate(self._blocks)], timeout=600.0)
+    def write_parquet(self, path: str, **kw) -> List[str]:
+        from .datasource import ParquetDatasource
+        return self.write_datasource(ParquetDatasource(), path=path, **kw)
+
+    def write_csv(self, path: str, **kw) -> List[str]:
+        from .datasource import CSVDatasource
+        return self.write_datasource(CSVDatasource(), path=path, **kw)
+
+    def write_json(self, path: str, **kw) -> List[str]:
+        from .datasource import JSONDatasource
+        return self.write_datasource(JSONDatasource(), path=path, **kw)
 
     # -- pipeline -----------------------------------------------------------
     def window(self, *, blocks_per_window: int = 10):
@@ -481,11 +508,18 @@ class Dataset:
         return DatasetPipeline.from_windows([self] * times)
 
     def stats(self) -> str:
+        """Per-stage execution report + dataset summary (reference:
+        `data/_internal/stats.py:1`).  Forces execution."""
         meta = self._ensure_meta()
-        return (f"Dataset(blocks={len(meta)}, "
-                f"rows={sum(m.num_rows or 0 for m in meta)}, "
-                f"bytes={sum(m.size_bytes or 0 for m in meta)})")
+        lines = [s.line(i) for i, s in enumerate(self._plan.stats())]
+        lines.append(f"Dataset(blocks={len(meta)}, "
+                     f"rows={sum(m.num_rows or 0 for m in meta)}, "
+                     f"bytes={sum(m.size_bytes or 0 for m in meta)})")
+        return "\n".join(lines)
 
     def __repr__(self):
+        if not self._plan.executed:
+            return (f"Dataset(num_blocks={self.num_blocks()}, "
+                    f"lazy stages={self._plan.stage_names()})")
         return (f"Dataset(num_blocks={self.num_blocks()}, "
                 f"num_rows={self._meta[0].num_rows and self.count()})")

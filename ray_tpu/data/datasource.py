@@ -1,0 +1,202 @@
+"""Pluggable read/write datasources.
+
+Capability mirror of the reference's `data/datasource/datasource.py:1`
+(Datasource ABC: ``prepare_read`` returning ReadTasks, ``do_write`` fanning
+out one write task per block) and `datasource/file_based_datasource.py`
+(path expansion + per-file read/write).  A ReadTask is a zero-arg callable
+producing one block; the execution plan fuses it with downstream map stages
+so read->map->filter chains run as ONE task per file.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from .block import Block, BlockAccessor
+
+
+class ReadTask:
+    """One unit of lazy input: call it to produce a block."""
+
+    def __init__(self, read_fn: Callable[[], Block],
+                 input_files: Optional[List[str]] = None):
+        self._read_fn = read_fn
+        self.input_files = input_files
+
+    def __call__(self) -> Block:
+        return self._read_fn()
+
+
+class Datasource:
+    """Read/write extension point (subclass and override)."""
+
+    def prepare_read(self, parallelism: int, **read_args) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def write_block(self, block: Block, path: str, index: int,
+                    **write_args) -> Any:
+        """Write ONE block; runs inside a task. Returns a result token."""
+        raise NotImplementedError
+
+    def do_write(self, block_refs: List[Any], path: str,
+                 **write_args) -> List[Any]:
+        """Fan out one write task per block and collect results."""
+        from .dataset import _remote
+        f = _remote("ds_write", _datasource_write_block)
+        from ..core.serialization import dumps_function
+        blob = dumps_function(self.write_block)
+        results = api.get(
+            [f.remote(blob, b, path, i, write_args)
+             for i, b in enumerate(block_refs)], timeout=600.0)
+        self.on_write_complete(results)
+        return results
+
+    def on_write_complete(self, write_results: List[Any]) -> None:
+        pass
+
+    def on_write_failed(self, error: Exception) -> None:
+        pass
+
+
+def _datasource_write_block(fn_blob: bytes, block: Block, path: str,
+                            index: int, write_args: Dict[str, Any]) -> Any:
+    from ..core.serialization import loads_function
+    write_block = loads_function(fn_blob)
+    return write_block(block, path, index, **write_args)
+
+
+# -- file-based datasources --------------------------------------------------
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """One ReadTask per file; subclasses define the per-file (de)serializer."""
+
+    _FILE_EXT = "dat"
+
+    def __init__(self, paths=None, **read_args):
+        self._paths = paths
+        self._read_args = read_args
+
+    def _read_file(self, path: str, **read_args) -> Block:
+        raise NotImplementedError
+
+    def _write_file(self, df, path: str, **write_args) -> None:
+        raise NotImplementedError
+
+    def prepare_read(self, parallelism: int, **read_args) -> List[ReadTask]:
+        args = {**self._read_args, **read_args}
+        files = _expand_paths(self._paths)
+        reader = self._read_file
+        return [ReadTask((lambda p=path: reader(p, **args)),
+                         input_files=[path])
+                for path in files]
+
+    def write_block(self, block: Block, path: str, index: int,
+                    **write_args) -> str:
+        out = os.path.join(path, f"part-{index:05d}.{self._FILE_EXT}")
+        self._write_file(BlockAccessor(block).to_pandas(), out, **write_args)
+        return out
+
+
+class ParquetDatasource(FileBasedDatasource):
+    _FILE_EXT = "parquet"
+
+    def _read_file(self, path: str, **kw) -> Block:
+        import pandas as pd
+        return pd.read_parquet(path, **kw)
+
+    def _write_file(self, df, path: str, **kw) -> None:
+        df.to_parquet(path, **kw)
+
+
+class CSVDatasource(FileBasedDatasource):
+    _FILE_EXT = "csv"
+
+    def _read_file(self, path: str, **kw) -> Block:
+        import pandas as pd
+        return pd.read_csv(path, **kw)
+
+    def _write_file(self, df, path: str, **kw) -> None:
+        df.to_csv(path, index=False, **kw)
+
+
+class JSONDatasource(FileBasedDatasource):
+    _FILE_EXT = "json"
+
+    def _read_file(self, path: str, **kw) -> Block:
+        import pandas as pd
+        return pd.read_json(path, orient="records", lines=True, **kw)
+
+    def _write_file(self, df, path: str, **kw) -> None:
+        df.to_json(path, orient="records", lines=True, **kw)
+
+
+class TextDatasource(FileBasedDatasource):
+    _FILE_EXT = "txt"
+
+    def _read_file(self, path: str, **kw) -> Block:
+        with open(path, "r", errors="replace") as f:
+            return [line.rstrip("\n") for line in f]
+
+    def _write_file(self, df, path: str, **kw) -> None:
+        with open(path, "w") as f:
+            for v in df[df.columns[0]]:
+                f.write(f"{v}\n")
+
+
+class BinaryDatasource(FileBasedDatasource):
+    _FILE_EXT = "bin"
+
+    def _read_file(self, path: str, **kw) -> Block:
+        with open(path, "rb") as f:
+            return [f.read()]
+
+
+class RangeDatasource(Datasource):
+    """Lazy integer range (reference: `datasource.RangeDatasource`)."""
+
+    def __init__(self, n: int, tensor_shape=None):
+        self._n = n
+        self._shape = tensor_shape
+
+    def prepare_read(self, parallelism: int, **read_args) -> List[ReadTask]:
+        import numpy as np
+        n_blocks = max(1, min(parallelism, self._n or 1))
+        bounds = np.linspace(0, self._n, n_blocks + 1).astype(int)
+        shape = self._shape
+
+        def make(lo: int, hi: int) -> Callable[[], Block]:
+            def read() -> Block:
+                import numpy as np
+                import pandas as pd
+                idx = np.arange(lo, hi)
+                if shape is None:
+                    return pd.DataFrame({"id": idx})
+                data = (idx.reshape((-1,) + (1,) * len(shape)) *
+                        np.ones(shape)[None])
+                return pd.DataFrame({"data": list(data)})
+            return read
+
+        return [ReadTask(make(int(lo), int(hi)))
+                for lo, hi in zip(bounds[:-1], bounds[1:])]

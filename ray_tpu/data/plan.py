@@ -1,0 +1,265 @@
+"""Lazy execution plan: stages build up, execute once, fuse where possible.
+
+Capability mirror of the reference's `data/_internal/plan.py:74`
+(ExecutionPlan with stage recording + one-to-one stage fusion) and
+`data/_internal/stats.py:1` (per-stage wall/rows/bytes).  Transforms append
+stages; nothing runs until a consumption op calls ``execute()``.  Chains of
+one-to-one stages — including the read itself — fuse into ONE task per
+block, so a 10-stage map pipeline holds one set of intermediate refs, not
+ten.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from .. import api
+from .block import BlockAccessor, BlockMetadata
+
+# -- task bodies (top-level, cloudpickled once) ------------------------------
+
+
+def _fused_map(fns_blob: bytes, block):
+    """Apply a chain of block functions in one task."""
+    from ..core.serialization import loads_function
+    for fn in loads_function(fns_blob):
+        block = fn(block)
+    return block, BlockAccessor(block).metadata()
+
+
+def _fused_read(task_blob: bytes, fns_blob: bytes):
+    """Run one ReadTask then the fused downstream chain, all in one task."""
+    from ..core.serialization import loads_function
+    read_task = loads_function(task_blob)
+    block = read_task()
+    input_files = getattr(read_task, "input_files", None)
+    for fn in loads_function(fns_blob):
+        block = fn(block)
+    return block, BlockAccessor(block).metadata(input_files=input_files)
+
+
+# -- stages ------------------------------------------------------------------
+
+
+class OneToOneStage:
+    """A per-block transform; consecutive ones fuse into a single task."""
+
+    fusable = True
+
+    def __init__(self, name: str, block_fn: Callable):
+        self.name = name
+        self.block_fn = block_fn
+
+    def expected_num_blocks(self, n_in: int) -> int:
+        return n_in
+
+
+class AllToAllStage:
+    """A barrier stage (shuffle/sort/repartition) run by a driver-side fn.
+
+    ``fn(refs, meta) -> (refs, meta)`` may submit its own task graph (the
+    two-stage shuffle pattern); it cannot fuse with neighbours.
+    """
+
+    fusable = False
+
+    def __init__(self, name: str, fn: Callable,
+                 num_out: Optional[int] = None):
+        self.name = name
+        self.fn = fn
+        self.num_out = num_out
+
+    def expected_num_blocks(self, n_in: int) -> int:
+        return self.num_out if self.num_out is not None else n_in
+
+
+@dataclasses.dataclass
+class StageStats:
+    """What one executed stage (or fused stage group) cost."""
+    name: str
+    wall_s: float
+    num_tasks: int
+    out_rows: int
+    out_bytes: int
+
+    def line(self, index: int) -> str:
+        return (f"Stage {index} {self.name}: {self.num_tasks} tasks, "
+                f"{self.wall_s:.3f}s wall, rows={self.out_rows}, "
+                f"bytes={self.out_bytes}")
+
+
+class ExecutionPlan:
+    """Input blocks (or pending read tasks) + recorded stages + cache."""
+
+    def __init__(self, in_refs: Optional[List[Any]] = None,
+                 in_meta: Optional[List[BlockMetadata]] = None,
+                 read_tasks: Optional[List[Any]] = None,
+                 read_name: str = "read",
+                 parent_stats: Optional[List[StageStats]] = None):
+        assert (in_refs is None) != (read_tasks is None)
+        self._in_refs = in_refs
+        self._in_meta = in_meta
+        self._read_tasks = read_tasks
+        self._read_name = read_name
+        self._stages: List[Any] = []
+        self._out: Optional[Tuple[List[Any], List[BlockMetadata]]] = None
+        self._stats: List[StageStats] = list(parent_stats or [])
+        # ancestor plan sharing our input + stage prefix; if it executes
+        # first, we start from its cached blocks instead of replaying the
+        # whole chain (read included) from scratch
+        self._parent: Optional["ExecutionPlan"] = None
+        # how many plans branched off this one while it was lazy; >1 means
+        # this is a shared branch point that must materialize exactly once
+        self._n_children = 0
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def from_blocks(cls, refs: List[Any],
+                    meta: Optional[List[BlockMetadata]]) -> "ExecutionPlan":
+        plan = cls(in_refs=list(refs),
+                   in_meta=list(meta) if meta else
+                   [BlockMetadata()] * len(refs))
+        plan._out = (plan._in_refs, plan._in_meta)  # already materialized
+        return plan
+
+    @classmethod
+    def from_read_tasks(cls, tasks: List[Any],
+                        name: str = "read") -> "ExecutionPlan":
+        return cls(read_tasks=list(tasks), read_name=name)
+
+    def with_stage(self, stage) -> "ExecutionPlan":
+        """A new plan extending this one; this plan is never mutated.
+
+        If this plan already executed, the child starts from the cached
+        output blocks (a snapshot — shared ancestors never re-run) and
+        inherits the full stats lineage.  Otherwise the child shares the
+        same input and replays the recorded stage chain plus ``stage``.
+        """
+        if self._out is not None:
+            refs, meta = self._out
+            child = ExecutionPlan(in_refs=refs, in_meta=meta,
+                                  parent_stats=self._stats)
+        elif self._read_tasks is not None:
+            child = ExecutionPlan(read_tasks=self._read_tasks,
+                                  read_name=self._read_name,
+                                  parent_stats=self._stats)
+            child._stages = list(self._stages)
+            child._parent = self
+            self._n_children += 1
+        else:
+            child = ExecutionPlan(in_refs=self._in_refs,
+                                  in_meta=self._in_meta,
+                                  parent_stats=self._stats)
+            child._stages = list(self._stages)
+            child._parent = self
+            self._n_children += 1
+        child._stages = child._stages + [stage]
+        return child
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def executed(self) -> bool:
+        return self._out is not None
+
+    def expected_num_blocks(self) -> int:
+        n = (len(self._read_tasks) if self._read_tasks is not None
+             else len(self._in_refs))
+        for s in self._stages:
+            n = s.expected_num_blocks(n)
+        return n
+
+    def stage_names(self) -> List[str]:
+        names = ([self._read_name] if self._read_tasks is not None else [])
+        return names + [s.name for s in self._stages]
+
+    def stats(self) -> List[StageStats]:
+        return list(self._stats)
+
+    # -- execution -----------------------------------------------------------
+    def execute(self) -> Tuple[List[Any], List[BlockMetadata]]:
+        if self._out is not None:
+            return self._out
+        from ..core.serialization import dumps_function
+        from .dataset import _remote
+
+        # Materialize the nearest shared branch point first: siblings
+        # forked from the same lazy plan must not each replay the read.
+        # Its execute() recurses for deeper shared ancestors.
+        node = self._parent
+        while node is not None and node._out is None:
+            if node._n_children > 1:
+                node.execute()
+                break
+            node = node._parent
+
+        # Reuse the nearest executed ancestor's cached blocks: by
+        # construction every ancestor's stage list is a prefix of ours,
+        # so only the suffix (plus no re-read) needs to run.
+        node = self._parent
+        while node is not None and node._out is None:
+            node = node._parent
+        if node is not None:
+            refs, meta = node._out
+            self._stats = list(node._stats)
+            i = len(node._stages)
+            stages = list(self._stages)
+            return self._run_stages(stages, i, refs, meta)
+
+        stages = list(self._stages)
+        i = 0
+        if self._read_tasks is not None:
+            # fuse the read with every leading one-to-one stage
+            fuse: List[Any] = []
+            while i < len(stages) and stages[i].fusable:
+                fuse.append(stages[i])
+                i += 1
+            name = "->".join([self._read_name] + [s.name for s in fuse])
+            t0 = time.perf_counter()
+            fns_blob = dumps_function([s.block_fn for s in fuse])
+            f = _remote("fused_read", _fused_read, num_returns=2)
+            pairs = [f.remote(dumps_function(task), fns_blob)
+                     for task in self._read_tasks]
+            refs = [p[0] for p in pairs]
+            meta = api.get([p[1] for p in pairs], timeout=600.0)
+            self._record(name, t0, len(refs), meta)
+        else:
+            refs, meta = self._in_refs, self._in_meta
+        return self._run_stages(stages, i, refs, meta)
+
+    def _run_stages(self, stages: List[Any], i: int, refs: List[Any],
+                    meta: List[BlockMetadata]):
+        from ..core.serialization import dumps_function
+        from .dataset import _remote
+
+        while i < len(stages):
+            if stages[i].fusable:
+                fuse = []
+                while i < len(stages) and stages[i].fusable:
+                    fuse.append(stages[i])
+                    i += 1
+                name = "->".join(s.name for s in fuse)
+                t0 = time.perf_counter()
+                fns_blob = dumps_function([s.block_fn for s in fuse])
+                f = _remote("fused_map", _fused_map, num_returns=2)
+                pairs = [f.remote(fns_blob, b) for b in refs]
+                refs = [p[0] for p in pairs]
+                meta = api.get([p[1] for p in pairs], timeout=600.0)
+                self._record(name, t0, len(refs), meta)
+            else:
+                stage = stages[i]
+                i += 1
+                t0 = time.perf_counter()
+                refs, meta = stage.fn(refs, meta)
+                self._record(stage.name, t0, len(refs), meta)
+
+        self._out = (refs, meta)
+        return self._out
+
+    def _record(self, name: str, t0: float, n_tasks: int,
+                meta: List[BlockMetadata]) -> None:
+        self._stats.append(StageStats(
+            name=name, wall_s=time.perf_counter() - t0, num_tasks=n_tasks,
+            out_rows=sum(m.num_rows or 0 for m in meta),
+            out_bytes=sum(m.size_bytes or 0 for m in meta)))

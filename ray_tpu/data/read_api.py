@@ -1,22 +1,24 @@
-"""Dataset creation: in-memory sources and file datasources.
+"""Dataset creation: in-memory sources and lazy file datasources.
 
 Capability mirror of the reference's `data/read_api.py` + `data/datasource/`
 (range/from_items/from_pandas/from_numpy/from_arrow, parquet/csv/json/text/
-binary readers).  File reads fan out one runtime task per file.
+binary readers, read_datasource).  File and range reads are LAZY: they
+build ReadTasks on an ExecutionPlan, so the read fuses with downstream map
+stages into one task per file (reference: `data/_internal/plan.py:74`).
 """
 
 from __future__ import annotations
 
 import builtins
-import glob as _glob
-import os
-from typing import Any, List, Optional
-
-import numpy as np
+from typing import Any, List
 
 from .. import api
-from .block import BlockAccessor, BlockMetadata
-from .dataset import Dataset, _remote
+from .block import BlockAccessor
+from .dataset import Dataset
+from .datasource import (BinaryDatasource, CSVDatasource, Datasource,
+                         JSONDatasource, ParquetDatasource, RangeDatasource,
+                         TextDatasource)
+from .plan import ExecutionPlan
 
 
 def _put_blocks(blocks: List[Any]) -> Dataset:
@@ -34,26 +36,13 @@ def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
 
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
-    import pandas as pd
-    n_blocks = max(1, min(parallelism, n or 1))
-    bounds = np.linspace(0, n, n_blocks + 1).astype(int)
-    blocks = [pd.DataFrame({"id": np.arange(lo, hi)})
-              for lo, hi in zip(bounds[:-1], bounds[1:])]
-    return _put_blocks(blocks)
+    return read_datasource(RangeDatasource(n), parallelism=parallelism,
+                           _name="range")
 
 
 def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
-    import pandas as pd
-    n_blocks = max(1, min(parallelism, n or 1))
-    bounds = np.linspace(0, n, n_blocks + 1).astype(int)
-    blocks = []
-    for lo, hi in zip(bounds[:-1], bounds[1:]):
-        idx = np.arange(lo, hi)
-        data = (idx.reshape((-1,) + (1,) * len(shape)) *
-                np.ones(shape)[None])
-        blocks.append(pd.DataFrame(
-            {"data": list(data)}))
-    return _put_blocks(blocks)
+    return read_datasource(RangeDatasource(n, tensor_shape=shape),
+                           parallelism=parallelism, _name="range_tensor")
 
 
 def from_pandas(dfs) -> Dataset:
@@ -75,70 +64,34 @@ def from_numpy(arrays) -> Dataset:
     return _put_blocks([pd.DataFrame({"data": list(a)}) for a in arrays])
 
 
-# -- file readers -----------------------------------------------------------
+# -- lazy reads --------------------------------------------------------------
 
-def _expand(paths) -> List[str]:
-    if isinstance(paths, str):
-        paths = [paths]
-    out: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            out.extend(sorted(
-                f for f in _glob.glob(os.path.join(p, "**"), recursive=True)
-                if os.path.isfile(f)))
-        elif any(ch in p for ch in "*?["):
-            out.extend(sorted(_glob.glob(p)))
-        else:
-            out.append(p)
-    if not out:
-        raise FileNotFoundError(f"no files matched {paths}")
-    return out
-
-
-def _read_file(path: str, fmt: str, kwargs: dict):
-    import pandas as pd
-    if fmt == "parquet":
-        block = pd.read_parquet(path, **kwargs)
-    elif fmt == "csv":
-        block = pd.read_csv(path, **kwargs)
-    elif fmt == "json":
-        block = pd.read_json(path, orient="records", lines=True, **kwargs)
-    elif fmt == "text":
-        with open(path, "r", errors="replace") as f:
-            block = [line.rstrip("\n") for line in f]
-    elif fmt == "binary":
-        with open(path, "rb") as f:
-            block = [f.read()]
-    else:
-        raise ValueError(fmt)
-    meta = BlockAccessor(block).metadata(input_files=[path])
-    return block, meta
-
-
-def _read(paths, fmt: str, **kwargs) -> Dataset:
-    files = _expand(paths)
-    f = _remote("read_file", _read_file, num_returns=2)
-    pairs = [f.remote(p, fmt, kwargs) for p in files]
-    refs = [p[0] for p in pairs]
-    meta = api.get([p[1] for p in pairs], timeout=600.0)
-    return Dataset(refs, meta)
+def read_datasource(datasource: Datasource, *, parallelism: int = 8,
+                    _name: str = "read", **read_args) -> Dataset:
+    """Build a lazy dataset from any Datasource's ReadTasks."""
+    tasks = datasource.prepare_read(parallelism, **read_args)
+    return Dataset.from_plan(ExecutionPlan.from_read_tasks(tasks, _name))
 
 
 def read_parquet(paths, **kwargs) -> Dataset:
-    return _read(paths, "parquet", **kwargs)
+    return read_datasource(ParquetDatasource(paths, **kwargs),
+                           _name="read_parquet")
 
 
 def read_csv(paths, **kwargs) -> Dataset:
-    return _read(paths, "csv", **kwargs)
+    return read_datasource(CSVDatasource(paths, **kwargs), _name="read_csv")
 
 
 def read_json(paths, **kwargs) -> Dataset:
-    return _read(paths, "json", **kwargs)
+    return read_datasource(JSONDatasource(paths, **kwargs),
+                           _name="read_json")
 
 
 def read_text(paths, **kwargs) -> Dataset:
-    return _read(paths, "text", **kwargs)
+    return read_datasource(TextDatasource(paths, **kwargs),
+                           _name="read_text")
 
 
 def read_binary_files(paths, **kwargs) -> Dataset:
-    return _read(paths, "binary", **kwargs)
+    return read_datasource(BinaryDatasource(paths, **kwargs),
+                           _name="read_binary_files")

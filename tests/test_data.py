@@ -195,3 +195,149 @@ def test_split_at_indices_edge_cases(cluster):
     empty = rdata.from_items(list(range(3)), parallelism=1).limit(0)
     train, test = empty.split_at_indices([1])
     assert train.count() == 0 and test.count() == 0
+
+
+def test_lazy_plan_and_fusion(cluster):
+    """Transforms record stages without executing (reference:
+    `data/_internal/plan.py:74`); chained map-family stages — including
+    the read — fuse into ONE task per block."""
+    ds = rdata.range(40, parallelism=4) \
+        .map_batches(lambda df: df.assign(id=df["id"] + 1),
+                     batch_format="pandas") \
+        .filter(lambda r: r["id"] % 2 == 0)
+    assert not ds._plan.executed
+    assert ds.num_blocks() == 4          # planned, not executed
+    assert "lazy stages" in repr(ds)
+
+    vals = sorted(r["id"] for r in ds.iter_rows())
+    assert vals == [i for i in range(1, 41) if i % 2 == 0]
+    assert ds._plan.executed
+    stats = ds._plan.stats()
+    # one fused stage ran: read+map_batches+filter in a single task/block
+    assert len(stats) == 1
+    assert stats[0].name == "range->map_batches->filter"
+    assert stats[0].num_tasks == 4
+
+
+def test_stats_per_stage(cluster):
+    """ds.stats() reports wall/rows/bytes per executed stage (reference:
+    `data/_internal/stats.py:1`)."""
+    ds = rdata.range(30, parallelism=3) \
+        .map(lambda r: {"id": r["id"]}) \
+        .repartition(2) \
+        .filter(lambda r: r["id"] < 15)
+    report = ds.stats()
+    lines = report.splitlines()
+    assert "range->map" in lines[0] and "3 tasks" in lines[0]
+    assert "repartition" in lines[1] and "2 tasks" in lines[1]
+    assert "filter" in lines[2]
+    assert "rows=15" in lines[-1]
+
+
+def test_lazy_snapshot_no_reexecution(cluster):
+    """Extending an executed dataset starts from its cached blocks; the
+    ancestor stages do not re-run."""
+    base = rdata.range(20, parallelism=2).map(lambda r: r)
+    assert base.count() == 20            # forces execution
+    n_stats = len(base._plan.stats())
+    child = base.filter(lambda r: r["id"] < 5)
+    assert child.count() == 5
+    # child lineage = inherited stats + exactly one new fused stage
+    assert len(child._plan.stats()) == n_stats + 1
+    assert len(base._plan.stats()) == n_stats  # parent untouched
+
+
+def test_custom_datasource_read_write(cluster, tmp_path):
+    """Datasource ABC round trip (reference:
+    `data/datasource/datasource.py:1`): a user datasource plugs into
+    read_datasource and write_datasource."""
+
+    class NpyDatasource(rdata.FileBasedDatasource):
+        _FILE_EXT = "npy"
+
+        def _read_file(self, path, **kw):
+            import pandas as pd
+            return pd.DataFrame({"v": np.load(path)})
+
+        def _write_file(self, df, path, **kw):
+            np.save(path, df["v"].to_numpy())
+
+    src = tmp_path / "src"
+    src.mkdir()
+    np.save(src / "a.npy", np.arange(5))
+    np.save(src / "b.npy", np.arange(5, 10))
+
+    ds = rdata.read_datasource(NpyDatasource(str(src)))
+    assert not ds._plan.executed
+    assert sorted(r["v"] for r in ds.iter_rows()) == list(range(10))
+
+    out = tmp_path / "out"
+    results = ds.write_datasource(NpyDatasource(), path=str(out))
+    assert len(results) == 2
+    back = np.sort(np.concatenate(
+        [np.load(f) for f in sorted(out.glob("*.npy"))]))
+    assert back.tolist() == list(range(10))
+
+
+def test_lazy_branch_reuses_parent_cache(cluster):
+    """A dataset branched BEFORE the parent executed still reuses the
+    parent's cached blocks once the parent runs (no re-read)."""
+    calls = []
+
+    class CountingDatasource(rdata.Datasource):
+        def prepare_read(self, parallelism, **kw):
+            import tempfile, os
+            marker = tempfile.mkdtemp(prefix="rt_count_")
+
+            def make(i):
+                def read():
+                    import os
+                    # one file per (task, execution) — lets the test count
+                    # how many times the read actually ran
+                    open(os.path.join(marker, f"{i}-{os.getpid()}-"
+                                      f"{len(os.listdir(marker))}"),
+                         "w").close()
+                    return [{"id": i}]
+                return read
+            tasks = [rdata.ReadTask(make(i)) for i in range(3)]
+            tasks[0].marker = marker
+            calls.append(marker)
+            return tasks
+
+    ds = rdata.read_datasource(CountingDatasource())
+    child = ds.map(lambda r: {"id": r["id"] + 1})  # branch while lazy
+    assert ds.count() == 3                         # parent executes first
+    import os
+    marker = calls[0]
+    n_after_parent = len(os.listdir(marker))
+    assert n_after_parent == 3
+    assert child.count() == 3
+    # child started from the parent's cached blocks: no extra reads
+    assert len(os.listdir(marker)) == n_after_parent
+
+
+def test_lazy_sibling_branches_read_once(cluster, tmp_path):
+    """Two branches forked from the same never-consumed lazy dataset
+    materialize the shared prefix once — the read does not replay per
+    branch."""
+    marker = tmp_path / "reads"
+    marker.mkdir()
+
+    class CountingDatasource(rdata.Datasource):
+        def prepare_read(self, parallelism, **kw):
+            mdir = str(marker)
+
+            def make(i):
+                def read():
+                    import os, uuid
+                    open(os.path.join(mdir, uuid.uuid4().hex), "w").close()
+                    return [{"id": i}]
+                return read
+            return [rdata.ReadTask(make(i)) for i in range(2)]
+
+    ds = rdata.read_datasource(CountingDatasource())
+    a = ds.map(lambda r: {"id": r["id"] + 1})
+    b = ds.map(lambda r: {"id": r["id"] * 10})
+    assert a.count() == 2
+    assert b.count() == 2
+    assert len(list(marker.iterdir())) == 2  # each read task ran ONCE
