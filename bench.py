@@ -166,8 +166,42 @@ def _run_measurement() -> dict:
     }
 
 
+def _run_rl_measurement() -> dict:
+    """PPO env-steps/s on the local device mesh (BASELINE north star #3:
+    100k env-steps/s).  Uses DDPPO — every device a learner, pmean grad
+    sync — so the number scales with the mesh instead of one chip."""
+    import jax
+
+    from ray_tpu.rl import CartPole, DDPPOConfig
+
+    n = len(jax.devices())
+    algo = DDPPOConfig(env=CartPole, num_envs=64, rollout_length=128,
+                       num_learners=n, lr=1e-3, seed=0).build()
+    algo.train()                      # compile + warmup
+    t0 = time.perf_counter()
+    steps = 0
+    iters = 0
+    while time.perf_counter() - t0 < 10.0 or iters < 3:
+        res = algo.train()
+        steps += res["env_steps_this_iter"]
+        iters += 1
+    dt = time.perf_counter() - t0
+    rate = steps / dt
+    return {
+        "metric": "ppo_env_steps_per_s", "value": round(rate, 1),
+        "unit": "env_steps/s", "vs_baseline": round(rate / 100_000, 4),
+        "detail": {"algo": "DDPPO", "num_learners": n, "iters": iters,
+                   "backend": jax.default_backend(),
+                   "episode_reward_mean":
+                       round(res["episode_reward_mean"], 1)},
+    }
+
+
 def _child_main(mode: str) -> None:
     """Run one measurement attempt in this (fresh) process."""
+    if mode == "rl":
+        print(json.dumps(_run_rl_measurement()))
+        return
     if mode == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
@@ -180,6 +214,12 @@ def _spawn(mode: str) -> "subprocess.CompletedProcess":
     if mode == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
+    elif mode == "rl":  # 8-device host mesh, TPU plugin bypassed
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                    "RAY_TPU_DEVICE_BACKEND": "cpu",
+                    "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
+                                  " --xla_force_host_platform_device"
+                                  "_count=8")})
     return subprocess.run(
         [sys.executable, os.path.abspath(__file__)], env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -198,10 +238,31 @@ def _extract_json_line(out: str):
     return None
 
 
+def _rl_main() -> None:
+    """`python bench.py --rl`: PPO env-steps/s on an 8-device CPU mesh
+    (the TPU headline stays the default mode; this is north star #3)."""
+    try:
+        proc = _spawn("rl")
+        result = _extract_json_line(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            print(json.dumps(result))
+            return
+        err = proc.stderr.strip()[-300:]
+    except Exception:
+        err = traceback.format_exc(limit=2)
+    print(json.dumps({
+        "metric": "ppo_env_steps_per_s", "value": 0.0,
+        "unit": "env_steps/s", "vs_baseline": 0.0,
+        "detail": {"error": err}}))
+
+
 def main() -> None:
     mode = os.environ.get(_CHILD_FLAG)
     if mode:
         _child_main(mode)
+        return
+    if "--rl" in sys.argv:
+        _rl_main()
         return
 
     errors = []
@@ -209,10 +270,18 @@ def main() -> None:
         t0 = time.perf_counter()
         try:
             proc = _spawn("tpu")
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as exc:
+            # the child's stderr breadcrumbs say WHERE it stalled
+            # (client init → relay wedged; post-backend → compile)
+            tail = exc.stderr or b""
+            if isinstance(tail, bytes):
+                tail = tail.decode(errors="replace")
+            tail = " | ".join(tail.strip().splitlines()[-4:])
+            crumbs = tail[-400:] or \
+                "(none - blocked before jax import finished)"
             errors.append(f"tpu attempt {attempt}: timeout after "
-                          f"{_TPU_ATTEMPT_TIMEOUT}s (wedged or "
-                          "over-budget compile)")
+                          f"{_TPU_ATTEMPT_TIMEOUT}s; breadcrumbs: "
+                          f"{crumbs}")
             break  # a killed slow attempt may have wedged the grant: stop
         result = _extract_json_line(proc.stdout)
         if proc.returncode == 0 and result is not None:

@@ -30,6 +30,7 @@ from .multi_agent import (  # noqa: F401
     MultiAgentJaxEnv,
     SpreadLine,
 )
+from .ddppo import DDPPO, DDPPOConfig  # noqa: F401
 from .policy import MLPPolicy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401

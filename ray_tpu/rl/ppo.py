@@ -93,6 +93,67 @@ def compute_gae(traj, last_value, gamma: float, lam: float):
     return advantages, returns
 
 
+def make_update_fn(policy, optimizer, cfg, batch_size: int,
+                   axis_name: Optional[str] = None):
+    """Epoch/minibatch clipped-surrogate SGD as one scan program.
+
+    With ``axis_name`` set the gradients are `pmean`-averaged across that
+    mesh axis before every apply — the decentralized-DP (DDPPO) learner
+    pattern where each device runs identical SGD on synchronized params.
+    """
+    mb_size = batch_size // cfg.num_minibatches
+
+    def loss_fn(params, batch):
+        logp, entropy, value = jax.vmap(
+            lambda o, a: policy.log_prob(params, o, a))(
+                batch["obs"], batch["action"])
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["adv"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - cfg.clip_eps,
+                           1 + cfg.clip_eps) * adv
+        pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+        vf_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
+        ent = jnp.mean(entropy)
+        total = pi_loss + cfg.vf_coeff * vf_loss \
+            - cfg.entropy_coeff * ent
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": ent}
+
+    def update_epoch(carry, _):
+        params, opt_state, batch, key = carry
+        key, pkey = jax.random.split(key)
+        perm = jax.random.permutation(pkey, batch_size)
+
+        def update_minibatch(carry, idx):
+            params, opt_state = carry
+            mb = jax.tree_util.tree_map(lambda x: x[idx], batch)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            if axis_name is not None:
+                grads = jax.lax.pmean(grads, axis_name)
+            updates, opt_state = optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), aux
+
+        idxs = perm[:cfg.num_minibatches * mb_size].reshape(
+            cfg.num_minibatches, mb_size)
+        (params, opt_state), auxs = jax.lax.scan(
+            update_minibatch, (params, opt_state), idxs)
+        return (params, opt_state, batch, key), auxs
+
+    def update(params, opt_state, flat, key):
+        (params, opt_state, _, key), auxs = jax.lax.scan(
+            update_epoch, (params, opt_state, flat, key), None,
+            length=cfg.num_sgd_epochs)
+        metrics = jax.tree_util.tree_map(lambda x: x[-1, -1], auxs)
+        return params, opt_state, key, metrics
+
+    return update
+
+
 class PPO(Algorithm):
     _config_cls = PPOConfig
 
@@ -127,58 +188,8 @@ class PPO(Algorithm):
 
     # -- the compiled iteration --------------------------------------------
     def _make_update_fn(self, batch_size: int):
-        cfg = self.config
-        policy = self.policy
-        mb_size = batch_size // cfg.num_minibatches
-
-        def loss_fn(params, batch):
-            logp, entropy, value = jax.vmap(
-                lambda o, a: policy.log_prob(params, o, a))(
-                    batch["obs"], batch["action"])
-            ratio = jnp.exp(logp - batch["logp"])
-            adv = batch["adv"]
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            unclipped = ratio * adv
-            clipped = jnp.clip(ratio, 1 - cfg.clip_eps,
-                               1 + cfg.clip_eps) * adv
-            pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
-            vf_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
-            ent = jnp.mean(entropy)
-            total = pi_loss + cfg.vf_coeff * vf_loss \
-                - cfg.entropy_coeff * ent
-            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
-                           "entropy": ent}
-
-        def update_epoch(carry, _):
-            params, opt_state, batch, key = carry
-            key, pkey = jax.random.split(key)
-            perm = jax.random.permutation(pkey, batch_size)
-
-            def update_minibatch(carry, idx):
-                params, opt_state = carry
-                mb = jax.tree_util.tree_map(
-                    lambda x: x[idx], batch)
-                (loss, aux), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, mb)
-                updates, opt_state = self.optimizer.update(
-                    grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), aux
-
-            idxs = perm[:cfg.num_minibatches * mb_size].reshape(
-                cfg.num_minibatches, mb_size)
-            (params, opt_state), auxs = jax.lax.scan(
-                update_minibatch, (params, opt_state), idxs)
-            return (params, opt_state, batch, key), auxs
-
-        def update(params, opt_state, flat, key):
-            (params, opt_state, _, key), auxs = jax.lax.scan(
-                update_epoch, (params, opt_state, flat, key), None,
-                length=cfg.num_sgd_epochs)
-            metrics = jax.tree_util.tree_map(lambda x: x[-1, -1], auxs)
-            return params, opt_state, key, metrics
-
-        return update
+        return make_update_fn(self.policy, self.optimizer, self.config,
+                              batch_size)
 
     def _make_train_iter(self):
         cfg = self.config
