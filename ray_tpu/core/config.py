@@ -100,7 +100,21 @@ _d("max_direct_call_object_size", int, 100 * 1024,
 _d("object_transfer_chunk_bytes", int, 4 * 1024 * 1024,
    "Chunk size for node-to-node object push (reference: object_manager.proto).")
 _d("worker_pool_initial_size", int, 2, "Workers prestarted per node.")
-_d("worker_pool_max_size", int, 16, "Hard cap on workers per node.")
+_d("worker_pool_max_size", int, 16,
+   "Hard cap on TASK-serving workers per node (import-storm guard).  "
+   "Workers dedicated to actors are counted separately under "
+   "actor_workers_max: they never return to the pool, so counting them "
+   "here would deadlock actor creation once the cap filled.")
+_d("actor_workers_max", int, 4096,
+   "Hard cap on actor-dedicated workers per node (reference analogue: "
+   "unbounded actor workers; bounded here as an OS-process backstop).")
+_d("worker_fork_server", bool, True,
+   "Fork workers from a pre-warmed zygote process (~10ms) instead of "
+   "exec'ing a fresh interpreter (~250ms import tax).  Falls back to "
+   "exec automatically if the zygote dies.")
+_d("actor_spawn_parallelism", int, 4,
+   "Max worker processes concurrently forked for a burst of actor "
+   "creations (Python import cost serializes on small hosts).")
 _d("worker_lease_idle_seconds", float, 0.2,
    "Grace period a drained lease is held awaiting new same-key tasks before "
    "the worker (and its resources) return to the pool.  Short on purpose: "
@@ -131,6 +145,14 @@ _d("memory_monitor_interval_s", float, 1.0,
 _d("memory_usage_threshold", float, 0.95,
    "Fraction of system memory above which the nodelet OOM-kills a worker "
    "(reference: memory_usage_threshold, worker_killing_policy.cc).")
+_d("task_pipeline_depth", int, 8,
+   "Max push_task RPCs in flight per leased worker; the worker still "
+   "executes serially (one executor thread) so this only hides the "
+   "submission round trip (reference: direct task transport pipelining).")
+_d("task_pipeline_fast_ms", float, 10.0,
+   "Pipeline a lease past depth 1 only when its completion-latency EWMA "
+   "is under this; deep windows on slow tasks would serialize work that "
+   "other leased workers could run in parallel.")
 _d("max_pending_lease_requests", int, 10,
    "Free (not-yet-executing) lease loops per scheduling key — bounds the "
    "lease-request pipeline like the reference's "

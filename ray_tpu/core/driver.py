@@ -661,6 +661,12 @@ class CoreClient:
             if reply.get("infeasible"):
                 return None
             if reply.get("timeout"):
+                # Busy, not infeasible: the cluster is saturated and the
+                # task is queued work.  Waiting must not burn the deadline
+                # (a 50k-task burst keeps every worker leased for minutes)
+                # — the reference likewise queues feasible tasks forever.
+                deadline = time.monotonic() + \
+                    GlobalConfig.lease_request_timeout_s
                 addr = self.nodelet_addr  # re-evaluate from local
                 continue
             return None
@@ -668,32 +674,46 @@ class CoreClient:
 
     async def _drain_through_worker(self, state: _SchedulingKeyState,
                                     worker_addr: str):
+        """Drain queued tasks through one leased worker, PIPELINED.
+
+        Up to ``task_pipeline_depth`` push_task calls ride the connection
+        concurrently; the worker executes them serially on its one
+        executor thread (resource semantics hold — one task RUNS at a
+        time), so pipelining only hides the per-push RPC round trip.
+        Mirrors the reference's submission pipelining
+        (direct_task_transport.cc in-flight pushes per lease).
+        """
         conn = await self._worker_conn(worker_addr)
+        max_depth = max(1, GlobalConfig.task_pipeline_depth)
+        fast_s = GlobalConfig.task_pipeline_fast_ms / 1000.0
         idle_deadline = time.monotonic() + GlobalConfig.worker_lease_idle_seconds
-        while True:
-            if not state.queue:
-                # Hold the lease briefly for new work (lease reuse hot path).
-                if time.monotonic() > idle_deadline:
-                    return
-                state.wakeup.clear()
-                try:
-                    await asyncio.wait_for(state.wakeup.wait(), timeout=0.05)
-                except asyncio.TimeoutError:
-                    continue
-                continue
-            spec, attempts_left = state.queue.popleft()
+        inflight: Dict[asyncio.Future, tuple] = {}
+        worker_dead = False
+        # Adaptive depth: a deep window on SLOW tasks would serialize work
+        # one lease could have spread across workers (the queue drains into
+        # this window and _maybe_grow_leases sees nothing left to grow
+        # for).  Start at 1 — identical to unpipelined behavior — and
+        # deepen only once completions prove sub-``fast_ms`` latency,
+        # where hiding the push RTT is the whole win.
+        depth = 1
+        lat_ewma: Optional[float] = None
+
+        async def _reap(fut: asyncio.Future) -> bool:
+            """Handle one completed push; returns True if lease is dead."""
+            nonlocal worker_dead, depth, lat_ewma
+            spec, attempts_left, t_push, occ = inflight.pop(fut)
+            # Normalize by the window occupancy at push time: at depth d a
+            # push waits behind ~d-1 earlier tasks in the serial worker, so
+            # raw push-to-reply latency scales with d and comparing it to
+            # fast_s directly would flap the depth between max and 1.
+            dt = (time.monotonic() - t_push) / max(1, occ)
+            lat_ewma = dt if lat_ewma is None else 0.7 * lat_ewma + 0.3 * dt
+            depth = max_depth if lat_ewma < fast_s else 1
             tid = spec.task_id.binary()
-            if tid in self._cancelled:
-                self._finish_cancel(spec)  # cancelled while queued
-                continue
-            state.busy += 1
-            self._task_sites[tid] = conn
+            state.busy -= 1
+            self._task_sites.pop(tid, None)
             try:
-                # The queue may still hold tasks that must run CONCURRENTLY
-                # with this one; with this loop now busy, grow the pool.
-                self._maybe_grow_leases(None, state)
-                reply = await conn.call("push_task", {"spec": spec.to_wire()},
-                                        timeout=None)
+                reply = fut.result()
             except rpc.RpcError as e:
                 self._worker_conns.pop(worker_addr, None)
                 if tid in self._cancelled:
@@ -702,15 +722,76 @@ class CoreClient:
                 elif attempts_left > 0:
                     state.queue.appendleft((spec, attempts_left - 1))
                 else:
-                    self._fail_task(spec, f"worker died executing task: {e}")
-                return  # lease is dead either way
-            finally:
+                    self._fail_task(spec,
+                                    f"worker died executing task: {e}")
+                worker_dead = True
+                return True
+            self._handle_task_reply(spec, reply, attempts_left, state)
+            return False
+
+        try:
+            while True:
+                # Clear BEFORE the fill scan: an enqueue that lands after
+                # the scan re-sets it and the wait below returns at once.
+                state.wakeup.clear()
+                while state.queue and len(inflight) < depth \
+                        and not worker_dead:
+                    spec, attempts_left = state.queue.popleft()
+                    tid = spec.task_id.binary()
+                    if tid in self._cancelled:
+                        self._finish_cancel(spec)  # cancelled while queued
+                        continue
+                    state.busy += 1
+                    self._task_sites[tid] = conn
+                    # The queue may still hold tasks that must run
+                    # CONCURRENTLY with this one; with this loop now busy,
+                    # grow the pool.
+                    self._maybe_grow_leases(None, state)
+                    fut = asyncio.ensure_future(
+                        conn.call("push_task", {"spec": spec.to_wire()},
+                                  timeout=None))
+                    inflight[fut] = (spec, attempts_left, time.monotonic(),
+                                     len(inflight) + 1)
+                if inflight:
+                    # Event-driven: wake on a completion OR on new queued
+                    # work (to top up a free pipeline slot) — a leased
+                    # worker running a minutes-long task costs ZERO
+                    # wakeups here.
+                    waker = asyncio.ensure_future(state.wakeup.wait())
+                    try:
+                        done, _ = await asyncio.wait(
+                            list(inflight) + [waker],
+                            return_when=asyncio.FIRST_COMPLETED)
+                    finally:
+                        waker.cancel()
+                    done.discard(waker)
+                    for fut in done:
+                        await _reap(fut)
+                    if done and not worker_dead:
+                        idle_deadline = time.monotonic() + \
+                            GlobalConfig.worker_lease_idle_seconds
+                    continue
+                if worker_dead:
+                    return  # lease is dead; caller re-leases
+                if not state.queue:
+                    # Hold the lease for new work (reuse hot path) until
+                    # the idle deadline — one timed wait, not a poll.
+                    remaining = idle_deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    try:
+                        await asyncio.wait_for(state.wakeup.wait(),
+                                               timeout=remaining)
+                    except asyncio.TimeoutError:
+                        pass
+        finally:
+            # a cancelled drain (client shutdown) must not leak busy counts
+            for fut in list(inflight):
+                fut.cancel()
+                spec, attempts_left, _, _ = inflight.pop(fut)
                 state.busy -= 1
-                self._task_sites.pop(tid, None)
-            retried = self._handle_task_reply(spec, reply, attempts_left, state)
-            if retried:
-                continue
-            idle_deadline = time.monotonic() + GlobalConfig.worker_lease_idle_seconds
+                self._task_sites.pop(spec.task_id.binary(), None)
+                state.queue.appendleft((spec, attempts_left))
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict,
                            attempts_left: int,

@@ -23,7 +23,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Set
 
-from . import rpc, spill
+from . import rpc, worker_zygote, spill
 from .config import GlobalConfig
 from .ids import NodeID, WorkerID
 from .object_store import client as store_client
@@ -99,6 +99,11 @@ class Nodelet:
         self._peer_conns: Dict[str, rpc.Connection] = {}
         self._tasks: List[asyncio.Task] = []
         self._next_worker_seq = 0
+        self._pending_actor_starts = 0
+        # Spawns parked in `await zygote.spawn()` are not yet in
+        # self.workers; count them or a burst blows past the pool caps.
+        self._spawns_inflight = 0
+        self.zygote: Optional[worker_zygote.ZygoteClient] = None
         self._stopping = False
         self._register_handlers()
 
@@ -109,8 +114,8 @@ class Nodelet:
                      "pull", "fetch_meta", "fetch", "free_local", "pg_prepare",
                      "pg_commit", "pg_abort", "pg_return", "kill_worker_at",
                      "node_info", "stats", "put_location", "ping",
-                     "task_state", "node_stats", "tail_log", "task_spans",
-                     "prestart_workers"):
+                     "task_state", "task_state_batch", "node_stats",
+                     "tail_log", "task_spans", "prestart_workers"):
             s.register(name, getattr(self, "_h_" + name))
 
     @property
@@ -128,8 +133,15 @@ class Nodelet:
             self.transfer_port = None  # chunked-RPC fallback still works
         await self.server.start()
         await self._connect_controller()
+        if GlobalConfig.worker_fork_server:
+            try:
+                self.zygote = await worker_zygote.ZygoteClient.create(
+                    self.session_dir)
+            except Exception:
+                traceback.print_exc()
+                self.zygote = None  # exec fallback for every spawn
         for _ in range(GlobalConfig.worker_pool_initial_size):
-            self._spawn_worker()
+            await self._spawn_worker()
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         if GlobalConfig.memory_monitor_interval_s > 0:
@@ -169,12 +181,21 @@ class Nodelet:
         self._stopping = True
         for t in self._tasks:
             t.cancel()
+        # Stop the zygote FIRST: its exit-push read loop lives on this
+        # (now-stopping) event loop, so ForkedProc.poll() must fall back
+        # to its direct os.kill liveness probe for the waits below to
+        # ever observe an exit.
+        if self.zygote is not None:
+            self.zygote.stop()
         for w in self.workers.values():
             if w.proc.poll() is None:
                 w.proc.terminate()
+        # One shared deadline — not 2 s per worker (a 1k-worker node
+        # would stall shutdown for half an hour serially).
+        deadline = time.monotonic() + 2.0
         for w in self.workers.values():
             try:
-                w.proc.wait(timeout=2)
+                w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
             except Exception:
                 w.proc.kill()
         await self.server.stop()
@@ -250,6 +271,17 @@ class Nodelet:
         prev_state = w.state
         w.state = "dead"
         self.workers.pop(w.worker_id, None)
+        # The worker's batched finish event may have died in its buffer;
+        # the process is gone, so its "running" entry is stale by
+        # definition — close it out as interrupted.
+        run = self._running_tasks.pop(w.worker_id, None)
+        if run is not None:
+            self._task_spans.append({
+                "name": run.get("name", "?"),
+                "worker_id": w.worker_id.hex(),
+                "task_id": run.get("task_id", ""),
+                "start": run.get("start"), "end": time.time(),
+                "interrupted": True})
         if prev_state == "leased" and w.lease_id in self.leases:
             lease = self.leases.pop(w.lease_id)
             self.available.release(lease.resources)
@@ -271,7 +303,7 @@ class Nodelet:
                 await self._notify_lease_waiters()
         if (prev_state in ("idle", "starting") and not self._stopping
                 and len(self.workers) < GlobalConfig.worker_pool_initial_size):
-            self._spawn_worker()
+            await self._spawn_worker()
 
     # ------------------------------------------------------- memory monitor
     @staticmethod
@@ -432,27 +464,51 @@ class Nodelet:
         return True
 
     # ------------------------------------------------------------ worker pool
-    def _spawn_worker(self) -> WorkerProc:
+    async def _spawn_worker(self) -> WorkerProc:
+        """Fork a worker from the zygote (~10 ms) or exec one (~250 ms).
+
+        The fork-server path is the default; it falls back to the exec
+        path transparently if the zygote is missing or died.
+        """
         worker_id = WorkerID.from_random().binary()
         self._next_worker_seq += 1
         log_path = os.path.join(self.session_dir, "logs",
                                 f"worker-{self.node_id.hex()[:8]}-{self._next_worker_seq}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
-        env = dict(os.environ)
-        env.update(self.worker_env)
+        env = dict(self.worker_env)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        logf = open(log_path, "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main",
-             "--nodelet", self.address,
-             "--controller", self.controller_addr,
-             "--store", self.store_path,
-             "--node-id", self.node_id.hex(),
-             "--worker-id", worker_id.hex(),
-             "--session-dir", self.session_dir],
-            stdout=logf, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True)
-        logf.close()
+        proc = None
+        if self.zygote is not None and not self.zygote.dead:
+            self._spawns_inflight += 1
+            try:
+                pid = await self.zygote.spawn(
+                    {"nodelet": self.address,
+                     "controller": self.controller_addr,
+                     "store": self.store_path,
+                     "node_id": self.node_id.hex(),
+                     "worker_id": worker_id.hex(),
+                     "session_dir": self.session_dir},
+                    log_path, env)
+                proc = worker_zygote.ForkedProc(pid, self.zygote)
+            except Exception:
+                proc = None  # zygote sick: exec below, heal at next boot
+            finally:
+                self._spawns_inflight -= 1
+        if proc is None:
+            full_env = dict(os.environ)
+            full_env.update(env)
+            logf = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main",
+                 "--nodelet", self.address,
+                 "--controller", self.controller_addr,
+                 "--store", self.store_path,
+                 "--node-id", self.node_id.hex(),
+                 "--worker-id", worker_id.hex(),
+                 "--session-dir", self.session_dir],
+                stdout=logf, stderr=subprocess.STDOUT, env=full_env,
+                start_new_session=True)
+            logf.close()
         w = WorkerProc(worker_id, proc)
         self.workers[worker_id] = w
         return w
@@ -471,8 +527,9 @@ class Nodelet:
 
     async def _h_prestart_workers(self, conn, data):
         for _ in range(data.get("count", 1)):
-            if len(self.workers) < GlobalConfig.worker_pool_max_size:
-                self._spawn_worker()
+            if len(self.workers) + self._spawns_inflight \
+                    < GlobalConfig.worker_pool_max_size:
+                await self._spawn_worker()
         return True
 
     async def _pop_idle_worker(self, waiting: int = 1) -> Optional[WorkerProc]:
@@ -482,10 +539,19 @@ class Nodelet:
         # Spawn by demand, not per poll: at most ``waiting`` workers may be
         # concurrently starting, else a burst of lease retries forks an
         # import storm that starves the very workers it is waiting on.
-        starting = sum(1 for w in self.workers.values() if w.state == "starting")
-        alive = sum(1 for w in self.workers.values() if w.state != "dead")
-        if starting < waiting and alive < GlobalConfig.worker_pool_max_size:
-            self._spawn_worker()
+        # Actor-dedicated workers never come back, so they live under their
+        # own (large) cap — else the 16-worker pool cap deadlocks the 17th
+        # actor forever.
+        starting = self._spawns_inflight + sum(
+            1 for w in self.workers.values() if w.state == "starting")
+        actor_workers = sum(1 for w in self.workers.values()
+                            if w.state == "actor")
+        pool = self._spawns_inflight + sum(
+            1 for w in self.workers.values()
+            if w.state not in ("dead", "actor"))
+        if starting < waiting and pool < GlobalConfig.worker_pool_max_size \
+                and actor_workers < GlobalConfig.actor_workers_max:
+            await self._spawn_worker()
         return None
 
     async def _notify_lease_waiters(self):
@@ -571,16 +637,26 @@ class Nodelet:
         deadline = time.monotonic() + \
             GlobalConfig.actor_worker_startup_timeout_s
         worker = None
-        while worker is None:
-            worker = await self._pop_idle_worker()
-            if worker is None:
-                if time.monotonic() > deadline:
-                    return {"ok": False, "retry": True, "error": "no worker available"}
-                async with self._lease_cv:
-                    try:
-                        await asyncio.wait_for(self._lease_cv.wait(), timeout=0.2)
-                    except asyncio.TimeoutError:
-                        pass
+        self._pending_actor_starts += 1
+        try:
+            while worker is None:
+                # a burst of actor creations may fork several workers at
+                # once (capped) instead of strictly one at a time
+                worker = await self._pop_idle_worker(
+                    waiting=min(self._pending_actor_starts,
+                                GlobalConfig.actor_spawn_parallelism))
+                if worker is None:
+                    if time.monotonic() > deadline:
+                        return {"ok": False, "retry": True,
+                                "error": "no worker available"}
+                    async with self._lease_cv:
+                        try:
+                            await asyncio.wait_for(self._lease_cv.wait(),
+                                                   timeout=0.2)
+                        except asyncio.TimeoutError:
+                            pass
+        finally:
+            self._pending_actor_starts -= 1
         self.available.acquire(request)
         worker.state = "actor"
         worker.actor_id = spec.actor_creation_id.binary()
@@ -919,13 +995,27 @@ class Nodelet:
         """Workers report task start/finish here (direct driver→worker
         pushes bypass the nodelet, so this notify is how the per-node task
         table — the reference's `ray list tasks` source — gets filled)."""
+        self._apply_task_state(data["worker_id"], data)
+        return True
+
+    async def _h_task_state_batch(self, conn, data):
+        """Batched form: workers coalesce start/finish events on a short
+        timer so the observability path costs one RPC per flush, not two
+        per task (noop tasks are cheaper than their own bookkeeping
+        otherwise)."""
         wid = data["worker_id"]
+        for event in data["events"]:
+            self._apply_task_state(wid, event)
+        return True
+
+    def _apply_task_state(self, wid: bytes, data: dict) -> None:
+        t = data.get("t") or time.time()
         if data["event"] == "start":
             self._running_tasks[wid] = {
                 "name": data.get("name", "?"),
                 "task_id": data.get("task_id", b"").hex()
                 if data.get("task_id") else "",
-                "start": time.time()}
+                "start": t}
         else:
             run = self._running_tasks.pop(wid, None)
             name = data.get("name", "?")
@@ -937,8 +1027,7 @@ class Nodelet:
                 self._task_spans.append({
                     "name": name, "worker_id": wid.hex(),
                     "task_id": run.get("task_id", ""),
-                    "start": run["start"], "end": time.time()})
-        return True
+                    "start": run["start"], "end": t})
 
     async def _h_task_spans(self, conn, data):
         spans = list(self._task_spans)

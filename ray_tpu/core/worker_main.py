@@ -4,6 +4,37 @@ import argparse
 import asyncio
 
 
+def run_worker(args: dict) -> None:
+    """Start the worker runtime and serve until shutdown.
+
+    ``args`` keys: nodelet, controller, store, node_id, worker_id (hex),
+    session_dir.  Shared by the exec path (`main`) and the fork-server
+    path (`worker_zygote._run_child`).
+    """
+    import json
+    import os
+    os.environ["RAY_TPU_WORKER_CONTEXT"] = json.dumps({
+        "controller": args["controller"], "nodelet": args["nodelet"],
+        "store": args["store"], "node_id": args["node_id"],
+        "session_dir": args["session_dir"]})
+
+    from .worker_runtime import WorkerRuntime
+
+    async def run():
+        rt = WorkerRuntime(
+            nodelet_addr=args["nodelet"],
+            controller_addr=args["controller"],
+            store_path=args["store"],
+            node_id=args["node_id"],
+            worker_id=bytes.fromhex(args["worker_id"]),
+            session_dir=args["session_dir"],
+        )
+        await rt.start()
+        await rt.run_forever()
+
+    asyncio.run(run())
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--nodelet", required=True)
@@ -22,28 +53,10 @@ def main():
     import signal
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
-    import json
-    import os
-    os.environ["RAY_TPU_WORKER_CONTEXT"] = json.dumps({
-        "controller": args.controller, "nodelet": args.nodelet,
-        "store": args.store, "node_id": args.node_id,
-        "session_dir": args.session_dir})
-
-    from .worker_runtime import WorkerRuntime
-
-    async def run():
-        rt = WorkerRuntime(
-            nodelet_addr=args.nodelet,
-            controller_addr=args.controller,
-            store_path=args.store,
-            node_id=args.node_id,
-            worker_id=bytes.fromhex(args.worker_id),
-            session_dir=args.session_dir,
-        )
-        await rt.start()
-        await rt.run_forever()
-
-    asyncio.run(run())
+    run_worker({"nodelet": args.nodelet, "controller": args.controller,
+                "store": args.store, "node_id": args.node_id,
+                "worker_id": args.worker_id,
+                "session_dir": args.session_dir})
 
 
 if __name__ == "__main__":

@@ -23,6 +23,7 @@ import asyncio
 import concurrent.futures
 import os
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional
 
@@ -94,6 +95,11 @@ class WorkerRuntime:
         self._running_aio: Dict[bytes, Any] = {}       # task_id -> aio task
         self._inflight: set = set()            # pushed, not yet replied
         self._cancel_requested: set = set()    # cancel seen pre-user-code
+        # task start/finish observability events batch up and flush on a
+        # short timer — two notify RPCs per task would otherwise cost more
+        # than a noop task itself on the control-plane hot path
+        self._ts_buf: List[Dict[str, Any]] = []
+        self._ts_flush = asyncio.Event()
         global _runtime_singleton
         _runtime_singleton = self
 
@@ -115,7 +121,31 @@ class WorkerRuntime:
             "pid": os.getpid()})
         GlobalConfig.load_snapshot(reply.get("config", {}))
         self.nodelet.on_close = lambda conn: os._exit(1)  # nodelet died -> die
+        asyncio.ensure_future(self._task_state_flusher())
         return self
+
+    # ------------------------------------------------- task-state batching
+    def _report_task_state(self, event: Dict[str, Any]) -> None:
+        self._ts_buf.append(event)
+        self._ts_flush.set()
+
+    async def _task_state_flusher(self):
+        """Event-driven: an IDLE worker parks here with ZERO timer wakeups
+        (a thousand idle actors polling every 50 ms would saturate a small
+        host by themselves); a busy worker flushes at most every 50 ms."""
+        while not self._dying:
+            await self._ts_flush.wait()
+            await asyncio.sleep(0.05)   # coalesce a burst into one notify
+            self._ts_flush.clear()
+            if not self._ts_buf:
+                continue
+            buf, self._ts_buf = self._ts_buf, []
+            try:
+                await self.nodelet.notify(
+                    "task_state_batch",
+                    {"worker_id": self.worker_id, "events": buf})
+            except Exception:
+                pass  # observability only; never kill the worker for it
 
     async def run_forever(self):
         await self._shutdown.wait()
@@ -391,15 +421,16 @@ class WorkerRuntime:
         # table the reference's core worker reports to the GCS
         # (task_manager / state API `ray list tasks`); pushes go direct
         # driver→worker, so the nodelet can't see them itself.
-        await self.nodelet.notify("task_state", {
-            "worker_id": self.worker_id, "event": "start",
-            "name": spec.function_name, "task_id": spec.task_id.binary()})
+        self._report_task_state({"event": "start",
+                                 "name": spec.function_name,
+                                 "task_id": spec.task_id.binary(),
+                                 "t": time.time()})
         try:
             return await self._execute(spec, fn)
         finally:
-            await self.nodelet.notify("task_state", {
-                "worker_id": self.worker_id, "event": "finish",
-                "name": spec.function_name})
+            self._report_task_state({"event": "finish",
+                                     "name": spec.function_name,
+                                     "t": time.time()})
 
     async def _h_create_actor(self, conn, data):
         spec = TaskSpec.from_wire(data["spec"])
@@ -472,18 +503,18 @@ class WorkerRuntime:
                 await ev.wait()
                 state["waiters"].pop(seq, None)
         try:
-            await self.nodelet.notify("task_state", {
-                "worker_id": self.worker_id, "event": "start",
+            self._report_task_state({
+                "event": "start",
                 "name": f"{type(self.actor_instance).__name__}."
                         f"{spec.function_name}",
-                "task_id": spec.task_id.binary()})
+                "task_id": spec.task_id.binary(), "t": time.time()})
             try:
                 return await self._execute(spec, method)
             finally:
-                await self.nodelet.notify("task_state", {
-                    "worker_id": self.worker_id, "event": "finish",
+                self._report_task_state({
+                    "event": "finish",
                     "name": f"{type(self.actor_instance).__name__}."
-                            f"{spec.function_name}"})
+                            f"{spec.function_name}", "t": time.time()})
         finally:
             if state["next"] <= seq:
                 state["next"] = seq + 1
@@ -502,6 +533,17 @@ class WorkerRuntime:
 
     async def _h_exit(self, conn, data):
         self._dying = True
+        # Drain any batched task-state events (a finish sitting in the
+        # 50 ms coalesce window would otherwise leave a stale "running"
+        # row in the nodelet for the life of the cluster).
+        if self._ts_buf:
+            buf, self._ts_buf = self._ts_buf, []
+            try:
+                await self.nodelet.notify(
+                    "task_state_batch",
+                    {"worker_id": self.worker_id, "events": buf})
+            except Exception:
+                pass
         if self.actor_instance is not None and self.actor_id is not None:
             try:
                 await self.controller.call("report_actor_death", {

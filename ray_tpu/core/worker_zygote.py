@@ -1,0 +1,278 @@
+"""Worker fork-server: pre-warmed process that forks workers in ~10ms.
+
+The reference amortizes worker startup with prestarted idle workers
+(worker_pool.cc); that still pays the full interpreter+import tax
+(~250 ms here) per worker, which caps actor-creation bursts at ~4/s on a
+small host.  This fork-server pays the import tax ONCE: the nodelet
+spawns one zygote at boot, the zygote imports the whole worker runtime,
+and every subsequent worker is an `os.fork()` away.
+
+Protocol (line-delimited JSON over a unix socket, nodelet is the only
+client):
+  nodelet -> zygote : {"cmd": "spawn", "seq": n, "log_path": p,
+                       "env": {...}, "args": {worker_main kwargs}}
+  zygote  -> nodelet: {"spawned": pid, "seq": n}
+  zygote  -> nodelet: {"exit": pid, "rc": code}      (async, on reap)
+
+The zygote is strictly single-threaded and never creates an event loop,
+so forking is safe; each child builds a fresh loop via `asyncio.run`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+
+class ForkedProc:
+    """`subprocess.Popen`-compatible shim for a zygote-forked worker.
+
+    The zygote pushes exit notifications, so ``poll()`` is a dict lookup
+    — cheap enough for the nodelet's 0.2 s reap sweep over thousands of
+    workers."""
+
+    def __init__(self, pid: int, client: "ZygoteClient"):
+        self.pid = pid
+        self._client = client
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is None:
+            # pop, not get: consuming the record keeps `exits` bounded and
+            # stops a kernel-recycled PID from matching a stale entry
+            self.returncode = self._client.exits.pop(self.pid, None)
+            if self.returncode is None and self._client.dead:
+                # zygote gone: no more exit pushes; probe liveness directly
+                try:
+                    os.kill(self.pid, 0)
+                except ProcessLookupError:
+                    self.returncode = -1
+        return self.returncode
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("zygote-worker", timeout)
+            time.sleep(0.05)
+        return self.returncode
+
+
+class ZygoteClient:
+    """Nodelet-side handle: launches the zygote, spawns workers over it."""
+
+    def __init__(self):
+        self.proc: Optional[subprocess.Popen] = None
+        self.exits: Dict[int, int] = {}
+        self.dead = False
+        self._seq = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._wlock = asyncio.Lock()
+        self._sock_path = ""
+
+    @classmethod
+    async def create(cls, session_dir: str,
+                     ready_timeout: float = 60.0) -> "ZygoteClient":
+        self = cls()
+        self._sock_path = os.path.join(
+            session_dir, f"zygote-{os.getpid()}-{time.monotonic_ns()}.sock")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_zygote",
+             "--socket", self._sock_path],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        loop = asyncio.get_event_loop()
+        try:
+            # ZYGOTE_READY on stdout gates the unix connect (imports warm)
+            line = await asyncio.wait_for(
+                loop.run_in_executor(None, self.proc.stdout.readline),
+                timeout=ready_timeout)
+            if b"ZYGOTE_READY" not in line:
+                raise RuntimeError(f"zygote failed to start: {line!r}")
+            reader, self._writer = await asyncio.open_unix_connection(
+                self._sock_path)
+        except BaseException:
+            self.stop()  # don't orphan a half-started zygote interpreter
+            raise
+        asyncio.ensure_future(self._read_loop(reader))
+        return self
+
+    async def _read_loop(self, reader: asyncio.StreamReader):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                msg = json.loads(line)
+                if "spawned" in msg:
+                    # any exit record under this PID is from a previous
+                    # incarnation (kernel recycled it) — purge HERE, in
+                    # stream order, before the new incarnation's own exit
+                    # can possibly arrive
+                    self.exits.pop(msg["spawned"], None)
+                    fut = self._pending.pop(msg["seq"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg["spawned"])
+                elif "exit" in msg:
+                    self.exits[msg["exit"]] = msg["rc"]
+        except Exception:
+            pass
+        finally:
+            self.dead = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(RuntimeError("zygote died"))
+            self._pending.clear()
+
+    async def spawn(self, args: dict, log_path: str,
+                    env: Dict[str, str]) -> int:
+        if self.dead:
+            raise RuntimeError("zygote is dead")
+        self._seq += 1
+        seq = self._seq
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[seq] = fut
+        payload = json.dumps({"cmd": "spawn", "seq": seq, "args": args,
+                              "log_path": log_path,
+                              "env": env}).encode() + b"\n"
+        async with self._wlock:
+            self._writer.write(payload)
+            await self._writer.drain()
+        return await asyncio.wait_for(fut, timeout=30.0)
+
+    def stop(self) -> None:
+        self.dead = True
+        try:
+            if self.proc is not None:
+                self.proc.kill()
+        except Exception:
+            pass
+        try:
+            os.unlink(self._sock_path)
+        except OSError:
+            pass
+
+
+def _run_child(req: dict) -> None:
+    """Post-fork setup + worker main loop.  Never returns."""
+    try:
+        os.setsid()
+        fd = os.open(req["log_path"],
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        os.dup2(fd, 1)
+        os.dup2(fd, 2)
+        os.close(fd)
+        os.environ.update(req.get("env") or {})
+
+        import faulthandler
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+        from .worker_main import run_worker
+        run_worker(req["args"])
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+    finally:
+        # skip inherited atexit/cleanup state — this process was forked
+        os._exit(0)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--socket", required=True)
+    args = p.parse_args()
+
+    # Pay the import tax once, before any fork.  Everything a worker
+    # needs at startup is warmed here; jax itself stays lazy (workers
+    # import it on first use, post-fork).
+    from . import (rpc, serialization, task_spec,  # noqa: F401
+                   worker_runtime)
+    from .object_store import client as store_client  # noqa: F401
+
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        os.unlink(args.socket)
+    except OSError:
+        pass
+    listener.bind(args.socket)
+    listener.listen(1)
+    print("ZYGOTE_READY", flush=True)
+    conn, _ = listener.accept()
+    conn.settimeout(0.1)
+
+    def send(obj: dict) -> None:
+        # The 0.1 s timeout exists for the recv poll; a timed-out sendall
+        # would leave a PARTIAL line on the wire and corrupt the framing,
+        # so sends run blocking (lines are tiny; the nodelet always reads).
+        try:
+            conn.settimeout(None)
+            conn.sendall(json.dumps(obj).encode() + b"\n")
+        except OSError:
+            pass
+        finally:
+            conn.settimeout(0.1)
+
+    buf = b""
+    children: set = set()
+    while True:
+        # reap exited children and push their exit codes
+        while children:
+            try:
+                pid, status = os.waitpid(-1, os.WNOHANG)
+            except ChildProcessError:
+                break
+            if pid == 0:
+                break
+            children.discard(pid)
+            rc = os.waitstatus_to_exitcode(status)
+            send({"exit": pid, "rc": rc})
+
+        try:
+            data = conn.recv(1 << 16)
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        if not data:
+            break  # nodelet died; workers notice via their own conns
+        buf += data
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if not line.strip():
+                continue
+            req = json.loads(line)
+            if req.get("cmd") == "spawn":
+                pid = os.fork()
+                if pid == 0:
+                    conn.close()
+                    listener.close()
+                    _run_child(req)  # never returns
+                children.add(pid)
+                send({"spawned": pid, "seq": req["seq"]})
+            elif req.get("cmd") == "exit":
+                sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Control-plane scale test (reference model: release/benchmarks/README.md
+many-tasks / many-actors / many-PGs rows, scaled to one host).
+
+Rates land in README.md §perf; the assertions here are floors loose
+enough to pass on a loaded single-core CI box while still proving the
+three scale dimensions: a 50k-task burst, a 1k-actor population, and a
+100-PG create/remove cycle on a multi-nodelet cluster.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("RAY_TPU_SKIP_SCALE") == "1",
+    reason="scale tests disabled")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster()
+    for _ in range(2):
+        c.add_node(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_many_tasks_50k(cluster):
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get([noop.remote() for _ in range(500)], timeout=120)  # warm
+    N = 50_000
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(N)]
+    ray_tpu.get(refs, timeout=600.0)
+    dt = time.perf_counter() - t0
+    rate = N / dt
+    print(f"\n[scale] {N} noop tasks in {dt:.1f}s -> {rate:.0f} tasks/s")
+    # loose floor: CI detection of collapse, not a perf bar — the box is
+    # one shared core running 20 cluster processes (see README for rates)
+    assert rate > 400, f"noop task throughput collapsed: {rate:.0f}/s"
+
+
+def test_many_actors_1k(cluster):
+    @ray_tpu.remote
+    class Member:
+        def ping(self):
+            return 1
+
+    N = 1_000
+    t0 = time.perf_counter()
+    actors = [Member.remote() for _ in range(N)]
+    # every actor answers: fully created, not just enqueued
+    assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                           timeout=600.0)) == N
+    dt = time.perf_counter() - t0
+    rate = N / dt
+    print(f"\n[scale] {N} actors created+pinged in {dt:.1f}s "
+          f"-> {rate:.1f} actors/s")
+    for a in actors:
+        ray_tpu.kill(a)
+    assert rate > 5, f"actor creation collapsed: {rate:.1f}/s"
+
+
+def test_many_placement_groups_100(cluster):
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    N = 100
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(N)]
+    for pg in pgs:
+        pg.wait(timeout_seconds=120)
+    created = time.perf_counter() - t0
+    for pg in pgs:
+        remove_placement_group(pg)
+    dt = time.perf_counter() - t0
+    print(f"\n[scale] {N} PGs created in {created:.1f}s, "
+          f"create+remove {dt:.1f}s -> {N / dt:.0f} PGs/s")
+    assert created < 120
