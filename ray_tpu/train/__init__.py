@@ -13,6 +13,7 @@ from .backend import Backend, BackendConfig, SpmdConfig, HostArrayConfig  # noqa
 from .backend_executor import BackendExecutor  # noqa: F401
 from .checkpointing import CheckpointManager  # noqa: F401
 from .hf import TransformersTrainer  # noqa: F401
+from .gbdt import GBDTModel, LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from .sklearn import GBDTTrainer, SklearnTrainer  # noqa: F401
 from .trainer import JaxTrainer, TorchCompatTrainer  # noqa: F401
 from .worker_group import WorkerGroup  # noqa: F401

@@ -115,15 +115,10 @@ class SklearnTrainer:
         return cloudpickle.loads(checkpoint.to_dict()["estimator"])
 
 
-class GBDTTrainer(SklearnTrainer):
-    """Gradient-boosted trees (reference: `train/gbdt_trainer.py`
-    xgboost/lightgbm backends).  Gated: neither library ships in this
-    image, so construction points at the sklearn HistGradientBoosting
-    equivalents instead of failing at fit time."""
-
-    def __init__(self, *args, **kwargs):
-        raise ImportError(
-            "xgboost/lightgbm are not available in this image; use "
-            "SklearnTrainer with sklearn.ensemble."
-            "HistGradientBoostingClassifier/Regressor (same algorithm "
-            "family) instead")
+def GBDTTrainer(*args, **kwargs):
+    """Back-compat name for the distributed booster (reference:
+    `train/gbdt_trainer.py`) — the real implementation lives in
+    `train/gbdt.py` as XGBoostTrainer (native histogram GBDT over worker
+    actors; xgboost itself is not in this image)."""
+    from .gbdt import XGBoostTrainer
+    return XGBoostTrainer(*args, **kwargs)
