@@ -20,7 +20,7 @@ import asyncio
 import time
 from typing import Any, Dict, List, Optional, Set
 
-from . import rpc, spill
+from . import rpc, runtime_metrics as rtm, spill
 from .config import GlobalConfig
 from .scheduling import NodeView, hybrid_policy, pack_bundles
 from .task_spec import ResourceSet, TaskSpec
@@ -203,8 +203,16 @@ class Controller:
                      "report_event", "list_events",
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
-                     "drain_node", "ping"):
+                     "drain_node", "ping", "metrics_text"):
             s.register(name, getattr(self, "_h_" + name))
+
+    async def _h_metrics_text(self, conn, data):
+        """Prometheus exposition of controller runtime metrics
+        (reference: GCS stats export, metric_defs.cc); gauges refresh at
+        scrape time."""
+        from .. import metrics
+        rtm.snapshot_controller(self)
+        return metrics.prometheus_text()
 
     async def start(self):
         await self.server.start()
@@ -240,6 +248,7 @@ class Controller:
         src/ray/pubsub/publisher.h + README — one wire message per
         subscriber per flush instead of per event; matters for the
         high-rate ``logs`` channel)."""
+        rtm.PUBSUB_MESSAGES.inc(tags={"channel": channel})
         for conn in list(self.subscribers.get(channel, ())):
             if conn.closed:
                 self.subscribers[channel].discard(conn)
@@ -396,6 +405,7 @@ class Controller:
 
     # ------------------------------------------------------------------ actors
     async def _h_register_actor(self, conn, data):
+        rtm.ACTORS_CREATED.inc()
         spec = data["spec"]
         actor_id = spec["actor_new"]
         name = data.get("name") or None
@@ -551,6 +561,7 @@ class Controller:
         actor.node_id = None
         if not intended and actor.num_restarts < actor.max_restarts:
             actor.num_restarts += 1
+            rtm.ACTORS_RESTARTED.inc()
             actor.state = RESTARTING
             self._pending_actor_wakeup.set()
         else:

@@ -23,7 +23,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Set
 
-from . import rpc, worker_zygote, spill
+from . import rpc, runtime_metrics as rtm, spill, worker_zygote
 from .config import GlobalConfig
 from .ids import NodeID, WorkerID
 from .object_store import client as store_client
@@ -103,6 +103,8 @@ class Nodelet:
         # Spawns parked in `await zygote.spawn()` are not yet in
         # self.workers; count them or a burst blows past the pool caps.
         self._spawns_inflight = 0
+        # short node tag for the runtime self-metrics battery
+        self._mnode = {"node": self.node_id.hex()[:12]}
         self.zygote: Optional[worker_zygote.ZygoteClient] = None
         self._stopping = False
         self._register_handlers()
@@ -115,7 +117,8 @@ class Nodelet:
                      "pg_commit", "pg_abort", "pg_return", "kill_worker_at",
                      "node_info", "stats", "put_location", "ping",
                      "task_state", "task_state_batch", "node_stats",
-                     "tail_log", "task_spans", "prestart_workers"):
+                     "tail_log", "task_spans", "prestart_workers",
+                     "metrics_text"):
             s.register(name, getattr(self, "_h_" + name))
 
     @property
@@ -245,6 +248,7 @@ class Nodelet:
             try:
                 if self.controller is None or self.controller.closed:
                     await self._connect_controller()
+                rtm.HEARTBEATS.inc(tags=self._mnode)
                 reply = await self.controller.call("heartbeat", {
                     "node_id": self.node_id.hex(),
                     "available": self.available.to_dict(),
@@ -271,6 +275,7 @@ class Nodelet:
         prev_state = w.state
         w.state = "dead"
         self.workers.pop(w.worker_id, None)
+        rtm.WORKERS_DIED.inc(tags=self._mnode)
         # The worker's batched finish event may have died in its buffer;
         # the process is gone, so its "running" entry is stale by
         # definition — close it out as interrupted.
@@ -370,6 +375,7 @@ class Nodelet:
                       f"rss={self._worker_rss_kb(victim.proc.pid)}kB)",
                       file=sys.stderr, flush=True)
                 self._oom_kills = getattr(self, "_oom_kills", 0) + 1
+                rtm.OOM_KILLS.inc(tags=self._mnode)
                 victim.proc.kill()
                 try:
                     await self.controller.notify("report_event", {
@@ -430,7 +436,10 @@ class Nodelet:
         try:
             if len(view) < GlobalConfig.spill_min_object_bytes:
                 return False
+            nbytes = len(view)
             url = await asyncio.to_thread(spill.write_object, oid, [view])
+            rtm.OBJECTS_SPILLED.inc(tags=self._mnode)
+            rtm.BYTES_SPILLED.inc(nbytes, tags=self._mnode)
         finally:
             del view
             self.store.release(oid)
@@ -490,6 +499,8 @@ class Nodelet:
                      "session_dir": self.session_dir},
                     log_path, env)
                 proc = worker_zygote.ForkedProc(pid, self.zygote)
+                rtm.WORKERS_SPAWNED.inc(
+                    tags={**self._mnode, "mode": "fork"})
             except Exception:
                 proc = None  # zygote sick: exec below, heal at next boot
             finally:
@@ -509,6 +520,7 @@ class Nodelet:
                 stdout=logf, stderr=subprocess.STDOUT, env=full_env,
                 start_new_session=True)
             logf.close()
+            rtm.WORKERS_SPAWNED.inc(tags={**self._mnode, "mode": "exec"})
         w = WorkerProc(worker_id, proc)
         self.workers[worker_id] = w
         return w
@@ -588,11 +600,13 @@ class Nodelet:
                 strategy=strategy)
             if target is not None and target != my_id:
                 nv = self.view.get(target)
+                rtm.LEASES_SPILLBACK.inc(tags=self._mnode)
                 return {"spillback": nv.addr, "node_id": target}
             if target is None and not self.total.fits(request):
                 # Infeasible everywhere we know of; wait for cluster growth.
                 if time.monotonic() > deadline:
                     totals = {n.node_id[:8]: n.total.res for n in self.view.values()}
+                    rtm.LEASES_INFEASIBLE.inc(tags=self._mnode)
                     return {"error": f"infeasible resource request {request.res} "
                                      f"(cluster node totals: {totals})",
                             "infeasible": True}
@@ -605,6 +619,7 @@ class Nodelet:
                     worker.lease_id = lease_id
                     self.leases[lease_id] = Lease(lease_id, worker, request)
                     self._refresh_self_view()
+                    rtm.LEASES_GRANTED.inc(tags=self._mnode)
                     return {"granted": True, "lease_id": lease_id,
                             "worker_id": worker.worker_id,
                             "worker_addr": worker.address}
@@ -895,6 +910,9 @@ class Nodelet:
                     ok = await asyncio.get_event_loop().run_in_executor(
                         None, self.store.fetch, host, tport, oid)
                     if ok:
+                        rtm.OBJECTS_PULLED.inc(tags=self._mnode)
+                        rtm.BYTES_PULLED.inc(meta["size"],
+                                             tags=self._mnode)
                         return True
                 except store_client.StoreError:
                     pass  # fall back to the chunked RPC path
@@ -931,6 +949,8 @@ class Nodelet:
                 raise
             del dest
             self.store.seal(oid)
+            rtm.OBJECTS_PULLED.inc(tags=self._mnode)
+            rtm.BYTES_PULLED.inc(size, tags=self._mnode)
             return True
         except (rpc.RpcError, OSError):
             return False
@@ -1020,6 +1040,7 @@ class Nodelet:
             run = self._running_tasks.pop(wid, None)
             name = data.get("name", "?")
             self._task_counts[name] = self._task_counts.get(name, 0) + 1
+            rtm.TASKS_FINISHED.inc(tags=self._mnode)
             # bounded span log for the cluster timeline (reference: per-task
             # profile events -> GCS -> ray.timeline chrome dump,
             # core_worker/profiling.cc + _private/state.py:414)
@@ -1034,6 +1055,14 @@ class Nodelet:
         if data.get("clear"):
             self._task_spans.clear()
         return spans
+
+    async def _h_metrics_text(self, conn, data):
+        """Prometheus exposition of this nodelet's runtime metrics
+        (reference: per-component stats exporters, metric_defs.cc).
+        Gauges refresh at scrape time, so idle nodes pay nothing."""
+        from .. import metrics
+        rtm.snapshot_nodelet(self)
+        return metrics.prometheus_text()
 
     async def _h_node_stats(self, conn, data):
         """Per-node deep stats (reference: dashboard/agent.py reporter +

@@ -1,0 +1,159 @@
+"""Runtime self-metrics: the framework instruments itself.
+
+Capability mirror of the reference's predefined metrics battery
+(`src/ray/stats/metric_defs.cc:1` — ~90 scheduler/object-store/transport
+gauges and counters every component exports).  Definitions live here in
+one place; components bump the counters directly at natural sites
+(spawn, death, lease grant, spill, ...) and `snapshot_<component>()`
+refreshes the gauges from live state at scrape time.  Exposition rides
+the existing Prometheus path (`ray_tpu/metrics.py`): the nodelet and
+controller answer a `metrics_text` RPC with their process registries,
+and `state.cluster_metrics_text()` / the dashboard's /metrics/cluster
+serve the cluster-wide union.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .. import metrics as m
+
+# ---------------------------------------------------------------- counters
+
+TASKS_FINISHED = m.Counter(
+    "ray_tpu_tasks_finished_total",
+    "Tasks finished on this node", ("node",))
+WORKERS_SPAWNED = m.Counter(
+    "ray_tpu_workers_spawned_total",
+    "Worker processes spawned", ("node", "mode"))   # mode: fork | exec
+WORKERS_DIED = m.Counter(
+    "ray_tpu_workers_died_total",
+    "Worker processes that exited", ("node",))
+OOM_KILLS = m.Counter(
+    "ray_tpu_oom_kills_total",
+    "Workers killed by the memory monitor", ("node",))
+LEASES_GRANTED = m.Counter(
+    "ray_tpu_scheduler_leases_granted_total",
+    "Worker leases granted", ("node",))
+LEASES_SPILLBACK = m.Counter(
+    "ray_tpu_scheduler_spillbacks_total",
+    "Lease requests redirected to a peer node", ("node",))
+LEASES_INFEASIBLE = m.Counter(
+    "ray_tpu_scheduler_infeasible_total",
+    "Lease requests infeasible cluster-wide", ("node",))
+OBJECTS_SPILLED = m.Counter(
+    "ray_tpu_objects_spilled_total",
+    "Objects spilled to external storage", ("node",))
+BYTES_SPILLED = m.Counter(
+    "ray_tpu_objects_spilled_bytes_total",
+    "Bytes spilled to external storage", ("node",))
+OBJECTS_RESTORED = m.Counter(
+    "ray_tpu_objects_restored_total",
+    "Spilled objects restored (driver-process restores only: worker "
+    "registries are not scraped)", ("node",))
+OBJECTS_PULLED = m.Counter(
+    "ray_tpu_objects_pulled_total",
+    "Objects pulled from peer nodes", ("node",))
+BYTES_PULLED = m.Counter(
+    "ray_tpu_objects_pulled_bytes_total",
+    "Bytes pulled from peer nodes", ("node",))
+HEARTBEATS = m.Counter(
+    "ray_tpu_heartbeats_total",
+    "Heartbeats sent to the controller", ("node",))
+ACTORS_CREATED = m.Counter(
+    "ray_tpu_actors_created_total",
+    "Actor creations processed by the controller", ())
+ACTORS_RESTARTED = m.Counter(
+    "ray_tpu_actors_restarted_total",
+    "Actor restarts orchestrated by the controller", ())
+PUBSUB_MESSAGES = m.Counter(
+    "ray_tpu_pubsub_messages_total",
+    "Messages published on controller channels", ("channel",))
+
+# ------------------------------------------------------------------ gauges
+
+WORKER_POOL = m.Gauge(
+    "ray_tpu_worker_pool_size",
+    "Workers by state", ("node", "state"))
+LEASE_WAITERS = m.Gauge(
+    "ray_tpu_scheduler_lease_waiters",
+    "Lease requests currently waiting", ("node",))
+RUNNING_TASKS = m.Gauge(
+    "ray_tpu_running_tasks",
+    "Tasks executing right now", ("node",))
+STORE_BYTES_USED = m.Gauge(
+    "ray_tpu_object_store_bytes_used",
+    "Object store bytes in use", ("node",))
+STORE_CAPACITY = m.Gauge(
+    "ray_tpu_object_store_capacity_bytes",
+    "Object store capacity", ("node",))
+STORE_OBJECTS = m.Gauge(
+    "ray_tpu_object_store_objects",
+    "Objects resident in the store", ("node",))
+PRIMARY_PINS = m.Gauge(
+    "ray_tpu_object_store_primary_pins",
+    "Primary copies pinned against eviction", ("node",))
+PG_RESERVED = m.Gauge(
+    "ray_tpu_placement_group_bundles_reserved",
+    "PG bundles holding resources on this node", ("node", "phase"))
+VIEW_VERSION = m.Gauge(
+    "ray_tpu_cluster_view_version",
+    "Version of the resource view this node has applied", ("node",))
+LOOP_LAG = m.Gauge(
+    "ray_tpu_event_loop_lag_seconds",
+    "EWMA of event-loop wakeup lag", ("node",))
+NODES_ALIVE = m.Gauge(
+    "ray_tpu_nodes_alive", "Nodes the controller sees alive", ())
+ACTORS_BY_STATE = m.Gauge(
+    "ray_tpu_actors", "Actors by lifecycle state", ("state",))
+KV_KEYS = m.Gauge(
+    "ray_tpu_internal_kv_keys", "Keys in the controller KV", ())
+OBJECT_DIRECTORY = m.Gauge(
+    "ray_tpu_object_directory_entries",
+    "Objects tracked in the controller directory", ())
+
+
+# ------------------------------------------------------------- snapshots
+
+
+def snapshot_nodelet(nl: Any) -> None:
+    """Refresh nodelet gauges from live state (heartbeat cadence)."""
+    nid = nl.node_id.hex()[:12]
+    states = {"idle": 0, "leased": 0, "actor": 0, "starting": 0}
+    for w in nl.workers.values():
+        if w.state in states:
+            states[w.state] += 1
+    for st, count in states.items():
+        WORKER_POOL.set(count, {"node": nid, "state": st})
+    LEASE_WAITERS.set(nl._lease_waiters, {"node": nid})
+    RUNNING_TASKS.set(len(nl._running_tasks), {"node": nid})
+    VIEW_VERSION.set(nl.view_version, {"node": nid})
+    PG_RESERVED.set(len(nl.pg_prepared), {"node": nid, "phase": "prepared"})
+    PG_RESERVED.set(len(nl.pg_committed),
+                    {"node": nid, "phase": "committed"})
+    if nl.store is not None:
+        try:
+            info = nl.store.stats()
+            STORE_BYTES_USED.set(info.get("used_bytes", 0), {"node": nid})
+            STORE_CAPACITY.set(info.get("capacity_bytes", 0),
+                               {"node": nid})
+            STORE_OBJECTS.set(info.get("num_objects", 0), {"node": nid})
+        except Exception:
+            pass
+    PRIMARY_PINS.set(len(nl._primary_pins), {"node": nid})
+    LOOP_LAG.set(getattr(nl, "_lag_ewma", 0.0), {"node": nid})
+
+
+def snapshot_controller(ctl: Any) -> None:
+    """Refresh controller gauges from live state."""
+    alive = sum(1 for r in ctl.nodes.values()
+                if getattr(r.view, "alive", False))
+    NODES_ALIVE.set(alive)
+    by_state: dict = {}
+    for a in ctl.actors.values():
+        st = getattr(a, "state", "?")
+        by_state[st] = by_state.get(st, 0) + 1
+    for st, count in by_state.items():
+        ACTORS_BY_STATE.set(count, {"state": st})
+    KV_KEYS.set(sum(len(v) for v in ctl.kv.values()))
+    OBJECT_DIRECTORY.set(len(ctl.object_dir))

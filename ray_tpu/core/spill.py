@@ -43,7 +43,14 @@ def kv_entry(oid: bytes) -> dict:
 
 
 def read_file(url: str) -> Optional[bytes]:
-    return external_storage.get_storage().restore(url)
+    data = external_storage.get_storage().restore(url)
+    if data is not None:
+        import os
+
+        from . import runtime_metrics as rtm
+        rtm.OBJECTS_RESTORED.inc(tags={
+            "node": os.environ.get("RAY_TPU_NODE_ID", "driver")[:12]})
+    return data
 
 
 def delete_file(url: str) -> None:

@@ -1,0 +1,232 @@
+"""Declarative Serve config: YAML/dict schema -> running deployments.
+
+Capability mirror of the reference's Serve REST schema + declarative CLI
+(`python/ray/serve/schema.py:1` ServeApplicationSchema/ServeDeploySchema;
+`serve deploy` / `serve status` / `serve config` round trip).  A config
+names applications by ``import_path`` ("module:attribute" resolving to a
+``@serve.deployment`` object); per-deployment overrides layer on top of
+the code-declared options.  The submitted config is stored in the
+cluster KV so any process — the CLI, the dashboard — can read back what
+was deployed (the reference keeps it in the Serve controller).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+from typing import Any, Dict, List, Optional
+
+_KV_NS = "serve"
+_KV_CONFIG_KEY = b"deploy_config"
+
+
+class SchemaError(ValueError):
+    """A config that does not match the schema, with a field path."""
+
+
+def _require(cond: bool, path: str, msg: str) -> None:
+    if not cond:
+        raise SchemaError(f"{path}: {msg}")
+
+
+@dataclasses.dataclass
+class DeploymentOverride:
+    """Per-deployment overrides (reference: DeploymentSchema)."""
+
+    name: str
+    num_replicas: Optional[int] = None
+    max_concurrent_queries: Optional[int] = None
+    user_config: Any = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    gang_size: Optional[int] = None
+    gang_mesh: Optional[str] = None
+    gang_strategy: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], path: str) -> "DeploymentOverride":
+        _require(isinstance(d, dict), path, f"expected a mapping, got {d!r}")
+        _require("name" in d, path, "deployment entry needs a 'name'")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        _require(not unknown, path, f"unknown field(s) {sorted(unknown)}")
+        out = cls(**d)
+        if out.num_replicas is not None:
+            _require(int(out.num_replicas) >= 0, f"{path}.num_replicas",
+                     "must be >= 0")
+        if out.autoscaling_config is not None:
+            _require(isinstance(out.autoscaling_config, dict),
+                     f"{path}.autoscaling_config", "must be a mapping")
+        return out
+
+
+@dataclasses.dataclass
+class ApplicationConfig:
+    """One application (reference: ServeApplicationSchema)."""
+
+    import_path: str
+    name: Optional[str] = None
+    route_prefix: Optional[str] = "__derive__"
+    args: Optional[List[Any]] = None
+    kwargs: Optional[Dict[str, Any]] = None
+    deployments: List[DeploymentOverride] = dataclasses.field(
+        default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], path: str) -> "ApplicationConfig":
+        _require(isinstance(d, dict), path, f"expected a mapping, got {d!r}")
+        _require("import_path" in d, path, "application needs 'import_path'")
+        ip = d["import_path"]
+        _require(isinstance(ip, str) and ":" in ip, f"{path}.import_path",
+                 "must be 'module:attribute'")
+        deps = [DeploymentOverride.from_dict(x, f"{path}.deployments[{i}]")
+                for i, x in enumerate(d.get("deployments") or [])]
+        known = {"import_path", "name", "route_prefix", "args", "kwargs",
+                 "deployments"}
+        unknown = set(d) - known
+        _require(not unknown, path, f"unknown field(s) {sorted(unknown)}")
+        return cls(import_path=ip, name=d.get("name"),
+                   route_prefix=d.get("route_prefix", "__derive__"),
+                   args=d.get("args"), kwargs=d.get("kwargs"),
+                   deployments=deps)
+
+    def resolve_target(self):
+        """Import the deployment object this application names."""
+        mod_name, _, attr = self.import_path.partition(":")
+        mod = importlib.import_module(mod_name)
+        target = mod
+        for part in attr.split("."):
+            target = getattr(target, part)
+        return target
+
+
+@dataclasses.dataclass
+class DeployConfig:
+    """Top-level config (reference: ServeDeploySchema)."""
+
+    applications: List[ApplicationConfig]
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DeployConfig":
+        _require(isinstance(d, dict), "<root>",
+                 f"expected a mapping, got {d!r}")
+        if "applications" in d:
+            apps_raw = d["applications"]
+            _require(isinstance(apps_raw, list) and apps_raw,
+                     "applications", "must be a non-empty list")
+            apps = [ApplicationConfig.from_dict(a, f"applications[{i}]")
+                    for i, a in enumerate(apps_raw)]
+        else:
+            # single-application shorthand: import_path at the top level
+            apps = [ApplicationConfig.from_dict(d, "<root>")]
+        names = [a.name or a.import_path for a in apps]
+        _require(len(names) == len(set(names)), "applications",
+                 f"duplicate application names in {names}")
+        return cls(applications=apps)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"applications": [
+            {k: v for k, v in dataclasses.asdict(a).items()
+             if v not in (None, [], {})}
+            for a in self.applications]}
+
+
+def load_config(source: Any) -> DeployConfig:
+    """Accepts a dict, a YAML/JSON string, or a path to a YAML file."""
+    if isinstance(source, DeployConfig):
+        return source
+    if isinstance(source, dict):
+        return DeployConfig.from_dict(source)
+    if isinstance(source, str):
+        import os
+
+        import yaml
+        if os.path.exists(source):
+            with open(source) as f:
+                return DeployConfig.from_dict(yaml.safe_load(f))
+        return DeployConfig.from_dict(yaml.safe_load(source))
+    raise SchemaError(f"unsupported config source {type(source)}")
+
+
+def _apply_overrides(dep, override: DeploymentOverride):
+    kw: Dict[str, Any] = {}
+    if override.num_replicas is not None:
+        kw["num_replicas"] = override.num_replicas
+    if override.max_concurrent_queries is not None:
+        kw["max_concurrent_queries"] = override.max_concurrent_queries
+    if override.user_config is not None:
+        kw["user_config"] = override.user_config
+    if override.autoscaling_config is not None:
+        from .config import AutoscalingConfig
+        kw["autoscaling_config"] = AutoscalingConfig(
+            **override.autoscaling_config)
+    if override.ray_actor_options is not None:
+        kw["ray_actor_options"] = override.ray_actor_options
+    for g in ("gang_size", "gang_mesh", "gang_strategy"):
+        v = getattr(override, g)
+        if v is not None:
+            kw[g] = v
+    return dep.options(**kw) if kw else dep
+
+
+def apply_config(source: Any) -> Dict[str, Any]:
+    """Deploy every application in the config; returns {app: handle}.
+
+    Declarative semantics: applying a config REPLACES what it names
+    (redeploy restarts replicas with the new options) and records the
+    config in the cluster KV for `serve config` / `serve status`.
+    """
+    from . import api as serve_api
+    from .deployment import Deployment
+
+    cfg = load_config(source)
+    handles: Dict[str, Any] = {}
+    for app in cfg.applications:
+        target = app.resolve_target()
+        if not isinstance(target, Deployment):
+            raise SchemaError(
+                f"{app.import_path} resolved to {type(target).__name__}; "
+                "expected a @serve.deployment object")
+        if app.args or app.kwargs:
+            target = target.bind(*(app.args or ()),
+                                 **(app.kwargs or {}))
+        override = next((o for o in app.deployments
+                         if o.name in (target.name, app.name)), None)
+        if override is not None:
+            target = _apply_overrides(target, override)
+        name = app.name or target.name
+        handles[name] = serve_api.run(
+            target, name=name,
+            route_prefix=app.route_prefix
+            if app.route_prefix != "__derive__" else "__derive__")
+    from ..util import kv
+    kv.kv_put(_KV_CONFIG_KEY, json.dumps(cfg.to_dict()).encode(),
+              namespace=_KV_NS)
+    return handles
+
+
+def get_deployed_config() -> Optional[Dict[str, Any]]:
+    """The last config applied to this cluster (reference: serve config)."""
+    from ..util import kv
+    raw = kv.kv_get(_KV_CONFIG_KEY, namespace=_KV_NS)
+    return json.loads(raw) if raw else None
+
+
+def status() -> Dict[str, Any]:
+    """Application-rolled-up status (reference: serve status CLI)."""
+    from . import api as serve_api
+    table = serve_api.status_table()
+    deployed = get_deployed_config()
+    apps: Dict[str, Any] = {}
+    for name, info in table.items():
+        healthy = info.get("num_replicas", 0) >= 1 or \
+            info.get("config", {}).get("num_replicas", 1) == 0
+        apps[name] = {
+            "status": "RUNNING" if healthy else "DEPLOYING",
+            "deployment": info,
+        }
+    return {"applications": apps,
+            "config": deployed,
+            "proxies": serve_api.proxy_statuses()
+            if hasattr(serve_api, "proxy_statuses") else {}}

@@ -106,6 +106,42 @@ def _node_call(addr: str, method: str, data: Optional[dict] = None,
                 raise
 
 
+def cluster_metrics_text() -> str:
+    """Prometheus exposition aggregated cluster-wide: this process's
+    registry + the controller's + every alive nodelet's (reference: the
+    ~90-metric runtime battery of metric_defs.cc, exported per
+    component; here one scrape endpoint serves the union)."""
+    from . import metrics
+    parts = [metrics.prometheus_text()]
+    core = _ensure_initialized()
+    try:
+        parts.append(core.controller.call("metrics_text", timeout=10.0))
+    except Exception:
+        pass
+    for n in list_nodes():
+        if not n.get("alive"):
+            continue
+        try:
+            parts.append(_node_call(n["addr"], "metrics_text"))
+        except Exception:
+            continue
+    # de-duplicate HELP/TYPE headers repeated across process registries
+    seen: set = set()
+    out: List[str] = []
+    for part in parts:
+        for line in (part or "").splitlines():
+            if line.startswith("#"):
+                if line in seen:
+                    continue
+                seen.add(line)
+            elif line in seen:
+                continue   # identical sample from an earlier registry
+            else:
+                seen.add(line)
+            out.append(line)
+    return "\n".join(out) + "\n"
+
+
 def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Deep per-node stats: worker tables, running tasks, store usage
     (reference: dashboard reporter/agent per-node stats)."""

@@ -1,0 +1,133 @@
+"""Experiment/trial artifact syncing to URI storage.
+
+Capability mirror of the reference's `tune/syncer.py:1` (SyncConfig +
+Syncer: push trial directories to cloud/URI storage on a cadence, pull
+them back for restore).  Backends: plain paths and ``file://`` via the
+filesystem; ``s3://`` / ``gs://`` per-file streaming via smart_open when
+credentials exist (same gating as `core/external_storage.py`).
+
+Sync is incremental by (mtime, size) so the per-checkpoint cost is the
+new files, not the whole experiment tree.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import urllib.parse
+from typing import Dict, Optional, Tuple
+
+
+def is_uri(path: str) -> bool:
+    return "://" in path
+
+
+def _scheme(uri: str) -> str:
+    return urllib.parse.urlparse(uri).scheme
+
+
+def uri_join(base: str, *parts: str) -> str:
+    out = base.rstrip("/")
+    for p in parts:
+        out += "/" + p.strip("/")
+    return out
+
+
+class SyncConfig:
+    """Where and how often to sync (reference: tune.SyncConfig)."""
+
+    def __init__(self, upload_dir: Optional[str] = None,
+                 sync_period_s: float = 10.0):
+        self.upload_dir = upload_dir
+        self.sync_period_s = sync_period_s
+
+
+class Syncer:
+    """Incremental directory mirror between a local tree and a URI."""
+
+    def __init__(self):
+        # (local_path) -> (mtime, size) at last successful upload
+        self._synced: Dict[str, Tuple[float, int]] = {}
+
+    # -- backend primitives --------------------------------------------------
+    @staticmethod
+    def _open_write(target: str):
+        if _scheme(target) in ("s3", "gs", "gcs"):
+            import smart_open
+            return smart_open.open(target, "wb")
+        path = target[len("file://"):] if target.startswith("file://") \
+            else target
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        return open(path, "wb")
+
+    @staticmethod
+    def _open_read(source: str):
+        if _scheme(source) in ("s3", "gs", "gcs"):
+            import smart_open
+            return smart_open.open(source, "rb")
+        path = source[len("file://"):] if source.startswith("file://") \
+            else source
+        return open(path, "rb")
+
+    @staticmethod
+    def _as_local(target: str) -> Optional[str]:
+        """Local filesystem path for path-like targets, else None."""
+        if target.startswith("file://"):
+            return target[len("file://"):]
+        if not is_uri(target):
+            return target
+        return None
+
+    # -- tree operations -----------------------------------------------------
+    def sync_up(self, local_dir: str, remote_dir: str) -> int:
+        """Mirror new/changed files up; returns the number uploaded."""
+        n = 0
+        for root, _dirs, files in os.walk(local_dir):
+            for fname in files:
+                src = os.path.join(root, fname)
+                try:
+                    st = os.stat(src)
+                except OSError:
+                    continue  # vanished mid-walk (checkpoint rotation)
+                sig = (st.st_mtime, st.st_size)
+                if self._synced.get(src) == sig:
+                    continue
+                rel = os.path.relpath(src, local_dir)
+                dst = uri_join(remote_dir, *rel.split(os.sep))
+                local_dst = self._as_local(dst)
+                if local_dst is not None:
+                    os.makedirs(os.path.dirname(local_dst), exist_ok=True)
+                    shutil.copy2(src, local_dst)
+                else:
+                    with open(src, "rb") as f, \
+                            self._open_write(dst) as out:
+                        shutil.copyfileobj(f, out)
+                self._synced[src] = sig
+                n += 1
+        return n
+
+    def sync_down(self, remote_dir: str, local_dir: str) -> int:
+        """Mirror a remote tree down; returns the number downloaded.
+        URI listing is only available for path-like remotes (s3/gs
+        listing needs a bucket API smart_open doesn't provide — the
+        reference gates the same way on pyarrow.fs availability)."""
+        src_root = self._as_local(remote_dir)
+        if src_root is None:
+            raise ValueError(
+                f"sync_down from {remote_dir!r} needs a listable "
+                "filesystem target (path or file://)")
+        n = 0
+        for root, _dirs, files in os.walk(src_root):
+            for fname in files:
+                src = os.path.join(root, fname)
+                rel = os.path.relpath(src, src_root)
+                dst = os.path.join(local_dir, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.copy2(src, dst)
+                n += 1
+        return n
+
+    def delete(self, remote_dir: str) -> None:
+        root = self._as_local(remote_dir)
+        if root is not None:
+            shutil.rmtree(root, ignore_errors=True)

@@ -1,0 +1,248 @@
+"""OpenTelemetry span injection for tasks and actor calls.
+
+Capability mirror of the reference's tracing helper
+(`python/ray/util/tracing/tracing_helper.py:87` — wrap task submission
+and execution in spans, propagate the W3C trace context inside the task
+spec).  Only `opentelemetry-api` is required: with no SDK/provider
+registered every span is the API's no-op span (zero overhead, the
+reference behaves the same).  For environments without the SDK this
+module also ships a minimal in-memory provider (`SpanRecorder`)
+implementing the API surface, so tests and local debugging can observe
+spans without extra packages.
+
+Enable with ``ray_tpu.util.otel.enable_tracing()`` before ``init()``
+(or ``RAY_TPU_OTEL=1``): the flag rides GlobalConfig's env propagation
+into every worker, like the reference's ``--tracing-startup-hook``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+try:
+    from opentelemetry import trace as _trace
+    from opentelemetry.trace import (NonRecordingSpan, SpanContext,
+                                     TraceFlags)
+    _HAVE_OTEL = True
+except ImportError:  # pragma: no cover - otel-api is in this image
+    _trace = None
+    _HAVE_OTEL = False
+
+_TRACER_NAME = "ray_tpu"
+
+
+def enable_tracing() -> bool:
+    """Turn on span injection for this process and future workers."""
+    if not _HAVE_OTEL:
+        return False
+    os.environ["RAY_TPU_OTEL"] = "1"
+    return True
+
+
+def disable_tracing() -> None:
+    os.environ.pop("RAY_TPU_OTEL", None)
+
+
+def is_enabled() -> bool:
+    return _HAVE_OTEL and os.environ.get("RAY_TPU_OTEL") == "1"
+
+
+def _tracer():
+    return _trace.get_tracer(_TRACER_NAME)
+
+
+def inject_context() -> Optional[str]:
+    """Current span as a W3C ``traceparent`` string, or None."""
+    if not is_enabled():
+        return None
+    span = _trace.get_current_span()
+    ctx = span.get_span_context()
+    if not ctx.is_valid:
+        return None
+    return (f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-"
+            f"{int(ctx.trace_flags):02x}")
+
+
+def _parse_traceparent(tp: str) -> Optional["SpanContext"]:
+    try:
+        _, trace_id, span_id, flags = tp.split("-")
+        return SpanContext(
+            trace_id=int(trace_id, 16), span_id=int(span_id, 16),
+            is_remote=True, trace_flags=TraceFlags(int(flags, 16)))
+    except (ValueError, AttributeError):
+        return None
+
+
+@contextlib.contextmanager
+def span(name: str, traceparent: Optional[str] = None,
+         attributes: Optional[Dict[str, Any]] = None):
+    """A span, optionally parented to a remote ``traceparent`` (the
+    worker-side half of cross-process propagation)."""
+    if not is_enabled():
+        yield None
+        return
+    ctx = None
+    if traceparent:
+        remote = _parse_traceparent(traceparent)
+        if remote is not None:
+            ctx = _trace.set_span_in_context(NonRecordingSpan(remote))
+    with _tracer().start_as_current_span(
+            name, context=ctx, attributes=attributes or {}) as sp:
+        yield sp
+
+
+def submit_span(function_name: str):
+    """Driver-side submission span (reference: _inject_tracing_into_task)."""
+    return span(f"task::{function_name} submit",
+                attributes={"ray_tpu.function": function_name,
+                            "ray_tpu.side": "driver"})
+
+
+def execute_span(function_name: str, traceparent: Optional[str]):
+    """Worker-side execution span, parented across the process boundary
+    (reference: _inject_tracing_into_execution)."""
+    return span(f"task::{function_name} execute", traceparent,
+                attributes={"ray_tpu.function": function_name,
+                            "ray_tpu.side": "worker",
+                            "ray_tpu.pid": os.getpid()})
+
+
+# ---------------------------------------------------------------- recorder
+
+
+class _RecordedSpan(_trace.Span if _HAVE_OTEL else object):
+    """Minimal recording span implementing the otel-api Span surface.
+    MUST subclass the Span ABC: ``trace.get_current_span`` isinstance-
+    checks it and returns INVALID_SPAN for duck-typed impostors."""
+
+    def __init__(self, recorder: "SpanRecorder", name: str,
+                 context: "SpanContext", parent_id: Optional[int],
+                 attributes: Dict[str, Any]):
+        self._recorder = recorder
+        self.name = name
+        self._context = context
+        self.parent_id = parent_id
+        self.attributes = dict(attributes)
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.status: Optional[Any] = None
+
+    # -- otel Span API ------------------------------------------------------
+    def get_span_context(self):
+        return self._context
+
+    def set_attribute(self, key, value):
+        self.attributes[key] = value
+
+    def set_attributes(self, attributes):
+        self.attributes.update(attributes)
+
+    def add_event(self, *a, **kw):
+        pass
+
+    def add_link(self, *a, **kw):
+        pass
+
+    def update_name(self, name):
+        self.name = name
+
+    def is_recording(self) -> bool:
+        return self.end_time is None
+
+    def set_status(self, status, description=None):
+        self.status = status
+
+    def record_exception(self, exception, *a, **kw):
+        self.attributes["exception.type"] = type(exception).__name__
+
+    def end(self, end_time=None):
+        if self.end_time is None:
+            self.end_time = time.time()
+            self._recorder._finished(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class _RecorderTracer(_trace.Tracer if _HAVE_OTEL else object):
+    def __init__(self, recorder: "SpanRecorder"):
+        self._recorder = recorder
+
+    def start_span(self, name, context=None, kind=None, attributes=None,
+                   links=None, start_time=None, record_exception=True,
+                   set_status_on_exception=True) -> _RecordedSpan:
+        parent = _trace.get_current_span(context).get_span_context()
+        trace_id = (parent.trace_id if parent.is_valid
+                    else random.getrandbits(128))
+        parent_id = parent.span_id if parent.is_valid else None
+        ctx = SpanContext(trace_id=trace_id,
+                          span_id=random.getrandbits(64), is_remote=False,
+                          trace_flags=TraceFlags(TraceFlags.SAMPLED))
+        return _RecordedSpan(self._recorder, name, ctx, parent_id,
+                             attributes or {})
+
+    @contextlib.contextmanager
+    def start_as_current_span(self, name, context=None, kind=None,
+                              attributes=None, links=None, start_time=None,
+                              record_exception=True,
+                              set_status_on_exception=True,
+                              end_on_exit=True):
+        sp = self.start_span(name, context=context, attributes=attributes)
+        token = _trace.context_api.attach(
+            _trace.set_span_in_context(sp))
+        try:
+            yield sp
+        finally:
+            _trace.context_api.detach(token)
+            if end_on_exit:
+                sp.end()
+
+
+class SpanRecorder(_trace.TracerProvider if _HAVE_OTEL else object):
+    """In-memory TracerProvider substitute for images without the otel
+    SDK.  ``SpanRecorder.install()`` registers it globally; finished
+    spans accumulate in ``.spans`` (driver) or export via
+    ``pop_serializable()`` for cross-process collection."""
+
+    _installed: Optional["SpanRecorder"] = None
+
+    def __init__(self):
+        self.spans: List[_RecordedSpan] = []
+        self._lock = threading.Lock()
+
+    def _finished(self, span_obj: _RecordedSpan) -> None:
+        with self._lock:
+            self.spans.append(span_obj)
+
+    # otel TracerProvider API
+    def get_tracer(self, name, *a, **kw) -> _RecorderTracer:
+        return _RecorderTracer(self)
+
+    @classmethod
+    def install(cls) -> "SpanRecorder":
+        rec = cls()
+        _trace.set_tracer_provider(rec)
+        cls._installed = rec
+        return rec
+
+    def pop_serializable(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = [{
+                "name": s.name,
+                "trace_id": f"{s.get_span_context().trace_id:032x}",
+                "span_id": f"{s.get_span_context().span_id:016x}",
+                "parent_id": (f"{s.parent_id:016x}"
+                              if s.parent_id else None),
+                "start": s.start_time, "end": s.end_time,
+                "attributes": dict(s.attributes),
+            } for s in self.spans if s.end_time is not None]
+            self.spans.clear()
+        return out

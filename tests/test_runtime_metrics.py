@@ -1,0 +1,54 @@
+"""Runtime self-metrics battery (reference: the predefined metric set of
+src/ray/stats/metric_defs.cc, exported per component and aggregated)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cluster_metrics_exposition(cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    assert ray_tpu.get([f.remote(i) for i in range(20)], timeout=60) == \
+        list(range(1, 21))
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    text = state.cluster_metrics_text()
+    # exposition format sanity
+    assert "# TYPE ray_tpu_tasks_finished_total counter" in text
+    assert "# TYPE ray_tpu_worker_pool_size gauge" in text
+
+    def sample_sum(name: str) -> float:
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(name) and not line.startswith("#"):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    # the battery reflects the work above
+    assert sample_sum("ray_tpu_tasks_finished_total") >= 20
+    assert sample_sum("ray_tpu_scheduler_leases_granted_total") >= 1
+    assert sample_sum("ray_tpu_workers_spawned_total") >= 1
+    assert sample_sum("ray_tpu_actors_created_total") >= 1
+    assert sample_sum("ray_tpu_nodes_alive") >= 1
+    assert sample_sum("ray_tpu_object_store_capacity_bytes") > 0
+    # ≥20 distinct metric families defined (the battery, not a token few)
+    families = {line.split(" ")[2] for line in text.splitlines()
+                if line.startswith("# TYPE ray_tpu_")}
+    assert len(families) >= 20, sorted(families)

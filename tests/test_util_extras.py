@@ -1,0 +1,117 @@
+"""Graph scheduler (dask-protocol), OTel span injection, Serve schema
+validation.  Reference capabilities: util/dask scheduler,
+util/tracing/tracing_helper.py, serve/schema.py."""
+
+import operator
+
+import pytest
+
+import ray_tpu
+from ray_tpu.serve.schema import DeployConfig, SchemaError, load_config
+from ray_tpu.util import graph_scheduler, otel
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init()
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- graphs
+
+
+def test_graph_scheduler_diamond(cluster):
+    dsk = {
+        "a": 1,
+        "b": (operator.add, "a", 10),
+        "c": (operator.mul, "a", 7),
+        "d": (operator.add, "b", "c"),
+    }
+    assert graph_scheduler.get(dsk, "d") == 18
+    assert graph_scheduler.get(dsk, ["b", "c"]) == [11, 7]
+
+
+def test_graph_scheduler_nested_and_alias(cluster):
+    dsk = {
+        "x": 2,
+        "alias": "x",
+        "lst": [(operator.add, "x", 1), (operator.add, "x", 2)],
+        "sum": (sum, "lst"),
+    }
+    assert graph_scheduler.get(dsk, "alias") == 2
+    assert graph_scheduler.get(dsk, "sum") == 7
+
+
+def test_graph_scheduler_cycle_raises(cluster):
+    with pytest.raises(ValueError, match="cycle"):
+        graph_scheduler.get({"a": (operator.add, "b", 1),
+                             "b": (operator.add, "a", 1)}, "a")
+
+
+# ------------------------------------------------------------------ otel
+
+
+def test_otel_cross_process_spans(cluster):
+    rec = otel.SpanRecorder.install()
+    assert otel.enable_tracing()
+    try:
+        @ray_tpu.remote
+        def traced(x):
+            return x + 1
+
+        with otel.submit_span("traced"):
+            tp = otel.inject_context()
+            assert tp and tp.startswith("00-")
+            assert ray_tpu.get(traced.remote(1), timeout=30) == 2
+        # the driver-side submit span is recorded locally
+        spans = rec.pop_serializable()
+        names = [s["name"] for s in spans]
+        assert "task::traced submit" in names
+    finally:
+        otel.disable_tracing()
+
+
+def test_otel_disabled_is_noop():
+    otel.disable_tracing()
+    assert otel.inject_context() is None
+    with otel.execute_span("f", None) as sp:
+        assert sp is None
+
+
+# ---------------------------------------------------------------- schema
+
+
+def test_schema_single_app_shorthand():
+    cfg = load_config({"import_path": "mymod:dep", "name": "app1"})
+    assert len(cfg.applications) == 1
+    assert cfg.applications[0].import_path == "mymod:dep"
+
+
+def test_schema_yaml_and_validation_errors(tmp_path):
+    good = tmp_path / "serve.yaml"
+    good.write_text(
+        "applications:\n"
+        "  - name: app1\n"
+        "    import_path: pkg.mod:dep\n"
+        "    route_prefix: /app1\n"
+        "    deployments:\n"
+        "      - name: dep\n"
+        "        num_replicas: 2\n")
+    cfg = load_config(str(good))
+    assert cfg.applications[0].deployments[0].num_replicas == 2
+    rt = DeployConfig.from_dict(cfg.to_dict())
+    assert rt.applications[0].import_path == "pkg.mod:dep"
+
+    with pytest.raises(SchemaError, match="import_path"):
+        load_config({"applications": [{"name": "x"}]})
+    with pytest.raises(SchemaError, match="module:attribute"):
+        load_config({"applications": [{"import_path": "noattr"}]})
+    with pytest.raises(SchemaError, match="unknown field"):
+        load_config({"import_path": "m:a", "bogus_field": 1})
+    with pytest.raises(SchemaError, match="needs a 'name'"):
+        load_config({"import_path": "m:a",
+                     "deployments": [{"num_replicas": 1}]})
+    with pytest.raises(SchemaError, match="duplicate"):
+        load_config({"applications": [{"import_path": "m:a"},
+                                      {"import_path": "m:a"}]})
