@@ -522,9 +522,23 @@ class CoreClient:
             return [ARG_REF, ref.binary()]
         return [ARG_VALUE, b"".join(bytes(p) for p in parts)]
 
+    def _stamp_trace_ctx(self, spec: TaskSpec) -> None:
+        """OTel span injection (reference: tracing_helper.py:87
+        _inject_tracing_into_task): when tracing is enabled, record a
+        driver-side submit span and ship its W3C context in the spec so
+        the worker's execution span parents across the process hop."""
+        from ..util import otel
+        if not otel.is_enabled():
+            return
+        with otel.submit_span(spec.function_name):
+            tp = otel.inject_context()
+        if tp:
+            spec.d["otel"] = tp
+
     def submit_task(self, spec: TaskSpec,
                     temp_refs: Optional[List["ObjectRef"]] = None
                     ) -> List[ObjectRef]:
+        self._stamp_trace_ctx(spec)
         with self._ref_lock:
             for oid in spec.return_ids():
                 self._owned.add(oid.binary())
@@ -962,6 +976,7 @@ class CoreClient:
                           max_task_retries: int = 0,
                           temp_refs: Optional[List["ObjectRef"]] = None
                           ) -> List[ObjectRef]:
+        self._stamp_trace_ctx(spec)
         with self._ref_lock:
             for oid in spec.return_ids():
                 self._owned.add(oid.binary())

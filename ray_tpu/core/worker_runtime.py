@@ -426,6 +426,14 @@ class WorkerRuntime:
                                  "task_id": spec.task_id.binary(),
                                  "t": time.time()})
         try:
+            tp = spec.d.get("otel")
+            if tp:
+                # execution span parented to the driver's submit span
+                # (reference: _inject_tracing_into_execution); no-op
+                # unless this worker registered a tracer provider
+                from ..util import otel
+                with otel.execute_span(spec.function_name, tp):
+                    return await self._execute(spec, fn)
             return await self._execute(spec, fn)
         finally:
             self._report_task_state({"event": "finish",
@@ -509,6 +517,11 @@ class WorkerRuntime:
                         f"{spec.function_name}",
                 "task_id": spec.task_id.binary(), "t": time.time()})
             try:
+                tp = spec.d.get("otel")
+                if tp:
+                    from ..util import otel
+                    with otel.execute_span(spec.function_name, tp):
+                        return await self._execute(spec, method)
                 return await self._execute(spec, method)
             finally:
                 self._report_task_state({

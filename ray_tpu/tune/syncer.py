@@ -46,8 +46,10 @@ class Syncer:
     """Incremental directory mirror between a local tree and a URI."""
 
     def __init__(self):
-        # (local_path) -> (mtime, size) at last successful upload
-        self._synced: Dict[str, Tuple[float, int]] = {}
+        # (local_path, remote_root) -> (mtime, size) at last successful
+        # upload — keyed by destination too, so syncing one tree to a
+        # second target (or a wiped one) re-uploads everything
+        self._synced: Dict[Tuple[str, str], Tuple[float, int]] = {}
 
     # -- backend primitives --------------------------------------------------
     @staticmethod
@@ -90,7 +92,7 @@ class Syncer:
                 except OSError:
                     continue  # vanished mid-walk (checkpoint rotation)
                 sig = (st.st_mtime, st.st_size)
-                if self._synced.get(src) == sig:
+                if self._synced.get((src, remote_dir)) == sig:
                     continue
                 rel = os.path.relpath(src, local_dir)
                 dst = uri_join(remote_dir, *rel.split(os.sep))
@@ -102,7 +104,7 @@ class Syncer:
                     with open(src, "rb") as f, \
                             self._open_write(dst) as out:
                         shutil.copyfileobj(f, out)
-                self._synced[src] = sig
+                self._synced[(src, remote_dir)] = sig
                 n += 1
         return n
 

@@ -10,9 +10,12 @@ module also ships a minimal in-memory provider (`SpanRecorder`)
 implementing the API surface, so tests and local debugging can observe
 spans without extra packages.
 
-Enable with ``ray_tpu.util.otel.enable_tracing()`` before ``init()``
-(or ``RAY_TPU_OTEL=1``): the flag rides GlobalConfig's env propagation
-into every worker, like the reference's ``--tracing-startup-hook``.
+Enable with ``ray_tpu.util.otel.enable_tracing()`` (or
+``RAY_TPU_OTEL=1``) in the driver: the driver records a submit span per
+task and ships its W3C context in the task spec; a worker opens the
+matching execution span whenever a spec carries one — the context's
+presence is the cross-process enablement signal, like the reference's
+``--tracing-startup-hook`` wiring in tracing_helper.py.
 """
 
 from __future__ import annotations
@@ -82,8 +85,14 @@ def _parse_traceparent(tp: str) -> Optional["SpanContext"]:
 def span(name: str, traceparent: Optional[str] = None,
          attributes: Optional[Dict[str, Any]] = None):
     """A span, optionally parented to a remote ``traceparent`` (the
-    worker-side half of cross-process propagation)."""
-    if not is_enabled():
+    worker-side half of cross-process propagation).  A present
+    traceparent IS the enablement signal: workers don't share the
+    driver's environment, the context shipped in the task spec is what
+    says this task is traced."""
+    if not is_enabled() and not traceparent:
+        yield None
+        return
+    if not _HAVE_OTEL:
         yield None
         return
     ctx = None
