@@ -92,6 +92,10 @@ class DashboardHead:
             from .. import metrics
             return metrics.prometheus_text()
 
+        def metrics_cluster(_):
+            from .. import state
+            return state.cluster_metrics_text()
+
         def node_stats(request):
             from .. import state
             return state.node_stats(request.match_info.get("node_id"))
@@ -139,6 +143,23 @@ class DashboardHead:
             from ..serve.api import status_table
             return status_table()
 
+        def serve_applications_get(_):
+            from ..serve import schema
+            return schema.status()
+
+        def serve_applications_put(request):
+            # declarative REST deploy (reference: PUT
+            # /api/serve/applications/ with a ServeDeploySchema body)
+            from ..serve import schema
+            raw = asyncio.run_coroutine_threadsafe(
+                request.read(), loop).result(timeout=10)
+            handles = schema.apply_config(json.loads(raw or b"{}"))
+            return {"deployed": sorted(handles)}
+
+        app.router.add_get("/api/serve/applications",
+                           blocking(serve_applications_get))
+        app.router.add_put("/api/serve/applications",
+                           blocking(serve_applications_put))
         app.router.add_get("/api/events", blocking(events))
         app.router.add_post("/api/workflow_events/{name}",
                             blocking(fire_workflow_event))
@@ -156,6 +177,7 @@ class DashboardHead:
         app.router.add_get("/api/jobs/{job_id}", blocking(job_status))
         app.router.add_get("/api/jobs/{job_id}/logs", blocking(job_logs))
         app.router.add_get("/metrics", blocking(metrics_text))
+        app.router.add_get("/metrics/cluster", blocking(metrics_cluster))
         app.router.add_get(
             "/api/version",
             blocking(lambda _: {"ray_tpu": __import__(

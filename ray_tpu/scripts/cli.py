@@ -121,12 +121,34 @@ def cmd_attach(args) -> None:
 
 
 def cmd_serve_status(args) -> None:
-    """Deployment table of the running Serve instance (reference:
-    `serve status` CLI)."""
+    """Application-level status of the running Serve instance
+    (reference: `serve status` CLI)."""
     import ray_tpu
-    from ray_tpu.serve.api import status_table
+    from ray_tpu.serve import schema
     _connect(args)
-    print(json.dumps(status_table(), indent=2, default=str))
+    print(json.dumps(schema.status(), indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_serve_deploy(args) -> None:
+    """Deploy a declarative YAML config (reference: `serve deploy`)."""
+    import ray_tpu
+    from ray_tpu.serve import schema
+    _connect(args)
+    handles = schema.apply_config(args.config_file)
+    print(f"deployed {len(handles)} application(s): "
+          f"{', '.join(handles)}")
+    ray_tpu.shutdown()
+
+
+def cmd_serve_config(args) -> None:
+    """The config last applied via serve deploy (reference:
+    `serve config`)."""
+    import ray_tpu
+    from ray_tpu.serve import schema
+    _connect(args)
+    cfg = schema.get_deployed_config()
+    print(json.dumps(cfg, indent=2, default=str) if cfg else "{}")
     ray_tpu.shutdown()
 
 
@@ -270,6 +292,17 @@ def main(argv=None) -> None:
     sp = sub.add_parser("serve-status", help="Serve deployment table")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve_status)
+
+    sp = sub.add_parser("serve-deploy",
+                        help="deploy a declarative Serve YAML config")
+    sp.add_argument("config_file")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_deploy)
+
+    sp = sub.add_parser("serve-config",
+                        help="show the last config applied via serve-deploy")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve_config)
 
     sp = sub.add_parser("up", help="launch a cluster from a YAML config")
     sp.add_argument("config")

@@ -14,10 +14,19 @@ from .api import (  # noqa: F401
     get_handle,
     http_address,
     list_deployments,
+    proxy_statuses,
     run,
     shutdown,
     start,
     status_table,
+)
+from .schema import (  # noqa: F401
+    DeployConfig,
+    SchemaError,
+    apply_config,
+    get_deployed_config,
+    load_config,
+    status,
 )
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401

@@ -27,21 +27,80 @@ def start(http_options: Optional[HTTPOptions] = None, *,
     """Boot the controller (and HTTP proxy) if not already running."""
     if "controller" in _state:
         return
+    # A Serve instance already running in this CLUSTER (deployed by some
+    # other process — `serve-deploy` CLI, dashboard PUT) must be
+    # ATTACHED, not re-booted: booting would spawn a second, stray HTTP
+    # proxy alongside whatever ingress mode the instance already runs.
+    if http_options is None:
+        try:
+            existing = core_api.get_actor("serve::controller")
+        except ValueError:
+            existing = None
+        if existing is not None:
+            _state["controller"] = existing
+            _state["router"] = Router(existing)
+            table = core_api.get(existing.proxy_table.remote(),
+                                 timeout=30.0)
+            _state["http_address"] = next(iter(table.values()), None)
+            return
     # Named so ANY process (e.g. a graph-driver replica composing other
     # deployments) can resolve the controller and build its own router.
     controller = core_api.remote(ServeController).options(
-        num_cpus=0.1, name="serve::controller",
+        num_cpus=0.1, name="serve::controller", lifetime="detached",
         get_if_exists=True).remote()
     _state["controller"] = controller
     _state["router"] = Router(controller)
     http = http_options or HTTPOptions(port=_free_port())
+    if http.location == "NoServer":
+        return
+    if http.location == "EveryNode":
+        # Per-node proxy fleet, reconciled by the controller (reference:
+        # http_state.py).  ensure_proxies only SPAWNS; each proxy pushes
+        # its bound address asynchronously, so wait driver-side until
+        # every alive node's proxy has announced.
+        import time as _time
+
+        from .. import state as _state_api
+        core_api.get(controller.ensure_proxies.remote(
+            {"host": http.host, "location": http.location}), timeout=60.0)
+        want = {n["id"] for n in _state_api.list_nodes()
+                if n.get("alive")}
+        deadline = _time.monotonic() + 120.0
+        table: Dict[str, str] = {}
+        while _time.monotonic() < deadline:
+            table = core_api.get(controller.proxy_table.remote(),
+                                 timeout=30.0)
+            if want.issubset(table):
+                break
+            _time.sleep(0.25)
+        if not table:
+            raise RuntimeError("no Serve proxy came up within 120s")
+        missing = want - set(table)
+        if missing:
+            import sys
+            print(f"WARNING: Serve proxies missing on node(s) "
+                  f"{sorted(missing)} after 120s; ingress is degraded "
+                  f"until the controller's reconcile brings them up",
+                  file=sys.stderr)
+        _state["proxy_table"] = table
+        my_node = core_api.get_runtime_context().node_id
+        addr = table.get(my_node) or next(iter(table.values()), None)
+        _state["http_address"] = addr
+        return
     from .http_proxy import HTTPProxy
     proxy = core_api.remote(HTTPProxy).options(
-        num_cpus=0.1, max_concurrency=64).remote(controller, http.host,
-                                                 http.port)
+        num_cpus=0.1, max_concurrency=64,
+        lifetime="detached").remote(controller, http.host, http.port)
     core_api.get(proxy.healthy.remote(), timeout=30.0)
     _state["proxy"] = proxy
     _state["http_address"] = f"http://{http.host}:{http.port}"
+    # adopt under the proxy's OWN node and reported address — HeadOnly
+    # placement has no affinity, so the creator's node may be wrong
+    proxy_node = core_api.get(proxy.node_id.remote(), timeout=30.0)
+    proxy_addr = core_api.get(proxy.address.remote(), timeout=30.0)
+    core_api.get(controller.adopt_proxy.remote(
+        proxy_node or core_api.get_runtime_context().node_id, proxy,
+        proxy_addr), timeout=30.0)
 
 
 def run(target: Deployment, *, name: Optional[str] = None,
@@ -117,6 +176,22 @@ def http_address() -> Optional[str]:
     return _state.get("http_address")
 
 
+def proxy_statuses() -> Dict[str, str]:
+    """node_id -> proxy http address (EveryNode mode; reference: `serve
+    status` proxies section).  Readable from ANY process via the named
+    controller, like `status_table`."""
+    h = _state.get("controller")
+    if h is None:
+        try:
+            h = core_api.get_actor("serve::controller")
+        except ValueError:
+            return {}
+    try:
+        return core_api.get(h.proxy_table.remote(), timeout=10.0)
+    except Exception:
+        return {}
+
+
 def delete(name: str) -> None:
     if "controller" in _state:
         core_api.get(_state["controller"].delete.remote(name),
@@ -128,6 +203,11 @@ def shutdown() -> None:
         try:
             core_api.get(_state["controller"].shutdown_all.remote(),
                          timeout=60.0)
+        except Exception:
+            pass
+        try:
+            core_api.get(_state["controller"].stop_proxies.remote(),
+                         timeout=30.0)
         except Exception:
             pass
         for key in ("proxy", "controller"):

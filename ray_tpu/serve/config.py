@@ -19,6 +19,14 @@ class AutoscalingConfig:
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
+    # "HeadOnly": one proxy in the driver's node (default).
+    # "EveryNode": the controller reconciles one proxy actor per alive
+    #   node, each binding an ephemeral port announced in the proxy
+    #   table (reference: http_state.py per-node proxy management; fixed
+    #   per-node ports are impossible here because test clusters share
+    #   one host/IP).
+    # "NoServer": handles only, no HTTP ingress.
+    location: str = "HeadOnly"
 
 
 @dataclasses.dataclass

@@ -20,6 +20,12 @@ class ServeController:
         self._deployments: Dict[str, Dict[str, Any]] = {}
         self._version = 0
         self._replica_seq = 0
+        # per-node HTTP proxies (reference: http_state.py HTTPProxyState
+        # reconciliation); node_id -> {"actor", "address"}
+        self._proxies: Dict[str, Dict[str, Any]] = {}
+        self._proxy_http: Optional[dict] = None
+        self._last_proxy_check = 0.0
+        self._replica_nodes: Dict[str, str] = {}  # replica id -> node id
 
     # -- deploy / delete ----------------------------------------------------
     def deploy(self, name: str, callable_blob: bytes, init_args: tuple,
@@ -94,12 +100,18 @@ class ServeController:
             handle = api.remote(ServeReplica).options(
                 max_concurrency=int(cfg.get("max_concurrent_queries", 8)),
                 num_cpus=opts.get("num_cpus", 0.1),
+                # detached: a replica must outlive the JOB that deployed
+                # it (e.g. a `serve-deploy` CLI process) — Serve owns
+                # replica lifecycle via scale-down/shutdown, the job GC
+                # does not (reference: all serve actors are detached)
+                lifetime="detached",
             ).remote(name, rid, entry["callable_blob"],
                      entry["init_args"], entry["init_kwargs"],
                      cfg.get("user_config"))
             entry["replicas"].append({"id": rid, "handle": handle})
         while len(entry["replicas"]) > target:
             rep = entry["replicas"].pop()
+            self._replica_nodes.pop(rep["id"], None)
             if rep.get("gang"):
                 from .gang import stop_gang_replica
                 stop_gang_replica(rep)
@@ -110,18 +122,146 @@ class ServeController:
                 pass
         self._version += 1
 
+    # -- per-node HTTP proxies ---------------------------------------------
+    def ensure_proxies(self, http: dict) -> Dict[str, str]:
+        """Reconcile one HTTPProxy actor per alive node (reference:
+        `serve/_private/http_state.py:28` proxy-state manager).  Each
+        proxy binds an ephemeral port on its node and the table maps
+        node_id -> http address; routers inside each proxy prefer
+        same-node replicas, so ingress on any node serves local traffic
+        without a cross-node hop when a local replica exists."""
+        from .. import api, state
+        from ..util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        from .http_proxy import HTTPProxy
+        self._proxy_http = dict(http)
+        alive = {n["id"]: n for n in state.list_nodes() if n.get("alive")}
+        # proxies whose ACTOR died while the node stayed alive must be
+        # replaced too — check the actor table, not just node membership
+        dead_aids = set()
+        try:
+            dead_aids = {row["actor_id"] for row in state.list_actors()
+                         if row.get("state") == "DEAD"}
+        except Exception:
+            pass
+        for nid in list(self._proxies):
+            entry = self._proxies[nid]
+            if nid in alive and \
+                    entry["actor"]._actor_id not in dead_aids:
+                continue
+            self._proxies.pop(nid)
+            try:
+                api.kill(entry["actor"])
+            except Exception:
+                pass
+        me = api.get_actor("serve::controller")
+        for nid in alive:
+            if nid in self._proxies:
+                continue
+            # Fire-and-forget: the proxy pushes its bound address via
+            # register_proxy once live.  NEVER await it here — this
+            # method runs inside the controller actor and the proxy's
+            # first routing snapshot calls back into this same actor.
+            actor = api.remote(HTTPProxy).options(
+                num_cpus=0.05, max_concurrency=64, lifetime="detached",
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=nid, soft=False),
+            ).remote(me, http.get("host", "127.0.0.1"), 0, nid)
+            self._proxies[nid] = {"actor": actor, "address": None}
+        return self.proxy_table()
+
+    def register_proxy(self, node_id: str, address: str) -> bool:
+        entry = self._proxies.get(node_id)
+        if entry is not None:
+            entry["address"] = address
+        return True
+
+    def adopt_proxy(self, node_id: str, actor: Any, address: str) -> bool:
+        """Track a proxy created OUTSIDE the controller (the HeadOnly
+        boot path) so proxy_statuses reports it and stop_proxies reaps
+        it — detached actors have no job GC to fall back on."""
+        self._proxies[node_id] = {"actor": actor, "address": address}
+        return True
+
+    def proxy_table(self) -> Dict[str, str]:
+        """node_id -> address, for proxies that have announced."""
+        return {nid: p["address"] for nid, p in self._proxies.items()
+                if p["address"]}
+
+    def stop_proxies(self) -> bool:
+        from .. import api
+        for p in self._proxies.values():
+            try:
+                api.kill(p["actor"])
+            except Exception:
+                pass
+        self._proxies.clear()
+        return True
+
+    def _maybe_reconcile_proxies(self) -> None:
+        """Piggybacked on router metric reports: pick up node joins and
+        deaths within ~5 s without a dedicated loop."""
+        if self._proxy_http is None:
+            return
+        now = time.monotonic()
+        if now - self._last_proxy_check < 5.0:
+            return
+        self._last_proxy_check = now
+        try:
+            self.ensure_proxies(self._proxy_http)
+        except Exception:
+            pass  # transient state-API failure; next report retries
+
     # -- routing state ------------------------------------------------------
+    def _resolve_replica_nodes(self) -> None:
+        """Fill the replica->node cache for locality routing with ONE
+        actor-table RPC, at most once per second.  Only truthy node ids
+        are cached: a replica still PENDING_CREATION has node_id None,
+        and caching that would disable locality for its whole life."""
+        unresolved = []
+        for entry in self._deployments.values():
+            for rep in entry["replicas"]:
+                if not self._replica_nodes.get(rep["id"]):
+                    unresolved.append(rep)
+        if not unresolved:
+            return
+        now = time.monotonic()
+        if now - getattr(self, "_last_node_resolve", 0.0) < 1.0:
+            return
+        self._last_node_resolve = now
+        try:
+            from .. import state
+            by_aid = {row.get("actor_id"): row.get("node_id")
+                      for row in state.list_actors()}
+        except Exception:
+            return  # transient; next snapshot retries
+        newly = 0
+        for rep in unresolved:
+            handle = (rep.get("gang") or [rep["handle"]])[0]
+            nid = by_aid.get(handle._actor_id)  # ids are bytes on the wire
+            if nid:
+                self._replica_nodes[rep["id"]] = nid
+                newly += 1
+        if newly:
+            # routers that already saw this version must re-pull to get
+            # the node annotations, or locality stays off until the next
+            # unrelated table change
+            self._version += 1
+
     def snapshot(self, known_version: int = -1) -> Optional[dict]:
         """Routing table if newer than known_version (long-poll pull)."""
         if known_version == self._version:
             return None
+        self._resolve_replica_nodes()
         table = {}
         for name, entry in self._deployments.items():
             table[name] = {
                 "route_prefix": entry.get("route_prefix"),
                 "max_concurrent_queries":
                     entry["config"].get("max_concurrent_queries", 8),
-                "replicas": [{"id": r["id"], "handle": r["handle"]}
+                "replicas": [{"id": r["id"], "handle": r["handle"],
+                              "node_id":
+                                  self._replica_nodes.get(r["id"])}
                              for r in entry["replicas"]],
             }
         return {"version": self._version, "table": table}
@@ -137,6 +277,8 @@ class ServeController:
     def report_metrics(self, name: str, ongoing_per_replica: List[int]
                        ) -> bool:
         """Router-reported in-flight counts drive the basic autoscaler."""
+        self._maybe_reconcile_proxies()
+        self._resolve_replica_nodes()   # 1s-throttled internally
         entry = self._deployments.get(name)
         if entry is None:
             return False

@@ -245,6 +245,7 @@ def start_gang_replica(name: str, rid: str, entry: Dict[str, Any],
             resources={k: v for k, v in bundle_res.items() if k != "CPU"},
             placement_group=pg, placement_group_bundle_index=rank,
             runtime_env=opts.get("runtime_env"),
+            lifetime="detached",  # serve owns the lifecycle, not the job
         ).remote(name, rid, rank, gang_size, group_name,
                  entry["callable_blob"], entry["init_args"],
                  entry["init_kwargs"], cfg.get("user_config"),

@@ -19,7 +19,7 @@ from typing import Optional
 
 class HTTPProxy:
     def __init__(self, controller_handle, host: str = "127.0.0.1",
-                 port: int = 8000):
+                 port: int = 8000, node_id: Optional[str] = None):
         from .router import Router
         self._router = Router(controller_handle)
         self._host = host
@@ -32,6 +32,13 @@ class HTTPProxy:
         self._ready.wait(timeout=15.0)
         if self._startup_error:
             raise RuntimeError(self._startup_error)
+        if node_id is not None:
+            # PUSH the bound address to the controller (fire-and-forget):
+            # the controller must never block waiting on a proxy, because
+            # the proxy's own router calls back into the controller for
+            # its first routing snapshot — a pull would deadlock.
+            controller_handle.register_proxy.remote(node_id,
+                                                    self.address())
 
     # -- server thread ------------------------------------------------------
     def _serve(self) -> None:
@@ -48,9 +55,7 @@ class HTTPProxy:
         async def handle(request: "web.Request") -> "web.Response":
             path = request.path
             if path == "/-/routes":
-                table = {name: f"/{name}"
-                         for name in self._router.deployment_names()}
-                return web.json_response(table)
+                return web.json_response(self._router.route_prefixes())
             if path == "/-/healthz":
                 return web.Response(text="ok")
             name = self._router.match_route(path)
@@ -98,6 +103,10 @@ class HTTPProxy:
             site = web.TCPSite(runner, self._host, self._port)
             try:
                 await site.start()
+                if self._port == 0:
+                    # ephemeral bind (per-node proxies on one shared
+                    # host): report the real port
+                    self._port = site._server.sockets[0].getsockname()[1]
             except OSError as e:
                 self._startup_error = str(e)
             self._ready.set()
@@ -109,6 +118,15 @@ class HTTPProxy:
     # -- actor surface ------------------------------------------------------
     def address(self) -> str:
         return f"http://{self._host}:{self._port}"
+
+    def node_id(self) -> Optional[str]:
+        """Node actually hosting this proxy (it may not be the node of
+        whoever created it — HeadOnly spawns with no affinity)."""
+        try:
+            from .. import api
+            return api.get_runtime_context().node_id
+        except Exception:
+            return None
 
     def healthy(self) -> bool:
         return self._thread.is_alive() and not self._startup_error

@@ -27,6 +27,14 @@ class Router:
         self._lock = threading.Lock()
         self._poll_interval = poll_interval_s
         self._last_poll = 0.0
+        # Locality: prefer replicas on this router's own node (the
+        # reference's LocalityScheduling in the replica scheduler) — a
+        # per-node proxy then serves node-local traffic without an extra
+        # network hop whenever a local replica has capacity.
+        try:
+            self._node_id = api.get_runtime_context().node_id
+        except Exception:
+            self._node_id = None
         self._refresh(force=True)
 
     def _refresh(self, force: bool = False) -> None:
@@ -48,6 +56,12 @@ class Router:
     def deployment_names(self):
         self._refresh()
         return list(self._table)
+
+    def route_prefixes(self) -> Dict[str, str]:
+        """deployment -> actual route prefix (HTTP-exposed only)."""
+        self._refresh()
+        return {name: e["route_prefix"] for name, e in self._table.items()
+                if e.get("route_prefix")}
 
     def match_route(self, path: str) -> Optional[str]:
         self._refresh()
@@ -75,12 +89,26 @@ class Router:
                 cap = entry.get("max_concurrent_queries", 8) if entry else 0
                 chosen = None
                 if replicas:
+                    # Least-loaded with local preference: locality is a
+                    # TIE-BREAK among the least-loaded candidates, never
+                    # a magnet — preferring any under-cap local replica
+                    # outright would funnel all traffic to it while its
+                    # siblings idle.  RR order breaks remaining ties.
                     start = next(self._rr[name]) % len(replicas)
+                    candidates = []
                     for off in range(len(replicas)):
                         rep = replicas[(start + off) % len(replicas)]
-                        if self._inflight.get(rep["id"], 0) < cap:
-                            chosen = rep
-                            break
+                        load = self._inflight.get(rep["id"], 0)
+                        if load < cap:
+                            candidates.append((load, rep))
+                    if candidates:
+                        min_load = min(load for load, _ in candidates)
+                        best = [rep for load, rep in candidates
+                                if load == min_load]
+                        chosen = next(
+                            (rep for rep in best if self._node_id and
+                             rep.get("node_id") == self._node_id),
+                            best[0])
                 if chosen is not None:
                     self._inflight[chosen["id"]] = \
                         self._inflight.get(chosen["id"], 0) + 1

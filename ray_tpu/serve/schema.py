@@ -126,10 +126,20 @@ class DeployConfig:
         return cls(applications=apps)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"applications": [
-            {k: v for k, v in dataclasses.asdict(a).items()
-             if v not in (None, [], {})}
-            for a in self.applications]}
+        apps = []
+        for a in self.applications:
+            d = {k: v for k, v in dataclasses.asdict(a).items()
+                 if v not in (None, [], {})}
+            # an EXPLICIT route_prefix=None means handle-only (no HTTP
+            # route) — dropping it would silently turn the deployment
+            # HTTP-exposed on a config re-apply; only the "__derive__"
+            # default is elidable
+            if a.route_prefix is None:
+                d["route_prefix"] = None
+            elif a.route_prefix == "__derive__":
+                d.pop("route_prefix", None)
+            apps.append(d)
+        return {"applications": apps}
 
 
 def load_config(source: Any) -> DeployConfig:
@@ -191,15 +201,21 @@ def apply_config(source: Any) -> Dict[str, Any]:
         if app.args or app.kwargs:
             target = target.bind(*(app.args or ()),
                                  **(app.kwargs or {}))
-        override = next((o for o in app.deployments
-                         if o.name in (target.name, app.name)), None)
-        if override is not None:
+        matched = [o for o in app.deployments
+                   if o.name in (target.name, app.name)]
+        unmatched = [o.name for o in app.deployments if o not in matched]
+        if unmatched:
+            # a typo'd override silently not taking effect is the worst
+            # failure mode of declarative config — make it loud
+            raise SchemaError(
+                f"application {app.name or app.import_path!r}: deployment "
+                f"override(s) {unmatched} match neither the target "
+                f"deployment {target.name!r} nor the application name")
+        for override in matched:
             target = _apply_overrides(target, override)
         name = app.name or target.name
-        handles[name] = serve_api.run(
-            target, name=name,
-            route_prefix=app.route_prefix
-            if app.route_prefix != "__derive__" else "__derive__")
+        handles[name] = serve_api.run(target, name=name,
+                                      route_prefix=app.route_prefix)
     from ..util import kv
     kv.kv_put(_KV_CONFIG_KEY, json.dumps(cfg.to_dict()).encode(),
               namespace=_KV_NS)
@@ -228,5 +244,4 @@ def status() -> Dict[str, Any]:
         }
     return {"applications": apps,
             "config": deployed,
-            "proxies": serve_api.proxy_statuses()
-            if hasattr(serve_api, "proxy_statuses") else {}}
+            "proxies": serve_api.proxy_statuses()}

@@ -1,0 +1,23 @@
+"""Importable Serve application for declarative-deploy tests (the
+``import_path`` target a YAML config names — reference:
+serve/schema.py import_path semantics)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=1)
+class Greeter:
+    def __init__(self, greeting: str = "hello"):
+        self.greeting = greeting
+
+    def __call__(self, payload=None):
+        who = (payload or {}).get("who", "world") \
+            if isinstance(payload, dict) else "world"
+        return {"message": f"{self.greeting} {who}"}
+
+    def reconfigure(self, user_config):
+        self.greeting = user_config.get("greeting", self.greeting)
+
+
+greeter_app = Greeter.bind("hello")
+not_a_deployment = object()
