@@ -55,8 +55,11 @@ class CheckpointConfig:
 @dataclasses.dataclass
 class RunConfig:
     name: Optional[str] = None
+    # a URI (file://, s3://, gs://) syncs experiment state + artifacts
+    # there (reference: RunConfig.storage_path + SyncConfig)
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 0
     stop: Optional[Dict[str, Any]] = None
+    sync_config: Optional[Any] = None   # tune.syncer.SyncConfig

@@ -48,6 +48,63 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_state: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+        """Resume an interrupted sweep from its experiment directory or
+        URI (reference: `Tuner.restore(path, trainable)` — experiment
+        state is reloaded, finished trials keep their results, and
+        unfinished trials relaunch from their last checkpoints).
+
+        ``trainable`` must be the same callable the sweep ran — like the
+        reference, code is not resurrected from disk, only state."""
+        import json as _json
+
+        from .syncer import Syncer, is_uri
+        local = path
+        if is_uri(path):
+            local = os.path.join(tempfile.gettempdir(),
+                                 "ray_tpu_restore",
+                                 path.rstrip("/").rsplit("/", 1)[-1])
+            try:
+                Syncer().sync_down(path, local)
+            except ValueError as e:
+                # s3://gs:// can be SYNCED UP but not listed back without
+                # a bucket-listing API this image lacks; restore needs a
+                # listable target (path or file://)
+                raise ValueError(
+                    f"Tuner.restore({path!r}): {e}; restore from the "
+                    "local experiment directory instead") from None
+        state_file = os.path.join(local, "experiment_state.json")
+        if not os.path.exists(state_file):
+            raise FileNotFoundError(
+                f"no experiment_state.json under {path!r} — not a tune "
+                "experiment directory (or the sweep never persisted)")
+        with open(state_file) as f:
+            saved = _json.load(f)
+        name = path.rstrip("/").rsplit("/", 1)[-1] if is_uri(path) \
+            else os.path.basename(local.rstrip(os.sep))
+        # storage_path must be the PARENT of the experiment dir — the
+        # runner re-joins <storage_path>/<name>, so passing the full
+        # experiment URI would nest <uri>/<name>/<name> and strand the
+        # authoritative remote state at its pre-restore content
+        parent = path.rstrip("/").rsplit("/", 1)[0] if is_uri(path) \
+            else os.path.dirname(local.rstrip(os.sep))
+        run_cfg = RunConfig(name=name, storage_path=parent,
+                            stop=saved.get("stop") or None)
+        tuner = cls(trainable,
+                    param_space=None,  # configs come from saved trials
+                    tune_config=TuneConfig(
+                        metric=saved.get("metric"),
+                        mode=saved.get("mode", "max"),
+                        num_samples=saved.get("num_samples", 1),
+                        max_concurrent_trials=saved.get(
+                            "max_concurrent_trials", 4)),
+                    run_config=run_cfg)
+        tuner._restore_state = saved
+        tuner._restore_local_dir = local
+        return tuner
 
     @staticmethod
     def _as_function(trainable: Callable) -> Callable:
@@ -72,11 +129,23 @@ class Tuner:
         scheduler = cfg.scheduler or FIFOScheduler()
         if cfg.metric:
             scheduler.set_metric(cfg.metric, cfg.mode)
+        param_space = self.param_space
+        if self._restore_state is not None and \
+                self._restore_state.get("param_space_blob"):
+            import base64
+
+            import cloudpickle
+            param_space = cloudpickle.loads(base64.b64decode(
+                self._restore_state["param_space_blob"]))
         searcher = cfg.search_alg or BasicVariantGenerator(
-            self.param_space, num_samples=cfg.num_samples,
+            param_space, num_samples=cfg.num_samples,
             metric=cfg.metric, mode=cfg.mode)
         runner = _TrialRunner(self.trainable, searcher, scheduler,
-                              cfg, self.run_config)
+                              cfg, self.run_config,
+                              param_space=param_space,
+                              restore_state=self._restore_state,
+                              storage_override=getattr(
+                                  self, "_restore_local_dir", None))
         trials = runner.run()
         return ResultGrid(trials, cfg.metric, cfg.mode)
 
@@ -101,21 +170,140 @@ class _RunningTrial:
 
 class _TrialRunner:
     def __init__(self, trainable, searcher, scheduler, tune_cfg: TuneConfig,
-                 run_cfg: RunConfig):
+                 run_cfg: RunConfig, *, param_space=None,
+                 restore_state=None, storage_override=None):
+        from .syncer import SyncConfig, Syncer, is_uri, uri_join
         self.trainable = trainable
         self.searcher = searcher
         self.scheduler = scheduler
         self.cfg = tune_cfg
         self.run_cfg = run_cfg
-        self.storage = os.path.join(
-            run_cfg.storage_path or os.path.join(tempfile.gettempdir(),
-                                                 "ray_tpu_results"),
-            run_cfg.name or f"tune_{int(time.time())}")
+        self.param_space = param_space
+        name = run_cfg.name or f"tune_{int(time.time())}"
+        # URI storage: run against a local mirror, sync up on a cadence
+        # (reference: tune/syncer.py Syncer + SyncConfig)
+        self._remote_dir: Optional[str] = None
+        root = run_cfg.storage_path
+        if root and is_uri(root) and not root.startswith("file://"):
+            self._remote_dir = uri_join(root, name)
+            root = os.path.join(tempfile.gettempdir(), "ray_tpu_results")
+        elif root and root.startswith("file://"):
+            self._remote_dir = uri_join(run_cfg.storage_path, name)
+            root = os.path.join(tempfile.gettempdir(), "ray_tpu_results")
+        self.storage = storage_override or os.path.join(
+            root or os.path.join(tempfile.gettempdir(),
+                                 "ray_tpu_results"), name)
         os.makedirs(self.storage, exist_ok=True)
+        self._syncer = Syncer()
+        self._sync_cfg = run_cfg.sync_config or SyncConfig()
+        self._last_sync = 0.0
         self.trials: List[Trial] = []
         self.running: List[_RunningTrial] = []
+        self._resume: List[Trial] = []
         self._fn_blob = dumps_function(self._wrap(trainable))
         self._actor_cls = api.remote(TrainWorker)
+        self._dirty = False
+        if restore_state:
+            if restore_state.get("searcher_blob"):
+                import base64
+
+                import cloudpickle
+                try:
+                    self.searcher = cloudpickle.loads(base64.b64decode(
+                        restore_state["searcher_blob"]))
+                except Exception:
+                    pass  # fall back to the fresh searcher
+            self._seed_from(restore_state)
+
+    # -- experiment state persistence (reference: experiment_state json +
+    # Tuner.restore) --------------------------------------------------------
+    def _seed_from(self, saved: Dict[str, Any]) -> None:
+        import base64
+
+        import cloudpickle
+        for row in saved.get("trials", []):
+            t = Trial(
+                config=cloudpickle.loads(base64.b64decode(row["config"])),
+                trial_id=row["trial_id"])
+            t.status = row["status"]
+            t.last_result = row.get("last_result") or {}
+            t.metrics_history = row.get("metrics_history") or []
+            t.iteration = row.get("iteration", 0)
+            t.error = row.get("error")
+            ckpt = row.get("checkpoint_dir")
+            if ckpt and not os.path.isdir(ckpt):
+                # relocated experiment dir (restore on another machine /
+                # from URI): re-anchor under the restored storage
+                cand = os.path.join(self.storage, t.trial_id,
+                                    os.path.basename(ckpt))
+                ckpt = cand if os.path.isdir(cand) else None
+            t.checkpoint_dir = ckpt
+            self.trials.append(t)
+            if t.status != TERMINATED:
+                # unfinished: relaunch from the last checkpoint
+                t.status = PENDING
+                t.error = None
+                self._resume.append(t)
+
+    def _persist_state(self, force: bool = False) -> None:
+        if not self._dirty and not force:
+            return   # nothing changed since the last write — the poll
+        self._dirty = False   # loop runs sub-second; don't churn disk
+        import base64
+        import json as _json
+
+        import cloudpickle
+        rows = []
+        for t in self.trials:
+            rows.append({
+                "trial_id": t.trial_id,
+                "config": base64.b64encode(
+                    cloudpickle.dumps(t.config)).decode(),
+                "status": t.status,
+                "last_result": t.last_result,
+                "metrics_history": t.metrics_history[-50:],
+                "iteration": t.iteration,
+                "error": t.error,
+                "checkpoint_dir": t.checkpoint_dir,
+            })
+        try:
+            # the searcher IS the sweep's progress (next grid index,
+            # random stream, TPE observations) — persist it whole, like
+            # the reference pickles searcher state for Tuner.restore
+            searcher_blob = base64.b64encode(
+                cloudpickle.dumps(self.searcher)).decode()
+        except Exception:
+            searcher_blob = None
+        state = {
+            "metric": self.cfg.metric, "mode": self.cfg.mode,
+            "num_samples": self.cfg.num_samples,
+            "max_concurrent_trials": self.cfg.max_concurrent_trials,
+            "stop": self.run_cfg.stop,
+            "param_space_blob": base64.b64encode(cloudpickle.dumps(
+                self.param_space)).decode()
+            if self.param_space is not None else None,
+            "searcher_blob": searcher_blob,
+            "trials": rows,
+        }
+        tmp = os.path.join(self.storage, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            _json.dump(state, f, default=str)
+        os.replace(tmp, os.path.join(self.storage,
+                                     "experiment_state.json"))
+        self._maybe_sync()
+
+    def _maybe_sync(self, force: bool = False) -> None:
+        if self._remote_dir is None:
+            return
+        now = time.time()
+        if not force and now - self._last_sync < \
+                self._sync_cfg.sync_period_s:
+            return
+        self._last_sync = now
+        try:
+            self._syncer.sync_up(self.storage, self._remote_dir)
+        except Exception:
+            pass  # sync is best-effort; local state stays authoritative
 
     @staticmethod
     def _wrap(trainable):
@@ -141,11 +329,13 @@ class _TrialRunner:
                 timeout=60.0)
         trial.status = RUNNING
         self.running.append(_RunningTrial(trial, actor))
+        self._dirty = True
 
     def _teardown(self, rt: _RunningTrial, status: str,
                   error: Optional[str] = None) -> None:
         rt.trial.status = status
         rt.trial.error = error
+        self._dirty = True
         try:
             api.kill(rt.actor)
         except Exception:
@@ -163,6 +353,7 @@ class _TrialRunner:
             shutil.rmtree(trial.checkpoint_dir, ignore_errors=True)
         Checkpoint.from_bytes(blob).to_directory(path)
         trial.checkpoint_dir = path
+        self._dirty = True
 
     def _should_stop(self, result: Dict[str, Any]) -> bool:
         stop = self.run_cfg.stop or {}
@@ -182,8 +373,16 @@ class _TrialRunner:
         max_trials = getattr(self.searcher, "total_trials",
                              self.cfg.num_samples)
         while True:
+            # restored unfinished trials first, from their checkpoints
+            while self._resume and \
+                    len(self.running) < self.cfg.max_concurrent_trials:
+                trial = self._resume.pop(0)
+                ckpt = (Checkpoint.from_directory(trial.checkpoint_dir)
+                        if trial.checkpoint_dir else None)
+                self._launch(trial, checkpoint=ckpt)
             # refill to concurrency
-            while len(self.running) < self.cfg.max_concurrent_trials \
+            while not self._resume \
+                    and len(self.running) < self.cfg.max_concurrent_trials \
                     and len(self.trials) < max_trials:
                 # suggest under the trial's OWN id: on_trial_result /
                 # on_trial_complete use trial.trial_id, and model-based
@@ -196,9 +395,12 @@ class _TrialRunner:
                 trial = Trial(config=cfg, trial_id=tid)
                 self.trials.append(trial)
                 self._launch(trial)
-            if not self.running:
+            if not self.running and not self._resume:
                 break
             self._poll()
+            self._persist_state()
+        self._persist_state(force=True)
+        self._maybe_sync(force=True)
         return self.trials
 
     def _poll(self) -> None:
@@ -232,6 +434,7 @@ class _TrialRunner:
         metrics.setdefault("training_iteration", trial.iteration)
         trial.last_result = metrics
         trial.metrics_history.append(metrics)
+        self._dirty = True
         if item.get("checkpoint") is not None:
             self._save_checkpoint(trial, item["checkpoint"])
         self.searcher.on_trial_result(trial.trial_id, metrics)
