@@ -53,11 +53,29 @@ class StandardAutoscaler:
     def _nodes_to_launch(self, alive: List[Dict[str, Any]]
                          ) -> Dict[str, int]:
         """Bin-pack outstanding demand bundles onto existing free capacity;
-        whatever doesn't fit maps to new nodes by type."""
+        whatever doesn't fit maps to new nodes by type.  Demand =
+        explicit `request_resources` bundles + the waiting lease
+        requests every nodelet reports in its heartbeat (the reference's
+        ResourceDemandScheduler load signal) — so queued-but-unplaceable
+        tasks drive scale-up without any user hint."""
         free = [dict(n.get("avail", {})) for n in alive]
         launch: Dict[str, int] = {}
+        # Provider nodes LAUNCHED but not yet alive in the cluster view
+        # are capacity in flight: count them, or the same demand bundle
+        # re-launches a node every tick until the first one boots (real
+        # VMs take minutes) and the fleet balloons to max_workers.
+        alive_ids = {n.get("id") for n in alive}
         pending_caps: List[Dict[str, float]] = []
-        for bundle in list(_pending_requests):
+        for pid in self.provider.non_terminated_nodes():
+            if pid in alive_ids:
+                continue
+            ntype = getattr(self.provider, "node_type_of",
+                            lambda _: None)(pid)
+            if ntype is not None:
+                pending_caps.append(self.provider.node_resources(ntype))
+        reported = [dict(b) for n in alive
+                    for b in (n.get("demand") or [])]
+        for bundle in list(_pending_requests) + reported:
             placed = False
             for cap in free + pending_caps:
                 if self._fits(bundle, cap):

@@ -80,6 +80,9 @@ class NodeRecord:
         self.view = view
         self.conn = conn
         self.last_heartbeat = time.monotonic()
+        # resource bundles of lease requests WAITING on this node
+        # (heartbeat-reported); the autoscaler's load signal
+        self.demand: List[Dict[str, float]] = []
 
 
 class Controller:
@@ -318,6 +321,7 @@ class Controller:
         if rec is None:
             return {"unknown_node": True}
         rec.last_heartbeat = time.monotonic()
+        rec.demand = data.get("demand") or []
         new_avail = ResourceSet(data["available"])
         new_total = ResourceSet(data["total"])
         if (new_avail.to_dict() != rec.view.available.to_dict()
@@ -341,7 +345,10 @@ class Controller:
                 "view_version": self.view_version}
 
     async def _h_list_nodes(self, conn, data):
-        return [v.to_wire() for v in self._views().values()]
+        # demand rides the node ROWS, not the synced views — it churns
+        # every heartbeat and would bloat the versioned delta stream
+        return [{**rec.view.to_wire(), "demand": rec.demand}
+                for rec in self.nodes.values()]
 
     async def _h_drain_node(self, conn, data):
         await self._mark_node_dead(data["node_id"], "drained")

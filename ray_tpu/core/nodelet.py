@@ -105,6 +105,11 @@ class Nodelet:
         self._spawns_inflight = 0
         # short node tag for the runtime self-metrics battery
         self._mnode = {"node": self.node_id.hex()[:12]}
+        # resource bundles of lease requests currently WAITING here —
+        # heartbeat-reported to the controller as the autoscaler's load
+        # signal (reference: ResourceDemandScheduler's pending demand)
+        self._demand_tokens: Dict[int, Dict[str, float]] = {}
+        self._demand_seq = 0
         self.zygote: Optional[worker_zygote.ZygoteClient] = None
         self._stopping = False
         self._register_handlers()
@@ -254,6 +259,8 @@ class Nodelet:
                     "available": self.available.to_dict(),
                     "total": self.total.to_dict(),
                     "view_version": self.view_version,
+                    "demand":
+                        list(self._demand_tokens.values())[:64],
                 }, timeout=5)
                 if reply and "view" in reply:
                     self._apply_view(reply["view"], reply["view_version"])
@@ -586,10 +593,14 @@ class Nodelet:
                                                GlobalConfig.lease_request_timeout_s)
         my_id = self.node_id.hex()
         self._lease_waiters += 1
+        self._demand_seq += 1
+        tok = self._demand_seq
+        self._demand_tokens[tok] = request.to_dict()
         try:
             return await self._lease_inner(spec, request, strategy, deadline, my_id)
         finally:
             self._lease_waiters -= 1
+            self._demand_tokens.pop(tok, None)
 
     async def _lease_inner(self, spec, request, strategy, deadline, my_id):
         while True:

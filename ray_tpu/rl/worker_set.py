@@ -12,6 +12,7 @@ from ..core.serialization import dumps_function
 class WorkerSet:
     def __init__(self, config):
         from .rollout_worker import RolloutWorker
+        self._config = config
         blob = dumps_function(config)
         cls = api.remote(RolloutWorker)
         self._workers = [cls.options(num_cpus=1.0).remote(blob, i)
@@ -19,8 +20,13 @@ class WorkerSet:
 
     def sample(self, weights) -> List[Dict[str, Any]]:
         ref = api.put(weights)  # broadcast once through the object store
+        # timeout from config: rollout length is env-dependent (long
+        # horizons legitimately exceed any fixed guess), so default
+        # unbounded; configs may set sample_timeout_s to also catch
+        # wedged-but-alive workers (dead ones surface via actor death)
         return api.get([w.sample.remote(ref) for w in self._workers],
-                       timeout=600.0)
+                       timeout=getattr(self._config,
+                                       "sample_timeout_s", None))
 
     def num_workers(self) -> int:
         return len(self._workers)

@@ -9,7 +9,14 @@ from .. import api
 
 
 class ActorPool:
-    def __init__(self, actors: List[Any]):
+    def __init__(self, actors: List[Any], *,
+                 task_timeout_s: float = None):
+        """``task_timeout_s``: optional per-task wall-clock bound.  The
+        default is unbounded — pool tasks are arbitrary user work (a
+        train step can legitimately run for hours) and dead actors
+        surface through the actor-death path; set a bound to also catch
+        wedged-but-alive workers (e.g. a hung device op)."""
+        self._task_timeout_s = task_timeout_s
         self._idle = list(actors)
         self._future_to_actor = {}
         self._pending = []          # ordered (index, ref)
@@ -36,7 +43,7 @@ class ActorPool:
     def _collect(self, ref) -> Any:
         actor = self._future_to_actor.pop(ref)
         self._idle.append(actor)
-        return api.get(ref, timeout=600.0)
+        return api.get(ref, timeout=self._task_timeout_s)
 
     def get_next(self, timeout: float = None) -> Any:
         """Next result in submission order."""

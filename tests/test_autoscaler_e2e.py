@@ -1,0 +1,79 @@
+"""Autoscaler end-to-end: load -> scale-up -> a REAL nodelet joins ->
+demand drains -> idle scale-down (reference:
+tests/test_autoscaler_fake_multinode.py driving fake_multi_node's
+provider through the actual control plane)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def small_cluster():
+    c = Cluster()
+    c.add_node(num_cpus=1, object_store_memory=96 * 1024 * 1024)
+    c.connect()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_load_scales_up_then_down(small_cluster):
+    provider = LocalNodeProvider(
+        small_cluster.session_dir, small_cluster.controller_addr,
+        node_types={"cpu_worker": {"CPU": 2.0}},
+        object_store_memory=96 * 1024 * 1024)
+    autoscaler = StandardAutoscaler(provider, max_workers=2,
+                                    idle_timeout_s=3.0)
+    stop = threading.Event()
+    monitor = threading.Thread(
+        target=autoscaler.run, kwargs={"interval_s": 0.5,
+                                       "stop_event": stop}, daemon=True)
+    monitor.start()
+    try:
+        @ray_tpu.remote(num_cpus=2)
+        def big(x):
+            return x * 2
+
+        # Needs 2 CPUs; the only node has 1.  The lease pends, the
+        # nodelet heartbeats the unmet demand, the autoscaler launches
+        # a real 2-CPU nodelet, the lease spills there and completes.
+        t0 = time.monotonic()
+        assert ray_tpu.get(big.remote(21), timeout=25.0) == 42
+        dt = time.monotonic() - t0
+        assert provider.non_terminated_nodes(), \
+            "task finished but no provider node was launched?!"
+        launched_node = provider.non_terminated_nodes()[0]
+        rows = {n["id"]: n for n in state.list_nodes()}
+        assert rows[launched_node]["alive"], "launched nodelet not alive"
+        print(f"\n[autoscaler] scale-up + task completion in {dt:.1f}s")
+
+        # demand drained -> the worker node idles -> terminated
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and \
+                provider.non_terminated_nodes():
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes(), \
+            "idle worker node was never scaled down"
+        # the controller notices the drained node dying
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            rows = {n["id"]: n for n in state.list_nodes()}
+            if not rows.get(launched_node, {}).get("alive", False):
+                break
+            time.sleep(0.5)
+        assert not rows.get(launched_node, {}).get("alive", True) or \
+            not state.list_nodes(), "dead node still marked alive"
+        print("[autoscaler] idle scale-down confirmed")
+    finally:
+        stop.set()
+        monitor.join(timeout=5)
+        for nid in provider.non_terminated_nodes():
+            provider.terminate_node(nid)
