@@ -30,7 +30,23 @@ from .multi_agent import (  # noqa: F401
     MultiAgentJaxEnv,
     SpreadLine,
 )
+from .catalog import build_policy, register_custom_model  # noqa: F401
+from .connectors import (  # noqa: F401
+    ClipActions,
+    ClipReward,
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    ObsNormalizer,
+    UnsquashActions,
+)
 from .ddppo import DDPPO, DDPPOConfig  # noqa: F401
+from .exploration import (  # noqa: F401
+    EpsilonGreedy,
+    GaussianActionNoise,
+    OrnsteinUhlenbeckNoise,
+    StochasticSampling,
+)
 from .policy import MLPPolicy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401

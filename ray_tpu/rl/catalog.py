@@ -1,0 +1,54 @@
+"""Model catalog: build policy networks from env spaces + model config.
+
+Capability mirror of the reference's `rllib/models/catalog.py:1`
+(ModelCatalog.get_model_v2 — space-driven model construction plus a
+custom-model registry).  The native policy family is pure-JAX
+(`policy.py` MLPPolicy); the catalog maps an env's observation/action
+space and a ``model`` config dict onto it, applies connector-driven
+observation resizing, and lets users register custom policy classes by
+name — the `register_custom_model` flow."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .env import JaxEnv
+from .policy import MLPPolicy
+
+_CUSTOM_MODELS: Dict[str, Callable[..., Any]] = {}
+
+DEFAULT_MODEL: Dict[str, Any] = {
+    "hidden": (64, 64),
+    "custom_model": None,
+    "custom_model_config": {},
+}
+
+
+def register_custom_model(name: str, factory: Callable[..., Any]) -> None:
+    """factory(obs_size, action_size, discrete=..., **custom_config) ->
+    policy object with the MLPPolicy interface (init/forward/
+    sample_action/log_prob)."""
+    _CUSTOM_MODELS[name] = factory
+
+
+def build_policy(env: JaxEnv, model: Optional[Dict[str, Any]] = None,
+                 obs_size_override: Optional[int] = None):
+    """Policy for an env's spaces (reference: get_model_v2).
+
+    ``obs_size_override``: observation size AFTER the agent connector
+    pipeline (e.g. FrameStack multiplies it) — pass
+    ``pipeline.out_size(env.observation_size)``."""
+    cfg = dict(DEFAULT_MODEL)
+    cfg.update(model or {})
+    obs_size = obs_size_override or env.observation_size
+    custom = cfg.get("custom_model")
+    if custom:
+        if custom not in _CUSTOM_MODELS:
+            raise ValueError(
+                f"custom model {custom!r} not registered "
+                f"(known: {sorted(_CUSTOM_MODELS)})")
+        return _CUSTOM_MODELS[custom](
+            obs_size, env.action_size, discrete=env.discrete,
+            **cfg.get("custom_model_config", {}))
+    return MLPPolicy(obs_size, env.action_size, discrete=env.discrete,
+                     hidden=tuple(cfg["hidden"]))

@@ -1,0 +1,206 @@
+"""Connectors: composable obs/action transform pipelines.
+
+Capability mirror of the reference's connector framework
+(`rllib/connectors/connector.py`, `agent/obs_preproc.py`,
+`action/clip.py` — pluggable transforms between env and policy,
+checkpointable with the policy).  Redesigned for the TPU rollout model:
+a connector here is a PURE function pair — ``init_state()`` builds a
+pytree, ``__call__(state, x) -> (state, x)`` is jit-traceable — so the
+whole pipeline composes INTO the `lax.scan` rollout instead of running
+as a per-step Python loop beside it.  State (running moments, stacked
+frames) is carried functionally through the scan like env state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+State = Any
+
+
+class Connector:
+    """One transform.  Stateless connectors return () from init_state.
+
+    ``kind`` declares what the transform applies to — "obs", "action",
+    or "reward" — so configs can validate placement (an action clipper
+    in an obs pipeline would silently distort observations otherwise).
+    ``reset_on_done`` marks state that must clear at episode boundaries
+    (FrameStack's ring) vs state that must persist across them
+    (ObsNormalizer's running moments)."""
+
+    kind = "obs"
+    reset_on_done = False
+
+    def init_state(self) -> State:
+        return ()
+
+    def __call__(self, state: State, x: jnp.ndarray
+                 ) -> Tuple[State, jnp.ndarray]:
+        raise NotImplementedError
+
+    def out_size(self, in_size: int) -> int:
+        """Observation size after this transform (for model building)."""
+        return in_size
+
+
+class ObsNormalizer(Connector):
+    """Running mean/std normalization (reference:
+    `rllib/connectors/agent/mean_std_filter.py`): Welford moments carried
+    as pipeline state, updated online inside the rollout scan."""
+
+    def __init__(self, size: int, clip: float = 10.0,
+                 epsilon: float = 1e-8):
+        self.size = size
+        self.clip = clip
+        self.epsilon = epsilon
+
+    def init_state(self) -> State:
+        return {"mean": jnp.zeros((self.size,)),
+                "m2": jnp.ones((self.size,)),
+                "count": jnp.ones(())}
+
+    def __call__(self, state, x):
+        # batched Welford update over the leading axis
+        batch = x.reshape((-1, self.size))
+        n = batch.shape[0]
+        b_mean = batch.mean(axis=0)
+        b_var = batch.var(axis=0)
+        count = state["count"] + n
+        delta = b_mean - state["mean"]
+        mean = state["mean"] + delta * n / count
+        m2 = state["m2"] + b_var * n + \
+            delta ** 2 * state["count"] * n / count
+        new = {"mean": mean, "m2": m2, "count": count}
+        std = jnp.sqrt(m2 / count + self.epsilon)
+        out = jnp.clip((x - mean) / std, -self.clip, self.clip)
+        return new, out
+
+
+class FrameStack(Connector):
+    """Stack the last k observations (reference: Atari framestacking in
+    the connector/preprocessor stack); the ring lives in pipeline state."""
+
+    reset_on_done = True   # a fresh episode must not see dead frames
+
+    def __init__(self, size: int, k: int = 4):
+        self.size = size
+        self.k = k
+
+    def init_state(self) -> State:
+        return jnp.zeros((self.k, self.size))
+
+    def __call__(self, state, x):
+        # x: [..., size]; state: [k, size] per logical stream — for
+        # vectorized envs wrap the pipeline in vmap (see make_pipeline)
+        new = jnp.concatenate([state[1:], x[None, :]], axis=0)
+        return new, new.reshape(-1)
+
+    def out_size(self, in_size: int) -> int:
+        return in_size * self.k
+
+
+class ClipReward(Connector):
+    """Reward clipping (reference: `rllib/connectors/agent/clip.py`)."""
+
+    kind = "reward"
+
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, state, x):
+        return state, jnp.clip(x, self.low, self.high)
+
+
+class ClipActions(Connector):
+    """Clip continuous actions into the env bound (reference:
+    `rllib/connectors/action/clip.py`)."""
+
+    kind = "action"
+
+    def __init__(self, high: float = 1.0):
+        self.high = high
+
+    def __call__(self, state, x):
+        return state, jnp.clip(x, -self.high, self.high)
+
+
+class UnsquashActions(Connector):
+    """Map tanh-squashed [-1, 1] policy outputs onto the env's action
+    interval (reference: `rllib/connectors/action/normalize.py`)."""
+
+    kind = "action"
+
+    def __init__(self, high: float = 1.0):
+        self.high = high
+
+    def __call__(self, state, x):
+        return state, jnp.tanh(x) * self.high
+
+
+class ConnectorPipeline:
+    """Ordered composition; state is the tuple of member states
+    (reference: ConnectorPipeline v2).  Jit/scan-safe."""
+
+    def __init__(self, connectors: Sequence[Connector]):
+        self.connectors = list(connectors)
+
+    def init_state(self) -> Tuple:
+        return tuple(c.init_state() for c in self.connectors)
+
+    def __call__(self, state: Tuple, x: jnp.ndarray
+                 ) -> Tuple[Tuple, jnp.ndarray]:
+        new_states: List[State] = []
+        for c, s in zip(self.connectors, state):
+            s, x = c(s, x)
+            new_states.append(s)
+        return tuple(new_states), x
+
+    def out_size(self, in_size: int) -> int:
+        for c in self.connectors:
+            in_size = c.out_size(in_size)
+        return in_size
+
+    def validate_kind(self, kind: str, where: str) -> "ConnectorPipeline":
+        bad = [type(c).__name__ for c in self.connectors
+               if c.kind != kind]
+        if bad:
+            raise ValueError(
+                f"{where} accepts only {kind!r} connectors; {bad} "
+                f"belong in the "
+                f"{'action_connectors' if kind == 'obs' else 'connectors'}"
+                " list")
+        return self
+
+    def reset_where(self, state: Tuple, done: jnp.ndarray) -> Tuple:
+        """Reset per-env state slices where ``done`` — only for members
+        with ``reset_on_done`` (FrameStack rings clear at episode
+        boundaries; ObsNormalizer moments persist).  ``state`` leaves
+        carry a leading [num_envs] axis (init_state_batch layout)."""
+        out = []
+        for c, s in zip(self.connectors, state):
+            if not c.reset_on_done:
+                out.append(s)
+                continue
+            init = c.init_state()
+
+            def mask(leaf, init_leaf):
+                d = done.reshape((-1,) + (1,) * (leaf.ndim - 1))
+                return jnp.where(d.astype(bool), init_leaf, leaf)
+
+            out.append(jax.tree_util.tree_map(mask, s, init))
+        return tuple(out)
+
+    def vmapped(self, num_envs: int):
+        """(states, batch_x) -> (states, batch_y) over vectorized envs;
+        use inside rollout scans.  init via init_state_batch."""
+        fn = jax.vmap(self.__call__)
+        return fn
+
+    def init_state_batch(self, num_envs: int) -> Tuple:
+        return jax.tree_util.tree_map(
+            lambda s: jnp.broadcast_to(s, (num_envs,) + s.shape)
+            if hasattr(s, "shape") else s,
+            self.init_state())

@@ -98,8 +98,8 @@ class DDPPO(Algorithm):
 
         def body(params, opt_state, env_states, obs, keys):
             key = keys[0]
-            traj, env_states, obs, last_value, key = rollout(
-                params, env_states, obs, key)
+            traj, env_states, obs, _, last_value, key = rollout(
+                params, env_states, obs, (), key)
             adv, ret = compute_gae(traj, last_value, cfg.gamma,
                                    cfg.gae_lambda)
             flat = {

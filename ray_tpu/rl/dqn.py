@@ -108,23 +108,18 @@ class DQN(Algorithm):
         env, q, opt = self.env, self.q, self.optimizer
         insert_bs = cfg.num_envs  # one buffer insert per scanned env step
 
-        def epsilon(total_steps):
-            frac = jnp.clip(total_steps / cfg.eps_decay_steps, 0.0, 1.0)
-            return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+        from .exploration import EpsilonGreedy
+        explorer = EpsilonGreedy(cfg.eps_start, cfg.eps_end,
+                                 cfg.eps_decay_steps)
 
         def train_iter(params, target_params, opt_state, buffer,
                        env_states, obs, key, total_steps):
-            eps = epsilon(total_steps)
 
             def collect(carry, _):
                 buffer, env_states, obs, key = carry
-                key, akey, gkey, skey = jax.random.split(key, 4)
+                key, akey, skey = jax.random.split(key, 3)
                 qvals = q.apply(params, obs)                  # [B, A]
-                greedy = jnp.argmax(qvals, axis=-1)
-                rand = jax.random.randint(akey, greedy.shape, 0,
-                                          env.action_size)
-                explore = jax.random.uniform(gkey, greedy.shape) < eps
-                action = jnp.where(explore, rand, greedy)
+                _, action = explorer((), akey, qvals, total_steps)
                 skeys = jax.random.split(skey, cfg.num_envs)
                 env_states, next_obs, reward, done = jax.vmap(env.step)(
                     env_states, action, skeys)

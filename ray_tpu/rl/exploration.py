@@ -1,0 +1,116 @@
+"""Exploration strategies as pure jittable functions.
+
+Capability mirror of the reference's exploration module zoo
+(`rllib/utils/exploration/epsilon_greedy.py`, `ornstein_uhlenbeck.py`,
+`gaussian_noise.py`, `stochastic_sampling.py`).  Each strategy is a
+(schedule, state-transition) pair with no Python-side mutation: state is
+a pytree threaded through the rollout scan, timestep-dependent schedules
+are closed-form so the whole anneal traces into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+State = Any
+
+
+class EpsilonGreedy:
+    """Annealed epsilon-greedy over Q-values/logits (reference:
+    epsilon_greedy.py PiecewiseSchedule)."""
+
+    def __init__(self, eps_start: float = 1.0, eps_end: float = 0.05,
+                 decay_steps: int = 20_000):
+        self.eps_start = eps_start
+        self.eps_end = eps_end
+        self.decay_steps = decay_steps
+
+    def epsilon(self, timestep: jnp.ndarray) -> jnp.ndarray:
+        frac = jnp.clip(timestep / self.decay_steps, 0.0, 1.0)
+        return self.eps_start + frac * (self.eps_end - self.eps_start)
+
+    def init_state(self) -> State:
+        return ()
+
+    def __call__(self, state: State, key: jax.Array, qvals: jnp.ndarray,
+                 timestep: jnp.ndarray) -> Tuple[State, jnp.ndarray]:
+        """qvals: [..., actions] -> (state, action)."""
+        k_choice, k_rand = jax.random.split(key)
+        greedy = jnp.argmax(qvals, axis=-1)
+        random = jax.random.randint(k_rand, greedy.shape, 0,
+                                    qvals.shape[-1])
+        explore = jax.random.uniform(k_choice, greedy.shape) < \
+            self.epsilon(timestep)
+        return state, jnp.where(explore, random, greedy)
+
+
+class GaussianActionNoise:
+    """Additive annealed Gaussian noise on continuous actions
+    (reference: gaussian_noise.py)."""
+
+    def __init__(self, scale_start: float = 0.3, scale_end: float = 0.05,
+                 decay_steps: int = 20_000, clip: float = 1.0):
+        self.scale_start = scale_start
+        self.scale_end = scale_end
+        self.decay_steps = decay_steps
+        self.clip = clip
+
+    def scale(self, timestep: jnp.ndarray) -> jnp.ndarray:
+        frac = jnp.clip(timestep / self.decay_steps, 0.0, 1.0)
+        return self.scale_start + frac * (self.scale_end -
+                                          self.scale_start)
+
+    def init_state(self) -> State:
+        return ()
+
+    def __call__(self, state, key, action, timestep):
+        noise = jax.random.normal(key, action.shape) * \
+            self.scale(timestep)
+        return state, jnp.clip(action + noise, -self.clip, self.clip)
+
+
+class OrnsteinUhlenbeckNoise:
+    """Temporally-correlated OU noise for continuous control
+    (reference: ornstein_uhlenbeck.py); the OU process state rides the
+    rollout scan."""
+
+    def __init__(self, action_size: int, theta: float = 0.15,
+                 sigma: float = 0.2, dt: float = 1e-2, clip: float = 1.0):
+        self.action_size = action_size
+        self.theta = theta
+        self.sigma = sigma
+        self.dt = dt
+        self.clip = clip
+
+    def init_state(self) -> State:
+        return jnp.zeros((self.action_size,))
+
+    def __call__(self, state, key, action, timestep):
+        noise = state + self.theta * (-state) * self.dt + \
+            self.sigma * jnp.sqrt(self.dt) * \
+            jax.random.normal(key, state.shape)
+        return noise, jnp.clip(action + noise, -self.clip, self.clip)
+
+
+class StochasticSampling:
+    """Sample from the policy distribution itself — the default for
+    PG-family algorithms (reference: stochastic_sampling.py).
+    ``discrete=True``: input is logits, output a categorical sample;
+    ``discrete=False``: input is an already-sampled continuous action,
+    passed through unchanged.  The space is DECLARED, not guessed —
+    both inputs are float arrays, so a dtype heuristic would silently
+    turn continuous actions into categorical indices."""
+
+    def __init__(self, discrete: bool = True):
+        self.discrete = discrete
+
+    def init_state(self) -> State:
+        return ()
+
+    def __call__(self, state, key, logits_or_action, timestep):
+        if self.discrete:
+            return state, jax.random.categorical(key, logits_or_action)
+        return state, logits_or_action

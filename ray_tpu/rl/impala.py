@@ -109,8 +109,9 @@ class TrajectoryWorker:
 
     def sample(self, weights) -> Dict[str, Any]:
         self.params = self.policy.set_weights(self.params, weights)
-        traj, self.env_states, self.obs, last_value, self.key = \
-            self._rollout(self.params, self.env_states, self.obs, self.key)
+        traj, self.env_states, self.obs, _, last_value, self.key = \
+            self._rollout(self.params, self.env_states, self.obs, (),
+                          self.key)
         rewards = np.asarray(traj["reward"])
         dones = np.asarray(traj["done"])
         for t in range(rewards.shape[0]):
@@ -242,9 +243,9 @@ class Impala(Algorithm):
                 self._arm(i)  # re-arm immediately with fresh weights
             env_steps = learned * cfg.num_envs * cfg.rollout_length
         else:
-            traj, self.env_states, self.obs, last_value, self.key = \
+            traj, self.env_states, self.obs, _, last_value, self.key = \
                 self._rollout(self.params, self.env_states, self.obs,
-                              self.key)
+                              (), self.key)
             self._track_episodes(np.asarray(traj["reward"]),
                                  np.asarray(traj["done"]))
             batch = {"obs": traj["obs"], "action": traj["action"],

@@ -22,7 +22,6 @@ import optax
 from .algorithm import Algorithm
 from .env import JaxEnv
 from .policy import MLPPolicy
-from .ppo import make_rollout_fn
 
 
 # ------------------------------------------------------------------ datasets

@@ -43,34 +43,80 @@ class PPOConfig:
     max_grad_norm: float = 0.5
     hidden: tuple = (64, 64)
     seed: int = 0
+    # model config dict consumed by rl.catalog (custom_model etc.);
+    # None → {"hidden": hidden}
+    model: Optional[dict] = None
+    # agent connectors (rl.connectors, kind="obs") applied to
+    # observations INSIDE the jitted rollout scan; state rides the carry
+    connectors: Optional[list] = None
+    # action connectors (kind="action"): transform what the env
+    # receives; the stored action stays the policy output
+    action_connectors: Optional[list] = None
+    # reward connectors (kind="reward"): transform stored rewards
+    reward_connectors: Optional[list] = None
 
     def build(self) -> "PPO":
         return PPO(self)
 
 
 def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
-                    rollout_length: int):
-    """Jittable: (params, env_states, key) → (batch, env_states, stats)."""
+                    rollout_length: int, pipeline=None,
+                    action_pipeline=None, reward_pipeline=None):
+    """Jittable rollout: ``(params, env_states, obs, conn_state, key) ->
+    (traj, env_states, last_obs, conn_state, last_value, key)``.
 
-    def rollout(params, env_states, obs, key):
+    ONE implementation for every caller: with no connectors the obs
+    transform is the identity and ``conn_state`` is ``()`` — zero cost
+    under jit.  Obs connectors run inside the scan (the trajectory
+    stores the PROCESSED observations the policy saw, so SGD log_prob
+    matches) and reset per-env at episode boundaries for members marked
+    ``reset_on_done``.  Action connectors transform what the ENV
+    receives while the stored action stays the policy's own output
+    (log_prob consistency — the reference's action-connector contract);
+    reward connectors transform stored rewards."""
+    has_conn = pipeline is not None and pipeline.connectors
+    apply_conn = jax.vmap(pipeline) if has_conn else (lambda s, x: (s, x))
+
+    def to_env_action(a):
+        if action_pipeline is not None:
+            for c in action_pipeline.connectors:
+                _, a = c((), a)   # stateless, elementwise: no vmap needed
+        return a
+
+    def to_stored_reward(r):
+        if reward_pipeline is not None:
+            for c in reward_pipeline.connectors:
+                _, r = c((), r)
+        return r
+
+    def rollout(params, env_states, obs, conn_state, key):
         def step(carry, _):
-            env_states, obs, key = carry
+            env_states, obs, conn_state, key = carry
             key, akey, skey = jax.random.split(key, 3)
+            conn_state, pobs = apply_conn(conn_state, obs)
             akeys = jax.random.split(akey, num_envs)
             actions, logps, values = jax.vmap(
-                lambda o, k: policy.sample_action(params, o, k))(obs, akeys)
+                lambda o, k: policy.sample_action(params, o, k))(pobs,
+                                                                 akeys)
             skeys = jax.random.split(skey, num_envs)
             env_states, next_obs, rewards, dones = jax.vmap(env.step)(
-                env_states, actions, skeys)
-            frame = {"obs": obs, "action": actions, "logp": logps,
-                     "value": values, "reward": rewards, "done": dones}
-            return (env_states, next_obs, key), frame
+                env_states, to_env_action(actions), skeys)
+            if has_conn:
+                conn_state = pipeline.reset_where(conn_state, dones)
+            frame = {"obs": pobs, "action": actions, "logp": logps,
+                     "value": values, "reward": to_stored_reward(rewards),
+                     "done": dones}
+            return (env_states, next_obs, conn_state, key), frame
 
-        (env_states, last_obs, key), traj = jax.lax.scan(
-            step, (env_states, obs, key), None, length=rollout_length)
+        (env_states, last_obs, conn_state, key), traj = jax.lax.scan(
+            step, (env_states, obs, conn_state, key), None,
+            length=rollout_length)
+        # bootstrap value on the processed view WITHOUT advancing the
+        # connector state a second time for the same frame
+        _, plast = apply_conn(conn_state, last_obs)
         _, last_value = jax.vmap(lambda o: policy.forward(params, o))(
-            last_obs)
-        return traj, env_states, last_obs, last_value, key
+            plast)
+        return traj, env_states, last_obs, conn_state, last_value, key
 
     return rollout
 
@@ -163,10 +209,20 @@ class PPO(Algorithm):
         if cfg.env is None:
             raise ValueError("PPOConfig.env required (an env factory)")
         self.env = cfg.env()
-        self.policy = MLPPolicy(self.env.observation_size,
-                                self.env.action_size,
-                                discrete=self.env.discrete,
-                                hidden=cfg.hidden)
+        from .catalog import build_policy
+        from .connectors import ConnectorPipeline
+        self.pipeline = ConnectorPipeline(cfg.connectors or []) \
+            .validate_kind("obs", "PPOConfig.connectors")
+        self._action_pipe = ConnectorPipeline(
+            cfg.action_connectors or []).validate_kind(
+                "action", "PPOConfig.action_connectors")
+        self._reward_pipe = ConnectorPipeline(
+            cfg.reward_connectors or []).validate_kind(
+                "reward", "PPOConfig.reward_connectors")
+        self.policy = build_policy(
+            self.env, cfg.model or {"hidden": cfg.hidden},
+            obs_size_override=self.pipeline.out_size(
+                self.env.observation_size))
         key = jax.random.PRNGKey(cfg.seed)
         key, pkey, ekey = jax.random.split(key, 3)
         self.params = self.policy.init(pkey)
@@ -177,8 +233,11 @@ class PPO(Algorithm):
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         self.key = key
-        self._rollout = make_rollout_fn(self.env, self.policy,
-                                        cfg.num_envs, cfg.rollout_length)
+        self.conn_state = self.pipeline.init_state_batch(cfg.num_envs)
+        self._rollout = make_rollout_fn(
+            self.env, self.policy, cfg.num_envs, cfg.rollout_length,
+            pipeline=self.pipeline, action_pipeline=self._action_pipe,
+            reward_pipeline=self._reward_pipe)
         self._train_iter = jax.jit(self._make_train_iter())
         self._workers = None
         if cfg.num_workers > 0:
@@ -196,9 +255,11 @@ class PPO(Algorithm):
         batch_size = cfg.num_envs * cfg.rollout_length
         update = self._make_update_fn(batch_size)
 
-        def train_iter(params, opt_state, env_states, obs, key):
-            traj, env_states, obs, last_value, key = self._rollout(
-                params, env_states, obs, key)
+        def train_iter(params, opt_state, env_states, obs, conn_state,
+                       key):
+            (traj, env_states, obs, conn_state, last_value,
+             key) = self._rollout(params, env_states, obs, conn_state,
+                                  key)
             adv, ret = compute_gae(traj, last_value, cfg.gamma,
                                    cfg.gae_lambda)
             flat = {
@@ -213,8 +274,8 @@ class PPO(Algorithm):
             params, opt_state, key, metrics = update(
                 params, opt_state, flat, key)
             metrics["reward_sum"] = traj["reward"].sum()
-            return params, opt_state, env_states, obs, key, metrics, \
-                traj["reward"], traj["done"]
+            return params, opt_state, env_states, obs, conn_state, key, \
+                metrics, traj["reward"], traj["done"]
 
         return train_iter
 
@@ -230,9 +291,10 @@ class PPO(Algorithm):
             env_steps = cfg.num_workers * cfg.num_envs * cfg.rollout_length
         else:
             (self.params, self.opt_state, self.env_states, self.obs,
-             self.key, metrics, rewards, dones) = self._train_iter(
+             self.conn_state, self.key, metrics, rewards,
+             dones) = self._train_iter(
                 self.params, self.opt_state, self.env_states, self.obs,
-                self.key)
+                self.conn_state, self.key)
             env_steps = cfg.num_envs * cfg.rollout_length
             self._track_episodes(np.asarray(rewards), np.asarray(dones))
             metrics = {k: float(v) for k, v in metrics.items()}
@@ -263,9 +325,17 @@ class PPO(Algorithm):
 
     # -- checkpointing ------------------------------------------------------
     def get_state(self) -> Dict[str, Any]:
+        # connector state ships with the policy (reference: connectors
+        # are checkpointed with it) — a restored ObsNormalizer without
+        # its moments would feed the policy unnormalized obs
         return {"params": self.policy.get_weights(self.params),
+                "conn_state": jax.tree_util.tree_map(
+                    np.asarray, self.conn_state),
                 "iteration": self.iteration}
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.params = self.policy.set_weights(self.params, state["params"])
+        if state.get("conn_state") is not None:
+            self.conn_state = jax.tree_util.tree_map(
+                jnp.asarray, state["conn_state"])
         self.iteration = state.get("iteration", 0)

@@ -19,26 +19,40 @@ class RolloutWorker:
         import jax
 
         from ..core.serialization import loads_function
-        from .policy import MLPPolicy
+        from .catalog import build_policy
+        from .connectors import ConnectorPipeline
         from .ppo import compute_gae, make_rollout_fn
         cfg = loads_function(config_blob)
         self.cfg = cfg
         self.env = cfg.env()
-        self.policy = MLPPolicy(self.env.observation_size,
-                                self.env.action_size,
-                                discrete=self.env.discrete,
-                                hidden=cfg.hidden)
+        # SAME model + connector wiring as the driver-side algorithm —
+        # a worker with a raw MLP while the driver trains a catalog
+        # model (or processed obs) would diverge or crash on weights
+        pipeline = ConnectorPipeline(
+            getattr(cfg, "connectors", None) or [])
+        action_pipe = ConnectorPipeline(
+            getattr(cfg, "action_connectors", None) or [])
+        reward_pipe = ConnectorPipeline(
+            getattr(cfg, "reward_connectors", None) or [])
+        self.policy = build_policy(
+            self.env, getattr(cfg, "model", None) or
+            {"hidden": cfg.hidden},
+            obs_size_override=pipeline.out_size(
+                self.env.observation_size))
         key = jax.random.PRNGKey(cfg.seed + 1000 * (worker_index + 1))
         self.key, ekey, pkey = jax.random.split(key, 3)
         self.params = self.policy.init(pkey)
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.conn_state = pipeline.init_state_batch(cfg.num_envs)
         rollout = make_rollout_fn(self.env, self.policy, cfg.num_envs,
-                                  cfg.rollout_length)
+                                  cfg.rollout_length, pipeline=pipeline,
+                                  action_pipeline=action_pipe,
+                                  reward_pipeline=reward_pipe)
 
-        def sample_fn(params, env_states, obs, key):
-            traj, env_states, obs, last_value, key = rollout(
-                params, env_states, obs, key)
+        def sample_fn(params, env_states, obs, conn_state, key):
+            traj, env_states, obs, conn_state, last_value, key = rollout(
+                params, env_states, obs, conn_state, key)
             adv, ret = compute_gae(traj, last_value, cfg.gamma,
                                    cfg.gae_lambda)
             bs = cfg.num_envs * cfg.rollout_length
@@ -50,7 +64,8 @@ class RolloutWorker:
                 "adv": adv.reshape(bs),
                 "ret": ret.reshape(bs),
             }
-            return flat, env_states, obs, key, traj["reward"], traj["done"]
+            return flat, env_states, obs, conn_state, key, \
+                traj["reward"], traj["done"]
 
         self._sample = jax.jit(sample_fn)
         self._ep_returns = np.zeros(cfg.num_envs)
@@ -58,8 +73,10 @@ class RolloutWorker:
 
     def sample(self, weights) -> Dict[str, Any]:
         self.params = self.policy.set_weights(self.params, weights)
-        flat, self.env_states, self.obs, self.key, rewards, dones = \
-            self._sample(self.params, self.env_states, self.obs, self.key)
+        (flat, self.env_states, self.obs, self.conn_state, self.key,
+         rewards, dones) = self._sample(
+            self.params, self.env_states, self.obs, self.conn_state,
+            self.key)
         rewards, dones = np.asarray(rewards), np.asarray(dones)
         for t in range(rewards.shape[0]):
             self._ep_returns += rewards[t]
