@@ -206,6 +206,12 @@ _d("events_buffer_size", int, 1000,
 _d("pubsub_coalesce_s", float, 0.01,
    "Controller publish loop batches events arriving within this window "
    "into one push per subscriber (reference: pubsub batched long-poll).")
+_d("worker_register_timeout_s", float, 20.0,
+   "A spawned worker must register within this long or the reap loop "
+   "kills and replaces it.  Without the bound, ONE hung spawn (fork "
+   "wedged in imports, exec stalled under load) counts as 'starting' "
+   "forever and the spawn throttle never starts another worker — "
+   "permanently wedging actor creation on that node.")
 _d("actor_worker_startup_timeout_s", float, 30.0,
    "How long an actor start waits for a pooled worker to come up before "
    "failing the placement.")

@@ -37,6 +37,7 @@ class WorkerProc:
         self.proc = proc
         self.port: Optional[int] = None
         self.registered = asyncio.Event()
+        self.spawned_at = time.monotonic()
         self.state = "starting"   # starting | idle | leased | actor | dead
         self.lease_id: Optional[bytes] = None
         self.actor_id: Optional[bytes] = None
@@ -271,11 +272,26 @@ class Nodelet:
             await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
 
     async def _reap_loop(self):
-        """Detect dead worker processes (the reference raylet gets SIGCHLD)."""
+        """Detect dead worker processes (the reference raylet gets
+        SIGCHLD), and reclaim spawns that never REGISTER: a live-but-
+        hung child still counts as 'starting', and one of those would
+        gate the spawn throttle forever — observed as a full-suite
+        serve flake where a replica's worker never came up because a
+        single wedged spawn from cluster boot blocked every later one."""
         while True:
             await asyncio.sleep(0.2)
+            now = time.monotonic()
             for w in list(self.workers.values()):
                 if w.state != "dead" and w.proc.poll() is not None:
+                    await self._on_worker_death(w)
+                elif w.state == "starting" and now - w.spawned_at > \
+                        GlobalConfig.worker_register_timeout_s:
+                    print(f"worker {w.worker_id.hex()[:8]} never "
+                          f"registered within "
+                          f"{GlobalConfig.worker_register_timeout_s}s; "
+                          f"killing and replacing it",
+                          file=sys.stderr, flush=True)
+                    w.proc.kill()
                     await self._on_worker_death(w)
 
     async def _on_worker_death(self, w: WorkerProc):

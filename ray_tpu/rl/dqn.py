@@ -184,7 +184,8 @@ class DQN(Algorithm):
             params, target_params, opt_state, key, last_loss = jax.lax.cond(
                 do_learn, run_updates, skip_updates,
                 (params, target_params, opt_state, key))
-            metrics = {"td_loss": last_loss, "epsilon": eps,
+            metrics = {"td_loss": last_loss,
+                       "epsilon": explorer.epsilon(total_steps),
                        "buffer_size": buffer["size"]}
             return (params, target_params, opt_state, buffer, env_states,
                     obs, key, metrics, traj["reward"], traj["done"])

@@ -21,6 +21,7 @@ from .sample import (  # noqa: F401
     sample_from,
     uniform,
 )
+from .pb2 import PB2  # noqa: F401
 from .schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
@@ -28,6 +29,7 @@ from .schedulers import (  # noqa: F401
     MedianStoppingRule,
     PopulationBasedTraining,
 )
+from .syncer import SyncConfig, Syncer  # noqa: F401
 from .search import (  # noqa: F401
     BasicVariantGenerator,
     OptunaSearch,

@@ -1,0 +1,130 @@
+"""PB2: population-based training with a GP-bandit explorer.
+
+Capability mirror of the reference's PB2 scheduler
+(`tune/schedulers/pb2.py:1` — Parker-Holder et al., "Provably Efficient
+Online Hyperparameter Optimization with Population-Based Bandits"):
+exploit copies a top trial's checkpoint like PBT, but EXPLORE selects
+the new hyperparameters by maximizing a GP-UCB acquisition fitted to
+the population's observed (config, time) -> reward-change data, instead
+of random 0.8x/1.2x perturbation.  The GP is sklearn's
+GaussianProcessRegressor (in this image); hyperparameters are bounded
+continuous ranges, optimized by UCB over a random candidate sweep —
+the reference optimizes the same acquisition on the same data shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .schedulers import CONTINUE, STOP, PopulationBasedTraining
+
+
+MAX_OBS = 500   # GP fit is O(n^3): bound the data like the reference
+
+
+class PB2(PopulationBasedTraining):
+    """``hyperparam_bounds``: {name: (low, high)} continuous ranges the
+    GP models (the reference's PB2 requirement); categorical
+    hyperparameters may ride along PBT-style via
+    ``hyperparam_mutations`` and are perturbed by the parent's
+    mutation logic, not the GP."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[
+                     Dict[str, Tuple[float, float]]] = None,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 time_attr: str = "training_iteration",
+                 ucb_kappa: float = 2.0, candidates: int = 256):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=hyperparam_mutations or {},
+                         quantile_fraction=quantile_fraction, seed=seed,
+                         time_attr=time_attr)
+        self.bounds = dict(hyperparam_bounds or {})
+        if not self.bounds:
+            raise ValueError("PB2 needs hyperparam_bounds "
+                             "({name: (low, high)})")
+        overlap = set(self.bounds) & set(self.mutations)
+        if overlap:
+            raise ValueError(f"{sorted(overlap)} appear in BOTH "
+                             "hyperparam_bounds (GP-selected) and "
+                             "hyperparam_mutations (PBT-perturbed); "
+                             "pick one per key")
+        self.ucb_kappa = ucb_kappa
+        self.candidates = candidates
+        # observation log: (t, config vector, reward delta)
+        self._obs: List[Tuple[float, np.ndarray, float]] = []
+        self._prev_score: Dict[str, float] = {}
+
+    # -- data collection -----------------------------------------------------
+    def _vec(self, config: Dict[str, Any]) -> np.ndarray:
+        return np.asarray([float(config[k]) for k in sorted(self.bounds)])
+
+    def on_trial_result(self, trial, result):
+        score = self._score(result)
+        prev = self._prev_score.get(trial.trial_id)
+        if prev is not None:
+            t = float(result.get(self.time_attr, 0))
+            try:
+                self._obs.append((t, self._vec(trial.config),
+                                  score - prev))
+            except (KeyError, TypeError, ValueError):
+                pass  # config missing a bounded key: skip the datapoint
+        self._prev_score[trial.trial_id] = score
+        if len(self._obs) > MAX_OBS:
+            self._obs = self._obs[-MAX_OBS:]
+        return super().on_trial_result(trial, result)
+
+    # -- GP-bandit explore ---------------------------------------------------
+    def exploit_directive(self, trial):
+        directive = super().exploit_directive(trial)
+        if directive is not None:
+            # the restarted trial resumes from the DONOR's checkpoint:
+            # its next score delta reflects the checkpoint jump, not the
+            # new config — a stale baseline here would teach the GP that
+            # whatever config was just assigned caused the jump
+            self._prev_score.pop(trial.trial_id, None)
+        return directive
+
+    def _select_config(self, base: Dict[str, Any]) -> Dict[str, Any]:
+        # categorical keys first, via the parent's PBT mutations; the
+        # GP then overwrites the bounded continuous keys
+        base = super()._select_config(base)
+        names = sorted(self.bounds)
+        lo = np.asarray([self.bounds[k][0] for k in names])
+        hi = np.asarray([self.bounds[k][1] for k in names])
+        cand = self.rng.uniform(lo, hi,
+                                size=(self.candidates, len(names)))
+        picked = None
+        if len(self._obs) >= 4:
+            try:
+                from sklearn.gaussian_process import \
+                    GaussianProcessRegressor
+                from sklearn.gaussian_process.kernels import (
+                    Matern, WhiteKernel)
+                X = np.stack([np.concatenate(([t], v))
+                              for t, v, _ in self._obs])
+                y = np.asarray([d for _, _, d in self._obs])
+                y = (y - y.mean()) / (y.std() + 1e-8)
+                gp = GaussianProcessRegressor(
+                    kernel=Matern(nu=2.5) + WhiteKernel(),
+                    normalize_y=False, alpha=1e-6)
+                gp.fit(X, y)
+                t_now = X[:, 0].max()
+                Xc = np.concatenate(
+                    [np.full((len(cand), 1), t_now), cand], axis=1)
+                mu, sigma = gp.predict(Xc, return_std=True)
+                picked = cand[int(np.argmax(mu +
+                                            self.ucb_kappa * sigma))]
+            except Exception:
+                picked = None  # GP failure: fall back to random
+        if picked is None:
+            picked = cand[0]
+        new_config = dict(base)
+        for k, v in zip(names, picked):
+            new_config[k] = float(v)
+        return new_config

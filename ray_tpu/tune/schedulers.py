@@ -153,7 +153,13 @@ class PopulationBasedTraining(TrialScheduler):
         donor_id = self._directives.pop(trial.trial_id, None)
         if donor_id is None:
             return None
-        new_config = dict(trial.config)
+        return donor_id, self._select_config(trial.config)
+
+    def _select_config(self, base):
+        """EXPLORE: the new config for an exploited trial.  Subclasses
+        (PB2) override the selection strategy only; the directive
+        protocol above stays in one place."""
+        new_config = dict(base)
         for k, mut in self.mutations.items():
             from .sample import Domain
             if isinstance(mut, Domain):
@@ -165,4 +171,4 @@ class PopulationBasedTraining(TrialScheduler):
             elif k in new_config:  # numeric: perturb by 0.8x / 1.2x
                 new_config[k] = new_config[k] * \
                     (1.2 if self.rng.random() < 0.5 else 0.8)
-        return donor_id, new_config
+        return new_config
