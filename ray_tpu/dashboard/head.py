@@ -96,6 +96,26 @@ class DashboardHead:
             from .. import state
             return state.cluster_metrics_text()
 
+        def logs_list(request):
+            from .. import state
+            return state.list_logs(request.query.get("node") or None)
+
+        def logs_tail(request):
+            from .. import state
+            name = request.query.get("name", "")
+            nbytes = int(request.query.get("bytes", "65536"))
+            data = state.tail_log(name,
+                                  request.query.get("node") or None,
+                                  nbytes=nbytes)
+            return data.decode("utf-8", "replace") \
+                if isinstance(data, (bytes, bytearray)) else str(data)
+
+        def timeline(_):
+            # cluster-wide chrome-trace events (driver spans + every
+            # node's finished-task spans)
+            from ..util import tracing
+            return tracing.cluster_trace_events()
+
         def node_stats(request):
             from .. import state
             return state.node_stats(request.match_info.get("node_id"))
@@ -178,6 +198,9 @@ class DashboardHead:
         app.router.add_get("/api/jobs/{job_id}/logs", blocking(job_logs))
         app.router.add_get("/metrics", blocking(metrics_text))
         app.router.add_get("/metrics/cluster", blocking(metrics_cluster))
+        app.router.add_get("/api/logs", blocking(logs_list))
+        app.router.add_get("/api/logs/tail", blocking(logs_tail))
+        app.router.add_get("/api/timeline", blocking(timeline))
         app.router.add_get(
             "/api/version",
             blocking(lambda _: {"ray_tpu": __import__(

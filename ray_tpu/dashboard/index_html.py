@@ -1,9 +1,13 @@
 """Single-file dashboard frontend served at ``/`` by the head.
 
-The reference ships a React/TS client (dashboard/client/src/); this is the
-framework-native minimal equivalent: one dependency-free HTML page that
-polls the REST API (/api/cluster_summary, /api/nodes, /api/actors,
-/api/tasks, /api/jobs, /api/memory) and renders live tables.
+The reference ships a React/TS client (dashboard/client/src/ — module
+pages for overview, logs, events, serve, metrics); this is the
+framework-native equivalent: one dependency-free HTML page with the
+same module set as tabs, polling the REST API.  Views: overview
+(cluster/nodes/tasks/actors/jobs/store), logs (per-node file list +
+tail), timeline (finished-task spans drawn as per-worker lanes), serve
+(applications/deployments/proxies), events, metrics (cluster-wide
+Prometheus exposition).
 """
 
 INDEX_HTML = """<!doctype html>
@@ -14,55 +18,201 @@ INDEX_HTML = """<!doctype html>
 <style>
   body { font-family: ui-monospace, Menlo, monospace; margin: 1.5rem;
          background: #101418; color: #d8dee6; }
-  h1 { font-size: 1.1rem; } h2 { font-size: 0.95rem; margin: 1.2rem 0 .4rem; }
+  h1 { font-size: 1.1rem; } h2 { font-size: .95rem; margin: 1.2rem 0 .4rem; }
   table { border-collapse: collapse; width: 100%; font-size: .8rem; }
   th, td { border: 1px solid #2a3138; padding: .25rem .5rem;
            text-align: left; }
   th { background: #1a2026; }
   .ok { color: #7fd962; } .bad { color: #f07178; }
   #err { color: #f07178; min-height: 1em; }
+  nav button { background: #1a2026; color: #d8dee6; border: 1px solid
+               #2a3138; padding: .3rem .8rem; cursor: pointer;
+               font-family: inherit; }
+  nav button.active { background: #2a3f52; }
+  .view { display: none; } .view.active { display: block; }
+  pre { background: #0b0e11; padding: .6rem; overflow-x: auto;
+        font-size: .75rem; max-height: 28rem; }
+  select, input { background: #1a2026; color: #d8dee6;
+                  border: 1px solid #2a3138; padding: .2rem; }
+  svg { background: #0b0e11; width: 100%; }
+  .lane-label { fill: #8a93a0; font-size: 10px; }
+  .span-rect { fill: #3d7bb8; } .span-rect.interrupted { fill: #f07178; }
 </style>
 </head>
 <body>
 <h1>ray-tpu dashboard</h1>
+<nav>
+  <button data-v="overview" class="active">overview</button>
+  <button data-v="logs">logs</button>
+  <button data-v="timeline">timeline</button>
+  <button data-v="serve">serve</button>
+  <button data-v="events">events</button>
+  <button data-v="metrics">metrics</button>
+</nav>
 <div id="err"></div>
-<h2>cluster</h2><div id="summary"></div>
-<h2>nodes</h2><table id="nodes"></table>
-<h2>running tasks</h2><table id="tasks"></table>
-<h2>actors</h2><table id="actors"></table>
-<h2>jobs</h2><table id="jobs"></table>
-<h2>object store</h2><table id="stores"></table>
+
+<div id="overview" class="view active">
+  <h2>cluster</h2><div id="summary"></div>
+  <h2>nodes</h2><table id="nodes"></table>
+  <h2>running tasks</h2><table id="tasks"></table>
+  <h2>actors</h2><table id="actors"></table>
+  <h2>jobs</h2><table id="jobs"></table>
+  <h2>object store</h2><table id="stores"></table>
+</div>
+
+<div id="logs" class="view">
+  <h2>logs <select id="logfile"></select>
+      <button onclick="tailLog()">tail</button></h2>
+  <pre id="logbody">(pick a file)</pre>
+</div>
+
+<div id="timeline" class="view">
+  <h2>task timeline (finished spans, newest window)</h2>
+  <svg id="tl" height="10"></svg>
+  <div id="tlinfo"></div>
+</div>
+
+<div id="serve" class="view">
+  <h2>applications</h2><table id="apps"></table>
+  <h2>proxies</h2><table id="proxies"></table>
+</div>
+
+<div id="events" class="view">
+  <h2>cluster events</h2><table id="evts"></table>
+</div>
+
+<div id="metrics" class="view">
+  <h2>cluster metrics (Prometheus)</h2>
+  <pre id="metricsbody"></pre>
+</div>
+
 <script>
 async function j(url) { const r = await fetch(url); return r.json(); }
+async function t(url) { const r = await fetch(url); return r.text(); }
 function table(el, rows, cols) {
-  const t = document.getElementById(el);
-  if (!rows || !rows.length) { t.innerHTML = "<tr><td>(none)</td></tr>"; return; }
+  const tb = document.getElementById(el);
+  if (!rows || !rows.length) { tb.innerHTML = "<tr><td>(none)</td></tr>"; return; }
   let h = "<tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
   for (const r of rows)
     h += "<tr>" + cols.map(c => `<td>${fmt(r[c])}</td>`).join("") + "</tr>";
-  t.innerHTML = h;
+  tb.innerHTML = h;
 }
 function fmt(v) {
   if (v === null || v === undefined) return "";
-  if (typeof v === "object") return JSON.stringify(v);
-  return String(v);
+  if (typeof v === "object") return esc(JSON.stringify(v));
+  return esc(String(v));
 }
+function esc(s) {
+  return String(s).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+                  .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+}
+let view = "overview";
+for (const b of document.querySelectorAll("nav button"))
+  b.onclick = () => {
+    view = b.dataset.v;
+    document.querySelectorAll("nav button").forEach(
+      x => x.classList.toggle("active", x === b));
+    document.querySelectorAll(".view").forEach(
+      x => x.classList.toggle("active", x.id === view));
+    refresh();
+  };
+
+async function refreshOverview() {
+  const [sum, nodes, actors, tasks, jobs, mem] = await Promise.all([
+    j("/api/cluster_summary"), j("/api/nodes"), j("/api/actors"),
+    j("/api/tasks"), j("/api/jobs"), j("/api/memory")]);
+  document.getElementById("summary").textContent = JSON.stringify(sum);
+  table("nodes", nodes, ["id", "addr", "alive", "total", "avail",
+                         "demand"]);
+  table("tasks", tasks, ["name", "task_id", "node_id", "worker_id"]);
+  table("actors", actors, ["actor_id", "class_name", "state", "name",
+                           "address", "num_restarts"]);
+  table("jobs", jobs, ["job_id", "status", "entrypoint"]);
+  const stores = Object.entries(mem.stores || {}).map(
+    ([k, v]) => ({node: k, ...v}));
+  table("stores", stores, ["node", "used_bytes", "capacity_bytes",
+                           "num_objects", "num_evictions",
+                           "primary_pins"]);
+}
+
+async function refreshLogs() {
+  const files = await j("/api/logs");
+  const sel = document.getElementById("logfile");
+  const cur = sel.value;
+  sel.innerHTML = files.map(f => `<option>${esc(f)}</option>`).join("");
+  if (files.includes(cur)) sel.value = cur;
+}
+async function tailLog() {
+  const name = document.getElementById("logfile").value;
+  if (!name) return;
+  document.getElementById("logbody").textContent =
+    await t(`/api/logs/tail?name=${encodeURIComponent(name)}`);
+}
+
+async function refreshTimeline() {
+  const all = (await j("/api/timeline")).filter(e => e.ph === "X");
+  const svg = document.getElementById("tl");
+  if (!all.length) { svg.setAttribute("height", 10);
+    document.getElementById("tlinfo").textContent = "(no spans yet)";
+    return; }
+  const t1 = Math.max(...all.map(e => e.ts + e.dur));
+  const t0 = Math.max(Math.min(...all.map(e => e.ts)), t1 - 60e6);
+  // window-filter FIRST: lanes and counts must describe what is drawn
+  // (driver-local profile spans use a different clock and would
+  // otherwise create permanently empty lanes)
+  const evts = all.filter(e => e.ts + e.dur >= t0);
+  const lanes = [...new Set(evts.map(e => `${e.pid}/${e.tid}`))].sort();
+  const H = 16, W = svg.clientWidth || 900;
+  svg.setAttribute("height", lanes.length * H + 6);
+  let body = "";
+  for (const e of evts) {
+    const y = lanes.indexOf(`${e.pid}/${e.tid}`) * H + 3;
+    const x = 140 + (Math.max(e.ts, t0) - t0) / (t1 - t0 + 1) * (W - 150);
+    const w = Math.max(1, e.dur / (t1 - t0 + 1) * (W - 150));
+    const cls = (e.args && e.args.interrupted) ?
+      "span-rect interrupted" : "span-rect";
+    body += `<rect class="${cls}" x="${x}" y="${y}" width="${w}"` +
+            ` height="${H - 5}"><title>${esc(e.name)} ` +
+            `${(e.dur / 1000).toFixed(1)}ms</title></rect>`;
+  }
+  lanes.forEach((l, i) => {
+    body += `<text class="lane-label" x="2" y="${i * H + 12}">` +
+            `${esc(l.slice(0, 22))}</text>`;
+  });
+  svg.innerHTML = body;
+  document.getElementById("tlinfo").textContent =
+    `${evts.length} spans, ${lanes.length} lanes, window ` +
+    `${((t1 - t0) / 1e6).toFixed(1)}s`;
+}
+
+async function refreshServe() {
+  const st = await j("/api/serve/applications");
+  const apps = Object.entries(st.applications || {}).map(
+    ([name, a]) => ({name, status: a.status, ...a.deployment}));
+  table("apps", apps, ["name", "status", "num_replicas",
+                       "route_prefix"]);
+  const proxies = Object.entries(st.proxies || {}).map(
+    ([node, addr]) => ({node, addr}));
+  table("proxies", proxies, ["node", "addr"]);
+}
+
+async function refreshEvents() {
+  const rows = (await j("/api/events")).map(
+    e => ({...e, time: e.ts ? new Date(e.ts * 1000).toISOString() : ""}));
+  table("evts", rows, ["time", "severity", "source", "message"]);
+}
+
+async function refreshMetrics() {
+  document.getElementById("metricsbody").textContent =
+    await t("/metrics/cluster");
+}
+
+const refreshers = {overview: refreshOverview, logs: refreshLogs,
+                    timeline: refreshTimeline, serve: refreshServe,
+                    events: refreshEvents, metrics: refreshMetrics};
 async function refresh() {
   try {
-    const [sum, nodes, actors, tasks, jobs, mem] = await Promise.all([
-      j("/api/cluster_summary"), j("/api/nodes"), j("/api/actors"),
-      j("/api/tasks"), j("/api/jobs"), j("/api/memory")]);
-    document.getElementById("summary").textContent = JSON.stringify(sum);
-    table("nodes", nodes, ["id", "addr", "alive", "total", "available"]);
-    table("tasks", tasks, ["name", "task_id", "node_id", "worker_id"]);
-    table("actors", actors, ["actor_id", "class_name", "state", "name",
-                             "address", "num_restarts"]);
-    table("jobs", jobs, ["job_id", "status", "entrypoint"]);
-    const stores = Object.entries(mem.stores || {}).map(
-      ([k, v]) => ({node: k, ...v}));
-    table("stores", stores, ["node", "used_bytes", "capacity_bytes",
-                             "num_objects", "num_evictions",
-                             "primary_pins"]);
+    await refreshers[view]();
     document.getElementById("err").textContent = "";
   } catch (e) {
     document.getElementById("err").textContent = "refresh failed: " + e;

@@ -61,7 +61,8 @@ def cluster_trace_events() -> List[dict]:
                     "dur": max(0.0, (sp["end"] - sp["start"])) * 1e6,
                     "pid": "node:" + n["id"][:8],
                     "tid": "worker:" + sp["worker_id"][:8],
-                    "args": {"task_id": sp.get("task_id", "")},
+                    "args": {"task_id": sp.get("task_id", ""),
+                             "interrupted": sp.get("interrupted", False)},
                 })
     except Exception:
         pass  # not connected / nodes unreachable: driver-local only

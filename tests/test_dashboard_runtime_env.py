@@ -39,6 +39,35 @@ def test_dashboard_state_endpoints(dashboard):
     assert requests.get(f"{addr}/metrics", timeout=10).status_code == 200
 
 
+def test_dashboard_logs_timeline_metrics(dashboard):
+    """The front-end module set beyond state tables: per-node log
+    tail, task timeline spans, cluster metrics exposition."""
+    addr = dashboard.address
+
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    assert ray_tpu.get([traced.remote() for _ in range(3)],
+                       timeout=60) == [1, 1, 1]
+    import time
+    time.sleep(0.3)   # worker task-state batches coalesce for 50ms
+    files = requests.get(f"{addr}/api/logs", timeout=10).json()
+    assert any("worker" in f or "controller" in f or "nodelet" in f
+               for f in files), files
+    body = requests.get(f"{addr}/api/logs/tail",
+                        params={"name": files[0]}, timeout=10)
+    assert body.status_code == 200
+    spans = requests.get(f"{addr}/api/timeline", timeout=10).json()
+    assert any(e.get("name") == "traced" for e in spans), \
+        [e.get("name") for e in spans][:10]
+    text = requests.get(f"{addr}/metrics/cluster", timeout=20).text
+    assert "ray_tpu_tasks_finished_total" in text
+    page = requests.get(addr, timeout=10).text
+    for tab in ("timeline", "serve", "metrics", "logs"):
+        assert f'data-v="{tab}"' in page
+
+
 def test_dashboard_job_flow(dashboard):
     addr = dashboard.address
     r = requests.post(f"{addr}/api/jobs", json={
