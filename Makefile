@@ -1,0 +1,30 @@
+# Unified build entry points (the L0 role of the reference's bazel
+# tree): native object store + transfer plane, C++ driver API, wheel.
+PY ?= python
+
+.PHONY: all native cpp wheel test bench clean
+
+all: native cpp
+
+native: ray_tpu/core/object_store/libtpustore.so
+
+ray_tpu/core/object_store/libtpustore.so: \
+		ray_tpu/core/object_store/store.cc \
+		ray_tpu/core/object_store/transfer.cc
+	g++ -O2 -shared -fPIC -pthread -o $@ $^
+
+cpp:
+	$(MAKE) -C ray_tpu/cpp
+
+wheel: native
+	$(PY) -m pip wheel --no-deps --no-build-isolation -w dist .
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -f ray_tpu/core/object_store/libtpustore.so dist/*.whl
+	$(MAKE) -C ray_tpu/cpp clean

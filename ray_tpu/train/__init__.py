@@ -15,5 +15,9 @@ from .checkpointing import CheckpointManager  # noqa: F401
 from .hf import TransformersTrainer  # noqa: F401
 from .gbdt import GBDTModel, LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from .sklearn import GBDTTrainer, SklearnTrainer  # noqa: F401
-from .trainer import JaxTrainer, TorchCompatTrainer  # noqa: F401
+from .trainer import (  # noqa: F401
+    JaxTrainer,
+    TensorflowTrainer,
+    TorchCompatTrainer,
+)
 from .worker_group import WorkerGroup  # noqa: F401

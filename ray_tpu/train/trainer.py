@@ -180,3 +180,53 @@ class TorchCompatTrainer(JaxTrainer):
     `train/torch/config.py:113` (`dist.init_process_group`)."""
 
     _default_backend = _TorchGlooBackendConfig
+
+
+class _TFConfigBackendConfig(BackendConfig):
+    @property
+    def backend_cls(self):
+        return _TFConfigBackend
+
+
+class _TFConfigBackend(_Backend):
+    """MultiWorkerMirroredStrategy environment setup (reference:
+    `train/tensorflow/config.py:21,40` `_setup_tensorflow_environment`):
+    the backend's entire distributed job is assembling ``TF_CONFIG`` —
+    a cluster worker list plus this rank's task index — before the user
+    loop builds its strategy.  tensorflow itself is imported only by
+    the user's code (and is not in this image; the env contract is what
+    this backend owns and what the test verifies)."""
+
+    def on_start(self, worker_group, executor) -> None:
+        # Each rank must BIND its listed endpoint, so the IP and the
+        # free-port probe must come from the rank's own host (the
+        # driver's view would break any off-driver placement).
+        def my_endpoint():
+            from ..parallel.coordinator import _free_port, _local_ip
+            return f"{_local_ip()}:{_free_port()}"
+
+        executor.shared_env["tf_workers"] = \
+            worker_group.execute(my_endpoint)
+
+    def worker_setup_fn(self, executor):
+        workers = list(executor.shared_env["tf_workers"])
+
+        def setup():
+            import json
+            import os
+
+            from ..air import session
+            os.environ["TF_CONFIG"] = json.dumps({
+                "cluster": {"worker": workers},
+                "task": {"type": "worker",
+                         "index": session.get_world_rank()}})
+
+        return setup
+
+
+class TensorflowTrainer(JaxTrainer):
+    """Runs reference-style TF MultiWorkerMirrored train functions: the
+    gang gets a consistent ``TF_CONFIG`` (one worker endpoint per rank)
+    exactly as the reference's TensorflowTrainer provisions it."""
+
+    _default_backend = _TFConfigBackendConfig

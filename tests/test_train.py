@@ -231,3 +231,27 @@ def test_pytree_checkpoint_resave_same_path(tmp_path):
     out = ck2.to_pytree()
     np.testing.assert_array_equal(np.asarray(out["x"]),
                                   np.full(4, 7.0))
+
+
+def test_tensorflow_trainer_tf_config(cluster):
+    """TensorflowTrainer provisions MultiWorkerMirrored's TF_CONFIG per
+    rank (reference: train/tensorflow/config.py env setup — the
+    backend's whole distributed job; tf itself is the user loop's)."""
+    import json
+
+    from ray_tpu.train import TensorflowTrainer
+
+    def train_loop(config):
+        cfg = json.loads(os.environ["TF_CONFIG"])
+        session.report({
+            "index": cfg["task"]["index"],
+            "type": cfg["task"]["type"],
+            "n_workers": len(cfg["cluster"]["worker"]),
+            "my_endpoint": cfg["cluster"]["worker"][cfg["task"]["index"]],
+        })
+
+    res = TensorflowTrainer(
+        train_loop, scaling_config=ScalingConfig(num_workers=3)).fit()
+    assert res.error is None
+    assert res.metrics["n_workers"] == 3
+    assert res.metrics["type"] == "worker"
