@@ -842,7 +842,8 @@ class CoreClient:
                 return True
             ev = _ErrorValue(err["traceback"], err.get("pickled"),
                              err.get("fname", spec.function_name),
-                             is_actor=spec.actor_id is not None)
+                             is_actor=spec.actor_id is not None,
+                             actor_down=bool(err.get("dying")))
             self._store_error(spec, ev)
             return False
         for oid, ret in zip(spec.return_ids(), reply["returns"]):
@@ -1025,7 +1026,7 @@ class CoreClient:
                     reason = (info or {}).get("death_cause") or "connection lost"
                     self._store_error(spec, _ErrorValue(
                         f"actor died: {reason}", None, spec.function_name,
-                        is_actor=True))
+                        is_actor=True, actor_down=True))
                 return
             self._handle_task_reply(spec, reply, 0, None)
         except Exception as e:
@@ -1065,7 +1066,7 @@ class CoreClient:
     def _fail_actor_task(self, spec: TaskSpec, state: _ActorState):
         self._store_error(spec, _ErrorValue(
             f"actor {state.actor_id.hex()[:12]} is dead: {state.dead_reason}",
-            None, spec.function_name, is_actor=True))
+            None, spec.function_name, is_actor=True, actor_down=True))
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         state = self._actors.get(actor_id)

@@ -245,6 +245,22 @@ class EventLoopThread:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self):
+        # Cancel and DRAIN pending tasks (read loops, lag monitor, lease
+        # loops) before stopping: bare loop.stop() leaves them pending
+        # and every driver exit spews "Task was destroyed but it is
+        # pending!" warnings from their GC.
+        async def _drain():
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _drain(), self.loop).result(timeout=1.0)
+        except Exception:
+            pass  # a stuck task must not block process exit
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=2)
 

@@ -572,11 +572,16 @@ class _ErrorValue:
     """A stored value representing a task failure; getting it re-raises."""
 
     def __init__(self, traceback_str: str, pickled: Optional[bytes], fname: str,
-                 is_actor: bool = False):
+                 is_actor: bool = False, actor_down: bool = False):
         self.traceback_str = traceback_str
         self.pickled = pickled
         self.fname = fname
         self.is_actor = is_actor
+        # the ACTOR (not the request) failed: killed mid-call, worker
+        # crashed, creation gave up — surfaces as the TYPED
+        # ActorDiedError so callers (e.g. the Serve router) can retry
+        # on another replica without substring-sniffing messages
+        self.actor_down = actor_down
 
     def unwrap(self, context_fname: str = "") -> Exception:
         cause = None
@@ -587,5 +592,7 @@ class _ErrorValue:
                 cause = None
         if isinstance(cause, exceptions.TaskCancelledError):
             return cause  # ray.cancel surfaces AS TaskCancelledError
+        if getattr(self, "actor_down", False):
+            return exceptions.ActorDiedError("", self.traceback_str)
         cls = exceptions.ActorError if self.is_actor else exceptions.TaskError
         return cls(self.fname or context_fname, self.traceback_str, cause)

@@ -30,7 +30,8 @@ class ActorDiedError(RayTpuError):
     def __init__(self, actor_id_hex: str, reason: str):
         self.actor_id_hex = actor_id_hex
         self.reason = reason
-        super().__init__(f"actor {actor_id_hex[:12]} died: {reason}")
+        who = f"actor {actor_id_hex[:12]}" if actor_id_hex else "actor"
+        super().__init__(f"{who} died: {reason}")
 
 
 class WorkerCrashedError(RayTpuError):

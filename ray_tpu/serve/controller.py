@@ -112,6 +112,7 @@ class ServeController:
         while len(entry["replicas"]) > target:
             rep = entry["replicas"].pop()
             self._replica_nodes.pop(rep["id"], None)
+            self._audit_kill(name, rep["id"], target)
             if rep.get("gang"):
                 from .gang import stop_gang_replica
                 stop_gang_replica(rep)
@@ -121,6 +122,20 @@ class ServeController:
             except Exception:
                 pass
         self._version += 1
+
+    @staticmethod
+    def _audit_kill(name: str, replica_id: str, target: int) -> None:
+        """Structured cluster event per replica teardown — when a
+        request races a kill, the events API says who killed what."""
+        why = (f"scale to {target}" if target >= 0
+               else "found dead; replacing")
+        try:
+            from .. import state
+            state.report_event(
+                f"serve: removing replica {replica_id} of {name!r} "
+                f"({why})", severity="INFO", source="serve")
+        except Exception:
+            pass
 
     # -- per-node HTTP proxies ---------------------------------------------
     def ensure_proxies(self, http: dict) -> Dict[str, str]:
@@ -212,6 +227,45 @@ class ServeController:
         except Exception:
             pass  # transient state-API failure; next report retries
 
+    def _maybe_heal_replicas(self) -> None:
+        """Replace DEAD replica actors (reference: deployment_state's
+        replica health checks — a replica whose worker died, was
+        OOM-killed, or lost its node gets a fresh replacement toward
+        the target count).  Throttled; piggybacks on metric reports."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_heal_check", 0.0) < 5.0:
+            return
+        self._last_heal_check = now
+        try:
+            from .. import state
+            dead = {row["actor_id"] for row in state.list_actors()
+                    if row.get("state") == "DEAD"}
+        except Exception:
+            return
+        if not dead:
+            return
+        for name, entry in self._deployments.items():
+            alive = []
+            lost = 0
+            for rep in entry["replicas"]:
+                handle = (rep.get("gang") or [rep["handle"]])[0]
+                if handle._actor_id in dead:
+                    lost += 1
+                    self._replica_nodes.pop(rep["id"], None)
+                    self._audit_kill(name, rep["id"], -1)
+                    if rep.get("gang"):
+                        from .gang import stop_gang_replica
+                        try:
+                            stop_gang_replica(rep)
+                        except Exception:
+                            pass
+                else:
+                    alive.append(rep)
+            if lost:
+                entry["replicas"] = alive
+                self._reconcile(name)   # refill to the target count
+                self._version += 1
+
     # -- routing state ------------------------------------------------------
     def _resolve_replica_nodes(self) -> None:
         """Fill the replica->node cache for locality routing with ONE
@@ -278,6 +332,7 @@ class ServeController:
                        ) -> bool:
         """Router-reported in-flight counts drive the basic autoscaler."""
         self._maybe_reconcile_proxies()
+        self._maybe_heal_replicas()     # 5s-throttled internally
         self._resolve_replica_nodes()   # 1s-throttled internally
         entry = self._deployments.get(name)
         if entry is None:

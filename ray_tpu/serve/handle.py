@@ -30,7 +30,8 @@ class ServeHandle:
         ref, replica_id = self._router.assign_request(
             self._name, args, kwargs, method)
         # completion accounting piggybacks on result retrieval
-        return _TrackedRef(ref, self._router, self._name, replica_id)
+        return _TrackedRef(ref, self._router, self._name, replica_id,
+                           args, kwargs, method)
 
     def __getattr__(self, item: str) -> _MethodCaller:
         if item.startswith("_"):
@@ -38,22 +39,72 @@ class ServeHandle:
         return _MethodCaller(self, item)
 
 
+def is_replica_down_error(exc: BaseException) -> bool:
+    """A failure that blames the REPLICA, not the request: killed mid-
+    call (redeploy/scale-down race) or its worker died.  Typed — never
+    inferred from message text, which would re-run non-idempotent user
+    requests whose own errors merely mention 'died'."""
+    from ..exceptions import ActorDiedError, WorkerCrashedError
+    return isinstance(exc, (ActorDiedError, WorkerCrashedError))
+
+
+def call_with_retry(router, name: str, args, kwargs,
+                    method: Optional[str] = None,
+                    timeout_s: float = 60.0, attempts: int = 3) -> Any:
+    """Assign + get with replica-failure retry under ONE deadline (the
+    reference router's handling of dead replicas).  A request that
+    raced a replica teardown re-routes to a live replica after a table
+    refresh; user errors propagate untouched on the first attempt."""
+    import time as _time
+    deadline = _time.monotonic() + timeout_s
+    for attempt in range(attempts):
+        budget = max(0.1, deadline - _time.monotonic())
+        ref, rid = router.assign_request(name, args, kwargs, method,
+                                         timeout_s=budget)
+        try:
+            return api.get(ref,
+                           timeout=max(0.1,
+                                       deadline - _time.monotonic()))
+        except Exception as e:
+            if attempt == attempts - 1 or not is_replica_down_error(e) \
+                    or _time.monotonic() >= deadline:
+                raise
+            router._refresh(force=True)
+        finally:
+            router.complete(name, rid)
+
+
 class _TrackedRef:
     """ObjectRef wrapper that releases the router's in-flight slot when the
     result is fetched."""
 
-    def __init__(self, ref, router, name, replica_id):
+    def __init__(self, ref, router, name, replica_id,
+                 args=(), kwargs=None, method=None):
         self._ref = ref
         self._router = router
         self._name = name
         self._replica_id = replica_id
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._method = method
         self._done = False
 
     def result(self, timeout_s: float = 60.0) -> Any:
+        import time as _time
+        t0 = _time.monotonic()
         try:
-            return api.get(self._ref, timeout=timeout_s)
-        finally:
-            self._release()
+            try:
+                return api.get(self._ref, timeout=timeout_s)
+            finally:
+                self._release()
+        except Exception as e:
+            remaining = timeout_s - (_time.monotonic() - t0)
+            if not is_replica_down_error(e) or remaining <= 0:
+                raise
+            self._router._refresh(force=True)
+            return call_with_retry(self._router, self._name, self._args,
+                                   self._kwargs, self._method,
+                                   timeout_s=remaining, attempts=2)
 
     def _release(self):
         if not self._done:

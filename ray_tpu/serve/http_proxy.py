@@ -74,15 +74,12 @@ class HTTPProxy:
                 payload = dict(request.query)
 
             def call():
-                from .. import api as _api
-                ref, rid = self._router.assign_request(
-                    name, (payload,) if payload is not None else (), {})
-                try:
-                    from ..core.config import GlobalConfig
-                    return _api.get(
-                        ref, timeout=GlobalConfig.serve_request_timeout_s)
-                finally:
-                    self._router.complete(name, rid)
+                from ..core.config import GlobalConfig
+                from .handle import call_with_retry
+                args = (payload,) if payload is not None else ()
+                return call_with_retry(
+                    self._router, name, args, {},
+                    timeout_s=GlobalConfig.serve_request_timeout_s)
 
             try:
                 result = await loop.run_in_executor(self._pool, call)

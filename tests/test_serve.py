@@ -225,3 +225,51 @@ def test_autoscaling_scales_up_under_load_and_back_down(served):
         time.sleep(0.3)
     assert serve.list_deployments()["auto_echo"]["num_replicas"] == 1
     serve.delete("auto_echo")
+
+
+def test_dead_replica_healed_and_requests_survive(served):
+    """A replica whose actor dies gets REPLACED toward the target count
+    (reference: deployment_state health checks), and in-flight callers
+    ride the router's typed replica-failure retry instead of erroring."""
+    import ray_tpu as rt
+    from ray_tpu import state as rt_state
+
+    @serve.deployment(num_replicas=2)
+    class Fragile:
+        def __call__(self, _=None):
+            import os
+            return os.getpid()
+
+    handle = serve.run(Fragile.bind(), name="fragile")
+    assert isinstance(handle.remote().result(timeout_s=60.0), int)
+
+    victims = [a for a in rt_state.list_actors()
+               if a.get("state") == "ALIVE"
+               and "ServeReplica" in a.get("class_name", "")]
+    assert victims, "no replica actors found in the actor table"
+    # kill one replica's actor out from under serve
+    from ray_tpu.api import ActorHandle
+    rt.kill(ActorHandle(victims[0]["actor_id"], "ServeReplica", []))
+
+    def alive_replicas():
+        return {a["actor_id"] for a in rt_state.list_actors()
+                if a.get("state") == "ALIVE"
+                and "ServeReplica" in a.get("class_name", "")}
+
+    before = alive_replicas()
+    # requests keep succeeding (typed replica-failure retry), and the
+    # heal sweep replaces the dead replica toward num_replicas=2: a NEW
+    # actor id appears while the victim stays gone
+    deadline = time.time() + 40.0
+    while time.time() < deadline:
+        assert isinstance(handle.remote().result(timeout_s=30.0), int)
+        now_alive = alive_replicas()
+        if victims[0]["actor_id"] not in now_alive \
+                and len(now_alive) >= len(before):
+            break
+        time.sleep(0.5)
+    now_alive = alive_replicas()
+    assert victims[0]["actor_id"] not in now_alive
+    assert len(now_alive) >= len(before), \
+        "dead replica was never replaced"
+    serve.delete("fragile")
