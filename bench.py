@@ -155,15 +155,91 @@ def _run_measurement() -> dict:
         dt = _measure(sync_every_step=True)
     tok_s = steps * tokens_per_step / dt
     mfu = _mfu(dt)
-    return {
+    detail = {"tokens_per_s": round(tok_s, 1),
+              "step_ms": round(1000 * dt / steps, 2),
+              "backend": jax.default_backend()}
+    result = {
         "metric": "gpt2s_train_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / 0.40, 4),
-        "detail": {"tokens_per_s": round(tok_s, 1),
-                   "step_ms": round(1000 * dt / steps, 2),
-                   "backend": jax.default_backend()},
+        "detail": detail,
     }
+    if on_tpu:
+        # SAFETY LINE before the extra validation work: the parent takes
+        # the LAST parseable stdout line, and salvages this one from a
+        # TimeoutExpired — a measured TPU headline must never be lost to
+        # a slow kernel-validation stage.
+        print(json.dumps(result), flush=True)
+        # free HBM before the validation allocates its own tensors (the
+        # naive seq-8192 reference materializes a ~2 GB score matrix)
+        del params, opt_state, batch_data, step
+        # Piggyback on-chip kernel validation inside the SAME claim
+        # (one claim/release cycle per attempt is the wedge-safety
+        # rule): flash fwd/bwd numerics vs reference, flash-vs-naive
+        # step time at two sequence lengths.
+        try:
+            detail["kernels"] = _validate_kernels_on_chip(log)
+        except Exception as exc:  # never sink the headline number
+            detail["kernels"] = {"error": repr(exc)[:200]}
+    return result
+
+
+def _validate_kernels_on_chip(log) -> dict:
+    """Flash-attention on the MXU: numerics parity (fwd + grads) and
+    measured speedup vs unfused attention (the round-2 verdict's ask:
+    an untested-on-hardware kernel is a prototype, not a component)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import reference_attention
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    out: dict = {}
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(k1, (1, 512, 8, 64), jnp.float32)
+    k = jax.random.normal(k2, (1, 512, 8, 64), jnp.float32)
+    v = jax.random.normal(k3, (1, 512, 8, 64), jnp.float32)
+    log("kernels: flash fwd parity...")
+    f = jax.jit(lambda *a: flash_attention(*a, causal=True))
+    r = jax.jit(lambda *a: reference_attention(*a, causal=True))
+    err = float(jnp.max(jnp.abs(f(q, k, v) - r(q, k, v))))
+    out["fwd_max_abs_err"] = round(err, 7)
+    log("kernels: flash bwd parity...")
+    gf = jax.jit(jax.grad(lambda *a: (flash_attention(
+        *a, causal=True) ** 2).sum(), argnums=(0, 1, 2)))
+    gr = jax.jit(jax.grad(lambda *a: (reference_attention(
+        *a, causal=True) ** 2).sum(), argnums=(0, 1, 2)))
+    bwd_err = max(float(jnp.max(jnp.abs(a - b)))
+                  for a, b in zip(gf(q, k, v), gr(q, k, v)))
+    out["bwd_max_abs_err"] = round(bwd_err, 6)
+    out["numerics_ok"] = err < 2e-4 and bwd_err < 5e-3
+
+    def _median_time(fn, *args, reps: int = 5) -> float:
+        jax.block_until_ready(fn(*args))   # warmup / compile
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    for seq in (2048, 8192):
+        try:
+            kq, kk, kv2 = jax.random.split(jax.random.PRNGKey(seq), 3)
+            qb = jax.random.normal(kq, (1, seq, 8, 64), jnp.bfloat16)
+            kb = jax.random.normal(kk, (1, seq, 8, 64), jnp.bfloat16)
+            vb = jax.random.normal(kv2, (1, seq, 8, 64), jnp.bfloat16)
+            log(f"kernels: timing seq={seq}...")
+            t_flash = _median_time(f, qb, kb, vb)
+            t_naive = _median_time(r, qb, kb, vb)
+            out[f"seq{seq}_flash_ms"] = round(t_flash * 1e3, 3)
+            out[f"seq{seq}_naive_ms"] = round(t_naive * 1e3, 3)
+            out[f"seq{seq}_speedup"] = round(t_naive / max(t_flash,
+                                                           1e-9), 2)
+        except Exception as exc:   # e.g. naive seq-8192 OOM: partial
+            out[f"seq{seq}_error"] = repr(exc)[:120]  # results still land
+    return out
 
 
 def _run_rl_measurement() -> dict:
@@ -271,6 +347,20 @@ def main() -> None:
         try:
             proc = _spawn("tpu")
         except subprocess.TimeoutExpired as exc:
+            # SALVAGE: the child prints a safety line as soon as the
+            # headline is measured, BEFORE the kernel-validation stage —
+            # a timeout there must not cost the TPU number.
+            out = exc.stdout or b""
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            salvaged = _extract_json_line(out)
+            if salvaged is not None and \
+                    (salvaged.get("detail") or {}).get("backend") == "tpu":
+                salvaged["detail"]["kernels"] = {
+                    "error": "attempt timed out during kernel "
+                             "validation; headline salvaged"}
+                print(json.dumps(salvaged))
+                return
             # the child's stderr breadcrumbs say WHERE it stalled
             # (client init → relay wedged; post-backend → compile)
             tail = exc.stderr or b""
