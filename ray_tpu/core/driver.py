@@ -149,6 +149,13 @@ class CoreClient:
         self._put_index = 0
         self._fn_registered: set = set()
         self._ref_lock = threading.Lock()
+        # Submission coalescing: a burst of .remote() calls lands in
+        # this queue and wakes the IO loop ONCE, not once per task —
+        # run_coroutine_threadsafe costs ~100us each, which alone caps
+        # a 10k-task burst at ~10k/s before any real work happens.
+        self._submit_q: deque = deque()
+        self._submit_scheduled = False
+        self._submit_lock = threading.Lock()
         self._local_refs: Dict[bytes, int] = {}
         self._owned: set = set()        # oids this process created (owner frees)
         self._plasma_oids: set = set()  # oids known to live in shared memory
@@ -558,8 +565,47 @@ class CoreClient:
                 self._add_local_ref(b)
             self._extra_pins_map[spec.task_id.binary()] = extra
         del temp_refs
-        self.lt.spawn(self._submit_pipeline(spec, spec.max_retries))
+        self._enqueue_submission(spec, spec.max_retries)
         return refs
+
+    def _enqueue_submission(self, spec: TaskSpec,
+                            attempts_left: int) -> None:
+        with self._submit_lock:
+            self._submit_q.append((spec, attempts_left))
+            if self._submit_scheduled:
+                return   # a drain is already on its way
+            self._submit_scheduled = True
+        try:
+            self.lt.loop.call_soon_threadsafe(self._drain_submissions)
+        except BaseException:
+            # scheduling failed (interrupt mid-call, closing loop): a
+            # stuck-True flag would silently wedge EVERY future submit
+            with self._submit_lock:
+                self._submit_scheduled = False
+            raise
+
+    def _drain_submissions(self) -> None:
+        """Runs ON the IO loop: start a pipeline per queued submission."""
+        try:
+            while True:
+                with self._submit_lock:
+                    if not self._submit_q:
+                        self._submit_scheduled = False
+                        return
+                    batch = list(self._submit_q)
+                    self._submit_q.clear()
+                for spec, attempts_left in batch:
+                    asyncio.ensure_future(
+                        self._submit_pipeline(spec, attempts_left))
+        except BaseException:
+            # keep the pump alive: clear the flag so the next enqueue
+            # (or the reschedule below) wakes the loop again
+            with self._submit_lock:
+                self._submit_scheduled = bool(self._submit_q)
+                resched = self._submit_scheduled
+            if resched:
+                self.lt.loop.call_soon(self._drain_submissions)
+            raise
 
     async def _submit_pipeline(self, spec: TaskSpec, attempts_left: int):
         try:
