@@ -565,13 +565,15 @@ class CoreClient:
                 self._add_local_ref(b)
             self._extra_pins_map[spec.task_id.binary()] = extra
         del temp_refs
-        self._enqueue_submission(spec, spec.max_retries)
+        self._enqueue_submission(
+            self._submit_pipeline(spec, spec.max_retries))
         return refs
 
-    def _enqueue_submission(self, spec: TaskSpec,
-                            attempts_left: int) -> None:
+    def _enqueue_submission(self, coro) -> None:
+        """Queue a submission pipeline (a coroutine OBJECT — not started
+        until the drain schedules it) for the next loop wakeup."""
         with self._submit_lock:
-            self._submit_q.append((spec, attempts_left))
+            self._submit_q.append(coro)
             if self._submit_scheduled:
                 return   # a drain is already on its way
             self._submit_scheduled = True
@@ -594,9 +596,8 @@ class CoreClient:
                         return
                     batch = list(self._submit_q)
                     self._submit_q.clear()
-                for spec, attempts_left in batch:
-                    asyncio.ensure_future(
-                        self._submit_pipeline(spec, attempts_left))
+                for coro in batch:
+                    asyncio.ensure_future(coro)
         except BaseException:
             # keep the pump alive: clear the flag so the next enqueue
             # (or the reschedule below) wakes the loop again
@@ -1036,8 +1037,9 @@ class CoreClient:
                 self._add_local_ref(b)
             self._extra_pins_map[spec.task_id.binary()] = extra
         del temp_refs
-        self.lt.spawn(self._submit_actor_pipeline(actor_id, spec,
-                                                  max_task_retries))
+        self._enqueue_submission(
+            self._submit_actor_pipeline(actor_id, spec,
+                                        max_task_retries))
         return refs
 
     async def _submit_actor_pipeline(self, actor_id: bytes, spec: TaskSpec,
