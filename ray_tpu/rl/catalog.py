@@ -13,12 +13,13 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional
 
 from .env import JaxEnv
-from .policy import MLPPolicy
+from .policy import ConvPolicy, MLPPolicy
 
 _CUSTOM_MODELS: Dict[str, Callable[..., Any]] = {}
 
 DEFAULT_MODEL: Dict[str, Any] = {
     "hidden": (64, 64),
+    "conv_filters": None,     # None -> catalog default for image spaces
     "custom_model": None,
     "custom_model_config": {},
 }
@@ -50,5 +51,16 @@ def build_policy(env: JaxEnv, model: Optional[Dict[str, Any]] = None,
         return _CUSTOM_MODELS[custom](
             obs_size, env.action_size, discrete=env.discrete,
             **cfg.get("custom_model_config", {}))
+    # image observation space -> conv torso (the reference catalog's
+    # vision-net selection); connectors that resize flat obs keep the
+    # MLP path since the image geometry no longer applies
+    obs_shape = getattr(env, "observation_shape", None)
+    if obs_shape is not None and len(obs_shape) == 3 and \
+            obs_size == env.observation_size:
+        return ConvPolicy(obs_shape, env.action_size,
+                          discrete=env.discrete,
+                          conv_filters=cfg.get("conv_filters")
+                          or ((16, 3, 1), (32, 3, 1)),
+                          hidden=tuple(cfg["hidden"]))
     return MLPPolicy(obs_size, env.action_size, discrete=env.discrete,
                      hidden=tuple(cfg["hidden"]))

@@ -132,3 +132,46 @@ class Pendulum(JaxEnv):
             lambda r, c: jnp.where(done, r, c), reset_state, cur)
         obs = self._obs(new_state["th"], new_state["thdot"])
         return new_state, obs, -cost, done
+
+
+class GridTarget(JaxEnv):
+    """Image-observation task: an agent on an N x N grid steps toward a
+    target; obs is a flattened 2-channel image (agent plane, target
+    plane).  The pixel-input test bed for the catalog's CNN path —
+    fully jittable like every first-class env here."""
+
+    N = 5
+    observation_shape = (N, N, 2)
+    observation_size = N * N * 2
+    action_size = 4          # up / down / left / right
+    discrete = True
+    max_episode_steps = 30
+
+    def _obs(self, agent, target):
+        img = jnp.zeros((self.N, self.N, 2))
+        img = img.at[agent[0], agent[1], 0].set(1.0)
+        img = img.at[target[0], target[1], 1].set(1.0)
+        return img.reshape(-1)
+
+    def reset(self, key):
+        ka, kt = jax.random.split(key)
+        agent = jax.random.randint(ka, (2,), 0, self.N)
+        target = jax.random.randint(kt, (2,), 0, self.N)
+        state = {"agent": agent, "target": target,
+                 "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(agent, target)
+
+    def step(self, state, action, key):
+        delta = jnp.asarray([[-1, 0], [1, 0], [0, -1], [0, 1]])[action]
+        agent = jnp.clip(state["agent"] + delta, 0, self.N - 1)
+        reached = jnp.all(agent == state["target"])
+        t = state["t"] + 1
+        done = reached | (t >= self.max_episode_steps)
+        reward = jnp.where(reached, 1.0, -0.02)
+        reset_state, reset_obs = self.reset(key)
+        new_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(done, r, c), reset_state,
+            {"agent": agent, "target": state["target"], "t": t})
+        obs = self._obs(agent, state["target"])
+        new_obs = jnp.where(done, reset_obs, obs)
+        return new_state, new_obs, reward, done

@@ -123,3 +123,61 @@ class MLPPolicy:
     def set_weights(self, params: Params, weights):
         return jax.tree_util.tree_map(lambda _, w: jnp.asarray(w),
                                       params, weights)
+
+
+class ConvPolicy(MLPPolicy):
+    """Conv-torso actor-critic for image observations (the CNN half of
+    the reference catalog's space-driven model selection,
+    `rllib/models/catalog.py` get_model_v2 + `models/torch/visionnet`).
+
+    Observations arrive FLAT (the rollout plumbing is shape-agnostic);
+    the torso reshapes to ``obs_shape`` (H, W, C), runs a small conv
+    stack (``conv_filters``: [(out_channels, kernel, stride), ...]),
+    and feeds the flattened features through the inherited MLP heads.
+    """
+
+    def __init__(self, obs_shape, action_size: int, *,
+                 discrete: bool = True,
+                 conv_filters: Sequence[Tuple[int, int, int]] = (
+                     (16, 3, 1), (32, 3, 1)),
+                 hidden: Sequence[int] = (64,)):
+        self.obs_shape = tuple(obs_shape)           # (H, W, C)
+        self.conv_filters = tuple(conv_filters)
+        h, w, c = self.obs_shape
+        for (out_c, ksize, stride) in self.conv_filters:
+            h = (h - ksize) // stride + 1
+            w = (w - ksize) // stride + 1
+            c = out_c
+        self._feat_size = h * w * c
+        # the inherited MLP torso/heads see the conv FEATURES, so size
+        # the base policy by the feature map, not the raw pixels
+        super().__init__(self._feat_size, action_size,
+                         discrete=discrete, hidden=tuple(hidden))
+
+    def init(self, key: jax.Array) -> Params:
+        kc, km = jax.random.split(key)
+        convs = []
+        in_c = self.obs_shape[-1]
+        for i, (out_c, ksize, _s) in enumerate(self.conv_filters):
+            kk = jax.random.fold_in(kc, i)
+            fan_in = ksize * ksize * in_c
+            convs.append({
+                "w": jax.random.normal(
+                    kk, (ksize, ksize, in_c, out_c)) *
+                math.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((out_c,))})
+            in_c = out_c
+        params = super().init(km)
+        params["convs"] = convs
+        return params
+
+    def _torso(self, params: Params, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.reshape(self.obs_shape)[None]        # [1, H, W, C]
+        for layer, (_o, _k, stride) in zip(params["convs"],
+                                           self.conv_filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(stride, stride),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jnp.tanh(x + layer["b"])
+        return super()._torso(params, x.reshape(-1))

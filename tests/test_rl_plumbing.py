@@ -165,3 +165,23 @@ def test_ppo_with_connectors_learns():
     # the policy was sized for the pipeline output and the normalizer
     # state advanced with training
     assert float(algo.conn_state[0]["count"][0]) > 100
+
+def test_catalog_selects_conv_policy_for_image_env():
+    from ray_tpu.rl import ConvPolicy, GridTarget
+    env = GridTarget()
+    pol = build_policy(env, {"hidden": (32,)})
+    assert isinstance(pol, ConvPolicy)
+    params = pol.init(jax.random.PRNGKey(0))
+    obs = jnp.zeros((env.observation_size,))
+    a, logp, v = pol.sample_action(params, obs, jax.random.PRNGKey(1))
+    assert int(a) in range(4) and v.shape == ()
+
+
+def test_ppo_learns_pixels_with_conv_policy():
+    from ray_tpu.rl import GridTarget
+    algo = PPOConfig(env=GridTarget, num_envs=32, rollout_length=64,
+                     num_sgd_epochs=3, num_minibatches=4, lr=5e-4,
+                     entropy_coeff=0.02, seed=0).build()
+    hist = [algo.train()["episode_reward_mean"] for _ in range(24)]
+    early, late = np.mean(hist[:5]), np.mean(hist[-5:])
+    assert late > early + 0.05, (early, late)
