@@ -23,3 +23,10 @@ from .transformer import (  # noqa: F401
     count_params,
     flops_per_token,
 )
+from .vit import (  # noqa: F401
+    ViTConfig,
+    init_vit_params,
+    make_vit_train_step,
+    vit_forward,
+    vit_loss,
+)

@@ -52,6 +52,7 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16         # activation/compute dtype
     param_dtype: Any = jnp.float32
     attention_impl: str = "auto"      # "auto"|"flash"|"reference"|"ring"
+    causal: bool = True               # False → bidirectional (encoders)
     remat: bool = True
     loss_chunk: int = 0               # >0 → chunked cross entropy: logits
     #   materialize [b, chunk, vocab] at a time (rematerialized in bwd)
@@ -154,7 +155,11 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
     unembed = cfg.vocab_size * d  # tied or not, the logits matmul runs
     n_matmul = cfg.n_layers * _per_layer_matmul_params(cfg, active=True) \
         + unembed
-    attn = 6 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len  # ≈ qk+pv
+    # qk+pv over the visible window: half the positions when causal,
+    # all of them for bidirectional encoders (causal=False)
+    attn_factor = 6 if cfg.causal else 12
+    attn = attn_factor * cfg.n_layers * cfg.n_heads * cfg.head_dim \
+        * seq_len
     return 6 * n_matmul + attn
 
 
@@ -263,7 +268,7 @@ def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
     if cfg.pos_emb == "rope":
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
-    attn = multi_head_attention(q, k, v, causal=True,
+    attn = multi_head_attention(q, k, v, causal=cfg.causal,
                                 impl=cfg.attention_impl)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
 

@@ -173,3 +173,65 @@ def test_chunked_lm_loss_parity():
                         jax.tree_util.tree_leaves(gc)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, rtol=1e-5)
+
+
+def test_vit_learns_and_shards():
+    """ViT family: tiny model learns a synthetic bars task; the same
+    params shard over a dp×fsdp mesh via the shared logical-axis rules."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import (ViTConfig, init_vit_params,
+                                make_vit_train_step, vit_forward)
+    from ray_tpu.parallel import (FSDP_TP_RULES, MeshSpec, create_mesh,
+                                  pytree_shardings)
+
+    cfg = ViTConfig.tiny()
+    key = jax.random.PRNGKey(0)
+    params, axes = init_vit_params(key, cfg)  # axes validated by the
+    # pytree_shardings call below (tuple leaves, same tree shape)
+
+    def make_batch(k, n=64):
+        kk, kl = jax.random.split(k)
+        labels = jax.random.randint(kl, (n,), 0, 4)
+        imgs = jnp.zeros((n, 16, 16, 1))
+        # class c -> a bright bar at row/col band c*4 (rows for even c,
+        # cols for odd), plus noise
+        for c in range(4):
+            band = jnp.zeros((16, 16, 1))
+            if c % 2 == 0:
+                band = band.at[c * 4:(c * 4) + 4, :, :].set(1.0)
+            else:
+                band = band.at[:, c * 4:(c * 4) + 4, :].set(1.0)
+            imgs = jnp.where((labels == c)[:, None, None, None],
+                             band[None], imgs)
+        imgs = imgs + 0.05 * jax.random.normal(kk, imgs.shape)
+        return {"image": imgs, "label": labels}
+
+    opt = optax.adam(3e-3)
+    step = jax.jit(make_vit_train_step(cfg, opt))
+    opt_state = opt.init(params)
+    losses = []
+    for i in range(30):
+        batch = make_batch(jax.random.PRNGKey(100 + i))
+        params, opt_state, m = step(params, opt_state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    eval_batch = make_batch(jax.random.PRNGKey(999))
+    logits = vit_forward(params, eval_batch["image"], cfg)
+    acc = float((jnp.argmax(logits, -1) == eval_batch["label"]).mean())
+    assert acc > 0.8, acc
+
+    # sharded: the SAME jitted train step runs over a dp×fsdp mesh
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=-1))
+    shardings = pytree_shardings(axes, mesh, FSDP_TP_RULES)
+    sharded = jax.device_put(params, shardings)
+    with jax.set_mesh(mesh):
+        s_opt_state = opt.init(sharded)
+        s_step = jax.jit(make_vit_train_step(cfg, opt))
+        sharded, s_opt_state, m = s_step(sharded, s_opt_state,
+                                         eval_batch)
+        out = vit_forward(sharded, eval_batch["image"], cfg)
+    assert float(m["loss"]) > 0.0
+    assert out.shape == (64, 4)
