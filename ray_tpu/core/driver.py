@@ -156,6 +156,9 @@ class CoreClient:
         self._submit_q: deque = deque()
         self._submit_scheduled = False
         self._submit_lock = threading.Lock()
+        # oids shipped nested while their value was still pending: the
+        # plasma promotion runs when the inline result arrives
+        self._promote_on_arrival: set = set()
         self._local_refs: Dict[bytes, int] = {}
         self._owned: set = set()        # oids this process created (owner frees)
         self._plasma_oids: set = set()  # oids known to live in shared memory
@@ -277,6 +280,8 @@ class CoreClient:
                 self._containers.add(oid.binary())
             self._notify_controller("ref_inc", {
                 "object_ids": contained, "holder": f"obj:{oid.hex()}"})
+            for b in contained:
+                self._promote_to_plasma(b)  # readers fetch them directly
         if size <= GlobalConfig.max_direct_call_object_size:
             self.memory_store.put(oid.binary(), b"".join(bytes(p) for p in parts))
         else:
@@ -307,6 +312,47 @@ class CoreClient:
                 self._spilled_paths[oid.binary()] = path
             self.memory_store.put_in_plasma_marker(oid.binary())
         return ObjectRef(oid, self)
+
+    def _promote_to_plasma(self, oid: bytes) -> None:
+        """Make a memory-store-only object fetchable by OTHER processes.
+
+        Small put()/return values live only in the owner's private
+        memory store; a ref to one that ships NESTED inside a container
+        (task arg dict, DataIterator, put() payload) deserializes in a
+        worker that has nowhere to fetch the value from — positional
+        ARG_REFs dodge this via inline-at-resolve, nested refs cannot.
+        Promotion mirrors put()'s plasma path: shm write, nodelet
+        primary pin, plasma marker locally."""
+        entry = self.memory_store.peek(oid)
+        if entry is None:
+            # value still pending (a nested ref to a running task's
+            # return): promote when the inline result LANDS — see
+            # _handle_task_reply — or the consumer could never fetch it
+            with self._ref_lock:
+                self._promote_on_arrival.add(oid)
+            return
+        if entry.value is IN_PLASMA or entry.is_exception \
+                or self.store.contains(oid):
+            return
+        parts = [memoryview(entry.value)]
+        size = len(entry.value)
+        try:
+            self.store.put_parts(oid, parts)
+            bridge = self.store.get(oid, timeout_ms=0) is not None
+            try:
+                self.nodelet.call("put_location",
+                                  {"object_id": oid, "size": size})
+            finally:
+                if bridge:
+                    self.store.release(oid)
+            with self._ref_lock:
+                self._plasma_oids.add(oid)
+        except store_client.StoreFullError:
+            path = spill.write_object(oid, parts)
+            self.controller.call(
+                "kv_put", {**spill.kv_entry(oid), "value": path.encode()})
+            self._spilled_paths[oid] = path
+        self.memory_store.put_in_plasma_marker(oid)
 
     # ------------------------------------------------------------------- get
     def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
@@ -515,6 +561,9 @@ class CoreClient:
         encoded.append(self._encode_arg(kwargs or {}, temp_refs, nested))
         for b in nested:
             temp_refs.append(ObjectRef(ObjectID(b), self))
+            # the consumer deserializes this ref OUT of a container and
+            # fetches it itself — the value must be shared, not private
+            self._promote_to_plasma(b)
         return encoded, temp_refs
 
     def _encode_arg(self, value: Any, temp_refs: List["ObjectRef"],
@@ -902,6 +951,12 @@ class CoreClient:
                     self._containers.add(oid.binary())
             if "inline" in ret:
                 self.memory_store.put(oid.binary(), ret["inline"])
+                with self._ref_lock:
+                    promote = oid.binary() in self._promote_on_arrival
+                    self._promote_on_arrival.discard(oid.binary())
+                if promote:
+                    # a nested ref to this value already shipped; share it
+                    self._promote_to_plasma(oid.binary())
             else:
                 with self._ref_lock:
                     self._plasma_oids.add(oid.binary())

@@ -229,6 +229,14 @@ class WorkerRuntime:
                 # (reference_count.h "contained in owned object" edges).
                 await self.controller.notify("ref_inc", {
                     "object_ids": contained, "holder": f"obj:{oid.hex()}"})
+                # a nested ref whose value lives only in THIS worker's
+                # private memory store (e.g. a small api.put here) must
+                # be shared or the caller can never fetch it
+                core = _get_worker_core()
+                if core is not None:
+                    for b in contained:
+                        await self._loop.run_in_executor(
+                            None, core._promote_to_plasma, b)
             if size <= GlobalConfig.max_direct_call_object_size:
                 out.append({"inline": b"".join(bytes(p) for p in parts),
                             "contained": bool(contained)})

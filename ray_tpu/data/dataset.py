@@ -147,6 +147,51 @@ def _exec_sort(refs: List[Any], meta: List[BlockMetadata],
     return out_refs, api.get(metas, timeout=600.0)
 
 
+
+def _batches_from_blocks(blocks: Iterator[Block], batch_size: int,
+                         batch_format: str,
+                         drop_last: bool) -> Iterator[Any]:
+    """ONE batching loop for Dataset.iter_batches and DataIterator:
+    stream fixed-size batches across block boundaries with a carry."""
+    carry: Optional[Block] = None
+    for block in blocks:
+        if carry is not None:
+            block = BlockAccessor.combine([carry, block])
+            carry = None
+        acc = BlockAccessor(block)
+        rows = acc.num_rows()
+        start = 0
+        while rows - start >= batch_size:
+            piece = BlockAccessor(acc.slice(start, start + batch_size))
+            yield piece.to_batch(batch_format)
+            start += batch_size
+        if start < rows:
+            carry = acc.slice(start, rows)
+    if carry is not None and not drop_last:
+        yield BlockAccessor(carry).to_batch(batch_format)
+
+
+def _torch_convert(batch: Any, dtypes, device) -> Any:
+    """numpy batch -> torch tensors with optional dtype/device moves —
+    shared by Dataset.iter_torch_batches and DataIterator."""
+    import torch
+
+    def _tensor(arr, column=None):
+        t = torch.as_tensor(np.ascontiguousarray(arr))
+        if isinstance(dtypes, dict):
+            if column in dtypes:
+                t = t.to(dtypes[column])
+        elif dtypes is not None:
+            t = t.to(dtypes)
+        if device is not None:
+            t = t.to(device)
+        return t
+
+    if isinstance(batch, dict):
+        return {k: _tensor(v, k) for k, v in batch.items()}
+    return _tensor(batch)
+
+
 class Dataset:
     """Distributed rows in object-store blocks, built lazily.
 
@@ -319,6 +364,31 @@ class Dataset:
             out.append(Dataset(self._blocks[i::n], self._meta[i::n]))
         return out
 
+    def streaming_split(self, n: int, *,
+                        equal: bool = False) -> List["DataIterator"]:
+        """N iterators that CONCURRENT consumers (e.g. Train workers)
+        drain together, each block consumed exactly once (reference:
+        `Dataset.streaming_split` — the coordinated ingest path).
+        Unlike `split`, assignment is dynamic: a slow consumer takes
+        fewer blocks instead of stalling the epoch.  With ``equal`` the
+        dataset repartitions to one block per iterator first."""
+        if equal:
+            # STATIC assignment: SPMD consumers (train workers doing
+            # collectives) need identical batch counts, so each
+            # iterator owns exactly one equal block — no coordinator,
+            # nothing to leak
+            ds = self.repartition(n)
+            blocks, meta = ds._blocks, ds._meta
+            return [DataIterator(blocks, meta, None, static_indices=[i])
+                    for i in builtins.range(n)]
+        blocks, meta = self._blocks, self._meta
+        # one coordinator actor per split, reclaimed with the job (it
+        # is not detached); epochs reuse it instead of re-splitting
+        coord = api.remote(_SplitCoordinator).options(
+            num_cpus=0.01).remote(len(blocks))
+        return [DataIterator(blocks, meta, coord)
+                for _ in builtins.range(n)]
+
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
         # Slice in the blocks' NATIVE representation — coercing through
         # pandas would silently turn list-block scalar rows into
@@ -376,23 +446,9 @@ class Dataset:
                      batch_format: str = "numpy",
                      drop_last: bool = False) -> Iterator[Any]:
         """Stream batches across block boundaries (Train ingest path)."""
-        carry: Optional[Block] = None
-        for ref in self._blocks:
-            block = api.get(ref, timeout=300.0)
-            if carry is not None:
-                block = BlockAccessor.combine([carry, block])
-                carry = None
-            acc = BlockAccessor(block)
-            rows = acc.num_rows()
-            start = 0
-            while rows - start >= batch_size:
-                piece = BlockAccessor(acc.slice(start, start + batch_size))
-                yield piece.to_batch(batch_format)
-                start += batch_size
-            if start < rows:
-                carry = acc.slice(start, rows)
-        if carry is not None and not drop_last:
-            yield BlockAccessor(carry).to_batch(batch_format)
+        blocks = (api.get(ref, timeout=300.0) for ref in self._blocks)
+        yield from _batches_from_blocks(blocks, batch_size, batch_format,
+                                        drop_last)
 
     def iter_torch_batches(self, *, batch_size: int = 256,
                            dtypes=None, device: Optional[str] = None,
@@ -401,26 +457,10 @@ class Dataset:
         `Dataset.iter_torch_batches` — the Torch ingest path).  Columnar
         batches become {column: tensor}; array batches become one
         tensor."""
-        import torch
-
-        def _tensor(arr, column=None):
-            t = torch.as_tensor(np.ascontiguousarray(arr))
-            if isinstance(dtypes, dict):
-                if column in dtypes:
-                    t = t.to(dtypes[column])
-            elif dtypes is not None:
-                t = t.to(dtypes)
-            if device is not None:
-                t = t.to(device)
-            return t
-
         for batch in self.iter_batches(batch_size=batch_size,
                                        batch_format="numpy",
                                        drop_last=drop_last):
-            if isinstance(batch, dict):
-                yield {k: _tensor(v, k) for k, v in batch.items()}
-            else:
-                yield _tensor(batch)
+            yield _torch_convert(batch, dtypes, device)
 
     def to_pandas(self):
         blocks = [BlockAccessor(api.get(r, timeout=300.0)).to_pandas()
@@ -523,3 +563,76 @@ class Dataset:
                     f"lazy stages={self._plan.stage_names()})")
         return (f"Dataset(num_blocks={self.num_blocks()}, "
                 f"num_rows={self._meta[0].num_rows and self.count()})")
+
+
+class _SplitCoordinator:
+    """Actor handing out block indices to streaming-split consumers —
+    each index exactly once PER EPOCH, dynamically (reference: the
+    streaming split coordinator in _internal/execution).  An epoch is
+    one full pass; each call of DataIterator.iter_batches opens the
+    consumer's next epoch, so standard multi-epoch training loops work
+    without explicit resets."""
+
+    def __init__(self, n_blocks: int):
+        self._n = n_blocks
+        self._pos: Dict[int, int] = {}   # epoch -> next unassigned index
+
+    def next_block_index(self, epoch: int) -> Optional[int]:
+        i = self._pos.get(epoch, 0)
+        if i >= self._n:
+            return None
+        self._pos[epoch] = i + 1
+        # old epochs never get new requests once every consumer moved on;
+        # drop them so the dict stays bounded
+        for e in [e for e in self._pos if e < epoch - 2]:
+            del self._pos[e]
+        return i
+
+
+class DataIterator:
+    """One streaming-split consumer's view (reference: DataIterator).
+    Picklable — block refs and the coordinator handle ship to worker
+    actors.  Dynamic mode pulls coordinator-assigned blocks (a slow
+    consumer takes fewer); ``equal`` mode iterates a fixed block
+    subset so every SPMD consumer sees the same batch count.  Each
+    ``iter_batches`` call is one epoch; iterating again replays the
+    dataset."""
+
+    def __init__(self, blocks: List[Any], meta: List[BlockMetadata],
+                 coord: Optional[Any],
+                 static_indices: Optional[List[int]] = None):
+        self._block_refs = list(blocks)
+        self._meta = list(meta)
+        self._coord = coord
+        self._static = static_indices
+        self._epoch = 0
+
+    def _assigned_blocks(self) -> Iterator[Block]:
+        if self._static is not None:
+            for i in self._static:
+                yield api.get(self._block_refs[i], timeout=300.0)
+            return
+        epoch = self._epoch
+        while True:
+            idx = api.get(self._coord.next_block_index.remote(epoch),
+                          timeout=300.0)
+            if idx is None:
+                return
+            yield api.get(self._block_refs[idx], timeout=300.0)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        try:
+            yield from _batches_from_blocks(
+                self._assigned_blocks(), batch_size, batch_format,
+                drop_last)
+        finally:
+            self._epoch += 1
+
+    def iter_torch_batches(self, *, batch_size: int = 256, dtypes=None,
+                           device: Optional[str] = None,
+                           drop_last: bool = False) -> Iterator[Any]:
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield _torch_convert(batch, dtypes, device)

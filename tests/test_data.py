@@ -341,3 +341,51 @@ def test_lazy_sibling_branches_read_once(cluster, tmp_path):
     assert a.count() == 2
     assert b.count() == 2
     assert len(list(marker.iterdir())) == 2  # each read task ran ONCE
+
+
+def test_streaming_split_concurrent_consumers(cluster):
+    """streaming_split: N consumers drain one dataset concurrently,
+    every block consumed exactly once, dynamic assignment (reference:
+    Dataset.streaming_split -> DataIterator per Train worker)."""
+    ds = rdata.from_items(list(range(200)), parallelism=8)
+    it_a, it_b = ds.streaming_split(2)
+
+    @ray_tpu.remote
+    def consume(it, delay):
+        import time
+        seen = []
+        for batch in it.iter_batches(batch_size=10):
+            seen.extend(int(x) for x in batch)
+            time.sleep(delay)
+        return seen
+
+    a, b = ray_tpu.get([consume.remote(it_a, 0.0),
+                        consume.remote(it_b, 0.02)], timeout=120)
+    assert sorted(a + b) == list(range(200))
+    assert a and b, "both consumers should get work"
+    # dynamic assignment: the fast consumer takes more rows
+    assert len(a) >= len(b)
+
+
+def test_streaming_split_epochs_and_equal(cluster):
+    ds = rdata.from_items(list(range(60)), parallelism=6)
+    it_a, it_b = ds.streaming_split(2)
+    # two epochs through the same iterators replay the dataset
+    for _ in range(2):
+        rows = []
+        for it in (it_a, it_b):
+            for batch in it.iter_batches(batch_size=10):
+                rows.extend(int(x) for x in batch)
+        assert sorted(rows) == list(range(60))
+
+    # equal mode: fixed per-consumer assignment with equal row counts
+    eq = ds.streaming_split(2, equal=True)
+    counts = []
+    all_rows = []
+    for it in eq:
+        rows = [int(x) for b in it.iter_batches(batch_size=10)
+                for x in b]
+        counts.append(len(rows))
+        all_rows.extend(rows)
+    assert counts[0] == counts[1] == 30
+    assert sorted(all_rows) == list(range(60))
