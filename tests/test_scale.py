@@ -22,7 +22,11 @@ pytestmark = pytest.mark.skipif(
 
 @pytest.fixture(scope="module")
 def cluster():
-    c = Cluster()
+    # generous heartbeat: this module measures THROUGHPUT under load
+    # bursts that legitimately lag the shared-core event loops for
+    # seconds — the default test timeout (2s) false-positives a node
+    # death mid-burst (failure detection has its own tests)
+    c = Cluster(heartbeat_timeout_s=15.0)
     for _ in range(2):
         c.add_node(num_cpus=8, object_store_memory=256 * 1024 * 1024)
     c.connect()
