@@ -2,11 +2,11 @@
 
 from __future__ import annotations
 
-import socket
 from typing import Any, Dict, Optional, Union
 
 from .. import api as core_api
 from ..core.serialization import dumps_function
+from ..parallel.coordinator import _free_port
 from .config import HTTPOptions
 from .controller import ServeController
 from .deployment import Deployment
@@ -14,12 +14,6 @@ from .handle import ServeHandle
 from .router import Router
 
 _state: Dict[str, Any] = {}
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
 
 
 def start(http_options: Optional[HTTPOptions] = None, *,

@@ -9,6 +9,7 @@ schedulers stop/exploit trials mid-flight, searchers feed new configs.
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import os
 import shutil
@@ -16,6 +17,8 @@ import tempfile
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
 
 from .. import api
 from ..air.checkpoint import Checkpoint
@@ -132,9 +135,6 @@ class Tuner:
         param_space = self.param_space
         if self._restore_state is not None and \
                 self._restore_state.get("param_space_blob"):
-            import base64
-
-            import cloudpickle
             param_space = cloudpickle.loads(base64.b64decode(
                 self._restore_state["param_space_blob"]))
         searcher = cfg.search_alg or BasicVariantGenerator(
@@ -205,9 +205,6 @@ class _TrialRunner:
         self._dirty = False
         if restore_state:
             if restore_state.get("searcher_blob"):
-                import base64
-
-                import cloudpickle
                 try:
                     self.searcher = cloudpickle.loads(base64.b64decode(
                         restore_state["searcher_blob"]))
@@ -218,9 +215,6 @@ class _TrialRunner:
     # -- experiment state persistence (reference: experiment_state json +
     # Tuner.restore) --------------------------------------------------------
     def _seed_from(self, saved: Dict[str, Any]) -> None:
-        import base64
-
-        import cloudpickle
         for row in saved.get("trials", []):
             t = Trial(
                 config=cloudpickle.loads(base64.b64decode(row["config"])),
@@ -249,10 +243,7 @@ class _TrialRunner:
         if not self._dirty and not force:
             return   # nothing changed since the last write — the poll
         self._dirty = False   # loop runs sub-second; don't churn disk
-        import base64
         import json as _json
-
-        import cloudpickle
         rows = []
         for t in self.trials:
             rows.append({
