@@ -89,3 +89,44 @@ def test_many_placement_groups_100(cluster):
     print(f"\n[scale] {N} PGs created in {created:.1f}s, "
           f"create+remove {dt:.1f}s -> {N / dt:.0f} PGs/s")
     assert created < 120
+
+
+def test_get_10k_objects_single_call(cluster):
+    """BASELINE row: 10,000+ plasma objects in one ray.get
+    (release/benchmarks/README.md:24-33, scaled to this host)."""
+    refs = [ray_tpu.put(i) for i in range(10_000)]
+    t0 = time.perf_counter()
+    vals = ray_tpu.get(refs, timeout=300.0)
+    dt = time.perf_counter() - t0
+    assert vals == list(range(10_000))
+    print(f"\n[scale] get(10k objects) in {dt:.2f}s")
+
+
+def test_task_with_10k_object_args(cluster):
+    """BASELINE row: 10,000+ object args to a single task."""
+    refs = [ray_tpu.put(1) for _ in range(10_000)]
+
+    @ray_tpu.remote
+    def total(*xs):
+        return sum(xs)
+
+    t0 = time.perf_counter()
+    assert ray_tpu.get(total.remote(*refs), timeout=300.0) == 10_000
+    print(f"[scale] task with 10k ref args in "
+          f"{time.perf_counter() - t0:.2f}s")
+
+
+def test_task_with_3k_returns(cluster):
+    """BASELINE row: 3,000+ objects returned from a single task."""
+    N = 3_000
+
+    @ray_tpu.remote(num_returns=N)
+    def burst():
+        return list(range(N))
+
+    t0 = time.perf_counter()
+    refs = burst.remote()
+    vals = ray_tpu.get(refs, timeout=300.0)
+    assert vals == list(range(N))
+    print(f"\n[scale] task with {N} returns in "
+          f"{time.perf_counter() - t0:.2f}s")
