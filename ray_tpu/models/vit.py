@@ -21,7 +21,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .transformer import TransformerConfig, _layer, _norm, init_params
+from .transformer import (TransformerConfig, _layer, _norm, init_params,
+                          remat_policy)
 
 Params = Any
 
@@ -149,9 +150,9 @@ def vit_forward(params: Params, images: jnp.ndarray,
     x = jnp.concatenate([cls, x], axis=1) + params["pos"].astype(dt)
 
     layer = functools.partial(_layer, bc)
-    if cfg.remat:
-        layer = jax.checkpoint(
-            layer, policy=jax.checkpoint_policies.nothing_saveable)
+    policy = remat_policy(cfg.remat)
+    if policy is not None:
+        layer = jax.checkpoint(layer, policy=policy)
 
     def body(h, lp):
         h, _aux = layer(h, lp, None, None)

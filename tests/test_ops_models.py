@@ -148,6 +148,48 @@ def test_flash_kernel_interpret_mode_parity(monkeypatch):
                                    atol=5e-4, rtol=5e-4)
 
 
+def test_flash_kernel_interpret_mode_bf16(monkeypatch):
+    """bf16 inputs through the kernels' production dtype path: the MXU
+    dots take bf16 operands with fp32 accumulation, and the bwd kernels
+    deliberately truncate p/ds to bf16 — the fp32 parity test above
+    makes every one of those casts a no-op, so this case is what
+    actually exercises them off-chip.  Mixed fp32-q/bf16-kv is included
+    for the entry-point dtype normalization."""
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import reference_attention
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(k1, (1, 128, 4, 32), jnp.bfloat16)
+    k = jax.random.normal(k2, (1, 128, 2, 32), jnp.bfloat16)  # GQA
+    v = jax.random.normal(k3, (1, 128, 2, 32), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = reference_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                              v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+    loss_f = lambda *a: (flash_attention(*a, causal=True)
+                         .astype(jnp.float32) ** 2).sum()
+    loss_r = lambda *a: (reference_attention(*a, causal=True)
+                         .astype(jnp.float32) ** 2).sum()
+    g_f = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_r, argnums=(0, 1, 2))(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32))
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), atol=0.25, rtol=0.25)
+
+    # mixed dtypes: fp32 query against a bf16 KV cache must not trace-fail
+    out_mixed = flash_attention(q.astype(jnp.float32), k, v, causal=True)
+    assert out_mixed.dtype == jnp.float32
+
+
 def test_chunked_lm_loss_parity():
     """Chunked cross entropy (one [b, chunk, vocab] logits block at a
     time) matches the full-logits loss in value AND gradients."""
