@@ -58,6 +58,10 @@ class TransformerConfig:
     #   the backward pass — most of full remat's memory win at zero extra
     #   MXU work (matmuls are never recomputed).  On one v5e chip this is
     #   what lets gpt2-small train at batch 32 instead of 8.
+    norm_remat: bool = False          # recompute layernorm/rmsnorm in bwd
+    #   instead of saving their fp32 intermediates — on v5e those saves
+    #   ([b, s, d] fp32 x 2 per layer) are what keep gpt2-small from
+    #   fitting batch 16 without full remat
     loss_chunk: int = 0               # >0 → chunked cross entropy: logits
     #   materialize [b, chunk, vocab] at a time (rematerialized in bwd)
     #   instead of the full [b, s, vocab] fp32 tensor — the biggest HBM
@@ -279,7 +283,12 @@ def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
     h, hk, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     dt = cfg.dtype
 
-    y = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
+    norm = functools.partial(_norm, cfg)
+    if cfg.norm_remat:
+        norm = jax.checkpoint(
+            norm, policy=jax.checkpoint_policies.nothing_saveable)
+
+    y = norm(x, lp["attn_norm"], lp.get("attn_norm_b"))
     q = jnp.einsum("bsd,dhk->bshk", y, lp["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", y, lp["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", y, lp["wv"].astype(dt))
@@ -290,7 +299,7 @@ def _layer(cfg: TransformerConfig, x: jnp.ndarray, lp: Params,
                                 impl=cfg.attention_impl)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"].astype(dt))
 
-    y = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+    y = norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
     z, aux = _ffn(cfg, y, lp)
     return x + z, aux
 
@@ -381,8 +390,11 @@ def forward_with_aux(params: Params, tokens: jnp.ndarray,
     as a GPipe pipeline over the ambient mesh's ``pp`` axis
     (parallel/pipeline.py); otherwise a plain `lax.scan`."""
     x, aux = _trunk(params, tokens, cfg)
-    logits = jnp.einsum("bsd,dv->bsv", x, _unembed(params, cfg))
-    return logits.astype(jnp.float32), aux
+    # fp32 MXU accumulation straight out of the dot — rounding the logits
+    # through bf16 first would cost ~3 decimal digits on a 50k-way softmax
+    logits = jnp.einsum("bsd,dv->bsv", x, _unembed(params, cfg),
+                        preferred_element_type=jnp.float32)
+    return logits, aux
 
 
 def forward(params: Params, tokens: jnp.ndarray,
@@ -434,8 +446,8 @@ def lm_loss(params: Params, batch: Dict[str, jnp.ndarray],
         vc = jnp.swapaxes(valid.reshape(b, n, cfg.loss_chunk), 0, 1)
 
         def chunk_sum(xi, ti, vi):
-            logits = jnp.einsum("bcd,dv->bcv", xi,
-                                w_out).astype(jnp.float32)
+            logits = jnp.einsum("bcd,dv->bcv", xi, w_out,
+                                preferred_element_type=jnp.float32)
             ls = optax.softmax_cross_entropy_with_integer_labels(logits, ti)
             return (ls * vi).sum()
 

@@ -17,7 +17,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .flash_attention import flash_attention
+from .flash_attention import _default_blocks, fit_block, flash_attention
 
 
 def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
@@ -61,8 +61,12 @@ def _flash_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
     s_q, s_kv, d = q.shape[1], k.shape[1], q.shape[-1]
     if d % 64:
         return False
-    bq, bk = min(128, s_q), min(128, s_kv)
-    return s_q % bq == 0 and s_kv % bk == 0
+    dbq, dbk = _default_blocks()
+    bq, bk = fit_block(dbq, s_q), fit_block(dbk, s_kv)
+    # eligible when a full-sized (>=128) block divides the seq, or the
+    # whole (short) seq is one block — same shape set the 128x128
+    # defaults accepted, now independent of the configured block size
+    return (bq >= 128 or bq == s_q) and (bk >= 128 or bk == s_kv)
 
 
 def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
