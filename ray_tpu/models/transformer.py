@@ -58,6 +58,8 @@ class TransformerConfig:
     #   the backward pass — most of full remat's memory win at zero extra
     #   MXU work (matmuls are never recomputed).  On one v5e chip this is
     #   what lets gpt2-small train at batch 32 instead of 8.
+    embed_impl: str = "gather"        # "gather" | "one_hot" (MXU-matmul
+    #   embedding: gather-bwd is a serialized scatter-add on TPU)
     norm_remat: bool = False          # recompute layernorm/rmsnorm in bwd
     #   instead of saving their fp32 intermediates — on v5e those saves
     #   ([b, s, d] fp32 x 2 per layer) are what keep gpt2-small from
@@ -333,7 +335,17 @@ def _trunk(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig
     tokens [b, s] → (hidden [b, s, d] in cfg.dtype, mean router aux)."""
     b, s = tokens.shape
     dt = cfg.dtype
-    x = params["embed"]["tok"][tokens].astype(dt)
+    if cfg.embed_impl == "one_hot":
+        # gather's backward is a scatter-add into [vocab, d] — serialized
+        # and slow on TPU; the one-hot formulation turns fwd AND bwd into
+        # MXU matmuls ([b*s, vocab] @ [vocab, d])
+        oh = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=dt)
+        x = jnp.einsum("bsv,vd->bsd", oh, params["embed"]["tok"].astype(dt))
+    elif cfg.embed_impl == "gather":
+        x = params["embed"]["tok"][tokens].astype(dt)
+    else:  # a typo must not silently mean the gather path (cf. remat_policy)
+        raise ValueError(f"embed_impl={cfg.embed_impl!r}: expected "
+                         f"'gather' or 'one_hot'")
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["pos"][:s].astype(dt)
     cos, sin = (rotary_angles(s, cfg.head_dim, cfg.rope_base)

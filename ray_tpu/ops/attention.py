@@ -63,10 +63,12 @@ def _flash_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
         return False
     dbq, dbk = _default_blocks()
     bq, bk = fit_block(dbq, s_q), fit_block(dbk, s_kv)
-    # eligible when a full-sized (>=128) block divides the seq, or the
-    # whole (short) seq is one block — same shape set the 128x128
-    # defaults accepted, now independent of the configured block size
-    return (bq >= 128 or bq == s_q) and (bk >= 128 or bk == s_kv)
+    # eligible when a block no smaller than the configured one (capped at
+    # the classic 128 floor) divides the seq, or the whole (short) seq is
+    # one block — so env-configured sub-128 sweeps still take the flash
+    # path instead of silently measuring unfused attention
+    return (bq >= min(128, dbq) or bq == s_q) and \
+        (bk >= min(128, dbk) or bk == s_kv)
 
 
 def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,

@@ -391,9 +391,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     """Fused attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     KV heads may be a divisor of query heads (GQA/MQA).  Differentiable via
-    flash backward kernels.  Raises if seq lengths don't divide the block
-    sizes — use `multi_head_attention` for automatic fallback.  Block sizes
-    default from `_default_blocks()` (env-tunable) when not given.
+    flash backward kernels.  Block sizes default from `_default_blocks()`
+    (env-tunable) when not given, and are clamped (halving search) to the
+    largest divisor of each seq length; raises only when no divisor >= 8
+    exists — use `multi_head_attention` for automatic fallback.
     """
     dq, dk_ = _default_blocks()
     if block_q is None:

@@ -32,6 +32,7 @@ from .schedulers import (  # noqa: F401
 from .syncer import SyncConfig, Syncer  # noqa: F401
 from .search import (  # noqa: F401
     BasicVariantGenerator,
+    BOHBSearch,
     OptunaSearch,
     Searcher,
     TPESearch,

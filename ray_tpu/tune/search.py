@@ -165,15 +165,21 @@ class TPESearch(Searcher):
         return dom.categories[int(self.rng.choice(len(dom.categories),
                                                   p=p))]
 
+    def _observations(self) -> List[tuple]:
+        """(config, objective-to-minimize) pairs the model learns from;
+        BOHBSearch overrides this with per-budget selection."""
+        return self._history
+
     # -- Searcher interface -------------------------------------------------
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         from .sample import Categorical, Function
         cfg = dict(self.consts)
+        obs = self._observations()
         # max(1, ...): the KDE path needs at least one observation
-        startup = len(self._history) < max(1, self.n_startup)
+        startup = len(obs) < max(1, self.n_startup)
         if not startup:
-            cut = max(1, int(np.ceil(self.gamma * len(self._history))))
-            ranked = sorted(self._history, key=lambda t: t[1])
+            cut = max(1, int(np.ceil(self.gamma * len(obs))))
+            ranked = sorted(obs, key=lambda t: t[1])
             good_cfgs = [c for c, _ in ranked[:cut]]
             bad_cfgs = [c for c, _ in ranked[cut:]] or good_cfgs
         for k, dom in self.domains.items():
@@ -205,6 +211,49 @@ class TPESearch(Searcher):
         if self.mode == "max":
             val = -val
         self._history.append((cfg, val))
+
+
+class BOHBSearch(TPESearch):
+    """BOHB — Bayesian Optimization + HyperBand (capability mirror of the
+    reference's `tune/search/bohb/bohb_search.py` paired with
+    `tune/schedulers/hb_bohb.py`).  Pair it with ASHAScheduler /
+    HyperBandScheduler: the scheduler provides the successive-halving
+    budgets, while this searcher builds a TPE model **per budget** from
+    every intermediate result and suggests from the largest budget that
+    has enough observations — so low-rung results steer the search long
+    before any trial reaches max_t."""
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", seed: Optional[int] = 0,
+                 n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24,
+                 time_attr: str = "training_iteration",
+                 min_points: Optional[int] = None):
+        super().__init__(param_space, metric, mode=mode, seed=seed,
+                         n_startup=n_startup, gamma=gamma,
+                         n_candidates=n_candidates)
+        self.time_attr = time_attr
+        # the classic BOHB rule of thumb: dims + 1 points before a budget's
+        # model is trusted
+        self.min_points = min_points or (len(self.domains) + 1)
+        self._budget_hist: Dict[int, List[tuple]] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
+        cfg = self._live.get(trial_id)
+        if cfg is None or self.metric not in result:
+            return
+        t = int(result.get(self.time_attr, 0))
+        val = float(result[self.metric])
+        if self.mode == "max":
+            val = -val
+        self._budget_hist.setdefault(t, []).append((dict(cfg), val))
+
+    def _observations(self) -> List[tuple]:
+        for t in sorted(self._budget_hist, reverse=True):
+            if len(self._budget_hist[t]) >= max(self.min_points,
+                                                self.n_startup):
+                return self._budget_hist[t]
+        return self._history  # completed trials (TPE fallback)
 
 
 class OptunaSearch(Searcher):

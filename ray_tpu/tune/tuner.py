@@ -356,6 +356,25 @@ class _TrialRunner:
                 return True
         return False
 
+    def _effective_concurrency(self) -> int:
+        """max_concurrent_trials capped by what the cluster can actually
+        schedule: a trial actor that can never get its CPUs would park
+        `_launch` on a 60 s init_session get and sink the whole run (hit
+        with the default 1-CPU local init and max_concurrent_trials > 1).
+        Recomputed every loop so autoscaled nodes raise the cap."""
+        per_trial = (self.cfg.trial_resources or {"CPU": 1.0}).get(
+            "CPU", 1.0)
+        if per_trial <= 0:
+            return self.cfg.max_concurrent_trials
+        try:
+            total = float(api.cluster_resources().get("CPU", 0.0))
+        except Exception:
+            return self.cfg.max_concurrent_trials
+        if total <= 0:
+            return self.cfg.max_concurrent_trials
+        return max(1, min(self.cfg.max_concurrent_trials,
+                          int(total // per_trial)))
+
     # -- event loop ---------------------------------------------------------
     def run(self) -> List[Trial]:
         # Model-based searchers (TPE/Optuna) suggest forever; num_samples
@@ -364,16 +383,16 @@ class _TrialRunner:
         max_trials = getattr(self.searcher, "total_trials",
                              self.cfg.num_samples)
         while True:
+            cap = self._effective_concurrency()
             # restored unfinished trials first, from their checkpoints
-            while self._resume and \
-                    len(self.running) < self.cfg.max_concurrent_trials:
+            while self._resume and len(self.running) < cap:
                 trial = self._resume.pop(0)
                 ckpt = (Checkpoint.from_directory(trial.checkpoint_dir)
                         if trial.checkpoint_dir else None)
                 self._launch(trial, checkpoint=ckpt)
             # refill to concurrency
             while not self._resume \
-                    and len(self.running) < self.cfg.max_concurrent_trials \
+                    and len(self.running) < cap \
                     and len(self.trials) < max_trials:
                 # suggest under the trial's OWN id: on_trial_result /
                 # on_trial_complete use trial.trial_id, and model-based

@@ -190,6 +190,31 @@ def test_flash_kernel_interpret_mode_bf16(monkeypatch):
     assert out_mixed.dtype == jnp.float32
 
 
+def test_one_hot_embed_parity():
+    """embed_impl='one_hot' (MXU-matmul embedding, avoids the slow TPU
+    scatter-add in gather's backward) matches the gather path in loss and
+    gradients exactly at fp32."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.transformer import lm_loss
+
+    base = dict(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+                n_kv_heads=2, max_seq_len=32, dtype=jnp.float32,
+                remat=False, attention_impl="reference")
+    c1 = TransformerConfig(**base)
+    c2 = TransformerConfig(embed_impl="one_hot", **base)
+    p, _ = init_params(jax.random.PRNGKey(0), c1)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (2, 32),
+                                          0, 64)}
+    l1, g1 = jax.value_and_grad(lambda pp: lm_loss(pp, batch, c1))(p)
+    l2, g2 = jax.value_and_grad(lambda pp: lm_loss(pp, batch, c2))(p)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_chunked_lm_loss_parity():
     """Chunked cross entropy (one [b, chunk, vocab] logits block at a
     time) matches the full-logits loss in value AND gradients."""

@@ -172,6 +172,119 @@ def test_tpe_search_beats_random_on_quadratic():
     assert tpe_best <= rand_best, (tpe_best, rand_best)
 
 
+def test_bohb_learns_from_intermediate_budgets():
+    """BOHBSearch builds TPE models from per-budget (rung) intermediate
+    results — suggestions improve BEFORE any trial completes, the property
+    that distinguishes BOHB from plain TPE (reference:
+    tune/search/bohb/bohb_search.py + schedulers/hb_bohb.py)."""
+    from ray_tpu.tune.search import BOHBSearch
+
+    def f(x):
+        return (x - 3.0) ** 2
+
+    bohb = BOHBSearch({"x": tune.uniform(-10, 10)}, metric="loss",
+                      mode="min", seed=0, n_startup=6, min_points=6)
+    # 12 trials report at budget t=1 but never complete
+    for i in range(12):
+        cfg = bohb.suggest(f"t{i}")
+        bohb.on_trial_result(f"t{i}", {"loss": f(cfg["x"]),
+                                       "training_iteration": 1})
+    assert bohb._history == []          # nothing completed
+    assert len(bohb._budget_hist[1]) == 12
+    # model-based now (budget-1 model has >= max(min_points, n_startup))
+    sug = [bohb.suggest(f"m{i}")["x"] for i in range(10)]
+    mean_err = float(np.mean([abs(x - 3.0) for x in sug]))
+    assert mean_err < 3.5, sug          # concentrated vs uniform (E=5.15)
+
+    # larger budgets dominate once populated: feed a DECOY optimum at
+    # budget 2 and check suggestions follow it
+    bohb2 = BOHBSearch({"x": tune.uniform(-10, 10)}, metric="loss",
+                       mode="min", seed=1, n_startup=4, min_points=4)
+    rng = np.random.default_rng(2)
+    for i in range(8):
+        cfg = bohb2.suggest(f"a{i}")
+        bohb2.on_trial_result(f"a{i}", {"loss": f(cfg["x"]),
+                                        "training_iteration": 1})
+    for i in range(8):   # budget-2 observations say optimum is at -6
+        x = float(rng.uniform(-10, 10))
+        bohb2._live[f"b{i}"] = {"x": x}
+        bohb2.on_trial_result(f"b{i}", {"loss": (x + 6.0) ** 2,
+                                        "training_iteration": 2})
+    obs = bohb2._observations()
+    assert obs is bohb2._budget_hist[2]
+
+
+def test_bohb_with_tuner_and_asha(cluster, tmp_path):
+    """BOHB end to end: ASHA gives the budgets, BOHBSearch consumes every
+    intermediate result through the runner's on_trial_result plumbing."""
+    from ray_tpu.tune.schedulers import ASHAScheduler
+    from ray_tpu.tune.search import BOHBSearch
+
+    def objective(config):
+        for it in range(4):
+            session.report({"loss": (config["x"] - 2.0) ** 2 + 0.1 / (it + 1)})
+
+    space = {"x": tune.uniform(-5, 5)}
+    searcher = BOHBSearch(space, metric="loss", mode="min", seed=1,
+                          n_startup=4, min_points=4)
+    res = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=10,
+                               max_concurrent_trials=3,
+                               search_alg=searcher,
+                               scheduler=ASHAScheduler(
+                                   max_t=4, grace_period=1,
+                                   reduction_factor=2)),
+        run_config=RunConfig(name="bohb", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(res) == 10
+    assert sum(len(v) for v in searcher._budget_hist.values()) > 0
+    assert res.get_best_result().metrics["loss"] < 5.0
+
+
+_CAP_SCRIPT = """
+import tempfile
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, session
+from ray_tpu.tune import TuneConfig, Tuner
+
+ray_tpu.init(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+
+def objective(config):
+    for it in range(2):
+        session.report({"loss": (config["x"] - 1.0) ** 2 + it})
+
+res = Tuner(
+    objective,
+    param_space={"x": tune.uniform(-3, 3)},
+    tune_config=TuneConfig(metric="loss", mode="min", num_samples=3,
+                           max_concurrent_trials=2),
+    run_config=RunConfig(name="cap", storage_path=tempfile.mkdtemp()),
+).fit()
+assert len(res) == 3, len(res)
+assert all(r.metrics is not None for r in res)
+print("CAP_OK")
+ray_tpu.shutdown()
+"""
+
+
+def test_concurrency_capped_by_cluster_cpus():
+    """max_concurrent_trials beyond cluster capacity must degrade to
+    what fits, not park _launch on a 60 s init_session timeout: on a
+    1-CPU cluster a 2-concurrency sweep previously died with
+    GetTimeoutError before the first trial finished.  (Subprocess: the
+    module-scoped fixture cluster has 4 CPUs; this needs its own 1-CPU
+    runtime.)"""
+    import subprocess
+    import sys
+    proc = subprocess.run([sys.executable, "-c", _CAP_SCRIPT],
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "CAP_OK" in proc.stdout
+
+
 def test_tpe_with_tuner(cluster, tmp_path):
     """num_samples bounds a model-based searcher's trial budget."""
     from ray_tpu.tune.search import TPESearch
