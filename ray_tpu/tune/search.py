@@ -165,18 +165,28 @@ class TPESearch(Searcher):
         return dom.categories[int(self.rng.choice(len(dom.categories),
                                                   p=p))]
 
+    def _objective(self, result: Dict[str, Any]) -> float:
+        """The metric as an objective-to-minimize (shared by completion
+        and BOHB's per-budget intermediate recording)."""
+        val = float(result[self.metric])
+        return -val if self.mode == "max" else val
+
     def _observations(self) -> List[tuple]:
         """(config, objective-to-minimize) pairs the model learns from;
         BOHBSearch overrides this with per-budget selection."""
         return self._history
+
+    def _model_ready(self, obs: List[tuple]) -> bool:
+        """Whether ``obs`` is trustworthy enough to leave random startup;
+        BOHBSearch holds budget models to its own (lower) min_points bar."""
+        return len(obs) >= max(1, self.n_startup)
 
     # -- Searcher interface -------------------------------------------------
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         from .sample import Categorical, Function
         cfg = dict(self.consts)
         obs = self._observations()
-        # max(1, ...): the KDE path needs at least one observation
-        startup = len(obs) < max(1, self.n_startup)
+        startup = not self._model_ready(obs)
         if not startup:
             cut = max(1, int(np.ceil(self.gamma * len(obs))))
             ranked = sorted(obs, key=lambda t: t[1])
@@ -207,10 +217,7 @@ class TPESearch(Searcher):
         cfg = self._live.pop(trial_id, None)
         if cfg is None or error or not result or self.metric not in result:
             return
-        val = float(result[self.metric])
-        if self.mode == "max":
-            val = -val
-        self._history.append((cfg, val))
+        self._history.append((cfg, self._objective(result)))
 
 
 class BOHBSearch(TPESearch):
@@ -236,24 +243,36 @@ class BOHBSearch(TPESearch):
         # the classic BOHB rule of thumb: dims + 1 points before a budget's
         # model is trusted
         self.min_points = min_points or (len(self.domains) + 1)
-        self._budget_hist: Dict[int, List[tuple]] = {}
+        # {budget: {trial_id: (config, objective)}} — keyed per trial so a
+        # trial re-reporting at the same budget updates in place, and
+        # capped to the largest budgets so long runs can't grow unbounded
+        # (only the largest qualifying budget is ever modelled)
+        self._budget_hist: Dict[int, Dict[str, tuple]] = {}
+        self._max_budgets = 64
 
     def on_trial_result(self, trial_id: str, result: Dict[str, Any]):
-        cfg = self._live.get(trial_id)
+        # fall back to the result's own config: after a PBT/PB2 exploit
+        # relaunch the runner has completed-and-popped this trial's _live
+        # entry, and the mutated config only exists in the result stream
+        cfg = self._live.get(trial_id) or result.get("config")
         if cfg is None or self.metric not in result:
             return
         t = int(result.get(self.time_attr, 0))
-        val = float(result[self.metric])
-        if self.mode == "max":
-            val = -val
-        self._budget_hist.setdefault(t, []).append((dict(cfg), val))
+        self._budget_hist.setdefault(t, {})[trial_id] = \
+            (dict(cfg), self._objective(result))
+        while len(self._budget_hist) > self._max_budgets:
+            del self._budget_hist[min(self._budget_hist)]
 
     def _observations(self) -> List[tuple]:
         for t in sorted(self._budget_hist, reverse=True):
-            if len(self._budget_hist[t]) >= max(self.min_points,
-                                                self.n_startup):
-                return self._budget_hist[t]
+            if len(self._budget_hist[t]) >= self.min_points:
+                return list(self._budget_hist[t].values())
         return self._history  # completed trials (TPE fallback)
+
+    def _model_ready(self, obs: List[tuple]) -> bool:
+        if obs is self._history:
+            return super()._model_ready(obs)
+        return len(obs) >= max(1, self.min_points)
 
 
 class OptunaSearch(Searcher):

@@ -361,19 +361,25 @@ class _TrialRunner:
         schedule: a trial actor that can never get its CPUs would park
         `_launch` on a 60 s init_session get and sink the whole run (hit
         with the default 1-CPU local init and max_concurrent_trials > 1).
-        Recomputed every loop so autoscaled nodes raise the cap."""
+        The capacity lookup is an RPC, so it refreshes at most every 5 s
+        (the event loop iterates per 0.25 s result poll); autoscaled
+        nodes still raise the cap within one refresh."""
+        now = time.time()
+        if now - getattr(self, "_cap_checked", 0.0) < 5.0:
+            return self._cap
+        self._cap_checked = now
+        self._cap = self.cfg.max_concurrent_trials
         per_trial = (self.cfg.trial_resources or {"CPU": 1.0}).get(
             "CPU", 1.0)
-        if per_trial <= 0:
-            return self.cfg.max_concurrent_trials
-        try:
-            total = float(api.cluster_resources().get("CPU", 0.0))
-        except Exception:
-            return self.cfg.max_concurrent_trials
-        if total <= 0:
-            return self.cfg.max_concurrent_trials
-        return max(1, min(self.cfg.max_concurrent_trials,
-                          int(total // per_trial)))
+        if per_trial > 0:
+            try:
+                total = float(api.cluster_resources().get("CPU", 0.0))
+            except Exception:
+                total = 0.0
+            if total > 0:
+                self._cap = max(1, min(self.cfg.max_concurrent_trials,
+                                       int(total // per_trial)))
+        return self._cap
 
     # -- event loop ---------------------------------------------------------
     def run(self) -> List[Trial]:

@@ -211,7 +211,25 @@ def test_bohb_learns_from_intermediate_budgets():
         bohb2.on_trial_result(f"b{i}", {"loss": (x + 6.0) ** 2,
                                         "training_iteration": 2})
     obs = bohb2._observations()
-    assert obs is bohb2._budget_hist[2]
+    assert sorted(v for _, v in obs) == \
+        sorted(v for _, v in bohb2._budget_hist[2].values())
+
+    # min_points below n_startup leaves startup early on budget models:
+    # 4 budget-1 observations suffice when min_points=3 even though
+    # n_startup=8 (the completed-history bar)
+    bohb3 = BOHBSearch({"x": tune.uniform(-10, 10)}, metric="loss",
+                       mode="min", seed=3, n_startup=8, min_points=3)
+    for i in range(4):
+        cfg = bohb3.suggest(f"c{i}")
+        bohb3.on_trial_result(f"c{i}", {"loss": f(cfg["x"]),
+                                        "training_iteration": 1})
+    assert bohb3._model_ready(bohb3._observations())
+
+    # exploit-relaunch path: feedback with no _live entry still lands via
+    # the result's own config
+    bohb3.on_trial_result("ghost", {"loss": 1.0, "training_iteration": 2,
+                                    "config": {"x": 0.5}})
+    assert "ghost" in bohb3._budget_hist[2]
 
 
 def test_bohb_with_tuner_and_asha(cluster, tmp_path):
