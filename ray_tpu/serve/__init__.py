@@ -28,6 +28,11 @@ from .schema import (  # noqa: F401
     load_config,
     status,
 )
+from .air_integrations import (  # noqa: F401
+    PredictorDeployment,
+    json_to_ndarray,
+    ndarray_to_json,
+)
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
 from .deployment import Deployment, deployment  # noqa: F401

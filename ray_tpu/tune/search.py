@@ -261,7 +261,12 @@ class BOHBSearch(TPESearch):
         self._budget_hist.setdefault(t, {})[trial_id] = \
             (dict(cfg), self._objective(result))
         while len(self._budget_hist) > self._max_budgets:
-            del self._budget_hist[min(self._budget_hist)]
+            # evict the SPARSEST budget (tie: smallest): under ASHA the
+            # small budgets hold most of the signal — dropping by budget
+            # value would throw away every qualifying model first
+            del self._budget_hist[min(
+                self._budget_hist,
+                key=lambda b: (len(self._budget_hist[b]), b))]
 
     def _observations(self) -> List[tuple]:
         for t in sorted(self._budget_hist, reverse=True):
@@ -270,9 +275,10 @@ class BOHBSearch(TPESearch):
         return self._history  # completed trials (TPE fallback)
 
     def _model_ready(self, obs: List[tuple]) -> bool:
-        if obs is self._history:
-            return super()._model_ready(obs)
-        return len(obs) >= max(1, self.min_points)
+        # budget populations from _observations() already meet min_points
+        # by construction; only the completed-history fallback needs the
+        # full n_startup bar
+        return obs is not self._history or super()._model_ready(obs)
 
 
 class OptunaSearch(Searcher):

@@ -368,14 +368,16 @@ class _TrialRunner:
         if now - getattr(self, "_cap_checked", 0.0) < 5.0:
             return self._cap
         self._cap_checked = now
-        self._cap = self.cfg.max_concurrent_trials
+        if not hasattr(self, "_cap"):
+            self._cap = self.cfg.max_concurrent_trials
         per_trial = (self.cfg.trial_resources or {"CPU": 1.0}).get(
             "CPU", 1.0)
         if per_trial > 0:
             try:
                 total = float(api.cluster_resources().get("CPU", 0.0))
             except Exception:
-                total = 0.0
+                total = 0.0   # keep the last known cap: a transient RPC
+                #   failure must not un-cap and flood unschedulable actors
             if total > 0:
                 self._cap = max(1, min(self.cfg.max_concurrent_trials,
                                        int(total // per_trial)))
@@ -453,7 +455,11 @@ class _TrialRunner:
         self._dirty = True
         if item.get("checkpoint") is not None:
             self._save_checkpoint(trial, item["checkpoint"])
-        self.searcher.on_trial_result(trial.trial_id, metrics)
+        # the searcher's copy carries the trial's CURRENT config: after a
+        # PBT/PB2 exploit relaunch the searcher's live entry is gone, and
+        # the mutated config exists nowhere else in the result stream
+        self.searcher.on_trial_result(trial.trial_id,
+                                      {**metrics, "config": trial.config})
         metric_known = self.scheduler.metric and \
             self.scheduler.metric in metrics
         decision = (self.scheduler.on_trial_result(trial, metrics)

@@ -273,3 +273,39 @@ def test_dead_replica_healed_and_requests_survive(served):
     assert len(now_alive) >= len(before), \
         "dead replica was never replaced"
     serve.delete("fragile")
+
+
+def test_predictor_deployment(served):
+    """AIR checkpoint served online: PredictorDeployment loads the model
+    once per replica and micro-batches requests (reference:
+    serve/air_integrations.py:359 + http_adapters.py adapters) — the
+    same predictor_fn contract BatchPredictor uses offline."""
+    from ray_tpu.air import Checkpoint
+    from ray_tpu.serve import PredictorDeployment
+
+    ckpt = Checkpoint.from_dict({"scale": 3.0, "bias": 1.0})
+
+    def predictor_fn(ckpt):
+        import numpy as np
+        d = ckpt.to_dict()
+        scale, bias = d["scale"], d["bias"]
+
+        def predict(batch):           # [n, ...] stacked requests
+            return np.asarray(batch) * scale + bias
+        return predict
+
+    dep = PredictorDeployment(ckpt, predictor_fn, name="affine",
+                              max_batch_size=4,
+                              route_prefix="/affine")
+    handle = serve.run(dep.bind(), name="affine", route_prefix="/affine")
+    # handle path: single requests, batched server-side
+    outs = [handle.remote([float(i), 0.0]) for i in range(4)]
+    got = [o.result(timeout_s=30.0) for o in outs]
+    assert got == [[i * 3.0 + 1.0, 1.0] for i in range(4)]
+    # HTTP path through the default json adapter
+    import requests
+    addr = serve.api.http_address()
+    r = requests.post(f"{addr}/affine", json={"array": [2.0, 4.0]},
+                      timeout=10)
+    assert r.status_code == 200
+    assert r.json() == [7.0, 13.0]

@@ -226,10 +226,60 @@ def test_bohb_learns_from_intermediate_budgets():
     assert bohb3._model_ready(bohb3._observations())
 
     # exploit-relaunch path: feedback with no _live entry still lands via
-    # the result's own config
+    # the config the RUNNER injects into every searcher-bound result
+    # (tuner._handle_result) — exactly what a post-PBT-exploit trial
+    # looks like to the searcher
     bohb3.on_trial_result("ghost", {"loss": 1.0, "training_iteration": 2,
                                     "config": {"x": 0.5}})
     assert "ghost" in bohb3._budget_hist[2]
+
+    # eviction keeps the most-populated budgets, not the largest ones
+    bohb4 = BOHBSearch({"x": tune.uniform(-10, 10)}, metric="loss",
+                       mode="min", seed=4, min_points=2)
+    bohb4._max_budgets = 3
+    for tid in ("p0", "p1", "p2"):   # budget 1: three trials
+        bohb4.on_trial_result(tid, {"loss": 1.0, "training_iteration": 1,
+                                    "config": {"x": 0.0}})
+    for t in (50, 70, 90):           # sparse large budgets
+        bohb4.on_trial_result("solo", {"loss": 1.0, "training_iteration": t,
+                                       "config": {"x": 0.0}})
+    assert 1 in bohb4._budget_hist      # the qualifying budget survived
+    assert len(bohb4._budget_hist) == 3
+
+
+def test_runner_injects_config_into_searcher_results(cluster, tmp_path):
+    """The runner passes the trial's CURRENT config with every result it
+    forwards to the searcher — the only channel that survives a PBT/PB2
+    exploit relaunch (where the searcher's live entry was popped)."""
+    from ray_tpu.tune.search import Searcher
+
+    seen = []
+
+    class Spy(Searcher):
+        def __init__(self):
+            super().__init__(metric="loss", mode="min")
+            self._n = 0
+
+        def suggest(self, trial_id):
+            if self._n >= 2:
+                return None
+            self._n += 1
+            return {"x": float(self._n)}
+
+        def on_trial_result(self, trial_id, result):
+            seen.append(result)
+
+    def objective(config):
+        session.report({"loss": config["x"]})
+
+    Tuner(objective, param_space={},
+          tune_config=TuneConfig(metric="loss", mode="min", num_samples=2,
+                                 max_concurrent_trials=1,
+                                 search_alg=Spy()),
+          run_config=RunConfig(name="spy", storage_path=str(tmp_path)),
+          ).fit()
+    assert len(seen) == 2
+    assert all(r.get("config", {}).get("x") in (1.0, 2.0) for r in seen)
 
 
 def test_bohb_with_tuner_and_asha(cluster, tmp_path):
