@@ -12,7 +12,13 @@ supported through rollout-worker actors like the reference's sampler.
 
 from .algorithm import Algorithm  # noqa: F401
 from .dqn import DQN, DQNConfig, QNetwork  # noqa: F401
-from .env import CartPole, GridTarget, JaxEnv, Pendulum  # noqa: F401
+from .env import (  # noqa: F401
+    CartPole,
+    GridTarget,
+    JaxEnv,
+    MemoryCue,
+    Pendulum,
+)
 from .es import ES, ESConfig  # noqa: F401
 from .impala import Impala, ImpalaConfig  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
@@ -47,7 +53,7 @@ from .exploration import (  # noqa: F401
     OrnsteinUhlenbeckNoise,
     StochasticSampling,
 )
-from .policy import ConvPolicy, MLPPolicy  # noqa: F401
+from .policy import ConvPolicy, LSTMPolicy, MLPPolicy  # noqa: F401
 from .ppo import PPO, PPOConfig  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
 from .worker_set import WorkerSet  # noqa: F401

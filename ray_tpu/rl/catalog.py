@@ -13,13 +13,15 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional
 
 from .env import JaxEnv
-from .policy import ConvPolicy, MLPPolicy
+from .policy import ConvPolicy, LSTMPolicy, MLPPolicy
 
 _CUSTOM_MODELS: Dict[str, Callable[..., Any]] = {}
 
 DEFAULT_MODEL: Dict[str, Any] = {
     "hidden": (64, 64),
     "conv_filters": None,     # None -> catalog default for image spaces
+    "use_lstm": False,        # recurrent wrapper (reference: catalog
+    "lstm_cell_size": 64,     # use_lstm / lstm_cell_size model options)
     "custom_model": None,
     "custom_model_config": {},
 }
@@ -51,6 +53,11 @@ def build_policy(env: JaxEnv, model: Optional[Dict[str, Any]] = None,
         return _CUSTOM_MODELS[custom](
             obs_size, env.action_size, discrete=env.discrete,
             **cfg.get("custom_model_config", {}))
+    if cfg.get("use_lstm"):
+        return LSTMPolicy(obs_size, env.action_size,
+                          discrete=env.discrete,
+                          hidden=tuple(cfg["hidden"]),
+                          lstm_size=cfg.get("lstm_cell_size", 64))
     # image observation space -> conv torso (the reference catalog's
     # vision-net selection); connectors that resize flat obs keep the
     # MLP path since the image geometry no longer applies

@@ -88,6 +88,40 @@ class CartPole(JaxEnv):
         return new_state, new_obs, reward, done
 
 
+class MemoryCue(JaxEnv):
+    """Partially observable cue-recall task: a binary cue is visible only
+    in the FIRST observation of an episode; reward 1 for choosing the
+    matching action at every step.  A memoryless policy earns at most
+    (1 + (T-1)/2)/T per step in expectation — solving it requires carrying
+    state across steps (the catalog's ``use_lstm`` path).  Reference
+    role: rllib's stateless/memory test envs (e.g. StatelessCartPole,
+    `rllib/examples/env/stateless_cartpole.py`)."""
+
+    observation_size = 3   # [cue==0, cue==1, first-step flag]
+    action_size = 2
+    discrete = True
+    max_episode_steps = 8
+
+    def reset(self, key):
+        cue = jax.random.bernoulli(key).astype(jnp.int32)
+        state = {"cue": cue, "t": jnp.zeros((), jnp.int32)}
+        obs = jnp.stack([1.0 - cue, cue * 1.0, jnp.ones(())],
+                        axis=0).astype(jnp.float32)
+        return state, obs
+
+    def step(self, state, action, key):
+        reward = (action == state["cue"]).astype(jnp.float32)
+        t = state["t"] + 1
+        done = t >= self.max_episode_steps
+        obs = jnp.zeros((3,), jnp.float32)   # cue hidden after t=0
+        reset_state, reset_obs = self.reset(key)
+        new_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(done, r, c),
+            reset_state, {"cue": state["cue"], "t": t})
+        new_obs = jnp.where(done, reset_obs, obs)
+        return new_state, new_obs, reward, done
+
+
 class Pendulum(JaxEnv):
     """Torque-controlled pendulum swing-up (continuous actions)."""
 

@@ -10,7 +10,7 @@ jit-friendly, discrete heads emit logits, continuous heads emit
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,40 +74,50 @@ class MLPPolicy:
         return pi, v
 
     # -- distributions ------------------------------------------------------
-    def sample_action(self, params: Params, obs: jnp.ndarray,
-                      key: jax.Array):
-        """→ (action, logp, value)."""
-        pi, v = self.forward(params, obs)
+    def _sample_from(self, pi: jnp.ndarray, key: jax.Array):
+        """Head output → (action, logp); shared by the feedforward and
+        recurrent sampling paths."""
         if self.discrete:
             action = jax.random.categorical(key, pi)
             logp_all = jax.nn.log_softmax(pi)
             logp = jnp.take_along_axis(
                 logp_all, action[..., None].astype(jnp.int32),
                 axis=-1)[..., 0]
-            return action, logp, v
+            return action, logp
         mean, log_std = jnp.split(pi, 2, axis=-1)
         log_std = jnp.clip(log_std, -5.0, 2.0)
         eps = jax.random.normal(key, mean.shape)
         action = mean + jnp.exp(log_std) * eps
-        logp = self._gauss_logp(mean, log_std, action)
-        return action, logp, v
+        return action, self._gauss_logp(mean, log_std, action)
 
-    def log_prob(self, params: Params, obs: jnp.ndarray,
-                 action: jnp.ndarray):
-        """→ (logp, entropy, value) for PPO updates."""
-        pi, v = self.forward(params, obs)
+    def _logp_entropy_from(self, pi: jnp.ndarray, action: jnp.ndarray):
+        """Head output + taken action → (logp, entropy)."""
         if self.discrete:
             logp_all = jax.nn.log_softmax(pi)
             logp = jnp.take_along_axis(
                 logp_all, action[..., None].astype(jnp.int32),
                 axis=-1)[..., 0]
             entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
-            return logp, entropy, v
+            return logp, entropy
         mean, log_std = jnp.split(pi, 2, axis=-1)
         log_std = jnp.clip(log_std, -5.0, 2.0)
         logp = self._gauss_logp(mean, log_std, action)
         entropy = jnp.sum(log_std + 0.5 * math.log(2 * math.pi * math.e),
                           axis=-1)
+        return logp, entropy
+
+    def sample_action(self, params: Params, obs: jnp.ndarray,
+                      key: jax.Array):
+        """→ (action, logp, value)."""
+        pi, v = self.forward(params, obs)
+        action, logp = self._sample_from(pi, key)
+        return action, logp, v
+
+    def log_prob(self, params: Params, obs: jnp.ndarray,
+                 action: jnp.ndarray):
+        """→ (logp, entropy, value) for PPO updates."""
+        pi, v = self.forward(params, obs)
+        logp, entropy = self._logp_entropy_from(pi, action)
         return logp, entropy, v
 
     @staticmethod
@@ -123,6 +133,100 @@ class MLPPolicy:
     def set_weights(self, params: Params, weights):
         return jax.tree_util.tree_map(lambda _, w: jnp.asarray(w),
                                       params, weights)
+
+
+class LSTMPolicy(MLPPolicy):
+    """Recurrent actor-critic: MLP torso → LSTM cell → pi/vf heads (the
+    reference catalog's ``use_lstm`` wrapper, `rllib/models/catalog.py` +
+    `models/torch/recurrent_net.py`, answered as an explicit-carry JAX
+    cell that composes with `lax.scan`).
+
+    The recurrent state is a ``(h, c)`` pair carried by the caller:
+    rollouts thread it through their scan (resetting at episode
+    boundaries), and PPO's sequence update replays the same scan under
+    `grad` from the segment's initial state (`log_prob_seq`).
+    """
+
+    is_recurrent = True
+
+    def __init__(self, obs_size: int, action_size: int, *,
+                 discrete: bool = True, hidden: Sequence[int] = (64,),
+                 lstm_size: int = 64):
+        super().__init__(obs_size, action_size, discrete=discrete,
+                         hidden=hidden)
+        self.lstm_size = lstm_size
+
+    def init(self, key: jax.Array) -> Params:
+        sizes = (self.obs_size,) + self.hidden
+        n_out = self.action_size if self.discrete else 2 * self.action_size
+        kt, kl, kp, kv = jax.random.split(key, 4)
+        in_dim = sizes[-1] + self.lstm_size
+        return {
+            "torso": mlp_init(kt, sizes),
+            "lstm": {"w": jax.random.normal(
+                kl, (in_dim, 4 * self.lstm_size)) * math.sqrt(1.0 / in_dim),
+                "b": jnp.zeros((4 * self.lstm_size,))},
+            "pi": {"w": jax.random.normal(
+                kp, (self.lstm_size, n_out)) * 0.01,
+                "b": jnp.zeros((n_out,))},
+            "vf": {"w": jax.random.normal(kv, (self.lstm_size, 1)),
+                   "b": jnp.zeros((1,))},
+        }
+
+    def initial_state(self, batch_size: Optional[int] = None):
+        shape = ((self.lstm_size,) if batch_size is None
+                 else (batch_size, self.lstm_size))
+        return (jnp.zeros(shape), jnp.zeros(shape))
+
+    def _cell(self, params: Params, x: jnp.ndarray, state):
+        h, c = state
+        z = jnp.concatenate([x, h], axis=-1) @ params["lstm"]["w"] \
+            + params["lstm"]["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+    def step_recurrent(self, params: Params, obs: jnp.ndarray, state):
+        """One timestep: → (pi head, value, new_state).  Works on single
+        obs [obs] or batches [B, obs] (state shaped to match)."""
+        x = self._torso(params, obs)
+        h, state = self._cell(params, x, state)
+        pi = h @ params["pi"]["w"] + params["pi"]["b"]
+        v = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return pi, v, state
+
+    def sample_action_recurrent(self, params: Params, obs: jnp.ndarray,
+                                state, key: jax.Array):
+        """→ (action, logp, value, new_state)."""
+        pi, v, state = self.step_recurrent(params, obs, state)
+        action, logp = self._sample_from(pi, key)
+        return action, logp, v, state
+
+    def log_prob_seq(self, params: Params, obs_seq: jnp.ndarray,
+                     action_seq: jnp.ndarray, done_seq: jnp.ndarray,
+                     init_state):
+        """Replay the rollout's recurrence under grad: [T, B, ...]
+        sequences + the segment's initial state → (logp, entropy, value)
+        each [T, B].  State resets AFTER a done step, mirroring the
+        rollout's reset timing exactly."""
+        def step(state, inp):
+            obs, action, done = inp
+            pi, v, state = self.step_recurrent(params, obs, state)
+            logp, ent = self._logp_entropy_from(pi, action)
+            keep = (1.0 - done.astype(jnp.float32))[..., None]
+            state = jax.tree_util.tree_map(lambda s: s * keep, state)
+            return state, (logp, ent, v)
+
+        _, (logp, ent, v) = jax.lax.scan(
+            step, init_state, (obs_seq, action_seq, done_seq))
+        return logp, ent, v
+
+    # forward() on a recurrent policy needs a state — fail loudly instead
+    # of silently using the base class's (shape-incompatible) params
+    def forward(self, params: Params, obs: jnp.ndarray):
+        raise TypeError("LSTMPolicy.forward needs a recurrent state; use "
+                        "step_recurrent(params, obs, state)")
 
 
 class ConvPolicy(MLPPolicy):

@@ -59,6 +59,20 @@ class PPOConfig:
         return PPO(self)
 
 
+def _make_elementwise_apply(pipe):
+    """Stateless elementwise connector application (action/reward
+    pipelines) shared by the feedforward and recurrent rollouts."""
+    if pipe is None or not getattr(pipe, "connectors", None):
+        return lambda x: x
+
+    def apply(x):
+        for c in pipe.connectors:
+            _, x = c((), x)
+        return x
+
+    return apply
+
+
 def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
                     rollout_length: int, pipeline=None,
                     action_pipeline=None, reward_pipeline=None):
@@ -74,20 +88,15 @@ def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
     receives while the stored action stays the policy's own output
     (log_prob consistency — the reference's action-connector contract);
     reward connectors transform stored rewards."""
+    if getattr(policy, "is_recurrent", False):
+        raise ValueError(
+            "recurrent policies (use_lstm) are supported by PPO's local "
+            "path only (make_recurrent_rollout_fn); this code path does "
+            "not carry policy state")
     has_conn = pipeline is not None and pipeline.connectors
     apply_conn = jax.vmap(pipeline) if has_conn else (lambda s, x: (s, x))
-
-    def to_env_action(a):
-        if action_pipeline is not None:
-            for c in action_pipeline.connectors:
-                _, a = c((), a)   # stateless, elementwise: no vmap needed
-        return a
-
-    def to_stored_reward(r):
-        if reward_pipeline is not None:
-            for c in reward_pipeline.connectors:
-                _, r = c((), r)
-        return r
+    to_env_action = _make_elementwise_apply(action_pipeline)
+    to_stored_reward = _make_elementwise_apply(reward_pipeline)
 
     def rollout(params, env_states, obs, conn_state, key):
         def step(carry, _):
@@ -119,6 +128,116 @@ def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
         return traj, env_states, last_obs, conn_state, last_value, key
 
     return rollout
+
+
+def make_recurrent_rollout_fn(env: JaxEnv, policy, num_envs: int,
+                              rollout_length: int, pipeline=None,
+                              action_pipeline=None, reward_pipeline=None):
+    """Rollout for a recurrent policy: the LSTM state joins the scan
+    carry and resets per env at episode boundaries.  Returns the
+    SEGMENT-INITIAL state alongside the trajectory — the sequence update
+    replays the recurrence from exactly there (`log_prob_seq`).
+    Action/reward connector semantics match the feedforward rollout.
+
+    → ``(params, env_states, obs, conn_state, pstate, key) -> (traj,
+    env_states, last_obs, conn_state, pstate, init_pstate, last_value,
+    key)``"""
+    has_conn = pipeline is not None and pipeline.connectors
+    apply_conn = jax.vmap(pipeline) if has_conn else (lambda s, x: (s, x))
+    to_env_action = _make_elementwise_apply(action_pipeline)
+    to_stored_reward = _make_elementwise_apply(reward_pipeline)
+
+    def rollout(params, env_states, obs, conn_state, pstate, key):
+        init_pstate = pstate
+
+        def step(carry, _):
+            env_states, obs, conn_state, pstate, key = carry
+            key, akey, skey = jax.random.split(key, 3)
+            conn_state, pobs = apply_conn(conn_state, obs)
+            actions, logps, values, pstate = \
+                policy.sample_action_recurrent(params, pobs, pstate, akey)
+            skeys = jax.random.split(skey, num_envs)
+            env_states, next_obs, rewards, dones = jax.vmap(env.step)(
+                env_states, to_env_action(actions), skeys)
+            if has_conn:
+                conn_state = pipeline.reset_where(conn_state, dones)
+            keep = (1.0 - dones.astype(jnp.float32))[..., None]
+            pstate = jax.tree_util.tree_map(lambda s: s * keep, pstate)
+            frame = {"obs": pobs, "action": actions, "logp": logps,
+                     "value": values,
+                     "reward": to_stored_reward(rewards), "done": dones}
+            return (env_states, next_obs, conn_state, pstate, key), frame
+
+        (env_states, last_obs, conn_state, pstate, key), traj = \
+            jax.lax.scan(step, (env_states, obs, conn_state, pstate, key),
+                         None, length=rollout_length)
+        _, plast = apply_conn(conn_state, last_obs)
+        _, last_value, _ = policy.step_recurrent(params, plast, pstate)
+        return (traj, env_states, last_obs, conn_state, pstate,
+                init_pstate, last_value, key)
+
+    return rollout
+
+
+def make_recurrent_update_fn(policy, optimizer, cfg, num_envs: int,
+                             axis_name: Optional[str] = None):
+    """Sequence-aware PPO update: minibatches are whole-env SEQUENCES
+    (shuffling the env axis, never time), and log-probs are recomputed by
+    replaying the LSTM from the segment's initial state."""
+    # fewer envs than minibatches: shrink the minibatch COUNT (a fixed
+    # num_minibatches would reshape more indices than perm holds)
+    n_mb = max(1, min(cfg.num_minibatches, num_envs))
+    mb_envs = num_envs // n_mb
+
+    def loss_fn(params, batch, init_state):
+        logp, entropy, value = policy.log_prob_seq(
+            params, batch["obs"], batch["action"], batch["done"],
+            init_state)
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["adv"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - cfg.clip_eps,
+                           1 + cfg.clip_eps) * adv
+        pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+        vf_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
+        ent = jnp.mean(entropy)
+        total = pi_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * ent
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": ent}
+
+    def update_epoch(carry, _):
+        params, opt_state, batch, init_state, key = carry
+        key, pkey = jax.random.split(key)
+        perm = jax.random.permutation(pkey, num_envs)
+
+        def update_minibatch(carry, idx):
+            params, opt_state = carry
+            mb = jax.tree_util.tree_map(lambda x: x[:, idx], batch)
+            mb_state = jax.tree_util.tree_map(lambda s: s[idx],
+                                              init_state)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb, mb_state)
+            if axis_name is not None:
+                grads = jax.lax.pmean(grads, axis_name)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), aux
+
+        idxs = perm[:n_mb * mb_envs].reshape(n_mb, mb_envs)
+        (params, opt_state), auxs = jax.lax.scan(
+            update_minibatch, (params, opt_state), idxs)
+        return (params, opt_state, batch, init_state, key), auxs
+
+    def update(params, opt_state, batch, init_state, key):
+        (params, opt_state, _, _, key), auxs = jax.lax.scan(
+            update_epoch, (params, opt_state, batch, init_state, key),
+            None, length=cfg.num_sgd_epochs)
+        metrics = jax.tree_util.tree_map(lambda x: x[-1, -1], auxs)
+        return params, opt_state, key, metrics
+
+    return update
 
 
 def compute_gae(traj, last_value, gamma: float, lam: float):
@@ -234,13 +353,25 @@ class PPO(Algorithm):
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         self.key = key
         self.conn_state = self.pipeline.init_state_batch(cfg.num_envs)
-        self._rollout = make_rollout_fn(
-            self.env, self.policy, cfg.num_envs, cfg.rollout_length,
-            pipeline=self.pipeline, action_pipeline=self._action_pipe,
-            reward_pipeline=self._reward_pipe)
+        self._recurrent = bool(getattr(self.policy, "is_recurrent", False))
+        if self._recurrent:
+            self.pstate = self.policy.initial_state(cfg.num_envs)
+            self._rollout = make_recurrent_rollout_fn(
+                self.env, self.policy, cfg.num_envs, cfg.rollout_length,
+                pipeline=self.pipeline, action_pipeline=self._action_pipe,
+                reward_pipeline=self._reward_pipe)
+        else:
+            self._rollout = make_rollout_fn(
+                self.env, self.policy, cfg.num_envs, cfg.rollout_length,
+                pipeline=self.pipeline, action_pipeline=self._action_pipe,
+                reward_pipeline=self._reward_pipe)
         self._train_iter = jax.jit(self._make_train_iter())
         self._workers = None
         if cfg.num_workers > 0:
+            if self._recurrent:
+                raise ValueError("use_lstm + num_workers>0 is not "
+                                 "supported: rollout workers are "
+                                 "feedforward-only")
             from .worker_set import WorkerSet
             self._workers = WorkerSet(cfg)
         self._init_episode_tracking(cfg.num_envs)
@@ -251,6 +382,8 @@ class PPO(Algorithm):
                               batch_size)
 
     def _make_train_iter(self):
+        if self._recurrent:
+            return self._make_recurrent_train_iter()
         cfg = self.config
         batch_size = cfg.num_envs * cfg.rollout_length
         update = self._make_update_fn(batch_size)
@@ -279,6 +412,29 @@ class PPO(Algorithm):
 
         return train_iter
 
+    def _make_recurrent_train_iter(self):
+        cfg = self.config
+        update = make_recurrent_update_fn(self.policy, self.optimizer,
+                                          cfg, cfg.num_envs)
+
+        def train_iter(params, opt_state, env_states, obs, conn_state,
+                       pstate, key):
+            (traj, env_states, obs, conn_state, pstate, init_pstate,
+             last_value, key) = self._rollout(params, env_states, obs,
+                                              conn_state, pstate, key)
+            adv, ret = compute_gae(traj, last_value, cfg.gamma,
+                                   cfg.gae_lambda)
+            batch = {"obs": traj["obs"], "action": traj["action"],
+                     "logp": traj["logp"], "done": traj["done"],
+                     "adv": adv, "ret": ret}
+            params, opt_state, key, metrics = update(
+                params, opt_state, batch, init_pstate, key)
+            metrics["reward_sum"] = traj["reward"].sum()
+            return params, opt_state, env_states, obs, conn_state, \
+                pstate, key, metrics, traj["reward"], traj["done"]
+
+        return train_iter
+
     # -- Trainable interface ------------------------------------------------
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
@@ -289,6 +445,15 @@ class PPO(Algorithm):
             # learn on driver from worker trajectories
             metrics = self._learn_on_batch(batches)
             env_steps = cfg.num_workers * cfg.num_envs * cfg.rollout_length
+        elif self._recurrent:
+            (self.params, self.opt_state, self.env_states, self.obs,
+             self.conn_state, self.pstate, self.key, metrics, rewards,
+             dones) = self._train_iter(
+                self.params, self.opt_state, self.env_states, self.obs,
+                self.conn_state, self.pstate, self.key)
+            env_steps = cfg.num_envs * cfg.rollout_length
+            self._track_episodes(np.asarray(rewards), np.asarray(dones))
+            metrics = {k: float(v) for k, v in metrics.items()}
         else:
             (self.params, self.opt_state, self.env_states, self.obs,
              self.conn_state, self.key, metrics, rewards,

@@ -16,7 +16,7 @@ compiled program over a stacked batch instead of per-request calls).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 from ..air.checkpoint import Checkpoint
 from .batching import batch
@@ -84,6 +84,11 @@ def PredictorDeployment(
 
         def __call__(self, payload):
             import numpy as np
-            return self._predict_batch(np.asarray(adapter(payload)))
+            arr = np.asarray(adapter(payload))
+            if arr.dtype == object:   # non-numeric payload: fail HERE,
+                raise ValueError(     # never inside a shared micro-batch
+                    "adapter produced a non-numeric array from payload "
+                    f"of type {type(payload).__name__}")
+            return self._predict_batch(arr)
 
     return _Predictor

@@ -261,12 +261,13 @@ class BOHBSearch(TPESearch):
         self._budget_hist.setdefault(t, {})[trial_id] = \
             (dict(cfg), self._objective(result))
         while len(self._budget_hist) > self._max_budgets:
-            # evict the SPARSEST budget (tie: smallest): under ASHA the
-            # small budgets hold most of the signal — dropping by budget
-            # value would throw away every qualifying model first
-            del self._budget_hist[min(
-                self._budget_hist,
-                key=lambda b: (len(self._budget_hist[b]), b))]
+            # evict the SPARSEST budget (tie: smallest) EXCEPT the one
+            # just updated: under ASHA the small budgets hold most of the
+            # signal, but a new higher budget must be allowed to
+            # accumulate instead of being evicted at one entry forever
+            victim = min((b for b in self._budget_hist if b != t),
+                         key=lambda b: (len(self._budget_hist[b]), b))
+            del self._budget_hist[victim]
 
     def _observations(self) -> List[tuple]:
         for t in sorted(self._budget_hist, reverse=True):
