@@ -45,6 +45,11 @@ def build_policy(env: JaxEnv, model: Optional[Dict[str, Any]] = None,
     cfg.update(model or {})
     obs_size = obs_size_override or env.observation_size
     custom = cfg.get("custom_model")
+    if custom and cfg.get("use_lstm"):
+        raise ValueError(
+            "custom_model + use_lstm is not supported: recurrence must "
+            "live inside the custom policy (give it is_recurrent=True "
+            "and the LSTMPolicy interface)")
     if custom:
         if custom not in _CUSTOM_MODELS:
             raise ValueError(

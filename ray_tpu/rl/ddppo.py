@@ -59,6 +59,10 @@ class DDPPO(Algorithm):
         self.mesh = Mesh(np.asarray(devices[:n]), ("dp",))
 
         self.env = cfg.env()
+        if (cfg.model or {}).get("use_lstm"):
+            raise ValueError("use_lstm is not supported by DDPPO: its "
+                             "per-device learners are feedforward-only "
+                             "(use PPO's local path for recurrence)")
         self.policy = MLPPolicy(self.env.observation_size,
                                 self.env.action_size,
                                 discrete=self.env.discrete,

@@ -184,9 +184,11 @@ def make_recurrent_update_fn(policy, optimizer, cfg, num_envs: int,
     """Sequence-aware PPO update: minibatches are whole-env SEQUENCES
     (shuffling the env axis, never time), and log-probs are recomputed by
     replaying the LSTM from the segment's initial state."""
-    # fewer envs than minibatches: shrink the minibatch COUNT (a fixed
-    # num_minibatches would reshape more indices than perm holds)
-    n_mb = max(1, min(cfg.num_minibatches, num_envs))
+    # minibatch count = the largest divisor of num_envs not above
+    # num_minibatches: every env sequence lands in exactly one minibatch
+    # (a non-divisor count would silently drop whole sequences per epoch)
+    n_mb = next(d for d in range(min(cfg.num_minibatches, num_envs), 0, -1)
+                if num_envs % d == 0)
     mb_envs = num_envs // n_mb
 
     def loss_fn(params, batch, init_state):

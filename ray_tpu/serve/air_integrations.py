@@ -85,10 +85,14 @@ def PredictorDeployment(
         def __call__(self, payload):
             import numpy as np
             arr = np.asarray(adapter(payload))
-            if arr.dtype == object:   # non-numeric payload: fail HERE,
-                raise ValueError(     # never inside a shared micro-batch
-                    "adapter produced a non-numeric array from payload "
-                    f"of type {type(payload).__name__}")
+            # non-numeric payloads (object/str/datetime arrays) fail HERE,
+            # never inside a micro-batch shared with valid requests
+            if not (np.issubdtype(arr.dtype, np.number)
+                    or arr.dtype == bool):
+                raise ValueError(
+                    f"adapter produced a non-numeric array "
+                    f"(dtype {arr.dtype}) from payload of type "
+                    f"{type(payload).__name__}")
             return self._predict_batch(arr)
 
     return _Predictor
