@@ -29,20 +29,41 @@ from .policy import mlp_apply, mlp_init
 
 
 class QNetwork:
-    """MLP state-action value network: obs → Q[action]."""
+    """MLP state-action value network: obs → Q[action].
+
+    With ``dueling`` the torso feeds separate value and advantage heads
+    and Q = V + A - mean(A) (reference: dueling architecture,
+    `rllib/algorithms/dqn` dueling option)."""
 
     def __init__(self, obs_size: int, n_actions: int,
-                 hidden=(64, 64)):
+                 hidden=(64, 64), dueling: bool = False):
         self.obs_size = obs_size
         self.n_actions = n_actions
         self.hidden = tuple(hidden)
+        self.dueling = dueling
 
     def init(self, key: jax.Array):
-        return mlp_init(key,
-                        (self.obs_size,) + self.hidden + (self.n_actions,))
+        if not self.dueling:
+            return mlp_init(
+                key, (self.obs_size,) + self.hidden + (self.n_actions,))
+        if not self.hidden:
+            raise ValueError("dueling=True needs at least one hidden "
+                             "layer (the shared torso the V/A heads read)")
+        kt, kv, ka = jax.random.split(key, 3)
+        width = self.hidden[-1]
+        return {"torso": mlp_init(kt, (self.obs_size,) + self.hidden),
+                "v": mlp_init(kv, (width, 1)),
+                "a": mlp_init(ka, (width, self.n_actions))}
 
     def apply(self, params, obs: jnp.ndarray) -> jnp.ndarray:
-        return mlp_apply(params, obs)
+        if not self.dueling:
+            return mlp_apply(params, obs)
+        x = obs
+        for layer in params["torso"]:    # activation on EVERY torso layer
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        v = mlp_apply(params["v"], x)                      # [..., 1]
+        a = mlp_apply(params["a"], x)                      # [..., A]
+        return v + a - a.mean(axis=-1, keepdims=True)
 
 
 @dataclasses.dataclass
@@ -57,6 +78,10 @@ class DQNConfig:
     lr: float = 1e-3
     tau: float = 0.01              # Polyak target-average rate
     double_q: bool = True
+    dueling: bool = False          # V + A - mean(A) heads
+    prioritized_replay: bool = False
+    per_alpha: float = 0.6         # priority exponent
+    per_beta: float = 0.4          # importance-weight exponent
     eps_start: float = 1.0
     eps_end: float = 0.05
     eps_decay_steps: int = 20_000  # env steps to anneal epsilon over
@@ -80,7 +105,7 @@ class DQN(Algorithm):
         if not self.env.discrete:
             raise ValueError("DQN requires a discrete-action env")
         self.q = QNetwork(self.env.observation_size, self.env.action_size,
-                          hidden=cfg.hidden)
+                          hidden=cfg.hidden, dueling=cfg.dueling)
         key = jax.random.PRNGKey(cfg.seed)
         key, pkey, ekey = jax.random.split(key, 3)
         self.params = self.q.init(pkey)
@@ -91,7 +116,9 @@ class DQN(Algorithm):
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         obs_dim = self.env.observation_size
-        self.buffer = replay.init(cfg.buffer_capacity, {
+        buffer_init = (replay.init_prioritized if cfg.prioritized_replay
+                       else replay.init)
+        self.buffer = buffer_init(cfg.buffer_capacity, {
             "obs": jnp.zeros((obs_dim,), jnp.float32),
             "action": jnp.zeros((), jnp.int32),
             "reward": jnp.zeros((), jnp.float32),
@@ -123,7 +150,9 @@ class DQN(Algorithm):
                 skeys = jax.random.split(skey, cfg.num_envs)
                 env_states, next_obs, reward, done = jax.vmap(env.step)(
                     env_states, action, skeys)
-                buffer = replay.add_batch(buffer, {
+                add = (replay.add_batch_prioritized
+                       if cfg.prioritized_replay else replay.add_batch)
+                buffer = add(buffer, {
                     "obs": obs.astype(jnp.float32),
                     "action": action.astype(jnp.int32),
                     "reward": reward.astype(jnp.float32),
@@ -137,7 +166,7 @@ class DQN(Algorithm):
                 collect, (buffer, env_states, obs, key), None,
                 length=cfg.rollout_steps)
 
-            def td_loss(params, batch):
+            def td_loss(params, batch, weights):
                 qvals = q.apply(params, batch["obs"])
                 q_sa = jnp.take_along_axis(
                     qvals, batch["action"][:, None], axis=-1)[:, 0]
@@ -153,37 +182,52 @@ class DQN(Algorithm):
                 target = batch["reward"] + cfg.gamma * next_q * \
                     (1.0 - batch["done"])
                 target = jax.lax.stop_gradient(target)
-                return jnp.mean((q_sa - target) ** 2)
+                td = q_sa - target
+                return jnp.mean(weights * td ** 2), jnp.abs(td)
 
             def update(carry, _):
-                params, target_params, opt_state, key = carry
-                batch, key = replay.sample(buffer, key, cfg.batch_size)
-                loss, grads = jax.value_and_grad(td_loss)(params, batch)
+                params, target_params, opt_state, buffer, key = carry
+                if cfg.prioritized_replay:
+                    batch, idx, weights, key = replay.sample_prioritized(
+                        buffer, key, cfg.batch_size,
+                        alpha=cfg.per_alpha, beta=cfg.per_beta)
+                else:
+                    batch, key = replay.sample(buffer, key, cfg.batch_size)
+                    idx, weights = None, jnp.ones((cfg.batch_size,))
+                (loss, td_abs), grads = jax.value_and_grad(
+                    td_loss, has_aux=True)(params, batch, weights)
+                if cfg.prioritized_replay:
+                    buffer = replay.update_priorities(buffer, idx, td_abs)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 target_params = jax.tree_util.tree_map(
                     lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
                     target_params, params)
-                return (params, target_params, opt_state, key), loss
+                return (params, target_params, opt_state, buffer,
+                        key), loss
 
             # gate learning until the buffer has learn_start transitions
             do_learn = buffer["size"] >= cfg.learn_start
 
             def run_updates(args):
-                params, target_params, opt_state, key = args
-                (params, target_params, opt_state, key), losses = \
+                params, target_params, opt_state, buffer, key = args
+                (params, target_params, opt_state, buffer, key), losses = \
                     jax.lax.scan(update,
-                                 (params, target_params, opt_state, key),
+                                 (params, target_params, opt_state,
+                                  buffer, key),
                                  None, length=cfg.num_updates)
-                return params, target_params, opt_state, key, losses[-1]
+                return (params, target_params, opt_state, buffer, key,
+                        losses[-1])
 
             def skip_updates(args):
-                params, target_params, opt_state, key = args
-                return params, target_params, opt_state, key, jnp.zeros(())
+                params, target_params, opt_state, buffer, key = args
+                return (params, target_params, opt_state, buffer, key,
+                        jnp.zeros(()))
 
-            params, target_params, opt_state, key, last_loss = jax.lax.cond(
+            (params, target_params, opt_state, buffer, key,
+             last_loss) = jax.lax.cond(
                 do_learn, run_updates, skip_updates,
-                (params, target_params, opt_state, key))
+                (params, target_params, opt_state, buffer, key))
             metrics = {"td_loss": last_loss,
                        "epsilon": explorer.epsilon(total_steps),
                        "buffer_size": buffer["size"]}

@@ -187,8 +187,8 @@ def make_recurrent_update_fn(policy, optimizer, cfg, num_envs: int,
     # minibatch count = the largest divisor of num_envs not above
     # num_minibatches: every env sequence lands in exactly one minibatch
     # (a non-divisor count would silently drop whole sequences per epoch)
-    n_mb = next(d for d in range(min(cfg.num_minibatches, num_envs), 0, -1)
-                if num_envs % d == 0)
+    n_mb = next((d for d in range(min(cfg.num_minibatches, num_envs),
+                                  0, -1) if num_envs % d == 0), 1)
     mb_envs = num_envs // n_mb
 
     def loss_fn(params, batch, init_state):

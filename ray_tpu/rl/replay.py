@@ -31,14 +31,29 @@ def init(capacity: int, example: Dict[str, jnp.ndarray]) -> BufferState:
             "capacity": capacity}
 
 
+def _capacity(state: BufferState) -> int:
+    """STATIC capacity from storage shape — the dict's "capacity" entry
+    becomes a traced value when the buffer rides a lax.scan carry
+    (prioritized updates mutate priorities inside the update scan), and
+    a traced value cannot size `arange`/shapes."""
+    return jax.tree_util.tree_leaves(state["data"])[0].shape[0]
+
+
+def _insert_indices(state: BufferState, batch_size: int) -> jnp.ndarray:
+    """The circular slots the next ``batch_size`` inserts land in — ONE
+    definition shared by the uniform and prioritized writers so priority
+    tagging can never desynchronize from the written slots."""
+    return (state["cursor"] + jnp.arange(batch_size)) % _capacity(state)
+
+
 def add_batch(state: BufferState, batch: Dict[str, jnp.ndarray],
               batch_size: int) -> BufferState:
     """Insert [batch_size, ...] transitions at the circular cursor.
 
     Scatter at (cursor + i) % capacity — jittable, handles wrap-around.
     """
-    capacity = state["capacity"]
-    idx = (state["cursor"] + jnp.arange(batch_size)) % capacity
+    capacity = _capacity(state)
+    idx = _insert_indices(state, batch_size)
     data = jax.tree_util.tree_map(
         lambda buf, new: buf.at[idx].set(new), state["data"], batch)
     return {"data": data,
@@ -55,3 +70,67 @@ def sample(state: BufferState, key: jax.Array, batch_size: int
                              jnp.maximum(state["size"], 1))
     batch = jax.tree_util.tree_map(lambda buf: buf[idx], state["data"])
     return batch, key
+
+
+# -- prioritized variant (reference: rllib/utils/replay_buffers/
+# prioritized_replay_buffer.py) --------------------------------------------
+#
+# Same circular storage plus a per-slot priority array.  The reference
+# uses a host-side segment tree for O(log n) sampling; on TPU a dense
+# `categorical` over the priority logits is one fused [capacity]-sized
+# kernel — cheaper than emulating pointer-chasing trees, and it keeps the
+# whole DQN iteration in a single XLA program.
+
+def init_prioritized(capacity: int,
+                     example: Dict[str, jnp.ndarray]) -> BufferState:
+    state = init(capacity, example)
+    state["priority"] = jnp.zeros((capacity,), jnp.float32)
+    state["max_priority"] = jnp.ones((), jnp.float32)
+    return state
+
+
+def add_batch_prioritized(state: BufferState,
+                          batch: Dict[str, jnp.ndarray],
+                          batch_size: int) -> BufferState:
+    """Insert with max-seen priority (new transitions sample eagerly
+    until their TD error is known — the standard PER convention)."""
+    idx = _insert_indices(state, batch_size)
+    new = add_batch({k: state[k] for k in
+                     ("data", "cursor", "size", "capacity")},
+                    batch, batch_size)
+    new["priority"] = state["priority"].at[idx].set(state["max_priority"])
+    new["max_priority"] = state["max_priority"]
+    return new
+
+
+def sample_prioritized(state: BufferState, key: jax.Array,
+                       batch_size: int, *, alpha: float = 0.6,
+                       beta: float = 0.4):
+    """Sample ∝ priority^alpha; → (batch, idx, importance_weights, key).
+
+    Weights are (N * P(i))^-beta normalized by their max (the PER paper's
+    bias correction).  Unfilled slots have priority 0 and are masked out
+    of the categorical."""
+    key, skey = jax.random.split(key)
+    valid = jnp.arange(_capacity(state)) < state["size"]
+    logits = jnp.where(valid,
+                       alpha * jnp.log(state["priority"] + 1e-6),
+                       -jnp.inf)
+    idx = jax.random.categorical(skey, logits, shape=(batch_size,))
+    probs = jax.nn.softmax(logits)[idx]
+    n = jnp.maximum(state["size"], 1).astype(jnp.float32)
+    weights = (n * probs) ** (-beta)
+    weights = weights / jnp.maximum(weights.max(), 1e-12)
+    batch = jax.tree_util.tree_map(lambda buf: buf[idx], state["data"])
+    return batch, idx, weights, key
+
+
+def update_priorities(state: BufferState, idx: jnp.ndarray,
+                      td_abs: jnp.ndarray,
+                      eps: float = 1e-3) -> BufferState:
+    new_p = td_abs + eps
+    state = dict(state)
+    state["priority"] = state["priority"].at[idx].set(new_p)
+    state["max_priority"] = jnp.maximum(state["max_priority"],
+                                        new_p.max())
+    return state

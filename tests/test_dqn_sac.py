@@ -107,3 +107,50 @@ def test_es_distributed_fan_out():
                    - r2["episode_reward_mean"]) < 1e-4
     finally:
         ray_tpu.shutdown()
+
+
+def test_dueling_per_dqn_learns_cartpole():
+    """Dueling heads (V + A - mean A) + prioritized replay (priority
+    ~ |TD error|, importance-weighted loss) — the reference DQN family's
+    two standard upgrades (rllib dqn dueling option +
+    utils/replay_buffers/prioritized_replay_buffer.py), both living
+    inside the single compiled iteration."""
+    algo = DQNConfig(env=CartPole, num_envs=16, rollout_steps=32,
+                     batch_size=128, num_updates=64, lr=1e-3,
+                     eps_decay_steps=6000, learn_start=512,
+                     dueling=True, prioritized_replay=True,
+                     seed=0).build()
+    rewards = []
+    for _ in range(16):
+        res = algo.train()
+        rewards.append(res["episode_reward_mean"])
+    assert rewards[-1] > 40, f"no learning progress: {rewards}"
+    # priorities actually moved away from their init value
+    import numpy as np
+    pri = np.asarray(algo.buffer["priority"])
+    filled = pri[: int(algo.buffer["size"])]
+    assert filled.std() > 1e-4, "priorities never updated"
+
+
+def test_prioritized_replay_prefers_high_td():
+    """sample_prioritized concentrates on high-priority slots and its
+    importance weights down-weight them (PER bias correction)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import replay
+
+    buf = replay.init_prioritized(64, {"x": jnp.zeros((), jnp.float32)})
+    buf = replay.add_batch_prioritized(
+        buf, {"x": jnp.arange(64, dtype=jnp.float32)}, 64)
+    # slot 7 gets 100x the priority of everyone else
+    buf = replay.update_priorities(buf, jnp.arange(64),
+                                   jnp.full((64,), 0.1))
+    buf = replay.update_priorities(buf, jnp.asarray([7]),
+                                   jnp.asarray([10.0]))
+    batch, idx, w, _ = replay.sample_prioritized(
+        buf, jax.random.PRNGKey(0), 256, alpha=1.0, beta=1.0)
+    frac7 = float((idx == 7).mean())
+    assert frac7 > 0.3, frac7            # ~61% expected at alpha=1
+    # the over-sampled slot carries the SMALLEST importance weight
+    assert float(w[idx == 7].max()) <= float(w[idx != 7].min()) + 1e-6
