@@ -116,8 +116,9 @@ class DQN(Algorithm):
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         obs_dim = self.env.observation_size
-        buffer_init = (replay.init_prioritized if cfg.prioritized_replay
-                       else replay.init)
+        self._replay_ops = replay.make_ops(
+            cfg.prioritized_replay, alpha=cfg.per_alpha, beta=cfg.per_beta)
+        buffer_init = self._replay_ops[0]
         self.buffer = buffer_init(cfg.buffer_capacity, {
             "obs": jnp.zeros((obs_dim,), jnp.float32),
             "action": jnp.zeros((), jnp.int32),
@@ -133,6 +134,7 @@ class DQN(Algorithm):
     def _make_train_iter(self):
         cfg = self.config
         env, q, opt = self.env, self.q, self.optimizer
+        _, add_fn, sample_fn, update_pri = self._replay_ops
         insert_bs = cfg.num_envs  # one buffer insert per scanned env step
 
         from .exploration import EpsilonGreedy
@@ -150,9 +152,7 @@ class DQN(Algorithm):
                 skeys = jax.random.split(skey, cfg.num_envs)
                 env_states, next_obs, reward, done = jax.vmap(env.step)(
                     env_states, action, skeys)
-                add = (replay.add_batch_prioritized
-                       if cfg.prioritized_replay else replay.add_batch)
-                buffer = add(buffer, {
+                buffer = add_fn(buffer, {
                     "obs": obs.astype(jnp.float32),
                     "action": action.astype(jnp.int32),
                     "reward": reward.astype(jnp.float32),
@@ -187,17 +187,11 @@ class DQN(Algorithm):
 
             def update(carry, _):
                 params, target_params, opt_state, buffer, key = carry
-                if cfg.prioritized_replay:
-                    batch, idx, weights, key = replay.sample_prioritized(
-                        buffer, key, cfg.batch_size,
-                        alpha=cfg.per_alpha, beta=cfg.per_beta)
-                else:
-                    batch, key = replay.sample(buffer, key, cfg.batch_size)
-                    idx, weights = None, jnp.ones((cfg.batch_size,))
+                batch, idx, weights, key = sample_fn(buffer, key,
+                                                     cfg.batch_size)
                 (loss, td_abs), grads = jax.value_and_grad(
                     td_loss, has_aux=True)(params, batch, weights)
-                if cfg.prioritized_replay:
-                    buffer = replay.update_priorities(buffer, idx, td_abs)
+                buffer = update_pri(buffer, idx, td_abs)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 target_params = jax.tree_util.tree_map(

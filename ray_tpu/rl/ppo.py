@@ -184,11 +184,15 @@ def make_recurrent_update_fn(policy, optimizer, cfg, num_envs: int,
     """Sequence-aware PPO update: minibatches are whole-env SEQUENCES
     (shuffling the env axis, never time), and log-probs are recomputed by
     replaying the LSTM from the segment's initial state."""
+    if cfg.num_minibatches < 1:
+        raise ValueError(f"num_minibatches={cfg.num_minibatches}: "
+                         f"must be >= 1")
     # minibatch count = the largest divisor of num_envs not above
     # num_minibatches: every env sequence lands in exactly one minibatch
-    # (a non-divisor count would silently drop whole sequences per epoch)
-    n_mb = next((d for d in range(min(cfg.num_minibatches, num_envs),
-                                  0, -1) if num_envs % d == 0), 1)
+    # (a non-divisor count would silently drop whole sequences per epoch;
+    # d=1 always divides, so the search cannot come up empty)
+    n_mb = next(d for d in range(min(cfg.num_minibatches, num_envs),
+                                 0, -1) if num_envs % d == 0)
     mb_envs = num_envs // n_mb
 
     def loss_fn(params, batch, init_state):

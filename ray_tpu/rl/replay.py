@@ -108,19 +108,23 @@ def sample_prioritized(state: BufferState, key: jax.Array,
                        beta: float = 0.4):
     """Sample ∝ priority^alpha; → (batch, idx, importance_weights, key).
 
-    Weights are (N * P(i))^-beta normalized by their max (the PER paper's
-    bias correction).  Unfilled slots have priority 0 and are masked out
-    of the categorical."""
+    Weights are (N * P(i))^-beta normalized by the BUFFER-WIDE max weight
+    — i.e. the weight of the minimum-probability valid entry (the PER
+    paper's bias correction; normalizing by the per-batch max would make
+    the effective step size fluctuate with batch composition).  Unfilled
+    slots have priority 0 and are masked out of the categorical."""
     key, skey = jax.random.split(key)
     valid = jnp.arange(_capacity(state)) < state["size"]
     logits = jnp.where(valid,
                        alpha * jnp.log(state["priority"] + 1e-6),
                        -jnp.inf)
     idx = jax.random.categorical(skey, logits, shape=(batch_size,))
-    probs = jax.nn.softmax(logits)[idx]
+    probs_all = jax.nn.softmax(logits)
+    probs = probs_all[idx]
     n = jnp.maximum(state["size"], 1).astype(jnp.float32)
-    weights = (n * probs) ** (-beta)
-    weights = weights / jnp.maximum(weights.max(), 1e-12)
+    min_prob = jnp.min(jnp.where(valid, probs_all, jnp.inf))
+    max_weight = (n * jnp.maximum(min_prob, 1e-12)) ** (-beta)
+    weights = (n * probs) ** (-beta) / jnp.maximum(max_weight, 1e-12)
     batch = jax.tree_util.tree_map(lambda buf: buf[idx], state["data"])
     return batch, idx, weights, key
 
@@ -134,3 +138,25 @@ def update_priorities(state: BufferState, idx: jnp.ndarray,
     state["max_priority"] = jnp.maximum(state["max_priority"],
                                         new_p.max())
     return state
+
+
+def make_ops(prioritized: bool, *, alpha: float = 0.6, beta: float = 0.4):
+    """One (init, add, sample, update_priorities) tuple for BOTH modes,
+    so algorithms (DQN, SAC) carry no per-mode branching: the uniform
+    sample returns ones for weights and its priority update is the
+    identity.  All four are jittable."""
+    if prioritized:
+        def sample_fn(state, key, batch_size):
+            return sample_prioritized(state, key, batch_size,
+                                      alpha=alpha, beta=beta)
+        return (init_prioritized, add_batch_prioritized, sample_fn,
+                update_priorities)
+
+    def sample_fn(state, key, batch_size):
+        batch, key = sample(state, key, batch_size)
+        return batch, None, jnp.ones((batch_size,)), key
+
+    def update_fn(state, idx, td_abs, eps=1e-3):
+        return state
+
+    return init, add_batch, sample_fn, update_fn

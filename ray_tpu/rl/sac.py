@@ -47,6 +47,9 @@ class SACConfig:
     tau: float = 0.005             # Polyak target-average rate
     init_alpha: float = 0.2
     autotune_alpha: bool = True    # gradient-tune log(alpha) to target entropy
+    prioritized_replay: bool = False
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
     learn_start: int = 1_000
     hidden: tuple = (128, 128)
     seed: int = 0
@@ -85,7 +88,10 @@ class SAC(Algorithm):
         self.opt_state = self.optimizer.init(self.params)
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
-        self.buffer = replay.init(cfg.buffer_capacity, {
+        self._replay_ops = replay.make_ops(
+            cfg.prioritized_replay, alpha=cfg.per_alpha, beta=cfg.per_beta)
+        buffer_init = self._replay_ops[0]
+        self.buffer = buffer_init(cfg.buffer_capacity, {
             "obs": jnp.zeros((obs_dim,), jnp.float32),
             "action": jnp.zeros((act_dim,), jnp.float32),
             "reward": jnp.zeros((), jnp.float32),
@@ -130,6 +136,7 @@ class SAC(Algorithm):
     def _make_train_iter(self):
         cfg = self.config
         env, opt = self.env, self.optimizer
+        _, add_fn, sample_fn, update_pri = self._replay_ops
 
         def train_iter(params, target_q, opt_state, buffer, env_states,
                        obs, key):
@@ -143,7 +150,7 @@ class SAC(Algorithm):
                 skeys = jax.random.split(skey, cfg.num_envs)
                 env_states, next_obs, reward, done = jax.vmap(env.step)(
                     env_states, action, skeys)
-                buffer = replay.add_batch(buffer, {
+                buffer = add_fn(buffer, {
                     "obs": obs.astype(jnp.float32),
                     "action": action.astype(jnp.float32),
                     "reward": reward.astype(jnp.float32),
@@ -157,7 +164,7 @@ class SAC(Algorithm):
                 collect, (buffer, env_states, obs, key), None,
                 length=cfg.rollout_steps)
 
-            def loss_fn(p, batch, key):
+            def loss_fn(p, batch, weights, key):
                 alpha = jnp.exp(p["log_alpha"])
                 # critic target from the CURRENT params' actor + target Qs
                 next_a, next_logp = jax.vmap(
@@ -173,8 +180,9 @@ class SAC(Algorithm):
                 target = jax.lax.stop_gradient(target)
                 q1 = self._q(p["q1"], batch["obs"], batch["action"])
                 q2 = self._q(p["q2"], batch["obs"], batch["action"])
-                critic_loss = jnp.mean((q1 - target) ** 2) \
-                    + jnp.mean((q2 - target) ** 2)
+                td1, td2 = q1 - target, q2 - target
+                critic_loss = jnp.mean(weights * td1 ** 2) \
+                    + jnp.mean(weights * td2 ** 2)
                 # actor: maximize E[min Q - alpha*logp] through fresh actions
                 key2 = jax.random.fold_in(key, 1)
                 a, logp = jax.vmap(
@@ -194,44 +202,52 @@ class SAC(Algorithm):
                 else:
                     alpha_loss = 0.0
                 total = critic_loss + actor_loss + alpha_loss
+                td_abs = 0.5 * (jnp.abs(td1) + jnp.abs(td2))
                 return total, {"critic_loss": critic_loss,
                                "actor_loss": actor_loss,
                                "alpha": alpha,
-                               "entropy": -jnp.mean(logp)}
+                               "entropy": -jnp.mean(logp),
+                               "td_abs": td_abs}
 
             def update(carry, _):
-                params, target_q, opt_state, key = carry
-                batch, key = replay.sample(buffer, key, cfg.batch_size)
+                params, target_q, opt_state, buffer, key = carry
+                batch, idx, weights, key = sample_fn(buffer, key,
+                                                     cfg.batch_size)
                 key, lkey = jax.random.split(key)
                 (_, aux), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, batch, lkey)
+                    loss_fn, has_aux=True)(params, batch, weights, lkey)
+                buffer = update_pri(buffer, idx, aux["td_abs"])
+                aux = {k: v for k, v in aux.items() if k != "td_abs"}
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 target_q = jax.tree_util.tree_map(
                     lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
                     target_q, {"q1": params["q1"], "q2": params["q2"]})
-                return (params, target_q, opt_state, key), aux
+                return (params, target_q, opt_state, buffer, key), aux
 
             do_learn = buffer["size"] >= cfg.learn_start
 
             def run(args):
-                params, target_q, opt_state, key = args
-                (params, target_q, opt_state, key), auxs = jax.lax.scan(
-                    update, (params, target_q, opt_state, key), None,
-                    length=cfg.num_updates)
-                return params, target_q, opt_state, key, \
+                params, target_q, opt_state, buffer, key = args
+                (params, target_q, opt_state, buffer, key), auxs = \
+                    jax.lax.scan(update,
+                                 (params, target_q, opt_state, buffer,
+                                  key), None, length=cfg.num_updates)
+                return params, target_q, opt_state, buffer, key, \
                     jax.tree_util.tree_map(lambda x: x[-1], auxs)
 
             def skip(args):
-                params, target_q, opt_state, key = args
+                params, target_q, opt_state, buffer, key = args
                 zero = {"critic_loss": jnp.zeros(()),
                         "actor_loss": jnp.zeros(()),
                         "alpha": jnp.exp(params["log_alpha"]),
                         "entropy": jnp.zeros(())}
-                return params, target_q, opt_state, key, zero
+                return params, target_q, opt_state, buffer, key, zero
 
-            params, target_q, opt_state, key, metrics = jax.lax.cond(
-                do_learn, run, skip, (params, target_q, opt_state, key))
+            (params, target_q, opt_state, buffer, key,
+             metrics) = jax.lax.cond(
+                do_learn, run, skip,
+                (params, target_q, opt_state, buffer, key))
             metrics["buffer_size"] = buffer["size"]
             return (params, target_q, opt_state, buffer, env_states, obs,
                     key, metrics, traj["reward"], traj["done"])
