@@ -60,8 +60,7 @@ class BatchPredictor:
 
         import cloudpickle
 
-        import ray_tpu
-        from ..core.config import GlobalConfig
+        from ..util.data_carrier import store_bytes
 
         blob = cloudpickle.dumps(self.checkpoint.to_dict())
         # key on checkpoint AND builder: two predictors sharing one
@@ -70,12 +69,10 @@ class BatchPredictor:
         fn_tag = hashlib.sha256(
             cloudpickle.dumps(self.predictor_fn)).hexdigest()[:8]
         key = hashlib.sha256(blob).hexdigest()[:16] + "-" + fn_tag
-        ckpt_ref = None
-        if len(blob) > GlobalConfig.inline_small_args_bytes:
-            ckpt_ref = ray_tpu.put(blob)   # plasma-backed: workers can pull
-            carrier: Any = ckpt_ref
-        else:
-            carrier = blob
+        # shared ref-vs-inline rule (util/data_carrier): refs only when
+        # the blob certainly lands in plasma, where workers CAN fetch it
+        carrier = store_bytes(blob)
+        ckpt_ref = carrier[1] if carrier[0] == "ref" else None
         predictor_fn = self.predictor_fn
 
         def _predict_batch(batch, _carrier=carrier, _key=key):
@@ -84,9 +81,8 @@ class BatchPredictor:
             if fn is None:
                 import cloudpickle as cp
 
-                import ray_tpu as rt
-                raw = _carrier if isinstance(_carrier, bytes) \
-                    else rt.get(_carrier)
+                from ..util.data_carrier import fetch_bytes
+                raw = fetch_bytes(_carrier)
                 fn = predictor_fn(Checkpoint.from_dict(cp.loads(raw)))
                 bp._PROCESS_CACHE[_key] = fn
                 # bounded: built models are large, workers are long-lived

@@ -38,4 +38,4 @@ from .search import (  # noqa: F401
     TPESearch,
 )
 from .trial import Trial  # noqa: F401
-from .tuner import TuneConfig, Tuner, run  # noqa: F401
+from .tuner import TuneConfig, Tuner, run, with_parameters  # noqa: F401

@@ -161,6 +161,34 @@ def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
                                         scheduler=scheduler)).fit()
 
 
+def with_parameters(trainable: Callable, **kwargs) -> Callable:
+    """Attach large data objects to a trainable (reference:
+    `tune/trainable/util.py:240`).  Each kwarg is stored ONCE — in the
+    shared object store when large enough for remote workers to fetch,
+    inline in the function blob when small (the owner's in-process
+    memory store is invisible to trial actors, the same rule
+    `air.BatchPredictor.predict` applies) — and resolved inside every
+    trial instead of being re-pickled per trial.
+
+    Example::
+
+        data = load_big_dataset()
+        Tuner(tune.with_parameters(train_fn, data=data), ...).fit()
+        # train_fn(config, data=...) sees the SAME stored object
+    """
+    from ..util.data_carrier import store_value
+
+    carriers = {k: store_value(v) for k, v in kwargs.items()}
+
+    def inner(config):
+        from ..util.data_carrier import fetch_value as _fetch
+        resolved = {k: _fetch(c) for k, c in carriers.items()}
+        return trainable(config, **resolved)
+
+    inner.__name__ = getattr(trainable, "__name__", "trainable")
+    return inner
+
+
 class _RunningTrial:
     def __init__(self, trial: Trial, actor):
         self.trial = trial

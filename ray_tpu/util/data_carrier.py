@@ -1,0 +1,64 @@
+"""Ship driver-side data to remote workers: object-store ref or inline.
+
+ONE home for a rule two call sites (`tune.with_parameters`,
+`air.BatchPredictor.predict`) previously each implemented with a latent
+bug: `ray_tpu.put` only writes plasma above
+``GlobalConfig.max_direct_call_object_size`` (100 KiB); smaller objects
+live in the driver's PRIVATE in-process memory store, which remote
+workers cannot fetch — a ref in that window, smuggled to a worker inside
+an opaque pickled blob (where the nested-ref plasma promotion can't see
+it), hangs the consumer forever.  Refs are therefore taken only when the
+object CERTAINLY lands in plasma; everything else rides inline, which is
+correct at any size (just unshared).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+Carrier = Tuple[str, Any]   # ("ref", ObjectRef) | ("inline", payload)
+
+
+def _plasma_certain(approx_nbytes: int) -> bool:
+    """Conservative 4x margin over the direct-call threshold: the size
+    probe (cloudpickle) and the wire serializer (msgpack + pickle
+    out-of-band) can disagree by small factors, and a ref that lands in
+    the memory store is a worker hang, not a slowdown."""
+    from ..core.config import GlobalConfig
+    return approx_nbytes > 4 * GlobalConfig.max_direct_call_object_size
+
+
+def store_bytes(blob: bytes) -> Carrier:
+    import ray_tpu
+    if _plasma_certain(len(blob)):
+        return ("ref", ray_tpu.put(blob))
+    return ("inline", blob)
+
+
+def fetch_bytes(carrier: Carrier) -> bytes:
+    kind, payload = carrier
+    if kind == "ref":
+        import ray_tpu
+        return ray_tpu.get(payload)
+    return payload
+
+
+def store_value(value: Any) -> Carrier:
+    """Like store_bytes but keeps VALUE semantics: large values are
+    `put` directly (numpy rides the serializer's out-of-band buffers and
+    reads back as zero-copy views from shm), small ones inline as-is."""
+    import cloudpickle
+
+    import ray_tpu
+    blob = cloudpickle.dumps(value)   # size probe, once at store time
+    if _plasma_certain(len(blob)):
+        return ("ref", ray_tpu.put(value))
+    return ("inline", value)
+
+
+def fetch_value(carrier: Carrier) -> Any:
+    kind, payload = carrier
+    if kind == "ref":
+        import ray_tpu
+        return ray_tpu.get(payload)
+    return payload

@@ -247,6 +247,31 @@ def test_bohb_learns_from_intermediate_budgets():
     assert len(bohb4._budget_hist) == 3
 
 
+def test_with_parameters(cluster, tmp_path):
+    """tune.with_parameters attaches data to a trainable once — a large
+    array rides the object store (fetchable by trial actors), a small
+    scalar inlines — and every trial receives both as kwargs."""
+    big = np.arange(100_000, dtype=np.float64)   # ~800 KB: plasma path
+
+    def objective(config, big=None, offset=None):
+        session.report({"loss": float(big.sum()) * 0.0
+                        + (config["x"] - offset) ** 2})
+
+    res = Tuner(
+        tune.with_parameters(objective, big=big, offset=2.0),
+        param_space={"x": tune.uniform(-5, 5)},
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=4,
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="wp", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(res) == 4
+    # the loss is exactly (x-2)^2: both kwargs arrived intact
+    for r in res:
+        x = r.metrics["config"]["x"]
+        np.testing.assert_allclose(r.metrics["loss"], (x - 2.0) ** 2,
+                                   rtol=1e-6)
+
+
 def test_runner_injects_config_into_searcher_results(cluster, tmp_path):
     """The runner passes the trial's CURRENT config with every result it
     forwards to the searcher — the only channel that survives a PBT/PB2
