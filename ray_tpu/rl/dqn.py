@@ -79,6 +79,9 @@ class DQNConfig:
     tau: float = 0.01              # Polyak target-average rate
     double_q: bool = True
     dueling: bool = False          # V + A - mean(A) heads
+    n_step: int = 1                # n-step targets (window gathered at
+    #   sample time from buffer adjacency; cursor-crossing windows fall
+    #   back to 1-step)
     prioritized_replay: bool = False
     per_alpha: float = 0.6         # priority exponent
     per_beta: float = 0.4          # importance-weight exponent
@@ -179,7 +182,7 @@ class DQN(Algorithm):
                         next_q_target, next_a[:, None], axis=-1)[:, 0]
                 else:
                     next_q = jnp.max(next_q_target, axis=-1)
-                target = batch["reward"] + cfg.gamma * next_q * \
+                target = batch["reward"] + batch["gamma_n"] * next_q * \
                     (1.0 - batch["done"])
                 target = jax.lax.stop_gradient(target)
                 td = q_sa - target
@@ -189,6 +192,19 @@ class DQN(Algorithm):
                 params, target_params, opt_state, buffer, key = carry
                 batch, idx, weights, key = sample_fn(buffer, key,
                                                      cfg.batch_size)
+                if cfg.n_step > 1:
+                    # collection interleaves num_envs slots per timestep
+                    reward_n, next_obs_n, done_n, gamma_n = \
+                        replay.nstep_window(buffer, idx, cfg.n_step,
+                                            cfg.gamma,
+                                            stride=cfg.num_envs)
+                    batch = {**batch, "reward": reward_n,
+                             "next_obs": next_obs_n, "done": done_n,
+                             "gamma_n": gamma_n}
+                else:
+                    batch = {**batch,
+                             "gamma_n": jnp.full((cfg.batch_size,),
+                                                 cfg.gamma)}
                 (loss, td_abs), grads = jax.value_and_grad(
                     td_loss, has_aux=True)(params, batch, weights)
                 buffer = update_pri(buffer, idx, td_abs)

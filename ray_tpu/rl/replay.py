@@ -63,13 +63,15 @@ def add_batch(state: BufferState, batch: Dict[str, jnp.ndarray],
 
 
 def sample(state: BufferState, key: jax.Array, batch_size: int
-           ) -> Tuple[Dict[str, jnp.ndarray], jax.Array]:
-    """Uniform sample of batch_size transitions from the filled region."""
+           ) -> Tuple[Dict[str, jnp.ndarray], jax.Array, jax.Array]:
+    """Uniform sample of batch_size transitions from the filled region.
+    → (batch, idx, key): indices are exposed for n-step lookups and
+    priority updates."""
     key, skey = jax.random.split(key)
     idx = jax.random.randint(skey, (batch_size,), 0,
                              jnp.maximum(state["size"], 1))
     batch = jax.tree_util.tree_map(lambda buf: buf[idx], state["data"])
-    return batch, key
+    return batch, idx, key
 
 
 # -- prioritized variant (reference: rllib/utils/replay_buffers/
@@ -140,6 +142,63 @@ def update_priorities(state: BufferState, idx: jnp.ndarray,
     return state
 
 
+def nstep_window(state: BufferState, idx: jnp.ndarray, n: int,
+                 gamma: float, stride: int = 1):
+    """n-step lookahead from sampled slots (reference: rllib's n_step
+    rewrite in the sampling path).
+
+    Writes are strictly sequential, so the transition temporally
+    following slot ``s`` lives at ``s + stride`` — where ``stride`` is
+    the insert batch size (vectorized collection interleaves one slot
+    per env per timestep; stride=1 only for single-env collection).
+    Windows that would cross the write cursor into a previous epoch's
+    data (or unwritten slots) fall back to their plain 1-step values.
+    Episode ends inside the window stop the accumulation (standard
+    n-step).
+
+    → (reward_n [B], bootstrap_obs [B, ...], done_n [B], gamma_n [B]):
+    ``target = reward_n + gamma_n * (1 - done_n) * maxQ(bootstrap_obs)``.
+    """
+    cap = _capacity(state)
+    widx = (idx[:, None] + jnp.arange(n) * stride) % cap     # [B, n]
+    rewards = state["data"]["reward"][widx]                  # [B, n]
+    dones = state["data"]["done"][widx]                      # [B, n]
+    # alive[k] = 1 while no done at steps < k (the done step itself
+    # still contributes its reward)
+    alive = jnp.cumprod(
+        jnp.concatenate([jnp.ones_like(dones[:, :1]),
+                         1.0 - dones[:, :-1]], axis=1), axis=1)
+    discount = gamma ** jnp.arange(n)
+    reward_n = (rewards * alive * discount).sum(axis=1)
+    # number of steps actually taken: first done truncates
+    steps = alive.sum(axis=1)                                # [B] in [1, n]
+    done_n = (dones * alive).sum(axis=1)                     # done inside?
+    gamma_n = gamma ** steps
+    # bootstrap from the LAST live step's next_obs
+    last = jnp.clip(steps - 1, 0, n - 1).astype(jnp.int32)
+    last_slot = (idx + last * stride) % cap
+    next_obs = state["data"]["next_obs"][last_slot]
+    # windows crossing the write cursor would read a different epoch's
+    # data (or unwritten slots while filling): require the whole window
+    # to fit before the cursor / the filled region
+    span = (n - 1) * stride
+    dist = (state["cursor"] - idx - 1) % cap
+    fill_dist = state["size"] - idx - 1
+    window_ok = jnp.where(state["size"] < cap,
+                          fill_dist >= span, dist >= span)
+
+    def fallback(x_n, x_1):
+        return jnp.where(window_ok, x_n, x_1)
+
+    reward_n = fallback(reward_n, state["data"]["reward"][idx])
+    done_n = fallback(done_n, state["data"]["done"][idx])
+    gamma_n = fallback(gamma_n, jnp.full_like(gamma_n, gamma))
+    obs_mask = window_ok.reshape((-1,) + (1,) * (next_obs.ndim - 1))
+    next_obs = jnp.where(obs_mask, next_obs,
+                         state["data"]["next_obs"][idx])
+    return reward_n, next_obs, done_n, gamma_n
+
+
 def make_ops(prioritized: bool, *, alpha: float = 0.6, beta: float = 0.4):
     """One (init, add, sample, update_priorities) tuple for BOTH modes,
     so algorithms (DQN, SAC) carry no per-mode branching: the uniform
@@ -153,8 +212,8 @@ def make_ops(prioritized: bool, *, alpha: float = 0.6, beta: float = 0.4):
                 update_priorities)
 
     def sample_fn(state, key, batch_size):
-        batch, key = sample(state, key, batch_size)
-        return batch, None, jnp.ones((batch_size,)), key
+        batch, idx, key = sample(state, key, batch_size)
+        return batch, idx, jnp.ones((batch_size,)), key
 
     def update_fn(state, idx, td_abs, eps=1e-3):
         return state

@@ -29,8 +29,13 @@ def _plasma_certain(approx_nbytes: int) -> bool:
 
 
 def store_bytes(blob: bytes) -> Carrier:
+    # for raw bytes the wire size IS len(blob) + small framing, so no
+    # probe margin is needed — just clear the direct-call threshold with
+    # framing slack (the 4x margin would regress 100-400 KiB checkpoints
+    # to per-task inline shipping)
     import ray_tpu
-    if _plasma_certain(len(blob)):
+    from ..core.config import GlobalConfig
+    if len(blob) > GlobalConfig.max_direct_call_object_size + 4096:
         return ("ref", ray_tpu.put(blob))
     return ("inline", blob)
 
@@ -43,15 +48,26 @@ def fetch_bytes(carrier: Carrier) -> bytes:
     return payload
 
 
+def _approx_nbytes(value: Any) -> int:
+    """Cheap size estimate — a full cloudpickle probe of a multi-GB
+    array would double peak memory for exactly the objects this module
+    exists to ship.  Array-likes and bytes answer from metadata; only
+    opaque objects pay for a pickle."""
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    import cloudpickle
+    return len(cloudpickle.dumps(value))
+
+
 def store_value(value: Any) -> Carrier:
     """Like store_bytes but keeps VALUE semantics: large values are
     `put` directly (numpy rides the serializer's out-of-band buffers and
     reads back as zero-copy views from shm), small ones inline as-is."""
-    import cloudpickle
-
     import ray_tpu
-    blob = cloudpickle.dumps(value)   # size probe, once at store time
-    if _plasma_certain(len(blob)):
+    if _plasma_certain(_approx_nbytes(value)):
         return ("ref", ray_tpu.put(value))
     return ("inline", value)
 

@@ -22,7 +22,7 @@ def test_replay_buffer_wraps_and_samples():
     # slots 0-3 hold the newest batch (i=2), 4-7 the middle one (i=1)
     data = np.asarray(buf["data"]["x"])
     assert (data[:4] == 2.0).all() and (data[4:] == 1.0).all()
-    sample, _ = replay.sample(buf, jax.random.PRNGKey(0), 16)
+    sample, _idx, _ = replay.sample(buf, jax.random.PRNGKey(0), 16)
     assert sample["x"].shape == (16, 2)
 
 
@@ -168,3 +168,50 @@ def test_sac_prioritized_replay_runs_and_updates_priorities():
     assert res["critic_loss"] != 0.0          # learning actually began
     pri = np.asarray(algo.buffer["priority"])[: int(algo.buffer["size"])]
     assert pri.std() > 1e-4, "priorities never updated"
+
+
+def test_nstep_window_math_and_stride():
+    """nstep_window hand-checks: discounted accumulation, done
+    truncation, cursor/fill fallback — and the stride semantics that
+    make it correct for interleaved vectorized collection (the temporal
+    successor of slot s is s + num_envs, not s + 1)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import replay
+
+    buf = replay.init(16, {"reward": jnp.zeros(()), "done": jnp.zeros(()),
+                           "next_obs": jnp.zeros((2,))})
+    r = jnp.asarray([1., 2., 3., 4., 5., 6.])
+    d = jnp.asarray([0., 0., 0., 1., 0., 0.])
+    no = jnp.stack([jnp.full((2,), i + 10.) for i in range(6)])
+    buf = replay.add_batch(buf, {"reward": r, "done": d, "next_obs": no}, 6)
+    rn, non, dn, gn = replay.nstep_window(
+        buf, jnp.asarray([0, 2, 3, 4]), 3, 0.9)
+    np.testing.assert_allclose(rn[0], 1 + .9 * 2 + .81 * 3, rtol=1e-6)
+    np.testing.assert_allclose(non[0], [12., 12.])
+    assert dn[0] == 0 and abs(float(gn[0]) - 0.9 ** 3) < 1e-6
+    np.testing.assert_allclose(rn[1], 3 + .9 * 4, rtol=1e-6)  # done stops
+    assert dn[1] == 1
+    np.testing.assert_allclose(rn[2], 4.0)                    # done at t
+    np.testing.assert_allclose(rn[3], 5.0)                    # fallback:
+    np.testing.assert_allclose(gn[3], 0.9)                    # window
+    #   would cross into unwritten slots
+
+    # stride=2 (two interleaved envs): env-0's successor of slot 0 is
+    # slot 2, so the 2-step return from slot 0 is r0 + gamma*r2
+    rn2, _, dn2, _ = replay.nstep_window(
+        buf, jnp.asarray([0]), 2, 0.9, stride=2)
+    np.testing.assert_allclose(rn2[0], 1 + .9 * 3, rtol=1e-6)
+    assert dn2[0] == 0
+
+
+def test_nstep_dqn_learns_cartpole():
+    """n_step=3 targets speed up credit assignment on CartPole: the same
+    budget that takes 1-step DQN to ~40-50 clears it comfortably."""
+    algo = DQNConfig(env=CartPole, num_envs=16, rollout_steps=32,
+                     batch_size=128, num_updates=64, lr=1e-3,
+                     eps_decay_steps=6000, learn_start=512, n_step=3,
+                     seed=0).build()
+    for _ in range(16):
+        res = algo.train()
+    assert res["episode_reward_mean"] > 40, res["episode_reward_mean"]
