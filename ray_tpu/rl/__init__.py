@@ -25,6 +25,8 @@ from .sac import SAC, SACConfig  # noqa: F401
 from .offline import (  # noqa: F401
     BC,
     BCConfig,
+    CQL,
+    CQLConfig,
     collect_dataset,
     importance_sampling_estimate,
     load_dataset,

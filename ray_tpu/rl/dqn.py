@@ -66,6 +66,23 @@ class QNetwork:
         return v + a - a.mean(axis=-1, keepdims=True)
 
 
+def dqn_target(q_apply, params, target_params, reward, next_obs, done,
+               gamma, double_q: bool):
+    """The (double-)DQN TD target, stop-gradiented — ONE definition
+    shared by online DQN and offline CQL so target-selection fixes
+    cannot diverge.  ``gamma`` may be a scalar or a per-sample vector
+    (n-step)."""
+    next_qt = q_apply(target_params, next_obs)
+    if double_q:
+        # double-DQN: online net selects, target net evaluates
+        next_a = jnp.argmax(q_apply(params, next_obs), axis=-1)
+        next_q = jnp.take_along_axis(next_qt, next_a[:, None],
+                                     axis=-1)[:, 0]
+    else:
+        next_q = jnp.max(next_qt, axis=-1)
+    return jax.lax.stop_gradient(reward + gamma * next_q * (1.0 - done))
+
+
 @dataclasses.dataclass
 class DQNConfig:
     env: Optional[Callable[[], JaxEnv]] = None
@@ -173,18 +190,10 @@ class DQN(Algorithm):
                 qvals = q.apply(params, batch["obs"])
                 q_sa = jnp.take_along_axis(
                     qvals, batch["action"][:, None], axis=-1)[:, 0]
-                next_q_target = q.apply(target_params, batch["next_obs"])
-                if cfg.double_q:
-                    # double-DQN: online net selects, target net evaluates
-                    next_a = jnp.argmax(q.apply(params, batch["next_obs"]),
-                                        axis=-1)
-                    next_q = jnp.take_along_axis(
-                        next_q_target, next_a[:, None], axis=-1)[:, 0]
-                else:
-                    next_q = jnp.max(next_q_target, axis=-1)
-                target = batch["reward"] + batch["gamma_n"] * next_q * \
-                    (1.0 - batch["done"])
-                target = jax.lax.stop_gradient(target)
+                target = dqn_target(q.apply, params, target_params,
+                                    batch["reward"], batch["next_obs"],
+                                    batch["done"], batch["gamma_n"],
+                                    cfg.double_q)
                 td = q_sa - target
                 return jnp.mean(weights * td ** 2), jnp.abs(td)
 
@@ -197,7 +206,8 @@ class DQN(Algorithm):
                     reward_n, next_obs_n, done_n, gamma_n = \
                         replay.nstep_window(buffer, idx, cfg.n_step,
                                             cfg.gamma,
-                                            stride=cfg.num_envs)
+                                            stride=cfg.num_envs,
+                                            one_step=batch)
                     batch = {**batch, "reward": reward_n,
                              "next_obs": next_obs_n, "done": done_n,
                              "gamma_n": gamma_n}

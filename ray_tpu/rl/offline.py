@@ -171,6 +171,148 @@ class BC(Algorithm):
 
 
 # ------------------------------------------------- off-policy estimation
+# ------------------------------------------------------ conservative Q
+@dataclasses.dataclass
+class CQLConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    dataset: Optional[Dict[str, np.ndarray]] = None
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs_per_iter: int = 1
+    gamma: float = 0.99
+    tau: float = 0.01              # Polyak target-average rate
+    cql_alpha: float = 1.0         # conservative-penalty weight
+    double_q: bool = True
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL(Algorithm):
+    """Conservative Q-Learning, discrete actions (reference:
+    `rllib/algorithms/cql/cql.py` — the flagship offline algorithm).
+
+    Standard (double-)DQN TD learning on the fixed dataset plus the CQL
+    regularizer ``alpha * E[logsumexp_a Q(s, a) - Q(s, a_data)]``, which
+    pushes down Q-values of actions the dataset never took — the
+    out-of-distribution overestimation that sinks naive offline DQN.
+    One jitted epoch function over permuted minibatches, like BC.
+    """
+
+    _config_cls = CQLConfig
+
+    def __init__(self, config: CQLConfig):
+        super().__init__(config)
+        if config.env is None or config.dataset is None:
+            raise ValueError("CQLConfig.env and CQLConfig.dataset required")
+        self.env = config.env()
+        if not self.env.discrete:
+            raise ValueError("this CQL implementation is discrete-action "
+                             "(the reference's continuous variant adds "
+                             "an SAC actor)")
+        from .dqn import QNetwork
+        self.q = QNetwork(self.env.observation_size, self.env.action_size,
+                          hidden=config.hidden)
+        self.key = jax.random.PRNGKey(config.seed)
+        self.key, pkey = jax.random.split(self.key)
+        self.params = self.q.init(pkey)
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        ds = config.dataset
+        n = (len(ds["obs"]) // config.batch_size) * config.batch_size
+        self._data = {
+            "obs": jnp.asarray(ds["obs"][:n], jnp.float32),
+            "action": jnp.asarray(ds["action"][:n], jnp.int32),
+            "reward": jnp.asarray(ds["reward"][:n], jnp.float32),
+            "next_obs": jnp.asarray(ds["next_obs"][:n], jnp.float32),
+            "done": jnp.asarray(ds["done"][:n], jnp.float32),
+        }
+        self._epoch = jax.jit(self._make_epoch_fn(n))
+
+    def _make_epoch_fn(self, n: int):
+        cfg = self.config
+        q = self.q
+        n_mb = n // cfg.batch_size
+
+        def epoch(params, target_params, opt_state, key):
+            key, pkey = jax.random.split(key)
+            idx = jax.random.permutation(pkey, n).reshape(
+                n_mb, cfg.batch_size)
+
+            def mb_step(carry, ix):
+                params, target_params, opt_state = carry
+                batch = jax.tree_util.tree_map(lambda x: x[ix],
+                                               self._data)
+
+                def loss_fn(p):
+                    from .dqn import dqn_target
+                    qvals = q.apply(p, batch["obs"])           # [B, A]
+                    q_sa = jnp.take_along_axis(
+                        qvals, batch["action"][:, None], axis=-1)[:, 0]
+                    target = dqn_target(q.apply, p, target_params,
+                                        batch["reward"],
+                                        batch["next_obs"], batch["done"],
+                                        cfg.gamma, cfg.double_q)
+                    td = q_sa - target
+                    # the conservative term: minimize OOD action values
+                    cql = jnp.mean(jax.nn.logsumexp(qvals, axis=-1)
+                                   - q_sa)
+                    return jnp.mean(td ** 2) + cfg.cql_alpha * cql, cql
+
+                (loss, cql), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                target_params = jax.tree_util.tree_map(
+                    lambda t, p_: (1 - cfg.tau) * t + cfg.tau * p_,
+                    target_params, params)
+                return (params, target_params, opt_state), (loss, cql)
+
+            (params, target_params, opt_state), (losses, cqls) = \
+                jax.lax.scan(mb_step, (params, target_params, opt_state),
+                             idx)
+            return (params, target_params, opt_state, key,
+                    losses.mean(), cqls.mean())
+
+        return epoch
+
+    def training_step(self) -> Dict[str, Any]:
+        loss = cql = None
+        for _ in range(self.config.epochs_per_iter):
+            (self.params, self.target_params, self.opt_state, self.key,
+             loss, cql) = self._epoch(self.params, self.target_params,
+                                      self.opt_state, self.key)
+        return {"cql_loss": float(loss), "cql_gap": float(cql),
+                "env_steps_this_iter": 0}
+
+    def action_fn(self):
+        """Greedy jittable policy for deployment/eval."""
+        q, params = self.q, self.params
+
+        def act(obs, key):
+            return jnp.argmax(q.apply(params, obs), axis=-1)
+        return act
+
+    def get_state(self) -> Dict[str, Any]:
+        to_np = jax.tree_util.tree_map
+        return {"params": to_np(np.asarray, self.params),
+                "target_params": to_np(np.asarray, self.target_params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        as_dev = lambda t, w: jax.tree_util.tree_map(  # noqa: E731
+            lambda _, x: jnp.asarray(x), t, w)
+        self.params = as_dev(self.params, state["params"])
+        self.target_params = as_dev(self.target_params,
+                                    state["target_params"])
+        self.iteration = state.get("iteration", 0)
+
+
 def importance_sampling_estimate(policy: MLPPolicy, params,
                                  episodes: Dict[str, np.ndarray],
                                  behavior_logp: np.ndarray,

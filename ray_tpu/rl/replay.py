@@ -143,7 +143,7 @@ def update_priorities(state: BufferState, idx: jnp.ndarray,
 
 
 def nstep_window(state: BufferState, idx: jnp.ndarray, n: int,
-                 gamma: float, stride: int = 1):
+                 gamma: float, stride: int = 1, one_step=None):
     """n-step lookahead from sampled slots (reference: rllib's n_step
     rewrite in the sampling path).
 
@@ -190,12 +190,15 @@ def nstep_window(state: BufferState, idx: jnp.ndarray, n: int,
     def fallback(x_n, x_1):
         return jnp.where(window_ok, x_n, x_1)
 
-    reward_n = fallback(reward_n, state["data"]["reward"][idx])
-    done_n = fallback(done_n, state["data"]["done"][idx])
+    # the caller usually sampled the 1-step values already (``one_step``:
+    # a batch dict) — reuse them rather than re-gathering
+    os_ = one_step or {k: state["data"][k][idx]
+                       for k in ("reward", "done", "next_obs")}
+    reward_n = fallback(reward_n, os_["reward"])
+    done_n = fallback(done_n, os_["done"])
     gamma_n = fallback(gamma_n, jnp.full_like(gamma_n, gamma))
     obs_mask = window_ok.reshape((-1,) + (1,) * (next_obs.ndim - 1))
-    next_obs = jnp.where(obs_mask, next_obs,
-                         state["data"]["next_obs"][idx])
+    next_obs = jnp.where(obs_mask, next_obs, os_["next_obs"])
     return reward_n, next_obs, done_n, gamma_n
 
 

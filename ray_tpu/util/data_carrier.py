@@ -56,7 +56,10 @@ def _approx_nbytes(value: Any) -> int:
     if isinstance(value, (bytes, bytearray)):
         return len(value)
     nbytes = getattr(value, "nbytes", None)
-    if isinstance(nbytes, int):
+    # object-dtype arrays report 8-byte pointers, not payload — fall
+    # through to the exact probe for those
+    if isinstance(nbytes, int) and \
+            str(getattr(value, "dtype", "")) != "object":
         return nbytes
     import cloudpickle
     return len(cloudpickle.dumps(value))
