@@ -14,6 +14,7 @@ from .backend_executor import BackendExecutor  # noqa: F401
 from .checkpointing import CheckpointManager  # noqa: F401
 from .hf import TransformersTrainer  # noqa: F401
 from .gbdt import GBDTModel, LightGBMTrainer, XGBoostTrainer  # noqa: F401
+from .rl import RLTrainer  # noqa: F401
 from .sklearn import GBDTTrainer, SklearnTrainer  # noqa: F401
 from .trainer import (  # noqa: F401
     JaxTrainer,

@@ -1,0 +1,49 @@
+"""RLTrainer: the Train-API face of the RL algorithms.
+
+Capability mirror of the reference's `train/rl/rl_trainer.py` (wrap an
+RLlib algorithm as an AIR Trainer so RL fits the same
+fit() → Result(metrics, checkpoint) contract as every other trainer).
+Here the algorithms are already fully-jitted JAX programs, so the
+trainer runs the iteration loop directly and checkpoints through the
+algorithm's own state dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..air import Result, RunConfig
+
+
+class RLTrainer:
+    """``RLTrainer(PPOConfig(env=...), iterations=20).fit()``.
+
+    ``algo_config`` is any RL config object with ``.build()`` (PPOConfig,
+    DQNConfig, SACConfig, CQLConfig, ...).  ``stop`` may name a metric
+    threshold (e.g. ``{"episode_reward_mean": 450}``) to end training
+    early.  The Result carries the final iteration's metrics and a
+    checkpoint restorable via ``algo_config.build().restore(ckpt)``.
+    """
+
+    def __init__(self, algo_config: Any, *, iterations: int = 10,
+                 stop: Optional[Dict[str, float]] = None,
+                 run_config: Optional[RunConfig] = None,
+                 on_result: Optional[Callable[[Dict[str, Any]], None]]
+                 = None):
+        self.algo_config = algo_config
+        self.iterations = iterations
+        self.stop = stop or {}
+        self.run_config = run_config or RunConfig()
+        self.on_result = on_result
+
+    def fit(self) -> Result:
+        algo = self.algo_config.build()
+        res: Dict[str, Any] = {}
+        for _ in range(self.iterations):
+            res = algo.train()
+            if self.on_result is not None:
+                self.on_result(res)
+            if any(res.get(k, float("-inf")) >= v
+                   for k, v in self.stop.items()):
+                break
+        return Result(metrics=res, checkpoint=algo.save())

@@ -182,3 +182,29 @@ def test_recurrent_policy_guards():
         PPOConfig(env=MemoryCue, num_workers=2, num_envs=4,
                   rollout_length=8,
                   model={"use_lstm": True}).build()
+
+
+def test_rl_trainer_air_contract():
+    """RLTrainer gives RL the same fit() -> Result(metrics, checkpoint)
+    contract as every other trainer (reference: train/rl/rl_trainer.py),
+    with early stopping on a metric threshold and a checkpoint that
+    restores into a fresh algorithm."""
+    import jax
+
+    from ray_tpu.rl import CartPole, PPOConfig
+    from ray_tpu.train import RLTrainer
+
+    seen = []
+    cfg = PPOConfig(env=CartPole, num_envs=16, rollout_length=64,
+                    lr=3e-3, seed=0)
+    result = RLTrainer(cfg, iterations=30,
+                       stop={"episode_reward_mean": 80},
+                       on_result=seen.append).fit()
+    assert result.metrics["episode_reward_mean"] >= 80
+    assert len(seen) < 30                      # early stop actually fired
+    algo2 = cfg.build()
+    algo2.restore(result.checkpoint)           # round-trips
+    for a, b in zip(
+            jax.tree_util.tree_leaves(result.checkpoint.to_dict()["params"]),
+            jax.tree_util.tree_leaves(algo2.get_state()["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
