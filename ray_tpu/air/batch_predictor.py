@@ -77,17 +77,21 @@ class BatchPredictor:
 
         def _predict_batch(batch, _carrier=carrier, _key=key):
             from ray_tpu.air import batch_predictor as bp
-            fn = bp._PROCESS_CACHE.get(_key)
-            if fn is None:
+            cached = bp._PROCESS_CACHE.get(_key)
+            if cached is None:
                 import cloudpickle as cp
 
                 from ..util.data_carrier import fetch_bytes
                 raw = fetch_bytes(_carrier)
-                fn = predictor_fn(Checkpoint.from_dict(cp.loads(raw)))
-                bp._PROCESS_CACHE[_key] = fn
+                ckpt = Checkpoint.from_dict(cp.loads(raw))
+                cached = (predictor_fn(ckpt), ckpt.get_preprocessor())
+                bp._PROCESS_CACHE[_key] = cached
                 # bounded: built models are large, workers are long-lived
                 while len(bp._PROCESS_CACHE) > bp._PROCESS_CACHE_MAX:
                     bp._PROCESS_CACHE.pop(next(iter(bp._PROCESS_CACHE)))
+            fn, preprocessor = cached
+            if preprocessor is not None:
+                batch = preprocessor.transform_batch(batch)
             return list(fn(batch))
 
         out = dataset.map_batches(_predict_batch, batch_size=batch_size)

@@ -155,7 +155,15 @@ class Checkpoint:
         fp = os.path.join(self._path, _DICT_FILE)
         if os.path.exists(fp):
             with open(fp, "rb") as f:
-                return pickle.load(f)
+                data = pickle.load(f)
+            # a preprocessor sidecar must survive the dict round trip
+            # (BatchPredictor ships checkpoints as to_dict blobs)
+            pf = os.path.join(self._path, self._PREPROCESSOR_FILE)
+            if os.path.exists(pf) and \
+                    self._PREPROCESSOR_KEY not in data:
+                with open(pf, "rb") as f:
+                    data[self._PREPROCESSOR_KEY] = f.read()
+            return data
         # generic directory → special key holding the file map
         out: Dict[str, Any] = {}
         for root, _, files in os.walk(self._path):
@@ -192,6 +200,50 @@ class Checkpoint:
         with tarfile.open(fileobj=buf, mode="w") as tf:
             tf.add(self._path, arcname=".")
         return pickle.dumps({"__ckpt_kind__": "tar", "tar": buf.getvalue()})
+
+    # -- preprocessor attachment --------------------------------------------
+    _PREPROCESSOR_KEY = "_preprocessor"
+    _PREPROCESSOR_FILE = "preprocessor.pkl"
+
+    def with_preprocessor(self, preprocessor: Any) -> "Checkpoint":
+        """Attach a fitted preprocessor (reference: air/checkpoint.py's
+        preprocessor attachment feeding BatchPredictor/Serve —
+        `python/ray/train/batch_predictor.py` applies it before every
+        predict batch).
+
+        Dict checkpoints return a NEW checkpoint; directory checkpoints
+        attach IN PLACE (a ``preprocessor.pkl`` sidecar next to the
+        payload — copying a multi-GB orbax tree for immutability would
+        be worse than the aliasing) and return self.
+        """
+        import cloudpickle
+        blob = cloudpickle.dumps(preprocessor)
+        if self._data is not None:
+            data = dict(self._data)
+            data[self._PREPROCESSOR_KEY] = blob
+            return Checkpoint.from_dict(data)
+        # sidecar file next to the payload (kept out of the orbax
+        # pytree dirs, which must stay orbax-owned)
+        with open(os.path.join(self._path, self._PREPROCESSOR_FILE),
+                  "wb") as f:
+            f.write(blob)
+        return self
+
+    def get_preprocessor(self) -> Optional[Any]:
+        import cloudpickle
+        if self._data is not None:
+            blob = self._data.get(self._PREPROCESSOR_KEY)
+            if blob is None:
+                # a directory checkpoint shipped via to_dict carries the
+                # sidecar in its file map
+                blob = self._data.get("__files__", {}).get(
+                    self._PREPROCESSOR_FILE)
+            return cloudpickle.loads(blob) if blob is not None else None
+        fp = os.path.join(self._path, self._PREPROCESSOR_FILE)
+        if os.path.exists(fp):
+            with open(fp, "rb") as f:
+                return cloudpickle.loads(f.read())
+        return None
 
     @property
     def path(self) -> Optional[str]:

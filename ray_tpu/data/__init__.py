@@ -38,3 +38,5 @@ from .read_api import (  # noqa: F401
     read_parquet,
     read_text,
 )
+
+from . import preprocessors  # noqa: F401,E402  (AIR preprocessor library)

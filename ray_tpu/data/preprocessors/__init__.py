@@ -1,0 +1,27 @@
+"""AIR preprocessors: fit on a Dataset, transform Datasets and batches,
+ride Checkpoints into BatchPredictor/Serve.
+
+Capability mirror of
+/root/reference/python/ray/data/preprocessors/__init__.py:1.
+"""
+
+from .base import (BatchMapper, Chain, Preprocessor,
+                   PreprocessorNotFittedError)
+from .encoders import (Categorizer, LabelEncoder, MultiHotEncoder,
+                       OneHotEncoder, OrdinalEncoder)
+from .scalers import (Concatenator, CustomKBinsDiscretizer, MaxAbsScaler,
+                      MinMaxScaler, Normalizer, PowerTransformer,
+                      RobustScaler, SimpleImputer, StandardScaler,
+                      UniformKBinsDiscretizer)
+from .text import (CountVectorizer, FeatureHasher, HashingVectorizer,
+                   Tokenizer)
+
+__all__ = [
+    "BatchMapper", "Categorizer", "Chain", "Concatenator",
+    "CountVectorizer", "CustomKBinsDiscretizer", "FeatureHasher",
+    "HashingVectorizer", "LabelEncoder", "MaxAbsScaler", "MinMaxScaler",
+    "MultiHotEncoder", "Normalizer", "OneHotEncoder", "OrdinalEncoder",
+    "PowerTransformer", "Preprocessor", "PreprocessorNotFittedError",
+    "RobustScaler", "SimpleImputer", "StandardScaler", "Tokenizer",
+    "UniformKBinsDiscretizer",
+]

@@ -1,0 +1,183 @@
+"""Preprocessor core: fit on a Dataset, transform Datasets and batches.
+
+Capability mirror of the reference's AIR preprocessor layer
+(/root/reference/python/ray/data/preprocessor.py:21 — Preprocessor with
+fit/transform/transform_batch and a fit-state contract;
+preprocessors/chain.py:8; preprocessors/batch_mapper.py:12).  Design
+differences: fit statistics are computed as one small partial dict per
+block gathered through the existing lazy plan machinery (map_batches →
+take_all) instead of the reference's Dataset.aggregate GroupBy path, and
+the fitted state is plain picklable attributes so a preprocessor rides a
+Checkpoint (``Checkpoint.with_preprocessor``) into BatchPredictor/Serve.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class PreprocessorNotFittedError(RuntimeError):
+    """transform called before fit on a fittable preprocessor."""
+
+
+class Preprocessor:
+    """Fit state from a Dataset; row-preserving transforms of batches.
+
+    Subclasses implement ``_fit(dataset)`` (set ``self.stats_``; skip if
+    stateless — set ``_is_fittable = False``) and
+    ``_transform_pandas(df) -> df``.
+    """
+
+    _is_fittable = True
+
+    # -- fitting ------------------------------------------------------------
+    def fit(self, dataset: Any) -> "Preprocessor":
+        if self._is_fittable:
+            self._fit(dataset)
+        return self
+
+    def fit_transform(self, dataset: Any) -> Any:
+        return self.fit(dataset).transform(dataset)
+
+    def _fit(self, dataset: Any) -> None:
+        raise NotImplementedError
+
+    @property
+    def fitted(self) -> bool:
+        return not self._is_fittable or \
+            getattr(self, "stats_", None) is not None
+
+    def _check_fitted(self) -> None:
+        if not self.fitted:
+            raise PreprocessorNotFittedError(
+                f"{type(self).__name__} must be fit before transforming")
+
+    # -- transforming -------------------------------------------------------
+    def transform(self, dataset: Any) -> Any:
+        self._check_fitted()
+        return dataset.map_batches(self._transform_pandas,
+                                   batch_format="pandas")
+
+    def transform_batch(self, batch: Any) -> Any:
+        """Batch (DataFrame | dict-of-arrays | list-of-dicts) → same
+        format, transformed.  The online-inference entry point
+        (BatchPredictor / Serve replicas)."""
+        self._check_fitted()
+        df, restore = _to_pandas(batch)
+        return restore(self._transform_pandas(df))
+
+    def _transform_pandas(self, df):
+        raise NotImplementedError
+
+    def __repr__(self):
+        state = "fitted" if self.fitted else "not fitted"
+        return f"{type(self).__name__}({state})"
+
+
+# -- batch format round trip -------------------------------------------------
+
+def _to_pandas(batch: Any):
+    """→ (DataFrame, restore_fn) where restore_fn returns the caller's
+    original batch format."""
+    import pandas as pd
+    if isinstance(batch, pd.DataFrame):
+        return batch, lambda df: df
+    if isinstance(batch, dict):
+        return pd.DataFrame({k: list(v) if getattr(v, "ndim", 1) > 1
+                             else v for k, v in batch.items()}), \
+            lambda df: {c: np.asarray(list(df[c])) for c in df.columns}
+    if isinstance(batch, list):
+        return pd.DataFrame(batch), \
+            lambda df: df.to_dict(orient="records")
+    if isinstance(batch, np.ndarray):
+        cols = [f"f{i}" for i in range(batch.shape[-1])] \
+            if batch.ndim == 2 else ["f0"]
+        return pd.DataFrame(np.atleast_2d(batch), columns=cols), \
+            lambda df: df.to_numpy()
+    raise TypeError(f"unsupported batch type {type(batch)}")
+
+
+# -- distributed fit plumbing -------------------------------------------------
+
+def block_partials(dataset: Any,
+                   partial_fn: Callable[[Any], Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    """One small stats dict per block, computed where the block lives
+    and gathered to the driver — the fit-side scan every fittable
+    preprocessor shares."""
+    parts = dataset.map_batches(lambda df: [partial_fn(df)],
+                                batch_format="pandas")
+    return [p for p in parts.take_all() if p is not None]
+
+
+def numeric_column(df, col: str) -> np.ndarray:
+    """Column as float ndarray with NaNs preserved (fit-side helper)."""
+    return df[col].to_numpy(dtype=np.float64, na_value=np.nan)
+
+
+# -- stateless wrappers -------------------------------------------------------
+
+class BatchMapper(Preprocessor):
+    """User function over batches (reference:
+    preprocessors/batch_mapper.py:12) — the escape hatch that makes any
+    row-preserving transform composable in a Chain."""
+
+    _is_fittable = False
+
+    def __init__(self, fn: Callable[[Any], Any],
+                 batch_format: str = "pandas"):
+        self.fn = fn
+        self.batch_format = batch_format
+
+    def _transform_pandas(self, df):
+        if self.batch_format == "pandas":
+            return self.fn(df)
+        df2, restore = _to_pandas(
+            self.fn({c: df[c].to_numpy() for c in df.columns}))
+        return df2
+
+
+class Chain(Preprocessor):
+    """Sequential composition (reference: preprocessors/chain.py:8).
+
+    ``fit`` is staged: each preprocessor fits on the output of its
+    predecessors (the transforms stay lazy plan stages, so the chain
+    fit is still one pass per fittable stage, not a materialization).
+    """
+
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    @property
+    def _is_fittable(self):  # type: ignore[override]
+        return any(p._is_fittable for p in self.preprocessors)
+
+    @property
+    def fitted(self) -> bool:
+        return all(p.fitted for p in self.preprocessors)
+
+    def _fit(self, dataset: Any) -> None:
+        for p in self.preprocessors:
+            dataset = p.fit(dataset).transform(dataset)
+
+    def fit_transform(self, dataset: Any) -> Any:
+        for p in self.preprocessors:
+            dataset = p.fit(dataset).transform(dataset)
+        return dataset
+
+    def transform(self, dataset: Any) -> Any:
+        self._check_fitted()
+        for p in self.preprocessors:
+            dataset = p.transform(dataset)
+        return dataset
+
+    def _transform_pandas(self, df):
+        for p in self.preprocessors:
+            df = p._transform_pandas(df)
+        return df
+
+    def __repr__(self):
+        inner = ", ".join(repr(p) for p in self.preprocessors)
+        return f"Chain({inner})"

@@ -1,0 +1,145 @@
+"""Categorical encoders (reference:
+/root/reference/python/ray/data/preprocessors/encoder.py:15 —
+OrdinalEncoder/OneHotEncoder/MultiHotEncoder/LabelEncoder/Categorizer).
+
+Fit scans gather per-block unique-value sets; category order is sorted
+(the reference's convention), so the mapping is deterministic across
+block orders and cluster sizes.  Unseen values at transform time encode
+as the reference does: null for ordinal/label, all-zeros for one-hot.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .base import Preprocessor, block_partials
+
+
+def _fit_uniques(dataset: Any, columns: List[str],
+                 of_lists: bool = False) -> Dict[str, List[Any]]:
+    def partial(df):
+        out = {}
+        for c in columns:
+            vals = df[c].dropna()
+            if of_lists:
+                seen = set()
+                for row in vals:
+                    seen.update(row)
+                out[c] = sorted(seen)
+            else:
+                out[c] = sorted(vals.unique().tolist())
+        return out
+    merged: Dict[str, set] = {c: set() for c in columns}
+    for p in block_partials(dataset, partial):
+        for c in columns:
+            merged[c].update(p[c])
+    return {c: sorted(merged[c]) for c in columns}
+
+
+class OrdinalEncoder(Preprocessor):
+    """category → sorted-order int; unseen → NaN."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, dataset: Any) -> None:
+        uniq = _fit_uniques(dataset, self.columns)
+        self.stats_ = {c: {v: i for i, v in enumerate(vals)}
+                       for c, vals in uniq.items()}
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            df[c] = df[c].map(self.stats_[c])
+        return df
+
+
+class OneHotEncoder(Preprocessor):
+    """category column → one 0/1 column per category, named
+    ``{col}_{value}``; unseen rows get all zeros."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, dataset: Any) -> None:
+        self.stats_ = _fit_uniques(dataset, self.columns)
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            col = df[c]
+            for v in self.stats_[c]:
+                df[f"{c}_{v}"] = (col == v).astype(np.int64)
+            df = df.drop(columns=[c])
+        return df
+
+
+class MultiHotEncoder(Preprocessor):
+    """list-valued column → multi-hot count vector (reference:
+    encoder.py MultiHotEncoder)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, dataset: Any) -> None:
+        self.stats_ = _fit_uniques(dataset, self.columns, of_lists=True)
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            index = {v: i for i, v in enumerate(self.stats_[c])}
+            k = len(index)
+
+            def encode(row, _index=index, _k=k):
+                vec = np.zeros(_k, dtype=np.int64)
+                for item in (row or ()):
+                    i = _index.get(item)
+                    if i is not None:
+                        vec[i] += 1
+                return vec
+            df[c] = df[c].map(encode)
+        return df
+
+
+class LabelEncoder(Preprocessor):
+    """Single label column → sorted-order int, with
+    :meth:`inverse_transform_batch` for decoding predictions."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+
+    def _fit(self, dataset: Any) -> None:
+        uniq = _fit_uniques(dataset, [self.label_column])
+        self.stats_ = {v: i for i, v in
+                       enumerate(uniq[self.label_column])}
+        self.classes_ = list(uniq[self.label_column])
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        df[self.label_column] = df[self.label_column].map(self.stats_)
+        return df
+
+    def inverse_transform_batch(self, labels) -> np.ndarray:
+        self._check_fitted()
+        classes = np.asarray(self.classes_, dtype=object)
+        return classes[np.asarray(labels, dtype=np.int64)]
+
+
+class Categorizer(Preprocessor):
+    """Columns → pandas Categorical dtype with dataset-wide category
+    sets (reference: encoder.py Categorizer — the GBDT-ingest enabler)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, dataset: Any) -> None:
+        self.stats_ = _fit_uniques(dataset, self.columns)
+
+    def _transform_pandas(self, df):
+        import pandas as pd
+        df = df.copy()
+        for c in self.columns:
+            df[c] = pd.Categorical(df[c], categories=self.stats_[c])
+        return df

@@ -1,0 +1,367 @@
+"""Numeric preprocessors: scalers, imputer, normalizer, discretizers,
+power transform, concatenator.
+
+Capability mirrors: /root/reference/python/ray/data/preprocessors/
+scaler.py:14 (Standard/MinMax/MaxAbs/Robust), imputer.py:12,
+normalizer.py:9, discretizer.py (Uniform/CustomKBins), transformer.py:9
+(PowerTransformer), concatenator.py:9.  Fit statistics are mergeable
+per-block partials (sum/sumsq, min/max, value counts, sorted samples)
+combined on the driver — associative merges, so block order never
+changes the result (except the documented RobustScaler sampling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .base import Preprocessor, block_partials, numeric_column
+
+#: per-block cap on values contributed to quantile estimation
+#: (RobustScaler, median imputing).  Exact when every block is under the
+#: cap; an evenly-strided subsample (not a prefix) beyond it.
+_QUANTILE_SAMPLE_CAP = 65536
+
+
+def _sample_sorted(vals: np.ndarray) -> np.ndarray:
+    vals = vals[~np.isnan(vals)]
+    if vals.size > _QUANTILE_SAMPLE_CAP:
+        idx = np.linspace(0, vals.size - 1, _QUANTILE_SAMPLE_CAP,
+                          dtype=np.int64)
+        vals = np.sort(vals)[idx]
+    return vals
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference: scaler.py:14)."""
+
+    def __init__(self, columns: List[str], ddof: int = 0):
+        self.columns = list(columns)
+        self.ddof = ddof
+
+    def _fit(self, dataset: Any) -> None:
+        def partial(df):
+            out = {}
+            for c in self.columns:
+                v = numeric_column(df, c)
+                v = v[~np.isnan(v)]
+                out[c] = (v.size, float(v.sum()),
+                          float((v ** 2).sum()))
+            return out
+        stats: Dict[str, Any] = {}
+        for c in self.columns:
+            n = s = ss = 0.0
+            for p in block_partials(dataset, partial):
+                pn, ps, pss = p[c]
+                n, s, ss = n + pn, s + ps, ss + pss
+            mean = s / max(n, 1)
+            var = max(ss / max(n, 1) - mean ** 2, 0.0)
+            if self.ddof and n > self.ddof:
+                var *= n / (n - self.ddof)
+            stats[c] = (mean, float(np.sqrt(var)))
+        self.stats_ = stats
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            df[c] = (df[c] - mean) / (std if std > 0 else 1.0)
+        return df
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (reference: scaler.py)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, dataset: Any) -> None:
+        def partial(df):
+            out = {}
+            for c in self.columns:
+                v = numeric_column(df, c)
+                v = v[~np.isnan(v)]
+                out[c] = (float(v.min()) if v.size else np.inf,
+                          float(v.max()) if v.size else -np.inf)
+            return out
+        stats = {}
+        for c in self.columns:
+            lo, hi = np.inf, -np.inf
+            for p in block_partials(dataset, partial):
+                lo, hi = min(lo, p[c][0]), max(hi, p[c][1])
+            stats[c] = (lo, hi)
+        self.stats_ = stats
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            span = hi - lo
+            df[c] = (df[c] - lo) / (span if span > 0 else 1.0)
+        return df
+
+
+class MaxAbsScaler(Preprocessor):
+    """x / max|x| per column (reference: scaler.py)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = list(columns)
+
+    def _fit(self, dataset: Any) -> None:
+        def partial(df):
+            out = {}
+            for c in self.columns:
+                v = numeric_column(df, c)
+                v = v[~np.isnan(v)]
+                out[c] = float(np.abs(v).max()) if v.size else 0.0
+            return out
+        stats = {c: 0.0 for c in self.columns}
+        for p in block_partials(dataset, partial):
+            for c in self.columns:
+                stats[c] = max(stats[c], p[c])
+        self.stats_ = stats
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            m = self.stats_[c]
+            df[c] = df[c] / (m if m > 0 else 1.0)
+        return df
+
+
+class RobustScaler(Preprocessor):
+    """(x - median) / IQR (reference: scaler.py RobustScaler).
+
+    Quantiles come from per-block sorted samples merged on the driver —
+    exact up to ``_QUANTILE_SAMPLE_CAP`` rows per block, an evenly
+    strided subsample beyond it.
+    """
+
+    def __init__(self, columns: List[str],
+                 quantile_range: Tuple[float, float] = (0.25, 0.75)):
+        self.columns = list(columns)
+        self.quantile_range = quantile_range
+
+    def _fit(self, dataset: Any) -> None:
+        def partial(df):
+            return {c: _sample_sorted(numeric_column(df, c))
+                    for c in self.columns}
+        parts = block_partials(dataset, partial)
+        lo_q, hi_q = self.quantile_range
+        stats = {}
+        for c in self.columns:
+            merged = np.concatenate([p[c] for p in parts]) \
+                if parts else np.array([0.0])
+            med = float(np.quantile(merged, 0.5))
+            iqr = float(np.quantile(merged, hi_q)
+                        - np.quantile(merged, lo_q))
+            stats[c] = (med, iqr)
+        self.stats_ = stats
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            med, iqr = self.stats_[c]
+            df[c] = (df[c] - med) / (iqr if iqr > 0 else 1.0)
+        return df
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing values (reference: imputer.py:12).  Strategies:
+    mean, median (sampled like RobustScaler), most_frequent, constant."""
+
+    def __init__(self, columns: List[str], strategy: str = "mean",
+                 fill_value: Any = None):
+        if strategy not in ("mean", "median", "most_frequent",
+                            "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' needs fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+
+    _is_fittable = property(
+        lambda self: self.strategy != "constant")  # type: ignore
+
+    def _fit(self, dataset: Any) -> None:
+        strat = self.strategy
+
+        def partial(df):
+            out = {}
+            for c in self.columns:
+                if strat == "most_frequent":
+                    vc = df[c].dropna().value_counts()
+                    out[c] = dict(vc.iloc[:256])
+                elif strat == "median":
+                    out[c] = _sample_sorted(numeric_column(df, c))
+                else:
+                    v = numeric_column(df, c)
+                    v = v[~np.isnan(v)]
+                    out[c] = (v.size, float(v.sum()))
+            return out
+        parts = block_partials(dataset, partial)
+        stats = {}
+        for c in self.columns:
+            if strat == "most_frequent":
+                counts: Dict[Any, int] = {}
+                for p in parts:
+                    for k, n in p[c].items():
+                        counts[k] = counts.get(k, 0) + int(n)
+                stats[c] = max(counts, key=counts.get) if counts else 0
+            elif strat == "median":
+                merged = np.concatenate([p[c] for p in parts]) \
+                    if parts else np.array([0.0])
+                stats[c] = float(np.quantile(merged, 0.5)) \
+                    if merged.size else 0.0
+            else:
+                n = sum(p[c][0] for p in parts)
+                s = sum(p[c][1] for p in parts)
+                stats[c] = s / max(n, 1)
+        self.stats_ = stats
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            fill = self.fill_value if self.strategy == "constant" \
+                else self.stats_[c]
+            df[c] = df[c].fillna(fill)
+        return df
+
+
+class Normalizer(Preprocessor):
+    """Row-wise normalization across ``columns`` (reference:
+    normalizer.py:9).  Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], norm: str = "l2"):
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"unknown norm {norm!r}")
+        self.columns = list(columns)
+        self.norm = norm
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        mat = df[self.columns].to_numpy(dtype=np.float64)
+        if self.norm == "l2":
+            d = np.sqrt((mat ** 2).sum(axis=1))
+        elif self.norm == "l1":
+            d = np.abs(mat).sum(axis=1)
+        else:
+            d = np.abs(mat).max(axis=1)
+        d = np.where(d > 0, d, 1.0)
+        mat = mat / d[:, None]
+        for i, c in enumerate(self.columns):
+            df[c] = mat[:, i]
+        return df
+
+
+class PowerTransformer(Preprocessor):
+    """Yeo-Johnson / Box-Cox with a GIVEN power (reference:
+    transformer.py:9 — the reference also takes the exponent as config,
+    it does not estimate it).  Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], power: float,
+                 method: str = "yeo-johnson"):
+        if method not in ("yeo-johnson", "box-cox"):
+            raise ValueError(f"unknown method {method!r}")
+        self.columns = list(columns)
+        self.power = power
+        self.method = method
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        lam = self.power
+        for c in self.columns:
+            x = df[c].to_numpy(dtype=np.float64)
+            if self.method == "box-cox":
+                y = np.log(x) if lam == 0 else (x ** lam - 1) / lam
+            else:
+                pos = x >= 0
+                if lam == 0:
+                    yp = np.log1p(np.where(pos, x, 0.0))
+                else:
+                    yp = ((np.where(pos, x, 0.0) + 1) ** lam - 1) / lam
+                if lam == 2:
+                    yn = -np.log1p(np.where(pos, 0.0, -x))
+                else:
+                    yn = -(((np.where(pos, 0.0, -x) + 1) ** (2 - lam)
+                            - 1) / (2 - lam))
+                y = np.where(pos, yp, yn)
+            df[c] = y
+        return df
+
+
+class UniformKBinsDiscretizer(Preprocessor):
+    """Equal-width binning: fit min/max, transform → bin index
+    (reference: discretizer.py UniformKBinsDiscretizer)."""
+
+    def __init__(self, columns: List[str], bins: int):
+        self.columns = list(columns)
+        self.bins = bins
+
+    def _fit(self, dataset: Any) -> None:
+        scaler = MinMaxScaler(self.columns)
+        scaler._fit(dataset)
+        stats = {}
+        for c in self.columns:
+            lo, hi = scaler.stats_[c]
+            stats[c] = np.linspace(lo, hi, self.bins + 1)[1:-1]
+        self.stats_ = stats
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            df[c] = np.digitize(df[c].to_numpy(dtype=np.float64),
+                                self.stats_[c])
+        return df
+
+
+class CustomKBinsDiscretizer(Preprocessor):
+    """Binning with caller-provided edges (reference: discretizer.py
+    CustomKBinsDiscretizer).  Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], bins: Dict[str, List[float]]):
+        self.columns = list(columns)
+        self.bins = {c: np.asarray(b, dtype=np.float64)
+                     for c, b in bins.items()}
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            # caller edges include the outer bounds (np.histogram style):
+            # interior edges are what digitize wants
+            df[c] = np.digitize(df[c].to_numpy(dtype=np.float64),
+                                self.bins[c][1:-1])
+        return df
+
+
+class Concatenator(Preprocessor):
+    """Pack numeric columns into one ndarray column (reference:
+    concatenator.py:9 — the trainer-ingest adapter).  Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, output_column_name: str = "concat",
+                 include: Optional[List[str]] = None,
+                 exclude: Optional[List[str]] = None,
+                 dtype: Any = np.float32):
+        self.output_column_name = output_column_name
+        self.include = list(include) if include else None
+        self.exclude = set(exclude or ())
+        self.dtype = dtype
+
+    def _transform_pandas(self, df):
+        cols = self.include if self.include is not None else \
+            [c for c in df.columns if c not in self.exclude]
+        mat = df[cols].to_numpy(dtype=self.dtype)
+        out = df.drop(columns=cols)
+        out = out.copy()
+        out[self.output_column_name] = list(mat)
+        return out

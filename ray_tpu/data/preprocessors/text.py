@@ -1,0 +1,141 @@
+"""Text/feature-hashing preprocessors (reference:
+/root/reference/python/ray/data/preprocessors/tokenizer.py:9,
+hasher.py:9, vectorizer.py:12 — Tokenizer, FeatureHasher,
+CountVectorizer, HashingVectorizer).
+
+Hashing uses a keyed stable hash (md5 of the token bytes), NOT Python's
+per-process-randomized ``hash`` — transforms must agree across workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .base import Preprocessor, block_partials
+
+
+def _stable_hash(token: str, mod: int) -> int:
+    digest = hashlib.md5(str(token).encode()).digest()
+    return int.from_bytes(digest[:8], "little") % mod
+
+
+def _default_tokenize(text: str) -> List[str]:
+    return str(text).lower().split()
+
+
+class Tokenizer(Preprocessor):
+    """string column → list-of-tokens column.  Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str],
+                 tokenization_fn: Optional[Callable] = None):
+        self.columns = list(columns)
+        self.tokenization_fn = tokenization_fn or _default_tokenize
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            df[c] = df[c].map(self.tokenization_fn)
+        return df
+
+
+class FeatureHasher(Preprocessor):
+    """Rows of {column: count} → fixed-width hashed count vector in
+    ``output_column`` (reference: hasher.py — the sparse-to-dense
+    bridge for bag-of-words at vocabulary scale).  Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], num_features: int,
+                 output_column: str = "hashed_features"):
+        self.columns = list(columns)
+        self.num_features = num_features
+        self.output_column = output_column
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        mat = np.zeros((len(df), self.num_features), dtype=np.float64)
+        for c in self.columns:
+            j_by_col = _stable_hash(c, self.num_features)
+            mat[:, j_by_col] += df[c].to_numpy(dtype=np.float64)
+        out = df.drop(columns=self.columns)
+        out[self.output_column] = list(mat)
+        return out
+
+
+class CountVectorizer(Preprocessor):
+    """Token-list columns → count vectors over a FITTED vocabulary
+    (top ``max_features`` by corpus frequency, ties broken
+    alphabetically for determinism)."""
+
+    def __init__(self, columns: List[str],
+                 max_features: Optional[int] = None):
+        self.columns = list(columns)
+        self.max_features = max_features
+
+    def _fit(self, dataset: Any) -> None:
+        def partial(df):
+            out = {}
+            for c in self.columns:
+                counts: Dict[str, int] = {}
+                for row in df[c].dropna():
+                    for tok in row:
+                        counts[tok] = counts.get(tok, 0) + 1
+                out[c] = counts
+            return out
+        merged: Dict[str, Dict[str, int]] = {c: {} for c in self.columns}
+        for p in block_partials(dataset, partial):
+            for c in self.columns:
+                for tok, n in p[c].items():
+                    merged[c][tok] = merged[c].get(tok, 0) + n
+        stats = {}
+        for c in self.columns:
+            toks = sorted(merged[c].items(), key=lambda kv: (-kv[1],
+                                                             kv[0]))
+            if self.max_features is not None:
+                toks = toks[:self.max_features]
+            stats[c] = {tok: i for i, tok in
+                        enumerate(sorted(t for t, _ in toks))}
+        self.stats_ = stats
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            vocab = self.stats_[c]
+            k = len(vocab)
+
+            def encode(row, _vocab=vocab, _k=k):
+                vec = np.zeros(_k, dtype=np.int64)
+                for tok in (row or ()):
+                    i = _vocab.get(tok)
+                    if i is not None:
+                        vec[i] += 1
+                return vec
+            df[c] = df[c].map(encode)
+        return df
+
+
+class HashingVectorizer(Preprocessor):
+    """Token-list columns → hashed count vectors, no fit (reference:
+    vectorizer.py HashingVectorizer).  Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], num_features: int):
+        self.columns = list(columns)
+        self.num_features = num_features
+
+    def _transform_pandas(self, df):
+        df = df.copy()
+        for c in self.columns:
+            def encode(row, _m=self.num_features):
+                vec = np.zeros(_m, dtype=np.int64)
+                for tok in (row or ()):
+                    vec[_stable_hash(tok, _m)] += 1
+                return vec
+            df[c] = df[c].map(encode)
+        return df

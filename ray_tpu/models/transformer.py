@@ -338,9 +338,22 @@ def _trunk(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig
     if cfg.embed_impl == "one_hot":
         # gather's backward is a scatter-add into [vocab, d] — serialized
         # and slow on TPU; the one-hot formulation turns fwd AND bwd into
-        # MXU matmuls ([b*s, vocab] @ [vocab, d])
-        oh = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=dt)
-        x = jnp.einsum("bsv,vd->bsd", oh, params["embed"]["tok"].astype(dt))
+        # MXU matmuls.  Chunked over tokens so the one-hot buffer peaks
+        # at [chunk, vocab] (~100 MB bf16 at vocab 50k) instead of
+        # [b*s, vocab] (~820 MB at b8/s1024) — XLA may fuse it away, but
+        # the bound must not depend on that.
+        emb = params["embed"]["tok"].astype(dt)
+        flat = tokens.reshape(-1)
+        chunk = 1024
+        if flat.size <= chunk:
+            x = jax.nn.one_hot(flat, cfg.vocab_size, dtype=dt) @ emb
+        else:
+            pad = (-flat.size) % chunk
+            chunks = jnp.pad(flat, (0, pad)).reshape(-1, chunk)
+            x = jax.lax.map(
+                lambda t: jax.nn.one_hot(t, cfg.vocab_size, dtype=dt)
+                @ emb, chunks).reshape(-1, cfg.d_model)[:flat.size]
+        x = x.reshape(b, s, cfg.d_model)
     elif cfg.embed_impl == "gather":
         x = params["embed"]["tok"][tokens].astype(dt)
     else:  # a typo must not silently mean the gather path (cf. remat_policy)

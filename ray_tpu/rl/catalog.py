@@ -58,17 +58,25 @@ def build_policy(env: JaxEnv, model: Optional[Dict[str, Any]] = None,
         return _CUSTOM_MODELS[custom](
             obs_size, env.action_size, discrete=env.discrete,
             **cfg.get("custom_model_config", {}))
-    if cfg.get("use_lstm"):
-        return LSTMPolicy(obs_size, env.action_size,
-                          discrete=env.discrete,
-                          hidden=tuple(cfg["hidden"]),
-                          lstm_size=cfg.get("lstm_cell_size", 64))
     # image observation space -> conv torso (the reference catalog's
     # vision-net selection); connectors that resize flat obs keep the
     # MLP path since the image geometry no longer applies
     obs_shape = getattr(env, "observation_shape", None)
-    if obs_shape is not None and len(obs_shape) == 3 and \
-            obs_size == env.observation_size:
+    is_image = obs_shape is not None and len(obs_shape) == 3 and \
+        obs_size == env.observation_size
+    if cfg.get("use_lstm"):
+        if is_image:
+            raise ValueError(
+                "use_lstm on an image-observation env would silently "
+                "drop the conv torso (the LSTMPolicy is MLP-bodied); "
+                "flatten the observations with a connector, or register "
+                "a custom Conv+LSTM policy (is_recurrent=True with the "
+                "LSTMPolicy interface)")
+        return LSTMPolicy(obs_size, env.action_size,
+                          discrete=env.discrete,
+                          hidden=tuple(cfg["hidden"]),
+                          lstm_size=cfg.get("lstm_cell_size", 64))
+    if is_image:
         return ConvPolicy(obs_shape, env.action_size,
                           discrete=env.discrete,
                           conv_filters=cfg.get("conv_filters")

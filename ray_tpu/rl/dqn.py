@@ -101,7 +101,9 @@ class DQNConfig:
     #   back to 1-step)
     prioritized_replay: bool = False
     per_alpha: float = 0.6         # priority exponent
-    per_beta: float = 0.4          # importance-weight exponent
+    per_beta: float = 0.4          # initial importance-weight exponent
+    per_beta_final: float = 1.0    # annealed to over eps_decay_steps
+    #   (PER paper: bias correction becomes exact as training converges)
     eps_start: float = 1.0
     eps_end: float = 0.05
     eps_decay_steps: int = 20_000  # env steps to anneal epsilon over
@@ -124,6 +126,13 @@ class DQN(Algorithm):
         self.env = cfg.env()
         if not self.env.discrete:
             raise ValueError("DQN requires a discrete-action env")
+        if cfg.n_step > 1 and (cfg.n_step - 1) * cfg.num_envs >= \
+                cfg.buffer_capacity:
+            raise ValueError(
+                f"n_step={cfg.n_step} with num_envs={cfg.num_envs} needs "
+                f"a window of {(cfg.n_step - 1) * cfg.num_envs} slots, "
+                f">= buffer_capacity={cfg.buffer_capacity}: every sample "
+                f"would silently fall back to 1-step targets")
         self.q = QNetwork(self.env.observation_size, self.env.action_size,
                           hidden=cfg.hidden, dueling=cfg.dueling)
         key = jax.random.PRNGKey(cfg.seed)
@@ -197,10 +206,16 @@ class DQN(Algorithm):
                 td = q_sa - target
                 return jnp.mean(weights * td ** 2), jnp.abs(td)
 
+            # anneal the PER bias-correction exponent toward its final
+            # value on the same horizon as epsilon
+            frac = jnp.clip(total_steps / cfg.eps_decay_steps, 0.0, 1.0)
+            beta_now = cfg.per_beta + \
+                (cfg.per_beta_final - cfg.per_beta) * frac
+
             def update(carry, _):
                 params, target_params, opt_state, buffer, key = carry
-                batch, idx, weights, key = sample_fn(buffer, key,
-                                                     cfg.batch_size)
+                batch, idx, weights, key = sample_fn(
+                    buffer, key, cfg.batch_size, beta_now=beta_now)
                 if cfg.n_step > 1:
                     # collection interleaves num_envs slots per timestep
                     reward_n, next_obs_n, done_n, gamma_n = \

@@ -95,6 +95,9 @@ class BC(Algorithm):
         super().__init__(config)
         if config.env is None or config.dataset is None:
             raise ValueError("BCConfig.env and BCConfig.dataset required")
+        if config.epochs_per_iter < 1:
+            raise ValueError("epochs_per_iter must be >= 1 (a zero-epoch "
+                             "iteration would report no loss)")
         self.env = config.env()
         self.policy = MLPPolicy(self.env.observation_size,
                                 self.env.action_size,
@@ -207,6 +210,9 @@ class CQL(Algorithm):
         super().__init__(config)
         if config.env is None or config.dataset is None:
             raise ValueError("CQLConfig.env and CQLConfig.dataset required")
+        if config.epochs_per_iter < 1:
+            raise ValueError("epochs_per_iter must be >= 1 (a zero-epoch "
+                             "iteration would report no loss)")
         self.env = config.env()
         if not self.env.discrete:
             raise ValueError("this CQL implementation is discrete-action "

@@ -208,13 +208,18 @@ def make_ops(prioritized: bool, *, alpha: float = 0.6, beta: float = 0.4):
     sample returns ones for weights and its priority update is the
     identity.  All four are jittable."""
     if prioritized:
-        def sample_fn(state, key, batch_size):
-            return sample_prioritized(state, key, batch_size,
-                                      alpha=alpha, beta=beta)
+        def sample_fn(state, key, batch_size, beta_now=None):
+            # beta_now (may be a traced scalar) lets callers anneal the
+            # importance-weight exponent toward 1.0 over training — the
+            # PER paper's schedule, where bias correction becomes exact
+            # as the policy converges
+            return sample_prioritized(
+                state, key, batch_size, alpha=alpha,
+                beta=beta if beta_now is None else beta_now)
         return (init_prioritized, add_batch_prioritized, sample_fn,
                 update_priorities)
 
-    def sample_fn(state, key, batch_size):
+    def sample_fn(state, key, batch_size, beta_now=None):
         batch, idx, key = sample(state, key, batch_size)
         return batch, idx, jnp.ones((batch_size,)), key
 

@@ -46,6 +46,7 @@ class SklearnTrainer:
 
     def __init__(self, estimator: Any, *, datasets: Dict[str, Any],
                  label_column: str, cv: Optional[int] = None,
+                 preprocessor: Optional[Any] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None):
         if "train" not in datasets:
@@ -54,6 +55,10 @@ class SklearnTrainer:
         self.datasets = datasets
         self.label_column = label_column
         self.cv = cv
+        # fits on the train split, transforms every split, and rides
+        # the result checkpoint into BatchPredictor (reference:
+        # train/base_trainer.py's preprocessor contract)
+        self.preprocessor = preprocessor
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
 
@@ -64,7 +69,20 @@ class SklearnTrainer:
 
         label = self.label_column
         est_blob = cloudpickle.dumps(self.estimator)
-        Xy = {name: _to_xy(ds, label) for name, ds in self.datasets.items()}
+        datasets = self.datasets
+        if self.preprocessor is not None:
+            train = datasets["train"]
+            if not hasattr(train, "map_batches"):   # raw DataFrame split
+                from ..data import from_pandas
+                train = from_pandas([train])
+            self.preprocessor.fit(train)
+            # every split must see the SAME features the estimator was
+            # fit on — DataFrame splits go through transform_batch
+            datasets = {name: (self.preprocessor.transform(ds)
+                               if hasattr(ds, "map_batches")
+                               else self.preprocessor.transform_batch(ds))
+                        for name, ds in datasets.items()}
+        Xy = {name: _to_xy(ds, label) for name, ds in datasets.items()}
 
         @api.remote
         def _fit_full(est_blob: bytes, X, y):
@@ -107,6 +125,8 @@ class SklearnTrainer:
                 metrics[f"{name}_score"] = float(fitted.score(X, y))
         ckpt = Checkpoint.from_dict({"estimator": fitted_blob,
                                      "label_column": label})
+        if self.preprocessor is not None:
+            ckpt = ckpt.with_preprocessor(self.preprocessor)
         return Result(metrics=metrics, checkpoint=ckpt)
 
     @staticmethod

@@ -1,0 +1,225 @@
+"""AIR preprocessor tests: fit-on-Dataset statistics, batch transforms,
+chains, and the Checkpoint → BatchPredictor round trip (reference model:
+`python/ray/data/tests/test_preprocessors.py`)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data.preprocessors import (
+    BatchMapper, Categorizer, Chain, Concatenator, CountVectorizer,
+    CustomKBinsDiscretizer, FeatureHasher, HashingVectorizer,
+    LabelEncoder, MaxAbsScaler, MinMaxScaler, MultiHotEncoder,
+    Normalizer, OneHotEncoder, OrdinalEncoder, PowerTransformer,
+    PreprocessorNotFittedError, RobustScaler, SimpleImputer,
+    StandardScaler, Tokenizer, UniformKBinsDiscretizer)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _num_ds(values, parallelism=3, col="x"):
+    return rdata.from_items([{col: float(v)} for v in values],
+                            parallelism=parallelism)
+
+
+def test_standard_scaler_matches_numpy(cluster):
+    vals = np.arange(30, dtype=np.float64)
+    pp = StandardScaler(["x"]).fit(_num_ds(vals))
+    mean, std = pp.stats_["x"]
+    assert mean == pytest.approx(vals.mean())
+    assert std == pytest.approx(vals.std())
+    out = pp.transform_batch(pd.DataFrame({"x": vals}))
+    np.testing.assert_allclose(out["x"], (vals - vals.mean()) / vals.std())
+
+
+def test_minmax_and_maxabs(cluster):
+    vals = [-4.0, 2.0, 8.0]
+    ds = _num_ds(vals, parallelism=2)
+    mm = MinMaxScaler(["x"]).fit(ds)
+    assert mm.stats_["x"] == (-4.0, 8.0)
+    out = mm.transform_batch({"x": np.array([-4.0, 8.0, 2.0])})
+    np.testing.assert_allclose(out["x"], [0.0, 1.0, 0.5])
+    ma = MaxAbsScaler(["x"]).fit(ds)
+    assert ma.stats_["x"] == 8.0
+
+
+def test_robust_scaler(cluster):
+    vals = np.arange(101, dtype=np.float64)   # median 50, IQR 50
+    pp = RobustScaler(["x"]).fit(_num_ds(vals, parallelism=4))
+    med, iqr = pp.stats_["x"]
+    assert med == pytest.approx(50.0)
+    assert iqr == pytest.approx(50.0)
+
+
+def test_transform_dataset_is_lazy_and_correct(cluster):
+    ds = _num_ds([0.0, 5.0, 10.0], parallelism=1)
+    pp = MinMaxScaler(["x"]).fit(ds)
+    got = sorted(r["x"] for r in pp.transform(ds).take_all())
+    assert got == pytest.approx([0.0, 0.5, 1.0])
+
+
+def test_unfitted_raises(cluster):
+    with pytest.raises(PreprocessorNotFittedError):
+        StandardScaler(["x"]).transform_batch(pd.DataFrame({"x": [1.0]}))
+
+
+def test_simple_imputer_strategies(cluster):
+    rows = [{"x": 1.0, "c": "a"}, {"x": None, "c": "b"},
+            {"x": 3.0, "c": "a"}, {"x": None, "c": None}]
+    ds = rdata.from_items(rows, parallelism=2)
+    mean_i = SimpleImputer(["x"], strategy="mean").fit(ds)
+    assert mean_i.stats_["x"] == pytest.approx(2.0)
+    freq_i = SimpleImputer(["c"], strategy="most_frequent").fit(ds)
+    assert freq_i.stats_["c"] == "a"
+    const_i = SimpleImputer(["x"], strategy="constant", fill_value=-1.0)
+    out = const_i.transform_batch(pd.DataFrame({"x": [np.nan, 2.0]}))
+    np.testing.assert_allclose(out["x"], [-1.0, 2.0])
+    med_i = SimpleImputer(["x"], strategy="median").fit(ds)
+    assert med_i.stats_["x"] == pytest.approx(2.0)
+
+
+def test_ordinal_onehot_label_encoders(cluster):
+    rows = [{"c": "red", "y": "cat"}, {"c": "blue", "y": "dog"},
+            {"c": "green", "y": "cat"}, {"c": "red", "y": "bird"}]
+    ds = rdata.from_items(rows, parallelism=2)
+    oe = OrdinalEncoder(["c"]).fit(ds)
+    assert oe.stats_["c"] == {"blue": 0, "green": 1, "red": 2}
+    out = oe.transform_batch(pd.DataFrame({"c": ["red", "blue"],
+                                           "y": ["cat", "dog"]}))
+    assert list(out["c"]) == [2, 0]
+
+    ohe = OneHotEncoder(["c"]).fit(ds)
+    out = ohe.transform_batch(pd.DataFrame({"c": ["green", "purple"],
+                                            "y": ["cat", "dog"]}))
+    assert list(out["c_green"]) == [1, 0]
+    assert list(out["c_red"]) == [0, 0]        # unseen row -> all zeros
+    assert "c" not in out.columns
+
+    le = LabelEncoder("y").fit(ds)
+    enc = le.transform_batch(pd.DataFrame({"y": ["dog", "bird"]}))
+    assert list(enc["y"]) == [2, 0]
+    assert list(le.inverse_transform_batch([2, 0])) == ["dog", "bird"]
+
+
+def test_multihot_and_categorizer(cluster):
+    rows = [{"tags": ["a", "b"]}, {"tags": ["b", "c"]}, {"tags": []}]
+    ds = rdata.from_items(rows, parallelism=2)
+    mh = MultiHotEncoder(["tags"]).fit(ds)
+    out = mh.transform_batch(pd.DataFrame({"tags": [["b", "b", "a"]]}))
+    np.testing.assert_array_equal(out["tags"].iloc[0], [1, 2, 0])
+
+    cat_ds = rdata.from_items([{"c": "x"}, {"c": "y"}], parallelism=1)
+    cz = Categorizer(["c"]).fit(cat_ds)
+    out = cz.transform_batch(pd.DataFrame({"c": ["y", "x"]}))
+    assert str(out["c"].dtype) == "category"
+    assert list(out["c"].cat.categories) == ["x", "y"]
+
+
+def test_discretizers(cluster):
+    ds = _num_ds(np.linspace(0.0, 10.0, 11), parallelism=2)
+    uk = UniformKBinsDiscretizer(["x"], bins=5).fit(ds)
+    out = uk.transform_batch(pd.DataFrame({"x": [0.5, 9.5]}))
+    assert list(out["x"]) == [0, 4]
+    ck = CustomKBinsDiscretizer(["x"], bins={"x": [0, 2, 5, 10]})
+    out = ck.transform_batch(pd.DataFrame({"x": [1.0, 3.0, 7.0]}))
+    assert list(out["x"]) == [0, 1, 2]
+
+
+def test_normalizer_power_concat(cluster):
+    nm = Normalizer(["a", "b"], norm="l2")
+    out = nm.transform_batch(pd.DataFrame({"a": [3.0], "b": [4.0]}))
+    np.testing.assert_allclose([out["a"][0], out["b"][0]], [0.6, 0.8])
+
+    pt = PowerTransformer(["a"], power=0.5, method="box-cox")
+    out = pt.transform_batch(pd.DataFrame({"a": [4.0]}))
+    assert out["a"][0] == pytest.approx((2.0 - 1) / 0.5)
+
+    cc = Concatenator(output_column_name="v", exclude=["keep"])
+    out = cc.transform_batch(pd.DataFrame({"a": [1.0], "b": [2.0],
+                                           "keep": ["k"]}))
+    np.testing.assert_allclose(out["v"].iloc[0], [1.0, 2.0])
+    assert list(out.columns) == ["keep", "v"]
+
+
+def test_text_pipeline(cluster):
+    rows = [{"t": "the cat sat"}, {"t": "the dog ran"}]
+    ds = rdata.from_items(rows, parallelism=2)
+    chain = Chain(Tokenizer(["t"]), CountVectorizer(["t"]))
+    out_ds = chain.fit_transform(ds)
+    vecs = {tuple(r["t"]) for r in out_ds.take_all()}
+    vocab = chain.preprocessors[1].stats_["t"]
+    assert set(vocab) == {"the", "cat", "sat", "dog", "ran"}
+    assert all(sum(v) == 3 for v in vecs)
+
+    hv = HashingVectorizer(["t"], num_features=16)
+    toks = Tokenizer(["t"]).transform_batch(
+        pd.DataFrame({"t": ["a b a"]}))
+    out = hv.transform_batch(toks)
+    assert out["t"].iloc[0].sum() == 3
+
+    fh = FeatureHasher(["f1", "f2"], num_features=8)
+    out = fh.transform_batch(pd.DataFrame({"f1": [2.0], "f2": [1.0]}))
+    assert out["hashed_features"].iloc[0].sum() == pytest.approx(3.0)
+
+
+def test_chain_fit_is_staged(cluster):
+    # the scaler must see the imputer's output, not raw NaNs
+    rows = [{"x": 0.0}, {"x": None}, {"x": 4.0}]
+    ds = rdata.from_items(rows, parallelism=2)
+    chain = Chain(SimpleImputer(["x"], strategy="mean"),
+                  MinMaxScaler(["x"]))
+    chain.fit(ds)
+    assert chain.preprocessors[0].stats_["x"] == pytest.approx(2.0)
+    assert chain.preprocessors[1].stats_["x"] == (0.0, 4.0)
+    out = chain.transform_batch(pd.DataFrame({"x": [np.nan]}))
+    assert out["x"][0] == pytest.approx(0.5)
+
+
+def test_batch_mapper_and_dict_batches(cluster):
+    bm = BatchMapper(lambda df: df.assign(x=df["x"] + 1))
+    out = bm.transform_batch({"x": np.array([1.0, 2.0])})
+    assert isinstance(out, dict)
+    np.testing.assert_allclose(out["x"], [2.0, 3.0])
+    out = bm.transform_batch([{"x": 1.0}])
+    assert out == [{"x": 2.0}]
+
+
+def test_checkpoint_roundtrip_into_batch_predictor(cluster):
+    from sklearn.linear_model import LinearRegression
+
+    from ray_tpu.air import BatchPredictor
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(100.0, 25.0, size=200)          # needs scaling
+    df = pd.DataFrame({"x": x, "y": 3.0 * (x - 100.0) / 25.0})
+    ds = rdata.from_pandas([df.iloc[:100], df.iloc[100:]])
+    trainer = SklearnTrainer(
+        LinearRegression(), datasets={"train": ds}, label_column="y",
+        preprocessor=StandardScaler(["x"]))
+    result = trainer.fit()
+
+    restored = result.checkpoint.get_preprocessor()
+    assert isinstance(restored, StandardScaler)
+    assert restored.stats_["x"][0] == pytest.approx(x.mean())
+
+    # the predictor must apply the SAME scaling before predicting
+    def build(ckpt):
+        import cloudpickle
+        est = cloudpickle.loads(ckpt.to_dict()["estimator"])
+        return lambda batch: est.predict(
+            batch.drop(columns=["y"]).to_numpy())
+
+    bp = BatchPredictor(result.checkpoint, build)
+    test_df = pd.DataFrame({"x": [100.0, 125.0], "y": [0.0, 3.0]})
+    preds = [r for r in bp.predict(
+        rdata.from_pandas([test_df])).take_all()]
+    np.testing.assert_allclose(np.asarray(preds, dtype=float).ravel(),
+                               [0.0, 3.0], atol=1e-6)
