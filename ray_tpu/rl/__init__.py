@@ -22,6 +22,7 @@ from .env import (  # noqa: F401
 from .es import ES, ESConfig  # noqa: F401
 from .impala import Impala, ImpalaConfig  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
+from .td3 import DDPG, DDPGConfig, TD3, TD3Config  # noqa: F401
 from .offline import (  # noqa: F401
     BC,
     BCConfig,
@@ -49,6 +50,11 @@ from .connectors import (  # noqa: F401
     UnsquashActions,
 )
 from .ddppo import DDPPO, DDPPOConfig  # noqa: F401
+from .external import (  # noqa: F401
+    ExternalEnv,
+    PolicyClient,
+    PolicyServerInput,
+)
 from .exploration import (  # noqa: F401
     EpsilonGreedy,
     GaussianActionNoise,

@@ -110,6 +110,14 @@ class DQNConfig:
     learn_start: int = 1_000       # env steps before updates begin
     hidden: tuple = (64, 64)
     seed: int = 0
+    # -- external input (reference: rllib/env/policy_server_input.py) ------
+    # transitions arrive from out-of-process simulators via an attached
+    # input reader (rl/external.py PolicyServerInput) instead of the
+    # fused env-collect scan; spaces are declared since there is no env
+    external_input: bool = False
+    observation_size: Optional[int] = None   # required when env is None
+    num_actions: Optional[int] = None        # required when env is None
+    ingest_chunk: int = 64         # fixed insert size (one compiled shape)
 
     def build(self) -> "DQN":
         return DQN(self)
@@ -121,11 +129,34 @@ class DQN(Algorithm):
     def __init__(self, config: DQNConfig):
         super().__init__(config)
         cfg = config
-        if cfg.env is None:
-            raise ValueError("DQNConfig.env required (an env factory)")
-        self.env = cfg.env()
-        if not self.env.discrete:
-            raise ValueError("DQN requires a discrete-action env")
+        if cfg.external_input:
+            if cfg.n_step > 1:
+                raise ValueError(
+                    "external_input does not support n_step > 1: the "
+                    "n-step window reads buffer ADJACENCY, and external "
+                    "transitions interleave arbitrarily many episodes")
+            if cfg.env is not None:
+                env = cfg.env()
+                if not env.discrete:
+                    raise ValueError("DQN requires a discrete-action "
+                                     "env (action_size of a continuous "
+                                     "env is a dimension, not a count)")
+                obs_dim, n_act = env.observation_size, env.action_size
+            elif cfg.observation_size and cfg.num_actions:
+                obs_dim, n_act = cfg.observation_size, cfg.num_actions
+            else:
+                raise ValueError(
+                    "external_input needs observation_size + num_actions "
+                    "(or an env factory to borrow the spaces from)")
+            self.env = None
+        else:
+            if cfg.env is None:
+                raise ValueError("DQNConfig.env required (an env factory)")
+            self.env = cfg.env()
+            if not self.env.discrete:
+                raise ValueError("DQN requires a discrete-action env")
+            obs_dim, n_act = (self.env.observation_size,
+                              self.env.action_size)
         if cfg.n_step > 1 and (cfg.n_step - 1) * cfg.num_envs >= \
                 cfg.buffer_capacity:
             raise ValueError(
@@ -133,7 +164,8 @@ class DQN(Algorithm):
                 f"a window of {(cfg.n_step - 1) * cfg.num_envs} slots, "
                 f">= buffer_capacity={cfg.buffer_capacity}: every sample "
                 f"would silently fall back to 1-step targets")
-        self.q = QNetwork(self.env.observation_size, self.env.action_size,
+        self.n_actions = n_act
+        self.q = QNetwork(obs_dim, n_act,
                           hidden=cfg.hidden, dueling=cfg.dueling)
         key = jax.random.PRNGKey(cfg.seed)
         key, pkey, ekey = jax.random.split(key, 3)
@@ -142,9 +174,6 @@ class DQN(Algorithm):
                                                     self.params)
         self.optimizer = optax.adam(cfg.lr)
         self.opt_state = self.optimizer.init(self.params)
-        ekeys = jax.random.split(ekey, cfg.num_envs)
-        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
-        obs_dim = self.env.observation_size
         self._replay_ops = replay.make_ops(
             cfg.prioritized_replay, alpha=cfg.per_alpha, beta=cfg.per_beta)
         buffer_init = self._replay_ops[0]
@@ -156,56 +185,50 @@ class DQN(Algorithm):
             "done": jnp.zeros((), jnp.float32),
         })
         self.key = key
-        self._train_iter = jax.jit(self._make_train_iter())
+        from .exploration import EpsilonGreedy
+        self._explorer = EpsilonGreedy(cfg.eps_start, cfg.eps_end,
+                                       cfg.eps_decay_steps)
+        self._act_jit = jax.jit(
+            lambda p, o: jnp.argmax(self.q.apply(p, o), axis=-1))
+        self._np_rng = np.random.default_rng(cfg.seed)
+        if cfg.external_input:
+            _, add_fn, _, _ = self._replay_ops
+            self._ingest_jit = jax.jit(
+                lambda buf, batch: add_fn(buf, batch, cfg.ingest_chunk))
+            self._update_jit = jax.jit(
+                self._make_update_block(insert_stride=1))
+            self._staging: list = []
+            self._input_reader = None
+        else:
+            ekeys = jax.random.split(ekey, cfg.num_envs)
+            self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+            self._train_iter = jax.jit(self._make_train_iter())
         self._init_episode_tracking(cfg.num_envs)
 
     # -- the compiled iteration --------------------------------------------
-    def _make_train_iter(self):
-        cfg = self.config
-        env, q, opt = self.env, self.q, self.optimizer
-        _, add_fn, sample_fn, update_pri = self._replay_ops
-        insert_bs = cfg.num_envs  # one buffer insert per scanned env step
+    def _make_update_block(self, insert_stride: int):
+        """``num_updates`` TD steps on replay samples behind the
+        learn_start gate — shared by the fused env-collect iteration and
+        the external-input path, where collection happens out of
+        process.  ``insert_stride``: slot distance between temporally
+        adjacent transitions (num_envs for the vectorized collect scan,
+        1 for external ingestion)."""
+        cfg, q, opt = self.config, self.q, self.optimizer
+        _, _, sample_fn, update_pri = self._replay_ops
 
-        from .exploration import EpsilonGreedy
-        explorer = EpsilonGreedy(cfg.eps_start, cfg.eps_end,
-                                 cfg.eps_decay_steps)
+        def td_loss(params, target_params, batch, weights):
+            qvals = q.apply(params, batch["obs"])
+            q_sa = jnp.take_along_axis(
+                qvals, batch["action"][:, None], axis=-1)[:, 0]
+            target = dqn_target(q.apply, params, target_params,
+                                batch["reward"], batch["next_obs"],
+                                batch["done"], batch["gamma_n"],
+                                cfg.double_q)
+            td = q_sa - target
+            return jnp.mean(weights * td ** 2), jnp.abs(td)
 
-        def train_iter(params, target_params, opt_state, buffer,
-                       env_states, obs, key, total_steps):
-
-            def collect(carry, _):
-                buffer, env_states, obs, key = carry
-                key, akey, skey = jax.random.split(key, 3)
-                qvals = q.apply(params, obs)                  # [B, A]
-                _, action = explorer((), akey, qvals, total_steps)
-                skeys = jax.random.split(skey, cfg.num_envs)
-                env_states, next_obs, reward, done = jax.vmap(env.step)(
-                    env_states, action, skeys)
-                buffer = add_fn(buffer, {
-                    "obs": obs.astype(jnp.float32),
-                    "action": action.astype(jnp.int32),
-                    "reward": reward.astype(jnp.float32),
-                    "next_obs": next_obs.astype(jnp.float32),
-                    "done": done.astype(jnp.float32),
-                }, insert_bs)
-                frame = {"reward": reward, "done": done}
-                return (buffer, env_states, next_obs, key), frame
-
-            (buffer, env_states, obs, key), traj = jax.lax.scan(
-                collect, (buffer, env_states, obs, key), None,
-                length=cfg.rollout_steps)
-
-            def td_loss(params, batch, weights):
-                qvals = q.apply(params, batch["obs"])
-                q_sa = jnp.take_along_axis(
-                    qvals, batch["action"][:, None], axis=-1)[:, 0]
-                target = dqn_target(q.apply, params, target_params,
-                                    batch["reward"], batch["next_obs"],
-                                    batch["done"], batch["gamma_n"],
-                                    cfg.double_q)
-                td = q_sa - target
-                return jnp.mean(weights * td ** 2), jnp.abs(td)
-
+        def update_block(params, target_params, opt_state, buffer, key,
+                         total_steps):
             # anneal the PER bias-correction exponent toward its final
             # value on the same horizon as epsilon
             frac = jnp.clip(total_steps / cfg.eps_decay_steps, 0.0, 1.0)
@@ -217,11 +240,11 @@ class DQN(Algorithm):
                 batch, idx, weights, key = sample_fn(
                     buffer, key, cfg.batch_size, beta_now=beta_now)
                 if cfg.n_step > 1:
-                    # collection interleaves num_envs slots per timestep
+                    # collection interleaves insert_stride slots per step
                     reward_n, next_obs_n, done_n, gamma_n = \
                         replay.nstep_window(buffer, idx, cfg.n_step,
                                             cfg.gamma,
-                                            stride=cfg.num_envs,
+                                            stride=insert_stride,
                                             one_step=batch)
                     batch = {**batch, "reward": reward_n,
                              "next_obs": next_obs_n, "done": done_n,
@@ -231,7 +254,8 @@ class DQN(Algorithm):
                              "gamma_n": jnp.full((cfg.batch_size,),
                                                  cfg.gamma)}
                 (loss, td_abs), grads = jax.value_and_grad(
-                    td_loss, has_aux=True)(params, batch, weights)
+                    td_loss, has_aux=True)(params, target_params, batch,
+                                           weights)
                 buffer = update_pri(buffer, idx, td_abs)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
@@ -259,10 +283,48 @@ class DQN(Algorithm):
                 return (params, target_params, opt_state, buffer, key,
                         jnp.zeros(()))
 
-            (params, target_params, opt_state, buffer, key,
-             last_loss) = jax.lax.cond(
+            return jax.lax.cond(
                 do_learn, run_updates, skip_updates,
                 (params, target_params, opt_state, buffer, key))
+
+        return update_block
+
+    def _make_train_iter(self):
+        cfg = self.config
+        env, q = self.env, self.q
+        _, add_fn, _, _ = self._replay_ops
+        insert_bs = cfg.num_envs  # one buffer insert per scanned env step
+        update_block = self._make_update_block(insert_stride=cfg.num_envs)
+        explorer = self._explorer
+
+        def train_iter(params, target_params, opt_state, buffer,
+                       env_states, obs, key, total_steps):
+
+            def collect(carry, _):
+                buffer, env_states, obs, key = carry
+                key, akey, skey = jax.random.split(key, 3)
+                qvals = q.apply(params, obs)                  # [B, A]
+                _, action = explorer((), akey, qvals, total_steps)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(env.step)(
+                    env_states, action, skeys)
+                buffer = add_fn(buffer, {
+                    "obs": obs.astype(jnp.float32),
+                    "action": action.astype(jnp.int32),
+                    "reward": reward.astype(jnp.float32),
+                    "next_obs": next_obs.astype(jnp.float32),
+                    "done": done.astype(jnp.float32),
+                }, insert_bs)
+                frame = {"reward": reward, "done": done}
+                return (buffer, env_states, next_obs, key), frame
+
+            (buffer, env_states, obs, key), traj = jax.lax.scan(
+                collect, (buffer, env_states, obs, key), None,
+                length=cfg.rollout_steps)
+
+            (params, target_params, opt_state, buffer, key,
+             last_loss) = update_block(params, target_params, opt_state,
+                                       buffer, key, total_steps)
             metrics = {"td_loss": last_loss,
                        "epsilon": explorer.epsilon(total_steps),
                        "buffer_size": buffer["size"]}
@@ -271,9 +333,79 @@ class DQN(Algorithm):
 
         return train_iter
 
+    # -- external input (reference: policy_server_input.py) -----------------
+    def set_input_reader(self, reader: Any) -> None:
+        """Attach a transition source (rl/external.py
+        PolicyServerInput): ``poll_transitions() -> list[dict]`` and
+        ``poll_episode_returns() -> list[float]``."""
+        if not self.config.external_input:
+            raise ValueError("build with external_input=True first")
+        self._input_reader = reader
+
+    def compute_single_action(self, obs, explore: bool = True) -> int:
+        """Epsilon-greedy action for ONE observation — the
+        policy-serving entry point (reference: Algorithm
+        .compute_single_action).  Exploration anneals on the
+        transitions-seen counter like the compiled collect scan."""
+        cfg = self.config
+        if explore:
+            # the SAME schedule object the compiled collect scan uses —
+            # served-action exploration must not diverge from in-process
+            eps = float(self._explorer.epsilon(self._total_env_steps))
+            if self._np_rng.random() < eps:
+                return int(self._np_rng.integers(self.n_actions))
+        obs = jnp.asarray(np.asarray(obs, np.float32))[None]
+        return int(self._act_jit(self.params, obs)[0])
+
+    def _external_training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        if self._input_reader is None:
+            raise RuntimeError(
+                "external_input=True but no input reader attached — "
+                "call set_input_reader(PolicyServerInput(...))")
+        t0 = time.perf_counter()
+        trans = self._input_reader.poll_transitions()
+        self._staging.extend(trans)
+        inserted = 0
+        while len(self._staging) >= cfg.ingest_chunk:
+            part = self._staging[:cfg.ingest_chunk]
+            del self._staging[:cfg.ingest_chunk]
+            batch = {
+                "obs": jnp.asarray(np.stack(
+                    [t["obs"] for t in part]).astype(np.float32)),
+                "action": jnp.asarray(np.asarray(
+                    [t["action"] for t in part], np.int32)),
+                "reward": jnp.asarray(np.asarray(
+                    [t["reward"] for t in part], np.float32)),
+                "next_obs": jnp.asarray(np.stack(
+                    [t["next_obs"] for t in part]).astype(np.float32)),
+                "done": jnp.asarray(np.asarray(
+                    [t["done"] for t in part], np.float32)),
+            }
+            self.buffer = self._ingest_jit(self.buffer, batch)
+            inserted += cfg.ingest_chunk
+        (self.params, self.target_params, self.opt_state, self.buffer,
+         self.key, last_loss) = self._update_jit(
+            self.params, self.target_params, self.opt_state, self.buffer,
+            self.key, jnp.asarray(self._total_env_steps, jnp.float32))
+        self._ep_done_returns.extend(
+            self._input_reader.poll_episode_returns())
+        dt = time.perf_counter() - t0
+        return {
+            "td_loss": float(last_loss),
+            "buffer_size": int(self.buffer["size"]),
+            "transitions_received": len(trans),
+            "transitions_inserted": inserted,
+            "env_steps_this_iter": len(trans),
+            "env_steps_per_s": len(trans) / dt,
+            "episode_reward_mean": self.episode_reward_mean(),
+        }
+
     # -- Trainable interface ------------------------------------------------
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
+        if cfg.external_input:
+            return self._external_training_step()
         t0 = time.perf_counter()
         (self.params, self.target_params, self.opt_state, self.buffer,
          self.env_states, self.obs, self.key, metrics, rewards, dones) = \

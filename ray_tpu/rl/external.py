@@ -1,0 +1,202 @@
+"""External environments: learn from simulators the framework does not
+drive.
+
+Capability mirror of the reference's external-env stack
+(`rllib/env/external_env.py:1` — inverted control: the simulation calls
+the policy; `rllib/env/policy_server_input.py:1` + `policy_client.py` —
+a REST server inside the learner serving actions and ingesting
+experiences).  TPU-first redesign: the learner's update loop stays a
+single compiled XLA program over the device-resident replay buffer
+(dqn.py `_make_update_block`); only ingestion is host-side.  The server
+rides the framework's own msgpack RPC plane (core/rpc.py) instead of
+HTTP — same protocol the cluster control plane uses.
+
+Wire protocol (all msgpack-native types):
+  start_episode {}                          -> episode_id
+  get_action    {episode_id, obs: [float]}  -> action (int)
+  log_action    {episode_id, obs, action}   -> {}   (off-policy actions)
+  log_returns   {episode_id, reward}        -> {}
+  end_episode   {episode_id, obs}           -> {}
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import rpc
+
+
+class PolicyServerInput:
+    """Runs inside the learner process: serves the CURRENT policy to
+    external simulators and accumulates their transitions for the
+    algorithm's ``poll_transitions``.
+
+    ``algo`` needs ``compute_single_action(obs, explore)`` (DQN has it);
+    the algorithm drains this reader inside ``training_step`` when built
+    with ``external_input=True``.
+    """
+
+    def __init__(self, algo: Any, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._algo = algo
+        self._lock = threading.Lock()
+        self._transitions: List[Dict[str, Any]] = []
+        self._episode_returns: List[float] = []
+        # episode -> {obs, action, reward_since} of the LAST served
+        # action; a transition completes when the next obs arrives
+        self._episodes: Dict[str, Dict[str, Any]] = {}
+        self._lt = rpc.EventLoopThread("rl-policy-server")
+        self.server = rpc.RpcServer(host, port)
+        for name in ("start_episode", "get_action", "log_action",
+                     "log_returns", "end_episode"):
+            fn = getattr(self, "_h_" + name)
+
+            async def handler(conn, data, _fn=fn):
+                return _fn(data)
+            self.server.register(name, handler)
+        self._lt.run(self.server.start())
+        self.host, self.port = self.server.host, self.server.port
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- handlers (run on the server's IO thread) ---------------------------
+    def _h_start_episode(self, data) -> str:
+        eid = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._episodes[eid] = {"obs": None, "action": None,
+                                   "reward_since": 0.0, "return": 0.0}
+        return eid
+
+    def _episode(self, data) -> Dict[str, Any]:
+        ep = self._episodes.get(data["episode_id"])
+        if ep is None:
+            raise KeyError(f"unknown episode {data['episode_id']!r} "
+                           f"(ended, or never started)")
+        return ep
+
+    def _record(self, ep, next_obs, done: float) -> None:
+        if ep["obs"] is not None:
+            self._transitions.append({
+                "obs": ep["obs"], "action": ep["action"],
+                "reward": ep["reward_since"],
+                "next_obs": np.asarray(next_obs, np.float32),
+                "done": done})
+
+    def _h_get_action(self, data) -> int:
+        obs = np.asarray(data["obs"], np.float32)
+        action = self._algo.compute_single_action(obs, explore=True)
+        with self._lock:
+            ep = self._episode(data)
+            self._record(ep, obs, 0.0)
+            ep.update(obs=obs, action=action, reward_since=0.0)
+        return int(action)
+
+    def _h_log_action(self, data) -> None:
+        """Off-policy: the client chose the action itself (reference:
+        ExternalEnv.log_action)."""
+        obs = np.asarray(data["obs"], np.float32)
+        with self._lock:
+            ep = self._episode(data)
+            self._record(ep, obs, 0.0)
+            ep.update(obs=obs, action=int(data["action"]),
+                      reward_since=0.0)
+
+    def _h_log_returns(self, data) -> None:
+        with self._lock:
+            ep = self._episode(data)
+            r = float(data["reward"])
+            ep["reward_since"] += r
+            ep["return"] += r
+
+    def _h_end_episode(self, data) -> None:
+        with self._lock:
+            ep = self._episode(data)
+            self._record(ep, data["obs"], 1.0)
+            self._episode_returns.append(ep["return"])
+            del self._episodes[data["episode_id"]]
+
+    # -- the input-reader face (drained by the algorithm) -------------------
+    def poll_transitions(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._transitions = self._transitions, []
+        return out
+
+    def poll_episode_returns(self) -> List[float]:
+        with self._lock:
+            out, self._episode_returns = self._episode_returns, []
+        return out
+
+    def stop(self) -> None:
+        try:
+            self._lt.run(self.server.stop())
+        finally:
+            self._lt.stop()
+
+
+class PolicyClient:
+    """The simulator side (reference: rllib/env/policy_client.py
+    remote-inference mode): a blocking msgpack client any Python
+    process can run — no jax required."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._lt = rpc.EventLoopThread("rl-policy-client")
+        self._client = rpc.BlockingClient.connect(self._lt, host,
+                                                  int(port))
+
+    def start_episode(self) -> str:
+        return self._client.call("start_episode", {})
+
+    def get_action(self, episode_id: str, obs) -> int:
+        return self._client.call("get_action", {
+            "episode_id": episode_id,
+            "obs": np.asarray(obs, np.float32).tolist()})
+
+    def log_action(self, episode_id: str, obs, action: int) -> None:
+        self._client.call("log_action", {
+            "episode_id": episode_id,
+            "obs": np.asarray(obs, np.float32).tolist(),
+            "action": int(action)})
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._client.call("log_returns", {
+            "episode_id": episode_id, "reward": float(reward)})
+
+    def end_episode(self, episode_id: str, obs) -> None:
+        self._client.call("end_episode", {
+            "episode_id": episode_id,
+            "obs": np.asarray(obs, np.float32).tolist()})
+
+    def close(self) -> None:
+        try:
+            self._client.close()
+        finally:
+            self._lt.stop()
+
+
+class ExternalEnv(threading.Thread):
+    """Inverted-control base (reference: external_env.py ExternalEnv):
+    subclass with a ``run()`` loop that drives YOUR simulator and calls
+    the episode API on ``self.client``.  Start it next to a learner
+    whose PolicyServerInput it points at."""
+
+    def __init__(self, client: PolicyClient):
+        super().__init__(daemon=True)
+        self.client = client
+
+    def run(self) -> None:
+        raise NotImplementedError(
+            "subclass ExternalEnv and implement run() — e.g.\n"
+            "  eid = self.client.start_episode()\n"
+            "  obs = sim.reset()\n"
+            "  while not done:\n"
+            "      a = self.client.get_action(eid, obs)\n"
+            "      obs, r, done = sim.step(a)\n"
+            "      self.client.log_returns(eid, r)\n"
+            "  self.client.end_episode(eid, obs)")

@@ -1,0 +1,337 @@
+"""TD3 / DDPG: deterministic-policy continuous control, fully jitted.
+
+Capability mirror of the reference's DDPG family
+(`rllib/algorithms/ddpg/ddpg.py:1` — deterministic actor, Q critic,
+OU/Gaussian exploration noise) and its TD3 preset
+(`rllib/algorithms/td3/td3.py:1` — twin critics, target-policy
+smoothing, delayed policy updates).  Redesigned like sac.py: the replay
+buffer lives on device (replay.py) and one ``training_step`` (collect
+scan → critic/delayed-actor update scan) is a single XLA program; the
+delayed update is a ``lax.cond`` on the update counter instead of the
+reference's host-side ``policy_delay`` loop bookkeeping.
+
+``DDPGConfig`` is TD3 with the three TD3 tricks off (single critic, no
+smoothing, every-step policy updates) and OU noise — the reference's
+relationship between the two algorithms, inverted (there TD3 subclasses
+DDPG).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import replay
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .exploration import GaussianActionNoise, OrnsteinUhlenbeckNoise
+from .policy import mlp_apply, mlp_init as _mlp_init
+
+
+def _relu_mlp(params, x):
+    return mlp_apply(params, x, activation=jax.nn.relu)
+
+
+@dataclasses.dataclass
+class TD3Config:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_envs: int = 16
+    rollout_steps: int = 16
+    buffer_capacity: int = 100_000
+    batch_size: int = 256
+    num_updates: int = 16
+    gamma: float = 0.99
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    tau: float = 0.005             # Polyak target-average rate
+    policy_delay: int = 2          # critic updates per actor update
+    smooth_target_policy: bool = True
+    target_noise: float = 0.2      # smoothing noise stddev
+    noise_clip: float = 0.5        # smoothing noise clip
+    twin_q: bool = True
+    ou_noise: bool = False         # exploration: OU instead of Gaussian
+    expl_noise_scale: float = 0.1  # Gaussian exploration stddev (start)
+    expl_noise_final: float = 0.05
+    expl_decay_steps: int = 50_000
+    prioritized_replay: bool = False
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    learn_start: int = 1_000
+    hidden: tuple = (128, 128)
+    seed: int = 0
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+@dataclasses.dataclass
+class DDPGConfig(TD3Config):
+    """Vanilla DDPG: the TD3 tricks off, OU exploration on."""
+    policy_delay: int = 1
+    smooth_target_policy: bool = False
+    twin_q: bool = False
+    ou_noise: bool = True
+
+
+class TD3(Algorithm):
+    _config_cls = TD3Config
+
+    def __init__(self, config: TD3Config):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("TD3Config.env required (an env factory)")
+        self.env = cfg.env()
+        if self.env.discrete:
+            raise ValueError("TD3/DDPG requires a continuous-action env")
+        obs_dim = self.env.observation_size
+        act_dim = self.env.action_size
+        self.act_dim = act_dim
+        key = jax.random.PRNGKey(cfg.seed)
+        key, k1, k2, k3, ekey = jax.random.split(key, 5)
+        h = tuple(cfg.hidden)
+        # q2 exists even with twin_q=False (uniform pytree shapes keep
+        # one compiled program per config); it never enters the loss
+        # there, so its grads are zero and it stays at init
+        self.params = {
+            "actor": _mlp_init(k1, (obs_dim,) + h + (act_dim,)),
+            "q1": _mlp_init(k2, (obs_dim + act_dim,) + h + (1,)),
+            "q2": _mlp_init(k3, (obs_dim + act_dim,) + h + (1,)),
+        }
+        self.targets = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.actor_opt_state = self.actor_opt.init(self.params["actor"])
+        self.critic_opt_state = self.critic_opt.init(
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        if cfg.ou_noise:
+            self.noise = OrnsteinUhlenbeckNoise(
+                act_dim, clip=self.env.action_high)
+            self.noise_state = jnp.zeros((cfg.num_envs, act_dim))
+        else:
+            self.noise = GaussianActionNoise(
+                cfg.expl_noise_scale * self.env.action_high,
+                cfg.expl_noise_final * self.env.action_high,
+                cfg.expl_decay_steps, clip=self.env.action_high)
+            self.noise_state = ()
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self._replay_ops = replay.make_ops(
+            cfg.prioritized_replay, alpha=cfg.per_alpha, beta=cfg.per_beta)
+        buffer_init = self._replay_ops[0]
+        self.buffer = buffer_init(cfg.buffer_capacity, {
+            "obs": jnp.zeros((obs_dim,), jnp.float32),
+            "action": jnp.zeros((act_dim,), jnp.float32),
+            "reward": jnp.zeros((), jnp.float32),
+            "next_obs": jnp.zeros((obs_dim,), jnp.float32),
+            "done": jnp.zeros((), jnp.float32),
+        })
+        self.key = key
+        self._update_count = jnp.zeros((), jnp.int32)
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._init_episode_tracking(cfg.num_envs)
+
+    # -- policy -------------------------------------------------------------
+    def _act(self, actor_params, obs):
+        return self.env.action_high * jnp.tanh(
+            _relu_mlp(actor_params, obs))
+
+    def _q(self, q_params, obs, act):
+        return _relu_mlp(q_params, jnp.concatenate([obs, act],
+                                                   axis=-1))[..., 0]
+
+    # -- the compiled iteration --------------------------------------------
+    def _make_train_iter(self):
+        cfg = self.config
+        env = self.env
+        high = self.env.action_high
+        noise = self.noise
+        _, add_fn, sample_fn, update_pri = self._replay_ops
+
+        def train_iter(params, targets, aopt_state, copt_state, buffer,
+                       env_states, obs, noise_state, key, upd_count,
+                       total_steps):
+
+            def collect(carry, _):
+                buffer, env_states, obs, noise_state, key = carry
+                key, nkey, skey = jax.random.split(key, 3)
+                action = self._act(params["actor"], obs)
+                noise_state, action = noise(noise_state, nkey, action,
+                                            total_steps)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(env.step)(
+                    env_states, action, skeys)
+                buffer = add_fn(buffer, {
+                    "obs": obs.astype(jnp.float32),
+                    "action": action.astype(jnp.float32),
+                    "reward": reward.astype(jnp.float32),
+                    "next_obs": next_obs.astype(jnp.float32),
+                    "done": done.astype(jnp.float32),
+                }, cfg.num_envs)
+                return (buffer, env_states, next_obs, noise_state, key), \
+                    {"reward": reward, "done": done}
+
+            (buffer, env_states, obs, noise_state, key), traj = \
+                jax.lax.scan(collect,
+                             (buffer, env_states, obs, noise_state, key),
+                             None, length=cfg.rollout_steps)
+
+            def critic_loss_fn(qp, targets, batch, weights, key):
+                next_a = self._act(targets["actor"], batch["next_obs"])
+                if cfg.smooth_target_policy:
+                    eps = jnp.clip(
+                        cfg.target_noise * jax.random.normal(
+                            key, next_a.shape),
+                        -cfg.noise_clip, cfg.noise_clip)
+                    next_a = jnp.clip(next_a + eps, -high, high)
+                tq1 = self._q(targets["q1"], batch["next_obs"], next_a)
+                if cfg.twin_q:
+                    tq = jnp.minimum(tq1, self._q(
+                        targets["q2"], batch["next_obs"], next_a))
+                else:
+                    tq = tq1
+                target = jax.lax.stop_gradient(
+                    batch["reward"] + cfg.gamma * (1.0 - batch["done"])
+                    * tq)
+                td1 = self._q(qp["q1"], batch["obs"], batch["action"]) \
+                    - target
+                loss = jnp.mean(weights * td1 ** 2)
+                td_abs = jnp.abs(td1)
+                if cfg.twin_q:
+                    td2 = self._q(qp["q2"], batch["obs"],
+                                  batch["action"]) - target
+                    loss = loss + jnp.mean(weights * td2 ** 2)
+                    td_abs = 0.5 * (td_abs + jnp.abs(td2))
+                return loss, td_abs
+
+            def actor_loss_fn(ap, q1, batch):
+                a = self._act(ap, batch["obs"])
+                return -jnp.mean(self._q(q1, batch["obs"], a))
+
+            def update(carry, _):
+                (params, targets, aopt_state, copt_state, buffer, key,
+                 upd_count) = carry
+                batch, idx, weights, key = sample_fn(buffer, key,
+                                                     cfg.batch_size)
+                key, skey = jax.random.split(key)
+                qp = {"q1": params["q1"], "q2": params["q2"]}
+                (_, td_abs), qgrads = jax.value_and_grad(
+                    critic_loss_fn, has_aux=True)(qp, targets, batch,
+                                                  weights, skey)
+                buffer = update_pri(buffer, idx, td_abs)
+                qupd, copt_state = self.critic_opt.update(
+                    qgrads, copt_state, qp)
+                qp = optax.apply_updates(qp, qupd)
+                params = {**params, "q1": qp["q1"], "q2": qp["q2"]}
+
+                def do_actor(args):
+                    params, targets, aopt_state = args
+                    agrads = jax.grad(actor_loss_fn)(
+                        params["actor"], params["q1"], batch)
+                    aupd, aopt_state = self.actor_opt.update(
+                        agrads, aopt_state, params["actor"])
+                    actor = optax.apply_updates(params["actor"], aupd)
+                    params = {**params, "actor": actor}
+                    # targets track ONLY on actor-update steps (TD3's
+                    # delayed-target rule; delay=1 makes it every step)
+                    targets = jax.tree_util.tree_map(
+                        lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+                        targets, params)
+                    return params, targets, aopt_state
+
+                params, targets, aopt_state = jax.lax.cond(
+                    upd_count % cfg.policy_delay == 0,
+                    do_actor, lambda args: args,
+                    (params, targets, aopt_state))
+                return (params, targets, aopt_state, copt_state, buffer,
+                        key, upd_count + 1), td_abs.mean()
+
+            do_learn = buffer["size"] >= cfg.learn_start
+
+            def run(args):
+                (params, targets, aopt_state, copt_state, buffer, key,
+                 upd_count) = args
+                (params, targets, aopt_state, copt_state, buffer, key,
+                 upd_count), tds = jax.lax.scan(
+                    update, args, None, length=cfg.num_updates)
+                return (params, targets, aopt_state, copt_state, buffer,
+                        key, upd_count, tds[-1])
+
+            def skip(args):
+                return args + (jnp.zeros(()),)
+
+            (params, targets, aopt_state, copt_state, buffer, key,
+             upd_count, last_td) = jax.lax.cond(
+                do_learn, run, skip,
+                (params, targets, aopt_state, copt_state, buffer, key,
+                 upd_count))
+            metrics = {"td_abs": last_td, "buffer_size": buffer["size"]}
+            return (params, targets, aopt_state, copt_state, buffer,
+                    env_states, obs, noise_state, key, upd_count,
+                    metrics, traj["reward"], traj["done"])
+
+        return train_iter
+
+    # -- Trainable interface ------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        (self.params, self.targets, self.actor_opt_state,
+         self.critic_opt_state, self.buffer, self.env_states, self.obs,
+         self.noise_state, self.key, self._update_count, metrics,
+         rewards, dones) = self._train_iter(
+            self.params, self.targets, self.actor_opt_state,
+            self.critic_opt_state, self.buffer, self.env_states,
+            self.obs, self.noise_state, self.key, self._update_count,
+            jnp.asarray(self._total_env_steps, jnp.float32))
+        env_steps = cfg.num_envs * cfg.rollout_steps
+        self._track_episodes(np.asarray(rewards), np.asarray(dones))
+        dt = time.perf_counter() - t0
+        out = {k: float(v) for k, v in metrics.items()}
+        out["step_reward_mean"] = float(np.asarray(rewards).mean())
+        out.update({
+            "env_steps_this_iter": env_steps,
+            "env_steps_per_s": env_steps / dt,
+            "episode_reward_mean": self.episode_reward_mean(),
+        })
+        return out
+
+    def action_fn(self):
+        """Deterministic jittable policy for deployment/eval."""
+        act, params = self._act, self.params
+
+        def policy(obs, key):
+            return act(params["actor"], obs)
+        return policy
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "targets": to_np(self.targets),
+                "iteration": self.iteration,
+                # exploration noise anneals on env_steps_total and the
+                # policy_delay phase rides the update counter: a restored
+                # run must not restart either (cf. dqn.py get_state)
+                "env_steps_total": self._total_env_steps,
+                "update_count": int(self._update_count)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        to_dev = lambda t, w: jax.tree_util.tree_map(  # noqa: E731
+            lambda _, x: jnp.asarray(x), t, w)
+        self.params = to_dev(self.params, state["params"])
+        self.targets = to_dev(self.targets, state["targets"])
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("env_steps_total", 0)
+        self._update_count = jnp.asarray(
+            state.get("update_count", 0), jnp.int32)
+
+
+class DDPG(TD3):
+    _config_cls = DDPGConfig

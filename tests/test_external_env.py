@@ -1,0 +1,168 @@
+"""External-env / policy-server input: a pure-Python simulator (no jax)
+trains the compiled DQN learner over the RPC plane (reference
+capability: rllib/env/external_env.py + policy_server_input.py)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import DQNConfig, ExternalEnv, PolicyClient, \
+    PolicyServerInput
+
+
+class NumpyCartPole:
+    """Gym-dynamics CartPole in plain numpy — deliberately NOT a JaxEnv:
+    the capability under test is learning from simulators the framework
+    cannot jit."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.default_rng(seed)
+        self.state = None
+        self.t = 0
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, 4)
+        self.t = 0
+        return self.state.copy()
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self.state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(th), np.sin(th)
+        temp = (force + 0.05 * th_dot ** 2 * sinth) / 1.1
+        th_acc = (9.8 * sinth - costh * temp) / \
+            (0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / 1.1))
+        x_acc = temp - 0.05 * th_acc * costh / 1.1
+        tau = 0.02
+        self.state = np.array([x + tau * x_dot, x_dot + tau * x_acc,
+                               th + tau * th_dot, th_dot + tau * th_acc])
+        self.t += 1
+        done = bool(abs(self.state[0]) > 2.4
+                    or abs(self.state[2]) > 0.2095 or self.t >= 200)
+        return self.state.copy(), 1.0, done
+
+
+class CartPoleRunner(ExternalEnv):
+    """Drives NumpyCartPole against the policy server until stopped."""
+
+    def __init__(self, client, episodes=10_000):
+        super().__init__(client)
+        self.episodes = episodes
+        self.stopped = threading.Event()
+        self.error = None
+
+    def run(self):
+        try:
+            sim = NumpyCartPole(seed=1)
+            for _ in range(self.episodes):
+                if self.stopped.is_set():
+                    return
+                eid = self.client.start_episode()
+                obs = sim.reset()
+                done = False
+                while not done and not self.stopped.is_set():
+                    a = self.client.get_action(eid, obs)
+                    obs, r, done = sim.step(a)
+                    self.client.log_returns(eid, r)
+                self.client.end_episode(eid, obs)
+        except Exception as exc:   # surface thread crashes in the test
+            self.error = exc
+
+
+def test_dqn_learns_cartpole_via_policy_server():
+    algo = DQNConfig(external_input=True, observation_size=4,
+                     num_actions=2, batch_size=64, num_updates=8,
+                     ingest_chunk=32, learn_start=256, lr=1e-3,
+                     eps_decay_steps=4_000, buffer_capacity=20_000,
+                     seed=0).build()
+    server = PolicyServerInput(algo)
+    algo.set_input_reader(server)
+    runner = CartPoleRunner(PolicyClient(server.address))
+    runner.start()
+    try:
+        import time
+        rewards = []
+        deadline = time.monotonic() + 150.0
+        while time.monotonic() < deadline:
+            res = algo.train()
+            if res["transitions_received"] < 16:
+                time.sleep(0.05)    # let the simulator thread produce
+            r = res["episode_reward_mean"]
+            if np.isfinite(r):
+                rewards.append(r)
+            if rewards and rewards[-1] > 120.0:
+                break
+            if runner.error is not None:
+                raise runner.error
+        assert rewards, "no episodes completed through the server"
+        assert rewards[-1] > 120.0, \
+            f"did not learn: reward progression tail {rewards[-10:]}"
+        assert res["env_steps_total"] > 1_000
+    finally:
+        runner.stopped.set()
+        runner.client.close()
+        server.stop()
+
+
+def test_policy_server_episode_bookkeeping():
+    """Transitions stitch (obs, action, accumulated reward, next_obs);
+    end_episode marks done and banks the return."""
+    algo = DQNConfig(external_input=True, observation_size=2,
+                     num_actions=3, seed=0).build()
+    server = PolicyServerInput(algo)
+    client = PolicyClient(server.address)
+    try:
+        eid = client.start_episode()
+        a0 = client.get_action(eid, [0.0, 0.0])
+        assert 0 <= a0 < 3
+        client.log_returns(eid, 0.5)
+        client.log_returns(eid, 0.25)
+        a1 = client.get_action(eid, [1.0, 0.0])
+        assert 0 <= a1 < 3
+        client.log_returns(eid, 1.0)
+        client.end_episode(eid, [2.0, 0.0])
+        trans = server.poll_transitions()
+        assert len(trans) == 2
+        np.testing.assert_allclose(trans[0]["obs"], [0.0, 0.0])
+        assert trans[0]["action"] == a0
+        assert trans[0]["reward"] == pytest.approx(0.75)
+        assert trans[0]["done"] == 0.0
+        np.testing.assert_allclose(trans[1]["next_obs"], [2.0, 0.0])
+        assert trans[1]["done"] == 1.0
+        assert server.poll_episode_returns() == [pytest.approx(1.75)]
+        # ended episodes are gone
+        with pytest.raises(Exception):
+            client.get_action(eid, [0.0, 0.0])
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_log_action_off_policy_path():
+    algo = DQNConfig(external_input=True, observation_size=2,
+                     num_actions=2, seed=0).build()
+    server = PolicyServerInput(algo)
+    client = PolicyClient(server.address)
+    try:
+        eid = client.start_episode()
+        client.log_action(eid, [0.0, 1.0], 1)
+        client.log_returns(eid, 2.0)
+        client.end_episode(eid, [1.0, 1.0])
+        (t,) = server.poll_transitions()
+        assert t["action"] == 1 and t["reward"] == pytest.approx(2.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_external_config_guards():
+    with pytest.raises(ValueError, match="observation_size"):
+        DQNConfig(external_input=True).build()
+    with pytest.raises(ValueError, match="n_step"):
+        DQNConfig(external_input=True, observation_size=4,
+                  num_actions=2, n_step=3).build()
+    algo = DQNConfig(external_input=True, observation_size=4,
+                     num_actions=2).build()
+    with pytest.raises(RuntimeError, match="input reader"):
+        algo.train()

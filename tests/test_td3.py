@@ -1,0 +1,80 @@
+"""TD3/DDPG: deterministic continuous control (reference capability:
+rllib/algorithms/ddpg + td3)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import DDPG, DDPGConfig, Pendulum, TD3, TD3Config
+
+
+def test_td3_improves_pendulum():
+    algo = TD3Config(env=Pendulum, num_envs=16, rollout_steps=25,
+                     batch_size=256, num_updates=100, learn_start=512,
+                     actor_lr=1e-3, critic_lr=1e-3, tau=0.01,
+                     seed=0).build()
+    per_step = []
+    for _ in range(36):
+        res = algo.train()
+        per_step.append(res["step_reward_mean"])
+    early = float(np.mean(per_step[:3]))
+    late = float(np.mean(per_step[-3:]))
+    assert late > early + 2.0, \
+        f"no improvement: early={early:.2f} late={late:.2f}"
+    assert np.isfinite(res["td_abs"])
+
+
+def test_td3_actions_respect_bounds_and_delay():
+    cfg = TD3Config(env=Pendulum, num_envs=4, rollout_steps=8,
+                    num_updates=4, learn_start=16, policy_delay=2,
+                    seed=1)
+    algo = cfg.build()
+    import jax
+    r0 = algo.train()
+    before = [np.asarray(x) for x in
+              jax.tree_util.tree_leaves(algo.params["actor"])]
+    algo.train()
+    after = jax.tree_util.tree_leaves(algo.params["actor"])
+    # actor moved (some update steps hit the delay schedule)
+    assert any(float(np.abs(np.asarray(a) - b).max()) > 0
+               for a, b in zip(after, before))
+    # deployment policy output stays inside the action bound
+    policy = algo.action_fn()
+    import jax
+    obs = np.zeros((5, 3), np.float32)
+    acts = np.asarray(policy(obs, jax.random.PRNGKey(0)))
+    assert np.all(np.abs(acts) <= Pendulum.action_high + 1e-6)
+    assert r0["env_steps_this_iter"] == 4 * 8
+
+
+def test_ddpg_config_runs():
+    algo = DDPGConfig(env=Pendulum, num_envs=4, rollout_steps=8,
+                      num_updates=4, learn_start=16, seed=0).build()
+    assert isinstance(algo, (TD3, DDPG))
+    assert algo.config.twin_q is False
+    assert algo.config.smooth_target_policy is False
+    # OU noise state persists across iterations
+    for _ in range(3):
+        res = algo.train()
+    assert np.isfinite(res["step_reward_mean"])
+    assert algo.noise_state.shape == (4, 1)
+
+
+def test_td3_checkpoint_roundtrip():
+    cfg = TD3Config(env=Pendulum, num_envs=4, rollout_steps=4,
+                    num_updates=2, learn_start=8, seed=0)
+    a = cfg.build()
+    a.train()
+    ckpt = a.save()
+    b = cfg.build()
+    b.restore(ckpt)
+    import jax
+    for x, y in zip(jax.tree_util.tree_leaves(a.params["actor"]),
+                    jax.tree_util.tree_leaves(b.params["actor"])):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+    assert b.iteration == a.iteration
+
+
+def test_discrete_env_rejected():
+    from ray_tpu.rl import CartPole
+    with pytest.raises(ValueError, match="continuous"):
+        TD3Config(env=CartPole).build()
