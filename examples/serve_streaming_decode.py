@@ -17,43 +17,19 @@ def main():
     @serve.deployment(max_concurrent_queries=4)
     class DecodeSession:
         def __init__(self):
-            import jax
             import jax.numpy as jnp
 
-            from ray_tpu.models import TransformerConfig, init_params
-            self.jnp = jnp
-            self.cfg = TransformerConfig.tiny(max_seq_len=64,
-                                              attention_impl="reference",
-                                              dtype=jnp.float32)
-            self.params, _ = init_params(jax.random.PRNGKey(0), self.cfg)
-            # the replica runs threaded (max_concurrent_queries > 1):
-            # session state needs a lock
-            import threading
-            self._lock = threading.Lock()
-            self.sessions = {}
-            self._next = 0
+            from ray_tpu.models import TransformerConfig
+            from ray_tpu.serve.decode_session import DecodeSessionCore
+            # DecodeSessionCore jits prefill/decode once per replica and
+            # locks the session table (the replica runs threaded)
+            self.core = DecodeSessionCore(
+                TransformerConfig.tiny(max_seq_len=64,
+                                       attention_impl="reference",
+                                       dtype=jnp.float32), max_len=64)
 
         def __call__(self, req):
-            from ray_tpu.models import decode_step, init_kv_cache, prefill
-            jnp = self.jnp
-            if req["op"] == "start":
-                prompt = jnp.asarray(req["prompt"], jnp.int32)
-                cache = init_kv_cache(self.cfg, prompt.shape[0], 64)
-                logits, cache = prefill(self.params, prompt, self.cfg,
-                                        cache)
-                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                with self._lock:
-                    sid = self._next
-                    self._next += 1
-                    self.sessions[sid] = (cache, tok)
-                return {"sid": sid, "token": tok.tolist()}
-            with self._lock:
-                cache, tok = self.sessions.pop(req["sid"])
-            logits, cache = decode_step(self.params, tok, cache, self.cfg)
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            with self._lock:
-                self.sessions[req["sid"]] = (cache, tok)
-            return {"token": tok.tolist()}
+            return self.core.handle(req)
 
     handle = serve.run(DecodeSession.bind())
     out = handle.remote({"op": "start", "prompt": [[5, 6, 7]]}).result(

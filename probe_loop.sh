@@ -1,0 +1,15 @@
+#!/bin/bash
+# Retry tpu_probe.py until the tunnelled chip claim succeeds (wedged
+# grants fail client init after ~1500s; healthy chips init in <1s).
+# One claimant at a time, never killed — the round-3 wedge discipline.
+cd /root/repo
+for i in $(seq 1 24); do
+    echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> probe_r04.err
+    python tpu_probe.py >> probe_r04.out 2>> probe_r04.err
+    rc=$?
+    if [ -s probe_r04.out ]; then
+        echo "=== probe produced output (rc=$rc), stopping ===" >> probe_r04.err
+        break
+    fi
+    sleep 90
+done

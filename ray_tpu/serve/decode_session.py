@@ -1,0 +1,74 @@
+"""Stateful KV-cache decode sessions for Serve replicas.
+
+The serving-side face of the model runtime (reference: Ray Serve
+delegates streaming decode to external engines like vLLM —
+/root/reference/doc/source/serve/index.md; here it is in-tree): a
+replica holds per-session KV caches so `start` pays one prefill and
+every `next` is a single decode step.  Used by the streaming-decode
+example and `bench.py --serve`; wrap it in a `@serve.deployment` whose
+``__call__`` forwards to :meth:`handle`.
+
+prefill/decode compile ONCE per replica (config static, cache position
+dynamic) — eager per-step dispatch costs ~100x on small models, which
+the round-4 TTFT benchmark measured directly (700 ms → 4.8 ms/token).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+
+class DecodeSessionCore:
+    """Session store + compiled prefill/decode over one model.
+
+    Protocol (msgpack/JSON-native):
+      {"op": "start", "prompt": [S ints] | [[S ints]xB]} ->
+          {"sid": int, "token": [B ints]}
+      {"op": "next", "sid": int} -> {"token": [B ints]}
+    Sessions are popped while decoding (pop-as-lease), so concurrent
+    `next` calls on ONE session serialize by construction.
+    """
+
+    def __init__(self, cfg, max_len: int, seed: int = 0,
+                 params: Any = None):
+        import jax
+
+        from ..models import decode_step, init_params, prefill
+        self.cfg = cfg
+        self.max_len = max_len
+        if params is None:
+            params, _ = init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+        self._prefill = jax.jit(prefill, static_argnames=("cfg",))
+        self._decode = jax.jit(decode_step, static_argnames=("cfg",))
+        self._lock = threading.Lock()
+        self.sessions: Dict[int, Any] = {}
+        self._next_sid = 0
+
+    def handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from ..models import init_kv_cache
+        if req["op"] == "start":
+            prompt = jnp.asarray(req["prompt"], jnp.int32)
+            if prompt.ndim == 1:
+                prompt = prompt[None]
+            cache = init_kv_cache(self.cfg, prompt.shape[0],
+                                  self.max_len)
+            logits, cache = self._prefill(self.params, prompt,
+                                          cfg=self.cfg, cache=cache)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            with self._lock:
+                sid = self._next_sid
+                self._next_sid += 1
+                self.sessions[sid] = (cache, tok)
+            return {"sid": sid, "token": tok.tolist()}
+        with self._lock:
+            cache, tok = self.sessions.pop(req["sid"])
+        logits, cache = self._decode(self.params, tok, cache,
+                                     cfg=self.cfg)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        with self._lock:
+            self.sessions[req["sid"]] = (cache, tok)
+        return {"token": tok.tolist()}
