@@ -342,6 +342,9 @@ def _run_serve_measurement() -> dict:
                       json={"op": "next", "sid": sid},
                       timeout=60).raise_for_status()
             per_tok.append(time.perf_counter() - t0)
+        # release the KV cache (sessions are real replica memory)
+        http.post(f"{addr}/generate", json={"op": "end", "sid": sid},
+                  timeout=60)
         return ttft, per_tok
 
     session(0)                       # warmup: compiles prefill + decode

@@ -10,5 +10,8 @@ strategy).
 """
 
 from .autoscaler import StandardAutoscaler, request_resources  # noqa: F401
+from .aws_provider import AwsProvider  # noqa: F401
+from .gce_provider import GceProvider  # noqa: F401
+from .kuberay_provider import KubeRayProvider  # noqa: F401
 from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401
 from .tpu_pod_provider import TpuPodProvider  # noqa: F401

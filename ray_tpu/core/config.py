@@ -177,6 +177,11 @@ _d("spill_low_water_frac", float, 0.60,
 _d("spill_min_object_bytes", int, 32 * 1024,
    "Primary copies smaller than this are never proactively spilled "
    "(reference: min_spilling_size batches small objects instead).")
+_d("dashboard_agent", bool, True,
+   "Launch a per-node dashboard agent process next to each nodelet "
+   "(reference: dashboard/agent.py spawned by the raylet) serving OS "
+   "stats + logs off the scheduler's critical path.  Agent death never "
+   "affects the nodelet; the head falls back to nodelet scraping.")
 _d("spill_check_interval_s", float, 0.5,
    "Nodelet store-pressure check period; 0 disables proactive spilling.")
 _d("log_to_driver", bool, True, "Forward worker stdout/stderr lines to the driver.")

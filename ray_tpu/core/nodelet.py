@@ -161,6 +161,29 @@ class Nodelet:
         self._lag_ewma = 0.0
         self._lag_max = 0.0
         self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
+        self._agent_proc = None
+        if GlobalConfig.dashboard_agent:
+            # per-node dashboard agent (reference: raylet spawning
+            # dashboard/agent.py); failures are non-fatal — the head
+            # falls back to scraping this nodelet directly
+            try:
+                os.makedirs(os.path.join(self.session_dir, "logs"),
+                            exist_ok=True)
+                logf = open(os.path.join(self.session_dir, "logs",
+                                         f"dashboard_agent_"
+                                         f"{self.node_id.hex()[:8]}.log"),
+                            "ab")
+                self._agent_proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu.dashboard.agent",
+                     "--node-id", self.node_id.hex(),
+                     "--session-dir", self.session_dir,
+                     "--controller", self.controller_addr,
+                     "--nodelet-addr", self.address],
+                    stdout=logf, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+                logf.close()
+            except Exception:
+                traceback.print_exc()
         return self
 
     async def _connect_controller(self):
@@ -196,6 +219,9 @@ class Nodelet:
         # ever observe an exit.
         if self.zygote is not None:
             self.zygote.stop()
+        agent = getattr(self, "_agent_proc", None)
+        if agent is not None and agent.poll() is None:
+            agent.terminate()
         for w in self.workers.values():
             if w.proc.poll() is None:
                 w.proc.terminate()
@@ -207,6 +233,12 @@ class Nodelet:
                 w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
             except Exception:
                 w.proc.kill()
+        if agent is not None:           # same escalation workers get —
+            try:                        # no zombies held by this process
+                agent.wait(timeout=max(0.05,
+                                       deadline - time.monotonic()))
+            except Exception:
+                agent.kill()
         await self.server.stop()
         if self.controller:
             await self.controller.close()

@@ -1,0 +1,189 @@
+"""Per-node dashboard agent: OS-level stats + log serving off the
+nodelet's critical path.
+
+Capability mirror of the reference's per-node agent
+(/root/reference/dashboard/agent.py:1 — a process the raylet launches
+next to itself; reporter/log modules sample the NODE while the head
+aggregates).  The split matters for the same reason as there: stats
+sampling and log tailing are IO the scheduler loop must not pay for,
+and a crashed agent must not take worker scheduling down with it.
+
+TPU-first shape: the agent is ~200 LoC riding the framework's own RPC
+plane and controller KV (namespace ``dashboard``, key
+``agent:<node_id>`` → address, heartbeat-refreshed) instead of the
+reference's gRPC + Redis; the head discovers agents through the KV and
+falls back to the nodelet scrape path when an agent is dead — logs and
+stats stay served either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+AGENT_KV_NS = "dashboard"
+AGENT_KV_PREFIX = "agent:"
+
+
+def _read_proc_stat() -> Dict[str, float]:
+    with open("/proc/stat") as f:
+        fields = f.readline().split()[1:8]
+    vals = [float(x) for x in fields]
+    idle = vals[3] + vals[4]
+    return {"total": sum(vals), "idle": idle}
+
+
+def _meminfo() -> Dict[str, float]:
+    out = {}
+    with open("/proc/meminfo") as f:
+        for line in f:
+            k, v = line.split(":", 1)
+            if k in ("MemTotal", "MemAvailable"):
+                out[k] = float(v.strip().split()[0]) * 1024
+    return out
+
+
+class DashboardAgent:
+    """Samples node stats, serves logs, heartbeats into controller KV."""
+
+    def __init__(self, *, node_id: str, session_dir: str,
+                 controller_addr: str, nodelet_addr: str = "",
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_s: float = 2.0):
+        from ..core import rpc
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.nodelet_addr = nodelet_addr
+        self.heartbeat_s = heartbeat_s
+        self._cpu_prev = _read_proc_stat()
+        self._cpu_pct = 0.0
+        self._lt = rpc.EventLoopThread("dashboard-agent")
+        self.server = rpc.RpcServer(host, port)
+        for name in ("agent_stats", "list_logs", "tail_log"):
+            fn = getattr(self, "_h_" + name)
+
+            async def handler(conn, data, _fn=fn):
+                return _fn(data or {})
+            self.server.register(name, handler)
+        self._lt.run(self.server.start())
+        self.address = f"{self.server.host}:{self.server.port}"
+        chost, cport = controller_addr.rsplit(":", 1)
+        self._controller = rpc.BlockingClient.connect(
+            self._lt, chost, int(cport))
+        self._stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True,
+                                           name="agent-heartbeat")
+        self._hb_thread.start()
+
+    # -- registration --------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._controller.call("kv_put", {
+                    "ns": AGENT_KV_NS,
+                    "key": AGENT_KV_PREFIX + self.node_id,
+                    "value": json.dumps({
+                        "addr": self.address, "pid": os.getpid(),
+                        "ts": time.time(),
+                        "heartbeat_s": self.heartbeat_s}),
+                }, timeout=5.0)
+            except Exception:
+                pass    # controller restarting: keep trying
+            self._stop.wait(self.heartbeat_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._controller.call("kv_del", {
+                "ns": AGENT_KV_NS,
+                "key": AGENT_KV_PREFIX + self.node_id}, timeout=2.0)
+        except Exception:
+            pass
+        try:
+            self._controller.close()
+            self._lt.run(self.server.stop())
+        finally:
+            self._lt.stop()
+
+    # -- handlers ------------------------------------------------------------
+    def _h_agent_stats(self, data) -> Dict[str, Any]:
+        """Node-level OS stats sampled from /proc (reference:
+        dashboard/modules/reporter/reporter_agent.py's psutil set)."""
+        cur = _read_proc_stat()
+        dt = cur["total"] - self._cpu_prev["total"]
+        didle = cur["idle"] - self._cpu_prev["idle"]
+        if dt > 0:
+            self._cpu_pct = max(0.0, min(100.0,
+                                         100.0 * (1.0 - didle / dt)))
+        self._cpu_prev = cur
+        mem = _meminfo()
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+        return {
+            "node_id": self.node_id,
+            "agent_addr": self.address,
+            "agent_pid": os.getpid(),
+            "cpu_percent": round(self._cpu_pct, 1),
+            "mem_total": mem.get("MemTotal", 0.0),
+            "mem_available": mem.get("MemAvailable", 0.0),
+            "load_avg": [load1, load5, load15],
+            "log_files": self._log_files(),
+        }
+
+    def _log_dir(self) -> str:
+        return os.path.join(self.session_dir, "logs")
+
+    def _log_files(self) -> list:
+        try:
+            return sorted(os.listdir(self._log_dir()))
+        except OSError:
+            return []
+
+    def _h_list_logs(self, data) -> Dict[str, Any]:
+        return {"files": self._log_files()}
+
+    def _h_tail_log(self, data) -> Dict[str, Any]:
+        name = data.get("name", "")
+        if "/" in name or ".." in name:
+            return {"error": "bad log name"}
+        path = os.path.join(self._log_dir(), name)
+        try:
+            size = os.path.getsize(path)
+            nbytes = int(data.get("bytes", 65536))
+            with open(path, "rb") as f:
+                f.seek(max(0, size - nbytes))
+                return {"data": f.read()}
+        except OSError as e:
+            return {"error": str(e)}
+
+
+def main() -> None:
+    import argparse
+    import signal
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--controller", required=True)
+    parser.add_argument("--nodelet-addr", default="")
+    args = parser.parse_args()
+    agent = DashboardAgent(node_id=args.node_id,
+                           session_dir=args.session_dir,
+                           controller_addr=args.controller,
+                           nodelet_addr=args.nodelet_addr)
+    done = threading.Event()
+    # the nodelet stops us with SIGTERM: deregister from the KV so the
+    # head doesn't keep dialing a dead address until the TTL lapses
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    agent.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,14 @@ class DashboardHead:
             from .. import state
             return state.node_stats(request.match_info.get("node_id"))
 
+        def agents(_):
+            from .. import state
+            return state.list_agents()
+
+        def agent_stats(request):
+            from .. import state
+            return state.agent_stats(request.query.get("node") or None)
+
         def objects(_):
             from .. import state
             return state.list_objects()
@@ -198,6 +206,8 @@ class DashboardHead:
         app.router.add_get("/api/jobs/{job_id}/logs", blocking(job_logs))
         app.router.add_get("/metrics", blocking(metrics_text))
         app.router.add_get("/metrics/cluster", blocking(metrics_cluster))
+        app.router.add_get("/api/agents", blocking(agents))
+        app.router.add_get("/api/agent_stats", blocking(agent_stats))
         app.router.add_get("/api/logs", blocking(logs_list))
         app.router.add_get("/api/logs/tail", blocking(logs_tail))
         app.router.add_get("/api/timeline", blocking(timeline))

@@ -26,24 +26,29 @@ class DecodeSessionCore:
       {"op": "start", "prompt": [S ints] | [[S ints]xB]} ->
           {"sid": int, "token": [B ints]}
       {"op": "next", "sid": int} -> {"token": [B ints]}
-    Sessions are popped while decoding (pop-as-lease), so concurrent
-    `next` calls on ONE session serialize by construction.
+      {"op": "end", "sid": int} -> {"ended": bool}
+    Sessions are popped while decoding (pop-as-lease): a pipelined
+    second `next` on the SAME sid — or a stale/unknown sid — gets an
+    ``{"error": ...}`` reply instead of racing the first.  KV caches
+    are real memory, so the table is LRU-bounded (``max_sessions``) and
+    clients should send ``end``; an evicted session's next call errors.
     """
 
     def __init__(self, cfg, max_len: int, seed: int = 0,
-                 params: Any = None):
+                 params: Any = None, max_sessions: int = 64):
         import jax
 
         from ..models import decode_step, init_params, prefill
         self.cfg = cfg
         self.max_len = max_len
+        self.max_sessions = max_sessions
         if params is None:
             params, _ = init_params(jax.random.PRNGKey(seed), cfg)
         self.params = params
         self._prefill = jax.jit(prefill, static_argnames=("cfg",))
         self._decode = jax.jit(decode_step, static_argnames=("cfg",))
         self._lock = threading.Lock()
-        self.sessions: Dict[int, Any] = {}
+        self.sessions: Dict[int, Any] = {}   # insertion-ordered = LRU
         self._next_sid = 0
 
     def handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
@@ -63,9 +68,19 @@ class DecodeSessionCore:
                 sid = self._next_sid
                 self._next_sid += 1
                 self.sessions[sid] = (cache, tok)
+                while len(self.sessions) > self.max_sessions:
+                    self.sessions.pop(next(iter(self.sessions)))
             return {"sid": sid, "token": tok.tolist()}
+        if req["op"] == "end":
+            with self._lock:
+                return {"ended":
+                        self.sessions.pop(req["sid"], None) is not None}
         with self._lock:
-            cache, tok = self.sessions.pop(req["sid"])
+            entry = self.sessions.pop(req["sid"], None)
+        if entry is None:
+            return {"error": f"unknown session {req['sid']!r} (ended, "
+                             f"evicted, or decoding in another request)"}
+        cache, tok = entry
         logits, cache = self._decode(self.params, tok, cache,
                                      cfg=self.cfg)
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -158,6 +158,67 @@ def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+# ------------------------------------------------ dashboard agents
+def _agent_fresh(info: Dict[str, Any]) -> bool:
+    """A registration is live if its heartbeat is recent — a SIGKILLed
+    agent never deregisters, so the 'ts' it refreshes every beat is the
+    liveness signal (3 missed beats + slack = dead)."""
+    import time as _time
+    hb = float(info.get("heartbeat_s", 2.0))
+    return _time.time() - float(info.get("ts", 0)) < 3.0 * hb + 2.0
+
+
+def list_agents(include_stale: bool = False) -> Dict[str, Dict[str, Any]]:
+    """Per-node dashboard agents registered in controller KV
+    (reference: the head's per-node agent table, dashboard/head.py
+    node-agent discovery through the GCS)."""
+    import json as _json
+
+    from .dashboard.agent import AGENT_KV_NS, AGENT_KV_PREFIX
+    core = _ensure_initialized()
+    keys = core.controller.call("kv_keys", {"ns": AGENT_KV_NS,
+                                            "prefix": AGENT_KV_PREFIX})
+    out = {}
+    for key in keys:
+        raw = core.controller.call("kv_get", {"ns": AGENT_KV_NS,
+                                              "key": key})
+        if raw is None:
+            continue
+        info = _json.loads(raw)
+        if include_stale or _agent_fresh(info):
+            out[key[len(AGENT_KV_PREFIX):]] = info
+    return out
+
+
+def agent_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """OS-level node stats served by the per-node agents, falling back
+    to the nodelet scrape path for nodes whose agent is dead or absent
+    — logs/stats stay served either way (the reference's head degrades
+    the same direction when an agent is unreachable)."""
+    agents = list_agents()
+    out = []
+    for n in list_nodes():
+        if not n.get("alive"):
+            continue
+        if node_id is not None and n["id"] != node_id:
+            continue
+        agent = agents.get(n["id"])
+        if agent is not None:
+            try:
+                out.append(_node_call(agent["addr"], "agent_stats",
+                                      timeout=5.0))
+                continue
+            except Exception:
+                pass    # dead agent: fall through to the nodelet
+        try:
+            stats = _node_call(n["addr"], "node_stats")
+            stats["agent"] = "fallback:nodelet"
+            out.append(stats)
+        except Exception as e:
+            out.append({"node_id": n["id"], "error": str(e)})
+    return out
+
+
 def list_tasks() -> List[Dict[str, Any]]:
     """RUNNING tasks cluster-wide with node attribution (reference:
     `ray list tasks`, experimental/state/api.py)."""
@@ -219,25 +280,66 @@ def memory_summary() -> Dict[str, Any]:
     }
 
 
+def _agent_for_addr(addr: str) -> Optional[str]:
+    """Agent address for a nodelet address, if a live agent registered.
+    ONE kv_get for the addressed node — not a full agent-table scan per
+    log poll."""
+    import json as _json
+
+    from .dashboard.agent import AGENT_KV_NS, AGENT_KV_PREFIX
+    try:
+        node_id = next((n["id"] for n in list_nodes()
+                        if n["addr"] == addr), None)
+        if node_id is None:
+            return None
+        raw = _ensure_initialized().controller.call(
+            "kv_get", {"ns": AGENT_KV_NS,
+                       "key": AGENT_KV_PREFIX + node_id})
+        if raw is None:
+            return None
+        info = _json.loads(raw)
+        return info["addr"] if _agent_fresh(info) else None
+    except Exception:
+        return None
+
+
 def list_logs(node_addr: Optional[str] = None) -> List[str]:
     """Per-process log files on a node's session dir (reference:
-    LogMonitor's file set, `ray logs`)."""
+    LogMonitor's file set, `ray logs`) — served by the node's dashboard
+    agent when one is alive, by the nodelet otherwise."""
     nodes = list_nodes()
     addr = node_addr or next(
         (n["addr"] for n in nodes if n.get("alive")), None)
     if addr is None:
         return []
+    agent_addr = _agent_for_addr(addr)
+    if agent_addr is not None:
+        try:
+            return _node_call(agent_addr, "list_logs",
+                              timeout=5.0).get("files", [])
+        except Exception:
+            pass
     return _node_call(addr, "tail_log", {}).get("files", [])
 
 
 def tail_log(name: str, node_addr: Optional[str] = None,
              nbytes: int = 65536) -> bytes:
-    """Tail one per-process log file (reference: `ray logs <file>`)."""
+    """Tail one per-process log file (reference: `ray logs <file>`) —
+    agent-served with nodelet fallback, like :func:`list_logs`."""
     nodes = list_nodes()
     addr = node_addr or next(
         (n["addr"] for n in nodes if n.get("alive")), None)
     if addr is None:
         raise RuntimeError("no alive node")
+    agent_addr = _agent_for_addr(addr)
+    if agent_addr is not None:
+        try:
+            r = _node_call(agent_addr, "tail_log",
+                           {"name": name, "bytes": nbytes}, timeout=5.0)
+            if "error" not in r:
+                return r["data"]
+        except Exception:
+            pass
     r = _node_call(addr, "tail_log", {"name": name, "bytes": nbytes})
     if "error" in r:
         raise RuntimeError(r["error"])

@@ -21,6 +21,10 @@ os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY_MB", "256")
 # helpers to the 8-device virtual CPU backend explicitly.
 os.environ.setdefault("RAY_TPU_DEVICE_BACKEND", "cpu")
 os.environ.setdefault("RAY_TPU_WORKER_POOL_INITIAL_SIZE", "1")
+# Per-node dashboard agents default ON in production; in the suite they
+# would add a subprocess per nodelet across hundreds of cluster boots.
+# The dedicated agent test re-enables them via GlobalConfig.update.
+os.environ.setdefault("RAY_TPU_DASHBOARD_AGENT", "0")
 # Do NOT clear PALLAS_AXON_POOL_IPS here: sitecustomize already registered
 # the axon plugin at interpreter start using the ambient value, and blanking
 # it post-registration makes the lazy PJRT client init block forever.

@@ -1,0 +1,249 @@
+"""AWS / KubeRay node providers against recorded-response fakes
+(reference capability: autoscaler/_private/aws/node_provider.py and
+_private/kuberay/node_provider.py; no cloud SDK in this image, so the
+client surfaces are injected — the same strategy as the gcloud-CLI
+fakes in test_tpu_pod_provider.py)."""
+
+import base64
+
+import pytest
+
+from ray_tpu.autoscaler import AwsProvider, KubeRayProvider
+
+
+# -- fakes -------------------------------------------------------------------
+
+class FakeEC2:
+    """boto3-client-shaped recorder: instances live in a dict."""
+
+    def __init__(self):
+        self.instances = {}        # id -> {state, tags}
+        self.calls = []
+        self._seq = 0
+
+    def run_instances(self, **kw):
+        self.calls.append(("run_instances", kw))
+        self._seq += 1
+        iid = f"i-{self._seq:08x}"
+        tags = {t["Key"]: t["Value"]
+                for t in kw["TagSpecifications"][0]["Tags"]}
+        self.instances[iid] = {"state": "pending", "tags": tags,
+                               "user_data": base64.b64decode(
+                                   kw["UserData"]).decode()}
+        return {"Instances": [{"InstanceId": iid}]}
+
+    def terminate_instances(self, InstanceIds):
+        self.calls.append(("terminate_instances", InstanceIds))
+        for iid in InstanceIds:
+            self.instances[iid]["state"] = "shutting-down"
+
+    def describe_instances(self, Filters):
+        self.calls.append(("describe_instances", Filters))
+        by_name = {f["Name"]: f["Values"] for f in Filters}
+        out = []
+        for iid, inst in self.instances.items():
+            if inst["state"] not in by_name.get(
+                    "instance-state-name", [inst["state"]]):
+                continue
+            cluster = by_name.get("tag:ray-tpu-cluster")
+            if cluster and inst["tags"].get("ray-tpu-cluster") \
+                    not in cluster:
+                continue
+            out.append({"InstanceId": iid,
+                        "Tags": [{"Key": k, "Value": v}
+                                 for k, v in inst["tags"].items()]})
+        return {"Reservations": [{"Instances": out}]} if out else \
+            {"Reservations": []}
+
+
+class FakeK8s:
+    """Kubernetes API fake: one RayCluster CR + an 'operator' that
+    reconciles pods when asked."""
+
+    def __init__(self):
+        self.cr = {"spec": {"workerGroupSpecs": [
+            {"groupName": "cpu-group", "replicas": 1,
+             "template": {"spec": {"containers": [{
+                 "resources": {"requests": {"cpu": "2"}}}]}}},
+            {"groupName": "tpu-group", "replicas": 0,
+             "template": {"spec": {"containers": [{
+                 "resources": {"requests": {
+                     "cpu": "500m", "google.com/tpu": "4"}}}]}}},
+        ]}}
+        self.pods = {}
+        self.patches = []
+        self._seq = 0
+        self._make_pod("cpu-group")        # replicas=1 starts satisfied
+
+    def _make_pod(self, group):
+        self._seq += 1
+        name = f"ray-{group}-{self._seq}"
+        self.pods[name] = {
+            "metadata": {"name": name, "labels": {
+                "ray.io/cluster": "demo", "ray.io/group": group,
+                "ray.io/node-type": "worker"}},
+            "status": {"phase": "Running"}}
+        return name
+
+    def reconcile(self):
+        """The operator: align pods with goal replicas, honoring
+        workersToDelete first."""
+        for g in self.cr["spec"]["workerGroupSpecs"]:
+            strat = (g.get("scaleStrategy") or {})
+            for pod in strat.get("workersToDelete", []):
+                self.pods.pop(pod, None)
+            g["scaleStrategy"] = {"workersToDelete": []}
+            have = [p for p in self.pods.values()
+                    if p["metadata"]["labels"]["ray.io/group"]
+                    == g["groupName"]]
+            for _ in range(int(g.get("replicas", 0)) - len(have)):
+                self._make_pod(g["groupName"])
+
+    def __call__(self, method, path, body=None):
+        if path.endswith("/rayclusters/demo"):
+            if method == "GET":
+                import copy
+                return copy.deepcopy(self.cr)
+            assert method == "PATCH"
+            self.patches.append(body)
+            for op in body:
+                # real apiservers 422 a "replace" on a missing member;
+                # the provider must send "add" (create-or-replace)
+                assert op["op"] == "add", op
+                parts = op["path"].strip("/").split("/")
+                tgt = self.cr
+                for p in parts[:-1]:
+                    tgt = tgt[int(p)] if p.isdigit() else tgt[p]
+                tgt[parts[-1]] = op["value"]
+            return {}
+        if "/pods/" in path:
+            name = path.rsplit("/", 1)[1]
+            if name not in self.pods:
+                raise KeyError(name)
+            return self.pods[name]
+        if "/pods?" in path:
+            return {"items": list(self.pods.values())}
+        raise AssertionError(f"unexpected {method} {path}")
+
+
+# -- shared contract ---------------------------------------------------------
+
+@pytest.fixture
+def aws():
+    ec2 = FakeEC2()
+    return ec2, AwsProvider(
+        region="us-west-2", head_address="10.0.0.2:7001",
+        cluster_name="demo", ec2=ec2,
+        node_types={"cpu_16": {"instance_type": "m6i.4xlarge",
+                               "ami": "ami-123",
+                               "host_resources": {"CPU": 16},
+                               "setup_commands": ["echo hi"]}})
+
+
+@pytest.fixture
+def kuberay():
+    k8s = FakeK8s()
+    return k8s, KubeRayProvider(namespace="ns", cluster_name="demo",
+                                api=k8s)
+
+
+def test_aws_lifecycle(aws):
+    ec2, p = aws
+    assert p.non_terminated_nodes() == []
+    iid = p.create_node("cpu_16")
+    assert p.non_terminated_nodes() == [iid]
+    assert p.node_type_of(iid) == "cpu_16"
+    assert p.node_resources("cpu_16") == {"CPU": 16}
+    p.terminate_node(iid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_aws_userdata_and_tags(aws):
+    ec2, p = aws
+    iid = p.create_node("cpu_16")
+    inst = ec2.instances[iid]
+    assert "ray-tpu start --address 10.0.0.2:7001" in inst["user_data"]
+    assert "--num-cpus 16" in inst["user_data"]
+    assert "echo hi" in inst["user_data"]
+    assert inst["tags"]["ray-tpu-cluster"] == "demo"
+    assert inst["tags"]["ray-tpu-node-type"] == "cpu_16"
+
+
+def test_aws_type_map_rebuilds_from_tags(aws):
+    """A restarted provider (fresh _type_by_id) relearns node types
+    from instance tags via describe — the reference's behavior."""
+    ec2, p = aws
+    iid = p.create_node("cpu_16")
+    p2 = AwsProvider(region="us-west-2", head_address="h:1",
+                     cluster_name="demo", ec2=ec2,
+                     node_types={"cpu_16": {"ami": "ami-123"}})
+    assert p2.node_type_of(iid) is None       # not yet observed
+    assert p2.non_terminated_nodes() == [iid]
+    assert p2.node_type_of(iid) == "cpu_16"
+
+
+def test_kuberay_scale_up_goal_state(kuberay):
+    k8s, p = kuberay
+    assert len(p.non_terminated_nodes()) == 1      # initial cpu pod
+    token = p.create_node("tpu-group")
+    assert token.startswith("goal:tpu-group")
+    # goal recorded in the CR; until the operator reconciles, the TOKEN
+    # is listed as a pending node so autoscaler launch accounting sees
+    # the in-flight capacity and does not re-launch every tick
+    assert k8s.cr["spec"]["workerGroupSpecs"][1]["replicas"] == 1
+    pending = p.non_terminated_nodes()
+    assert len(pending) == 2 and token in pending
+    assert p.node_type_of(token) == "tpu-group"
+    k8s.reconcile()
+    nodes = p.non_terminated_nodes()
+    assert len(nodes) == 2 and token not in nodes  # pod replaced token
+    tpu_pod = [n for n in nodes if "tpu-group" in n][0]
+    assert p.node_type_of(tpu_pod) == "tpu-group"
+
+
+def test_kuberay_terminate_names_pod_in_one_patch(kuberay):
+    """Scale-down must patch replicas AND workersToDelete atomically
+    (separate patches race the operator into deleting an arbitrary
+    pod — the reference submits them together)."""
+    k8s, p = kuberay
+    (pod,) = p.non_terminated_nodes()
+    p.terminate_node(pod)
+    last = k8s.patches[-1]
+    assert len(last) == 2
+    paths = {op["path"] for op in last}
+    assert "/spec/workerGroupSpecs/0/replicas" in paths
+    assert any("scaleStrategy" in p_ for p_ in paths)
+    assert k8s.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 0
+    k8s.reconcile()
+    assert p.non_terminated_nodes() == []
+
+
+def test_kuberay_goal_token_terminate_lowers_goal(kuberay):
+    k8s, p = kuberay
+    token = p.create_node("tpu-group")
+    p.terminate_node(token)
+    assert k8s.cr["spec"]["workerGroupSpecs"][1]["replicas"] == 0
+
+
+def test_kuberay_resources_parse_millicpu_and_tpu(kuberay):
+    _, p = kuberay
+    assert p.node_resources("cpu-group") == {"CPU": 2.0}
+    assert p.node_resources("tpu-group") == {"CPU": 0.5, "TPU": 4.0}
+
+
+def test_kuberay_unknown_group_raises(kuberay):
+    _, p = kuberay
+    with pytest.raises(ValueError, match="nope"):
+        p.create_node("nope")
+
+
+def test_autoscaler_drives_fake_aws(aws):
+    """The StandardAutoscaler contract-drives the provider the same
+    way it drives LocalNodeProvider in test_autoscaler_e2e.py."""
+    ec2, p = aws
+    a = p.create_node("cpu_16")
+    b = p.create_node("cpu_16")
+    assert set(p.non_terminated_nodes()) == {a, b}
+    for nid in list(p.non_terminated_nodes()):
+        p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []

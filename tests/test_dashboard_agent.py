@@ -1,0 +1,103 @@
+"""Per-node dashboard agent: spawned by the nodelet, discovered via
+controller KV, survives into head endpoints, and the head degrades to
+nodelet scraping when an agent dies (reference capability:
+dashboard/agent.py + the head's agent table)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.core.config import GlobalConfig
+
+
+@pytest.fixture
+def agent_cluster():
+    GlobalConfig.update({"dashboard_agent": True})
+    try:
+        ray_tpu.init(num_cpus=2,
+                     object_store_memory=128 * 1024 * 1024)
+        yield
+    finally:
+        ray_tpu.shutdown()
+        GlobalConfig.update({"dashboard_agent": False})
+
+
+def _wait_for_agents(n=1, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        agents = state.list_agents()
+        if len(agents) >= n:
+            return agents
+        time.sleep(0.25)
+    raise AssertionError(f"no agent registered: {state.list_agents()}")
+
+
+def test_agent_spawns_registers_and_serves_stats(agent_cluster):
+    agents = _wait_for_agents()
+    (node_id, info), = list(agents.items())
+    assert info["pid"] > 0
+    stats = state.agent_stats()
+    assert len(stats) == 1
+    s = stats[0]
+    assert s["node_id"] == node_id
+    assert s["agent_pid"] == info["pid"]
+    assert 0.0 <= s["cpu_percent"] <= 100.0
+    assert s["mem_total"] > 0
+    assert "log_files" in s
+
+
+def test_agent_serves_logs(agent_cluster):
+    _wait_for_agents()
+
+    @ray_tpu.remote
+    def noisy():
+        print("agent-log-probe")
+        return 1
+
+    assert ray_tpu.get(noisy.remote()) == 1
+    files = state.list_logs()
+    assert any(f.startswith("worker") for f in files), files
+    worker_log = next(f for f in files if f.startswith("worker"))
+    # tolerate buffering: the tail may lag the task completion briefly
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        data = state.tail_log(worker_log)
+        if b"agent-log-probe" in data:
+            break
+        time.sleep(0.3)
+    assert b"agent-log-probe" in data
+
+
+def test_head_survives_agent_death(agent_cluster):
+    """Kill the agent process: stats/logs must still be served via the
+    nodelet fallback, and the nodelet must stay healthy."""
+    agents = _wait_for_agents()
+    (node_id, info), = list(agents.items())
+    os.kill(info["pid"], signal.SIGKILL)
+    time.sleep(0.5)
+    stats = state.agent_stats()
+    assert len(stats) == 1
+    assert stats[0].get("agent") == "fallback:nodelet" \
+        or "workers" in stats[0]
+    # logs still served through the nodelet path
+    assert isinstance(state.list_logs(), list)
+
+    @ray_tpu.remote
+    def alive():
+        return "yes"
+
+    assert ray_tpu.get(alive.remote()) == "yes"
+
+
+def test_agents_disabled_by_default_in_suite():
+    ray_tpu.init(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    try:
+        assert state.list_agents() == {}
+        # the scrape path serves stats without any agent
+        assert state.agent_stats()
+    finally:
+        ray_tpu.shutdown()
