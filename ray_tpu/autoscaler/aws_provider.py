@@ -12,7 +12,6 @@ builds the real client lazily.
 
 from __future__ import annotations
 
-import base64
 import shlex
 from typing import Any, Callable, Dict, List, Optional
 
@@ -71,7 +70,9 @@ class AwsProvider(NodeProvider):
             ImageId=nt["ami"],
             InstanceType=nt.get("instance_type", "m6i.xlarge"),
             MinCount=1, MaxCount=1,
-            UserData=base64.b64encode(user_data.encode()).decode(),
+            # RAW script: boto3 base64-encodes UserData itself —
+            # pre-encoding would hand cloud-init a double-encoded blob
+            UserData=user_data,
             TagSpecifications=[{
                 "ResourceType": "instance",
                 "Tags": [

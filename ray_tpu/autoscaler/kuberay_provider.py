@@ -199,12 +199,20 @@ class KubeRayProvider(NodeProvider):
                 per_group[group] = per_group.get(group, 0) + 1
         # unsatisfied goal tokens count as pending nodes so launch
         # accounting converges; a token retires once the operator has
-        # materialized at least its target pod count
-        for token, goal in list(self._goals.items()):
-            if per_group.get(goal["group"], 0) >= goal["target"]:
-                del self._goals[token]
-            else:
-                out.append(token)
+        # materialized its target pod count OR the goal itself has been
+        # lowered below the target (a later scale-down cancelled it —
+        # without this the phantom 'pending' node lives forever)
+        if self._goals:
+            cr = self._get_cr()
+            goal_replicas = {g["groupName"]: int(g.get("replicas", 0))
+                             for g in cr["spec"]["workerGroupSpecs"]}
+            for token, goal in list(self._goals.items()):
+                if per_group.get(goal["group"], 0) >= goal["target"] \
+                        or goal_replicas.get(goal["group"], 0) \
+                        < goal["target"]:
+                    del self._goals[token]
+                else:
+                    out.append(token)
         return out
 
     def node_type_of(self, node_id: str) -> Optional[str]:

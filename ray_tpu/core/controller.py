@@ -390,7 +390,11 @@ class Controller:
         key = data["key"]
         if data.get("overwrite", True) or key not in ns:
             ns[key] = data["value"]
-            self._p("kv_put", ns_name, key, data["value"])
+            # persist=False: ephemeral liveness keys (dashboard-agent
+            # heartbeats) must not append a WAL record per beat — they
+            # are rewritten every ~2s and meaningless after a restart
+            if data.get("persist", True):
+                self._p("kv_put", ns_name, key, data["value"])
             return True
         return False
 

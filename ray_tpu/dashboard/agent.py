@@ -90,6 +90,8 @@ class DashboardAgent:
                         "addr": self.address, "pid": os.getpid(),
                         "ts": time.time(),
                         "heartbeat_s": self.heartbeat_s}),
+                    # liveness beat, not durable state: no WAL record
+                    "persist": False,
                 }, timeout=5.0)
             except Exception:
                 pass    # controller restarting: keep trying

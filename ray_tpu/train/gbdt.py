@@ -12,9 +12,13 @@ EXACT, so N-worker training produces bit-identical trees to 1-worker
 training — then broadcasts the chosen splits.  Communication per tree
 level is `nodes x features x bins x 2` floats, independent of row count.
 
-Supported objectives: ``reg:squarederror`` and ``binary:logistic``
-(second-order boosting, xgboost-style gain with L2 ``lambda`` and
-``min_child_weight``).
+Supported objectives: ``reg:squarederror``, ``binary:logistic``,
+``multi:softprob`` / ``multi:softmax`` (K trees per round, one per
+class, softmax grad/hess — xgboost's multiclass scheme), and
+``rank:pairwise`` (LambdaRank-style pairwise gradients within query
+groups; shard boundaries snap to group boundaries so a group never
+splits across workers).  All second-order boosting, xgboost-style gain
+with L2 ``lambda`` and ``min_child_weight``.
 """
 
 from __future__ import annotations
@@ -92,29 +96,47 @@ class GBDTModel:
     """Fitted booster: bin edges + tree ensemble + base score."""
 
     def __init__(self, bin_edges: List[np.ndarray], objective: str,
-                 base_score: float, learning_rate: float):
+                 base_score: float, learning_rate: float,
+                 n_classes: int = 0):
         self.bin_edges = bin_edges
         self.objective = objective
         self.base_score = base_score
         self.learning_rate = learning_rate
+        self.n_classes = n_classes          # 0 for scalar objectives
         self.trees: List[_Tree] = []
+        self.tree_class: List[int] = []     # class each tree boosts
 
     def _bin(self, X: np.ndarray) -> np.ndarray:
         return _bin_matrix(X, self.bin_edges)
 
     def predict_margin(self, X: np.ndarray) -> np.ndarray:
+        """[n] for scalar objectives, [n, K] for multiclass."""
         X = np.asarray(X, dtype=np.float64)
         Xb = self._bin(X)
+        if self.n_classes:
+            margin = np.full((len(X), self.n_classes), self.base_score)
+            for tree, k in zip(self.trees, self.tree_class):
+                margin[:, k] += self.learning_rate \
+                    * tree.predict_bins(Xb)
+            return margin
         margin = np.full(len(X), self.base_score)
         for tree in self.trees:
             margin += self.learning_rate * tree.predict_bins(Xb)
         return margin
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        """Probabilities for binary:logistic, values for regression."""
+        """Probabilities for binary:logistic, class probabilities for
+        multi:softprob, class ids for multi:softmax, scores/values
+        otherwise."""
         margin = self.predict_margin(X)
         if self.objective == "binary:logistic":
             return 1.0 / (1.0 + np.exp(-margin))
+        if self.objective in ("multi:softprob", "multi:softmax"):
+            z = margin - margin.max(axis=1, keepdims=True)
+            p = np.exp(z)
+            p /= p.sum(axis=1, keepdims=True)
+            return np.argmax(p, axis=1) \
+                if self.objective == "multi:softmax" else p
         return margin
 
     def to_dict(self) -> Dict[str, Any]:
@@ -122,13 +144,18 @@ class GBDTModel:
                 "objective": self.objective,
                 "base_score": self.base_score,
                 "learning_rate": self.learning_rate,
+                "n_classes": self.n_classes,
+                "tree_class": list(self.tree_class),
                 "trees": [t.to_dict() for t in self.trees]}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "GBDTModel":
         m = cls([np.asarray(e) for e in d["bin_edges"]], d["objective"],
-                d["base_score"], d["learning_rate"])
+                d["base_score"], d["learning_rate"],
+                d.get("n_classes", 0))
         m.trees = [_Tree.from_dict(t) for t in d["trees"]]
+        m.tree_class = list(d.get("tree_class",
+                                  [0] * len(m.trees)))
         return m
 
 
@@ -140,13 +167,20 @@ class _GBDTShard:
 
     def __init__(self, X: np.ndarray, y: np.ndarray,
                  bin_edges: List[np.ndarray], objective: str,
-                 base_score: float):
+                 base_score: float, n_classes: int = 0,
+                 groups: Optional[np.ndarray] = None):
         self.y = np.asarray(y, dtype=np.float64)
         X = np.asarray(X, dtype=np.float64)
         self.Xb = _bin_matrix(X, bin_edges)
         self.n_features = X.shape[1]
         self.objective = objective
-        self.margin = np.full(len(self.y), base_score)
+        self.n_classes = n_classes
+        self.groups = None if groups is None else \
+            np.asarray(groups)
+        if n_classes:
+            self.margin = np.full((len(self.y), n_classes), base_score)
+        else:
+            self.margin = np.full(len(self.y), base_score)
         # node assignment of each row for the tree under construction
         self.node_of_row = np.zeros(len(self.y), dtype=np.int32)
         self.grad = np.zeros(len(self.y))
@@ -155,15 +189,45 @@ class _GBDTShard:
     def num_rows(self) -> int:
         return len(self.y)
 
-    def start_tree(self) -> None:
+    def _softmax(self) -> np.ndarray:
+        z = self.margin - self.margin.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        return p / p.sum(axis=1, keepdims=True)
+
+    def start_tree(self, class_k: int = 0) -> None:
         if self.objective == "binary:logistic":
             p = 1.0 / (1.0 + np.exp(-self.margin))
             self.grad = p - self.y
             self.hess = p * (1.0 - p)
+        elif self.objective in ("multi:softprob", "multi:softmax"):
+            # xgboost's multiclass scheme: one tree per class per
+            # round, softmax grad/hess for THIS class's margin column
+            pk = self._softmax()[:, class_k]
+            self.grad = pk - (self.y == class_k)
+            self.hess = np.maximum(pk * (1.0 - pk), 1e-16)
+        elif self.objective == "rank:pairwise":
+            self._rank_gradients()
         else:  # reg:squarederror
             self.grad = self.margin - self.y
             self.hess = np.ones(len(self.y))
         self.node_of_row[:] = 0
+
+    def _rank_gradients(self) -> None:
+        """LambdaRank-style pairwise grad/hess within query groups
+        (xgboost rank:pairwise): for each pair i≻j in a group,
+        rho = sigmoid(-(m_i - m_j)) pushes m_i up and m_j down."""
+        self.grad = np.zeros(len(self.y))
+        self.hess = np.zeros(len(self.y))
+        for gid in np.unique(self.groups):
+            rows = np.nonzero(self.groups == gid)[0]
+            m, rel = self.margin[rows], self.y[rows]
+            better = rel[:, None] > rel[None, :]         # i beats j
+            rho = 1.0 / (1.0 + np.exp(m[:, None] - m[None, :]))
+            rho = np.where(better, rho, 0.0)
+            hs = np.where(better, rho * (1.0 - rho), 0.0)
+            self.grad[rows] = -rho.sum(axis=1) + rho.sum(axis=0)
+            self.hess[rows] = hs.sum(axis=1) + hs.sum(axis=0)
+        self.hess = np.maximum(self.hess, 1e-16)
 
     def histograms(self, nodes: List[int]):
         """Per requested node: [features, bins] grad and hess sums."""
@@ -192,13 +256,17 @@ class _GBDTShard:
             self.node_of_row[rows[~go_left]] = right
 
     def finish_tree(self, leaf_values: Dict[int, float],
-                    learning_rate: float) -> None:
+                    learning_rate: float, class_k: int = 0) -> None:
         values = np.zeros(int(self.node_of_row.max()) + 1 if len(self.y)
                           else 1)
         for node, v in leaf_values.items():
             if node < len(values):
                 values[node] = v
-        self.margin += learning_rate * values[self.node_of_row]
+        delta = learning_rate * values[self.node_of_row]
+        if self.n_classes:
+            self.margin[:, class_k] += delta
+        else:
+            self.margin += delta
 
     def eval_metric(self):
         """(sum_metric, count) for the trainer's running train metric."""
@@ -207,19 +275,37 @@ class _GBDTShard:
                         1 - 1e-12)
             loss = -(self.y * np.log(p) + (1 - self.y) * np.log(1 - p))
             return float(loss.sum()), len(self.y)
+        if self.objective in ("multi:softprob", "multi:softmax"):
+            p = np.clip(self._softmax(), 1e-12, 1.0)
+            rows = np.arange(len(self.y))
+            loss = -np.log(p[rows, self.y.astype(int)])
+            return float(loss.sum()), len(self.y)
+        if self.objective == "rank:pairwise":
+            # pairwise error fraction: ordered pairs the model ranks
+            # the wrong way, summed per group
+            bad = total = 0
+            for gid in np.unique(self.groups):
+                rows = np.nonzero(self.groups == gid)[0]
+                m, rel = self.margin[rows], self.y[rows]
+                better = rel[:, None] > rel[None, :]
+                bad += int((better & (m[:, None] <= m[None, :])).sum())
+                total += int(better.sum())
+            return float(bad), max(total, 1)
         return float(((self.margin - self.y) ** 2).sum()), len(self.y)
 
 
 # -- trainer -----------------------------------------------------------------
 
 
-def _to_xy(dataset: Any, label: str):
+def _to_xy(dataset: Any, label: str, group: Optional[str] = None):
     import pandas as pd
     df = dataset.to_pandas() if hasattr(dataset, "to_pandas") else dataset
     assert isinstance(df, pd.DataFrame)
     y = df[label].to_numpy(dtype=np.float64)
-    X = df.drop(columns=[label]).to_numpy(dtype=np.float64)
-    return X, y
+    drop = [label] + ([group] if group else [])
+    X = df.drop(columns=drop).to_numpy(dtype=np.float64)
+    groups = None if group is None else df[group].to_numpy()
+    return X, y, groups
 
 
 class XGBoostTrainer:
@@ -234,7 +320,7 @@ class XGBoostTrainer:
 
     def __init__(self, *, params: Dict[str, Any], num_boost_round: int,
                  datasets: Dict[str, Any], label_column: str,
-                 num_workers: int = 2,
+                 num_workers: int = 2, group_column: Optional[str] = None,
                  scaling_config: Optional[Any] = None):
         if "train" not in datasets:
             raise ValueError("datasets must contain a 'train' split")
@@ -242,6 +328,9 @@ class XGBoostTrainer:
         self.num_boost_round = num_boost_round
         self.datasets = datasets
         self.label_column = label_column
+        # rank:pairwise query groups (xgboost's DMatrix.set_group,
+        # expressed as a per-row column like the label)
+        self.group_column = group_column
         if scaling_config is not None and \
                 getattr(scaling_config, "num_workers", None):
             num_workers = scaling_config.num_workers
@@ -258,15 +347,28 @@ class XGBoostTrainer:
         from .. import api
 
         objective = self._p("objective", default="reg:squarederror")
-        if objective not in ("reg:squarederror", "binary:logistic"):
-            raise ValueError(f"unsupported objective {objective!r}")
+        supported = ("reg:squarederror", "binary:logistic",
+                     "multi:softprob", "multi:softmax", "rank:pairwise")
+        if objective not in supported:
+            raise ValueError(f"unsupported objective {objective!r} "
+                             f"(supported: {supported})")
+        n_classes = 0
+        if objective.startswith("multi:"):
+            n_classes = int(self._p("num_class", default=0))
+            if n_classes < 2:
+                raise ValueError("multi:* objectives need params"
+                                 "['num_class'] >= 2")
+        if objective == "rank:pairwise" and self.group_column is None:
+            raise ValueError("rank:pairwise needs group_column (the "
+                             "per-row query-group id)")
         lr = float(self._p("eta", "learning_rate", default=0.3))
         max_depth = int(self._p("max_depth", default=6))
         lam = float(self._p("lambda", "reg_lambda", default=1.0))
         gamma = float(self._p("gamma", default=0.0))
         min_child_weight = float(self._p("min_child_weight", default=1.0))
 
-        X, y = _to_xy(self.datasets["train"], self.label_column)
+        X, y, groups = _to_xy(self.datasets["train"], self.label_column,
+                              self.group_column)
         n, n_features = X.shape
 
         # global quantile bin edges (shared by every worker and the model)
@@ -274,21 +376,47 @@ class XGBoostTrainer:
         for j in range(n_features):
             qs = np.quantile(X[:, j], np.linspace(0, 1, MAX_BINS)[1:])
             bin_edges.append(np.unique(qs))
-        base_score = float(np.mean(y)) if objective == "reg:squarederror" \
-            else float(np.log(np.clip(np.mean(y), 1e-6, 1 - 1e-6)
-                              / np.clip(1 - np.mean(y), 1e-6, 1)))
+        if objective == "reg:squarederror":
+            base_score = float(np.mean(y))
+        elif objective == "binary:logistic":
+            base_score = float(np.log(np.clip(np.mean(y), 1e-6, 1 - 1e-6)
+                                      / np.clip(1 - np.mean(y), 1e-6, 1)))
+        else:   # multiclass margins / rank scores start at zero
+            base_score = 0.0
 
         ShardActor = api.remote(_GBDTShard)
         k = min(self.num_workers, n) or 1
         bounds = np.linspace(0, n, k + 1).astype(int)
-        shards = [ShardActor.remote(X[lo:hi], y[lo:hi], bin_edges,
-                                    objective, base_score)
-                  for lo, hi in zip(bounds[:-1], bounds[1:])]
+        if groups is not None:
+            # a query group must live whole on one shard (pairwise
+            # gradients are within-group) — snap bounds forward to the
+            # next group boundary.  Snapping assumes each group is one
+            # contiguous run of rows; a shuffled frame would silently
+            # split groups and drop their cross-shard pairs, so reject
+            # it loudly.
+            run_starts = 1 + int((np.asarray(groups[1:])
+                                  != np.asarray(groups[:-1])).sum())
+            if run_starts != len(np.unique(groups)):
+                raise ValueError(
+                    "rank:pairwise needs rows sorted so each query "
+                    "group is contiguous (sort by the group column "
+                    "first); found interleaved group ids")
+            bounds = np.array(
+                [0] + [self._snap_to_group(b, groups)
+                       for b in bounds[1:-1]] + [n])
+        shards = [ShardActor.remote(
+            X[lo:hi], y[lo:hi], bin_edges, objective, base_score,
+            n_classes, None if groups is None else groups[lo:hi])
+            for lo, hi in zip(bounds[:-1], bounds[1:])]
 
-        model = GBDTModel(bin_edges, objective, base_score, lr)
+        model = GBDTModel(bin_edges, objective, base_score, lr,
+                          n_classes)
         metrics: Dict[str, Any] = {}
-        metric_name = "rmse" if objective == "reg:squarederror" \
-            else "logloss"
+        metric_name = {"reg:squarederror": "rmse",
+                       "binary:logistic": "logloss",
+                       "multi:softprob": "mlogloss",
+                       "multi:softmax": "mlogloss",
+                       "rank:pairwise": "pairwise-error"}[objective]
 
         try:
             self._boost(api, shards, model, metrics, metric_name,
@@ -303,62 +431,80 @@ class XGBoostTrainer:
                                      "label_column": self.label_column})
         return Result(metrics=metrics, checkpoint=ckpt)
 
+    @staticmethod
+    def _snap_to_group(b: int, groups: np.ndarray) -> int:
+        n = len(groups)
+        while 0 < b < n and groups[b] == groups[b - 1]:
+            b += 1
+        return b
+
     def _boost(self, api, shards, model, metrics, metric_name,
                max_depth, lam, gamma, min_child_weight, lr):
+        trees_per_round = model.n_classes or 1
         for _ in range(self.num_boost_round):
-            api.get([s.start_tree.remote() for s in shards], timeout=300.0)
-            tree = _Tree()
-            root = tree.add_node()
-            # node -> (sum_grad, sum_hess), computed from merged histograms
-            frontier = [root]
-            depth = 0
-            while frontier and depth < max_depth:
-                hists = api.get(
-                    [s.histograms.remote(frontier) for s in shards],
-                    timeout=300.0)
-                merged = {}
-                for node in frontier:
-                    g = sum(h[node][0] for h in hists)
-                    h_ = sum(h[node][1] for h in hists)
-                    merged[node] = (g, h_)
-                splits: Dict[int, tuple] = {}
-                next_frontier: List[int] = []
-                for node, (g, h_) in merged.items():
-                    best = self._best_split(g, h_, lam, gamma,
-                                            min_child_weight)
-                    if best is None:
-                        continue
-                    feat, thr, _gain = best
-                    left = tree.add_node()
-                    right = tree.add_node()
-                    tree.feature[node] = feat
-                    tree.threshold_bin[node] = thr
-                    tree.left[node] = left
-                    tree.right[node] = right
-                    splits[node] = (feat, thr, left, right)
-                    next_frontier += [left, right]
-                if splits:
-                    api.get([s.apply_splits.remote(splits) for s in shards],
-                            timeout=300.0)
-                frontier = next_frontier
-                depth += 1
-            # leaf values from the final frontier histograms
-            leaves = [i for i in range(len(tree.feature))
-                      if tree.feature[i] < 0]
-            hists = api.get([s.histograms.remote(leaves) for s in shards],
-                            timeout=300.0)
-            leaf_values: Dict[int, float] = {}
-            for node in leaves:
-                g = sum(float(h[node][0][0].sum()) for h in hists)
-                h_ = sum(float(h[node][1][0].sum()) for h in hists)
-                v = -g / (h_ + lam) if (h_ + lam) > 0 else 0.0
-                tree.value[node] = v
-                leaf_values[node] = v
-            api.get([s.finish_tree.remote(leaf_values, lr)
-                     for s in shards], timeout=300.0)
-            model.trees.append(tree)
+            for class_k in range(trees_per_round):
+                self._boost_one_tree(api, shards, model, max_depth, lam,
+                                     gamma, min_child_weight, lr,
+                                     class_k)
+        self._final_metrics(api, shards, model, metrics, metric_name)
 
-        # final metrics
+    def _boost_one_tree(self, api, shards, model, max_depth, lam, gamma,
+                        min_child_weight, lr, class_k):
+        api.get([s.start_tree.remote(class_k) for s in shards],
+                timeout=300.0)
+        tree = _Tree()
+        root = tree.add_node()
+        # node -> (sum_grad, sum_hess), computed from merged histograms
+        frontier = [root]
+        depth = 0
+        while frontier and depth < max_depth:
+            hists = api.get(
+                [s.histograms.remote(frontier) for s in shards],
+                timeout=300.0)
+            merged = {}
+            for node in frontier:
+                g = sum(h[node][0] for h in hists)
+                h_ = sum(h[node][1] for h in hists)
+                merged[node] = (g, h_)
+            splits: Dict[int, tuple] = {}
+            next_frontier: List[int] = []
+            for node, (g, h_) in merged.items():
+                best = self._best_split(g, h_, lam, gamma,
+                                        min_child_weight)
+                if best is None:
+                    continue
+                feat, thr, _gain = best
+                left = tree.add_node()
+                right = tree.add_node()
+                tree.feature[node] = feat
+                tree.threshold_bin[node] = thr
+                tree.left[node] = left
+                tree.right[node] = right
+                splits[node] = (feat, thr, left, right)
+                next_frontier += [left, right]
+            if splits:
+                api.get([s.apply_splits.remote(splits) for s in shards],
+                        timeout=300.0)
+            frontier = next_frontier
+            depth += 1
+        # leaf values from the final frontier histograms
+        leaves = [i for i in range(len(tree.feature))
+                  if tree.feature[i] < 0]
+        hists = api.get([s.histograms.remote(leaves) for s in shards],
+                        timeout=300.0)
+        leaf_values: Dict[int, float] = {}
+        for node in leaves:
+            g = sum(float(h[node][0][0].sum()) for h in hists)
+            h_ = sum(float(h[node][1][0].sum()) for h in hists)
+            v = -g / (h_ + lam) if (h_ + lam) > 0 else 0.0
+            tree.value[node] = v
+            leaf_values[node] = v
+        api.get([s.finish_tree.remote(leaf_values, lr, class_k)
+                 for s in shards], timeout=300.0)
+        model.trees.append(tree)
+        model.tree_class.append(class_k)
+
+    def _final_metrics(self, api, shards, model, metrics, metric_name):
         parts = api.get([s.eval_metric.remote() for s in shards],
                         timeout=300.0)
         total, count = (sum(p[0] for p in parts), sum(p[1] for p in parts))
@@ -368,11 +514,30 @@ class XGBoostTrainer:
         for name, ds in self.datasets.items():
             if name == "train":
                 continue
-            Xv, yv = _to_xy(ds, self.label_column)
+            Xv, yv, gv = _to_xy(ds, self.label_column,
+                                self.group_column)
             margin = model.predict_margin(Xv)
             if metric_name == "rmse":
                 metrics[f"{name}-rmse"] = float(
                     np.sqrt(np.mean((margin - yv) ** 2)))
+            elif metric_name == "mlogloss":
+                z = margin - margin.max(axis=1, keepdims=True)
+                p = np.exp(z)
+                p = np.clip(p / p.sum(axis=1, keepdims=True), 1e-12,
+                            1.0)
+                rows = np.arange(len(yv))
+                metrics[f"{name}-mlogloss"] = float(
+                    -np.mean(np.log(p[rows, yv.astype(int)])))
+            elif metric_name == "pairwise-error":
+                bad = tot = 0
+                for gid in np.unique(gv):
+                    rows = np.nonzero(gv == gid)[0]
+                    m, rel = margin[rows], yv[rows]
+                    better = rel[:, None] > rel[None, :]
+                    bad += int((better
+                                & (m[:, None] <= m[None, :])).sum())
+                    tot += int(better.sum())
+                metrics[f"{name}-pairwise-error"] = bad / max(tot, 1)
             else:
                 p = np.clip(1 / (1 + np.exp(-margin)), 1e-12, 1 - 1e-12)
                 metrics[f"{name}-logloss"] = float(-np.mean(

@@ -37,5 +37,6 @@ from .search import (  # noqa: F401
     Searcher,
     TPESearch,
 )
+from .search_ext import AxSearch, HyperOptSearch  # noqa: F401
 from .trial import Trial  # noqa: F401
 from .tuner import TuneConfig, Tuner, run, with_parameters  # noqa: F401

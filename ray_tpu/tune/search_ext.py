@@ -1,0 +1,173 @@
+"""Optional-library searcher adapters: HyperOpt and Ax.
+
+Capability mirror of the reference's adapter zoo
+(/root/reference/python/ray/tune/search/hyperopt/hyperopt_search.py:1 —
+TPE over a hyperopt space driven through hyperopt's Trials/Domain
+internals; /root/reference/python/ray/tune/search/ax/ax_search.py:1 —
+Bayesian optimization through AxClient's ask/tell).  Same shape as the
+in-tree OptunaSearch (search.py): translate this framework's `Domain`
+objects into the library's space language, ask per trial_id, tell on
+completion.  Both libraries are OPTIONAL — constructors raise a clear
+ImportError when absent, and the tests drive the adapters through
+stub modules implementing exactly this documented call surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .search import Searcher, _split_spec
+
+
+class HyperOptSearch(Searcher):
+    """TPE suggestions via hyperopt (reference: hyperopt_search.py).
+
+    Drives hyperopt the way the reference does — an own ``Trials``
+    ledger, ``tpe.suggest`` for new points, trial docs completed with
+    ``{"loss": ..., "status": STATUS_OK}`` — rather than ``fmin``,
+    which would invert control.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", seed: Optional[int] = 0,
+                 n_startup: int = 8):
+        super().__init__(metric=metric, mode=mode)
+        try:
+            import hyperopt as hpo
+        except ImportError as e:
+            raise ImportError(
+                "HyperOptSearch requires the hyperopt package "
+                "(pip install hyperopt)") from e
+        self._hpo = hpo
+        grids, self.domains, self.consts = _split_spec(param_space)
+        if grids:
+            raise ValueError("HyperOptSearch does not combine with "
+                             "grid_search; use BasicVariantGenerator")
+        space = {k: self._to_hp(k, dom)
+                 for k, dom in self.domains.items()}
+        self._domain = hpo.Domain(lambda spc: spc, space)
+        self._trials = hpo.Trials()
+        self._rstate = np.random.default_rng(seed)
+        import functools
+        self._algo = functools.partial(hpo.tpe.suggest,
+                                       n_startup_jobs=n_startup)
+        self._open: Dict[str, Any] = {}   # trial_id -> hyperopt tid
+
+    def _to_hp(self, name: str, dom) -> Any:
+        from .sample import (Categorical, LogUniform, Normal, Randint,
+                             Uniform)
+        hp = self._hpo.hp
+        if isinstance(dom, Categorical):
+            return hp.choice(name, dom.categories)
+        if isinstance(dom, LogUniform):
+            return hp.loguniform(name, float(np.log(dom.low)),
+                                 float(np.log(dom.high)))
+        if isinstance(dom, Uniform):
+            return hp.uniform(name, dom.low, dom.high)
+        if isinstance(dom, Randint):
+            return hp.randint(name, dom.low, dom.high)
+        if isinstance(dom, Normal):
+            return hp.normal(name, dom.mean, dom.sd)
+        raise ValueError(f"unsupported domain for {name!r}: {dom!r}")
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        from .sample import Categorical
+        new_ids = self._trials.new_trial_ids(1)
+        self._trials.refresh()
+        docs = self._algo(new_ids, self._domain, self._trials,
+                          self._rstate.integers(2 ** 31 - 1))
+        self._trials.insert_trial_docs(docs)
+        self._trials.refresh()
+        doc = docs[0]
+        self._open[trial_id] = doc["tid"]
+        vals = {k: v[0] for k, v in doc["misc"]["vals"].items() if v}
+        cfg = dict(self.consts)
+        for k, dom in self.domains.items():
+            v = vals[k]
+            # hp.choice yields an INDEX into the category list
+            cfg[k] = dom.categories[int(v)] \
+                if isinstance(dom, Categorical) else v
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        hpo = self._hpo
+        tid = self._open.pop(trial_id, None)
+        if tid is None:
+            return
+        for doc in self._trials.trials:
+            if doc["tid"] != tid:
+                continue
+            if error or not result or self.metric not in result:
+                doc["state"] = hpo.JOB_STATE_ERROR
+                doc["result"] = {"status": hpo.STATUS_FAIL}
+            else:
+                value = float(result[self.metric])
+                loss = -value if self.mode == "max" else value
+                doc["state"] = hpo.JOB_STATE_DONE
+                doc["result"] = {"loss": loss,
+                                 "status": hpo.STATUS_OK}
+            break
+        self._trials.refresh()
+
+
+class AxSearch(Searcher):
+    """Bayesian optimization via Ax's service API (reference:
+    ax_search.py — AxClient.create_experiment / get_next_trial /
+    complete_trial)."""
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", seed: Optional[int] = 0):
+        super().__init__(metric=metric, mode=mode)
+        try:
+            from ax.service.ax_client import AxClient
+        except ImportError as e:
+            raise ImportError(
+                "AxSearch requires the ax-platform package "
+                "(pip install ax-platform)") from e
+        grids, self.domains, self.consts = _split_spec(param_space)
+        if grids:
+            raise ValueError("AxSearch does not combine with "
+                             "grid_search; use BasicVariantGenerator")
+        self._ax = AxClient(random_seed=seed, verbose_logging=False)
+        self._ax.create_experiment(
+            parameters=[self._to_ax(k, dom)
+                        for k, dom in self.domains.items()],
+            objective_name=metric,
+            minimize=(mode == "min"))
+        self._open: Dict[str, int] = {}   # trial_id -> ax trial index
+
+    @staticmethod
+    def _to_ax(name: str, dom) -> Dict[str, Any]:
+        from .sample import (Categorical, LogUniform, Randint, Uniform)
+        if isinstance(dom, Categorical):
+            return {"name": name, "type": "choice",
+                    "values": list(dom.categories)}
+        if isinstance(dom, LogUniform):
+            return {"name": name, "type": "range",
+                    "bounds": [float(dom.low), float(dom.high)],
+                    "log_scale": True}
+        if isinstance(dom, Uniform):
+            return {"name": name, "type": "range",
+                    "bounds": [float(dom.low), float(dom.high)]}
+        if isinstance(dom, Randint):
+            return {"name": name, "type": "range",
+                    "bounds": [int(dom.low), int(dom.high) - 1],
+                    "value_type": "int"}
+        raise ValueError(f"unsupported domain for {name!r}: {dom!r}")
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        params, index = self._ax.get_next_trial()
+        self._open[trial_id] = index
+        return {**self.consts, **params}
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        index = self._open.pop(trial_id, None)
+        if index is None:
+            return
+        if error or not result or self.metric not in result:
+            self._ax.log_trial_failure(index)
+            return
+        self._ax.complete_trial(index, raw_data={
+            self.metric: (float(result[self.metric]), 0.0)})

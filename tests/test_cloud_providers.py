@@ -4,8 +4,6 @@ _private/kuberay/node_provider.py; no cloud SDK in this image, so the
 client surfaces are injected — the same strategy as the gcloud-CLI
 fakes in test_tpu_pod_provider.py)."""
 
-import base64
-
 import pytest
 
 from ray_tpu.autoscaler import AwsProvider, KubeRayProvider
@@ -27,9 +25,11 @@ class FakeEC2:
         iid = f"i-{self._seq:08x}"
         tags = {t["Key"]: t["Value"]
                 for t in kw["TagSpecifications"][0]["Tags"]}
+        # boto3 accepts the RAW script and base64s it on the wire; a
+        # client-shaped fake therefore sees the plain text
+        assert kw["UserData"].startswith("#!/bin/bash"), kw["UserData"]
         self.instances[iid] = {"state": "pending", "tags": tags,
-                               "user_data": base64.b64decode(
-                                   kw["UserData"]).decode()}
+                               "user_data": kw["UserData"]}
         return {"Instances": [{"InstanceId": iid}]}
 
     def terminate_instances(self, InstanceIds):
@@ -247,3 +247,15 @@ def test_autoscaler_drives_fake_aws(aws):
     for nid in list(p.non_terminated_nodes()):
         p.terminate_node(nid)
     assert p.non_terminated_nodes() == []
+
+
+def test_kuberay_cancelled_goal_retires(kuberay):
+    """A goal token whose target was cancelled by a later scale-down
+    must retire instead of haunting non_terminated_nodes forever."""
+    k8s, p = kuberay
+    (pod,) = p.non_terminated_nodes()
+    token = p.create_node("cpu-group")           # goal: 2 replicas
+    p.terminate_node(pod)                        # goal back to 1
+    k8s.reconcile()
+    nodes = p.non_terminated_nodes()
+    assert token not in nodes, nodes

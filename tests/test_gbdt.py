@@ -112,3 +112,122 @@ def test_gbdt_more_workers_same_model(cluster):
         preds.append(model.predict(
             df.drop(columns=["target"]).to_numpy()))
     np.testing.assert_allclose(preds[0], preds[1], rtol=1e-5, atol=1e-6)
+
+
+def _multiclass_frame(n=1500, seed=2):
+    import pandas as pd
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    score = np.stack([1.5 * X[:, 0] - X[:, 1],
+                      X[:, 1] + X[:, 2],
+                      -X[:, 0] + 0.5 * X[:, 3]], axis=1)
+    y = np.argmax(score + 0.2 * rng.normal(size=score.shape), axis=1)
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(4)])
+    df["target"] = y.astype(np.float64)
+    return df
+
+
+def _ranking_frame(n_groups=120, group_size=8, seed=3):
+    import pandas as pd
+    rng = np.random.default_rng(seed)
+    n = n_groups * group_size
+    X = rng.normal(size=(n, 4))
+    rel = 2.0 * X[:, 0] + X[:, 1] + 0.2 * rng.normal(size=n)
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(4)])
+    df["rel"] = np.floor(
+        3 * (rel - rel.min()) / (np.ptp(rel) + 1e-9)).clip(0, 2)
+    df["qid"] = np.repeat(np.arange(n_groups), group_size)
+    return df
+
+
+def test_multiclass_softprob_learns_and_roundtrips(cluster):
+    df = _multiclass_frame()
+    train, valid = df.iloc[:1200], df.iloc[1200:]
+    trainer = XGBoostTrainer(
+        params={"objective": "multi:softprob", "num_class": 3,
+                "eta": 0.3, "max_depth": 4},
+        num_boost_round=12, num_workers=2,
+        datasets={"train": rdata.from_pandas([train]),
+                  "valid": rdata.from_pandas([valid])},
+        label_column="target")
+    result = trainer.fit()
+    assert result.metrics["valid-mlogloss"] < 0.55, result.metrics
+    model = XGBoostTrainer.load_model(result.checkpoint)
+    Xv = valid.drop(columns=["target"]).to_numpy()
+    probs = model.predict(Xv)
+    assert probs.shape == (len(valid), 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-9)
+    acc = float(np.mean(np.argmax(probs, axis=1)
+                        == valid["target"].to_numpy()))
+    assert acc > 0.8, acc
+    # K trees per round, tagged per class
+    assert len(model.trees) == 36
+    assert model.tree_class[:3] == [0, 1, 2]
+
+
+def test_multiclass_nworker_parity(cluster):
+    """The exact-histogram-sum property must hold per class: 1-worker
+    and 3-worker training produce the same multiclass ensembles (up to
+    fp summation order, the bar test_gbdt_more_workers_same_model
+    sets)."""
+    df = _multiclass_frame(n=900)
+    common = dict(params={"objective": "multi:softmax", "num_class": 3,
+                          "eta": 0.4, "max_depth": 3},
+                  num_boost_round=6, label_column="target")
+    preds = []
+    for workers in (1, 3):
+        trainer = XGBoostTrainer(
+            datasets={"train": rdata.from_pandas([df])},
+            num_workers=workers, **common)
+        model = XGBoostTrainer.load_model(trainer.fit().checkpoint)
+        preds.append(model.predict_margin(
+            df.drop(columns=["target"]).to_numpy()))
+    np.testing.assert_allclose(preds[0], preds[1], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_rank_pairwise_orders_groups(cluster):
+    df = _ranking_frame()
+    train, valid = df.iloc[:800], df.iloc[800:]
+    trainer = XGBoostTrainer(
+        params={"objective": "rank:pairwise", "eta": 0.3,
+                "max_depth": 4},
+        num_boost_round=15, num_workers=2, group_column="qid",
+        datasets={"train": rdata.from_pandas([train]),
+                  "valid": rdata.from_pandas([valid])},
+        label_column="rel")
+    result = trainer.fit()
+    # well under the 0.5 coin-flip pairwise error
+    assert result.metrics["train-pairwise-error"] < 0.2, result.metrics
+    assert result.metrics["valid-pairwise-error"] < 0.3, result.metrics
+
+
+def test_rank_requires_group_column(cluster):
+    df = _ranking_frame(n_groups=4)
+    trainer = XGBoostTrainer(
+        params={"objective": "rank:pairwise"}, num_boost_round=2,
+        datasets={"train": rdata.from_pandas([df])}, label_column="rel")
+    with pytest.raises(ValueError, match="group_column"):
+        trainer.fit()
+
+
+def test_multiclass_requires_num_class(cluster):
+    df = _multiclass_frame(n=100)
+    trainer = XGBoostTrainer(
+        params={"objective": "multi:softprob"}, num_boost_round=2,
+        datasets={"train": rdata.from_pandas([df])},
+        label_column="target")
+    with pytest.raises(ValueError, match="num_class"):
+        trainer.fit()
+
+
+def test_rank_rejects_interleaved_groups(cluster):
+    df = _ranking_frame(n_groups=6)
+    shuffled = df.sample(frac=1.0, random_state=0)
+    trainer = XGBoostTrainer(
+        params={"objective": "rank:pairwise"}, num_boost_round=2,
+        group_column="qid",
+        datasets={"train": rdata.from_pandas([shuffled])},
+        label_column="rel")
+    with pytest.raises(ValueError, match="contiguous"):
+        trainer.fit()

@@ -1,0 +1,249 @@
+"""HyperOpt/Ax searcher adapters driven through stub modules that
+implement exactly the documented library surface the adapters call
+(reference capability: tune/search/hyperopt + tune/search/ax; neither
+library ships in this image, so the stubs play the recorded-response
+role the cloud-provider fakes do).  Real-library behavior is covered by
+skip-if-absent tests that run wherever the packages exist."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from ray_tpu import tune
+
+
+# -- stub hyperopt ------------------------------------------------------------
+
+def _install_hyperopt_stub(monkeypatch):
+    mod = types.ModuleType("hyperopt")
+
+    class _Spec:
+        def __init__(self, kind, name, args):
+            self.kind, self.name, self.args = kind, name, args
+
+    hp = types.SimpleNamespace(
+        choice=lambda n, values: _Spec("choice", n, values),
+        uniform=lambda n, lo, hi: _Spec("uniform", n, (lo, hi)),
+        loguniform=lambda n, lo, hi: _Spec("loguniform", n, (lo, hi)),
+        randint=lambda n, lo, hi: _Spec("randint", n, (lo, hi)),
+        normal=lambda n, mu, sd: _Spec("normal", n, (mu, sd)),
+    )
+
+    class Trials:
+        def __init__(self):
+            self._docs = []
+            self._next = 0
+
+        def new_trial_ids(self, n):
+            ids = list(range(self._next, self._next + n))
+            self._next += n
+            return ids
+
+        def insert_trial_docs(self, docs):
+            self._docs.extend(docs)
+
+        def refresh(self):
+            pass
+
+        @property
+        def trials(self):
+            return self._docs
+
+    class Domain:
+        def __init__(self, fn, space):
+            self.space = space
+
+    def tpe_suggest(new_ids, domain, trials, seed, n_startup_jobs=20):
+        rng = np.random.default_rng(int(seed))
+        docs = []
+        for tid in new_ids:
+            vals = {}
+            for name, spec in domain.space.items():
+                if spec.kind == "choice":
+                    v = int(rng.integers(len(spec.args)))
+                elif spec.kind == "uniform":
+                    v = float(rng.uniform(*spec.args))
+                elif spec.kind == "loguniform":
+                    v = float(np.exp(rng.uniform(*spec.args)))
+                elif spec.kind == "randint":
+                    v = int(rng.integers(*spec.args))
+                else:
+                    v = float(rng.normal(*spec.args))
+                vals[name] = [v]
+            docs.append({"tid": tid, "state": 0,
+                         "misc": {"vals": vals}, "result": {}})
+        return docs
+
+    mod.hp = hp
+    mod.Trials = Trials
+    mod.Domain = Domain
+    mod.tpe = types.SimpleNamespace(suggest=tpe_suggest)
+    mod.JOB_STATE_DONE = 2
+    mod.JOB_STATE_ERROR = 3
+    mod.STATUS_OK = "ok"
+    mod.STATUS_FAIL = "fail"
+    monkeypatch.setitem(sys.modules, "hyperopt", mod)
+    return mod
+
+
+# -- stub ax ------------------------------------------------------------------
+
+def _install_ax_stub(monkeypatch):
+    class AxClient:
+        def __init__(self, random_seed=None, verbose_logging=True):
+            self.rng = np.random.default_rng(random_seed or 0)
+            self.experiment = None
+            self.completed = {}
+            self.failed = set()
+            self._next = 0
+
+        def create_experiment(self, *, parameters, objective_name,
+                              minimize):
+            self.experiment = {"parameters": parameters,
+                               "objective_name": objective_name,
+                               "minimize": minimize}
+
+        def get_next_trial(self):
+            params = {}
+            for p in self.experiment["parameters"]:
+                if p["type"] == "choice":
+                    params[p["name"]] = p["values"][
+                        int(self.rng.integers(len(p["values"])))]
+                else:
+                    lo, hi = p["bounds"]
+                    v = self.rng.uniform(lo, hi)
+                    if p.get("value_type") == "int":
+                        v = int(round(v))
+                    params[p["name"]] = v
+            idx = self._next
+            self._next += 1
+            return params, idx
+
+        def complete_trial(self, index, raw_data):
+            self.completed[index] = raw_data
+
+        def log_trial_failure(self, index):
+            self.failed.add(index)
+
+    ax = types.ModuleType("ax")
+    service = types.ModuleType("ax.service")
+    ax_client = types.ModuleType("ax.service.ax_client")
+    ax_client.AxClient = AxClient
+    ax.service = service
+    service.ax_client = ax_client
+    monkeypatch.setitem(sys.modules, "ax", ax)
+    monkeypatch.setitem(sys.modules, "ax.service", service)
+    monkeypatch.setitem(sys.modules, "ax.service.ax_client", ax_client)
+    return ax_client
+
+
+# -- hyperopt adapter ---------------------------------------------------------
+
+def test_hyperopt_suggest_and_complete(monkeypatch):
+    hpo = _install_hyperopt_stub(monkeypatch)
+    s = tune.HyperOptSearch(
+        {"lr": tune.loguniform(1e-4, 1e-1),
+         "act": tune.choice(["relu", "tanh"]),
+         "layers": tune.randint(1, 5),
+         "c": 42},
+        metric="score", mode="max", seed=0)
+    cfg = s.suggest("t1")
+    assert 1e-4 <= cfg["lr"] <= 1e-1
+    assert cfg["act"] in ("relu", "tanh")     # index decoded to value
+    assert 1 <= cfg["layers"] < 5
+    assert cfg["c"] == 42
+    s.on_trial_complete("t1", {"score": 3.5})
+    doc = s._trials.trials[0]
+    assert doc["state"] == hpo.JOB_STATE_DONE
+    assert doc["result"]["loss"] == -3.5      # max -> negated loss
+    # error path marks the doc failed
+    s.suggest("t2")
+    s.on_trial_complete("t2", error=True)
+    assert s._trials.trials[1]["state"] == hpo.JOB_STATE_ERROR
+    # unknown trial id is a no-op
+    s.on_trial_complete("nope", {"score": 1.0})
+
+
+def test_hyperopt_observations_accumulate(monkeypatch):
+    _install_hyperopt_stub(monkeypatch)
+    s = tune.HyperOptSearch({"x": tune.uniform(0, 1)},
+                            metric="loss", mode="min", seed=1)
+    for i in range(5):
+        s.suggest(f"t{i}")
+        s.on_trial_complete(f"t{i}", {"loss": float(i)})
+    assert len(s._trials.trials) == 5
+    assert all(d["result"]["loss"] == float(i)
+               for i, d in enumerate(s._trials.trials))
+
+
+def test_hyperopt_rejects_grid(monkeypatch):
+    _install_hyperopt_stub(monkeypatch)
+    with pytest.raises(ValueError, match="grid_search"):
+        tune.HyperOptSearch({"x": tune.grid_search([1, 2])},
+                            metric="m")
+
+
+def test_hyperopt_missing_library_message():
+    assert "hyperopt" not in sys.modules
+    with pytest.raises(ImportError, match="hyperopt"):
+        tune.HyperOptSearch({"x": tune.uniform(0, 1)}, metric="m")
+
+
+# -- ax adapter ---------------------------------------------------------------
+
+def test_ax_suggest_and_complete(monkeypatch):
+    _install_ax_stub(monkeypatch)
+    s = tune.AxSearch({"lr": tune.loguniform(1e-4, 1e-1),
+                       "opt": tune.choice(["adam", "sgd"]),
+                       "n": tune.randint(1, 9), "const": "k"},
+                      metric="acc", mode="max", seed=0)
+    exp = s._ax.experiment
+    assert exp["minimize"] is False
+    assert exp["objective_name"] == "acc"
+    by_name = {p["name"]: p for p in exp["parameters"]}
+    assert by_name["lr"]["log_scale"] is True
+    assert by_name["n"] == {"name": "n", "type": "range",
+                            "bounds": [1, 8], "value_type": "int"}
+    cfg = s.suggest("t1")
+    assert cfg["const"] == "k" and cfg["opt"] in ("adam", "sgd")
+    s.on_trial_complete("t1", {"acc": 0.9})
+    assert s._ax.completed[0] == {"acc": (0.9, 0.0)}
+    s.suggest("t2")
+    s.on_trial_complete("t2", error=True)
+    assert 1 in s._ax.failed
+
+
+def test_ax_missing_library_message():
+    assert "ax" not in sys.modules
+    with pytest.raises(ImportError, match="ax-platform"):
+        tune.AxSearch({"x": tune.uniform(0, 1)}, metric="m")
+
+
+# -- end to end through the Tuner --------------------------------------------
+
+def test_hyperopt_drives_tuner(monkeypatch):
+    _install_hyperopt_stub(monkeypatch)
+
+    def trainable(config):
+        from ray_tpu.air import session
+        session.report({"loss": (config["x"] - 0.3) ** 2})
+
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        searcher = tune.HyperOptSearch({"x": tune.uniform(0, 1)},
+                                       metric="loss", mode="min",
+                                       seed=0)
+        tuner = tune.Tuner(
+            trainable,
+            tune_config=tune.TuneConfig(search_alg=searcher,
+                                        num_samples=6, metric="loss",
+                                        mode="min"))
+        results = tuner.fit()
+        best = results.get_best_result()
+        assert best.metrics["loss"] < 0.5
+        assert len(searcher._trials.trials) == 6
+    finally:
+        ray_tpu.shutdown()
