@@ -31,6 +31,12 @@ import jax                                                     # noqa: E402
 import jax.numpy as jnp                                        # noqa: E402
 import numpy as np                                             # noqa: E402
 
+# sitecustomize registered the axon TPU plugin at interpreter start from
+# the AMBIENT env (before this file's os.environ writes ran) — the
+# config pin, not the env var, is what keeps backend discovery off the
+# tunnelled chip (cf. tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
 
 def _time(fn, *args, iters=5):
     out = fn(*args)

@@ -243,6 +243,14 @@ class Checkpoint:
         if os.path.exists(fp):
             with open(fp, "rb") as f:
                 return cloudpickle.loads(f.read())
+        # a dict checkpoint persisted to a directory (CheckpointManager)
+        # carries the key inside checkpoint.pkl, not as a sidecar
+        dp = os.path.join(self._path, _DICT_FILE)
+        if os.path.exists(dp):
+            with open(dp, "rb") as f:
+                blob = pickle.load(f).get(self._PREPROCESSOR_KEY)
+            if blob is not None:
+                return cloudpickle.loads(blob)
         return None
 
     @property

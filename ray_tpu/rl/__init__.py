@@ -18,6 +18,7 @@ from .env import (  # noqa: F401
     JaxEnv,
     MemoryCue,
     Pendulum,
+    PixelPong,
 )
 from .es import ES, ESConfig  # noqa: F401
 from .impala import Impala, ImpalaConfig  # noqa: F401
@@ -28,6 +29,8 @@ from .offline import (  # noqa: F401
     BCConfig,
     CQL,
     CQLConfig,
+    MARWIL,
+    MARWILConfig,
     collect_dataset,
     importance_sampling_estimate,
     load_dataset,
@@ -62,6 +65,6 @@ from .exploration import (  # noqa: F401
     StochasticSampling,
 )
 from .policy import ConvPolicy, LSTMPolicy, MLPPolicy  # noqa: F401
-from .ppo import PPO, PPOConfig  # noqa: F401
+from .ppo import A2CConfig, PPO, PPOConfig  # noqa: F401
 from .rollout_worker import RolloutWorker  # noqa: F401
 from .worker_set import WorkerSet  # noqa: F401

@@ -173,6 +173,167 @@ class BC(Algorithm):
         self.iteration = state.get("iteration", 0)
 
 
+# ------------------------------------------------------------- MARWIL
+@dataclasses.dataclass
+class MARWILConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    dataset: Optional[Dict[str, np.ndarray]] = None
+    beta: float = 1.0              # advantage-weighting temperature;
+    #   beta=0 degenerates to BC (the reference's exact relationship)
+    gamma: float = 0.99
+    vf_coeff: float = 1.0
+    weight_clip: float = 20.0      # cap exp(beta * A / c) (reference's
+    #   moving-average normalization guards the same blowup)
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs_per_iter: int = 1
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class MARWIL(Algorithm):
+    """Monotonic Advantage Re-Weighted Imitation Learning (reference:
+    rllib/algorithms/marwil/marwil.py:1 — exponentially
+    advantage-weighted behavioral cloning with a jointly learned value
+    function).  Advantages are one-step TD residuals against the
+    learned V (the columnar dataset carries next_obs/done, so no
+    episode reconstruction is needed), normalized by a running
+    root-mean-square like the reference's moving-average c².  One
+    jitted epoch over permuted minibatches, like BC/CQL.
+    """
+
+    _config_cls = MARWILConfig
+
+    def __init__(self, config: MARWILConfig):
+        super().__init__(config)
+        if config.env is None or config.dataset is None:
+            raise ValueError("MARWILConfig.env and MARWILConfig.dataset "
+                             "required")
+        if config.epochs_per_iter < 1:
+            raise ValueError("epochs_per_iter must be >= 1 (a zero-epoch "
+                             "iteration would report no loss)")
+        self.env = config.env()
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=config.hidden)
+        from .policy import mlp_apply, mlp_init
+        self._v_apply = mlp_apply
+        self.key = jax.random.PRNGKey(config.seed)
+        self.key, pkey, vkey = jax.random.split(self.key, 3)
+        self.params = {
+            "pi": self.policy.init(pkey),
+            "v": mlp_init(vkey, (self.env.observation_size,)
+                          + tuple(config.hidden) + (1,)),
+        }
+        self.adv_rms = jnp.ones(())     # running sqrt(E[A^2])
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        ds = config.dataset
+        n = (len(ds["obs"]) // config.batch_size) * config.batch_size
+        if n == 0:
+            raise ValueError(
+                f"dataset has {len(ds['obs'])} rows < batch_size="
+                f"{config.batch_size}: an epoch would run zero "
+                f"minibatches and train nothing")
+        self._data = {
+            "obs": jnp.asarray(ds["obs"][:n], jnp.float32),
+            "action": jnp.asarray(ds["action"][:n]),
+            "reward": jnp.asarray(ds["reward"][:n], jnp.float32),
+            "next_obs": jnp.asarray(ds["next_obs"][:n], jnp.float32),
+            "done": jnp.asarray(ds["done"][:n], jnp.float32),
+        }
+        self._epoch = jax.jit(self._make_epoch_fn(n))
+
+    def _make_epoch_fn(self, n: int):
+        cfg = self.config
+        policy, v_apply, data = self.policy, self._v_apply, self._data
+        n_mb = n // cfg.batch_size
+
+        def epoch(params, opt_state, adv_rms, key):
+            key, pkey = jax.random.split(key)
+            idx = jax.random.permutation(pkey, n).reshape(
+                n_mb, cfg.batch_size)
+
+            def mb_step(carry, ix):
+                params, opt_state, adv_rms = carry
+                batch = jax.tree_util.tree_map(lambda c: c[ix], data)
+
+                def loss_fn(p):
+                    v = v_apply(p["v"], batch["obs"])[..., 0]
+                    v_next = v_apply(p["v"], batch["next_obs"])[..., 0]
+                    target = batch["reward"] + cfg.gamma \
+                        * (1.0 - batch["done"]) \
+                        * jax.lax.stop_gradient(v_next)
+                    vf_loss = jnp.mean((v - target) ** 2)
+                    adv = jax.lax.stop_gradient(target - v)
+                    weights = jnp.minimum(
+                        jnp.exp(cfg.beta * adv
+                                / jnp.maximum(adv_rms, 1e-6)),
+                        cfg.weight_clip)
+                    logp, _, _ = jax.vmap(
+                        lambda o, a: policy.log_prob(p["pi"], o, a))(
+                            batch["obs"], batch["action"])
+                    pi_loss = -jnp.mean(weights * logp)
+                    return pi_loss + cfg.vf_coeff * vf_loss, \
+                        (pi_loss, vf_loss, adv)
+
+                (loss, (pi_loss, vf_loss, adv)), grads = \
+                    jax.value_and_grad(loss_fn, has_aux=True)(params)
+                # running RMS of advantages (the reference's moving
+                # average c^2: c^2 += 1e-8 * (mean(A^2) - c^2))
+                adv_rms = jnp.sqrt(
+                    0.99 * adv_rms ** 2 + 0.01 * jnp.mean(adv ** 2))
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, adv_rms), (pi_loss, vf_loss)
+
+            (params, opt_state, adv_rms), (pi_losses, vf_losses) = \
+                jax.lax.scan(mb_step, (params, opt_state, adv_rms), idx)
+            return (params, opt_state, adv_rms, key,
+                    pi_losses.mean(), vf_losses.mean())
+
+        return epoch
+
+    def training_step(self) -> Dict[str, Any]:
+        pi_loss = vf_loss = None
+        for _ in range(self.config.epochs_per_iter):
+            (self.params, self.opt_state, self.adv_rms, self.key,
+             pi_loss, vf_loss) = self._epoch(
+                self.params, self.opt_state, self.adv_rms, self.key)
+        return {"policy_loss": float(pi_loss),
+                "vf_loss": float(vf_loss),
+                "adv_rms": float(self.adv_rms),
+                "env_steps_this_iter": 0}
+
+    def action_fn(self):
+        """Greedy jittable policy for deployment/eval."""
+        policy = self.policy
+        params = self.params["pi"]
+
+        def act(obs, key):
+            return policy.greedy_action(params, obs) \
+                if hasattr(policy, "greedy_action") \
+                else policy.sample_action(params, obs, key)[0]
+        return act
+
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "adv_rms": float(self.adv_rms),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.adv_rms = jnp.asarray(state.get("adv_rms", 1.0))
+        self.iteration = state.get("iteration", 0)
+
+
 # ------------------------------------------------- off-policy estimation
 # ------------------------------------------------------ conservative Q
 @dataclasses.dataclass

@@ -59,6 +59,23 @@ class PPOConfig:
         return PPO(self)
 
 
+@dataclasses.dataclass
+class A2CConfig(PPOConfig):
+    """Synchronous advantage actor-critic (reference:
+    rllib/algorithms/a2c/a2c.py:1 — A3C with synchronous updates).
+    A2C IS single-epoch unclipped PPO over the whole rollout (the
+    surrogate with ratio≈1 reduces to the policy gradient), so the
+    preset reuses the compiled PPO iteration exactly — the same
+    degenerate-case relationship the reference documents.
+    """
+    num_sgd_epochs: int = 1
+    num_minibatches: int = 1
+    clip_eps: float = 10.0        # effectively unclipped
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
 def _make_elementwise_apply(pipe):
     """Stateless elementwise connector application (action/reward
     pipelines) shared by the feedforward and recurrent rollouts."""

@@ -52,12 +52,68 @@ class HTTPProxy:
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
 
+        def make_call(name, payload):
+            def call():
+                from ..core.config import GlobalConfig
+                from .handle import call_with_retry
+                args = (payload,) if payload is not None else ()
+                return call_with_retry(
+                    self._router, name, args, {},
+                    timeout_s=GlobalConfig.serve_request_timeout_s)
+            return call
+
+        async def stream_tokens(request, name, payload):
+            """Server-sent-events generation (reference capability:
+            Serve's StreamingResponse, serve/_private/http_util.py) —
+            the PROXY drives a decode-session deployment
+            (serve/decode_session.py protocol) and emits one SSE event
+            per token, so clients get tokens as they decode instead of
+            one request per token."""
+            max_new = int(payload.pop("max_new_tokens", 64))
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+
+            async def emit(obj):
+                await resp.write(
+                    b"data: " + json.dumps(obj).encode() + b"\n\n")
+
+            out = await loop.run_in_executor(
+                self._pool, make_call(name, {"op": "start", **payload}))
+            sid = out.get("sid") if isinstance(out, dict) else None
+            # the session exists from this point: EVERY exit — including
+            # the first emit raising on an already-closed connection —
+            # must release the replica's KV cache
+            try:
+                await emit(out)
+                if sid is not None and "error" not in out:
+                    for _ in range(max_new - 1):
+                        out = await loop.run_in_executor(
+                            self._pool,
+                            make_call(name, {"op": "next", "sid": sid}))
+                        await emit(out)
+                        if not isinstance(out, dict) or "error" in out \
+                                or out.get("eos"):
+                            break
+            finally:
+                if sid is not None:
+                    await loop.run_in_executor(
+                        self._pool,
+                        make_call(name, {"op": "end", "sid": sid}))
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+
         async def handle(request: "web.Request") -> "web.Response":
             path = request.path
             if path == "/-/routes":
                 return web.json_response(self._router.route_prefixes())
             if path == "/-/healthz":
                 return web.Response(text="ok")
+            streaming = path.endswith("/stream")
+            if streaming:
+                path = path[:-len("/stream")]
             name = self._router.match_route(path)
             if name is None:
                 return web.Response(status=404,
@@ -73,16 +129,19 @@ class HTTPProxy:
             if payload is None and request.query:
                 payload = dict(request.query)
 
-            def call():
-                from ..core.config import GlobalConfig
-                from .handle import call_with_retry
-                args = (payload,) if payload is not None else ()
-                return call_with_retry(
-                    self._router, name, args, {},
-                    timeout_s=GlobalConfig.serve_request_timeout_s)
+            if streaming:
+                if not isinstance(payload, dict):
+                    return web.Response(
+                        status=400,
+                        text="/stream needs a JSON object body")
+                try:
+                    return await stream_tokens(request, name, payload)
+                except Exception as e:
+                    return web.Response(status=500, text=str(e))
 
             try:
-                result = await loop.run_in_executor(self._pool, call)
+                result = await loop.run_in_executor(
+                    self._pool, make_call(name, payload))
             except Exception as e:
                 return web.Response(status=500, text=str(e))
             if isinstance(result, (bytes, bytearray)):

@@ -33,6 +33,28 @@ from ..air.result import Result
 MAX_BINS = 64
 
 
+def _softmax_rows(margin: np.ndarray) -> np.ndarray:
+    """Row-wise softmax over [n, K] margins — ONE definition shared by
+    shard gradients, model predict, and validation metrics."""
+    z = margin - margin.max(axis=1, keepdims=True)
+    p = np.exp(z)
+    return p / p.sum(axis=1, keepdims=True)
+
+
+def _pairwise_error(margin: np.ndarray, rel: np.ndarray,
+                    groups: np.ndarray) -> tuple:
+    """(mis-ordered pairs, ordered pairs) within query groups — shared
+    by the shard train metric and validation scoring."""
+    bad = total = 0
+    for gid in np.unique(groups):
+        rows = np.nonzero(groups == gid)[0]
+        m, r = margin[rows], rel[rows]
+        better = r[:, None] > r[None, :]
+        bad += int((better & (m[:, None] <= m[None, :])).sum())
+        total += int(better.sum())
+    return bad, total
+
+
 def _bin_matrix(X: np.ndarray, bin_edges: List[np.ndarray]) -> np.ndarray:
     """Quantize rows to uint8 bin ids — the ONE binning definition shared
     by training shards and the fitted model (exactness depends on it)."""
@@ -132,9 +154,7 @@ class GBDTModel:
         if self.objective == "binary:logistic":
             return 1.0 / (1.0 + np.exp(-margin))
         if self.objective in ("multi:softprob", "multi:softmax"):
-            z = margin - margin.max(axis=1, keepdims=True)
-            p = np.exp(z)
-            p /= p.sum(axis=1, keepdims=True)
+            p = _softmax_rows(margin)
             return np.argmax(p, axis=1) \
                 if self.objective == "multi:softmax" else p
         return margin
@@ -190,9 +210,7 @@ class _GBDTShard:
         return len(self.y)
 
     def _softmax(self) -> np.ndarray:
-        z = self.margin - self.margin.max(axis=1, keepdims=True)
-        p = np.exp(z)
-        return p / p.sum(axis=1, keepdims=True)
+        return _softmax_rows(self.margin)
 
     def start_tree(self, class_k: int = 0) -> None:
         if self.objective == "binary:logistic":
@@ -281,15 +299,8 @@ class _GBDTShard:
             loss = -np.log(p[rows, self.y.astype(int)])
             return float(loss.sum()), len(self.y)
         if self.objective == "rank:pairwise":
-            # pairwise error fraction: ordered pairs the model ranks
-            # the wrong way, summed per group
-            bad = total = 0
-            for gid in np.unique(self.groups):
-                rows = np.nonzero(self.groups == gid)[0]
-                m, rel = self.margin[rows], self.y[rows]
-                better = rel[:, None] > rel[None, :]
-                bad += int((better & (m[:, None] <= m[None, :])).sum())
-                total += int(better.sum())
+            bad, total = _pairwise_error(self.margin, self.y,
+                                         self.groups)
             return float(bad), max(total, 1)
         return float(((self.margin - self.y) ** 2).sum()), len(self.y)
 
@@ -521,22 +532,12 @@ class XGBoostTrainer:
                 metrics[f"{name}-rmse"] = float(
                     np.sqrt(np.mean((margin - yv) ** 2)))
             elif metric_name == "mlogloss":
-                z = margin - margin.max(axis=1, keepdims=True)
-                p = np.exp(z)
-                p = np.clip(p / p.sum(axis=1, keepdims=True), 1e-12,
-                            1.0)
+                p = np.clip(_softmax_rows(margin), 1e-12, 1.0)
                 rows = np.arange(len(yv))
                 metrics[f"{name}-mlogloss"] = float(
                     -np.mean(np.log(p[rows, yv.astype(int)])))
             elif metric_name == "pairwise-error":
-                bad = tot = 0
-                for gid in np.unique(gv):
-                    rows = np.nonzero(gv == gid)[0]
-                    m, rel = margin[rows], yv[rows]
-                    better = rel[:, None] > rel[None, :]
-                    bad += int((better
-                                & (m[:, None] <= m[None, :])).sum())
-                    tot += int(better.sum())
+                bad, tot = _pairwise_error(margin, yv, gv)
                 metrics[f"{name}-pairwise-error"] = bad / max(tot, 1)
             else:
                 p = np.clip(1 / (1 + np.exp(-margin)), 1e-12, 1 - 1e-12)

@@ -41,6 +41,7 @@ class JaxTrainer:
                  run_config: Optional[RunConfig] = None,
                  backend_config: Optional[BackendConfig] = None,
                  datasets: Optional[Dict[str, Any]] = None,
+                 preprocessor: Optional[Any] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
@@ -48,10 +49,31 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.backend_config = backend_config or self._default_backend()
         self.datasets = datasets or {}
+        # the base-trainer preprocessor contract (reference:
+        # train/base_trainer.py): fit on the train split, transform
+        # every Dataset split before sharding, attach to every
+        # checkpoint the run registers so BatchPredictor/Serve apply
+        # the SAME transforms at inference
+        self.preprocessor = preprocessor
         self.resume_from_checkpoint = resume_from_checkpoint
 
     # -- orchestration ------------------------------------------------------
     def fit(self) -> Result:
+        if self.preprocessor is not None:
+            train = self.datasets.get("train") if self.datasets else None
+            if train is not None and hasattr(train, "map_batches"):
+                self.preprocessor.fit(train)
+            elif not getattr(self.preprocessor, "fitted", True):
+                # attaching an unfitted preprocessor would surface as an
+                # AttributeError at INFERENCE time — fail at the
+                # misconfiguration instead
+                raise ValueError(
+                    "preprocessor needs a 'train' Dataset split to fit "
+                    "on (or pass an already-fitted preprocessor)")
+            self.datasets = {
+                name: (self.preprocessor.transform(ds)
+                       if hasattr(ds, "map_batches") else ds)
+                for name, ds in self.datasets.items()}
         name = self.run_config.name or "train_run"
         storage = (self.run_config.storage_path
                    or os.path.join(tempfile.gettempdir(), "ray_tpu_results"))
@@ -118,8 +140,10 @@ class JaxTrainer:
                 history.append(last_metrics)
                 ckpt_blob = rank0.get("checkpoint")
                 if ckpt_blob is not None:
-                    ckpt_mgr.register(rank0["iteration"],
-                                      Checkpoint.from_bytes(ckpt_blob),
+                    ckpt = Checkpoint.from_bytes(ckpt_blob)
+                    if self.preprocessor is not None:
+                        ckpt = ckpt.with_preprocessor(self.preprocessor)
+                    ckpt_mgr.register(rank0["iteration"], ckpt,
                                       last_metrics)
             executor.finish()
             return last_metrics

@@ -65,8 +65,18 @@ class HyperOptSearch(Searcher):
             return hp.loguniform(name, float(np.log(dom.low)),
                                  float(np.log(dom.high)))
         if isinstance(dom, Uniform):
+            # quantized domains must stay quantized through the adapter
+            # — hp.quniform is hyperopt's native q form
+            if dom.q:
+                return hp.quniform(name, dom.low, dom.high, dom.q)
             return hp.uniform(name, dom.low, dom.high)
         if isinstance(dom, Randint):
+            if dom.log:
+                return hp.qloguniform(name, float(np.log(dom.low)),
+                                      float(np.log(dom.high)),
+                                      max(dom.q, 1))
+            if dom.q > 1:
+                return hp.quniform(name, dom.low, dom.high - 1, dom.q)
             return hp.randint(name, dom.low, dom.high)
         if isinstance(dom, Normal):
             return hp.normal(name, dom.mean, dom.sd)
@@ -84,11 +94,16 @@ class HyperOptSearch(Searcher):
         self._open[trial_id] = doc["tid"]
         vals = {k: v[0] for k, v in doc["misc"]["vals"].items() if v}
         cfg = dict(self.consts)
+        from .sample import Randint
         for k, dom in self.domains.items():
             v = vals[k]
-            # hp.choice yields an INDEX into the category list
-            cfg[k] = dom.categories[int(v)] \
-                if isinstance(dom, Categorical) else v
+            if isinstance(dom, Categorical):
+                # hp.choice yields an INDEX into the category list
+                cfg[k] = dom.categories[int(v)]
+            elif isinstance(dom, Randint):
+                cfg[k] = int(v)     # q*uniform forms return floats
+            else:
+                cfg[k] = v
         return cfg
 
     def on_trial_complete(self, trial_id, result=None, error=False):
@@ -149,12 +164,23 @@ class AxSearch(Searcher):
                     "bounds": [float(dom.low), float(dom.high)],
                     "log_scale": True}
         if isinstance(dom, Uniform):
+            if dom.q:
+                # Ax ranges have no quantization knob: enumerating the
+                # grid as a choice preserves the user's space exactly
+                grid = np.arange(dom.low, dom.high + dom.q / 2, dom.q)
+                return {"name": name, "type": "choice",
+                        "values": [float(v) for v in grid]}
             return {"name": name, "type": "range",
                     "bounds": [float(dom.low), float(dom.high)]}
         if isinstance(dom, Randint):
+            if dom.q > 1:
+                grid = range(dom.low, dom.high, dom.q)
+                return {"name": name, "type": "choice",
+                        "values": [int(v) for v in grid]}
             return {"name": name, "type": "range",
                     "bounds": [int(dom.low), int(dom.high) - 1],
-                    "value_type": "int"}
+                    "value_type": "int",
+                    **({"log_scale": True} if dom.log else {})}
         raise ValueError(f"unsupported domain for {name!r}: {dom!r}")
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:

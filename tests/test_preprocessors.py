@@ -223,3 +223,38 @@ def test_checkpoint_roundtrip_into_batch_predictor(cluster):
         rdata.from_pandas([test_df])).take_all()]
     np.testing.assert_allclose(np.asarray(preds, dtype=float).ravel(),
                                [0.0, 3.0], atol=1e-6)
+
+
+def test_jax_trainer_preprocessor_contract(cluster):
+    """The base-trainer contract: fit on train, transform shards,
+    attach to checkpoints (reference: train/base_trainer.py)."""
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.air import ScalingConfig
+
+    def loop(config):
+        import numpy as np
+
+        from ray_tpu.air import Checkpoint, session
+        shard = session.get_dataset_shard("train")
+        xs = np.concatenate([b["x"] for b in
+                             shard.iter_batches(batch_size=32)])
+        # StandardScaler output: mean ~0 within fp noise
+        session.report({"mean_abs": float(abs(xs.mean())),
+                        "rows": int(len(xs))},
+                       checkpoint=Checkpoint.from_dict({"w": 1.0}))
+
+    ds = rdata.from_items([{"x": float(i)} for i in range(64)],
+                          parallelism=2)
+    trainer = JaxTrainer(
+        loop, datasets={"train": ds},
+        preprocessor=StandardScaler(["x"]),
+        scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    # rank 0's shard (rows 0..31) scaled with GLOBAL stats has mean
+    # (15.5 - 31.5) / std(0..63) = -0.866; a (wrong) per-shard fit
+    # would give 0 — this discriminates global-fit-then-shard
+    assert result.metrics["mean_abs"] == pytest.approx(0.866, abs=0.02)
+    assert result.metrics["rows"] == 32
+    pp = result.checkpoint.get_preprocessor()
+    assert isinstance(pp, StandardScaler)
+    assert pp.stats_["x"][0] == pytest.approx(31.5)

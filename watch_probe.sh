@@ -1,0 +1,17 @@
+#!/bin/bash
+# When probe_loop.sh lands tpu_probe results (the chip healed), chase
+# them with the real benchmark immediately — a healthy grant window must
+# not wait for round end.  One claimant at a time: bench runs only after
+# the probe's claim has exited.
+cd /root/repo
+while true; do
+    if [ -s probe_r04.out ] && ! pgrep -f tpu_probe.py > /dev/null; then
+        echo "probe results landed $(date -u +%H:%M:%S); running bench" \
+            >> watch_probe.log
+        python bench.py > BENCH_live_r04.json 2>> watch_probe.log
+        echo "bench rc=$? $(date -u +%H:%M:%S)" >> watch_probe.log
+        python bench.py --rl > BENCH_live_rl_r04.json 2>> watch_probe.log
+        break
+    fi
+    sleep 60
+done
