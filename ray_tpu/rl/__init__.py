@@ -21,7 +21,7 @@ from .env import (  # noqa: F401
     PixelPong,
 )
 from .es import ES, ESConfig  # noqa: F401
-from .impala import Impala, ImpalaConfig  # noqa: F401
+from .impala import APPOConfig, Impala, ImpalaConfig  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
 from .td3 import DDPG, DDPGConfig, TD3, TD3Config  # noqa: F401
 from .offline import (  # noqa: F401

@@ -51,9 +51,23 @@ class ImpalaConfig:
     max_grad_norm: float = 40.0
     hidden: tuple = (64, 64)
     seed: int = 0
+    # None = plain V-trace policy gradient (IMPALA); a float enables
+    # the PPO clipped surrogate on V-trace advantages — which IS APPO
+    clip_eps: Optional[float] = None
 
     def build(self) -> "Impala":
         return Impala(self)
+
+
+@dataclasses.dataclass
+class APPOConfig(ImpalaConfig):
+    """Asynchronous PPO (reference: rllib/algorithms/appo/appo.py:1 —
+    'IMPALA with a surrogate policy loss and clipping').  Exactly that
+    here: the same async actor-learner machinery and V-trace
+    correction, with the PPO clip on the importance-ratio surrogate.
+    ``build()`` is inherited — APPO IS an Impala configuration."""
+    clip_eps: Optional[float] = 0.2
+    lr: float = 3e-4
 
 
 def vtrace(behavior_logp, target_logp, values, last_value, rewards, dones,
@@ -190,7 +204,16 @@ class Impala(Algorithm):
                     batch["logp"], logp, value, batch["last_value"],
                     batch["reward"], batch["done"], gamma=cfg.gamma,
                     rho_bar=cfg.rho_bar, c_bar=cfg.c_bar)
-                pi_loss = -jnp.mean(logp * pg_adv)
+                if cfg.clip_eps is not None:
+                    # APPO: PPO's clipped surrogate on the V-trace
+                    # advantages, ratio against the BEHAVIOR policy
+                    ratio = jnp.exp(logp - batch["logp"])
+                    unclipped = ratio * pg_adv
+                    clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps,
+                                       1.0 + cfg.clip_eps) * pg_adv
+                    pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+                else:
+                    pi_loss = -jnp.mean(logp * pg_adv)
                 vf_loss = 0.5 * jnp.mean((vs - value) ** 2)
                 ent = jnp.mean(entropy)
                 total = pi_loss + cfg.vf_coeff * vf_loss \

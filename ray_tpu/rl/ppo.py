@@ -71,9 +71,7 @@ class A2CConfig(PPOConfig):
     num_sgd_epochs: int = 1
     num_minibatches: int = 1
     clip_eps: float = 10.0        # effectively unclipped
-
-    def build(self) -> "PPO":
-        return PPO(self)
+    # build() inherited: A2C IS a PPO configuration
 
 
 def _make_elementwise_apply(pipe):

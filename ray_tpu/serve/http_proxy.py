@@ -70,21 +70,24 @@ class HTTPProxy:
             per token, so clients get tokens as they decode instead of
             one request per token."""
             max_new = int(payload.pop("max_new_tokens", 64))
+            # the start op runs BEFORE headers go out: a failure here
+            # still gets a clean HTTP 500 from the caller
+            out = await loop.run_in_executor(
+                self._pool, make_call(name, {"op": "start", **payload}))
+            sid = out.get("sid") if isinstance(out, dict) else None
             resp = web.StreamResponse(headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache"})
-            await resp.prepare(request)
 
             async def emit(obj):
                 await resp.write(
                     b"data: " + json.dumps(obj).encode() + b"\n\n")
 
-            out = await loop.run_in_executor(
-                self._pool, make_call(name, {"op": "start", **payload}))
-            sid = out.get("sid") if isinstance(out, dict) else None
-            # the session exists from this point: EVERY exit — including
-            # the first emit raising on an already-closed connection —
-            # must release the replica's KV cache
+            # once prepared, this exchange IS the response: mid-stream
+            # failures must become in-band error events (a second
+            # Response on a live stream corrupts the connection), and
+            # EVERY exit must release the replica's KV cache
+            await resp.prepare(request)
             try:
                 await emit(out)
                 if sid is not None and "error" not in out:
@@ -96,13 +99,21 @@ class HTTPProxy:
                         if not isinstance(out, dict) or "error" in out \
                                 or out.get("eos"):
                             break
+            except Exception as e:
+                try:
+                    await emit({"error": str(e)})
+                except Exception:
+                    pass    # connection already gone
             finally:
                 if sid is not None:
                     await loop.run_in_executor(
                         self._pool,
                         make_call(name, {"op": "end", "sid": sid}))
-            await resp.write(b"data: [DONE]\n\n")
-            await resp.write_eof()
+            try:
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+            except Exception:
+                pass
             return resp
 
         async def handle(request: "web.Request") -> "web.Response":

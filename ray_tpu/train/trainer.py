@@ -59,21 +59,29 @@ class JaxTrainer:
 
     # -- orchestration ------------------------------------------------------
     def fit(self) -> Result:
-        if self.preprocessor is not None:
+        if self.preprocessor is not None and \
+                not getattr(self, "_datasets_preprocessed", False):
             train = self.datasets.get("train") if self.datasets else None
-            if train is not None and hasattr(train, "map_batches"):
+            fitted = getattr(self.preprocessor, "fitted", True)
+            if not fitted:
+                if train is None or not hasattr(train, "map_batches"):
+                    # attaching an unfitted preprocessor would surface
+                    # as an AttributeError at INFERENCE time — fail at
+                    # the misconfiguration instead
+                    raise ValueError(
+                        "preprocessor needs a 'train' Dataset split to "
+                        "fit on (or pass an already-fitted "
+                        "preprocessor)")
+                # fit-only-if-unfitted (the reference contract): a
+                # user-fitted preprocessor's statistics are respected
                 self.preprocessor.fit(train)
-            elif not getattr(self.preprocessor, "fitted", True):
-                # attaching an unfitted preprocessor would surface as an
-                # AttributeError at INFERENCE time — fail at the
-                # misconfiguration instead
-                raise ValueError(
-                    "preprocessor needs a 'train' Dataset split to fit "
-                    "on (or pass an already-fitted preprocessor)")
             self.datasets = {
                 name: (self.preprocessor.transform(ds)
                        if hasattr(ds, "map_batches") else ds)
                 for name, ds in self.datasets.items()}
+            # fit() may run again (failure retries): never double-fit
+            # or double-transform
+            self._datasets_preprocessed = True
         name = self.run_config.name or "train_run"
         storage = (self.run_config.storage_path
                    or os.path.join(tempfile.gettempdir(), "ray_tpu_results"))

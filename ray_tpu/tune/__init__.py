@@ -37,6 +37,10 @@ from .search import (  # noqa: F401
     Searcher,
     TPESearch,
 )
-from .search_ext import AxSearch, HyperOptSearch  # noqa: F401
+from .search_ext import (  # noqa: F401
+    AxSearch,
+    BayesOptSearch,
+    HyperOptSearch,
+)
 from .trial import Trial  # noqa: F401
 from .tuner import TuneConfig, Tuner, run, with_parameters  # noqa: F401

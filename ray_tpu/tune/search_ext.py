@@ -72,9 +72,12 @@ class HyperOptSearch(Searcher):
             return hp.uniform(name, dom.low, dom.high)
         if isinstance(dom, Randint):
             if dom.log:
+                # upper bound log(high - q/2): round-to-nearest of
+                # exp(x) then stays STRICTLY below the exclusive high
+                q = max(dom.q, 1)
                 return hp.qloguniform(name, float(np.log(dom.low)),
-                                      float(np.log(dom.high)),
-                                      max(dom.q, 1))
+                                      float(np.log(dom.high - q / 2)),
+                                      q)
             if dom.q > 1:
                 return hp.quniform(name, dom.low, dom.high - 1, dom.q)
             return hp.randint(name, dom.low, dom.high)
@@ -125,6 +128,107 @@ class HyperOptSearch(Searcher):
                                  "status": hpo.STATUS_OK}
             break
         self._trials.refresh()
+
+
+class BayesOptSearch(Searcher):
+    """Gaussian-process Bayesian optimization with expected
+    improvement (reference capability:
+    tune/search/bayesopt/bayesopt_search.py, which wraps the external
+    `bayesian-optimization` package).  In-tree design: sklearn's
+    GaussianProcessRegressor (in the image) models the objective over
+    the unit cube; numeric domains map through the same transforms
+    TPESearch uses (log-space for LogUniform), categoricals are
+    one-hot; candidates are random samples scored by EI.
+    """
+
+    def __init__(self, param_space: Dict[str, Any], metric: str,
+                 mode: str = "max", seed: Optional[int] = 0,
+                 n_startup: int = 8, n_candidates: int = 256):
+        super().__init__(metric=metric, mode=mode)
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+        grids, self.domains, self.consts = _split_spec(param_space)
+        if grids:
+            raise ValueError("BayesOptSearch does not combine with "
+                             "grid_search; use BasicVariantGenerator")
+        self.rng = np.random.default_rng(seed)
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self._gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), normalize_y=True,
+            alpha=1e-6, random_state=seed)
+        self._live: Dict[str, np.ndarray] = {}   # trial_id -> unit vec
+        self._X: list = []                       # observed unit vecs
+        self._y: list = []                       # objective (maximize)
+
+    # -- unit-cube encoding --------------------------------------------------
+    def _dims(self):
+        from .sample import Categorical
+        for k, dom in self.domains.items():
+            yield k, dom, (len(dom.categories)
+                           if isinstance(dom, Categorical) else 1)
+
+    def _decode(self, u: np.ndarray) -> Dict[str, Any]:
+        from .sample import (Categorical, LogUniform, Normal, Randint,
+                             Uniform)
+        cfg = dict(self.consts)
+        i = 0
+        for k, dom, width in self._dims():
+            v = u[i:i + width]
+            i += width
+            if isinstance(dom, Categorical):
+                cfg[k] = dom.categories[int(np.argmax(v))]
+            elif isinstance(dom, LogUniform):
+                lo, hi = np.log(dom.low), np.log(dom.high)
+                cfg[k] = float(np.exp(lo + v[0] * (hi - lo)))
+            elif isinstance(dom, Uniform):
+                x = dom.low + v[0] * (dom.high - dom.low)
+                cfg[k] = float(round(x / dom.q) * dom.q) if dom.q \
+                    else float(x)
+            elif isinstance(dom, Randint):
+                if dom.log:
+                    lo, hi = np.log(dom.low), np.log(max(dom.high - 1,
+                                                         dom.low))
+                    x = int(np.exp(lo + v[0] * (hi - lo)))
+                else:
+                    x = dom.low + int(v[0] * (dom.high - dom.low))
+                x = min(max(x, dom.low), dom.high - 1)
+                cfg[k] = (x // dom.q) * dom.q if dom.q > 1 else x
+            elif isinstance(dom, Normal):
+                # inverse-CDF-ish: map [0,1] to ±3 sd
+                cfg[k] = float(dom.mean + dom.sd * (6.0 * v[0] - 3.0))
+            else:
+                cfg[k] = dom.sample(self.rng)
+        return cfg
+
+    def _sample_unit(self, n: int) -> np.ndarray:
+        width = sum(w for _, _, w in self._dims())
+        return self.rng.random((n, width))
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._y) < self.n_startup:
+            u = self._sample_unit(1)[0]
+        else:
+            from scipy.stats import norm
+            self._gp.fit(np.asarray(self._X), np.asarray(self._y))
+            cands = self._sample_unit(self.n_candidates)
+            mu, sigma = self._gp.predict(cands, return_std=True)
+            best = max(self._y)
+            sigma = np.maximum(sigma, 1e-9)
+            z = (mu - best) / sigma
+            ei = (mu - best) * norm.cdf(z) + sigma * norm.pdf(z)
+            u = cands[int(np.argmax(ei))]
+        self._live[trial_id] = u
+        return self._decode(u)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        u = self._live.pop(trial_id, None)
+        if u is None or error or not result or \
+                self.metric not in result:
+            return
+        value = float(result[self.metric])
+        self._X.append(u)
+        self._y.append(value if self.mode == "max" else -value)
 
 
 class AxSearch(Searcher):

@@ -29,6 +29,10 @@ def _install_hyperopt_stub(monkeypatch):
         loguniform=lambda n, lo, hi: _Spec("loguniform", n, (lo, hi)),
         randint=lambda n, lo, hi: _Spec("randint", n, (lo, hi)),
         normal=lambda n, mu, sd: _Spec("normal", n, (mu, sd)),
+        quniform=lambda n, lo, hi, q: _Spec("quniform", n,
+                                            (lo, hi, q)),
+        qloguniform=lambda n, lo, hi, q: _Spec("qloguniform", n,
+                                               (lo, hi, q)),
     )
 
     class Trials:
@@ -69,6 +73,13 @@ def _install_hyperopt_stub(monkeypatch):
                     v = float(np.exp(rng.uniform(*spec.args)))
                 elif spec.kind == "randint":
                     v = int(rng.integers(*spec.args))
+                elif spec.kind == "quniform":
+                    lo, hi, q = spec.args
+                    v = float(np.round(rng.uniform(lo, hi) / q) * q)
+                elif spec.kind == "qloguniform":
+                    lo, hi, q = spec.args
+                    v = float(np.round(
+                        np.exp(rng.uniform(lo, hi)) / q) * q)
                 else:
                     v = float(rng.normal(*spec.args))
                 vals[name] = [v]
@@ -247,3 +258,65 @@ def test_hyperopt_drives_tuner(monkeypatch):
         assert len(searcher._trials.trials) == 6
     finally:
         ray_tpu.shutdown()
+
+
+# -- in-tree GP BayesOpt ------------------------------------------------------
+
+def test_bayesopt_concentrates_near_optimum():
+    """sklearn-GP EI search on a smooth 1-D objective: post-startup
+    suggestions must cluster near the optimum (the TPESearch test's
+    bar, applied to the GP searcher)."""
+    s = tune.BayesOptSearch({"x": tune.uniform(-10.0, 10.0)},
+                            metric="loss", mode="min", seed=0,
+                            n_startup=6, n_candidates=128)
+    for i in range(18):
+        cfg = s.suggest(f"t{i}")
+        loss = (cfg["x"] - 3.0) ** 2
+        s.on_trial_complete(f"t{i}", {"loss": loss})
+    late = [s.suggest(f"late{j}") for j in range(4)]
+    dists = [abs(c["x"] - 3.0) for c in late]
+    assert np.median(dists) < 3.0, dists
+
+
+def test_bayesopt_mixed_space_decoding():
+    s = tune.BayesOptSearch(
+        {"lr": tune.loguniform(1e-5, 1e-1),
+         "opt": tune.choice(["adam", "sgd", "lamb"]),
+         "layers": tune.randint(2, 9), "k": "const"},
+        metric="m", seed=1)
+    for i in range(10):
+        cfg = s.suggest(f"t{i}")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert cfg["opt"] in ("adam", "sgd", "lamb")
+        assert 2 <= cfg["layers"] < 9
+        assert isinstance(cfg["layers"], int)
+        assert cfg["k"] == "const"
+        s.on_trial_complete(f"t{i}", {"m": float(i)})
+
+
+def test_quantized_domains_stay_quantized(monkeypatch):
+    hpo = _install_hyperopt_stub(monkeypatch)
+    # BayesOpt decodes q itself
+    s = tune.BayesOptSearch({"bs": tune.quniform(16, 256, 16.0)},
+                            metric="m", seed=0)
+    for i in range(6):
+        v = s.suggest(f"t{i}")["bs"]
+        assert v % 16 == 0, v
+        s.on_trial_complete(f"t{i}", {"m": 1.0})
+    # HyperOpt maps q domains onto hp.quniform/qloguniform
+    h = tune.HyperOptSearch(
+        {"bs": tune.quniform(16, 256, 16.0),
+         "layers": tune.lograndint(1, 8)}, metric="m", seed=0)
+    specs = h._domain.space
+    assert specs["bs"].kind == "quniform"
+    assert specs["bs"].args == (16, 256, 16.0)
+    assert specs["layers"].kind == "qloguniform"
+    lo, hi, q = specs["layers"].args
+    # exp of the upper bound stays strictly under the exclusive high
+    assert np.exp(hi) < 8 and q == 1
+    for i in range(8):
+        cfg = h.suggest(f"h{i}")
+        assert cfg["bs"] % 16 == 0
+        assert 1 <= cfg["layers"] < 8
+        assert isinstance(cfg["layers"], int)
+        h.on_trial_complete(f"h{i}", {"m": 1.0})
