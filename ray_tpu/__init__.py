@@ -31,7 +31,7 @@ from .api import (  # noqa: F401
     timeline,
     wait,
 )
-from .core.driver import ObjectRef  # noqa: F401
+from .core.driver import ObjectRef, ObjectRefGenerator  # noqa: F401
 from . import exceptions  # noqa: F401
 from .dag.node import install_bind as _install_bind
 

@@ -17,10 +17,11 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 from . import exceptions
 from .core import serialization
 from .core.config import GlobalConfig
-from .core.driver import CoreClient, ObjectRef, get_global_core, set_global_core
+from .core.driver import (CoreClient, ObjectRef, ObjectRefGenerator,
+                          get_global_core, set_global_core)
 from .core.ids import ActorID, ObjectID, PlacementGroupID, TaskID
 from .core.node import LocalCluster
-from .core.task_spec import TaskSpec
+from .core.task_spec import DYNAMIC_RETURNS, TaskSpec
 
 _init_lock = threading.RLock()
 _local_cluster: Optional[LocalCluster] = None
@@ -247,7 +248,11 @@ class RemoteFunction:
             function_id=self._fid,
             function_name=opts.get("name") or self._fn.__name__,
             args=encoded_args,
-            num_returns=opts["num_returns"],
+            # "dynamic" (reference: num_returns="dynamic"): one ref
+            # resolving to an ObjectRefGenerator of worker-minted refs
+            num_returns=DYNAMIC_RETURNS
+            if opts["num_returns"] == "dynamic"
+            else opts["num_returns"],
             resources=_resolve_resources(opts),
             owner_addr="",
             max_retries=max_retries,
@@ -259,7 +264,7 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
         )
         refs = core.submit_task(spec, temp_refs=temp_refs)
-        return refs[0] if opts["num_returns"] == 1 else refs
+        return refs[0] if opts["num_returns"] in (1, "dynamic") else refs
 
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Remote function {self._fn.__name__} cannot be called "
@@ -317,7 +322,8 @@ class ActorHandle:
             function_id=b"\x00" * 20,
             function_name=method,
             args=encoded_args,
-            num_returns=num_returns,
+            num_returns=DYNAMIC_RETURNS if num_returns == "dynamic"
+            else num_returns,
             resources={},
             owner_addr="",
             actor_id=ActorID(self._actor_id),
@@ -326,7 +332,7 @@ class ActorHandle:
         refs = core.submit_actor_task(self._actor_id, spec,
                                       self._max_task_retries,
                                       temp_refs=temp_refs)
-        return refs[0] if num_returns == 1 else refs
+        return refs[0] if num_returns in (1, "dynamic") else refs
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._class_name,

@@ -32,6 +32,33 @@ from .task_spec import ARG_REF, ARG_VALUE, TaskSpec
 from .worker_runtime import FN_NAMESPACE, _ErrorValue
 
 
+class ObjectRefGenerator:
+    """The value of a ``num_returns="dynamic"`` task's single return
+    (reference: _raylet.pyx ObjectRefGenerator): an indexable,
+    iterable sequence of ObjectRefs the WORKER minted at execution
+    time, one per yielded item.  It is a plain container of refs, so
+    the existing nested-ref machinery (containment pins, borrow
+    registration on deserialize, plasma promotion) carries all of its
+    lifetime semantics."""
+
+    __slots__ = ("_refs",)
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
 class ObjectRef:
     """A handle to a (possibly pending) object (reference: ObjectRef in
     _raylet.pyx).  Dropping the last local reference releases the object."""

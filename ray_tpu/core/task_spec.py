@@ -20,6 +20,7 @@ EPS = 1e-6
 # Argument encodings inside a spec.
 ARG_VALUE = 0   # inline serialized bytes
 ARG_REF = 1     # ObjectID binary — resolved before execution
+DYNAMIC_RETURNS = -1   # num_returns sentinel: worker-minted child refs
 
 
 class ResourceSet:
@@ -220,7 +221,12 @@ class TaskSpec:
 
     def return_ids(self) -> List[ObjectID]:
         tid = self.task_id
-        return [ObjectID.for_task_return(tid, i) for i in range(self.num_returns)]
+        # dynamic (-1): ONE top-level return holding an
+        # ObjectRefGenerator; the worker mints the children at
+        # execution time (reference: num_returns="dynamic")
+        n = 1 if self.num_returns == DYNAMIC_RETURNS \
+            else self.num_returns
+        return [ObjectID.for_task_return(tid, i) for i in range(n)]
 
     def arg_ref_ids(self) -> List[ObjectID]:
         return [ObjectID(a[1]) for a in self.d["args"] if a[0] == ARG_REF]

@@ -38,7 +38,9 @@ def _get_worker_core():
     from .driver import get_global_core
     return get_global_core()
 from .object_store import client as store_client
-from .task_spec import ARG_REF, ARG_VALUE, TaskSpec
+import functools
+
+from .task_spec import (ARG_REF, ARG_VALUE, DYNAMIC_RETURNS, TaskSpec)
 
 FN_NAMESPACE = "fn"
 
@@ -213,7 +215,10 @@ class WorkerRuntime:
 
     async def _store_returns(self, spec: TaskSpec, result: Any) -> List[dict]:
         nret = spec.num_returns
-        values = [result] if nret == 1 else list(result)
+        # dynamic: result was already materialized into an
+        # ObjectRefGenerator by _execute — ONE top-level return
+        values = [result] if nret in (1, DYNAMIC_RETURNS) \
+            else list(result)
         if nret > 1 and len(values) != nret:
             raise ValueError(f"task {spec.function_name} declared {nret} returns "
                              f"but produced {len(values)}")
@@ -369,6 +374,41 @@ class WorkerRuntime:
             result = await result  # sync wrapper returned a coroutine
         return result
 
+    @staticmethod
+    def _dynamic_wrapper(fn, fname: str):
+        """num_returns="dynamic": exhaust the user's generator INSIDE
+        the normal execution lane — the generator body must see the
+        task's runtime_env, current-spec context, and cancellation
+        registration, and must run on the executor thread, none of
+        which hold once the lazily-evaluated generator escapes to the
+        event loop."""
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            try:
+                items = iter(out)
+            except TypeError:
+                raise TypeError(
+                    f"task {fname} declared num_returns='dynamic' but "
+                    f"returned non-iterable "
+                    f"{type(out).__name__}") from None
+            return list(items)
+        return wrapper
+
+    async def _materialize_dynamic(self, spec: TaskSpec, values: list):
+        """Store each already-evaluated yielded value as its own object
+        via api.put (the existing nested-ref machinery owns promotion,
+        containment pins, and borrows — reference: _raylet.pyx dynamic
+        return generators) and return an ObjectRefGenerator as the
+        single top-level value."""
+        from .. import api
+        from .driver import ObjectRefGenerator
+        refs = []
+        for item in values:
+            refs.append(await self._loop.run_in_executor(
+                None, api.put, item))
+        return ObjectRefGenerator(refs)
+
     async def _execute(self, spec: TaskSpec, fn) -> dict:
         # NB: store pins taken while resolving reference args are *not*
         # released after execution — deserialization is zero-copy, so user
@@ -378,7 +418,12 @@ class WorkerRuntime:
         # pin-while-mapped semantics).
         try:
             args, kwargs, _views = await self._resolve_args(spec)
+            dynamic = spec.num_returns == DYNAMIC_RETURNS
+            if dynamic:
+                fn = self._dynamic_wrapper(fn, spec.function_name)
             result = await self._run_target(spec, fn, args, kwargs)
+            if dynamic:
+                result = await self._materialize_dynamic(spec, result)
             returns = await self._store_returns(spec, result)
             # Borrow barrier: refs deserialized during this task registered
             # borrows via fire-and-forget notifies on the worker-core's own

@@ -83,12 +83,14 @@ class HTTPProxy:
                 await resp.write(
                     b"data: " + json.dumps(obj).encode() + b"\n\n")
 
-            # once prepared, this exchange IS the response: mid-stream
-            # failures must become in-band error events (a second
-            # Response on a live stream corrupts the connection), and
-            # EVERY exit must release the replica's KV cache
-            await resp.prepare(request)
+            # from here the session exists and this exchange IS the
+            # response: prepare() itself can raise on a dead transport,
+            # so it lives INSIDE the try — every exit path must release
+            # the replica's KV cache, and mid-stream failures become
+            # in-band error events (a second Response on a live stream
+            # corrupts the connection)
             try:
+                await resp.prepare(request)
                 await emit(out)
                 if sid is not None and "error" not in out:
                     for _ in range(max_new - 1):
