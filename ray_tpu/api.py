@@ -182,6 +182,18 @@ _DEFAULT_ACTOR_OPTIONS = dict(
 )
 
 
+def _normalize_num_returns(n) -> int:
+    """"dynamic" → the sentinel; ints validated so a stray -1 can never
+    silently activate the dynamic machinery."""
+    if n == "dynamic":
+        return DYNAMIC_RETURNS
+    if isinstance(n, int) and not isinstance(n, bool) and n >= 0:
+        return n
+    raise ValueError(
+        f"num_returns must be 'dynamic' or a non-negative int "
+        f"(got {n!r})")
+
+
 def _resolve_resources(opts: dict) -> Dict[str, float]:
     res = dict(opts.get("resources") or {})
     if opts.get("num_cpus"):
@@ -250,9 +262,7 @@ class RemoteFunction:
             args=encoded_args,
             # "dynamic" (reference: num_returns="dynamic"): one ref
             # resolving to an ObjectRefGenerator of worker-minted refs
-            num_returns=DYNAMIC_RETURNS
-            if opts["num_returns"] == "dynamic"
-            else opts["num_returns"],
+            num_returns=_normalize_num_returns(opts["num_returns"]),
             resources=_resolve_resources(opts),
             owner_addr="",
             max_retries=max_retries,
@@ -322,8 +332,7 @@ class ActorHandle:
             function_id=b"\x00" * 20,
             function_name=method,
             args=encoded_args,
-            num_returns=DYNAMIC_RETURNS if num_returns == "dynamic"
-            else num_returns,
+            num_returns=_normalize_num_returns(num_returns),
             resources={},
             owner_addr="",
             actor_id=ActorID(self._actor_id),

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import inspect
 import os
 import threading
 import time
@@ -381,18 +382,31 @@ class WorkerRuntime:
         task's runtime_env, current-spec context, and cancellation
         registration, and must run on the executor thread, none of
         which hold once the lazily-evaluated generator escapes to the
-        event loop."""
-        @functools.wraps(fn)
-        def wrapper(*args, **kwargs):
-            out = fn(*args, **kwargs)
+        event loop.  Async functions keep their async dispatch: the
+        wrapper mirrors the wrapped function's color."""
+        def _listify(out):
             try:
-                items = iter(out)
+                return list(iter(out))
             except TypeError:
                 raise TypeError(
                     f"task {fname} declared num_returns='dynamic' but "
                     f"returned non-iterable "
                     f"{type(out).__name__}") from None
-            return list(items)
+
+        if inspect.isasyncgenfunction(fn):
+            @functools.wraps(fn)
+            async def agen_wrapper(*args, **kwargs):
+                return [item async for item in fn(*args, **kwargs)]
+            return agen_wrapper
+        if inspect.iscoroutinefunction(fn):
+            @functools.wraps(fn)
+            async def coro_wrapper(*args, **kwargs):
+                return _listify(await fn(*args, **kwargs))
+            return coro_wrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return _listify(fn(*args, **kwargs))
         return wrapper
 
     async def _materialize_dynamic(self, spec: TaskSpec, values: list):
@@ -400,14 +414,14 @@ class WorkerRuntime:
         via api.put (the existing nested-ref machinery owns promotion,
         containment pins, and borrows — reference: _raylet.pyx dynamic
         return generators) and return an ObjectRefGenerator as the
-        single top-level value."""
+        single top-level value.  Puts are independent: they overlap on
+        the worker's own pool; gather preserves yield order."""
         from .. import api
         from .driver import ObjectRefGenerator
-        refs = []
-        for item in values:
-            refs.append(await self._loop.run_in_executor(
-                None, api.put, item))
-        return ObjectRefGenerator(refs)
+        refs = await asyncio.gather(*[
+            self._loop.run_in_executor(self.executor, api.put, item)
+            for item in values])
+        return ObjectRefGenerator(list(refs))
 
     async def _execute(self, spec: TaskSpec, fn) -> dict:
         # NB: store pins taken while resolving reference args are *not*

@@ -36,6 +36,8 @@ from .air_integrations import (  # noqa: F401
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
 from .deployment import Deployment, deployment  # noqa: F401
+from .ingress import ingress, route  # noqa: F401
+from .replica import ReplicaContext, get_replica_context  # noqa: F401
 from .gang import GangContext, get_gang_context  # noqa: F401
 from .graph import composed, pipeline, run_graph  # noqa: F401
 from .handle import ServeHandle  # noqa: F401

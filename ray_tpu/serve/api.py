@@ -130,6 +130,10 @@ def run(target: Deployment, *, name: Optional[str] = None,
         "gang_size": dep.config.gang_size,
         "gang_mesh": dep.config.gang_mesh,
         "gang_strategy": dep.config.gang_strategy,
+        # @serve.ingress deployments receive the full http context
+        # (path/method/query/body) from the proxy
+        "ingress": bool(getattr(dep.func_or_class, "_serve_ingress",
+                                False)),
     }
     core_api.get(_state["controller"].deploy.remote(
         dep_name, dumps_function(dep.func_or_class), dep.init_args,

@@ -311,6 +311,7 @@ class ServeController:
         for name, entry in self._deployments.items():
             table[name] = {
                 "route_prefix": entry.get("route_prefix"),
+                "ingress": entry["config"].get("ingress", False),
                 "max_concurrent_queries":
                     entry["config"].get("max_concurrent_queries", 8),
                 "replicas": [{"id": r["id"], "handle": r["handle"],

@@ -124,6 +124,7 @@ class HTTPProxy:
                 return web.json_response(self._router.route_prefixes())
             if path == "/-/healthz":
                 return web.Response(text="ok")
+            full_path = path
             streaming = path.endswith("/stream")
             if streaming:
                 path = path[:-len("/stream")]
@@ -131,6 +132,15 @@ class HTTPProxy:
             if name is None:
                 return web.Response(status=404,
                                     text=f"no deployment for {path}")
+            info = self._router.route_info(name)
+            ingress = info.get("ingress", False)
+            if ingress and streaming:
+                # the SSE decode-session lane is for token generators;
+                # an ingress route ending in /stream is the
+                # deployment's OWN route — re-match on the full path
+                streaming = False
+                path = full_path
+                name = self._router.match_route(path) or name
             if request.can_read_body:
                 raw = await request.read()
                 try:
@@ -139,7 +149,19 @@ class HTTPProxy:
                     payload = raw.decode("utf-8", "replace")
             else:
                 payload = None
-            if payload is None and request.query:
+            if ingress:
+                # @serve.ingress: the deployment dispatches on the full
+                # http context; body is the RAW decoded body only —
+                # query params have their own field
+                prefix = (info.get("route_prefix") or "/").rstrip("/")
+                from .ingress import HTTP_KEY
+                payload = {HTTP_KEY: {
+                    "path": path[len(prefix):] or "/",
+                    "method": request.method,
+                    "query": dict(request.query),
+                    "body": payload,
+                }}
+            elif payload is None and request.query:
                 payload = dict(request.query)
 
             if streaming:
@@ -161,6 +183,13 @@ class HTTPProxy:
                 return web.Response(body=bytes(result))
             if isinstance(result, str):
                 return web.Response(text=result)
+            if ingress and isinstance(result, dict) \
+                    and isinstance(result.get("status"), int):
+                # ingress dispatchers signal HTTP status via the
+                # reserved key (404/405 must not read as 200 to load
+                # balancers and monitors)
+                return web.json_response(result,
+                                         status=result["status"])
             return web.json_response(result)
 
         app = web.Application()

@@ -1,0 +1,93 @@
+"""HTTP ingress deployments: sub-path routing inside a deployment.
+
+Capability mirror of the reference's ``serve.ingress`` (serve/api.py —
+bind a FastAPI app so one deployment serves many routes/methods).
+FastAPI is not in this image, so the TPU-native shape is a lightweight
+route table: decorate methods with :func:`route` and the class with
+:func:`ingress`; the HTTP proxy forwards the full request context
+(sub-path, method, query, body) to ingress deployments, and the
+generated ``__call__`` dispatches.
+
+    @serve.deployment
+    @serve.ingress
+    class Api:
+        @serve.route("/items", methods=("GET",))
+        def list_items(self, request):
+            return {"items": [...], "q": request["query"]}
+
+        @serve.route("/items", methods=("POST",))
+        def add_item(self, request):
+            return {"added": request["body"]}
+
+``request`` is ``{"path", "method", "query", "body"}`` where ``path``
+is the remainder AFTER the deployment's route prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+#: key the proxy uses to ship http context to ingress deployments
+HTTP_KEY = "__http__"
+
+
+def route(path: str, methods: Sequence[str] = ("GET", "POST")):
+    """Mark a method as handling ``path`` (exact or prefix of deeper
+    paths) for the given HTTP methods."""
+    if not path.startswith("/"):
+        raise ValueError(f"route path must start with '/' (got {path!r})")
+
+    def deco(fn: Callable) -> Callable:
+        routes = getattr(fn, "_serve_routes", [])
+        fn._serve_routes = routes + [
+            (path, tuple(m.upper() for m in methods))]
+        return fn
+    return deco
+
+
+def ingress(cls):
+    """Class decorator wiring the route table into ``__call__``."""
+    if not isinstance(cls, type):
+        raise TypeError("@serve.ingress decorates a class (apply it "
+                        "UNDER @serve.deployment)")
+    table = []          # (path, methods, attr_name)
+    seen = set()
+    # walk the MRO: routes inherited from base classes are routes too
+    # (nearest definition wins, like normal attribute lookup)
+    for klass in cls.__mro__:
+        for attr_name, attr in vars(klass).items():
+            if attr_name in seen:
+                continue
+            seen.add(attr_name)
+            for path, methods in getattr(attr, "_serve_routes", ()):
+                table.append((path, methods, attr_name))
+    if not table:
+        raise ValueError(
+            "@serve.ingress found no @serve.route-decorated methods "
+            f"on {cls.__name__}")
+    # longest prefix wins, like the proxy's own route matching
+    table.sort(key=lambda t: -len(t[0]))
+
+    def __call__(self, request: Any):
+        ctx = request.get(HTTP_KEY) if isinstance(request, dict) else None
+        if ctx is None:
+            raise TypeError(
+                f"{cls.__name__} is an ingress deployment: call it over "
+                "HTTP (the proxy supplies the request context), not "
+                "through a bare handle payload")
+        path, method = ctx["path"] or "/", ctx["method"].upper()
+        allowed_elsewhere = False
+        for rpath, methods, attr in table:
+            if path == rpath or path.startswith(
+                    rpath.rstrip("/") + "/"):
+                if method in methods:
+                    return getattr(self, attr)(ctx)
+                allowed_elsewhere = True
+        if allowed_elsewhere:
+            return {"error": f"method {method} not allowed for {path}",
+                    "status": 405}
+        return {"error": f"no route for {path}", "status": 404}
+
+    cls.__call__ = __call__
+    cls._serve_ingress = True
+    return cls

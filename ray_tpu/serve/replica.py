@@ -10,9 +10,30 @@ class/function, counts in-flight queries, supports `reconfigure`
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import inspect
 import threading
 from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaContext:
+    """What serve.get_replica_context() returns inside a replica
+    (reference: serve/context.py ReplicaContext)."""
+    deployment: str
+    replica_tag: str
+
+
+#: set by ServeReplica.__init__ in the replica's worker process
+_replica_context: Optional[ReplicaContext] = None
+
+
+def get_replica_context() -> ReplicaContext:
+    if _replica_context is None:
+        raise RuntimeError(
+            "get_replica_context() may only be called inside a Serve "
+            "replica (deployment __init__ or request handler)")
+    return _replica_context
 
 
 class ServeReplica:
@@ -22,6 +43,10 @@ class ServeReplica:
         from ..core.serialization import loads_function
         self.deployment_name = deployment_name
         self.replica_id = replica_id
+        # replica context (reference: serve.get_replica_context()) —
+        # set BEFORE user __init__ runs so constructors can read it
+        global _replica_context
+        _replica_context = ReplicaContext(deployment_name, replica_id)
         fc = loads_function(callable_blob)
         if inspect.isclass(fc):
             self._callable = fc(*init_args, **init_kwargs)

@@ -75,6 +75,14 @@ class Router:
                     best = (name, prefix)
         return best[0] if best else None
 
+    def route_info(self, name: str) -> dict:
+        """Deployment routing metadata for the proxy: prefix + whether
+        it takes the full http context (@serve.ingress)."""
+        self._refresh()
+        entry = self._table.get(name, {})
+        return {"route_prefix": entry.get("route_prefix"),
+                "ingress": entry.get("ingress", False)}
+
     def assign_request(self, name: str, args: tuple, kwargs: dict,
                        method: Optional[str] = None,
                        timeout_s: float = 60.0):

@@ -97,3 +97,26 @@ def test_dynamic_generator_body_sees_runtime_env(cluster):
 
     gen = ray_tpu.get(produce.remote())
     assert [ray_tpu.get(r) for r in gen] == ["inside", "inside"]
+
+
+def test_dynamic_rejects_bad_num_returns(cluster):
+    with pytest.raises(ValueError, match="num_returns"):
+        @ray_tpu.remote(num_returns=-1)
+        def f():
+            return 1
+        f.remote()
+
+
+def test_dynamic_async_actor_generator(cluster):
+    """Async generators keep their async dispatch through the dynamic
+    wrapper (they run on the actor's asyncio lane)."""
+    @ray_tpu.remote(max_concurrency=2)
+    class AsyncGen:
+        async def produce(self, n):
+            for i in range(n):
+                yield i * 2
+
+    a = AsyncGen.remote()
+    gen = ray_tpu.get(
+        a.produce.options(num_returns="dynamic").remote(3))
+    assert [ray_tpu.get(r) for r in gen] == [0, 2, 4]

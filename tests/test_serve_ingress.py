@@ -1,0 +1,105 @@
+"""serve.ingress sub-path routing + get_replica_context (reference
+capability: serve.ingress(FastAPI app) and serve/context.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def app():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    serve.start()
+
+    @serve.deployment
+    @serve.ingress
+    class Api:
+        def __init__(self):
+            self.items = []
+            ctx = serve.get_replica_context()
+            self.me = f"{ctx.deployment}#{ctx.replica_tag}"
+
+        @serve.route("/items", methods=("GET",))
+        def list_items(self, request):
+            return {"items": self.items, "q": request["query"]}
+
+        @serve.route("/items", methods=("POST",))
+        def add_item(self, request):
+            self.items.append(request["body"])
+            return {"count": len(self.items)}
+
+        @serve.route("/whoami", methods=("GET",))
+        def whoami(self, request):
+            return {"replica": self.me}
+
+    serve.run(Api.bind(), name="api")
+    yield serve.api.http_address()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_method_dispatch(app):
+    import requests
+    assert requests.get(f"{app}/api/items",
+                        timeout=30).json() == {"items": [], "q": {}}
+    r = requests.post(f"{app}/api/items", json={"name": "x"},
+                      timeout=30)
+    assert r.json() == {"count": 1}
+    got = requests.get(f"{app}/api/items", timeout=30).json()
+    assert got["items"] == [{"name": "x"}]
+
+
+def test_query_params_forwarded(app):
+    import requests
+    got = requests.get(f"{app}/api/items?limit=5&sort=asc",
+                       timeout=30).json()
+    assert got["q"] == {"limit": "5", "sort": "asc"}
+
+
+def test_unknown_route_and_method(app):
+    import requests
+    r = requests.get(f"{app}/api/nope", timeout=30)
+    assert r.status_code == 404 and r.json()["status"] == 404
+    r = requests.delete(f"{app}/api/items", timeout=30)
+    assert r.status_code == 405 and r.json()["status"] == 405
+
+
+def test_ingress_routes_inherit_from_bases():
+    class Base:
+        @serve.route("/ping", methods=("GET",))
+        def ping(self, request):
+            return {"pong": True}
+
+    @serve.ingress
+    class Child(Base):
+        @serve.route("/extra", methods=("GET",))
+        def extra(self, request):
+            return {"extra": True}
+
+    from ray_tpu.serve.ingress import HTTP_KEY
+    c = Child()
+    out = c({HTTP_KEY: {"path": "/ping", "method": "GET",
+                        "query": {}, "body": None}})
+    assert out == {"pong": True}
+    out = c({HTTP_KEY: {"path": "/extra", "method": "GET",
+                        "query": {}, "body": None}})
+    assert out == {"extra": True}
+
+
+def test_replica_context_inside_replica(app):
+    import requests
+    who = requests.get(f"{app}/api/whoami", timeout=30).json()
+    assert who["replica"].startswith("api#")
+
+
+def test_replica_context_outside_raises():
+    with pytest.raises(RuntimeError, match="inside a Serve replica"):
+        serve.get_replica_context()
+
+
+def test_ingress_requires_routes():
+    with pytest.raises(ValueError, match="no @serve.route"):
+        @serve.ingress
+        class Empty:
+            pass
