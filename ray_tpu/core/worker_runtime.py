@@ -313,9 +313,15 @@ class WorkerRuntime:
         return True
 
     def _is_async(self, fn) -> bool:
-        import inspect
-        return inspect.iscoroutinefunction(fn) or \
-            inspect.iscoroutinefunction(getattr(fn, "__call__", None))
+        # async GENERATOR methods are async too (dynamic returns
+        # dispatch them on the event-loop lane) — they must earn the
+        # async-actor default concurrency cap like coroutines do
+        return inspect.iscoroutinefunction(fn) \
+            or inspect.isasyncgenfunction(fn) \
+            or inspect.iscoroutinefunction(getattr(fn, "__call__",
+                                                   None)) \
+            or inspect.isasyncgenfunction(getattr(fn, "__call__",
+                                                  None))
 
     async def _run_target(self, spec: TaskSpec, fn, args, kwargs):
         """Dispatch to the right execution lane.
@@ -415,11 +421,14 @@ class WorkerRuntime:
         containment pins, and borrows — reference: _raylet.pyx dynamic
         return generators) and return an ObjectRefGenerator as the
         single top-level value.  Puts are independent: they overlap on
-        the worker's own pool; gather preserves yield order."""
+        the loop's default pool (NOT self.executor — that is the user
+        sync lane, where queuing behind a long user method could even
+        deadlock a caller waiting on these results); gather preserves
+        yield order."""
         from .. import api
         from .driver import ObjectRefGenerator
         refs = await asyncio.gather(*[
-            self._loop.run_in_executor(self.executor, api.put, item)
+            self._loop.run_in_executor(None, api.put, item)
             for item in values])
         return ObjectRefGenerator(list(refs))
 

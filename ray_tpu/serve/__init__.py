@@ -30,8 +30,10 @@ from .schema import (  # noqa: F401
 )
 from .air_integrations import (  # noqa: F401
     PredictorDeployment,
+    json_to_multi_ndarray,
     json_to_ndarray,
     ndarray_to_json,
+    pandas_read_json,
 )
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401

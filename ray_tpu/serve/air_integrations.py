@@ -39,6 +39,28 @@ def ndarray_to_json(pred: Any):
     return arr.tolist() if arr.ndim else arr.item()
 
 
+def json_to_multi_ndarray(payload: Any):
+    """``{"col": {"array": [...]} | [...], ...}`` → dict of arrays
+    (the reference's `http_adapters.json_to_multi_ndarray` — the
+    multi-input model adapter)."""
+    if not isinstance(payload, dict):
+        raise TypeError("json_to_multi_ndarray expects a JSON object "
+                        "mapping input names to arrays")
+    return {k: json_to_ndarray(v) for k, v in payload.items()}
+
+
+def pandas_read_json(payload: Any):
+    """JSON records/columns → pandas DataFrame (the reference's
+    `http_adapters.pandas_read_json` — the tabular-model adapter)."""
+    import pandas as pd
+    if isinstance(payload, list):
+        return pd.DataFrame.from_records(payload)
+    if isinstance(payload, dict):
+        return pd.DataFrame(payload)
+    raise TypeError("pandas_read_json expects JSON records (list of "
+                    "objects) or a columns object")
+
+
 def PredictorDeployment(
         checkpoint: Checkpoint,
         predictor_fn: Callable[[Checkpoint], Callable[[Any], Any]], *,

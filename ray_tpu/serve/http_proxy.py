@@ -138,9 +138,13 @@ class HTTPProxy:
                 # the SSE decode-session lane is for token generators;
                 # an ingress route ending in /stream is the
                 # deployment's OWN route — re-match on the full path
+                # and refresh the metadata (the re-match may land on a
+                # DIFFERENT deployment than the stripped path did)
                 streaming = False
                 path = full_path
                 name = self._router.match_route(path) or name
+                info = self._router.route_info(name)
+                ingress = info.get("ingress", False)
             if request.can_read_body:
                 raw = await request.read()
                 try:

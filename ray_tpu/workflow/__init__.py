@@ -8,6 +8,8 @@ workflow skips finished steps and re-executes the rest.
 """
 
 from .api import (  # noqa: F401
+    Continuation,
+    continuation,
     delete,
     get_output,
     get_status,

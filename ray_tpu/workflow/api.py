@@ -48,6 +48,40 @@ def _assign_step_ids(node: DAGNode, counter: List[int],
     counter[0] += 1
 
 
+class Continuation:
+    """A step's return value that CONTINUES the workflow with another
+    DAG (reference: workflow/api.py:712 ``workflow.continuation`` —
+    dynamic workflows: recursion/loops whose shape is decided at
+    runtime).  The engine executes the inner DAG in the step's place,
+    with inner step ids namespaced under the step so resume stays
+    deterministic."""
+
+    __slots__ = ("dag",)
+
+    def __init__(self, dag: DAGNode):
+        self.dag = dag
+
+
+def continuation(dag_node: DAGNode) -> Continuation:
+    if not isinstance(dag_node, DAGNode):
+        raise TypeError(
+            f"workflow.continuation expects a DAG node bind() result "
+            f"(got {type(dag_node).__name__})")
+    return Continuation(dag_node)
+
+
+class _PendingContinuation:
+    """Checkpoint marker: this step's own function already ran and
+    returned a continuation — resume must NOT re-execute the function
+    (its side effects happened), only finish the recorded chain."""
+
+    __slots__ = ("dag_blob", "depth")
+
+    def __init__(self, dag_blob: bytes, depth: int):
+        self.dag_blob = dag_blob
+        self.depth = depth
+
+
 class _DurableExecutor:
     """Resolves the DAG like DAGNode.execute, but consults storage before
     running a FunctionNode and persists results after."""
@@ -65,6 +99,26 @@ class _DurableExecutor:
         return api.get(out, timeout=600.0) \
             if isinstance(out, ObjectRef) else out
 
+    def _run_continuations(self, step_id: str, val: Any,
+                           depth: int = 0) -> Any:
+        """Dynamic workflows: a returned continuation replaces the
+        step's value with its inner DAG's result.  Each frontier is
+        checkpointed as a _PendingContinuation BEFORE executing, so a
+        crash mid-chain resumes from the deepest recorded frontier
+        instead of re-running finished step functions; inner steps
+        checkpoint under ids namespaced by step and depth."""
+        from ..core.serialization import dumps_function
+        while isinstance(val, Continuation):
+            self.storage.save_step(step_id, _PendingContinuation(
+                dumps_function(val.dag), depth))
+            sub_ids: Dict[int, str] = {}
+            _assign_step_ids(val.dag, [0], sub_ids)
+            prefix = f"{step_id}/c{depth}"
+            sub_ids = {k: f"{prefix}/{v}" for k, v in sub_ids.items()}
+            val = self._resolve(val.dag, sub_ids, {})
+            depth += 1
+        return val
+
     def _resolve(self, node: Any, ids, cache):
         from .. import api
         from ..core.driver import ObjectRef
@@ -76,6 +130,15 @@ class _DurableExecutor:
         if isinstance(node, FunctionNode) and \
                 self.storage.has_step(step_id):
             val = self.storage.load_step(step_id)
+            if isinstance(val, _PendingContinuation):
+                # the step function ran (side effects done); finish its
+                # continuation chain from the recorded frontier
+                from ..core.serialization import loads_function
+                val = self._run_continuations(
+                    step_id,
+                    Continuation(loads_function(val.dag_blob)),
+                    depth=val.depth)
+                self.storage.save_step(step_id, val)
             cache[id(node)] = val
             return val
         # resolve children then run
@@ -86,6 +149,7 @@ class _DurableExecutor:
             if isinstance(node, FunctionNode):
                 ref = node._fn.remote(*args, **kwargs)
                 val = api.get(ref, timeout=600.0)
+                val = self._run_continuations(step_id, val)
                 self.storage.save_step(step_id, val)
             elif isinstance(node, ClassNode):
                 val = node._cls.remote(*args, **kwargs)

@@ -35,6 +35,9 @@ class WorkflowStorage:
 
     # -- atomic file io -----------------------------------------------------
     def _write(self, path: str, obj: Any) -> None:
+        # continuation step ids are hierarchical (step/c0/step...):
+        # the parent directories exist only once the chain runs
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(obj, f)
@@ -73,8 +76,17 @@ class WorkflowStorage:
         return self._read(self._step_path(step_id))
 
     def list_steps(self) -> List[str]:
+        """All step ids, INCLUDING hierarchical continuation
+        checkpoints (steps/<id>/c0/<id>.pkl → '<id>/c0/<id>')."""
         d = os.path.join(self.root, "steps")
-        return sorted(f[:-4] for f in os.listdir(d) if f.endswith(".pkl"))
+        out = []
+        for root, _, files in os.walk(d):
+            rel = os.path.relpath(root, d)
+            for f in files:
+                if f.endswith(".pkl"):
+                    sid = f[:-4] if rel == "." else f"{rel}/{f[:-4]}"
+                    out.append(sid)
+        return sorted(out)
 
     # -- output -------------------------------------------------------------
     def save_output(self, value: Any) -> None:

@@ -157,3 +157,84 @@ def test_workflow_event_timeout(cluster):
     with pytest.raises(Exception):
         workflow.run(dag, workflow_id="wf_evt_timeout")
     workflow.delete("wf_evt_timeout")
+
+
+def test_workflow_continuation_recursion(cluster, tmp_path):
+    """Dynamic workflows (reference: workflow.continuation): a step
+    returns another DAG; the engine runs it in the step's place.
+    Factorial-by-recursion is the reference's canonical example."""
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def fact(n, acc):
+        from ray_tpu import workflow as wf
+        if n <= 1:
+            return acc
+        return wf.continuation(fact.bind(n - 1, acc * n))
+
+    out = workflow.run(fact.bind(5, 1), workflow_id="wc1")
+    assert out == 120
+    assert workflow.get_output("wc1") == 120
+
+
+def test_workflow_continuation_resume_mid_chain(cluster, tmp_path):
+    """Crash inside a continuation: resume finishes the chain, reusing
+    the outer checkpoints."""
+    workflow.init(str(tmp_path))
+    marker = str(tmp_path / "boom_marker")
+
+    @ray_tpu.remote
+    def chain(n, marker):
+        import os
+
+        from ray_tpu import workflow as wf
+        if n == 2 and not os.path.exists(marker):
+            open(marker, "w").write("x")
+            raise RuntimeError("boom at n=2")
+        if n <= 0:
+            return "done"
+        return wf.continuation(chain.bind(n - 1, marker))
+
+    with pytest.raises(Exception):
+        workflow.run(chain.bind(4, marker), workflow_id="wc2")
+    assert workflow.get_status("wc2") == workflow.api.FAILED
+    assert workflow.resume("wc2") == "done"
+
+
+def test_continuation_type_guard(cluster):
+    with pytest.raises(TypeError, match="bind"):
+        workflow.continuation(42)
+
+
+def test_continuation_resume_does_not_rerun_finished_levels(
+        cluster, tmp_path):
+    """Each chain level's function must execute at most twice (once +
+    the crashed level's retry), never the whole prefix again — the
+    frontier checkpoints make resume skip finished levels."""
+    workflow.init(str(tmp_path))
+    logdir = str(tmp_path / "exec_log")
+    os.makedirs(logdir, exist_ok=True)
+
+    @ray_tpu.remote
+    def level(n, logdir):
+        from ray_tpu import workflow as wf
+        with open(f"{logdir}/n{n}", "a") as f:
+            f.write("x")
+        if n == 1 and len(open(f"{logdir}/n1").read()) == 1:
+            raise RuntimeError("crash at level 1, first attempt")
+        if n == 0:
+            return "bottom"
+        return wf.continuation(level.bind(n - 1, logdir))
+
+    with pytest.raises(Exception):
+        workflow.run(level.bind(3, logdir), workflow_id="wc3")
+    assert workflow.resume("wc3") == "bottom"
+    counts = {f: len(open(f"{logdir}/{f}").read())
+              for f in os.listdir(logdir)}
+    # levels 3 and 2 finished before the crash: exactly one execution
+    assert counts["n3"] == 1 and counts["n2"] == 1, counts
+    assert counts["n1"] == 2, counts          # crashed once, retried
+    # the step listing surfaces the hierarchical checkpoints
+    from ray_tpu.workflow import WorkflowStorage
+    steps = WorkflowStorage("wc3").list_steps()
+    assert any("/c0/" in s for s in steps), steps

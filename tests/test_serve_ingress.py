@@ -103,3 +103,21 @@ def test_ingress_requires_routes():
         @serve.ingress
         class Empty:
             pass
+
+
+def test_http_adapters():
+    """Reference http_adapters parity: multi-array and tabular JSON."""
+    import numpy as np
+
+    from ray_tpu.serve import json_to_multi_ndarray, pandas_read_json
+
+    out = json_to_multi_ndarray({"a": [1, 2], "b": {"array": [[3.0]]}})
+    np.testing.assert_array_equal(out["a"], [1, 2])
+    assert out["b"].shape == (1, 1)
+    with pytest.raises(TypeError):
+        json_to_multi_ndarray([1, 2])
+
+    df = pandas_read_json([{"x": 1, "y": "a"}, {"x": 2, "y": "b"}])
+    assert list(df.columns) == ["x", "y"] and len(df) == 2
+    df2 = pandas_read_json({"x": [1, 2], "y": ["a", "b"]})
+    assert df2["x"].tolist() == [1, 2]
