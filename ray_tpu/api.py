@@ -63,7 +63,17 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
             node_id = _local_cluster.node_id
             session_dir = _local_cluster.session_dir
         else:
+            if address == "auto":
+                # reference ray.init(address="auto"): resolve from the
+                # environment (ray-tpu exec/attach/start export these)
+                address = os.environ.get("RAY_TPU_ADDRESS")
+                if address is None:
+                    raise ValueError(
+                        "address='auto' needs RAY_TPU_ADDRESS in the "
+                        "environment (ray-tpu exec/attach set it)")
             controller_addr = address
+            if nodelet_addr is None:
+                nodelet_addr = os.environ.get("RAY_TPU_NODELET")
             if nodelet_addr is None:
                 raise ValueError("connecting to an existing cluster requires "
                                  "nodelet_addr of a local nodelet")

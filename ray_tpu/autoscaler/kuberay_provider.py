@@ -103,6 +103,10 @@ class KubeRayProvider(NodeProvider):
         return {g["groupName"]: g
                 for g in cr["spec"]["workerGroupSpecs"]}
 
+    def set_node_type(self, name: str, shape: Dict[str, Any]) -> None:
+        """No-op: worker shapes are the CR's workerGroupSpecs — YAML
+        shapes from `ray-tpu up` don't apply here."""
+
     # -- provider contract ---------------------------------------------------
     def node_resources(self, node_type: str) -> Dict[str, float]:
         cr = self._get_cr()

@@ -55,8 +55,9 @@ def load_config(path: str) -> Dict[str, Any]:
     if "cluster_name" not in cfg:
         raise ValueError("cluster config needs a cluster_name")
     provider = (cfg.get("provider") or {}).get("type", "local")
-    if provider not in ("local", "tpu_pod"):
-        raise ValueError(f"unknown provider type {provider!r}")
+    if provider not in _PROVIDER_TYPES:
+        raise ValueError(f"unknown provider type {provider!r} "
+                         f"(supported: {_PROVIDER_TYPES})")
     return cfg
 
 
@@ -111,9 +112,12 @@ def up(config_path: str) -> Dict[str, Any]:
         provider = _make_provider(cfg, session_dir, controller_addr)
         for wtype, wcfg in (cfg.get("workers") or {}).items():
             count = int((wcfg or {}).get("count", 0))
-            if hasattr(provider, "node_types") and isinstance(wcfg, dict) \
-                    and wcfg.get("resources"):
-                provider.node_types[wtype] = dict(wcfg["resources"])
+            shape = {k: v for k, v in (wcfg or {}).items()
+                     if k != "count"}
+            # explicit provider contract: each provider decides what a
+            # YAML worker shape means to it (KubeRay: nothing — the CR
+            # is its source of truth)
+            provider.set_node_type(wtype, shape)
             for _ in range(count):
                 nid = provider.create_node(wtype)
                 state["provider_nodes"].append(nid)
@@ -140,10 +144,12 @@ def down(name_or_config: str) -> Dict[str, Any]:
         raise RuntimeError(f"no running cluster named {name!r}")
     with open(state_file) as f:
         state = json.load(f)
-    if state.get("provider") == "tpu_pod":
-        # best effort: a moved/deleted YAML must not make the cluster
-        # permanently un-down-able — the head pids and the state file
-        # still get cleaned up below either way
+    if state.get("provider") not in (None, "local"):
+        # EVERY cloud provider's nodes must terminate here (local
+        # workers are plain pids handled below).  Best effort: a
+        # moved/deleted YAML must not make the cluster permanently
+        # un-down-able — the head pids and the state file still get
+        # cleaned up below either way
         try:
             cfg = load_config(state["config_path"])
             provider = _make_provider(cfg, state["session_dir"],
@@ -186,10 +192,14 @@ def down(name_or_config: str) -> Dict[str, Any]:
     return state
 
 
-def exec_cmd(name_or_config: str, command: List[str],
+def exec_cmd(name_or_config: str, command,
              timeout: Optional[float] = None) -> int:
     """Run a command with the cluster's address exported (the local-form
-    `ray exec`: the command lands on the head environment)."""
+    `ray exec`: the command lands on the head environment).
+
+    A string runs through the shell like the reference's `ray exec`;
+    a list runs as an exact argv (programmatic callers keep precise
+    semantics — the CLI decides which form a user's input is)."""
     name = _resolve_name(name_or_config)
     with open(_state_path(name)) as f:
         state = json.load(f)
@@ -197,7 +207,8 @@ def exec_cmd(name_or_config: str, command: List[str],
     env["RAY_TPU_ADDRESS"] = state["controller"]
     env["RAY_TPU_NODELET"] = state["nodelet"]
     env["RAY_TPU_SESSION_DIR"] = state["session_dir"]
-    proc = subprocess.run(command, env=env, timeout=timeout)
+    proc = subprocess.run(command, env=env, timeout=timeout,
+                          shell=isinstance(command, str))
     return proc.returncode
 
 
@@ -217,6 +228,9 @@ def _resolve_name(name_or_config: str) -> str:
     return name_or_config
 
 
+_PROVIDER_TYPES = ("local", "tpu_pod", "gce", "aws", "kuberay")
+
+
 def _make_provider(cfg: Dict[str, Any], session_dir: str,
                    controller_addr: str):
     from .node_provider import LocalNodeProvider
@@ -224,8 +238,23 @@ def _make_provider(cfg: Dict[str, Any], session_dir: str,
     if ptype == "local":
         return LocalNodeProvider(session_dir, controller_addr,
                                  node_types={})
-    from .tpu_pod_provider import TpuPodProvider
     p = dict(cfg["provider"])
     p.pop("type")
-    return TpuPodProvider(head_address=controller_addr,
-                          node_types={}, **p)
+    if ptype == "tpu_pod":
+        from .tpu_pod_provider import TpuPodProvider
+        return TpuPodProvider(head_address=controller_addr,
+                              node_types={}, **p)
+    if ptype == "gce":
+        from .gce_provider import GceProvider
+        return GceProvider(head_address=controller_addr,
+                           node_types={}, **p)
+    if ptype == "aws":
+        from .aws_provider import AwsProvider
+        p.setdefault("cluster_name", cfg["cluster_name"])
+        return AwsProvider(head_address=controller_addr,
+                           node_types={}, **p)
+    if ptype == "kuberay":
+        from .kuberay_provider import KubeRayProvider
+        p.setdefault("cluster_name", cfg["cluster_name"])
+        return KubeRayProvider(**p)
+    raise ValueError(f"unknown provider type {ptype!r}")

@@ -9,6 +9,12 @@ from typing import Any, Dict, List, Optional
 class NodeProvider:
     """Minimal provider contract: launch/terminate/list."""
 
+    def set_node_type(self, name: str, shape: Dict[str, Any]) -> None:
+        """Register a worker shape from cluster YAML (`ray-tpu up`).
+        Providers whose shapes live elsewhere (KubeRay reads the
+        RayCluster CR) override this to a no-op."""
+        self.node_types[name] = shape    # type: ignore[attr-defined]
+
     def create_node(self, node_type: str) -> str:
         raise NotImplementedError
 
@@ -36,6 +42,12 @@ class LocalNodeProvider(NodeProvider):
         }
         self.object_store_memory = object_store_memory
         self._nodes: Dict[str, Any] = {}
+
+    def set_node_type(self, name: str, shape: Dict[str, Any]) -> None:
+        # local workers are plain processes: only the resource bag
+        # matters out of the YAML shape
+        self.node_types[name] = dict(shape.get("resources")
+                                     or {"CPU": 2.0})
 
     def node_resources(self, node_type: str) -> Dict[str, float]:
         return dict(self.node_types[node_type])

@@ -53,6 +53,9 @@ def submit_job(entrypoint: str, *,
     from .core.node import _child_env
     env = _child_env()  # strips TPU-claim vars in hermetic CPU mode
     env["RAY_TPU_ADDRESS"] = core.controller_addr
+    # init(address="auto") inside the job needs the local nodelet too
+    env["RAY_TPU_NODELET"] = core.nodelet_addr
+    env["RAY_TPU_SESSION_DIR"] = core.session_dir
     env["RAY_TPU_JOB_ID"] = job_id
     for k, v in (runtime_env or {}).get("env_vars", {}).items():
         env[k] = str(v)

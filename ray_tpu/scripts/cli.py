@@ -109,7 +109,10 @@ def cmd_down(args) -> None:
 
 def cmd_exec(args) -> None:
     from ray_tpu.autoscaler import launcher
-    sys.exit(launcher.exec_cmd(args.cluster, args.command))
+    # a single quoted argument is a SHELL command (ray exec semantics);
+    # multiple arguments are an exact argv
+    cmd = args.command[0] if len(args.command) == 1 else args.command
+    sys.exit(launcher.exec_cmd(args.cluster, cmd))
 
 
 def cmd_attach(args) -> None:

@@ -106,14 +106,22 @@ class _DurableExecutor:
         checkpointed as a _PendingContinuation BEFORE executing, so a
         crash mid-chain resumes from the deepest recorded frontier
         instead of re-running finished step functions; inner steps
-        checkpoint under ids namespaced by step and depth."""
-        from ..core.serialization import dumps_function
+        checkpoint under ids namespaced by step and depth.
+
+        The namespace is a HASH of the parent id, not the id itself —
+        literal nesting grows the path by one component per chain level
+        and ENAMETOOLONGs somewhere around depth 150, wedging exactly
+        the unbounded recursions continuations exist for.  Hashing
+        keeps every id two path components deep at any depth, and stays
+        deterministic across resume because the parent ids are."""
+        import hashlib
         while isinstance(val, Continuation):
             self.storage.save_step(step_id, _PendingContinuation(
                 dumps_function(val.dag), depth))
+            tag = hashlib.sha1(step_id.encode()).hexdigest()[:12]
             sub_ids: Dict[int, str] = {}
             _assign_step_ids(val.dag, [0], sub_ids)
-            prefix = f"{step_id}/c{depth}"
+            prefix = f"cont_{tag}_c{depth}"
             sub_ids = {k: f"{prefix}/{v}" for k, v in sub_ids.items()}
             val = self._resolve(val.dag, sub_ids, {})
             depth += 1
@@ -133,7 +141,6 @@ class _DurableExecutor:
             if isinstance(val, _PendingContinuation):
                 # the step function ran (side effects done); finish its
                 # continuation chain from the recorded frontier
-                from ..core.serialization import loads_function
                 val = self._run_continuations(
                     step_id,
                     Continuation(loads_function(val.dag_blob)),

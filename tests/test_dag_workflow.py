@@ -234,7 +234,24 @@ def test_continuation_resume_does_not_rerun_finished_levels(
     # levels 3 and 2 finished before the crash: exactly one execution
     assert counts["n3"] == 1 and counts["n2"] == 1, counts
     assert counts["n1"] == 2, counts          # crashed once, retried
-    # the step listing surfaces the hierarchical checkpoints
+    # the step listing surfaces the (flat, hashed) frontier checkpoints
     from ray_tpu.workflow import WorkflowStorage
     steps = WorkflowStorage("wc3").list_steps()
-    assert any("/c0/" in s for s in steps), steps
+    assert any(s.startswith("cont_") and "_c0/" in s
+               for s in steps), steps
+
+
+def test_continuation_deep_chain_flat_ids(cluster, tmp_path):
+    """Hashed frontier ids keep checkpoint paths flat: a 250-level
+    chain (which would ENAMETOOLONG under literal nesting) completes."""
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def countdown(n):
+        from ray_tpu import workflow as wf
+        if n == 0:
+            return "deep-done"
+        return wf.continuation(countdown.bind(n - 1))
+
+    assert workflow.run(countdown.bind(250),
+                        workflow_id="deep") == "deep-done"

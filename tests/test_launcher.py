@@ -114,3 +114,59 @@ def test_bad_config_rejected(tmp_path):
     bad2.write_text("cluster_name: x\nprovider: {type: martian}\n")
     with pytest.raises(ValueError):
         launcher.load_config(str(bad2))
+
+
+def test_up_with_aws_provider_stubbed(tmp_path, monkeypatch):
+    """`ray-tpu up` against the aws provider: head boots locally, worker
+    instances launch through the (stubbed) EC2 surface with user data
+    that joins the head."""
+    import sys
+    import types
+
+    import yaml
+
+    from tests.test_cloud_providers import FakeEC2
+
+    fake_ec2 = FakeEC2()
+    boto3 = types.ModuleType("boto3")
+    boto3.client = lambda service, region_name=None: fake_ec2
+    monkeypatch.setitem(sys.modules, "boto3", boto3)
+
+    cfg = {
+        "cluster_name": "aws-test",
+        "provider": {"type": "aws", "region": "us-west-2"},
+        "head": {"num_cpus": 1},
+        "workers": {"cpu_16": {"count": 2, "ami": "ami-1",
+                               "instance_type": "m6i.4xlarge",
+                               "host_resources": {"CPU": 16}}},
+    }
+    path = tmp_path / "aws.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    from ray_tpu.autoscaler import launcher
+    monkeypatch.setattr(launcher, "_state_dir",
+                        lambda: str(tmp_path / "state"))
+    state = launcher.up(str(path))
+    try:
+        assert len(state["provider_nodes"]) == 2
+        assert len(fake_ec2.instances) == 2
+        inst = next(iter(fake_ec2.instances.values()))
+        # the join command targets the freshly booted head
+        assert state["controller"] in inst["user_data"]
+        assert inst["tags"]["ray-tpu-cluster"] == "aws-test"
+    finally:
+        launcher.down(str(path))
+    # down() must terminate the INSTANCES, not just local pids
+    states = {i["state"] for i in fake_ec2.instances.values()}
+    assert states == {"shutting-down"}, states
+
+
+def test_unknown_provider_rejected(tmp_path):
+    import yaml
+
+    from ray_tpu.autoscaler import launcher
+    path = tmp_path / "bad.yaml"
+    path.write_text(yaml.safe_dump({"cluster_name": "x",
+                                    "provider": {"type": "azure"}}))
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="azure"):
+        launcher.load_config(str(path))
