@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import builtins
 import itertools
+import os
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 import numpy as np
@@ -32,6 +33,17 @@ def _remote(name: str, fn: Callable, num_returns: int = 1):
 
 
 # -- task bodies (top-level, cloudpickled once each) ------------------------
+
+
+def _bernoulli_sample_block(block: Block, idx: int, seed,
+                            fraction) -> Block:
+    """Bernoulli row sample of one block; seeded PER BLOCK — one shared
+    stream would apply the same positional keep-mask to every block
+    (N copies of one pattern, not a sample)."""
+    rng = np.random.default_rng(None if seed is None else (seed, idx))
+    acc = BlockAccessor(block)
+    keep = np.nonzero(rng.random(acc.num_rows()) < fraction)[0]
+    return acc.take(list(keep))
 
 def _split_block(block: Block, n: int, how: str, seed: Optional[int],
                  part_index: int) -> List[Block]:
@@ -412,6 +424,144 @@ class Dataset:
                                [BlockAccessor(piece).metadata()]))
             prev = idx
         return out
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["Dataset"]:
+        """Split by fractions; the remainder becomes the final split
+        (reference: `Dataset.split_proportionately` — len(proportions)
+        + 1 datasets)."""
+        if not proportions or any(p <= 0 for p in proportions) \
+                or sum(proportions) >= 1.0:
+            raise ValueError("proportions must be positive and sum to "
+                             "< 1 (the remainder is the last split)")
+        n = self.count()
+        indices, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            # round, not truncate: int(50*0.58) is 28 from float error
+            indices.append(builtins.round(n * acc))
+        return self.split_at_indices(indices)
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> List["Dataset"]:
+        """(train, test) by fraction (reference:
+        `Dataset.train_test_split`)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        return ds.split_proportionately([1.0 - test_size])
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Row-level Bernoulli sample (reference:
+        `Dataset.random_sample`)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        sample = _remote("random_sample_block",
+                 _bernoulli_sample_block)
+        # executes eagerly (the per-block index needs the block list);
+        # downstream stages are lazy again on the result
+        return Dataset([sample.remote(b, i, seed, fraction)
+                        for i, b in enumerate(self._blocks)])
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        """Shuffle BLOCK order only — the cheap epoch-to-epoch
+        decorrelation (reference: `Dataset.randomize_block_order`)."""
+        import numpy as _np
+        rng = _np.random.default_rng(seed)
+        order = rng.permutation(self.num_blocks()).tolist()
+        return Dataset([self._blocks[i] for i in order],
+                       [self._meta[i] for i in order])
+
+    def aggregate(self, *aggs) -> Any:
+        """Whole-dataset aggregation with the GroupedData agg tuples
+        (reference: `Dataset.aggregate`): ``aggregate(("mean", "x"),
+        ("max", "x"))`` → dict of results.  ONE column pull per unique
+        column, however many aggregations read it."""
+        known = {"count": len, "sum": np.sum, "min": np.min,
+                 "max": np.max, "mean": np.mean, "std": np.std}
+        for name, _ in aggs:
+            if name not in known:
+                raise ValueError(f"unknown aggregation {name!r} "
+                                 f"(supported: {sorted(known)})")
+        values = {c: self._column_values(c)
+                  for c in {col for _, col in aggs}}
+        return {f"{name}({col})": float(known[name](values[col]))
+                for name, col in aggs}
+
+    def copy(self) -> "Dataset":
+        """New handle sharing this dataset's plan — lazy stages stay
+        lazy; execution results are shared (blocks are immutable)."""
+        return Dataset.from_plan(self._plan)
+
+    # -- reference-name aliases (the execution model is already lazy) --
+    def lazy(self) -> "Dataset":
+        return self
+
+    def fully_executed(self) -> "Dataset":
+        return self.materialize()
+
+    def is_fully_executed(self) -> bool:
+        return self._plan.executed
+
+    def get_internal_block_refs(self) -> List[Any]:
+        """The block ObjectRefs (reference:
+        `Dataset.get_internal_block_refs`)."""
+        return list(self._blocks)
+
+    def to_pandas_refs(self) -> List[Any]:
+        """One DataFrame ref per block (reference:
+        `Dataset.to_pandas_refs` — zero driver materialization)."""
+        @api.remote
+        def _to_df(block: Block):
+            return BlockAccessor(block).to_pandas()
+        return [_to_df.remote(b) for b in self._blocks]
+
+    def to_numpy_refs(self, column: Optional[str] = None) -> List[Any]:
+        """One ndarray ref per block (reference:
+        `Dataset.to_numpy_refs`)."""
+        @api.remote
+        def _to_np(block: Block, _col=column):
+            df = BlockAccessor(block).to_pandas()
+            return df[_col].to_numpy() if _col else df.to_numpy()
+        return [_to_np.remote(b) for b in self._blocks]
+
+    def to_torch(self, *, batch_size: int = 256,
+                 dtypes: Any = None, device: Any = None):
+        """Torch IterableDataset over this dataset (reference:
+        `Dataset.to_torch`)."""
+        import torch
+        outer = self
+
+        class _IterableDataset(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                return outer.iter_torch_batches(
+                    batch_size=batch_size, dtypes=dtypes,
+                    device=device)
+        return _IterableDataset()
+
+    def iter_tf_batches(self, **kwargs):
+        """TensorFlow is not in this image; the reference capability is
+        gated with a clear error (cf. runtime_env conda gating)."""
+        raise ImportError(
+            "iter_tf_batches/to_tf need tensorflow, which this image "
+            "does not ship; use iter_batches (numpy) or "
+            "iter_torch_batches")
+
+    to_tf = iter_tf_batches
+
+    def write_numpy(self, path: str, *,
+                    column: Optional[str] = None) -> None:
+        """One .npy file per block (reference:
+        `Dataset.write_numpy`).  Blocks fetch ONE at a time — peak
+        driver memory is a single block, not the dataset."""
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self.to_numpy_refs(column=column)):
+            arr = api.get(ref, timeout=600.0)
+            np.save(os.path.join(path, f"block_{i:05d}.npy"), arr)
 
     def limit(self, n: int) -> "Dataset":
         taken: List[Block] = []

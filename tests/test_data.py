@@ -389,3 +389,68 @@ def test_streaming_split_epochs_and_equal(cluster):
         all_rows.extend(rows)
     assert counts[0] == counts[1] == 30
     assert sorted(all_rows) == list(range(60))
+
+
+def test_split_proportionately_and_train_test(cluster):
+    ds = rdata.range(100, parallelism=4)
+    a, b, c = ds.split_proportionately([0.5, 0.3])
+    assert (a.count(), b.count(), c.count()) == (50, 30, 20)
+    train, test = ds.train_test_split(0.25)
+    assert (train.count(), test.count()) == (75, 25)
+    tr2, te2 = ds.train_test_split(0.2, shuffle=True, seed=0)
+    assert tr2.count() == 80 and te2.count() == 20
+    assert sorted(r["id"] for r in tr2.take_all() + te2.take_all()) == \
+        list(range(100))
+    with pytest.raises(ValueError):
+        ds.train_test_split(1.5)
+
+
+def test_random_sample_and_block_order(cluster):
+    ds = rdata.range(1000, parallelism=4)
+    sampled = ds.random_sample(0.3, seed=0)
+    n = sampled.count()
+    assert 200 < n < 400, n
+    ds2 = ds.randomize_block_order(seed=1)
+    assert ds2.count() == 1000
+    assert sorted(r["id"] for r in ds2.take_all()) == list(range(1000))
+
+
+def test_dataset_aggregate_and_aliases(cluster):
+    ds = rdata.from_items([{"x": float(i)} for i in range(10)],
+                          parallelism=2)
+    agg = ds.aggregate(("mean", "x"), ("max", "x"), ("count", "x"))
+    assert agg["mean(x)"] == pytest.approx(4.5)
+    assert agg["max(x)"] == 9.0 and agg["count(x)"] == 10
+    assert ds.lazy() is ds
+    m = ds.fully_executed()
+    assert m.is_fully_executed()
+    assert len(ds.get_internal_block_refs()) == ds.num_blocks()
+    assert ds.copy().count() == 10
+
+
+def test_to_refs_and_write_numpy(cluster, tmp_path):
+    ds = rdata.from_items([{"x": float(i)} for i in range(20)],
+                          parallelism=2)
+    dfs = ray_tpu.get(ds.to_pandas_refs())
+    assert sum(len(d) for d in dfs) == 20
+    arrs = ray_tpu.get(ds.to_numpy_refs(column="x"))
+    assert sum(a.shape[0] for a in arrs) == 20
+    out = str(tmp_path / "npy")
+    ds.write_numpy(out, column="x")
+    import os as _os
+    files = sorted(_os.listdir(out))
+    assert len(files) == 2 and files[0].endswith(".npy")
+    total = np.concatenate([np.load(f"{out}/{f}") for f in files])
+    assert sorted(total.tolist()) == [float(i) for i in range(20)]
+
+
+def test_to_torch_iterable(cluster):
+    import torch
+    ds = rdata.from_items([{"x": float(i)} for i in range(64)],
+                          parallelism=2)
+    it = ds.to_torch(batch_size=32)
+    batches = list(iter(it))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    with pytest.raises(ImportError, match="tensorflow"):
+        ds.iter_tf_batches()
