@@ -1,0 +1,38 @@
+"""num_returns="dynamic": tasks whose output count is decided at
+runtime (reference: dynamic generators / ObjectRefGenerator).
+
+The canonical use: a loader discovers how many shards a source splits
+into; downstream tasks consume the shard refs without the whole dataset
+ever landing in one process.
+"""
+
+import numpy as np
+
+import ray_tpu
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def load_shards(n_rows, shard_rows):
+        # shard count depends on the data — unknown at call time
+        for start in range(0, n_rows, shard_rows):
+            yield np.arange(start, min(start + shard_rows, n_rows),
+                            dtype=np.float64)
+
+    @ray_tpu.remote
+    def shard_sum(shard):
+        return float(shard.sum())
+
+    gen = ray_tpu.get(load_shards.remote(1000, 256))
+    print(f"loader produced {len(gen)} shards")
+    totals = ray_tpu.get([shard_sum.remote(ref) for ref in gen])
+    assert sum(totals) == sum(range(1000))
+    print(f"sum over {len(totals)} shard tasks: {sum(totals):.0f}")
+    ray_tpu.shutdown()
+    print("EXAMPLE_OK dynamic_returns")
+
+
+if __name__ == "__main__":
+    main()

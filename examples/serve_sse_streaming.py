@@ -1,0 +1,59 @@
+"""Token streaming over one HTTP connection: SSE through the proxy.
+
+The serving pattern for interactive generation (reference capability:
+Serve's StreamingResponse): `POST /<route>/stream` makes the PROXY
+drive the decode session and emit one server-sent event per token —
+clients read tokens as they decode instead of polling per token, and
+the replica's KV cache is released however the stream ends.
+"""
+
+import json
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+
+    @serve.deployment
+    class Generator:
+        def __init__(self):
+            import jax.numpy as jnp
+
+            from ray_tpu.models import TransformerConfig
+            from ray_tpu.serve.decode_session import DecodeSessionCore
+            self.core = DecodeSessionCore(
+                TransformerConfig.tiny(max_seq_len=64,
+                                       attention_impl="reference",
+                                       dtype=jnp.float32), max_len=64)
+
+        def __call__(self, req):
+            return self.core.handle(req)
+
+    serve.run(Generator.bind(), name="llm")
+    addr = serve.api.http_address()
+
+    import requests
+    tokens = []
+    with requests.post(f"{addr}/llm/stream",
+                       json={"prompt": [3, 1, 4, 1, 5],
+                             "max_new_tokens": 8},
+                       stream=True, timeout=180) as r:
+        for line in r.iter_lines():
+            if not line.startswith(b"data: "):
+                continue
+            body = line[len(b"data: "):]
+            if body == b"[DONE]":
+                break
+            tokens.append(json.loads(body)["token"][0])
+            print(f"token {len(tokens)}: {tokens[-1]}")
+    assert len(tokens) == 8
+    serve.shutdown()
+    ray_tpu.shutdown()
+    print("EXAMPLE_OK serve_sse_streaming")
+
+
+if __name__ == "__main__":
+    main()
