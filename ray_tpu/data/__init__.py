@@ -9,7 +9,7 @@ numpy-dict batches shaped for `jax.device_put` onto a mesh's data axis, and
 """
 
 from .block import Block, BlockAccessor, BlockMetadata  # noqa: F401
-from .dataset import DataIterator, Dataset  # noqa: F401
+from .dataset import ActorPoolStrategy, DataIterator, Dataset  # noqa: F401
 from .dataset_pipeline import DatasetPipeline  # noqa: F401
 from .datasource import (  # noqa: F401
     BinaryDatasource,

@@ -32,6 +32,48 @@ def _remote(name: str, fn: Callable, num_returns: int = 1):
     return _REMOTES[key]
 
 
+class ActorPoolStrategy:
+    """Compute strategy for map_batches: a pool of ``size`` long-lived
+    actors (reference: `data.ActorPoolStrategy` — the stateful
+    batch-inference path)."""
+
+    def __init__(self, size: int = 2):
+        if size < 1:
+            raise ValueError("ActorPoolStrategy size must be >= 1")
+        self.size = size
+
+
+def _map_block_batches(fn: Callable, block: "Block", batch_size,
+                       batch_format: str) -> "Block":
+    """ONE definition of the slice→fn→recombine loop, shared by the
+    task path and the actor path."""
+    acc = BlockAccessor(block)
+    rows = acc.num_rows()
+    size = batch_size or max(rows, 1)
+    outs = []
+    for start in builtins.range(0, max(rows, 1), size):
+        piece = BlockAccessor(acc.slice(start, min(start + size, rows)))
+        res = fn(piece.to_batch(batch_format))
+        outs.append(batch_to_block(res))
+    return BlockAccessor.combine(outs) if outs else block
+
+
+class _BatchMapWorker:
+    """Actor body for map_batches(compute=ActorPoolStrategy): a
+    callable CLASS instantiates ONCE here (the load-model-once
+    contract); plain functions pass through."""
+
+    def __init__(self, fn_blob: bytes):
+        from ..core.serialization import loads_function
+        fn = loads_function(fn_blob)
+        self._fn = fn() if isinstance(fn, type) else fn
+
+    def map_block(self, block, batch_size, batch_format):
+        out = _map_block_batches(self._fn, block, batch_size,
+                                 batch_format)
+        return out, BlockAccessor(out).metadata()
+
+
 # -- task bodies (top-level, cloudpickled once each) ------------------------
 
 
@@ -272,19 +314,60 @@ class Dataset:
             self._plan.with_stage(OneToOneStage(name, block_fn)))
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
-                    batch_format: str = "native") -> "Dataset":
+                    batch_format: str = "native",
+                    compute: Any = None) -> "Dataset":
+        """``compute=ActorPoolStrategy(size=n)`` (reference:
+        `Dataset.map_batches(compute=...)`) runs batches on a pool of
+        long-lived actors instead of one task per block — the stateful
+        path: ``fn`` may be a CLASS, instantiated once per actor (load
+        a model once, map many blocks)."""
+        if compute is not None and not (isinstance(compute, str)
+                                        and compute == "tasks"):
+            return self._map_batches_actors(fn, batch_size,
+                                            batch_format, compute)
+        if isinstance(fn, type):
+            raise ValueError(
+                "a callable CLASS needs the actor compute strategy "
+                "(pass compute=ActorPoolStrategy(...)): tasks would "
+                "re-instantiate it per block")
+
         def block_fn(block: Block) -> Block:
-            acc = BlockAccessor(block)
-            rows = acc.num_rows()
-            size = batch_size or max(rows, 1)
-            outs = []
-            for start in builtins.range(0, max(rows, 1), size):
-                piece = BlockAccessor(acc.slice(start, min(start + size,
-                                                           rows)))
-                res = fn(piece.to_batch(batch_format))
-                outs.append(batch_to_block(res))
-            return BlockAccessor.combine(outs) if outs else block
+            return _map_block_batches(fn, block, batch_size,
+                                      batch_format)
         return self._map_all(block_fn, "map_batches")
+
+    def _map_batches_actors(self, fn, batch_size, batch_format,
+                            compute) -> "Dataset":
+        """Executes eagerly: the pool's lifetime brackets the map."""
+        if isinstance(compute, ActorPoolStrategy):
+            size = compute.size
+        elif isinstance(compute, int) and not isinstance(compute, bool):
+            size = compute
+        else:
+            raise ValueError(
+                f"compute must be \"tasks\", an int pool size, or "
+                f"ActorPoolStrategy(size=n) (got {compute!r})")
+        from ..core.serialization import dumps_function
+        worker_cls = api.remote(_BatchMapWorker)
+        blob = dumps_function(fn)
+        actors = [worker_cls.remote(blob)
+                  for _ in builtins.range(max(1, size))]
+        try:
+            pairs = [actors[i % len(actors)].map_block.options(
+                num_returns=2).remote(b, batch_size, batch_format)
+                for i, b in enumerate(self._blocks)]
+            refs = [p[0] for p in pairs]
+            # no timeout: stateful maps (model inference over many
+            # blocks) legitimately run long; failures surface through
+            # the actor-death path, not a wall-clock guess
+            metas = api.get([p[1] for p in pairs], timeout=None)
+            return Dataset(refs, metas)
+        finally:
+            for a in actors:
+                try:
+                    api.kill(a, no_restart=True)
+                except Exception:
+                    pass
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         def block_fn(block: Block) -> Block:

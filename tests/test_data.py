@@ -454,3 +454,50 @@ def test_to_torch_iterable(cluster):
     assert isinstance(batches[0]["x"], torch.Tensor)
     with pytest.raises(ImportError, match="tensorflow"):
         ds.iter_tf_batches()
+
+
+def test_map_batches_actor_pool_stateful(cluster):
+    """compute=ActorPoolStrategy: a callable CLASS instantiates once
+    per pool actor (the load-model-once batch-inference contract)."""
+    import os as _os
+
+    class AddPid:
+        def __init__(self):
+            self.pid = _os.getpid()   # one per actor, not per block
+
+        def __call__(self, batch):
+            return [{"x": r["x"] + 1, "pid": self.pid} for r in batch]
+
+    ds = rdata.from_items([{"x": i} for i in range(40)], parallelism=8)
+    out = ds.map_batches(AddPid, batch_size=5,
+                         compute=rdata.ActorPoolStrategy(size=2))
+    rows = out.take_all()
+    assert sorted(r["x"] for r in rows) == list(range(1, 41))
+    # 8 blocks mapped onto exactly 2 distinct actor processes
+    assert len({r["pid"] for r in rows}) == 2
+
+
+def test_map_batches_class_requires_actor_strategy(cluster):
+    class F:
+        def __call__(self, b):
+            return b
+
+    ds = rdata.range(4, parallelism=1)
+    with pytest.raises(ValueError, match="ActorPoolStrategy"):
+        ds.map_batches(F)
+
+
+def test_map_batches_actor_pool_function(cluster):
+    ds = rdata.from_items([{"x": i} for i in range(10)], parallelism=2)
+    out = ds.map_batches(lambda b: [{"x": r["x"] * 2} for r in b],
+                         compute=rdata.ActorPoolStrategy(size=1))
+    assert sorted(r["x"] for r in out.take_all()) == \
+        [i * 2 for i in range(10)]
+
+
+def test_map_batches_bad_compute_rejected(cluster):
+    ds = rdata.range(4, parallelism=1)
+    with pytest.raises(ValueError, match="ActorPoolStrategy"):
+        ds.map_batches(lambda b: b, compute="actors")
+    with pytest.raises(ValueError, match="ActorPoolStrategy"):
+        ds.map_batches(lambda b: b, compute=rdata.ActorPoolStrategy)
