@@ -341,11 +341,12 @@ class Dataset:
         """Executes eagerly: the pool's lifetime brackets the map."""
         if isinstance(compute, ActorPoolStrategy):
             size = compute.size
-        elif isinstance(compute, int) and not isinstance(compute, bool):
+        elif isinstance(compute, int) and not isinstance(compute, bool) \
+                and compute >= 1:
             size = compute
         else:
             raise ValueError(
-                f"compute must be \"tasks\", an int pool size, or "
+                f"compute must be \"tasks\", an int pool size >= 1, or "
                 f"ActorPoolStrategy(size=n) (got {compute!r})")
         from ..core.serialization import dumps_function
         worker_cls = api.remote(_BatchMapWorker)

@@ -17,6 +17,9 @@ Wire protocol (all msgpack-native types):
   log_action    {episode_id, obs, action}   -> {}   (off-policy actions)
   log_returns   {episode_id, reward}        -> {}
   end_episode   {episode_id, obs}           -> {}
+  get_policy    {}                          -> pickled {layers, epsilon,
+                                               num_actions}  (local
+                                               client-side inference)
 """
 
 from __future__ import annotations
@@ -52,7 +55,7 @@ class PolicyServerInput:
         self._lt = rpc.EventLoopThread("rl-policy-server")
         self.server = rpc.RpcServer(host, port)
         for name in ("start_episode", "get_action", "log_action",
-                     "log_returns", "end_episode"):
+                     "log_returns", "end_episode", "get_policy"):
             fn = getattr(self, "_h_" + name)
 
             async def handler(conn, data, _fn=fn):
@@ -114,6 +117,36 @@ class PolicyServerInput:
             ep["reward_since"] += r
             ep["return"] += r
 
+    def _h_get_policy(self, data) -> bytes:
+        """Weights + exploration state for LOCAL client-side inference
+        (reference: policy_client.py inference_mode="local" — clients
+        poll this instead of a round trip per action).  The payload is
+        numpy-only: the client needs no jax."""
+        import pickle
+
+        import jax
+        params = jax.tree_util.tree_map(np.asarray, self._algo.params)
+        if not isinstance(params, list):
+            raise TypeError(
+                "local inference serves plain MLP Q-networks; this "
+                "algorithm's params are structured (e.g. dueling heads)"
+                " — use remote inference (get_action)")
+        eps = float(self._algo._explorer.epsilon(
+            self._algo._total_env_steps)) \
+            if hasattr(self._algo, "_explorer") else 0.0
+        num_actions = getattr(self._algo, "n_actions", None)
+        if num_actions is None:
+            # fail at SYNC time, not at the first exploratory step
+            raise TypeError(
+                "local inference needs the algorithm to expose "
+                "n_actions (the epsilon branch samples uniformly)")
+        return pickle.dumps({
+            "layers": [{"w": np.asarray(l["w"]), "b": np.asarray(l["b"])}
+                       for l in params],
+            "epsilon": eps,
+            "num_actions": int(num_actions),
+        })
+
     def _h_end_episode(self, data) -> None:
         with self._lock:
             ep = self._episode(data)
@@ -140,20 +173,76 @@ class PolicyServerInput:
 
 
 class PolicyClient:
-    """The simulator side (reference: rllib/env/policy_client.py
-    remote-inference mode): a blocking msgpack client any Python
-    process can run — no jax required."""
+    """The simulator side (reference: rllib/env/policy_client.py): a
+    blocking msgpack client any Python process can run — no jax
+    required.
 
-    def __init__(self, address: str):
+    ``inference_mode="remote"`` (default): every get_action is a round
+    trip, the server computes.  ``inference_mode="local"``: the client
+    polls the policy weights every ``update_interval_s`` and computes
+    epsilon-greedy actions itself with a pure-numpy forward — one RPC
+    per WEIGHT SYNC instead of one per step; actions report back via
+    log_action so the learner still sees every transition.
+    """
+
+    def __init__(self, address: str, *,
+                 inference_mode: str = "remote",
+                 update_interval_s: float = 2.0, seed: int = 0):
+        if inference_mode not in ("remote", "local"):
+            raise ValueError("inference_mode must be 'remote'|'local'")
         host, port = address.rsplit(":", 1)
         self._lt = rpc.EventLoopThread("rl-policy-client")
         self._client = rpc.BlockingClient.connect(self._lt, host,
                                                   int(port))
+        self._mode = inference_mode
+        self._update_interval_s = update_interval_s
+        self._policy = None
+        self._policy_ts = 0.0
+        self._rng = np.random.default_rng(seed)
+
+    # -- local inference -----------------------------------------------------
+    def _sync_policy(self) -> None:
+        import pickle
+        import time
+        if self._policy is not None and \
+                time.monotonic() - self._policy_ts \
+                < self._update_interval_s:
+            return
+        self._policy = pickle.loads(
+            self._client.call("get_policy", {}))
+        self._policy_ts = time.monotonic()
+
+    def _local_q(self, obs) -> np.ndarray:
+        # float32 end to end, matching the server's XLA forward
+        x = np.asarray(obs, np.float32)
+        layers = self._policy["layers"]
+        for layer in layers[:-1]:
+            x = np.tanh(x @ layer["w"].astype(np.float32)
+                        + layer["b"].astype(np.float32))
+        return x @ layers[-1]["w"].astype(np.float32) \
+            + layers[-1]["b"].astype(np.float32)
+
+    def _local_action(self, obs) -> int:
+        self._sync_policy()
+        pol = self._policy
+        if self._rng.random() < pol["epsilon"]:
+            return int(self._rng.integers(pol["num_actions"]))
+        return int(np.argmax(self._local_q(obs)))
 
     def start_episode(self) -> str:
         return self._client.call("start_episode", {})
 
     def get_action(self, episode_id: str, obs) -> int:
+        if self._mode == "local":
+            action = self._local_action(obs)
+            # fire-and-forget: the whole point of local mode is zero
+            # blocking round trips per step; the connection preserves
+            # ordering, and end_episode (a call) is the sync barrier
+            self._client.notify("log_action", {
+                "episode_id": episode_id,
+                "obs": np.asarray(obs, np.float32).tolist(),
+                "action": int(action)})
+            return action
         return self._client.call("get_action", {
             "episode_id": episode_id,
             "obs": np.asarray(obs, np.float32).tolist()})
@@ -165,8 +254,11 @@ class PolicyClient:
             "action": int(action)})
 
     def log_returns(self, episode_id: str, reward: float) -> None:
-        self._client.call("log_returns", {
-            "episode_id": episode_id, "reward": float(reward)})
+        payload = {"episode_id": episode_id, "reward": float(reward)}
+        if self._mode == "local":
+            self._client.notify("log_returns", payload)
+        else:
+            self._client.call("log_returns", payload)
 
     def end_episode(self, episode_id: str, obs) -> None:
         self._client.call("end_episode", {
