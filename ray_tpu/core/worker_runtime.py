@@ -41,7 +41,7 @@ def _get_worker_core():
 from .object_store import client as store_client
 import functools
 
-from .task_spec import (ARG_REF, ARG_VALUE, DYNAMIC_RETURNS, TaskSpec)
+from .task_spec import ARG_REF, ARG_VALUE, DYNAMIC_RETURNS, TaskSpec
 
 FN_NAMESPACE = "fn"
 

@@ -33,16 +33,37 @@ class QNetwork:
 
     With ``dueling`` the torso feeds separate value and advantage heads
     and Q = V + A - mean(A) (reference: dueling architecture,
-    `rllib/algorithms/dqn` dueling option)."""
+    `rllib/algorithms/dqn` dueling option).  With ``num_atoms > 1`` the
+    net is DISTRIBUTIONAL (C51, reference: `rllib/algorithms/dqn`
+    num_atoms option): ``logits`` returns [.., A, atoms] and ``apply``
+    still returns expected Q-values, so every value-based call site
+    (exploration, greedy eval, double-DQN selection) works unchanged.
+    """
 
     def __init__(self, obs_size: int, n_actions: int,
-                 hidden=(64, 64), dueling: bool = False):
+                 hidden=(64, 64), dueling: bool = False,
+                 num_atoms: int = 1, v_min: float = -10.0,
+                 v_max: float = 10.0):
         self.obs_size = obs_size
         self.n_actions = n_actions
         self.hidden = tuple(hidden)
         self.dueling = dueling
+        self.num_atoms = num_atoms
+        if num_atoms > 1:
+            if dueling:
+                raise ValueError("dueling + distributional is not "
+                                 "supported; pick one head structure")
+            if not v_min < v_max:
+                raise ValueError(
+                    f"distributional support needs v_min < v_max "
+                    f"(got {v_min} >= {v_max}): a zero-width support "
+                    f"divides by zero in the projection")
+            self.support = jnp.linspace(v_min, v_max, num_atoms)
 
     def init(self, key: jax.Array):
+        if self.num_atoms > 1:
+            return mlp_init(key, (self.obs_size,) + self.hidden
+                            + (self.n_actions * self.num_atoms,))
         if not self.dueling:
             return mlp_init(
                 key, (self.obs_size,) + self.hidden + (self.n_actions,))
@@ -55,7 +76,16 @@ class QNetwork:
                 "v": mlp_init(kv, (width, 1)),
                 "a": mlp_init(ka, (width, self.n_actions))}
 
+    def logits(self, params, obs: jnp.ndarray) -> jnp.ndarray:
+        """[.., A, atoms] distribution logits (num_atoms > 1 only)."""
+        out = mlp_apply(params, obs)
+        return out.reshape(out.shape[:-1]
+                           + (self.n_actions, self.num_atoms))
+
     def apply(self, params, obs: jnp.ndarray) -> jnp.ndarray:
+        if self.num_atoms > 1:
+            probs = jax.nn.softmax(self.logits(params, obs), axis=-1)
+            return (probs * self.support).sum(axis=-1)
         if not self.dueling:
             return mlp_apply(params, obs)
         x = obs
@@ -64,6 +94,53 @@ class QNetwork:
         v = mlp_apply(params["v"], x)                      # [..., 1]
         a = mlp_apply(params["a"], x)                      # [..., A]
         return v + a - a.mean(axis=-1, keepdims=True)
+
+
+def categorical_td_loss(q: "QNetwork", params, target_params, batch,
+                        weights, double_q: bool):
+    """C51 (Bellemare et al. 2017): project the Bellman-shifted target
+    distribution onto the fixed support, cross-entropy against the
+    predicted distribution at the taken action.  Handles per-sample
+    gamma (n-step) like the scalar path.  → (loss, per-sample CE) —
+    the CE doubles as the PER priority, the distributional
+    convention."""
+    z = q.support                                        # [atoms]
+    atoms = q.num_atoms
+    dz = (z[-1] - z[0]) / (atoms - 1)
+    # next-state distribution at the selected action
+    next_logits = q.logits(target_params, batch["next_obs"])
+    if double_q:
+        next_a = jnp.argmax(q.apply(params, batch["next_obs"]),
+                            axis=-1)
+    else:
+        next_probs_all = jax.nn.softmax(next_logits, axis=-1)
+        next_a = jnp.argmax((next_probs_all * z).sum(-1), axis=-1)
+    next_p = jax.nn.softmax(jnp.take_along_axis(
+        next_logits, next_a[:, None, None].repeat(atoms, -1),
+        axis=1)[:, 0], axis=-1)                          # [B, atoms]
+    # Bellman shift + clamp + triangular projection onto the support
+    tz = jnp.clip(batch["reward"][:, None]
+                  + batch["gamma_n"][:, None]
+                  * (1.0 - batch["done"][:, None]) * z[None, :],
+                  z[0], z[-1])                           # [B, atoms]
+    b = (tz - z[0]) / dz
+    low = jnp.clip(jnp.floor(b), 0, atoms - 1)
+    up = jnp.clip(jnp.ceil(b), 0, atoms - 1)
+    # when low == up (b integral) all mass goes to that atom
+    w_up = jnp.where(up == low, 1.0, b - low)
+    w_low = 1.0 - w_up
+    proj = jnp.zeros_like(next_p)
+    bidx = jnp.arange(next_p.shape[0])[:, None]
+    proj = proj.at[bidx, low.astype(jnp.int32)].add(next_p * w_low)
+    proj = proj.at[bidx, up.astype(jnp.int32)].add(next_p * w_up)
+    proj = jax.lax.stop_gradient(proj)
+    pred_logits = jnp.take_along_axis(
+        q.logits(params, batch["obs"]),
+        batch["action"][:, None, None].repeat(atoms, -1),
+        axis=1)[:, 0]                                    # [B, atoms]
+    log_p = jax.nn.log_softmax(pred_logits, axis=-1)
+    ce = -(proj * log_p).sum(axis=-1)                    # [B]
+    return jnp.mean(weights * ce), ce
 
 
 def dqn_target(q_apply, params, target_params, reward, next_obs, done,
@@ -96,6 +173,9 @@ class DQNConfig:
     tau: float = 0.01              # Polyak target-average rate
     double_q: bool = True
     dueling: bool = False          # V + A - mean(A) heads
+    num_atoms: int = 1             # >1: distributional C51 over
+    v_min: float = -10.0           #   linspace(v_min, v_max, atoms)
+    v_max: float = 10.0
     n_step: int = 1                # n-step targets (window gathered at
     #   sample time from buffer adjacency; cursor-crossing windows fall
     #   back to 1-step)
@@ -166,7 +246,9 @@ class DQN(Algorithm):
                 f"would silently fall back to 1-step targets")
         self.n_actions = n_act
         self.q = QNetwork(obs_dim, n_act,
-                          hidden=cfg.hidden, dueling=cfg.dueling)
+                          hidden=cfg.hidden, dueling=cfg.dueling,
+                          num_atoms=cfg.num_atoms, v_min=cfg.v_min,
+                          v_max=cfg.v_max)
         key = jax.random.PRNGKey(cfg.seed)
         key, pkey, ekey = jax.random.split(key, 3)
         self.params = self.q.init(pkey)
@@ -217,6 +299,12 @@ class DQN(Algorithm):
         _, _, sample_fn, update_pri = self._replay_ops
 
         def td_loss(params, target_params, batch, weights):
+            if cfg.num_atoms > 1:
+                # C51: cross-entropy against the projected target
+                # distribution; per-sample CE is the PER priority
+                return categorical_td_loss(q, params, target_params,
+                                           batch, weights,
+                                           cfg.double_q)
             qvals = q.apply(params, batch["obs"])
             q_sa = jnp.take_along_axis(
                 qvals, batch["action"][:, None], axis=-1)[:, 0]
