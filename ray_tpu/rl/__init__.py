@@ -11,6 +11,7 @@ supported through rollout-worker actors like the reference's sampler.
 """
 
 from .algorithm import Algorithm  # noqa: F401
+from .apex import ApexDQN, ApexDQNConfig, collector_epsilon  # noqa: F401
 from .dqn import DQN, DQNConfig, QNetwork  # noqa: F401
 from .env import (  # noqa: F401
     CartPole,

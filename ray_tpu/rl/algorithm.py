@@ -15,6 +15,21 @@ import numpy as np
 from ..air.checkpoint import Checkpoint
 
 
+def track_episode_returns(ep_returns: np.ndarray, done_returns: list,
+                          rewards: np.ndarray,
+                          dones: np.ndarray) -> None:
+    """ONE definition of the reward/done episode bookkeeping, shared by
+    Algorithm subclasses and out-of-process collectors (impala/apex):
+    accumulate per-env returns over a [T, B] trajectory, bank each
+    finished episode, zero its accumulator."""
+    for t in range(rewards.shape[0]):
+        ep_returns += rewards[t]
+        finished = dones[t].astype(bool)
+        if finished.any():
+            done_returns.extend(ep_returns[finished].tolist())
+            ep_returns[finished] = 0.0
+
+
 class Algorithm:
     _config_cls = None
 
@@ -31,13 +46,8 @@ class Algorithm:
     def _track_episodes(self, rewards: np.ndarray, dones: np.ndarray):
         """Accumulate per-env returns from a [T, B] reward/done trajectory,
         banking each finished episode's return."""
-        for t in range(rewards.shape[0]):
-            self._ep_returns += rewards[t]
-            finished = dones[t].astype(bool)
-            if finished.any():
-                self._ep_done_returns.extend(
-                    self._ep_returns[finished].tolist())
-                self._ep_returns[finished] = 0.0
+        track_episode_returns(self._ep_returns, self._ep_done_returns,
+                              rewards, dones)
 
     def episode_reward_mean(self) -> float:
         """Mean return of the last 100 finished episodes (NaN before any)."""

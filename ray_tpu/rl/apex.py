@@ -1,0 +1,233 @@
+"""Ape-X DQN: distributed prioritized replay (Horgan et al. 2018).
+
+Capability mirror of the reference's APEX
+(`rllib/algorithms/apex_dqn/apex_dqn.py:1` — many actors with a
+SPECTRUM of fixed exploration rates feed one prioritized-replay
+learner).  TPU-first composition: the learner IS the external-input
+DQN (device-resident buffer + compiled update scan, dqn.py
+`_make_update_block`), and each collector actor runs its own compiled
+epsilon-greedy rollout scan — the IMPALA async driver pattern (one
+in-flight collect per actor, re-armed with fresh weights as each batch
+lands) feeding the DQN replay path instead of V-trace."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .algorithm import track_episode_returns
+from .dqn import DQN, DQNConfig, QNetwork
+
+
+def collector_epsilon(i: int, n: int, base: float = 0.4,
+                      alpha: float = 7.0) -> float:
+    """The Ape-X exploration spectrum: eps_i = base^(1 + i*alpha/(n-1))
+    — worker 0 explores most, the tail is near-greedy."""
+    if n <= 1:
+        return base
+    return float(base ** (1.0 + i * alpha / (n - 1)))
+
+
+class _DQNCollector:
+    """Actor: compiled vectorized epsilon-greedy collection at a FIXED
+    per-worker epsilon; ships columnar transition batches."""
+
+    def __init__(self, config_blob: bytes, worker_index: int,
+                 num_workers: int):
+        from ..core.serialization import loads_function
+        cfg = loads_function(config_blob)
+        self.cfg = cfg
+        self.env = cfg.env()
+        self.q = QNetwork(self.env.observation_size,
+                          self.env.action_size, hidden=cfg.hidden,
+                          dueling=cfg.dueling,
+                          num_atoms=cfg.num_atoms, v_min=cfg.v_min,
+                          v_max=cfg.v_max)
+        self.eps = collector_epsilon(worker_index, num_workers)
+        key = jax.random.PRNGKey(cfg.seed + 104729 * (worker_index + 1))
+        self.key, ekey, pkey = jax.random.split(key, 3)
+        self.params = self.q.init(pkey)
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self._collect = jax.jit(self._make_collect())
+        self._ep_returns = np.zeros(cfg.num_envs)
+        self._done_returns: list = []
+
+    def _make_collect(self):
+        cfg, env, q, eps = self.cfg, self.env, self.q, self.eps
+
+        def collect(params, env_states, obs, key):
+            def step(carry, _):
+                env_states, obs, key = carry
+                key, akey, rkey, skey = jax.random.split(key, 4)
+                greedy = jnp.argmax(q.apply(params, obs), axis=-1)
+                random_a = jax.random.randint(
+                    rkey, greedy.shape, 0, env.action_size)
+                explore = jax.random.uniform(
+                    akey, greedy.shape) < eps
+                action = jnp.where(explore, random_a, greedy)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(
+                    env.step)(env_states, action, skeys)
+                frame = {"obs": obs, "action": action,
+                         "reward": reward, "next_obs": next_obs,
+                         "done": done}
+                return (env_states, next_obs, key), frame
+
+            (env_states, obs, key), traj = jax.lax.scan(
+                step, (env_states, obs, key), None,
+                length=cfg.collect_steps)
+            return env_states, obs, key, traj
+
+        return collect
+
+    def collect(self, weights) -> Dict[str, Any]:
+        self.params = jax.tree_util.tree_map(
+            lambda _, w: jnp.asarray(w), self.params, weights)
+        self.env_states, self.obs, self.key, traj = self._collect(
+            self.params, self.env_states, self.obs, self.key)
+        rewards = np.asarray(traj["reward"])
+        dones = np.asarray(traj["done"])
+        track_episode_returns(self._ep_returns, self._done_returns,
+                              rewards, dones)
+        T, B = rewards.shape
+        out = {k: np.asarray(v).reshape((T * B,) + v.shape[2:])
+               for k, v in traj.items()}
+        out["episode_returns"] = self._done_returns
+        self._done_returns = []
+        return out
+
+
+@dataclasses.dataclass
+class ApexDQNConfig(DQNConfig):
+    num_collectors: int = 2
+    collect_steps: int = 64        # env steps per env per collect call
+
+    def build(self) -> "ApexDQN":
+        return ApexDQN(self)
+
+
+class ApexDQN(DQN):
+    """The learner: external-input DQN machinery + a fleet of
+    collector actors as the transition source."""
+
+    _config_cls = ApexDQNConfig
+
+    def __init__(self, config: ApexDQNConfig):
+        if config.env is None:
+            raise ValueError("ApexDQNConfig.env required")
+        # the learner is EXACTLY the external-input DQN: device buffer,
+        # compiled update scan, no inline env
+        super().__init__(dataclasses.replace(config,
+                                             external_input=True))
+        from .. import api
+        from ..core.serialization import dumps_function
+        blob = dumps_function(config)
+        cls = api.remote(_DQNCollector)
+        self._collectors = [
+            cls.remote(blob, i, config.num_collectors)
+            for i in range(config.num_collectors)]
+        self._inflight: Dict[int, Any] = {}
+        self._pending: Dict[str, np.ndarray] = {}
+
+    def _arm(self, i: int, weights_ref: Any = None) -> None:
+        from .. import api
+        if weights_ref is None:
+            weights_ref = api.put(jax.tree_util.tree_map(
+                np.asarray, self.params))
+        self._inflight[i] = self._collectors[i].collect.remote(
+            weights_ref)
+
+    def _ingest_columnar(self, cols: Dict[str, np.ndarray]) -> int:
+        """Concatenate into the pending staging columns; insert full
+        ingest_chunk slices through the jitted add."""
+        cfg = self.config
+        for k in ("obs", "action", "reward", "next_obs", "done"):
+            v = np.asarray(cols[k])
+            self._pending[k] = v if k not in self._pending else \
+                np.concatenate([self._pending[k], v])
+        inserted = 0
+        n = len(self._pending["obs"])
+        while n - inserted >= cfg.ingest_chunk:
+            sl = slice(inserted, inserted + cfg.ingest_chunk)
+            batch = {
+                "obs": jnp.asarray(self._pending["obs"][sl],
+                                   jnp.float32),
+                "action": jnp.asarray(self._pending["action"][sl],
+                                      jnp.int32),
+                "reward": jnp.asarray(self._pending["reward"][sl],
+                                      jnp.float32),
+                "next_obs": jnp.asarray(self._pending["next_obs"][sl],
+                                        jnp.float32),
+                "done": jnp.asarray(self._pending["done"][sl],
+                                    jnp.float32),
+            }
+            self.buffer = self._ingest_jit(self.buffer, batch)
+            inserted += cfg.ingest_chunk
+        self._pending = {k: v[inserted:]
+                         for k, v in self._pending.items()}
+        return inserted
+
+    def training_step(self) -> Dict[str, Any]:
+        from .. import api
+        cfg = self.config
+        t0 = time.perf_counter()
+        for i in range(len(self._collectors)):
+            if i not in self._inflight:
+                self._arm(i)
+        refs = {self._inflight[i]: i for i in self._inflight}
+        # drain only what's READY: blocking on stragglers would degrade
+        # the learner to the slowest collector (api.wait blocks until
+        # at least one batch exists, so progress is guaranteed)
+        ready, _ = api.wait(list(refs), num_returns=1, timeout=300.0)
+        ready_set = set(ready)
+        for r in list(refs):
+            if r not in ready_set:
+                more, _ = api.wait([r], num_returns=1, timeout=0.0)
+                ready_set.update(more)
+        received = 0
+        drained = []
+        for r in ready_set:
+            i = refs[r]
+            batch = api.get(self._inflight.pop(i), timeout=300.0)
+            ep = batch.pop("episode_returns", None)
+            if ep:
+                self._ep_done_returns.extend(ep)
+            received += len(batch["obs"])
+            self._ingest_columnar(batch)
+            drained.append(i)
+        (self.params, self.target_params, self.opt_state, self.buffer,
+         self.key, last_loss) = self._update_jit(
+            self.params, self.target_params, self.opt_state,
+            self.buffer, self.key,
+            jnp.asarray(self._total_env_steps, jnp.float32))
+        # re-arm AFTER the update with the post-update weights — one
+        # shared put serves the whole drained set
+        if drained:
+            weights_ref = api.put(jax.tree_util.tree_map(
+                np.asarray, self.params))
+            for i in drained:
+                self._arm(i, weights_ref)
+        dt = time.perf_counter() - t0
+        return {
+            "td_loss": float(last_loss),
+            "buffer_size": int(self.buffer["size"]),
+            "transitions_received": received,
+            "env_steps_this_iter": received,
+            "env_steps_per_s": received / dt,
+            "episode_reward_mean": self.episode_reward_mean(),
+        }
+
+    def stop(self) -> None:
+        from .. import api
+        for c in self._collectors:
+            try:
+                api.kill(c)
+            except Exception:
+                pass
+        self._collectors = []
