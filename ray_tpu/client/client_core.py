@@ -58,11 +58,43 @@ class ClientCore:
         self.controller = _ControllerProxy(self._srv)
         self._ref_lock = threading.Lock()
         self._local_refs: Dict[bytes, int] = {}
+        self._deferred_decs: list = []
         self._fn_registered: set = set()
         self._closed = False
+        # plain daemon thread, NOT the IO loop: _remove_local_ref's
+        # notify blocks on that loop (BlockingClient.run), which from
+        # the loop thread itself would deadlock
+        threading.Thread(target=self._deferred_dec_sweep,
+                         name="client-ref-sweep", daemon=True).start()
 
     # ---------------------------------------------------------- ref counting
+    def _defer_remove_local_ref(self, oid: bytes):
+        """GC path for ObjectRef.__del__ — must never take _ref_lock
+        (same hazard and same fix as core/driver.py: gc can fire inside
+        a locked section on this thread)."""
+        self._deferred_decs.append(oid)
+
+    def _drain_deferred_decs(self):
+        if not self._deferred_decs:
+            return
+        while True:
+            try:
+                oid = self._deferred_decs.pop()
+            except IndexError:
+                return
+            try:
+                self._remove_local_ref(oid)
+            except Exception:
+                pass    # a failing dec must not poison the drain
+
+    def _deferred_dec_sweep(self):
+        import time as _time
+        while not self._closed:
+            _time.sleep(0.05)
+            self._drain_deferred_decs()
+
     def _add_local_ref(self, oid: bytes):
+        self._drain_deferred_decs()
         with self._ref_lock:
             n = self._local_refs.get(oid, 0)
             self._local_refs[oid] = n + 1

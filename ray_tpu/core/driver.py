@@ -89,7 +89,12 @@ class ObjectRef:
         core = self._core
         if core is not None:
             try:
-                core._remove_local_ref(self._id.binary())
+                # GC-safe path: __del__ can fire mid-allocation while
+                # THIS thread holds core._ref_lock (observed as a
+                # same-thread deadlock under memory pressure) — so the
+                # GC path must never lock; the dec is queued and
+                # applied at the next locked-free entry point
+                core._defer_remove_local_ref(self._id.binary())
             except Exception:
                 pass
 
@@ -176,6 +181,10 @@ class CoreClient:
         self._put_index = 0
         self._fn_registered: set = set()
         self._ref_lock = threading.Lock()
+        # decs queued by ObjectRef.__del__ (the GC path must never take
+        # _ref_lock: gc can fire mid-allocation INSIDE a locked section
+        # on the same thread); drained at lock-free entry points
+        self._deferred_decs: List[bytes] = []
         # Submission coalescing: a burst of .remote() calls lands in
         # this queue and wakes the IO loop ONCE, not once per task —
         # run_coroutine_threadsafe costs ~100us each, which alone caps
@@ -207,17 +216,48 @@ class CoreClient:
         self._cancelled: set = set()   # task_ids cancel() was called on
         self._task_sites: Dict[bytes, rpc.Connection] = {}  # running tasks
         self._spurious_requeues: Dict[bytes, int] = {}
+        self.lt.spawn(self._deferred_dec_loop())
         if mode == "driver":
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
                                   "driver": f"pid-{os.getpid()}"})
 
     # ------------------------------------------------------------- refcounts
+    def _defer_remove_local_ref(self, oid: bytes):
+        """The ONLY operation the GC path may perform: queue the dec
+        (list append is atomic under the GIL; no lock ever taken here).
+        Drained by _drain_deferred_decs at entry points and by the IO
+        loop's periodic sweep, so releases stay prompt even in an idle
+        driver."""
+        self._deferred_decs.append(oid)
+
+    async def _deferred_dec_loop(self):
+        while not self._closed:
+            await asyncio.sleep(0.05)
+            self._drain_deferred_decs()
+
+    def _drain_deferred_decs(self):
+        if not self._deferred_decs:     # hot path: every ObjectRef()
+            return
+        while True:
+            try:
+                oid = self._deferred_decs.pop()
+            except IndexError:
+                return
+            try:
+                self._remove_local_ref(oid)
+            except Exception:
+                # the old __del__ path swallowed dec errors too; one
+                # failing dec must not kill the sweep or surface in an
+                # unrelated caller's get()
+                pass
+
     def _add_local_ref(self, oid: bytes):
         """Local count; a 0→1 transition on a *borrowed* oid additionally
         registers this process as a borrower with the controller (the
         distributed half of reference_count.h's borrower protocol — the
         owner's free is gated on these)."""
+        self._drain_deferred_decs()
         with self._ref_lock:
             n = self._local_refs.get(oid, 0)
             self._local_refs[oid] = n + 1
@@ -383,6 +423,7 @@ class CoreClient:
 
     # ------------------------------------------------------------------- get
     def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        self._drain_deferred_decs()
         oids = [r.binary() for r in refs]
         # Revived refs (deserialized out of a container after the original
         # handle was released) have no memory-store entry — the release

@@ -188,3 +188,46 @@ def test_borrower_crash_releases_holds():
         pytest.fail(f"free never ran after borrower death: {rc}")
     finally:
         ray_tpu.shutdown()
+
+
+def test_gc_ref_release_never_takes_the_lock(local_cluster):
+    """ObjectRef.__del__ must queue its dec (GC can fire inside a
+    _ref_lock critical section on the same thread — a deadlock if the
+    GC path locks); entry points and the IO loop's sweep drain it."""
+    import gc
+
+    from ray_tpu.core.driver import get_global_core
+    core = get_global_core()
+    ref = ray_tpu.put(list(range(100)))
+    oid = ref.binary()
+    assert core._local_refs.get(oid, 0) >= 1
+    del ref
+    gc.collect()
+    # the release lands without ANY further API activity (the sweep)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and \
+            core._local_refs.get(oid, 0) > 0:
+        time.sleep(0.02)
+    assert core._local_refs.get(oid, 0) == 0
+    assert oid not in list(core._deferred_decs)
+
+
+def test_graph_scheduler_burst_survives_gc_pressure(local_cluster):
+    """Regression for the r4 full-suite hang: many short-lived refs
+    created/dropped in bursts (gc firing at unlucky allocations) must
+    never deadlock submission."""
+    import gc
+
+    gc.set_threshold(50)     # force frequent collections
+    try:
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        for _ in range(30):
+            refs = [add.remote(i, i) for i in range(20)]
+            total = sum(ray_tpu.get(refs, timeout=60.0))
+            assert total == 2 * sum(range(20))
+            del refs
+    finally:
+        gc.set_threshold(700, 10, 10)
