@@ -18,7 +18,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from .. import exceptions
 from ..core import rpc, serialization
 from ..core.config import GlobalConfig
-from ..core.driver import ObjectRef
+from ..core.driver import DeferredRefDecs, ObjectRef
 from ..core.ids import ActorID, JobID, ObjectID, TaskID
 from ..core.task_spec import ARG_REF, ARG_VALUE, TaskSpec
 from ..core.worker_runtime import _ErrorValue
@@ -42,7 +42,7 @@ class _ControllerProxy:
                                 {"method": method, "data": data})
 
 
-class ClientCore:
+class ClientCore(DeferredRefDecs):
     """Drop-in for CoreClient in client mode (mode == "client")."""
 
     def __init__(self, address: str):
@@ -58,39 +58,27 @@ class ClientCore:
         self.controller = _ControllerProxy(self._srv)
         self._ref_lock = threading.Lock()
         self._local_refs: Dict[bytes, int] = {}
-        self._deferred_decs: list = []
+        self._init_deferred_decs()
         self._fn_registered: set = set()
         self._closed = False
         # plain daemon thread, NOT the IO loop: _remove_local_ref's
         # notify blocks on that loop (BlockingClient.run), which from
         # the loop thread itself would deadlock
-        threading.Thread(target=self._deferred_dec_sweep,
-                         name="client-ref-sweep", daemon=True).start()
+        self._sweep_stop = threading.Event()
+        self._sweep_thread = threading.Thread(
+            target=self._deferred_dec_sweep, name="client-ref-sweep",
+            daemon=True)
+        self._sweep_thread.start()
 
     # ---------------------------------------------------------- ref counting
-    def _defer_remove_local_ref(self, oid: bytes):
-        """GC path for ObjectRef.__del__ — must never take _ref_lock
-        (same hazard and same fix as core/driver.py: gc can fire inside
-        a locked section on this thread)."""
-        self._deferred_decs.append(oid)
-
-    def _drain_deferred_decs(self):
-        if not self._deferred_decs:
-            return
-        while True:
-            try:
-                oid = self._deferred_decs.pop()
-            except IndexError:
-                return
-            try:
-                self._remove_local_ref(oid)
-            except Exception:
-                pass    # a failing dec must not poison the drain
-
     def _deferred_dec_sweep(self):
-        import time as _time
-        while not self._closed:
-            _time.sleep(0.05)
+        # Event-paced (not sleep): shutdown() signals + JOINS this
+        # thread while the IO loop is still alive, so no notify can be
+        # mid-flight against a stopped loop (a blocked lt.run there
+        # would hang this thread forever)
+        while not self._sweep_stop.wait(0.05):
+            if self._closed:
+                return
             self._drain_deferred_decs()
 
     def _add_local_ref(self, oid: bytes):
@@ -241,6 +229,9 @@ class ClientCore:
         if self._closed:
             return
         self._closed = True
+        # stop the ref sweep BEFORE tearing the connection/loop down
+        self._sweep_stop.set()
+        self._sweep_thread.join(timeout=2.0)
         try:
             self._srv.call("client_bye", {}, timeout=10)
         except Exception:

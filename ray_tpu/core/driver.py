@@ -59,6 +59,40 @@ class ObjectRefGenerator:
         return f"ObjectRefGenerator({len(self._refs)} refs)"
 
 
+class DeferredRefDecs:
+    """GC-safe ref-release queue, shared by CoreClient and ClientCore.
+
+    ObjectRef.__del__ may fire mid-allocation while its thread holds
+    the owner's _ref_lock, so the GC path must never lock: it only
+    appends here (atomic under the GIL).  Owners drain at entry points
+    and from a periodic sweep — whose dispatch differs per owner (the
+    driver sweeps on its IO loop, the client on a plain thread because
+    its dec path BLOCKS on its own loop), so the sweep itself stays
+    per-class."""
+
+    def _init_deferred_decs(self) -> None:
+        self._deferred_decs: list = []
+
+    def _defer_remove_local_ref(self, oid: bytes) -> None:
+        self._deferred_decs.append(oid)
+
+    def _drain_deferred_decs(self) -> None:
+        if not self._deferred_decs:     # hot path: every ObjectRef()
+            return
+        while True:
+            try:
+                oid = self._deferred_decs.pop()
+            except IndexError:
+                return
+            try:
+                self._remove_local_ref(oid)
+            except Exception:
+                # the old __del__ path swallowed dec errors too; one
+                # failing dec must not kill the sweep or surface in an
+                # unrelated caller's get()
+                pass
+
+
 class ObjectRef:
     """A handle to a (possibly pending) object (reference: ObjectRef in
     _raylet.pyx).  Dropping the last local reference releases the object."""
@@ -156,7 +190,7 @@ class _ActorState:
         self.dead_reason: Optional[str] = None
 
 
-class CoreClient:
+class CoreClient(DeferredRefDecs):
     def __init__(self, *, controller_addr: str, nodelet_addr: str,
                  store_path: str, node_id: str, session_dir: str,
                  job_id: Optional[JobID] = None, mode: str = "driver"):
@@ -181,10 +215,7 @@ class CoreClient:
         self._put_index = 0
         self._fn_registered: set = set()
         self._ref_lock = threading.Lock()
-        # decs queued by ObjectRef.__del__ (the GC path must never take
-        # _ref_lock: gc can fire mid-allocation INSIDE a locked section
-        # on the same thread); drained at lock-free entry points
-        self._deferred_decs: List[bytes] = []
+        self._init_deferred_decs()
         # Submission coalescing: a burst of .remote() calls lands in
         # this queue and wakes the IO loop ONCE, not once per task —
         # run_coroutine_threadsafe costs ~100us each, which alone caps
@@ -223,34 +254,12 @@ class CoreClient:
                                   "driver": f"pid-{os.getpid()}"})
 
     # ------------------------------------------------------------- refcounts
-    def _defer_remove_local_ref(self, oid: bytes):
-        """The ONLY operation the GC path may perform: queue the dec
-        (list append is atomic under the GIL; no lock ever taken here).
-        Drained by _drain_deferred_decs at entry points and by the IO
-        loop's periodic sweep, so releases stay prompt even in an idle
-        driver."""
-        self._deferred_decs.append(oid)
-
     async def _deferred_dec_loop(self):
+        # the IO-loop sweep: _remove_local_ref here only fire-and-forget
+        # spawns, so draining on the loop never blocks it
         while not self._closed:
             await asyncio.sleep(0.05)
             self._drain_deferred_decs()
-
-    def _drain_deferred_decs(self):
-        if not self._deferred_decs:     # hot path: every ObjectRef()
-            return
-        while True:
-            try:
-                oid = self._deferred_decs.pop()
-            except IndexError:
-                return
-            try:
-                self._remove_local_ref(oid)
-            except Exception:
-                # the old __del__ path swallowed dec errors too; one
-                # failing dec must not kill the sweep or surface in an
-                # unrelated caller's get()
-                pass
 
     def _add_local_ref(self, oid: bytes):
         """Local count; a 0→1 transition on a *borrowed* oid additionally

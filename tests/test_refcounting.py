@@ -218,6 +218,7 @@ def test_graph_scheduler_burst_survives_gc_pressure(local_cluster):
     never deadlock submission."""
     import gc
 
+    saved = gc.get_threshold()
     gc.set_threshold(50)     # force frequent collections
     try:
         @ray_tpu.remote
@@ -230,4 +231,4 @@ def test_graph_scheduler_burst_survives_gc_pressure(local_cluster):
             assert total == 2 * sum(range(20))
             del refs
     finally:
-        gc.set_threshold(700, 10, 10)
+        gc.set_threshold(*saved)
