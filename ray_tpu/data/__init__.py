@@ -17,6 +17,7 @@ from .datasource import (  # noqa: F401
     Datasource,
     FileBasedDatasource,
     JSONDatasource,
+    NumpyDatasource,
     ParquetDatasource,
     RangeDatasource,
     ReadTask,
@@ -35,6 +36,7 @@ from .read_api import (  # noqa: F401
     read_csv,
     read_datasource,
     read_json,
+    read_numpy,
     read_parquet,
     read_text,
 )

@@ -641,8 +641,16 @@ class Dataset:
                     column: Optional[str] = None) -> None:
         """One .npy file per block (reference:
         `Dataset.write_numpy`).  Blocks fetch ONE at a time — peak
-        driver memory is a single block, not the dataset."""
+        driver memory is a single block, not the dataset.  Without
+        ``column`` the whole block writes as a STRUCTURED array
+        (to_records), so `read_numpy` restores column names/dtypes."""
         os.makedirs(path, exist_ok=True)
+        if column is None:
+            for i, ref in enumerate(self.to_pandas_refs()):
+                df = api.get(ref, timeout=600.0)
+                np.save(os.path.join(path, f"block_{i:05d}.npy"),
+                        df.to_records(index=False))
+            return
         for i, ref in enumerate(self.to_numpy_refs(column=column)):
             arr = api.get(ref, timeout=600.0)
             np.save(os.path.join(path, f"block_{i:05d}.npy"), arr)

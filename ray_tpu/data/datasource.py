@@ -141,6 +141,37 @@ class CSVDatasource(FileBasedDatasource):
         df.to_csv(path, index=False, **kw)
 
 
+class NumpyDatasource(FileBasedDatasource):
+    """.npy files ⇄ blocks (reference:
+    `data/datasource/numpy_datasource.py` — the read counterpart of
+    `Dataset.write_numpy`).
+
+    Structured arrays (what ``_write_file`` and column-less
+    ``write_numpy`` produce via ``to_records``) restore their column
+    names and dtypes; plain arrays become rows along axis 0 under
+    ``column`` (default ``"data"``, matching ``from_numpy``).
+    ``allow_pickle`` defaults True because ``np.save`` pickles
+    object-dtype columns without asking — the write side already
+    committed to it."""
+
+    _FILE_EXT = "npy"
+
+    def _read_file(self, path: str, column: str = "data",
+                   allow_pickle: bool = True, **kw) -> Block:
+        import numpy as np
+        import pandas as pd
+        arr = np.load(path, allow_pickle=allow_pickle, **kw)
+        if arr.dtype.names:       # structured: columns round-trip
+            return pd.DataFrame.from_records(arr)
+        return pd.DataFrame({column: list(np.atleast_1d(arr))})
+
+    def _write_file(self, df, path: str, **kw) -> None:
+        import numpy as np
+        # to_records keeps column names/dtypes — the same fidelity the
+        # CSV/JSON/Parquet datasources in this file provide
+        np.save(path, df.to_records(index=False), **kw)
+
+
 class JSONDatasource(FileBasedDatasource):
     _FILE_EXT = "json"
 

@@ -16,7 +16,8 @@ from .. import api
 from .block import BlockAccessor
 from .dataset import Dataset
 from .datasource import (BinaryDatasource, CSVDatasource, Datasource,
-                         JSONDatasource, ParquetDatasource, RangeDatasource,
+                         JSONDatasource, NumpyDatasource,
+                         ParquetDatasource, RangeDatasource,
                          TextDatasource)
 from .plan import ExecutionPlan
 
@@ -80,6 +81,13 @@ def read_parquet(paths, **kwargs) -> Dataset:
 
 def read_csv(paths, **kwargs) -> Dataset:
     return read_datasource(CSVDatasource(paths, **kwargs), _name="read_csv")
+
+
+def read_numpy(paths, **kwargs) -> Dataset:
+    """.npy files, rows along axis 0 (reference: `ray.data.read_numpy`
+    — the read counterpart of `Dataset.write_numpy`)."""
+    return read_datasource(NumpyDatasource(paths, **kwargs),
+                           _name="read_numpy")
 
 
 def read_json(paths, **kwargs) -> Dataset:
