@@ -646,10 +646,11 @@ class Dataset:
         (to_records), so `read_numpy` restores column names/dtypes."""
         os.makedirs(path, exist_ok=True)
         if column is None:
-            for i, ref in enumerate(self.to_pandas_refs()):
-                df = api.get(ref, timeout=600.0)
-                np.save(os.path.join(path, f"block_{i:05d}.npy"),
-                        df.to_records(index=False))
+            # the datasource path fans out one write task per block —
+            # no driver materialization, and ONE definition of the
+            # structured-records format (NumpyDatasource._write_file)
+            from .datasource import NumpyDatasource
+            self.write_datasource(NumpyDatasource(), path=path)
             return
         for i, ref in enumerate(self.to_numpy_refs(column=column)):
             arr = api.get(ref, timeout=600.0)

@@ -43,4 +43,10 @@ from .search_ext import (  # noqa: F401
     HyperOptSearch,
 )
 from .trial import Trial  # noqa: F401
-from .tuner import TuneConfig, Tuner, run, with_parameters  # noqa: F401
+from .tuner import (  # noqa: F401
+    TuneConfig,
+    Tuner,
+    run,
+    with_parameters,
+    with_resources,
+)

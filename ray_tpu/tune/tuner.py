@@ -161,6 +161,43 @@ def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
                                         scheduler=scheduler)).fit()
 
 
+def with_resources(trainable: Callable,
+                   resources: Dict[str, float]) -> Callable:
+    """Per-trainable trial resources (reference:
+    `tune/trainable/util.py:394` — overrides TuneConfig
+    .trial_resources for this trainable).  CPU drives actor sizing and
+    the concurrency cap; other keys (``TPU``, custom resources) are
+    reserved on the trial actor::
+
+        Tuner(tune.with_resources(train_fn, {"CPU": 2, "TPU": 1}), ...)
+    """
+    import functools
+    import inspect
+
+    if not callable(trainable):
+        raise TypeError(
+            f"with_resources wraps a function trainable (got "
+            f"{type(trainable).__name__}); Trainer objects size their "
+            f"workers via ScalingConfig instead")
+    try:
+        takes_config = bool(inspect.signature(trainable).parameters)
+    except (TypeError, ValueError):
+        takes_config = True     # builtins/partials without signatures
+
+    # explicit (config) signature: the trial runner dispatches on the
+    # wrapper's OWN __code__.co_argcount (functools.wraps does not copy
+    # __code__), so *args would read as a zero-arg trainable
+    @functools.wraps(trainable)
+    def wrapped(config, **kwargs):
+        # **kwargs passthrough: with_parameters(with_resources(fn))
+        # resolves its data kwargs THROUGH this wrapper
+        return trainable(config, **kwargs) if takes_config \
+            else trainable()
+
+    wrapped._tune_trial_resources = dict(resources)
+    return wrapped
+
+
 def with_parameters(trainable: Callable, **kwargs) -> Callable:
     """Attach large data objects to a trainable (reference:
     `tune/trainable/util.py:240`).  Each kwarg is stored ONCE — in the
@@ -186,6 +223,12 @@ def with_parameters(trainable: Callable, **kwargs) -> Callable:
         return trainable(config, **resolved)
 
     inner.__name__ = getattr(trainable, "__name__", "trainable")
+    # composing with_parameters(with_resources(fn, ...)) must keep the
+    # resource request — otherwise the order of the two wrappers
+    # silently decides whether trials are provisioned
+    res = getattr(trainable, "_tune_trial_resources", None)
+    if res is not None:
+        inner._tune_trial_resources = dict(res)
     return inner
 
 
@@ -334,11 +377,23 @@ class _TrialRunner:
         return wrapped
 
     # -- lifecycle ----------------------------------------------------------
+    def _trial_resources(self) -> Dict[str, float]:
+        """with_resources beats the config default (reference
+        precedence); ONE definition for actor sizing and the
+        concurrency cap."""
+        return dict(
+            getattr(self.trainable, "_tune_trial_resources", None)
+            or self.cfg.trial_resources or {"CPU": 1.0})
+
     def _launch(self, trial: Trial,
                 checkpoint: Optional[Checkpoint] = None) -> None:
-        resources = dict(self.cfg.trial_resources or {"CPU": 1.0})
+        resources = self._trial_resources()
         actor = self._actor_cls.options(
-            num_cpus=resources.get("CPU", 1.0)).remote({})
+            num_cpus=resources.get("CPU", 1.0),
+            # non-CPU keys (TPU, custom) reserve on the trial actor —
+            # with_resources' docstring promises the reservation
+            resources={k: v for k, v in resources.items()
+                       if k != "CPU"} or None).remote({})
         api.get(actor.init_session.remote(
             world_rank=0, local_rank=0, world_size=1, node_rank=0,
             trial_name=trial.trial_id,
@@ -398,8 +453,7 @@ class _TrialRunner:
         self._cap_checked = now
         if not hasattr(self, "_cap"):
             self._cap = self.cfg.max_concurrent_trials
-        per_trial = (self.cfg.trial_resources or {"CPU": 1.0}).get(
-            "CPU", 1.0)
+        per_trial = self._trial_resources().get("CPU", 1.0)
         if per_trial > 0:
             try:
                 total = float(api.cluster_resources().get("CPU", 0.0))

@@ -401,3 +401,50 @@ def test_tpe_with_tuner(cluster, tmp_path):
     # it TPE silently degrades to random sampling
     assert len(searcher._history) == 12
     assert res.get_best_result().metrics["loss"] < 4.0
+
+
+def test_with_resources_overrides_trial_resources(cluster):
+    """tune.with_resources beats TuneConfig.trial_resources (reference:
+    tune/trainable/util.py with_resources precedence) — asserted at
+    the resolution point both actor sizing and the concurrency cap
+    read."""
+    import functools
+    import types
+
+    from ray_tpu.air import session
+    from ray_tpu.tune.tuner import _TrialRunner
+
+    def trainable(config):
+        session.report({"ok": 1.0})
+
+    wrapped = tune.with_resources(trainable, {"CPU": 2.0})
+    assert wrapped._tune_trial_resources == {"CPU": 2.0}
+    # the shared resolution helper: override beats the config default
+    fake = types.SimpleNamespace(
+        trainable=wrapped,
+        cfg=types.SimpleNamespace(trial_resources={"CPU": 1.0}))
+    assert _TrialRunner._trial_resources(fake) == {"CPU": 2.0}
+    fake.trainable = trainable
+    assert _TrialRunner._trial_resources(fake) == {"CPU": 1.0}
+
+    # composition keeps the request AND runs end to end (the wrapper
+    # must pass with_parameters' resolved kwargs through)
+    def needs_extra(config, extra):
+        session.report({"ok": float(extra)})
+
+    both = tune.with_parameters(
+        tune.with_resources(needs_extra, {"CPU": 2.0}), extra=7)
+    assert both._tune_trial_resources == {"CPU": 2.0}
+    r = tune.Tuner(both, tune_config=tune.TuneConfig(
+        num_samples=1, metric="ok", mode="max")).fit()
+    assert r.get_best_result().metrics["ok"] == 7.0
+
+    # partials (no __code__) wrap fine and trials still run
+    part = functools.partial(trainable)
+    results = tune.Tuner(
+        tune.with_resources(part, {"CPU": 2.0}),
+        tune_config=tune.TuneConfig(num_samples=2, metric="ok",
+                                    mode="max",
+                                    trial_resources={"CPU": 1.0})).fit()
+    assert len(list(results)) == 2
+    assert all(r.metrics["ok"] == 1.0 for r in results)
