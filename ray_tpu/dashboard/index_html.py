@@ -47,6 +47,7 @@ INDEX_HTML = """<!doctype html>
   <button data-v="timeline">timeline</button>
   <button data-v="serve">serve</button>
   <button data-v="events">events</button>
+  <button data-v="agents">agents</button>
   <button data-v="metrics">metrics</button>
 </nav>
 <div id="err"></div>
@@ -58,6 +59,12 @@ INDEX_HTML = """<!doctype html>
   <h2>actors</h2><table id="actors"></table>
   <h2>jobs</h2><table id="jobs"></table>
   <h2>object store</h2><table id="stores"></table>
+</div>
+
+<div id="agents" class="view">
+  <h2>per-node dashboard agents</h2><table id="agentlist"></table>
+  <h2>node OS stats (agent-served, nodelet fallback)</h2>
+  <table id="agentstats"></table>
 </div>
 
 <div id="logs" class="view">
@@ -135,6 +142,28 @@ async function refreshOverview() {
                            "primary_pins"]);
 }
 
+async function refreshAgents() {
+  const [agents, stats] = await Promise.all([
+    j("/api/agents"), j("/api/agent_stats")]);
+  table("agentlist", Object.entries(agents).map(
+    ([node, a]) => ({node, ...a,
+                     beat: new Date(a.ts * 1000).toISOString(),
+                     age_s: (Date.now() / 1000 - a.ts).toFixed(1)})),
+    ["node", "addr", "pid", "beat", "age_s"]);
+  table("agentstats", stats.map(s => ({
+    node: s.node_id, cpu_pct: s.cpu_percent,
+    mem_avail_gb: s.mem_available
+      ? (s.mem_available / 1e9).toFixed(1) : "",
+    load: (s.load_avg || []).map(x => x.toFixed ? x.toFixed(2) : x)
+      .join(" "),
+    source: s.error ? "ERROR" : (s.agent_pid ? "agent"
+                                  : (s.agent || "nodelet")),
+    error: s.error || "",
+    logs: (s.log_files || []).length})),
+    ["node", "cpu_pct", "mem_avail_gb", "load", "source", "error",
+     "logs"]);
+}
+
 async function refreshLogs() {
   const files = await j("/api/logs");
   const sel = document.getElementById("logfile");
@@ -209,7 +238,8 @@ async function refreshMetrics() {
 
 const refreshers = {overview: refreshOverview, logs: refreshLogs,
                     timeline: refreshTimeline, serve: refreshServe,
-                    events: refreshEvents, metrics: refreshMetrics};
+                    events: refreshEvents, agents: refreshAgents,
+                    metrics: refreshMetrics};
 async function refresh() {
   try {
     await refreshers[view]();

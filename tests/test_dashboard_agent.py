@@ -101,3 +101,22 @@ def test_agents_disabled_by_default_in_suite():
         assert state.agent_stats()
     finally:
         ray_tpu.shutdown()
+
+
+def test_dashboard_html_has_agents_tab(agent_cluster, free_tcp_port):
+    """The frontend ships an agents view wired to the agent REST
+    endpoints (the head/agent split must be visible, not just
+    queryable)."""
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+    _wait_for_agents()
+    head = start_dashboard(port=free_tcp_port)
+    html = urllib.request.urlopen(head.address + "/",
+                                  timeout=15).read().decode()
+    assert 'data-v="agents"' in html
+    assert "refreshAgents" in html and "/api/agent_stats" in html
+    stats = json.loads(urllib.request.urlopen(
+        head.address + "/api/agent_stats", timeout=15).read())
+    assert stats and stats[0]["agent_pid"] > 0
