@@ -12,7 +12,24 @@ supported through rollout-worker actors like the reference's sampler.
 
 from .algorithm import Algorithm  # noqa: F401
 from .apex import ApexDQN, ApexDQNConfig, collector_epsilon  # noqa: F401
-from .dqn import DQN, DQNConfig, QNetwork  # noqa: F401
+from .bandit import (  # noqa: F401
+    ContextBandit,
+    LinearContextBandit,
+    LinTS,
+    LinTSConfig,
+    LinUCB,
+    LinUCBConfig,
+)
+from .dqn import (  # noqa: F401
+    DQN,
+    DQNConfig,
+    QNetwork,
+    Rainbow,
+    RainbowConfig,
+    SimpleQ,
+    SimpleQConfig,
+)
+from .pg import PG, PGConfig  # noqa: F401
 from .env import (  # noqa: F401
     CartPole,
     GridTarget,
@@ -21,7 +38,7 @@ from .env import (  # noqa: F401
     Pendulum,
     PixelPong,
 )
-from .es import ES, ESConfig  # noqa: F401
+from .es import ARS, ARSConfig, ES, ESConfig  # noqa: F401
 from .impala import APPOConfig, Impala, ImpalaConfig  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
 from .td3 import DDPG, DDPGConfig, TD3, TD3Config  # noqa: F401
@@ -30,6 +47,8 @@ from .offline import (  # noqa: F401
     BCConfig,
     CQL,
     CQLConfig,
+    CRR,
+    CRRConfig,
     MARWIL,
     MARWILConfig,
     collect_dataset,

@@ -50,9 +50,6 @@ class QNetwork:
         self.dueling = dueling
         self.num_atoms = num_atoms
         if num_atoms > 1:
-            if dueling:
-                raise ValueError("dueling + distributional is not "
-                                 "supported; pick one head structure")
             if not v_min < v_max:
                 raise ValueError(
                     f"distributional support needs v_min < v_max "
@@ -61,7 +58,7 @@ class QNetwork:
             self.support = jnp.linspace(v_min, v_max, num_atoms)
 
     def init(self, key: jax.Array):
-        if self.num_atoms > 1:
+        if self.num_atoms > 1 and not self.dueling:
             return mlp_init(key, (self.obs_size,) + self.hidden
                             + (self.n_actions * self.num_atoms,))
         if not self.dueling:
@@ -72,12 +69,27 @@ class QNetwork:
                              "layer (the shared torso the V/A heads read)")
         kt, kv, ka = jax.random.split(key, 3)
         width = self.hidden[-1]
+        # dueling heads; with num_atoms > 1 each head emits atoms-wide
+        # outputs (the Rainbow dueling-distributional structure)
         return {"torso": mlp_init(kt, (self.obs_size,) + self.hidden),
-                "v": mlp_init(kv, (width, 1)),
-                "a": mlp_init(ka, (width, self.n_actions))}
+                "v": mlp_init(kv, (width, self.num_atoms)),
+                "a": mlp_init(ka, (width,
+                                   self.n_actions * self.num_atoms))}
+
+    def _torso(self, params, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs
+        for layer in params["torso"]:    # activation on EVERY torso layer
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        return x
 
     def logits(self, params, obs: jnp.ndarray) -> jnp.ndarray:
         """[.., A, atoms] distribution logits (num_atoms > 1 only)."""
+        if self.dueling:
+            x = self._torso(params, obs)
+            v = mlp_apply(params["v"], x)[..., None, :]   # [.., 1, atoms]
+            a = mlp_apply(params["a"], x).reshape(
+                x.shape[:-1] + (self.n_actions, self.num_atoms))
+            return v + a - a.mean(axis=-2, keepdims=True)
         out = mlp_apply(params, obs)
         return out.reshape(out.shape[:-1]
                            + (self.n_actions, self.num_atoms))
@@ -88,9 +100,7 @@ class QNetwork:
             return (probs * self.support).sum(axis=-1)
         if not self.dueling:
             return mlp_apply(params, obs)
-        x = obs
-        for layer in params["torso"]:    # activation on EVERY torso layer
-            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        x = self._torso(params, obs)
         v = mlp_apply(params["v"], x)                      # [..., 1]
         a = mlp_apply(params["a"], x)                      # [..., A]
         return v + a - a.mean(axis=-1, keepdims=True)
@@ -531,3 +541,45 @@ class DQN(Algorithm):
                                     state["target_params"])
         self.iteration = state.get("iteration", 0)
         self._total_env_steps = state.get("env_steps_total", 0)
+
+
+@dataclasses.dataclass
+class SimpleQConfig(DQNConfig):
+    """The reference's SimpleQ (`rllib/algorithms/simple_q/simple_q.py`):
+    DQN stripped to its 2013 core — no double-Q, no dueling heads, no
+    n-step, uniform replay.  A preset, because here those are all config
+    bits of the one compiled DQN iteration."""
+    double_q: bool = False
+    dueling: bool = False
+    n_step: int = 1
+    prioritized_replay: bool = False
+    num_atoms: int = 1
+
+    def build(self) -> "SimpleQ":  # type: ignore[override]
+        return SimpleQ(self)
+
+
+class SimpleQ(DQN):
+    _config_cls = SimpleQConfig
+
+
+@dataclasses.dataclass
+class RainbowConfig(DQNConfig):
+    """Every DQN improvement at once (the Rainbow recipe, which the
+    reference exposes as DQN config flags: `rllib/algorithms/dqn/dqn.py`
+    n_step/double/dueling/noisy/num_atoms): double-Q + dueling + 3-step
+    + prioritized replay + C51 distributional heads."""
+    double_q: bool = True
+    dueling: bool = True
+    n_step: int = 3
+    prioritized_replay: bool = True
+    num_atoms: int = 51
+    v_min: float = -10.0
+    v_max: float = 10.0
+
+    def build(self) -> "Rainbow":  # type: ignore[override]
+        return Rainbow(self)
+
+
+class Rainbow(DQN):
+    _config_cls = RainbowConfig

@@ -153,10 +153,13 @@ class ES(Algorithm):
         self._iter_seed = cfg.seed
         self._remote_task = None
 
-    # -- one ES iteration ---------------------------------------------------
-    def training_step(self) -> Dict[str, Any]:
+    # -- shared perturbation fan-out (ES and ARS) ---------------------------
+    def _evaluate_directions(self):
+        """Advance the noise stream one iteration and evaluate every
+        antithetic perturbation pair — one task per pair across the
+        cluster when ``num_workers > 0``, inline otherwise.
+        → (seeds, r_pos, r_neg)."""
         cfg = self.config
-        t0 = time.perf_counter()
         self._iter_seed += 1
         # SeedSequence entropy lists mix (config seed, iteration, index)
         # NON-linearly: adjacent config seeds must not share noise streams
@@ -182,6 +185,13 @@ class ES(Algorithm):
 
         r_pos = np.asarray([r[0] for r in results])
         r_neg = np.asarray([r[1] for r in results])
+        return seeds, r_pos, r_neg
+
+    # -- one ES iteration ---------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        seeds, r_pos, r_neg = self._evaluate_directions()
         # centered-rank normalization over the 2n evaluations (the
         # public ES recipe: robust to return scale)
         all_r = np.concatenate([r_pos, r_neg])
@@ -221,3 +231,56 @@ class ES(Algorithm):
         # already trained on would break the gradient estimate's
         # independence assumption
         self._iter_seed = self.config.seed + self.iteration
+
+
+# ---------------------------------------------------------------------------
+# ARS: Augmented Random Search (the reference's `rllib/algorithms/ars/
+# ars.py` — same perturbation fan-out as ES with three changes from the
+# public ARS recipe: only the top-k directions by max(r+, r-) contribute,
+# the step is normalized by the std of the selected returns, and the
+# perturbation noise is NOT rank-normalized).  Shares the ES evaluation
+# tasks (seed-only shipping, cluster fan-out, jitted episode batches).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ARSConfig(ESConfig):
+    top_k: int = 0                 # 0 → use all directions (vanilla BRS)
+    sigma: float = 0.05
+    lr: float = 0.02
+
+    def build(self) -> "ARS":      # type: ignore[override]
+        return ARS(self)
+
+
+class ARS(ES):
+    _config_cls = ARSConfig
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        seeds, r_pos, r_neg = self._evaluate_directions()
+        # top-k directions by best-of-pair return (ARS v1-t / v2-t)
+        k = cfg.top_k or len(seeds)
+        k = min(k, len(seeds))
+        order = np.argsort(-np.maximum(r_pos, r_neg))[:k]
+        used = np.concatenate([r_pos[order], r_neg[order]])
+        sigma_r = float(used.std()) or 1.0
+
+        grad = np.zeros(self.flat.shape[0], dtype=np.float32)
+        for i in order:
+            rng = np.random.default_rng(np.random.SeedSequence(seeds[i]))
+            eps = rng.standard_normal(self.flat.shape[0], dtype=np.float32)
+            grad += (r_pos[i] - r_neg[i]) * eps
+        self.flat = self.flat + (cfg.lr / (k * sigma_r)) * jnp.asarray(grad)
+
+        dt = time.perf_counter() - t0
+        episodes = 2 * len(seeds) * cfg.episodes_per_eval
+        mean_return = float(self._eval(
+            _unflatten(self.flat, self.meta),
+            jax.random.PRNGKey(self._iter_seed)))
+        return {"episode_reward_mean": mean_return,
+                "perturbations": len(seeds), "top_k": k,
+                "return_std": sigma_r,
+                "env_steps_this_iter": episodes * cfg.horizon,
+                "env_steps_per_s": episodes * cfg.horizon / dt}

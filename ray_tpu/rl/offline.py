@@ -520,3 +520,186 @@ def importance_sampling_estimate(policy: MLPPolicy, params,
             "v_behavior": float(ep_returns.mean()),
             "num_episodes": int(len(ep_returns)),
             "mean_ratio": float(ratios.mean())}
+
+
+# ------------------------------------------------- critic-regularized
+@dataclasses.dataclass
+class CRRConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    dataset: Optional[Dict[str, np.ndarray]] = None
+    weight_fn: str = "binary"      # "binary" (1[A>0]) | "exp"
+    beta: float = 1.0              # exp-weight temperature
+    weight_clip: float = 20.0      # cap on exp weights
+    gamma: float = 0.99
+    tau: float = 0.01              # Polyak target-average rate
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs_per_iter: int = 1
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "CRR":
+        return CRR(self)
+
+
+class CRR(Algorithm):
+    """Critic-Regularized Regression, discrete actions (reference:
+    `rllib/algorithms/crr/crr.py` — offline actor-critic where the actor
+    is advantage-filtered behavioral cloning).
+
+    The critic is a Q-network TD-trained against the CURRENT policy's
+    expected target value (``y = r + g*(1-d)*E_{a'~pi} Q_tgt(s',a')`` —
+    exact for discrete actions, no sampling needed); the actor clones
+    only transitions the critic approves: weight ``1[A(s,a) > 0]``
+    ("binary") or ``exp(A/beta)`` ("exp"), with
+    ``A(s,a) = Q(s,a) - E_{a~pi} Q(s,a)``.  Against CQL's pessimism,
+    CRR's filter needs no OOD penalty — the actor simply never imitates
+    dataset actions its critic dislikes.  One jitted epoch over
+    permuted minibatches, like BC/MARWIL/CQL.
+    """
+
+    _config_cls = CRRConfig
+
+    def __init__(self, config: CRRConfig):
+        super().__init__(config)
+        if config.env is None or config.dataset is None:
+            raise ValueError("CRRConfig.env and CRRConfig.dataset required")
+        if config.epochs_per_iter < 1:
+            raise ValueError("epochs_per_iter must be >= 1 (a zero-epoch "
+                             "iteration would report no loss)")
+        if config.weight_fn not in ("binary", "exp"):
+            raise ValueError(f"weight_fn={config.weight_fn!r} not in "
+                             "('binary', 'exp')")
+        self.env = config.env()
+        if not self.env.discrete:
+            raise ValueError("this CRR implementation is discrete-action "
+                             "(the reference's continuous variant samples "
+                             "the policy for the advantage expectation)")
+        from .dqn import QNetwork
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size, discrete=True,
+                                hidden=config.hidden)
+        self.q = QNetwork(self.env.observation_size, self.env.action_size,
+                          hidden=config.hidden)
+        self.key = jax.random.PRNGKey(config.seed)
+        self.key, pkey, qkey = jax.random.split(self.key, 3)
+        self.params = {"pi": self.policy.init(pkey),
+                       "q": self.q.init(qkey)}
+        self.target_q = jax.tree_util.tree_map(lambda x: x,
+                                               self.params["q"])
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        ds = config.dataset
+        n = (len(ds["obs"]) // config.batch_size) * config.batch_size
+        if n == 0:
+            raise ValueError(
+                f"dataset has {len(ds['obs'])} rows < batch_size="
+                f"{config.batch_size}: an epoch would run zero "
+                f"minibatches and train nothing")
+        self._data = {
+            "obs": jnp.asarray(ds["obs"][:n], jnp.float32),
+            "action": jnp.asarray(ds["action"][:n], jnp.int32),
+            "reward": jnp.asarray(ds["reward"][:n], jnp.float32),
+            "next_obs": jnp.asarray(ds["next_obs"][:n], jnp.float32),
+            "done": jnp.asarray(ds["done"][:n], jnp.float32),
+        }
+        self._epoch = jax.jit(self._make_epoch_fn(n))
+
+    def _make_epoch_fn(self, n: int):
+        cfg = self.config
+        policy, q = self.policy, self.q
+        n_mb = n // cfg.batch_size
+
+        def epoch(params, target_q, opt_state, key):
+            key, pkey = jax.random.split(key)
+            idx = jax.random.permutation(pkey, n).reshape(
+                n_mb, cfg.batch_size)
+
+            def mb_step(carry, ix):
+                params, target_q, opt_state = carry
+                batch = jax.tree_util.tree_map(lambda x: x[ix],
+                                               self._data)
+
+                def loss_fn(p):
+                    B = batch["obs"].shape[0]
+                    qvals = q.apply(p["q"], batch["obs"])       # [B, A]
+                    q_sa = qvals[jnp.arange(B), batch["action"]]
+                    # policy distribution at s' for the expected target
+                    pi_next, _ = jax.vmap(
+                        lambda o: policy.forward(p["pi"], o))(
+                            batch["next_obs"])
+                    pi_next = jax.nn.softmax(
+                        jax.lax.stop_gradient(pi_next))
+                    q_next = q.apply(target_q, batch["next_obs"])
+                    v_next = (pi_next * q_next).sum(-1)
+                    target = batch["reward"] + cfg.gamma \
+                        * (1.0 - batch["done"]) \
+                        * jax.lax.stop_gradient(v_next)
+                    critic_loss = jnp.mean((q_sa - target) ** 2)
+                    # advantage under the CURRENT policy's expectation
+                    pi_cur, _ = jax.vmap(
+                        lambda o: policy.forward(p["pi"], o))(
+                            batch["obs"])
+                    pi_cur = jax.nn.softmax(jax.lax.stop_gradient(pi_cur))
+                    v_s = (pi_cur * jax.lax.stop_gradient(qvals)).sum(-1)
+                    adv = jax.lax.stop_gradient(q_sa) - v_s
+                    if cfg.weight_fn == "binary":
+                        w = (adv > 0).astype(jnp.float32)
+                    else:
+                        w = jnp.minimum(jnp.exp(adv / cfg.beta),
+                                        cfg.weight_clip)
+                    logp, _, _ = jax.vmap(
+                        lambda o, a: policy.log_prob(p["pi"], o, a))(
+                            batch["obs"], batch["action"])
+                    actor_loss = -jnp.mean(w * logp)
+                    return actor_loss + critic_loss, \
+                        (actor_loss, critic_loss, w.mean())
+
+                (loss, (a_l, c_l, w_mean)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                target_q = jax.tree_util.tree_map(
+                    lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                    target_q, params["q"])
+                return (params, target_q, opt_state), (a_l, c_l, w_mean)
+
+            (params, target_q, opt_state), (a_ls, c_ls, w_means) = \
+                jax.lax.scan(mb_step, (params, target_q, opt_state), idx)
+            return (params, target_q, opt_state, key,
+                    a_ls.mean(), c_ls.mean(), w_means.mean())
+
+        return epoch
+
+    def training_step(self) -> Dict[str, Any]:
+        a_l = c_l = w_m = None
+        for _ in range(self.config.epochs_per_iter):
+            (self.params, self.target_q, self.opt_state, self.key,
+             a_l, c_l, w_m) = self._epoch(
+                self.params, self.target_q, self.opt_state, self.key)
+        return {"actor_loss": float(a_l), "critic_loss": float(c_l),
+                "accepted_fraction": float(w_m),
+                "env_steps_this_iter": 0}
+
+    def action_fn(self):
+        """Greedy jittable policy for deployment/eval."""
+        policy, params = self.policy, self.params["pi"]
+
+        def act(obs, key):
+            pi, _ = policy.forward(params, obs)
+            return jnp.argmax(pi, axis=-1)
+        return act
+
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "target_q": to_np(self.target_q),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.target_q = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.target_q, state["target_q"])
+        self.iteration = state.get("iteration", 0)

@@ -1,0 +1,117 @@
+"""Vanilla policy gradient (REINFORCE).
+
+Capability mirror of the reference's PG
+(`rllib/algorithms/pg/pg.py` — the minimal on-policy algorithm: loss is
+``-logp * discounted_return``, no critic, no clipping, one pass over each
+batch).  TPU-first shape: rollout + return computation + the single
+gradient step compile into ONE XLA program, sharing PPO's vectorized
+rollout scan (`make_rollout_fn`) and connector plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .ppo import PPO, PPOConfig
+
+
+@dataclasses.dataclass
+class PGConfig(PPOConfig):
+    lr: float = 4e-3
+    entropy_coeff: float = 0.0
+    normalize_advantages: bool = True
+
+    def build(self) -> "PG":       # type: ignore[override]
+        return PG(self)
+
+
+def _returns_to_go(rewards, dones, gamma: float):
+    """[T, B] rewards/dones → [T, B] discounted returns, zero-bootstrapped
+    at episode ends AND at the rollout truncation (no critic exists to
+    bootstrap with — the PG contract)."""
+
+    def scan_fn(ret_next, frame):
+        r, d = frame
+        ret = r + gamma * ret_next * (1.0 - d)
+        return ret, ret
+
+    _, rets = jax.lax.scan(
+        scan_fn, jnp.zeros_like(rewards[0]),
+        (rewards, dones.astype(rewards.dtype)), reverse=True)
+    return rets
+
+
+class PG(PPO):
+    _config_cls = PGConfig
+
+    def _make_update_fn(self, batch_size: int):
+        cfg, policy, optimizer = self.config, self.policy, self.optimizer
+
+        def loss_fn(params, batch):
+            logp, entropy, _value = jax.vmap(
+                lambda o, a: policy.log_prob(params, o, a))(
+                    batch["obs"], batch["action"])
+            adv = batch["adv"]
+            if cfg.normalize_advantages:
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg_loss = -(logp * adv).mean()
+            ent = entropy.mean()
+            return pg_loss - cfg.entropy_coeff * ent, \
+                {"pg_loss": pg_loss, "entropy": ent}
+
+        def update(params, opt_state, flat, key):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, flat)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, key, metrics
+
+        return update
+
+    def _make_train_iter(self):
+        if self._recurrent:
+            raise ValueError("PG does not support use_lstm; use PPO")
+        if self.config.num_workers > 0:
+            # PPO's worker path ships GAE advantages computed against the
+            # value head — which PG's loss never trains, so those
+            # advantages would come from a frozen random critic
+            raise ValueError("PG does not support num_workers > 0: "
+                             "rollout workers compute critic-based GAE "
+                             "advantages and PG trains no critic; use "
+                             "the inline path (num_workers=0) or PPO")
+        cfg = self.config
+        batch_size = cfg.num_envs * cfg.rollout_length
+        update = self._make_update_fn(batch_size)
+
+        def train_iter(params, opt_state, env_states, obs, conn_state,
+                       key):
+            (traj, env_states, obs, conn_state, _last_value,
+             key) = self._rollout(params, env_states, obs, conn_state,
+                                  key)
+            ret = _returns_to_go(traj["reward"], traj["done"], cfg.gamma)
+            flat = {
+                "obs": traj["obs"].reshape(batch_size, -1),
+                "action": traj["action"].reshape(
+                    (batch_size,) if self.env.discrete
+                    else (batch_size, -1)),
+                "adv": ret.reshape(batch_size),
+            }
+            params, opt_state, key, metrics = update(
+                params, opt_state, flat, key)
+            metrics["reward_sum"] = traj["reward"].sum()
+            return params, opt_state, env_states, obs, conn_state, key, \
+                metrics, traj["reward"], traj["done"]
+
+        return train_iter
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["algo"] = "PG"
+        return state

@@ -298,9 +298,18 @@ def test_c51_dqn_learns_cartpole():
     assert best > 120, best
 
 
-def test_c51_rejects_dueling():
-    import pytest as _pytest
+def test_c51_dueling_heads():
+    """Dueling + distributional combine (the Rainbow head structure):
+    per-atom V and A streams, Q = E_z[softmax(V + A - mean_A A)]."""
+    import jax
 
     from ray_tpu.rl.dqn import QNetwork
-    with _pytest.raises(ValueError, match="dueling"):
-        QNetwork(4, 2, dueling=True, num_atoms=51)
+    q = QNetwork(4, 2, dueling=True, num_atoms=51)
+    params = q.init(jax.random.PRNGKey(0))
+    obs = np.zeros((7, 4), np.float32)
+    logits = q.logits(params, obs)
+    assert logits.shape == (7, 2, 51)
+    qv = q.apply(params, obs)
+    assert qv.shape == (7, 2)
+    # expected values must lie inside the distribution's support
+    assert float(jnp.abs(qv).max()) <= 10.0 + 1e-5
