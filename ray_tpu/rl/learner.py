@@ -1,0 +1,61 @@
+"""Shared off-policy learner scaffolding.
+
+The sample → TD-grad → optimizer step → Polyak target-average loop behind
+a learn-start gate is the same compiled structure in every value-based
+algorithm here (dqn.py pioneered it; R2D2 and QMIX reuse it through this
+helper instead of re-pasting the scan/cond scaffolding).  The reference
+spreads this across per-algorithm execution plans
+(`rllib/execution/train_ops.py`); under jit it is one reusable
+closure."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_update_gate(optimizer, *, tau: float, learn_start: int,
+                     num_updates: int,
+                     sample_fn: Callable,
+                     loss_fn: Callable):
+    """→ ``gate(params, target_params, opt_state, buffer, key)`` running
+    ``num_updates`` TD steps behind the learn-start gate (a no-op until
+    the buffer holds ``learn_start`` rows), Polyak-averaging the target
+    after every step.
+
+    ``sample_fn(buffer, key) -> (batch, idx, key)``;
+    ``loss_fn(params, target_params, batch) -> scalar loss``.
+    Returns ``(params, target_params, opt_state, buffer, key,
+    last_loss)``."""
+
+    def update(carry, _):
+        params, target_params, opt_state, buffer, key = carry
+        batch, _, key = sample_fn(buffer, key)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        target_params = jax.tree_util.tree_map(
+            lambda t, p: (1 - tau) * t + tau * p, target_params, params)
+        return (params, target_params, opt_state, buffer, key), loss
+
+    def run_updates(args):
+        (params, target_params, opt_state, buffer, key), losses = \
+            jax.lax.scan(update, args, None, length=num_updates)
+        return (params, target_params, opt_state, buffer, key,
+                losses[-1])
+
+    def skip_updates(args):
+        params, target_params, opt_state, buffer, key = args
+        return (params, target_params, opt_state, buffer, key,
+                jnp.zeros(()))
+
+    def gate(params, target_params, opt_state, buffer, key):
+        return jax.lax.cond(
+            buffer["size"] >= learn_start, run_updates, skip_updates,
+            (params, target_params, opt_state, buffer, key))
+
+    return gate

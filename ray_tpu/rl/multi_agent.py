@@ -44,7 +44,10 @@ class MultiAgentJaxEnv:
         raise NotImplementedError
 
     def step(self, state, actions, key):
-        """→ (state, obs[N, obs], rewards[N], done) — shared episode end."""
+        """→ (state, obs[N, obs], rewards[N], done) — shared episode end.
+        Envs AUTO-RESET on done (returning the new episode's state/obs),
+        the same contract as the single-agent JaxEnv: collect scans carry
+        env state across iterations and never reset explicitly."""
         raise NotImplementedError
 
 
@@ -90,6 +93,13 @@ class SpreadLine(MultiAgentJaxEnv):
         dist = jnp.abs(pos - state["targets"])
         rewards = -dist - 0.25 * jnp.sum(close, axis=1)
         done = t >= self.horizon
+        # auto-reset (the MultiAgentJaxEnv contract): past the horizon
+        # the returned state/obs belong to a fresh episode — without
+        # this, carried env states stay terminal forever and every
+        # replayed transition after the first horizon is degenerate
+        reset_state, _ = self.reset(key)
+        state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(done, r, c), reset_state, state)
         return state, self._obs(state), rewards, done
 
 

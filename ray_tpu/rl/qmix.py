@@ -1,0 +1,255 @@
+"""QMIX: cooperative multi-agent Q-learning with monotonic value mixing.
+
+Capability mirror of the reference's QMIX
+(`rllib/algorithms/qmix/qmix.py` — per-agent Q-networks whose chosen
+values feed a state-conditioned monotonic mixing network; TD is on the
+TEAM value).  TPU-first shape, matching multi_agent.py's design: the
+agent population is a static leading axis (per-agent Q evaluation is a
+``vmap``, not a policy-map loop), the hypernetwork mixer keeps
+``dQ_tot/dQ_a >= 0`` through ``abs()`` weights, and collect → replay
+insert → sample → mixer TD compile into ONE XLA program like dqn.py.
+
+Agents share Q-network parameters (the reference default); the env's
+``rewards[N]`` sum to the team reward, and the global mixer state is
+``env.global_state(state)`` when provided, else the concatenated agent
+observations (the standard QMIX fallback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import replay
+from .algorithm import Algorithm
+from .multi_agent import MultiAgentJaxEnv
+from .policy import mlp_apply, mlp_init
+
+
+def mixer_init(key: jax.Array, state_size: int, n_agents: int,
+               embed: int):
+    """Hypernetworks mapping the global state to mixer weights."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "hw1": mlp_init(k1, (state_size, n_agents * embed)),
+        "hb1": mlp_init(k2, (state_size, embed)),
+        "hw2": mlp_init(k3, (state_size, embed)),
+        # the final bias runs through a small MLP (the paper's V(s))
+        "hv": mlp_init(k4, (state_size, embed, 1)),
+    }
+
+
+def mixer_apply(params, q_agents: jnp.ndarray,
+                state: jnp.ndarray) -> jnp.ndarray:
+    """[.., N] chosen per-agent Qs + [.., S] global state → [..] Q_tot.
+    Monotonic in every q_a: hypernet outputs pass through ``abs``."""
+    n = q_agents.shape[-1]
+    w1 = jnp.abs(mlp_apply(params["hw1"], state))
+    w1 = w1.reshape(state.shape[:-1] + (n, -1))          # [.., N, E]
+    b1 = mlp_apply(params["hb1"], state)                 # [.., E]
+    hidden = jax.nn.elu(
+        jnp.einsum("...n,...ne->...e", q_agents, w1) + b1)
+    w2 = jnp.abs(mlp_apply(params["hw2"], state))        # [.., E]
+    v = mlp_apply(params["hv"], state)[..., 0]           # [..]
+    return (hidden * w2).sum(-1) + v
+
+
+@dataclasses.dataclass
+class QMIXConfig:
+    env: Optional[Callable[[], MultiAgentJaxEnv]] = None
+    num_envs: int = 16
+    rollout_steps: int = 32        # env steps per iteration
+    buffer_capacity: int = 50_000
+    batch_size: int = 128
+    num_updates: int = 16
+    mixing_embed: int = 32
+    gamma: float = 0.99
+    lr: float = 1e-3
+    tau: float = 0.01              # Polyak target-average rate
+    double_q: bool = True
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 20_000
+    learn_start: int = 1_000
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "QMIX":
+        return QMIX(self)
+
+
+class QMIX(Algorithm):
+    _config_cls = QMIXConfig
+
+    def __init__(self, config: QMIXConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("QMIXConfig.env required (a MultiAgentJaxEnv "
+                             "factory)")
+        self.env = cfg.env()
+        if not self.env.discrete:
+            raise ValueError("QMIX is value-based: discrete actions only")
+        self.n_agents = self.env.n_agents
+        obs_dim, n_act = self.env.observation_size, self.env.action_size
+        self._state_fn = getattr(self.env, "global_state", None)
+        if self._state_fn is None:
+            self.state_size = self.n_agents * obs_dim
+        else:
+            self.state_size = self.env.global_state_size
+        key = jax.random.PRNGKey(cfg.seed)
+        key, qk, mk, ek = jax.random.split(key, 4)
+        self.params = {
+            "q": mlp_init(qk, (obs_dim,) + tuple(cfg.hidden) + (n_act,)),
+            "mix": mixer_init(mk, self.state_size, self.n_agents,
+                              cfg.mixing_embed),
+        }
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.buffer = replay.init(cfg.buffer_capacity, {
+            "obs": jnp.zeros((self.n_agents, obs_dim), jnp.float32),
+            "state": jnp.zeros((self.state_size,), jnp.float32),
+            "action": jnp.zeros((self.n_agents,), jnp.int32),
+            "reward": jnp.zeros((), jnp.float32),
+            "next_obs": jnp.zeros((self.n_agents, obs_dim), jnp.float32),
+            "next_state": jnp.zeros((self.state_size,), jnp.float32),
+            "done": jnp.zeros((), jnp.float32),
+        })
+        ekeys = jax.random.split(ek, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.key = key
+        from .exploration import EpsilonGreedy
+        self._explorer = EpsilonGreedy(cfg.eps_start, cfg.eps_end,
+                                       cfg.eps_decay_steps)
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._init_episode_tracking(cfg.num_envs)
+
+    def _global_state(self, env_state, obs):
+        """[B]-batched global mixer state."""
+        if self._state_fn is not None:
+            return jax.vmap(self._state_fn)(env_state)
+        return obs.reshape(obs.shape[0], -1)
+
+    # -- the compiled iteration --------------------------------------------
+    def _make_train_iter(self):
+        cfg, env = self.config, self.env
+        explorer = self._explorer
+        N = self.n_agents
+        from .learner import make_update_gate
+
+        def agent_q(qp, obs):
+            """[.., N, obs] → [.., N, A] (shared agent parameters)."""
+            return mlp_apply(qp, obs)
+
+        def td_loss(params, target_params, batch):
+            q_all = agent_q(params["q"], batch["obs"])   # [B, N, A]
+            q_sa = jnp.take_along_axis(
+                q_all, batch["action"][..., None], axis=-1)[..., 0]
+            q_tot = mixer_apply(params["mix"], q_sa, batch["state"])
+            q_next_t = agent_q(target_params["q"], batch["next_obs"])
+            if cfg.double_q:
+                sel = jnp.argmax(agent_q(params["q"], batch["next_obs"]),
+                                 axis=-1)
+            else:
+                sel = jnp.argmax(q_next_t, axis=-1)
+            q_next = jnp.take_along_axis(
+                q_next_t, sel[..., None], axis=-1)[..., 0]   # [B, N]
+            q_tot_next = mixer_apply(target_params["mix"], q_next,
+                                     batch["next_state"])
+            target = batch["reward"] + cfg.gamma \
+                * (1.0 - batch["done"]) * jax.lax.stop_gradient(q_tot_next)
+            return jnp.mean((q_tot - target) ** 2)
+
+        update_gate = make_update_gate(
+            self.optimizer, tau=cfg.tau, learn_start=cfg.learn_start,
+            num_updates=cfg.num_updates,
+            sample_fn=lambda buf, key: replay.sample(buf, key,
+                                                     cfg.batch_size),
+            loss_fn=td_loss)
+
+        def train_iter(params, target_params, opt_state, buffer,
+                       env_states, obs, key, total_steps):
+
+            def collect(carry, _):
+                buffer, env_states, obs, key = carry
+                key, akey, skey = jax.random.split(key, 3)
+                state_g = self._global_state(env_states, obs)
+                qvals = agent_q(params["q"], obs)        # [B, N, A]
+                _, action = explorer((), akey, qvals, total_steps)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, rewards, done = jax.vmap(env.step)(
+                    env_states, action, skeys)
+                next_state_g = self._global_state(env_states, next_obs)
+                team_r = rewards.sum(-1)
+                buffer = replay.add_batch(buffer, {
+                    "obs": obs.astype(jnp.float32),
+                    "state": state_g.astype(jnp.float32),
+                    "action": action.astype(jnp.int32),
+                    "reward": team_r.astype(jnp.float32),
+                    "next_obs": next_obs.astype(jnp.float32),
+                    "next_state": next_state_g.astype(jnp.float32),
+                    "done": done.astype(jnp.float32),
+                }, cfg.num_envs)
+                frame = {"reward": team_r, "done": done}
+                return (buffer, env_states, next_obs, key), frame
+
+            (buffer, env_states, obs, key), traj = jax.lax.scan(
+                collect, (buffer, env_states, obs, key), None,
+                length=cfg.rollout_steps)
+
+            (params, target_params, opt_state, buffer, key,
+             last_loss) = update_gate(params, target_params, opt_state,
+                                      buffer, key)
+            metrics = {"td_loss": last_loss,
+                       "epsilon": explorer.epsilon(total_steps),
+                       "buffer_size": buffer["size"]}
+            return (params, target_params, opt_state, buffer, env_states,
+                    obs, key, metrics, traj["reward"], traj["done"])
+
+        return train_iter
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        (self.params, self.target_params, self.opt_state, self.buffer,
+         self.env_states, self.obs, self.key, metrics, rewards,
+         dones) = self._train_iter(
+            self.params, self.target_params, self.opt_state, self.buffer,
+            self.env_states, self.obs, self.key,
+            jnp.asarray(self._total_env_steps, jnp.float32))
+        self._track_episodes(np.asarray(rewards), np.asarray(dones))
+        dt = time.perf_counter() - t0
+        steps = cfg.num_envs * cfg.rollout_steps
+        return {
+            "td_loss": float(metrics["td_loss"]),
+            "epsilon": float(metrics["epsilon"]),
+            "buffer_size": int(metrics["buffer_size"]),
+            "episode_reward_mean": self.episode_reward_mean(),
+            "env_steps_this_iter": steps,
+            "env_steps_per_s": steps / dt,
+        }
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "target_params": to_np(self.target_params),
+                "iteration": self.iteration,
+                "env_steps_total": self._total_env_steps}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.target_params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.target_params,
+            state["target_params"])
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("env_steps_total", 0)

@@ -1,0 +1,294 @@
+"""R2D2: recurrent-replay distributed DQN.
+
+Capability mirror of the reference's R2D2
+(`rllib/algorithms/r2d2/r2d2.py` — DQN over an LSTM Q-network with a
+sequence replay buffer, stored recurrent states, and burn-in).  TPU-first
+shape: the buffer rows ARE fixed-length sequences (the same
+device-resident circular buffer as DQN, with ``[T, ...]``-shaped leaves),
+the vectorized collect scan banks one sequence per env per iteration
+together with the LSTM state at its start (the paper's "stored state"
+strategy), and the update unrolls burn-in + TD through ``lax.scan``
+entirely on device — collection, insertion, sampling, and the recurrent
+double-Q update compile into ONE XLA program, like dqn.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import replay
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import mlp_apply, mlp_init
+
+
+class RecurrentQNetwork:
+    """obs → MLP torso → LSTM cell → Q[action]; explicit ``(h, c)``
+    carry like LSTMPolicy (policy.py), composing with ``lax.scan``."""
+
+    def __init__(self, obs_size: int, n_actions: int, hidden=(64,),
+                 lstm_size: int = 64):
+        if not hidden:
+            raise ValueError("RecurrentQNetwork needs >=1 torso layer")
+        self.obs_size = obs_size
+        self.n_actions = n_actions
+        self.hidden = tuple(hidden)
+        self.lstm_size = lstm_size
+
+    def init(self, key: jax.Array):
+        kt, kl, kq = jax.random.split(key, 3)
+        in_dim = self.hidden[-1] + self.lstm_size
+        return {
+            "torso": mlp_init(kt, (self.obs_size,) + self.hidden),
+            "lstm": {"w": jax.random.normal(
+                kl, (in_dim, 4 * self.lstm_size))
+                * math.sqrt(1.0 / in_dim),
+                "b": jnp.zeros((4 * self.lstm_size,))},
+            "q": {"w": jax.random.normal(
+                kq, (self.lstm_size, self.n_actions)) * 0.01,
+                "b": jnp.zeros((self.n_actions,))},
+        }
+
+    def initial_state(self, batch_size: Optional[int] = None):
+        shape = ((self.lstm_size,) if batch_size is None
+                 else (batch_size, self.lstm_size))
+        return (jnp.zeros(shape), jnp.zeros(shape))
+
+    def step(self, params, obs: jnp.ndarray, state):
+        """One timestep: obs [.., obs] + (h, c) → (q [.., A], state')."""
+        x = obs
+        for layer in params["torso"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        h, c = state
+        z = jnp.concatenate([x, h], axis=-1) @ params["lstm"]["w"] \
+            + params["lstm"]["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        q = h @ params["q"]["w"] + params["q"]["b"]
+        return q, (h, c)
+
+    def unroll(self, params, obs_seq: jnp.ndarray, done_seq: jnp.ndarray,
+               init_state):
+        """[T, B, obs] + done [T, B] (state resets AFTER a done step,
+        matching the collect scan) → q_seq [T, B, A]."""
+
+        def step_fn(state, inp):
+            obs, done = inp
+            q, state = self.step(params, obs, state)
+            keep = (1.0 - done.astype(jnp.float32))[..., None]
+            state = jax.tree_util.tree_map(lambda s: s * keep, state)
+            return state, q
+
+        _, q_seq = jax.lax.scan(step_fn, init_state, (obs_seq, done_seq))
+        return q_seq
+
+
+@dataclasses.dataclass
+class R2D2Config:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_envs: int = 16
+    seq_len: int = 20              # stored sequence length (after burn-in)
+    burn_in: int = 4               # prefix steps that only warm the state
+    buffer_capacity: int = 2048    # capacity in SEQUENCES
+    batch_size: int = 32           # sequences per TD update
+    num_updates: int = 8           # SGD steps per iteration
+    gamma: float = 0.99
+    lr: float = 1e-3
+    tau: float = 0.01              # Polyak target-average rate
+    double_q: bool = True
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 20_000
+    learn_start: int = 32          # sequences in buffer before updates
+    hidden: tuple = (64,)
+    lstm_size: int = 64
+    seed: int = 0
+
+    def build(self) -> "R2D2":
+        return R2D2(self)
+
+
+class R2D2(Algorithm):
+    _config_cls = R2D2Config
+
+    def __init__(self, config: R2D2Config):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("R2D2Config.env required (an env factory)")
+        if cfg.burn_in >= cfg.seq_len:
+            raise ValueError(
+                f"burn_in={cfg.burn_in} >= seq_len={cfg.seq_len}: no "
+                "steps would remain for the TD loss")
+        self.env = cfg.env()
+        if not self.env.discrete:
+            raise ValueError("R2D2 is a DQN variant: discrete actions "
+                             "only")
+        obs_dim, n_act = self.env.observation_size, self.env.action_size
+        self.q = RecurrentQNetwork(obs_dim, n_act, hidden=cfg.hidden,
+                                   lstm_size=cfg.lstm_size)
+        key = jax.random.PRNGKey(cfg.seed)
+        key, pkey, ekey = jax.random.split(key, 3)
+        self.params = self.q.init(pkey)
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        T = cfg.seq_len
+        # one row = one sequence + the LSTM state at its start; obs has
+        # T+1 entries so every step's TD target has its next_obs in-row
+        self.buffer = replay.init(cfg.buffer_capacity, {
+            "obs": jnp.zeros((T + 1, obs_dim), jnp.float32),
+            "action": jnp.zeros((T,), jnp.int32),
+            "reward": jnp.zeros((T,), jnp.float32),
+            "done": jnp.zeros((T,), jnp.float32),
+            "h0": jnp.zeros((cfg.lstm_size,), jnp.float32),
+            "c0": jnp.zeros((cfg.lstm_size,), jnp.float32),
+        })
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.lstm_state = self.q.initial_state(cfg.num_envs)
+        self.key = key
+        from .exploration import EpsilonGreedy
+        self._explorer = EpsilonGreedy(cfg.eps_start, cfg.eps_end,
+                                       cfg.eps_decay_steps)
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._init_episode_tracking(cfg.num_envs)
+
+    # -- the compiled iteration --------------------------------------------
+    def _make_train_iter(self):
+        cfg, env, q = self.config, self.env, self.q
+        explorer = self._explorer
+        T = cfg.seq_len
+        from .learner import make_update_gate
+
+        def td_loss(params, target_params, batch):
+            """batch leaves are [B, ...] sequence rows."""
+            # time-major views
+            obs = jnp.swapaxes(batch["obs"], 0, 1)        # [T+1, B, obs]
+            done = jnp.swapaxes(batch["done"], 0, 1)      # [T, B]
+            init = (batch["h0"], batch["c0"])
+            # the T+1-th unroll step needs a done flag; the final obs
+            # never produces a TD target past it, so pad with zeros
+            done_pad = jnp.concatenate(
+                [done, jnp.zeros((1,) + done.shape[1:])], axis=0)
+            q_on = q.unroll(params, obs, done_pad, init)  # [T+1, B, A]
+            q_tg = q.unroll(target_params, obs, done_pad, init)
+            q_sa = jnp.take_along_axis(
+                q_on[:T], jnp.swapaxes(batch["action"], 0, 1)[..., None],
+                axis=-1)[..., 0]                           # [T, B]
+            if cfg.double_q:
+                sel = jnp.argmax(q_on[1:], axis=-1)        # [T, B]
+            else:
+                sel = jnp.argmax(q_tg[1:], axis=-1)
+            q_next = jnp.take_along_axis(
+                q_tg[1:], sel[..., None], axis=-1)[..., 0]
+            target = jnp.swapaxes(batch["reward"], 0, 1) + cfg.gamma \
+                * (1.0 - done) * jax.lax.stop_gradient(q_next)
+            td = q_sa - jax.lax.stop_gradient(target)
+            # burn-in steps warm the recurrence but carry no loss
+            mask = (jnp.arange(T) >= cfg.burn_in).astype(jnp.float32)
+            td = td * mask[:, None]
+            return (td ** 2).sum() / (mask.sum() * td.shape[1])
+
+        update_gate = make_update_gate(
+            self.optimizer, tau=cfg.tau, learn_start=cfg.learn_start,
+            num_updates=cfg.num_updates,
+            sample_fn=lambda buf, key: replay.sample(buf, key,
+                                                     cfg.batch_size),
+            loss_fn=td_loss)
+
+        def train_iter(params, target_params, opt_state, buffer,
+                       env_states, obs, lstm_state, key, total_steps):
+            h0, c0 = lstm_state                            # state at seq start
+
+            def collect(carry, _):
+                env_states, obs, lstm_state, key = carry
+                key, akey, skey = jax.random.split(key, 3)
+                qvals, lstm_state = q.step(params, obs, lstm_state)
+                _, action = explorer((), akey, qvals, total_steps)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(env.step)(
+                    env_states, action, skeys)
+                keep = (1.0 - done.astype(jnp.float32))[..., None]
+                lstm_state = jax.tree_util.tree_map(
+                    lambda s: s * keep, lstm_state)
+                frame = {"obs": obs.astype(jnp.float32),
+                         "action": action.astype(jnp.int32),
+                         "reward": reward.astype(jnp.float32),
+                         "done": done.astype(jnp.float32)}
+                return (env_states, next_obs, lstm_state, key), frame
+
+            (env_states, obs, lstm_state, key), traj = jax.lax.scan(
+                collect, (env_states, obs, lstm_state, key), None,
+                length=T)
+            # bank one sequence per env, batch-major rows with the final
+            # observation appended
+            obs_rows = jnp.concatenate(
+                [jnp.swapaxes(traj["obs"], 0, 1), obs[:, None]], axis=1)
+            buffer = replay.add_batch(buffer, {
+                "obs": obs_rows,
+                "action": jnp.swapaxes(traj["action"], 0, 1),
+                "reward": jnp.swapaxes(traj["reward"], 0, 1),
+                "done": jnp.swapaxes(traj["done"], 0, 1),
+                "h0": h0, "c0": c0,
+            }, cfg.num_envs)
+
+            (params, target_params, opt_state, buffer, key,
+             last_loss) = update_gate(params, target_params, opt_state,
+                                      buffer, key)
+            metrics = {"td_loss": last_loss,
+                       "epsilon": explorer.epsilon(total_steps),
+                       "buffer_size": buffer["size"]}
+            return (params, target_params, opt_state, buffer, env_states,
+                    obs, lstm_state, key, metrics, traj["reward"],
+                    traj["done"])
+
+        return train_iter
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        (self.params, self.target_params, self.opt_state, self.buffer,
+         self.env_states, self.obs, self.lstm_state, self.key, metrics,
+         rewards, dones) = self._train_iter(
+            self.params, self.target_params, self.opt_state, self.buffer,
+            self.env_states, self.obs, self.lstm_state, self.key,
+            jnp.asarray(self._total_env_steps, jnp.float32))
+        self._track_episodes(np.asarray(rewards), np.asarray(dones))
+        dt = time.perf_counter() - t0
+        steps = cfg.num_envs * cfg.seq_len
+        return {
+            "td_loss": float(metrics["td_loss"]),
+            "epsilon": float(metrics["epsilon"]),
+            "buffer_size": int(metrics["buffer_size"]),
+            "episode_reward_mean": self.episode_reward_mean(),
+            "env_steps_this_iter": steps,
+            "env_steps_per_s": steps / dt,
+        }
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "target_params": to_np(self.target_params),
+                "iteration": self.iteration,
+                "env_steps_total": self._total_env_steps}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.target_params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.target_params,
+            state["target_params"])
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("env_steps_total", 0)

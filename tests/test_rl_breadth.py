@@ -187,3 +187,40 @@ def test_crr_validates_config():
     with pytest.raises(ValueError, match="epochs_per_iter"):
         CRRConfig(env=CartPole, dataset={"obs": np.zeros((10, 4))},
                   epochs_per_iter=0).build()
+
+
+def test_r2d2_solves_memory_task():
+    """The LSTM Q-network must beat the memoryless reward ceiling on the
+    cue-recall env (a feedforward DQN tops out near 4.5/8)."""
+    from ray_tpu.rl import MemoryCue, R2D2Config
+    algo = R2D2Config(env=MemoryCue, num_envs=16, seq_len=16, burn_in=2,
+                      buffer_capacity=1024, batch_size=32, num_updates=8,
+                      eps_decay_steps=6000, learn_start=64, lr=2e-3,
+                      lstm_size=32, seed=0).build()
+    best = 0.0
+    for _ in range(40):
+        best = max(best, algo.train()["episode_reward_mean"])
+    assert best > 6.5, best
+
+
+def test_r2d2_validates_config():
+    from ray_tpu.rl import CartPole, Pendulum, R2D2Config
+    with pytest.raises(ValueError, match="burn_in"):
+        R2D2Config(env=CartPole, seq_len=8, burn_in=8).build()
+    with pytest.raises(ValueError, match="discrete"):
+        R2D2Config(env=Pendulum).build()
+
+
+def test_r2d2_checkpoint_roundtrip():
+    from ray_tpu.rl import CartPole, R2D2Config
+    import jax
+    algo = R2D2Config(env=CartPole, num_envs=4, seq_len=8,
+                      buffer_capacity=128, learn_start=4).build()
+    algo.train()
+    state = algo.get_state()
+    algo2 = R2D2Config(env=CartPole, num_envs=4, seq_len=8,
+                       buffer_capacity=128, learn_start=4).build()
+    algo2.set_state(state)
+    for a, b in zip(jax.tree_util.tree_leaves(algo.params),
+                    jax.tree_util.tree_leaves(algo2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
