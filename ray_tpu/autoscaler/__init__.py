@@ -11,6 +11,7 @@ strategy).
 
 from .autoscaler import StandardAutoscaler, request_resources  # noqa: F401
 from .aws_provider import AwsProvider  # noqa: F401
+from .azure_provider import AzureProvider  # noqa: F401
 from .gce_provider import GceProvider  # noqa: F401
 from .kuberay_provider import KubeRayProvider  # noqa: F401
 from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401

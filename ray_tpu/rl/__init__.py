@@ -30,6 +30,12 @@ from .dqn import (  # noqa: F401
     SimpleQConfig,
 )
 from .pg import PG, PGConfig  # noqa: F401
+from .dt import DT, DTConfig  # noqa: F401
+from .maddpg import (  # noqa: F401
+    MADDPG,
+    MADDPGConfig,
+    SpreadLineContinuous,
+)
 from .qmix import QMIX, QMIXConfig  # noqa: F401
 from .r2d2 import R2D2, R2D2Config, RecurrentQNetwork  # noqa: F401
 from .env import (  # noqa: F401

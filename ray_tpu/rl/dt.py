@@ -1,0 +1,355 @@
+"""Decision Transformer: offline RL as return-conditioned sequence
+modeling.
+
+Capability mirror of the reference's DT
+(`rllib/algorithms/dt/dt.py` — GPT-style causal transformer over
+(return-to-go, state, action) triplets, trained with action-prediction
+loss on offline trajectories, deployed by conditioning on a target
+return).  TPU-first shape: the trunk is a compact causal transformer
+built on the framework's own attention op (`ops/attention.py` — the same
+flash kernel the LM stack uses when shapes allow), training samples
+fixed-length windows so one jitted epoch covers permuted minibatches
+like BC/CQL/CRR, and evaluation unrolls the feedback loop as a
+``lax.scan`` whose carry is the rolling (rtg, obs, act) context —
+data-dependent Python control flow nowhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..ops.attention import multi_head_attention
+from .algorithm import Algorithm
+from .env import JaxEnv
+
+
+
+def _dense_init(key, d_in, d_out, scale=None):
+    scale = scale if scale is not None else math.sqrt(2.0 / d_in)
+    return {"w": jax.random.normal(key, (d_in, d_out)) * scale,
+            "b": jnp.zeros((d_out,))}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def trunk_init(key, d_model: int, n_layers: int, n_heads: int,
+               d_ff: int):
+    def layer(k):
+        k1, k2, k3, k4 = jax.random.split(k, 4)
+        return {
+            "ln1": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+            "qkv": _dense_init(k1, d_model, 3 * d_model),
+            "proj": _dense_init(k2, d_model, d_model,
+                                scale=0.02 / math.sqrt(n_layers)),
+            "ln2": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+            "up": _dense_init(k3, d_model, d_ff),
+            "down": _dense_init(k4, d_ff, d_model,
+                                scale=0.02 / math.sqrt(n_layers)),
+        }
+
+    keys = jax.random.split(key, n_layers)
+    return {"layers": jax.vmap(layer)(keys),
+            "ln_f": {"g": jnp.ones((d_model,)),
+                     "b": jnp.zeros((d_model,))}}
+
+
+def _ln(p, x):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
+
+
+def trunk_apply(params, x: jnp.ndarray, n_heads: int) -> jnp.ndarray:
+    """[B, S, D] → [B, S, D], causal; layers scanned (stacked weights,
+    the same compile-once shape as models/transformer.py)."""
+    b, s, d = x.shape
+    hd = d // n_heads
+
+    def layer_fn(h, lp):
+        y = _ln(lp["ln1"], h)
+        qkv = _dense(lp["qkv"], y).reshape(b, s, 3, n_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = multi_head_attention(q, k, v, causal=True)
+        h = h + _dense(lp["proj"], att.reshape(b, s, d))
+        y = _ln(lp["ln2"], h)
+        h = h + _dense(lp["down"], jax.nn.gelu(_dense(lp["up"], y)))
+        return h, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    return _ln(params["ln_f"], x)
+
+
+@dataclasses.dataclass
+class DTConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    dataset: Optional[Dict[str, np.ndarray]] = None   # EPISODIC columns
+    context_len: int = 20          # K triplets of (rtg, obs, act)
+    d_model: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 256
+    gamma: float = 1.0             # DT uses undiscounted returns-to-go
+    lr: float = 1e-3
+    batch_size: int = 64
+    steps_per_iter: int = 100      # minibatch updates per train()
+    target_return: float = 200.0   # conditioning return at eval time
+    rtg_scale: float = 100.0       # return normalization divisor
+    seed: int = 0
+
+    def build(self) -> "DT":
+        return DT(self)
+
+
+def _returns_to_go(rewards: np.ndarray, gamma: float) -> np.ndarray:
+    """Per-episode (discounted) returns-to-go; gamma=1 (the DT paper's
+    convention) is a plain reverse cumsum."""
+    if gamma >= 1.0:
+        return np.flip(np.cumsum(np.flip(rewards))).copy()
+    out = np.empty_like(rewards, dtype=np.float64)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out.astype(rewards.dtype)
+
+
+def episodes_from_columns(ds: Dict[str, np.ndarray]):
+    """Split columnar (obs, action, reward, done) rows into episode
+    lists — offline datasets store flat transition columns
+    (rl/offline.py collect_dataset)."""
+    ends = np.flatnonzero(np.asarray(ds["done"]) > 0.5)
+    episodes = []
+    start = 0
+    for e in ends:
+        sl = slice(start, e + 1)
+        episodes.append({k: np.asarray(ds[k][sl]) for k in
+                         ("obs", "action", "reward")})
+        start = e + 1
+    if start < len(ds["obs"]):     # trailing partial episode
+        sl = slice(start, len(ds["obs"]))
+        episodes.append({k: np.asarray(ds[k][sl]) for k in
+                         ("obs", "action", "reward")})
+    return episodes
+
+
+class DT(Algorithm):
+    _config_cls = DTConfig
+
+    def __init__(self, config: DTConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None or cfg.dataset is None:
+            raise ValueError("DTConfig.env and DTConfig.dataset required")
+        if cfg.d_model % cfg.n_heads:
+            raise ValueError(f"d_model={cfg.d_model} not divisible by "
+                             f"n_heads={cfg.n_heads}")
+        self.env = cfg.env()
+        if not self.env.discrete:
+            raise ValueError("this DT implementation is discrete-action "
+                             "(continuous heads are an MSE swap)")
+        obs_dim, n_act = self.env.observation_size, self.env.action_size
+        self.n_actions = n_act
+        K, D = cfg.context_len, cfg.d_model
+        key = jax.random.PRNGKey(cfg.seed)
+        (key, kt, kr, ko, ka, kh, kp) = jax.random.split(key, 7)
+        self.params = {
+            "trunk": trunk_init(kt, D, cfg.n_layers, cfg.n_heads,
+                                cfg.d_ff),
+            "emb_rtg": _dense_init(kr, 1, D),
+            "emb_obs": _dense_init(ko, obs_dim, D),
+            "emb_act": _dense_init(ka, n_act, D),
+            "emb_t": jax.random.normal(kh, (K, D)) * 0.02,
+            "head": _dense_init(kp, D, n_act, scale=0.02),
+        }
+        self.optimizer = optax.adamw(cfg.lr, weight_decay=1e-4)
+        self.opt_state = self.optimizer.init(self.params)
+        self.key = key
+
+        # ---- window the offline episodes once, on the host ---------------
+        episodes = episodes_from_columns(cfg.dataset)
+        obs_w, act_w, rtg_w, len_w = [], [], [], []
+        for ep in episodes:
+            T = len(ep["reward"])
+            rtg = _returns_to_go(ep["reward"], cfg.gamma)
+            for start in range(0, T, max(1, K // 2)):
+                end = min(start + K, T)
+                n = end - start
+                pad = K - n
+                obs_w.append(np.pad(ep["obs"][start:end].astype(
+                    np.float32), ((0, pad), (0, 0))))
+                act_w.append(np.pad(ep["action"][start:end].astype(
+                    np.int64), (0, pad)))
+                rtg_w.append(np.pad(rtg[start:end].astype(np.float32),
+                                    (0, pad)))
+                len_w.append(n)
+        self._windows = {
+            "obs": jnp.asarray(np.stack(obs_w)),          # [W, K, obs]
+            "action": jnp.asarray(np.stack(act_w), jnp.int32),
+            "rtg": jnp.asarray(np.stack(rtg_w)) / cfg.rtg_scale,
+            "mask": jnp.asarray(
+                np.arange(K)[None, :] < np.asarray(len_w)[:, None],
+                jnp.float32),
+        }
+        self._update = jax.jit(self._make_update())
+        self._eval_rollout = jax.jit(self._make_eval_rollout())
+
+    # -- the model: windows → per-step action logits ------------------------
+    def _logits(self, params, rtg, obs, act):
+        """[B, K] rtg, [B, K, obs] obs, [B, K] act → [B, K, A] logits
+        predicting act_t from (.., rtg_t, s_t)."""
+        cfg = self.config
+        B, K = rtg.shape
+        e_r = _dense(params["emb_rtg"], rtg[..., None])
+        e_s = _dense(params["emb_obs"], obs)
+        a_onehot = jax.nn.one_hot(act, self.n_actions)
+        e_a = _dense(params["emb_act"], a_onehot)
+        t_emb = params["emb_t"][None, :K]
+        # interleave [r_0, s_0, a_0, r_1, s_1, a_1, ...] → [B, 3K, D]
+        tokens = jnp.stack([e_r + t_emb, e_s + t_emb, e_a + t_emb],
+                           axis=2).reshape(B, 3 * K, cfg.d_model)
+        h = trunk_apply(params["trunk"], tokens, cfg.n_heads)
+        # the state token (position 3t+1) predicts action a_t
+        h_s = h[:, 1::3]
+        return _dense(params["head"], h_s)
+
+    def _make_update(self):
+        cfg = self.config
+        W = self._windows["obs"].shape[0]
+
+        def loss_fn(params, batch):
+            logits = self._logits(params, batch["rtg"], batch["obs"],
+                                  batch["action"])
+            logp = jax.nn.log_softmax(logits)
+            ce = -jnp.take_along_axis(
+                logp, batch["action"][..., None], axis=-1)[..., 0]
+            return (ce * batch["mask"]).sum() / batch["mask"].sum()
+
+        def update(params, opt_state, key):
+            def step(carry, _):
+                params, opt_state, key = carry
+                key, bkey = jax.random.split(key)
+                idx = jax.random.randint(bkey, (cfg.batch_size,), 0, W)
+                batch = jax.tree_util.tree_map(lambda x: x[idx],
+                                               self._windows)
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, key), loss
+
+            (params, opt_state, key), losses = jax.lax.scan(
+                step, (params, opt_state, key), None,
+                length=cfg.steps_per_iter)
+            return params, opt_state, key, losses.mean()
+
+        return update
+
+    # -- return-conditioned evaluation --------------------------------------
+    def _make_eval_rollout(self):
+        cfg, env = self.config, self.env
+        K = cfg.context_len
+        horizon = env.max_episode_steps
+
+        def rollout(params, key, target_return):
+            key, rkey = jax.random.split(key)
+            state, obs = env.reset(rkey)
+            obs_dim = obs.shape[-1]
+            ctx = {
+                "rtg": jnp.zeros((K,)),
+                "obs": jnp.zeros((K, obs_dim)),
+                "act": jnp.zeros((K,), jnp.int32),
+                "n": jnp.zeros((), jnp.int32),   # filled positions
+            }
+
+            def place(buf, x, n):
+                """Left-aligned insert: fill slot n while the window is
+                filling, shift once full — matching the TRAINING window
+                layout (content left-aligned, padding only at the end),
+                so eval never shows the model leading-zero contexts it
+                was never trained on."""
+                shifted = jnp.concatenate([buf[1:], x[None]], axis=0)
+                filled = jax.lax.dynamic_update_index_in_dim(
+                    buf, x, jnp.minimum(n, K - 1), axis=0)
+                return jnp.where(n < K, filled, shifted)
+
+            def step(carry, _):
+                state, obs, ctx, rtg_now, ret, done, key = carry
+                n = ctx["n"]
+                pos = jnp.minimum(n, K - 1)   # slot holding the current step
+                # place the CURRENT (rtg, obs) with a placeholder action,
+                # predict that slot's action
+                ctx2 = {
+                    "rtg": place(ctx["rtg"], rtg_now / cfg.rtg_scale, n),
+                    "obs": place(ctx["obs"], obs, n),
+                    "act": place(ctx["act"], jnp.zeros((), jnp.int32), n),
+                }
+                logits = self._logits(
+                    params, ctx2["rtg"][None], ctx2["obs"][None],
+                    ctx2["act"][None])[0, pos]
+                action = jnp.argmax(logits, -1)
+                key, skey = jax.random.split(key)
+                state2, obs2, reward, step_done = env.step(state, action,
+                                                           skey)
+                # write the TAKEN action into the context
+                ctx = {"rtg": ctx2["rtg"], "obs": ctx2["obs"],
+                       "act": jax.lax.dynamic_update_index_in_dim(
+                           ctx2["act"], action, pos, axis=0),
+                       "n": jnp.minimum(n + 1, K)}
+                live = 1.0 - done
+                ret = ret + reward * live
+                rtg_next = rtg_now - reward
+                done = jnp.maximum(done, step_done.astype(jnp.float32))
+                return (state2, obs2, ctx, rtg_next, ret, done,
+                        key), None
+
+            init = (state, obs, ctx, jnp.asarray(target_return,
+                                                 jnp.float32),
+                    jnp.zeros(()), jnp.zeros(()), key)
+            (_, _, _, _, ret, _, _), _ = jax.lax.scan(
+                step, init, None, length=horizon)
+            return ret
+
+        return rollout
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        self.params, self.opt_state, self.key, loss = self._update(
+            self.params, self.opt_state, self.key)
+        dt_s = time.perf_counter() - t0
+        return {"action_ce_loss": float(loss),
+                "windows": int(self._windows["obs"].shape[0]),
+                "updates_per_s": cfg.steps_per_iter / dt_s,
+                "env_steps_this_iter": 0}
+
+    def evaluate(self, n_episodes: int = 8,
+                 target_return: Optional[float] = None) -> float:
+        """Mean achieved return when conditioned on ``target_return``."""
+        tr = target_return if target_return is not None \
+            else self.config.target_return
+        rets = []
+        for i in range(n_episodes):
+            self.key, ekey = jax.random.split(self.key)
+            rets.append(float(self._eval_rollout(self.params, ekey, tr)))
+        return float(np.mean(rets))
+
+    # -- checkpointing -------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.iteration = state.get("iteration", 0)

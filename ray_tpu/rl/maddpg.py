@@ -1,0 +1,319 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Capability mirror of the reference's MADDPG
+(`rllib/algorithms/maddpg/maddpg.py` — decentralized deterministic
+actors, per-agent critics conditioned on the GLOBAL state and EVERY
+agent's action; "centralized training, decentralized execution").
+TPU-first shape, following multi_agent.py: per-agent actor and critic
+parameters are STACKED along a leading agent axis and evaluated with
+``vmap`` — N actors and N centralized critics train as one XLA program,
+and the whole iteration (collect scan → replay insert → critic/actor
+update scan) compiles like td3.py.
+
+Actor i's gradient flows through its OWN action only; the other agents'
+actions come from the sampled batch (the MADDPG actor update), which
+falls out naturally from an ``at[]``-style substitution under ``vmap``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import replay
+from .algorithm import Algorithm
+from .multi_agent import MultiAgentJaxEnv
+from .policy import mlp_apply, mlp_init
+
+
+def _relu_mlp(params, x):
+    return mlp_apply(params, x, activation=jax.nn.relu)
+
+
+class SpreadLineContinuous(MultiAgentJaxEnv):
+    """SpreadLine with velocity actions in [-1, 1] — the continuous
+    testbed MADDPG needs (discrete SpreadLine serves QMIX/IPPO)."""
+
+    discrete = False
+
+    def __init__(self, n_agents: int = 3, horizon: int = 64):
+        self.n_agents = n_agents
+        self.horizon = horizon
+        self.observation_size = 3
+        self.action_size = 1
+
+    def reset(self, key):
+        pkey, _ = jax.random.split(key)
+        pos = jax.random.uniform(pkey, (self.n_agents,), minval=-1.0,
+                                 maxval=1.0)
+        targets = jnp.linspace(-1.0, 1.0, self.n_agents)
+        state = {"pos": pos, "targets": targets,
+                 "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(state)
+
+    def _obs(self, state):
+        pos, targets = state["pos"], state["targets"]
+        diff = jnp.abs(pos[:, None] - pos[None, :]) \
+            + jnp.eye(self.n_agents) * 1e9
+        nearest = jnp.min(diff, axis=1)
+        return jnp.stack([pos, targets, nearest], axis=1)
+
+    def step(self, state, actions, key):
+        delta = jnp.clip(actions[..., 0], -1.0, 1.0) * 0.1
+        pos = jnp.clip(state["pos"] + delta, -1.5, 1.5)
+        diff = pos[:, None] - pos[None, :]
+        close = (jnp.abs(diff) < 0.1) & ~jnp.eye(self.n_agents, dtype=bool)
+        push = jnp.sum(jnp.sign(diff) * close * 0.05, axis=1)
+        pos = jnp.clip(pos + push, -1.5, 1.5)
+        t = state["t"] + 1
+        state = {"pos": pos, "targets": state["targets"], "t": t}
+        dist = jnp.abs(pos - state["targets"])
+        rewards = -dist - 0.25 * jnp.sum(close, axis=1)
+        done = t >= self.horizon
+        # auto-reset on done — the MultiAgentJaxEnv contract
+        reset_state, _ = self.reset(key)
+        state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(done, r, c), reset_state, state)
+        return state, self._obs(state), rewards, done
+
+
+@dataclasses.dataclass
+class MADDPGConfig:
+    env: Optional[Callable[[], MultiAgentJaxEnv]] = None
+    num_envs: int = 16
+    rollout_steps: int = 16
+    buffer_capacity: int = 100_000
+    batch_size: int = 256
+    num_updates: int = 16
+    gamma: float = 0.95            # the MADDPG paper's default
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    tau: float = 0.01
+    expl_noise: float = 0.1        # Gaussian exploration stddev
+    learn_start: int = 1_000
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "MADDPG":
+        return MADDPG(self)
+
+
+class MADDPG(Algorithm):
+    _config_cls = MADDPGConfig
+
+    def __init__(self, config: MADDPGConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("MADDPGConfig.env required (a "
+                             "MultiAgentJaxEnv factory)")
+        self.env = cfg.env()
+        if self.env.discrete:
+            raise ValueError(
+                "MADDPG is deterministic-gradient continuous control; "
+                "use QMIX or IndependentPPO for discrete multi-agent "
+                "envs (the reference's discrete mode relies on "
+                "Gumbel-softmax relaxation)")
+        self.n_agents = N = self.env.n_agents
+        obs_dim = self.env.observation_size
+        act_dim = self.env.action_size
+        # centralized critic input: every agent's obs and action
+        critic_in = N * (obs_dim + act_dim)
+        key = jax.random.PRNGKey(cfg.seed)
+        key, ak, ck, ek = jax.random.split(key, 4)
+
+        def stack_init(k, sizes, n):
+            return jax.vmap(lambda kk: mlp_init(kk, sizes))(
+                jax.random.split(k, n))
+
+        self.params = {
+            "actor": stack_init(ak, (obs_dim,) + tuple(cfg.hidden)
+                                + (act_dim,), N),
+            "critic": stack_init(ck, (critic_in,) + tuple(cfg.hidden)
+                                 + (1,), N),
+        }
+        self.targets = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.aopt_state = self.actor_opt.init(self.params["actor"])
+        self.copt_state = self.critic_opt.init(self.params["critic"])
+        self.buffer = replay.init(cfg.buffer_capacity, {
+            "obs": jnp.zeros((N, obs_dim), jnp.float32),
+            "action": jnp.zeros((N, act_dim), jnp.float32),
+            "reward": jnp.zeros((N,), jnp.float32),
+            "next_obs": jnp.zeros((N, obs_dim), jnp.float32),
+            "done": jnp.zeros((), jnp.float32),
+        })
+        ekeys = jax.random.split(ek, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.key = key
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._init_episode_tracking(cfg.num_envs)
+
+    # -- parameter-stacked evaluation helpers -------------------------------
+    def _act(self, actor_params, obs):
+        """[.., N, obs] → [.., N, act] in [-1, 1]; per-agent params."""
+        def one(p, o):
+            return jnp.tanh(_relu_mlp(p, o))
+        return jax.vmap(one, in_axes=(0, -2), out_axes=-2)(
+            actor_params, obs)
+
+    def _q_all(self, critic_params, obs, actions):
+        """Centralized critics: [.., N, obs] + [.., N, act] → [.., N]
+        (critic i sees EVERY agent's obs+action)."""
+        flat = jnp.concatenate(
+            [obs.reshape(obs.shape[:-2] + (-1,)),
+             actions.reshape(actions.shape[:-2] + (-1,))], axis=-1)
+
+        def one(p):
+            return _relu_mlp(p, flat)[..., 0]
+        return jnp.moveaxis(jax.vmap(one)(critic_params), 0, -1)
+
+    # -- the compiled iteration ---------------------------------------------
+    def _make_train_iter(self):
+        cfg, env = self.config, self.env
+        N = self.n_agents
+
+        def critic_loss(critic_params, targets, batch):
+            next_act = self._act(targets["actor"], batch["next_obs"])
+            q_next = self._q_all(targets["critic"], batch["next_obs"],
+                                 next_act)                 # [B, N]
+            target = batch["reward"] + cfg.gamma \
+                * (1.0 - batch["done"])[:, None] \
+                * jax.lax.stop_gradient(q_next)
+            q = self._q_all(critic_params, batch["obs"], batch["action"])
+            return jnp.mean((q - target) ** 2)
+
+        def actor_loss(actor_params, critic_params, batch):
+            # each actor's fresh action substitutes ONLY its own slot;
+            # other agents' actions stay as sampled (the MADDPG update)
+            my_act = self._act(actor_params, batch["obs"])  # [B, N, act]
+            eye = jnp.eye(N)[None, :, :, None]              # [1,N,N,1]
+            # for critic i: actions[:, j] = my_act[:, j] if j==i else
+            # batch action — build all N substituted joint actions
+            joint = batch["action"][:, None, :, :] * (1 - eye) \
+                + my_act[:, None, :, :] * eye               # [B,N,N,act]
+            q = jax.vmap(
+                lambda cp, ja: _relu_mlp(
+                    cp, jnp.concatenate(
+                        [batch["obs"].reshape(batch["obs"].shape[0], -1),
+                         ja.reshape(ja.shape[0], -1)], axis=-1))[..., 0],
+                in_axes=(0, 1))(critic_params, joint)       # [N, B]
+            return -jnp.mean(q)
+
+        def train_iter(params, targets, aopt_state, copt_state, buffer,
+                       env_states, obs, key):
+
+            def collect(carry, _):
+                buffer, env_states, obs, key = carry
+                key, nkey, skey = jax.random.split(key, 3)
+                action = self._act(params["actor"], obs)
+                action = jnp.clip(
+                    action + cfg.expl_noise * jax.random.normal(
+                        nkey, action.shape), -1.0, 1.0)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, rewards, done = jax.vmap(env.step)(
+                    env_states, action, skeys)
+                buffer = replay.add_batch(buffer, {
+                    "obs": obs.astype(jnp.float32),
+                    "action": action.astype(jnp.float32),
+                    "reward": rewards.astype(jnp.float32),
+                    "next_obs": next_obs.astype(jnp.float32),
+                    "done": done.astype(jnp.float32),
+                }, cfg.num_envs)
+                frame = {"reward": rewards.sum(-1), "done": done}
+                return (buffer, env_states, next_obs, key), frame
+
+            (buffer, env_states, obs, key), traj = jax.lax.scan(
+                collect, (buffer, env_states, obs, key), None,
+                length=cfg.rollout_steps)
+
+            def update(carry, _):
+                params, targets, aopt_state, copt_state, buffer, key = \
+                    carry
+                batch, _, key = replay.sample(buffer, key, cfg.batch_size)
+                c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                    params["critic"], targets, batch)
+                c_updates, copt_state = self.critic_opt.update(
+                    c_grads, copt_state, params["critic"])
+                params = {**params, "critic": optax.apply_updates(
+                    params["critic"], c_updates)}
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(
+                    params["actor"], params["critic"], batch)
+                a_updates, aopt_state = self.actor_opt.update(
+                    a_grads, aopt_state, params["actor"])
+                params = {**params, "actor": optax.apply_updates(
+                    params["actor"], a_updates)}
+                targets = jax.tree_util.tree_map(
+                    lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+                    targets, params)
+                return (params, targets, aopt_state, copt_state, buffer,
+                        key), (c_loss, a_loss)
+
+            def run_updates(args):
+                (params, targets, aopt_state, copt_state, buffer, key), \
+                    (c_losses, a_losses) = jax.lax.scan(
+                        update, args, None, length=cfg.num_updates)
+                return (params, targets, aopt_state, copt_state, buffer,
+                        key, c_losses[-1], a_losses[-1])
+
+            def skip_updates(args):
+                params, targets, aopt_state, copt_state, buffer, key = \
+                    args
+                return (params, targets, aopt_state, copt_state, buffer,
+                        key, jnp.zeros(()), jnp.zeros(()))
+
+            (params, targets, aopt_state, copt_state, buffer, key,
+             c_loss, a_loss) = jax.lax.cond(
+                buffer["size"] >= cfg.learn_start, run_updates,
+                skip_updates,
+                (params, targets, aopt_state, copt_state, buffer, key))
+            metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "buffer_size": buffer["size"]}
+            return (params, targets, aopt_state, copt_state, buffer,
+                    env_states, obs, key, metrics, traj["reward"],
+                    traj["done"])
+
+        return train_iter
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        (self.params, self.targets, self.aopt_state, self.copt_state,
+         self.buffer, self.env_states, self.obs, self.key, metrics,
+         rewards, dones) = self._train_iter(
+            self.params, self.targets, self.aopt_state, self.copt_state,
+            self.buffer, self.env_states, self.obs, self.key)
+        self._track_episodes(np.asarray(rewards), np.asarray(dones))
+        dt = time.perf_counter() - t0
+        steps = cfg.num_envs * cfg.rollout_steps
+        return {
+            "critic_loss": float(metrics["critic_loss"]),
+            "actor_loss": float(metrics["actor_loss"]),
+            "buffer_size": int(metrics["buffer_size"]),
+            "episode_reward_mean": self.episode_reward_mean(),
+            "env_steps_this_iter": steps,
+            "env_steps_per_s": steps / dt,
+        }
+
+    # -- checkpointing -------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "targets": to_np(self.targets),
+                "iteration": self.iteration,
+                "env_steps_total": self._total_env_steps}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.targets = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.targets, state["targets"])
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("env_steps_total", 0)

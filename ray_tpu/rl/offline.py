@@ -31,9 +31,14 @@ def collect_dataset(env_factory: Callable[[], JaxEnv], policy_fn,
     """Roll a (possibly scripted) policy and record columnar experience.
 
     ``policy_fn(obs, key) -> action`` is any jittable function — a trained
-    policy's sampler or a scripted expert.  Returns T-major flattened
+    policy's sampler or a scripted expert.  Returns ENV-MAJOR flattened
     columns: obs, action, reward, done, next_obs (the reference's
-    SampleBatch columns, rllib/policy/sample_batch.py).
+    SampleBatch columns, rllib/policy/sample_batch.py).  Env-major order
+    means each env's trajectory is a contiguous run of rows with episode
+    boundaries marked by ``done`` — so sequence consumers (DT's
+    episodes_from_columns) can reconstruct real episodes; minibatch
+    consumers (BC/CQL/CRR/MARWIL) permute rows anyway and are
+    order-indifferent.
     """
     env = env_factory()
     key = jax.random.PRNGKey(seed)
@@ -56,7 +61,8 @@ def collect_dataset(env_factory: Callable[[], JaxEnv], policy_fn,
                                    length=steps)
     flat = {}
     for k, v in traj.items():
-        v = np.asarray(v)
+        v = np.asarray(v)                       # [T, B, ...]
+        v = np.swapaxes(v, 0, 1)                # env-major [B, T, ...]
         flat[k] = v.reshape((-1,) + v.shape[2:])[:n_steps]
     return flat
 

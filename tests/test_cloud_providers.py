@@ -259,3 +259,98 @@ def test_kuberay_cancelled_goal_retires(kuberay):
     k8s.reconcile()
     nodes = p.non_terminated_nodes()
     assert token not in nodes, nodes
+
+
+# -- Azure -------------------------------------------------------------------
+
+class _FakePoller:
+    def result(self):
+        return None
+
+
+class FakeCompute:
+    """azure-mgmt-compute-shaped recorder (reference capability:
+    autoscaler/_private/_azure/node_provider.py)."""
+
+    class _VM:
+        def __init__(self, name, tags, state="Succeeded"):
+            self.name = name
+            self.tags = tags
+            self.provisioning_state = state
+
+    def __init__(self):
+        self.vms = {}            # name -> {params, state}
+        self.calls = []
+        outer = self
+
+        class _VirtualMachines:
+            def begin_create_or_update(self, rg, name, params):
+                outer.calls.append(("create", rg, name))
+                outer.vms[name] = {"params": params,
+                                   "state": "Succeeded"}
+                return _FakePoller()
+
+            def begin_delete(self, rg, name):
+                outer.calls.append(("delete", rg, name))
+                outer.vms[name]["state"] = "Deleting"
+                return _FakePoller()
+
+            def list(self, rg):
+                outer.calls.append(("list", rg))
+                return [FakeCompute._VM(n, v["params"].get("tags", {}),
+                                        v["state"])
+                        for n, v in outer.vms.items()]
+
+        self.virtual_machines = _VirtualMachines()
+
+
+@pytest.fixture
+def azure():
+    from ray_tpu.autoscaler import AzureProvider
+    compute = FakeCompute()
+    return compute, AzureProvider(
+        subscription_id="sub", resource_group="rg", location="eastus2",
+        head_address="10.0.0.2:7001", cluster_name="demo",
+        compute=compute,
+        node_types={"cpu_16": {"vm_size": "Standard_D16s_v5",
+                               "image_id": "/subs/img",
+                               "host_resources": {"CPU": 16},
+                               "setup_commands": ["echo hi"]}})
+
+
+def test_azure_lifecycle(azure):
+    compute, p = azure
+    assert p.non_terminated_nodes() == []
+    name = p.create_node("cpu_16")
+    assert p.non_terminated_nodes() == [name]
+    assert p.node_type_of(name) == "cpu_16"
+    assert p.node_resources("cpu_16") == {"CPU": 16}
+    p.terminate_node(name)
+    assert p.non_terminated_nodes() == []
+
+
+def test_azure_custom_data_and_tags(azure):
+    import base64
+    compute, p = azure
+    name = p.create_node("cpu_16")
+    params = compute.vms[name]["params"]
+    script = base64.b64decode(
+        params["os_profile"]["custom_data"]).decode()
+    assert "ray-tpu start --address 10.0.0.2:7001" in script
+    assert "--num-cpus 16" in script
+    assert "echo hi" in script
+    assert params["tags"]["ray-tpu-cluster"] == "demo"
+    assert params["tags"]["ray-tpu-node-type"] == "cpu_16"
+
+
+def test_azure_type_map_rebuilds_from_tags(azure):
+    compute, p = azure
+    name = p.create_node("cpu_16")
+    # a fresh provider instance discovers type from VM tags
+    from ray_tpu.autoscaler import AzureProvider
+    p2 = AzureProvider(
+        subscription_id="sub", resource_group="rg", location="eastus2",
+        head_address="10.0.0.2:7001", cluster_name="demo",
+        compute=compute, node_types={"cpu_16": {"image_id": "/s/i"}})
+    assert p2.non_terminated_nodes() == [name]
+    assert p2.node_type_of(name) == "cpu_16"

@@ -1,0 +1,238 @@
+"""Second staged on-chip probe — follow-ups from TPU_PROBE_r04.jsonl.
+
+Same discipline as tpu_probe.py (ONE claim, every stage guarded, every
+result fsync'd to TPU_PROBE2_r04.jsonl immediately, never killed).
+Changes from probe 1's lessons:
+  * RL-on-TPU runs FIRST (small compiles; probe 1 never reached it —
+    the llama-1b GQA flash compile hung the remote helper for 50 min
+    and the stage after it sat behind the wreckage)
+  * the generation stage uses attention_impl="reference" and tries
+    llama-tiny before llama-1b (prefill at seq 512 doesn't need the
+    flash kernel; the unfused path compiles like every other jit)
+  * MFU follow-ups on the winning recipe: b16 with 1024x512 blocks,
+    1024x1024 blocks, and a seq-2048 variant
+"""
+
+import json
+import os
+import time
+import traceback
+
+T0 = time.perf_counter()
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "TPU_PROBE2_r04.jsonl")
+
+
+def log(msg: str) -> None:
+    print(f"[probe2 {time.perf_counter() - T0:7.1f}s] {msg}", flush=True)
+
+
+def emit(stage: str, payload: dict) -> None:
+    rec = {"stage": stage, "t": round(time.perf_counter() - T0, 1)}
+    rec.update(payload)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    log(f"{stage}: {payload}")
+
+
+def guarded(stage):
+    def deco(fn):
+        def run(*a, **kw):
+            try:
+                return fn(*a, **kw)
+            except Exception as exc:
+                emit(stage, {"error": repr(exc)[:300],
+                             "tb": traceback.format_exc(limit=3)[-400:]})
+                return None
+        return run
+    return deco
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_compile_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    from ray_tpu.models import (TransformerConfig, flops_per_token,
+                                init_params, make_train_step)
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    emit("env", {"backend": backend,
+                 "device": getattr(dev, "device_kind", "?")})
+    if backend != "tpu":
+        emit("abort", {"reason": f"backend={backend}, not tpu"})
+        return
+    peak = 197e12 if "v5" in dev.device_kind else 275e12
+
+    # ---- stage 1: canary + RL on the chip -------------------------------
+    @guarded("rl_tpu")
+    def rl_tpu():
+        from ray_tpu.rl import CartPole, PPOConfig
+        algo = PPOConfig(env=CartPole, num_envs=128, rollout_length=128,
+                         lr=1e-3, seed=0).build()
+        algo.train()                      # compile + warmup
+        t0 = time.perf_counter()
+        steps = 0
+        iters = 0
+        while time.perf_counter() - t0 < 8.0 or iters < 3:
+            res = algo.train()
+            steps += res["env_steps_this_iter"]
+            iters += 1
+        dt = time.perf_counter() - t0
+        emit("rl_tpu", {"algo": "PPO", "env": "CartPole",
+                        "env_steps_per_s": round(steps / dt, 1),
+                        "iters": iters, "backend": jax.default_backend(),
+                        "reward": round(res["episode_reward_mean"], 1)})
+        return True
+
+    if rl_tpu() is None:
+        # even the small PPO compile failed: the backend is unhealthy,
+        # don't burn the claim on the rest
+        emit("abort", {"reason": "rl stage failed; backend unhealthy"})
+        return
+
+    @guarded("rl_dqn_tpu")
+    def rl_dqn_tpu():
+        from ray_tpu.rl import CartPole, DQNConfig
+        algo = DQNConfig(env=CartPole, num_envs=128, rollout_steps=32,
+                         buffer_capacity=100_000, batch_size=256,
+                         num_updates=16, learn_start=1024, seed=0).build()
+        algo.train()
+        t0 = time.perf_counter()
+        steps = 0
+        iters = 0
+        while time.perf_counter() - t0 < 6.0 or iters < 3:
+            res = algo.train()
+            steps += res["env_steps_this_iter"]
+            iters += 1
+        dt = time.perf_counter() - t0
+        emit("rl_dqn_tpu", {"algo": "DQN(double)",
+                            "env_steps_per_s": round(steps / dt, 1),
+                            "iters": iters})
+
+    rl_dqn_tpu()
+
+    # ---- stage 2: MFU follow-ups on the winning recipe -------------------
+    def measure_mfu(tag, cfg_kw, batch, steps=12, seq=1024,
+                    blocks=(1024, 512)):
+        t_stage = time.perf_counter()
+        os.environ["RAY_TPU_FLASH_BLOCK_Q"] = str(blocks[0])
+        os.environ["RAY_TPU_FLASH_BLOCK_K"] = str(blocks[1])
+        cfg = TransformerConfig.gpt2("small", loss_chunk=128,
+                                     max_seq_len=max(1024, seq), **cfg_kw)
+        params, _ = init_params(jax.random.PRNGKey(0), cfg)
+        opt = optax.adamw(3e-4, weight_decay=0.1)
+        opt_state = opt.init(params)
+        step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
+                                    0, cfg.vocab_size)
+        data = {"tokens": tokens}
+        for _ in range(2):
+            params, opt_state, m = step(params, opt_state, data)
+        float(m["loss"])
+        compile_s = time.perf_counter() - t_stage
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = step(params, opt_state, data)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+        mfu = steps * batch * seq / dt * flops_per_token(cfg, seq) / peak
+        if not (0.0 < mfu < 0.95):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, m = step(params, opt_state, data)
+                float(m["loss"])
+            dt = time.perf_counter() - t0
+            mfu = steps * batch * seq / dt \
+                * flops_per_token(cfg, seq) / peak
+        emit("mfu", {"tag": tag, "batch": batch, "seq": seq,
+                     "blocks": list(blocks), "mfu": round(mfu, 4),
+                     "step_ms": round(1000 * dt / steps, 1),
+                     "tok_s": round(steps * batch * seq / dt),
+                     "compile_s": round(compile_s, 1)})
+        del params, opt_state, step, tokens, data
+        return mfu
+
+    nr = dict(remat=False, norm_remat=True)
+    for tag, kw, batch, seq, blocks in (
+            ("b8_confirm", nr, 8, 1024, (1024, 512)),
+            ("b16_bigblocks", nr, 16, 1024, (1024, 512)),
+            ("b8_1024x1024", nr, 8, 1024, (1024, 1024)),
+            ("b16_1024x1024", nr, 16, 1024, (1024, 1024)),
+            ("b4_seq2048", nr, 4, 2048, (1024, 512)),
+            ("b8_seq2048_dots", dict(remat="dots", norm_remat=True), 8,
+             2048, (1024, 512)),
+    ):
+        guarded(f"mfu:{tag}")(measure_mfu)(tag, kw, batch, seq=seq,
+                                           blocks=blocks)
+    os.environ.pop("RAY_TPU_FLASH_BLOCK_Q", None)
+    os.environ.pop("RAY_TPU_FLASH_BLOCK_K", None)
+
+    # ---- stage 3: generation TTFT/decode (reference attention) ----------
+    def gen_stage(tag, cfg, prompt_len, decode_n):
+        from ray_tpu.models.generate import (decode_step, init_kv_cache,
+                                             prefill)
+        t_init = time.perf_counter()
+        params, _ = init_params(jax.random.PRNGKey(0), cfg)
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+        jax.block_until_ready(params)
+        init_s = time.perf_counter() - t_init
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (1, prompt_len), 0, cfg.vocab_size)
+        cache_len = prompt_len + decode_n + 32
+        pre = jax.jit(lambda p, t: prefill(p, t, cfg,
+                                           init_kv_cache(cfg, 1,
+                                                         cache_len)))
+        logits, cache = pre(params, tokens)
+        jax.block_until_ready(logits)          # compile
+        t0 = time.perf_counter()
+        logits, cache = pre(params, tokens)
+        jax.block_until_ready(logits)
+        ttft = time.perf_counter() - t0
+        dec = jax.jit(lambda p, tok, c: decode_step(p, tok, c, cfg))
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        lg, cache = dec(params, tok, cache)
+        jax.block_until_ready(lg)
+        t0 = time.perf_counter()
+        for _ in range(decode_n):
+            tok = jnp.argmax(lg, -1).astype(jnp.int32)
+            lg, cache = dec(params, tok, cache)
+        jax.block_until_ready(lg)
+        dt = time.perf_counter() - t0
+        emit("gen", {"tag": tag, "prompt_len": prompt_len,
+                     "prefill_ms": round(ttft * 1e3, 1),
+                     "decode_ms_per_tok": round(dt / decode_n * 1e3, 2),
+                     "decode_tok_s": round(decode_n / dt, 1),
+                     "param_init_s": round(init_s, 1)})
+
+    guarded("gen:gpt2s")(gen_stage)(
+        "gpt2-small bf16",
+        TransformerConfig.gpt2("small", remat=False,
+                               attention_impl="reference"), 256, 64)
+    guarded("gen:llama_tiny")(gen_stage)(
+        "llama-tiny bf16",
+        TransformerConfig.llama("tiny", max_seq_len=1024, remat=False,
+                                attention_impl="reference"), 512, 64)
+    guarded("gen:llama_1b")(gen_stage)(
+        "llama-1b bf16",
+        TransformerConfig.llama("1b", max_seq_len=1024, remat=False,
+                                attention_impl="reference"), 512, 64)
+
+    emit("done", {"total_s": round(time.perf_counter() - T0, 1)})
+
+
+if __name__ == "__main__":
+    main()
