@@ -11,7 +11,14 @@ supported through rollout-worker actors like the reference's sampler.
 """
 
 from .algorithm import Algorithm  # noqa: F401
-from .apex import ApexDQN, ApexDQNConfig, collector_epsilon  # noqa: F401
+from .apex import (  # noqa: F401
+    ApexDDPG,
+    ApexDDPGConfig,
+    ApexDQN,
+    ApexDQNConfig,
+    collector_epsilon,
+    collector_noise_scale,
+)
 from .bandit import (  # noqa: F401
     ContextBandit,
     LinearContextBandit,

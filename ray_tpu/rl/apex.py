@@ -22,6 +22,7 @@ import numpy as np
 
 from .algorithm import track_episode_returns
 from .dqn import DQN, DQNConfig, QNetwork
+from .td3 import TD3, TD3Config
 
 
 def collector_epsilon(i: int, n: int, base: float = 0.4,
@@ -103,45 +104,42 @@ class _DQNCollector:
         return out
 
 
-@dataclasses.dataclass
-class ApexDQNConfig(DQNConfig):
-    num_collectors: int = 2
-    collect_steps: int = 64        # env steps per env per collect call
+class _ApexDriver:
+    """The collector-fleet driver shared by ApexDQN and ApexDDPG: spawn
+    actors, keep one collect in flight per actor, drain whatever is
+    READY each iteration, ingest through the staged columnar path, run
+    the learner's update block, re-arm drained actors with post-update
+    weights."""
 
-    def build(self) -> "ApexDQN":
-        return ApexDQN(self)
+    _action_jnp_dtype = jnp.int32
 
-
-class ApexDQN(DQN):
-    """The learner: external-input DQN machinery + a fleet of
-    collector actors as the transition source."""
-
-    _config_cls = ApexDQNConfig
-
-    def __init__(self, config: ApexDQNConfig):
-        if config.env is None:
-            raise ValueError("ApexDQNConfig.env required")
-        # the learner is EXACTLY the external-input DQN: device buffer,
-        # compiled update scan, no inline env
-        super().__init__(dataclasses.replace(config,
-                                             external_input=True))
+    def _spawn_collectors(self, config, collector_cls) -> None:
         from .. import api
         from ..core.serialization import dumps_function
         blob = dumps_function(config)
-        cls = api.remote(_DQNCollector)
+        cls = api.remote(collector_cls)
         self._collectors = [
             cls.remote(blob, i, config.num_collectors)
             for i in range(config.num_collectors)]
         self._inflight: Dict[int, Any] = {}
         self._pending: Dict[str, np.ndarray] = {}
 
+    def _collector_weights(self):
+        """The (sub)tree of parameters collectors need — the full
+        params by default; ApexDDPG ships the actor only."""
+        return self.params
+
     def _arm(self, i: int, weights_ref: Any = None) -> None:
         from .. import api
         if weights_ref is None:
             weights_ref = api.put(jax.tree_util.tree_map(
-                np.asarray, self.params))
+                np.asarray, self._collector_weights()))
         self._inflight[i] = self._collectors[i].collect.remote(
             weights_ref)
+
+    def _learner_update(self):
+        """→ scalar loss for the metrics dict (learner-specific)."""
+        raise NotImplementedError
 
     def _ingest_columnar(self, cols: Dict[str, np.ndarray]) -> int:
         """Concatenate into the pending staging columns; insert full
@@ -159,7 +157,7 @@ class ApexDQN(DQN):
                 "obs": jnp.asarray(self._pending["obs"][sl],
                                    jnp.float32),
                 "action": jnp.asarray(self._pending["action"][sl],
-                                      jnp.int32),
+                                      self._action_jnp_dtype),
                 "reward": jnp.asarray(self._pending["reward"][sl],
                                       jnp.float32),
                 "next_obs": jnp.asarray(self._pending["next_obs"][sl],
@@ -201,16 +199,12 @@ class ApexDQN(DQN):
             received += len(batch["obs"])
             self._ingest_columnar(batch)
             drained.append(i)
-        (self.params, self.target_params, self.opt_state, self.buffer,
-         self.key, last_loss) = self._update_jit(
-            self.params, self.target_params, self.opt_state,
-            self.buffer, self.key,
-            jnp.asarray(self._total_env_steps, jnp.float32))
+        last_loss = self._learner_update()
         # re-arm AFTER the update with the post-update weights — one
         # shared put serves the whole drained set
         if drained:
             weights_ref = api.put(jax.tree_util.tree_map(
-                np.asarray, self.params))
+                np.asarray, self._collector_weights()))
             for i in drained:
                 self._arm(i, weights_ref)
         dt = time.perf_counter() - t0
@@ -231,3 +225,171 @@ class ApexDQN(DQN):
             except Exception:
                 pass
         self._collectors = []
+
+
+@dataclasses.dataclass
+class ApexDQNConfig(DQNConfig):
+    num_collectors: int = 2
+    collect_steps: int = 64        # env steps per env per collect call
+
+    def build(self) -> "ApexDQN":
+        return ApexDQN(self)
+
+
+class ApexDQN(_ApexDriver, DQN):
+    """The learner: external-input DQN machinery + a fleet of
+    collector actors as the transition source."""
+
+    _config_cls = ApexDQNConfig
+
+    def __init__(self, config: ApexDQNConfig):
+        if config.env is None:
+            raise ValueError("ApexDQNConfig.env required")
+        # the learner is EXACTLY the external-input DQN: device buffer,
+        # compiled update scan, no inline env
+        super().__init__(dataclasses.replace(config,
+                                             external_input=True))
+        self._spawn_collectors(config, _DQNCollector)
+
+
+    def _learner_update(self):
+        (self.params, self.target_params, self.opt_state, self.buffer,
+         self.key, last_loss) = self._update_jit(
+            self.params, self.target_params, self.opt_state,
+            self.buffer, self.key,
+            jnp.asarray(self._total_env_steps, jnp.float32))
+        return last_loss
+
+
+# ---------------------------------------------------------------------------
+# Ape-X DDPG: the same distributed-replay architecture over the
+# continuous-control learner (reference: rllib/algorithms/apex_ddpg/
+# apex_ddpg.py — DDPG/TD3 learner fed by actors with a SPECTRUM of
+# exploration-noise scales instead of epsilons).
+# ---------------------------------------------------------------------------
+
+
+def collector_noise_scale(i: int, n: int, base: float = 0.4,
+                          alpha: float = 7.0) -> float:
+    """Per-worker Gaussian exploration stddev on the Ape-X spectrum —
+    the continuous analogue of `collector_epsilon`."""
+    return collector_epsilon(i, n, base=base, alpha=alpha)
+
+
+class _DDPGCollector:
+    """Actor: compiled deterministic-policy collection with FIXED
+    per-worker Gaussian action noise; ships columnar float batches."""
+
+    def __init__(self, config_blob: bytes, worker_index: int,
+                 num_workers: int):
+        from ..core.serialization import loads_function
+        from .td3 import _relu_mlp
+        from .policy import mlp_init
+        cfg = loads_function(config_blob)
+        self.cfg = cfg
+        self.env = cfg.env()
+        self.sigma = collector_noise_scale(
+            worker_index, num_workers) * self.env.action_high
+        key = jax.random.PRNGKey(cfg.seed + 104729 * (worker_index + 1))
+        self.key, ekey, pkey = jax.random.split(key, 3)
+        h = tuple(cfg.hidden)
+        self.actor_params = mlp_init(
+            pkey, (self.env.observation_size,) + h
+            + (self.env.action_size,))
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self._collect = jax.jit(self._make_collect())
+        self._ep_returns = np.zeros(cfg.num_envs)
+        self._done_returns: list = []
+
+    def _make_collect(self):
+        from .td3 import _relu_mlp
+        cfg, env, sigma = self.cfg, self.env, self.sigma
+        high = env.action_high
+
+        def collect(actor_params, env_states, obs, key):
+            def step(carry, _):
+                env_states, obs, key = carry
+                key, nkey, skey = jax.random.split(key, 3)
+                action = high * jnp.tanh(_relu_mlp(actor_params, obs))
+                action = jnp.clip(
+                    action + sigma * jax.random.normal(nkey,
+                                                       action.shape),
+                    -high, high)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(
+                    env.step)(env_states, action, skeys)
+                frame = {"obs": obs, "action": action,
+                         "reward": reward, "next_obs": next_obs,
+                         "done": done}
+                return (env_states, next_obs, key), frame
+
+            (env_states, obs, key), traj = jax.lax.scan(
+                step, (env_states, obs, key), None,
+                length=cfg.collect_steps)
+            return env_states, obs, key, traj
+
+        return collect
+
+    def collect(self, weights) -> Dict[str, Any]:
+        self.actor_params = jax.tree_util.tree_map(
+            lambda _, w: jnp.asarray(w), self.actor_params, weights)
+        self.env_states, self.obs, self.key, traj = self._collect(
+            self.actor_params, self.env_states, self.obs, self.key)
+        rewards = np.asarray(traj["reward"])
+        dones = np.asarray(traj["done"])
+        track_episode_returns(self._ep_returns, self._done_returns,
+                              rewards, dones)
+        T, B = rewards.shape
+        out = {k: np.asarray(v).reshape((T * B,) + v.shape[2:])
+               for k, v in traj.items()}
+        out["episode_returns"] = self._done_returns
+        self._done_returns = []
+        return out
+
+
+@dataclasses.dataclass
+class ApexDDPGConfig(TD3Config):
+    num_collectors: int = 2
+    collect_steps: int = 64        # env steps per env per collect call
+    ingest_chunk: int = 64         # fixed insert size (one compiled shape)
+
+    def build(self) -> "ApexDDPG":
+        return ApexDDPG(self)
+
+
+class ApexDDPG(_ApexDriver, TD3):
+    """The learner IS TD3/DDPG's update block over the device buffer;
+    collectors ship noisy deterministic-policy transitions.  Collector
+    weights are the ACTOR only (critics never leave the learner)."""
+
+    _config_cls = ApexDDPGConfig
+    _action_jnp_dtype = jnp.float32
+
+    def __init__(self, config: ApexDDPGConfig):
+        if config.env is None:
+            raise ValueError("ApexDDPGConfig.env required")
+        super().__init__(config)
+        _, add_fn, _, _ = self._replay_ops
+        self._ingest_jit = jax.jit(
+            lambda buf, batch: add_fn(buf, batch, config.ingest_chunk))
+        self._update_only = jax.jit(self._make_update_block())
+        self._spawn_collectors(config, _DDPGCollector)
+
+    def _collector_weights(self):
+        return self.params["actor"]
+
+    def training_step(self) -> Dict[str, Any]:
+        # the driver loop re-arms with self.params["actor"] via _arm
+        result = _ApexDriver.training_step(self)
+        result["td_abs"] = result.pop("td_loss")
+        return result
+
+    def _learner_update(self):
+        (self.params, self.targets, self.actor_opt_state,
+         self.critic_opt_state, self.buffer, self.key,
+         self._update_count, last_td) = self._update_only(
+            self.params, self.targets, self.actor_opt_state,
+            self.critic_opt_state, self.buffer, self.key,
+            self._update_count)
+        return last_td

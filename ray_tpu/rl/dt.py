@@ -126,8 +126,20 @@ def _returns_to_go(rewards: np.ndarray, gamma: float) -> np.ndarray:
 def episodes_from_columns(ds: Dict[str, np.ndarray]):
     """Split columnar (obs, action, reward, done) rows into episode
     lists — offline datasets store flat transition columns
-    (rl/offline.py collect_dataset)."""
-    ends = np.flatnonzero(np.asarray(ds["done"]) > 0.5)
+    (rl/offline.py collect_dataset).  Episodes end at ``done`` marks
+    AND at ``env_id`` changes (when present): each env's trailing
+    partial episode carries done=0, so without the env_id cut it would
+    be spliced onto the next env's first episode."""
+    done = np.asarray(ds["done"]) > 0.5
+    n = len(done)
+    last = np.zeros(n, bool)
+    if "env_id" in ds:
+        env_id = np.asarray(ds["env_id"])
+        last[:-1] = env_id[1:] != env_id[:-1]
+        last[-1] = True
+    else:
+        last[-1] = True
+    ends = np.flatnonzero(done | last)
     episodes = []
     start = 0
     for e in ends:
@@ -135,10 +147,6 @@ def episodes_from_columns(ds: Dict[str, np.ndarray]):
         episodes.append({k: np.asarray(ds[k][sl]) for k in
                          ("obs", "action", "reward")})
         start = e + 1
-    if start < len(ds["obs"]):     # trailing partial episode
-        sl = slice(start, len(ds["obs"]))
-        episodes.append({k: np.asarray(ds[k][sl]) for k in
-                         ("obs", "action", "reward")})
     return episodes
 
 
@@ -223,6 +231,9 @@ class DT(Algorithm):
         return _dense(params["head"], h_s)
 
     def _make_update(self):
+        """Windows enter as a jit ARGUMENT, not a closure: a closed-over
+        dataset would be baked into the executable as XLA constants
+        (a second device copy + compile time growing with the data)."""
         cfg = self.config
         W = self._windows["obs"].shape[0]
 
@@ -234,13 +245,13 @@ class DT(Algorithm):
                 logp, batch["action"][..., None], axis=-1)[..., 0]
             return (ce * batch["mask"]).sum() / batch["mask"].sum()
 
-        def update(params, opt_state, key):
+        def update(params, opt_state, key, windows):
             def step(carry, _):
                 params, opt_state, key = carry
                 key, bkey = jax.random.split(key)
                 idx = jax.random.randint(bkey, (cfg.batch_size,), 0, W)
                 batch = jax.tree_util.tree_map(lambda x: x[idx],
-                                               self._windows)
+                                               windows)
                 loss, grads = jax.value_and_grad(loss_fn)(params, batch)
                 updates, opt_state = self.optimizer.update(
                     grads, opt_state, params)
@@ -325,7 +336,7 @@ class DT(Algorithm):
         cfg = self.config
         t0 = time.perf_counter()
         self.params, self.opt_state, self.key, loss = self._update(
-            self.params, self.opt_state, self.key)
+            self.params, self.opt_state, self.key, self._windows)
         dt_s = time.perf_counter() - t0
         return {"action_ce_loss": float(loss),
                 "windows": int(self._windows["obs"].shape[0]),

@@ -29,11 +29,8 @@ import optax
 from . import replay
 from .algorithm import Algorithm
 from .multi_agent import MultiAgentJaxEnv
-from .policy import mlp_apply, mlp_init
-
-
-def _relu_mlp(params, x):
-    return mlp_apply(params, x, activation=jax.nn.relu)
+from .policy import mlp_init
+from .td3 import _relu_mlp
 
 
 class SpreadLineContinuous(MultiAgentJaxEnv):

@@ -64,6 +64,10 @@ def collect_dataset(env_factory: Callable[[], JaxEnv], policy_fn,
         v = np.asarray(v)                       # [T, B, ...]
         v = np.swapaxes(v, 0, 1)                # env-major [B, T, ...]
         flat[k] = v.reshape((-1,) + v.shape[2:])[:n_steps]
+    # env_id marks the block junctions: each env's TRAILING partial
+    # episode has done=0, so without it episode reconstruction would
+    # splice env i's tail onto env i+1's first episode
+    flat["env_id"] = np.repeat(np.arange(num_envs), steps)[:n_steps]
     return flat
 
 

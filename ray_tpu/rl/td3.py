@@ -147,12 +147,113 @@ class TD3(Algorithm):
                                                    axis=-1))[..., 0]
 
     # -- the compiled iteration --------------------------------------------
+    def _make_update_block(self):
+        """``num_updates`` TD3 updates behind the learn-start gate —
+        shared by the fused collect+update iteration and external-input
+        learners (ApexDDPG), like dqn.py's `_make_update_block`."""
+        cfg = self.config
+        high = self.env.action_high
+        _, _, sample_fn, update_pri = self._replay_ops
+
+        def critic_loss_fn(qp, targets, batch, weights, key):
+            next_a = self._act(targets["actor"], batch["next_obs"])
+            if cfg.smooth_target_policy:
+                eps = jnp.clip(
+                    cfg.target_noise * jax.random.normal(
+                        key, next_a.shape),
+                    -cfg.noise_clip, cfg.noise_clip)
+                next_a = jnp.clip(next_a + eps, -high, high)
+            tq1 = self._q(targets["q1"], batch["next_obs"], next_a)
+            if cfg.twin_q:
+                tq = jnp.minimum(tq1, self._q(
+                    targets["q2"], batch["next_obs"], next_a))
+            else:
+                tq = tq1
+            target = jax.lax.stop_gradient(
+                batch["reward"] + cfg.gamma * (1.0 - batch["done"])
+                * tq)
+            td1 = self._q(qp["q1"], batch["obs"], batch["action"]) \
+                - target
+            loss = jnp.mean(weights * td1 ** 2)
+            td_abs = jnp.abs(td1)
+            if cfg.twin_q:
+                td2 = self._q(qp["q2"], batch["obs"],
+                              batch["action"]) - target
+                loss = loss + jnp.mean(weights * td2 ** 2)
+                td_abs = 0.5 * (td_abs + jnp.abs(td2))
+            return loss, td_abs
+
+        def actor_loss_fn(ap, q1, batch):
+            a = self._act(ap, batch["obs"])
+            return -jnp.mean(self._q(q1, batch["obs"], a))
+
+        def update(carry, _):
+            (params, targets, aopt_state, copt_state, buffer, key,
+             upd_count) = carry
+            batch, idx, weights, key = sample_fn(buffer, key,
+                                                 cfg.batch_size)
+            key, skey = jax.random.split(key)
+            qp = {"q1": params["q1"], "q2": params["q2"]}
+            (_, td_abs), qgrads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True)(qp, targets, batch,
+                                              weights, skey)
+            buffer = update_pri(buffer, idx, td_abs)
+            qupd, copt_state = self.critic_opt.update(
+                qgrads, copt_state, qp)
+            qp = optax.apply_updates(qp, qupd)
+            params = {**params, "q1": qp["q1"], "q2": qp["q2"]}
+
+            def do_actor(args):
+                params, targets, aopt_state = args
+                agrads = jax.grad(actor_loss_fn)(
+                    params["actor"], params["q1"], batch)
+                aupd, aopt_state = self.actor_opt.update(
+                    agrads, aopt_state, params["actor"])
+                actor = optax.apply_updates(params["actor"], aupd)
+                params = {**params, "actor": actor}
+                # targets track ONLY on actor-update steps (TD3's
+                # delayed-target rule; delay=1 makes it every step)
+                targets = jax.tree_util.tree_map(
+                    lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+                    targets, params)
+                return params, targets, aopt_state
+
+            params, targets, aopt_state = jax.lax.cond(
+                upd_count % cfg.policy_delay == 0,
+                do_actor, lambda args: args,
+                (params, targets, aopt_state))
+            return (params, targets, aopt_state, copt_state, buffer,
+                    key, upd_count + 1), td_abs.mean()
+
+        def update_block(params, targets, aopt_state, copt_state,
+                         buffer, key, upd_count):
+            do_learn = buffer["size"] >= cfg.learn_start
+
+            def run(args):
+                (params, targets, aopt_state, copt_state, buffer, key,
+                 upd_count) = args
+                (params, targets, aopt_state, copt_state, buffer, key,
+                 upd_count), tds = jax.lax.scan(
+                    update, args, None, length=cfg.num_updates)
+                return (params, targets, aopt_state, copt_state, buffer,
+                        key, upd_count, tds[-1])
+
+            def skip(args):
+                return args + (jnp.zeros(()),)
+
+            return jax.lax.cond(
+                do_learn, run, skip,
+                (params, targets, aopt_state, copt_state, buffer, key,
+                 upd_count))
+
+        return update_block
+
     def _make_train_iter(self):
         cfg = self.config
         env = self.env
-        high = self.env.action_high
         noise = self.noise
-        _, add_fn, sample_fn, update_pri = self._replay_ops
+        _, add_fn, _, _ = self._replay_ops
+        update_block = self._make_update_block()
 
         def train_iter(params, targets, aopt_state, copt_state, buffer,
                        env_states, obs, noise_state, key, upd_count,
@@ -182,95 +283,10 @@ class TD3(Algorithm):
                              (buffer, env_states, obs, noise_state, key),
                              None, length=cfg.rollout_steps)
 
-            def critic_loss_fn(qp, targets, batch, weights, key):
-                next_a = self._act(targets["actor"], batch["next_obs"])
-                if cfg.smooth_target_policy:
-                    eps = jnp.clip(
-                        cfg.target_noise * jax.random.normal(
-                            key, next_a.shape),
-                        -cfg.noise_clip, cfg.noise_clip)
-                    next_a = jnp.clip(next_a + eps, -high, high)
-                tq1 = self._q(targets["q1"], batch["next_obs"], next_a)
-                if cfg.twin_q:
-                    tq = jnp.minimum(tq1, self._q(
-                        targets["q2"], batch["next_obs"], next_a))
-                else:
-                    tq = tq1
-                target = jax.lax.stop_gradient(
-                    batch["reward"] + cfg.gamma * (1.0 - batch["done"])
-                    * tq)
-                td1 = self._q(qp["q1"], batch["obs"], batch["action"]) \
-                    - target
-                loss = jnp.mean(weights * td1 ** 2)
-                td_abs = jnp.abs(td1)
-                if cfg.twin_q:
-                    td2 = self._q(qp["q2"], batch["obs"],
-                                  batch["action"]) - target
-                    loss = loss + jnp.mean(weights * td2 ** 2)
-                    td_abs = 0.5 * (td_abs + jnp.abs(td2))
-                return loss, td_abs
-
-            def actor_loss_fn(ap, q1, batch):
-                a = self._act(ap, batch["obs"])
-                return -jnp.mean(self._q(q1, batch["obs"], a))
-
-            def update(carry, _):
-                (params, targets, aopt_state, copt_state, buffer, key,
-                 upd_count) = carry
-                batch, idx, weights, key = sample_fn(buffer, key,
-                                                     cfg.batch_size)
-                key, skey = jax.random.split(key)
-                qp = {"q1": params["q1"], "q2": params["q2"]}
-                (_, td_abs), qgrads = jax.value_and_grad(
-                    critic_loss_fn, has_aux=True)(qp, targets, batch,
-                                                  weights, skey)
-                buffer = update_pri(buffer, idx, td_abs)
-                qupd, copt_state = self.critic_opt.update(
-                    qgrads, copt_state, qp)
-                qp = optax.apply_updates(qp, qupd)
-                params = {**params, "q1": qp["q1"], "q2": qp["q2"]}
-
-                def do_actor(args):
-                    params, targets, aopt_state = args
-                    agrads = jax.grad(actor_loss_fn)(
-                        params["actor"], params["q1"], batch)
-                    aupd, aopt_state = self.actor_opt.update(
-                        agrads, aopt_state, params["actor"])
-                    actor = optax.apply_updates(params["actor"], aupd)
-                    params = {**params, "actor": actor}
-                    # targets track ONLY on actor-update steps (TD3's
-                    # delayed-target rule; delay=1 makes it every step)
-                    targets = jax.tree_util.tree_map(
-                        lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
-                        targets, params)
-                    return params, targets, aopt_state
-
-                params, targets, aopt_state = jax.lax.cond(
-                    upd_count % cfg.policy_delay == 0,
-                    do_actor, lambda args: args,
-                    (params, targets, aopt_state))
-                return (params, targets, aopt_state, copt_state, buffer,
-                        key, upd_count + 1), td_abs.mean()
-
-            do_learn = buffer["size"] >= cfg.learn_start
-
-            def run(args):
-                (params, targets, aopt_state, copt_state, buffer, key,
-                 upd_count) = args
-                (params, targets, aopt_state, copt_state, buffer, key,
-                 upd_count), tds = jax.lax.scan(
-                    update, args, None, length=cfg.num_updates)
-                return (params, targets, aopt_state, copt_state, buffer,
-                        key, upd_count, tds[-1])
-
-            def skip(args):
-                return args + (jnp.zeros(()),)
-
             (params, targets, aopt_state, copt_state, buffer, key,
-             upd_count, last_td) = jax.lax.cond(
-                do_learn, run, skip,
-                (params, targets, aopt_state, copt_state, buffer, key,
-                 upd_count))
+             upd_count, last_td) = update_block(
+                params, targets, aopt_state, copt_state, buffer, key,
+                upd_count)
             metrics = {"td_abs": last_td, "buffer_size": buffer["size"]}
             return (params, targets, aopt_state, copt_state, buffer,
                     env_states, obs, noise_state, key, upd_count,

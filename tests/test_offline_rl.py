@@ -23,7 +23,8 @@ def _expert(obs, key):
 
 def test_collect_and_roundtrip(tmp_path):
     ds = collect_dataset(CartPole, _expert, n_steps=2048, num_envs=32)
-    assert set(ds) == {"obs", "action", "reward", "done", "next_obs"}
+    assert set(ds) == {"obs", "action", "reward", "done", "next_obs",
+                       "env_id"}
     assert len(ds["obs"]) == 2048 and ds["obs"].shape[1] == 4
     assert ds["reward"].sum() > 0
     p = str(tmp_path / "cartpole_expert.npz")
