@@ -1,0 +1,476 @@
+"""AlphaZero: self-play MCTS + policy/value network training.
+
+Capability mirror of the reference's AlphaZero
+(`rllib/algorithms/alpha_zero/alpha_zero.py` — MCTS over a model of the
+env, visit-count policy targets, game-outcome value targets).  The
+reference's MCTS is a Python object graph walked per simulation
+(`alpha_zero/mcts.py`); that shape cannot run on an accelerator.  Here
+the search tree is a FIXED-SIZE ARRAY structure (the public mctx
+design: node-indexed tensors for visit counts, values, priors, and a
+children map), every simulation is a bounded ``lax.while_loop``
+traversal + expand + backup, and the WHOLE self-play game — MCTS at
+every move, both players — is one jitted program ``vmap``-able over a
+batch of games.  Training is the standard AlphaZero loss: cross-entropy
+of the network policy against MCTS visit distributions plus MSE of the
+value head against the final game outcome.
+
+Env contract: a perfect-information, two-player, alternating-move game
+expressed functionally (`TicTacToe` below is the in-tree example).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .policy import mlp_apply, mlp_init
+
+
+class TicTacToe:
+    """3x3 alternating-move game as pure functions.  Board: [9] values
+    in {-1, 0, +1} from the CURRENT player's perspective (+1 = mine).
+    The observation IS the board; after every move the board flips sign
+    so the network always sees the position to move."""
+
+    num_actions = 9
+    observation_size = 9
+    max_game_len = 9
+
+    _LINES = np.array([[0, 1, 2], [3, 4, 5], [6, 7, 8],
+                       [0, 3, 6], [1, 4, 7], [2, 5, 8],
+                       [0, 4, 8], [2, 4, 6]])
+
+    def initial_state(self):
+        return {"board": jnp.zeros((9,), jnp.int8),
+                "terminal": jnp.zeros((), jnp.bool_),
+                # outcome for the player who JUST moved (+1 win / 0)
+                "winner": jnp.zeros((), jnp.float32)}
+
+    def legal_mask(self, state) -> jnp.ndarray:
+        return (state["board"] == 0) & ~state["terminal"]
+
+    def step(self, state, action):
+        """Apply the current player's move; → state FLIPPED to the next
+        player's perspective.  ``winner`` is +1 if the move just played
+        WON the game (from the mover's perspective), else 0; draws end
+        with winner 0."""
+        board = state["board"].at[action].set(1)
+        lines = board[jnp.asarray(self._LINES)]
+        won = jnp.any(jnp.all(lines == 1, axis=1))
+        full = jnp.all(board != 0)
+        terminal = won | full | state["terminal"]
+        return {"board": (-board).astype(jnp.int8),
+                "terminal": terminal,
+                "winner": jnp.where(won, 1.0, 0.0)}
+
+
+@dataclasses.dataclass
+class AlphaZeroConfig:
+    env: Optional[Callable[[], Any]] = None       # game factory
+    num_simulations: int = 32      # MCTS simulations per move
+    c_puct: float = 1.5
+    dirichlet_alpha: float = 0.6   # root exploration noise
+    dirichlet_eps: float = 0.25
+    temperature_moves: int = 2     # sample-by-visits for the first k moves
+    games_per_iter: int = 64       # self-play games per training_step
+    epochs_per_iter: int = 2
+    batch_size: int = 256
+    lr: float = 3e-3
+    value_coeff: float = 1.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "AlphaZero":
+        return AlphaZero(self)
+
+
+def make_mcts(game, net_apply, num_simulations: int, c_puct: float):
+    """→ jittable ``mcts(params, root_state, key, noise_eps) ->
+    (visit_distribution [A], root_value)``.
+
+    Array tree: node 0 is the root; each simulation adds at most one
+    node.  Tensors indexed [node]: game state pytree, prior P[node, A],
+    N[node, A], W[node, A] (total value of the CHILD subtree from the
+    child mover's perspective is stored negated — standard negamax
+    backup), children[node, A] (index or -1), expanded flag."""
+    A = game.num_actions
+    max_nodes = num_simulations + 1
+    max_depth = game.max_game_len + 1
+
+    def eval_net(params, state):
+        logits, value = net_apply(params, state["board"].astype(
+            jnp.float32))
+        mask = game.legal_mask(state)
+        logits = jnp.where(mask, logits, -1e9)
+        prior = jax.nn.softmax(logits)
+        # terminal nodes have no network value: the game outcome rules
+        value = jnp.where(
+            state["terminal"],
+            # state is POST-move flipped: winner=1 means the player to
+            # move here has LOST (previous mover won)
+            -state["winner"], value)
+        return prior, value
+
+    def mcts(params, root_state, key, noise_eps, dirichlet_alpha):
+        tree_state = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((max_nodes,) + x.shape, x.dtype),
+            root_state)
+        tree_state = jax.tree_util.tree_map(
+            lambda t, r: t.at[0].set(r), tree_state, root_state)
+        P = jnp.zeros((max_nodes, A))
+        N = jnp.zeros((max_nodes, A))
+        W = jnp.zeros((max_nodes, A))
+        children = jnp.full((max_nodes, A), -1, jnp.int32)
+
+        prior0, _ = eval_net(params, root_state)
+        key, nkey = jax.random.split(key)
+        noise = jax.random.dirichlet(
+            nkey, jnp.full((A,), dirichlet_alpha))
+        legal = game.legal_mask(root_state)
+        prior0 = jnp.where(
+            legal,
+            (1 - noise_eps) * prior0 + noise_eps * noise, 0.0)
+        prior0 = prior0 / jnp.maximum(prior0.sum(), 1e-9)
+        P = P.at[0].set(prior0)
+
+        def simulate(sim, carry):
+            tree_state, P, N, W, children, key = carry
+            new_node = sim + 1
+
+            # -- selection: walk PUCT until an unexpanded child --------
+            def select_cond(sc):
+                node, depth, path_n, path_a, done = sc
+                return ~done & (depth < max_depth)
+
+            def select_body(sc):
+                node, depth, path_n, path_a, done = sc
+                n_tot = N[node].sum()
+                q = W[node] / jnp.maximum(N[node], 1.0)
+                u = c_puct * P[node] * jnp.sqrt(n_tot + 1.0) \
+                    / (1.0 + N[node])
+                state_n = jax.tree_util.tree_map(lambda t: t[node],
+                                                 tree_state)
+                legal = game.legal_mask(state_n)
+                score = jnp.where(legal, q + u, -jnp.inf)
+                # terminal node: stop HERE (no legal moves)
+                is_term = state_n["terminal"]
+                act = jnp.argmax(score)
+                path_n = path_n.at[depth].set(node)
+                path_a = path_a.at[depth].set(act)
+                child = children[node, act]
+                stop = is_term | (child < 0)
+                next_node = jnp.where(child < 0, node, child)
+                return (next_node, depth + 1, path_n, path_a,
+                        stop | done)
+
+            path_n0 = jnp.full((max_depth,), -1, jnp.int32)
+            path_a0 = jnp.full((max_depth,), -1, jnp.int32)
+            node, depth, path_n, path_a, _ = jax.lax.while_loop(
+                select_cond, select_body,
+                (jnp.zeros((), jnp.int32), 0, path_n0, path_a0,
+                 jnp.zeros((), jnp.bool_)))
+            # leaf = last visited node; edge = (leaf, act)
+            leaf = path_n[depth - 1]
+            act = path_a[depth - 1]
+            leaf_state = jax.tree_util.tree_map(lambda t: t[leaf],
+                                                tree_state)
+            is_term = leaf_state["terminal"]
+
+            # -- expansion + evaluation --------------------------------
+            child_state = game.step(leaf_state, jnp.maximum(act, 0))
+            prior_c, value_c = eval_net(params, child_state)
+            # terminal leaf: its outcome IS the value (eval_net would
+            # return exactly this — skip the redundant forward)
+            value = jnp.where(is_term, -leaf_state["winner"], value_c)
+
+            def do_expand(args):
+                tree_state, P, children = args
+                ts = jax.tree_util.tree_map(
+                    lambda t, c: t.at[new_node].set(c), tree_state,
+                    child_state)
+                return (ts, P.at[new_node].set(prior_c),
+                        children.at[leaf, act].set(new_node))
+
+            tree_state, P, children = jax.lax.cond(
+                is_term, lambda a: a, do_expand,
+                (tree_state, P, children))
+
+            # -- backup along the path (negamax: value flips sign per
+            # ply; `value` is from the perspective of the player to
+            # move AT THE EVALUATED position).  Expansion evaluates the
+            # new child at ply `depth`; a terminal leaf is its own
+            # evaluated position at ply `depth - 1`, and its recorded
+            # placeholder edge receives NO update.
+            eval_ply = jnp.where(is_term, depth - 1, depth)
+            n_edges = jnp.where(is_term, depth - 1, depth)
+
+            def backup(d, nw):
+                N, W = nw
+                on_path = d < n_edges
+                n_i = path_n[d]
+                a_i = path_a[d]
+                # edge d's mover sits at ply d: same player as the
+                # evaluated position iff the ply distance is even
+                sign = jnp.where((eval_ply - d) % 2 == 1, -value, value)
+                N = N.at[n_i, a_i].add(jnp.where(on_path, 1.0, 0.0))
+                W = W.at[n_i, a_i].add(jnp.where(on_path, sign, 0.0))
+                return (N, W)
+
+            N, W = jax.lax.fori_loop(0, max_depth, backup, (N, W))
+            return (tree_state, P, N, W, children, key)
+
+        (tree_state, P, N, W, children, key) = jax.lax.fori_loop(
+            0, num_simulations, simulate,
+            (tree_state, P, N, W, children, key))
+        visits = N[0]
+        pi = visits / jnp.maximum(visits.sum(), 1e-9)
+        root_value = (W[0].sum() / jnp.maximum(visits.sum(), 1e-9))
+        return pi, root_value
+
+    return mcts
+
+
+class AlphaZero(Algorithm):
+    _config_cls = AlphaZeroConfig
+
+    def __init__(self, config: AlphaZeroConfig):
+        super().__init__(config)
+        cfg = config
+        self.game = (cfg.env or TicTacToe)()
+        if cfg.games_per_iter * self.game.max_game_len < cfg.batch_size:
+            raise ValueError(
+                f"games_per_iter={cfg.games_per_iter} x max_game_len="
+                f"{self.game.max_game_len} yields fewer rows than "
+                f"batch_size={cfg.batch_size}: every epoch would run "
+                f"zero minibatches and train nothing")
+        A = self.game.num_actions
+        obs = self.game.observation_size
+        key = jax.random.PRNGKey(cfg.seed)
+        key, pk, vk, tk = jax.random.split(key, 4)
+        h = tuple(cfg.hidden)
+        self.params = {
+            "torso": mlp_init(tk, (obs,) + h),
+            "pi": mlp_init(pk, (h[-1], A)),
+            "v": mlp_init(vk, (h[-1], 1)),
+        }
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.key = key
+        self._selfplay = jax.jit(self._make_selfplay())
+        self._update = jax.jit(self._make_update())
+
+    # -- network ------------------------------------------------------------
+    def _net(self, params, board):
+        x = board
+        for layer in params["torso"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = mlp_apply(params["pi"], x)
+        value = jnp.tanh(mlp_apply(params["v"], x)[..., 0])
+        return logits, value
+
+    # -- self-play ----------------------------------------------------------
+    def _make_selfplay(self):
+        cfg, game = self.config, self.game
+        T = game.max_game_len
+        mcts = make_mcts(game, self._net, cfg.num_simulations,
+                         cfg.c_puct)
+
+        def one_game(params, key):
+            state = game.initial_state()
+
+            def move(carry, t):
+                state, key, z_sign = carry
+                key, mkey, akey = jax.random.split(key, 3)
+                pi, _ = mcts(params, state, mkey, cfg.dirichlet_eps,
+                             cfg.dirichlet_alpha)
+                # temperature: sample by visits early, argmax later
+                greedy = jnp.argmax(pi)
+                sampled = jax.random.categorical(
+                    akey, jnp.log(jnp.maximum(pi, 1e-9)))
+                action = jnp.where(t < cfg.temperature_moves, sampled,
+                                   greedy)
+                live = ~state["terminal"]
+                frame = {"board": state["board"].astype(jnp.float32),
+                         "pi": pi, "live": live,
+                         # the mover's sign relative to game end is
+                         # resolved after the game; store ply parity
+                         "ply": jnp.asarray(t, jnp.int32)}
+                next_state = game.step(state, action)
+                # if this move ended the game with a win, the MOVER at
+                # ply t won: z for ply t is +1, alternating backwards
+                just_won = next_state["terminal"] & ~state["terminal"] \
+                    & (next_state["winner"] > 0)
+                z_sign = jnp.where(just_won,
+                                   jnp.asarray(t, jnp.int32), z_sign)
+                state = jax.tree_util.tree_map(
+                    lambda n, c: jnp.where(state["terminal"], c, n),
+                    next_state, state)
+                return (state, key, z_sign), frame
+
+            (state, key, win_ply), frames = jax.lax.scan(
+                move, (state, key, jnp.asarray(-1, jnp.int32)),
+                jnp.arange(T))
+            # value target per recorded ply: +1 for plies with the
+            # winner's parity, -1 for the loser's, 0 for draws
+            z = jnp.where(
+                win_ply < 0, 0.0,
+                jnp.where((frames["ply"] % 2) == (win_ply % 2),
+                          1.0, -1.0))
+            return {"board": frames["board"], "pi": frames["pi"],
+                    "z": z, "live": frames["live"]}
+
+        def selfplay(params, key):
+            keys = jax.random.split(key, cfg.games_per_iter)
+            return jax.vmap(lambda k: one_game(params, k))(keys)
+
+        return selfplay
+
+    # -- training -----------------------------------------------------------
+    def _make_update(self):
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            logits, value = self._net(params, batch["board"])
+            logp = jax.nn.log_softmax(logits)
+            ce = -(batch["pi"] * logp).sum(-1)
+            mse = (value - batch["z"]) ** 2
+            w = batch["live"].astype(jnp.float32)
+            denom = jnp.maximum(w.sum(), 1.0)
+            return ((ce + cfg.value_coeff * mse) * w).sum() / denom, \
+                (ce * w).sum() / denom
+
+        def update(params, opt_state, data, key):
+            n = data["board"].shape[0]
+
+            def epoch(carry, _):
+                params, opt_state, key = carry
+                key, pkey = jax.random.split(key)
+                idx = jax.random.permutation(pkey, n)
+                n_mb = n // cfg.batch_size
+
+                def mb(carry, i):
+                    params, opt_state = carry
+                    sel = jax.lax.dynamic_slice_in_dim(
+                        idx, i * cfg.batch_size, cfg.batch_size)
+                    batch = jax.tree_util.tree_map(
+                        lambda x: x[sel], data)
+                    (loss, ce), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, batch)
+                    updates, opt_state = self.optimizer.update(
+                        grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), (loss, ce)
+
+                (params, opt_state), (losses, ces) = jax.lax.scan(
+                    mb, (params, opt_state), jnp.arange(n_mb))
+                return (params, opt_state, key), (losses.mean(),
+                                                  ces.mean())
+
+            (params, opt_state, key), (losses, ces) = jax.lax.scan(
+                epoch, (params, opt_state, key), None,
+                length=cfg.epochs_per_iter)
+            return params, opt_state, key, losses[-1], ces[-1]
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        self.key, skey = jax.random.split(self.key)
+        games = self._selfplay(self.params, skey)
+        data = jax.tree_util.tree_map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), games)
+        self.params, self.opt_state, self.key, loss, ce = self._update(
+            self.params, self.opt_state, data, self.key)
+        dt = time.perf_counter() - t0
+        live = np.asarray(games["live"])
+        z = np.asarray(games["z"])
+        first = z[:, 0]                   # outcome from player-1 plies
+        return {
+            "total_loss": float(loss),
+            "policy_ce": float(ce),
+            "games": cfg.games_per_iter,
+            "p1_win_rate": float((first > 0).mean()),
+            "draw_rate": float((z.max(axis=1) == 0).mean()),
+            "moves_per_game": float(live.sum(axis=1).mean()),
+            "env_steps_this_iter": int(live.sum()),
+            "env_steps_per_s": float(live.sum() / dt),
+        }
+
+    # -- evaluation ---------------------------------------------------------
+    def play_vs_random(self, n_games: int = 32,
+                       az_first: bool = True) -> Dict[str, float]:
+        """Pit greedy-MCTS AlphaZero against a uniform-random player."""
+        one = self._pit_fn()
+        self.key, *keys = jax.random.split(self.key, n_games + 1)
+        az_wins = rnd_wins = 0
+        for i, k in enumerate(keys):
+            # az_first=True → AlphaZero always opens; otherwise sides
+            # alternate game to game
+            plays_even = True if az_first else (i % 2 == 0)
+            a, r = one(self.params, k, jnp.asarray(plays_even))
+            az_wins += int(a)
+            rnd_wins += int(r)
+        return {"az_win_rate": az_wins / n_games,
+                "random_win_rate": rnd_wins / n_games,
+                "draw_rate": 1.0 - (az_wins + rnd_wins) / n_games}
+
+    def _pit_fn(self):
+        """Jitted pit-vs-random game, compiled ONCE per algorithm
+        instance (a per-call jit would recompile the whole MCTS
+        program every evaluation)."""
+        if getattr(self, "_pit_cached", None) is not None:
+            return self._pit_cached
+        cfg, game = self.config, self.game
+        mcts = make_mcts(game, self._net, cfg.num_simulations,
+                         cfg.c_puct)
+
+        @jax.jit
+        def one(params, key, az_plays_even):
+            state = game.initial_state()
+
+            def move(carry, t):
+                state, key = carry
+                key, mkey, rkey = jax.random.split(key, 3)
+                pi, _ = mcts(params, state, mkey, 0.0,
+                             cfg.dirichlet_alpha)
+                az_act = jnp.argmax(pi)
+                legal = game.legal_mask(state)
+                rand_act = jax.random.categorical(
+                    rkey, jnp.where(legal, 0.0, -1e9))
+                az_turn = (t % 2 == 0) == az_plays_even
+                action = jnp.where(az_turn, az_act, rand_act)
+                next_state = game.step(state, action)
+                just_won = next_state["terminal"] & ~state["terminal"] \
+                    & (next_state["winner"] > 0)
+                az_won = just_won & az_turn
+                rnd_won = just_won & ~az_turn
+                state = jax.tree_util.tree_map(
+                    lambda n, c: jnp.where(state["terminal"], c, n),
+                    next_state, state)
+                return (state, key), (az_won, rnd_won)
+
+            (state, key), (az_w, rnd_w) = jax.lax.scan(
+                move, (state, key), jnp.arange(game.max_game_len))
+            return az_w.any(), rnd_w.any()
+
+        self._pit_cached = one
+        return one
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.iteration = state.get("iteration", 0)

@@ -34,9 +34,11 @@ def collector_epsilon(i: int, n: int, base: float = 0.4,
     return float(base ** (1.0 + i * alpha / (n - 1)))
 
 
-class _DQNCollector:
-    """Actor: compiled vectorized epsilon-greedy collection at a FIXED
-    per-worker epsilon; ships columnar transition batches."""
+class _CollectorBase:
+    """Shared collector-actor scaffolding: compiled vectorized rollout
+    scan + columnar shipping.  Subclasses implement `_setup(cfg,
+    worker_index, num_workers)` (build nets, set ``self.params``) and
+    `_action_fn(params, obs, key)` (the per-step exploration rule)."""
 
     def __init__(self, config_blob: bytes, worker_index: int,
                  num_workers: int):
@@ -44,34 +46,29 @@ class _DQNCollector:
         cfg = loads_function(config_blob)
         self.cfg = cfg
         self.env = cfg.env()
-        self.q = QNetwork(self.env.observation_size,
-                          self.env.action_size, hidden=cfg.hidden,
-                          dueling=cfg.dueling,
-                          num_atoms=cfg.num_atoms, v_min=cfg.v_min,
-                          v_max=cfg.v_max)
-        self.eps = collector_epsilon(worker_index, num_workers)
         key = jax.random.PRNGKey(cfg.seed + 104729 * (worker_index + 1))
         self.key, ekey, pkey = jax.random.split(key, 3)
-        self.params = self.q.init(pkey)
+        self._setup(cfg, worker_index, num_workers, pkey)
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         self._collect = jax.jit(self._make_collect())
         self._ep_returns = np.zeros(cfg.num_envs)
         self._done_returns: list = []
 
+    def _setup(self, cfg, worker_index, num_workers, pkey):
+        raise NotImplementedError
+
+    def _action_fn(self, params, obs, key):
+        raise NotImplementedError
+
     def _make_collect(self):
-        cfg, env, q, eps = self.cfg, self.env, self.q, self.eps
+        cfg, env = self.cfg, self.env
 
         def collect(params, env_states, obs, key):
             def step(carry, _):
                 env_states, obs, key = carry
-                key, akey, rkey, skey = jax.random.split(key, 4)
-                greedy = jnp.argmax(q.apply(params, obs), axis=-1)
-                random_a = jax.random.randint(
-                    rkey, greedy.shape, 0, env.action_size)
-                explore = jax.random.uniform(
-                    akey, greedy.shape) < eps
-                action = jnp.where(explore, random_a, greedy)
+                key, akey, skey = jax.random.split(key, 3)
+                action = self._action_fn(params, obs, akey)
                 skeys = jax.random.split(skey, cfg.num_envs)
                 env_states, next_obs, reward, done = jax.vmap(
                     env.step)(env_states, action, skeys)
@@ -102,6 +99,27 @@ class _DQNCollector:
         out["episode_returns"] = self._done_returns
         self._done_returns = []
         return out
+
+
+class _DQNCollector(_CollectorBase):
+    """Epsilon-greedy collection at a FIXED per-worker epsilon."""
+
+    def _setup(self, cfg, worker_index, num_workers, pkey):
+        self.q = QNetwork(self.env.observation_size,
+                          self.env.action_size, hidden=cfg.hidden,
+                          dueling=cfg.dueling,
+                          num_atoms=cfg.num_atoms, v_min=cfg.v_min,
+                          v_max=cfg.v_max)
+        self.eps = collector_epsilon(worker_index, num_workers)
+        self.params = self.q.init(pkey)
+
+    def _action_fn(self, params, obs, key):
+        akey, rkey = jax.random.split(key)
+        greedy = jnp.argmax(self.q.apply(params, obs), axis=-1)
+        random_a = jax.random.randint(rkey, greedy.shape, 0,
+                                      self.env.action_size)
+        explore = jax.random.uniform(akey, greedy.shape) < self.eps
+        return jnp.where(explore, random_a, greedy)
 
 
 class _ApexDriver:
@@ -276,76 +294,26 @@ def collector_noise_scale(i: int, n: int, base: float = 0.4,
     return collector_epsilon(i, n, base=base, alpha=alpha)
 
 
-class _DDPGCollector:
-    """Actor: compiled deterministic-policy collection with FIXED
-    per-worker Gaussian action noise; ships columnar float batches."""
+class _DDPGCollector(_CollectorBase):
+    """Deterministic-policy collection with FIXED per-worker Gaussian
+    action noise (the continuous Ape-X exploration spectrum)."""
 
-    def __init__(self, config_blob: bytes, worker_index: int,
-                 num_workers: int):
-        from ..core.serialization import loads_function
-        from .td3 import _relu_mlp
+    def _setup(self, cfg, worker_index, num_workers, pkey):
         from .policy import mlp_init
-        cfg = loads_function(config_blob)
-        self.cfg = cfg
-        self.env = cfg.env()
         self.sigma = collector_noise_scale(
             worker_index, num_workers) * self.env.action_high
-        key = jax.random.PRNGKey(cfg.seed + 104729 * (worker_index + 1))
-        self.key, ekey, pkey = jax.random.split(key, 3)
         h = tuple(cfg.hidden)
-        self.actor_params = mlp_init(
+        self.params = mlp_init(
             pkey, (self.env.observation_size,) + h
             + (self.env.action_size,))
-        ekeys = jax.random.split(ekey, cfg.num_envs)
-        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
-        self._collect = jax.jit(self._make_collect())
-        self._ep_returns = np.zeros(cfg.num_envs)
-        self._done_returns: list = []
 
-    def _make_collect(self):
+    def _action_fn(self, params, obs, key):
         from .td3 import _relu_mlp
-        cfg, env, sigma = self.cfg, self.env, self.sigma
-        high = env.action_high
-
-        def collect(actor_params, env_states, obs, key):
-            def step(carry, _):
-                env_states, obs, key = carry
-                key, nkey, skey = jax.random.split(key, 3)
-                action = high * jnp.tanh(_relu_mlp(actor_params, obs))
-                action = jnp.clip(
-                    action + sigma * jax.random.normal(nkey,
-                                                       action.shape),
-                    -high, high)
-                skeys = jax.random.split(skey, cfg.num_envs)
-                env_states, next_obs, reward, done = jax.vmap(
-                    env.step)(env_states, action, skeys)
-                frame = {"obs": obs, "action": action,
-                         "reward": reward, "next_obs": next_obs,
-                         "done": done}
-                return (env_states, next_obs, key), frame
-
-            (env_states, obs, key), traj = jax.lax.scan(
-                step, (env_states, obs, key), None,
-                length=cfg.collect_steps)
-            return env_states, obs, key, traj
-
-        return collect
-
-    def collect(self, weights) -> Dict[str, Any]:
-        self.actor_params = jax.tree_util.tree_map(
-            lambda _, w: jnp.asarray(w), self.actor_params, weights)
-        self.env_states, self.obs, self.key, traj = self._collect(
-            self.actor_params, self.env_states, self.obs, self.key)
-        rewards = np.asarray(traj["reward"])
-        dones = np.asarray(traj["done"])
-        track_episode_returns(self._ep_returns, self._done_returns,
-                              rewards, dones)
-        T, B = rewards.shape
-        out = {k: np.asarray(v).reshape((T * B,) + v.shape[2:])
-               for k, v in traj.items()}
-        out["episode_returns"] = self._done_returns
-        self._done_returns = []
-        return out
+        high = self.env.action_high
+        action = high * jnp.tanh(_relu_mlp(params, obs))
+        return jnp.clip(
+            action + self.sigma * jax.random.normal(key, action.shape),
+            -high, high)
 
 
 @dataclasses.dataclass
