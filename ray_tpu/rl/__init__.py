@@ -11,6 +11,7 @@ supported through rollout-worker actors like the reference's sampler.
 """
 
 from .algorithm import Algorithm  # noqa: F401
+from .a3c import A3C, A3CConfig  # noqa: F401
 from .alpha_zero import AlphaZero, AlphaZeroConfig, TicTacToe  # noqa: F401
 from .apex import (  # noqa: F401
     ApexDDPG,

@@ -1,0 +1,227 @@
+"""A3C: asynchronous advantage actor-critic (gradient-shipping workers).
+
+Capability mirror of the reference's A3C
+(`rllib/algorithms/a3c/a3c.py` — the defining trait vs A2C/IMPALA:
+workers compute GRADIENTS locally on their own rollouts and ship grads,
+not trajectories; the learner applies them as they arrive, tolerating
+policy staleness with no importance correction).  TPU-first shape: each
+worker actor jits rollout + GAE + the gradient computation into one XLA
+program, the driver keeps one task in flight per worker (the same async
+re-arm pattern as apex.py/_ApexDriver) and applies whichever gradients
+land first — HOGWILD-style asynchrony over the actor runtime instead of
+the reference's shared-parameter threads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm, track_episode_returns
+from .env import JaxEnv
+from .policy import MLPPolicy
+from .ppo import compute_gae, make_rollout_fn
+
+
+@dataclasses.dataclass
+class A3CConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_workers: int = 2
+    num_envs: int = 16             # vectorized envs per worker
+    rollout_length: int = 32
+    gamma: float = 0.99
+    gae_lambda: float = 1.0        # reference A3C default: plain returns
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    lr: float = 1e-3
+    max_grad_norm: float = 40.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "A3C":
+        return A3C(self)
+
+
+class _A3CWorker:
+    """Actor: one jitted rollout→GAE→grad program; ships gradients."""
+
+    def __init__(self, config_blob: bytes, worker_index: int):
+        from ..core.serialization import loads_function
+        cfg = loads_function(config_blob)
+        self.cfg = cfg
+        self.env = cfg.env()
+        self.policy = MLPPolicy(self.env.observation_size,
+                                self.env.action_size,
+                                discrete=self.env.discrete,
+                                hidden=tuple(cfg.hidden))
+        key = jax.random.PRNGKey(cfg.seed + 7919 * (worker_index + 1))
+        self.key, ekey, pkey = jax.random.split(key, 3)
+        self.params = self.policy.init(pkey)   # overwritten per call
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self._rollout = make_rollout_fn(self.env, self.policy,
+                                        cfg.num_envs, cfg.rollout_length)
+        self._grad_fn = jax.jit(self._make_grad_fn())
+        self._ep_returns = np.zeros(cfg.num_envs)
+        self._done_returns: list = []
+
+    def _make_grad_fn(self):
+        cfg, policy = self.cfg, self.policy
+        batch = cfg.num_envs * cfg.rollout_length
+
+        def loss_fn(params, flat):
+            logp, entropy, value = jax.vmap(
+                lambda o, a: policy.log_prob(params, o, a))(
+                    flat["obs"], flat["action"])
+            adv = flat["adv"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg = -(logp * adv).mean()
+            vf = ((value - flat["ret"]) ** 2).mean()
+            ent = entropy.mean()
+            return pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent, \
+                (pg, vf, ent)
+
+        def grad_fn(params, env_states, obs, key):
+            traj, env_states, obs, _conn, last_value, key = \
+                self._rollout(params, env_states, obs, (), key)
+            adv, ret = compute_gae(traj, last_value, cfg.gamma,
+                                   cfg.gae_lambda)
+            flat = {
+                "obs": traj["obs"].reshape(batch, -1),
+                "action": traj["action"].reshape(
+                    (batch,) if self.env.discrete else (batch, -1)),
+                "adv": adv.reshape(batch),
+                "ret": ret.reshape(batch),
+            }
+            (loss, (pg, vf, ent)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, flat)
+            return (grads, env_states, obs, key, loss,
+                    traj["reward"], traj["done"])
+
+        return grad_fn
+
+    def compute_gradients(self, weights) -> Dict[str, Any]:
+        self.params = jax.tree_util.tree_map(
+            lambda _, w: jnp.asarray(w), self.params, weights)
+        (grads, self.env_states, self.obs, self.key, loss, rewards,
+         dones) = self._grad_fn(self.params, self.env_states, self.obs,
+                                self.key)
+        track_episode_returns(self._ep_returns, self._done_returns,
+                              np.asarray(rewards), np.asarray(dones))
+        out = {
+            "grads": jax.tree_util.tree_map(np.asarray, grads),
+            "loss": float(loss),
+            "steps": self.cfg.num_envs * self.cfg.rollout_length,
+            "episode_returns": self._done_returns,
+        }
+        self._done_returns = []
+        return out
+
+
+class A3C(Algorithm):
+    _config_cls = A3CConfig
+
+    def __init__(self, config: A3CConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("A3CConfig.env required (an env factory)")
+        if cfg.num_workers < 1:
+            raise ValueError("A3C is defined by asynchronous gradient "
+                             "workers: num_workers >= 1 (use A2C for "
+                             "the synchronous inline variant)")
+        env = cfg.env()
+        self.policy = MLPPolicy(env.observation_size, env.action_size,
+                                discrete=env.discrete,
+                                hidden=tuple(cfg.hidden))
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = self.policy.init(key)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._apply = jax.jit(self._apply_grads)
+        from .. import api
+        from ..core.serialization import dumps_function
+        blob = dumps_function(cfg)
+        cls = api.remote(_A3CWorker)
+        self._workers = [cls.remote(blob, i)
+                         for i in range(cfg.num_workers)]
+        self._inflight: Dict[int, Any] = {}
+        self._init_episode_tracking(cfg.num_envs)
+
+    def _apply_grads(self, params, opt_state, grads):
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def _arm(self, i: int) -> None:
+        from .. import api
+        weights_ref = api.put(jax.tree_util.tree_map(np.asarray,
+                                                     self.params))
+        self._inflight[i] = \
+            self._workers[i].compute_gradients.remote(weights_ref)
+
+    def training_step(self) -> Dict[str, Any]:
+        from .. import api
+        t0 = time.perf_counter()
+        for i in range(len(self._workers)):
+            if i not in self._inflight:
+                self._arm(i)
+        refs = {self._inflight[i]: i for i in self._inflight}
+        # apply whichever gradients are ready — the A3C contract: no
+        # barrier, no importance correction, staleness tolerated
+        ready, _ = api.wait(list(refs), num_returns=1, timeout=300.0)
+        ready_set = set(ready)
+        for r in list(refs):
+            if r not in ready_set:
+                more, _ = api.wait([r], num_returns=1, timeout=0.0)
+                ready_set.update(more)
+        steps = 0
+        losses = []
+        for r in ready_set:
+            i = refs[r]
+            out = api.get(self._inflight.pop(i), timeout=300.0)
+            grads = jax.tree_util.tree_map(jnp.asarray, out["grads"])
+            # sequential application, one optimizer step per worker
+            # batch — each arrival immediately updates the weights
+            self.params, self.opt_state = self._apply(
+                self.params, self.opt_state, grads)
+            steps += out["steps"]
+            losses.append(out["loss"])
+            self._ep_done_returns.extend(out["episode_returns"])
+            self._arm(i)            # re-arm with the fresh weights
+        dt = time.perf_counter() - t0
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "grads_applied": len(losses),
+            "episode_reward_mean": self.episode_reward_mean(),
+            "env_steps_this_iter": steps,
+            "env_steps_per_s": steps / dt,
+        }
+
+    def stop(self) -> None:
+        from .. import api
+        for w in self._workers:
+            try:
+                api.kill(w)
+            except Exception:
+                pass
+        self._workers = []
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.iteration = state.get("iteration", 0)

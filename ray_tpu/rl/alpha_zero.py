@@ -92,8 +92,8 @@ class AlphaZeroConfig:
 
 
 def make_mcts(game, net_apply, num_simulations: int, c_puct: float):
-    """→ jittable ``mcts(params, root_state, key, noise_eps) ->
-    (visit_distribution [A], root_value)``.
+    """→ jittable ``mcts(params, root_state, key, noise_eps,
+    dirichlet_alpha) -> (visit_distribution [A], root_value)``.
 
     Array tree: node 0 is the root; each simulation adds at most one
     node.  Tensors indexed [node]: game state pytree, prior P[node, A],
@@ -409,15 +409,17 @@ class AlphaZero(Algorithm):
                        az_first: bool = True) -> Dict[str, float]:
         """Pit greedy-MCTS AlphaZero against a uniform-random player."""
         one = self._pit_fn()
-        self.key, *keys = jax.random.split(self.key, n_games + 1)
-        az_wins = rnd_wins = 0
-        for i, k in enumerate(keys):
-            # az_first=True → AlphaZero always opens; otherwise sides
-            # alternate game to game
-            plays_even = True if az_first else (i % 2 == 0)
-            a, r = one(self.params, k, jnp.asarray(plays_even))
-            az_wins += int(a)
-            rnd_wins += int(r)
+        self.key, gkey = jax.random.split(self.key)
+        keys = jax.random.split(gkey, n_games)
+        # az_first=True → AlphaZero always opens; otherwise sides
+        # alternate game to game.  All games run as ONE vmapped call
+        # (the selfplay pattern), not n_games serial device programs.
+        plays_even = jnp.ones((n_games,), jnp.bool_) if az_first else \
+            (jnp.arange(n_games) % 2 == 0)
+        az_w, rnd_w = jax.vmap(
+            lambda k, p: one(self.params, k, p))(keys, plays_even)
+        az_wins = int(np.asarray(az_w).sum())
+        rnd_wins = int(np.asarray(rnd_w).sum())
         return {"az_win_rate": az_wins / n_games,
                 "random_win_rate": rnd_wins / n_games,
                 "draw_rate": 1.0 - (az_wins + rnd_wins) / n_games}

@@ -37,8 +37,9 @@ def collector_epsilon(i: int, n: int, base: float = 0.4,
 class _CollectorBase:
     """Shared collector-actor scaffolding: compiled vectorized rollout
     scan + columnar shipping.  Subclasses implement `_setup(cfg,
-    worker_index, num_workers)` (build nets, set ``self.params``) and
-    `_action_fn(params, obs, key)` (the per-step exploration rule)."""
+    worker_index, num_workers, pkey)` (build nets from the param key,
+    set ``self.params``) and `_action_fn(params, obs, key)` (the
+    per-step exploration rule)."""
 
     def __init__(self, config_blob: bytes, worker_index: int,
                  num_workers: int):

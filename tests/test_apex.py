@@ -103,3 +103,28 @@ def test_noise_spectrum():
     assert s == sorted(s, reverse=True)
     assert s[0] == pytest.approx(0.4)
     assert s[-1] < 0.01
+
+
+def test_a3c_learns_cartpole(cluster):
+    """Gradient-shipping async workers (reference capability:
+    rllib/algorithms/a3c — grads, not trajectories, cross the wire)."""
+    import time
+
+    from ray_tpu.rl import A3CConfig, CartPole
+
+    algo = A3CConfig(env=CartPole, num_workers=2, num_envs=16,
+                     rollout_length=32, lr=1e-3, seed=0).build()
+    try:
+        best = -1.0
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            res = algo.train()
+            r = res["episode_reward_mean"]
+            if np.isfinite(r):
+                best = max(best, r)
+            if best > 100:
+                break
+        assert best > 100, best
+        assert res["grads_applied"] >= 1
+    finally:
+        algo.stop()
