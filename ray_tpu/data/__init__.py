@@ -18,6 +18,7 @@ from .datasource import (  # noqa: F401
     FileBasedDatasource,
     JSONDatasource,
     NumpyDatasource,
+    ImageDatasource,
     ParquetDatasource,
     RangeDatasource,
     ReadTask,
@@ -37,8 +38,10 @@ from .read_api import (  # noqa: F401
     read_datasource,
     read_json,
     read_numpy,
+    read_images,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 
 from . import preprocessors  # noqa: F401,E402  (AIR preprocessor library)

@@ -777,6 +777,11 @@ class Dataset:
         from .datasource import JSONDatasource
         return self.write_datasource(JSONDatasource(), path=path, **kw)
 
+    def write_tfrecords(self, path: str, **kw) -> List[str]:
+        from .tfrecords import TFRecordDatasource
+        return self.write_datasource(TFRecordDatasource(), path=path,
+                                     **kw)
+
     # -- pipeline -----------------------------------------------------------
     def window(self, *, blocks_per_window: int = 10):
         from .dataset_pipeline import DatasetPipeline

@@ -231,3 +231,42 @@ class RangeDatasource(Datasource):
 
         return [ReadTask(make(int(lo), int(hi)))
                 for lo, hi in zip(bounds[:-1], bounds[1:])]
+
+
+class ImageDatasource(FileBasedDatasource):
+    """Image files → rows with decoded pixel arrays (reference:
+    `data/datasource/image_datasource.py` — `ray.data.read_images`).
+    Columns: ``image`` (HWC ndarray, native mode preserved) and
+    optionally ``path``; ``size=(H, W)`` resizes on read, ``mode``
+    converts (e.g. "RGB", "L"; default None keeps the file's own
+    mode/channels).  Directory reads skip non-image files by extension,
+    like the reference."""
+
+    _FILE_EXT = "png"
+    _IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".gif", ".bmp", ".webp",
+                   ".tif", ".tiff")
+
+    def prepare_read(self, parallelism: int, **read_args):
+        tasks = super().prepare_read(parallelism, **read_args)
+        kept = [t for t in tasks
+                if t.input_files[0].lower().endswith(self._IMAGE_EXTS)]
+        if not kept:
+            raise FileNotFoundError(
+                f"no image files ({'/'.join(self._IMAGE_EXTS)}) "
+                f"matched {self._paths}")
+        return kept
+
+    def _read_file(self, path: str, size=None, mode=None,
+                   include_paths: bool = False, **kw):
+        import numpy as np
+        import pandas as pd
+        from PIL import Image
+        img = Image.open(path)
+        if mode is not None:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        row = {"image": [np.asarray(img)]}
+        if include_paths:
+            row["path"] = [path]
+        return pd.DataFrame(row)

@@ -103,3 +103,20 @@ def read_text(paths, **kwargs) -> Dataset:
 def read_binary_files(paths, **kwargs) -> Dataset:
     return read_datasource(BinaryDatasource(paths, **kwargs),
                            _name="read_binary_files")
+
+
+def read_tfrecords(paths, **kwargs) -> Dataset:
+    """TFRecord files of `tf.train.Example` records (reference:
+    `ray.data.read_tfrecords`) — no TensorFlow needed; the container
+    and proto codec are implemented in data/tfrecords.py."""
+    from .tfrecords import TFRecordDatasource
+    return read_datasource(TFRecordDatasource(paths, **kwargs),
+                           _name="read_tfrecords")
+
+
+def read_images(paths, **kwargs) -> Dataset:
+    """Image files → rows of decoded HWC uint8 arrays (reference:
+    `ray.data.read_images`)."""
+    from .datasource import ImageDatasource
+    return read_datasource(ImageDatasource(paths, **kwargs),
+                           _name="read_images")

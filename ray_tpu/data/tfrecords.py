@@ -1,0 +1,264 @@
+"""TFRecord datasource: the TPU ecosystem's native file format.
+
+Capability mirror of the reference's TFRecords datasource
+(/root/reference/python/ray/data/datasource/tfrecords_datasource.py —
+`tf.train.Example` records in the length-prefixed, CRC-masked TFRecord
+container).  This image ships no TensorFlow, so BOTH layers are
+implemented directly:
+
+  * the TFRecord container — ``uint64 length | masked crc32c(length) |
+    data | masked crc32c(data)`` with the Castagnoli polynomial and
+    TensorFlow's mask rotation; and
+  * the `tf.train.Example` protobuf wire format — a hand-rolled codec
+    for the fixed three-level schema (Example → Features →
+    map<string, Feature{bytes_list|float_list|int64_list}>), which is
+    stable and tiny enough that a dependency would be heavier than the
+    codec.
+
+Files written here are readable by real TensorFlow/`tf.data`, and vice
+versa — the point of the format on TPU pipelines.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List
+
+from .datasource import FileBasedDatasource
+
+# -- crc32c (Castagnoli), table-driven -------------------------------------
+
+_CRC_TABLE: List[int] = []
+
+
+def _crc_table() -> List[int]:
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+try:                               # a C implementation when one exists
+    from crc32c import crc32c as _crc32c_fast       # pragma: no cover
+except ImportError:
+    try:
+        from google_crc32c import value as _crc32c_fast  # pragma: no cover
+    except ImportError:
+        _crc32c_fast = None
+
+
+def crc32c(data: bytes) -> int:
+    if _crc32c_fast is not None:                    # pragma: no cover
+        return _crc32c_fast(data)
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- protobuf wire helpers --------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+# -- tf.train.Example codec --------------------------------------------------
+
+
+def encode_example(row: Dict[str, Any]) -> bytes:
+    """Column dict → serialized `tf.train.Example`.  Value mapping
+    follows the reference datasource: bytes/str → bytes_list, floats →
+    float_list, ints/bools → int64_list; lists/arrays of those map to
+    multi-value features."""
+    import numpy as np
+    features = b""
+    for key, value in row.items():
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        if not isinstance(value, (list, tuple)):
+            value = [value]
+        # type is decided over the WHOLE list: any float anywhere makes
+        # it a float_list (sniffing only value[0] would silently
+        # truncate [1, 2.5] to ints)
+        if any(isinstance(v, (bytes, str)) for v in value):
+            if not all(isinstance(v, (bytes, str)) for v in value):
+                raise TypeError(
+                    f"feature {key!r} mixes bytes/str with numbers: "
+                    f"{value!r}")
+            payload = b"".join(
+                _len_delim(1, v.encode() if isinstance(v, str) else v)
+                for v in value)
+            feature = _len_delim(1, payload)              # bytes_list
+        elif any(isinstance(v, (float, np.floating)) for v in value):
+            packed = struct.pack(f"<{len(value)}f",
+                                 *[float(v) for v in value])
+            feature = _len_delim(2, _len_delim(1, packed))  # float_list
+        else:
+            packed = b"".join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+                              for v in value)
+            feature = _len_delim(3, _len_delim(1, packed))  # int64_list
+        entry = _len_delim(1, key.encode()) + _len_delim(2, feature)
+        features += _len_delim(1, entry)                  # map entry
+    return _len_delim(1, features)                        # Example.features
+
+
+def _parse_fields(buf: bytes) -> Iterator:
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            yield field, buf[pos:pos + ln]
+            pos += ln
+        elif wire == 0:
+            v, pos = _read_varint(buf, pos)
+            yield field, v
+        elif wire == 5:
+            yield field, buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            yield field, buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def decode_example(data: bytes) -> Dict[str, Any]:
+    """Serialized `tf.train.Example` → column dict.  Single-element
+    features unwrap to scalars (the reference's behavior)."""
+    row: Dict[str, Any] = {}
+    for f_ex, features in _parse_fields(data):
+        if f_ex != 1:
+            continue
+        for f_map, entry in _parse_fields(features):
+            key = None
+            value: Any = None
+            for f_e, v in _parse_fields(entry):
+                if f_e == 1:
+                    key = v.decode()
+                elif f_e == 2:
+                    value = _decode_feature(v)
+            if key is not None:
+                row[key] = value
+    return row
+
+
+def _decode_feature(buf: bytes):
+    for kind, payload in _parse_fields(buf):
+        if kind == 1:       # bytes_list
+            vals = [v for f, v in _parse_fields(payload) if f == 1]
+            return vals[0] if len(vals) == 1 else vals
+        if kind == 2:       # float_list (packed or repeated)
+            floats: List[float] = []
+            for f, v in _parse_fields(payload):
+                if f == 1:
+                    if isinstance(v, bytes):
+                        floats.extend(struct.unpack(
+                            f"<{len(v) // 4}f", v))
+                    else:   # unpacked fixed32 comes as 4 bytes too
+                        floats.append(float(v))
+            return floats[0] if len(floats) == 1 else floats
+        if kind == 3:       # int64_list (packed varints)
+            ints: List[int] = []
+            for f, v in _parse_fields(payload):
+                if f == 1:
+                    if isinstance(v, bytes):
+                        pos = 0
+                        while pos < len(v):
+                            n, pos = _read_varint(v, pos)
+                            # two's-complement back to signed
+                            if n >= 1 << 63:
+                                n -= 1 << 64
+                            ints.append(n)
+                    else:
+                        ints.append(v if v < 1 << 63 else v - (1 << 64))
+            return ints[0] if len(ints) == 1 else ints
+    return None
+
+
+# -- the container + datasource ---------------------------------------------
+
+
+def write_tfrecord_file(path: str, records: List[bytes]) -> None:
+    with open(path, "wb") as f:
+        for rec in records:
+            length = struct.pack("<Q", len(rec))
+            f.write(length)
+            f.write(struct.pack("<I", _masked_crc(length)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(rec)))
+
+
+def read_tfrecord_file(path: str) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return
+            (length,) = struct.unpack("<Q", header)
+            (len_crc,) = struct.unpack("<I", f.read(4))
+            if len_crc != _masked_crc(header):
+                raise ValueError(f"corrupt TFRecord length crc in {path}")
+            data = f.read(length)
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if data_crc != _masked_crc(data):
+                raise ValueError(f"corrupt TFRecord data crc in {path}")
+            yield data
+
+
+class TFRecordDatasource(FileBasedDatasource):
+    """`tf.train.Example` TFRecord files ⇄ tabular blocks."""
+
+    _FILE_EXT = "tfrecords"
+
+    def _read_file(self, path: str, **kw):
+        import pandas as pd
+        rows = [decode_example(rec) for rec in read_tfrecord_file(path)]
+        return pd.DataFrame(rows)
+
+    def _write_file(self, df, path: str, **kw) -> None:
+        write_tfrecord_file(
+            path, [encode_example(row)
+                   for row in df.to_dict(orient="records")])
