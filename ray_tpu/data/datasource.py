@@ -247,9 +247,18 @@ class ImageDatasource(FileBasedDatasource):
                    ".tif", ".tiff")
 
     def prepare_read(self, parallelism: int, **read_args):
+        # extension filtering applies only to files DISCOVERED through
+        # directory/glob expansion; a file the user named explicitly is
+        # always read (and PIL raises loudly if it isn't an image)
+        paths = [self._paths] if isinstance(self._paths, str) \
+            else list(self._paths)
+        explicit = {p for p in paths
+                    if not os.path.isdir(p)
+                    and not any(ch in p for ch in "*?[")}
         tasks = super().prepare_read(parallelism, **read_args)
         kept = [t for t in tasks
-                if t.input_files[0].lower().endswith(self._IMAGE_EXTS)]
+                if t.input_files[0] in explicit
+                or t.input_files[0].lower().endswith(self._IMAGE_EXTS)]
         if not kept:
             raise FileNotFoundError(
                 f"no image files ({'/'.join(self._IMAGE_EXTS)}) "

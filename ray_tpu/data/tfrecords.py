@@ -231,20 +231,34 @@ def write_tfrecord_file(path: str, records: List[bytes]) -> None:
             f.write(struct.pack("<I", _masked_crc(rec)))
 
 
-def read_tfrecord_file(path: str) -> Iterator[bytes]:
+def read_tfrecord_file(path: str,
+                       verify_crc: bool = True) -> Iterator[bytes]:
+    """``verify_crc=False`` skips checksum verification (the tf.data
+    reader's own default) — with the pure-Python CRC fallback that is
+    the dominant cost of reading large files."""
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
             if not header:
                 return
+            if len(header) < 8:
+                raise ValueError(f"truncated TFRecord header in {path}")
             (length,) = struct.unpack("<Q", header)
-            (len_crc,) = struct.unpack("<I", f.read(4))
-            if len_crc != _masked_crc(header):
-                raise ValueError(f"corrupt TFRecord length crc in {path}")
+            crc_buf = f.read(4)
             data = f.read(length)
-            (data_crc,) = struct.unpack("<I", f.read(4))
-            if data_crc != _masked_crc(data):
-                raise ValueError(f"corrupt TFRecord data crc in {path}")
+            crc_buf2 = f.read(4)
+            if len(crc_buf) < 4 or len(data) < length \
+                    or len(crc_buf2) < 4:
+                raise ValueError(f"truncated TFRecord record in {path}")
+            if verify_crc:
+                if struct.unpack("<I", crc_buf)[0] != \
+                        _masked_crc(header):
+                    raise ValueError(
+                        f"corrupt TFRecord length crc in {path}")
+                if struct.unpack("<I", crc_buf2)[0] != \
+                        _masked_crc(data):
+                    raise ValueError(
+                        f"corrupt TFRecord data crc in {path}")
             yield data
 
 
@@ -253,9 +267,11 @@ class TFRecordDatasource(FileBasedDatasource):
 
     _FILE_EXT = "tfrecords"
 
-    def _read_file(self, path: str, **kw):
+    def _read_file(self, path: str, verify_crc: bool = True, **kw):
         import pandas as pd
-        rows = [decode_example(rec) for rec in read_tfrecord_file(path)]
+        rows = [decode_example(rec)
+                for rec in read_tfrecord_file(path,
+                                              verify_crc=verify_crc)]
         return pd.DataFrame(rows)
 
     def _write_file(self, df, path: str, **kw) -> None:

@@ -1,0 +1,72 @@
+"""MAML meta-RL tests (reference: rllib/algorithms/maml/ — the
+meta-gradient here is plain jax.grad through the inner update)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import MAMLConfig
+from ray_tpu.rl.maml import GoalDirection
+
+
+def test_task_env_contract():
+    env = GoalDirection()
+    tasks = env.sample_tasks(jax.random.PRNGKey(0), 8)
+    assert tasks.shape == (8, 1)
+    assert set(np.unique(np.asarray(tasks))) <= {-1.0, 1.0}
+    state, obs = env.reset(jax.random.PRNGKey(1), tasks[0])
+    state, obs, r, d = env.step(state, jnp.array([1.0]),
+                                jax.random.PRNGKey(2), tasks[0])
+    assert float(r) == pytest.approx(float(tasks[0, 0]))
+
+
+def test_maml_adaptation_gain():
+    """The direction is hidden, so the UNADAPTED policy averages ~0
+    reward; meta-training must make ONE/TWO inner gradient steps lift
+    task reward clearly (measured: post-adapt peaks 0.6-0.75)."""
+    algo = MAMLConfig(meta_batch_size=16, num_envs=8, rollout_length=16,
+                      gamma=0.0, inner_lr=1.0, outer_lr=1e-2,
+                      inner_steps=2, seed=0).build()
+    best_post, best_gain = -9.0, -9.0
+    for i in range(90):
+        r = algo.train()
+        best_post = max(best_post, r["post_adapt_reward_mean"])
+        best_gain = max(best_gain, r["adaptation_gain"])
+        if best_post > 0.45 and best_gain > 0.35:
+            break
+    assert best_post > 0.4, best_post
+    assert best_gain > 0.3, best_gain
+
+
+def test_maml_adapt_to_task_direction():
+    """adapt_to_task must push the action mean toward the task's
+    hidden direction."""
+    algo = MAMLConfig(meta_batch_size=16, num_envs=8, rollout_length=16,
+                      gamma=0.0, inner_lr=1.0, outer_lr=1e-2,
+                      inner_steps=2, seed=0).build()
+    for _ in range(30):
+        algo.train()
+
+    def mean_at_zero(params):
+        pi, _ = algo.policy.forward(params, jnp.array([0.0]))
+        mean, _ = jnp.split(pi, 2, axis=-1)
+        return float(mean[0])
+
+    m_pos = mean_at_zero(algo.adapt_to_task([1.0]))
+    m_neg = mean_at_zero(algo.adapt_to_task([-1.0]))
+    assert m_pos > m_neg + 0.2, (m_pos, m_neg)
+
+
+def test_maml_checkpoint_roundtrip():
+    algo = MAMLConfig(meta_batch_size=4, num_envs=4,
+                      rollout_length=8).build()
+    algo.train()
+    state = algo.get_state()
+    algo2 = MAMLConfig(meta_batch_size=4, num_envs=4,
+                       rollout_length=8).build()
+    algo2.set_state(state)
+    for a, b in zip(jax.tree_util.tree_leaves(algo.params),
+                    jax.tree_util.tree_leaves(algo2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

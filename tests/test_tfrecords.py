@@ -109,3 +109,24 @@ def test_read_images_preserves_native_mode(cluster, tmp_path):
         tmp_path / "g.png")
     img = rdata.read_images(str(tmp_path)).take(1)[0]["image"]
     assert img.shape == (4, 4)      # grayscale stays single-channel
+
+
+def test_truncated_file_raises_value_error(tmp_path):
+    p = str(tmp_path / "t.tfrecords")
+    write_tfrecord_file(p, [b"abcdef"])
+    blob = open(p, "rb").read()
+    open(p, "wb").write(blob[:-3])    # cut inside the trailing crc
+    with pytest.raises(ValueError, match="truncated"):
+        list(read_tfrecord_file(p))
+    # verify_crc=False still detects truncation (structure, not sums)
+    with pytest.raises(ValueError, match="truncated"):
+        list(read_tfrecord_file(p, verify_crc=False))
+
+
+def test_explicitly_named_non_image_file_is_read(cluster, tmp_path):
+    from PIL import Image
+    # a real image saved under a non-image extension, named EXPLICITLY
+    p = tmp_path / "weird.blob"
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(p, format="PNG")
+    ds = rdata.read_images([str(p)])
+    assert ds.take(1)[0]["image"].shape == (4, 4, 3)
