@@ -16,9 +16,13 @@ cached by content-hash URI).  Here the same shape, node-local:
   index egress), later users reuse the cached env, and workers prepend
   the env's site-packages to ``sys.path``.  Creation is concurrency-safe
   (atomic rename of a staging dir).
-* **conda / container** validate but raise: neither a conda binary nor
-  a container runtime exists in this image; the error says so instead
-  of failing deep in a worker.
+* **conda** translates an ``environment.yml``-shaped spec onto the same
+  venv machinery: its pip dependencies install offline into an isolated
+  cached venv, python-version pins are checked against the node
+  interpreter, and conda-ONLY packages fail loudly at validation (no
+  conda binary ships in this image).
+* **container** validates but raises: no container runtime exists in
+  this image; the error says so instead of failing deep in a worker.
 """
 
 from __future__ import annotations
@@ -38,15 +42,21 @@ SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
              "container"}
 
 
-def validate(env: Dict[str, Any]) -> None:
+def validate(env: Dict[str, Any],
+             _conda_pretranslated: bool = False) -> None:
     unknown = set(env) - SUPPORTED
     if unknown:
         raise ValueError(f"unsupported runtime_env keys: {sorted(unknown)}")
-    if env.get("conda"):
-        raise RuntimeError(
-            "conda runtime envs need a conda binary, which this image "
-            "does not ship; use the pip plugin (offline wheels) or "
-            "pre-bake dependencies")
+    if env.get("conda") is not None and env.get("pip") is not None:
+        # the reference rejects the combination too: two isolated envs
+        # on one sys.path would silently shadow each other's versions
+        raise ValueError(
+            "runtime_env cannot specify both 'pip' and 'conda'; put "
+            "all pip dependencies inside the conda spec's pip entry")
+    if env.get("conda") is not None and not _conda_pretranslated:
+        # translation-validate eagerly so errors surface at submission,
+        # not deep inside a worker
+        conda_to_pip(env["conda"])
     if env.get("container"):
         raise RuntimeError(
             "container runtime envs need a container runtime, which this "
@@ -59,6 +69,98 @@ def validate(env: Dict[str, Any]) -> None:
                 "pip runtime envs install OFFLINE (no package-index "
                 "egress): provide {'packages': [...], 'find_links': "
                 "'<dir with wheels>'}")
+
+
+# --------------------------------------------------------------- conda plugin
+
+def conda_to_pip(conda: Any) -> Dict[str, Any]:
+    """Translate a conda environment spec into this node's venv/pip
+    machinery (reference: `_private/runtime_env/conda.py` builds a real
+    conda env; this image ships no conda binary, so the spec's PIP
+    dependencies install into an isolated venv and conda-only packages
+    fail loudly at validation).
+
+    Accepted forms: a conda ``environment.yml``-shaped dict, or a path
+    to such a YAML file.  Named pre-existing conda envs need the conda
+    binary and raise.  Because installs are offline, a spec with pip
+    dependencies must carry ``find_links`` (a directory of wheels) —
+    either at top level or inside the pip entry dict."""
+    if isinstance(conda, str):
+        if conda.endswith((".yml", ".yaml")):
+            import yaml
+            with open(conda) as f:
+                conda = yaml.safe_load(f)
+        else:
+            raise RuntimeError(
+                f"conda runtime env names a pre-existing env "
+                f"({conda!r}), which needs a conda binary this image "
+                f"does not ship; pass an environment.yml dict/path "
+                f"with pip dependencies instead")
+    if not isinstance(conda, dict):
+        raise ValueError(f"conda spec must be a dict or YAML path, "
+                         f"got {type(conda)}")
+    import re
+
+    packages: List[str] = []
+    find_links = conda.get("find_links")
+    host_py = f"{sys.version_info.major}.{sys.version_info.minor}"
+    host_tuple = (sys.version_info.major, sys.version_info.minor)
+    for dep in conda.get("dependencies", []):
+        if isinstance(dep, dict):
+            if set(dep) - {"pip", "find_links"}:
+                raise RuntimeError(
+                    f"conda-only dependency group {sorted(set(dep))} "
+                    f"needs a conda binary; ship wheels via the pip "
+                    f"entry instead")
+            packages.extend(dep.get("pip", []))
+            if dep.get("find_links"):
+                find_links = dep["find_links"]
+            continue
+        name = str(dep)
+        # split at the first comparator; conda build strings
+        # (name=version=build) keep only the version part
+        m = re.match(r"^([A-Za-z0-9_.-]+)\s*(==|>=|<=|=|>|<|~=)?\s*"
+                     r"([^=]*)", name)
+        base, op, ver = m.group(1), m.group(2) or "=", \
+            m.group(3).strip().rstrip("*").rstrip(".")
+        if base == "python":
+            if not ver:
+                continue
+            parts = tuple(int(p) for p in ver.split(".")[:2]
+                          if p.isdigit())
+            exact_ok = (host_py == ver
+                        or host_py.startswith(ver + ".")
+                        or ver.startswith(host_py + "."))
+            if op in ("=", "=="):
+                compatible = exact_ok
+            elif op in (">=", ">"):
+                compatible = host_tuple >= parts
+            elif op in ("<=", "<"):
+                compatible = host_tuple <= parts
+            else:            # ~= etc.: same major.minor family
+                compatible = exact_ok
+            if not compatible:
+                raise RuntimeError(
+                    f"conda spec pins python{op}{ver} but this node "
+                    f"runs {host_py}; venv-backed envs share the node "
+                    f"interpreter")
+            continue
+        if base in ("pip", "setuptools", "wheel"):
+            continue
+        raise RuntimeError(
+            f"conda-only dependency {name!r} needs a conda binary, "
+            f"which this image does not ship; if a wheel exists, move "
+            f"it under the spec's pip entry with find_links")
+    if packages and not find_links:
+        raise RuntimeError(
+            "conda runtime envs install pip dependencies OFFLINE: add "
+            "find_links: '<dir with wheels>' to the spec")
+    return {"packages": packages, "find_links": find_links}
+
+
+def ensure_conda_env(conda: Any) -> str:
+    """Create-or-reuse the venv backing a conda spec; → site-packages."""
+    return ensure_pip_env(conda_to_pip(conda))
 
 
 # ----------------------------------------------------------------- pip plugin
@@ -150,7 +252,11 @@ def delete_uri(uri: str) -> bool:
 
 def apply(env: Dict[str, Any]) -> Dict[str, Any]:
     """Apply; returns an undo record for `restore`."""
-    validate(env)
+    # translate conda ONCE (validate would otherwise re-read a YAML
+    # path a second time, with a TOCTOU window between the reads)
+    conda_spec = conda_to_pip(env["conda"]) \
+        if env.get("conda") is not None else None
+    validate(env, _conda_pretranslated=conda_spec is not None)
     undo: Dict[str, Any] = {"env_vars": {}, "cwd": None, "sys_path": None}
     for k, v in (env.get("env_vars") or {}).items():
         undo["env_vars"][k] = os.environ.get(k)
@@ -163,6 +269,8 @@ def apply(env: Dict[str, Any]) -> Dict[str, Any]:
     pip = env.get("pip")
     if pip is not None:
         mods.append(ensure_pip_env(pip))
+    if conda_spec is not None:
+        mods.append(ensure_pip_env(conda_spec))
     if mods:
         undo["sys_path"] = list(sys.path)
         # sys.path restore alone is not isolation: modules imported FROM
