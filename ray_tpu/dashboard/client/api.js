@@ -1,0 +1,46 @@
+// REST wrapper for the head's /api surface (head.py routes).
+export async function get(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+  const ct = r.headers.get("content-type") || "";
+  return ct.includes("json") ? r.json() : r.text();
+}
+export const api = {
+  summary: () => get("/api/cluster_summary"),
+  nodes: () => get("/api/nodes"),
+  actors: () => get("/api/actors"),
+  tasks: () => get("/api/tasks"),
+  jobs: () => get("/api/jobs"),
+  memory: () => get("/api/memory"),
+  objects: () => get("/api/objects"),
+  pgs: () => get("/api/placement_groups"),
+  events: () => get("/api/events"),
+  agents: () => get("/api/agents"),
+  agentStats: () => get("/api/agent_stats"),
+  logsList: () => get("/api/logs"),
+  timeline: () => get("/api/timeline"),
+  serveApps: () => get("/api/serve/applications"),
+  serveDeployments: () => get("/api/serve/deployments"),
+  metricsCluster: () => get("/metrics/cluster"),
+};
+export function esc(s) {
+  // server payloads carry user-controlled strings (job entrypoints,
+  // event messages, task names) — always escape before innerHTML
+  return String(s).replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;" }[c]));
+}
+export function table(el, rows, cols) {
+  if (!rows || !rows.length) { el.innerHTML = "<tr><td>(none)</td></tr>"; return; }
+  cols = cols || Object.keys(rows[0]);
+  el.innerHTML =
+    "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c => {
+      let v = r[c];
+      if (typeof v === "object" && v !== null) v = JSON.stringify(v);
+      if (v === undefined || v === null) v = "";
+      const cls = v === "ALIVE" || v === "RUNNING" || v === "ok"
+        ? "ok" : (v === "DEAD" || v === "FAILED" ? "bad" : "");
+      return `<td class="${cls}">${esc(String(v).slice(0, 200))}</td>`;
+    }).join("") + "</tr>").join("");
+}

@@ -1,0 +1,62 @@
+// Tab router + polling driver.  Each view module exports
+// {title, render(root), refresh(root)}; refresh polls while visible.
+import * as overview from "/static/views/overview.js";
+import * as jobs from "/static/views/jobs.js";
+import * as logs from "/static/views/logs.js";
+import * as timeline from "/static/views/timeline.js";
+import * as serve from "/static/views/serve.js";
+import * as events from "/static/views/events.js";
+import * as agents from "/static/views/agents.js";
+import * as metrics from "/static/views/metrics.js";
+
+const VIEWS = { overview, jobs, logs, timeline, serve, events, agents,
+                metrics };
+const nav = document.getElementById("nav");
+const root = document.getElementById("root");
+const err = document.getElementById("err");
+let current = location.hash.slice(1) || "overview";
+if (!VIEWS[current]) current = "overview";
+
+for (const name of Object.keys(VIEWS)) {
+  const b = document.createElement("button");
+  b.textContent = VIEWS[name].title || name;
+  b.dataset.v = name;
+  b.onclick = () => show(name);
+  nav.appendChild(b);
+}
+
+let gen = 0;          // invalidates in-flight refreshes on tab switch
+let busy = false;     // one refresh at a time (no 2s-interval stacking)
+
+async function show(name) {
+  current = name;
+  gen += 1;
+  location.hash = name;
+  for (const b of nav.children)
+    b.classList.toggle("active", b.dataset.v === name);
+  root.innerHTML = "";
+  VIEWS[name].render(root);
+  await tick();
+}
+
+async function tick() {
+  if (busy) return;
+  busy = true;
+  const myGen = gen;
+  try {
+    await VIEWS[current].refresh(root);
+    if (myGen === gen) err.textContent = "";
+  } catch (e) {
+    // a refresh raced a tab switch: its DOM is gone, not an error
+    if (myGen === gen) err.textContent = String(e);
+  } finally { busy = false; }
+}
+
+setInterval(() => {
+  if (document.getElementById("auto").checked) tick();
+}, 2000);
+window.addEventListener("hashchange", () => {
+  const name = location.hash.slice(1);
+  if (VIEWS[name] && name !== current) show(name);
+});
+show(current);

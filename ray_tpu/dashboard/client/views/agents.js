@@ -1,0 +1,16 @@
+import { api, table } from "/static/api.js";
+export const title = "agents";
+export function render(root) {
+  root.innerHTML = `<h2>per-node dashboard agents</h2>
+    <table id="list"></table>
+    <h2>node OS stats (agent-served, nodelet fallback)</h2>
+    <table id="stats"></table>`;
+}
+export async function refresh(root) {
+  const [agents, stats] = await Promise.all([
+    api.agents(), api.agentStats()]);
+  table(root.querySelector("#list"), agents);
+  table(root.querySelector("#stats"),
+        Array.isArray(stats) ? stats : Object.entries(stats).map(
+          ([node, s]) => ({ node, ...s })));
+}

@@ -1,0 +1,9 @@
+import { api, table } from "/static/api.js";
+export const title = "events";
+export function render(root) {
+  root.innerHTML = `<h2>cluster events</h2><table id="ev"></table>`;
+}
+export async function refresh(root) {
+  const ev = await api.events();
+  table(root.querySelector("#ev"), ev.slice(-200).reverse());
+}

@@ -1,0 +1,26 @@
+import { api, table } from "/static/api.js";
+export const title = "jobs";
+export function render(root) {
+  root.innerHTML = `<h2>jobs</h2><table id="jobs"></table>
+    <h2>job logs <select id="jobsel"></select></h2><pre id="jlog">(pick)</pre>`;
+  root.querySelector("#jobsel").onchange = async (e) => {
+    const id = e.target.value;
+    if (!id) return;
+    const r = await fetch(`/api/jobs/${id}/logs`);
+    root.querySelector("#jlog").textContent = await r.text();
+  };
+}
+export async function refresh(root) {
+  const jobs = await api.jobs();
+  table(root.querySelector("#jobs"), jobs);
+  const sel = root.querySelector("#jobsel");
+  const have = new Set([...sel.options].map(o => o.value));
+  for (const j of jobs) {
+    const id = j.job_id || j.submission_id || j.id;
+    if (id && !have.has(id)) {
+      const o = document.createElement("option");
+      o.value = o.textContent = id;
+      sel.appendChild(o);
+    }
+  }
+}

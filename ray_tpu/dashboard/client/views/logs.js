@@ -1,0 +1,29 @@
+import { get } from "/static/api.js";
+export const title = "logs";
+export function render(root) {
+  root.innerHTML = `<h2>logs <select id="file"></select>
+    bytes <input type="text" id="nbytes" value="65536" size="7"></h2>
+    <pre id="body">(pick a file)</pre>`;
+  root.querySelector("#file").onchange = () => tail(root);
+}
+async function tail(root) {
+  const name = root.querySelector("#file").value;
+  if (!name) return;
+  const nbytes = root.querySelector("#nbytes").value || 65536;
+  const out = await get(
+    `/api/logs/tail?name=${encodeURIComponent(name)}&bytes=${nbytes}`);
+  root.querySelector("#body").textContent =
+    typeof out === "string" ? out : JSON.stringify(out);
+}
+export async function refresh(root) {
+  const sel = root.querySelector("#file");
+  if (!sel.options.length) {
+    // /api/logs returns a flat filename list for the head's node
+    const files = await get("/api/logs");
+    for (const f of files) {
+      const o = document.createElement("option");
+      o.value = o.textContent = f;
+      sel.appendChild(o);
+    }
+  } else if (sel.value) await tail(root);
+}

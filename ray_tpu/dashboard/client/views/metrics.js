@@ -1,0 +1,19 @@
+import { api } from "/static/api.js";
+export const title = "metrics";
+export function render(root) {
+  root.innerHTML = `<h2>cluster metrics (Prometheus exposition)</h2>
+    <input type="text" id="filter" placeholder="filter...">
+    <pre id="body"></pre>`;
+  root.querySelector("#filter").oninput = () => show(root);
+}
+let raw = "";
+function show(root) {
+  const f = root.querySelector("#filter").value;
+  root.querySelector("#body").textContent = f
+    ? raw.split("\n").filter(l => l.includes(f)).join("\n") : raw;
+}
+export async function refresh(root) {
+  raw = await api.metricsCluster();
+  if (typeof raw !== "string") raw = JSON.stringify(raw, null, 2);
+  show(root);
+}

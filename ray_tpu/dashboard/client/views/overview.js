@@ -1,0 +1,27 @@
+import { api, table } from "/static/api.js";
+export const title = "overview";
+export function render(root) {
+  root.innerHTML = `
+    <div class="cards" id="cards"></div>
+    <h2>nodes</h2><table id="nodes"></table>
+    <h2>running tasks</h2><table id="tasks"></table>
+    <h2>actors</h2><table id="actors"></table>
+    <h2>placement groups</h2><table id="pgs"></table>
+    <h2>object store</h2><table id="stores"></table>`;
+}
+export async function refresh(root) {
+  const [s, nodes, tasks, actors, pgs, mem] = await Promise.all([
+    api.summary(), api.nodes(), api.tasks(), api.actors(), api.pgs(),
+    api.memory()]);
+  const cards = Object.entries(s).filter(([, v]) => typeof v !== "object");
+  root.querySelector("#cards").innerHTML = cards.map(([k, v]) =>
+    `<div class="card"><div class="v">${v}</div>
+     <div class="k">${k}</div></div>`).join("");
+  table(root.querySelector("#nodes"), nodes);
+  table(root.querySelector("#tasks"), tasks);
+  table(root.querySelector("#actors"), actors);
+  table(root.querySelector("#pgs"), pgs);
+  const stores = (mem && mem.stores) || (mem && mem.nodes) || [];
+  table(root.querySelector("#stores"),
+        Array.isArray(stores) ? stores : [mem]);
+}

@@ -1,0 +1,15 @@
+import { api, table } from "/static/api.js";
+export const title = "serve";
+export function render(root) {
+  root.innerHTML = `<h2>applications</h2><pre id="apps"></pre>
+    <h2>deployments</h2><table id="deps"></table>`;
+}
+export async function refresh(root) {
+  const [apps, deps] = await Promise.all([
+    api.serveApps().catch(() => ({})),
+    api.serveDeployments().catch(() => [])]);
+  root.querySelector("#apps").textContent =
+    JSON.stringify(apps, null, 2);
+  table(root.querySelector("#deps"),
+        Array.isArray(deps) ? deps : (deps.deployments || []));
+}

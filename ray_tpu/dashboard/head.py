@@ -38,11 +38,18 @@ class DashboardHead:
                 try:
                     body = await loop.run_in_executor(
                         self._pool, fn, request)
+                    if isinstance(body, str):
+                        return web.Response(text=body)
+                    # default=str: state payloads carry bytes ids and
+                    # other non-JSON scalars; a serialization failure
+                    # here must surface as a JSON error, not aiohttp's
+                    # bare 500 page (it used to escape this handler)
+                    return web.Response(
+                        text=json.dumps(body, default=str),
+                        content_type="application/json")
                 except Exception as e:
-                    return web.json_response({"error": str(e)}, status=500)
-                if isinstance(body, str):
-                    return web.Response(text=body)
-                return web.json_response(body)
+                    return web.json_response({"error": str(e)},
+                                             status=500)
             return handler
 
         def nodes(_):
@@ -138,16 +145,29 @@ class DashboardHead:
 
         def memory(_):
             from .. import state
-            m = state.memory_summary()
-            # refs values contain non-JSON types (hex-keyed dicts are fine)
-            return json.loads(json.dumps(m, default=str))
+            # blocking() serializes with default=str; no pre-sanitizing
+            # round-trip needed
+            return state.memory_summary()
+
+        import os
+
+        client_dir = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "client")
 
         async def index(_):
+            # the modular client (client/ static app, the reference's
+            # dashboard/client analogue) when present; the single-file
+            # fallback keeps the dashboard alive in stripped installs
+            page = os.path.join(client_dir, "index.html")
+            if os.path.isfile(page):
+                return web.FileResponse(page)
             from .index_html import INDEX_HTML
             return web.Response(text=INDEX_HTML, content_type="text/html")
 
         app = web.Application()
         app.router.add_get("/", index)
+        if os.path.isdir(client_dir):
+            app.router.add_static("/static", client_dir)
         app.router.add_get("/api/nodes/{node_id}/stats",
                            blocking(node_stats))
         def events(_):

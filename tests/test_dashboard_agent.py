@@ -113,10 +113,15 @@ def test_dashboard_html_has_agents_tab(agent_cluster, free_tcp_port):
     from ray_tpu.dashboard import start_dashboard
     _wait_for_agents()
     head = start_dashboard(port=free_tcp_port)
-    html = urllib.request.urlopen(head.address + "/",
-                                  timeout=15).read().decode()
-    assert 'data-v="agents"' in html
-    assert "refreshAgents" in html and "/api/agent_stats" in html
+    # tabs are built client-side: the agents module ships as a static
+    # asset and polls /api/agent_stats
+    agents_js = urllib.request.urlopen(
+        head.address + "/static/views/agents.js",
+        timeout=15).read().decode()
+    assert "agentStats" in agents_js
+    app_js = urllib.request.urlopen(
+        head.address + "/static/app.js", timeout=15).read().decode()
+    assert "views/agents.js" in app_js
     stats = json.loads(urllib.request.urlopen(
         head.address + "/api/agent_stats", timeout=15).read())
     assert stats and stats[0]["agent_pid"] > 0

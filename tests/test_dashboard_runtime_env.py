@@ -63,9 +63,10 @@ def test_dashboard_logs_timeline_metrics(dashboard):
         [e.get("name") for e in spans][:10]
     text = requests.get(f"{addr}/metrics/cluster", timeout=20).text
     assert "ray_tpu_tasks_finished_total" in text
-    page = requests.get(addr, timeout=10).text
+    # tabs are built client-side now: the module set lives in app.js
+    app_js = requests.get(addr + "/static/app.js", timeout=10).text
     for tab in ("timeline", "serve", "metrics", "logs"):
-        assert f'data-v="{tab}"' in page
+        assert f"views/{tab}.js" in app_js
 
 
 def test_dashboard_job_flow(dashboard):
@@ -301,3 +302,27 @@ def test_runtime_env_conda_comparators_and_exclusivity():
     # pip + conda together is rejected at validation
     with _pytest.raises(ValueError, match="both"):
         re_mod.validate({"pip": ["a"], "conda": {"dependencies": []}})
+
+
+def test_dashboard_modular_client(dashboard):
+    """The client/ static app serves at / with every module asset
+    (reference analogue: dashboard/client single-page app)."""
+    addr = dashboard.address
+    index = requests.get(addr + "/", timeout=10)
+    assert index.status_code == 200
+    assert "/static/app.js" in index.text
+    for asset in ("style.css", "api.js", "app.js", "views/overview.js",
+                  "views/jobs.js", "views/logs.js", "views/timeline.js",
+                  "views/serve.js", "views/events.js", "views/agents.js",
+                  "views/metrics.js"):
+        r = requests.get(f"{addr}/static/{asset}", timeout=10)
+        assert r.status_code == 200, asset
+        assert len(r.text) > 50, asset
+    # every endpoint the client polls answers JSON-cleanly (the actors
+    # route used to 500 on bytes ids escaping the handler's try block)
+    for ep in ("/api/cluster_summary", "/api/nodes", "/api/tasks",
+               "/api/actors", "/api/placement_groups", "/api/memory",
+               "/api/jobs", "/api/events", "/api/agents",
+               "/api/agent_stats", "/api/logs", "/api/timeline"):
+        r = requests.get(addr + ep, timeout=10)
+        assert r.status_code == 200, (ep, r.text[:100])
