@@ -59,6 +59,7 @@ from .env import (  # noqa: F401
 from .es import ARS, ARSConfig, ES, ESConfig  # noqa: F401
 from .impala import APPOConfig, Impala, ImpalaConfig  # noqa: F401
 from .sac import SAC, SACConfig  # noqa: F401
+from .slateq import RecSlateEnv, SlateQ, SlateQConfig  # noqa: F401
 from .td3 import DDPG, DDPGConfig, TD3, TD3Config  # noqa: F401
 from .offline import (  # noqa: F401
     BC,

@@ -1,0 +1,92 @@
+"""SlateQ tests (reference: rllib/algorithms/slateq/ — decomposed
+slate Q-learning over a RecSim-style choice-model env)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import RecSlateEnv, SlateQConfig
+
+
+def test_env_contract():
+    env = RecSlateEnv()
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    assert obs["user"].shape == (env.n_topics,)
+    assert obs["topics"].shape == (env.n_candidates, env.n_topics)
+    slate = jnp.array([0, 1, 2])
+    state, obs, r, d, pick = env.step(state, slate,
+                                      jax.random.PRNGKey(1))
+    assert 0 <= int(pick) <= env.slate_size    # slot or no-click
+    assert not bool(d)
+
+
+def test_decomposed_value_matches_choice_model():
+    """slate value = sum_i P(click i | slate) * Q_i under the MNL user
+    model — verify against a hand computation."""
+    algo = SlateQConfig(seed=0).build()
+    env = algo.env
+    key = jax.random.PRNGKey(3)
+    state, obs = env.reset(key)
+    q = algo._q_items(algo.params, obs["user"], obs["topics"],
+                      obs["quality"])
+    slate = jnp.array([4, 7, 9])
+    v = float(algo._slate_value(q, obs["user"], obs["topics"], slate))
+    logits = np.asarray(obs["topics"][slate] @ obs["user"])
+    full = np.concatenate([logits, [env.no_click_logit]])
+    p = np.exp(full) / np.exp(full).sum()
+    expect = float((p[:3] * np.asarray(q)[slate]).sum())
+    assert v == pytest.approx(expect, rel=1e-5)
+
+
+def test_slateq_beats_myopic_quality():
+    """In the reluctant-user regime (high no-click logit) showing the
+    highest-quality docs regardless of appeal underperforms; the
+    learned choice-weighted item Q must beat it (measured: random
+    2.2, top-quality 3.9, slateq ~4.3 after 90 iters)."""
+    env_f = lambda: RecSlateEnv(no_click_logit=3.0)  # noqa: E731
+    algo = SlateQConfig(env=env_f, num_envs=16, rollout_steps=32,
+                        batch_size=128, num_updates=16, learn_start=512,
+                        eps_decay_steps=6000, seed=0).build()
+    rs = [algo.train()["episode_reward_mean"] for _ in range(90)]
+    first = float(np.mean(rs[5:15]))
+    last = float(np.mean(rs[-10:]))
+    assert last > first + 0.5, (first, last)
+    assert last > 3.9, last          # above the top-quality heuristic
+
+
+def test_slateq_checkpoint_roundtrip():
+    algo = SlateQConfig(num_envs=4, rollout_steps=8,
+                        buffer_capacity=512, learn_start=32).build()
+    algo.train()
+    state = algo.get_state()
+    algo2 = SlateQConfig(num_envs=4, rollout_steps=8,
+                         buffer_capacity=512, learn_start=32).build()
+    algo2.set_state(state)
+    for a, b in zip(jax.tree_util.tree_leaves(algo.params),
+                    jax.tree_util.tree_leaves(algo2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_best_slate_is_exact_optimum():
+    """_best_slate must dominate ANY heuristic ranking under the
+    decomposed value (it enumerates; heuristics like additive Q+logit
+    provably mis-rank when a high-logit item shifts the shared
+    denominator)."""
+    algo = SlateQConfig(seed=1).build()
+    env = algo.env
+    state, obs = env.reset(jax.random.PRNGKey(9))
+    q = algo._q_items(algo.params, obs["user"], obs["topics"],
+                      obs["quality"])
+    best = algo._best_slate(q, obs["user"], obs["topics"])
+    v_best = float(algo._slate_value(q, obs["user"], obs["topics"],
+                                     best))
+    for heuristic in (
+            jax.lax.top_k(q, env.slate_size)[1],
+            jax.lax.top_k(q + obs["topics"] @ obs["user"],
+                          env.slate_size)[1],
+            jnp.arange(env.slate_size)):
+        v_h = float(algo._slate_value(q, obs["user"], obs["topics"],
+                                      heuristic))
+        assert v_best >= v_h - 1e-6, (v_best, v_h)
