@@ -39,6 +39,7 @@ from .dqn import (  # noqa: F401
     SimpleQConfig,
 )
 from .pg import PG, PGConfig  # noqa: F401
+from .dreamer import Dreamer, DreamerConfig  # noqa: F401
 from .dt import DT, DTConfig  # noqa: F401
 from .maml import MAML, MAMLConfig  # noqa: F401
 from .maddpg import (  # noqa: F401

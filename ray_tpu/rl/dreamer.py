@@ -1,0 +1,480 @@
+"""Dreamer: model-based RL — learn a latent world model, act in
+imagination.
+
+Capability mirror of the reference's Dreamer
+(`rllib/algorithms/dreamer/dreamer.py` — RSSM world model trained on
+replayed sequences; actor and value learned from imagined latent
+rollouts).  TPU-first shape: the RSSM posterior scan over replay
+sequences, the KL-balanced world-model loss, the H-step imagination
+scan, and the λ-return actor-critic updates all compile into ONE XLA
+program per iteration; collection threads the (h, z) latent through the
+vectorized env scan like r2d2.py threads its LSTM state.
+
+Vector-observation variant (the reference's is image-based with conv
+encoders): encoder/decoder are MLPs, the stochastic latent is Gaussian,
+and the discrete-action actor trains with REINFORCE on imagined
+λ-returns (the DreamerV2 discrete recipe) while the critic regresses
+λ-returns with a slow target copy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import replay
+from .algorithm import Algorithm
+from .env import JaxEnv
+from .policy import mlp_apply, mlp_init
+
+
+def _elu_mlp(params, x):
+    return mlp_apply(params, x, activation=jax.nn.elu)
+
+
+@dataclasses.dataclass
+class DreamerConfig:
+    env: Optional[Callable[[], JaxEnv]] = None
+    num_envs: int = 16
+    seq_len: int = 16              # collected/model-training sequence
+    buffer_capacity: int = 2048    # in sequences
+    batch_size: int = 16           # sequences per model update
+    model_updates: int = 4         # world-model steps per iteration
+    ac_updates: int = 4            # actor-critic steps per iteration
+    horizon: int = 12              # imagination length
+    deter_size: int = 96           # GRU state
+    stoch_size: int = 24           # Gaussian latent
+    hidden: int = 96               # MLP widths
+    gamma: float = 0.98
+    lam: float = 0.95              # λ-returns
+    free_nats: float = 1.0         # KL floor
+    kl_balance: float = 0.8        # posterior/prior KL mixing
+    model_lr: float = 3e-4
+    actor_lr: float = 1e-4
+    critic_lr: float = 3e-4
+    entropy_coeff: float = 3e-3
+    critic_tau: float = 0.02       # slow-target rate
+    learn_start: int = 16          # sequences before updates
+    seed: int = 0
+
+    def build(self) -> "Dreamer":
+        return Dreamer(self)
+
+
+class Dreamer(Algorithm):
+    _config_cls = DreamerConfig
+
+    def __init__(self, config: DreamerConfig):
+        super().__init__(config)
+        cfg = config
+        if cfg.env is None:
+            raise ValueError("DreamerConfig.env required")
+        self.env = cfg.env()
+        if not self.env.discrete:
+            raise ValueError("this Dreamer variant is discrete-action "
+                             "(continuous needs pathwise imagination "
+                             "gradients — a tanh-Normal actor swap)")
+        obs_dim, n_act = self.env.observation_size, self.env.action_size
+        self.n_act = n_act
+        D, S, H = cfg.deter_size, cfg.stoch_size, cfg.hidden
+        key = jax.random.PRNGKey(cfg.seed)
+        keys = jax.random.split(key, 12)
+        in_dim = S + n_act + D
+        k_ru, k_c = jax.random.split(keys[0])
+        self.params = {
+            # RSSM (standard GRU: reset/update gates, candidate on r*h)
+            "gru": {
+                "w_ru": jax.random.normal(
+                    k_ru, (in_dim, 2 * D)) / np.sqrt(in_dim),
+                "b_ru": jnp.zeros((2 * D,)),
+                "w_c": jax.random.normal(
+                    k_c, (in_dim, D)) / np.sqrt(in_dim),
+                "b_c": jnp.zeros((D,)),
+            },
+            "prior": mlp_init(keys[1], (D, H, 2 * S)),
+            "post": mlp_init(keys[2], (D + obs_dim, H, 2 * S)),
+            # heads
+            "decoder": mlp_init(keys[3], (D + S, H, obs_dim)),
+            "reward": mlp_init(keys[4], (D + S, H, 1)),
+            "cont": mlp_init(keys[5], (D + S, H, 1)),
+        }
+        self.actor_params = mlp_init(keys[6], (D + S, H, H, n_act))
+        self.critic_params = mlp_init(keys[7], (D + S, H, H, 1))
+        self.critic_target = jax.tree_util.tree_map(
+            lambda x: x, self.critic_params)
+        self.model_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                     optax.adam(cfg.model_lr))
+        self.actor_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                     optax.adam(cfg.actor_lr))
+        self.critic_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                      optax.adam(cfg.critic_lr))
+        self.model_opt_state = self.model_opt.init(self.params)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.critic_opt_state = self.critic_opt.init(self.critic_params)
+        T = cfg.seq_len
+        self.buffer = replay.init(cfg.buffer_capacity, {
+            "obs": jnp.zeros((T, obs_dim), jnp.float32),
+            "action": jnp.zeros((T,), jnp.int32),
+            "reward": jnp.zeros((T,), jnp.float32),
+            "done": jnp.zeros((T,), jnp.float32),
+        })
+        key, ekey = jax.random.split(keys[11])
+        ekeys = jax.random.split(ekey, cfg.num_envs)
+        self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
+        self.h = jnp.zeros((cfg.num_envs, D))
+        self.key = key
+        self._train_iter = jax.jit(self._make_train_iter())
+        self._init_episode_tracking(cfg.num_envs)
+
+    # -- RSSM pieces ---------------------------------------------------------
+    def _gru(self, p, x, h):
+        D = h.shape[-1]
+        ru = jnp.concatenate([x, h], -1) @ p["w_ru"] + p["b_ru"]
+        r = jax.nn.sigmoid(ru[..., :D])
+        u = jax.nn.sigmoid(ru[..., D:])
+        cand = jnp.tanh(
+            jnp.concatenate([x, r * h], -1) @ p["w_c"] + p["b_c"])
+        return u * h + (1 - u) * cand
+
+    def _step_deter(self, params, z, a_onehot, h):
+        x = jnp.concatenate([z, a_onehot], -1)
+        return self._gru(params["gru"], x, h)
+
+    @staticmethod
+    def _gauss(stats):
+        mean, std_raw = jnp.split(stats, 2, -1)
+        std = jax.nn.softplus(std_raw) + 0.1
+        return mean, std
+
+    def _prior(self, params, h):
+        return self._gauss(_elu_mlp(params["prior"], h))
+
+    def _post(self, params, h, obs):
+        return self._gauss(_elu_mlp(
+            params["post"], jnp.concatenate([h, obs], -1)))
+
+    def _feat(self, h, z):
+        return jnp.concatenate([h, z], -1)
+
+    def _actor_logits(self, actor_params, feat):
+        return _elu_mlp(actor_params, feat)
+
+    # -- the compiled iteration ---------------------------------------------
+    def _make_train_iter(self):
+        cfg, env = self.config, self.env
+        n_act = self.n_act
+        T, Hrz = cfg.seq_len, cfg.horizon
+
+        def observe_seq(params, obs_seq, act_seq, done_seq, key):
+            """Posterior scan over ONE sequence: [T, ...] → features,
+            KL, reconstruction stats (batched via vmap outside)."""
+            D = cfg.deter_size
+
+            def step(carry, inp):
+                h, z, key = carry
+                obs, prev_a, prev_done = inp
+                # episode boundary: reset latent like collection does
+                keep = (1.0 - prev_done)[..., None]
+                h, z = h * keep, z * keep
+                # h_t is advanced with the PREVIOUS action — the same
+                # alignment collection uses (h paired with obs_t was
+                # stepped with a_{t-1}); feeding a_t here would train
+                # the posterior one action ahead of inference time
+                a_onehot = jax.nn.one_hot(prev_a, n_act)
+                h = self._step_deter(params, z, a_onehot, h)
+                pm, ps = self._prior(params, h)
+                qm, qs = self._post(params, h, obs)
+                key, zkey = jax.random.split(key)
+                z = qm + qs * jax.random.normal(zkey, qm.shape)
+                # balanced KL(q||p), diagonal Gaussians
+                def kl(m1, s1, m2, s2):
+                    return (jnp.log(s2 / s1) + (s1 ** 2 + (m1 - m2) ** 2)
+                            / (2 * s2 ** 2) - 0.5).sum(-1)
+                kl_post = kl(qm, qs, jax.lax.stop_gradient(pm),
+                             jax.lax.stop_gradient(ps))
+                kl_prior = kl(jax.lax.stop_gradient(qm),
+                              jax.lax.stop_gradient(qs), pm, ps)
+                kl_val = cfg.kl_balance * kl_prior \
+                    + (1 - cfg.kl_balance) * kl_post
+                return (h, z, key), (h, z, kl_val)
+
+            # prev_*: the action/done that PRECEDED each observation
+            prev_done = jnp.concatenate(
+                [jnp.zeros((1,)), done_seq[:-1]])
+            prev_act = jnp.concatenate(
+                [jnp.zeros((1,), act_seq.dtype), act_seq[:-1]])
+            (h, z, key), (hs, zs, kls) = jax.lax.scan(
+                step, (jnp.zeros((D,)), jnp.zeros((cfg.stoch_size,)),
+                       key), (obs_seq, prev_act, prev_done))
+            return hs, zs, kls
+
+        def model_loss(params, batch, key):
+            keys = jax.random.split(key, batch["obs"].shape[0])
+            hs, zs, kls = jax.vmap(
+                lambda o, a, d, k: observe_seq(params, o, a, d, k))(
+                    batch["obs"], batch["action"], batch["done"], keys)
+            feat = self._feat(hs, zs)                     # [B, T, D+S]
+            recon = _elu_mlp(params["decoder"], feat)
+            r_hat = _elu_mlp(params["reward"], feat)[..., 0]
+            c_logit = _elu_mlp(params["cont"], feat)[..., 0]
+            recon_l = ((recon - batch["obs"]) ** 2).sum(-1).mean()
+            reward_l = ((r_hat - batch["reward"]) ** 2).mean()
+            cont_target = 1.0 - batch["done"]
+            cont_l = optax.sigmoid_binary_cross_entropy(
+                c_logit, cont_target).mean()
+            kl_l = jnp.maximum(kls.mean(), cfg.free_nats)
+            loss = recon_l + reward_l + cont_l + kl_l
+            return loss, (feat, recon_l, kl_l)
+
+        def imagine(params, actor_params, feat0, key):
+            """From flattened posterior features, roll the PRIOR for
+            Hrz steps under the actor. → feats [Hrz+1, N, F], actions,
+            logps, entropies."""
+            D, S = cfg.deter_size, cfg.stoch_size
+            h0 = feat0[..., :D]
+            z0 = feat0[..., D:]
+
+            def step(carry, _):
+                h, z, key = carry
+                feat = self._feat(h, z)
+                logits = self._actor_logits(actor_params, feat)
+                key, akey, zkey = jax.random.split(key, 3)
+                a = jax.random.categorical(akey, logits)
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits), a[..., None],
+                    -1)[..., 0]
+                ent = -(jax.nn.softmax(logits)
+                        * jax.nn.log_softmax(logits)).sum(-1)
+                h = self._step_deter(params, z,
+                                     jax.nn.one_hot(a, n_act), h)
+                pm, ps = self._prior(params, h)
+                z = pm + ps * jax.random.normal(zkey, pm.shape)
+                return (h, z, key), (self._feat(h, z), logp, ent)
+
+            (h, z, key), (feats, logps, ents) = jax.lax.scan(
+                step, (h0, z0, key), None, length=Hrz)
+            feats = jnp.concatenate([feat0[None], feats], 0)
+            return feats, logps, ents
+
+        def lambda_returns(rewards, conts, values):
+            """λ-returns over imagined trajectories: rewards/conts
+            [Hrz, N] for transitions, values [Hrz+1, N]."""
+            def step(nxt, inp):
+                r, c, v_next = inp
+                ret = r + cfg.gamma * c * (
+                    (1 - cfg.lam) * v_next + cfg.lam * nxt)
+                return ret, ret
+
+            _, rets = jax.lax.scan(
+                step, values[-1], (rewards, conts, values[1:]),
+                reverse=True)
+            return rets                                   # [Hrz, N]
+
+        def actor_loss(actor_params, critic_target, params, feat_flat,
+                       key):
+            """REINFORCE on imagined λ-returns; aux carries the
+            imagined data (detached) so the critic trains on the SAME
+            rollouts without re-imagining."""
+            feats, logps, ents = imagine(params, actor_params,
+                                         feat_flat, key)
+            r_im = _elu_mlp(params["reward"], feats[1:])[..., 0]
+            c_im = jax.nn.sigmoid(
+                _elu_mlp(params["cont"], feats[1:])[..., 0])
+            v_t = _elu_mlp(critic_target, feats)[..., 0]
+            rets = lambda_returns(r_im, c_im,
+                                  jax.lax.stop_gradient(v_t))
+            # discount weights: probability the trajectory is alive
+            w = jnp.cumprod(jnp.concatenate(
+                [jnp.ones((1,) + c_im.shape[1:]),
+                 cfg.gamma * c_im[:-1]], 0), 0)
+            w = jax.lax.stop_gradient(w)
+            adv = jax.lax.stop_gradient(rets - v_t[:-1])
+            a_l = -(w * (logps * adv
+                         + cfg.entropy_coeff * ents)).mean()
+            aux = (jax.lax.stop_gradient(feats),
+                   jax.lax.stop_gradient(rets), w)
+            return a_l, aux
+
+        def train_iter(params, actor_params, critic_params,
+                       critic_target, m_opt, a_opt, c_opt, buffer,
+                       env_states, obs, h, key):
+            # ---- collect one sequence per env with the latent actor --
+            def collect(carry, _):
+                env_states, obs, h, key = carry
+                key, zkey, akey, skey = jax.random.split(key, 4)
+                qm, qs = self._post(params, h, obs)
+                zn = qm + qs * jax.random.normal(zkey, qm.shape)
+                logits = self._actor_logits(
+                    actor_params, self._feat(h, zn))
+                a = jax.random.categorical(akey, logits)
+                skeys = jax.random.split(skey, cfg.num_envs)
+                env_states, next_obs, reward, done = jax.vmap(
+                    env.step)(env_states, a, skeys)
+                frame = {"obs": obs, "action": a, "reward": reward,
+                         "done": done}
+                # advance the deterministic state; reset on done
+                h2 = self._step_deter(params, zn,
+                                      jax.nn.one_hot(a, n_act), h)
+                keep = (1.0 - done.astype(jnp.float32))[..., None]
+                return (env_states, next_obs, h2 * keep, key), frame
+
+            (env_states, obs, h, key), traj = jax.lax.scan(
+                collect, (env_states, obs, h, key), None, length=T)
+            rows = {
+                "obs": jnp.swapaxes(traj["obs"], 0, 1),
+                "action": jnp.swapaxes(traj["action"], 0, 1)
+                .astype(jnp.int32),
+                "reward": jnp.swapaxes(traj["reward"], 0, 1)
+                .astype(jnp.float32),
+                "done": jnp.swapaxes(traj["done"], 0, 1)
+                .astype(jnp.float32),
+            }
+            buffer = replay.add_batch(buffer, rows, cfg.num_envs)
+
+            # ---- model + actor-critic updates ------------------------
+            def updates(args):
+                (params, actor_params, critic_params, critic_target,
+                 m_opt, a_opt, c_opt, buffer, key) = args
+
+                feat0 = jnp.zeros(
+                    (cfg.batch_size, T,
+                     cfg.deter_size + cfg.stoch_size))
+
+                def model_step(carry, _):
+                    params, m_opt, key, _feat = carry
+                    key, skey, lkey = jax.random.split(key, 3)
+                    batch, _, skey = replay.sample(buffer, skey,
+                                                   cfg.batch_size)
+                    (loss, (feat, recon_l, kl_l)), grads = \
+                        jax.value_and_grad(model_loss, has_aux=True)(
+                            params, batch, lkey)
+                    upd, m_opt = self.model_opt.update(grads, m_opt,
+                                                       params)
+                    params = optax.apply_updates(params, upd)
+                    # feat rides the CARRY: only the last batch's
+                    # features seed imagination (stacking every
+                    # update's features would hold model_updates
+                    # copies live for nothing)
+                    return (params, m_opt, key, feat), loss
+
+                (params, m_opt, key, feat_last), m_losses = \
+                    jax.lax.scan(model_step,
+                                 (params, m_opt, key, feat0), None,
+                                 length=cfg.model_updates)
+                feat_flat = feat_last.reshape(-1, feat_last.shape[-1])
+
+                def ac_step(carry, _):
+                    (actor_params, critic_params, critic_target, a_opt,
+                     c_opt, key) = carry
+                    key, ikey = jax.random.split(key)
+                    (a_l, (feats, rets, w)), a_grads = \
+                        jax.value_and_grad(actor_loss, has_aux=True)(
+                            actor_params, critic_target, params,
+                            feat_flat, ikey)
+                    aupd, a_opt = self.actor_opt.update(
+                        a_grads, a_opt, actor_params)
+                    actor_params = optax.apply_updates(actor_params,
+                                                       aupd)
+
+                    def critic_loss(cp):
+                        v = _elu_mlp(cp, feats[:-1])[..., 0]
+                        return (w * (v - rets) ** 2).mean()
+
+                    c_l, c_grads = jax.value_and_grad(critic_loss)(
+                        critic_params)
+                    cupd, c_opt = self.critic_opt.update(
+                        c_grads, c_opt, critic_params)
+                    critic_params = optax.apply_updates(critic_params,
+                                                        cupd)
+                    critic_target = jax.tree_util.tree_map(
+                        lambda t, p: (1 - cfg.critic_tau) * t
+                        + cfg.critic_tau * p, critic_target,
+                        critic_params)
+                    return (actor_params, critic_params, critic_target,
+                            a_opt, c_opt, key), (a_l, c_l, rets.mean())
+
+                (actor_params, critic_params, critic_target, a_opt,
+                 c_opt, key), (a_ls, c_ls, rets) = jax.lax.scan(
+                    ac_step, (actor_params, critic_params,
+                              critic_target, a_opt, c_opt, key), None,
+                    length=cfg.ac_updates)
+                return (params, actor_params, critic_params,
+                        critic_target, m_opt, a_opt, c_opt, buffer,
+                        key, m_losses[-1], a_ls[-1], c_ls[-1],
+                        rets[-1])
+
+            def skip(args):
+                return args + (jnp.zeros(()), jnp.zeros(()),
+                               jnp.zeros(()), jnp.zeros(()))
+
+            (params, actor_params, critic_params, critic_target,
+             m_opt, a_opt, c_opt, buffer, key, m_l, a_l, c_l,
+             im_ret) = jax.lax.cond(
+                buffer["size"] >= cfg.learn_start, updates, skip,
+                (params, actor_params, critic_params, critic_target,
+                 m_opt, a_opt, c_opt, buffer, key))
+            metrics = {"model_loss": m_l, "actor_loss": a_l,
+                       "critic_loss": c_l, "imagined_return": im_ret,
+                       "buffer_size": buffer["size"]}
+            return (params, actor_params, critic_params, critic_target,
+                    m_opt, a_opt, c_opt, buffer, env_states, obs, h,
+                    key, metrics, traj["reward"], traj["done"])
+
+        return train_iter
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        (self.params, self.actor_params, self.critic_params,
+         self.critic_target, self.model_opt_state, self.actor_opt_state,
+         self.critic_opt_state, self.buffer, self.env_states, self.obs,
+         self.h, self.key, metrics, rewards,
+         dones) = self._train_iter(
+            self.params, self.actor_params, self.critic_params,
+            self.critic_target, self.model_opt_state,
+            self.actor_opt_state, self.critic_opt_state, self.buffer,
+            self.env_states, self.obs, self.h, self.key)
+        self._track_episodes(np.asarray(rewards), np.asarray(dones))
+        dt = time.perf_counter() - t0
+        steps = cfg.num_envs * cfg.seq_len
+        return {
+            "model_loss": float(metrics["model_loss"]),
+            "actor_loss": float(metrics["actor_loss"]),
+            "critic_loss": float(metrics["critic_loss"]),
+            "imagined_return": float(metrics["imagined_return"]),
+            "buffer_size": int(metrics["buffer_size"]),
+            "episode_reward_mean": self.episode_reward_mean(),
+            "env_steps_this_iter": steps,
+            "env_steps_per_s": steps / dt,
+        }
+
+    # -- checkpointing ------------------------------------------------------
+    def get_state(self) -> Dict[str, Any]:
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "actor_params": to_np(self.actor_params),
+                "critic_params": to_np(self.critic_params),
+                "critic_target": to_np(self.critic_target),
+                "iteration": self.iteration,
+                "env_steps_total": self._total_env_steps}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.params, state["params"])
+        self.actor_params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.actor_params,
+            state["actor_params"])
+        self.critic_params = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.critic_params,
+            state["critic_params"])
+        self.critic_target = jax.tree_util.tree_map(
+            lambda _, x: jnp.asarray(x), self.critic_target,
+            state.get("critic_target", state["critic_params"]))
+        self.iteration = state.get("iteration", 0)
+        self._total_env_steps = state.get("env_steps_total", 0)
