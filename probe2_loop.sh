@@ -1,0 +1,22 @@
+#!/bin/bash
+# Retry tpu_probe2.py until the tunnelled chip claim succeeds (wedged
+# grants fail client init after ~1500s; healthy chips init in <1s).
+# One claimant at a time, never killed — the round-3 wedge discipline.
+cd /root/repo
+for i in $(seq 1 40); do
+    echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> probe2_r04.err
+    python tpu_probe2.py >> probe2_r04.out 2>> probe2_r04.err
+    rc=$?
+    # Success = the probe got past the env stage (backend really tpu and
+    # at least the RL canary emitted something beyond env/abort).
+    if [ -f TPU_PROBE2_r04.jsonl ] && grep -qv '"stage": "env"\|"stage": "abort"' TPU_PROBE2_r04.jsonl; then
+        echo "=== probe2 produced results (rc=$rc), stopping ===" >> probe2_r04.err
+        break
+    fi
+    # A wedged claim aborts with backend!=tpu or errors out; clear the
+    # abort-only ledger so the next attempt starts a fresh file.
+    if [ -f TPU_PROBE2_r04.jsonl ]; then
+        mv TPU_PROBE2_r04.jsonl "TPU_PROBE2_r04.abort.$i" 2>/dev/null
+    fi
+    sleep 90
+done

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Wait for probe2's claim to exit (one claimant at a time, never kill),
+# then run tpu_probe3.py with the same retry discipline.
+cd /root/repo
+while pgrep -f "tpu_probe2.py" > /dev/null || pgrep -f "probe2_loop.sh" > /dev/null; do
+    sleep 30
+done
+for i in $(seq 1 40); do
+    echo "=== attempt $i $(date -u +%H:%M:%S) ===" >> probe3_r04.err
+    python tpu_probe3.py >> probe3_r04.out 2>> probe3_r04.err
+    rc=$?
+    if [ -f TPU_PROBE3_r04.jsonl ] && grep -q '"stage": "canary"' TPU_PROBE3_r04.jsonl && ! grep -q '"stage": "abort"' TPU_PROBE3_r04.jsonl; then
+        echo "=== probe3 produced results (rc=$rc), stopping ===" >> probe3_r04.err
+        break
+    fi
+    if [ -f TPU_PROBE3_r04.jsonl ]; then
+        mv TPU_PROBE3_r04.jsonl "TPU_PROBE3_r04.abort.$i" 2>/dev/null
+    fi
+    sleep 90
+done

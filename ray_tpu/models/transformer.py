@@ -102,8 +102,8 @@ class TransformerConfig:
         d, l, h = dims
         return TransformerConfig(
             vocab_size=50304, d_model=d, n_layers=l, n_heads=h,
-            max_seq_len=1024, pos_emb="learned", activation="gelu",
-            norm="layernorm", tie_embeddings=True, **kw)
+            max_seq_len=kw.pop("max_seq_len", 1024), pos_emb="learned",
+            activation="gelu", norm="layernorm", tie_embeddings=True, **kw)
 
     @staticmethod
     def llama(size: str = "1b", **kw) -> "TransformerConfig":
