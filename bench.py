@@ -107,22 +107,26 @@ def _run_measurement() -> dict:
         # remat=False: gpt2-small at b8/s1024 fits HBM without
         # rematerialization, and remat's recompute FLOPs are real work
         # the MFU numerator does not count (~25-30% of the step).
-        # loss_chunk: never materialize the full [8, 1024, 50304] fp32
-        # logits (1.6 GB) — one [8, 128, 50304] block at a time.
-        # norm_remat + flash blocks 1024x512: the round-4 on-chip ablation
-        # winners (TPU_PROBE_r04.jsonl: 0.297 base -> 0.319 norm_remat ->
-        # 0.333 with whole-seq q blocks on the v5e).
+        # loss_chunk: never materialize the full [16, 1024, 50304] fp32
+        # logits (3.2 GB) — one [16, 128, 50304] block at a time.
+        # norm_remat + flash blocks 1024x1024 + batch 16 + bf16 Adam-mu:
+        # the round-4 on-chip ablation winners (TPU_PROBE_r04.jsonl:
+        # 0.297 base -> 0.319 norm_remat -> 0.333 whole-seq q blocks;
+        # TPU_PROBE3_r04.jsonl: 0.345 b8 1024x1024 k blocks -> 0.3601
+        # b16; TPU_PROBE5_r04.jsonl: 0.3686 with bf16 mu; b24 OOMs).
         os.environ.setdefault("RAY_TPU_FLASH_BLOCK_Q", "1024")
-        os.environ.setdefault("RAY_TPU_FLASH_BLOCK_K", "512")
+        os.environ.setdefault("RAY_TPU_FLASH_BLOCK_K", "1024")
         cfg = TransformerConfig.gpt2("small", remat=False, loss_chunk=128,
                                      norm_remat=True)
-        batch, seq, steps = 8, 1024, 20
+        batch, seq, steps = 16, 1024, 20
     else:  # smoke-test shape for CPU runs of this script
         cfg = TransformerConfig.tiny()
         batch, seq, steps = 4, 128, 3
 
     params, _ = init_params(jax.random.PRNGKey(0), cfg)
-    opt = optax.adamw(3e-4, weight_decay=0.1)
+    # bf16 first moments halve the Adam-mu HBM traffic: +0.009 MFU on
+    # the v5e (TPU_PROBE5_r04.jsonl b16_kk_bf16mu 0.3686 vs 0.3601)
+    opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
     opt_state = opt.init(params)
     step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
     # lm_loss runs the model on the full token length — keep it equal to

@@ -1,0 +1,143 @@
+"""Shared harness for the staged on-chip probes (tpu_probe*.py).
+
+Extracted after round 4: the emit/guarded/measure_mfu bodies were
+copy-pasted across four probe scripts, and a bug in one copy (the
+gpt2() max_seq_len collision) cost a chip window while the other
+copies had diverged.  New probes: ``from probe_common import
+ProbeLedger, measure_mfu`` and keep the per-probe file to just its
+stage grid.
+
+Discipline (learned rounds 3-4, encoded here):
+  * ONE claim per process; never kill a TPU run mid-compile.
+  * Every stage guarded — one bad stage must not sink the claim.
+  * Every result fsync'd to the ledger immediately.
+  * Canary (tiny matmul) before committing the claim to big compiles.
+"""
+
+import json
+import os
+import time
+import traceback
+
+from bench import _peak_flops
+
+
+class ProbeLedger:
+    """fsync'd JSONL ledger + guarded-stage decorator for one probe."""
+
+    def __init__(self, out_path: str):
+        self.t0 = time.perf_counter()
+        self.out = out_path
+
+    def log(self, msg: str) -> None:
+        print(f"[probe {time.perf_counter() - self.t0:7.1f}s] {msg}",
+              flush=True)
+
+    def emit(self, stage: str, payload: dict) -> None:
+        rec = {"stage": stage, "t": round(time.perf_counter() - self.t0, 1)}
+        rec.update(payload)
+        with open(self.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.log(f"{stage}: {payload}")
+
+    def guarded(self, stage: str):
+        def deco(fn):
+            def run(*a, **kw):
+                try:
+                    return fn(*a, **kw)
+                except Exception as exc:
+                    self.emit(stage, {
+                        "error": repr(exc)[:300],
+                        "tb": traceback.format_exc(limit=3)[-400:]})
+                    return None
+            return run
+        return deco
+
+    def claim_or_abort(self) -> bool:
+        """env + canary stages; False means don't burn the claim."""
+        import jax
+        import jax.numpy as jnp
+        backend = jax.default_backend()
+        dev = jax.devices()[0]
+        self.emit("env", {"backend": backend,
+                          "device": getattr(dev, "device_kind", "?")})
+        if backend != "tpu":
+            self.emit("abort", {"reason": f"backend={backend}, not tpu"})
+            return False
+
+        def canary():
+            x = jnp.ones((1024, 1024), jnp.bfloat16)
+            jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+            self.emit("canary", {"ok": True})
+            return True
+
+        if self.guarded("canary")(canary)() is None:
+            self.emit("abort", {"reason": "canary failed; claim unhealthy"})
+            return False
+        return True
+
+
+def enable_compile_cache() -> None:
+    import jax
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_compile_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
+def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
+                steps: int = 12, seq: int = 1024,
+                blocks=(1024, 1024), mu_dtype=None) -> float:
+    """GPT-2-small train-step MFU at the given recipe; emits an "mfu"
+    stage record.  Peak FLOPs via bench._peak_flops (device-kind table,
+    longest-prefix matched — the probes' old `"v5" in kind` guess
+    mis-rated v5p/v6e)."""
+    import jax
+    import optax
+
+    from ray_tpu.models import (TransformerConfig, flops_per_token,
+                                init_params, make_train_step)
+    t_stage = time.perf_counter()
+    os.environ["RAY_TPU_FLASH_BLOCK_Q"] = str(blocks[0])
+    os.environ["RAY_TPU_FLASH_BLOCK_K"] = str(blocks[1])
+    cfg = TransformerConfig.gpt2("small", loss_chunk=128,
+                                 max_seq_len=max(1024, seq), **cfg_kw)
+    params, _ = init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=mu_dtype)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
+                                0, cfg.vocab_size)
+    data = {"tokens": tokens}
+    for _ in range(2):
+        params, opt_state, m = step(params, opt_state, data)
+    float(m["loss"])
+    compile_s = time.perf_counter() - t_stage
+    peak = _peak_flops(jax.devices()[0])
+
+    def timed(sync_each: bool) -> float:
+        nonlocal params, opt_state, m
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = step(params, opt_state, data)
+            if sync_each:
+                float(m["loss"])
+        float(m["loss"])
+        return time.perf_counter() - t0
+
+    dt = timed(False)
+    mfu = steps * batch * seq / dt * flops_per_token(cfg, seq) / peak
+    if not (0.0 < mfu < 0.95):       # async dispatch outran the device
+        dt = timed(True)
+        mfu = steps * batch * seq / dt * flops_per_token(cfg, seq) / peak
+    ledger.emit("mfu", {"tag": tag, "batch": batch, "seq": seq,
+                        "blocks": list(blocks), "mfu": round(mfu, 4),
+                        "step_ms": round(1000 * dt / steps, 1),
+                        "tok_s": round(steps * batch * seq / dt),
+                        "compile_s": round(compile_s, 1)})
+    return mfu

@@ -1,0 +1,137 @@
+"""Fourth staged on-chip probe — scanned-generation throughput.
+
+Probe2 timed decode per-dispatch (one jit call per token), which on the
+tunnelled chip pays ~4 ms dispatch latency per token.  The framework's
+real serving path (`ray_tpu.models.generate.generate`) compiles prefill
++ a `lax.scan` of decode_step into ONE program, so a whole completion
+costs one dispatch.  This probe measures that path — the honest
+chip-side generation throughput — at batch 1 and batch 8.
+
+Same discipline: ONE claim, guarded stages, fsync'd ledger, never kill.
+"""
+
+import json
+import os
+import time
+import traceback
+
+T0 = time.perf_counter()
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "TPU_PROBE4_r04.jsonl")
+
+
+def log(msg: str) -> None:
+    print(f"[probe4 {time.perf_counter() - T0:7.1f}s] {msg}", flush=True)
+
+
+def emit(stage: str, payload: dict) -> None:
+    rec = {"stage": stage, "t": round(time.perf_counter() - T0, 1)}
+    rec.update(payload)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    log(f"{stage}: {payload}")
+
+
+def guarded(stage):
+    def deco(fn):
+        def run(*a, **kw):
+            try:
+                return fn(*a, **kw)
+            except Exception as exc:
+                emit(stage, {"error": repr(exc)[:300],
+                             "tb": traceback.format_exc(limit=3)[-400:]})
+                return None
+        return run
+    return deco
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_compile_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.generate import generate
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    emit("env", {"backend": backend,
+                 "device": getattr(dev, "device_kind", "?")})
+    if backend != "tpu":
+        emit("abort", {"reason": f"backend={backend}, not tpu"})
+        return
+
+    @guarded("canary")
+    def canary():
+        x = jnp.ones((1024, 1024), jnp.bfloat16)
+        jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+        emit("canary", {"ok": True})
+        return True
+
+    if canary() is None:
+        emit("abort", {"reason": "canary failed; claim unhealthy"})
+        return
+
+    def gen_scan(tag, cfg, batch, prompt_len, max_new):
+        t_init = time.perf_counter()
+        params, _ = init_params(jax.random.PRNGKey(0), cfg)
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+        jax.block_until_ready(params)
+        prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, prompt_len), 0, cfg.vocab_size)
+        # greedy (temperature=0) — sampling cost is negligible either way
+        toks = generate(params, prompt, cfg=cfg, max_new_tokens=max_new,
+                        temperature=0.0)
+        jax.block_until_ready(toks)        # compile + warmup
+        compile_s = time.perf_counter() - t_init
+        t0 = time.perf_counter()
+        n_calls = 3
+        for i in range(n_calls):
+            prompt_i = (prompt + i + 1) % cfg.vocab_size
+            toks = generate(params, prompt_i, cfg=cfg,
+                            max_new_tokens=max_new, temperature=0.0)
+            jax.block_until_ready(toks)
+        dt = (time.perf_counter() - t0) / n_calls
+        emit("gen_scan", {
+            "tag": tag, "batch": batch, "prompt_len": prompt_len,
+            "max_new": max_new,
+            "e2e_ms": round(dt * 1e3, 1),
+            "decode_tok_s_per_seq": round(max_new / dt, 1),
+            "decode_tok_s_total": round(batch * max_new / dt, 1),
+            "compile_s": round(compile_s, 1)})
+        del params, toks
+
+    grids = (
+        ("gpt2s b1", TransformerConfig.gpt2(
+            "small", remat=False, attention_impl="reference"), 1, 256, 128),
+        ("gpt2s b8", TransformerConfig.gpt2(
+            "small", remat=False, attention_impl="reference"), 8, 256, 128),
+        ("llama-tiny b1", TransformerConfig.llama(
+            "tiny", max_seq_len=1024, remat=False,
+            attention_impl="reference"), 1, 512, 128),
+        ("llama-1b b1", TransformerConfig.llama(
+            "1b", max_seq_len=1024, remat=False,
+            attention_impl="reference"), 1, 512, 128),
+        ("llama-1b b8", TransformerConfig.llama(
+            "1b", max_seq_len=1024, remat=False,
+            attention_impl="reference"), 8, 512, 128),
+    )
+    for tag, cfg, batch, plen, mnew in grids:
+        guarded(f"gen_scan:{tag}")(gen_scan)(tag, cfg, batch, plen, mnew)
+
+    emit("done", {"total_s": round(time.perf_counter() - T0, 1)})
+
+
+if __name__ == "__main__":
+    main()
