@@ -105,8 +105,10 @@ def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
     t_stage = time.perf_counter()
     os.environ["RAY_TPU_FLASH_BLOCK_Q"] = str(blocks[0])
     os.environ["RAY_TPU_FLASH_BLOCK_K"] = str(blocks[1])
-    cfg = TransformerConfig.gpt2("small", loss_chunk=128,
-                                 max_seq_len=max(1024, seq), **cfg_kw)
+    cfg_kw = dict(cfg_kw)
+    cfg = TransformerConfig.gpt2(
+        "small", loss_chunk=cfg_kw.pop("loss_chunk", 128),
+        max_seq_len=max(1024, seq), **cfg_kw)
     params, _ = init_params(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=mu_dtype)
     opt_state = opt.init(params)

@@ -9,7 +9,7 @@ string) on ScalingConfig — placement becomes ICI-topology-aware bundles.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from ..parallel.mesh import MeshSpec
 
@@ -61,5 +61,8 @@ class RunConfig:
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 0
-    stop: Optional[Dict[str, Any]] = None
+    # dict of metric thresholds, a tune.Stopper, or a plain
+    # (trial_id, result) -> bool callable (tune/stopper.py)
+    stop: Optional[Union[Dict[str, Any], Callable[[str, Dict[str, Any]],
+                                                  bool]]] = None
     sync_config: Optional[Any] = None   # tune.syncer.SyncConfig

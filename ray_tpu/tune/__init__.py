@@ -29,6 +29,16 @@ from .schedulers import (  # noqa: F401
     MedianStoppingRule,
     PopulationBasedTraining,
 )
+from .stopper import (  # noqa: F401
+    CombinedStopper,
+    ExperimentPlateauStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    NoopStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
 from .syncer import SyncConfig, Syncer  # noqa: F401
 from .search import (  # noqa: F401
     BasicVariantGenerator,

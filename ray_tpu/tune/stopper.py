@@ -1,0 +1,188 @@
+"""Experiment/trial stopping conditions.
+
+Capability mirror of the reference's stopper family
+(`/root/reference/python/ray/tune/stopper/stopper.py:1` Stopper ABC with
+``__call__(trial_id, result)`` + ``stop_all()``; `maximum_iteration.py`,
+`function_stopper.py`, `timeout.py`, `trial_plateau.py`,
+`experiment_plateau.py`, `noop.py`, and CombinedStopper) — redesigned
+onto this Tuner's single event loop: a stopper decides per-result
+whether its trial stops, and ``stop_all`` ends the whole experiment at
+the loop's next tick.
+
+Pass an instance (or a plain ``(trial_id, result) -> bool`` callable,
+auto-wrapped) as ``RunConfig.stop`` next to the existing dict form.
+"""
+
+import time
+from collections import defaultdict, deque
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "Stopper", "NoopStopper", "FunctionStopper",
+    "MaximumIterationStopper", "TimeoutStopper", "TrialPlateauStopper",
+    "ExperimentPlateauStopper", "CombinedStopper",
+]
+
+
+class Stopper:
+    """Decides, per reported result, whether a trial should stop — and,
+    via ``stop_all``, whether the whole experiment should."""
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class NoopStopper(Stopper):
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return False
+
+
+class FunctionStopper(Stopper):
+    """Wraps a plain ``(trial_id, result) -> bool`` function."""
+
+    def __init__(self, function: Callable[[str, Dict], bool]):
+        if not callable(function):
+            raise ValueError("FunctionStopper needs a callable "
+                             f"(trial_id, result) -> bool, got "
+                             f"{type(function).__name__}")
+        self._fn = function
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return bool(self._fn(trial_id, result))
+
+
+class MaximumIterationStopper(Stopper):
+    """Stop each trial after ``max_iter`` of its own results."""
+
+    def __init__(self, max_iter: int):
+        self._max_iter = max_iter
+        self._count: Dict[str, int] = defaultdict(int)
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        self._count[trial_id] += 1
+        return self._count[trial_id] >= self._max_iter
+
+
+class TimeoutStopper(Stopper):
+    """Stop the WHOLE experiment after a wall-clock budget (the
+    reference keys this off stop_all too).  Pickles as the REMAINING
+    budget, re-anchored on load — a raw monotonic deadline is
+    meaningless in another process (restore after a crash/reboot would
+    otherwise never fire, or fire instantly)."""
+
+    def __init__(self, timeout_s: float):
+        self._deadline = time.monotonic() + float(timeout_s)
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        return self.stop_all()
+
+    def stop_all(self) -> bool:
+        return time.monotonic() >= self._deadline
+
+    def __getstate__(self):
+        return {"remaining_s": self._deadline - time.monotonic()}
+
+    def __setstate__(self, state):
+        self._deadline = time.monotonic() + state["remaining_s"]
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop a trial whose metric's stddev over the last ``num_results``
+    results fell to ``std`` or below (after ``grace_period`` results).
+    Mirror of the reference's `trial_plateau.py` semantics."""
+
+    def __init__(self, metric: str, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4,
+                 metric_threshold: Optional[float] = None,
+                 mode: str = "min"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self._metric = metric
+        self._std = std
+        self._num_results = num_results
+        self._grace = grace_period
+        self._threshold = metric_threshold
+        self._mode = mode
+        self._window: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=num_results))
+        self._seen: Dict[str, int] = defaultdict(int)
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        value = result.get(self._metric)
+        if value is None:
+            return False
+        self._seen[trial_id] += 1
+        w = self._window[trial_id]
+        w.append(float(value))
+        if self._seen[trial_id] < self._grace or len(w) < self._num_results:
+            return False
+        if self._threshold is not None:
+            # only plateau-stop once the metric is good enough / bad
+            # enough to bother (reference: metric_threshold + mode)
+            if self._mode == "min" and w[-1] > self._threshold:
+                return False
+            if self._mode == "max" and w[-1] < self._threshold:
+                return False
+        mean = sum(w) / len(w)
+        var = sum((x - mean) ** 2 for x in w) / len(w)
+        return var ** 0.5 <= self._std
+
+
+class ExperimentPlateauStopper(Stopper):
+    """Stop the whole experiment when the best ``metric`` seen stops
+    improving for ``patience`` consecutive checks past ``top`` trials.
+    Mirror of the reference's `experiment_plateau.py`."""
+
+    def __init__(self, metric: str, std: float = 0.001, top: int = 10,
+                 mode: str = "min", patience: int = 0):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self._metric = metric
+        self._std = std
+        self._top = top
+        self._mode = mode
+        self._patience = patience
+        self._best: list = []
+        self._stagnant = 0
+        self._should_stop = False
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        value = result.get(self._metric)
+        if value is None:
+            return self._should_stop
+        v = float(value)
+        self._best.append(v)
+        self._best.sort(reverse=(self._mode == "max"))
+        del self._best[self._top:]
+        if len(self._best) == self._top:
+            mean = sum(self._best) / len(self._best)
+            var = sum((x - mean) ** 2 for x in self._best) / len(self._best)
+            if var ** 0.5 <= self._std:
+                self._stagnant += 1
+            else:
+                self._stagnant = 0
+            if self._stagnant > self._patience:
+                self._should_stop = True
+        return self._should_stop
+
+    def stop_all(self) -> bool:
+        return self._should_stop
+
+
+class CombinedStopper(Stopper):
+    """OR-combination of stoppers (reference: `stopper.py`
+    CombinedStopper)."""
+
+    def __init__(self, *stoppers: Stopper):
+        self._stoppers = stoppers
+
+    def __call__(self, trial_id: str, result: Dict) -> bool:
+        # no short-circuit: stateful stoppers (iteration counters,
+        # plateau windows) must observe EVERY result
+        return any([s(trial_id, result) for s in self._stoppers])
+
+    def stop_all(self) -> bool:
+        return any(s.stop_all() for s in self._stoppers)

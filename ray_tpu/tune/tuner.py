@@ -94,8 +94,14 @@ class Tuner:
         # authoritative remote state at its pre-restore content
         parent = path.rstrip("/").rsplit("/", 1)[0] if is_uri(path) \
             else os.path.dirname(local.rstrip(os.sep))
-        run_cfg = RunConfig(name=name, storage_path=parent,
-                            stop=saved.get("stop") or None)
+        stop = saved.get("stop") or None
+        if stop is None and saved.get("stop_blob"):
+            try:
+                stop = cloudpickle.loads(
+                    base64.b64decode(saved["stop_blob"]))
+            except Exception:
+                stop = None   # stopper code unavailable: resume unstopped
+        run_cfg = RunConfig(name=name, storage_path=parent, stop=stop)
         tuner = cls(trainable,
                     param_space=None,  # configs come from saved trials
                     tune_config=TuneConfig(
@@ -271,6 +277,21 @@ class _TrialRunner:
         self.trials: List[Trial] = []
         self.running: List[_RunningTrial] = []
         self._resume: List[Trial] = []
+        # stop criteria: dict (metric thresholds), Stopper, or a plain
+        # (trial_id, result) -> bool callable (auto-wrapped); reference:
+        # tune.run(stop=...) accepts the same three forms
+        from .stopper import FunctionStopper, Stopper
+        stop = run_cfg.stop
+        self._stopper: Optional[Stopper] = None
+        if isinstance(stop, Stopper):
+            self._stopper = stop
+        elif callable(stop):
+            self._stopper = FunctionStopper(stop)
+        elif stop is not None and not isinstance(stop, dict):
+            raise ValueError(
+                "RunConfig.stop must be a dict of metric thresholds, a "
+                f"tune.Stopper, or a callable; got {type(stop).__name__}")
+        self._stop_all = False
         self._fn_blob = dumps_function(self._wrap(trainable))
         self._actor_cls = api.remote(TrainWorker)
         self._dirty = False
@@ -340,7 +361,12 @@ class _TrialRunner:
             "metric": self.cfg.metric, "mode": self.cfg.mode,
             "num_samples": self.cfg.num_samples,
             "max_concurrent_trials": self.cfg.max_concurrent_trials,
-            "stop": self.run_cfg.stop,
+            "stop": self.run_cfg.stop
+            if isinstance(self.run_cfg.stop, dict) else None,
+            "stop_blob": base64.b64encode(cloudpickle.dumps(
+                self.run_cfg.stop)).decode()
+            if self.run_cfg.stop is not None
+            and not isinstance(self.run_cfg.stop, dict) else None,
             "param_space_blob": base64.b64encode(cloudpickle.dumps(
                 self.param_space)).decode()
             if self.param_space is not None else None,
@@ -429,8 +455,15 @@ class _TrialRunner:
         trial.checkpoint_dir = path
         self._dirty = True
 
-    def _should_stop(self, result: Dict[str, Any]) -> bool:
-        stop = self.run_cfg.stop or {}
+    def _should_stop(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        if self._stopper is not None:
+            hit = bool(self._stopper(trial_id, result))
+            if self._stopper.stop_all():
+                self._stop_all = True
+                return True
+            return hit
+        stop = self.run_cfg.stop if isinstance(self.run_cfg.stop, dict) \
+            else {}
         for k, v in stop.items():
             if k == "training_iteration":
                 if result.get("training_iteration", 0) >= v:
@@ -473,6 +506,19 @@ class _TrialRunner:
         max_trials = getattr(self.searcher, "total_trials",
                              self.cfg.num_samples)
         while True:
+            if self._stop_all:
+                # a Stopper ended the experiment: stop every live trial
+                # gracefully and exit BEFORE launching/refilling — a
+                # post-refill check would spawn trials only to kill them
+                # (phantom TERMINATED rows feeding garbage to searchers)
+                for rt in list(self.running):
+                    try:
+                        api.get(rt.actor.stop_session.remote(),
+                                timeout=30.0)
+                    except Exception:
+                        pass
+                    self._teardown(rt, TERMINATED)
+                break
             cap = self._effective_concurrency()
             # restored unfinished trials first, from their checkpoints
             while self._resume and len(self.running) < cap:
@@ -546,7 +592,7 @@ class _TrialRunner:
             self.scheduler.metric in metrics
         decision = (self.scheduler.on_trial_result(trial, metrics)
                     if metric_known else CONTINUE)
-        if self._should_stop(metrics):
+        if self._should_stop(trial.trial_id, metrics):
             decision = STOP
         if decision == STOP:
             directive = self.scheduler.exploit_directive(trial)

@@ -1,0 +1,155 @@
+"""Stopper family tests (reference model:
+`python/ray/tune/tests/test_stopper.py` semantics — per-trial stops,
+experiment-wide stop_all, combinations — exercised through this
+Tuner's event loop and as pure units)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, session
+from ray_tpu.tune import (CombinedStopper, ExperimentPlateauStopper,
+                          FunctionStopper, MaximumIterationStopper,
+                          NoopStopper, TimeoutStopper,
+                          TrialPlateauStopper, TuneConfig, Tuner)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# -- pure-unit semantics ----------------------------------------------------
+
+def test_maximum_iteration_counts_per_trial():
+    s = MaximumIterationStopper(3)
+    assert [s("a", {}) for _ in range(3)] == [False, False, True]
+    # an unrelated trial has its own counter
+    assert s("b", {}) is False
+
+
+def test_function_stopper_wraps_and_validates():
+    s = FunctionStopper(lambda tid, r: r["loss"] < 0.1)
+    assert not s("t", {"loss": 1.0})
+    assert s("t", {"loss": 0.05})
+    with pytest.raises(ValueError):
+        FunctionStopper("not callable")
+
+
+def test_trial_plateau_stops_on_flat_window():
+    s = TrialPlateauStopper(metric="loss", std=1e-3, num_results=3,
+                            grace_period=3)
+    flat = [1.0, 1.0, 1.0, 1.0]
+    hits = [s("t", {"loss": v}) for v in flat]
+    assert hits[-1] and not any(hits[:2])
+    # a still-moving trial does not stop
+    s2 = TrialPlateauStopper(metric="loss", std=1e-3, num_results=3,
+                             grace_period=3)
+    assert not any(s2("t", {"loss": v}) for v in [3.0, 2.0, 1.0, 0.5])
+
+
+def test_trial_plateau_threshold_gates_stop():
+    # mode=min with a threshold: a plateau ABOVE it keeps running
+    s = TrialPlateauStopper(metric="loss", std=1e-3, num_results=3,
+                            grace_period=3, metric_threshold=0.5,
+                            mode="min")
+    assert not any(s("t", {"loss": 2.0}) for _ in range(5))
+    s2 = TrialPlateauStopper(metric="loss", std=1e-3, num_results=3,
+                             grace_period=3, metric_threshold=0.5,
+                             mode="min")
+    assert [s2("t", {"loss": 0.1}) for _ in range(3)][-1]
+
+
+def test_experiment_plateau_sets_stop_all():
+    s = ExperimentPlateauStopper(metric="score", std=1e-3, top=3,
+                                 mode="max", patience=0)
+    for v in (1.0, 1.0, 1.0, 1.0):
+        s("t", {"score": v})
+    assert s.stop_all()
+
+
+def test_timeout_and_combined():
+    s = CombinedStopper(NoopStopper(), TimeoutStopper(0.05))
+    assert not s.stop_all()
+    time.sleep(0.06)
+    assert s("t", {}) and s.stop_all()
+
+
+def test_combined_feeds_every_stateful_member():
+    # no short-circuit: both iteration counters must advance together
+    a, b = MaximumIterationStopper(2), MaximumIterationStopper(2)
+    s = CombinedStopper(a, b)
+    s("t", {})
+    assert s("t", {})          # both reach max_iter on the same result
+    assert a._count["t"] == b._count["t"] == 2
+
+
+# -- through the Tuner event loop ------------------------------------------
+
+def test_stopper_stops_trials_in_tuner(cluster, tmp_path):
+    def objective(config):
+        for i in range(50):
+            session.report({"loss": 1.0 / (i + 1)})
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="stop_iter", storage_path=str(tmp_path),
+                             stop=MaximumIterationStopper(4)),
+    ).fit()
+    assert len(grid) == 2
+    for res in grid:
+        assert res.metrics["training_iteration"] <= 4
+
+
+def test_stop_all_ends_experiment(cluster, tmp_path):
+    def objective(config):
+        for i in range(200):
+            session.report({"score": 1.0})
+
+    t0 = time.time()
+    Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               max_concurrent_trials=2),
+        run_config=RunConfig(
+            name="stop_all", storage_path=str(tmp_path),
+            stop=ExperimentPlateauStopper(metric="score", std=1e-6,
+                                          top=3, mode="max")),
+    ).fit()
+    # 4 trials x 200 reports would take far longer; the experiment-wide
+    # stop must cut it short
+    assert time.time() - t0 < 60
+
+
+def test_plain_callable_as_stop(cluster, tmp_path):
+    def objective(config):
+        for i in range(50):
+            session.report({"loss": 1.0 / (i + 1)})
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="stop_fn", storage_path=str(tmp_path),
+                             stop=lambda tid, r: r["loss"] < 0.3),
+    ).fit()
+    assert grid[0].metrics["loss"] >= 1.0 / 5
+
+
+def test_invalid_stop_type_raises(cluster, tmp_path):
+    with pytest.raises(ValueError, match="RunConfig.stop"):
+        Tuner(
+            lambda config: session.report({"x": 1}),
+            param_space={},
+            run_config=RunConfig(name="bad_stop",
+                                 storage_path=str(tmp_path),
+                                 stop=42),
+        ).fit()

@@ -1,0 +1,64 @@
+"""Sixth staged on-chip probe — pixel-env RL and MFU micro-levers.
+
+The round-3 verdict called the RL north star "CartPole-weight"; probe3
+fixed the substrate (285k env-steps/s ON the chip) and this probe
+fixes the workload: PPO with the catalog's conv policy on PixelPong,
+an Atari-class rendered-frame env, entirely on-device.  Also sweeps
+loss_chunk (the last unmeasured MFU knob at the winning recipe).
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
+
+OUT = __file__.replace("tpu_probe6.py", "TPU_PROBE6_r04.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax
+    import jax.numpy as jnp  # noqa: F401
+
+    # ---- stage 1: conv-policy PPO on the pixel env ----------------------
+    def ppo_pong(num_envs, rollout):
+        from ray_tpu.rl import PixelPong, PPOConfig
+        algo = PPOConfig(env=PixelPong, num_envs=num_envs,
+                         rollout_length=rollout, num_sgd_epochs=2,
+                         num_minibatches=4, lr=3e-4, seed=0).build()
+        algo.train()                      # compile + warmup
+        t0 = time.perf_counter()
+        steps = 0
+        iters = 0
+        while time.perf_counter() - t0 < 8.0 or iters < 3:
+            res = algo.train()
+            steps += res["env_steps_this_iter"]
+            iters += 1
+        dt = time.perf_counter() - t0
+        led.emit("rl_ppo_pixel", {
+            "env": "PixelPong(conv)", "num_envs": num_envs,
+            "rollout": rollout,
+            "env_steps_per_s": round(steps / dt, 1), "iters": iters,
+            "reward": round(res["episode_reward_mean"], 2)})
+
+    for ne in (128, 512, 1024):
+        led.guarded(f"rl_ppo_pixel:{ne}")(ppo_pong)(ne, 64)
+
+    # ---- stage 2: loss_chunk sweep at the winning MFU recipe ------------
+    nr = dict(remat=False, norm_remat=True)
+    bf16 = jnp.bfloat16
+    for tag, chunk in (("b16_chunk256", 256), ("b16_chunk512", 512)):
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, dict(nr, loss_chunk=chunk), 16,
+            blocks=(1024, 1024), mu_dtype=bf16)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
