@@ -1,0 +1,52 @@
+"""Seventh staged on-chip probe — the last MFU levers at the winning
+recipe (b16, 1024x1024 flash blocks, bf16 Adam-mu = 0.3702 official):
+loss_chunk sweep (128 default vs 256/512 — fewer, larger vocab-50k
+matmuls per step) and XLA's latency-hiding scheduler (compile-time
+flag, so it must be set before the first jax import; pass
+RAY_TPU_PROBE7_LHS=1 to run the flagged variant — the runner invokes
+this script twice).
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import os
+
+LHS = os.environ.get("RAY_TPU_PROBE7_LHS") == "1"
+if LHS:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_tpu_enable_latency_hiding_scheduler=true").strip()
+
+import time  # noqa: E402
+
+from probe_common import (ProbeLedger, enable_compile_cache,  # noqa: E402
+                          measure_mfu)
+
+OUT = __file__.replace("tpu_probe7.py", "TPU_PROBE7_r04.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax.numpy as jnp
+
+    nr = dict(remat=False, norm_remat=True)
+    bf16 = jnp.bfloat16
+    suffix = "_lhs" if LHS else ""
+    grid = ((f"b16_chunk256{suffix}", dict(nr, loss_chunk=256)),
+            (f"b16_chunk512{suffix}", dict(nr, loss_chunk=512)))
+    if LHS:  # the flagged rerun also re-measures the incumbent recipe
+        grid = ((f"b16_chunk128{suffix}", nr),) + grid
+    for tag, kw in grid:
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, kw, 16, blocks=(1024, 1024), mu_dtype=bf16)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1),
+                      "lhs": LHS})
+
+
+if __name__ == "__main__":
+    main()
