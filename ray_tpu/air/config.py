@@ -61,6 +61,9 @@ class RunConfig:
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 0
+    # a tune.ProgressReporter (e.g. CLIReporter); verbose>0 implies a
+    # default CLIReporter when unset
+    progress_reporter: Optional[Any] = None
     # dict of metric thresholds, a tune.Stopper, or a plain
     # (trial_id, result) -> bool callable (tune/stopper.py)
     stop: Optional[Union[Dict[str, Any], Callable[[str, Dict[str, Any]],

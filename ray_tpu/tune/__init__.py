@@ -29,6 +29,7 @@ from .schedulers import (  # noqa: F401
     MedianStoppingRule,
     PopulationBasedTraining,
 )
+from .progress import CLIReporter, ProgressReporter  # noqa: F401
 from .stopper import (  # noqa: F401
     CombinedStopper,
     ExperimentPlateauStopper,

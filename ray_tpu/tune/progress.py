@@ -1,0 +1,96 @@
+"""Progress reporting for Tune sweeps.
+
+Capability mirror of the reference's
+`/root/reference/python/ray/tune/progress_reporter.py:1` (ProgressReporter
+ABC, CLIReporter table output, max_report_frequency throttling) — cut to
+this Tuner's single event loop: the runner calls ``maybe_report`` each
+poll tick and once with ``done=True`` at exit.
+"""
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ProgressReporter", "CLIReporter"]
+
+
+class ProgressReporter:
+    def should_report(self, trials: List, done: bool = False) -> bool:
+        raise NotImplementedError
+
+    def report(self, trials: List, done: bool = False) -> None:
+        raise NotImplementedError
+
+    def maybe_report(self, trials: List, done: bool = False) -> None:
+        if self.should_report(trials, done):
+            self.report(trials, done)
+
+
+class CLIReporter(ProgressReporter):
+    """Periodic fixed-width trial table on stdout.
+
+    ``metric_columns``: result keys to show (str, or {key: header});
+    ``max_report_frequency``: min seconds between tables (always prints
+    on ``done``)."""
+
+    def __init__(self, *, metric_columns=None,
+                 parameter_columns: Optional[List[str]] = None,
+                 max_progress_rows: int = 20,
+                 max_report_frequency: float = 5.0,
+                 out=None):
+        if isinstance(metric_columns, dict):
+            self._metrics = metric_columns
+        else:
+            self._metrics = {m: m for m in (metric_columns or [])}
+        self._params = parameter_columns or []
+        self._max_rows = max_progress_rows
+        self._freq = max_report_frequency
+        self._last = -float("inf")   # first call always reports
+        self._out = out or sys.stdout
+
+    def should_report(self, trials: List, done: bool = False) -> bool:
+        return done or (time.monotonic() - self._last) >= self._freq
+
+    def report(self, trials: List, done: bool = False) -> None:
+        self._last = time.monotonic()
+        by_status: Dict[str, int] = {}
+        for t in trials:
+            by_status[t.status] = by_status.get(t.status, 0) + 1
+        counts = ", ".join(f"{n} {s}" for s, n in sorted(by_status.items()))
+        header = (["trial", "status", "iter"] + self._params
+                  + list(self._metrics.values()))
+        rows = []
+        # live trials first so a capped table never hides the running
+        # ones behind long-terminated early trials
+        ordered = ([t for t in trials if t.status == "RUNNING"]
+                   + [t for t in trials if t.status != "RUNNING"])
+        for t in ordered[:self._max_rows]:
+            res = t.last_result or {}
+            cfg = t.config or {}
+            rows.append(
+                [t.trial_id, t.status, str(t.iteration)]
+                + [_fmt(cfg.get(p)) for p in self._params]
+                + [_fmt(res.get(k)) for k in self._metrics])
+        widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+                  if rows else len(header[i]) for i in range(len(header))]
+
+        def line(cells):
+            return "| " + " | ".join(c.ljust(w)
+                                     for c, w in zip(cells, widths)) + " |"
+
+        banner = "== Tune status: " + (counts or "no trials") \
+            + (" (done)" if done else "") + " =="
+        parts = [banner, line(header),
+                 "|" + "|".join("-" * (w + 2) for w in widths) + "|"]
+        parts += [line(r) for r in rows]
+        if len(trials) > self._max_rows:
+            parts.append(f"... {len(trials) - self._max_rows} more trials")
+        print("\n".join(parts), file=self._out, flush=True)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, float):
+        return f"{v:.5g}"
+    return str(v)

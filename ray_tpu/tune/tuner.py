@@ -292,6 +292,13 @@ class _TrialRunner:
                 "RunConfig.stop must be a dict of metric thresholds, a "
                 f"tune.Stopper, or a callable; got {type(stop).__name__}")
         self._stop_all = False
+        # progress reporting (reference: tune/progress_reporter.py):
+        # explicit reporter wins; verbose>0 gets a default CLIReporter
+        from .progress import CLIReporter
+        self._reporter = run_cfg.progress_reporter
+        if self._reporter is None and run_cfg.verbose:
+            cols = [tune_cfg.metric] if tune_cfg.metric else []
+            self._reporter = CLIReporter(metric_columns=cols)
         self._fn_blob = dumps_function(self._wrap(trainable))
         self._actor_cls = api.remote(TrainWorker)
         self._dirty = False
@@ -545,6 +552,10 @@ class _TrialRunner:
                 break
             self._poll()
             self._persist_state()
+            if self._reporter is not None:
+                self._reporter.maybe_report(self.trials)
+        if self._reporter is not None:
+            self._reporter.maybe_report(self.trials, done=True)
         self._persist_state(force=True)
         self._maybe_sync(force=True)
         return self.trials

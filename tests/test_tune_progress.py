@@ -1,0 +1,78 @@
+"""CLIReporter tests (reference model:
+`python/ray/tune/tests/test_progress_reporter.py` — table contents,
+throttling, done-time report) — unit-level on Trial objects plus one
+pass through the Tuner loop."""
+
+import io
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, session
+from ray_tpu.tune import CLIReporter, TuneConfig, Tuner
+from ray_tpu.tune.trial import RUNNING, TERMINATED, Trial
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _trial(tid, status, it, cfg, res):
+    t = Trial(config=cfg, trial_id=tid)
+    t.status = status
+    t.iteration = it
+    t.last_result = res
+    return t
+
+
+def test_table_contents_and_throttle():
+    out = io.StringIO()
+    rep = CLIReporter(metric_columns=["loss"], parameter_columns=["lr"],
+                      max_report_frequency=60.0, out=out)
+    trials = [_trial("t0", RUNNING, 3, {"lr": 0.01}, {"loss": 0.5}),
+              _trial("t1", TERMINATED, 9, {"lr": 0.1}, {"loss": 0.125})]
+    rep.maybe_report(trials)
+    text = out.getvalue()
+    assert "1 RUNNING" in text and "1 TERMINATED" in text
+    assert "t0" in text and "0.01" in text and "0.125" in text
+    # throttled: a second immediate report is suppressed...
+    rep.maybe_report(trials)
+    assert out.getvalue() == text
+    # ...unless done
+    rep.maybe_report(trials, done=True)
+    assert "(done)" in out.getvalue()
+
+
+def test_row_cap():
+    out = io.StringIO()
+    rep = CLIReporter(max_progress_rows=2, max_report_frequency=0.0,
+                      out=out)
+    trials = [_trial(f"t{i}", RUNNING, 1, {}, {}) for i in range(5)]
+    rep.report(trials)
+    assert "... 3 more trials" in out.getvalue()
+
+
+def test_reporter_through_tuner(cluster, tmp_path):
+    out = io.StringIO()
+
+    def objective(config):
+        for i in range(3):
+            session.report({"score": i})
+
+    Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            name="progress", storage_path=str(tmp_path),
+            progress_reporter=CLIReporter(metric_columns=["score"],
+                                          max_report_frequency=0.0,
+                                          out=out)),
+    ).fit()
+    text = out.getvalue()
+    assert "Tune status" in text and "(done)" in text
+    assert "score" in text
