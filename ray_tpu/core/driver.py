@@ -451,10 +451,8 @@ class CoreClient(DeferredRefDecs):
         # fulfilled by task replies / put markers), so the periodic RPC
         # check is bounded to the borrowed subset.
         deadline = None if timeout is None else time.monotonic() + timeout
-        # timeout=0 must stay a non-blocking poll (0 is falsy: no `or`)
-        first_slice = 5.0 if timeout is None else min(timeout, 5.0)
-        entries = self.memory_store.get(oids, first_slice)
-        while entries is None:
+
+        def _revive_borrowed() -> bool:
             revived = False
             with self._ref_lock:
                 borrowed = [o for o in dict.fromkeys(oids)
@@ -464,6 +462,21 @@ class CoreClient(DeferredRefDecs):
                         and self._object_available(oid):
                     self.memory_store.put_in_plasma_marker(oid)
                     revived = True
+            return revived
+
+        # Borrowed refs that already exist somewhere in the cluster must
+        # resolve NOW, not after the first wait slice: a borrowed ref
+        # never gets a local entry pushed to it, so without this pre-pass
+        # every cross-node get of an existing object ate a full 5 s
+        # first_slice before the revive loop looked at the directory
+        # (measured: 64 MiB node-to-node fetch = 5.09 s wall, ~0.06 s of
+        # it transfer — bench_broadcast.py caught it).
+        _revive_borrowed()   # zero RPCs when nothing is borrowed+missing
+        # timeout=0 must stay a non-blocking poll (0 is falsy: no `or`)
+        first_slice = 5.0 if timeout is None else min(timeout, 5.0)
+        entries = self.memory_store.get(oids, first_slice)
+        while entries is None:
+            revived = _revive_borrowed()
             remaining = None if deadline is None \
                 else deadline - time.monotonic()
             if remaining is not None and remaining <= 0 and not revived:
