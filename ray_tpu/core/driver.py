@@ -451,19 +451,6 @@ class CoreClient(DeferredRefDecs):
         # fulfilled by task replies / put markers), so the periodic RPC
         # check is bounded to the borrowed subset.
         deadline = None if timeout is None else time.monotonic() + timeout
-
-        def _revive_borrowed() -> bool:
-            revived = False
-            with self._ref_lock:
-                borrowed = [o for o in dict.fromkeys(oids)
-                            if o not in self._owned]
-            for oid in borrowed:
-                if self.memory_store.peek(oid) is None \
-                        and self._object_available(oid):
-                    self.memory_store.put_in_plasma_marker(oid)
-                    revived = True
-            return revived
-
         # Borrowed refs that already exist somewhere in the cluster must
         # resolve NOW, not after the first wait slice: a borrowed ref
         # never gets a local entry pushed to it, so without this pre-pass
@@ -471,12 +458,12 @@ class CoreClient(DeferredRefDecs):
         # first_slice before the revive loop looked at the directory
         # (measured: 64 MiB node-to-node fetch = 5.09 s wall, ~0.06 s of
         # it transfer — bench_broadcast.py caught it).
-        _revive_borrowed()   # zero RPCs when nothing is borrowed+missing
+        self._revive_borrowed(oids)  # zero RPCs when none borrowed+missing
         # timeout=0 must stay a non-blocking poll (0 is falsy: no `or`)
         first_slice = 5.0 if timeout is None else min(timeout, 5.0)
         entries = self.memory_store.get(oids, first_slice)
         while entries is None:
-            revived = _revive_borrowed()
+            revived = self._revive_borrowed(oids)
             remaining = None if deadline is None \
                 else deadline - time.monotonic()
             if remaining is not None and remaining <= 0 and not revived:
@@ -538,6 +525,23 @@ class CoreClient(DeferredRefDecs):
                 return None
             path = raw.decode()
         return spill.read_file(path)
+
+    def _revive_borrowed(self, oids) -> bool:
+        """Place plasma markers for borrowed refs whose objects already
+        exist cluster-wide (directory/spill lookup).  Borrowed refs never
+        get local entries pushed; without this, get()/wait() block their
+        full first slice (or forever, for wait) on objects that are
+        sitting in another node's store."""
+        revived = False
+        with self._ref_lock:
+            borrowed = [o for o in dict.fromkeys(oids)
+                        if o not in self._owned]
+        for oid in borrowed:
+            if self.memory_store.peek(oid) is None \
+                    and self._object_available(oid):
+                self.memory_store.put_in_plasma_marker(oid)
+                revived = True
+        return revived
 
     def _object_available(self, oid: bytes) -> bool:
         """Reachable without reconstruction: local memory/store, any node's
@@ -623,7 +627,28 @@ class CoreClient(DeferredRefDecs):
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         oids = [r.binary() for r in refs]
         by_oid = {r.binary(): r for r in refs}
-        ready, not_ready = self.memory_store.wait(oids, num_returns, timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Fast path first (zero RPCs): enough objects already ready
+        # locally.  Only when that falls short does the borrowed-ref
+        # revive run — same blindness as get() had: an object living
+        # only on another node never gets a local entry pushed, so a
+        # bare memory_store.wait would burn the full timeout (or block
+        # forever) on refs that are long since ready cluster-wide.  The
+        # revive repeats between bounded wait slices so borrowed objects
+        # that materialize MID-wait are seen too.
+        ready, not_ready = self.memory_store.wait(oids, num_returns, 0)
+        while len(ready) < num_returns:
+            self._revive_borrowed(oids)
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                ready, not_ready = self.memory_store.wait(
+                    oids, num_returns, 0)
+                break
+            step = 5.0 if remaining is None \
+                else max(0.05, min(remaining, 5.0))
+            ready, not_ready = self.memory_store.wait(
+                oids, num_returns, step)
         return [by_oid[o] for o in ready], [by_oid[o] for o in not_ready]
 
     # -------------------------------------------------------- task submission

@@ -105,3 +105,62 @@ def test_borrowed_ref_get_has_no_wait_floor():
         assert dt < 2.0, f"borrowed-ref get took {dt:.2f}s (5s-floor bug?)"
     finally:
         cluster.shutdown()
+
+
+def test_borrowed_ref_wait_sees_remote_object():
+    """wait() on a borrowed ref whose object lives only on another node
+    must report it ready via the directory pre-pass — previously wait()
+    consulted only the local memory store and timed out on objects that
+    were long since ready cluster-wide."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"a": 1.0},
+                     object_store_memory=96 * 1024 * 1024)
+    cluster.add_node(num_cpus=2, resources={"b": 1.0},
+                     object_store_memory=96 * 1024 * 1024)
+    cluster.connect()
+    try:
+        ref = ray_tpu.put(np.arange(1 << 18, dtype=np.uint8))
+
+        @ray_tpu.remote(resources={"b": 0.5}, num_cpus=0)
+        def waiter(wrapped):
+            ready, not_ready = ray_tpu.wait(wrapped, num_returns=1,
+                                            timeout=3.0)
+            return len(ready), len(not_ready)
+
+        n_ready, n_not = ray_tpu.get(waiter.remote([ref]), timeout=120.0)
+        assert (n_ready, n_not) == (1, 0)
+    finally:
+        cluster.shutdown()
+
+
+def test_borrowed_ref_wait_sees_object_materializing_mid_wait():
+    """The revive pass must repeat BETWEEN wait slices: a borrowed ref
+    whose producer finishes on another node mid-wait becomes ready
+    without the waiter re-calling wait()."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2, resources={"a": 1.0},
+                     object_store_memory=96 * 1024 * 1024)
+    cluster.add_node(num_cpus=2, resources={"b": 1.0},
+                     object_store_memory=96 * 1024 * 1024)
+    cluster.connect()
+    try:
+        @ray_tpu.remote(resources={"a": 0.5}, num_cpus=0)
+        def slow_producer():
+            import time as _t
+            _t.sleep(2.0)
+            return np.ones(1 << 18, dtype=np.uint8)
+
+        ref = slow_producer.remote()
+
+        @ray_tpu.remote(resources={"b": 0.5}, num_cpus=0)
+        def waiter(wrapped):
+            import time as _t
+            t0 = _t.perf_counter()
+            ready, _ = ray_tpu.wait(wrapped, num_returns=1, timeout=30.0)
+            return len(ready), float(_t.perf_counter() - t0)
+
+        n_ready, dt = ray_tpu.get(waiter.remote([ref]), timeout=120.0)
+        assert n_ready == 1
+        assert dt < 25.0, f"wait burned its timeout ({dt:.1f}s)"
+    finally:
+        cluster.shutdown()

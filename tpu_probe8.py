@@ -1,0 +1,46 @@
+"""Eighth staged on-chip probe — larger-model MFU.
+
+Bigger d_model means more FLOPs per HBM byte, so gpt2-medium/large
+should sit HIGHER on the roofline than small's 0.37 at the same
+recipe — a shot at crossing the 0.40 north star outright (the BASELINE
+metric stays gpt2-small; this is the scaling evidence).  Memory: at
+b8/s1024, medium (350M) fits like small's b16 did; large (774M) only
+with selective remat — both staged guarded, OOM just fails the stage.
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
+
+OUT = __file__.replace("tpu_probe8.py", "TPU_PROBE8_r04.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax.numpy as jnp
+
+    nr = dict(remat=False, norm_remat=True)
+    bf16 = jnp.bfloat16
+    for tag, preset, kw, batch, mu in (
+            ("medium_b4", "medium", nr, 4, bf16),
+            ("medium_b8", "medium", nr, 8, bf16),
+            ("medium_b16", "medium", nr, 16, bf16),
+            ("large_b2", "large", nr, 2, bf16),
+            ("large_b4_dots", "large",
+             dict(remat="dots", norm_remat=True), 4, bf16),
+    ):
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, kw, batch, blocks=(1024, 1024), mu_dtype=mu,
+            preset=preset)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
