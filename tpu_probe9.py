@@ -1,0 +1,45 @@
+"""Ninth staged on-chip probe — long-context training MFU.
+
+The flash kernel's measured speedup grows with sequence (1.03x at 2048,
+2.13x at 8192 vs unfused) — this probe measures what that buys a FULL
+train step: gpt2-small at seq 4096/8192 (learned pos table stretches),
+with and without selective remat.  The long-context rows anchor the
+SP/ring-attention story: single-chip flash first, ring across chips
+when the sequence outgrows one HBM.
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
+
+OUT = __file__.replace("tpu_probe9.py", "TPU_PROBE9_r04.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax.numpy as jnp
+
+    nr = dict(remat=False, norm_remat=True)
+    dots = dict(remat="dots", norm_remat=True)
+    bf16 = jnp.bfloat16
+    for tag, kw, batch, seq in (
+            ("b2_seq4096", nr, 2, 4096),
+            ("b4_seq4096", nr, 4, 4096),
+            ("b1_seq8192", nr, 1, 8192),
+            ("b2_seq8192_dots", dots, 2, 8192),
+    ):
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, kw, batch, seq=seq, blocks=(1024, 1024),
+            mu_dtype=bf16)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
