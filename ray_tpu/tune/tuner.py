@@ -54,11 +54,18 @@ class Tuner:
         self._restore_state: Optional[Dict[str, Any]] = None
 
     @classmethod
-    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+    def restore(cls, path: str, trainable: Callable,
+                restart_errored: bool = True) -> "Tuner":
         """Resume an interrupted sweep from its experiment directory or
-        URI (reference: `Tuner.restore(path, trainable)` — experiment
-        state is reloaded, finished trials keep their results, and
-        unfinished trials relaunch from their last checkpoints).
+        URI (reference: `Tuner.restore(path, trainable,
+        restart_errored=...)` — experiment state is reloaded, finished
+        trials keep their results, and unfinished trials relaunch from
+        their last checkpoints).  ``restart_errored=True`` restarts
+        ERRORED trials FROM SCRATCH (reference semantics — their last
+        checkpoint may be the poisoned state that erred);
+        ``restart_errored=False`` keeps them terminal.  This build
+        defaults to True — a restore usually follows fixing whatever
+        erred.
 
         ``trainable`` must be the same callable the sweep ran — like the
         reference, code is not resurrected from disk, only state."""
@@ -113,6 +120,7 @@ class Tuner:
                     run_config=run_cfg)
         tuner._restore_state = saved
         tuner._restore_local_dir = local
+        tuner._restart_errored = restart_errored
         return tuner
 
     @staticmethod
@@ -151,7 +159,9 @@ class Tuner:
                               param_space=param_space,
                               restore_state=self._restore_state,
                               storage_override=getattr(
-                                  self, "_restore_local_dir", None))
+                                  self, "_restore_local_dir", None),
+                              restart_errored=getattr(
+                                  self, "_restart_errored", True))
         trials = runner.run()
         return ResultGrid(trials, cfg.metric, cfg.mode)
 
@@ -248,7 +258,8 @@ class _RunningTrial:
 class _TrialRunner:
     def __init__(self, trainable, searcher, scheduler, tune_cfg: TuneConfig,
                  run_cfg: RunConfig, *, param_space=None,
-                 restore_state=None, storage_override=None):
+                 restore_state=None, storage_override=None,
+                 restart_errored: bool = True):
         from .syncer import SyncConfig, Syncer, is_uri, uri_join
         self.trainable = trainable
         self.searcher = searcher
@@ -302,6 +313,7 @@ class _TrialRunner:
         self._fn_blob = dumps_function(self._wrap(trainable))
         self._actor_cls = api.remote(TrainWorker)
         self._dirty = False
+        self._restart_errored = restart_errored
         if restore_state:
             if restore_state.get("searcher_blob"):
                 try:
@@ -332,6 +344,16 @@ class _TrialRunner:
                 ckpt = cand if os.path.isdir(cand) else None
             t.checkpoint_dir = ckpt
             self.trials.append(t)
+            if t.status == ERRORED:
+                if not self._restart_errored:
+                    continue   # restore(restart_errored=False): terminal
+                # reference semantics: restart_errored RESTARTS from
+                # scratch (its checkpoint-resume variant is
+                # resume_errored) — the last checkpoint may be exactly
+                # the poisoned state that erred
+                t.checkpoint_dir = None
+                t.iteration = 0
+                t.metrics_history = []
             if t.status != TERMINATED:
                 # unfinished: relaunch from the last checkpoint
                 t.status = PENDING

@@ -107,3 +107,39 @@ def test_syncer_incremental_and_multi_target(tmp_path):
     (src / "a.txt").write_text("two!")
     assert s.sync_up(str(src), t1) == 1          # changed: re-uploads
     assert open(os.path.join(t1, "a.txt")).read() == "two!"
+
+
+def test_restore_restart_errored_false_keeps_errored(cluster, tmp_path):
+    """restore(restart_errored=False) keeps ERRORED trials terminal
+    (reference: Tuner.restore's restart_errored flag); the default True
+    relaunches them."""
+    import json as _json
+
+    from ray_tpu import tune as tune_mod
+    calls = str(tmp_path / "calls")
+    os.makedirs(calls, exist_ok=True)
+
+    def objective(config):
+        open(os.path.join(calls, f"x{config['x']}"), "a").write("run\n")
+        if config["x"] == 2:
+            raise RuntimeError("boom")
+        session.report({"score": config["x"]})
+
+    Tuner(
+        objective,
+        param_space={"x": tune_mod.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="err_restore",
+                             storage_path=str(tmp_path)),
+    ).fit()
+    exp = str(tmp_path / "err_restore")
+    state = _json.load(open(os.path.join(exp, "experiment_state.json")))
+    assert any(r["status"] == "ERRORED" for r in state["trials"])
+
+    Tuner.restore(exp, objective, restart_errored=False).fit()
+    # the errored trial was NOT re-run: its call file has exactly 1 line
+    assert open(os.path.join(calls, "x2")).read().count("run") == 1
+
+    Tuner.restore(exp, objective, restart_errored=True).fit()
+    # default/True path re-runs it (fails again, but it ran)
+    assert open(os.path.join(calls, "x2")).read().count("run") == 2
