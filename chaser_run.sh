@@ -1,0 +1,17 @@
+#!/bin/bash
+# Final bounded chaser: retry probe8 (then probe9) until 19:30 UTC,
+# then stop claiming entirely so the driver's end-of-round bench gets
+# a quiet field. One claimant via the campaign flock.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+while [ "$(date -u +%H%M)" -lt 1930 ]; do
+    python tpu_probe8.py >> probe8_r04.out 2>> probe8_r04.err
+    if [ -f TPU_PROBE8_r04.jsonl ] && grep -q '"stage": "mfu"' TPU_PROBE8_r04.jsonl; then
+        python tpu_probe9.py >> probe9_r04.out 2>> probe9_r04.err
+        break
+    fi
+    [ -f TPU_PROBE8_r04.jsonl ] && mv TPU_PROBE8_r04.jsonl "TPU_PROBE8_r04.abort.$(date -u +%H%M)"
+    sleep 60
+done
+echo "chaser exit $(date -u +%H:%M)" >> probe8_r04.err
