@@ -164,7 +164,7 @@ class ExperimentPlateauStopper(Stopper):
                 self._stagnant += 1
             else:
                 self._stagnant = 0
-            if self._stagnant > self._patience:
+            if self._stagnant >= max(1, self._patience):
                 self._should_stop = True
         return self._should_stop
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import base64
 import dataclasses
+import logging
 import os
 import shutil
 import tempfile
@@ -29,6 +30,8 @@ from .result_grid import ResultGrid
 from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
 from .search import BasicVariantGenerator, Searcher
 from .trial import ERRORED, PENDING, RUNNING, TERMINATED, Trial
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -55,7 +58,7 @@ class Tuner:
 
     @classmethod
     def restore(cls, path: str, trainable: Callable,
-                restart_errored: bool = True) -> "Tuner":
+                restart_errored: bool = False) -> "Tuner":
         """Resume an interrupted sweep from its experiment directory or
         URI (reference: `Tuner.restore(path, trainable,
         restart_errored=...)` — experiment state is reloaded, finished
@@ -63,9 +66,9 @@ class Tuner:
         their last checkpoints).  ``restart_errored=True`` restarts
         ERRORED trials FROM SCRATCH (reference semantics — their last
         checkpoint may be the poisoned state that erred);
-        ``restart_errored=False`` keeps them terminal.  This build
-        defaults to True — a restore usually follows fixing whatever
-        erred.
+        ``restart_errored=False`` (the default, matching the reference's
+        ``resume_errored=False``/``restart_errored=False``) keeps them
+        terminal.
 
         ``trainable`` must be the same callable the sweep ran — like the
         reference, code is not resurrected from disk, only state."""
@@ -161,7 +164,7 @@ class Tuner:
                               storage_override=getattr(
                                   self, "_restore_local_dir", None),
                               restart_errored=getattr(
-                                  self, "_restart_errored", True))
+                                  self, "_restart_errored", False))
         trials = runner.run()
         return ResultGrid(trials, cfg.metric, cfg.mode)
 
@@ -259,7 +262,7 @@ class _TrialRunner:
     def __init__(self, trainable, searcher, scheduler, tune_cfg: TuneConfig,
                  run_cfg: RunConfig, *, param_space=None,
                  restore_state=None, storage_override=None,
-                 restart_errored: bool = True):
+                 restart_errored: bool = False):
         from .syncer import SyncConfig, Syncer, is_uri, uri_join
         self.trainable = trainable
         self.searcher = searcher
@@ -351,9 +354,38 @@ class _TrialRunner:
                 # scratch (its checkpoint-resume variant is
                 # resume_errored) — the last checkpoint may be exactly
                 # the poisoned state that erred
+                logger.warning(
+                    "Tuner.restore(restart_errored=True): restarting "
+                    "errored trial %s from scratch (discarding its "
+                    "checkpoint)", t.trial_id)
+                # delete the on-disk checkpoints too — the rerun writes
+                # checkpoint_NNNNNN into the same per-trial dir and
+                # to_directory merges rather than clearing, so a stale
+                # pre-error file could survive inside a "fresh" one
+                trial_dir = os.path.join(self.storage, t.trial_id)
+                if os.path.isdir(trial_dir):
+                    for entry in os.listdir(trial_dir):
+                        if entry.startswith("checkpoint_"):
+                            shutil.rmtree(os.path.join(trial_dir, entry),
+                                          ignore_errors=True)
+                            if self._remote_dir is not None:
+                                # sync_up never deletes remote extras, so
+                                # purge the authoritative copy too (no-op
+                                # for non-listable s3/gs remotes)
+                                from .syncer import uri_join
+                                try:
+                                    self._syncer.delete(uri_join(
+                                        self._remote_dir, t.trial_id,
+                                        entry))
+                                except Exception:
+                                    pass
                 t.checkpoint_dir = None
                 t.iteration = 0
                 t.metrics_history = []
+                # scrub the pre-error result too — schedulers, searchers
+                # and the CLIReporter consume last_result until the
+                # restarted trial reports again
+                t.last_result = {}
             if t.status != TERMINATED:
                 # unfinished: relaunch from the last checkpoint
                 t.status = PENDING
@@ -480,6 +512,11 @@ class _TrialRunner:
                             f"checkpoint_{trial.iteration:06d}")
         if trial.checkpoint_dir and os.path.isdir(trial.checkpoint_dir):
             shutil.rmtree(trial.checkpoint_dir, ignore_errors=True)
+        if os.path.isdir(path):
+            # a restarted trial can re-reach an iteration number whose
+            # dir survived; to_directory merges rather than clearing, so
+            # stale pre-restart files would ride inside the new one
+            shutil.rmtree(path, ignore_errors=True)
         Checkpoint.from_bytes(blob).to_directory(path)
         trial.checkpoint_dir = path
         self._dirty = True
@@ -535,6 +572,12 @@ class _TrialRunner:
         max_trials = getattr(self.searcher, "total_trials",
                              self.cfg.num_samples)
         while True:
+            # poll experiment-wide stop every tick, not only when a trial
+            # reports (reference trial_runner.py:1137 polls per step) — a
+            # TimeoutStopper must fire even while trainables are silent
+            if not self._stop_all and self._stopper is not None \
+                    and self._stopper.stop_all():
+                self._stop_all = True
             if self._stop_all:
                 # a Stopper ended the experiment: stop every live trial
                 # gracefully and exit BEFORE launching/refilling — a

@@ -60,15 +60,42 @@ def test_restore_resumes_unfinished_trials(cluster, tmp_path):
     # "heal" the environment and resume in a fresh Tuner (same process
     # stands in for a fresh one; state flows only through the dir)
     open(flag, "w").close()
-    t2 = Tuner.restore(exp_dir, trainable)
+    # restart_errored must be opted into (default False matches the
+    # reference: errored trials stay terminal on a plain restore)
+    t2 = Tuner.restore(exp_dir, trainable, restart_errored=True)
     grid2 = t2.fit()
     by_x = {t.config["x"]: t for t in grid2._trials}
     assert by_x[2].status == "TERMINATED"
-    # resumed from checkpoint i=2: iterations 3..5 ran, final score 10
+    # restarted from scratch, ran 1..5 in the healed env: final score 10
     assert by_x[2].last_result["score"] == 10
+    # pin from-scratch (5 reports) vs checkpoint-resume (3 reports) —
+    # the final score is 10 on both paths, so count the reports
+    assert len(by_x[2].metrics_history) == 5, by_x[2].metrics_history
     # the finished trial kept its result without re-running
     assert by_x[1].status == "TERMINATED"
     assert by_x[1].last_result["score"] == 5
+
+
+def test_restore_default_keeps_errored_terminal(cluster, tmp_path):
+    flag = str(tmp_path / "healed")
+    storage = str(tmp_path / "exp_root2")
+    trainable = _trainable_factory(flag)
+
+    t1 = Tuner(trainable,
+               param_space={"x": ray_tpu.tune.grid_search([1, 2])},
+               tune_config=TuneConfig(metric="score", mode="max",
+                                      num_samples=1),
+               run_config=RunConfig(name="keep_errored",
+                                    storage_path=storage))
+    t1.fit()
+    exp_dir = os.path.join(storage, "keep_errored")
+    open(flag, "w").close()
+    # default restore: errored trials stay terminal (reference
+    # resume_errored/restart_errored both default False)
+    grid2 = Tuner.restore(exp_dir, trainable).fit()
+    by_x = {t.config["x"]: t for t in grid2._trials}
+    assert by_x[2].status == "ERRORED"
+    assert by_x[1].status == "TERMINATED"
 
 
 def test_storage_uri_syncs_experiment(cluster, tmp_path):
@@ -110,9 +137,9 @@ def test_syncer_incremental_and_multi_target(tmp_path):
 
 
 def test_restore_restart_errored_false_keeps_errored(cluster, tmp_path):
-    """restore(restart_errored=False) keeps ERRORED trials terminal
-    (reference: Tuner.restore's restart_errored flag); the default True
-    relaunches them."""
+    """restore(restart_errored=False) — the default — keeps ERRORED
+    trials terminal (reference: Tuner.restore's restart_errored flag);
+    restart_errored=True relaunches them from scratch."""
     import json as _json
 
     from ray_tpu import tune as tune_mod
@@ -141,5 +168,5 @@ def test_restore_restart_errored_false_keeps_errored(cluster, tmp_path):
     assert open(os.path.join(calls, "x2")).read().count("run") == 1
 
     Tuner.restore(exp, objective, restart_errored=True).fit()
-    # default/True path re-runs it (fails again, but it ran)
+    # restart_errored=True re-runs it (fails again, but it ran)
     assert open(os.path.join(calls, "x2")).read().count("run") == 2
