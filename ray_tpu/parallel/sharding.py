@@ -11,10 +11,13 @@ pjit/GSPMD recipe from the scaling playbook.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 MeshAxis = Union[str, Tuple[str, ...], None]
 
@@ -92,19 +95,71 @@ def tree_paths_to_logical(params: Any,
     return {path: ax for (path, _), ax in zip(flat_p, flat_a)}
 
 
+def _drop_nondividing_axes(spec: P, mesh: Mesh, shape) -> P:
+    """Replicate any dimension whose assigned mesh-axis product does not
+    divide it.  The canonical case is GQA under wide tensor parallelism:
+    n_kv_heads=2 with tp=4 cannot shard the kv-head dim, so k/v projections
+    fall back to replication across the excess tp ranks (the standard TPU
+    recipe) while q/o stay head-sharded."""
+    sizes = mesh.shape
+
+    entries = tuple(spec)
+    if len(entries) > len(shape):
+        raise ValueError(
+            f"sharding spec {spec} has {len(entries)} entries for a "
+            f"rank-{len(shape)} array of shape {tuple(shape)} — bad "
+            "logical-axes annotation")
+    entries = entries + (None,) * (len(shape) - len(entries))
+
+    def fix(entry, dim):
+        if entry is None:
+            return None
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        prod = 1
+        for a in axes:
+            prod *= sizes.get(a, 1)
+        if prod and dim % prod == 0:
+            return entry
+        logger.warning(
+            "sharding: axis %r (mesh extent %d) does not divide dim of "
+            "size %d (shape %s) — replicating that dimension instead",
+            entry, prod, dim, tuple(shape))
+        return None
+
+    return P(*(fix(e, d) for e, d in zip(entries, shape)))
+
+
 def pytree_shardings(params_axes: Any, mesh: Mesh,
-                     rules: Mapping[str, MeshAxis]) -> Any:
-    """Map a tree of logical-axis tuples → tree of NamedShardings."""
-    return jax.tree_util.tree_map(
-        lambda ax: named_sharding(mesh, ax, rules),
-        params_axes,
-        is_leaf=lambda x: x is None or isinstance(x, tuple))
+                     rules: Mapping[str, MeshAxis],
+                     params: Any = None) -> Any:
+    """Map a tree of logical-axis tuples → tree of NamedShardings.
+
+    With ``params`` given, each leaf's sharding is validated against its
+    shape and non-dividing mesh axes degrade to replication (GQA kv heads
+    under tp>n_kv_heads, odd vocab under wide tp, …)."""
+    is_axes_leaf = lambda x: x is None or isinstance(x, tuple)
+    if params is None:
+        return jax.tree_util.tree_map(
+            lambda ax: named_sharding(mesh, ax, rules),
+            params_axes, is_leaf=is_axes_leaf)
+
+    def fit(ax, p):
+        s = named_sharding(mesh, ax, rules)
+        shape = getattr(p, "shape", None)
+        if shape is None:
+            return s
+        return NamedSharding(mesh, _drop_nondividing_axes(s.spec, mesh,
+                                                          shape))
+
+    return jax.tree_util.tree_map(fit, params_axes, params,
+                                  is_leaf=is_axes_leaf)
 
 
 def shard_pytree(params: Any, params_axes: Any, mesh: Mesh,
                  rules: Mapping[str, MeshAxis]) -> Any:
-    """Place a host pytree onto the mesh under the given rules."""
-    shardings = pytree_shardings(params_axes, mesh, rules)
+    """Place a host pytree onto the mesh under the given rules (shape-aware:
+    non-dividing assignments replicate rather than error)."""
+    shardings = pytree_shardings(params_axes, mesh, rules, params=params)
     return jax.device_put(params, shardings)
 
 

@@ -100,3 +100,35 @@ def test_kv_roundtrip(local_cluster):
     assert kv.kv_keys("al", namespace="t") == [b"alpha"]
     assert kv.kv_del("alpha", namespace="t")
     assert kv.kv_get("alpha", namespace="t") is None
+
+
+def test_shape_aware_sharding_gqa_kv_replication():
+    """tp wider than n_kv_heads: shape-aware pytree_shardings replicates
+    the kv-head dim instead of erroring (the GQA-on-v4-32 class of bug
+    the 16/32-device dryrun flushes out), while q keeps its tp shard."""
+    from ray_tpu.parallel import pytree_shardings
+
+    mesh = local_mesh(tp=4, sp=2, fsdp=1)
+    params = {
+        "wq": jnp.zeros((2, 64, 4, 16)),   # (layers, embed, heads=4, kv)
+        "wk": jnp.zeros((2, 64, 2, 16)),   # kv_heads=2: 2 % tp4 != 0
+    }
+    axes = {"wq": ("layers", "embed", "heads", "kv"),
+            "wk": ("layers", "embed", "heads", "kv")}
+    sh = pytree_shardings(axes, mesh, FSDP_TP_RULES, params=params)
+    assert sh["wq"].spec == P(None, "fsdp", "tp", None)
+    assert sh["wk"].spec == P(None, "fsdp", None, None)
+    # and the placement actually succeeds
+    placed = jax.device_put(params, sh)
+    assert placed["wk"].sharding.spec == P(None, "fsdp", None, None)
+
+
+def test_shape_aware_sharding_without_params_unchanged():
+    """No params given: pytree_shardings keeps the raw rule mapping (the
+    pre-existing contract for shape-agnostic callers)."""
+    from ray_tpu.parallel import pytree_shardings
+
+    mesh = local_mesh(tp=4, sp=2, fsdp=1)
+    sh = pytree_shardings({"wk": ("layers", "embed", "heads", "kv")},
+                          mesh, FSDP_TP_RULES)
+    assert sh["wk"].spec == P(None, "fsdp", "tp", None)

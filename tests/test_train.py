@@ -255,3 +255,46 @@ def test_tensorflow_trainer_tf_config(cluster):
     assert res.error is None
     assert res.metrics["n_workers"] == 3
     assert res.metrics["type"] == "worker"
+
+
+def test_gang_world_size_4_cross_process_collective(cluster, tmp_path):
+    """A 4-process SPMD gang (VERDICT r4 item 7): every worker joins one
+    jax.distributed runtime through the controller-KV rendezvous, the
+    mesh spans all four processes (dp=4 outermost, one row per process),
+    and a jitted global reduction over a dp-sharded array returns the
+    cross-process total on every rank."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.air import ScalingConfig, session as tsession
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.backend import SpmdConfig
+
+    def train_loop(config):
+        mesh = tsession.get_mesh()
+        assert jax.process_count() == 4
+        rank = jax.process_index()
+        dp = mesh.devices.shape[list(mesh.axis_names).index("dp")]
+        assert dp == 4, mesh.devices.shape
+        per = 2
+        sh = NamedSharding(mesh, P("dp"))
+        local = np.full((per,), float(rank), np.float32)
+        x = jax.make_array_from_process_local_data(
+            sh, local, global_shape=(per * 4,))
+        total = jax.jit(jnp.sum,
+                        out_shardings=NamedSharding(mesh, P()))(x)
+        tsession.report({"rank": tsession.get_world_rank(),
+                         "total": float(total),
+                         "world": tsession.get_world_size()})
+
+    result = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=4),
+        backend_config=SpmdConfig(mesh="dp=4,fsdp=-1"),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["world"] == 4
+    # 2 elements per process, values 0+1+2+3 → 2*6
+    assert result.metrics["total"] == 12.0

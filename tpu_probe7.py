@@ -23,7 +23,7 @@ import time  # noqa: E402
 from probe_common import (ProbeLedger, enable_compile_cache,  # noqa: E402
                           measure_mfu)
 
-OUT = __file__.replace("tpu_probe7.py", "TPU_PROBE7_r04.jsonl")
+OUT = __file__.replace("tpu_probe7.py", "TPU_PROBE7_r05.jsonl")
 
 
 def main() -> None:

@@ -15,7 +15,7 @@ import time
 
 from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
 
-OUT = __file__.replace("tpu_probe8.py", "TPU_PROBE8_r04.jsonl")
+OUT = __file__.replace("tpu_probe8.py", "TPU_PROBE8_r05.jsonl")
 
 
 def main() -> None:

@@ -15,7 +15,7 @@ import time
 
 from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
 
-OUT = __file__.replace("tpu_probe9.py", "TPU_PROBE9_r04.jsonl")
+OUT = __file__.replace("tpu_probe9.py", "TPU_PROBE9_r05.jsonl")
 
 
 def main() -> None:
@@ -28,8 +28,15 @@ def main() -> None:
     nr = dict(remat=False, norm_remat=True)
     dots = dict(remat="dots", norm_remat=True)
     bf16 = jnp.bfloat16
+    naive = dict(nr, attention_impl="reference")
     for tag, kw, batch, seq in (
+            # flash-vs-naive at identical configs (VERDICT r4 weak #3:
+            # the seq2048 kernel microbench showed 1.03x parity — settle
+            # it with train-step MFU on both impls at 2048 and 4096)
+            ("b2_seq2048_flash", dict(nr, attention_impl="flash"), 2, 2048),
+            ("b2_seq2048_naive", naive, 2, 2048),
             ("b2_seq4096", nr, 2, 4096),
+            ("b2_seq4096_naive", naive, 2, 4096),
             ("b4_seq4096", nr, 4, 4096),
             ("b1_seq8192", nr, 1, 8192),
             ("b2_seq8192_dots", dots, 2, 8192),
