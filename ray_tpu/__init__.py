@@ -17,6 +17,8 @@ from .api import (  # noqa: F401
     available_resources,
     cancel,
     cluster_resources,
+    cpp_actor,
+    cpp_function,
     get,
     get_actor,
     get_runtime_context,

@@ -151,8 +151,11 @@ class ClientContext:
 
 
 # ----------------------------------------------------------------- object ops
-def put(value: Any) -> ObjectRef:
-    return _ensure_initialized().put(value)
+def put(value: Any, *, xlang: bool = False) -> ObjectRef:
+    """Store a value.  ``xlang=True`` uses the cross-language RTX1
+    encoding (msgpack-typed values only) so C++ workers can consume the
+    object (`cpp_function` / `cpp_actor` args)."""
+    return _ensure_initialized().put(value, xlang=xlang)
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
@@ -422,6 +425,136 @@ class ActorClass:
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Actor class {self._cls.__name__} cannot be "
                         "instantiated directly; use .remote()")
+
+
+# --------------------------------------------------------------- C++ tasks
+# Worker-side native execution (reference: cpp/src/ray/runtime/task/
+# task_executor.cc executes RAY_REMOTE functions in C++ workers).  A cpp
+# task's descriptor is "path/to/lib.so:Name" built against
+# ray_tpu/cpp/task_api.h; the nodelet routes lang=="cpp" leases to native
+# worker processes (core/nodelet.py _spawn_cpp_worker).  Arguments and
+# returns cross in the RTX1 xlang format — msgpack-typed values only,
+# plus ObjectRefs to other xlang objects.
+
+def _encode_xlang_args(core, args: tuple) -> list:
+    encoded = []
+    for a in args:
+        if isinstance(a, ObjectRef):
+            encoded.append([1, a.binary()])          # ARG_REF
+        else:
+            encoded.append([0, serialization.serialize_xlang(a)])
+    return encoded
+
+
+class CppFunction:
+    """Handle to a C++ function exported via RAY_TPU_REMOTE."""
+
+    def __init__(self, library: str, symbol: str, options: dict):
+        self._library = os.path.abspath(library)
+        self._symbol = symbol
+        self._opts = {**_DEFAULT_TASK_OPTIONS, **options}
+        self._fname = f"{self._library}:{symbol}"
+        self._fid = hashlib.sha256(self._fname.encode()).digest()[:20]
+
+    def options(self, **overrides) -> "CppFunction":
+        return CppFunction(self._library, self._symbol,
+                           {**self._opts, **overrides})
+
+    def remote(self, *args) -> ObjectRef:
+        core = _ensure_initialized()
+        opts = self._opts
+        spec = TaskSpec.build(
+            task_id=TaskID.for_driver(core.job_id),
+            job_id=core.job_id,
+            function_id=self._fid,
+            function_name=self._fname,
+            args=_encode_xlang_args(core, args),
+            num_returns=1,
+            resources=_resolve_resources(opts),
+            owner_addr="",
+            max_retries=opts["max_retries"] or 0,
+            scheduling_strategy=_strategy_dict(opts),
+            lang="cpp",
+        )
+        return core.submit_task(spec)[0]
+
+
+class CppActorHandle:
+    """Handle to a C++ actor; methods are invoked by name:
+    ``handle.task("method", *args)``."""
+
+    def __init__(self, actor_id: bytes, class_name: str):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    def task(self, method: str, *args) -> ObjectRef:
+        core = _ensure_initialized()
+        core.attach_actor(self._actor_id, self._class_name)
+        spec = TaskSpec.build(
+            task_id=TaskID.of(ActorID(self._actor_id)),
+            job_id=core.job_id,
+            function_id=b"\x00" * 20,
+            function_name=method,
+            args=_encode_xlang_args(core, args),
+            num_returns=1,
+            resources={},
+            owner_addr="",
+            actor_id=ActorID(self._actor_id),
+            lang="cpp",
+        )
+        return core.submit_actor_task(self._actor_id, spec)[0]
+
+    def __reduce__(self):
+        return (CppActorHandle, (self._actor_id, self._class_name))
+
+    def __repr__(self):
+        return (f"CppActorHandle({self._class_name}, "
+                f"{self._actor_id.hex()[:12]})")
+
+
+class CppActorClass:
+    def __init__(self, library: str, class_name: str, options: dict):
+        self._library = os.path.abspath(library)
+        self._class_name = class_name
+        self._opts = {**_DEFAULT_TASK_OPTIONS, "max_concurrency": 1,
+                      "max_restarts": 0, **options}
+        self._fname = f"{self._library}:{class_name}"
+        self._fid = hashlib.sha256(self._fname.encode()).digest()[:20]
+
+    def options(self, **overrides) -> "CppActorClass":
+        return CppActorClass(self._library, self._class_name,
+                             {**self._opts, **overrides})
+
+    def remote(self, *args) -> CppActorHandle:
+        core = _ensure_initialized()
+        actor_id = ActorID.of(core.job_id)
+        spec = TaskSpec.build(
+            task_id=TaskID.of(actor_id),
+            job_id=core.job_id,
+            function_id=self._fid,
+            function_name=self._fname,
+            args=_encode_xlang_args(core, args),
+            num_returns=0,
+            resources=_resolve_resources(self._opts) or {"CPU": 0.0},
+            owner_addr="",
+            actor_creation_id=actor_id,
+            max_restarts=int(self._opts.get("max_restarts") or 0),
+            scheduling_strategy=_strategy_dict(self._opts),
+            lang="cpp",
+        )
+        final_id = core.create_actor(spec, name=self._opts.get("name"),
+                                     detached=False)
+        return CppActorHandle(final_id, self._class_name)
+
+
+def cpp_function(library: str, symbol: str, **options) -> CppFunction:
+    """A remote C++ function: ``cpp_function("libmy.so", "Add").remote(1, 2)``."""
+    return CppFunction(library, symbol, options)
+
+
+def cpp_actor(library: str, class_name: str, **options) -> CppActorClass:
+    """A C++ actor class: ``cpp_actor("libmy.so", "Counter").remote()``."""
+    return CppActorClass(library, class_name, options)
 
 
 def remote(*args, **options):

@@ -108,9 +108,11 @@ class ClientCore(DeferredRefDecs):
             pass
 
     # -------------------------------------------------------------- data ops
-    def put(self, value: Any) -> ObjectRef:
-        blob = serialization.serialize_to_bytes(value)
-        r = self._srv.call("client_put", {"blob": blob}, timeout=120)
+    def put(self, value: Any, xlang: bool = False) -> ObjectRef:
+        blob = serialization.serialize_xlang(value) if xlang \
+            else serialization.serialize_to_bytes(value)
+        r = self._srv.call("client_put", {"blob": blob, "xlang": xlang},
+                           timeout=120)
         return ObjectRef(ObjectID(r["object_id"]), self)
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float]

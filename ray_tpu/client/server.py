@@ -88,7 +88,7 @@ class ClientServer:
 
     def _h_put(self, conn, data):
         value = serialization.deserialize(memoryview(data["blob"]))
-        ref = self.core.put(value)
+        ref = self.core.put(value, xlang=data.get("xlang", False))
         self._hold(conn, ref)
         return {"object_id": ref.binary()}
 

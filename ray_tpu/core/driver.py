@@ -340,11 +340,17 @@ class CoreClient(DeferredRefDecs):
         self._notify_controller("free_request", {"object_ids": [oid]})
 
     # ------------------------------------------------------------------- put
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, xlang: bool = False) -> ObjectRef:
         self._put_index += 1
         oid = ObjectID.for_put(self.task_ctx, self._put_index)
         contained: List[bytes] = []
-        parts = serialization.serialize(value, ref_collector=contained)
+        if xlang:
+            # cross-language encoding (RTX1): readable by non-Python
+            # workers; msgpack-typed values only (reference: the
+            # cross-language serializer is likewise opt-in per object)
+            parts = [memoryview(serialization.serialize_xlang(value))]
+        else:
+            parts = serialization.serialize(value, ref_collector=contained)
         size = serialization.serialized_size(parts)
         with self._ref_lock:
             self._owned.add(oid.binary())

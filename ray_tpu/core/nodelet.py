@@ -32,9 +32,11 @@ from .task_spec import ResourceSet, TaskSpec
 
 
 class WorkerProc:
-    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen,
+                 lang: str = "py"):
         self.worker_id = worker_id
         self.proc = proc
+        self.lang = lang          # "py" | "cpp" (executes native tasks)
         self.port: Optional[int] = None
         self.registered = asyncio.Event()
         self.spawned_at = time.monotonic()
@@ -528,11 +530,14 @@ class Nodelet:
         return True
 
     # ------------------------------------------------------------ worker pool
-    async def _spawn_worker(self) -> WorkerProc:
+    async def _spawn_worker(self, lang: str = "py") -> WorkerProc:
         """Fork a worker from the zygote (~10 ms) or exec one (~250 ms).
 
-        The fork-server path is the default; it falls back to the exec
-        path transparently if the zygote is missing or died.
+        The fork-server path is the default for Python; it falls back to
+        the exec path transparently if the zygote is missing or died.
+        C++ workers (lang="cpp") always exec the native worker binary
+        (reference: C++ workers are their own executable too —
+        cpp/src/ray/runtime/).
         """
         worker_id = WorkerID.from_random().binary()
         self._next_worker_seq += 1
@@ -541,6 +546,8 @@ class Nodelet:
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         env = dict(self.worker_env)
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if lang == "cpp":
+            return await self._spawn_cpp_worker(worker_id, log_path, env)
         proc = None
         if self.zygote is not None and not self.zygote.dead:
             self._spawns_inflight += 1
@@ -580,6 +587,40 @@ class Nodelet:
         self.workers[worker_id] = w
         return w
 
+    async def _spawn_cpp_worker(self, worker_id: bytes, log_path: str,
+                                env: Dict[str, str]) -> WorkerProc:
+        """Exec the native C++ worker binary (built on demand from
+        ray_tpu/cpp/worker_main.cc; speaks the same register/push_task
+        wire protocol as the Python worker runtime)."""
+        from ..cpp import build as cpp_build
+        from .object_store import client as store_client
+        loop = asyncio.get_event_loop()
+        # g++ runs off-loop: a cold multi-second compile must not stall
+        # heartbeats/leases (it's an mtime-checked no-op afterwards)
+        binary = await loop.run_in_executor(None,
+                                            cpp_build.ensure_worker_built)
+        store_lib = await loop.run_in_executor(None,
+                                              store_client._ensure_built)
+        full_env = dict(os.environ)
+        full_env.update(env)
+        full_env["RAY_TPU_STORE_LIB"] = store_lib
+        logf = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [binary,
+             "--nodelet", self.address,
+             "--controller", self.controller_addr,
+             "--store", self.store_path,
+             "--node-id", self.node_id.hex(),
+             "--worker-id", worker_id.hex(),
+             "--session-dir", self.session_dir],
+            stdout=logf, stderr=subprocess.STDOUT, env=full_env,
+            start_new_session=True)
+        logf.close()
+        rtm.WORKERS_SPAWNED.inc(tags={**self._mnode, "mode": "cpp"})
+        w = WorkerProc(worker_id, proc, lang="cpp")
+        self.workers[worker_id] = w
+        return w
+
     async def _h_register_worker(self, conn, data):
         w = self.workers.get(data["worker_id"])
         if w is None:
@@ -599,26 +640,32 @@ class Nodelet:
                 await self._spawn_worker()
         return True
 
-    async def _pop_idle_worker(self, waiting: int = 1) -> Optional[WorkerProc]:
+    async def _pop_idle_worker(self, waiting: int = 1,
+                               lang: str = "py") -> Optional[WorkerProc]:
         for w in self.workers.values():
-            if w.state == "idle":
+            if w.state == "idle" and w.lang == lang:
                 return w
         # Spawn by demand, not per poll: at most ``waiting`` workers may be
         # concurrently starting, else a burst of lease retries forks an
         # import storm that starves the very workers it is waiting on.
         # Actor-dedicated workers never come back, so they live under their
         # own (large) cap — else the 16-worker pool cap deadlocks the 17th
-        # actor forever.
+        # actor forever.  The starting-throttle counts only the requested
+        # language, so a burst of python spawns can't starve a cpp lease.
         starting = self._spawns_inflight + sum(
-            1 for w in self.workers.values() if w.state == "starting")
+            1 for w in self.workers.values()
+            if w.state == "starting" and w.lang == lang)
         actor_workers = sum(1 for w in self.workers.values()
                             if w.state == "actor")
+        # The pool cap is per-language: a full pool of idle PYTHON
+        # workers (which are never reaped) must not starve the first cpp
+        # lease forever, and vice versa.
         pool = self._spawns_inflight + sum(
             1 for w in self.workers.values()
-            if w.state not in ("dead", "actor"))
+            if w.state not in ("dead", "actor") and w.lang == lang)
         if starting < waiting and pool < GlobalConfig.worker_pool_max_size \
                 and actor_workers < GlobalConfig.actor_workers_max:
-            await self._spawn_worker()
+            await self._spawn_worker(lang=lang)
         return None
 
     async def _notify_lease_waiters(self):
@@ -670,7 +717,8 @@ class Nodelet:
                                      f"(cluster node totals: {totals})",
                             "infeasible": True}
             if self.available.fits(request):
-                worker = await self._pop_idle_worker(self._lease_waiters)
+                worker = await self._pop_idle_worker(self._lease_waiters,
+                                                     lang=spec.lang)
                 if worker is not None:
                     lease_id = os.urandom(16)
                     self.available.acquire(request)
@@ -718,7 +766,8 @@ class Nodelet:
                 # once (capped) instead of strictly one at a time
                 worker = await self._pop_idle_worker(
                     waiting=min(self._pending_actor_starts,
-                                GlobalConfig.actor_spawn_parallelism))
+                                GlobalConfig.actor_spawn_parallelism),
+                    lang=spec.lang)
                 if worker is None:
                     if time.monotonic() > deadline:
                         return {"ok": False, "retry": True,

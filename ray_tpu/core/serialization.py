@@ -134,6 +134,27 @@ def serialize_to_bytes(value: Any) -> bytes:
     return b"".join(bytes(p) for p in parts)
 
 
+_XLANG_MAGIC = b"RTX1"  # ray-tpu xlang object: header + raw msgpack
+
+
+def serialize_xlang(value: Any) -> bytes:
+    """Cross-language object encoding: plain msgpack behind an RTX1 magic.
+
+    The reference restricts cross-language data (java/cpp ↔ python) to
+    msgpack-representable values (`cpp/` xlang boundary); same here —
+    nil/bool/int/float/str/bytes/list/dict only.  Objects in this format
+    are readable by every language runtime: `deserialize` dispatches on
+    the magic, so a Python driver `get()`s a C++ task's return directly
+    and a C++ worker reads Python-sent args without speaking pickle."""
+    try:
+        return _XLANG_MAGIC + msgpack.packb(value, use_bin_type=True)
+    except (TypeError, ValueError) as e:
+        raise TypeError(
+            f"value of type {type(value).__name__} does not cross the "
+            "xlang boundary (allowed: nil/bool/int/float/str/bytes/"
+            f"list/dict): {e}") from None
+
+
 def deserialize(data: memoryview) -> Any:
     """Deserialize from a single contiguous buffer.
 
@@ -141,6 +162,8 @@ def deserialize(data: memoryview) -> Any:
     arrays produced here alias store memory and are read-only, exactly like
     the reference's zero-copy numpy reads from plasma.
     """
+    if bytes(data[:4]) == _XLANG_MAGIC:
+        return msgpack.unpackb(bytes(data[4:]), raw=False)
     if bytes(data[:4]) != _MAGIC:
         raise ValueError("corrupt object: bad magic")
     (hlen,) = struct.unpack("<I", data[4:8])

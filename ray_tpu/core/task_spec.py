@@ -99,6 +99,7 @@ class TaskSpec:
         runtime_env: Optional[Dict[str, Any]] = None,
         concurrency_groups: Optional[Dict[str, int]] = None,
         concurrency_group: Optional[str] = None,
+        lang: str = "py",
     ) -> "TaskSpec":
         return cls({
             "tid": task_id.binary(),
@@ -122,6 +123,7 @@ class TaskSpec:
             "renv": runtime_env or {},
             "cgroups": concurrency_groups or {},
             "cgroup": concurrency_group,
+            "lang": lang,
         })
 
     # -- accessors -----------------------------------------------------------
@@ -203,6 +205,12 @@ class TaskSpec:
     @property
     def scheduling_strategy(self) -> Dict[str, Any]:
         return self.d.get("strategy") or {}
+
+    @property
+    def lang(self) -> str:
+        """Execution language: "py" (cloudpickled Python) or "cpp" (native
+        worker; reference cpp/src/ray/runtime/task/task_executor.cc)."""
+        return self.d.get("lang") or "py"
 
     @property
     def concurrency_groups(self) -> Dict[str, int]:

@@ -1,0 +1,3 @@
+"""Native C++ language surface: driver client (ray_tpu_client.cc),
+worker-side task/actor execution (worker_main.cc + task_api.h), and
+on-demand builds (build.py)."""

@@ -1,0 +1,43 @@
+"""On-demand builds for the native C++ worker and example task library.
+
+Same pattern as the object store's auto-compile
+(core/object_store/client.py::_ensure_built): g++ straight from the
+in-tree sources, mtime-checked, atomic rename."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+
+
+def _build(output: str, srcs: list, extra: list) -> str:
+    out_path = os.path.join(_DIR, output)
+    src_paths = [os.path.join(_DIR, s) for s in srcs]
+    hdrs = [os.path.join(_DIR, h)
+            for h in ("msgpack_lite.h", "task_api.h")
+            if os.path.exists(os.path.join(_DIR, h))]
+    with _lock:
+        newest = max(os.path.getmtime(p) for p in src_paths + hdrs)
+        if not os.path.exists(out_path) \
+                or os.path.getmtime(out_path) < newest:
+            tmp = out_path + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", *extra, "-o", tmp, *src_paths],
+                check=True, capture_output=True, cwd=_DIR)
+            os.replace(tmp, out_path)
+    return out_path
+
+
+def ensure_worker_built() -> str:
+    """The native worker binary the nodelet execs for lang="cpp" leases."""
+    return _build("ray_tpu_cpp_worker", ["worker_main.cc"], ["-ldl"])
+
+
+def ensure_example_lib_built() -> str:
+    """The example/test task library (task_api.h fixture)."""
+    return _build("libexample.so", ["example_tasks.cc"],
+                  ["-shared", "-fPIC"])

@@ -1,0 +1,53 @@
+// Example C++ task library (also the test fixture for
+// tests/test_cpp_worker.py) — the counterpart of the reference's
+// cpp/example/example.cc RAY_REMOTE demo, executed by the native worker.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 example_tasks.cc -o libexample.so
+#include "task_api.h"
+
+using ray_tpu::msgpack_lite::Value;
+
+static Value Add(const std::vector<Value>& args) {
+  return Value::Of(args[0].as_int() + args[1].as_int());
+}
+RAY_TPU_REMOTE(Add);
+
+static Value Concat(const std::vector<Value>& args) {
+  return Value::Str(args[0].as_str() + args[1].as_str());
+}
+RAY_TPU_REMOTE(Concat);
+
+// Sums a list argument — exercises nested xlang values.
+static Value SumList(const std::vector<Value>& args) {
+  int64_t total = 0;
+  for (const auto& v : args[0].arr) total += v.as_int();
+  return Value::Of(total);
+}
+RAY_TPU_REMOTE(SumList);
+
+// Returns a large bytes payload — exercises the shared-memory return
+// path (result > max_direct_call_object_size goes to the store).
+static Value BigBlob(const std::vector<Value>& args) {
+  return Value::Bin(std::string((size_t)args[0].as_int(), 'x'));
+}
+RAY_TPU_REMOTE(BigBlob);
+
+static Value Fail(const std::vector<Value>&) {
+  throw std::runtime_error("deliberate C++ task failure");
+}
+RAY_TPU_REMOTE(Fail);
+
+struct Counter : ray_tpu::CppActor {
+  int64_t n;
+  explicit Counter(const std::vector<Value>& args)
+      : n(args.empty() ? 0 : args[0].as_int()) {}
+  Value Call(const std::string& m, const std::vector<Value>& a) override {
+    if (m == "add") {
+      n += a[0].as_int();
+      return Value::Of(n);
+    }
+    if (m == "get") return Value::Of(n);
+    throw std::runtime_error("Counter has no method '" + m + "'");
+  }
+};
+RAY_TPU_ACTOR(Counter);

@@ -1,0 +1,186 @@
+// User-facing C++ task/actor API: build your functions into a shared
+// library the native worker executes.
+//
+// The reference's C++ worker API registers remote functions with
+// RAY_REMOTE and executes them inside C++ workers
+// (/root/reference/cpp/include/ray/api.h, RAY_REMOTE in
+// cpp/include/ray/api/function_manager.h); this header is that surface
+// for the TPU-native runtime.  Usage:
+//
+//   #include "task_api.h"
+//   using ray_tpu::msgpack_lite::Value;
+//   static Value Add(const std::vector<Value>& args) {
+//     return Value::Of(args[0].as_int() + args[1].as_int());
+//   }
+//   RAY_TPU_REMOTE(Add);
+//
+//   struct Counter : ray_tpu::CppActor {
+//     int64_t n = 0;
+//     Value Call(const std::string& m,
+//                const std::vector<Value>& a) override {
+//       if (m == "add") { n += a[0].as_int(); return Value::Of(n); }
+//       if (m == "get") return Value::Of(n);
+//       throw std::runtime_error("no method " + m);
+//     }
+//   };
+//   RAY_TPU_ACTOR(Counter);
+//
+// Compile: g++ -O2 -shared -fPIC -std=c++17 mylib.cc -o libmy.so
+// Invoke from Python:
+//   f = ray_tpu.cpp_function("/path/libmy.so", "Add")
+//   ray_tpu.get(f.remote(2, 3))                      # -> 5
+//   c = ray_tpu.cpp_actor("/path/libmy.so", "Counter").remote()
+//   ray_tpu.get(c.task("add", 7))                    # -> 7
+//
+// Values cross the boundary as msgpack (RTX1 xlang format): nil, bool,
+// int, float, str, bytes, list, dict — the same restriction the
+// reference places on cross-language calls.
+#pragma once
+
+#include <cstring>
+#include <functional>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "msgpack_lite.h"
+
+namespace ray_tpu {
+
+using TaskFn = std::function<msgpack_lite::Value(
+    const std::vector<msgpack_lite::Value>&)>;
+
+struct CppActor {
+  virtual msgpack_lite::Value Call(
+      const std::string& method,
+      const std::vector<msgpack_lite::Value>& args) = 0;
+  virtual ~CppActor() = default;
+};
+
+using ActorFactory = std::function<CppActor*(
+    const std::vector<msgpack_lite::Value>&)>;
+
+inline std::map<std::string, TaskFn>& TaskRegistry() {
+  static std::map<std::string, TaskFn> r;
+  return r;
+}
+
+inline std::map<std::string, ActorFactory>& ActorRegistry() {
+  static std::map<std::string, ActorFactory> r;
+  return r;
+}
+
+struct TaskRegistrar {
+  TaskRegistrar(const char* name, TaskFn fn) {
+    TaskRegistry()[name] = std::move(fn);
+  }
+};
+
+struct ActorRegistrar {
+  ActorRegistrar(const char* name, ActorFactory f) {
+    ActorRegistry()[name] = std::move(f);
+  }
+};
+
+}  // namespace ray_tpu
+
+#define RAY_TPU_REMOTE(fn)                                              \
+  static ::ray_tpu::TaskRegistrar _ray_tpu_reg_##fn(#fn, fn)
+
+#define RAY_TPU_ACTOR(cls)                                              \
+  static ::ray_tpu::ActorRegistrar _ray_tpu_actor_##cls(                \
+      #cls, [](const std::vector<::ray_tpu::msgpack_lite::Value>& a)    \
+                -> ::ray_tpu::CppActor* { return new cls(a); })
+
+// Variant for actors whose constructor ignores creation args.
+#define RAY_TPU_ACTOR_NOARGS(cls)                                       \
+  static ::ray_tpu::ActorRegistrar _ray_tpu_actor_##cls(                \
+      #cls, [](const std::vector<::ray_tpu::msgpack_lite::Value>&)      \
+                -> ::ray_tpu::CppActor* { return new cls(); })
+
+// ----------------------------------------------------------------- worker ABI
+// Fixed extern "C" surface the native worker dlopens.  Implemented once
+// here (header-only): every user library exports the same symbols.
+// ``inline`` keeps multi-TU inclusion ODR-clean (weak linkage);
+// ``used`` + default visibility force the unreferenced definitions into
+// the .so's dynamic symbol table for dlsym.
+#define RAY_TPU_ABI \
+  inline __attribute__((used, visibility("default")))
+
+extern "C" {
+
+RAY_TPU_ABI char* _ray_tpu_strdup(const std::string& s) {
+  char* p = (char*)malloc(s.size() + 1);
+  memcpy(p, s.data(), s.size() + 1);
+  return p;
+}
+
+RAY_TPU_ABI int ray_tpu_cpp_invoke(const char* name, const char* args,
+                              size_t args_len, char** out, size_t* out_len,
+                              char** err) {
+  try {
+    auto& reg = ::ray_tpu::TaskRegistry();
+    auto it = reg.find(name);
+    if (it == reg.end())
+      throw std::runtime_error(std::string("no registered task '") + name +
+                               "' (RAY_TPU_REMOTE it)");
+    auto arr =
+        ::ray_tpu::msgpack_lite::Unpack(std::string(args, args_len)).arr;
+    auto result = it->second(arr);
+    std::string packed = ::ray_tpu::msgpack_lite::Pack(result);
+    *out_len = packed.size();
+    *out = (char*)malloc(packed.size());
+    memcpy(*out, packed.data(), packed.size());
+    return 0;
+  } catch (const std::exception& e) {
+    *err = _ray_tpu_strdup(e.what());
+    return 1;
+  }
+}
+
+RAY_TPU_ABI int ray_tpu_cpp_actor_new(const char* cls, const char* args,
+                                 size_t args_len, void** instance,
+                                 char** err) {
+  try {
+    auto& reg = ::ray_tpu::ActorRegistry();
+    auto it = reg.find(cls);
+    if (it == reg.end())
+      throw std::runtime_error(std::string("no registered actor '") + cls +
+                               "' (RAY_TPU_ACTOR it)");
+    auto arr =
+        ::ray_tpu::msgpack_lite::Unpack(std::string(args, args_len)).arr;
+    *instance = it->second(arr);
+    return 0;
+  } catch (const std::exception& e) {
+    *err = _ray_tpu_strdup(e.what());
+    return 1;
+  }
+}
+
+RAY_TPU_ABI int ray_tpu_cpp_actor_call(void* instance, const char* method,
+                                  const char* args, size_t args_len,
+                                  char** out, size_t* out_len, char** err) {
+  try {
+    auto arr =
+        ::ray_tpu::msgpack_lite::Unpack(std::string(args, args_len)).arr;
+    auto result =
+        ((::ray_tpu::CppActor*)instance)->Call(method, arr);
+    std::string packed = ::ray_tpu::msgpack_lite::Pack(result);
+    *out_len = packed.size();
+    *out = (char*)malloc(packed.size());
+    memcpy(*out, packed.data(), packed.size());
+    return 0;
+  } catch (const std::exception& e) {
+    *err = _ray_tpu_strdup(e.what());
+    return 1;
+  }
+}
+
+RAY_TPU_ABI void ray_tpu_cpp_actor_destroy(void* instance) {
+  delete (::ray_tpu::CppActor*)instance;
+}
+
+RAY_TPU_ABI void ray_tpu_cpp_free(char* p) { free(p); }
+
+}  // extern "C"

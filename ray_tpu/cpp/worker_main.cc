@@ -1,0 +1,683 @@
+// Native C++ worker: task/actor EXECUTION in C++.
+//
+// The reference executes tasks inside C++ worker processes
+// (/root/reference/cpp/src/ray/runtime/task/task_executor.cc with the
+// user API in cpp/include/ray/api/); this is that capability for the
+// TPU-native runtime.  The binary speaks the exact worker wire protocol
+// of ray_tpu/core/worker_runtime.py — register_worker with the nodelet,
+// then serve push_task / create_actor / push_actor_task / ping / exit
+// frames — so the nodelet leases it like any Python worker (routed by
+// TaskSpec lang=="cpp", nodelet._spawn_cpp_worker).
+//
+// Execution model: user code lives in a shared library that implements
+// the fixed ABI of task_api.h (ray_tpu_cpp_invoke / ray_tpu_cpp_actor_*);
+// function descriptors are "path/to/lib.so:name".  Values cross the
+// language boundary in the RTX1 xlang object format (msgpack behind a
+// 4-byte magic, core/serialization.py serialize_xlang) — the same
+// msgpack-typed data restriction as the reference's cross-language calls.
+// The worker is single-threaded: tasks execute inline in the frame loop
+// (max_concurrency==1 semantics; per-connection FIFO gives per-caller
+// actor ordering).
+//
+// Object store access is direct: the worker links the rts_* C API of
+// store.cc (dlopened libtpustore.so) and reads argument objects /
+// writes large returns straight in shared memory; missing objects are
+// pulled via the nodelet ("pull" RPC) exactly like the Python worker.
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "msgpack_lite.h"
+
+using ray_tpu::msgpack_lite::Pack;
+using ray_tpu::msgpack_lite::Unpack;
+using Val = ray_tpu::msgpack_lite::Value;
+
+namespace {
+
+constexpr int kRequest = 0, kReply = 1, kError = 2, kNotify = 3;
+constexpr int kArgValue = 0, kArgRef = 1;
+const char kXMagic[4] = {'R', 'T', 'X', '1'};
+
+// ---------------------------------------------------------------- store API
+struct StoreApi {
+  void* handle = nullptr;            // rts segment handle
+  uint8_t* base = nullptr;           // mapped segment base
+  int64_t (*create)(void*, const uint8_t*, uint64_t) = nullptr;
+  int (*seal)(void*, const uint8_t*) = nullptr;
+  int (*abort_)(void*, const uint8_t*) = nullptr;
+  int (*get)(void*, const uint8_t*, int64_t, uint64_t*, uint64_t*) = nullptr;
+  int (*release)(void*, const uint8_t*) = nullptr;
+};
+
+// Handle layout prefix — must match store.cc's Handle {fd, base, size, hdr}
+// (same prefix-view trick transfer.cc uses for zero-copy sends).
+struct HandleView {
+  int fd;
+  uint8_t* base;
+};
+
+StoreApi OpenStore(const std::string& lib_path, const std::string& seg_path,
+                   std::string* err) {
+  StoreApi api;
+  void* lib = dlopen(lib_path.c_str(), RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    *err = std::string("dlopen libtpustore: ") + dlerror();
+    return api;
+  }
+  void* (*open_fn)(const char*) =
+      (void* (*)(const char*))dlsym(lib, "rts_open");
+  api.create = (int64_t (*)(void*, const uint8_t*, uint64_t))
+      dlsym(lib, "rts_create");
+  api.seal = (int (*)(void*, const uint8_t*))dlsym(lib, "rts_seal");
+  api.abort_ = (int (*)(void*, const uint8_t*))dlsym(lib, "rts_abort");
+  api.get = (int (*)(void*, const uint8_t*, int64_t, uint64_t*, uint64_t*))
+      dlsym(lib, "rts_get");
+  api.release = (int (*)(void*, const uint8_t*))dlsym(lib, "rts_release");
+  if (!open_fn || !api.create || !api.seal || !api.get || !api.release) {
+    *err = "libtpustore missing rts_* symbols";
+    return api;
+  }
+  api.handle = open_fn(seg_path.c_str());
+  if (!api.handle) {
+    *err = "rts_open failed for " + seg_path;
+    return api;
+  }
+  api.base = ((HandleView*)api.handle)->base;
+  return api;
+}
+
+// ----------------------------------------------------------------- sockets
+bool WriteAll(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t k = ::write(fd, p, n);
+    if (k <= 0) {
+      if (k < 0 && (errno == EINTR || errno == EAGAIN)) continue;
+      return false;
+    }
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+struct Conn {
+  int fd = -1;
+  std::string rbuf;               // partial-frame accumulator
+  int64_t next_seq = 0;           // outbound request seqs
+  bool dead = false;
+
+  bool SendFrame(const std::string& payload) {
+    char head[4];
+    uint32_t n = (uint32_t)payload.size();
+    memcpy(head, &n, 4);          // little-endian on every target we run on
+    if (!WriteAll(fd, head, 4) || !WriteAll(fd, payload.data(), n)) {
+      dead = true;
+      return false;
+    }
+    return true;
+  }
+
+  bool Send(int64_t seq, int kind, const std::string& method,
+            const Val& data) {
+    Val frame = Val::Arr({Val::Of(seq), Val::Of((int64_t)kind),
+                          Val::Str(method), data});
+    return SendFrame(Pack(frame));
+  }
+
+  // Pop one complete frame out of rbuf, if present.
+  bool PopFrame(Val* out) {
+    if (rbuf.size() < 4) return false;
+    uint32_t n;
+    memcpy(&n, rbuf.data(), 4);
+    if (rbuf.size() < 4 + (size_t)n) return false;
+    *out = Unpack(rbuf.substr(4, n));
+    rbuf.erase(0, 4 + (size_t)n);
+    return true;
+  }
+
+  // Blocking read of at least one byte into rbuf.
+  bool Fill() {
+    char buf[65536];
+    ssize_t k = ::read(fd, buf, sizeof buf);
+    if (k <= 0) {
+      if (k < 0 && (errno == EINTR || errno == EAGAIN)) return true;
+      dead = true;
+      return false;
+    }
+    rbuf.append(buf, (size_t)k);
+    return true;
+  }
+};
+
+int DialTcp(const std::string& hostport, std::string* err) {
+  auto colon = hostport.rfind(':');
+  std::string host = hostport.substr(0, colon);
+  int port = atoi(hostport.c_str() + colon + 1);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  for (int attempt = 0; attempt < 50; ++attempt) {
+    if (connect(fd, (sockaddr*)&addr, sizeof addr) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      return fd;
+    }
+    usleep(100 * 1000);
+  }
+  *err = "connect " + hostport + ": " + strerror(errno);
+  close(fd);
+  return -1;
+}
+
+// ---------------------------------------------------------------- user ABI
+// Mirrors task_api.h's extern "C" exports.
+typedef int (*InvokeFn)(const char* name, const char* args, size_t args_len,
+                        char** out, size_t* out_len, char** err);
+typedef int (*ActorNewFn)(const char* cls, const char* args, size_t args_len,
+                          void** instance, char** err);
+typedef int (*ActorCallFn)(void* instance, const char* method,
+                           const char* args, size_t args_len, char** out,
+                           size_t* out_len, char** err);
+typedef void (*ActorDelFn)(void* instance);
+typedef void (*FreeFn)(char* p);
+
+struct UserLib {
+  void* dl = nullptr;
+  InvokeFn invoke = nullptr;
+  ActorNewFn actor_new = nullptr;
+  ActorCallFn actor_call = nullptr;
+  ActorDelFn actor_del = nullptr;
+  FreeFn free_buf = nullptr;
+};
+
+// --------------------------------------------------------------- the worker
+class Worker {
+ public:
+  Worker(std::string nodelet, std::string controller, std::string store_path,
+         std::string node_id, std::string worker_id_hex,
+         std::string session_dir)
+      : nodelet_addr_(std::move(nodelet)),
+        controller_addr_(std::move(controller)),
+        store_path_(std::move(store_path)),
+        node_id_(std::move(node_id)),
+        session_dir_(std::move(session_dir)) {
+    for (size_t i = 0; i + 1 < worker_id_hex.size(); i += 2)
+      worker_id_.push_back(
+          (char)strtol(worker_id_hex.substr(i, 2).c_str(), nullptr, 16));
+  }
+
+  int Run() {
+    std::string err;
+    // store segment: same dlopened library the Python client builds
+    std::string lib = store_path_;
+    auto slash = lib.rfind('/');
+    (void)slash;
+    const char* libpath = getenv("RAY_TPU_STORE_LIB");
+    store_ = OpenStore(libpath ? libpath : "libtpustore.so", store_path_,
+                       &err);
+    if (!store_.handle) {
+      fprintf(stderr, "cpp_worker: %s\n", err.c_str());
+      return 1;
+    }
+    if (!Listen(&err) || !Register(&err)) {
+      fprintf(stderr, "cpp_worker: %s\n", err.c_str());
+      return 1;
+    }
+    Loop();
+    return 0;
+  }
+
+ private:
+  bool Listen(std::string* err) {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof addr) != 0 ||
+        listen(listen_fd_, 64) != 0) {
+      *err = std::string("bind/listen: ") + strerror(errno);
+      return false;
+    }
+    socklen_t len = sizeof addr;
+    getsockname(listen_fd_, (sockaddr*)&addr, &len);
+    port_ = ntohs(addr.sin_port);
+    return true;
+  }
+
+  bool Register(std::string* err) {
+    int fd = DialTcp(nodelet_addr_, err);
+    if (fd < 0) return false;
+    nodelet_ = std::make_unique<Conn>();
+    nodelet_->fd = fd;
+    Val req = Val::MapOf({{"worker_id", Val::Bin(worker_id_)},
+                          {"port", Val::Of((int64_t)port_)},
+                          {"lang", Val::Str("cpp")}});
+    Val reply = Call(nodelet_.get(), "register_worker", req);
+    if (reply.has("error") && !reply.at("error").is_nil()) {
+      *err = "register_worker: " + reply.at("error").as_str();
+      return false;
+    }
+    if (reply.has("config")) {
+      const Val& cfg = reply.at("config");
+      if (cfg.has("max_direct_call_object_size"))
+        inline_cap_ = (size_t)cfg.at("max_direct_call_object_size").as_int();
+    }
+    return true;
+  }
+
+  // Synchronous request on a bidirectional connection: requests arriving
+  // while we wait are queued and dispatched after (the nodelet pushes
+  // create_actor over the worker's own registration connection).
+  Val Call(Conn* c, const std::string& method, const Val& data) {
+    int64_t seq = ++c->next_seq;
+    c->Send(seq, kRequest, method, data);
+    while (!c->dead) {
+      Val frame;
+      while (!c->PopFrame(&frame)) {
+        if (!c->Fill() || c->dead)
+          return Val::MapOf({{"error", Val::Str("connection lost")}});
+      }
+      int kind = (int)frame.arr[1].as_int();
+      if ((kind == kReply || kind == kError) &&
+          frame.arr[0].as_int() == seq) {
+        if (kind == kError)
+          return Val::MapOf({{"error", frame.arr[3]}});
+        return frame.arr[3];
+      }
+      if (kind == kRequest || kind == kNotify) {
+        pending_.push_back({c, frame});
+      }
+      // stale replies to earlier (abandoned) calls: drop
+    }
+    return Val::MapOf({{"error", Val::Str("connection lost")}});
+  }
+
+  Val Controller() {
+    // lazy controller connection (only actors need it)
+    if (!controller_) {
+      std::string err;
+      int fd = DialTcp(controller_addr_, &err);
+      if (fd >= 0) {
+        controller_ = std::make_unique<Conn>();
+        controller_->fd = fd;
+      }
+    }
+    return Val();
+  }
+
+  void Loop() {
+    while (!exiting_) {
+      // deferred requests first (arrived during a synchronous Call)
+      while (!pending_.empty()) {
+        auto item = pending_.front();
+        pending_.erase(pending_.begin());
+        Val frame = item.second;
+        Dispatch(item.first, frame);
+      }
+      // Frames can sit fully-buffered in a conn's rbuf after a blocking
+      // Call() read more than its own reply (e.g. create_actor arriving
+      // right behind the register_worker reply) — poll() will never
+      // signal for them, so drain buffers before sleeping.
+      bool drained_any = true;
+      while (drained_any) {
+        drained_any = false;
+        Val frame;
+        while (nodelet_->PopFrame(&frame)) {
+          drained_any = true;
+          Dispatch(nodelet_.get(), frame);
+        }
+        for (auto& c : driver_conns_)
+          while (!c->dead && c->PopFrame(&frame)) {
+            drained_any = true;
+            Dispatch(c.get(), frame);
+          }
+        if (!pending_.empty()) break;  // outer loop handles these first
+      }
+      if (!pending_.empty()) continue;
+      std::vector<pollfd> fds;
+      std::vector<Conn*> polled;          // parallel to fds[2..]
+      fds.push_back({listen_fd_, POLLIN, 0});
+      fds.push_back({nodelet_->fd, POLLIN, 0});
+      for (auto& c : driver_conns_)
+        if (!c->dead) {
+          fds.push_back({c->fd, POLLIN, 0});
+          polled.push_back(c.get());
+        }
+      if (poll(fds.data(), fds.size(), 1000) <= 0) continue;
+      if (fds[0].revents & POLLIN) Accept();  // new conns poll next round
+      if (fds[1].revents & POLLIN) Pump(nodelet_.get());
+      for (size_t k = 0; k < polled.size(); ++k)
+        if (fds[2 + k].revents & POLLIN) Pump(polled[k]);
+      driver_conns_.erase(
+          std::remove_if(driver_conns_.begin(), driver_conns_.end(),
+                         [](const std::unique_ptr<Conn>& c) {
+                           return c->dead;
+                         }),
+          driver_conns_.end());
+      if (nodelet_->dead) exiting_ = true;  // nodelet gone: die with it
+    }
+  }
+
+  void Accept() {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    auto c = std::make_unique<Conn>();
+    c->fd = fd;
+    driver_conns_.push_back(std::move(c));
+  }
+
+  void Pump(Conn* c) {
+    if (!c->Fill()) return;
+    Val frame;
+    while (c->PopFrame(&frame)) Dispatch(c, frame);
+  }
+
+  void Dispatch(Conn* c, const Val& frame) {
+    int64_t seq = frame.arr[0].as_int();
+    int kind = (int)frame.arr[1].as_int();
+    const std::string& method = frame.arr[2].as_str();
+    const Val& data = frame.arr[3];
+    if (debug_)
+      fprintf(stderr, "cpp_worker: dispatch %s kind=%d seq=%ld\n",
+              method.c_str(), kind, (long)seq);
+    if (kind != kRequest && kind != kNotify) return;
+    Val reply;
+    try {
+      reply = Route(method, data);
+    } catch (const std::exception& e) {
+      reply = ErrorReply(std::string("worker internal error: ") + e.what(),
+                         method);
+    }
+    if (kind == kRequest) c->Send(seq, kReply, method, reply);
+  }
+
+  Val Route(const std::string& method, const Val& data) {
+    Val reply;
+    if (method == "ping") {
+      reply = Val::Str("pong");
+    } else if (method == "exit") {
+      exiting_ = true;
+      reply = Val::Of(true);
+    } else if (method == "push_task") {
+      reply = ExecuteTask(data.at("spec"), /*actor_method=*/false);
+    } else if (method == "create_actor") {
+      reply = CreateActor(data.at("spec"));
+    } else if (method == "push_actor_task") {
+      reply = ExecuteTask(data.at("spec"), /*actor_method=*/true);
+    } else if (method == "cancel_task") {
+      reply = Val::Of(false);  // single-threaded: nothing interruptible
+    } else {
+      reply = Val::MapOf({{"error", Val::Str("no handler " + method)}});
+    }
+    return reply;
+  }
+
+  // ------------------------------------------------------------- user libs
+  UserLib* LoadLib(const std::string& path, std::string* err) {
+    auto it = libs_.find(path);
+    if (it != libs_.end()) return &it->second;
+    UserLib lib;
+    lib.dl = dlopen(path.c_str(), RTLD_NOW | RTLD_LOCAL);
+    if (!lib.dl) {
+      *err = std::string("dlopen ") + path + ": " + dlerror();
+      return nullptr;
+    }
+    lib.invoke = (InvokeFn)dlsym(lib.dl, "ray_tpu_cpp_invoke");
+    lib.actor_new = (ActorNewFn)dlsym(lib.dl, "ray_tpu_cpp_actor_new");
+    lib.actor_call = (ActorCallFn)dlsym(lib.dl, "ray_tpu_cpp_actor_call");
+    lib.actor_del = (ActorDelFn)dlsym(lib.dl, "ray_tpu_cpp_actor_destroy");
+    lib.free_buf = (FreeFn)dlsym(lib.dl, "ray_tpu_cpp_free");
+    if (!lib.invoke) {
+      *err = path + " does not export ray_tpu_cpp_invoke (build it "
+             "against ray_tpu/cpp/task_api.h)";
+      return nullptr;
+    }
+    return &libs_.emplace(path, lib).first->second;
+  }
+
+  // -------------------------------------------------------- args / returns
+  static Val ErrorReply(const std::string& tb, const std::string& fname) {
+    return Val::MapOf({{"error", Val::MapOf({{"traceback", Val::Str(tb)},
+                                             {"pickled", Val::Nil()},
+                                             {"fname", Val::Str(fname)}})}});
+  }
+
+  bool ResolveArgs(const Val& spec, std::string* packed_args,
+                   std::string* err) {
+    // Build one msgpack array of the positional args (xlang values).
+    std::vector<Val> out;
+    for (const auto& a : spec.at("args").arr) {
+      int kind = (int)a.arr[0].as_int();
+      const std::string& payload = a.arr[1].as_str();
+      if (kind == kArgValue) {
+        if (payload.size() < 4 || memcmp(payload.data(), kXMagic, 4) != 0) {
+          *err = "argument is a Python-pickled object; only RTX1 xlang "
+                 "values (nil/bool/int/float/str/bytes/list/dict) cross "
+                 "into C++ tasks";
+          return false;
+        }
+        out.push_back(Unpack(payload.substr(4)));
+      } else {
+        std::string blob;
+        if (!FetchObject(payload, &blob, err)) return false;
+        if (blob.size() < 4 || memcmp(blob.data(), kXMagic, 4) != 0) {
+          *err = "object " + Hex(payload) + " is a Python-pickled value; "
+                 "it does not cross the xlang boundary";
+          return false;
+        }
+        out.push_back(Unpack(blob.substr(4)));
+      }
+    }
+    *packed_args = Pack(Val::Arr(std::move(out)));
+    return true;
+  }
+
+  bool FetchObject(const std::string& id, std::string* blob,
+                   std::string* err) {
+    uint64_t off = 0, size = 0;
+    int rc = store_.get(store_.handle, (const uint8_t*)id.data(), 0, &off,
+                        &size);
+    if (rc != 0) {
+      // ask the nodelet to pull it to this node (remote or evicted)
+      Val r = Call(nodelet_.get(), "pull",
+                   Val::MapOf({{"object_id", Val::Bin(id)}}));
+      bool ok = r.has("ok") && r.at("ok").type == Val::Type::Bool &&
+                r.at("ok").b;
+      if (!ok) {
+        *err = "object " + Hex(id) + " could not be pulled";
+        return false;
+      }
+      rc = store_.get(store_.handle, (const uint8_t*)id.data(), 5000, &off,
+                      &size);
+      if (rc != 0) {
+        *err = "object " + Hex(id) + " pull raced eviction";
+        return false;
+      }
+    }
+    blob->assign((const char*)(store_.base + off), size);
+    store_.release(store_.handle, (const uint8_t*)id.data());
+    return true;
+  }
+
+  static std::string Hex(const std::string& b) {
+    static const char* d = "0123456789abcdef";
+    std::string s;
+    for (unsigned char ch : b) {
+      s.push_back(d[ch >> 4]);
+      s.push_back(d[ch & 15]);
+    }
+    return s;
+  }
+
+  Val StoreReturns(const Val& spec, const std::string& result_payload) {
+    // result_payload: msgpack of the return VALUE (single return).
+    std::string blob(kXMagic, 4);
+    blob += result_payload;
+    if (blob.size() <= inline_cap_) {
+      return Val::MapOf({{"returns", Val::Arr({Val::MapOf(
+                             {{"inline", Val::Bin(blob)},
+                              {"contained", Val::Of(false)}})})}});
+    }
+    // large return: straight into the shared-memory store.  Return ids
+    // are derived, not shipped: task_id + LE uint32 index
+    // (core/ids.py ObjectID.for_task_return)
+    std::string oid = spec.at("tid").as_str();
+    oid.append(4, '\0');  // index 0, little-endian
+    int64_t off = store_.create(store_.handle, (const uint8_t*)oid.data(),
+                                blob.size());
+    if (off >= 0) {
+      memcpy(store_.base + off, blob.data(), blob.size());
+      store_.seal(store_.handle, (const uint8_t*)oid.data());
+      Call(nodelet_.get(), "put_location",
+           Val::MapOf({{"object_id", Val::Bin(oid)},
+                       {"size", Val::Of((int64_t)blob.size())}}));
+      return Val::MapOf({{"returns", Val::Arr({Val::MapOf(
+                             {{"plasma", Val::Of((int64_t)blob.size())},
+                              {"contained", Val::Of(false)}})})}});
+    }
+    return ErrorReply("store full for " + std::to_string(blob.size()) +
+                          "-byte return",
+                      spec.at("fname").as_str());
+  }
+
+  // --------------------------------------------------------- task execution
+  // fname convention: "path/to/libuser.so:function" (tasks) or
+  // "path/to/libuser.so:Class" (actor creation); actor methods are bare
+  // method names (the library is remembered from creation).
+  Val ExecuteTask(const Val& spec, bool actor_method) {
+    std::string fname = spec.at("fname").as_str();
+    std::string err;
+    std::string packed_args;
+    if (!ResolveArgs(spec, &packed_args, &err))
+      return ErrorReply(err, fname);
+
+    char* out = nullptr;
+    size_t out_len = 0;
+    char* uerr = nullptr;
+    UserLib* lib = nullptr;
+    int rc;
+    if (actor_method) {
+      if (!actor_instance_)
+        return ErrorReply("actor instance not created", fname);
+      lib = actor_lib_;
+      rc = lib->actor_call(actor_instance_, fname.c_str(),
+                           packed_args.data(), packed_args.size(), &out,
+                           &out_len, &uerr);
+    } else {
+      auto colon = fname.rfind(':');
+      if (colon == std::string::npos)
+        return ErrorReply("cpp task fname must be 'lib.so:function', got " +
+                              fname,
+                          fname);
+      lib = LoadLib(fname.substr(0, colon), &err);
+      if (!lib) return ErrorReply(err, fname);
+      std::string sym = fname.substr(colon + 1);
+      rc = lib->invoke(sym.c_str(), packed_args.data(), packed_args.size(),
+                       &out, &out_len, &uerr);
+    }
+    if (rc != 0) {
+      std::string tb = uerr ? uerr : "cpp task failed";
+      if (uerr && lib->free_buf) lib->free_buf(uerr);
+      return ErrorReply(tb, fname);
+    }
+    std::string payload(out, out_len);
+    if (out && lib->free_buf) lib->free_buf(out);
+    return StoreReturns(spec, payload);
+  }
+
+  Val CreateActor(const Val& spec) {
+    std::string fname = spec.at("fname").as_str();
+    auto colon = fname.rfind(':');
+    if (colon == std::string::npos)
+      return Val::MapOf({{"ok", Val::Of(false)},
+                         {"error", Val::Str("cpp actor fname must be "
+                                            "'lib.so:Class'")}});
+    std::string err;
+    UserLib* lib = LoadLib(fname.substr(0, colon), &err);
+    if (!lib) return Val::MapOf({{"ok", Val::Of(false)},
+                                 {"error", Val::Str(err)}});
+    if (!lib->actor_new)
+      return Val::MapOf({{"ok", Val::Of(false)},
+                         {"error", Val::Str("library exports no actor "
+                                            "ABI")}});
+    std::string packed_args;
+    if (!ResolveArgs(spec, &packed_args, &err))
+      return Val::MapOf({{"ok", Val::Of(false)}, {"error", Val::Str(err)}});
+    char* uerr = nullptr;
+    void* inst = nullptr;
+    int rc = lib->actor_new(fname.substr(colon + 1).c_str(),
+                            packed_args.data(), packed_args.size(), &inst,
+                            &uerr);
+    if (rc != 0) {
+      std::string e = uerr ? uerr : "actor construction failed";
+      if (uerr && lib->free_buf) lib->free_buf(uerr);
+      return Val::MapOf({{"ok", Val::Of(false)}, {"error", Val::Str(e)}});
+    }
+    actor_instance_ = inst;
+    actor_lib_ = lib;
+    actor_id_ = spec.at("actor_new").as_str();
+    // announce liveness to the controller (actor FSM → ALIVE), exactly
+    // like worker_runtime._h_create_actor
+    std::string cerr;
+    int fd = DialTcp(controller_addr_, &cerr);
+    if (fd >= 0) {
+      controller_ = std::make_unique<Conn>();
+      controller_->fd = fd;
+      Call(controller_.get(), "actor_alive",
+           Val::MapOf({{"actor_id", Val::Bin(actor_id_)},
+                       {"address",
+                        Val::Str("127.0.0.1:" + std::to_string(port_))},
+                       {"worker_id", Val::Bin(worker_id_)},
+                       {"node_id", Val::Str(node_id_)}}));
+    }
+    return Val::MapOf({{"ok", Val::Of(true)}});
+  }
+
+  std::string nodelet_addr_, controller_addr_, store_path_, node_id_,
+      session_dir_;
+  std::string worker_id_;
+  int listen_fd_ = -1;
+  uint16_t port_ = 0;
+  size_t inline_cap_ = 100 * 1024;
+  StoreApi store_;
+  std::unique_ptr<Conn> nodelet_, controller_;
+  std::vector<std::unique_ptr<Conn>> driver_conns_;
+  std::vector<std::pair<Conn*, Val>> pending_;
+  std::map<std::string, UserLib> libs_;
+  void* actor_instance_ = nullptr;
+  UserLib* actor_lib_ = nullptr;
+  std::string actor_id_;
+  bool exiting_ = false;
+  bool debug_ = getenv("RAY_TPU_CPP_WORKER_DEBUG") != nullptr;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::map<std::string, std::string> args;
+  for (int i = 1; i + 1 < argc; i += 2) args[argv[i]] = argv[i + 1];
+  Worker w(args["--nodelet"], args["--controller"], args["--store"],
+           args["--node-id"], args["--worker-id"], args["--session-dir"]);
+  return w.Run();
+}

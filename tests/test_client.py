@@ -40,6 +40,10 @@ CLIENT_SCRIPT = textwrap.dedent("""
     back = ray_tpu.get(ref, timeout=60.0)
     assert (back == arr).all()
 
+    # xlang put over the client connection (RTX1 path)
+    xref = ray_tpu.put([1, 2, 3], xlang=True)
+    assert ray_tpu.get(xref, timeout=30.0) == [1, 2, 3]
+
     # refs as task args resolve server-side
     assert int(ray_tpu.get(add.remote(ref, ref), timeout=60.0)[-1]) == \\
         2 * (500_000 - 1)

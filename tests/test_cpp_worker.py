@@ -1,0 +1,107 @@
+"""C++ worker-side task/actor execution (reference:
+cpp/src/ray/runtime/task/task_executor.cc executes RAY_REMOTE functions
+inside native workers; cpp/include/ray/api/ is the user surface).
+
+The native worker (ray_tpu/cpp/worker_main.cc) registers with the
+nodelet over the same wire protocol as Python workers; TaskSpec
+lang=="cpp" routes leases to it; user code lives in a dlopened library
+built against ray_tpu/cpp/task_api.h; values cross in the RTX1 xlang
+msgpack format (core/serialization.py serialize_xlang)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cpp.build import ensure_example_lib_built, ensure_worker_built
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    # build before the cluster comes up so spawn never hits a cold compile
+    ensure_worker_built()
+    lib = ensure_example_lib_built()
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield lib
+    ray_tpu.shutdown()
+
+
+def test_cpp_task_roundtrip(cluster):
+    add = ray_tpu.cpp_function(cluster, "Add")
+    assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5
+    concat = ray_tpu.cpp_function(cluster, "Concat")
+    assert ray_tpu.get(concat.remote("tpu-", "native"), timeout=30) \
+        == "tpu-native"
+
+
+def test_cpp_task_nested_values_and_ref_args(cluster):
+    sum_list = ray_tpu.cpp_function(cluster, "SumList")
+    assert ray_tpu.get(sum_list.remote([1, 2, 3, 4]), timeout=30) == 10
+    # a C++ task's return (an RTX1 store object) feeds another C++ task
+    add = ray_tpu.cpp_function(cluster, "Add")
+    r1 = add.remote(10, 20)
+    r2 = add.remote(r1, 5)
+    assert ray_tpu.get(r2, timeout=30) == 35
+
+
+def test_cpp_task_large_return_via_store(cluster):
+    """Returns past max_direct_call_object_size ride shared memory."""
+    blob = ray_tpu.cpp_function(cluster, "BigBlob")
+    out = ray_tpu.get(blob.remote(1_000_000), timeout=60)
+    assert isinstance(out, bytes) and len(out) == 1_000_000
+    assert out[:3] == b"xxx"
+
+
+def test_cpp_task_error_propagates(cluster):
+    fail = ray_tpu.cpp_function(cluster, "Fail")
+    with pytest.raises(Exception, match="deliberate C\\+\\+ task failure"):
+        ray_tpu.get(fail.remote(), timeout=30)
+
+
+def test_cpp_task_unknown_symbol(cluster):
+    ghost = ray_tpu.cpp_function(cluster, "NoSuchFn")
+    with pytest.raises(Exception, match="no registered task"):
+        ray_tpu.get(ghost.remote(), timeout=30)
+
+
+def test_cpp_pickled_arg_rejected(cluster):
+    """Python-pickled objects must not silently cross the boundary."""
+    add = ray_tpu.cpp_function(cluster, "Add")
+    ref = ray_tpu.put(object())       # unpicklable-to-msgpack python value
+    with pytest.raises(Exception, match="xlang"):
+        ray_tpu.get(add.remote(ref, 1), timeout=30)
+
+
+def test_cpp_actor_stateful_methods(cluster):
+    counter = ray_tpu.cpp_actor(cluster, "Counter").remote(100)
+    assert ray_tpu.get(counter.task("add", 5), timeout=60) == 105
+    assert ray_tpu.get(counter.task("add", 7), timeout=30) == 112
+    assert ray_tpu.get(counter.task("get"), timeout=30) == 112
+
+
+def test_cpp_actor_method_error(cluster):
+    counter = ray_tpu.cpp_actor(cluster, "Counter").remote()
+    with pytest.raises(Exception, match="no method"):
+        ray_tpu.get(counter.task("fly"), timeout=30)
+    # the actor survives a failed method
+    assert ray_tpu.get(counter.task("add", 1), timeout=30) == 1
+
+
+def test_python_gets_cpp_result_and_mixed_pipeline(cluster):
+    """RTX1 objects read transparently from Python, and a Python task can
+    consume a C++ task's output ref."""
+    add = ray_tpu.cpp_function(cluster, "Add")
+    ref = add.remote(40, 2)
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    assert ray_tpu.get(plus_one.remote(ref), timeout=60) == 43
+
+
+def test_xlang_put_feeds_cpp_task(cluster):
+    """put(v, xlang=True) stores RTX1 objects C++ tasks consume; Python
+    reads them back transparently too."""
+    ref = ray_tpu.put([5, 6, 7], xlang=True)
+    sum_list = ray_tpu.cpp_function(cluster, "SumList")
+    assert ray_tpu.get(sum_list.remote(ref), timeout=60) == 18
+    assert ray_tpu.get(ref, timeout=10) == [5, 6, 7]
