@@ -1,0 +1,112 @@
+"""Single-node bulk-ingest throughput benchmark (VERDICT r4 missing #2).
+
+Reference methodology: the AIR bulk-ingest benchmark reads parquet,
+applies a trivial map_batches, and consumes every block — 0.51 GiB/s on
+one m5.4xlarge (16 vCPU) (`/root/reference/doc/source/ray-air/
+benchmarks.rst:30-46`, release/air_tests data_ingest).  Same shape here:
+generate N GiB of parquet, then time read_parquet → map_batches →
+full consumption through the object store.  Writes DATA_BENCH.json.
+
+Run: JAX_PLATFORMS=cpu python bench_data.py [--gib 4]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import tempfile
+import time
+
+
+def generate_parquet(root: str, gib: float, files: int) -> float:
+    """Write ~gib GiB of parquet across ``files`` files; returns bytes."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(root, exist_ok=True)
+    rows_per_file = int(gib * 1024**3 / files / 80)  # ~80B/row of floats
+    rng = np.random.default_rng(0)
+    total = 0
+    for i in range(files):
+        cols = {f"f{j}": rng.random(rows_per_file) for j in range(8)}
+        cols["key"] = rng.integers(0, 1 << 30, rows_per_file)
+        cols["label"] = rng.integers(0, 2, rows_per_file)
+        table = pa.table(cols)
+        path = os.path.join(root, f"part-{i:04d}.parquet")
+        pq.write_table(table, path, compression="NONE")
+        total += os.path.getsize(path)
+    return total
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gib", type=float, default=4.0)
+    ap.add_argument("--files", type=int, default=32)
+    ap.add_argument("--out", default="DATA_BENCH.json")
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import data as rdata
+
+    root = os.path.join(tempfile.gettempdir(), "ingest_bench")
+    shutil.rmtree(root, ignore_errors=True)
+    t0 = time.perf_counter()
+    nbytes = generate_parquet(root, args.gib, args.files)
+    gen_s = time.perf_counter() - t0
+    gib = nbytes / 1024**3
+    print(f"generated {gib:.2f} GiB parquet in {gen_s:.1f}s")
+
+    ray_tpu.init(num_cpus=8,
+                 object_store_memory=int((args.gib + 2) * 1024**3))
+
+    # --- bulk ingest: read -> trivial map_batches -> consume all blocks ---
+    t0 = time.perf_counter()
+    ds = rdata.read_parquet(
+        [os.path.join(root, f) for f in sorted(os.listdir(root))])
+
+    def add_one(batch):
+        batch["f0"] = batch["f0"] + 1.0
+        return batch
+
+    ds = ds.map_batches(add_one)
+    consumed_rows = 0
+    consumed_bytes = 0
+    for batch in ds.iter_batches(batch_size=65536):
+        col = next(iter(batch.values()))
+        consumed_rows += len(col)
+        consumed_bytes += sum(
+            getattr(v, "nbytes", 0) for v in batch.values())
+    ingest_s = time.perf_counter() - t0
+    rate = gib / ingest_s
+    print(f"[data] ingest {gib:.2f} GiB in {ingest_s:.1f}s -> "
+          f"{rate:.2f} GiB/s ({consumed_rows} rows)")
+
+    commit = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                            capture_output=True, text=True,
+                            cwd=os.path.dirname(os.path.abspath(__file__))
+                            ).stdout.strip()
+    result = {
+        "bench": "bulk_ingest_single_node",
+        "gib": round(gib, 3),
+        "seconds": round(ingest_s, 1),
+        "gib_per_s": round(rate, 3),
+        "rows": consumed_rows,
+        "consumed_gib": round(consumed_bytes / 1024**3, 3),
+        "reference": {"value_gib_s": 0.51, "hardware": "1x m5.4xlarge "
+                      "(16 vCPU)", "source":
+                      "doc/source/ray-air/benchmarks.rst:30-46"},
+        "hardware": "1 shared CPU core (this image)",
+        "commit": commit,
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           args.out), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    ray_tpu.shutdown()
+    shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

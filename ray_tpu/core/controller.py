@@ -49,6 +49,9 @@ class ActorRecord:
         self.death_cause: Optional[str] = None
         self.owner_conn_id: Optional[int] = None
         self.waiters: List[asyncio.Event] = []
+        # nodes that recently reported actor-cap saturation → expiry time
+        # (scheduling steers around them until the entry lapses)
+        self.avoid_nodes: Dict[str, float] = {}
 
     def to_wire(self):
         return {"actor_id": self.actor_id, "state": self.state,
@@ -456,6 +459,12 @@ class Controller:
                 pass
 
     async def _schedule_one(self, actor: ActorRecord):
+        # NOTE: creations stay concurrent and unbounded here — gang-actor
+        # constructors block on their peers, so serializing dispatch
+        # would deadlock gangs.  The 5k-burst thundering herd is bounded
+        # on the NODELET side instead (admission semaphore around the
+        # worker-pop loop, released before the blocking create_actor
+        # push — nodelet._h_start_actor).
         try:
             await self._try_schedule_actor(actor)
         finally:
@@ -471,7 +480,22 @@ class Controller:
             if pg is None or pg.state != "CREATED":
                 return  # wait for the PG
             strategy["node_id"] = pg.node_ids[max(actor.spec.get("bundle", 0), 0)]
-        node_id = hybrid_policy(self._views(), spec.resources, None,
+        views = self._views()
+        now = time.monotonic()
+        for n, expiry in list(actor.avoid_nodes.items()):
+            if expiry < now:
+                del actor.avoid_nodes[n]
+        # Schedule around nodes that recently reported actor-cap
+        # saturation — but NEVER prune a node the strategy pins (PG
+        # bundle / node affinity): pruning the pinned node makes
+        # hybrid_policy return None forever even after the cap frees.
+        pinned = strategy.get("node_id")
+        if actor.avoid_nodes:
+            pruned = {k: v for k, v in views.items()
+                      if k not in actor.avoid_nodes or k == pinned}
+            if pruned:
+                views = pruned
+        node_id = hybrid_policy(views, spec.resources, None,
                                 strategy=strategy)
         if node_id is None:
             return
@@ -488,6 +512,8 @@ class Controller:
             return
         if not result.get("ok"):
             actor.node_id = None
+            if result.get("saturated"):
+                actor.avoid_nodes[node_id] = time.monotonic() + 5.0
             if result.get("retry"):
                 self._pending_actor_wakeup.set()
             else:

@@ -31,6 +31,27 @@ from .scheduling import NodeView, hybrid_policy
 from .task_spec import ResourceSet, TaskSpec
 
 
+# Resolved at import time: preexec_fn runs in the post-fork child of a
+# (potentially) multithreaded parent, where import/dlopen can deadlock
+# on inherited locks — the hook below must be a single pre-bound C call.
+try:
+    import ctypes as _ctypes
+    import signal as _signal
+    _libc_prctl = _ctypes.CDLL("libc.so.6", use_errno=True).prctl
+    _SIGTERM = int(_signal.SIGTERM)
+except Exception:              # non-glibc platform: hook becomes a no-op
+    _libc_prctl = None
+    _SIGTERM = 15
+
+
+def _pdeathsig_term() -> None:
+    """preexec hook: deliver SIGTERM to the child when its parent dies
+    (PR_SET_PDEATHSIG) — covers SIGKILLed nodelets, which can never run
+    their own teardown."""
+    if _libc_prctl is not None:
+        _libc_prctl(1, _SIGTERM, 0, 0, 0)  # PR_SET_PDEATHSIG == 1
+
+
 class WorkerProc:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
                  lang: str = "py"):
@@ -103,6 +124,7 @@ class Nodelet:
         self._tasks: List[asyncio.Task] = []
         self._next_worker_seq = 0
         self._pending_actor_starts = 0
+        self._actor_admission = asyncio.Semaphore(32)
         # Spawns parked in `await zygote.spawn()` are not yet in
         # self.workers; count them or a burst blows past the pool caps.
         self._spawns_inflight = 0
@@ -182,7 +204,11 @@ class Nodelet:
                      "--controller", self.controller_addr,
                      "--nodelet-addr", self.address],
                     stdout=logf, stderr=subprocess.STDOUT,
-                    start_new_session=True)
+                    start_new_session=True,
+                    # die with the nodelet even when it is SIGKILLed —
+                    # orphaned agents otherwise outlive crashed clusters
+                    # and heartbeat into nothing forever
+                    preexec_fn=_pdeathsig_term)
                 logf.close()
             except Exception:
                 traceback.print_exc()
@@ -756,10 +782,35 @@ class Nodelet:
         request = spec.resources
         if not self.available.fits(request):
             return {"ok": False, "retry": True, "error": "resources busy"}
+        if sum(1 for w in self.workers.values() if w.state == "actor") \
+                + self._pending_actor_starts \
+                >= GlobalConfig.actor_workers_max:
+            # hard per-node actor-process cap (in-flight starts counted,
+            # else 64 concurrent handlers overshoot it): tell the
+            # controller NOW so it schedules elsewhere — zero-resource
+            # actors otherwise pack onto this node until the 30s pop
+            # deadline, starving creations while other nodes idle
+            # (found by the 5k-actor scale probe, round 5)
+            return {"ok": False, "retry": True, "saturated": True,
+                    "error": "actor worker cap reached"}
         deadline = time.monotonic() + \
             GlobalConfig.actor_worker_startup_timeout_s
         worker = None
         self._pending_actor_starts += 1
+        # Admission bound on the worker-pop loop: a 5k-creation burst
+        # otherwise parks thousands of handlers in the cv-wait below,
+        # each waking on every lease event — O(pending^2) wakeup work
+        # that collapses creation throughput.  The permit is released
+        # BEFORE the blocking create_actor push, so gang-actor
+        # constructors that wait on >32 peers cannot deadlock on it.
+        try:
+            await asyncio.wait_for(
+                self._actor_admission.acquire(),
+                timeout=max(0.1, deadline - time.monotonic()))
+        except asyncio.TimeoutError:
+            self._pending_actor_starts -= 1
+            return {"ok": False, "retry": True,
+                    "error": "actor admission queue full"}
         try:
             while worker is None:
                 # a burst of actor creations may fork several workers at
@@ -779,6 +830,7 @@ class Nodelet:
                         except asyncio.TimeoutError:
                             pass
         finally:
+            self._actor_admission.release()
             self._pending_actor_starts -= 1
         self.available.acquire(request)
         worker.state = "actor"

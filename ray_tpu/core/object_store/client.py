@@ -23,6 +23,8 @@ _SRC = _SRCS[0]
 _LIB = os.path.join(_HERE, "libtpustore.so")
 
 ID_LEN = 24
+# Bounded-copy chunk for multi-GiB writes (see put_parts)
+_COPY_CHUNK = 256 * 1024 * 1024
 
 RTS_OK = 0
 RTS_ERR_FULL = -1
@@ -176,8 +178,20 @@ class StoreClient:
         off = 0
         try:
             for p in parts:
-                dest[off: off + p.nbytes] = p
-                off += p.nbytes
+                n = p.nbytes
+                if n > _COPY_CHUNK:
+                    # CPython's one-shot buffer copy falls off its memcpy
+                    # fast path for multi-GiB views (measured 0.12 GiB/s
+                    # at 4 GiB vs 1.8 GiB/s chunked) — copy big parts in
+                    # bounded chunks
+                    flat = p.cast("B") if p.format != "B" or p.ndim != 1 \
+                        else p
+                    for coff in range(0, n, _COPY_CHUNK):
+                        dest[off + coff: off + min(coff + _COPY_CHUNK, n)] \
+                            = flat[coff: min(coff + _COPY_CHUNK, n)]
+                else:
+                    dest[off: off + n] = p
+                off += n
         except BaseException:
             del dest
             self.abort(object_id)
