@@ -43,7 +43,7 @@ def generate_parquet(root: str, gib: float, files: int) -> float:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gib", type=float, default=4.0)
-    ap.add_argument("--files", type=int, default=32)
+    ap.add_argument("--files", type=int, default=64)
     ap.add_argument("--out", default="DATA_BENCH.json")
     args = ap.parse_args()
 
