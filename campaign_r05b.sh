@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-5 campaign, stage B: waits for the serial flock (stage A runs
+# probe7/7lhs/8/9), then probe10 (non-composite Serve-on-chip TTFT)
+# and an interim live bench capture as a hedge — the official
+# report-time capture still happens on the final tree at round end.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok10 () {
+    [ -f TPU_PROBE10_r05.jsonl ] \
+        && grep '"stage": "serve_ttft"' TPU_PROBE10_r05.jsonl \
+           | grep -qv '"error"'
+}
+
+tries=0
+while [ $tries -lt 15 ]; do
+    tries=$((tries+1))
+    echo "=== probe10 attempt $tries $(date -u +%H:%M:%S) ===" >> probe10_r05.err
+    python tpu_probe10.py >> probe10_r05.out 2>> probe10_r05.err
+    if ok10; then
+        echo "=== probe10 landed $(date -u +%H:%M:%S) ===" >> probe10_r05.err
+        break
+    fi
+    if [ -f TPU_PROBE10_r05.jsonl ] && ! ok10; then
+        mv TPU_PROBE10_r05.jsonl "TPU_PROBE10_r05.abort.$tries"
+    fi
+    sleep 240
+done
+
+echo "=== interim bench capture $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "interim bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log

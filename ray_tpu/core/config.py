@@ -108,6 +108,10 @@ _d("worker_pool_max_size", int, 16,
 _d("actor_workers_max", int, 4096,
    "Hard cap on actor-dedicated workers per node (reference analogue: "
    "unbounded actor workers; bounded here as an OS-process backstop).")
+_d("worker_shutdown_grace_s", float, 2.0,
+   "Seconds a stopping nodelet waits for SIGTERMed workers before "
+   "SIGKILL.  Raise (e.g. 30) for workers holding a TPU client: their "
+   "graceful exit releases the tunnelled grant; a SIGKILL wedges it.")
 _d("worker_fork_server", bool, True,
    "Fork workers from a pre-warmed zygote process (~10ms) instead of "
    "exec'ing a fresh interpreter (~250ms import tax).  Falls back to "

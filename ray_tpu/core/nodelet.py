@@ -255,7 +255,12 @@ class Nodelet:
                 w.proc.terminate()
         # One shared deadline — not 2 s per worker (a 1k-worker node
         # would stall shutdown for half an hour serially).
-        deadline = time.monotonic() + 2.0
+        # Grace is configurable: workers holding a TPU client exit
+        # gracefully on SIGTERM (interpreter teardown releases the
+        # tunnelled grant) and need more than the 2s default before the
+        # SIGKILL escalation would wedge the grant — on-chip Serve runs
+        # set RAY_TPU_WORKER_SHUTDOWN_GRACE_S=30.
+        deadline = time.monotonic() + GlobalConfig.worker_shutdown_grace_s
         for w in self.workers.values():
             try:
                 w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))

@@ -29,6 +29,18 @@ def run_worker(args: dict) -> None:
             worker_id=bytes.fromhex(args["worker_id"]),
             session_dir=args["session_dir"],
         )
+        # SIGTERM (nodelet teardown) exits gracefully: a worker holding
+        # an accelerator client must run interpreter teardown so the TPU
+        # plugin releases the tunnelled grant (default SIGTERM handling
+        # — like os._exit — wedges it; see WorkerRuntime.request_exit).
+        # Installed BEFORE start() so a teardown racing worker spawn
+        # still takes the graceful path.
+        import signal as _signal
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                _signal.SIGTERM, rt.request_exit, 0)
+        except (NotImplementedError, RuntimeError):
+            pass
         await rt.start()
         await rt.run_forever()
 

@@ -123,7 +123,11 @@ class WorkerRuntime:
             "worker_id": self.worker_id, "port": self.server.port,
             "pid": os.getpid()})
         GlobalConfig.load_snapshot(reply.get("config", {}))
-        self.nodelet.on_close = lambda conn: os._exit(1)  # nodelet died -> die
+        # nodelet died -> die.  NOT during a graceful exit: loop cleanup
+        # closes this connection and the hook would os._exit before
+        # interpreter teardown could release an accelerator grant.
+        self.nodelet.on_close = (
+            lambda conn: None if self._dying else os._exit(1))
         asyncio.ensure_future(self._task_state_flusher())
         return self
 
@@ -640,8 +644,46 @@ class WorkerRuntime:
                     "intended": not data.get("restart", False)})
             except rpc.RpcError:
                 pass
-        threading.Timer(0.05, lambda: os._exit(0)).start()
+        self.request_exit(0)
         return True
+
+    def request_exit(self, code: int = 0) -> None:
+        """Exit this worker.  Plain workers take the fast path
+        (``os._exit`` — no teardown hangs on broken connections).  A
+        worker holding a live accelerator client exits GRACEFULLY
+        instead: interpreter teardown must run so the TPU plugin
+        releases the tunnelled grant — an ``os._exit``/SIGKILLed
+        claimant wedges the grant for hours (round-4 Serve-on-chip
+        lesson, SURVEY §9).  A watchdog hard-exits if graceful teardown
+        itself hangs."""
+        self._dying = True
+        if not self._holds_accelerator():
+            t = threading.Timer(0.05, lambda: os._exit(code))
+            t.daemon = True
+            t.start()
+            return
+        # watchdog in case graceful teardown hangs; daemon so a SUCCESSFUL
+        # teardown is not joined-on before atexit (a non-daemon timer
+        # would block interpreter finalization, then os._exit anyway)
+        t = threading.Timer(20.0, lambda: os._exit(code))
+        t.daemon = True
+        t.start()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._shutdown.set)
+        else:
+            self._shutdown.set()
+
+    @staticmethod
+    def _holds_accelerator() -> bool:
+        import sys
+        if "jax" not in sys.modules:
+            return False
+        try:
+            from jax._src import xla_bridge
+            return any(name != "cpu"
+                       for name in (xla_bridge._backends or {}))
+        except Exception:
+            return True   # can't tell: assume yes, exit gracefully
 
 
 class _ErrorValue:
