@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-5 campaign, stage C: queued behind stages A (probe7/8/9) and B
+# (probe10 + interim bench) on the serial flock; runs probe11 (llama-1b
+# chunked-prefill TTFT — the bounded-compile answer to the round-4
+# compile-helper killer).
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok11 () {
+    [ -f TPU_PROBE11_r05.jsonl ] \
+        && grep '"stage": "mfu"' TPU_PROBE11_r05.jsonl \
+           | grep -q chunked_prefill_ttft
+}
+
+tries=0
+while [ $tries -lt 10 ]; do
+    tries=$((tries+1))
+    echo "=== probe11 attempt $tries $(date -u +%H:%M:%S) ===" >> probe11_r05.err
+    python tpu_probe11.py >> probe11_r05.out 2>> probe11_r05.err
+    if ok11; then
+        echo "=== probe11 landed $(date -u +%H:%M:%S) ===" >> probe11_r05.err
+        break
+    fi
+    if [ -f TPU_PROBE11_r05.jsonl ] && ! ok11; then
+        mv TPU_PROBE11_r05.jsonl "TPU_PROBE11_r05.abort.$tries"
+    fi
+    sleep 240
+done
+echo "stage C done $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -12,6 +12,8 @@ from .generate import (  # noqa: F401
     generate,
     init_kv_cache,
     prefill,
+    prefill_chunk,
+    prefill_chunked,
 )
 from .transformer import (  # noqa: F401
     TransformerConfig,

@@ -92,6 +92,99 @@ def prefill(params: Params, tokens: jnp.ndarray, cfg: TransformerConfig,
     return logits.astype(jnp.float32), cache
 
 
+def prefill_chunk(params: Params, tokens: jnp.ndarray, cache: KVCache,
+                  cfg: TransformerConfig) -> Tuple[jnp.ndarray, KVCache]:
+    """Extend the cache with a CHUNK of prompt tokens [B, C] starting at
+    ``cache['pos']`` → (logits of the chunk's last position, cache').
+
+    The compile-helper-friendly prefill: one program per (B, C) shape,
+    reused across a prompt of any length.  A whole-prompt flash prefill
+    compiles a program proportional to the full sequence — the
+    llama-1b GQA variant of that compile is a known remote-compile-
+    helper killer (SURVEY §9); chunking caps the compiled program at C
+    positions.  Chunk attention runs dense against the cache's max_len
+    (O(C·max_len) per chunk) — more FLOPs than causal flash, traded for
+    a bounded, cacheable compile."""
+    _check_decodable(cfg)
+    b, c = tokens.shape
+    dt = cfg.dtype
+    pos = cache["pos"]
+    max_len = cache["k"].shape[2]
+    x = params["embed"]["tok"][tokens].astype(dt)              # [B,C,D]
+    if cfg.pos_emb == "learned":
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["embed"]["pos"], pos, c, axis=0).astype(dt)
+    if cfg.pos_emb == "rope":
+        full_cos, full_sin = rotary_angles(max_len, cfg.head_dim,
+                                           cfg.rope_base)
+        cos = jax.lax.dynamic_slice_in_dim(full_cos, pos, c, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(full_sin, pos, c, axis=0)
+    else:
+        cos = sin = None
+
+    h, hk, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    # mask[i, t]: cached position t visible to chunk token i (causal
+    # within the chunk, everything before it fully visible)
+    mask = jnp.arange(max_len)[None, :] <= (pos + jnp.arange(c))[:, None]
+
+    def body(carry, inputs):
+        xc = carry
+        lp, ck, cv = inputs                                    # per-layer
+        y = _norm(cfg, xc, lp["attn_norm"], lp.get("attn_norm_b"))
+        q = jnp.einsum("bsd,dhk->bshk", y, lp["wq"].astype(dt))
+        if cfg.pos_emb == "rope":
+            q = apply_rotary(q, cos, sin)
+        k_new, v_new = _project_kv(cfg, y, lp, cos, sin)
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(cfg.dtype),
+                                          (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cfg.dtype),
+                                          (0, pos, 0, 0))
+        qh = q.reshape(b, c, hk, h // hk, hd)
+        scores = jnp.einsum("bskgd,btkd->bskgt", qh,
+                            ck.astype(dt)) / jnp.sqrt(float(hd))
+        scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum("bskgt,btkd->bskgd", probs.astype(dt),
+                          cv.astype(dt))
+        attn = attn.reshape(b, c, h, hd)
+        xc = xc + jnp.einsum("bshk,hkd->bsd", attn,
+                             lp["wo"].astype(dt))
+        y2 = _norm(cfg, xc, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        z, _ = _ffn(cfg, y2, lp)
+        xc = xc + z
+        return xc, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["layers"], cache["k"], cache["v"]))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], _unembed(params, cfg))
+    return logits.astype(jnp.float32), {"k": ks, "v": vs, "pos": pos + c}
+
+
+# Module-level jit: every prefill_chunked caller shares one trace/compile
+# cache (the point of chunking is a bounded, REUSED program)
+_prefill_chunk_jit = jax.jit(prefill_chunk, static_argnames=("cfg",))
+
+
+def prefill_chunked(params: Params, tokens: jnp.ndarray,
+                    cfg: TransformerConfig, cache: KVCache,
+                    *, chunk: int = 512,
+                    _jitted=None) -> Tuple[jnp.ndarray, KVCache]:
+    """Whole-prompt prefill as ceil(s/chunk) reusable chunk programs
+    (at most two compiled shapes: ``chunk`` and the tail remainder).
+    Drop-in for :func:`prefill` where compile size must stay bounded."""
+    b, s = tokens.shape
+    if s > cache["k"].shape[2]:
+        raise ValueError(f"prompt length {s} exceeds cache capacity "
+                         f"{cache['k'].shape[2]}")
+    fn = _jitted or _prefill_chunk_jit
+    logits = None
+    for off in range(0, s, chunk):
+        logits, cache = fn(params, tokens[:, off:off + chunk], cache,
+                           cfg=cfg)
+    return logits, cache
+
+
 def decode_step(params: Params, token: jnp.ndarray, cache: KVCache,
                 cfg: TransformerConfig) -> Tuple[jnp.ndarray, KVCache]:
     """One token [B] int32 → (next-token logits [B, vocab], cache')."""

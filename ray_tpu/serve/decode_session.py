@@ -35,17 +35,30 @@ class DecodeSessionCore:
     """
 
     def __init__(self, cfg, max_len: int, seed: int = 0,
-                 params: Any = None, max_sessions: int = 64):
+                 params: Any = None, max_sessions: int = 64,
+                 prefill_chunk: int = 0):
+        """``prefill_chunk > 0`` prefills in fixed-size chunks through
+        one small reusable program instead of a whole-prompt compile —
+        for models whose full-prompt flash prefill is a compile-helper
+        killer (llama-family GQA, SURVEY §9)."""
         import jax
 
         from ..models import decode_step, init_params, prefill
+        from ..models import prefill_chunked
         self.cfg = cfg
         self.max_len = max_len
         self.max_sessions = max_sessions
         if params is None:
             params, _ = init_params(jax.random.PRNGKey(seed), cfg)
         self.params = params
-        self._prefill = jax.jit(prefill, static_argnames=("cfg",))
+        if prefill_chunk > 0:
+            def chunked(params, prompt, *, cfg, cache):
+                return prefill_chunked(params, prompt, cfg, cache,
+                                       chunk=prefill_chunk)
+
+            self._prefill = chunked
+        else:
+            self._prefill = jax.jit(prefill, static_argnames=("cfg",))
         self._decode = jax.jit(decode_step, static_argnames=("cfg",))
         self._lock = threading.Lock()
         self.sessions: Dict[int, Any] = {}   # insertion-ordered = LRU

@@ -140,3 +140,72 @@ def test_pp_config_rejected():
     with pytest.raises(NotImplementedError):
         prefill(params, jnp.ones((1, 4), jnp.int32), cfg,
                 init_kv_cache(cfg, 1, 8))
+
+
+def test_chunked_prefill_parity_with_whole_prefill():
+    """prefill_chunked must produce the same last-position logits and
+    the same cache as one whole-prompt prefill — the bounded-compile
+    alternative for compile-helper-killer models (SURVEY section 9),
+    including GQA and a non-divisible tail chunk."""
+    from ray_tpu.models.generate import prefill_chunked
+
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, max_seq_len=64,
+                            pos_emb="rope", attention_impl="reference",
+                            dtype=jnp.float32, remat=False)
+    params, _ = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 13), 0,
+                                cfg.vocab_size)
+    whole_logits, whole_cache = prefill(
+        params, prompt, cfg, init_kv_cache(cfg, 2, 32))
+    # chunk=4 over 13 tokens: three full chunks + tail of 1
+    chunk_logits, chunk_cache = prefill_chunked(
+        params, prompt, cfg, init_kv_cache(cfg, 2, 32), chunk=4)
+    assert int(chunk_cache["pos"]) == 13 == int(whole_cache["pos"])
+    np.testing.assert_allclose(np.asarray(chunk_logits),
+                               np.asarray(whole_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(chunk_cache["k"]),
+                               np.asarray(whole_cache["k"]),
+                               rtol=2e-4, atol=2e-4)
+    # and decode continues identically from a chunk-built cache
+    tok = jnp.argmax(chunk_logits, axis=-1).astype(jnp.int32)
+    l1, _ = decode_step(params, tok, chunk_cache, cfg)
+    l2, _ = decode_step(params, tok, whole_cache, cfg)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_session_chunked_prefill_tokens_match():
+    """DecodeSessionCore(prefill_chunk=N) serves the same tokens as the
+    whole-prefill session."""
+    from ray_tpu.serve.decode_session import DecodeSessionCore
+
+    cfg = TransformerConfig.tiny(max_seq_len=64,
+                                 attention_impl="reference",
+                                 dtype=jnp.float32)
+    a = DecodeSessionCore(cfg, max_len=64, seed=3)
+    b = DecodeSessionCore(cfg, max_len=64, seed=3, prefill_chunk=4)
+    prompt = list(range(10))
+    ra = a.handle({"op": "start", "prompt": prompt})
+    rb = b.handle({"op": "start", "prompt": prompt})
+    assert ra["token"] == rb["token"]
+    for _ in range(5):
+        ta = a.handle({"op": "next", "sid": ra["sid"]})["token"]
+        tb = b.handle({"op": "next", "sid": rb["sid"]})["token"]
+        assert ta == tb
+
+
+def test_chunked_prefill_rejects_overlong_prompt():
+    """Same loud failure as whole-prompt prefill — silent cache
+    corruption via clamped dynamic_update_slice is not acceptable."""
+    from ray_tpu.models.generate import prefill_chunked
+
+    cfg = TransformerConfig.tiny(max_seq_len=64,
+                                 attention_impl="reference",
+                                 dtype=jnp.float32)
+    params, _ = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 40), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds cache capacity"):
+        prefill_chunked(params, prompt, cfg, init_kv_cache(cfg, 1, 32),
+                        chunk=8)
