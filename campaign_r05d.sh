@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 campaign, stage D: queued behind stages A/B/C on the serial
+# flock; runs probe12 (pixel-env PPO past the 128-env compile ceiling
+# via PPOConfig.env_chunk — bounded-compile rollouts).
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok12 () {
+    [ -f TPU_PROBE12_r05.jsonl ] \
+        && grep '"stage": "rl_ppo_pixel"' TPU_PROBE12_r05.jsonl \
+           | grep -v '"error"' | grep -q '"num_envs": 512'
+}
+
+tries=0
+while [ $tries -lt 10 ]; do
+    tries=$((tries+1))
+    echo "=== probe12 attempt $tries $(date -u +%H:%M:%S) ===" >> probe12_r05.err
+    python tpu_probe12.py >> probe12_r05.out 2>> probe12_r05.err
+    if ok12; then
+        echo "=== probe12 landed $(date -u +%H:%M:%S) ===" >> probe12_r05.err
+        break
+    fi
+    if [ -f TPU_PROBE12_r05.jsonl ] && ! ok12; then
+        mv TPU_PROBE12_r05.jsonl "TPU_PROBE12_r05.abort.$tries"
+    fi
+    sleep 240
+done
+echo "stage D done $(date -u +%H:%M:%S)" >> campaign_r05.log

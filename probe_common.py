@@ -93,12 +93,19 @@ def enable_compile_cache() -> None:
 def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
                 steps: int = 12, seq: int = 1024,
                 blocks=(1024, 1024), mu_dtype=None,
-                preset: str = "small") -> float:
+                preset: str = "small",
+                compiler_options: dict | None = None) -> float:
     """GPT-2 train-step MFU at the given recipe (``preset`` picks the
     size; default small = the BASELINE workload); emits an "mfu" stage
     record.  Peak FLOPs via bench._peak_flops (device-kind table,
     longest-prefix matched — the probes' old `"v5" in kind` guess
-    mis-rated v5p/v6e)."""
+    mis-rated v5p/v6e).
+
+    ``compiler_options`` go through the AOT ``lower().compile()`` path —
+    the only channel that reaches the compiler when compilation happens
+    in the remote helper (client-side XLA_FLAGS either never arrive or,
+    worse, hit the local parser as unknown flags and abort the
+    process)."""
     import jax
     import optax
 
@@ -118,6 +125,9 @@ def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
                                 0, cfg.vocab_size)
     data = {"tokens": tokens}
+    if compiler_options:
+        step = step.lower(params, opt_state, data).compile(
+            compiler_options=compiler_options)
     for _ in range(2):
         params, opt_state, m = step(params, opt_state, data)
     float(m["loss"])

@@ -31,6 +31,11 @@ class PPOConfig:
     env: Optional[Callable[[], JaxEnv]] = None
     num_envs: int = 64            # vectorized envs per worker
     rollout_length: int = 128     # steps per env per iteration
+    # bound the compiled rollout to this many envs (lax.map over
+    # num_envs // env_chunk chunk rollouts); None = one flat program.
+    # Use for conv/pixel policies at >=512 envs, where a single
+    # proportional-to-num_envs program kills the compiler (SURVEY §9)
+    env_chunk: Optional[int] = None
     num_workers: int = 0          # 0 = rollouts inline on the driver
     gamma: float = 0.99
     gae_lambda: float = 0.95
@@ -90,7 +95,8 @@ def _make_elementwise_apply(pipe):
 
 def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
                     rollout_length: int, pipeline=None,
-                    action_pipeline=None, reward_pipeline=None):
+                    action_pipeline=None, reward_pipeline=None,
+                    env_chunk: Optional[int] = None):
     """Jittable rollout: ``(params, env_states, obs, conn_state, key) ->
     (traj, env_states, last_obs, conn_state, last_value, key)``.
 
@@ -102,12 +108,30 @@ def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
     ``reset_on_done``.  Action connectors transform what the ENV
     receives while the stored action stays the policy's own output
     (log_prob consistency — the reference's action-connector contract);
-    reward connectors transform stored rewards."""
+    reward connectors transform stored rewards.
+
+    ``env_chunk`` bounds the COMPILED program size: envs are
+    independent, so a rollout over ``num_envs`` is ``lax.map`` over
+    ``num_envs // env_chunk`` chunk-sized rollouts — XLA compiles ONE
+    chunk body regardless of the env count.  This is the rollout twin
+    of ``models/generate.py prefill_chunk`` (the round-4 compile-helper
+    killer was a single program proportional to the full env batch;
+    SURVEY §9 round-5 amendment)."""
     if getattr(policy, "is_recurrent", False):
         raise ValueError(
             "recurrent policies (use_lstm) are supported by PPO's local "
             "path only (make_recurrent_rollout_fn); this code path does "
             "not carry policy state")
+    if env_chunk is not None and env_chunk <= 0:
+        raise ValueError(f"env_chunk={env_chunk} must be positive")
+    if env_chunk and env_chunk < num_envs:
+        if num_envs % env_chunk:
+            raise ValueError(
+                f"env_chunk={env_chunk} must divide num_envs={num_envs}")
+        return _make_chunked_rollout_fn(
+            env, policy, num_envs, rollout_length, env_chunk,
+            pipeline=pipeline, action_pipeline=action_pipeline,
+            reward_pipeline=reward_pipeline)
     has_conn = pipeline is not None and pipeline.connectors
     apply_conn = jax.vmap(pipeline) if has_conn else (lambda s, x: (s, x))
     to_env_action = _make_elementwise_apply(action_pipeline)
@@ -141,6 +165,48 @@ def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
         _, last_value = jax.vmap(lambda o: policy.forward(params, o))(
             plast)
         return traj, env_states, last_obs, conn_state, last_value, key
+
+    return rollout
+
+
+def _make_chunked_rollout_fn(env, policy, num_envs, rollout_length,
+                             env_chunk, pipeline=None,
+                             action_pipeline=None, reward_pipeline=None):
+    """``lax.map`` of chunk-sized rollouts over the env axis; same
+    signature and return shapes as the flat rollout.  Params are closed
+    over (one copy shared by every chunk iteration)."""
+    n_chunks = num_envs // env_chunk
+    inner = make_rollout_fn(env, policy, env_chunk, rollout_length,
+                            pipeline=pipeline,
+                            action_pipeline=action_pipeline,
+                            reward_pipeline=reward_pipeline)
+    tmap = jax.tree_util.tree_map
+
+    def split(tree):           # [num_envs, ...] -> [n_chunks, chunk, ...]
+        return tmap(lambda x: x.reshape((n_chunks, env_chunk)
+                                        + x.shape[1:]), tree)
+
+    def merge(tree):           # [n_chunks, chunk, ...] -> [num_envs, ...]
+        return tmap(lambda x: x.reshape((num_envs,) + x.shape[2:]), tree)
+
+    def merge_traj(tree):      # [n_chunks, T, chunk, ...] -> [T, N, ...]
+        return tmap(lambda x: jnp.moveaxis(x, 0, 1).reshape(
+            (rollout_length, num_envs) + x.shape[3:]), tree)
+
+    def rollout(params, env_states, obs, conn_state, key):
+        key, sub = jax.random.split(key)
+        chunk_keys = jax.random.split(sub, n_chunks)
+
+        def body(args):
+            (es, ob, cs), k = args
+            traj, es, last_obs, cs, last_value, _ = inner(
+                params, es, ob, cs, k)
+            return traj, es, last_obs, cs, last_value
+
+        traj, env_states, last_obs, conn_state, last_value = jax.lax.map(
+            body, (split((env_states, obs, conn_state)), chunk_keys))
+        return (merge_traj(traj), merge(env_states), merge(last_obs),
+                merge(conn_state), merge(last_value), key)
 
     return rollout
 
@@ -376,6 +442,10 @@ class PPO(Algorithm):
         self.conn_state = self.pipeline.init_state_batch(cfg.num_envs)
         self._recurrent = bool(getattr(self.policy, "is_recurrent", False))
         if self._recurrent:
+            if cfg.env_chunk:
+                raise ValueError("env_chunk requires a feedforward "
+                                 "policy (the LSTM state does not ride "
+                                 "the chunk map)")
             self.pstate = self.policy.initial_state(cfg.num_envs)
             self._rollout = make_recurrent_rollout_fn(
                 self.env, self.policy, cfg.num_envs, cfg.rollout_length,
@@ -385,7 +455,8 @@ class PPO(Algorithm):
             self._rollout = make_rollout_fn(
                 self.env, self.policy, cfg.num_envs, cfg.rollout_length,
                 pipeline=self.pipeline, action_pipeline=self._action_pipe,
-                reward_pipeline=self._reward_pipe)
+                reward_pipeline=self._reward_pipe,
+                env_chunk=cfg.env_chunk)
         self._train_iter = jax.jit(self._make_train_iter())
         self._workers = None
         if cfg.num_workers > 0:

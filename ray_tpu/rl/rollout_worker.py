@@ -48,7 +48,9 @@ class RolloutWorker:
         rollout = make_rollout_fn(self.env, self.policy, cfg.num_envs,
                                   cfg.rollout_length, pipeline=pipeline,
                                   action_pipeline=action_pipe,
-                                  reward_pipeline=reward_pipe)
+                                  reward_pipeline=reward_pipe,
+                                  env_chunk=getattr(cfg, "env_chunk",
+                                                    None))
 
         def sample_fn(params, env_states, obs, conn_state, key):
             traj, env_states, obs, conn_state, last_value, key = rollout(

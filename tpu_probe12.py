@@ -1,0 +1,60 @@
+"""Twelfth staged on-chip probe — pixel-env RL past the compile
+ceiling.
+
+Round-4's probe6 stalled at 128 conv envs: one rollout program
+proportional to the full env batch killed the remote compile helper at
+>=512 envs (SURVEY §9).  PPOConfig.env_chunk is the engineered answer
+(lax.map of chunk-sized rollouts — XLA compiles ONE 128-env body no
+matter the env count); this probe measures PixelPong conv-PPO at
+512/1024/2048 envs through it, with the 128-env flat program as the
+control row.
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache
+
+OUT = __file__.replace("tpu_probe12.py", "TPU_PROBE12_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+
+    def ppo_pong(num_envs, rollout, env_chunk):
+        from ray_tpu.rl import PixelPong, PPOConfig
+        algo = PPOConfig(env=PixelPong, num_envs=num_envs,
+                         rollout_length=rollout, env_chunk=env_chunk,
+                         num_sgd_epochs=2, num_minibatches=4, lr=3e-4,
+                         seed=0).build()
+        t_c = time.perf_counter()
+        algo.train()                      # compile + warmup
+        compile_s = time.perf_counter() - t_c
+        t0 = time.perf_counter()
+        steps = 0
+        iters = 0
+        while time.perf_counter() - t0 < 8.0 or iters < 3:
+            res = algo.train()
+            steps += res["env_steps_this_iter"]
+            iters += 1
+        dt = time.perf_counter() - t0
+        led.emit("rl_ppo_pixel", {
+            "env": "PixelPong(conv)", "num_envs": num_envs,
+            "rollout": rollout, "env_chunk": env_chunk,
+            "env_steps_per_s": round(steps / dt, 1), "iters": iters,
+            "compile_s": round(compile_s, 1),
+            "reward": round(res["episode_reward_mean"], 2)})
+
+    for ne, chunk in ((128, None), (512, 128), (1024, 128), (2048, 256)):
+        led.guarded(f"rl_ppo_pixel:{ne}")(ppo_pong)(ne, 64, chunk)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()

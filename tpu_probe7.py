@@ -1,10 +1,10 @@
 """Seventh staged on-chip probe — the last MFU levers at the winning
 recipe (b16, 1024x1024 flash blocks, bf16 Adam-mu = 0.3702 official):
 loss_chunk sweep (128 default vs 256/512 — fewer, larger vocab-50k
-matmuls per step) and XLA's latency-hiding scheduler (compile-time
-flag, so it must be set before the first jax import; pass
-RAY_TPU_PROBE7_LHS=1 to run the flagged variant — the runner invokes
-this script twice).
+matmuls per step) and XLA's latency-hiding scheduler (passed as
+per-program compiler_options through the AOT compile path; pass
+RAY_TPU_PROBE7_LHS=1 to run that variant — the runner invokes this
+script twice).
 
 Uses the shared probe_common harness.  Same discipline: ONE claim,
 guarded stages, fsync'd ledger, never kill.
@@ -12,11 +12,13 @@ guarded stages, fsync'd ledger, never kill.
 
 import os
 
+# Latency-hiding scheduler rides per-program compiler_options through
+# the AOT compile path (probe_common.measure_mfu) — NOT XLA_FLAGS: the
+# client-side flag parser in this jaxlib aborts on the unknown TPU flag
+# (parse_flags_from_env fatal), and compilation happens in the remote
+# helper anyway, which client env vars never reach.
 LHS = os.environ.get("RAY_TPU_PROBE7_LHS") == "1"
-if LHS:
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_tpu_enable_latency_hiding_scheduler=true").strip()
+LHS_OPTS = {"xla_tpu_enable_latency_hiding_scheduler": "true"}
 
 import time  # noqa: E402
 
@@ -42,7 +44,8 @@ def main() -> None:
         grid = ((f"b16_chunk128{suffix}", nr),) + grid
     for tag, kw in grid:
         led.guarded(f"mfu:{tag}")(measure_mfu)(
-            led, tag, kw, 16, blocks=(1024, 1024), mu_dtype=bf16)
+            led, tag, kw, 16, blocks=(1024, 1024), mu_dtype=bf16,
+            compiler_options=LHS_OPTS if LHS else None)
 
     led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1),
                       "lhs": LHS})
