@@ -52,6 +52,39 @@ def _peak_flops(device) -> float:
     return 197.0 * 1e12  # conservative default
 
 
+def timed_mfu_loop(step, params, opt_state, data, steps,
+                   tokens_per_step, flops_tok, peak):
+    """THE timing discipline, shared by the headline measurement, the
+    scaling rows, and probe_common.measure_mfu (one copy — the r4/r5
+    barrier fixes each had to be reasoned about per-copy before this).
+
+    ``float(m["loss"])`` is the barrier: a scalar host readback is the
+    only sync the axon relay cannot satisfy at remote enqueue
+    (block_until_ready returns early there).  If async dispatch outran
+    the device (non-physical MFU), re-times with a per-step sync.
+    Returns ``(mfu, dt, params, opt_state)`` — params/opt_state are
+    threaded through because ``step`` donates them.
+    """
+    m = None
+
+    def timed(sync_each: bool) -> float:
+        nonlocal params, opt_state, m
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, m = step(params, opt_state, data)
+            if sync_each:
+                float(m["loss"])
+        float(m["loss"])
+        return time.perf_counter() - t0
+
+    dt = timed(False)
+    mfu = steps * tokens_per_step / dt * flops_tok / peak
+    if not (0.0 < mfu < 0.95):  # async dispatch outran the device
+        dt = timed(True)
+        mfu = steps * tokens_per_step / dt * flops_tok / peak
+    return mfu, dt, params, opt_state
+
+
 def _run_measurement() -> dict:
     """The actual benchmark body; assumes a working JAX backend."""
     t_start = time.perf_counter()
@@ -143,28 +176,13 @@ def _run_measurement() -> dict:
     float(metrics["loss"])
     log(f"warmup done; measuring {steps} steps")
 
-    def _measure(sync_every_step: bool) -> float:
-        nonlocal params, opt_state
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt_state, m = step(params, opt_state, batch_data)
-            if sync_every_step:
-                float(m["loss"])
-        float(m["loss"])
-        return time.perf_counter() - t0
-
-    dt = _measure(sync_every_step=False)
     tokens_per_step = batch * seq
     flops_tok = flops_per_token(cfg, seq)
     peak = _peak_flops(jax.devices()[0])
-
-    def _mfu(dt: float) -> float:
-        return steps * tokens_per_step / dt * flops_tok / peak
-
-    if not (0.0 < _mfu(dt) < 0.95):  # async dispatch outran the device
-        dt = _measure(sync_every_step=True)
+    mfu, dt, params, opt_state = timed_mfu_loop(
+        step, params, opt_state, batch_data, steps, tokens_per_step,
+        flops_tok, peak)
     tok_s = steps * tokens_per_step / dt
-    mfu = _mfu(dt)
     detail = {"tokens_per_s": round(tok_s, 1),
               "step_ms": round(1000 * dt / steps, 2),
               "backend": jax.default_backend()}
@@ -192,7 +210,57 @@ def _run_measurement() -> dict:
             detail["kernels"] = _validate_kernels_on_chip(log)
         except Exception as exc:  # never sink the headline number
             detail["kernels"] = {"error": repr(exc)[:200]}
+        # Scaling evidence rows (VERDICT r4 next #1/#2): gpt2-medium at
+        # the same recipe sits HIGHER on the roofline than small (the
+        # 0.40 target's multi-chip argument), and the long-context row
+        # is the SP story's single-chip anchor.  Same claim, guarded.
+        try:
+            detail["scaling"] = _scaling_rows_on_chip(log)
+        except Exception as exc:
+            detail["scaling"] = {"error": repr(exc)[:200]}
     return result
+
+
+def _scaling_rows_on_chip(log) -> dict:
+    """gpt2-medium b4 s1024 and gpt2-small b4 s4096 train MFU at the
+    headline recipe (probe8/probe9 r5 operating points: medium_b4
+    0.3839, b4_seq4096 0.3236 — both above-or-near small's official
+    0.37 with 4x the context)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import (TransformerConfig, flops_per_token,
+                                init_params, make_train_step)
+    rows = {}
+    peak = _peak_flops(jax.devices()[0])
+    for name, preset, batch, seq in (("medium_b4_s1024", "medium", 4, 1024),
+                                     ("small_b4_s4096", "small", 4, 4096)):
+        log(f"scaling: {name} compiling...")
+        cfg = TransformerConfig.gpt2(preset, remat=False, loss_chunk=128,
+                                     norm_remat=True,
+                                     max_seq_len=max(1024, seq))
+        params, _ = init_params(jax.random.PRNGKey(0), cfg)
+        opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+        opt_state = opt.init(params)
+        step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+        data = {"tokens": jax.random.randint(jax.random.PRNGKey(1),
+                                             (batch, seq), 0,
+                                             cfg.vocab_size)}
+        for _ in range(2):
+            params, opt_state, m = step(params, opt_state, data)
+        float(m["loss"])
+        steps = 12
+        flops_tok = flops_per_token(cfg, seq)
+        mfu, dt, params, opt_state = timed_mfu_loop(
+            step, params, opt_state, data, steps, batch * seq,
+            flops_tok, peak)
+        rows[name] = {"mfu": round(mfu, 4),
+                      "step_ms": round(1000 * dt / steps, 1),
+                      "tok_s": round(steps * batch * seq / dt)}
+        log(f"scaling: {name} mfu={rows[name]['mfu']}")
+        del params, opt_state, step, data, m
+    return rows
 
 
 def _validate_kernels_on_chip(log) -> dict:
@@ -265,13 +333,19 @@ def _validate_kernels_on_chip(log) -> dict:
         # harder still, but scanned pallas bodies were observed wedging
         # the remote compile helper for >10 min — not worth the risk in
         # the same claim as the headline.)
+        # The barrier is a scalar HOST READBACK, not block_until_ready:
+        # under the axon relay block_until_ready returns at remote
+        # enqueue (probe11 r5 measured a 1024-token llama prefill at a
+        # non-physical 1.8 ms through it), which is exactly why the r4
+        # capture showed flash≈naive "parity" at seq2048 — both sides
+        # were timed at the enqueue floor.
         fnj = jax.jit(fn)
         out = fnj(q0, kb, vb)
-        jax.block_until_ready(out)                # compile + warmup
+        float(jnp.max(out))                       # compile + warmup
         t0 = time.perf_counter()
         for _ in range(n):
             out = fnj(out, kb, vb)
-        jax.block_until_ready(out)
+        float(jnp.max(out))
         return (time.perf_counter() - t0) / n
 
     for seq in (2048, 8192):

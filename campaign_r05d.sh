@@ -6,6 +6,9 @@ cd /root/repo
 exec 9>/tmp/tpu_campaign.lock
 flock 9
 
+# Gate on the 512-env row (the headline ask): 1024/2048 rows are
+# guarded extras whose legitimate OOM/ceiling errors should NOT force a
+# rerun that moves a good ledger aside.
 ok12 () {
     [ -f TPU_PROBE12_r05.jsonl ] \
         && grep '"stage": "rl_ppo_pixel"' TPU_PROBE12_r05.jsonl \

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 campaign, stage E: queued on the serial flock; runs probe13
+# (the remaining MFU cells: medium b5/b6 + chunk/seq variants, the two
+# unexplored large cells).
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok13 () {
+    [ -f TPU_PROBE13_r05.jsonl ] \
+        && grep '"stage": "mfu"' TPU_PROBE13_r05.jsonl \
+           | grep -v '"error"' | grep -q 'medium_b'
+}
+
+tries=0
+while [ $tries -lt 10 ]; do
+    tries=$((tries+1))
+    echo "=== probe13 attempt $tries $(date -u +%H:%M:%S) ===" >> probe13_r05.err
+    python tpu_probe13.py >> probe13_r05.out 2>> probe13_r05.err
+    if ok13; then
+        echo "=== probe13 landed $(date -u +%H:%M:%S) ===" >> probe13_r05.err
+        break
+    fi
+    if [ -f TPU_PROBE13_r05.jsonl ] && ! ok13; then
+        mv TPU_PROBE13_r05.jsonl "TPU_PROBE13_r05.abort.$tries"
+    fi
+    sleep 240
+done
+echo "stage E done $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -133,22 +133,10 @@ def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
     float(m["loss"])
     compile_s = time.perf_counter() - t_stage
     peak = _peak_flops(jax.devices()[0])
-
-    def timed(sync_each: bool) -> float:
-        nonlocal params, opt_state, m
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt_state, m = step(params, opt_state, data)
-            if sync_each:
-                float(m["loss"])
-        float(m["loss"])
-        return time.perf_counter() - t0
-
-    dt = timed(False)
-    mfu = steps * batch * seq / dt * flops_per_token(cfg, seq) / peak
-    if not (0.0 < mfu < 0.95):       # async dispatch outran the device
-        dt = timed(True)
-        mfu = steps * batch * seq / dt * flops_per_token(cfg, seq) / peak
+    from bench import timed_mfu_loop
+    mfu, dt, params, opt_state = timed_mfu_loop(
+        step, params, opt_state, data, steps, batch * seq,
+        flops_per_token(cfg, seq), peak)
     ledger.emit("mfu", {"tag": tag, "model": f"gpt2-{preset}",
                         "batch": batch, "seq": seq,
                         "blocks": list(blocks), "mfu": round(mfu, 4),

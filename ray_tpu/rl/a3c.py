@@ -65,8 +65,9 @@ class _A3CWorker:
         self.params = self.policy.init(pkey)   # overwritten per call
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
-        self._rollout = make_rollout_fn(self.env, self.policy,
-                                        cfg.num_envs, cfg.rollout_length)
+        self._rollout = make_rollout_fn(
+            self.env, self.policy, cfg.num_envs, cfg.rollout_length,
+            env_chunk=getattr(cfg, "env_chunk", None))
         self._grad_fn = jax.jit(self._make_grad_fn())
         self._ep_returns = np.zeros(cfg.num_envs)
         self._done_returns: list = []

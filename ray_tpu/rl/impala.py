@@ -117,7 +117,8 @@ class TrajectoryWorker:
         ekeys = jax.random.split(ekey, cfg.num_envs)
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         self._rollout = jax.jit(make_rollout_fn(
-            self.env, self.policy, cfg.num_envs, cfg.rollout_length))
+            self.env, self.policy, cfg.num_envs, cfg.rollout_length,
+            env_chunk=getattr(cfg, "env_chunk", None)))
         self._ep_returns = np.zeros(cfg.num_envs)
         self._done_returns: list = []
 
@@ -181,7 +182,8 @@ class Impala(Algorithm):
             ekeys = jax.random.split(ekey, cfg.num_envs)
             self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
             self._rollout = jax.jit(make_rollout_fn(
-                self.env, self.policy, cfg.num_envs, cfg.rollout_length))
+                self.env, self.policy, cfg.num_envs, cfg.rollout_length,
+                env_chunk=getattr(cfg, "env_chunk", None)))
             self._ep_returns = np.zeros(cfg.num_envs)
 
     # -- the compiled learner step ------------------------------------------

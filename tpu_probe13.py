@@ -1,0 +1,53 @@
+"""Thirteenth staged on-chip probe — the remaining MFU cells.
+
+probe8 landed gpt2-medium b4 at 0.3839 (above small's 0.3702 official,
+confirming bigger d_model sits higher on the roofline) but b8/b16 and
+both large cells OOM'd the 16 GiB chip.  This grid fills the untried
+memory/batch cells between those points:
+
+  * medium b5/b6 — the largest batch that fits decides medium's
+    single-chip ceiling (b4 fits easily, b8 barely OOMs)
+  * medium b4 + loss_chunk 256 — chunk sweep at the new operating point
+  * medium b2 @ seq2048 — same tokens as b4@1024, attention fraction up
+  * large b2 with dots remat / large b1 without — the two unexplored
+    large cells (probe8 only tried b2-no-remat and b4-dots, both OOM)
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
+
+OUT = __file__.replace("tpu_probe13.py", "TPU_PROBE13_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax.numpy as jnp
+
+    nr = dict(remat=False, norm_remat=True)
+    bf16 = jnp.bfloat16
+    for tag, preset, kw, batch, seq in (
+            ("medium_b6", "medium", nr, 6, 1024),
+            ("medium_b5", "medium", nr, 5, 1024),
+            ("medium_b4_chunk256", "medium", dict(nr, loss_chunk=256), 4,
+             1024),
+            ("medium_b2_seq2048", "medium", nr, 2, 2048),
+            ("large_b2_dots", "large",
+             dict(remat="dots", norm_remat=True), 2, 1024),
+            ("large_b1", "large", nr, 1, 1024),
+    ):
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, kw, batch, seq=seq, blocks=(1024, 1024),
+            mu_dtype=bf16, preset=preset)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
