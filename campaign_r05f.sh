@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 campaign, stage F: queued on the serial flock; runs probe14
+# (flash block sweep at the seq-2048 kernel anomaly + b4 rows).
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok14 () {
+    [ -f TPU_PROBE14_r05.jsonl ] \
+        && grep '"stage": "kernel"' TPU_PROBE14_r05.jsonl \
+           | grep -v '"error"' | grep -q 'flash_b'
+}
+
+tries=0
+while [ $tries -lt 10 ]; do
+    tries=$((tries+1))
+    echo "=== probe14 attempt $tries $(date -u +%H:%M:%S) ===" >> probe14_r05.err
+    python tpu_probe14.py >> probe14_r05.out 2>> probe14_r05.err
+    if ok14; then
+        echo "=== probe14 landed $(date -u +%H:%M:%S) ===" >> probe14_r05.err
+        break
+    fi
+    if [ -f TPU_PROBE14_r05.jsonl ] && ! ok14; then
+        mv TPU_PROBE14_r05.jsonl "TPU_PROBE14_r05.abort.$tries"
+    fi
+    sleep 240
+done
+echo "stage F done $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 campaign, stage G: queued on the serial flock; runs probe15
+# (gradient-accumulation MFU grid — the last single-chip lever).
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok15 () {
+    [ -f TPU_PROBE15_r05.jsonl ] \
+        && grep '"stage": "mfu"' TPU_PROBE15_r05.jsonl \
+           | grep -v '"error"' | grep -q 'medium_m'
+}
+
+tries=0
+while [ $tries -lt 10 ]; do
+    tries=$((tries+1))
+    echo "=== probe15 attempt $tries $(date -u +%H:%M:%S) ===" >> probe15_r05.err
+    python tpu_probe15.py >> probe15_r05.out 2>> probe15_r05.err
+    if ok15; then
+        echo "=== probe15 landed $(date -u +%H:%M:%S) ===" >> probe15_r05.err
+        break
+    fi
+    if [ -f TPU_PROBE15_r05.jsonl ] && ! ok15; then
+        mv TPU_PROBE15_r05.jsonl "TPU_PROBE15_r05.abort.$tries"
+    fi
+    sleep 240
+done
+echo "stage G done $(date -u +%H:%M:%S)" >> campaign_r05.log

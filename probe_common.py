@@ -94,7 +94,8 @@ def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
                 steps: int = 12, seq: int = 1024,
                 blocks=(1024, 1024), mu_dtype=None,
                 preset: str = "small",
-                compiler_options: dict | None = None) -> float:
+                compiler_options: dict | None = None,
+                accum_steps: int = 1) -> float:
     """GPT-2 train-step MFU at the given recipe (``preset`` picks the
     size; default small = the BASELINE workload); emits an "mfu" stage
     record.  Peak FLOPs via bench._peak_flops (device-kind table,
@@ -121,7 +122,8 @@ def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
     params, _ = init_params(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=mu_dtype)
     opt_state = opt.init(params)
-    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    step = jax.jit(make_train_step(cfg, opt, accum_steps=accum_steps),
+                   donate_argnums=(0, 1))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
                                 0, cfg.vocab_size)
     data = {"tokens": tokens}
@@ -139,6 +141,7 @@ def measure_mfu(ledger: ProbeLedger, tag: str, cfg_kw: dict, batch: int,
         flops_per_token(cfg, seq), peak)
     ledger.emit("mfu", {"tag": tag, "model": f"gpt2-{preset}",
                         "batch": batch, "seq": seq,
+                        "accum": accum_steps,
                         "blocks": list(blocks), "mfu": round(mfu, 4),
                         "step_ms": round(1000 * dt / steps, 1),
                         "tok_s": round(steps * batch * seq / dt),

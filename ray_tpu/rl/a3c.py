@@ -43,6 +43,8 @@ class A3CConfig:
     max_grad_norm: float = 40.0
     hidden: tuple = (64, 64)
     seed: int = 0
+    # bound the compiled rollout to this many envs (see PPOConfig)
+    env_chunk: Optional[int] = None
 
     def build(self) -> "A3C":
         return A3C(self)
@@ -67,7 +69,7 @@ class _A3CWorker:
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         self._rollout = make_rollout_fn(
             self.env, self.policy, cfg.num_envs, cfg.rollout_length,
-            env_chunk=getattr(cfg, "env_chunk", None))
+            env_chunk=cfg.env_chunk)
         self._grad_fn = jax.jit(self._make_grad_fn())
         self._ep_returns = np.zeros(cfg.num_envs)
         self._done_returns: list = []

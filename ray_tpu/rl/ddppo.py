@@ -96,8 +96,7 @@ class DDPPO(Algorithm):
         local_batch = cfg.num_envs * cfg.rollout_length
         rollout = make_rollout_fn(self.env, self.policy, cfg.num_envs,
                                   cfg.rollout_length,
-                                  env_chunk=getattr(cfg, "env_chunk",
-                                                    None))
+                                  env_chunk=cfg.env_chunk)
         update = make_update_fn(self.policy, self.optimizer, cfg,
                                 local_batch, axis_name="dp")
         discrete = self.env.discrete

@@ -50,6 +50,8 @@ class ImpalaConfig:
     lr: float = 5e-4
     max_grad_norm: float = 40.0
     hidden: tuple = (64, 64)
+    # bound the compiled rollout to this many envs (see PPOConfig)
+    env_chunk: Optional[int] = None
     seed: int = 0
     # None = plain V-trace policy gradient (IMPALA); a float enables
     # the PPO clipped surrogate on V-trace advantages — which IS APPO
@@ -118,7 +120,7 @@ class TrajectoryWorker:
         self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
         self._rollout = jax.jit(make_rollout_fn(
             self.env, self.policy, cfg.num_envs, cfg.rollout_length,
-            env_chunk=getattr(cfg, "env_chunk", None)))
+            env_chunk=cfg.env_chunk))
         self._ep_returns = np.zeros(cfg.num_envs)
         self._done_returns: list = []
 
@@ -183,7 +185,7 @@ class Impala(Algorithm):
             self.env_states, self.obs = jax.vmap(self.env.reset)(ekeys)
             self._rollout = jax.jit(make_rollout_fn(
                 self.env, self.policy, cfg.num_envs, cfg.rollout_length,
-                env_chunk=getattr(cfg, "env_chunk", None)))
+                env_chunk=cfg.env_chunk))
             self._ep_returns = np.zeros(cfg.num_envs)
 
     # -- the compiled learner step ------------------------------------------

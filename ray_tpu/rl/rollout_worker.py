@@ -49,8 +49,7 @@ class RolloutWorker:
                                   cfg.rollout_length, pipeline=pipeline,
                                   action_pipeline=action_pipe,
                                   reward_pipeline=reward_pipe,
-                                  env_chunk=getattr(cfg, "env_chunk",
-                                                    None))
+                                  env_chunk=cfg.env_chunk)
 
         def sample_fn(params, env_states, obs, conn_state, key):
             traj, env_states, obs, conn_state, last_value, key = rollout(

@@ -1,0 +1,91 @@
+"""Fourteenth staged on-chip probe — flash kernel block sweep at the
+seq-2048 anomaly point.
+
+bench.py's kernel micro (b1, 8 heads) times flash at 0.78x naive at
+seq2048 with the headline's 1024x1024 blocks, while the TRAIN MFU at
+the same seq shows flash 2.4x ahead (probe9: 0.3229 vs 0.1349) — the
+micro is either block-tuned wrong for short seq or too small to cover
+pallas grid overhead.  Two grids:
+
+  * block sweep at (b1,h8,seq2048): q/k blocks in {512,1024,2048}
+  * batch sweep: the same timing at b4 (the train step's operating
+    point) for flash AND naive — if flash wins at b4, the micro's b1
+    row was under-occupancy, not a kernel deficiency
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import os
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache
+
+OUT = __file__.replace("tpu_probe14.py", "TPU_PROBE14_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax
+    import jax.numpy as jnp
+
+    def chained_time(fn, q0, kb, vb, n=16) -> float:
+        fnj = jax.jit(fn)
+        out = fnj(q0, kb, vb)
+        float(jnp.max(out))                   # compile + warmup; real sync
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fnj(out, kb, vb)
+        float(jnp.max(out))
+        return (time.perf_counter() - t0) / n
+
+    def mk(batch, seq):
+        ks = jax.random.split(jax.random.PRNGKey(seq + batch), 3)
+        return [jax.random.normal(k, (batch, seq, 8, 64), jnp.bfloat16)
+                for k in ks]
+
+    def flash_time(batch, seq, bq, bk, tag):
+        # block env vars are read at call time (ops.flash_attention
+        # _env_block), so setting them between jits is enough
+        os.environ["RAY_TPU_FLASH_BLOCK_Q"] = str(bq)
+        os.environ["RAY_TPU_FLASH_BLOCK_K"] = str(bk)
+        from ray_tpu.ops.flash_attention import flash_attention
+        q, k, v = mk(batch, seq)
+        t = chained_time(lambda *a: flash_attention(*a, causal=True),
+                         q, k, v)
+        led.emit("kernel", {"tag": tag, "batch": batch, "seq": seq,
+                            "blocks": [bq, bk],
+                            "ms": round(t * 1e3, 3)})
+        return t
+
+    def naive_time(batch, seq, tag):
+        from ray_tpu.ops.attention import reference_attention
+        q, k, v = mk(batch, seq)
+        t = chained_time(
+            lambda *a: reference_attention(*a, causal=True), q, k, v)
+        led.emit("kernel", {"tag": tag, "batch": batch, "seq": seq,
+                            "blocks": None, "ms": round(t * 1e3, 3)})
+        return t
+
+    # -- stage 1: block sweep at the anomaly point (b1, seq2048) ---------
+    for bq, bk in ((512, 512), (1024, 512), (512, 1024), (2048, 1024),
+                   (2048, 2048), (1024, 1024)):
+        led.guarded(f"flash_b1_s2048_{bq}x{bk}")(flash_time)(
+            1, 2048, bq, bk, f"flash_b1_s2048_{bq}x{bk}")
+    led.guarded("naive_b1_s2048")(naive_time)(1, 2048, "naive_b1_s2048")
+
+    # -- stage 2: representative batch (b4) at both seqs ------------------
+    for seq in (2048, 8192):
+        led.guarded(f"flash_b4_s{seq}")(flash_time)(
+            4, seq, 1024, 1024, f"flash_b4_s{seq}_1024x1024")
+        led.guarded(f"naive_b4_s{seq}")(naive_time)(4, seq,
+                                                    f"naive_b4_s{seq}")
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Fifteenth staged on-chip probe — gradient accumulation as the last
+single-chip MFU lever.
+
+probe13 capped the batch-bound ceiling at medium_b5 = 0.3865 (b6 OOMs).
+Accumulation changes the trade: activation memory scales with the
+MICRObatch while the Adam-moment read/write traffic (~GBs/update)
+amortizes over ``accum`` x more tokens — per-token model FLOPs (the
+MFU numerator) unchanged.  If the update tax on medium is ~6 ms/step,
+accum 4-8 puts the operating point at or past 0.40.
+
+Grid: medium micro-4/5 at accum 2/4/8, plus small micro-16 accum 4 (the
+BASELINE workload with the same trick).  All guarded; OOM fails the
+stage only.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
+
+OUT = __file__.replace("tpu_probe15.py", "TPU_PROBE15_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax.numpy as jnp
+
+    nr = dict(remat=False, norm_remat=True)
+    bf16 = jnp.bfloat16
+    for tag, preset, micro, accum in (
+            ("medium_m4_a2", "medium", 4, 2),
+            ("medium_m4_a4", "medium", 4, 4),
+            ("medium_m4_a8", "medium", 4, 8),
+            ("medium_m5_a4", "medium", 5, 4),
+            ("small_m16_a4", "small", 16, 4),
+    ):
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, nr, micro * accum, blocks=(1024, 1024),
+            mu_dtype=bf16, preset=preset, accum_steps=accum)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
