@@ -151,11 +151,11 @@ def _run_measurement() -> dict:
         os.environ.setdefault("RAY_TPU_FLASH_BLOCK_K", "1024")
         cfg = TransformerConfig.gpt2("small", remat=False, loss_chunk=128,
                                      norm_remat=True)
-        # accum 4 over micro-16: activation memory stays at the b16
-        # point while the Adam-moment HBM traffic amortizes over 4x the
-        # tokens — +0.007 MFU on the v5e (TPU_PROBE15_r05.jsonl
-        # small_m16_a4 0.3769 vs b16 flat 0.3702)
-        batch, seq, steps, accum = 64, 1024, 8, 4
+        # accum 8 over micro-16: activation memory stays at the b16
+        # point while the Adam-moment HBM traffic amortizes over 8x the
+        # tokens — +0.010 MFU on the v5e (TPU_PROBE16_r05.jsonl
+        # small_m16_a8 0.3798 vs a4 0.3769 vs b16 flat 0.3702)
+        batch, seq, steps, accum = 128, 1024, 6, 8
     else:  # smoke-test shape for CPU runs of this script
         cfg = TransformerConfig.tiny()
         batch, seq, steps, accum = 4, 128, 3, 1
@@ -230,7 +230,7 @@ def _run_measurement() -> dict:
 def _scaling_rows_on_chip(log) -> dict:
     """The scaling evidence rows at the headline recipe (probe8/9/15
     r5 operating points): gpt2-MEDIUM with in-step grad accumulation
-    CROSSES the 0.40 GPT-2 target on one chip (m4_a8 0.4175); the
+    CROSSES the 0.40 GPT-2 target on one chip (m4_a16 0.4235, probe16); the
     long-context row anchors the SP story (seq4096 0.3236, where naive
     attention OOMs outright — probe9)."""
     import jax
@@ -242,7 +242,7 @@ def _scaling_rows_on_chip(log) -> dict:
     rows = {}
     peak = _peak_flops(jax.devices()[0])
     for name, preset, batch, seq, accum in (
-            ("medium_m4_a8_s1024", "medium", 32, 1024, 8),
+            ("medium_m4_a16_s1024", "medium", 64, 1024, 16),
             ("small_b4_s4096", "small", 4, 4096, 1)):
         log(f"scaling: {name} compiling...")
         cfg = TransformerConfig.gpt2(preset, remat=False, loss_chunk=128,

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 campaign, stage J: probe11 rerun with the honest completion
+# barrier (scalar host readback; "synced": true rows) — the first
+# capture timed remote ENQUEUE, not execution.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok11b () {
+    [ -f TPU_PROBE11_r05.jsonl ] \
+        && grep '"synced": true' TPU_PROBE11_r05.jsonl \
+           | grep -v '"error"' | grep -q chunked_prefill_ttft
+}
+
+tries=0
+while [ $tries -lt 8 ]; do
+    tries=$((tries+1))
+    echo "=== probe11sync attempt $tries $(date -u +%H:%M:%S) ===" >> probe11_r05.err
+    python tpu_probe11.py >> probe11_r05.out 2>> probe11_r05.err
+    if ok11b; then
+        echo "=== probe11sync landed $(date -u +%H:%M:%S) ===" >> probe11_r05.err
+        break
+    fi
+    sleep 240
+done
+echo "stage J done $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -1,0 +1,24 @@
+#!/bin/bash
+# probe16: accumulation depth + LHS at the new operating point + 4096-env pixel RL.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok16 () {
+    [ -f TPU_PROBE16_r05.jsonl ] \
+        && grep '"stage": "mfu"' TPU_PROBE16_r05.jsonl \
+           | grep -v '"error"' | grep -q medium_m4
+}
+
+tries=0
+while [ $tries -lt 8 ]; do
+    tries=$((tries+1))
+    echo "=== probe16 attempt $tries $(date -u +%H:%M:%S) ===" >> probe16_r05.err
+    python tpu_probe16.py >> probe16_r05.out 2>> probe16_r05.err
+    if ok16; then
+        echo "=== probe16 landed $(date -u +%H:%M:%S) ===" >> probe16_r05.err
+        break
+    fi
+    sleep 240
+done
+echo "stage K done $(date -u +%H:%M:%S)" >> campaign_r05.log

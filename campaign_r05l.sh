@@ -1,0 +1,9 @@
+#!/bin/bash
+# Round-5 campaign, stage L: live bench validation of the new headline
+# recipe (small accum4) + scaling rows (medium a8 crossing 0.40).
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+echo "=== stage L bench $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "stage L bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -1,0 +1,66 @@
+"""Sixteenth staged on-chip probe — squeeze the new operating points.
+
+probe15 crossed the 0.40 GPT-2 target (medium m4_a8 0.4175).  This
+grid asks what's left on the table: deeper accumulation (a16), the
+latency-hiding scheduler AT the accumulated operating point (the scan
+epilogue + optimizer apply is exactly what LHS can overlap), small at
+a8, and the pixel-RL env_chunk path at 4096 envs.
+
+Uses the shared probe_common harness.  Same discipline: ONE claim,
+guarded stages, fsync'd ledger, never kill.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
+
+OUT = __file__.replace("tpu_probe16.py", "TPU_PROBE16_r05.jsonl")
+LHS_OPTS = {"xla_tpu_enable_latency_hiding_scheduler": "true"}
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax.numpy as jnp
+
+    nr = dict(remat=False, norm_remat=True)
+    bf16 = jnp.bfloat16
+    for tag, preset, micro, accum, opts in (
+            ("medium_m4_a16", "medium", 4, 16, None),
+            ("medium_m4_a8_lhs", "medium", 4, 8, LHS_OPTS),
+            ("small_m16_a8", "small", 16, 8, None),
+    ):
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, nr, micro * accum, blocks=(1024, 1024),
+            mu_dtype=bf16, preset=preset, accum_steps=accum,
+            compiler_options=opts)
+
+    def ppo_pong_4096():
+        from ray_tpu.rl import PixelPong, PPOConfig
+        algo = PPOConfig(env=PixelPong, num_envs=4096, rollout_length=64,
+                         env_chunk=256, num_sgd_epochs=2,
+                         num_minibatches=4, lr=3e-4, seed=0).build()
+        t_c = time.perf_counter()
+        algo.train()
+        compile_s = time.perf_counter() - t_c
+        t0 = time.perf_counter()
+        steps = iters = 0
+        while time.perf_counter() - t0 < 8.0 or iters < 3:
+            res = algo.train()
+            steps += res["env_steps_this_iter"]
+            iters += 1
+        dt = time.perf_counter() - t0
+        led.emit("rl_ppo_pixel", {
+            "env": "PixelPong(conv)", "num_envs": 4096, "rollout": 64,
+            "env_chunk": 256, "env_steps_per_s": round(steps / dt, 1),
+            "iters": iters, "compile_s": round(compile_s, 1),
+            "reward": round(res["episode_reward_mean"], 2)})
+
+    led.guarded("rl_ppo_pixel:4096")(ppo_pong_4096)()
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
