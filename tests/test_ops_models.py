@@ -362,3 +362,21 @@ def test_grad_accumulation_honors_mask():
                                rtol=2e-4)
     np.testing.assert_allclose(float(m1["grad_norm"]),
                                float(m2["grad_norm"]), rtol=2e-3)
+
+
+def test_sharded_grad_accumulation_on_virtual_mesh():
+    """accum_steps composes with dp×fsdp×tp shardings (the multichip
+    path): microbatch scan + f32 grad carry over sharded params."""
+    cfg = TransformerConfig.tiny()
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    params, axes = init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(params,
+                            pytree_shardings(axes, mesh, FSDP_TP_RULES))
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt, accum_steps=2))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 256)
+    with jax.set_mesh(mesh):
+        params, opt_state, metrics = step(params, opt_state,
+                                          {"tokens": toks})
+    assert np.isfinite(float(metrics["loss"]))
