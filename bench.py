@@ -591,7 +591,7 @@ def _attach_probe_evidence(result: dict) -> dict:
         import glob
         import re
         here = os.path.dirname(os.path.abspath(__file__))
-        best_rl, gens = None, {}
+        best_rl, gens, serve = None, {}, None
         paths = glob.glob(os.path.join(here, "TPU_PROBE*_r*.jsonl"))
         # only the NEWEST round's ledgers: a stale prior-round number must
         # not mask a regression by riding into the current headline
@@ -628,12 +628,26 @@ def _attach_probe_evidence(result: dict) -> dict:
                         ("prompt_len", "prefill_ms",
                          "decode_ms_per_tok", "decode_tok_s")
                         if k in rec}
+                elif (rec.get("kind") in ("chunked_prefill_ttft",
+                                          "decode")
+                      and rec.get("synced") and "tag" in rec):
+                    gens[rec["tag"]] = {
+                        k: rec[k] for k in
+                        ("prompt_len", "chunk", "first_ms",
+                         "warm_ttft_ms", "ms_per_tok") if k in rec}
+                elif stage == "serve_ttft" and "error" not in rec:
+                    serve = {k: rec[k] for k in
+                             ("p50_ttft_ms", "p90_ttft_ms",
+                              "decode_ms_per_tok_p50", "path", "model",
+                              "non_composite") if k in rec}
         detail = result.setdefault("detail", {})
         if best_rl is not None:
             best_rl["backend"] = "tpu"
             detail["rl_tpu"] = best_rl
         if gens:
             detail["gen_tpu"] = gens
+        if serve is not None:
+            detail["serve_tpu"] = serve
     except Exception:
         pass
     return result
