@@ -23,7 +23,10 @@ def train_loop(config):
                             pytree_shardings(axes, mesh, FSDP_TP_RULES))
     opt = optax.adamw(1e-3)
     opt_state = opt.init(params)
-    step = jax.jit(make_train_step(cfg, opt))
+    # accum_steps: microbatch the compiled step (activation memory at
+    # batch/accum; Adam-moment traffic amortized — the r5 MFU lever)
+    step = jax.jit(make_train_step(cfg, opt,
+                                   accum_steps=config.get("accum", 1)))
     tokens = jax.device_put(
         jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
                            cfg.vocab_size),
@@ -39,7 +42,7 @@ def main():
     import ray_tpu
     ray_tpu.init(num_cpus=4)
     result = JaxTrainer(
-        train_loop, train_loop_config={"steps": 3},
+        train_loop, train_loop_config={"steps": 3, "accum": 2},
         scaling_config=ScalingConfig(num_workers=2),
     ).fit()
     print("final loss:", result.metrics["loss"])

@@ -1,0 +1,120 @@
+"""Seventeenth staged on-chip probe — streamed decode through Serve.
+
+probe10 measured decode at 70 ms/token through per-request polling
+(each token = one HTTP POST), while the chip-side decode dispatch is
+~17 ms/token (probe11) — the difference is per-request serving-path
+overhead paid per token.  SSE streaming (`POST /<route>/stream`, one
+request, proxy-driven decode loop, one server-sent event per token) is
+the serving answer; this probe measures its per-token inter-arrival on
+the same on-chip gpt2-small replica as probe10.
+
+Claim discipline: replica is the only chip claimant; flock serializes.
+"""
+
+import os
+import time
+
+os.environ.setdefault("RAY_TPU_WORKER_SHUTDOWN_GRACE_S", "30")
+os.environ.setdefault("RAY_TPU_TPU_AUTODETECT", "0")
+
+from probe_common import ProbeLedger  # noqa: E402
+
+OUT = __file__.replace("tpu_probe17.py", "TPU_PROBE17_r05.jsonl")
+
+
+def main() -> None:
+    led = ProbeLedger(OUT)
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+
+    @serve.deployment(max_concurrent_queries=4)
+    class Generator:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models import TransformerConfig
+            from ray_tpu.serve.decode_session import DecodeSessionCore
+            self.backend = jax.default_backend()
+            self.device = getattr(jax.devices()[0], "device_kind", "?")
+            dtype = jnp.bfloat16 if self.backend == "tpu" else jnp.float32
+            self.core = DecodeSessionCore(
+                TransformerConfig.gpt2("small", max_seq_len=512,
+                                       dtype=dtype),
+                max_len=512)
+
+        def __call__(self, req):
+            if req.get("op") == "env":
+                return {"backend": self.backend, "device": self.device}
+            return self.core.handle(req)
+
+    import numpy as np
+    import requests
+    serve.run(Generator.bind(), name="generate")
+    addr = serve.api.http_address()
+    http = requests.Session()
+
+    env = http.post(f"{addr}/generate", json={"op": "env"},
+                    timeout=600).json()
+    led.emit("env", env)
+    if env.get("backend") != "tpu":
+        led.emit("abort", {"reason": f"replica backend={env.get('backend')}"})
+        _teardown(serve, ray_tpu)
+        return
+
+    prompt_len, new_tokens = 256, 24
+
+    def stream_session(i: int):
+        prompt = [(7 * i + j) % 250 for j in range(prompt_len)]
+        arrivals = []
+        t0 = time.perf_counter()
+        with http.post(f"{addr}/generate/stream",
+                       json={"prompt": prompt,
+                             "max_new_tokens": new_tokens},
+                       stream=True, timeout=900) as r:
+            r.raise_for_status()
+            for line in r.iter_lines():
+                if not line.startswith(b"data: "):
+                    continue
+                if line[len(b"data: "):] == b"[DONE]":
+                    break
+                arrivals.append(time.perf_counter())
+        ttft = arrivals[0] - t0
+        gaps = np.diff(arrivals)
+        return ttft, gaps
+
+    led.log("warmup (compiles prefill+decode on chip)")
+    t0 = time.perf_counter()
+    stream_session(0)
+    led.emit("warmup", {"compile_s": round(time.perf_counter() - t0, 1)})
+
+    ttfts, gaps = [], []
+    for i in range(1, 9):
+        ttft, g = stream_session(i)
+        ttfts.append(ttft)
+        gaps.extend(g.tolist())
+    led.emit("serve_stream", {
+        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "stream_ms_per_tok_p50":
+            round(float(np.percentile(gaps, 50)) * 1e3, 2),
+        "stream_tok_s":
+            round(1.0 / max(float(np.mean(gaps)), 1e-9), 1),
+        "sessions": 8, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "path": "http SSE stream->proxy-driven decode(replica ON CHIP)",
+        "model": "gpt2-small bf16 seq512"})
+    _teardown(serve, ray_tpu)
+    led.emit("done", {"teardown": "graceful"})
+
+
+def _teardown(serve, ray_tpu) -> None:
+    serve.shutdown()
+    time.sleep(5.0)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
