@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 campaign, stage M: probe17 (SSE streamed decode on-chip), then
+# a live validation of the new gpt2-medium headline recipe.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok17 () {
+    [ -f TPU_PROBE17_r05.jsonl ] \
+        && grep '"stage": "serve_stream"' TPU_PROBE17_r05.jsonl \
+           | grep -qv '"error"'
+}
+
+tries=0
+while [ $tries -lt 6 ]; do
+    tries=$((tries+1))
+    echo "=== probe17 attempt $tries $(date -u +%H:%M:%S) ===" >> probe17_r05.err
+    python tpu_probe17.py >> probe17_r05.out 2>> probe17_r05.err
+    if ok17; then
+        echo "=== probe17 landed $(date -u +%H:%M:%S) ===" >> probe17_r05.err
+        break
+    fi
+    sleep 240
+done
+
+echo "=== stage M bench (gpt2-medium headline) $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "stage M bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log
