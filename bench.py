@@ -254,11 +254,20 @@ def _scaling_rows_on_chip(log) -> dict:
     peak = _peak_flops(jax.devices()[0])
     for name, preset, batch, seq, accum in (
             ("small_m16_a8_s1024", "small", 128, 1024, 8),
-            ("small_b4_s4096", "small", 4, 4096, 1)):
+            ("small_b4_s4096", "small", 4, 4096, 1),
+            ("llama1b_bf16p_b4_dots", "llama1b", 4, 1024, 1)):
         log(f"scaling: {name} compiling...")
-        cfg = TransformerConfig.gpt2(preset, remat=False, loss_chunk=128,
-                                     norm_remat=True,
-                                     max_seq_len=max(1024, seq))
+        if preset == "llama1b":
+            # the llama family row (BASELINE config #4): 1.5B params,
+            # bf16 params + dots remat (fp32+Adam is ~19 GB > HBM) —
+            # 0.4769 MFU in TPU_PROBE18_r05.jsonl
+            cfg = TransformerConfig.llama(
+                "1b", max_seq_len=1024, remat="dots", norm_remat=True,
+                loss_chunk=128, param_dtype=jnp.bfloat16)
+        else:
+            cfg = TransformerConfig.gpt2(preset, remat=False,
+                                         loss_chunk=128, norm_remat=True,
+                                         max_seq_len=max(1024, seq))
         params, _ = init_params(jax.random.PRNGKey(0), cfg)
         opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
         opt_state = opt.init(params)

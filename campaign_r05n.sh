@@ -1,0 +1,26 @@
+#!/bin/bash
+# stage N: probe18 — llama-family train MFU.
+# a live validation of the new gpt2-medium headline recipe.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok18 () {
+    [ -f TPU_PROBE18_r05.jsonl ] \
+        && grep '"stage": "mfu"' TPU_PROBE18_r05.jsonl \
+           | grep -qv '"error"'
+}
+
+tries=0
+while [ $tries -lt 6 ]; do
+    tries=$((tries+1))
+    echo "=== probe18 attempt $tries $(date -u +%H:%M:%S) ===" >> probe18_r05.err
+    python tpu_probe18.py >> probe18_r05.out 2>> probe18_r05.err
+    if ok18; then
+        echo "=== probe18 landed $(date -u +%H:%M:%S) ===" >> probe18_r05.err
+        break
+    fi
+    sleep 240
+done
+
+echo "stage N done $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -1,0 +1,93 @@
+"""Eighteenth staged on-chip probe — llama-family TRAIN MFU.
+
+The campaign's train rows are all gpt2 (learned pos-emb, GELU,
+layernorm, MHA); the llama architecture exercises different compute
+paths — RoPE rotation, SwiGLU (3 mlp matmuls), RMSNorm, GQA flash
+attention — and BASELINE config #4 names the llama family explicitly.
+Memory walls on one 16 GiB chip: llama-1b fp32 params + Adam is ~19 GB
+(cannot fit), so the grid measures (a) llama-1b with bf16 params +
+dots remat at b2, and (b) a ~700M fp32 llama config (d1536 x 24L,
+vocab 32k) at the gpt2-medium-class operating point, with and without
+accumulation.
+
+Uses bench.timed_mfu_loop (the shared honest-barrier discipline)
+directly since probe_common.measure_mfu builds gpt2 presets only.
+"""
+
+import os
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache
+
+OUT = __file__.replace("tpu_probe18.py", "TPU_PROBE18_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bench import _peak_flops, timed_mfu_loop
+    from ray_tpu.models import (TransformerConfig, count_params,
+                                flops_per_token, init_params,
+                                make_train_step)
+
+    os.environ["RAY_TPU_FLASH_BLOCK_Q"] = "1024"
+    os.environ["RAY_TPU_FLASH_BLOCK_K"] = "1024"
+    peak = _peak_flops(jax.devices()[0])
+
+    def mfu_stage(tag, cfg, batch, accum=1, steps=8, seq=1024):
+        t0 = time.perf_counter()
+        params, _ = init_params(jax.random.PRNGKey(0), cfg)
+        opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
+        opt_state = opt.init(params)
+        step = jax.jit(make_train_step(cfg, opt, accum_steps=accum),
+                       donate_argnums=(0, 1))
+        data = {"tokens": jax.random.randint(jax.random.PRNGKey(1),
+                                             (batch, seq), 0,
+                                             cfg.vocab_size)}
+        for _ in range(2):
+            params, opt_state, m = step(params, opt_state, data)
+        float(m["loss"])
+        compile_s = time.perf_counter() - t0
+        mfu, dt, params, opt_state = timed_mfu_loop(
+            step, params, opt_state, data, steps, batch * seq,
+            flops_per_token(cfg, seq), peak)
+        led.emit("mfu", {"tag": tag, "params_m":
+                         round(count_params(cfg) / 1e6),
+                         "batch": batch, "accum": accum, "seq": seq,
+                         "mfu": round(mfu, 4),
+                         "step_ms": round(1000 * dt / steps, 1),
+                         "tok_s": round(steps * batch * seq / dt),
+                         "compile_s": round(compile_s, 1)})
+
+    # (a) real llama-1b: bf16 params (fp32+Adam is ~19 GB), dots remat
+    cfg_1b = TransformerConfig.llama(
+        "1b", max_seq_len=1024, remat="dots", norm_remat=True,
+        loss_chunk=128, param_dtype=jnp.bfloat16)
+    led.guarded("mfu:llama1b_bf16p_b2_dots")(mfu_stage)(
+        "llama1b_bf16p_b2_dots", cfg_1b, 2)
+    led.guarded("mfu:llama1b_bf16p_b4_dots")(mfu_stage)(
+        "llama1b_bf16p_b4_dots", cfg_1b, 4)
+
+    # (b) ~700M llama architecture, fp32 params, no remat (the
+    # gpt2-medium-class operating point on the llama compute path)
+    cfg_700 = TransformerConfig(
+        vocab_size=32000, d_model=1536, n_layers=24, n_heads=12,
+        n_kv_heads=4, d_ff=6144, max_seq_len=1024, pos_emb="rope",
+        activation="swiglu", norm="rmsnorm", tie_embeddings=False,
+        remat=False, norm_remat=True, loss_chunk=128)
+    led.guarded("mfu:llama700m_b4")(mfu_stage)(
+        "llama700m_b4", cfg_700, 4)
+    led.guarded("mfu:llama700m_m4_a8")(mfu_stage)(
+        "llama700m_m4_a8", cfg_700, 32, accum=8)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
