@@ -531,23 +531,36 @@ def make_train_step(cfg: TransformerConfig, optimizer, accum_steps: int = 1):
                 batch)
 
             def micro_step(carry, mb):
-                gsum, lsum = carry
+                gsum, lsum, csum = carry
                 loss, grads = grad_fn(params, mb)
+                # weight by this microbatch's valid-token count so the
+                # combined gradient equals the FULL-batch step even when
+                # a padding mask is uneven across microbatches (lm_loss
+                # normalizes per call by its own mask[:, 1:].sum();
+                # equal 1/accum weighting would over-weight nearly-empty
+                # microbatches)
+                if "mask" in mb:
+                    count = mb["mask"][:, 1:].astype(jnp.float32).sum()
+                else:
+                    count = jnp.float32(micro
+                                        * (mb["tokens"].shape[1] - 1))
                 gsum = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), gsum, grads)
-                return (gsum, lsum + loss), None
+                    lambda a, g: a + g.astype(jnp.float32) * count,
+                    gsum, grads)
+                return (gsum, lsum + loss * count, csum + count), None
 
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            (gsum, lsum), _ = jax.lax.scan(
-                micro_step, (zeros, jnp.float32(0.0)), mbatch)
+            (gsum, lsum, csum), _ = jax.lax.scan(
+                micro_step, (zeros, jnp.float32(0.0), jnp.float32(0.0)),
+                mbatch)
+            csum = jnp.maximum(csum, 1.0)
             # back to the dtype grad_fn itself produces (param dtype) so
             # optimizer state dtypes — and therefore buffer donation —
             # match the accum_steps=1 path
             grads = jax.tree_util.tree_map(
-                lambda g, p: (g / accum_steps).astype(p.dtype), gsum,
-                params)
-            loss = lsum / accum_steps
+                lambda g, p: (g / csum).astype(p.dtype), gsum, params)
+            loss = lsum / csum
         else:
             loss, grads = grad_fn(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)

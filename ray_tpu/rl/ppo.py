@@ -110,11 +110,14 @@ def make_rollout_fn(env: JaxEnv, policy: MLPPolicy, num_envs: int,
     (log_prob consistency — the reference's action-connector contract);
     reward connectors transform stored rewards.
 
-    ``env_chunk`` bounds the COMPILED program size: envs are
-    independent, so a rollout over ``num_envs`` is ``lax.map`` over
-    ``num_envs // env_chunk`` chunk-sized rollouts — XLA compiles ONE
-    chunk body regardless of the env count.  This is the rollout twin
-    of ``models/generate.py prefill_chunk`` (the round-4 compile-helper
+    ``env_chunk`` is an UPPER BOUND on the compiled program's env
+    batch: envs are independent, so a rollout over ``num_envs`` is
+    ``lax.map`` over ``num_envs // env_chunk`` chunk-sized rollouts —
+    XLA compiles ONE chunk body regardless of the env count.  When
+    ``num_envs <= env_chunk`` the flat program already satisfies the
+    bound and no chunking happens (so divisibility is only required
+    when chunking applies).  This is the rollout twin of
+    ``models/generate.py prefill_chunk`` (the round-4 compile-helper
     killer was a single program proportional to the full env batch;
     SURVEY §9 round-5 amendment)."""
     if getattr(policy, "is_recurrent", False):

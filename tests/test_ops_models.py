@@ -342,8 +342,11 @@ def test_grad_accumulation_matches_full_batch():
 
 
 def test_grad_accumulation_honors_mask():
-    """accum path must split EVERY batch leaf — a padded batch's mask
-    has to reach the microbatch loss (review finding r5)."""
+    """accum path must split EVERY batch leaf AND weight microbatches
+    by their valid-token counts: the mask here is deliberately UNEVEN
+    across microbatches (rows 0-1 nearly full, rows 2-3 nearly empty),
+    the case equal 1/accum weighting gets silently wrong (review
+    finding r5)."""
     import optax
 
     cfg = TransformerConfig.tiny()
@@ -352,7 +355,7 @@ def test_grad_accumulation_honors_mask():
     opt_state = opt.init(params)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0,
                                 cfg.vocab_size)
-    mask = jnp.zeros((4, 64)).at[:, :8].set(1.0)
+    mask = jnp.zeros((4, 64)).at[:2, :60].set(1.0).at[2:, :3].set(1.0)
     batch = {"tokens": tokens, "mask": mask}
     flat = jax.jit(make_train_step(cfg, opt))
     acc = jax.jit(make_train_step(cfg, opt, accum_steps=2))

@@ -82,31 +82,43 @@ def main() -> None:
                 if line[len(b"data: "):] == b"[DONE]":
                     break
                 arrivals.append(time.perf_counter())
+        if not arrivals:
+            raise RuntimeError("stream yielded no token events")
         ttft = arrivals[0] - t0
         gaps = np.diff(arrivals)
         return ttft, gaps
 
-    led.log("warmup (compiles prefill+decode on chip)")
-    t0 = time.perf_counter()
-    stream_session(0)
-    led.emit("warmup", {"compile_s": round(time.perf_counter() - t0, 1)})
+    # teardown MUST run however measurement ends — a leaked replica
+    # keeps the chip claimed and every campaign retry then fails
+    # against it (the other probes' guarded-stage equivalent)
+    try:
+        led.log("warmup (compiles prefill+decode on chip)")
+        t0 = time.perf_counter()
+        stream_session(0)
+        led.emit("warmup",
+                 {"compile_s": round(time.perf_counter() - t0, 1)})
 
-    ttfts, gaps = [], []
-    for i in range(1, 9):
-        ttft, g = stream_session(i)
-        ttfts.append(ttft)
-        gaps.extend(g.tolist())
-    led.emit("serve_stream", {
-        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
-        "stream_ms_per_tok_p50":
-            round(float(np.percentile(gaps, 50)) * 1e3, 2),
-        "stream_tok_s":
-            round(1.0 / max(float(np.mean(gaps)), 1e-9), 1),
-        "sessions": 8, "prompt_len": prompt_len,
-        "new_tokens": new_tokens,
-        "path": "http SSE stream->proxy-driven decode(replica ON CHIP)",
-        "model": "gpt2-small bf16 seq512"})
-    _teardown(serve, ray_tpu)
+        ttfts, gaps = [], []
+        for i in range(1, 9):
+            ttft, g = stream_session(i)
+            ttfts.append(ttft)
+            gaps.extend(g.tolist())
+        led.emit("serve_stream", {
+            "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3,
+                                 2),
+            "stream_ms_per_tok_p50":
+                round(float(np.percentile(gaps, 50)) * 1e3, 2),
+            "stream_tok_s":
+                round(1.0 / max(float(np.mean(gaps)), 1e-9), 1),
+            "sessions": 8, "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "path":
+                "http SSE stream->proxy-driven decode(replica ON CHIP)",
+            "model": "gpt2-small bf16 seq512"})
+    except Exception as exc:
+        led.emit("serve_stream", {"error": repr(exc)[:300]})
+    finally:
+        _teardown(serve, ray_tpu)
     led.emit("done", {"teardown": "graceful"})
 
 
