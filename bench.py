@@ -642,12 +642,14 @@ def _attach_probe_evidence(result: dict) -> dict:
                                    ("env_steps_per_s", "num_envs",
                                     "rollout", "reward", "algo", "env")
                                    if k in rec}
-                elif stage == "gen" and "tag" in rec:
+                elif stage == "gen" and "tag" in rec \
+                        and "error" not in rec:
                     gens[rec["tag"]] = {
                         k: rec[k] for k in
                         ("prompt_len", "prefill_ms",
-                         "decode_ms_per_tok", "decode_tok_s")
-                        if k in rec}
+                         "decode_ms_per_tok", "decode_tok_s",
+                         "batch", "new_tokens", "ms_per_tok",
+                         "agg_tok_s") if k in rec}
                 elif (rec.get("kind") in ("chunked_prefill_ttft",
                                           "decode")
                       and rec.get("synced") and "tag" in rec):

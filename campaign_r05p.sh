@@ -1,0 +1,28 @@
+#!/bin/bash
+# stage P: probe19 (scanned-generation honest decode) then the final
+# validation bench on the count-weighted-accum tree.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok19 () {
+    [ -f TPU_PROBE19_r05.jsonl ] \
+        && grep '"stage": "gen"' TPU_PROBE19_r05.jsonl \
+           | grep -v '"error"' | grep -q scan
+}
+
+tries=0
+while [ $tries -lt 6 ]; do
+    tries=$((tries+1))
+    echo "=== probe19 attempt $tries $(date -u +%H:%M:%S) ===" >> probe19_r05.err
+    python tpu_probe19.py >> probe19_r05.out 2>> probe19_r05.err
+    if ok19; then
+        echo "=== probe19 landed $(date -u +%H:%M:%S) ===" >> probe19_r05.err
+        break
+    fi
+    sleep 240
+done
+
+echo "=== stage P bench $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "stage P bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -1,0 +1,77 @@
+"""Nineteenth staged on-chip probe — scanned one-program generation
+with honest barriers.
+
+probe11's 17.3 ms/token decode is per-dispatch: every token pays a
+relay round trip.  The framework's `generate` (one compiled program:
+prefill + `lax.scan` of decode_step) amortizes the relay over the
+whole generation — this probe measures its per-token cost with the
+scalar-readback barrier (r4's probe4 measured the same path at
+~2.4 ms/step but through the enqueue-returning block_until_ready, so
+that number was the relay floor, not the chip).
+
+Prompts stay SHORT (64-256) so the prefill grid inside the program is
+small — whole-prompt llama GQA flash prefill at >=512 was the r4
+compile killer (chunked prefill is the serving answer; this probe is
+about the scanned DECODE).
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache
+
+OUT = __file__.replace("tpu_probe19.py", "TPU_PROBE19_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.generate import generate
+
+    def gen_stage(tag, cfg, batch, prompt_len, new_tokens):
+        params, _ = init_params(jax.random.PRNGKey(0), cfg)
+        jax.block_until_ready(params)
+        prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, prompt_len), 0,
+                                    cfg.vocab_size)
+        t0 = time.perf_counter()
+        toks = generate(params, prompt, cfg=cfg,
+                        max_new_tokens=new_tokens,
+                        max_len=prompt_len + new_tokens)
+        float(jnp.sum(toks))              # honest completion barrier
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        toks = generate(params, prompt, cfg=cfg,
+                        max_new_tokens=new_tokens,
+                        max_len=prompt_len + new_tokens)
+        float(jnp.sum(toks))
+        warm = time.perf_counter() - t0
+        led.emit("gen", {"tag": tag, "batch": batch,
+                         "prompt_len": prompt_len,
+                         "new_tokens": new_tokens, "synced": True,
+                         "first_s": round(first, 1),
+                         "warm_ms": round(warm * 1e3, 1),
+                         "ms_per_tok": round(warm * 1e3 / new_tokens, 2),
+                         "agg_tok_s":
+                             round(batch * new_tokens / warm, 1)})
+
+    small = TransformerConfig.gpt2("small", max_seq_len=512)
+    led.guarded("gen:gpt2s_b1")(gen_stage)(
+        "gpt2s_b1_scan", small, 1, 256, 64)
+    llama = TransformerConfig.llama(
+        "1b", max_seq_len=256, param_dtype=jnp.bfloat16)
+    led.guarded("gen:llama1b_b1")(gen_stage)(
+        "llama1b_b1_scan", llama, 1, 64, 64)
+    led.guarded("gen:llama1b_b8")(gen_stage)(
+        "llama1b_b8_scan", llama, 8, 64, 64)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
