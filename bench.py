@@ -611,7 +611,7 @@ def _attach_probe_evidence(result: dict) -> dict:
         import glob
         import re
         here = os.path.dirname(os.path.abspath(__file__))
-        best_rl, gens, serve = None, {}, None
+        best_rl, gens, serve, vision = None, {}, None, {}
         paths = glob.glob(os.path.join(here, "TPU_PROBE*_r*.jsonl"))
         # only the NEWEST round's ledgers: a stale prior-round number must
         # not mask a regression by riding into the current headline
@@ -669,6 +669,12 @@ def _attach_probe_evidence(result: dict) -> dict:
                     serve.update({k: rec[k] for k in
                                   ("stream_ms_per_tok_p50",
                                    "stream_tok_s") if k in rec})
+                elif (rec.get("model") == "vit-b16"
+                      and "error" not in rec and "tag" in rec):
+                    vision[rec["tag"]] = {
+                        k: rec[k] for k in
+                        ("mfu", "images_per_s", "batch",
+                         "ms_per_batch") if k in rec}
         detail = result.setdefault("detail", {})
         if best_rl is not None:
             best_rl["backend"] = "tpu"
@@ -677,6 +683,8 @@ def _attach_probe_evidence(result: dict) -> dict:
             detail["gen_tpu"] = gens
         if serve is not None:
             detail["serve_tpu"] = serve
+        if vision:
+            detail["vision_tpu"] = vision
     except Exception:
         pass
     return result

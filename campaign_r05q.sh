@@ -1,0 +1,28 @@
+#!/bin/bash
+# stage Q: probe20 (scanned-generation honest decode) then the final
+# validation bench on the count-weighted-accum tree.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok20 () {
+    [ -f TPU_PROBE20_r05.jsonl ] \
+        && grep '"stage": "mfu"' TPU_PROBE20_r05.jsonl \
+           | grep -v '"error"' | grep -q vit_b
+}
+
+tries=0
+while [ $tries -lt 6 ]; do
+    tries=$((tries+1))
+    echo "=== probe20 attempt $tries $(date -u +%H:%M:%S) ===" >> probe20_r05.err
+    python tpu_probe20.py >> probe20_r05.out 2>> probe20_r05.err
+    if ok20; then
+        echo "=== probe20 landed $(date -u +%H:%M:%S) ===" >> probe20_r05.err
+        break
+    fi
+    sleep 240
+done
+
+echo "=== stage Q bench $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "stage Q bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log

@@ -1,0 +1,101 @@
+"""Twentieth staged on-chip probe — the image-model family (ViT-B/16).
+
+BASELINE config #2 is the image-training class (the reference's
+published rows are ResNet: 40.7→746.3 images/s TRAIN across 1→4 GPU
+nodes, 35.2→533.9 images/s batch-predict,
+/root/reference/doc/source/ray-air/benchmarks.rst:119-174).  The
+framework's vision family is ViT (models/vit.py, ViT-B/16 = 86M);
+this probe puts train MFU + images/s and forward-only batch-predict
+images/s on the board for ONE v5e chip.
+
+MFU accounting: encoder-layer FLOPs via the shared
+flops_per_token(block_cfg, seq=197) x 197 tokens/image (patch/head
+matmuls add ~1%, uncounted — MFU is slightly understated).
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache
+
+OUT = __file__.replace("tpu_probe20.py", "TPU_PROBE20_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bench import _peak_flops, timed_mfu_loop
+    from ray_tpu.models import flops_per_token
+    from ray_tpu.models.vit import (ViTConfig, init_vit_params,
+                                    make_vit_train_step, vit_forward)
+
+    peak = _peak_flops(jax.devices()[0])
+    cfg = ViTConfig.base()                      # ViT-B/16, 224x224
+    flops_img = flops_per_token(cfg.block_cfg(), cfg.seq_len) \
+        * cfg.seq_len
+
+    def train_stage(tag, batch, steps=10):
+        t0 = time.perf_counter()
+        params, _ = init_vit_params(jax.random.PRNGKey(0), cfg)
+        opt = optax.adamw(3e-4, weight_decay=0.1,
+                          mu_dtype=jnp.bfloat16)
+        opt_state = opt.init(params)
+        step = jax.jit(make_vit_train_step(cfg, opt),
+                       donate_argnums=(0, 1))
+        data = {
+            "image": jax.random.normal(
+                jax.random.PRNGKey(1),
+                (batch, cfg.image_size, cfg.image_size, cfg.channels),
+                jnp.bfloat16),
+            "label": jax.random.randint(jax.random.PRNGKey(2),
+                                        (batch,), 0, cfg.num_classes),
+        }
+        for _ in range(2):
+            params, opt_state, m = step(params, opt_state, data)
+        float(m["loss"])
+        compile_s = time.perf_counter() - t0
+        mfu, dt, params, opt_state = timed_mfu_loop(
+            step, params, opt_state, data, steps, batch, flops_img,
+            peak)
+        led.emit("mfu", {"tag": tag, "model": "vit-b16", "batch": batch,
+                         "mfu": round(mfu, 4),
+                         "images_per_s": round(steps * batch / dt, 1),
+                         "step_ms": round(1000 * dt / steps, 1),
+                         "compile_s": round(compile_s, 1)})
+
+    def predict_stage(tag, batch, steps=16):
+        params, _ = init_vit_params(jax.random.PRNGKey(0), cfg)
+        fwd = jax.jit(lambda p, x: vit_forward(p, x, cfg))
+        x = jax.random.normal(
+            jax.random.PRNGKey(1),
+            (batch, cfg.image_size, cfg.image_size, cfg.channels),
+            jnp.bfloat16)
+        out = fwd(params, x)
+        float(jnp.max(out))                    # compile + barrier
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fwd(params, x)
+        float(jnp.max(out))
+        dt = time.perf_counter() - t0
+        led.emit("predict", {"tag": tag, "model": "vit-b16",
+                             "batch": batch,
+                             "images_per_s":
+                                 round(steps * batch / dt, 1),
+                             "ms_per_batch":
+                                 round(1000 * dt / steps, 2)})
+
+    led.guarded("mfu:vit_b64")(train_stage)("vit_b64", 64)
+    led.guarded("mfu:vit_b128")(train_stage)("vit_b128", 128)
+    led.guarded("mfu:vit_b256")(train_stage)("vit_b256", 256)
+    led.guarded("predict:vit_b256")(predict_stage)("vit_pred_b256", 256)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
