@@ -172,3 +172,38 @@ def test_task_with_3k_returns(cluster):
     assert vals == list(range(N))
     print(f"\n[scale] task with {N} returns in "
           f"{time.perf_counter() - t0:.2f}s")
+
+
+def test_tune_many_trials(cluster):
+    """Tune at reference-class trial counts: 64 (FULL: 256) trials of a
+    fast trainable under ASHA through the real TrialRunner + trial
+    actors (the reference's scale story runs thousands of trials;
+    `tune/execution/trial_runner.py` drives them through the same
+    actor machinery exercised here)."""
+    from ray_tpu import tune
+    from ray_tpu.air import session
+
+    N = 256 if FULL else 64
+
+    def trainable(config):
+        for i in range(3):
+            session.report({"score": config["x"] * (i + 1),
+                            "training_iteration": i + 1})
+
+    t0 = time.perf_counter()
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search(list(range(N)))},
+        tune_config=tune.TuneConfig(
+            scheduler=tune.ASHAScheduler(metric="score", mode="max",
+                                         max_t=3, grace_period=1),
+            max_concurrent_trials=16),
+    ).fit()
+    dt = time.perf_counter() - t0
+    assert len(results) == N
+    assert results.get_best_result("score", "max").metrics["score"] \
+        >= (N - 1)
+    errored = [r for r in results if r.error]
+    assert not errored
+    print(f"\n[scale] tune {N} ASHA trials in {dt:.1f}s "
+          f"({N / dt:.1f} trials/s)")
