@@ -34,8 +34,11 @@ def cluster():
     # generous heartbeat: this module measures THROUGHPUT under load
     # bursts that legitimately lag the shared-core event loops for
     # seconds — the default test timeout (2s) false-positives a node
-    # death mid-burst (failure detection has its own tests)
-    c = Cluster(heartbeat_timeout_s=15.0)
+    # death mid-burst (failure detection has its own tests).  60 s:
+    # at the tail of a fully-contended ~70-min whole-suite run the
+    # event loops have been observed lagging past 15 s, which killed
+    # a healthy actor mid-ping (r5 full-suite flake, once)
+    c = Cluster(heartbeat_timeout_s=60.0)
     # multi-GiB store: tmpfs segments are lazily allocated, so the size
     # costs nothing until test_get_past_2gib_single_object writes into it
     for _ in range(2):
