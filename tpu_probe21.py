@@ -1,0 +1,43 @@
+"""Twenty-first staged on-chip probe — MoE train MFU.
+
+The expert-parallel path has virtual-mesh characterization
+(PARALLEL_BENCH: ep=8 all_to_all tax 1.13x) but no on-chip train row.
+Single chip exercises the MoE COMPUTE path — router, top-k dispatch,
+capacity-bounded expert matmuls, Switch aux loss — without the
+cross-device all_to_all.  Grid: gpt2-small-with-E4/top-1 and E8/top-2
+vs the dense small control at the same microbatch; MFU accounting uses
+flops_per_token's active-expert count (top-k experts per token), so
+dense and MoE rows are comparable utilization numbers.
+"""
+
+import time
+
+from probe_common import ProbeLedger, enable_compile_cache, measure_mfu
+
+OUT = __file__.replace("tpu_probe21.py", "TPU_PROBE21_r05.jsonl")
+
+
+def main() -> None:
+    enable_compile_cache()
+    led = ProbeLedger(OUT)
+    if not led.claim_or_abort():
+        return
+    import jax.numpy as jnp
+
+    nr = dict(remat=False, norm_remat=True)
+    bf16 = jnp.bfloat16
+    for tag, kw, batch in (
+            ("small_dense_b8", nr, 8),
+            ("small_moe_e4k1_b8",
+             dict(nr, n_experts=4, expert_top_k=1), 8),
+            ("small_moe_e8k2_b4",
+             dict(nr, n_experts=8, expert_top_k=2), 4),
+    ):
+        led.guarded(f"mfu:{tag}")(measure_mfu)(
+            led, tag, kw, batch, blocks=(1024, 1024), mu_dtype=bf16)
+
+    led.emit("done", {"total_s": round(time.perf_counter() - led.t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
