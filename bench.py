@@ -658,17 +658,20 @@ def _attach_probe_evidence(result: dict) -> dict:
                         ("prompt_len", "chunk", "first_ms",
                          "warm_ttft_ms", "ms_per_tok") if k in rec}
                 elif stage == "serve_ttft" and "error" not in rec:
-                    serve = dict(serve or {})
-                    serve.update({k: rec[k] for k in
-                                  ("p50_ttft_ms", "p90_ttft_ms",
-                                   "decode_ms_per_tok_p50", "path",
-                                   "model", "non_composite")
-                                  if k in rec})
+                    serve = serve or {}
+                    serve.setdefault(rec.get("model", "model"),
+                                     {}).update(
+                        {k: rec[k] for k in
+                         ("p50_ttft_ms", "p90_ttft_ms",
+                          "decode_ms_per_tok_p50", "prompt_len",
+                          "path", "non_composite") if k in rec})
                 elif stage == "serve_stream" and "error" not in rec:
-                    serve = dict(serve or {})
-                    serve.update({k: rec[k] for k in
-                                  ("stream_ms_per_tok_p50",
-                                   "stream_tok_s") if k in rec})
+                    serve = serve or {}
+                    serve.setdefault(rec.get("model", "model"),
+                                     {}).update(
+                        {k: rec[k] for k in
+                         ("stream_ms_per_tok_p50", "stream_tok_s")
+                         if k in rec})
                 elif (rec.get("model") == "vit-b16"
                       and "error" not in rec and "tag" in rec):
                     vision[rec["tag"]] = {

@@ -1,0 +1,28 @@
+#!/bin/bash
+# stage T: probe22 (scanned-generation honest decode) then the final
+# validation bench on the count-weighted-accum tree.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok22 () {
+    [ -f TPU_PROBE22_r05.jsonl ] \
+        && grep '"stage": "serve_ttft"' TPU_PROBE22_r05.jsonl \
+           | grep -v '"error"' | grep -qv ERRNEVER
+}
+
+tries=0
+while [ $tries -lt 6 ]; do
+    tries=$((tries+1))
+    echo "=== probe22 attempt $tries $(date -u +%H:%M:%S) ===" >> probe22_r05.err
+    python tpu_probe22.py >> probe22_r05.out 2>> probe22_r05.err
+    if ok22; then
+        echo "=== probe22 landed $(date -u +%H:%M:%S) ===" >> probe22_r05.err
+        break
+    fi
+    sleep 240
+done
+
+echo "=== stage T bench $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "stage T bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log

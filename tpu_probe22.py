@@ -1,0 +1,152 @@
+"""Twenty-second staged on-chip probe — the FLAGSHIP model through the
+full serving path (BASELINE config #5 is "Serve: Llama inference"):
+llama-1b, bf16 params, CHUNKED prefill (the bounded-compile answer to
+the r4 compile killer) hosted in a Serve replica ON the chip, measured
+proxy → router → replica in one request path.  The round-4 number
+was composite (CPU-harness path overhead + separately-measured TPU
+prefill) because a Serve worker killed while holding the tunnelled
+grant wedged it; round 5 made worker exit graceful for
+accelerator-holding processes (worker_runtime.request_exit: SIGTERM /
+exit-RPC run interpreter teardown so the axon client releases the
+grant) and raised the nodelet SIGKILL escalation grace — this probe
+exercises exactly that teardown.
+
+Claim discipline: the REPLICA worker is the one chip claimant (the
+driver/cluster processes never initialize a jax backend); the campaign
+flock serializes the probe against other claimants.  Ledger rows:
+  env        — replica-reported backend/device (not a driver claim)
+  serve_ttft — p50/p90 TTFT ms + decode ms/tok through the full path
+"""
+
+import json
+import os
+import time
+
+# the nodelet must give TPU-holding workers time to exit gracefully
+os.environ.setdefault("RAY_TPU_WORKER_SHUTDOWN_GRACE_S", "30")
+# driver-side safety: the probe main process must never claim the chip,
+# so keep its own jax (if anything imports it) off the TPU.  Worker
+# processes get a clean env via worker_env below.
+os.environ.setdefault("RAY_TPU_TPU_AUTODETECT", "0")
+
+from probe_common import ProbeLedger  # noqa: E402
+
+OUT = __file__.replace("tpu_probe22.py", "TPU_PROBE22_r05.jsonl")
+
+
+def main() -> None:
+    led = ProbeLedger(OUT)
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+
+    @serve.deployment(max_concurrent_queries=4)
+    class Generator:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models import TransformerConfig
+            from ray_tpu.serve.decode_session import DecodeSessionCore
+            self.backend = jax.default_backend()
+            self.device = getattr(jax.devices()[0], "device_kind", "?")
+            dtype = jnp.bfloat16 if self.backend == "tpu" else jnp.float32
+            self.core = DecodeSessionCore(
+                TransformerConfig.llama(
+                    "1b", max_seq_len=1280,
+                    param_dtype=jnp.bfloat16, dtype=dtype),
+                max_len=1280, prefill_chunk=256, max_sessions=4)
+
+        def __call__(self, req):
+            if req.get("op") == "env":
+                return {"backend": self.backend, "device": self.device}
+            return self.core.handle(req)
+
+    import requests
+    serve.run(Generator.bind(), name="generate")
+    addr = serve.api.http_address()
+    http = requests.Session()
+
+    # the replica, not the driver, claims the chip: ask it what it got.
+    # llama-1b replica __init__ takes minutes (param init + first
+    # compiles); until it is ready the proxy answers with a non-JSON
+    # error body — poll instead of trusting the first reply.
+    env = None
+    deadline = time.time() + 900
+    while time.time() < deadline:
+        try:
+            r = http.post(f"{addr}/generate", json={"op": "env"},
+                          timeout=600)
+            if r.status_code == 200:
+                env = r.json()
+                break
+        except Exception:
+            pass
+        time.sleep(5.0)
+    if env is None:
+        led.emit("abort", {"reason": "replica never became ready"})
+        _teardown(serve, ray_tpu)
+        return
+    led.emit("env", env)
+    if env.get("backend") != "tpu":
+        led.emit("abort", {"reason": f"replica backend={env.get('backend')}"})
+        _teardown(serve, ray_tpu)
+        return
+
+    prompt_len, decode_steps = 1024, 8
+
+    def session(i: int):
+        prompt = [(11 * i + j) % 250 for j in range(prompt_len)]
+        t0 = time.perf_counter()
+        r = http.post(f"{addr}/generate",
+                      json={"op": "start", "prompt": prompt}, timeout=900)
+        ttft = time.perf_counter() - t0
+        r.raise_for_status()
+        sid = r.json()["sid"]
+        per_tok = []
+        for _ in range(decode_steps):
+            t0 = time.perf_counter()
+            http.post(f"{addr}/generate", json={"op": "next", "sid": sid},
+                      timeout=120).raise_for_status()
+            per_tok.append(time.perf_counter() - t0)
+        http.post(f"{addr}/generate", json={"op": "end", "sid": sid},
+                  timeout=120)
+        return ttft, per_tok
+
+    led.log("warmup (compiles prefill+decode on chip)")
+    t0 = time.perf_counter()
+    session(0)
+    led.emit("warmup", {"compile_s": round(time.perf_counter() - t0, 1)})
+
+    ttfts, decodes = [], []
+    for i in range(1, 13):
+        ttft, per_tok = session(i)
+        ttfts.append(ttft)
+        decodes.extend(per_tok)
+    import numpy as np
+    led.emit("serve_ttft", {
+        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "p90_ttft_ms": round(float(np.percentile(ttfts, 90)) * 1e3, 2),
+        "decode_ms_per_tok_p50":
+            round(float(np.percentile(decodes, 50)) * 1e3, 2),
+        "sessions": 12, "prompt_len": prompt_len,
+        "decode_steps": decode_steps,
+        "path": "http_proxy->router->replica(llama-1b ON CHIP)",
+        "model": "llama-1b bf16 prefill_chunk=256",
+        "non_composite": True})
+    _teardown(serve, ray_tpu)
+    led.emit("done", {"teardown": "graceful"})
+
+
+def _teardown(serve, ray_tpu) -> None:
+    # graceful, ordered: drain → serve shutdown (exit RPC → replica runs
+    # interpreter teardown, releasing the grant) → cluster shutdown
+    serve.shutdown()
+    time.sleep(5.0)    # let the replica's python exit fully
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
