@@ -1,0 +1,28 @@
+#!/bin/bash
+# stage V: probe21 (scanned-generation honest decode) then the final
+# validation bench on the count-weighted-accum tree.
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+
+ok21b () {
+    [ -f TPU_PROBE21_r05.jsonl ] \
+        && grep '"stage": "mfu"' TPU_PROBE21_r05.jsonl \
+           | grep -v '"error"' | grep -qv ERRNEVER
+}
+
+tries=0
+while [ $tries -lt 6 ]; do
+    tries=$((tries+1))
+    echo "=== probe21 attempt $tries $(date -u +%H:%M:%S) ===" >> probe21_r05.err
+    python tpu_probe21.py >> probe21_r05.out 2>> probe21_r05.err
+    if ok21b; then
+        echo "=== probe21 landed $(date -u +%H:%M:%S) ===" >> probe21_r05.err
+        break
+    fi
+    sleep 240
+done
+
+echo "=== stage V bench $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "stage V bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log
