@@ -1,0 +1,9 @@
+#!/bin/bash
+# stage W: final live validation bench (medium headline + 3 scaling rows
+# incl. llama-1b).
+cd /root/repo
+exec 9>/tmp/tpu_campaign.lock
+flock 9
+echo "=== stage W bench $(date -u +%H:%M:%S) ===" >> campaign_r05.log
+python bench.py > BENCH_live_r05_interim.json 2>> campaign_r05.log
+echo "stage W bench rc=$? $(date -u +%H:%M:%S)" >> campaign_r05.log
