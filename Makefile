@@ -2,7 +2,7 @@
 # tree): native object store + transfer plane, C++ driver API, wheel.
 PY ?= python
 
-.PHONY: all native cpp wheel test bench clean
+.PHONY: all native cpp wheel test bench obs clean
 
 all: native cpp
 
@@ -21,6 +21,12 @@ wheel: native
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# Observability suite: timeline/span propagation, runtime-metrics
+# battery, structured events (all tier-1 — no `slow` markers).
+obs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_observability.py \
+		tests/test_runtime_metrics.py tests/test_events.py -q
 
 bench:
 	$(PY) bench.py

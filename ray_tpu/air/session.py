@@ -29,10 +29,23 @@ class _Session:
         self.stop_event = threading.Event()
         self.last_checkpoint: Optional[Checkpoint] = None
         self.iteration = 0
+        self._last_report_t: Optional[float] = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
+        import time as _time
+
+        from ..util import tracing
         self.iteration += 1
+        # per-step span: report() marks step boundaries, so each span
+        # covers one train step on this worker's timeline lane
+        now = _time.time()
+        if self._last_report_t is not None:
+            tracing.record_span(f"train_step::{self.trial_name}", "train",
+                                self._last_report_t, now,
+                                iteration=self.iteration,
+                                rank=self.world_rank)
+        self._last_report_t = now
         if checkpoint is not None:
             self.last_checkpoint = checkpoint
         self.queue.put({"metrics": dict(metrics), "checkpoint": checkpoint,

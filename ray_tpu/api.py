@@ -695,8 +695,11 @@ def available_resources() -> Dict[str, float]:
 
 
 def timeline() -> List[dict]:
-    """Chrome-trace events, cluster-wide: driver-local spans + per-node
+    """Chrome-trace events, cluster-wide: driver-local profile spans +
+    every process's task-lifecycle spans (submit → schedule → dequeue →
+    fetch → exec → put, merged from the controller KV) + per-node
     finished-task spans (reference: ray.timeline / chrome_tracing_dump,
-    _private/state.py:414)."""
+    _private/state.py:414).  ``state.timeline()`` returns the same
+    spans wrapped as a ready-to-save Chrome-trace dict."""
     from .util import tracing
     return tracing.cluster_trace_events()

@@ -210,6 +210,14 @@ _d("train_default_checkpoint_keep", int, 2, "Checkpoints retained by CheckpointM
 # --- observability ----------------------------------------------------------
 _d("task_spans_buffer_size", int, 5000,
    "Finished-task spans retained per nodelet for the cluster timeline.")
+_d("trace_enabled", bool, True,
+   "Record distributed task-lifecycle spans (submit/schedule/dequeue/"
+   "fetch/exec/put) for the cluster timeline.")
+_d("trace_buffer_size", int, 4096,
+   "Chrome-trace lifecycle spans buffered per process (overwrite-flushed "
+   "to the controller KV, so this also bounds the KV copy).")
+_d("trace_flush_interval_s", float, 0.25,
+   "Period of each process's span flush to the controller KV.")
 _d("events_buffer_size", int, 1000,
    "Structured cluster events retained by the controller.")
 _d("pubsub_coalesce_s", float, 0.01,

@@ -224,7 +224,22 @@ class Controller:
         await self.server.start()
         self._tasks.append(asyncio.ensure_future(self._health_check_loop()))
         self._tasks.append(asyncio.ensure_future(self._actor_scheduler_loop()))
+        from ..util import tracing
+        tracing.configure("controller")
+        tracing.claim_flusher()
+        self._tasks.append(asyncio.ensure_future(self._trace_flush_loop()))
         return self
+
+    async def _trace_flush_loop(self):
+        """The controller flushes its own lifecycle spans straight into
+        its KV — same namespace every other process flushes to over RPC."""
+        from ..util import tracing
+        while True:
+            await asyncio.sleep(GlobalConfig.trace_flush_interval_s)
+            payload = tracing.kv_payload()
+            if payload is not None:
+                self.kv.setdefault(tracing.TRACE_KV_NS, {})[
+                    tracing.kv_key()] = payload
 
     async def stop(self):
         for t in self._tasks:
@@ -503,6 +518,7 @@ class Controller:
         if rec is None or not rec.view.alive:
             return
         actor.node_id = node_id
+        t_place = time.time()
         try:
             result = await rec.conn.call("start_actor", {"spec": actor.spec},
                                          timeout=120)
@@ -518,6 +534,15 @@ class Controller:
                 self._pending_actor_wakeup.set()
             else:
                 await self._on_actor_failure(actor, result.get("error", "creation failed"))
+        else:
+            # actor placement span: controller pick -> worker dedicated
+            # (the central-scheduling leg tasks never take)
+            from ..util import tracing
+            tracing.record_span(
+                f"schedule_actor::{spec.function_name}", "sched",
+                t_place, time.time(),
+                task_id=spec.task_id.hex(), trace=spec.trace_id,
+                actor_id=actor.actor_id.hex(), node_id=node_id[:12])
 
     async def _h_actor_alive(self, conn, data):
         """Called by the actor's worker process once the instance exists."""

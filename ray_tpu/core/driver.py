@@ -23,7 +23,7 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import exceptions
-from . import rpc, serialization, spill
+from . import rpc, runtime_metrics as rtm, serialization, spill
 from .config import GlobalConfig
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .memory_store import IN_PLASMA, MemoryStore
@@ -249,9 +249,56 @@ class CoreClient(DeferredRefDecs):
         self._spurious_requeues: Dict[bytes, int] = {}
         self.lt.spawn(self._deferred_dec_loop())
         if mode == "driver":
+            # lifecycle-span identity + KV flush (worker processes flush
+            # through their WorkerRuntime instead — claim_flusher dedupes)
+            from ..util import tracing
+            tracing.configure("driver", self.node_id)
+            self.lt.spawn(self._trace_flush_loop())
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
                                   "driver": f"pid-{os.getpid()}"})
+
+    # -------------------------------------------------------------- tracing
+    async def _trace_flush_loop(self):
+        """Rewrite this process's span buffer into the controller KV when
+        dirty (overwrite semantics; see util/tracing.py)."""
+        from ..util import tracing
+        if not tracing.claim_flusher():
+            return
+        while not self._closed:
+            await asyncio.sleep(GlobalConfig.trace_flush_interval_s)
+            payload = tracing.kv_payload()
+            if payload is None:
+                continue
+            try:
+                await self.controller.conn.notify("kv_put", {
+                    "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
+                    "value": payload, "persist": False})
+            except Exception:
+                tracing.mark_dirty()  # retry next tick
+
+    def _stamp_submit(self, spec: TaskSpec) -> None:
+        """Submit-time span + wall-clock stamp: downstream hops (driver
+        dispatch, serve replicas) derive queue-wait from ``t_submit``."""
+        from ..util import tracing
+        now = time.time()
+        spec.d["t_submit"] = now
+        tracing.record_span(f"submit::{spec.function_name}", "driver",
+                            now, now, task_id=spec.task_id.hex(),
+                            trace=spec.trace_id)
+
+    def _note_dispatch(self, spec: TaskSpec) -> None:
+        """The task leaves the driver for a worker: dequeue span +
+        queue-wait histogram (submit -> dispatch)."""
+        from ..util import tracing
+        t_sub = spec.submit_time
+        if t_sub is None:
+            return
+        now = time.time()
+        rtm.QUEUE_WAIT.observe(now - t_sub, tags={"node": self.node_id[:12]})
+        tracing.record_span(f"dequeue::{spec.function_name}", "sched",
+                            t_sub, now, task_id=spec.task_id.hex(),
+                            trace=spec.trace_id)
 
     # ------------------------------------------------------------- refcounts
     async def _deferred_dec_loop(self):
@@ -716,6 +763,7 @@ class CoreClient(DeferredRefDecs):
                     temp_refs: Optional[List["ObjectRef"]] = None
                     ) -> List[ObjectRef]:
         self._stamp_trace_ctx(spec)
+        self._stamp_submit(spec)
         with self._ref_lock:
             for oid in spec.return_ids():
                 self._owned.add(oid.binary())
@@ -974,6 +1022,7 @@ class CoreClient(DeferredRefDecs):
                         continue
                     state.busy += 1
                     self._task_sites[tid] = conn
+                    self._note_dispatch(spec)
                     # The queue may still hold tasks that must run
                     # CONCURRENTLY with this one; with this loop now busy,
                     # grow the pool.
@@ -1201,6 +1250,7 @@ class CoreClient(DeferredRefDecs):
                           temp_refs: Optional[List["ObjectRef"]] = None
                           ) -> List[ObjectRef]:
         self._stamp_trace_ctx(spec)
+        self._stamp_submit(spec)
         with self._ref_lock:
             for oid in spec.return_ids():
                 self._owned.add(oid.binary())
@@ -1234,6 +1284,7 @@ class CoreClient(DeferredRefDecs):
                     return
                 spec.d["seq"] = state.seq
                 state.seq += 1
+            self._note_dispatch(spec)
             try:
                 reply = await conn.call("push_actor_task",
                                         {"spec": spec.to_wire()}, timeout=None)

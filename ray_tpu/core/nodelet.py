@@ -185,6 +185,9 @@ class Nodelet:
         self._lag_ewma = 0.0
         self._lag_max = 0.0
         self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
+        from ..util import tracing
+        tracing.configure("nodelet", self.node_id.hex())
+        self._tasks.append(asyncio.ensure_future(self._trace_flush_loop()))
         self._agent_proc = None
         if GlobalConfig.dashboard_agent:
             # per-node dashboard agent (reference: raylet spawning
@@ -335,6 +338,24 @@ class Nodelet:
             except (rpc.RpcError, OSError):
                 pass
             await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
+
+    async def _trace_flush_loop(self):
+        """Flush this nodelet's lifecycle spans to the controller KV
+        (overwrite semantics; see util/tracing.py)."""
+        from ..util import tracing
+        if not tracing.claim_flusher():
+            return
+        while True:
+            await asyncio.sleep(GlobalConfig.trace_flush_interval_s)
+            payload = tracing.kv_payload()
+            if payload is None:
+                continue
+            try:
+                await self.controller.notify("kv_put", {
+                    "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
+                    "value": payload, "persist": False})
+            except Exception:
+                tracing.mark_dirty()  # controller reconnecting: retry
 
     async def _reap_loop(self):
         """Detect dead worker processes (the reference raylet gets
@@ -722,8 +743,21 @@ class Nodelet:
         self._demand_seq += 1
         tok = self._demand_seq
         self._demand_tokens[tok] = request.to_dict()
+        t_req = time.time()
         try:
-            return await self._lease_inner(spec, request, strategy, deadline, my_id)
+            reply = await self._lease_inner(spec, request, strategy,
+                                            deadline, my_id)
+            if reply.get("granted"):
+                # scheduling latency: lease request arrival -> worker
+                # grant, attributed to the task whose spec rode the
+                # request (spillbacks/timeouts are not grants)
+                from ..util import tracing
+                now = time.time()
+                rtm.SCHED_LATENCY.observe(now - t_req, tags=self._mnode)
+                tracing.record_span(
+                    f"schedule::{spec.function_name}", "sched", t_req, now,
+                    task_id=spec.task_id.hex(), trace=spec.trace_id)
+            return reply
         finally:
             self._lease_waiters -= 1
             self._demand_tokens.pop(tok, None)
@@ -1206,6 +1240,12 @@ class Nodelet:
             name = data.get("name", "?")
             self._task_counts[name] = self._task_counts.get(name, 0) + 1
             rtm.TASKS_FINISHED.inc(tags=self._mnode)
+            # latency breakdown: workers measure fetch/exec/put per task
+            # and ship the durations on the finish event (their own
+            # registries are never scraped — this nodelet's is)
+            durs = data.get("durs")
+            if durs:
+                rtm.observe_task_durs(durs, self._mnode["node"])
             # bounded span log for the cluster timeline (reference: per-task
             # profile events -> GCS -> ray.timeline chrome dump,
             # core_worker/profiling.cc + _private/state.py:414)

@@ -70,7 +70,17 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(_ensure_built(), use_errno=True)
+    try:
+        lib = ctypes.CDLL(_ensure_built(), use_errno=True)
+    except OSError:
+        # A stale prebuilt .so linked against a different glibc (the
+        # repo may have been seeded from another image) fails dlopen;
+        # force one rebuild from the in-tree sources and retry.
+        try:
+            os.unlink(_LIB)
+        except OSError:
+            pass
+        lib = ctypes.CDLL(_ensure_built(), use_errno=True)
     lib.rts_create_segment.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.rts_create_segment.restype = ctypes.c_int
     lib.rts_open.argtypes = [ctypes.c_char_p]

@@ -70,6 +70,45 @@ PUBSUB_MESSAGES = m.Counter(
     "ray_tpu_pubsub_messages_total",
     "Messages published on controller channels", ("channel",))
 
+# -------------------------------------------------- latency histograms
+# Per-phase breakdown of a task's life, derived from the same lifecycle
+# spans the cluster timeline draws (reference: the scheduler/transport
+# latency battery of metric_defs.cc).  Scheduling + queue wait land in
+# the nodelet/driver registries directly; fetch/exec/put are observed
+# worker-side and reported to the nodelet on the finish event (worker
+# registries are not scraped).
+
+_LAT_BOUNDS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+               1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+SCHED_LATENCY = m.Histogram(
+    "ray_tpu_task_scheduling_latency_seconds",
+    "Lease request arrival to worker grant", _LAT_BOUNDS, ("node",))
+QUEUE_WAIT = m.Histogram(
+    "ray_tpu_task_queue_wait_seconds",
+    "Task submit to dispatch at a leased worker", _LAT_BOUNDS, ("node",))
+ARG_FETCH = m.Histogram(
+    "ray_tpu_task_arg_fetch_seconds",
+    "Argument resolution/object-store fetch time", _LAT_BOUNDS, ("node",))
+EXEC_TIME = m.Histogram(
+    "ray_tpu_task_exec_seconds",
+    "User-code execution time", _LAT_BOUNDS, ("node",))
+RESULT_PUT = m.Histogram(
+    "ray_tpu_task_result_put_seconds",
+    "Result serialization/store time", _LAT_BOUNDS, ("node",))
+
+
+def observe_task_durs(durs: dict, node: str) -> None:
+    """Feed one finished task's worker-reported phase durations into the
+    breakdown histograms (nodelet-side, at finish-event apply time)."""
+    tags = {"node": node}
+    for key, hist in (("fetch", ARG_FETCH), ("exec", EXEC_TIME),
+                      ("put", RESULT_PUT)):
+        v = durs.get(key)
+        if v is not None:
+            hist.observe(float(v), tags)
+
+
 # ------------------------------------------------------------------ gauges
 
 WORKER_POOL = m.Gauge(

@@ -11,6 +11,7 @@ repeatedly acquiring/returning fractional resources).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
@@ -124,6 +125,11 @@ class TaskSpec:
             "cgroups": concurrency_groups or {},
             "cgroup": concurrency_group,
             "lang": lang,
+            # trace id minted at .remote() call time; every lifecycle
+            # span this task produces — on any process — carries it, so
+            # the cluster timeline can follow one task end to end
+            # (reference: task profile events keyed by task id).
+            "trace": os.urandom(8).hex(),
         })
 
     # -- accessors -----------------------------------------------------------
@@ -222,6 +228,15 @@ class TaskSpec:
     @property
     def concurrency_group(self) -> Optional[str]:
         return self.d.get("cgroup")
+
+    @property
+    def trace_id(self) -> str:
+        return self.d.get("trace") or ""
+
+    @property
+    def submit_time(self) -> Optional[float]:
+        """Wall-clock submit stamp (set by the driver at submit_task)."""
+        return self.d.get("t_submit")
 
     @property
     def runtime_env(self) -> Dict[str, Any]:

@@ -129,6 +129,9 @@ class WorkerRuntime:
         self.nodelet.on_close = (
             lambda conn: None if self._dying else os._exit(1))
         asyncio.ensure_future(self._task_state_flusher())
+        from ..util import tracing
+        tracing.configure("worker", self.node_id)
+        asyncio.ensure_future(self._trace_flush_loop())
         return self
 
     # ------------------------------------------------- task-state batching
@@ -153,6 +156,25 @@ class WorkerRuntime:
                     {"worker_id": self.worker_id, "events": buf})
             except Exception:
                 pass  # observability only; never kill the worker for it
+
+    async def _trace_flush_loop(self):
+        """Flush this worker's lifecycle spans to the controller KV
+        (overwrite semantics; see util/tracing.py).  This worker's lazy
+        CoreClient defers to us via claim_flusher."""
+        from ..util import tracing
+        if not tracing.claim_flusher():
+            return
+        while not self._dying:
+            await asyncio.sleep(GlobalConfig.trace_flush_interval_s)
+            payload = tracing.kv_payload()
+            if payload is None:
+                continue
+            try:
+                await self.controller.notify("kv_put", {
+                    "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
+                    "value": payload, "persist": False})
+            except Exception:
+                tracing.mark_dirty()
 
     async def run_forever(self):
         await self._shutdown.wait()
@@ -436,22 +458,35 @@ class WorkerRuntime:
             for item in values])
         return ObjectRefGenerator(list(refs))
 
-    async def _execute(self, spec: TaskSpec, fn) -> dict:
+    async def _execute(self, spec: TaskSpec, fn,
+                       durs: Optional[Dict[str, float]] = None) -> dict:
         # NB: store pins taken while resolving reference args are *not*
         # released after execution — deserialization is zero-copy, so user
         # code (e.g. an actor stashing an argument array) may alias store
         # memory indefinitely.  Pins are deduped per object and dropped only
         # when the worker exits (reference plasma has the same client-side
         # pin-while-mapped semantics).
+        from ..util import tracing
+        tr = {"task_id": spec.task_id.hex(), "trace": spec.trace_id}
+        fname = spec.function_name
         try:
+            t0 = time.time()
             args, kwargs, _views = await self._resolve_args(spec)
+            t1 = time.time()
+            tracing.record_span(f"fetch::{fname}", "fetch", t0, t1, **tr)
             dynamic = spec.num_returns == DYNAMIC_RETURNS
             if dynamic:
                 fn = self._dynamic_wrapper(fn, spec.function_name)
             result = await self._run_target(spec, fn, args, kwargs)
+            t2 = time.time()
+            tracing.record_span(f"exec::{fname}", "exec", t1, t2, **tr)
             if dynamic:
                 result = await self._materialize_dynamic(spec, result)
             returns = await self._store_returns(spec, result)
+            t3 = time.time()
+            tracing.record_span(f"put::{fname}", "put", t2, t3, **tr)
+            if durs is not None:
+                durs.update(fetch=t1 - t0, exec=t2 - t1, put=t3 - t2)
             # Borrow barrier: refs deserialized during this task registered
             # borrows via fire-and-forget notifies on the worker-core's own
             # controller connection; the caller drops its argument pins the
@@ -505,6 +540,7 @@ class WorkerRuntime:
                                  "name": spec.function_name,
                                  "task_id": spec.task_id.binary(),
                                  "t": time.time()})
+        durs: Dict[str, float] = {}
         try:
             tp = spec.d.get("otel")
             if tp:
@@ -513,11 +549,12 @@ class WorkerRuntime:
                 # unless this worker registered a tracer provider
                 from ..util import otel
                 with otel.execute_span(spec.function_name, tp):
-                    return await self._execute(spec, fn)
-            return await self._execute(spec, fn)
+                    return await self._execute(spec, fn, durs)
+            return await self._execute(spec, fn, durs)
         finally:
             self._report_task_state({"event": "finish",
                                      "name": spec.function_name,
+                                     "durs": durs,
                                      "t": time.time()})
 
     async def _h_create_actor(self, conn, data):
@@ -596,18 +633,20 @@ class WorkerRuntime:
                 "name": f"{type(self.actor_instance).__name__}."
                         f"{spec.function_name}",
                 "task_id": spec.task_id.binary(), "t": time.time()})
+            durs: Dict[str, float] = {}
             try:
                 tp = spec.d.get("otel")
                 if tp:
                     from ..util import otel
                     with otel.execute_span(spec.function_name, tp):
-                        return await self._execute(spec, method)
-                return await self._execute(spec, method)
+                        return await self._execute(spec, method, durs)
+                return await self._execute(spec, method, durs)
             finally:
                 self._report_task_state({
                     "event": "finish",
                     "name": f"{type(self.actor_instance).__name__}."
-                            f"{spec.function_name}", "t": time.time()})
+                            f"{spec.function_name}", "durs": durs,
+                    "t": time.time()})
         finally:
             if state["next"] <= seq:
                 state["next"] = seq + 1

@@ -14,6 +14,33 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _lock = threading.Lock()
 
 
+_healthy_cache: set = set()
+
+
+def _artifact_healthy(path: str) -> bool:
+    """A fresh-by-mtime artifact can still be unusable: a prebuilt
+    binary seeded from another image fails in the dynamic loader
+    (GLIBC version mismatch) before main.  Probe cheaply — dlopen for
+    shared libs, ``--selftest`` (prints and exits pre-connect) for the
+    worker executable — and rebuild on failure.  Probed once per
+    process (ensure_worker_built runs on every cpp worker spawn)."""
+    if path in _healthy_cache:
+        return True
+    try:
+        if path.endswith(".so"):
+            import ctypes
+            ctypes.CDLL(path)
+            _healthy_cache.add(path)
+            return True
+        r = subprocess.run([path, "--selftest"], capture_output=True,
+                           timeout=10)
+        if r.returncode == 0:
+            _healthy_cache.add(path)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
 def _build(output: str, srcs: list, extra: list) -> str:
     out_path = os.path.join(_DIR, output)
     src_paths = [os.path.join(_DIR, s) for s in srcs]
@@ -23,10 +50,15 @@ def _build(output: str, srcs: list, extra: list) -> str:
     with _lock:
         newest = max(os.path.getmtime(p) for p in src_paths + hdrs)
         if not os.path.exists(out_path) \
-                or os.path.getmtime(out_path) < newest:
+                or os.path.getmtime(out_path) < newest \
+                or not _artifact_healthy(out_path):
             tmp = out_path + f".tmp.{os.getpid()}"
+            # libraries (-ldl) must follow the sources: this image's ld
+            # defaults to --as-needed and drops libs named before any
+            # object that references them
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", *extra, "-o", tmp, *src_paths],
+                ["g++", "-O2", "-std=c++17", *extra, "-o", tmp,
+                 *src_paths, "-ldl"],
                 check=True, capture_output=True, cwd=_DIR)
             os.replace(tmp, out_path)
     return out_path
@@ -34,7 +66,7 @@ def _build(output: str, srcs: list, extra: list) -> str:
 
 def ensure_worker_built() -> str:
     """The native worker binary the nodelet execs for lang="cpp" leases."""
-    return _build("ray_tpu_cpp_worker", ["worker_main.cc"], ["-ldl"])
+    return _build("ray_tpu_cpp_worker", ["worker_main.cc"], [])
 
 
 def ensure_example_lib_built() -> str:

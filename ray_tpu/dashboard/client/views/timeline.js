@@ -1,37 +1,44 @@
 import { api, esc } from "/static/api.js";
 export const title = "timeline";
 export function render(root) {
-  root.innerHTML = `<h2>task timeline (finished spans)</h2>
+  root.innerHTML = `<h2>cluster task timeline (lifecycle spans:
+    submit &rarr; schedule &rarr; dequeue &rarr; fetch &rarr; exec
+    &rarr; put; newest window)</h2>
     <svg id="tl" height="10"></svg><div id="info"></div>`;
 }
 export async function refresh(root) {
-  // Chrome-trace "X" events: ts/dur in microseconds, tid = worker lane
-  const spans = (await api.timeline()).filter(s => s.ph === "X");
+  // Chrome-trace "X" events: ts/dur in microseconds, pid/tid = process lane
+  const all = (await api.timeline()).filter(s => s.ph === "X");
   const svg = root.querySelector("#tl");
-  if (!spans.length) {
+  if (!all.length) {
     root.querySelector("#info").textContent = "(no spans)";
     return;
   }
-  const lanes = [...new Set(spans.map(s => s.tid))];
-  const t0 = Math.min(...spans.map(s => s.ts));
-  const t1 = Math.max(...spans.map(s => s.ts + s.dur));
+  // window-filter FIRST (newest 60s): driver-local profile spans ride a
+  // different clock and would otherwise stretch the window to nonsense
+  const t1 = Math.max(...all.map(s => s.ts + s.dur));
+  const t0 = Math.max(Math.min(...all.map(s => s.ts)), t1 - 60e6);
+  const spans = all.filter(s => s.ts + s.dur >= t0);
+  const lanes = [...new Set(spans.map(s => `${s.pid}/${s.tid}`))].sort();
   const W = svg.clientWidth || 900, H = lanes.length * 18 + 6;
   svg.setAttribute("height", H);
-  const x = t => 130 + (W - 140) * (t - t0) / Math.max(t1 - t0, 1e-9);
+  const x = t =>
+    130 + (W - 140) * (Math.max(t, t0) - t0) / Math.max(t1 - t0, 1e-9);
   svg.innerHTML =
     lanes.map((l, i) =>
       `<text class="lane-label" x="2" y="${i * 18 + 14}">` +
       `${String(l).slice(0, 18)}</text>`).join("") +
     spans.map(s => {
-      const i = lanes.indexOf(s.tid);
+      const i = lanes.indexOf(`${s.pid}/${s.tid}`);
       const cls = (s.args && s.args.interrupted)
         ? "span-rect interrupted" : "span-rect";
       return `<rect class="${cls}" x="${x(s.ts)}" y="${i * 18 + 4}"
         width="${Math.max(x(s.ts + s.dur) - x(s.ts), 1)}" height="12">
-        <title>${esc(s.name || "")} ${(s.dur / 1e3).toFixed(1)}ms</title>
+        <title>[${esc(s.cat || "task")}] ${esc(s.name || "")}
+        ${(s.dur / 1e3).toFixed(1)}ms</title>
         </rect>`;
     }).join("");
   root.querySelector("#info").textContent =
     `${spans.length} spans over ${((t1 - t0) / 1e6).toFixed(2)}s on ` +
-    `${lanes.length} workers`;
+    `${lanes.length} lanes`;
 }

@@ -74,7 +74,10 @@ INDEX_HTML = """<!doctype html>
 </div>
 
 <div id="timeline" class="view">
-  <h2>task timeline (finished spans, newest window)</h2>
+  <h2>cluster task timeline (lifecycle spans: submit &rarr; schedule
+      &rarr; dequeue &rarr; fetch &rarr; exec &rarr; put; newest window
+      &mdash; <code>ray-tpu timeline</code> dumps the full Perfetto
+      trace)</h2>
   <svg id="tl" height="10"></svg>
   <div id="tlinfo"></div>
 </div>
@@ -201,8 +204,8 @@ async function refreshTimeline() {
     const cls = (e.args && e.args.interrupted) ?
       "span-rect interrupted" : "span-rect";
     body += `<rect class="${cls}" x="${x}" y="${y}" width="${w}"` +
-            ` height="${H - 5}"><title>${esc(e.name)} ` +
-            `${(e.dur / 1000).toFixed(1)}ms</title></rect>`;
+            ` height="${H - 5}"><title>[${esc(e.cat || "task")}] ` +
+            `${esc(e.name)} ${(e.dur / 1000).toFixed(1)}ms</title></rect>`;
   }
   lanes.forEach((l, i) => {
     body += `<text class="lane-label" x="2" y="${i * H + 12}">` +

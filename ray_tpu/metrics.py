@@ -104,9 +104,10 @@ def prometheus_text() -> str:
                 for b, c in zip(m.boundaries + [float("inf")], counts):
                     cum += c
                     le = "+Inf" if b == float("inf") else repr(b)
+                    le_attr = 'le="%s"' % le
                     out.append(
                         f"{m.name}_bucket"
-                        f"{_fmt_tags(m.tag_keys, k, f'le=\"{le}\"')} {cum}")
+                        f"{_fmt_tags(m.tag_keys, k, le_attr)} {cum}")
                 out.append(f"{m.name}_sum{_fmt_tags(m.tag_keys, k)} "
                            f"{m._sums.get(k, 0.0)}")
                 out.append(f"{m.name}_count{_fmt_tags(m.tag_keys, k)} "

@@ -251,13 +251,18 @@ def cmd_taillog(args) -> None:
 
 
 def cmd_timeline(args) -> None:
+    """Dump the cluster-wide task timeline (lifecycle spans from every
+    process, merged via the controller KV) as Chrome-trace JSON."""
     import ray_tpu
+    from ray_tpu import state
     _connect(args)
-    events = ray_tpu.timeline()
+    dump = state.timeline()
     path = args.output or "timeline.json"
     with open(path, "w") as f:
-        json.dump(events, f)
-    print(f"{len(events)} events -> {path}")
+        json.dump(dump, f)
+    spans = [e for e in dump["traceEvents"] if e.get("ph") == "X"]
+    print(f"{len(spans)} spans -> {path} "
+          f"(open in https://ui.perfetto.dev or chrome://tracing)")
     ray_tpu.shutdown()
 
 
@@ -357,7 +362,9 @@ def main(argv=None) -> None:
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_taillog)
 
-    sp = sub.add_parser("timeline", help="dump chrome trace")
+    sp = sub.add_parser("timeline",
+                        help="dump the cluster task timeline as a "
+                             "chrome trace (Perfetto-loadable)")
     sp.add_argument("--address")
     sp.add_argument("-o", "--output")
     sp.set_defaults(fn=cmd_timeline)

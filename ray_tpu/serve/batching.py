@@ -64,7 +64,11 @@ class _Batcher:
             self._leader = bool(self._queue)
             requeue_leader = self._leader
         try:
-            results = self.fn([s.args for s in batch])
+            from ..util import tracing
+            with tracing.span(
+                    f"serve_batch::{getattr(self.fn, '__name__', 'batch')}",
+                    "serve", batch_size=len(batch)):
+                results = self.fn([s.args for s in batch])
             if results is None or len(results) != len(batch):
                 raise ValueError(
                     "@serve.batch function must return one result per "

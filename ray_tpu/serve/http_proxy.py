@@ -130,6 +130,13 @@ class HTTPProxy:
                 path = path[:-len("/stream")]
             name = self._router.match_route(path)
             if name is None:
+                # A request can beat the router's 0.25s poll TTL to a
+                # just-deployed route (the table still holds the
+                # boot-time snapshot); force one refresh before 404ing.
+                # Costs one snapshot RPC, only on unmatched paths.
+                self._router._refresh(force=True)
+                name = self._router.match_route(path)
+            if name is None:
                 return web.Response(status=404,
                                     text=f"no deployment for {path}")
             info = self._router.route_info(name)

@@ -13,6 +13,7 @@ import asyncio
 import dataclasses
 import inspect
 import threading
+import time
 from typing import Any, Dict, Optional
 
 
@@ -66,8 +67,30 @@ class ServeReplica:
             target.reconfigure(user_config)
         return True
 
+    def _trace_args(self) -> Dict[str, Any]:
+        """Span attribution for the request being handled: the replica's
+        identity plus the actor-task spec's trace id, so serve spans
+        join the same timeline as the task-lifecycle spans."""
+        tr = {"deployment": self.deployment_name,
+              "replica": self.replica_id}
+        from ..core.worker_runtime import current_task_spec
+        spec = current_task_spec()
+        if spec is not None:
+            tr["task_id"] = spec.task_id.hex()
+            tr["trace"] = spec.trace_id
+        return tr
+
     def handle_request(self, args: tuple, kwargs: Dict[str, Any],
                        method: Optional[str] = None) -> Any:
+        from ..core.worker_runtime import current_task_spec
+        from ..util import tracing
+        tr = self._trace_args()
+        spec = current_task_spec()
+        now = time.time()
+        if spec is not None and spec.submit_time:
+            # router assign -> replica start: the request's queue leg
+            tracing.record_span(f"serve_queue::{self.deployment_name}",
+                                "serve", spec.submit_time, now, **tr)
         with self._lock:
             self._num_ongoing += 1
             self._total += 1
@@ -82,6 +105,8 @@ class ServeReplica:
                 result = asyncio.run(result)
             return result
         finally:
+            tracing.record_span(f"serve_exec::{self.deployment_name}",
+                                "serve", now, time.time(), **tr)
             with self._lock:
                 self._num_ongoing -= 1
 

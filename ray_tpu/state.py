@@ -219,6 +219,84 @@ def agent_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+# ---------------------------------------------------- cluster timeline
+def _trace_span_events() -> List[Dict[str, Any]]:
+    """Every process's flushed lifecycle spans, merged from the
+    controller KV (namespace ``trace``, one key per process).  The
+    driver's own buffer is flushed synchronously first so a dump taken
+    right after a burst is complete."""
+    import json as _json
+
+    from .util import tracing
+    core = _ensure_initialized()
+    payload = tracing.kv_payload()
+    if payload is not None:
+        try:
+            core.controller.call("kv_put", {
+                "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
+                "value": payload, "persist": False})
+        except Exception:
+            tracing.mark_dirty()
+    events: List[Dict[str, Any]] = []
+    for key in core.controller.call("kv_keys",
+                                    {"ns": tracing.TRACE_KV_NS,
+                                     "prefix": ""}):
+        raw = core.controller.call("kv_get", {"ns": tracing.TRACE_KV_NS,
+                                              "key": key})
+        if raw:
+            try:
+                events.extend(_json.loads(raw))
+            except ValueError:
+                continue
+    return events
+
+
+def _node_task_span_events() -> List[Dict[str, Any]]:
+    """Legacy per-node finished-task spans (nodelet ``task_spans``
+    buffers) as Chrome events — still the only source for tasks whose
+    worker died mid-flight (``interrupted`` spans)."""
+    events: List[Dict[str, Any]] = []
+    try:
+        for n in list_nodes():
+            if not n.get("alive"):
+                continue
+            for sp in _node_call(n["addr"], "task_spans"):
+                events.append({
+                    "name": sp["name"], "cat": "task", "ph": "X",
+                    "ts": sp["start"] * 1e6,
+                    "dur": max(0.0, (sp["end"] - sp["start"])) * 1e6,
+                    "pid": "node:" + n["id"][:8],
+                    "tid": "worker:" + sp["worker_id"][:8],
+                    "args": {"task_id": sp.get("task_id", ""),
+                             "interrupted": sp.get("interrupted", False)},
+                })
+    except Exception:
+        pass
+    return events
+
+
+def timeline() -> Dict[str, Any]:
+    """Cluster-wide task timeline as a Chrome-trace dict (reference:
+    `ray timeline` / chrome_tracing_dump, _private/state.py:414).
+
+    Merges every process's lifecycle spans (submit → schedule → dequeue
+    → fetch → exec → put, plus serve/train workload spans) with the
+    legacy per-node finished-task spans, ordered by timestamp with
+    per-process pid/tid attribution.  The returned dict serializes
+    directly to a file loadable in https://ui.perfetto.dev or
+    chrome://tracing."""
+    events = _trace_span_events() + _node_task_span_events()
+    events.sort(key=lambda e: e.get("ts", 0))
+    pids: List[Any] = []
+    for e in events:
+        p = e.get("pid")
+        if p not in pids:
+            pids.append(p)
+    meta = [{"ph": "M", "name": "process_name", "pid": p, "tid": 0,
+             "args": {"name": str(p)}} for p in pids]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def list_tasks() -> List[Dict[str, Any]]:
     """RUNNING tasks cluster-wide with node attribution (reference:
     `ray list tasks`, experimental/state/api.py)."""

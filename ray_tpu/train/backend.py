@@ -69,10 +69,15 @@ class _SpmdBackend(Backend):
             from ..air import session
             from ..parallel.coordinator import join_mesh_gang
             from ..parallel.mesh import MeshSpec
+            from ..util import tracing
             spec = MeshSpec.parse(mesh_text) if mesh_text else None
-            mesh = join_mesh_gang(group_name, world_size,
-                                  rank=session.get_world_rank(),
-                                  timeout_s=timeout_s, spec=spec)
+            rank = session.get_world_rank()
+            # rendezvous span: gang-join stalls (a slow peer, a wedged
+            # runtime) show up on the cluster timeline per worker rank
+            with tracing.span(f"train_rendezvous::{group_name}", "train",
+                              rank=rank, world_size=world_size):
+                mesh = join_mesh_gang(group_name, world_size, rank=rank,
+                                      timeout_s=timeout_s, spec=spec)
             session._get_session().mesh = mesh
 
         return setup

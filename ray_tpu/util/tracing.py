@@ -1,8 +1,24 @@
-"""Chrome-trace profiling events (reference: core_worker/profiling.cc +
+"""Cluster-wide task-lifecycle tracing (reference: core_worker/profiling.cc
+profile events -> GCS, surfaced by `ray timeline` /
 python/ray/_private/state.py:414 chrome_tracing_dump).
 
-Round-1 scope: in-process event collection; cross-process aggregation rides
-the controller KV.
+Two layers live here:
+
+* ``profile`` — the legacy in-process Chrome-trace context manager
+  (perf_counter clock, local buffer only).  Useful for driver-side
+  micro-profiling; it never crosses a process boundary.
+
+* **Distributed lifecycle spans** — every runtime process (driver,
+  controller, nodelet, worker) appends spans for the hops of a task's
+  life (submit → schedule → dequeue → fetch → exec → put, plus serve /
+  train workload spans) into a bounded per-process buffer, stamped with
+  the wall clock so cross-process merge lines up.  A per-process flush
+  loop rewrites the buffer into the controller KV (namespace
+  ``trace``, one key per process, ``persist=False`` so the WAL never
+  sees it); ``state.timeline()`` merges every process's batch into one
+  Chrome-trace JSON.  Overwrite semantics keep the controller's copy
+  bounded: the KV holds "the recent spans of each process", nothing
+  grows without bound.
 """
 
 from __future__ import annotations
@@ -11,22 +27,32 @@ import json
 import os
 import threading
 import time
-from typing import List
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..core.config import GlobalConfig
+
+TRACE_KV_NS = "trace"
 
 _events: List[dict] = []
 _lock = threading.Lock()
 
 
 class profile:
-    """Context manager recording one Chrome-trace duration event."""
+    """Context manager recording one LOCAL Chrome-trace duration event.
+
+    Both endpoints read ``time.perf_counter() * 1e6`` — one clock, one
+    unit (µs).  (An earlier revision probed for a nonexistent
+    ``time.perf_counter_us`` on enter, which would have mixed units with
+    the exit path had it ever resolved.)
+    """
 
     def __init__(self, name: str, category: str = "task"):
         self.name = name
         self.category = category
 
     def __enter__(self):
-        self.start = time.perf_counter_us() if hasattr(time, "perf_counter_us") \
-            else time.perf_counter() * 1e6
+        self.start = time.perf_counter() * 1e6
         return self
 
     def __exit__(self, *exc):
@@ -34,7 +60,7 @@ class profile:
         with _lock:
             _events.append({
                 "name": self.name, "cat": self.category, "ph": "X",
-                "ts": self.start, "dur": end - self.start,
+                "ts": self.start, "dur": max(0.0, end - self.start),
                 "pid": os.getpid(), "tid": threading.get_ident() % 10000,
             })
 
@@ -44,26 +70,119 @@ def chrome_trace_events() -> List[dict]:
         return list(_events)
 
 
+# --------------------------------------------------- distributed spans
+
+_span_lock = threading.Lock()
+_spans: Optional[deque] = None
+_dirty = False
+_proc = {"kind": "proc", "node": ""}
+_flusher_claimed = False
+
+
+def configure(kind: str, node_id: str = "") -> None:
+    """Set this process's identity for span attribution (called once by
+    the driver core, worker runtime, nodelet, and controller)."""
+    _proc["kind"] = kind
+    _proc["node"] = (node_id or "")[:8]
+
+
+def claim_flusher() -> bool:
+    """First caller owns the KV flush loop for this process (a worker
+    process hosts both a WorkerRuntime and a lazy CoreClient; only one
+    may flush or they'd race on the dirty flag)."""
+    global _flusher_claimed
+    with _span_lock:
+        if _flusher_claimed:
+            return False
+        _flusher_claimed = True
+        return True
+
+
+def _buffer() -> deque:
+    global _spans
+    if _spans is None:
+        _spans = deque(maxlen=max(16, GlobalConfig.trace_buffer_size))
+    return _spans
+
+
+def proc_label() -> str:
+    node = _proc["node"]
+    return f"{_proc['kind']}@{node}" if node else _proc["kind"]
+
+
+def kv_key() -> str:
+    return f"{_proc['kind']}:{_proc['node']}:{os.getpid()}"
+
+
+def record_span(name: str, cat: str, start_s: float, end_s: float,
+                **args: Any) -> None:
+    """Record one lifecycle span (wall-clock seconds in, Chrome µs out)."""
+    if not GlobalConfig.trace_enabled:
+        return
+    ev = {
+        "name": name, "cat": cat, "ph": "X",
+        "ts": start_s * 1e6, "dur": max(0.0, end_s - start_s) * 1e6,
+        "pid": proc_label(), "tid": str(os.getpid()),
+        "args": {k: v for k, v in args.items() if v},
+    }
+    global _dirty
+    with _span_lock:
+        _buffer().append(ev)
+        _dirty = True
+
+
+class span:
+    """Context manager form of :func:`record_span` (wall clock)."""
+
+    def __init__(self, name: str, cat: str = "task", **args: Any):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self.start = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        record_span(self.name, self.cat, self.start, time.time(),
+                    **self.args)
+
+
+def span_events() -> List[dict]:
+    """Snapshot of this process's span buffer."""
+    with _span_lock:
+        return list(_buffer())
+
+
+def kv_payload() -> Optional[bytes]:
+    """The buffer as JSON bytes if anything changed since the last
+    flush, else None.  Clears the dirty flag — callers whose flush RPC
+    fails should :func:`mark_dirty` so the next tick retries."""
+    global _dirty
+    with _span_lock:
+        if not _dirty:
+            return None
+        _dirty = False
+        return json.dumps(list(_buffer())).encode()
+
+
+def mark_dirty() -> None:
+    global _dirty
+    with _span_lock:
+        _dirty = True
+
+
 def cluster_trace_events() -> List[dict]:
-    """Driver-local spans PLUS every node's finished-task spans (the
-    reference's profile-event aggregation: core_worker/profiling.cc ->
-    GCS -> `ray.timeline` chrome dump, _private/state.py:414)."""
+    """Driver-local profile spans PLUS every process's flushed lifecycle
+    spans PLUS every node's legacy finished-task spans — the flat-list
+    form the dashboard consumes (``state.timeline()`` wraps the same
+    spans, minus the differently-clocked local profile events, as a
+    Chrome-trace dict)."""
     events = chrome_trace_events()
     try:
         from .. import state
-        for n in state.list_nodes():
-            if not n.get("alive"):
-                continue
-            for sp in state._node_call(n["addr"], "task_spans"):
-                events.append({
-                    "name": sp["name"], "cat": "task", "ph": "X",
-                    "ts": sp["start"] * 1e6,
-                    "dur": max(0.0, (sp["end"] - sp["start"])) * 1e6,
-                    "pid": "node:" + n["id"][:8],
-                    "tid": "worker:" + sp["worker_id"][:8],
-                    "args": {"task_id": sp.get("task_id", ""),
-                             "interrupted": sp.get("interrupted", False)},
-                })
+        events += state._trace_span_events()
+        events += state._node_task_span_events()
     except Exception:
         pass  # not connected / nodes unreachable: driver-local only
     return events

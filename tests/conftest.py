@@ -46,6 +46,43 @@ import pytest  # noqa: E402
 # consults, so this confines every test to the 8-device virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 
+# Compat: this image ships jax 0.4.37, which predates several APIs the
+# code uses.  Each shim maps to the 0.4-era equivalent and is a no-op on
+# newer jax (hasattr guards).
+if not hasattr(jax, "set_mesh"):
+    # every use here is `with jax.set_mesh(mesh):`, and Mesh is itself a
+    # context manager with the equivalent semantics
+    jax.set_mesh = lambda mesh: mesh
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, mesh=None, **kw):
+        if mesh is None:  # newer jax infers the ambient mesh
+            mesh = jax._src.mesh.thread_resources.env.physical_mesh
+        axis_names = kw.pop("axis_names", None)
+        if axis_names is not None:
+            # new-jax partial-manual (manual over axis_names) == old-jax
+            # `auto` over the complement
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _shard_map(f, mesh, **kw)
+    jax.shard_map = _compat_shard_map
+if not hasattr(jax.sharding, "get_abstract_mesh"):
+    # the ambient mesh entered via `with mesh:` (thread_resources is the
+    # 0.4 mechanism backing that context manager)
+    jax.sharding.get_abstract_mesh = (
+        lambda: jax._src.mesh.thread_resources.env.physical_mesh)
+if not hasattr(jax.lax, "pcast"):
+    # vma re-typing only exists in the sharding-in-types world; on 0.4
+    # shard_map there is no varying-axis type to cast — identity
+    jax.lax.pcast = lambda x, axes=None, to=None: x
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+    if not hasattr(_pltpu, "CompilerParams") \
+            and hasattr(_pltpu, "TPUCompilerParams"):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except Exception:
+    pass
+
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test on a fresh event loop")

@@ -27,4 +27,8 @@ def test_example_runs(script):
     assert out.returncode == 0, \
         f"{script} failed:\nstdout:\n{out.stdout[-2000:]}\n" \
         f"stderr:\n{out.stderr[-2000:]}"
+    if f"SKIP {script[:-3]}" in out.stdout:
+        # the example detected a capability this image lacks (e.g. no
+        # multiprocess CPU collectives on this jaxlib) and bowed out
+        pytest.skip(out.stdout.strip().splitlines()[-1])
     assert f"EXAMPLE_OK {script[:-3]}" in out.stdout
