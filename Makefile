@@ -2,7 +2,7 @@
 # tree): native object store + transfer plane, C++ driver API, wheel.
 PY ?= python
 
-.PHONY: all native cpp wheel test bench obs clean
+.PHONY: all native cpp wheel test bench obs chaos clean
 
 all: native cpp
 
@@ -27,6 +27,14 @@ test:
 obs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_observability.py \
 		tests/test_runtime_metrics.py tests/test_events.py -q
+
+# Chaos suite: seeded fault-injection units + all four end-to-end
+# recovery scenarios (each runs twice with the same seeds — injection
+# is deterministic).  Includes the `slow`-marked multi-process
+# scenarios tier-1 skips.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py \
+		tests/test_controller_ft.py -q
 
 bench:
 	$(PY) bench.py

@@ -1,0 +1,45 @@
+"""Driver-facing chaos control: apply/clear/inspect the cluster fault plan.
+
+``apply`` stores the plan in the controller KV (namespace ``chaos``) and
+broadcasts it on the ``chaos`` pubsub channel — nodelets re-arm on the
+push and forward it to their live workers, so the whole cluster is armed
+within one notify fan-out.  Processes spawned later pick the plan up at
+registration (nodelets query it after subscribing; workers receive it via
+``chaos_update`` or the env-propagated ``chaos_plan`` config flag).
+
+See ``ray_tpu/util/fault_injection.py`` for the rule schema, and the
+``ray-tpu chaos`` CLI for the file-based form.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .api import _ensure_initialized
+from .util import fault_injection as fi
+
+
+def apply(plan: List[Dict[str, Any]]) -> int:
+    """Arm ``plan`` cluster-wide (and locally, so driver-side sites like
+    ``rpc.send`` fire too).  Returns the number of rules applied."""
+    core = _ensure_initialized()
+    core.controller.call("chaos_plan", {"plan": list(plan)}, timeout=30.0)
+    fi.arm(plan)
+    return len(plan)
+
+
+def clear() -> None:
+    """Disarm the chaos layer cluster-wide."""
+    core = _ensure_initialized()
+    core.controller.call("chaos_plan", {"clear": True}, timeout=30.0)
+    fi.disarm()
+
+
+def status() -> Dict[str, Any]:
+    """The cluster plan (from the controller KV) plus this process's
+    injection counts."""
+    core = _ensure_initialized()
+    plan: Optional[list] = core.controller.call("chaos_plan", {},
+                                                timeout=30.0)
+    return {"plan": plan, "armed_locally": fi.ACTIVE is not None,
+            "local_injected": fi.injected_counts()}

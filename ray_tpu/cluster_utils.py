@@ -9,8 +9,9 @@ real cluster.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from . import api
 from .core import node as node_mod
@@ -31,7 +32,17 @@ class ClusterNode:
 
 
 class Cluster:
-    def __init__(self, *, heartbeat_timeout_s: float = 2.0):
+    def __init__(self, *, heartbeat_timeout_s: float = 2.0,
+                 chaos_plan: Optional[List[Dict[str, Any]]] = None):
+        """``chaos_plan`` arms the deterministic fault-injection layer
+        (util/fault_injection.py) in EVERY process of this cluster —
+        controller, nodelets, workers, and the connecting driver — via
+        the env-propagated ``chaos_plan`` config flag.  ``shutdown()``
+        disarms and scrubs the env so later clusters boot clean."""
+        self._chaos_armed = chaos_plan is not None
+        if chaos_plan is not None:
+            from .core.config import GlobalConfig
+            GlobalConfig.update({"chaos_plan": json.dumps(chaos_plan)})
         self.session_dir = node_mod.new_session_dir()
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.controller_proc, self.controller_addr = node_mod.start_controller(
@@ -74,6 +85,12 @@ class Cluster:
                         nodelet_addr=target.address)
 
     def shutdown(self):
+        if self._chaos_armed:
+            from .core.config import GlobalConfig
+            from .util import fault_injection as fi
+            GlobalConfig.update({"chaos_plan": ""})
+            os.environ.pop("RAY_TPU_CHAOS_PLAN", None)
+            fi.disarm()
         if api.is_initialized():
             api.shutdown()
         for n in self.nodes:

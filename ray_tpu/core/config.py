@@ -138,7 +138,14 @@ _d("scheduler_top_k_fraction", float, 0.2,
 _d("lease_request_timeout_s", float, 30.0, "Timeout for a worker lease grant.")
 _d("actor_creation_timeout_s", float, 300.0,
    "How long method calls wait for a PENDING/RESTARTING actor to come up.")
-_d("rpc_connect_retries", int, 60, "TCP connect retries (20ms backoff) at bootstrap.")
+_d("rpc_connect_retries", int, 60,
+   "TCP connect retries at bootstrap/reconnect (capped exponential "
+   "backoff with full jitter between attempts).")
+_d("rpc_connect_backoff_cap_s", float, 0.5,
+   "Cap for the full-jitter exponential backoff between TCP connect "
+   "retries (base is the call's retry_delay, default 20ms).  Jitter "
+   "keeps a restarted controller from eating a reconnect thundering-"
+   "herd from every nodelet and driver at once.")
 _d("pull_retry_interval_s", float, 0.5, "Retry period for remote object pulls.")
 _d("usage_stats_enabled", bool, False,
    "Write a local JSON usage report under the session dir at shutdown "
@@ -196,6 +203,17 @@ _d("max_reconstruction_depth", int, 20,
    "Maximum recursion depth when reconstructing a chain of lost objects "
    "(reference: object_recovery_manager.h recursive recovery).")
 
+# --- robustness / chaos -----------------------------------------------------
+_d("chaos_plan", str, "",
+   "JSON fault-injection plan (list of rules) armed at process start; "
+   "'' disables the chaos layer entirely (zero-cost None check on hot "
+   "paths).  Rule schema: util/fault_injection.py.  Runtime apply: "
+   "`ray-tpu chaos apply plan.json` (controller KV + pubsub fan-out).")
+_d("mp_pool_default_timeout_s", float, 600.0,
+   "Default result timeout for util.multiprocessing Pool gets; raises "
+   "the typed GetTimeoutError instead of hanging a pool on a result "
+   "that will never arrive.")
+
 # --- TPU / accelerator ------------------------------------------------------
 _d("tpu_autodetect", bool, True, "Detect local TPU chips via JAX at node start.")
 _d("tpu_detect_timeout_s", float, 30.0,
@@ -241,6 +259,12 @@ _d("serve_http_port", int, 8000, "HTTP proxy bind port.")
 _d("serve_request_timeout_s", float, 60.0,
    "End-to-end timeout for one proxied HTTP request (replica execution "
    "included).")
+_d("serve_backoff_base_s", float, 0.01,
+   "Base of the full-jitter exponential backoff the Serve router uses "
+   "while every replica is saturated, and between replica-failure "
+   "retry attempts in call_with_retry.")
+_d("serve_backoff_cap_s", float, 0.2,
+   "Cap of the Serve router/handle retry backoff.")
 _d("serve_gang_ready_timeout_s", float, 300.0,
    "How long gang-replica bring-up may take (PG + N actors + "
    "jax.distributed rendezvous + model load) before the replica is "

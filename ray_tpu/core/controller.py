@@ -133,6 +133,18 @@ class Controller:
             self.pstore = ControllerStore(persist_dir)
             self.pstore._snapshot_provider = self._tables_snapshot
             self._restore(self.pstore.load())
+        # chaos layer: `once` fault rules are claimed here (exactly one
+        # firing cluster-wide); arm from env config, then let a plan
+        # persisted in the KV (applied pre-restart) override it
+        self._chaos_claims: Set[str] = set()
+        from ..util import fault_injection as fi
+        fi.maybe_arm_from_config()
+        raw_plan = self.kv.get(fi.CHAOS_KV_NS, {}).get(fi.CHAOS_KV_KEY)
+        if raw_plan:
+            try:
+                fi.arm(raw_plan)
+            except (ValueError, KeyError):
+                pass
         self._register_handlers()
 
     # ------------------------------------------------------------ durability
@@ -209,8 +221,49 @@ class Controller:
                      "report_event", "list_events",
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
-                     "drain_node", "ping", "metrics_text"):
+                     "drain_node", "ping", "metrics_text",
+                     "chaos_plan", "chaos_claim"):
             s.register(name, getattr(self, "_h_" + name))
+
+    # ------------------------------------------------------------- chaos
+    async def _h_chaos_plan(self, conn, data):
+        """Set/clear/read the cluster fault plan.  The plan lives in the
+        KV (namespace ``chaos``, persisted — it must survive a controller
+        kill mid-scenario) and fans out on the ``chaos`` pubsub channel;
+        nodelets re-arm and forward to their workers."""
+        import json as _json
+
+        from ..util import fault_injection as fi
+        ns = self.kv.setdefault(fi.CHAOS_KV_NS, {})
+        if data.get("clear"):
+            if ns.pop(fi.CHAOS_KV_KEY, None) is not None:
+                self._p("kv_del", fi.CHAOS_KV_NS, fi.CHAOS_KV_KEY)
+            fi.disarm()
+            self._chaos_claims.clear()
+            self._emit_event("INFO", "chaos", "fault plan cleared")
+            await self._broadcast("chaos", {"plan": None})
+            return None
+        plan = data.get("plan")
+        if plan is not None:
+            raw = _json.dumps(plan).encode()
+            ns[fi.CHAOS_KV_KEY] = raw
+            self._p("kv_put", fi.CHAOS_KV_NS, fi.CHAOS_KV_KEY, raw)
+            fi.arm(plan)
+            self._emit_event("WARNING", "chaos",
+                             f"fault plan applied ({len(plan)} rules)")
+            await self._broadcast("chaos", {"plan": plan})
+        cur = ns.get(fi.CHAOS_KV_KEY)
+        return _json.loads(cur) if cur else None
+
+    async def _h_chaos_claim(self, conn, data):
+        """First-claimer-wins gate for `once` fault rules: exactly one
+        process cluster-wide fires the fault, every other matching
+        process gets False and skips it."""
+        rid = data["id"]
+        if rid in self._chaos_claims:
+            return False
+        self._chaos_claims.add(rid)
+        return True
 
     async def _h_metrics_text(self, conn, data):
         """Prometheus exposition of controller runtime metrics

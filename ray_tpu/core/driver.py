@@ -257,6 +257,20 @@ class CoreClient(DeferredRefDecs):
             self.controller.call("register_job",
                                  {"job_id": self.job_id.binary(),
                                   "driver": f"pid-{os.getpid()}"})
+        # chaos layer (env/config-armed; no-op when already armed, so a
+        # worker's lazy CoreClient never resets live rule counters)
+        from ..util import fault_injection
+        fault_injection.maybe_arm_from_config()
+        if mode == "driver" and fault_injection.ACTIVE is None:
+            # a runtime-applied plan must cover drivers that connect
+            # AFTER `chaos apply` too — they hold no chaos subscription,
+            # so pull the KV copy once at boot
+            try:
+                plan = self.controller.call("chaos_plan", {}, timeout=10)
+                if plan:
+                    fault_injection.arm(plan)
+            except Exception:
+                pass
 
     # -------------------------------------------------------------- tracing
     async def _trace_flush_loop(self):

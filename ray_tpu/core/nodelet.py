@@ -24,6 +24,7 @@ import traceback
 from typing import Any, Dict, List, Optional, Set
 
 from . import rpc, runtime_metrics as rtm, spill, worker_zygote
+from ..util import fault_injection as fi
 from .config import GlobalConfig
 from .ids import NodeID, WorkerID
 from .object_store import client as store_client
@@ -148,7 +149,7 @@ class Nodelet:
                      "node_info", "stats", "put_location", "ping",
                      "task_state", "task_state_batch", "node_stats",
                      "tail_log", "task_spans", "prestart_workers",
-                     "metrics_text"):
+                     "metrics_text", "chaos_injected"):
             s.register(name, getattr(self, "_h_" + name))
 
     @property
@@ -156,6 +157,11 @@ class Nodelet:
         return f"{self.server.host}:{self.server.port}"
 
     async def start(self):
+        # identity + chaos arming first: proc-filtered fault rules must
+        # see kind "nodelet" from the very first (chaos-visible) dial
+        from ..util import tracing
+        tracing.configure("nodelet", self.node_id.hex())
+        fi.maybe_arm_from_config()
         store_client.create_segment(self.store_path, self.store_capacity)
         self.store = store_client.StoreClient(self.store_path)
         # Native object plane: C++ in-store transfer server (transfer.cc) —
@@ -185,8 +191,6 @@ class Nodelet:
         self._lag_ewma = 0.0
         self._lag_max = 0.0
         self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
-        from ..util import tracing
-        tracing.configure("nodelet", self.node_id.hex())
         self._tasks.append(asyncio.ensure_future(self._trace_flush_loop()))
         self._agent_proc = None
         if GlobalConfig.dashboard_agent:
@@ -227,6 +231,7 @@ class Nodelet:
         # PG 2PC, frees) — give it the full handler table plus pubsub.
         handlers = dict(self.server.handlers)
         handlers["pub:nodes"] = self._on_nodes_event
+        handlers["pub:chaos"] = self._on_chaos_event
         self.controller = await rpc.connect(
             host, int(port), handlers=handlers,
             retries=GlobalConfig.rpc_connect_retries)
@@ -238,6 +243,18 @@ class Nodelet:
             "config": GlobalConfig.snapshot(),
         })
         await self.controller.call("subscribe", {"channel": "nodes"})
+        await self.controller.call("subscribe", {"channel": "chaos"})
+        # Late joiners (and reconnects after a controller restart) pull
+        # the current fault plan; a plan applied mid-run must cover nodes
+        # added after `ray-tpu chaos apply`.
+        try:
+            plan = await self.controller.call("chaos_plan", {})
+            # arm only on CHANGE: heartbeat reconnects land here too, and
+            # re-arming an identical plan would reset its nth counters
+            if plan and (fi.ACTIVE is None or fi.ACTIVE.raw != plan):
+                fi.arm(plan)
+        except rpc.RpcError:
+            pass
         self._apply_view(reply["view"], reply["view_version"])
 
     async def stop(self):
@@ -317,11 +334,40 @@ class Nodelet:
                 nv.alive = False
             self._peer_conns.pop(data.get("addr", ""), None)
 
+    async def _on_chaos_event(self, conn, data):
+        """Runtime fault-plan push: re-arm locally and fan out to every
+        live worker on this node (workers hold no controller
+        subscription of their own)."""
+        plan = data.get("plan")
+        if plan:
+            fi.arm(plan)
+        else:
+            fi.disarm()
+        for w in list(self.workers.values()):
+            if w.conn is not None and not w.conn.closed:
+                try:
+                    await w.conn.notify("chaos_update", {"plan": plan})
+                except Exception:
+                    pass
+
+    async def _h_chaos_injected(self, conn, data):
+        """A worker's injection report: crashing workers notify here just
+        before exiting so the fault is visible in a SCRAPED registry
+        (worker registries never are)."""
+        fi.count_injection(data.get("site", "?"), data.get("action", "?"))
+        return True
+
     async def _heartbeat_loop(self):
         while True:
             try:
                 if self.controller is None or self.controller.closed:
                     await self._connect_controller()
+                if fi.ACTIVE is not None and fi.ACTIVE.point(
+                        "nodelet.heartbeat", self.node_id.hex()):
+                    # blackholed beat: simulates a partition — enough of
+                    # these in a row and the controller declares us dead
+                    await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
+                    continue
                 rtm.HEARTBEATS.inc(tags=self._mnode)
                 reply = await self.controller.call("heartbeat", {
                     "node_id": self.node_id.hex(),
@@ -747,6 +793,18 @@ class Nodelet:
         try:
             reply = await self._lease_inner(spec, request, strategy,
                                             deadline, my_id)
+            if fi.ACTIVE is not None and reply.get("granted"):
+                act = fi.ACTIVE.point("nodelet.lease", spec.function_name)
+                if act is not None and act["action"] == "kill_worker":
+                    # the granted worker dies ``delay_s`` after the grant
+                    # — i.e. mid-dispatch or mid-step, pinning down the
+                    # driver's re-lease/retry semantics
+                    w = self.workers.get(reply["worker_id"])
+                    if w is not None:
+                        asyncio.get_event_loop().call_later(
+                            max(0.0, act["delay_s"]),
+                            lambda proc=w.proc: proc.poll() is None
+                            and proc.kill())
             if reply.get("granted"):
                 # scheduling latency: lease request arrival -> worker
                 # grant, attributed to the task whose spec rode the
@@ -1156,6 +1214,26 @@ class Nodelet:
 
     async def _h_fetch_meta(self, conn, data):
         oid = data["object_id"]
+        if fi.ACTIVE is not None:
+            act = fi.ACTIVE.point("object.fetch_meta", oid.hex())
+            if act is not None and act["action"] == "evict":
+                # Force-evict the local copy mid-pull: drop the primary
+                # pin, the store copy, and our directory entry — the
+                # puller sees a vanished replica and the owner's lineage
+                # reconstruction path has to recover the object.
+                if self._primary_pins.pop(oid, None) is not None:
+                    self.store.release(oid)
+                try:
+                    self.store.delete(oid)
+                except store_client.StoreError:
+                    pass
+                try:
+                    await self.controller.call(
+                        "object_location_remove",
+                        {"object_id": oid, "node_id": self.node_id.hex()})
+                except rpc.RpcError:
+                    pass
+                return {"exists": False}
         view = self.store.get(oid, timeout_ms=0)
         if view is None:
             return {"exists": False}

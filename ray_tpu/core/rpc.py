@@ -26,6 +26,12 @@ REQUEST, REPLY, ERROR, NOTIFY = 0, 1, 2, 3
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 
+# Armed fault-injection plan (util/fault_injection.py sets/clears this —
+# this module sits below ray_tpu.util in the import graph and cannot
+# import it at module scope).  None == chaos disabled: hot paths pay one
+# module-global None check and nothing else.
+_chaos = None
+
 
 class RpcError(Exception):
     pass
@@ -72,15 +78,34 @@ class Connection:
         seq = self._seq
         fut = asyncio.get_event_loop().create_future()
         self._pending[seq] = fut
-        await self._send(_pack(seq, REQUEST, method, data))
+        if _chaos is not None and await self._chaos_send(method):
+            # frame "lost on the wire": the request hangs to its timeout
+            # exactly as a real drop would
+            pass
+        else:
+            await self._send(_pack(seq, REQUEST, method, data))
         try:
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(seq, None)
 
+    async def _chaos_send(self, method: str) -> bool:
+        """Apply an armed ``rpc.send`` rule; True == drop the frame."""
+        act = await _chaos.async_point("rpc.send", method)
+        if act is None:
+            return False
+        if act["action"] == "sever":
+            await self._shutdown()
+            raise ConnectionLost(f"chaos: connection severed ({method})")
+        if act["action"] == "error":
+            raise RpcError(f"chaos: injected send error ({method})")
+        return act["action"] == "drop"
+
     async def notify(self, method: str, data: Any = None):
         if self._closed:
             raise ConnectionLost(f"connection closed (notifying {method})")
+        if _chaos is not None and await self._chaos_send(method):
+            return
         await self._send(_pack(0, NOTIFY, method, data))
 
     async def _read_loop(self):
@@ -199,14 +224,29 @@ async def connect(host: str, port: int,
                     await h(conn, ev)
             return True
         handlers = {**handlers, "pub_batch": _pub_batch}
+    # Capped exponential backoff with FULL jitter between attempts: a
+    # restarted controller comes back to staggered redials, not a
+    # thundering herd of every nodelet/driver waking on the same fixed
+    # 20 ms tick (utils/backoff.py; the reference's gcs_rpc_client
+    # reconnect spreads the same way).
+    from ..util.backoff import ExponentialBackoff
+    from .config import GlobalConfig as _cfg
+    bo = ExponentialBackoff(base=retry_delay,
+                            cap=_cfg.rpc_connect_backoff_cap_s)
     last = None
-    for _ in range(max(1, retries)):
+    for attempt in range(max(1, retries)):
+        if _chaos is not None:
+            act = await _chaos.async_point("rpc.connect", f"{host}:{port}")
+            if act is not None and act["action"] in ("error", "drop"):
+                last = OSError("chaos: connect refused")
+                await asyncio.sleep(bo.next_delay())
+                continue
         try:
             reader, writer = await asyncio.open_connection(host, port)
             return Connection(reader, writer, handlers or {})
         except OSError as e:
             last = e
-            await asyncio.sleep(retry_delay)
+            await asyncio.sleep(bo.next_delay())
     raise ConnectionLost(f"cannot connect to {host}:{port}: {last}")
 
 

@@ -45,6 +45,11 @@ from .task_spec import ARG_REF, ARG_VALUE, DYNAMIC_RETURNS, TaskSpec
 
 FN_NAMESPACE = "fn"
 
+# Armed fault-injection plan (util/fault_injection.py sets/clears this —
+# importing ray_tpu.util at module scope here would cycle through the
+# package __init__).  None == chaos disabled (one None check per site).
+_chaos = None
+
 # The spec of the task currently executing in this context (thread /
 # asyncio task) — feeds `ray_tpu.get_runtime_context()` (reference:
 # WorkerContext / ray.get_runtime_context).
@@ -92,7 +97,8 @@ class WorkerRuntime:
         self._dying = False
         self._shutdown = asyncio.Event()
         for name in ("push_task", "create_actor", "push_actor_task", "ping",
-                     "exit", "actor_checkpoint", "cancel_task"):
+                     "exit", "actor_checkpoint", "cancel_task",
+                     "chaos_update"):
             self.server.register(name, getattr(self, "_h_" + name))
         self._running_threads: Dict[bytes, int] = {}   # task_id -> thread id
         self._running_aio: Dict[bytes, Any] = {}       # task_id -> aio task
@@ -123,6 +129,8 @@ class WorkerRuntime:
             "worker_id": self.worker_id, "port": self.server.port,
             "pid": os.getpid()})
         GlobalConfig.load_snapshot(reply.get("config", {}))
+        from ..util import fault_injection as fi
+        fi.maybe_arm_from_config()
         # nodelet died -> die.  NOT during a graceful exit: loop cleanup
         # closes this connection and the hook would os._exit before
         # interpreter teardown could release an accelerator grant.
@@ -170,11 +178,66 @@ class WorkerRuntime:
             if payload is None:
                 continue
             try:
-                await self.controller.notify("kv_put", {
+                conn = await self._controller_conn()
+                await conn.notify("kv_put", {
                     "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
                     "value": payload, "persist": False})
             except Exception:
                 tracing.mark_dirty()
+
+    async def _controller_conn(self) -> rpc.Connection:
+        """Redial the controller when the connection dropped (it restarts
+        at the same address; reference: GCS clients reconnecting through
+        gcs_rpc_client).  Without this, every worker permanently lost its
+        function table / KV / actor reporting after a controller restart
+        — the chaos controller-kill scenario caught it."""
+        if self.controller is None or self.controller.closed:
+            host, port = self.controller_addr.rsplit(":", 1)
+            self.controller = await rpc.connect(
+                host, int(port), retries=GlobalConfig.rpc_connect_retries)
+        return self.controller
+
+    async def _h_chaos_update(self, conn, data):
+        """Runtime fault-plan push, forwarded by our nodelet."""
+        from ..util import fault_injection as fi
+        plan = data.get("plan")
+        if plan:
+            fi.arm(plan)
+        else:
+            fi.disarm()
+        return True
+
+    async def _chaos_site(self, site: str, key: str) -> None:
+        """Apply an armed rule at a worker execution site.  ``crash``
+        exits the process (after a best-effort injection report to the
+        nodelet — this registry dies with us and worker registries are
+        never scraped anyway); ``once`` crashes are claimed through the
+        controller so exactly one process cluster-wide takes the hit."""
+        act = await _chaos.async_point(site, key)
+        if act is None:
+            return
+        if act["action"] == "crash":
+            from ..util import fault_injection as fi
+            if act["once"] and not await self._chaos_claim(act["rule_id"]):
+                return
+            try:
+                await self.nodelet.notify("chaos_injected",
+                                          {"site": site, "action": "crash"})
+            except Exception:
+                pass
+            os._exit(fi.CRASH_EXIT_CODE)
+        if act["action"] == "error":
+            raise exceptions.RayTpuError(
+                f"chaos: injected error at {site} ({key})")
+
+    async def _chaos_claim(self, rule_id: str) -> bool:
+        from ..util import fault_injection as fi
+        try:
+            conn = await self._controller_conn()
+            return bool(await conn.call("chaos_claim", {"id": rule_id},
+                                        timeout=5))
+        except Exception:
+            return fi.local_claim(rule_id)
 
     async def run_forever(self):
         await self._shutdown.wait()
@@ -224,7 +287,8 @@ class WorkerRuntime:
 
     async def _read_spilled(self, oid: bytes):
         from . import spill
-        raw = await self.controller.call("kv_get", spill.kv_entry(oid))
+        conn = await self._controller_conn()
+        raw = await conn.call("kv_get", spill.kv_entry(oid))
         if not raw:
             return None
         return spill.read_file(raw.decode())
@@ -232,8 +296,9 @@ class WorkerRuntime:
     async def _get_function(self, fid: bytes):
         fn = self.fn_cache.get(fid)
         if fn is None:
-            blob = await self.controller.call("kv_get",
-                                              {"ns": FN_NAMESPACE, "key": fid})
+            conn = await self._controller_conn()
+            blob = await conn.call("kv_get",
+                                   {"ns": FN_NAMESPACE, "key": fid})
             if blob is None:
                 raise exceptions.RayTpuError(f"function {fid.hex()[:12]} not registered")
             fn = serialization.loads_function(blob)
@@ -259,7 +324,8 @@ class WorkerRuntime:
                 # Containment pin keyed on the return object: nested refs
                 # stay alive until the caller frees the container
                 # (reference_count.h "contained in owned object" edges).
-                await self.controller.notify("ref_inc", {
+                conn = await self._controller_conn()
+                await conn.notify("ref_inc", {
                     "object_ids": contained, "holder": f"obj:{oid.hex()}"})
                 # a nested ref whose value lives only in THIS worker's
                 # private memory store (e.g. a small api.put here) must
@@ -289,7 +355,8 @@ class WorkerRuntime:
                 except store_client.StoreFullError:
                     from . import spill
                     path = spill.write_object(oid, parts)
-                    await self.controller.call(
+                    conn = await self._controller_conn()
+                    await conn.call(
                         "kv_put", {**spill.kv_entry(oid),
                                    "value": path.encode()})
                 out.append({"plasma": size, "contained": bool(contained)})
@@ -482,7 +549,15 @@ class WorkerRuntime:
             tracing.record_span(f"exec::{fname}", "exec", t1, t2, **tr)
             if dynamic:
                 result = await self._materialize_dynamic(spec, result)
+            if _chaos is not None:
+                # crash-BEFORE-put: the result never reached the store,
+                # the caller's retry re-executes from scratch
+                await self._chaos_site("worker.before_put", fname)
             returns = await self._store_returns(spec, result)
+            if _chaos is not None:
+                # crash-AFTER-put: the object exists but the reply is
+                # lost — the retry must be idempotent against it
+                await self._chaos_site("worker.after_put", fname)
             t3 = time.time()
             tracing.record_span(f"put::{fname}", "put", t2, t3, **tr)
             if durs is not None:
@@ -589,7 +664,8 @@ class WorkerRuntime:
                     concurrent.futures.ThreadPoolExecutor(
                         max_workers=max(1, int(cap)))
                 self._group_sems[gname] = asyncio.Semaphore(max(1, int(cap)))
-            await self.controller.call("actor_alive", {
+            conn2 = await self._controller_conn()
+            await conn2.call("actor_alive", {
                 "actor_id": self.actor_id, "address": self.address,
                 "worker_id": self.worker_id, "node_id": self.node_id})
             return {"ok": True}
@@ -678,10 +754,11 @@ class WorkerRuntime:
                 pass
         if self.actor_instance is not None and self.actor_id is not None:
             try:
-                await self.controller.call("report_actor_death", {
+                conn = await self._controller_conn()
+                await conn.call("report_actor_death", {
                     "actor_id": self.actor_id, "reason": "ray_tpu.kill",
                     "intended": not data.get("restart", False)})
-            except rpc.RpcError:
+            except (rpc.RpcError, OSError):
                 pass
         self.request_exit(0)
         return True

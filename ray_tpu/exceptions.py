@@ -47,6 +47,22 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class ReplicaUnavailableError(RayTpuError):
+    """A Serve deployment currently has zero live replicas.
+
+    Typed fast-shed signal: the router raises it immediately instead of
+    busy-polling its table until the request deadline, and the HTTP
+    proxy maps it to 503 + ``Retry-After`` so load balancers back off
+    instead of piling on a deployment that is restarting."""
+
+    def __init__(self, deployment: str, retry_after_s: float = 1.0):
+        self.deployment = deployment
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"no live replicas for deployment {deployment!r} "
+            f"(retry after ~{retry_after_s:g}s)")
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -266,6 +266,30 @@ def cmd_timeline(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_chaos(args) -> None:
+    """Fault-injection (chaos) plan control: apply a JSON plan file
+    cluster-wide (controller KV + pubsub fan-out), clear it, or show the
+    current plan + this process's injection counts."""
+    import ray_tpu
+    from ray_tpu import chaos
+    _connect(args)
+    try:
+        if args.op == "apply":
+            if not args.plan:
+                sys.exit("chaos apply needs a JSON plan file")
+            with open(args.plan) as f:
+                plan = json.load(f)
+            n = chaos.apply(plan)
+            print(f"chaos plan applied: {n} rule(s)")
+        elif args.op == "clear":
+            chaos.clear()
+            print("chaos plan cleared")
+        else:
+            print(json.dumps(chaos.status(), indent=2, default=str))
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_microbenchmark(args) -> None:
     import ray_tpu
     from ray_tpu.microbenchmark import run_microbenchmarks
@@ -368,6 +392,16 @@ def main(argv=None) -> None:
     sp.add_argument("--address")
     sp.add_argument("-o", "--output")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("chaos",
+                        help="fault-injection plan control "
+                             "(apply/clear/status)")
+    sp.add_argument("op", choices=["apply", "clear", "status"])
+    sp.add_argument("plan", nargs="?",
+                    help="JSON plan file (for apply); rule schema in "
+                         "ray_tpu/util/fault_injection.py")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("microbenchmark", help="core op throughput")
     sp.add_argument("--num-cpus", type=float, default=4)

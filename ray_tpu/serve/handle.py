@@ -54,9 +54,17 @@ def call_with_retry(router, name: str, args, kwargs,
     """Assign + get with replica-failure retry under ONE deadline (the
     reference router's handling of dead replicas).  A request that
     raced a replica teardown re-routes to a live replica after a table
-    refresh; user errors propagate untouched on the first attempt."""
+    refresh; user errors propagate untouched on the first attempt.
+    Retry attempts are spaced by capped full-jitter backoff so a burst
+    of failed requests doesn't hammer the table refresh and the
+    surviving replicas in lockstep."""
     import time as _time
+
+    from ..core.config import GlobalConfig
+    from ..util.backoff import ExponentialBackoff
     deadline = _time.monotonic() + timeout_s
+    bo = ExponentialBackoff(base=GlobalConfig.serve_backoff_base_s,
+                            cap=GlobalConfig.serve_backoff_cap_s)
     for attempt in range(attempts):
         budget = max(0.1, deadline - _time.monotonic())
         ref, rid = router.assign_request(name, args, kwargs, method,
@@ -70,6 +78,8 @@ def call_with_retry(router, name: str, args, kwargs,
                     or _time.monotonic() >= deadline:
                 raise
             router._refresh(force=True)
+            _time.sleep(min(bo.next_delay(),
+                            max(0.0, deadline - _time.monotonic())))
         finally:
             router.complete(name, rid)
 

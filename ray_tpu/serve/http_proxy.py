@@ -49,8 +49,19 @@ class HTTPProxy:
             self._ready.set()
             return
 
+        from ..exceptions import ReplicaUnavailableError
+
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
+
+        def unavailable(e: ReplicaUnavailableError) -> "web.Response":
+            # graceful degradation: zero live replicas sheds fast as 503
+            # + Retry-After, so clients/load balancers back off instead
+            # of stacking doomed requests on a restarting deployment
+            return web.Response(
+                status=503, text=str(e),
+                headers={"Retry-After":
+                         str(max(1, int(round(e.retry_after_s))))})
 
         def make_call(name, payload):
             def call():
@@ -182,12 +193,16 @@ class HTTPProxy:
                         text="/stream needs a JSON object body")
                 try:
                     return await stream_tokens(request, name, payload)
+                except ReplicaUnavailableError as e:
+                    return unavailable(e)
                 except Exception as e:
                     return web.Response(status=500, text=str(e))
 
             try:
                 result = await loop.run_in_executor(
                     self._pool, make_call(name, payload))
+            except ReplicaUnavailableError as e:
+                return unavailable(e)
             except Exception as e:
                 return web.Response(status=500, text=str(e))
             if isinstance(result, (bytes, bytearray)):

@@ -80,10 +80,59 @@ class ServeReplica:
             tr["trace"] = spec.trace_id
         return tr
 
+    def _chaos_site(self, site: str) -> None:
+        """Chaos-layer hook for serve scenarios: the replica dies
+        mid-request (`crash`), fails the request (`error`), or stalls
+        (`latency`) — the router/handle retry path must keep these
+        invisible to callers."""
+        from ..util import fault_injection as fi
+        if fi.ACTIVE is None:
+            return
+        act = fi.ACTIVE.point(site, self.deployment_name)
+        if act is None:
+            return
+        if act["action"] == "crash":
+            import asyncio
+            import os
+
+            from ..core.worker_runtime import current_worker_runtime
+            rt = current_worker_runtime()
+            if act["once"]:
+                # claim through the controller (exactly one replica
+                # cluster-wide takes the hit); runs on an executor
+                # thread, so hop onto the worker's event loop
+                claimed = fi.local_claim(act["rule_id"])
+                if rt is not None and rt._loop is not None:
+                    try:
+                        claimed = asyncio.run_coroutine_threadsafe(
+                            rt._chaos_claim(act["rule_id"]),
+                            rt._loop).result(5)
+                    except Exception:
+                        pass
+                if not claimed:
+                    return
+            if rt is not None and rt._loop is not None:
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        rt.nodelet.notify(
+                            "chaos_injected",
+                            {"site": site, "action": "crash"}),
+                        rt._loop).result(2)
+                except Exception:
+                    pass
+            os._exit(fi.CRASH_EXIT_CODE)
+        if act["action"] in ("delay", "latency"):
+            time.sleep(max(0.0, act["delay_s"]))
+        elif act["action"] in ("error", "fail"):
+            raise RuntimeError(
+                f"chaos: injected {site} failure in "
+                f"{self.deployment_name}/{self.replica_id}")
+
     def handle_request(self, args: tuple, kwargs: Dict[str, Any],
                        method: Optional[str] = None) -> Any:
         from ..core.worker_runtime import current_task_spec
         from ..util import tracing
+        self._chaos_site("serve.request")
         tr = self._trace_args()
         spec = current_task_spec()
         now = time.time()
@@ -117,6 +166,7 @@ class ServeReplica:
                     "total": self._total}
 
     def health_check(self) -> bool:
+        self._chaos_site("serve.health_check")
         target = self._callable
         if not self._is_function and hasattr(target, "check_health"):
             target.check_health()

@@ -87,8 +87,22 @@ class Router:
                        method: Optional[str] = None,
                        timeout_s: float = 60.0):
         """Pick a non-saturated replica round-robin and return the result
-        ObjectRef; counts in-flight per replica."""
+        ObjectRef; counts in-flight per replica.
+
+        Graceful degradation: a deployment with ZERO live replicas sheds
+        the request immediately with the typed ReplicaUnavailableError
+        (confirmed against a force-refreshed table first) — holding it
+        until the deadline would just stack up doomed requests while the
+        deployment restarts.  When replicas exist but all are at their
+        in-flight cap, waits under capped exponential backoff with full
+        jitter instead of the old fixed 10 ms busy-poll."""
+        from ..core.config import GlobalConfig
+        from ..exceptions import ReplicaUnavailableError
+        from ..util.backoff import ExponentialBackoff
         deadline = time.monotonic() + timeout_s
+        bo = ExponentialBackoff(base=GlobalConfig.serve_backoff_base_s,
+                                cap=GlobalConfig.serve_backoff_cap_s)
+        confirmed_empty = False
         while True:
             self._refresh()
             with self._lock:
@@ -124,12 +138,23 @@ class Router:
                 ref = chosen["handle"].handle_request.remote(
                     args, kwargs, method)
                 return ref, chosen["id"]
+            if not replicas:
+                # unknown deployment or zero live replicas: one forced
+                # refresh guards against a stale table (deploy racing the
+                # poll TTL), then shed fast with the typed error
+                if confirmed_empty:
+                    raise ReplicaUnavailableError(name)
+                confirmed_empty = True
+                self._refresh(force=True)
+                continue
+            confirmed_empty = False
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"no replica available for {name!r} within "
                     f"{timeout_s}s")
             self._refresh(force=True)
-            time.sleep(0.01)
+            time.sleep(min(bo.next_delay(),
+                           max(0.0, deadline - time.monotonic())))
 
     def complete(self, name: str, replica_id: str) -> None:
         with self._lock:

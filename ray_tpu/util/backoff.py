@@ -1,0 +1,67 @@
+"""Capped exponential backoff with full jitter.
+
+One retry-delay policy for every reconnect/retry loop in the runtime
+(reference: the AWS architecture-blog "exponential backoff and jitter"
+full-jitter variant, which the reference's gcs_rpc_client reconnects and
+Serve router approximate).  Full jitter — ``uniform(0, min(cap, base *
+factor**attempt))`` — decorrelates a fleet of clients retrying against
+the same restarted server: a fixed delay (the old 20 ms in
+``rpc.connect``) wakes every nodelet and driver on the same tick and
+thundering-herds the controller the moment it comes back.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+
+class ExponentialBackoff:
+    """Stateful per-loop backoff: each ``next_delay()`` call advances the
+    attempt counter and samples a full-jitter delay.
+
+    The deterministic *envelope* (``envelope(n)``) grows monotonically
+    ``base * factor**n`` up to ``cap``; the sampled delay is uniform in
+    ``[0, envelope)``.  Pass ``rng`` for reproducible schedules (the
+    chaos suite does)."""
+
+    def __init__(self, base: float = 0.02, cap: float = 2.0,
+                 factor: float = 2.0,
+                 rng: Optional[random.Random] = None):
+        if base <= 0:
+            base = 1e-3
+        self.base = base
+        self.cap = max(cap, base)
+        self.factor = max(factor, 1.0)
+        self.attempt = 0
+        self._rng = rng or random
+
+    def envelope(self, attempt: Optional[int] = None) -> float:
+        """Upper bound of the delay for ``attempt`` (default: the next
+        one).  Monotone non-decreasing in ``attempt``, capped."""
+        n = self.attempt if attempt is None else attempt
+        # factor**n overflows for huge n; cap the exponent search instead
+        env = self.base
+        for _ in range(min(n, 64)):
+            env *= self.factor
+            if env >= self.cap:
+                return self.cap
+        return min(env, self.cap)
+
+    def next_delay(self) -> float:
+        """Sample the next full-jitter delay and advance the attempt."""
+        env = self.envelope()
+        self.attempt += 1
+        return self._rng.uniform(0.0, env)
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+    def sleep(self) -> float:
+        """Blocking convenience for sync retry loops; returns the delay
+        actually slept."""
+        d = self.next_delay()
+        if d > 0:
+            time.sleep(d)
+        return d

@@ -1,0 +1,297 @@
+"""Deterministic fault injection (chaos) layer.
+
+Failure is a first-class, *seeded* test input: a fault **plan** is a JSON
+list of rules
+
+    {"site": "rpc.send",                 # where to inject
+     "match": {"nth": 3} | {"prob": 0.1, "seed": 7} | {"regex": "hb.*"},
+     "action": "drop",                   # what to do (site-dependent)
+     "delay_s": 0.05,                    # for delay/latency + kill delays
+     "once": true,                       # fire once CLUSTER-wide (claimed
+                                         #   through the controller)
+     "max_fires": 2,                     # per-process fire cap
+     "proc": "worker"}                   # only in this process kind
+
+distributed to every process via the controller KV (namespace ``chaos``,
+pubsub channel ``chaos``, ``ray-tpu chaos apply``) or armed at bootstrap
+from the ``chaos_plan`` config flag (``RAY_TPU_CHAOS_PLAN``), which the
+existing config propagation ships to every spawned process.
+
+Matchers are deterministic: ``nth`` fires on the Nth *eligible* hit of
+the site in this process (regex filters which calls count as hits);
+``prob`` draws from a per-rule ``random.Random(seed)`` whose sequence
+replays identically run-to-run; ``regex`` matches the site key (RPC
+method, function name, deployment name, object id hex).
+
+Known sites (threaded through the runtime):
+
+==========================  =====================================================
+site                        actions
+==========================  =====================================================
+``rpc.send``                ``drop`` (frame lost), ``delay``, ``sever`` (close
+                            the connection), ``error`` (raise RpcError)
+``rpc.connect``             ``error``/``drop`` (connect refused), ``delay``
+``nodelet.lease``           ``kill_worker`` (kill the just-granted worker after
+                            ``delay_s`` — a gang/task worker dying mid-step)
+``nodelet.heartbeat``       any action blackholes that heartbeat (partition)
+``object.fetch_meta``       ``evict`` (drop the local copy + directory entry —
+                            forces lineage reconstruction at the puller)
+``worker.before_put``       ``crash`` (exit before the result reaches the
+                            store: the task retries and re-executes),
+                            ``delay``, ``error``
+``worker.after_put``        same, after the result put (retry must be
+                            idempotent against the already-stored object)
+``serve.request``           ``crash`` (replica dies mid-request), ``error``,
+                            ``delay``/``latency``
+``serve.health_check``      ``error`` (health check fails)
+==========================  =====================================================
+
+Zero-cost when disabled: every hot path guards with one module-level
+``None`` check (``fi.ACTIVE is not None``, or the ``_chaos`` hook the
+arm() call injects into ``core.rpc``/``core.worker_runtime``, which
+cannot import this package at module scope without a cycle).  Every
+injected fault increments ``ray_tpu_chaos_injected_total{site,action}``
+(the counter is registered only while the layer is armed, so a clean
+cluster's metrics never mention it) and records a ``chaos`` trace span
+so the cluster timeline shows the fault *and* the recovery around it.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import re
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..core.config import GlobalConfig
+from . import tracing
+
+CHAOS_KV_NS = "chaos"
+CHAOS_KV_KEY = b"plan"
+METRIC_NAME = "ray_tpu_chaos_injected_total"
+CRASH_EXIT_CODE = 170  # distinguishable from user exits in worker logs
+
+#: The armed plan, or None when the chaos layer is disabled.  Hot paths
+#: outside the import-cycle modules guard with ``fi.ACTIVE is not None``.
+ACTIVE: Optional["FaultPlan"] = None
+
+_lock = threading.Lock()
+_counter = None            # metrics.Counter, registered only while armed
+_local_claims: set = set()  # per-process fallback for `once` rules
+
+# Modules whose hot paths cannot import this package at module scope
+# (they sit below ray_tpu.util in the import graph); arm()/disarm() push
+# the plan into their `_chaos` module global instead.
+_HOOKED_MODULES = ("ray_tpu.core.rpc", "ray_tpu.core.worker_runtime")
+
+
+class FaultRule:
+    def __init__(self, idx: int, d: Dict[str, Any]):
+        self.site = d["site"]
+        self.action = d["action"]
+        m = d.get("match") or {}
+        self.nth = m.get("nth")
+        self.prob = m.get("prob")
+        self.regex = re.compile(m["regex"]) if m.get("regex") else None
+        self.seed = int(m.get("seed", d.get("seed", 0)))
+        self.delay_s = float(d.get("delay_s", 0.05))
+        self.max_fires = d.get("max_fires")
+        self.once = bool(d.get("once"))
+        self.proc = d.get("proc")
+        self.rule_id = d.get("id") or f"{self.site}#{idx}"
+        self._rng = random.Random(self.seed)
+        self.hits = 0
+        self.fires = 0
+
+    def matches(self, key: str, proc_kind: str) -> bool:
+        """One eligible hit of this rule's site; True when the fault
+        fires.  Order matters for determinism: the regex filters which
+        calls count as hits, then nth/prob decide on the hit sequence."""
+        if self.proc and self.proc != proc_kind:
+            return False
+        if self.regex is not None and not self.regex.search(key or ""):
+            return False
+        self.hits += 1
+        if self.once and self.fires >= 1:
+            return False
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.nth is not None:
+            wanted = self.nth if isinstance(self.nth, (list, tuple)) \
+                else (self.nth,)
+            if self.hits not in wanted:
+                return False
+        if self.prob is not None and self._rng.random() >= self.prob:
+            return False
+        self.fires += 1
+        return True
+
+    def to_act(self) -> Dict[str, Any]:
+        return {"action": self.action, "delay_s": self.delay_s,
+                "rule_id": self.rule_id, "once": self.once}
+
+
+class FaultPlan:
+    """Parsed plan; also the object injected into the hooked modules
+    (they call ``point``/``async_point`` on it directly)."""
+
+    def __init__(self, rules_json: List[Dict[str, Any]]):
+        self.raw = [dict(r) for r in rules_json]
+        self.rules: Dict[str, List[FaultRule]] = {}
+        for i, d in enumerate(rules_json):
+            r = FaultRule(i, d)
+            self.rules.setdefault(r.site, []).append(r)
+
+    def point(self, site: str, key: str = "") -> Optional[Dict[str, Any]]:
+        """Evaluate the plan at one injection site.  Returns the action
+        dict when a rule fires (counting the metric and recording a
+        trace span), else None.  Sync and loop-safe."""
+        rules = self.rules.get(site)
+        if not rules:
+            return None
+        kind = tracing._proc.get("kind", "")
+        for r in rules:
+            with _lock:
+                fired = r.matches(key, kind)
+            if fired:
+                _count(site, r.action)
+                now = time.time()
+                tracing.record_span(f"chaos::{site}", "chaos", now, now,
+                                    action=r.action, rule=r.rule_id,
+                                    key=key)
+                return r.to_act()
+        return None
+
+    async def async_point(self, site: str,
+                          key: str = "") -> Optional[Dict[str, Any]]:
+        """``point`` for async sites: delay/latency actions sleep here
+        (non-blocking); the action dict is returned either way so the
+        caller applies drop/sever/error semantics itself."""
+        act = self.point(site, key)
+        if act is not None and act["action"] in ("delay", "latency"):
+            import asyncio
+            await asyncio.sleep(max(0.0, act["delay_s"]))
+        return act
+
+
+# ----------------------------------------------------------- arm / disarm
+
+def arm(plan: Any) -> "FaultPlan":
+    """Arm the chaos layer in THIS process.  ``plan`` is the rule list
+    (or its JSON text).  Re-arming replaces the plan and resets rule
+    counters.  The plan is also written into GlobalConfig so processes
+    THIS one spawns or registers later inherit it (a nodelet's
+    register_worker reply ships its config snapshot — workers forked
+    after a runtime `chaos apply` must still arm)."""
+    global ACTIVE
+    if isinstance(plan, (str, bytes)):
+        plan = json.loads(plan)
+    fp = FaultPlan(list(plan))
+    with _lock:
+        ACTIVE = fp
+        _ensure_counter()
+    try:
+        GlobalConfig.update({"chaos_plan": json.dumps(fp.raw)})
+    except KeyError:
+        pass
+    _sync_hooks(fp)
+    return fp
+
+
+def disarm() -> None:
+    """Disable the layer and deregister its counter — a disabled cluster's
+    metrics must not even mention the chaos metric."""
+    global ACTIVE, _counter
+    with _lock:
+        ACTIVE = None
+        if _counter is not None:
+            from .. import metrics
+            with metrics._lock:
+                metrics._registry.pop(METRIC_NAME, None)
+            _counter = None
+        _local_claims.clear()
+    try:
+        import os
+        GlobalConfig.update({"chaos_plan": ""})
+        os.environ.pop("RAY_TPU_CHAOS_PLAN", None)
+    except KeyError:
+        pass
+    _sync_hooks(None)
+
+
+def maybe_arm_from_config() -> None:
+    """Arm from the ``chaos_plan`` config flag (env-propagated to every
+    spawned process) — no-op when empty or when already armed (so a late
+    lazy CoreClient never resets a live plan's counters)."""
+    if ACTIVE is not None:
+        return
+    raw = getattr(GlobalConfig, "chaos_plan", "") or ""
+    if not raw:
+        return
+    try:
+        arm(raw)
+    except (ValueError, KeyError) as e:
+        print(f"WARNING: ignoring malformed chaos plan: {e}",
+              file=sys.stderr, flush=True)
+
+
+def _sync_hooks(fp: Optional["FaultPlan"]) -> None:
+    for name in _HOOKED_MODULES:
+        mod = sys.modules.get(name)
+        if mod is not None:
+            mod._chaos = fp
+
+
+def plan_snapshot() -> Optional[List[Dict[str, Any]]]:
+    return list(ACTIVE.raw) if ACTIVE is not None else None
+
+
+# ------------------------------------------------------------------ metric
+
+def _ensure_counter():
+    global _counter
+    if _counter is None:
+        from .. import metrics
+        _counter = metrics.Counter(
+            METRIC_NAME,
+            "Faults injected by the chaos layer", ("site", "action"))
+    return _counter
+
+
+def _count(site: str, action: str) -> None:
+    c = _ensure_counter()
+    c.inc(tags={"site": site, "action": action})
+
+
+def count_injection(site: str, action: str) -> None:
+    """Record an injection observed REMOTELY (a crashing worker's
+    last-gasp notify lands in its nodelet's registry — worker registries
+    are never scraped, and the process is gone a millisecond later)."""
+    _count(site, action)
+
+
+def injected_counts() -> Dict[str, float]:
+    """site|action -> count for this process (chaos status CLI)."""
+    if _counter is None:
+        return {}
+    return {"|".join(k): v for k, v in _counter._samples()}
+
+
+# ------------------------------------------------------------- once claims
+
+def local_claim(rule_id: str) -> bool:
+    """Per-process `once` fallback when no controller is reachable."""
+    with _lock:
+        if rule_id in _local_claims:
+            return False
+        _local_claims.add(rule_id)
+        return True
+
+
+def chaos_env(plan: List[Dict[str, Any]]) -> Dict[str, str]:
+    """Env block that arms spawned processes with ``plan`` (the
+    cluster_utils / add_node(env=...) plumbing)."""
+    return {"RAY_TPU_CHAOS_PLAN": json.dumps(plan)}

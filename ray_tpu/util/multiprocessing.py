@@ -1,20 +1,43 @@
 """multiprocessing.Pool over runtime tasks (reference:
-`python/ray/util/multiprocessing/pool.py`)."""
+`python/ray/util/multiprocessing/pool.py`).
+
+Result waits are bounded: every ``get`` runs under a configurable
+timeout (``mp_pool_default_timeout_s``, default 600 s, or the Pool's
+``default_timeout_s`` override) and raises the typed ``GetTimeoutError``
+— a lost result (worker crashed past its retries, object unreachable)
+fails the caller promptly instead of hanging the pool."""
 
 from __future__ import annotations
 
 from typing import Any, Callable, Iterable, List, Optional
 
 from .. import api
+from ..core.config import GlobalConfig
+
+
+def _resolve_timeout(timeout: Optional[float],
+                     default: Optional[float] = None) -> float:
+    if timeout is not None:
+        return timeout
+    if default is not None:
+        return default
+    return GlobalConfig.mp_pool_default_timeout_s
 
 
 class AsyncResult:
-    def __init__(self, refs, single: bool):
+    def __init__(self, refs, single: bool,
+                 default_timeout_s: Optional[float] = None):
         self._refs = refs
         self._single = single
+        self._default_timeout_s = default_timeout_s
 
     def get(self, timeout: Optional[float] = None):
-        out = api.get(self._refs, timeout=timeout or 600.0)
+        """Raises GetTimeoutError when the results don't arrive within
+        ``timeout`` (default: the pool's / the mp_pool_default_timeout_s
+        config)."""
+        out = api.get(self._refs,
+                      timeout=_resolve_timeout(timeout,
+                                               self._default_timeout_s))
         return out[0] if self._single else out
 
     def ready(self) -> bool:
@@ -28,10 +51,16 @@ class AsyncResult:
 
 class Pool:
     """Process pool on cluster tasks; `processes` caps concurrency only in
-    the scheduler sense (tasks queue beyond it)."""
+    the scheduler sense (tasks queue beyond it).  ``default_timeout_s``
+    overrides the config-level result-wait bound for this pool."""
 
-    def __init__(self, processes: Optional[int] = None):
+    def __init__(self, processes: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None):
         self._task = api.remote(_call)
+        self._default_timeout_s = default_timeout_s
+
+    def _timeout(self, timeout: Optional[float] = None) -> float:
+        return _resolve_timeout(timeout, self._default_timeout_s)
 
     def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
         return self.apply_async(fn, args, kwds).get()
@@ -41,7 +70,8 @@ class Pool:
         from ..core.serialization import dumps_function
         blob = dumps_function(fn)
         return AsyncResult([self._task.remote(blob, args, kwds or {})],
-                           single=True)
+                           single=True,
+                           default_timeout_s=self._default_timeout_s)
 
     def map(self, fn: Callable, iterable: Iterable[Any]) -> List[Any]:
         return self.map_async(fn, iterable).get()
@@ -51,21 +81,22 @@ class Pool:
         from ..core.serialization import dumps_function
         blob = dumps_function(fn)
         refs = [self._task.remote(blob, (x,), {}) for x in iterable]
-        return AsyncResult(refs, single=False)
+        return AsyncResult(refs, single=False,
+                           default_timeout_s=self._default_timeout_s)
 
     def imap(self, fn: Callable, iterable: Iterable[Any]):
         from ..core.serialization import dumps_function
         blob = dumps_function(fn)
         refs = [self._task.remote(blob, (x,), {}) for x in iterable]
         for r in refs:
-            yield api.get(r, timeout=600.0)
+            yield api.get(r, timeout=self._timeout())
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple]) -> List[Any]:
         from ..core.serialization import dumps_function
         blob = dumps_function(fn)
         refs = [self._task.remote(blob, tuple(args), {})
                 for args in iterable]
-        return api.get(refs, timeout=600.0)
+        return api.get(refs, timeout=self._timeout())
 
     def close(self) -> None:
         pass

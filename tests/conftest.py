@@ -86,6 +86,10 @@ except Exception:
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test on a fresh event loop")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multi-process scenario excluded from tier-1 "
+        "(-m 'not slow'); `make chaos` runs them")
 
 
 @pytest.hookimpl(tryfirst=True)

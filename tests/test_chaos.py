@@ -1,0 +1,401 @@
+"""Chaos suite: deterministic fault injection + verified end-to-end recovery.
+
+The fault plans here are SEEDED and plan-driven (util/fault_injection.py):
+every scenario runs twice with the same seeds (parametrized ``run``) and
+must behave identically — injection is a test input, not luck.  The heavy
+multi-process scenarios are marked ``slow`` (run them via ``make chaos``);
+one fast worker-crash scenario stays tier-1.
+
+Recovery scenarios proven end-to-end:
+
+1. train gang worker killed mid-step  -> FailureConfig restart-from-
+   checkpoint converges                          (test_chaos_train_*)
+2. serve replica killed under traffic -> bounded retries, zero
+   user-visible failures                         (test_chaos_serve_*)
+3. controller killed+restarted mid task wave -> every task completes
+   (chaos variant lives in test_controller_ft.py)
+4. object evicted during pull         -> lineage reconstruction
+   succeeds                                      (test_chaos_object_*)
+"""
+
+import json
+import os
+import random
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import GlobalConfig
+from ray_tpu.util import fault_injection as fi
+from ray_tpu.util.backoff import ExponentialBackoff
+
+slow = pytest.mark.slow
+
+
+@pytest.fixture
+def chaos_cleanup():
+    """Disarm + scrub the env after a test, whatever it did."""
+    yield
+    fi.disarm()
+    GlobalConfig.update({"chaos_plan": ""})
+    os.environ.pop("RAY_TPU_CHAOS_PLAN", None)
+
+
+def _arm_env(plan):
+    """Arm via config/env, as a production `RAY_TPU_CHAOS_PLAN=` boot
+    would — every process the runtime spawns inherits it."""
+    GlobalConfig.update({"chaos_plan": json.dumps(plan)})
+
+
+# ------------------------------------------------------------ backoff units
+
+def test_backoff_envelope_monotone_and_capped():
+    bo = ExponentialBackoff(base=0.01, cap=0.5)
+    envs = [bo.envelope(n) for n in range(16)]
+    assert envs == sorted(envs), "envelope must grow monotonically"
+    assert envs[0] == pytest.approx(0.01)
+    assert envs[-1] == 0.5, "envelope must saturate at the cap"
+    # the cap is reached and never exceeded even for huge attempts
+    assert bo.envelope(10_000) == 0.5
+
+
+def test_backoff_full_jitter_bounds_and_determinism():
+    bo = ExponentialBackoff(base=0.01, cap=0.25, rng=random.Random(7))
+    delays = [bo.next_delay() for _ in range(32)]
+    ref = ExponentialBackoff(base=0.01, cap=0.25)
+    for i, d in enumerate(delays):
+        assert 0.0 <= d <= ref.envelope(i) + 1e-12
+    # same seed -> same schedule (the chaos suite's reproducibility hook)
+    bo2 = ExponentialBackoff(base=0.01, cap=0.25, rng=random.Random(7))
+    assert delays == [bo2.next_delay() for _ in range(32)]
+    # jitter actually jitters: not all samples equal
+    assert len({round(d, 9) for d in delays}) > 5
+
+
+def test_backoff_degenerate_inputs():
+    bo = ExponentialBackoff(base=0.0, cap=0.0)
+    assert 0.0 <= bo.next_delay() <= bo.cap
+    assert bo.cap >= bo.base > 0.0
+
+
+# -------------------------------------------------------- fault-plan units
+
+def test_fault_rule_nth_with_regex_filter(chaos_cleanup):
+    plan = fi.FaultPlan([{"site": "s", "match": {"nth": 3, "regex": "^foo"},
+                          "action": "error"}])
+    decisions = [plan.point("s", k)
+                 for k in ["bar", "foo", "foo2", "foo", "foo"]]
+    # "bar" is filtered out by the regex, so hits are foo/foo2/foo/foo
+    # and the 3rd eligible hit fires
+    assert [d["action"] if d else None for d in decisions] == \
+        [None, None, None, "error", None]
+
+
+def test_fault_rule_prob_is_seed_deterministic(chaos_cleanup):
+    def decisions():
+        plan = fi.FaultPlan([{"site": "s", "match": {"prob": 0.3,
+                                                     "seed": 42},
+                              "action": "drop"}])
+        return [plan.point("s", "k") is not None for _ in range(200)]
+
+    a, b = decisions(), decisions()
+    assert a == b, "same seed must replay the same injection sequence"
+    assert 20 < sum(a) < 120  # ~0.3 of 200, loosely bounded
+
+
+def test_fault_rule_max_fires_and_proc_filter(chaos_cleanup):
+    plan = fi.FaultPlan([{"site": "s", "action": "error", "max_fires": 2}])
+    fired = sum(plan.point("s", "") is not None for _ in range(10))
+    assert fired == 2
+    # proc filter: this test process is not a "nodelet"
+    plan2 = fi.FaultPlan([{"site": "s", "action": "error",
+                           "proc": "nodelet"}])
+    assert all(plan2.point("s", "") is None for _ in range(5))
+
+
+def test_disabled_layer_injects_nothing_and_registers_no_counter(
+        chaos_cleanup):
+    from ray_tpu import metrics
+    from ray_tpu.core import rpc, worker_runtime
+    assert fi.ACTIVE is None
+    assert rpc._chaos is None and worker_runtime._chaos is None
+    assert fi.METRIC_NAME not in metrics.prometheus_text()
+    fi.arm([{"site": "s", "match": {"nth": 1}, "action": "error"}])
+    assert rpc._chaos is fi.ACTIVE is not None
+    assert fi.ACTIVE.point("s", "") is not None
+    assert fi.METRIC_NAME in metrics.prometheus_text()
+    fi.disarm()
+    assert fi.ACTIVE is None and rpc._chaos is None
+    assert fi.METRIC_NAME not in metrics.prometheus_text(), \
+        "a disarmed layer must deregister its counter entirely"
+
+
+async def test_rpc_send_drop_then_recover(chaos_cleanup):
+    """In-process RPC pair: the first `echo` frame is dropped (call times
+    out), the second goes through — and the injection is metered."""
+    import asyncio
+
+    from ray_tpu.core import rpc
+
+    async def echo(conn, data):
+        return data
+
+    server = rpc.RpcServer("127.0.0.1", 0)
+    server.register("echo", echo)
+    await server.start()
+    conn = await rpc.connect("127.0.0.1", server.port)
+    try:
+        fi.arm([{"site": "rpc.send", "match": {"nth": 1, "regex": "^echo$"},
+                 "action": "drop"}])
+        with pytest.raises(asyncio.TimeoutError):
+            await conn.call("echo", 1, timeout=0.3)
+        assert await conn.call("echo", 2, timeout=10) == 2
+        assert fi.injected_counts().get("rpc.send|drop") == 1.0
+    finally:
+        await conn.close()
+        await server.stop()
+
+
+async def test_rpc_send_sever_closes_connection(chaos_cleanup):
+    from ray_tpu.core import rpc
+
+    async def echo(conn, data):
+        return data
+
+    server = rpc.RpcServer("127.0.0.1", 0)
+    server.register("echo", echo)
+    await server.start()
+    conn = await rpc.connect("127.0.0.1", server.port)
+    try:
+        fi.arm([{"site": "rpc.send", "match": {"nth": 1, "regex": "^echo$"},
+                 "action": "sever"}])
+        with pytest.raises(rpc.ConnectionLost):
+            await conn.call("echo", 1, timeout=5)
+        assert conn.closed
+    finally:
+        await conn.close()
+        await server.stop()
+
+
+# ------------------------------------------- tier-1 fast recovery scenario
+
+@pytest.mark.parametrize("run", [1, 2])
+def test_chaos_worker_crash_before_put_retries(chaos_cleanup, run):
+    """Deterministic fast scenario (tier-1): the first execution of the
+    task crashes its worker just before the result put; the driver's
+    retry re-executes it on a fresh worker and the caller never sees the
+    fault.  The injection lands in cluster_metrics_text via the
+    crashing worker's last-gasp report to its nodelet."""
+    _arm_env([{"site": "worker.before_put",
+               "match": {"nth": 1, "regex": "chaos_flaky"},
+               "action": "crash", "once": True}])
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(max_retries=3)
+        def chaos_flaky():
+            return 42
+
+        assert ray_tpu.get(chaos_flaky.remote(), timeout=120.0) == 42
+        from ray_tpu import state
+        text = state.cluster_metrics_text()
+        assert fi.METRIC_NAME in text
+        assert 'site="worker.before_put"' in text
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_chaos_crash_after_put_is_idempotent(chaos_cleanup):
+    """Crash AFTER the result put: the object is already in the store
+    when the retry re-executes — the second put must be a no-op, not an
+    error (pins down the at-least-once retry semantics)."""
+    _arm_env([{"site": "worker.after_put",
+               "match": {"nth": 1, "regex": "big_result"},
+               "action": "crash", "once": True}])
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(max_retries=3)
+        def big_result():
+            # > max_direct_call_object_size so the result goes through
+            # the shared-memory store (the non-idempotence hazard)
+            return b"x" * (256 * 1024)
+
+        out = ray_tpu.get(big_result.remote(), timeout=120.0)
+        assert len(out) == 256 * 1024
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_mp_pool_get_timeout_is_typed_and_configurable():
+    """Satellite: pool result waits are bounded and raise the typed
+    GetTimeoutError (per-pool override or the
+    mp_pool_default_timeout_s config) instead of hanging 10 minutes on
+    a result that will never arrive."""
+    from ray_tpu.exceptions import GetTimeoutError
+    from ray_tpu.util.multiprocessing import Pool
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        with Pool(default_timeout_s=1.0) as p:
+            r = p.apply_async(lambda: __import__("time").sleep(8))
+            t0 = time.monotonic()
+            with pytest.raises(GetTimeoutError):
+                r.get()
+            assert time.monotonic() - t0 < 6.0
+        GlobalConfig.update({"mp_pool_default_timeout_s": 1.0})
+        try:
+            with Pool() as p:
+                r = p.apply_async(lambda: __import__("time").sleep(8))
+                with pytest.raises(GetTimeoutError):
+                    r.get()
+                # an explicit timeout still wins over both defaults
+                assert p.apply_async(lambda: 7).get(timeout=30.0) == 7
+        finally:
+            GlobalConfig.update({"mp_pool_default_timeout_s": 600.0})
+    finally:
+        ray_tpu.shutdown()
+
+
+# -------------------------------------------------- serve graceful shedding
+
+def test_serve_zero_replicas_sheds_fast_with_503(chaos_cleanup):
+    """Satellite: a deployment with zero live replicas raises the typed
+    ReplicaUnavailableError immediately (no deadline busy-poll) and the
+    HTTP proxy maps it to 503 + Retry-After."""
+    import requests
+
+    from ray_tpu import serve
+    from ray_tpu.exceptions import ReplicaUnavailableError
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        serve.start()
+
+        @serve.deployment(num_replicas=0)
+        def empty(x=None):
+            return x
+
+        handle = serve.run(empty, name="empty", route_prefix="/empty")
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaUnavailableError):
+            handle.remote(1)
+        assert time.monotonic() - t0 < 10.0, \
+            "zero-replica shed must not busy-poll out the deadline"
+        addr = serve.api.http_address()
+        r = requests.post(f"{addr}/empty", json={}, timeout=30)
+        assert r.status_code == 503
+        assert "Retry-After" in r.headers
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+@slow
+@pytest.mark.parametrize("run", [1, 2])
+def test_chaos_serve_replica_killed_under_traffic(chaos_cleanup, run):
+    """Recovery scenario 2: one of two replicas crashes mid-request (the
+    `once` rule is claimed through the controller, so exactly one dies).
+    Every request still succeeds — the handle's bounded, jitter-backed
+    retries re-route around the dead replica until the controller heals
+    it."""
+    _arm_env([{"site": "serve.request",
+               "match": {"nth": 3, "regex": "^victim$"},
+               "action": "crash", "once": True}])
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        from ray_tpu import serve
+
+        serve.start()
+
+        @serve.deployment(num_replicas=2)
+        def victim(x=None):
+            return {"ok": x}
+
+        handle = serve.run(victim, name="victim")
+        for i in range(12):
+            assert handle.remote(i).result(timeout_s=60.0) == {"ok": i}, \
+                f"request {i} leaked a replica failure to the caller"
+        from ray_tpu import state
+        text = state.cluster_metrics_text()
+        assert fi.METRIC_NAME in text
+        assert 'site="serve.request"' in text
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------------- object eviction -> reconstruction
+
+@slow
+@pytest.mark.parametrize("run", [1, 2])
+def test_chaos_object_evicted_during_pull_reconstructs(run):
+    """Recovery scenario 4: the only copy of a task result is force-
+    evicted from its node exactly when the driver's pull asks for it;
+    lineage reconstruction re-executes the producing task and the get
+    still returns the value."""
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(chaos_plan=[{"site": "object.fetch_meta",
+                                   "match": {"nth": 1},
+                                   "action": "evict"}])
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2, resources={"side": 1.0})
+        cluster.connect()
+
+        @ray_tpu.remote(resources={"side": 1.0}, max_retries=3)
+        def produce():
+            import numpy as np
+            return np.arange(64_000, dtype=np.int64)
+
+        out = ray_tpu.get(produce.remote(), timeout=120.0)
+        assert out.shape == (64_000,)
+        assert int(out[-1]) == 63_999
+    finally:
+        cluster.shutdown()
+
+
+# ------------------------------------------------- train gang FT scenario
+
+@slow
+@pytest.mark.parametrize("run", [1, 2])
+def test_chaos_train_worker_killed_mid_step_recovers(chaos_cleanup, run,
+                                                     tmp_path):
+    """Recovery scenario 1: a train-gang worker is chaos-killed mid-run;
+    FailureConfig restarts the attempt FROM THE LAST CHECKPOINT and the
+    run converges — without re-running the whole schedule."""
+    _arm_env([{"site": "worker.before_put",
+               "match": {"nth": 3, "regex": "next_result"},
+               "action": "crash", "once": True}])
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        from ray_tpu.air import session
+        from ray_tpu.air.checkpoint import Checkpoint
+        from ray_tpu.air.config import (FailureConfig, RunConfig,
+                                        ScalingConfig)
+        from ray_tpu.train.backend import BackendConfig
+        from ray_tpu.train.trainer import JaxTrainer
+
+        def train_loop(config):
+            ckpt = session.get_checkpoint()
+            start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+            for step in range(start, 6):
+                session.report(
+                    {"step": step, "loss": 1.0 / (step + 1)},
+                    checkpoint=Checkpoint.from_dict({"step": step}))
+
+        trainer = JaxTrainer(
+            train_loop,
+            scaling_config=ScalingConfig(num_workers=2,
+                                         resources_per_worker={"CPU": 0.5}),
+            backend_config=BackendConfig(),
+            run_config=RunConfig(name=f"chaos_train_{run}",
+                                 storage_path=str(tmp_path),
+                                 failure_config=FailureConfig(
+                                     max_failures=2)))
+        result = trainer.fit()
+        assert result.error is None, f"training did not recover: {result.error}"
+        assert result.metrics.get("step") == 5
+        assert result.checkpoint is not None
+        # the restart resumed from a checkpoint: strictly fewer reports
+        # than two from-scratch runs would produce
+        assert 0 < len(result.metrics_history) < 12
+    finally:
+        ray_tpu.shutdown()

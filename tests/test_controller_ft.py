@@ -90,6 +90,45 @@ def test_controller_restart_keeps_actors_pgs_kv():
         cluster.shutdown()
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("run", [1, 2])
+def test_chaos_controller_restart_with_tasks_in_flight(run):
+    """Chaos variant (recovery scenario 3): the controller is killed and
+    restarted while a wave of tasks is EXECUTING, with a seeded fault
+    plan making every nodelet reconnect attempt flaky (25% injected
+    connect failures) — the jittered-backoff redial loops must still
+    converge, every in-flight task must complete, and the control plane
+    must schedule new work afterwards."""
+    plan = [{"site": "rpc.connect", "match": {"prob": 0.25, "seed": 1234},
+             "action": "error", "proc": "nodelet"}]
+    cluster = Cluster(chaos_plan=plan)
+    try:
+        cluster.add_node(num_cpus=4)
+        cluster.connect()
+
+        @ray_tpu.remote
+        def slow_inc(x):
+            import time as _t
+            _t.sleep(0.4)
+            return x + 1
+
+        # Warm one execution so the wave is mid-flight work, not setup.
+        assert ray_tpu.get(slow_inc.remote(0), timeout=60.0) == 1
+        refs = [slow_inc.remote(i) for i in range(10)]
+        time.sleep(0.3)  # let the wave reach the workers
+        cluster.kill_controller()
+        time.sleep(0.5)
+        cluster.restart_controller()
+        assert ray_tpu.get(refs, timeout=180.0) == list(range(1, 11))
+        # control plane fully live again: fresh tasks schedule and the
+        # nodes re-registered through their (chaos-flaky) reconnects
+        refs2 = [slow_inc.remote(i) for i in range(4)]
+        assert ray_tpu.get(refs2, timeout=120.0) == [1, 2, 3, 4]
+        _wait_nodes(1)
+    finally:
+        cluster.shutdown()
+
+
 def test_wal_snapshot_roundtrip(tmp_path):
     """Unit: snapshot + WAL replay reproduce the tables, torn tails are
     discarded."""
