@@ -2,7 +2,7 @@
 # tree): native object store + transfer plane, C++ driver API, wheel.
 PY ?= python
 
-.PHONY: all native cpp wheel test bench obs chaos clean
+.PHONY: all native cpp wheel test bench obs chaos drain clean
 
 all: native cpp
 
@@ -35,6 +35,12 @@ obs:
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py \
 		tests/test_controller_ft.py -q
+
+# Drain suite: graceful-node-drain units + end-to-end phased
+# evacuation, including the `slow` chaos variants (drain under serve
+# traffic, injected evacuation failure -> lineage fallback).
+drain:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_drain.py -q
 
 bench:
 	$(PY) bench.py

@@ -17,8 +17,10 @@ that launches ``ray-tpu start --address <head>`` on every host.
 from __future__ import annotations
 
 import json
+import os
 import shlex
 import subprocess
+import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from .node_provider import NodeProvider
@@ -104,6 +106,30 @@ class TpuPodProvider(NodeProvider):
                     self.name_prefix)
                 and n.get("state") in ("READY", "CREATING", None)]
 
+    # -- maintenance notices --------------------------------------------------
+    def maintenance_notices(self) -> List[Dict[str, Any]]:
+        """Upcoming-maintenance notices for our slices.  Cloud TPU
+        announces host maintenance through the VM metadata server /
+        `upcoming-maintenance` and queued-resource state; here we read
+        the slice descriptions and surface any with a scheduled event.
+        Tests (and air-gapped runs) inject notices via
+        ``RAY_TPU_MAINT_NOTICE_FILE`` instead (MaintenanceWatcher)."""
+        out = self._run([
+            "compute", "tpus", "tpu-vm", "list",
+            "--project", self.project, "--zone", self.zone,
+            "--format", "json",
+        ])
+        notices = []
+        for n in json.loads(out or "[]"):
+            name = n.get("name", "").rsplit("/", 1)[-1]
+            if not name.startswith(self.name_prefix):
+                continue
+            window = (n.get("scheduling") or {}).get("upcomingMaintenance") \
+                or n.get("upcomingMaintenance")
+            if window:
+                notices.append({"host": name, "window": window})
+        return notices
+
     # -- wiring ---------------------------------------------------------------
     def _startup_script(self, nt: Dict[str, Any]) -> str:
         """Every host of the slice joins the cluster as a nodelet; the
@@ -113,3 +139,124 @@ class TpuPodProvider(NodeProvider):
         join = (f"ray-tpu start --address "
                 f"{shlex.quote(self.head_address)}")
         return "#! /bin/bash\n" + "\n".join([*extra, join]) + "\n"
+
+
+class MaintenanceWatcher:
+    """Turns announced TPU departures into graceful drains.
+
+    Polls a notice source and issues ``drain_node`` to the controller
+    for every affected node — so a maintenance event or preemption with
+    60 s of warning becomes a phased evacuation instead of a crash the
+    lineage machinery has to mop up.
+
+    Notice source (injectable): ``fetch_notices()`` returns a list of
+    dicts, each naming a node by ``node_id`` (controller hex id) or by
+    ``host`` (matched against the node's address or hostname label).
+    The default source reads a JSON file named by
+    ``RAY_TPU_MAINT_NOTICE_FILE`` — the hook both tests and external
+    notice daemons (metadata-server watchers) use; a provider's
+    ``maintenance_notices`` can be passed directly as the fetcher."""
+
+    def __init__(self, controller_addr: str,
+                 fetch_notices: Optional[Callable[[], List[Dict[str, Any]]]]
+                 = None,
+                 drain_fn: Optional[Callable[[str, Optional[float]], Any]]
+                 = None,
+                 drain_timeout_s: Optional[float] = None):
+        self.controller_addr = controller_addr
+        self._fetch = fetch_notices or self._fetch_from_file
+        self._drain = drain_fn or self._drain_via_controller
+        self.drain_timeout_s = drain_timeout_s
+        self._drained: set = set()     # node ids already handed a drain
+        self._client = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- notice sources -------------------------------------------------------
+    @staticmethod
+    def _fetch_from_file() -> List[Dict[str, Any]]:
+        path = os.environ.get("RAY_TPU_MAINT_NOTICE_FILE")
+        if not path or not os.path.exists(path):
+            return []
+        try:
+            with open(path) as f:
+                notices = json.load(f)
+            return list(notices) if isinstance(notices, list) else []
+        except (OSError, ValueError):
+            return []
+
+    # -- controller plumbing --------------------------------------------------
+    def _conn(self):
+        if self._client is None:
+            from ..core import rpc
+            host, port = self.controller_addr.rsplit(":", 1)
+            lt = rpc.EventLoopThread("maint-watcher-io")
+            self._client = rpc.BlockingClient.connect(
+                lt, host, int(port), retries=10)
+        return self._client
+
+    def _list_nodes(self) -> List[Dict[str, Any]]:
+        return self._conn().call("list_nodes", {}, timeout=10)
+
+    def _drain_via_controller(self, node_id: str,
+                              timeout_s: Optional[float]):
+        budget = timeout_s or 600.0
+        return self._conn().call(
+            "drain_node", {"node_id": node_id, "timeout_s": timeout_s,
+                           "wait": False}, timeout=budget + 30)
+
+    def _resolve(self, notice: Dict[str, Any]) -> Optional[str]:
+        nid = notice.get("node_id")
+        if nid:
+            return nid
+        host = notice.get("host")
+        if not host:
+            return None
+        for n in self._list_nodes():
+            if not n.get("alive"):
+                continue
+            if n.get("addr", "").split(":")[0] == host \
+                    or (n.get("labels") or {}).get("hostname") == host:
+                return n["id"]
+        return None
+
+    # -- the watch loop -------------------------------------------------------
+    def poll_once(self) -> List[str]:
+        """One notice sweep; returns the node ids newly handed a drain."""
+        drained = []
+        for notice in self._fetch():
+            try:
+                node_id = self._resolve(notice)
+            except Exception:
+                continue
+            if node_id is None or node_id in self._drained:
+                continue
+            timeout = notice.get("timeout_s", self.drain_timeout_s)
+            try:
+                self._drain(node_id, timeout)
+            except Exception:
+                continue  # controller unreachable: retry next poll
+            self._drained.add(node_id)
+            drained.append(node_id)
+        return drained
+
+    def start(self, interval_s: Optional[float] = None) -> None:
+        from ..core.config import GlobalConfig
+        period = interval_s or GlobalConfig.maintenance_poll_interval_s
+
+        def loop():
+            while not self._stop.wait(period):
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass  # the watcher must never die
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="maintenance-watcher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -213,6 +213,17 @@ _d("mp_pool_default_timeout_s", float, 600.0,
    "Default result timeout for util.multiprocessing Pool gets; raises "
    "the typed GetTimeoutError instead of hanging a pool on a result "
    "that will never arrive.")
+_d("drain_timeout_s", float, 30.0,
+   "Default deadline for a graceful node drain (lease stop, object "
+   "evacuation, actor migration, in-flight task wait).  On overrun the "
+   "controller falls back to the hard-death path — lineage/restart "
+   "recovery is the safety net, not the plan.")
+_d("drain_poll_interval_s", float, 0.2,
+   "How often the drain orchestrator polls the draining nodelet for "
+   "in-flight work while waiting for it to quiesce.")
+_d("maintenance_poll_interval_s", float, 10.0,
+   "Period of the autoscaler's maintenance-notice watcher "
+   "(tpu_pod_provider.MaintenanceWatcher) between notice polls.")
 
 # --- TPU / accelerator ------------------------------------------------------
 _d("tpu_autodetect", bool, True, "Detect local TPU chips via JAX at node start.")

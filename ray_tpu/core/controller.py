@@ -33,6 +33,11 @@ RESTARTING = "RESTARTING"
 DEAD = "DEAD"
 
 
+class _DrainDeadline(Exception):
+    """Internal: the graceful-drain budget ran out (or the chaos layer
+    forced an overrun) — fall back to the hard-death recovery path."""
+
+
 class ActorRecord:
     def __init__(self, actor_id: bytes, spec: dict, name: Optional[str],
                  max_restarts: int, detached: bool):
@@ -113,6 +118,13 @@ class Controller:
         self.ref_stats = {"lineage_evictions": 0, "deferred_frees": 0,
                           "cascade_frees": 0}
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}  # channel -> conns
+        # node drains in progress: node_id -> live progress dict (phase,
+        # in-flight count, objects left) surfaced via list_nodes
+        self.draining: Dict[str, Dict[str, Any]] = {}
+        self._drain_tasks: Dict[str, asyncio.Task] = {}
+        # actor_ids mid-migration off a draining node: the old worker's
+        # death is intended and must not burn restart budget
+        self._migrating: Set[bytes] = set()
         self.view_version = 0
         self.config_snapshot: Dict[str, Any] = {}
         self.jobs: Dict[bytes, dict] = {}
@@ -176,6 +188,7 @@ class Controller:
             "pgs": {pg.pg_id: self._pg_to_disk(pg)
                     for pg in self.pgs.values()},
             "jobs": {jid: info for jid, info in self.jobs.items()},
+            "draining_nodes": list(self.draining),
         }
 
     def _restore(self, state: Optional[dict]) -> None:
@@ -205,6 +218,12 @@ class Controller:
             pg.node_ids = list(d.get("node_ids", []))
             self.pgs[pg.pg_id] = pg
         self.jobs = dict(state.get("jobs", {}))
+        # drains interrupted by our restart: keep the nodes out of the
+        # placement pool; the orchestration resumes (with a fresh default
+        # budget) when each nodelet re-registers
+        for nid in state.get("draining_nodes", []):
+            self.draining[nid] = {"phase": "restored", "in_flight": -1,
+                                  "objects_left": -1}
 
     # ------------------------------------------------------------------ setup
     def _register_handlers(self):
@@ -364,6 +383,14 @@ class Controller:
         self.nodes[data["node_id"]] = NodeRecord(view, conn)
         conn.peer_info["node_id"] = data["node_id"]
         conn.on_close = self._node_conn_closed
+        if data["node_id"] in self.draining:
+            # re-registration of a node whose drain our restart (or a
+            # dropped connection) interrupted: stay out of the placement
+            # pool and resume the drain with a fresh default budget
+            view.draining = True
+            if data["node_id"] not in self._drain_tasks:
+                self._start_drain(data["node_id"],
+                                  GlobalConfig.drain_timeout_s)
         self._bump_view(data["node_id"])
         self.config_snapshot.update(data.get("config") or {})
         await self._broadcast("nodes", {"event": "added", "node": view.to_wire()})
@@ -418,12 +445,208 @@ class Controller:
     async def _h_list_nodes(self, conn, data):
         # demand rides the node ROWS, not the synced views — it churns
         # every heartbeat and would bloat the versioned delta stream
-        return [{**rec.view.to_wire(), "demand": rec.demand}
-                for rec in self.nodes.values()]
+        out = []
+        for rec in self.nodes.values():
+            row = {**rec.view.to_wire(), "demand": rec.demand}
+            row["state"] = ("DRAINING" if rec.view.draining and
+                            rec.view.alive else
+                            "ALIVE" if rec.view.alive else "DEAD")
+            drain = self.draining.get(rec.view.node_id)
+            if drain is not None:
+                row["drain"] = dict(drain)
+            out.append(row)
+        return out
 
+    # ------------------------------------------------------------ node drain
     async def _h_drain_node(self, conn, data):
-        await self._mark_node_dead(data["node_id"], "drained")
-        return True
+        """Graceful, phased evacuation of one node ahead of a planned
+        departure (maintenance event / preemption notice).  Phases:
+        stop new leases and placements → evacuate sole-copy objects to
+        peers → migrate actors elsewhere (no restart budget burned) →
+        wait for in-flight tasks up to the deadline → cleanly
+        deregister.  On deadline overrun the node takes the existing
+        hard-death path, so lineage/restart recovery is the safety net
+        rather than the plan."""
+        node_id = data["node_id"]
+        rec = self.nodes.get(node_id)
+        if rec is None or not rec.view.alive:
+            return {"ok": False, "error": f"unknown or dead node "
+                                          f"{node_id[:16]}"}
+        timeout_s = float(data.get("timeout_s") or
+                          GlobalConfig.drain_timeout_s)
+        if node_id in self._drain_tasks:
+            task = self._drain_tasks[node_id]
+        else:
+            task = self._start_drain(node_id, timeout_s)
+        if not data.get("wait", True):
+            return {"ok": True, "started": True}
+        outcome = await asyncio.shield(task)
+        return {"ok": True, "outcome": outcome,
+                "node_id": node_id}
+
+    def _start_drain(self, node_id: str, timeout_s: float) -> asyncio.Task:
+        task = asyncio.ensure_future(self._drain_node(node_id, timeout_s))
+        self._drain_tasks[node_id] = task
+        task.add_done_callback(
+            lambda _t, nid=node_id: self._drain_tasks.pop(nid, None))
+        return task
+
+    async def _drain_node(self, node_id: str, timeout_s: float) -> str:
+        from ..util import fault_injection as fi
+        from ..util import tracing
+        rec = self.nodes[node_id]
+        t0 = time.time()
+        deadline = time.monotonic() + timeout_s
+        prog = self.draining.setdefault(
+            node_id, {"in_flight": -1, "objects_left": -1})
+        prog.update(phase="lease_stop", started=t0, timeout_s=timeout_s)
+        self._p("drain", node_id)
+        rec.view.draining = True
+        self._bump_view(node_id)
+        self._emit_event("WARNING", "controller",
+                         f"draining node {node_id[:12]} "
+                         f"(budget {timeout_s:g}s)", node_id=node_id)
+        # immediate fan-out: nodelets stop spilling leases here, serve
+        # routers drop this node's replicas without waiting for a poll
+        await self._broadcast("nodes", {"event": "draining",
+                                        "node_id": node_id})
+        outcome = "completed"
+        try:
+            # Phase 1 — the nodelet refuses new leases/actor starts.
+            reply = await rec.conn.call("drain", {"timeout_s": timeout_s},
+                                        timeout=10)
+            prog["in_flight"] = reply.get("in_flight", -1)
+            prog["objects_left"] = reply.get("objects_left", -1)
+            if fi.ACTIVE is not None and \
+                    fi.ACTIVE.point("drain.deadline", node_id):
+                raise _DrainDeadline()
+            # Phase 2 — sole-copy objects move to live peers (the
+            # nodelet pushes primaries; the object directory follows).
+            prog["phase"] = "evacuate_objects"
+            ev = await rec.conn.call(
+                "drain_evacuate", {},
+                timeout=max(2.0, deadline - time.monotonic()))
+            prog["objects_left"] = ev.get("left", -1)
+            # Phase 3 — actors restart elsewhere, proactively.
+            prog["phase"] = "migrate_actors"
+            await self._drain_migrate_actors(node_id, deadline)
+            # Phase 4 — wait for in-flight leases/tasks to finish.
+            prog["phase"] = "wait_in_flight"
+            while True:
+                await self._drain_migrate_actors(node_id, deadline)
+                st = await rec.conn.call("drain_status", {}, timeout=5)
+                prog["in_flight"] = st.get("in_flight", -1)
+                prog["objects_left"] = st.get("objects_left", -1)
+                if st.get("in_flight", 0) == 0 \
+                        and not self._actors_on(node_id):
+                    break
+                if time.monotonic() > deadline:
+                    raise _DrainDeadline()
+                await asyncio.sleep(GlobalConfig.drain_poll_interval_s)
+            # Phase 5 — clean deregister: the nodelet stops heartbeating
+            # (it must not resurrect), then leaves the membership table.
+            prog["phase"] = "deregister"
+            await self._mark_node_dead(node_id, "drained")
+            await self._fence_drained_node(node_id, rec)
+        except _DrainDeadline:
+            outcome = "deadline"
+            self._emit_event(
+                "ERROR", "controller",
+                f"drain of node {node_id[:12]} overran its "
+                f"{timeout_s:g}s budget; falling back to hard death",
+                node_id=node_id)
+            await self._mark_node_dead(node_id, "drain deadline exceeded")
+            await self._fence_drained_node(node_id, rec)
+        except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
+            outcome = "error"
+            await self._mark_node_dead(node_id, f"drain failed: {e}")
+            await self._fence_drained_node(node_id, rec)
+        finally:
+            self.draining.pop(node_id, None)
+            self._p("drain_del", node_id)
+            dur = time.time() - t0
+            rtm.NODE_DRAINS.inc(tags={"outcome": outcome})
+            rtm.DRAIN_DURATION.observe(dur, tags={"outcome": outcome})
+            tracing.record_span(f"drain::{node_id[:12]}", "drain",
+                                t0, time.time(), node_id=node_id[:12],
+                                outcome=outcome)
+        return outcome
+
+    async def _fence_drained_node(self, node_id: str, rec: NodeRecord):
+        """A drained (or drain-failed) node must STAY gone: the host is
+        departing, so its nodelet stops heartbeating (a beat would
+        resurrect the membership row) and the record leaves the table."""
+        try:
+            await rec.conn.call("drain_complete", {}, timeout=5)
+        except (rpc.RpcError, OSError):
+            pass
+        self.nodes.pop(node_id, None)
+
+    def _actors_on(self, node_id: str) -> List["ActorRecord"]:
+        return [a for a in self.actors.values()
+                if a.node_id == node_id
+                and a.state in (ALIVE, PENDING_CREATION)]
+
+    async def _drain_migrate_actors(self, node_id: str, deadline: float):
+        """Restart every actor living on the draining node somewhere
+        else — without burning restart budget (the departure is planned,
+        not a failure).  The old worker is killed DETACHED (the nodelet
+        forgets its actor binding first) so its death reports nothing."""
+        rec = self.nodes.get(node_id)
+        migrated = []
+        for actor in self._actors_on(node_id):
+            if actor.state != ALIVE:
+                continue  # pending creations re-route via the retry path
+            old_addr = actor.address
+            strat = (actor.spec.get("strategy") or {})
+            pinned_here = (strat.get("node_id") == node_id
+                           and not strat.get("soft")) \
+                or actor.spec.get("pg") is not None
+            if pinned_here:
+                # Hard node affinity / committed PG bundle: this actor
+                # CANNOT live anywhere else — a planned departure retires
+                # it (its owner replaces per-node actors: the serve proxy
+                # reconciler re-creates proxies, train's FailureConfig
+                # restarts the gang from its proactive drain checkpoint).
+                await self._on_actor_failure(
+                    actor, f"node {node_id[:12]} drained", intended=True)
+                if rec is not None and old_addr:
+                    try:
+                        await rec.conn.call("detach_kill_worker",
+                                            {"address": old_addr},
+                                            timeout=10)
+                    except rpc.RpcError:
+                        pass
+                continue
+            self._migrating.add(actor.actor_id)
+            rtm.ACTORS_MIGRATED.inc()
+            self._emit_event(
+                "INFO", "controller",
+                f"migrating actor {actor.actor_id.hex()[:12]} "
+                f"({actor.spec.get('fname', '?')}) off draining node "
+                f"{node_id[:12]}", actor_id=actor.actor_id.hex())
+            actor.state = RESTARTING
+            actor.address = None
+            actor.worker_id = None
+            actor.node_id = None
+            self._p("actor", self._actor_to_disk(actor))
+            await self._broadcast("actors", actor.to_wire())
+            if rec is not None and old_addr:
+                try:
+                    await rec.conn.call("detach_kill_worker",
+                                        {"address": old_addr}, timeout=10)
+                except rpc.RpcError:
+                    pass
+            migrated.append(actor)
+        self._pending_actor_wakeup.set()
+        # wait for the migrated actors to land elsewhere (or die for
+        # reasons of their own) inside the drain budget
+        while time.monotonic() < deadline:
+            if all(a.state in (ALIVE, DEAD) for a in migrated):
+                break
+            await asyncio.sleep(0.1)
+        for a in migrated:
+            self._migrating.discard(a.actor_id)
 
     async def _health_check_loop(self):
         while True:
@@ -439,9 +662,15 @@ class Controller:
             return
         rec.view.alive = False
         self._bump_view(node_id)
-        self._emit_event("ERROR", "controller",
-                         f"node {node_id[:12]} died: {reason}",
-                         node_id=node_id)
+        if reason == "drained":
+            # planned departure that quiesced in budget: not an error
+            self._emit_event("INFO", "controller",
+                             f"node {node_id[:12]} drained cleanly",
+                             node_id=node_id)
+        else:
+            self._emit_event("ERROR", "controller",
+                             f"node {node_id[:12]} died: {reason}",
+                             node_id=node_id)
         await self._broadcast("nodes", {"event": "dead", "node_id": node_id,
                                         "reason": reason})
         # Purge object locations on that node.
@@ -568,7 +797,7 @@ class Controller:
         if node_id is None:
             return
         rec = self.nodes.get(node_id)
-        if rec is None or not rec.view.alive:
+        if rec is None or not rec.view.alive or rec.view.draining:
             return
         actor.node_id = node_id
         t_place = time.time()
@@ -602,6 +831,7 @@ class Controller:
         actor = self.actors.get(data["actor_id"])
         if actor is None:
             return False
+        self._migrating.discard(actor.actor_id)
         actor.state = ALIVE
         actor.address = data["address"]
         actor.worker_id = data["worker_id"]
@@ -670,6 +900,13 @@ class Controller:
     async def _on_actor_failure(self, actor: ActorRecord, reason: str,
                                 intended: bool = False):
         if actor.state == DEAD:
+            return
+        if actor.actor_id in self._migrating and actor.worker_id is None \
+                and actor.state == RESTARTING:
+            # the OLD incarnation dying IS the drain migration — the
+            # reschedule is already queued; burning restart budget (or
+            # killing a max_restarts=0 actor) here would turn a planned
+            # departure into a failure
             return
         actor.address = None
         actor.worker_id = None

@@ -205,9 +205,16 @@ class CoreClient(DeferredRefDecs):
         self.memory_store = MemoryStore()
         self.store = store_client.StoreClient(store_path)
         self.lt = rpc.EventLoopThread(f"ray-tpu-{mode}-io")
+        # node-membership listeners (serve routers evict dead/draining
+        # replicas the moment the pubsub event lands, not at a poll TTL);
+        # the handler is registered up front so it survives redials
+        self._node_listeners: list = []
+        self._node_sub_lock = threading.Lock()
+        self._node_subscribed = False
         self.controller = rpc.BlockingClient.connect(
             self.lt, *_split(controller_addr),
-            handlers={"pub:logs": self._on_log},
+            handlers={"pub:logs": self._on_log,
+                      "pub:nodes": self._on_nodes_pub},
             retries=GlobalConfig.rpc_connect_retries)
         self.nodelet = rpc.BlockingClient.connect(
             self.lt, *_split(nodelet_addr),
@@ -951,6 +958,13 @@ class CoreClient(DeferredRefDecs):
             if reply.get("spillback"):
                 addr = reply["spillback"]
                 continue
+            if reply.get("draining"):
+                # the target is evacuating (planned departure) and no
+                # peer fits yet: back off briefly and retry — replacement
+                # capacity or the node's deregistration changes the view
+                await asyncio.sleep(0.2)
+                addr = self.nodelet_addr
+                continue
             if reply.get("infeasible"):
                 return None
             if reply.get("timeout"):
@@ -1389,6 +1403,29 @@ class CoreClient(DeferredRefDecs):
         if GlobalConfig.log_to_driver:
             print(f"({data.get('src', 'worker')}) {data.get('line', '')}",
                   flush=True)
+
+    async def _on_nodes_pub(self, conn, data):
+        for cb in list(self._node_listeners):
+            try:
+                cb(data)
+            except Exception:
+                pass
+
+    def subscribe_node_events(self, callback) -> None:
+        """Register ``callback(event_dict)`` for controller ``nodes``
+        pubsub events ({"event": "added"|"dead"|"draining", ...}).  The
+        first registration subscribes this process's controller
+        connection; callbacks run on the IO loop and must not block."""
+        with self._node_sub_lock:
+            self._node_listeners.append(callback)
+            first = not self._node_subscribed
+            self._node_subscribed = True
+        if first:
+            try:
+                self.controller.call("subscribe", {"channel": "nodes"},
+                                     timeout=10)
+            except Exception:
+                pass  # degraded: listeners fall back to table polling
 
     # -------------------------------------------------------------- shutdown
     def shutdown(self):

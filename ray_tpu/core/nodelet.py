@@ -138,6 +138,12 @@ class Nodelet:
         self._demand_seq = 0
         self.zygote: Optional[worker_zygote.ZygoteClient] = None
         self._stopping = False
+        # Drain mode (planned departure): no new leases or actor starts
+        # are granted here; in-flight work finishes and sole-copy
+        # objects evacuate to peers before the controller deregisters us.
+        self.draining = False
+        self._drain_finished = False   # heartbeats stop; never resurrect
+        self._evac_rr = 0              # round-robin cursor over peers
         self._register_handlers()
 
     # ------------------------------------------------------------------ setup
@@ -149,7 +155,9 @@ class Nodelet:
                      "node_info", "stats", "put_location", "ping",
                      "task_state", "task_state_batch", "node_stats",
                      "tail_log", "task_spans", "prestart_workers",
-                     "metrics_text", "chaos_injected"):
+                     "metrics_text", "chaos_injected",
+                     "drain", "drain_status", "drain_evacuate",
+                     "drain_complete", "detach_kill_worker"):
             s.register(name, getattr(self, "_h_" + name))
 
     @property
@@ -326,6 +334,8 @@ class Nodelet:
         if me is not None:
             me.available = self.available.copy()
             me.total = self.total.copy()
+            if self.draining:
+                me.draining = True
 
     async def _on_nodes_event(self, conn, data):
         if data.get("event") == "dead":
@@ -333,6 +343,14 @@ class Nodelet:
             if nv:
                 nv.alive = False
             self._peer_conns.pop(data.get("addr", ""), None)
+        elif data.get("event") == "draining":
+            # stop spilling leases to the draining peer NOW — the
+            # versioned view delta may be a heartbeat away
+            nv = self.view.get(data["node_id"])
+            if nv:
+                nv.draining = True
+            if data["node_id"] == self.node_id.hex():
+                self.draining = True
 
     async def _on_chaos_event(self, conn, data):
         """Runtime fault-plan push: re-arm locally and fan out to every
@@ -359,6 +377,10 @@ class Nodelet:
 
     async def _heartbeat_loop(self):
         while True:
+            if self._drain_finished:
+                # cleanly deregistered: a heartbeat now would resurrect
+                # the node in the controller's membership table
+                return
             try:
                 if self.controller is None or self.controller.closed:
                     await self._connect_controller()
@@ -462,6 +484,7 @@ class Nodelet:
                 self.available.release(res)
                 await self._notify_lease_waiters()
         if (prev_state in ("idle", "starting") and not self._stopping
+                and not self._drain_finished
                 and len(self.workers) < GlobalConfig.worker_pool_initial_size):
             await self._spawn_worker()
 
@@ -823,6 +846,17 @@ class Nodelet:
     async def _lease_inner(self, spec, request, strategy, deadline, my_id):
         while True:
             self._refresh_self_view()
+            if self.draining:
+                # never grant here again: spill to a live peer when one
+                # fits, else tell the driver to retry (it re-evaluates
+                # against the synced view, which now marks us DRAINING)
+                target = hybrid_policy(self.view, request, None,
+                                       strategy=strategy)
+                if target is not None and target != my_id:
+                    nv = self.view.get(target)
+                    rtm.LEASES_SPILLBACK.inc(tags=self._mnode)
+                    return {"spillback": nv.addr, "node_id": target}
+                return {"retry": True, "draining": True}
             target = hybrid_policy(
                 self.view, request, my_id,
                 spread_threshold=GlobalConfig.scheduler_spread_threshold,
@@ -877,6 +911,11 @@ class Nodelet:
         for the actor's lifetime and push the creation task to it."""
         spec = TaskSpec.from_wire(data["spec"])
         request = spec.resources
+        if self.draining:
+            # planned departure in progress: the controller's scheduler
+            # re-places the actor on a live node (draining views are
+            # infeasible there too — this covers the race window)
+            return {"ok": False, "retry": True, "error": "node draining"}
         if not self.available.fits(request):
             return {"ok": False, "retry": True, "error": "resources busy"}
         if sum(1 for w in self.workers.values() if w.state == "actor") \
@@ -964,6 +1003,100 @@ class Nodelet:
                 return True
         return False
 
+    async def _h_detach_kill_worker(self, conn, data):
+        """Kill a worker with its actor binding FORGOTTEN first: the
+        death is a planned migration, so the reap loop must not report
+        an actor failure (which would burn restart budget — or kill a
+        max_restarts=0 actor — for a departure the controller itself
+        orchestrated)."""
+        for w in self.workers.values():
+            if w.address == data["address"] and w.proc.poll() is None:
+                w.actor_id = None
+                w.proc.terminate()
+                return True
+        return False
+
+    # ------------------------------------------------------------- drain
+    async def _h_drain(self, conn, data):
+        """Enter drain mode: no new leases or actor starts; existing
+        leases/tasks run to completion.  Returns the quiesce baseline."""
+        self.draining = True
+        me = self.view.get(self.node_id.hex())
+        if me is not None:
+            me.draining = True
+        # wake queued lease waiters so they re-evaluate (spillback or
+        # retry) instead of sleeping toward their deadline here
+        await self._notify_lease_waiters()
+        return {"ok": True, "in_flight": len(self.leases),
+                "objects_left": len(self._primary_pins)}
+
+    async def _h_drain_status(self, conn, data):
+        return {"in_flight": len(self.leases),
+                "running": len(self._running_tasks),
+                "objects_left": len(self._primary_pins),
+                "actor_workers": sum(1 for w in self.workers.values()
+                                     if w.state == "actor")}
+
+    def _evac_peers(self):
+        me = self.node_id.hex()
+        return [nv for nv in self.view.values()
+                if nv.alive and not nv.draining and nv.node_id != me]
+
+    async def _h_drain_evacuate(self, conn, data):
+        """Push every pinned primary (each the sole durable copy on this
+        node) to a live peer, which takes over the primary pin and the
+        directory entry.  Our local copy STAYS until deregistration so
+        readers mid-get finish; `_mark_node_dead` purges our directory
+        entries.  A failed evacuation leaves the object to the lineage-
+        reconstruction safety net — exactly the crash path, minus the
+        surprise."""
+        moved = failed = 0
+        for oid in list(self._primary_pins):
+            if fi.ACTIVE is not None and \
+                    fi.ACTIVE.point("drain.evacuate", oid.hex()) is not None:
+                failed += 1  # injected evacuation failure (chaos suite)
+                continue
+            peers = self._evac_peers()
+            if not peers:
+                failed += 1
+                continue
+            ok = False
+            for i in range(len(peers)):
+                peer = peers[(self._evac_rr + i) % len(peers)]
+                try:
+                    pconn = await self._peer(peer.addr)
+                    r = await pconn.call(
+                        "pull", {"object_id": oid, "timeout": 30.0,
+                                 "pin_primary": True}, timeout=40)
+                except (rpc.RpcError, OSError):
+                    continue
+                if r.get("ok"):
+                    ok = True
+                    break
+            self._evac_rr += 1
+            if ok:
+                # the peer holds the primary pin now; release ours (the
+                # unpinned local copy remains a plain replica)
+                if self._primary_pins.pop(oid, None) is not None:
+                    self.store.release(oid)
+                moved += 1
+                rtm.OBJECTS_EVACUATED.inc(tags=self._mnode)
+            else:
+                failed += 1
+        return {"moved": moved, "failed": failed,
+                "left": len(self._primary_pins)}
+
+    async def _h_drain_complete(self, conn, data):
+        """The controller deregistered us cleanly: stop heartbeating
+        (a beat now would resurrect the node) and wind the worker pool
+        down.  The process itself stays up — the store keeps serving
+        reads until the host actually goes away."""
+        self._drain_finished = True
+        for w in self.workers.values():
+            if w.state in ("idle", "starting") and w.proc.poll() is None:
+                w.proc.terminate()
+        return True
+
     # --------------------------------------------------- placement-group 2PC
     async def _h_pg_prepare(self, conn, data):
         req = ResourceSet(data["resources"])
@@ -1040,10 +1173,18 @@ class Nodelet:
         oid = data["object_id"]
         timeout = data.get("timeout", 30.0)
         if self.store.contains(oid):
+            if data.get("pin_primary"):
+                # drain evacuation to a node already holding a replica:
+                # primacy must still transfer or nothing pins the copy
+                await self._h_put_location(
+                    None, {"object_id": oid, "primary": True})
             return {"ok": True}
         lock = self._pull_locks.setdefault(oid, asyncio.Lock())
         async with lock:
             if self.store.contains(oid):
+                if data.get("pin_primary"):
+                    await self._h_put_location(
+                        None, {"object_id": oid, "primary": True})
                 return {"ok": True}
             deadline = time.monotonic() + timeout
             # Fast-fail when the directory has NO location anywhere (self
@@ -1080,8 +1221,13 @@ class Nodelet:
                     async with self._pull_sem:  # bound store churn
                         pulled = await self._pull_from(oid, addr)
                     if pulled:
+                        # pin_primary: a drain evacuation hands PRIMARY
+                        # responsibility to us — pin the copy so LRU
+                        # eviction cannot drop what is now the sole copy
                         await self._h_put_location(
-                            None, {"object_id": oid, "primary": False})
+                            None, {"object_id": oid,
+                                   "primary": bool(data.get("pin_primary")),
+                                   "size": int(info.get("size", 0))})
                         return {"ok": True}
                     # Evicted replica left a stale directory entry: purge it
                     # so the no-location fast-fail above can fire.

@@ -117,7 +117,7 @@ class ControllerStore:
 
 def _empty_tables() -> Dict[str, Any]:
     return {"kv": {}, "actors": {}, "pgs": {}, "jobs": {},
-            "named_actors": {}}
+            "named_actors": {}, "draining_nodes": []}
 
 
 def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
@@ -146,3 +146,13 @@ def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
         state["jobs"][rec[1]] = rec[2]
     elif op == "job_del":
         state["jobs"].pop(rec[1], None)
+    elif op == "drain":
+        # a node entered DRAINING: a restarted controller must keep it
+        # out of the placement pool and resume/finish the drain
+        nodes = state.setdefault("draining_nodes", [])
+        if rec[1] not in nodes:
+            nodes.append(rec[1])
+    elif op == "drain_del":
+        nodes = state.setdefault("draining_nodes", [])
+        if rec[1] in nodes:
+            nodes.remove(rec[1])

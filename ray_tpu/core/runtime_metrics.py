@@ -69,6 +69,17 @@ ACTORS_RESTARTED = m.Counter(
 PUBSUB_MESSAGES = m.Counter(
     "ray_tpu_pubsub_messages_total",
     "Messages published on controller channels", ("channel",))
+NODE_DRAINS = m.Counter(
+    "ray_tpu_node_drains_total",
+    "Graceful node drains by outcome (completed | deadline | error)",
+    ("outcome",))
+ACTORS_MIGRATED = m.Counter(
+    "ray_tpu_actors_migrated_total",
+    "Actors proactively migrated off draining nodes (no restart budget "
+    "burned)", ())
+OBJECTS_EVACUATED = m.Counter(
+    "ray_tpu_objects_evacuated_total",
+    "Sole-copy objects pushed to a peer during node drain", ("node",))
 
 # -------------------------------------------------- latency histograms
 # Per-phase breakdown of a task's life, derived from the same lifecycle
@@ -96,6 +107,11 @@ EXEC_TIME = m.Histogram(
 RESULT_PUT = m.Histogram(
     "ray_tpu_task_result_put_seconds",
     "Result serialization/store time", _LAT_BOUNDS, ("node",))
+DRAIN_DURATION = m.Histogram(
+    "ray_tpu_node_drain_duration_seconds",
+    "Wall time of one node drain, start to deregister/fallback",
+    (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
+    ("outcome",))
 
 
 def observe_task_durs(durs: dict, node: str) -> None:

@@ -18,12 +18,12 @@ from .task_spec import EPS, ResourceSet
 
 class NodeView:
     __slots__ = ("node_id", "addr", "available", "total", "alive", "labels",
-                 "version")
+                 "version", "draining")
 
     def __init__(self, node_id: str, addr: str, available: Dict[str, float],
                  total: Dict[str, float], alive: bool = True,
                  labels: Optional[Dict[str, str]] = None,
-                 version: int = 0):
+                 version: int = 0, draining: bool = False):
         self.node_id = node_id
         self.addr = addr
         self.available = ResourceSet(available)
@@ -35,21 +35,26 @@ class NodeView:
         # high-water mark (reference: RaySyncer per-node versioned views,
         # src/ray/common/ray_syncer/ray_syncer.h:75-88).
         self.version = version
+        # DRAINING: the node is evacuating ahead of a planned departure
+        # (maintenance / preemption notice).  Still alive — in-flight work
+        # finishes, objects stay fetchable — but never a target for new
+        # leases, actor placements, or PG bundles.
+        self.draining = draining
 
     def to_wire(self):
         return {"id": self.node_id, "addr": self.addr,
                 "avail": self.available.to_dict(), "total": self.total.to_dict(),
                 "alive": self.alive, "labels": self.labels,
-                "ver": self.version}
+                "ver": self.version, "draining": self.draining}
 
     @classmethod
     def from_wire(cls, d):
         return cls(d["id"], d["addr"], d["avail"], d["total"], d["alive"],
-                   d.get("labels"), d.get("ver", 0))
+                   d.get("labels"), d.get("ver", 0), d.get("draining", False))
 
 
 def is_feasible(view: NodeView, request: ResourceSet) -> bool:
-    return view.alive and view.total.fits(request)
+    return view.alive and not view.draining and view.total.fits(request)
 
 
 def hybrid_policy(
@@ -76,7 +81,15 @@ def hybrid_policy(
             if strategy.get("soft") or nv.available.fits(request):
                 return nv.node_id
             return nv.node_id  # hard affinity: queue there
-        return None
+        if not strategy.get("soft"):
+            return None
+        # soft affinity to a dead/draining/infeasible node falls back to
+        # normal placement (matches the reference's soft NodeAffinity) —
+        # returning None here would pin the task to a corpse forever
+        strategy = {k: v for k, v in strategy.items()
+                    if k not in ("node_id", "soft")}
+        return hybrid_policy(views, request, local_node_id,
+                             spread_threshold, strategy, rng)
     if strategy.get("spread"):
         # Round-robin over feasible nodes, preferring available ones.
         avail = [n for n in views.values()
@@ -123,7 +136,7 @@ def pack_bundles(
     (reference: src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc)
     """
     reqs = [ResourceSet(b) for b in bundles]
-    nodes = [n for n in views.values() if n.alive]
+    nodes = [n for n in views.values() if n.alive and not n.draining]
     scratch = {n.node_id: n.available.copy() for n in nodes}
 
     def fits(nid, req):

@@ -266,6 +266,37 @@ def cmd_timeline(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_drain(args) -> None:
+    """Gracefully drain a node ahead of planned maintenance: stop new
+    work, evacuate sole-copy objects, migrate actors, wait for in-flight
+    tasks, then cleanly deregister.  On deadline overrun the node takes
+    the hard-death recovery path."""
+    import ray_tpu
+    from ray_tpu.core.config import GlobalConfig
+    from ray_tpu.core.driver import get_global_core
+    _connect(args)
+    try:
+        core = get_global_core()
+        nodes = core.controller.call("list_nodes", {}, timeout=10)
+        matches = [n for n in nodes
+                   if n["id"].startswith(args.node_id) and n.get("alive")]
+        if len(matches) != 1:
+            sys.exit(f"node id {args.node_id!r} matches "
+                     f"{len(matches)} alive nodes "
+                     f"({[n['id'][:12] for n in matches]})")
+        node_id = matches[0]["id"]
+        timeout = args.timeout or GlobalConfig.drain_timeout_s
+        print(f"draining {node_id[:12]}... (budget {timeout:g}s)")
+        reply = core.controller.call(
+            "drain_node", {"node_id": node_id, "timeout_s": timeout,
+                           "wait": True}, timeout=timeout + 60)
+        print(json.dumps(reply, indent=2, default=str))
+        if reply.get("outcome") != "completed":
+            sys.exit(1)
+    finally:
+        ray_tpu.shutdown()
+
+
 def cmd_chaos(args) -> None:
     """Fault-injection (chaos) plan control: apply a JSON plan file
     cluster-wide (controller KV + pubsub fan-out), clear it, or show the
@@ -392,6 +423,17 @@ def main(argv=None) -> None:
     sp.add_argument("--address")
     sp.add_argument("-o", "--output")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("drain",
+                        help="gracefully drain a node (phased "
+                             "evacuation for planned maintenance)")
+    sp.add_argument("node_id", help="node id (hex, prefix ok)")
+    sp.add_argument("--timeout", type=float, default=None,
+                    help="graceful budget in seconds before the "
+                         "hard-death fallback (default: "
+                         "drain_timeout_s config)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("chaos",
                         help="fault-injection plan control "

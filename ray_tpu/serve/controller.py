@@ -11,8 +11,21 @@ and applies the autoscaling policy on router-reported metrics
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
+
+
+def _process_core():
+    """This process's CoreClient, creating it from the worker context
+    when needed (a serve actor's __init__ may run before any api call
+    lazily built one).  Never bootstraps a cluster."""
+    from ..core.driver import get_global_core
+    core = get_global_core()
+    if core is None and os.environ.get("RAY_TPU_WORKER_CONTEXT"):
+        from ..api import _ensure_initialized
+        core = _ensure_initialized()
+    return core
 
 
 class ServeController:
@@ -26,6 +39,38 @@ class ServeController:
         self._proxy_http: Optional[dict] = None
         self._last_proxy_check = 0.0
         self._replica_nodes: Dict[str, str] = {}  # replica id -> node id
+        # drain evacuations in flight: doomed replica id -> {"name",
+        # "replacement"} — the replacement is pre-started BEFORE the
+        # draining replica stops, so capacity never dips
+        self._evacuations: Dict[str, Dict[str, Any]] = {}
+        # Node-membership push: a dead/draining node invalidates the
+        # replica->node locality cache immediately.  A migrated replica
+        # (same actor, new node) otherwise keeps its stale annotation
+        # forever and every router evicts it as if it were still on the
+        # corpse.
+        try:
+            core = _process_core()
+            if core is not None:
+                core.subscribe_node_events(self._on_node_event)
+        except Exception:
+            pass
+
+    def _on_node_event(self, data: Dict[str, Any]) -> None:
+        """A node DIED: drop its replicas' locality annotations so
+        routers stop evicting replicas that are mid-restart elsewhere.
+        DRAINING keeps the annotations — that eviction is the point."""
+        if data.get("event") != "dead":
+            return
+        nid = data.get("node_id")
+        if not nid:
+            return
+        stale = [rid for rid, n in self._replica_nodes.items() if n == nid]
+        for rid in stale:
+            self._replica_nodes.pop(rid, None)
+        if stale:
+            # force routers to re-pull: the fresh table drops the stale
+            # annotations and _resolve_replica_nodes re-resolves them
+            self._version += 1
 
     # -- deploy / delete ----------------------------------------------------
     def deploy(self, name: str, callable_blob: bytes, init_args: tuple,
@@ -79,36 +124,45 @@ class ServeController:
             cfg["num_replicas"] = target
         self._scale_to(name, target)
 
-    def _scale_to(self, name: str, target: int) -> None:
+    def _start_replica(self, name: str, entry: Dict[str, Any]
+                       ) -> Dict[str, Any]:
+        """Start one replica (or gang replica) and append it to the
+        deployment's table; returns the new table row."""
         from .. import api
         from .replica import ServeReplica
-        entry = self._deployments[name]
         cfg = entry.get("config", {})
         gang_size = int(cfg.get("gang_size", 1) or 1)
+        self._replica_seq += 1
+        rid = f"{name}#{self._replica_seq}"
+        if gang_size > 1:
+            # Multi-process replica: a placement-group gang hosting one
+            # sharded program (serve/gang.py); the routing table carries
+            # only the leader, so the router sees one unit.
+            from .gang import start_gang_replica
+            rep = start_gang_replica(name, rid, entry, cfg)
+            entry["replicas"].append(rep)
+            return rep
+        opts = dict(cfg.get("ray_actor_options") or {})
+        handle = api.remote(ServeReplica).options(
+            max_concurrency=int(cfg.get("max_concurrent_queries", 8)),
+            num_cpus=opts.get("num_cpus", 0.1),
+            # detached: a replica must outlive the JOB that deployed
+            # it (e.g. a `serve-deploy` CLI process) — Serve owns
+            # replica lifecycle via scale-down/shutdown, the job GC
+            # does not (reference: all serve actors are detached)
+            lifetime="detached",
+        ).remote(name, rid, entry["callable_blob"],
+                 entry["init_args"], entry["init_kwargs"],
+                 cfg.get("user_config"))
+        rep = {"id": rid, "handle": handle}
+        entry["replicas"].append(rep)
+        return rep
+
+    def _scale_to(self, name: str, target: int) -> None:
+        from .. import api
+        entry = self._deployments[name]
         while len(entry["replicas"]) < target:
-            self._replica_seq += 1
-            rid = f"{name}#{self._replica_seq}"
-            if gang_size > 1:
-                # Multi-process replica: a placement-group gang hosting one
-                # sharded program (serve/gang.py); the routing table carries
-                # only the leader, so the router sees one unit.
-                from .gang import start_gang_replica
-                entry["replicas"].append(
-                    start_gang_replica(name, rid, entry, cfg))
-                continue
-            opts = dict(cfg.get("ray_actor_options") or {})
-            handle = api.remote(ServeReplica).options(
-                max_concurrency=int(cfg.get("max_concurrent_queries", 8)),
-                num_cpus=opts.get("num_cpus", 0.1),
-                # detached: a replica must outlive the JOB that deployed
-                # it (e.g. a `serve-deploy` CLI process) — Serve owns
-                # replica lifecycle via scale-down/shutdown, the job GC
-                # does not (reference: all serve actors are detached)
-                lifetime="detached",
-            ).remote(name, rid, entry["callable_blob"],
-                     entry["init_args"], entry["init_kwargs"],
-                     cfg.get("user_config"))
-            entry["replicas"].append({"id": rid, "handle": handle})
+            self._start_replica(name, entry)
         while len(entry["replicas"]) > target:
             rep = entry["replicas"].pop()
             self._replica_nodes.pop(rep["id"], None)
@@ -128,7 +182,8 @@ class ServeController:
         """Structured cluster event per replica teardown — when a
         request races a kill, the events API says who killed what."""
         why = (f"scale to {target}" if target >= 0
-               else "found dead; replacing")
+               else "node draining; replacement pre-started"
+               if target == -2 else "found dead; replacing")
         try:
             from .. import state
             state.report_event(
@@ -266,6 +321,107 @@ class ServeController:
                 self._reconcile(name)   # refill to the target count
                 self._version += 1
 
+    def _maybe_evacuate_draining(self) -> None:
+        """Zero-downtime replica evacuation off DRAINING nodes
+        (reference rationale: deployment_state's graceful scale — here
+        triggered by the cluster's drain protocol).  Two-phase, spread
+        over poll ticks: (1) pre-start a replacement for every ALIVE
+        replica sitting on a draining node, (2) once the replacement is
+        ALIVE on a live node, stop the doomed replica.  Also refreshes
+        the locality cache for replicas the core controller already
+        migrated (same actor, new node) so routers stop evicting them.
+        Throttled; piggybacks on router metric reports."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_drain_check", 0.0) < 2.0:
+            return
+        self._last_drain_check = now
+        try:
+            from .. import state
+            nodes = state.list_nodes()
+        except Exception:
+            return  # transient state-API failure; next tick retries
+        alive_ids, draining = set(), set()
+        for n in nodes:
+            if n.get("alive"):
+                alive_ids.add(n["id"])
+                if n.get("draining"):
+                    draining.add(n["id"])
+        # cached annotations naming departed nodes must be re-resolved —
+        # a drained node's replicas restarted elsewhere, and routers
+        # would keep evicting them on the corpse annotation
+        stale = any(nid not in alive_ids
+                    for nid in self._replica_nodes.values())
+        if not draining and not self._evacuations and not stale:
+            return
+        try:
+            from .. import state
+            by_aid = {row.get("actor_id"): row
+                      for row in state.list_actors()}
+        except Exception:
+            return
+        replacing = {e["replacement"] for e in self._evacuations.values()}
+        for name, entry in self._deployments.items():
+            for rep in list(entry["replicas"]):
+                rid = rep["id"]
+                handle = (rep.get("gang") or [rep["handle"]])[0]
+                row = by_aid.get(handle._actor_id) or {}
+                nid = row.get("node_id")
+                cached = self._replica_nodes.get(rid)
+                if nid and cached != nid:
+                    # migrated replica: refresh the node annotation or
+                    # routers keep treating it as draining forever
+                    self._replica_nodes[rid] = nid
+                    self._version += 1
+                elif not nid and cached and cached not in alive_ids:
+                    # mid-restart off a gone node: drop the corpse
+                    # annotation so routers may route to it again once
+                    # the restart lands
+                    self._replica_nodes.pop(rid, None)
+                    self._version += 1
+                if rid in self._evacuations or rid in replacing:
+                    continue
+                if nid in draining and row.get("state") == "ALIVE":
+                    replacement = self._start_replica(name, entry)
+                    # keep the doomed replica LAST so a concurrent
+                    # scale-down pops it, never the replacement
+                    entry["replicas"].remove(rep)
+                    entry["replicas"].append(rep)
+                    self._evacuations[rid] = {
+                        "name": name, "replacement": replacement["id"]}
+                    self._version += 1
+        # phase 2: replacements that came up take over; doomed replicas stop
+        for rid, info in list(self._evacuations.items()):
+            entry = self._deployments.get(info["name"])
+            rep = None if entry is None else next(
+                (r for r in entry["replicas"] if r["id"] == rid), None)
+            new_rep = None if entry is None else next(
+                (r for r in entry["replicas"]
+                 if r["id"] == info["replacement"]), None)
+            if rep is None or new_rep is None:
+                self._evacuations.pop(rid, None)
+                continue  # deleted/healed under us; reconcile covers it
+            nh = (new_rep.get("gang") or [new_rep["handle"]])[0]
+            row = by_aid.get(nh._actor_id) or {}
+            if row.get("state") != "ALIVE" or row.get("node_id") in draining:
+                continue  # replacement not ready yet; next tick
+            from .. import api
+            entry["replicas"].remove(rep)
+            self._replica_nodes.pop(rid, None)
+            self._audit_kill(info["name"], rid, -2)
+            if rep.get("gang"):
+                from .gang import stop_gang_replica
+                try:
+                    stop_gang_replica(rep)
+                except Exception:
+                    pass
+            else:
+                try:
+                    api.kill(rep["handle"])
+                except Exception:
+                    pass
+            self._evacuations.pop(rid, None)
+            self._version += 1
+
     # -- routing state ------------------------------------------------------
     def _resolve_replica_nodes(self) -> None:
         """Fill the replica->node cache for locality routing with ONE
@@ -304,6 +460,12 @@ class ServeController:
 
     def snapshot(self, known_version: int = -1) -> Optional[dict]:
         """Routing table if newer than known_version (long-poll pull)."""
+        # Reconcile drains on the POLL path too (throttled): when every
+        # replica of a deployment is evicted, completions — and with
+        # them report_metrics — stop entirely, but failing routers keep
+        # polling snapshot; without this hook the stale annotations
+        # would never refresh and the outage would be permanent.
+        self._maybe_evacuate_draining()
         if known_version == self._version:
             return None
         self._resolve_replica_nodes()
@@ -334,6 +496,7 @@ class ServeController:
         """Router-reported in-flight counts drive the basic autoscaler."""
         self._maybe_reconcile_proxies()
         self._maybe_heal_replicas()     # 5s-throttled internally
+        self._maybe_evacuate_draining()  # 2s-throttled internally
         self._resolve_replica_nodes()   # 1s-throttled internally
         entry = self._deployments.get(name)
         if entry is None:

@@ -35,7 +35,32 @@ class Router:
             self._node_id = api.get_runtime_context().node_id
         except Exception:
             self._node_id = None
+        # Replicas on dead/DRAINING nodes are evicted the moment the
+        # controller's `nodes` pubsub event lands — not after the
+        # health-check TTL expired (a node death otherwise leaves a
+        # window of requests routed to a corpse).
+        self._down_nodes: set = set()
+        try:
+            from .controller import _process_core
+            core = _process_core()
+            if core is not None:
+                core.subscribe_node_events(self._on_node_event)
+        except Exception:
+            pass  # degraded: the poll TTL + heal loop still converge
         self._refresh(force=True)
+
+    def _on_node_event(self, data) -> None:
+        ev = data.get("event")
+        if ev in ("dead", "draining"):
+            nid = data.get("node_id")
+            if nid:
+                with self._lock:
+                    self._down_nodes.add(nid)
+        elif ev == "added":
+            nid = (data.get("node") or {}).get("id")
+            if nid:
+                with self._lock:
+                    self._down_nodes.discard(nid)
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -120,6 +145,8 @@ class Router:
                     candidates = []
                     for off in range(len(replicas)):
                         rep = replicas[(start + off) % len(replicas)]
+                        if rep.get("node_id") in self._down_nodes:
+                            continue  # dead/draining node: never route
                         load = self._inflight.get(rep["id"], 0)
                         if load < cap:
                             candidates.append((load, rep))

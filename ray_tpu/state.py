@@ -13,7 +13,16 @@ from .api import _ensure_initialized
 
 
 def list_nodes() -> List[Dict[str, Any]]:
+    """Node membership rows.  Each row carries ``state`` (ALIVE |
+    DRAINING | DEAD) and, while a drain is in progress, a ``drain``
+    progress dict (phase, in-flight tasks left, objects left to
+    evacuate)."""
     return _ensure_initialized().controller.call("list_nodes")
+
+
+def nodes() -> List[Dict[str, Any]]:
+    """Alias of :func:`list_nodes` (reference naming: state.nodes)."""
+    return list_nodes()
 
 
 def list_actors() -> List[Dict[str, Any]]:

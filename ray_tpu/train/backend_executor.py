@@ -11,6 +11,7 @@ axes line up with ICI neighborhoods.
 from __future__ import annotations
 
 import itertools
+import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
@@ -38,6 +39,12 @@ class BackendExecutor:
         self.run_id = uuid.uuid4().hex[:8]
         self.worker_group: Optional[WorkerGroup] = None
         self.shared_env: Dict[str, Any] = {}
+        # proactive drain handling: when a gang worker's node starts
+        # DRAINING, finish the in-flight report (so its checkpoint is
+        # registered), then restart the attempt from that checkpoint —
+        # instead of dying mid-step when the node departs
+        self._drain_pending: Optional[str] = None
+        self._last_drain_check = 0.0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, *, trial_name: str = "train",
@@ -84,9 +91,40 @@ class BackendExecutor:
         api.get([w.start_training.remote(blob, config or {})
                  for w in self.worker_group.workers], timeout=120.0)
 
+    def _gang_on_draining_node(self) -> Optional[str]:
+        """Node id of a draining node hosting one of our gang actors, or
+        None.  Throttled — one state-API round trip every ~2 s."""
+        now = time.monotonic()
+        if now - self._last_drain_check < 2.0:
+            return None
+        self._last_drain_check = now
+        try:
+            from .. import state
+            draining = {n["id"] for n in state.list_nodes()
+                        if n.get("alive") and n.get("draining")}
+            if not draining:
+                return None
+            aids = {w._actor_id for w in self.worker_group.workers}
+            for row in state.list_actors():
+                if row.get("actor_id") in aids \
+                        and row.get("node_id") in draining:
+                    return row["node_id"]
+        except Exception:
+            return None
+        return None
+
     def next_results(self, timeout_s: float = 60.0):
         """One report from every rank (ordered by world rank), or None when
         all ranks finished.  Raises TrainingFailedError on worker failure."""
+        if self._drain_pending is not None:
+            # the previous report (and its checkpoint) has been consumed
+            # by the trainer — restart NOW from it, before the draining
+            # node kills the gang mid-step
+            nid = self._drain_pending
+            self._drain_pending = None
+            raise TrainingFailedError(
+                f"gang worker on draining node {nid[:12]}; restarting "
+                f"from the latest checkpoint before the node departs")
         refs = [w.next_result.remote(timeout_s)
                 for w in self.worker_group.workers]
         try:
@@ -95,6 +133,7 @@ class BackendExecutor:
             raise TrainingFailedError(f"worker lost mid-training: {e}") from e
         if all(r is None for r in results):
             return None
+        self._drain_pending = self._gang_on_draining_node()
         if any(r is None for r in results):
             # some ranks done, some not: drain the stragglers next call
             results = [r if r is not None else "__timeout__"

@@ -44,6 +44,12 @@ site                        actions
 ``serve.request``           ``crash`` (replica dies mid-request), ``error``,
                             ``delay``/``latency``
 ``serve.health_check``      ``error`` (health check fails)
+``drain.evacuate``          any action fails that object's evacuation during a
+                            node drain (the object rides the node to its death
+                            and must come back via lineage reconstruction)
+``drain.deadline``          any action forces the drain orchestrator to treat
+                            the drain as deadline-overrun — the node takes the
+                            hard-death recovery path immediately
 ==========================  =====================================================
 
 Zero-cost when disabled: every hot path guards with one module-level
