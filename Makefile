@@ -2,7 +2,7 @@
 # tree): native object store + transfer plane, C++ driver API, wheel.
 PY ?= python
 
-.PHONY: all native cpp wheel test bench obs chaos drain clean
+.PHONY: all native cpp wheel test bench serve-bench obs chaos drain clean
 
 all: native cpp
 
@@ -44,6 +44,13 @@ drain:
 
 bench:
 	$(PY) bench.py
+
+# Serve decode benchmark: generation TTFT plus the continuous-batching
+# streaming lane (1/4/8 concurrent SSE sessions; agg_tok_s and
+# stream_ms_per_tok_p50) through the full proxy -> router -> replica
+# path on the CPU harness.
+serve-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve
 
 clean:
 	rm -f ray_tpu/core/object_store/libtpustore.so dist/*.whl

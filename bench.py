@@ -491,6 +491,7 @@ def _run_serve_measurement() -> dict:
     p50 = float(np.percentile(ttfts, 50)) * 1e3
     p90 = float(np.percentile(ttfts, 90)) * 1e3
     dec_p50 = float(np.percentile(decodes, 50)) * 1e3
+    streaming = _measure_concurrent_streaming(http, addr, prompt_len)
     serve.shutdown()
     ray_tpu.shutdown()
     return {
@@ -506,10 +507,65 @@ def _run_serve_measurement() -> dict:
                    "sessions": 20, "prompt_len": prompt_len,
                    "path": "http_proxy->router->replica",
                    "model": "transformer-tiny(cpu harness)",
+                   "streaming": streaming,
                    "note": ("TPU model-side prefill/decode measured in "
                             "tpu_probe.py; end-to-end TPU TTFT ~= this "
-                            "path overhead + that prefill")},
+                            "path overhead + that prefill; 'streaming' "
+                            "is the continuous-batching SSE lane "
+                            "(chunked next_chunk drains) at 1/4/8 "
+                            "concurrent sessions")},
     }
+
+
+def _measure_concurrent_streaming(http, addr: str,
+                                  prompt_len: int) -> dict:
+    """Continuous-batching serve benchmark: N concurrent SSE streams
+    through `/generate/stream` (replica decode engine + chunked
+    `next_chunk` drains + sid-sticky routing).  Reports per-N
+    ``agg_tok_s`` (total tokens / wall) and ``stream_ms_per_tok_p50``
+    (per-session wall per token) — the serve-side counterpart of the
+    raw `llama1b_b8_scan` batched-decode headline."""
+    import threading
+
+    import numpy as np
+    import requests
+    max_new = 32
+
+    def stream_one(i: int, out: dict) -> None:
+        prompt = [(11 * i + j) % 250 for j in range(prompt_len)]
+        tokens = 0
+        t0 = time.perf_counter()
+        with requests.post(f"{addr}/generate/stream",
+                           json={"prompt": prompt,
+                                 "max_new_tokens": max_new},
+                           stream=True, timeout=300) as r:
+            r.raise_for_status()
+            for line in r.iter_lines():
+                if line.startswith(b"data: ") and b'"token"' in line:
+                    tokens += 1
+        out[i] = (time.perf_counter() - t0, tokens)
+
+    stream_one(0, {})                # warmup: engine slot-step compile
+    result = {}
+    for n in (1, 4, 8):
+        out: dict = {}
+        threads = [threading.Thread(target=stream_one, args=(i, out))
+                   for i in range(n)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        total = sum(tok for _, tok in out.values())
+        per_tok = [dur / max(tok, 1) for dur, tok in out.values()]
+        result[f"s{n}"] = {
+            "agg_tok_s": round(total / max(wall, 1e-9), 1),
+            "stream_ms_per_tok_p50":
+                round(float(np.percentile(per_tok, 50)) * 1e3, 2),
+            "sessions": n, "tokens": total,
+        }
+    return result
 
 
 def _run_rl_measurement() -> dict:

@@ -270,6 +270,10 @@ _d("serve_http_port", int, 8000, "HTTP proxy bind port.")
 _d("serve_request_timeout_s", float, 60.0,
    "End-to-end timeout for one proxied HTTP request (replica execution "
    "included).")
+_d("serve_stream_chunk_tokens", int, 16,
+   "SSE decode streaming drains up to this many buffered tokens per "
+   "`next_chunk` router round trip (continuous-batching engine lane) — "
+   "transport amortizes over N tokens instead of one RPC per token.")
 _d("serve_backoff_base_s", float, 0.01,
    "Base of the full-jitter exponential backoff the Serve router uses "
    "while every replica is saturated, and between replica-failure "

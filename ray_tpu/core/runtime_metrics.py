@@ -80,6 +80,11 @@ ACTORS_MIGRATED = m.Counter(
 OBJECTS_EVACUATED = m.Counter(
     "ray_tpu_objects_evacuated_total",
     "Sole-copy objects pushed to a peer during node drain", ("node",))
+SERVE_TOKENS = m.Counter(
+    "ray_tpu_serve_tokens_total",
+    "Tokens decoded by replica continuous-batching engines "
+    "(decode_session.py); registered in the replica's process",
+    ("deployment",))
 
 # -------------------------------------------------- latency histograms
 # Per-phase breakdown of a task's life, derived from the same lifecycle
@@ -107,6 +112,12 @@ EXEC_TIME = m.Histogram(
 RESULT_PUT = m.Histogram(
     "ray_tpu_task_result_put_seconds",
     "Result serialization/store time", _LAT_BOUNDS, ("node",))
+SERVE_DECODE_OCCUPANCY = m.Histogram(
+    "ray_tpu_serve_decode_batch_occupancy",
+    "Active decode slots per continuous-batching engine step — how full "
+    "the batched decode program runs (the serve-vs-raw decode gap closes "
+    "as this climbs toward max_slots)",
+    (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0), ("deployment",))
 DRAIN_DURATION = m.Histogram(
     "ray_tpu_node_drain_duration_seconds",
     "Wall time of one node drain, start to deregister/fallback",

@@ -8,9 +8,12 @@ Pallas attention (`ray_tpu.ops`).
 """
 
 from .generate import (  # noqa: F401
+    cache_insert_slot,
     decode_step,
+    decode_step_slots,
     generate,
     init_kv_cache,
+    init_slot_cache,
     prefill,
     prefill_chunk,
     prefill_chunked,

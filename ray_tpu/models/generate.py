@@ -243,6 +243,122 @@ def decode_step(params: Params, token: jnp.ndarray, cache: KVCache,
     return logits.astype(jnp.float32), {"k": ks, "v": vs, "pos": pos + 1}
 
 
+def init_slot_cache(cfg: TransformerConfig, slots: int,
+                    max_len: int) -> KVCache:
+    """KV cache for a continuous-batching decode engine: ``slots``
+    independent sessions share one batched program, so ``pos`` is a
+    per-slot vector instead of the single scalar of
+    :func:`init_kv_cache`."""
+    shape = (cfg.n_layers, slots, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((slots,), jnp.int32)}
+
+
+def cache_insert_slot(slot_cache: KVCache, cache: KVCache,
+                      slot: jnp.ndarray) -> KVCache:
+    """Write a batch-1 session cache (from :func:`prefill`) into slot
+    ``slot`` of a slot-batched cache.  ``slot`` is a TRACED index —
+    one jitted program serves every slot, so session admission never
+    recompiles."""
+    return {
+        "k": jax.lax.dynamic_update_slice(
+            slot_cache["k"], cache["k"].astype(slot_cache["k"].dtype),
+            (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            slot_cache["v"], cache["v"].astype(slot_cache["v"].dtype),
+            (0, slot, 0, 0, 0)),
+        "pos": jax.lax.dynamic_update_slice(
+            slot_cache["pos"],
+            jnp.reshape(cache["pos"], (1,)).astype(jnp.int32), (slot,)),
+    }
+
+
+def _rotate_slots(x: jnp.ndarray, cos: jnp.ndarray,
+                  sin: jnp.ndarray) -> jnp.ndarray:
+    """apply_rotary for PER-SLOT positions: cos/sin are [S, 1, 1, hd//2]
+    (one angle row per slot) instead of the shared [seq, hd//2] table.
+    Same fp32 rotate-half math, so slot decode matches the batch-1
+    path bit-for-bit."""
+    x32 = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def decode_step_slots(params: Params, token: jnp.ndarray, cache: KVCache,
+                      active: jnp.ndarray, cfg: TransformerConfig
+                      ) -> Tuple[jnp.ndarray, KVCache]:
+    """One continuous-batching decode step over ALL slots at once.
+
+    ``token`` [S] int32 (each slot's last token; free/paused slots may
+    carry any value), ``cache`` a slot cache with per-slot ``pos`` [S],
+    ``active`` [S] bool.  → (logits [S, vocab], cache') where ``pos``
+    advances only on active slots.  Inactive slots still compute (the
+    batch shape is FIXED — that is what keeps this a single compiled
+    program) but their K/V write lands at their un-advanced ``pos`` and
+    is overwritten by the next active step before any read, and their
+    logits are discarded by the engine.
+    """
+    _check_decodable(cfg)
+    s = token.shape[0]
+    dt = cfg.dtype
+    pos = cache["pos"]                                         # [S]
+    max_len = cache["k"].shape[2]
+    x = params["embed"]["tok"][token][:, None].astype(dt)      # [S,1,D]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["pos"][pos][:, None].astype(dt)
+    if cfg.pos_emb == "rope":
+        full_cos, full_sin = rotary_angles(max_len, cfg.head_dim,
+                                           cfg.rope_base)
+        cos = full_cos[pos][:, None, None, :]                  # [S,1,1,·]
+        sin = full_sin[pos][:, None, None, :]
+    else:
+        cos = sin = None
+
+    h, hk, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    slot_ix = jnp.arange(s)
+    mask = jnp.arange(max_len)[None, :] <= pos[:, None]        # [S, T]
+
+    def body(carry, inputs):
+        xc = carry
+        lp, ck, cv = inputs                                    # per-layer
+        y = _norm(cfg, xc, lp["attn_norm"], lp.get("attn_norm_b"))
+        q = jnp.einsum("bsd,dhk->bshk", y, lp["wq"].astype(dt))
+        k_new = jnp.einsum("bsd,dhk->bshk", y, lp["wk"].astype(dt))
+        v_new = jnp.einsum("bsd,dhk->bshk", y, lp["wv"].astype(dt))
+        if cfg.pos_emb == "rope":
+            q = _rotate_slots(q, cos, sin)
+            k_new = _rotate_slots(k_new, cos, sin)
+        # per-slot write positions: scatter instead of the batch-1
+        # path's dynamic_update_slice (slots decode at DIFFERENT pos)
+        ck = ck.at[slot_ix, pos].set(k_new[:, 0].astype(cfg.dtype))
+        cv = cv.at[slot_ix, pos].set(v_new[:, 0].astype(cfg.dtype))
+        qh = q[:, 0].reshape(s, hk, h // hk, hd)
+        scores = jnp.einsum("bkgd,btkd->bkgt", qh,
+                            ck.astype(dt)) / jnp.sqrt(float(hd))
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum("bkgt,btkd->bkgd", probs.astype(dt),
+                          cv.astype(dt))
+        attn = attn.reshape(s, 1, h, hd)
+        xc = xc + jnp.einsum("bshk,hkd->bsd", attn,
+                             lp["wo"].astype(dt))
+        y2 = _norm(cfg, xc, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        z, _ = _ffn(cfg, y2, lp)
+        xc = xc + z
+        return xc, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["layers"], cache["k"], cache["v"]))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], _unembed(params, cfg))
+    return logits.astype(jnp.float32), {
+        "k": ks, "v": vs, "pos": pos + active.astype(jnp.int32)}
+
+
 def _sample(logits: jnp.ndarray, key: jax.Array, greedy: bool,
             temperature: jnp.ndarray, top_k: Optional[int]) -> jnp.ndarray:
     if greedy:

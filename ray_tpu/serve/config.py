@@ -30,6 +30,29 @@ class HTTPOptions:
 
 
 @dataclasses.dataclass
+class DecodeEngineConfig:
+    """Knobs of the replica-resident continuous-batching decode engine
+    (`serve/decode_session.py`).  One fixed-slot batched KV cache and
+    one jitted decode step are shared by every live session; these
+    bounds govern admission and token buffering."""
+    # decode slots in the batched KV cache — the compiled batch size.
+    # Sessions beyond this wait for a slot (iteration-level admission).
+    max_slots: int = 8
+    # per-session bounded token queue: the engine decodes ahead of the
+    # client by at most this many tokens, then pauses the slot
+    token_queue_depth: int = 64
+    # sessions allowed to wait for a slot before `start` is rejected
+    # with ReplicaUnavailableError (→ HTTP 503 + Retry-After)
+    max_waiting: int = 32
+    # how long a `next_chunk` drain will linger for its chunk to fill
+    # once at least one token is buffered (amortizes transport without
+    # stalling slow decodes)
+    chunk_linger_s: float = 0.025
+    # server-side cap on one `next_chunk` wait with an empty queue
+    chunk_timeout_s: float = 30.0
+
+
+@dataclasses.dataclass
 class DeploymentConfig:
     num_replicas: int = 1
     max_concurrent_queries: int = 8

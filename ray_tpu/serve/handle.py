@@ -50,14 +50,20 @@ def is_replica_down_error(exc: BaseException) -> bool:
 
 def call_with_retry(router, name: str, args, kwargs,
                     method: Optional[str] = None,
-                    timeout_s: float = 60.0, attempts: int = 3) -> Any:
+                    timeout_s: float = 60.0, attempts: int = 3,
+                    sticky_replica_id: Optional[str] = None) -> Any:
     """Assign + get with replica-failure retry under ONE deadline (the
     reference router's handling of dead replicas).  A request that
     raced a replica teardown re-routes to a live replica after a table
     refresh; user errors propagate untouched on the first attempt.
     Retry attempts are spaced by capped full-jitter backoff so a burst
     of failed requests doesn't hammer the table refresh and the
-    surviving replicas in lockstep."""
+    surviving replicas in lockstep.
+
+    A ``sticky_replica_id`` request (decode-session ops: the KV cache
+    lives on one replica) never re-routes: the replica dying took the
+    session with it, so the failure propagates for the caller to
+    surface (the SSE lane turns it into an in-band error event)."""
     import time as _time
 
     from ..core.config import GlobalConfig
@@ -67,14 +73,16 @@ def call_with_retry(router, name: str, args, kwargs,
                             cap=GlobalConfig.serve_backoff_cap_s)
     for attempt in range(attempts):
         budget = max(0.1, deadline - _time.monotonic())
-        ref, rid = router.assign_request(name, args, kwargs, method,
-                                         timeout_s=budget)
+        ref, rid = router.assign_request(
+            name, args, kwargs, method, timeout_s=budget,
+            sticky_replica_id=sticky_replica_id)
         try:
             return api.get(ref,
                            timeout=max(0.1,
                                        deadline - _time.monotonic()))
         except Exception as e:
             if attempt == attempts - 1 or not is_replica_down_error(e) \
+                    or sticky_replica_id is not None \
                     or _time.monotonic() >= deadline:
                 raise
             router._refresh(force=True)

@@ -63,14 +63,24 @@ class HTTPProxy:
                 headers={"Retry-After":
                          str(max(1, int(round(e.retry_after_s))))})
 
-        def make_call(name, payload):
+        def make_call(name, payload, sticky=None):
             def call():
                 from ..core.config import GlobalConfig
+                from ..exceptions import TaskError
                 from .handle import call_with_retry
                 args = (payload,) if payload is not None else ()
-                return call_with_retry(
-                    self._router, name, args, {},
-                    timeout_s=GlobalConfig.serve_request_timeout_s)
+                try:
+                    return call_with_retry(
+                        self._router, name, args, {},
+                        timeout_s=GlobalConfig.serve_request_timeout_s,
+                        sticky_replica_id=sticky)
+                except TaskError as e:
+                    # a replica-side typed shed (decode-engine admission
+                    # backpressure) arrives wrapped as the task error;
+                    # unwrap so the 503 + Retry-After mapping fires
+                    if isinstance(e.cause, ReplicaUnavailableError):
+                        raise e.cause from None
+                    raise
             return call
 
         async def stream_tokens(request, name, payload):
@@ -79,13 +89,30 @@ class HTTPProxy:
             the PROXY drives a decode-session deployment
             (serve/decode_session.py protocol) and emits one SSE event
             per token, so clients get tokens as they decode instead of
-            one request per token."""
+            one request per token.
+
+            Two transport lanes: replicas whose `start` reply announces
+            ``proto: "chunk"`` (the continuous-batching engine) are
+            drained via ``next_chunk`` — ONE sid-sticky router round
+            trip per N buffered tokens — while legacy replicas fall back
+            to one `next` RPC per token.  Either way the CLIENT contract
+            is unchanged: one SSE event per token."""
+            from ..core.config import GlobalConfig
             max_new = int(payload.pop("max_new_tokens", 64))
+            chunk = int(payload.pop("chunk_tokens", 0) or
+                        GlobalConfig.serve_stream_chunk_tokens)
             # the start op runs BEFORE headers go out: a failure here
-            # still gets a clean HTTP 500 from the caller
+            # still gets a clean HTTP 500/503 from the caller
             out = await loop.run_in_executor(
                 self._pool, make_call(name, {"op": "start", **payload}))
             sid = out.get("sid") if isinstance(out, dict) else None
+            chunked = isinstance(out, dict) and \
+                out.pop("proto", None) == "chunk"
+            # engine sids carry their owner: "<replica_id>:<n>" — every
+            # follow-up op for this session is pinned to that replica
+            sticky = sid.rsplit(":", 1)[0] \
+                if chunked and isinstance(sid, str) and ":" in sid \
+                else None
             resp = web.StreamResponse(headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache"})
@@ -104,14 +131,35 @@ class HTTPProxy:
                 await resp.prepare(request)
                 await emit(out)
                 if sid is not None and "error" not in out:
-                    for _ in range(max_new - 1):
-                        out = await loop.run_in_executor(
-                            self._pool,
-                            make_call(name, {"op": "next", "sid": sid}))
-                        await emit(out)
-                        if not isinstance(out, dict) or "error" in out \
-                                or out.get("eos"):
-                            break
+                    if chunked:
+                        emitted = 1   # start already carried token #1
+                        while emitted < max_new:
+                            out = await loop.run_in_executor(
+                                self._pool,
+                                make_call(name, {
+                                    "op": "next_chunk", "sid": sid,
+                                    "max_tokens": min(chunk,
+                                                      max_new - emitted),
+                                }, sticky))
+                            if not isinstance(out, dict) \
+                                    or "error" in out:
+                                await emit(out)
+                                break
+                            for tok in out.get("tokens", ()):
+                                await emit({"token": [tok]})
+                                emitted += 1
+                            if out.get("done"):
+                                break
+                    else:
+                        for _ in range(max_new - 1):
+                            out = await loop.run_in_executor(
+                                self._pool,
+                                make_call(name,
+                                          {"op": "next", "sid": sid}))
+                            await emit(out)
+                            if not isinstance(out, dict) \
+                                    or "error" in out or out.get("eos"):
+                                break
             except Exception as e:
                 try:
                     await emit({"error": str(e)})
@@ -119,9 +167,13 @@ class HTTPProxy:
                     pass    # connection already gone
             finally:
                 if sid is not None:
-                    await loop.run_in_executor(
-                        self._pool,
-                        make_call(name, {"op": "end", "sid": sid}))
+                    try:
+                        await loop.run_in_executor(
+                            self._pool,
+                            make_call(name, {"op": "end", "sid": sid},
+                                      sticky))
+                    except Exception:
+                        pass   # owner died mid-stream: nothing to free
             try:
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()

@@ -161,9 +161,20 @@ class ServeReplica:
 
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
-            return {"replica_id": self.replica_id,
-                    "num_ongoing": self._num_ongoing,
-                    "total": self._total}
+            out = {"replica_id": self.replica_id,
+                   "num_ongoing": self._num_ongoing,
+                   "total": self._total}
+        # decode-session deployments expose their continuous-batching
+        # engine's occupancy/queue counters (the callable convention:
+        # any `engine_stats()` method merges into replica metrics, so
+        # autoscalers/dashboards see slot pressure, not just RPC counts)
+        target = self._callable
+        if not self._is_function and hasattr(target, "engine_stats"):
+            try:
+                out["engine"] = target.engine_stats()
+            except Exception:
+                pass
+        return out
 
     def health_check(self) -> bool:
         self._chaos_site("serve.health_check")

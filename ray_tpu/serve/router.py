@@ -110,9 +110,18 @@ class Router:
 
     def assign_request(self, name: str, args: tuple, kwargs: dict,
                        method: Optional[str] = None,
-                       timeout_s: float = 60.0):
+                       timeout_s: float = 60.0,
+                       sticky_replica_id: Optional[str] = None):
         """Pick a non-saturated replica round-robin and return the result
         ObjectRef; counts in-flight per replica.
+
+        ``sticky_replica_id`` pins the request to ONE replica (decode
+        sessions: a session's KV cache lives on the replica that ran
+        `start`, so its `next_chunk`/`end` must land there — never on a
+        load-balancing pass).  A sticky request waits out a saturated
+        owner but NEVER spills to a sibling; a vanished owner (scale
+        down, crash) raises ReplicaUnavailableError after one forced
+        table refresh, because the session died with it.
 
         Graceful degradation: a deployment with ZERO live replicas sheds
         the request immediately with the typed ReplicaUnavailableError
@@ -135,7 +144,16 @@ class Router:
                 replicas = entry["replicas"] if entry else []
                 cap = entry.get("max_concurrent_queries", 8) if entry else 0
                 chosen = None
-                if replicas:
+                sticky_gone = False
+                if sticky_replica_id is not None:
+                    rep = next((r for r in replicas
+                                if r["id"] == sticky_replica_id), None)
+                    if rep is None or \
+                            rep.get("node_id") in self._down_nodes:
+                        sticky_gone = True
+                    elif self._inflight.get(rep["id"], 0) < cap:
+                        chosen = rep
+                elif replicas:
                     # Least-loaded with local preference: locality is a
                     # TIE-BREAK among the least-loaded candidates, never
                     # a magnet — preferring any under-cap local replica
@@ -165,6 +183,18 @@ class Router:
                 ref = chosen["handle"].handle_request.remote(
                     args, kwargs, method)
                 return ref, chosen["id"]
+            if sticky_replica_id is not None and sticky_gone:
+                # the session's owner is out of the table: one forced
+                # refresh guards against staleness, then fail loudly —
+                # re-routing would hand the sid to a replica that has
+                # no such KV cache
+                if confirmed_empty:
+                    raise ReplicaUnavailableError(
+                        f"{name} (replica {sticky_replica_id} owning "
+                        f"this decode session is gone)")
+                confirmed_empty = True
+                self._refresh(force=True)
+                continue
             if not replicas:
                 # unknown deployment or zero live replicas: one forced
                 # refresh guards against a stale table (deploy racing the
