@@ -2,7 +2,8 @@
 # tree): native object store + transfer plane, C++ driver API, wheel.
 PY ?= python
 
-.PHONY: all native cpp wheel test bench serve-bench obs chaos drain clean
+.PHONY: all native cpp wheel test bench serve-bench obs chaos drain \
+	failover clean
 
 all: native cpp
 
@@ -41,6 +42,13 @@ chaos:
 # traffic, injected evacuation failure -> lineage fallback).
 drain:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_drain.py -q
+
+# Failover suite: decode-stream failover — replay-journal/seq-dedupe
+# units, teacher-forced resume parity, chaos mid-stream replica kill
+# with byte-identical recovery, and the `slow` multi-node drain of a
+# node hosting live streams (zero dropped sessions).
+failover:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_failover.py -q
 
 bench:
 	$(PY) bench.py

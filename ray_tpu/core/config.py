@@ -280,6 +280,19 @@ _d("serve_backoff_base_s", float, 0.01,
    "retry attempts in call_with_retry.")
 _d("serve_backoff_cap_s", float, 0.2,
    "Cap of the Serve router/handle retry backoff.")
+_d("serve_session_failover_attempts", int, 6,
+   "Minimum resume attempts a failed decode stream makes (teacher-"
+   "forced prefix prefill on a healthy replica) before the failure "
+   "may surface to the client as an in-band SSE error.")
+_d("serve_session_failover_timeout_s", float, 30.0,
+   "Wall-clock budget for decode-stream resume retries: fast "
+   "rejections (every replica still shedding while a replacement "
+   "boots) keep retrying under backoff until this elapses, even after "
+   "serve_session_failover_attempts tries.")
+_d("serve_session_migration_timeout_s", float, 30.0,
+   "How long the serve controller waits for live decode sessions to "
+   "migrate off a draining replica before stopping it anyway (the "
+   "proxy-side failover path then covers any stragglers).")
 _d("serve_gang_ready_timeout_s", float, 300.0,
    "How long gang-replica bring-up may take (PG + N actors + "
    "jax.distributed rendezvous + model load) before the replica is "

@@ -85,6 +85,13 @@ SERVE_TOKENS = m.Counter(
     "Tokens decoded by replica continuous-batching engines "
     "(decode_session.py); registered in the replica's process",
     ("deployment",))
+SERVE_SESSIONS_MIGRATED = m.Counter(
+    "ray_tpu_serve_sessions_migrated_total",
+    "Decode sessions re-admitted on a healthy replica by the proxy-side "
+    "failover path (serve/failover.py), by trigger: replica_death "
+    "(owner crashed / node died), drain (owner's replica evacuating), "
+    "error (persistent request failure or a lost destructive "
+    "next_chunk reply)", ("reason",))
 
 # -------------------------------------------------- latency histograms
 # Per-phase breakdown of a task's life, derived from the same lifecycle
@@ -118,6 +125,12 @@ SERVE_DECODE_OCCUPANCY = m.Histogram(
     "the batched decode program runs (the serve-vs-raw decode gap closes "
     "as this climbs toward max_slots)",
     (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0), ("deployment",))
+SERVE_FAILOVER_LATENCY = m.Histogram(
+    "ray_tpu_serve_session_failover_seconds",
+    "Wall time of one decode-session failover: recovery trigger to the "
+    "resumed session's first token on the new replica (the client-"
+    "visible stall)",
+    (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0), ("deployment",))
 DRAIN_DURATION = m.Histogram(
     "ray_tpu_node_drain_duration_seconds",
     "Wall time of one node drain, start to deregister/fallback",

@@ -17,6 +17,7 @@ from .generate import (  # noqa: F401
     prefill,
     prefill_chunk,
     prefill_chunked,
+    resume_prefill,
 )
 from .transformer import (  # noqa: F401
     TransformerConfig,

@@ -185,6 +185,40 @@ def prefill_chunked(params: Params, tokens: jnp.ndarray,
     return logits, cache
 
 
+def resume_prefill(params: Params, tokens: jnp.ndarray,
+                   cfg: TransformerConfig, cache: KVCache,
+                   *, chunk: int = 32,
+                   _jitted=None) -> Tuple[jnp.ndarray, KVCache]:
+    """Teacher-forced prefix prefill for decode-session failover.
+
+    A resumed session replays ``prompt + tokens-generated-so-far`` into a
+    fresh cache, and that prefix has an *arbitrary* length — one compile
+    per resume length (the whole-prompt :func:`prefill` behavior) would
+    turn every failover into a compile storm.  This walks the prefix
+    through exactly TWO reusable chunk programs: ``[B, chunk]`` blocks,
+    then ``[B, 1]`` steps for the remainder — so resuming at any point of
+    any stream reuses the same compiled code.
+
+    Greedy replay is deterministic: the logits of the last position are
+    (numerically) the same the uninterrupted session would have produced,
+    so the argmax — the next token — matches exactly."""
+    b, s = tokens.shape
+    if s > cache["k"].shape[2]:
+        raise ValueError(f"resume prefix length {s} exceeds cache "
+                         f"capacity {cache['k'].shape[2]}")
+    fn = _jitted or _prefill_chunk_jit
+    logits = None
+    off = 0
+    while off + chunk <= s:
+        logits, cache = fn(params, tokens[:, off:off + chunk], cache,
+                           cfg=cfg)
+        off += chunk
+    while off < s:
+        logits, cache = fn(params, tokens[:, off:off + 1], cache, cfg=cfg)
+        off += 1
+    return logits, cache
+
+
 def decode_step(params: Params, token: jnp.ndarray, cache: KVCache,
                 cfg: TransformerConfig) -> Tuple[jnp.ndarray, KVCache]:
     """One token [B] int32 → (next-token logits [B, vocab], cache')."""

@@ -297,19 +297,47 @@ def cmd_drain(args) -> None:
         ray_tpu.shutdown()
 
 
+def _load_chaos_plan(path):
+    if not path:
+        sys.exit("chaos needs a JSON plan file for this operation")
+    with open(path) as f:
+        try:
+            return json.load(f)
+        except json.JSONDecodeError as e:
+            sys.exit(f"{path}: not valid JSON: {e}")
+
+
 def cmd_chaos(args) -> None:
     """Fault-injection (chaos) plan control: apply a JSON plan file
-    cluster-wide (controller KV + pubsub fan-out), clear it, or show the
-    current plan + this process's injection counts."""
+    cluster-wide (controller KV + pubsub fan-out), clear it, show the
+    current plan + this process's injection counts, or validate a plan
+    file offline (no cluster needed) — a typoed site or bad matcher
+    otherwise fails SILENTLY by never firing."""
     import ray_tpu
     from ray_tpu import chaos
+    from ray_tpu.util import fault_injection as fi
+    if args.op == "validate":
+        plan = _load_chaos_plan(args.plan)
+        issues = fi.validate_plan(plan)
+        if issues:
+            for issue in issues:
+                print(f"ERROR: {issue}")
+            sys.exit(f"{args.plan}: {len(issues)} issue(s) — this plan "
+                     f"would misfire or never fire")
+        n = len(plan)
+        print(f"{args.plan}: OK ({n} rule(s), all sites/matchers valid)")
+        return
     _connect(args)
     try:
         if args.op == "apply":
-            if not args.plan:
-                sys.exit("chaos apply needs a JSON plan file")
-            with open(args.plan) as f:
-                plan = json.load(f)
+            plan = _load_chaos_plan(args.plan)
+            issues = fi.validate_plan(plan)
+            if issues:
+                for issue in issues:
+                    print(f"ERROR: {issue}")
+                sys.exit("refusing to apply a plan that would misfire; "
+                         "fix it or dry-run with `ray-tpu chaos "
+                         "validate`")
             n = chaos.apply(plan)
             print(f"chaos plan applied: {n} rule(s)")
         elif args.op == "clear":
@@ -437,11 +465,16 @@ def main(argv=None) -> None:
 
     sp = sub.add_parser("chaos",
                         help="fault-injection plan control "
-                             "(apply/clear/status)")
-    sp.add_argument("op", choices=["apply", "clear", "status"])
+                             "(apply/clear/status/validate)")
+    sp.add_argument("op", choices=["apply", "clear", "status",
+                                   "validate"])
     sp.add_argument("plan", nargs="?",
-                    help="JSON plan file (for apply); rule schema in "
-                         "ray_tpu/util/fault_injection.py")
+                    help="JSON plan file (for apply/validate); rule "
+                         "schema in ray_tpu/util/fault_injection.py. "
+                         "`validate` lints offline — unknown sites, "
+                         "bad regexes, conflicting once rules — so a "
+                         "plan that would silently never fire fails "
+                         "fast")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_chaos)
 

@@ -37,7 +37,9 @@ from .air_integrations import (  # noqa: F401
 )
 from .batching import batch  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
+from .config import DecodeEngineConfig  # noqa: F401
 from .deployment import Deployment, deployment  # noqa: F401
+from .failover import FailoverSession, StreamFailedError  # noqa: F401
 from .ingress import ingress, route  # noqa: F401
 from .replica import ReplicaContext, get_replica_context  # noqa: F401
 from .gang import GangContext, get_gang_context  # noqa: F401

@@ -50,6 +50,11 @@ class DecodeEngineConfig:
     chunk_linger_s: float = 0.025
     # server-side cap on one `next_chunk` wait with an empty queue
     chunk_timeout_s: float = 30.0
+    # leak reaper: a session whose client has not polled (`next_chunk`)
+    # for this long is evicted and its slot reclaimed — abandoned
+    # streams (client crashed without `end`) must not hold decode slots
+    # or session-table memory forever.  <= 0 disables the reaper.
+    session_idle_ttl_s: float = 120.0
 
 
 @dataclasses.dataclass

@@ -405,6 +405,31 @@ class ServeController:
             if row.get("state") != "ALIVE" or row.get("node_id") in draining:
                 continue  # replacement not ready yet; next tick
             from .. import api
+            if not rep.get("gang"):
+                # Migrate live decode sessions off the doomed replica
+                # BEFORE stopping it: flip its engines into drain mode
+                # (new starts shed with the typed 503; streams hand off
+                # via the ``migrating`` reply and the proxy's failover
+                # client re-admits them on the replacement), then wait
+                # — bounded — for the live-session count to reach zero
+                # so a drain with active streams drops none of them.
+                from ..core.config import GlobalConfig
+                if "session_deadline" not in info:
+                    info["session_deadline"] = now + \
+                        GlobalConfig.serve_session_migration_timeout_s
+                    try:
+                        api.get(rep["handle"].prepare_drain.remote(),
+                                timeout=10.0)
+                    except Exception:
+                        pass  # dead/hung replica: the deadline covers it
+                live = 0
+                try:
+                    live = api.get(rep["handle"].drain_status.remote(),
+                                   timeout=5.0).get("live_sessions", 0)
+                except Exception:
+                    live = 0
+                if live > 0 and now < info["session_deadline"]:
+                    continue   # sessions still handing off; next tick
             entry["replicas"].remove(rep)
             self._replica_nodes.pop(rid, None)
             self._audit_kill(info["name"], rid, -2)

@@ -67,9 +67,10 @@ class _EngineSession:
     waiting for admission), bounded token queue, and terminal state."""
 
     __slots__ = ("sid", "slot", "queue", "last_tok", "pos", "done",
-                 "error", "ended")
+                 "error", "ended", "seq", "last_poll")
 
-    def __init__(self, sid: str, last_tok: int, pos: int):
+    def __init__(self, sid: str, last_tok: int, pos: int,
+                 seq_base: int = 0):
         self.sid = sid
         self.slot: Optional[int] = None
         self.queue: collections.deque = collections.deque()
@@ -78,6 +79,12 @@ class _EngineSession:
         self.done = False             # no more tokens will be produced
         self.error: Optional[str] = None
         self.ended = False            # client sent `end`
+        # seq of the next token to be DELIVERED (the start/resume reply
+        # itself carries token seq_base) — replies stamp their first
+        # token's seq so the failover client can dedupe replayed tokens
+        # and detect a destructively-popped chunk whose reply was lost
+        self.seq = seq_base + 1
+        self.last_poll = time.monotonic()  # leak-reaper clock
 
 
 class ContinuousBatchingEngine:
@@ -124,34 +131,55 @@ class ContinuousBatchingEngine:
         self._next_sid = 0
         self._thread: Optional[threading.Thread] = None
         self._shutdown = False
+        self._draining = False   # replica evacuating: hand sessions off
         self.steps = 0
         self.tokens = 0
+        self.reaped = 0          # sessions evicted by the idle reaper
 
     # ------------------------------------------------------------ client ops
 
-    def start(self, prompt, max_sessions: int) -> Dict[str, Any]:
+    def start(self, prompt, max_sessions: int, seq_base: int = 0,
+              teacher_forced: bool = False) -> Dict[str, Any]:
         """Prefill one batch-1 prompt and enqueue the session for
         iteration-level admission; returns immediately with the sid and
         first token (a waiting session's tokens start flowing once a
-        slot frees)."""
+        slot frees).
+
+        ``teacher_forced`` is the failover-resume path: ``prompt`` is a
+        full replay prefix (original prompt + every token already
+        delivered to the client) walked through the bounded-compile
+        :func:`models.resume_prefill` programs, and the session's token
+        seqs continue from ``seq_base`` so the client can splice the
+        resumed stream in without duplicates or gaps."""
         import jax.numpy as jnp
 
         from ..exceptions import ReplicaUnavailableError
         from ..models import init_kv_cache
         with self._cond:
+            if self._draining:
+                raise ReplicaUnavailableError(self.name)
             if not self._free and len(self._pending) >= self.ecfg.max_waiting:
                 raise ReplicaUnavailableError(self.name)
         cache = init_kv_cache(self.cfg, 1, self.max_len)
-        logits, cache = self._prefill(self.params, prompt,
-                                      cfg=self.cfg, cache=cache)
+        if teacher_forced:
+            from ..models import resume_prefill
+            logits, cache = resume_prefill(self.params, prompt, self.cfg,
+                                           cache)
+        else:
+            logits, cache = self._prefill(self.params, prompt,
+                                          cfg=self.cfg, cache=cache)
         tok = int(jnp.argmax(logits, axis=-1).astype(jnp.int32)[0])
         with self._cond:
             # admission re-check: concurrent starts raced the prefill
+            # (a drain may also have begun while we were prefilling)
+            if self._draining:
+                raise ReplicaUnavailableError(self.name)
             if not self._free and len(self._pending) >= self.ecfg.max_waiting:
                 raise ReplicaUnavailableError(self.name)
             sid = f"{self._tag}:{self._next_sid}"
             self._next_sid += 1
-            sess = _EngineSession(sid, tok, int(prompt.shape[1]))
+            sess = _EngineSession(sid, tok, int(prompt.shape[1]),
+                                  seq_base=seq_base)
             if sess.pos >= self.max_len:
                 sess.done = True      # prompt filled the cache exactly
             # LRU bound on ABANDONED sessions: evict the oldest
@@ -167,7 +195,11 @@ class ContinuousBatchingEngine:
                 self._pending.append((sess, cache))
             self._ensure_thread()
             self._cond.notify_all()
-        return {"sid": sid, "token": [tok], "proto": "chunk"}
+        reply = {"sid": sid, "token": [tok], "proto": "chunk",
+                 "seq": seq_base}
+        if sess.done:
+            reply["done"] = True   # prompt/replay prefix filled the cache
+        return reply
 
     def next_chunk(self, sid: str, max_tokens: int = 16,
                    timeout_s: Optional[float] = None) -> Dict[str, Any]:
@@ -184,9 +216,12 @@ class ContinuousBatchingEngine:
             if sess is None:
                 return {"error": f"unknown session {sid!r} (ended, "
                                  f"evicted, or never started)"}
+            sess.last_poll = time.monotonic()
             while True:
                 if sess.error is not None:
                     return {"error": sess.error, "done": True}
+                if self._draining:
+                    break   # hand off what's buffered, don't wait
                 if len(sess.queue) >= max_tokens or \
                         (sess.queue and sess.done):
                     break
@@ -199,17 +234,31 @@ class ContinuousBatchingEngine:
                     wait = min(linger_deadline, deadline) - now
                 else:
                     if sess.done:
-                        return {"tokens": [], "done": True}
+                        return {"tokens": [], "done": True,
+                                "seq": sess.seq}
                     wait = deadline - now
                 if wait <= 0:
                     break
                 self._cond.wait(wait)
+            first_seq = sess.seq
             toks = [sess.queue.popleft()
                     for _ in range(min(len(sess.queue), max_tokens))]
+            sess.seq += len(toks)
             done = sess.done and not sess.queue
+            out = {"tokens": toks, "done": done, "seq": first_seq}
+            if self._draining and not done:
+                # replica evacuating: deliver the buffered tokens and
+                # hand the session over — the failover client re-admits
+                # it (teacher-forced resume) on a healthy replica, and
+                # popping it here lets the controller's migration wait
+                # see the live-session count drain to zero
+                out["migrating"] = True
+                sess.done = True
+                sess.ended = True
+                self.sessions.pop(sid, None)
             # draining may un-pause a slot whose queue was full
             self._cond.notify_all()
-        return {"tokens": toks, "done": done}
+        return out
 
     def end(self, sid: str) -> bool:
         with self._cond:
@@ -227,7 +276,33 @@ class ContinuousBatchingEngine:
                     "occupied_slots": len(self._slots),
                     "waiting": len(self._pending),
                     "sessions": len(self.sessions),
+                    "live_sessions": self._live_locked(),
+                    "draining": self._draining,
+                    "reaped": self.reaped,
                     "steps": self.steps, "tokens": self.tokens}
+
+    def _live_locked(self) -> int:
+        """Sessions a client may still come back for (not `end`ed):
+        the controller's drain wait counts these toward zero before
+        stopping the replica."""
+        return sum(1 for s in self.sessions.values() if not s.ended)
+
+    def begin_drain(self) -> int:
+        """Enter drain mode: shed new starts/resumes with the typed
+        ReplicaUnavailableError, stop stepping, and hand every live
+        session off on its next `next_chunk` poll (buffered tokens are
+        still delivered, stamped with a ``migrating`` flag that sends
+        the failover client to a healthy replica).  Returns the number
+        of sessions awaiting handoff."""
+        with self._cond:
+            self._draining = True
+            n = self._live_locked()
+            self._cond.notify_all()   # wake blocked next_chunk waits
+        return n
+
+    def live_sessions(self) -> int:
+        with self._cond:
+            return self._live_locked()
 
     def shutdown(self) -> None:
         with self._cond:
@@ -245,7 +320,20 @@ class ContinuousBatchingEngine:
             self._thread.start()
 
     def _reap_locked(self) -> None:
-        """Vacate slots of ended/finished sessions (between steps)."""
+        """Vacate slots of ended/finished sessions (between steps), and
+        evict sessions whose client stopped polling: an abandoned stream
+        (client crashed, never sent `end`) would otherwise decode to its
+        queue bound and then hold a slot plus session-table memory
+        forever."""
+        ttl = getattr(self.ecfg, "session_idle_ttl_s", 0.0) or 0.0
+        if ttl > 0:
+            now = time.monotonic()
+            for sid, sess in list(self.sessions.items()):
+                if not sess.ended and now - sess.last_poll > ttl:
+                    sess.done = True      # slot vacated just below
+                    sess.ended = True
+                    self.sessions.pop(sid, None)
+                    self.reaped += 1
         for slot, sess in list(self._slots.items()):
             if sess.done:
                 del self._slots[slot]
@@ -254,6 +342,8 @@ class ContinuousBatchingEngine:
 
     def _admit_locked(self) -> List[Tuple[_EngineSession, Any, int]]:
         admitted = []
+        if self._draining:
+            return admitted   # evacuating: no new slot occupancy
         while self._free and self._pending:
             sess, cache = self._pending.pop(0)
             if sess.ended:
@@ -265,7 +355,12 @@ class ContinuousBatchingEngine:
         return admitted
 
     def _collect_locked(self) -> List[_EngineSession]:
-        """Slots decoding THIS step: live sessions with queue room."""
+        """Slots decoding THIS step: live sessions with queue room.
+        A draining engine stops stepping — every live session is being
+        handed to a healthy replica, and the replay there regenerates
+        anything this engine would have decoded."""
+        if self._draining:
+            return []
         return [s for s in self._slots.values()
                 if not s.done and
                 len(s.queue) < self.ecfg.token_queue_depth]
@@ -357,11 +452,20 @@ class DecodeSessionCore:
 
     Protocol (msgpack/JSON-native):
       {"op": "start", "prompt": [S ints] | [[S ints]xB]} ->
-          {"sid": str|int, "token": [B ints]} (+ {"proto": "chunk"}
-          when the continuous-batching engine owns the session)
+          {"sid": str|int, "token": [B ints]} (+ {"proto": "chunk",
+          "seq": 0} when the continuous-batching engine owns the
+          session)
+      {"op": "resume", "prompt": [S ints], "generated": [G ints]} ->
+          same shape as an engine start, with "seq": G — failover
+          re-admission: teacher-forced prefix prefill of
+          prompt+generated into a fresh engine slot; the returned token
+          is exactly the one the uninterrupted session would have
+          produced next (greedy decode is deterministic)
       {"op": "next", "sid": ...} -> {"token": [B ints]}
       {"op": "next_chunk", "sid": str, "max_tokens": N} ->
-          {"tokens": [<=N ints], "done": bool}
+          {"tokens": [<=N ints], "done": bool, "seq": first token's
+          seq} (+ {"migrating": true} when the replica is draining and
+          the session must be resumed elsewhere)
       {"op": "end", "sid": ...} -> {"ended": bool}
       {"op": "stats"} -> engine/session counters (tests, dashboards)
 
@@ -459,6 +563,22 @@ class DecodeSessionCore:
                 while len(self.sessions) > self.max_sessions:
                     self.sessions.pop(next(iter(self.sessions)))
             return {"sid": sid, "token": tok.tolist()}
+        if op == "resume":
+            # failover re-admission (serve/failover.py): replay the
+            # journal — prompt + every token the client already has —
+            # through a teacher-forced prefix prefill into a fresh
+            # engine slot, continuing seqs at len(generated)
+            if self._engine_cfg is None:
+                return {"error": "resume requires the continuous-"
+                                 "batching engine (engine=False core)"}
+            prompt = req["prompt"]
+            if prompt and isinstance(prompt[0], (list, tuple)):
+                prompt = prompt[0]     # batched form: engine is B=1
+            generated = list(req.get("generated") or [])
+            prefix = jnp.asarray([list(prompt) + generated], jnp.int32)
+            return self.engine.start(prefix, self.max_sessions,
+                                     seq_base=len(generated),
+                                     teacher_forced=True)
         if op == "stats":
             out = {"legacy_sessions": len(self.sessions)}
             if self._engine is not None:

@@ -48,6 +48,20 @@ def is_replica_down_error(exc: BaseException) -> bool:
     return isinstance(exc, (ActorDiedError, WorkerCrashedError))
 
 
+def _shed_error(exc: BaseException):
+    """The typed 503 signal, whether raised router-side (zero live
+    replicas, sticky owner gone) or replica-side (decode-engine
+    admission backpressure, draining engine) — the latter arrives
+    wrapped in the remote TaskError."""
+    from ..exceptions import ReplicaUnavailableError, TaskError
+    if isinstance(exc, ReplicaUnavailableError):
+        return exc
+    if isinstance(exc, TaskError) and isinstance(
+            getattr(exc, "cause", None), ReplicaUnavailableError):
+        return exc.cause
+    return None
+
+
 def call_with_retry(router, name: str, args, kwargs,
                     method: Optional[str] = None,
                     timeout_s: float = 60.0, attempts: int = 3,
@@ -60,10 +74,20 @@ def call_with_retry(router, name: str, args, kwargs,
     of failed requests doesn't hammer the table refresh and the
     surviving replicas in lockstep.
 
+    A typed shed (``ReplicaUnavailableError`` — zero live replicas, or
+    replica-side admission backpressure) carries a server-sent
+    ``Retry-After`` hint; instead of the fixed retry cadence, attempts
+    after a shed are spaced by full-jitter delays sampled from that
+    hint (``uniform(0, retry_after * 2**n)``, capped) — the server said
+    when to come back, and jitter keeps a burst of shed clients from
+    returning in lockstep.  After ``attempts`` sheds the error
+    propagates (the HTTP proxy maps it to 503 + Retry-After).
+
     A ``sticky_replica_id`` request (decode-session ops: the KV cache
     lives on one replica) never re-routes: the replica dying took the
     session with it, so the failure propagates for the caller to
-    surface (the SSE lane turns it into an in-band error event)."""
+    surface (the SSE lane's failover client re-admits the session on a
+    healthy replica via teacher-forced replay)."""
     import time as _time
 
     from ..core.config import GlobalConfig
@@ -71,16 +95,43 @@ def call_with_retry(router, name: str, args, kwargs,
     deadline = _time.monotonic() + timeout_s
     bo = ExponentialBackoff(base=GlobalConfig.serve_backoff_base_s,
                             cap=GlobalConfig.serve_backoff_cap_s)
+    shed_bo = None   # built lazily from the first Retry-After hint
+
+    def _shed_wait(shed) -> bool:
+        """Sleep a full-jitter delay honoring the shed's Retry-After;
+        False when the deadline can't absorb another wait."""
+        nonlocal shed_bo
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            return False
+        if shed_bo is None:
+            ra = max(float(getattr(shed, "retry_after_s", 1.0) or 1.0),
+                     1e-3)
+            shed_bo = ExponentialBackoff(base=ra, cap=4.0 * ra)
+        _time.sleep(min(shed_bo.next_delay(), remaining))
+        return True
+
     for attempt in range(attempts):
         budget = max(0.1, deadline - _time.monotonic())
-        ref, rid = router.assign_request(
-            name, args, kwargs, method, timeout_s=budget,
-            sticky_replica_id=sticky_replica_id)
+        try:
+            ref, rid = router.assign_request(
+                name, args, kwargs, method, timeout_s=budget,
+                sticky_replica_id=sticky_replica_id)
+        except Exception as e:
+            shed = _shed_error(e)
+            if shed is None or sticky_replica_id is not None \
+                    or attempt == attempts - 1 or not _shed_wait(shed):
+                raise
+            continue
         try:
             return api.get(ref,
                            timeout=max(0.1,
                                        deadline - _time.monotonic()))
         except Exception as e:
+            shed = _shed_error(e)
+            if shed is not None and sticky_replica_id is None \
+                    and attempt < attempts - 1 and _shed_wait(shed):
+                continue
             if attempt == attempts - 1 or not is_replica_down_error(e) \
                     or sticky_replica_id is not None \
                     or _time.monotonic() >= deadline:

@@ -63,24 +63,28 @@ class HTTPProxy:
                 headers={"Retry-After":
                          str(max(1, int(round(e.retry_after_s))))})
 
+        def route_call(name, payload, sticky=None):
+            from ..core.config import GlobalConfig
+            from ..exceptions import TaskError
+            from .handle import call_with_retry
+            args = (payload,) if payload is not None else ()
+            try:
+                return call_with_retry(
+                    self._router, name, args, {},
+                    timeout_s=GlobalConfig.serve_request_timeout_s,
+                    sticky_replica_id=sticky)
+            except TaskError as e:
+                # a replica-side typed shed (decode-engine admission
+                # backpressure, draining engine) arrives wrapped as the
+                # task error; unwrap so the 503 + Retry-After mapping —
+                # and the failover client's classification — fire
+                if isinstance(e.cause, ReplicaUnavailableError):
+                    raise e.cause from None
+                raise
+
         def make_call(name, payload, sticky=None):
             def call():
-                from ..core.config import GlobalConfig
-                from ..exceptions import TaskError
-                from .handle import call_with_retry
-                args = (payload,) if payload is not None else ()
-                try:
-                    return call_with_retry(
-                        self._router, name, args, {},
-                        timeout_s=GlobalConfig.serve_request_timeout_s,
-                        sticky_replica_id=sticky)
-                except TaskError as e:
-                    # a replica-side typed shed (decode-engine admission
-                    # backpressure) arrives wrapped as the task error;
-                    # unwrap so the 503 + Retry-After mapping fires
-                    if isinstance(e.cause, ReplicaUnavailableError):
-                        raise e.cause from None
-                    raise
+                return route_call(name, payload, sticky)
             return call
 
         async def stream_tokens(request, name, payload):
@@ -96,23 +100,34 @@ class HTTPProxy:
             drained via ``next_chunk`` — ONE sid-sticky router round
             trip per N buffered tokens — while legacy replicas fall back
             to one `next` RPC per token.  Either way the CLIENT contract
-            is unchanged: one SSE event per token."""
+            is unchanged: one SSE event per token.
+
+            The chunked lane rides a :class:`FailoverSession`
+            (serve/failover.py): the proxy journals every emitted token,
+            and an owner-replica death or drain mid-stream is healed by
+            a teacher-forced resume on a healthy replica — the client
+            sees a stall, never an error and never a duplicate/missing
+            token.  A vanished CLIENT is cancelled eagerly: the loop
+            checks the transport each chunk and releases the session
+            instead of decoding to max_tokens into a full queue."""
             from ..core.config import GlobalConfig
+            from .failover import FailoverSession
             max_new = int(payload.pop("max_new_tokens", 64))
             chunk = int(payload.pop("chunk_tokens", 0) or
                         GlobalConfig.serve_stream_chunk_tokens)
+
+            def session_call(p, sticky=None):
+                return route_call(name, p, sticky)
+
+            sess = FailoverSession(session_call,
+                                   {"op": "start", **payload},
+                                   deployment=name)
             # the start op runs BEFORE headers go out: a failure here
             # still gets a clean HTTP 500/503 from the caller
-            out = await loop.run_in_executor(
-                self._pool, make_call(name, {"op": "start", **payload}))
+            out = await loop.run_in_executor(self._pool, sess.start)
             sid = out.get("sid") if isinstance(out, dict) else None
-            chunked = isinstance(out, dict) and \
-                out.pop("proto", None) == "chunk"
-            # engine sids carry their owner: "<replica_id>:<n>" — every
-            # follow-up op for this session is pinned to that replica
-            sticky = sid.rsplit(":", 1)[0] \
-                if chunked and isinstance(sid, str) and ":" in sid \
-                else None
+            if isinstance(out, dict):
+                out.pop("proto", None)
             resp = web.StreamResponse(headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache"})
@@ -121,57 +136,55 @@ class HTTPProxy:
                 await resp.write(
                     b"data: " + json.dumps(obj).encode() + b"\n\n")
 
+            def client_gone():
+                t = request.transport
+                return t is None or t.is_closing()
+
             # from here the session exists and this exchange IS the
             # response: prepare() itself can raise on a dead transport,
             # so it lives INSIDE the try — every exit path must release
-            # the replica's KV cache, and mid-stream failures become
-            # in-band error events (a second Response on a live stream
-            # corrupts the connection)
+            # the replica's KV cache, and unrecoverable mid-stream
+            # failures become in-band error events (a second Response
+            # on a live stream corrupts the connection)
             try:
                 await resp.prepare(request)
                 await emit(out)
-                if sid is not None and "error" not in out:
-                    if chunked:
-                        emitted = 1   # start already carried token #1
-                        while emitted < max_new:
-                            out = await loop.run_in_executor(
-                                self._pool,
-                                make_call(name, {
-                                    "op": "next_chunk", "sid": sid,
-                                    "max_tokens": min(chunk,
-                                                      max_new - emitted),
-                                }, sticky))
-                            if not isinstance(out, dict) \
-                                    or "error" in out:
-                                await emit(out)
-                                break
-                            for tok in out.get("tokens", ()):
-                                await emit({"token": [tok]})
-                                emitted += 1
-                            if out.get("done"):
-                                break
-                    else:
-                        for _ in range(max_new - 1):
-                            out = await loop.run_in_executor(
-                                self._pool,
-                                make_call(name,
-                                          {"op": "next", "sid": sid}))
-                            await emit(out)
-                            if not isinstance(out, dict) \
-                                    or "error" in out or out.get("eos"):
-                                break
+                if sess.chunked and sid is not None \
+                        and "error" not in out:
+                    emitted = len(sess.journal)  # start carried token #1
+                    while emitted < max_new and not sess.done:
+                        if client_gone():
+                            break   # client disconnected: cancel now
+                        out = await loop.run_in_executor(
+                            self._pool, sess.next_tokens,
+                            min(chunk, max_new - emitted))
+                        for tok in out["tokens"][:max_new - emitted]:
+                            await emit({"token": [tok]})
+                            emitted += 1
+                elif sid is not None and "error" not in out:
+                    for _ in range(max_new - 1):
+                        if client_gone():
+                            break
+                        out = await loop.run_in_executor(
+                            self._pool,
+                            make_call(name, {"op": "next", "sid": sid}))
+                        await emit(out)
+                        if not isinstance(out, dict) \
+                                or "error" in out or out.get("eos"):
+                            break
             except Exception as e:
                 try:
                     await emit({"error": str(e)})
                 except Exception:
                     pass    # connection already gone
             finally:
-                if sid is not None:
+                if sess.chunked:
+                    await loop.run_in_executor(self._pool, sess.end)
+                elif sid is not None:
                     try:
                         await loop.run_in_executor(
                             self._pool,
-                            make_call(name, {"op": "end", "sid": sid},
-                                      sticky))
+                            make_call(name, {"op": "end", "sid": sid}))
                     except Exception:
                         pass   # owner died mid-stream: nothing to free
             try:

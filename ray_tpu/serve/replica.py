@@ -182,3 +182,30 @@ class ServeReplica:
         if not self._is_function and hasattr(target, "check_health"):
             target.check_health()
         return True
+
+    # ---------------------------------------------- decode-session drain
+    def _my_engines(self):
+        """Continuous-batching engines living in THIS replica's process
+        (decode_session registers every engine in a process-wide set;
+        filter by replica tag in case a worker ever hosts several)."""
+        from .decode_session import _ENGINES
+        return [eng for eng in list(_ENGINES)
+                if getattr(eng, "_tag", None) in (self.replica_id,
+                                                  "local")]
+
+    def prepare_drain(self) -> int:
+        """Replica is about to be stopped (node drain evacuation): put
+        every decode engine into drain mode so live sessions hand
+        themselves off — new starts shed with the typed 503, blocked
+        `next_chunk` waits wake and deliver their buffered tokens with
+        the ``migrating`` flag, and the proxy-side failover client
+        re-admits each session on a healthy replica.  Returns the
+        number of sessions awaiting handoff."""
+        return sum(eng.begin_drain() for eng in self._my_engines())
+
+    def drain_status(self) -> Dict[str, Any]:
+        """Live-session count the controller polls before stopping a
+        draining replica — zero means every stream has migrated (or
+        ended) and the replica can die without dropping a session."""
+        return {"live_sessions": sum(eng.live_sessions()
+                                     for eng in self._my_engines())}

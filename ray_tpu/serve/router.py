@@ -121,7 +121,10 @@ class Router:
         load-balancing pass).  A sticky request waits out a saturated
         owner but NEVER spills to a sibling; a vanished owner (scale
         down, crash) raises ReplicaUnavailableError after one forced
-        table refresh, because the session died with it.
+        table refresh, because the session's KV cache died with it —
+        the proxy-side failover client (serve/failover.py) then
+        re-admits the session on a healthy replica via teacher-forced
+        replay of its journal, so the stream survives the owner.
 
         Graceful degradation: a deployment with ZERO live replicas sheds
         the request immediately with the typed ReplicaUnavailableError

@@ -44,6 +44,11 @@ site                        actions
 ``serve.request``           ``crash`` (replica dies mid-request), ``error``,
                             ``delay``/``latency``
 ``serve.health_check``      ``error`` (health check fails)
+``serve.session_failover``  attacks decode-stream RECOVERY itself
+                            (serve/failover.py): ``error`` fails the
+                            resume (the stream surfaces the in-band
+                            error the failover would have hidden),
+                            ``delay`` stretches the client-visible stall
 ``drain.evacuate``          any action fails that object's evacuation during a
                             node drain (the object rides the node to its death
                             and must come back via lineage reconstruction)
@@ -79,6 +84,30 @@ CHAOS_KV_NS = "chaos"
 CHAOS_KV_KEY = b"plan"
 METRIC_NAME = "ray_tpu_chaos_injected_total"
 CRASH_EXIT_CODE = 170  # distinguishable from user exits in worker logs
+
+#: Every injection site threaded through the runtime, with the actions
+#: that site understands (None = any action blackholes/fails the site).
+#: ``delay``/``latency`` are universally valid.  `ray-tpu chaos
+#: validate` lints plans against this registry so a typoed site or
+#: action fails FAST instead of silently never firing.
+KNOWN_SITES: Dict[str, Optional[frozenset]] = {
+    "rpc.send": frozenset({"drop", "sever", "error"}),
+    "rpc.connect": frozenset({"error", "drop"}),
+    "nodelet.lease": frozenset({"kill_worker"}),
+    "nodelet.heartbeat": None,
+    "object.fetch_meta": frozenset({"evict"}),
+    "worker.before_put": frozenset({"crash", "error"}),
+    "worker.after_put": frozenset({"crash", "error"}),
+    "serve.request": frozenset({"crash", "error", "fail"}),
+    "serve.health_check": frozenset({"error", "fail"}),
+    "serve.session_failover": frozenset({"error", "fail"}),
+    "drain.evacuate": None,
+    "drain.deadline": None,
+}
+_UNIVERSAL_ACTIONS = frozenset({"delay", "latency"})
+_RULE_KEYS = frozenset({"site", "action", "match", "delay_s", "once",
+                        "max_fires", "proc", "id", "seed"})
+_MATCH_KEYS = frozenset({"nth", "prob", "seed", "regex"})
 
 #: The armed plan, or None when the chaos layer is disabled.  Hot paths
 #: outside the import-cycle modules guard with ``fi.ACTIVE is not None``.
@@ -253,6 +282,113 @@ def _sync_hooks(fp: Optional["FaultPlan"]) -> None:
 
 def plan_snapshot() -> Optional[List[Dict[str, Any]]]:
     return list(ACTIVE.raw) if ACTIVE is not None else None
+
+
+# ---------------------------------------------------------------- validation
+
+def validate_plan(plan: Any) -> List[str]:
+    """Lint a chaos plan; returns human-readable issues (empty = clean).
+
+    A malformed plan mostly fails SILENTLY at runtime — an unknown site
+    never fires, a bad regex raises at arm time in every process, two
+    ``once`` rules sharing an id starve each other at the claim — so
+    `ray-tpu chaos validate <plan.json>` runs these checks up front."""
+    issues: List[str] = []
+    if not isinstance(plan, list):
+        return [f"plan must be a JSON list of rules, got "
+                f"{type(plan).__name__}"]
+    seen_ids: Dict[str, int] = {}
+    for i, d in enumerate(plan):
+        tag = f"rule #{i}"
+        if not isinstance(d, dict):
+            issues.append(f"{tag}: not an object "
+                          f"({type(d).__name__})")
+            continue
+        site = d.get("site")
+        if d.get("id"):
+            tag = f"rule #{i} ({d['id']!r})"
+        elif site:
+            tag = f"rule #{i} ({site})"
+        for k in d:
+            if k not in _RULE_KEYS:
+                issues.append(f"{tag}: unknown key {k!r} "
+                              f"(known: {', '.join(sorted(_RULE_KEYS))})")
+        if not site:
+            issues.append(f"{tag}: missing 'site'")
+        elif site not in KNOWN_SITES:
+            issues.append(
+                f"{tag}: unknown site {site!r} — the rule would never "
+                f"fire (known: {', '.join(sorted(KNOWN_SITES))})")
+        action = d.get("action")
+        if not action:
+            issues.append(f"{tag}: missing 'action'")
+        elif site in KNOWN_SITES:
+            allowed = KNOWN_SITES[site]
+            if allowed is not None and action not in allowed \
+                    and action not in _UNIVERSAL_ACTIONS:
+                issues.append(
+                    f"{tag}: action {action!r} is a no-op at site "
+                    f"{site!r} (understood: "
+                    f"{', '.join(sorted(allowed | _UNIVERSAL_ACTIONS))})")
+        m = d.get("match")
+        if m is not None and not isinstance(m, dict):
+            issues.append(f"{tag}: 'match' must be an object")
+            m = None
+        if m:
+            for k in m:
+                if k not in _MATCH_KEYS:
+                    issues.append(f"{tag}: unknown matcher {k!r} "
+                                  f"(known: nth, prob, seed, regex)")
+            if "nth" in m and "prob" in m:
+                issues.append(f"{tag}: 'nth' and 'prob' conflict — one "
+                              f"rule matches by count OR by draw, not "
+                              f"both")
+            nth = m.get("nth")
+            if nth is not None and not (
+                    isinstance(nth, int) and not isinstance(nth, bool)
+                    or (isinstance(nth, (list, tuple)) and nth and all(
+                        isinstance(n, int) and not isinstance(n, bool)
+                        for n in nth))):
+                issues.append(f"{tag}: 'nth' must be an int or a "
+                              f"non-empty list of ints, got {nth!r}")
+            prob = m.get("prob")
+            if prob is not None and not (
+                    isinstance(prob, (int, float))
+                    and not isinstance(prob, bool) and 0 < prob <= 1):
+                issues.append(f"{tag}: 'prob' must be in (0, 1], got "
+                              f"{prob!r}")
+            if m.get("regex") is not None:
+                try:
+                    re.compile(m["regex"])
+                except (re.error, TypeError) as e:
+                    issues.append(f"{tag}: bad regex "
+                                  f"{m.get('regex')!r}: {e}")
+        delay = d.get("delay_s")
+        if delay is not None and (not isinstance(delay, (int, float))
+                                  or isinstance(delay, bool)
+                                  or delay < 0):
+            issues.append(f"{tag}: 'delay_s' must be a non-negative "
+                          f"number, got {delay!r}")
+        mf = d.get("max_fires")
+        if mf is not None and (not isinstance(mf, int)
+                               or isinstance(mf, bool) or mf < 1):
+            issues.append(f"{tag}: 'max_fires' must be a positive int, "
+                          f"got {mf!r}")
+        if d.get("once") and isinstance(mf, int) and mf > 1:
+            issues.append(f"{tag}: 'once' conflicts with max_fires="
+                          f"{mf} — once caps the rule at one fire "
+                          f"cluster-wide")
+        rid = d.get("id")
+        if rid:
+            if rid in seen_ids:
+                issues.append(
+                    f"{tag}: duplicate rule id {rid!r} (also rule "
+                    f"#{seen_ids[rid]}) — `once` claims are keyed by "
+                    f"id, so duplicates starve each other and at most "
+                    f"one ever fires")
+            else:
+                seen_ids[rid] = i
+    return issues
 
 
 # ------------------------------------------------------------------ metric
