@@ -2,8 +2,8 @@
 # tree): native object store + transfer plane, C++ driver API, wheel.
 PY ?= python
 
-.PHONY: all native cpp wheel test bench serve-bench obs chaos drain \
-	failover clean
+.PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
+	chaos drain failover spec clean
 
 all: native cpp
 
@@ -50,6 +50,13 @@ drain:
 failover:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_failover.py -q
 
+# Spec suite: chunked-prefill admission + speculative decoding —
+# verify-program exactness, chunk-boundary/admission parity, shared and
+# adversarial (random) draft parity, chaos degrade-to-plain, resume
+# into a speculating engine, program-shape dedup.
+spec:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_spec_decode.py -q
+
 bench:
 	$(PY) bench.py
 
@@ -59,6 +66,14 @@ bench:
 # path on the CPU harness.
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve
+
+# Chunked-prefill + speculative-decoding benchmark (engine level, CPU
+# harness): spec-on vs spec-off ms/tok A/B with byte-identical-output
+# assertion, and TTFT-under-load (long-prompt join into a saturated
+# 8-session batch; stall inflicted on incumbents vs their steady chunk
+# cadence).  Results merge into SERVE_BENCH.json detail.
+spec-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --spec-bench
 
 clean:
 	rm -f ray_tpu/core/object_store/libtpustore.so dist/*.whl

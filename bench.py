@@ -568,6 +568,253 @@ def _measure_concurrent_streaming(http, addr: str,
     return result
 
 
+def _run_spec_bench() -> dict:
+    """`--spec-bench`: the PR-6 model-side serve optimisations, at the
+    ENGINE level (DecodeSessionCore.handle, no cluster/HTTP) so the
+    numbers isolate the data plane the optimisations live in.
+
+    * ``spec_ab``: ms/tok for N concurrent streams with speculative
+      decoding on vs off, asserting byte-identical output.  The draft
+      is the target's FIRST LAYER and the target's second-layer output
+      projections are zeroed — an exact distillation pair (the only way
+      untrained weights admit a cheap high-acceptance draft; a random
+      independent draft measures ~1% acceptance and a weight-shared
+      draft pays full-size proposal compute).  Every measured FLOP is
+      really executed: the target runs both layers, the draft one.  On
+      chip the draft is a real small model, e.g. gpt2s for llama-1b.
+      The win is 2 dispatches per 1..k accepted tokens vs 1 per token,
+      plus the k-wide verify forward batching what k single steps
+      would compute.
+    * ``ttft_under_load``: a long-prompt session joins a saturated
+      8-session batch; reports the joiner's TTFT and the worst stall it
+      inflicts on incumbent streams, vs their steady chunk cadence —
+      chunked admission bounds that stall at ~one chunk program.
+    """
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.serve.config import DecodeEngineConfig
+    from ray_tpu.serve.decode_session import DecodeSessionCore
+
+    cfg = TransformerConfig.tiny(max_seq_len=256, n_layers=4,
+                                 attention_impl="reference",
+                                 dtype=jnp.float32)
+    params, _ = init_params(jax.random.PRNGKey(5), cfg)
+    # distillation pair at a realistic 4:1 compute ratio: zero layers
+    # 2-4's output projections (the layers still RUN — their residual
+    # contribution is exactly 0), so the 1-layer draft slice computes
+    # the same function at a quarter of the FLOPs and acceptance sits
+    # near 1.0
+    layers = dict(params["layers"])
+    for key in ("wo", "w_out"):
+        layers[key] = layers[key].at[1:].set(0.0)
+    params = {**params, "layers": layers}
+    draft_cfg = dataclasses.replace(cfg, n_layers=1)
+    draft_params = {**params, "layers": jax.tree_util.tree_map(
+        lambda x: x[:1], layers)}
+    # prompt = exactly one [1, 32] chunk block and a long decode tail
+    # (sessions run to cache cap): the A/B isolates the decode path —
+    # admission cost is identical on both sides and measured separately
+    # by ttft_under_load.  Token queues are deeper than the stream so
+    # the engine never pauses and the timed window is pure engine
+    # throughput (client drains happen after, untimed, for the parity
+    # assertion — concurrent polling only adds equal GIL noise to both
+    # sides).
+    max_len, nsess = 224, 4
+    prompts = [[(11 * i + j) % 250 for j in range(32)]
+               for i in range(nsess)]
+
+    def run_core(core):
+        r = core.handle({"op": "start", "prompt": list(range(32))})
+        while True:                   # warmup: compiles every program
+            o = core.handle({"op": "next_chunk", "sid": r["sid"],
+                             "max_tokens": 8, "timeout_s": 10.0})
+            if o["tokens"] or o.get("done"):
+                break
+        core.handle({"op": "end", "sid": r["sid"]})
+        time.sleep(0.2)
+        rs = [core.handle({"op": "start", "prompt": p})
+              for p in prompts]
+        st0 = core.handle({"op": "stats"})["engine"]
+        t0 = time.perf_counter()
+        while core.handle({"op": "stats"})["engine"]["occupied_slots"]:
+            time.sleep(0.005)
+        wall = time.perf_counter() - t0
+        st1 = core.handle({"op": "stats"})["engine"]
+        outs = []
+        for r in rs:
+            toks = list(r["token"])
+            while True:
+                o = core.handle({"op": "next_chunk", "sid": r["sid"],
+                                 "max_tokens": 256})
+                toks += o["tokens"]
+                if o["done"]:
+                    break
+            core.handle({"op": "end", "sid": r["sid"]})
+            outs.append(toks)
+        toks_decoded = max(1, st1["tokens"] - st0["tokens"])
+        return wall / toks_decoded * 1e3, outs, st1
+
+    k = 12
+    core_off = DecodeSessionCore(
+        cfg, max_len=max_len, seed=5, params=params,
+        engine=DecodeEngineConfig(max_slots=nsess,
+                                  token_queue_depth=256))
+    core_on = DecodeSessionCore(
+        cfg, max_len=max_len, seed=5, params=params,
+        engine=DecodeEngineConfig(max_slots=nsess,
+                                  token_queue_depth=256,
+                                  spec_draft=(draft_cfg, draft_params),
+                                  spec_k=k))
+    # best-of-3 interleaved rounds: a fresh process carries
+    # allocator/XLA warm-up noise and CPU scheduling jitter moves
+    # single rounds by ±40%; the per-core minimum is stable
+    ms_off, outs_off, _ = run_core(core_off)
+    ms_on, outs_on, st = run_core(core_on)
+    for _ in range(2):
+        ms_off = min(ms_off, run_core(core_off)[0])
+        ms_on = min(ms_on, run_core(core_on)[0])
+    assert outs_on == outs_off, \
+        "speculative decode changed the token stream"
+    core_off.engine.shutdown()
+    core_on.engine.shutdown()
+    spec_ab = {
+        "sessions": nsess,
+        "tokens_per_stream": len(outs_on[0]), "spec_k": k,
+        "spec_off_ms_per_tok": round(ms_off, 3),
+        "spec_on_ms_per_tok": round(ms_on, 3),
+        "speedup": round(ms_off / max(ms_on, 1e-9), 2),
+        "ratio_on_over_off": round(ms_on / max(ms_off, 1e-9), 3),
+        "ms_per_tok_is": "aggregate engine decode wall per token, "
+                         "4 concurrent slots",
+        "acceptance": st["spec"]["acceptance"],
+        "draft": "exact-distillation pair: draft = target's first "
+                 "layer, target's 2nd-layer output projections zeroed "
+                 "(untrained harness weights admit no other cheap "
+                 "high-acceptance draft; on chip: gpt2s drafts for "
+                 "llama-1b)",
+        "output_identical": True,
+    }
+
+    # ---- TTFT under load: join a saturated batch with a long prompt.
+    # Incumbents get a deep cache (long runway) and the poller lanes
+    # record chunk-arrival timestamps continuously, so the joiner's
+    # admission lands mid-stream and its inflicted stall is readable
+    # from the incumbents' inter-chunk gaps.
+    chunk_tokens = 32
+    incumbents, joiner_prompt_len = 8, 128
+    cfg2 = TransformerConfig.tiny(max_seq_len=2048,
+                                  attention_impl="reference",
+                                  dtype=jnp.float32)
+    core = DecodeSessionCore(
+        cfg2, max_len=2048, seed=5,
+        engine=DecodeEngineConfig(max_slots=incumbents + 1,
+                                  prefill_chunk_tokens=chunk_tokens))
+    # warm every program shape the measurement touches ([1,32] blocks +
+    # [1,1] tail + the decode step) so the joiner's TTFT is admission,
+    # not compilation
+    w = core.handle({"op": "start",
+                     "prompt": [(3 + j) % 250 for j in range(80)]})
+    while True:
+        o = core.handle({"op": "next_chunk", "sid": w["sid"],
+                         "max_tokens": 8})
+        if o["tokens"] or o.get("done"):
+            break
+    core.handle({"op": "end", "sid": w["sid"]})
+
+    stop = threading.Event()
+    arrivals = [[] for _ in range(incumbents)]   # chunk arrival stamps
+
+    def incumbent(i):
+        r = core.handle({"op": "start",
+                         "prompt": [(7 * i + j) % 250
+                                    for j in range(40)]})
+        while not stop.is_set():
+            o = core.handle({"op": "next_chunk", "sid": r["sid"],
+                             "max_tokens": 4, "timeout_s": 5.0})
+            if o.get("done") or "error" in o:
+                break
+            if o["tokens"]:
+                arrivals[i].append(time.perf_counter())
+        core.handle({"op": "end", "sid": r["sid"]})
+
+    threads = [threading.Thread(target=incumbent, args=(i,))
+               for i in range(incumbents)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and \
+            any(len(lane) < 30 for lane in arrivals):
+        time.sleep(0.05)              # all lanes streaming steadily
+    t_join = time.perf_counter()
+    r = core.handle({"op": "start",
+                     "prompt": [(13 + j) % 250
+                                for j in range(joiner_prompt_len)]})
+    ttft_ms = (time.perf_counter() - t_join) * 1e3
+    time.sleep(0.5)
+    stop.set()
+    core.handle({"op": "end", "sid": r["sid"]})
+    for t in threads:
+        t.join(timeout=30)
+    core.engine.shutdown()
+
+    pre_gaps, join_gaps = [], []
+    join_end = t_join + ttft_ms / 1e3
+    for lane in arrivals:
+        for t0, t1 in zip(lane, lane[1:]):
+            if t1 < t_join:
+                pre_gaps.append(t1 - t0)
+            elif t1 <= join_end + 0.25:
+                join_gaps.append(t1 - t0)
+    steady_ms = float(np.percentile(pre_gaps, 50)) * 1e3 \
+        if pre_gaps else 0.0
+    worst_ms = float(np.max(join_gaps)) * 1e3 if join_gaps else 0.0
+    stall_ms = max(0.0, worst_ms - steady_ms)
+    ttft_load = {
+        "incumbents": incumbents,
+        "joiner_prompt_len": joiner_prompt_len,
+        "prefill_chunk_tokens": chunk_tokens,
+        "joiner_ttft_ms": round(ttft_ms, 2),
+        "incumbent_chunk_interval_ms_p50": round(steady_ms, 2),
+        "incumbent_worst_gap_during_join_ms": round(worst_ms, 2),
+        "joiner_inflicted_stall_ms": round(stall_ms, 2),
+        "stall_lt_chunk_interval": bool(stall_ms < max(steady_ms, 1e-9)),
+    }
+    return {"spec_ab": spec_ab, "ttft_under_load": ttft_load}
+
+
+def _spec_bench_main() -> None:
+    """`python bench.py --spec-bench`: run the PR-6 measurements and
+    merge them into SERVE_BENCH.json's detail (the headline serve
+    record stays the full-path `--serve` run)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("RAY_TPU_DEVICE_BACKEND", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    try:
+        result = _run_spec_bench()
+    except Exception:
+        result = {"error": traceback.format_exc(limit=3)}
+    print(json.dumps(result))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SERVE_BENCH.json")
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+    except Exception:
+        ledger = {"metric": "serve_gen_ttft_ms_p50", "detail": {}}
+    ledger.setdefault("detail", {}).update(result)
+    try:
+        with open(path, "w") as f:
+            json.dump(ledger, f)
+    except OSError:
+        pass
+
+
 def _run_rl_measurement() -> dict:
     """PPO env-steps/s on the local device mesh (BASELINE north star #3:
     100k env-steps/s).  Uses DDPPO — every device a learner, pmean grad
@@ -836,6 +1083,9 @@ def main() -> None:
         return
     if "--serve" in sys.argv:
         _serve_main()
+        return
+    if "--spec-bench" in sys.argv:
+        _spec_bench_main()
         return
 
     errors = []

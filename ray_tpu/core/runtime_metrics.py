@@ -85,6 +85,21 @@ SERVE_TOKENS = m.Counter(
     "Tokens decoded by replica continuous-batching engines "
     "(decode_session.py); registered in the replica's process",
     ("deployment",))
+SERVE_PREFILL_CHUNKS = m.Counter(
+    "ray_tpu_serve_prefill_chunks_total",
+    "Fixed-shape prefill chunk programs run by serve decode engines — "
+    "chunked admission and failover resume share these programs, and "
+    "each one is the most a joining session may stall live streams",
+    ("deployment",))
+SERVE_SPEC_PROPOSED = m.Counter(
+    "ray_tpu_serve_spec_tokens_proposed_total",
+    "Draft-model tokens offered to speculative verification by serve "
+    "decode engines", ("deployment",))
+SERVE_SPEC_ACCEPTED = m.Counter(
+    "ray_tpu_serve_spec_tokens_accepted_total",
+    "Draft-model tokens the target's batched verify step accepted "
+    "(exact greedy match; the bonus token per iteration is not counted)",
+    ("deployment",))
 SERVE_SESSIONS_MIGRATED = m.Counter(
     "ray_tpu_serve_sessions_migrated_total",
     "Decode sessions re-admitted on a healthy replica by the proxy-side "
@@ -190,6 +205,11 @@ KV_KEYS = m.Gauge(
 OBJECT_DIRECTORY = m.Gauge(
     "ray_tpu_object_directory_entries",
     "Objects tracked in the controller directory", ())
+SERVE_SPEC_ACCEPTANCE = m.Gauge(
+    "ray_tpu_serve_spec_acceptance_ratio",
+    "Cumulative speculative-decoding acceptance ratio (accepted / "
+    "proposed draft tokens) per serve decode engine — the knob that "
+    "decides whether spec_k is paying for itself", ("deployment",))
 
 
 # ------------------------------------------------------------- snapshots

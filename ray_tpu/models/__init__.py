@@ -11,13 +11,16 @@ from .generate import (  # noqa: F401
     cache_insert_slot,
     decode_step,
     decode_step_slots,
+    draft_propose_slots,
     generate,
     init_kv_cache,
     init_slot_cache,
     prefill,
     prefill_chunk,
+    prefill_chunk_jit,
     prefill_chunked,
     resume_prefill,
+    verify_step_slots,
 )
 from .transformer import (  # noqa: F401
     TransformerConfig,

@@ -165,6 +165,14 @@ def prefill_chunk(params: Params, tokens: jnp.ndarray, cache: KVCache,
 # cache (the point of chunking is a bounded, REUSED program)
 _prefill_chunk_jit = jax.jit(prefill_chunk, static_argnames=("cfg",))
 
+#: The ONE shared chunk program behind every prefill path: legacy
+#: `prefill_chunked`, failover `resume_prefill`, AND the serve engine's
+#: chunked admission (serve/decode_session.py) all dispatch through this
+#: handle, so a replica compiles at most two prefill shapes per model
+#: config ([B, chunk] blocks + [B, 1] tail steps) no matter how many
+#: prompts, resumes, or admissions it serves.
+prefill_chunk_jit = _prefill_chunk_jit
+
 
 def prefill_chunked(params: Params, tokens: jnp.ndarray,
                     cfg: TransformerConfig, cache: KVCache,
@@ -391,6 +399,128 @@ def decode_step_slots(params: Params, token: jnp.ndarray, cache: KVCache,
     logits = jnp.einsum("bd,dv->bv", x[:, 0], _unembed(params, cfg))
     return logits.astype(jnp.float32), {
         "k": ks, "v": vs, "pos": pos + active.astype(jnp.int32)}
+
+
+def draft_propose_slots(params: Params, token: jnp.ndarray,
+                        cache: KVCache, active: jnp.ndarray,
+                        cfg: TransformerConfig, k: int
+                        ) -> Tuple[jnp.ndarray, KVCache]:
+    """Draft ``k`` greedy tokens per slot in ONE compiled program.
+
+    The proposer side of speculative decoding: a ``lax.scan`` over
+    :func:`decode_step_slots` feeds each argmax back in, so one dispatch
+    produces ``k`` proposals per slot regardless of ``k`` — on the
+    dispatch-bound serving path that is the entire point (k eager draft
+    steps would cost k dispatches and erase the win).
+
+    ``token`` [S] int32 (each slot's pending token), ``cache`` the
+    DRAFT model's slot cache whose ``pos`` the engine re-syncs from the
+    target cache every iteration (rejected speculative writes are then
+    overwritten before any masked read — the same invariant paused
+    slots rely on).  → (proposals [S, k], cache') with ``pos`` advanced
+    by ``k`` on active slots."""
+
+    def step(carry, _):
+        tok, c = carry
+        logits, c = decode_step_slots(params, tok, c, active, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, tok)
+        return (nxt, c), nxt
+
+    (_, cache), toks = jax.lax.scan(step, (token, cache), None, length=k)
+    return jnp.swapaxes(toks, 0, 1), cache                     # [S, k]
+
+
+def verify_step_slots(params: Params, tokens: jnp.ndarray,
+                      proposals: jnp.ndarray, cache: KVCache,
+                      active: jnp.ndarray, cfg: TransformerConfig
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, KVCache]:
+    """Speculative-decoding verification: one batched forward over
+    ``C`` tokens per slot checks a draft's ``C - 1`` proposals and
+    yields 1..C accepted tokens per slot.
+
+    ``tokens`` [S, C] int32 — per slot ``[last_tok, d_1, .., d_{C-1}]``
+    (the slot's pending token followed by the draft's proposals);
+    ``proposals`` [S, C-1] are the ``d_i`` alone; ``cache`` a slot
+    cache with per-slot ``pos`` [S]; ``active`` [S] bool.
+
+    → ``(greedy [S, C], accepted [S], cache')`` where ``greedy[s, i]``
+    is the target's argmax after consuming ``tokens[s, :i+1]`` and
+    ``accepted[s]`` = 1 + the longest proposal prefix matching that
+    greedy chain (clamped to remaining cache capacity) — exactly the
+    tokens slot ``s`` emits this iteration, ``greedy[s, :accepted[s]]``.
+    ``pos`` advances by ``accepted`` on active slots only.
+
+    Greedy speculative decoding is EXACT: every emitted token is the
+    target's own greedy choice given the accepted prefix — the draft
+    only decides how many of them one dispatch yields — so the stream
+    is byte-identical to plain decode.  K/V of every fed token is
+    written at its position; rejected-suffix writes land past the
+    advanced ``pos`` and are rewritten (with the true token) before any
+    masked read, the same invariant plain decode relies on for paused
+    slots.  Writes past ``max_len`` are dropped by XLA scatter
+    semantics and ``accepted`` is clamped so emission never outruns the
+    cache."""
+    _check_decodable(cfg)
+    s, c = tokens.shape
+    dt = cfg.dtype
+    pos = cache["pos"]                                         # [S]
+    max_len = cache["k"].shape[2]
+    posm = pos[:, None] + jnp.arange(c)[None, :]               # [S, C]
+    x = params["embed"]["tok"][tokens].astype(dt)              # [S,C,D]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["pos"][posm].astype(dt)
+    if cfg.pos_emb == "rope":
+        full_cos, full_sin = rotary_angles(max_len, cfg.head_dim,
+                                           cfg.rope_base)
+        cos = full_cos[posm][:, :, None, :]                    # [S,C,1,·]
+        sin = full_sin[posm][:, :, None, :]
+    else:
+        cos = sin = None
+
+    h, hk, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    slot_ix = jnp.arange(s)[:, None]                           # [S, 1]
+    # mask[s, i, t]: cached position t visible to fed token i of slot s
+    mask = jnp.arange(max_len)[None, None, :] <= posm[:, :, None]
+
+    def body(carry, inputs):
+        xc = carry
+        lp, ck, cv = inputs                                    # per-layer
+        y = _norm(cfg, xc, lp["attn_norm"], lp.get("attn_norm_b"))
+        q = jnp.einsum("bsd,dhk->bshk", y, lp["wq"].astype(dt))
+        k_new = jnp.einsum("bsd,dhk->bshk", y, lp["wk"].astype(dt))
+        v_new = jnp.einsum("bsd,dhk->bshk", y, lp["wv"].astype(dt))
+        if cfg.pos_emb == "rope":
+            q = _rotate_slots(q, cos, sin)
+            k_new = _rotate_slots(k_new, cos, sin)
+        ck = ck.at[slot_ix, posm].set(k_new.astype(cfg.dtype))
+        cv = cv.at[slot_ix, posm].set(v_new.astype(cfg.dtype))
+        qh = q.reshape(s, c, hk, h // hk, hd)
+        scores = jnp.einsum("bskgd,btkd->bskgt", qh,
+                            ck.astype(dt)) / jnp.sqrt(float(hd))
+        scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        attn = jnp.einsum("bskgt,btkd->bskgd", probs.astype(dt),
+                          cv.astype(dt))
+        attn = attn.reshape(s, c, h, hd)
+        xc = xc + jnp.einsum("bshk,hkd->bsd", attn,
+                             lp["wo"].astype(dt))
+        y2 = _norm(cfg, xc, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        z, _ = _ffn(cfg, y2, lp)
+        xc = xc + z
+        return xc, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(body, x,
+                               (params["layers"], cache["k"], cache["v"]))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    logits = jnp.einsum("bsd,dv->bsv", x, _unembed(params, cfg))
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [S, C]
+    ok = (greedy[:, :-1] == proposals).astype(jnp.int32)
+    accepted = 1 + jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+    accepted = jnp.minimum(accepted,
+                           jnp.maximum(max_len - pos, 1)).astype(jnp.int32)
+    adv = jnp.where(active, accepted, 0).astype(jnp.int32)
+    return greedy, accepted, {"k": ks, "v": vs, "pos": pos + adv}
 
 
 def _sample(logits: jnp.ndarray, key: jax.Array, greedy: bool,

@@ -55,6 +55,36 @@ class DecodeEngineConfig:
     # streams (client crashed without `end`) must not hold decode slots
     # or session-table memory forever.  <= 0 disables the reaper.
     session_idle_ttl_s: float = 120.0
+    # -- chunked-prefill admission ----------------------------------------
+    # a joining session's prompt is consumed [1, chunk] tokens at a time
+    # BETWEEN shared decode steps on the engine thread (remainder in
+    # [1, 1] tail steps) — admission, failover resume, and the legacy
+    # prefill_chunked path all reuse the same two compiled chunk shapes,
+    # and a join never stalls live streams by more than one chunk
+    # interval.  Matches models.resume_prefill's default so resumes and
+    # admissions share programs.
+    prefill_chunk_tokens: int = 32
+    # bound on one `start`/`resume` call: enqueue -> first token (the
+    # prompt is prefilled by the engine thread; a wedged engine must not
+    # hang the caller forever — timeout sheds with the typed 503)
+    admission_timeout_s: float = 60.0
+    # -- speculative decoding ---------------------------------------------
+    # draft model proposing tokens for the target to verify in one
+    # batched k-token forward.  None disables; "shared" weight-shares
+    # the target (exact self-speculation — acceptance 1.0, the win is
+    # dispatch amortization: 2 dispatches per k+1 tokens); a
+    # (TransformerConfig, params) tuple supplies a real draft; a bare
+    # TransformerConfig gets fresh seed-0 params (tests).  Greedy
+    # verification is exact-match, so token streams stay byte-identical
+    # to plain decode whatever the draft quality.
+    spec_draft: Any = None
+    # draft tokens proposed per engine iteration (the verify program is
+    # k+1 tokens wide; each iteration emits 1..k+1 tokens per slot)
+    spec_k: int = 4
+    # consecutive draft/verify failures before the engine stops
+    # speculating and stays on plain decode (each failure already falls
+    # back to a plain step for that iteration — streams never corrupt)
+    spec_fail_disable: int = 3
 
 
 @dataclasses.dataclass

@@ -22,6 +22,28 @@ Two decode data planes live here:
   serve-vs-raw decode gap: batch-1 decode steps and one RPC per token
   both disappear.
 
+  Two model-side optimisations compound inside the loop:
+
+  - **Chunked-prefill admission**: a joining session's prompt is
+    consumed ``[1, chunk]`` tokens at a time between shared decode
+    steps (``DecodeEngineConfig.prefill_chunk_tokens``), so a join
+    stalls live streams by at most one chunk interval instead of a
+    whole prompt forward, and TTFT-under-load stops being
+    O(prompt_len) of batch stall.  Admission, failover resume
+    (``op: resume``), and the legacy ``prefill_chunked`` path all
+    dispatch the SAME module-level chunk programs
+    (`models.prefill_chunk_jit`) — at most two compiled prefill shapes
+    per model, whatever the traffic.
+  - **Speculative decoding** (``DecodeEngineConfig.spec_draft`` /
+    ``spec_k``): a draft model proposes k tokens per iteration in one
+    scanned dispatch (`models.draft_propose_slots`) and the target
+    verifies all of them plus a bonus token in one k+1-wide batched
+    forward (`models.verify_step_slots`) — 2 dispatches for 1..k+1
+    tokens per slot.  Greedy acceptance is exact-match, so streams
+    (and the PR-5 seq-based replay journal) stay byte-identical to
+    plain decode; any draft/verify fault falls back to a plain step
+    (chaos site ``serve.spec_verify``), never corrupting a stream.
+
 * **Legacy per-call path** (``engine=False`` or batched prompts): the
   original pop-as-lease session table, one eager `next` per token.
   Kept as the fallback for non-session deployments and B>1 prompt
@@ -63,19 +85,24 @@ def _shutdown_engines() -> None:
 
 
 class _EngineSession:
-    """One live session inside the engine: its slot (or None while
-    waiting for admission), bounded token queue, and terminal state."""
+    """One live session inside the engine, through three phases:
+    *prefilling* (the engine thread consumes its prompt one fixed-shape
+    chunk program at a time, between decode steps), *waiting* (prompt
+    fully prefilled into a batch-1 cache, first token produced, queued
+    for a free slot), and *decoding* (cache inserted into its slot of
+    the shared batched cache)."""
 
     __slots__ = ("sid", "slot", "queue", "last_tok", "pos", "done",
-                 "error", "ended", "seq", "last_poll")
+                 "error", "ended", "seq", "last_poll",
+                 "prompt", "poff", "pcache", "dcache", "plogits",
+                 "ready", "shed")
 
-    def __init__(self, sid: str, last_tok: int, pos: int,
-                 seq_base: int = 0):
+    def __init__(self, sid: str, prompt: Any, seq_base: int = 0):
         self.sid = sid
         self.slot: Optional[int] = None
         self.queue: collections.deque = collections.deque()
-        self.last_tok = last_tok      # feeds the next decode step
-        self.pos = pos                # host mirror of cache pos
+        self.last_tok: Optional[int] = None  # set when prefill completes
+        self.pos = 0                  # host mirror of cache pos
         self.done = False             # no more tokens will be produced
         self.error: Optional[str] = None
         self.ended = False            # client sent `end`
@@ -85,6 +112,14 @@ class _EngineSession:
         # and detect a destructively-popped chunk whose reply was lost
         self.seq = seq_base + 1
         self.last_poll = time.monotonic()  # leak-reaper clock
+        # ---- chunked-admission state (cleared once decoding) ----
+        self.prompt = prompt          # [1, S] int32 still to prefill
+        self.poff = 0                 # tokens consumed so far
+        self.pcache: Any = None       # target batch-1 cache being built
+        self.dcache: Any = None       # draft batch-1 cache (speculating)
+        self.plogits: Any = None      # last chunk's final-position logits
+        self.ready = False            # first token produced; start() may return
+        self.shed = False             # drained mid-admission: typed 503
 
 
 class ContinuousBatchingEngine:
@@ -95,20 +130,21 @@ class ContinuousBatchingEngine:
     under the engine condition variable, so no device array is ever
     raced."""
 
-    def __init__(self, cfg, max_len: int, params: Any, prefill_fn,
+    def __init__(self, cfg, max_len: int, params: Any,
                  engine_cfg: DecodeEngineConfig, name: str = "",
                  replica_tag: str = "local"):
         import jax
         import jax.numpy as jnp
 
-        from ..models import cache_insert_slot, decode_step_slots
+        from ..models import (cache_insert_slot, decode_step_slots,
+                              draft_propose_slots, prefill_chunk_jit,
+                              verify_step_slots)
         self.cfg = cfg
         self.max_len = max_len
         self.params = params
         self.ecfg = engine_cfg
         self.name = name or "decode"
         self._tag = replica_tag
-        self._prefill = prefill_fn
 
         def fused_step(params, tok, cache, active, *, cfg):
             # decode + greedy sample + carry in ONE program: the loop
@@ -122,10 +158,48 @@ class ContinuousBatchingEngine:
 
         self._step = jax.jit(fused_step, static_argnames=("cfg",))
         self._insert = jax.jit(cache_insert_slot)
+        # the chunk program is the MODULE-LEVEL shared jit: admission
+        # here, failover resume (models.resume_prefill), and the legacy
+        # prefill_chunked path all hit one compile cache
+        self._chunk = prefill_chunk_jit
+        # ---- speculative decoding ----
+        self._spec = False
+        self._draft_cfg = None
+        self._draft_params = None
+        spec = engine_cfg.spec_draft
+        if spec:
+            if spec in ("shared", True):
+                self._draft_cfg, self._draft_params = cfg, params
+            elif isinstance(spec, tuple):
+                self._draft_cfg, self._draft_params = spec
+            else:   # a bare TransformerConfig: fresh params (tests)
+                from ..models import init_params
+                self._draft_cfg = spec
+                self._draft_params, _ = init_params(
+                    jax.random.PRNGKey(0), spec)
+            if self._draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {self._draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}: proposals must be target "
+                    f"token ids")
+            self._spec = True
+            self._draft = jax.jit(draft_propose_slots,
+                                  static_argnames=("cfg", "k"))
+            self._verify = jax.jit(verify_step_slots,
+                                   static_argnames=("cfg",))
+        self._spec_k = max(2, int(engine_cfg.spec_k))
+        self._spec_disabled = False
+        self._spec_fail_streak = 0
+        self.spec_proposed = 0   # draft tokens offered to verification
+        self.spec_accepted = 0   # draft tokens the target agreed with
+        self.spec_fallbacks = 0  # iterations degraded to plain decode
         self._cache = None            # allocated lazily on first start
+        self._dcache = None           # draft slot cache (speculating)
+        self._shapes: set = set()     # distinct compiled program shapes
         self._cond = threading.Condition()
         self.sessions: Dict[str, _EngineSession] = {}  # insertion = LRU
-        self._pending: List[Tuple[_EngineSession, Any]] = []
+        self._pending: List[_EngineSession] = []   # prefilled, want slot
+        self._prefilling: List[_EngineSession] = []
         self._free: List[int] = list(range(engine_cfg.max_slots))
         self._slots: Dict[int, _EngineSession] = {}
         self._next_sid = 0
@@ -135,53 +209,45 @@ class ContinuousBatchingEngine:
         self.steps = 0
         self.tokens = 0
         self.reaped = 0          # sessions evicted by the idle reaper
+        self.prefill_chunks = 0  # chunk programs run for admissions
 
     # ------------------------------------------------------------ client ops
 
     def start(self, prompt, max_sessions: int, seq_base: int = 0,
               teacher_forced: bool = False) -> Dict[str, Any]:
-        """Prefill one batch-1 prompt and enqueue the session for
-        iteration-level admission; returns immediately with the sid and
-        first token (a waiting session's tokens start flowing once a
-        slot frees).
+        """Enqueue one batch-1 prompt for chunked admission and block
+        until the ENGINE THREAD has prefilled it — `[1, chunk]` blocks
+        (tail in `[1, 1]` steps) interleaved between shared decode
+        steps, so a joining session never stalls live streams by more
+        than one chunk interval and admission reuses the same two
+        compiled chunk shapes as failover resume.  Returns the sid and
+        first token; the session's remaining tokens start flowing once
+        a slot frees (iteration-level admission).
 
-        ``teacher_forced`` is the failover-resume path: ``prompt`` is a
-        full replay prefix (original prompt + every token already
-        delivered to the client) walked through the bounded-compile
-        :func:`models.resume_prefill` programs, and the session's token
-        seqs continue from ``seq_base`` so the client can splice the
-        resumed stream in without duplicates or gaps."""
+        ``teacher_forced`` marks the failover-resume path: ``prompt``
+        is a full replay prefix (original prompt + every token already
+        delivered) and the session's token seqs continue from
+        ``seq_base`` so the client can splice the resumed stream in
+        without duplicates or gaps.  Resume IS admission here — both
+        walk the same chunk programs, so resumes never compile-storm."""
         import jax.numpy as jnp
 
         from ..exceptions import ReplicaUnavailableError
-        from ..models import init_kv_cache
+        s_len = int(prompt.shape[1])
+        if s_len > self.max_len:
+            raise ValueError(f"prompt length {s_len} exceeds cache "
+                             f"capacity {self.max_len}")
+        prompt = jnp.asarray(prompt, jnp.int32)
         with self._cond:
             if self._draining:
                 raise ReplicaUnavailableError(self.name)
-            if not self._free and len(self._pending) >= self.ecfg.max_waiting:
-                raise ReplicaUnavailableError(self.name)
-        cache = init_kv_cache(self.cfg, 1, self.max_len)
-        if teacher_forced:
-            from ..models import resume_prefill
-            logits, cache = resume_prefill(self.params, prompt, self.cfg,
-                                           cache)
-        else:
-            logits, cache = self._prefill(self.params, prompt,
-                                          cfg=self.cfg, cache=cache)
-        tok = int(jnp.argmax(logits, axis=-1).astype(jnp.int32)[0])
-        with self._cond:
-            # admission re-check: concurrent starts raced the prefill
-            # (a drain may also have begun while we were prefilling)
-            if self._draining:
-                raise ReplicaUnavailableError(self.name)
-            if not self._free and len(self._pending) >= self.ecfg.max_waiting:
+            if not self._free and \
+                    len(self._pending) + len(self._prefilling) \
+                    >= self.ecfg.max_waiting:
                 raise ReplicaUnavailableError(self.name)
             sid = f"{self._tag}:{self._next_sid}"
             self._next_sid += 1
-            sess = _EngineSession(sid, tok, int(prompt.shape[1]),
-                                  seq_base=seq_base)
-            if sess.pos >= self.max_len:
-                sess.done = True      # prompt filled the cache exactly
+            sess = _EngineSession(sid, prompt, seq_base=seq_base)
             # LRU bound on ABANDONED sessions: evict the oldest
             # slot-less finished session (ended clients pop themselves)
             while len(self.sessions) >= max_sessions:
@@ -191,14 +257,31 @@ class ContinuousBatchingEngine:
                     break
                 self.sessions.pop(victim.sid)
             self.sessions[sid] = sess
-            if not sess.done:
-                self._pending.append((sess, cache))
+            self._prefilling.append(sess)
             self._ensure_thread()
             self._cond.notify_all()
-        reply = {"sid": sid, "token": [tok], "proto": "chunk",
-                 "seq": seq_base}
-        if sess.done:
-            reply["done"] = True   # prompt/replay prefix filled the cache
+            deadline = time.monotonic() + \
+                max(1.0, self.ecfg.admission_timeout_s)
+            while not sess.ready and sess.error is None \
+                    and not sess.shed and not sess.done:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._shutdown:
+                    sess.done = True
+                    sess.ended = True
+                    self.sessions.pop(sid, None)
+                    raise ReplicaUnavailableError(self.name)
+                self._cond.wait(min(left, 1.0))
+            if sess.shed:     # drain began mid-admission: typed shed,
+                raise ReplicaUnavailableError(self.name)  # client resumes elsewhere
+            if sess.error is not None:
+                raise RuntimeError(sess.error)
+            if not sess.ready:   # reaped/force-ended mid-admission
+                self.sessions.pop(sid, None)
+                raise ReplicaUnavailableError(self.name)
+            reply = {"sid": sid, "token": [sess.last_tok],
+                     "proto": "chunk", "seq": seq_base}
+            if sess.done:
+                reply["done"] = True  # prompt/replay prefix filled the cache
         return reply
 
     def next_chunk(self, sid: str, max_tokens: int = 16,
@@ -272,14 +355,33 @@ class ContinuousBatchingEngine:
 
     def stats(self) -> Dict[str, Any]:
         with self._cond:
+            prop, acc = self.spec_proposed, self.spec_accepted
             return {"max_slots": self.ecfg.max_slots,
                     "occupied_slots": len(self._slots),
                     "waiting": len(self._pending),
+                    "prefilling": len(self._prefilling),
                     "sessions": len(self.sessions),
                     "live_sessions": self._live_locked(),
                     "draining": self._draining,
                     "reaped": self.reaped,
-                    "steps": self.steps, "tokens": self.tokens}
+                    "steps": self.steps, "tokens": self.tokens,
+                    "prefill_chunks": self.prefill_chunks,
+                    # every distinct program shape this engine has
+                    # dispatched — a compile-storm regression (one
+                    # program per prompt/resume length) shows up here
+                    # as a count growing with traffic instead of
+                    # staying O(1)
+                    "program_shapes": sorted(
+                        "%s:%s" % (k[0], "x".join(str(d) for d in k[1:]))
+                        for k in self._shapes),
+                    "distinct_program_shapes": len(self._shapes),
+                    "spec": {"enabled": self._spec,
+                             "disabled": self._spec_disabled,
+                             "k": self._spec_k,
+                             "proposed": prop, "accepted": acc,
+                             "acceptance":
+                                 round(acc / prop, 4) if prop else None,
+                             "fallbacks": self.spec_fallbacks}}
 
     def _live_locked(self) -> int:
         """Sessions a client may still come back for (not `end`ed):
@@ -292,10 +394,20 @@ class ContinuousBatchingEngine:
         ReplicaUnavailableError, stop stepping, and hand every live
         session off on its next `next_chunk` poll (buffered tokens are
         still delivered, stamped with a ``migrating`` flag that sends
-        the failover client to a healthy replica).  Returns the number
-        of sessions awaiting handoff."""
+        the failover client to a healthy replica).  Sessions still
+        mid-prefill are shed the same typed way — their `start` caller
+        has no sid yet, so the shed IS the handoff (the failover client
+        replays the journal elsewhere).  Returns the number of sessions
+        awaiting handoff."""
         with self._cond:
             self._draining = True
+            for sess in self._prefilling:
+                sess.shed = True
+                sess.done = True
+                sess.ended = True
+                sess.pcache = sess.dcache = sess.plogits = None
+                self.sessions.pop(sess.sid, None)
+            self._prefilling.clear()
             n = self._live_locked()
             self._cond.notify_all()   # wake blocked next_chunk waits
         return n
@@ -340,18 +452,20 @@ class ContinuousBatchingEngine:
                 sess.slot = None
                 self._free.append(slot)
 
-    def _admit_locked(self) -> List[Tuple[_EngineSession, Any, int]]:
+    def _admit_locked(self) -> List[Tuple[_EngineSession, Any, Any, int]]:
         admitted = []
         if self._draining:
             return admitted   # evacuating: no new slot occupancy
         while self._free and self._pending:
-            sess, cache = self._pending.pop(0)
-            if sess.ended:
+            sess = self._pending.pop(0)
+            if sess.ended or sess.done:
+                sess.pcache = sess.dcache = None
                 continue              # ended while waiting
             slot = self._free.pop()
             sess.slot = slot
             self._slots[slot] = sess
-            admitted.append((sess, cache, slot))
+            admitted.append((sess, sess.pcache, sess.dcache, slot))
+            sess.pcache = sess.dcache = None
         return admitted
 
     def _collect_locked(self) -> List[_EngineSession]:
@@ -365,18 +479,122 @@ class ContinuousBatchingEngine:
                 if not s.done and
                 len(s.queue) < self.ecfg.token_queue_depth]
 
+    def _shape_seen(self, kind: str, *dims) -> None:
+        """Record one dispatched program shape (engine thread only) —
+        surfaces in stats() so a per-path compile storm is visible."""
+        self._shapes.add((kind,) + tuple(int(d) for d in dims))
+
+    def _prefill_advance(self, sess: _EngineSession) -> Optional[int]:
+        """Run ONE fixed-shape chunk program of a joining session's
+        prompt (target + draft when speculating) on the engine thread —
+        interleaved between shared decode steps, so admission stalls
+        live streams by at most one chunk interval instead of a whole
+        prompt.  Returns the session's first token once the prompt is
+        fully consumed, else None."""
+        import jax.numpy as jnp
+
+        from ..core.runtime_metrics import SERVE_PREFILL_CHUNKS
+        from ..models import init_kv_cache
+        from ..util import tracing
+        if sess.pcache is None:
+            sess.pcache = init_kv_cache(self.cfg, 1, self.max_len)
+            if self._spec:
+                sess.dcache = init_kv_cache(self._draft_cfg, 1,
+                                            self.max_len)
+        chunk = max(1, int(self.ecfg.prefill_chunk_tokens))
+        n = int(sess.prompt.shape[1])
+        off = sess.poff
+        take = chunk if n - off >= chunk else 1
+        toks = sess.prompt[:, off:off + take]
+        t0 = time.time()
+        sess.plogits, sess.pcache = self._chunk(self.params, toks,
+                                                sess.pcache, cfg=self.cfg)
+        self._shape_seen("prefill_chunk", 1, take)
+        if self._spec:
+            _, sess.dcache = self._chunk(self._draft_params, toks,
+                                         sess.dcache,
+                                         cfg=self._draft_cfg)
+            self._shape_seen("draft_prefill_chunk", 1, take)
+        sess.poff = off + take
+        self.prefill_chunks += 1
+        SERVE_PREFILL_CHUNKS.inc(tags={"deployment": self.name})
+        tracing.record_span(f"serve_prefill_chunk::{self.name}", "serve",
+                            t0, time.time(), tokens=take,
+                            deployment=self.name)
+        if sess.poff < n:
+            return None
+        return int(jnp.argmax(sess.plogits, axis=-1)
+                   .astype(jnp.int32)[0])
+
+    def _spec_step(self, tokens, active, fi):
+        """One speculative iteration over the whole batch: the draft
+        proposes ``spec_k`` tokens per slot in one scanned dispatch and
+        the target verifies all of them (plus one bonus token) in one
+        k+1-wide batched forward.  Returns host arrays
+        ``(greedy [S, k+1], accepted [S])``; raises on any draft/verify
+        fault (the loop falls back to a plain step — a broken draft can
+        slow a stream, never corrupt it)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+        if fi.ACTIVE is not None:
+            act = fi.ACTIVE.point("serve.spec_verify", self.name)
+            if act is not None:
+                if act["action"] in ("delay", "latency"):
+                    time.sleep(max(0.0, act["delay_s"]))
+                else:
+                    raise RuntimeError(
+                        f"chaos: injected spec_verify failure for "
+                        f"{self.name}")
+        tok_dev = jnp.asarray(tokens)
+        active_dev = jnp.asarray(active)
+        # the draft cache's pos is re-synced from the target every
+        # iteration: its rejected speculative writes sit past the true
+        # pos and are rewritten before any masked read
+        dcache = {"k": self._dcache["k"], "v": self._dcache["v"],
+                  "pos": self._cache["pos"]}
+        # the draft scans spec_k steps but only spec_k - 1 proposals are
+        # verified: the k-th step's K/V WRITE is what matters — on a
+        # fully-accepted iteration the last emitted token's row must
+        # already be in the draft cache, or every later proposal chain
+        # attends a hole and acceptance collapses
+        props, dcache = self._draft(self._draft_params, tok_dev, dcache,
+                                    active_dev, cfg=self._draft_cfg,
+                                    k=self._spec_k)
+        self._shape_seen("draft_propose", len(tokens), self._spec_k)
+        props = props[:, :self._spec_k - 1]
+        fed = jnp.concatenate([tok_dev[:, None], props], axis=1)
+        greedy_dev, acc_dev, new_cache = self._verify(
+            self.params, fed, props, self._cache, active_dev,
+            cfg=self.cfg)
+        self._shape_seen("verify", len(tokens), self._spec_k)
+        # materialize BEFORE committing the caches: an async device
+        # fault surfaces here and leaves the pre-spec state untouched
+        greedy = np.asarray(greedy_dev)
+        accepted = np.asarray(acc_dev)
+        self._cache = new_cache
+        self._dcache = dcache
+        return greedy, accepted
+
     def _loop(self) -> None:
         import numpy as np
 
         import jax.numpy as jnp
 
         from ..core.runtime_metrics import (SERVE_DECODE_OCCUPANCY,
+                                            SERVE_SPEC_ACCEPTANCE,
+                                            SERVE_SPEC_ACCEPTED,
+                                            SERVE_SPEC_PROPOSED,
                                             SERVE_TOKENS)
         from ..models import init_slot_cache
+        from ..util import fault_injection as fi
         from ..util import tracing
         if self._cache is None:
             self._cache = init_slot_cache(self.cfg, self.ecfg.max_slots,
                                           self.max_len)
+            if self._spec:
+                self._dcache = init_slot_cache(
+                    self._draft_cfg, self.ecfg.max_slots, self.max_len)
         tokens = np.zeros(self.ecfg.max_slots, np.int32)
         tok_dev = None       # device-resident step output → next input
         active_dev = None
@@ -385,9 +603,14 @@ class ContinuousBatchingEngine:
             with self._cond:
                 while not self._shutdown:
                     self._reap_locked()
+                    self._prefilling = [
+                        s for s in self._prefilling
+                        if not (s.ready or s.done or s.ended or s.shed)]
                     admitted = self._admit_locked()
+                    prefills = ([] if self._draining
+                                else list(self._prefilling))
                     batch = self._collect_locked()
-                    if admitted or batch:
+                    if admitted or prefills or batch:
                         break
                     self._cond.wait(0.5)
                 if self._shutdown:
@@ -399,52 +622,139 @@ class ContinuousBatchingEngine:
             # ---- device work, OUTSIDE the lock (nobody else touches
             # the slot cache, and client ops must not stall on compute)
             t0 = time.time()
-            try:
-                for _sess, cache, slot in admitted:
-                    self._cache = self._insert(self._cache, cache,
-                                               jnp.int32(slot))
-                if not batch:
-                    continue          # admissions only: step next round
-                if admitted or tok_dev is None or \
-                        active_key != tuple(active):
-                    # membership changed: re-upload the [S] token/mask
-                    # rows; on a steady batch the step output feeds the
-                    # next step directly from device memory
-                    tok_dev = jnp.asarray(tokens)
-                    active_dev = jnp.asarray(active)
-                    active_key = tuple(active)
-                tok_dev, self._cache = self._step(
-                    self.params, tok_dev, self._cache, active_dev,
-                    cfg=self.cfg)
-                new_toks = np.asarray(tok_dev)
-                tokens[:] = new_toks
-            except Exception as e:                 # pragma: no cover
+            for sess, pcache, dcache, slot in admitted:
+                self._cache = self._insert(self._cache, pcache,
+                                           jnp.int32(slot))
+                if self._spec and dcache is not None:
+                    self._dcache = self._insert(self._dcache, dcache,
+                                                jnp.int32(slot))
+            # one chunk program per joining session per iteration: the
+            # prompt is consumed BETWEEN decode steps, never ahead of
+            # the live batch
+            ready: List[Tuple[_EngineSession, int]] = []
+            for sess in prefills:
+                try:
+                    first = self._prefill_advance(sess)
+                    if first is not None:
+                        ready.append((sess, first))
+                except Exception as e:
+                    with self._cond:
+                        sess.error = f"chunked prefill failed: {e!r}"
+                        sess.done = True
+                        sess.ready = True
+                        sess.pcache = sess.dcache = sess.plogits = None
+                        self._cond.notify_all()
+            if ready:
                 with self._cond:
-                    for s in batch:
-                        s.error = f"decode engine step failed: {e!r}"
-                        s.done = True
+                    for sess, first in ready:
+                        sess.last_tok = first
+                        sess.pos = sess.poff
+                        sess.ready = True
+                        sess.prompt = sess.plogits = None
+                        if sess.pos >= self.max_len or sess.ended:
+                            sess.done = True  # nothing left to decode
+                            sess.pcache = sess.dcache = None
+                        else:
+                            self._pending.append(sess)
                     self._cond.notify_all()
-                tok_dev = None
-                continue
+            if not batch:
+                continue          # admissions/prefill only: step next round
+            spec_out = None
+            if self._spec and not self._spec_disabled:
+                try:
+                    spec_out = self._spec_step(tokens, active, fi)
+                    self._spec_fail_streak = 0
+                    tok_dev = None   # host owns the carry again
+                except Exception as e:
+                    self.spec_fallbacks += 1
+                    self._spec_fail_streak += 1
+                    if self._spec_fail_streak >= \
+                            max(1, self.ecfg.spec_fail_disable):
+                        self._spec_disabled = True
+                    tracing.record_span(
+                        f"serve_spec_fallback::{self.name}", "serve",
+                        t0, time.time(), error=repr(e),
+                        deployment=self.name)
+                    tok_dev = None   # degrade to the plain step below
+            if spec_out is None:
+                try:
+                    if admitted or tok_dev is None or \
+                            active_key != tuple(active):
+                        # membership changed: re-upload the [S]
+                        # token/mask rows; on a steady batch the step
+                        # output feeds the next step from device memory
+                        tok_dev = jnp.asarray(tokens)
+                        active_dev = jnp.asarray(active)
+                        active_key = tuple(active)
+                    tok_dev, self._cache = self._step(
+                        self.params, tok_dev, self._cache, active_dev,
+                        cfg=self.cfg)
+                    self._shape_seen("decode_step", len(tokens))
+                    new_toks = np.asarray(tok_dev)
+                    tokens[:] = new_toks
+                except Exception as e:             # pragma: no cover
+                    with self._cond:
+                        for s in batch:
+                            s.error = f"decode engine step failed: {e!r}"
+                            s.done = True
+                        self._cond.notify_all()
+                    tok_dev = None
+                    continue
             occupancy = len(batch)
-            tracing.record_span(f"serve_decode_step::{self.name}",
-                                "serve", t0, time.time(),
-                                batch=occupancy, deployment=self.name)
+            now = time.time()
+            if spec_out is not None:
+                greedy, accepted = spec_out
+                emitted = int(sum(accepted[s.slot] for s in batch))
+                tracing.record_span(
+                    f"serve_spec_verify::{self.name}", "serve", t0, now,
+                    batch=occupancy, proposed=(self._spec_k - 1) * occupancy,
+                    emitted=emitted, deployment=self.name)
+            else:
+                emitted = occupancy
+                tracing.record_span(f"serve_decode_step::{self.name}",
+                                    "serve", t0, now,
+                                    batch=occupancy,
+                                    deployment=self.name)
             SERVE_DECODE_OCCUPANCY.observe(occupancy,
                                            {"deployment": self.name})
-            SERVE_TOKENS.inc(occupancy, {"deployment": self.name})
+            SERVE_TOKENS.inc(emitted, {"deployment": self.name})
             with self._cond:
                 self.steps += 1
-                self.tokens += occupancy
-                for s in batch:
-                    tok = int(new_toks[s.slot])
-                    s.last_tok = tok
-                    s.pos += 1
-                    if not s.ended:
-                        s.queue.append(tok)
-                    if s.pos >= self.max_len:
-                        s.done = True  # cache full: slot reaped next turn
+                self.tokens += emitted
+                if spec_out is not None:
+                    greedy, accepted = spec_out
+                    for s in batch:
+                        n = int(accepted[s.slot])
+                        row = greedy[s.slot]
+                        toks = [int(row[i]) for i in range(n)]
+                        s.last_tok = toks[-1]
+                        tokens[s.slot] = s.last_tok
+                        s.pos += n
+                        if not s.ended:
+                            s.queue.extend(toks)
+                        if s.pos >= self.max_len:
+                            s.done = True
+                    self.spec_proposed += (self._spec_k - 1) * occupancy
+                    self.spec_accepted += emitted - occupancy
+                else:
+                    for s in batch:
+                        tok = int(new_toks[s.slot])
+                        s.last_tok = tok
+                        s.pos += 1
+                        if not s.ended:
+                            s.queue.append(tok)
+                        if s.pos >= self.max_len:
+                            s.done = True  # cache full: reaped next turn
                 self._cond.notify_all()
+            if spec_out is not None:
+                SERVE_SPEC_PROPOSED.inc((self._spec_k - 1) * occupancy,
+                                        {"deployment": self.name})
+                SERVE_SPEC_ACCEPTED.inc(emitted - occupancy,
+                                        {"deployment": self.name})
+                if self.spec_proposed:
+                    SERVE_SPEC_ACCEPTANCE.set(
+                        self.spec_accepted / self.spec_proposed,
+                        {"deployment": self.name})
 
 
 class DecodeSessionCore:
@@ -485,8 +795,10 @@ class DecodeSessionCore:
         """``prefill_chunk > 0`` prefills in fixed-size chunks through
         one small reusable program instead of a whole-prompt compile —
         for models whose full-prompt flash prefill is a compile-helper
-        killer (llama-family GQA, SURVEY §9).  ``engine`` is True
-        (default), False, or a :class:`DecodeEngineConfig`."""
+        killer (llama-family GQA, SURVEY §9); it also overrides the
+        engine's ``prefill_chunk_tokens`` so the legacy path and the
+        engine's chunked admission share one chunk shape.  ``engine``
+        is True (default), False, or a :class:`DecodeEngineConfig`."""
         import jax
 
         from ..models import decode_step, init_params, prefill
@@ -515,6 +827,13 @@ class DecodeSessionCore:
             self._engine_cfg = engine
         else:
             self._engine_cfg = DecodeEngineConfig()
+        if self._engine_cfg is not None and prefill_chunk > 0:
+            # one chunk width per replica: the engine's admission/resume
+            # programs and the legacy prefill_chunked path must share
+            # shapes, or each path compiles its own chunk program
+            import dataclasses as _dc
+            self._engine_cfg = _dc.replace(
+                self._engine_cfg, prefill_chunk_tokens=prefill_chunk)
         self._engine: Optional[ContinuousBatchingEngine] = None
 
     @property
@@ -536,8 +855,7 @@ class DecodeSessionCore:
                         pass
                     self._engine = ContinuousBatchingEngine(
                         self.cfg, self.max_len, self.params,
-                        self._prefill, self._engine_cfg,
-                        name=name, replica_tag=tag)
+                        self._engine_cfg, name=name, replica_tag=tag)
         return self._engine
 
     def handle(self, req: Dict[str, Any]) -> Dict[str, Any]:

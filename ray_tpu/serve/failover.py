@@ -8,10 +8,16 @@ exactly deterministic: prompt + tokens-delivered-so-far fully determine
 the rest of the stream.  So the proxy keeps a per-session **replay
 journal** (prompt, emitted token ids, monotonic seq) and, on owner
 failure, re-admits the session on a healthy replica with a
-teacher-forced prefix prefill (``{"op": "resume"}`` →
-``models.resume_prefill`` → ``models.cache_insert_slot``), resuming at
-the next seq.  The client sees a stall — never an error, never a
-repeated or dropped token.
+teacher-forced prefix prefill (``{"op": "resume"}``), resuming at the
+next seq.  Resume IS chunked admission since PR-6: the target engine's
+thread walks the replay prefix through the same fixed-shape chunk
+programs every admission uses (``models.prefill_chunk_jit`` →
+``models.cache_insert_slot``), so a resume never stalls the healthy
+replica's live streams and never compiles a new program — and a
+resume into a SPECULATING engine is byte-identical too, because greedy
+speculative acceptance is exact-match against the target's own chain.
+The client sees a stall — never an error, never a repeated or dropped
+token.
 
 Seq accounting makes the splice airtight:
 

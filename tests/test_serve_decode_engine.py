@@ -129,9 +129,14 @@ def test_engine_slot_reclamation_backpressure_and_lru():
     from ray_tpu.serve.config import DecodeEngineConfig
     from ray_tpu.serve.decode_session import DecodeSessionCore
     cfg = _tiny_cfg()
+    # token_queue_depth=4 pins occupancy: each session decodes 4 tokens
+    # ahead then PAUSES holding its slot, so `occupied == 2` is a
+    # stable state instead of a race against sessions running to cache
+    # cap (chunked admission made joins fast enough to lose that race)
     core = DecodeSessionCore(
         cfg, max_len=64, seed=0, max_sessions=4,
-        engine=DecodeEngineConfig(max_slots=2, max_waiting=0))
+        engine=DecodeEngineConfig(max_slots=2, max_waiting=0,
+                                  token_queue_depth=4))
     a = core.handle({"op": "start", "prompt": [1, 2, 3]})
     b = core.handle({"op": "start", "prompt": [4, 5, 6]})
     deadline = time.monotonic() + 120
@@ -155,10 +160,14 @@ def test_engine_slot_reclamation_backpressure_and_lru():
     assert len(out["tokens"]) == 3
     # ended sid is forgotten
     assert "error" in core.handle({"op": "next", "sid": a["sid"]})
-    # LRU: b was abandoned (never ended); let it run to cache cap (its
-    # slot is reclaimed the moment it finishes), then push the session
-    # TABLE past max_sessions — the abandoned finished session is the
-    # eviction victim, so replica memory stays bounded
+    # LRU: b was abandoned (never ended); un-pin the queue bound so it
+    # runs to cache cap (its slot is reclaimed the moment it finishes),
+    # then push the session TABLE past max_sessions — the abandoned
+    # finished session is the eviction victim, so replica memory stays
+    # bounded
+    core.engine.ecfg.token_queue_depth = 64
+    with core.engine._cond:
+        core.engine._cond.notify_all()   # wake the paused loop
     while core.handle({"op": "stats"})["engine"]["occupied_slots"] > 1:
         assert time.monotonic() < deadline
         time.sleep(0.05)

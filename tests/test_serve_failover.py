@@ -326,8 +326,18 @@ def test_engine_resume_replay_parity():
         ref += out["tokens"]
     core.handle({"op": "end", "sid": r["sid"]})
 
-    for cut in (1, 7, 12):
-        fresh = DecodeSessionCore(cfg, max_len=64, seed=3)
+    from ray_tpu.serve.config import DecodeEngineConfig
+
+    # engines to resume INTO: plain, and (PR-6) one that speculates —
+    # chunked teacher-forced admission + exact greedy verification must
+    # keep the replayed continuation byte-identical either way
+    engines = {1: True, 7: True,
+               12: DecodeEngineConfig(spec_draft="shared", spec_k=4),
+               6: DecodeEngineConfig(prefill_chunk_tokens=4,
+                                     spec_draft="shared", spec_k=3)}
+    for cut, engine in engines.items():
+        fresh = DecodeSessionCore(cfg, max_len=64, seed=3,
+                                  engine=engine)
         rr = fresh.handle({"op": "resume", "prompt": prompt,
                            "generated": ref[:cut]})
         assert "error" not in rr, rr
@@ -340,6 +350,8 @@ def test_engine_resume_replay_parity():
             toks += out["tokens"]
         assert toks == ref, f"cut={cut}: {toks} != {ref}"
         fresh.handle({"op": "end", "sid": rr["sid"]})
+        if fresh.engine is not None:
+            fresh.engine.shutdown()
 
 
 # --------------------------------------------------- session leak reaper
