@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
-	chaos drain failover spec clean
+	chaos drain failover spec elastic clean
 
 all: native cpp
 
@@ -49,6 +49,13 @@ drain:
 # node hosting live streams (zero dropped sessions).
 failover:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_failover.py -q
+
+# Elastic suite: unannounced-failure gang repair — crash-safe
+# checkpoint registration, pubsub death/drain signal units, the hard
+# node-kill acceptance scenario (fast repair, loss parity, ×2 seeds),
+# and the `slow` chaos-abort / double-kill fallback cases.
+elastic:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_elastic.py -q
 
 # Spec suite: chunked-prefill admission + speculative decoding —
 # verify-program exactness, chunk-boundary/admission parity, shared and

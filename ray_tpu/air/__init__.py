@@ -11,6 +11,7 @@ from .batch_predictor import BatchPredictor  # noqa: F401
 from .checkpoint import Checkpoint  # noqa: F401
 from .config import (  # noqa: F401
     CheckpointConfig,
+    ElasticConfig,
     FailureConfig,
     RunConfig,
     ScalingConfig,

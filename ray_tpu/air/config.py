@@ -46,6 +46,32 @@ class FailureConfig:
 
 
 @dataclasses.dataclass
+class ElasticConfig:
+    """Elastic gang recovery: in-memory replicated micro-checkpoints +
+    fast rank replacement, so an *unannounced* TPU preemption costs
+    seconds and at most ``snapshot_interval_steps`` steps instead of a
+    full-gang restart from the last disk checkpoint.
+
+    Each rank snapshots its reported train state into the object store
+    every ``snapshot_interval_steps`` reports (asynchronously, off the
+    step path), with the primary copy pinned on a ring-neighbor peer
+    host so one host's death never loses its own shard.  On a worker or
+    node death the BackendExecutor parks healthy ranks, reschedules only
+    the dead ranks, restores everyone from the peer-held shards, and
+    resumes at the snapshot step — falling back to the legacy
+    restart-from-disk path when repair overruns ``repair_deadline_s``
+    or a second failure lands mid-repair."""
+    snapshot_interval_steps: int = 10   # "elastic_snapshot_interval_steps"
+    repair_deadline_s: float = 30.0     # rendezvous barrier budget
+    max_repairs: int = 8                # fast-repair budget per attempt
+    # history depth per rank: 2 guarantees a common restore step exists
+    # even when a death races a snapshot wave (ranks snapshot at the
+    # same iteration boundaries, so each rank's kept steps differ by at
+    # most one interval)
+    keep_snapshots: int = 2
+
+
+@dataclasses.dataclass
 class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_frequency: int = 0
@@ -60,6 +86,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
+    # an ElasticConfig turns on in-memory replicated micro-checkpoints
+    # and fast rank replacement for unannounced worker/node deaths
+    elastic_config: Optional[ElasticConfig] = None
     verbose: int = 0
     # a tune.ProgressReporter (e.g. CLIReporter); verbose>0 implies a
     # default CLIReporter when unset

@@ -30,6 +30,10 @@ class _Session:
         self.last_checkpoint: Optional[Checkpoint] = None
         self.iteration = 0
         self._last_report_t: Optional[float] = None
+        # elastic recovery (train/elastic.py): a per-rank background
+        # snapshotter installed by TrainWorker.init_session; report()
+        # only ENQUEUES — serialization/replication stay off-step-path
+        self.elastic = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None):
@@ -50,6 +54,8 @@ class _Session:
             self.last_checkpoint = checkpoint
         self.queue.put({"metrics": dict(metrics), "checkpoint": checkpoint,
                         "iteration": self.iteration})
+        if self.elastic is not None and checkpoint is not None:
+            self.elastic.maybe_snapshot(self.iteration, checkpoint)
         if self.stop_event.is_set():
             raise SystemExit("session stopped by driver")
 

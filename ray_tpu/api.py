@@ -227,6 +227,20 @@ def _resolve_resources(opts: dict) -> Dict[str, float]:
     return res
 
 
+def _bundle_index(opts: dict) -> int:
+    """Bundle index from either surface: the explicit option, or the
+    PlacementGroupSchedulingStrategy (the way WorkerGroup and every
+    reference-style caller passes it).  Reading only the option pinned
+    every gang actor to bundle 0's node — on multi-node placement groups
+    the rest of the gang could never place."""
+    idx = opts.get("placement_group_bundle_index", -1)
+    strat = opts.get("scheduling_strategy")
+    if idx < 0 and strat is not None \
+            and hasattr(strat, "placement_group_bundle_index"):
+        idx = strat.placement_group_bundle_index
+    return idx
+
+
 def _strategy_dict(opts: dict) -> Dict[str, Any]:
     strat = opts.get("scheduling_strategy")
     d: Dict[str, Any] = {}
@@ -282,7 +296,7 @@ class RemoteFunction:
             retry_exceptions=opts["retry_exceptions"],
             placement_group_id=PlacementGroupID(pg.id.binary())
             if pg is not None and hasattr(pg, "id") else None,
-            bundle_index=opts.get("placement_group_bundle_index", -1),
+            bundle_index=_bundle_index(opts),
             scheduling_strategy=_strategy_dict(opts),
             runtime_env=opts.get("runtime_env"),
         )
@@ -405,7 +419,7 @@ class ActorClass:
             max_restarts=opts["max_restarts"],
             placement_group_id=PlacementGroupID(pg.id.binary())
             if pg is not None and hasattr(pg, "id") else None,
-            bundle_index=opts.get("placement_group_bundle_index", -1),
+            bundle_index=_bundle_index(opts),
             scheduling_strategy=_strategy_dict(opts),
             runtime_env=opts.get("runtime_env"),
         )

@@ -235,7 +235,8 @@ class Controller:
                      "create_placement_group", "wait_placement_group",
                      "remove_placement_group", "list_placement_groups",
                      "object_location_add", "object_location_remove",
-                     "object_locations_get", "free_objects", "list_objects",
+                     "object_locations_get", "object_replicate",
+                     "free_objects", "list_objects",
                      "ref_inc", "ref_dec", "free_request", "ref_counts",
                      "report_event", "list_events",
                      "subscribe", "publish", "register_job", "finish_job",
@@ -1104,6 +1105,38 @@ class Controller:
                 await asyncio.wait_for(ev.wait(), timeout=remaining)
             except asyncio.TimeoutError:
                 pass
+
+    async def _h_object_replicate(self, conn, data):
+        """Replicate an object onto a live peer node with a primary pin
+        there (the drain-era ``pull {pin_primary}`` machinery).  The
+        target is the caller's RING NEIGHBOR — the next alive,
+        non-draining node after ``exclude_node`` in sorted-id order — so
+        elastic train snapshots land deterministically off-host and one
+        host's death never loses its own shard."""
+        oid = data["object_id"]
+        exclude = data.get("exclude_node")
+        ring = sorted(nid for nid, rec in self.nodes.items()
+                      if rec.view.alive and not rec.view.draining
+                      and nid != exclude)
+        if not ring:
+            return {"ok": False, "error": "no live peer to replicate to"}
+        target = data.get("node_id")
+        if target is None:
+            target = (next((n for n in ring if n > (exclude or "")),
+                           ring[0]))
+        rec = self.nodes.get(target)
+        if rec is None or not rec.view.alive:
+            return {"ok": False, "error": f"target {target!r} not alive"}
+        try:
+            r = await rec.conn.call(
+                "pull", {"object_id": oid,
+                         "timeout": float(data.get("timeout", 20.0)),
+                         "pin_primary": True},
+                timeout=float(data.get("timeout", 20.0)) + 10.0)
+        except rpc.RpcError as e:
+            return {"ok": False, "error": str(e), "node_id": target}
+        return {"ok": bool(r.get("ok")), "node_id": target,
+                "error": r.get("error")}
 
     async def _h_free_objects(self, conn, data):
         """Immediate (unconditional) free — spilling/testing paths."""

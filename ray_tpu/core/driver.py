@@ -1427,6 +1427,16 @@ class CoreClient(DeferredRefDecs):
             except Exception:
                 pass  # degraded: listeners fall back to table polling
 
+    def unsubscribe_node_events(self, callback) -> None:
+        """Drop a listener registered with :meth:`subscribe_node_events`
+        (the controller subscription itself stays — other listeners may
+        share it, and a bare subscription is one no-op push per event)."""
+        with self._node_sub_lock:
+            try:
+                self._node_listeners.remove(callback)
+            except ValueError:
+                pass
+
     # -------------------------------------------------------------- shutdown
     def shutdown(self):
         if self._closed:

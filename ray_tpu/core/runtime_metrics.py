@@ -80,6 +80,17 @@ ACTORS_MIGRATED = m.Counter(
 OBJECTS_EVACUATED = m.Counter(
     "ray_tpu_objects_evacuated_total",
     "Sole-copy objects pushed to a peer during node drain", ("node",))
+TRAIN_REPAIRS = m.Counter(
+    "ray_tpu_train_repairs_total",
+    "Elastic gang repairs after an unannounced worker/node death, by "
+    "outcome (repaired: healthy ranks parked, dead ranks rescheduled, "
+    "gang resumed from the peer-replicated snapshot | fallback: repair "
+    "aborted, legacy full restart-from-disk taken)", ("outcome",))
+TRAIN_LOST_STEPS = m.Counter(
+    "ray_tpu_train_repair_lost_steps_total",
+    "Train steps rewound by elastic repairs (last reported step minus "
+    "the restored snapshot step; bounded by "
+    "elastic snapshot_interval_steps per repair)", ())
 SERVE_TOKENS = m.Counter(
     "ray_tpu_serve_tokens_total",
     "Tokens decoded by replica continuous-batching engines "
@@ -146,6 +157,12 @@ SERVE_FAILOVER_LATENCY = m.Histogram(
     "resumed session's first token on the new replica (the client-"
     "visible stall)",
     (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0), ("deployment",))
+TRAIN_REPAIR_DURATION = m.Histogram(
+    "ray_tpu_train_repair_seconds",
+    "Wall time of one elastic gang repair: death detection to the gang "
+    "training again at the snapshot step (recovery time; the elastic "
+    "promise is seconds, not a full-restart rendezvous)",
+    (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0), ("outcome",))
 DRAIN_DURATION = m.Histogram(
     "ray_tpu_node_drain_duration_seconds",
     "Wall time of one node drain, start to deregister/fallback",

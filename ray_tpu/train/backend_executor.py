@@ -6,22 +6,60 @@ per-rank `train_func` launch :314, result bubbling).  Ranks are assigned by
 sorted (hostname, pid): workers on the same host get consecutive local
 ranks — on TPU pods that makes world rank == slice host order, so the mesh
 axes line up with ICI neighborhoods.
+
+Elastic recovery (train/elastic.py): with an ElasticConfig, an
+*unannounced* worker/node death no longer tears the gang down.  Healthy
+ranks park in a deadline-bounded repair barrier (their actors survive;
+only the train thread rewinds), only the dead ranks are rescheduled onto
+spare capacity, every rank restores from the peer-replicated in-memory
+snapshot, and the gang resumes at the snapshot step.  Deadline overrun,
+a missing snapshot, or a second failure mid-repair falls back to the
+legacy TrainingFailedError → full restart-from-disk path — the repair
+can only ever make recovery faster, never less safe.
 """
 
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 import uuid
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from .. import api
 from ..air.checkpoint import Checkpoint
+from ..core import runtime_metrics as rtm
+from ..util import fault_injection as fi
+from ..util import tracing
+from . import elastic
 from .backend import Backend, BackendConfig
 from .worker_group import WorkerGroup
 
+#: next_result poll slice: short enough that a repair never waits long
+#: behind an in-flight poll on a (serial) healthy actor, long enough to
+#: keep the idle RPC rate trivial
+_POLL_SLICE_S = 2.0
+_PROBE_TIMEOUT_S = 5.0
+#: reconcile interval for the draining-node state poll — the pubsub
+#: push is the primary signal now, the poll only heals a missed event
+_DRAIN_POLL_INTERVAL_S = 10.0
+
 
 class TrainingFailedError(RuntimeError):
+    #: True on subclasses raised for PLANNED restarts (node drain):
+    #: the trainer restarts without burning FailureConfig.max_failures
+    planned = False
+
+
+class GangDrainRestart(TrainingFailedError):
+    """A gang worker sits on a draining node: restart from the latest
+    checkpoint before the node departs.  Planned maintenance — exempt
+    from the failure budget (the actor-migration path got this
+    exemption in the drain PR; trainer attempts now match)."""
+    planned = True
+
+
+class _RepairAborted(RuntimeError):
     pass
 
 
@@ -29,13 +67,15 @@ class BackendExecutor:
     def __init__(self, backend_config: Optional[BackendConfig] = None,
                  num_workers: int = 1,
                  resources_per_worker: Optional[Dict[str, float]] = None,
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 elastic_config: Optional[Any] = None):
         self.backend_config = backend_config or BackendConfig()
         self.backend: Backend = self.backend_config.backend_cls(
             self.backend_config)
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
         self.placement_strategy = placement_strategy
+        self.elastic_config = elastic_config
         self.run_id = uuid.uuid4().hex[:8]
         self.worker_group: Optional[WorkerGroup] = None
         self.shared_env: Dict[str, Any] = {}
@@ -45,11 +85,28 @@ class BackendExecutor:
         # instead of dying mid-step when the node departs
         self._drain_pending: Optional[str] = None
         self._last_drain_check = 0.0
+        # node-membership push state (controller `nodes` pubsub): the
+        # primary death/drain signal — the state-API poll only reconciles
+        self._event_lock = threading.Lock()
+        self._pushed_draining: Set[str] = set()
+        self._pushed_dead: Set[str] = set()
+        self._subscribed_core = None
+        self._node_of_worker: Dict[int, Optional[str]] = {}
+        self._rank_assignments: Dict[int, Dict[str, Any]] = {}
+        self._trial_name = "train"
+        self._dataset_shards: Optional[List[Any]] = None
+        self._elastic_args: Optional[Dict[str, Any]] = None
+        self._train_blob: Optional[bytes] = None
+        self._train_config: Dict[str, Any] = {}
+        self._last_seen_iteration = 0
+        self._repairs_done = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, *, trial_name: str = "train",
               resume_checkpoint: Optional[Checkpoint] = None,
               dataset_shards: Optional[List[Any]] = None) -> None:
+        self._trial_name = trial_name
+        self._dataset_shards = dataset_shards
         self.worker_group = WorkerGroup(
             self.num_workers, self.resources_per_worker,
             self.placement_strategy)
@@ -59,16 +116,27 @@ class BackendExecutor:
                        key=lambda i: (meta[i]["hostname"], meta[i]["pid"]))
         self.world_ranks = {worker_idx: rank
                             for rank, worker_idx in enumerate(order)}
+        self._node_of_worker = {i: meta[i].get("node_id")
+                                for i in range(self.num_workers)}
         local_counters: Dict[str, Any] = {}
         node_ids: Dict[str, int] = {}
         ckpt_bytes = (resume_checkpoint.to_bytes()
                       if resume_checkpoint else None)
+        ec = self.elastic_config
+        self._elastic_args = None
+        if ec is not None:
+            self._elastic_args = {
+                "run_id": f"{trial_name}:{self.run_id}",
+                "interval": ec.snapshot_interval_steps,
+                "keep": ec.keep_snapshots}
         refs = []
         for worker_idx, w in enumerate(self.worker_group.workers):
             host = meta[worker_idx]["hostname"]
             local_rank = local_counters.setdefault(
                 host, itertools.count()).__next__()
             node_rank = node_ids.setdefault(host, len(node_ids))
+            self._rank_assignments[worker_idx] = {
+                "local_rank": local_rank, "node_rank": node_rank}
             refs.append(w.init_session.remote(
                 world_rank=self.world_ranks[worker_idx],
                 local_rank=local_rank,
@@ -77,8 +145,10 @@ class BackendExecutor:
                 trial_name=trial_name,
                 checkpoint_bytes=ckpt_bytes,
                 dataset_shard=(dataset_shards[self.world_ranks[worker_idx]]
-                               if dataset_shards else None)))
+                               if dataset_shards else None),
+                elastic=self._elastic_args))
         api.get(refs, timeout=120.0)
+        self._subscribe_node_events()
         self.backend.on_start(self.worker_group, self)
         setup = self.backend.worker_setup_fn(self)
         if setup is not None:
@@ -87,23 +157,71 @@ class BackendExecutor:
     def start_training(self, train_fn: Callable,
                        config: Optional[Dict[str, Any]] = None) -> None:
         from ..core.serialization import dumps_function
-        blob = dumps_function(train_fn)
-        api.get([w.start_training.remote(blob, config or {})
+        self._train_blob = dumps_function(train_fn)
+        self._train_config = config or {}
+        api.get([w.start_training.remote(self._train_blob,
+                                         self._train_config)
                  for w in self.worker_group.workers], timeout=120.0)
+
+    # -- node-membership push ------------------------------------------------
+    def _subscribe_node_events(self) -> None:
+        try:
+            from ..core.driver import get_global_core
+            core = get_global_core()
+            if core is None:
+                return
+            core.subscribe_node_events(self._on_node_event)
+            self._subscribed_core = core
+        except Exception:
+            self._subscribed_core = None  # poll reconcile still covers us
+
+    def _unsubscribe_node_events(self) -> None:
+        core, self._subscribed_core = self._subscribed_core, None
+        if core is not None:
+            try:
+                core.unsubscribe_node_events(self._on_node_event)
+            except Exception:
+                pass
+
+    def _on_node_event(self, ev: Dict[str, Any]) -> None:
+        # runs on the driver IO loop: record and return, never block
+        event, nid = ev.get("event"), ev.get("node_id")
+        if not nid:
+            return
+        with self._event_lock:
+            if event == "draining":
+                self._pushed_draining.add(nid)
+            elif event == "dead":
+                self._pushed_dead.add(nid)
+
+    def _gang_nodes(self) -> Set[str]:
+        return {n for n in self._node_of_worker.values() if n}
 
     def _gang_on_draining_node(self) -> Optional[str]:
         """Node id of a draining node hosting one of our gang actors, or
-        None.  Throttled — one state-API round trip every ~2 s."""
+        None.  Pubsub-pushed state answers instantly; the throttled
+        state-API poll (every ~10 s) only reconciles a missed event."""
+        gang = self._gang_nodes()
+        with self._event_lock:
+            hit = next((n for n in self._pushed_draining if n in gang),
+                       None)
+        if hit is not None:
+            return hit
         now = time.monotonic()
-        if now - self._last_drain_check < 2.0:
+        if now - self._last_drain_check < _DRAIN_POLL_INTERVAL_S:
             return None
         self._last_drain_check = now
         try:
             from .. import state
             draining = {n["id"] for n in state.list_nodes()
                         if n.get("alive") and n.get("draining")}
+            hit = next((n for n in draining if n in gang), None)
+            if hit is not None:
+                return hit
             if not draining:
                 return None
+            # gang metadata may predate a migration: fall back to the
+            # actor table the old poll used
             aids = {w._actor_id for w in self.worker_group.workers}
             for row in state.list_actors():
                 if row.get("actor_id") in aids \
@@ -113,36 +231,196 @@ class BackendExecutor:
             return None
         return None
 
+    def _gang_node_died(self) -> bool:
+        with self._event_lock:
+            return bool(self._pushed_dead & self._gang_nodes())
+
+    # -- results -------------------------------------------------------------
     def next_results(self, timeout_s: float = 60.0):
         """One report from every rank (ordered by world rank), or None when
-        all ranks finished.  Raises TrainingFailedError on worker failure."""
-        if self._drain_pending is not None:
-            # the previous report (and its checkpoint) has been consumed
-            # by the trainer — restart NOW from it, before the draining
-            # node kills the gang mid-step
-            nid = self._drain_pending
-            self._drain_pending = None
-            raise TrainingFailedError(
-                f"gang worker on draining node {nid[:12]}; restarting "
-                f"from the latest checkpoint before the node departs")
-        refs = [w.next_result.remote(timeout_s)
-                for w in self.worker_group.workers]
-        try:
-            results = api.get(refs, timeout=timeout_s + 60.0)
-        except Exception as e:
-            raise TrainingFailedError(f"worker lost mid-training: {e}") from e
-        if all(r is None for r in results):
-            return None
-        self._drain_pending = self._gang_on_draining_node()
-        if any(r is None for r in results):
-            # some ranks done, some not: drain the stragglers next call
-            results = [r if r is not None else "__timeout__"
-                       for r in results]
-        by_rank = [None] * self.num_workers
-        for worker_idx, r in enumerate(results):
-            by_rank[self.world_ranks[worker_idx]] = r
-        return by_rank
+        all ranks finished.  Raises TrainingFailedError on worker failure
+        (GangDrainRestart for planned drains).  Polls in short slices so
+        an elastic repair is never stuck behind a long in-flight poll on
+        a healthy rank's serial actor queue."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self._drain_pending is not None:
+                # the previous report (and its checkpoint) has been
+                # consumed by the trainer — restart NOW from it, before
+                # the draining node kills the gang mid-step
+                nid = self._drain_pending
+                self._drain_pending = None
+                raise GangDrainRestart(
+                    f"gang worker on draining node {nid[:12]}; restarting "
+                    f"from the latest checkpoint before the node departs")
+            if self.elastic_config is not None and self._gang_node_died():
+                # pubsub beat the RPC failure to us: repair proactively
+                if not self._try_repair():
+                    raise TrainingFailedError(
+                        "gang node died and elastic repair failed")
+                continue
+            poll = min(_POLL_SLICE_S, max(0.2, deadline - time.monotonic()))
+            refs = [w.next_result.remote(poll)
+                    for w in self.worker_group.workers]
+            try:
+                results = api.get(refs, timeout=poll + 60.0)
+            except Exception as e:
+                if self._try_repair():
+                    continue
+                raise TrainingFailedError(
+                    f"worker lost mid-training: {e}") from e
+            if all(r is None for r in results):
+                return None
+            if all(r in (None, "__timeout__") for r in results) \
+                    and time.monotonic() < deadline:
+                continue  # nothing reported yet: poll the next slice
+            self._drain_pending = self._gang_on_draining_node()
+            if any(r is None for r in results):
+                # some ranks done, some not: drain the stragglers next call
+                results = [r if r is not None else "__timeout__"
+                           for r in results]
+            by_rank = [None] * self.num_workers
+            for worker_idx, r in enumerate(results):
+                by_rank[self.world_ranks[worker_idx]] = r
+                if isinstance(r, dict):
+                    self._last_seen_iteration = max(
+                        self._last_seen_iteration, r.get("iteration", 0))
+            return by_rank
 
+    # -- elastic repair ------------------------------------------------------
+    def _try_repair(self) -> bool:
+        """Fast gang repair after an unannounced death.  True: the gang
+        is training again from the newest common snapshot.  False: the
+        caller must take the legacy full-restart path."""
+        ec = self.elastic_config
+        if ec is None or self.worker_group is None \
+                or self._train_blob is None:
+            return False
+        if self._repairs_done >= ec.max_repairs:
+            return False
+        t0 = time.monotonic()
+        t0_wall = time.time()
+        outcome, step = "fallback", -1
+        try:
+            step = self._repair_once(t0 + ec.repair_deadline_s)
+            self._repairs_done += 1
+            outcome = "repaired"
+            return True
+        except Exception:
+            return False
+        finally:
+            rtm.TRAIN_REPAIRS.inc(tags={"outcome": outcome})
+            rtm.TRAIN_REPAIR_DURATION.observe(
+                time.monotonic() - t0, tags={"outcome": outcome})
+            tracing.record_span(
+                f"train_repair::{self._trial_name}", "train",
+                t0_wall, time.time(), outcome=outcome, step=step,
+                run_id=self.run_id)
+
+    def _check_deadline(self, deadline: float, phase: str) -> float:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise _RepairAborted(f"repair deadline overrun at {phase}")
+        return remaining
+
+    def _repair_once(self, deadline: float) -> int:
+        ec = self.elastic_config
+        wg = self.worker_group
+        # 1. probe the gang: which ranks are gone?  (A dead actor's call
+        # fails fast — the conn is reset and the controller knows.)
+        dead: List[int] = []
+        probes = [(i, w.metadata.remote()) for i, w in
+                  enumerate(wg.workers)]
+        for i, ref in probes:
+            try:
+                api.get([ref], timeout=min(
+                    _PROBE_TIMEOUT_S,
+                    self._check_deadline(deadline, "probe")))
+            except Exception:
+                dead.append(i)
+        if not dead:
+            raise _RepairAborted("no dead rank found")
+        # 2. newest step every rank holds a replicated snapshot for
+        run_id = (self._elastic_args or {}).get("run_id", "")
+        snaps = elastic.load_gang_snapshots(run_id, self.num_workers)
+        step = elastic.pick_common_step(snaps, self.num_workers)
+        if step is None:
+            raise _RepairAborted("no common replicated snapshot step")
+        if fi.ACTIVE is not None:
+            act = fi.ACTIVE.point(elastic.RESTORE_SITE,
+                                  f"{run_id}:{step}")
+            if act is not None:
+                if act["action"] in ("delay", "latency"):
+                    time.sleep(max(0.0, act["delay_s"]))
+                else:
+                    raise _RepairAborted("chaos: repair restore failed")
+        # 3. fetch every rank's shard (the dead ranks' shards survive on
+        # their ring-neighbor peers — that is the whole point)
+        blobs: Dict[int, bytes] = {}
+        for worker_idx in range(self.num_workers):
+            rank = self.world_ranks[worker_idx]
+            entry = elastic.snapshot_at(snaps[rank], step)
+            blobs[worker_idx] = elastic.fetch_snapshot_bytes(
+                entry, timeout=min(20.0, self._check_deadline(
+                    deadline, "restore")))
+        # 4. park the healthy ranks: rewind their sessions in place —
+        # actors stay up, no placement work, no restart budget burned
+        for i, w in enumerate(wg.workers):
+            if i in dead:
+                continue
+            remaining = self._check_deadline(deadline, "park")
+            ok = api.get([w.reset_for_repair.remote(
+                blobs[i], step,
+                join_timeout_s=min(10.0, remaining))],
+                timeout=remaining + 10.0)[0]
+            if not ok:
+                raise _RepairAborted(
+                    f"rank {self.world_ranks[i]} refused to park")
+        # 5. reschedule ONLY the dead ranks (outside the PG — their
+        # bundles sit on the dead node; spare capacity takes them)
+        init_refs = []
+        for i in dead:
+            w = wg.spawn_replacement(i)
+            asn = self._rank_assignments.get(i, {})
+            rank = self.world_ranks[i]
+            init_refs.append(w.init_session.remote(
+                world_rank=rank,
+                local_rank=asn.get("local_rank", 0),
+                world_size=self.num_workers,
+                node_rank=asn.get("node_rank", 0),
+                trial_name=self._trial_name,
+                checkpoint_bytes=blobs[i],
+                dataset_shard=(self._dataset_shards[rank]
+                               if self._dataset_shards else None),
+                elastic=self._elastic_args,
+                start_iteration=step))
+        api.get(init_refs, timeout=self._check_deadline(deadline, "spawn"))
+        # 6. refresh the gang's node map + consume the death flags
+        try:
+            meta = wg.metadata()
+            self._node_of_worker = {i: meta[i].get("node_id")
+                                    for i in range(self.num_workers)}
+        except Exception as e:
+            raise _RepairAborted(f"post-repair metadata probe: {e}")
+        with self._event_lock:
+            self._pushed_dead &= self._gang_nodes()
+        # 7. re-run the backend rendezvous (process groups, mesh env)
+        self._check_deadline(deadline, "rendezvous")
+        self.backend.on_start(wg, self)
+        setup = self.backend.worker_setup_fn(self)
+        if setup is not None:
+            wg.execute(setup)
+        # 8. resume every rank from the snapshot step
+        api.get([w.start_training.remote(self._train_blob,
+                                         self._train_config)
+                 for w in wg.workers],
+                timeout=self._check_deadline(deadline, "resume") + 30.0)
+        lost = max(0, self._last_seen_iteration - step)
+        rtm.TRAIN_LOST_STEPS.inc(lost)
+        self._last_seen_iteration = step
+        return step
+
+    # -- teardown ------------------------------------------------------------
     def finish(self) -> None:
         try:
             api.get([w.finish.remote()
@@ -151,9 +429,19 @@ class BackendExecutor:
             raise TrainingFailedError(str(e)) from e
 
     def shutdown(self) -> None:
+        self._unsubscribe_node_events()
         if self.worker_group is not None:
             try:
                 self.backend.on_shutdown(self.worker_group, self)
             finally:
                 self.worker_group.shutdown()
                 self.worker_group = None
+        # free the snapshot objects AFTER the gang is gone: a still-live
+        # snapshotter could otherwise re-register behind the sweep and
+        # leak its peer-pinned object
+        if self._elastic_args is not None:
+            try:
+                elastic.cleanup_run(self._elastic_args["run_id"],
+                                    self.num_workers)
+            except Exception:
+                pass

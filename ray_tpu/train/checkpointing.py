@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..air.checkpoint import Checkpoint
@@ -27,12 +28,34 @@ class CheckpointManager:
         self.mode = mode
         self._tracked: List[Tuple[int, str, Dict[str, Any]]] = []
         os.makedirs(storage_path, exist_ok=True)
+        # sweep torn writes from a previous crash: a .tmp-* staging dir
+        # is by definition incomplete and must never be resumed from
+        for name in os.listdir(storage_path):
+            if ".tmp-" in name:
+                shutil.rmtree(os.path.join(storage_path, name),
+                              ignore_errors=True)
 
     def register(self, iteration: int, checkpoint: Checkpoint,
                  metrics: Optional[Dict[str, Any]] = None) -> str:
+        """Crash-safe: the checkpoint is staged into a temp dir and
+        atomically renamed into place, so a crash mid-write can never
+        leave a torn ``checkpoint_<iter>`` that a later resume would
+        read as valid."""
         path = os.path.join(self.storage_path, f"checkpoint_{iteration:06d}")
-        checkpoint.to_directory(path)
-        self._tracked.append((iteration, path, dict(metrics or {})))
+        staging = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+        checkpoint.to_directory(staging)
+        if os.path.isdir(path):
+            # re-registration after a restart resumed at this iteration:
+            # replace the old complete dir (never visible half-written)
+            old = f"{path}.tmp-replaced-{uuid.uuid4().hex[:8]}"
+            os.rename(path, old)
+            os.rename(staging, path)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(staging, path)
+        entry = (iteration, path, dict(metrics or {}))
+        self._tracked = [e for e in self._tracked if e[1] != path]
+        self._tracked.append(entry)
         self._prune()
         return path
 

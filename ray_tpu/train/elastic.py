@@ -1,0 +1,272 @@
+"""Elastic gang recovery: in-memory replicated micro-checkpoints.
+
+The disk checkpoint path (CheckpointManager) is the durable story; this
+module is the *fast* one.  Every ``snapshot_interval_steps`` reports,
+each rank serializes its latest reported checkpoint into the object
+store **asynchronously** (a dedicated snapshotter thread — the step
+path only enqueues) and asks the controller to replicate it to a
+ring-neighbor peer host with a primary pin (the drain-era
+``pull {pin_primary}`` transfer machinery), so one host's unannounced
+death never loses its own shard.  The snapshot registry lives in the
+controller KV (namespace ``elastic``, key ``<run_id>:<rank>``) — the
+BackendExecutor's repair path reads it to find, per rank, the newest
+step every rank has a replicated snapshot for.
+
+Snapshots are runtime-managed objects *outside* the user refcount
+system: created straight through the store + ``put_location`` (primary
+pin at the origin), pinned again at the peer by the replicating pull,
+and freed explicitly when superseded or when the run ends
+(``cleanup_run``).  A worker's death therefore cannot GC the very bytes
+its repair needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..util import fault_injection as fi
+from ..util import tracing
+
+ELASTIC_KV_NS = "elastic"
+
+#: snapshot puts the repair would miss are degraded, never fatal: a
+#: failed put just widens the lost-steps window to the previous one
+SNAPSHOT_SITE = "train.snapshot_put"
+#: attacks the recovery itself: an error here aborts the repair and
+#: must take the legacy restart-from-disk fallback
+RESTORE_SITE = "train.repair_restore"
+
+
+def _kv_key(run_id: str, rank: int) -> bytes:
+    return f"{run_id}:{rank}".encode()
+
+
+def _snapshot_oid(step: int) -> bytes:
+    """A fresh runtime-managed object id (24 bytes, put-flagged).  The
+    random task prefix keeps snapshot ids out of every driver/worker
+    put-index space; the step rides in the index for log readability."""
+    from ..core import ids
+    return os.urandom(ids.TaskID.SIZE) + \
+        struct.pack("<I", 0x80000000 | (step & 0x7FFFFFFF))
+
+
+class ElasticSnapshotter:
+    """Per-rank background snapshotter.  ``maybe_snapshot`` (called from
+    ``session.report`` on the train thread) only enqueues; the thread
+    serializes, stores, replicates and registers.  Latest-wins: a slow
+    replication drops intermediate snapshots rather than queueing them."""
+
+    def __init__(self, run_id: str, world_rank: int, interval: int,
+                 keep: int = 2):
+        self.run_id = run_id
+        self.world_rank = world_rank
+        self.interval = max(1, int(interval))
+        self.keep = max(1, int(keep))
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._stop = False
+        self._history: List[Dict[str, Any]] = []
+        self._adopted = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"elastic-snap-r{world_rank}")
+        self._thread.start()
+
+    # ------------------------------------------------------- train thread
+    def maybe_snapshot(self, iteration: int, checkpoint) -> None:
+        if self._stop or iteration % self.interval != 0:
+            return
+        item = (iteration, checkpoint)
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            # latest wins: replace the stale pending snapshot
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:
+                pass
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+
+    # -------------------------------------------------- snapshotter thread
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None or self._stop:
+                return
+            try:
+                self._snapshot_once(*item)
+            except Exception:
+                # degraded, never fatal: the previous snapshot stands
+                pass
+
+    def _snapshot_once(self, iteration: int, checkpoint) -> None:
+        from ..api import _ensure_initialized
+        from ..core import serialization
+        key = f"{self.run_id}:{self.world_rank}:{iteration}"
+        if fi.ACTIVE is not None:
+            act = fi.ACTIVE.point(SNAPSHOT_SITE, key)
+            if act is not None:
+                if act["action"] in ("delay", "latency"):
+                    time.sleep(max(0.0, act["delay_s"]))
+                else:
+                    return  # snapshot lost; the previous one stands
+        t0 = time.time()
+        core = _ensure_initialized()
+        if not self._adopted:
+            # a repair-spawned replacement inherits the dead rank's
+            # registered snapshots: superseding them through the normal
+            # history rotation frees their peer-pinned objects instead
+            # of orphaning them under an overwritten KV entry
+            self._adopted = True
+            try:
+                raw = core.controller.call("kv_get", {
+                    "ns": ELASTIC_KV_NS,
+                    "key": _kv_key(self.run_id, self.world_rank)})
+                if raw:
+                    self._history = list(json.loads(raw)["snaps"])
+            except Exception:
+                pass
+        blob = checkpoint.to_bytes()
+        oid = _snapshot_oid(iteration)
+        parts = serialization.serialize(blob)
+        try:
+            core.store.put_parts(oid, parts)
+        except Exception:
+            return  # store full / closed: skip, keep training
+        # primary pin at the origin nodelet + directory entry
+        core.nodelet.call("put_location", {
+            "object_id": oid,
+            "size": serialization.serialized_size(parts)})
+        # replicate: the ring-neighbor peer pulls and takes a primary
+        # pin of its own — only then is the snapshot registered as
+        # restorable (an unreplicated snapshot dies with its host)
+        peer = None
+        try:
+            rep = core.controller.call("object_replicate", {
+                "object_id": oid, "exclude_node": core.node_id,
+                "timeout": 20.0}, timeout=30.0)
+            if rep.get("ok"):
+                peer = rep.get("node_id")
+        except Exception:
+            pass
+        entry = {"step": iteration, "oid": oid.hex(),
+                 "node": core.node_id, "peer": peer}
+        # entries at >= this step belong to an abandoned timeline (a
+        # post-repair rewind re-reaches their steps): supersede them too
+        dropped = [e for e in self._history if e["step"] >= iteration]
+        self._history = [e for e in self._history
+                         if e["step"] < iteration] + [entry]
+        dropped += self._history[:-self.keep]
+        self._history = self._history[-self.keep:]
+        core.controller.call("kv_put", {
+            "ns": ELASTIC_KV_NS,
+            "key": _kv_key(self.run_id, self.world_rank),
+            "value": json.dumps({"snaps": self._history}).encode()})
+        for d in dropped:
+            try:
+                core.controller.call("free_objects", {
+                    "object_ids": [bytes.fromhex(d["oid"])]})
+            except Exception:
+                pass
+        tracing.record_span(f"train_snapshot::{self.run_id}", "train",
+                            t0, time.time(), rank=self.world_rank,
+                            step=iteration, peer=peer or "")
+
+
+# ------------------------------------------------------- repair-side reads
+
+def load_gang_snapshots(run_id: str,
+                        world_size: int) -> Dict[int, List[Dict[str, Any]]]:
+    """rank -> registered snapshot entries (oldest first), from the
+    controller KV.  Ranks with no registered snapshot are absent."""
+    from ..api import _ensure_initialized
+    core = _ensure_initialized()
+    out: Dict[int, List[Dict[str, Any]]] = {}
+    for rank in range(world_size):
+        raw = core.controller.call("kv_get", {
+            "ns": ELASTIC_KV_NS, "key": _kv_key(run_id, rank)})
+        if not raw:
+            continue
+        try:
+            snaps = json.loads(raw)["snaps"]
+        except (ValueError, KeyError):
+            continue
+        if snaps:
+            out[rank] = snaps
+    return out
+
+
+def pick_common_step(snaps_by_rank: Dict[int, List[Dict[str, Any]]],
+                     world_size: int) -> Optional[int]:
+    """Newest step EVERY rank holds a snapshot for, or None.  Ranks
+    snapshot at the same iteration boundaries, so with keep>=2 a death
+    racing a snapshot wave still leaves min(latest) in every history."""
+    if len(snaps_by_rank) < world_size:
+        return None
+    step = min(max(s["step"] for s in snaps) for snaps in
+               snaps_by_rank.values())
+    for snaps in snaps_by_rank.values():
+        if not any(s["step"] == step for s in snaps):
+            return None
+    return step
+
+
+def snapshot_at(snaps: List[Dict[str, Any]],
+                step: int) -> Optional[Dict[str, Any]]:
+    return next((s for s in snaps if s["step"] == step), None)
+
+
+def fetch_snapshot_bytes(entry: Dict[str, Any],
+                         timeout: float = 20.0) -> bytes:
+    """Fetch one rank's snapshot blob by object id (pulls from whatever
+    replica survives — origin or ring-neighbor peer)."""
+    from ..api import _ensure_initialized
+    from ..core.driver import ObjectRef
+    from ..core.ids import ObjectID
+    core = _ensure_initialized()
+    ref = ObjectRef(ObjectID(bytes.fromhex(entry["oid"])), core)
+    blob = core.get([ref], timeout=timeout)[0]
+    if not isinstance(blob, (bytes, bytearray)):
+        raise TypeError(f"elastic snapshot {entry['oid'][:12]} "
+                        f"deserialized to {type(blob).__name__}")
+    return bytes(blob)
+
+
+def cleanup_run(run_id: str, world_size: int) -> None:
+    """Free every registered snapshot object and drop the KV entries —
+    called from BackendExecutor.shutdown so finished (or fallen-back)
+    runs leave nothing pinned on peer hosts."""
+    from ..api import _ensure_initialized
+    try:
+        core = _ensure_initialized()
+    except Exception:
+        return
+    for rank, snaps in load_gang_snapshots(run_id, world_size).items():
+        oids = []
+        for s in snaps:
+            try:
+                oids.append(bytes.fromhex(s["oid"]))
+            except ValueError:
+                continue
+        try:
+            if oids:
+                core.controller.call("free_objects", {"object_ids": oids})
+            core.controller.call("kv_del", {
+                "ns": ELASTIC_KV_NS, "key": _kv_key(run_id, rank)})
+        except Exception:
+            continue

@@ -91,6 +91,7 @@ class JaxTrainer:
         failure = self.run_config.failure_config or FailureConfig()
 
         attempts = 0
+        planned_restarts = 0
         resume = self.resume_from_checkpoint
         history: List[Dict[str, Any]] = []
         while True:
@@ -100,7 +101,15 @@ class JaxTrainer:
                               checkpoint=ckpt_mgr.latest_checkpoint,
                               path=run_dir, metrics_history=history)
             except TrainingFailedError as e:
-                attempts += 1
+                if getattr(e, "planned", False) and planned_restarts < 64:
+                    # drain-triggered restart: planned maintenance must
+                    # not burn the failure budget (the drain PR gave
+                    # actor migration this exemption; trainer attempts
+                    # now match).  The cap only guards against a
+                    # pathological drain loop.
+                    planned_restarts += 1
+                else:
+                    attempts += 1
                 if failure.max_failures >= 0 and \
                         attempts > failure.max_failures:
                     return Result(metrics=history[-1] if history else {},
@@ -130,7 +139,8 @@ class JaxTrainer:
         executor = BackendExecutor(
             self.backend_config, num_workers=sc.num_workers,
             resources_per_worker=sc.bundle(),
-            placement_strategy=sc.placement_strategy)
+            placement_strategy=sc.placement_strategy,
+            elastic_config=self.run_config.elastic_config)
         try:
             executor.start(trial_name=name, resume_checkpoint=resume,
                            dataset_shards=self._dataset_shards())

@@ -10,6 +10,7 @@ info for mesh bring-up.
 from __future__ import annotations
 
 import socket
+import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import api
@@ -32,8 +33,17 @@ class TrainWorker:
         self._error: Optional[BaseException] = None
 
     def metadata(self) -> Dict[str, Any]:
+        import json
         import os
-        return {"hostname": socket.gethostname(), "pid": os.getpid()}
+        node_id = None
+        ctx = os.environ.get("RAY_TPU_WORKER_CONTEXT")
+        if ctx:
+            try:
+                node_id = json.loads(ctx).get("node_id")
+            except ValueError:
+                pass
+        return {"hostname": socket.gethostname(), "pid": os.getpid(),
+                "node_id": node_id}
 
     def execute(self, fn_bytes: bytes, *args, **kwargs):
         from ..core.serialization import loads_function
@@ -44,7 +54,9 @@ class TrainWorker:
                      world_size: int, node_rank: int,
                      trial_name: str = "train",
                      checkpoint_bytes: Optional[bytes] = None,
-                     dataset_shard=None):
+                     dataset_shard=None,
+                     elastic: Optional[Dict[str, Any]] = None,
+                     start_iteration: int = 0):
         from ..air.checkpoint import Checkpoint
         from ..air.session import _Session, _set_session
         self._session = _Session(
@@ -54,6 +66,15 @@ class TrainWorker:
         if checkpoint_bytes is not None:
             self._session.last_checkpoint = Checkpoint.from_bytes(
                 checkpoint_bytes)
+        # a repair-spawned replacement resumes mid-run: its report
+        # iterations must continue from the restored snapshot step
+        self._session.iteration = int(start_iteration)
+        if elastic:
+            from .elastic import ElasticSnapshotter
+            self._session.elastic = ElasticSnapshotter(
+                run_id=elastic["run_id"], world_rank=world_rank,
+                interval=elastic.get("interval", 10),
+                keep=elastic.get("keep", 2))
         # install on the actor main thread as well: backend setup fns run
         # there (via execute) and need ranks / a place to hang the mesh
         _set_session(self._session)
@@ -118,9 +139,55 @@ class TrainWorker:
                 traceback.format_exception(self._error)))
         return True
 
+    def reset_for_repair(self, checkpoint_bytes: bytes, iteration: int,
+                         join_timeout_s: float = 10.0) -> bool:
+        """Park this healthy rank for an elastic gang repair: stop the
+        running train thread (it exits at its next ``session.report``),
+        rewind the session to the restored snapshot, and leave the actor
+        ready for a fresh ``start_training`` — WITHOUT killing the actor
+        or re-running placement.  False (thread refused to stop inside
+        the budget, e.g. blocked in a collective with the dead rank)
+        sends the executor to the full-restart fallback."""
+        import queue as _q
+
+        from ..air.checkpoint import Checkpoint
+        s = self._session
+        if s is None:
+            return False
+        s.stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(0.0, join_timeout_s))
+            if self._thread.is_alive():
+                return False
+            self._thread = None
+        # drop reports from the abandoned timeline (incl. the sentinel
+        # the stopping thread's finally pushed)
+        while True:
+            try:
+                s.queue.get_nowait()
+            except _q.Empty:
+                break
+        if s.elastic is not None:
+            # a queued-but-unwritten snapshot is from the abandoned
+            # timeline too — registering it after the rewind would
+            # advertise state the new timeline may never reproduce
+            try:
+                s.elastic._q.get_nowait()
+            except _q.Empty:
+                pass
+        s.stop_event = threading.Event()
+        s.last_checkpoint = Checkpoint.from_bytes(checkpoint_bytes)
+        s.iteration = int(iteration)
+        s._last_report_t = None
+        self._error = None
+        self._finished = False
+        return True
+
     def stop_session(self):
         if self._session is not None:
             self._session.stop_event.set()
+            if self._session.elastic is not None:
+                self._session.elastic.stop()
         return True
 
     def shutdown(self):
@@ -140,6 +207,8 @@ class WorkerGroup:
             b = dict(resources_per_worker or {})
             b.setdefault("CPU", 1.0)
             bundles.append(b)
+        self._bundles = bundles
+        self._rank_env = rank_env or {}
         self.pg: PlacementGroup = placement_group(
             bundles, strategy=placement_strategy)
         self.pg.ready()
@@ -152,7 +221,21 @@ class WorkerGroup:
                 actor_cls.options(
                     scheduling_strategy=strategy,
                     num_cpus=bundles[i].get("CPU", 1.0),
-                ).remote(rank_env or {}))
+                ).remote(self._rank_env))
+
+    def spawn_replacement(self, index: int):
+        """Replace a dead gang member with a fresh actor OUTSIDE the
+        placement group (its bundle sits on the dead node): the
+        scheduler places it on whatever spare capacity exists.  The old
+        handle is dropped; callers re-init the session themselves."""
+        actor_cls = api.remote(TrainWorker)
+        w = actor_cls.options(
+            num_cpus=self._bundles[index].get("CPU", 1.0),
+            resources={k: v for k, v in self._bundles[index].items()
+                       if k != "CPU"},
+        ).remote(self._rank_env)
+        self.workers[index] = w
+        return w
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
         """Run fn on every worker, return per-rank results."""

@@ -55,6 +55,17 @@ site                        actions
 ``drain.deadline``          any action forces the drain orchestrator to treat
                             the drain as deadline-overrun — the node takes the
                             hard-death recovery path immediately
+``train.snapshot_put``      ``error``/``fail`` loses that elastic train
+                            snapshot (the previous one stands — a repair's
+                            lost-steps window widens by one interval),
+                            ``delay`` stretches the off-step-path put
+``train.repair_restore``    attacks elastic gang REPAIR itself
+                            (train/backend_executor.py): ``error``/``fail``
+                            aborts the repair — the run must take the
+                            legacy full-restart-from-disk fallback;
+                            ``delay`` stretches the repair window (the
+                            double-failure tests land a second kill inside
+                            it)
 ==========================  =====================================================
 
 Zero-cost when disabled: every hot path guards with one module-level
@@ -104,6 +115,8 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "serve.spec_verify": frozenset({"error", "fail"}),
     "drain.evacuate": None,
     "drain.deadline": None,
+    "train.snapshot_put": frozenset({"error", "fail"}),
+    "train.repair_restore": frozenset({"error", "fail"}),
 }
 _UNIVERSAL_ACTIONS = frozenset({"delay", "latency"})
 _RULE_KEYS = frozenset({"site", "action", "match", "delay_s", "once",

@@ -33,6 +33,11 @@ def test_cluster_metrics_exposition(cluster):
     # exposition format sanity
     assert "# TYPE ray_tpu_tasks_finished_total counter" in text
     assert "# TYPE ray_tpu_worker_pool_size gauge" in text
+    # the elastic-recovery battery is registered wherever the train
+    # driver runs: recovery-time histogram + lost-steps/repairs counters
+    assert "# TYPE ray_tpu_train_repairs_total counter" in text
+    assert "# TYPE ray_tpu_train_repair_lost_steps_total counter" in text
+    assert "# TYPE ray_tpu_train_repair_seconds histogram" in text
 
     def sample_sum(name: str) -> float:
         total = 0.0
