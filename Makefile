@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
-	chaos drain failover spec elastic clean
+	chaos drain failover spec elastic ha clean
 
 all: native cpp
 
@@ -56,6 +56,15 @@ failover:
 # and the `slow` chaos-abort / double-kill fallback cases.
 elastic:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_elastic.py -q
+
+# Controller HA suite: WAL CRC/replication units, split-brain epoch
+# fencing, in-process promotion, the end-to-end kill-the-leader
+# acceptance scenario (tables intact, in-flight wave completes, ×2
+# seeds), chaos-severed replication -> bounded-lag async degrade, and
+# the `slow` leader-death-mid-drain / mid-elastic-repair resumptions.
+ha:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_controller_ha.py \
+		tests/test_controller_ft.py -q
 
 # Spec suite: chunked-prefill admission + speculative decoding —
 # verify-program exactness, chunk-boundary/admission parity, shared and

@@ -189,10 +189,9 @@ class MaintenanceWatcher:
     def _conn(self):
         if self._client is None:
             from ..core import rpc
-            host, port = self.controller_addr.rsplit(":", 1)
             lt = rpc.EventLoopThread("maint-watcher-io")
-            self._client = rpc.BlockingClient.connect(
-                lt, host, int(port), retries=10)
+            self._client = rpc.BlockingClient.connect_ha(
+                lt, self.controller_addr, retries=10)
         return self._client
 
     def _list_nodes(self) -> List[Dict[str, Any]]:

@@ -33,25 +33,97 @@ class ClusterNode:
 
 class Cluster:
     def __init__(self, *, heartbeat_timeout_s: float = 2.0,
-                 chaos_plan: Optional[List[Dict[str, Any]]] = None):
+                 chaos_plan: Optional[List[Dict[str, Any]]] = None,
+                 ha_standby: bool = False,
+                 lease_timeout_s: Optional[float] = None):
         """``chaos_plan`` arms the deterministic fault-injection layer
         (util/fault_injection.py) in EVERY process of this cluster —
         controller, nodelets, workers, and the connecting driver — via
         the env-propagated ``chaos_plan`` config flag.  ``shutdown()``
-        disarms and scrubs the env so later clusters boot clean."""
+        disarms and scrubs the env so later clusters boot clean.
+
+        ``ha_standby=True`` additionally boots a HOT-STANDBY controller
+        (core/ha.py): it replicates the leader's WAL into its own state
+        dir and promotes itself when the leader dies; every nodelet and
+        driver of this cluster gets the full controller address list, so
+        ``kill_leader()`` is survivable mid-workload."""
         self._chaos_armed = chaos_plan is not None
         if chaos_plan is not None:
             from .core.config import GlobalConfig
             GlobalConfig.update({"chaos_plan": json.dumps(chaos_plan)})
         self.session_dir = node_mod.new_session_dir()
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.lease_timeout_s = lease_timeout_s
         self.controller_proc, self.controller_addr = node_mod.start_controller(
-            self.session_dir, heartbeat_timeout_s)
+            self.session_dir, heartbeat_timeout_s,
+            lease_timeout_s=lease_timeout_s)
+        self.standby_proc = None
+        self.standby_addr: Optional[str] = None
+        if ha_standby:
+            self.add_standby()
         self.nodes: List[ClusterNode] = []
+
+    # ------------------------------------------------------------ control HA
+    @property
+    def controller_addrs(self) -> str:
+        """Full controller address list (leader first, then standby) —
+        what nodelets and drivers dial; they probe for the leader."""
+        return ",".join(a for a in (self.controller_addr, self.standby_addr)
+                        if a)
+
+    def add_standby(self) -> str:
+        """Boot a hot-standby controller replicating the leader's WAL
+        (its own state dir — on a real pod this is a different host)."""
+        self.standby_proc, self.standby_addr = node_mod.start_controller(
+            self.session_dir, self.heartbeat_timeout_s,
+            standby_of=self.controller_addr,
+            state_dir="controller_standby_state",
+            lease_timeout_s=self.lease_timeout_s)
+        return self.standby_addr
+
+    def controller_status(self) -> List[Dict[str, Any]]:
+        """``ha_status`` of every controller process (role / epoch /
+        replication lag), unreachable ones marked as such."""
+        from .core import rpc as rpc_mod
+        out = []
+        lt = rpc_mod.EventLoopThread("ctl-status")
+        try:
+            for addr in (self.controller_addr, self.standby_addr):
+                if not addr:
+                    continue
+                try:
+                    host, port = addr.rsplit(":", 1)
+                    conn = lt.run(rpc_mod.connect(host, int(port),
+                                                  retries=1))
+                    try:
+                        st = lt.run(conn.call("ha_status", {}, timeout=5))
+                    finally:
+                        lt.run(conn.close())
+                    out.append({"addr": addr, **(st or {})})
+                except Exception as e:
+                    out.append({"addr": addr, "role": "unreachable",
+                                "error": str(e)})
+        finally:
+            lt.stop()
+        return out
 
     def kill_controller(self):
         """Hard-kill the control plane (fault injection for controller FT)."""
         self.controller_proc.kill(sig_term_first=False)
+
+    def kill_leader(self):
+        """Hard-kill whichever controller currently LEADS (after a prior
+        failover that may be the standby process)."""
+        for st in self.controller_status():
+            if st.get("role") == "leader":
+                if st["addr"] == self.standby_addr:
+                    self.standby_proc.kill(sig_term_first=False)
+                else:
+                    self.controller_proc.kill(sig_term_first=False)
+                return st["addr"]
+        # nobody claims leadership (mid-failover): kill the original
+        self.controller_proc.kill(sig_term_first=False)
+        return self.controller_addr
 
     def restart_controller(self):
         """Restart the controller at the SAME address; it restores its
@@ -70,7 +142,7 @@ class Cluster:
         if num_tpus:
             res["TPU"] = float(num_tpus)
         handle, addr, node_id, store_path = node_mod.start_nodelet(
-            self.session_dir, self.controller_addr, res, object_store_memory,
+            self.session_dir, self.controller_addrs, res, object_store_memory,
             env=env)
         cn = ClusterNode(handle, addr, node_id, store_path)
         self.nodes.append(cn)
@@ -81,7 +153,7 @@ class Cluster:
         first node)."""
         target = node or self.nodes[0]
         os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
-        return api.init(address=self.controller_addr,
+        return api.init(address=self.controller_addrs,
                         nodelet_addr=target.address)
 
     def shutdown(self):
@@ -106,3 +178,8 @@ class Cluster:
             self.controller_proc.kill()
         except Exception:
             pass
+        if self.standby_proc is not None:
+            try:
+                self.standby_proc.kill()
+            except Exception:
+                pass

@@ -225,6 +225,34 @@ _d("maintenance_poll_interval_s", float, 10.0,
    "Period of the autoscaler's maintenance-notice watcher "
    "(tpu_pod_provider.MaintenanceWatcher) between notice polls.")
 
+# --- controller high availability (core/ha.py) ------------------------------
+_d("ha_lease_timeout_s", float, 2.0,
+   "A hot-standby controller promotes itself once it has heard nothing "
+   "from the leader (lease renewals, replication traffic) for this "
+   "long.  The client-visible control-plane outage on leader death is "
+   "roughly this plus one reconnect round.")
+_d("ha_lease_interval_s", float, 0.5,
+   "Leader -> standby lease renewal period (piggybacks on replication "
+   "traffic when there is any).")
+_d("ha_repl_mode", str, "sync",
+   "'sync': a controller mutation is acked to its caller only once the "
+   "standby has durably appended it (sync_floor); degrades to bounded-"
+   "lag async when the standby stalls past ha_sync_timeout_s.  "
+   "'async': never gate replies on replication.")
+_d("ha_sync_timeout_s", float, 1.0,
+   "How long a sync-mode mutation reply waits for the standby's "
+   "replication ack before the leader degrades to async mode (leader "
+   "writes must never stall behind a sick standby).")
+_d("ha_max_lag_records", int, 4096,
+   "Replication records buffered for a lagging standby; past this the "
+   "leader drops the incremental stream and resyncs the standby with a "
+   "full snapshot.")
+_d("ha_client_failover_timeout_s", float, 30.0,
+   "Controller clients (drivers, serve routers, train executors) retry "
+   "a failed controller call against the standby address list for up "
+   "to this long before surfacing the error — in-flight ops replay "
+   "transparently against the promoted leader inside this budget.")
+
 # --- TPU / accelerator ------------------------------------------------------
 _d("tpu_autodetect", bool, True, "Detect local TPU chips via JAX at node start.")
 _d("tpu_detect_timeout_s", float, 30.0,

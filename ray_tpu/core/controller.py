@@ -96,8 +96,16 @@ class NodeRecord:
 class Controller:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  heartbeat_timeout_s: float = 5.0,
-                 persist_dir: Optional[str] = None):
+                 persist_dir: Optional[str] = None,
+                 standby_of: Optional[str] = None,
+                 lease_timeout_s: Optional[float] = None):
         self.server = rpc.RpcServer(host, port)
+        # HA role (core/ha.py): leader unless booted with standby_of, in
+        # which case this controller replicates the leader's WAL and
+        # promotes itself when the leader's lease lapses
+        from .ha import HAManager
+        self.ha = HAManager(self, standby_of=standby_of,
+                            lease_timeout_s=lease_timeout_s)
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.nodes: Dict[str, NodeRecord] = {}
         self.actors: Dict[bytes, ActorRecord] = {}
@@ -143,8 +151,13 @@ class Controller:
         if persist_dir:
             from .persistence import ControllerStore
             self.pstore = ControllerStore(persist_dir)
-            self.pstore._snapshot_provider = self._tables_snapshot
-            self._restore(self.pstore.load())
+            self.pstore._snapshot_provider = self._persist_tables_source
+            self.pstore.tap = self.ha.offer
+            if standby_of is None:
+                self._restore(self.pstore.load())
+            # a standby leaves its local state to ha._standby_loop: it
+            # adopts the leader's snapshot (or, if the leader never
+            # appears, promotes from the on-disk tables)
         # chaos layer: `once` fault rules are claimed here (exactly one
         # firing cluster-wide); arm from env config, then let a plan
         # persisted in the KV (applied pre-restart) override it
@@ -189,7 +202,15 @@ class Controller:
                     for pg in self.pgs.values()},
             "jobs": {jid: info for jid, info in self.jobs.items()},
             "draining_nodes": list(self.draining),
+            "ha_epoch": self.ha.epoch,
         }
+
+    def _persist_tables_source(self) -> dict:
+        """WAL-compaction source: the live tables when leading, the
+        replicated tables while standing by."""
+        if self.ha.is_leader or self.ha.tables is None:
+            return self._tables_snapshot()
+        return self.ha.tables
 
     def _restore(self, state: Optional[dict]) -> None:
         """Repopulate tables after a controller restart.  Live nodelets
@@ -197,6 +218,8 @@ class Controller:
         keep their addresses (their worker processes survived us)."""
         if not state:
             return
+        self.ha.epoch = max(self.ha.epoch,
+                            int(state.get("ha_epoch", 0) or 0))
         self.kv = {ns: dict(d) for ns, d in state.get("kv", {}).items()}
         for d in state.get("actors", {}).values():
             rec = ActorRecord(d["actor_id"], d["spec"], d.get("name"),
@@ -242,8 +265,33 @@ class Controller:
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
                      "drain_node", "ping", "metrics_text",
-                     "chaos_plan", "chaos_claim"):
-            s.register(name, getattr(self, "_h_" + name))
+                     "chaos_plan", "chaos_claim",
+                     "ha_status", "ha_register_standby", "ha_replicate",
+                     "ha_sync_snapshot", "ha_lease", "ha_fence"):
+            s.register(name, self._ha_gate(name, getattr(self, "_h_" + name)))
+
+    def _ha_gate(self, name: str, fn):
+        """Wrap one RPC handler with the HA protocol: epoch fencing (a
+        caller that has seen a newer epoch deposes us), leadership
+        rejection (standby/fenced controllers serve only the HA_EXEMPT
+        set), and the sync_floor replication gate (a mutating reply is
+        held until the standby durably has its WAL records)."""
+        from .ha import HA_EXEMPT
+
+        async def gated(conn, data, _name=name, _fn=fn):
+            ha = self.ha
+            await ha.maybe_fence_from(data)
+            if _name not in HA_EXEMPT and not ha.is_leader:
+                return {"_not_leader": True, "leader": ha.leader_addr,
+                        "epoch": ha.epoch}
+            if _name in HA_EXEMPT or not ha.sync_gate_active():
+                return await _fn(conn, data)
+            seq0 = self.pstore.seq
+            result = await _fn(conn, data)
+            if self.pstore.seq > seq0:
+                await ha.wait_replicated(self.pstore.seq)
+            return result
+        return gated
 
     # ------------------------------------------------------------- chaos
     async def _h_chaos_plan(self, conn, data):
@@ -293,8 +341,79 @@ class Controller:
         rtm.snapshot_controller(self)
         return metrics.prometheus_text()
 
+    # ------------------------------------------------------ high availability
+    async def _h_ha_status(self, conn, data):
+        """Role / epoch / replication-lag probe — served by every role
+        (clients use it to find the leader among the address list)."""
+        return self.ha.status()
+
+    async def _h_ha_register_standby(self, conn, data):
+        """A hot standby joins (leader only — the gate rejects this on a
+        non-leader, which redirects the standby to the real leader)."""
+        if self.pstore is None:
+            return {"error": "leader has no persist dir: HA replication "
+                             "needs a WAL to stream"}
+        peer_epoch = int(data.get("epoch", 0))
+        if peer_epoch > self.ha.epoch:
+            # a standby that has durably seen a newer epoch must not
+            # join us — we are the stale side of a partition
+            await self.ha.fence(peer_epoch, "standby joined with a "
+                                            "newer epoch")
+            return {"_not_leader": True, "leader": self.ha.leader_addr,
+                    "epoch": self.ha.epoch}
+        return self.ha.add_standby(data["addr"], conn)
+
+    async def _h_ha_replicate(self, conn, data):
+        """Standby side: apply + durably append one batch of the
+        leader's WAL records; the reply is the leader's sync_floor ack."""
+        ha = self.ha
+        if ha.is_leader:
+            return {"stale": True, "epoch": ha.epoch,
+                    "leader": self.address}
+        if int(data.get("epoch", 0)) < ha.epoch:
+            return {"stale": True, "epoch": ha.epoch,
+                    "leader": ha.leader_addr}
+        if ha.tables is None or int(data["from_seq"]) != ha.applied_seq + 1:
+            return {"resync": True}
+        from . import persistence
+        for blob in data["records"]:
+            rec = persistence._unpack(blob)
+            persistence._apply(ha.tables, rec)
+            if self.pstore is not None:
+                self.pstore.append_replica(rec)
+        ha.applied_seq = int(data["to_seq"])
+        ha.last_lease = time.monotonic()
+        return {"ok": True, "seq": ha.applied_seq}
+
+    async def _h_ha_sync_snapshot(self, conn, data):
+        """Standby side: full-state resync after the incremental stream
+        broke (lag bound blown, dropped records, fresh registration)."""
+        ha = self.ha
+        if ha.is_leader:
+            return {"stale": True, "epoch": ha.epoch,
+                    "leader": self.address}
+        if int(data.get("epoch", 0)) < ha.epoch:
+            return {"stale": True, "epoch": ha.epoch,
+                    "leader": ha.leader_addr}
+        ha.adopt_snapshot(data)
+        return {"ok": True, "seq": ha.applied_seq}
+
+    async def _h_ha_lease(self, conn, data):
+        if not self.ha.is_leader \
+                and int(data.get("epoch", 0)) >= self.ha.epoch:
+            self.ha.last_lease = time.monotonic()
+        return True
+
+    async def _h_ha_fence(self, conn, data):
+        """A promoted leader fences its predecessor explicitly (the
+        passive path — epoch stamps on client RPCs — also works)."""
+        await self.ha.fence(int(data["epoch"]), "fenced by promoted leader",
+                            data.get("leader"))
+        return True
+
     async def start(self):
         await self.server.start()
+        await self.ha.start()
         self._tasks.append(asyncio.ensure_future(self._health_check_loop()))
         self._tasks.append(asyncio.ensure_future(self._actor_scheduler_loop()))
         from ..util import tracing
@@ -315,6 +434,7 @@ class Controller:
                     tracing.kv_key()] = payload
 
     async def stop(self):
+        await self.ha.stop()
         for t in self._tasks:
             t.cancel()
         await self.server.stop()
@@ -767,7 +887,20 @@ class Controller:
             await self._try_schedule_actor(actor)
         finally:
             actor.scheduling = False
-            self._pending_actor_wakeup.set()
+            # A PROGRESS pass (the actor got a node, or left the pending
+            # states) re-wakes the scheduler immediately — peers waiting
+            # on it (gangs, PG bundles) proceed at once.  A NO-PROGRESS
+            # pass re-wakes on a short timer instead: waking
+            # unconditionally made one unschedulable actor spin the loop
+            # at 100% CPU (every pass re-queued it, which re-woke the
+            # pass) — a promoted standby hit this hard, with every
+            # restored actor pending until the nodelets re-register.
+            if actor.node_id is not None \
+                    or actor.state not in (PENDING_CREATION, RESTARTING):
+                self._pending_actor_wakeup.set()
+            else:
+                asyncio.get_event_loop().call_later(
+                    0.05, self._pending_actor_wakeup.set)
 
     async def _try_schedule_actor(self, actor: ActorRecord):
         spec = TaskSpec(actor.spec)
@@ -1347,7 +1480,10 @@ class Controller:
 
 
 async def run_controller(host: str, port: int, heartbeat_timeout_s: float = 5.0,
-                         persist_dir: Optional[str] = None):
-    c = Controller(host, port, heartbeat_timeout_s, persist_dir=persist_dir)
+                         persist_dir: Optional[str] = None,
+                         standby_of: Optional[str] = None,
+                         lease_timeout_s: Optional[float] = None):
+    c = Controller(host, port, heartbeat_timeout_s, persist_dir=persist_dir,
+                   standby_of=standby_of, lease_timeout_s=lease_timeout_s)
     await c.start()
     return c

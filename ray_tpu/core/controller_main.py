@@ -16,6 +16,12 @@ def main():
     p.add_argument("--heartbeat-timeout", type=float, default=5.0)
     p.add_argument("--persist-dir", default=None,
                    help="snapshot+WAL dir for controller fault tolerance")
+    p.add_argument("--standby-of", default=None,
+                   help="boot as a hot standby of the leader at this "
+                        "address: replicate its WAL and promote when its "
+                        "lease lapses (core/ha.py)")
+    p.add_argument("--lease-timeout", type=float, default=None,
+                   help="override ha_lease_timeout_s for this controller")
     args = p.parse_args()
 
     # `ray stack` facility: SIGUSR1 dumps every thread's Python stack to
@@ -30,7 +36,9 @@ def main():
 
     async def run():
         c = Controller(args.host, args.port, args.heartbeat_timeout,
-                       persist_dir=args.persist_dir)
+                       persist_dir=args.persist_dir,
+                       standby_of=args.standby_of,
+                       lease_timeout_s=args.lease_timeout)
         await c.start()
         print(f"CONTROLLER_READY {c.address}", flush=True)
         await asyncio.Event().wait()

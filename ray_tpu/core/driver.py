@@ -211,11 +211,15 @@ class CoreClient(DeferredRefDecs):
         self._node_listeners: list = []
         self._node_sub_lock = threading.Lock()
         self._node_subscribed = False
-        self.controller = rpc.BlockingClient.connect(
-            self.lt, *_split(controller_addr),
+        # HA-aware: the address may be a comma list (leader + hot
+        # standbys); the client follows leadership and replays failed
+        # calls against a promoted standby (core/ha.py)
+        self.controller = rpc.BlockingClient.connect_ha(
+            self.lt, controller_addr,
             handlers={"pub:logs": self._on_log,
                       "pub:nodes": self._on_nodes_pub},
             retries=GlobalConfig.rpc_connect_retries)
+        self.controller.on_reconnect = self._on_controller_reconnect
         self.nodelet = rpc.BlockingClient.connect(
             self.lt, *_split(nodelet_addr),
             retries=GlobalConfig.rpc_connect_retries)
@@ -1336,9 +1340,33 @@ class CoreClient(DeferredRefDecs):
             self._fail_task(spec, f"actor submission failed: {e!r}")
 
     async def _wait_actor_info(self, actor_id: bytes, timeout: float = 60.0):
-        return await self.controller.conn.call(
-            "wait_actor", {"actor_id": actor_id, "timeout": timeout},
-            timeout=timeout + 10)
+        """Actor-state poll that SURVIVES a controller failover: the
+        raw connection dies with the leader mid-wait, so replay against
+        the promoted standby instead of failing the actor submission
+        (found as elastic repair's replacement rank dying with 'actor
+        submission failed: ConnectionLost' when the leader was killed
+        mid-repair)."""
+        deadline = time.monotonic() + timeout \
+            + GlobalConfig.ha_client_failover_timeout_s
+        while True:
+            try:
+                conn = await self.controller.aconn()
+                r = await conn.call(
+                    "wait_actor",
+                    {"actor_id": actor_id, "timeout": timeout},
+                    timeout=timeout + 10)
+            except (rpc.ConnectionLost, OSError, asyncio.TimeoutError):
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.2)
+                continue
+            if isinstance(r, dict) and r.get("_not_leader"):
+                if time.monotonic() > deadline:
+                    raise rpc.RpcError(
+                        "no leader controller emerged for wait_actor")
+                await asyncio.sleep(0.2)
+                continue
+            return r
 
     async def _get_actor_conn(self, state: _ActorState):
         if state.conn is not None and not state.conn.closed:
@@ -1411,6 +1439,19 @@ class CoreClient(DeferredRefDecs):
             except Exception:
                 pass
 
+    def _on_controller_reconnect(self, bc):
+        """The controller connection failed over (leader death → promoted
+        standby): connection-scoped state must be re-established — the
+        ``nodes`` pubsub subscription serve routers and train executors
+        rely on lives on the dead TCP connection."""
+        if not self._node_subscribed:
+            return
+        try:
+            self.lt.spawn(bc.conn.call("subscribe", {"channel": "nodes"},
+                                       timeout=10))
+        except Exception:
+            pass  # degraded: listeners fall back to table polling
+
     def subscribe_node_events(self, callback) -> None:
         """Register ``callback(event_dict)`` for controller ``nodes``
         pubsub events ({"event": "added"|"dead"|"draining", ...}).  The
@@ -1450,6 +1491,12 @@ class CoreClient(DeferredRefDecs):
             except Exception:
                 pass
         self._value_finalizers.clear()
+        # shutdown must not burn the HA failover budget redialing a
+        # cluster that is being torn down
+        try:
+            self.controller.fail_fast()
+        except Exception:
+            pass
         if self.mode == "driver":
             try:
                 self.controller.call("finish_job",

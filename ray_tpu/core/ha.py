@@ -1,0 +1,498 @@
+"""Controller high availability: hot standby, WAL streaming, leader leases.
+
+The control plane was the last single point of failure: ``persistence.py``
+snapshots + WALs the controller's tables to LOCAL disk, so recovery only
+worked if the controller restarted on the same host.  This module keeps the
+no-external-store design rule and adds a **hot-standby controller** on a
+peer host (reference: the Ray paper's fault-tolerant GCS, arXiv:1712.05889
+§4.2 — there backed by replicated Redis; here by our own WAL stream):
+
+* **WAL streaming replication** — the leader's ``ControllerStore.tap``
+  feeds every locally durable mutation record into a replicator that
+  streams it to the standby, which appends it to its OWN WAL.  In sync
+  mode a mutation is acked to its caller only once the standby has it
+  (``sync_floor``); if the standby stalls past ``ha_sync_timeout_s`` the
+  leader degrades to bounded-lag async mode instead of stalling writes,
+  and resyncs via a full snapshot when the lag bound is blown.
+* **Lease + monotonic epoch** — the leader renews a lease over the
+  replication connection; when the standby has heard nothing for
+  ``ha_lease_timeout_s`` it promotes itself: epoch+1 (persisted in its
+  WAL — and, once the old leader is reachable again, fenced into his),
+  then rebuilds the full controller state through the same
+  ``Controller._restore`` path a local restart uses.
+* **Epoch fencing** — every controller RPC may carry the caller's known
+  ``_ha_epoch``; a controller that sees a newer epoch fences itself
+  (stops accepting writes), so a deposed leader can never corrupt the
+  actor/PG/KV tables even under a full split-brain partition.
+
+Chaos sites: ``controller.wal_replicate`` (drop/delay the replication
+stream — exercises the lag bound and the async fallback) and
+``controller.lease_renew`` (blackhole renewals — forces a failover under
+a live TCP connection).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import persistence, rpc, runtime_metrics as rtm
+from .config import GlobalConfig
+
+#: methods served regardless of role (standby/fenced controllers must
+#: answer the HA protocol itself, liveness probes, and metric scrapes)
+HA_EXEMPT = frozenset({
+    "ping", "ha_status", "ha_replicate", "ha_sync_snapshot",
+    "ha_lease", "ha_fence", "metrics_text",
+})
+
+_REPL_BATCH = 256
+
+
+class HAManager:
+    """Per-controller HA state machine (leader and standby sides)."""
+
+    def __init__(self, controller, standby_of: Optional[str] = None,
+                 lease_timeout_s: Optional[float] = None):
+        self.c = controller
+        self.standby_of = standby_of
+        self.is_leader = standby_of is None
+        self.fenced = False
+        self.epoch = 0
+        self.leader_addr: Optional[str] = standby_of
+        self.lease_timeout = float(lease_timeout_s
+                                   or GlobalConfig.ha_lease_timeout_s)
+        self.lease_interval = GlobalConfig.ha_lease_interval_s
+        self.sync_mode = GlobalConfig.ha_repl_mode == "sync"
+        self.degraded = False          # sync → async fallback engaged
+        # -- leader side -----------------------------------------------------
+        self.standby: Optional[Dict[str, Any]] = None   # {addr, conn}
+        self.acked = 0                 # highest seq the standby has durably
+        self._pending: deque = deque()  # (seq, packed record)
+        self._need_snapshot = False
+        self._wake = asyncio.Event()
+        self._ack_waiters: List[tuple] = []   # (target_seq, Event)
+        self._last_renewal = time.monotonic()
+        # -- standby side ----------------------------------------------------
+        self.tables: Optional[dict] = None
+        self.applied_seq = 0
+        self.last_lease = time.monotonic()
+        self._tasks: List[asyncio.Task] = []
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self):
+        self._tasks.append(asyncio.ensure_future(self._sender_loop()))
+        self._tasks.append(asyncio.ensure_future(self._lease_loop()))
+        if self.standby_of is not None:
+            self._tasks.append(asyncio.ensure_future(self._standby_loop()))
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+
+    # --------------------------------------------------------------- fencing
+    async def maybe_fence_from(self, data: Any) -> None:
+        """Epoch sniff on every inbound RPC: a caller that has durably
+        seen a newer epoch proves this controller is deposed."""
+        if type(data) is dict:
+            pe = data.get("_ha_epoch")
+            if pe is not None and pe > self.epoch:
+                await self.fence(pe, "observed newer epoch on an RPC")
+
+    async def fence(self, new_epoch: int, reason: str,
+                    leader_addr: Optional[str] = None) -> None:
+        if new_epoch <= self.epoch:
+            if leader_addr and not self.is_leader:
+                self.leader_addr = leader_addr
+            return
+        was_leader = self.is_leader
+        self.epoch = int(new_epoch)
+        if leader_addr:
+            self.leader_addr = leader_addr
+        if not was_leader:
+            return
+        self.is_leader = False
+        self.fenced = True
+        if self.c.pstore is not None:
+            # durably renounce: a restart of this process must never
+            # serve below the epoch that deposed it
+            self.c.pstore.append("epoch", self.epoch)
+        rtm.CONTROLLER_FAILOVERS.inc(tags={"outcome": "fenced"})
+        self.c._emit_event(
+            "ERROR", "controller",
+            f"leader fenced at epoch {self.epoch}: {reason} — "
+            f"writes are rejected from now on")
+        from ..util import tracing
+        now = time.time()
+        tracing.record_span(f"controller_failover::fence-e{self.epoch}",
+                            "controller_failover", now, now,
+                            outcome="fenced", reason=reason)
+
+    # ---------------------------------------------------------- leader: repl
+    def offer(self, record: List[Any]) -> None:
+        """ControllerStore tap: one locally durable record enters the
+        replication stream.  Synchronous (called under append)."""
+        if self.standby is None:
+            return
+        self._pending.append((self.c.pstore.seq, persistence._pack(record)))
+        if len(self._pending) > GlobalConfig.ha_max_lag_records:
+            # lag bound blown: drop the incremental stream, full resync
+            self._pending.clear()
+            self._need_snapshot = True
+        self._wake.set()
+
+    def lag(self) -> int:
+        """Replication lag in records (0 when no standby is attached)."""
+        if self.standby is None or self.c.pstore is None:
+            return 0
+        return max(0, self.c.pstore.seq - self.acked)
+
+    def sync_gate_active(self) -> bool:
+        return (self.is_leader and self.sync_mode and not self.degraded
+                and self.standby is not None and self.c.pstore is not None)
+
+    async def wait_replicated(self, target_seq: int) -> None:
+        """sync_floor: hold a mutation's reply until the standby acked
+        its record — or degrade to async when the standby stalls."""
+        if self.acked >= target_seq or not self.sync_gate_active():
+            return
+        ev = asyncio.Event()
+        self._ack_waiters.append((target_seq, ev))
+        self._wake.set()
+        try:
+            await asyncio.wait_for(ev.wait(), GlobalConfig.ha_sync_timeout_s)
+        except asyncio.TimeoutError:
+            if not self.degraded:
+                self.degraded = True
+                self.c._emit_event(
+                    "WARNING", "controller",
+                    f"WAL replication stalled ({self.lag()} records "
+                    f"behind): degrading to bounded-lag async mode — "
+                    f"leader writes no longer wait for the standby")
+
+    def _wake_ack_waiters(self) -> None:
+        rest = []
+        for target, ev in self._ack_waiters:
+            if self.acked >= target:
+                ev.set()
+            else:
+                rest.append((target, ev))
+        self._ack_waiters = rest
+
+    def add_standby(self, addr: str, conn: rpc.Connection) -> dict:
+        """A standby registered (leader side): hand it a full snapshot
+        and start streaming from the current seq."""
+        self.standby = {"addr": addr, "conn": conn}
+        self._pending.clear()
+        self._need_snapshot = False
+        seq = self.c.pstore.seq if self.c.pstore is not None else 0
+        self.acked = seq
+        self.degraded = False
+        prev = conn.on_close
+
+        def _closed(c, prev=prev):
+            if prev:
+                prev(c)
+            if self.standby is not None and self.standby["conn"] is c:
+                self.standby = None
+                self._pending.clear()
+                for _t, ev in self._ack_waiters:
+                    ev.set()
+                self._ack_waiters = []
+                self.c._emit_event("WARNING", "controller",
+                                   f"standby {addr} disconnected — "
+                                   f"running without a hot standby")
+        conn.on_close = _closed
+        self.c._emit_event("INFO", "controller",
+                           f"standby controller registered at {addr} "
+                           f"(epoch {self.epoch}, seq {seq})")
+        return {
+            "tables_blob": persistence._pack(self.c._tables_snapshot()),
+            "seq": seq, "epoch": self.epoch,
+            "lease_timeout": self.lease_timeout,
+            "lease_interval": self.lease_interval,
+        }
+
+    def standby_addrs(self) -> List[str]:
+        return [self.standby["addr"]] if self.standby is not None else []
+
+    async def _sender_loop(self):
+        """Leader: push pending WAL records (or a full snapshot after a
+        lag blowout) to the standby, advancing the ack floor."""
+        from ..util import fault_injection as fi
+        while True:
+            await self._wake.wait()
+            self._wake.clear()
+            while self.standby is not None and self.is_leader:
+                conn = self.standby["conn"]
+                if conn.closed:
+                    break
+                if self._need_snapshot:
+                    if not await self._send_snapshot(conn):
+                        break
+                    continue
+                if not self._pending:
+                    if self.lag() > 0:
+                        # silent loss (dropped batches): nothing left to
+                        # stream but the standby is behind — full resync
+                        self._need_snapshot = True
+                        continue
+                    break
+                n = min(len(self._pending), _REPL_BATCH)
+                batch = [self._pending[i] for i in range(n)]
+                if fi.ACTIVE is not None:
+                    act = await fi.ACTIVE.async_point(
+                        "controller.wal_replicate", str(batch[0][0]))
+                    if act is not None and act["action"] == "drop":
+                        # stream loss: the records never reach the
+                        # standby — lag grows until the seq gap forces a
+                        # snapshot resync
+                        for _ in range(n):
+                            self._pending.popleft()
+                        continue
+                try:
+                    r = await conn.call("ha_replicate", {
+                        "epoch": self.epoch,
+                        "from_seq": batch[0][0], "to_seq": batch[-1][0],
+                        "records": [b for _s, b in batch],
+                    }, timeout=GlobalConfig.ha_sync_timeout_s + 5.0)
+                except (rpc.RpcError, OSError):
+                    break   # conn sick: retried on the next wake/renewal
+                if not isinstance(r, dict):
+                    break
+                if r.get("stale"):
+                    await self.fence(int(r.get("epoch", self.epoch + 1)),
+                                     "standby reports a newer epoch",
+                                     r.get("leader"))
+                    break
+                if r.get("resync"):
+                    self._need_snapshot = True
+                    continue
+                if r.get("ok"):
+                    for _ in range(n):
+                        self._pending.popleft()
+                    self.acked = max(self.acked, int(r["seq"]))
+                    self._wake_ack_waiters()
+                    if self.degraded and self.lag() == 0:
+                        self.degraded = False
+                        self.c._emit_event(
+                            "INFO", "controller",
+                            "standby caught up: sync replication "
+                            "restored")
+                else:
+                    break
+
+    async def _send_snapshot(self, conn: rpc.Connection) -> bool:
+        from ..util import fault_injection as fi
+        if fi.ACTIVE is not None:
+            act = await fi.ACTIVE.async_point("controller.wal_replicate",
+                                              "snapshot")
+            if act is not None and act["action"] == "drop":
+                return False   # resync lost on the wire too
+        seq = self.c.pstore.seq if self.c.pstore is not None else 0
+        try:
+            r = await conn.call("ha_sync_snapshot", {
+                "epoch": self.epoch, "seq": seq,
+                "tables_blob": persistence._pack(self.c._tables_snapshot()),
+            }, timeout=GlobalConfig.ha_sync_timeout_s + 10.0)
+        except (rpc.RpcError, OSError):
+            return False
+        if not isinstance(r, dict) or not r.get("ok"):
+            if isinstance(r, dict) and r.get("stale"):
+                await self.fence(int(r.get("epoch", self.epoch + 1)),
+                                 "standby reports a newer epoch",
+                                 r.get("leader"))
+            return False
+        self._need_snapshot = False
+        # the snapshot covers every record appended up to `seq`; drop the
+        # now-redundant prefix of the pending stream
+        while self._pending and self._pending[0][0] <= seq:
+            self._pending.popleft()
+        self.acked = max(self.acked, seq)
+        self._wake_ack_waiters()
+        if self.degraded and self.lag() == 0:
+            self.degraded = False
+            self.c._emit_event("INFO", "controller",
+                               "standby resynced via snapshot: sync "
+                               "replication restored")
+        return True
+
+    async def _lease_loop(self):
+        """Leader: renew the standby's lease; also re-kicks a sender that
+        broke off a failed push."""
+        from ..util import fault_injection as fi
+        while True:
+            await asyncio.sleep(self.lease_interval)
+            if not self.is_leader or self.standby is None:
+                continue
+            conn = self.standby["conn"]
+            if conn.closed:
+                continue
+            if self._pending or self._need_snapshot or self.lag() > 0:
+                self._wake.set()
+            if fi.ACTIVE is not None and fi.ACTIVE.point(
+                    "controller.lease_renew", self.standby["addr"]):
+                continue    # blackholed renewal: the standby ages out
+            try:
+                await conn.notify("ha_lease", {
+                    "epoch": self.epoch,
+                    "seq": self.c.pstore.seq if self.c.pstore else 0})
+                self._last_renewal = time.monotonic()
+            except (rpc.RpcError, OSError):
+                pass
+
+    # --------------------------------------------------------------- standby
+    def adopt_snapshot(self, data: dict) -> None:
+        self.tables = persistence._unpack(data["tables_blob"])
+        self.applied_seq = int(data.get("seq", 0))
+        self.epoch = max(self.epoch, int(data.get("epoch", 0)))
+        if data.get("lease_timeout"):
+            self.lease_timeout = float(data["lease_timeout"])
+        if self.c.pstore is not None:
+            self.c.pstore.snapshot(self.tables)
+        self.last_lease = time.monotonic()
+
+    def _lease_lapsed(self) -> bool:
+        return (self.tables is not None
+                and time.monotonic() - self.last_lease > self.lease_timeout)
+
+    async def _standby_loop(self):
+        """Standby: stay registered with the leader; promote when its
+        lease lapses.  ``nodes``-channel liveness rides the same wire —
+        replication traffic and renewals both refresh the lease."""
+        from ..util.backoff import ExponentialBackoff
+        bo = ExponentialBackoff(base=0.05, cap=0.5)
+        # A standby restarted with local state may promote from disk if
+        # the leader never shows up (both hosts lost, standby's returns).
+        if self.c.pstore is not None and self.tables is None:
+            state = None
+            try:
+                state = self.c.pstore.load()
+            except Exception:
+                pass
+            if state:
+                self.tables = state
+                self.applied_seq = 0
+                self.epoch = max(self.epoch,
+                                 int(state.get("ha_epoch", 0) or 0))
+        self.last_lease = time.monotonic()
+        while not self.is_leader:
+            try:
+                host, port = self.standby_of.rsplit(":", 1)
+                conn = await rpc.connect(
+                    host, int(port),
+                    handlers=dict(self.c.server.handlers), retries=2)
+            except (rpc.RpcError, OSError):
+                if self._lease_lapsed():
+                    await self._promote("leader unreachable")
+                    return
+                await asyncio.sleep(bo.next_delay())
+                continue
+            try:
+                r = await conn.call("ha_register_standby", {
+                    "addr": self.c.address, "epoch": self.epoch},
+                    timeout=10)
+            except (rpc.RpcError, OSError):
+                r = None
+            if not isinstance(r, dict) or "tables_blob" not in r:
+                await conn.close()
+                hint = (r or {}).get("leader") if isinstance(r, dict) \
+                    else None
+                if hint and hint != self.c.address:
+                    self.standby_of = hint   # joined a non-leader: follow
+                if self._lease_lapsed():
+                    await self._promote("leader not serving")
+                    return
+                await asyncio.sleep(bo.next_delay())
+                continue
+            self.adopt_snapshot(r)
+            self.leader_addr = self.standby_of
+            bo = ExponentialBackoff(base=0.05, cap=0.5)
+            check = max(0.05, min(self.lease_interval,
+                                  self.lease_timeout / 4))
+            while not conn.closed and not self.is_leader:
+                await asyncio.sleep(check)
+                if self._lease_lapsed():
+                    await conn.close()
+                    await self._promote("lease lapsed")
+                    return
+            if self.is_leader:
+                return
+            # connection dropped: redial; a lapse during redials promotes
+
+    async def _promote(self, reason: str) -> None:
+        """Standby → leader: epoch+1 (persisted), rebuild the live
+        controller state from the replicated tables — the exact path a
+        same-host restart takes — and fence the old leader if reachable."""
+        from ..util import tracing
+        t_last_contact = self.last_lease
+        old_leader = self.leader_addr
+        t0 = time.time()
+        tables = self.tables or persistence._empty_tables()
+        self.epoch = max(self.epoch,
+                         int(tables.get("ha_epoch", 0) or 0)) + 1
+        tables["ha_epoch"] = self.epoch
+        self.is_leader = True
+        self.fenced = False
+        self.leader_addr = self.c.address
+        self.c._restore(tables)
+        if self.c.pstore is not None:
+            self.c.pstore.append("epoch", self.epoch)
+        outage = time.monotonic() - t_last_contact
+        rtm.CONTROLLER_FAILOVERS.inc(tags={"outcome": "promoted"})
+        rtm.CONTROLLER_FAILOVER_DURATION.observe(outage)
+        tracing.record_span(f"controller_failover::e{self.epoch}",
+                            "controller_failover", t0, time.time(),
+                            outcome="promoted", reason=reason,
+                            epoch=self.epoch, outage_s=round(outage, 3))
+        self.c._emit_event(
+            "WARNING", "controller",
+            f"standby promoted to leader at epoch {self.epoch} "
+            f"({reason}; {outage:.2f}s since last leader contact) — "
+            f"{len(tables.get('actors', {}))} actors, "
+            f"{len(tables.get('pgs', {}))} placement groups restored")
+        self.c._pending_actor_wakeup.set()
+        if old_leader and old_leader != self.c.address:
+            asyncio.ensure_future(
+                self._fence_old_leader(old_leader, self.epoch))
+
+    async def _fence_old_leader(self, addr: str, epoch: int) -> None:
+        try:
+            host, port = addr.rsplit(":", 1)
+            conn = await rpc.connect(host, int(port), retries=1)
+        except (rpc.RpcError, OSError):
+            return   # dead (the common case) — epoch stamps fence it later
+        try:
+            await conn.call("ha_fence", {"epoch": epoch,
+                                         "leader": self.c.address},
+                            timeout=3)
+        except (rpc.RpcError, OSError):
+            pass
+        finally:
+            await conn.close()
+
+    # ---------------------------------------------------------------- status
+    def status(self) -> dict:
+        role = ("leader" if self.is_leader
+                else "fenced" if self.fenced else "standby")
+        st = {
+            "role": role, "epoch": self.epoch, "addr": self.c.address,
+            "leader": (self.c.address if self.is_leader
+                       else self.leader_addr),
+            "standbys": self.standby_addrs(),
+        }
+        if self.is_leader:
+            st["repl"] = {
+                "mode": ("async" if not self.sync_mode or self.degraded
+                         else "sync"),
+                "degraded": self.degraded,
+                "seq": self.c.pstore.seq if self.c.pstore else 0,
+                "acked": self.acked, "lag": self.lag(),
+            }
+        else:
+            st["lease_age_s"] = round(
+                time.monotonic() - self.last_lease, 3)
+            st["applied_seq"] = self.applied_seq
+        return st

@@ -89,16 +89,29 @@ class ProcessHandle:
 
 
 def start_controller(session_dir: str, heartbeat_timeout_s: float = 5.0,
-                     port: int = 0, persist: bool = True) -> tuple:
+                     port: int = 0, persist: bool = True,
+                     standby_of: Optional[str] = None,
+                     state_dir: str = "controller_state",
+                     lease_timeout_s: Optional[float] = None) -> tuple:
     """Persistence is on by default: the controller snapshots/WALs its
     metadata tables under the session dir, so a restarted controller at
     the same address resumes with actors/PGs/KV/jobs intact (reference:
-    GCS restart-from-Redis, gcs_table_storage.h:357)."""
-    log = open(os.path.join(session_dir, "logs", "controller.err"), "ab")
+    GCS restart-from-Redis, gcs_table_storage.h:357).
+
+    ``standby_of``: boot as a HOT STANDBY of the leader at that address
+    (core/ha.py) — it replicates the leader's WAL into its own
+    ``state_dir`` (which must differ from the leader's) and promotes
+    itself when the leader's lease lapses."""
+    log_name = "controller_standby.err" if standby_of else "controller.err"
+    log = open(os.path.join(session_dir, "logs", log_name), "ab")
     cmd = [sys.executable, "-m", "ray_tpu.core.controller_main",
            "--port", str(port), "--heartbeat-timeout", str(heartbeat_timeout_s)]
     if persist:
-        cmd += ["--persist-dir", os.path.join(session_dir, "controller_state")]
+        cmd += ["--persist-dir", os.path.join(session_dir, state_dir)]
+    if standby_of:
+        cmd += ["--standby-of", standby_of]
+    if lease_timeout_s is not None:
+        cmd += ["--lease-timeout", str(lease_timeout_s)]
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=log, start_new_session=True,
         env=_child_env())

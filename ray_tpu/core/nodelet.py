@@ -230,26 +230,38 @@ class Nodelet:
         return self
 
     async def _connect_controller(self):
-        """Dial + register with the controller.  Also the RECONNECT path: a
-        restarted (persistence-restored) controller learns its live nodes
-        only from these re-registrations, so the heartbeat loop calls this
-        whenever the connection drops."""
-        host, port = self.controller_addr.rsplit(":", 1)
+        """Dial + register with the LEADER controller.  Also the
+        RECONNECT path: a restarted (persistence-restored) or freshly
+        promoted controller learns its live nodes only from these
+        re-registrations, so the heartbeat loop calls this whenever the
+        connection drops.  ``controller_addr`` may be an address LIST
+        (leader + hot standbys) — the probe follows leadership, so a
+        leader-host death fails this nodelet over to the promoted
+        standby transparently."""
         # The controller calls back over this same connection (actor starts,
         # PG 2PC, frees) — give it the full handler table plus pubsub.
         handlers = dict(self.server.handlers)
         handlers["pub:nodes"] = self._on_nodes_event
         handlers["pub:chaos"] = self._on_chaos_event
-        self.controller = await rpc.connect(
-            host, int(port), handlers=handlers,
+        self.controller, _ep, st = await rpc.connect_leader(
+            self.controller_addr, handlers=handlers,
             retries=GlobalConfig.rpc_connect_retries)
+        self._ctl_epoch = max(getattr(self, "_ctl_epoch", 0),
+                              int((st or {}).get("epoch", 0) or 0))
         reply = await self.controller.call("register_node", {
             "node_id": self.node_id.hex(),
             "addr": self.address,
             "resources": self.total.to_dict(),
             "labels": self.labels,
             "config": GlobalConfig.snapshot(),
+            "_ha_epoch": self._ctl_epoch,
         })
+        if isinstance(reply, dict) and reply.get("_not_leader"):
+            # lost a leadership race between probe and register: the
+            # heartbeat loop redials (and re-probes) on the next beat
+            await self.controller.close()
+            raise rpc.ConnectionLost("controller lost leadership during "
+                                     "registration")
         await self.controller.call("subscribe", {"channel": "nodes"})
         await self.controller.call("subscribe", {"channel": "chaos"})
         # Late joiners (and reconnects after a controller restart) pull
@@ -398,8 +410,23 @@ class Nodelet:
                     "view_version": self.view_version,
                     "demand":
                         list(self._demand_tokens.values())[:64],
+                    "_ha_epoch": getattr(self, "_ctl_epoch", 0),
                 }, timeout=5)
-                if reply and "view" in reply:
+                if reply and reply.get("_not_leader"):
+                    # beat landed on a deposed/standby controller: find
+                    # the current leader and re-register there
+                    self._ctl_epoch = max(
+                        getattr(self, "_ctl_epoch", 0),
+                        int(reply.get("epoch", 0) or 0))
+                    await self.controller.close()
+                    await self._connect_controller()
+                elif reply and reply.get("unknown_node"):
+                    # a freshly promoted leader answered before we
+                    # re-registered (race with its own restore):
+                    # re-register
+                    await self.controller.close()
+                    await self._connect_controller()
+                elif reply and "view" in reply:
                     self._apply_view(reply["view"], reply["view_version"])
                 elif reply and "delta" in reply:
                     self._apply_delta(reply["delta"], reply["view_version"])

@@ -9,21 +9,39 @@ nodelets re-register over their heartbeat loops.
 
 Design: no external store (the reference needs Redis; a TPU-pod control
 plane should not).  Tables are msgpack'd to a snapshot file; every mutation
-between snapshots appends one length-prefixed msgpack record to a WAL.
-Recovery = load snapshot, replay WAL.  The WAL is compacted into a fresh
-snapshot every ``compact_every`` appends.  Mutation rate on the controller
-is low (actors/PGs/KV, never tasks), so fsync-per-append is affordable.
+between snapshots appends one length-prefixed, CRC-guarded msgpack record
+to a WAL.  Recovery = load snapshot, replay WAL.  The WAL is compacted into
+a fresh snapshot every ``compact_every`` appends.  Mutation rate on the
+controller is low (actors/PGs/KV, never tasks), so fsync-per-append is
+affordable.
+
+WAL format v2: the file opens with an 8-byte magic, then records of
+``<u32 len><u32 crc32><payload>``.  A record whose CRC does not match is
+treated exactly like a torn tail — replay stops at the last valid prefix
+(a corrupt middle record must not unpack garbage into the tables).
+CRC-less v1 files (no magic, ``<u32 len><payload>`` records) stay
+readable; an existing v1 WAL keeps its format until the next compaction.
+
+Replication: the store carries a monotonic ``seq`` and an optional
+``tap`` callback fired after every locally durable append — the leader's
+HA replicator (core/ha.py) streams those records to a hot-standby
+controller on a peer host, which appends them to its OWN store via
+:meth:`append_replica` (the lease + epoch are thereby "persisted in both
+WALs").
 """
 
 from __future__ import annotations
 
 import os
 import struct
-from typing import Any, Dict, List, Optional
+import zlib
+from typing import Any, Callable, Dict, List, Optional
 
 import msgpack
 
 _LEN = struct.Struct("<I")
+_CRC = struct.Struct("<I")
+WAL_MAGIC = b"RTPUWAL2"
 
 
 def _pack(obj: Any) -> bytes:
@@ -32,6 +50,23 @@ def _pack(obj: Any) -> bytes:
 
 def _unpack(raw: bytes) -> Any:
     return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename/unlink inside it is itself durable.
+    ``os.replace`` orders the data blocks, not the directory entry — on
+    power loss the rename can vanish, resurrecting a stale snapshot
+    against a WAL that was already deleted."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class ControllerStore:
@@ -44,10 +79,17 @@ class ControllerStore:
         self.snap_path = os.path.join(persist_dir, "controller.snapshot")
         self.wal_path = os.path.join(persist_dir, "controller.wal")
         self._wal = None
+        self._wal_v2 = True      # decided when the file is opened
         self._appends = 0
         self._compact_every = compact_every
         self._fsync = fsync
         self._snapshot_provider = None  # set by the controller
+        #: records appended (locally durable) since this store object was
+        #: created — the replication stream's sequence domain
+        self.seq = 0
+        #: called with the record list after each durable local append
+        #: (core/ha.py wires the leader's replicator here)
+        self.tap: Optional[Callable[[List[Any]], None]] = None
 
     # -- recovery ------------------------------------------------------------
     def load(self) -> Optional[Dict[str, Any]]:
@@ -70,28 +112,77 @@ class ControllerStore:
         with open(self.wal_path, "rb") as f:
             raw = f.read()
         off = 0
-        while off + _LEN.size <= len(raw):
+        v2 = raw.startswith(WAL_MAGIC)
+        if v2:
+            off = len(WAL_MAGIC)
+        head = _LEN.size + (_CRC.size if v2 else 0)
+        while off + head <= len(raw):
             (n,) = _LEN.unpack_from(raw, off)
             off += _LEN.size
+            if v2:
+                (crc,) = _CRC.unpack_from(raw, off)
+                off += _CRC.size
             if off + n > len(raw):
                 break  # torn tail write: discard (snapshot+prefix is valid)
-            out.append(_unpack(raw[off:off + n]))
+            blob = raw[off:off + n]
+            if v2 and zlib.crc32(blob) & 0xFFFFFFFF != crc:
+                # corrupt record: everything at and after it is suspect —
+                # stop at the last valid prefix, same as a torn tail
+                break
+            try:
+                out.append(_unpack(blob))
+            except Exception:
+                break  # v1 record that doesn't unpack: treat as torn
             off += n
         return out
 
     # -- mutation log --------------------------------------------------------
-    def append(self, *record: Any) -> None:
+    def _open_wal(self):
+        exists = os.path.exists(self.wal_path) \
+            and os.path.getsize(self.wal_path) > 0
+        self._wal = open(self.wal_path, "ab")
+        if not exists:
+            self._wal.write(WAL_MAGIC)
+            self._wal_v2 = True
+        else:
+            # keep appending in whatever format the file started with —
+            # mixing CRC and CRC-less records in one file is unreadable
+            with open(self.wal_path, "rb") as f:
+                self._wal_v2 = f.read(len(WAL_MAGIC)) == WAL_MAGIC
+
+    def append(self, *record: Any) -> int:
+        """Durably append one mutation record; returns its seq.  Feeds
+        the replication tap after the local fsync (a record is offered to
+        the standby only once it can no longer be lost locally)."""
+        seq = self._append_local(list(record))
+        if self.tap is not None:
+            self.tap(list(record))
+        return seq
+
+    def append_replica(self, record: List[Any]) -> int:
+        """Append a record RECEIVED over replication (standby side): same
+        durability, but never re-fed to the tap (no echo loops)."""
+        return self._append_local(list(record))
+
+    def _append_local(self, record: List[Any]) -> int:
         if self._wal is None:
-            self._wal = open(self.wal_path, "ab")
-        blob = _pack(list(record))
-        self._wal.write(_LEN.pack(len(blob)) + blob)
+            self._open_wal()
+        blob = _pack(record)
+        if self._wal_v2:
+            frame = _LEN.pack(len(blob)) \
+                + _CRC.pack(zlib.crc32(blob) & 0xFFFFFFFF) + blob
+        else:
+            frame = _LEN.pack(len(blob)) + blob
+        self._wal.write(frame)
         self._wal.flush()
         if self._fsync:
             os.fsync(self._wal.fileno())
+        self.seq += 1
         self._appends += 1
         if self._appends >= self._compact_every \
                 and self._snapshot_provider is not None:
             self.snapshot(self._snapshot_provider())
+        return self.seq
 
     def snapshot(self, tables: Dict[str, Any]) -> None:
         tmp = self.snap_path + ".tmp"
@@ -100,6 +191,9 @@ class ControllerStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.snap_path)
+        if self._fsync:
+            # make the rename itself durable before the WAL goes away
+            fsync_dir(self.dir)
         if self._wal is not None:
             self._wal.close()
             self._wal = None
@@ -107,6 +201,8 @@ class ControllerStore:
             os.unlink(self.wal_path)
         except OSError:
             pass
+        if self._fsync:
+            fsync_dir(self.dir)
         self._appends = 0
 
     def close(self) -> None:
@@ -117,7 +213,7 @@ class ControllerStore:
 
 def _empty_tables() -> Dict[str, Any]:
     return {"kv": {}, "actors": {}, "pgs": {}, "jobs": {},
-            "named_actors": {}, "draining_nodes": []}
+            "named_actors": {}, "draining_nodes": [], "ha_epoch": 0}
 
 
 def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
@@ -156,3 +252,8 @@ def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
         nodes = state.setdefault("draining_nodes", [])
         if rec[1] in nodes:
             nodes.remove(rec[1])
+    elif op == "epoch":
+        # leader-lease epoch: monotonic across failovers; a controller
+        # must never serve at an epoch below one it has durably seen
+        state["ha_epoch"] = max(int(state.get("ha_epoch", 0) or 0),
+                                int(rec[1]))

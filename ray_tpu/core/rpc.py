@@ -210,6 +210,83 @@ class RpcServer:
             await conn.close()
 
 
+def parse_endpoints(addr) -> list:
+    """``"h1:p1,h2:p2"`` (or a list of such / (host, port) pairs) →
+    ``[(host, port), ...]``.  Controller addresses grew into lists with
+    HA: the leader plus its hot standby(s)."""
+    if isinstance(addr, (list, tuple)) and addr \
+            and not isinstance(addr[0], str):
+        return [(h, int(p)) for h, p in addr]
+    parts = addr if isinstance(addr, (list, tuple)) else str(addr).split(",")
+    out = []
+    for part in parts:
+        part = str(part).strip()
+        if not part:
+            continue
+        host, port = part.rsplit(":", 1)
+        out.append((host, int(port)))
+    return out
+
+
+async def connect_leader(endpoints, handlers=None, retries: int = 30,
+                         probe_timeout: float = 3.0,
+                         deadline_s: Optional[float] = None):
+    """Dial the LEADER controller among ``endpoints``.
+
+    Each round probes every endpoint with ``ha_status`` and follows
+    leader/standby hints it returns (so a standby added after this
+    process booted is still discovered).  Returns ``(conn, endpoint,
+    status_dict)``.  A peer without an ``ha_status`` handler is treated
+    as a leader (pre-HA controller, plain test server)."""
+    from ..util.backoff import ExponentialBackoff
+    from .config import GlobalConfig as _cfg
+    eps = list(dict.fromkeys(parse_endpoints(endpoints)))
+    bo = ExponentialBackoff(base=0.05,
+                            cap=_cfg.rpc_connect_backoff_cap_s)
+    deadline = None if deadline_s is None \
+        else asyncio.get_event_loop().time() + deadline_s
+    last = None
+    for _attempt in range(max(1, retries)):
+        for ep in list(eps):
+            try:
+                conn = await connect(*ep, handlers, retries=1)
+            except (ConnectionLost, OSError) as e:
+                last = e
+                continue
+            try:
+                st = await conn.call("ha_status", {}, timeout=probe_timeout)
+            except RpcError as e:
+                if "no handler" in str(e):
+                    return conn, ep, {}   # pre-HA peer: it IS the leader
+                await conn.close()
+                last = e
+                continue
+            except (asyncio.TimeoutError, OSError) as e:
+                await conn.close()
+                last = e
+                continue
+            if not isinstance(st, dict):
+                return conn, ep, {}
+            for hint in list(st.get("standbys") or []) \
+                    + ([st.get("leader")] if st.get("leader") else []):
+                try:
+                    for e2 in parse_endpoints(hint):
+                        if e2 not in eps:
+                            eps.append(e2)
+                except (ValueError, AttributeError):
+                    pass
+            if st.get("role", "leader") == "leader":
+                return conn, ep, st
+            await conn.close()
+            last = ConnectionLost(f"{ep[0]}:{ep[1]} is {st.get('role')}")
+        if deadline is not None \
+                and asyncio.get_event_loop().time() > deadline:
+            break
+        await asyncio.sleep(bo.next_delay())
+    raise ConnectionLost(
+        f"no leader controller among {parse_endpoints(endpoints)}: {last}")
+
+
 async def connect(host: str, port: int,
                   handlers: Optional[Dict[str, Callable]] = None,
                   retries: int = 1, retry_delay: float = 0.02) -> Connection:
@@ -325,7 +402,14 @@ class BlockingClient:
     When constructed via ``connect`` it remembers its endpoint and redials
     on entry if the connection has dropped — the client half of controller
     fault tolerance (a restarted controller resumes at the same address;
-    reference: GCS clients retry through gcs_rpc_client.h)."""
+    reference: GCS clients retry through gcs_rpc_client.h).
+
+    Constructed via ``connect_ha`` it additionally holds the controller
+    ADDRESS LIST (leader + hot standbys): a failed call transparently
+    replays against whichever endpoint currently leads (epoch-stamped, so
+    a deposed leader the client stumbles onto fences itself), and
+    ``_not_leader`` replies from a standby/fenced controller reroute
+    instead of surfacing."""
 
     def __init__(self, loop_thread: EventLoopThread, conn: Connection,
                  endpoint: Optional[Tuple[str, int]] = None, handlers=None):
@@ -334,6 +418,13 @@ class BlockingClient:
         self._endpoint = endpoint
         self._handlers = handlers
         self._redial_lock = threading.Lock()
+        self._ha = False
+        self._endpoints: list = [endpoint] if endpoint else []
+        self._epoch = 0
+        self._fail_fast = False
+        #: called with this client after a successful HA redial — owners
+        #: re-establish connection-scoped state (pubsub subscriptions)
+        self.on_reconnect = None
 
     @classmethod
     def connect(cls, loop_thread: EventLoopThread, host: str, port: int,
@@ -341,22 +432,152 @@ class BlockingClient:
         conn = loop_thread.run(connect(host, port, handlers, retries=retries))
         return cls(loop_thread, conn, endpoint=(host, port), handlers=handlers)
 
-    def _ensure_conn(self):
-        if not self.conn.closed or self._endpoint is None:
+    @classmethod
+    def connect_ha(cls, loop_thread: EventLoopThread, addr,
+                   handlers=None, retries: int = 50):
+        """Connect to the leader among a controller address list
+        (``"h1:p1,h2:p2"``); the client follows leadership from then on."""
+        eps = parse_endpoints(addr)
+        conn, ep, st = loop_thread.run(
+            connect_leader(eps, handlers, retries=retries))
+        bc = cls(loop_thread, conn, endpoint=ep, handlers=handlers)
+        bc._ha = True
+        bc._endpoints = eps
+        bc._absorb_status(st)
+        return bc
+
+    def _absorb_status(self, st: dict):
+        if not isinstance(st, dict):
             return
+        self._epoch = max(self._epoch, int(st.get("epoch", 0) or 0))
+        for hint in list(st.get("standbys") or []):
+            try:
+                for ep in parse_endpoints(hint):
+                    if ep not in self._endpoints:
+                        self._endpoints.append(ep)
+            except (ValueError, AttributeError):
+                pass
+
+    def fail_fast(self):
+        """Disable failover retries (shutdown path: a dead controller
+        must not cost the full failover budget on the way out)."""
+        self._fail_fast = True
+
+    def endpoints(self):
+        return list(self._endpoints)
+
+    async def aconn(self) -> Connection:
+        """Current connection, redialed ON THE LOOP when dead — for the
+        owner's async internals (actor-wait polls, pubsub re-subscribes)
+        that share this client.  Never touches the sync redial lock: the
+        sync path blocks a caller thread on `_lt.run(...)` INTO this
+        loop, so acquiring its lock here could deadlock the loop."""
+        if not self.conn.closed:
+            return self.conn
+        if not self._ha or self._fail_fast:
+            raise ConnectionLost("controller connection closed")
+        conn, ep, st = await connect_leader(
+            self._endpoints, self._handlers, retries=5, deadline_s=5.0)
+        if self.conn.closed:
+            self.conn, self._endpoint = conn, ep
+            self._absorb_status(st)
+            cb = self.on_reconnect
+            if cb is not None:
+                try:
+                    cb(self)
+                except Exception:
+                    pass
+        else:
+            # lost a redial race against the sync path: keep the winner
+            await conn.close()
+        return self.conn
+
+    def _ensure_conn(self, reprobe: bool = False):
+        if not reprobe and (not self.conn.closed or self._endpoint is None):
+            return
+        cb = None
         with self._redial_lock:
-            if self.conn.closed:
-                self.conn = self._lt.run(connect(
-                    *self._endpoint, self._handlers, retries=10))
+            if self.conn.closed or reprobe:
+                if self._ha and not self._fail_fast:
+                    from .config import GlobalConfig as _cfg
+                    old = self.conn
+                    conn, ep, st = self._lt.run(connect_leader(
+                        self._endpoints, self._handlers, retries=1000,
+                        deadline_s=_cfg.ha_client_failover_timeout_s))
+                    self.conn, self._endpoint = conn, ep
+                    self._absorb_status(st)
+                    if not old.closed and old is not conn:
+                        try:
+                            self._lt.run(old.close())
+                        except Exception:
+                            pass
+                    cb = self.on_reconnect
+                else:
+                    self.conn = self._lt.run(connect(
+                        *self._endpoint, self._handlers, retries=10))
+                    cb = self.on_reconnect
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass
 
     def call(self, method: str, data: Any = None, timeout: Optional[float] = None):
-        self._ensure_conn()
-        return self._lt.run(self.conn.call(method, data, timeout=timeout),
-                            timeout=None if timeout is None else timeout + 5)
+        if not self._ha:
+            self._ensure_conn()
+            return self._lt.run(self.conn.call(method, data, timeout=timeout),
+                                timeout=None if timeout is None else timeout + 5)
+        from .config import GlobalConfig as _cfg
+        import time as _time
+        deadline = _time.monotonic() + _cfg.ha_client_failover_timeout_s
+        from ..util.backoff import ExponentialBackoff
+        bo = ExponentialBackoff(base=0.05, cap=0.5)
+        reprobe = False
+        while True:
+            try:
+                self._ensure_conn(reprobe=reprobe)
+                reprobe = False
+                payload = data
+                if type(data) is dict and "_ha_epoch" not in data:
+                    payload = {**data, "_ha_epoch": self._epoch}
+                r = self._lt.run(
+                    self.conn.call(method, payload, timeout=timeout),
+                    timeout=None if timeout is None else timeout + 5)
+            except (ConnectionLost, OSError) as e:
+                # leader died mid-call: replay against the new leader
+                if self._fail_fast or _time.monotonic() > deadline:
+                    raise
+                _time.sleep(bo.next_delay())
+                continue
+            if type(r) is dict and r.get("_not_leader"):
+                self._epoch = max(self._epoch, int(r.get("epoch", 0) or 0))
+                hint = r.get("leader")
+                if hint:
+                    try:
+                        for ep in parse_endpoints(hint):
+                            if ep not in self._endpoints:
+                                self._endpoints.append(ep)
+                    except (ValueError, AttributeError):
+                        pass
+                if self._fail_fast or _time.monotonic() > deadline:
+                    raise RpcError(
+                        f"controller at {self._endpoint} is not the "
+                        f"leader (epoch {self._epoch}) and no leader "
+                        f"emerged in time (calling {method})")
+                reprobe = True
+                _time.sleep(bo.next_delay())
+                continue
+            return r
 
     def notify(self, method: str, data: Any = None):
         self._ensure_conn()
-        return self._lt.run(self.conn.notify(method, data))
+        try:
+            return self._lt.run(self.conn.notify(method, data))
+        except (ConnectionLost, OSError):
+            if not self._ha or self._fail_fast:
+                raise
+            self._ensure_conn()
+            return self._lt.run(self.conn.notify(method, data))
 
     def close(self):
         try:

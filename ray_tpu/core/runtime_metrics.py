@@ -111,6 +111,12 @@ SERVE_SPEC_ACCEPTED = m.Counter(
     "Draft-model tokens the target's batched verify step accepted "
     "(exact greedy match; the bonus token per iteration is not counted)",
     ("deployment",))
+CONTROLLER_FAILOVERS = m.Counter(
+    "ray_tpu_controller_failovers_total",
+    "Controller leadership changes by outcome (promoted: a hot standby "
+    "took leadership after the leader's lease lapsed | fenced: a "
+    "deposed leader was epoch-fenced and stopped accepting writes)",
+    ("outcome",))
 SERVE_SESSIONS_MIGRATED = m.Counter(
     "ray_tpu_serve_sessions_migrated_total",
     "Decode sessions re-admitted on a healthy replica by the proxy-side "
@@ -168,6 +174,12 @@ DRAIN_DURATION = m.Histogram(
     "Wall time of one node drain, start to deregister/fallback",
     (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
     ("outcome",))
+CONTROLLER_FAILOVER_DURATION = m.Histogram(
+    "ray_tpu_controller_failover_seconds",
+    "Control-plane outage of one leader failover: last contact with the "
+    "dead leader to the standby serving as the new leader (bounded by "
+    "ha_lease_timeout_s plus one state restore)",
+    (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0), ())
 
 
 def observe_task_durs(durs: dict, node: str) -> None:
@@ -222,6 +234,11 @@ KV_KEYS = m.Gauge(
 OBJECT_DIRECTORY = m.Gauge(
     "ray_tpu_object_directory_entries",
     "Objects tracked in the controller directory", ())
+WAL_REPLICATION_LAG = m.Gauge(
+    "ray_tpu_controller_wal_replication_lag_records",
+    "WAL records the hot-standby controller is behind the leader "
+    "(0 with a healthy sync stream; grows while the replication stream "
+    "is severed or the leader runs in degraded async mode)", ())
 SERVE_SPEC_ACCEPTANCE = m.Gauge(
     "ray_tpu_serve_spec_acceptance_ratio",
     "Cumulative speculative-decoding acceptance ratio (accepted / "
@@ -273,3 +290,6 @@ def snapshot_controller(ctl: Any) -> None:
         ACTORS_BY_STATE.set(count, {"state": st})
     KV_KEYS.set(sum(len(v) for v in ctl.kv.values()))
     OBJECT_DIRECTORY.set(len(ctl.object_dir))
+    ha = getattr(ctl, "ha", None)
+    if ha is not None:
+        WAL_REPLICATION_LAG.set(ha.lag())

@@ -122,9 +122,8 @@ class WorkerRuntime:
         self.nodelet = await rpc.connect(host, int(port),
                                          handlers=dict(self.server.handlers),
                                          retries=GlobalConfig.rpc_connect_retries)
-        host, port = self.controller_addr.rsplit(":", 1)
-        self.controller = await rpc.connect(host, int(port),
-                                            retries=GlobalConfig.rpc_connect_retries)
+        self.controller, _ep, _st = await rpc.connect_leader(
+            self.controller_addr, retries=GlobalConfig.rpc_connect_retries)
         reply = await self.nodelet.call("register_worker", {
             "worker_id": self.worker_id, "port": self.server.port,
             "pid": os.getpid()})
@@ -187,14 +186,16 @@ class WorkerRuntime:
 
     async def _controller_conn(self) -> rpc.Connection:
         """Redial the controller when the connection dropped (it restarts
-        at the same address; reference: GCS clients reconnecting through
-        gcs_rpc_client).  Without this, every worker permanently lost its
-        function table / KV / actor reporting after a controller restart
-        — the chaos controller-kill scenario caught it."""
+        at the same address, or a hot standby from the address list got
+        promoted — core/ha.py; reference: GCS clients reconnecting
+        through gcs_rpc_client).  Without this, every worker permanently
+        lost its function table / KV / actor reporting after a
+        controller restart — the chaos controller-kill scenario caught
+        it."""
         if self.controller is None or self.controller.closed:
-            host, port = self.controller_addr.rsplit(":", 1)
-            self.controller = await rpc.connect(
-                host, int(port), retries=GlobalConfig.rpc_connect_retries)
+            self.controller, _ep, _st = await rpc.connect_leader(
+                self.controller_addr,
+                retries=GlobalConfig.rpc_connect_retries)
         return self.controller
 
     async def _h_chaos_update(self, conn, data):
@@ -287,8 +288,7 @@ class WorkerRuntime:
 
     async def _read_spilled(self, oid: bytes):
         from . import spill
-        conn = await self._controller_conn()
-        raw = await conn.call("kv_get", spill.kv_entry(oid))
+        raw = await self._ctl_call_retry("kv_get", spill.kv_entry(oid))
         if not raw:
             return None
         return spill.read_file(raw.decode())
@@ -296,14 +296,30 @@ class WorkerRuntime:
     async def _get_function(self, fid: bytes):
         fn = self.fn_cache.get(fid)
         if fn is None:
-            conn = await self._controller_conn()
-            blob = await conn.call("kv_get",
-                                   {"ns": FN_NAMESPACE, "key": fid})
+            blob = await self._ctl_call_retry(
+                "kv_get", {"ns": FN_NAMESPACE, "key": fid})
             if blob is None:
                 raise exceptions.RayTpuError(f"function {fid.hex()[:12]} not registered")
             fn = serialization.loads_function(blob)
             self.fn_cache[fid] = fn
         return fn
+
+    async def _ctl_call_retry(self, method: str, data, timeout: float = 30.0):
+        """Controller call that rides out a controller restart/failover:
+        an in-flight call dies with the leader's connection, which used
+        to fail the TASK (function-table fetch racing a controller kill
+        — the task errored with ConnectionLost instead of retrying
+        against the restarted/promoted controller)."""
+        deadline = time.monotonic() + \
+            GlobalConfig.ha_client_failover_timeout_s
+        while True:
+            try:
+                conn = await self._controller_conn()
+                return await conn.call(method, data, timeout=timeout)
+            except (rpc.ConnectionLost, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.2)
 
     async def _store_returns(self, spec: TaskSpec, result: Any) -> List[dict]:
         nret = spec.num_returns

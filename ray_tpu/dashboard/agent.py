@@ -70,9 +70,8 @@ class DashboardAgent:
             self.server.register(name, handler)
         self._lt.run(self.server.start())
         self.address = f"{self.server.host}:{self.server.port}"
-        chost, cport = controller_addr.rsplit(":", 1)
-        self._controller = rpc.BlockingClient.connect(
-            self._lt, chost, int(cport))
+        self._controller = rpc.BlockingClient.connect_ha(
+            self._lt, controller_addr)
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True,

@@ -297,6 +297,41 @@ def cmd_drain(args) -> None:
         ray_tpu.shutdown()
 
 
+def cmd_controller(args) -> None:
+    """Control-plane HA status: one row per controller (leader + hot
+    standbys) with role, epoch, and WAL replication mode/lag — the
+    operator's view of core/ha.py."""
+    import ray_tpu
+    from ray_tpu import state
+    if args.op != "status":
+        sys.exit(f"unknown controller op {args.op!r}")
+    _connect(args)
+    try:
+        rows = state.list_controllers()
+        print(f"{'ROLE':<12} {'ADDR':<22} {'EPOCH':>5}  "
+              f"{'REPL':<6} {'LAG':>5}  DETAIL")
+        for r in rows:
+            repl = r.get("repl") or {}
+            detail = ""
+            if r.get("role") == "leader":
+                detail = (f"acked={repl.get('acked', '-')} "
+                          f"seq={repl.get('seq', '-')}"
+                          + (" DEGRADED" if repl.get("degraded") else ""))
+            elif r.get("role") == "standby":
+                detail = (f"lease_age={r.get('lease_age_s', '-')}s "
+                          f"applied_seq={r.get('applied_seq', '-')}")
+            elif r.get("error"):
+                detail = r["error"][:60]
+            print(f"{r.get('role', '?'):<12} {r.get('addr', '?'):<22} "
+                  f"{r.get('epoch', '-'):>5}  "
+                  f"{repl.get('mode', '-'):<6} "
+                  f"{repl.get('lag', '-'):>5}  {detail}")
+        if not any(r.get("role") == "leader" for r in rows):
+            sys.exit("no controller currently claims leadership")
+    finally:
+        ray_tpu.shutdown()
+
+
 def _load_chaos_plan(path):
     if not path:
         sys.exit("chaos needs a JSON plan file for this operation")
@@ -462,6 +497,13 @@ def main(argv=None) -> None:
                          "drain_timeout_s config)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_drain)
+
+    sp = sub.add_parser("controller",
+                        help="control-plane HA status "
+                             "(leader/standby/epoch/replication lag)")
+    sp.add_argument("op", choices=["status"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_controller)
 
     sp = sub.add_parser("chaos",
                         help="fault-injection plan control "

@@ -67,6 +67,43 @@ def cluster_summary() -> Dict[str, Any]:
     }
 
 
+def list_controllers() -> List[Dict[str, Any]]:
+    """One row per controller process this driver knows about (the
+    leader plus its hot standbys — core/ha.py): role, epoch, and — for
+    the leader — WAL replication mode/lag.  Dead or unreachable
+    controllers are reported as such rather than omitted."""
+    from .core import rpc as rpc_mod
+    core = _ensure_initialized()
+    eps = []
+    try:
+        eps = core.controller.endpoints()
+    except Exception:
+        pass
+    if not eps:
+        eps = rpc_mod.parse_endpoints(core.controller_addr)
+    rows = []
+    for host, port in eps:
+        addr = f"{host}:{port}"
+        try:
+            conn = core.lt.run(rpc_mod.connect(host, port, retries=1))
+            try:
+                st = core.lt.run(conn.call("ha_status", {}, timeout=5))
+            finally:
+                core.lt.run(conn.close())
+            rows.append({"addr": addr, **(st or {})})
+        except Exception as e:
+            rows.append({"addr": addr, "role": "unreachable",
+                         "error": str(e)})
+    return rows
+
+
+def cluster_info() -> Dict[str, Any]:
+    """Control-plane + membership overview: a row for EVERY controller
+    (leader and standby, with epoch and replication lag) plus the node
+    table — the `ray-tpu controller status` data source."""
+    return {"controllers": list_controllers(), "nodes": list_nodes()}
+
+
 # -------------------------------------------------- per-node deep state
 def _node_call(addr: str, method: str, data: Optional[dict] = None,
                timeout: float = 10.0):

@@ -66,6 +66,16 @@ site                        actions
                             ``delay`` stretches the repair window (the
                             double-failure tests land a second kill inside
                             it)
+``controller.wal_replicate`` attacks the leader→standby WAL stream
+                            (core/ha.py): ``drop`` loses a record batch
+                            (the seq gap forces a snapshot resync; sync-
+                            mode writes degrade to bounded-lag async
+                            instead of stalling), ``delay`` stretches the
+                            replication lag
+``controller.lease_renew``  any action blackholes one leader→standby
+                            lease renewal — enough in a row and the
+                            standby promotes itself (forced failover
+                            under a live TCP connection)
 ==========================  =====================================================
 
 Zero-cost when disabled: every hot path guards with one module-level
@@ -117,6 +127,8 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "drain.deadline": None,
     "train.snapshot_put": frozenset({"error", "fail"}),
     "train.repair_restore": frozenset({"error", "fail"}),
+    "controller.wal_replicate": frozenset({"drop"}),
+    "controller.lease_renew": None,
 }
 _UNIVERSAL_ACTIONS = frozenset({"delay", "latency"})
 _RULE_KEYS = frozenset({"site", "action", "match", "delay_s", "once",

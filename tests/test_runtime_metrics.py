@@ -38,6 +38,12 @@ def test_cluster_metrics_exposition(cluster):
     assert "# TYPE ray_tpu_train_repairs_total counter" in text
     assert "# TYPE ray_tpu_train_repair_lost_steps_total counter" in text
     assert "# TYPE ray_tpu_train_repair_seconds histogram" in text
+    # the controller-HA battery (core/ha.py): failover counter +
+    # outage histogram + WAL replication lag gauge
+    assert "# TYPE ray_tpu_controller_failovers_total counter" in text
+    assert "# TYPE ray_tpu_controller_failover_seconds histogram" in text
+    assert ("# TYPE ray_tpu_controller_wal_replication_lag_records gauge"
+            in text)
 
     def sample_sum(name: str) -> float:
         total = 0.0
