@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
-	chaos drain failover spec elastic ha clean
+	chaos drain failover spec elastic ha partition clean
 
 all: native cpp
 
@@ -65,6 +65,16 @@ elastic:
 ha:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_controller_ha.py \
 		tests/test_controller_ft.py -q
+
+# Partition suite: gray-failure handling — connectivity-matrix fold
+# units (asymmetric / controller-only / full partitions), the
+# alternate-path fetch ladder, suspect/quarantine end to end
+# (controller-link blackhole keeps the node SUSPECT, its actor
+# survives, zero-restart rejoin ×2 seeds; grace exhaustion dies), and
+# the `slow` asymmetric A↛B transfer partition under a task wave
+# completing via the relay rung ×2 seeds.
+partition:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_partition.py -q
 
 # Spec suite: chunked-prefill admission + speculative decoding —
 # verify-program exactness, chunk-boundary/admission parity, shared and

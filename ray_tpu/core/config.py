@@ -125,7 +125,36 @@ _d("worker_lease_idle_seconds", float, 0.2,
    "the lease pins scheduler resources; warm reuse across bursts comes from "
    "the nodelet's idle worker pool, not from held leases.")
 _d("heartbeat_interval_s", float, 0.5, "Nodelet -> controller resource report period.")
-_d("node_death_timeout_s", float, 5.0, "Heartbeat silence after which a node is dead.")
+_d("node_death_timeout_s", float, 5.0,
+   "Heartbeat silence after which the controller acts on a node: if "
+   "probing peers still reach it the node becomes SUSPECT (quarantined, "
+   "nothing killed), else it is declared dead.  This is the "
+   "controller's heartbeat_timeout_s default (it was hardcoded at "
+   "construction before the partition-tolerance layer).")
+_d("suspect_grace_s", float, 15.0,
+   "How long a SUSPECT node (controller link down, peers still reach "
+   "it) may stay quarantined before it is declared dead anyway.  A "
+   "link that heals inside this budget rejoins the node with its "
+   "actors and objects untouched.")
+_d("peer_probe_interval_s", float, 0.5,
+   "Period of each nodelet's peer-reachability probe round (RPC port + "
+   "object-transfer port of a few rotating peers); results piggyback "
+   "on the next heartbeat and feed the controller's connectivity "
+   "matrix.")
+_d("peer_probe_fanout", int, 2,
+   "Peers probed per probe round (rotating over the membership, so "
+   "every pair is sampled within a few rounds).")
+_d("peer_probe_timeout_s", float, 1.0,
+   "Per-peer probe timeout; a probe that cannot complete inside this "
+   "reports the peer unreachable for this round.")
+_d("peer_reach_fresh_s", float, 2.5,
+   "Freshness window of connectivity-matrix entries: a reachability "
+   "report older than this no longer counts as evidence (suspect "
+   "decisions and scheduling avoidance both read the matrix).")
+_d("object_fetch_attempts", int, 3,
+   "Bounded full-jitter retry attempts per source in the cross-node "
+   "object fetch ladder (retry -> alternate directory copy -> "
+   "controller-mediated relay -> lineage reconstruction).")
 _d("task_retry_delay_s", float, 0.2, "Delay before resubmitting a failed task.")
 _d("default_max_retries", int, 3, "Default retries for idempotent tasks.")
 _d("actor_restart_delay_s", float, 0.2, "Delay before restarting a dead actor.")

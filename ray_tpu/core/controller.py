@@ -95,7 +95,7 @@ class NodeRecord:
 
 class Controller:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat_timeout_s: float = 5.0,
+                 heartbeat_timeout_s: Optional[float] = None,
                  persist_dir: Optional[str] = None,
                  standby_of: Optional[str] = None,
                  lease_timeout_s: Optional[float] = None):
@@ -106,8 +106,22 @@ class Controller:
         from .ha import HAManager
         self.ha = HAManager(self, standby_of=standby_of,
                             lease_timeout_s=lease_timeout_s)
-        self.heartbeat_timeout_s = heartbeat_timeout_s
+        # config-backed (RAY_TPU_NODE_DEATH_TIMEOUT_S) unless the caller
+        # pins it — the old hardcoded 5.0 was untunable cluster-wide
+        self.heartbeat_timeout_s = (
+            heartbeat_timeout_s if heartbeat_timeout_s is not None
+            else GlobalConfig.node_death_timeout_s)
         self.nodes: Dict[str, NodeRecord] = {}
+        # peer-reachability connectivity matrix, folded from the
+        # reachability vectors nodelets piggyback on their heartbeats
+        from .reachability import ReachMatrix
+        self.reach = ReachMatrix(GlobalConfig.peer_reach_fresh_s)
+        # SUSPECT quarantine: node_id -> monotonic time it entered.  A
+        # suspect node's controller link is down but probing peers still
+        # reach it — no new leases/placements land there, serve routers
+        # skip it, but its actors and objects are UNTOUCHED; it rejoins
+        # with zero restarts when the link heals inside suspect_grace_s.
+        self.suspects: Dict[str, float] = {}
         self.actors: Dict[bytes, ActorRecord] = {}
         self.named_actors: Dict[str, bytes] = {}
         self.pgs: Dict[bytes, PGRecord] = {}
@@ -202,6 +216,7 @@ class Controller:
                     for pg in self.pgs.values()},
             "jobs": {jid: info for jid, info in self.jobs.items()},
             "draining_nodes": list(self.draining),
+            "suspect_nodes": list(self.suspects),
             "ha_epoch": self.ha.epoch,
         }
 
@@ -247,6 +262,12 @@ class Controller:
         for nid in state.get("draining_nodes", []):
             self.draining[nid] = {"phase": "restored", "in_flight": -1,
                                   "objects_left": -1}
+        # suspects survive the restart/promotion with a FRESH grace
+        # budget: the quarantined node either re-registers (rejoins with
+        # everything intact) or the health loop declares it dead once
+        # the restarted grace runs out with no peer reaching it
+        for nid in state.get("suspect_nodes", []):
+            self.suspects[nid] = time.monotonic()
 
     # ------------------------------------------------------------------ setup
     def _register_handlers(self):
@@ -259,6 +280,7 @@ class Controller:
                      "remove_placement_group", "list_placement_groups",
                      "object_location_add", "object_location_remove",
                      "object_locations_get", "object_replicate",
+                     "object_relay",
                      "free_objects", "list_objects",
                      "ref_inc", "ref_dec", "free_request", "ref_counts",
                      "report_event", "list_events",
@@ -504,6 +526,10 @@ class Controller:
         self.nodes[data["node_id"]] = NodeRecord(view, conn)
         conn.peer_info["node_id"] = data["node_id"]
         conn.on_close = self._node_conn_closed
+        if data["node_id"] in self.suspects:
+            # the quarantined node's link healed (its reconnect loop
+            # re-registered): rejoin with actors/objects untouched
+            await self._rejoin_node(data["node_id"])
         if data["node_id"] in self.draining:
             # re-registration of a node whose drain our restart (or a
             # dropped connection) interrupted: stay out of the placement
@@ -522,8 +548,13 @@ class Controller:
 
     def _node_conn_closed(self, conn):
         nid = conn.peer_info.get("node_id")
-        if nid and nid in self.nodes:
-            asyncio.ensure_future(self._mark_node_dead(nid, "connection lost"))
+        if nid and nid in self.nodes \
+                and self.nodes[nid].conn is conn:
+            # a lost controller link is not proof of death: peers may
+            # still reach the node (controller-only partition) — the
+            # suspect path decides
+            asyncio.ensure_future(
+                self._on_node_silent(nid, "connection lost"))
 
     async def _h_heartbeat(self, conn, data):
         """Resource report + versioned view sync in one round trip.
@@ -541,6 +572,19 @@ class Controller:
             return {"unknown_node": True}
         rec.last_heartbeat = time.monotonic()
         rec.demand = data.get("demand") or []
+        if nid in self.suspects:
+            # the controller link healed inside the grace budget
+            await self._rejoin_node(nid)
+        # fold the piggybacked peer-reachability vector into the
+        # connectivity matrix; changed unreachable sets ride the
+        # versioned view sync so every nodelet's scheduler sees them
+        reach = data.get("reach")
+        if reach:
+            self.reach.report(nid, reach)
+            unreach = self.reach.unreachable_from(nid)
+            if unreach != rec.view.unreachable:
+                rec.view.unreachable = unreach
+                self._bump_view(nid)
         new_avail = ResourceSet(data["available"])
         new_total = ResourceSet(data["total"])
         if (new_avail.to_dict() != rec.view.available.to_dict()
@@ -567,12 +611,29 @@ class Controller:
         # demand rides the node ROWS, not the synced views — it churns
         # every heartbeat and would bloat the versioned delta stream
         out = []
+        now = time.monotonic()
         for rec in self.nodes.values():
+            nid = rec.view.node_id
             row = {**rec.view.to_wire(), "demand": rec.demand}
             row["state"] = ("DRAINING" if rec.view.draining and
                             rec.view.alive else
+                            "SUSPECT" if nid in self.suspects and
+                            rec.view.alive else
                             "ALIVE" if rec.view.alive else "DEAD")
-            drain = self.draining.get(rec.view.node_id)
+            row["health"] = {
+                "heartbeat_age_s": round(now - rec.last_heartbeat, 3),
+                "heartbeat_timeout_s": self.heartbeat_timeout_s,
+                "suspect_grace_s": GlobalConfig.suspect_grace_s,
+                "peer_probe_fanout": GlobalConfig.peer_probe_fanout,
+            }
+            if nid in self.suspects:
+                row["suspect_for_s"] = round(now - self.suspects[nid], 3)
+                row["peers_reaching"] = sorted(
+                    self.reach.reachable_by(nid, now))
+            unreach = self.reach.unreachable_from(nid, now)
+            if unreach:
+                row["unreachable_peers"] = sorted(unreach)
+            drain = self.draining.get(nid)
             if drain is not None:
                 row["drain"] = dict(drain)
             out.append(row)
@@ -774,14 +835,163 @@ class Controller:
             await asyncio.sleep(self.heartbeat_timeout_s / 3)
             now = time.monotonic()
             for nid, rec in list(self.nodes.items()):
-                if rec.view.alive and now - rec.last_heartbeat > self.heartbeat_timeout_s:
-                    await self._mark_node_dead(nid, "heartbeat timeout")
+                if not rec.view.alive:
+                    continue
+                if nid in self.suspects:
+                    await self._check_suspect(nid, now)
+                elif now - rec.last_heartbeat > self.heartbeat_timeout_s:
+                    await self._on_node_silent(nid, "heartbeat timeout")
+            # restored suspects whose node never re-registered (promoted
+            # standby / controller restart): no NodeRecord exists, but
+            # the grace budget still runs down
+            for nid in list(self.suspects):
+                if nid not in self.nodes:
+                    await self._check_suspect(nid, now)
+
+    async def _on_node_silent(self, node_id: str, reason: str):
+        """The controller lost its own signal from a node (heartbeat
+        timeout or dropped connection).  Binary death is wrong when the
+        failure is a controller-only partition: if probing peers still
+        reach the node it is quarantined SUSPECT instead — nothing is
+        killed, and a link that heals inside ``suspect_grace_s`` rejoins
+        the node with zero restarts.  Only a node the controller AND
+        its peers cannot reach takes the hard-death path.  Peers are
+        probed ON DEMAND first: the piggybacked gossip may be a probe
+        round stale, and deciding a real death off a stale "reachable"
+        would delay recovery by the whole freshness window."""
+        from .reachability import classify_silent_node
+        await self._solicit_probes(node_id)
+        if classify_silent_node(self.reach, node_id) == "suspect":
+            await self._mark_node_suspect(node_id, reason)
+        else:
+            await self._mark_node_dead(node_id, reason)
+
+    async def _solicit_probes(self, node_id: str):
+        """Ask a couple of live peers to probe ``node_id`` RIGHT NOW and
+        fold the answers — fresh directed evidence replaces whatever
+        stale entries the background gossip left, so suspect/dead
+        decisions never wait out the freshness window."""
+        rec_t = self.nodes.get(node_id)
+        addr = rec_t.view.addr if rec_t is not None else None
+        peers = sorted(
+            (nid, rec) for nid, rec in self.nodes.items()
+            if nid != node_id and rec.view.alive and not rec.view.draining
+            and nid not in self.suspects and not rec.conn.closed)
+        peers = peers[:max(1, GlobalConfig.peer_probe_fanout)]
+        if not peers:
+            return
+
+        async def _ask(nid, rec):
+            try:
+                ok = await rec.conn.call(
+                    "probe_peer_now", {"node_id": node_id, "addr": addr},
+                    timeout=GlobalConfig.peer_probe_timeout_s * 2 + 1.0)
+                return nid, bool(ok)
+            except (rpc.RpcError, asyncio.TimeoutError, OSError):
+                return nid, None  # the PROBER is unreachable: no evidence
+        results = await asyncio.gather(*(_ask(n, r) for n, r in peers))
+        for nid, ok in results:
+            if ok is not None:
+                self.reach.report(nid, {node_id: ok})
+
+    async def _mark_node_suspect(self, node_id: str, reason: str):
+        if node_id in self.suspects:
+            return
+        self.suspects[node_id] = time.monotonic()
+        self._p("suspect", node_id)
+        rec = self.nodes.get(node_id)
+        if rec is not None:
+            rec.view.suspect = True
+            self._bump_view(node_id)
+        self._emit_event(
+            "WARNING", "controller",
+            f"node {node_id[:12]} SUSPECT ({reason}): peers still reach "
+            f"it — quarantined for up to "
+            f"{GlobalConfig.suspect_grace_s:g}s, nothing killed",
+            node_id=node_id)
+        # routers/peers stop targeting it NOW, without waiting for the
+        # versioned view delta to propagate
+        await self._broadcast("nodes", {"event": "suspect",
+                                        "node_id": node_id,
+                                        "reason": reason})
+
+    async def _check_suspect(self, node_id: str, now: float):
+        """Re-evaluate one quarantined node every health tick: grace
+        exhausted or peer evidence gone → dead (today's recovery path);
+        heartbeats resuming rejoin it in ``_h_heartbeat`` instead."""
+        since = self.suspects.get(node_id)
+        if since is None:
+            return
+        if now - since > GlobalConfig.suspect_grace_s:
+            await self._suspect_died(
+                node_id, f"suspect grace "
+                         f"({GlobalConfig.suspect_grace_s:g}s) exceeded")
+            return
+        if not self.reach.reachable_by(node_id):
+            # stale-looking quarantine: re-probe on demand before the
+            # verdict (a heartbeat may already have rejoined it — the
+            # dict re-check below covers the await window)
+            await self._solicit_probes(node_id)
+            if node_id in self.suspects \
+                    and not self.reach.reachable_by(node_id):
+                await self._suspect_died(
+                    node_id, "unreachable by controller and probing peers")
+
+    async def _suspect_died(self, node_id: str, reason: str):
+        if node_id in self.nodes:
+            await self._mark_node_dead(node_id, reason)
+            return
+        # no membership record (suspect restored by a promoted standby,
+        # node never re-registered): run the death consequences directly
+        self._clear_suspect(node_id, "died")
+        self.reach.forget(node_id)
+        self._emit_event("ERROR", "controller",
+                         f"node {node_id[:12]} died: {reason}",
+                         node_id=node_id)
+        await self._broadcast("nodes", {"event": "dead",
+                                        "node_id": node_id,
+                                        "reason": reason})
+        for oid, locs in list(self.object_dir.items()):
+            locs.discard(node_id)
+            if not locs:
+                del self.object_dir[oid]
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id \
+                    and actor.state in (ALIVE, PENDING_CREATION):
+                await self._on_actor_failure(
+                    actor, f"node {node_id} died: {reason}")
+
+    def _clear_suspect(self, node_id: str, outcome: str) -> bool:
+        """Leave quarantine (either direction); True if it was in it."""
+        if self.suspects.pop(node_id, None) is None:
+            return False
+        self._p("suspect_del", node_id)
+        rtm.SUSPECT_TRANSITIONS.inc(tags={"outcome": outcome})
+        rec = self.nodes.get(node_id)
+        if rec is not None and rec.view.suspect:
+            rec.view.suspect = False
+            self._bump_view(node_id)
+        return True
+
+    async def _rejoin_node(self, node_id: str):
+        if not self._clear_suspect(node_id, "rejoined"):
+            return
+        self._emit_event(
+            "INFO", "controller",
+            f"node {node_id[:12]} rejoined from SUSPECT: link healed, "
+            f"actors/objects intact", node_id=node_id)
+        self._pending_actor_wakeup.set()
+        await self._broadcast("nodes", {"event": "rejoined",
+                                        "node_id": node_id})
 
     async def _mark_node_dead(self, node_id: str, reason: str):
         rec = self.nodes.get(node_id)
         if rec is None or not rec.view.alive:
             return
+        self._clear_suspect(node_id, "died")
+        self.reach.forget(node_id)
         rec.view.alive = False
+        rec.view.suspect = False
         self._bump_view(node_id)
         if reason == "drained":
             # planned departure that quiesced in budget: not an error
@@ -931,7 +1141,8 @@ class Controller:
         if node_id is None:
             return
         rec = self.nodes.get(node_id)
-        if rec is None or not rec.view.alive or rec.view.draining:
+        if rec is None or not rec.view.alive or rec.view.draining \
+                or rec.view.suspect:
             return
         actor.node_id = node_id
         t_place = time.time()
@@ -1271,6 +1482,55 @@ class Controller:
         return {"ok": bool(r.get("ok")), "node_id": target,
                 "error": r.get("error")}
 
+    async def _h_object_relay(self, conn, data):
+        """Alternate-path fetch, relay rung: the requester exhausted its
+        direct sources (asymmetric partition — every holder exists but
+        the requester cannot reach them), so pick a MUTUALLY REACHABLE
+        peer C (requester→C and C→holder both clean per the
+        connectivity matrix), have C pull a copy, and hand its address
+        back for the requester to refetch from.  The relay copy lands
+        in the object directory like any replica, so even a raced
+        retry finds it."""
+        oid = data["object_id"]
+        requester = data.get("node_id") or ""
+        timeout = float(data.get("timeout", 10.0))
+        now = time.monotonic()
+        holders = {n for n in self.object_dir.get(oid, set())
+                   if n != requester and n in self.nodes
+                   and self.nodes[n].view.alive}
+        if not holders:
+            return {"ok": False, "error": "no live holder to relay from"}
+        req_cant = self.reach.unreachable_from(requester, now)
+        cands = []
+        for nid, rec in self.nodes.items():
+            if nid == requester or nid in holders:
+                continue
+            if not rec.view.alive or rec.view.draining \
+                    or nid in self.suspects:
+                continue
+            if nid in req_cant:
+                continue  # the requester can't reach this relay either
+            cant = self.reach.unreachable_from(nid, now)
+            if any(h not in cant for h in holders):
+                cands.append((nid, rec))
+        for nid, rec in sorted(cands, key=lambda p: p[0]):
+            try:
+                r = await rec.conn.call(
+                    "pull", {"object_id": oid, "timeout": timeout},
+                    timeout=timeout + 5.0)
+            except (rpc.RpcError, asyncio.TimeoutError, OSError):
+                continue
+            if r.get("ok"):
+                self._emit_event(
+                    "INFO", "controller",
+                    f"object {oid.hex()[:12]} relayed via node "
+                    f"{nid[:12]} for partitioned requester "
+                    f"{requester[:12]}", node_id=nid)
+                return {"ok": True, "node_id": nid,
+                        "addr": rec.view.addr}
+        return {"ok": False,
+                "error": "no mutually-reachable relay peer succeeded"}
+
     async def _h_free_objects(self, conn, data):
         """Immediate (unconditional) free — spilling/testing paths."""
         await self._do_free(data["object_ids"])
@@ -1479,7 +1739,8 @@ class Controller:
         return True
 
 
-async def run_controller(host: str, port: int, heartbeat_timeout_s: float = 5.0,
+async def run_controller(host: str, port: int,
+                         heartbeat_timeout_s: Optional[float] = None,
                          persist_dir: Optional[str] = None,
                          standby_of: Optional[str] = None,
                          lease_timeout_s: Optional[float] = None):

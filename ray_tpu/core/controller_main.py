@@ -13,7 +13,10 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
-    p.add_argument("--heartbeat-timeout", type=float, default=5.0)
+    p.add_argument("--heartbeat-timeout", type=float, default=None,
+                   help="heartbeat silence before the controller acts "
+                        "on a node (default: the node_death_timeout_s "
+                        "config flag)")
     p.add_argument("--persist-dir", default=None,
                    help="snapshot+WAL dir for controller fault tolerance")
     p.add_argument("--standby-of", default=None,

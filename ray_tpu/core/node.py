@@ -88,7 +88,8 @@ class ProcessHandle:
         self.proc.wait(timeout=5)
 
 
-def start_controller(session_dir: str, heartbeat_timeout_s: float = 5.0,
+def start_controller(session_dir: str,
+                     heartbeat_timeout_s: Optional[float] = None,
                      port: int = 0, persist: bool = True,
                      standby_of: Optional[str] = None,
                      state_dir: str = "controller_state",
@@ -105,7 +106,9 @@ def start_controller(session_dir: str, heartbeat_timeout_s: float = 5.0,
     log_name = "controller_standby.err" if standby_of else "controller.err"
     log = open(os.path.join(session_dir, "logs", log_name), "ab")
     cmd = [sys.executable, "-m", "ray_tpu.core.controller_main",
-           "--port", str(port), "--heartbeat-timeout", str(heartbeat_timeout_s)]
+           "--port", str(port)]
+    if heartbeat_timeout_s is not None:
+        cmd += ["--heartbeat-timeout", str(heartbeat_timeout_s)]
     if persist:
         cmd += ["--persist-dir", os.path.join(session_dir, state_dir)]
     if standby_of:
@@ -146,7 +149,7 @@ class LocalCluster:
 
     def __init__(self, *, resources: Optional[Dict[str, float]] = None,
                  object_store_memory: int = 0,
-                 heartbeat_timeout_s: float = 5.0):
+                 heartbeat_timeout_s: Optional[float] = None):
         self.session_dir = new_session_dir()
         self.controller_proc, self.controller_addr = start_controller(
             self.session_dir, heartbeat_timeout_s)

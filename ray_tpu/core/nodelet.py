@@ -144,6 +144,13 @@ class Nodelet:
         self.draining = False
         self._drain_finished = False   # heartbeats stop; never resurrect
         self._evac_rr = 0              # round-robin cursor over peers
+        # Peer-reachability gossip: a few rotating peers are probed per
+        # probe round (RPC port + object-transfer port); fresh results
+        # piggyback on the heartbeat and feed the controller's
+        # connectivity matrix (suspect/quarantine decisions, A↛B-aware
+        # scheduling, relay-peer selection).
+        self._peer_reach: Dict[str, tuple] = {}   # nid -> (ok, mono ts)
+        self._probe_rr = 0
         self._register_handlers()
 
     # ------------------------------------------------------------------ setup
@@ -157,7 +164,8 @@ class Nodelet:
                      "tail_log", "task_spans", "prestart_workers",
                      "metrics_text", "chaos_injected",
                      "drain", "drain_status", "drain_evacuate",
-                     "drain_complete", "detach_kill_worker"):
+                     "drain_complete", "detach_kill_worker",
+                     "peer_probe", "probe_peer_now"):
             s.register(name, getattr(self, "_h_" + name))
 
     @property
@@ -198,6 +206,9 @@ class Nodelet:
             self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         self._lag_ewma = 0.0
         self._lag_max = 0.0
+        if GlobalConfig.peer_probe_interval_s > 0:
+            self._tasks.append(
+                asyncio.ensure_future(self._peer_probe_loop()))
         self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
         self._tasks.append(asyncio.ensure_future(self._trace_flush_loop()))
         self._agent_proc = None
@@ -355,6 +366,17 @@ class Nodelet:
             if nv:
                 nv.alive = False
             self._peer_conns.pop(data.get("addr", ""), None)
+            self._peer_reach.pop(data["node_id"], None)
+        elif data.get("event") == "suspect":
+            # quarantined peer: stop spilling leases there immediately
+            # (the versioned view delta may be a heartbeat away)
+            nv = self.view.get(data["node_id"])
+            if nv:
+                nv.suspect = True
+        elif data.get("event") == "rejoined":
+            nv = self.view.get(data["node_id"])
+            if nv:
+                nv.suspect = False
         elif data.get("event") == "draining":
             # stop spilling leases to the draining peer NOW — the
             # versioned view delta may be a heartbeat away
@@ -410,6 +432,7 @@ class Nodelet:
                     "view_version": self.view_version,
                     "demand":
                         list(self._demand_tokens.values())[:64],
+                    "reach": self._fresh_reach(),
                     "_ha_epoch": getattr(self, "_ctl_epoch", 0),
                 }, timeout=5)
                 if reply and reply.get("_not_leader"):
@@ -433,6 +456,109 @@ class Nodelet:
             except (rpc.RpcError, OSError):
                 pass
             await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
+
+    # -------------------------------------------- peer-reachability gossip
+    def _fresh_reach(self) -> Dict[str, bool]:
+        """Probe results young enough to count as evidence — the
+        reachability vector piggybacked on the next heartbeat."""
+        now = time.monotonic()
+        fresh = GlobalConfig.peer_reach_fresh_s
+        return {nid: ok for nid, (ok, ts) in self._peer_reach.items()
+                if now - ts <= fresh}
+
+    async def _h_peer_probe(self, conn, data):
+        """A peer is probing our RPC plane; the reply carries the
+        object-transfer port so the prober can check the data plane
+        too (gray failures break them independently)."""
+        return {"ok": True, "transfer_port": self.transfer_port,
+                "node_id": self.node_id.hex()}
+
+    async def _h_probe_peer_now(self, conn, data):
+        """On-demand probe solicited by the controller while it decides
+        suspect-vs-dead for a silent node: probe the target immediately
+        and answer with the outcome (also folded into our own gossip so
+        the next heartbeat carries it)."""
+        nid = data.get("node_id") or ""
+        nv = self.view.get(nid)
+        if nv is None:
+            addr = data.get("addr")
+            if not addr:
+                return False
+            from types import SimpleNamespace
+            nv = SimpleNamespace(node_id=nid, addr=addr)
+        ok = await self._probe_peer(nv)
+        if nid:
+            self._peer_reach[nid] = (ok, time.monotonic())
+        return ok
+
+    async def _peer_probe_loop(self):
+        """Probe a few rotating peers per round (RPC port + transfer
+        port) and remember the outcome; results ride the heartbeat into
+        the controller's connectivity matrix.  A probe round records a
+        ``peer_probe`` span only when some peer's state CHANGED — a
+        healthy cluster's trace buffer stays quiet."""
+        from ..util import tracing
+        while True:
+            await asyncio.sleep(GlobalConfig.peer_probe_interval_s)
+            if self._drain_finished or self._stopping:
+                return
+            me = self.node_id.hex()
+            peers = sorted((nv for nv in self.view.values()
+                            if nv.alive and nv.node_id != me),
+                           key=lambda nv: nv.node_id)
+            if not peers:
+                continue
+            fanout = max(1, GlobalConfig.peer_probe_fanout)
+            chosen, seen = [], set()
+            for i in range(min(fanout, len(peers))):
+                nv = peers[(self._probe_rr + i) % len(peers)]
+                if nv.node_id not in seen:
+                    seen.add(nv.node_id)
+                    chosen.append(nv)
+            self._probe_rr = (self._probe_rr + len(chosen)) % len(peers)
+            t0 = time.time()
+            changed = {}
+            for nv in chosen:
+                ok = await self._probe_peer(nv)
+                prev = self._peer_reach.get(nv.node_id)
+                self._peer_reach[nv.node_id] = (ok, time.monotonic())
+                if prev is None or prev[0] != ok:
+                    changed[nv.node_id[:12]] = ok
+            if changed:
+                tracing.record_span(
+                    f"peer_probe::{me[:8]}", "peer_probe",
+                    t0, time.time(), node_id=me[:12],
+                    changed={k: ("reachable" if v else "unreachable")
+                             for k, v in changed.items()})
+
+    async def _probe_peer(self, nv) -> bool:
+        """One peer probe: RPC round trip, then a TCP dial of the
+        peer's object-transfer port — both planes must answer for the
+        peer to count as reachable from here."""
+        if fi.ACTIVE is not None and fi.ACTIVE.point(
+                "nodelet.peer_probe", nv.node_id,
+                peer=nv.node_id) is not None:
+            return False  # injected false negative (chaos)
+        timeout = GlobalConfig.peer_probe_timeout_s
+        try:
+            conn = await asyncio.wait_for(self._peer(nv.addr),
+                                          timeout=timeout)
+            r = await asyncio.wait_for(conn.call("peer_probe", {}),
+                                       timeout=timeout)
+            tport = r.get("transfer_port") if isinstance(r, dict) else None
+            if tport:
+                host = nv.addr.rsplit(":", 1)[0]
+                _r, w = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(tport)),
+                    timeout=timeout)
+                w.close()
+            return True
+        except (rpc.RpcError, asyncio.TimeoutError, OSError):
+            # drop the cached conn if it died so a healed link redials
+            cached = self._peer_conns.get(nv.addr)
+            if cached is not None and cached.closed:
+                self._peer_conns.pop(nv.addr, None)
+            return False
 
     async def _trace_flush_loop(self):
         """Flush this nodelet's lifecycle spans to the controller KV
@@ -871,6 +997,16 @@ class Nodelet:
             self._demand_tokens.pop(tok, None)
 
     async def _lease_inner(self, spec, request, strategy, deadline, my_id):
+        # Arg-locality hint for the connectivity matrix: the task's ref
+        # args are fetchable from (at least) this submitting node, so a
+        # spillback target that freshly reported it cannot reach US
+        # would wedge the task's arg fetch behind a severed link —
+        # hybrid_policy avoids such targets (softly: the relay rung of
+        # the fetch ladder remains the safety net).
+        try:
+            arg_nodes = {my_id} if spec.arg_ref_ids() else None
+        except (KeyError, TypeError):
+            arg_nodes = None
         while True:
             self._refresh_self_view()
             if self.draining:
@@ -878,7 +1014,8 @@ class Nodelet:
                 # fits, else tell the driver to retry (it re-evaluates
                 # against the synced view, which now marks us DRAINING)
                 target = hybrid_policy(self.view, request, None,
-                                       strategy=strategy)
+                                       strategy=strategy,
+                                       arg_nodes=arg_nodes)
                 if target is not None and target != my_id:
                     nv = self.view.get(target)
                     rtm.LEASES_SPILLBACK.inc(tags=self._mnode)
@@ -887,7 +1024,7 @@ class Nodelet:
             target = hybrid_policy(
                 self.view, request, my_id,
                 spread_threshold=GlobalConfig.scheduler_spread_threshold,
-                strategy=strategy)
+                strategy=strategy, arg_nodes=arg_nodes)
             if target is not None and target != my_id:
                 nv = self.view.get(target)
                 rtm.LEASES_SPILLBACK.inc(tags=self._mnode)
@@ -1194,9 +1331,15 @@ class Nodelet:
         return True
 
     async def _h_pull(self, conn, data):
-        """Make the object local: chunk-pull from a peer holding it
-        (reference: pull_manager.cc:442 TryToMakeObjectLocal +
-        push_manager.cc chunked pushes)."""
+        """Make the object local, climbing the alternate-path fetch
+        ladder (reference: pull_manager.cc:442 TryToMakeObjectLocal +
+        push_manager.cc chunked pushes): each directory copy gets
+        bounded full-jitter retries; when every direct source fails but
+        copies exist (asymmetric partition), the controller relays the
+        object through a mutually-reachable peer; only then does the
+        failure surface for lineage reconstruction.  Every rung taken
+        is counted in ``ray_tpu_object_fetch_fallbacks_total{path}``."""
+        from ..util import tracing
         oid = data["object_id"]
         timeout = data.get("timeout", 30.0)
         if self.store.contains(oid):
@@ -1220,6 +1363,29 @@ class Nodelet:
             # or freed) — report promptly so the owner's lineage
             # reconstruction starts instead of spinning out the timeout.
             no_loc_deadline = time.monotonic() + min(timeout, 5.0)
+            t0 = time.time()
+            attempted: List[str] = []
+            failed_sources: Set[str] = set()
+            relay_tried = False
+            first_addr: Optional[str] = None
+
+            async def _success(rung: Optional[str], size: int):
+                # pin_primary: a drain evacuation hands PRIMARY
+                # responsibility to us — pin the copy so LRU eviction
+                # cannot drop what is now the sole copy
+                await self._h_put_location(
+                    None, {"object_id": oid,
+                           "primary": bool(data.get("pin_primary")),
+                           "size": size})
+                if rung is not None:
+                    rtm.FETCH_FALLBACKS.inc(tags={"path": rung})
+                    tracing.record_span(
+                        f"object_fetch_fallback::{oid.hex()[:12]}",
+                        "object_fetch_fallback", t0, time.time(),
+                        path=rung, attempts=len(attempted) + 1,
+                        node_id=self.node_id.hex()[:12])
+                return {"ok": True}
+
             while time.monotonic() < deadline:
                 try:
                     info = await self.controller.call("object_locations_get", {
@@ -1238,6 +1404,8 @@ class Nodelet:
                         return {"ok": True}
                     if not info["locations"] \
                             and time.monotonic() > no_loc_deadline:
+                        if attempted:
+                            break  # sources died under us: ladder report
                         return {"ok": False,
                                 "error": f"no locations for {oid.hex()}"}
                     await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
@@ -1245,17 +1413,21 @@ class Nodelet:
                 no_loc_deadline = time.monotonic() + min(timeout, 5.0)
                 await self._admit_pull(int(info.get("size", 0)), deadline)
                 for addr, nid in pairs:
+                    if first_addr is None:
+                        first_addr = addr
                     async with self._pull_sem:  # bound store churn
-                        pulled = await self._pull_from(oid, addr)
+                        pulled, retried = await self._fetch_with_retry(
+                            oid, addr, nid, deadline)
                     if pulled:
-                        # pin_primary: a drain evacuation hands PRIMARY
-                        # responsibility to us — pin the copy so LRU
-                        # eviction cannot drop what is now the sole copy
-                        await self._h_put_location(
-                            None, {"object_id": oid,
-                                   "primary": bool(data.get("pin_primary")),
-                                   "size": int(info.get("size", 0))})
-                        return {"ok": True}
+                        rung = "retry" if retried else None
+                        if addr != first_addr or failed_sources:
+                            rung = "alt_copy"
+                        return await _success(rung,
+                                              int(info.get("size", 0)))
+                    failed_sources.add(addr)
+                    if len(attempted) < 64:  # bound the failure report
+                        attempted.append(
+                            addr if nid is None else f"{addr}({nid[:8]})")
                     # Evicted replica left a stale directory entry: purge it
                     # so the no-location fast-fail above can fire.
                     if nid is not None and pulled is None:
@@ -1265,8 +1437,47 @@ class Nodelet:
                                 {"object_id": oid, "node_id": nid})
                         except rpc.RpcError:
                             pass
+                if pairs and not relay_tried:
+                    # every direct source failed this pass, but copies
+                    # exist: ask the controller for a relay through a
+                    # mutually-reachable peer (asymmetric A↛B partition)
+                    relay_tried = True
+                    try:
+                        r = await self.controller.call("object_relay", {
+                            "object_id": oid,
+                            "node_id": self.node_id.hex(),
+                            "timeout": min(
+                                20.0, max(2.0,
+                                          deadline - time.monotonic()))},
+                            timeout=30)
+                    except rpc.RpcError:
+                        r = None
+                    if r and r.get("ok"):
+                        async with self._pull_sem:
+                            pulled, _ = await self._fetch_with_retry(
+                                oid, r["addr"], r["node_id"], deadline)
+                        if pulled:
+                            return await _success(
+                                "relay", int(info.get("size", 0)))
+                        attempted.append(f"relay via {r['addr']}")
+                    elif r is not None:
+                        attempted.append(
+                            f"relay: {r.get('error', 'unavailable')}")
                 await asyncio.sleep(GlobalConfig.pull_retry_interval_s / 5)
-            return {"ok": False, "error": f"pull timeout for {oid.hex()}"}
+            # ladder exhausted — the owner's lineage reconstruction runs
+            # next; surface every source we tried (ObjectFetchError text)
+            if attempted:
+                rtm.FETCH_FALLBACKS.inc(tags={"path": "lineage"})
+                tracing.record_span(
+                    f"object_fetch_fallback::{oid.hex()[:12]}",
+                    "object_fetch_fallback", t0, time.time(),
+                    path="lineage", attempts=len(attempted),
+                    node_id=self.node_id.hex()[:12])
+                return {"ok": False, "attempted": attempted,
+                        "error": str(store_client.ObjectFetchError(
+                            oid.hex(), attempted))}
+            return {"ok": False,
+                    "error": f"pull timeout for {oid.hex()}"}
 
     async def _make_room(self, nbytes: int) -> None:
         """Spill pinned primaries oldest-first until ``nbytes`` fits (or
@@ -1323,14 +1534,67 @@ class Nodelet:
             self._peer_conns[addr] = conn
         return conn
 
-    async def _pull_from(self, oid: bytes, addr: str) -> Optional[bool]:
+    async def _fetch_with_retry(self, oid: bytes, addr: str,
+                                nid: Optional[str],
+                                deadline: float) -> tuple:
+        """Bounded full-jitter retries of ONE source — the first rung of
+        the fetch ladder.  Returns ``(result, retried)`` where result is
+        the ``_pull_from`` trivalent (True / None=absent / False)."""
+        from ..util.backoff import ExponentialBackoff
+        bo = ExponentialBackoff(base=0.05, cap=0.5)
+        attempts = max(1, GlobalConfig.object_fetch_attempts)
+        for attempt in range(attempts):
+            res = await self._pull_from(oid, addr, nid)
+            if res or res is None:
+                return res, attempt > 0
+            if attempt + 1 >= attempts:
+                break
+            delay = bo.next_delay()
+            if time.monotonic() + delay >= deadline:
+                break
+            await asyncio.sleep(delay)
+        return False, False
+
+    def _crc_ok(self, oid: bytes, expect: int) -> bool:
+        """Verify a freshly fetched local copy against the serving
+        side's checksum; a mismatch drops the copy (the ladder refetches
+        once, then lineage reconstruction takes over)."""
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            return False
+        try:
+            ok = store_client.crc32_of(view) == expect
+        finally:
+            del view
+            self.store.release(oid)
+        if not ok:
+            print(f"CRC mismatch on fetched object {oid.hex()[:12]}; "
+                  f"dropping the corrupt copy", file=sys.stderr, flush=True)
+            try:
+                self.store.delete(oid)
+            except store_client.StoreError:
+                pass
+        return ok
+
+    async def _pull_from(self, oid: bytes, addr: str,
+                         nid: Optional[str] = None) -> Optional[bool]:
         """True = pulled; None = peer definitively lacks the object (caller
-        may purge the stale directory entry); False = transient failure."""
+        may purge the stale directory entry); False = transient failure.
+        The payload CRC from ``fetch_meta`` is verified on both transfer
+        paths before the copy counts as pulled."""
+        if fi.ACTIVE is not None:
+            act = await fi.ACTIVE.async_point("object.transfer_fetch",
+                                              oid.hex(), peer=nid or addr)
+            if act is not None and act["action"] not in ("delay", "latency"):
+                # injected severed transfer path (peer-directed: A→B
+                # only, when the rule pins proc+peer)
+                return False
         try:
             peer = await self._peer(addr)
             meta = await peer.call("fetch_meta", {"object_id": oid}, timeout=10)
             if not meta.get("exists"):
                 return None
+            crc = meta.get("crc32")
             # Fast path: the C++ object plane (transfer.cc) streams the
             # payload segment-to-segment with no Python on the data path.
             tport = meta.get("transfer_port")
@@ -1338,8 +1602,11 @@ class Nodelet:
                 host = addr.rsplit(":", 1)[0]
                 try:
                     ok = await asyncio.get_event_loop().run_in_executor(
-                        None, self.store.fetch, host, tport, oid)
+                        None, lambda: self.store.fetch_retrying(
+                            host, tport, oid, attempts=2))
                     if ok:
+                        if crc is not None and not self._crc_ok(oid, crc):
+                            return False
                         rtm.OBJECTS_PULLED.inc(tags=self._mnode)
                         rtm.BYTES_PULLED.inc(meta["size"],
                                              tags=self._mnode)
@@ -1377,6 +1644,13 @@ class Nodelet:
                 del dest
                 self.store.abort(oid)
                 raise
+            if crc is not None and store_client.crc32_of(dest) != crc:
+                del dest
+                self.store.abort(oid)
+                print(f"CRC mismatch on chunked fetch of "
+                      f"{oid.hex()[:12]} from {addr}; dropping it",
+                      file=sys.stderr, flush=True)
+                return False
             del dest
             self.store.seal(oid)
             rtm.OBJECTS_PULLED.inc(tags=self._mnode)
@@ -1411,7 +1685,11 @@ class Nodelet:
         if view is None:
             return {"exists": False}
         try:
+            # payload checksum: the puller verifies it on BOTH transfer
+            # paths (native segment-to-segment and chunked RPC) — a
+            # corrupted cross-node copy is refetched, never sealed
             return {"exists": True, "size": view.nbytes,
+                    "crc32": store_client.crc32_of(view),
                     "transfer_port": self.transfer_port}
         finally:
             del view

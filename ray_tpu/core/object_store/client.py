@@ -12,8 +12,11 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
+import random
 import subprocess
 import threading
+import time
+import zlib
 from typing import Dict, List, Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -49,6 +52,29 @@ class StoreFullError(StoreError):
 
 class ObjectExistsError(StoreError):
     pass
+
+
+class ObjectFetchError(StoreError):
+    """A cross-node object fetch exhausted its retry/alternate-source
+    ladder.  Carries every attempted source with its failure, so the
+    caller (and the eventual ``ObjectLostError``) can say exactly which
+    paths were tried before lineage reconstruction became the answer."""
+
+    def __init__(self, object_id_hex: str, attempted: List[str]):
+        self.object_id_hex = object_id_hex
+        self.attempted = list(attempted)
+        tail = "; ".join(self.attempted[-4:]) or "no sources"
+        super().__init__(
+            f"fetch of {object_id_hex[:16]} failed after "
+            f"{len(self.attempted)} attempt(s): {tail}")
+
+
+def crc32_of(view) -> int:
+    """Payload checksum used by the cross-node transfer path: computed
+    by the serving side (``fetch_meta``) and verified by the puller on
+    every cross-node fetch — a corrupted payload triggers one refetch,
+    then lineage reconstruction."""
+    return zlib.crc32(view) & 0xFFFFFFFF
 
 
 def _ensure_built() -> str:
@@ -290,6 +316,27 @@ class StoreClient:
         if rc == -2:
             return False  # peer no longer has it: caller tries elsewhere
         raise StoreError(f"native fetch failed rc={rc}")
+
+    def fetch_retrying(self, host: str, port: int, object_id: bytes,
+                       attempts: int = 2, backoff_base_s: float = 0.05,
+                       backoff_cap_s: float = 0.5) -> bool:
+        """``fetch`` with bounded full-jitter retries — the first rung of
+        the alternate-path fetch ladder.  Transient transport failures
+        (``StoreError``) retry; a peer that definitively lacks the
+        object returns False immediately (the caller's next rung is
+        another directory copy, not this peer again).  Exhaustion raises
+        the typed :class:`ObjectFetchError` carrying every attempt."""
+        attempted: List[str] = []
+        for i in range(max(1, attempts)):
+            try:
+                return self.fetch(host, port, object_id)
+            except StoreError as e:
+                attempted.append(f"native {host}:{port} try{i + 1}: {e}")
+                if i + 1 < attempts:
+                    # full jitter: uniform over the capped exponential
+                    time.sleep(random.uniform(
+                        0.0, min(backoff_cap_s, backoff_base_s * (2 ** i))))
+        raise ObjectFetchError(object_id.hex(), attempted)
 
     def close(self):
         with self._close_lock:

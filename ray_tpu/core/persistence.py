@@ -213,7 +213,8 @@ class ControllerStore:
 
 def _empty_tables() -> Dict[str, Any]:
     return {"kv": {}, "actors": {}, "pgs": {}, "jobs": {},
-            "named_actors": {}, "draining_nodes": [], "ha_epoch": 0}
+            "named_actors": {}, "draining_nodes": [], "suspect_nodes": [],
+            "ha_epoch": 0}
 
 
 def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
@@ -250,6 +251,18 @@ def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
             nodes.append(rec[1])
     elif op == "drain_del":
         nodes = state.setdefault("draining_nodes", [])
+        if rec[1] in nodes:
+            nodes.remove(rec[1])
+    elif op == "suspect":
+        # a node entered SUSPECT quarantine (controller link down, peers
+        # still reach it): a restarted/promoted controller must inherit
+        # the quarantine — actors/objects stay untouched while the grace
+        # budget (restarted fresh on restore) runs down
+        nodes = state.setdefault("suspect_nodes", [])
+        if rec[1] not in nodes:
+            nodes.append(rec[1])
+    elif op == "suspect_del":
+        nodes = state.setdefault("suspect_nodes", [])
         if rec[1] in nodes:
             nodes.remove(rec[1])
     elif op == "epoch":

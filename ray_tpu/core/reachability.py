@@ -1,0 +1,113 @@
+"""Peer-reachability connectivity matrix (gray-failure detection).
+
+Each nodelet probes a few rotating peers per heartbeat interval (RPC
+port + object-transfer port) and piggybacks the results on its
+heartbeat; the controller folds those reports into this directed
+matrix.  The matrix answers the two questions binary liveness cannot:
+
+* **Is a silent node dead, or just cut off from the controller?**
+  A node whose controller link is down but that probing peers still
+  reach becomes SUSPECT (quarantined — no new placements, nothing
+  killed) instead of dead; only a node unreachable by controller *and*
+  peers takes the hard-death path (``classify_silent_node``).
+* **Which links are asymmetrically broken?**  ``unreachable_from``
+  feeds scheduling (don't place work on A when its args live on B and
+  A↛B) and the alternate-path fetch ladder (pick a relay peer both
+  sides can reach).
+
+Entries are timestamped and expire after ``fresh_s`` — stale gossip
+must not keep a dead node suspect nor a healed link blacklisted.  The
+fold is deliberately a pure, clock-injectable data structure so the
+partition suite can unit-test asymmetric / controller-only / full
+partitions without a cluster.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class ReachMatrix:
+    """Directed reachability reports: ``src`` said it can(not) reach
+    ``dst`` at time ``ts``.  Only reports younger than ``fresh_s``
+    count as evidence."""
+
+    def __init__(self, fresh_s: float = 2.5):
+        self.fresh_s = fresh_s
+        # src -> dst -> (reachable, monotonic ts of the report)
+        self._rows: Dict[str, Dict[str, Tuple[bool, float]]] = {}
+
+    def report(self, src: str, reach: Dict[str, bool],
+               now: Optional[float] = None) -> None:
+        if not reach:
+            return
+        now = time.monotonic() if now is None else now
+        row = self._rows.setdefault(src, {})
+        for dst, ok in reach.items():
+            if dst != src:
+                row[dst] = (bool(ok), now)
+
+    def forget(self, node_id: str) -> None:
+        """Drop a departed node's row and column (death/deregister)."""
+        self._rows.pop(node_id, None)
+        for row in self._rows.values():
+            row.pop(node_id, None)
+
+    def _fresh(self, ts: float, now: float) -> bool:
+        return now - ts <= self.fresh_s
+
+    def reachable_by(self, dst: str, now: Optional[float] = None) -> Set[str]:
+        """Peers with a FRESH report that they reach ``dst``."""
+        now = time.monotonic() if now is None else now
+        return {src for src, row in self._rows.items()
+                if dst in row and row[dst][0] and self._fresh(row[dst][1], now)}
+
+    def unreachable_by(self, dst: str,
+                       now: Optional[float] = None) -> Set[str]:
+        """Peers with a FRESH report that they canNOT reach ``dst``."""
+        now = time.monotonic() if now is None else now
+        return {src for src, row in self._rows.items()
+                if dst in row and not row[dst][0]
+                and self._fresh(row[dst][1], now)}
+
+    def unreachable_from(self, src: str,
+                         now: Optional[float] = None) -> Set[str]:
+        """Destinations ``src`` freshly reported it cannot reach."""
+        now = time.monotonic() if now is None else now
+        row = self._rows.get(src, {})
+        return {dst for dst, (ok, ts) in row.items()
+                if not ok and self._fresh(ts, now)}
+
+    def unreachable_pairs(self,
+                          now: Optional[float] = None
+                          ) -> List[Tuple[str, str]]:
+        """All fresh directed (src, dst) pairs currently reported
+        broken — the ``ray_tpu_peer_unreachable_pairs`` gauge."""
+        now = time.monotonic() if now is None else now
+        out = []
+        for src, row in self._rows.items():
+            for dst, (ok, ts) in row.items():
+                if not ok and self._fresh(ts, now):
+                    out.append((src, dst))
+        return sorted(out)
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Dict[str, bool]]:
+        """Fresh entries only, for observability rows."""
+        now = time.monotonic() if now is None else now
+        return {src: {dst: ok for dst, (ok, ts) in row.items()
+                      if self._fresh(ts, now)}
+                for src, row in self._rows.items()}
+
+
+def classify_silent_node(matrix: ReachMatrix, node_id: str,
+                         now: Optional[float] = None) -> str:
+    """Decide what a controller-silent node is.
+
+    ``"suspect"`` — at least one peer freshly reports reaching it: the
+    failure is controller-link-only (or asymmetric), so quarantine
+    instead of killing its actors/objects.  ``"dead"`` — no fresh peer
+    reaches it either (full partition, crashed host, or a cluster too
+    small to have peer evidence): today's hard-death path is correct.
+    """
+    return "suspect" if matrix.reachable_by(node_id, now) else "dead"

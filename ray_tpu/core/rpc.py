@@ -60,6 +60,10 @@ class Connection:
         self._send_lock = asyncio.Lock()
         self.on_close: Optional[Callable[["Connection"], None]] = None
         self.peer_info: Dict[str, Any] = {}  # set by handshake handlers
+        # "host:port" this end DIALED (empty on accepted conns) — the
+        # chaos layer's peer label, so a fault plan can sever the A→B
+        # direction of a link while B→A keeps working
+        self.peer_label: str = ""
         self._task = asyncio.ensure_future(self._read_loop())
 
     @property
@@ -91,7 +95,8 @@ class Connection:
 
     async def _chaos_send(self, method: str) -> bool:
         """Apply an armed ``rpc.send`` rule; True == drop the frame."""
-        act = await _chaos.async_point("rpc.send", method)
+        act = await _chaos.async_point("rpc.send", method,
+                                       peer=self.peer_label)
         if act is None:
             return False
         if act["action"] == "sever":
@@ -320,7 +325,9 @@ async def connect(host: str, port: int,
                 continue
         try:
             reader, writer = await asyncio.open_connection(host, port)
-            return Connection(reader, writer, handlers or {})
+            conn = Connection(reader, writer, handlers or {})
+            conn.peer_label = f"{host}:{port}"
+            return conn
         except OSError as e:
             last = e
             await asyncio.sleep(bo.next_delay())

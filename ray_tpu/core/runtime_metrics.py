@@ -117,6 +117,19 @@ CONTROLLER_FAILOVERS = m.Counter(
     "took leadership after the leader's lease lapsed | fenced: a "
     "deposed leader was epoch-fenced and stopped accepting writes)",
     ("outcome",))
+SUSPECT_TRANSITIONS = m.Counter(
+    "ray_tpu_node_suspect_transitions_total",
+    "SUSPECT-quarantine exits by outcome (rejoined: the controller link "
+    "healed inside the grace budget — actors and objects untouched, "
+    "zero restarts | died: the grace ran out, or probing peers lost the "
+    "node too, so the hard-death recovery path ran)", ("outcome",))
+FETCH_FALLBACKS = m.Counter(
+    "ray_tpu_object_fetch_fallbacks_total",
+    "Cross-node object fetches that needed a ladder rung beyond the "
+    "first direct attempt (retry: same source succeeded on a jittered "
+    "retry | alt_copy: another directory copy served it | relay: a "
+    "controller-picked mutually-reachable peer relayed it | lineage: "
+    "every path failed and reconstruction is the answer)", ("path",))
 SERVE_SESSIONS_MIGRATED = m.Counter(
     "ray_tpu_serve_sessions_migrated_total",
     "Decode sessions re-admitted on a healthy replica by the proxy-side "
@@ -234,6 +247,12 @@ KV_KEYS = m.Gauge(
 OBJECT_DIRECTORY = m.Gauge(
     "ray_tpu_object_directory_entries",
     "Objects tracked in the controller directory", ())
+PEER_UNREACHABLE_PAIRS = m.Gauge(
+    "ray_tpu_peer_unreachable_pairs",
+    "Directed node pairs (src -> dst) whose peer-reachability probe "
+    "freshly failed, per the controller's connectivity matrix — 0 in a "
+    "healthy cluster; asymmetric links count once per broken direction",
+    ())
 WAL_REPLICATION_LAG = m.Gauge(
     "ray_tpu_controller_wal_replication_lag_records",
     "WAL records the hot-standby controller is behind the leader "
@@ -293,3 +312,6 @@ def snapshot_controller(ctl: Any) -> None:
     ha = getattr(ctl, "ha", None)
     if ha is not None:
         WAL_REPLICATION_LAG.set(ha.lag())
+    reach = getattr(ctl, "reach", None)
+    if reach is not None:
+        PEER_UNREACHABLE_PAIRS.set(len(reach.unreachable_pairs()))

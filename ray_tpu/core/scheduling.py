@@ -18,12 +18,13 @@ from .task_spec import EPS, ResourceSet
 
 class NodeView:
     __slots__ = ("node_id", "addr", "available", "total", "alive", "labels",
-                 "version", "draining")
+                 "version", "draining", "suspect", "unreachable")
 
     def __init__(self, node_id: str, addr: str, available: Dict[str, float],
                  total: Dict[str, float], alive: bool = True,
                  labels: Optional[Dict[str, str]] = None,
-                 version: int = 0, draining: bool = False):
+                 version: int = 0, draining: bool = False,
+                 suspect: bool = False, unreachable=None):
         self.node_id = node_id
         self.addr = addr
         self.available = ResourceSet(available)
@@ -40,21 +41,41 @@ class NodeView:
         # finishes, objects stay fetchable — but never a target for new
         # leases, actor placements, or PG bundles.
         self.draining = draining
+        # SUSPECT: the controller's link to the node is down but probing
+        # peers still reach it (gray failure / controller-only
+        # partition).  Quarantined — no new leases, placements, or
+        # serve routes — but its actors and objects are untouched; it
+        # rejoins intact when the link heals inside the grace budget.
+        self.suspect = suspect
+        # Peers this node freshly reported it cannot reach (directed:
+        # this-node -> peer).  Scheduling avoids placing a task here
+        # when its args live only on an unreachable peer.
+        self.unreachable: set = set(unreachable or ())
 
     def to_wire(self):
         return {"id": self.node_id, "addr": self.addr,
                 "avail": self.available.to_dict(), "total": self.total.to_dict(),
                 "alive": self.alive, "labels": self.labels,
-                "ver": self.version, "draining": self.draining}
+                "ver": self.version, "draining": self.draining,
+                "sus": self.suspect, "unreach": sorted(self.unreachable)}
 
     @classmethod
     def from_wire(cls, d):
         return cls(d["id"], d["addr"], d["avail"], d["total"], d["alive"],
-                   d.get("labels"), d.get("ver", 0), d.get("draining", False))
+                   d.get("labels"), d.get("ver", 0), d.get("draining", False),
+                   d.get("sus", False), d.get("unreach"))
 
 
 def is_feasible(view: NodeView, request: ResourceSet) -> bool:
-    return view.alive and not view.draining and view.total.fits(request)
+    return view.alive and not view.draining and not view.suspect \
+        and view.total.fits(request)
+
+
+def _links_ok(view: NodeView, arg_nodes) -> bool:
+    """True when ``view`` can fetch from every node in ``arg_nodes``
+    (per its own fresh reachability reports)."""
+    return not any(b != view.node_id and b in view.unreachable
+                   for b in arg_nodes)
 
 
 def hybrid_policy(
@@ -64,6 +85,7 @@ def hybrid_policy(
     spread_threshold: float = 0.5,
     strategy: Optional[dict] = None,
     rng: Optional[random.Random] = None,
+    arg_nodes: Optional[set] = None,
 ) -> Optional[str]:
     """Pick a node id for ``request``, or None if infeasible everywhere.
 
@@ -73,8 +95,21 @@ def hybrid_policy(
     under-utilized cluster packs (ties broken toward the local node, then
     lexical node id for determinism), and spreads once utilization passes the
     threshold.
+
+    ``arg_nodes``: nodes the task's arguments live on.  Candidates that
+    freshly reported one of them unreachable (connectivity matrix via
+    the view sync) are avoided — placing there would wedge the task's
+    arg fetch behind a severed link.  The filter is SOFT: if it would
+    empty the candidate set (stale gossip, full partition) placement
+    proceeds unfiltered and the fetch ladder's relay path is the
+    safety net.  Hard node affinity is never filtered.
     """
     strategy = strategy or {}
+    if arg_nodes and not strategy.get("node_id"):
+        ok_views = {nid: v for nid, v in views.items()
+                    if _links_ok(v, arg_nodes)}
+        if ok_views:
+            views = ok_views
     if strategy.get("node_id"):
         nv = views.get(strategy["node_id"])
         if nv is not None and is_feasible(nv, request):
@@ -89,7 +124,7 @@ def hybrid_policy(
         strategy = {k: v for k, v in strategy.items()
                     if k not in ("node_id", "soft")}
         return hybrid_policy(views, request, local_node_id,
-                             spread_threshold, strategy, rng)
+                             spread_threshold, strategy, rng, arg_nodes)
     if strategy.get("spread"):
         # Round-robin over feasible nodes, preferring available ones.
         avail = [n for n in views.values()
@@ -134,16 +169,34 @@ def pack_bundles(
     fill.  STRICT_PACK: all on one node.  SPREAD: best-effort distinct nodes.
     STRICT_SPREAD: must be distinct nodes.  Returns None if unplaceable now.
     (reference: src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc)
+
+    Bundles must land on MUTUALLY REACHABLE nodes: a gang spanning an
+    asymmetric partition (A↛B per the connectivity matrix) could place
+    but never rendezvous, so a candidate that cannot reach — or is not
+    reached by — an already-chosen node is skipped (unplaceable now; the
+    matrix entries expire when the link heals).
     """
     reqs = [ResourceSet(b) for b in bundles]
-    nodes = [n for n in views.values() if n.alive and not n.draining]
+    nodes = [n for n in views.values()
+             if n.alive and not n.draining and not n.suspect]
     scratch = {n.node_id: n.available.copy() for n in nodes}
+    by_id = {n.node_id: n for n in nodes}
 
     def fits(nid, req):
         return scratch[nid].fits(req)
 
     def take(nid, req):
         scratch[nid].acquire(req)
+
+    def reachable_with(nid, placed) -> bool:
+        n = by_id[nid]
+        for pid in placed:
+            if pid is None or pid == nid:
+                continue
+            p = by_id[pid]
+            if pid in n.unreachable or nid in p.unreachable:
+                return False
+        return True
 
     if strategy == "STRICT_PACK":
         for n in nodes:
@@ -157,7 +210,8 @@ def pack_bundles(
         for i, req in enumerate(reqs):
             placed = False
             for n in order:
-                if fits(n.node_id, req):
+                if fits(n.node_id, req) \
+                        and reachable_with(n.node_id, placement):
                     take(n.node_id, req)
                     placement[i] = n.node_id
                     placed = True
@@ -174,6 +228,8 @@ def pack_bundles(
         placed = False
         for n in candidates:
             if strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                continue
+            if not reachable_with(n.node_id, placement):
                 continue
             if fits(n.node_id, req):
                 take(n.node_id, req)

@@ -43,6 +43,13 @@ class ObjectLostError(RayTpuError):
         super().__init__(f"object {object_id_hex[:16]} is lost: {detail}")
 
 
+# A cross-node fetch that exhausted its retry / alternate-copy / relay
+# ladder raises this typed error carrying every attempted source; it is
+# defined next to the store client (the layer that fetches) and
+# re-exported here as user-facing API.
+from .core.object_store.client import ObjectFetchError  # noqa: E402,F401
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

@@ -87,6 +87,31 @@ def cmd_status(args) -> None:
     _connect(args)
     summary = state.cluster_summary()
     print(json.dumps(summary, indent=2, default=str))
+    # per-node health table: alive|suspect|draining|dead state plus the
+    # failure-detection knobs in force (heartbeat timeout, suspect
+    # grace, probe fanout) and any severed peer links
+    rows = state.list_nodes()
+    if rows:
+        h = (rows[0].get("health") or {})
+        print(f"\nheartbeat_timeout_s={h.get('heartbeat_timeout_s', '-')} "
+              f"suspect_grace_s={h.get('suspect_grace_s', '-')} "
+              f"peer_probe_fanout={h.get('peer_probe_fanout', '-')}")
+        print(f"{'NODE':<14} {'STATE':<9} {'HB_AGE':>7}  DETAIL")
+        for n in rows:
+            detail = ""
+            if n.get("state") == "SUSPECT":
+                detail = (f"suspect_for={n.get('suspect_for_s', '?')}s "
+                          f"peers_reaching="
+                          f"{[p[:8] for p in n.get('peers_reaching', [])]}")
+            if n.get("unreachable_peers"):
+                detail += (" cannot_reach="
+                           f"{[p[:8] for p in n['unreachable_peers']]}")
+            drain = n.get("drain")
+            if drain:
+                detail += f" drain={drain.get('phase', '?')}"
+            hb = (n.get("health") or {}).get("heartbeat_age_s", "-")
+            print(f"{n['id'][:12]:<14} {n.get('state', '?'):<9} "
+                  f"{hb:>7}  {detail}")
     ray_tpu.shutdown()
 
 

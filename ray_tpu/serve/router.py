@@ -51,11 +51,19 @@ class Router:
 
     def _on_node_event(self, data) -> None:
         ev = data.get("event")
-        if ev in ("dead", "draining"):
+        if ev in ("dead", "draining", "suspect"):
+            # SUSPECT (gray failure / controller-only partition) is
+            # routed around exactly like dead/draining — but the node's
+            # replicas are NOT torn down, so a rejoin restores them
             nid = data.get("node_id")
             if nid:
                 with self._lock:
                     self._down_nodes.add(nid)
+        elif ev == "rejoined":
+            nid = data.get("node_id")
+            if nid:
+                with self._lock:
+                    self._down_nodes.discard(nid)
         elif ev == "added":
             nid = (data.get("node") or {}).get("id")
             if nid:

@@ -14,9 +14,11 @@ from .api import _ensure_initialized
 
 def list_nodes() -> List[Dict[str, Any]]:
     """Node membership rows.  Each row carries ``state`` (ALIVE |
-    DRAINING | DEAD) and, while a drain is in progress, a ``drain``
-    progress dict (phase, in-flight tasks left, objects left to
-    evacuate)."""
+    SUSPECT | DRAINING | DEAD), a ``health`` dict (heartbeat age plus
+    the heartbeat-timeout / suspect-grace / probe-fanout knobs in
+    force), ``unreachable_peers`` when the node reported severed links,
+    and, while a drain or suspect quarantine is in progress, its
+    progress (``drain`` dict / ``suspect_for_s`` + ``peers_reaching``)."""
     return _ensure_initialized().controller.call("list_nodes")
 
 
@@ -51,6 +53,11 @@ def summarize_nodes() -> Dict[str, Any]:
     return {
         "total": len(ns),
         "alive": sum(1 for n in ns if n.get("alive")),
+        "suspect": sum(1 for n in ns if n.get("state") == "SUSPECT"),
+        "draining": sum(1 for n in ns if n.get("state") == "DRAINING"),
+        "unreachable_pairs": sorted(
+            (n["id"][:12], dst[:12]) for n in ns
+            for dst in n.get("unreachable_peers", ())),
         "resources": {
             k: sum(n["total"].get(k, 0) for n in ns if n.get("alive"))
             for n in ns for k in n.get("total", {})

@@ -193,6 +193,13 @@ class BackendExecutor:
                 self._pushed_draining.add(nid)
             elif event == "dead":
                 self._pushed_dead.add(nid)
+            # "suspect" is deliberately NOT a repair trigger: the node's
+            # controller link is down but peers still reach it, its rank
+            # is alive and stepping (collectives run peer-to-peer), and
+            # it rejoins intact inside the grace budget — tearing the
+            # gang down for a gray failure is exactly the over-reaction
+            # the quarantine exists to prevent.  A suspect that really
+            # dies escalates to a "dead" event, which repairs as usual.
 
     def _gang_nodes(self) -> Set[str]:
         return {n for n in self._node_of_worker.values() if n}

@@ -4,13 +4,20 @@ Failure is a first-class, *seeded* test input: a fault **plan** is a JSON
 list of rules
 
     {"site": "rpc.send",                 # where to inject
-     "match": {"nth": 3} | {"prob": 0.1, "seed": 7} | {"regex": "hb.*"},
+     "match": {"nth": 3} | {"prob": 0.1, "seed": 7} | {"regex": "hb.*"}
+              | {"peer": "ab12"},        # peer-directed sites only: fire
+                                         #   only toward matching peers —
+                                         #   severs A→B while B→A works
      "action": "drop",                   # what to do (site-dependent)
      "delay_s": 0.05,                    # for delay/latency + kill delays
      "once": true,                       # fire once CLUSTER-wide (claimed
                                          #   through the controller)
      "max_fires": 2,                     # per-process fire cap
-     "proc": "worker"}                   # only in this process kind
+     "proc": "worker"}                   # only in this process kind; a
+                                         #   "nodelet:<node-id-prefix>"
+                                         #   form pins the rule to ONE
+                                         #   node's process (asymmetric
+                                         #   partitions need a side)
 
 distributed to every process via the controller KV (namespace ``chaos``,
 pubsub channel ``chaos``, ``ray-tpu chaos apply``) or armed at bootstrap
@@ -76,7 +83,25 @@ site                        actions
                             lease renewal — enough in a row and the
                             standby promotes itself (forced failover
                             under a live TCP connection)
+``object.transfer_fetch``   any action fails that cross-node object
+                            fetch attempt at the PULLING nodelet (native
+                            and chunked paths both) — with a ``peer``
+                            matcher + a ``proc`` node pin this severs
+                            the A→B transfer path only, driving the
+                            alternate-path fetch ladder (retry →
+                            alt copy → relay → lineage)
+``nodelet.peer_probe``      any action makes that peer-reachability
+                            probe report the peer unreachable — feeds
+                            false negatives into the connectivity
+                            matrix the suspect/quarantine logic folds
 ==========================  =====================================================
+
+Peer-directed sites (``rpc.send``, ``object.transfer_fetch``,
+``nodelet.peer_probe``) evaluate an optional ``match.peer`` regex
+against the remote side's label (dialed ``host:port`` for RPC, peer
+node id for transfer/probe) — a rule can sever the A→B direction of a
+link while B→A keeps working, the asymmetric partitions real networks
+produce.
 
 Zero-cost when disabled: every hot path guards with one module-level
 ``None`` check (``fi.ACTIVE is not None``, or the ``_chaos`` hook the
@@ -129,11 +154,13 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "train.repair_restore": frozenset({"error", "fail"}),
     "controller.wal_replicate": frozenset({"drop"}),
     "controller.lease_renew": None,
+    "object.transfer_fetch": None,
+    "nodelet.peer_probe": None,
 }
 _UNIVERSAL_ACTIONS = frozenset({"delay", "latency"})
 _RULE_KEYS = frozenset({"site", "action", "match", "delay_s", "once",
                         "max_fires", "proc", "id", "seed"})
-_MATCH_KEYS = frozenset({"nth", "prob", "seed", "regex"})
+_MATCH_KEYS = frozenset({"nth", "prob", "seed", "regex", "peer"})
 
 #: The armed plan, or None when the chaos layer is disabled.  Hot paths
 #: outside the import-cycle modules guard with ``fi.ACTIVE is not None``.
@@ -157,6 +184,9 @@ class FaultRule:
         self.nth = m.get("nth")
         self.prob = m.get("prob")
         self.regex = re.compile(m["regex"]) if m.get("regex") else None
+        # peer-directed filter: only fire toward matching remote peers
+        # (severs one DIRECTION of a link — asymmetric partitions)
+        self.peer = re.compile(m["peer"]) if m.get("peer") else None
         self.seed = int(m.get("seed", d.get("seed", 0)))
         self.delay_s = float(d.get("delay_s", 0.05))
         self.max_fires = d.get("max_fires")
@@ -167,13 +197,17 @@ class FaultRule:
         self.hits = 0
         self.fires = 0
 
-    def matches(self, key: str, proc_kind: str) -> bool:
+    def matches(self, key: str, proc_kind: str, proc_node: str = "",
+                peer: str = "") -> bool:
         """One eligible hit of this rule's site; True when the fault
-        fires.  Order matters for determinism: the regex filters which
-        calls count as hits, then nth/prob decide on the hit sequence."""
-        if self.proc and self.proc != proc_kind:
+        fires.  Order matters for determinism: the regex/peer filters
+        decide which calls count as hits, then nth/prob decide on the
+        hit sequence."""
+        if self.proc and not self._proc_matches(proc_kind, proc_node):
             return False
         if self.regex is not None and not self.regex.search(key or ""):
+            return False
+        if self.peer is not None and not self.peer.search(peer or ""):
             return False
         self.hits += 1
         if self.once and self.fires >= 1:
@@ -189,6 +223,18 @@ class FaultRule:
             return False
         self.fires += 1
         return True
+
+    def _proc_matches(self, proc_kind: str, proc_node: str) -> bool:
+        """``proc`` filter: a bare kind ("nodelet") matches every process
+        of that kind; ``"nodelet:<node-id-prefix>"`` pins the rule to
+        the process running on ONE node (the tracing identity stores 8
+        hex chars, so prefixes compare on their overlap)."""
+        if ":" not in self.proc:
+            return self.proc == proc_kind
+        kind, _, pref = self.proc.partition(":")
+        if kind != proc_kind or not pref or not proc_node:
+            return False
+        return pref.startswith(proc_node) or proc_node.startswith(pref)
 
     def to_act(self) -> Dict[str, Any]:
         return {"action": self.action, "delay_s": self.delay_s,
@@ -206,17 +252,21 @@ class FaultPlan:
             r = FaultRule(i, d)
             self.rules.setdefault(r.site, []).append(r)
 
-    def point(self, site: str, key: str = "") -> Optional[Dict[str, Any]]:
+    def point(self, site: str, key: str = "",
+              peer: str = "") -> Optional[Dict[str, Any]]:
         """Evaluate the plan at one injection site.  Returns the action
         dict when a rule fires (counting the metric and recording a
-        trace span), else None.  Sync and loop-safe."""
+        trace span), else None.  Sync and loop-safe.  ``peer`` labels
+        the remote side at peer-directed sites (dialed host:port, peer
+        node id) for ``match.peer`` rules."""
         rules = self.rules.get(site)
         if not rules:
             return None
         kind = tracing._proc.get("kind", "")
+        node = tracing._proc.get("node", "")
         for r in rules:
             with _lock:
-                fired = r.matches(key, kind)
+                fired = r.matches(key, kind, node, peer)
             if fired:
                 _count(site, r.action)
                 now = time.time()
@@ -226,12 +276,12 @@ class FaultPlan:
                 return r.to_act()
         return None
 
-    async def async_point(self, site: str,
-                          key: str = "") -> Optional[Dict[str, Any]]:
+    async def async_point(self, site: str, key: str = "",
+                          peer: str = "") -> Optional[Dict[str, Any]]:
         """``point`` for async sites: delay/latency actions sleep here
         (non-blocking); the action dict is returned either way so the
         caller applies drop/sever/error semantics itself."""
-        act = self.point(site, key)
+        act = self.point(site, key, peer)
         if act is not None and act["action"] in ("delay", "latency"):
             import asyncio
             await asyncio.sleep(max(0.0, act["delay_s"]))
@@ -364,7 +414,8 @@ def validate_plan(plan: Any) -> List[str]:
             for k in m:
                 if k not in _MATCH_KEYS:
                     issues.append(f"{tag}: unknown matcher {k!r} "
-                                  f"(known: nth, prob, seed, regex)")
+                                  f"(known: nth, prob, seed, regex, "
+                                  f"peer)")
             if "nth" in m and "prob" in m:
                 issues.append(f"{tag}: 'nth' and 'prob' conflict — one "
                               f"rule matches by count OR by draw, not "
@@ -389,6 +440,12 @@ def validate_plan(plan: Any) -> List[str]:
                 except (re.error, TypeError) as e:
                     issues.append(f"{tag}: bad regex "
                                   f"{m.get('regex')!r}: {e}")
+            if m.get("peer") is not None:
+                try:
+                    re.compile(m["peer"])
+                except (re.error, TypeError) as e:
+                    issues.append(f"{tag}: bad peer matcher "
+                                  f"{m.get('peer')!r}: {e}")
         delay = d.get("delay_s")
         if delay is not None and (not isinstance(delay, (int, float))
                                   or isinstance(delay, bool)

@@ -1,0 +1,472 @@
+"""Partition tolerance & gray-failure handling.
+
+Real networks produce messier failures than "a process died": asymmetric
+links (A→B severed while B→A works), controller-only partitions (every
+peer reaches a node the controller cannot), and slow-but-alive hosts.
+This suite covers the three layers that absorb them:
+
+* **Connectivity matrix** (core/reachability.py): nodelets probe a few
+  rotating peers per heartbeat interval and piggyback the results; the
+  controller folds them into a directed, freshness-bounded matrix.
+* **Suspect/quarantine** (controller): a node whose controller link is
+  down but that peers still reach becomes SUSPECT — no new placements,
+  serve routers skip it, nothing is killed — and rejoins with zero
+  restarts when the link heals inside ``suspect_grace_s``; only a node
+  unreachable by controller AND peers takes the hard-death path.
+* **Alternate-path fetch ladder** (nodelet `_h_pull`): bounded
+  full-jitter retries → another directory copy → controller-mediated
+  relay through a mutually-reachable peer → lineage reconstruction,
+  with a payload CRC verified on every cross-node fetch.
+
+Tier-1: matrix-fold / ladder / scheduling units, the controller-link
+blackhole scenario (node stays SUSPECT, its named actor survives, it
+rejoins with zero restarts, ×2 seeds) and the grace-exhaustion death.
+`slow`: an asymmetric A↛B transfer partition under a task wave — zero
+task re-executions, completed via the relay rung, ×2 seeds.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.driver import get_global_core
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+slow = pytest.mark.slow
+
+
+def _wait_for(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+def _wait_view(n_nodes, timeout=30.0):
+    core = get_global_core()
+    _wait_for(
+        lambda: sum(1 for v in core.nodelet.call(
+            "stats", timeout=10)["cluster_view"].values()
+            if v.get("alive")) >= n_nodes,
+        timeout, f"view sync of {n_nodes} nodes")
+
+
+def _node_state(node_id):
+    return next((n.get("state") for n in state.list_nodes()
+                 if n["id"] == node_id), None)
+
+
+def _metric_sum(text, name, tag=""):
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#") \
+                and tag in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+# --------------------------------------------- connectivity-matrix units
+
+def test_matrix_fold_asymmetric_partition():
+    """A↛B while B→A works: the matrix keeps the DIRECTED evidence —
+    unreachable_from(A) names B, B is still reached by A's peers."""
+    from ray_tpu.core.reachability import ReachMatrix
+    m = ReachMatrix(fresh_s=2.0)
+    m.report("A", {"B": False, "C": True}, now=100.0)
+    m.report("B", {"A": True, "C": True}, now=100.0)
+    m.report("C", {"A": True, "B": True}, now=100.0)
+    assert m.unreachable_from("A", now=100.5) == {"B"}
+    assert m.unreachable_from("B", now=100.5) == set()
+    # B is still reached by C (and reports reaching A): one broken
+    # DIRECTED pair, not a dead node
+    assert m.unreachable_pairs(now=100.5) == [("A", "B")]
+    assert m.reachable_by("B", now=100.5) == {"C"}
+    # freshness: the evidence expires instead of blacklisting forever
+    assert m.unreachable_pairs(now=103.0) == []
+    assert m.unreachable_from("A", now=103.0) == set()
+
+
+def test_matrix_controller_only_partition_is_suspect():
+    """The controller lost its link to X but every peer reaches X: the
+    silent-node classification must be SUSPECT, not dead."""
+    from ray_tpu.core.reachability import ReachMatrix, classify_silent_node
+    m = ReachMatrix(fresh_s=2.0)
+    m.report("A", {"X": True}, now=50.0)
+    m.report("B", {"X": True}, now=50.0)
+    assert classify_silent_node(m, "X", now=50.5) == "suspect"
+    # stale evidence does not keep a node suspect
+    assert classify_silent_node(m, "X", now=60.0) == "dead"
+
+
+def test_matrix_full_partition_is_dead():
+    """Controller silent AND peers freshly failing to reach X (or no
+    peer evidence at all — single-node cluster): hard death."""
+    from ray_tpu.core.reachability import ReachMatrix, classify_silent_node
+    m = ReachMatrix(fresh_s=2.0)
+    m.report("A", {"X": False}, now=10.0)
+    m.report("B", {"X": False}, now=10.0)
+    assert classify_silent_node(m, "X", now=10.5) == "dead"
+    assert classify_silent_node(ReachMatrix(2.0), "X") == "dead"
+    # forget() drops row and column (node deregistered)
+    m.report("X", {"A": False}, now=10.0)
+    m.forget("X")
+    assert m.unreachable_pairs(now=10.5) == []
+
+
+def test_suspect_wal_roundtrip(tmp_path):
+    """SUSPECT quarantine is WAL-persisted so a restarted or promoted
+    controller inherits it (grace restarts, nothing killed meanwhile)."""
+    from ray_tpu.core.persistence import ControllerStore
+    st = ControllerStore(str(tmp_path), fsync=False)
+    st.append("suspect", "node_a")
+    st.append("suspect", "node_b")
+    st.append("suspect_del", "node_a")
+    tables = st.load()
+    assert tables["suspect_nodes"] == ["node_b"]
+    st.snapshot(tables)
+    st.append("suspect", "node_c")
+    st.close()
+    st2 = ControllerStore(str(tmp_path), fsync=False)
+    assert st2.load()["suspect_nodes"] == ["node_b", "node_c"]
+
+
+# ------------------------------------------------------ scheduling units
+
+def test_scheduling_skips_suspect_and_unreachable_nodes():
+    from ray_tpu.core.scheduling import NodeView, hybrid_policy, pack_bundles
+    from ray_tpu.core.task_spec import ResourceSet
+    views = {"a": NodeView("a", "h:1", {"CPU": 4}, {"CPU": 4}),
+             "b": NodeView("b", "h:2", {"CPU": 4}, {"CPU": 4},
+                           suspect=True)}
+    req = ResourceSet({"CPU": 1})
+    # suspect nodes are never lease/placement targets...
+    for _ in range(4):
+        assert hybrid_policy(views, req, None) == "a"
+    assert pack_bundles(views, [{"CPU": 2}, {"CPU": 2}],
+                        "STRICT_SPREAD") is None
+    # ...and the flags survive the wire round trip (view sync)
+    nv = NodeView.from_wire(views["b"].to_wire())
+    assert nv.suspect
+    views["b"].unreachable = {"a"}
+    assert NodeView.from_wire(views["b"].to_wire()).unreachable == {"a"}
+
+    # arg-locality: node c freshly reported it cannot reach b, so a task
+    # whose args live on b avoids c (soft — placement still proceeds
+    # when every candidate is filtered)
+    views = {"b": NodeView("b", "h:2", {"CPU": 0}, {"CPU": 4}),
+             "c": NodeView("c", "h:3", {"CPU": 4}, {"CPU": 4},
+                           unreachable={"b"}),
+             "d": NodeView("d", "h:4", {"CPU": 4}, {"CPU": 4})}
+    assert hybrid_policy(views, req, None, arg_nodes={"b"}) == "d"
+    # the filter never beats hard affinity, and falls back when it
+    # would empty the candidate set entirely
+    assert hybrid_policy(views, req, None, strategy={"node_id": "c"},
+                         arg_nodes={"b"}) == "c"
+    only_c = {"c": views["c"]}
+    assert hybrid_policy(only_c, req, None, arg_nodes={"b"}) == "c"
+
+
+def test_pg_packing_requires_mutual_reachability():
+    """A gang spanning an asymmetric partition could place but never
+    rendezvous: bundles must land on mutually reachable nodes."""
+    from ray_tpu.core.scheduling import NodeView, pack_bundles
+    views = {"a": NodeView("a", "h:1", {"CPU": 2}, {"CPU": 2},
+                           unreachable={"b"}),
+             "b": NodeView("b", "h:2", {"CPU": 2}, {"CPU": 2}),
+             "c": NodeView("c", "h:3", {"CPU": 2}, {"CPU": 2})}
+    got = pack_bundles(views, [{"CPU": 2}, {"CPU": 2}], "STRICT_SPREAD")
+    assert got is not None and set(got) != {"a", "b"}, got
+    # with only the partitioned pair available the PG stays PENDING
+    two = {k: v for k, v in views.items() if k in ("a", "b")}
+    assert pack_bundles(two, [{"CPU": 2}, {"CPU": 2}],
+                        "STRICT_SPREAD") is None
+    # healed link (fresh matrix entries expired -> empty set): places
+    views["a"].unreachable = set()
+    assert pack_bundles(two, [{"CPU": 2}, {"CPU": 2}],
+                        "STRICT_SPREAD") is not None
+
+
+# ------------------------------------------------- chaos layer units
+
+def test_chaos_validate_knows_partition_sites():
+    from ray_tpu.util import fault_injection as fi
+    plan = [
+        {"site": "object.transfer_fetch", "action": "error",
+         "proc": "nodelet:ab12cd34", "match": {"peer": "^ef56"}},
+        {"site": "nodelet.peer_probe", "action": "fail",
+         "match": {"nth": 2}},
+    ]
+    assert fi.validate_plan(plan) == []
+    issues = fi.validate_plan(
+        [{"site": "object.transfer_fetch", "action": "error",
+          "match": {"peer": "["}}])
+    assert any("peer" in i for i in issues), issues
+
+
+def test_chaos_peer_and_proc_node_matchers():
+    """``match.peer`` severs ONE direction of a link; ``proc:
+    "nodelet:<prefix>"`` pins a rule to one node's process."""
+    from ray_tpu.util.fault_injection import FaultRule
+    r = FaultRule(0, {"site": "object.transfer_fetch", "action": "error",
+                      "match": {"peer": "^bbbb"}})
+    assert not r.matches("oid1", "nodelet", "aaaa1111", peer="cccc2222")
+    assert r.matches("oid1", "nodelet", "aaaa1111", peer="bbbb2222")
+    # peer filter gates eligibility BEFORE hit counting (determinism)
+    r2 = FaultRule(0, {"site": "object.transfer_fetch", "action": "error",
+                       "match": {"peer": "^bbbb", "nth": 1}})
+    assert not r2.matches("x", "nodelet", "", peer="cccc")
+    assert r2.matches("x", "nodelet", "", peer="bbbb")  # first eligible hit
+    # proc node pin: kind must match and node prefixes must agree
+    r3 = FaultRule(0, {"site": "nodelet.peer_probe", "action": "fail",
+                       "proc": "nodelet:aaaa1111"})
+    assert r3.matches("p", "nodelet", "aaaa1111", peer="")
+    assert r3.matches("p", "nodelet", "aaaa11", peer="")  # 8-char identity
+    assert not r3.matches("p", "nodelet", "bbbb2222", peer="")
+    assert not r3.matches("p", "worker", "aaaa1111", peer="")
+
+
+# ------------------------------------------------- fetch-ladder units
+
+def test_fetch_retrying_typed_error_and_crc(tmp_path):
+    from ray_tpu.core.object_store import client as sc
+    path = str(tmp_path / "seg")
+    sc.create_segment(path, 4 * 1024 * 1024)
+    cl = sc.StoreClient(path)
+    try:
+        oid = b"o" * sc.ID_LEN
+        payload = memoryview(b"x" * 1000)
+        cl.put_parts(oid, [payload])
+        # crc helper matches an independent computation
+        import zlib
+        view = cl.get(oid)
+        try:
+            assert sc.crc32_of(view) == zlib.crc32(b"x" * 1000) & 0xFFFFFFFF
+        finally:
+            del view
+            cl.release(oid)
+
+        # exhausted retries raise the TYPED error carrying every attempt
+        calls = []
+
+        def flaky(host, port, object_id):
+            calls.append(1)
+            raise sc.StoreError("link reset")
+
+        cl.fetch = flaky
+        with pytest.raises(sc.ObjectFetchError) as ei:
+            cl.fetch_retrying("10.0.0.9", 7001, oid, attempts=3,
+                              backoff_base_s=0.001, backoff_cap_s=0.002)
+        assert len(calls) == 3
+        assert len(ei.value.attempted) == 3
+        assert "10.0.0.9:7001" in ei.value.attempted[0]
+        assert ei.value.object_id_hex == oid.hex()
+
+        # transient failure then success: the retry rung absorbs it
+        calls.clear()
+
+        def flaky_once(host, port, object_id):
+            calls.append(1)
+            if len(calls) == 1:
+                raise sc.StoreError("link reset")
+            return True
+
+        cl.fetch = flaky_once
+        assert cl.fetch_retrying("h", 1, oid, attempts=3,
+                                 backoff_base_s=0.001) is True
+        # a peer that definitively LACKS the object is not retried —
+        # the next rung is another directory copy, not this peer
+        calls.clear()
+        cl.fetch = lambda h, p, o: (calls.append(1), False)[1]
+        assert cl.fetch_retrying("h", 1, oid, attempts=3) is False
+        assert len(calls) == 1
+    finally:
+        cl.close()
+
+
+# ------------------------------- tier-1 e2e: controller-only partition
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_controller_partition_suspect_then_rejoin(seed):
+    """The acceptance scenario: blackhole ONE node's heartbeats (chaos
+    site ``nodelet.heartbeat`` — the controller-only partition) while
+    its peers keep reaching it.  The node must go SUSPECT (not dead),
+    its named actor must survive and keep answering, and when the
+    blackhole lifts the node rejoins with ZERO restarts."""
+    from ray_tpu import chaos
+    cluster = Cluster(heartbeat_timeout_s=2.0)
+    try:
+        n1 = cluster.add_node(num_cpus=4)
+        n2 = cluster.add_node(num_cpus=4)
+        n3 = cluster.add_node(num_cpus=4)
+        cluster.connect(n1)
+        _wait_view(3)
+
+        @ray_tpu.remote
+        class Canary:
+            def __init__(self):
+                self.n = 0
+
+            def ping(self):
+                self.n += 1
+                return self.n
+
+        aff = NodeAffinitySchedulingStrategy(node_id=n2.node_id, soft=True)
+        canary = Canary.options(name="canary", num_cpus=0.5,
+                                scheduling_strategy=aff).remote()
+        assert ray_tpu.get(canary.ping.remote(), timeout=60.0) == 1
+        row = next(r for r in state.list_actors()
+                   if r.get("name") == "canary")
+        assert row["node_id"] == n2.node_id, \
+            "precondition: the canary must live on the partition target"
+
+        # give the probe gossip a beat to build fresh peer evidence,
+        # then blackhole ~10 heartbeats (5s silence > 2s timeout, well
+        # under the 15s suspect grace)
+        time.sleep(1.5)
+        chaos.apply([{"site": "nodelet.heartbeat", "action": "drop",
+                      "match": {"regex": "^" + n2.node_id},
+                      "max_fires": 10, "seed": seed}])
+        _wait_for(lambda: _node_state(n2.node_id) == "SUSPECT", 15.0,
+                  "node to enter SUSPECT quarantine")
+        # quarantined, NOT killed: the actor still answers (driver and
+        # peers reach the node fine; only the controller link is dark)
+        assert ray_tpu.get(canary.ping.remote(), timeout=30.0) == 2
+        rows = state.list_nodes()
+        srow = next(r for r in rows if r["id"] == n2.node_id)
+        assert srow["health"]["heartbeat_timeout_s"] == 2.0
+        assert srow["health"]["suspect_grace_s"] > 0
+        assert "suspect_for_s" in srow
+
+        # the blackhole lifts (max_fires exhausted): rejoin, intact
+        _wait_for(lambda: _node_state(n2.node_id) == "ALIVE", 30.0,
+                  "suspect node to rejoin")
+        assert ray_tpu.get(canary.ping.remote(), timeout=30.0) == 3, \
+            "actor state must survive the quarantine (no restart)"
+        row = next(r for r in state.list_actors()
+                   if r.get("name") == "canary")
+        assert row["state"] == "ALIVE" and row["num_restarts"] == 0 \
+            and row["node_id"] == n2.node_id
+        text = state.cluster_metrics_text()
+        assert _metric_sum(text, "ray_tpu_node_suspect_transitions_total",
+                           'outcome="rejoined"') >= 1, text[:2000]
+        assert "# TYPE ray_tpu_peer_unreachable_pairs gauge" in text
+    finally:
+        try:
+            chaos.clear()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def test_suspect_grace_exhausted_takes_death_path(monkeypatch):
+    """A quarantine is a grace budget, not amnesty: a node that never
+    heals its controller link is declared dead once suspect_grace_s
+    runs out, and recovery proceeds on today's hard-death path."""
+    from ray_tpu import chaos
+    monkeypatch.setenv("RAY_TPU_SUSPECT_GRACE_S", "3.0")
+    cluster = Cluster(heartbeat_timeout_s=2.0)
+    try:
+        n1 = cluster.add_node(num_cpus=4)
+        n2 = cluster.add_node(num_cpus=4)
+        n3 = cluster.add_node(num_cpus=4)
+        cluster.connect(n1)
+        _wait_view(3)
+        time.sleep(1.5)  # fresh peer evidence first
+        chaos.apply([{"site": "nodelet.heartbeat", "action": "drop",
+                      "match": {"regex": "^" + n2.node_id},
+                      "max_fires": 500}])
+        _wait_for(lambda: _node_state(n2.node_id) == "SUSPECT", 15.0,
+                  "node to enter SUSPECT quarantine")
+        _wait_for(lambda: _node_state(n2.node_id) == "DEAD", 20.0,
+                  "grace exhaustion to declare the node dead")
+        text = state.cluster_metrics_text()
+        assert _metric_sum(text, "ray_tpu_node_suspect_transitions_total",
+                           'outcome="died"') >= 1
+    finally:
+        try:
+            chaos.clear()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# --------------------------- slow e2e: asymmetric transfer partition
+
+@slow
+@pytest.mark.parametrize("seed", [1, 2])
+def test_asymmetric_partition_task_wave_relays(seed, tmp_path):
+    """Sever the A→B object-transfer path only (chaos site
+    ``object.transfer_fetch``, proc-pinned to A, peer-matched to B)
+    while B→A and every path through C stay clean.  A task wave whose
+    args are produced on B and consumed on A must complete with ZERO
+    task re-executions — the fetch ladder's relay rung routes the
+    payloads through C — and the fallback counter must prove which rung
+    fired."""
+    from ray_tpu import chaos
+    cluster = Cluster(heartbeat_timeout_s=5.0)
+    try:
+        n_a = cluster.add_node(num_cpus=4)
+        n_b = cluster.add_node(num_cpus=4)
+        n_c = cluster.add_node(num_cpus=4)
+        cluster.connect(n_a)
+        _wait_view(3)
+
+        @ray_tpu.remote(max_retries=3)
+        def produce(i, path):
+            import numpy as np
+            with open(f"{path}.prod.{i}", "a") as f:
+                f.write("x")
+            return np.arange(30_000, dtype=np.int64) + i
+
+        @ray_tpu.remote(max_retries=3)
+        def consume(x, i, path):
+            with open(f"{path}.cons.{i}", "a") as f:
+                f.write("x")
+            return int(x[0]) + int(x[-1])
+
+        mark = str(tmp_path / f"wave{seed}")
+        aff_b = NodeAffinitySchedulingStrategy(node_id=n_b.node_id,
+                                               soft=True)
+        aff_a = NodeAffinitySchedulingStrategy(node_id=n_a.node_id,
+                                               soft=True)
+        n_tasks = 8
+        produced = [produce.options(scheduling_strategy=aff_b)
+                    .remote(i, mark) for i in range(n_tasks)]
+        ready, _ = ray_tpu.wait(produced, num_returns=n_tasks,
+                                timeout=120.0)
+        assert len(ready) == n_tasks
+
+        # NOW sever A→B transfers (both native and chunked paths fire
+        # the same site); peer-matched so A→C / C→B stay clean
+        chaos.apply([{"site": "object.transfer_fetch", "action": "error",
+                      "proc": f"nodelet:{n_a.node_id[:8]}",
+                      "match": {"peer": "^" + n_b.node_id},
+                      "seed": seed}])
+        wave = [consume.options(scheduling_strategy=aff_a)
+                .remote(produced[i], i, mark) for i in range(n_tasks)]
+        out = ray_tpu.get(wave, timeout=180.0)
+        assert out == [i + (29_999 + i) for i in range(n_tasks)]
+        # ZERO task failures: every producer and consumer ran exactly
+        # once (a retry would double-append its marker file)
+        for i in range(n_tasks):
+            assert (tmp_path / f"wave{seed}.prod.{i}").read_text() == "x"
+            assert (tmp_path / f"wave{seed}.cons.{i}").read_text() == "x"
+        text = state.cluster_metrics_text()
+        relays = _metric_sum(text, "ray_tpu_object_fetch_fallbacks_total",
+                             'path="relay"')
+        alt = _metric_sum(text, "ray_tpu_object_fetch_fallbacks_total",
+                          'path="alt_copy"')
+        assert relays + alt >= 1, \
+            "the fallback ladder must have served the severed fetches"
+        assert relays >= 1, "the relay rung should have fired"
+    finally:
+        try:
+            chaos.clear()
+        except Exception:
+            pass
+        cluster.shutdown()

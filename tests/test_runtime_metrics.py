@@ -44,6 +44,11 @@ def test_cluster_metrics_exposition(cluster):
     assert "# TYPE ray_tpu_controller_failover_seconds histogram" in text
     assert ("# TYPE ray_tpu_controller_wal_replication_lag_records gauge"
             in text)
+    # the partition-tolerance battery: suspect-quarantine transitions,
+    # the fetch-ladder rung counter, and the connectivity-matrix gauge
+    assert "# TYPE ray_tpu_node_suspect_transitions_total counter" in text
+    assert "# TYPE ray_tpu_object_fetch_fallbacks_total counter" in text
+    assert "# TYPE ray_tpu_peer_unreachable_pairs gauge" in text
 
     def sample_sum(name: str) -> float:
         total = 0.0
