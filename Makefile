@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
-	chaos drain failover spec elastic ha partition clean
+	attr chaos drain failover spec elastic ha partition clean
 
 all: native cpp
 
@@ -24,10 +24,19 @@ test:
 	$(PY) -m pytest tests/ -q
 
 # Observability suite: timeline/span propagation, runtime-metrics
-# battery, structured events (all tier-1 — no `slow` markers).
+# battery, structured events, plus the PR-10 flight-recorder layer —
+# per-RPC attribution, metrics history, incident bundles, clock-offset
+# timeline merge, metrics lint (all tier-1 — no `slow` markers).
 obs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_observability.py \
-		tests/test_runtime_metrics.py tests/test_events.py -q
+		tests/test_runtime_metrics.py tests/test_events.py \
+		tests/test_control_plane_obs.py -q
+
+# Per-RPC attribution snapshot: scripted task/actor wave, prints the
+# controller handler table and appends it to the SCALE_r06 ledger
+# (ROADMAP item 4's "before" evidence).
+attr:
+	JAX_PLATFORMS=cpu $(PY) bench.py --attr
 
 # Chaos suite: seeded fault-injection units + all four end-to-end
 # recovery scenarios (each runs twice with the same seeds — injection

@@ -1073,6 +1073,87 @@ def _serve_main() -> None:
         "detail": {"error": err}}))
 
 
+def _attr_main() -> None:
+    """`python bench.py --attr`: scripted control-plane wave (task burst
+    + actor burst), then append the per-RPC attribution table — where
+    controller/nodelet handler time went, WAL append/fsync cost, loop
+    lag, scheduler wave stats — to the SCALE_r06 ledger.  This is the
+    'before' snapshot ROADMAP item 4 demands: the same table re-run
+    after the batching/sharding work proves where the serialization
+    points moved."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import state
+
+    n_tasks = int(os.environ.get("RAY_TPU_ATTR_TASKS", "20000"))
+    n_actors = int(os.environ.get("RAY_TPU_ATTR_ACTORS", "200"))
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        @ray_tpu.remote
+        class Member:
+            def ping(self):
+                return 1
+
+        ray_tpu.get([noop.remote() for _ in range(500)], timeout=120)
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n_tasks)],
+                    timeout=900.0)
+        task_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        actors = [Member.remote() for _ in range(n_actors)]
+        assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                               timeout=900.0)) == n_actors
+        actor_dt = time.perf_counter() - t0
+        time.sleep(1.0)   # let history/trace flush ticks settle
+        attr = state.rpc_attribution()
+        ctl = attr.get("controller") or {}
+        result = {
+            "wave": {"tasks": n_tasks, "task_rate_per_s":
+                     round(n_tasks / task_dt, 1),
+                     "actors": n_actors, "actor_rate_per_s":
+                     round(n_actors / actor_dt, 1)},
+            "controller_ops": (ctl.get("ops") or [])[:15],
+            "controller_top3_by_total_s":
+                [r["op"] for r in (ctl.get("ops") or [])[:3]],
+            "wal": ctl.get("wal"),
+            "controller_loop_lag": ctl.get("loop_lag"),
+            "nodes": {nid: (a.get("ops") or [])[:10]
+                      for nid, a in (attr.get("nodes") or {}).items()},
+        }
+        for a in actors:
+            ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({"metric": "control_plane_rpc_attr",
+                      "value": result["wave"]["task_rate_per_s"],
+                      "unit": "tasks/s", "detail": result}))
+    # merge into the SCALE_r06 ledger (best effort; the table printed
+    # above is the product)
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "SCALE_r06.json")
+        ledger = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                ledger = json.load(f)
+        ledger.setdefault("round", 6)
+        ledger.setdefault(
+            "what", "control-plane scale round 6 ledger; rpc_attr_before"
+            " is the PR-10 per-RPC attribution snapshot taken BEFORE the"
+            " item-4 batching/sharding work")
+        ledger["rpc_attr_before"] = {
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            **result}
+        with open(path, "w") as f:
+            json.dump(ledger, f, indent=1)
+    except OSError:
+        pass
+
+
 def main() -> None:
     mode = os.environ.get(_CHILD_FLAG)
     if mode:
@@ -1086,6 +1167,9 @@ def main() -> None:
         return
     if "--spec-bench" in sys.argv:
         _spec_bench_main()
+        return
+    if "--attr" in sys.argv:
+        _attr_main()
         return
 
     errors = []

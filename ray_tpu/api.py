@@ -94,7 +94,40 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
                           store_path=store_path, node_id=node_id,
                           session_dir=session_dir, mode="driver")
         set_global_core(core)
+        _register_atexit_span_flush()
         return ClientContext(core)
+
+
+_atexit_flush_registered = False
+
+
+def _register_atexit_span_flush() -> None:
+    """A driver that exits without calling shutdown() (script end,
+    exception) still ships its final span batch — up to one
+    trace_flush_interval_s of spans otherwise evaporates with the
+    process.  CoreClient.shutdown() does the same flush inline for the
+    orderly path; kv_payload() clears the dirty flag, so whichever runs
+    second is a no-op."""
+    global _atexit_flush_registered
+    if _atexit_flush_registered:
+        return
+    _atexit_flush_registered = True
+    import atexit
+
+    def _flush():
+        core = get_global_core()
+        if core is None or core._closed:
+            return
+        try:
+            from .util import tracing
+            payload = tracing.kv_payload()
+            if payload is not None:
+                core.controller.call("kv_put", {
+                    "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
+                    "value": payload, "persist": False}, timeout=2)
+        except Exception:
+            pass
+    atexit.register(_flush)
 
 
 def shutdown():

@@ -306,6 +306,37 @@ _d("trace_flush_interval_s", float, 0.25,
    "Period of each process's span flush to the controller KV.")
 _d("events_buffer_size", int, 1000,
    "Structured cluster events retained by the controller.")
+_d("metrics_history_interval_s", float, 0.5,
+   "Sampling period of the per-process metrics-history ring (controller "
+   "and nodelets snapshot their own registries — counter deltas + "
+   "gauges — on this cadence); 0 disables history sampling.")
+_d("metrics_history_window", int, 240,
+   "Samples retained in each process's metrics-history ring (bounded "
+   "memory: window * interval is the look-back the autoscale loop and "
+   "`ray-tpu top` can read — 2 minutes at the defaults).")
+_d("flight_recorder_enabled", bool, True,
+   "Capture an incident bundle (recent spans from every process, the "
+   "metrics-history window, structured events, node snapshot) to "
+   "flight_recorder_dir on SUSPECT transitions, controller failovers, "
+   "drain deadline overruns, elastic repairs, and OOM kills.")
+_d("flight_recorder_dir", str, "",
+   "Directory incident bundles land in ('' = "
+   "<tmpdir>/ray_tpu_incidents).  Each bundle is one subdirectory "
+   "named <unix-ms>_<trigger> holding meta/spans/metrics/events/nodes "
+   "JSON files.")
+_d("flight_recorder_keep", int, 20,
+   "Incident bundles retained; the oldest are pruned past this count.")
+_d("flight_recorder_min_interval_s", float, 5.0,
+   "Per-trigger rate limit between automatic captures (a flapping link "
+   "must not turn the recorder into its own incident); manual "
+   "`ray-tpu debug capture` bypasses it.")
+_d("metrics_lint_max_tags", int, 4,
+   "`ray-tpu metrics lint` cardinality bound: a registered metric may "
+   "declare at most this many label keys.")
+_d("metrics_lint_max_series", int, 512,
+   "`ray-tpu metrics lint` bound on live label-value combinations per "
+   "metric (exposition-time check; a per-task or per-object label "
+   "would blow this within minutes).")
 _d("pubsub_coalesce_s", float, 0.01,
    "Controller publish loop batches events arriving within this window "
    "into one push per subscriber (reference: pubsub batched long-poll).")

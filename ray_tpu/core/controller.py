@@ -91,6 +91,12 @@ class NodeRecord:
         # resource bundles of lease requests WAITING on this node
         # (heartbeat-reported); the autoscaler's load signal
         self.demand: List[Dict[str, float]] = []
+        # heartbeat-estimated wall-clock offset, node − controller:
+        # SUBTRACT it from the node's timestamps to land on the
+        # controller clock (RTT-midpoint sample, EWMA-smoothed nodelet-
+        # side) — state.timeline() uses it so cross-host spans merge in
+        # causal order
+        self.clock_offset = 0.0
 
 
 class Controller:
@@ -159,6 +165,15 @@ class Controller:
         from collections import deque as _deque
         self.events = _deque(maxlen=GlobalConfig.events_buffer_size)
         self._event_seq = 0
+        # self-observation (core/metrics_history.py, flight_recorder.py):
+        # the controller samples its own registry into a bounded ring and
+        # captures incident bundles on suspect/failover/drain/OOM events
+        from .flight_recorder import FlightRecorder
+        from .metrics_history import MetricsRing
+        self.metrics_ring = MetricsRing()
+        self.flight = FlightRecorder(self)
+        self._lag_ewma = 0.0   # asyncio loop lag (rpc.loop_lag_monitor)
+        self._lag_max = 0.0
         # -- durability (reference: gcs_table_storage.h:357 Redis-backed
         # GCS restart; here snapshot+WAL on local disk, persistence.py) ----
         self.pstore = None
@@ -287,6 +302,7 @@ class Controller:
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
                      "drain_node", "ping", "metrics_text",
+                     "rpc_attribution", "metrics_history", "debug_capture",
                      "chaos_plan", "chaos_claim",
                      "ha_status", "ha_register_standby", "ha_replicate",
                      "ha_sync_snapshot", "ha_lease", "ha_fence"):
@@ -362,6 +378,41 @@ class Controller:
         from .. import metrics
         rtm.snapshot_controller(self)
         return metrics.prometheus_text()
+
+    async def _h_rpc_attribution(self, conn, data):
+        """Per-op dispatch attribution of THIS controller process —
+        count, time-in-handler, latency quantiles, payload bytes — plus
+        the WAL append/fsync timing and asyncio loop lag riding along
+        (the instruments item 4's serialization hunt reads)."""
+        out = {"proc": "controller", "addr": self.address,
+               "ops": rpc.attribution_rows(),
+               "loop_lag": {"ewma_ms": self._lag_ewma * 1e3,
+                            "max_ms": self._lag_max * 1e3}}
+        if self.pstore is not None:
+            out["wal"] = dict(self.pstore.timing)
+        return out
+
+    async def _h_metrics_history(self, conn, data):
+        """This controller's metrics-history ring (bounded, fixed-
+        interval counter deltas + gauges; core/metrics_history.py)."""
+        rtm.snapshot_controller(self)
+        return self.metrics_ring.to_wire(last=data.get("last"))
+
+    async def _h_debug_capture(self, conn, data):
+        """Manual / remotely-triggered flight-recorder capture.  Manual
+        grabs (``ray-tpu debug capture``) bypass the per-trigger rate
+        limit; component-reported triggers (a nodelet's OOM kill, an
+        executor's elastic repair) go through it."""
+        trigger = data.get("trigger") or "manual"
+        reason = data.get("reason") or ""
+        if not GlobalConfig.flight_recorder_enabled:
+            return {"ok": False, "error": "flight recorder disabled"}
+        if trigger == "manual":
+            path = await self.flight.capture("manual", reason,
+                                             data.get("meta"))
+            return {"ok": True, "path": path}
+        self.flight.trigger(trigger, reason, **(data.get("meta") or {}))
+        return {"ok": True}
 
     # ------------------------------------------------------ high availability
     async def _h_ha_status(self, conn, data):
@@ -442,6 +493,12 @@ class Controller:
         tracing.configure("controller")
         tracing.claim_flusher()
         self._tasks.append(asyncio.ensure_future(self._trace_flush_loop()))
+        # self-observation: asyncio loop-lag probe + metrics-history ring
+        # (gauges refreshed before each sample so the ring is live)
+        self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
+        self._tasks.append(asyncio.ensure_future(
+            self.metrics_ring.run(
+                refresh=lambda: rtm.snapshot_controller(self))))
         return self
 
     async def _trace_flush_loop(self):
@@ -572,6 +629,10 @@ class Controller:
             return {"unknown_node": True}
         rec.last_heartbeat = time.monotonic()
         rec.demand = data.get("demand") or []
+        if "clock_offset" in data:
+            # RTT-midpoint clock-offset estimate the nodelet derived
+            # from OUR `now` stamp on an earlier reply
+            rec.clock_offset = float(data["clock_offset"])
         if nid in self.suspects:
             # the controller link healed inside the grace budget
             await self._rejoin_node(nid)
@@ -596,7 +657,10 @@ class Controller:
             rec.view.alive = True
             self._bump_view(nid)
         self._pending_actor_wakeup.set()
-        reply: Dict[str, Any] = {"view_version": self.view_version}
+        # `now` lets the nodelet estimate its clock offset from the RTT
+        # midpoint of this very round trip
+        reply: Dict[str, Any] = {"view_version": self.view_version,
+                                 "now": time.time()}
         known = data.get("view_version", -1)
         if known != self.view_version:
             reply["delta"] = [v.to_wire() for v in self._views().values()
@@ -608,6 +672,9 @@ class Controller:
                 "view_version": self.view_version}
 
     async def _h_list_nodes(self, conn, data):
+        return self.node_rows()
+
+    def node_rows(self) -> List[Dict[str, Any]]:
         # demand rides the node ROWS, not the synced views — it churns
         # every heartbeat and would bloat the versioned delta stream
         out = []
@@ -626,6 +693,7 @@ class Controller:
                 "suspect_grace_s": GlobalConfig.suspect_grace_s,
                 "peer_probe_fanout": GlobalConfig.peer_probe_fanout,
             }
+            row["clock_offset_s"] = round(rec.clock_offset, 6)
             if nid in self.suspects:
                 row["suspect_for_s"] = round(now - self.suspects[nid], 3)
                 row["peers_reaching"] = sorted(
@@ -737,6 +805,9 @@ class Controller:
                 f"drain of node {node_id[:12]} overran its "
                 f"{timeout_s:g}s budget; falling back to hard death",
                 node_id=node_id)
+            self.flight.trigger("drain_deadline",
+                                f"budget {timeout_s:g}s overrun",
+                                node_id=node_id[:12])
             await self._mark_node_dead(node_id, "drain deadline exceeded")
             await self._fence_drained_node(node_id, rec)
         except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
@@ -914,6 +985,7 @@ class Controller:
         await self._broadcast("nodes", {"event": "suspect",
                                         "node_id": node_id,
                                         "reason": reason})
+        self.flight.trigger("node_suspect", reason, node_id=node_id[:12])
 
     async def _check_suspect(self, node_id: str, now: float):
         """Re-evaluate one quarantined node every health tick: grace

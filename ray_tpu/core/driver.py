@@ -1443,7 +1443,15 @@ class CoreClient(DeferredRefDecs):
         """The controller connection failed over (leader death → promoted
         standby): connection-scoped state must be re-established — the
         ``nodes`` pubsub subscription serve routers and train executors
-        rely on lives on the dead TCP connection."""
+        rely on lives on the dead TCP connection.  The promoted leader's
+        trace KV is also EMPTY (persist=False keys are WAL-exempt), so
+        mark the span buffer dirty: the next flush re-ships this
+        driver's full history to the new leader's timeline."""
+        try:
+            from ..util import tracing
+            tracing.mark_dirty()
+        except Exception:
+            pass
         if not self._node_subscribed:
             return
         try:
@@ -1495,6 +1503,19 @@ class CoreClient(DeferredRefDecs):
         # cluster that is being torn down
         try:
             self.controller.fail_fast()
+        except Exception:
+            pass
+        # final span flush: whatever the 0.25s flush loop hasn't shipped
+        # yet must reach the controller's trace KV before this process's
+        # buffer evaporates — the controller RETAINS exited processes'
+        # last batch, so these spans stay in state.timeline()
+        try:
+            from ..util import tracing
+            payload = tracing.kv_payload()
+            if payload is not None:
+                self.controller.call("kv_put", {
+                    "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
+                    "value": payload, "persist": False}, timeout=2)
         except Exception:
             pass
         if self.mode == "driver":

@@ -46,6 +46,10 @@ from .config import GlobalConfig
 HA_EXEMPT = frozenset({
     "ping", "ha_status", "ha_replicate", "ha_sync_snapshot",
     "ha_lease", "ha_fence", "metrics_text",
+    # read-only self-observation: a standby (or fenced ex-leader) must
+    # stay inspectable — its dispatch table, metrics ring, and loop lag
+    # are exactly what a failover postmortem wants to see
+    "rpc_attribution", "metrics_history",
 })
 
 _REPL_BATCH = 256
@@ -454,6 +458,13 @@ class HAManager:
             f"{len(tables.get('actors', {}))} actors, "
             f"{len(tables.get('pgs', {}))} placement groups restored")
         self.c._pending_actor_wakeup.set()
+        # incident bundle at the moment of promotion: the replicated
+        # tables, node snapshot, and whatever spans/metrics this process
+        # already has — the postmortem's "state the new leader woke to"
+        self.c.flight.trigger(
+            "controller_failover",
+            f"promoted at epoch {self.epoch}: {reason}",
+            epoch=self.epoch, outage_s=round(outage, 3))
         if old_leader and old_leader != self.c.address:
             asyncio.ensure_future(
                 self._fence_old_leader(old_leader, self.epoch))

@@ -1,0 +1,148 @@
+"""Bounded in-memory metrics history (the time-series the autoscale
+loop and ``ray-tpu top`` read).
+
+``cluster_metrics_text()`` is a point-in-time scrape: by the time anyone
+looks, the interesting transient (a queue spike, a wave of lease grants,
+a failover stall) is gone.  Each server process (controller, nodelet)
+runs one :class:`MetricsRing` that snapshots its OWN process registry at
+a fixed interval — counter deltas plus gauge values — into a bounded
+ring (reference: the dashboard's per-component MetricsHistory windows
+over the GCS stats stream).  The ring is served over the existing RPC
+plane (``metrics_history`` handler), merged cluster-wide by
+``state.metrics_history()``, exposed at ``/api/metrics/history``, and
+snapshotted into flight-recorder bundles so postmortems carry the
+minutes AROUND an incident, not just the moment someone scraped.
+
+Samples are plain dicts (msgpack/JSON-safe)::
+
+    {"ts": <wall clock>,
+     "counters": {'name{tag="v"}': [cumulative, delta]},
+     "gauges":   {'name{tag="v"}': value}}
+
+Histogram families contribute their ``_count``/``_sum`` series as
+counters, so rates of histogram-observed events (drains, failovers,
+task phases) are recoverable from history too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .config import GlobalConfig
+
+
+def _registry_totals() -> Dict[str, float]:
+    """Flatten this process's metric registry into {sample_key: value}
+    for counters and gauges (histograms fold to _count/_sum)."""
+    from .. import metrics
+    out: Dict[str, float] = {}
+    with metrics._lock:
+        mets = list(metrics._registry.values())
+    for m in mets:
+        if isinstance(m, metrics.Histogram):
+            for k, n in list(m._totals.items()):
+                tags = metrics._fmt_tags(m.tag_keys, k)
+                out[f"{m.name}_count{tags}"] = float(n)
+                out[f"{m.name}_sum{tags}"] = float(m._sums.get(k, 0.0))
+        elif m.kind == "counter":
+            for k, v in m._samples():
+                out[f"{m.name}{metrics._fmt_tags(m.tag_keys, k)}"] = v
+    return out
+
+
+def _registry_gauges() -> Dict[str, float]:
+    from .. import metrics
+    out: Dict[str, float] = {}
+    with metrics._lock:
+        mets = [m for m in metrics._registry.values()
+                if m.kind == "gauge"]
+    for m in mets:
+        for k, v in m._samples():
+            out[f"{m.name}{metrics._fmt_tags(m.tag_keys, k)}"] = v
+    return out
+
+
+class MetricsRing:
+    """Fixed-interval sampler over this process's metric registry."""
+
+    def __init__(self, interval_s: Optional[float] = None,
+                 window: Optional[int] = None):
+        self.interval_s = (GlobalConfig.metrics_history_interval_s
+                           if interval_s is None else interval_s)
+        self.window = (GlobalConfig.metrics_history_window
+                       if window is None else window)
+        self._ring: deque = deque(maxlen=max(2, self.window))
+        self._prev: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def sample_once(self, now: Optional[float] = None) -> dict:
+        """Take one sample (callers refresh scrape-time gauges first)."""
+        totals = _registry_totals()
+        sample = {
+            "ts": time.time() if now is None else now,
+            "counters": {k: [v, max(0.0, v - self._prev.get(k, 0.0))]
+                         for k, v in totals.items()},
+            "gauges": _registry_gauges(),
+        }
+        with self._lock:
+            self._prev = totals
+            self._ring.append(sample)
+        return sample
+
+    def history(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._ring)
+        return out[-last:] if last else out
+
+    def window_around(self, ts: float, before_s: float = 60.0,
+                      after_s: float = 10.0) -> List[dict]:
+        """Samples inside [ts - before_s, ts + after_s] — the flight
+        recorder's 'metrics window around the trigger'."""
+        return [s for s in self.history()
+                if ts - before_s <= s["ts"] <= ts + after_s]
+
+    async def run(self, refresh=None) -> None:
+        """Sampling loop for asyncio server processes.  ``refresh`` is
+        called before each sample so scrape-time gauges (worker pool,
+        store usage, ...) are live in the ring, not stale."""
+        import asyncio
+        if self.interval_s <= 0:
+            return
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                if refresh is not None:
+                    refresh()
+                self.sample_once()
+            except Exception:
+                pass  # history must never kill its host process
+
+    def to_wire(self, last: Optional[int] = None) -> dict:
+        from ..util import tracing
+        return {"label": tracing.proc_label(),
+                "interval_s": self.interval_s,
+                "window": self.window,
+                "samples": self.history(last)}
+
+
+def series(samples: List[dict], name: str,
+           kind: str = "counters") -> List[dict]:
+    """Extract one metric family's samples: every sample key whose name
+    part (before any ``{``) equals ``name``.  Counter entries yield
+    ``{"ts", "key", "value", "delta"}``; gauges ``{"ts", "key",
+    "value"}``."""
+    out = []
+    for s in samples:
+        for key, v in s.get(kind, {}).items():
+            base = key.split("{", 1)[0]
+            if base != name:
+                continue
+            if kind == "counters":
+                out.append({"ts": s["ts"], "key": key,
+                            "value": v[0], "delta": v[1]})
+            else:
+                out.append({"ts": s["ts"], "key": key, "value": v})
+    return out

@@ -151,6 +151,15 @@ class Nodelet:
         # scheduling, relay-peer selection).
         self._peer_reach: Dict[str, tuple] = {}   # nid -> (ok, mono ts)
         self._probe_rr = 0
+        # wall-clock offset vs the controller (EWMA of heartbeat RTT-
+        # midpoint samples; + means this host's clock runs ahead of the
+        # controller's) — reported on the heartbeat so state.timeline()
+        # merges cross-host spans in causal order
+        self._clock_offset: Optional[float] = None
+        # bounded metrics-history ring (core/metrics_history.py),
+        # sampled by a start() task, served via `metrics_history`
+        from .metrics_history import MetricsRing
+        self.metrics_ring = MetricsRing()
         self._register_handlers()
 
     # ------------------------------------------------------------------ setup
@@ -162,7 +171,8 @@ class Nodelet:
                      "node_info", "stats", "put_location", "ping",
                      "task_state", "task_state_batch", "node_stats",
                      "tail_log", "task_spans", "prestart_workers",
-                     "metrics_text", "chaos_injected",
+                     "metrics_text", "rpc_attribution", "metrics_history",
+                     "chaos_injected",
                      "drain", "drain_status", "drain_evacuate",
                      "drain_complete", "detach_kill_worker",
                      "peer_probe", "probe_peer_now"):
@@ -211,6 +221,9 @@ class Nodelet:
                 asyncio.ensure_future(self._peer_probe_loop()))
         self._tasks.append(asyncio.ensure_future(rpc.loop_lag_monitor(self)))
         self._tasks.append(asyncio.ensure_future(self._trace_flush_loop()))
+        self._tasks.append(asyncio.ensure_future(
+            self.metrics_ring.run(
+                refresh=lambda: rtm.snapshot_nodelet(self))))
         self._agent_proc = None
         if GlobalConfig.dashboard_agent:
             # per-node dashboard agent (reference: raylet spawning
@@ -275,6 +288,11 @@ class Nodelet:
                                      "registration")
         await self.controller.call("subscribe", {"channel": "nodes"})
         await self.controller.call("subscribe", {"channel": "chaos"})
+        # a freshly restarted/promoted controller has an EMPTY trace KV
+        # (persist=False keys are WAL-exempt): re-ship this nodelet's
+        # full span buffer on the next flush tick
+        from ..util import tracing as _tracing
+        _tracing.mark_dirty()
         # Late joiners (and reconnects after a controller restart) pull
         # the current fault plan; a plan applied mid-run must cover nodes
         # added after `ray-tpu chaos apply`.
@@ -425,7 +443,7 @@ class Nodelet:
                     await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
                     continue
                 rtm.HEARTBEATS.inc(tags=self._mnode)
-                reply = await self.controller.call("heartbeat", {
+                hb = {
                     "node_id": self.node_id.hex(),
                     "available": self.available.to_dict(),
                     "total": self.total.to_dict(),
@@ -434,7 +452,13 @@ class Nodelet:
                         list(self._demand_tokens.values())[:64],
                     "reach": self._fresh_reach(),
                     "_ha_epoch": getattr(self, "_ctl_epoch", 0),
-                }, timeout=5)
+                }
+                if self._clock_offset is not None:
+                    hb["clock_offset"] = round(self._clock_offset, 6)
+                t0_wall = time.time()
+                reply = await self.controller.call("heartbeat", hb,
+                                                   timeout=5)
+                self._note_clock(t0_wall, time.time(), reply)
                 if reply and reply.get("_not_leader"):
                     # beat landed on a deposed/standby controller: find
                     # the current leader and re-register there
@@ -456,6 +480,21 @@ class Nodelet:
             except (rpc.RpcError, OSError):
                 pass
             await asyncio.sleep(GlobalConfig.heartbeat_interval_s)
+
+    def _note_clock(self, t0_wall: float, t1_wall: float, reply) -> None:
+        """Fold one clock-offset sample from a heartbeat round trip: the
+        controller stamped its wall clock into the reply, which was read
+        roughly at the RTT midpoint of [t0, t1].  offset = local −
+        controller (SUBTRACT it from local stamps to land on the
+        controller clock); EWMA-smoothed so one slow beat doesn't yank
+        the timeline."""
+        if not isinstance(reply, dict) or "now" not in reply:
+            return
+        sample = (t0_wall + t1_wall) / 2.0 - float(reply["now"])
+        if self._clock_offset is None:
+            self._clock_offset = sample
+        else:
+            self._clock_offset = 0.8 * self._clock_offset + 0.2 * sample
 
     # -------------------------------------------- peer-reachability gossip
     def _fresh_reach(self) -> Dict[str, bool]:
@@ -715,6 +754,14 @@ class Nodelet:
                                    f"{victim.worker_id.hex()[:8]} at "
                                    f"{frac:.2f} memory usage",
                         "meta": {"node_id": self.node_id.hex()}})
+                    # incident bundle at the controller: the spans and
+                    # metrics window AROUND the kill, while they exist
+                    await self.controller.notify("debug_capture", {
+                        "trigger": "oom_kill",
+                        "reason": f"worker "
+                                  f"{victim.worker_id.hex()[:8]} at "
+                                  f"{frac:.2f} usage",
+                        "meta": {"node_id": self.node_id.hex()[:12]}})
                 except Exception:
                     pass
             except Exception:
@@ -943,6 +990,13 @@ class Nodelet:
         return None
 
     async def _notify_lease_waiters(self):
+        # wave stats: each notify_all is one scheduler WAVE — the whole
+        # waiter cohort re-runs admission; cohort size + depth-at-grant
+        # histograms are the batching signals item 4 reads
+        rtm.SCHED_WAVES.inc(tags=self._mnode)
+        if self._lease_waiters:
+            rtm.SCHED_WAVE_BATCH.observe(self._lease_waiters,
+                                         tags=self._mnode)
         self._refresh_self_view()
         async with self._lease_cv:
             self._lease_cv.notify_all()
@@ -1048,6 +1102,8 @@ class Nodelet:
                     self.leases[lease_id] = Lease(lease_id, worker, request)
                     self._refresh_self_view()
                     rtm.LEASES_GRANTED.inc(tags=self._mnode)
+                    rtm.SCHED_QUEUE_DEPTH_AT_GRANT.observe(
+                        self._lease_waiters, tags=self._mnode)
                     return {"granted": True, "lease_id": lease_id,
                             "worker_id": worker.worker_id,
                             "worker_addr": worker.address}
@@ -1797,6 +1853,22 @@ class Nodelet:
         from .. import metrics
         rtm.snapshot_nodelet(self)
         return metrics.prometheus_text()
+
+    async def _h_rpc_attribution(self, conn, data):
+        """Per-op RPC dispatch attribution for THIS nodelet process
+        (count / time-in-handler / latency quantiles / payload bytes)."""
+        return {"proc": f"nodelet@{self.node_id.hex()[:8]}",
+                "addr": self.address,
+                "ops": rpc.attribution_rows(),
+                "loop_lag": {
+                    "ewma_ms": getattr(self, "_lag_ewma", 0.0) * 1e3,
+                    "max_ms": getattr(self, "_lag_max", 0.0) * 1e3}}
+
+    async def _h_metrics_history(self, conn, data):
+        """This nodelet's bounded metrics-history ring (fixed-interval
+        counter deltas + gauges; core/metrics_history.py)."""
+        rtm.snapshot_nodelet(self)
+        return self.metrics_ring.to_wire(last=data.get("last"))
 
     async def _h_node_stats(self, conn, data):
         """Per-node deep stats (reference: dashboard/agent.py reporter +

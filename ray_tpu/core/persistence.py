@@ -90,6 +90,12 @@ class ControllerStore:
         #: called with the record list after each durable local append
         #: (core/ha.py wires the leader's replicator here)
         self.tap: Optional[Callable[[List[Any]], None]] = None
+        #: WAL hot-path timing (flight-recorder / attribution source):
+        #: every append's wall time plus the fsync share, so "the
+        #: controller stalls on fsync" is measurable, not folklore
+        self.timing: Dict[str, float] = {
+            "appends": 0, "append_s": 0.0, "append_max_s": 0.0,
+            "fsync_s": 0.0, "fsync_max_s": 0.0}
 
     # -- recovery ------------------------------------------------------------
     def load(self) -> Optional[Dict[str, Any]]:
@@ -165,6 +171,8 @@ class ControllerStore:
         return self._append_local(list(record))
 
     def _append_local(self, record: List[Any]) -> int:
+        import time as _time
+        t0 = _time.perf_counter()
         if self._wal is None:
             self._open_wal()
         blob = _pack(record)
@@ -176,7 +184,17 @@ class ControllerStore:
         self._wal.write(frame)
         self._wal.flush()
         if self._fsync:
+            tf = _time.perf_counter()
             os.fsync(self._wal.fileno())
+            dt_f = _time.perf_counter() - tf
+            self.timing["fsync_s"] += dt_f
+            if dt_f > self.timing["fsync_max_s"]:
+                self.timing["fsync_max_s"] = dt_f
+        dt = _time.perf_counter() - t0
+        self.timing["appends"] += 1
+        self.timing["append_s"] += dt
+        if dt > self.timing["append_max_s"]:
+            self.timing["append_max_s"] = dt
         self.seq += 1
         self._appends += 1
         if self._appends >= self._compact_every \

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import struct
 import threading
+import time
 import traceback
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
@@ -25,6 +26,96 @@ import msgpack
 REQUEST, REPLY, ERROR, NOTIFY = 0, 1, 2, 3
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
+
+# ------------------------------------------------------ dispatch attribution
+# Per-process table of where RPC-handler time goes (reference: the
+# per-method gRPC server stats of grpc_server.h + event_stats.cc).  Lives
+# HERE because this module hosts every server's dispatch loop and sits
+# below ray_tpu.util/metrics in the import graph — runtime_metrics folds
+# the table into Prometheus at scrape time, and the controller/nodelet
+# `rpc_attribution` handlers serve it raw.  Cost per dispatch: two
+# perf_counter reads and one dict update under a plain dict (asyncio
+# single-threaded per loop; cross-thread readers tolerate torn snapshots).
+
+#: latency histogram bucket upper bounds (seconds) for the attribution
+#: table — fixed so p50/p99 estimates survive serialization
+DISPATCH_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+_dispatch_stats: Dict[str, dict] = {}
+
+
+def _note_dispatch(method: str, dur_s: float, bytes_in: int,
+                   bytes_out: int, error: bool) -> None:
+    st = _dispatch_stats.get(method)
+    if st is None:
+        st = _dispatch_stats[method] = {
+            "count": 0, "errors": 0, "total_s": 0.0, "max_s": 0.0,
+            "bytes_in": 0, "bytes_out": 0,
+            "buckets": [0] * (len(DISPATCH_BUCKETS) + 1)}
+    st["count"] += 1
+    if error:
+        st["errors"] += 1
+    st["total_s"] += dur_s
+    if dur_s > st["max_s"]:
+        st["max_s"] = dur_s
+    st["bytes_in"] += bytes_in
+    st["bytes_out"] += bytes_out
+    lo = 0
+    for i, b in enumerate(DISPATCH_BUCKETS):
+        if dur_s <= b:
+            lo = i
+            break
+    else:
+        lo = len(DISPATCH_BUCKETS)
+    st["buckets"][lo] += 1
+
+
+def _bucket_quantile(buckets, q: float) -> float:
+    """Estimate a latency quantile from the fixed bucket counts (upper
+    bound of the bucket holding the q-th sample; +Inf bucket reports the
+    last finite bound)."""
+    total = sum(buckets)
+    if not total:
+        return 0.0
+    want = q * total
+    seen = 0
+    for i, c in enumerate(buckets):
+        seen += c
+        if seen >= want:
+            return DISPATCH_BUCKETS[min(i, len(DISPATCH_BUCKETS) - 1)]
+    return DISPATCH_BUCKETS[-1]
+
+
+def dispatch_stats() -> Dict[str, dict]:
+    """Snapshot of this process's per-op dispatch table (value copies:
+    safe to serialize while dispatches keep landing)."""
+    return {m: dict(st, buckets=list(st["buckets"]))
+            for m, st in _dispatch_stats.items()}
+
+
+def attribution_rows(stats: Optional[Dict[str, dict]] = None) -> list:
+    """The dispatch table as rows sorted by total handler time (the
+    'where does control-plane time go' view), with derived avg/p50/p99."""
+    stats = dispatch_stats() if stats is None else stats
+    rows = []
+    for op, st in stats.items():
+        n = st["count"] or 1
+        rows.append({
+            "op": op, "count": st["count"], "errors": st["errors"],
+            "total_s": round(st["total_s"], 6),
+            "avg_ms": round(st["total_s"] / n * 1e3, 3),
+            "p50_ms": round(_bucket_quantile(st["buckets"], 0.5) * 1e3, 3),
+            "p99_ms": round(_bucket_quantile(st["buckets"], 0.99) * 1e3, 3),
+            "max_ms": round(st["max_s"] * 1e3, 3),
+            "bytes_in": st["bytes_in"], "bytes_out": st["bytes_out"],
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def reset_dispatch_stats() -> None:
+    _dispatch_stats.clear()
 
 # Armed fault-injection plan (util/fault_injection.py sets/clears this —
 # this module sits below ray_tpu.util in the import graph and cannot
@@ -123,9 +214,11 @@ class Connection:
                 payload = await self.reader.readexactly(length)
                 seq, kind, method, data = msgpack.unpackb(payload, raw=False)
                 if kind == REQUEST:
-                    asyncio.ensure_future(self._dispatch(seq, method, data))
+                    asyncio.ensure_future(
+                        self._dispatch(seq, method, data, length))
                 elif kind == NOTIFY:
-                    asyncio.ensure_future(self._dispatch(0, method, data))
+                    asyncio.ensure_future(
+                        self._dispatch(0, method, data, length))
                 elif kind in (REPLY, ERROR):
                     fut = self._pending.pop(seq, None)
                     if fut is not None and not fut.done():
@@ -140,20 +233,30 @@ class Connection:
         finally:
             await self._shutdown()
 
-    async def _dispatch(self, seq: int, method: str, data: Any):
+    async def _dispatch(self, seq: int, method: str, data: Any,
+                        nbytes: int = 0):
         handler = self.handlers.get(method)
+        t0 = time.perf_counter()
+        bytes_out = 0
+        error = False
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r}")
             result = await handler(self, data)
             if seq:
-                await self._send(_pack(seq, REPLY, method, result))
+                frame = _pack(seq, REPLY, method, result)
+                bytes_out = len(frame)
+                await self._send(frame)
         except Exception:
+            error = True
             if seq:
                 try:
                     await self._send(_pack(seq, ERROR, method, traceback.format_exc()))
                 except Exception:
                     pass
+        finally:
+            _note_dispatch(method, time.perf_counter() - t0, nbytes,
+                           bytes_out, error)
 
     async def _shutdown(self):
         if self._closed:

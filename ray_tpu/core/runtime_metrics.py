@@ -130,6 +130,39 @@ FETCH_FALLBACKS = m.Counter(
     "retry | alt_copy: another directory copy served it | relay: a "
     "controller-picked mutually-reachable peer relayed it | lineage: "
     "every path failed and reconstruction is the answer)", ("path",))
+# -- per-RPC attribution (folded from rpc.dispatch_stats at scrape /
+# history-sample time; the raw table with latency quantiles is served by
+# the `rpc_attribution` RPC and state.rpc_attribution()) ----------------
+RPC_HANDLER_CALLS = m.Counter(
+    "ray_tpu_rpc_handler_calls_total",
+    "RPC dispatches handled, by op and serving process — the "
+    "control-plane attribution table's count column", ("op", "proc"))
+RPC_HANDLER_ERRORS = m.Counter(
+    "ray_tpu_rpc_handler_errors_total",
+    "RPC dispatches whose handler raised", ("op", "proc"))
+RPC_HANDLER_SECONDS = m.Counter(
+    "ray_tpu_rpc_handler_seconds_total",
+    "Wall seconds spent inside RPC handlers (dispatch to reply sent), "
+    "by op — where control-plane time actually goes", ("op", "proc"))
+RPC_HANDLER_BYTES = m.Counter(
+    "ray_tpu_rpc_handler_bytes_total",
+    "Payload bytes through RPC handlers (direction: in = request "
+    "frame, out = reply frame)", ("op", "proc", "direction"))
+WAL_APPENDS = m.Counter(
+    "ray_tpu_controller_wal_appends_total",
+    "WAL records durably appended by this controller", ())
+WAL_APPEND_SECONDS = m.Counter(
+    "ray_tpu_controller_wal_append_seconds_total",
+    "Wall seconds spent in WAL appends (pack + write + fsync) — "
+    "divide by appends_total for the mean append cost", ())
+WAL_FSYNC_SECONDS = m.Counter(
+    "ray_tpu_controller_wal_fsync_seconds_total",
+    "Wall seconds of the fsync share of WAL appends (the disk-bound "
+    "floor under every mutating controller reply)", ())
+SCHED_WAVES = m.Counter(
+    "ray_tpu_scheduler_waves_total",
+    "Scheduler wake-up waves (lease-waiter cohort re-evaluations after "
+    "resources freed or the view changed)", ("node",))
 SERVE_SESSIONS_MIGRATED = m.Counter(
     "ray_tpu_serve_sessions_migrated_total",
     "Decode sessions re-admitted on a healthy replica by the proxy-side "
@@ -193,6 +226,19 @@ CONTROLLER_FAILOVER_DURATION = m.Histogram(
     "dead leader to the standby serving as the new leader (bounded by "
     "ha_lease_timeout_s plus one state restore)",
     (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0), ())
+SCHED_QUEUE_DEPTH_AT_GRANT = m.Histogram(
+    "ray_tpu_scheduler_queue_depth_at_grant",
+    "Lease requests waiting at this node at the moment one was granted "
+    "— sustained depth under a wave is the admission backlog item 4's "
+    "batching must drain",
+    (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 1024.0),
+    ("node",))
+SCHED_WAVE_BATCH = m.Histogram(
+    "ray_tpu_scheduler_wave_batch_size",
+    "Lease waiters woken per scheduler wave (cohort size when freed "
+    "resources / a view change re-ran admission)",
+    (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 1024.0),
+    ("node",))
 
 
 def observe_task_durs(durs: dict, node: str) -> None:
@@ -267,6 +313,45 @@ SERVE_SPEC_ACCEPTANCE = m.Gauge(
 
 # ------------------------------------------------------------- snapshots
 
+# last-folded cumulative values per (metric, op, direction) — the rpc /
+# WAL tables are cumulative, Counters only accept increments
+_folded: dict = {}
+
+
+def _fold(metric: "m.Counter", total: float, **tags: str) -> None:
+    key = (metric.name,) + tuple(sorted(tags.items()))
+    prev = _folded.get(key, 0.0)
+    if total > prev:
+        metric.inc(total - prev, tags=tags)
+        _folded[key] = total
+
+
+def fold_rpc_dispatch() -> None:
+    """Fold this process's per-op RPC dispatch table (core/rpc.py) into
+    the Prometheus counters — called at scrape and history-sample time
+    by the controller and nodelets."""
+    from ..util import tracing
+    from . import rpc
+    proc = tracing.proc_label()
+    for op, st in rpc.dispatch_stats().items():
+        _fold(RPC_HANDLER_CALLS, st["count"], op=op, proc=proc)
+        if st["errors"]:
+            _fold(RPC_HANDLER_ERRORS, st["errors"], op=op, proc=proc)
+        _fold(RPC_HANDLER_SECONDS, st["total_s"], op=op, proc=proc)
+        _fold(RPC_HANDLER_BYTES, st["bytes_in"], op=op, proc=proc,
+              direction="in")
+        _fold(RPC_HANDLER_BYTES, st["bytes_out"], op=op, proc=proc,
+              direction="out")
+
+
+def fold_wal_timing(pstore: Any) -> None:
+    if pstore is None:
+        return
+    t = pstore.timing
+    _fold(WAL_APPENDS, t["appends"])
+    _fold(WAL_APPEND_SECONDS, t["append_s"])
+    _fold(WAL_FSYNC_SECONDS, t["fsync_s"])
+
 
 def snapshot_nodelet(nl: Any) -> None:
     """Refresh nodelet gauges from live state (heartbeat cadence)."""
@@ -294,10 +379,14 @@ def snapshot_nodelet(nl: Any) -> None:
             pass
     PRIMARY_PINS.set(len(nl._primary_pins), {"node": nid})
     LOOP_LAG.set(getattr(nl, "_lag_ewma", 0.0), {"node": nid})
+    fold_rpc_dispatch()
 
 
 def snapshot_controller(ctl: Any) -> None:
     """Refresh controller gauges from live state."""
+    fold_rpc_dispatch()
+    fold_wal_timing(ctl.pstore)
+    LOOP_LAG.set(getattr(ctl, "_lag_ewma", 0.0), {"node": "controller"})
     alive = sum(1 for r in ctl.nodes.values()
                 if getattr(r.view, "alive", False))
     NODES_ALIVE.set(alive)

@@ -43,6 +43,9 @@ def run_worker(args: dict) -> None:
             pass
         await rt.start()
         await rt.run_forever()
+        # graceful teardown (SIGTERM / accelerator-holding exit): ship
+        # the final span batch before the loop dies with this process
+        await rt.final_span_flush()
 
     asyncio.run(run())
 

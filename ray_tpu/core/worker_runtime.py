@@ -173,6 +173,12 @@ class WorkerRuntime:
             return
         while not self._dying:
             await asyncio.sleep(GlobalConfig.trace_flush_interval_s)
+            if self.controller is not None and self.controller.closed:
+                # controller restarted or a standby was promoted: its
+                # trace KV is empty (persist=False keys never replicate
+                # through the WAL) — re-ship our FULL buffer so the new
+                # leader's timeline regains this process's history
+                tracing.mark_dirty()
             payload = tracing.kv_payload()
             if payload is None:
                 continue
@@ -183,6 +189,25 @@ class WorkerRuntime:
                     "value": payload, "persist": False})
             except Exception:
                 tracing.mark_dirty()
+
+    async def final_span_flush(self):
+        """Last-gasp span flush on the way out: the flush loop ticks
+        every trace_flush_interval_s, so up to one interval of spans
+        (the task that was running when this worker was told to die)
+        sits only in the local buffer.  The controller RETAINS each
+        exited process's final KV batch, so flushing here is what makes
+        a killed worker's last spans appear in state.timeline()."""
+        from ..util import tracing
+        try:
+            payload = tracing.kv_payload()
+            if payload is None:
+                return
+            conn = await self._controller_conn()
+            await asyncio.wait_for(conn.call("kv_put", {
+                "ns": tracing.TRACE_KV_NS, "key": tracing.kv_key(),
+                "value": payload, "persist": False}), timeout=2.0)
+        except Exception:
+            pass  # exiting anyway; observability must not block death
 
     async def _controller_conn(self) -> rpc.Connection:
         """Redial the controller when the connection dropped (it restarts
@@ -776,6 +801,7 @@ class WorkerRuntime:
                     "intended": not data.get("restart", False)})
             except (rpc.RpcError, OSError):
                 pass
+        await self.final_span_flush()
         self.request_exit(0)
         return True
 
@@ -789,6 +815,15 @@ class WorkerRuntime:
         lesson, SURVEY §9).  A watchdog hard-exits if graceful teardown
         itself hangs."""
         self._dying = True
+        # best-effort last span flush on the loop before the hard exit
+        # below (the _h_exit path already awaited one; SIGTERM and crash
+        # exits land here directly)
+        if self._loop is not None and not self._loop.is_closed():
+            try:
+                asyncio.run_coroutine_threadsafe(self.final_span_flush(),
+                                                 self._loop)
+            except RuntimeError:
+                pass
         if not self._holds_accelerator():
             t = threading.Timer(0.05, lambda: os._exit(code))
             t.daemon = True

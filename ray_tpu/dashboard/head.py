@@ -123,6 +123,19 @@ class DashboardHead:
             from ..util import tracing
             return tracing.cluster_trace_events()
 
+        def metrics_history(request):
+            # per-process metrics-history rings (counter deltas +
+            # gauges), optionally reduced to one metric family's series
+            from .. import state
+            last = request.query.get("last")
+            return state.metrics_history(
+                name=request.query.get("name") or None,
+                last=int(last) if last else None)
+
+        def rpc_attribution(_):
+            from .. import state
+            return state.rpc_attribution()
+
         def node_stats(request):
             from .. import state
             return state.node_stats(request.match_info.get("node_id"))
@@ -226,6 +239,10 @@ class DashboardHead:
         app.router.add_get("/api/jobs/{job_id}/logs", blocking(job_logs))
         app.router.add_get("/metrics", blocking(metrics_text))
         app.router.add_get("/metrics/cluster", blocking(metrics_cluster))
+        app.router.add_get("/api/metrics/history",
+                           blocking(metrics_history))
+        app.router.add_get("/api/rpc_attribution",
+                           blocking(rpc_attribution))
         app.router.add_get("/api/agents", blocking(agents))
         app.router.add_get("/api/agent_stats", blocking(agent_stats))
         app.router.add_get("/api/logs", blocking(logs_list))

@@ -118,6 +118,79 @@ def prometheus_text() -> str:
     return "\n".join(out) + "\n"
 
 
+# ----------------------------------------------------------------- linting
+
+_NAME_RE = None  # compiled lazily
+
+
+def lint_registry(max_tags: int = None, max_series: int = None,
+                  prefix: str = "ray_tpu_") -> List[str]:
+    """Lint every metric registered in THIS process (the `ray-tpu
+    metrics lint` engine, sibling of `chaos validate`): a metric that
+    breaks exposition or explodes cardinality otherwise fails SILENTLY
+    — scrapers drop the family, dashboards show a hole, and nobody
+    notices until the postmortem needed it.  Returns human-readable
+    issues (empty = clean).
+
+    Checks: HELP (non-empty description) and TYPE present, Prometheus-
+    legal unique names under the expected prefix, counters named
+    ``*_total``, no reserved histogram suffixes (``_bucket``/``_sum``/
+    ``_count``) on non-histograms, label keys unique and at most
+    ``max_tags`` per metric, and live label-value combinations below
+    ``max_series`` (a per-task or per-object label blows this within
+    minutes)."""
+    global _NAME_RE
+    import re
+    if _NAME_RE is None:
+        _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    if max_tags is None or max_series is None:
+        from .core.config import GlobalConfig
+        max_tags = max_tags or GlobalConfig.metrics_lint_max_tags
+        max_series = max_series or GlobalConfig.metrics_lint_max_series
+    issues: List[str] = []
+    with _lock:
+        mets = list(_registry.values())
+    lowered: Dict[str, str] = {}
+    for m in mets:
+        tag = m.name
+        if not m.description or not str(m.description).strip():
+            issues.append(f"{tag}: missing HELP (empty description)")
+        if m.kind not in ("counter", "gauge", "histogram"):
+            issues.append(f"{tag}: missing/unknown TYPE ({m.kind!r})")
+        if not _NAME_RE.match(m.name):
+            issues.append(f"{tag}: not a legal Prometheus metric name")
+        if prefix and not m.name.startswith(prefix):
+            issues.append(f"{tag}: name must start with {prefix!r}")
+        if m.kind == "counter" and not m.name.endswith("_total"):
+            issues.append(f"{tag}: counter names must end in '_total'")
+        if m.kind != "histogram" and m.name.endswith(
+                ("_bucket", "_sum", "_count")):
+            issues.append(f"{tag}: reserved histogram suffix on a "
+                          f"{m.kind} collides with exposition")
+        low = m.name.lower()
+        if low in lowered and lowered[low] != m.name:
+            issues.append(f"{tag}: case-colliding duplicate of "
+                          f"{lowered[low]}")
+        lowered[low] = m.name
+        if len(m.tag_keys) != len(set(m.tag_keys)):
+            issues.append(f"{tag}: duplicate label keys {m.tag_keys}")
+        if len(m.tag_keys) > max_tags:
+            issues.append(f"{tag}: {len(m.tag_keys)} label keys exceeds "
+                          f"the cardinality bound ({max_tags}) — every "
+                          f"extra key multiplies the series count")
+        for k in m.tag_keys:
+            if not _NAME_RE.match(k) or k.startswith("__"):
+                issues.append(f"{tag}: illegal label key {k!r}")
+        live = len(m._values) if not isinstance(m, Histogram) \
+            else len(m._counts)
+        if live > max_series:
+            issues.append(
+                f"{tag}: {live} live label combinations exceeds the "
+                f"bound ({max_series}) — an unbounded label value "
+                f"(task id? object id?) is leaking into tags")
+    return issues
+
+
 def serve_metrics(port: int = 0) -> int:
     """Expose /metrics on a background thread; returns the bound port."""
     import http.server

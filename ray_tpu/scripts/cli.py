@@ -409,6 +409,149 @@ def cmd_chaos(args) -> None:
         ray_tpu.shutdown()
 
 
+def _fmt_rate(v) -> str:
+    return f"{v:,.1f}" if isinstance(v, float) else str(v)
+
+
+def render_top(nodes, history, attr, top_k: int = 10) -> str:
+    """One frame of the `ray-tpu top` terminal view (pure function of
+    the three state-API payloads, so it is unit-testable offline)."""
+    from ray_tpu.core import metrics_history as mh
+    lines = []
+    alive = sum(1 for n in nodes if n.get("alive"))
+    lines.append(
+        f"ray-tpu top — {time.strftime('%H:%M:%S')}  nodes: "
+        f"{len(nodes)} total / {alive} alive / "
+        f"{sum(1 for n in nodes if n.get('state') == 'SUSPECT')} suspect"
+        f" / {sum(1 for n in nodes if n.get('state') == 'DRAINING')}"
+        f" draining")
+    # per-node rates out of each nodelet's metrics-history ring
+    interval = history.get("interval_s") or 1.0
+    lines.append(f"{'NODE':<14} {'STATE':<9} {'TASKS/S':>9} "
+                 f"{'GRANTS/S':>9} {'HB_AGE':>7} {'LAG_MS':>7} "
+                 f"{'CLK_OFF_MS':>10}")
+    for n in nodes:
+        label = f"nodelet@{n['id'][:8]}"
+        samples = (history.get("processes", {})
+                   .get(label, {}) or {}).get("samples", [])
+        win = samples[-20:]
+        # n samples cover (n-1) intervals of deltas
+        span_s = max(interval, (len(win) - 1) * interval)
+
+        def rate(name):
+            tot = sum(s["delta"] for s in mh.series(win, name))
+            return tot / span_s
+        lag = next((s["value"] for s in reversed(
+            mh.series(win, "ray_tpu_event_loop_lag_seconds", "gauges"))),
+            0.0)
+        hb = (n.get("health") or {}).get("heartbeat_age_s", "-")
+        lines.append(
+            f"{n['id'][:12]:<14} {n.get('state', '?'):<9} "
+            f"{_fmt_rate(rate('ray_tpu_tasks_finished_total')):>9} "
+            f"{_fmt_rate(rate('ray_tpu_scheduler_leases_granted_total')):>9} "
+            f"{hb:>7} {lag * 1e3:>7.1f} "
+            f"{float(n.get('clock_offset_s') or 0.0) * 1e3:>10.1f}")
+    ctl = attr.get("controller") or {}
+    ops = list(ctl.get("ops") or [])[:top_k]
+    lines.append("")
+    lines.append(f"CONTROLLER RPC — top {len(ops)} handlers by total "
+                 f"handler time")
+    lines.append(f"{'OP':<26} {'CALLS':>9} {'ERR':>5} {'TOTAL_S':>9} "
+                 f"{'AVG_MS':>8} {'P99_MS':>8} {'IN_KB':>9} {'OUT_KB':>9}")
+    for r in ops:
+        lines.append(
+            f"{r['op']:<26} {r['count']:>9} {r['errors']:>5} "
+            f"{r['total_s']:>9.3f} {r['avg_ms']:>8.3f} "
+            f"{r['p99_ms']:>8.3f} {r['bytes_in'] / 1024:>9.1f} "
+            f"{r['bytes_out'] / 1024:>9.1f}")
+    wal = ctl.get("wal")
+    if wal and wal.get("appends"):
+        lines.append(
+            f"WAL: {wal['appends']} appends, "
+            f"avg {wal['append_s'] / wal['appends'] * 1e3:.2f} ms "
+            f"(fsync {wal['fsync_s'] / wal['appends'] * 1e3:.2f} ms), "
+            f"max {wal['append_max_s'] * 1e3:.2f} ms")
+    lag = ctl.get("loop_lag") or {}
+    lines.append(f"controller loop lag: "
+                 f"ewma {lag.get('ewma_ms', 0):.2f} ms / "
+                 f"max {lag.get('max_ms', 0):.2f} ms")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> None:
+    """Live terminal view over the metrics-history rings + per-RPC
+    attribution (reference: `ray status`'s periodic refresh + the
+    dashboard's machine view, as a terminal loop)."""
+    import ray_tpu
+    from ray_tpu import state
+    _connect(args)
+    try:
+        n = 0
+        while True:
+            frame = render_top(state.list_nodes(),
+                               state.metrics_history(last=60),
+                               state.rpc_attribution())
+            if not args.once:
+                print("\033[2J\033[H", end="")
+            print(frame, flush=True)
+            n += 1
+            if args.once or (args.iterations and n >= args.iterations):
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_debug(args) -> None:
+    """Flight-recorder control: `capture` grabs an incident bundle NOW
+    (manual grabs bypass the per-trigger rate limit); `list` shows the
+    bundles already on disk under flight_recorder_dir."""
+    from ray_tpu.core import flight_recorder as fr
+    if args.op == "list":
+        base = fr.recorder_dir()
+        bundles = fr.list_bundles(base)
+        print(f"{len(bundles)} bundle(s) in {base}")
+        for b in bundles:
+            print(f"  {b}")
+        return
+    import ray_tpu
+    from ray_tpu import state
+    _connect(args)
+    try:
+        reply = state.debug_capture(args.reason or "manual CLI capture")
+        if not reply.get("ok"):
+            sys.exit(f"capture failed: {reply.get('error')}")
+        print(f"bundle captured: {reply['path']}")
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_metrics(args) -> None:
+    """Metrics tooling: `lint` checks every metric the runtime battery
+    registers — HELP/TYPE present, names legal/unique/prefixed,
+    counters `*_total`, label sets under the cardinality bounds — so a
+    new metric cannot silently break exposition (sibling of `chaos
+    validate`; offline, no cluster needed)."""
+    if args.op != "lint":
+        sys.exit(f"unknown metrics op {args.op!r}")
+    # register the full runtime battery in this process, then lint it
+    import ray_tpu  # noqa: F401  (registers core metrics on import)
+    import ray_tpu.core.runtime_metrics  # noqa: F401
+    from ray_tpu import metrics
+    issues = metrics.lint_registry()
+    if issues:
+        for issue in issues:
+            print(f"ERROR: {issue}")
+        sys.exit(f"{len(issues)} metric issue(s) — exposition or "
+                 f"cardinality would break silently")
+    with metrics._lock:
+        n = len(metrics._registry)
+    print(f"OK: {n} registered metric(s), all HELP/TYPE/naming/"
+          f"cardinality checks clean")
+
+
 def cmd_microbenchmark(args) -> None:
     import ray_tpu
     from ray_tpu.microbenchmark import run_microbenchmarks
@@ -544,6 +687,33 @@ def main(argv=None) -> None:
                          "fast")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser("top",
+                        help="live cluster view: per-node task/lease "
+                             "rates from the metrics-history rings + "
+                             "top RPC handlers by handler time")
+    sp.add_argument("--address")
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = until Ctrl-C)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clear)")
+    sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("debug",
+                        help="flight recorder: capture an incident "
+                             "bundle now, or list bundles on disk")
+    sp.add_argument("op", choices=["capture", "list"])
+    sp.add_argument("--reason", default="")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("metrics",
+                        help="metrics tooling (lint: offline HELP/TYPE/"
+                             "naming/cardinality check of the "
+                             "registered battery)")
+    sp.add_argument("op", choices=["lint"])
+    sp.set_defaults(fn=cmd_metrics)
 
     sp = sub.add_parser("microbenchmark", help="core op throughput")
     sp.add_argument("--num-cpus", type=float, default=4)

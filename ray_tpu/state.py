@@ -195,6 +195,81 @@ def cluster_metrics_text() -> str:
     return "\n".join(out) + "\n"
 
 
+def metrics_history(name: Optional[str] = None,
+                    last: Optional[int] = None) -> Dict[str, Any]:
+    """Cluster-wide metrics history: each server process's bounded ring
+    of fixed-interval samples (counter deltas + gauges;
+    core/metrics_history.py), keyed by process label.  With ``name``,
+    a ``series`` view extracts that one metric family per process —
+    the signal source the serve autoscale loop (ROADMAP item 2) and
+    ``ray-tpu top`` read."""
+    from .core import metrics_history as mh
+    core = _ensure_initialized()
+    procs: Dict[str, Any] = {}
+    try:
+        procs["controller"] = core.controller.call(
+            "metrics_history", {"last": last}, timeout=10.0)
+    except Exception:
+        pass
+    for n in list_nodes():
+        if not n.get("alive"):
+            continue
+        try:
+            r = _node_call(n["addr"], "metrics_history", {"last": last})
+            procs[r.get("label") or f"nodelet@{n['id'][:8]}"] = r
+        except Exception:
+            continue
+    out: Dict[str, Any] = {
+        "interval_s": next((p.get("interval_s") for p in procs.values()),
+                           None),
+        "processes": procs,
+    }
+    if name:
+        out["series"] = {
+            label: mh.series(p.get("samples", []), name)
+            for label, p in procs.items()}
+    return out
+
+
+def rpc_attribution() -> Dict[str, Any]:
+    """Per-RPC control-plane attribution: for the controller and every
+    alive nodelet, the per-op dispatch table (count, errors, total
+    handler seconds, avg/p50/p99/max latency, payload bytes — sorted by
+    total time), plus WAL append/fsync timing and asyncio loop lag.
+    The 'where does control-plane time go' view SCALE_r06 reads before
+    and after (ROADMAP item 4)."""
+    core = _ensure_initialized()
+    out: Dict[str, Any] = {"nodes": {}}
+    try:
+        out["controller"] = core.controller.call("rpc_attribution", {},
+                                                 timeout=10.0)
+    except Exception as e:
+        out["controller"] = {"error": str(e)}
+    for n in list_nodes():
+        if not n.get("alive"):
+            continue
+        try:
+            out["nodes"][n["id"][:12]] = _node_call(n["addr"],
+                                                    "rpc_attribution")
+        except Exception:
+            continue
+    return out
+
+
+def top_rpc_ops(k: int = 3) -> List[Dict[str, Any]]:
+    """The controller's top-``k`` RPC handlers by total handler time."""
+    attr = rpc_attribution().get("controller") or {}
+    return list(attr.get("ops") or [])[:k]
+
+
+def debug_capture(reason: str = "") -> Dict[str, Any]:
+    """Capture a flight-recorder bundle NOW (manual grab; bypasses the
+    per-trigger rate limit).  Returns {"ok", "path"}."""
+    return _ensure_initialized().controller.call(
+        "debug_capture", {"trigger": "manual", "reason": reason},
+        timeout=30.0)
+
+
 def node_stats(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Deep per-node stats: worker tables, running tasks, store usage
     (reference: dashboard reporter/agent per-node stats)."""
@@ -328,6 +403,39 @@ def _node_task_span_events() -> List[Dict[str, Any]]:
     return events
 
 
+def _clock_offsets() -> Dict[str, float]:
+    """node-id-prefix (8 hex) → estimated wall-clock offset in seconds
+    (node − controller), from the heartbeat RTT-midpoint estimates the
+    controller folds into its node rows."""
+    try:
+        return {n["id"][:8]: float(n.get("clock_offset_s") or 0.0)
+                for n in list_nodes()}
+    except Exception:
+        return {}
+
+
+def apply_clock_offsets(events: List[Dict[str, Any]],
+                        offsets: Dict[str, float]) -> None:
+    """Shift each span onto the CONTROLLER clock in place: a span's pid
+    names its process ("kind@<node8>" lifecycle spans, "node:<node8>"
+    legacy task spans); subtracting that node's offset re-aligns
+    cross-host spans into causal order (a follower whose clock runs
+    100ms ahead otherwise renders its exec span before the submit that
+    caused it)."""
+    if not offsets:
+        return
+    for e in events:
+        pid = str(e.get("pid") or "")
+        node8 = ""
+        if "@" in pid:
+            node8 = pid.rsplit("@", 1)[1][:8]
+        elif pid.startswith("node:"):
+            node8 = pid[5:][:8]
+        off = offsets.get(node8)
+        if off:
+            e["ts"] = e.get("ts", 0) - off * 1e6
+
+
 def timeline() -> Dict[str, Any]:
     """Cluster-wide task timeline as a Chrome-trace dict (reference:
     `ray timeline` / chrome_tracing_dump, _private/state.py:414).
@@ -335,10 +443,12 @@ def timeline() -> Dict[str, Any]:
     Merges every process's lifecycle spans (submit → schedule → dequeue
     → fetch → exec → put, plus serve/train workload spans) with the
     legacy per-node finished-task spans, ordered by timestamp with
-    per-process pid/tid attribution.  The returned dict serializes
-    directly to a file loadable in https://ui.perfetto.dev or
-    chrome://tracing."""
+    per-process pid/tid attribution, re-aligned onto the controller
+    clock via the heartbeat-estimated per-host offsets.  The returned
+    dict serializes directly to a file loadable in
+    https://ui.perfetto.dev or chrome://tracing."""
     events = _trace_span_events() + _node_task_span_events()
+    apply_clock_offsets(events, _clock_offsets())
     events.sort(key=lambda e: e.get("ts", 0))
     pids: List[Any] = []
     for e in events:

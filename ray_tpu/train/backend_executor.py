@@ -323,6 +323,18 @@ class BackendExecutor:
                 f"train_repair::{self._trial_name}", "train",
                 t0_wall, time.time(), outcome=outcome, step=step,
                 run_id=self.run_id)
+            # flight-recorder bundle at the controller: the death that
+            # caused this repair plus the repair itself, capturable
+            # after the fact (rate-limited controller-side; best effort)
+            try:
+                from ..core.driver import get_global_core
+                get_global_core().controller.notify("debug_capture", {
+                    "trigger": "elastic_repair",
+                    "reason": f"{outcome} at step {step} "
+                              f"({self._trial_name})",
+                    "meta": {"run_id": self.run_id}})
+            except Exception:
+                pass
 
     def _check_deadline(self, deadline: float, phase: str) -> float:
         remaining = deadline - time.monotonic()

@@ -1,0 +1,350 @@
+"""Control-plane flight recorder (PR-10): per-RPC attribution, metrics
+history, incident capture, clock-offset timeline merge, metrics lint.
+
+Acceptance (ISSUE 10): a scripted task wave yields (a) a per-RPC
+attribution table naming the top-3 controller handlers by total time,
+(b) ``state.metrics_history()`` with >= 30 samples of a named counter
+and correct deltas, and (c) a chaos-triggered SUSPECT transition
+producing a flight-record bundle containing spans, the metrics window,
+and the node snapshot.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+_OBS_ENV = {
+    # 0.1s sampling: >=30 history samples inside a few seconds of test
+    "RAY_TPU_METRICS_HISTORY_INTERVAL_S": "0.1",
+    "RAY_TPU_METRICS_HISTORY_WINDOW": "400",
+}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    old = {k: os.environ.get(k) for k in _OBS_ENV}
+    os.environ.update(_OBS_ENV)
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+
+
+def _wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------- units: rpc stats
+
+def test_dispatch_stats_unit():
+    from ray_tpu.core import rpc
+    stats = {}
+    saved, rpc._dispatch_stats = rpc._dispatch_stats, stats
+    try:
+        rpc._note_dispatch("heartbeat", 0.002, 100, 50, False)
+        rpc._note_dispatch("heartbeat", 0.004, 100, 50, False)
+        rpc._note_dispatch("kv_put", 0.5, 10_000, 5, True)
+        rows = rpc.attribution_rows()
+        # kv_put burned more total time -> first row
+        assert [r["op"] for r in rows] == ["kv_put", "heartbeat"]
+        hb = rows[1]
+        assert hb["count"] == 2 and hb["errors"] == 0
+        assert hb["bytes_in"] == 200 and hb["bytes_out"] == 100
+        assert 0 < hb["p50_ms"] <= 5.0
+        assert rows[0]["errors"] == 1
+        assert rows[0]["p99_ms"] >= 500 * 0.9  # 0.5s sample in ms
+    finally:
+        rpc._dispatch_stats = saved
+
+
+# --------------------------------------------------- units: metrics ring
+
+def test_metrics_ring_deltas_unit():
+    from ray_tpu import metrics
+    from ray_tpu.core.metrics_history import MetricsRing, series
+    name = "ray_tpu_test_ring_total"
+    c = metrics.Counter(name, "test counter", ())
+    try:
+        ring = MetricsRing(interval_s=0.01, window=5)
+        ring.sample_once()
+        for i in range(8):
+            c.inc(3)
+            ring.sample_once()
+        samples = ring.history()
+        assert len(samples) == 5, "ring must stay bounded at its window"
+        ser = series(samples, name)
+        assert len(ser) == 5
+        # deltas: exactly one inc(3) between consecutive samples
+        assert all(s["delta"] == 3 for s in ser), ser
+        # cumulative values monotonic and consistent with deltas
+        for prev, cur in zip(ser, ser[1:]):
+            assert cur["value"] - prev["value"] == cur["delta"]
+    finally:
+        with metrics._lock:
+            metrics._registry.pop(name, None)
+
+
+# ----------------------------------------------------- units: clock merge
+
+def test_clock_offset_timeline_merge_unit():
+    from ray_tpu.state import apply_clock_offsets
+    # node bb's clock runs 0.1s AHEAD: uncorrected, its exec span
+    # renders before the submit that caused it
+    events = [
+        {"name": "submit::f", "pid": "driver@aaaaaaaa", "ts": 1_000_000.0},
+        {"name": "exec::f", "pid": "worker@bbbbbbbb", "ts": 1_050_000.0},
+        {"name": "legacy", "pid": "node:bbbbbbbb", "ts": 1_060_000.0},
+    ]
+    apply_clock_offsets(events, {"aaaaaaaa": 0.0, "bbbbbbbb": 0.1})
+    assert events[0]["ts"] == 1_000_000.0
+    assert events[1]["ts"] == pytest.approx(950_000.0)
+    assert events[2]["ts"] == pytest.approx(960_000.0)
+    # unknown node prefix: untouched
+    ev = [{"name": "x", "pid": "worker@cccccccc", "ts": 5.0}]
+    apply_clock_offsets(ev, {"bbbbbbbb": 1.0})
+    assert ev[0]["ts"] == 5.0
+
+
+# ------------------------------------------------------ units: metric lint
+
+def test_metrics_lint_clean_battery():
+    import ray_tpu.core.runtime_metrics  # noqa: F401  (registers all)
+    from ray_tpu import metrics
+    issues = metrics.lint_registry()
+    assert issues == [], issues
+
+
+def test_metrics_lint_catches_bad_metrics():
+    from ray_tpu import metrics
+    bad = [
+        metrics.Counter("ray_tpu_bad_counter", "missing _total suffix"),
+        metrics.Gauge("ray_tpu_bad_help", ""),
+        metrics.Gauge("ray_tpu_bad_sum", "reserved suffix"),
+        metrics.Counter("ray_tpu_bad_tags_total", "too many keys",
+                        ("a", "b", "c", "d", "e")),
+        metrics.Counter("not_prefixed_total", "wrong prefix"),
+    ]
+    try:
+        issues = "\n".join(metrics.lint_registry())
+        assert "ray_tpu_bad_counter" in issues and "_total" in issues
+        assert "ray_tpu_bad_help" in issues and "HELP" in issues
+        assert "ray_tpu_bad_sum" in issues and "reserved" in issues
+        assert "ray_tpu_bad_tags_total" in issues
+        assert "not_prefixed_total" in issues
+    finally:
+        with metrics._lock:
+            for m in bad:
+                metrics._registry.pop(m.name, None)
+    assert metrics.lint_registry() == []
+
+
+def test_cli_metrics_lint_offline():
+    from ray_tpu.scripts import cli
+    cli.main(["metrics", "lint"])  # exits nonzero on any issue
+
+
+# ------------------------------------------ units: flight recorder prune
+
+def test_flight_recorder_write_and_prune(tmp_path, monkeypatch):
+    from ray_tpu.core.config import GlobalConfig
+    from ray_tpu.core import flight_recorder as fr
+    monkeypatch.setitem(GlobalConfig._values, "flight_recorder_dir",
+                        str(tmp_path))
+    monkeypatch.setitem(GlobalConfig._values, "flight_recorder_keep", 3)
+    rec = fr.FlightRecorder(controller=None)
+    bundle = {"meta": {"trigger": "t"}, "spans": [], "metrics": {},
+              "events": [], "nodes": []}
+    for i in range(5):
+        rec._write(f"{1000 + i}_t", bundle)
+    names = fr.list_bundles(str(tmp_path))
+    assert names == ["1002_t", "1003_t", "1004_t"], names
+    files = sorted(os.listdir(tmp_path / "1004_t"))
+    assert files == ["events.json", "meta.json", "metrics.json",
+                     "nodes.json", "spans.json"]
+
+
+# ------------------------------------------------------- units: top render
+
+def test_render_top_offline():
+    from ray_tpu.scripts.cli import render_top
+    nodes = [{"id": "ab" * 16, "state": "ALIVE", "alive": True,
+              "health": {"heartbeat_age_s": 0.2},
+              "clock_offset_s": 0.001}]
+    samples = [
+        {"ts": 1.0, "counters": {"ray_tpu_tasks_finished_total"
+                                 '{node="abababababab"}': [10, 0]},
+         "gauges": {"ray_tpu_event_loop_lag_seconds"
+                    '{node="abababababab"}': 0.002}},
+        {"ts": 1.5, "counters": {"ray_tpu_tasks_finished_total"
+                                 '{node="abababababab"}': [20, 10]},
+         "gauges": {}},
+    ]
+    history = {"interval_s": 0.5, "processes": {
+        f"nodelet@{'ab' * 4}": {"samples": samples}}}
+    attr = {"controller": {
+        "ops": [{"op": "heartbeat", "count": 9, "errors": 0,
+                 "total_s": 0.1, "avg_ms": 11.1, "p50_ms": 10.0,
+                 "p99_ms": 25.0, "max_ms": 30.0, "bytes_in": 900,
+                 "bytes_out": 400}],
+        "wal": {"appends": 4, "append_s": 0.01, "fsync_s": 0.008,
+                "append_max_s": 0.004, "fsync_max_s": 0.003},
+        "loop_lag": {"ewma_ms": 0.5, "max_ms": 2.0}}}
+    frame = render_top(nodes, history, attr)
+    assert "heartbeat" in frame and "WAL:" in frame
+    assert "TASKS/S" in frame and "20.0" in frame  # 10 delta / 0.5s
+
+
+# --------------------------------- acceptance (a): attribution table e2e
+
+def test_rpc_attribution_table_after_wave(cluster):
+    @ray_tpu.remote
+    def obs_wave(x):
+        return x
+
+    @ray_tpu.remote
+    class WaveActor:
+        def ping(self):
+            return 1
+
+    assert ray_tpu.get([obs_wave.remote(i) for i in range(100)],
+                       timeout=120) == list(range(100))
+    actors = [WaveActor.remote() for _ in range(4)]
+    assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                           timeout=120)) == 4
+
+    attr = state.rpc_attribution()
+    ctl = attr["controller"]
+    assert ctl.get("error") is None
+    ops = ctl["ops"]
+    assert len(ops) >= 5, ops
+    # sorted by total handler time, descending
+    totals = [r["total_s"] for r in ops]
+    assert totals == sorted(totals, reverse=True)
+    # the top-3 naming requirement: real handlers with real time/counts
+    top3 = state.top_rpc_ops(3)
+    assert len(top3) == 3
+    for r in top3:
+        assert r["count"] > 0 and r["total_s"] > 0, r
+        assert r["bytes_in"] > 0
+    named = {r["op"] for r in ops}
+    assert "heartbeat" in named  # the steady-state controller op
+    # WAL timing + loop lag ride along (persistence is on by default)
+    assert ctl["wal"]["appends"] > 0
+    assert ctl["wal"]["append_s"] > 0
+    assert "ewma_ms" in ctl["loop_lag"]
+    # nodelet side instrumented too (lease/task traffic)
+    assert attr["nodes"], "nodelet attribution missing"
+    node_ops = {r["op"] for a in attr["nodes"].values()
+                for r in a["ops"]}
+    assert "lease" in node_ops or "register_worker" in node_ops, node_ops
+
+
+# ----------------------------- acceptance (b): metrics history >= 30
+
+def test_metrics_history_30_samples_correct_deltas(cluster):
+    @ray_tpu.remote
+    def tick(x):
+        return x
+
+    # spread work across the sampling window so deltas are non-trivial
+    for _ in range(5):
+        assert ray_tpu.get([tick.remote(i) for i in range(20)],
+                           timeout=60) == list(range(20))
+        time.sleep(0.3)
+
+    name = "ray_tpu_tasks_finished_total"
+
+    def n_samples():
+        h = state.metrics_history(name=name)
+        for label, ser in (h.get("series") or {}).items():
+            if label.startswith("nodelet") and len(ser) >= 30:
+                return True
+        return False
+    _wait_for(n_samples, 30.0, ">=30 history samples of " + name)
+
+    h = state.metrics_history(name=name)
+    assert h["interval_s"] == pytest.approx(0.1)
+    label, ser = next((kv for kv in h["series"].items()
+                       if kv[0].startswith("nodelet") and len(kv[1]) >= 30))
+    # correct deltas: consecutive cumulative differences ARE the deltas,
+    # and the whole window's delta sum matches cumulative growth
+    for prev, cur in zip(ser, ser[1:]):
+        assert cur["value"] >= prev["value"]
+        assert cur["delta"] == pytest.approx(cur["value"] - prev["value"])
+    total_delta = sum(s["delta"] for s in ser[1:])
+    assert total_delta == pytest.approx(ser[-1]["value"] - ser[0]["value"])
+    assert ser[-1]["value"] >= 100, "the 100-task wave must be visible"
+    # raw per-process rings are exposed too (the autoscale loop's feed)
+    procs = h["processes"]
+    assert any(len(p.get("samples", [])) >= 30 for p in procs.values())
+
+
+def test_dashboard_metrics_history_endpoint(cluster):
+    import urllib.request
+    from ray_tpu.dashboard.head import start_dashboard
+    head = start_dashboard(port=8299)
+    with urllib.request.urlopen(
+            head.address + "/api/metrics/history?name="
+            "ray_tpu_tasks_finished_total&last=50", timeout=15) as r:
+        payload = json.loads(r.read())
+    assert payload["interval_s"] == pytest.approx(0.1)
+    assert payload["processes"], payload
+    with urllib.request.urlopen(head.address + "/api/rpc_attribution",
+                                timeout=15) as r:
+        attr = json.loads(r.read())
+    assert attr["controller"]["ops"]
+
+
+# ------------------------ satellite: exited worker's final spans retained
+
+def test_killed_actor_final_spans_retained(cluster):
+    @ray_tpu.remote
+    class LastGasp:
+        def work(self):
+            # span recorded in THIS worker's buffer moments before the
+            # kill below — without the exit flush it would still be
+            # waiting on the 0.25s flush tick when the process dies
+            from ray_tpu.util import tracing
+            t = time.time()
+            tracing.record_span("lastgasp_marker", "test", t, t)
+            return 42
+
+    a = LastGasp.remote()
+    assert ray_tpu.get(a.work.remote(), timeout=60) == 42
+    # kill IMMEDIATELY: the exit path must flush the buffer, and the
+    # controller must RETAIN the dead process's final batch
+    ray_tpu.kill(a)
+    time.sleep(1.0)
+
+    def span_present():
+        evs = [e for e in state.timeline()["traceEvents"]
+               if e.get("ph") == "X"]
+        return any(e["name"] == "lastgasp_marker" for e in evs)
+    _wait_for(span_present, 15.0,
+              "killed actor's final spans in state.timeline()")
+
+
+def test_debug_capture_manual(cluster):
+    cap = state.debug_capture("test grab")
+    assert cap["ok"], cap
+    path = cap["path"]
+    meta = json.load(open(os.path.join(path, "meta.json")))
+    assert meta["trigger"] == "manual" and meta["reason"] == "test grab"
+    spans = json.load(open(os.path.join(path, "spans.json")))
+    assert spans, "bundle must carry spans"
+    nodes = json.load(open(os.path.join(path, "nodes.json")))
+    assert nodes and nodes[0]["state"] == "ALIVE"
+    met = json.load(open(os.path.join(path, "metrics.json")))
+    assert met["rpc_attribution"], met.keys()
+    assert met["history"]["controller"], "metrics window missing"

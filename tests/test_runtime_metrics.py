@@ -49,6 +49,19 @@ def test_cluster_metrics_exposition(cluster):
     assert "# TYPE ray_tpu_node_suspect_transitions_total counter" in text
     assert "# TYPE ray_tpu_object_fetch_fallbacks_total counter" in text
     assert "# TYPE ray_tpu_peer_unreachable_pairs gauge" in text
+    # the PR-10 attribution battery: per-op RPC handler counters (folded
+    # from the rpc.py dispatch table), WAL append/fsync timing, and the
+    # scheduler wave instruments
+    assert "# TYPE ray_tpu_rpc_handler_calls_total counter" in text
+    assert "# TYPE ray_tpu_rpc_handler_seconds_total counter" in text
+    assert "# TYPE ray_tpu_rpc_handler_bytes_total counter" in text
+    assert "# TYPE ray_tpu_controller_wal_appends_total counter" in text
+    assert ("# TYPE ray_tpu_controller_wal_fsync_seconds_total counter"
+            in text)
+    assert "# TYPE ray_tpu_scheduler_waves_total counter" in text
+    assert ("# TYPE ray_tpu_scheduler_queue_depth_at_grant histogram"
+            in text)
+    assert "# TYPE ray_tpu_scheduler_wave_batch_size histogram" in text
 
     def sample_sum(name: str) -> float:
         total = 0.0
@@ -60,6 +73,9 @@ def test_cluster_metrics_exposition(cluster):
     # the battery reflects the work above
     assert sample_sum("ray_tpu_tasks_finished_total") >= 20
     assert sample_sum("ray_tpu_scheduler_leases_granted_total") >= 1
+    assert sample_sum("ray_tpu_rpc_handler_calls_total") >= 20
+    assert sample_sum("ray_tpu_scheduler_waves_total") >= 1
+    assert sample_sum("ray_tpu_controller_wal_appends_total") >= 1
     assert sample_sum("ray_tpu_workers_spawned_total") >= 1
     assert sample_sum("ray_tpu_actors_created_total") >= 1
     assert sample_sum("ray_tpu_nodes_alive") >= 1
