@@ -3,7 +3,8 @@
 PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
-	attr chaos drain failover spec elastic ha partition clean
+	attr chaos drain failover spec elastic ha partition autoscale \
+	autoscale-bench clean
 
 all: native cpp
 
@@ -109,6 +110,19 @@ serve-bench:
 # cadence).  Results merge into SERVE_BENCH.json detail.
 spec-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --spec-bench
+
+# Autoscale suite: pure policy units (trend/hysteresis/cooldown/SUSPECT
+# down-weight/victim pick), prefix-trie units, engine shared-prefix
+# admission parity, controller loop + chaos-dropped-decision retry,
+# router prefix affinity, per-deployment metrics-history filter.
+autoscale:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_autoscale.py -q
+
+# Bursty multi-tenant chat scenario (shared prefixes, sessions joining
+# and leaving): replica-count-vs-load timeline + prefix-hit/cold TTFT,
+# merged into SERVE_BENCH.json's `autoscale` block.
+autoscale-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --autoscale-bench
 
 clean:
 	rm -f ray_tpu/core/object_store/libtpustore.so dist/*.whl

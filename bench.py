@@ -815,6 +815,269 @@ def _spec_bench_main() -> None:
         pass
 
 
+def _run_autoscale_bench() -> dict:
+    """`--autoscale-bench`: bursty multi-tenant chat through the FULL
+    path (HTTP SSE -> proxy -> prefix-affinity router -> autoscaled
+    engine replicas).  Sessions share a long system prompt and join/
+    leave in phases; the ledger records the replica-count-vs-load
+    timeline (the autoscaler tracking the burst and draining back
+    down), prefix-hit vs cold TTFT on a warm replica, and that every
+    stream completed with zero user-visible errors — scale-downs drain
+    via live-session migration, never drop."""
+    import threading
+
+    import requests
+
+    import ray_tpu
+    from ray_tpu import serve, state
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    ray_tpu.init(num_cpus=8)
+    serve.start()
+
+    @serve.deployment(
+        max_concurrent_queries=32,
+        autoscaling_config=AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            occupancy_high=0.7, occupancy_low=0.25,
+            target_occupancy=0.6, trend_window_s=4.0,
+            upscale_delay_s=0.0, downscale_delay_s=2.0))
+    class Chat:
+        def __init__(self):
+            import jax.numpy as jnp
+
+            from ray_tpu.models import TransformerConfig
+            from ray_tpu.serve.config import DecodeEngineConfig
+            from ray_tpu.serve.decode_session import DecodeSessionCore
+            self.core = DecodeSessionCore(
+                TransformerConfig.tiny(max_seq_len=512,
+                                       attention_impl="reference",
+                                       dtype=jnp.float32),
+                max_len=512,
+                engine=DecodeEngineConfig(
+                    max_slots=2, token_queue_depth=4, max_waiting=32,
+                    admission_timeout_s=180.0))
+
+        def engine_stats(self):
+            return self.core.handle({"op": "stats"})
+
+        def __call__(self, req):
+            return self.core.handle(req)
+
+    serve.run(Chat.bind(), name="chat")
+    addr = serve.api.http_address()
+    system = [(13 * j) % 250 for j in range(320)]   # shared sys prompt
+
+    live = {"n": 0}
+    live_lock = threading.Lock()
+    timeline = []
+    stop_sampler = threading.Event()
+
+    def sampler():
+        while not stop_sampler.is_set():
+            try:
+                reps = serve.list_deployments()["chat"]["num_replicas"]
+            except Exception:
+                reps = -1
+            with live_lock:
+                n = live["n"]
+            timeline.append({"t": round(time.perf_counter() - t_base, 2),
+                             "replicas": reps, "live_sessions": n})
+            stop_sampler.wait(0.5)
+
+    errors = []
+
+    def stream(i, tokens=120, pace=0.04, suffix=None):
+        """One paced SSE chat turn; returns (ttft_s, tokens_seen)."""
+        prompt = system + (suffix or [251, (i * 3) % 250, i % 250])
+        with live_lock:
+            live["n"] += 1
+        try:
+            t0 = time.perf_counter()
+            ttft = None
+            seen = 0
+            with requests.post(
+                    f"{addr}/chat/stream",
+                    json={"prompt": prompt, "max_new_tokens": tokens},
+                    stream=True, timeout=600) as r:
+                if r.status_code != 200:
+                    errors.append(f"s{i}: HTTP {r.status_code}")
+                    return None, 0
+                for line in r.iter_lines():
+                    if not line.startswith(b"data: "):
+                        continue
+                    body = line[len(b"data: "):]
+                    if body == b"[DONE]":
+                        break
+                    ev = json.loads(body)
+                    if "error" in ev:
+                        errors.append(f"s{i}: {ev['error']}")
+                        break
+                    if "token" in ev:
+                        if ttft is None:
+                            ttft = time.perf_counter() - t0
+                        seen += 1
+                        time.sleep(pace)   # paced client: session lives
+            if seen < tokens:
+                errors.append(f"s{i}: {seen}/{tokens} tokens")
+            return ttft, seen
+        finally:
+            with live_lock:
+                live["n"] -= 1
+
+    t_base = time.perf_counter()
+    sam = threading.Thread(target=sampler, daemon=True)
+    sam.start()
+    # phase 1 — single tenant (warms compiles; fleet stays at min)
+    stream(0, tokens=30, pace=0.0)
+    # phase 2 — burst: 8 tenants sharing the system prompt join inside
+    # 2s; slots saturate, waiting depth climbs, the fleet must grow
+    threads = []
+    burst_ttfts = []
+
+    def one(i):
+        ttft, _ = stream(i, tokens=120, pace=0.04)
+        if ttft is not None:
+            burst_ttfts.append(ttft)
+    for i in range(1, 9):
+        th = threading.Thread(target=one, args=(i,))
+        th.start()
+        threads.append(th)
+        time.sleep(0.25)
+    for th in threads:
+        th.join(timeout=600)
+    peak = max((p["replicas"] for p in timeline), default=1)
+    # phase 3 — idle: the fleet must drain back to min via the
+    # retirement path (ticks come from the proxy's autoscale nudge)
+    deadline = time.perf_counter() + 60
+    final = peak
+    while time.perf_counter() < deadline:
+        try:
+            final = serve.list_deployments()["chat"]["num_replicas"]
+        except Exception:
+            pass
+        if final == 1:
+            break
+        time.sleep(0.5)
+    # phase 4 — prefix-hit vs cold TTFT on the now-stable warm fleet
+    # (measuring mid-retirement would fold scale-down sheds into the
+    # numbers): seed one donor session with the system prompt, then
+    # A-B streams whose only difference is whether their 320-token
+    # prefix is resident in a slot
+    cold_ttfts, hit_ttfts = [], []
+    _sse_ttft(requests, addr, system + [250], 4)     # donor seed
+    # hits first, back to back: each admission gathers the 320-token
+    # prefix from its predecessor's slot (slots are LIFO-reused, so
+    # interleaving colds here would evict the donor between hits)
+    for i in range(3):
+        th, _ = _sse_ttft(requests, addr, system + [252, i], 8)
+        if th is not None:
+            hit_ttfts.append(th)
+    for i in range(3):
+        # cold: a prompt sharing NOTHING with any resident prefix
+        cold_prompt = [(97 * (i + 1) + j) % 250 for j in range(320)]
+        tc, _ = _sse_ttft(requests, addr, cold_prompt + [i], 8)
+        if tc is not None:
+            cold_ttfts.append(tc)
+    # prefix-cache hit accounting straight from the engines
+    hits = reused = 0
+    try:
+        # engine stats are per replica and the handle load-balances:
+        # sample several times and keep the busiest replica's counts
+        # (a conservative floor on fleet-wide hits)
+        h = serve.get_handle("chat")
+        for _ in range(8):
+            st = h.engine_stats.remote().result(timeout_s=30.0)
+            eng = (st or {}).get("engine") or {}
+            pfx = eng.get("prefix") or {}
+            if pfx.get("applied_hits", 0) >= hits:
+                hits = pfx.get("applied_hits", 0)
+                reused = pfx.get("tokens_reused", 0)
+    except Exception:
+        pass
+    stop_sampler.set()
+    sam.join(timeout=5)
+    # per-deployment occupancy series through the satellite API (the
+    # same series the autoscale loop trended)
+    series_pts = 0
+    try:
+        hist = state.metrics_history(
+            name="ray_tpu_serve_engine_occupied_slots",
+            deployment="chat", kind="gauges")
+        series_pts = sum(len(v) for v in hist.get("series", {}).values())
+    except Exception:
+        pass
+    serve.shutdown()
+    ray_tpu.shutdown()
+    import numpy as np
+    med = (lambda xs: round(float(np.median(xs)) * 1e3, 1)
+           if xs else None)
+    return {
+        "peak_replicas": peak, "final_replicas": final,
+        "burst_sessions": 8, "errors": errors[:10],
+        "zero_user_visible_errors": not errors,
+        "burst_ttft_ms_p50": med(burst_ttfts),
+        "cold_ttft_ms_p50": med(cold_ttfts),
+        "prefix_hit_ttft_ms_p50": med(hit_ttfts),
+        "prefix_applied_hits": hits,
+        "prefix_tokens_reused": reused,
+        "occupancy_series_points": series_pts,
+        "timeline": timeline,
+    }
+
+
+def _sse_ttft(requests, addr, prompt, tokens):
+    """TTFT of one unpaced SSE stream (helper for the cold/hit A-B)."""
+    t0 = time.perf_counter()
+    ttft = None
+    seen = 0
+    with requests.post(f"{addr}/chat/stream",
+                       json={"prompt": prompt,
+                             "max_new_tokens": tokens},
+                       stream=True, timeout=300) as r:
+        if r.status_code != 200:
+            return None, 0
+        for line in r.iter_lines():
+            if not line.startswith(b"data: "):
+                continue
+            body = line[len(b"data: "):]
+            if body == b"[DONE]":
+                break
+            ev = json.loads(body)
+            if "token" in ev:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                seen += 1
+    return ttft, seen
+
+
+def _autoscale_bench_main() -> None:
+    """`python bench.py --autoscale-bench`: run the bursty multi-tenant
+    scenario in a fresh child and merge an `autoscale` block into
+    SERVE_BENCH.json."""
+    try:
+        proc = _spawn("autoscale")
+        result = _extract_json_line(proc.stdout)
+        if proc.returncode != 0 or result is None:
+            result = {"error": (proc.stderr or "").strip()[-400:]}
+    except Exception:
+        result = {"error": traceback.format_exc(limit=3)}
+    print(json.dumps(result))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SERVE_BENCH.json")
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+    except Exception:
+        ledger = {"metric": "serve_gen_ttft_ms_p50", "detail": {}}
+    ledger["autoscale"] = result
+    try:
+        with open(path, "w") as f:
+            json.dump(ledger, f)
+    except OSError:
+        pass
+
+
 def _run_rl_measurement() -> dict:
     """PPO env-steps/s on the local device mesh (BASELINE north star #3:
     100k env-steps/s).  Uses DDPPO — every device a learner, pmean grad
@@ -859,6 +1122,12 @@ def _child_main(mode: str) -> None:
         os.environ["RAY_TPU_DEVICE_BACKEND"] = "cpu"
         print(json.dumps(_run_serve_measurement()))
         return
+    if mode == "autoscale":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["RAY_TPU_DEVICE_BACKEND"] = "cpu"
+        print(json.dumps(_run_autoscale_bench()))
+        return
     if mode == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
@@ -868,7 +1137,7 @@ def _child_main(mode: str) -> None:
 def _spawn(mode: str) -> "subprocess.CompletedProcess":
     env = dict(os.environ)
     env[_CHILD_FLAG] = mode
-    if mode in ("cpu", "serve"):
+    if mode in ("cpu", "serve", "autoscale"):
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["RAY_TPU_DEVICE_BACKEND"] = "cpu"
@@ -1167,6 +1436,9 @@ def main() -> None:
         return
     if "--spec-bench" in sys.argv:
         _spec_bench_main()
+        return
+    if "--autoscale-bench" in sys.argv:
+        _autoscale_bench_main()
         return
     if "--attr" in sys.argv:
         _attr_main()

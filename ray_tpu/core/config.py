@@ -381,6 +381,22 @@ _d("serve_session_migration_timeout_s", float, 30.0,
    "How long the serve controller waits for live decode sessions to "
    "migrate off a draining replica before stopping it anyway (the "
    "proxy-side failover path then covers any stragglers).")
+_d("serve_autoscale_interval_s", float, 1.0,
+   "Cadence of the serve controller's autoscale loop (occupancy-trend "
+   "policy over metrics history; serve/autoscaler.py).  Ticks ride "
+   "router metric reports, snapshot polls, and the HTTP proxies' "
+   "periodic nudge, throttled to this interval; <= 0 disables the "
+   "loop (deployments keep their static replica counts).")
+_d("serve_engine_metrics_interval_s", float, 0.5,
+   "How often a replica's decode engine pushes occupancy/waiting/"
+   "prefix-cache samples to its nodelet (gauges labeled by deployment "
+   "and replica, so `state.metrics_history` serves per-deployment "
+   "series to the autoscaler and `ray-tpu top`).")
+_d("serve_replica_boot_ewma_alpha", float, 0.3,
+   "EWMA weight of the newest observed replica boot time (start -> "
+   "ALIVE).  The smoothed boot time becomes the Retry-After on typed "
+   "503s shed while a scale-up is in flight, so clients re-arrive "
+   "right as capacity lands instead of on the generic backoff floor.")
 _d("serve_gang_ready_timeout_s", float, 300.0,
    "How long gang-replica bring-up may take (PG + N actors + "
    "jax.distributed rendezvous + model load) before the replica is "

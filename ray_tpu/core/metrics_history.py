@@ -128,18 +128,41 @@ class MetricsRing:
                 "samples": self.history(last)}
 
 
+def parse_labels(key: str) -> Dict[str, str]:
+    """Labels of one sample key (``name{a="x",b="y"}`` form).  Values
+    produced by ``metrics._fmt_tags`` never contain quotes or commas,
+    so a split parser is exact here."""
+    if "{" not in key:
+        return {}
+    body = key.split("{", 1)[1].rstrip("}")
+    out: Dict[str, str] = {}
+    for part in body.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
 def series(samples: List[dict], name: str,
-           kind: str = "counters") -> List[dict]:
+           kind: str = "counters",
+           labels: Optional[Dict[str, str]] = None) -> List[dict]:
     """Extract one metric family's samples: every sample key whose name
-    part (before any ``{``) equals ``name``.  Counter entries yield
-    ``{"ts", "key", "value", "delta"}``; gauges ``{"ts", "key",
-    "value"}``."""
+    part (before any ``{``) equals ``name`` — and, with ``labels``,
+    whose key carries every given label value (server-side filtering
+    for per-deployment serve series: no client regex over merged
+    rings).  Counter entries yield ``{"ts", "key", "value", "delta"}``;
+    gauges ``{"ts", "key", "value"}``."""
     out = []
     for s in samples:
         for key, v in s.get(kind, {}).items():
             base = key.split("{", 1)[0]
             if base != name:
                 continue
+            if labels:
+                got = parse_labels(key)
+                if any(got.get(k) != str(want)
+                       for k, want in labels.items()):
+                    continue
             if kind == "counters":
                 out.append({"ts": s["ts"], "key": key,
                             "value": v[0], "delta": v[1]})

@@ -172,7 +172,7 @@ class Nodelet:
                      "task_state", "task_state_batch", "node_stats",
                      "tail_log", "task_spans", "prestart_workers",
                      "metrics_text", "rpc_attribution", "metrics_history",
-                     "chaos_injected",
+                     "chaos_injected", "serve_metrics",
                      "drain", "drain_status", "drain_evacuate",
                      "drain_complete", "detach_kill_worker",
                      "peer_probe", "probe_peer_now"):
@@ -1863,6 +1863,35 @@ class Nodelet:
                 "loop_lag": {
                     "ewma_ms": getattr(self, "_lag_ewma", 0.0) * 1e3,
                     "max_ms": getattr(self, "_lag_max", 0.0) * 1e3}}
+
+    async def _h_serve_metrics(self, conn, data):
+        """Serve-plane samples pushed by THIS node's worker processes
+        (replica decode engines every serve_engine_metrics_interval_s;
+        the serve controller after autoscale ticks).  Worker registries
+        are never scraped, so folding the samples into the NODELET's
+        registry — labeled by deployment/replica — is what puts
+        per-deployment occupancy, waiting depth, and replica count into
+        the metrics-history ring the autoscale loop and `ray-tpu top`
+        read."""
+        dep = str(data.get("deployment") or "?")
+        rep = data.get("replica")
+        if rep is not None:
+            tags = {"deployment": dep, "replica": str(rep)}
+            rtm.SERVE_ENGINE_OCCUPIED.set(
+                float(data.get("occupied", 0)), tags)
+            rtm.SERVE_ENGINE_WAITING.set(
+                float(data.get("waiting", 0)), tags)
+            rtm.SERVE_ENGINE_SLOTS.set(
+                float(data.get("max_slots", 0)), tags)
+        if "replicas" in data:
+            rtm.SERVE_DEPLOYMENT_REPLICAS.set(
+                float(data["replicas"]), {"deployment": dep})
+        for direction in ("up", "down"):
+            n = data.get(f"decisions_{direction}")
+            if n:
+                rtm.SERVE_AUTOSCALE_DECISIONS.inc(
+                    int(n), {"deployment": dep, "direction": direction})
+        return True
 
     async def _h_metrics_history(self, conn, data):
         """This nodelet's bounded metrics-history ring (fixed-interval

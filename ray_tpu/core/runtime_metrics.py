@@ -102,6 +102,44 @@ SERVE_PREFILL_CHUNKS = m.Counter(
     "chunked admission and failover resume share these programs, and "
     "each one is the most a joining session may stall live streams",
     ("deployment",))
+SERVE_PREFIX_HITS = m.Counter(
+    "ray_tpu_serve_prefix_hits_total",
+    "Engine admissions seeded from a live slot's shared prompt prefix "
+    "(serve/prefix_cache.py): the session prefilled only its unshared "
+    "suffix instead of the whole prompt", ("deployment",))
+SERVE_PREFIX_TOKENS_REUSED = m.Counter(
+    "ray_tpu_serve_prefix_tokens_reused_total",
+    "Prompt tokens whose prefill was skipped by shared-prefix KV reuse "
+    "(copied out of a donor decode slot via models.cache_gather_slot)",
+    ("deployment",))
+SERVE_ENGINE_OCCUPIED = m.Gauge(
+    "ray_tpu_serve_engine_occupied_slots",
+    "Occupied decode slots per serve engine, folded into the NODELET's "
+    "registry from replica `serve_metrics` pushes — the per-deployment "
+    "occupancy series the autoscale loop trends via metrics history",
+    ("deployment", "replica"))
+SERVE_ENGINE_WAITING = m.Gauge(
+    "ray_tpu_serve_engine_waiting_sessions",
+    "Sessions waiting for a decode slot (admission queue + mid-prefill) "
+    "per serve engine; nodelet-folded like occupied_slots — waiting "
+    "depth trending up is the autoscaler's scale-up-before-shedding "
+    "signal", ("deployment", "replica"))
+SERVE_ENGINE_SLOTS = m.Gauge(
+    "ray_tpu_serve_engine_max_slots",
+    "Compiled decode-slot capacity per serve engine (DecodeEngineConfig"
+    ".max_slots); capacity denominator of the autoscaler's utilization",
+    ("deployment", "replica"))
+SERVE_DEPLOYMENT_REPLICAS = m.Gauge(
+    "ray_tpu_serve_deployment_replicas",
+    "Serving replica count per deployment as pushed by the serve "
+    "controller's autoscale loop — with the occupancy series, the "
+    "replica-count-vs-load timeline of the autoscale bench",
+    ("deployment",))
+SERVE_AUTOSCALE_DECISIONS = m.Counter(
+    "ray_tpu_serve_autoscale_decisions_total",
+    "Applied serve autoscale decisions by direction (up | down); "
+    "nodelet-folded from serve controller pushes so history/top see "
+    "scale activity", ("deployment", "direction"))
 SERVE_SPEC_PROPOSED = m.Counter(
     "ray_tpu_serve_spec_tokens_proposed_total",
     "Draft-model tokens offered to speculative verification by serve "

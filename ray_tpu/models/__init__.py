@@ -8,6 +8,7 @@ Pallas attention (`ray_tpu.ops`).
 """
 
 from .generate import (  # noqa: F401
+    cache_gather_slot,
     cache_insert_slot,
     decode_step,
     decode_step_slots,

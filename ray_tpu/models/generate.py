@@ -316,6 +316,30 @@ def cache_insert_slot(slot_cache: KVCache, cache: KVCache,
     }
 
 
+def cache_gather_slot(slot_cache: KVCache, slot: jnp.ndarray,
+                      upto: jnp.ndarray) -> KVCache:
+    """Extract slot ``slot`` of a slot-batched cache as a batch-1 cache
+    TRUNCATED to its first ``upto`` positions — the prefix-reuse
+    admission primitive (inverse of :func:`cache_insert_slot`).
+
+    A new session whose prompt shares ``upto`` tokens with a live
+    slot's prompt seeds its prefill cache from this copy and chunk-
+    prefills only the unshared suffix.  The K/V rows at positions >=
+    ``upto`` still hold the donor's LATER tokens, but they sit past the
+    returned ``pos`` and every prefill/decode program masks reads to
+    positions <= pos — the same stale-rows-are-invisible invariant
+    paused slots and rejected speculative writes rely on — and the
+    suffix prefill overwrites them before ``pos`` ever reaches them.
+    ``slot`` and ``upto`` are TRACED, so one compiled program serves
+    every (donor slot, prefix length) pair."""
+    nl, _, max_len, hk, hd = slot_cache["k"].shape
+    k = jax.lax.dynamic_slice(slot_cache["k"], (0, slot, 0, 0, 0),
+                              (nl, 1, max_len, hk, hd))
+    v = jax.lax.dynamic_slice(slot_cache["v"], (0, slot, 0, 0, 0),
+                              (nl, 1, max_len, hk, hd))
+    return {"k": k, "v": v, "pos": jnp.asarray(upto, jnp.int32)}
+
+
 def _rotate_slots(x: jnp.ndarray, cos: jnp.ndarray,
                   sin: jnp.ndarray) -> jnp.ndarray:
     """apply_rotary for PER-SLOT positions: cos/sin are [S, 1, 1, hd//2]

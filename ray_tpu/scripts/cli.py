@@ -451,6 +451,38 @@ def render_top(nodes, history, attr, top_k: int = 10) -> str:
             f"{_fmt_rate(rate('ray_tpu_scheduler_leases_granted_total')):>9} "
             f"{hb:>7} {lag * 1e3:>7.1f} "
             f"{float(n.get('clock_offset_s') or 0.0) * 1e3:>10.1f}")
+    # serve fleet (engine + serve-controller pushes folded into the
+    # nodelet rings): per-deployment replica count and slot pressure —
+    # the autoscaler's own view of the world
+    dep_rep, dep_eng = {}, {}
+    for proc in (history.get("processes") or {}).values():
+        samples = (proc or {}).get("samples", [])
+        for pt in mh.series(samples, "ray_tpu_serve_deployment_replicas",
+                            "gauges"):
+            dep = mh.parse_labels(pt["key"]).get("deployment", "?")
+            dep_rep[dep] = pt["value"]          # time-ordered: last wins
+        for fam, field in (("ray_tpu_serve_engine_occupied_slots", 0),
+                           ("ray_tpu_serve_engine_max_slots", 1),
+                           ("ray_tpu_serve_engine_waiting_sessions", 2)):
+            for pt in mh.series(samples, fam, "gauges"):
+                lb = mh.parse_labels(pt["key"])
+                key = (lb.get("deployment", "?"), lb.get("replica", "?"))
+                dep_eng.setdefault(key, [0.0, 0.0, 0.0])[field] = \
+                    pt["value"]
+    if dep_rep or dep_eng:
+        lines.append("")
+        lines.append(f"SERVE — {'DEPLOYMENT':<18} {'REPLICAS':>8} "
+                     f"{'OCC/SLOTS':>10} {'WAITING':>8}")
+        deps = sorted(set(dep_rep) | {d for d, _ in dep_eng})
+        for dep in deps:
+            occ = sum(v[0] for (d, _), v in dep_eng.items() if d == dep)
+            slots = sum(v[1] for (d, _), v in dep_eng.items() if d == dep)
+            wait = sum(v[2] for (d, _), v in dep_eng.items() if d == dep)
+            reps = dep_rep.get(dep)
+            lines.append(
+                f"        {dep:<18} "
+                f"{('%d' % reps) if reps is not None else '-':>8} "
+                f"{'%g/%g' % (occ, slots):>10} {wait:>8g}")
     ctl = attr.get("controller") or {}
     ops = list(ctl.get("ops") or [])[:top_k]
     lines.append("")

@@ -36,8 +36,10 @@ from .air_integrations import (  # noqa: F401
     pandas_read_json,
 )
 from .batching import batch  # noqa: F401
+from .autoscaler import Decision, FleetSample, ReplicaView  # noqa: F401
 from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
 from .config import DecodeEngineConfig  # noqa: F401
+from .prefix_cache import PrefixIndex  # noqa: F401
 from .deployment import Deployment, deployment  # noqa: F401
 from .failover import FailoverSession, StreamFailedError  # noqa: F401
 from .ingress import ingress, route  # noqa: F401

@@ -1,0 +1,173 @@
+"""Serve fleet autoscaling: the pure decision policy.
+
+The serve controller's loop (`serve/controller.py::_maybe_autoscale`)
+gathers signals — per-replica decode-engine occupancy/waiting series
+from `state.metrics_history` (pushed by engines, labeled by deployment
+and replica), router-reported in-flight counts as the fallback for
+plain deployments, and SUSPECT node membership from the `nodes` pubsub
+— and hands them to :func:`decide`, a pure function of explicit inputs
+(no clocks, no RPCs), so every branch is unit-testable offline:
+
+* **scale up on trends, before saturation sheds**: recent utilization
+  over the high watermark, or sessions waiting for slots, grows the
+  fleet toward ``target_occupancy`` — clients should never meet the
+  admission-backpressure 503 when the trend saw the burst coming;
+* **hysteresis + cooldown**: the ``[occupancy_low, occupancy_high]``
+  band holds steady, and each direction has its own cooldown, so
+  bursty traffic cannot flap replicas (reference:
+  serve/_private/autoscaling_policy.py's delay semantics);
+* **SUSPECT down-weighting**: a replica on a quarantined (gray) node
+  counts at ``suspect_weight`` capacity — the fleet pre-emptively
+  grows around a brownout — and suspect replicas are first in line as
+  scale-down victims;
+* **scale down drains, never drops**: the decision names its victims
+  (suspect first, then least-loaded); the controller retires them via
+  the PR-3/5 drain path (engine sheds new starts, live sessions
+  migrate via the failover client) instead of killing them outright.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaView:
+    """One replica's share of the fleet signal at decision time."""
+    replica_id: str
+    # latest demand on this replica: occupied decode slots (engine
+    # replicas) or router-reported in-flight requests (plain replicas)
+    occupied: float = 0.0
+    # sessions queued for admission (engine ``waiting + prefilling``;
+    # plain replicas have no queue visibility -> 0)
+    waiting: float = 0.0
+    # capacity unit: decode slots, or target_num_ongoing_requests_per_
+    # replica for plain replicas
+    capacity: float = 1.0
+    suspect: bool = False       # node quarantined (PR-9 gray failure)
+    retiring: bool = False      # already draining out: not capacity
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSample:
+    """One point of the trended series: aggregate utilization of the
+    fleet at ``ts`` (occupied / weighted capacity) plus total waiting
+    depth.  The controller builds these from metrics history (engine
+    deployments) or its own router-report ring (plain deployments)."""
+    ts: float
+    utilization: float
+    waiting: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    target: int                     # desired replica count (serving)
+    reason: str
+    # replicas to retire when target < current, best victims first
+    victims: Tuple[str, ...] = ()
+
+    @property
+    def direction(self) -> int:
+        return 0 if not self.reason else (
+            1 if self.reason.startswith("up") else
+            -1 if self.reason.startswith("down") else 0)
+
+
+def _cfg(auto: Dict, key: str, default: float) -> float:
+    v = auto.get(key)
+    return default if v is None else float(v)
+
+
+def weighted_capacity(replicas: Sequence[ReplicaView],
+                      suspect_weight: float) -> float:
+    return sum((r.capacity * (suspect_weight if r.suspect else 1.0))
+               for r in replicas if not r.retiring)
+
+
+def fleet_sample(ts: float, replicas: Sequence[ReplicaView],
+                 suspect_weight: float) -> FleetSample:
+    """Fold per-replica views into one trend point."""
+    cap = weighted_capacity(replicas, suspect_weight)
+    occ = sum(r.occupied for r in replicas if not r.retiring)
+    wait = sum(r.waiting for r in replicas if not r.retiring)
+    return FleetSample(ts=ts, utilization=(occ / cap) if cap > 0 else
+                       (1.0 if (occ or wait) else 0.0), waiting=wait)
+
+
+def pick_victims(replicas: Sequence[ReplicaView], n: int) -> Tuple[str, ...]:
+    """Scale-down victims: suspect replicas first (their capacity is
+    already down-weighted away), then least-loaded — retiring the
+    emptiest replica migrates the fewest live sessions."""
+    pool = [r for r in replicas if not r.retiring]
+    pool.sort(key=lambda r: (not r.suspect, r.occupied + r.waiting))
+    return tuple(r.replica_id for r in pool[:max(0, n)])
+
+
+def decide(auto: Dict, replicas: Sequence[ReplicaView],
+           series: Sequence[FleetSample], now: float,
+           last_up: float = 0.0, last_down: float = 0.0) -> Decision:
+    """Pure autoscale decision.
+
+    ``auto`` is the deployment's autoscaling_config mapping (missing
+    keys fall back to :class:`AutoscalingConfig` defaults, so dict
+    configs from YAML deploys work unchanged); ``replicas`` the current
+    fleet view; ``series`` the time-ordered trend samples (the newest
+    matter; empty series = no signal, hold); ``now``/``last_up``/
+    ``last_down`` are explicit clocks so cooldown is testable."""
+    cur = sum(1 for r in replicas if not r.retiring)
+    lo = int(_cfg(auto, "min_replicas", 1))
+    hi = int(_cfg(auto, "max_replicas", 4))
+    if cur < lo:
+        return Decision(lo, "up:below-min")
+    window_s = _cfg(auto, "trend_window_s", 10.0)
+    occ_high = _cfg(auto, "occupancy_high", 0.8)
+    occ_low = _cfg(auto, "occupancy_low", 0.3)
+    target_occ = max(0.05, _cfg(auto, "target_occupancy", 0.6))
+    suspect_w = _cfg(auto, "suspect_weight", 0.25)
+    win = [s for s in series if s.ts >= now - window_s]
+    if not win:
+        return Decision(cur, "")
+    latest = win[-1]
+    # recent = newest half of the window's SAMPLES: the trend's "where
+    # is it heading" read (a single hot sample does not scale the
+    # fleet, a sustained climb does; count-based halving stays correct
+    # whatever the tick cadence)
+    half = win[len(win) // 2:] or [latest]
+    recent_u = sum(s.utilization for s in half) / len(half)
+    recent_wait = sum(s.waiting for s in half) / len(half)
+    avg_u = sum(s.utilization for s in win) / len(win)
+    avg_wait = sum(s.waiting for s in win) / len(win)
+
+    cap_unit = max(0.05, (weighted_capacity(replicas, suspect_w) / cur)
+                   if cur else _cfg(auto, "target_num_ongoing_requests_"
+                                    "per_replica", 2.0))
+    demand = latest.utilization * weighted_capacity(replicas, suspect_w) \
+        + latest.waiting
+
+    # waiting depth only counts as pressure when slots are actually
+    # busy — one session transiting the admission queue while the
+    # fleet has free capacity is latency, not load, and scaling on it
+    # flaps the fleet on every trickle
+    wait_pressure = recent_wait >= 1.0 and recent_u >= target_occ
+    if (recent_u >= occ_high or wait_pressure) and cur < hi:
+        if now - last_up < _cfg(auto, "upscale_delay_s", 0.0):
+            return Decision(cur, "")          # cooldown: hold
+        desired = int(math.ceil(demand / (target_occ * cap_unit)))
+        desired = min(hi, max(desired, cur + 1))
+        return Decision(desired, "up:occupancy-trend")
+
+    if recent_u < occ_high and avg_u <= occ_low and avg_wait < 0.5 \
+            and cur > lo:
+        if now - last_down < _cfg(auto, "downscale_delay_s", 2.0):
+            return Decision(cur, "")
+        desired = int(math.ceil(demand / (target_occ * cap_unit))) \
+            if demand > 0 else lo
+        desired = max(lo, min(desired, cur - 1))
+        if desired >= cur:
+            return Decision(cur, "")
+        return Decision(desired, "down:idle",
+                        victims=pick_victims(replicas, cur - desired))
+
+    return Decision(cur, "")   # hysteresis band: hold steady

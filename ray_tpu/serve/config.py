@@ -10,9 +10,33 @@ from typing import Any, Dict, Optional
 class AutoscalingConfig:
     min_replicas: int = 1
     max_replicas: int = 4
+    # capacity unit of a replica WITHOUT a decode engine: router-
+    # reported in-flight requests per replica (the pre-engine signal,
+    # still the fallback for plain deployments).  Engine replicas use
+    # their real slot capacity instead.
     target_num_ongoing_requests_per_replica: float = 2.0
+    # cooldowns between applied scale decisions, per direction —
+    # hysteresis in time, so bursty traffic cannot flap the fleet
     upscale_delay_s: float = 0.0
     downscale_delay_s: float = 2.0
+    # -- occupancy-trend policy (serve/autoscaler.py) ---------------------
+    # utilization the fleet is sized toward after a scale decision
+    target_occupancy: float = 0.6
+    # scale up once recent utilization crosses this watermark (or any
+    # sessions are waiting for slots) — BEFORE saturation sheds
+    occupancy_high: float = 0.8
+    # scale down only when utilization over the whole trend window
+    # stays under this watermark; the [low, high] band is the
+    # hysteresis dead zone where the fleet holds steady
+    occupancy_low: float = 0.3
+    # look-back the policy trends over (occupancy/waiting series from
+    # `state.metrics_history` or the controller's own sample ring)
+    trend_window_s: float = 10.0
+    # capacity weight of a replica whose node is SUSPECT (gray
+    # failure): counting it at full weight hides the brownout, zero
+    # would thrash on every transient quarantine.  Down-weighted
+    # replicas are also first in line as scale-down victims.
+    suspect_weight: float = 0.25
 
 
 @dataclasses.dataclass
@@ -68,6 +92,16 @@ class DecodeEngineConfig:
     # prompt is prefilled by the engine thread; a wedged engine must not
     # hang the caller forever — timeout sheds with the typed 503)
     admission_timeout_s: float = 60.0
+    # -- shared-prefix KV reuse -------------------------------------------
+    # admission consults a radix trie over live slots' prompts
+    # (serve/prefix_cache.py): a new session sharing a prefix with a
+    # resident slot copies those K/V rows (`models.cache_gather_slot`)
+    # and chunk-prefills ONLY the unshared suffix — shared system
+    # prompts skip their prefill entirely
+    prefix_cache: bool = True
+    # minimum shared tokens worth a gather dispatch (a 1-2 token match
+    # costs more in dispatch than it saves in prefill)
+    prefix_cache_min_tokens: int = 4
     # -- speculative decoding ---------------------------------------------
     # draft model proposing tokens for the target to verify in one
     # batched k-token forward.  None disables; "shared" weight-shares

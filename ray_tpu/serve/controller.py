@@ -43,6 +43,22 @@ class ServeController:
         # "replacement"} — the replacement is pre-started BEFORE the
         # draining replica stops, so capacity never dips
         self._evacuations: Dict[str, Dict[str, Any]] = {}
+        # autoscale scale-downs in flight: replica id -> {"name",
+        # "deadline"} — the victim drains (engine sheds new starts,
+        # live sessions migrate via the failover client) and is only
+        # killed at live_sessions == 0 or the migration deadline, so a
+        # scale-down never drops a stream
+        self._retiring: Dict[str, Dict[str, Any]] = {}
+        # SUSPECT (gray) nodes from the pubsub push: their replicas'
+        # capacity is down-weighted by the autoscale policy, growing
+        # the fleet around a brownout before it shows up as errors
+        self._suspect_nodes: set = set()
+        # replica boot-time EWMA (start -> ALIVE in the actor table):
+        # the Retry-After on scale-up-in-progress sheds, so clients
+        # re-arrive right as the new capacity lands
+        self._boot_pending: Dict[str, float] = {}
+        self._boot_ewma: Optional[float] = None
+        self._last_autoscale = 0.0
         # Node-membership push: a dead/draining node invalidates the
         # replica->node locality cache immediately.  A migrated replica
         # (same actor, new node) otherwise keeps its stale annotation
@@ -58,12 +74,23 @@ class ServeController:
     def _on_node_event(self, data: Dict[str, Any]) -> None:
         """A node DIED: drop its replicas' locality annotations so
         routers stop evicting replicas that are mid-restart elsewhere.
-        DRAINING keeps the annotations — that eviction is the point."""
-        if data.get("event") != "dead":
+        DRAINING keeps the annotations — that eviction is the point.
+        SUSPECT membership feeds the autoscale policy's capacity
+        down-weighting (routers route around those nodes on their own
+        copy of the same events)."""
+        ev = data.get("event")
+        nid = data.get("node_id") or (data.get("node") or {}).get("id")
+        if ev == "suspect" and nid:
+            self._suspect_nodes.add(nid)
             return
-        nid = data.get("node_id")
+        if ev in ("rejoined", "added") and nid:
+            self._suspect_nodes.discard(nid)
+            return
+        if ev != "dead":
+            return
         if not nid:
             return
+        self._suspect_nodes.discard(nid)
         stale = [rid for rid, n in self._replica_nodes.items() if n == nid]
         for rid in stale:
             self._replica_nodes.pop(rid, None)
@@ -156,6 +183,7 @@ class ServeController:
                  cfg.get("user_config"))
         rep = {"id": rid, "handle": handle}
         entry["replicas"].append(rep)
+        self._boot_pending[rid] = time.monotonic()
         return rep
 
     def _scale_to(self, name: str, target: int) -> None:
@@ -166,6 +194,8 @@ class ServeController:
         while len(entry["replicas"]) > target:
             rep = entry["replicas"].pop()
             self._replica_nodes.pop(rep["id"], None)
+            self._boot_pending.pop(rep["id"], None)
+            self._retiring.pop(rep["id"], None)
             self._audit_kill(name, rep["id"], target)
             if rep.get("gang"):
                 from .gang import stop_gang_replica
@@ -183,7 +213,9 @@ class ServeController:
         request races a kill, the events API says who killed what."""
         why = (f"scale to {target}" if target >= 0
                else "node draining; replacement pre-started"
-               if target == -2 else "found dead; replacing")
+               if target == -2
+               else "autoscale down; sessions migrated first"
+               if target == -3 else "found dead; replacing")
         try:
             from .. import state
             state.report_event(
@@ -491,9 +523,11 @@ class ServeController:
         # polling snapshot; without this hook the stale annotations
         # would never refresh and the outage would be permanent.
         self._maybe_evacuate_draining()
+        self._maybe_autoscale()
         if known_version == self._version:
             return None
         self._resolve_replica_nodes()
+        now = time.monotonic()
         table = {}
         for name, entry in self._deployments.items():
             table[name] = {
@@ -501,9 +535,18 @@ class ServeController:
                 "ingress": entry["config"].get("ingress", False),
                 "max_concurrent_queries":
                     entry["config"].get("max_concurrent_queries", 8),
+                # boot-EWMA Retry-After while a scale-up is in flight:
+                # routers stamp it on typed sheds so clients re-arrive
+                # as the new replica lands
+                "scaleup_retry_after_s":
+                    self._scaleup_retry_after(name, now),
                 "replicas": [{"id": r["id"], "handle": r["handle"],
                               "node_id":
-                                  self._replica_nodes.get(r["id"])}
+                                  self._replica_nodes.get(r["id"]),
+                              # retiring (autoscale drain-down): keep
+                              # sid-sticky session ops flowing, take no
+                              # NEW sessions
+                              "draining": bool(r.get("retiring"))}
                              for r in entry["replicas"]],
             }
         return {"version": self._version, "table": table}
@@ -516,9 +559,12 @@ class ServeController:
                 for name, e in self._deployments.items()}
 
     # -- autoscaling --------------------------------------------------------
-    def report_metrics(self, name: str, ongoing_per_replica: List[int]
-                       ) -> bool:
-        """Router-reported in-flight counts drive the basic autoscaler."""
+    def report_metrics(self, name: str, ongoing_per_replica) -> bool:
+        """Router-reported in-flight counts: the occupancy fallback for
+        deployments without a decode engine, and one of the tick
+        sources of the autoscale loop.  ``ongoing_per_replica`` is a
+        {replica_id: in_flight} mapping (older routers sent a bare
+        list; tolerated)."""
         self._maybe_reconcile_proxies()
         self._maybe_heal_replicas()     # 5s-throttled internally
         self._maybe_evacuate_draining()  # 2s-throttled internally
@@ -526,22 +572,311 @@ class ServeController:
         entry = self._deployments.get(name)
         if entry is None:
             return False
-        cfg = entry["config"]
-        auto = cfg.get("autoscaling_config")
-        if not auto:
-            return True
-        now = time.monotonic()
-        n = max(len(ongoing_per_replica), 1)
-        avg = sum(ongoing_per_replica) / n
-        target_per = auto["target_num_ongoing_requests_per_replica"]
-        desired = min(max(
-            int(-(-sum(ongoing_per_replica) // target_per) or 1),
-            auto["min_replicas"]), auto["max_replicas"])
-        cur = len(entry["replicas"])
-        delay = (auto["upscale_delay_s"] if desired > cur
-                 else auto["downscale_delay_s"])
-        if desired != cur and now - entry["last_scaled"] >= delay:
-            entry["last_scaled"] = now
-            cfg["num_replicas"] = desired
-            self._scale_to(name, desired)
+        if not isinstance(ongoing_per_replica, dict):
+            ongoing_per_replica = {
+                r["id"]: c for r, c in zip(entry["replicas"],
+                                           ongoing_per_replica or [])}
+        entry["metrics"] = {"ongoing": dict(ongoing_per_replica),
+                            "ts": time.monotonic()}
+        self._maybe_autoscale()         # interval-throttled internally
         return True
+
+    def autoscale_tick(self) -> bool:
+        """Explicit loop nudge (HTTP proxies schedule one per
+        serve_autoscale_interval_s): keeps the autoscaler — and the
+        piggybacked heal/evacuate reconciles — ticking through idle
+        valleys, when no request traffic is polling snapshots, so
+        scale-DOWN to min_replicas happens without a client trickle."""
+        self._maybe_reconcile_proxies()
+        self._maybe_heal_replicas()
+        self._maybe_evacuate_draining()
+        self._maybe_autoscale()
+        return True
+
+    def _maybe_autoscale(self) -> None:
+        """One pass of the autoscale loop, throttled to
+        serve_autoscale_interval_s: fold boot observations, advance
+        in-flight retirements, then decide each autoscaled deployment
+        via the pure policy (serve/autoscaler.py) over engine
+        occupancy series (metrics history) or router-reported counts."""
+        from ..core.config import GlobalConfig
+        iv = GlobalConfig.serve_autoscale_interval_s
+        if iv is None or iv <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_autoscale < iv:
+            return
+        self._last_autoscale = now
+        self._observe_boots(now)
+        self._tick_retirements(now)
+        autoscaled = [name for name, e in self._deployments.items()
+                      if e["config"].get("autoscaling_config")]
+        if autoscaled:
+            hist = self._engine_history()
+            for name in autoscaled:
+                entry = self._deployments.get(name)
+                if entry is None:
+                    continue
+                try:
+                    self._autoscale_one(name, entry, now, hist)
+                except Exception:
+                    # chaos 'error' action or a transient state-API
+                    # failure: the decision is re-derived next tick
+                    pass
+        self._push_deployment_metrics()
+
+    @staticmethod
+    def _engine_history() -> Dict[str, Any]:
+        """Latest engine-pushed serve gauges from every process's
+        metrics-history ring (state.metrics_history plumbing): the
+        occupancy/waiting signal for engine deployments.  One fetch
+        per tick, shared by every deployment's decision."""
+        try:
+            from .. import state
+            return state.metrics_history(last=4)
+        except Exception:
+            return {}
+
+    def _latest_engine_gauges(self, hist: Dict[str, Any],
+                              name: str) -> Dict[str, Dict[str, float]]:
+        """{replica_id: {occupied, waiting, max_slots}} from the newest
+        history sample carrying this deployment's label."""
+        from ..core import metrics_history as mh
+        out: Dict[str, Dict[str, float]] = {}
+        fam = {"occupied": "ray_tpu_serve_engine_occupied_slots",
+               "waiting": "ray_tpu_serve_engine_waiting_sessions",
+               "max_slots": "ray_tpu_serve_engine_max_slots"}
+        for proc in (hist.get("processes") or {}).values():
+            samples = proc.get("samples") or []
+            for field, metric in fam.items():
+                for pt in mh.series(samples, metric, kind="gauges",
+                                    labels={"deployment": name}):
+                    rid = mh.parse_labels(pt["key"]).get("replica")
+                    if not rid:
+                        continue
+                    slot = out.setdefault(rid, {})
+                    # series is time-ordered: the last write wins
+                    slot[field] = float(pt["value"])
+        return out
+
+    def _autoscale_one(self, name: str, entry: Dict[str, Any],
+                       now: float, hist: Dict[str, Any]) -> None:
+        import collections
+
+        from . import autoscaler
+        auto = entry["config"]["autoscaling_config"]
+        gauges = self._latest_engine_gauges(hist, name)
+        ongoing = (entry.get("metrics") or {}).get("ongoing") or {}
+        target_per = float(auto.get(
+            "target_num_ongoing_requests_per_replica", 2.0) or 2.0)
+        views = []
+        for rep in entry["replicas"]:
+            rid = rep["id"]
+            g = gauges.get(rid)
+            if g and g.get("max_slots"):
+                occupied = g.get("occupied", 0.0)
+                waiting = g.get("waiting", 0.0)
+                capacity = g["max_slots"]
+            else:
+                occupied = float(ongoing.get(rid, 0.0))
+                waiting = 0.0
+                capacity = max(target_per, 0.1)
+            views.append(autoscaler.ReplicaView(
+                replica_id=rid, occupied=occupied, waiting=waiting,
+                capacity=capacity,
+                suspect=self._replica_nodes.get(rid)
+                in self._suspect_nodes,
+                retiring=bool(rep.get("retiring"))))
+        ring = entry.setdefault(
+            "signal", collections.deque(maxlen=600))
+        ring.append(autoscaler.fleet_sample(
+            now, views, float(auto.get("suspect_weight", 0.25) or 0.0)))
+        decision = autoscaler.decide(
+            auto, views, list(ring), now,
+            last_up=entry.get("as_last_up", 0.0),
+            last_down=entry.get("as_last_down", 0.0))
+        cur = sum(1 for v in views if not v.retiring)
+        if decision.target == cur:
+            return
+        # chaos site: delay or drop the DECISION itself (`ray-tpu chaos
+        # validate` knows it).  A dropped decision is simply re-derived
+        # next tick from current state — targets are absolute, so a
+        # retried decision can never double-scale.
+        from ..util import fault_injection as fi
+        if fi.ACTIVE is not None:
+            act = fi.ACTIVE.point("serve.autoscale", name)
+            if act is not None:
+                if act["action"] in ("delay", "latency"):
+                    time.sleep(max(0.0, act["delay_s"]))
+                elif act["action"] == "drop":
+                    return
+                else:
+                    raise RuntimeError(
+                        f"chaos: injected serve.autoscale failure for "
+                        f"{name}")
+        self._apply_decision(name, entry, decision, cur, now)
+
+    def _apply_decision(self, name: str, entry: Dict[str, Any],
+                        decision, cur: int, now: float) -> None:
+        target = decision.target
+        try:
+            from .. import state
+            state.report_event(
+                f"serve: autoscale {name!r} {cur} -> {target} "
+                f"({decision.reason})", severity="INFO", source="serve")
+        except Exception:
+            pass
+        if target > cur:
+            for _ in range(target - cur):
+                self._start_replica(name, entry)
+            entry["as_last_up"] = now
+            entry["as_dec_up"] = entry.get("as_dec_up", 0) + 1
+        else:
+            victims = list(decision.victims) or [
+                r["id"] for r in reversed(entry["replicas"])
+                if not r.get("retiring")]
+            for rid in victims[:cur - target]:
+                self._begin_retirement(name, entry, rid, now)
+            entry["as_last_down"] = now
+            entry["as_dec_down"] = entry.get("as_dec_down", 0) + 1
+        entry["config"]["num_replicas"] = target
+        entry["last_scaled"] = now
+        self._version += 1
+
+    def _begin_retirement(self, name: str, entry: Dict[str, Any],
+                          rid: str, now: float) -> None:
+        """Scale-down via the drain path: the victim stops taking NEW
+        sessions (its engine sheds starts; routers skip it via the
+        snapshot's ``draining`` flag) while live streams keep their
+        sid-sticky access until they migrate — the failover client
+        re-admits each one elsewhere on the ``migrating`` reply.  The
+        kill happens in _tick_retirements at live_sessions == 0 (or
+        the migration deadline)."""
+        from .. import api
+        from ..core.config import GlobalConfig
+        rep = next((r for r in entry["replicas"] if r["id"] == rid),
+                   None)
+        if rep is None or rid in self._retiring \
+                or rid in self._evacuations:
+            return
+        rep["retiring"] = True
+        # doomed replicas sit LAST so an unrelated _scale_to pops them
+        # first, never a serving replica
+        entry["replicas"].remove(rep)
+        entry["replicas"].append(rep)
+        self._retiring[rid] = {
+            "name": name,
+            "deadline": now + GlobalConfig.serve_session_migration_timeout_s}
+        if not rep.get("gang"):
+            try:
+                api.get(rep["handle"].prepare_drain.remote(),
+                        timeout=10.0)
+            except Exception:
+                pass   # dead/hung replica: the deadline covers it
+
+    def _tick_retirements(self, now: float) -> None:
+        from .. import api
+        for rid, info in list(self._retiring.items()):
+            entry = self._deployments.get(info["name"])
+            rep = None if entry is None else next(
+                (r for r in entry["replicas"] if r["id"] == rid), None)
+            if rep is None:
+                self._retiring.pop(rid, None)
+                continue   # deleted / healed / scaled under us
+            live = 0
+            if now < info["deadline"] and not rep.get("gang"):
+                try:
+                    live = api.get(rep["handle"].drain_status.remote(),
+                                   timeout=5.0).get("live_sessions", 0)
+                except Exception:
+                    live = 0
+            if live > 0 and now < info["deadline"]:
+                continue   # sessions still migrating; next tick
+            entry["replicas"].remove(rep)
+            self._replica_nodes.pop(rid, None)
+            self._boot_pending.pop(rid, None)
+            self._audit_kill(info["name"], rid, -3)
+            if rep.get("gang"):
+                from .gang import stop_gang_replica
+                try:
+                    stop_gang_replica(rep)
+                except Exception:
+                    pass
+            else:
+                try:
+                    api.kill(rep["handle"])
+                except Exception:
+                    pass
+            self._retiring.pop(rid, None)
+            self._version += 1
+
+    def _observe_boots(self, now: float) -> None:
+        """Fold completed replica boots (start -> ALIVE in the actor
+        table) into the boot-time EWMA behind scale-up Retry-After
+        hints."""
+        if not self._boot_pending:
+            return
+        try:
+            from .. import state
+            alive = {row["actor_id"] for row in state.list_actors()
+                     if row.get("state") == "ALIVE"}
+        except Exception:
+            return
+        by_rid: Dict[str, Any] = {}
+        for entry in self._deployments.values():
+            for rep in entry["replicas"]:
+                by_rid[rep["id"]] = (rep.get("gang")
+                                     or [rep["handle"]])[0]
+        from ..core.config import GlobalConfig
+        alpha = min(1.0, max(
+            0.01, GlobalConfig.serve_replica_boot_ewma_alpha))
+        for rid, t0 in list(self._boot_pending.items()):
+            handle = by_rid.get(rid)
+            if handle is None or now - t0 > 600.0:
+                self._boot_pending.pop(rid, None)   # gone or wedged
+                continue
+            if handle._actor_id in alive:
+                boot = max(0.1, now - t0)
+                self._boot_ewma = boot if self._boot_ewma is None else \
+                    alpha * boot + (1.0 - alpha) * self._boot_ewma
+                self._boot_pending.pop(rid, None)
+
+    def _scaleup_retry_after(self, name: str, now: float
+                             ) -> Optional[float]:
+        """Retry-After for sheds while this deployment's scale-up is in
+        flight: the EWMA boot time minus how long the oldest pending
+        replica has already been booting — clients re-arrive right as
+        capacity lands instead of on the generic backoff floor."""
+        pending = [t0 for rid, t0 in self._boot_pending.items()
+                   if rid.rsplit("#", 1)[0] == name]
+        if not pending or self._boot_ewma is None:
+            return None
+        return max(0.5, self._boot_ewma - (now - min(pending)))
+
+    def _push_deployment_metrics(self) -> None:
+        """Replica-count + decision samples to this worker's nodelet
+        (same ``serve_metrics`` plumbing the engines use), so metrics
+        history carries the replica-count-vs-load timeline."""
+        try:
+            import asyncio
+
+            from ..core.worker_runtime import current_worker_runtime
+            rt = current_worker_runtime()
+            if rt is None or rt._loop is None:
+                return
+            for name, entry in self._deployments.items():
+                payload: Dict[str, Any] = {
+                    "deployment": name,
+                    "replicas": sum(1 for r in entry["replicas"]
+                                    if not r.get("retiring"))}
+                up = entry.pop("as_dec_up", 0)
+                down = entry.pop("as_dec_down", 0)
+                if up:
+                    payload["decisions_up"] = up
+                if down:
+                    payload["decisions_down"] = down
+                asyncio.run_coroutine_threadsafe(
+                    rt.nodelet.notify("serve_metrics", payload),
+                    rt._loop)
+        except Exception:
+            pass

@@ -44,10 +44,13 @@ Two decode data planes live here:
     plain decode; any draft/verify fault falls back to a plain step
     (chaos site ``serve.spec_verify``), never corrupting a stream.
 
-* **Legacy per-call path** (``engine=False`` or batched prompts): the
-  original pop-as-lease session table, one eager `next` per token.
-  Kept as the fallback for non-session deployments and B>1 prompt
-  batches.
+* **Eager per-call path** (``engine=False`` ONLY): the original
+  pop-as-lease session table, one eager `next` per token.  Kept solely
+  for non-LM deployments and as the parity oracle in tests — an
+  engine-enabled core routes EVERYTHING through the engine (B>1 prompt
+  batches become per-row engine sessions behind a group sid), so a
+  replica has exactly one decode data plane to route, autoscale, and
+  journal, and never compiles the whole-prompt prefill program at all.
 
 prefill/decode compile ONCE per replica (config static, cache position
 dynamic) — eager per-step dispatch costs ~100x on small models, which
@@ -95,10 +98,14 @@ class _EngineSession:
     __slots__ = ("sid", "slot", "queue", "last_tok", "pos", "done",
                  "error", "ended", "seq", "last_poll",
                  "prompt", "poff", "pcache", "dcache", "plogits",
-                 "ready", "shed")
+                 "ready", "shed", "ptoks")
 
     def __init__(self, sid: str, prompt: Any, seq_base: int = 0):
         self.sid = sid
+        # host copy of the prompt tokens: the shared-prefix index key
+        # (inserted when this session takes a slot, matched by later
+        # admissions)
+        self.ptoks: tuple = ()
         self.slot: Optional[int] = None
         self.queue: collections.deque = collections.deque()
         self.last_tok: Optional[int] = None  # set when prefill completes
@@ -158,6 +165,21 @@ class ContinuousBatchingEngine:
 
         self._step = jax.jit(fused_step, static_argnames=("cfg",))
         self._insert = jax.jit(cache_insert_slot)
+        # ---- shared-prefix KV reuse ----
+        # radix trie over live slots' prompts (serve/prefix_cache.py):
+        # admission copies the longest shared prefix out of a donor
+        # slot and prefills only the unshared suffix.  Engine-thread
+        # only, like the slot cache itself.
+        self._prefix = None
+        self._gather = None
+        if getattr(engine_cfg, "prefix_cache", True):
+            from ..models import cache_gather_slot
+            from .prefix_cache import PrefixIndex
+            self._prefix = PrefixIndex()
+            self._gather = jax.jit(cache_gather_slot)
+        self.prefix_hits = 0          # admissions seeded from a donor
+        self.prefix_tokens_reused = 0  # prefill tokens skipped
+        self._last_metrics_push = 0.0
         # the chunk program is the MODULE-LEVEL shared jit: admission
         # here, failover resume (models.resume_prefill), and the legacy
         # prefill_chunked path all hit one compile cache
@@ -214,7 +236,8 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------------------ client ops
 
     def start(self, prompt, max_sessions: int, seq_base: int = 0,
-              teacher_forced: bool = False) -> Dict[str, Any]:
+              teacher_forced: bool = False,
+              ptoks: Optional[tuple] = None) -> Dict[str, Any]:
         """Enqueue one batch-1 prompt for chunked admission and block
         until the ENGINE THREAD has prefilled it — `[1, chunk]` blocks
         (tail in `[1, 1]` steps) interleaved between shared decode
@@ -237,6 +260,13 @@ class ContinuousBatchingEngine:
         if s_len > self.max_len:
             raise ValueError(f"prompt length {s_len} exceeds cache "
                              f"capacity {self.max_len}")
+        # ``ptoks`` is the HOST copy of the prompt (the prefix-index
+        # key).  handle() passes it from the request's own list —
+        # reading it back off the device array here would be an extra
+        # sync on the admission path
+        if ptoks is None and self._prefix is not None:
+            import numpy as np
+            ptoks = tuple(int(t) for t in np.asarray(prompt)[0])
         prompt = jnp.asarray(prompt, jnp.int32)
         with self._cond:
             if self._draining:
@@ -248,6 +278,7 @@ class ContinuousBatchingEngine:
             sid = f"{self._tag}:{self._next_sid}"
             self._next_sid += 1
             sess = _EngineSession(sid, prompt, seq_base=seq_base)
+            sess.ptoks = ptoks or ()
             # LRU bound on ABANDONED sessions: evict the oldest
             # slot-less finished session (ended clients pop themselves)
             while len(self.sessions) >= max_sessions:
@@ -375,6 +406,12 @@ class ContinuousBatchingEngine:
                         "%s:%s" % (k[0], "x".join(str(d) for d in k[1:]))
                         for k in self._shapes),
                     "distinct_program_shapes": len(self._shapes),
+                    "prefix": dict(
+                        (self._prefix.stats() if self._prefix is not None
+                         else {"entries": 0, "hits": 0, "misses": 0,
+                               "hit_rate": None, "tokens_matched": 0}),
+                        applied_hits=self.prefix_hits,
+                        tokens_reused=self.prefix_tokens_reused),
                     "spec": {"enabled": self._spec,
                              "disabled": self._spec_disabled,
                              "k": self._spec_k,
@@ -451,6 +488,12 @@ class ContinuousBatchingEngine:
                 del self._slots[slot]
                 sess.slot = None
                 self._free.append(slot)
+                # the prefix index KEEPS a freed slot's entry: nothing
+                # writes rows below its pos until the slot is
+                # reassigned (inactive slots only scribble AT pos,
+                # which is past any matchable prefix), so an ended
+                # session's system prompt stays a warm donor until the
+                # slot is actually reclaimed by a new admission
 
     def _admit_locked(self) -> List[Tuple[_EngineSession, Any, Any, int]]:
         admitted = []
@@ -464,6 +507,14 @@ class ContinuousBatchingEngine:
             slot = self._free.pop()
             sess.slot = slot
             self._slots[slot] = sess
+            if self._prefix is not None:
+                # slot reclaim IS the eviction point: the insert below
+                # replaces whatever prefix the slot advertised before
+                # (its rows are about to be overwritten by
+                # cache_insert_slot)
+                self._prefix.evict(slot)
+                if sess.ptoks:
+                    self._prefix.insert(sess.ptoks, slot)
             admitted.append((sess, sess.pcache, sess.dcache, slot))
             sess.pcache = sess.dcache = None
         return admitted
@@ -478,6 +529,41 @@ class ContinuousBatchingEngine:
         return [s for s in self._slots.values()
                 if not s.done and
                 len(s.queue) < self.ecfg.token_queue_depth]
+
+    def _maybe_push_metrics(self, force: bool = False) -> None:
+        """Fire-and-forget occupancy/waiting/prefix sample to this
+        worker's nodelet (``serve_metrics`` notify): the nodelet folds
+        it into per-(deployment, replica) gauges in its OWN registry,
+        which the metrics-history ring samples — that is how engine
+        occupancy becomes the per-deployment time series the autoscale
+        loop and ``ray-tpu top`` read (worker registries are never
+        scraped directly).  Engine thread only; never blocks on the
+        RPC."""
+        from ..core.config import GlobalConfig
+        iv = getattr(GlobalConfig, "serve_engine_metrics_interval_s", 0.5)
+        if iv is None or iv <= 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_metrics_push < iv:
+            return
+        self._last_metrics_push = now
+        payload = {"deployment": self.name, "replica": self._tag,
+                   "occupied": len(self._slots),
+                   "max_slots": self.ecfg.max_slots,
+                   "waiting": len(self._pending) + len(self._prefilling),
+                   "live": self._live_locked(),
+                   "prefix_hits": self.prefix_hits,
+                   "prefix_tokens_reused": self.prefix_tokens_reused}
+        try:
+            import asyncio
+
+            from ..core.worker_runtime import current_worker_runtime
+            rt = current_worker_runtime()
+            if rt is not None and rt._loop is not None:
+                asyncio.run_coroutine_threadsafe(
+                    rt.nodelet.notify("serve_metrics", payload), rt._loop)
+        except Exception:
+            pass   # driver-local engine (tests) or torn-down runtime
 
     def _shape_seen(self, kind: str, *dims) -> None:
         """Record one dispatched program shape (engine thread only) —
@@ -497,10 +583,45 @@ class ContinuousBatchingEngine:
         from ..models import init_kv_cache
         from ..util import tracing
         if sess.pcache is None:
-            sess.pcache = init_kv_cache(self.cfg, 1, self.max_len)
-            if self._spec:
-                sess.dcache = init_kv_cache(self._draft_cfg, 1,
-                                            self.max_len)
+            seeded = False
+            if self._prefix is not None and sess.ptoks:
+                # shared-prefix admission: the longest prefix this
+                # prompt shares with a LIVE slot's prompt is already in
+                # the slot cache — copy those K/V rows (one compiled
+                # gather, slot + depth traced) and prefill only the
+                # unshared suffix.  Cap at len-1: the last prompt
+                # token's logits must be recomputed to emit the first
+                # token.
+                donor, depth = self._prefix.longest_match(
+                    sess.ptoks, cap=len(sess.ptoks) - 1)
+                # an indexed donor is valid whether its session is
+                # still decoding or ended: entries are only replaced
+                # when the slot is reassigned, and freed slots' rows
+                # below the match depth are never written in between
+                if donor is not None and \
+                        depth >= max(1, self.ecfg.prefix_cache_min_tokens):
+                    from ..core.runtime_metrics import (
+                        SERVE_PREFIX_HITS, SERVE_PREFIX_TOKENS_REUSED)
+                    sess.pcache = self._gather(self._cache,
+                                               jnp.int32(donor),
+                                               jnp.int32(depth))
+                    if self._spec:
+                        sess.dcache = self._gather(self._dcache,
+                                                   jnp.int32(donor),
+                                                   jnp.int32(depth))
+                    sess.poff = depth
+                    seeded = True
+                    self.prefix_hits += 1
+                    self.prefix_tokens_reused += depth
+                    self._shape_seen("prefix_gather", 1)
+                    SERVE_PREFIX_HITS.inc(tags={"deployment": self.name})
+                    SERVE_PREFIX_TOKENS_REUSED.inc(
+                        depth, tags={"deployment": self.name})
+            if not seeded:
+                sess.pcache = init_kv_cache(self.cfg, 1, self.max_len)
+                if self._spec:
+                    sess.dcache = init_kv_cache(self._draft_cfg, 1,
+                                                self.max_len)
         chunk = max(1, int(self.ecfg.prefill_chunk_tokens))
         n = int(sess.prompt.shape[1])
         off = sess.poff
@@ -603,6 +724,7 @@ class ContinuousBatchingEngine:
             with self._cond:
                 while not self._shutdown:
                     self._reap_locked()
+                    self._maybe_push_metrics()
                     self._prefilling = [
                         s for s in self._prefilling
                         if not (s.ready or s.done or s.ended or s.shed)]
@@ -757,6 +879,22 @@ class ContinuousBatchingEngine:
                         {"deployment": self.name})
 
 
+def _host_tokens(prompt) -> Optional[tuple]:
+    """Prompt ints straight from the request payload (the prefix-index
+    key) — no device round trip.  Returns None when the payload isn't a
+    host-side B=1 token list (device arrays fall back to start()'s own
+    materialization)."""
+    if not isinstance(prompt, (list, tuple)):
+        return None
+    try:
+        p = prompt
+        if p and isinstance(p[0], (list, tuple)):
+            p = p[0]
+        return tuple(int(t) for t in p)
+    except (TypeError, ValueError, IndexError):
+        return None
+
+
 class DecodeSessionCore:
     """Session store + compiled prefill/decode over one model.
 
@@ -782,10 +920,13 @@ class DecodeSessionCore:
     Engine sessions (single-prompt starts, the serving hot path) carry
     STRING sids of the form ``<replica_tag>:<n>`` — the prefix is the
     owning replica, which the proxy/router use for sid-sticky routing.
-    Batched (B>1) prompts and ``engine=False`` cores use the legacy
-    integer-sid path: pop-as-lease (a pipelined second `next` on the
-    SAME sid — or a stale/unknown sid — gets an ``{"error": ...}``
-    reply instead of racing the first), LRU-bounded ``max_sessions``.
+    Batched (B>1) prompts on an engine core are admitted row-by-row as
+    engine sessions behind a ``grp:<n>`` sid that keeps the legacy
+    reply shape.  Only ``engine=False`` cores (non-LM deployments, the
+    parity oracle in tests) still run the eager integer-sid path:
+    pop-as-lease (a pipelined second `next` on the SAME sid — or a
+    stale/unknown sid — gets an ``{"error": ...}`` reply instead of
+    racing the first), LRU-bounded ``max_sessions``.
     """
 
     def __init__(self, cfg, max_len: int, seed: int = 0,
@@ -801,32 +942,43 @@ class DecodeSessionCore:
         is True (default), False, or a :class:`DecodeEngineConfig`."""
         import jax
 
-        from ..models import decode_step, init_params, prefill
-        from ..models import prefill_chunked
+        from ..models import init_params
         self.cfg = cfg
         self.max_len = max_len
         self.max_sessions = max_sessions
         if params is None:
             params, _ = init_params(jax.random.PRNGKey(seed), cfg)
         self.params = params
-        if prefill_chunk > 0:
-            def chunked(params, prompt, *, cfg, cache):
-                return prefill_chunked(params, prompt, cfg, cache,
-                                       chunk=prefill_chunk)
-
-            self._prefill = chunked
-        else:
-            self._prefill = jax.jit(prefill, static_argnames=("cfg",))
-        self._decode = jax.jit(decode_step, static_argnames=("cfg",))
         self._lock = threading.Lock()
         self.sessions: Dict[int, Any] = {}   # insertion-ordered = LRU
         self._next_sid = 0
+        # B>1 prompt batches on an engine core: each row is its own
+        # engine session; the group keeps the legacy one-reply-per-step
+        # protocol shape (sid + [B] tokens) over the SINGLE data plane
+        self._groups: Dict[str, List[str]] = {}
+        self._next_gid = 0
         if engine is False or engine is None:
             self._engine_cfg = None
         elif isinstance(engine, DecodeEngineConfig):
             self._engine_cfg = engine
         else:
             self._engine_cfg = DecodeEngineConfig()
+        if self._engine_cfg is None:
+            # the eager per-call path survives ONLY as the explicit
+            # opt-out (`engine=False`): non-LM deployments and the
+            # parity oracle in tests.  Engine cores never compile the
+            # whole-prompt prefill or the batch-1 decode step at all —
+            # exactly one decode data plane per replica.
+            from ..models import decode_step, prefill, prefill_chunked
+            if prefill_chunk > 0:
+                def chunked(params, prompt, *, cfg, cache):
+                    return prefill_chunked(params, prompt, cfg, cache,
+                                           chunk=prefill_chunk)
+
+                self._prefill = chunked
+            else:
+                self._prefill = jax.jit(prefill, static_argnames=("cfg",))
+            self._decode = jax.jit(decode_step, static_argnames=("cfg",))
         if self._engine_cfg is not None and prefill_chunk > 0:
             # one chunk width per replica: the engine's admission/resume
             # programs and the legacy prefill_chunked path must share
@@ -867,8 +1019,12 @@ class DecodeSessionCore:
             prompt = jnp.asarray(req["prompt"], jnp.int32)
             if prompt.ndim == 1:
                 prompt = prompt[None]
-            if self._engine_cfg is not None and prompt.shape[0] == 1:
-                return self.engine.start(prompt, self.max_sessions)
+            if self._engine_cfg is not None:
+                if prompt.shape[0] == 1:
+                    return self.engine.start(
+                        prompt, self.max_sessions,
+                        ptoks=_host_tokens(req["prompt"]))
+                return self._group_start(prompt, req["prompt"])
             cache = init_kv_cache(self.cfg, prompt.shape[0],
                                   self.max_len)
             logits, cache = self._prefill(self.params, prompt,
@@ -893,16 +1049,21 @@ class DecodeSessionCore:
             if prompt and isinstance(prompt[0], (list, tuple)):
                 prompt = prompt[0]     # batched form: engine is B=1
             generated = list(req.get("generated") or [])
-            prefix = jnp.asarray([list(prompt) + generated], jnp.int32)
-            return self.engine.start(prefix, self.max_sessions,
-                                     seq_base=len(generated),
-                                     teacher_forced=True)
+            replay = list(prompt) + generated
+            prefix = jnp.asarray([replay], jnp.int32)
+            return self.engine.start(
+                prefix, self.max_sessions, seq_base=len(generated),
+                teacher_forced=True,
+                ptoks=tuple(int(t) for t in replay))
         if op == "stats":
-            out = {"legacy_sessions": len(self.sessions)}
+            out = {"legacy_sessions": len(self.sessions),
+                   "groups": len(self._groups)}
             if self._engine is not None:
                 out["engine"] = self._engine.stats()
             return out
         sid = req.get("sid")
+        if isinstance(sid, str) and sid.startswith("grp:"):
+            return self._group_op(op, sid)
         if op == "end":
             if isinstance(sid, str):
                 if self._engine is None:
@@ -933,6 +1094,65 @@ class DecodeSessionCore:
                 reply["eos"] = True
             return reply
         return self._legacy_next(sid)
+
+    def _group_start(self, prompt, raw_prompt=None) -> Dict[str, Any]:
+        """B>1 prompts through the ONE data plane: admit each row as
+        its own engine session and hand back a group sid whose `next`
+        pops one token per member — the legacy per-call protocol shape
+        ({sid, token: [B]}) without the legacy prefill/decode programs.
+        A member shed mid-admission (slots + wait queue full) releases
+        the members already admitted and re-raises, so a group is all
+        or nothing."""
+        sids, toks = [], []
+        try:
+            for row in range(int(prompt.shape[0])):
+                pt = None
+                if raw_prompt is not None:
+                    try:
+                        pt = _host_tokens([raw_prompt[row]])
+                    except (TypeError, IndexError):
+                        pt = None
+                out = self.engine.start(prompt[row:row + 1],
+                                        self.max_sessions, ptoks=pt)
+                sids.append(out["sid"])
+                toks.extend(out["token"])
+        except BaseException:
+            for s in sids:
+                self.engine.end(s)
+            raise
+        with self._lock:
+            gid = f"grp:{self._next_gid}"
+            self._next_gid += 1
+            self._groups[gid] = sids
+        return {"sid": gid, "token": toks}
+
+    def _group_op(self, op: str, gid: str) -> Dict[str, Any]:
+        with self._lock:
+            sids = self._groups.get(gid)
+        if sids is None or self._engine is None:
+            return {"error": f"unknown session {gid!r} (ended, "
+                             f"evicted, or never started)"}
+        if op == "end":
+            for s in sids:
+                self._engine.end(s)
+            with self._lock:
+                self._groups.pop(gid, None)
+            return {"ended": True}
+        # op in ("next", "next_chunk"): one decode step for every
+        # member (rows share a prompt length, so they reach the cache
+        # cap together, like the legacy shared-pos batch did)
+        toks = []
+        for s in sids:
+            out = self._engine.next_chunk(s, 1)
+            if "error" in out:
+                return out
+            if not out["tokens"]:
+                return {"error": f"session {gid!r} finished "
+                                 f"(cache capacity reached)"}
+            toks.extend(out["tokens"])
+        if op == "next_chunk":
+            return {"tokens": toks, "done": False}
+        return {"token": toks}
 
     def _legacy_next(self, sid) -> Dict[str, Any]:
         import jax.numpy as jnp

@@ -65,7 +65,8 @@ def _shed_error(exc: BaseException):
 def call_with_retry(router, name: str, args, kwargs,
                     method: Optional[str] = None,
                     timeout_s: float = 60.0, attempts: int = 3,
-                    sticky_replica_id: Optional[str] = None) -> Any:
+                    sticky_replica_id: Optional[str] = None,
+                    prefix_tokens=None) -> Any:
     """Assign + get with replica-failure retry under ONE deadline (the
     reference router's handling of dead replicas).  A request that
     raced a replica teardown re-routes to a live replica after a table
@@ -114,9 +115,13 @@ def call_with_retry(router, name: str, args, kwargs,
     for attempt in range(attempts):
         budget = max(0.1, deadline - _time.monotonic())
         try:
+            # prefix_tokens only when set: scripted fake routers in
+            # tests predate the affinity parameter
+            extra = ({"prefix_tokens": prefix_tokens}
+                     if prefix_tokens is not None else {})
             ref, rid = router.assign_request(
                 name, args, kwargs, method, timeout_s=budget,
-                sticky_replica_id=sticky_replica_id)
+                sticky_replica_id=sticky_replica_id, **extra)
         except Exception as e:
             shed = _shed_error(e)
             if shed is None or sticky_replica_id is not None \

@@ -22,6 +22,7 @@ class HTTPProxy:
                  port: int = 8000, node_id: Optional[str] = None):
         from .router import Router
         self._router = Router(controller_handle)
+        self._controller = controller_handle
         self._host = host
         self._port = port
         self._pool = ThreadPoolExecutor(max_workers=32)
@@ -63,6 +64,25 @@ class HTTPProxy:
                 headers={"Retry-After":
                          str(max(1, int(round(e.retry_after_s))))})
 
+        def prefix_of(payload):
+            """Prompt tokens of a session start/resume: the router's
+            prefix-affinity key (sessions sharing a system prompt land
+            where that prefix's KV is hot).  Resume includes generated
+            tokens — its replay prefix is what the target must hold."""
+            if not isinstance(payload, dict) or \
+                    payload.get("op") not in ("start", "resume"):
+                return None
+            p = payload.get("prompt") or []
+            if p and isinstance(p[0], (list, tuple)):
+                if len(p) != 1:
+                    return None   # batched prompts: no single prefix
+                p = p[0]
+            try:
+                return [int(t) for t in p] + \
+                    [int(t) for t in (payload.get("generated") or ())]
+            except (TypeError, ValueError):
+                return None
+
         def route_call(name, payload, sticky=None):
             from ..core.config import GlobalConfig
             from ..exceptions import TaskError
@@ -72,7 +92,9 @@ class HTTPProxy:
                 return call_with_retry(
                     self._router, name, args, {},
                     timeout_s=GlobalConfig.serve_request_timeout_s,
-                    sticky_replica_id=sticky)
+                    sticky_replica_id=sticky,
+                    prefix_tokens=(None if sticky
+                                   else prefix_of(payload)))
             except TaskError as e:
                 # a replica-side typed shed (decode-engine admission
                 # backpressure, draining engine) arrives wrapped as the
@@ -300,8 +322,28 @@ class HTTPProxy:
                 self._startup_error = str(e)
             self._ready.set()
 
+        async def autoscale_ticker():
+            """Periodic controller nudge: the autoscale loop must tick
+            through idle valleys too (scale-down to min_replicas), and
+            with zero traffic nothing else polls the controller.  The
+            proxy is the natural host — one exists wherever Serve
+            serves HTTP, and a fire-and-forget actor call per interval
+            costs nothing."""
+            from ..core.config import GlobalConfig
+            while True:
+                iv = GlobalConfig.serve_autoscale_interval_s
+                if not iv or iv <= 0:
+                    await asyncio.sleep(5.0)
+                    continue
+                await asyncio.sleep(max(0.25, float(iv)))
+                try:
+                    self._controller.autoscale_tick.remote()
+                except Exception:
+                    pass   # controller restarting: next tick retries
+
         loop.run_until_complete(start())
         if not self._startup_error:
+            loop.create_task(autoscale_ticker())
             loop.run_forever()
 
     # -- actor surface ------------------------------------------------------

@@ -47,6 +47,17 @@ class Router:
                 core.subscribe_node_events(self._on_node_event)
         except Exception:
             pass  # degraded: the poll TTL + heal loop still converge
+        # Prefix affinity (serve/prefix_cache.py): recently routed
+        # session prompts -> owning replica.  New sessions sharing a
+        # system prompt land where that prefix's KV is already hot, so
+        # the replica-side prefix cache hits instead of every replica
+        # warming the same prefix independently.  Owners are unique
+        # ints (one trie key each); _paff_owner maps them back to
+        # replica ids.
+        from .prefix_cache import PrefixIndex
+        self._paffinity = PrefixIndex(max_owners=512)
+        self._paff_owner: Dict[int, str] = {}
+        self._paff_seq = 0
         self._refresh(force=True)
 
     def _on_node_event(self, data) -> None:
@@ -85,6 +96,14 @@ class Router:
             self._rr = {name: itertools.cycle(range(
                 max(len(e["replicas"]), 1)))
                 for name, e in self._table.items()}
+            # affinity entries pointing at replicas that left the
+            # table are dead weight: evict them
+            live = {r["id"] for e in self._table.values()
+                    for r in e["replicas"]}
+            for owner, rid in list(self._paff_owner.items()):
+                if rid not in live:
+                    self._paffinity.evict(owner)
+                    self._paff_owner.pop(owner, None)
 
     def deployment_names(self):
         self._refresh()
@@ -116,10 +135,32 @@ class Router:
         return {"route_prefix": entry.get("route_prefix"),
                 "ingress": entry.get("ingress", False)}
 
+    def _prefix_note(self, tokens, rid: str) -> None:
+        """Remember that ``rid`` just admitted a session with this
+        prompt — the affinity signal for later sessions sharing its
+        prefix.  Caller holds the lock."""
+        self._paff_seq += 1
+        self._paffinity.insert(tokens, self._paff_seq)
+        self._paff_owner[self._paff_seq] = rid
+        if len(self._paff_owner) > len(self._paffinity) + 16:
+            livemap = set(self._paffinity.owners())
+            self._paff_owner = {o: r for o, r
+                                in self._paff_owner.items()
+                                if o in livemap}
+
+    def _prefix_prefer(self, tokens) -> Optional[str]:
+        """Replica holding the longest shared prefix with ``tokens``
+        (None on a miss).  Caller holds the lock."""
+        owner, depth = self._paffinity.longest_match(tokens)
+        if owner is None or depth <= 0:
+            return None
+        return self._paff_owner.get(owner)
+
     def assign_request(self, name: str, args: tuple, kwargs: dict,
                        method: Optional[str] = None,
                        timeout_s: float = 60.0,
-                       sticky_replica_id: Optional[str] = None):
+                       sticky_replica_id: Optional[str] = None,
+                       prefix_tokens=None):
         """Pick a non-saturated replica round-robin and return the result
         ObjectRef; counts in-flight per replica.
 
@@ -165,28 +206,46 @@ class Router:
                     elif self._inflight.get(rep["id"], 0) < cap:
                         chosen = rep
                 elif replicas:
-                    # Least-loaded with local preference: locality is a
-                    # TIE-BREAK among the least-loaded candidates, never
-                    # a magnet — preferring any under-cap local replica
-                    # outright would funnel all traffic to it while its
-                    # siblings idle.  RR order breaks remaining ties.
+                    # Least-loaded with prefix-affinity and local
+                    # preference: locality is a TIE-BREAK among the
+                    # least-loaded candidates, never a magnet —
+                    # preferring any under-cap local replica outright
+                    # would funnel all traffic to it while its
+                    # siblings idle.  A session start whose prompt
+                    # shares a prefix with a recently routed session
+                    # prefers THAT replica (its KV prefix is hot) as
+                    # long as it is within one request of the least
+                    # load — affinity must not defeat load balance.
+                    # RR order breaks remaining ties.
                     start = next(self._rr[name]) % len(replicas)
                     candidates = []
                     for off in range(len(replicas)):
                         rep = replicas[(start + off) % len(replicas)]
                         if rep.get("node_id") in self._down_nodes:
                             continue  # dead/draining node: never route
+                        if rep.get("draining"):
+                            continue  # retiring: no NEW sessions
                         load = self._inflight.get(rep["id"], 0)
                         if load < cap:
                             candidates.append((load, rep))
                     if candidates:
                         min_load = min(load for load, _ in candidates)
-                        best = [rep for load, rep in candidates
-                                if load == min_load]
-                        chosen = next(
-                            (rep for rep in best if self._node_id and
-                             rep.get("node_id") == self._node_id),
-                            best[0])
+                        if prefix_tokens:
+                            want = self._prefix_prefer(prefix_tokens)
+                            if want is not None:
+                                chosen = next(
+                                    (rep for load, rep in candidates
+                                     if rep["id"] == want
+                                     and load <= min_load + 1), None)
+                        if chosen is None:
+                            best = [rep for load, rep in candidates
+                                    if load == min_load]
+                            chosen = next(
+                                (rep for rep in best if self._node_id and
+                                 rep.get("node_id") == self._node_id),
+                                best[0])
+                if chosen is not None and prefix_tokens:
+                    self._prefix_note(prefix_tokens, chosen["id"])
                 if chosen is not None:
                     self._inflight[chosen["id"]] = \
                         self._inflight.get(chosen["id"], 0) + 1
@@ -194,6 +253,11 @@ class Router:
                 ref = chosen["handle"].handle_request.remote(
                     args, kwargs, method)
                 return ref, chosen["id"]
+            # server-derived Retry-After: while a scale-up is in
+            # flight the snapshot carries the boot-time EWMA hint, so
+            # shed clients re-arrive right as the new capacity lands
+            # instead of on the generic backoff floor
+            hint = (entry or {}).get("scaleup_retry_after_s") or 1.0
             if sticky_replica_id is not None and sticky_gone:
                 # the session's owner is out of the table: one forced
                 # refresh guards against staleness, then fail loudly —
@@ -202,7 +266,8 @@ class Router:
                 if confirmed_empty:
                     raise ReplicaUnavailableError(
                         f"{name} (replica {sticky_replica_id} owning "
-                        f"this decode session is gone)")
+                        f"this decode session is gone)",
+                        retry_after_s=hint)
                 confirmed_empty = True
                 self._refresh(force=True)
                 continue
@@ -211,7 +276,8 @@ class Router:
                 # refresh guards against a stale table (deploy racing the
                 # poll TTL), then shed fast with the typed error
                 if confirmed_empty:
-                    raise ReplicaUnavailableError(name)
+                    raise ReplicaUnavailableError(name,
+                                                  retry_after_s=hint)
                 confirmed_empty = True
                 self._refresh(force=True)
                 continue
@@ -236,8 +302,8 @@ class Router:
         entry = self._table.get(name)
         if not entry:
             return
-        counts = [self._inflight.get(r["id"], 0)
-                  for r in entry["replicas"]]
+        counts = {r["id"]: self._inflight.get(r["id"], 0)
+                  for r in entry["replicas"]}
         try:
             self._controller.report_metrics.remote(name, counts)
         except Exception:

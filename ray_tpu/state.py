@@ -196,13 +196,19 @@ def cluster_metrics_text() -> str:
 
 
 def metrics_history(name: Optional[str] = None,
-                    last: Optional[int] = None) -> Dict[str, Any]:
+                    last: Optional[int] = None,
+                    deployment: Optional[str] = None,
+                    kind: str = "counters") -> Dict[str, Any]:
     """Cluster-wide metrics history: each server process's bounded ring
     of fixed-interval samples (counter deltas + gauges;
     core/metrics_history.py), keyed by process label.  With ``name``,
     a ``series`` view extracts that one metric family per process —
-    the signal source the serve autoscale loop (ROADMAP item 2) and
-    ``ray-tpu top`` read."""
+    the signal source the serve autoscale loop and ``ray-tpu top``
+    read.  ``deployment`` filters the series to samples carrying that
+    ``deployment=`` label (serve engine occupancy/waiting pushes are
+    labeled per deployment and replica), so per-deployment series come
+    back without client-side regex over the merged rings; ``kind``
+    picks "counters" or "gauges" (serve engine samples are gauges)."""
     from .core import metrics_history as mh
     core = _ensure_initialized()
     procs: Dict[str, Any] = {}
@@ -225,8 +231,10 @@ def metrics_history(name: Optional[str] = None,
         "processes": procs,
     }
     if name:
+        labels = {"deployment": deployment} if deployment else None
         out["series"] = {
-            label: mh.series(p.get("samples", []), name)
+            label: mh.series(p.get("samples", []), name, kind=kind,
+                             labels=labels)
             for label, p in procs.items()}
     return out
 

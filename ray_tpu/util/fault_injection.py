@@ -147,6 +147,7 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "serve.request": frozenset({"crash", "error", "fail"}),
     "serve.health_check": frozenset({"error", "fail"}),
     "serve.session_failover": frozenset({"error", "fail"}),
+    "serve.autoscale": frozenset({"drop", "error", "fail"}),
     "serve.spec_verify": frozenset({"error", "fail"}),
     "drain.evacuate": None,
     "drain.deadline": None,
