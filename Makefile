@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
 	attr chaos drain failover spec elastic ha partition autoscale \
-	autoscale-bench clean
+	autoscale-bench lint clean
 
 all: native cpp
 
@@ -92,6 +92,14 @@ partition:
 # into a speculating engine, program-shape dedup.
 spec:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_spec_decode.py -q
+
+# Static analysis in one shot: the PR-13 framework-invariant suite
+# (loop-blocking / thread-race / chaos-site / WAL-op / RPC-surface
+# rules against the committed baseline) plus the PR-10 metrics lint.
+# Both are offline — no cluster, no JAX — and both gate tier-1.
+lint:
+	$(PY) -m ray_tpu.scripts.cli lint
+	$(PY) -m ray_tpu.scripts.cli metrics lint
 
 bench:
 	$(PY) bench.py

@@ -1735,6 +1735,7 @@ class Controller:
     async def _do_free(self, oids: List[bytes]):
         by_node: Dict[str, List[bytes]] = {}
         spill_ns = self.kv.get("spill", {})
+        spill_paths: List[str] = []
         for oid in oids:
             self.pending_free.discard(oid)
             for nid in self.object_dir.pop(oid, set()):
@@ -1744,8 +1745,16 @@ class Controller:
             # registered here; shared-fs/single-machine sessions can unlink).
             path = spill_ns.pop(oid, None)
             if path is not None:
-                spill.delete_file(path.decode() if isinstance(path, bytes)
-                                  else path)
+                spill_paths.append(path.decode()
+                                   if isinstance(path, bytes) else path)
+        if spill_paths:
+            # off-loop: a batch free of spilled objects is N serial
+            # unlinks — on the controller loop that stalls every
+            # handler behind the disk (PR-13 loop-blocking lint)
+            def _sweep(paths=spill_paths):
+                for p in paths:
+                    spill.delete_file(p)
+            await asyncio.to_thread(_sweep)
         for nid, node_oids in by_node.items():
             rec = self.nodes.get(nid)
             if rec is not None and rec.view.alive:

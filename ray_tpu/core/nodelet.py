@@ -1945,13 +1945,18 @@ class Nodelet:
             return {"files": sorted(os.path.basename(p) for p in
                                     glob.glob(os.path.join(log_dir, "*")))}
         path = os.path.join(log_dir, name)
-        try:
+
+        def _read_tail():
             with open(path, "rb") as f:
                 f.seek(0, 2)
                 size = f.tell()
                 n = min(int(data.get("bytes", 65536)), size)
                 f.seek(size - n)
                 return {"data": f.read(n), "size": size}
+        try:
+            # off-loop: a 64 KB read from a cold page cache must not
+            # stall heartbeats/leases (PR-13 loop-blocking lint)
+            return await asyncio.to_thread(_read_tail)
         except OSError as e:
             return {"error": str(e)}
 

@@ -395,7 +395,11 @@ class WorkerRuntime:
                             self.store.release(oid)
                 except store_client.StoreFullError:
                     from . import spill
-                    path = spill.write_object(oid, parts)
+                    # off-loop: spilled returns can be arbitrarily
+                    # large, and this loop also serves ping/cancel
+                    # (PR-13 loop-blocking lint)
+                    path = await asyncio.to_thread(
+                        spill.write_object, oid, parts)
                     conn = await self._controller_conn()
                     await conn.call(
                         "kv_put", {**spill.kv_entry(oid),

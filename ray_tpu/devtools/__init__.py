@@ -1,0 +1,5 @@
+"""Developer tooling that ships with the package (linters, validators).
+
+Nothing under here runs in the data/control plane — these are the
+framework-invariant checks wired into tier-1 and `make lint`.
+"""

@@ -1,0 +1,41 @@
+"""AST-based static-analysis suite for framework invariants.
+
+The control plane is a set of asyncio loops plus a few background
+threads, and every defect class that has cost a PR cycle — a handler
+blocking the controller loop, a thread racing a public method on shared
+state, a chaos site/WAL op/RPC op drifting out of its registry — is
+statically detectable.  `ray-tpu lint` runs five rules over the package
+source (no cluster, no imports of the linted code):
+
+``loop-blocking``
+    blocking calls (``time.sleep``, sync file I/O, ``fsync``, blocking
+    subprocess/socket ops, unbounded ``lock.acquire``, known-blocking
+    ray_tpu helpers) inside ``async def`` bodies — each one stalls an
+    event loop that heartbeats, leases, and serves are sharing.
+``thread-race``
+    in classes that spawn ``threading.Thread`` onto one of their own
+    methods: instance attributes mutated on the thread side without the
+    instance lock while a public method also touches them.
+``chaos-site-drift``
+    every ``fault_injection`` site string used at an injection point
+    exists in ``KNOWN_SITES`` and vice versa (plans were validated
+    before; now call sites are too).
+``wal-op-coverage``
+    every op string appended to the controller WAL has a replay arm in
+    ``persistence._apply`` (a new WAL op can never silently not replay
+    after restart/HA promotion), and no replay arm is dead.
+``rpc-surface``
+    every client-side op string sent over ``core/rpc.py`` has a
+    registered server handler somewhere, and every registered handler
+    is reachable from some call site (package, tests, or C++ sources).
+
+Suppression: append ``# rtpu: allow[<rule-id>]`` (comma list ok) to the
+flagged line or the line above it.  Grandfathered findings live in the
+committed ``baseline.json`` next to this module — every entry must
+carry a non-empty ``reason``.  See ``engine.py`` for the walker and
+``rules/`` for the per-rule visitors.
+"""
+
+from .engine import (BASELINE_FILENAME, Finding, LintResult,  # noqa: F401
+                     default_baseline_path, load_baseline, run_lint)
+from .rules import ALL_RULES, make_rules  # noqa: F401

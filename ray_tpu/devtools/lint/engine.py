@@ -1,0 +1,310 @@
+"""Lint engine: shared file walker, suppressions, baseline, output.
+
+One parse per file: the walker builds each module's AST once and hands
+it to every rule (``visit_file``); cross-file rules accumulate state in
+the shared :class:`LintContext` and emit their findings in
+``finalize``.  Nothing here imports the linted code — a file that
+cannot even parse is itself reported as a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
+
+BASELINE_FILENAME = "baseline.json"
+
+#: directories never walked (bytecode, VCS, build junk)
+_SKIP_DIRS = {"__pycache__", ".git", ".eggs", "build", "dist"}
+
+#: inline suppression: ``# rtpu: allow[rule-a,rule-b]`` on the flagged
+#: line or the line directly above it
+_ALLOW_RE = re.compile(r"#\s*rtpu:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+
+#: quoted identifiers harvested from evidence files (tests, C++
+#: sources) — reachability witnesses for the rpc-surface rule
+_EVIDENCE_STR_RE = re.compile(r'"([A-Za-z_][A-Za-z0-9_:.\-]*)"')
+
+
+class Finding:
+    """One lint finding.  ``key`` is line-number-free on purpose: it
+    names the rule, file, enclosing scope, and a short detail token, so
+    baseline entries survive unrelated edits to the same file."""
+
+    def __init__(self, rule: str, rel: str, line: int, scope: str,
+                 detail: str, message: str):
+        self.rule = rule
+        self.rel = rel
+        self.line = line
+        self.scope = scope
+        self.detail = detail
+        self.message = message
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.rel}:{self.scope}:{self.detail}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.rel, "line": self.line,
+                "scope": self.scope, "detail": self.detail,
+                "key": self.key, "message": self.message}
+
+    def __repr__(self) -> str:
+        return f"<Finding {self.key} @{self.line}>"
+
+
+class LintContext:
+    """Shared state across files and rules for one lint run."""
+
+    def __init__(self, root: str):
+        self.root = root
+        #: rel path -> source lines (rules may want the raw text)
+        self.sources: Dict[str, List[str]] = {}
+        #: quoted strings seen in evidence files (tests, .cc/.h)
+        self.evidence: Set[str] = set()
+        #: free-form per-rule scratch space, keyed by rule id
+        self.scratch: Dict[str, Any] = {}
+
+
+class LintResult:
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []      # new (fail the run)
+        self.suppressed: List[Finding] = []    # inline-allowed
+        self.baselined: List[Finding] = []     # grandfathered
+        self.stale_baseline: List[str] = []    # baseline keys not seen
+        self.baseline_errors: List[str] = []   # malformed entries
+        self.files = 0
+        self.duration_s = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.baseline_errors
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "duration_s": round(self.duration_s, 3),
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "baselined": [f.to_json() for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+            "baseline_errors": list(self.baseline_errors),
+        }
+
+
+def default_baseline_path(package_dir: str) -> str:
+    return os.path.join(package_dir, "devtools", "lint", BASELINE_FILENAME)
+
+
+def load_baseline(path: str) -> tuple:
+    """Returns ``(keys_to_reason, errors)``.  Every entry must carry a
+    non-empty reason — a grandfathered finding without one is itself a
+    lint failure (the baseline is documentation, not a mute button)."""
+    if not path or not os.path.exists(path):
+        return {}, []
+    errors: List[str] = []
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return {}, [f"baseline {path}: unreadable ({e})"]
+    entries = data.get("entries") if isinstance(data, dict) else None
+    if not isinstance(entries, list):
+        return {}, [f"baseline {path}: expected {{'entries': [...]}}"]
+    keys: Dict[str, str] = {}
+    for i, ent in enumerate(entries):
+        if not isinstance(ent, dict) or not ent.get("key"):
+            errors.append(f"baseline entry #{i}: missing 'key'")
+            continue
+        reason = (ent.get("reason") or "").strip()
+        if not reason:
+            errors.append(f"baseline entry {ent['key']!r}: empty "
+                          f"'reason' — every grandfathered finding "
+                          f"must say why it is tolerated")
+        keys[ent["key"]] = reason
+    return keys, errors
+
+
+def _walk_py(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _walk_evidence(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith((".py", ".cc", ".h", ".cpp")):
+                    yield os.path.join(dirpath, fn)
+
+
+def _allowed_rules(lines: List[str], line_no: int) -> Set[str]:
+    """Suppressions in force at ``line_no`` (1-based): the line itself
+    or the one above."""
+    out: Set[str] = set()
+    for idx in (line_no - 1, line_no - 2):
+        if 0 <= idx < len(lines):
+            m = _ALLOW_RE.search(lines[idx])
+            if m:
+                out.update(p.strip() for p in m.group(1).split(","))
+    return out
+
+
+def run_lint(package_dir: str, rules: Optional[Sequence] = None,
+             baseline_path: Optional[str] = None,
+             evidence_dirs: Sequence[str] = (),
+             exclude: Sequence[str] = ()) -> LintResult:
+    """Lint every ``.py`` under ``package_dir`` with ``rules``.
+
+    ``evidence_dirs`` (plus any C/C++ sources inside the package) are
+    scanned for quoted strings only — reachability witnesses, never
+    findings.  ``baseline_path=None`` means the committed default next
+    to this module; pass ``""`` to disable the baseline entirely.
+    ``exclude`` holds fnmatch patterns against the rel path."""
+    from .rules import make_rules
+    t0 = time.monotonic()
+    package_dir = os.path.abspath(package_dir)
+    if rules is None:
+        rules = make_rules()
+    if baseline_path is None:
+        baseline_path = default_baseline_path(package_dir)
+    res = LintResult()
+    ctx = LintContext(package_dir)
+
+    # evidence pass: cheap textual harvest (no parse)
+    cc_in_pkg = [package_dir]
+    for path in _walk_evidence(list(evidence_dirs)):
+        _harvest_evidence(path, ctx)
+    for path in _walk_evidence(cc_in_pkg):
+        if path.endswith((".cc", ".h", ".cpp")):
+            _harvest_evidence(path, ctx)
+
+    raw: List[Finding] = []
+    for path in _walk_py(package_dir):
+        rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
+        if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+            continue
+        res.files += 1
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            raw.append(Finding("parse-error", rel, e.lineno or 0,
+                               "<module>", "syntax",
+                               f"file does not parse: {e.msg}"))
+            continue
+        lines = src.splitlines()
+        ctx.sources[rel] = lines
+        for rule in rules:
+            raw.extend(rule.visit_file(rel, tree, lines, ctx) or ())
+    for rule in rules:
+        raw.extend(rule.finalize(ctx) or ())
+
+    # suppressions, dedupe (same key keeps its first site), baseline
+    baseline, res.baseline_errors = load_baseline(baseline_path)
+    seen_keys: Set[str] = set()
+    hit_baseline: Set[str] = set()
+    for f in raw:
+        lines = ctx.sources.get(f.rel, [])
+        if f.rule in _allowed_rules(lines, f.line):
+            res.suppressed.append(f)
+            continue
+        if f.key in seen_keys:
+            continue
+        seen_keys.add(f.key)
+        if f.key in baseline:
+            hit_baseline.add(f.key)
+            res.baselined.append(f)
+        else:
+            res.findings.append(f)
+    res.stale_baseline = sorted(set(baseline) - hit_baseline)
+    res.findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    res.duration_s = time.monotonic() - t0
+    return res
+
+
+def _harvest_evidence(path: str, ctx: LintContext) -> None:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return
+    ctx.evidence.update(_EVIDENCE_STR_RE.findall(text))
+
+
+def render_text(res: LintResult, verbose: bool = False) -> str:
+    """Human-readable report (the `ray-tpu lint` default output)."""
+    out: List[str] = []
+    for f in res.findings:
+        out.append(f"ERROR: {f.rel}:{f.line}: [{f.rule}] {f.message}")
+        out.append(f"       key: {f.key}")
+    for err in res.baseline_errors:
+        out.append(f"ERROR: {err}")
+    if verbose:
+        for f in res.baselined:
+            out.append(f"baselined: {f.rel}:{f.line}: [{f.rule}] "
+                       f"{f.message}")
+    for key in res.stale_baseline:
+        out.append(f"WARNING: stale baseline entry (no longer found): "
+                   f"{key}")
+    status = "OK" if res.ok else f"{len(res.findings)} new finding(s)"
+    out.append(f"{status}: {res.files} file(s) linted in "
+               f"{res.duration_s:.2f}s — {len(res.findings)} new, "
+               f"{len(res.baselined)} baselined, "
+               f"{len(res.suppressed)} suppressed")
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------- rule base
+
+class Rule:
+    """Base class for rule plugins.  Per-file rules override
+    ``visit_file``; cross-file rules accumulate into ``ctx.scratch``
+    and emit from ``finalize``."""
+
+    id = "abstract"
+
+    def visit_file(self, rel: str, tree: ast.AST, lines: List[str],
+                   ctx: LintContext) -> List[Finding]:
+        return []
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        return []
+
+    # ---------------------------------------------------- shared helpers
+    @staticmethod
+    def dotted(node: ast.AST) -> str:
+        """``a.b.c`` for a Name/Attribute chain, else ''."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        if parts:
+            # unresolvable base (call result, subscript): keep the
+            # attribute tail so suffix matches still work
+            return "?." + ".".join(reversed(parts))
+        return ""
+
+    @staticmethod
+    def str_const(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return None

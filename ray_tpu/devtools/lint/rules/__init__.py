@@ -1,0 +1,19 @@
+"""Rule registry: one visitor plugin per framework invariant."""
+
+from .chaos_sites import ChaosSiteDriftRule
+from .loop_blocking import LoopBlockingRule
+from .rpc_surface import RpcSurfaceRule
+from .thread_race import ThreadRaceRule
+from .wal_ops import WalOpCoverageRule
+
+ALL_RULES = (LoopBlockingRule, ThreadRaceRule, ChaosSiteDriftRule,
+             WalOpCoverageRule, RpcSurfaceRule)
+
+
+def make_rules(only=None):
+    """Fresh rule instances (cross-file rules carry per-run state)."""
+    rules = [cls() for cls in ALL_RULES]
+    if only:
+        want = set(only)
+        rules = [r for r in rules if r.id in want]
+    return rules

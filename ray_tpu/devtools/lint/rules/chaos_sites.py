@@ -1,0 +1,103 @@
+"""Rule: chaos injection sites used in code == KNOWN_SITES registry.
+
+`ray-tpu chaos validate` lints *plans* against
+``fault_injection.KNOWN_SITES``, but nothing checked the *call sites*:
+a typo'd site string at a ``fi.ACTIVE.point(...)`` threads a fault
+point that no valid plan can ever arm (and validate would even reject
+the plan that tries), while a registry entry whose call site was
+refactored away keeps validating plans that can never fire.  This rule
+closes both directions:
+
+* every site-string literal passed to ``point`` / ``async_point`` /
+  ``_chaos_site`` — or assigned to a ``*_SITE`` constant — must exist
+  in ``KNOWN_SITES``;
+* every ``KNOWN_SITES`` key must be used by at least one such call
+  site (or ``*_SITE`` constant) somewhere in the package.
+
+The registry is parsed from ``util/fault_injection.py``'s AST — the
+linted tree is never imported.  When that file is absent from the walk
+(fixture trees), the rule is silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..engine import Finding, LintContext, Rule
+
+_REGISTRY_FILE_SUFFIX = "util/fault_injection.py"
+_POINT_FUNCS = {"point", "async_point", "_chaos_site"}
+
+
+class ChaosSiteDriftRule(Rule):
+    id = "chaos-site-drift"
+
+    def __init__(self) -> None:
+        #: site -> (rel, line) of first use in code
+        self.used: Dict[str, Tuple[str, int]] = {}
+        #: registry keys -> (rel, line)
+        self.known: Dict[str, Tuple[str, int]] = {}
+        self.registry_rel: str = ""
+
+    def visit_file(self, rel: str, tree: ast.AST, lines, ctx:
+                   LintContext) -> List[Finding]:
+        if rel.endswith(_REGISTRY_FILE_SUFFIX):
+            self.registry_rel = rel
+            self._harvest_registry(rel, tree)
+            return []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fname = ""
+                if isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                if fname in _POINT_FUNCS and node.args:
+                    site = self.str_const(node.args[0])
+                    if site is not None:
+                        self.used.setdefault(site, (rel, node.lineno))
+            elif isinstance(node, ast.Assign):
+                # SNAPSHOT_SITE = "train.snapshot_put" style constants
+                for t in node.targets:
+                    if isinstance(t, ast.Name) \
+                            and t.id.endswith("_SITE"):
+                        site = self.str_const(node.value)
+                        if site is not None:
+                            self.used.setdefault(site,
+                                                 (rel, node.lineno))
+        return []
+
+    def _harvest_registry(self, rel: str, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "KNOWN_SITES"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    site = self.str_const(k)
+                    if site is not None:
+                        self.known[site] = (rel, k.lineno)
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not self.known:
+            return []  # registry not in this tree (fixture runs)
+        findings: List[Finding] = []
+        for site, (rel, line) in sorted(self.used.items()):
+            if site not in self.known:
+                findings.append(Finding(
+                    self.id, rel, line, "<module>", site,
+                    f"chaos site {site!r} is threaded through the "
+                    f"code but missing from "
+                    f"fault_injection.KNOWN_SITES — no plan can ever "
+                    f"arm it (chaos validate rejects the site)"))
+        for site, (rel, line) in sorted(self.known.items()):
+            if site not in self.used:
+                findings.append(Finding(
+                    self.id, rel, line, "KNOWN_SITES", site,
+                    f"KNOWN_SITES entry {site!r} has no injection "
+                    f"point in the code — plans naming it validate "
+                    f"but can never fire; prune it or restore the "
+                    f"call site"))
+        return findings

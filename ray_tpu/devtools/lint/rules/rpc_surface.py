@@ -1,0 +1,185 @@
+"""Rule: client-side RPC op strings <-> registered server handlers.
+
+The control plane's wire protocol is stringly typed: a client does
+``conn.call("kv_put", ...)`` and a server must have run
+``server.register("kv_put", handler)``.  A typo on either side fails
+only at runtime ("no handler for method"), and an orphaned handler
+keeps an op name alive in ``dispatch_stats`` / attribution tables that
+nothing can reach.  This rule cross-checks the whole package:
+
+**Registrations** are harvested from
+* the registry-loop idiom: ``for name in ("a", "b", ...):
+  server.register(name, getattr(self, "_h_" + name))``
+* literal ``*.register("op", fn)`` calls
+* handler-dict wiring: ``handlers["op"] = fn`` (any name containing
+  ``handlers``) and dict literals assigned to such names
+* ``@server.handler("op")`` decorators
+
+**Call sites** are literal first arguments of ``*.call("op", ...)`` /
+``*.notify("op", ...)``.
+
+Checks: every call-site op must be registered somewhere; every
+registered op must appear at some call site — in the package, in the
+tests tree, or in the C++ sources (both scanned as reachability
+evidence).  Dynamic pubsub handlers (``pub:*`` / ``pub_batch``) are
+exempt from reachability: their call side is computed
+(``"pub:" + channel``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..engine import Finding, LintContext, Rule
+
+#: registered names exempt from the reachability check: dispatched via
+#: computed strings ("pub:" + channel) or by the remote runtime itself
+_REACH_EXEMPT_PREFIXES = ("pub:",)
+_REACH_EXEMPT = {"pub_batch"}
+
+
+class RpcSurfaceRule(Rule):
+    id = "rpc-surface"
+
+    def __init__(self) -> None:
+        #: op -> (rel, line, scope) of first registration
+        self.registered: Dict[str, Tuple[str, int, str]] = {}
+        #: op -> (rel, line, scope) of first literal call site
+        self.called: Dict[str, Tuple[str, int, str]] = {}
+        #: weak reachability witnesses: string args (any position) of
+        #: call-shaped wrappers (`_node_call(addr, "op")`,
+        #: `self._notify_controller("op", ...)`) — enough to prove a
+        #: handler reachable, too fuzzy to assert registration against
+        self.wrapper_evidence: set = set()
+
+    def visit_file(self, rel: str, tree: ast.AST, lines, ctx:
+                   LintContext) -> List[Finding]:
+        self._scan(rel, "<module>", tree)
+        return []
+
+    # ------------------------------------------------------------- harvest
+    def _scan(self, rel: str, scope: str, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                self._scan(rel, child.name, child)
+                continue
+            if isinstance(child, ast.ClassDef):
+                self._scan(rel, child.name, child)
+                continue
+            self._visit(rel, scope, child)
+            self._scan(rel, scope, child)
+
+    def _visit(self, rel: str, scope: str, node: ast.AST) -> None:
+        if isinstance(node, ast.For):
+            self._maybe_registry_loop(rel, scope, node)
+        elif isinstance(node, ast.Call):
+            self._maybe_call(rel, scope, node)
+        elif isinstance(node, ast.Assign):
+            self._maybe_handler_assign(rel, scope, node)
+
+    def _maybe_registry_loop(self, rel: str, scope: str,
+                             node: ast.For) -> None:
+        """``for name in ("a", "b"): server.register(name, ...)``"""
+        if not isinstance(node.target, ast.Name) \
+                or not isinstance(node.iter, (ast.Tuple, ast.List)):
+            return
+        loop_var = node.target.id
+        registers = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "register" \
+                    and sub.args \
+                    and isinstance(sub.args[0], ast.Name) \
+                    and sub.args[0].id == loop_var:
+                registers = True
+                break
+        if not registers:
+            return
+        for elt in node.iter.elts:
+            name = self.str_const(elt)
+            if name is not None:
+                self.registered.setdefault(name,
+                                           (rel, elt.lineno, scope))
+
+    def _maybe_call(self, rel: str, scope: str, call: ast.Call) -> None:
+        if isinstance(call.func, ast.Name):
+            if "call" in call.func.id.lower() \
+                    or "notify" in call.func.id.lower():
+                for arg in call.args:
+                    s = self.str_const(arg)
+                    if s is not None:
+                        self.wrapper_evidence.add(s)
+            return
+        if not isinstance(call.func, ast.Attribute):
+            return
+        attr = call.func.attr
+        if attr == "register" and call.args:
+            name = self.str_const(call.args[0])
+            if name is not None:
+                self.registered.setdefault(name,
+                                           (rel, call.lineno, scope))
+            return
+        if attr == "handler" and len(call.args) == 1:
+            name = self.str_const(call.args[0])
+            if name is not None:
+                self.registered.setdefault(name,
+                                           (rel, call.lineno, scope))
+            return
+        if attr in ("call", "notify") and call.args:
+            name = self.str_const(call.args[0])
+            if name is not None:
+                self.called.setdefault(name, (rel, call.lineno, scope))
+            return
+        if "call" in attr.lower() or "notify" in attr.lower():
+            for arg in call.args:
+                s = self.str_const(arg)
+                if s is not None:
+                    self.wrapper_evidence.add(s)
+
+    def _maybe_handler_assign(self, rel: str, scope: str,
+                              node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) \
+                    and "handlers" in self.dotted(t.value).lower():
+                name = self.str_const(t.slice)
+                if name is not None:
+                    self.registered.setdefault(name,
+                                               (rel, t.lineno, scope))
+            if isinstance(t, ast.Name) and "handlers" in t.id.lower() \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    name = self.str_const(k)
+                    if name is not None:
+                        self.registered.setdefault(name,
+                                                   (rel, k.lineno,
+                                                    scope))
+
+    # ------------------------------------------------------------ finalize
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not self.registered:
+            return []   # no server surface in this tree (fixture runs)
+        findings: List[Finding] = []
+        for op, (rel, line, scope) in sorted(self.called.items()):
+            if op not in self.registered:
+                findings.append(Finding(
+                    self.id, rel, line, scope, op,
+                    f"RPC op {op!r} is sent here but no server "
+                    f"registers a handler for it — the call can only "
+                    f"ever raise 'no handler for method'"))
+        for op, (rel, line, scope) in sorted(self.registered.items()):
+            if op in _REACH_EXEMPT \
+                    or op.startswith(_REACH_EXEMPT_PREFIXES):
+                continue
+            if op in self.called or op in self.wrapper_evidence \
+                    or op in ctx.evidence:
+                continue
+            findings.append(Finding(
+                self.id, rel, line, scope, op,
+                f"registered RPC handler {op!r} has no call site in "
+                f"the package, tests, or C++ sources — dead surface "
+                f"(remove it, or baseline with the external caller "
+                f"as the reason)"))
+        return findings

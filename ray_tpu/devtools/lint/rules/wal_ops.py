@@ -1,0 +1,115 @@
+"""Rule: every WAL op appended to ControllerStore replays in _apply.
+
+The controller's durability story is snapshot + WAL; HA promotion and
+same-host restart both rebuild the tables by replaying records through
+``persistence._apply``.  ``_apply`` silently ignores unknown ops (by
+design — forward compat), which means a NEW op string appended via
+``Controller._p(...)`` / ``pstore.append(...)`` without a matching
+replay arm persists bytes that do nothing: the mutation is durable on
+disk and lost on every restart.  That failure is invisible until the
+first failover.  This rule cross-checks:
+
+* every op-string literal appended (``self._p("op", ...)``, any
+  ``*.pstore.append("op", ...)``) has an ``op == "..."`` arm in
+  ``_apply``;
+* every ``_apply`` arm has at least one appender (a dead arm is
+  usually a refactor leftover — or intentional compat, which belongs
+  in the baseline with that reason).
+
+Silent when ``core/persistence.py`` is absent from the walked tree
+(fixture runs).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..engine import Finding, LintContext, Rule
+
+_PERSISTENCE_FILE_SUFFIX = "core/persistence.py"
+_APPEND_SUFFIXES = ("pstore.append",)
+
+
+class WalOpCoverageRule(Rule):
+    id = "wal-op-coverage"
+
+    def __init__(self) -> None:
+        self.appended: Dict[str, Tuple[str, int, str]] = {}
+        self.arms: Dict[str, Tuple[str, int]] = {}
+        self.saw_apply = False
+
+    def visit_file(self, rel: str, tree: ast.AST, lines, ctx:
+                   LintContext) -> List[Finding]:
+        if rel.endswith(_PERSISTENCE_FILE_SUFFIX):
+            self._harvest_arms(rel, tree)
+        scope = "<module>"
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        self._maybe_append(rel, node.name, sub)
+            elif isinstance(node, ast.Call):
+                self._maybe_append(rel, scope, node)
+        return []
+
+    def _maybe_append(self, rel: str, scope: str, call: ast.Call) -> None:
+        dotted = self.dotted(call.func)
+        # `self._p(...)` is the controller's WAL shorthand — only count
+        # it under core/ (train/gbdt.py has an unrelated `_p` helper);
+        # `*.pstore.append(...)` is unambiguous anywhere
+        is_append = (dotted.endswith("._p")
+                     and ("core/" in rel or rel.startswith("core/"))) \
+            or any(dotted.endswith(s) for s in _APPEND_SUFFIXES)
+        if not is_append or not call.args:
+            return
+        op = self.str_const(call.args[0])
+        if op is not None:
+            self.appended.setdefault(op, (rel, call.lineno, scope))
+
+    def _harvest_arms(self, rel: str, tree: ast.AST) -> None:
+        apply_fn = None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "_apply":
+                apply_fn = node
+                break
+        if apply_fn is None:
+            return
+        self.saw_apply = True
+        for node in ast.walk(apply_fn):
+            if isinstance(node, ast.Compare) \
+                    and isinstance(node.left, ast.Name) \
+                    and node.left.id == "op" \
+                    and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.In)):
+                for cmp in node.comparators:
+                    consts = [cmp] if not isinstance(
+                        cmp, (ast.Tuple, ast.List, ast.Set)) \
+                        else list(cmp.elts)
+                    for c in consts:
+                        opname = self.str_const(c)
+                        if opname is not None:
+                            self.arms.setdefault(opname,
+                                                 (rel, c.lineno))
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not self.saw_apply:
+            return []
+        findings: List[Finding] = []
+        for op, (rel, line, scope) in sorted(self.appended.items()):
+            if op not in self.arms:
+                findings.append(Finding(
+                    self.id, rel, line, scope, op,
+                    f"WAL op {op!r} is appended here but has no "
+                    f"replay arm in persistence._apply — the record "
+                    f"is durable on disk and silently dropped on "
+                    f"every restart/HA promotion"))
+        for op, (rel, line) in sorted(self.arms.items()):
+            if op not in self.appended:
+                findings.append(Finding(
+                    self.id, rel, line, "_apply", op,
+                    f"persistence._apply has a replay arm for "
+                    f"{op!r} but nothing appends that op — dead arm "
+                    f"(refactor leftover, or baseline it as "
+                    f"intentional WAL compat)"))
+        return findings

@@ -584,6 +584,45 @@ def cmd_metrics(args) -> None:
           f"cardinality checks clean")
 
 
+def cmd_lint(args) -> None:
+    """Framework-invariant static analysis (offline, no cluster): the
+    five AST rules of ray_tpu/devtools/lint — loop-blocking calls in
+    async bodies, thread/shared-state races, chaos-site drift, WAL-op
+    replay coverage, RPC surface consistency — checked against the
+    committed baseline.  Exits non-zero on any NEW finding (or a
+    baseline entry missing its reason)."""
+    import ray_tpu
+    from ray_tpu.devtools.lint import engine as lint_engine
+
+    if args.root:
+        package_dir = os.path.abspath(args.root)
+    else:
+        package_dir = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+    repo_root = os.path.dirname(package_dir)
+    evidence = []
+    tests_dir = os.path.join(repo_root, "tests")
+    if os.path.isdir(tests_dir):
+        evidence.append(tests_dir)
+    baseline = args.baseline
+    if args.no_baseline:
+        baseline = ""
+    elif args.root and baseline is None:
+        # linting a foreign tree: only use a baseline it carries itself
+        cand = lint_engine.default_baseline_path(package_dir)
+        baseline = cand if os.path.exists(cand) else ""
+    res = lint_engine.run_lint(package_dir, baseline_path=baseline,
+                               evidence_dirs=evidence)
+    if args.json:
+        print(json.dumps(res.to_json(), indent=2))
+    else:
+        print(lint_engine.render_text(res, verbose=args.verbose))
+    if not res.ok:
+        sys.exit(f"{len(res.findings)} new lint finding(s) + "
+                 f"{len(res.baseline_errors)} baseline issue(s) — fix "
+                 f"them, suppress with `# rtpu: allow[<rule>]`, or "
+                 f"baseline them WITH a reason")
+
+
 def cmd_microbenchmark(args) -> None:
     import ray_tpu
     from ray_tpu.microbenchmark import run_microbenchmarks
@@ -746,6 +785,25 @@ def main(argv=None) -> None:
                              "registered battery)")
     sp.add_argument("op", choices=["lint"])
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("lint",
+                        help="static analysis of the package source: "
+                             "loop-blocking, thread-race, chaos-site/"
+                             "WAL-op/RPC-surface drift (offline; "
+                             "non-zero exit on new findings)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    sp.add_argument("--verbose", action="store_true",
+                    help="also list baselined findings")
+    sp.add_argument("--baseline", default=None,
+                    help="baseline file (default: the committed "
+                         "ray_tpu/devtools/lint/baseline.json)")
+    sp.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    sp.add_argument("--root",
+                    help="lint this package dir instead of the "
+                         "installed ray_tpu (tests, fixture trees)")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("microbenchmark", help="core op throughput")
     sp.add_argument("--num-cpus", type=float, default=4)

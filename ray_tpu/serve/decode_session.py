@@ -611,8 +611,9 @@ class ContinuousBatchingEngine:
                                                    jnp.int32(depth))
                     sess.poff = depth
                     seeded = True
-                    self.prefix_hits += 1
-                    self.prefix_tokens_reused += depth
+                    with self._cond:   # stats() reads these counters
+                        self.prefix_hits += 1
+                        self.prefix_tokens_reused += depth
                     self._shape_seen("prefix_gather", 1)
                     SERVE_PREFIX_HITS.inc(tags={"deployment": self.name})
                     SERVE_PREFIX_TOKENS_REUSED.inc(
@@ -637,7 +638,8 @@ class ContinuousBatchingEngine:
                                          cfg=self._draft_cfg)
             self._shape_seen("draft_prefill_chunk", 1, take)
         sess.poff = off + take
-        self.prefill_chunks += 1
+        with self._cond:   # stats() reads this counter
+            self.prefill_chunks += 1
         SERVE_PREFILL_CHUNKS.inc(tags={"deployment": self.name})
         tracing.record_span(f"serve_prefill_chunk::{self.name}", "serve",
                             t0, time.time(), tokens=take,
@@ -788,11 +790,12 @@ class ContinuousBatchingEngine:
                     self._spec_fail_streak = 0
                     tok_dev = None   # host owns the carry again
                 except Exception as e:
-                    self.spec_fallbacks += 1
-                    self._spec_fail_streak += 1
-                    if self._spec_fail_streak >= \
-                            max(1, self.ecfg.spec_fail_disable):
-                        self._spec_disabled = True
+                    with self._cond:   # stats() reads these
+                        self.spec_fallbacks += 1
+                        self._spec_fail_streak += 1
+                        if self._spec_fail_streak >= \
+                                max(1, self.ecfg.spec_fail_disable):
+                            self._spec_disabled = True
                     tracing.record_span(
                         f"serve_spec_fallback::{self.name}", "serve",
                         t0, time.time(), error=repr(e),

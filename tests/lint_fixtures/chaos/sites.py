@@ -1,0 +1,14 @@
+"""Fixture injection points: one known, one constant, one unknown."""
+from .util import fault_injection as fi
+
+FX_CONST_SITE = "fx.const_site"
+
+
+async def good_path():
+    if fi.ACTIVE is not None:
+        await fi.ACTIVE.async_point("fx.used_site", "key")
+
+
+def bad_path():
+    if fi.ACTIVE is not None:
+        fi.ACTIVE.point("fx.typoed_site", "key")   # not in KNOWN_SITES

@@ -1,0 +1,9 @@
+"""Fixture registry mirroring util/fault_injection.py's shape."""
+
+KNOWN_SITES = {
+    "fx.used_site": None,
+    "fx.const_site": frozenset({"error"}),
+    "fx.dead_site": None,          # nothing injects here -> drift
+}
+
+ACTIVE = None

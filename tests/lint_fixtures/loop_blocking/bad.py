@@ -1,0 +1,40 @@
+"""Fixture: every loop-blocking pattern the rule must flag."""
+import os
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+class Store:
+    def __init__(self):
+        self.pstore = None
+        self._lt = None
+
+    def _p(self, *rec):
+        pass
+
+    async def handler_sleep(self, conn, data):          # time.sleep
+        time.sleep(0.1)
+
+    async def handler_open(self, conn, data):           # sync file I/O
+        with open("/tmp/x", "rb") as f:
+            return f.read()
+
+    async def handler_fsync(self, conn, data):          # os.fsync
+        os.fsync(3)
+
+    async def handler_wal(self, conn, data):            # known helper
+        self._p("kv_put", b"k", b"v")
+        self.pstore.append("epoch", 1)
+
+    async def handler_popen(self, conn, data):          # subprocess
+        subprocess.run(["true"])
+        subprocess.Popen(["true"])
+
+    async def handler_acquire(self, conn, data):        # unbounded lock
+        _lock.acquire()
+
+    async def handler_lt_run(self, conn, data):         # cross-thread join
+        return self._lt.run(None)

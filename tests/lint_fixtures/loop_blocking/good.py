@@ -1,0 +1,46 @@
+"""Fixture: async code the loop-blocking rule must NOT flag."""
+import asyncio
+import threading
+import time
+
+_lock = threading.Lock()
+_alock = asyncio.Lock()
+
+
+def sync_helper():
+    time.sleep(0.1)        # sync function: not on a loop
+    with open("/tmp/x") as f:
+        return f.read()
+
+
+class Store:
+    async def ok_sleep(self):
+        await asyncio.sleep(0.1)
+
+    async def ok_to_thread(self):
+        # blocking work shipped off-loop — the callable is an argument,
+        # not a call, and lambda/def bodies are exempt
+        data = await asyncio.to_thread(open, "/tmp/x", "rb")
+        await asyncio.get_event_loop().run_in_executor(
+            None, lambda: open("/tmp/y").read())
+        return data
+
+    async def ok_async_acquire(self):
+        await _alock.acquire()
+
+    async def ok_wait_for_acquire(self):
+        await asyncio.wait_for(_alock.acquire(), timeout=1.0)
+
+    async def ok_bounded_acquire(self):
+        _lock.acquire(timeout=0.5)
+        _lock.acquire(False)
+
+    async def ok_nested_def(self):
+        def _read():
+            time.sleep(0.01)
+            with open("/tmp/x") as f:
+                return f.read()
+        return await asyncio.to_thread(_read)
+
+    async def ok_suppressed(self):
+        time.sleep(0.01)  # rtpu: allow[loop-blocking]

@@ -1,0 +1,47 @@
+"""Fixture RPC surface: registry loop, literal register, handler dict,
+call sites — with one unregistered call and one unreachable handler."""
+
+
+class Server:
+    def __init__(self, server):
+        self.server = server
+        for name in ("fx_ping", "fx_lease", "fx_orphan_handler"):
+            server.register(name, getattr(self, "_h_" + name))
+        server.register("fx_literal", self._h_literal)
+        handlers = {"pub:fx": self._on_event}
+        handlers["fx_dict_wired"] = self._h_dict
+
+    async def _h_fx_ping(self, conn, data):
+        return "pong"
+
+    async def _h_fx_lease(self, conn, data):
+        return True
+
+    async def _h_fx_orphan_handler(self, conn, data):
+        return None   # nothing ever calls this op -> dead surface
+
+    async def _h_literal(self, conn, data):
+        return True
+
+    async def _h_dict(self, conn, data):
+        return True
+
+    async def _on_event(self, conn, data):
+        return True
+
+
+class Client:
+    def __init__(self, conn):
+        self.conn = conn
+
+    async def ping(self):
+        return await self.conn.call("fx_ping", {})
+
+    async def lease(self):
+        await self.conn.notify("fx_lease", {})
+        await self.conn.call("fx_literal", {})
+        await self.conn.call("fx_dict_wired", {})
+
+    async def typo(self):
+        # no server registers this op -> drift
+        return await self.conn.call("fx_ping_typo", {})

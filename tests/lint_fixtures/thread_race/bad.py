@@ -1,0 +1,40 @@
+"""Fixture: shared-state races the thread-race rule must flag."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.steps = 0
+        self.tokens = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self.steps += 1          # unlocked, thread side
+            self._advance()
+
+    def _advance(self):
+        self.tokens += 1             # unlocked, via transitive closure
+
+    def stats(self):
+        with self._cond:
+            return {"steps": self.steps, "tokens": self.tokens}
+
+
+class PublicMutator:
+    """Reverse direction: public method mutates what the thread reads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mode = "idle"
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            if self.mode == "stop":
+                return
+
+    def set_mode(self, m):
+        self.mode = m                # unlocked, public side

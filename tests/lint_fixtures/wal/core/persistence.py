@@ -1,0 +1,11 @@
+"""Fixture _apply mirroring core/persistence.py's replay shape."""
+
+
+def _apply(state, rec):
+    op = rec[0]
+    if op == "fx_kv_put":
+        state["kv"][rec[1]] = rec[2]
+    elif op == "fx_kv_del":
+        state["kv"].pop(rec[1], None)
+    elif op == "fx_dead_arm":           # nothing appends this -> drift
+        state["dead"] = True

@@ -1,0 +1,19 @@
+"""Fixture WAL writers: covered ops + one with no replay arm."""
+
+
+class Controller:
+    def __init__(self, pstore):
+        self.pstore = pstore
+
+    def _p(self, *record):
+        if self.pstore is not None:
+            self.pstore.append(*record)
+
+    def put(self, k, v):
+        self._p("fx_kv_put", k, v)          # has a replay arm
+
+    def delete(self, k):
+        self.pstore.append("fx_kv_del", k)  # has a replay arm
+
+    def orphan(self, node_id):
+        self._p("fx_orphan_op", node_id)    # NO replay arm -> drift

@@ -1,0 +1,263 @@
+"""PR-13: framework-invariant static-analysis suite (tier-1).
+
+Covers: the repo lints clean against the committed baseline (< 60 s),
+fixture-based positive/negative cases for each of the five rules,
+inline-suppression and baseline mechanics, the JSON output schema, and
+the `ray-tpu lint` CLI exiting non-zero on an injected violation of
+every rule.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from ray_tpu.devtools.lint import (default_baseline_path, load_baseline,
+                                   make_rules, run_lint)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PACKAGE = os.path.join(REPO, "ray_tpu")
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+
+
+def _lint(subdir, only=None, baseline_path=""):
+    return run_lint(os.path.join(FIXTURES, subdir),
+                    rules=make_rules(only=only),
+                    baseline_path=baseline_path)
+
+
+# --------------------------------------------------------- the real repo
+
+def test_repo_lints_clean_against_baseline():
+    """The committed tree must produce ZERO new findings — anything
+    grandfathered lives in baseline.json with a reason."""
+    res = run_lint(PACKAGE, evidence_dirs=[HERE])
+    assert res.files > 150
+    msgs = "\n".join(f"{f.rel}:{f.line} [{f.rule}] {f.message}"
+                     for f in res.findings)
+    assert res.findings == [], f"new lint findings:\n{msgs}"
+    assert res.baseline_errors == []
+    assert res.stale_baseline == [], (
+        "baseline entries no longer matched — prune them: "
+        f"{res.stale_baseline}")
+    assert res.duration_s < 60.0
+
+
+def test_committed_baseline_entries_all_carry_reasons():
+    keys, errors = load_baseline(default_baseline_path(PACKAGE))
+    assert errors == []
+    assert keys, "committed baseline exists and is non-empty"
+    for key, reason in keys.items():
+        assert len(reason) > 10, f"{key}: reason too thin: {reason!r}"
+
+
+# ------------------------------------------------- rule 1: loop-blocking
+
+def test_loop_blocking_positive():
+    res = _lint("loop_blocking", only={"loop-blocking"})
+    by_scope = {f.scope: f.detail for f in res.findings
+                if f.rel == "bad.py"}
+    assert by_scope["handler_sleep"] == "time.sleep"
+    assert by_scope["handler_open"] == "open"
+    assert by_scope["handler_fsync"] == "os.fsync"
+    assert by_scope["handler_acquire"] == "_lock.acquire"
+    assert by_scope["handler_lt_run"] == "_lt.run"
+    wal_details = {f.detail for f in res.findings
+                   if f.scope == "handler_wal"}
+    assert wal_details == {"_p", "pstore.append"}
+    popen = {f.detail for f in res.findings if f.scope == "handler_popen"}
+    assert popen == {"subprocess.run", "subprocess.Popen"}
+
+
+def test_loop_blocking_negative_and_suppression():
+    res = _lint("loop_blocking", only={"loop-blocking"})
+    good = [f for f in res.findings if f.rel == "good.py"]
+    assert good == [], [f.key for f in good]
+    assert any(f.rel == "good.py" and f.scope == "ok_suppressed"
+               for f in res.suppressed)
+
+
+# --------------------------------------------------- rule 2: thread-race
+
+def test_thread_race_positive():
+    res = _lint("thread_race", only={"thread-race"})
+    flagged = {(f.scope.split(".")[0], f.detail)
+               for f in res.findings if f.rel == "bad.py"}
+    assert ("Engine", "steps") in flagged      # thread entry itself
+    assert ("Engine", "tokens") in flagged     # transitive self-call
+    assert ("PublicMutator", "mode") in flagged  # public-side mutation
+
+
+def test_thread_race_negative_and_suppression():
+    res = _lint("thread_race", only={"thread-race"})
+    good = [f for f in res.findings if f.rel == "good.py"]
+    assert good == [], [f.key for f in good]
+    assert any(f.rel == "good.py" and f.detail == "flag"
+               for f in res.suppressed)
+
+
+# ----------------------------------------------- rule 3: chaos-site drift
+
+def test_chaos_site_drift_both_directions():
+    res = _lint("chaos", only={"chaos-site-drift"})
+    details = {f.detail for f in res.findings}
+    assert details == {"fx.typoed_site", "fx.dead_site"}
+    typo = next(f for f in res.findings if f.detail == "fx.typoed_site")
+    assert typo.rel == "sites.py"
+    dead = next(f for f in res.findings if f.detail == "fx.dead_site")
+    assert dead.rel.endswith("util/fault_injection.py")
+
+
+def test_chaos_rule_silent_without_registry():
+    # a tree with injection points but no KNOWN_SITES file: no findings
+    res = _lint("loop_blocking", only={"chaos-site-drift"})
+    assert res.findings == []
+
+
+# ---------------------------------------------- rule 4: WAL-op coverage
+
+def test_wal_op_coverage_both_directions():
+    res = _lint("wal", only={"wal-op-coverage"})
+    details = {f.detail for f in res.findings}
+    assert details == {"fx_orphan_op", "fx_dead_arm"}
+    orphan = next(f for f in res.findings if f.detail == "fx_orphan_op")
+    assert orphan.rel.endswith("core/writer.py")
+    assert orphan.scope == "orphan"
+
+
+# ------------------------------------------------- rule 5: rpc-surface
+
+def test_rpc_surface_both_directions():
+    res = _lint("rpc", only={"rpc-surface"})
+    details = {f.detail for f in res.findings}
+    assert details == {"fx_ping_typo", "fx_orphan_handler"}
+    # pub:* registrations and wired/called ops are never flagged
+    assert "pub:fx" not in details
+    assert "fx_dict_wired" not in details
+
+
+# --------------------------------------------------- baseline mechanics
+
+def _one_violation_tree(tmp_path):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "mod.py").write_text(
+        "import time\n"
+        "async def handler(conn, data):\n"
+        "    time.sleep(1)\n")
+    return str(tree)
+
+
+def test_baseline_grandfathers_known_findings(tmp_path):
+    tree = _one_violation_tree(tmp_path)
+    res = run_lint(tree, rules=make_rules(only={"loop-blocking"}),
+                   baseline_path="")
+    assert len(res.findings) == 1
+    key = res.findings[0].key
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"entries": [{"key": key, "reason": "known: fixture"}]}))
+    res2 = run_lint(tree, rules=make_rules(only={"loop-blocking"}),
+                    baseline_path=str(bl))
+    assert res2.ok and res2.findings == []
+    assert [f.key for f in res2.baselined] == [key]
+
+
+def test_baseline_requires_reasons_and_flags_stale(tmp_path):
+    tree = _one_violation_tree(tmp_path)
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"entries": [
+        {"key": "loop-blocking:mod.py:handler:time.sleep",
+         "reason": ""},                       # empty reason -> error
+        {"key": "loop-blocking:gone.py:x:y",
+         "reason": "this code was deleted"},  # stale -> warning
+    ]}))
+    res = run_lint(tree, rules=make_rules(only={"loop-blocking"}),
+                   baseline_path=str(bl))
+    assert not res.ok
+    assert any("empty" in e for e in res.baseline_errors)
+    assert res.stale_baseline == ["loop-blocking:gone.py:x:y"]
+
+
+def test_suppression_on_line_above(tmp_path):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "mod.py").write_text(
+        "import time\n"
+        "async def handler(conn, data):\n"
+        "    # rtpu: allow[loop-blocking]\n"
+        "    time.sleep(1)\n")
+    res = run_lint(str(tree), rules=make_rules(only={"loop-blocking"}),
+                   baseline_path="")
+    assert res.findings == [] and len(res.suppressed) == 1
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    (tree / "broken.py").write_text("def f(:\n")
+    res = run_lint(str(tree), baseline_path="")
+    assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+# ------------------------------------------------------- JSON schema
+
+def test_json_output_schema():
+    res = _lint("wal", only={"wal-op-coverage"})
+    payload = res.to_json()
+    assert set(payload) == {"ok", "files", "duration_s", "findings",
+                            "suppressed", "baselined", "stale_baseline",
+                            "baseline_errors"}
+    assert payload["ok"] is False
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "path", "line", "scope", "detail",
+                          "key", "message"}
+        assert f["key"].startswith(f["rule"] + ":")
+        assert isinstance(f["line"], int) and f["line"] > 0
+    # round-trips through json
+    json.loads(json.dumps(payload))
+
+
+# ------------------------------------------------------------- CLI
+
+def _cli(argv):
+    from ray_tpu.scripts import cli
+    cli.main(argv)
+
+
+def test_cli_clean_repo_exits_zero(capsys):
+    _cli(["lint"])  # raises SystemExit on failure
+    out = capsys.readouterr().out
+    assert "OK" in out and "baselined" in out
+
+
+def test_cli_json_flag(capsys):
+    _cli(["lint", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+
+
+@pytest.mark.parametrize("subdir,seed", [
+    ("loop_blocking", None),
+    ("thread_race", None),
+    ("chaos", None),
+    ("wal", None),
+    ("rpc", None),
+])
+def test_cli_exits_nonzero_on_injected_violation(tmp_path, subdir, seed):
+    """Acceptance: one injected violation of each rule fails the CLI."""
+    tree = tmp_path / "pkg"
+    shutil.copytree(os.path.join(FIXTURES, subdir), tree)
+    with pytest.raises(SystemExit) as ei:
+        _cli(["lint", "--root", str(tree)])
+    assert ei.value.code not in (0, None)
+
+
+def test_cli_exits_zero_on_clean_tree(tmp_path):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "loop_blocking", "good.py"),
+                tree / "good.py")
+    _cli(["lint", "--root", str(tree)])
