@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
 	attr chaos drain failover spec elastic ha partition autoscale \
-	autoscale-bench lint clean
+	autoscale-bench lint lint-fast clean
 
 all: native cpp
 
@@ -93,13 +93,20 @@ partition:
 spec:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve_spec_decode.py -q
 
-# Static analysis in one shot: the PR-13 framework-invariant suite
-# (loop-blocking / thread-race / chaos-site / WAL-op / RPC-surface
-# rules against the committed baseline) plus the PR-10 metrics lint.
-# Both are offline — no cluster, no JAX — and both gate tier-1.
+# Static analysis in one shot: the framework-invariant suite — all
+# eight rules (PR-13: loop-blocking / thread-race / chaos-site /
+# WAL-op / RPC-surface; PR-14: rpc-payload-contract / lock-order /
+# wal-replay-determinism) in ONE invocation against the committed
+# baseline — plus the PR-10 metrics lint.  Offline: no cluster, no
+# JAX; both gate tier-1.
 lint:
 	$(PY) -m ray_tpu.scripts.cli lint
 	$(PY) -m ray_tpu.scripts.cli metrics lint
+
+# Pre-commit fast path: full registries, findings filtered to files
+# git considers changed.
+lint-fast:
+	$(PY) -m ray_tpu.scripts.cli lint --changed
 
 bench:
 	$(PY) bench.py

@@ -475,6 +475,11 @@ class Controller:
         if not self.ha.is_leader \
                 and int(data.get("epoch", 0)) >= self.ha.epoch:
             self.ha.last_lease = time.monotonic()
+            # the renewal carries the leader's durable WAL seq: the
+            # standby's own view of its replay lag (leader_seq -
+            # applied_seq) surfaces in ha_status / `controller status`
+            self.ha.leader_seq = max(self.ha.leader_seq,
+                                     int(data.get("seq", 0) or 0))
         return True
 
     async def _h_ha_fence(self, conn, data):

@@ -82,6 +82,9 @@ class HAManager:
         # -- standby side ----------------------------------------------------
         self.tables: Optional[dict] = None
         self.applied_seq = 0
+        #: leader's durable WAL seq as last advertised on a lease
+        #: renewal — the standby's own replay-lag view
+        self.leader_seq = 0
         self.last_lease = time.monotonic()
         self._tasks: List[asyncio.Task] = []
 
@@ -351,6 +354,7 @@ class HAManager:
     def adopt_snapshot(self, data: dict) -> None:
         self.tables = persistence._unpack(data["tables_blob"])
         self.applied_seq = int(data.get("seq", 0))
+        self.leader_seq = max(self.leader_seq, self.applied_seq)
         self.epoch = max(self.epoch, int(data.get("epoch", 0)))
         if data.get("lease_timeout"):
             self.lease_timeout = float(data["lease_timeout"])
@@ -506,4 +510,6 @@ class HAManager:
             st["lease_age_s"] = round(
                 time.monotonic() - self.last_lease, 3)
             st["applied_seq"] = self.applied_seq
+            st["leader_seq"] = self.leader_seq
+            st["replay_lag"] = max(0, self.leader_seq - self.applied_seq)
         return st

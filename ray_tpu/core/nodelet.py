@@ -142,6 +142,10 @@ class Nodelet:
         # are granted here; in-flight work finishes and sole-copy
         # objects evacuate to peers before the controller deregisters us.
         self.draining = False
+        self._drain_deadline: Optional[float] = None
+        #: last cumulative serve counter value per (deployment,
+        #: replica, key) — `_h_serve_metrics` folds deltas from them
+        self._serve_counter_seen: Dict[tuple, int] = {}
         self._drain_finished = False   # heartbeats stop; never resurrect
         self._evac_rr = 0              # round-robin cursor over peers
         # Peer-reachability gossip: a few rotating peers are probed per
@@ -1241,6 +1245,11 @@ class Nodelet:
         """Enter drain mode: no new leases or actor starts; existing
         leases/tasks run to completion.  Returns the quiesce baseline."""
         self.draining = True
+        # the controller's evacuation budget: tracked so drain_status
+        # (and anyone tailing this nodelet) can see the runway left
+        budget = float(data.get("timeout_s") or 0.0)
+        self._drain_deadline = (time.monotonic() + budget) if budget \
+            else None
         me = self.view.get(self.node_id.hex())
         if me is not None:
             me.draining = True
@@ -1251,11 +1260,15 @@ class Nodelet:
                 "objects_left": len(self._primary_pins)}
 
     async def _h_drain_status(self, conn, data):
-        return {"in_flight": len(self.leases),
-                "running": len(self._running_tasks),
-                "objects_left": len(self._primary_pins),
-                "actor_workers": sum(1 for w in self.workers.values()
-                                     if w.state == "actor")}
+        st = {"in_flight": len(self.leases),
+              "running": len(self._running_tasks),
+              "objects_left": len(self._primary_pins),
+              "actor_workers": sum(1 for w in self.workers.values()
+                                   if w.state == "actor")}
+        if self._drain_deadline is not None:
+            st["budget_left_s"] = round(
+                self._drain_deadline - time.monotonic(), 3)
+        return st
 
     def _evac_peers(self):
         me = self.node_id.hex()
@@ -1883,6 +1896,24 @@ class Nodelet:
                 float(data.get("waiting", 0)), tags)
             rtm.SERVE_ENGINE_SLOTS.set(
                 float(data.get("max_slots", 0)), tags)
+            # prefix-cache counters travel CUMULATIVE (worker
+            # registries are never scraped — this fold is what makes
+            # hit rate visible cluster-wide); inc the positive delta,
+            # and treat a shrink as an engine restart
+            for key, metric in (
+                    ("prefix_hits", rtm.SERVE_PREFIX_HITS),
+                    ("prefix_tokens_reused",
+                     rtm.SERVE_PREFIX_TOKENS_REUSED)):
+                cur = data.get(key)
+                if cur is None:
+                    continue
+                cur = int(cur)
+                seen = (dep, str(rep), key)
+                prev = self._serve_counter_seen.get(seen, 0)
+                delta = cur - prev if cur >= prev else cur
+                self._serve_counter_seen[seen] = cur
+                if delta > 0:
+                    metric.inc(delta, {"deployment": dep})
         if "replicas" in data:
             rtm.SERVE_DEPLOYMENT_REPLICAS.set(
                 float(data["replicas"]), {"deployment": dep})

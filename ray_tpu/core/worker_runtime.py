@@ -124,9 +124,11 @@ class WorkerRuntime:
                                          retries=GlobalConfig.rpc_connect_retries)
         self.controller, _ep, _st = await rpc.connect_leader(
             self.controller_addr, retries=GlobalConfig.rpc_connect_retries)
+        # no "pid" on the wire: the nodelet owns the authoritative pid
+        # from the spawn path (Popen / zygote fork reply) on every
+        # worker it tracks
         reply = await self.nodelet.call("register_worker", {
-            "worker_id": self.worker_id, "port": self.server.port,
-            "pid": os.getpid()})
+            "worker_id": self.worker_id, "port": self.server.port})
         GlobalConfig.load_snapshot(reply.get("config", {}))
         from ..util import fault_injection as fi
         fi.maybe_arm_from_config()

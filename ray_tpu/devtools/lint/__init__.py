@@ -3,9 +3,13 @@
 The control plane is a set of asyncio loops plus a few background
 threads, and every defect class that has cost a PR cycle — a handler
 blocking the controller loop, a thread racing a public method on shared
-state, a chaos site/WAL op/RPC op drifting out of its registry — is
-statically detectable.  `ray-tpu lint` runs five rules over the package
-source (no cluster, no imports of the linted code):
+state, a chaos site/WAL op/RPC op drifting out of its registry, two
+sides of an RPC disagreeing on payload keys, two locks taken in
+opposite orders, a WAL replay arm reading a clock — is statically
+detectable.  `ray-tpu lint` runs eight rules over the package source
+(no cluster, no imports of the linted code); the interprocedural ones
+share one call-graph/closure builder (``callgraph.py``, built once per
+file by the engine):
 
 ``loop-blocking``
     blocking calls (``time.sleep``, sync file I/O, ``fsync``, blocking
@@ -28,14 +32,34 @@ source (no cluster, no imports of the linted code):
     every client-side op string sent over ``core/rpc.py`` has a
     registered server handler somewhere, and every registered handler
     is reachable from some call site (package, tests, or C++ sources).
+``rpc-payload-contract``
+    per RPC op: the keys each sender provably ships vs the keys the
+    handler reads (required ``req["k"]`` reads a sender omits →
+    KeyError under version skew/failover replay; keys sent but never
+    read → dead wire bytes; reply keys a caller reads that no return
+    arm includes → reply-shape drift).
+``lock-order``
+    per-process lock-acquisition graph over the call closure: cycles
+    between locks taken in inconsistent order (the silent deadlock),
+    and ``await`` while holding a ``threading`` lock (the dynamic
+    sibling of loop-blocking).
+``wal-replay-determinism``
+    no clocks, randomness, env reads, or set iteration inside the
+    transitive closure of ``persistence._apply`` — leader and standby
+    must fold identical state from identical WAL records.
 
 Suppression: append ``# rtpu: allow[<rule-id>]`` (comma list ok) to the
 flagged line or the line above it.  Grandfathered findings live in the
 committed ``baseline.json`` next to this module — every entry must
-carry a non-empty ``reason``.  See ``engine.py`` for the walker and
-``rules/`` for the per-rule visitors.
+carry a non-empty ``reason``; entries that stop firing FAIL the run
+until pruned (or regenerate with ``ray-tpu lint --update-baseline``).
+See ``engine.py`` for the walker, ``callgraph.py`` for the shared
+closure builder, and ``rules/`` for the per-rule visitors.
 """
 
+from .callgraph import (FuncInfo, ModuleGraph,  # noqa: F401
+                        build_module_graph)
 from .engine import (BASELINE_FILENAME, Finding, LintResult,  # noqa: F401
-                     default_baseline_path, load_baseline, run_lint)
+                     default_baseline_path, load_baseline, run_lint,
+                     update_baseline)
 from .rules import ALL_RULES, make_rules  # noqa: F401

@@ -1,0 +1,172 @@
+"""Shared call-graph / closure builder for the lint rules.
+
+PR-13's thread-race rule privately computed a transitive ``self.*()``
+call closure to decide what runs "on the thread side".  Every
+interprocedural rule needs the same thing — the lock-order rule walks
+what a method reaches while a lock is held, the WAL-determinism rule
+walks what a replay arm can execute, the payload rule follows a request
+dict handed to a helper.  This module builds that graph ONCE per file
+(the engine stores it in ``LintContext.graphs``) and every rule shares
+it.
+
+Resolution is deliberately module-local and structural:
+
+* ``self.m(...)`` resolves to method ``m`` of the lexically enclosing
+  class (no MRO — the framework does not override control-plane
+  methods across subclasses);
+* a bare ``f(...)`` resolves to a module-level ``def f`` in the same
+  file;
+* anything else (other objects, imports, ``cls.m``) is out of graph —
+  rules treat unresolved calls as opaque.
+
+Nested ``def``/``lambda`` bodies are NOT folded into the enclosing
+function's edges: a nested function is usually a callback/executor
+payload that runs at a different time (often on a different thread or
+loop), so attributing its calls to the enclosing frame would poison
+both the race and the lock-order analyses.  Comprehension bodies DO
+count (they run inline).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class FuncInfo:
+    """One function or method: its AST node plus resolved call edges."""
+
+    __slots__ = ("rel", "cls", "name", "node", "lineno", "is_async",
+                 "self_calls", "func_calls")
+
+    def __init__(self, rel: str, cls: Optional[str], name: str, node):
+        self.rel = rel
+        self.cls = cls                       # class name or None
+        self.name = name
+        self.node = node
+        self.lineno = node.lineno
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        #: method names called as ``self.<m>(...)`` (class scope)
+        self.self_calls: Set[str] = set()
+        #: bare names called as ``<f>(...)`` (module scope)
+        self.func_calls: Set[str] = set()
+
+    @property
+    def qname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    def __repr__(self) -> str:
+        return f"<FuncInfo {self.rel}:{self.qname}>"
+
+
+class ModuleGraph:
+    """Call graph of one parsed module."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        #: class name -> {method name -> FuncInfo}
+        self.classes: Dict[str, Dict[str, FuncInfo]] = {}
+        #: module-level function name -> FuncInfo
+        self.functions: Dict[str, FuncInfo] = {}
+        self._closure_cache: Dict[Tuple[Optional[str], str],
+                                  List[FuncInfo]] = {}
+
+    # ------------------------------------------------------------ lookup
+    def resolve(self, cls: Optional[str], name: str) -> Optional[FuncInfo]:
+        if cls is not None:
+            return self.classes.get(cls, {}).get(name)
+        return self.functions.get(name)
+
+    def iter_all(self) -> Iterable[FuncInfo]:
+        for methods in self.classes.values():
+            yield from methods.values()
+        yield from self.functions.values()
+
+    # ----------------------------------------------------------- closure
+    def closure(self, fn: FuncInfo) -> List[FuncInfo]:
+        """Transitive call closure of ``fn`` (including ``fn`` itself),
+        following ``self.*`` edges within its class and bare-name edges
+        to module functions.  Deterministic order (BFS, sorted
+        frontier); cached per (class, name) — cycles are fine."""
+        key = (fn.cls, fn.name)
+        cached = self._closure_cache.get(key)
+        if cached is not None:
+            return cached
+        seen: Set[Tuple[Optional[str], str]] = {key}
+        order: List[FuncInfo] = [fn]
+        frontier = [fn]
+        while frontier:
+            cur = frontier.pop(0)
+            nxt: List[Tuple[Optional[str], str]] = []
+            # self-calls stay in the CALLER's class context: a module
+            # function has no self, so self_calls is empty there
+            nxt.extend((cur.cls, m) for m in sorted(cur.self_calls))
+            nxt.extend((None, f) for f in sorted(cur.func_calls))
+            for ck, cn in nxt:
+                if (ck, cn) in seen:
+                    continue
+                seen.add((ck, cn))
+                info = self.resolve(ck, cn)
+                if info is not None:
+                    order.append(info)
+                    frontier.append(info)
+        self._closure_cache[key] = order
+        return order
+
+    def method_closure_names(self, cls: str, entries: Iterable[str]) \
+            -> Set[str]:
+        """Names of methods of ``cls`` reachable from ``entries`` via
+        self-calls (the thread-race rule's historical contract)."""
+        out: Set[str] = set()
+        for entry in entries:
+            info = self.resolve(cls, entry)
+            if info is None:
+                # e.g. a class nested inside a function (not in the
+                # module-top-level graph): the entry itself still
+                # counts as thread context
+                out.add(entry)
+                continue
+            for fn in self.closure(info):
+                if fn.cls == cls:
+                    out.add(fn.name)
+        return out
+
+
+def build_module_graph(rel: str, tree: ast.AST) -> ModuleGraph:
+    g = ModuleGraph(rel)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            methods = g.classes.setdefault(node.name, {})
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    info = FuncInfo(rel, node.name, item.name, item)
+                    _collect_edges(item, info)
+                    methods.setdefault(item.name, info)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = FuncInfo(rel, None, node.name, node)
+            _collect_edges(node, info)
+            g.functions.setdefault(node.name, info)
+    return g
+
+
+def _collect_edges(fn, info: FuncInfo) -> None:
+    """Harvest call edges from ``fn``'s own body, skipping nested
+    function/lambda scopes (they run at another time/place)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _NESTED_SCOPES) \
+                or isinstance(node, ast.ClassDef):
+            continue
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self":
+                info.self_calls.add(f.attr)
+            elif isinstance(f, ast.Name):
+                info.func_calls.add(f.id)
+        stack.extend(ast.iter_child_nodes(node))

@@ -17,6 +17,8 @@ import re
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Set
 
+from .callgraph import ModuleGraph, build_module_graph
+
 BASELINE_FILENAME = "baseline.json"
 
 #: directories never walked (bytecode, VCS, build junk)
@@ -65,6 +67,10 @@ class LintContext:
         self.root = root
         #: rel path -> source lines (rules may want the raw text)
         self.sources: Dict[str, List[str]] = {}
+        #: rel path -> ModuleGraph (shared call-graph/closure builder:
+        #: built once per file by the engine, reused by every
+        #: interprocedural rule)
+        self.graphs: Dict[str, ModuleGraph] = {}
         #: quoted strings seen in evidence files (tests, .cc/.h)
         self.evidence: Set[str] = set()
         #: free-form per-rule scratch space, keyed by rule id
@@ -80,16 +86,25 @@ class LintResult:
         self.baseline_errors: List[str] = []   # malformed entries
         self.files = 0
         self.duration_s = 0.0
+        #: rule id -> seconds spent in its visit_file + finalize
+        self.rule_timing: Dict[str, float] = {}
 
     @property
     def ok(self) -> bool:
-        return not self.findings and not self.baseline_errors
+        # stale baseline entries FAIL (PR-14): a key that no longer
+        # fires means the code was fixed — prune the entry (or run
+        # `ray-tpu lint --update-baseline`) so the baseline never
+        # shadows a future regression at the same key
+        return not self.findings and not self.baseline_errors \
+            and not self.stale_baseline
 
     def to_json(self) -> Dict[str, Any]:
         return {
             "ok": self.ok,
             "files": self.files,
             "duration_s": round(self.duration_s, 3),
+            "rule_timing": {r: round(t, 4)
+                            for r, t in sorted(self.rule_timing.items())},
             "findings": [f.to_json() for f in self.findings],
             "suppressed": [f.to_json() for f in self.suppressed],
             "baselined": [f.to_json() for f in self.baselined],
@@ -167,14 +182,18 @@ def _allowed_rules(lines: List[str], line_no: int) -> Set[str]:
 def run_lint(package_dir: str, rules: Optional[Sequence] = None,
              baseline_path: Optional[str] = None,
              evidence_dirs: Sequence[str] = (),
-             exclude: Sequence[str] = ()) -> LintResult:
+             exclude: Sequence[str] = (),
+             only_rel: Optional[Set[str]] = None) -> LintResult:
     """Lint every ``.py`` under ``package_dir`` with ``rules``.
 
     ``evidence_dirs`` (plus any C/C++ sources inside the package) are
     scanned for quoted strings only — reachability witnesses, never
     findings.  ``baseline_path=None`` means the committed default next
     to this module; pass ``""`` to disable the baseline entirely.
-    ``exclude`` holds fnmatch patterns against the rel path."""
+    ``exclude`` holds fnmatch patterns against the rel path.
+    ``only_rel`` (the `--changed` path) still walks the WHOLE tree —
+    cross-file rules need the full registries — but reports only
+    findings anchored in those rel paths."""
     from .rules import make_rules
     t0 = time.monotonic()
     package_dir = os.path.abspath(package_dir)
@@ -210,10 +229,18 @@ def run_lint(package_dir: str, rules: Optional[Sequence] = None,
             continue
         lines = src.splitlines()
         ctx.sources[rel] = lines
+        ctx.graphs[rel] = build_module_graph(rel, tree)
         for rule in rules:
+            rt0 = time.monotonic()
             raw.extend(rule.visit_file(rel, tree, lines, ctx) or ())
+            res.rule_timing[rule.id] = \
+                res.rule_timing.get(rule.id, 0.0) \
+                + (time.monotonic() - rt0)
     for rule in rules:
+        rt0 = time.monotonic()
         raw.extend(rule.finalize(ctx) or ())
+        res.rule_timing[rule.id] = \
+            res.rule_timing.get(rule.id, 0.0) + (time.monotonic() - rt0)
 
     # suppressions, dedupe (same key keeps its first site), baseline
     baseline, res.baseline_errors = load_baseline(baseline_path)
@@ -233,6 +260,8 @@ def run_lint(package_dir: str, rules: Optional[Sequence] = None,
         else:
             res.findings.append(f)
     res.stale_baseline = sorted(set(baseline) - hit_baseline)
+    if only_rel is not None:
+        res.findings = [f for f in res.findings if f.rel in only_rel]
     res.findings.sort(key=lambda f: (f.rel, f.line, f.rule))
     res.duration_s = time.monotonic() - t0
     return res
@@ -260,14 +289,51 @@ def render_text(res: LintResult, verbose: bool = False) -> str:
             out.append(f"baselined: {f.rel}:{f.line}: [{f.rule}] "
                        f"{f.message}")
     for key in res.stale_baseline:
-        out.append(f"WARNING: stale baseline entry (no longer found): "
-                   f"{key}")
+        out.append(f"ERROR: stale baseline entry (no longer fires): "
+                   f"{key} — the code was fixed; prune the entry or "
+                   f"run `ray-tpu lint --update-baseline`")
     status = "OK" if res.ok else f"{len(res.findings)} new finding(s)"
     out.append(f"{status}: {res.files} file(s) linted in "
                f"{res.duration_s:.2f}s — {len(res.findings)} new, "
                f"{len(res.baselined)} baselined, "
-               f"{len(res.suppressed)} suppressed")
+               f"{len(res.suppressed)} suppressed, "
+               f"{len(res.stale_baseline)} stale")
     return "\n".join(out)
+
+
+def update_baseline(path: str, res: LintResult) -> Dict[str, int]:
+    """Regenerate the baseline file in place from ``res``: every
+    finding that still fires keeps its existing reason, NEW findings
+    get an EMPTY reason (which `ray-tpu lint` refuses until a human
+    fills it in — regeneration documents, it does not absolve), and
+    stale entries are dropped.  Returns counts for the CLI summary."""
+    old, _ = load_baseline(path)
+    entries: List[Dict[str, str]] = []
+    kept = new = 0
+    for f in sorted(res.baselined + res.findings, key=lambda f: f.key):
+        reason = old.get(f.key, "")
+        if reason:
+            kept += 1
+        else:
+            new += 1
+        entries.append({"key": f.key, "reason": reason})
+    payload = {
+        "version": 1,
+        "comment": ("Grandfathered lint findings. Every entry needs a "
+                    "non-empty reason; `ray-tpu lint` fails on new "
+                    "findings not listed here. Remove entries as the "
+                    "underlying code is fixed (stale entries FAIL). "
+                    "Regenerate with `ray-tpu lint --update-baseline`."),
+        "entries": entries,
+    }
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return {"kept": kept, "new": new,
+            "dropped": len(res.stale_baseline)}
 
 
 # --------------------------------------------------------------- rule base

@@ -1,13 +1,18 @@
 """Rule registry: one visitor plugin per framework invariant."""
 
 from .chaos_sites import ChaosSiteDriftRule
+from .lock_order import LockOrderRule
 from .loop_blocking import LoopBlockingRule
+from .rpc_payload import RpcPayloadContractRule
 from .rpc_surface import RpcSurfaceRule
 from .thread_race import ThreadRaceRule
+from .wal_determinism import WalReplayDeterminismRule
 from .wal_ops import WalOpCoverageRule
 
 ALL_RULES = (LoopBlockingRule, ThreadRaceRule, ChaosSiteDriftRule,
-             WalOpCoverageRule, RpcSurfaceRule)
+             WalOpCoverageRule, RpcSurfaceRule,
+             RpcPayloadContractRule, LockOrderRule,
+             WalReplayDeterminismRule)
 
 
 def make_rules(only=None):

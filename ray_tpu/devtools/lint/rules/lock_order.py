@@ -1,0 +1,283 @@
+"""Rule: per-process lock-order cycles and awaits under a thread lock.
+
+Two locks taken in opposite orders on two code paths is the classic
+distributed-runtime deadlock: it never fires in tests (the windows are
+microseconds) and freezes a nodelet in production.  With the shared
+call graph, the order is statically visible:
+
+* every ``with self.<lock>:`` / ``async with`` / bare ``.acquire()``
+  on a lock attribute is an acquisition; while one is lexically held,
+  any acquisition reached through the transitive self-call/module-call
+  closure adds a ``held -> acquired`` edge;
+* the per-module edge graph (each control-plane process is one module:
+  controller, nodelet, worker runtime, engine) is searched for cycles —
+  every strongly-connected component with more than one lock is a
+  finding listing the contradictory sites;
+* an ``await`` while a **threading** lock is held is the dynamic
+  sibling of PR-13's loop-blocking rule: the coroutine parks, the OS
+  lock stays taken, and every thread (and any other handler needing
+  that lock) blocks behind a suspended frame.  ``asyncio`` primitives
+  are exempt — parking while holding one is their design.
+
+Lock identity is structural: ``self.<attr>`` assigned a
+``threading.*``/``asyncio.*`` ``Lock/RLock/Condition/Semaphore``
+factory (per class), or a module-level name assigned one.  Self-edges
+(re-acquiring the same lock) are ignored — reentrant locks and
+condition-variable idioms would drown the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, LintContext, Rule
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class _FnLocks:
+    __slots__ = ("direct", "held_calls", "edges")
+
+    def __init__(self):
+        #: lock id -> line of first direct acquisition in this function
+        self.direct: Dict[str, int] = {}
+        #: (held lock ids, callee (cls, name), line)
+        self.held_calls: List[Tuple[Tuple[str, ...],
+                                    Tuple[Optional[str], str], int]] = []
+        #: direct lexical edges: (held, acquired) -> line
+        self.edges: Dict[Tuple[str, str], int] = {}
+
+
+class LockOrderRule(Rule):
+    id = "lock-order"
+
+    def visit_file(self, rel: str, tree: ast.AST, lines, ctx:
+                   LintContext) -> List[Finding]:
+        graph = ctx.graphs.get(rel)
+        if graph is None:
+            return []
+        findings: List[Finding] = []
+        # -- lock universe: per-class self attrs + module-level names
+        class_locks: Dict[str, Dict[str, str]] = {}   # cls -> attr -> kind
+        module_locks: Dict[str, str] = {}             # name -> kind
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                kind = self._factory_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            module_locks[t.id] = kind
+        for info in graph.iter_all():
+            if info.cls is None:
+                continue
+            locks = class_locks.setdefault(info.cls, {})
+            for sub in ast.walk(info.node):
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Call):
+                    kind = self._factory_kind(sub.value)
+                    if not kind:
+                        continue
+                    for t in sub.targets:
+                        attr = self._self_attr(t)
+                        if attr is not None:
+                            locks[attr] = kind
+        if not any(class_locks.values()) and not module_locks:
+            return []
+
+        # -- per-function lexical scan
+        fn_locks: Dict[Tuple[Optional[str], str], _FnLocks] = {}
+        kinds: Dict[str, str] = {}      # lock id -> thread|async
+        for info in graph.iter_all():
+            rec = _FnLocks()
+            fn_locks[(info.cls, info.name)] = rec
+            cl = class_locks.get(info.cls, {}) if info.cls else {}
+            self._scan_fn(rel, info, cl, module_locks, rec, kinds,
+                          findings)
+
+        # -- propagate: edges from held-site into everything the callee
+        #    closure acquires
+        totals: Dict[Tuple[Optional[str], str], Dict[str, int]] = {}
+
+        def total_acquires(key) -> Dict[str, int]:
+            if key in totals:
+                return totals[key]
+            totals[key] = {}   # cycle guard
+            info = graph.resolve(*key)
+            if info is None:
+                return totals[key]
+            acc: Dict[str, int] = {}
+            for fn in graph.closure(info):
+                rec = fn_locks.get((fn.cls, fn.name))
+                if rec:
+                    for lk, ln in rec.direct.items():
+                        acc.setdefault(lk, ln)
+            totals[key] = acc
+            return acc
+
+        edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        for (cls, name), rec in fn_locks.items():
+            scope = f"{cls}.{name}" if cls else name
+            for (a, b), line in rec.edges.items():
+                edges.setdefault((a, b), (line, scope))
+            for held, callee, line in rec.held_calls:
+                for b in total_acquires(callee):
+                    for a in held:
+                        if a != b:
+                            edges.setdefault((a, b), (line, scope))
+
+        findings.extend(self._cycle_findings(rel, edges))
+        return findings
+
+    # ------------------------------------------------------------- cycles
+    def _cycle_findings(self, rel: str, edges) -> List[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        # strongly-connected components (iterative Tarjan would be
+        # overkill: lock graphs are tiny — use reachability)
+        reach: Dict[str, Set[str]] = {}
+
+        def reachable(n: str) -> Set[str]:
+            if n in reach:
+                return reach[n]
+            seen: Set[str] = set()
+            stack = [n]
+            while stack:
+                cur = stack.pop()
+                for nxt in adj.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            reach[n] = seen
+            return seen
+
+        nodes = sorted(adj)
+        assigned: Set[str] = set()
+        findings: List[Finding] = []
+        for n in nodes:
+            if n in assigned:
+                continue
+            # n is in its own SCC iff a cycle returns to it (reachable
+            # is the strict forward set, so mutual membership already
+            # implies the cycle)
+            scc = {m for m in nodes
+                   if m in reachable(n) and n in reachable(m)}
+            if len(scc) < 2:
+                continue
+            assigned |= scc
+            locks = sorted(scc)
+            sites = []
+            for (a, b), (line, scope) in sorted(edges.items()):
+                if a in scc and b in scc:
+                    sites.append(f"{a}->{b} at {scope}:{line}")
+            first_line = min(line for (a, b), (line, _) in edges.items()
+                             if a in scc and b in scc)
+            findings.append(Finding(
+                self.id, rel, first_line, "<module>",
+                "<>".join(locks),
+                f"lock-order cycle between {', '.join(locks)}: the "
+                f"same locks are acquired in inconsistent order on "
+                f"different paths ({'; '.join(sites[:4])}) — two "
+                f"threads/tasks interleaving these paths deadlock; "
+                f"pick one global order"))
+        return findings
+
+    # ------------------------------------------------------------ scanning
+    def _scan_fn(self, rel, info, class_locks, module_locks, rec,
+                 kinds, findings) -> None:
+        cls = info.cls
+
+        def lock_of(expr) -> Optional[str]:
+            attr = self._self_attr(expr)
+            if attr is not None and attr in class_locks:
+                lid = f"{cls}.{attr}"
+                kinds.setdefault(lid, class_locks[attr])
+                return lid
+            if isinstance(expr, ast.Name) and expr.id in module_locks:
+                kinds.setdefault(expr.id, module_locks[expr.id])
+                return expr.id
+            return None
+
+        def walk(node, held: Tuple[str, ...]):
+            if isinstance(node, _NESTED) and node is not info.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = list(held)
+                for it in node.items:
+                    ctx_expr = it.context_expr
+                    # `with self._lock:` — possibly via `.acquire()`?
+                    lk = lock_of(ctx_expr)
+                    if lk is None:
+                        walk(ctx_expr, tuple(new_held))
+                    else:
+                        for h in new_held:
+                            if h != lk:
+                                rec.edges.setdefault((h, lk),
+                                                     node.lineno)
+                        rec.direct.setdefault(lk, node.lineno)
+                        new_held.append(lk)
+                for child in node.body:
+                    walk(child, tuple(new_held))
+                return
+            if isinstance(node, ast.Await):
+                held_thread = [h for h in held
+                               if kinds.get(h) == "thread"]
+                if held_thread:
+                    findings.append(Finding(
+                        self.id, rel, node.lineno,
+                        f"{cls}.{info.name}" if cls else info.name,
+                        f"await-under:{held_thread[0]}",
+                        f"`await` while holding threading lock "
+                        f"{held_thread[0]} — the coroutine parks but "
+                        f"the OS lock stays taken: every thread and "
+                        f"handler needing it blocks behind a "
+                        f"suspended frame; use an asyncio primitive "
+                        f"or release before awaiting"))
+                walk(node.value, held)
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    lk = lock_of(f.value)
+                    if lk is not None:
+                        for h in held:
+                            if h != lk:
+                                rec.edges.setdefault((h, lk),
+                                                     node.lineno)
+                        rec.direct.setdefault(lk, node.lineno)
+                if held and isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self":
+                    rec.held_calls.append((held, (cls, f.attr),
+                                           node.lineno))
+                elif held and isinstance(f, ast.Name):
+                    rec.held_calls.append((held, (None, f.id),
+                                           node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in info.node.body:
+            walk(stmt, ())
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _self_attr(node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _factory_kind(self, call: ast.Call) -> Optional[str]:
+        dotted = self.dotted(call.func)
+        base = dotted.split(".")[-1]
+        if base not in _LOCK_FACTORIES:
+            return None
+        return "async" if dotted.startswith("asyncio.") else "thread"

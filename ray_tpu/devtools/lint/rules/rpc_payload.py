@@ -1,0 +1,700 @@
+"""Rule: cross-process RPC payload contracts (request, consumption, reply).
+
+The rpc-surface rule (PR-13) proves every op string has a handler; this
+rule proves the two sides agree on the PAYLOAD.  The wire protocol is
+schemaless msgpack dicts, so a sender building ``{"oid": ...}`` while
+the handler reads ``req["object_id"]`` fails only at runtime — as a
+KeyError inside the controller, typically first observed under version
+skew or HA failover replay.  Three checks per op:
+
+* **missing required key** — the handler reads ``req["k"]`` (no
+  default) but some sender's payload provably omits ``k``.  Sender key
+  sets come from dict literals and tracked locals (``payload = {...}``
+  plus later ``payload["k"] = ...`` adds); senders whose payload we
+  cannot resolve contribute nothing.
+* **dead wire bytes** — a key some sender ships that NO handler of the
+  op ever reads (checked only when every handler's read set is closed,
+  i.e. the request dict never escapes whole).  Underscore-prefixed keys
+  (``_ha_epoch``) are protocol metadata consumed by generic layers and
+  exempt.
+* **reply-shape drift** — a caller reads ``reply["k"]`` but no return
+  arm of the handler ever includes ``k`` (checked only when every
+  return statement in the handler closure is a dict literal or a bare
+  constant; ``reply.get`` probes and underscore meta keys are exempt —
+  the HA gate injects ``_not_leader`` replies on every op).
+
+Handlers are resolved through the same idioms the rpc-surface rule
+harvests — registry loops (``getattr(self, "_h_" + name)``), literal
+``register("op", self._m)``, handler dicts, ``@server.handler`` — and
+their payload reads are followed interprocedurally through the shared
+call graph when the handler passes the request dict to a helper
+(``self._do_x(data)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, LintContext, Rule
+
+_FWD_DEPTH = 3          # how deep a payload dict is followed
+_HARMLESS_BUILTINS = {"len", "bool", "type", "isinstance", "repr",
+                      "str", "id", "print"}
+
+
+class _Sender:
+    __slots__ = ("rel", "line", "scope", "keys", "cond_keys", "closed",
+                 "none_payload")
+
+    def __init__(self, rel, line, scope):
+        self.rel = rel
+        self.line = line
+        self.scope = scope
+        self.keys: Set[str] = set()        # keys always present
+        self.cond_keys: Set[str] = set()   # keys maybe present
+        self.closed = False                # key set fully known
+        self.none_payload = False          # call sent no payload at all
+
+
+class _HandlerReads:
+    """Consumption profile of one handler (merged over its payload
+    forwarding closure)."""
+
+    __slots__ = ("required", "optional", "written", "open_reads",
+                 "reply_sets", "reply_open", "has_dict_reply")
+
+    def __init__(self):
+        self.required: Dict[str, int] = {}   # key -> line of req["k"]
+        self.optional: Set[str] = set()
+        self.written: Set[str] = set()
+        self.open_reads = False
+        self.reply_sets: List[Set[str]] = []
+        self.reply_open = False
+        self.has_dict_reply = False
+
+
+class _ReplyRead:
+    __slots__ = ("rel", "line", "scope", "key")
+
+    def __init__(self, rel, line, scope, key):
+        self.rel = rel
+        self.line = line
+        self.scope = scope
+        self.key = key
+
+
+class RpcPayloadContractRule(Rule):
+    id = "rpc-payload-contract"
+
+    def __init__(self) -> None:
+        #: op -> list of (rel, class-or-None, func name) handler refs
+        self.handlers: Dict[str, List[Tuple[str, Optional[str], str]]] = {}
+        #: ops whose handler expression we could not resolve — skip
+        self.unresolved_ops: Set[str] = set()
+        self.senders: Dict[str, List[_Sender]] = {}
+        self.reply_reads: Dict[str, List[_ReplyRead]] = {}
+
+    # ---------------------------------------------------------------- visit
+    def visit_file(self, rel: str, tree: ast.AST, lines, ctx:
+                   LintContext) -> List[Finding]:
+        self._scan_scope(rel, None, "<module>", tree)
+        return []
+
+    def _scan_scope(self, rel: str, cls: Optional[str], scope: str,
+                    node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._scan_scope(rel, child.name, child.name, child)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                self._decorator_handlers(rel, cls, child)
+                self._scan_function(rel, cls, child)
+            else:
+                self._scan_scope(rel, cls, scope, child)
+
+    def _decorator_handlers(self, rel, cls, fn) -> None:
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) \
+                    and isinstance(dec.func, ast.Attribute) \
+                    and dec.func.attr == "handler" and dec.args:
+                op = self.str_const(dec.args[0])
+                if op is not None:
+                    self.handlers.setdefault(op, []).append(
+                        (rel, cls, fn.name))
+
+    # ------------------------------------------------------ per function
+    def _scan_function(self, rel: str, cls: Optional[str], fn) -> None:
+        scope = fn.name
+        #: local var -> (always keys, cond keys, resolvable) for
+        #: payload locals (`payload = {...}`; later subscript adds)
+        locals_: Dict[str, List] = {}
+        #: reply var name -> op
+        reply_vars: Dict[str, str] = {}
+        # ast.walk covers nested defs too: a send site inside a nested
+        # callback is still attributed to this (named) scope
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                self._track_local(node, locals_)
+                self._track_reply_var(node, reply_vars)
+            elif isinstance(node, ast.For):
+                self._maybe_registry_loop(rel, cls, node)
+            elif isinstance(node, ast.Call):
+                self._maybe_register(rel, cls, node)
+            elif isinstance(node, ast.Subscript):
+                self._track_local_add(node, locals_)
+        # second pass: send sites (locals_ now complete) + reply reads
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                self._maybe_send(rel, scope, node, locals_)
+            self._maybe_reply_read(rel, scope, node, reply_vars)
+
+    # -- payload locals ---------------------------------------------------
+    @staticmethod
+    def _track_local(node: ast.Assign, locals_: Dict[str, List]) -> None:
+        if len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Dict):
+            keys, closed = _dict_keys(node.value)
+            if name in locals_:
+                locals_[name][2] = False  # reassigned: give up
+            else:
+                locals_[name] = [keys, set(), closed]
+        elif name in locals_:
+            locals_[name][2] = False      # rebound to something else
+
+    @staticmethod
+    def _track_local_add(node: ast.Subscript, locals_: Dict[str, List]) \
+            -> None:
+        # `payload["k"] = ...` anywhere in the function: the key is at
+        # least conditionally present
+        if isinstance(node.ctx, ast.Store) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in locals_:
+            key = RpcPayloadContractRule.str_const(node.slice)
+            if key is not None:
+                locals_[node.value.id][1].add(key)
+            else:
+                locals_[node.value.id][2] = False
+
+    def _track_reply_var(self, node: ast.Assign,
+                         reply_vars: Dict[str, str]) -> None:
+        if len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            return
+        op = self._send_op(node.value)
+        if op is not None:
+            reply_vars[node.targets[0].id] = op
+
+    @staticmethod
+    def _send_op(expr) -> Optional[str]:
+        """Op string if ``expr`` is (an Await of) ``*.call("op", ...)``."""
+        if isinstance(expr, ast.Await):
+            expr = expr.value
+        if isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr == "call" and expr.args:
+            return RpcPayloadContractRule.str_const(expr.args[0])
+        return None
+
+    # -- registrations ----------------------------------------------------
+    def _maybe_registry_loop(self, rel: str, cls: Optional[str],
+                             node: ast.For) -> None:
+        """``for name in ("a", ...): s.register(name,
+        [wrapper(...,] getattr(self, "_h_" + name) [)])``"""
+        if not isinstance(node.target, ast.Name) \
+                or not isinstance(node.iter, (ast.Tuple, ast.List)):
+            return
+        loop_var = node.target.id
+        prefix = None
+        registers = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "register" and sub.args \
+                    and isinstance(sub.args[0], ast.Name) \
+                    and sub.args[0].id == loop_var:
+                registers = True
+            p = _getattr_prefix(sub, loop_var)
+            if p is not None:
+                prefix = p
+        if not registers:
+            return
+        for elt in node.iter.elts:
+            op = self.str_const(elt)
+            if op is None:
+                continue
+            if prefix is None:
+                self.unresolved_ops.add(op)
+            else:
+                self.handlers.setdefault(op, []).append(
+                    (rel, cls, prefix + op))
+
+    def _maybe_register(self, rel: str, cls: Optional[str],
+                        call: ast.Call) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        if call.func.attr == "register" and len(call.args) >= 2:
+            op = self.str_const(call.args[0])
+            if op is None:
+                return
+            ref = self._handler_ref(rel, cls, call.args[1])
+            if ref is None:
+                # opaque handler expression (lambda, partial, computed
+                # getattr with a literal op): skip the op entirely
+                self.unresolved_ops.add(op)
+            else:
+                self.handlers.setdefault(op, []).append(ref)
+
+    @staticmethod
+    def _handler_ref(rel, cls, expr) -> Optional[Tuple]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return (rel, cls, expr.attr)
+        if isinstance(expr, ast.Name):
+            return (rel, None, expr.id)
+        return None
+
+    # -- send sites -------------------------------------------------------
+    def _maybe_send(self, rel: str, scope: str, call: ast.Call,
+                    locals_: Dict[str, List]) -> None:
+        func = call.func
+        op = None
+        payload = _OMITTED
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("call", "notify"):
+            op = self.str_const(call.args[0]) if call.args else None
+            if op is not None:
+                payload = call.args[1] if len(call.args) > 1 else None
+        elif isinstance(func, (ast.Attribute, ast.Name)):
+            # wrapper idiom: first string-const positional is the op,
+            # the next positional is the payload candidate
+            tail = func.attr if isinstance(func, ast.Attribute) \
+                else func.id
+            low = tail.lower()
+            if ("call" in low or "notify" in low) \
+                    and tail not in ("call", "notify"):
+                for i, a in enumerate(call.args):
+                    s = self.str_const(a)
+                    if s is not None:
+                        op = s
+                        payload = call.args[i + 1] \
+                            if len(call.args) > i + 1 else None
+                        break
+        if op is None:
+            return
+        # keyword payloads (timeout=...) are not the payload
+        sender = _Sender(rel, call.lineno, scope)
+        if payload is _OMITTED or payload is None \
+                or (isinstance(payload, ast.Constant)
+                    and payload.value is None):
+            sender.closed = True
+            sender.none_payload = True
+        elif isinstance(payload, ast.Dict):
+            sender.keys, sender.closed = _dict_keys(payload)
+        elif isinstance(payload, ast.Name) \
+                and payload.id in locals_:
+            keys, cond, resolvable = locals_[payload.id]
+            if resolvable:
+                sender.keys = set(keys)
+                sender.cond_keys = set(cond)
+                sender.closed = True
+            else:
+                return      # unknown payload — contributes nothing
+        else:
+            return          # computed payload — contributes nothing
+        self.senders.setdefault(op, []).append(sender)
+
+    # -- reply reads ------------------------------------------------------
+    def _maybe_reply_read(self, rel: str, scope: str, node,
+                          reply_vars: Dict[str, str]) -> None:
+        # r["k"] where r was assigned from *.call("op", ...), or the
+        # chained form (await conn.call("op", ...))["k"]
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            key = self.str_const(node.slice)
+            if key is None:
+                return
+            op = None
+            if isinstance(node.value, ast.Name):
+                op = reply_vars.get(node.value.id)
+            else:
+                op = self._send_op(node.value)
+            if op is not None:
+                self.reply_reads.setdefault(op, []).append(
+                    _ReplyRead(rel, node.lineno, scope, key))
+
+    # ------------------------------------------------------------ finalize
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not self.handlers:
+            return []
+        findings: List[Finding] = []
+        profiles: Dict[str, List[_HandlerReads]] = {}
+        for op, refs in self.handlers.items():
+            if op in self.unresolved_ops:
+                continue
+            profs = []
+            for rel, cls, name in refs:
+                graph = ctx.graphs.get(rel)
+                info = graph.resolve(cls, name) if graph else None
+                if info is None:
+                    profs = None
+                    break
+                prof = _HandlerReads()
+                _analyze_handler(graph, info, prof, _FWD_DEPTH, set())
+                profs.append(prof)
+            if profs:
+                profiles[op] = profs
+
+        for op in sorted(profiles):
+            profs = profiles[op]
+            handler_rel, _, handler_name = self.handlers[op][0]
+            # 1. required key missing from a provably-closed sender.
+            # A key that is ALSO membership-checked / .get-probed /
+            # written by the handler is guarded ("if 'k' in req:
+            # req['k']") — not required on the wire.
+            required: Dict[str, int] = {
+                k: v for k, v in profs[0].required.items()
+                if k not in profs[0].optional
+                and k not in profs[0].written}
+            for p in profs[1:]:
+                required = {k: v for k, v in required.items()
+                            if k in p.required and k not in p.optional
+                            and k not in p.written}
+            for sender in self.senders.get(op, ()):
+                if not sender.closed:
+                    continue
+                present = sender.keys | sender.cond_keys
+                for k in sorted(required):
+                    if k in present:
+                        continue
+                    what = "no payload at all" if sender.none_payload \
+                        else f"keys {sorted(present)}"
+                    findings.append(Finding(
+                        self.id, sender.rel, sender.line, sender.scope,
+                        f"{op}.{k}",
+                        f"sends RPC op {op!r} with {what} but the "
+                        f"handler `{handler_name}` "
+                        f"({handler_rel}) reads req[{k!r}] without a "
+                        f"default — KeyError on the serving process "
+                        f"(first seen under version skew or failover "
+                        f"replay); send the key or make the handler "
+                        f"read .get({k!r}, ...)"))
+            # 2. dead wire bytes (all handlers' read sets closed)
+            if all(not p.open_reads for p in profs):
+                read: Set[str] = set()
+                for p in profs:
+                    read |= set(p.required) | p.optional | p.written
+                for sender in self.senders.get(op, ()):
+                    for k in sorted((sender.keys | sender.cond_keys)
+                                    - read):
+                        if k.startswith("_"):
+                            continue   # protocol meta (_ha_epoch)
+                        findings.append(Finding(
+                            self.id, sender.rel, sender.line,
+                            sender.scope, f"{op}.{k}:dead",
+                            f"key {k!r} is sent with RPC op {op!r} "
+                            f"but no handler ever reads it — dead "
+                            f"wire bytes on every call (drop it, or "
+                            f"consume it in `{handler_name}`)"))
+            # 3. reply-shape drift (all handlers reply-closed)
+            if all(not p.reply_open and p.has_dict_reply
+                   for p in profs):
+                reply_union: Set[str] = set()
+                for p in profs:
+                    for s in p.reply_sets:
+                        reply_union |= s
+                for rr in self.reply_reads.get(op, ()):
+                    if rr.key.startswith("_") or rr.key in reply_union:
+                        continue
+                    findings.append(Finding(
+                        self.id, rr.rel, rr.line, rr.scope,
+                        f"{op}.{rr.key}:reply",
+                        f"reads reply[{rr.key!r}] of RPC op {op!r} "
+                        f"but no return arm of handler "
+                        f"`{handler_name}` ({handler_rel}) includes "
+                        f"that key — reply-shape drift (KeyError on "
+                        f"the caller)"))
+        return findings
+
+
+#: sentinel distinguishing "no payload argument" from explicit None
+_OMITTED = object()
+
+
+def _dict_keys(d: ast.Dict) -> Tuple[Set[str], bool]:
+    """(literal string keys, fully-known?) for a dict literal."""
+    keys: Set[str] = set()
+    closed = True
+    for k in d.keys:
+        if k is None:                     # **spread
+            closed = False
+            continue
+        s = RpcPayloadContractRule.str_const(k)
+        if s is None:
+            closed = False
+        else:
+            keys.add(s)
+    return keys, closed
+
+
+def _getattr_prefix(node, loop_var: str) -> Optional[str]:
+    """``getattr(self, "_h_" + name)`` -> "_h_" (either operand
+    order)."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr" and len(node.args) >= 2):
+        return None
+    arg = node.args[1]
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+        for const, var in ((arg.left, arg.right), (arg.right, arg.left)):
+            s = RpcPayloadContractRule.str_const(const)
+            if s is not None and isinstance(var, ast.Name) \
+                    and var.id == loop_var:
+                return s
+    return None
+
+
+# ------------------------------------------------------- handler analysis
+
+def _analyze_handler(graph, info, prof: _HandlerReads, depth: int,
+                     seen: Set[Tuple]) -> None:
+    """Fold ``info``'s consumption of its payload parameter into
+    ``prof``, following the request dict through ``self.helper(data)``
+    forwards via the shared call graph."""
+    key = (info.cls, info.name)
+    if key in seen:
+        return
+    seen.add(key)
+    args = [a.arg for a in info.node.args.args]
+    if not args:
+        return
+    param = args[-1]
+    if param in ("self", "conn"):
+        return
+    forwards: List[Tuple[str, int, Optional[str]]] = []
+    _scan_payload_use(info.node, param, prof, forwards, top=True)
+    if depth <= 0:
+        if forwards:
+            prof.open_reads = True
+        return
+    for callee, pos, kwname in forwards:
+        target = graph.resolve(info.cls, callee)
+        if target is None:
+            prof.open_reads = True
+            continue
+        t_args = [a.arg for a in target.node.args.args]
+        t_param = None
+        if kwname is not None:
+            t_param = kwname if kwname in t_args else None
+        else:
+            idx = pos + (1 if t_args and t_args[0] == "self" else 0)
+            if idx < len(t_args):
+                t_param = t_args[idx]
+        if t_param is None:
+            prof.open_reads = True
+            continue
+        sub = _HandlerReads()
+        fwd2: List[Tuple[str, int, Optional[str]]] = []
+        _scan_payload_use(target.node, t_param, sub, fwd2, top=False)
+        # recurse one level deeper through the callee's own forwards
+        for c2, p2, kw2 in fwd2:
+            t2 = graph.resolve(target.cls, c2)
+            if t2 is None:
+                sub.open_reads = True
+                continue
+            sub_seen = set(seen)
+            saved = (sub.reply_sets, sub.reply_open, sub.has_dict_reply)
+            _analyze_forward(graph, t2, p2, kw2, sub, depth - 2,
+                             sub_seen)
+            sub.reply_sets, sub.reply_open, sub.has_dict_reply = saved
+        prof.required.update(
+            {k: v for k, v in sub.required.items()
+             if k not in prof.required})
+        prof.optional |= sub.optional
+        prof.written |= sub.written
+        prof.open_reads = prof.open_reads or sub.open_reads
+
+
+def _analyze_forward(graph, target, pos, kwname, prof, depth, seen) \
+        -> None:
+    t_args = [a.arg for a in target.node.args.args]
+    t_param = None
+    if kwname is not None:
+        t_param = kwname if kwname in t_args else None
+    else:
+        idx = pos + (1 if t_args and t_args[0] == "self" else 0)
+        if idx < len(t_args):
+            t_param = t_args[idx]
+    if t_param is None:
+        prof.open_reads = True
+        return
+    fwd: List[Tuple[str, int, Optional[str]]] = []
+    _scan_payload_use(target.node, t_param, prof, fwd, top=False)
+    if fwd and depth <= 0:
+        prof.open_reads = True
+
+
+def _scan_payload_use(fn, param: str, prof: _HandlerReads,
+                      forwards: List, top: bool) -> None:
+    """One function body: where does ``param`` (the request dict) go?
+    ``top`` controls whether return statements define the reply
+    shape."""
+
+    def is_param(node) -> bool:
+        return isinstance(node, ast.Name) and node.id == param
+
+    def scan(node, in_test=False):
+        if node is None:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            # the dict does not change identity — reads inside nested
+            # defs are still reads of the same payload
+            for child in ast.iter_child_nodes(node):
+                scan(child)
+            return
+        if isinstance(node, ast.Subscript) and is_param(node.value):
+            key = RpcPayloadContractRule.str_const(node.slice)
+            if key is None:
+                prof.open_reads = True
+            elif isinstance(node.ctx, ast.Load):
+                prof.required.setdefault(key, node.lineno)
+            elif isinstance(node.ctx, ast.Store):
+                prof.written.add(key)
+            else:
+                prof.optional.add(key)
+            scan(node.slice)
+            return
+        if isinstance(node, ast.Call):
+            scan_call(node)
+            return
+        if isinstance(node, ast.Compare) and len(node.ops) == 1:
+            if isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                    and is_param(node.comparators[0]):
+                k = RpcPayloadContractRule.str_const(node.left)
+                if k is not None:
+                    prof.optional.add(k)
+                scan(node.left)
+                return
+            if isinstance(node.ops[0], (ast.Is, ast.IsNot, ast.Eq,
+                                        ast.NotEq)):
+                # `data is None` / truthiness probes read no keys
+                if is_param(node.left) or any(
+                        is_param(c) for c in node.comparators):
+                    for c in [node.left] + node.comparators:
+                        if not is_param(c):
+                            scan(c)
+                    return
+        if isinstance(node, ast.Return):
+            if top:
+                v = node.value
+                if v is None or (isinstance(v, ast.Constant)):
+                    pass                      # bare/scalar: no keys
+                elif isinstance(v, ast.Dict):
+                    keys, closed = _dict_keys(v)
+                    prof.reply_sets.append(keys)
+                    prof.has_dict_reply = True
+                    if not closed:
+                        prof.reply_open = True
+                else:
+                    prof.reply_open = True
+            if node.value is not None:
+                if is_param(node.value):
+                    prof.open_reads = True
+                else:
+                    scan(node.value)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            scan(node.test, in_test=True)
+            for child in node.body + getattr(node, "orelse", []):
+                scan(child)
+            return
+        if isinstance(node, (ast.BoolOp, ast.UnaryOp)) and in_test:
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_test=True)
+            return
+        if isinstance(node, ast.For) and is_param(node.iter):
+            prof.open_reads = True
+            scan(node.target)
+            for child in node.body + node.orelse:
+                scan(child)
+            return
+        if is_param(node) and not in_test:
+            # any unrecognized appearance: aliasing, serialization,
+            # container membership — the read set is no longer closed
+            prof.open_reads = True
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    def scan_call(node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and is_param(f.value):
+            k = RpcPayloadContractRule.str_const(node.args[0]) \
+                if node.args else None
+            if f.attr == "get":
+                if k is None:
+                    prof.open_reads = True
+                else:
+                    prof.optional.add(k)
+            elif f.attr == "pop":
+                if k is None:
+                    prof.open_reads = True
+                elif len(node.args) >= 2:
+                    prof.optional.add(k)
+                else:
+                    prof.required.setdefault(k, node.lineno)
+            elif f.attr == "setdefault":
+                if k is None:
+                    prof.open_reads = True
+                else:
+                    prof.optional.add(k)
+                    prof.written.add(k)
+            else:
+                # .items()/.keys()/.values()/.copy()/.update(...):
+                # the whole dict is on the table
+                prof.open_reads = True
+            for a in node.args[1:]:
+                scan(a)
+            for kw in node.keywords:
+                scan(kw.value)
+            return
+        if isinstance(f, ast.Attribute) and isinstance(f.value,
+                                                       ast.Name) \
+                and f.value.id == "self":
+            for i, a in enumerate(node.args):
+                if is_param(a):
+                    forwards.append((f.attr, i, None))
+                else:
+                    scan(a)
+            for kw in node.keywords:
+                if is_param(kw.value):
+                    if kw.arg is None:
+                        prof.open_reads = True     # self.m(**data)
+                    else:
+                        forwards.append((f.attr, -1, kw.arg))
+                else:
+                    scan(kw.value)
+            return
+        if isinstance(f, ast.Name) and f.id in _HARMLESS_BUILTINS:
+            for a in node.args:
+                if not is_param(a):
+                    scan(a)
+            return
+        for a in node.args:
+            if is_param(a):
+                prof.open_reads = True
+            else:
+                scan(a)
+        for kw in node.keywords:
+            if is_param(kw.value):
+                prof.open_reads = True
+            else:
+                scan(kw.value)
+        scan(f)
+
+    for stmt in fn.body:
+        scan(stmt)

@@ -9,7 +9,9 @@ the instance lock.  This rule checks it structurally:
 * a class "spawns a thread" when any method constructs
   ``threading.Thread(target=self.<m>, ...)`` — ``<m>`` is the thread
   entry; the thread context is its transitive ``self.*()`` call
-  closure within the class.
+  closure within the class, taken from the engine's shared call graph
+  (``LintContext.graphs`` — PR-14 generalized the closure this rule
+  used to compute privately).
 * "instance locks" are attributes assigned ``threading.Lock()`` /
   ``RLock()`` / ``Condition()`` (any dotted spelling).
 * a mutation (``self.x = ...`` / ``self.x += ...``) counts as locked
@@ -46,7 +48,6 @@ class _MethodInfo:
         self.mutated_locked: Set[str] = set()
         self.mutated_unlocked: Dict[str, int] = {}   # attr -> line
         self.reads: Set[str] = set()
-        self.calls_self: Set[str] = set()
 
 
 class ThreadRaceRule(Rule):
@@ -57,11 +58,12 @@ class ThreadRaceRule(Rule):
         findings: List[Finding] = []
         for node in ast.walk(tree):
             if isinstance(node, ast.ClassDef):
-                findings.extend(self._check_class(rel, node))
+                findings.extend(self._check_class(rel, node, ctx))
         return findings
 
     # ------------------------------------------------------------ per class
-    def _check_class(self, rel: str, cls: ast.ClassDef) -> List[Finding]:
+    def _check_class(self, rel: str, cls: ast.ClassDef,
+                     ctx: LintContext) -> List[Finding]:
         methods: Dict[str, _MethodInfo] = {}
         lock_attrs: Set[str] = set()
         thread_targets: Set[str] = set()
@@ -76,15 +78,12 @@ class ThreadRaceRule(Rule):
             return []
 
         # thread context: entry methods + transitive self-call closure
-        thread_ctx: Set[str] = set()
-        frontier = [m for m in thread_targets if m in methods]
-        while frontier:
-            m = frontier.pop()
-            if m in thread_ctx:
-                continue
-            thread_ctx.add(m)
-            frontier.extend(c for c in methods[m].calls_self
-                            if c in methods and c not in thread_ctx)
+        # (from the engine's shared call graph)
+        graph = ctx.graphs.get(rel)
+        thread_ctx: Set[str] = graph.method_closure_names(
+            cls.name, [m for m in thread_targets if m in methods]) \
+            if graph is not None else set(thread_targets)
+        thread_ctx &= set(methods)
 
         public = [m for m in methods
                   if not m.startswith("_") and m not in thread_ctx]
@@ -205,8 +204,6 @@ class ThreadRaceRule(Rule):
                             tgt = self._self_attr(kw.value)
                             if tgt is not None:
                                 thread_targets.add(tgt)
-                if dotted.startswith("self.") and dotted.count(".") == 1:
-                    info.calls_self.add(dotted.split(".", 1)[1])
             attr = self._self_attr(sub)
             if attr is not None and isinstance(getattr(sub, "ctx",
                                                        None), ast.Load):

@@ -1,0 +1,142 @@
+"""Rule: WAL replay (`persistence._apply`) must be deterministic.
+
+The HA design (PR-8) and the reference's replicated-GCS assumption
+(arXiv:1712.05889 §4.2) rest on one invariant: leader and standby fold
+IDENTICAL table state from identical WAL records.  ``_apply`` runs at
+different wall-clock times on different hosts — any nondeterminism
+source inside it (or anything it transitively calls) silently forks
+the replicas: a ``time.time()`` stamp, a ``uuid4`` id, an env read, or
+iterating a ``set`` (whose order depends on hash seeding across
+processes) all produce divergent state that no test compares and no
+failover survives cleanly.
+
+This rule takes the transitive call closure of ``_apply`` in
+``core/persistence.py`` from the shared call graph (module-local —
+helpers that replay arms call live in the same file by design) and
+flags every reachable nondeterminism source:
+
+* clocks: ``time.time``/``monotonic``/``perf_counter``/``*_ns``,
+  ``datetime.now``/``utcnow``
+* randomness: ``random.*``, ``uuid.*``, ``secrets.*``, ``os.urandom``
+* environment reads: ``os.getenv``, ``os.environ[...]``/``.get``
+* set iteration: ``for ... in`` over a set literal/comprehension or a
+  ``set(...)``/``frozenset(...)`` call (dicts are insertion-ordered
+  and fine; sets are not)
+
+A legitimate use (e.g. a replay-progress log line) is suppressed at
+the site; anything else is a real replica-divergence bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..engine import Finding, LintContext, Rule
+
+_PERSISTENCE_FILE_SUFFIX = "core/persistence.py"
+_APPLY_FN = "_apply"
+
+_NONDET_EXACT = {
+    "time.time": "wall clock",
+    "time.time_ns": "wall clock",
+    "time.monotonic": "process-local clock",
+    "time.monotonic_ns": "process-local clock",
+    "time.perf_counter": "process-local clock",
+    "time.perf_counter_ns": "process-local clock",
+    "datetime.now": "wall clock",
+    "datetime.utcnow": "wall clock",
+    "datetime.datetime.now": "wall clock",
+    "datetime.datetime.utcnow": "wall clock",
+    "os.urandom": "entropy",
+    "os.getenv": "environment read",
+    "os.environ.get": "environment read",
+}
+_NONDET_PREFIXES = {
+    "random.": "randomness",
+    "uuid.": "randomness",
+    "secrets.": "entropy",
+}
+
+
+class WalReplayDeterminismRule(Rule):
+    id = "wal-replay-determinism"
+
+    def visit_file(self, rel: str, tree: ast.AST, lines, ctx:
+                   LintContext) -> List[Finding]:
+        if not rel.endswith(_PERSISTENCE_FILE_SUFFIX):
+            return []
+        graph = ctx.graphs.get(rel)
+        if graph is None:
+            return []
+        entry = graph.functions.get(_APPLY_FN)
+        if entry is None:
+            for methods in graph.classes.values():
+                if _APPLY_FN in methods:
+                    entry = methods[_APPLY_FN]
+                    break
+        if entry is None:
+            return []
+        findings: List[Finding] = []
+        for fn in graph.closure(entry):
+            self._scan_fn(rel, fn, findings)
+        return findings
+
+    def _scan_fn(self, rel: str, fn, findings: List[Finding]) -> None:
+        scope = fn.qname
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                why = self._nondet_call(node)
+                if why is not None:
+                    detail, kind = why
+                    findings.append(Finding(
+                        self.id, rel, node.lineno, scope, detail,
+                        f"`{detail}(...)` inside the replay closure "
+                        f"of persistence._apply ({kind}) — leader and "
+                        f"standby must fold IDENTICAL state from "
+                        f"identical WAL records; derive the value "
+                        f"from the record itself or move it out of "
+                        f"replay"))
+            elif isinstance(node, ast.Subscript):
+                if self.dotted(node.value) == "os.environ":
+                    findings.append(Finding(
+                        self.id, rel, node.lineno, scope, "os.environ",
+                        f"os.environ[...] inside the replay closure "
+                        f"of persistence._apply (environment read) — "
+                        f"replicas with different environments fold "
+                        f"different state from the same WAL"))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if self._is_set_iter(node.iter):
+                    findings.append(Finding(
+                        self.id, rel, node.lineno, scope,
+                        "set-iteration",
+                        f"iterating a set inside the replay closure "
+                        f"of persistence._apply — set order depends "
+                        f"on per-process hash seeding, so two "
+                        f"replicas replaying the same records can "
+                        f"fold tables in different order; sort it or "
+                        f"use a list/dict"))
+
+    def _nondet_call(self, call: ast.Call) -> Optional[tuple]:
+        dotted = self.dotted(call.func)
+        if not dotted:
+            return None
+        # `import time as _time` is the repo's local-import idiom —
+        # normalize the leading component's underscores away
+        parts = dotted.split(".")
+        norm = ".".join([parts[0].lstrip("_") or parts[0]] + parts[1:])
+        kind = _NONDET_EXACT.get(norm)
+        if kind is not None:
+            return dotted, kind
+        for prefix, k in _NONDET_PREFIXES.items():
+            if norm.startswith(prefix):
+                return dotted, k
+        return None
+
+    def _is_set_iter(self, it) -> bool:
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(it, ast.Call):
+            dotted = self.dotted(it.func)
+            return dotted in ("set", "frozenset")
+        return False

@@ -586,11 +586,12 @@ def cmd_metrics(args) -> None:
 
 def cmd_lint(args) -> None:
     """Framework-invariant static analysis (offline, no cluster): the
-    five AST rules of ray_tpu/devtools/lint — loop-blocking calls in
+    eight AST rules of ray_tpu/devtools/lint — loop-blocking calls in
     async bodies, thread/shared-state races, chaos-site drift, WAL-op
-    replay coverage, RPC surface consistency — checked against the
-    committed baseline.  Exits non-zero on any NEW finding (or a
-    baseline entry missing its reason)."""
+    replay coverage, RPC surface consistency, RPC payload contracts,
+    lock-order cycles, WAL replay determinism — checked against the
+    committed baseline.  Exits non-zero on any NEW finding, a baseline
+    entry missing its reason, or a STALE baseline entry."""
     import ray_tpu
     from ray_tpu.devtools.lint import engine as lint_engine
 
@@ -610,17 +611,67 @@ def cmd_lint(args) -> None:
         # linting a foreign tree: only use a baseline it carries itself
         cand = lint_engine.default_baseline_path(package_dir)
         baseline = cand if os.path.exists(cand) else ""
+    only_rel = None
+    if args.changed and not args.update_baseline:
+        only_rel = _git_changed_rels(repo_root, package_dir)
+        if only_rel is None:
+            print("lint --changed: not a git tree (or git failed) — "
+                  "running the full scan")
+        elif not only_rel:
+            print("lint --changed: no changed files under the package "
+                  "— nothing to report (cross-file registries still "
+                  "validated)")
     res = lint_engine.run_lint(package_dir, baseline_path=baseline,
-                               evidence_dirs=evidence)
+                               evidence_dirs=evidence,
+                               only_rel=only_rel)
+    if args.update_baseline:
+        path = baseline or lint_engine.default_baseline_path(package_dir)
+        counts = lint_engine.update_baseline(path, res)
+        print(f"baseline regenerated at {path}: {counts['kept']} "
+              f"entr(ies) kept their reason, {counts['new']} NEW with "
+              f"an empty reason, {counts['dropped']} stale dropped")
+        if counts["new"]:
+            print("fill in every empty reason before committing — "
+                  "`ray-tpu lint` fails on reasonless entries")
+        return
     if args.json:
         print(json.dumps(res.to_json(), indent=2))
     else:
         print(lint_engine.render_text(res, verbose=args.verbose))
     if not res.ok:
         sys.exit(f"{len(res.findings)} new lint finding(s) + "
-                 f"{len(res.baseline_errors)} baseline issue(s) — fix "
+                 f"{len(res.baseline_errors)} baseline issue(s) + "
+                 f"{len(res.stale_baseline)} stale entr(ies) — fix "
                  f"them, suppress with `# rtpu: allow[<rule>]`, or "
                  f"baseline them WITH a reason")
+
+
+def _git_changed_rels(repo_root, package_dir):
+    """Package-relative paths of files git considers changed (worktree
+    + index vs HEAD, plus untracked).  None when git is unavailable."""
+    import subprocess
+    changed = set()
+    for argv in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(argv, cwd=repo_root,
+                                 capture_output=True, text=True,
+                                 timeout=15)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        changed.update(ln.strip() for ln in out.stdout.splitlines()
+                       if ln.strip())
+    prefix = os.path.relpath(package_dir, repo_root)
+    prefix = "" if prefix == "." else prefix.replace(os.sep, "/") + "/"
+    rels = set()
+    for path in changed:
+        p = path.replace(os.sep, "/")
+        if prefix and not p.startswith(prefix):
+            continue
+        rels.add(p[len(prefix):])
+    return rels
 
 
 def cmd_microbenchmark(args) -> None:
@@ -789,10 +840,13 @@ def main(argv=None) -> None:
     sp = sub.add_parser("lint",
                         help="static analysis of the package source: "
                              "loop-blocking, thread-race, chaos-site/"
-                             "WAL-op/RPC-surface drift (offline; "
-                             "non-zero exit on new findings)")
+                             "WAL-op/RPC-surface drift, RPC payload "
+                             "contracts, lock-order cycles, WAL replay "
+                             "determinism (offline; non-zero exit on "
+                             "new findings)")
     sp.add_argument("--json", action="store_true",
-                    help="machine-readable report")
+                    help="machine-readable report (includes per-rule "
+                         "timing)")
     sp.add_argument("--verbose", action="store_true",
                     help="also list baselined findings")
     sp.add_argument("--baseline", default=None,
@@ -803,6 +857,15 @@ def main(argv=None) -> None:
     sp.add_argument("--root",
                     help="lint this package dir instead of the "
                          "installed ray_tpu (tests, fixture trees)")
+    sp.add_argument("--changed", action="store_true",
+                    help="report only findings anchored in "
+                         "git-changed files (cross-file rules still "
+                         "scan the whole tree); pre-commit fast path")
+    sp.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the baseline in place: existing "
+                         "reasons kept, new findings added with an "
+                         "EMPTY reason that must be filled before "
+                         "commit, stale entries dropped")
     sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("microbenchmark", help="core op throughput")

@@ -547,11 +547,13 @@ class ContinuousBatchingEngine:
         if not force and now - self._last_metrics_push < iv:
             return
         self._last_metrics_push = now
+        # every key here is folded by nodelet._h_serve_metrics (the
+        # rpc-payload-contract rule flags unread wire bytes); prefix
+        # counters travel cumulative and the nodelet folds the delta
         payload = {"deployment": self.name, "replica": self._tag,
                    "occupied": len(self._slots),
                    "max_slots": self.ecfg.max_slots,
                    "waiting": len(self._pending) + len(self._prefilling),
-                   "live": self._live_locked(),
                    "prefix_hits": self.prefix_hits,
                    "prefix_tokens_reused": self.prefix_tokens_reused}
         try:

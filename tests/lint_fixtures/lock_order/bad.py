@@ -1,0 +1,36 @@
+"""Positive fixtures for lock-order: a two-lock ordering cycle (one
+side direct, the other through a self-call) and an await while holding
+a threading lock."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def path1(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def path2(self):
+        with self._b:
+            return self._helper()
+
+    def _helper(self):
+        with self._a:
+            return 2
+
+
+class AwaitUnder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def handler(self):
+        with self._lock:
+            await self._fetch()
+
+    async def _fetch(self):
+        return None

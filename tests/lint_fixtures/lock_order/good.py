@@ -1,0 +1,61 @@
+"""Negative fixtures for lock-order: consistent ordering, asyncio
+primitives under await, reentrant same-lock idioms, and an inline
+suppression."""
+
+import asyncio
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def p1(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def p2(self):
+        with self._a:
+            return self._helper()
+
+    def _helper(self):
+        # a -> b again: same global order, no cycle
+        with self._b:
+            return 2
+
+
+class AsyncOk:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+
+    async def handler(self):
+        async with self._alock:
+            await asyncio.sleep(0)     # asyncio lock: parking is fine
+
+
+class CondOk:
+    """Condition self-reacquire is the engine's wait idiom."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def waiter(self):
+        with self._cond:
+            self._cond.wait(0.01)
+            return self.peek()
+
+    def peek(self):
+        with self._cond:
+            return 1
+
+
+class Suppressed:
+    def __init__(self):
+        self._l = threading.Lock()
+
+    async def h(self):
+        with self._l:
+            # rtpu: allow[lock-order]
+            await asyncio.sleep(0)

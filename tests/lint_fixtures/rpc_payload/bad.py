@@ -1,0 +1,40 @@
+"""Positive fixtures for rpc-payload-contract: payload drift in both
+directions (sender key missing vs handler read, sender key never read),
+reply-shape drift, and a required read reached through payload
+forwarding."""
+
+
+class Server:
+    def __init__(self, server):
+        for name in ("fx_put", "fx_info", "fx_fwdbad"):
+            server.register(name, getattr(self, "_h_" + name))
+
+    async def _h_fx_put(self, conn, data):
+        oid = data["object_id"]        # required — sender sends "oid"
+        size = data.get("size", 0)
+        return oid is not None and size >= 0
+
+    async def _h_fx_info(self, conn, data):
+        if data.get("detail"):
+            return {"addr": "host", "port": 1}
+        return {"addr": "host"}
+
+    async def _h_fx_fwdbad(self, conn, data):
+        return self._consume(data)
+
+    def _consume(self, req):
+        return req["needed"]           # required through the forward
+
+
+class Client:
+    def put(self, conn):
+        # "oid" vs "object_id": KeyError on the server; "junk" is dead
+        # wire bytes
+        conn.call("fx_put", {"oid": b"x", "junk": 1})
+
+    def info(self, conn):
+        r = conn.call("fx_info", {})
+        return r["address"]            # handler returns "addr"
+
+    def fwdbad(self, conn):
+        conn.call("fx_fwdbad", {})     # omits "needed"

@@ -1,0 +1,40 @@
+"""Negative fixtures for rpc-payload-contract: agreeing contracts,
+guarded optional reads, tracked payload locals, forwarding, and an
+inline suppression."""
+
+
+class GoodServer:
+    def __init__(self, server):
+        for name in ("fx_ok", "fx_fwd", "fx_sup"):
+            server.register(name, getattr(self, "_h_" + name))
+
+    async def _h_fx_ok(self, conn, data):
+        ns = data.get("ns", "")
+        key = data["key"]
+        if "opt" in data:
+            ns = ns + str(data["opt"])     # membership-guarded read
+        return {"value": key, "ns": ns}
+
+    async def _h_fx_fwd(self, conn, data):
+        return self._do_fwd(data)
+
+    def _do_fwd(self, req):
+        return req["target"]
+
+    async def _h_fx_sup(self, conn, data):
+        return data["must"]
+
+
+class GoodClient:
+    def go(self, conn):
+        payload = {"key": b"k"}
+        payload["opt"] = 1                 # conditional add is "present"
+        r = conn.call("fx_ok", payload)
+        return r.get("value")
+
+    def fwd(self, conn):
+        conn.notify("fx_fwd", {"target": "t"})
+
+    def sup(self, conn):
+        # rtpu: allow[rpc-payload-contract]
+        conn.call("fx_sup", {})

@@ -1,0 +1,34 @@
+"""Positive fixtures for wal-replay-determinism: clock, uuid, env and
+set-iteration nondeterminism inside the _apply closure; deterministic
+helpers stay clean."""
+
+import os
+import time
+import uuid
+
+
+def _apply(state, rec):
+    op = rec[0]
+    if op == "stamp":
+        state["t"] = time.time()              # wall clock in replay
+    elif op == "merge":
+        _merge(state, rec)
+    elif op == "env":
+        state["home"] = os.environ["HOME"]    # environment read
+    elif op == "ok":
+        _ok(state, rec)
+
+
+def _merge(state, rec):
+    state["id"] = uuid.uuid4().hex            # transitive randomness
+    for k in set(rec[1]):                     # set order is per-process
+        state[k] = True
+
+
+def _ok(state, rec):
+    # deterministic: sorted set, dict iteration, record-derived values
+    for k in sorted(set(rec[1])):
+        state[k] = rec[2]
+    for k, v in dict(rec[3]).items():
+        state[k] = v
+    state["n"] = len(rec)

@@ -1,0 +1,166 @@
+"""PR-14: unit tests for the shared call-graph/closure builder
+(ray_tpu/devtools/lint/callgraph.py) every interprocedural lint rule
+rides on — method resolution through ``self.``, module-function edges,
+cycle termination, nested-scope exclusion, and closure caching."""
+
+import ast
+import textwrap
+
+from ray_tpu.devtools.lint.callgraph import build_module_graph
+
+
+def _graph(src):
+    return build_module_graph("mod.py", ast.parse(textwrap.dedent(src)))
+
+
+def test_method_resolution_through_self():
+    g = _graph("""
+        class C:
+            def a(self):
+                self.b()
+            def b(self):
+                self.c()
+                helper()
+            def c(self):
+                pass
+
+        def helper():
+            leaf()
+
+        def leaf():
+            pass
+    """)
+    a = g.resolve("C", "a")
+    names = {(f.cls, f.name) for f in g.closure(a)}
+    assert names == {("C", "a"), ("C", "b"), ("C", "c"),
+                     (None, "helper"), (None, "leaf")}
+
+
+def test_cycles_terminate_and_include_both_sides():
+    g = _graph("""
+        class C:
+            def ping(self):
+                self.pong()
+            def pong(self):
+                self.ping()
+    """)
+    closure = g.closure(g.resolve("C", "ping"))
+    assert {(f.cls, f.name) for f in closure} == {("C", "ping"),
+                                                  ("C", "pong")}
+    # direct recursion is equally fine
+    g2 = _graph("""
+        def f():
+            f()
+    """)
+    assert [fn.name for fn in g2.closure(g2.functions["f"])] == ["f"]
+
+
+def test_closure_is_cached():
+    g = _graph("""
+        class C:
+            def a(self):
+                self.b()
+            def b(self):
+                pass
+    """)
+    a = g.resolve("C", "a")
+    first = g.closure(a)
+    assert g.closure(a) is first          # same object: cache hit
+    # the cache is per-entry, not shared across entries
+    b = g.resolve("C", "b")
+    assert g.closure(b) is not first
+    assert [f.name for f in g.closure(b)] == ["b"]
+
+
+def test_nested_defs_and_lambdas_are_not_edges():
+    """A nested function is a callback that runs elsewhere — its calls
+    must not be attributed to the enclosing frame (they would poison
+    the lock-order and thread-race analyses)."""
+    g = _graph("""
+        class C:
+            def a(self):
+                def cb():
+                    self.hidden()
+                register(cb)
+                f = lambda: self.also_hidden()
+                return f
+            def hidden(self):
+                pass
+            def also_hidden(self):
+                pass
+    """)
+    a = g.resolve("C", "a")
+    assert a.self_calls == set()
+    assert {f.name for f in g.closure(a)} == {"a"}
+
+
+def test_comprehensions_do_count():
+    g = _graph("""
+        class C:
+            def a(self):
+                return [self.b(x) for x in range(3)]
+            def b(self, x):
+                return x
+    """)
+    assert {f.name for f in g.closure(g.resolve("C", "a"))} \
+        == {"a", "b"}
+
+
+def test_self_calls_stay_in_class_and_bare_calls_in_module():
+    """`self.x()` never resolves to a module function `x`, and a bare
+    `x()` never resolves to a method `x`."""
+    g = _graph("""
+        def x():
+            trap()
+
+        def trap():
+            pass
+
+        class C:
+            def a(self):
+                self.x()
+            def x(self):
+                pass
+
+        class D:
+            def a(self):
+                x()
+    """)
+    c = {(f.cls, f.name) for f in g.closure(g.resolve("C", "a"))}
+    assert c == {("C", "a"), ("C", "x")}
+    d = {(f.cls, f.name) for f in g.closure(g.resolve("D", "a"))}
+    assert d == {("D", "a"), (None, "x"), (None, "trap")}
+
+
+def test_method_closure_names_helper():
+    g = _graph("""
+        class Eng:
+            def run(self):
+                self.step()
+            def step(self):
+                self.emit()
+            def emit(self):
+                pass
+            def unrelated(self):
+                pass
+    """)
+    assert g.method_closure_names("Eng", ["run"]) \
+        == {"run", "step", "emit"}
+    # unresolvable entries still count as context (nested classes)
+    assert "ghost" in g.method_closure_names("Eng", ["ghost"])
+
+
+def test_async_and_qname_metadata():
+    g = _graph("""
+        class C:
+            async def h(self):
+                pass
+
+        def f():
+            pass
+    """)
+    h = g.resolve("C", "h")
+    assert h.is_async and h.qname == "C.h"
+    f = g.functions["f"]
+    assert not f.is_async and f.qname == "f"
+    assert {fn.qname for fn in g.iter_all()} == {"C.h", "f"}

@@ -604,3 +604,45 @@ def test_chaos_validate_knows_serve_autoscale_site():
     issues = validate_plan([{"site": "serve.autoscale",
                              "action": "kill_worker"}])
     assert issues
+
+
+def test_nodelet_folds_prefix_counter_deltas():
+    """PR-14 (found by the rpc-payload-contract rule): engines push
+    prefix-cache counters CUMULATIVELY in `serve_metrics`; the nodelet
+    must fold positive deltas into its own registry (worker registries
+    are never scraped) and treat a shrink as an engine restart."""
+    import asyncio
+
+    import ray_tpu.metrics as metrics
+    from ray_tpu.core import runtime_metrics as rtm
+    from ray_tpu.core.nodelet import Nodelet
+
+    def counter_value():
+        for line in metrics.prometheus_text().splitlines():
+            if line.startswith("ray_tpu_serve_prefix_hits_total") \
+                    and 'deployment="fold_dep"' in line:
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    n = object.__new__(Nodelet)
+    n._serve_counter_seen = {}
+    base = counter_value()
+
+    async def push(hits):
+        await Nodelet._h_serve_metrics(n, None, {
+            "deployment": "fold_dep", "replica": "r0",
+            "occupied": 1, "waiting": 0, "max_slots": 8,
+            "prefix_hits": hits, "prefix_tokens_reused": 0})
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(push(3))     # first sample: +3
+        assert counter_value() == base + 3
+        loop.run_until_complete(push(5))     # cumulative 5: +2
+        assert counter_value() == base + 5
+        loop.run_until_complete(push(5))     # no growth: +0
+        assert counter_value() == base + 5
+        loop.run_until_complete(push(2))     # shrank: restart, +2
+        assert counter_value() == base + 7
+    finally:
+        loop.close()
