@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
 	attr chaos drain failover spec elastic ha partition autoscale \
-	autoscale-bench lint lint-fast clean
+	autoscale-bench serve-breakdown profile lint lint-fast clean
 
 all: native cpp
 
@@ -138,6 +138,21 @@ autoscale:
 # merged into SERVE_BENCH.json's `autoscale` block.
 autoscale-bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py --autoscale-bench
+
+# Serve attribution table (PR-16 data-plane flight instruments):
+# streamed generation through the full path, reduced to per-phase
+# ms/token (queue / admission / prefill / decode_dispatch /
+# stream_drain) with the >=0.9 coverage bar; merges into
+# SERVE_BENCH.json's `breakdown` block.
+serve-breakdown:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve-breakdown
+
+# Dispatch-profiler / tracing suite: wrap-once shims, compile ledger,
+# MFU table, per-request TTFT/ITL propagation, breakdown coverage,
+# compile-storm + SLO-breach triggers.
+profile:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_device_profile.py \
+		tests/test_serve_breakdown.py -q
 
 clean:
 	rm -f ray_tpu/core/object_store/libtpustore.so dist/*.whl

@@ -195,8 +195,16 @@ def _run_measurement() -> dict:
     # the v5e (TPU_PROBE5_r04.jsonl b16_kk_bf16mu 0.3686 vs 0.3601)
     opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
     opt_state = opt.init(params)
-    step = jax.jit(make_train_step(cfg, opt, accum_steps=accum),
-                   donate_argnums=(0, 1))
+    # dispatch-profiler shim over the train step: the compile ledger
+    # (recompiles, compile seconds, distinct shapes) rides the bench
+    # detail.  sample_every is effectively off — only first-seen-shape
+    # dispatches sync, so the measured MFU loop is never perturbed.
+    from ray_tpu.util.device_profile import DispatchProfiler
+    prof = DispatchProfiler(sample_every=10 ** 9)
+    step = prof.wrap("train_step",
+                     jax.jit(make_train_step(cfg, opt,
+                                             accum_steps=accum),
+                             donate_argnums=(0, 1)))
     # lm_loss runs the model on the full token length — keep it equal to
     # seq so the flash kernel's 128-block alignment holds
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
@@ -218,10 +226,16 @@ def _run_measurement() -> dict:
         step, params, opt_state, batch_data, steps, tokens_per_step,
         flops_tok, peak)
     tok_s = steps * tokens_per_step / dt
+    prof.set_flops_per_token("train_step", flops_tok)
+    prof.note_tokens("train_step", (2 + steps) * tokens_per_step)
     detail = {"tokens_per_s": round(tok_s, 1),
               "step_ms": round(1000 * dt / steps, 2),
               "batch": batch, "accum": accum,
-              "backend": jax.default_backend()}
+              "backend": jax.default_backend(),
+              # compile ledger: recompiles past the warmup shape mean
+              # the step program is shape-unstable (every entry here is
+              # one XLA compile paid at dispatch time)
+              "train_profile": prof.snapshot(peak)}
     detail["model"] = "gpt2-medium(355M) m4_a16" if on_tpu else "tiny-smoke"
     result = {
         "metric": "gpt2_train_mfu",
@@ -1342,6 +1356,110 @@ def _serve_main() -> None:
         "detail": {"error": err}}))
 
 
+def _run_serve_breakdown() -> dict:
+    """`--serve-breakdown`: streamed generation through the FULL path
+    (HTTP proxy → router → replica continuous-batching engine) on the
+    CPU harness, then reduce the data-plane flight instruments to the
+    per-phase attribution table (`state.serve_breakdown`).  The product
+    is the COVERAGE number: attributed phase seconds (queue, admission,
+    prefill, decode_dispatch, stream_drain) over client-measured
+    seconds (TTFT + ITL sums) — >= 0.9 means the instruments explain at
+    least 90% of what streaming clients actually waited."""
+    import ray_tpu
+    from ray_tpu import serve, state
+
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+
+    @serve.deployment(max_concurrent_queries=8)
+    class Generator:
+        def __init__(self):
+            import jax.numpy as jnp
+
+            from ray_tpu.models import TransformerConfig
+            from ray_tpu.serve.decode_session import DecodeSessionCore
+            self.core = DecodeSessionCore(
+                TransformerConfig.tiny(max_seq_len=256,
+                                       dtype=jnp.float32), max_len=256)
+
+        def __call__(self, req):
+            return self.core.handle(req)
+
+    import requests
+    serve.run(Generator.bind(), name="generate")
+    addr = serve.api.http_address()
+    http = requests.Session()
+    prompt_len, max_new, n_sessions = 48, 24, 12
+
+    def stream_one(i: int) -> int:
+        prompt = [(13 * i + j) % 250 for j in range(prompt_len)]
+        n = 0
+        with http.post(f"{addr}/generate/stream",
+                       json={"prompt": prompt,
+                             "max_new_tokens": max_new,
+                             "tenant": f"bench-{i % 3}"},
+                       stream=True, timeout=180) as r:
+            r.raise_for_status()
+            for line in r.iter_lines():
+                if line.startswith(b"data: ") and b"token" in line:
+                    n += 1
+        return n
+
+    stream_one(0)        # warmup: compiles the chunk + decode programs
+    total = sum(stream_one(i) for i in range(1, n_sessions + 1))
+    time.sleep(1.5)      # final engine push (0.5s cadence) + fold
+    table = state.serve_breakdown()
+    serve.shutdown()
+    ray_tpu.shutdown()
+    dep = (table.get("deployments") or {}).get("generate") or {}
+    cov = dep.get("coverage") or 0.0
+    return {
+        "metric": "serve_breakdown_coverage",
+        "value": round(cov, 4),
+        "unit": "fraction_of_client_measured_serve_time",
+        "vs_baseline": round(cov / 0.9, 4),   # 0.9 is the floor
+        "detail": {"sessions": n_sessions,
+                   "tokens_streamed": total,
+                   "phases": table.get("phases"),
+                   "deployments": table.get("deployments"),
+                   "note": "coverage = attributed phase seconds / "
+                           "(TTFT sum + ITL sum) measured at the "
+                           "proxy; >= 0.9 is the acceptance bar"},
+    }
+
+
+def _serve_breakdown_main() -> None:
+    """`python bench.py --serve-breakdown` (`make serve-breakdown`):
+    run the attribution measurement inline on the CPU backend and
+    write the table into SERVE_BENCH.json's top-level ``breakdown``
+    block (the headline serve record stays the `--serve` run)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("RAY_TPU_DEVICE_BACKEND", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    try:
+        result = _run_serve_breakdown()
+    except Exception:
+        result = {"metric": "serve_breakdown_coverage", "value": 0.0,
+                  "unit": "fraction_of_client_measured_serve_time",
+                  "vs_baseline": 0.0,
+                  "detail": {"error": traceback.format_exc(limit=3)}}
+    print(json.dumps(result))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SERVE_BENCH.json")
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+    except Exception:
+        ledger = {"metric": "serve_gen_ttft_ms_p50", "detail": {}}
+    ledger["breakdown"] = {
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"), **result}
+    try:
+        with open(path, "w") as f:
+            json.dump(ledger, f, indent=1)
+    except OSError:
+        pass
+
+
 def _attr_main() -> None:
     """`python bench.py --attr`: scripted control-plane wave (task burst
     + actor burst), then append the per-RPC attribution table — where
@@ -1439,6 +1557,9 @@ def main() -> None:
         return
     if "--autoscale-bench" in sys.argv:
         _autoscale_bench_main()
+        return
+    if "--serve-breakdown" in sys.argv:
+        _serve_breakdown_main()
         return
     if "--attr" in sys.argv:
         _attr_main()

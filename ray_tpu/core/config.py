@@ -330,6 +330,38 @@ _d("flight_recorder_min_interval_s", float, 5.0,
    "Per-trigger rate limit between automatic captures (a flapping link "
    "must not turn the recorder into its own incident); manual "
    "`ray-tpu debug capture` bypasses it.")
+_d("device_profile_sample_every", int, 10,
+   "The dispatch profiler block-until-readys every Nth dispatch of each "
+   "jitted program to sample true device time (util/device_profile.py); "
+   "the other N-1 dispatches stay fully async so the hot loop stays "
+   "hot.  1 = sync every dispatch (tests).")
+_d("device_profile_peak_flops", float, 0.0,
+   "Per-device peak FLOP/s for the profiler's MFU denominator; 0 = "
+   "auto (TPU spec-sheet table by device kind, nominal fallback on "
+   "CPU — the CPU ratio is indicative, not a hardware truth).")
+_d("serve_compile_storm_threshold", int, 8,
+   "Recompiles per replica within serve_compile_storm_window_s that "
+   "fire the `compile_storm` flight-recorder trigger (a steady engine "
+   "compiles O(1) programs total; one-per-request shapes blow past "
+   "this in seconds).  0 disables storm detection.")
+_d("serve_compile_storm_window_s", float, 30.0,
+   "Sliding window of the compile-storm detector (nodelet-side, over "
+   "the folded compile-ledger deltas).")
+_d("serve_slo_ttft_p95_s", float, 0.0,
+   "p95 TTFT bound: the nodelet's SLO evaluator fires the `slo_breach` "
+   "flight-recorder trigger when the recent p95 of "
+   "ray_tpu_serve_ttft_seconds exceeds this.  0 disables (default: "
+   "tier-1 runs must not self-trigger).")
+_d("serve_slo_itl_p95_s", float, 0.0,
+   "p95 inter-token-latency bound for the `slo_breach` trigger "
+   "(evaluated like serve_slo_ttft_p95_s).  0 disables.")
+_d("serve_slo_min_samples", int, 20,
+   "Requests (TTFT) / tokens (ITL) the SLO evaluator needs in its "
+   "window before judging a p95 — a one-request blip is not a breach.")
+_d("serve_tenant_label_max", int, 16,
+   "Distinct tenant label values admitted into the serve TTFT/ITL "
+   "histograms per nodelet; overflow tenants are bucketed as 'other' "
+   "so an open tenant field cannot blow series cardinality.")
 _d("metrics_lint_max_tags", int, 4,
    "`ray-tpu metrics lint` cardinality bound: a registered metric may "
    "declare at most this many label keys.")

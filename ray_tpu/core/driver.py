@@ -1262,6 +1262,12 @@ class CoreClient(DeferredRefDecs):
     # ---------------------------------------------------------------- actors
     def create_actor(self, spec: TaskSpec, *, name: Optional[str],
                      detached: bool, get_if_exists: bool = False) -> bytes:
+        self._stamp_trace_ctx(spec)
+        # creation specs carry t_submit like any task: the constructor
+        # runs as a task on the placed worker, and downstream consumers
+        # (serve replica cold-start attribution) measure scheduling +
+        # spawn wait from this stamp
+        self._stamp_submit(spec)
         reply = self.controller.call("register_actor", {
             "spec": spec.to_wire(), "name": name,
             "max_restarts": spec.max_restarts, "detached": detached,
@@ -1522,6 +1528,15 @@ class CoreClient(DeferredRefDecs):
             try:
                 self.controller.call("finish_job",
                                      {"job_id": self.job_id.binary()}, timeout=5)
+            except Exception:
+                pass
+            # the flush-loop claim is process-global; a driver that
+            # reconnects (init -> shutdown -> init, i.e. every test
+            # after the first) must be able to claim it again or its
+            # spans never leave this process
+            try:
+                from ..util import tracing
+                tracing.release_flusher()
             except Exception:
                 pass
         for c in (self.controller, self.nodelet):

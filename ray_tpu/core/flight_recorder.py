@@ -43,7 +43,8 @@ from .config import GlobalConfig
 
 #: triggers the controller fires automatically (manual grabs use "manual")
 AUTO_TRIGGERS = ("node_suspect", "node_dead", "controller_failover",
-                 "drain_deadline", "elastic_repair", "oom_kill")
+                 "drain_deadline", "elastic_repair", "oom_kill",
+                 "compile_storm", "slo_breach")
 
 
 def recorder_dir() -> str:
@@ -54,8 +55,11 @@ def recorder_dir() -> str:
 def list_bundles(base: Optional[str] = None) -> List[str]:
     base = base or recorder_dir()
     try:
+        # dot-prefixed dirs are in-flight staging (bundles publish by
+        # rename, so a listed bundle always holds all five files)
         return sorted(p for p in os.listdir(base)
-                      if os.path.isdir(os.path.join(base, p)))
+                      if not p.startswith(".")
+                      and os.path.isdir(os.path.join(base, p)))
     except OSError:
         return []
 
@@ -174,10 +178,20 @@ class FlightRecorder:
     def _write(self, name: str, bundle: dict) -> str:
         base = recorder_dir()
         path = os.path.join(base, name)
-        os.makedirs(path, exist_ok=True)
+        # stage under a dot-prefixed name and publish by rename: a
+        # consumer that lists the directory mid-capture (tests polling
+        # for a bundle, `ray-tpu debug list`) must never see a bundle
+        # dir whose files are still being written
+        stage = os.path.join(base, "." + name)
+        os.makedirs(stage, exist_ok=True)
         for part in ("meta", "spans", "metrics", "events", "nodes"):
-            with open(os.path.join(path, f"{part}.json"), "w") as f:
+            with open(os.path.join(stage, f"{part}.json"), "w") as f:
                 json.dump(bundle[part], f, default=str)
+        try:
+            os.rename(stage, path)
+        except OSError:
+            shutil.rmtree(path, ignore_errors=True)
+            os.rename(stage, path)
         # prune oldest past the retention bound (names sort by time)
         keep = max(1, GlobalConfig.flight_recorder_keep)
         existing = list_bundles(base)

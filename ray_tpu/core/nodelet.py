@@ -21,6 +21,7 @@ import subprocess
 import sys
 import time
 import traceback
+from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
 from . import rpc, runtime_metrics as rtm, spill, worker_zygote
@@ -145,7 +146,19 @@ class Nodelet:
         self._drain_deadline: Optional[float] = None
         #: last cumulative serve counter value per (deployment,
         #: replica, key) — `_h_serve_metrics` folds deltas from them
-        self._serve_counter_seen: Dict[tuple, int] = {}
+        #: (float-valued: device/phase seconds travel cumulative too)
+        self._serve_counter_seen: Dict[tuple, float] = {}
+        #: recent recompile events per (deployment, replica) — (mono
+        #: ts, n) pairs the compile-storm detector sums over its
+        #: sliding window
+        self._compile_events: Dict[tuple, deque] = {}
+        #: recent TTFT/ITL samples per (deployment, kind) for the p95
+        #: SLO evaluator — raw values, because the history ring folds
+        #: histograms to _count/_sum which cannot yield a quantile
+        self._slo_samples: Dict[tuple, deque] = {}
+        #: tenant labels admitted into serve latency histograms
+        #: (cardinality cap serve_tenant_label_max; overflow -> other)
+        self._serve_tenants: Set[str] = set()
         self._drain_finished = False   # heartbeats stop; never resurrect
         self._evac_rr = 0              # round-robin cursor over peers
         # Peer-reachability gossip: a few rotating peers are probed per
@@ -1914,6 +1927,44 @@ class Nodelet:
                 self._serve_counter_seen[seen] = cur
                 if delta > 0:
                     metric.inc(delta, {"deployment": dep})
+            # ---- data-plane flight instruments (PR-16) ----
+            shapes = data.get("distinct_program_shapes")
+            if shapes is not None:
+                rtm.SERVE_PROGRAM_SHAPES.set(float(shapes), tags)
+            tok = data.get("tokens")
+            if tok is not None:
+                tok = int(tok)
+                seen = (dep, str(rep), "tokens")
+                prev = self._serve_counter_seen.get(seen, 0)
+                delta = tok - prev if tok >= prev else tok
+                self._serve_counter_seen[seen] = tok
+                if delta > 0:
+                    rtm.SERVE_TOKENS.inc(delta, {"deployment": dep})
+            self._fold_phase_totals(dep, str(rep),
+                                    data.get("phase_totals"))
+            compiled = self._fold_device_profile(
+                dep, str(rep), data.get("device_profile"))
+            if compiled:
+                await self._note_compiles(dep, str(rep), compiled)
+        # per-request latency samples (HTTP proxy pushes; no replica
+        # key) — folded into the tenant-labeled SLO histograms, then
+        # the p95 evaluator runs: latency only ever arrives HERE, so
+        # evaluating at fold time needs no loop and is free when idle
+        ttft = data.get("ttft_s")
+        itl = data.get("itl_s")
+        if ttft is not None or itl:
+            tenant = self._tenant_label(str(data.get("tenant")
+                                            or "anon"))
+            htags = {"deployment": dep, "tenant": tenant}
+            if ttft is not None:
+                rtm.SERVE_TTFT.observe(float(ttft), htags)
+                self._slo_note(dep, "ttft", (float(ttft),))
+            if itl:
+                vals = tuple(float(v) for v in itl)
+                for v in vals:
+                    rtm.SERVE_ITL.observe(v, htags)
+                self._slo_note(dep, "itl", vals)
+            await self._maybe_slo_eval(dep)
         if "replicas" in data:
             rtm.SERVE_DEPLOYMENT_REPLICAS.set(
                 float(data["replicas"]), {"deployment": dep})
@@ -1923,6 +1974,152 @@ class Nodelet:
                 rtm.SERVE_AUTOSCALE_DECISIONS.inc(
                     int(n), {"deployment": dep, "direction": direction})
         return True
+
+    def _tenant_label(self, tenant: str) -> str:
+        """Cardinality gate on the serve-histogram tenant label: the
+        first `serve_tenant_label_max` distinct tenants keep their
+        name; everyone after that is bucketed to ``other`` so a tenant
+        enumeration can never blow up the registry series count."""
+        if tenant in self._serve_tenants:
+            return tenant
+        cap = int(getattr(GlobalConfig, "serve_tenant_label_max", 16))
+        if len(self._serve_tenants) < max(1, cap):
+            self._serve_tenants.add(tenant)
+            return tenant
+        return "other"
+
+    def _fold_phase_totals(self, dep: str, rep: str, phases) -> None:
+        """Delta-fold an engine's cumulative phase seconds (queue /
+        admission / prefill / decode_dispatch) into the per-deployment
+        phase counter — the serve_breakdown table's source series."""
+        if not phases:
+            return
+        for phase, cur in phases.items():
+            try:
+                cur = float(cur)
+            except (TypeError, ValueError):
+                continue
+            seen = (dep, rep, f"phase:{phase}")
+            prev = self._serve_counter_seen.get(seen, 0)
+            delta = cur - prev if cur >= prev else cur
+            self._serve_counter_seen[seen] = cur
+            if delta > 0:
+                rtm.SERVE_PHASE_SECONDS.inc(
+                    delta, {"deployment": dep, "phase": str(phase)})
+
+    def _fold_device_profile(self, dep: str, rep: str, rows) -> int:
+        """Delta-fold a replica's cumulative dispatch-profiler snapshot
+        (see util/device_profile.py) into the per-program device
+        counters and the MFU gauge.  Returns the summed recompile delta
+        — the compile-storm detector's input."""
+        if not rows:
+            return 0
+        compiled = 0
+        for row in rows:
+            if not isinstance(row, dict):
+                continue
+            prog = str(row.get("program") or "?")
+            ptags = {"program": prog, "deployment": dep}
+            for key, metric, cast in (
+                    ("dispatches", rtm.DEVICE_DISPATCHES, int),
+                    ("device_s", rtm.DEVICE_SECONDS, float),
+                    ("compile_s", rtm.DEVICE_COMPILE_SECONDS, float),
+                    ("compiles", rtm.DEVICE_COMPILES, int)):
+                cur = row.get(key)
+                if cur is None:
+                    continue
+                cur = cast(cur)
+                seen = (dep, rep, f"dp:{prog}:{key}")
+                prev = self._serve_counter_seen.get(seen, 0)
+                delta = cur - prev if cur >= prev else cur
+                self._serve_counter_seen[seen] = cur
+                if delta > 0:
+                    metric.inc(delta, ptags)
+                    if key == "compiles":
+                        compiled += int(delta)
+            mfu = row.get("mfu")
+            if mfu is not None:
+                rtm.MFU_RATIO.set(float(mfu), ptags)
+        return compiled
+
+    async def _note_compiles(self, dep: str, rep: str, n: int) -> None:
+        """Compile-storm detector: recompiles per (deployment, replica)
+        summed over a sliding window; past the threshold the controller
+        captures a flight bundle (trigger ``compile_storm`` — rate-
+        limited there like every auto trigger)."""
+        thresh = int(getattr(GlobalConfig,
+                             "serve_compile_storm_threshold", 8))
+        if thresh <= 0:
+            return
+        win = float(getattr(GlobalConfig,
+                            "serve_compile_storm_window_s", 30.0))
+        now = time.monotonic()
+        dq = self._compile_events.setdefault((dep, rep), deque())
+        dq.append((now, int(n)))
+        while dq and now - dq[0][0] > win:
+            dq.popleft()
+        total = sum(c for _, c in dq)
+        if total < thresh:
+            return
+        dq.clear()    # one alert per accumulation window
+        try:
+            await self.controller.notify("debug_capture", {
+                "trigger": "compile_storm",
+                "reason": f"{total} recompiles in {win:.0f}s on "
+                          f"{dep}/{rep}",
+                "meta": {"deployment": dep, "replica": rep,
+                         "compiles": total, "window_s": win}})
+        except Exception:
+            pass   # controller reconnecting; next window retries
+
+    def _slo_note(self, dep: str, kind: str, vals) -> None:
+        dq = self._slo_samples.setdefault((dep, kind),
+                                          deque(maxlen=512))
+        dq.extend(vals)
+
+    async def _maybe_slo_eval(self, dep: str) -> None:
+        """p95 TTFT/ITL SLO check over the retained raw-sample windows;
+        disabled until `serve_slo_{ttft,itl}_p95_s` is set.  A breach
+        fires the ``slo_breach`` flight-recorder trigger with the
+        measured quantile in the bundle meta."""
+        bounds = (
+            ("ttft", float(getattr(GlobalConfig,
+                                   "serve_slo_ttft_p95_s", 0.0))),
+            ("itl", float(getattr(GlobalConfig,
+                                  "serve_slo_itl_p95_s", 0.0))))
+        if all(b <= 0 for _, b in bounds):
+            return
+        if fi.ACTIVE is not None:
+            act = fi.ACTIVE.point("serve.slo_eval", dep)
+            if act is not None:
+                if act["action"] in ("delay", "latency"):
+                    await asyncio.sleep(max(0.0, act["delay_s"]))
+                else:
+                    raise RuntimeError(
+                        f"chaos: injected slo_eval failure for {dep}")
+        min_n = max(1, int(getattr(GlobalConfig,
+                                   "serve_slo_min_samples", 20)))
+        for kind, bound in bounds:
+            if bound <= 0:
+                continue
+            dq = self._slo_samples.get((dep, kind))
+            if dq is None or len(dq) < min_n:
+                continue
+            vals = sorted(dq)
+            p95 = vals[min(len(vals) - 1, int(0.95 * len(vals)))]
+            if p95 <= bound:
+                continue
+            dq.clear()   # re-arm: breach needs min_n fresh samples
+            try:
+                await self.controller.notify("debug_capture", {
+                    "trigger": "slo_breach",
+                    "reason": f"{dep} p95 {kind} {p95 * 1e3:.1f}ms > "
+                              f"bound {bound * 1e3:.1f}ms",
+                    "meta": {"deployment": dep, "kind": kind,
+                             "p95_s": round(p95, 6), "bound_s": bound,
+                             "samples": len(vals)}})
+            except Exception:
+                pass
 
     async def _h_metrics_history(self, conn, data):
         """This nodelet's bounded metrics-history ring (fixed-interval

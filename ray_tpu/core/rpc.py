@@ -162,9 +162,17 @@ class Connection:
         return self._closed
 
     async def _send(self, frame: bytes):
-        async with self._send_lock:
-            self.writer.write(frame)
-            await self.writer.drain()
+        # A peer that dies mid-send surfaces as a raw OS error from the
+        # transport (ConnectionResetError/BrokenPipeError).  Callers all
+        # handle RpcError — an untranslated escape here kills whole
+        # supervision loops (a chaos-crashed worker took the driver's
+        # _lease_loop down with it, losing the task retry).
+        try:
+            async with self._send_lock:
+                self.writer.write(frame)
+                await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise ConnectionLost(f"send failed: {e}") from e
 
     async def call(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:
         if self._closed:

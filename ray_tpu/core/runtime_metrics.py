@@ -94,7 +94,10 @@ TRAIN_LOST_STEPS = m.Counter(
 SERVE_TOKENS = m.Counter(
     "ray_tpu_serve_tokens_total",
     "Tokens decoded by replica continuous-batching engines "
-    "(decode_session.py); registered in the replica's process",
+    "(decode_session.py); incremented in the replica's process AND "
+    "delta-folded into the nodelet registry from engine "
+    "`serve_metrics` pushes, so the cluster scrape carries it (the "
+    "serve_breakdown table's per-token denominator)",
     ("deployment",))
 SERVE_PREFILL_CHUNKS = m.Counter(
     "ray_tpu_serve_prefill_chunks_total",
@@ -149,6 +152,39 @@ SERVE_SPEC_ACCEPTED = m.Counter(
     "Draft-model tokens the target's batched verify step accepted "
     "(exact greedy match; the bonus token per iteration is not counted)",
     ("deployment",))
+# -- data-plane dispatch profiling (util/device_profile.py snapshots
+# ride the replica's `serve_metrics` push; the nodelet folds cumulative
+# deltas here so compile ledgers and MFU reach cluster scrape) ---------
+DEVICE_DISPATCHES = m.Counter(
+    "ray_tpu_device_dispatches_total",
+    "Jitted-program dispatches by the data plane (decode step, prefill "
+    "chunk, draft/verify, cache insert/gather), folded from replica "
+    "dispatch-profiler snapshots", ("program", "deployment"))
+DEVICE_SECONDS = m.Counter(
+    "ray_tpu_device_seconds_total",
+    "Estimated device seconds per jitted program (block-until-ready "
+    "time sampled every Nth dispatch, extrapolated over all "
+    "dispatches) — the MFU denominator and the decode roofline",
+    ("program", "deployment"))
+DEVICE_COMPILE_SECONDS = m.Counter(
+    "ray_tpu_device_compile_seconds_total",
+    "Wall seconds spent in first-seen-shape dispatches (XLA trace + "
+    "compile) per jitted program — the compile ledger's cost column",
+    ("program", "deployment"))
+DEVICE_COMPILES = m.Counter(
+    "ray_tpu_device_compiles_total",
+    "Distinct argument shapes dispatched per jitted program (each one "
+    "compiled a new executable); growth proportional to traffic "
+    "instead of O(1) is a compile storm and fires the `compile_storm` "
+    "flight-recorder trigger", ("program", "deployment"))
+SERVE_PHASE_SECONDS = m.Counter(
+    "ray_tpu_serve_phase_seconds_total",
+    "Serve data-plane time by named phase (cold_start: lazy replica "
+    "construction; queue: enqueue to first prefill chunk; admission: "
+    "first token to decode slot; prefill: chunk program wall; "
+    "decode_dispatch: decode/draft/verify/insert program wall) — the "
+    "serve_breakdown attribution table's source",
+    ("deployment", "phase"))
 CONTROLLER_FAILOVERS = m.Counter(
     "ray_tpu_controller_failovers_total",
     "Controller leadership changes by outcome (promoted: a hot standby "
@@ -271,6 +307,22 @@ SCHED_QUEUE_DEPTH_AT_GRANT = m.Histogram(
     "batching must drain",
     (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 1024.0),
     ("node",))
+SERVE_TTFT = m.Histogram(
+    "ray_tpu_serve_ttft_seconds",
+    "Time to first token of one streamed decode request, measured at "
+    "the HTTP proxy (request accepted to first token ready) and pushed "
+    "to the nodelet per request; tenant from the request's `tenant` "
+    "field / x-tenant header, default 'anon', cardinality-capped with "
+    "overflow bucketed to 'other' — the per-tenant SLO series",
+    (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+     30.0), ("deployment", "tenant"))
+SERVE_ITL = m.Histogram(
+    "ray_tpu_serve_itl_seconds",
+    "Inter-token latency of streamed decode requests (gap between "
+    "consecutive SSE token emissions at the proxy), nodelet-folded "
+    "like ray_tpu_serve_ttft_seconds and labeled the same way",
+    (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+     1.0, 5.0), ("deployment", "tenant"))
 SCHED_WAVE_BATCH = m.Histogram(
     "ray_tpu_scheduler_wave_batch_size",
     "Lease waiters woken per scheduler wave (cohort size when freed "
@@ -342,6 +394,19 @@ WAL_REPLICATION_LAG = m.Gauge(
     "WAL records the hot-standby controller is behind the leader "
     "(0 with a healthy sync stream; grows while the replication stream "
     "is severed or the leader runs in degraded async mode)", ())
+MFU_RATIO = m.Gauge(
+    "ray_tpu_mfu_ratio",
+    "Model-FLOPs-utilization estimate per jitted data-plane program "
+    "(analytic FLOPs/token × tokens ÷ sampled device seconds ÷ peak "
+    "FLOP/s), computed replica-side by the dispatch profiler and "
+    "nodelet-folded; on CPU harnesses the peak is nominal, so treat "
+    "the ratio as relative, not absolute", ("program", "deployment"))
+SERVE_PROGRAM_SHAPES = m.Gauge(
+    "ray_tpu_serve_program_shapes",
+    "Distinct compiled program shapes a serve decode engine has "
+    "dispatched (engine_stats program_shapes, finally at cluster "
+    "scrape) — O(1) when healthy; growth with traffic is the "
+    "compile-storm signature", ("deployment", "replica"))
 SERVE_SPEC_ACCEPTANCE = m.Gauge(
     "ray_tpu_serve_spec_acceptance_ratio",
     "Cumulative speculative-decoding acceptance ratio (accepted / "

@@ -687,8 +687,20 @@ class WorkerRuntime:
             if spec.runtime_env:
                 from . import runtime_env as _renv
                 _renv.apply(spec.runtime_env)  # actor keeps env for life
+            def construct():
+                # the constructor runs AS the creation task: expose its
+                # spec so __init__ bodies can read runtime context
+                # (trace id, submit stamp — e.g. serve replicas
+                # attribute their cold start from t_submit).  Executor
+                # threads don't inherit the loop's contextvars, so set
+                # and reset around the call.
+                token = _current_spec.set(spec)
+                try:
+                    return cls(*args, **kwargs)
+                finally:
+                    _current_spec.reset(token)
             self.actor_instance = await self._loop.run_in_executor(
-                self.executor, lambda: cls(*args, **kwargs))
+                self.executor, construct)
             self.actor_id = spec.actor_creation_id.binary()
             self.actor_max_concurrency = max(1, spec.max_concurrency)
             if self.actor_max_concurrency > 1:

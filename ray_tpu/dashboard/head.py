@@ -136,6 +136,10 @@ class DashboardHead:
             from .. import state
             return state.rpc_attribution()
 
+        def serve_breakdown(_):
+            from .. import state
+            return state.serve_breakdown()
+
         def node_stats(request):
             from .. import state
             return state.node_stats(request.match_info.get("node_id"))
@@ -243,6 +247,8 @@ class DashboardHead:
                            blocking(metrics_history))
         app.router.add_get("/api/rpc_attribution",
                            blocking(rpc_attribution))
+        app.router.add_get("/api/serve/breakdown",
+                           blocking(serve_breakdown))
         app.router.add_get("/api/agents", blocking(agents))
         app.router.add_get("/api/agent_stats", blocking(agent_stats))
         app.router.add_get("/api/logs", blocking(logs_list))

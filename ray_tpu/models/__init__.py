@@ -32,6 +32,8 @@ from .transformer import (  # noqa: F401
     make_train_step,
     count_params,
     flops_per_token,
+    decode_flops_per_token,
+    engine_flops_table,
 )
 from .vit import (  # noqa: F401
     ViTConfig,

@@ -173,6 +173,43 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
     return 6 * n_matmul + attn
 
 
+def decode_flops_per_token(cfg: TransformerConfig,
+                           context_len: int) -> float:
+    """Inference forward FLOPs for ONE token at cache position
+    ``context_len``: 2*N_active_matmul for the weight matmuls (forward
+    only — no backward factor) plus the attention reads against the KV
+    cache (qk^T and probs·v, 2 FLOPs per MAC each, over every cached
+    position)."""
+    n_matmul = cfg.n_layers * _per_layer_matmul_params(cfg, active=True) \
+        + cfg.vocab_size * cfg.d_model   # unembed logits matmul
+    attn = 4 * cfg.n_layers * cfg.n_heads * cfg.head_dim * context_len
+    return 2 * n_matmul + attn
+
+
+def engine_flops_table(cfg: TransformerConfig, max_len: int,
+                       draft_cfg: "TransformerConfig" = None) -> dict:
+    """Analytic FLOPs-per-token for each of the serve engine's jitted
+    programs (the dispatch profiler's MFU numerators), evaluated at the
+    mid-stream cache position ``max_len // 2`` — the average context a
+    token attends over a full stream.  Pure-copy programs (cache
+    insert/gather) are 0: they move bytes, not FLOPs, and the profiler
+    reports no MFU for them."""
+    mid = max(1, max_len // 2)
+    target = decode_flops_per_token(cfg, mid)
+    table = {
+        "decode_step": target,
+        "prefill_chunk": target,   # per prompt token, same forward
+        "verify": target,          # k+1-wide target forward per token
+        "cache_insert": 0.0,
+        "prefix_gather": 0.0,
+    }
+    if draft_cfg is not None:
+        draft = decode_flops_per_token(draft_cfg, mid)
+        table["draft_propose"] = draft
+        table["draft_prefill_chunk"] = draft
+    return table
+
+
 # ---------------------------------------------------------------------------
 # init
 # ---------------------------------------------------------------------------

@@ -413,9 +413,12 @@ def _fmt_rate(v) -> str:
     return f"{v:,.1f}" if isinstance(v, float) else str(v)
 
 
-def render_top(nodes, history, attr, top_k: int = 10) -> str:
+def render_top(nodes, history, attr, top_k: int = 10,
+               breakdown=None) -> str:
     """One frame of the `ray-tpu top` terminal view (pure function of
-    the three state-API payloads, so it is unit-testable offline)."""
+    the state-API payloads, so it is unit-testable offline).
+    ``breakdown`` is the optional `state.serve_breakdown()` table —
+    per-deployment ms/token attribution with coverage and MFU."""
     from ray_tpu.core import metrics_history as mh
     lines = []
     alive = sum(1 for n in nodes if n.get("alive"))
@@ -483,6 +486,28 @@ def render_top(nodes, history, attr, top_k: int = 10) -> str:
                 f"        {dep:<18} "
                 f"{('%d' % reps) if reps is not None else '-':>8} "
                 f"{'%g/%g' % (occ, slots):>10} {wait:>8g}")
+    # serve data-plane breakdown: where a served ms/token goes (engine
+    # phase counters + proxy latency histograms, state.serve_breakdown)
+    if breakdown and breakdown.get("deployments"):
+        phases = list(breakdown.get("phases") or ())
+        lines.append("")
+        lines.append("SERVE BREAKDOWN — ms/token by phase "
+                     "(COV = attributed / client-measured time)")
+        hdr = " ".join(f"{p[:9].upper():>9}" for p in phases)
+        lines.append(f"{'DEPLOYMENT':<18} {'TOKENS':>8} {hdr} "
+                     f"{'COV':>5} {'MFU':>6}")
+        for dep, row in sorted(breakdown["deployments"].items()):
+            mpt = row.get("ms_per_token") or {}
+            cells = " ".join(
+                f"{('%.2f' % mpt[p]) if mpt.get(p) is not None else '-':>9}"
+                for p in phases)
+            cov = row.get("coverage")
+            mfu = row.get("mfu") or {}
+            peak_mfu = max(mfu.values()) if mfu else None
+            lines.append(
+                f"{dep:<18} {row.get('tokens', 0):>8} {cells} "
+                f"{('%.0f%%' % (cov * 100)) if cov is not None else '-':>5}"
+                f" {('%.3f' % peak_mfu) if peak_mfu is not None else '-':>6}")
     ctl = attr.get("controller") or {}
     ops = list(ctl.get("ops") or [])[:top_k]
     lines.append("")
@@ -520,9 +545,14 @@ def cmd_top(args) -> None:
     try:
         n = 0
         while True:
+            try:
+                bd = state.serve_breakdown()
+            except Exception:
+                bd = None   # no serve plane up: panel just stays off
             frame = render_top(state.list_nodes(),
                                state.metrics_history(last=60),
-                               state.rpc_attribution())
+                               state.rpc_attribution(),
+                               breakdown=bd)
             if not args.once:
                 print("\033[2J\033[H", end="")
             print(frame, flush=True)

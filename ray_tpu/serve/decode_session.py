@@ -98,10 +98,17 @@ class _EngineSession:
     __slots__ = ("sid", "slot", "queue", "last_tok", "pos", "done",
                  "error", "ended", "seq", "last_poll",
                  "prompt", "poff", "pcache", "dcache", "plogits",
-                 "ready", "shed", "ptoks")
+                 "ready", "shed", "ptoks", "rid", "t_enq", "t_pf",
+                 "t_ready")
 
-    def __init__(self, sid: str, prompt: Any, seq_base: int = 0):
+    def __init__(self, sid: str, prompt: Any, seq_base: int = 0,
+                 rid: str = ""):
         self.sid = sid
+        # ---- per-request phase marks (monotonic clock) ----
+        self.rid = rid                # proxy-minted request id ("" = none)
+        self.t_enq = time.monotonic()  # enqueued for chunked admission
+        self.t_pf: Optional[float] = None     # first prefill chunk ran
+        self.t_ready: Optional[float] = None  # first token produced
         # host copy of the prompt tokens: the shared-prefix index key
         # (inserted when this session takes a slot, matched by later
         # admissions)
@@ -163,8 +170,17 @@ class ContinuousBatchingEngine:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return jnp.where(active, nxt, tok), cache
 
-        self._step = jax.jit(fused_step, static_argnames=("cfg",))
-        self._insert = jax.jit(cache_insert_slot)
+        # ---- dispatch profiler (util/device_profile.py) ----
+        # every jitted program below goes through a wrap-once timing
+        # shim: dispatch counts, sampled device time, and the compile
+        # ledger (first-seen argument shapes) per program.  Snapshots
+        # ride _maybe_push_metrics to the nodelet fold.
+        from ..util.device_profile import DispatchProfiler
+        self._prof = DispatchProfiler()
+        self._step = self._prof.wrap(
+            "decode_step", jax.jit(fused_step, static_argnames=("cfg",)))
+        self._insert = self._prof.wrap("cache_insert",
+                                       jax.jit(cache_insert_slot))
         # ---- shared-prefix KV reuse ----
         # radix trie over live slots' prompts (serve/prefix_cache.py):
         # admission copies the longest shared prefix out of a donor
@@ -176,14 +192,17 @@ class ContinuousBatchingEngine:
             from ..models import cache_gather_slot
             from .prefix_cache import PrefixIndex
             self._prefix = PrefixIndex()
-            self._gather = jax.jit(cache_gather_slot)
+            self._gather = self._prof.wrap("prefix_gather",
+                                           jax.jit(cache_gather_slot))
         self.prefix_hits = 0          # admissions seeded from a donor
         self.prefix_tokens_reused = 0  # prefill tokens skipped
         self._last_metrics_push = 0.0
         # the chunk program is the MODULE-LEVEL shared jit: admission
         # here, failover resume (models.resume_prefill), and the legacy
-        # prefill_chunked path all hit one compile cache
-        self._chunk = prefill_chunk_jit
+        # prefill_chunked path all hit one compile cache.  The profiler
+        # wrap is idempotent, so an engine restart re-wrapping the same
+        # shared jit never stacks a second timer over it.
+        self._chunk = self._prof.wrap("prefill_chunk", prefill_chunk_jit)
         # ---- speculative decoding ----
         self._spec = False
         self._draft_cfg = None
@@ -205,10 +224,12 @@ class ContinuousBatchingEngine:
                     f"vocab {cfg.vocab_size}: proposals must be target "
                     f"token ids")
             self._spec = True
-            self._draft = jax.jit(draft_propose_slots,
-                                  static_argnames=("cfg", "k"))
-            self._verify = jax.jit(verify_step_slots,
-                                   static_argnames=("cfg",))
+            self._draft = self._prof.wrap(
+                "draft_propose", jax.jit(draft_propose_slots,
+                                         static_argnames=("cfg", "k")))
+            self._verify = self._prof.wrap(
+                "verify", jax.jit(verify_step_slots,
+                                  static_argnames=("cfg",)))
         self._spec_k = max(2, int(engine_cfg.spec_k))
         self._spec_disabled = False
         self._spec_fail_streak = 0
@@ -232,12 +253,24 @@ class ContinuousBatchingEngine:
         self.tokens = 0
         self.reaped = 0          # sessions evicted by the idle reaper
         self.prefill_chunks = 0  # chunk programs run for admissions
+        # analytic FLOPs/token per program -> the profiler's MFU
+        # numerators (models.engine_flops_table; pure-copy programs 0)
+        from ..models import engine_flops_table
+        for prog, f in engine_flops_table(
+                cfg, max_len, draft_cfg=self._draft_cfg).items():
+            self._prof.set_flops_per_token(prog, f)
+        # engine-side phase accumulators of the serve_breakdown table
+        # (queue: enqueue -> first prefill chunk; admission: first
+        # token -> decode slot); prefill/decode_dispatch walls come
+        # from the profiler at snapshot time
+        self.phase_s = {"queue": 0.0, "admission": 0.0}
 
     # ------------------------------------------------------------ client ops
 
     def start(self, prompt, max_sessions: int, seq_base: int = 0,
               teacher_forced: bool = False,
-              ptoks: Optional[tuple] = None) -> Dict[str, Any]:
+              ptoks: Optional[tuple] = None,
+              rid: str = "") -> Dict[str, Any]:
         """Enqueue one batch-1 prompt for chunked admission and block
         until the ENGINE THREAD has prefilled it — `[1, chunk]` blocks
         (tail in `[1, 1]` steps) interleaved between shared decode
@@ -277,7 +310,8 @@ class ContinuousBatchingEngine:
                 raise ReplicaUnavailableError(self.name)
             sid = f"{self._tag}:{self._next_sid}"
             self._next_sid += 1
-            sess = _EngineSession(sid, prompt, seq_base=seq_base)
+            sess = _EngineSession(sid, prompt, seq_base=seq_base,
+                                  rid=rid)
             sess.ptoks = ptoks or ()
             # LRU bound on ABANDONED sessions: evict the oldest
             # slot-less finished session (ended clients pop themselves)
@@ -418,7 +452,28 @@ class ContinuousBatchingEngine:
                              "proposed": prop, "accepted": acc,
                              "acceptance":
                                  round(acc / prop, 4) if prop else None,
-                             "fallbacks": self.spec_fallbacks}}
+                             "fallbacks": self.spec_fallbacks},
+                    # data-plane flight instruments: per-program
+                    # dispatch/compile/MFU ledger + phase attribution
+                    "device_profile": self._prof.snapshot(),
+                    "phase_totals": self.phase_totals()}
+
+    def phase_totals(self) -> Dict[str, float]:
+        """Cumulative serve-phase seconds — the serve_breakdown
+        attribution sources.  queue/admission come from per-session
+        marks; prefill/decode_dispatch are the profiler's per-program
+        dispatch walls (engine-thread occupancy, which is what a token
+        actually waits on)."""
+        wall = self._prof.wall_seconds()
+        prefill = sum(wall.get(p, 0.0)
+                      for p in ("prefill_chunk", "prefix_gather"))
+        decode = sum(wall.get(p, 0.0)
+                     for p in ("decode_step", "draft_propose", "verify",
+                               "cache_insert"))
+        return {"queue": round(self.phase_s["queue"], 6),
+                "admission": round(self.phase_s["admission"], 6),
+                "prefill": round(prefill, 6),
+                "decode_dispatch": round(decode, 6)}
 
     def _live_locked(self) -> int:
         """Sessions a client may still come back for (not `end`ed):
@@ -507,6 +562,9 @@ class ContinuousBatchingEngine:
             slot = self._free.pop()
             sess.slot = slot
             self._slots[slot] = sess
+            if sess.t_ready is not None:   # admission phase: first
+                self.phase_s["admission"] += \
+                    time.monotonic() - sess.t_ready  # token -> slot
             if self._prefix is not None:
                 # slot reclaim IS the eviction point: the insert below
                 # replaces whatever prefix the slot advertised before
@@ -555,7 +613,16 @@ class ContinuousBatchingEngine:
                    "max_slots": self.ecfg.max_slots,
                    "waiting": len(self._pending) + len(self._prefilling),
                    "prefix_hits": self.prefix_hits,
-                   "prefix_tokens_reused": self.prefix_tokens_reused}
+                   "prefix_tokens_reused": self.prefix_tokens_reused,
+                   # data-plane flight instruments (all cumulative;
+                   # nodelet delta-folds): per-program dispatch/compile
+                   # ledger + MFU, tokens generated, phase attribution,
+                   # and the distinct-shape count the compile-storm
+                   # detector watches
+                   "tokens": self.tokens,
+                   "distinct_program_shapes": len(self._shapes),
+                   "device_profile": self._prof.snapshot(),
+                   "phase_totals": self.phase_totals()}
         try:
             import asyncio
 
@@ -630,6 +697,9 @@ class ContinuousBatchingEngine:
         off = sess.poff
         take = chunk if n - off >= chunk else 1
         toks = sess.prompt[:, off:off + take]
+        if sess.t_pf is None:          # queue phase ends at the first
+            sess.t_pf = time.monotonic()  # chunk program of the prompt
+            self.phase_s["queue"] += sess.t_pf - sess.t_enq
         t0 = time.time()
         sess.plogits, sess.pcache = self._chunk(self.params, toks,
                                                 sess.pcache, cfg=self.cfg)
@@ -640,6 +710,7 @@ class ContinuousBatchingEngine:
                                          cfg=self._draft_cfg)
             self._shape_seen("draft_prefill_chunk", 1, take)
         sess.poff = off + take
+        self._prof.note_tokens("prefill_chunk", take)
         with self._cond:   # stats() reads this counter
             self.prefill_chunks += 1
         SERVE_PREFILL_CHUNKS.inc(tags={"deployment": self.name})
@@ -771,8 +842,18 @@ class ContinuousBatchingEngine:
                         sess.pcache = sess.dcache = sess.plogits = None
                         self._cond.notify_all()
             if ready:
+                now_mono = time.monotonic()
+                now_wall = time.time()
                 with self._cond:
                     for sess, first in ready:
+                        sess.t_ready = now_mono
+                        # per-request admission span (wall clock, like
+                        # every lifecycle span): enqueue -> first token
+                        tracing.record_span(
+                            f"serve_admission::{self.name}", "serve",
+                            now_wall - (now_mono - sess.t_enq),
+                            now_wall, rid=sess.rid, sid=sess.sid,
+                            deployment=self.name)
                         sess.last_tok = first
                         sess.pos = sess.poff
                         sess.ready = True
@@ -828,6 +909,15 @@ class ContinuousBatchingEngine:
                     tok_dev = None
                     continue
             occupancy = len(batch)
+            # MFU numerators: useful tokens only (active slots), host-
+            # known counts — never a device sync
+            if spec_out is not None:
+                self._prof.note_tokens("draft_propose",
+                                       occupancy * self._spec_k)
+                self._prof.note_tokens("verify",
+                                       occupancy * self._spec_k)
+            else:
+                self._prof.note_tokens("decode_step", occupancy)
             now = time.time()
             if spec_out is not None:
                 greedy, accepted = spec_out
@@ -1028,7 +1118,8 @@ class DecodeSessionCore:
                 if prompt.shape[0] == 1:
                     return self.engine.start(
                         prompt, self.max_sessions,
-                        ptoks=_host_tokens(req["prompt"]))
+                        ptoks=_host_tokens(req["prompt"]),
+                        rid=str(req.get("_rid") or ""))
                 return self._group_start(prompt, req["prompt"])
             cache = init_kv_cache(self.cfg, prompt.shape[0],
                                   self.max_len)
@@ -1059,7 +1150,8 @@ class DecodeSessionCore:
             return self.engine.start(
                 prefix, self.max_sessions, seq_base=len(generated),
                 teacher_forced=True,
-                ptoks=tuple(int(t) for t in replay))
+                ptoks=tuple(int(t) for t in replay),
+                rid=str(req.get("_rid") or ""))
         if op == "stats":
             out = {"legacy_sessions": len(self.sessions),
                    "groups": len(self._groups)}

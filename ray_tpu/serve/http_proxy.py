@@ -13,8 +13,33 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
+
+from ..util import tracing
+
+
+def _push_latency(deployment: str, tenant: str, ttft_s: float,
+                  itl) -> None:
+    """Fire-and-forget one request's TTFT/ITL sample to this node's
+    nodelet (``serve_metrics`` notify, the same lane the decode engine
+    uses): the nodelet folds it into the tenant-labeled
+    ``ray_tpu_serve_{ttft,itl}_seconds`` histograms and runs the SLO
+    evaluator.  Proxy registries are never scraped — the fold is what
+    makes per-tenant latency visible cluster-wide."""
+    payload = {"deployment": deployment, "tenant": tenant,
+               "ttft_s": round(float(ttft_s), 6),
+               "itl_s": [round(float(v), 6) for v in itl]}
+    try:
+        from ..core.worker_runtime import current_worker_runtime
+        rt = current_worker_runtime()
+        if rt is not None and rt._loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                rt.nodelet.notify("serve_metrics", payload), rt._loop)
+    except Exception:
+        pass   # driver-local proxy (tests) or torn-down runtime
 
 
 class HTTPProxy:
@@ -137,6 +162,19 @@ class HTTPProxy:
             max_new = int(payload.pop("max_new_tokens", 64))
             chunk = int(payload.pop("chunk_tokens", 0) or
                         GlobalConfig.serve_stream_chunk_tokens)
+            # per-request tracing: the rid minted here rides the start
+            # payload to the replica engine (underscore key = protocol
+            # meta; FailoverSession replays it on resume, so a healed
+            # stream keeps its id); the tenant — request field first,
+            # x-tenant header second — labels the TTFT/ITL histograms,
+            # cardinality-capped at the nodelet fold
+            rid = uuid.uuid4().hex[:12]
+            tenant = str(payload.pop("tenant", None)
+                         or request.headers.get("x-tenant") or "anon")
+            payload.setdefault("_rid", rid)
+            t0 = time.time()
+            ttft = None       # start-accepted -> first token ready
+            itl = []          # gaps between consecutive SSE emissions
 
             def session_call(p, sticky=None):
                 return route_call(name, p, sticky)
@@ -148,6 +186,9 @@ class HTTPProxy:
             # still gets a clean HTTP 500/503 from the caller
             out = await loop.run_in_executor(self._pool, sess.start)
             sid = out.get("sid") if isinstance(out, dict) else None
+            if isinstance(out, dict) and "error" not in out:
+                ttft = time.time() - t0
+            t_last = time.time()
             if isinstance(out, dict):
                 out.pop("proto", None)
             resp = web.StreamResponse(headers={
@@ -183,6 +224,9 @@ class HTTPProxy:
                         for tok in out["tokens"][:max_new - emitted]:
                             await emit({"token": [tok]})
                             emitted += 1
+                            now = time.time()
+                            itl.append(now - t_last)
+                            t_last = now
                 elif sid is not None and "error" not in out:
                     for _ in range(max_new - 1):
                         if client_gone():
@@ -191,6 +235,9 @@ class HTTPProxy:
                             self._pool,
                             make_call(name, {"op": "next", "sid": sid}))
                         await emit(out)
+                        now = time.time()
+                        itl.append(now - t_last)
+                        t_last = now
                         if not isinstance(out, dict) \
                                 or "error" in out or out.get("eos"):
                             break
@@ -209,6 +256,19 @@ class HTTPProxy:
                             make_call(name, {"op": "end", "sid": sid}))
                     except Exception:
                         pass   # owner died mid-stream: nothing to free
+            # request timeline span + one latency sample to the nodelet
+            # fold — after the stream, off the token path
+            try:
+                tracing.record_span(
+                    f"serve_request::{name}", "serve", t0, time.time(),
+                    rid=rid, sid=sid, deployment=name, tenant=tenant,
+                    tokens=(0 if ttft is None else 1 + len(itl)),
+                    ttft_ms=(None if ttft is None
+                             else round(ttft * 1e3, 3)))
+            except Exception:
+                pass
+            if ttft is not None:
+                _push_latency(name, tenant, ttft, itl)
             try:
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()

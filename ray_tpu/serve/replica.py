@@ -48,6 +48,7 @@ class ServeReplica:
         # set BEFORE user __init__ runs so constructors can read it
         global _replica_context
         _replica_context = ReplicaContext(deployment_name, replica_id)
+        t0 = time.perf_counter()
         fc = loads_function(callable_blob)
         if inspect.isclass(fc):
             self._callable = fc(*init_args, **init_kwargs)
@@ -60,6 +61,32 @@ class ServeReplica:
         self._total = 0
         if user_config is not None:
             self.reconfigure(user_config)
+        # cold-start attribution (serve_breakdown's `cold_start`
+        # phase): replicas construct lazily, so worker acquisition plus
+        # the user constructor — model init, first jit compiles — sit
+        # inside the first request's client-measured TTFT.  Without
+        # this one-shot push that time is unattributable and the
+        # coverage bar reads a cold cluster as an instrumentation gap.
+        # The constructor runs AS an actor task, so its spec's
+        # submit_time extends the phase back to the controller-side
+        # creation submit (covering scheduling/spawn wait too).
+        dt = time.perf_counter() - t0
+        try:
+            from ..core.worker_runtime import (current_task_spec,
+                                               current_worker_runtime)
+            spec = current_task_spec()
+            if spec is not None and getattr(spec, "submit_time", 0):
+                dt = max(dt, time.time() - spec.submit_time)
+            rt = current_worker_runtime()
+            if rt is not None and rt._loop is not None:
+                asyncio.run_coroutine_threadsafe(
+                    rt.nodelet.notify("serve_metrics", {
+                        "deployment": deployment_name,
+                        "replica": replica_id,
+                        "phase_totals": {"cold_start": round(dt, 6)}}),
+                    rt._loop)
+        except Exception:
+            pass
 
     def reconfigure(self, user_config: Any) -> bool:
         target = self._callable

@@ -239,6 +239,109 @@ def metrics_history(name: Optional[str] = None,
     return out
 
 
+def _prom_samples(text: str) -> Dict[str, list]:
+    """Parse Prometheus exposition text into name -> [(tags, value)].
+    Minimal by design: our own exposition format (metrics.py) — one
+    sample per line, ``label="value"`` pairs, no escapes."""
+    import re
+    line_re = re.compile(
+        r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)$")
+    tag_re = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"')
+    out: Dict[str, list] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = line_re.match(line)
+        if m is None:
+            continue
+        try:
+            val = float(m.group(3))
+        except ValueError:
+            continue
+        tags = dict(tag_re.findall(m.group(2) or ""))
+        out.setdefault(m.group(1), []).append((tags, val))
+    return out
+
+
+#: serve_breakdown's named phases, in pipeline order
+SERVE_PHASES = ("cold_start", "queue", "admission", "prefill",
+                "decode_dispatch", "stream_drain")
+
+
+def serve_breakdown() -> Dict[str, Any]:
+    """Per-deployment serve time attribution: where does a served
+    millisecond-per-token actually go?  Reads the cluster scrape
+    (`cluster_metrics_text`) and reduces the data-plane flight
+    instruments — engine phase counters, proxy TTFT/ITL histograms,
+    token counters, per-program MFU — to one table per deployment:
+
+    * ``phases_s`` / ``ms_per_token``: cold_start (lazy replica
+      construction — model init and first compiles land inside the
+      first request's TTFT), queue (enqueue -> first prefill chunk),
+      admission (first token -> decode slot), prefill (chunk program
+      wall), decode_dispatch (decode/draft/verify/insert program
+      wall), stream_drain (client-observed inter-token time not
+      explained by decode dispatch: queue depth + RPC + SSE);
+    * ``coverage``: attributed seconds over client-measured seconds
+      (TTFT sum + ITL sum) — the honesty metric.  Healthy is >= 0.9:
+      the engine-side marks explain at least 90% of what clients
+      actually waited; a gap means an uninstrumented phase;
+    * ``mfu``: per-program model-FLOPs-utilization gauges.
+
+    Surfaces: `ray-tpu top` breakdown panel, ``/api/serve/breakdown``,
+    ``bench.py --serve-breakdown`` (SERVE_BENCH.json)."""
+    samples = _prom_samples(cluster_metrics_text())
+    per: Dict[str, Dict[str, Any]] = {}
+
+    def acc(dep: str) -> Dict[str, Any]:
+        return per.setdefault(dep, {
+            "phases_s": dict.fromkeys(SERVE_PHASES, 0.0),
+            "tokens": 0.0, "requests": 0.0,
+            "ttft_s": 0.0, "itl_s": 0.0, "mfu": {}})
+
+    for tags, v in samples.get("ray_tpu_serve_phase_seconds_total", ()):
+        a = acc(tags.get("deployment", "?"))
+        ph = tags.get("phase", "")
+        if ph in a["phases_s"]:
+            a["phases_s"][ph] += v
+    for tags, v in samples.get("ray_tpu_serve_tokens_total", ()):
+        acc(tags.get("deployment", "?"))["tokens"] += v
+    for name, key in (("ray_tpu_serve_ttft_seconds_sum", "ttft_s"),
+                      ("ray_tpu_serve_itl_seconds_sum", "itl_s")):
+        for tags, v in samples.get(name, ()):
+            acc(tags.get("deployment", "?"))[key] += v
+    for tags, v in samples.get("ray_tpu_serve_ttft_seconds_count", ()):
+        acc(tags.get("deployment", "?"))["requests"] += v
+    for tags, v in samples.get("ray_tpu_mfu_ratio", ()):
+        acc(tags.get("deployment", "?"))["mfu"][
+            tags.get("program", "?")] = v
+
+    deployments: Dict[str, Any] = {}
+    for dep, a in sorted(per.items()):
+        ph = a["phases_s"]
+        # inter-token time clients saw but decode dispatch does not
+        # explain: slot queueing, chunk RPC, SSE write — the drain tail
+        ph["stream_drain"] = max(0.0, a["itl_s"]
+                                 - ph["decode_dispatch"])
+        measured = a["ttft_s"] + a["itl_s"]
+        attributed = sum(ph.values())
+        tokens = a["tokens"]
+        deployments[dep] = {
+            "tokens": int(tokens),
+            "requests": int(a["requests"]),
+            "measured_s": round(measured, 6),
+            "attributed_s": round(attributed, 6),
+            "coverage": (round(attributed / measured, 4)
+                         if measured > 0 else None),
+            "phases_s": {k: round(v, 6) for k, v in ph.items()},
+            "ms_per_token": {
+                k: (round(v / tokens * 1e3, 4) if tokens else None)
+                for k, v in ph.items()},
+            "mfu": {k: round(v, 4) for k, v in sorted(a["mfu"].items())},
+        }
+    return {"phases": list(SERVE_PHASES), "deployments": deployments}
+
+
 def rpc_attribution() -> Dict[str, Any]:
     """Per-RPC control-plane attribution: for the controller and every
     alive nodelet, the per-op dispatch table (count, errors, total

@@ -1,0 +1,258 @@
+"""Per-dispatch device profiling: the data-plane flight instruments.
+
+PR-10's flight recorder made the control plane explainable after the
+fact; this module does the same for the DATA plane.  Every registered
+jitted program (decode step, prefill chunk, cache insert/gather,
+draft/verify, train step) is wrapped ONCE in a timing shim that records,
+per program:
+
+* dispatch count and cumulative dispatch wall time (always);
+* block-until-ready device time, sampled every Nth dispatch
+  (``device_profile_sample_every``) so the hot loop stays hot — the
+  estimate extrapolates the sampled mean over all dispatches;
+* the argument-shape key of each dispatch, and the wall time of every
+  FIRST-SEEN shape — the **compile ledger**.  A novel shape means XLA
+  traces + compiles inside that dispatch, so its wall time is the
+  observed compile cost and the recompile count is exactly the distinct
+  shape count.  A ledger growing with traffic instead of staying O(1)
+  is a compile storm — counted here, alerted via the nodelet's
+  ``compile_storm`` flight-recorder trigger;
+* tokens processed (host-known counts fed by the engine via
+  :meth:`DispatchProfiler.note_tokens` — no device sync) and an
+  analytic FLOPs-per-token figure (``models.decode_flops_per_token``),
+  giving a roofline/MFU estimate per program:
+  ``mfu = tokens * flops_per_token / device_seconds / peak_flops``.
+
+The wrap is idempotent: wrapping an already-wrapped callable re-wraps
+the ORIGINAL underneath, never stacking shims — critical because the
+prefill chunk program is a module-level shared jit and every engine
+(re)start wraps it again; stacking would double-count every dispatch.
+
+Snapshots are cumulative plain dicts; the serve engine ships them on
+its existing ``serve_metrics`` push and the nodelet folds deltas into
+``ray_tpu_device_{dispatches,device_seconds,compile_seconds,compiles}``
+counters and the ``ray_tpu_mfu_ratio`` gauge.
+
+MFU caveat: peak FLOP/s comes from ``device_profile_peak_flops`` when
+set, else a public-spec-sheet table by TPU device kind, else a nominal
+CPU figure — on the CPU test harness the ratio is an indicative
+utilization number, not a hardware truth.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets) —
+# kept in sync with bench.py's table; longest prefix wins so
+# "TPU v5p" is not shadowed by "TPU v5"
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6e": 918.0,
+    "TPU v6 lite": 918.0,
+}
+#: nominal peak for non-TPU backends (CPU harness): a few hundred
+#: GFLOP/s of fused f32 — makes the MFU gauge a meaningful relative
+#: number in tests without pretending to be a spec sheet
+_FALLBACK_PEAK = 2e11
+
+
+def peak_flops() -> float:
+    """Per-device peak FLOP/s: config override, else device-kind table,
+    else the nominal fallback."""
+    from ..core.config import GlobalConfig
+    cfg = getattr(GlobalConfig, "device_profile_peak_flops", 0.0) or 0.0
+    if cfg > 0:
+        return float(cfg)
+    try:
+        import jax
+        kind = getattr(jax.devices()[0], "device_kind", "")
+    except Exception:
+        kind = ""
+    for key, tf in sorted(_PEAK_TFLOPS.items(),
+                          key=lambda kv: -len(kv[0])):
+        if kind.startswith(key):
+            return tf * 1e12
+    return _FALLBACK_PEAK
+
+
+def _shape_key(args: tuple, kwargs: dict) -> tuple:
+    """Cheap per-dispatch shape fingerprint: the shapes of TOP-LEVEL
+    array arguments plus scalar statics.  Pytrees (params, caches) are
+    summarized as ``*`` — walking them per dispatch would cost more
+    than the dispatch; the dims that actually vary (token blocks,
+    chunk widths, static ints) are all top-level here."""
+    key: List[Any] = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            key.append(tuple(int(d) for d in shape))
+        elif isinstance(a, (int, bool, str, float)):
+            key.append(a)
+        else:
+            key.append("*")
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        key.append((k, getattr(v, "shape", None) or
+                    (v if isinstance(v, (int, bool, str, float))
+                     else "*")))
+    return tuple(key)
+
+
+class _ProgramStats:
+    """Cumulative ledger of one wrapped program (single writer — the
+    dispatching thread; snapshot readers tolerate torn reads)."""
+
+    __slots__ = ("program", "dispatches", "wall_s", "sampled_s",
+                 "sampled_n", "compile_s", "compiles", "shapes",
+                 "tokens", "flops_per_token")
+
+    def __init__(self, program: str):
+        self.program = program
+        self.dispatches = 0
+        self.wall_s = 0.0
+        self.sampled_s = 0.0        # block-until-ready sample total
+        self.sampled_n = 0          # dispatches actually sampled
+        self.compile_s = 0.0        # wall time of first-seen shapes
+        self.compiles = 0           # distinct argument-shape keys seen
+        self.shapes: set = set()
+        self.tokens = 0
+        self.flops_per_token = 0.0
+
+    def device_seconds(self) -> float:
+        """Extrapolated device time: sampled mean × all dispatches.
+        Until the first sample lands, dispatch wall time is the bound
+        (async dispatch makes it an underestimate, never zero)."""
+        if self.sampled_n:
+            return self.sampled_s * (self.dispatches
+                                     / max(1, self.sampled_n))
+        return self.wall_s
+
+    def mfu(self, peak: float) -> Optional[float]:
+        dev = self.device_seconds()
+        if not self.flops_per_token or not self.tokens or dev <= 0 \
+                or peak <= 0:
+            return None
+        return (self.tokens * self.flops_per_token) / dev / peak
+
+
+class DispatchProfiler:
+    """Wrap-once timing shims over a set of named jitted programs."""
+
+    def __init__(self, sample_every: Optional[int] = None):
+        self._sample_every = sample_every
+        self._stats: Dict[str, _ProgramStats] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ wiring
+    def _stat(self, program: str) -> _ProgramStats:
+        st = self._stats.get(program)
+        if st is None:
+            with self._lock:
+                st = self._stats.setdefault(program,
+                                            _ProgramStats(program))
+        return st
+
+    def _every(self) -> int:
+        if self._sample_every is not None:
+            return max(1, int(self._sample_every))
+        from ..core.config import GlobalConfig
+        return max(1, int(getattr(GlobalConfig,
+                                  "device_profile_sample_every", 10)))
+
+    def wrap(self, program: str, fn: Callable) -> Callable:
+        """Return ``fn`` timed under ``program``.  Idempotent: a
+        callable that is already a profiler shim (this profiler's or a
+        previous engine incarnation's) is unwrapped to the original
+        first, so re-registration after an engine restart never stacks
+        two timers over one dispatch."""
+        inner = getattr(fn, "_rt_profiled_inner", None)
+        if inner is not None:
+            fn = inner
+        st = self._stat(program)
+
+        def dispatch(*args, **kwargs):
+            key = _shape_key(args, kwargs)
+            novel = key not in st.shapes
+            sample = novel or (st.dispatches + 1) % self._every() == 0
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if sample:
+                try:
+                    import jax
+                    out = jax.block_until_ready(out)
+                except Exception:
+                    pass
+            dt = time.perf_counter() - t0
+            st.dispatches += 1
+            st.wall_s += dt
+            if novel:
+                # first dispatch of a shape pays trace + compile: its
+                # wall time IS the observed compile cost (excluded from
+                # the device-time sample pool so MFU is steady-state)
+                st.shapes.add(key)
+                st.compiles += 1
+                st.compile_s += dt
+            elif sample:
+                st.sampled_s += dt
+                st.sampled_n += 1
+            return out
+
+        dispatch._rt_profiled_inner = fn
+        dispatch._rt_profiler = self
+        dispatch.__name__ = getattr(fn, "__name__", program)
+        return dispatch
+
+    # ---------------------------------------------------------- feeding
+    def note_tokens(self, program: str, n: int) -> None:
+        """Credit ``n`` processed tokens to ``program`` — host-known
+        counts (batch occupancy, chunk width) so the MFU numerator
+        never costs a device sync."""
+        if n > 0:
+            self._stat(program).tokens += n
+
+    def set_flops_per_token(self, program: str, flops: float) -> None:
+        self._stat(program).flops_per_token = float(flops or 0.0)
+
+    # --------------------------------------------------------- snapshot
+    def wall_seconds(self) -> Dict[str, float]:
+        """program -> cumulative dispatch wall seconds (the phase-
+        attribution source: wall, not sampled device time, because the
+        engine thread is occupied for the whole dispatch)."""
+        with self._lock:
+            return {p: s.wall_s for p, s in self._stats.items()}
+
+    def distinct_shapes(self) -> int:
+        with self._lock:
+            return sum(len(s.shapes) for s in self._stats.values())
+
+    def total_compiles(self) -> int:
+        with self._lock:
+            return sum(s.compiles for s in self._stats.values())
+
+    def snapshot(self, peak: Optional[float] = None) -> List[dict]:
+        """Cumulative per-program rows, wire-ready for the nodelet fold
+        (every numeric travels cumulative; the nodelet incs deltas)."""
+        pk = peak if peak is not None else peak_flops()
+        rows = []
+        with self._lock:
+            stats = list(self._stats.values())
+        for st in sorted(stats, key=lambda s: s.program):
+            mfu = st.mfu(pk)
+            rows.append({
+                "program": st.program,
+                "dispatches": st.dispatches,
+                "wall_s": round(st.wall_s, 6),
+                "device_s": round(st.device_seconds(), 6),
+                "compile_s": round(st.compile_s, 6),
+                "compiles": st.compiles,
+                "shapes": len(st.shapes),
+                "tokens": st.tokens,
+                "mfu": None if mfu is None else round(mfu, 6),
+            })
+        return rows

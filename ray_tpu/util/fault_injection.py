@@ -149,6 +149,7 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "serve.session_failover": frozenset({"error", "fail"}),
     "serve.autoscale": frozenset({"drop", "error", "fail"}),
     "serve.spec_verify": frozenset({"error", "fail"}),
+    "serve.slo_eval": frozenset({"error", "fail"}),
     "drain.evacuate": None,
     "drain.deadline": None,
     "train.snapshot_put": frozenset({"error", "fail"}),

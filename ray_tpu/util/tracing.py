@@ -98,6 +98,17 @@ def claim_flusher() -> bool:
         return True
 
 
+def release_flusher() -> None:
+    """Claimant is shutting down (driver disconnect): let the NEXT
+    runtime in this process own the flush loop again.  Without this, a
+    process doing init() -> shutdown() -> init() (every test after the
+    first in a pytest invocation) silently loses its span flusher and
+    the second cluster's timeline never sees driver spans."""
+    global _flusher_claimed
+    with _span_lock:
+        _flusher_claimed = False
+
+
 def _buffer() -> deque:
     global _spans
     if _spans is None:

@@ -198,9 +198,19 @@ def test_chaos_worker_crash_before_put_retries(chaos_cleanup, run):
 
         assert ray_tpu.get(chaos_flaky.remote(), timeout=120.0) == 42
         from ray_tpu import state
-        text = state.cluster_metrics_text()
-        assert fi.METRIC_NAME in text
-        assert 'site="worker.before_put"' in text
+
+        # the crashing worker's last-gasp injection report races the
+        # scrape (it travels worker -> nodelet fold); poll with a
+        # deadline instead of reading once
+        def injected_visible():
+            text = state.cluster_metrics_text()
+            return fi.METRIC_NAME in text and \
+                'site="worker.before_put"' in text
+        deadline = time.monotonic() + 20.0
+        while not injected_visible():
+            assert time.monotonic() < deadline, \
+                "injection never reached cluster_metrics_text"
+            time.sleep(0.25)
     finally:
         ray_tpu.shutdown()
 

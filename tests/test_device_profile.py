@@ -1,0 +1,189 @@
+"""Dispatch-profiler units (PR-16 data-plane flight instruments):
+wrap-once idempotence across engine restarts, the compile ledger
+(novel-shape dispatches counted as compiles), device-time sampling and
+extrapolation, MFU arithmetic against hand-computed analytic FLOPs,
+and the peak-FLOPs resolution order."""
+
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.core.config import GlobalConfig  # noqa: E402
+from ray_tpu.models import (TransformerConfig,  # noqa: E402
+                            decode_flops_per_token, engine_flops_table)
+from ray_tpu.util.device_profile import (DispatchProfiler,  # noqa: E402
+                                         peak_flops)
+
+
+# ------------------------------------------------------------ wrap-once
+
+def test_wrap_is_idempotent_across_engine_restarts():
+    """The prefill chunk program is a module-level shared jit: every
+    engine (re)start wraps it again.  A re-wrap must unwrap to the
+    ORIGINAL underneath — stacking two shims would double-count every
+    dispatch and double-time every sample."""
+    calls = []
+
+    def fn(x):
+        calls.append(1)
+        return x
+
+    p1 = DispatchProfiler(sample_every=1)
+    w1 = p1.wrap("prog", fn)
+    # "engine restart": a fresh profiler wraps the already-wrapped fn
+    p2 = DispatchProfiler(sample_every=1)
+    w2 = p2.wrap("prog", w1)
+    assert w2._rt_profiled_inner is fn     # unwrapped, not stacked
+    w2(jnp.ones((2, 2)))
+    assert len(calls) == 1                 # the original ran once
+    assert p2.snapshot(peak=1.0)[0]["dispatches"] == 1
+    assert p1.snapshot(peak=1.0)[0]["dispatches"] == 0  # old shim idle
+
+    # re-wrap within the SAME profiler must not stack either
+    w3 = p1.wrap("prog", p1.wrap("prog", fn))
+    w3(jnp.ones((2, 2)))
+    assert p1.snapshot(peak=1.0)[0]["dispatches"] == 1
+
+
+# -------------------------------------------------------- compile ledger
+
+def test_compile_ledger_counts_novel_shapes():
+    """A first-seen argument-shape dispatch pays XLA trace + compile:
+    the ledger must count exactly the distinct shapes, bill their wall
+    time as compile seconds, and keep them out of the steady-state
+    device-time sample pool."""
+    p = DispatchProfiler(sample_every=10 ** 9)   # novel-only sampling
+    f = p.wrap("prog", jax.jit(lambda x: x * 2))
+    a, b = jnp.ones((1, 4)), jnp.ones((1, 8))
+    for arg in (a, a, b, a, b):
+        f(arg)
+    row = p.snapshot(peak=1.0)[0]
+    assert row["dispatches"] == 5
+    assert row["compiles"] == 2 == row["shapes"]
+    assert row["compile_s"] > 0
+    assert p.total_compiles() == 2
+    assert p.distinct_shapes() == 2
+
+
+def test_shape_key_sees_scalar_statics():
+    """Static scalars retrace jits too — a static int flipping per call
+    is a compile storm the ledger must see."""
+    p = DispatchProfiler(sample_every=10 ** 9)
+    f = p.wrap("prog", lambda x, k: x)
+    x = jnp.ones((2,))
+    f(x, 1)
+    f(x, 2)
+    f(x, 1)
+    assert p.snapshot(peak=1.0)[0]["compiles"] == 2
+
+
+# ------------------------------------------------- device time and MFU
+
+def test_device_seconds_extrapolation_and_mfu_arithmetic():
+    p = DispatchProfiler(sample_every=1)    # sample every dispatch
+
+    def fn(x):
+        time.sleep(0.002)
+        return x
+
+    w = p.wrap("prog", fn)
+    x = jnp.ones((2, 2))
+    for _ in range(5):
+        w(x)
+    p.set_flops_per_token("prog", 1e6)
+    p.note_tokens("prog", 500)
+    row = p.snapshot(peak=1e9)[0]
+    assert row["device_s"] > 0
+    # mfu = tokens * flops_per_token / device_seconds / peak
+    expect = 500 * 1e6 / row["device_s"] / 1e9
+    assert row["mfu"] == pytest.approx(expect, rel=0.02)
+
+
+def test_mfu_is_none_without_tokens_or_flops():
+    p = DispatchProfiler(sample_every=1)
+    w = p.wrap("prog", lambda x: x)
+    w(jnp.ones((2,)))
+    assert p.snapshot(peak=1e9)[0]["mfu"] is None   # no flops, no toks
+    p.set_flops_per_token("prog", 1e6)
+    assert p.snapshot(peak=1e9)[0]["mfu"] is None   # still no tokens
+
+
+def test_decode_flops_per_token_matches_hand_computation():
+    """Re-derive the analytic decode FLOPs for the tiny config straight
+    from its fields: 2 FLOPs/MAC over qkvo + swiglu MLP + unembed, plus
+    qk^T and probs.v reads against every cached position."""
+    cfg = TransformerConfig.tiny()
+    d, h, hk, hd = cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    ff, L = cfg.ff_dim, cfg.n_layers
+    assert cfg.activation == "swiglu" and not cfg.n_experts
+    per_layer = d * h * hd + 2 * d * hk * hd + h * hd * d + 3 * d * ff
+    ctx = 64
+    hand = 2 * (L * per_layer + cfg.vocab_size * d) + 4 * L * h * hd * ctx
+    assert decode_flops_per_token(cfg, ctx) == hand
+
+    table = engine_flops_table(cfg, max_len=2 * ctx)   # mid == ctx
+    assert table["decode_step"] == hand
+    assert table["prefill_chunk"] == hand
+    assert table["verify"] == hand
+    assert table["cache_insert"] == 0.0     # byte movers: no MFU
+    assert table["prefix_gather"] == 0.0
+    assert "draft_propose" not in table     # no draft cfg
+
+    draft = TransformerConfig.tiny(n_layers=1)
+    t2 = engine_flops_table(cfg, max_len=2 * ctx, draft_cfg=draft)
+    assert t2["draft_propose"] == decode_flops_per_token(draft, ctx)
+    assert t2["draft_propose"] < t2["decode_step"]
+
+
+def test_peak_flops_config_override_wins(monkeypatch):
+    monkeypatch.setitem(GlobalConfig._values,
+                        "device_profile_peak_flops", 123.0)
+    assert peak_flops() == 123.0
+    monkeypatch.setitem(GlobalConfig._values,
+                        "device_profile_peak_flops", 0.0)
+    assert peak_flops() > 0      # device table or nominal fallback
+
+
+# ---------------------------------------------- engine integration seam
+
+def test_engine_stats_carry_profile_and_phase_totals():
+    """The serve engine's stats() must ship the profiler snapshot and
+    the phase attribution table, and the profiler's prefill tokens must
+    match the prompt lengths it actually prefilled (host-side count —
+    the MFU numerator never costs a device sync)."""
+    from ray_tpu.serve.decode_session import DecodeSessionCore
+
+    cfg = TransformerConfig.tiny(max_seq_len=128, dtype=jnp.float32)
+    core = DecodeSessionCore(cfg, max_len=128)
+    try:
+        prompt = [int(i) % cfg.vocab_size for i in range(17)]
+        out = core.handle({"op": "start", "prompt": prompt})
+        assert "sid" in out
+        for _ in range(4):
+            core.handle({"op": "next_chunk", "sid": out["sid"],
+                         "max_tokens": 2})
+        st = core.handle({"op": "stats"})["engine"]
+        prof = {r["program"]: r for r in st["device_profile"]}
+        assert prof["prefill_chunk"]["dispatches"] >= 1
+        assert prof["prefill_chunk"]["tokens"] == len(prompt)
+        assert prof["decode_step"]["dispatches"] >= 1
+        assert prof["decode_step"]["compiles"] >= 1   # ledger alive
+        ph = st["phase_totals"]
+        assert set(ph) == {"queue", "admission", "prefill",
+                           "decode_dispatch"}
+        assert ph["prefill"] > 0 and ph["decode_dispatch"] > 0
+        # wrap-once across restart: a second engine re-wraps the
+        # module-level shared prefill chunk jit; its ledger starts
+        # clean instead of inheriting a stacked shim
+        core2 = DecodeSessionCore(cfg, max_len=128)
+        try:
+            p2 = {r["program"]: r
+                  for r in core2.engine.stats()["device_profile"]}
+            assert p2["prefill_chunk"]["dispatches"] == 0
+        finally:
+            core2.engine.shutdown()
+    finally:
+        core.engine.shutdown()

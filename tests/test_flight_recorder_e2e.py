@@ -56,6 +56,13 @@ def test_suspect_transition_captures_flight_bundle(tmp_path):
         _wait_for(lambda: suspect_bundle(), 25.0,
                   "SUSPECT transition to produce a flight bundle")
         path = os.path.join(dump_dir, suspect_bundle()[0])
+        # bundles publish by rename so a listed dir is complete; keep a
+        # belt-and-braces wait so a future non-atomic writer can only
+        # slow this test down, never flake it
+        _wait_for(lambda: all(
+            os.path.exists(os.path.join(path, f"{part}.json"))
+            for part in ("meta", "spans", "metrics", "events",
+                         "nodes")), 10.0, "bundle files on disk")
         meta = json.load(open(os.path.join(path, "meta.json")))
         assert meta["trigger"] == "node_suspect"
         assert meta["node_id"] == n2.node_id[:12]
