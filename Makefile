@@ -4,7 +4,8 @@ PY ?= python
 
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
 	attr chaos drain failover spec elastic ha partition autoscale \
-	autoscale-bench serve-breakdown profile lint lint-fast clean
+	autoscale-bench serve-breakdown profile lint lint-fast overload \
+	clean
 
 all: native cpp
 
@@ -46,6 +47,12 @@ attr:
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py \
 		tests/test_controller_ft.py -q
+
+# Overload-protection suite (PR-17): priority RPC lanes, watermark
+# state machine + admission shedding, credit flow control, bounded
+# pubsub, kv-blob divert, and the tier-1 brownout soak.
+overload:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_overload.py -q
 
 # Drain suite: graceful-node-drain units + end-to-end phased
 # evacuation, including the `slow` chaos variants (drain under serve

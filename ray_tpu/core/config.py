@@ -254,6 +254,49 @@ _d("maintenance_poll_interval_s", float, 10.0,
    "Period of the autoscaler's maintenance-notice watcher "
    "(tpu_pod_provider.MaintenanceWatcher) between notice polls.")
 
+# --- overload protection (core/overload.py, rpc lanes) ----------------------
+_d("rpc_bulk_inflight", int, 64,
+   "Per-connection cap on concurrently RUNNING bulk-lane dispatches "
+   "(kv_put blobs, telemetry pushes); liveness/control dispatches are "
+   "unbounded.  Excess bulk frames wait in the lane queue, where the "
+   "overload watermarks can see (and shed) them.")
+_d("kv_inline_max_bytes", int, 256 * 1024,
+   "KV values above this size are diverted to the object-store path by "
+   "writers (a small ref marker is stored in KV instead); readers "
+   "follow the ref transparently.  Keeps function-table blobs and "
+   "other large payloads off the controller's memory/WAL entirely.")
+_d("flow_credit_window", int, 4096,
+   "Submission credits granted per credit_request round under a NORMAL "
+   "controller (soft overload grants a quarter window, brownout grants "
+   "zero — clients buffer locally until recovery).")
+_d("overload_soft_rss_mb", int, 0,
+   "Controller-process RSS (MB) soft watermark: above it the overload "
+   "state machine enters 'soft' (credits shrink, optional work slows). "
+   "0 disables the RSS watermarks (queued-bytes watermarks still "
+   "apply).")
+_d("overload_hard_rss_mb", int, 0,
+   "Controller-process RSS (MB) hard watermark: above it the state "
+   "machine enters 'brownout' — bulk ops are shed with the typed "
+   "retriable pushback and optional work stops.  0 disables.")
+_d("overload_queued_soft_bytes", int, 64 * 1024 * 1024,
+   "Bytes queued across this process's RPC lanes that trip the 'soft' "
+   "overload state.  0 disables the queued-bytes watermarks.")
+_d("overload_queued_hard_bytes", int, 256 * 1024 * 1024,
+   "Queued-bytes hard watermark: 'brownout' — shed bulk, stop optional "
+   "work, fire the `overload` flight-recorder trigger.  0 disables.")
+_d("overload_eval_interval_s", float, 0.25,
+   "Period of the controller's overload watermark evaluator (RSS read "
+   "+ lane-table scan; recovery re-arms automatically on the same "
+   "tick).")
+_d("overload_shed_retry_after_s", float, 0.5,
+   "Retry-After hint carried by shed replies; clients sleep roughly "
+   "this (full jitter) before replaying a shed op.")
+_d("pubsub_max_buffer", int, 4096,
+   "Per-subscriber pubsub event-buffer bound.  Overflow drops the "
+   "OLDEST event (counted in ray_tpu_pubsub_dropped_total) and flags "
+   "the subscriber for snapshot resync instead of growing without "
+   "bound under a slow consumer.")
+
 # --- controller high availability (core/ha.py) ------------------------------
 _d("ha_lease_timeout_s", float, 2.0,
    "A hot-standby controller promotes itself once it has heard nothing "

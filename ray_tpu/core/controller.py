@@ -53,7 +53,8 @@ class ActorRecord:
         self.num_restarts = 0
         self.death_cause: Optional[str] = None
         self.owner_conn_id: Optional[int] = None
-        self.waiters: List[asyncio.Event] = []
+        # wait_actor futures resolved at the ALIVE/DEAD FSM transition
+        self.waiters: List[asyncio.Future] = []
         # nodes that recently reported actor-cap saturation → expiry time
         # (scheduling steers around them until the entry lapses)
         self.avoid_nodes: Dict[str, float] = {}
@@ -160,6 +161,9 @@ class Controller:
         self._tasks: List[asyncio.Task] = []
         self._pub_buf: Dict[int, tuple] = {}   # conn id -> (conn, events)
         self._pub_flusher: Optional[asyncio.Task] = None
+        # conn id -> channels whose events were dropped (bounded buffer
+        # overflow): the next flush tells the subscriber to resync
+        self._pub_resync: Dict[int, set] = {}
         # structured cluster events (reference: src/ray/util/event.h +
         # dashboard/modules/event): bounded ring, newest last
         from collections import deque as _deque
@@ -172,6 +176,10 @@ class Controller:
         from .metrics_history import MetricsRing
         self.metrics_ring = MetricsRing()
         self.flight = FlightRecorder(self)
+        # overload protection: watermark state machine + admission
+        # shedding + credit grants (core/overload.py)
+        from .overload import OverloadManager
+        self.overload = OverloadManager(self)
         self._lag_ewma = 0.0   # asyncio loop lag (rpc.loop_lag_monitor)
         self._lag_max = 0.0
         # -- durability (reference: gcs_table_storage.h:357 Redis-backed
@@ -301,7 +309,7 @@ class Controller:
                      "report_event", "list_events",
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
-                     "drain_node", "ping", "metrics_text",
+                     "drain_node", "ping", "metrics_text", "credit_request",
                      "rpc_attribution", "metrics_history", "debug_capture",
                      "chaos_plan", "chaos_claim",
                      "ha_status", "ha_register_standby", "ha_replicate",
@@ -322,6 +330,12 @@ class Controller:
             if _name not in HA_EXEMPT and not ha.is_leader:
                 return {"_not_leader": True, "leader": ha.leader_addr,
                         "epoch": ha.epoch}
+            # overload admission: brownout sheds bulk-lane ops with an
+            # in-band retriable reply (liveness is never shed)
+            ra = self.overload.admit(_name)
+            if ra is not None:
+                return {"_overload": True, "retry_after_s": ra,
+                        "op": _name}
             if _name in HA_EXEMPT or not ha.sync_gate_active():
                 return await _fn(conn, data)
             seq0 = self.pstore.seq
@@ -386,6 +400,8 @@ class Controller:
         (the instruments item 4's serialization hunt reads)."""
         out = {"proc": "controller", "addr": self.address,
                "ops": rpc.attribution_rows(),
+               "lanes": rpc.lane_stats(),
+               "overload": self.overload.snapshot(),
                "loop_lag": {"ewma_ms": self._lag_ewma * 1e3,
                             "max_ms": self._lag_max * 1e3}}
         if self.pstore is not None:
@@ -504,6 +520,7 @@ class Controller:
         self._tasks.append(asyncio.ensure_future(
             self.metrics_ring.run(
                 refresh=lambda: rtm.snapshot_controller(self))))
+        self._tasks.append(asyncio.ensure_future(self.overload.run()))
         return self
 
     async def _trace_flush_loop(self):
@@ -547,12 +564,21 @@ class Controller:
         subscriber per flush instead of per event; matters for the
         high-rate ``logs`` channel)."""
         rtm.PUBSUB_MESSAGES.inc(tags={"channel": channel})
+        cap = GlobalConfig.pubsub_max_buffer
         for conn in list(self.subscribers.get(channel, ())):
             if conn.closed:
                 self.subscribers[channel].discard(conn)
                 continue
-            self._pub_buf.setdefault(id(conn), (conn, []))[1].append(
-                (channel, data))
+            buf = self._pub_buf.setdefault(id(conn), (conn, []))[1]
+            buf.append((channel, data))
+            # bounded per-subscriber buffer: a slow consumer drops its
+            # OLDEST event and is told to resync the channel snapshot
+            # instead of running the controller out of memory
+            if 0 < cap < len(buf):
+                dropped_ch, _ = buf.pop(0)
+                rtm.PUBSUB_DROPPED.inc(tags={"channel": dropped_ch})
+                self._pub_resync.setdefault(id(conn), set()).add(
+                    dropped_ch)
         if self._pub_buf and self._pub_flusher is None:
             self._pub_flusher = asyncio.ensure_future(self._flush_pubs())
 
@@ -560,11 +586,19 @@ class Controller:
         try:
             while self._pub_buf:
                 buf, self._pub_buf = self._pub_buf, {}
-                for conn, events in buf.values():
+                resync, self._pub_resync = self._pub_resync, {}
+                for cid, (conn, events) in buf.items():
                     if conn.closed:
                         continue
+                    chans = resync.pop(cid, None)
                     try:
-                        if len(events) == 1:
+                        if chans:
+                            # overflow happened: force the batch form so
+                            # the resync list rides along
+                            await conn.notify(
+                                "pub_batch", {"events": events,
+                                              "resync": sorted(chans)})
+                        elif len(events) == 1:
                             ch, data = events[0]
                             await conn.notify("pub:" + ch, data)
                         else:
@@ -572,6 +606,9 @@ class Controller:
                                               {"events": events})
                     except Exception:
                         pass
+                # resync owed to conns with no buffered events this round
+                for cid, chans in resync.items():
+                    self._pub_resync.setdefault(cid, set()).update(chans)
                 if self._pub_buf:
                     await asyncio.sleep(          # coalesce the burst
                         GlobalConfig.pubsub_coalesce_s)
@@ -581,6 +618,16 @@ class Controller:
     # ------------------------------------------------------------- node table
     async def _h_ping(self, conn, data):
         return "pong"
+
+    async def _h_credit_request(self, conn, data):
+        """Grant a submission-credit window sized by the overload state
+        (drivers call this; nodelets get credits on the heartbeat
+        reply).  Rides the liveness lane so a grant is never queued
+        behind the very backlog it regulates."""
+        return {"credits": self.overload.credits_for(
+                    int(data.get("want", 0))),
+                "state": self.overload.state,
+                "retry_after_s": GlobalConfig.overload_shed_retry_after_s}
 
     async def _h_register_node(self, conn, data):
         view = NodeView(data["node_id"], data["addr"], data["resources"],
@@ -666,6 +713,11 @@ class Controller:
         # midpoint of this very round trip
         reply: Dict[str, Any] = {"view_version": self.view_version,
                                  "now": time.time()}
+        # flow control rides the heartbeat: submission credits plus the
+        # overload state (nodelets pause optional work under brownout)
+        reply["overload"] = self.overload.state
+        if data.get("want_credits"):
+            reply["credits"] = self.overload.credits_for()
         known = data.get("view_version", -1)
         if known != self.view_version:
             reply["delta"] = [v.to_wire() for v in self._views().values()
@@ -947,6 +999,8 @@ class Controller:
         fold the answers — fresh directed evidence replaces whatever
         stale entries the background gossip left, so suspect/dead
         decisions never wait out the freshness window."""
+        if self.overload.state == "brownout":
+            return  # optional on-demand probes pause under brownout
         rec_t = self.nodes.get(node_id)
         addr = rec_t.view.addr if rec_t is not None else None
         peers = sorted(
@@ -1264,8 +1318,12 @@ class Controller:
         return True
 
     def _notify_actor_waiters(self, actor: ActorRecord):
-        for ev in actor.waiters:
-            ev.set()
+        """Resolve every parked ``wait_actor`` future at the FSM
+        transition that settles it (ALIVE or DEAD) — waiters are
+        event-driven, not poll-driven."""
+        for fut in actor.waiters:
+            if not fut.done():
+                fut.set_result(actor.state)
         actor.waiters.clear()
 
     async def _h_wait_actor(self, conn, data):
@@ -1275,15 +1333,18 @@ class Controller:
         timeout = data.get("timeout", 60.0)
         deadline = time.monotonic() + timeout
         while actor.state not in (ALIVE, DEAD):
-            ev = asyncio.Event()
-            actor.waiters.append(ev)
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return {"state": actor.state, "timeout": True}
+            fut = asyncio.get_event_loop().create_future()
+            actor.waiters.append(fut)
             try:
-                await asyncio.wait_for(ev.wait(), timeout=remaining)
+                await asyncio.wait_for(fut, timeout=remaining)
             except asyncio.TimeoutError:
                 return {"state": actor.state, "timeout": True}
+            finally:
+                if fut in actor.waiters:
+                    actor.waiters.remove(fut)
         return actor.to_wire()
 
     async def _h_get_actor(self, conn, data):

@@ -225,6 +225,13 @@ class CoreClient(DeferredRefDecs):
             retries=GlobalConfig.rpc_connect_retries)
         self._put_index = 0
         self._fn_registered: set = set()
+        # fid -> ObjectRef for function blobs diverted to the object
+        # store (core/kvref.py): the owner must keep the payload alive
+        self._fn_blob_refs: Dict[bytes, Any] = {}
+        # credit-based submission flow control (core/overload.py): the
+        # window refills via `credit_request` when it runs out
+        self._credits = 0
+        self._credit_lock = threading.Lock()
         self._ref_lock = threading.Lock()
         self._init_deferred_decs()
         # Submission coalescing: a burst of .remote() calls lands in
@@ -730,11 +737,57 @@ class CoreClient(DeferredRefDecs):
         return [by_oid[o] for o in ready], [by_oid[o] for o in not_ready]
 
     # -------------------------------------------------------- task submission
+    def _take_submit_credit(self) -> None:
+        """Consume one submission credit, refilling the window from the
+        controller when empty.  A zero grant means the controller is
+        shedding load: buffer locally (sleep and re-ask with full-jitter
+        backoff) until it recovers or the failover deadline passes, then
+        surface the typed pushback."""
+        if GlobalConfig.flow_credit_window <= 0:
+            return  # flow control disabled
+        with self._credit_lock:
+            if self._credits > 0:
+                self._credits -= 1
+                return
+        from ..util.backoff import ExponentialBackoff
+        bo = ExponentialBackoff(base=0.05,
+                                cap=GlobalConfig.rpc_connect_backoff_cap_s)
+        deadline = time.monotonic() + \
+            GlobalConfig.ha_client_failover_timeout_s
+        while True:
+            r = self.controller.call(
+                "credit_request",
+                {"want": GlobalConfig.flow_credit_window}, timeout=10)
+            granted = int(r.get("credits", 0)) if isinstance(r, dict) else 0
+            if granted > 0:
+                with self._credit_lock:
+                    self._credits += granted - 1
+                return
+            ra = float(r.get("retry_after_s", 0.5)) \
+                if isinstance(r, dict) else 0.5
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise exceptions.ControlPlaneOverloadError("submit", ra)
+            time.sleep(min(remaining,
+                           ra * rpc._jitter() + bo.next_delay()))
+
     def register_function(self, fid: bytes, blob: bytes):
         if fid in self._fn_registered:
             return
+        value = blob
+        if 0 < GlobalConfig.kv_inline_max_bytes < len(blob):
+            # big function-table blob: divert the payload to the object
+            # plane (local shm write + primary pin) and register only a
+            # small ref marker in the control-plane KV — readers
+            # (`_get_function`) follow the marker transparently
+            from . import kvref
+            ref = self.put(blob)
+            self._promote_to_plasma(ref.binary())
+            self._fn_blob_refs[fid] = ref   # owner keeps payload alive
+            value = kvref.pack(ref.binary())
+        self._take_submit_credit()
         self.controller.call("kv_put", {"ns": FN_NAMESPACE, "key": fid,
-                                        "value": blob, "overwrite": False})
+                                        "value": value, "overwrite": False})
         self._fn_registered.add(fid)
 
     def build_args(self, args: tuple, kwargs: dict):
@@ -787,6 +840,7 @@ class CoreClient(DeferredRefDecs):
     def submit_task(self, spec: TaskSpec,
                     temp_refs: Optional[List["ObjectRef"]] = None
                     ) -> List[ObjectRef]:
+        self._take_submit_credit()
         self._stamp_trace_ctx(spec)
         self._stamp_submit(spec)
         with self._ref_lock:

@@ -44,7 +44,7 @@ from .config import GlobalConfig
 #: triggers the controller fires automatically (manual grabs use "manual")
 AUTO_TRIGGERS = ("node_suspect", "node_dead", "controller_failover",
                  "drain_deadline", "elastic_repair", "oom_kill",
-                 "compile_storm", "slo_breach")
+                 "compile_storm", "slo_breach", "overload")
 
 
 def recorder_dir() -> str:

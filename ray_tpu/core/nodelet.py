@@ -139,6 +139,11 @@ class Nodelet:
         self._demand_seq = 0
         self.zygote: Optional[worker_zygote.ZygoteClient] = None
         self._stopping = False
+        # controller overload state + submission credits (both absorbed
+        # from heartbeat replies): brownout pauses optional pushes, soft
+        # rations them by the credit window
+        self._ctl_overload = "normal"
+        self._ctl_credits = 0
         # Drain mode (planned departure): no new leases or actor starts
         # are granted here; in-flight work finishes and sole-copy
         # objects evacuate to peers before the controller deregisters us.
@@ -284,6 +289,7 @@ class Nodelet:
         handlers = dict(self.server.handlers)
         handlers["pub:nodes"] = self._on_nodes_event
         handlers["pub:chaos"] = self._on_chaos_event
+        handlers["pub:_resync"] = self._on_pub_resync
         self.controller, _ep, st = await rpc.connect_leader(
             self.controller_addr, handlers=handlers,
             retries=GlobalConfig.rpc_connect_retries)
@@ -421,6 +427,21 @@ class Nodelet:
             if data["node_id"] == self.node_id.hex():
                 self.draining = True
 
+    async def _on_pub_resync(self, conn, channel):
+        """The publisher's bounded buffer overflowed and dropped events
+        we will never see: invalidate the incremental state so the next
+        heartbeat pulls a full snapshot instead of trusting a view with
+        holes in it."""
+        if channel == "nodes":
+            self.view_version = -1   # forces a full-view delta next beat
+        elif channel == "chaos":
+            try:
+                plan = await self.controller.call("chaos_plan", {})
+                if plan and (fi.ACTIVE is None or fi.ACTIVE.raw != plan):
+                    fi.arm(plan)
+            except (rpc.RpcError, OSError):
+                pass
+
     async def _on_chaos_event(self, conn, data):
         """Runtime fault-plan push: re-arm locally and fan out to every
         live worker on this node (workers hold no controller
@@ -472,10 +493,19 @@ class Nodelet:
                 }
                 if self._clock_offset is not None:
                     hb["clock_offset"] = round(self._clock_offset, 6)
+                if self._ctl_credits <= 0:
+                    hb["want_credits"] = True
                 t0_wall = time.time()
                 reply = await self.controller.call("heartbeat", hb,
                                                    timeout=5)
                 self._note_clock(t0_wall, time.time(), reply)
+                if isinstance(reply, dict):
+                    # flow control rides the beat: overload state gates
+                    # optional pushes, credits ration them under "soft"
+                    self._ctl_overload = reply.get(
+                        "overload", self._ctl_overload)
+                    if "credits" in reply:
+                        self._ctl_credits = int(reply["credits"])
                 if reply and reply.get("_not_leader"):
                     # beat landed on a deposed/standby controller: find
                     # the current leader and re-register there
@@ -624,6 +654,15 @@ class Nodelet:
             return
         while True:
             await asyncio.sleep(GlobalConfig.trace_flush_interval_s)
+            # brownout: trace flushes are optional work — hold the spans
+            # locally (overwrite semantics, nothing lost) until recovery;
+            # soft: ration flushes by the heartbeat credit window
+            if self._ctl_overload == "brownout":
+                continue
+            if self._ctl_overload == "soft":
+                if self._ctl_credits <= 0:
+                    continue
+                self._ctl_credits -= 1
             payload = tracing.kv_payload()
             if payload is None:
                 continue
@@ -1886,6 +1925,7 @@ class Nodelet:
         return {"proc": f"nodelet@{self.node_id.hex()[:8]}",
                 "addr": self.address,
                 "ops": rpc.attribution_rows(),
+                "lanes": rpc.lane_stats(),
                 "loop_lag": {
                     "ewma_ms": getattr(self, "_lag_ewma", 0.0) * 1e3,
                     "max_ms": getattr(self, "_lag_max", 0.0) * 1e3}}

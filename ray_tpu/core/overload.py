@@ -1,0 +1,181 @@
+"""Controller overload protection: watermark state machine + admission.
+
+The reference controller (GCS) leans on replicated Redis to absorb load
+spikes (arXiv:1712.05889 §4.2); this controller is one asyncio loop and
+must degrade gracefully instead of stalling heartbeats or blowing
+memory.  Three cooperating mechanisms, all fed by the priority-lane
+queue table in ``core/rpc.py``:
+
+* **Watermark state machine** — ``normal -> soft -> brownout`` off the
+  process RSS (``/proc/self/statm``; no psutil in the image) and the
+  bytes queued across the RPC lanes.  Recovery re-arms automatically:
+  dropping below the soft watermarks returns to ``normal`` on the next
+  evaluator tick.
+* **Admission shedding** — under brownout every bulk-lane REQUEST is
+  answered with an in-band ``{"_overload": True, "retry_after_s": ...}``
+  reply (the ``_not_leader`` pattern); clients replay with full-jitter
+  backoff or surface the typed ``ControlPlaneOverloadError``.  The
+  chaos site ``controller.admission_shed`` can force or suppress the
+  decision — forced sheds still never touch the liveness lane, which is
+  the invariant the chaos suite pins.
+* **Credit grants** — drivers size their submission window from
+  ``credit_request`` replies, nodelets from a field on the heartbeat
+  reply: a full ``flow_credit_window`` when normal, a quarter when
+  soft, zero under brownout (clients buffer locally until recovery).
+
+Brownout entry fires the ``overload`` flight-recorder trigger with the
+lane/credit tables in the bundle's meta.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Any, Dict, Optional
+
+from . import rpc, runtime_metrics as rtm
+from .config import GlobalConfig
+
+try:
+    _PAGE = os.sysconf("SC_PAGE_SIZE")
+except (ValueError, OSError, AttributeError):
+    _PAGE = 4096
+
+#: watermark states in severity order (index == wire value)
+STATES = ("normal", "soft", "brownout")
+
+
+def process_rss_mb() -> float:
+    """Resident set size of THIS process in MB (0.0 where /proc is
+    unavailable — the RSS watermarks simply never trip there)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE / 1e6
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
+class OverloadManager:
+    """One per controller; evaluated every ``overload_eval_interval_s``."""
+
+    def __init__(self, controller: Any):
+        self.c = controller
+        self.state = "normal"
+        self.rss_mb = 0.0
+        self.queued_bytes = 0
+        self._shed: Dict[str, int] = {}          # op -> shed count
+        self._credits_granted = 0
+        self._entered_mono = time.monotonic()
+
+    # ---------------------------------------------------------- evaluation
+    def state_index(self) -> int:
+        return STATES.index(self.state)
+
+    def _classify(self) -> str:
+        rss, qb = self.rss_mb, self.queued_bytes
+        cfg = GlobalConfig
+        if (0 < cfg.overload_hard_rss_mb <= rss) or \
+                (0 < cfg.overload_queued_hard_bytes <= qb):
+            return "brownout"
+        if (0 < cfg.overload_soft_rss_mb <= rss) or \
+                (0 < cfg.overload_queued_soft_bytes <= qb):
+            return "soft"
+        return "normal"
+
+    def evaluate_once(self) -> None:
+        """One watermark tick: sample, classify, act on transitions.
+        Leaving brownout requires dropping below the SOFT watermarks
+        (the brownout->soft step is the hysteresis)."""
+        self.rss_mb = process_rss_mb()
+        lanes = rpc.lane_stats()
+        self.queued_bytes = sum(ln["queued_bytes"] for ln in lanes.values())
+        new = self._classify()
+        if new == self.state:
+            return
+        old, self.state = self.state, new
+        self._entered_mono = time.monotonic()
+        rtm.OVERLOAD_STATE.set(self.state_index())
+        if new == "brownout":
+            reason = (f"rss={self.rss_mb:.0f}MB "
+                      f"queued={self.queued_bytes}B")
+            self.c._emit_event(
+                "WARNING", "overload",
+                f"controller entered brownout ({reason}): shedding bulk "
+                f"ops, optional work paused", state=new, **self.snapshot())
+            self.c.flight.trigger("overload", reason,
+                                  overload=self.snapshot())
+        elif old == "brownout":
+            self.c._emit_event(
+                "INFO", "overload",
+                f"controller left brownout -> {new} "
+                f"(rss={self.rss_mb:.0f}MB queued={self.queued_bytes}B)",
+                state=new)
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(GlobalConfig.overload_eval_interval_s)
+            try:
+                self.evaluate_once()
+            except Exception:
+                pass  # the protector must never hurt the protected
+
+    # ----------------------------------------------------------- admission
+    def admit(self, op: str) -> Optional[float]:
+        """Admission decision for one inbound REQUEST: ``None`` admits,
+        a float sheds with that Retry-After.  Liveness-lane ops are
+        NEVER shed — not even by a chaos-forced storm."""
+        lane = rpc.lane_for(op)
+        forced = False
+        from ..util import fault_injection as fi
+        if fi.ACTIVE is not None:
+            act = fi.ACTIVE.point("controller.admission_shed", op)
+            if act is not None:
+                if act["action"] == "suppress":
+                    return None
+                forced = act["action"] == "force"
+        if lane == "liveness":
+            return None
+        if not forced and (self.state != "brownout" or lane != "bulk"):
+            return None
+        self._shed[op] = self._shed.get(op, 0) + 1
+        rtm.OVERLOAD_SHED.inc(tags={"op": op})
+        return GlobalConfig.overload_shed_retry_after_s
+
+    # ------------------------------------------------------------- credits
+    def credits_for(self, want: int = 0) -> int:
+        """Submission-credit grant for one requesting client under the
+        current state (zero == buffer locally and re-ask later).  A
+        positive ``want`` caps the grant — a client asking for a small
+        window shouldn't be handed the full one."""
+        window = max(1, GlobalConfig.flow_credit_window)
+        if want > 0:
+            window = min(window, want)
+        if self.state == "normal":
+            n = window
+        elif self.state == "soft":
+            n = max(1, window // 4)
+        else:
+            n = 0
+        self._credits_granted += n
+        return n
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> Dict[str, Any]:
+        """The lane/credit tables for rpc_attribution and the overload
+        flight bundle."""
+        return {
+            "overload_state": self.state,
+            "rss_mb": round(self.rss_mb, 1),
+            "queued_bytes": self.queued_bytes,
+            "in_state_s": round(time.monotonic() - self._entered_mono, 3),
+            "lanes": rpc.lane_stats(),
+            "shed": dict(self._shed),
+            "credits_granted": self._credits_granted,
+            "watermarks": {
+                "soft_rss_mb": GlobalConfig.overload_soft_rss_mb,
+                "hard_rss_mb": GlobalConfig.overload_hard_rss_mb,
+                "soft_queued_bytes": GlobalConfig.overload_queued_soft_bytes,
+                "hard_queued_bytes": GlobalConfig.overload_queued_hard_bytes,
+            },
+        }

@@ -19,6 +19,7 @@ import struct
 import threading
 import time
 import traceback
+from collections import deque as _deque
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
@@ -117,11 +118,92 @@ def attribution_rows(stats: Optional[Dict[str, dict]] = None) -> list:
 def reset_dispatch_stats() -> None:
     _dispatch_stats.clear()
 
+
+# ------------------------------------------------------- priority RPC lanes
+# Every inbound REQUEST/NOTIFY is classified into one of three lanes and
+# dispatched from per-connection lane queues in strict priority order, so
+# a controller digesting a bulk kv_put flood still STARTS heartbeat
+# handlers immediately (the overload-resilience half of the reference's
+# control-store design — arXiv:1712.05889 §4.2; replicated Redis absorbs
+# this for the reference, our single asyncio loop must self-protect).
+# REPLY/ERROR frames never queue: a client's pending-call futures resolve
+# straight from the read loop regardless of inbound request backlog.
+
+#: dispatch priority order (index == priority, 0 highest)
+LANES = ("liveness", "control", "bulk")
+
+#: ops whose timeliness IS cluster health: heartbeats, liveness probes,
+#: HA leases, flow-control credit grants.  Never queued behind anything.
+#: NB: "ping" stays in the control lane — sync_borrows uses its reply as
+#: a FIFO fence behind ref_inc notifies, which only holds same-lane.
+_LIVENESS_OPS = frozenset({
+    "heartbeat", "ha_lease", "ha_status", "peer_probe",
+    "probe_peer_now", "credit_request", "drain_status"})
+
+#: high-volume payload/telemetry ops: blob ships, trace/metrics pushes,
+#: pubsub fan-in, observability pulls.  Everything else (leases, actor
+#: FSM, WAL-backed mutations, ...) defaults to the "control" lane.
+_BULK_OPS = frozenset({
+    "kv_put", "publish", "task_state", "task_state_batch",
+    "serve_metrics", "metrics_text", "metrics_history", "task_spans",
+    "tail_log", "node_stats", "stats", "chaos_injected", "report_event",
+    "pub_batch"})
+
+
+def lane_for(method: str) -> str:
+    """Lane classification for an RPC op (pubsub pushes count as bulk)."""
+    if method in _LIVENESS_OPS:
+        return "liveness"
+    if method in _BULK_OPS or method.startswith("pub:"):
+        return "bulk"
+    return "control"
+
+
+def _new_lane_stats() -> Dict[str, dict]:
+    return {lane: {"depth": 0, "queued_bytes": 0, "dispatched": 0,
+                   "queued_s": 0.0, "queued_s_max": 0.0}
+            for lane in LANES}
+
+
+#: per-process lane table (all connections fold in here — the per-lane
+#: depth/latency gauges the attribution plumbing and the overload
+#: watermark evaluator read)
+_lane_stats: Dict[str, dict] = _new_lane_stats()
+
+
+def lane_stats() -> Dict[str, dict]:
+    """Snapshot of this process's per-lane queue table (value copies)."""
+    return {lane: dict(st) for lane, st in _lane_stats.items()}
+
+
+def _bulk_cap() -> int:
+    """In-flight bulk-dispatch bound per connection (config-read at use:
+    this module sits below core.config in the import graph)."""
+    try:
+        from .config import GlobalConfig as _cfg
+        return max(1, _cfg.rpc_bulk_inflight)
+    except Exception:
+        return 64
+
+
+def reset_lane_stats() -> None:
+    # mutate in place: live connections may still decrement depth for
+    # items they enqueued before the reset
+    for st in _lane_stats.values():
+        st.update(depth=0, queued_bytes=0, dispatched=0,
+                  queued_s=0.0, queued_s_max=0.0)
+
 # Armed fault-injection plan (util/fault_injection.py sets/clears this —
 # this module sits below ray_tpu.util in the import graph and cannot
 # import it at module scope).  None == chaos disabled: hot paths pay one
 # module-global None check and nothing else.
 _chaos = None
+
+
+def _jitter() -> float:
+    """Full-jitter multiplier for Retry-After sleeps."""
+    import random
+    return random.uniform(0.5, 1.5)
 
 
 class RpcError(Exception):
@@ -155,6 +237,16 @@ class Connection:
         # chaos layer's peer label, so a fault plan can sever the A→B
         # direction of a link while B→A keeps working
         self.peer_label: str = ""
+        # Priority lane queues: the read loop ENQUEUES inbound
+        # REQUEST/NOTIFY frames, the pump STARTS their dispatches in
+        # lane-priority order (handlers still run concurrently — many
+        # are long-polls).  Bulk dispatches are additionally bounded
+        # in-flight so a blob flood cannot swamp the loop.
+        self._lanes: Dict[str, "deque"] = {ln: _deque() for ln in LANES}
+        self._lane_wake = asyncio.Event()
+        self._lane_holds: Dict[str, float] = {}   # lane -> perf_counter until
+        self._bulk_inflight = 0
+        self._pump_task = asyncio.ensure_future(self._lane_pump())
         self._task = asyncio.ensure_future(self._read_loop())
 
     @property
@@ -221,12 +313,15 @@ class Connection:
                     raise RpcError(f"frame too large: {length}")
                 payload = await self.reader.readexactly(length)
                 seq, kind, method, data = msgpack.unpackb(payload, raw=False)
-                if kind == REQUEST:
-                    asyncio.ensure_future(
-                        self._dispatch(seq, method, data, length))
-                elif kind == NOTIFY:
-                    asyncio.ensure_future(
-                        self._dispatch(0, method, data, length))
+                if kind in (REQUEST, NOTIFY):
+                    lane = lane_for(method)
+                    st = _lane_stats[lane]
+                    st["depth"] += 1
+                    st["queued_bytes"] += length
+                    self._lanes[lane].append(
+                        (seq if kind == REQUEST else 0, method, data,
+                         length, time.perf_counter()))
+                    self._lane_wake.set()
                 elif kind in (REPLY, ERROR):
                     fut = self._pending.pop(seq, None)
                     if fut is not None and not fut.done():
@@ -240,6 +335,84 @@ class Connection:
             pass
         finally:
             await self._shutdown()
+
+    def _pop_next(self):
+        """Highest-priority dispatchable item, or (None, None).
+
+        A lane is skipped while chaos holds it (``rpc.lane_starve``) or,
+        for bulk, while the in-flight dispatch cap is reached — lower
+        lanes keep flowing, which is the whole point."""
+        now = time.perf_counter()
+        for lane in LANES:
+            q = self._lanes[lane]
+            if not q:
+                continue
+            if lane == "bulk" and self._bulk_inflight >= _bulk_cap():
+                continue
+            hold = self._lane_holds.get(lane)
+            if hold is not None:
+                if hold > now:
+                    continue
+                # hold served: admit ONE item before re-evaluating chaos,
+                # so a persistent latency rule THROTTLES the lane (one
+                # dispatch per delay_s) instead of starving it outright
+                del self._lane_holds[lane]
+            elif _chaos is not None:
+                act = _chaos.point("rpc.lane_starve", lane,
+                                   peer=self.peer_label)
+                if act is not None and act.get("delay_s"):
+                    self._lane_holds[lane] = now + act["delay_s"]
+                    continue
+            return q.popleft(), lane
+        return None, None
+
+    def _hold_timeout(self) -> Optional[float]:
+        """Seconds until the earliest chaos lane-hold on a NON-EMPTY
+        lane expires (None: nothing time-gated, wait for the event)."""
+        now = time.perf_counter()
+        pending = [until - now for lane, until in self._lane_holds.items()
+                   if until > now and self._lanes[lane]]
+        return max(0.0, min(pending)) if pending else None
+
+    async def _lane_pump(self):
+        """Start queued dispatches in lane-priority order.  Dispatches
+        themselves run as independent tasks (handlers long-poll); only
+        the START order and the bulk in-flight bound are serialized
+        here."""
+        try:
+            while True:
+                item, lane = self._pop_next()
+                if item is None:
+                    self._lane_wake.clear()
+                    item, lane = self._pop_next()  # re-check: lost-wakeup
+                    if item is None:
+                        timeout = self._hold_timeout()
+                        try:
+                            await asyncio.wait_for(self._lane_wake.wait(),
+                                                   timeout)
+                        except asyncio.TimeoutError:
+                            pass
+                        continue
+                seq, method, data, length, t_enq = item
+                st = _lane_stats[lane]
+                st["depth"] -= 1
+                st["queued_bytes"] -= length
+                waited = time.perf_counter() - t_enq
+                st["dispatched"] += 1
+                st["queued_s"] += waited
+                if waited > st["queued_s_max"]:
+                    st["queued_s_max"] = waited
+                fut = asyncio.ensure_future(
+                    self._dispatch(seq, method, data, length))
+                if lane == "bulk":
+                    self._bulk_inflight += 1
+                    fut.add_done_callback(self._bulk_done)
+        except asyncio.CancelledError:
+            pass
+
+    def _bulk_done(self, _fut) -> None:
+        self._bulk_inflight -= 1
+        self._lane_wake.set()   # a bulk slot freed: re-check the queues
 
     async def _dispatch(self, seq: int, method: str, data: Any,
                         nbytes: int = 0):
@@ -270,6 +443,15 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        self._pump_task.cancel()
+        # un-count still-queued items so the module lane table doesn't
+        # leak depth/bytes from connections that died with a backlog
+        for lane, q in self._lanes.items():
+            st = _lane_stats[lane]
+            while q:
+                _s, _m, _d, length, _t = q.popleft()
+                st["depth"] -= 1
+                st["queued_bytes"] -= length
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost("peer disconnected"))
@@ -415,6 +597,12 @@ async def connect(host: str, port: int,
                 h = _h.get("pub:" + ch)
                 if h is not None:
                     await h(conn, ev)
+            # overflow at the publisher dropped this subscriber's oldest
+            # events: tell it which channels need a snapshot resync
+            rs = _h.get("pub:_resync")
+            if rs is not None:
+                for ch in data.get("resync", ()):
+                    await rs(conn, ch)
             return True
         handlers = {**handlers, "pub_batch": _pub_batch}
     # Capped exponential backoff with FULL jitter between attempts: a
@@ -666,6 +854,17 @@ class BlockingClient:
                 if self._fail_fast or _time.monotonic() > deadline:
                     raise
                 _time.sleep(bo.next_delay())
+                continue
+            if type(r) is dict and r.get("_overload"):
+                # typed pushback: the controller shed this bulk op under
+                # overload — honor Retry-After with full jitter (same
+                # spread-the-herd rationale as the reconnect backoff)
+                ra = float(r.get("retry_after_s") or 1.0)
+                remaining = deadline - _time.monotonic()
+                if self._fail_fast or remaining <= 0:
+                    from ..exceptions import ControlPlaneOverloadError
+                    raise ControlPlaneOverloadError(method, ra)
+                _time.sleep(min(remaining, ra * _jitter() + bo.next_delay()))
                 continue
             if type(r) is dict and r.get("_not_leader"):
                 self._epoch = max(self._epoch, int(r.get("epoch", 0) or 0))

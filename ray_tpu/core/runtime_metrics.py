@@ -69,6 +69,34 @@ ACTORS_RESTARTED = m.Counter(
 PUBSUB_MESSAGES = m.Counter(
     "ray_tpu_pubsub_messages_total",
     "Messages published on controller channels", ("channel",))
+PUBSUB_DROPPED = m.Counter(
+    "ray_tpu_pubsub_dropped_total",
+    "Pubsub events dropped (oldest-first) because a subscriber's "
+    "bounded buffer overflowed (pubsub_max_buffer); the subscriber is "
+    "flagged for snapshot resync on its next flush", ("channel",))
+RPC_LANE_DEPTH = m.Gauge(
+    "ray_tpu_rpc_lane_depth",
+    "Inbound RPC frames currently queued per priority lane "
+    "(liveness | control | bulk) in this process", ("lane", "proc"))
+RPC_LANE_QUEUED_BYTES = m.Gauge(
+    "ray_tpu_rpc_lane_queued_bytes",
+    "Payload bytes currently queued per RPC priority lane — the "
+    "overload watermark evaluator's queued-bytes signal", ("lane", "proc"))
+RPC_LANE_DISPATCHED = m.Counter(
+    "ray_tpu_rpc_lane_dispatched_total",
+    "RPC dispatches started per priority lane", ("lane", "proc"))
+RPC_LANE_WAIT_SECONDS = m.Counter(
+    "ray_tpu_rpc_lane_queue_wait_seconds_total",
+    "Cumulative time RPC frames waited in their lane queue before "
+    "dispatch started", ("lane", "proc"))
+OVERLOAD_STATE = m.Gauge(
+    "ray_tpu_overload_state",
+    "Controller overload watermark state (0=normal 1=soft 2=brownout)",
+    ())
+OVERLOAD_SHED = m.Counter(
+    "ray_tpu_overload_shed_total",
+    "Bulk-lane ops shed with the typed retriable pushback under "
+    "overload (brownout or a chaos-forced shed)", ("op",))
 NODE_DRAINS = m.Counter(
     "ray_tpu_node_drains_total",
     "Graceful node drains by outcome (completed | deadline | error)",
@@ -447,6 +475,21 @@ def fold_rpc_dispatch() -> None:
               direction="out")
 
 
+def fold_rpc_lanes() -> None:
+    """Fold this process's per-lane RPC queue table (core/rpc.py) into
+    the Prometheus battery — gauges set directly, monotonic totals
+    delta-folded like the dispatch table."""
+    from ..util import tracing
+    from . import rpc
+    proc = tracing.proc_label()
+    for lane, st in rpc.lane_stats().items():
+        RPC_LANE_DEPTH.set(st["depth"], {"lane": lane, "proc": proc})
+        RPC_LANE_QUEUED_BYTES.set(st["queued_bytes"],
+                                  {"lane": lane, "proc": proc})
+        _fold(RPC_LANE_DISPATCHED, st["dispatched"], lane=lane, proc=proc)
+        _fold(RPC_LANE_WAIT_SECONDS, st["queued_s"], lane=lane, proc=proc)
+
+
 def fold_wal_timing(pstore: Any) -> None:
     if pstore is None:
         return
@@ -483,12 +526,17 @@ def snapshot_nodelet(nl: Any) -> None:
     PRIMARY_PINS.set(len(nl._primary_pins), {"node": nid})
     LOOP_LAG.set(getattr(nl, "_lag_ewma", 0.0), {"node": nid})
     fold_rpc_dispatch()
+    fold_rpc_lanes()
 
 
 def snapshot_controller(ctl: Any) -> None:
     """Refresh controller gauges from live state."""
     fold_rpc_dispatch()
+    fold_rpc_lanes()
     fold_wal_timing(ctl.pstore)
+    ovl = getattr(ctl, "overload", None)
+    if ovl is not None:
+        OVERLOAD_STATE.set(ovl.state_index())
     LOOP_LAG.set(getattr(ctl, "_lag_ewma", 0.0), {"node": "controller"})
     alive = sum(1 for r in ctl.nodes.values()
                 if getattr(r.view, "alive", False))

@@ -327,9 +327,32 @@ class WorkerRuntime:
                 "kv_get", {"ns": FN_NAMESPACE, "key": fid})
             if blob is None:
                 raise exceptions.RayTpuError(f"function {fid.hex()[:12]} not registered")
+            from . import kvref
+            if kvref.is_ref(blob):
+                # big blob diverted off the control plane: the KV holds
+                # only a marker, the payload rides the object plane
+                blob = await self._fetch_kvref(kvref.unpack(blob))
             fn = serialization.loads_function(blob)
             self.fn_cache[fid] = fn
         return fn
+
+    async def _fetch_kvref(self, oid: bytes) -> bytes:
+        """Materialize a KV ref marker's payload from the object plane
+        (local shm hit, else nodelet pull)."""
+        view = self.store.get(oid, timeout_ms=0)
+        if view is None:
+            r = await self.nodelet.call("pull", {"object_id": oid},
+                                        timeout=60)
+            if not r.get("ok"):
+                raise exceptions.ObjectLostError(oid.hex(), r.get("error", ""))
+            view = self.store.get(oid, timeout_ms=5000)
+            if view is None:
+                raise exceptions.ObjectLostError(oid.hex(),
+                                                 "pull raced eviction")
+        try:
+            return serialization.deserialize(view)
+        finally:
+            self.store.release(oid)
 
     async def _ctl_call_retry(self, method: str, data, timeout: float = 30.0):
         """Controller call that rides out a controller restart/failover:
@@ -342,7 +365,16 @@ class WorkerRuntime:
         while True:
             try:
                 conn = await self._controller_conn()
-                return await conn.call(method, data, timeout=timeout)
+                r = await conn.call(method, data, timeout=timeout)
+                if type(r) is dict and r.get("_overload"):
+                    # controller shedding bulk ops: honor Retry-After
+                    ra = float(r.get("retry_after_s") or 1.0)
+                    if time.monotonic() + ra > deadline:
+                        raise exceptions.ControlPlaneOverloadError(
+                            method, ra)
+                    await asyncio.sleep(ra * rpc._jitter())
+                    continue
+                return r
             except (rpc.ConnectionLost, OSError):
                 if time.monotonic() > deadline:
                     raise

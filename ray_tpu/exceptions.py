@@ -70,6 +70,22 @@ class ReplicaUnavailableError(RayTpuError):
             f"(retry after ~{retry_after_s:g}s)")
 
 
+class ControlPlaneOverloadError(RayTpuError):
+    """The controller shed a bulk-lane op under overload (brownout).
+
+    Typed retriable pushback carrying ``Retry-After``: clients replay
+    the op with full-jitter backoff until the controller's watermark
+    state machine recovers; only a shed that outlives the whole
+    failover/backoff budget surfaces as this exception."""
+
+    def __init__(self, op: str, retry_after_s: float = 1.0):
+        self.op = op
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"control plane overloaded: {op!r} shed "
+            f"(retry after ~{retry_after_s:g}s)")
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -94,6 +94,20 @@ site                        actions
                             probe report the peer unreachable — feeds
                             false negatives into the connectivity
                             matrix the suspect/quarantine logic folds
+``controller.admission_shed`` ``force`` sheds the matched op (typed
+                            ``_overload`` pushback) regardless of the
+                            watermark state, ``suppress`` admits it even
+                            under brownout — key is the op name.
+                            Liveness-lane ops are never shed, forced or
+                            not (core/overload.py pins the invariant)
+``rpc.lane_starve``         ``delay``/``latency`` holds dispatch of
+                            ONE priority lane (key: ``liveness`` |
+                            ``control`` | ``bulk``) at the receiving
+                            connection; a persistent rule THROTTLES
+                            the lane to one dispatch per ``delay_s``
+                            (an expired hold admits one item before
+                            chaos re-evaluates) — proves the other
+                            lanes keep flowing past a wedged one
 ==========================  =====================================================
 
 Peer-directed sites (``rpc.send``, ``object.transfer_fetch``,
@@ -158,6 +172,8 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "controller.lease_renew": None,
     "object.transfer_fetch": None,
     "nodelet.peer_probe": None,
+    "controller.admission_shed": frozenset({"force", "suppress"}),
+    "rpc.lane_starve": frozenset(),
 }
 _UNIVERSAL_ACTIONS = frozenset({"delay", "latency"})
 _RULE_KEYS = frozenset({"site", "action", "match", "delay_s", "once",
