@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
 	attr chaos drain failover spec elastic ha partition autoscale \
 	autoscale-bench serve-breakdown profile lint lint-fast overload \
-	clean
+	diskfault clean
 
 all: native cpp
 
@@ -47,6 +47,13 @@ attr:
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py \
 		tests/test_controller_ft.py -q
+
+# Storage-fault suite (PR-18): filesystem chaos sites (WAL / spill /
+# checkpoint / flight-recorder), WAL-poison self-fence -> standby
+# promotion, spill CRC + ENOSPC backpressure, checkpoint keep-previous,
+# disk-health watermarks, and the fn_lost re-registration path.
+diskfault:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_diskfault.py -q
 
 # Overload-protection suite (PR-17): priority RPC lanes, watermark
 # state machine + admission shedding, credit flow control, bounded

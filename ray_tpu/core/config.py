@@ -224,6 +224,25 @@ _d("dashboard_agent", bool, True,
    "affects the nodelet; the head falls back to nodelet scraping.")
 _d("spill_check_interval_s", float, 0.5,
    "Nodelet store-pressure check period; 0 disables proactive spilling.")
+_d("spill_backpressure_retries", int, 8,
+   "Backpressure budget when a capacity-pressure spill hits a disk "
+   "fault (ENOSPC/EIO): the put retries the store write this many "
+   "times (the store may drain between attempts) before surfacing the "
+   "typed retriable StorageDegradedError — never a task failure.")
+_d("spill_backpressure_delay_s", float, 0.25,
+   "Base delay between spill-backpressure retries (full jitter).")
+_d("disk_monitor_interval_s", float, 1.0,
+   "Nodelet disk-health check period (statvfs on the spill root, off "
+   "the event loop); 0 disables the monitor.  State rides heartbeats "
+   "into state.nodes() / ray-tpu status.")
+_d("disk_low_water_frac", float, 0.85,
+   "Disk usage fraction above which the node is flagged LOW: it stops "
+   "being picked as a lease spill-target by peers (soft filter).")
+_d("disk_red_frac", float, 0.95,
+   "Disk usage fraction above which the node is RED: proactive spill "
+   "stops (spilling would trade memory pressure for certain ENOSPC) "
+   "and the controller fires the disk_pressure flight-recorder "
+   "trigger.")
 _d("log_to_driver", bool, True, "Forward worker stdout/stderr lines to the driver.")
 _d("metrics_report_interval_s", float, 2.0, "Worker metric push period.")
 _d("lineage_cache_size", int, 100000,

@@ -21,6 +21,7 @@ import time
 from typing import Any, Dict, List, Optional, Set
 
 from . import rpc, runtime_metrics as rtm, spill
+from ..exceptions import WalWriteError
 from .config import GlobalConfig
 from .scheduling import NodeView, hybrid_policy, pack_bundles
 from .task_spec import ResourceSet, TaskSpec
@@ -92,6 +93,9 @@ class NodeRecord:
         # resource bundles of lease requests WAITING on this node
         # (heartbeat-reported); the autoscaler's load signal
         self.demand: List[Dict[str, float]] = []
+        # last heartbeat-reported disk-health dict ({state, used_frac});
+        # the state alone also rides the synced view (view.disk)
+        self.disk: Optional[Dict[str, Any]] = None
         # heartbeat-estimated wall-clock offset, node − controller:
         # SUBTRACT it from the node's timestamps to land on the
         # controller clock (RTT-midpoint sample, EWMA-smoothed nodelet-
@@ -211,9 +215,20 @@ class Controller:
 
     # ------------------------------------------------------------ durability
     def _p(self, *record):
-        """Append one mutation to the WAL (no-op without persistence)."""
+        """Append one mutation to the WAL (no-op without persistence).
+
+        A WAL write/fsync failure poisons the store (fsyncgate); the
+        leader self-fences RIGHT HERE — before the mutation could be
+        acked — and the error propagates so no caller treats the
+        mutation as durable.  The RPC gate converts it to an in-band
+        ``_not_leader`` so clients re-dial and find the promoted
+        standby."""
         if self.pstore is not None:
-            self.pstore.append(*record)
+            try:
+                self.pstore.append(*record)
+            except WalWriteError as e:
+                self.ha.self_fence(str(e))
+                raise
 
     @staticmethod
     def _actor_to_disk(rec: "ActorRecord") -> dict:
@@ -336,13 +351,20 @@ class Controller:
             if ra is not None:
                 return {"_overload": True, "retry_after_s": ra,
                         "op": _name}
-            if _name in HA_EXEMPT or not ha.sync_gate_active():
-                return await _fn(conn, data)
-            seq0 = self.pstore.seq
-            result = await _fn(conn, data)
-            if self.pstore.seq > seq0:
-                await ha.wait_replicated(self.pstore.seq)
-            return result
+            try:
+                if _name in HA_EXEMPT or not ha.sync_gate_active():
+                    return await _fn(conn, data)
+                seq0 = self.pstore.seq
+                result = await _fn(conn, data)
+                if self.pstore.seq > seq0:
+                    await ha.wait_replicated(self.pstore.seq)
+                return result
+            except WalWriteError:
+                # poisoned WAL: _p already self-fenced; answer in-band
+                # so the client's failover machinery re-dials instead of
+                # surfacing a transport error for an unacked mutation
+                return {"_not_leader": True, "leader": ha.leader_addr,
+                        "epoch": ha.epoch}
         return gated
 
     # ------------------------------------------------------------- chaos
@@ -698,6 +720,35 @@ class Controller:
             if unreach != rec.view.unreachable:
                 rec.view.unreachable = unreach
                 self._bump_view(nid)
+        # fold the disk-health watermark into the synced view: every
+        # nodelet's scheduler stops picking red peers as spill-back
+        # targets within one heartbeat period
+        disk = data.get("disk")
+        if isinstance(disk, dict):
+            rec.disk = disk
+            state = disk.get("state", "ok")
+            if state != rec.view.disk:
+                prev = rec.view.disk
+                rec.view.disk = state
+                self._bump_view(nid)
+                if state == "red":
+                    self._emit_event(
+                        "WARN", "controller",
+                        f"node {nid[:12]} disk red "
+                        f"({disk.get('used_frac', 0):.2f} used): spill "
+                        f"target excluded, proactive spill stopped",
+                        node_id=nid)
+                    self.flight.trigger(
+                        "disk_pressure",
+                        f"node {nid[:12]} at "
+                        f"{disk.get('used_frac', 0):.2f} disk usage",
+                        node_id=nid[:12])
+                elif prev == "red":
+                    self._emit_event(
+                        "INFO", "controller",
+                        f"node {nid[:12]} disk recovered to {state} "
+                        f"({disk.get('used_frac', 0):.2f} used)",
+                        node_id=nid)
         new_avail = ResourceSet(data["available"])
         new_total = ResourceSet(data["total"])
         if (new_avail.to_dict() != rec.view.available.to_dict()
@@ -751,6 +802,10 @@ class Controller:
                 "peer_probe_fanout": GlobalConfig.peer_probe_fanout,
             }
             row["clock_offset_s"] = round(rec.clock_offset, 6)
+            disk = getattr(rec, "disk", None)
+            if disk:
+                row["disk_used_frac"] = round(
+                    float(disk.get("used_frac", 0.0)), 4)
             if nid in self.suspects:
                 row["suspect_for_s"] = round(now - self.suspects[nid], 3)
                 row["peers_reaching"] = sorted(

@@ -228,6 +228,12 @@ class CoreClient(DeferredRefDecs):
         # fid -> ObjectRef for function blobs diverted to the object
         # store (core/kvref.py): the owner must keep the payload alive
         self._fn_blob_refs: Dict[bytes, Any] = {}
+        # fid -> raw serialized blob, kept for re-registration when a
+        # worker reports the kvref payload lost (`fn_lost` replies)
+        self._fn_blobs: Dict[bytes, bytes] = {}
+        # tid -> count of fn_lost requeues (bounded: a blob that stays
+        # lost after re-registration must not requeue forever)
+        self._fn_requeues: Dict[bytes, int] = {}
         # credit-based submission flow control (core/overload.py): the
         # window refills via `credit_request` when it runs out
         self._credits = 0
@@ -466,7 +472,7 @@ class CoreClient(DeferredRefDecs):
             except store_client.StoreFullError:
                 # spill to external storage (reference: plasma → spill
                 # workers → ExternalStorage; here the writer spills inline)
-                path = spill.write_object(oid.binary(), parts)
+                path = self._spill_backpressured(oid.binary(), parts)
                 self.controller.call(
                     "kv_put", {**spill.kv_entry(oid.binary()),
                                "value": path.encode()})
@@ -509,11 +515,30 @@ class CoreClient(DeferredRefDecs):
             with self._ref_lock:
                 self._plasma_oids.add(oid)
         except store_client.StoreFullError:
-            path = spill.write_object(oid, parts)
+            path = self._spill_backpressured(oid, parts)
             self.controller.call(
                 "kv_put", {**spill.kv_entry(oid), "value": path.encode()})
             self._spilled_paths[oid] = path
         self.memory_store.put_in_plasma_marker(oid)
+
+    def _spill_backpressured(self, oid: bytes, parts) -> str:
+        """Writer-inline spill with put backpressure: a disk fault
+        (ENOSPC/EIO) while the store is full waits and retries —
+        a spill wave elsewhere may free space — and exhausts into the
+        TYPED retriable StorageDegradedError, never a bare OSError."""
+        for attempt in range(GlobalConfig.spill_backpressure_retries + 1):
+            try:
+                return spill.write_object(oid, parts)
+            except OSError as e:
+                spill.count_fault(spill.SPILL_WRITE_SITE, "backpressured")
+                if attempt >= GlobalConfig.spill_backpressure_retries:
+                    raise exceptions.StorageDegradedError(
+                        f"put {oid.hex()[:12]}: store full and spill "
+                        f"failed: {e}",
+                        retry_after_s=GlobalConfig.
+                        spill_backpressure_delay_s) from e
+                time.sleep(GlobalConfig.spill_backpressure_delay_s
+                           * rpc._jitter())
 
     # ------------------------------------------------------------------- get
     def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
@@ -774,6 +799,14 @@ class CoreClient(DeferredRefDecs):
     def register_function(self, fid: bytes, blob: bytes):
         if fid in self._fn_registered:
             return
+        # keep the raw blob: if a worker later reports the kvref payload
+        # lost (`fn_lost`), _reregister_function re-puts from this cache
+        self._fn_blobs[fid] = blob
+        self._register_function_inner(fid, blob, overwrite=False)
+        self._fn_registered.add(fid)
+
+    def _register_function_inner(self, fid: bytes, blob: bytes,
+                                 overwrite: bool):
         value = blob
         if 0 < GlobalConfig.kv_inline_max_bytes < len(blob):
             # big function-table blob: divert the payload to the object
@@ -787,8 +820,18 @@ class CoreClient(DeferredRefDecs):
             value = kvref.pack(ref.binary())
         self._take_submit_credit()
         self.controller.call("kv_put", {"ns": FN_NAMESPACE, "key": fid,
-                                        "value": value, "overwrite": False})
-        self._fn_registered.add(fid)
+                                        "value": value,
+                                        "overwrite": overwrite})
+
+    def _reregister_function(self, fid: bytes) -> bool:
+        """Re-publish a function whose kvref payload was lost (a worker
+        reported ``fn_lost``): put a FRESH blob ref and overwrite the KV
+        marker so the requeued task finds a live payload."""
+        blob = self._fn_blobs.get(fid)
+        if blob is None:
+            return False
+        self._register_function_inner(fid, blob, overwrite=True)
+        return True
 
     def build_args(self, args: tuple, kwargs: dict):
         """Encode call arguments for a spec: ObjectRefs stay refs, small
@@ -1170,12 +1213,27 @@ class CoreClient(DeferredRefDecs):
             # poison a future lineage resubmission of the same task_id
             self._cancelled.discard(tid)
             self._spurious_requeues.pop(tid, None)
+            self._fn_requeues.pop(tid, None)
         if err is not None:
             if tid in self._cancelled:
                 # an interrupted task errors out (TaskCancelledError raised
                 # in the worker); surface THE CANCEL, never retry
                 self._finish_cancel(spec)
                 return False
+            if err.get("fn_lost") and state is not None:
+                # The function's kvref blob vanished (owner restart,
+                # lost spill file): re-register from the cached blob and
+                # requeue WITHOUT burning the task's retry budget — the
+                # fault is the function table's, not the task's.
+                # Bounded: a blob that stays lost fails the task with
+                # the worker's typed FunctionUnavailableError traceback.
+                n = self._fn_requeues.get(tid, 0)
+                if n < 3 and self._reregister_function(
+                        bytes.fromhex(err["fn_lost"])):
+                    self._fn_requeues[tid] = n + 1
+                    state.queue.append((spec, attempts_left))
+                    state.wakeup.set()
+                    return True
             if self._is_spurious_cancel(err) and state is not None:
                 # The TAGGED injection class for a task nobody cancelled:
                 # PyThreadState_SetAsyncExc landed in a pool thread that

@@ -23,9 +23,37 @@ readable.
 from __future__ import annotations
 
 import os
+import struct
 import tempfile
+import zlib
 from abc import ABC, abstractmethod
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+# Spill-file integrity trailer: ``<payload><4s magic><u32 crc32>``.
+# PR-9 put CRCs on cross-node fetches only; the at-rest copy was
+# trusted.  The trailer keeps legacy (trailer-less) files readable:
+# check_crc treats a file without the magic as a v1 payload.
+SPILL_CRC_MAGIC = b"RTpC"
+_TRAILER = struct.Struct("<4sI")
+
+
+def crc_trailer(crc: int) -> bytes:
+    return _TRAILER.pack(SPILL_CRC_MAGIC, crc & 0xFFFFFFFF)
+
+
+def check_crc(raw: bytes) -> Tuple[Optional[bytes], str]:
+    """Split payload from trailer and verify.  Returns ``(payload,
+    state)`` with state ``ok`` (verified), ``legacy`` (no trailer —
+    pre-CRC file, returned as-is), or ``corrupt`` (mismatch/truncation
+    — payload is None and must be treated as a missing copy)."""
+    if len(raw) >= _TRAILER.size:
+        magic, crc = _TRAILER.unpack_from(raw, len(raw) - _TRAILER.size)
+        if magic == SPILL_CRC_MAGIC:
+            payload = raw[:-_TRAILER.size]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                return None, "corrupt"
+            return payload, "ok"
+    return raw, "legacy"
 
 
 class ExternalStorage(ABC):
@@ -54,10 +82,22 @@ class FilesystemStorage(ExternalStorage):
     def spill(self, oid: bytes, parts: List[memoryview]) -> str:
         path = os.path.join(self.root, oid.hex())
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            for p in parts:
-                f.write(bytes(p))
-        os.replace(tmp, path)
+        crc = 0
+        try:
+            with open(tmp, "wb") as f:
+                for p in parts:
+                    b = bytes(p)
+                    crc = zlib.crc32(b, crc)
+                    f.write(b)
+                f.write(crc_trailer(crc))
+            os.replace(tmp, path)
+        except OSError:
+            # half-written tmp must not survive to be mistaken for data
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return path
 
     def restore(self, url: str) -> Optional[bytes]:
@@ -97,9 +137,13 @@ class SmartOpenStorage(ExternalStorage):
 
     def spill(self, oid: bytes, parts: List[memoryview]) -> str:
         url = f"{self.prefix}/{oid.hex()}"
+        crc = 0
         with self._open(url, "wb") as f:
             for p in parts:
-                f.write(bytes(p))
+                b = bytes(p)
+                crc = zlib.crc32(b, crc)
+                f.write(b)
+            f.write(crc_trailer(crc))
         return url
 
     def restore(self, url: str) -> Optional[bytes]:

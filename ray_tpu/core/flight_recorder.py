@@ -44,7 +44,10 @@ from .config import GlobalConfig
 #: triggers the controller fires automatically (manual grabs use "manual")
 AUTO_TRIGGERS = ("node_suspect", "node_dead", "controller_failover",
                  "drain_deadline", "elastic_repair", "oom_kill",
-                 "compile_storm", "slo_breach", "overload")
+                 "compile_storm", "slo_breach", "overload",
+                 "disk_pressure")
+
+FLIGHT_WRITE_SITE = "flight.write"
 
 
 def recorder_dir() -> str:
@@ -176,6 +179,10 @@ class FlightRecorder:
 
     # --------------------------------------------------------------- disk
     def _write(self, name: str, bundle: dict) -> str:
+        """Bundle write is BEST-EFFORT: an incident capture hitting a
+        full/broken disk is shed with a counter (the recorder observes
+        incidents, it must never cause one) — raising here would turn a
+        disk fault into a failed capture task for every trigger."""
         base = recorder_dir()
         path = os.path.join(base, name)
         # stage under a dot-prefixed name and publish by rename: a
@@ -183,15 +190,25 @@ class FlightRecorder:
         # for a bundle, `ray-tpu debug list`) must never see a bundle
         # dir whose files are still being written
         stage = os.path.join(base, "." + name)
-        os.makedirs(stage, exist_ok=True)
-        for part in ("meta", "spans", "metrics", "events", "nodes"):
-            with open(os.path.join(stage, f"{part}.json"), "w") as f:
-                json.dump(bundle[part], f, default=str)
         try:
-            os.rename(stage, path)
-        except OSError:
-            shutil.rmtree(path, ignore_errors=True)
-            os.rename(stage, path)
+            from ..util import fault_injection as fi
+            fi.fs_point(FLIGHT_WRITE_SITE, name)
+            os.makedirs(stage, exist_ok=True)
+            for part in ("meta", "spans", "metrics", "events", "nodes"):
+                with open(os.path.join(stage, f"{part}.json"), "w") as f:
+                    json.dump(bundle[part], f, default=str)
+            try:
+                os.rename(stage, path)
+            except OSError:
+                # name collision with a published bundle: replace it
+                shutil.rmtree(path, ignore_errors=True)
+                os.rename(stage, path)
+        except OSError as e:
+            shutil.rmtree(stage, ignore_errors=True)
+            from . import runtime_metrics as rtm
+            rtm.STORAGE_FAULTS.inc(tags={"site": FLIGHT_WRITE_SITE,
+                                         "outcome": "shed"})
+            return f"<shed: {e}>"
         # prune oldest past the retention bound (names sort by time)
         keep = max(1, GlobalConfig.flight_recorder_keep)
         existing = list_bundles(base)

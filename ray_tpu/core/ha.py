@@ -137,6 +137,33 @@ class HAManager:
                             "controller_failover", now, now,
                             outcome="fenced", reason=reason)
 
+    def self_fence(self, reason: str) -> None:
+        """The leader renounces leadership over its OWN storage failure
+        (poisoned WAL — fsyncgate).  Unlike :meth:`fence` this never
+        touches the store: the WAL is exactly what failed, so the
+        durable-renounce append is impossible.  Dropping ``is_leader``
+        stops the lease loop's renewals; the standby's lease lapses and
+        it promotes itself through the normal epoch+1 path, which fences
+        this process durably the moment the new epoch is observed."""
+        if self.fenced or not self.is_leader:
+            return
+        self.is_leader = False
+        self.fenced = True
+        rtm.CONTROLLER_FAILOVERS.inc(tags={"outcome": "self_fenced"})
+        self.c._emit_event(
+            "ERROR", "controller",
+            f"leader self-fenced: {reason} — writes it cannot persist "
+            f"are rejected; standby takes over on lease lapse")
+        from ..util import tracing
+        now = time.time()
+        tracing.record_span(f"controller_failover::self-fence-e{self.epoch}",
+                            "controller_failover", now, now,
+                            outcome="self_fenced", reason=reason)
+        flight = getattr(self.c, "flight", None)
+        if flight is not None:
+            flight.trigger("controller_failover",
+                           {"outcome": "self_fenced", "reason": reason})
+
     # ---------------------------------------------------------- leader: repl
     def offer(self, record: List[Any]) -> None:
         """ControllerStore tap: one locally durable record enters the

@@ -178,6 +178,12 @@ class Nodelet:
         # controller's) — reported on the heartbeat so state.timeline()
         # merges cross-host spans in causal order
         self._clock_offset: Optional[float] = None
+        # Disk-health watermark state of the spill filesystem (statvfs
+        # by _disk_monitor_loop): "ok" | "low" (peers stop spilling
+        # leases here) | "red" (proactive spill stops too).  Rides the
+        # heartbeat into the controller's view/state.nodes().
+        self.disk_health: Dict[str, Any] = {
+            "state": "ok", "used_frac": 0.0, "free_bytes": 0}
         # bounded metrics-history ring (core/metrics_history.py),
         # sampled by a start() task, served via `metrics_history`
         from .metrics_history import MetricsRing
@@ -234,6 +240,9 @@ class Nodelet:
         if GlobalConfig.memory_monitor_interval_s > 0:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
+        if GlobalConfig.disk_monitor_interval_s > 0:
+            self._tasks.append(
+                asyncio.ensure_future(self._disk_monitor_loop()))
         if GlobalConfig.spill_check_interval_s > 0:
             self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         self._lag_ewma = 0.0
@@ -489,6 +498,8 @@ class Nodelet:
                     "demand":
                         list(self._demand_tokens.values())[:64],
                     "reach": self._fresh_reach(),
+                    "disk": {"state": self.disk_health["state"],
+                             "used_frac": self.disk_health["used_frac"]},
                     "_ha_epoch": getattr(self, "_ctl_epoch", 0),
                 }
                 if self._clock_offset is not None:
@@ -823,6 +834,74 @@ class Nodelet:
             except Exception:
                 pass  # the monitor must never die
 
+    def _disk_usage(self):
+        """statvfs snapshot of the spill filesystem (sync: runs via
+        to_thread off the event loop)."""
+        st = os.statvfs(spill.spill_root())
+        total = st.f_frsize * st.f_blocks
+        free = st.f_frsize * st.f_bavail
+        used_frac = 1.0 - (free / total) if total else 0.0
+        return used_frac, free
+
+    async def _disk_monitor_loop(self):
+        """Disk-health watermarks beside the memory monitor: statvfs the
+        spill filesystem and classify ok / low / red
+        (``disk_low_water_frac`` / ``disk_red_frac``).  LOW nodes stop
+        being chosen as lease spill-back targets; RED additionally stops
+        proactive spilling (writes there would only fail) and fires a
+        ``disk_pressure`` incident bundle at the controller.  The state
+        rides every heartbeat into ``state.nodes()`` / ``ray-tpu
+        status``."""
+        while True:
+            await asyncio.sleep(GlobalConfig.disk_monitor_interval_s)
+            try:
+                try:
+                    used_frac, free = await asyncio.to_thread(
+                        self._disk_usage)
+                except OSError:
+                    continue  # spill root vanished: keep last state
+                if used_frac >= GlobalConfig.disk_red_frac:
+                    state = "red"
+                elif used_frac >= GlobalConfig.disk_low_water_frac:
+                    state = "low"
+                else:
+                    state = "ok"
+                prev = self.disk_health["state"]
+                self.disk_health = {"state": state,
+                                    "used_frac": round(used_frac, 4),
+                                    "free_bytes": free}
+                if state == prev:
+                    continue
+                # reflect immediately in our own view so local spillback
+                # decisions don't wait a heartbeat round-trip
+                me = self.view.get(self.node_id.hex())
+                if me is not None:
+                    me.disk = state
+                if state == "red" and prev != "red":
+                    print(f"DISK PRESSURE {used_frac:.3f} >= "
+                          f"{GlobalConfig.disk_red_frac}: proactive spill "
+                          f"stopped on node {self.node_id.hex()[:12]} "
+                          f"({free >> 20} MiB free)",
+                          file=sys.stderr, flush=True)
+                    try:
+                        await self.controller.notify("report_event", {
+                            "severity": "ERROR", "source": "disk_monitor",
+                            "message": f"disk red at {used_frac:.2f} used "
+                                       f"({free >> 20} MiB free): spill "
+                                       f"target excluded, proactive spill "
+                                       f"stopped",
+                            "meta": {"node_id": self.node_id.hex()}})
+                        await self.controller.notify("debug_capture", {
+                            "trigger": "disk_pressure",
+                            "reason": f"node "
+                                      f"{self.node_id.hex()[:12]} at "
+                                      f"{used_frac:.2f} disk usage",
+                            "meta": {"node_id": self.node_id.hex()[:12]}})
+                    except Exception:
+                        pass
+            except Exception:
+                pass  # the monitor must never die
+
     async def _spill_loop(self):
         """Proactive spilling under store pressure (reference:
         `src/ray/raylet/local_object_manager.cc` SpillObjectsOfSize — the
@@ -836,6 +915,11 @@ class Nodelet:
         while True:
             await asyncio.sleep(GlobalConfig.spill_check_interval_s)
             try:
+                if self.disk_health["state"] == "red":
+                    # spilling onto a red disk can only trade memory
+                    # pressure for ENOSPC failures: hold copies in memory
+                    # (put-side backpressure takes over) until it clears
+                    continue
                 st = self.store.stats()
                 cap = st["capacity_bytes"] or 1
                 if st["used_bytes"] / cap < GlobalConfig.spill_threshold_frac:
@@ -862,6 +946,12 @@ class Nodelet:
         self._spilling.add(oid)
         try:
             return await self._spill_locked(oid, view)
+        except OSError:
+            # disk fault mid-spill (ENOSPC/EIO): degrade, don't fail —
+            # the primary copy stays pinned in memory and put-side
+            # backpressure carries the pressure until space frees
+            spill.count_fault(spill.SPILL_WRITE_SITE, "retained")
+            return False
         finally:
             self._spilling.discard(oid)
             self._spill_tombstones.discard(oid)
@@ -1119,11 +1209,20 @@ class Nodelet:
             arg_nodes = None
         while True:
             self._refresh_self_view()
+            # Disk-health filter, SOFT like arg_nodes: peers whose spill
+            # filesystem is past the red watermark are skipped as
+            # spill-back targets (work sent there could neither spill
+            # nor absorb a put under pressure), unless that empties the
+            # candidate set.  LOW nodes stay eligible — they are only
+            # flagged for operators.
+            views = {nid: v for nid, v in self.view.items()
+                     if nid == my_id or getattr(v, "disk", "ok") != "red"}
+            views = views if views else self.view
             if self.draining:
                 # never grant here again: spill to a live peer when one
                 # fits, else tell the driver to retry (it re-evaluates
                 # against the synced view, which now marks us DRAINING)
-                target = hybrid_policy(self.view, request, None,
+                target = hybrid_policy(views, request, None,
                                        strategy=strategy,
                                        arg_nodes=arg_nodes)
                 if target is not None and target != my_id:
@@ -1132,7 +1231,7 @@ class Nodelet:
                     return {"spillback": nv.addr, "node_id": target}
                 return {"retry": True, "draining": True}
             target = hybrid_policy(
-                self.view, request, my_id,
+                views, request, my_id,
                 spread_threshold=GlobalConfig.scheduler_spread_threshold,
                 strategy=strategy, arg_nodes=arg_nodes)
             if target is not None and target != my_id:

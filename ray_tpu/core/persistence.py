@@ -39,9 +39,19 @@ from typing import Any, Callable, Dict, List, Optional
 
 import msgpack
 
+from ..exceptions import WalWriteError
+from ..util import fault_injection as fi
+
 _LEN = struct.Struct("<I")
 _CRC = struct.Struct("<I")
 WAL_MAGIC = b"RTPUWAL2"
+
+# Chaos sites for the filesystem fault domain (util/fault_injection.py).
+# Keyed "<dirname>:<op>" so a plan can target the leader's store without
+# also poisoning an in-process standby replaying the same record ops.
+WAL_APPEND_SITE = "wal.append"
+WAL_FSYNC_SITE = "wal.fsync"
+WAL_SNAPSHOT_SITE = "wal.snapshot"
 
 
 def _pack(obj: Any) -> bytes:
@@ -56,15 +66,15 @@ def fsync_dir(path: str) -> None:
     """fsync a DIRECTORY so a rename/unlink inside it is itself durable.
     ``os.replace`` orders the data blocks, not the directory entry — on
     power loss the rename can vanish, resurrecting a stale snapshot
-    against a WAL that was already deleted."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
+    against a WAL that was already deleted.
+
+    Raises the ``OSError``: swallowing it here silently demoted every
+    caller's durability story (a failed directory fsync means the rename
+    ordering is NOT guaranteed) — callers decide whether that is fatal
+    (WAL poison) or a degradation (compaction keeps the WAL)."""
+    fd = os.open(path, os.O_RDONLY)
     try:
         os.fsync(fd)
-    except OSError:
-        pass
     finally:
         os.close(fd)
 
@@ -95,7 +105,13 @@ class ControllerStore:
         #: controller stalls on fsync" is measurable, not folklore
         self.timing: Dict[str, float] = {
             "appends": 0, "append_s": 0.0, "append_max_s": 0.0,
-            "fsync_s": 0.0, "fsync_max_s": 0.0}
+            "fsync_s": 0.0, "fsync_max_s": 0.0,
+            "append_errors": 0, "fsync_errors": 0, "snapshot_errors": 0}
+        #: set to the failure detail by the FIRST write/fsync OSError:
+        #: after one failed fsync the page-cache state of the log is
+        #: unknowable (fsyncgate), so every later append raises
+        #: WalWriteError — the HA self-fence path is the only exit
+        self.poisoned: Optional[str] = None
 
     # -- recovery ------------------------------------------------------------
     def load(self) -> Optional[Dict[str, Any]]:
@@ -170,8 +186,18 @@ class ControllerStore:
         durability, but never re-fed to the tap (no echo loops)."""
         return self._append_local(list(record))
 
+    def _poison(self, op: str, exc: OSError) -> None:
+        """First write/fsync failure: poison the store and surface the
+        typed error.  The failed record was never fed to the replication
+        tap (append() raises before tap), so nothing unacked ships."""
+        self.timing[f"{op}_errors"] += 1
+        self.poisoned = f"{op} failed: {exc}"
+        raise WalWriteError(op, str(exc)) from exc
+
     def _append_local(self, record: List[Any]) -> int:
         import time as _time
+        if self.poisoned is not None:
+            raise WalWriteError("append", self.poisoned)
         t0 = _time.perf_counter()
         if self._wal is None:
             self._open_wal()
@@ -181,11 +207,21 @@ class ControllerStore:
                 + _CRC.pack(zlib.crc32(blob) & 0xFFFFFFFF) + blob
         else:
             frame = _LEN.pack(len(blob)) + blob
-        self._wal.write(frame)
-        self._wal.flush()
+        key = f"{os.path.basename(self.dir)}:" \
+              f"{record[0] if record else ''}"
+        try:
+            fi.fs_point(WAL_APPEND_SITE, key)
+            self._wal.write(frame)
+            self._wal.flush()
+        except OSError as e:
+            self._poison("append", e)
         if self._fsync:
             tf = _time.perf_counter()
-            os.fsync(self._wal.fileno())
+            try:
+                fi.fs_point(WAL_FSYNC_SITE, key)
+                os.fsync(self._wal.fileno())
+            except OSError as e:
+                self._poison("fsync", e)
             dt_f = _time.perf_counter() - tf
             self.timing["fsync_s"] += dt_f
             if dt_f > self.timing["fsync_max_s"]:
@@ -202,26 +238,45 @@ class ControllerStore:
             self.snapshot(self._snapshot_provider())
         return self.seq
 
-    def snapshot(self, tables: Dict[str, Any]) -> None:
+    def snapshot(self, tables: Dict[str, Any]) -> bool:
+        """Compact the WAL into a fresh snapshot.  Compaction is an
+        OPTIMIZATION: on any fs failure the dance rolls back, the WAL is
+        KEPT (replaying it over an older — or even the just-renamed —
+        snapshot is idempotent) and appends continue unpoisoned; returns
+        False so callers can tell the compaction did not land."""
         tmp = self.snap_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(_pack(tables))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.snap_path)
-        if self._fsync:
-            # make the rename itself durable before the WAL goes away
-            fsync_dir(self.dir)
+        try:
+            fi.fs_point(WAL_SNAPSHOT_SITE,
+                        f"{os.path.basename(self.dir)}:snapshot")
+            with open(tmp, "wb") as f:
+                f.write(_pack(tables))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snap_path)
+            if self._fsync:
+                # make the rename itself durable before the WAL goes away
+                fsync_dir(self.dir)
+        except OSError:
+            self.timing["snapshot_errors"] += 1
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            self._appends = 0  # retry at the next compaction threshold
+            return False
         if self._wal is not None:
             self._wal.close()
             self._wal = None
         try:
             os.unlink(self.wal_path)
+            if self._fsync:
+                fsync_dir(self.dir)
         except OSError:
-            pass
-        if self._fsync:
-            fsync_dir(self.dir)
+            # unlink durability unknown: a resurrected WAL replays over
+            # the new snapshot, which is idempotent — degrade, count
+            self.timing["snapshot_errors"] += 1
         self._appends = 0
+        return True
 
     def close(self) -> None:
         if self._wal is not None:

@@ -261,6 +261,30 @@ WAL_FSYNC_SECONDS = m.Counter(
     "ray_tpu_controller_wal_fsync_seconds_total",
     "Wall seconds of the fsync share of WAL appends (the disk-bound "
     "floor under every mutating controller reply)", ())
+WAL_ERRORS = m.Counter(
+    "ray_tpu_controller_wal_errors_total",
+    "WAL write failures by op (append | fsync: the FIRST one poisons "
+    "the store and self-fences the leader — fsyncgate | snapshot: "
+    "compaction failed and the WAL was kept)", ("op",))
+STORAGE_FAULTS = m.Counter(
+    "ray_tpu_storage_faults_total",
+    "Storage faults absorbed by a degradation ladder, by site and "
+    "outcome (retained: spill failed, object stayed in memory | "
+    "backpressured: a put waited out a spill fault | missing / "
+    "corrupt_dropped: a spill copy was unusable and the fetch ladder "
+    "fell through | kept_previous: a checkpoint write failed, the last "
+    "good one stands | shed: a best-effort incident write was dropped "
+    "| leaked: a spill-file GC unlink failed)", ("site", "outcome"))
+NODE_DISK_USED_FRAC = m.Gauge(
+    "ray_tpu_node_disk_used_frac",
+    "Used fraction of the filesystem under the node's spill root "
+    "(statvfs, disk-health monitor cadence)", ("node",))
+NODE_DISK_STATE = m.Gauge(
+    "ray_tpu_node_disk_state",
+    "Disk-health watermark state of the node's spill filesystem "
+    "(0=ok, 1=low: spill-target selection avoids the node, 2=red: "
+    "proactive spill stops and the disk_pressure trigger fires)",
+    ("node",))
 SCHED_WAVES = m.Counter(
     "ray_tpu_scheduler_waves_total",
     "Scheduler wake-up waves (lease-waiter cohort re-evaluations after "
@@ -497,6 +521,10 @@ def fold_wal_timing(pstore: Any) -> None:
     _fold(WAL_APPENDS, t["appends"])
     _fold(WAL_APPEND_SECONDS, t["append_s"])
     _fold(WAL_FSYNC_SECONDS, t["fsync_s"])
+    for op in ("append", "fsync", "snapshot"):
+        errs = t.get(f"{op}_errors", 0)
+        if errs:
+            _fold(WAL_ERRORS, errs, op=op)
 
 
 def snapshot_nodelet(nl: Any) -> None:
@@ -525,6 +553,12 @@ def snapshot_nodelet(nl: Any) -> None:
             pass
     PRIMARY_PINS.set(len(nl._primary_pins), {"node": nid})
     LOOP_LAG.set(getattr(nl, "_lag_ewma", 0.0), {"node": nid})
+    disk = getattr(nl, "disk_health", None)
+    if disk:
+        NODE_DISK_USED_FRAC.set(disk.get("used_frac", 0.0), {"node": nid})
+        NODE_DISK_STATE.set(
+            {"ok": 0, "low": 1, "red": 2}.get(disk.get("state"), 0),
+            {"node": nid})
     fold_rpc_dispatch()
     fold_rpc_lanes()
 

@@ -18,13 +18,14 @@ from .task_spec import EPS, ResourceSet
 
 class NodeView:
     __slots__ = ("node_id", "addr", "available", "total", "alive", "labels",
-                 "version", "draining", "suspect", "unreachable")
+                 "version", "draining", "suspect", "unreachable", "disk")
 
     def __init__(self, node_id: str, addr: str, available: Dict[str, float],
                  total: Dict[str, float], alive: bool = True,
                  labels: Optional[Dict[str, str]] = None,
                  version: int = 0, draining: bool = False,
-                 suspect: bool = False, unreachable=None):
+                 suspect: bool = False, unreachable=None,
+                 disk: str = "ok"):
         self.node_id = node_id
         self.addr = addr
         self.available = ResourceSet(available)
@@ -51,19 +52,27 @@ class NodeView:
         # this-node -> peer).  Scheduling avoids placing a task here
         # when its args live only on an unreachable peer.
         self.unreachable: set = set(unreachable or ())
+        # Disk-health watermark state of the node's spill filesystem
+        # ("ok" | "low" | "red", nodelet disk monitor via heartbeats).
+        # RED nodes are soft-excluded as lease spill-back targets —
+        # work spilled there could neither spill objects nor absorb a
+        # capacity-pressure put.  LOW is operator-facing only.
+        self.disk = disk or "ok"
 
     def to_wire(self):
         return {"id": self.node_id, "addr": self.addr,
                 "avail": self.available.to_dict(), "total": self.total.to_dict(),
                 "alive": self.alive, "labels": self.labels,
                 "ver": self.version, "draining": self.draining,
-                "sus": self.suspect, "unreach": sorted(self.unreachable)}
+                "sus": self.suspect, "unreach": sorted(self.unreachable),
+                "disk": self.disk}
 
     @classmethod
     def from_wire(cls, d):
         return cls(d["id"], d["addr"], d["avail"], d["total"], d["alive"],
                    d.get("labels"), d.get("ver", 0), d.get("draining", False),
-                   d.get("sus", False), d.get("unreach"))
+                   d.get("sus", False), d.get("unreach"),
+                   d.get("disk", "ok"))
 
 
 def is_feasible(view: NodeView, request: ResourceSet) -> bool:

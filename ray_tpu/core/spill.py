@@ -18,6 +18,17 @@ Either way the restore URL is registered in the controller KV
 (session dir on one machine, bucket URI across hosts) can restore.
 The backend is pluggable via the ``spill_storage_uri`` flag — see
 `external_storage.py`.
+
+Integrity: every spill file carries a CRC32 trailer
+(external_storage.py) verified on restore — a corrupt or truncated
+file is treated exactly like a MISSING copy (``read_file`` returns
+None) so the fetch ladder falls through to alternates/lineage and
+garbage is never deserialized.  Filesystem chaos sites ``spill.write``
+/ ``spill.restore`` / ``spill.delete`` inject ENOSPC/EIO here; the
+degradation ladder is: proactive spill skips the object (it stays in
+memory), capacity-pressure spill retains in memory + backpressures the
+put, restore failure falls through the existing fetch ladder, delete
+failure only leaks a file.
 """
 
 from __future__ import annotations
@@ -26,7 +37,30 @@ from typing import List, Optional
 
 from . import external_storage
 
+
+def _fi():
+    # lazy: this module loads inside the ray_tpu.api import chain, and
+    # importing ..util there would close a circular import (util's
+    # __init__ re-enters api via placement_group)
+    from ..util import fault_injection
+    return fault_injection
+
 _NS = "spill"
+
+SPILL_WRITE_SITE = "spill.write"
+SPILL_RESTORE_SITE = "spill.restore"
+SPILL_DELETE_SITE = "spill.delete"
+
+
+def _node_tag() -> str:
+    import os
+    return os.environ.get("RAY_TPU_NODE_ID", "driver")[:12]
+
+
+def count_fault(site: str, outcome: str) -> None:
+    """Fold one storage-fault degradation into the metrics battery."""
+    from . import runtime_metrics as rtm
+    rtm.STORAGE_FAULTS.inc(tags={"site": site, "outcome": outcome})
 
 
 def spill_root() -> str:
@@ -34,7 +68,10 @@ def spill_root() -> str:
 
 
 def write_object(oid: bytes, parts: List[memoryview]) -> str:
-    """Spill serialized parts to the configured backend; returns the URL."""
+    """Spill serialized parts to the configured backend; returns the URL.
+    Raises ``OSError`` (ENOSPC/EIO/...) when the backend cannot absorb
+    the object — callers own the degradation (retain in memory)."""
+    _fi().fs_point(SPILL_WRITE_SITE, oid.hex())
     return external_storage.get_storage().spill(oid, parts)
 
 
@@ -43,15 +80,31 @@ def kv_entry(oid: bytes) -> dict:
 
 
 def read_file(url: str) -> Optional[bytes]:
-    data = external_storage.get_storage().restore(url)
-    if data is not None:
-        import os
-
-        from . import runtime_metrics as rtm
-        rtm.OBJECTS_RESTORED.inc(tags={
-            "node": os.environ.get("RAY_TPU_NODE_ID", "driver")[:12]})
+    """Restore one spilled object, or None when the copy is unusable
+    (absent, unreadable, CRC mismatch) — None means "missing" to every
+    caller, which falls through the fetch ladder to lineage."""
+    try:
+        _fi().fs_point(SPILL_RESTORE_SITE, url)
+        raw = external_storage.get_storage().restore(url)
+    except OSError:
+        count_fault(SPILL_RESTORE_SITE, "missing")
+        return None
+    if raw is None:
+        return None
+    data, state = external_storage.check_crc(raw)
+    if state == "corrupt":
+        # truncated/bit-flipped spill file: NEVER deserialized — drop
+        # the copy and let the ladder reconstruct
+        count_fault(SPILL_RESTORE_SITE, "corrupt_dropped")
+        return None
+    from . import runtime_metrics as rtm
+    rtm.OBJECTS_RESTORED.inc(tags={"node": _node_tag()})
     return data
 
 
 def delete_file(url: str) -> None:
-    external_storage.get_storage().delete(url)
+    try:
+        _fi().fs_point(SPILL_DELETE_SITE, url)
+        external_storage.get_storage().delete(url)
+    except OSError:
+        count_fault(SPILL_DELETE_SITE, "leaked")

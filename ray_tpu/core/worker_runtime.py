@@ -331,7 +331,16 @@ class WorkerRuntime:
             if kvref.is_ref(blob):
                 # big blob diverted off the control plane: the KV holds
                 # only a marker, the payload rides the object plane
-                blob = await self._fetch_kvref(kvref.unpack(blob))
+                try:
+                    blob = await self._fetch_kvref(kvref.unpack(blob))
+                except exceptions.ObjectLostError as e:
+                    # the marker survived but its blob is gone (owner
+                    # died, spill file corrupted/lost): typed + tagged
+                    # so the driver re-registers from its cached blob
+                    # and requeues instead of failing the task on an
+                    # opaque KeyError
+                    raise exceptions.FunctionUnavailableError(
+                        fid.hex(), str(e)) from e
             fn = serialization.loads_function(blob)
             self.fn_cache[fid] = fn
         return fn
@@ -414,30 +423,55 @@ class WorkerRuntime:
                 out.append({"inline": b"".join(bytes(p) for p in parts),
                             "contained": bool(contained)})
             else:
-                try:
-                    self.store.put_parts(oid, parts)
-                    # Bridge pin until the nodelet takes its primary pin —
-                    # same LRU-race close as the driver put path: under
-                    # store pressure an unpinned return value could be
-                    # evicted before put_location pins it.
-                    bridge = self.store.get(oid, timeout_ms=0) is not None
+                for attempt in range(
+                        GlobalConfig.spill_backpressure_retries + 1):
                     try:
-                        await self.nodelet.call(
-                            "put_location", {"object_id": oid, "size": size})
-                    finally:
-                        if bridge:
-                            self.store.release(oid)
-                except store_client.StoreFullError:
-                    from . import spill
-                    # off-loop: spilled returns can be arbitrarily
-                    # large, and this loop also serves ping/cancel
-                    # (PR-13 loop-blocking lint)
-                    path = await asyncio.to_thread(
-                        spill.write_object, oid, parts)
-                    conn = await self._controller_conn()
-                    await conn.call(
-                        "kv_put", {**spill.kv_entry(oid),
-                                   "value": path.encode()})
+                        self.store.put_parts(oid, parts)
+                        # Bridge pin until the nodelet takes its primary pin —
+                        # same LRU-race close as the driver put path: under
+                        # store pressure an unpinned return value could be
+                        # evicted before put_location pins it.
+                        bridge = self.store.get(oid, timeout_ms=0) is not None
+                        try:
+                            await self.nodelet.call(
+                                "put_location", {"object_id": oid, "size": size})
+                        finally:
+                            if bridge:
+                                self.store.release(oid)
+                        break
+                    except store_client.StoreFullError:
+                        from . import spill
+                        try:
+                            # off-loop: spilled returns can be arbitrarily
+                            # large, and this loop also serves ping/cancel
+                            # (PR-13 loop-blocking lint)
+                            path = await asyncio.to_thread(
+                                spill.write_object, oid, parts)
+                        except OSError as e:
+                            # store full AND spill disk faulting
+                            # (ENOSPC/EIO): backpressure — wait for the
+                            # store to drain or the disk to clear, then
+                            # retry the in-memory put first.  Exhausted
+                            # retries surface a TYPED retriable error,
+                            # never a bare OSError task failure.
+                            spill.count_fault(spill.SPILL_WRITE_SITE,
+                                              "backpressured")
+                            if attempt >= \
+                                    GlobalConfig.spill_backpressure_retries:
+                                raise exceptions.StorageDegradedError(
+                                    f"return {oid.hex()[:12]}: store full "
+                                    f"and spill failed: {e}",
+                                    retry_after_s=GlobalConfig.
+                                    spill_backpressure_delay_s) from e
+                            await asyncio.sleep(
+                                GlobalConfig.spill_backpressure_delay_s
+                                * rpc._jitter())
+                            continue
+                        conn = await self._controller_conn()
+                        await conn.call(
+                            "kv_put", {**spill.kv_entry(oid),
+                                       "value": path.encode()})
+                        break
                 out.append({"plasma": size, "contained": bool(contained)})
         return out
 
@@ -680,6 +714,14 @@ class WorkerRuntime:
     async def _push_task_body(self, spec: TaskSpec):
         try:
             fn = await self._get_function(spec.function_id)
+        except exceptions.FunctionUnavailableError:
+            # the function's kvref blob is gone, not a user error: tag
+            # the reply so the driver re-registers the function from its
+            # cached blob and requeues (bounded) without burning the
+            # task's retry budget
+            return {"error": {"traceback": traceback.format_exc(),
+                              "pickled": None, "fname": spec.function_name,
+                              "fn_lost": spec.function_id.hex()}}
         except Exception:
             # Function-table / unpickling failures are user errors, not
             # transport errors: report in-band so the driver doesn't treat a

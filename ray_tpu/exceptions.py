@@ -86,6 +86,69 @@ class ControlPlaneOverloadError(RayTpuError):
             f"(retry after ~{retry_after_s:g}s)")
 
 
+class StorageDegradedError(RayTpuError):
+    """Local storage (spill disk) cannot absorb an object right now.
+
+    Typed retriable pushback for the spill degradation ladder: an
+    ENOSPC/EIO spill failure under memory pressure retains the object
+    in memory and backpressures the put instead of failing tasks; only
+    a put that exhausts the whole backpressure budget surfaces this —
+    and it still carries ``Retry-After`` so callers can keep backing
+    off rather than treating the node as broken."""
+
+    def __init__(self, detail: str, retry_after_s: float = 1.0):
+        self.detail = detail
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"storage degraded: {detail} "
+            f"(retry after ~{retry_after_s:g}s)")
+
+
+class CheckpointWriteError(RayTpuError):
+    """A checkpoint commit failed durably (ENOSPC/EIO in the staging or
+    replace dance).  The previous checkpoint is intact and loadable —
+    the manager rolls the dance back before raising — so callers keep
+    training and retry the save later instead of aborting the run."""
+
+    def __init__(self, name: str, detail: str):
+        self.name = name
+        self.detail = detail
+        super().__init__(
+            f"checkpoint {name!r} write failed ({detail}); "
+            f"previous checkpoint kept")
+
+
+class WalWriteError(RayTpuError):
+    """The controller WAL hit an unrecoverable write/fsync failure.
+
+    fsyncgate bug class: after ONE failed fsync the page-cache state of
+    the log is unknowable, so the store poisons itself (every later
+    append raises this) and the leader must self-fence and hand off to
+    the HA standby rather than ack mutations it cannot persist."""
+
+    def __init__(self, op: str, detail: str):
+        self.op = op
+        self.detail = detail
+        super().__init__(f"controller WAL poisoned at {op!r}: {detail}")
+
+
+class FunctionUnavailableError(RayTpuError):
+    """A registered function's payload is gone from the object plane.
+
+    Oversized function blobs live behind a kvref marker (the KV holds
+    only a pointer); if the blob was evicted or its host died, the
+    fetch fails AFTER registration succeeded.  Typed and retriable: the
+    worker reports it in-band, the owning driver re-registers the blob
+    and requeues the task without burning its retry budget."""
+
+    def __init__(self, fid_hex: str, detail: str = ""):
+        self.fid_hex = fid_hex
+        self.detail = detail
+        super().__init__(
+            f"function {fid_hex[:12]} blob unavailable: {detail or 'lost'} "
+            f"(owner re-registration required)")
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -109,6 +109,9 @@ def cmd_status(args) -> None:
             drain = n.get("drain")
             if drain:
                 detail += f" drain={drain.get('phase', '?')}"
+            if n.get("disk", "ok") != "ok":
+                detail += (f" disk={n['disk']}"
+                           f"({n.get('disk_used_frac', '?')} used)")
             hb = (n.get("health") or {}).get("heartbeat_age_s", "-")
             print(f"{n['id'][:12]:<14} {n.get('state', '?'):<9} "
                   f"{hb:>7}  {detail}")

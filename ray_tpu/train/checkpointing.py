@@ -16,6 +16,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..air.checkpoint import Checkpoint
 from ..air.config import CheckpointConfig
+from ..exceptions import CheckpointWriteError
+from ..util import fault_injection as fi
+
+CHECKPOINT_REGISTER_SITE = "train.checkpoint_register"
 
 
 class CheckpointManager:
@@ -40,19 +44,42 @@ class CheckpointManager:
         """Crash-safe: the checkpoint is staged into a temp dir and
         atomically renamed into place, so a crash mid-write can never
         leave a torn ``checkpoint_<iter>`` that a later resume would
-        read as valid."""
+        read as valid.
+
+        Durable under disk faults: an ENOSPC/EIO anywhere in the stage /
+        replace dance rolls back (staging cleaned, a half-swapped old
+        dir restored) and raises a typed :class:`CheckpointWriteError` —
+        the previously registered checkpoints stay tracked and loadable,
+        so the run keeps training and retries the save later."""
         path = os.path.join(self.storage_path, f"checkpoint_{iteration:06d}")
         staging = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
-        checkpoint.to_directory(staging)
-        if os.path.isdir(path):
-            # re-registration after a restart resumed at this iteration:
-            # replace the old complete dir (never visible half-written)
-            old = f"{path}.tmp-replaced-{uuid.uuid4().hex[:8]}"
-            os.rename(path, old)
-            os.rename(staging, path)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(staging, path)
+        old = None
+        try:
+            fi.fs_point(CHECKPOINT_REGISTER_SITE, path)
+            checkpoint.to_directory(staging)
+            if os.path.isdir(path):
+                # re-registration after a restart resumed at this
+                # iteration: replace the old complete dir (never visible
+                # half-written)
+                old = f"{path}.tmp-replaced-{uuid.uuid4().hex[:8]}"
+                os.rename(path, old)
+                os.rename(staging, path)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(staging, path)
+        except OSError as e:
+            shutil.rmtree(staging, ignore_errors=True)
+            if old is not None and os.path.isdir(old) \
+                    and not os.path.isdir(path):
+                # the old dir was swapped out but the new one never
+                # landed: put the last good checkpoint back
+                os.rename(old, path)
+            from ..core import runtime_metrics as rtm
+            rtm.STORAGE_FAULTS.inc(tags={
+                "site": CHECKPOINT_REGISTER_SITE,
+                "outcome": "kept_previous"})
+            raise CheckpointWriteError(os.path.basename(path),
+                                       str(e)) from e
         entry = (iteration, path, dict(metrics or {}))
         self._tracked = [e for e in self._tracked if e[1] != path]
         self._tracked.append(entry)

@@ -108,6 +108,35 @@ site                        actions
                             (an expired hold admits one item before
                             chaos re-evaluates) — proves the other
                             lanes keep flowing past a wedged one
+``wal.append``              filesystem domain (key ``<dirname>:<op>``):
+                            ``enospc``/``eio``/``error`` fails the WAL
+                            record write — the store poisons itself and
+                            the leader must self-fence (fsyncgate: after
+                            one failed write the durable state is
+                            unknowable)
+``wal.fsync``               same, at the per-append fsync; ``delay`` is
+                            a BLOCKING fsync stall (a dying disk hangs,
+                            it does not return)
+``wal.snapshot``            fails the compaction snapshot's tmp-write /
+                            replace / dir-fsync dance — the WAL must
+                            survive intact and replay
+``spill.write``             ``enospc``/``eio``/``error`` fails that
+                            object spill write (key: object id hex) —
+                            proactive spill skips the object (it stays
+                            in memory), capacity-pressure spill degrades
+                            to in-memory retention + put backpressure
+``spill.restore``           fails/corrupts that spill read — the copy is
+                            treated as missing and the fetch ladder
+                            falls through to alternates/lineage
+``spill.delete``            fails the spill-file GC unlink (leaked file,
+                            never a correctness fault)
+``train.checkpoint_register`` fails the checkpoint commit dance
+                            (train/checkpointing.py): the previous
+                            checkpoint must stay loadable and the
+                            caller gets a typed CheckpointWriteError
+``flight.write``            fails the flight-recorder bundle write —
+                            incident capture is best-effort: shed with
+                            a counter, never an operator-visible error
 ==========================  =====================================================
 
 Peer-directed sites (``rpc.send``, ``object.transfer_fetch``,
@@ -145,6 +174,11 @@ CHAOS_KV_KEY = b"plan"
 METRIC_NAME = "ray_tpu_chaos_injected_total"
 CRASH_EXIT_CODE = 170  # distinguishable from user exits in worker logs
 
+#: Filesystem sites all speak the same action set; error is a generic
+#: injected OSError, enospc/eio carry the matching errno so callers'
+#: errno-discriminating paths are exercised.
+_FS_ACTIONS = frozenset({"error", "enospc", "eio"})
+
 #: Every injection site threaded through the runtime, with the actions
 #: that site understands (None = any action blackholes/fails the site).
 #: ``delay``/``latency`` are universally valid.  `ray-tpu chaos
@@ -174,6 +208,16 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "nodelet.peer_probe": None,
     "controller.admission_shed": frozenset({"force", "suppress"}),
     "rpc.lane_starve": frozenset(),
+    # Filesystem fault domain: error/enospc/eio raise OSError at the
+    # site (delay/latency = a blocking stall — a dying disk hangs).
+    "wal.append": _FS_ACTIONS,
+    "wal.fsync": _FS_ACTIONS,
+    "wal.snapshot": _FS_ACTIONS,
+    "spill.write": _FS_ACTIONS,
+    "spill.restore": _FS_ACTIONS,
+    "spill.delete": _FS_ACTIONS,
+    "train.checkpoint_register": _FS_ACTIONS,
+    "flight.write": _FS_ACTIONS,
 }
 _UNIVERSAL_ACTIONS = frozenset({"delay", "latency"})
 _RULE_KEYS = frozenset({"site", "action", "match", "delay_s", "once",
@@ -304,6 +348,32 @@ class FaultPlan:
             import asyncio
             await asyncio.sleep(max(0.0, act["delay_s"]))
         return act
+
+
+# ----------------------------------------------------- filesystem domain
+
+def fs_point(site: str, key: str = "") -> None:
+    """Evaluate a filesystem chaos site; raises the injected ``OSError``
+    (errno per action) or sleeps through a ``delay`` stall.
+
+    Filesystem sites run in sync context (``asyncio.to_thread`` workers,
+    the controller's deliberate fsync-per-append path), so the delay is
+    a BLOCKING sleep — exactly what a stalling fsync does to its caller.
+    """
+    if ACTIVE is None:
+        return
+    act = ACTIVE.point(site, key)
+    if act is None:
+        return
+    if act["action"] in _UNIVERSAL_ACTIONS:
+        time.sleep(max(0.0, act["delay_s"]))
+        return
+    import errno
+    import os
+    eno = {"enospc": errno.ENOSPC, "eio": errno.EIO}.get(
+        act["action"], errno.EIO)
+    raise OSError(eno, f"chaos[{act['rule_id']}]: injected "
+                       f"{os.strerror(eno)}", key or site)
 
 
 # ----------------------------------------------------------- arm / disarm

@@ -1,0 +1,770 @@
+"""Storage-fault tolerance: the filesystem chaos domain and every rung
+of its degradation ladder (PR-18).
+
+The invariant under test: **a disk fault is never an opaque task/write
+failure** — each subsystem degrades along its own ladder:
+
+* WAL append/fsync EIO   -> the store POISONS itself (fsyncgate: never
+  ack what wasn't persisted), the leader SELF-FENCES, the hot standby
+  promotes with zero acked-mutation loss.
+* spill ENOSPC           -> in-memory retention + put backpressure +
+  typed retriable ``StorageDegradedError``; never a failed task.
+* corrupt spill file     -> CRC mismatch == missing copy; the fetch
+  ladder falls through to lineage, garbage is never deserialized.
+* checkpoint ENOSPC      -> last good checkpoint kept + typed
+  ``CheckpointWriteError``.
+* flight-recorder EIO    -> capture shed with a counter (the recorder
+  observes incidents, it must never cause one).
+* disk watermarks        -> nodelet statvfs monitor flags low/red nodes
+  on heartbeats; red stops proactive spill + spill-target selection
+  and fires a ``disk_pressure`` incident bundle.
+
+Injection is seeded and plan-driven (util/fault_injection.py); the
+end-to-end scenarios run twice with fixed seeds and must behave
+identically.
+"""
+
+import asyncio
+import errno
+import json
+import os
+import threading
+import time
+import types
+
+import pytest
+
+import ray_tpu
+from ray_tpu import metrics, state
+from ray_tpu.core.config import GlobalConfig
+from ray_tpu.util import fault_injection as fi
+
+slow = pytest.mark.slow
+
+
+@pytest.fixture
+def chaos_cleanup():
+    yield
+    fi.disarm()
+    GlobalConfig.update({"chaos_plan": ""}, export_env=False)
+    os.environ.pop("RAY_TPU_CHAOS_PLAN", None)
+
+
+@pytest.fixture
+def spill_tmp(tmp_path):
+    """Route spill writes into an isolated tmp backend for the test."""
+    from ray_tpu.core import external_storage
+    GlobalConfig.update({"spill_storage_uri": f"file://{tmp_path}/sp"},
+                        export_env=False)
+    yield str(tmp_path / "sp")
+    GlobalConfig.update({"spill_storage_uri": ""}, export_env=False)
+    os.environ.pop("RAY_TPU_SPILL_STORAGE_URI", None)
+    external_storage.reset_storage()
+
+
+def _arm_env(plan):
+    GlobalConfig.update({"chaos_plan": json.dumps(plan)})
+
+
+def _metric_sum(text, name, tag=""):
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#") \
+                and tag in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+# ----------------------------------------------------- fs-site registry
+
+def test_fs_sites_validate_and_reject_foreign_actions(chaos_cleanup):
+    """`ray-tpu chaos validate` (registry-driven) must know every new
+    filesystem site with its error/enospc/eio + delay vocabulary."""
+    plan = [
+        {"site": "wal.append", "action": "eio", "match": {"nth": 1}},
+        {"site": "wal.fsync", "action": "enospc", "match": {"nth": 1}},
+        {"site": "wal.snapshot", "action": "error", "match": {"nth": 1}},
+        {"site": "spill.write", "action": "enospc",
+         "match": {"prob": 1.0, "seed": 7}},
+        {"site": "spill.restore", "action": "eio", "match": {"nth": 1}},
+        {"site": "spill.delete", "action": "error", "match": {"nth": 1}},
+        {"site": "train.checkpoint_register", "action": "enospc",
+         "match": {"nth": 1}},
+        {"site": "flight.write", "action": "eio", "match": {"nth": 1}},
+        # fsync-stall flavor: universal delay applies to fs sites too
+        {"site": "wal.fsync", "action": "delay", "delay_s": 0.01},
+    ]
+    assert fi.validate_plan(plan) == []
+    # an RPC-flavored action on an fs site is a plan bug, not a no-op
+    issues = fi.validate_plan(
+        [{"site": "wal.fsync", "action": "drop"}])
+    assert issues and "wal.fsync" in issues[0]
+
+
+def test_fs_point_raises_typed_oserrors(chaos_cleanup):
+    fi.arm([
+        {"site": "spill.write", "action": "enospc", "match": {"nth": 1}},
+        {"site": "wal.fsync", "action": "eio", "match": {"nth": 1}},
+        {"site": "flight.write", "action": "error", "match": {"nth": 1}},
+    ])
+    with pytest.raises(OSError) as e1:
+        fi.fs_point("spill.write", "aa")
+    assert e1.value.errno == errno.ENOSPC
+    with pytest.raises(OSError) as e2:
+        fi.fs_point("wal.fsync", "leader:kv_put")
+    assert e2.value.errno == errno.EIO
+    with pytest.raises(OSError) as e3:
+        fi.fs_point("flight.write", "b")
+    assert e3.value.errno == errno.EIO  # "error" defaults to EIO
+    # chaos errors are attributable to their rule in logs
+    assert "chaos[" in str(e1.value)
+    # spent rules: the site is quiet again
+    fi.fs_point("spill.write", "aa")
+
+
+def test_fs_point_delay_is_fsync_stall_not_error(chaos_cleanup):
+    fi.arm([{"site": "wal.fsync", "action": "delay", "delay_s": 0.05,
+             "match": {"nth": 1}}])
+    t0 = time.monotonic()
+    fi.fs_point("wal.fsync", "x:kv_put")  # stalls, must not raise
+    assert time.monotonic() - t0 >= 0.04
+
+
+# ------------------------------------------------- WAL poison (fsyncgate)
+
+def test_wal_append_error_poisons_store(tmp_path, chaos_cleanup):
+    """First append OSError: counted, raised as the typed WalWriteError,
+    and the store is POISONED — every later append refuses without
+    touching the file.  Acking writes a WAL cannot persist is the
+    fsyncgate failure mode; self-fencing is the only exit."""
+    from ray_tpu.core.persistence import ControllerStore
+    from ray_tpu.exceptions import WalWriteError
+
+    st = ControllerStore(str(tmp_path / "wal"), fsync=False)
+    st.append("kv_put", "u", b"a", b"1")
+    fi.arm([{"site": "wal.append", "action": "eio",
+             "match": {"nth": 1, "regex": "^wal:"}}])
+    with pytest.raises(WalWriteError) as ei:
+        st.append("kv_put", "u", b"b", b"2")
+    assert ei.value.op == "append"
+    assert st.poisoned and st.timing["append_errors"] == 1
+    fi.disarm()
+    # poison persists past the injection: no append ever again
+    with pytest.raises(WalWriteError):
+        st.append("kv_put", "u", b"c", b"3")
+    assert st.timing["append_errors"] == 1, \
+        "poisoned-refusal is not a new fs error"
+    # the pre-fault prefix is intact on disk
+    st2 = ControllerStore(str(tmp_path / "wal"), fsync=False)
+    assert st2.load()["kv"]["u"] == {b"a": b"1"}
+    st2.close()
+
+
+def test_wal_fsync_error_poisons_store(tmp_path, chaos_cleanup):
+    from ray_tpu.core.persistence import ControllerStore
+    from ray_tpu.exceptions import WalWriteError
+
+    st = ControllerStore(str(tmp_path / "wal"), fsync=True)
+    fi.arm([{"site": "wal.fsync", "action": "eio",
+             "match": {"nth": 1, "regex": "^wal:"}}])
+    with pytest.raises(WalWriteError) as ei:
+        st.append("kv_put", "u", b"a", b"1")
+    assert ei.value.op == "fsync"
+    assert st.poisoned and st.timing["fsync_errors"] == 1
+    fi.disarm()
+    with pytest.raises(WalWriteError):
+        st.append("kv_put", "u", b"b", b"2")
+
+
+def test_fsync_dir_propagates_oserror(tmp_path, monkeypatch):
+    """fsync_dir used to swallow OSError — a silently skipped directory
+    fsync is exactly the fsyncgate bug class."""
+    from ray_tpu.core import persistence
+
+    def boom(fd):
+        raise OSError(errno.EIO, "injected")
+
+    monkeypatch.setattr(persistence.os, "fsync", boom)
+    with pytest.raises(OSError):
+        persistence.fsync_dir(str(tmp_path))
+
+
+def test_wal_snapshot_failure_keeps_wal_and_never_poisons(
+        tmp_path, chaos_cleanup):
+    """Compaction is an optimization: a snapshot hitting ENOSPC rolls
+    back, keeps the WAL, counts the error, and appends continue."""
+    from ray_tpu.core.persistence import ControllerStore
+
+    st = ControllerStore(str(tmp_path / "wal"), fsync=False)
+    st.append("kv_put", "u", b"a", b"1")
+    fi.arm([{"site": "wal.snapshot", "action": "enospc",
+             "match": {"nth": 1}}])
+    assert st.snapshot({"kv": {"u": {b"a": b"1"}}}) is False
+    assert st.timing["snapshot_errors"] >= 1
+    assert st.poisoned is None, "snapshot failure must NOT poison"
+    st.append("kv_put", "u", b"b", b"2")     # appends keep working
+    fi.disarm()
+    assert st.snapshot({"kv": {"u": {b"a": b"1", b"b": b"2"}}}) is True
+    st.close()
+    st2 = ControllerStore(str(tmp_path / "wal"), fsync=False)
+    assert st2.load()["kv"]["u"] == {b"a": b"1", b"b": b"2"}
+    st2.close()
+
+
+def test_wal_errors_metric_folds_from_timing(tmp_path, chaos_cleanup):
+    from ray_tpu.core import runtime_metrics as rtm
+    from ray_tpu.core.persistence import ControllerStore
+    from ray_tpu.exceptions import WalWriteError
+
+    st = ControllerStore(str(tmp_path / "wal"), fsync=False)
+    fi.arm([{"site": "wal.append", "action": "eio",
+             "match": {"nth": 1, "regex": "^wal:"}}])
+    with pytest.raises(WalWriteError):
+        st.append("kv_put", "u", b"a", b"1")
+    fi.disarm()
+    rtm.fold_wal_timing(st)
+    text = metrics.prometheus_text()
+    assert "# TYPE ray_tpu_controller_wal_errors_total counter" in text
+    assert _metric_sum(text, "ray_tpu_controller_wal_errors_total",
+                       'op="append"') >= 1
+
+
+# --------------------------------- self-fence -> standby promotion (e2e)
+
+async def _pair(tmp, lease_timeout=1.0):
+    from ray_tpu.core.controller import Controller
+    leader = Controller(port=0, persist_dir=f"{tmp}/leader",
+                        lease_timeout_s=lease_timeout)
+    await leader.start()
+    standby = Controller(port=0, persist_dir=f"{tmp}/standby",
+                         standby_of=leader.address,
+                         lease_timeout_s=lease_timeout)
+    await standby.start()
+    deadline = time.monotonic() + 10
+    while leader.ha.standby is None and time.monotonic() < deadline:
+        await asyncio.sleep(0.05)
+    assert leader.ha.standby is not None, "standby never registered"
+    return leader, standby
+
+
+async def _dial(ctrl):
+    from ray_tpu.core import rpc
+    host, port = ctrl.address.rsplit(":", 1)
+    return await rpc.connect(host, int(port))
+
+
+@pytest.mark.parametrize("run", [1, 2])
+def test_wal_fsync_eio_self_fence_promotes_standby(
+        tmp_path, chaos_cleanup, run):
+    """Acceptance (a): WAL fsync EIO on the live leader — it must
+    SELF-FENCE (never ack a write it could not persist) and hand off to
+    the hot standby; every previously ACKED mutation survives; the
+    un-persistable write is answered in-band with ``_not_leader`` so
+    the client re-dials.  ×2 identical runs — injection is seeded."""
+    from ray_tpu.core.persistence import WAL_FSYNC_SITE
+
+    async def main():
+        tmp = str(tmp_path / f"r{run}")
+        leader, standby = await _pair(tmp)
+        try:
+            conn = await _dial(leader)
+            assert await conn.call(
+                "kv_put", {"ns": "u", "key": b"acked", "value": b"1"})
+            epoch0 = leader.ha.epoch
+            fi.arm([{"site": WAL_FSYNC_SITE, "action": "eio",
+                     "match": {"prob": 1.0, "seed": run,
+                               "regex": "^leader:kv_put"}}])
+            r = await conn.call(
+                "kv_put", {"ns": "u", "key": b"doomed", "value": b"2"})
+            assert isinstance(r, dict) and r.get("_not_leader"), \
+                f"un-persistable write must not ack: {r!r}"
+            assert leader.ha.fenced and not leader.ha.is_leader
+            # renewals stopped with the fence: the standby's lease
+            # lapses and it promotes at epoch+1
+            t0 = time.monotonic()
+            while not standby.ha.is_leader \
+                    and time.monotonic() - t0 < 15:
+                await asyncio.sleep(0.05)
+            assert standby.ha.is_leader, "standby never promoted"
+            assert standby.ha.epoch == epoch0 + 1
+            c2 = await _dial(standby)
+            # zero acked mutations lost; the unacked one is nowhere
+            assert await c2.call("kv_get",
+                                 {"ns": "u", "key": b"acked"}) == b"1"
+            assert await c2.call("kv_get",
+                                 {"ns": "u", "key": b"doomed"}) is None
+            assert await c2.call(
+                "kv_put", {"ns": "u", "key": b"after", "value": b"3"})
+            await c2.close()
+            await conn.close()
+            text = metrics.prometheus_text()
+            assert _metric_sum(
+                text, "ray_tpu_controller_failovers_total",
+                'outcome="self_fenced"') >= 1
+            assert _metric_sum(
+                text, "ray_tpu_controller_failovers_total",
+                'outcome="promoted"') >= 1
+            assert leader.pstore.timing["fsync_errors"] >= 1
+        finally:
+            fi.disarm()
+            await standby.stop()
+            await leader.stop()
+    asyncio.run(main())
+
+
+# ------------------------------------------------- spill CRC integrity
+
+def test_spill_crc_roundtrip_and_trailer(spill_tmp, chaos_cleanup):
+    from ray_tpu.core import external_storage, spill
+
+    payload = os.urandom(4096)
+    url = spill.write_object(b"o" * 20, [memoryview(payload)])
+    # read back through the one restore funnel: CRC verified
+    assert spill.read_file(url) == payload
+    # the trailer is physically on disk
+    fpath = url[7:] if url.startswith("file://") else url
+    fpath = fpath.split("?", 1)[0]
+    raw = open(fpath, "rb").read()
+    assert raw[:-8] == payload and external_storage.SPILL_CRC_MAGIC \
+        in raw[-8:]
+
+
+def test_spill_corrupt_file_is_a_missing_copy(spill_tmp, chaos_cleanup):
+    """A truncated/bit-flipped spill file must never deserialize: the
+    CRC check drops the copy (read_file -> None == missing) and the
+    fetch ladder falls through to alternates/lineage."""
+    from ray_tpu.core import spill
+
+    payload = os.urandom(4096)
+    url = spill.write_object(b"p" * 20, [memoryview(payload)])
+    fpath = url[7:] if url.startswith("file://") else url
+    fpath = fpath.split("?", 1)[0]
+    good = open(fpath, "rb").read()
+    flipped = bytearray(good)
+    flipped[100] ^= 0xFF
+    open(fpath, "wb").write(bytes(flipped))
+    assert spill.read_file(url) is None
+    # a torn write (hole mid-payload, trailer intact) is corruption too
+    open(fpath, "wb").write(good[:50] + good[60:])
+    assert spill.read_file(url) is None
+    text = metrics.prometheus_text()
+    assert _metric_sum(text, "ray_tpu_storage_faults_total",
+                       'outcome="corrupt_dropped"') >= 2
+
+
+def test_spill_legacy_trailerless_file_still_restores(
+        spill_tmp, chaos_cleanup):
+    """Pre-CRC spill files (no trailer) keep restoring — rolling
+    upgrades must not orphan existing external storage."""
+    from ray_tpu.core import spill
+
+    payload = os.urandom(512)
+    url = spill.write_object(b"q" * 20, [memoryview(payload)])
+    fpath = url[7:] if url.startswith("file://") else url
+    fpath = fpath.split("?", 1)[0]
+    open(fpath, "wb").write(payload)   # strip the trailer: v0 format
+    assert spill.read_file(url) == payload
+
+
+def test_spill_restore_fault_counts_missing(spill_tmp, chaos_cleanup):
+    from ray_tpu.core import spill
+
+    url = spill.write_object(b"r" * 20, [memoryview(b"x" * 256)])
+    fi.arm([{"site": "spill.restore", "action": "eio",
+             "match": {"nth": 1}}])
+    assert spill.read_file(url) is None
+    assert spill.read_file(url) == b"x" * 256  # rule spent: readable
+    text = metrics.prometheus_text()
+    assert _metric_sum(text, "ray_tpu_storage_faults_total",
+                       'site="spill.restore"') >= 1
+
+
+def test_spill_delete_fault_leaks_with_counter(spill_tmp, chaos_cleanup):
+    from ray_tpu.core import spill
+
+    url = spill.write_object(b"s" * 20, [memoryview(b"y" * 256)])
+    fi.arm([{"site": "spill.delete", "action": "eio",
+             "match": {"nth": 1}}])
+    spill.delete_file(url)             # must not raise
+    text = metrics.prometheus_text()
+    assert _metric_sum(text, "ray_tpu_storage_faults_total",
+                       'outcome="leaked"') >= 1
+
+
+# ------------------------------------- proactive-spill retention (unit)
+
+def test_proactive_spill_oserror_retains_in_memory(chaos_cleanup):
+    """The nodelet's proactive spill hitting a disk fault DEGRADES: the
+    primary copy stays pinned in memory (counted ``retained``), the
+    loop moves on — never an exception out of the pressure-relief
+    path."""
+    from ray_tpu.core.nodelet import Nodelet
+
+    class StubStore:
+        def get(self, oid, timeout_ms=0):
+            return memoryview(b"z" * 64)
+
+    async def failing_spill_locked(oid, view):
+        raise OSError(errno.ENOSPC, "injected")
+
+    stub = types.SimpleNamespace(
+        store=StubStore(), _primary_pins={b"o" * 20: 64},
+        _spilling=set(), _spill_tombstones=set(),
+        _spill_locked=failing_spill_locked)
+    before = metrics.prometheus_text()
+    n0 = _metric_sum(before, "ray_tpu_storage_faults_total",
+                     'outcome="retained"')
+    ok = asyncio.run(Nodelet._spill_one(stub, b"o" * 20))
+    assert ok is False
+    assert b"o" * 20 in stub._primary_pins, "object must stay pinned"
+    assert not stub._spilling
+    after = metrics.prometheus_text()
+    assert _metric_sum(after, "ray_tpu_storage_faults_total",
+                       'outcome="retained"') == n0 + 1
+
+
+# --------------------------------------- ENOSPC spill wave (acceptance b)
+
+@pytest.mark.parametrize("run", [1, 2])
+def test_enospc_spill_wave_backpressures_zero_failures(
+        chaos_cleanup, run):
+    """Acceptance (b): ENOSPC injected across a spill-heavy put wave —
+    the wave completes with ZERO task failures (backpressure + retry,
+    typed errors only on exhaustion) and the degradation is visible in
+    ``ray_tpu_storage_faults_total``.  ×2 identical seeded runs."""
+    import numpy as np
+
+    _arm_env([{"site": "spill.write", "action": "enospc",
+               "match": {"nth": [1, 2, 4], "seed": run}}])
+    ray_tpu.init(num_cpus=2, object_store_memory=16 * 1024 * 1024,
+                 system_config={"spill_backpressure_delay_s": 0.05})
+    try:
+        blobs = [np.full(4 * 1024 * 1024, i, dtype=np.uint8)
+                 for i in range(8)]   # 32 MiB > 16 MiB store: must spill
+        refs = [ray_tpu.put(b) for b in blobs]
+
+        @ray_tpu.remote
+        def head(arr):
+            return int(arr[0])
+
+        # zero task failures, zero lost objects
+        assert ray_tpu.get([head.remote(r) for r in refs],
+                           timeout=120.0) == list(range(8))
+        for i, r in enumerate(refs):
+            assert ray_tpu.get(r, timeout=60.0)[0] == i
+        text = metrics.prometheus_text()
+        assert _metric_sum(text, "ray_tpu_storage_faults_total",
+                           'outcome="backpressured"') >= 1, \
+            "degradation must be visible, not silent"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_spill_exhaustion_raises_typed_retriable_error(
+        spill_tmp, chaos_cleanup):
+    """When backpressure budget runs dry the caller gets the typed
+    retriable StorageDegradedError — never a bare OSError."""
+    from ray_tpu.core.driver import CoreClient
+    from ray_tpu.exceptions import StorageDegradedError
+
+    GlobalConfig.update({"spill_backpressure_retries": 2,
+                         "spill_backpressure_delay_s": 0.01},
+                        export_env=False)
+    try:
+        fi.arm([{"site": "spill.write", "action": "enospc",
+                 "match": {"prob": 1.0, "seed": 3}}])
+        stub = types.SimpleNamespace()
+        with pytest.raises(StorageDegradedError) as ei:
+            CoreClient._spill_backpressured(stub, b"t" * 20,
+                                            [memoryview(b"v" * 64)])
+        assert ei.value.retry_after_s > 0
+        text = metrics.prometheus_text()
+        assert _metric_sum(text, "ray_tpu_storage_faults_total",
+                           'outcome="backpressured"') >= 3
+    finally:
+        GlobalConfig.update({"spill_backpressure_retries": 8,
+                             "spill_backpressure_delay_s": 0.25},
+                            export_env=False)
+
+
+# --------------------------------------------- checkpoint durability
+
+def test_checkpoint_enospc_keeps_previous_loadable(
+        tmp_path, chaos_cleanup):
+    """Satellite: checkpoint ENOSPC/EIO — the previous checkpoint stays
+    registered and loadable, the failure surfaces as the typed
+    CheckpointWriteError, and a later retry lands."""
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.exceptions import CheckpointWriteError
+    from ray_tpu.train.checkpointing import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.register(1, Checkpoint.from_dict({"step": 1}))
+    fi.arm([{"site": "train.checkpoint_register", "action": "enospc",
+             "match": {"nth": 1}}])
+    with pytest.raises(CheckpointWriteError) as ei:
+        mgr.register(2, Checkpoint.from_dict({"step": 2}))
+    assert "previous checkpoint kept" in str(ei.value)
+    assert mgr.latest_iteration == 1
+    assert mgr.latest_checkpoint.to_dict()["step"] == 1
+    # no torn staging dirs left behind
+    leftovers = [n for n in os.listdir(str(tmp_path / "ckpt"))
+                 if ".tmp-" in n]
+    assert leftovers == []
+    fi.disarm()
+    mgr.register(2, Checkpoint.from_dict({"step": 2}))  # retry lands
+    assert mgr.latest_iteration == 2
+    text = metrics.prometheus_text()
+    assert _metric_sum(text, "ray_tpu_storage_faults_total",
+                       'outcome="kept_previous"') >= 1
+
+
+def test_checkpoint_reregister_failure_keeps_old_dir(
+        tmp_path, chaos_cleanup):
+    """Re-registration of an existing iteration failing mid-dance must
+    leave the OLD complete dir in place, never a hole."""
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.exceptions import CheckpointWriteError
+    from ray_tpu.train.checkpointing import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    path = mgr.register(5, Checkpoint.from_dict({"v": "old"}))
+    fi.arm([{"site": "train.checkpoint_register", "action": "eio",
+             "match": {"nth": 1}}])
+    with pytest.raises(CheckpointWriteError):
+        mgr.register(5, Checkpoint.from_dict({"v": "new"}))
+    assert os.path.isdir(path)
+    assert Checkpoint.from_directory(path).to_dict()["v"] == "old"
+
+
+def test_checkpoint_chaos_composes_with_snapshot_put(chaos_cleanup):
+    """The new fs site composes with the elastic-train chaos site in one
+    plan: both validate together and fire independently."""
+    plan = [
+        {"site": "train.checkpoint_register", "action": "enospc",
+         "match": {"nth": 1}},
+        {"site": "train.snapshot_put", "action": "error",
+         "match": {"nth": 1}},
+    ]
+    assert fi.validate_plan(plan) == []
+    fi.arm(plan)
+    assert fi.ACTIVE.point("train.snapshot_put", "w0") is not None
+    with pytest.raises(OSError):
+        fi.fs_point("train.checkpoint_register", "checkpoint_000001")
+
+
+# ------------------------------------------- flight-recorder shedding
+
+def test_flight_write_is_shed_with_counter(tmp_path, chaos_cleanup):
+    from ray_tpu.core.flight_recorder import FlightRecorder, list_bundles
+
+    GlobalConfig.update({"flight_recorder_dir": str(tmp_path / "fr")},
+                        export_env=False)
+    try:
+        fr = FlightRecorder(controller=None)
+        bundle = {p: {} for p in
+                  ("meta", "spans", "metrics", "events", "nodes")}
+        fi.arm([{"site": "flight.write", "action": "eio",
+                 "match": {"nth": 1}}])
+        out = fr._write("1000_manual", bundle)
+        assert out.startswith("<shed:"), out
+        assert list_bundles(str(tmp_path / "fr")) == []
+        text = metrics.prometheus_text()
+        assert _metric_sum(text, "ray_tpu_storage_faults_total",
+                           'site="flight.write"') >= 1
+        # rule spent: the next capture publishes a complete bundle
+        out2 = fr._write("2000_manual", bundle)
+        assert os.path.isdir(out2)
+        assert sorted(os.listdir(out2)) == [
+            "events.json", "meta.json", "metrics.json", "nodes.json",
+            "spans.json"]
+    finally:
+        GlobalConfig.update({"flight_recorder_dir": ""},
+                            export_env=False)
+
+
+# --------------------------------------------------- kvref gap (PR-17)
+
+def test_get_function_lost_kvref_raises_typed_error():
+    """Satellite: a kvref marker whose blob is GONE must surface the
+    typed FunctionUnavailableError (re-registration path), never an
+    opaque KeyError/ObjectLostError out of the function table."""
+    from ray_tpu.core import kvref
+    from ray_tpu.core.worker_runtime import WorkerRuntime
+    from ray_tpu.exceptions import (FunctionUnavailableError,
+                                    ObjectLostError)
+
+    fid = b"f" * 16
+
+    class Stub:
+        fn_cache = {}
+
+        async def _ctl_call_retry(self, method, data, timeout=30.0):
+            assert method == "kv_get"
+            return kvref.pack(b"o" * 20)   # marker survives...
+
+        async def _fetch_kvref(self, oid):
+            raise ObjectLostError(oid.hex(), "owner died")  # ...blob gone
+
+    with pytest.raises(FunctionUnavailableError) as ei:
+        asyncio.run(WorkerRuntime._get_function(Stub(), fid))
+    assert fid.hex()[:12] in str(ei.value)
+    assert "re-registration" in str(ei.value)
+
+
+def test_driver_fn_lost_reply_requeues_and_reregisters():
+    """An ``fn_lost``-tagged error reply re-registers the function from
+    the driver's cached blob (KV overwrite) and requeues the task
+    WITHOUT burning retry budget — bounded at 3 requeues."""
+    from ray_tpu.core.driver import CoreClient
+
+    fid, blob, tid = b"g" * 16, b"BLOB", b"t" * 16
+
+    class StubCore:
+        _handle_task_reply = CoreClient._handle_task_reply
+        _reregister_function = CoreClient._reregister_function
+        _is_spurious_cancel = staticmethod(
+            CoreClient._is_spurious_cancel.__func__
+            if isinstance(CoreClient._is_spurious_cancel, staticmethod)
+            else CoreClient._is_spurious_cancel)
+
+        def __init__(self):
+            self._cancelled = set()
+            self._spurious_requeues = {}
+            self._fn_requeues = {}
+            self._fn_blobs = {fid: blob}
+            self.registered = []
+            self.errors = []
+
+        def _register_function_inner(self, f, b, overwrite):
+            self.registered.append((f, b, overwrite))
+
+        def _store_error(self, spec, ev):
+            self.errors.append(ev)
+
+    core = StubCore()
+    spec = types.SimpleNamespace(
+        task_id=types.SimpleNamespace(binary=lambda: tid),
+        function_name="f", actor_id=None, retry_exceptions=False)
+    state_stub = types.SimpleNamespace(queue=[],
+                                       wakeup=threading.Event())
+    err = {"traceback": "tb", "pickled": None, "fname": "f",
+           "fn_lost": fid.hex()}
+    for i in range(3):
+        assert core._handle_task_reply(spec, {"error": err}, 2,
+                                       state_stub) is True
+        assert state_stub.queue.pop() == (spec, 2), \
+            "requeue must not burn the retry budget"
+    assert core.registered == [(fid, blob, True)] * 3, \
+        "re-registration must overwrite the KV marker"
+    # bounded: the 4th loss fails the task with the typed traceback
+    assert core._handle_task_reply(spec, {"error": err}, 2,
+                                   state_stub) is False
+    assert not state_stub.queue and len(core.errors) == 1
+    # unknown fid (nothing cached): no requeue loop either
+    err2 = dict(err, fn_lost=(b"h" * 16).hex())
+    core2 = StubCore()
+    assert core2._handle_task_reply(spec, {"error": err2}, 2,
+                                    state_stub) is False
+
+
+# ------------------------------------------- disk watermarks (acceptance c)
+
+def test_nodeview_disk_rides_the_wire():
+    from ray_tpu.core.scheduling import NodeView
+
+    v = NodeView("n1", "h:1", {"CPU": 1.0}, {"CPU": 1.0}, disk="red")
+    w = NodeView.from_wire(v.to_wire())
+    assert w.disk == "red"
+    # absent on old wires -> "ok"
+    d = v.to_wire()
+    d.pop("disk")
+    assert NodeView.from_wire(d).disk == "ok"
+
+
+def test_lease_spillback_skips_disk_red_peers():
+    """hybrid_policy over a disk-filtered view: the red peer loses its
+    spill-target eligibility; when EVERY candidate is red the filter is
+    soft and placement proceeds unfiltered."""
+    from ray_tpu.core.scheduling import NodeView, hybrid_policy
+    from ray_tpu.core.task_spec import ResourceSet
+
+    def views(red_ids, busy="me"):
+        out = {}
+        for nid in ("me", "peer_a", "peer_b"):
+            avail = {"CPU": 0.0} if nid == busy else {"CPU": 4.0}
+            out[nid] = NodeView(nid, f"{nid}:1", avail, {"CPU": 4.0},
+                                disk="red" if nid in red_ids else "ok")
+        return out
+
+    req = ResourceSet({"CPU": 1.0})
+    # mirrors nodelet._lease_inner's soft filter
+    def pick(red_ids):
+        vs = views(red_ids)
+        filtered = {nid: v for nid, v in vs.items()
+                    if nid == "me" or v.disk != "red"}
+        return hybrid_policy(filtered or vs, req, "me",
+                             spread_threshold=0.5)
+
+    assert pick(set()) in ("peer_a", "peer_b")
+    assert pick({"peer_a"}) == "peer_b"
+    assert pick({"peer_a", "peer_b"}) == "me", \
+        "all-red: soft filter must not strand the request"
+
+
+@pytest.mark.parametrize("run", [1, 2])
+def test_disk_red_node_flagged_and_disk_pressure_bundle(tmp_path, run):
+    """Acceptance (c): watermarks pinned below actual usage -> the node
+    goes RED within a monitor tick, the flag shows in state.nodes() /
+    the node-disk gauges, and a ``disk_pressure`` incident bundle is
+    captured.  (Proactive spill + spill-target exclusion on red are
+    unit-proven above; this proves the reporting pipeline end to end.)"""
+    from ray_tpu.core.flight_recorder import list_bundles
+
+    frdir = str(tmp_path / f"fr{run}")
+    ray_tpu.init(num_cpus=1, object_store_memory=64 * 1024 * 1024,
+                 system_config={
+                     "disk_monitor_interval_s": 0.1,
+                     "disk_low_water_frac": 1e-9,
+                     "disk_red_frac": 1e-9,   # any used byte == red
+                     "flight_recorder_dir": frdir,
+                     "flight_recorder_min_interval_s": 0.0})
+    try:
+        deadline = time.monotonic() + 30
+        row = None
+        while time.monotonic() < deadline:
+            rows = state.nodes()
+            if rows and rows[0].get("disk") == "red":
+                row = rows[0]
+                break
+            time.sleep(0.2)
+        assert row is not None, f"node never went red: {state.nodes()}"
+        assert row.get("disk_used_frac", 0) > 0
+        # the incident bundle fired on the red transition
+        while time.monotonic() < deadline:
+            if any("disk_pressure" in b for b in list_bundles(frdir)):
+                break
+            time.sleep(0.2)
+        assert any("disk_pressure" in b for b in list_bundles(frdir)), \
+            f"no disk_pressure bundle in {list_bundles(frdir)}"
+        # per-node disk gauges in the cluster scrape
+        deadline2 = time.monotonic() + 15
+        while time.monotonic() < deadline2:
+            text = state.cluster_metrics_text()
+            if _metric_sum(text, "ray_tpu_node_disk_state") >= 2:
+                break
+            time.sleep(0.2)
+        assert _metric_sum(text, "ray_tpu_node_disk_state") >= 2
+        assert "ray_tpu_node_disk_used_frac" in text
+    finally:
+        ray_tpu.shutdown()
+        for k in ("disk_monitor_interval_s", "disk_low_water_frac",
+                  "disk_red_frac", "flight_recorder_dir",
+                  "flight_recorder_min_interval_s"):
+            os.environ.pop(f"RAY_TPU_{k.upper()}", None)
+        GlobalConfig.update({"disk_monitor_interval_s": 1.0,
+                             "disk_low_water_frac": 0.85,
+                             "disk_red_frac": 0.95,
+                             "flight_recorder_dir": "",
+                             "flight_recorder_min_interval_s": 30.0},
+                            export_env=False)
