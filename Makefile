@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: all native cpp wheel test bench serve-bench spec-bench obs \
 	attr chaos drain failover spec elastic ha partition autoscale \
 	autoscale-bench serve-breakdown profile lint lint-fast overload \
-	diskfault clean
+	diskfault containment clean
 
 all: native cpp
 
@@ -54,6 +54,9 @@ chaos:
 # disk-health watermarks, and the fn_lost re-registration path.
 diskfault:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_diskfault.py -q
+
+containment:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_containment.py -q
 
 # Overload-protection suite (PR-17): priority RPC lanes, watermark
 # state machine + admission shedding, credit flow control, bounded

@@ -249,7 +249,45 @@ _d("lineage_cache_size", int, 100000,
    "Task specs retained per driver for lineage reconstruction.")
 _d("max_reconstruction_depth", int, 20,
    "Maximum recursion depth when reconstructing a chain of lost objects "
-   "(reference: object_recovery_manager.h recursive recovery).")
+   "(reference: object_recovery_manager.h recursive recovery); "
+   "exceeding it raises the typed ReconstructionDepthError carrying "
+   "the oid lineage chain.")
+_d("reconstruction_max_inflight", int, 8,
+   "Concurrent lineage reconstruction re-executions per owner process "
+   "(one driver owns its lineage, so for the common single-driver "
+   "cluster this is the cluster-wide cap).  Excess _reconstruct calls "
+   "wait for a slot; duplicates for the SAME object always dedupe onto "
+   "one in-flight future regardless of this cap — together they keep "
+   "one lost node from stampeding the scheduler with a re-execution "
+   "storm.")
+
+# --- blast-radius containment (crash ledger / quarantine) -------------------
+_d("poison_task_threshold", int, 3,
+   "Poison-shaped worker deaths (SIGSEGV family, oom_kill, clean "
+   "nonzero exit) for ONE task signature within poison_window_s that "
+   "quarantine the signature: further executions fail fast with the "
+   "typed PoisonTaskError (evidence trail attached) instead of burning "
+   "more workers.  0 disables task quarantine.")
+_d("poison_window_s", float, 60.0,
+   "Sliding window of the controller's crash ledger: only worker kills "
+   "within this window count toward poison_task_threshold, so a task "
+   "that crashes once a day never accumulates into a quarantine.")
+_d("poison_quarantine_ttl_s", float, 300.0,
+   "Seconds a poison quarantine (task signature or crash-looped actor) "
+   "stands before it auto-expires and executions are allowed again; "
+   "`ray-tpu quarantine clear` lifts it early.")
+_d("actor_restart_backoff_base_s", float, 0.2,
+   "Base of the full-jitter exponential backoff between actor restarts "
+   "(attempt n waits uniform(0, min(cap, base*2^n)) measured over "
+   "restarts inside actor_restart_window_s) — a crash-looping "
+   "constructor no longer respawns workers back-to-back.")
+_d("actor_restart_backoff_cap_s", float, 30.0,
+   "Cap of the actor restart backoff envelope.")
+_d("actor_restart_window_s", float, 600.0,
+   "Rolling window of actor restart accounting: the max_restarts "
+   "budget applies to restarts WITHIN this window (a long-lived actor "
+   "crashing once a day keeps a full budget), and exhausting it on "
+   "poison-shaped deaths parks the actor QUARANTINED instead of DEAD.")
 
 # --- robustness / chaos -----------------------------------------------------
 _d("chaos_plan", str, "",

@@ -32,6 +32,11 @@ PENDING_CREATION = "PENDING_CREATION"
 ALIVE = "ALIVE"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
+# Crash-loop quarantine: restart budget exhausted inside the rolling
+# window by poison-shaped deaths.  Terminal for callers (they get the
+# typed error) but NOT forever — the quarantine TTL or an operator
+# `ray-tpu quarantine clear` moves the actor back to RESTARTING.
+QUARANTINED = "QUARANTINED"
 
 
 class _DrainDeadline(Exception):
@@ -54,6 +59,15 @@ class ActorRecord:
         self.num_restarts = 0
         self.death_cause: Optional[str] = None
         self.owner_conn_id: Optional[int] = None
+        # rolling-window restart accounting: [wall_ts, node, cause] per
+        # restart consumed — only stamps inside actor_restart_window_s
+        # count against max_restarts, so a long-lived actor that crashes
+        # once a day is not condemned (persisted; evidence on quarantine)
+        self.restart_stamps: List[list] = []
+        # earliest monotonic time the scheduler may place the next
+        # incarnation (full-jitter exponential backoff between restarts;
+        # runtime-only — a restored controller restarts immediately)
+        self.restart_at: float = 0.0
         # wait_actor futures resolved at the ALIVE/DEAD FSM transition
         self.waiters: List[asyncio.Future] = []
         # nodes that recently reported actor-cap saturation → expiry time
@@ -65,6 +79,7 @@ class ActorRecord:
                 "address": self.address, "node_id": self.node_id,
                 "name": self.name, "num_restarts": self.num_restarts,
                 "death_cause": self.death_cause,
+                "quarantined": self.state == QUARANTINED,
                 "class_name": self.spec.get("fname", "")}
 
 
@@ -135,6 +150,16 @@ class Controller:
         self.suspects: Dict[str, float] = {}
         self.actors: Dict[bytes, ActorRecord] = {}
         self.named_actors: Dict[str, bytes] = {}
+        # -- blast-radius containment ------------------------------------
+        # crash ledger: task/actor signature -> recent death hits
+        # [{ts, node, cause, poison}], pruned to poison_window_s.  In-
+        # memory only — individual hits are cheap to re-accumulate after
+        # a failover; the *decisions* below are what must survive.
+        self.crash_ledger: Dict[str, List[dict]] = {}
+        # poison quarantine: signature -> WAL-persisted record
+        # {sig, kind, since, until, evidence[, actor_id]} — rides
+        # heartbeat replies so every lease desk fails the signature fast
+        self.quarantine: Dict[str, dict] = {}
         self.pgs: Dict[bytes, PGRecord] = {}
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.object_dir: Dict[bytes, Set[str]] = {}       # oid -> node ids
@@ -236,7 +261,8 @@ class Controller:
                 "max_restarts": rec.max_restarts, "detached": rec.detached,
                 "state": rec.state, "address": rec.address,
                 "node_id": rec.node_id, "num_restarts": rec.num_restarts,
-                "death_cause": rec.death_cause}
+                "death_cause": rec.death_cause,
+                "restart_stamps": rec.restart_stamps}
 
     @staticmethod
     def _pg_to_disk(pg: "PGRecord") -> dict:
@@ -255,6 +281,8 @@ class Controller:
             "jobs": {jid: info for jid, info in self.jobs.items()},
             "draining_nodes": list(self.draining),
             "suspect_nodes": list(self.suspects),
+            "quarantine": {sig: dict(rec)
+                           for sig, rec in self.quarantine.items()},
             "ha_epoch": self.ha.epoch,
         }
 
@@ -283,6 +311,8 @@ class Controller:
             rec.node_id = d.get("node_id")
             rec.num_restarts = d.get("num_restarts", 0)
             rec.death_cause = d.get("death_cause")
+            rec.restart_stamps = [list(s) for s in
+                                  d.get("restart_stamps", [])]
             if rec.state in (PENDING_CREATION, RESTARTING):
                 rec.node_id = None  # reschedule once nodes re-register
             self.actors[rec.actor_id] = rec
@@ -306,6 +336,11 @@ class Controller:
         # the restarted grace runs out with no peer reaching it
         for nid in state.get("suspect_nodes", []):
             self.suspects[nid] = time.monotonic()
+        # quarantines survive the restart/promotion intact: a poison
+        # signature must not get a fresh blast radius just because the
+        # controller moved (TTL keeps running on the persisted `until`)
+        self.quarantine = {sig: dict(rec) for sig, rec in
+                           state.get("quarantine", {}).items()}
 
     # ------------------------------------------------------------------ setup
     def _register_handlers(self):
@@ -324,6 +359,8 @@ class Controller:
                      "report_event", "list_events",
                      "subscribe", "publish", "register_job", "finish_job",
                      "list_nodes", "report_worker_failure", "actor_alive",
+                     "report_task_crash", "quarantine_list",
+                     "quarantine_clear",
                      "drain_node", "ping", "metrics_text", "credit_request",
                      "rpc_attribution", "metrics_history", "debug_capture",
                      "chaos_plan", "chaos_claim",
@@ -532,6 +569,7 @@ class Controller:
         await self.ha.start()
         self._tasks.append(asyncio.ensure_future(self._health_check_loop()))
         self._tasks.append(asyncio.ensure_future(self._actor_scheduler_loop()))
+        self._tasks.append(asyncio.ensure_future(self._quarantine_ttl_loop()))
         from ..util import tracing
         tracing.configure("controller")
         tracing.claim_flusher()
@@ -767,6 +805,10 @@ class Controller:
         # flow control rides the heartbeat: submission credits plus the
         # overload state (nodelets pause optional work under brownout)
         reply["overload"] = self.overload.state
+        # poison-quarantine table (tiny) rides every beat: lease desks
+        # cluster-wide fail a quarantined signature fast, and clears /
+        # TTL expiries lift within one heartbeat period
+        reply["quarantine"] = self.quarantine
         if data.get("want_credits"):
             reply["credits"] = self.overload.credits_for()
         known = data.get("view_version", -1)
@@ -1264,6 +1306,7 @@ class Controller:
             for actor in list(self.actors.values()):
                 if actor.state in (PENDING_CREATION, RESTARTING) \
                         and actor.node_id is None \
+                        and time.monotonic() >= actor.restart_at \
                         and not getattr(actor, "scheduling", False):
                     actor.scheduling = True
                     asyncio.ensure_future(self._schedule_one(actor))
@@ -1374,8 +1417,8 @@ class Controller:
 
     def _notify_actor_waiters(self, actor: ActorRecord):
         """Resolve every parked ``wait_actor`` future at the FSM
-        transition that settles it (ALIVE or DEAD) — waiters are
-        event-driven, not poll-driven."""
+        transition that settles it (ALIVE, DEAD or QUARANTINED) —
+        waiters are event-driven, not poll-driven."""
         for fut in actor.waiters:
             if not fut.done():
                 fut.set_result(actor.state)
@@ -1387,7 +1430,7 @@ class Controller:
             return {"error": "no such actor"}
         timeout = data.get("timeout", 60.0)
         deadline = time.monotonic() + timeout
-        while actor.state not in (ALIVE, DEAD):
+        while actor.state not in (ALIVE, DEAD, QUARANTINED):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return {"state": actor.state, "timeout": True}
@@ -1432,11 +1475,120 @@ class Controller:
         if actor_id:
             actor = self.actors.get(actor_id)
             if actor is not None:
-                await self._on_actor_failure(actor, data.get("reason", "worker crashed"))
+                await self._on_actor_failure(
+                    actor, data.get("reason", "worker crashed"),
+                    cause=data.get("cause"))
         return True
 
+    # ------------------------------------------------- poison quarantine
+    def _quarantine_put(self, rec: dict) -> None:
+        """Declare one quarantine: WAL it (it must survive failover),
+        count it, capture an incident bundle, tell the operator."""
+        self.quarantine[rec["sig"]] = rec
+        self._p("quarantine", rec)
+        rtm.QUARANTINES.inc(tags={"kind": rec.get("kind", "task")})
+        nodes = sorted({e.get("node", "")[:12]
+                        for e in rec.get("evidence", ())})
+        self._emit_event(
+            "ERROR", "controller",
+            f"{rec.get('kind', 'task')} signature {rec['sig']!r} "
+            f"quarantined as poison after "
+            f"{len(rec.get('evidence', ()))} worker deaths on "
+            f"{len(nodes)} node(s) {nodes}; clears at TTL or "
+            f"`ray-tpu quarantine clear`", sig=rec["sig"])
+        self.flight.trigger(
+            "crash_loop",
+            f"{rec.get('kind', 'task')} signature {rec['sig']} "
+            f"quarantined ({len(rec.get('evidence', ()))} deaths)",
+            sig=rec["sig"])
+
+    def _quarantine_remove(self, sig: str, reason: str) -> bool:
+        rec = self.quarantine.pop(sig, None)
+        if rec is None:
+            return False
+        self._p("quarantine_del", sig)
+        self._emit_event("INFO", "controller",
+                         f"quarantine lifted for {sig!r} ({reason})",
+                         sig=sig)
+        aid = rec.get("actor_id")
+        if aid is not None:
+            actor = self.actors.get(bytes.fromhex(aid))
+            if actor is not None and actor.state == QUARANTINED:
+                # budget refreshed: the crash-loop actor gets another
+                # rolling window of restarts
+                actor.state = RESTARTING
+                actor.death_cause = None
+                actor.restart_stamps = []
+                actor.restart_at = 0.0
+                self._p("actor", self._actor_to_disk(actor))
+                self._pending_actor_wakeup.set()
+        return True
+
+    async def _h_report_task_crash(self, conn, data):
+        """Crash-ledger entry from a nodelet whose leased worker died.
+
+        Every leased death lands here (the cause carries its shape);
+        only POISON-shaped causes count toward the quarantine threshold
+        — preemption-shaped deaths (chaos kills, planned kills) retry
+        freely forever.  The reply returns the fresh verdict plus the
+        window's crash sites, so the reporting nodelet (and the driver
+        blocked on its death-info query) see the ledger state with zero
+        propagation latency."""
+        sig = data["sig"]
+        cause = data.get("cause") or {}
+        now = time.time()
+        win = GlobalConfig.poison_window_s
+        hits = self.crash_ledger.setdefault(sig, [])
+        hits.append({"ts": now, "node": data.get("node_id", ""),
+                     "cause": cause.get("kind", "unknown"),
+                     "poison": bool(cause.get("poison"))})
+        hits[:] = [h for h in hits if now - h["ts"] <= win]
+        q = self.quarantine.get(sig)
+        thr = GlobalConfig.poison_task_threshold
+        if q is None and thr > 0 \
+                and sum(1 for h in hits if h["poison"]) >= thr:
+            q = {"sig": sig, "kind": "task", "since": now,
+                 "until": now + GlobalConfig.poison_quarantine_ttl_s,
+                 "evidence": [{"ts": h["ts"], "node": h["node"],
+                               "cause": h["cause"]} for h in hits]}
+            self._quarantine_put(q)
+        return {"quarantined": q,
+                "avoid": sorted({h["node"] for h in hits if h["node"]})}
+
+    async def _h_quarantine_list(self, conn, data):
+        return sorted(self.quarantine.values(),
+                      key=lambda r: r.get("since", 0))
+
+    async def _h_quarantine_clear(self, conn, data):
+        sigs = [data["sig"]] if data.get("sig") else list(self.quarantine)
+        return {"cleared": [s for s in sigs if self._quarantine_remove(
+            s, "cleared by operator")]}
+
+    async def _quarantine_ttl_loop(self):
+        """Leader-only expiry sweep.  TTL expiry NEVER happens inside
+        WAL replay (_apply is clock-free by lint); the runtime loop
+        appends an explicit `quarantine_del`, so replicas replay the
+        same decision instead of re-deriving it from their own clocks."""
+        while True:
+            await asyncio.sleep(0.5)
+            if not self.ha.is_leader:
+                continue
+            now = time.time()
+            for sig, rec in list(self.quarantine.items()):
+                if now >= rec.get("until", 0):
+                    try:
+                        self._quarantine_remove(sig, "TTL expired")
+                    except WalWriteError:
+                        break  # fenced: the new leader owns expiry now
+            for sig, hits in list(self.crash_ledger.items()):
+                hits[:] = [h for h in hits
+                           if now - h["ts"] <= GlobalConfig.poison_window_s]
+                if not hits:
+                    del self.crash_ledger[sig]
+
     async def _on_actor_failure(self, actor: ActorRecord, reason: str,
-                                intended: bool = False):
+                                intended: bool = False,
+                                cause: Optional[dict] = None):
         if actor.state == DEAD:
             return
         if actor.actor_id in self._migrating and actor.worker_id is None \
@@ -1449,11 +1601,53 @@ class Controller:
         actor.address = None
         actor.worker_id = None
         actor.node_id = None
-        if not intended and actor.num_restarts < actor.max_restarts:
+        # Rolling-window restart accounting: only stamps inside the
+        # window hold budget (num_restarts stays the lifetime total for
+        # observability).
+        now_wall = time.time()
+        win = GlobalConfig.actor_restart_window_s
+        actor.restart_stamps = [s for s in actor.restart_stamps
+                                if now_wall - s[0] <= win]
+        used = len(actor.restart_stamps)
+        kind = (cause or {}).get("kind", "?")
+        node = (cause or {}).get("node", "")
+        if not intended and used < actor.max_restarts:
+            actor.restart_stamps.append([now_wall, node, kind])
             actor.num_restarts += 1
             rtm.ACTORS_RESTARTED.inc()
             actor.state = RESTARTING
+            # full-jitter exponential backoff between incarnations: a
+            # crash-looping constructor must not grind the scheduler
+            # (and its node's worker pool) at restart_delay granularity
+            from ..util.backoff import ExponentialBackoff
+            bo = ExponentialBackoff(
+                base=GlobalConfig.actor_restart_backoff_base_s,
+                cap=GlobalConfig.actor_restart_backoff_cap_s)
+            bo.attempt = used
+            actor.restart_at = time.monotonic() + bo.next_delay()
             self._pending_actor_wakeup.set()
+        elif not intended and actor.max_restarts > 0 \
+                and bool((cause or {}).get("poison")) \
+                and GlobalConfig.poison_task_threshold > 0:
+            # budget exhausted INSIDE the window by poison-shaped deaths:
+            # crash loop — quarantine instead of a terminal DEAD, so the
+            # TTL (or an operator clear) can give it another window
+            actor.state = QUARANTINED
+            actor.death_cause = f"crash loop ({used} restarts in " \
+                                f"{win:.0f}s window): {reason}"
+            sig = (f"actor:{actor.spec.get('fname', '?')}:"
+                   f"{actor.actor_id.hex()[:12]}")
+            if sig not in self.quarantine:
+                self._quarantine_put({
+                    "sig": sig, "kind": "actor", "since": now_wall,
+                    "until": now_wall +
+                    GlobalConfig.poison_quarantine_ttl_s,
+                    "actor_id": actor.actor_id.hex(),
+                    "evidence": [{"ts": s[0], "node": s[1],
+                                  "cause": s[2]}
+                                 for s in actor.restart_stamps]
+                    + [{"ts": now_wall, "node": node, "cause": kind}]})
+            self._notify_actor_waiters(actor)
         else:
             actor.state = DEAD
             actor.death_cause = reason

@@ -16,7 +16,9 @@ direct_task_transport.cc:174).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures as cf
 import os
+import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -177,6 +179,9 @@ class _SchedulingKeyState:
         self.leases = 0                      # leases held or being acquired
         self.busy = 0                        # lease loops executing a task
         self.wakeup = asyncio.Event()
+        # crash-site anti-affinity: node ids this key's workers recently
+        # died on (from death-info evidence) — retries spread elsewhere
+        self.avoid: set = set()
 
 
 class _ActorState:
@@ -188,6 +193,7 @@ class _ActorState:
         self.seq = 0
         self.lock: Optional[asyncio.Lock] = None
         self.dead_reason: Optional[str] = None
+        self.quarantined = False   # crash-loop quarantine (typed error)
 
 
 class CoreClient(DeferredRefDecs):
@@ -271,6 +277,19 @@ class CoreClient(DeferredRefDecs):
         self._cancelled: set = set()   # task_ids cancel() was called on
         self._task_sites: Dict[bytes, rpc.Connection] = {}  # running tasks
         self._spurious_requeues: Dict[bytes, int] = {}
+        # Reconstruction-storm governance: concurrent _reconstruct calls
+        # for the SAME oid collapse onto one in-flight future, and total
+        # concurrent resubmissions are capped by the semaphore — an
+        # evicted fan-out must not resubmit its producer N times.
+        self._recon_lock = threading.Lock()
+        self._recon_inflight: Dict[bytes, "cf.Future"] = {}
+        self._recon_sem = threading.BoundedSemaphore(
+            max(1, GlobalConfig.reconstruction_max_inflight))
+        # Quarantine verdicts this driver has already seen, keyed by
+        # function name: later submissions of the same signature fail
+        # fast HERE, without racing the heartbeat that propagates the
+        # verdict to nodelet lease checks (entries honor the TTL)
+        self._poison_sigs: Dict[str, dict] = {}
         self.lt.spawn(self._deferred_dec_loop())
         if mode == "driver":
             # lifecycle-span identity + KV flush (worker processes flush
@@ -674,38 +693,80 @@ class CoreClient(DeferredRefDecs):
         return False
 
     def _reconstruct(self, oid: bytes, timeout: Optional[float],
-                     _depth: int = 0) -> bool:
+                     _depth: int = 0, _chain: tuple = ()) -> bool:
         """Multi-level lineage reconstruction (reference:
         `object_recovery_manager.h:96-106`): resubmit the task that created
         the lost object, first recursively reconstructing any of its
         argument objects that are themselves lost — so a chain a→b→c
-        recovers end-to-end after the whole chain is evicted."""
+        recovers end-to-end after the whole chain is evicted.
+
+        Storm governance: concurrent callers for the same oid dedupe
+        onto ONE in-flight reconstruction (the rest wait on its future),
+        and crossing the lineage-depth ceiling raises the typed
+        ``ReconstructionDepthError`` carrying the oid chain instead of
+        collapsing into a generic ObjectLostError."""
+        chain = _chain + (oid,)
         if _depth > GlobalConfig.max_reconstruction_depth:
-            return False
+            raise exceptions.ReconstructionDepthError(chain)
+        with self._recon_lock:
+            fut = self._recon_inflight.get(oid)
+            owner = fut is None
+            if owner:
+                fut = cf.Future()
+                self._recon_inflight[oid] = fut
+        if not owner:
+            rtm.RECONSTRUCTION_DEDUP.inc()
+            try:
+                return bool(fut.result(timeout=(timeout or 60.0) + 30.0))
+            except cf.TimeoutError:
+                return False
+        try:
+            ok = self._reconstruct_inner(oid, timeout, _depth, chain)
+            fut.set_result(ok)
+            return ok
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            with self._recon_lock:
+                self._recon_inflight.pop(oid, None)
+
+    def _reconstruct_inner(self, oid: bytes, timeout: Optional[float],
+                           _depth: int, chain: tuple) -> bool:
         spec = self._lineage.get(oid)
         if spec is None:
             return False
         for arg_oid in {o.binary() if hasattr(o, "binary") else o
                         for o in spec.arg_ref_ids()}:
             if not self._object_available(arg_oid):
-                if not self._reconstruct(arg_oid, timeout, _depth + 1):
+                if not self._reconstruct(arg_oid, timeout, _depth + 1,
+                                         chain):
                     return False
-        # The resubmitted task's reply releases one local ref per arg
-        # (_handle_task_reply) — take those refs NOW or the user's own
-        # handles get over-decremented (and freed) by the recovery.
-        for arg_oid in spec.arg_ref_ids():
-            self._add_local_ref(arg_oid.binary())
-        self.lt.spawn(self._submit_pipeline(spec, spec.max_retries))
-        deadline = time.monotonic() + (timeout or 60.0)
-        while time.monotonic() < deadline:
-            if self.store.contains(oid):
-                return True
-            r = self.nodelet.call("pull", {"object_id": oid,
-                                           "timeout": 1.0}, timeout=11)
-            if r.get("ok"):
-                return True
-            time.sleep(0.2)
-        return False
+        # Resubmission concurrency cap: recursion above runs OUTSIDE the
+        # permit (a parent never holds one while a child waits), so deep
+        # chains cannot deadlock the bounded pool.
+        if not self._recon_sem.acquire(timeout=(timeout or 60.0)):
+            return False
+        try:
+            rtm.RECONSTRUCTION_EXECUTED.inc()
+            # The resubmitted task's reply releases one local ref per arg
+            # (_handle_task_reply) — take those refs NOW or the user's own
+            # handles get over-decremented (and freed) by the recovery.
+            for arg_oid in spec.arg_ref_ids():
+                self._add_local_ref(arg_oid.binary())
+            self.lt.spawn(self._submit_pipeline(spec, spec.max_retries))
+            deadline = time.monotonic() + (timeout or 60.0)
+            while time.monotonic() < deadline:
+                if self.store.contains(oid):
+                    return True
+                r = self.nodelet.call("pull", {"object_id": oid,
+                                               "timeout": 1.0}, timeout=11)
+                if r.get("ok"):
+                    return True
+                time.sleep(0.2)
+            return False
+        finally:
+            self._recon_sem.release()
 
     def _tie_pin_to_value(self, oid: bytes, value: Any):
         import weakref
@@ -1017,16 +1078,25 @@ class CoreClient(DeferredRefDecs):
         try:
             while state.queue:
                 spec0, _ = state.queue[0]
-                grant = await self._acquire_lease(spec0)
+                grant = await self._acquire_lease(spec0, state)
                 if grant is None:
                     while state.queue:
                         spec, _ = state.queue.popleft()
                         self._fail_task(spec, "could not lease a worker "
                                               "(infeasible or timeout)")
                     return
-                nodelet_conn, lease_id, worker_addr = grant
+                if isinstance(grant, dict):
+                    # the signature is quarantined as poison: fail the
+                    # whole queue fast with the typed evidence trail
+                    # instead of burning workers one retry at a time
+                    while state.queue:
+                        spec, _ = state.queue.popleft()
+                        self._fail_poisoned(spec, grant["poisoned"])
+                    return
+                nodelet_conn, lease_id, worker_addr, worker_id = grant
                 try:
-                    await self._drain_through_worker(state, worker_addr)
+                    await self._drain_through_worker(
+                        state, worker_addr, nodelet_conn, worker_id)
                 except rpc.RpcError:
                     # Worker vanished between grant and connect (crash
                     # window before the nodelet reaps it); re-lease.
@@ -1040,22 +1110,34 @@ class CoreClient(DeferredRefDecs):
         finally:
             state.leases -= 1
 
-    async def _acquire_lease(self, spec: TaskSpec):
+    async def _acquire_lease(self, spec: TaskSpec,
+                             state: Optional[_SchedulingKeyState] = None):
+        rec = self._poison_sigs.get(spec.function_name)
+        if rec is not None:
+            if rec.get("until", 0.0) > time.time():
+                return {"poisoned": rec}
+            self._poison_sigs.pop(spec.function_name, None)
         addr = self.nodelet_addr
         deadline = time.monotonic() + GlobalConfig.lease_request_timeout_s
         while time.monotonic() < deadline:
             try:
                 conn = await self._nodelet_conn(addr)
-                reply = await conn.call("lease", {"spec": spec.to_wire(),
-                                                  "timeout": 5.0}, timeout=20)
+                reply = await conn.call(
+                    "lease", {"spec": spec.to_wire(), "timeout": 5.0,
+                              "avoid": sorted(state.avoid)
+                              if state is not None else []},
+                    timeout=20)
             except rpc.RpcError:
                 # Target nodelet unreachable (e.g. died): fall back local.
                 self._nodelet_conns.pop(addr, None)
                 addr = self.nodelet_addr
                 await asyncio.sleep(0.2)
                 continue
+            if reply.get("poisoned"):
+                return {"poisoned": reply["poisoned"]}
             if reply.get("granted"):
-                return conn, reply["lease_id"], reply["worker_addr"]
+                return (conn, reply["lease_id"], reply["worker_addr"],
+                        reply["worker_id"])
             if reply.get("spillback"):
                 addr = reply["spillback"]
                 continue
@@ -1081,7 +1163,9 @@ class CoreClient(DeferredRefDecs):
         return None
 
     async def _drain_through_worker(self, state: _SchedulingKeyState,
-                                    worker_addr: str):
+                                    worker_addr: str,
+                                    nodelet_conn=None,
+                                    worker_id: Optional[bytes] = None):
         """Drain queued tasks through one leased worker, PIPELINED.
 
         Up to ``task_pipeline_depth`` push_task calls ride the connection
@@ -1124,14 +1208,34 @@ class CoreClient(DeferredRefDecs):
                 reply = fut.result()
             except rpc.RpcError as e:
                 self._worker_conns.pop(worker_addr, None)
+                # typed death attribution: ask the granting nodelet WHY
+                # before deciding the retry (blocks this dead lease only)
+                death = None
+                if tid not in self._cancelled and worker_id is not None:
+                    death = await self._query_death(nodelet_conn,
+                                                    worker_id)
+                if death:
+                    state.avoid.update(death.get("avoid") or ())
                 if tid in self._cancelled:
                     # force-cancel killed the worker: that IS the cancel
                     self._finish_cancel(spec)
+                elif death and death.get("quarantined"):
+                    # the controller just declared this signature poison:
+                    # fail fast with the typed evidence trail
+                    self._fail_poisoned(spec, death["quarantined"])
                 elif attempts_left > 0:
+                    # jittered pause before the re-lease: lets the crash
+                    # report land so anti-affinity steers the retry, and
+                    # decorrelates a wave of dead leases re-leasing
+                    await asyncio.sleep(GlobalConfig.task_retry_delay_s
+                                        * (0.5 + random.random()))
                     state.queue.appendleft((spec, attempts_left - 1))
                 else:
+                    why = (f" ({death['cause']}: {death['detail']})"
+                           if death and death.get("cause") else "")
                     self._fail_task(spec,
-                                    f"worker died executing task: {e}")
+                                    f"worker died executing task: "
+                                    f"{e}{why}")
                 worker_dead = True
                 return True
             self._handle_task_reply(spec, reply, attempts_left, state)
@@ -1295,6 +1399,34 @@ class CoreClient(DeferredRefDecs):
 
     def _fail_task(self, spec: TaskSpec, reason: str):
         self._store_error(spec, _ErrorValue(reason, None, spec.function_name))
+
+    async def _query_death(self, nodelet_conn, worker_id: bytes):
+        """Best-effort typed death attribution from the granting
+        nodelet; None when the nodelet is unreachable or the corpse was
+        never classified (the caller falls back to plain retry)."""
+        if nodelet_conn is None:
+            return None
+        try:
+            r = await nodelet_conn.call(
+                "worker_death_info",
+                {"worker_id": worker_id, "timeout": 2.0}, timeout=10)
+        except (rpc.RpcError, OSError, asyncio.TimeoutError):
+            return None
+        return r if isinstance(r, dict) and not r.get("unknown") else None
+
+    def _fail_poisoned(self, spec: TaskSpec, record: dict):
+        """Fulfill a quarantined task's refs with the typed
+        PoisonTaskError carrying the evidence trail."""
+        self._poison_sigs[spec.function_name] = record
+        err = exceptions.PoisonTaskError(
+            record.get("sig", spec.function_name),
+            record.get("evidence"), record.get("until", 0.0))
+        try:
+            pickled = serialization.dumps_function(err)
+        except Exception:
+            pickled = None
+        self._store_error(spec, _ErrorValue(str(err), pickled,
+                                            spec.function_name))
 
     # ---------------------------------------------------------------- cancel
     def cancel(self, ref: "ObjectRef", *, force: bool = False) -> bool:
@@ -1496,9 +1628,14 @@ class CoreClient(DeferredRefDecs):
             info = await self._wait_actor_info(state.actor_id, timeout=30)
             st = info.get("state")
             if st == "ALIVE" and info.get("address"):
+                state.quarantined = False
                 break
             if st == "DEAD":
                 state.dead_reason = info.get("death_cause") or "DEAD"
+                return None
+            if st == "QUARANTINED":
+                state.dead_reason = info.get("death_cause") or "QUARANTINED"
+                state.quarantined = True
                 return None
             if time.monotonic() > deadline:
                 state.dead_reason = f"still {st} after creation timeout"
@@ -1513,9 +1650,20 @@ class CoreClient(DeferredRefDecs):
         return state.conn
 
     def _fail_actor_task(self, spec: TaskSpec, state: _ActorState):
+        pickled = None
+        if state.quarantined:
+            # typed: callers distinguish a crash-loop quarantine (may
+            # clear via TTL/operator) from a terminal death
+            try:
+                pickled = serialization.dumps_function(
+                    exceptions.ActorQuarantinedError(
+                        state.actor_id.hex(),
+                        state.dead_reason or "crash loop"))
+            except Exception:
+                pickled = None
         self._store_error(spec, _ErrorValue(
             f"actor {state.actor_id.hex()[:12]} is dead: {state.dead_reason}",
-            None, spec.function_name, is_actor=True, actor_down=True))
+            pickled, spec.function_name, is_actor=True, actor_down=True))
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         state = self._actors.get(actor_id)

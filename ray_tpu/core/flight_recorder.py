@@ -45,7 +45,7 @@ from .config import GlobalConfig
 AUTO_TRIGGERS = ("node_suspect", "node_dead", "controller_failover",
                  "drain_deadline", "elastic_repair", "oom_kill",
                  "compile_storm", "slo_breach", "overload",
-                 "disk_pressure")
+                 "disk_pressure", "crash_loop")
 
 FLIGHT_WRITE_SITE = "flight.write"
 

@@ -17,11 +17,12 @@ from __future__ import annotations
 
 import asyncio
 import os
+import signal
 import subprocess
 import sys
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Set
 
 from . import rpc, runtime_metrics as rtm, spill, worker_zygote
@@ -67,6 +68,11 @@ class WorkerProc:
         self.lease_id: Optional[bytes] = None
         self.actor_id: Optional[bytes] = None
         self.conn: Optional[rpc.Connection] = None
+        # function name of the task signature this worker was last leased
+        # for — the death classifier's signature source (a worker chaos-
+        # killed at execution start dies before it ever reports
+        # task_state, so _running_tasks alone cannot attribute it)
+        self.leased_fname: Optional[str] = None
 
     @property
     def address(self) -> str:
@@ -184,6 +190,26 @@ class Nodelet:
         # heartbeat into the controller's view/state.nodes().
         self.disk_health: Dict[str, Any] = {
             "state": "ok", "used_frac": 0.0, "free_bytes": 0}
+        # -- blast-radius containment (typed death attribution) ---------
+        # Kills WE initiated are recorded against the worker id BEFORE
+        # the kill signal goes out, so the reap-loop classifier can tell
+        # a chaos preemption / OOM kill / operator kill apart from a
+        # genuine crash (which counts against poison quarantine).
+        self._chaos_kills: Set[bytes] = set()
+        self._oom_victims: Set[bytes] = set()
+        self._intended_kills: Set[bytes] = set()
+        # classified deaths, bounded, keyed by worker id — drivers whose
+        # worker connection dropped ask `worker_death_info` here before
+        # deciding whether the task is retry-worthy
+        self._recent_deaths: "OrderedDict[bytes, dict]" = OrderedDict()
+        # poison-quarantine view (sig -> record) absorbed from
+        # controller heartbeat replies and crash-report replies: leases
+        # for a quarantined signature fail fast with the evidence trail
+        self._quarantine_view: Dict[str, dict] = {}
+        # crash-site anti-affinity: sig -> {node_id -> wall expiry} —
+        # retries of a recently-crashed signature spread away from the
+        # nodes it already died on (soft: never empties the candidates)
+        self._crash_sites: Dict[str, Dict[str, float]] = {}
         # bounded metrics-history ring (core/metrics_history.py),
         # sampled by a start() task, served via `metrics_history`
         from .metrics_history import MetricsRing
@@ -203,7 +229,7 @@ class Nodelet:
                      "chaos_injected", "serve_metrics",
                      "drain", "drain_status", "drain_evacuate",
                      "drain_complete", "detach_kill_worker",
-                     "peer_probe", "probe_peer_now"):
+                     "peer_probe", "probe_peer_now", "worker_death_info"):
             s.register(name, getattr(self, "_h_" + name))
 
     @property
@@ -517,6 +543,12 @@ class Nodelet:
                         "overload", self._ctl_overload)
                     if "credits" in reply:
                         self._ctl_credits = int(reply["credits"])
+                    if "quarantine" in reply:
+                        # full-table sync (tiny): quarantines declared
+                        # elsewhere fail-fast at OUR lease desk too, and
+                        # TTL expiries / operator clears lift them here
+                        self._quarantine_view = dict(
+                            reply["quarantine"] or {})
                 if reply and reply.get("_not_leader"):
                     # beat landed on a deposed/standby controller: find
                     # the current leader and re-register there
@@ -707,11 +739,64 @@ class Nodelet:
                     w.proc.kill()
                     await self._on_worker_death(w)
 
+    def _classify_death(self, w: WorkerProc) -> dict:
+        """Attribute one worker corpse to a typed cause.
+
+        ``poison`` shapes the retry decision downstream: preemption-
+        shaped deaths (chaos kills, planned kills) retry freely, while
+        poison-shaped ones (real signals, OOM kills, nonzero exits)
+        count against the controller's quarantine threshold.  Kills this
+        nodelet initiated were pre-recorded against the worker id, so
+        the returncode alone never has to guess."""
+        if fi.ACTIVE is not None and fi.ACTIVE.point(
+                "nodelet.death_classify", w.worker_id.hex()) is not None:
+            # attribution subsystem degraded by chaos: conservative —
+            # an unexplained corpse counts as poison, never as free retry
+            return {"kind": "unknown", "poison": True,
+                    "detail": "death attribution degraded (chaos)"}
+        if w.worker_id in self._intended_kills:
+            return {"kind": "intended_kill", "poison": False,
+                    "detail": "operator/controller-requested kill"}
+        if w.worker_id in self._chaos_kills:
+            return {"kind": "chaos_kill", "poison": False,
+                    "detail": "chaos-injected kill (preemption-shaped)"}
+        if w.worker_id in self._oom_victims:
+            return {"kind": "oom_kill", "poison": True,
+                    "detail": "nodelet memory monitor killed the worker"}
+        rc = w.proc.returncode
+        if rc is not None and rc < 0:
+            try:
+                name = signal.Signals(-rc).name
+            except ValueError:
+                name = f"SIG{-rc}"
+            return {"kind": f"signal:{name}", "poison": True,
+                    "detail": f"terminated by {name}"}
+        if rc == fi.CRASH_EXIT_CODE:
+            # the chaos layer's own crash action exits with a reserved
+            # code precisely so it reads as injected, not as user poison
+            return {"kind": "chaos_kill", "poison": False,
+                    "detail": f"chaos crash exit ({rc})"}
+        if rc:
+            return {"kind": f"exit:{rc}", "poison": True,
+                    "detail": f"exited with code {rc}"}
+        return {"kind": "exit:0", "poison": False, "detail": "clean exit"}
+
+    def _note_crash_sites(self, sig: str, nodes) -> None:
+        if not nodes:
+            return
+        expiry = time.time() + GlobalConfig.poison_window_s
+        site = self._crash_sites.setdefault(sig, {})
+        for nid in nodes:
+            site[nid] = expiry
+
     async def _on_worker_death(self, w: WorkerProc):
         prev_state = w.state
         w.state = "dead"
         self.workers.pop(w.worker_id, None)
         rtm.WORKERS_DIED.inc(tags=self._mnode)
+        cause = self._classify_death(w)
+        rtm.TASK_DEATHS.inc(tags={"node": self._mnode["node"],
+                                  "cause": cause["kind"]})
         # The worker's batched finish event may have died in its buffer;
         # the process is gone, so its "running" entry is stale by
         # definition — close it out as interrupted.
@@ -722,16 +807,50 @@ class Nodelet:
                 "worker_id": w.worker_id.hex(),
                 "task_id": run.get("task_id", ""),
                 "start": run.get("start"), "end": time.time(),
-                "interrupted": True})
+                "interrupted": True, "cause": cause["kind"]})
+        death = {"worker_id": w.worker_id.hex(), "ts": time.time(),
+                 "node_id": self.node_id.hex(), "cause": cause["kind"],
+                 "poison": cause["poison"], "detail": cause["detail"],
+                 "quarantined": None, "avoid": []}
         if prev_state == "leased" and w.lease_id in self.leases:
             lease = self.leases.pop(w.lease_id)
             self.available.release(lease.resources)
             await self._notify_lease_waiters()
+            fname = w.leased_fname or (run or {}).get("name")
+            if fname:
+                # Crash ledger report — SYNCHRONOUS on purpose: the
+                # reply carries any quarantine verdict plus the crash-
+                # site set, and the driver's death-info query blocks on
+                # this entry, so a poison signature is contained after
+                # the threshold with zero propagation latency.
+                death["sig"] = f"task:{fname}"
+                try:
+                    r = await self.controller.call("report_task_crash", {
+                        "sig": death["sig"],
+                        "node_id": self.node_id.hex(),
+                        "cause": {"kind": cause["kind"],
+                                  "poison": cause["poison"],
+                                  "node": self.node_id.hex()},
+                    }, timeout=5)
+                    if isinstance(r, dict):
+                        death["quarantined"] = r.get("quarantined")
+                        death["avoid"] = r.get("avoid") or []
+                        if r.get("quarantined"):
+                            self._quarantine_view[death["sig"]] = \
+                                r["quarantined"]
+                        self._note_crash_sites(death["sig"],
+                                               death["avoid"])
+                except (rpc.RpcError, OSError, asyncio.TimeoutError):
+                    pass
         if prev_state == "actor" and w.actor_id is not None:
             try:
                 await self.controller.call("report_worker_failure", {
                     "actor_id": w.actor_id,
-                    "reason": f"worker process exited with code {w.proc.returncode}",
+                    "reason": f"worker died: {cause['kind']} "
+                              f"({cause['detail']})",
+                    "cause": {"kind": cause["kind"],
+                              "poison": cause["poison"],
+                              "node": self.node_id.hex()},
                 })
             except rpc.RpcError:
                 pass
@@ -742,10 +861,35 @@ class Nodelet:
                 w.actor_resources = None
                 self.available.release(res)
                 await self._notify_lease_waiters()
+        # publish for driver death-info queries (bounded ring), then
+        # retire the one-shot attribution marks
+        self._recent_deaths[w.worker_id] = death
+        while len(self._recent_deaths) > 256:
+            self._recent_deaths.popitem(last=False)
+        self._chaos_kills.discard(w.worker_id)
+        self._oom_victims.discard(w.worker_id)
+        self._intended_kills.discard(w.worker_id)
         if (prev_state in ("idle", "starting") and not self._stopping
                 and not self._drain_finished
                 and len(self.workers) < GlobalConfig.worker_pool_initial_size):
             await self._spawn_worker()
+
+    async def _h_worker_death_info(self, conn, data):
+        """Driver-side death attribution: after a worker connection
+        drops, the driver asks the granting nodelet WHY before deciding
+        to retry.  Parks briefly for the reap loop + crash-ledger round
+        trip, so the reply reflects any quarantine the controller just
+        declared — closing the window where a poison task could burn
+        extra workers between the kill and the next heartbeat."""
+        wid = data.get("worker_id")
+        deadline = time.monotonic() + min(3.0, data.get("timeout", 2.0))
+        while True:
+            d = self._recent_deaths.get(wid)
+            if d is not None:
+                return d
+            if time.monotonic() > deadline:
+                return {"unknown": True}
+            await asyncio.sleep(0.05)
 
     # ------------------------------------------------------- memory monitor
     @staticmethod
@@ -813,6 +957,9 @@ class Nodelet:
                       file=sys.stderr, flush=True)
                 self._oom_kills = getattr(self, "_oom_kills", 0) + 1
                 rtm.OOM_KILLS.inc(tags=self._mnode)
+                # marked BEFORE the kill: the reap loop attributes the
+                # corpse to us, not to a mystery SIGKILL
+                self._oom_victims.add(victim.worker_id)
                 victim.proc.kill()
                 try:
                     await self.controller.notify("report_event", {
@@ -1156,6 +1303,12 @@ class Nodelet:
         so one code path covers them all.
         """
         spec = TaskSpec.from_wire(data["spec"])
+        q = self._poisoned(spec.function_name)
+        if q is not None:
+            # poison quarantine: fail fast with the evidence trail
+            # instead of burning another worker on a known-bad signature
+            return {"poisoned": q}
+        avoid = set(data.get("avoid") or ())
         request = spec.resources
         strategy = spec.scheduling_strategy
         deadline = time.monotonic() + data.get("timeout",
@@ -1168,7 +1321,7 @@ class Nodelet:
         t_req = time.time()
         try:
             reply = await self._lease_inner(spec, request, strategy,
-                                            deadline, my_id)
+                                            deadline, my_id, avoid)
             if fi.ACTIVE is not None and reply.get("granted"):
                 act = fi.ACTIVE.point("nodelet.lease", spec.function_name)
                 if act is not None and act["action"] == "kill_worker":
@@ -1177,6 +1330,9 @@ class Nodelet:
                     # driver's re-lease/retry semantics
                     w = self.workers.get(reply["worker_id"])
                     if w is not None:
+                        # pre-attributed: the classifier must read this
+                        # corpse as injected preemption, not poison
+                        self._chaos_kills.add(w.worker_id)
                         asyncio.get_event_loop().call_later(
                             max(0.0, act["delay_s"]),
                             lambda proc=w.proc: proc.poll() is None
@@ -1196,7 +1352,26 @@ class Nodelet:
             self._lease_waiters -= 1
             self._demand_tokens.pop(tok, None)
 
-    async def _lease_inner(self, spec, request, strategy, deadline, my_id):
+    def _poisoned(self, fname: str) -> Optional[dict]:
+        """Active quarantine record for a task signature, if any."""
+        rec = self._quarantine_view.get(f"task:{fname}")
+        if rec is not None and rec.get("until", 0) > time.time():
+            return rec
+        return None
+
+    def _crash_site_nodes(self, fname: str) -> Set[str]:
+        """Nodes this signature recently died on (anti-affinity)."""
+        site = self._crash_sites.get(f"task:{fname}")
+        if not site:
+            return set()
+        now = time.time()
+        live = {n for n, exp in site.items() if exp > now}
+        if not live:
+            self._crash_sites.pop(f"task:{fname}", None)
+        return live
+
+    async def _lease_inner(self, spec, request, strategy, deadline, my_id,
+                           avoid=None):
         # Arg-locality hint for the connectivity matrix: the task's ref
         # args are fetchable from (at least) this submitting node, so a
         # spillback target that freshly reported it cannot reach US
@@ -1218,6 +1393,18 @@ class Nodelet:
             views = {nid: v for nid, v in self.view.items()
                      if nid == my_id or getattr(v, "disk", "ok") != "red"}
             views = views if views else self.view
+            # Crash-site anti-affinity, SOFT like the filters above: the
+            # driver's death-info evidence plus our own crash-site view
+            # steer a recently-crashed signature away from the nodes it
+            # already died on — ruling out a bad host without ever
+            # emptying the candidate set.
+            shun = set(avoid or ()) | self._crash_site_nodes(
+                spec.function_name)
+            if shun:
+                spread = {nid: v for nid, v in views.items()
+                          if nid not in shun}
+                if spread:
+                    views = spread
             if self.draining:
                 # never grant here again: spill to a live peer when one
                 # fits, else tell the driver to retry (it re-evaluates
@@ -1254,6 +1441,7 @@ class Nodelet:
                     self.available.acquire(request)
                     worker.state = "leased"
                     worker.lease_id = lease_id
+                    worker.leased_fname = spec.function_name
                     self.leases[lease_id] = Lease(lease_id, worker, request)
                     self._refresh_self_view()
                     rtm.LEASES_GRANTED.inc(tags=self._mnode)
@@ -1374,6 +1562,7 @@ class Nodelet:
     async def _h_kill_worker_at(self, conn, data):
         for w in self.workers.values():
             if w.address == data["address"] and w.proc.poll() is None:
+                self._intended_kills.add(w.worker_id)
                 w.proc.terminate()
                 return True
         return False
@@ -1387,6 +1576,7 @@ class Nodelet:
         for w in self.workers.values():
             if w.address == data["address"] and w.proc.poll() is None:
                 w.actor_id = None
+                self._intended_kills.add(w.worker_id)
                 w.proc.terminate()
                 return True
         return False

@@ -287,7 +287,7 @@ class ControllerStore:
 def _empty_tables() -> Dict[str, Any]:
     return {"kv": {}, "actors": {}, "pgs": {}, "jobs": {},
             "named_actors": {}, "draining_nodes": [], "suspect_nodes": [],
-            "ha_epoch": 0}
+            "quarantine": {}, "ha_epoch": 0}
 
 
 def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
@@ -338,6 +338,15 @@ def _apply(state: Dict[str, Any], rec: List[Any]) -> None:
         nodes = state.setdefault("suspect_nodes", [])
         if rec[1] in nodes:
             nodes.remove(rec[1])
+    elif op == "quarantine":
+        # a poison quarantine was imposed (task signature or crash-
+        # looped actor): a restarted/promoted controller must keep
+        # failing the signature fast — the record carries its own wall
+        # timestamps (since/until/evidence), stamped by the HANDLER, so
+        # replay stays clock-free and deterministic
+        state.setdefault("quarantine", {})[rec[1]["sig"]] = rec[1]
+    elif op == "quarantine_del":
+        state.setdefault("quarantine", {}).pop(rec[1], None)
     elif op == "epoch":
         # leader-lease epoch: monotonic across failovers; a controller
         # must never serve at an epoch below one it has durably seen

@@ -32,6 +32,29 @@ WORKERS_DIED = m.Counter(
 OOM_KILLS = m.Counter(
     "ray_tpu_oom_kills_total",
     "Workers killed by the memory monitor", ("node",))
+TASK_DEATHS = m.Counter(
+    "ray_tpu_task_deaths_total",
+    "Worker deaths classified by the nodelet's death attributor, by "
+    "typed cause (signal:<NAME> | oom_kill | exit:<code> | chaos_kill | "
+    "node_death | unknown) — poison-shaped causes feed the controller's "
+    "crash ledger, preemption-shaped ones retry freely",
+    ("node", "cause"))
+QUARANTINES = m.Counter(
+    "ray_tpu_quarantines_total",
+    "Poison quarantines imposed by the controller's crash ledger, by "
+    "kind (task: a signature hit poison_task_threshold kills inside "
+    "poison_window_s | actor: a crash-looping actor exhausted its "
+    "rolling restart window on poison-shaped deaths)", ("kind",))
+RECONSTRUCTION_DEDUP = m.Counter(
+    "ray_tpu_reconstruction_dedup_total",
+    "Lineage reconstruction requests that joined an already in-flight "
+    "reconstruction of the same object instead of re-executing its "
+    "producer again (owner-side storm governance)", ())
+RECONSTRUCTION_EXECUTED = m.Counter(
+    "ray_tpu_reconstruction_executed_total",
+    "Lineage reconstructions that actually resubmitted the producing "
+    "task (the re-execution amplification numerator against "
+    "dedup_total)", ())
 LEASES_GRANTED = m.Counter(
     "ray_tpu_scheduler_leases_granted_total",
     "Worker leases granted", ("node",))

@@ -254,6 +254,25 @@ class WorkerRuntime:
             except Exception:
                 pass
             os._exit(fi.CRASH_EXIT_CODE)
+        if act["action"] in ("sigkill", "sigsegv", "sigabrt"):
+            # die by REAL signal: unlike `crash` (reserved exit code)
+            # or the lease-level kill_worker (pre-attributed chaos),
+            # the nodelet's classifier sees a genuine signal death —
+            # poison-shaped, counting toward quarantine.  That is the
+            # point: this site exercises the containment machinery.
+            import signal as _sig
+            signo = {"sigkill": _sig.SIGKILL, "sigsegv": _sig.SIGSEGV,
+                     "sigabrt": _sig.SIGABRT}[act["action"]]
+            if act["once"] and not await self._chaos_claim(act["rule_id"]):
+                return
+            try:
+                await self.nodelet.notify(
+                    "chaos_injected", {"site": site,
+                                       "action": act["action"]})
+            except Exception:
+                pass
+            os.kill(os.getpid(), signo)
+            await asyncio.sleep(5)  # SIGKILL delivery is not instant
         if act["action"] == "error":
             raise exceptions.RayTpuError(
                 f"chaos: injected error at {site} ({key})")
@@ -650,6 +669,10 @@ class WorkerRuntime:
         tr = {"task_id": spec.task_id.hex(), "trace": spec.trace_id}
         fname = spec.function_name
         try:
+            if _chaos is not None:
+                # signal-kill at execution start: a real signal death the
+                # nodelet classifies as poison (feeds the crash ledger)
+                await self._chaos_site("worker.exec_crash", fname)
             t0 = time.time()
             args, kwargs, _views = await self._resolve_args(spec)
             t1 = time.time()
@@ -969,6 +992,13 @@ class _ErrorValue:
                 cause = None
         if isinstance(cause, exceptions.TaskCancelledError):
             return cause  # ray.cancel surfaces AS TaskCancelledError
+        if isinstance(cause, (exceptions.PoisonTaskError,
+                              exceptions.ReconstructionDepthError)):
+            return cause  # containment errors surface typed, not wrapped
+        if isinstance(cause, exceptions.ActorQuarantinedError):
+            # subclasses ActorDiedError but carries the quarantine
+            # evidence — must win over the generic actor_down path
+            return cause
         if getattr(self, "actor_down", False):
             return exceptions.ActorDiedError("", self.traceback_str)
         cls = exceptions.ActorError if self.is_actor else exceptions.TaskError

@@ -34,6 +34,28 @@ class ActorDiedError(RayTpuError):
         super().__init__(f"{who} died: {reason}")
 
 
+class ActorQuarantinedError(ActorDiedError):
+    """An actor crash-looped into the QUARANTINED state.
+
+    Raised to callers of an actor whose restarts exhausted the rolling
+    restart window on poison-shaped deaths (crash-loop governance):
+    distinguishes "this actor's own code keeps killing its worker" from
+    plain ActorDiedError so callers stop resubmitting instead of
+    retrying.  Subclasses ActorDiedError so replica routers and other
+    existing handlers keep working.  The quarantine clears on TTL or
+    ``ray-tpu quarantine clear`` (the actor then resumes RESTARTING)."""
+
+    def __init__(self, actor_id_hex: str, reason: str):
+        self._init_args = (actor_id_hex, reason)
+        super().__init__(actor_id_hex, f"QUARANTINED (crash loop): {reason}")
+
+    def __reduce__(self):
+        # Exception.__reduce__ would replay the FORMATTED message into
+        # __init__ — these errors cross process boundaries pickled, so
+        # reconstruct from the original arguments instead
+        return (self.__class__, self._init_args)
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing a task died unexpectedly."""
 
@@ -147,6 +169,59 @@ class FunctionUnavailableError(RayTpuError):
         super().__init__(
             f"function {fid_hex[:12]} blob unavailable: {detail or 'lost'} "
             f"(owner re-registration required)")
+
+
+class PoisonTaskError(RayTpuError):
+    """A task signature was quarantined after repeatedly killing workers.
+
+    The controller's crash ledger counted ``poison_task_threshold``
+    poison-shaped worker deaths (SIGSEGV, oom_kill, clean nonzero exit)
+    for one task signature inside ``poison_window_s`` — across the
+    crash-site anti-affinity spread, so a single bad host is ruled out —
+    and fails further executions fast instead of burning more workers.
+    ``evidence`` carries the trail: one ``{"node", "cause", "ts"}``
+    entry per kill.  Clears on TTL expiry or ``ray-tpu quarantine
+    clear``."""
+
+    def __reduce__(self):
+        # survive the pickle boundary with the evidence trail intact
+        return (self.__class__, (self.signature, self.evidence,
+                                 self.until))
+
+    def __init__(self, signature: str, evidence=None, until: float = 0.0):
+        self.signature = signature
+        self.evidence = list(evidence or [])
+        self.until = until
+        nodes = sorted({e.get("node", "?")[:12] for e in self.evidence})
+        causes = [f"{e.get('cause', '?')}@{e.get('node', '?')[:8]}"
+                  for e in self.evidence]
+        super().__init__(
+            f"task signature {signature!r} quarantined as poison after "
+            f"{len(self.evidence)} worker deaths on {len(nodes)} node(s) "
+            f"{nodes}: {causes} (clears at TTL or `ray-tpu quarantine "
+            f"clear`)")
+
+
+class ReconstructionDepthError(RayTpuError):
+    """Lineage reconstruction recursed past ``max_reconstruction_depth``.
+
+    Typed replacement for the silent False at the depth check: the
+    message names the oid lineage chain that was being walked, so the
+    owner of a deep a->b->c->... pipeline sees WHERE the recursion blew
+    the budget instead of a generic unreconstructable-object failure."""
+
+    def __reduce__(self):
+        return (self.__class__, (self.chain,))
+
+    def __init__(self, chain):
+        self.chain = [c.hex() if isinstance(c, bytes) else str(c)
+                      for c in chain]
+        shown = " -> ".join(c[:12] for c in self.chain)
+        super().__init__(
+            f"lineage reconstruction exceeded max_reconstruction_depth "
+            f"({len(self.chain) - 1} levels deep) along oid chain "
+            f"{shown}; raise RAY_TPU_MAX_RECONSTRUCTION_DEPTH or "
+            f"checkpoint intermediate objects")
 
 
 class TaskCancelledError(RayTpuError):

@@ -115,6 +115,23 @@ def cmd_status(args) -> None:
             hb = (n.get("health") or {}).get("heartbeat_age_s", "-")
             print(f"{n['id'][:12]:<14} {n.get('state', '?'):<9} "
                   f"{hb:>7}  {detail}")
+    # per-actor restart/containment table: lifetime restart count plus
+    # whether the crash-loop governor has quarantined the actor
+    acts = state.actors()
+    if acts:
+        print(f"\n{'ACTOR':<14} {'CLASS':<18} {'STATE':<12} "
+              f"{'RESTARTS':>8}  {'QUARANTINED'}")
+        for a in acts:
+            aid = a.get("actor_id")
+            aid = aid.hex()[:12] if isinstance(aid, bytes) else str(aid)[:12]
+            print(f"{aid:<14} {str(a.get('class_name', ''))[:18]:<18} "
+                  f"{a.get('state', '?'):<12} "
+                  f"{a.get('num_restarts', 0):>8}  "
+                  f"{'yes' if a.get('quarantined') else 'no'}")
+    q = state.quarantine_list()
+    if q:
+        print(f"\n{len(q)} quarantined signature(s) — "
+              "see `ray-tpu quarantine list`")
     ray_tpu.shutdown()
 
 
@@ -356,6 +373,49 @@ def cmd_controller(args) -> None:
                   f"{repl.get('lag', '-'):>5}  {detail}")
         if not any(r.get("role") == "leader" for r in rows):
             sys.exit("no controller currently claims leadership")
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_quarantine(args) -> None:
+    """Poison-task / crash-loop quarantine control: list the quarantined
+    signatures with their evidence trails (which nodes the signature
+    killed workers on, and why), or clear one signature — or all — to
+    let the work run again immediately instead of waiting out the TTL."""
+    import ray_tpu
+    from ray_tpu.core.driver import get_global_core
+    _connect(args)
+    try:
+        core = get_global_core()
+        if args.op == "list":
+            rows = core.controller.call("quarantine_list", {}, timeout=10)
+            if not rows:
+                print("no quarantined signatures")
+                return
+            now = time.time()
+            print(f"{'SIGNATURE':<40} {'KIND':<12} {'TTL':>6}  EVIDENCE")
+            for r in rows:
+                ttl = max(0.0, float(r.get("until", 0.0)) - now)
+                ev = r.get("evidence") or []
+                nodes = sorted({str(h.get("node", "?"))[:8] for h in ev})
+                causes = sorted({str(h.get("cause", {}).get("kind", "?"))
+                                 if isinstance(h.get("cause"), dict)
+                                 else str(h.get("cause", "?")) for h in ev})
+                print(f"{str(r.get('sig', '?'))[:40]:<40} "
+                      f"{str(r.get('kind', '?')):<12} {ttl:>5.0f}s  "
+                      f"{len(ev)} kills on {nodes} ({','.join(causes)})")
+        elif args.op == "clear":
+            data = {"sig": args.sig} if args.sig else {}
+            reply = core.controller.call("quarantine_clear", data,
+                                         timeout=10)
+            cleared = reply.get("cleared") or []
+            if not cleared:
+                print("nothing to clear" if not args.sig
+                      else f"{args.sig!r} is not quarantined")
+            for sig in cleared:
+                print(f"cleared {sig}")
+        else:
+            sys.exit(f"unknown quarantine op {args.op!r}")
     finally:
         ray_tpu.shutdown()
 
@@ -827,6 +887,16 @@ def main(argv=None) -> None:
     sp.add_argument("op", choices=["status"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_controller)
+
+    sp = sub.add_parser("quarantine",
+                        help="poison-task / crash-loop quarantine "
+                             "(list evidence trails, clear signatures)")
+    sp.add_argument("op", choices=["list", "clear"])
+    sp.add_argument("sig", nargs="?",
+                    help="signature to clear (e.g. task:train_step or "
+                         "actor:Worker:<id>); omit to clear ALL")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_quarantine)
 
     sp = sub.add_parser("chaos",
                         help="fault-injection plan control "

@@ -31,6 +31,22 @@ def list_actors() -> List[Dict[str, Any]]:
     return _ensure_initialized().controller.call("list_actors")
 
 
+def actors() -> List[Dict[str, Any]]:
+    """Alias of :func:`list_actors` (reference naming: state.actors).
+
+    Rows carry restart/containment columns: ``num_restarts`` (lifetime
+    restart count) and ``quarantined`` (True once the controller has
+    crash-loop-quarantined the actor; callers get a typed
+    ``ActorQuarantinedError`` instead of endless restarts).
+    """
+    return list_actors()
+
+
+def quarantine_list() -> List[Dict[str, Any]]:
+    """Poison-task / crash-loop quarantine records (evidence trails)."""
+    return _ensure_initialized().controller.call("quarantine_list")
+
+
 def list_placement_groups() -> List[Dict[str, Any]]:
     return _ensure_initialized().controller.call("list_placement_groups")
 

@@ -48,6 +48,20 @@ site                        actions
                             ``delay``, ``error``
 ``worker.after_put``        same, after the result put (retry must be
                             idempotent against the already-stored object)
+``worker.exec_crash``       ``sigkill``/``sigsegv``/``sigabrt`` signal-
+                            kills the worker at task execution start
+                            (key: function name) — a REAL signal death,
+                            so the nodelet's death attributor classifies
+                            it poison-shaped and the controller's crash
+                            ledger counts it (the poison-wave e2e's
+                            weapon); ``crash``/``error`` behave like the
+                            ``worker.before_put`` variants
+``nodelet.death_classify``  any action degrades the nodelet's death
+                            attribution for that worker death (key:
+                            worker id hex) to cause ``unknown`` —
+                            proves the containment layer fails safe
+                            when the classifier itself is attacked
+                            (unknown is conservatively poison-shaped)
 ``serve.request``           ``crash`` (replica dies mid-request), ``error``,
                             ``delay``/``latency``
 ``serve.health_check``      ``error`` (health check fails)
@@ -192,6 +206,9 @@ KNOWN_SITES: Dict[str, Optional[frozenset]] = {
     "object.fetch_meta": frozenset({"evict"}),
     "worker.before_put": frozenset({"crash", "error"}),
     "worker.after_put": frozenset({"crash", "error"}),
+    "worker.exec_crash": frozenset({"sigkill", "sigsegv", "sigabrt",
+                                    "crash", "error"}),
+    "nodelet.death_classify": None,
     "serve.request": frozenset({"crash", "error", "fail"}),
     "serve.health_check": frozenset({"error", "fail"}),
     "serve.session_failover": frozenset({"error", "fail"}),

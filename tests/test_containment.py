@@ -1,0 +1,506 @@
+"""Blast-radius containment: typed death attribution, poison-task
+quarantine, crash-loop governance, reconstruction-storm dedupe.
+
+The invariant under test: one poisonous task signature (or one
+crash-looping actor) burns a BOUNDED number of workers — at most
+``poison_task_threshold`` deaths cluster-wide — then every caller gets
+a typed error carrying the evidence trail, while unrelated work on the
+same cluster is untouched.  The quarantine table is WAL-replicated, so
+the verdict survives a controller failover.
+
+Layers covered:
+
+1. nodelet death classifier units   (signal decode, pre-marked kills)
+2. controller crash ledger units    (threshold, window, clear, avoid)
+3. quarantine across HA failover    (in-process leader + standby)
+4. e2e poison wave, x2 seeded       (<=3 deaths, healthy wave unharmed)
+5. e2e actor crash loop             (QUARANTINED state, typed error,
+                                     operator clear revives)
+6. reconstruction-storm dedupe      (concurrent callers join one
+                                     in-flight recovery; depth ceiling
+                                     raises the typed chain error)
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+import threading
+import time
+import types
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions, state
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.config import GlobalConfig
+from ray_tpu.core import runtime_metrics as rtm
+from ray_tpu.util import fault_injection as fi
+
+
+@pytest.fixture
+def chaos_cleanup():
+    yield
+    fi.disarm()
+    GlobalConfig.update({"chaos_plan": ""})
+    os.environ.pop("RAY_TPU_CHAOS_PLAN", None)
+
+
+@pytest.fixture
+def cfg_cleanup():
+    """Restore every containment knob (value + exported env) after a
+    test that tightens thresholds/backoffs for speed."""
+    knobs = ("poison_task_threshold", "poison_window_s",
+             "poison_quarantine_ttl_s", "actor_restart_backoff_base_s",
+             "actor_restart_backoff_cap_s", "actor_restart_window_s",
+             "task_retry_delay_s")
+    snap = {k: getattr(GlobalConfig, k) for k in knobs}
+    env = {k: os.environ.get(f"RAY_TPU_{k.upper()}") for k in knobs}
+    yield
+    GlobalConfig.update(snap, export_env=False)
+    for k, v in env.items():
+        if v is None:
+            os.environ.pop(f"RAY_TPU_{k.upper()}", None)
+        else:
+            os.environ[f"RAY_TPU_{k.upper()}"] = v
+
+
+def _metric_sum(text, name, tag=""):
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#") \
+                and tag in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+# ------------------------------------------------ death classifier units
+
+def _bare_nodelet():
+    """A Nodelet shell with just the attribution state the classifier
+    reads — no sockets, no workers."""
+    from ray_tpu.core.nodelet import Nodelet
+    n = Nodelet.__new__(Nodelet)
+    n._intended_kills = set()
+    n._chaos_kills = set()
+    n._oom_victims = set()
+    return n
+
+
+def _corpse(wid=b"\x01" * 8, rc=0):
+    return types.SimpleNamespace(worker_id=wid,
+                                 proc=types.SimpleNamespace(returncode=rc))
+
+
+def test_classifier_decodes_signals_and_exits():
+    n = _bare_nodelet()
+    c = n._classify_death(_corpse(rc=-9))
+    assert c["kind"] == "signal:SIGKILL" and c["poison"]
+    c = n._classify_death(_corpse(rc=-11))
+    assert c["kind"] == "signal:SIGSEGV" and c["poison"]
+    # unknown signal numbers still decode (no crash in the classifier)
+    c = n._classify_death(_corpse(rc=-63))
+    assert c["kind"].startswith("signal:") and c["poison"]
+    c = n._classify_death(_corpse(rc=1))
+    assert c["kind"] == "exit:1" and c["poison"]
+    c = n._classify_death(_corpse(rc=0))
+    assert c["kind"] == "exit:0" and not c["poison"]
+    # the chaos layer's reserved crash exit code reads as INJECTED,
+    # never as user poison — chaos-retry tests must not quarantine
+    c = n._classify_death(_corpse(rc=fi.CRASH_EXIT_CODE))
+    assert c["kind"] == "chaos_kill" and not c["poison"]
+
+
+def test_classifier_premarked_kills_beat_returncode():
+    """Kills the nodelet itself initiated were recorded against the
+    worker id BEFORE the signal went out: the returncode (SIGTERM/
+    SIGKILL — poison-shaped on its own) never gets to guess."""
+    n = _bare_nodelet()
+    wid = b"\x02" * 8
+    n._intended_kills.add(wid)
+    c = n._classify_death(_corpse(wid, rc=-15))
+    assert c["kind"] == "intended_kill" and not c["poison"]
+    n = _bare_nodelet()
+    n._chaos_kills.add(wid)
+    c = n._classify_death(_corpse(wid, rc=-9))
+    assert c["kind"] == "chaos_kill" and not c["poison"]
+    n = _bare_nodelet()
+    n._oom_victims.add(wid)
+    c = n._classify_death(_corpse(wid, rc=-9))
+    assert c["kind"] == "oom_kill" and c["poison"]
+
+
+def test_classifier_chaos_degraded_is_conservative(chaos_cleanup):
+    """nodelet.death_classify chaos degrades attribution itself: an
+    unexplained corpse must count as poison, never as a free retry."""
+    fi.arm([{"site": "nodelet.death_classify", "action": "error"}])
+    n = _bare_nodelet()
+    c = n._classify_death(_corpse(rc=0))
+    assert c["kind"] == "unknown" and c["poison"]
+
+
+def test_nodelet_lease_refuses_quarantined_signature():
+    """The heartbeat-fed quarantine view makes EVERY nodelet refuse the
+    signature at lease time — no worker is burned to rediscover the
+    verdict; expiry reopens it without a controller round-trip."""
+    from ray_tpu.core.nodelet import Nodelet
+    n = Nodelet.__new__(Nodelet)
+    n._quarantine_view = {"task:venom": {"sig": "task:venom",
+                                         "until": time.time() + 60}}
+    assert n._poisoned("venom")["sig"] == "task:venom"
+    assert n._poisoned("other") is None
+    n._quarantine_view["task:venom"]["until"] = time.time() - 1
+    assert n._poisoned("venom") is None
+
+
+# ------------------------------------------- crash ledger units (in-proc)
+
+async def _one_controller(tmp):
+    from ray_tpu.core.controller import Controller
+    c = Controller(port=0, persist_dir=tmp)
+    await c.start()
+    return c
+
+
+def _crash(node, kind="signal:SIGKILL", poison=True):
+    return {"sig": "task:venom", "node_id": node,
+            "cause": {"kind": kind, "poison": poison, "node": node}}
+
+
+def test_ledger_threshold_counts_only_poison(cfg_cleanup):
+    """Preemption-shaped deaths (chaos/planned kills) never count
+    toward quarantine; the Nth POISON hit inside the window trips it,
+    and the reply's avoid-set names every crash site seen so far."""
+    GlobalConfig.update({"poison_task_threshold": 3}, export_env=False)
+
+    async def main():
+        with tempfile.TemporaryDirectory() as tmp:
+            c = await _one_controller(tmp)
+            try:
+                r = await c._h_report_task_crash(None, _crash("nodeA"))
+                assert r["quarantined"] is None
+                # chaos kill: free retry, not a poison hit
+                r = await c._h_report_task_crash(
+                    None, _crash("nodeB", "chaos_kill", poison=False))
+                assert r["quarantined"] is None
+                r = await c._h_report_task_crash(None, _crash("nodeB"))
+                assert r["quarantined"] is None
+                assert r["avoid"] == ["nodeA", "nodeB"]
+                r = await c._h_report_task_crash(None, _crash("nodeC"))
+                q = r["quarantined"]
+                assert q is not None and q["sig"] == "task:venom"
+                assert q["kind"] == "task"
+                assert len(q["evidence"]) == 4  # whole window, typed
+                assert {e["node"] for e in q["evidence"]} == \
+                    {"nodeA", "nodeB", "nodeC"}
+                assert "task:venom" in c.quarantine
+                rows = await c._h_quarantine_list(None, {})
+                assert [x["sig"] for x in rows] == ["task:venom"]
+                # operator clear reopens the signature
+                out = await c._h_quarantine_clear(None,
+                                                  {"sig": "task:venom"})
+                assert out["cleared"] == ["task:venom"]
+                assert not c.quarantine
+            finally:
+                await c.stop()
+    asyncio.run(main())
+
+
+def test_ledger_window_prunes_stale_hits(cfg_cleanup):
+    """Two poison hits that aged out of poison_window_s plus one fresh
+    hit is ONE hit, not three — no quarantine."""
+    GlobalConfig.update({"poison_task_threshold": 3,
+                         "poison_window_s": 5.0}, export_env=False)
+
+    async def main():
+        with tempfile.TemporaryDirectory() as tmp:
+            c = await _one_controller(tmp)
+            try:
+                for _ in range(2):
+                    await c._h_report_task_crash(None, _crash("nodeA"))
+                for h in c.crash_ledger["task:venom"]:
+                    h["ts"] -= 60.0  # age them out of the window
+                r = await c._h_report_task_crash(None, _crash("nodeB"))
+                assert r["quarantined"] is None
+                assert len(c.crash_ledger["task:venom"]) == 1
+            finally:
+                await c.stop()
+    asyncio.run(main())
+
+
+def test_quarantine_ttl_expiry_is_a_wal_decision(cfg_cleanup):
+    """TTL expiry happens ONLY in the leader's runtime loop (an explicit
+    quarantine_del WAL record) — never inside replay — and a cold
+    restart from the WAL agrees byte-for-byte."""
+    GlobalConfig.update({"poison_task_threshold": 2,
+                         "poison_quarantine_ttl_s": 0.6},
+                        export_env=False)
+
+    async def main():
+        with tempfile.TemporaryDirectory() as tmp:
+            c = await _one_controller(tmp)
+            try:
+                for node in ("nodeA", "nodeB"):
+                    await c._h_report_task_crash(None, _crash(node))
+                assert "task:venom" in c.quarantine
+                deadline = time.monotonic() + 10
+                while c.quarantine and time.monotonic() < deadline:
+                    await asyncio.sleep(0.1)
+                assert not c.quarantine, "TTL sweep never fired"
+            finally:
+                await c.stop()
+            # replay: the del record makes the restart agree
+            from ray_tpu.core.controller import Controller
+            c2 = Controller(port=0, persist_dir=tmp)
+            await c2.start()
+            try:
+                assert not c2.quarantine
+            finally:
+                await c2.stop()
+    asyncio.run(main())
+
+
+def test_quarantine_survives_ha_failover(cfg_cleanup):
+    """The tentpole durability claim: the quarantine verdict is WAL-
+    replicated, so the promoted standby still refuses the signature."""
+    GlobalConfig.update({"poison_task_threshold": 3}, export_env=False)
+
+    async def main():
+        from ray_tpu.core.controller import Controller
+        from ray_tpu.core import rpc
+
+        async def dial(ctrl):
+            host, port = ctrl.address.rsplit(":", 1)
+            return await rpc.connect(host, int(port))
+
+        with tempfile.TemporaryDirectory() as tmp:
+            leader = Controller(port=0, persist_dir=f"{tmp}/leader",
+                                lease_timeout_s=1.0)
+            await leader.start()
+            standby = Controller(port=0, persist_dir=f"{tmp}/standby",
+                                 standby_of=leader.address,
+                                 lease_timeout_s=1.0)
+            await standby.start()
+            deadline = time.monotonic() + 10
+            while leader.ha.standby is None \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert leader.ha.standby is not None
+            try:
+                conn = await dial(leader)
+                for node in ("nodeA", "nodeB", "nodeC"):
+                    r = await conn.call("report_task_crash",
+                                        _crash(node))
+                assert r["quarantined"] is not None
+                await conn.close()
+                await leader.stop()
+                t0 = time.monotonic()
+                while not standby.ha.is_leader \
+                        and time.monotonic() - t0 < 10:
+                    await asyncio.sleep(0.05)
+                assert standby.ha.is_leader, "standby never promoted"
+                c2 = await dial(standby)
+                rows = await c2.call("quarantine_list", {})
+                assert [x["sig"] for x in rows] == ["task:venom"]
+                assert {e["node"] for e in rows[0]["evidence"]} == \
+                    {"nodeA", "nodeB", "nodeC"}
+                await c2.close()
+            finally:
+                await standby.stop()
+    asyncio.run(main())
+
+
+# -------------------------------------------------- e2e poison task wave
+
+@pytest.mark.parametrize("run", [1, 2])
+def test_poison_wave_contained(chaos_cleanup, cfg_cleanup, run):
+    """THE containment scenario, seeded x2: a 200-task wave where one
+    signature is chaos-SIGKILLed at every execution.  The poisonous
+    signature burns at most poison_task_threshold workers cluster-wide,
+    then every caller gets the typed PoisonTaskError with the evidence
+    trail (>=2 distinct crash sites: anti-affinity steered the
+    retries); the 199 healthy tasks all complete."""
+    GlobalConfig.update({"task_retry_delay_s": 0.1})
+    cluster = Cluster(chaos_plan=[
+        {"site": "worker.exec_crash",
+         "match": {"regex": "venom_task", "seed": run},
+         "action": "sigkill"}])
+    try:
+        for _ in range(3):
+            cluster.add_node(num_cpus=4)
+        cluster.connect()
+
+        @ray_tpu.remote
+        def healthy(i):
+            return i * 2
+
+        @ray_tpu.remote(max_retries=6)
+        def venom_task():
+            return "never"
+
+        refs = [healthy.remote(i) for i in range(199)]
+        poison_ref = venom_task.remote()
+
+        # the healthy wave is untouched by the quarantine storm
+        assert ray_tpu.get(refs, timeout=180.0) == \
+            [i * 2 for i in range(199)]
+
+        with pytest.raises(exceptions.PoisonTaskError) as ei:
+            ray_tpu.get(poison_ref, timeout=180.0)
+        err = ei.value
+        assert err.signature == "task:venom_task"
+        # blast radius: at most threshold deaths despite 6 retries left
+        assert len(err.evidence) <= GlobalConfig.poison_task_threshold
+        nodes = {e["node"] for e in err.evidence}
+        assert len(nodes) >= 2, \
+            f"anti-affinity never spread the retries: {nodes}"
+        assert all(e["cause"] == "signal:SIGKILL" for e in err.evidence)
+
+        # a LATER submission of the same signature fails fast at lease
+        # time — no worker is ever burned on it
+        t0 = time.monotonic()
+        with pytest.raises(exceptions.PoisonTaskError):
+            ray_tpu.get(venom_task.remote(), timeout=60.0)
+        assert time.monotonic() - t0 < 30.0
+
+        # the flight instruments moved: typed death causes + quarantine
+        def visible():
+            text = state.cluster_metrics_text()
+            deaths = _metric_sum(text, "ray_tpu_task_deaths_total",
+                                 'cause="signal:SIGKILL"')
+            quars = _metric_sum(text, "ray_tpu_quarantines_total",
+                                'kind="task"')
+            return deaths, quars
+        deadline = time.monotonic() + 20.0
+        deaths, quars = visible()
+        while quars < 1 and time.monotonic() < deadline:
+            time.sleep(0.25)
+            deaths, quars = visible()
+        assert 1 <= deaths <= GlobalConfig.poison_task_threshold
+        assert quars >= 1
+        assert state.quarantine_list()[0]["sig"] == "task:venom_task"
+    finally:
+        cluster.shutdown()
+
+
+# ------------------------------------------------- e2e actor crash loop
+
+def test_actor_crash_loop_quarantined_then_cleared(cfg_cleanup,
+                                                   tmp_path):
+    """An actor whose method murders its worker every incarnation
+    exhausts its rolling restart window and lands in QUARANTINED (not
+    an endless RESTARTING grind): callers get the typed
+    ActorQuarantinedError, the state surfaces in state.actors(), and an
+    operator clear revives it with a fresh budget."""
+    GlobalConfig.update({"actor_restart_backoff_base_s": 0.05,
+                         "actor_restart_backoff_cap_s": 0.2,
+                         "task_retry_delay_s": 0.1})
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        defuse_flag = str(tmp_path / "defused")
+
+        @ray_tpu.remote(max_restarts=2)
+        class Grenade:
+            def __init__(self, flag):
+                self.flag = flag  # filesystem flag: worker-visible
+
+            def ping(self):
+                if not os.path.exists(self.flag):
+                    os._exit(1)  # poison-shaped: clean nonzero exit
+                return "pong"
+
+        g = Grenade.remote(defuse_flag)
+        saw_quarantine = None
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get(g.ping.remote(), timeout=30.0)
+            except exceptions.ActorQuarantinedError as e:
+                saw_quarantine = e
+                break
+            except Exception:
+                time.sleep(0.2)  # mid-restart: keep poking
+        assert saw_quarantine is not None, \
+            "crash loop never reached QUARANTINED"
+        assert isinstance(saw_quarantine, exceptions.ActorDiedError)
+
+        rows = [a for a in state.actors()
+                if a.get("class_name") == "Grenade"]
+        assert rows and rows[0]["quarantined"]
+        assert rows[0]["state"] == "QUARANTINED"
+        assert rows[0]["num_restarts"] == 2
+
+        q = state.quarantine_list()
+        assert q and q[0]["kind"] == "actor"
+        assert q[0]["sig"].startswith("actor:Grenade:")
+
+        # operator clear: fresh window, actor reschedules and (defused
+        # via the flag file) answers again
+        with open(defuse_flag, "w") as f:
+            f.write("1")
+        from ray_tpu.core.driver import get_global_core
+        core = get_global_core()
+        out = core.controller.call("quarantine_clear", {})
+        assert q[0]["sig"] in out["cleared"]
+        deadline = time.monotonic() + 60.0
+        pong = None
+        while time.monotonic() < deadline and pong != "pong":
+            try:
+                pong = ray_tpu.get(g.ping.remote(), timeout=30.0)
+            except Exception:
+                time.sleep(0.2)
+        assert pong == "pong", "cleared actor never came back"
+    finally:
+        ray_tpu.shutdown()
+
+
+# -------------------------------------- reconstruction storm governance
+
+def test_reconstruction_dedupe_and_depth_ceiling():
+    """Concurrent reconstructions of the SAME lost object join one
+    in-flight recovery (counted in dedup_total) instead of resubmitting
+    the producer N times; crossing the lineage-depth ceiling raises the
+    typed ReconstructionDepthError carrying the oid chain."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        from ray_tpu.core.driver import get_global_core
+        core = get_global_core()
+        oid = b"\xab" * 16
+        started = threading.Event()
+
+        def slow_inner(o, timeout, depth, chain):
+            started.set()
+            time.sleep(0.4)
+            return True
+
+        real = core._reconstruct_inner
+        core._reconstruct_inner = slow_inner
+        dedup0 = sum(rtm.RECONSTRUCTION_DEDUP._values.values())
+        try:
+            results = []
+            ts = [threading.Thread(
+                target=lambda: results.append(
+                    core._reconstruct(oid, 5.0))) for _ in range(5)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+        finally:
+            core._reconstruct_inner = real
+        assert results == [True] * 5
+        dedup = sum(rtm.RECONSTRUCTION_DEDUP._values.values()) - dedup0
+        assert dedup == 4, \
+            f"expected 4 joiners on 1 in-flight recovery, got {dedup}"
+        assert not core._recon_inflight  # table drains after the storm
+
+        # depth ceiling: typed, with the oid chain for the post-mortem
+        with pytest.raises(exceptions.ReconstructionDepthError) as ei:
+            core._reconstruct(
+                oid, 1.0,
+                _depth=GlobalConfig.max_reconstruction_depth + 1,
+                _chain=(b"\xcd" * 16,))
+        assert oid.hex()[:12] in str(ei.value)
+        assert ei.value.chain[-1] == oid.hex()
+    finally:
+        ray_tpu.shutdown()
